@@ -1,0 +1,21 @@
+"""bee-code-interpreter-tpu: a TPU-native sandboxed code-execution service for LLM agents.
+
+A from-scratch rebuild of the capability surface of i-am-bee/bee-code-interpreter
+(reference: /root/reference) designed TPU-first:
+
+- Control plane (this package): asyncio service exposing ``POST /v1/execute``,
+  ``/v1/parse-custom-tool``, ``/v1/execute-custom-tool`` over HTTP (aiohttp) and the
+  equivalent 3 RPCs over gRPC, maintaining a warm pool of single-use sandbox pods.
+  (Reference layer map: SURVEY.md §1; reference API at
+  src/code_interpreter/services/http_server.py:89-160.)
+- In-sandbox executor: a native C++ HTTP server (``executor/``) replacing the
+  reference's Rust server (executor/server.rs:29-201) — workspace file I/O,
+  auto-dependency-install, subprocess execution with timeout, changed-file scan —
+  extended to own the pod's TPU chips and export ICI/DCN topology env.
+- TPU sandbox runtime (``runtime/``, ``models/``, ``ops/``, ``parallel/``): the
+  JAX/XLA-native library available to LLM-submitted code inside the sandbox —
+  transparent numpy→XLA rerouting, device meshes, sharded training steps, ring
+  attention for long sequences, and Pallas kernels for the hot ops.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,48 @@
+"""Service entry point: HTTP + gRPC servers sharing one asyncio loop.
+
+Reference: __main__.py:22-36 (uvicorn + grpc.aio under aiorun). Here: aiohttp
+AppRunner + grpc.aio, plain asyncio.run with signal-driven shutdown.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import signal
+
+from aiohttp import web
+
+from bee_code_interpreter_tpu.application_context import ApplicationContext
+
+logger = logging.getLogger(__name__)
+
+
+async def main() -> None:
+    ctx = ApplicationContext()
+
+    host, _, port = ctx.config.http_listen_addr.rpartition(":")
+    runner = web.AppRunner(ctx.http_server)
+    await runner.setup()
+    site = web.TCPSite(runner, host or "0.0.0.0", int(port))
+    await site.start()
+    logger.info("HTTP server listening on %s", ctx.config.http_listen_addr)
+
+    await ctx.grpc_server.start(ctx.config.grpc_listen_addr)
+    logger.info("gRPC server listening on %s", ctx.config.grpc_listen_addr)
+
+    stop = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        loop.add_signal_handler(sig, stop.set)
+    await stop.wait()
+
+    await ctx.grpc_server.stop()
+    await runner.cleanup()
+
+
+def run() -> None:
+    asyncio.run(main())
+
+
+if __name__ == "__main__":
+    run()

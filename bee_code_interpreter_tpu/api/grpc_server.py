@@ -1,0 +1,179 @@
+"""gRPC server: the 3 CodeInterpreterService RPCs over grpc.aio.
+
+Equivalent surface to the reference's gRPC layer (grpc_server.py:22-71 +
+code_interpreter_servicer.py:33-135): async servicer, optional mTLS, oneof
+success/error responses for the tool RPCs, per-RPC request-id correlation.
+
+grpc_python_plugin isn't available here, so instead of generated ``_pb2_grpc``
+stubs the service is registered through ``grpc.method_handlers_generic_handler``
+with explicit (de)serializers — structurally the same trick as the reference's
+reflection-based generic registrar (grpc_server.py:42-69), minus the generated
+class it reflected over. ``service_stubs()`` builds the matching client-side
+multicallables for health checks and tests.
+"""
+
+from __future__ import annotations
+
+import logging
+
+import grpc
+import grpc.aio
+
+from bee_code_interpreter_tpu.proto import code_interpreter_pb2 as pb
+from bee_code_interpreter_tpu.services.code_executor import CodeExecutor
+from bee_code_interpreter_tpu.services.custom_tool_executor import (
+    CustomToolExecuteError,
+    CustomToolExecutor,
+    CustomToolParseError,
+)
+from bee_code_interpreter_tpu.utils.request_id import new_request_id
+
+logger = logging.getLogger(__name__)
+
+SERVICE_NAME = "code_interpreter.v1.CodeInterpreterService"
+
+_METHODS: dict[str, tuple[type, type]] = {
+    "Execute": (pb.ExecuteRequest, pb.ExecuteResponse),
+    "ParseCustomTool": (pb.ParseCustomToolRequest, pb.ParseCustomToolResponse),
+    "ExecuteCustomTool": (pb.ExecuteCustomToolRequest, pb.ExecuteCustomToolResponse),
+}
+
+
+class CodeInterpreterServicer:
+    """RPC implementations (reference code_interpreter_servicer.py:33-135)."""
+
+    def __init__(
+        self, code_executor: CodeExecutor, custom_tool_executor: CustomToolExecutor
+    ) -> None:
+        self._code_executor = code_executor
+        self._custom_tool_executor = custom_tool_executor
+
+    async def Execute(
+        self, request: pb.ExecuteRequest, context: grpc.aio.ServicerContext
+    ) -> pb.ExecuteResponse:
+        new_request_id()
+        if not request.source_code:
+            await context.abort(grpc.StatusCode.INVALID_ARGUMENT, "source_code is required")
+        logger.info("Executing code: %s", request.source_code)
+        result = await self._code_executor.execute(
+            source_code=request.source_code,
+            files=dict(request.files),
+            env=dict(request.env),  # env forwarded, unlike reference (:67-70)
+        )
+        return pb.ExecuteResponse(
+            stdout=result.stdout,
+            stderr=result.stderr,
+            exit_code=result.exit_code,
+            files=result.files,
+        )
+
+    async def ParseCustomTool(
+        self, request: pb.ParseCustomToolRequest, context: grpc.aio.ServicerContext
+    ) -> pb.ParseCustomToolResponse:
+        new_request_id()
+        try:
+            tool = self._custom_tool_executor.parse(request.tool_source_code)
+        except CustomToolParseError as e:
+            return pb.ParseCustomToolResponse(
+                error=pb.ParseCustomToolResponse.ErrorResponse(
+                    error_messages=e.error_messages
+                )
+            )
+        import json
+
+        return pb.ParseCustomToolResponse(
+            success=pb.ParseCustomToolResponse.SuccessResponse(
+                tool_name=tool.name,
+                tool_input_schema_json=json.dumps(tool.input_schema),
+                tool_description=tool.description,
+            )
+        )
+
+    async def ExecuteCustomTool(
+        self, request: pb.ExecuteCustomToolRequest, context: grpc.aio.ServicerContext
+    ) -> pb.ExecuteCustomToolResponse:
+        new_request_id()
+        import json
+
+        try:
+            output = await self._custom_tool_executor.execute(
+                tool_source_code=request.tool_source_code,
+                tool_input_json=request.tool_input_json,
+                env=dict(request.env),
+            )
+        except CustomToolParseError as e:
+            await context.abort(grpc.StatusCode.INVALID_ARGUMENT, "; ".join(e.error_messages))
+        except CustomToolExecuteError as e:
+            return pb.ExecuteCustomToolResponse(
+                error=pb.ExecuteCustomToolResponse.ErrorResponse(stderr=e.stderr)
+            )
+        return pb.ExecuteCustomToolResponse(
+            success=pb.ExecuteCustomToolResponse.SuccessResponse(
+                tool_output_json=json.dumps(output)
+            )
+        )
+
+
+def _generic_handler(servicer: CodeInterpreterServicer) -> grpc.GenericRpcHandler:
+    handlers = {
+        name: grpc.unary_unary_rpc_method_handler(
+            getattr(servicer, name),
+            request_deserializer=req_cls.FromString,
+            response_serializer=resp_cls.SerializeToString,
+        )
+        for name, (req_cls, resp_cls) in _METHODS.items()
+    }
+    return grpc.method_handlers_generic_handler(SERVICE_NAME, handlers)
+
+
+def service_stubs(channel: grpc.aio.Channel | grpc.Channel) -> dict[str, object]:
+    """Client-side multicallables for the 3 RPCs (health_check + tests)."""
+    return {
+        name: channel.unary_unary(
+            f"/{SERVICE_NAME}/{name}",
+            request_serializer=req_cls.SerializeToString,
+            response_deserializer=resp_cls.FromString,
+        )
+        for name, (req_cls, resp_cls) in _METHODS.items()
+    }
+
+
+class GrpcServer:
+    def __init__(
+        self,
+        code_executor: CodeExecutor,
+        custom_tool_executor: CustomToolExecutor,
+        tls_cert: bytes | None = None,
+        tls_cert_key: bytes | None = None,
+        tls_ca_cert: bytes | None = None,
+    ) -> None:
+        self._servicer = CodeInterpreterServicer(code_executor, custom_tool_executor)
+        self._tls_cert = tls_cert
+        self._tls_cert_key = tls_cert_key
+        self._tls_ca_cert = tls_ca_cert
+        self._server: grpc.aio.Server | None = None
+
+    async def start(self, listen_addr: str) -> int:
+        """Start serving; returns the bound port (useful with ':0')."""
+        self._server = grpc.aio.server()
+        self._server.add_generic_rpc_handlers((_generic_handler(self._servicer),))
+        if self._tls_cert and self._tls_cert_key:
+            # mTLS when a CA is provided (reference application_context.py:102-110).
+            creds = grpc.ssl_server_credentials(
+                [(self._tls_cert_key, self._tls_cert)],
+                root_certificates=self._tls_ca_cert,
+                require_client_auth=self._tls_ca_cert is not None,
+            )
+            port = self._server.add_secure_port(listen_addr, creds)
+        else:
+            port = self._server.add_insecure_port(listen_addr)
+        await self._server.start()
+        return port
+
+    async def stop(self, grace: float = 5.0) -> None:
+        if self._server is not None:
+            await self._server.stop(grace)
+
+    async def wait_for_termination(self) -> None:
+        if self._server is not None:
+            await self._server.wait_for_termination()

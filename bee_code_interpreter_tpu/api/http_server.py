@@ -1,0 +1,108 @@
+"""HTTP API server (aiohttp).
+
+Same route surface and status-code contract as the reference's FastAPI app
+(http_server.py:77-162): ``POST /v1/execute`` (500 on executor failure),
+``POST /v1/parse-custom-tool`` (400 + ``{error_messages}`` on parse error),
+``POST /v1/execute-custom-tool`` (400 + ``{stderr}`` on tool failure), plus
+``GET /healthz``. FastAPI/uvicorn are not available in this environment;
+aiohttp is the asyncio-native equivalent and shares the event loop with the
+gRPC server exactly as the reference's uvicorn does (reference __main__.py:24-34).
+
+Request validation errors (pydantic) return 422 like FastAPI would.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+
+import pydantic
+from aiohttp import web
+
+from bee_code_interpreter_tpu.api import models
+from bee_code_interpreter_tpu.services.code_executor import CodeExecutor
+from bee_code_interpreter_tpu.services.custom_tool_executor import (
+    CustomToolExecuteError,
+    CustomToolExecutor,
+    CustomToolParseError,
+)
+from bee_code_interpreter_tpu.utils.request_id import new_request_id
+
+logger = logging.getLogger(__name__)
+
+
+def create_http_server(
+    code_executor: CodeExecutor,
+    custom_tool_executor: CustomToolExecutor,
+) -> web.Application:
+    app = web.Application(client_max_size=1 << 30)
+
+    @web.middleware
+    async def request_id_middleware(request: web.Request, handler):
+        new_request_id()
+        return await handler(request)
+
+    app.middlewares.append(request_id_middleware)
+
+    async def parse_body(request: web.Request, model: type[pydantic.BaseModel]):
+        try:
+            # pydantic v2 handles malformed JSON itself (json_invalid → 422).
+            return model.model_validate_json(await request.read())
+        except pydantic.ValidationError as e:
+            raise web.HTTPUnprocessableEntity(
+                text=e.json(), content_type="application/json"
+            ) from e
+
+    async def execute(request: web.Request) -> web.Response:
+        req = await parse_body(request, models.ExecuteRequest)
+        logger.info("Executing code: %s", req.source_code)
+        try:
+            result = await code_executor.execute(
+                source_code=req.source_code, files=req.files, env=req.env
+            )
+        except Exception:
+            logger.exception("Execution failed")
+            return web.json_response({"detail": "Execution failed"}, status=500)
+        logger.info("Execution result: exit_code=%s", result.exit_code)
+        return web.json_response(
+            models.ExecuteResponse(**result.model_dump()).model_dump()
+        )
+
+    async def parse_custom_tool(request: web.Request) -> web.Response:
+        req = await parse_body(request, models.ParseCustomToolRequest)
+        try:
+            tool = custom_tool_executor.parse(req.tool_source_code)
+        except CustomToolParseError as e:
+            return web.json_response({"error_messages": e.error_messages}, status=400)
+        return web.json_response(
+            models.ParseCustomToolResponse(
+                tool_name=tool.name,
+                tool_input_schema_json=json.dumps(tool.input_schema),
+                tool_description=tool.description,
+            ).model_dump()
+        )
+
+    async def execute_custom_tool(request: web.Request) -> web.Response:
+        req = await parse_body(request, models.ExecuteCustomToolRequest)
+        try:
+            output = await custom_tool_executor.execute(
+                tool_source_code=req.tool_source_code,
+                tool_input_json=req.tool_input_json,
+                env=req.env,
+            )
+        except CustomToolParseError as e:
+            return web.json_response({"error_messages": e.error_messages}, status=400)
+        except CustomToolExecuteError as e:
+            return web.json_response({"stderr": e.stderr}, status=400)
+        return web.json_response(
+            models.ExecuteCustomToolResponse(tool_output_json=json.dumps(output)).model_dump()
+        )
+
+    async def healthz(_request: web.Request) -> web.Response:
+        return web.json_response({"status": "ok"})
+
+    app.router.add_post("/v1/execute", execute)
+    app.router.add_post("/v1/parse-custom-tool", parse_custom_tool)
+    app.router.add_post("/v1/execute-custom-tool", execute_custom_tool)
+    app.router.add_get("/healthz", healthz)
+    return app

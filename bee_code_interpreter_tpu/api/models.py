@@ -1,0 +1,48 @@
+"""HTTP API request/response models (reference http_server.py:36-74)."""
+
+from __future__ import annotations
+
+from pydantic import BaseModel, Field
+
+from bee_code_interpreter_tpu.utils.validation import AbsolutePath, Hash
+
+
+class ExecuteRequest(BaseModel):
+    source_code: str
+    files: dict[AbsolutePath, Hash] = Field(default_factory=dict)
+    env: dict[str, str] = Field(default_factory=dict)
+
+
+class ExecuteResponse(BaseModel):
+    stdout: str
+    stderr: str
+    exit_code: int
+    files: dict[AbsolutePath, Hash]
+
+
+class ParseCustomToolRequest(BaseModel):
+    tool_source_code: str
+
+
+class ParseCustomToolResponse(BaseModel):
+    tool_name: str
+    tool_input_schema_json: str
+    tool_description: str
+
+
+class ParseCustomToolErrorResponse(BaseModel):
+    error_messages: list[str]
+
+
+class ExecuteCustomToolRequest(BaseModel):
+    tool_source_code: str
+    tool_input_json: str
+    env: dict[str, str] = Field(default_factory=dict)
+
+
+class ExecuteCustomToolResponse(BaseModel):
+    tool_output_json: str
+
+
+class ExecuteCustomToolErrorResponse(BaseModel):
+    stderr: str

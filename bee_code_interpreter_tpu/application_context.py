@@ -1,0 +1,87 @@
+"""Composition root: wires config → storage → executors → servers.
+
+Same role and shape as the reference's ApplicationContext
+(application_context.py:36-125): lazy ``cached_property`` singletons, logging
+dictConfig + request-id filter installed at construction, pod-pool warmup
+kicked off on first access to the Kubernetes executor.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging.config
+from functools import cached_property
+
+from bee_code_interpreter_tpu.config import Config
+from bee_code_interpreter_tpu.services.custom_tool_executor import CustomToolExecutor
+from bee_code_interpreter_tpu.services.storage import Storage
+from bee_code_interpreter_tpu.utils.request_id import install_request_id_filter
+
+
+class ApplicationContext:
+    def __init__(self, config: Config | None = None) -> None:
+        self.config = config or Config.from_env()
+        logging.config.dictConfig(self.config.logging_config)
+        install_request_id_filter()
+
+    @cached_property
+    def storage(self) -> Storage:
+        return Storage(storage_path=self.config.file_storage_path)
+
+    @cached_property
+    def code_executor(self):
+        if self.config.executor_backend == "local":
+            from bee_code_interpreter_tpu.services.local_code_executor import (
+                LocalCodeExecutor,
+            )
+
+            return LocalCodeExecutor(
+                storage=self.storage,
+                workspace_root=self.config.local_workspace_root,
+                disable_dep_install=self.config.disable_dep_install,
+                execution_timeout_s=self.config.execution_timeout_s,
+            )
+        from bee_code_interpreter_tpu.services.kubectl import Kubectl
+        from bee_code_interpreter_tpu.services.kubernetes_code_executor import (
+            KubernetesCodeExecutor,
+        )
+
+        executor = KubernetesCodeExecutor(
+            kubectl=Kubectl(),
+            storage=self.storage,
+            config=self.config,
+        )
+        # Pool warmup starts as soon as the executor exists (reference
+        # application_context.py:83). Outside a running loop (e.g. tests
+        # constructing the context), warmup is deferred — the pool refills on
+        # first use anyway.
+        try:
+            asyncio.get_running_loop().create_task(executor.fill_executor_pod_queue())
+        except RuntimeError:
+            pass
+        return executor
+
+    @cached_property
+    def custom_tool_executor(self) -> CustomToolExecutor:
+        return CustomToolExecutor(code_executor=self.code_executor)
+
+    @cached_property
+    def http_server(self):
+        from bee_code_interpreter_tpu.api.http_server import create_http_server
+
+        return create_http_server(
+            code_executor=self.code_executor,
+            custom_tool_executor=self.custom_tool_executor,
+        )
+
+    @cached_property
+    def grpc_server(self):
+        from bee_code_interpreter_tpu.api.grpc_server import GrpcServer
+
+        return GrpcServer(
+            code_executor=self.code_executor,
+            custom_tool_executor=self.custom_tool_executor,
+            tls_cert=self.config.grpc_tls_cert,
+            tls_cert_key=self.config.grpc_tls_cert_key,
+            tls_ca_cert=self.config.grpc_tls_ca_cert,
+        )

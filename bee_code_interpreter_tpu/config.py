@@ -1,0 +1,124 @@
+"""Service configuration.
+
+Single env-overridable config object with the ``APP_`` prefix, matching the
+reference's production-config surface (reference: src/code_interpreter/config.py:18-80,
+README.md:159). pydantic-settings is not available in this environment, so env
+loading is implemented directly on top of pydantic: scalar fields parse from the
+raw string, dict/list-valued fields (container resources, pod-spec extras,
+logging config, TPU node selectors) parse from JSON env strings — the documented
+way deployments inject gVisor ``runtimeClassName``, resource limits, and TPU
+node-pool selectors.
+
+TPU additions beyond the reference's fields: executor backend selection
+(``kubernetes`` | ``local``), slice topology (accelerator type, chips per host,
+hosts per slice) used by the pod-group scheduler, and the execution timeout that
+the reference hardcoded in the executor (executor/server.rs:151).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Literal
+
+from pydantic import BaseModel, Field
+
+
+def _default_logging_config() -> dict[str, Any]:
+    return {
+        "version": 1,
+        "disable_existing_loggers": False,
+        "formatters": {
+            "default": {
+                "format": "%(asctime)s [%(levelname)s] [%(request_id)s] %(name)s: %(message)s",
+            }
+        },
+        "handlers": {
+            "default": {
+                "class": "logging.StreamHandler",
+                "formatter": "default",
+                "stream": "ext://sys.stderr",
+            }
+        },
+        "root": {"level": "WARNING", "handlers": ["default"]},
+        "loggers": {
+            "bee_code_interpreter_tpu": {"level": "INFO"},
+            "aiohttp.access": {"level": "INFO"},
+        },
+    }
+
+
+class Config(BaseModel):
+    """All service configuration; every field overridable via ``APP_<UPPER_NAME>``."""
+
+    # --- network listeners (reference config.py:50-53) ---
+    http_listen_addr: str = "0.0.0.0:50081"
+    grpc_listen_addr: str = "0.0.0.0:50051"
+
+    # --- optional gRPC mTLS (reference config.py:56-62) ---
+    grpc_tls_cert: bytes | None = None
+    grpc_tls_cert_key: bytes | None = None
+    grpc_tls_ca_cert: bytes | None = None
+
+    # --- executor backend ---
+    # Default is "local" until the Kubernetes pod-pool backend lands; the
+    # production default will be "kubernetes" for parity with the reference.
+    executor_backend: Literal["kubernetes", "local"] = "local"
+    executor_image: str = "bee-code-interpreter-tpu-executor:local"
+    executor_container_resources: dict[str, Any] = Field(default_factory=dict)
+    executor_pod_spec_extra: dict[str, Any] = Field(default_factory=dict)
+    executor_pod_queue_target_length: int = 5
+    executor_pod_name_prefix: str = "code-executor-"
+    executor_port: int = 8000
+    # Per-execution wall-clock timeout, plumbed through to the sandbox executor
+    # (the reference hardcoded 60s in the executor and never set the request
+    # field: executor/server.rs:151, kubernetes_code_executor.py:117-123).
+    execution_timeout_s: float = 60.0
+    # Service→pod HTTP client timeout (reference kubernetes_code_executor.py:95-97).
+    executor_http_timeout_s: float = 60.0
+    # Cold pod spawn readiness bound (reference kubernetes_code_executor.py:239-241).
+    pod_ready_timeout_s: float = 60.0
+
+    # --- object storage (reference config.py:74) ---
+    file_storage_path: str = "./.tmp/files"
+
+    # --- TPU slice topology (new; consumed by the pod-group scheduler) ---
+    # Accelerator type label value, e.g. "tpu-v5-lite-podslice" on GKE.
+    tpu_accelerator_type: str | None = None
+    # Topology label value, e.g. "2x4" (8 chips, 1 host) or "8x8" (64 chips, 8 hosts).
+    tpu_topology: str | None = None
+    # Hosts per slice: >1 makes the scheduler gang-schedule a pod *group* and
+    # plumb jax.distributed coordination env into every member.
+    tpu_hosts_per_slice: int = 1
+    tpu_chips_per_host: int = 8
+    # Extra nodeSelector entries for TPU node pools.
+    tpu_node_selector: dict[str, str] = Field(default_factory=dict)
+
+    # --- local backend ---
+    # Path to the native executor binary; when unset, the pure-Python in-process
+    # executor (the test fake the reference never had; SURVEY.md §4) is used.
+    local_executor_binary: str | None = None
+    local_workspace_root: str = "./.tmp/workspaces"
+    # Disable auto `pip install` of guessed deps (tests / air-gapped envs).
+    disable_dep_install: bool = False
+
+    logging_config: dict[str, Any] = Field(default_factory=_default_logging_config)
+
+    @classmethod
+    def from_env(cls, env: dict[str, str] | None = None, prefix: str = "APP_") -> "Config":
+        env = os.environ if env is None else env
+        kwargs: dict[str, Any] = {}
+        for name, field in cls.model_fields.items():
+            raw = env.get(prefix + name.upper())
+            if raw is None or raw == "":  # env_ignore_empty semantics (reference config.py:19)
+                continue
+            ann = str(field.annotation)
+            if "dict" in ann or "list" in ann:
+                kwargs[name] = json.loads(raw)
+            elif "bytes" in ann:
+                kwargs[name] = raw.encode()
+            elif field.annotation is bool or "bool" in ann:
+                kwargs[name] = raw.lower() in ("1", "true", "yes", "on")
+            else:
+                kwargs[name] = raw
+        return cls(**kwargs)

@@ -1,0 +1,52 @@
+"""Liveness probe: asserts the full execute path end-to-end over gRPC.
+
+Reference: health_check.py:25-53 — Execute("print(21 * 2)") must return stdout
+"42\\n". Used as the k8s liveness command and as the gate before the e2e suite.
+
+    python -m bee_code_interpreter_tpu.health_check [addr]
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import sys
+
+import grpc.aio
+
+from bee_code_interpreter_tpu.api.grpc_server import service_stubs
+from bee_code_interpreter_tpu.proto import code_interpreter_pb2 as pb
+
+
+async def check(addr: str) -> None:
+    cert = os.environ.get("APP_GRPC_TLS_CERT")
+    key = os.environ.get("APP_GRPC_TLS_CERT_KEY")
+    ca = os.environ.get("APP_GRPC_TLS_CA_CERT")
+    if cert and key:
+        creds = grpc.ssl_channel_credentials(
+            root_certificates=ca.encode() if ca else None,
+            private_key=key.encode(),
+            certificate_chain=cert.encode(),
+        )
+        channel = grpc.aio.secure_channel(addr, creds)
+    else:
+        channel = grpc.aio.insecure_channel(addr)
+    async with channel:
+        stubs = service_stubs(channel)
+        response = await stubs["Execute"](
+            pb.ExecuteRequest(source_code="print(21 * 2)"), timeout=120
+        )
+    assert response.stdout == "42\n", f"unexpected stdout: {response.stdout!r}"
+    assert response.exit_code == 0, f"unexpected exit code: {response.exit_code}"
+
+
+def main() -> None:
+    addr = sys.argv[1] if len(sys.argv) > 1 else os.environ.get(
+        "APP_GRPC_ADDR", "localhost:50051"
+    )
+    asyncio.run(check(addr))
+    print("healthy")
+
+
+if __name__ == "__main__":
+    main()

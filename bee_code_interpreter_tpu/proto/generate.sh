@@ -1,0 +1,7 @@
+#!/usr/bin/env bash
+# Regenerate code_interpreter_pb2.py from the proto. The gRPC service layer is
+# hand-written (api/grpc_server.py) because grpc_python_plugin is not available
+# in this environment — only message codegen is needed.
+set -euo pipefail
+cd "$(dirname "$0")"
+protoc --python_out=. code_interpreter.proto

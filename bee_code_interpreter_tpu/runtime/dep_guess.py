@@ -1,0 +1,142 @@
+"""Static import → PyPI dependency guesser.
+
+Replaces the reference's out-of-process ``upm guess`` subprocess + sqlite
+import-map (reference: executor/server.rs:126-133, executor/Dockerfile:30-37,
+124-126) with an in-process static scan: parse the submitted source with
+``ast``, collect absolutely-imported top-level module names, drop stdlib and
+preinstalled/skip-listed names, and map the rest through a curated
+import-name → PyPI-package table. No subprocess, no sqlite — this removes a
+per-request fork+exec from the hot path (SURVEY.md §3.2 lists ``upm guess``
+as a latency driver).
+
+The C++ executor implements the same algorithm (executor/dep_guess.cpp) against
+the same table file so both executors agree; this module is also the unit-test
+oracle for that file format.
+"""
+
+from __future__ import annotations
+
+import ast
+import sys
+from pathlib import Path
+
+# Import-name → PyPI-distribution-name, for the common cases where they differ.
+# (Equivalent of upm's pypi_map.sqlite; the executor image ships this as
+# executor/pypi_map.tsv for the C++ server.)
+PYPI_MAP: dict[str, str] = {
+    "attr": "attrs",
+    "bs4": "beautifulsoup4",
+    "cairosvg": "CairoSVG",
+    "cv2": "opencv-python",
+    "Crypto": "pycryptodome",
+    "dateutil": "python-dateutil",
+    "docx": "python-docx",
+    "dotenv": "python-dotenv",
+    "fitz": "pymupdf",
+    "github": "PyGithub",
+    "googleapiclient": "google-api-python-client",
+    "jose": "python-jose",
+    "kubernetes": "kubernetes",
+    "lxml": "lxml",
+    "magic": "python-magic",
+    "mpl_toolkits": "matplotlib",
+    "OpenSSL": "pyOpenSSL",
+    "PIL": "pillow",
+    "pptx": "python-pptx",
+    "psycopg2": "psycopg2-binary",
+    "pydub": "pydub",
+    "pypdf": "pypdf",
+    "PyPDF2": "PyPDF2",
+    "serial": "pyserial",
+    "skimage": "scikit-image",
+    "sklearn": "scikit-learn",
+    "slugify": "python-slugify",
+    "socks": "PySocks",
+    "telegram": "python-telegram-bot",
+    "usb": "pyusb",
+    "yaml": "PyYAML",
+    "zmq": "pyzmq",
+}
+
+# Names that must never be pip-installed: provided by the OS/image, or aliases
+# whose pip name collides with an unrelated/broken dist (reference:
+# executor/requirements-skip.txt:1-26). The TPU image additionally pins the
+# accelerator stack — auto-install must never clobber jax/libtpu versions
+# (SURVEY.md §7 hard part (d)).
+SKIP: frozenset[str] = frozenset(
+    {
+        # accelerator stack — pinned in the image, never reinstall
+        "jax", "jaxlib", "libtpu", "torch", "torch_xla", "flax", "optax",
+        "orbax", "chex", "haiku", "pallas",
+        # OS-package-provided tools that upm-style guessers misattribute
+        "ffmpeg", "pandoc", "magick", "imagemagick",
+        # our own runtime
+        "bee_code_interpreter_tpu",
+    }
+)
+
+
+def guessed_imports(source_code: str) -> set[str]:
+    """Top-level module names imported (absolutely) anywhere in the source."""
+    try:
+        tree = ast.parse(source_code)
+    except SyntaxError:
+        return set()
+    names: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            names.update(alias.name.split(".")[0] for alias in node.names)
+        elif isinstance(node, ast.ImportFrom) and node.level == 0 and node.module:
+            names.add(node.module.split(".")[0])
+    return names
+
+
+def guess_dependencies(
+    source_code: str,
+    preinstalled: frozenset[str] | set[str] = frozenset(),
+    extra_skip: frozenset[str] | set[str] = frozenset(),
+) -> list[str]:
+    """PyPI package names to install before running ``source_code``.
+
+    ``preinstalled`` holds *normalized distribution names* already in the image
+    (loaded from requirements.txt like the reference's REQUIREMENTS set,
+    executor/server.rs:44-67).
+    """
+    deps: set[str] = set()
+    pre = {_normalize(p) for p in preinstalled}
+    for mod in guessed_imports(source_code):
+        if mod in sys.stdlib_module_names or mod in SKIP or mod in extra_skip:
+            continue
+        pkg = PYPI_MAP.get(mod, mod)
+        if _normalize(pkg) in pre or _normalize(mod) in pre:
+            continue
+        deps.add(pkg)
+    return sorted(deps)
+
+
+def _normalize(name: str) -> str:
+    # PEP 503 normalization, plus stripping extras ("pandas[excel]" → "pandas").
+    name = name.split("[", 1)[0].strip()
+    return name.lower().replace("_", "-").replace(".", "-")
+
+
+def load_requirements_set(*paths: str | Path) -> frozenset[str]:
+    """Preinstalled-requirements set from requirements.txt-style files.
+
+    Strips comments, version specifiers, and extras, mirroring the reference's
+    startup loading of /requirements.txt + /requirements-skip.txt
+    (executor/server.rs:44-67, 198-201).
+    """
+    out: set[str] = set()
+    for p in paths:
+        p = Path(p)
+        if not p.exists():
+            continue
+        for line in p.read_text().splitlines():
+            line = line.split("#", 1)[0].strip()
+            if not line:
+                continue
+            for sep in ("==", ">=", "<=", "~=", "!=", ">", "<", ";", "@"):
+                line = line.split(sep, 1)[0]
+            out.add(_normalize(line))
+    return frozenset(out)

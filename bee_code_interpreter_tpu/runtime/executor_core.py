@@ -1,0 +1,251 @@
+"""In-sandbox execution engine: the behavior behind ``POST /execute``.
+
+Pure-Python reference implementation of the sandbox executor's core loop,
+mirrored by the native C++ server (executor/server.cpp). The reference
+implements this in Rust (executor/server.rs:120-179): write script → guess deps
+→ pip install new ones → run under xonsh with timeout → scan changed files.
+
+Deliberate TPU-first departures from the reference:
+
+- **Plain python, not xonsh** — the reference notes ~80 ms/exec startup cost of
+  xonsh as a TODO (server.rs:152); we never pay it. Shell escapes are not part
+  of the capability surface we preserve (LLM code that needs a shell can use
+  subprocess).
+- **Recursive changed-file scan by (mtime_ns, size) snapshot diff** — the
+  reference scans only the workspace top level and compares ctime to a start
+  timestamp (server.rs:98-118), missing nested files and files rewritten with
+  preserved timestamps. We snapshot before and diff after.
+- **TPU env plumbing** — the child process inherits the pod's TPU topology env
+  (TPU_WORKER_ID, TPU_WORKER_HOSTNAMES, coordinator address; SURVEY.md §2
+  "Parallelism strategies") so ``jax.distributed.initialize()`` works out of the
+  box on multi-host slices, and PYTHONPATH is prefixed with the runtime shim dir
+  so the sitecustomize display/XLA patches load (reference sitecustomize.py:1-31).
+- **Warm interpreter option** — hook point for keeping a preheated XLA client
+  (SURVEY.md §7 hard part (c)); see ``warmup()``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import os
+import sys
+import tempfile
+from pathlib import Path
+
+from bee_code_interpreter_tpu.runtime import dep_guess
+
+# Env vars the executor forwards from its own environment into every user
+# process, so JAX/libtpu sees the slice topology the scheduler provisioned.
+TPU_PASSTHROUGH_ENV = (
+    "TPU_WORKER_ID",
+    "TPU_WORKER_HOSTNAMES",
+    "TPU_ACCELERATOR_TYPE",
+    "TPU_TOPOLOGY",
+    "TPU_CHIPS_PER_HOST_BOUNDS",
+    "JAX_COORDINATOR_ADDRESS",
+    "JAX_NUM_PROCESSES",
+    "JAX_PROCESS_ID",
+    "JAX_PLATFORMS",
+    "XLA_FLAGS",
+    "TPU_SKIP_MDS_QUERY",
+)
+
+EXECUTION_TIMED_OUT = "Execution timed out"
+
+
+@dataclasses.dataclass
+class ExecutionOutcome:
+    """Wire shape of the ``POST /execute`` response (minus serialization)."""
+
+    stdout: str
+    stderr: str
+    exit_code: int
+    files: list[str]  # logical absolute paths, e.g. "/workspace/plot.png"
+
+
+def snapshot_workspace(root: Path) -> dict[str, tuple[int, int]]:
+    """{relative path: (mtime_ns, size)} for every regular file under root."""
+    snap: dict[str, tuple[int, int]] = {}
+    for dirpath, _dirnames, filenames in os.walk(root):
+        for name in filenames:
+            p = Path(dirpath) / name
+            try:
+                st = p.stat()
+            except OSError:
+                continue
+            snap[str(p.relative_to(root))] = (st.st_mtime_ns, st.st_size)
+    return snap
+
+
+def changed_files(before: dict[str, tuple[int, int]], after: dict[str, tuple[int, int]]) -> list[str]:
+    return sorted(rel for rel, sig in after.items() if before.get(rel) != sig)
+
+
+class ExecutorCore:
+    """One sandbox's execution engine, bound to a workspace directory.
+
+    ``logical_prefix`` is the path the *client* sees ("/workspace"); the real
+    directory may live anywhere (a tempdir in local mode, /workspace in a pod).
+    """
+
+    def __init__(
+        self,
+        workspace: str | Path,
+        logical_prefix: str = "/workspace",
+        preinstalled: frozenset[str] = frozenset(),
+        disable_dep_install: bool = False,
+        default_timeout_s: float = 60.0,
+        python_executable: str | None = None,
+        shim_dir: str | Path | None = None,
+        installed_cache: set[str] | None = None,
+    ) -> None:
+        self.workspace = Path(workspace)
+        self.workspace.mkdir(parents=True, exist_ok=True)
+        self.logical_prefix = logical_prefix.rstrip("/")
+        self.preinstalled = preinstalled
+        self.disable_dep_install = disable_dep_install
+        self.default_timeout_s = default_timeout_s
+        self.python = python_executable or sys.executable
+        self.shim_dir = str(shim_dir) if shim_dir else None
+        # May be shared across per-execution cores (LocalCodeExecutor) so a dep
+        # installed once isn't re-installed on every request.
+        self._installed_this_session: set[str] = (
+            installed_cache if installed_cache is not None else set()
+        )
+
+    # ---- logical path mapping (PUT/GET /workspace/{path}) ----
+
+    def resolve(self, logical_path: str) -> Path:
+        """Map a client path to a real file path, refusing escapes.
+
+        Accepts "/workspace/foo", "workspace/foo", or bare "foo" — the reference
+        strips the "/workspace/" prefix on upload (kubernetes_code_executor.py:103)
+        and its executor joins paths as-is (server.rs:69-88); we additionally
+        reject traversal outside the workspace root.
+        """
+        p = logical_path
+        for prefix in (self.logical_prefix + "/", self.logical_prefix.lstrip("/") + "/"):
+            if p.startswith(prefix):
+                p = p[len(prefix):]
+                break
+        p = p.lstrip("/")
+        real = (self.workspace / p).resolve()
+        if not real.is_relative_to(self.workspace.resolve()):
+            raise ValueError(f"path escapes workspace: {logical_path!r}")
+        return real
+
+    def logical(self, rel: str) -> str:
+        return f"{self.logical_prefix}/{rel}"
+
+    # ---- dependency install ----
+
+    async def ensure_dependencies(self, source_code: str) -> tuple[list[str], str]:
+        """Guess + install missing deps. Returns (installed, stderr_notes)."""
+        deps = dep_guess.guess_dependencies(source_code, self.preinstalled)
+        deps = [d for d in deps if d not in self._installed_this_session]
+        if not deps or self.disable_dep_install:
+            return [], ""
+        proc = await asyncio.create_subprocess_exec(
+            self.python, "-m", "pip", "install", "--no-cache-dir", *deps,
+            stdout=asyncio.subprocess.DEVNULL,
+            stderr=asyncio.subprocess.PIPE,
+        )
+        _, stderr = await proc.communicate()
+        if proc.returncode == 0:
+            self._installed_this_session.update(deps)
+            return deps, ""
+        # Match the reference's behavior of surfacing install failures in-band
+        # (server.rs:140-147): execution proceeds; the user import error + pip
+        # stderr tell the story.
+        return [], stderr.decode(errors="replace")
+
+    # ---- execution ----
+
+    def _child_env(self, request_env: dict[str, str]) -> dict[str, str]:
+        env = {
+            "PATH": os.environ.get("PATH", "/usr/bin:/bin"),
+            "HOME": os.environ.get("HOME", str(self.workspace)),
+            "LANG": "C.UTF-8",
+            "PYTHONUNBUFFERED": "1",
+        }
+        for key in TPU_PASSTHROUGH_ENV:
+            if key in os.environ:
+                env[key] = os.environ[key]
+        if self.shim_dir:
+            existing = os.environ.get("PYTHONPATH", "")
+            env["PYTHONPATH"] = self.shim_dir + (os.pathsep + existing if existing else "")
+        elif "PYTHONPATH" in os.environ:
+            env["PYTHONPATH"] = os.environ["PYTHONPATH"]
+        env.update(request_env)  # request env wins (reference server.rs:154)
+        return env
+
+    async def execute(
+        self,
+        source_code: str,
+        env: dict[str, str] | None = None,
+        timeout_s: float | None = None,
+    ) -> ExecutionOutcome:
+        env = env or {}
+        timeout_s = timeout_s or self.default_timeout_s
+        before = snapshot_workspace(self.workspace)
+
+        _installed, pip_notes = await self.ensure_dependencies(source_code)
+
+        with tempfile.TemporaryDirectory(prefix="exec-") as td:
+            script = Path(td) / "script.py"
+            script.write_text(source_code)
+            # start_new_session puts the script in its own process group so a
+            # timeout kill reaps grandchildren too — user code is allowed to
+            # spawn subprocesses, and a surviving orphan would keep writing into
+            # a torn-down workspace (or hold the pod's TPU via libtpu).
+            proc = await asyncio.create_subprocess_exec(
+                self.python, str(script),
+                cwd=self.workspace,
+                env=self._child_env(env),
+                stdout=asyncio.subprocess.PIPE,
+                stderr=asyncio.subprocess.PIPE,
+                start_new_session=True,
+            )
+            try:
+                stdout_b, stderr_b = await asyncio.wait_for(
+                    proc.communicate(), timeout=timeout_s
+                )
+                exit_code = proc.returncode
+                stdout = stdout_b.decode(errors="replace")
+                stderr = stderr_b.decode(errors="replace")
+            except asyncio.TimeoutError:
+                # Reference behavior: kill, exit_code -1, fixed stderr message
+                # (server.rs:151-169); the kill targets the whole group.
+                import signal
+
+                try:
+                    os.killpg(proc.pid, signal.SIGKILL)
+                except (ProcessLookupError, PermissionError):
+                    proc.kill()
+                await proc.wait()
+                stdout, stderr, exit_code = "", EXECUTION_TIMED_OUT, -1
+
+        if pip_notes:
+            stderr = pip_notes + ("\n" + stderr if stderr else "")
+
+        after = snapshot_workspace(self.workspace)
+        files = [self.logical(rel) for rel in changed_files(before, after)]
+        return ExecutionOutcome(stdout=stdout, stderr=stderr, exit_code=exit_code, files=files)
+
+    async def warmup(self) -> None:
+        """Pre-heat the interpreter/XLA path so the first request doesn't pay it.
+
+        In the TPU pod this runs at container start (the C++ server execs it
+        before reporting Ready): import jax, touch the device, trigger libtpu
+        init. Analogous in spirit to the reference image's matplotlib font-cache
+        warmup at build time (executor/Dockerfile:103), but for the XLA client.
+        """
+        await self.execute(
+            "try:\n"
+            "    import jax\n"
+            "    jax.numpy.zeros(8).block_until_ready()\n"
+            "except Exception:\n"
+            "    pass\n",
+            timeout_s=120.0,
+        )

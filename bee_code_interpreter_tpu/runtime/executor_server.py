@@ -1,0 +1,109 @@
+"""Standalone in-sandbox executor HTTP server (Python implementation).
+
+Serves the executor wire contract on the pod network, identical to the native
+C++ server (executor/server.cpp) and to the reference's Rust server
+(executor/server.rs:186-192):
+
+- ``PUT  /workspace/{path}``  — stream request body into the workspace
+- ``GET  /workspace/{path}``  — stream file back (404 if absent)
+- ``POST /execute``           — ``{source_code, env?, timeout?}`` →
+                                ``{stdout, stderr, exit_code, files[]}``
+- ``GET  /healthz``           — readiness (new; the reference relied solely on
+                                k8s pod Ready)
+
+This Python server is (a) the development/test double for the pod HTTP seam —
+the fake the reference never had (SURVEY.md §4) — and (b) a fallback pod
+entrypoint where the C++ binary isn't built. Run:
+
+    python -m bee_code_interpreter_tpu.runtime.executor_server
+
+Env: APP_LISTEN_ADDR (default 0.0.0.0:8000), APP_WORKSPACE (default
+/workspace), APP_REQUIREMENTS / APP_REQUIREMENTS_SKIP (preinstalled-set files,
+reference server.rs:198-201), APP_DISABLE_DEP_INSTALL, APP_SHIM_DIR.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+
+from aiohttp import web
+
+from bee_code_interpreter_tpu.runtime.dep_guess import load_requirements_set
+from bee_code_interpreter_tpu.runtime.executor_core import ExecutorCore
+
+
+def create_app(core: ExecutorCore) -> web.Application:
+    app = web.Application(client_max_size=1 << 30)
+
+    async def upload_file(request: web.Request) -> web.Response:
+        try:
+            path = core.resolve(request.match_info["path"])
+        except ValueError as e:
+            return web.Response(status=400, text=str(e))
+        path.parent.mkdir(parents=True, exist_ok=True)
+        with open(path, "wb") as f:
+            async for chunk in request.content.iter_chunked(1 << 20):
+                f.write(chunk)
+        return web.Response(status=204)
+
+    async def download_file(request: web.Request) -> web.StreamResponse:
+        try:
+            path = core.resolve(request.match_info["path"])
+        except ValueError as e:
+            return web.Response(status=400, text=str(e))
+        if not path.is_file():
+            return web.Response(status=404)
+        return web.FileResponse(path)
+
+    async def execute(request: web.Request) -> web.Response:
+        body = await request.json()
+        outcome = await core.execute(
+            source_code=body["source_code"],
+            env=body.get("env") or {},
+            timeout_s=body.get("timeout"),
+        )
+        return web.json_response(
+            {
+                "stdout": outcome.stdout,
+                "stderr": outcome.stderr,
+                "exit_code": outcome.exit_code,
+                "files": outcome.files,
+            }
+        )
+
+    async def healthz(_request: web.Request) -> web.Response:
+        return web.json_response({"status": "ok", "workspace": str(core.workspace)})
+
+    app.router.add_put("/workspace/{path:.+}", upload_file)
+    app.router.add_get("/workspace/{path:.+}", download_file)
+    app.router.add_post("/execute", execute)
+    app.router.add_get("/healthz", healthz)
+    return app
+
+
+def core_from_env() -> ExecutorCore:
+    preinstalled = load_requirements_set(
+        os.environ.get("APP_REQUIREMENTS", "/requirements.txt"),
+        os.environ.get("APP_REQUIREMENTS_SKIP", "/requirements-skip.txt"),
+    )
+    return ExecutorCore(
+        workspace=os.environ.get("APP_WORKSPACE", "/workspace"),
+        preinstalled=preinstalled,
+        disable_dep_install=os.environ.get("APP_DISABLE_DEP_INSTALL", "") == "1",
+        default_timeout_s=float(os.environ.get("APP_EXECUTION_TIMEOUT_S", "60")),
+        shim_dir=os.environ.get("APP_SHIM_DIR") or None,
+    )
+
+
+def main() -> None:
+    core = core_from_env()
+    listen = os.environ.get("APP_LISTEN_ADDR", "0.0.0.0:8000")
+    host, _, port = listen.rpartition(":")
+    if os.environ.get("APP_WARMUP", "") == "1":
+        asyncio.run(core.warmup())
+    web.run_app(create_app(core), host=host or "0.0.0.0", port=int(port))
+
+
+if __name__ == "__main__":
+    main()

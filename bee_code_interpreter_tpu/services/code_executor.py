@@ -1,0 +1,37 @@
+"""Service-level code-executor contract shared by all backends.
+
+``Result`` is the service-level execution result: stdout/stderr/exit code plus
+the {logical path → storage object id} map of files the execution created or
+modified — the *workspace file map* that doubles as the checkpoint/session
+mechanism (SURVEY.md §5 "Checkpoint / resume"; reference
+kubernetes_code_executor.py:144-149).
+
+Backends: ``KubernetesCodeExecutor`` (warm pod pool on a TPU node pool) and
+``LocalCodeExecutor`` (in-process; the unit-test/dev backend the reference
+lacked, SURVEY.md §4).
+"""
+
+from __future__ import annotations
+
+from typing import Protocol, runtime_checkable
+
+from pydantic import BaseModel
+
+from bee_code_interpreter_tpu.utils.validation import AbsolutePath, Hash
+
+
+class Result(BaseModel):
+    stdout: str
+    stderr: str
+    exit_code: int
+    files: dict[AbsolutePath, Hash]
+
+
+@runtime_checkable
+class CodeExecutor(Protocol):
+    async def execute(
+        self,
+        source_code: str,
+        files: dict[AbsolutePath, Hash] | None = None,
+        env: dict[str, str] | None = None,
+    ) -> Result: ...

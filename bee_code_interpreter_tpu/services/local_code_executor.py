@@ -1,0 +1,108 @@
+"""In-process code executor: fresh workspace per execution, no cluster.
+
+The minimum runnable slice (SURVEY.md §7 step 3): same contract as the
+Kubernetes backend — restore the client's {path → object id} map into a fresh
+workspace, run the code through ``ExecutorCore``, snapshot changed files back
+into content-addressed storage — but everything happens in this process on this
+host (including its TPU chips, if any). Preserves the reference's single-use
+hygiene (kubernetes_code_executor.py:262-264): each execution gets a brand-new
+workspace directory, torn down afterwards; state only survives through the
+returned file map.
+"""
+
+from __future__ import annotations
+
+import logging
+import secrets
+import shutil
+from pathlib import Path
+
+from bee_code_interpreter_tpu.runtime.executor_core import ExecutorCore
+from bee_code_interpreter_tpu.services.code_executor import Result
+from bee_code_interpreter_tpu.services.storage import Storage
+from bee_code_interpreter_tpu.utils.validation import AbsolutePath, Hash
+
+logger = logging.getLogger(__name__)
+
+
+class LocalCodeExecutor:
+    def __init__(
+        self,
+        storage: Storage,
+        workspace_root: str | Path = "./.tmp/workspaces",
+        disable_dep_install: bool = True,
+        execution_timeout_s: float = 60.0,
+        shim_dir: str | Path | None = None,
+    ) -> None:
+        self._storage = storage
+        self._workspace_root = Path(workspace_root)
+        self._disable_dep_install = disable_dep_install
+        self._execution_timeout_s = execution_timeout_s
+        self._shim_dir = shim_dir
+        # Shared across executions so an installed dep is installed once.
+        self._installed_cache: set[str] = set()
+        self._preinstalled: frozenset[str] | None = None
+
+    def _preinstalled_set(self) -> frozenset[str]:
+        """Distributions already importable in this interpreter (lazy, once).
+
+        The pod executor loads this from the image's requirements.txt; in-process
+        we ask importlib.metadata so `import numpy` never triggers pip.
+        """
+        if self._preinstalled is None:
+            import importlib.metadata
+
+            self._preinstalled = frozenset(
+                d.metadata["Name"] for d in importlib.metadata.distributions()
+                if d.metadata["Name"]
+            )
+        return self._preinstalled
+
+    async def execute(
+        self,
+        source_code: str,
+        files: dict[AbsolutePath, Hash] | None = None,
+        env: dict[str, str] | None = None,
+    ) -> Result:
+        files = files or {}
+        workspace = self._workspace_root / secrets.token_hex(8)
+        core = ExecutorCore(
+            workspace=workspace,
+            preinstalled=(
+                frozenset() if self._disable_dep_install else self._preinstalled_set()
+            ),
+            disable_dep_install=self._disable_dep_install,
+            default_timeout_s=self._execution_timeout_s,
+            shim_dir=self._shim_dir,
+            installed_cache=self._installed_cache,
+        )
+        try:
+            # Restore the client's workspace snapshot (reference
+            # kubernetes_code_executor.py:100-113, via HTTP PUT; here direct I/O).
+            for logical_path, object_id in files.items():
+                real = core.resolve(logical_path)
+                real.parent.mkdir(parents=True, exist_ok=True)
+                with open(real, "wb") as f:
+                    async with self._storage.reader(object_id) as r:
+                        async for chunk in r:
+                            f.write(chunk)
+
+            outcome = await core.execute(source_code, env=env)
+
+            # Snapshot changed files back (reference :126-142).
+            out_files: dict[str, str] = {}
+            for logical_path in outcome.files:
+                real = core.resolve(logical_path)
+                async with self._storage.writer() as w:
+                    with open(real, "rb") as f:
+                        while chunk := f.read(1 << 20):
+                            await w.write(chunk)
+                out_files[logical_path] = w.hash
+            return Result(
+                stdout=outcome.stdout,
+                stderr=outcome.stderr,
+                exit_code=outcome.exit_code,
+                files=out_files,
+            )
+        finally:
+            shutil.rmtree(workspace, ignore_errors=True)

@@ -1,0 +1,130 @@
+"""Content-addressed object storage for workspace file snapshots.
+
+The reference stores files in a flat directory under *random* 64-hex ids despite
+its docstring claiming sha256 addressing (reference: src/code_interpreter/services/
+storage.py:34-90, the random id at :52). We implement what the docstring promised:
+the object id IS the sha256 of the content, computed while streaming the write and
+atomically renamed into place on close. This gives free dedup across executions
+(identical workspace files snapshot to the same object) while keeping the exact
+same API contract — clients treat ids as opaque ``Hash`` strings either way.
+
+Async file I/O uses a worker thread via asyncio.to_thread per chunk, mirroring the
+reference's anyio usage without the dependency on anyio.Path semantics.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import os
+import secrets
+from contextlib import asynccontextmanager
+from pathlib import Path
+from typing import AsyncIterator
+
+from bee_code_interpreter_tpu.utils.validation import Hash
+
+
+class ObjectReader:
+    def __init__(self, path: Path, chunk_size: int = 1 << 20) -> None:
+        self._path = path
+        self._chunk_size = chunk_size
+        self._file = None
+
+    async def _open(self) -> None:
+        self._file = await asyncio.to_thread(open, self._path, "rb")
+
+    async def read(self, size: int = -1) -> bytes:
+        return await asyncio.to_thread(self._file.read, size)
+
+    async def __aiter__(self) -> AsyncIterator[bytes]:
+        while chunk := await asyncio.to_thread(self._file.read, self._chunk_size):
+            yield chunk
+
+    async def _close(self) -> None:
+        await asyncio.to_thread(self._file.close)
+
+
+class ObjectWriter:
+    """Streams bytes to a temp file while hashing; final id is the sha256 hex."""
+
+    def __init__(self, root: Path) -> None:
+        self._root = root
+        self._tmp_path = root / f".tmp-{secrets.token_hex(8)}"
+        self._hasher = hashlib.sha256()
+        self._file = None
+        self.hash: Hash | None = None
+
+    async def _open(self) -> None:
+        self._file = await asyncio.to_thread(open, self._tmp_path, "wb")
+
+    async def write(self, data: bytes) -> None:
+        self._hasher.update(data)
+        await asyncio.to_thread(self._file.write, data)
+
+    async def _finalize(self) -> None:
+        await asyncio.to_thread(self._file.close)
+        self.hash = self._hasher.hexdigest()
+        final = self._root / self.hash
+        # Content-addressed: identical content → same path; rename is atomic and
+        # overwriting an identical object is a no-op.
+        await asyncio.to_thread(os.replace, self._tmp_path, final)
+
+    async def _abort(self) -> None:
+        await asyncio.to_thread(self._file.close)
+        try:
+            await asyncio.to_thread(os.unlink, self._tmp_path)
+        except FileNotFoundError:
+            pass
+
+
+class Storage:
+    """Flat-directory object store keyed by content hash.
+
+    API shape mirrors the reference (storage.py:44-90): async ``reader``/``writer``
+    context managers plus whole-object ``read``/``write``/``exists`` helpers.
+    """
+
+    def __init__(self, storage_path: str | os.PathLike) -> None:
+        self._root = Path(storage_path)
+
+    async def _ensure_root(self) -> None:
+        await asyncio.to_thread(self._root.mkdir, 0o777, True, True)
+
+    def _object_path(self, object_id: Hash) -> Path:
+        # Hash pattern forbids "/" and ".." so a plain join cannot escape root.
+        return self._root / object_id
+
+    @asynccontextmanager
+    async def reader(self, object_id: Hash) -> AsyncIterator[ObjectReader]:
+        reader = ObjectReader(self._object_path(object_id))
+        await reader._open()
+        try:
+            yield reader
+        finally:
+            await reader._close()
+
+    @asynccontextmanager
+    async def writer(self) -> AsyncIterator[ObjectWriter]:
+        await self._ensure_root()
+        writer = ObjectWriter(self._root)
+        await writer._open()
+        try:
+            yield writer
+        except BaseException:
+            await writer._abort()
+            raise
+        else:
+            await writer._finalize()
+
+    async def read(self, object_id: Hash) -> bytes:
+        async with self.reader(object_id) as r:
+            return await r.read()
+
+    async def write(self, data: bytes) -> Hash:
+        async with self.writer() as w:
+            await w.write(data)
+        return w.hash
+
+    async def exists(self, object_id: Hash) -> bool:
+        return await asyncio.to_thread(self._object_path(object_id).exists)

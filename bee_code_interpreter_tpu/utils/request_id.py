@@ -1,0 +1,33 @@
+"""Per-request correlation id, injected into every log line.
+
+Reference pattern: a ContextVar set at request entry (http_server.py:84-87,
+code_interpreter_servicer.py:60) read by a logging filter installed on every
+handler (application_context.py:40-53). Propagated onward to the sandbox via
+the ``X-Request-Id`` header so pod-side logs correlate too (SURVEY.md §5
+"Tracing / profiling").
+"""
+
+from __future__ import annotations
+
+import logging
+import uuid
+from contextvars import ContextVar
+
+request_id_context_var: ContextVar[str] = ContextVar("request_id", default="-")
+
+
+def new_request_id() -> str:
+    rid = str(uuid.uuid4())
+    request_id_context_var.set(rid)
+    return rid
+
+
+class RequestIdLoggingFilter(logging.Filter):
+    def filter(self, record: logging.LogRecord) -> bool:
+        record.request_id = request_id_context_var.get()
+        return True
+
+
+def install_request_id_filter() -> None:
+    for handler in logging.getLogger().handlers:
+        handler.addFilter(RequestIdLoggingFilter())

@@ -1,0 +1,15 @@
+"""Validated string types used across the API surface.
+
+Mirrors the reference's pydantic annotated aliases (utils/validation.py:19-22):
+``Hash`` for storage object ids and ``AbsolutePath`` for workspace file paths.
+Our storage ids are genuinely content-addressed (sha256 hex), so the Hash
+pattern is tighter than the reference's ``^[0-9a-zA-Z_-]{1,255}$``, while still
+accepting any 1-255 char token-safe id for forward compatibility.
+"""
+
+from typing import Annotated
+
+from pydantic import StringConstraints
+
+Hash = Annotated[str, StringConstraints(pattern=r"^[0-9a-zA-Z_-]{1,255}$")]
+AbsolutePath = Annotated[str, StringConstraints(pattern=r"^/[^/].*$")]

@@ -1,0 +1,47 @@
+"""Test harness configuration.
+
+- Forces JAX onto a *virtual 8-device CPU mesh* (SURVEY.md §4 "Implication for
+  the TPU build") so DP/TP/SP paths run in CI without TPU hardware. Must happen
+  before the first ``import jax`` anywhere in the test session.
+- Runs ``async def`` tests directly (no pytest-asyncio in this environment):
+  a minimal pytest_pyfunc_call hook executes coroutine tests via asyncio.run.
+"""
+
+import asyncio
+import inspect
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import pytest  # noqa: E402
+
+
+def pytest_pyfunc_call(pyfuncitem):
+    fn = pyfuncitem.obj
+    if inspect.iscoroutinefunction(fn):
+        kwargs = {name: pyfuncitem.funcargs[name] for name in pyfuncitem._fixtureinfo.argnames}
+        asyncio.run(fn(**kwargs))
+        return True
+    return None
+
+
+@pytest.fixture
+def storage(tmp_path):
+    from bee_code_interpreter_tpu.services.storage import Storage
+
+    return Storage(tmp_path / "objects")
+
+
+@pytest.fixture
+def local_executor(storage, tmp_path):
+    from bee_code_interpreter_tpu.services.local_code_executor import LocalCodeExecutor
+
+    return LocalCodeExecutor(
+        storage=storage,
+        workspace_root=tmp_path / "workspaces",
+        disable_dep_install=True,
+        execution_timeout_s=30.0,
+    )

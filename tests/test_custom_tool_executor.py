@@ -1,0 +1,200 @@
+"""Custom-tool parse/execute behavior, pinned to the reference e2e contract
+(reference test/e2e/test_http.py:100-302), exercised as unit tests against the
+in-process executor backend."""
+
+import json
+
+import pytest
+
+from bee_code_interpreter_tpu.services.custom_tool_executor import (
+    CustomToolExecuteError,
+    CustomToolExecutor,
+    CustomToolParseError,
+)
+
+
+@pytest.fixture
+def tool_executor(local_executor):
+    return CustomToolExecutor(code_executor=local_executor)
+
+
+GNARLY_TOOL = '''
+import typing
+import typing as banana
+from typing import Optional
+from typing import Union as Onion
+
+def my_tool(a: int, b: typing.Tuple[Optional[str], str] = ("hello", "world"), *, c: Onion[list[str], dict[str, banana.Optional[float]]]) -> int:
+    """
+    This tool is really really cool.
+    Very toolish experience:
+    - Toolable.
+    - Toolastic.
+    - Toolicious.
+    :param a: something cool
+    (very cool indeed)
+    :param b: something nice
+    :return: something great
+    :param c: something awful
+    """
+    return 1 + 1
+'''
+
+
+def test_parse_gnarly_typing(tool_executor):
+    tool = tool_executor.parse(GNARLY_TOOL)
+    assert tool.name == "my_tool"
+    assert tool.description == (
+        "This tool is really really cool.\nVery toolish experience:\n- Toolable.\n"
+        "- Toolastic.\n- Toolicious.\n\nReturns: int -- something great"
+    )
+    assert tool.input_schema == {
+        "$schema": "http://json-schema.org/draft-07/schema#",
+        "type": "object",
+        "title": "my_tool",
+        "properties": {
+            "a": {"type": "integer", "description": "something cool\n(very cool indeed)"},
+            "b": {
+                "type": "array",
+                "minItems": 2,
+                "items": [
+                    {"anyOf": [{"type": "string"}, {"type": "null"}]},
+                    {"type": "string"},
+                ],
+                "additionalItems": False,
+                "description": "something nice",
+            },
+            "c": {
+                "anyOf": [
+                    {"type": "array", "items": {"type": "string"}},
+                    {
+                        "type": "object",
+                        "additionalProperties": {
+                            "anyOf": [{"type": "number"}, {"type": "null"}]
+                        },
+                    },
+                ],
+                "description": "something awful",
+            },
+        },
+        "required": ["a", "c"],
+        "additionalProperties": False,
+    }
+
+
+def test_parse_no_return_annotation(tool_executor):
+    tool = tool_executor.parse(
+        '''
+import typing
+import requests
+
+def current_weather(lat: float, lon: float):
+    """
+    Get the current weather at a location.
+
+    :param lat: A latitude.
+    :param lon: A longitude.
+    :return: A dictionary with the current weather.
+    """
+    url = "https://fake-api.com/weather?lat=" + str(lat) + "&lon=" + str(lon)
+    response = requests.get(url)
+    response.raise_for_status()
+    return response.json()'''
+    )
+    assert tool.name == "current_weather"
+    assert tool.description == (
+        "Get the current weather at a location.\n\nReturns: A dictionary with the current weather."
+    )
+    assert tool.input_schema["properties"] == {
+        "lat": {"type": "number", "description": "A latitude."},
+        "lon": {"type": "number", "description": "A longitude."},
+    }
+    assert tool.input_schema["required"] == ["lat", "lon"]
+
+
+def test_parse_error_messages(tool_executor):
+    with pytest.raises(CustomToolParseError) as e:
+        tool_executor.parse("def my_tool(a, /, b, *args, **kwargs) -> int:\n  return 1 + 1")
+    assert set(e.value.error_messages) == {
+        "The tool function must not have positional-only arguments",
+        "The tool function must not have *args",
+        "The tool function must not have **kwargs",
+        "The tool function arguments must have type annotations",
+    }
+
+
+def test_parse_rejects_non_function_statements(tool_executor):
+    with pytest.raises(CustomToolParseError):
+        tool_executor.parse("x = 1\ndef f(a: int) -> int:\n  return a")
+
+
+def test_parse_rejects_unsafe_annotation(tool_executor):
+    with pytest.raises(CustomToolParseError):
+        tool_executor.parse("def f(a: __import__('os').system) -> int:\n  return 1")
+
+
+def test_parse_syntax_error(tool_executor):
+    with pytest.raises(CustomToolParseError):
+        tool_executor.parse("def broken(:")
+
+
+async def test_execute_simple(tool_executor):
+    out = await tool_executor.execute(
+        "def adding_tool(a: int, b: int) -> int:\n  return a + b",
+        '{"a": 1, "b": 2}',
+    )
+    assert out == 3
+
+
+async def test_execute_datetime_coercion(tool_executor):
+    out = await tool_executor.execute(
+        """
+import datetime
+
+def date_tool(a: datetime.datetime) -> str:
+    return f"The year is {a.year}"
+""",
+        '{"a": "2000-01-01T00:00:00"}',
+    )
+    assert out == "The year is 2000"
+
+
+async def test_execute_runtime_error_surfaces_stderr(tool_executor):
+    with pytest.raises(CustomToolExecuteError) as e:
+        await tool_executor.execute(
+            "def division_tool(a: int, b: int) -> int:\n  return a / b",
+            '{"a": 0, "b": 0}',
+        )
+    assert "division by zero" in e.value.stderr
+
+
+async def test_execute_with_env(tool_executor):
+    out = await tool_executor.execute(
+        "import os\ndef greet() -> str:\n  return 'Hello ' + os.environ['MY_NAME']",
+        "{}",
+        env={"MY_NAME": "John Doe"},
+    )
+    assert out == "Hello John Doe"
+
+
+async def test_tool_body_stdout_suppressed(tool_executor):
+    out = await tool_executor.execute(
+        "def noisy(a: int) -> int:\n  print('SIDE CHANNEL')\n  return a",
+        '{"a": 7}',
+    )
+    assert out == 7
+
+
+def test_json_roundtrip_of_output_encoding(tool_executor):
+    # exact JSON encodings pinned by reference test_grpc.py:254,271
+    assert json.dumps(3) == "3"
+    assert json.dumps("The year is 2000") == '"The year is 2000"'
+
+
+async def test_async_tool_supported(tool_executor):
+    out = await tool_executor.execute(
+        "import asyncio\nasync def slow_add(a: int, b: int) -> int:\n"
+        "  await asyncio.sleep(0)\n  return a + b",
+        '{"a": 2, "b": 3}',
+    )
+    assert out == 5

@@ -1,0 +1,49 @@
+from bee_code_interpreter_tpu.runtime.dep_guess import (
+    guess_dependencies,
+    guessed_imports,
+    load_requirements_set,
+)
+
+
+def test_collects_top_level_imports():
+    src = "import numpy as np\nfrom pandas.io import api\nimport os, sys\n"
+    assert guessed_imports(src) == {"numpy", "pandas", "os", "sys"}
+
+
+def test_stdlib_and_relative_excluded():
+    src = "import json\nfrom . import sibling\nfrom ..pkg import thing\n"
+    assert guess_dependencies(src) == []
+
+
+def test_pypi_name_mapping():
+    src = "import cv2\nimport sklearn\nfrom PIL import Image\nimport yaml\n"
+    assert guess_dependencies(src) == ["PyYAML", "opencv-python", "pillow", "scikit-learn"]
+
+
+def test_preinstalled_filtered_with_normalization():
+    pre = frozenset({"opencv-python", "scikit_learn", "PyYAML"})
+    src = "import cv2\nimport sklearn\nimport yaml\nimport cowsay\n"
+    assert guess_dependencies(src, preinstalled=pre) == ["cowsay"]
+
+
+def test_accelerator_stack_never_reinstalled():
+    src = "import jax\nimport torch\nimport flax\nimport libtpu\n"
+    assert guess_dependencies(src) == []
+
+
+def test_syntax_error_returns_empty():
+    assert guess_dependencies("def broken(:\n") == []
+
+
+def test_nested_function_imports_found():
+    src = "def f():\n    import requests\n    return requests\n"
+    assert guess_dependencies(src) == ["requests"]
+
+
+def test_load_requirements_set(tmp_path):
+    req = tmp_path / "requirements.txt"
+    req.write_text("pandas[excel]==2.2\n# comment\nPy_YAML>=6 ; python_version>'3'\n\nscipy\n")
+    skip = tmp_path / "skip.txt"
+    skip.write_text("ffmpeg  # OS package\n")
+    got = load_requirements_set(req, skip, tmp_path / "missing.txt")
+    assert got == frozenset({"pandas", "py-yaml", "scipy", "ffmpeg"})

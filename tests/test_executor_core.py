@@ -1,0 +1,124 @@
+import os
+
+from bee_code_interpreter_tpu.runtime.executor_core import (
+    EXECUTION_TIMED_OUT,
+    ExecutorCore,
+    changed_files,
+    snapshot_workspace,
+)
+
+
+def make_core(tmp_path, **kw):
+    kw.setdefault("disable_dep_install", True)
+    return ExecutorCore(workspace=tmp_path / "ws", **kw)
+
+
+async def test_stdout_stderr_exit_code(tmp_path):
+    core = make_core(tmp_path)
+    out = await core.execute("import sys\nprint('out')\nprint('err', file=sys.stderr)\nsys.exit(3)\n")
+    assert out.stdout == "out\n"
+    assert out.stderr == "err\n"
+    assert out.exit_code == 3
+    assert out.files == []
+
+
+async def test_crash_has_nonzero_exit(tmp_path):
+    # examples/crash.py behavior (reference examples; SURVEY.md §2 Examples)
+    out = await core_exec(tmp_path, "raise RuntimeError('boom')")
+    assert out.exit_code != 0
+    assert "boom" in out.stderr
+
+
+async def core_exec(tmp_path, src, **kw):
+    return await make_core(tmp_path).execute(src, **kw)
+
+
+async def test_changed_file_detection_recursive(tmp_path):
+    core = make_core(tmp_path)
+    out = await core.execute(
+        "import pathlib\n"
+        "pathlib.Path('top.txt').write_text('x')\n"
+        "pathlib.Path('sub/dir').mkdir(parents=True)\n"
+        "pathlib.Path('sub/dir/nested.txt').write_text('y')\n"
+    )
+    assert out.files == ["/workspace/sub/dir/nested.txt", "/workspace/top.txt"]
+
+
+async def test_unchanged_files_not_reported(tmp_path):
+    core = make_core(tmp_path)
+    (core.workspace / "old.txt").write_text("preexisting")
+    out = await core.execute("print(open('old.txt').read())")
+    assert out.files == []
+    assert out.stdout == "preexisting\n"
+
+
+async def test_env_passthrough(tmp_path):
+    out = await core_exec(tmp_path, "import os\nprint(os.environ['MY_VAR'])", env={"MY_VAR": "42"})
+    assert out.stdout == "42\n"
+
+
+async def test_timeout(tmp_path):
+    core = make_core(tmp_path, default_timeout_s=0.5)
+    out = await core.execute("import time\ntime.sleep(30)")
+    assert out.exit_code == -1
+    assert out.stderr == EXECUTION_TIMED_OUT
+
+
+async def test_tpu_topology_env_forwarded(tmp_path):
+    os.environ["TPU_WORKER_ID"] = "3"
+    try:
+        out = await core_exec(tmp_path, "import os\nprint(os.environ.get('TPU_WORKER_ID'))")
+        assert out.stdout == "3\n"
+    finally:
+        del os.environ["TPU_WORKER_ID"]
+
+
+def test_resolve_strips_logical_prefix(tmp_path):
+    core = make_core(tmp_path)
+    ws = core.workspace.resolve()
+    assert core.resolve("/workspace/a.txt") == ws / "a.txt"
+    assert core.resolve("workspace/a.txt") == ws / "a.txt"
+    assert core.resolve("b/c.txt") == ws / "b" / "c.txt"
+
+
+def test_resolve_rejects_escape(tmp_path):
+    core = make_core(tmp_path)
+    for bad in ("/workspace/../../etc/passwd", "../outside", "/workspace/a/../../x"):
+        try:
+            core.resolve(bad)
+        except ValueError:
+            continue
+        raise AssertionError(f"escape not rejected: {bad}")
+
+
+def test_snapshot_diff(tmp_path):
+    ws = tmp_path / "ws"
+    ws.mkdir()
+    (ws / "a.txt").write_text("1")
+    before = snapshot_workspace(ws)
+    (ws / "a.txt").write_text("22")  # size change
+    (ws / "b.txt").write_text("new")
+    after = snapshot_workspace(ws)
+    assert changed_files(before, after) == ["a.txt", "b.txt"]
+
+
+async def test_timeout_kills_grandchildren(tmp_path):
+    core = make_core(tmp_path, default_timeout_s=1.0)
+    out = await core.execute(
+        "import subprocess, sys, time\n"
+        "p = subprocess.Popen([sys.executable, '-c', "
+        "'import time; time.sleep(60); open(\"orphan.txt\",\"w\").write(\"x\")'])\n"
+        "open('pid.txt','w').write(str(p.pid))\n"
+        "time.sleep(60)\n"
+    )
+    assert out.exit_code == -1
+    pid = int((core.workspace / "pid.txt").read_text())
+    import time
+    for _ in range(20):  # grandchild should be gone promptly
+        try:
+            os.kill(pid, 0)
+        except ProcessLookupError:
+            break
+        time.sleep(0.1)
+    else:
+        raise AssertionError(f"grandchild {pid} survived the timeout kill")

@@ -1,0 +1,162 @@
+"""HTTP API e2e tests against an in-process server with the local executor
+backend — same coverage shape as the reference's live-service suite
+(test/e2e/test_http.py) without requiring a cluster (SURVEY.md §4)."""
+
+import json
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from bee_code_interpreter_tpu.api.http_server import create_http_server
+from bee_code_interpreter_tpu.services.custom_tool_executor import CustomToolExecutor
+
+
+@pytest.fixture
+def http_app(local_executor):
+    return create_http_server(
+        code_executor=local_executor,
+        custom_tool_executor=CustomToolExecutor(code_executor=local_executor),
+    )
+
+
+async def with_client(app, fn):
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    try:
+        return await fn(client)
+    finally:
+        await client.close()
+
+
+async def test_execute_basic(http_app):
+    async def go(client: TestClient):
+        resp = await client.post("/v1/execute", json={"source_code": "print(21 * 2)"})
+        assert resp.status == 200
+        body = await resp.json()
+        assert body["stdout"] == "42\n"
+        assert body["exit_code"] == 0
+        assert body["files"] == {}
+
+    await with_client(http_app, go)
+
+
+async def test_execute_file_roundtrip(http_app):
+    # reference test_http.py:47-85
+    async def go(client: TestClient):
+        r1 = await (
+            await client.post(
+                "/v1/execute",
+                json={"source_code": "open('state.txt','w').write('round trip')"},
+            )
+        ).json()
+        assert set(r1["files"]) == {"/workspace/state.txt"}
+        r2 = await (
+            await client.post(
+                "/v1/execute",
+                json={
+                    "source_code": "print(open('state.txt').read())",
+                    "files": r1["files"],
+                },
+            )
+        ).json()
+        assert r2["stdout"] == "round trip\n"
+
+    await with_client(http_app, go)
+
+
+async def test_execute_env(http_app):
+    # reference test_http.py:88-99
+    async def go(client: TestClient):
+        resp = await client.post(
+            "/v1/execute",
+            json={
+                "source_code": "import os; print(os.environ['GREETING'])",
+                "env": {"GREETING": "hi"},
+            },
+        )
+        assert (await resp.json())["stdout"] == "hi\n"
+
+    await with_client(http_app, go)
+
+
+async def test_execute_validation_error(http_app):
+    async def go(client: TestClient):
+        resp = await client.post("/v1/execute", json={"files": {"bad": "x"}})
+        assert resp.status == 422
+
+    await with_client(http_app, go)
+
+
+async def test_parse_custom_tool_success(http_app):
+    async def go(client: TestClient):
+        resp = await client.post(
+            "/v1/parse-custom-tool",
+            json={
+                "tool_source_code": (
+                    'def adder(a: int, b: int) -> int:\n    """Adds.\n\n'
+                    '    :param a: first\n    :param b: second\n    :return: the sum\n    """\n'
+                    "    return a + b"
+                )
+            },
+        )
+        assert resp.status == 200
+        body = await resp.json()
+        assert body["tool_name"] == "adder"
+        assert body["tool_description"] == "Adds.\n\nReturns: int -- the sum"
+        schema = json.loads(body["tool_input_schema_json"])
+        assert schema["required"] == ["a", "b"]
+        assert schema["$schema"] == "http://json-schema.org/draft-07/schema#"
+
+    await with_client(http_app, go)
+
+
+async def test_parse_custom_tool_error_400(http_app):
+    async def go(client: TestClient):
+        resp = await client.post(
+            "/v1/parse-custom-tool",
+            json={"tool_source_code": "def t(*args) -> int:\n  return 1"},
+        )
+        assert resp.status == 400
+        assert (await resp.json())["error_messages"] == [
+            "The tool function must not have *args"
+        ]
+
+    await with_client(http_app, go)
+
+
+async def test_execute_custom_tool_success(http_app):
+    async def go(client: TestClient):
+        resp = await client.post(
+            "/v1/execute-custom-tool",
+            json={
+                "tool_source_code": "def adding_tool(a: int, b: int) -> int:\n  return a + b",
+                "tool_input_json": '{"a": 1, "b": 2}',
+            },
+        )
+        assert resp.status == 200
+        assert json.loads((await resp.json())["tool_output_json"]) == 3
+
+    await with_client(http_app, go)
+
+
+async def test_execute_custom_tool_error_400(http_app):
+    async def go(client: TestClient):
+        resp = await client.post(
+            "/v1/execute-custom-tool",
+            json={
+                "tool_source_code": "def div(a: int, b: int) -> int:\n  return a / b",
+                "tool_input_json": '{"a": 0, "b": 0}',
+            },
+        )
+        assert resp.status == 400
+        assert "division by zero" in (await resp.json())["stderr"]
+
+    await with_client(http_app, go)
+
+
+async def test_healthz(http_app):
+    async def go(client: TestClient):
+        resp = await client.get("/healthz")
+        assert resp.status == 200
+
+    await with_client(http_app, go)

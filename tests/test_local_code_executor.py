@@ -1,0 +1,46 @@
+from bee_code_interpreter_tpu.services.local_code_executor import LocalCodeExecutor
+
+
+async def test_execute_basic(local_executor: LocalCodeExecutor):
+    result = await local_executor.execute("print(21 * 2)")
+    # health-check contract (reference health_check.py:25-53)
+    assert result.stdout == "42\n"
+    assert result.exit_code == 0
+
+
+async def test_file_roundtrip_across_executions(local_executor: LocalCodeExecutor):
+    # The session-continuity mechanism: file map out of one execution feeds the
+    # next (reference test_http.py:47-85; SURVEY.md §5 checkpoint/resume).
+    r1 = await local_executor.execute("open('data.txt', 'w').write('persisted state')")
+    assert set(r1.files) == {"/workspace/data.txt"}
+    r2 = await local_executor.execute(
+        "print(open('data.txt').read())", files=r1.files
+    )
+    assert r2.stdout == "persisted state\n"
+    assert r2.exit_code == 0
+    # unchanged restored file is not re-reported
+    assert r2.files == {}
+
+
+async def test_workspace_isolated_between_executions(local_executor: LocalCodeExecutor):
+    await local_executor.execute("open('leak.txt', 'w').write('x')")
+    r = await local_executor.execute("import os; print(os.path.exists('leak.txt'))")
+    assert r.stdout == "False\n"
+
+
+async def test_env_forwarded(local_executor: LocalCodeExecutor):
+    r = await local_executor.execute(
+        "import os; print(os.environ['FOO'])", env={"FOO": "bar"}
+    )
+    assert r.stdout == "bar\n"
+
+
+async def test_binary_file_roundtrip(local_executor: LocalCodeExecutor):
+    r1 = await local_executor.execute(
+        "open('blob.bin','wb').write(bytes(range(256)))"
+    )
+    r2 = await local_executor.execute(
+        "data = open('blob.bin','rb').read()\nprint(len(data), data[:4].hex())",
+        files=r1.files,
+    )
+    assert r2.stdout == "256 00010203\n"

@@ -1,0 +1,47 @@
+import hashlib
+
+import pytest
+
+from bee_code_interpreter_tpu.services.storage import Storage
+
+
+async def test_roundtrip(storage: Storage):
+    object_id = await storage.write(b"hello tpu")
+    assert await storage.read(object_id) == b"hello tpu"
+    assert await storage.exists(object_id)
+
+
+async def test_content_addressed(storage: Storage):
+    data = b"deterministic content"
+    a = await storage.write(data)
+    b = await storage.write(data)
+    assert a == b == hashlib.sha256(data).hexdigest()
+
+
+async def test_streaming_writer_reader(storage: Storage):
+    async with storage.writer() as w:
+        await w.write(b"part1-")
+        await w.write(b"part2")
+    chunks = []
+    async with storage.reader(w.hash) as r:
+        async for chunk in r:
+            chunks.append(chunk)
+    assert b"".join(chunks) == b"part1-part2"
+
+
+async def test_missing_object(storage: Storage):
+    assert not await storage.exists("0" * 64)
+    with pytest.raises(FileNotFoundError):
+        await storage.read("0" * 64)
+
+
+async def test_aborted_write_leaves_no_object(storage: Storage, tmp_path):
+    class Boom(Exception):
+        pass
+
+    with pytest.raises(Boom):
+        async with storage.writer() as w:
+            await w.write(b"partial")
+            raise Boom()
+    # no temp litter, no object
+    assert list((tmp_path / "objects").iterdir()) == []
