@@ -61,9 +61,7 @@ class Config(BaseModel):
     grpc_tls_ca_cert: bytes | None = None
 
     # --- executor backend ---
-    # Default is "local" until the Kubernetes pod-pool backend lands; the
-    # production default will be "kubernetes" for parity with the reference.
-    executor_backend: Literal["kubernetes", "local"] = "local"
+    executor_backend: Literal["kubernetes", "local"] = "kubernetes"
     executor_image: str = "bee-code-interpreter-tpu-executor:local"
     executor_container_resources: dict[str, Any] = Field(default_factory=dict)
     executor_pod_spec_extra: dict[str, Any] = Field(default_factory=dict)

@@ -1,0 +1,94 @@
+"""Async wrapper over the ``kubectl`` CLI.
+
+Same "dumb wrapper" philosophy as the reference (services/kubectl.py:24-41):
+no kubernetes-client dependency, just subprocess + JSON. Method name becomes
+the subcommand (underscores → dashes), kwargs become ``--key=value`` flags,
+positional args pass through; commands whose output kubectl can render as JSON
+get ``--output=json`` added and parsed (reference :99-131 vs :133-178).
+
+    pod = await kubectl.get("pod", "my-pod")              # parsed JSON
+    await kubectl.wait("pod/my-pod", for_="condition=Ready", timeout="60s")
+    await kubectl.delete("pod", "my-pod", ignore_not_found="true")
+
+Trailing-underscore kwargs (``for_``) drop the underscore so reserved words
+work. ``exec_raw`` returns the live process for streaming (reference :190-193).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+from typing import Any
+
+logger = logging.getLogger(__name__)
+
+# Subcommands that accept -o json and return an object (reference kubectl.py:99-131).
+JSON_OUTPUT_COMMANDS = frozenset(
+    {"get", "create", "apply", "delete", "patch", "label", "annotate", "expose",
+     "run", "scale", "wait"}
+)
+
+
+class KubectlError(RuntimeError):
+    def __init__(self, argv: list[str], returncode: int, stderr: str) -> None:
+        super().__init__(f"kubectl {' '.join(argv)} failed ({returncode}): {stderr.strip()}")
+        self.argv = argv
+        self.returncode = returncode
+        self.stderr = stderr
+
+
+class Kubectl:
+    def __init__(self, kubectl_path: str = "kubectl", namespace: str | None = None) -> None:
+        self._kubectl = kubectl_path
+        self._namespace = namespace
+
+    def __getattr__(self, name: str):
+        if name.startswith("_"):
+            raise AttributeError(name)
+
+        async def run(*args: str, _input: str | bytes | None = None, **kwargs: Any):
+            return await self._run(name.replace("_", "-"), *args, _input=_input, **kwargs)
+
+        run.__name__ = name
+        return run
+
+    async def _run(
+        self, command: str, *args: str, _input: str | bytes | None = None, **kwargs: Any
+    ):
+        argv = [command, *args]
+        json_output = command in JSON_OUTPUT_COMMANDS and "output" not in kwargs
+        if json_output:
+            argv.append("--output=json")
+        if self._namespace:
+            argv.append(f"--namespace={self._namespace}")
+        for key, value in kwargs.items():
+            flag = key.rstrip("_").replace("_", "-")
+            argv.append(f"--{flag}={value}")
+        logger.info("kubectl %s", " ".join(argv))
+        proc = await asyncio.create_subprocess_exec(
+            self._kubectl, *argv,
+            stdin=asyncio.subprocess.PIPE if _input is not None else None,
+            stdout=asyncio.subprocess.PIPE,
+            stderr=asyncio.subprocess.PIPE,
+        )
+        if isinstance(_input, str):
+            _input = _input.encode()
+        stdout, stderr = await proc.communicate(_input)
+        if proc.returncode != 0:
+            raise KubectlError(argv, proc.returncode, stderr.decode(errors="replace"))
+        text = stdout.decode(errors="replace")
+        if json_output and text.strip():
+            try:
+                return json.loads(text)
+            except json.JSONDecodeError:
+                return text
+        return text
+
+    async def exec_raw(self, *args: str) -> asyncio.subprocess.Process:
+        """Live process for streaming use (reference kubectl.py:190-193)."""
+        return await asyncio.create_subprocess_exec(
+            self._kubectl, "exec", *args,
+            stdout=asyncio.subprocess.PIPE,
+            stderr=asyncio.subprocess.PIPE,
+        )

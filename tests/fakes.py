@@ -1,0 +1,106 @@
+"""Test doubles for the cluster seam (SURVEY.md §4: the fake kubectl / fake
+executor the reference never had)."""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import socket
+from pathlib import Path
+
+from aiohttp import web
+
+from bee_code_interpreter_tpu.runtime.executor_core import ExecutorCore
+from bee_code_interpreter_tpu.runtime.executor_server import create_app
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+class FakeExecutorPods:
+    """Real executor HTTP servers, one per simulated pod, each on its own
+    loopback IP (127.1.0.x) sharing a single port — so the executor driver can
+    address them exactly like pods on a pod network."""
+
+    def __init__(self, workspace_root: Path, port: int | None = None) -> None:
+        self.workspace_root = workspace_root
+        self.port = port or free_port()
+        self._runners: dict[str, web.AppRunner] = {}
+        self.cores: dict[str, ExecutorCore] = {}
+        self.execute_counts: dict[str, int] = {}
+        self._next_ip = 1
+
+    async def start_pod(self) -> str:
+        ip = f"127.1.0.{self._next_ip}"
+        self._next_ip += 1
+        core = ExecutorCore(
+            workspace=self.workspace_root / ip, disable_dep_install=True,
+            default_timeout_s=30.0,
+        )
+        app = create_app(core)
+
+        @web.middleware
+        async def count_executes(request, handler):
+            if request.path == "/execute":
+                self.execute_counts[ip] = self.execute_counts.get(ip, 0) + 1
+            return await handler(request)
+
+        app.middlewares.append(count_executes)
+        runner = web.AppRunner(app)
+        await runner.setup()
+        site = web.TCPSite(runner, ip, self.port)
+        await site.start()
+        self._runners[ip] = runner
+        self.cores[ip] = core
+        return ip
+
+    async def close(self) -> None:
+        for runner in self._runners.values():
+            await runner.cleanup()
+
+
+class FakeKubectl:
+    """In-memory kubectl: create/get/wait/delete on pod manifests, backed by
+    FakeExecutorPods for pod IPs."""
+
+    def __init__(self, pods: FakeExecutorPods) -> None:
+        self._backend = pods
+        self.pods: dict[str, dict] = {}
+        self.deleted: list[str] = []
+        self.created_manifests: list[dict] = []
+        self.fail_create_names: set[str] = set()  # pods whose creation errors
+        self.fail_ready_names: set[str] = set()  # pods that never become Ready
+
+    async def create(self, *args, _input=None, **kwargs):
+        manifest = json.loads(_input)
+        name = manifest["metadata"]["name"]
+        self.created_manifests.append(manifest)
+        if name in self.fail_create_names:
+            raise RuntimeError(f"fake: create {name} failed")
+        ip = await self._backend.start_pod()
+        self.pods[name] = {
+            "metadata": manifest["metadata"],
+            "spec": manifest["spec"],
+            "status": {"podIP": ip, "phase": "Running"},
+        }
+        return self.pods[name]
+
+    async def get(self, kind, name, **kwargs):
+        assert kind == "pod"
+        if name not in self.pods:
+            raise RuntimeError(f"fake: pod {name} not found")
+        return self.pods[name]
+
+    async def wait(self, target, **kwargs):
+        name = target.removeprefix("pod/")
+        if name in self.fail_ready_names or name not in self.pods:
+            raise RuntimeError(f"fake: pod {name} never Ready")
+        return self.pods[name]
+
+    async def delete(self, kind, name, **kwargs):
+        self.deleted.append(name)
+        self.pods.pop(name, None)
+        return {}

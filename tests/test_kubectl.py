@@ -1,0 +1,75 @@
+"""Kubectl CLI wrapper: argv construction, JSON parsing, error surfacing —
+exercised against a stub `kubectl` binary."""
+
+import json
+import os
+import stat
+
+import pytest
+
+from bee_code_interpreter_tpu.services.kubectl import Kubectl, KubectlError
+
+STUB = """#!/bin/sh
+# echoes its argv back as a JSON object; fails when first arg is "fail-me"
+if [ "$1" = "fail-me" ]; then
+  echo "boom" >&2
+  exit 3
+fi
+printf '{"argv": ['
+first=1
+for a in "$@"; do
+  [ $first -eq 1 ] || printf ', '
+  printf '"%s"' "$a"
+  first=0
+done
+printf '], "stdin": "'
+if [ ! -t 0 ]; then tr -d '\\n"' ; fi
+printf '"}'
+"""
+
+
+@pytest.fixture
+def kubectl(tmp_path):
+    stub = tmp_path / "kubectl"
+    stub.write_text(STUB)
+    stub.chmod(stub.stat().st_mode | stat.S_IEXEC)
+    return Kubectl(kubectl_path=str(stub))
+
+
+async def test_json_command_adds_output_json(kubectl):
+    out = await kubectl.get("pod", "my-pod")
+    assert out["argv"] == ["get", "pod", "my-pod", "--output=json"]
+
+
+async def test_kwargs_become_flags(kubectl):
+    out = await kubectl.wait("pod/x", for_="condition=Ready", timeout="60s")
+    assert out["argv"] == ["wait", "pod/x", "--output=json", "--for=condition=Ready", "--timeout=60s"]
+
+
+async def test_underscore_to_dash_in_command_and_flags(kubectl):
+    out = await kubectl.delete("pod", "x", ignore_not_found="true")
+    assert out["argv"][0] == "delete"
+    assert "--ignore-not-found=true" in out["argv"]
+
+
+async def test_stdin_manifest(kubectl):
+    out = await kubectl.create("-f", "-", _input='{"kind":"Pod"}')
+    assert out["stdin"] == "{kind:Pod}"
+
+
+async def test_namespace_injected(tmp_path, kubectl):
+    k = Kubectl(kubectl_path=kubectl._kubectl, namespace="sandbox")
+    out = await k.get("pod", "p")
+    assert "--namespace=sandbox" in out["argv"]
+
+
+async def test_error_raises_with_stderr(kubectl):
+    with pytest.raises(KubectlError) as e:
+        await kubectl.fail_me()
+    assert e.value.returncode == 3
+    assert "boom" in e.value.stderr
+
+
+async def test_non_json_command_returns_text(kubectl):
+    out = await kubectl.logs("pod-x")
+    assert isinstance(out, str)  # "logs" is not a JSON-output command
