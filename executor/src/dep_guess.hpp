@@ -1,0 +1,146 @@
+// Static import -> PyPI dependency guesser (native).
+//
+// In-process replacement for the reference's `upm guess` subprocess + sqlite
+// map (reference server.rs:126-138, executor/Dockerfile:30-37,124-126). Same
+// algorithm as the Python oracle (bee_code_interpreter_tpu/runtime/dep_guess.py):
+// scan top-level absolute imports, drop stdlib/skip/preinstalled, map through
+// the import->PyPI table (pypi_map.tsv, shared with the Python side).
+//
+// The stdlib module set is asked from the interpreter once at startup
+// (sys.stdlib_module_names) rather than embedded, so it always matches the
+// sandbox's actual Python.
+#pragma once
+
+#include <algorithm>
+#include <cctype>
+#include <map>
+#include <regex>
+#include <set>
+#include <sstream>
+#include <string>
+#include <vector>
+
+namespace dep_guess {
+
+// PEP 503 normalization + extras stripping ("pandas[excel]" -> "pandas").
+inline std::string normalize(std::string name) {
+  auto bracket = name.find('[');
+  if (bracket != std::string::npos) name.resize(bracket);
+  // trim
+  while (!name.empty() && isspace(static_cast<unsigned char>(name.back()))) name.pop_back();
+  size_t start = 0;
+  while (start < name.size() && isspace(static_cast<unsigned char>(name[start]))) ++start;
+  name = name.substr(start);
+  for (auto& c : name) {
+    c = static_cast<char>(tolower(static_cast<unsigned char>(c)));
+    if (c == '_' || c == '.') c = '-';
+  }
+  return name;
+}
+
+// Accelerator stack + OS-provided names that must never be pip-installed
+// (mirrors dep_guess.py SKIP; reference requirements-skip.txt:1-26).
+inline const std::set<std::string>& builtin_skip() {
+  static const std::set<std::string> skip = {
+      "jax", "jaxlib", "libtpu", "torch", "torch_xla", "flax", "optax",
+      "orbax", "chex", "haiku", "pallas",
+      "ffmpeg", "pandoc", "magick", "imagemagick",
+      "bee_code_interpreter_tpu",
+  };
+  return skip;
+}
+
+// Top-level module names from absolute `import X` / `from X import ...`
+// statements. A line-based scan is sufficient for dependency *guessing*
+// (imports hidden behind exec/getattr are out of scope, same as upm).
+inline std::set<std::string> guessed_imports(const std::string& source) {
+  static const std::regex import_re(R"(^\s*import\s+(.+?)\s*$)");
+  static const std::regex from_re(R"(^\s*from\s+([A-Za-z_][\w.]*)\s+import\b)");
+  std::set<std::string> names;
+  std::istringstream stream(source);
+  std::string line;
+  while (std::getline(stream, line)) {
+    std::smatch m;
+    if (std::regex_search(line, m, from_re)) {
+      std::string mod = m[1].str();
+      names.insert(mod.substr(0, mod.find('.')));
+    } else if (std::regex_match(line, m, import_re)) {
+      // "import a.b as c, d" -> a, d ; strip trailing comments
+      std::string rest = m[1].str();
+      auto hash = rest.find('#');
+      if (hash != std::string::npos) rest.resize(hash);
+      std::istringstream parts(rest);
+      std::string part;
+      while (std::getline(parts, part, ',')) {
+        std::istringstream words(part);
+        std::string mod;
+        words >> mod;  // first token; ignores "as alias"
+        if (mod.empty() || mod[0] == '.') continue;
+        bool valid = true;
+        for (char c : mod) {
+          if (!(isalnum(static_cast<unsigned char>(c)) || c == '_' || c == '.')) {
+            valid = false;
+            break;
+          }
+        }
+        if (valid) names.insert(mod.substr(0, mod.find('.')));
+      }
+    }
+  }
+  return names;
+}
+
+struct Guesser {
+  std::set<std::string> stdlib;                 // module names
+  std::map<std::string, std::string> pypi_map;  // import name -> dist name
+  std::set<std::string> preinstalled;           // normalized dist names
+
+  std::vector<std::string> guess(const std::string& source) const {
+    std::vector<std::string> deps;
+    for (const auto& mod : guessed_imports(source)) {
+      if (stdlib.count(mod) || builtin_skip().count(mod)) continue;
+      auto it = pypi_map.find(mod);
+      std::string pkg = it == pypi_map.end() ? mod : it->second;
+      if (preinstalled.count(normalize(pkg)) || preinstalled.count(normalize(mod)))
+        continue;
+      deps.push_back(pkg);
+    }
+    std::sort(deps.begin(), deps.end());
+    deps.erase(std::unique(deps.begin(), deps.end()), deps.end());
+    return deps;
+  }
+};
+
+// pypi_map.tsv: "<import-name>\t<pypi-name>" per line, '#' comments.
+inline std::map<std::string, std::string> load_pypi_map(const std::string& text) {
+  std::map<std::string, std::string> map;
+  std::istringstream stream(text);
+  std::string line;
+  while (std::getline(stream, line)) {
+    if (line.empty() || line[0] == '#') continue;
+    auto tab = line.find('\t');
+    if (tab == std::string::npos) continue;
+    map[line.substr(0, tab)] = line.substr(tab + 1);
+  }
+  return map;
+}
+
+// requirements.txt-style parsing into the normalized preinstalled set
+// (reference server.rs:44-67).
+inline void load_requirements_into(const std::string& text,
+                                   std::set<std::string>& out) {
+  std::istringstream stream(text);
+  std::string line;
+  while (std::getline(stream, line)) {
+    auto hash = line.find('#');
+    if (hash != std::string::npos) line.resize(hash);
+    for (const char* sep : {"==", ">=", "<=", "~=", "!=", ">", "<", ";", "@"}) {
+      auto pos = line.find(sep);
+      if (pos != std::string::npos) line.resize(pos);
+    }
+    std::string name = normalize(line);
+    if (!name.empty()) out.insert(name);
+  }
+}
+
+}  // namespace dep_guess

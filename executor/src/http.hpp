@@ -1,0 +1,275 @@
+// Minimal multi-threaded HTTP/1.1 server for the executor wire contract.
+//
+// Replaces the reference's actix-web dependency (executor/server.rs:186-192)
+// with a dependency-free implementation: blocking accept loop, one thread per
+// connection (per-pod concurrency is a handful of requests), Content-Length
+// and chunked request bodies (the control plane streams uploads chunked),
+// streaming file responses. Not a general web server -- exactly what the
+// executor needs.
+#pragma once
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <cstring>
+#include <functional>
+#include <map>
+#include <optional>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace minihttp {
+
+struct Request {
+  std::string method;
+  std::string path;  // percent-decoded, query stripped
+  std::map<std::string, std::string> headers;  // lower-cased keys
+  std::string body;
+};
+
+struct Response {
+  int status = 200;
+  std::string content_type = "application/json";
+  std::string body;
+  std::optional<std::string> file_path;  // if set, stream this file as body
+};
+
+using Handler = std::function<Response(const Request&)>;
+
+inline std::string status_text(int code) {
+  switch (code) {
+    case 200: return "OK";
+    case 204: return "No Content";
+    case 400: return "Bad Request";
+    case 404: return "Not Found";
+    case 405: return "Method Not Allowed";
+    case 413: return "Payload Too Large";
+    default: return "Internal Server Error";
+  }
+}
+
+class Server {
+ public:
+  explicit Server(Handler handler) : handler_(std::move(handler)) {}
+
+  // Binds and listens; returns the bound port (for ":0" style tests).
+  int bind(const std::string& host, int port) {
+    fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
+    int one = 1;
+    setsockopt(fd_, SOL_SOCKET, SO_REUSEADDR, &one, sizeof one);
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_port = htons(static_cast<uint16_t>(port));
+    if (inet_pton(AF_INET, host.c_str(), &addr.sin_addr) != 1)
+      addr.sin_addr.s_addr = INADDR_ANY;
+    if (::bind(fd_, reinterpret_cast<sockaddr*>(&addr), sizeof addr) != 0)
+      throw std::runtime_error("bind failed: " + std::string(strerror(errno)));
+    if (::listen(fd_, 64) != 0)
+      throw std::runtime_error("listen failed");
+    socklen_t len = sizeof addr;
+    getsockname(fd_, reinterpret_cast<sockaddr*>(&addr), &len);
+    return ntohs(addr.sin_port);
+  }
+
+  void serve_forever() {
+    while (!stopping_.load()) {
+      int client = ::accept(fd_, nullptr, nullptr);
+      if (client < 0) {
+        if (stopping_.load()) break;
+        continue;
+      }
+      std::thread([this, client] { handle_connection(client); }).detach();
+    }
+  }
+
+  void stop() {
+    stopping_.store(true);
+    ::shutdown(fd_, SHUT_RDWR);
+    ::close(fd_);
+  }
+
+ private:
+  void handle_connection(int fd) {
+    int one = 1;
+    setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof one);
+    std::string buffer;
+    // keep-alive loop: serve requests until the peer closes
+    while (true) {
+      Request req;
+      if (!read_request(fd, buffer, req)) break;
+      Response resp;
+      try {
+        resp = handler_(req);
+      } catch (const std::exception& e) {
+        resp.status = 500;
+        resp.body = std::string("{\"detail\":\"") + e.what() + "\"}";
+      }
+      if (!write_response(fd, resp)) break;
+      auto it = req.headers.find("connection");
+      if (it != req.headers.end() && it->second == "close") break;
+    }
+    ::close(fd);
+  }
+
+  // Reads one full request (headers + body) from fd into req. Returns false
+  // on EOF/error. `buffer` carries over bytes read past the previous request.
+  bool read_request(int fd, std::string& buffer, Request& req) {
+    // -- headers --
+    size_t header_end;
+    while ((header_end = buffer.find("\r\n\r\n")) == std::string::npos) {
+      if (!fill(fd, buffer)) return false;
+      if (buffer.size() > (1u << 20)) return false;  // header flood
+    }
+    std::string head = buffer.substr(0, header_end);
+    buffer.erase(0, header_end + 4);
+
+    size_t line_end = head.find("\r\n");
+    std::string request_line = head.substr(0, line_end);
+    size_t sp1 = request_line.find(' ');
+    size_t sp2 = request_line.find(' ', sp1 + 1);
+    if (sp1 == std::string::npos || sp2 == std::string::npos) return false;
+    req.method = request_line.substr(0, sp1);
+    std::string target = request_line.substr(sp1 + 1, sp2 - sp1 - 1);
+    size_t q = target.find('?');
+    if (q != std::string::npos) target.resize(q);
+    req.path = percent_decode(target);
+
+    size_t pos = line_end + 2;
+    while (pos < head.size()) {
+      size_t eol = head.find("\r\n", pos);
+      if (eol == std::string::npos) eol = head.size();
+      std::string line = head.substr(pos, eol - pos);
+      pos = eol + 2;
+      size_t colon = line.find(':');
+      if (colon == std::string::npos) continue;
+      std::string key = line.substr(0, colon);
+      for (auto& c : key) c = static_cast<char>(tolower(c));
+      size_t vstart = line.find_first_not_of(" \t", colon + 1);
+      req.headers[key] = vstart == std::string::npos ? "" : line.substr(vstart);
+    }
+
+    // -- body --
+    auto te = req.headers.find("transfer-encoding");
+    if (te != req.headers.end() && te->second.find("chunked") != std::string::npos) {
+      return read_chunked_body(fd, buffer, req.body);
+    }
+    auto cl = req.headers.find("content-length");
+    size_t content_length = cl == req.headers.end() ? 0 : std::stoull(cl->second);
+    if (content_length > kMaxBody) return false;
+    while (buffer.size() < content_length) {
+      if (!fill(fd, buffer)) return false;
+    }
+    req.body = buffer.substr(0, content_length);
+    buffer.erase(0, content_length);
+    return true;
+  }
+
+  bool read_chunked_body(int fd, std::string& buffer, std::string& body) {
+    while (true) {
+      size_t eol;
+      while ((eol = buffer.find("\r\n")) == std::string::npos) {
+        if (!fill(fd, buffer)) return false;
+      }
+      size_t chunk_size = std::stoull(buffer.substr(0, eol), nullptr, 16);
+      buffer.erase(0, eol + 2);
+      if (chunk_size == 0) {
+        // trailer section ends with CRLF
+        while (buffer.find("\r\n") == std::string::npos) {
+          if (!fill(fd, buffer)) return false;
+        }
+        buffer.erase(0, buffer.find("\r\n") + 2);
+        return true;
+      }
+      if (body.size() + chunk_size > kMaxBody) return false;
+      while (buffer.size() < chunk_size + 2) {
+        if (!fill(fd, buffer)) return false;
+      }
+      body.append(buffer, 0, chunk_size);
+      buffer.erase(0, chunk_size + 2);  // chunk + CRLF
+    }
+  }
+
+  bool write_response(int fd, const Response& resp) {
+    std::string body = resp.body;
+    long long content_length = static_cast<long long>(body.size());
+    FILE* file = nullptr;
+    if (resp.file_path) {
+      file = fopen(resp.file_path->c_str(), "rb");
+      if (!file) {
+        return write_response(fd, Response{404, "application/json", "{}", {}});
+      }
+      fseek(file, 0, SEEK_END);
+      content_length = ftell(file);
+      fseek(file, 0, SEEK_SET);
+    }
+    std::string head = "HTTP/1.1 " + std::to_string(resp.status) + " " +
+                       status_text(resp.status) + "\r\n" +
+                       "Content-Type: " + resp.content_type + "\r\n" +
+                       "Content-Length: " + std::to_string(content_length) +
+                       "\r\n\r\n";
+    bool ok = send_all(fd, head.data(), head.size());
+    if (ok && file) {
+      char buf[1 << 16];
+      size_t n;
+      while (ok && (n = fread(buf, 1, sizeof buf, file)) > 0)
+        ok = send_all(fd, buf, n);
+    } else if (ok && !body.empty()) {
+      ok = send_all(fd, body.data(), body.size());
+    }
+    if (file) fclose(file);
+    return ok;
+  }
+
+  static bool send_all(int fd, const char* data, size_t len) {
+    while (len > 0) {
+      ssize_t n = ::send(fd, data, len, MSG_NOSIGNAL);
+      if (n <= 0) return false;
+      data += n;
+      len -= static_cast<size_t>(n);
+    }
+    return true;
+  }
+
+  static bool fill(int fd, std::string& buffer) {
+    char buf[1 << 16];
+    ssize_t n = ::recv(fd, buf, sizeof buf, 0);
+    if (n <= 0) return false;
+    buffer.append(buf, static_cast<size_t>(n));
+    return true;
+  }
+
+  static std::string percent_decode(const std::string& s) {
+    std::string out;
+    for (size_t i = 0; i < s.size(); ++i) {
+      if (s[i] == '%' && i + 2 < s.size()) {
+        auto hex = [](char c) -> int {
+          if (c >= '0' && c <= '9') return c - '0';
+          if (c >= 'a' && c <= 'f') return c - 'a' + 10;
+          if (c >= 'A' && c <= 'F') return c - 'A' + 10;
+          return -1;
+        };
+        int hi = hex(s[i + 1]), lo = hex(s[i + 2]);
+        if (hi >= 0 && lo >= 0) {
+          out += static_cast<char>(hi * 16 + lo);
+          i += 2;
+          continue;
+        }
+      }
+      out += s[i];
+    }
+    return out;
+  }
+
+  static constexpr size_t kMaxBody = 1ull << 30;  // 1 GiB, matches control plane
+
+  Handler handler_;
+  int fd_ = -1;
+  std::atomic<bool> stopping_{false};
+};
+
+}  // namespace minihttp

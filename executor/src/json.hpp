@@ -1,0 +1,306 @@
+// Minimal JSON value type + parser + serializer for the executor wire contract.
+// (The reference's Rust executor gets this from serde_json, server.rs deps
+// Cargo.toml:14-23; we keep the executor dependency-free instead.)
+//
+// Supports the full JSON grammar; numbers are doubles (the contract only
+// carries small integers: exit_code, timeout). Strings are byte strings --
+// UTF-8 passes through untouched, \uXXXX escapes are decoded to UTF-8.
+#pragma once
+
+#include <cstdint>
+#include <map>
+#include <memory>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+namespace minijson {
+
+class Value;
+using Array = std::vector<Value>;
+using Object = std::map<std::string, Value>;
+
+class Value {
+ public:
+  enum class Type { Null, Bool, Number, String, Array, Object };
+
+  Value() : type_(Type::Null) {}
+  Value(std::nullptr_t) : type_(Type::Null) {}
+  Value(bool b) : type_(Type::Bool), bool_(b) {}
+  Value(double n) : type_(Type::Number), num_(n) {}
+  Value(int n) : type_(Type::Number), num_(n) {}
+  Value(int64_t n) : type_(Type::Number), num_(static_cast<double>(n)) {}
+  Value(const char* s) : type_(Type::String), str_(s) {}
+  Value(std::string s) : type_(Type::String), str_(std::move(s)) {}
+  Value(Array a) : type_(Type::Array), arr_(std::move(a)) {}
+  Value(Object o) : type_(Type::Object), obj_(std::move(o)) {}
+
+  Type type() const { return type_; }
+  bool is_null() const { return type_ == Type::Null; }
+  bool as_bool(bool dflt = false) const { return type_ == Type::Bool ? bool_ : dflt; }
+  double as_number(double dflt = 0) const { return type_ == Type::Number ? num_ : dflt; }
+  const std::string& as_string() const {
+    static const std::string empty;
+    return type_ == Type::String ? str_ : empty;
+  }
+  const Array& as_array() const {
+    static const Array empty;
+    return type_ == Type::Array ? arr_ : empty;
+  }
+  const Object& as_object() const {
+    static const Object empty;
+    return type_ == Type::Object ? obj_ : empty;
+  }
+  const Value& operator[](const std::string& key) const {
+    static const Value null_value;
+    if (type_ != Type::Object) return null_value;
+    auto it = obj_.find(key);
+    return it == obj_.end() ? null_value : it->second;
+  }
+
+ private:
+  Type type_;
+  bool bool_ = false;
+  double num_ = 0;
+  std::string str_;
+  Array arr_;
+  Object obj_;
+};
+
+namespace detail {
+
+struct Parser {
+  const char* p;
+  const char* end;
+
+  [[noreturn]] void fail(const std::string& msg) {
+    throw std::runtime_error("json parse error: " + msg);
+  }
+  void skip_ws() {
+    while (p < end && (*p == ' ' || *p == '\t' || *p == '\n' || *p == '\r')) ++p;
+  }
+  char peek() {
+    if (p >= end) fail("unexpected end");
+    return *p;
+  }
+  void expect(char c) {
+    if (p >= end || *p != c) fail(std::string("expected '") + c + "'");
+    ++p;
+  }
+  bool consume(const char* lit) {
+    size_t n = strlen(lit);
+    if (static_cast<size_t>(end - p) >= n && memcmp(p, lit, n) == 0) {
+      p += n;
+      return true;
+    }
+    return false;
+  }
+
+  Value parse_value() {
+    skip_ws();
+    char c = peek();
+    if (c == '{') return parse_object();
+    if (c == '[') return parse_array();
+    if (c == '"') return Value(parse_string());
+    if (consume("true")) return Value(true);
+    if (consume("false")) return Value(false);
+    if (consume("null")) return Value(nullptr);
+    return parse_number();
+  }
+
+  Value parse_object() {
+    expect('{');
+    Object obj;
+    skip_ws();
+    if (peek() == '}') { ++p; return Value(std::move(obj)); }
+    while (true) {
+      skip_ws();
+      std::string key = parse_string();
+      skip_ws();
+      expect(':');
+      obj[std::move(key)] = parse_value();
+      skip_ws();
+      if (peek() == ',') { ++p; continue; }
+      expect('}');
+      return Value(std::move(obj));
+    }
+  }
+
+  Value parse_array() {
+    expect('[');
+    Array arr;
+    skip_ws();
+    if (peek() == ']') { ++p; return Value(std::move(arr)); }
+    while (true) {
+      arr.push_back(parse_value());
+      skip_ws();
+      if (peek() == ',') { ++p; continue; }
+      expect(']');
+      return Value(std::move(arr));
+    }
+  }
+
+  std::string parse_string() {
+    expect('"');
+    std::string out;
+    while (true) {
+      if (p >= end) fail("unterminated string");
+      char c = *p++;
+      if (c == '"') return out;
+      if (c == '\\') {
+        if (p >= end) fail("bad escape");
+        char e = *p++;
+        switch (e) {
+          case '"': out += '"'; break;
+          case '\\': out += '\\'; break;
+          case '/': out += '/'; break;
+          case 'b': out += '\b'; break;
+          case 'f': out += '\f'; break;
+          case 'n': out += '\n'; break;
+          case 'r': out += '\r'; break;
+          case 't': out += '\t'; break;
+          case 'u': {
+            unsigned cp = parse_hex4();
+            if (cp >= 0xD800 && cp <= 0xDBFF) {  // surrogate pair
+              if (!consume("\\u")) fail("lone high surrogate");
+              unsigned lo = parse_hex4();
+              cp = 0x10000 + ((cp - 0xD800) << 10) + (lo - 0xDC00);
+            }
+            append_utf8(out, cp);
+            break;
+          }
+          default: fail("bad escape char");
+        }
+      } else {
+        out += c;
+      }
+    }
+  }
+
+  unsigned parse_hex4() {
+    if (end - p < 4) fail("bad \\u escape");
+    unsigned v = 0;
+    for (int i = 0; i < 4; ++i) {
+      char c = *p++;
+      v <<= 4;
+      if (c >= '0' && c <= '9') v |= c - '0';
+      else if (c >= 'a' && c <= 'f') v |= c - 'a' + 10;
+      else if (c >= 'A' && c <= 'F') v |= c - 'A' + 10;
+      else fail("bad hex digit");
+    }
+    return v;
+  }
+
+  static void append_utf8(std::string& out, unsigned cp) {
+    if (cp < 0x80) {
+      out += static_cast<char>(cp);
+    } else if (cp < 0x800) {
+      out += static_cast<char>(0xC0 | (cp >> 6));
+      out += static_cast<char>(0x80 | (cp & 0x3F));
+    } else if (cp < 0x10000) {
+      out += static_cast<char>(0xE0 | (cp >> 12));
+      out += static_cast<char>(0x80 | ((cp >> 6) & 0x3F));
+      out += static_cast<char>(0x80 | (cp & 0x3F));
+    } else {
+      out += static_cast<char>(0xF0 | (cp >> 18));
+      out += static_cast<char>(0x80 | ((cp >> 12) & 0x3F));
+      out += static_cast<char>(0x80 | ((cp >> 6) & 0x3F));
+      out += static_cast<char>(0x80 | (cp & 0x3F));
+    }
+  }
+
+  Value parse_number() {
+    const char* start = p;
+    if (p < end && *p == '-') ++p;
+    while (p < end && ((*p >= '0' && *p <= '9') || *p == '.' || *p == 'e' ||
+                       *p == 'E' || *p == '+' || *p == '-'))
+      ++p;
+    if (p == start) fail("invalid value");
+    return Value(std::stod(std::string(start, p)));
+  }
+};
+
+inline void dump_string(const std::string& s, std::string& out) {
+  out += '"';
+  for (unsigned char c : s) {
+    switch (c) {
+      case '"': out += "\\\""; break;
+      case '\\': out += "\\\\"; break;
+      case '\b': out += "\\b"; break;
+      case '\f': out += "\\f"; break;
+      case '\n': out += "\\n"; break;
+      case '\r': out += "\\r"; break;
+      case '\t': out += "\\t"; break;
+      default:
+        if (c < 0x20) {
+          char buf[8];
+          snprintf(buf, sizeof buf, "\\u%04x", c);
+          out += buf;
+        } else {
+          out += static_cast<char>(c);
+        }
+    }
+  }
+  out += '"';
+}
+
+}  // namespace detail
+
+inline Value parse(const std::string& text) {
+  detail::Parser parser{text.data(), text.data() + text.size()};
+  Value v = parser.parse_value();
+  parser.skip_ws();
+  if (parser.p != parser.end) parser.fail("trailing garbage");
+  return v;
+}
+
+inline void dump(const Value& v, std::string& out) {
+  switch (v.type()) {
+    case Value::Type::Null: out += "null"; break;
+    case Value::Type::Bool: out += v.as_bool() ? "true" : "false"; break;
+    case Value::Type::Number: {
+      double d = v.as_number();
+      if (d == static_cast<int64_t>(d)) {
+        out += std::to_string(static_cast<int64_t>(d));
+      } else {
+        char buf[32];
+        snprintf(buf, sizeof buf, "%.17g", d);
+        out += buf;
+      }
+      break;
+    }
+    case Value::Type::String: detail::dump_string(v.as_string(), out); break;
+    case Value::Type::Array: {
+      out += '[';
+      bool first = true;
+      for (const auto& e : v.as_array()) {
+        if (!first) out += ',';
+        first = false;
+        dump(e, out);
+      }
+      out += ']';
+      break;
+    }
+    case Value::Type::Object: {
+      out += '{';
+      bool first = true;
+      for (const auto& [k, val] : v.as_object()) {
+        if (!first) out += ',';
+        first = false;
+        detail::dump_string(k, out);
+        out += ':';
+        dump(val, out);
+      }
+      out += '}';
+      break;
+    }
+  }
+}
+
+inline std::string dump(const Value& v) {
+  std::string out;
+  dump(v, out);
+  return out;
+}
+
+}  // namespace minijson

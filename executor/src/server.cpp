@@ -1,0 +1,273 @@
+// bee-code-interpreter-tpu in-sandbox executor server (native).
+//
+// C++ replacement for the reference's Rust executor (executor/server.rs:29-201)
+// with the same wire contract:
+//
+//   PUT  /workspace/{path}   stream body into the workspace
+//   GET  /workspace/{path}   stream file back
+//   POST /execute            {source_code, env?, timeout?} ->
+//                            {stdout, stderr, exit_code, files[]}
+//   GET  /healthz            readiness probe (new)
+//
+// TPU-first differences from the reference:
+//  * plain `python` instead of xonsh (saves the ~80 ms/exec the reference left
+//    on the table, server.rs:152)
+//  * in-process dependency guessing (dep_guess.hpp) instead of an `upm guess`
+//    subprocess + sqlite map
+//  * recursive (mtime,size) changed-file diff instead of top-level ctime scan
+//  * process-group SIGKILL on timeout (grandchildren can't leak and hold the
+//    pod's TPU)
+//  * optional XLA warmup at startup (APP_WARMUP=1): imports jax and touches
+//    the device before the pod reports ready, so the first request never pays
+//    libtpu init (SURVEY.md §7 hard part (c))
+//
+// Env: APP_LISTEN_ADDR (0.0.0.0:8000), APP_WORKSPACE (/workspace),
+// APP_REQUIREMENTS, APP_REQUIREMENTS_SKIP, APP_PYPI_MAP, APP_SHIM_DIR,
+// APP_DISABLE_DEP_INSTALL, APP_EXECUTION_TIMEOUT_S, APP_PYTHON, APP_WARMUP.
+
+#include <fstream>
+#include <iostream>
+#include <mutex>
+#include <sstream>
+
+#include "dep_guess.hpp"
+#include "http.hpp"
+#include "json.hpp"
+#include "subprocess.hpp"
+#include "workspace.hpp"
+
+namespace fs = std::filesystem;
+
+namespace {
+
+std::string env_or(const char* name, const std::string& dflt) {
+  const char* v = getenv(name);
+  return v && *v ? v : dflt;
+}
+
+std::string read_file(const fs::path& path) {
+  std::ifstream in(path, std::ios::binary);
+  std::ostringstream ss;
+  ss << in.rdbuf();
+  return ss.str();
+}
+
+// Env vars forwarded from the pod into every user process so JAX/libtpu sees
+// the slice topology (mirrors executor_core.TPU_PASSTHROUGH_ENV).
+constexpr const char* kTpuPassthrough[] = {
+    "TPU_WORKER_ID", "TPU_WORKER_HOSTNAMES", "TPU_ACCELERATOR_TYPE",
+    "TPU_TOPOLOGY", "TPU_CHIPS_PER_HOST_BOUNDS", "JAX_COORDINATOR_ADDRESS",
+    "JAX_NUM_PROCESSES", "JAX_PROCESS_ID", "JAX_PLATFORMS", "XLA_FLAGS",
+    "TPU_SKIP_MDS_QUERY",
+};
+
+struct ExecutorConfig {
+  std::string python = env_or("APP_PYTHON", "python3");
+  fs::path workspace_root = env_or("APP_WORKSPACE", "/workspace");
+  bool disable_dep_install = env_or("APP_DISABLE_DEP_INSTALL", "") == "1";
+  double default_timeout_s = std::stod(env_or("APP_EXECUTION_TIMEOUT_S", "60"));
+  std::string shim_dir = env_or("APP_SHIM_DIR", "");
+};
+
+class Executor {
+ public:
+  explicit Executor(ExecutorConfig config) : config_(std::move(config)) {
+    fs::create_directories(config_.workspace_root);
+    load_stdlib();
+    guesser_.pypi_map = dep_guess::load_pypi_map(
+        read_file(env_or("APP_PYPI_MAP", "/pypi_map.tsv")));
+    dep_guess::load_requirements_into(
+        read_file(env_or("APP_REQUIREMENTS", "/requirements.txt")),
+        guesser_.preinstalled);
+    dep_guess::load_requirements_into(
+        read_file(env_or("APP_REQUIREMENTS_SKIP", "/requirements-skip.txt")),
+        guesser_.preinstalled);
+  }
+
+  minihttp::Response handle(const minihttp::Request& req) {
+    if (req.path == "/healthz") {
+      return {200, "application/json", "{\"status\":\"ok\"}", {}};
+    }
+    if (req.path.rfind("/workspace/", 0) == 0) {
+      auto real = workspace::resolve(config_.workspace_root, req.path);
+      if (!real) return {400, "application/json", "{\"detail\":\"path escapes workspace\"}", {}};
+      if (req.method == "PUT") return upload(*real, req.body);
+      if (req.method == "GET") return download(*real);
+      return {405, "application/json", "{}", {}};
+    }
+    if (req.path == "/execute" && req.method == "POST") return execute(req.body);
+    return {404, "application/json", "{}", {}};
+  }
+
+  void warmup() {
+    // Pre-heat libtpu/XLA before the pod reports ready.
+    run_python(
+        "try:\n"
+        "    import jax\n"
+        "    jax.numpy.zeros(8).block_until_ready()\n"
+        "except Exception:\n"
+        "    pass\n",
+        {}, 300.0);
+  }
+
+ private:
+  minihttp::Response upload(const fs::path& real, const std::string& body) {
+    std::error_code ec;
+    fs::create_directories(real.parent_path(), ec);
+    std::ofstream out(real, std::ios::binary | std::ios::trunc);
+    if (!out) return {500, "application/json", "{\"detail\":\"open failed\"}", {}};
+    out.write(body.data(), static_cast<std::streamsize>(body.size()));
+    return {204, "application/json", "", {}};
+  }
+
+  minihttp::Response download(const fs::path& real) {
+    if (!fs::is_regular_file(real)) return {404, "application/json", "{}", {}};
+    minihttp::Response resp;
+    resp.content_type = "application/octet-stream";
+    resp.file_path = real.string();
+    return resp;
+  }
+
+  minihttp::Response execute(const std::string& body) {
+    minijson::Value req;
+    try {
+      req = minijson::parse(body);
+    } catch (const std::exception& e) {
+      return {400, "application/json",
+              minijson::dump(minijson::Object{{"detail", e.what()}}), {}};
+    }
+    std::string source = req["source_code"].as_string();
+    double timeout = req["timeout"].is_null() ? config_.default_timeout_s
+                                              : req["timeout"].as_number();
+    std::map<std::string, std::string> request_env;
+    for (const auto& [k, v] : req["env"].as_object()) request_env[k] = v.as_string();
+
+    auto before = workspace::snapshot(config_.workspace_root);
+    std::string pip_notes = ensure_dependencies(source);
+    auto result = run_python(source, request_env, timeout);
+    auto after = workspace::snapshot(config_.workspace_root);
+
+    minijson::Array files;
+    for (const auto& rel : workspace::changed_files(before, after))
+      files.push_back(minijson::Value("/workspace/" + rel));
+
+    std::string stderr_out = result.err;
+    if (!pip_notes.empty())
+      stderr_out = pip_notes + (stderr_out.empty() ? "" : "\n" + stderr_out);
+
+    minijson::Object resp{
+        {"stdout", result.out},
+        {"stderr", stderr_out},
+        {"exit_code", result.exit_code},
+        {"files", std::move(files)},
+    };
+    return {200, "application/json", minijson::dump(minijson::Value(std::move(resp))), {}};
+  }
+
+  // Returns pip stderr notes on failure, "" on success/no-op (install
+  // failures surface in-band like the reference, server.rs:140-147).
+  std::string ensure_dependencies(const std::string& source) {
+    auto deps = guesser_.guess(source);
+    {
+      std::lock_guard<std::mutex> lock(installed_mutex_);
+      deps.erase(std::remove_if(deps.begin(), deps.end(),
+                                [&](const std::string& d) {
+                                  return installed_this_session_.count(d) > 0;
+                                }),
+                 deps.end());
+    }
+    if (deps.empty() || config_.disable_dep_install) return "";
+    std::vector<std::string> argv = {config_.python, "-m", "pip", "install",
+                                     "--no-cache-dir"};
+    argv.insert(argv.end(), deps.begin(), deps.end());
+    auto result = subprocess::run(argv, base_env({}), "", 300.0);
+    if (result.exit_code == 0) {
+      std::lock_guard<std::mutex> lock(installed_mutex_);
+      installed_this_session_.insert(deps.begin(), deps.end());
+      return "";
+    }
+    return result.err;
+  }
+
+  subprocess::RunResult run_python(const std::string& source,
+                                   const std::map<std::string, std::string>& request_env,
+                                   double timeout_s) {
+    char tmpl[] = "/tmp/exec-XXXXXX";
+    char* tmpdir = mkdtemp(tmpl);
+    if (!tmpdir) return {"", "mkdtemp failed", -1, false};
+    fs::path script = fs::path(tmpdir) / "script.py";
+    {
+      std::ofstream out(script, std::ios::binary);
+      out << source;
+    }
+    auto result = subprocess::run({config_.python, script.string()},
+                                  base_env(request_env),
+                                  config_.workspace_root.string(), timeout_s);
+    std::error_code ec;
+    fs::remove_all(tmpdir, ec);
+    return result;
+  }
+
+  std::map<std::string, std::string> base_env(
+      const std::map<std::string, std::string>& request_env) {
+    std::map<std::string, std::string> env{
+        {"PATH", env_or("PATH", "/usr/local/bin:/usr/bin:/bin")},
+        {"HOME", env_or("HOME", config_.workspace_root.string())},
+        {"LANG", "C.UTF-8"},
+        {"PYTHONUNBUFFERED", "1"},
+    };
+    for (const char* key : kTpuPassthrough) {
+      const char* v = getenv(key);
+      if (v) env[key] = v;
+    }
+    if (!config_.shim_dir.empty()) {
+      std::string existing = env_or("PYTHONPATH", "");
+      env["PYTHONPATH"] =
+          existing.empty() ? config_.shim_dir : config_.shim_dir + ":" + existing;
+    } else if (getenv("PYTHONPATH")) {
+      env["PYTHONPATH"] = getenv("PYTHONPATH");
+    }
+    for (const auto& [k, v] : request_env) env[k] = v;  // request env wins
+    return env;
+  }
+
+  void load_stdlib() {
+    auto result = subprocess::run(
+        {config_.python, "-c",
+         "import sys; print('\\n'.join(sorted(sys.stdlib_module_names)))"},
+        base_env({}), "", 30.0);
+    std::istringstream stream(result.out);
+    std::string name;
+    while (std::getline(stream, name))
+      if (!name.empty()) guesser_.stdlib.insert(name);
+    if (guesser_.stdlib.empty())
+      std::cerr << "warning: could not load stdlib module names from "
+                << config_.python << "\n";
+  }
+
+  ExecutorConfig config_;
+  dep_guess::Guesser guesser_;
+  std::set<std::string> installed_this_session_;
+  std::mutex installed_mutex_;
+};
+
+}  // namespace
+
+int main() {
+  ExecutorConfig config;
+  Executor executor(config);
+
+  if (env_or("APP_WARMUP", "") == "1") executor.warmup();
+
+  std::string listen = env_or("APP_LISTEN_ADDR", "0.0.0.0:8000");
+  auto colon = listen.rfind(':');
+  std::string host = listen.substr(0, colon);
+  int port = std::stoi(listen.substr(colon + 1));
+
+  minihttp::Server server(
+      [&executor](const minihttp::Request& req) { return executor.handle(req); });
+  int bound = server.bind(host, port);
+  std::cout << "executor-server listening on " << host << ":" << bound << std::endl;
+  server.serve_forever();
+  return 0;
+}

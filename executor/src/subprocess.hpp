@@ -1,0 +1,126 @@
+// Subprocess execution with wall-clock timeout and process-group kill.
+//
+// Same behavior as the reference executor's run path (server.rs:149-169):
+// run the interpreter on the script with the request env merged in, capture
+// stdout/stderr, and on timeout return exit_code -1 with stderr "Execution
+// timed out". The child gets its own process group (setpgid) so the timeout
+// kill also reaps grandchildren the user code spawned.
+#pragma once
+
+#include <fcntl.h>
+#include <poll.h>
+#include <signal.h>
+#include <spawn.h>
+#include <sys/wait.h>
+#include <unistd.h>
+
+#include <chrono>
+#include <cstring>
+#include <map>
+#include <string>
+#include <vector>
+
+namespace subprocess {
+
+struct RunResult {
+  std::string out;
+  std::string err;
+  int exit_code = 0;
+  bool timed_out = false;
+};
+
+inline constexpr const char* kTimeoutMessage = "Execution timed out";
+
+// argv: program + args. env: complete child environment.
+inline RunResult run(const std::vector<std::string>& argv,
+                     const std::map<std::string, std::string>& env,
+                     const std::string& cwd,
+                     double timeout_s) {
+  int out_pipe[2], err_pipe[2];
+  if (pipe(out_pipe) != 0 || pipe(err_pipe) != 0)
+    return {"", "pipe() failed", -1, false};
+
+  pid_t pid = fork();
+  if (pid < 0) return {"", "fork() failed", -1, false};
+  if (pid == 0) {
+    // child
+    setpgid(0, 0);
+    if (!cwd.empty()) {
+      if (chdir(cwd.c_str()) != 0) _exit(127);
+    }
+    dup2(out_pipe[1], STDOUT_FILENO);
+    dup2(err_pipe[1], STDERR_FILENO);
+    close(out_pipe[0]); close(out_pipe[1]);
+    close(err_pipe[0]); close(err_pipe[1]);
+    std::vector<char*> cargv;
+    for (const auto& a : argv) cargv.push_back(const_cast<char*>(a.c_str()));
+    cargv.push_back(nullptr);
+    std::vector<std::string> env_strings;
+    env_strings.reserve(env.size());
+    for (const auto& [k, v] : env) env_strings.push_back(k + "=" + v);
+    std::vector<char*> cenv;
+    for (const auto& e : env_strings) cenv.push_back(const_cast<char*>(e.c_str()));
+    cenv.push_back(nullptr);
+    execve(argv[0].c_str(), cargv.data(), cenv.data());
+    // fallback to PATH lookup
+    execvpe(argv[0].c_str(), cargv.data(), cenv.data());
+    fprintf(stderr, "exec failed: %s\n", strerror(errno));
+    _exit(127);
+  }
+
+  // parent
+  setpgid(pid, pid);  // race-safe double setpgid
+  close(out_pipe[1]);
+  close(err_pipe[1]);
+  fcntl(out_pipe[0], F_SETFL, O_NONBLOCK);
+  fcntl(err_pipe[0], F_SETFL, O_NONBLOCK);
+
+  RunResult result;
+  auto deadline = std::chrono::steady_clock::now() +
+                  std::chrono::duration<double>(timeout_s);
+  bool out_open = true, err_open = true;
+  char buf[1 << 16];
+  while (out_open || err_open) {
+    auto remaining = std::chrono::duration_cast<std::chrono::milliseconds>(
+                         deadline - std::chrono::steady_clock::now())
+                         .count();
+    if (remaining <= 0) {
+      result.timed_out = true;
+      kill(-pid, SIGKILL);
+      break;
+    }
+    pollfd fds[2];
+    nfds_t nfds = 0;
+    if (out_open) fds[nfds++] = {out_pipe[0], POLLIN, 0};
+    if (err_open) fds[nfds++] = {err_pipe[0], POLLIN, 0};
+    int rc = poll(fds, nfds, static_cast<int>(std::min<long long>(remaining, 1000)));
+    if (rc < 0) break;
+    for (nfds_t i = 0; i < nfds; ++i) {
+      if (!(fds[i].revents & (POLLIN | POLLHUP))) continue;
+      ssize_t n = read(fds[i].fd, buf, sizeof buf);
+      bool is_out = fds[i].fd == out_pipe[0];
+      if (n > 0) {
+        (is_out ? result.out : result.err).append(buf, static_cast<size_t>(n));
+      } else if (n == 0 || (n < 0 && errno != EAGAIN)) {
+        if (is_out) out_open = false; else err_open = false;
+      }
+    }
+  }
+  close(out_pipe[0]);
+  close(err_pipe[0]);
+
+  int status = 0;
+  waitpid(pid, &status, 0);
+  if (result.timed_out) {
+    result.out.clear();
+    result.err = kTimeoutMessage;
+    result.exit_code = -1;
+  } else if (WIFEXITED(status)) {
+    result.exit_code = WEXITSTATUS(status);
+  } else if (WIFSIGNALED(status)) {
+    result.exit_code = -WTERMSIG(status);
+  }
+  return result;
+}
+
+}  // namespace subprocess

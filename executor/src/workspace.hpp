@@ -1,0 +1,84 @@
+// Workspace path mapping and changed-file detection.
+//
+// Same semantics as the Python reference implementation
+// (bee_code_interpreter_tpu/runtime/executor_core.py): logical client paths
+// ("/workspace/...") map into a real root with traversal protection, and
+// changed files are found by a *recursive* before/after snapshot diff on
+// (mtime_ns, size) -- deliberately stronger than the reference executor's
+// top-level-only ctime scan (reference server.rs:98-118).
+#pragma once
+
+#include <sys/stat.h>
+
+#include <filesystem>
+#include <map>
+#include <optional>
+#include <string>
+#include <utility>
+#include <vector>
+
+namespace workspace {
+
+namespace fs = std::filesystem;
+
+struct FileSig {
+  int64_t mtime_ns;
+  int64_t size;
+  bool operator==(const FileSig&) const = default;
+};
+
+using Snapshot = std::map<std::string, FileSig>;
+
+// Maps a logical path ("/workspace/a/b", "workspace/a/b", or "a/b") to a real
+// path under root. Returns nullopt if the path escapes the workspace.
+inline std::optional<fs::path> resolve(const fs::path& root,
+                                       std::string logical,
+                                       const std::string& prefix = "/workspace") {
+  std::string stripped = prefix.substr(1) + "/";  // "workspace/"
+  if (logical.rfind(prefix + "/", 0) == 0) {
+    logical = logical.substr(prefix.size() + 1);
+  } else if (logical.rfind(stripped, 0) == 0) {
+    logical = logical.substr(stripped.size());
+  }
+  while (!logical.empty() && logical.front() == '/') logical.erase(0, 1);
+  fs::path joined = root / logical;
+  // lexically normalize and verify containment (no symlink resolution needed
+  // for containment: reject any ".." that climbs out)
+  fs::path normal = joined.lexically_normal();
+  fs::path normal_root = root.lexically_normal();
+  auto root_it = normal_root.begin();
+  for (auto it = normal.begin(); root_it != normal_root.end(); ++it, ++root_it) {
+    if (it == normal.end() || *it != *root_it) return std::nullopt;
+  }
+  return normal;
+}
+
+inline Snapshot snapshot(const fs::path& root) {
+  Snapshot snap;
+  std::error_code ec;
+  fs::recursive_directory_iterator it(root, fs::directory_options::skip_permission_denied, ec);
+  if (ec) return snap;
+  for (const auto& entry : it) {
+    std::error_code sec;
+    if (!entry.is_regular_file(sec) || sec) continue;
+    struct stat st{};
+    if (::stat(entry.path().c_str(), &st) != 0) continue;
+    std::string rel = fs::relative(entry.path(), root, sec).generic_string();
+    if (sec) continue;
+    snap[rel] = FileSig{st.st_mtim.tv_sec * 1000000000LL + st.st_mtim.tv_nsec,
+                        static_cast<int64_t>(st.st_size)};
+  }
+  return snap;
+}
+
+inline std::vector<std::string> changed_files(const Snapshot& before,
+                                              const Snapshot& after) {
+  std::vector<std::string> out;
+  for (const auto& [rel, sig] : after) {
+    auto it = before.find(rel);
+    if (it == before.end() || !(it->second == sig)) out.push_back(rel);
+  }
+  return out;  // std::map iteration => already sorted
+}
+
+}  // namespace workspace

@@ -1,0 +1,233 @@
+"""Drives the native C++ executor server end-to-end over its wire contract,
+including full control-plane interop (KubernetesCodeExecutor with fake kubectl
+pointing pods at real native-server processes)."""
+
+import asyncio
+import json
+import shutil
+import socket
+import subprocess
+import time
+from pathlib import Path
+
+import httpx
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+EXECUTOR_DIR = REPO / "executor"
+BINARY = EXECUTOR_DIR / "build" / "executor-server"
+
+
+def build_binary() -> bool:
+    if shutil.which("make") is None or shutil.which("g++") is None:
+        return False
+    result = subprocess.run(
+        ["make", "-C", str(EXECUTOR_DIR)], capture_output=True, text=True
+    )
+    return result.returncode == 0 and BINARY.exists()
+
+
+pytestmark = pytest.mark.skipif(
+    not build_binary(), reason="native toolchain unavailable"
+)
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+class NativeExecutor:
+    def __init__(self, workspace: Path, ip: str = "127.0.0.1", port: int | None = None):
+        self.ip = ip
+        self.port = port or free_port()
+        self.workspace = workspace
+        self.proc = subprocess.Popen(
+            [str(BINARY)],
+            env={
+                "PATH": "/usr/local/bin:/usr/bin:/bin",
+                "APP_LISTEN_ADDR": f"{ip}:{self.port}",
+                "APP_WORKSPACE": str(workspace),
+                "APP_DISABLE_DEP_INSTALL": "1",
+                "APP_PYPI_MAP": str(EXECUTOR_DIR / "pypi_map.tsv"),
+            },
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+        )
+        self.base = f"http://{ip}:{self.port}"
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            try:
+                if httpx.get(self.base + "/healthz", timeout=1).status_code == 200:
+                    return
+            except httpx.HTTPError:
+                time.sleep(0.05)
+        raise RuntimeError("native executor did not become healthy")
+
+    def stop(self):
+        self.proc.kill()
+        self.proc.wait()
+
+
+@pytest.fixture
+def native(tmp_path):
+    server = NativeExecutor(tmp_path / "ws")
+    yield server
+    server.stop()
+
+
+def test_healthz(native):
+    assert httpx.get(native.base + "/healthz").json() == {"status": "ok"}
+
+
+def test_execute_basic(native):
+    r = httpx.post(
+        native.base + "/execute", json={"source_code": "print(21 * 2)"}
+    ).json()
+    assert r == {"stdout": "42\n", "stderr": "", "exit_code": 0, "files": []}
+
+
+def test_upload_execute_download_roundtrip(native):
+    data = bytes(range(256)) * 100
+    assert (
+        httpx.put(native.base + "/workspace/sub/in.bin", content=data).status_code
+        == 204
+    )
+    r = httpx.post(
+        native.base + "/execute",
+        json={
+            "source_code": "raw = open('sub/in.bin','rb').read()\n"
+            "open('out.bin','wb').write(raw[::-1])"
+        },
+    ).json()
+    assert r["exit_code"] == 0
+    assert r["files"] == ["/workspace/out.bin"]
+    out = httpx.get(native.base + "/workspace/out.bin")
+    assert out.content == data[::-1]
+
+
+def test_env_and_unicode(native):
+    r = httpx.post(
+        native.base + "/execute",
+        json={
+            "source_code": "import os\nprint(os.environ['GREETING'])",
+            "env": {"GREETING": "héllo ✓ wörld"},
+        },
+    ).json()
+    assert r["stdout"] == "héllo ✓ wörld\n"
+
+
+def test_timeout_kills_group(native):
+    r = httpx.post(
+        native.base + "/execute",
+        json={
+            "source_code": "import subprocess, sys, time\n"
+            "subprocess.Popen([sys.executable, '-c', 'import time; time.sleep(60)'])\n"
+            "time.sleep(60)",
+            "timeout": 1,
+        },
+        timeout=30,
+    ).json()
+    assert r["exit_code"] == -1
+    assert r["stderr"] == "Execution timed out"
+
+
+def test_path_escape_rejected(native):
+    # raw socket: clients like httpx normalize "..", the server must not rely on that
+    with socket.create_connection((native.ip, native.port)) as sock:
+        sock.sendall(
+            b"PUT /workspace/../../etc/evil HTTP/1.1\r\n"
+            b"Host: x\r\nContent-Length: 1\r\nConnection: close\r\n\r\nx"
+        )
+        status = b""
+        while chunk := sock.recv(4096):
+            status += chunk
+    assert b"400" in status.split(b"\r\n", 1)[0]
+    # encoded traversal through a real client
+    assert (
+        httpx.put(
+            native.base + "/workspace/%2e%2e/%2e%2e/etc/evil2", content=b"x"
+        ).status_code
+        == 400
+    )
+
+
+def test_download_missing_404(native):
+    assert httpx.get(native.base + "/workspace/nope.txt").status_code == 404
+
+
+def test_crash_propagates_exit_code(native):
+    r = httpx.post(
+        native.base + "/execute", json={"source_code": "raise SystemExit(9)"}
+    ).json()
+    assert r["exit_code"] == 9
+
+
+async def test_chunked_streaming_upload(native):
+    # the control plane streams uploads with an async generator => chunked
+    # transfer-encoding; the native server must decode it
+    async def body():
+        for i in range(64):
+            yield bytes([i]) * 1024
+
+    async with httpx.AsyncClient() as client:
+        resp = await client.put(native.base + "/workspace/chunked.bin", content=body())
+        assert resp.status_code == 204
+    r = httpx.post(
+        native.base + "/execute",
+        json={"source_code": "import os\nprint(os.path.getsize('chunked.bin'))"},
+    ).json()
+    assert r["stdout"] == f"{64 * 1024}\n"
+
+
+async def test_control_plane_against_native_pods(tmp_path, storage):
+    """KubernetesCodeExecutor drives real native-server 'pods' (distinct
+    loopback IPs, one shared port) through the full upload/execute/download
+    flow — the reference's boundary (c) (SURVEY.md §3.5) with our C++ server."""
+    from bee_code_interpreter_tpu.config import Config
+    from bee_code_interpreter_tpu.services.kubernetes_code_executor import (
+        KubernetesCodeExecutor,
+    )
+    from tests.fakes import FakeKubectl
+
+    port = free_port()
+    servers: list[NativeExecutor] = []
+
+    class NativeBackend:
+        port_ = port
+
+        def __init__(self):
+            self.port = port
+            self._next = 1
+
+        async def start_pod(self) -> str:
+            ip = f"127.1.1.{self._next}"
+            self._next += 1
+            server = await asyncio.to_thread(
+                NativeExecutor, tmp_path / f"pod-{self._next}", ip, port
+            )
+            servers.append(server)
+            return ip
+
+    config = Config(
+        executor_backend="kubernetes",
+        executor_port=port,
+        executor_pod_queue_target_length=1,
+        tpu_hosts_per_slice=2,
+    )
+    executor = KubernetesCodeExecutor(
+        kubectl=FakeKubectl(NativeBackend()), storage=storage, config=config
+    )
+    try:
+        r1 = await executor.execute("open('state.json','w').write('{\"n\": 1}')")
+        assert r1.exit_code == 0
+        assert set(r1.files) == {"/workspace/state.json"}
+        r2 = await executor.execute(
+            "import json\nprint(json.load(open('state.json'))['n'] + 1)",
+            files=r1.files,
+        )
+        assert r2.stdout == "2\n"
+    finally:
+        for s in servers:
+            s.stop()
