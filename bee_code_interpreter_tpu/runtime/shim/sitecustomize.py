@@ -1,0 +1,135 @@
+"""Sandbox interpreter shim, loaded into every user process via PYTHONPATH.
+
+TPU-native growth of the reference's sitecustomize (executor/sitecustomize.py:
+1-31). Keeps the reference's headless-display patches and adds the numpy→XLA
+reroute. Everything is installed through one lazy ``__import__`` patch so
+interpreter startup stays free: nothing heavy imports until user code itself
+imports the module in question.
+
+Patches:
+- ``numpy``           → XLA reroute entry points (runtime/xla_reroute.py)
+- ``matplotlib.pyplot.show``  → ``savefig("plot.png")``   (headless pods)
+- ``PIL`` ``ImageShow.show``  → ``img.save("image.png")``
+- ``moviepy`` ``write_videofile``: logger silenced (tqdm noise in stderr)
+- ``torch``           → if torch_xla is importable, make "xla" the default
+                        device so torch code lands on the TPU too
+"""
+
+import builtins
+import sys
+
+_patched = set()
+_original_import = builtins.__import__
+
+
+def _patch_numpy(numpy):
+    try:
+        from bee_code_interpreter_tpu.runtime import xla_reroute
+
+        xla_reroute.install(numpy)
+    except Exception:
+        pass
+
+
+def _patch_pyplot(pyplot):
+    def show(*_args, **_kwargs):
+        pyplot.savefig("plot.png")
+
+    pyplot.show = show
+
+
+def _patch_pil(image_show):
+    def show(img, *_args, **_kwargs):
+        img.save("image.png")
+        return True
+
+    image_show.show = show
+
+
+def _patch_moviepy_editor(editor):
+    try:
+        original = editor.VideoClip.write_videofile
+
+        def write_videofile(self, *args, **kwargs):
+            kwargs.setdefault("logger", None)
+            return original(self, *args, **kwargs)
+
+        editor.VideoClip.write_videofile = write_videofile
+    except Exception:
+        pass
+
+
+def _patch_torch(torch):
+    try:
+        import torch_xla.core.xla_model as xm  # noqa: F401
+
+        torch.set_default_device("xla")
+    except Exception:
+        pass  # CPU torch stays CPU torch
+
+
+_PATCHES = {
+    "numpy": _patch_numpy,
+    "matplotlib.pyplot": _patch_pyplot,
+    "PIL.ImageShow": _patch_pil,
+    "moviepy.editor": _patch_moviepy_editor,
+    "torch": _patch_torch,
+}
+
+
+def _import(name, globals=None, locals=None, fromlist=(), level=0):
+    module = _original_import(name, globals, locals, fromlist, level)
+    for target, patch in _PATCHES.items():
+        if target in _patched or target not in sys.modules:
+            continue
+        candidate = sys.modules[target]
+        # Don't touch a module that is still executing its own package init
+        # (sys.modules holds partially-initialized modules during import) —
+        # patches applied then would be overwritten by the init itself.
+        spec = getattr(candidate, "__spec__", None)
+        if spec is not None and getattr(spec, "_initializing", False):
+            continue
+        _patched.add(target)
+        try:
+            patch(candidate)
+        except Exception:
+            pass
+    return module
+
+
+builtins.__import__ = _import
+
+
+def _chain_load_next_sitecustomize():
+    """Execute the next sitecustomize.py further down sys.path.
+
+    Python imports only the *first* sitecustomize it finds; since this shim is
+    prepended to PYTHONPATH it would otherwise shadow the sandbox image's own
+    site hooks (e.g. the PJRT/TPU plugin registration some images perform
+    there). Cooperate instead of replacing.
+    """
+    import importlib.util
+    import os
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    for entry in sys.path:
+        try:
+            candidate = os.path.join(entry or ".", "sitecustomize.py")
+            if os.path.abspath(os.path.dirname(candidate)) == here:
+                continue
+            if not os.path.isfile(candidate):
+                continue
+        except OSError:
+            continue
+        try:
+            spec = importlib.util.spec_from_file_location(
+                "_chained_sitecustomize", candidate
+            )
+            module = importlib.util.module_from_spec(spec)
+            spec.loader.exec_module(module)
+        except Exception:
+            pass
+        break  # only the first shadowed one, matching Python's own behavior
+
+
+_chain_load_next_sitecustomize()

@@ -1,0 +1,61 @@
+"""The sitecustomize shim end-to-end: executed user code gets the numpy→XLA
+reroute and display patches without importing anything itself."""
+
+from pathlib import Path
+
+import pytest
+
+from bee_code_interpreter_tpu.services.local_code_executor import LocalCodeExecutor
+
+SHIM_DIR = (
+    Path(__file__).resolve().parent.parent
+    / "bee_code_interpreter_tpu" / "runtime" / "shim"
+)
+
+
+@pytest.fixture
+def shimmed_executor(storage, tmp_path):
+    return LocalCodeExecutor(
+        storage=storage,
+        workspace_root=tmp_path / "workspaces",
+        disable_dep_install=True,
+        execution_timeout_s=120.0,
+        shim_dir=SHIM_DIR,
+    )
+
+
+async def test_numpy_reroute_active_in_sandbox(shimmed_executor):
+    result = await shimmed_executor.execute(
+        "import numpy as np\n"
+        "x = np.random.rand(2_000_000)\n"
+        "s = np.sum(np.square(x))\n"
+        "print(type(s).__name__)\n"
+        "print(abs(float(s) / len(x) - 1/3) < 0.01)\n",
+        env={"JAX_PLATFORMS": "cpu"},
+    )
+    assert result.exit_code == 0, result.stderr
+    assert result.stdout == "TpuArray\nTrue\n"
+
+
+async def test_small_arrays_untouched_in_sandbox(shimmed_executor):
+    result = await shimmed_executor.execute(
+        "import numpy as np\n"
+        "out = np.matmul(np.ones((3, 3)), np.ones((3, 3)))\n"
+        "print(type(out).__name__)\n",
+        env={"JAX_PLATFORMS": "cpu"},
+    )
+    assert result.exit_code == 0, result.stderr
+    assert result.stdout == "ndarray\n"
+
+
+async def test_matplotlib_show_saves_plot(shimmed_executor):
+    pytest.importorskip("matplotlib")
+    result = await shimmed_executor.execute(
+        "import matplotlib\n"
+        "matplotlib.use('Agg')\n"
+        "import matplotlib.pyplot as plt\n"
+        "plt.plot([1, 2, 3])\n"
+        "plt.show()\n",
+    )
+    assert result.exit_code == 0, result.stderr
+    assert "/workspace/plot.png" in result.files
