@@ -1,0 +1,10 @@
+"""Pallas TPU kernels for the hot ops, with XLA fallbacks.
+
+The reference has no compute kernels at all (it is a code-execution service;
+SURVEY.md §2) — this package exists because the TPU build makes the sandbox a
+first-class numerical runtime: the bundled models (models/) and user-visible
+runtime (runtime/) call these ops, and they are written against the TPU memory
+hierarchy (HBM→VMEM→MXU/VPU; /opt/skills/guides/pallas_guide.md).
+"""
+
+from bee_code_interpreter_tpu.ops.flash_attention import flash_attention  # noqa: F401

@@ -1,0 +1,27 @@
+"""Device-mesh parallelism for sandboxed TPU workloads.
+
+The reference has no tensor/data/sequence parallelism of any kind (SURVEY.md
+§2 "Parallelism strategies": grep-verifiable absence of NCCL/MPI/collectives) —
+its scale story is "many pods". The TPU build makes parallelism a first-class
+sandbox capability: LLM-submitted code (and our bundled models) runs SPMD over
+a `jax.sharding.Mesh` spanning the pod group's chips, with XLA collectives
+riding ICI within a slice and DCN across slices.
+
+Axis conventions (used by models/, ops/ and the flagship train step):
+
+- ``dp``   data parallel (batch dimension)
+- ``fsdp`` parameter sharding within data parallel (ZeRO-style)
+- ``tp``   tensor parallel (Megatron column/row splits)
+- ``sp``   sequence/context parallel (ring attention over ICI)
+- ``ep``   expert parallel (MoE)
+"""
+
+from bee_code_interpreter_tpu.parallel.mesh import (  # noqa: F401
+    MeshPlan,
+    auto_mesh,
+    local_device_count,
+    make_mesh,
+)
+from bee_code_interpreter_tpu.parallel.ring_attention import (  # noqa: F401
+    ring_attention,
+)

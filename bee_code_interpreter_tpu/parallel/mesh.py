@@ -1,0 +1,107 @@
+"""Mesh construction and sharding helpers.
+
+Multi-host awareness: inside a pod group spawned by the scheduler
+(services/kubernetes_code_executor.py), ``initialize_distributed()`` reads the
+env the control plane baked into each worker (JAX_COORDINATOR_ADDRESS,
+JAX_NUM_PROCESSES, JAX_PROCESS_ID) and brings up ``jax.distributed`` so
+``jax.devices()`` spans every host of the slice; the mesh axes then map onto
+ICI (within slice) / DCN (across slices) by device order, which is exactly the
+layout XLA's collectives want.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import os
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+AXES = ("dp", "fsdp", "tp", "sp", "ep")
+
+
+def initialize_distributed() -> bool:
+    """Bring up jax.distributed from the pod-group env. Idempotent, no-op on
+    single-process sandboxes."""
+    num_processes = int(os.environ.get("JAX_NUM_PROCESSES", "1"))
+    if num_processes <= 1:
+        return False
+    if jax.process_count() > 1:  # already initialized
+        return True
+    jax.distributed.initialize(
+        coordinator_address=os.environ["JAX_COORDINATOR_ADDRESS"],
+        num_processes=num_processes,
+        process_id=int(os.environ.get("JAX_PROCESS_ID", "0")),
+    )
+    return True
+
+
+def local_device_count() -> int:
+    return len(jax.devices())
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshPlan:
+    """A named assignment of the device grid: axis name -> size."""
+
+    axes: dict[str, int]
+
+    @property
+    def n_devices(self) -> int:
+        return math.prod(self.axes.values())
+
+    def names(self) -> tuple[str, ...]:
+        return tuple(self.axes.keys())
+
+
+def make_mesh(axes: dict[str, int], devices=None) -> Mesh:
+    """Build a Mesh with the given axis sizes over the (global) device list.
+
+    Axis order follows the dict order; put the most communication-hungry axis
+    (tp, then sp) last so it lands on adjacent devices — on TPU, adjacency in
+    the device list means ICI neighbours, which is where all-gather/ppermute
+    bandwidth lives.
+    """
+    devices = np.asarray(devices if devices is not None else jax.devices())
+    plan = MeshPlan(dict(axes))
+    if plan.n_devices > devices.size:
+        raise ValueError(
+            f"mesh plan {axes} needs {plan.n_devices} devices, have {devices.size}"
+        )
+    grid = devices[: plan.n_devices].reshape(tuple(axes.values()))
+    return Mesh(grid, plan.names())
+
+
+def auto_mesh(n_devices: int | None = None, *, sp: int = 1) -> Mesh:
+    """A sensible default mesh: tp over adjacent chips, dp over the rest.
+
+    ``sp`` > 1 carves a sequence-parallel axis for long-context work.
+    """
+    total = n_devices or local_device_count()
+    if total % sp != 0:
+        raise ValueError(f"{total} devices not divisible by sp={sp}")
+    rest = total // sp
+    # tp gets the largest power of two <= min(rest, 8) that divides rest
+    tp = 1
+    for candidate in (8, 4, 2):
+        if rest % candidate == 0:
+            tp = candidate
+            break
+    dp = rest // tp
+    return make_mesh({"dp": dp, "sp": sp, "tp": tp})
+
+
+def sharding(mesh: Mesh, *spec) -> NamedSharding:
+    return NamedSharding(mesh, P(*spec))
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Inputs: batch over dp, sequence over sp (if present)."""
+    seq_axis = "sp" if "sp" in mesh.axis_names else None
+    return NamedSharding(mesh, P("dp", seq_axis))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())

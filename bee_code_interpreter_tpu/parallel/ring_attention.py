@@ -1,0 +1,160 @@
+"""Ring attention: exact attention over sequences sharded across devices.
+
+Long-context is first-class in this framework (SURVEY.md §5 "Long-context"):
+a sequence of length L is sharded L/sp per device over the ``sp`` mesh axis,
+and K/V blocks rotate around the ring via ``lax.ppermute`` (ICI
+neighbour-to-neighbour — the cheapest collective on TPU) while each device
+accumulates its queries' attention with a numerically-stable online softmax
+(flash-attention style running max/normalizer). Peak memory per device is
+O(L/sp · d); communication is sp-1 ppermute steps of the local K/V block,
+fully overlappable with compute by XLA since each step's matmuls depend only
+on the block already received.
+
+Causality is handled per block pair: a device's query block q_idx attends to
+rotating K/V blocks k_idx with full attention (k_idx < q_idx), triangular
+masking (k_idx == q_idx), or is skipped entirely via lax.cond (k_idx > q_idx).
+
+``ring_attention`` is the collective core, to be called *inside* shard_map
+(models/transformer.py does this when the mesh has sp > 1);
+``ring_attention_sharded`` wraps it for standalone use on a mesh.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def _block_attend(q, k, v, m, l, o, sm_scale, mask):
+    """One online-softmax accumulation step against a K/V block.
+
+    q: [B, H, Lq, D]; k, v: [B, H, Lk, D]; m, l: [B, H, Lq, 1]; o like q
+    (all float32 accumulators). mask: [Lq, Lk] additive (-inf) or None.
+    """
+    scores = jnp.einsum(
+        "bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) * sm_scale
+    if mask is not None:
+        scores = scores + mask
+    block_max = jnp.max(scores, axis=-1, keepdims=True)  # [B,H,Lq,1]
+    new_m = jnp.maximum(m, block_max)
+    # rescale previous accumulator to the new max
+    correction = jnp.exp(m - new_m)
+    p = jnp.exp(scores - new_m)  # [B,H,Lq,Lk]
+    new_l = l * correction + jnp.sum(p, axis=-1, keepdims=True)
+    pv = jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32))
+    new_o = o * correction + pv
+    return new_m, new_l, new_o
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    axis_name: str = "sp",
+    causal: bool = True,
+    sm_scale: float | None = None,
+) -> jax.Array:
+    """Exact attention with K/V rotating around the ``axis_name`` ring.
+
+    Shapes (per device): q, k, v: [B, H, L_local, D]. Returns [B, H, L_local, D]
+    in q's dtype. Must run inside shard_map with ``axis_name`` bound.
+    """
+    orig_dtype = q.dtype
+    B, H, Lq, D = q.shape
+    Lk = k.shape[2]
+    sm_scale = sm_scale if sm_scale is not None else D ** -0.5
+
+    n = lax.axis_size(axis_name)
+    my_idx = lax.axis_index(axis_name)
+
+    qf = q.astype(jnp.float32)
+    # pvary: constants must be marked varying over the ring axis or lax.cond
+    # branches disagree on the carry's sharding type under shard_map
+    m0 = lax.pvary(jnp.full((B, H, Lq, 1), -jnp.inf, dtype=jnp.float32), axis_name)
+    l0 = lax.pvary(jnp.zeros((B, H, Lq, 1), dtype=jnp.float32), axis_name)
+    o0 = lax.pvary(jnp.zeros((B, H, Lq, D), dtype=jnp.float32), axis_name)
+
+    causal_mask = None
+    if causal:
+        row = lax.broadcasted_iota(jnp.int32, (Lq, Lk), 0)
+        col = lax.broadcasted_iota(jnp.int32, (Lq, Lk), 1)
+        causal_mask = jnp.where(row >= col, 0.0, -jnp.inf).astype(jnp.float32)
+
+    # send to next ring member; after `step` hops we hold block (my_idx - step)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def body(step, carry):
+        m, l, o, k_blk, v_blk = carry
+        k_idx = (my_idx - step) % n
+
+        def attend(args):
+            m, l, o = args
+            if causal:
+                # same block: triangular mask; earlier block: no mask
+                def same_block(_):
+                    return _block_attend(qf, k_blk, v_blk, m, l, o, sm_scale, causal_mask)
+
+                def earlier_block(_):
+                    return _block_attend(qf, k_blk, v_blk, m, l, o, sm_scale, None)
+
+                return lax.cond(k_idx == my_idx, same_block, earlier_block, None)
+            return _block_attend(qf, k_blk, v_blk, m, l, o, sm_scale, None)
+
+        def skip(args):
+            return args
+
+        if causal:
+            m, l, o = lax.cond(k_idx > my_idx, skip, attend, (m, l, o))
+        else:
+            m, l, o = attend((m, l, o))
+
+        k_next = lax.ppermute(k_blk, axis_name, perm)
+        v_next = lax.ppermute(v_blk, axis_name, perm)
+        return m, l, o, k_next, v_next
+
+    m, l, o, _, _ = lax.fori_loop(0, n, body, (m0, l0, o0, k, v))
+    # guard fully-masked rows (shouldn't occur: every query sees its own block)
+    out = o / jnp.maximum(l, 1e-30)
+    return out.astype(orig_dtype)
+
+
+def ring_attention_sharded(
+    mesh: Mesh,
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    axis_name: str = "sp",
+    causal: bool = True,
+) -> jax.Array:
+    """Standalone entry: shards [B, H, L, D] inputs over ``axis_name`` on L
+    and runs the ring. For use outside an existing shard_map context."""
+    spec = P(None, None, axis_name, None)
+    fn = jax.shard_map(
+        functools.partial(ring_attention, axis_name=axis_name, causal=causal),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+    )
+    return fn(q, k, v)
+
+
+def reference_attention(q, k, v, *, causal=True):
+    """O(L²)-memory reference for tests."""
+    scores = jnp.einsum(
+        "bhqd,bhkd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32),
+        preferred_element_type=jnp.float32,
+    ) * (q.shape[-1] ** -0.5)
+    if causal:
+        Lq, Lk = scores.shape[-2:]
+        row = lax.broadcasted_iota(jnp.int32, (Lq, Lk), 0)
+        col = lax.broadcasted_iota(jnp.int32, (Lq, Lk), 1)
+        scores = jnp.where(row >= col, scores, -jnp.inf)
+    weights = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", weights, v.astype(jnp.float32)).astype(q.dtype)
