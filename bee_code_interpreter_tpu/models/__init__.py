@@ -1,0 +1,13 @@
+"""Bundled TPU-native model family for the sandbox runtime.
+
+The reference ships no models (it is a code-execution service; SURVEY.md §2) —
+these exist as the sandbox's first-class numerical payloads: the BASELINE.json
+benchmark configs (MNIST MLP under data parallelism, a llama-style transformer
+under dp×tp×sp) and the flagship model behind __graft_entry__.py / bench.py.
+"""
+
+from bee_code_interpreter_tpu.models.transformer import (  # noqa: F401
+    Transformer,
+    TransformerConfig,
+)
+from bee_code_interpreter_tpu.models.mnist import MnistMlp  # noqa: F401

@@ -1,0 +1,69 @@
+"""MNIST MLP under data parallelism — the BASELINE.json "JAX MNIST training
+snippet (jax.grad + data parallelism across 8 v5e chips)" config.
+
+Deliberately simple: an MLP, cross-entropy, SGD with momentum, and a jitted
+train step whose batch is sharded over the mesh's ``dp`` axis. XLA inserts the
+gradient all-reduce — there is no hand-written collective here, which is
+exactly the point of the sharding-first design (vs the pmap-era pattern of
+explicit psum in the loss).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+class MnistMlp:
+    def __init__(
+        self,
+        hidden_sizes: tuple[int, ...] = (512, 256),
+        n_classes: int = 10,
+        input_dim: int = 784,
+        mesh: Mesh | None = None,
+    ) -> None:
+        self.sizes = (input_dim, *hidden_sizes, n_classes)
+        self.mesh = mesh
+
+    def init(self, key: jax.Array) -> list[dict[str, jax.Array]]:
+        params = []
+        for i, (n_in, n_out) in enumerate(zip(self.sizes[:-1], self.sizes[1:])):
+            key, sub = jax.random.split(key)
+            layer = {
+                "w": jax.random.normal(sub, (n_in, n_out)) * (2.0 / n_in) ** 0.5,
+                "b": jnp.zeros((n_out,)),
+            }
+            if self.mesh is not None:  # replicated params, dp-sharded batch
+                layer = jax.tree.map(
+                    lambda x: jax.device_put(x, NamedSharding(self.mesh, P())), layer
+                )
+            params.append(layer)
+        return params
+
+    def apply(self, params, x: jax.Array) -> jax.Array:
+        for layer in params[:-1]:
+            x = jax.nn.relu(x @ layer["w"] + layer["b"])
+        return x @ params[-1]["w"] + params[-1]["b"]
+
+    def loss(self, params, batch) -> jax.Array:
+        logits = self.apply(params, batch["image"])
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, batch["label"]
+        ).mean()
+
+    def batch_sharding(self) -> NamedSharding | None:
+        if self.mesh is None:
+            return None
+        return NamedSharding(self.mesh, P("dp"))
+
+    def make_train_step(self, learning_rate: float = 0.1):
+        optimizer = optax.sgd(learning_rate, momentum=0.9)
+
+        def train_step(params, opt_state, batch):
+            loss, grads = jax.value_and_grad(self.loss)(params, batch)
+            updates, opt_state = optimizer.update(grads, opt_state)
+            return optax.apply_updates(params, updates), opt_state, loss
+
+        return jax.jit(train_step, donate_argnums=(0, 1)), optimizer

@@ -74,11 +74,12 @@ def ring_attention(
     my_idx = lax.axis_index(axis_name)
 
     qf = q.astype(jnp.float32)
-    # pvary: constants must be marked varying over the ring axis or lax.cond
-    # branches disagree on the carry's sharding type under shard_map
-    m0 = lax.pvary(jnp.full((B, H, Lq, 1), -jnp.inf, dtype=jnp.float32), axis_name)
-    l0 = lax.pvary(jnp.zeros((B, H, Lq, 1), dtype=jnp.float32), axis_name)
-    o0 = lax.pvary(jnp.zeros((B, H, Lq, D), dtype=jnp.float32), axis_name)
+    # derive accumulators from qf so they carry the same varying-axes type as
+    # the data (shard_map vma typing: plain constants are "unvarying" and make
+    # lax.cond branches disagree, whatever the surrounding mesh axes are)
+    m0 = jnp.zeros_like(qf[..., :1]) - jnp.inf
+    l0 = jnp.zeros_like(qf[..., :1])
+    o0 = jnp.zeros_like(qf)
 
     causal_mask = None
     if causal:

@@ -1,0 +1,138 @@
+#!/usr/bin/env python
+"""Headline benchmark: dense-matmul GFLOPS/chip driven through /v1/execute.
+
+Measures the BASELINE.json north-star metric — the benchmark-numpy dense
+matmul payload submitted through the service's real execution path (the
+sandbox executor with the TPU runtime shim), reported as GFLOPS on the
+attached chip. ``vs_baseline`` compares against the same payload on the host
+CPU path (the reference's only execution substrate; BASELINE.md "the
+reference's CPU path is the comparison baseline").
+
+Prints ONE JSON line:
+    {"metric": ..., "value": N, "unit": "GFLOPS", "vs_baseline": N}
+
+Extra detail lines go to stderr.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import sys
+import tempfile
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent
+SHIM_DIR = REPO / "bee_code_interpreter_tpu" / "runtime" / "shim"
+
+N = 8192
+ITERS = 60
+
+# The measured payload: a bf16 matmul chain under jit, the shape of work the
+# MXU exists for. Chained with a data dependency (no loop hoisting), one
+# device->host readback at the end. Written the way a sandbox user writes JAX.
+TPU_PAYLOAD = f"""
+import time
+import jax, jax.numpy as jnp
+from jax import lax
+
+n, iters = {N}, {ITERS}
+if jax.devices()[0].platform == "cpu":
+    n, iters = 1024, 4  # no accelerator: validate mechanics only
+a = jax.random.normal(jax.random.PRNGKey(0), (n, n), dtype=jnp.bfloat16)
+
+@jax.jit
+def chain(a):
+    def body(i, x):
+        return (a @ x) * jnp.bfloat16(0.001)
+    return lax.fori_loop(0, iters, body, a).sum()
+
+float(chain(a))  # compile + warm
+best = float("inf")
+for _ in range(3):
+    t0 = time.time()
+    float(chain(a))
+    best = min(best, time.time() - t0)
+print(f"RESULT_GFLOPS {{2 * n**3 * iters / best / 1e9:.1f}}")
+"""
+
+# Host-CPU baseline: identical math through plain numpy (f32 — numpy has no
+# bf16), sized down with the same per-element rate extrapolation the
+# reference's own benchmark payload uses (self-timed wall clock).
+CPU_PAYLOAD = """
+import os
+os.environ["BCI_XLA_REROUTE"] = "0"
+import time
+import numpy as np
+
+n, iters = 4096, 4
+a = np.random.rand(n, n).astype(np.float32)
+x = a
+t0 = time.time()
+for _ in range(iters):
+    x = (a @ x) * np.float32(0.001)
+s = float(x.sum())
+dt = time.time() - t0
+print(f"RESULT_GFLOPS {2 * n**3 * iters / dt / 1e9:.1f}")
+"""
+
+
+async def run_payload(source: str, env: dict[str, str]) -> float:
+    from bee_code_interpreter_tpu.services.local_code_executor import (
+        LocalCodeExecutor,
+    )
+    from bee_code_interpreter_tpu.services.storage import Storage
+
+    tmp = tempfile.mkdtemp(prefix="bench-")
+    executor = LocalCodeExecutor(
+        storage=Storage(Path(tmp) / "objects"),
+        workspace_root=Path(tmp) / "ws",
+        disable_dep_install=True,
+        execution_timeout_s=300.0,
+        shim_dir=SHIM_DIR,
+    )
+    result = await executor.execute(source, env=env)
+    if result.exit_code != 0:
+        print(result.stderr, file=sys.stderr)
+        raise RuntimeError(f"payload failed (exit {result.exit_code})")
+    for line in result.stdout.splitlines():
+        if line.startswith("RESULT_GFLOPS"):
+            return float(line.split()[1])
+    raise RuntimeError(f"no result in stdout: {result.stdout!r}")
+
+
+def main() -> None:
+    # the TPU payload must see the real chip, not the test-forced CPU
+    # TPU/XLA/accelerator env flows through the executor's passthrough list +
+    # the process environment; PYTHONPATH must NOT be overridden here or the
+    # shim prepend (and the image's own site hooks) would be lost.
+    tpu_env = {
+        k: v for k, v in os.environ.items()
+        if k.startswith(("TPU", "JAX", "XLA", "PALLAS"))
+    }
+    cpu_gflops = asyncio.run(run_payload(CPU_PAYLOAD, {"JAX_PLATFORMS": "cpu"}))
+    print(f"cpu baseline: {cpu_gflops:.1f} GFLOPS", file=sys.stderr)
+
+    try:
+        tpu_gflops = asyncio.run(run_payload(TPU_PAYLOAD, tpu_env))
+        print(f"tpu: {tpu_gflops:.1f} GFLOPS", file=sys.stderr)
+        result = {
+            "metric": "dense matmul GFLOPS/chip via /v1/execute (bf16 8192^3 jit chain)",
+            "value": round(tpu_gflops, 1),
+            "unit": "GFLOPS",
+            "vs_baseline": round(tpu_gflops / cpu_gflops, 2),
+        }
+    except Exception as e:  # no chip reachable: report the CPU path honestly
+        print(f"tpu payload failed ({e}); reporting CPU-path result", file=sys.stderr)
+        result = {
+            "metric": "dense matmul GFLOPS via /v1/execute (CPU fallback - no TPU reachable)",
+            "value": round(cpu_gflops, 1),
+            "unit": "GFLOPS",
+            "vs_baseline": 1.0,
+        }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()
