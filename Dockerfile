@@ -1,0 +1,24 @@
+# Service (control plane) image. Parity with the reference's service image
+# (Dockerfile:1-20): python runtime + kubectl + storage dir; our dependencies
+# are pure-pip (aiohttp/grpcio/pydantic/httpx/tenacity).
+FROM python:3.12-slim AS runtime
+
+RUN apt-get update \
+    && apt-get install -y --no-install-recommends curl ca-certificates \
+    && curl -fsSLo /usr/local/bin/kubectl \
+       "https://dl.k8s.io/release/v1.30.0/bin/linux/$(dpkg --print-architecture)/kubectl" \
+    && chmod +x /usr/local/bin/kubectl \
+    && apt-get purge -y curl && apt-get autoremove -y \
+    && rm -rf /var/lib/apt/lists/*
+
+WORKDIR /app
+COPY pyproject.toml ./
+COPY bee_code_interpreter_tpu ./bee_code_interpreter_tpu
+RUN pip install --no-cache-dir aiohttp grpcio protobuf pydantic httpx tenacity \
+    && pip install --no-cache-dir --no-deps .
+
+RUN mkdir -p /storage && chmod 777 /storage
+ENV APP_FILE_STORAGE_PATH=/storage
+
+EXPOSE 50051 50081
+CMD ["python", "-m", "bee_code_interpreter_tpu"]

@@ -1,0 +1,17 @@
+# CPU benchmark payload (parity with reference examples/benchmark-fib.py:17-33):
+# pure-Python bignum work, deliberately NOT acceleratable — measures the
+# sandbox's plain interpreter throughput.
+import time
+
+
+def fib(n: int) -> int:
+    a, b = 0, 1
+    for _ in range(n):
+        a, b = b, a + b
+    return a
+
+
+start = time.time()
+for _ in range(1000):
+    fib(10000)
+print(f"1000 x fib(10000) in {time.time() - start:.3f}s")

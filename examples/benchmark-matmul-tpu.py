@@ -1,0 +1,25 @@
+# The headline TPU payload (bench.py runs this shape through /v1/execute):
+# a jit-compiled bf16 matmul chain — the MXU at work from LLM-submitted code.
+import time
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+n, iters = 8192, 60
+a = jax.random.normal(jax.random.PRNGKey(0), (n, n), dtype=jnp.bfloat16)
+
+
+@jax.jit
+def chain(a):
+    def body(i, x):
+        return (a @ x) * jnp.bfloat16(0.001)
+    return lax.fori_loop(0, iters, body, a).sum()
+
+
+float(chain(a))  # compile
+t0 = time.time()
+float(chain(a))
+dt = time.time() - t0
+print(f"devices: {jax.devices()}")
+print(f"{2 * n**3 * iters / dt / 1e12:.1f} TFLOPS")

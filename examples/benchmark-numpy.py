@@ -1,0 +1,15 @@
+# Dense-array benchmark payload (capability parity with the reference's
+# examples/benchmark-numpy.py:19-29): plain numpy code, self-timed. Under the
+# TPU sandbox runtime the creation + square + sum chain runs on the attached
+# chip via the transparent XLA reroute; on the reference it runs on host CPU.
+import time
+
+import numpy as np
+
+n = 10**8
+start = time.time()
+x = np.random.rand(n)
+result = float(np.sum(np.square(x)))
+elapsed = time.time() - start
+print(f"kind={type(np.square(x)).__name__}")
+print(f"sum(square(rand({n}))) = {result:.1f} in {elapsed:.3f}s")

@@ -1,0 +1,6 @@
+# On-the-fly dependency install probe (parity with reference
+# examples/cowsay.py): cowsay is not preinstalled; the executor's import
+# guesser should pip-install it before running this.
+import cowsay
+
+cowsay.cow("mooooo from the TPU sandbox")

@@ -1,0 +1,3 @@
+# Nonzero-exit probe (parity with reference examples/crash.py): the service
+# must surface the exit code and traceback, not 500.
+raise RuntimeError("intentional crash to exercise error propagation")

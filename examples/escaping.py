@@ -1,0 +1,6 @@
+# Quoting/escaping edge cases (parity with reference examples/escaping.py —
+# which probed xonsh quirks; we run plain python so these must all be literal).
+print("double \" and single ' quotes")
+print('backslash \\ and tab \t end')
+print("""triple ' " mixed $HOME `backticks` $(subshell)""")
+print("unicode: ünïcödé ✓ 中文")

@@ -1,0 +1,8 @@
+# Workspace file round-trip probe (parity with reference examples/files.py and
+# hello_world_{read,write}_file.py): files written here come back in the
+# response's file map and can be re-mounted into the next execution.
+from pathlib import Path
+
+Path("notes/session.txt").parent.mkdir(parents=True, exist_ok=True)
+Path("notes/session.txt").write_text("state carried between executions\n")
+print(sorted(str(p) for p in Path(".").rglob("*") if p.is_file()))

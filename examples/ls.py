@@ -1,0 +1,7 @@
+# Workspace environment probe (parity with reference examples/ls.py).
+import os
+import sys
+
+print("cwd:", os.getcwd())
+print("python:", sys.version.split()[0])
+print("entries:", sorted(os.listdir(".")))

@@ -1,0 +1,28 @@
+# The BASELINE.json "JAX MNIST training across 8 chips" config: data-parallel
+# training using the bundled model library inside the sandbox.
+import jax
+import jax.numpy as jnp
+
+from bee_code_interpreter_tpu.models import MnistMlp
+from bee_code_interpreter_tpu.parallel import make_mesh
+
+n = len(jax.devices())
+mesh = make_mesh({"dp": n})
+model = MnistMlp(mesh=mesh)
+params = model.init(jax.random.PRNGKey(0))
+step, optimizer = model.make_train_step(0.05)
+opt_state = optimizer.init(params)
+
+key = jax.random.PRNGKey(1)
+batch = jax.device_put(
+    {
+        "image": jax.random.normal(key, (64 * n, 784)),
+        "label": jax.random.randint(key, (64 * n,), 0, 10),
+    },
+    model.batch_sharding(),
+)
+for i in range(20):
+    params, opt_state, loss = step(params, opt_state, batch)
+    if i % 5 == 0:
+        print(f"step {i}: loss {float(loss):.4f}")
+print(f"trained data-parallel over {n} device(s): {jax.devices()}")

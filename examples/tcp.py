@@ -1,0 +1,10 @@
+# Network egress probe (parity with reference examples/tcp.py): reports
+# whether the sandbox allows outbound TCP — deployments typically restrict it
+# with NetworkPolicy.
+import socket
+
+try:
+    with socket.create_connection(("1.1.1.1", 443), timeout=3):
+        print("egress: OPEN")
+except OSError as e:
+    print(f"egress: BLOCKED ({e})")

@@ -1,0 +1,12 @@
+# Preinstalled scientific stack probe (parity with reference
+# examples/using_imports.py): numpy/pandas/scipy interop, with the numpy work
+# transparently rerouted to the TPU where it is large enough.
+import numpy as np
+import pandas as pd
+from scipy import stats
+
+a = np.random.rand(2_000_000)
+b = np.random.rand(2_000_000)
+t, p = stats.ttest_ind(np.asarray(a), np.asarray(b))  # scipy consumes host views
+df = pd.DataFrame({"t": [t], "p": [p]})
+print(df.to_string(index=False))

@@ -1,0 +1,18 @@
+#!/usr/bin/env bash
+# Build both images locally, (re)deploy to the current kubectl context, wait
+# for readiness, port-forward, and follow logs (reference scripts/run-build.sh
+# :16-27 behavior).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+docker build -t bee-code-interpreter-tpu:local .
+docker build -t bee-code-interpreter-tpu-executor:local \
+  --build-context repo=. executor/
+
+kubectl delete pod bee-code-interpreter-tpu --ignore-not-found=true --wait=true
+kubectl apply -f k8s/local.yaml
+kubectl wait --for=condition=Ready pod/bee-code-interpreter-tpu --timeout=120s
+
+kubectl port-forward pod/bee-code-interpreter-tpu 50081:50081 50051:50051 &
+trap 'kill %1' EXIT
+kubectl logs -f bee-code-interpreter-tpu

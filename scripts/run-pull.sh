@@ -1,0 +1,13 @@
+#!/usr/bin/env bash
+# Deploy from the published images instead of a local build (reference
+# scripts/run-pull.sh:16-24 behavior).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+kubectl delete pod bee-code-interpreter-tpu --ignore-not-found=true --wait=true
+kubectl apply -f k8s/tpu.yaml
+kubectl wait --for=condition=Ready pod/bee-code-interpreter-tpu --timeout=300s
+
+kubectl port-forward pod/bee-code-interpreter-tpu 50081:50081 50051:50051 &
+trap 'kill %1' EXIT
+kubectl logs -f bee-code-interpreter-tpu
