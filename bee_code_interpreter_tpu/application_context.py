@@ -40,6 +40,7 @@ class ApplicationContext:
                 workspace_root=self.config.local_workspace_root,
                 disable_dep_install=self.config.disable_dep_install,
                 execution_timeout_s=self.config.execution_timeout_s,
+                shim_dir=self.config.resolved_shim_dir(),
             )
         from bee_code_interpreter_tpu.services.kubectl import Kubectl
         from bee_code_interpreter_tpu.services.kubernetes_code_executor import (

@@ -99,6 +99,21 @@ class Config(BaseModel):
     local_workspace_root: str = "./.tmp/workspaces"
     # Disable auto `pip install` of guessed deps (tests / air-gapped envs).
     disable_dep_install: bool = False
+    # Directory prepended to every sandbox process's PYTHONPATH so the
+    # sitecustomize shim (display patches + numpy→XLA reroute; reference
+    # executor/sitecustomize.py:1-31) loads. Defaults to the shim shipped in
+    # this package; set to "none" (or "") to disable — the env surface drops
+    # empty values (env_ignore_empty), so APP_SHIM_DIR=none is the way to
+    # disable it on a deployment.
+    shim_dir: str | None = None
+
+    def resolved_shim_dir(self) -> str | None:
+        if self.shim_dir is not None:
+            disabled = self.shim_dir.strip().lower() in ("", "none", "off", "disabled")
+            return None if disabled else self.shim_dir
+        from pathlib import Path
+
+        return str(Path(__file__).resolve().parent / "runtime" / "shim")
 
     logging_config: dict[str, Any] = Field(default_factory=_default_logging_config)
 

@@ -24,7 +24,19 @@ _original_import = builtins.__import__
 
 def _patch_numpy(numpy):
     try:
-        from bee_code_interpreter_tpu.runtime import xla_reroute
+        try:
+            from bee_code_interpreter_tpu.runtime import xla_reroute
+        except ImportError:
+            # Sandbox interpreters get only this shim dir on PYTHONPATH; the
+            # shim ships inside the package tree (…/bee_code_interpreter_tpu/
+            # runtime/shim/), so the package root is three levels up.
+            import os
+
+            root = os.path.dirname(os.path.dirname(os.path.dirname(
+                os.path.dirname(os.path.abspath(__file__)))))
+            if root not in sys.path:
+                sys.path.append(root)
+            from bee_code_interpreter_tpu.runtime import xla_reroute
 
         xla_reroute.install(numpy)
     except Exception:

@@ -9,7 +9,8 @@ import numpy as np
 n = 10**8
 start = time.time()
 x = np.random.rand(n)
-result = float(np.sum(np.square(x)))
+y = np.square(x)
+result = float(np.sum(y))
 elapsed = time.time() - start
-print(f"kind={type(np.square(x)).__name__}")
+print(f"kind={type(y).__name__}")
 print(f"sum(square(rand({n}))) = {result:.1f} in {elapsed:.3f}s")

@@ -1,11 +1,15 @@
 #!/usr/bin/env bash
 # Deploy from the published images instead of a local build (reference
-# scripts/run-pull.sh:16-24 behavior).
+# scripts/run-pull.sh:16-24 behavior). CI publishes to
+# ghcr.io/<owner>/<repo>/{service,executor}:{<tag>,latest}; point IMAGE_REPO at
+# that prefix (k8s/tpu.yaml carries an IMAGE_REPO placeholder).
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
+: "${IMAGE_REPO:?set IMAGE_REPO to the registry prefix, e.g. ghcr.io/<owner>/<repo>}"
+
 kubectl delete pod bee-code-interpreter-tpu --ignore-not-found=true --wait=true
-kubectl apply -f k8s/tpu.yaml
+sed "s#IMAGE_REPO#${IMAGE_REPO}#g" k8s/tpu.yaml | kubectl apply -f -
 kubectl wait --for=condition=Ready pod/bee-code-interpreter-tpu --timeout=300s
 
 kubectl port-forward pod/bee-code-interpreter-tpu 50081:50081 50051:50051 &

@@ -1,0 +1,61 @@
+"""Composition-root wiring: the service must assemble executors exactly as the
+operator-facing config describes (reference application_context.py:36-125).
+
+Regression anchor: the sandbox shim (sitecustomize display patches + numpy→XLA
+reroute) must be wired into the local executor by *default* — it broke silently
+once because only hand-built LocalCodeExecutor fixtures passed shim_dir.
+"""
+
+from pathlib import Path
+
+from bee_code_interpreter_tpu.application_context import ApplicationContext
+from bee_code_interpreter_tpu.config import Config
+from bee_code_interpreter_tpu.services.local_code_executor import LocalCodeExecutor
+
+
+def _local_config(tmp_path, **overrides) -> Config:
+    return Config(
+        executor_backend="local",
+        file_storage_path=str(tmp_path / "files"),
+        local_workspace_root=str(tmp_path / "ws"),
+        disable_dep_install=True,
+        **overrides,
+    )
+
+
+def test_local_backend_gets_default_shim(tmp_path):
+    ctx = ApplicationContext(_local_config(tmp_path))
+    executor = ctx.code_executor
+    assert isinstance(executor, LocalCodeExecutor)
+    shim_dir = executor._shim_dir
+    assert shim_dir is not None
+    assert (Path(shim_dir) / "sitecustomize.py").is_file()
+
+
+def test_shim_disabled_by_empty_string(tmp_path):
+    ctx = ApplicationContext(_local_config(tmp_path, shim_dir=""))
+    assert ctx.code_executor._shim_dir is None
+
+
+def test_shim_disabled_via_env(tmp_path):
+    # The env surface drops empty values (env_ignore_empty), so the documented
+    # disable spelling is APP_SHIM_DIR=none.
+    config = Config.from_env(
+        {"APP_EXECUTOR_BACKEND": "local", "APP_SHIM_DIR": "none"}
+    )
+    assert config.resolved_shim_dir() is None
+
+
+def test_shim_dir_env_override(tmp_path):
+    config = Config.from_env(
+        {
+            "APP_EXECUTOR_BACKEND": "local",
+            "APP_SHIM_DIR": str(tmp_path / "custom-shim"),
+        }
+    )
+    assert config.resolved_shim_dir() == str(tmp_path / "custom-shim")
+
+
+def test_servers_share_one_executor(tmp_path):
+    ctx = ApplicationContext(_local_config(tmp_path))
+    assert ctx.custom_tool_executor._code_executor is ctx.code_executor
