@@ -14,12 +14,14 @@ multicallables for health checks and tests.
 
 from __future__ import annotations
 
+import asyncio
 import logging
 
 import grpc
 import grpc.aio
 
 from bee_code_interpreter_tpu.proto import code_interpreter_pb2 as pb
+from bee_code_interpreter_tpu.proto import health_pb2
 from bee_code_interpreter_tpu.services.code_executor import CodeExecutor
 from bee_code_interpreter_tpu.services.custom_tool_executor import (
     CustomToolExecuteError,
@@ -114,6 +116,74 @@ class CodeInterpreterServicer:
         )
 
 
+HEALTH_SERVICE_NAME = "grpc.health.v1.Health"
+
+
+class HealthServicer:
+    """The standard gRPC health protocol (proto/health.proto) — the reference
+    left this as a TODO (reference grpc_server.py:71). The empty service name
+    tracks overall server health; ``set_status`` flips per-service status and
+    wakes any Watch streams."""
+
+    def __init__(self) -> None:
+        self._statuses: dict[str, int] = {
+            "": health_pb2.HealthCheckResponse.SERVING,
+            SERVICE_NAME: health_pb2.HealthCheckResponse.SERVING,
+        }
+        self._changed: "asyncio.Event" = asyncio.Event()
+
+    def set_status(self, service: str, status: int) -> None:
+        self._statuses[service] = status
+        self._changed.set()
+        self._changed = asyncio.Event()
+
+    def _status_of(self, service: str) -> int | None:
+        return self._statuses.get(service)
+
+    async def Check(
+        self, request: health_pb2.HealthCheckRequest, context: grpc.aio.ServicerContext
+    ) -> health_pb2.HealthCheckResponse:
+        status = self._status_of(request.service)
+        if status is None:
+            await context.abort(grpc.StatusCode.NOT_FOUND, "unknown service")
+        return health_pb2.HealthCheckResponse(status=status)
+
+    async def Watch(
+        self, request: health_pb2.HealthCheckRequest, context: grpc.aio.ServicerContext
+    ):
+        last: int | None = object()  # type: ignore[assignment] # force first send
+        while True:
+            # capture the event BEFORE reading the status: a set_status racing
+            # with the yield below then fires this (already-captured) event and
+            # the next loop iteration re-reads, so no transition is lost
+            event = self._changed
+            status = self._status_of(request.service)
+            if status is None:
+                status = health_pb2.HealthCheckResponse.SERVICE_UNKNOWN
+            if status != last:
+                yield health_pb2.HealthCheckResponse(status=status)
+                last = status
+            await event.wait()
+
+
+def _health_handler(servicer: HealthServicer) -> grpc.GenericRpcHandler:
+    return grpc.method_handlers_generic_handler(
+        HEALTH_SERVICE_NAME,
+        {
+            "Check": grpc.unary_unary_rpc_method_handler(
+                servicer.Check,
+                request_deserializer=health_pb2.HealthCheckRequest.FromString,
+                response_serializer=health_pb2.HealthCheckResponse.SerializeToString,
+            ),
+            "Watch": grpc.unary_stream_rpc_method_handler(
+                servicer.Watch,
+                request_deserializer=health_pb2.HealthCheckRequest.FromString,
+                response_serializer=health_pb2.HealthCheckResponse.SerializeToString,
+            ),
+        },
+    )
+
+
 def _generic_handler(servicer: CodeInterpreterServicer) -> grpc.GenericRpcHandler:
     handlers = {
         name: grpc.unary_unary_rpc_method_handler(
@@ -138,6 +208,15 @@ def service_stubs(channel: grpc.aio.Channel | grpc.Channel) -> dict[str, object]
     }
 
 
+def health_stub(channel: grpc.aio.Channel | grpc.Channel):
+    """Client-side Check multicallable for the standard health protocol."""
+    return channel.unary_unary(
+        f"/{HEALTH_SERVICE_NAME}/Check",
+        request_serializer=health_pb2.HealthCheckRequest.SerializeToString,
+        response_deserializer=health_pb2.HealthCheckResponse.FromString,
+    )
+
+
 class GrpcServer:
     def __init__(
         self,
@@ -148,6 +227,7 @@ class GrpcServer:
         tls_ca_cert: bytes | None = None,
     ) -> None:
         self._servicer = CodeInterpreterServicer(code_executor, custom_tool_executor)
+        self.health = HealthServicer()
         self._tls_cert = tls_cert
         self._tls_cert_key = tls_cert_key
         self._tls_ca_cert = tls_ca_cert
@@ -156,7 +236,9 @@ class GrpcServer:
     async def start(self, listen_addr: str) -> int:
         """Start serving; returns the bound port (useful with ':0')."""
         self._server = grpc.aio.server()
-        self._server.add_generic_rpc_handlers((_generic_handler(self._servicer),))
+        self._server.add_generic_rpc_handlers(
+            (_generic_handler(self._servicer), _health_handler(self.health))
+        )
         if self._tls_cert and self._tls_cert_key:
             # mTLS when a CA is provided (reference application_context.py:102-110).
             creds = grpc.ssl_server_credentials(

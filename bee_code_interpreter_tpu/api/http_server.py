@@ -26,6 +26,7 @@ from bee_code_interpreter_tpu.services.custom_tool_executor import (
     CustomToolExecutor,
     CustomToolParseError,
 )
+from bee_code_interpreter_tpu.utils.metrics import Registry
 from bee_code_interpreter_tpu.utils.request_id import new_request_id
 
 logger = logging.getLogger(__name__)
@@ -34,13 +35,39 @@ logger = logging.getLogger(__name__)
 def create_http_server(
     code_executor: CodeExecutor,
     custom_tool_executor: CustomToolExecutor,
+    metrics: Registry | None = None,
 ) -> web.Application:
     app = web.Application(client_max_size=1 << 30)
+    metrics = metrics or Registry()
+    requests_total = metrics.counter(
+        "bci_http_requests_total", "HTTP requests by route and status"
+    )
+    request_seconds = metrics.histogram(
+        "bci_http_request_seconds", "HTTP request latency by route"
+    )
 
     @web.middleware
     async def request_id_middleware(request: web.Request, handler):
         new_request_id()
-        return await handler(request)
+        # label by the *matched* route template, never the raw path: raw paths
+        # are attacker-controlled (unbounded label cardinality + exposition
+        # injection via percent-decoded quotes)
+        # match_info is a dict subclass (empty — falsy — for static routes), so
+        # test identity, not truthiness
+        match_info = request.match_info
+        resource = match_info.route.resource if match_info is not None else None
+        route = resource.canonical if resource is not None else "unmatched"
+        with request_seconds.time(route=route):
+            try:
+                response = await handler(request)
+            except web.HTTPException as e:
+                requests_total.inc(route=route, status=str(e.status))
+                raise
+            except Exception:
+                requests_total.inc(route=route, status="500")
+                raise
+        requests_total.inc(route=route, status=str(response.status))
+        return response
 
     app.middlewares.append(request_id_middleware)
 
@@ -101,8 +128,14 @@ def create_http_server(
     async def healthz(_request: web.Request) -> web.Response:
         return web.json_response({"status": "ok"})
 
+    async def metrics_endpoint(_request: web.Request) -> web.Response:
+        return web.Response(
+            text=metrics.expose(), content_type="text/plain", charset="utf-8"
+        )
+
     app.router.add_post("/v1/execute", execute)
     app.router.add_post("/v1/parse-custom-tool", parse_custom_tool)
     app.router.add_post("/v1/execute-custom-tool", execute_custom_tool)
     app.router.add_get("/healthz", healthz)
+    app.router.add_get("/metrics", metrics_endpoint)
     return app

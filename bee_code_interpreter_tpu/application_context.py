@@ -15,6 +15,7 @@ from functools import cached_property
 from bee_code_interpreter_tpu.config import Config
 from bee_code_interpreter_tpu.services.custom_tool_executor import CustomToolExecutor
 from bee_code_interpreter_tpu.services.storage import Storage
+from bee_code_interpreter_tpu.utils.metrics import Registry
 from bee_code_interpreter_tpu.utils.request_id import install_request_id_filter
 
 
@@ -23,6 +24,7 @@ class ApplicationContext:
         self.config = config or Config.from_env()
         logging.config.dictConfig(self.config.logging_config)
         install_request_id_filter()
+        self.metrics = Registry()
 
     @cached_property
     def storage(self) -> Storage:
@@ -52,6 +54,16 @@ class ApplicationContext:
             storage=self.storage,
             config=self.config,
         )
+        self.metrics.gauge(
+            "bci_executor_pool_ready",
+            "Warm executor pod groups ready in the pool",
+            lambda: executor.pool_ready_count,
+        )
+        self.metrics.gauge(
+            "bci_executor_pool_spawning",
+            "Executor pod groups currently being spawned",
+            lambda: executor.pool_spawning_count,
+        )
         # Pool warmup starts as soon as the executor exists (reference
         # application_context.py:83). Outside a running loop (e.g. tests
         # constructing the context), warmup is deferred — the pool refills on
@@ -73,6 +85,7 @@ class ApplicationContext:
         return create_http_server(
             code_executor=self.code_executor,
             custom_tool_executor=self.custom_tool_executor,
+            metrics=self.metrics,
         )
 
     @cached_property

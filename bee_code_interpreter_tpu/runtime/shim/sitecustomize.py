@@ -13,6 +13,8 @@ Patches:
 - ``moviepy`` ``write_videofile``: logger silenced (tqdm noise in stderr)
 - ``torch``           → if torch_xla is importable, make "xla" the default
                         device so torch code lands on the TPU too
+- ``jax``             → if BCI_PROFILE_DIR is set, capture a jax.profiler
+                        trace of the whole run into that directory
 """
 
 import builtins
@@ -29,7 +31,8 @@ def _patch_numpy(numpy):
         except ImportError:
             # Sandbox interpreters get only this shim dir on PYTHONPATH; the
             # shim ships inside the package tree (…/bee_code_interpreter_tpu/
-            # runtime/shim/), so the package root is three levels up.
+            # runtime/shim/sitecustomize.py), so the directory *containing* the
+            # package is four dirname()s up from this file.
             import os
 
             root = os.path.dirname(os.path.dirname(os.path.dirname(
@@ -80,12 +83,38 @@ def _patch_torch(torch):
         pass  # CPU torch stays CPU torch
 
 
+def _patch_jax_profiler(jax):
+    """BCI_PROFILE_DIR=<dir> captures a jax.profiler trace of the whole run.
+
+    The trace starts when user code first imports jax and stops at interpreter
+    exit; written under the workspace it rides the executor's changed-file
+    snapshot back to the client (SURVEY.md §5 "add jax.profiler trace capture
+    endpoints in the sandbox") — no separate download channel needed.
+    """
+    import atexit
+    import os
+
+    trace_dir = os.environ.get("BCI_PROFILE_DIR")
+    if not trace_dir:
+        return
+    jax.profiler.start_trace(trace_dir)
+
+    def _stop():
+        try:
+            jax.profiler.stop_trace()
+        except Exception:
+            pass
+
+    atexit.register(_stop)
+
+
 _PATCHES = {
     "numpy": _patch_numpy,
     "matplotlib.pyplot": _patch_pyplot,
     "PIL.ImageShow": _patch_pil,
     "moviepy.editor": _patch_moviepy_editor,
     "torch": _patch_torch,
+    "jax": _patch_jax_profiler,
 }
 
 

@@ -96,6 +96,16 @@ class KubernetesCodeExecutor:
         self._fill_lock = asyncio.Lock()
         self._self_pod: dict | None = None
 
+    @property
+    def pool_ready_count(self) -> int:
+        """Warm pod groups ready to serve (metrics/introspection)."""
+        return len(self._queue)
+
+    @property
+    def pool_spawning_count(self) -> int:
+        """Pod groups currently being spawned (metrics/introspection)."""
+        return self._spawning_count
+
     # ------------------------------------------------------------- execution
 
     @retry(

@@ -1,0 +1,145 @@
+"""Dependency-free Prometheus-style metrics.
+
+The reference has no metrics at all (SURVEY.md §5 "No Prometheus/OTel"); this
+adds the standard text exposition format (counters, gauges, histograms) without
+requiring prometheus_client in the image. One process-global registry, scraped
+at ``GET /metrics`` on the HTTP server.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import defaultdict
+from typing import Callable, Iterable
+
+# Latency buckets (seconds) spanning a warm local exec (~50ms) through a cold
+# TPU pod spawn (~60s, reference kubernetes_code_executor.py:239-241).
+DEFAULT_BUCKETS = (0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0)
+
+
+def _escape(value: str) -> str:
+    # Prometheus exposition label-value escaping: backslash, quote, newline.
+    return value.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _fmt_labels(labels: dict[str, str]) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(f'{k}="{_escape(v)}"' for k, v in sorted(labels.items()))
+    return "{" + inner + "}"
+
+
+def _fmt_num(value: float) -> str:
+    # %g rounds to 6 significant digits, visibly corrupting counters past 1e6;
+    # emit integers exactly and floats at full precision like prometheus_client.
+    if float(value).is_integer() and abs(value) < 2**53:
+        return str(int(value))
+    return repr(float(value))
+
+
+class Counter:
+    def __init__(self, name: str, help_text: str) -> None:
+        self.name, self.help = name, help_text
+        self._values: dict[tuple, float] = defaultdict(float)
+
+    def inc(self, value: float = 1.0, **labels: str) -> None:
+        self._values[tuple(sorted(labels.items()))] += value
+
+    def collect(self) -> Iterable[str]:
+        yield f"# HELP {self.name} {self.help}"
+        yield f"# TYPE {self.name} counter"
+        for key, v in sorted(self._values.items()):
+            yield f"{self.name}{_fmt_labels(dict(key))} {_fmt_num(v)}"
+
+
+class Gauge:
+    """A gauge read from a callback at scrape time (pool sizes, queue depths)."""
+
+    def __init__(self, name: str, help_text: str, fn: Callable[[], float]) -> None:
+        self.name, self.help, self._fn = name, help_text, fn
+
+    def collect(self) -> Iterable[str]:
+        yield f"# HELP {self.name} {self.help}"
+        yield f"# TYPE {self.name} gauge"
+        try:
+            yield f"{self.name} {_fmt_num(self._fn())}"
+        except Exception:
+            yield f"{self.name} NaN"
+
+
+class Histogram:
+    def __init__(
+        self, name: str, help_text: str, buckets: tuple[float, ...] = DEFAULT_BUCKETS
+    ) -> None:
+        self.name, self.help = name, help_text
+        self._buckets = tuple(sorted(buckets))
+        self._counts: dict[tuple, list[int]] = {}
+        self._sums: dict[tuple, float] = defaultdict(float)
+        self._totals: dict[tuple, int] = defaultdict(int)
+
+    def observe(self, value: float, **labels: str) -> None:
+        key = tuple(sorted(labels.items()))
+        counts = self._counts.setdefault(key, [0] * len(self._buckets))
+        for i, bound in enumerate(self._buckets):
+            if value <= bound:
+                counts[i] += 1
+        self._sums[key] += value
+        self._totals[key] += 1
+
+    def time(self, **labels: str) -> "_Timer":
+        return _Timer(self, labels)
+
+    def collect(self) -> Iterable[str]:
+        yield f"# HELP {self.name} {self.help}"
+        yield f"# TYPE {self.name} histogram"
+        for key in sorted(self._totals):
+            base = dict(key)
+            counts = self._counts.get(key, [0] * len(self._buckets))
+            for bound, c in zip(self._buckets, counts):
+                yield (
+                    f"{self.name}_bucket"
+                    f"{_fmt_labels({**base, 'le': f'{bound:g}'})} {c}"
+                )
+            yield f"{self.name}_bucket{_fmt_labels({**base, 'le': '+Inf'})} {self._totals[key]}"
+            yield f"{self.name}_sum{_fmt_labels(base)} {_fmt_num(self._sums[key])}"
+            yield f"{self.name}_count{_fmt_labels(base)} {self._totals[key]}"
+
+
+class _Timer:
+    def __init__(self, hist: Histogram, labels: dict[str, str]) -> None:
+        self._hist, self._labels = hist, labels
+
+    def __enter__(self) -> "_Timer":
+        self._t0 = time.monotonic()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self._hist.observe(time.monotonic() - self._t0, **self._labels)
+
+
+class Registry:
+    def __init__(self) -> None:
+        self._metrics: list[Counter | Gauge | Histogram] = []
+
+    def counter(self, name: str, help_text: str) -> Counter:
+        m = Counter(name, help_text)
+        self._metrics.append(m)
+        return m
+
+    def gauge(self, name: str, help_text: str, fn: Callable[[], float]) -> Gauge:
+        m = Gauge(name, help_text, fn)
+        self._metrics.append(m)
+        return m
+
+    def histogram(
+        self, name: str, help_text: str, buckets: tuple[float, ...] = DEFAULT_BUCKETS
+    ) -> Histogram:
+        m = Histogram(name, help_text, buckets)
+        self._metrics.append(m)
+        return m
+
+    def expose(self) -> str:
+        lines: list[str] = []
+        for m in self._metrics:
+            lines.extend(m.collect())
+        return "\n".join(lines) + "\n"

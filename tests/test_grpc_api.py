@@ -128,3 +128,33 @@ async def test_execute_custom_tool_oneof_error(grpc_server):
         assert "division by zero" in resp.error.stderr
 
     await run_with(grpc_server, go)
+
+
+async def test_health_check_protocol(grpc_server):
+    # Standard grpc.health.v1 Check — the reference's acknowledged TODO
+    # (reference grpc_server.py:71), so any stock gRPC prober works against us.
+    from bee_code_interpreter_tpu.api.grpc_server import SERVICE_NAME, health_stub
+    from bee_code_interpreter_tpu.proto import health_pb2
+
+    port = await grpc_server.start("127.0.0.1:0")
+    try:
+        async with grpc.aio.insecure_channel(f"127.0.0.1:{port}") as channel:
+            check = health_stub(channel)
+            for service in ("", SERVICE_NAME):
+                resp = await check(health_pb2.HealthCheckRequest(service=service))
+                assert resp.status == health_pb2.HealthCheckResponse.SERVING
+
+            try:
+                await check(health_pb2.HealthCheckRequest(service="no.such.Service"))
+            except grpc.aio.AioRpcError as e:
+                assert e.code() == grpc.StatusCode.NOT_FOUND
+            else:
+                raise AssertionError("expected NOT_FOUND")
+
+            grpc_server.health.set_status(
+                "", health_pb2.HealthCheckResponse.NOT_SERVING
+            )
+            resp = await check(health_pb2.HealthCheckRequest(service=""))
+            assert resp.status == health_pb2.HealthCheckResponse.NOT_SERVING
+    finally:
+        await grpc_server.stop(None)

@@ -1,0 +1,65 @@
+"""Prometheus-style metrics: exposition format and the /metrics endpoint.
+(New capability — the reference ships no metrics at all, SURVEY.md §5.)"""
+
+from aiohttp.test_utils import TestClient, TestServer
+
+from bee_code_interpreter_tpu.api.http_server import create_http_server
+from bee_code_interpreter_tpu.services.custom_tool_executor import CustomToolExecutor
+from bee_code_interpreter_tpu.utils.metrics import Registry
+
+
+def test_counter_labels_and_format():
+    reg = Registry()
+    c = reg.counter("x_total", "help here")
+    c.inc(route="/a", status="200")
+    c.inc(route="/a", status="200")
+    c.inc(route="/b", status="500")
+    text = reg.expose()
+    assert "# TYPE x_total counter" in text
+    assert 'x_total{route="/a",status="200"} 2' in text
+    assert 'x_total{route="/b",status="500"} 1' in text
+
+
+def test_histogram_buckets_sum_count():
+    reg = Registry()
+    h = reg.histogram("lat_seconds", "latency", buckets=(0.1, 1.0))
+    h.observe(0.05, route="/a")
+    h.observe(0.5, route="/a")
+    h.observe(5.0, route="/a")
+    text = reg.expose()
+    assert 'lat_seconds_bucket{le="0.1",route="/a"} 1' in text
+    assert 'lat_seconds_bucket{le="1",route="/a"} 2' in text
+    assert 'lat_seconds_bucket{le="+Inf",route="/a"} 3' in text
+    assert 'lat_seconds_count{route="/a"} 3' in text
+    assert 'lat_seconds_sum{route="/a"} 5.55' in text
+
+
+def test_gauge_reads_callback_at_scrape():
+    reg = Registry()
+    pool = [1, 2, 3]
+    reg.gauge("pool_size", "pool", lambda: len(pool))
+    assert "pool_size 3" in reg.expose()
+    pool.append(4)
+    assert "pool_size 4" in reg.expose()
+
+
+async def test_metrics_endpoint_counts_requests(local_executor):
+    app = create_http_server(
+        code_executor=local_executor,
+        custom_tool_executor=CustomToolExecutor(code_executor=local_executor),
+    )
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    try:
+        resp = await client.post("/v1/execute", json={"source_code": "print(1)"})
+        assert resp.status == 200
+        # unmatched paths bucket into one label (no attacker-driven cardinality)
+        await client.get('/%22injected%22/scan1')
+        await client.get("/scan2")
+        text = await (await client.get("/metrics")).text()
+        assert 'bci_http_requests_total{route="/v1/execute",status="200"} 1' in text
+        assert 'bci_http_request_seconds_count{route="/v1/execute"} 1' in text
+        assert 'bci_http_requests_total{route="unmatched",status="404"} 2' in text
+        assert "injected" not in text and "scan2" not in text
+    finally:
+        await client.close()

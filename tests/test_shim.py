@@ -48,6 +48,18 @@ async def test_small_arrays_untouched_in_sandbox(shimmed_executor):
     assert result.stdout == "ndarray\n"
 
 
+async def test_profile_capture_rides_file_snapshot(shimmed_executor):
+    # BCI_PROFILE_DIR → jax.profiler trace written under the workspace, so it
+    # comes back through the ordinary changed-file map (SURVEY.md §5).
+    result = await shimmed_executor.execute(
+        "import jax\n"
+        "jax.numpy.arange(16).sum().block_until_ready()\n",
+        env={"JAX_PLATFORMS": "cpu", "BCI_PROFILE_DIR": "trace"},
+    )
+    assert result.exit_code == 0, result.stderr
+    assert any(f.startswith("/workspace/trace/") for f in result.files), result.files
+
+
 async def test_matplotlib_show_saves_plot(shimmed_executor):
     pytest.importorskip("matplotlib")
     result = await shimmed_executor.execute(
