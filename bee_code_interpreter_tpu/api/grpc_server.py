@@ -254,6 +254,12 @@ class GrpcServer:
 
     async def stop(self, grace: float = 5.0) -> None:
         if self._server is not None:
+            # Flip health to NOT_SERVING before the drain so probers stop
+            # routing new traffic here while in-flight RPCs finish.
+            for service in ("", SERVICE_NAME):
+                self.health.set_status(
+                    service, health_pb2.HealthCheckResponse.NOT_SERVING
+                )
             await self._server.stop(grace)
 
     async def wait_for_termination(self) -> None:

@@ -121,8 +121,31 @@ class TpuArray:
         """The underlying jax.Array, for code that wants to go native."""
         return self._jax
 
+    @property
+    def device(self):
+        # Array-API device probe (numpy 2.x ndarray.device == "cpu"). scipy's
+        # array-api-compat reads this on hypothesis-test results and feeds it
+        # back into numpy-namespace asarray(..., device=...); reporting the
+        # host view keeps that interop path working (SURVEY.md §7 hard part b:
+        # reroute must not break pandas/scipy).
+        return "cpu"
+
+    def to_device(self, device, /, *, stream=None):
+        if device == "cpu":
+            return self
+        raise ValueError(f"unsupported device: {device!r}")
+
     def __repr__(self):
-        return f"TpuArray({self._jax!r})"
+        # Human output renders like numpy (pandas/print paths call str/repr on
+        # cell objects); materializing here is fine — repr is for humans.
+        return repr(self._jax.item()) if self._jax.ndim == 0 else repr(self.__array__())
+
+    def __str__(self):
+        return str(self._jax.item()) if self._jax.ndim == 0 else str(self.__array__())
+
+    def __format__(self, spec):
+        value = self._jax.item() if self._jax.ndim == 0 else self.__array__()
+        return format(value, spec)
 
     def __len__(self):
         return self._jax.shape[0] if self._jax.ndim else 0
@@ -179,18 +202,31 @@ class TpuArray:
 
     # -- numpy protocol hooks: ops on TpuArray stay on device -------------
     def __array_ufunc__(self, ufunc, method, *inputs, **kwargs):
-        if method != "__call__":
-            # reductions like np.add.reduce: let numpy do it on host
+        fn = (
+            getattr(_jax_numpy(), ufunc.__name__, None)
+            if method == "__call__"
+            else None
+        )
+        if fn is not None and kwargs.get("out") is None:
+            return _wrap(fn(*map(_unwrap, inputs), **kwargs))
+        # Graceful CPU fallback (SURVEY.md §7 hard part b): ufuncs with no
+        # jax.numpy equivalent (e.g. scipy.special.stdtr), reduce/accumulate
+        # forms, and out= targets run on host views. Materializing here (not
+        # returning NotImplemented) matters: numpy defers to TpuArray's higher
+        # __array_priority__, so bailing would poison the whole expression.
+        out = kwargs.get("out")
+        if out is not None and any(isinstance(o, TpuArray) for o in out):
+            return NotImplemented  # jax arrays are immutable; no in-place target
+        if method == "at":
+            # np.add.at(x, idx, v) mutates x in place; a host view of a device
+            # array would swallow (or, where the view aliases the buffer,
+            # corrupt) the update. Refuse loudly instead.
             return NotImplemented
-        jnp = _jax_numpy()
-        fn = getattr(jnp, ufunc.__name__, None)
-        if fn is None:
-            return NotImplemented
-        out = kwargs.pop("out", None)
-        result = fn(*map(_unwrap, inputs), **kwargs)
-        if out is not None:
-            return NotImplemented
-        return _wrap(result)
+        np = _numpy()
+        host_inputs = [
+            np.asarray(x) if isinstance(x, TpuArray) else x for x in inputs
+        ]
+        return getattr(ufunc, method)(*host_inputs, **kwargs)
 
     def __array_function__(self, func, types, args, kwargs):
         jnp = _jax_numpy()

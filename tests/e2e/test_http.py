@@ -1,0 +1,209 @@
+"""HTTP e2e suite against the live service (reference test/e2e/test_http.py).
+
+Coverage mirrors the reference behavior-for-behavior (SURVEY.md §4): preinstalled
+imports, workspace file round-trip across two executions, env passthrough, custom
+tool parse/execute happy paths, parse errors as 400 with the exact message set,
+tool runtime errors surfaced as 400 stderr, tool env. The on-the-fly pip-install
+case (reference test_http.py:34-44, cowsay) is exercised at the unit layer
+against a fake index — this environment has no network egress.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+
+import httpx
+import pytest
+
+EXAMPLES = Path(__file__).resolve().parent.parent.parent / "examples"
+
+
+@pytest.fixture(scope="module")
+def client(service):
+    with httpx.Client(base_url=service.http_url, timeout=120) as c:
+        yield c
+
+
+def test_healthz(client):
+    assert client.get("/healthz").json() == {"status": "ok"}
+
+
+def test_imports(client):
+    # Reference test_http.py:23-31 reads examples/using_imports.py from disk.
+    response = client.post(
+        "/v1/execute",
+        json={"source_code": (EXAMPLES / "using_imports.py").read_text()},
+    )
+    response.raise_for_status()
+    result = response.json()
+    assert result["stderr"] == ""
+    assert result["exit_code"] == 0
+
+
+def test_create_file_in_interpreter(client):
+    # Reference test_http.py:47-85: files written by one execution come back as
+    # {path: id} and can be re-mounted into a later execution.
+    file_content = "Hello, World!"
+    response = client.post(
+        "/v1/execute",
+        json={
+            "source_code": f'''
+with open("file.txt", "w") as f:
+    f.write("{file_content}")
+''',
+        },
+    )
+    response.raise_for_status()
+    result = response.json()
+    assert result["exit_code"] == 0
+    assert "/workspace/file.txt" in result["files"]
+
+    response = client.post(
+        "/v1/execute",
+        json={
+            "source_code": '''
+with open("file.txt", "r") as f:
+    print(f.read())
+''',
+            "files": result["files"],
+        },
+    )
+    response.raise_for_status()
+    result = response.json()
+    assert result["stdout"] == file_content + "\n"
+    # Reading a file does not re-snapshot it (ctime/mtime unchanged).
+    assert result["files"] == {}
+
+
+def test_env_passthrough(client):
+    # Reference test_http.py:88-99.
+    response = client.post(
+        "/v1/execute",
+        json={
+            "source_code": 'import os; print(os.environ["TEST_VAR"])',
+            "env": {"TEST_VAR": "hello-from-env"},
+        },
+    )
+    response.raise_for_status()
+    result = response.json()
+    assert result["stdout"] == "hello-from-env\n"
+    assert result["exit_code"] == 0
+
+
+def test_nonzero_exit(client):
+    response = client.post(
+        "/v1/execute",
+        json={"source_code": (EXAMPLES / "crash.py").read_text()},
+    )
+    response.raise_for_status()
+    result = response.json()
+    assert result["exit_code"] != 0
+    assert result["stderr"] != ""
+
+
+def test_parse_custom_tool(client):
+    # Reference test_http.py:103-221 (happy path with typing + docstring).
+    response = client.post(
+        "/v1/parse-custom-tool",
+        json={
+            "tool_source_code": '''
+def current_weather(lat: float, lon: float):
+    """
+    Get the current weather at a location.
+
+    :param lat: A latitude.
+    :param lon: A longitude.
+    :return: A dictionary with the current weather.
+    """
+    return {"lat": lat, "lon": lon}
+'''
+        },
+    )
+    response.raise_for_status()
+    tool = response.json()
+    assert tool["tool_name"] == "current_weather"
+    assert tool["tool_description"] == (
+        "Get the current weather at a location.\n\n"
+        "Returns: A dictionary with the current weather."
+    )
+    schema = json.loads(tool["tool_input_schema_json"])
+    assert schema["properties"]["lat"] == {"type": "number", "description": "A latitude."}
+    assert schema["required"] == ["lat", "lon"]
+
+
+def test_parse_custom_tool_error(client):
+    # Reference test_http.py:257-271: 400 with the exact message set.
+    response = client.post(
+        "/v1/parse-custom-tool",
+        json={"tool_source_code": "def my_tool(a, /, b, *args, **kwargs) -> int:\n  return 1"},
+    )
+    assert response.status_code == 400
+    assert set(response.json()["error_messages"]) == {
+        "The tool function must not have positional-only arguments",
+        "The tool function must not have *args",
+        "The tool function must not have **kwargs",
+        "The tool function arguments must have type annotations",
+    }
+
+
+def test_execute_custom_tool(client):
+    # Reference test_http.py:224-254.
+    response = client.post(
+        "/v1/execute-custom-tool",
+        json={
+            "tool_source_code": "def adding_tool(a: int, b: int) -> int:\n  return a + b",
+            "tool_input_json": '{"a": 1, "b": 2}',
+        },
+    )
+    response.raise_for_status()
+    assert response.json()["tool_output_json"] == "3"
+
+
+def test_execute_custom_tool_datetime_coercion(client):
+    response = client.post(
+        "/v1/execute-custom-tool",
+        json={
+            "tool_source_code": '''
+import datetime
+
+def year_tool(when: datetime.datetime) -> str:
+    return f"The year is {when.year}"
+''',
+            "tool_input_json": '{"when": "2000-01-01T00:00:00"}',
+        },
+    )
+    response.raise_for_status()
+    assert response.json()["tool_output_json"] == '"The year is 2000"'
+
+
+def test_execute_custom_tool_runtime_error(client):
+    # Reference test_http.py:274-285: tool raising → 400 with stderr.
+    response = client.post(
+        "/v1/execute-custom-tool",
+        json={
+            "tool_source_code": "def boom() -> int:\n  raise ValueError('it broke')",
+            "tool_input_json": "{}",
+        },
+    )
+    assert response.status_code == 400
+    assert "it broke" in response.json()["stderr"]
+
+
+def test_execute_custom_tool_env(client):
+    # Reference test_http.py:288-302.
+    response = client.post(
+        "/v1/execute-custom-tool",
+        json={
+            "tool_source_code": '''
+import os
+
+def env_tool() -> str:
+    return os.environ["TOOL_VAR"]
+''',
+            "tool_input_json": "{}",
+            "env": {"TOOL_VAR": "tool-env-value"},
+        },
+    )
+    response.raise_for_status()
+    assert response.json()["tool_output_json"] == '"tool-env-value"'
