@@ -38,6 +38,11 @@ async def main() -> None:
 
     await ctx.grpc_server.stop()
     await runner.cleanup()
+    # Tear down any warm sandboxes (only if the executor was ever built —
+    # touching the cached_property here would needlessly construct it).
+    executor = ctx.__dict__.get("code_executor")
+    if executor is not None and hasattr(executor, "shutdown"):
+        executor.shutdown()
 
 
 def run() -> None:

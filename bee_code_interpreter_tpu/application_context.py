@@ -33,6 +33,26 @@ class ApplicationContext:
     @cached_property
     def code_executor(self):
         if self.config.executor_backend == "local":
+            # With a native binary configured, sandboxes are real executor-server
+            # processes (the single-TPU-VM deployment mode — full wire contract,
+            # no cluster); otherwise the pure-Python in-process core.
+            if self.config.local_executor_binary:
+                from bee_code_interpreter_tpu.services.native_process_code_executor import (
+                    NativeProcessCodeExecutor,
+                )
+
+                executor = NativeProcessCodeExecutor(
+                    storage=self.storage, config=self.config
+                )
+                self._register_pool_gauges(executor)
+                try:
+                    asyncio.get_running_loop()
+                except RuntimeError:
+                    pass
+                else:
+                    # anchored on the executor's task set (loop refs are weak)
+                    executor._spawn_background(executor.fill_sandbox_queue())
+                return executor
             from bee_code_interpreter_tpu.services.local_code_executor import (
                 LocalCodeExecutor,
             )
@@ -54,25 +74,31 @@ class ApplicationContext:
             storage=self.storage,
             config=self.config,
         )
-        self.metrics.gauge(
-            "bci_executor_pool_ready",
-            "Warm executor pod groups ready in the pool",
-            lambda: executor.pool_ready_count,
-        )
-        self.metrics.gauge(
-            "bci_executor_pool_spawning",
-            "Executor pod groups currently being spawned",
-            lambda: executor.pool_spawning_count,
-        )
+        self._register_pool_gauges(executor)
         # Pool warmup starts as soon as the executor exists (reference
         # application_context.py:83). Outside a running loop (e.g. tests
         # constructing the context), warmup is deferred — the pool refills on
         # first use anyway.
         try:
-            asyncio.get_running_loop().create_task(executor.fill_executor_pod_queue())
+            asyncio.get_running_loop()
         except RuntimeError:
             pass
+        else:
+            # anchored on the executor's task set (loop refs are weak)
+            executor._spawn_background(executor.fill_executor_pod_queue())
         return executor
+
+    def _register_pool_gauges(self, executor) -> None:
+        self.metrics.gauge(
+            "bci_executor_pool_ready",
+            "Warm executor sandboxes ready in the pool",
+            lambda: executor.pool_ready_count,
+        )
+        self.metrics.gauge(
+            "bci_executor_pool_spawning",
+            "Executor sandboxes currently being spawned",
+            lambda: executor.pool_spawning_count,
+        )
 
     @cached_property
     def custom_tool_executor(self) -> CustomToolExecutor:

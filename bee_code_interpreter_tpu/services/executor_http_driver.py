@@ -1,0 +1,64 @@
+"""Shared HTTP data-plane driver for executor sandboxes.
+
+Both sandbox backends — Kubernetes pod groups and local native-server
+processes — speak the same wire contract (reference executor/server.rs:186-192;
+ours executor/src/server.cpp): ``PUT/GET /workspace/{path}`` for the workspace
+snapshot and ``POST /execute`` for the run. This mixin holds the driver side of
+that contract (reference kubernetes_code_executor.py:95-142), addressed by
+``host:port`` so the transport is identical whether the sandbox is across the
+pod network or on localhost.
+"""
+
+from __future__ import annotations
+
+import httpx
+
+from bee_code_interpreter_tpu.services.storage import Storage
+from bee_code_interpreter_tpu.utils.validation import Hash
+
+
+class ExecutorHttpDriver:
+    """Mixin: requires ``self._http`` (httpx.AsyncClient) and ``self._storage``."""
+
+    _http: httpx.AsyncClient
+    _storage: Storage
+
+    async def _upload_file(self, addr: str, path: str, object_id: Hash) -> None:
+        async def body():
+            async with self._storage.reader(object_id) as reader:
+                async for chunk in reader:
+                    yield chunk
+
+        response = await self._http.put(self._sandbox_url(addr, path), content=body())
+        if response.status_code >= 300:
+            raise RuntimeError(f"file upload to {addr} failed: {response.status_code}")
+
+    async def _download_file(self, addr: str, path: str) -> Hash:
+        async with self._storage.writer() as writer:
+            async with self._http.stream(
+                "GET", self._sandbox_url(addr, path)
+            ) as response:
+                if response.status_code >= 300:
+                    raise RuntimeError(
+                        f"file download from {addr} failed: {response.status_code}"
+                    )
+                async for chunk in response.aiter_bytes():
+                    await writer.write(chunk)
+        return writer.hash
+
+    async def _post_execute(
+        self, addr: str, source_code: str, env: dict[str, str], timeout_s: float
+    ) -> dict:
+        response = await self._http.post(
+            f"http://{addr}/execute",
+            json={"source_code": source_code, "env": env, "timeout": timeout_s},
+        )
+        if response.status_code != 200:
+            raise RuntimeError(
+                f"execute on {addr} failed: {response.status_code} {response.text}"
+            )
+        return response.json()
+
+    def _sandbox_url(self, addr: str, logical_path: str) -> str:
+        rel = logical_path.removeprefix("/workspace/").lstrip("/")
+        return f"http://{addr}/workspace/{rel}"

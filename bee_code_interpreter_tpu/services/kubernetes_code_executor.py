@@ -52,6 +52,7 @@ from tenacity import (
 
 from bee_code_interpreter_tpu.config import Config
 from bee_code_interpreter_tpu.services.code_executor import Result
+from bee_code_interpreter_tpu.services.executor_http_driver import ExecutorHttpDriver
 from bee_code_interpreter_tpu.services.kubectl import Kubectl
 from bee_code_interpreter_tpu.services.storage import Storage
 from bee_code_interpreter_tpu.utils.validation import AbsolutePath, Hash
@@ -77,7 +78,7 @@ class PodGroup:
         return [p["status"]["podIP"] for p in self.pods]
 
 
-class KubernetesCodeExecutor:
+class KubernetesCodeExecutor(ExecutorHttpDriver):
     def __init__(
         self,
         kubectl: Kubectl,
@@ -95,6 +96,9 @@ class KubernetesCodeExecutor:
         self._spawning_count = 0
         self._fill_lock = asyncio.Lock()
         self._self_pod: dict | None = None
+        # The event loop holds only weak refs to tasks; fire-and-forget refills
+        # and deletions must be anchored here or GC can cancel them mid-flight.
+        self._background_tasks: set[asyncio.Task] = set()
 
     @property
     def pool_ready_count(self) -> int:
@@ -123,19 +127,26 @@ class KubernetesCodeExecutor:
         files = files or {}
         env = env or {}
         async with self.executor_pod_group() as group:
-            ips = group.pod_ips
+            addrs = [
+                f"{ip}:{self._config.executor_port}" for ip in group.pod_ips
+            ]
             # Restore the workspace snapshot on every worker (SPMD inputs).
             await asyncio.gather(
                 *(
-                    self._upload_file(ip, path, object_id)
-                    for ip in ips
+                    self._upload_file(addr, path, object_id)
+                    for addr in addrs
                     for path, object_id in files.items()
                 )
             )
             # Run on all workers concurrently; every JAX process must execute
             # the same program for collectives to rendezvous.
             responses = await asyncio.gather(
-                *(self._post_execute(ip, source_code, env) for ip in ips)
+                *(
+                    self._post_execute(
+                        addr, source_code, env, self._config.execution_timeout_s
+                    )
+                    for addr in addrs
+                )
             )
             primary = responses[0]
             exit_code = next(
@@ -145,7 +156,7 @@ class KubernetesCodeExecutor:
             for path, object_id in zip(
                 primary["files"],
                 await asyncio.gather(
-                    *(self._download_file(ips[0], p) for p in primary["files"])
+                    *(self._download_file(addrs[0], p) for p in primary["files"])
                 ),
             ):
                 out_files[path] = object_id
@@ -156,52 +167,6 @@ class KubernetesCodeExecutor:
                 files=out_files,
             )
 
-    async def _upload_file(self, pod_ip: str, path: str, object_id: Hash) -> None:
-        async def body():
-            async with self._storage.reader(object_id) as reader:
-                async for chunk in reader:
-                    yield chunk
-
-        response = await self._http.put(self._pod_url(pod_ip, path), content=body())
-        if response.status_code >= 300:
-            raise RuntimeError(
-                f"file upload to {pod_ip} failed: {response.status_code}"
-            )
-
-    async def _download_file(self, pod_ip: str, path: str) -> Hash:
-        async with self._storage.writer() as writer:
-            async with self._http.stream(
-                "GET", self._pod_url(pod_ip, path)
-            ) as response:
-                if response.status_code >= 300:
-                    raise RuntimeError(
-                        f"file download from {pod_ip} failed: {response.status_code}"
-                    )
-                async for chunk in response.aiter_bytes():
-                    await writer.write(chunk)
-        return writer.hash
-
-    async def _post_execute(
-        self, pod_ip: str, source_code: str, env: dict[str, str]
-    ) -> dict:
-        response = await self._http.post(
-            f"http://{pod_ip}:{self._config.executor_port}/execute",
-            json={
-                "source_code": source_code,
-                "env": env,
-                "timeout": self._config.execution_timeout_s,
-            },
-        )
-        if response.status_code != 200:
-            raise RuntimeError(
-                f"execute on {pod_ip} failed: {response.status_code} {response.text}"
-            )
-        return response.json()
-
-    def _pod_url(self, pod_ip: str, logical_path: str) -> str:
-        rel = logical_path.removeprefix("/workspace/").lstrip("/")
-        return f"http://{pod_ip}:{self._config.executor_port}/workspace/{rel}"
-
     # ------------------------------------------------------------------ pool
 
     @asynccontextmanager
@@ -209,12 +174,17 @@ class KubernetesCodeExecutor:
         """Pop a warm group or spawn one; single-use teardown + async refill
         (reference executor_pod ctx-mgr :248-264)."""
         group = self._queue.popleft() if self._queue else await self.spawn_pod_group()
-        asyncio.ensure_future(self.fill_executor_pod_queue())
+        self._spawn_background(self.fill_executor_pod_queue())
         try:
             yield group
         finally:
             for pod_name in group.pod_names:
-                asyncio.ensure_future(self._delete_pod(pod_name))
+                self._spawn_background(self._delete_pod(pod_name))
+
+    def _spawn_background(self, coro) -> None:
+        task = asyncio.ensure_future(coro)
+        self._background_tasks.add(task)
+        task.add_done_callback(self._background_tasks.discard)
 
     async def fill_executor_pod_queue(self) -> None:
         """Keep the warm queue at target length (reference :151-189)."""
@@ -228,23 +198,29 @@ class KubernetesCodeExecutor:
                 return
             self._spawning_count += missing
         logger.info("Filling executor pool: spawning %d pod group(s)", missing)
-        spawned = 0
-        try:
-            for coro in asyncio.as_completed(
-                [self.spawn_pod_group() for _ in range(missing)]
-            ):
-                try:
-                    group = await coro
-                    self._queue.append(group)
-                    spawned += 1
-                finally:
-                    self._spawning_count -= 1
-        except Exception:
-            logger.exception(
+        # Each spawn settles its own accounting — a failed spawn must never
+        # abandon its siblings or leave a phantom spawning count behind.
+        results = await asyncio.gather(
+            *(self._spawn_into_queue() for _ in range(missing))
+        )
+        spawned = sum(results)
+        if spawned < missing:
+            logger.warning(
                 "Pool refill finished with failures: %d/%d spawned", spawned, missing
             )
         else:
             logger.info("Pool refill complete: %d/%d spawned", spawned, missing)
+
+    async def _spawn_into_queue(self) -> bool:
+        try:
+            group = await self.spawn_pod_group()
+        except Exception:
+            logger.exception("Pod group spawn failed")
+            return False
+        finally:
+            self._spawning_count -= 1
+        self._queue.append(group)
+        return True
 
     @retry(
         retry=retry_if_exception_type(RuntimeError),

@@ -1,0 +1,305 @@
+"""Native-process code executor: warm pool of local C++ executor servers.
+
+The k8s-free deployment mode for a single TPU VM: the control plane and the
+sandboxes share one host, with each sandbox being a fresh instance of the
+native executor server (executor/src/server.cpp — the TPU-native counterpart
+of the reference's in-pod Rust server, executor/server.rs) listening on a
+loopback port with its own throwaway workspace directory.
+
+Pool semantics mirror the Kubernetes backend (and through it the reference's
+pod pool, kubernetes_code_executor.py:151-264): a deque of warm, /healthz-ready
+server processes kept at a target length with spawning-count accounting;
+sandboxes are single-use — after one execution the process is killed and its
+workspace deleted, so no state survives a run except through the returned
+file map. The data plane is the shared HTTP wire contract (ExecutorHttpDriver),
+byte-identical to what the pod network carries.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import secrets
+import shutil
+import socket
+import subprocess
+from collections import deque
+from contextlib import asynccontextmanager
+from dataclasses import dataclass
+from pathlib import Path
+
+import httpx
+from tenacity import (
+    retry,
+    retry_if_exception_type,
+    stop_after_attempt,
+    wait_exponential,
+)
+
+from bee_code_interpreter_tpu.config import Config
+from bee_code_interpreter_tpu.services.code_executor import Result
+from bee_code_interpreter_tpu.services.executor_http_driver import ExecutorHttpDriver
+from bee_code_interpreter_tpu.services.storage import Storage
+from bee_code_interpreter_tpu.utils.validation import AbsolutePath, Hash
+
+logger = logging.getLogger(__name__)
+
+REPO_EXECUTOR_DIR = Path(__file__).resolve().parent.parent.parent / "executor"
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _die_with_parent() -> None:
+    """PR_SET_PDEATHSIG: the kernel kills the sandbox if the service dies.
+
+    The local analogue of the reference's ownerReferences cascade-GC
+    (kubernetes_code_executor.py:215-224) — warm sandboxes must never outlive
+    the control plane, even on SIGKILL. Linux-only; elsewhere orphans are only
+    cleaned up by the cooperative shutdown() path.
+    """
+    try:
+        import ctypes
+        import signal as _signal
+
+        PR_SET_PDEATHSIG = 1
+        ctypes.CDLL("libc.so.6", use_errno=True).prctl(
+            PR_SET_PDEATHSIG, _signal.SIGKILL, 0, 0, 0
+        )
+    except Exception:
+        pass
+
+
+@dataclass
+class NativeSandbox:
+    """One warm native executor-server process."""
+
+    proc: subprocess.Popen
+    addr: str  # 127.0.0.1:port
+    workspace: Path
+
+    def destroy(self) -> None:
+        if self.proc.poll() is None:
+            self.proc.terminate()
+            try:
+                self.proc.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                self.proc.kill()
+        shutil.rmtree(self.workspace, ignore_errors=True)
+
+
+class NativeProcessCodeExecutor(ExecutorHttpDriver):
+    def __init__(
+        self,
+        storage: Storage,
+        config: Config,
+        binary: str | Path | None = None,
+        http_client: httpx.AsyncClient | None = None,
+    ) -> None:
+        self._storage = storage
+        self._config = config
+        self._binary = Path(binary or config.local_executor_binary or "")
+        if not self._binary.is_file():
+            raise FileNotFoundError(
+                f"native executor binary not found: {self._binary} "
+                "(build with `make -C executor`)"
+            )
+        self._http = http_client or httpx.AsyncClient(
+            timeout=config.executor_http_timeout_s
+        )
+        self._workspace_root = Path(config.local_workspace_root)
+        self._queue: deque[NativeSandbox] = deque()
+        self._spawning_count = 0
+        self._fill_lock = asyncio.Lock()
+        self._closed = False
+        # The event loop holds only weak refs to tasks; fire-and-forget refills
+        # must be anchored here or GC can cancel them mid-spawn.
+        self._background_tasks: set[asyncio.Task] = set()
+
+    @property
+    def pool_ready_count(self) -> int:
+        return len(self._queue)
+
+    @property
+    def pool_spawning_count(self) -> int:
+        return self._spawning_count
+
+    # ------------------------------------------------------------- execution
+
+    @retry(
+        retry=retry_if_exception_type(RuntimeError),
+        stop=stop_after_attempt(3),
+        wait=wait_exponential(min=0.2, max=2),
+        reraise=True,
+    )
+    async def execute(
+        self,
+        source_code: str,
+        files: dict[AbsolutePath, Hash] | None = None,
+        env: dict[str, str] | None = None,
+    ) -> Result:
+        files = files or {}
+        env = env or {}
+        async with self.sandbox() as box:
+            await asyncio.gather(
+                *(
+                    self._upload_file(box.addr, path, object_id)
+                    for path, object_id in files.items()
+                )
+            )
+            response = await self._post_execute(
+                box.addr, source_code, env, self._config.execution_timeout_s
+            )
+            out_files: dict[str, str] = {}
+            for path, object_id in zip(
+                response["files"],
+                await asyncio.gather(
+                    *(self._download_file(box.addr, p) for p in response["files"])
+                ),
+            ):
+                out_files[path] = object_id
+            return Result(
+                stdout=response["stdout"],
+                stderr=response["stderr"],
+                exit_code=response["exit_code"],
+                files=out_files,
+            )
+
+    # ------------------------------------------------------------------ pool
+
+    @asynccontextmanager
+    async def sandbox(self):
+        """Pop a warm server or spawn one; single-use teardown + async refill."""
+        box = self._queue.popleft() if self._queue else await self.spawn_sandbox()
+        self._spawn_background(self.fill_sandbox_queue())
+        try:
+            yield box
+        finally:
+            # Teardown must not block the response (reference deletes pods
+            # fire-and-forget, kubernetes_code_executor.py:262-264).
+            asyncio.get_running_loop().run_in_executor(None, box.destroy)
+
+    def _spawn_background(self, coro) -> None:
+        task = asyncio.ensure_future(coro)
+        self._background_tasks.add(task)
+        task.add_done_callback(self._background_tasks.discard)
+
+    async def fill_sandbox_queue(self) -> None:
+        if self._closed:
+            return
+        async with self._fill_lock:
+            missing = (
+                self._config.executor_pod_queue_target_length
+                - len(self._queue)
+                - self._spawning_count
+            )
+            if missing <= 0:
+                return
+            self._spawning_count += missing
+        # Each spawn settles its own accounting — a failed spawn must never
+        # abandon its siblings or leave a phantom spawning count behind.
+        results = await asyncio.gather(
+            *(self._spawn_into_queue() for _ in range(missing))
+        )
+        if not all(results):
+            logger.warning(
+                "Sandbox pool refill finished with failures: %d/%d spawned",
+                sum(results),
+                missing,
+            )
+
+    async def _spawn_into_queue(self) -> bool:
+        try:
+            box = await self.spawn_sandbox()
+        except Exception:
+            logger.exception("Sandbox spawn failed")
+            return False
+        finally:
+            self._spawning_count -= 1
+        if self._closed:
+            box.destroy()  # raced with shutdown: don't repopulate a dead pool
+            return False
+        self._queue.append(box)
+        return True
+
+    @retry(
+        retry=retry_if_exception_type(RuntimeError),
+        stop=stop_after_attempt(3),
+        wait=wait_exponential(min=0.2, max=2),
+        reraise=True,
+    )
+    async def spawn_sandbox(self) -> NativeSandbox:
+        cfg = self._config
+        port = _free_port()
+        addr = f"127.0.0.1:{port}"
+        workspace = self._workspace_root / secrets.token_hex(8)
+        workspace.mkdir(parents=True, exist_ok=True)
+
+        env = dict(os.environ)
+        env.update(
+            APP_LISTEN_ADDR=addr,
+            APP_WORKSPACE=str(workspace),
+            APP_EXECUTION_TIMEOUT_S=str(cfg.execution_timeout_s),
+            APP_REQUIREMENTS=str(REPO_EXECUTOR_DIR / "requirements.txt"),
+            APP_REQUIREMENTS_SKIP=str(REPO_EXECUTOR_DIR / "requirements-skip.txt"),
+            APP_PYPI_MAP=str(REPO_EXECUTOR_DIR / "pypi_map.tsv"),
+        )
+        if cfg.disable_dep_install:
+            env["APP_DISABLE_DEP_INSTALL"] = "1"
+        shim = cfg.resolved_shim_dir()
+        if shim:
+            env["APP_SHIM_DIR"] = str(shim)
+
+        proc = subprocess.Popen(
+            [str(self._binary)],
+            env=env,
+            stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL,
+            preexec_fn=_die_with_parent,
+        )
+        box = NativeSandbox(proc=proc, addr=addr, workspace=workspace)
+        try:
+            deadline = (
+                asyncio.get_running_loop().time() + cfg.pod_ready_timeout_s
+            )
+            while True:
+                if proc.poll() is not None:
+                    raise RuntimeError(
+                        f"native executor exited at startup (code {proc.returncode})"
+                    )
+                try:
+                    response = await self._http.get(f"http://{addr}/healthz")
+                    if response.status_code == 200:
+                        return box
+                except httpx.TransportError:
+                    pass
+                if asyncio.get_running_loop().time() > deadline:
+                    raise RuntimeError(
+                        f"native executor on {addr} never became ready"
+                    )
+                await asyncio.sleep(0.05)
+        except Exception:
+            box.destroy()
+            raise
+
+    def shutdown(self) -> None:
+        """Kill every warm sandbox (no idle processes left behind).
+
+        Sets the closed flag first so refills already in flight destroy their
+        sandboxes instead of repopulating a dead pool.
+        """
+        self._closed = True
+        while self._queue:
+            self._queue.popleft().destroy()
+        try:
+            loop = asyncio.get_running_loop()
+        except RuntimeError:
+            pass
+        else:
+            task = loop.create_task(self._http.aclose())
+            self._background_tasks.add(task)
+            task.add_done_callback(self._background_tasks.discard)

@@ -35,6 +35,27 @@ def pytest_pyfunc_call(pyfuncitem):
     return None
 
 
+@pytest.fixture(scope="session")
+def native_binary():
+    """Build the C++ executor server once per session; None without a toolchain.
+
+    Shared by the native-executor unit tests and the e2e native backend so the
+    `make -C executor` invocation happens exactly once per pytest run.
+    """
+    import shutil
+    import subprocess
+    from pathlib import Path
+
+    executor_dir = Path(__file__).resolve().parent.parent / "executor"
+    binary = executor_dir / "build" / "executor-server"
+    if shutil.which("make") is None or shutil.which("g++") is None:
+        return None
+    result = subprocess.run(
+        ["make", "-C", str(executor_dir)], capture_output=True, text=True
+    )
+    return binary if result.returncode == 0 and binary.exists() else None
+
+
 @pytest.fixture
 def storage(tmp_path):
     from bee_code_interpreter_tpu.services.storage import Storage

@@ -25,10 +25,9 @@ REPO = Path(__file__).resolve().parent.parent.parent
 EXAMPLES = REPO / "examples"
 
 
-def _free_port() -> int:
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        return s.getsockname()[1]
+from bee_code_interpreter_tpu.services.native_process_code_executor import (
+    _free_port,
+)
 
 
 class Service:
@@ -39,9 +38,12 @@ class Service:
         self.log = log
 
 
-@pytest.fixture(scope="session")
-def service(tmp_path_factory):
-    tmp = tmp_path_factory.mktemp("e2e")
+# The whole e2e suite runs once per local backend: the pure-Python in-process
+# executor and (toolchain permitting) the native C++ executor-server pool —
+# both must present identical behavior through the service API.
+@pytest.fixture(scope="session", params=["python", "native"])
+def service(request, tmp_path_factory, native_binary):
+    tmp = tmp_path_factory.mktemp(f"e2e-{request.param}")
     http_port, grpc_port = _free_port(), _free_port()
     log_path = tmp / "service.log"
 
@@ -56,6 +58,12 @@ def service(tmp_path_factory):
         # Sandbox subprocesses must stay on the virtual CPU mesh in CI.
         JAX_PLATFORMS="cpu",
     )
+    if request.param == "native":
+        if native_binary is None:
+            pytest.skip("native toolchain unavailable")
+        env["APP_LOCAL_EXECUTOR_BINARY"] = str(native_binary)
+        # Keep warm-pool startup cheap for the test session.
+        env["APP_EXECUTOR_POD_QUEUE_TARGET_LENGTH"] = "2"
     log = open(log_path, "wb")
     proc = subprocess.Popen(
         [sys.executable, "-m", "bee_code_interpreter_tpu"],

@@ -4,7 +4,6 @@ pointing pods at real native-server processes)."""
 
 import asyncio
 import json
-import shutil
 import socket
 import subprocess
 import time
@@ -13,29 +12,20 @@ from pathlib import Path
 import httpx
 import pytest
 
+from bee_code_interpreter_tpu.services.native_process_code_executor import (
+    _free_port as free_port,
+)
+
 REPO = Path(__file__).resolve().parent.parent
 EXECUTOR_DIR = REPO / "executor"
 BINARY = EXECUTOR_DIR / "build" / "executor-server"
 
 
-def build_binary() -> bool:
-    if shutil.which("make") is None or shutil.which("g++") is None:
-        return False
-    result = subprocess.run(
-        ["make", "-C", str(EXECUTOR_DIR)], capture_output=True, text=True
-    )
-    return result.returncode == 0 and BINARY.exists()
-
-
-pytestmark = pytest.mark.skipif(
-    not build_binary(), reason="native toolchain unavailable"
-)
-
-
-def free_port() -> int:
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        return s.getsockname()[1]
+@pytest.fixture(autouse=True)
+def _require_native(native_binary):
+    # native_binary (shared session fixture) builds the server exactly once.
+    if native_binary is None:
+        pytest.skip("native toolchain unavailable")
 
 
 class NativeExecutor:

@@ -1,0 +1,175 @@
+"""NativeProcessCodeExecutor: warm process pool driving real C++ servers.
+
+The single-TPU-VM backend — pool semantics mirror the pod pool (single-use
+sandboxes, async refill, spawning-count accounting) with local processes
+standing in for pods."""
+
+import asyncio
+import subprocess
+from pathlib import Path
+
+import pytest
+
+from bee_code_interpreter_tpu.config import Config
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+@pytest.fixture(autouse=True)
+def _require_native(native_binary):
+    if native_binary is None:
+        pytest.skip("native toolchain unavailable")
+
+
+@pytest.fixture
+def native_executor(storage, tmp_path, native_binary):
+    from bee_code_interpreter_tpu.services.native_process_code_executor import (
+        NativeProcessCodeExecutor,
+    )
+
+    config = Config(
+        executor_backend="local",
+        local_executor_binary=str(native_binary),
+        local_workspace_root=str(tmp_path / "ws"),
+        disable_dep_install=True,
+        executor_pod_queue_target_length=2,
+        execution_timeout_s=30.0,
+        pod_ready_timeout_s=20.0,
+        shim_dir="none",
+    )
+    executor = NativeProcessCodeExecutor(storage=storage, config=config)
+    yield executor
+    executor.shutdown()
+
+
+async def test_execute_round_trip(native_executor):
+    result = await native_executor.execute("print(21 * 2)")
+    assert result.stdout == "42\n"
+    assert result.exit_code == 0
+
+
+async def test_file_snapshot_round_trip(native_executor):
+    first = await native_executor.execute(
+        'with open("out.txt", "w") as f:\n    f.write("native")'
+    )
+    assert first.exit_code == 0
+    assert "/workspace/out.txt" in first.files
+    second = await native_executor.execute(
+        'print(open("out.txt").read())', files=first.files
+    )
+    assert second.stdout == "native\n"
+
+
+async def test_env_passthrough(native_executor):
+    result = await native_executor.execute(
+        'import os; print(os.environ["NATIVE_VAR"])', env={"NATIVE_VAR": "yes"}
+    )
+    assert result.stdout == "yes\n"
+
+
+async def test_sandboxes_are_single_use(native_executor):
+    # A file created in one run must not be visible to the next (fresh
+    # process + fresh workspace per execution).
+    await native_executor.execute('open("leak.txt", "w").write("x")')
+    result = await native_executor.execute(
+        'import os; print(os.path.exists("leak.txt"))'
+    )
+    assert result.stdout == "False\n"
+
+
+async def test_pool_refills_and_reuses_warm_sandboxes(native_executor):
+    await native_executor.fill_sandbox_queue()
+    assert native_executor.pool_ready_count == 2
+    assert native_executor.pool_spawning_count == 0
+    # An execution takes a warm sandbox (no cold spawn) and triggers a refill;
+    # the refill is asynchronous, so wait for the pool to converge.
+    result = await native_executor.execute("print('warm')")
+    assert result.stdout == "warm\n"
+    for _ in range(200):
+        await native_executor.fill_sandbox_queue()
+        if (
+            native_executor.pool_ready_count == 2
+            and native_executor.pool_spawning_count == 0
+        ):
+            break
+        await asyncio.sleep(0.05)
+    assert native_executor.pool_ready_count == 2
+
+
+async def test_shutdown_kills_warm_pool(native_executor):
+    await native_executor.fill_sandbox_queue()
+    procs = [box.proc for box in native_executor._queue]
+    native_executor.shutdown()
+    assert native_executor.pool_ready_count == 0
+    for proc in procs:
+        assert proc.poll() is not None
+
+
+def test_missing_binary_is_a_loud_error(storage, tmp_path):
+    from bee_code_interpreter_tpu.services.native_process_code_executor import (
+        NativeProcessCodeExecutor,
+    )
+
+    with pytest.raises(FileNotFoundError):
+        NativeProcessCodeExecutor(
+            storage=storage,
+            config=Config(local_executor_binary=str(tmp_path / "nope")),
+        )
+
+
+async def test_sandboxes_die_with_parent_kill(native_executor, tmp_path, native_binary):
+    # PDEATHSIG guarantee: a SIGKILLed controller must not leave orphan
+    # sandboxes behind. Simulate by spawning a sandbox from a disposable child
+    # process and SIGKILLing it.
+    import os
+    import signal
+    import textwrap
+    import time
+
+    script = textwrap.dedent(f"""
+        import asyncio, os, sys
+        sys.path.insert(0, {str(REPO)!r})
+        from bee_code_interpreter_tpu.config import Config
+        from bee_code_interpreter_tpu.services.storage import Storage
+        from bee_code_interpreter_tpu.services.native_process_code_executor import (
+            NativeProcessCodeExecutor,
+        )
+
+        async def main():
+            ex = NativeProcessCodeExecutor(
+                storage=Storage({str(tmp_path / "obj")!r}),
+                config=Config(
+                    local_executor_binary={str(native_binary)!r},
+                    local_workspace_root={str(tmp_path / "ws2")!r},
+                    executor_pod_queue_target_length=1,
+                    disable_dep_install=True,
+                    shim_dir="none",
+                ),
+            )
+            await ex.fill_sandbox_queue()
+            print(ex._queue[0].proc.pid, flush=True)
+            await asyncio.sleep(60)
+
+        asyncio.run(main())
+    """)
+    controller = subprocess.Popen(
+        ["python", "-c", script], stdout=subprocess.PIPE, text=True
+    )
+    sandbox_pid = int(controller.stdout.readline())
+    assert _alive(sandbox_pid)
+    controller.kill()
+    controller.wait()
+    deadline = time.time() + 10
+    while _alive(sandbox_pid) and time.time() < deadline:
+        time.sleep(0.1)
+    assert not _alive(sandbox_pid), "sandbox outlived its SIGKILLed controller"
+
+
+def _alive(pid: int) -> bool:
+    import os
+
+    try:
+        os.kill(pid, 0)
+        return True
+    except ProcessLookupError:
+        return False
