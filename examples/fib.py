@@ -1,0 +1,11 @@
+# Pure-CPU recursive workload: exercises the sandbox's plain-python path
+# (no TPU, no imports). Parity payload for the reference's examples/fib.py,
+# capped at 35 terms so the naive recursion stays well inside the sandbox's
+# 60 s execution timeout (heavier CPU burn lives in benchmark-fib.py).
+
+def fib(n: int) -> int:
+    return n if n < 2 else fib(n - 1) + fib(n - 2)
+
+
+for i in range(35):
+    print(fib(i))

@@ -1,0 +1,7 @@
+# Workspace read: expects example.txt restored from storage via the request's
+# {path -> id} file map (written by hello_world_write_file.py in a previous
+# execution). Parity payload for the reference's examples/hello_world_read_file.py.
+
+from pathlib import Path
+
+print(Path("example.txt").read_text())

@@ -1,0 +1,8 @@
+# Workspace write: the created file is picked up by the executor's
+# changed-file scan and snapshotted into storage, so a follow-up execution
+# (hello_world_read_file.py) can restore and read it. Parity payload for the
+# reference's examples/hello_world_write_file.py.
+
+from pathlib import Path
+
+Path("example.txt").write_text("Hello, world! How are you?")

@@ -76,6 +76,32 @@ with open("file.txt", "r") as f:
     assert result["files"] == {}
 
 
+def test_hello_world_examples_round_trip(client):
+    # The hello_world example pair as payloads: write_file snapshots
+    # example.txt, read_file restores it via the files map in a second
+    # execution (reference examples/hello_world_{write,read}_file.py).
+    response = client.post(
+        "/v1/execute",
+        json={"source_code": (EXAMPLES / "hello_world_write_file.py").read_text()},
+    )
+    response.raise_for_status()
+    result = response.json()
+    assert result["exit_code"] == 0
+    assert "/workspace/example.txt" in result["files"]
+
+    response = client.post(
+        "/v1/execute",
+        json={
+            "source_code": (EXAMPLES / "hello_world_read_file.py").read_text(),
+            "files": result["files"],
+        },
+    )
+    response.raise_for_status()
+    result = response.json()
+    assert result["stdout"] == "Hello, world! How are you?\n"
+    assert result["exit_code"] == 0
+
+
 def test_env_passthrough(client):
     # Reference test_http.py:88-99.
     response = client.post(
