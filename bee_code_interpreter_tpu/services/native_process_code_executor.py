@@ -18,12 +18,16 @@ byte-identical to what the pod network carries.
 from __future__ import annotations
 
 import asyncio
+import concurrent.futures
+import functools
 import logging
 import os
 import secrets
 import shutil
 import socket
 import subprocess
+import sys
+import tempfile
 from collections import deque
 from contextlib import asynccontextmanager
 from dataclasses import dataclass
@@ -54,24 +58,16 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def _die_with_parent() -> None:
-    """PR_SET_PDEATHSIG: the kernel kills the sandbox if the service dies.
-
-    The local analogue of the reference's ownerReferences cascade-GC
-    (kubernetes_code_executor.py:215-224) — warm sandboxes must never outlive
-    the control plane, even on SIGKILL. Linux-only; elsewhere orphans are only
-    cleaned up by the cooperative shutdown() path.
-    """
-    try:
-        import ctypes
-        import signal as _signal
-
-        PR_SET_PDEATHSIG = 1
-        ctypes.CDLL("libc.so.6", use_errno=True).prctl(
-            PR_SET_PDEATHSIG, _signal.SIGKILL, 0, 0, 0
-        )
-    except Exception:
-        pass
+# Orphan protection (the local analogue of the reference's ownerReferences
+# cascade-GC, kubernetes_code_executor.py:215-224): the C++ server sets
+# PR_SET_PDEATHSIG on itself and watches APP_PARENT_PID when
+# APP_DIE_WITH_PARENT=1 (executor/src/server.cpp main()), so warm sandboxes
+# never outlive the control plane even on SIGKILL. Doing it in the child
+# instead of a preexec_fn lets Popen use vfork instead of a classic fork of
+# the (large) service process — the fork was measured blocking the event loop
+# ~35 ms per pool refill, which showed up directly in in-flight request p50.
+# (CPython only takes the posix_spawn path with close_fds=False, which a
+# sandbox must not use — service fds would leak into user code.)
 
 
 @dataclass
@@ -119,6 +115,53 @@ class NativeProcessCodeExecutor(ExecutorHttpDriver):
         # The event loop holds only weak refs to tasks; fire-and-forget refills
         # must be anchored here or GC can cancel them mid-spawn.
         self._background_tasks: set[asyncio.Task] = set()
+        # Dedicated spawn thread: PR_SET_PDEATHSIG fires when the spawning
+        # *thread* exits (prctl(2)), so sandboxes must not be forked from
+        # default-executor workers whose lifetime we don't control. This
+        # thread lives exactly as long as the pool.
+        self._spawn_pool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="sandbox-spawn"
+        )
+        self._stdlib_file_path: str | None = None
+        self._stdlib_lock = asyncio.Lock()
+
+    async def _stdlib_file(self) -> str | None:
+        """Stdlib module list for the dep guesser, generated once per service
+        process by asking the *sandbox* interpreter (same APP_PYTHON
+        resolution the C++ server uses — its stdlib can differ from the
+        control plane venv's); sandboxes read the file instead of each paying
+        a python startup to ask. None when dep-install is disabled (the list
+        is never consulted). The probe runs off-loop (a python startup must
+        not stall in-flight requests), lands in a private per-process runtime
+        dir — NOT under workspace_root, where sandboxed user code could
+        overwrite it via ``../`` and poison later guesses — and is fresh
+        every service start so an interpreter upgrade can't serve a stale
+        list. Falls back to this interpreter's own list if the probe fails.
+        (The executor image pregenerates /stdlib_names.txt the same way.)"""
+        if self._config.disable_dep_install:
+            return None
+        async with self._stdlib_lock:
+            if self._stdlib_file_path is None:
+                python = os.environ.get("APP_PYTHON", "python3")
+                probe = "import sys; print('\\n'.join(sorted(sys.stdlib_module_names)))"
+
+                def generate() -> str:
+                    try:
+                        return subprocess.run(
+                            [python, "-c", probe],
+                            capture_output=True, text=True, timeout=30, check=True,
+                        ).stdout
+                    except (OSError, subprocess.SubprocessError):
+                        return "\n".join(sorted(sys.stdlib_module_names)) + "\n"
+
+                names = await asyncio.get_running_loop().run_in_executor(
+                    self._spawn_pool, generate
+                )
+                runtime_dir = Path(tempfile.mkdtemp(prefix="bci-runtime-"))
+                path = runtime_dir / "stdlib_names.txt"
+                path.write_text(names)
+                self._stdlib_file_path = str(path)
+        return self._stdlib_file_path
 
     @property
     def pool_ready_count(self) -> int:
@@ -253,13 +296,23 @@ class NativeProcessCodeExecutor(ExecutorHttpDriver):
         shim = cfg.resolved_shim_dir()
         if shim:
             env["APP_SHIM_DIR"] = str(shim)
+        env["APP_DIE_WITH_PARENT"] = "1"  # server watches us via PDEATHSIG+ppid
+        env["APP_PARENT_PID"] = str(os.getpid())
+        stdlib_file = await self._stdlib_file()
+        if stdlib_file:
+            env["APP_STDLIB_FILE"] = stdlib_file
 
-        proc = subprocess.Popen(
-            [str(self._binary)],
-            env=env,
-            stdout=subprocess.DEVNULL,
-            stderr=subprocess.DEVNULL,
-            preexec_fn=_die_with_parent,
+        # Off-loop spawn: even vfork costs ~ms, and refills run concurrently
+        # with in-flight requests.
+        proc = await asyncio.get_running_loop().run_in_executor(
+            self._spawn_pool,
+            functools.partial(
+                subprocess.Popen,
+                [str(self._binary)],
+                env=env,
+                stdout=subprocess.DEVNULL,
+                stderr=subprocess.DEVNULL,
+            ),
         )
         box = NativeSandbox(proc=proc, addr=addr, workspace=workspace)
         try:
@@ -295,6 +348,13 @@ class NativeProcessCodeExecutor(ExecutorHttpDriver):
         self._closed = True
         while self._queue:
             self._queue.popleft().destroy()
+        # The spawn thread's exit triggers PDEATHSIG in any sandbox it forked
+        # — including one currently serving a request. That is the intended
+        # contract: shutdown() terminates the backend; an execution still in
+        # flight dies with it (its handler is being torn down with the loop
+        # anyway). Queued sandboxes were destroyed above; in-flight refills
+        # see the closed flag and destroy their own.
+        self._spawn_pool.shutdown(wait=False)
         try:
             loop = asyncio.get_running_loop()
         except RuntimeError:

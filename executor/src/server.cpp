@@ -30,6 +30,13 @@
 #include <mutex>
 #include <sstream>
 
+#include <signal.h>
+#include <sys/prctl.h>
+#include <unistd.h>
+
+#include <chrono>
+#include <thread>
+
 #include "dep_guess.hpp"
 #include "http.hpp"
 #include "json.hpp"
@@ -73,7 +80,6 @@ class Executor {
  public:
   explicit Executor(ExecutorConfig config) : config_(std::move(config)) {
     fs::create_directories(config_.workspace_root);
-    load_stdlib();
     guesser_.pypi_map = dep_guess::load_pypi_map(
         read_file(env_or("APP_PYPI_MAP", "/pypi_map.tsv")));
     dep_guess::load_requirements_into(
@@ -167,6 +173,12 @@ class Executor {
   // Returns pip stderr notes on failure, "" on success/no-op (install
   // failures surface in-band like the reference, server.rs:140-147).
   std::string ensure_dependencies(const std::string& source) {
+    if (config_.disable_dep_install) return "";
+    // Lazy: asking the interpreter for sys.stdlib_module_names costs a full
+    // python startup (~20 ms CPU); paying it in the constructor made every
+    // warm-pool refill visibly steal latency from in-flight requests on
+    // small hosts. First guess pays it once; disabled dep-install never does.
+    std::call_once(stdlib_loaded_, [this] { load_stdlib(); });
     auto deps = guesser_.guess(source);
     {
       std::lock_guard<std::mutex> lock(installed_mutex_);
@@ -176,7 +188,7 @@ class Executor {
                                 }),
                  deps.end());
     }
-    if (deps.empty() || config_.disable_dep_install) return "";
+    if (deps.empty()) return "";
     std::vector<std::string> argv = {config_.python, "-m", "pip", "install",
                                      "--no-cache-dir"};
     argv.insert(argv.end(), deps.begin(), deps.end());
@@ -232,11 +244,19 @@ class Executor {
   }
 
   void load_stdlib() {
-    auto result = subprocess::run(
-        {config_.python, "-c",
-         "import sys; print('\\n'.join(sorted(sys.stdlib_module_names)))"},
-        base_env({}), "", 30.0);
-    std::istringstream stream(result.out);
+    // Prefer a pregenerated list (APP_STDLIB_FILE; written once at image
+    // build or pool startup) — asking the interpreter costs a full python
+    // startup, which single-use sandboxes would otherwise pay per request.
+    std::string cached = read_file(env_or("APP_STDLIB_FILE", "/stdlib_names.txt"));
+    std::string names = cached;
+    if (names.empty()) {
+      auto result = subprocess::run(
+          {config_.python, "-c",
+           "import sys; print('\\n'.join(sorted(sys.stdlib_module_names)))"},
+          base_env({}), "", 30.0);
+      names = result.out;
+    }
+    std::istringstream stream(names);
     std::string name;
     while (std::getline(stream, name))
       if (!name.empty()) guesser_.stdlib.insert(name);
@@ -247,6 +267,7 @@ class Executor {
 
   ExecutorConfig config_;
   dep_guess::Guesser guesser_;
+  std::once_flag stdlib_loaded_;
   std::set<std::string> installed_this_session_;
   std::mutex installed_mutex_;
 };
@@ -254,6 +275,34 @@ class Executor {
 }  // namespace
 
 int main() {
+  // Die with the spawning service (native-process backend). Setting PDEATHSIG
+  // here — instead of a Python preexec_fn in the parent — keeps the control
+  // plane's Popen on the vfork fast path, so pool refills never block its
+  // event loop on a classic fork of the (large) service process.
+  //
+  // PDEATHSIG alone is not enough: it fires when the spawning *thread* exits
+  // (prctl(2)), it can't catch a parent that died before we attached, and on
+  // some sandboxed kernels it never fires at all (measured: no delivery even
+  // preexec-style on a Firecracker 6.18 microVM). APP_PARENT_PID names the
+  // service process explicitly; the watchdog thread below is the guaranteed
+  // cleanup path — exit as soon as we are reparented away from the service.
+  // (A plain getppid()==1 test would false-positive when the service itself
+  // runs as PID 1 in a container.)
+  if (env_or("APP_DIE_WITH_PARENT", "") == "1") {
+    prctl(PR_SET_PDEATHSIG, SIGKILL);
+    const std::string parent = env_or("APP_PARENT_PID", "");
+    const long parent_val = parent.empty() ? 0 : strtol(parent.c_str(), nullptr, 10);
+    if (parent_val > 0) {
+      const pid_t parent_pid = static_cast<pid_t>(parent_val);
+      if (getppid() != parent_pid) return 1;  // orphaned before we attached
+      std::thread([parent_pid] {
+        while (getppid() == parent_pid)
+          std::this_thread::sleep_for(std::chrono::seconds(2));
+        _exit(1);
+      }).detach();
+    }
+  }
+
   ExecutorConfig config;
   Executor executor(config);
 

@@ -1,0 +1,104 @@
+#!/usr/bin/env python
+"""Measure /v1/execute latency percentiles (BASELINE.md north-star #3).
+
+Drives the trivial health-check payload (``print(21 * 2)``) through two
+execution backends and reports p50/p90:
+
+- **warm**: NativeProcessCodeExecutor — warm pool of C++ sandbox servers, the
+  TPU-native analogue of the reference's warm pod queue
+  (kubernetes_code_executor.py:151-264). This is what a client observes when
+  the pool keeps up.
+- **cold**: LocalCodeExecutor — a fresh interpreter spawned per request; the
+  pool-empty worst case (analogous to the reference's cold pod spawn, minus
+  the k8s scheduling delay which depends on the cluster).
+
+Usage: python scripts/measure-latency.py [N]    (default 30 requests each)
+"""
+
+from __future__ import annotations
+
+import asyncio
+import statistics
+import sys
+import tempfile
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+PAYLOAD = "print(21 * 2)"
+
+
+def pct(samples: list[float], q: float) -> float:
+    return statistics.quantiles(samples, n=100)[int(q) - 1]
+
+
+async def bench_warm(n: int) -> list[float]:
+    from bee_code_interpreter_tpu.config import Config
+    from bee_code_interpreter_tpu.services.native_process_code_executor import (
+        NativeProcessCodeExecutor,
+    )
+    from bee_code_interpreter_tpu.services.storage import Storage
+
+    tmp = Path(tempfile.mkdtemp(prefix="lat-warm-"))
+    config = Config(
+        file_storage_path=str(tmp / "objects"),
+        local_workspace_root=str(tmp / "ws"),
+        executor_pod_queue_target_length=4,
+        disable_dep_install=True,
+    )
+    executor = NativeProcessCodeExecutor(
+        storage=Storage(tmp / "objects"),
+        config=config,
+        binary=REPO / "executor" / "build" / "executor-server",
+    )
+    try:
+        await executor.fill_sandbox_queue()
+        samples = []
+        for _ in range(n):
+            t0 = time.perf_counter()
+            r = await executor.execute(PAYLOAD)
+            assert r.stdout == "42\n", r.stderr
+            samples.append(time.perf_counter() - t0)
+        return samples
+    finally:
+        executor.shutdown()
+
+
+async def bench_cold(n: int) -> list[float]:
+    from bee_code_interpreter_tpu.services.local_code_executor import (
+        LocalCodeExecutor,
+    )
+    from bee_code_interpreter_tpu.services.storage import Storage
+
+    tmp = Path(tempfile.mkdtemp(prefix="lat-cold-"))
+    executor = LocalCodeExecutor(
+        storage=Storage(tmp / "objects"),
+        workspace_root=tmp / "ws",
+        disable_dep_install=True,
+    )
+    samples = []
+    for _ in range(n):
+        t0 = time.perf_counter()
+        r = await executor.execute(PAYLOAD)
+        assert r.stdout == "42\n", r.stderr
+        samples.append(time.perf_counter() - t0)
+    return samples
+
+
+def main() -> None:
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 30
+    import subprocess
+
+    subprocess.run(["make", "-C", str(REPO / "executor"), "-s"], check=True)
+    for name, fn in (("warm", bench_warm), ("cold", bench_cold)):
+        s = asyncio.run(fn(n))
+        print(
+            f"{name}: n={n} p50={pct(s, 50) * 1000:.1f}ms "
+            f"p90={pct(s, 90) * 1000:.1f}ms min={min(s) * 1000:.1f}ms"
+        )
+
+
+if __name__ == "__main__":
+    main()
