@@ -92,6 +92,12 @@ class Config(BaseModel):
     # Extra nodeSelector entries for TPU node pools.
     tpu_node_selector: dict[str, str] = Field(default_factory=dict)
 
+    # Shared persistent XLA compile-cache directory exported to sandboxes as
+    # JAX_COMPILATION_CACHE_DIR (opt-in; point at a shared volume in k8s).
+    # Single-use sandboxes then pay each unique program's compile once per
+    # deployment instead of once per request.
+    jax_cache_dir: str | None = None
+
     # --- local backend ---
     # Path to the native executor binary; when unset, the pure-Python in-process
     # executor (the test fake the reference never had; SURVEY.md §4) is used.

@@ -35,21 +35,30 @@ from pathlib import Path
 
 from bee_code_interpreter_tpu.runtime import dep_guess
 
-# Env vars the executor forwards from its own environment into every user
-# process, so JAX/libtpu sees the slice topology the scheduler provisioned.
-TPU_PASSTHROUGH_ENV = (
-    "TPU_WORKER_ID",
-    "TPU_WORKER_HOSTNAMES",
-    "TPU_ACCELERATOR_TYPE",
-    "TPU_TOPOLOGY",
-    "TPU_CHIPS_PER_HOST_BOUNDS",
-    "JAX_COORDINATOR_ADDRESS",
-    "JAX_NUM_PROCESSES",
-    "JAX_PROCESS_ID",
-    "JAX_PLATFORMS",
-    "XLA_FLAGS",
-    "TPU_SKIP_MDS_QUERY",
+# Env the executor forwards from its own environment into every user process,
+# so JAX/libtpu sees the slice topology the scheduler provisioned, by prefix:
+# the accelerator stack's vars are open-ended (libtpu TPU_*, jax JAX_*, XLA_*,
+# pallas PALLAS_*, platform plugins like the axon dev tunnel AXON_*, plus
+# LIBTPU_*/MEGASCALE_* for multi-slice), and missing one silently strands the
+# sandbox on host CPU — the exact failure the transparent reroute exists to
+# prevent.
+TPU_PASSTHROUGH_PREFIXES = (
+    "TPU_", "JAX_", "XLA_", "PALLAS_", "AXON_", "LIBTPU_", "MEGASCALE_",
 )
+
+# Kubernetes service links (enableServiceLinks) auto-inject FOO_SERVICE_HOST /
+# FOO_PORT_80_TCP-style vars for every Service in the namespace; a Service
+# named tpu-* or jax-* would land inside the prefixes above and leak cluster
+# addresses into untrusted user code. Filter that shape back out.
+_K8S_SERVICE_LINK_MARKERS = ("_SERVICE_", "_PORT_")
+
+
+def _is_passthrough_env(key: str) -> bool:
+    return (
+        key.startswith(TPU_PASSTHROUGH_PREFIXES)
+        and not key.endswith("_PORT")
+        and not any(m in key for m in _K8S_SERVICE_LINK_MARKERS)
+    )
 
 EXECUTION_TIMED_OUT = "Execution timed out"
 
@@ -169,14 +178,20 @@ class ExecutorCore:
             "LANG": "C.UTF-8",
             "PYTHONUNBUFFERED": "1",
         }
-        for key in TPU_PASSTHROUGH_ENV:
-            if key in os.environ:
-                env[key] = os.environ[key]
+        for key, value in os.environ.items():
+            if _is_passthrough_env(key):
+                env[key] = value
         if self.shim_dir:
             existing = os.environ.get("PYTHONPATH", "")
             env["PYTHONPATH"] = self.shim_dir + (os.pathsep + existing if existing else "")
         elif "PYTHONPATH" in os.environ:
             env["PYTHONPATH"] = os.environ["PYTHONPATH"]
+        # Shared persistent XLA compile cache (operator opt-in): single-use
+        # sandboxes then pay each unique program's compile once per
+        # deployment instead of once per request.
+        jax_cache = os.environ.get("APP_JAX_CACHE_DIR")
+        if jax_cache and "JAX_COMPILATION_CACHE_DIR" not in env:
+            env["JAX_COMPILATION_CACHE_DIR"] = jax_cache
         env.update(request_env)  # request env wins (reference server.rs:154)
         return env
 

@@ -300,6 +300,11 @@ class KubernetesCodeExecutor(ExecutorHttpDriver):
             )
         if cfg.tpu_topology:
             env.append({"name": "TPU_TOPOLOGY", "value": cfg.tpu_topology})
+        if cfg.jax_cache_dir:
+            # Shared XLA compile cache (must point at a mounted shared volume,
+            # via executor_pod_spec_extra): unique programs compile once per
+            # deployment, not once per single-use pod.
+            env.append({"name": "APP_JAX_CACHE_DIR", "value": cfg.jax_cache_dir})
         if num_workers > 1:
             # Worker 0 coordinates on its own IP; the others dial it.
             address = (

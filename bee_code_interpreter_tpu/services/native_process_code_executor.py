@@ -296,6 +296,8 @@ class NativeProcessCodeExecutor(ExecutorHttpDriver):
         shim = cfg.resolved_shim_dir()
         if shim:
             env["APP_SHIM_DIR"] = str(shim)
+        if cfg.jax_cache_dir:
+            env["APP_JAX_CACHE_DIR"] = cfg.jax_cache_dir
         env["APP_DIE_WITH_PARENT"] = "1"  # server watches us via PDEATHSIG+ppid
         env["APP_PARENT_PID"] = str(os.getpid())
         stdlib_file = await self._stdlib_file()

@@ -37,6 +37,8 @@
 #include <chrono>
 #include <thread>
 
+extern char** environ;
+
 #include "dep_guess.hpp"
 #include "http.hpp"
 #include "json.hpp"
@@ -59,14 +61,28 @@ std::string read_file(const fs::path& path) {
   return ss.str();
 }
 
-// Env vars forwarded from the pod into every user process so JAX/libtpu sees
-// the slice topology (mirrors executor_core.TPU_PASSTHROUGH_ENV).
-constexpr const char* kTpuPassthrough[] = {
-    "TPU_WORKER_ID", "TPU_WORKER_HOSTNAMES", "TPU_ACCELERATOR_TYPE",
-    "TPU_TOPOLOGY", "TPU_CHIPS_PER_HOST_BOUNDS", "JAX_COORDINATOR_ADDRESS",
-    "JAX_NUM_PROCESSES", "JAX_PROCESS_ID", "JAX_PLATFORMS", "XLA_FLAGS",
-    "TPU_SKIP_MDS_QUERY",
+// Env prefixes forwarded from the pod into every user process so JAX/libtpu
+// sees the slice topology (mirrors executor_core.TPU_PASSTHROUGH_PREFIXES):
+// the accelerator stack's vars are open-ended, and missing one silently
+// strands the sandbox on host CPU.
+constexpr const char* kTpuPassthroughPrefixes[] = {
+    "TPU_", "JAX_", "XLA_", "PALLAS_", "AXON_", "LIBTPU_", "MEGASCALE_",
 };
+
+// Kubernetes service links (enableServiceLinks) auto-inject FOO_SERVICE_HOST /
+// FOO_PORT_80_TCP-style vars for every Service in the namespace; a Service
+// named tpu-* would land inside the prefixes above and leak cluster addresses
+// into untrusted user code (mirrors executor_core._is_passthrough_env).
+inline bool is_passthrough_env(const std::string& key) {
+  bool prefixed = false;
+  for (const char* prefix : kTpuPassthroughPrefixes)
+    if (key.rfind(prefix, 0) == 0) { prefixed = true; break; }
+  if (!prefixed) return false;
+  if (key.size() >= 5 && key.compare(key.size() - 5, 5, "_PORT") == 0) return false;
+  if (key.find("_SERVICE_") != std::string::npos) return false;
+  if (key.find("_PORT_") != std::string::npos) return false;
+  return true;
+}
 
 struct ExecutorConfig {
   std::string python = env_or("APP_PYTHON", "python3");
@@ -228,9 +244,12 @@ class Executor {
         {"LANG", "C.UTF-8"},
         {"PYTHONUNBUFFERED", "1"},
     };
-    for (const char* key : kTpuPassthrough) {
-      const char* v = getenv(key);
-      if (v) env[key] = v;
+    for (char** e = environ; *e; ++e) {
+      const std::string entry(*e);
+      const auto eq = entry.find('=');
+      if (eq == std::string::npos) continue;
+      const std::string key = entry.substr(0, eq);
+      if (is_passthrough_env(key)) env[key] = entry.substr(eq + 1);
     }
     if (!config_.shim_dir.empty()) {
       std::string existing = env_or("PYTHONPATH", "");
@@ -239,6 +258,12 @@ class Executor {
     } else if (getenv("PYTHONPATH")) {
       env["PYTHONPATH"] = getenv("PYTHONPATH");
     }
+    // Shared persistent XLA compile cache (operator opt-in, e.g. a pod
+    // volume): single-use sandboxes then pay each unique program's compile
+    // once per deployment instead of once per request.
+    const std::string jax_cache = env_or("APP_JAX_CACHE_DIR", "");
+    if (!jax_cache.empty() && !env.count("JAX_COMPILATION_CACHE_DIR"))
+      env["JAX_COMPILATION_CACHE_DIR"] = jax_cache;
     for (const auto& [k, v] : request_env) env[k] = v;  // request env wins
     return env;
   }

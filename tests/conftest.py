@@ -15,6 +15,13 @@ import os
 # platform and prepends it to jax_platforms even when the env var says cpu):
 # tests must run on the virtual 8-device CPU mesh.
 os.environ["JAX_PLATFORMS"] = "cpu"
+# Drop accelerator-tunnel plugin vars entirely: the dev box's TPU plugin hooks
+# jax backend init whenever its pool vars are visible — even under
+# JAX_PLATFORMS=cpu — and blocks on the (single-client) tunnel. Tests and
+# every sandbox subprocess they spawn (which inherit via the executor's
+# TPU_PASSTHROUGH_PREFIXES) must be hermetic CPU-only.
+for _k in [k for k in os.environ if k.startswith(("PALLAS_", "AXON_"))]:
+    os.environ.pop(_k)
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()

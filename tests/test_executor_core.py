@@ -73,6 +73,53 @@ async def test_tpu_topology_env_forwarded(tmp_path):
         del os.environ["TPU_WORKER_ID"]
 
 
+async def test_accelerator_env_forwarded_by_prefix(tmp_path):
+    # The accelerator stack's env surface is open-ended (libtpu, pallas,
+    # platform plugins); forwarding is by prefix, and unrelated host env must
+    # NOT leak into the sandbox.
+    os.environ["PALLAS_TEST_FLAG"] = "on"
+    os.environ["LIBTPU_INIT_ARGS"] = "--xla_foo"
+    os.environ["UNRELATED_SECRET"] = "nope"
+    # k8s service-link shapes inside a matching prefix must NOT leak
+    os.environ["TPU_PROXY_SERVICE_HOST"] = "10.0.0.5"
+    os.environ["TPU_PROXY_PORT_80_TCP"] = "tcp://10.0.0.5:80"
+    try:
+        out = await core_exec(
+            tmp_path,
+            "import os\n"
+            "print(os.environ.get('PALLAS_TEST_FLAG'))\n"
+            "print(os.environ.get('LIBTPU_INIT_ARGS'))\n"
+            "print(os.environ.get('UNRELATED_SECRET'))\n"
+            "print(os.environ.get('TPU_PROXY_SERVICE_HOST'))\n"
+            "print(os.environ.get('TPU_PROXY_PORT_80_TCP'))",
+        )
+        assert out.stdout == "on\n--xla_foo\nNone\nNone\nNone\n"
+    finally:
+        for key in (
+            "PALLAS_TEST_FLAG",
+            "LIBTPU_INIT_ARGS",
+            "UNRELATED_SECRET",
+            "TPU_PROXY_SERVICE_HOST",
+            "TPU_PROXY_PORT_80_TCP",
+        ):
+            del os.environ[key]
+
+
+async def test_jax_cache_dir_exported(tmp_path, monkeypatch):
+    # A developer's own JAX_COMPILATION_CACHE_DIR would win over the opt-in
+    # (pod env beats service config by design); clear it for determinism.
+    monkeypatch.delenv("JAX_COMPILATION_CACHE_DIR", raising=False)
+    os.environ["APP_JAX_CACHE_DIR"] = "/shared/xla-cache"
+    try:
+        out = await core_exec(
+            tmp_path,
+            "import os\nprint(os.environ.get('JAX_COMPILATION_CACHE_DIR'))",
+        )
+        assert out.stdout == "/shared/xla-cache\n"
+    finally:
+        del os.environ["APP_JAX_CACHE_DIR"]
+
+
 def test_resolve_strips_logical_prefix(tmp_path):
     core = make_core(tmp_path)
     ws = core.workspace.resolve()
