@@ -30,12 +30,16 @@ async def main() -> None:
     await ctx.grpc_server.start(ctx.config.grpc_listen_addr)
     logger.info("gRPC server listening on %s", ctx.config.grpc_listen_addr)
 
+    sweeper = ctx.start_storage_sweeper()
+
     stop = asyncio.Event()
     loop = asyncio.get_running_loop()
     for sig in (signal.SIGINT, signal.SIGTERM):
         loop.add_signal_handler(sig, stop.set)
     await stop.wait()
 
+    if sweeper is not None:
+        sweeper.cancel()
     await ctx.grpc_server.stop()
     await runner.cleanup()
     # Tear down any warm sandboxes (only if the executor was ever built —

@@ -30,6 +30,26 @@ class ApplicationContext:
     def storage(self) -> Storage:
         return Storage(storage_path=self.config.file_storage_path)
 
+    def start_storage_sweeper(self) -> asyncio.Task | None:
+        """Periodic TTL sweep of stored objects when storage_max_age_s is set
+        (must be called from a running loop; __main__ does)."""
+        if self.config.storage_max_age_s is None:
+            return None
+
+        async def sweeper() -> None:
+            log = logging.getLogger(__name__)
+            while True:
+                try:
+                    removed = await self.storage.sweep(self.config.storage_max_age_s)
+                    if removed:
+                        log.info("Storage sweep removed %d expired objects", removed)
+                except Exception:
+                    log.exception("Storage sweep failed")
+                await asyncio.sleep(self.config.storage_sweep_interval_s)
+
+        self._storage_sweeper_task = asyncio.create_task(sweeper())
+        return self._storage_sweeper_task
+
     @cached_property
     def code_executor(self):
         if self.config.executor_backend == "local":

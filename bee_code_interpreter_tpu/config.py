@@ -79,6 +79,11 @@ class Config(BaseModel):
 
     # --- object storage (reference config.py:74) ---
     file_storage_path: str = "./.tmp/files"
+    # Optional TTL sweep of stored objects (the reference leaves cleanup to
+    # the operator, its README.md:167). Unset disables; objects age from
+    # their last snapshot (content-addressed rewrites refresh mtime).
+    storage_max_age_s: float | None = Field(default=None, gt=0)
+    storage_sweep_interval_s: float = Field(default=3600.0, gt=0)
 
     # --- TPU slice topology (new; consumed by the pod-group scheduler) ---
     # Accelerator type label value, e.g. "tpu-v5-lite-podslice" on GKE.

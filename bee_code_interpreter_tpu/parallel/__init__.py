@@ -19,6 +19,7 @@ Axis conventions (used by models/, ops/ and the flagship train step):
 from bee_code_interpreter_tpu.parallel.mesh import (  # noqa: F401
     MeshPlan,
     auto_mesh,
+    initialize_distributed,
     local_device_count,
     make_mesh,
 )

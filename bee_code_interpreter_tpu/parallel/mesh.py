@@ -28,7 +28,11 @@ def initialize_distributed() -> bool:
     num_processes = int(os.environ.get("JAX_NUM_PROCESSES", "1"))
     if num_processes <= 1:
         return False
-    if jax.process_count() > 1:  # already initialized
+    # Idempotency must NOT be probed via jax.process_count(): that call
+    # initializes the XLA backend, after which jax.distributed.initialize
+    # refuses to run at all (caught by tests/test_multihost_distributed.py).
+    # is_initialized() checks the coordination client without touching XLA.
+    if jax.distributed.is_initialized():
         return True
     jax.distributed.initialize(
         coordinator_address=os.environ["JAX_COORDINATOR_ADDRESS"],

@@ -97,8 +97,16 @@ class Storage:
 
     @asynccontextmanager
     async def reader(self, object_id: Hash) -> AsyncIterator[ObjectReader]:
-        reader = ObjectReader(self._object_path(object_id))
+        path = self._object_path(object_id)
+        reader = ObjectReader(path)
         await reader._open()
+        try:
+            # Reads mark the object as in use: sessions that only restore a
+            # file (never modify it) must still keep it alive under the TTL
+            # sweep, which ages by mtime.
+            await asyncio.to_thread(os.utime, path)
+        except OSError:
+            pass
         try:
             yield reader
         finally:
@@ -128,3 +136,47 @@ class Storage:
 
     async def exists(self, object_id: Hash) -> bool:
         return await asyncio.to_thread(self._object_path(object_id).exists)
+
+    async def sweep(self, max_age_s: float) -> int:
+        """Delete objects untouched for longer than ``max_age_s``; returns the
+        count removed.
+
+        The reference leaves cleanup to the operator ("temporary solution ...
+        S3 TTL", its README.md:167); this makes the TTL a service feature for
+        the flat-directory store. Objects age from last *use*: writes refresh
+        mtime via os.replace (ObjectWriter._finalize) and reads refresh it
+        explicitly (reader()), so anything an active session touches stays.
+
+        A residual TOCTOU exists: an identical-content write finalizing in
+        the microseconds between the freshness re-check and the unlink loses
+        its object. The double-stat shrinks the window to the same order as
+        S3-lifecycle-style races; full closure would need per-object locking
+        the flat-file store deliberately avoids.
+        """
+
+        def _sweep_sync() -> int:
+            import time
+
+            if not self._root.is_dir():
+                return 0
+            cutoff = time.time() - max_age_s
+            removed = 0
+            for entry in self._root.iterdir():
+                try:
+                    if entry.name.startswith(".tmp-"):
+                        continue  # in-flight write
+                    if entry.stat().st_mtime >= cutoff:
+                        continue
+                    # Re-check right before deleting: a concurrent identical
+                    # write or a reader's utime may have just refreshed it.
+                    if entry.stat().st_mtime >= cutoff:
+                        continue
+                    entry.unlink()
+                    removed += 1
+                except OSError:
+                    # Missing (raced), a directory, permission-denied — skip
+                    # this entry, keep sweeping the rest.
+                    continue
+            return removed
+
+        return await asyncio.to_thread(_sweep_sync)

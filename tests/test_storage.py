@@ -45,3 +45,52 @@ async def test_aborted_write_leaves_no_object(storage: Storage, tmp_path):
             raise Boom()
     # no temp litter, no object
     assert list((tmp_path / "objects").iterdir()) == []
+
+
+async def test_sweep_removes_only_expired(storage: Storage, tmp_path):
+    import os
+    import time
+
+    old_id = await storage.write(b"ancient snapshot")
+    fresh_id = await storage.write(b"current snapshot")
+    # age the first object past the TTL
+    past = time.time() - 1000
+    os.utime(tmp_path / "objects" / old_id, (past, past))
+
+    removed = await storage.sweep(max_age_s=500)
+    assert removed == 1
+    assert not await storage.exists(old_id)
+    assert await storage.exists(fresh_id)
+    # sweeping an empty/again is a no-op
+    assert await storage.sweep(max_age_s=500) == 0
+
+
+async def test_sweep_skips_inflight_writes(storage: Storage, tmp_path):
+    import os
+    import time
+
+    root = tmp_path / "objects"
+    async with storage.writer() as w:
+        await w.write(b"long upload in progress")
+        # even an "old" temp file survives (clock skew / slow streams)
+        tmp_files = [p for p in root.iterdir() if p.name.startswith(".tmp-")]
+        past = time.time() - 10_000
+        for p in tmp_files:
+            os.utime(p, (past, past))
+        assert await storage.sweep(max_age_s=500) == 0
+    assert await storage.exists(w.hash)
+
+
+async def test_read_refreshes_ttl(storage: Storage, tmp_path):
+    # A session that only restores a file (never rewrites it) must keep it
+    # alive under the TTL sweep: reads mark use.
+    import os
+    import time
+
+    object_id = await storage.write(b"restored every run, never modified")
+    past = time.time() - 1000
+    os.utime(tmp_path / "objects" / object_id, (past, past))
+
+    assert await storage.read(object_id)  # a restore happens...
+    assert await storage.sweep(max_age_s=500) == 0  # ...so it survives
+    assert await storage.exists(object_id)
