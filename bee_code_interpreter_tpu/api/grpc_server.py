@@ -61,6 +61,7 @@ class CodeInterpreterServicer:
             source_code=request.source_code,
             files=dict(request.files),
             env=dict(request.env),  # env forwarded, unlike reference (:67-70)
+            timeout_s=request.timeout or None,  # proto default 0 = unset
         )
         return pb.ExecuteResponse(
             stdout=result.stdout,

@@ -85,7 +85,10 @@ def create_http_server(
         logger.info("Executing code: %s", req.source_code)
         try:
             result = await code_executor.execute(
-                source_code=req.source_code, files=req.files, env=req.env
+                source_code=req.source_code,
+                files=req.files,
+                env=req.env,
+                timeout_s=req.timeout,
             )
         except Exception:
             logger.exception("Execution failed")

@@ -11,6 +11,11 @@ class ExecuteRequest(BaseModel):
     source_code: str
     files: dict[AbsolutePath, Hash] = Field(default_factory=dict)
     env: dict[str, str] = Field(default_factory=dict)
+    # Optional per-request deadline in seconds; clamped to the service's
+    # configured execution_timeout_s (a request may shorten, never extend).
+    # The reference's executor had this field but never exposed it
+    # (server.rs:32; omitted by kubernetes_code_executor.py:117-123).
+    timeout: float | None = Field(default=None, gt=0)
 
 
 class ExecuteResponse(BaseModel):

@@ -28,7 +28,10 @@ class ApplicationContext:
 
     @cached_property
     def storage(self) -> Storage:
-        return Storage(storage_path=self.config.file_storage_path)
+        return Storage(
+            storage_path=self.config.file_storage_path,
+            touch_on_read=self.config.storage_max_age_s is not None,
+        )
 
     def start_storage_sweeper(self) -> asyncio.Task | None:
         """Periodic TTL sweep of stored objects when storage_max_age_s is set

@@ -34,4 +34,5 @@ class CodeExecutor(Protocol):
         source_code: str,
         files: dict[AbsolutePath, Hash] | None = None,
         env: dict[str, str] | None = None,
+        timeout_s: float | None = None,
     ) -> Result: ...

@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import httpx
 
+from bee_code_interpreter_tpu.config import Config
 from bee_code_interpreter_tpu.services.storage import Storage
 from bee_code_interpreter_tpu.utils.validation import Hash
 
@@ -22,6 +23,7 @@ class ExecutorHttpDriver:
 
     _http: httpx.AsyncClient
     _storage: Storage
+    _config: Config
 
     async def _upload_file(self, addr: str, path: str, object_id: Hash) -> None:
         async def body():
@@ -45,6 +47,14 @@ class ExecutorHttpDriver:
                 async for chunk in response.aiter_bytes():
                     await writer.write(chunk)
         return writer.hash
+
+    def _effective_timeout(self, timeout_s: float | None) -> float:
+        """A request may shorten the execution deadline, never extend it past
+        the service-configured bound (requires ``self._config``)."""
+        bound = self._config.execution_timeout_s
+        if timeout_s is None or timeout_s <= 0:
+            return bound
+        return min(timeout_s, bound)
 
     async def _post_execute(
         self, addr: str, source_code: str, env: dict[str, str], timeout_s: float

@@ -123,6 +123,7 @@ class KubernetesCodeExecutor(ExecutorHttpDriver):
         source_code: str,
         files: dict[AbsolutePath, Hash] | None = None,
         env: dict[str, str] | None = None,
+        timeout_s: float | None = None,
     ) -> Result:
         files = files or {}
         env = env or {}
@@ -143,7 +144,7 @@ class KubernetesCodeExecutor(ExecutorHttpDriver):
             responses = await asyncio.gather(
                 *(
                     self._post_execute(
-                        addr, source_code, env, self._config.execution_timeout_s
+                        addr, source_code, env, self._effective_timeout(timeout_s)
                     )
                     for addr in addrs
                 )

@@ -58,11 +58,19 @@ class LocalCodeExecutor:
             )
         return self._preinstalled
 
+    def _clamp_timeout(self, timeout_s: float | None) -> float | None:
+        """A request may shorten the deadline, never extend past the
+        service-configured bound."""
+        if timeout_s is None or timeout_s <= 0:
+            return None
+        return min(timeout_s, self._execution_timeout_s)
+
     async def execute(
         self,
         source_code: str,
         files: dict[AbsolutePath, Hash] | None = None,
         env: dict[str, str] | None = None,
+        timeout_s: float | None = None,
     ) -> Result:
         files = files or {}
         workspace = self._workspace_root / secrets.token_hex(8)
@@ -87,7 +95,9 @@ class LocalCodeExecutor:
                         async for chunk in r:
                             f.write(chunk)
 
-            outcome = await core.execute(source_code, env=env)
+            outcome = await core.execute(
+                source_code, env=env, timeout_s=self._clamp_timeout(timeout_s)
+            )
 
             # Snapshot changed files back (reference :126-142).
             out_files: dict[str, str] = {}

@@ -184,6 +184,7 @@ class NativeProcessCodeExecutor(ExecutorHttpDriver):
         source_code: str,
         files: dict[AbsolutePath, Hash] | None = None,
         env: dict[str, str] | None = None,
+        timeout_s: float | None = None,
     ) -> Result:
         files = files or {}
         env = env or {}
@@ -195,7 +196,7 @@ class NativeProcessCodeExecutor(ExecutorHttpDriver):
                 )
             )
             response = await self._post_execute(
-                box.addr, source_code, env, self._config.execution_timeout_s
+                box.addr, source_code, env, self._effective_timeout(timeout_s)
             )
             out_files: dict[str, str] = {}
             for path, object_id in zip(

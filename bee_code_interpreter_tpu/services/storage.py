@@ -85,8 +85,14 @@ class Storage:
     context managers plus whole-object ``read``/``write``/``exists`` helpers.
     """
 
-    def __init__(self, storage_path: str | os.PathLike) -> None:
+    def __init__(
+        self, storage_path: str | os.PathLike, touch_on_read: bool = False
+    ) -> None:
         self._root = Path(storage_path)
+        # Only pay the per-read utime when a TTL sweep actually ages objects
+        # (ApplicationContext sets this from storage_max_age_s); reads are on
+        # the warm-execute hot path.
+        self._touch_on_read = touch_on_read
 
     async def _ensure_root(self) -> None:
         await asyncio.to_thread(self._root.mkdir, 0o777, True, True)
@@ -100,13 +106,14 @@ class Storage:
         path = self._object_path(object_id)
         reader = ObjectReader(path)
         await reader._open()
-        try:
-            # Reads mark the object as in use: sessions that only restore a
-            # file (never modify it) must still keep it alive under the TTL
-            # sweep, which ages by mtime.
-            await asyncio.to_thread(os.utime, path)
-        except OSError:
-            pass
+        if self._touch_on_read:
+            try:
+                # Reads mark the object as in use: sessions that only restore
+                # a file (never modify it) must still keep it alive under the
+                # TTL sweep, which ages by mtime.
+                await asyncio.to_thread(os.utime, path)
+            except OSError:
+                pass
         try:
             yield reader
         finally:
@@ -147,11 +154,11 @@ class Storage:
         mtime via os.replace (ObjectWriter._finalize) and reads refresh it
         explicitly (reader()), so anything an active session touches stays.
 
-        A residual TOCTOU exists: an identical-content write finalizing in
-        the microseconds between the freshness re-check and the unlink loses
-        its object. The double-stat shrinks the window to the same order as
-        S3-lifecycle-style races; full closure would need per-object locking
-        the flat-file store deliberately avoids.
+        A residual TOCTOU exists: an identical-content write (or reader
+        utime) landing in the microseconds between the freshness stat and the
+        unlink loses its object — the same order of race S3 lifecycle rules
+        accept; full closure would need per-object locking the flat-file
+        store deliberately avoids.
         """
 
         def _sweep_sync() -> int:
@@ -165,10 +172,6 @@ class Storage:
                 try:
                     if entry.name.startswith(".tmp-"):
                         continue  # in-flight write
-                    if entry.stat().st_mtime >= cutoff:
-                        continue
-                    # Re-check right before deleting: a concurrent identical
-                    # write or a reader's utime may have just refreshed it.
                     if entry.stat().st_mtime >= cutoff:
                         continue
                     entry.unlink()

@@ -48,6 +48,16 @@ async def test_imports(grpc_addr):
     assert response.exit_code == 0
 
 
+async def test_per_request_timeout(grpc_addr):
+    response = await call(
+        grpc_addr,
+        "Execute",
+        pb.ExecuteRequest(source_code="import time\ntime.sleep(30)", timeout=0.5),
+    )
+    assert response.exit_code == -1
+    assert response.stderr == "Execution timed out"
+
+
 async def test_file_round_trip(grpc_addr):
     response = await call(
         grpc_addr,

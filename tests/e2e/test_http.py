@@ -117,6 +117,19 @@ def test_env_passthrough(client):
     assert result["exit_code"] == 0
 
 
+def test_per_request_timeout(client):
+    # New over the reference: its executor had the timeout field but the
+    # service never exposed it (server.rs:32). Clamped to the configured max.
+    response = client.post(
+        "/v1/execute",
+        json={"source_code": "import time\ntime.sleep(30)", "timeout": 0.5},
+    )
+    response.raise_for_status()
+    result = response.json()
+    assert result["exit_code"] == -1
+    assert result["stderr"] == "Execution timed out"
+
+
 def test_nonzero_exit(client):
     response = client.post(
         "/v1/execute",

@@ -80,3 +80,25 @@ async def test_mnist_dp_8chip_example_end_to_end(storage, tmp_path):
         if line.startswith("step ")
     ]
     assert losses[-1] < losses[0], r.stdout
+
+
+async def test_per_request_timeout(local_executor: LocalCodeExecutor):
+    # A request may shorten the deadline below the service default...
+    r = await local_executor.execute(
+        "import time\ntime.sleep(30)", timeout_s=0.5
+    )
+    assert r.exit_code == -1
+    assert r.stderr == "Execution timed out"
+
+
+async def test_per_request_timeout_clamped_to_service_bound(storage, tmp_path):
+    # ...but can never extend past it.
+    executor = LocalCodeExecutor(
+        storage=storage,
+        workspace_root=tmp_path / "workspaces",
+        disable_dep_install=True,
+        execution_timeout_s=0.5,
+    )
+    r = await executor.execute("import time\ntime.sleep(30)", timeout_s=9999)
+    assert r.exit_code == -1
+    assert r.stderr == "Execution timed out"

@@ -81,12 +81,14 @@ async def test_sweep_skips_inflight_writes(storage: Storage, tmp_path):
     assert await storage.exists(w.hash)
 
 
-async def test_read_refreshes_ttl(storage: Storage, tmp_path):
+async def test_read_refreshes_ttl(tmp_path):
     # A session that only restores a file (never rewrites it) must keep it
-    # alive under the TTL sweep: reads mark use.
+    # alive under the TTL sweep: reads mark use. Touch-on-read is opt-in —
+    # enabled by ApplicationContext exactly when a TTL is configured.
     import os
     import time
 
+    storage = Storage(tmp_path / "objects", touch_on_read=True)
     object_id = await storage.write(b"restored every run, never modified")
     past = time.time() - 1000
     os.utime(tmp_path / "objects" / object_id, (past, past))
