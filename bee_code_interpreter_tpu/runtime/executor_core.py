@@ -193,6 +193,14 @@ class ExecutorCore:
         if jax_cache and "JAX_COMPILATION_CACHE_DIR" not in env:
             env["JAX_COMPILATION_CACHE_DIR"] = jax_cache
         env.update(request_env)  # request env wins (reference server.rs:154)
+        # ...except the shim must survive a request-supplied PYTHONPATH: it is
+        # part of the sandbox platform (reroute/display patches), not a
+        # default the request replaces. (BCI_XLA_REROUTE=0 is the opt-out.)
+        if self.shim_dir and self.shim_dir not in env.get("PYTHONPATH", ""):
+            existing = env.get("PYTHONPATH", "")
+            env["PYTHONPATH"] = self.shim_dir + (
+                os.pathsep + existing if existing else ""
+            )
         return env
 
     async def execute(

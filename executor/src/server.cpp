@@ -84,12 +84,90 @@ inline bool is_passthrough_env(const std::string& key) {
   return true;
 }
 
+// Bootstrap for the pre-started interpreter: a warm python (configured
+// imports already loaded) blocked on stdin waiting for its single execution
+// request. Because sandboxes are single-use, one pre-started worker removes
+// interpreter startup + import cost from the request path entirely. The
+// request line carries {script, cwd, env}; request env overlays the worker's
+// startup env (same result as base_env(request_env) on the cold path). The
+// traceback surgery drops the bootstrap's own frame so errors render exactly
+// as `python script.py` would. A ppid watchdog mirrors the server's own
+// (PDEATHSIG is unreliable on some sandboxed kernels): the worker never
+// outlives the server.
+constexpr const char* kPrestartBootstrap = R"PY(
+import json, os, sys, threading
+
+_server_pid = os.getppid()
+def _watch():
+    import time
+    while os.getppid() == _server_pid:
+        time.sleep(2)
+    os._exit(1)
+threading.Thread(target=_watch, daemon=True).start()
+
+# Preload output (import-time warnings, library banners) must not leak into
+# the request's captured stdout/stderr: mute fds 1/2 until the request.
+_saved_out, _saved_err = os.dup(1), os.dup(2)
+_devnull = os.open(os.devnull, os.O_WRONLY)
+os.dup2(_devnull, 1)
+os.dup2(_devnull, 2)
+
+for _m in os.environ.get("APP_PRESTART_IMPORTS", "numpy").split(","):
+    _m = _m.strip()
+    if _m:
+        try:
+            __import__(_m)
+        except Exception:
+            pass
+
+_req = json.loads(sys.stdin.readline())
+os.dup2(_saved_out, 1)
+os.dup2(_saved_err, 2)
+os.close(_saved_out); os.close(_saved_err); os.close(_devnull)
+
+os.environ.update(_req.get("env", {}))
+os.chdir(_req["cwd"])
+# Cold-path sys.path parity: `python script.py` puts the script's directory
+# at [0] (under `python -c` that slot is the cwd — replace it), followed by
+# PYTHONPATH entries — including any the request supplied after this
+# interpreter already started.
+sys.path[0:1] = [os.path.dirname(_req["script"])]
+_idx = 1
+for _p in _req.get("env", {}).get("PYTHONPATH", "").split(os.pathsep):
+    if _p and _p not in sys.path:
+        sys.path.insert(_idx, _p)
+        _idx += 1
+sys.argv = [_req["script"]]
+with open(_req["script"], "rb") as _f:
+    _code = _f.read()
+_g = {
+    "__name__": "__main__",
+    "__file__": _req["script"],
+    "__builtins__": __builtins__,
+    "__doc__": None,
+    "__package__": None,
+    "__spec__": None,
+}
+try:
+    exec(compile(_code, _req["script"], "exec"), _g)
+except SystemExit:
+    raise
+except BaseException:
+    import traceback
+    _tp, _e, _tb = sys.exc_info()
+    traceback.print_exception(_tp, _e, _tb.tb_next)  # drop bootstrap frame
+    sys.exit(1)
+)PY";
+
 struct ExecutorConfig {
   std::string python = env_or("APP_PYTHON", "python3");
   fs::path workspace_root = env_or("APP_WORKSPACE", "/workspace");
   bool disable_dep_install = env_or("APP_DISABLE_DEP_INSTALL", "") == "1";
   double default_timeout_s = std::stod(env_or("APP_EXECUTION_TIMEOUT_S", "60"));
   std::string shim_dir = env_or("APP_SHIM_DIR", "");
+  // Pre-started warm interpreter (APP_PRESTART=0 disables; imports list via
+  // APP_PRESTART_IMPORTS, default "numpy").
+  bool prestart = env_or("APP_PRESTART", "1") == "1";
 };
 
 class Executor {
@@ -104,6 +182,16 @@ class Executor {
     dep_guess::load_requirements_into(
         read_file(env_or("APP_REQUIREMENTS_SKIP", "/requirements-skip.txt")),
         guesser_.preinstalled);
+    if (config_.prestart) {
+      auto env = base_env({});
+      // base_env deliberately excludes APP_* control vars; the preload list
+      // is the one the bootstrap needs.
+      const std::string preload = env_or("APP_PRESTART_IMPORTS", "");
+      if (!preload.empty()) env["APP_PRESTART_IMPORTS"] = preload;
+      prestart_ = subprocess::spawn({config_.python, "-c", kPrestartBootstrap},
+                                    env, config_.workspace_root.string(),
+                                    /*want_stdin=*/true);
+    }
   }
 
   minihttp::Response handle(const minihttp::Request& req) {
@@ -122,14 +210,17 @@ class Executor {
   }
 
   void warmup() {
-    // Pre-heat libtpu/XLA before the pod reports ready.
-    run_python(
-        "try:\n"
-        "    import jax\n"
-        "    jax.numpy.zeros(8).block_until_ready()\n"
-        "except Exception:\n"
-        "    pass\n",
-        {}, 300.0);
+    // Pre-heat libtpu/XLA before the pod reports ready. Runs a dedicated
+    // cold interpreter — it must NOT consume the pre-started worker, whose
+    // point is to stay warm for the actual request.
+    subprocess::run(
+        {config_.python, "-c",
+         "try:\n"
+         "    import jax\n"
+         "    jax.numpy.zeros(8).block_until_ready()\n"
+         "except Exception:\n"
+         "    pass\n"},
+        base_env({}), config_.workspace_root.string(), 300.0);
   }
 
  private:
@@ -228,12 +319,66 @@ class Executor {
       std::ofstream out(script, std::ios::binary);
       out << source;
     }
-    auto result = subprocess::run({config_.python, script.string()},
-                                  base_env(request_env),
-                                  config_.workspace_root.string(), timeout_s);
+
+    subprocess::RunResult result;
+    subprocess::Child worker;
+    {
+      // Claim the pre-started worker (single-use, like the sandbox itself).
+      std::lock_guard<std::mutex> lock(prestart_mutex_);
+      worker = prestart_;
+      prestart_ = {};
+    }
+    bool ran_warm = false;
+    if (worker.valid()) {
+      // alive() reaps via waitpid(WNOHANG) when the worker already died —
+      // after that the pid may be recycled, so never signal it again.
+      const bool was_alive = worker.alive();
+      if (was_alive &&
+          send_prestart_request(worker, script.string(), request_env)) {
+        result = subprocess::collect(worker, timeout_s);
+        ran_warm = true;
+      } else {
+        if (was_alive) {
+          // write failed mid-handshake: kill and reap (blocking is safe —
+          // SIGKILL delivery to our own unwaited child is certain).
+          worker.kill_group();
+          int status = 0;
+          waitpid(worker.pid, &status, 0);
+        }
+        worker.close_fds();
+      }
+    }
+    if (!ran_warm) {
+      result = subprocess::run({config_.python, script.string()},
+                               base_env(request_env),
+                               config_.workspace_root.string(), timeout_s);
+    }
     std::error_code ec;
     fs::remove_all(tmpdir, ec);
     return result;
+  }
+
+  // One JSON line into the warm worker's stdin: {script, cwd, env}.
+  bool send_prestart_request(
+      subprocess::Child& worker, const std::string& script,
+      const std::map<std::string, std::string>& request_env) {
+    minijson::Object env_obj;
+    for (const auto& [k, v] : request_env) env_obj[k] = minijson::Value(v);
+    minijson::Object msg{
+        {"script", minijson::Value(script)},
+        {"cwd", minijson::Value(config_.workspace_root.string())},
+        {"env", minijson::Value(std::move(env_obj))},
+    };
+    std::string line = minijson::dump(minijson::Value(std::move(msg))) + "\n";
+    size_t sent = 0;
+    while (sent < line.size()) {
+      ssize_t n = write(worker.stdin_fd, line.data() + sent, line.size() - sent);
+      if (n <= 0) return false;  // worker gone (SIGPIPE ignored in main)
+      sent += static_cast<size_t>(n);
+    }
+    close(worker.stdin_fd);
+    worker.stdin_fd = -1;
+    return true;
   }
 
   std::map<std::string, std::string> base_env(
@@ -265,6 +410,17 @@ class Executor {
     if (!jax_cache.empty() && !env.count("JAX_COMPILATION_CACHE_DIR"))
       env["JAX_COMPILATION_CACHE_DIR"] = jax_cache;
     for (const auto& [k, v] : request_env) env[k] = v;  // request env wins
+    // ...except the shim must survive a request-supplied PYTHONPATH: it is
+    // part of the sandbox platform (reroute/display patches), not a default
+    // the request replaces. (BCI_XLA_REROUTE=0 is the opt-out.)
+    if (!config_.shim_dir.empty()) {
+      auto it = env.find("PYTHONPATH");
+      if (it == env.end()) {
+        env["PYTHONPATH"] = config_.shim_dir;
+      } else if (it->second.find(config_.shim_dir) == std::string::npos) {
+        it->second = config_.shim_dir + ":" + it->second;
+      }
+    }
     return env;
   }
 
@@ -295,11 +451,17 @@ class Executor {
   std::once_flag stdlib_loaded_;
   std::set<std::string> installed_this_session_;
   std::mutex installed_mutex_;
+  subprocess::Child prestart_;
+  std::mutex prestart_mutex_;
 };
 
 }  // namespace
 
 int main() {
+  // A dead pre-started worker must surface as a failed write (→ cold-path
+  // fallback), not a fatal SIGPIPE.
+  signal(SIGPIPE, SIG_IGN);
+
   // Die with the spawning service (native-process backend). Setting PDEATHSIG
   // here — instead of a Python preexec_fn in the parent — keeps the control
   // plane's Popen on the vfork fast path, so pool refills never block its

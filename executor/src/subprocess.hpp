@@ -31,22 +31,65 @@ struct RunResult {
 
 inline constexpr const char* kTimeoutMessage = "Execution timed out";
 
-// argv: program + args. env: complete child environment.
-inline RunResult run(const std::vector<std::string>& argv,
-                     const std::map<std::string, std::string>& env,
-                     const std::string& cwd,
-                     double timeout_s) {
-  int out_pipe[2], err_pipe[2];
-  if (pipe(out_pipe) != 0 || pipe(err_pipe) != 0)
-    return {"", "pipe() failed", -1, false};
+// A spawned child with captured output (and optionally writable stdin).
+// Returned by spawn(); pass to collect() to stream output until exit.
+struct Child {
+  pid_t pid = -1;
+  int stdin_fd = -1;  // -1 unless want_stdin
+  int out_fd = -1;
+  int err_fd = -1;
+
+  bool valid() const { return pid > 0; }
+
+  bool alive() const {
+    if (pid <= 0) return false;
+    int status = 0;
+    return waitpid(pid, &status, WNOHANG) == 0;
+  }
+
+  void close_fds() {
+    if (stdin_fd >= 0) { close(stdin_fd); stdin_fd = -1; }
+    if (out_fd >= 0) { close(out_fd); out_fd = -1; }
+    if (err_fd >= 0) { close(err_fd); err_fd = -1; }
+  }
+
+  void kill_group() {
+    if (pid > 0) kill(-pid, SIGKILL);
+  }
+};
+
+// Fork+exec into its own process group with stdout/stderr pipes (and stdin
+// pipe when want_stdin). env is the COMPLETE child environment.
+inline Child spawn(const std::vector<std::string>& argv,
+                   const std::map<std::string, std::string>& env,
+                   const std::string& cwd,
+                   bool want_stdin = false) {
+  int out_pipe[2] = {-1, -1}, err_pipe[2] = {-1, -1}, in_pipe[2] = {-1, -1};
+  auto close_all = [&] {
+    for (int fd : {out_pipe[0], out_pipe[1], err_pipe[0], err_pipe[1],
+                   in_pipe[0], in_pipe[1]})
+      if (fd >= 0) close(fd);
+  };
+  if (pipe(out_pipe) != 0 || pipe(err_pipe) != 0 ||
+      (want_stdin && pipe(in_pipe) != 0)) {
+    close_all();
+    return {};
+  }
 
   pid_t pid = fork();
-  if (pid < 0) return {"", "fork() failed", -1, false};
+  if (pid < 0) {
+    close_all();
+    return {};
+  }
   if (pid == 0) {
     // child
     setpgid(0, 0);
     if (!cwd.empty()) {
       if (chdir(cwd.c_str()) != 0) _exit(127);
+    }
+    if (want_stdin) {
+      dup2(in_pipe[0], STDIN_FILENO);
+      close(in_pipe[0]); close(in_pipe[1]);
     }
     dup2(out_pipe[1], STDOUT_FILENO);
     dup2(err_pipe[1], STDERR_FILENO);
@@ -72,8 +115,26 @@ inline RunResult run(const std::vector<std::string>& argv,
   setpgid(pid, pid);  // race-safe double setpgid
   close(out_pipe[1]);
   close(err_pipe[1]);
-  fcntl(out_pipe[0], F_SETFL, O_NONBLOCK);
-  fcntl(err_pipe[0], F_SETFL, O_NONBLOCK);
+  Child child;
+  child.pid = pid;
+  child.out_fd = out_pipe[0];
+  child.err_fd = err_pipe[0];
+  if (want_stdin) {
+    close(in_pipe[0]);
+    child.stdin_fd = in_pipe[1];
+  }
+  fcntl(child.out_fd, F_SETFL, O_NONBLOCK);
+  fcntl(child.err_fd, F_SETFL, O_NONBLOCK);
+  return child;
+}
+
+// Stream the child's output until exit or deadline (timeout → process-group
+// SIGKILL, exit_code -1, stderr replaced with the timeout message).
+inline RunResult collect(Child child, double timeout_s) {
+  if (!child.valid()) return {"", "spawn failed", -1, false};
+  if (child.stdin_fd >= 0) { close(child.stdin_fd); child.stdin_fd = -1; }
+  int out_pipe0 = child.out_fd, err_pipe0 = child.err_fd;
+  pid_t pid = child.pid;
 
   RunResult result;
   auto deadline = std::chrono::steady_clock::now() +
@@ -91,14 +152,14 @@ inline RunResult run(const std::vector<std::string>& argv,
     }
     pollfd fds[2];
     nfds_t nfds = 0;
-    if (out_open) fds[nfds++] = {out_pipe[0], POLLIN, 0};
-    if (err_open) fds[nfds++] = {err_pipe[0], POLLIN, 0};
+    if (out_open) fds[nfds++] = {out_pipe0, POLLIN, 0};
+    if (err_open) fds[nfds++] = {err_pipe0, POLLIN, 0};
     int rc = poll(fds, nfds, static_cast<int>(std::min<long long>(remaining, 1000)));
     if (rc < 0) break;
     for (nfds_t i = 0; i < nfds; ++i) {
       if (!(fds[i].revents & (POLLIN | POLLHUP))) continue;
       ssize_t n = read(fds[i].fd, buf, sizeof buf);
-      bool is_out = fds[i].fd == out_pipe[0];
+      bool is_out = fds[i].fd == out_pipe0;
       if (n > 0) {
         (is_out ? result.out : result.err).append(buf, static_cast<size_t>(n));
       } else if (n == 0 || (n < 0 && errno != EAGAIN)) {
@@ -106,8 +167,8 @@ inline RunResult run(const std::vector<std::string>& argv,
       }
     }
   }
-  close(out_pipe[0]);
-  close(err_pipe[0]);
+  close(out_pipe0);
+  close(err_pipe0);
 
   int status = 0;
   waitpid(pid, &status, 0);
@@ -121,6 +182,14 @@ inline RunResult run(const std::vector<std::string>& argv,
     result.exit_code = -WTERMSIG(status);
   }
   return result;
+}
+
+// argv: program + args. env: complete child environment.
+inline RunResult run(const std::vector<std::string>& argv,
+                     const std::map<std::string, std::string>& env,
+                     const std::string& cwd,
+                     double timeout_s) {
+  return collect(spawn(argv, env, cwd), timeout_s);
 }
 
 }  // namespace subprocess

@@ -29,7 +29,13 @@ def _require_native(native_binary):
 
 
 class NativeExecutor:
-    def __init__(self, workspace: Path, ip: str = "127.0.0.1", port: int | None = None):
+    def __init__(
+        self,
+        workspace: Path,
+        ip: str = "127.0.0.1",
+        port: int | None = None,
+        extra_env: dict[str, str] | None = None,
+    ):
         self.ip = ip
         self.port = port or free_port()
         self.workspace = workspace
@@ -41,6 +47,7 @@ class NativeExecutor:
                 "APP_WORKSPACE": str(workspace),
                 "APP_DISABLE_DEP_INSTALL": "1",
                 "APP_PYPI_MAP": str(EXECUTOR_DIR / "pypi_map.tsv"),
+                **(extra_env or {}),
             },
             stdout=subprocess.PIPE,
             stderr=subprocess.STDOUT,
@@ -221,3 +228,124 @@ async def test_control_plane_against_native_pods(tmp_path, storage):
     finally:
         for s in servers:
             s.stop()
+
+
+def test_warm_worker_traceback_matches_plain_python(native):
+    # The pre-started interpreter's bootstrap frame must never appear in user
+    # tracebacks — errors render exactly as `python script.py` would.
+    r = httpx.post(
+        native.base + "/execute",
+        json={"source_code": "def boom():\n    raise ValueError('xyz')\nboom()"},
+    ).json()
+    assert r["exit_code"] == 1
+    assert "ValueError: xyz" in r["stderr"]
+    assert 'File "<string>"' not in r["stderr"]
+    assert "bootstrap" not in r["stderr"]
+    # frames point at the script, like plain python
+    assert 'in boom' in r["stderr"]
+
+
+def test_consecutive_executes_after_warm_worker_consumed(native):
+    # Request 1 consumes the pre-started worker; request 2 must fall back to
+    # a cold interpreter with identical semantics (sandboxes are single-use
+    # in production, but the server itself must not require that).
+    for expected in ("first", "second", "third"):
+        r = httpx.post(
+            native.base + "/execute",
+            json={"source_code": f"print('{expected}')"},
+        ).json()
+        assert r == {
+            "stdout": f"{expected}\n", "stderr": "", "exit_code": 0, "files": [],
+        }
+
+
+def test_prestart_disabled_parity(tmp_path):
+    server = NativeExecutor(tmp_path / "ws", extra_env={"APP_PRESTART": "0"})
+    try:
+        r = httpx.post(
+            server.base + "/execute",
+            json={
+                "source_code": "import os\nprint(os.environ['X'], 21 * 2)",
+                "env": {"X": "y"},
+            },
+        ).json()
+        assert r == {"stdout": "y 42\n", "stderr": "", "exit_code": 0, "files": []}
+    finally:
+        server.stop()
+
+
+def test_warm_worker_timeout_kill(native):
+    # Timeout enforcement must hold on the pre-started worker path too
+    # (process-group SIGKILL reaches grandchildren).
+    t0 = time.time()
+    r = httpx.post(
+        native.base + "/execute",
+        json={"source_code": "import time\ntime.sleep(60)", "timeout": 1.0},
+        timeout=30,
+    ).json()
+    assert r["exit_code"] == -1
+    assert r["stderr"] == "Execution timed out"
+    assert time.time() - t0 < 20
+
+
+def test_warm_worker_request_pythonpath(native, tmp_path):
+    # Request-env PYTHONPATH must reach imports on the warm path too, even
+    # though the interpreter started before the request arrived.
+    lib = tmp_path / "lib"
+    lib.mkdir()
+    (lib / "reqmod.py").write_text("VALUE = 'from-request-path'\n")
+    r = httpx.post(
+        native.base + "/execute",
+        json={
+            "source_code": "import reqmod\nprint(reqmod.VALUE)",
+            "env": {"PYTHONPATH": str(lib)},
+        },
+    ).json()
+    assert r["stdout"] == "from-request-path\n", r["stderr"]
+
+
+def test_workspace_import_parity_warm_vs_cold(native):
+    # `python script.py` does NOT put the workspace on sys.path (the script
+    # lives in a tempdir); the warm-worker path must behave identically, so
+    # `import helper` fails the same way on request 1 (warm) and 2 (cold).
+    httpx.put(native.base + "/workspace/helper.py", content=b"VALUE = 1\n")
+    results = [
+        httpx.post(
+            native.base + "/execute", json={"source_code": "import helper"}
+        ).json()
+        for _ in range(2)
+    ]
+    for r in results:
+        assert r["exit_code"] == 1
+        assert "ModuleNotFoundError" in r["stderr"]
+
+
+def test_prestart_imports_env_reaches_worker(tmp_path):
+    # APP_PRESTART_IMPORTS must actually reach the warm worker; a module with
+    # an import-time side effect proves it ran at preload, and its noise is
+    # muted out of the request's captured output.
+    lib = tmp_path / "lib"
+    lib.mkdir()
+    (lib / "preloadmark.py").write_text(
+        "import sys\nsys._preloaded_mark = True\nprint('preload noise')\n"
+    )
+    server = NativeExecutor(
+        tmp_path / "ws",
+        extra_env={
+            "APP_PRESTART_IMPORTS": "preloadmark",
+            "PYTHONPATH": str(lib),
+        },
+    )
+    try:
+        r = httpx.post(
+            server.base + "/execute",
+            json={
+                "source_code": "import sys\n"
+                "print(getattr(sys, '_preloaded_mark', False))"
+            },
+        ).json()
+        assert r["stdout"] == "True\n", r
+        assert "preload noise" not in r["stdout"]
+        assert r["stderr"] == ""
+    finally:
+        server.stop()
