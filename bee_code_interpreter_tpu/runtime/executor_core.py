@@ -196,11 +196,13 @@ class ExecutorCore:
         # ...except the shim must survive a request-supplied PYTHONPATH: it is
         # part of the sandbox platform (reroute/display patches), not a
         # default the request replaces. (BCI_XLA_REROUTE=0 is the opt-out.)
-        if self.shim_dir and self.shim_dir not in env.get("PYTHONPATH", ""):
+        # Component comparison, not substring (/opt/shim vs /opt/shim2).
+        if self.shim_dir:
             existing = env.get("PYTHONPATH", "")
-            env["PYTHONPATH"] = self.shim_dir + (
-                os.pathsep + existing if existing else ""
-            )
+            if self.shim_dir not in existing.split(os.pathsep):
+                env["PYTHONPATH"] = self.shim_dir + (
+                    os.pathsep + existing if existing else ""
+                )
         return env
 
     async def execute(

@@ -112,7 +112,7 @@ _devnull = os.open(os.devnull, os.O_WRONLY)
 os.dup2(_devnull, 1)
 os.dup2(_devnull, 2)
 
-for _m in os.environ.get("APP_PRESTART_IMPORTS", "numpy").split(","):
+for _m in os.environ.pop("APP_PRESTART_IMPORTS", "numpy").split(","):
     _m = _m.strip()
     if _m:
         try:
@@ -358,12 +358,19 @@ class Executor {
     return result;
   }
 
-  // One JSON line into the warm worker's stdin: {script, cwd, env}.
+  // One JSON line into the warm worker's stdin: {script, cwd, env}. The
+  // request env gets the same shim-PYTHONPATH merge the cold path's
+  // base_env applies, so grandchildren spawned by user code inherit the
+  // shim identically on both paths.
   bool send_prestart_request(
       subprocess::Child& worker, const std::string& script,
       const std::map<std::string, std::string>& request_env) {
     minijson::Object env_obj;
     for (const auto& [k, v] : request_env) env_obj[k] = minijson::Value(v);
+    if (!config_.shim_dir.empty() && request_env.count("PYTHONPATH")) {
+      env_obj["PYTHONPATH"] =
+          minijson::Value(merge_shim_pythonpath(request_env.at("PYTHONPATH")));
+    }
     minijson::Object msg{
         {"script", minijson::Value(script)},
         {"cwd", minijson::Value(config_.workspace_root.string())},
@@ -415,13 +422,24 @@ class Executor {
     // the request replaces. (BCI_XLA_REROUTE=0 is the opt-out.)
     if (!config_.shim_dir.empty()) {
       auto it = env.find("PYTHONPATH");
-      if (it == env.end()) {
-        env["PYTHONPATH"] = config_.shim_dir;
-      } else if (it->second.find(config_.shim_dir) == std::string::npos) {
-        it->second = config_.shim_dir + ":" + it->second;
-      }
+      env["PYTHONPATH"] =
+          merge_shim_pythonpath(it == env.end() ? "" : it->second);
     }
     return env;
+  }
+
+  // Prepend the shim dir unless it is already a path *component* (substring
+  // matching would be fooled by e.g. /opt/shim vs /opt/shim2).
+  std::string merge_shim_pythonpath(const std::string& value) {
+    if (value.empty()) return config_.shim_dir;
+    size_t start = 0;
+    while (start <= value.size()) {
+      size_t end = value.find(':', start);
+      if (end == std::string::npos) end = value.size();
+      if (value.compare(start, end - start, config_.shim_dir) == 0) return value;
+      start = end + 1;
+    }
+    return config_.shim_dir + ":" + value;
   }
 
   void load_stdlib() {
