@@ -117,6 +117,29 @@ def test_env_passthrough(client):
     assert result["exit_code"] == 0
 
 
+def test_torch_runs_in_sandbox(client):
+    # torch (CPU build here; torch-xla in the TPU image) must work out of the
+    # box — the shim's torch patch only engages when torch_xla is importable.
+    # The local-backend sandbox shares this venv, so importorskip is an exact
+    # availability proxy (CI installs no torch).
+    pytest.importorskip("torch")
+    response = client.post(
+        "/v1/execute",
+        json={
+            "source_code": (
+                "import torch\n"
+                "x = torch.arange(6, dtype=torch.float32).reshape(2, 3)\n"
+                "print(int((x @ x.T).diag().sum().item()))"
+            ),
+            "timeout": 120,
+        },
+    )
+    response.raise_for_status()
+    result = response.json()
+    assert result["exit_code"] == 0, result["stderr"]
+    assert result["stdout"] == "55\n"
+
+
 def test_per_request_timeout(client):
     # New over the reference: its executor had the timeout field but the
     # service never exposed it (server.rs:32). Clamped to the configured max.

@@ -1,0 +1,65 @@
+"""Deployment manifests stay loadable and structurally sound.
+
+The reference ships k8s/local.yaml + k8s/pull.yaml (SURVEY.md §2 "k8s
+manifests"); ours are local.yaml + tpu.yaml. A malformed manifest only
+surfaces at kubectl-apply time in production — catch it in CI instead.
+"""
+
+from pathlib import Path
+
+import pytest
+
+yaml = pytest.importorskip("yaml")  # PyYAML: not a runtime dependency
+
+K8S = Path(__file__).resolve().parent.parent / "k8s"
+
+
+def _docs(name: str) -> list[dict]:
+    return [d for d in yaml.safe_load_all((K8S / name).read_text()) if d]
+
+
+def test_manifests_parse():
+    for name in ("local.yaml", "tpu.yaml"):
+        docs = _docs(name)
+        assert docs, name
+        for doc in docs:
+            assert "kind" in doc and "metadata" in doc, (name, doc)
+
+
+def test_rbac_covers_pod_lifecycle():
+    # The scheduler creates/waits/deletes pods and streams exec/logs; the
+    # Role must allow all of it (reference k8s/local.yaml grants pods +
+    # pods/exec + pods/log with verbs *).
+    for name in ("local.yaml", "tpu.yaml"):
+        roles = [d for d in _docs(name) if d["kind"] == "Role"]
+        assert roles, f"{name}: no Role"
+        rules = roles[0]["rules"]
+        resources = {r for rule in rules for r in rule["resources"]}
+        assert {"pods", "pods/exec", "pods/log"} <= resources, (name, resources)
+        for rule in rules:
+            verbs = set(rule["verbs"])
+            assert "*" in verbs or {"create", "get", "delete", "watch"} <= verbs, (
+                name,
+                verbs,
+            )
+
+
+def test_service_pod_wires_ports_and_storage():
+    for name in ("local.yaml", "tpu.yaml"):
+        pods = [d for d in _docs(name) if d["kind"] == "Pod"]
+        assert pods, f"{name}: no service Pod"
+        container = pods[0]["spec"]["containers"][0]
+        ports = {p["containerPort"] for p in container.get("ports", [])}
+        assert {50051, 50081} <= ports, (name, ports)
+        env = {e["name"]: e.get("value") for e in container.get("env", [])}
+        assert "APP_FILE_STORAGE_PATH" in env, name
+
+
+def test_tpu_manifest_sets_slice_topology():
+    pods = [d for d in _docs("tpu.yaml") if d["kind"] == "Pod"]
+    env = {
+        e["name"]: e.get("value")
+        for e in pods[0]["spec"]["containers"][0].get("env", [])
+    }
+    assert "APP_EXECUTOR_IMAGE" in env
+    assert any(k.startswith("APP_TPU_") for k in env), env
