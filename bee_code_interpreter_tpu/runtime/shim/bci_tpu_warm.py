@@ -1,0 +1,28 @@
+"""Preload module for the TPU executor image: warm XLA client in the sandbox.
+
+Listed in ``APP_PRESTART_IMPORTS`` (executor/Dockerfile) so the pre-started
+worker doesn't just import numpy — it brings the pod's TPU all the way up
+(jax import, libtpu init, device enumeration, one tiny compiled dispatch)
+while the sandbox sits warm in the pool. The pod owns its chips exclusively
+and is single-use, so holding the initialized client until the request
+arrives wastes nothing — and the request's first ``jax`` (or rerouted numpy)
+op starts on a live backend instead of paying multi-second libtpu init.
+
+This realizes SURVEY.md §2's native-checklist item: "keeps a warm XLA client
+so first-touch compile latency isn't paid per request".
+
+Trade-off (documented in docs/configuration.md): backend-affecting request
+env (e.g. ``JAX_PLATFORMS``) is ignored on the warm path once the backend is
+initialized. Deployments that need per-request platform switching should
+drop this module from APP_PRESTART_IMPORTS or set APP_PRESTART=0.
+
+Import errors are swallowed by the bootstrap's preload loop, so listing this
+module on a host without TPU/jax is harmless.
+"""
+
+import jax
+
+# Initialize the backend and keep it held; a trivial dispatch also warms the
+# compile/executable caches' hot paths (not any real program's compilation).
+_devices = jax.devices()
+jax.numpy.zeros(8).block_until_ready()

@@ -112,6 +112,22 @@ _devnull = os.open(os.devnull, os.O_WRONLY)
 os.dup2(_devnull, 1)
 os.dup2(_devnull, 2)
 
+# A hung preload (e.g. accelerator init against an unreachable TPU) must not
+# convert every request into an execution timeout: past the deadline the
+# worker exits (never having written the started byte on fd 3) and the
+# server runs the request cold.
+_preload_done = threading.Event()
+try:
+    _preload_deadline = float(
+        os.environ.pop("APP_PRESTART_PRELOAD_TIMEOUT_S", "") or "45"
+    )
+except ValueError:
+    _preload_deadline = 45.0
+def _preload_guard():
+    if not _preload_done.wait(_preload_deadline):
+        os._exit(113)
+threading.Thread(target=_preload_guard, daemon=True).start()
+
 for _m in os.environ.pop("APP_PRESTART_IMPORTS", "numpy").split(","):
     _m = _m.strip()
     if _m:
@@ -119,8 +135,16 @@ for _m in os.environ.pop("APP_PRESTART_IMPORTS", "numpy").split(","):
             __import__(_m)
         except Exception:
             pass
+_preload_done.set()
 
 _req = json.loads(sys.stdin.readline())
+# Started byte on the status pipe: the server now knows user code WILL run,
+# so it must never cold-retry this request (side effects would double).
+try:
+    os.write(3, b"S")
+    os.close(3)
+except OSError:
+    pass
 os.dup2(_saved_out, 1)
 os.dup2(_saved_err, 2)
 os.close(_saved_out); os.close(_saved_err); os.close(_devnull)
@@ -188,9 +212,19 @@ class Executor {
       // is the one the bootstrap needs.
       const std::string preload = env_or("APP_PRESTART_IMPORTS", "");
       if (!preload.empty()) env["APP_PRESTART_IMPORTS"] = preload;
+      const std::string preload_timeout = env_or("APP_PRESTART_PRELOAD_TIMEOUT_S", "");
+      if (!preload_timeout.empty())
+        env["APP_PRESTART_PRELOAD_TIMEOUT_S"] = preload_timeout;
       prestart_ = subprocess::spawn({config_.python, "-c", kPrestartBootstrap},
                                     env, config_.workspace_root.string(),
-                                    /*want_stdin=*/true);
+                                    /*want_stdin=*/true, /*want_status=*/true);
+      prestart_spawned_at_ = std::chrono::steady_clock::now();
+      const char* pt = getenv("APP_PRESTART_PRELOAD_TIMEOUT_S");
+      if (pt) {
+        char* end = nullptr;
+        double v = strtod(pt, &end);
+        if (end != pt && v > 0) preload_deadline_s_ = v;
+      }
     }
   }
 
@@ -329,18 +363,47 @@ class Executor {
       prestart_ = {};
     }
     bool ran_warm = false;
+    double remaining_s = timeout_s;
     if (worker.valid()) {
       // alive() reaps via waitpid(WNOHANG) when the worker already died —
       // after that the pid may be recycled, so never signal it again.
       const bool was_alive = worker.alive();
+      bool kill_worker = false;
       if (was_alive &&
           send_prestart_request(worker, script.string(), request_env)) {
-        result = subprocess::collect(worker, timeout_s);
-        ran_warm = true;
+        // Phase 1: wait for the started byte — written right before user
+        // code runs, so its presence/absence tells us EXACTLY whether a
+        // cold retry is safe (no exit-code heuristics, no double-running
+        // side effects). Waiting is bounded by the preload guard's own
+        // remaining deadline (plus grace), never past the request budget.
+        const double since_spawn =
+            std::chrono::duration<double>(std::chrono::steady_clock::now() -
+                                          prestart_spawned_at_)
+                .count();
+        const double guard_remaining =
+            std::max(0.0, preload_deadline_s_ - since_spawn) + 2.0;
+        const auto t0 = std::chrono::steady_clock::now();
+        if (subprocess::wait_for_status_byte(
+                worker.status_fd, std::min(timeout_s, guard_remaining))) {
+          close(worker.status_fd);
+          worker.status_fd = -1;
+          result = subprocess::collect(worker, timeout_s);
+          ran_warm = true;
+        } else {
+          // preload never finished: cold-retry with the remaining budget
+          remaining_s = std::max(
+              0.5, timeout_s - std::chrono::duration<double>(
+                                   std::chrono::steady_clock::now() - t0)
+                                   .count());
+          kill_worker = true;
+        }
       } else {
-        if (was_alive) {
-          // write failed mid-handshake: kill and reap (blocking is safe —
-          // SIGKILL delivery to our own unwaited child is certain).
+        kill_worker = was_alive;
+      }
+      if (!ran_warm) {
+        if (kill_worker) {
+          // kill and reap (blocking is safe — SIGKILL delivery to our own
+          // unwaited child is certain).
           worker.kill_group();
           int status = 0;
           waitpid(worker.pid, &status, 0);
@@ -351,7 +414,7 @@ class Executor {
     if (!ran_warm) {
       result = subprocess::run({config_.python, script.string()},
                                base_env(request_env),
-                               config_.workspace_root.string(), timeout_s);
+                               config_.workspace_root.string(), remaining_s);
     }
     std::error_code ec;
     fs::remove_all(tmpdir, ec);
@@ -471,6 +534,8 @@ class Executor {
   std::mutex installed_mutex_;
   subprocess::Child prestart_;
   std::mutex prestart_mutex_;
+  std::chrono::steady_clock::time_point prestart_spawned_at_;
+  double preload_deadline_s_ = 45.0;
 };
 
 }  // namespace

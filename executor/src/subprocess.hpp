@@ -35,7 +35,8 @@ inline constexpr const char* kTimeoutMessage = "Execution timed out";
 // Returned by spawn(); pass to collect() to stream output until exit.
 struct Child {
   pid_t pid = -1;
-  int stdin_fd = -1;  // -1 unless want_stdin
+  int stdin_fd = -1;   // -1 unless want_stdin
+  int status_fd = -1;  // -1 unless want_status (child writes on its fd 3)
   int out_fd = -1;
   int err_fd = -1;
 
@@ -49,6 +50,7 @@ struct Child {
 
   void close_fds() {
     if (stdin_fd >= 0) { close(stdin_fd); stdin_fd = -1; }
+    if (status_fd >= 0) { close(status_fd); status_fd = -1; }
     if (out_fd >= 0) { close(out_fd); out_fd = -1; }
     if (err_fd >= 0) { close(err_fd); err_fd = -1; }
   }
@@ -58,20 +60,47 @@ struct Child {
   }
 };
 
-// Fork+exec into its own process group with stdout/stderr pipes (and stdin
-// pipe when want_stdin). env is the COMPLETE child environment.
+// Block up to timeout_s for one byte on a status fd. True iff a byte arrived;
+// false on EOF (writer died without reporting) or deadline.
+inline bool wait_for_status_byte(int fd, double timeout_s) {
+  if (fd < 0) return false;
+  auto deadline = std::chrono::steady_clock::now() +
+                  std::chrono::duration<double>(timeout_s);
+  while (true) {
+    auto remaining = std::chrono::duration_cast<std::chrono::milliseconds>(
+                         deadline - std::chrono::steady_clock::now())
+                         .count();
+    if (remaining <= 0) return false;
+    pollfd p{fd, POLLIN, 0};
+    int rc = poll(&p, 1, static_cast<int>(std::min<long long>(remaining, 1000)));
+    if (rc < 0) return false;
+    if (p.revents & (POLLIN | POLLHUP)) {
+      char b;
+      ssize_t n = read(fd, &b, 1);
+      if (n == 1) return true;
+      if (n == 0) return false;  // EOF: writer exited silently
+      if (errno != EAGAIN && errno != EINTR) return false;
+    }
+  }
+}
+
+// Fork+exec into its own process group with stdout/stderr pipes (and stdin /
+// status pipes when requested). env is the COMPLETE child environment.
 inline Child spawn(const std::vector<std::string>& argv,
                    const std::map<std::string, std::string>& env,
                    const std::string& cwd,
-                   bool want_stdin = false) {
-  int out_pipe[2] = {-1, -1}, err_pipe[2] = {-1, -1}, in_pipe[2] = {-1, -1};
+                   bool want_stdin = false,
+                   bool want_status = false) {
+  int out_pipe[2] = {-1, -1}, err_pipe[2] = {-1, -1}, in_pipe[2] = {-1, -1},
+      status_pipe[2] = {-1, -1};
   auto close_all = [&] {
     for (int fd : {out_pipe[0], out_pipe[1], err_pipe[0], err_pipe[1],
-                   in_pipe[0], in_pipe[1]})
+                   in_pipe[0], in_pipe[1], status_pipe[0], status_pipe[1]})
       if (fd >= 0) close(fd);
   };
   if (pipe(out_pipe) != 0 || pipe(err_pipe) != 0 ||
-      (want_stdin && pipe(in_pipe) != 0)) {
+      (want_stdin && pipe(in_pipe) != 0) ||
+      (want_status && pipe(status_pipe) != 0)) {
     close_all();
     return {};
   }
@@ -95,6 +124,13 @@ inline Child spawn(const std::vector<std::string>& argv,
     dup2(err_pipe[1], STDERR_FILENO);
     close(out_pipe[0]); close(out_pipe[1]);
     close(err_pipe[0]); close(err_pipe[1]);
+    if (want_status) {
+      // AFTER the other pipes are dup2'd+closed: fd 3 may have been one of
+      // their descriptor numbers, and closing them would clobber it.
+      dup2(status_pipe[1], 3);
+      if (status_pipe[0] != 3) close(status_pipe[0]);
+      if (status_pipe[1] != 3) close(status_pipe[1]);
+    }
     std::vector<char*> cargv;
     for (const auto& a : argv) cargv.push_back(const_cast<char*>(a.c_str()));
     cargv.push_back(nullptr);
@@ -123,6 +159,11 @@ inline Child spawn(const std::vector<std::string>& argv,
     close(in_pipe[0]);
     child.stdin_fd = in_pipe[1];
   }
+  if (want_status) {
+    close(status_pipe[1]);
+    child.status_fd = status_pipe[0];
+    fcntl(child.status_fd, F_SETFL, O_NONBLOCK);
+  }
   fcntl(child.out_fd, F_SETFL, O_NONBLOCK);
   fcntl(child.err_fd, F_SETFL, O_NONBLOCK);
   return child;
@@ -133,6 +174,7 @@ inline Child spawn(const std::vector<std::string>& argv,
 inline RunResult collect(Child child, double timeout_s) {
   if (!child.valid()) return {"", "spawn failed", -1, false};
   if (child.stdin_fd >= 0) { close(child.stdin_fd); child.stdin_fd = -1; }
+  if (child.status_fd >= 0) { close(child.status_fd); child.status_fd = -1; }
   int out_pipe0 = child.out_fd, err_pipe0 = child.err_fd;
   pid_t pid = child.pid;
 

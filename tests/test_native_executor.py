@@ -6,6 +6,7 @@ import asyncio
 import json
 import socket
 import subprocess
+import sys
 import time
 from pathlib import Path
 
@@ -347,5 +348,105 @@ def test_prestart_imports_env_reaches_worker(tmp_path):
         assert r["stdout"] == "True\n", r
         assert "preload noise" not in r["stdout"]
         assert r["stderr"] == ""
+    finally:
+        server.stop()
+
+
+def test_tpu_warm_preload_initializes_backend(tmp_path):
+    # bci_tpu_warm in APP_PRESTART_IMPORTS brings the XLA backend up inside
+    # the warm worker before the request arrives (CPU backend here; the TPU
+    # image points it at the pod's chips). The executed code proves both that
+    # the preload ran (module already in sys.modules) and that the backend
+    # was initialized ahead of user code.
+    server = NativeExecutor(
+        tmp_path / "ws",
+        extra_env={
+            "APP_PYTHON": sys.executable,  # the interpreter that has jax
+            "APP_PRESTART_IMPORTS": "numpy,bci_tpu_warm",
+            "APP_SHIM_DIR": str(
+                REPO / "bee_code_interpreter_tpu" / "runtime" / "shim"
+            ),
+            "HOME": str(tmp_path),
+            "JAX_PLATFORMS": "cpu",
+        },
+    )
+    try:
+        r = httpx.post(
+            server.base + "/execute",
+            json={
+                "source_code": (
+                    "import sys\n"
+                    "print('bci_tpu_warm' in sys.modules)\n"
+                    "import jax\n"
+                    "from jax._src import xla_bridge\n"
+                    "print(bool(xla_bridge._backends))\n"
+                    "print(jax.devices()[0].platform)"
+                ),
+                "timeout": 120,
+            },
+            timeout=130,
+        ).json()
+        assert r["stdout"] == "True\nTrue\ncpu\n", (r["stdout"], r["stderr"][-400:])
+    finally:
+        server.stop()
+
+
+def test_hung_preload_falls_back_cold(tmp_path):
+    # A preload that never finishes (unreachable accelerator) must not turn
+    # every request into an execution timeout: the guard kills the worker at
+    # the deadline and the request runs on the cold path instead.
+    lib = tmp_path / "lib"
+    lib.mkdir()
+    (lib / "hangmod.py").write_text("import time\ntime.sleep(3600)\n")
+    server = NativeExecutor(
+        tmp_path / "ws",
+        extra_env={
+            "APP_PRESTART_IMPORTS": "hangmod",
+            "APP_PRESTART_PRELOAD_TIMEOUT_S": "1",
+            "PYTHONPATH": str(lib),
+        },
+    )
+    try:
+        t0 = time.time()
+        r = httpx.post(
+            server.base + "/execute",
+            json={"source_code": "print('survived')", "timeout": 30},
+            timeout=60,
+        ).json()
+        assert r["stdout"] == "survived\n", r
+        assert r["exit_code"] == 0
+        assert time.time() - t0 < 25
+    finally:
+        server.stop()
+
+
+def test_hung_preload_mid_request_falls_back_cold(tmp_path):
+    # The harder variant: the request is handed to the worker BEFORE the
+    # preload guard fires. The started-byte protocol tells the server user
+    # code never ran, so the cold retry is safe, bounded by the remaining
+    # request budget.
+    lib = tmp_path / "lib"
+    lib.mkdir()
+    (lib / "hangmod2.py").write_text("import time\ntime.sleep(3600)\n")
+    server = NativeExecutor(
+        tmp_path / "ws",
+        extra_env={
+            "APP_PRESTART_IMPORTS": "hangmod2",
+            "APP_PRESTART_PRELOAD_TIMEOUT_S": "6",
+            "PYTHONPATH": str(lib),
+        },
+    )
+    try:
+        t0 = time.time()
+        r = httpx.post(
+            server.base + "/execute",
+            json={"source_code": "print('survived-midflight')", "timeout": 30},
+            timeout=60,
+        ).json()
+        elapsed = time.time() - t0
+        assert r["stdout"] == "survived-midflight\n", r
+        assert r["exit_code"] == 0
+        # waited out the guard (~6s from server start), then ran cold
+        assert elapsed < 25, elapsed
     finally:
         server.stop()
