@@ -173,14 +173,50 @@ class KubernetesCodeExecutor(ExecutorHttpDriver):
     @asynccontextmanager
     async def executor_pod_group(self):
         """Pop a warm group or spawn one; single-use teardown + async refill
-        (reference executor_pod ctx-mgr :248-264)."""
-        group = self._queue.popleft() if self._queue else await self.spawn_pod_group()
+        (reference executor_pod ctx-mgr :248-264).
+
+        Preemption-aware (SURVEY.md §5: v5e pods are preemptible): a popped
+        group is health-probed before use — a group whose pod was preempted or
+        OOM-killed while queued is torn down and skipped instead of burning a
+        request attempt on it.
+        """
+        group = None
+        while group is None:
+            if not self._queue:
+                group = await self.spawn_pod_group()  # freshly Ready: trust it
+                break
+            candidate = self._queue.popleft()
+            if await self._group_healthy(candidate):
+                group = candidate
+            else:
+                logger.warning(
+                    "Warm pod group %s unhealthy (preempted?); discarding",
+                    candidate.name,
+                )
+                for pod_name in candidate.pod_names:
+                    self._spawn_background(self._delete_pod(pod_name))
         self._spawn_background(self.fill_executor_pod_queue())
         try:
             yield group
         finally:
             for pod_name in group.pod_names:
                 self._spawn_background(self._delete_pod(pod_name))
+
+    async def _group_healthy(self, group: PodGroup) -> bool:
+        """Every worker answers /healthz (sub-second; runs on the pod network)."""
+
+        async def probe(ip: str) -> bool:
+            try:
+                response = await self._http.get(
+                    f"http://{ip}:{self._config.executor_port}/healthz",
+                    timeout=2.0,
+                )
+                return response.status_code == 200
+            except httpx.HTTPError:
+                return False
+
+        results = await asyncio.gather(*(probe(ip) for ip in group.pod_ips))
+        return all(results)
 
     def _spawn_background(self, coro) -> None:
         task = asyncio.ensure_future(coro)

@@ -217,8 +217,19 @@ class NativeProcessCodeExecutor(ExecutorHttpDriver):
 
     @asynccontextmanager
     async def sandbox(self):
-        """Pop a warm server or spawn one; single-use teardown + async refill."""
-        box = self._queue.popleft() if self._queue else await self.spawn_sandbox()
+        """Pop a warm server or spawn one; single-use teardown + async refill.
+        A sandbox whose process died while queued (OOM, crash) is discarded,
+        not handed to a request."""
+        box = None
+        while self._queue:
+            candidate = self._queue.popleft()
+            if candidate.proc.poll() is None:
+                box = candidate
+                break
+            logger.warning("Warm sandbox on %s died in queue; discarding", candidate.addr)
+            candidate.destroy()
+        if box is None:
+            box = await self.spawn_sandbox()
         self._spawn_background(self.fill_sandbox_queue())
         try:
             yield box

@@ -57,6 +57,12 @@ class FakeExecutorPods:
         self.cores[ip] = core
         return ip
 
+    async def stop_pod(self, ip: str) -> None:
+        """Simulate preemption: the pod's server vanishes mid-pool."""
+        runner = self._runners.pop(ip, None)
+        if runner is not None:
+            await runner.cleanup()
+
     async def close(self) -> None:
         for runner in self._runners.values():
             await runner.cleanup()

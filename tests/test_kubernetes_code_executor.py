@@ -158,3 +158,26 @@ async def test_tpu_pod_spec(pods, storage):
         assert env["TPU_TOPOLOGY"] == "2x4"
     finally:
         await pods.close()
+
+
+async def test_preempted_warm_group_discarded_not_used(pods, storage):
+    # SURVEY.md §5 (TPU build addition): v5e pods are preemptible. A warm
+    # group whose pod vanished while queued must be health-probed out of the
+    # pool and the request served by a healthy group — not burned as a failed
+    # attempt.
+    executor = make_executor(pods, storage)
+    kubectl = executor._kubectl
+    try:
+        await executor.fill_executor_pod_queue()
+        assert len(executor._queue) == 2
+        victim = executor._queue[0]
+        await pods.stop_pod(victim.pod_ips[0])  # "preemption"
+
+        result = await executor.execute("print('still served')")
+        assert result.stdout == "still served\n"
+        assert result.exit_code == 0
+        await drain_tasks()
+        # the preempted group was torn down, not reused
+        assert victim.pod_names[0] in kubectl.deleted
+    finally:
+        await pods.close()

@@ -221,3 +221,33 @@ async def test_concurrent_executes_pool_accounting(storage, tmp_path, native_bin
     # synchronously; no watchdog delay involved)
     for box in warm_boxes:
         assert box.proc.poll() is not None
+
+
+async def test_dead_warm_sandbox_discarded(storage, tmp_path, native_binary):
+    # A sandbox whose server process died while queued (OOM/crash) must be
+    # skipped, and the request served by a live one.
+    from bee_code_interpreter_tpu.services.native_process_code_executor import (
+        NativeProcessCodeExecutor,
+    )
+
+    config = Config(
+        file_storage_path=str(tmp_path / "objects"),
+        local_workspace_root=str(tmp_path / "ws"),
+        executor_pod_queue_target_length=2,
+        disable_dep_install=True,
+        shim_dir="none",
+    )
+    executor = NativeProcessCodeExecutor(
+        storage=storage, config=config, binary=native_binary
+    )
+    try:
+        await executor.fill_sandbox_queue()
+        victim = executor._queue[0]
+        victim.proc.kill()
+        victim.proc.wait()
+
+        r = await executor.execute("print('alive path')")
+        assert r.stdout == "alive path\n"
+        assert r.exit_code == 0
+    finally:
+        executor.shutdown()
