@@ -47,18 +47,30 @@ TPU_PASSTHROUGH_PREFIXES = (
 )
 
 # Kubernetes service links (enableServiceLinks) auto-inject FOO_SERVICE_HOST /
-# FOO_PORT_80_TCP-style vars for every Service in the namespace; a Service
-# named tpu-* or jax-* would land inside the prefixes above and leak cluster
-# addresses into untrusted user code. Filter that shape back out.
-_K8S_SERVICE_LINK_MARKERS = ("_SERVICE_", "_PORT_")
+# FOO_PORT / FOO_PORT_80_TCP-style vars for every Service in the namespace; a
+# Service named tpu-* or jax-* would land inside the prefixes above and leak
+# cluster addresses into untrusted user code. But real accelerator topology
+# vars share the port-suffix shape (libtpu's TPU_PROCESS_PORT, multi-slice
+# MEGASCALE_PORT) — filtering on suffix alone silently strands the sandbox on
+# host CPU, the exact failure this passthrough exists to prevent. So port-
+# shaped keys are dropped only when the definitive service-link signature is
+# present: a sibling FOO_SERVICE_HOST in the same environment (k8s always
+# injects the pair together; libtpu never sets *_SERVICE_HOST).
 
 
-def _is_passthrough_env(key: str) -> bool:
-    return (
-        key.startswith(TPU_PASSTHROUGH_PREFIXES)
-        and not key.endswith("_PORT")
-        and not any(m in key for m in _K8S_SERVICE_LINK_MARKERS)
-    )
+def _is_passthrough_env(key: str, environ=None) -> bool:
+    if not key.startswith(TPU_PASSTHROUGH_PREFIXES):
+        return False
+    if "_SERVICE_" in key:
+        return False
+    if key.endswith("_PORT"):
+        base = key[:-len("_PORT")]
+    elif "_PORT_" in key:
+        base = key[: key.index("_PORT_")]
+    else:
+        return True
+    env = os.environ if environ is None else environ
+    return f"{base}_SERVICE_HOST" not in env
 
 EXECUTION_TIMED_OUT = "Execution timed out"
 

@@ -22,6 +22,13 @@ import sys
 
 _patched = set()
 _original_import = builtins.__import__
+# True while the image's own (shadowed) sitecustomize executes: imports it
+# performs are platform infrastructure (plugin registration often pulls in
+# numpy), not the user "importing numpy" — patching then would (a) install the
+# reroute before the request env is even visible and (b) wrap numpy for
+# processes that never use it. Defer: the module stays in sys.modules and gets
+# patched at the first post-site import statement instead.
+_deferring = False
 
 
 def _patch_numpy(numpy):
@@ -120,6 +127,8 @@ _PATCHES = {
 
 def _import(name, globals=None, locals=None, fromlist=(), level=0):
     module = _original_import(name, globals, locals, fromlist, level)
+    if _deferring:
+        return module
     for target, patch in _PATCHES.items():
         if target in _patched or target not in sys.modules:
             continue
@@ -152,6 +161,7 @@ def _chain_load_next_sitecustomize():
     import importlib.util
     import os
 
+    global _deferring
     here = os.path.dirname(os.path.abspath(__file__))
     for entry in sys.path:
         try:
@@ -163,6 +173,7 @@ def _chain_load_next_sitecustomize():
         except OSError:
             continue
         try:
+            _deferring = True
             spec = importlib.util.spec_from_file_location(
                 "_chained_sitecustomize", candidate
             )
@@ -170,6 +181,8 @@ def _chain_load_next_sitecustomize():
             spec.loader.exec_module(module)
         except Exception:
             pass
+        finally:
+            _deferring = False
         break  # only the first shadowed one, matching Python's own behavior
 
 

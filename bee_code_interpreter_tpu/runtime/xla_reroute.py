@@ -23,18 +23,44 @@ compute transparently lands on the attached TPU:
 Nothing here imports jax at interpreter startup: wrappers are installed by an
 import hook (see shim/sitecustomize.py) and jax loads lazily on the first
 large-array hit. Set ``BCI_XLA_REROUTE=0`` to disable, or
-``BCI_XLA_REROUTE_MIN_ELEMS`` to tune the threshold.
+``BCI_XLA_REROUTE_MIN_ELEMS`` to tune the threshold. Both are re-read at
+**call time**, not only at install time: a warm (pre-started) sandbox installs
+the proxies before the request env is applied, and user code that sets the
+flag after numpy is already imported must still get the documented opt-out.
+
+The first device placement is guarded by a backend-init watchdog
+(``BCI_XLA_INIT_TIMEOUT_S``, default 30s): if jax's backend cannot come up in
+time — e.g. a platform plugin blocking on an unreachable accelerator tunnel —
+the reroute permanently falls back to host numpy instead of hanging the user's
+script. That IS the module's "graceful fallback" promise applied to the
+backend itself.
 """
 
 from __future__ import annotations
 
 import os
+import threading
 from typing import Any, Callable
 
-_MIN_ELEMS = int(os.environ.get("BCI_XLA_REROUTE_MIN_ELEMS", str(1 << 20)))
+_DEFAULT_MIN_ELEMS = 1 << 20
 
 _jnp = None
 _np = None
+
+
+def _enabled() -> bool:
+    """Per-call opt-out check — see module docstring for why not install-time."""
+    return os.environ.get("BCI_XLA_REROUTE", "1") != "0"
+
+
+def _min_elems() -> int:
+    raw = os.environ.get("BCI_XLA_REROUTE_MIN_ELEMS")
+    if raw:
+        try:
+            return int(raw)
+        except ValueError:
+            pass
+    return _DEFAULT_MIN_ELEMS
 
 
 def _jax_numpy():
@@ -69,9 +95,64 @@ def _eligible(value: Any) -> bool:
     np = _numpy()
     return (
         isinstance(value, np.ndarray)
-        and value.size >= _MIN_ELEMS
+        and value.size >= _min_elems()
         and str(value.dtype) in _REROUTE_DTYPES
     )
+
+
+# None = not yet probed, True = backend usable, False = init failed/timed out
+# (reroute then stays on host numpy for the life of the process).
+_backend_state: bool | None = None
+_backend_lock = threading.Lock()
+
+
+def _backend_ok() -> bool:
+    """One-time watchdogged jax backend probe.
+
+    jax backend init is the one step the reroute cannot survive failing
+    mid-expression: a platform plugin that hooks init and blocks on an
+    unreachable device (observed: a TPU tunnel plugin activating even under
+    JAX_PLATFORMS=cpu) would turn "transparent acceleration" into a silent
+    multi-minute hang. Probe it once on a daemon thread with a deadline; on
+    timeout or error, disable rerouting permanently and let every entry point
+    fall through to host numpy.
+    """
+    global _backend_state
+    if _backend_state is not None:
+        return _backend_state
+    with _backend_lock:
+        if _backend_state is not None:
+            return _backend_state
+        # Default 30s: comfortably above a healthy cold TPU init (~10-20s)
+        # but well under the default 60s execution timeout, so a wedged
+        # backend still leaves the user's script time to finish on host.
+        try:
+            timeout_s = float(os.environ.get("BCI_XLA_INIT_TIMEOUT_S", "30"))
+        except ValueError:
+            timeout_s = 30.0
+        outcome: list[bool] = []
+
+        def probe() -> None:
+            try:
+                # jax's import chain registers dtypes against the *real*
+                # numpy entry points (see _jax_numpy) — this probe is usually
+                # the process's first jax import, so the same pristine guard
+                # applies here.
+                with _pristine_numpy():
+                    import jax
+
+                    jax.devices()
+                outcome.append(True)
+            except Exception:
+                outcome.append(False)
+
+        thread = threading.Thread(
+            target=probe, name="bci-xla-init-probe", daemon=True
+        )
+        thread.start()
+        thread.join(timeout_s)
+        _backend_state = bool(outcome and outcome[0])
+    return _backend_state
 
 
 def _to_device(value: Any):
@@ -347,7 +428,14 @@ class _EntryProxy:
         object.__setattr__(self, "_name", name)
 
     def __call__(self, *args, **kwargs):
-        if any(_eligible(a) for a in args) and not kwargs.get("out"):
+        # _backend_ok() last: small/ineligible calls must never pay (or hang
+        # on) backend init, and a disabled reroute must not probe at all.
+        if (
+            _enabled()
+            and any(_eligible(a) for a in args)
+            and not kwargs.get("out")
+            and _backend_ok()
+        ):
             fn = getattr(_jax_numpy(), self._name, None)
             if fn is not None:
                 try:
@@ -412,7 +500,7 @@ class _CreationProxy:
 
     def __call__(self, *args, **kwargs):
         host = self.__wrapped__(*args, **kwargs)
-        if _eligible(host):
+        if _enabled() and _eligible(host) and _backend_ok():
             try:
                 return TpuArray(_to_device(host))
             except Exception:
@@ -436,8 +524,13 @@ class _CreationProxy:
 
 
 def install(numpy_module=None) -> bool:
-    """Patch the numpy module's entry points. Idempotent. Returns success."""
-    if os.environ.get("BCI_XLA_REROUTE", "1") == "0":
+    """Patch the numpy module's entry points. Idempotent. Returns success.
+
+    Note the proxies re-check ``BCI_XLA_REROUTE`` on every call, so installing
+    while the flag is off would be harmless — but honoring it here too keeps
+    the explicitly-opted-out interpreter entirely proxy-free.
+    """
+    if not _enabled():
         return False
     np = numpy_module
     if np is None:
@@ -465,3 +558,33 @@ def install(numpy_module=None) -> bool:
                 setattr(random_module, name, _CreationProxy(original, host_first=True))
     np.__bci_xla_rerouted__ = True
     return True
+
+
+def uninstall(numpy_module=None) -> None:
+    """Restore every proxied numpy entry point to the original callable.
+
+    The complement ``install()`` never had: a warm sandbox whose request env
+    sets ``BCI_XLA_REROUTE=0`` can now fully de-proxy numpy (the bootstrap
+    calls this after applying the request env) instead of relying solely on
+    the proxies' per-call flag check.
+    """
+    np = numpy_module
+    if np is None:
+        np = _np
+    if np is None:
+        import sys
+
+        np = sys.modules.get("numpy")
+    if np is None or not getattr(np, "__bci_xla_rerouted__", False):
+        return
+    for name in ENTRY_POINTS + CREATION_FUNCS:
+        current = getattr(np, name, None)
+        if isinstance(current, (_EntryProxy, _CreationProxy)):
+            setattr(np, name, current.__wrapped__)
+    random_module = getattr(np, "random", None)
+    if random_module is not None:
+        for name in RANDOM_FUNCS:
+            current = getattr(random_module, name, None)
+            if isinstance(current, _CreationProxy):
+                setattr(random_module, name, current.__wrapped__)
+    np.__bci_xla_rerouted__ = False

@@ -1,0 +1,36 @@
+"""Scrub accelerator-tunnel plugin env vars before a CPU-only jax init.
+
+Single source of truth for the PALLAS_*/AXON_* scrub that tests/conftest.py,
+bench.py, and __graft_entry__.py all need (round-1 postmortem: these vars make
+a TPU tunnel plugin hook jax backend init even under JAX_PLATFORMS=cpu and
+block on a single-client tunnel — rc=124 in MULTICHIP_r01.json). One copy
+means a newly discovered plugin prefix is added exactly once.
+
+__graft_entry__.py keeps a standalone inline copy by design: the driver may
+import it before this package is on sys.path.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import MutableMapping
+
+# Env prefixes owned by accelerator-tunnel platform plugins (not by jax or
+# libtpu themselves): their presence alone activates the plugin's backend
+# hook, so a process pinned to CPU must drop them entirely.
+TUNNEL_PLUGIN_PREFIXES = ("PALLAS_", "AXON_")
+
+
+def scrub_tunnel_plugin_vars(
+    environ: MutableMapping[str, str] | None = None,
+) -> list[str]:
+    """Remove tunnel-plugin vars from ``environ`` (default: os.environ).
+
+    Returns the removed keys (useful for logging/tests). Must run before the
+    first jax backend touch to have any effect.
+    """
+    env = os.environ if environ is None else environ
+    removed = [k for k in env if k.startswith(TUNNEL_PLUGIN_PREFIXES)]
+    for key in removed:
+        env.pop(key)
+    return removed

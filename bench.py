@@ -9,9 +9,20 @@ CPU path (the reference's only execution substrate; BASELINE.md "the
 reference's CPU path is the comparison baseline").
 
 Prints ONE JSON line:
-    {"metric": ..., "value": N, "unit": "GFLOPS", "vs_baseline": N}
+    {"metric": ..., "value": N, "unit": "GFLOPS", "vs_baseline": N,
+     "latency_warm_p50_ms": N | null, "cpu_baseline_gflops": N}
 
 Extra detail lines go to stderr.
+
+Ordering and guards (round-1 lesson, BENCH_r01.json rc=1): the TPU
+measurement — the number this benchmark exists to produce — runs FIRST and
+nothing that happens to the auxiliary measurements can take it down. The CPU
+baseline runs second, try/except-guarded, in a process env scrubbed of
+accelerator-tunnel plugin vars (PALLAS_*/AXON_* hook jax backend init even
+under JAX_PLATFORMS=cpu and block on a single-client tunnel) with the reroute
+opted out via the *request env* (not in-script — numpy may already be proxied
+by the time user code runs). If the live baseline fails anyway, a recorded
+baseline keeps ``vs_baseline`` meaningful and is flagged on stderr.
 """
 
 from __future__ import annotations
@@ -19,11 +30,16 @@ from __future__ import annotations
 import asyncio
 import json
 import os
+import statistics
+import subprocess
 import sys
 import tempfile
+import time
 from pathlib import Path
 
 REPO = Path(__file__).resolve().parent
+if str(REPO) not in sys.path:
+    sys.path.insert(0, str(REPO))
 SHIM_DIR = REPO / "bee_code_interpreter_tpu" / "runtime" / "shim"
 
 N = 32768
@@ -65,14 +81,13 @@ print(f"RESULT_GFLOPS {{2 * n**3 * iters / best / 1e9:.1f}}")
 # Host-CPU baseline: the same kernel as the TPU chain — one-time 1/128
 # pre-scale, then a pure data-dependent matmul chain with a single readback —
 # through plain numpy (f32; numpy has no bf16), sized down (self-timed wall
-# clock, as the reference's own benchmark payload does).
+# clock, as the reference's own benchmark payload does). n=2048 is enough to
+# saturate the host BLAS; anything larger just risks the driver's clock.
 CPU_PAYLOAD = """
-import os
-os.environ["BCI_XLA_REROUTE"] = "0"
 import time
 import numpy as np
 
-n, iters = 4096, 4
+n, iters = 2048, 8
 a = np.random.rand(n, n).astype(np.float32) * np.float32(1 / 128)
 x = a
 t0 = time.time()
@@ -83,8 +98,17 @@ dt = time.time() - t0
 print(f"RESULT_GFLOPS {2 * n**3 * iters / dt / 1e9:.1f}")
 """
 
+# Live-CPU-baseline fallback: the same payload measured out-of-band on this
+# machine class (round-1 verification run: 120 GFLOPS through the identical
+# LocalCodeExecutor path). Used only if the live baseline fails; stderr says so.
+RECORDED_CPU_GFLOPS = 120.0
 
-async def run_payload(source: str, env: dict[str, str]) -> float:
+LATENCY_PAYLOAD = "print(21 * 2)"
+
+
+async def run_payload(
+    source: str, env: dict[str, str], timeout_s: float
+) -> float:
     from bee_code_interpreter_tpu.services.local_code_executor import (
         LocalCodeExecutor,
     )
@@ -95,7 +119,7 @@ async def run_payload(source: str, env: dict[str, str]) -> float:
         storage=Storage(Path(tmp) / "objects"),
         workspace_root=Path(tmp) / "ws",
         disable_dep_install=True,
-        execution_timeout_s=300.0,
+        execution_timeout_s=timeout_s,
         shim_dir=SHIM_DIR,
     )
     result = await executor.execute(source, env=env)
@@ -108,35 +132,139 @@ async def run_payload(source: str, env: dict[str, str]) -> float:
     raise RuntimeError(f"no result in stdout: {result.stdout!r}")
 
 
-def main() -> None:
-    # the TPU payload must see the real chip, not the test-forced CPU
-    # TPU/XLA/accelerator env flows through the executor's passthrough list +
-    # the process environment; PYTHONPATH must NOT be overridden here or the
-    # shim prepend (and the image's own site hooks) would be lost.
-    tpu_env = {
-        k: v for k, v in os.environ.items()
-        if k.startswith(("TPU", "JAX", "XLA", "PALLAS"))
-    }
-    cpu_gflops = asyncio.run(run_payload(CPU_PAYLOAD, {"JAX_PLATFORMS": "cpu"}))
-    print(f"cpu baseline: {cpu_gflops:.1f} GFLOPS", file=sys.stderr)
+def scrub_tunnel_vars() -> None:
+    """Drop accelerator-tunnel plugin vars from THIS process (inherited by the
+    executor's TPU_PASSTHROUGH_PREFIXES) so CPU-pinned payloads cannot be
+    hijacked into a blocking TPU backend init. Called only after the TPU
+    measurement — which needs those very vars — has completed."""
+    from bee_code_interpreter_tpu.utils.envscrub import scrub_tunnel_plugin_vars
 
+    scrub_tunnel_plugin_vars()
+
+
+def ensure_native_binary() -> Path | None:
+    """Build the C++ executor if needed — synchronously, OUTSIDE any event
+    loop (a blocking subprocess inside a coroutine would stall the loop and
+    defeat the asyncio.wait_for guard around the latency measurement)."""
+    binary = REPO / "executor" / "build" / "executor-server"
+    if binary.exists():
+        return binary
     try:
-        tpu_gflops = asyncio.run(run_payload(TPU_PAYLOAD, tpu_env))
+        build = subprocess.run(
+            ["make", "-C", str(REPO / "executor"), "-s"],
+            capture_output=True,
+            timeout=180,
+        )
+    except (OSError, subprocess.TimeoutExpired) as e:
+        print(f"latency: executor build failed ({e})", file=sys.stderr)
+        return None
+    if build.returncode != 0 or not binary.exists():
+        print("latency: no native executor binary", file=sys.stderr)
+        return None
+    return binary
+
+
+async def measure_warm_latency_p50_ms(binary: Path, n: int = 20) -> float | None:
+    """p50 of a trivial execute through the warm native-executor pool
+    (BASELINE.md north-star #3; scripts/measure-latency.py is the full
+    percentile harness)."""
+    from bee_code_interpreter_tpu.config import Config
+    from bee_code_interpreter_tpu.services.native_process_code_executor import (
+        NativeProcessCodeExecutor,
+    )
+    from bee_code_interpreter_tpu.services.storage import Storage
+
+    tmp = Path(tempfile.mkdtemp(prefix="bench-lat-"))
+    config = Config(
+        file_storage_path=str(tmp / "objects"),
+        local_workspace_root=str(tmp / "ws"),
+        executor_pod_queue_target_length=4,
+        disable_dep_install=True,
+    )
+    executor = NativeProcessCodeExecutor(
+        storage=Storage(tmp / "objects"), config=config, binary=binary
+    )
+    try:
+        await executor.fill_sandbox_queue()
+        samples = []
+        for _ in range(n):
+            t0 = time.perf_counter()
+            result = await executor.execute(LATENCY_PAYLOAD)
+            if result.stdout != "42\n":
+                raise RuntimeError(f"latency payload failed: {result.stderr}")
+            samples.append(time.perf_counter() - t0)
+        return statistics.median(samples) * 1000
+    finally:
+        executor.shutdown()
+
+
+def main() -> None:
+    # --- 1. the headline TPU number (runs first; ambient accelerator env —
+    # including any tunnel plugin vars — flows through the executor's
+    # passthrough so the payload sees the real chip) -----------------------
+    tpu_gflops: float | None = None
+    try:
+        tpu_gflops = asyncio.run(run_payload(TPU_PAYLOAD, {}, timeout_s=360.0))
         print(f"tpu: {tpu_gflops:.1f} GFLOPS", file=sys.stderr)
+    except Exception as e:
+        print(f"tpu payload failed: {e}", file=sys.stderr)
+
+    # --- 2. CPU baseline (guarded: can only degrade vs_baseline) ----------
+    scrub_tunnel_vars()
+    cpu_gflops: float | None = None
+    cpu_source = "measured"
+    try:
+        cpu_gflops = asyncio.run(
+            run_payload(
+                CPU_PAYLOAD,
+                {"JAX_PLATFORMS": "cpu", "BCI_XLA_REROUTE": "0"},
+                timeout_s=120.0,
+            )
+        )
+        print(f"cpu baseline: {cpu_gflops:.1f} GFLOPS", file=sys.stderr)
+    except Exception as e:
+        print(
+            f"cpu baseline failed ({e}); using recorded "
+            f"{RECORDED_CPU_GFLOPS} GFLOPS",
+            file=sys.stderr,
+        )
+        cpu_gflops = RECORDED_CPU_GFLOPS
+        cpu_source = "recorded"
+
+    # --- 3. warm-pool execute latency (guarded; extra field) --------------
+    latency_p50_ms: float | None = None
+    binary = ensure_native_binary()
+    if binary is not None:
+        try:
+            latency_p50_ms = asyncio.run(
+                asyncio.wait_for(measure_warm_latency_p50_ms(binary), timeout=120.0)
+            )
+            if latency_p50_ms is not None:
+                print(f"warm execute p50: {latency_p50_ms:.1f} ms", file=sys.stderr)
+        except Exception as e:
+            print(f"latency measurement failed: {e}", file=sys.stderr)
+
+    if tpu_gflops is not None:
         result = {
             "metric": "dense matmul GFLOPS/chip via /v1/execute (bf16 32768^3 jit chain)",
             "value": round(tpu_gflops, 1),
             "unit": "GFLOPS",
             "vs_baseline": round(tpu_gflops / cpu_gflops, 2),
         }
-    except Exception as e:  # no chip reachable: report the CPU path honestly
-        print(f"tpu payload failed ({e}); reporting CPU-path result", file=sys.stderr)
+    else:  # no chip reachable: report the CPU path honestly
         result = {
             "metric": "dense matmul GFLOPS via /v1/execute (CPU fallback - no TPU reachable)",
             "value": round(cpu_gflops, 1),
             "unit": "GFLOPS",
             "vs_baseline": 1.0,
         }
+    result["latency_warm_p50_ms"] = (
+        round(latency_p50_ms, 1) if latency_p50_ms is not None else None
+    )
+    result["cpu_baseline_gflops"] = round(cpu_gflops, 1)
+    # "recorded" = the live CPU run failed and vs_baseline uses the recorded
+    # machine-class figure — a constant must never masquerade as a measurement
+    result["cpu_baseline_source"] = cpu_source
     print(json.dumps(result))
 
 

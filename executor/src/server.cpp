@@ -73,15 +73,25 @@ constexpr const char* kTpuPassthroughPrefixes[] = {
 // FOO_PORT_80_TCP-style vars for every Service in the namespace; a Service
 // named tpu-* would land inside the prefixes above and leak cluster addresses
 // into untrusted user code (mirrors executor_core._is_passthrough_env).
+// Port-shaped keys (FOO_PORT, FOO_PORT_80_TCP) are dropped only when the
+// definitive service-link signature — a sibling FOO_SERVICE_HOST — exists:
+// real accelerator topology vars share the suffix shape (TPU_PROCESS_PORT,
+// MEGASCALE_PORT) and must pass through (libtpu never sets *_SERVICE_HOST).
 inline bool is_passthrough_env(const std::string& key) {
   bool prefixed = false;
   for (const char* prefix : kTpuPassthroughPrefixes)
     if (key.rfind(prefix, 0) == 0) { prefixed = true; break; }
   if (!prefixed) return false;
-  if (key.size() >= 5 && key.compare(key.size() - 5, 5, "_PORT") == 0) return false;
   if (key.find("_SERVICE_") != std::string::npos) return false;
-  if (key.find("_PORT_") != std::string::npos) return false;
-  return true;
+  std::string base;
+  if (key.size() >= 5 && key.compare(key.size() - 5, 5, "_PORT") == 0) {
+    base = key.substr(0, key.size() - 5);
+  } else {
+    const auto idx = key.find("_PORT_");
+    if (idx == std::string::npos) return true;
+    base = key.substr(0, idx);
+  }
+  return getenv((base + "_SERVICE_HOST").c_str()) == nullptr;
 }
 
 // Bootstrap for the pre-started interpreter: a warm python (configured
@@ -150,17 +160,31 @@ os.dup2(_saved_err, 2)
 os.close(_saved_out); os.close(_saved_err); os.close(_devnull)
 
 os.environ.update(_req.get("env", {}))
+# The preload imported numpy before the request env existed, so the reroute
+# proxies were installed regardless of the request's BCI_XLA_REROUTE. The
+# proxies re-check the env per call, but a request that opted out deserves a
+# fully de-proxied numpy (identical to a cold APP_PRESTART=0 interpreter).
+if os.environ.get("BCI_XLA_REROUTE") == "0" and "numpy" in sys.modules:
+    try:
+        from bee_code_interpreter_tpu.runtime import xla_reroute
+        xla_reroute.uninstall(sys.modules["numpy"])
+    except Exception:
+        pass
 os.chdir(_req["cwd"])
 # Cold-path sys.path parity: `python script.py` puts the script's directory
 # at [0] (under `python -c` that slot is the cwd — replace it), followed by
-# PYTHONPATH entries — including any the request supplied after this
-# interpreter already started.
+# PYTHONPATH entries in their merged (shim-first) order — repositioning
+# entries the worker's startup already added, so a request-supplied path
+# resolves identically warm and cold ([script_dir, shim, request paths...]).
 sys.path[0:1] = [os.path.dirname(_req["script"])]
 _idx = 1
 for _p in _req.get("env", {}).get("PYTHONPATH", "").split(os.pathsep):
-    if _p and _p not in sys.path:
-        sys.path.insert(_idx, _p)
-        _idx += 1
+    if not _p:
+        continue
+    if _p in sys.path[1:]:
+        sys.path.remove(_p)
+    sys.path.insert(_idx, _p)
+    _idx += 1
 sys.argv = [_req["script"]]
 with open(_req["script"], "rb") as _f:
     _code = _f.read()
@@ -387,7 +411,14 @@ class Executor {
                 worker.status_fd, std::min(timeout_s, guard_remaining))) {
           close(worker.status_fd);
           worker.status_fd = -1;
-          result = subprocess::collect(worker, timeout_s);
+          // Charge the phase-1 wait against the request budget: collect()
+          // must not restart a full budget or the warm path could run for
+          // guard+timeout, past what the control-plane client waits for.
+          const double waited =
+              std::chrono::duration<double>(std::chrono::steady_clock::now() -
+                                            t0)
+                  .count();
+          result = subprocess::collect(worker, std::max(0.5, timeout_s - waited));
           ran_warm = true;
         } else {
           // preload never finished: cold-retry with the remaining budget
@@ -400,6 +431,7 @@ class Executor {
       } else {
         kill_worker = was_alive;
       }
+      bool started_after_deadline = false;
       if (!ran_warm) {
         if (kill_worker) {
           // kill and reap (blocking is safe — SIGKILL delivery to our own
@@ -407,8 +439,27 @@ class Executor {
           worker.kill_group();
           int status = 0;
           waitpid(worker.pid, &status, 0);
+          // Close the race between deadline expiry and the kill: if the
+          // started byte landed in that gap, user code already began in the
+          // warm worker (side effects possible) and a cold retry would
+          // double-execute it. One final drain of the (now-EOF'd) status
+          // pipe tells us for certain.
+          started_after_deadline =
+              subprocess::wait_for_status_byte(worker.status_fd, 0.05);
         }
         worker.close_fds();
+      }
+      if (started_after_deadline) {
+        std::error_code ec;
+        fs::remove_all(tmpdir, ec);
+        // Not a request timeout — only the (much shorter) preload-guard
+        // window elapsed. Say what actually happened instead of borrowing
+        // the timeout sentinel.
+        return {"",
+                "Execution aborted: the warm interpreter was killed at its "
+                "preload deadline after user code had already started; not "
+                "retried to avoid running the code twice",
+                -1, false};
       }
     }
     if (!ran_warm) {

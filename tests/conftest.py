@@ -20,8 +20,15 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 # JAX_PLATFORMS=cpu — and blocks on the (single-client) tunnel. Tests and
 # every sandbox subprocess they spawn (which inherit via the executor's
 # TPU_PASSTHROUGH_PREFIXES) must be hermetic CPU-only.
-for _k in [k for k in os.environ if k.startswith(("PALLAS_", "AXON_"))]:
-    os.environ.pop(_k)
+import sys  # noqa: E402
+from pathlib import Path  # noqa: E402
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+from bee_code_interpreter_tpu.utils.envscrub import (  # noqa: E402
+    scrub_tunnel_plugin_vars,
+)
+
+scrub_tunnel_plugin_vars()
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()

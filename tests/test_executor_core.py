@@ -169,3 +169,22 @@ async def test_timeout_kills_grandchildren(tmp_path):
         time.sleep(0.1)
     else:
         raise AssertionError(f"grandchild {pid} survived the timeout kill")
+
+
+def test_accelerator_port_vars_pass_through():
+    # ADVICE round 1: libtpu/megascale topology vars share the k8s
+    # service-link suffix shape; they must pass through unless the definitive
+    # sibling *_SERVICE_HOST signature marks them as service links.
+    from bee_code_interpreter_tpu.runtime.executor_core import _is_passthrough_env
+
+    env = {"TPU_PROCESS_PORT": "8476", "MEGASCALE_PORT": "8080"}
+    assert _is_passthrough_env("TPU_PROCESS_PORT", env)
+    assert _is_passthrough_env("MEGASCALE_PORT", env)
+    assert _is_passthrough_env("TPU_PROCESS_ADDRESSES", env)
+    # the same key becomes a service link when k8s injected the pair
+    linked = {"TPU_PROXY_SERVICE_HOST": "10.0.0.5", "TPU_PROXY_PORT": "tcp://10.0.0.5:80"}
+    assert not _is_passthrough_env("TPU_PROXY_PORT", linked)
+    assert not _is_passthrough_env("TPU_PROXY_PORT_80_TCP", linked)
+    assert not _is_passthrough_env("TPU_PROXY_SERVICE_HOST", linked)
+    # non-accelerator prefixes never pass regardless
+    assert not _is_passthrough_env("FOO_PORT", {})

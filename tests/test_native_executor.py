@@ -450,3 +450,83 @@ def test_hung_preload_mid_request_falls_back_cold(tmp_path):
         assert elapsed < 25, elapsed
     finally:
         server.stop()
+
+
+def test_warm_path_request_env_optout_deproxies_numpy(tmp_path):
+    # ADVICE round 1 (medium): the warm worker preloads numpy — installing the
+    # reroute proxies — before the request env exists. A request opting out
+    # via BCI_XLA_REROUTE=0 must still get a fully de-proxied numpy (the
+    # bootstrap uninstalls after applying the request env).
+    server = NativeExecutor(
+        tmp_path / "ws",
+        extra_env={
+            "APP_PYTHON": sys.executable,
+            "APP_PRESTART_IMPORTS": "numpy",
+            "APP_SHIM_DIR": str(
+                REPO / "bee_code_interpreter_tpu" / "runtime" / "shim"
+            ),
+            "HOME": str(tmp_path),
+            "JAX_PLATFORMS": "cpu",
+        },
+    )
+    try:
+        r = httpx.post(
+            server.base + "/execute",
+            json={
+                "source_code": (
+                    "import sys\n"
+                    "assert 'numpy' in sys.modules  # proves warm path\n"
+                    "import numpy as np\n"
+                    "print(bool(getattr(np, '__bci_xla_rerouted__', False)))\n"
+                    "print(type(np.random.rand(2_000_000)).__name__)\n"
+                ),
+                "env": {"BCI_XLA_REROUTE": "0"},
+                "timeout": 60,
+            },
+            timeout=70,
+        ).json()
+        assert r["stdout"] == "False\nndarray\n", (r["stdout"], r["stderr"][-500:])
+        assert r["exit_code"] == 0
+    finally:
+        server.stop()
+
+
+def test_warm_path_pythonpath_ordering_matches_cold(tmp_path):
+    # ADVICE round 1 (low): a request-supplied PYTHONPATH entry must resolve
+    # in the same relative position warm and cold: [script_dir, shim,
+    # request paths...]. A request path shadowing a shim-visible module name
+    # must NOT win over the shim on the warm path.
+    req_lib = tmp_path / "reqlib"
+    req_lib.mkdir()
+    shim = str(REPO / "bee_code_interpreter_tpu" / "runtime" / "shim")
+    probe = (
+        "import sys\n"
+        f"shim_i = sys.path.index({shim!r})\n"
+        f"req_i = sys.path.index({str(req_lib)!r})\n"
+        "print(shim_i < req_i)\n"
+    )
+    for prestart in ("1", "0"):
+        server = NativeExecutor(
+            tmp_path / f"ws-{prestart}",
+            extra_env={
+                "APP_PYTHON": sys.executable,
+                "APP_PRESTART": prestart,
+                "APP_PRESTART_IMPORTS": "numpy",
+                "APP_SHIM_DIR": shim,
+                "HOME": str(tmp_path),
+                "JAX_PLATFORMS": "cpu",
+            },
+        )
+        try:
+            r = httpx.post(
+                server.base + "/execute",
+                json={
+                    "source_code": probe,
+                    "env": {"PYTHONPATH": str(req_lib)},
+                    "timeout": 60,
+                },
+                timeout=70,
+            ).json()
+            assert r["stdout"] == "True\n", (prestart, r["stdout"], r["stderr"][-500:])
+        finally:
+            server.stop()

@@ -71,3 +71,62 @@ async def test_matplotlib_show_saves_plot(shimmed_executor):
     )
     assert result.exit_code == 0, result.stderr
     assert "/workspace/plot.png" in result.files
+
+
+async def test_request_env_optout_disables_reroute(shimmed_executor):
+    # BCI_XLA_REROUTE=0 in the request env is the documented opt-out
+    # (executor_core._child_env); big arrays must stay plain ndarrays.
+    result = await shimmed_executor.execute(
+        "import numpy as np\n"
+        "x = np.random.rand(2_000_000)\n"
+        "print(type(x).__name__)\n"
+        "print(type(np.sum(np.square(x))).__name__)\n",
+        env={"JAX_PLATFORMS": "cpu", "BCI_XLA_REROUTE": "0"},
+    )
+    assert result.exit_code == 0, result.stderr
+    assert result.stdout == "ndarray\nfloat64\n"
+
+
+async def test_midscript_optout_takes_effect(shimmed_executor):
+    # Round-1 weak #3: once numpy was imported (by anything — site hooks,
+    # preload), an in-script env opt-out was a no-op because the proxies only
+    # checked the flag at install time. Now they re-check per call.
+    result = await shimmed_executor.execute(
+        "import numpy as np\n"
+        "before = type(np.random.rand(2_000_000)).__name__\n"
+        "import os\n"
+        "os.environ['BCI_XLA_REROUTE'] = '0'\n"
+        "after = type(np.random.rand(2_000_000)).__name__\n"
+        "print(before, after)\n",
+        env={"JAX_PLATFORMS": "cpu"},
+    )
+    assert result.exit_code == 0, result.stderr
+    assert result.stdout == "TpuArray ndarray\n"
+
+
+async def test_chainloaded_sitecustomize_defers_patch(shimmed_executor, tmp_path):
+    # Round-1 weak #1 root cause: the image's own (chained) sitecustomize
+    # imported numpy during platform init and the import hook installed the
+    # reroute right then — before the request env was even visible. Imports
+    # made while the chained sitecustomize executes must NOT trigger patches;
+    # the first user-level import still must.
+    site_dir = tmp_path / "image-site"
+    site_dir.mkdir()
+    (site_dir / "sitecustomize.py").write_text(
+        "import json\n"
+        "import numpy as np\n"  # platform infrastructure importing numpy
+        "with open('chainprobe.json', 'w') as f:\n"
+        "    json.dump(\n"
+        "        {'proxied_during_chain':\n"
+        "         bool(getattr(np, '__bci_xla_rerouted__', False))}, f)\n"
+    )
+    result = await shimmed_executor.execute(
+        "import json\n"
+        "import numpy as np\n"  # the *user* import: patch applies here
+        "probe = json.load(open('chainprobe.json'))\n"
+        "print(probe['proxied_during_chain'])\n"
+        "print(bool(getattr(np, '__bci_xla_rerouted__', False)))\n",
+        env={"JAX_PLATFORMS": "cpu", "PYTHONPATH": str(site_dir)},
+    )
+    assert result.exit_code == 0, result.stderr
+    assert result.stdout == "False\nTrue\n"
