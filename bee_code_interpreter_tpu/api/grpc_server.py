@@ -19,9 +19,12 @@ import logging
 
 import grpc
 import grpc.aio
+from google.protobuf import descriptor_pb2, descriptor_pool
+from pydantic import ValidationError
 
+from bee_code_interpreter_tpu.api import models as api_models
 from bee_code_interpreter_tpu.proto import code_interpreter_pb2 as pb
-from bee_code_interpreter_tpu.proto import health_pb2
+from bee_code_interpreter_tpu.proto import health_pb2, reflection_pb2
 from bee_code_interpreter_tpu.services.code_executor import CodeExecutor
 from bee_code_interpreter_tpu.services.custom_tool_executor import (
     CustomToolExecuteError,
@@ -41,6 +44,26 @@ _METHODS: dict[str, tuple[type, type]] = {
 }
 
 
+def _violation_text(error: ValidationError) -> str:
+    """Render pydantic errors the way protovalidate renders violations: a
+    field path plus the constraint message (reference
+    code_interpreter_servicer.py:44-53 aborts with the violation list)."""
+    return "; ".join(
+        f"{'.'.join(str(part) for part in err['loc']) or 'request'}: {err['msg']}"
+        for err in error.errors()
+    )
+
+
+async def _validated(context: grpc.aio.ServicerContext, model_cls, **fields):
+    """Run the SAME pydantic model the HTTP transport uses (api/models.py) so
+    the two transports accept/reject identical requests; abort
+    INVALID_ARGUMENT with the violation text on failure."""
+    try:
+        return model_cls(**fields)
+    except ValidationError as e:
+        await context.abort(grpc.StatusCode.INVALID_ARGUMENT, _violation_text(e))
+
+
 class CodeInterpreterServicer:
     """RPC implementations (reference code_interpreter_servicer.py:33-135)."""
 
@@ -56,12 +79,20 @@ class CodeInterpreterServicer:
         new_request_id()
         if not request.source_code:
             await context.abort(grpc.StatusCode.INVALID_ARGUMENT, "source_code is required")
-        logger.info("Executing code: %s", request.source_code)
-        result = await self._code_executor.execute(
+        validated = await _validated(
+            context,
+            api_models.ExecuteRequest,
             source_code=request.source_code,
             files=dict(request.files),
-            env=dict(request.env),  # env forwarded, unlike reference (:67-70)
-            timeout_s=request.timeout or None,  # proto default 0 = unset
+            env=dict(request.env),
+            timeout=request.timeout or None,  # proto default 0 = unset
+        )
+        logger.info("Executing code: %s", validated.source_code)
+        result = await self._code_executor.execute(
+            source_code=validated.source_code,
+            files=validated.files,
+            env=validated.env,  # env forwarded, unlike reference (:67-70)
+            timeout_s=validated.timeout,
         )
         return pb.ExecuteResponse(
             stdout=result.stdout,
@@ -74,8 +105,13 @@ class CodeInterpreterServicer:
         self, request: pb.ParseCustomToolRequest, context: grpc.aio.ServicerContext
     ) -> pb.ParseCustomToolResponse:
         new_request_id()
+        validated = await _validated(
+            context,
+            api_models.ParseCustomToolRequest,
+            tool_source_code=request.tool_source_code,
+        )
         try:
-            tool = self._custom_tool_executor.parse(request.tool_source_code)
+            tool = self._custom_tool_executor.parse(validated.tool_source_code)
         except CustomToolParseError as e:
             return pb.ParseCustomToolResponse(
                 error=pb.ParseCustomToolResponse.ErrorResponse(
@@ -98,11 +134,18 @@ class CodeInterpreterServicer:
         new_request_id()
         import json
 
+        validated = await _validated(
+            context,
+            api_models.ExecuteCustomToolRequest,
+            tool_source_code=request.tool_source_code,
+            tool_input_json=request.tool_input_json,
+            env=dict(request.env),
+        )
         try:
             output = await self._custom_tool_executor.execute(
-                tool_source_code=request.tool_source_code,
-                tool_input_json=request.tool_input_json,
-                env=dict(request.env),
+                tool_source_code=validated.tool_source_code,
+                tool_input_json=validated.tool_input_json,
+                env=validated.env,
             )
         except CustomToolParseError as e:
             await context.abort(grpc.StatusCode.INVALID_ARGUMENT, "; ".join(e.error_messages))
@@ -165,6 +208,123 @@ class HealthServicer:
                 yield health_pb2.HealthCheckResponse(status=status)
                 last = status
             await event.wait()
+
+
+REFLECTION_SERVICE_NAME = "grpc.reflection.v1alpha.ServerReflection"
+
+
+class ReflectionServicer:
+    """The standard gRPC server-reflection protocol, hand-implemented over the
+    default descriptor pool (the checked-in ``*_pb2`` modules register their
+    FileDescriptorProtos there at import). Equivalent surface to the
+    reference's ``grpc_reflection.enable_server_reflection`` (reference
+    grpc_server.py:67-69) — that package isn't available in this environment.
+    grpcurl's ``list``/``describe`` drive ``list_services`` +
+    ``file_containing_symbol``; clients get the transitive descriptor closure
+    per file so they can build a local pool."""
+
+    def __init__(self, service_names: tuple[str, ...]) -> None:
+        self._service_names = service_names
+        self._pool = descriptor_pool.Default()
+
+    def _file_closure_bytes(self, file_descriptor) -> list[bytes]:
+        """Serialized FileDescriptorProto for the file + transitive imports."""
+        out: list[bytes] = []
+        seen: set[str] = set()
+        stack = [file_descriptor]
+        while stack:
+            fd = stack.pop()
+            if fd.name in seen:
+                continue
+            seen.add(fd.name)
+            proto = descriptor_pb2.FileDescriptorProto()
+            fd.CopyToProto(proto)
+            out.append(proto.SerializeToString())
+            stack.extend(fd.dependencies)
+        return out
+
+    def _handle(
+        self, request: reflection_pb2.ServerReflectionRequest
+    ) -> reflection_pb2.ServerReflectionResponse:
+        response = reflection_pb2.ServerReflectionResponse(
+            valid_host=request.host, original_request=request
+        )
+        kind = request.WhichOneof("message_request")
+        try:
+            if kind == "list_services":
+                response.list_services_response.service.extend(
+                    reflection_pb2.ServiceResponse(name=name)
+                    for name in self._service_names
+                )
+            elif kind == "file_by_filename":
+                fd = self._pool.FindFileByName(request.file_by_filename)
+                response.file_descriptor_response.file_descriptor_proto.extend(
+                    self._file_closure_bytes(fd)
+                )
+            elif kind == "file_containing_symbol":
+                fd = self._pool.FindFileContainingSymbol(
+                    request.file_containing_symbol
+                )
+                response.file_descriptor_response.file_descriptor_proto.extend(
+                    self._file_closure_bytes(fd)
+                )
+            elif kind == "all_extension_numbers_of_type":
+                # proto3 services here declare no extensions; confirm the type
+                # exists, then report an empty number list.
+                self._pool.FindMessageTypeByName(
+                    request.all_extension_numbers_of_type
+                )
+                response.all_extension_numbers_response.base_type_name = (
+                    request.all_extension_numbers_of_type
+                )
+            elif kind == "file_containing_extension":
+                response.error_response.error_code = (
+                    grpc.StatusCode.NOT_FOUND.value[0]
+                )
+                response.error_response.error_message = "extensions not supported"
+            else:
+                response.error_response.error_code = (
+                    grpc.StatusCode.INVALID_ARGUMENT.value[0]
+                )
+                response.error_response.error_message = "empty message_request"
+        except KeyError:
+            response.error_response.error_code = grpc.StatusCode.NOT_FOUND.value[0]
+            response.error_response.error_message = "not found"
+        return response
+
+    async def ServerReflectionInfo(self, request_iterator, context):
+        async for request in request_iterator:
+            yield self._handle(request)
+
+
+def _reflection_handler(servicer: ReflectionServicer) -> grpc.GenericRpcHandler:
+    return grpc.method_handlers_generic_handler(
+        REFLECTION_SERVICE_NAME,
+        {
+            "ServerReflectionInfo": grpc.stream_stream_rpc_method_handler(
+                servicer.ServerReflectionInfo,
+                request_deserializer=(
+                    reflection_pb2.ServerReflectionRequest.FromString
+                ),
+                response_serializer=(
+                    reflection_pb2.ServerReflectionResponse.SerializeToString
+                ),
+            )
+        },
+    )
+
+
+def reflection_stub(channel: grpc.aio.Channel):
+    """Client-side ServerReflectionInfo multicallable (tests/tooling)."""
+    return channel.stream_stream(
+        f"/{REFLECTION_SERVICE_NAME}/ServerReflectionInfo",
+        request_serializer=(
+            reflection_pb2.ServerReflectionRequest.SerializeToString
+        ),
+        response_deserializer=(
+            reflection_pb2.ServerReflectionResponse.FromString
+        ),
+    )
 
 
 def _health_handler(servicer: HealthServicer) -> grpc.GenericRpcHandler:
@@ -237,8 +397,15 @@ class GrpcServer:
     async def start(self, listen_addr: str) -> int:
         """Start serving; returns the bound port (useful with ':0')."""
         self._server = grpc.aio.server()
+        reflection = ReflectionServicer(
+            (SERVICE_NAME, HEALTH_SERVICE_NAME, REFLECTION_SERVICE_NAME)
+        )
         self._server.add_generic_rpc_handlers(
-            (_generic_handler(self._servicer), _health_handler(self.health))
+            (
+                _generic_handler(self._servicer),
+                _health_handler(self.health),
+                _reflection_handler(reflection),
+            )
         )
         if self._tls_cert and self._tls_cert_key:
             # mTLS when a CA is provided (reference application_context.py:102-110).

@@ -4,4 +4,4 @@
 # in this environment — only message codegen is needed.
 set -euo pipefail
 cd "$(dirname "$0")"
-protoc --python_out=. code_interpreter.proto health.proto
+protoc --python_out=. code_interpreter.proto health.proto reflection.proto
