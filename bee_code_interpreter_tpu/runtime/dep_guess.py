@@ -20,42 +20,362 @@ import ast
 import sys
 from pathlib import Path
 
-# Import-name → PyPI-distribution-name, for the common cases where they differ.
-# (Equivalent of upm's pypi_map.sqlite; the executor image ships this as
-# executor/pypi_map.tsv for the C++ server.)
+# Import-name → PyPI-distribution-name, for the cases where they differ.
+# (Equivalent of upm's pypi_map.sqlite, curated down to the high-traffic
+# entries LLM-generated code actually imports; the executor image ships this
+# as executor/pypi_map.tsv for the C++ server — regenerate with
+# scripts/generate-pypi-map.py after editing.) Identity mappings are omitted:
+# ``guess_dependencies`` falls back to the import name itself.
 PYPI_MAP: dict[str, str] = {
-    "attr": "attrs",
-    "bs4": "beautifulsoup4",
-    "cairosvg": "CairoSVG",
-    "cv2": "opencv-python",
-    "Crypto": "pycryptodome",
-    "dateutil": "python-dateutil",
-    "docx": "python-docx",
-    "dotenv": "python-dotenv",
-    "fitz": "pymupdf",
-    "github": "PyGithub",
-    "googleapiclient": "google-api-python-client",
-    "jose": "python-jose",
-    "kubernetes": "kubernetes",
-    "lxml": "lxml",
-    "magic": "python-magic",
-    "mpl_toolkits": "matplotlib",
-    "OpenSSL": "pyOpenSSL",
+    # -- imaging / media ------------------------------------------------
     "PIL": "pillow",
-    "pptx": "python-pptx",
-    "psycopg2": "psycopg2-binary",
+    "cv2": "opencv-python",
+    "skimage": "scikit-image",
+    "imageio_ffmpeg": "imageio-ffmpeg",
+    "ffmpeg": "ffmpeg-python",
     "pydub": "pydub",
+    "moviepy": "moviepy",
+    "cairosvg": "CairoSVG",
+    "cairo": "pycairo",
+    "wand": "Wand",
+    "qrcode": "qrcode",
+    "pytesseract": "pytesseract",
+    "face_recognition": "face-recognition",
+    "insightface": "insightface",
+    # -- documents / office ---------------------------------------------
+    "fitz": "pymupdf",
+    "pymupdf": "pymupdf",
+    "docx": "python-docx",
+    "pptx": "python-pptx",
+    "xlrd": "xlrd",
+    "xlsxwriter": "XlsxWriter",
+    "odf": "odfpy",
+    "ebooklib": "EbookLib",
+    "pdfminer": "pdfminer.six",
+    "pdf2image": "pdf2image",
+    "pikepdf": "pikepdf",
+    "pypandoc": "pypandoc",
+    "weasyprint": "weasyprint",
+    "reportlab": "reportlab",
+    "tabula": "tabula-py",
+    "camelot": "camelot-py",
     "pypdf": "pypdf",
     "PyPDF2": "PyPDF2",
-    "serial": "pyserial",
-    "skimage": "scikit-image",
-    "sklearn": "scikit-learn",
-    "slugify": "python-slugify",
+    "fpdf": "fpdf2",
+    "markdown": "Markdown",
+    "markdownify": "markdownify",
+    "frontmatter": "python-frontmatter",
+    "pylatex": "PyLaTeX",
+    "pybtex": "pybtex",
+    # -- scraping / web clients -----------------------------------------
+    "bs4": "beautifulsoup4",
+    "requests_oauthlib": "requests-oauthlib",
+    "requests_toolbelt": "requests-toolbelt",
+    "websocket": "websocket-client",
     "socks": "PySocks",
+    "fake_useragent": "fake-useragent",
+    "selenium": "selenium",
+    "scrapy": "Scrapy",
+    "cloudscraper": "cloudscraper",
+    "newspaper": "newspaper3k",
+    "readability": "readability-lxml",
+    "feedparser": "feedparser",
+    "yt_dlp": "yt-dlp",
+    "youtube_dl": "youtube-dl",
+    "wikipedia": "wikipedia",
+    "duckduckgo_search": "duckduckgo-search",
+    # -- data / scientific ----------------------------------------------
+    "mpl_toolkits": "matplotlib",
+    "pylab": "matplotlib",
+    "sklearn": "scikit-learn",
+    "umap": "umap-learn",
+    "Bio": "biopython",
+    "rdkit": "rdkit",
+    "pywt": "PyWavelets",
+    "netCDF4": "netCDF4",
+    "osgeo": "GDAL",
+    "shapefile": "pyshp",
+    "mpl_finance": "mpl-finance",
+    "mplfinance": "mplfinance",
+    "ta": "ta",
+    "yfinance": "yfinance",
+    "pandas_datareader": "pandas-datareader",
+    "pandas_ta": "pandas-ta",
+    "stl": "numpy-stl",
+    "graphviz": "graphviz",
+    "pygraphviz": "pygraphviz",
+    "igraph": "python-igraph",
+    "community": "python-louvain",
+    "fuzzywuzzy": "fuzzywuzzy",
+    "Levenshtein": "Levenshtein",
+    "jellyfish": "jellyfish",
+    "patsy": "patsy",
+    "pymc": "pymc",
+    "cvxpy": "cvxpy",
+    "pulp": "PuLP",
+    "ortools": "ortools",
+    "deap": "deap",
+    "gymnasium": "gymnasium",
+    "gym": "gym",
+    # -- ML / NLP ---------------------------------------------------------
+    "speech_recognition": "SpeechRecognition",
+    "sentence_transformers": "sentence-transformers",
+    "huggingface_hub": "huggingface-hub",
+    "datasets": "datasets",
+    "tokenizers": "tokenizers",
+    "safetensors": "safetensors",
+    "sklearn_crfsuite": "sklearn-crfsuite",
+    "textblob": "textblob",
+    "langdetect": "langdetect",
+    "nltk": "nltk",
+    "spacy": "spacy",
+    "gensim": "gensim",
+    "wordcloud": "wordcloud",
+    "whisper": "openai-whisper",
+    "tiktoken": "tiktoken",
+    "langchain": "langchain",
+    "anthropic": "anthropic",
+    "openai": "openai",
+    "google.protobuf": "protobuf",
+    # -- databases / storage ----------------------------------------------
+    "psycopg2": "psycopg2-binary",
+    "MySQLdb": "mysqlclient",
+    "pymysql": "PyMySQL",
+    "mysql": "mysql-connector-python",
+    "sqlalchemy": "SQLAlchemy",
+    "bson": "pymongo",
+    "gridfs": "pymongo",
+    "cassandra": "cassandra-driver",
+    "couchdb": "CouchDB",
+    "neo4j": "neo4j",
+    "redis": "redis",
+    "memcache": "python-memcached",
+    "snowflake": "snowflake-connector-python",
+    "duckdb": "duckdb",
+    "pyarrow": "pyarrow",
+    "fastparquet": "fastparquet",
+    "h5py": "h5py",
+    "tables": "tables",
+    "zarr": "zarr",
+    "smart_open": "smart-open",
+    "fsspec": "fsspec",
+    "s3fs": "s3fs",
+    "gcsfs": "gcsfs",
+    "minio": "minio",
+    # -- cloud / APIs -----------------------------------------------------
+    "googleapiclient": "google-api-python-client",
+    "google_auth_oauthlib": "google-auth-oauthlib",
+    "github": "PyGithub",
+    "gitlab": "python-gitlab",
+    "git": "GitPython",
+    "jira": "jira",
+    "slack_sdk": "slack-sdk",
     "telegram": "python-telegram-bot",
+    "discord": "discord.py",
+    "tweepy": "tweepy",
+    "praw": "praw",
+    "stripe": "stripe",
+    "twilio": "twilio",
+    "sendgrid": "sendgrid",
+    "boto3": "boto3",
+    "botocore": "botocore",
+    "azure": "azure",
+    "kubernetes": "kubernetes",
+    "docker": "docker",
+    "kafka": "kafka-python",
+    "pika": "pika",
+    "paho": "paho-mqtt",
+    "grpc": "grpcio",
+    "etcd3": "etcd3",
+    "consul": "python-consul",
+    # -- web frameworks ---------------------------------------------------
+    "flask": "Flask",
+    "flask_cors": "Flask-Cors",
+    "flask_sqlalchemy": "Flask-SQLAlchemy",
+    "flask_login": "Flask-Login",
+    "flask_wtf": "Flask-WTF",
+    "flask_migrate": "Flask-Migrate",
+    "flask_restful": "Flask-RESTful",
+    "django": "Django",
+    "rest_framework": "djangorestframework",
+    "corsheaders": "django-cors-headers",
+    "fastapi": "fastapi",
+    "starlette": "starlette",
+    "uvicorn": "uvicorn",
+    "gunicorn": "gunicorn",
+    "sanic": "sanic",
+    "tornado": "tornado",
+    "aiohttp": "aiohttp",
+    "socketio": "python-socketio",
+    "engineio": "python-engineio",
+    "jinja2": "Jinja2",
+    "wtforms": "WTForms",
+    "werkzeug": "Werkzeug",
+    "multipart": "python-multipart",
+    "jwt": "PyJWT",
+    "jose": "python-jose",
+    "email_validator": "email-validator",
+    "itsdangerous": "itsdangerous",
+    "graphene": "graphene",
+    "strawberry": "strawberry-graphql",
+    "streamlit": "streamlit",
+    "gradio": "gradio",
+    "dash": "dash",
+    "nicegui": "nicegui",
+    # -- crypto / security ------------------------------------------------
+    "Crypto": "pycryptodome",
+    "Cryptodome": "pycryptodomex",
+    "OpenSSL": "pyOpenSSL",
+    "nacl": "PyNaCl",
+    "jwcrypto": "jwcrypto",
+    "passlib": "passlib",
+    "bcrypt": "bcrypt",
+    "argon2": "argon2-cffi",
+    "scapy": "scapy",
+    "nmap": "python-nmap",
+    "shodan": "shodan",
+    "web3": "web3",
+    "eth_account": "eth-account",
+    "solana": "solana",
+    "ccxt": "ccxt",
+    # -- system / misc utilities ------------------------------------------
+    "attr": "attrs",
+    "attrs": "attrs",
+    "dateutil": "python-dateutil",
+    "dotenv": "python-dotenv",
+    "magic": "python-magic",
+    "serial": "pyserial",
     "usb": "pyusb",
     "yaml": "PyYAML",
     "zmq": "pyzmq",
+    "slugify": "python-slugify",
+    "unidecode": "Unidecode",
+    "charset_normalizer": "charset-normalizer",
+    "chardet": "chardet",
+    "prettytable": "prettytable",
+    "tabulate": "tabulate",
+    "termcolor": "termcolor",
+    "colorama": "colorama",
+    "rich": "rich",
+    "typer": "typer",
+    "click": "click",
+    "fire": "fire",
+    "docopt": "docopt",
+    "tqdm": "tqdm",
+    "halo": "halo",
+    "schedule": "schedule",
+    "crontab": "python-crontab",
+    "apscheduler": "APScheduler",
+    "dateparser": "dateparser",
+    "pendulum": "pendulum",
+    "arrow": "arrow",
+    "tzlocal": "tzlocal",
+    "pytz": "pytz",
+    "humanize": "humanize",
+    "phonenumbers": "phonenumbers",
+    "faker": "Faker",
+    "mimesis": "mimesis",
+    "constraint": "python-constraint",
+    "ruamel": "ruamel.yaml",
+    "toml": "toml",
+    "tomlkit": "tomlkit",
+    "ujson": "ujson",
+    "orjson": "orjson",
+    "msgpack": "msgpack",
+    "jsonschema": "jsonschema",
+    "cerberus": "Cerberus",
+    "marshmallow": "marshmallow",
+    "deepdiff": "deepdiff",
+    "dictdiffer": "dictdiffer",
+    "xmltodict": "xmltodict",
+    "defusedxml": "defusedxml",
+    "html5lib": "html5lib",
+    "cssselect": "cssselect",
+    "emoji": "emoji",
+    "regex": "regex",
+    "parse": "parse",
+    "ply": "ply",
+    "lark": "lark",
+    "pyparsing": "pyparsing",
+    "prometheus_client": "prometheus-client",
+    "structlog": "structlog",
+    "loguru": "loguru",
+    "sentry_sdk": "sentry-sdk",
+    "dotmap": "dotmap",
+    "box": "python-box",
+    "cachetools": "cachetools",
+    "diskcache": "diskcache",
+    "joblib": "joblib",
+    "cloudpickle": "cloudpickle",
+    "dill": "dill",
+    "psutil": "psutil",
+    "distro": "distro",
+    "watchdog": "watchdog",
+    "send2trash": "Send2Trash",
+    "filelock": "filelock",
+    "portalocker": "portalocker",
+    "retrying": "retrying",
+    "tenacity": "tenacity",
+    "backoff": "backoff",
+    "ratelimit": "ratelimit",
+    "more_itertools": "more-itertools",
+    "toolz": "toolz",
+    "funcy": "funcy",
+    "boltons": "boltons",
+    "sortedcontainers": "sortedcontainers",
+    "bidict": "bidict",
+    "frozendict": "frozendict",
+    "typing_extensions": "typing-extensions",
+    "pkg_resources": "setuptools",
+    "pygments": "Pygments",
+    "sphinx": "Sphinx",
+    "nbformat": "nbformat",
+    "nbconvert": "nbconvert",
+    "papermill": "papermill",
+    "ipywidgets": "ipywidgets",
+    "IPython": "ipython",
+    "pexpect": "pexpect",
+    "ptyprocess": "ptyprocess",
+    "sh": "sh",
+    "plumbum": "plumbum",
+    "invoke": "invoke",
+    "fabric": "fabric",
+    "paramiko": "paramiko",
+    "scp": "scp",
+    "asyncssh": "asyncssh",
+    "aiofiles": "aiofiles",
+    "anyio": "anyio",
+    "trio": "trio",
+    "curio": "curio",
+    "uvloop": "uvloop",
+    "nest_asyncio": "nest-asyncio",
+    # -- games / gui / audio ----------------------------------------------
+    "pygame": "pygame",
+    "pyglet": "pyglet",
+    "arcade": "arcade",
+    "wx": "wxPython",
+    "gi": "PyGObject",
+    "PyQt5": "PyQt5",
+    "PyQt6": "PyQt6",
+    "PySide6": "PySide6",
+    "kivy": "Kivy",
+    "turtle3d": "turtle3d",
+    "sounddevice": "sounddevice",
+    "soundfile": "soundfile",
+    "librosa": "librosa",
+    "mido": "mido",
+    "music21": "music21",
+    "pyaudio": "PyAudio",
+    "playsound": "playsound",
+    "gtts": "gTTS",
+    "pyttsx3": "pyttsx3",
+    "chess": "chess",
+    "pynput": "pynput",
+    "pyautogui": "PyAutoGUI",
+    "keyboard": "keyboard",
+    "mouse": "mouse",
+    "screeninfo": "screeninfo",
+    "mss": "mss",
 }
 
 # Names that must never be pip-installed: provided by the OS/image, or aliases
@@ -68,8 +388,10 @@ SKIP: frozenset[str] = frozenset(
         # accelerator stack — pinned in the image, never reinstall
         "jax", "jaxlib", "libtpu", "torch", "torch_xla", "flax", "optax",
         "orbax", "chex", "haiku", "pallas",
-        # OS-package-provided tools that upm-style guessers misattribute
-        "ffmpeg", "pandoc", "magick", "imagemagick",
+        # OS-package-provided tools that upm-style guessers misattribute.
+        # NOT "ffmpeg": that import is a real pip dist (ffmpeg-python) and
+        # PYPI_MAP redirects it — skipping here would block the install.
+        "pandoc", "magick", "imagemagick",
         # our own runtime
         "bee_code_interpreter_tpu",
     }

@@ -44,7 +44,8 @@ inline const std::set<std::string>& builtin_skip() {
   static const std::set<std::string> skip = {
       "jax", "jaxlib", "libtpu", "torch", "torch_xla", "flax", "optax",
       "orbax", "chex", "haiku", "pallas",
-      "ffmpeg", "pandoc", "magick", "imagemagick",
+      // NOT "ffmpeg": that import maps to the real ffmpeg-python dist.
+      "pandoc", "magick", "imagemagick",
       "bee_code_interpreter_tpu",
   };
   return skip;

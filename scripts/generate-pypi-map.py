@@ -1,0 +1,35 @@
+#!/usr/bin/env python
+"""Regenerate executor/pypi_map.tsv from runtime/dep_guess.py's PYPI_MAP.
+
+The Python guesser (unit-test oracle) and the C++ server (executor/
+dep_guess.hpp loading /pypi_map.tsv) must agree on the import→distribution
+table; this script is the one direction of truth flow. Run after editing
+PYPI_MAP.
+"""
+
+from __future__ import annotations
+
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+from bee_code_interpreter_tpu.runtime.dep_guess import PYPI_MAP  # noqa: E402
+
+OUT = REPO / "executor" / "pypi_map.tsv"
+
+
+def main() -> None:
+    lines = [
+        "# import-name -> PyPI distribution name "
+        "(generated from runtime/dep_guess.py PYPI_MAP "
+        "by scripts/generate-pypi-map.py)"
+    ]
+    lines += [f"{imp}\t{dist}" for imp, dist in sorted(PYPI_MAP.items())]
+    OUT.write_text("\n".join(lines) + "\n")
+    print(f"wrote {len(PYPI_MAP)} entries to {OUT}")
+
+
+if __name__ == "__main__":
+    main()

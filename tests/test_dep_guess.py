@@ -47,3 +47,39 @@ def test_load_requirements_set(tmp_path):
     skip.write_text("ffmpeg  # OS package\n")
     got = load_requirements_set(req, skip, tmp_path / "missing.txt")
     assert got == frozenset({"pandas", "py-yaml", "scipy", "ffmpeg"})
+
+
+def test_media_alias_traps_resolve():
+    # The reference image's hard-won alias corrections (its
+    # requirements-skip.txt:22-26), expressed here through the map: the alias
+    # import resolves to the REAL dist, so a missing target still installs.
+    src = "import fitz\nimport ffmpeg\nimport yt_dlp\nimport bson\nimport pylab\n"
+    assert guess_dependencies(src) == [
+        "ffmpeg-python", "matplotlib", "pymongo", "pymupdf", "yt-dlp",
+    ]
+    # ...and with the image's stack preinstalled, none of them reinstall
+    pre = load_requirements_set(
+        "executor/requirements.txt", "executor/requirements-skip.txt"
+    )
+    assert guess_dependencies(src, preinstalled=pre) == ["pymongo"]
+
+
+def test_image_skip_file_blocks_os_and_accel_names():
+    pre = load_requirements_set("executor/requirements-skip.txt")
+    src = "import pandoc\nimport libtpu\nimport jaxlib\nimport tpu_info\n"
+    assert guess_dependencies(src, preinstalled=pre) == []
+
+
+def test_pypi_map_tsv_in_sync_with_oracle():
+    # The C++ server loads executor/pypi_map.tsv; it must match the Python
+    # oracle exactly (regenerate with scripts/generate-pypi-map.py).
+    from bee_code_interpreter_tpu.runtime.dep_guess import PYPI_MAP
+
+    rows = {}
+    for line in open("executor/pypi_map.tsv"):
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        imp, dist = line.split("\t")
+        rows[imp] = dist
+    assert rows == PYPI_MAP
