@@ -33,7 +33,7 @@ class FakeExecutorPods:
         self.execute_counts: dict[str, int] = {}
         self._next_ip = 1
 
-    async def start_pod(self) -> str:
+    async def start_pod(self, manifest: dict | None = None) -> str:
         ip = f"127.1.0.{self._next_ip}"
         self._next_ip += 1
         core = ExecutorCore(
@@ -86,7 +86,10 @@ class FakeKubectl:
         self.created_manifests.append(manifest)
         if name in self.fail_create_names:
             raise RuntimeError(f"fake: create {name} failed")
-        ip = await self._backend.start_pod()
+        # Backends get the manifest so they can honor the container env the
+        # control plane baked in (the full-stack distributed test applies it
+        # to real server processes; most backends ignore it).
+        ip = await self._backend.start_pod(manifest)
         self.pods[name] = {
             "metadata": manifest["metadata"],
             "spec": manifest["spec"],

@@ -199,7 +199,7 @@ async def test_control_plane_against_native_pods(tmp_path, storage):
             self.port = port
             self._next = 1
 
-        async def start_pod(self) -> str:
+        async def start_pod(self, manifest=None) -> str:
             ip = f"127.1.1.{self._next}"
             self._next += 1
             server = await asyncio.to_thread(
@@ -530,3 +530,89 @@ def test_warm_path_pythonpath_ordering_matches_cold(tmp_path):
             assert r["stdout"] == "True\n", (prestart, r["stdout"], r["stderr"][-500:])
         finally:
             server.stop()
+
+
+async def test_pod_group_runs_cross_process_collective(tmp_path, storage):
+    """Full-stack multi-host composition (round-1 weak #7): the gang scheduler
+    spawns 2 REAL native-server 'pods', the manifest env it baked in
+    (JAX_COORDINATOR_ADDRESS / JAX_NUM_PROCESSES / JAX_PROCESS_ID) is applied
+    to the actual server processes, and the submitted payload brings up
+    jax.distributed and runs a cross-process collective. Worker-0 stdout
+    proves the 2-process world rendezvoused end-to-end through
+    kubernetes_code_executor -> executor server -> sandbox -> parallel.mesh."""
+    from bee_code_interpreter_tpu.config import Config
+    from bee_code_interpreter_tpu.services.kubernetes_code_executor import (
+        KubernetesCodeExecutor,
+    )
+    from tests.fakes import FakeKubectl
+
+    port = free_port()
+    servers: list[NativeExecutor] = []
+
+    class DistributedNativeBackend:
+        """Starts a real executor-server per 'pod', honoring the manifest's
+        container env — the exact plumbing the fake-pod tests bypass."""
+
+        def __init__(self):
+            self.port = port
+            self._next = 1
+
+        async def start_pod(self, manifest=None) -> str:
+            ip = f"127.1.2.{self._next}"
+            self._next += 1
+            manifest_env = {
+                e["name"]: e["value"]
+                for e in (manifest or {"spec": {"containers": [{"env": []}]}})[
+                    "spec"
+                ]["containers"][0]["env"]
+                if not e["name"].startswith("APP_")
+            }
+            server = await asyncio.to_thread(
+                NativeExecutor,
+                tmp_path / f"dpod-{self._next}",
+                ip,
+                port,
+                {
+                    "APP_PYTHON": sys.executable,
+                    "APP_PRESTART": "0",  # collectives need fresh env per run
+                    "HOME": str(tmp_path),
+                    "PYTHONPATH": str(REPO),
+                    "JAX_PLATFORMS": "cpu",
+                    "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+                    **manifest_env,
+                },
+            )
+            servers.append(server)
+            return ip
+
+    config = Config(
+        executor_backend="kubernetes",
+        executor_port=port,
+        executor_pod_queue_target_length=1,
+        tpu_hosts_per_slice=2,
+        execution_timeout_s=120.0,
+    )
+    executor = KubernetesCodeExecutor(
+        kubectl=FakeKubectl(DistributedNativeBackend()),
+        storage=storage,
+        config=config,
+    )
+    payload = (
+        "import jax\n"
+        "from bee_code_interpreter_tpu.parallel import initialize_distributed\n"
+        "assert initialize_distributed(), 'pod-group env missing'\n"
+        "assert jax.process_count() == 2, jax.process_count()\n"
+        "import numpy as np\n"
+        "from jax.experimental import multihost_utils\n"
+        "g = multihost_utils.process_allgather(np.array([jax.process_index()]))\n"
+        "print('GANG', sorted(int(x) for x in np.asarray(g).ravel()))\n"
+    )
+    try:
+        result = await executor.execute(payload)
+        assert result.exit_code == 0, result.stderr[-800:]
+        # jax's CPU collective backend (gloo) logs a connection banner to
+        # stdout; the line that matters proves both processes contributed.
+        assert "GANG [0, 1]" in result.stdout, result.stdout
+    finally:
+        for s in servers:
+            s.stop()
