@@ -224,8 +224,14 @@ def forward(
     tokens: jax.Array,  # [B, L] int32
     config: TransformerConfig,
     mesh: Mesh | None = None,
-) -> jax.Array:
-    """Returns logits [B, L, vocab] (f32)."""
+    return_kv: bool = False,
+) -> jax.Array | tuple[jax.Array, tuple[jax.Array, jax.Array]]:
+    """Returns logits [B, L, vocab] (f32).
+
+    With ``return_kv`` (the prefill half of cached decoding), also returns the
+    per-layer post-RoPE K/V stacked [n_layers, B, kv_heads, L, head_dim] —
+    pre-GQA-broadcast, so the cache stores kv_heads not n_heads.
+    """
     c = config
     use_ring = mesh is not None and "sp" in mesh.axis_names and (
         mesh.shape["sp"] > 1
@@ -260,6 +266,7 @@ def forward(
         q = rope(proj(layer["wq"], nh), positions, c.rope_theta)
         k = rope(proj(layer["wk"], kvh), positions, c.rope_theta)
         v = proj(layer["wv"], kvh)
+        kv_out = (k, v) if return_kv else None
         if kvh != nh:  # grouped-query: broadcast kv heads
             rep = nh // kvh
             k = jnp.repeat(k, rep, axis=1)
@@ -279,12 +286,90 @@ def forward(
             "blf,fd->bld", jax.nn.silu(gate) * up, layer["w_down"].astype(c.dtype)
         )
         h = h + constrain(mlp, batch_ax, sp, None)
-        return h, None
+        return h, kv_out
 
-    h, _ = lax.scan(layer_step, h, params["layers"])
+    h, kv = lax.scan(layer_step, h, params["layers"])
     h = rms_norm(h, params["ln_f"])
     logits = jnp.einsum("bld,dv->blv", h, params["lm_head"].astype(c.dtype))
-    return logits.astype(jnp.float32)
+    logits = logits.astype(jnp.float32)
+    if return_kv:
+        return logits, kv
+    return logits
+
+
+# ------------------------------------------------------------- cached decode
+
+
+def decode_step(
+    params: Params,
+    token: jax.Array,  # [B, 1] int32 — the token just produced/fed
+    pos: jax.Array,  # scalar int32: its position in the sequence
+    cache: tuple[jax.Array, jax.Array],  # k,v [n_layers, B, kvh, max, Dh]
+    config: TransformerConfig,
+) -> tuple[jax.Array, tuple[jax.Array, jax.Array]]:
+    """One incremental decode step: O(L) attention against the cache instead
+    of the O(L^2) full re-encode (the round-1 generate). Static shapes: the
+    cache is allocated at its final length and masked by position, so the
+    whole decode loop is one compiled program.
+
+    Runs with plain einsum attention (no pallas/shard_map): a 1-token query
+    is MXU-trivial and GSPMD can shard these einsums over tp on its own.
+    """
+    c = config
+    k_cache, v_cache = cache
+    B = token.shape[0]
+    max_len = k_cache.shape[3]
+    positions = jnp.full((B, 1), pos, dtype=jnp.int32)
+
+    h = params["embed"].astype(c.dtype)[token[:, 0]][:, None, :]  # [B, 1, D]
+
+    def layer_step(h, scanned):
+        layer, k_layer, v_layer = scanned  # caches: [B, kvh, max, Dh]
+        x = rms_norm(h, layer["ln1"])
+        dh, nh, kvh = c.head_dim, c.n_heads, c.kv_heads
+
+        def proj(w, heads):
+            out = jnp.einsum("bld,dk->blk", x, w.astype(c.dtype))
+            return out.reshape(B, 1, heads, dh).transpose(0, 2, 1, 3)
+
+        q = rope(proj(layer["wq"], nh), positions, c.rope_theta)  # [B,nh,1,Dh]
+        k_new = rope(proj(layer["wk"], kvh), positions, c.rope_theta)
+        v_new = proj(layer["wv"], kvh)
+        k_layer = lax.dynamic_update_slice(k_layer, k_new, (0, 0, pos, 0))
+        v_layer = lax.dynamic_update_slice(v_layer, v_new, (0, 0, pos, 0))
+
+        k, v = k_layer, v_layer
+        if kvh != nh:
+            rep = nh // kvh
+            k = jnp.repeat(k, rep, axis=1)
+            v = jnp.repeat(v, rep, axis=1)
+
+        scores = jnp.einsum(
+            "bhqd,bhkd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32)
+        ) / math.sqrt(dh)
+        visible = jnp.arange(max_len) <= pos  # [max]
+        scores = jnp.where(visible[None, None, None, :], scores, -jnp.inf)
+        weights = jax.nn.softmax(scores, axis=-1).astype(c.dtype)
+        attn = jnp.einsum("bhqk,bhkd->bhqd", weights, v)  # [B,nh,1,Dh]
+        attn = attn.transpose(0, 2, 1, 3).reshape(B, 1, nh * dh)
+        h = h + jnp.einsum("blk,kd->bld", attn, layer["wo"].astype(c.dtype))
+
+        y = rms_norm(h, layer["ln2"])
+        gate = jnp.einsum("bld,df->blf", y, layer["w_gate"].astype(c.dtype))
+        up = jnp.einsum("bld,df->blf", y, layer["w_up"].astype(c.dtype))
+        mlp = jnp.einsum(
+            "blf,fd->bld", jax.nn.silu(gate) * up, layer["w_down"].astype(c.dtype)
+        )
+        h = h + mlp
+        return h, (k_layer, v_layer)
+
+    k_cache_t, v_cache_t = k_cache, v_cache
+    h, (k_cache, v_cache) = lax.scan(
+        layer_step, h, (params["layers"], k_cache_t, v_cache_t)
+    )
+    h = rms_norm(h, params["ln_f"])
+    logits = jnp.einsum("bld,dv->blv", h, params["lm_head"].astype(c.dtype))
+    return logits.astype(jnp.float32), (k_cache, v_cache)
 
 
 # ---------------------------------------------------------------- loss/train
@@ -367,5 +452,44 @@ class Transformer:
 
         tokens, _ = lax.scan(
             step, tokens, jnp.arange(L, total), length=max_new_tokens
+        )
+        return tokens
+
+    def generate_cached(
+        self, params: Params, prompt: jax.Array, max_new_tokens: int = 32
+    ) -> jax.Array:
+        """Greedy decode with a KV cache: one O(L^2) prefill, then
+        ``max_new_tokens - 1`` O(L) incremental steps (decode_step). Output
+        is pinned equal to ``generate`` by tests/test_models.py."""
+        c = self.config
+        B, L = prompt.shape
+        total = L + max_new_tokens
+
+        logits, (k_pre, v_pre) = forward(
+            params, prompt, c, self.mesh, return_kv=True
+        )
+        k_cache = jnp.zeros((c.n_layers, B, c.kv_heads, total, c.head_dim), c.dtype)
+        v_cache = jnp.zeros_like(k_cache)
+        k_cache = k_cache.at[:, :, :, :L, :].set(k_pre.astype(c.dtype))
+        v_cache = v_cache.at[:, :, :, :L, :].set(v_pre.astype(c.dtype))
+
+        first = jnp.argmax(logits[:, L - 1 : L, :], axis=-1).astype(jnp.int32)
+        tokens = (
+            jnp.zeros((B, total), dtype=jnp.int32)
+            .at[:, :L].set(prompt)
+            .at[:, L : L + 1].set(first)
+        )
+
+        def step(carry, pos):
+            tokens, current, cache = carry
+            step_logits, cache = decode_step(params, current, pos, cache, c)
+            next_tok = jnp.argmax(step_logits[:, -1:, :], axis=-1).astype(jnp.int32)
+            tokens = lax.dynamic_update_slice(tokens, next_tok, (0, pos + 1))
+            return (tokens, next_tok, cache), None
+
+        (tokens, _, _), _ = lax.scan(
+            step,
+            (tokens, first, (k_cache, v_cache)),
+            jnp.arange(L, total - 1, dtype=jnp.int32),
         )
         return tokens

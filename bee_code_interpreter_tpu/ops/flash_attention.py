@@ -1,4 +1,4 @@
-"""Flash attention for TPU (Pallas forward kernel + blockwise VJP).
+"""Flash attention for TPU (Pallas forward + Pallas backward kernels).
 
 Forward: a Pallas kernel tiled for the MXU — grid (batch·heads, q-blocks,
 k-blocks), the k dimension iterated sequentially ("arbitrary" semantics) with
@@ -7,14 +7,18 @@ across k steps. Scores accumulate in float32 regardless of input dtype
 (bfloat16 inputs hit the MXU, statistics stay fp32). Fully-masked causal
 blocks are skipped with predication. O(L·block) memory instead of O(L²).
 
-Backward: a jax-level *blockwise* recompute using the saved log-sum-exp —
-``lax.scan`` over k-blocks keeps memory at O(L·block) while XLA still maps the
-matmuls onto the MXU. (A hand-written Pallas backward kernel is the listed
-follow-up optimization; the scan already avoids the O(L²) materialization.)
+Backward: two Pallas kernels (FlashAttention-2 split) recomputing P from the
+saved log-sum-exp — one accumulates dK/dV with the q dimension iterated
+sequentially, one accumulates dQ with the k dimension sequential; both skip
+fully-masked causal blocks. ``delta = rowsum(dO·O)`` is precomputed at the
+jax level (one cheap fused reduction). The previous jax-level blockwise scan
+(``_attention_bwd_blockwise``) is kept as the oracle the kernel tests check
+against.
 
-On non-TPU backends (CPU tests) the kernel runs in Pallas interpreter mode.
-Sequence lengths are padded to the block size internally; padded key positions
-are masked out, so any [B, H, L, D] input works.
+On non-TPU backends (CPU tests) the kernels run in Pallas interpreter mode.
+Sequence lengths are padded to the block size internally; padded key (and, in
+the backward, padded query) positions are masked out, so any [B, H, L, D]
+input works.
 """
 
 from __future__ import annotations
@@ -192,6 +196,196 @@ def _attention_bwd_blockwise(q, k, v, o, lse, do, causal, sm_scale, blk_k):
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 
+# ------------------------------------------------------------ pallas backward
+
+
+def _bwd_p_block(q, k, lse_col, row, col, *, sm_scale, causal, seq_len_q, seq_len_k):
+    """Recompute the probability block P = exp(S - lse) with validity masking.
+
+    Padded-row lse is garbage (the forward never normalized those rows), so P
+    must be forced to zero wherever the position pair is invalid — exp of a
+    masked score minus a garbage lse is NOT reliably zero.
+    """
+    scores = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    ) * sm_scale
+    mask = (row < seq_len_q) & (col < seq_len_k)
+    if causal:
+        mask = mask & (row >= col)
+    p = jnp.where(mask, jnp.exp(scores - lse_col), 0.0)
+    return p, mask
+
+
+def _bwd_dkdv_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,  # blocks (see specs)
+    dk_ref, dv_ref,
+    dk_scratch, dv_scratch,  # VMEM f32 [blk_k, D]
+    *, sm_scale: float, causal: bool, blk_q: int, blk_k: int,
+    seq_len_q: int, seq_len_k: int,
+):
+    """Grid (BH, k-blocks, q-blocks): q iterated sequentially, dK/dV for this
+    k-block accumulate in VMEM across q steps."""
+    i = pl.program_id(2)
+    num_q = pl.num_programs(2)
+    j = pl.program_id(1)
+
+    @pl.when(i == 0)
+    def _init():
+        dk_scratch[:] = jnp.zeros_like(dk_scratch)
+        dv_scratch[:] = jnp.zeros_like(dv_scratch)
+
+    q_start = i * blk_q
+    k_start = j * blk_k
+    should_compute = True
+    if causal:  # skip q-blocks entirely above the diagonal
+        should_compute = q_start + blk_q - 1 >= k_start
+
+    @pl.when(should_compute)
+    def _compute():
+        q = q_ref[0]        # [blk_q, D]
+        k = k_ref[0]        # [blk_k, D]
+        do = do_ref[0].astype(jnp.float32)
+        row = q_start + lax.broadcasted_iota(jnp.int32, (blk_q, blk_k), 0)
+        col = k_start + lax.broadcasted_iota(jnp.int32, (blk_q, blk_k), 1)
+        p, _ = _bwd_p_block(
+            q, k, lse_ref[0], row, col, sm_scale=sm_scale, causal=causal,
+            seq_len_q=seq_len_q, seq_len_k=seq_len_k,
+        )
+        dv_scratch[:] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),  # pᵀ · dO -> [blk_k, D]
+            preferred_element_type=jnp.float32,
+        )
+        dp = jax.lax.dot_general(
+            do, v_ref[0], (((1,), (1,)), ((), ())),  # dO · Vᵀ -> [blk_q, blk_k]
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta_ref[0]) * sm_scale
+        dk_scratch[:] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),  # dsᵀ · Q -> [blk_k, D]
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(i == num_q - 1)
+    def _finalize():
+        dk_ref[0] = dk_scratch[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scratch[:].astype(dv_ref.dtype)
+
+
+def _bwd_dq_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+    dq_ref,
+    dq_scratch,  # VMEM f32 [blk_q, D]
+    *, sm_scale: float, causal: bool, blk_q: int, blk_k: int,
+    seq_len_q: int, seq_len_k: int,
+):
+    """Grid (BH, q-blocks, k-blocks): k iterated sequentially, dQ for this
+    q-block accumulates in VMEM across k steps."""
+    j = pl.program_id(2)
+    num_k = pl.num_programs(2)
+    i = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _init():
+        dq_scratch[:] = jnp.zeros_like(dq_scratch)
+
+    q_start = i * blk_q
+    k_start = j * blk_k
+    should_compute = True
+    if causal:
+        should_compute = k_start <= q_start + blk_q - 1
+
+    @pl.when(should_compute)
+    def _compute():
+        q = q_ref[0]
+        k = k_ref[0]
+        do = do_ref[0].astype(jnp.float32)
+        row = q_start + lax.broadcasted_iota(jnp.int32, (blk_q, blk_k), 0)
+        col = k_start + lax.broadcasted_iota(jnp.int32, (blk_q, blk_k), 1)
+        p, _ = _bwd_p_block(
+            q, k, lse_ref[0], row, col, sm_scale=sm_scale, causal=causal,
+            seq_len_q=seq_len_q, seq_len_k=seq_len_k,
+        )
+        dp = jax.lax.dot_general(
+            do, v_ref[0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta_ref[0]) * sm_scale
+        dq_scratch[:] += jax.lax.dot_general(
+            ds, k.astype(jnp.float32), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(j == num_k - 1)
+    def _finalize():
+        dq_ref[0] = dq_scratch[:].astype(dq_ref.dtype)
+
+
+def _flash_bwd_pallas(q, k, v, o, lse, do, causal, sm_scale, blk_q, blk_k, interpret):
+    """dq, dk, dv via the two Pallas kernels. All inputs [BH, L(.), D]."""
+    BH, L, D = q.shape
+    Lk = k.shape[1]
+    Lp = max(blk_q, blk_k) * pl.cdiv(max(L, Lk), max(blk_q, blk_k))
+    qp = _pad_to(q, Lp, 1)
+    kp = _pad_to(k, Lp, 1)
+    vp = _pad_to(v, Lp, 1)
+    dop = _pad_to(do, Lp, 1)
+    # delta = rowsum(dO ⊙ O): one fused jax-level reduction
+    delta = jnp.sum(
+        do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1
+    )  # [BH, L]
+    deltap = _pad_to(delta, Lp, 1)[..., None]  # [BH, Lp, 1]
+    lsep = _pad_to(lse, Lp, 1)[..., None]
+
+    num_q, num_k = Lp // blk_q, Lp // blk_k
+    q_spec = pl.BlockSpec((1, blk_q, D), lambda b, j, i: (b, i, 0))
+    kv_spec = pl.BlockSpec((1, blk_k, D), lambda b, j, i: (b, j, 0))
+    stat_spec = pl.BlockSpec((1, blk_q, 1), lambda b, j, i: (b, i, 0))
+    dkdv = functools.partial(
+        _bwd_dkdv_kernel, sm_scale=sm_scale, causal=causal,
+        blk_q=blk_q, blk_k=blk_k, seq_len_q=L, seq_len_k=Lk,
+    )
+    dk, dv = pl.pallas_call(
+        dkdv,
+        grid=(BH, num_k, num_q),
+        in_specs=[q_spec, kv_spec, kv_spec, q_spec, stat_spec, stat_spec],
+        out_specs=[kv_spec, kv_spec],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, Lp, D), k.dtype),
+            jax.ShapeDtypeStruct((BH, Lp, D), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((blk_k, D), jnp.float32),
+            pltpu.VMEM((blk_k, D), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ) if not interpret else None,
+        interpret=interpret,
+    )(qp, kp, vp, dop, lsep, deltap)
+
+    q_spec2 = pl.BlockSpec((1, blk_q, D), lambda b, i, j: (b, i, 0))
+    kv_spec2 = pl.BlockSpec((1, blk_k, D), lambda b, i, j: (b, j, 0))
+    stat_spec2 = pl.BlockSpec((1, blk_q, 1), lambda b, i, j: (b, i, 0))
+    dqk = functools.partial(
+        _bwd_dq_kernel, sm_scale=sm_scale, causal=causal,
+        blk_q=blk_q, blk_k=blk_k, seq_len_q=L, seq_len_k=Lk,
+    )
+    dq = pl.pallas_call(
+        dqk,
+        grid=(BH, num_q, num_k),
+        in_specs=[q_spec2, kv_spec2, kv_spec2, q_spec2, stat_spec2, stat_spec2],
+        out_specs=q_spec2,
+        out_shape=jax.ShapeDtypeStruct((BH, Lp, D), q.dtype),
+        scratch_shapes=[pltpu.VMEM((blk_q, D), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ) if not interpret else None,
+        interpret=interpret,
+    )(qp, kp, vp, dop, lsep, deltap)
+
+    return dq[:, :L], dk[:, :Lk], dv[:, :Lk]
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def flash_attention(
     q, k, v,
@@ -232,13 +426,18 @@ def _flash_fwd_rule(q, k, v, causal, sm_scale, block_q, block_k, interpret):
 
 def _flash_bwd_rule(causal, sm_scale, block_q, block_k, interpret, residuals, g):
     q, k, v, out, lse = residuals
-    sm_scale, _ = _resolve(q, sm_scale, interpret)
+    sm_scale, interpret = _resolve(q, sm_scale, interpret)
     B, H, L, D = q.shape
     Lk = k.shape[2]
-    dq, dk, dv = _attention_bwd_blockwise(
+    # The backward holds more live f32 blocks than the forward (P, dP, dS plus
+    # two accumulators), so cap its tiles at 512 for VMEM headroom; 512²·f32
+    # intermediates are 1 MB each.
+    blk_q = min(block_q, 512, _round_up(L))
+    blk_k = min(block_k, 512, _round_up(Lk))
+    dq, dk, dv = _flash_bwd_pallas(
         q.reshape(B * H, L, D), k.reshape(B * H, Lk, D), v.reshape(B * H, Lk, D),
         out.reshape(B * H, L, D), lse, g.reshape(B * H, L, D),
-        causal, sm_scale, block_k,
+        causal, sm_scale, blk_q, blk_k, interpret,
     )
     return dq.reshape(B, H, L, D), dk.reshape(B, H, Lk, D), dv.reshape(B, H, Lk, D)
 

@@ -63,3 +63,78 @@ def test_jit_compiles():
     q, k, v = (rand((B, H, L, D), i) for i in range(3))
     out = jax.jit(lambda a, b, c: flash_attention(a, b, c))(q, k, v)
     assert out.shape == (B, H, L, D)
+
+
+def test_pallas_bwd_matches_blockwise_oracle():
+    # The hand-written Pallas backward vs the retained jax-level blockwise
+    # recompute (same lse, same math, independent implementation).
+    import functools
+
+    from bee_code_interpreter_tpu.ops.flash_attention import (
+        _attention_bwd_blockwise,
+        _flash_bwd_pallas,
+        _flash_fwd,
+    )
+
+    B, H, L, D = 2, 3, 192, 64  # L not a multiple of the 128 block
+    keys = jax.random.split(jax.random.PRNGKey(7), 4)
+    q, k, v, do = (
+        jax.random.normal(kk, (B * H, L, D), dtype=jnp.float32) for kk in keys
+    )
+    for causal in (True, False):
+        sm_scale = D**-0.5
+        o4, lse = _flash_fwd(
+            q.reshape(B, H, L, D), k.reshape(B, H, L, D), v.reshape(B, H, L, D),
+            causal, sm_scale, 128, 128, True,
+        )
+        o = o4.reshape(B * H, L, D)
+        got = _flash_bwd_pallas(q, k, v, o, lse, do, causal, sm_scale, 128, 128, True)
+        want = _attention_bwd_blockwise(q, k, v, o, lse, do, causal, sm_scale, 128)
+        for g, w, name in zip(got, want, ("dq", "dk", "dv")):
+            assert jnp.allclose(g, w, atol=2e-4, rtol=2e-4), (causal, name)
+
+
+def test_grad_bf16_matches_reference():
+    # bf16 end-to-end grads vs the dense reference attention at bf16 —
+    # the VERDICT-requested grad-equivalence pin for the Pallas backward.
+    from bee_code_interpreter_tpu.parallel.ring_attention import reference_attention
+
+    B, H, L, D = 1, 2, 256, 64
+    keys = jax.random.split(jax.random.PRNGKey(3), 3)
+    q, k, v = (
+        jax.random.normal(kk, (B, H, L, D), dtype=jnp.bfloat16) for kk in keys
+    )
+
+    def loss_flash(q, k, v):
+        return flash_attention(q, k, v, True).astype(jnp.float32).sum()
+
+    def loss_ref(q, k, v):
+        return reference_attention(q, k, v, causal=True).astype(jnp.float32).sum()
+
+    g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for gf, gr, name in zip(g_flash, g_ref, ("dq", "dk", "dv")):
+        diff = jnp.max(jnp.abs(gf.astype(jnp.float32) - gr.astype(jnp.float32)))
+        assert diff < 0.1, (name, float(diff))  # bf16 resolution over L=256 sums
+
+
+def test_cross_attention_bwd_different_kv_length():
+    # Lq != Lk exercises the padded-row/column masking in both kernels.
+    B, H, Lq, Lk, D = 1, 2, 100, 160, 64
+    keys = jax.random.split(jax.random.PRNGKey(5), 4)
+    q = jax.random.normal(keys[0], (B, H, Lq, D))
+    k = jax.random.normal(keys[1], (B, H, Lk, D))
+    v = jax.random.normal(keys[2], (B, H, Lk, D))
+
+    def loss(q, k, v):
+        return flash_attention(q, k, v, False).sum()
+
+    from bee_code_interpreter_tpu.parallel.ring_attention import reference_attention
+
+    def loss_ref(q, k, v):
+        return reference_attention(q, k, v, causal=False).sum()
+
+    got = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+    want = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for g, w, name in zip(got, want, ("dq", "dk", "dv")):
+        assert jnp.allclose(g, w, atol=1e-4, rtol=1e-4), name

@@ -133,3 +133,47 @@ def test_mnist_dp_training_converges():
         params, opt_state, loss = step(params, opt_state, batch)
         losses.append(float(loss))
     assert losses[-1] < losses[0] * 0.5, losses[::10]
+
+
+def test_generate_cached_matches_uncached():
+    # The cached decode (decode_step + generate_cached) must be token-exact
+    # vs the full-re-encode generate. f32 avoids bf16 argmax tie drift
+    # obscuring a real mismatch.
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+
+    from bee_code_interpreter_tpu.models.transformer import (
+        Transformer,
+        TransformerConfig,
+    )
+
+    config = dataclasses.replace(
+        TransformerConfig.tiny(), dtype=jnp.float32, n_kv_heads=2
+    )
+    model = Transformer(config)
+    params = model.init(jax.random.PRNGKey(0))
+    prompt = jax.random.randint(jax.random.PRNGKey(1), (2, 7), 0, config.vocab_size)
+
+    uncached = model.generate(params, prompt, max_new_tokens=6)
+    cached = model.generate_cached(params, prompt, max_new_tokens=6)
+    assert cached.shape == uncached.shape
+    assert (cached == uncached).all(), (cached, uncached)
+
+
+def test_generate_cached_single_token():
+    # max_new_tokens=1 takes the zero-decode-steps path (prefill only)
+    import jax
+
+    from bee_code_interpreter_tpu.models.transformer import (
+        Transformer,
+        TransformerConfig,
+    )
+
+    model = Transformer(TransformerConfig.tiny())
+    params = model.init(jax.random.PRNGKey(0))
+    prompt = jax.random.randint(jax.random.PRNGKey(1), (1, 5), 0, 256)
+    uncached = model.generate(params, prompt, max_new_tokens=1)
+    cached = model.generate_cached(params, prompt, max_new_tokens=1)
+    assert (cached == uncached).all()
