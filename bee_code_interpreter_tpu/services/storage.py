@@ -154,11 +154,14 @@ class Storage:
         mtime via os.replace (ObjectWriter._finalize) and reads refresh it
         explicitly (reader()), so anything an active session touches stays.
 
-        A residual TOCTOU exists: an identical-content write (or reader
-        utime) landing in the microseconds between the freshness stat and the
-        unlink loses its object — the same order of race S3 lifecycle rules
-        accept; full closure would need per-object locking the flat-file
-        store deliberately avoids.
+        Stale-unlink race closed with a per-object rename guard: the entry is
+        atomically renamed aside, re-stat'ed, and renamed back if something
+        refreshed it between the first stat and the rename. A concurrent
+        identical-content write is unaffected either way (os.replace creates
+        a fresh object under the public name). The one remaining race — a
+        reader touching the object in the instant it is renamed aside — is
+        surfaced to that reader as a missing object, the same outcome S3
+        lifecycle rules produce.
         """
 
         def _sweep_sync() -> int:
@@ -174,11 +177,20 @@ class Storage:
                         continue  # in-flight write
                     if entry.stat().st_mtime >= cutoff:
                         continue
-                    entry.unlink()
-                    removed += 1
+                    guard = self._root / f".tmp-sweep-{entry.name}"
+                    entry.rename(guard)
                 except OSError:
                     # Missing (raced), a directory, permission-denied — skip
                     # this entry, keep sweeping the rest.
+                    continue
+                try:
+                    if guard.stat().st_mtime >= cutoff:
+                        # refreshed between stat and rename: put it back
+                        guard.rename(entry)
+                        continue
+                    guard.unlink()
+                    removed += 1
+                except OSError:
                     continue
             return removed
 
