@@ -202,9 +202,13 @@ def main() -> None:
     # --- 1. the headline TPU number (runs first; ambient accelerator env —
     # including any tunnel plugin vars — flows through the executor's
     # passthrough so the payload sees the real chip) -----------------------
+    # Budgets sized so the worst case (wedged tunnel: TPU payload burns its
+    # full timeout) still leaves room for the CPU + latency measurements
+    # inside a ~600 s driver window. A healthy chip needs ~90 s (init ~20-40,
+    # compile ~20-40, 4 timed chains ~25).
     tpu_gflops: float | None = None
     try:
-        tpu_gflops = asyncio.run(run_payload(TPU_PAYLOAD, {}, timeout_s=360.0))
+        tpu_gflops = asyncio.run(run_payload(TPU_PAYLOAD, {}, timeout_s=300.0))
         print(f"tpu: {tpu_gflops:.1f} GFLOPS", file=sys.stderr)
     except Exception as e:
         print(f"tpu payload failed: {e}", file=sys.stderr)
@@ -218,7 +222,7 @@ def main() -> None:
             run_payload(
                 CPU_PAYLOAD,
                 {"JAX_PLATFORMS": "cpu", "BCI_XLA_REROUTE": "0"},
-                timeout_s=120.0,
+                timeout_s=90.0,
             )
         )
         print(f"cpu baseline: {cpu_gflops:.1f} GFLOPS", file=sys.stderr)
@@ -237,7 +241,7 @@ def main() -> None:
     if binary is not None:
         try:
             latency_p50_ms = asyncio.run(
-                asyncio.wait_for(measure_warm_latency_p50_ms(binary), timeout=120.0)
+                asyncio.wait_for(measure_warm_latency_p50_ms(binary), timeout=90.0)
             )
             if latency_p50_ms is not None:
                 print(f"warm execute p50: {latency_p50_ms:.1f} ms", file=sys.stderr)

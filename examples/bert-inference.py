@@ -1,0 +1,28 @@
+"""BASELINE.json config #4: HuggingFace BERT-base inference via /v1/execute.
+
+Submit as the ``source_code`` of a ``POST /v1/execute``. transformers is
+preinstalled in the sandbox image; the model weights download on first use
+(cached under the workspace, so a warm pool with a shared cache volume pays
+it once). On a TPU sandbox, torch lands on "xla" via the runtime shim; the
+flax path below is the jax-native route and needs no shim at all.
+"""
+
+import time
+
+from transformers import AutoTokenizer, FlaxBertModel
+
+tokenizer = AutoTokenizer.from_pretrained("bert-base-uncased")
+model = FlaxBertModel.from_pretrained("bert-base-uncased")
+
+texts = ["The TPU sandbox runs %d payloads." % i for i in range(32)]
+batch = tokenizer(texts, return_tensors="np", padding="max_length", max_length=128)
+
+model(**batch)  # warm: first call compiles under jit
+t0 = time.time()
+for _ in range(8):
+    out = model(**batch)
+out.last_hidden_state.block_until_ready()
+dt = time.time() - t0
+
+print(f"hidden={out.last_hidden_state.shape}")
+print(f"RESULT_SEQS_PER_S {32 * 8 / dt:.1f}")

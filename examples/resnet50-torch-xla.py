@@ -1,0 +1,36 @@
+"""BASELINE.json config #2: torch ResNet-50 inference through /v1/execute.
+
+Submit this file's source as the ``source_code`` of a ``POST /v1/execute``.
+Inside the TPU sandbox image the runtime shim (runtime/shim/sitecustomize.py)
+sets torch's default device to "xla" when torch_xla is importable, so the
+model and inputs land on the pod's TPU chip without the payload mentioning
+XLA at all — the same transparent-acceleration contract as the numpy reroute.
+On a CPU-only sandbox the exact same payload runs on host torch.
+
+(The reference ships torch CPU wheels in its executor image and this payload
+shape in its BASELINE configs; torchvision is auto-installed by the dep
+guesser on first use.)
+"""
+
+import time
+
+import torch
+import torchvision
+
+model = torchvision.models.resnet50(weights=None).eval()
+device = next(model.parameters()).device  # "xla:0" on TPU sandboxes
+batch = torch.randn(8, 3, 224, 224, device=device)
+
+with torch.no_grad():
+    model(batch)  # warm (first XLA compile happens here)
+    t0 = time.time()
+    for _ in range(8):
+        out = model(batch)
+    if device.type == "xla":
+        import torch_xla.core.xla_model as xm
+
+        xm.mark_step()  # flush the lazy graph before reading the clock
+    dt = time.time() - t0
+
+print(f"device={device} top1={int(out.argmax(1)[0])}")
+print(f"RESULT_IMAGES_PER_S {8 * 8 / dt:.1f}")
