@@ -24,6 +24,7 @@ input works.
 from __future__ import annotations
 
 import functools
+import math
 
 import jax
 import jax.numpy as jnp
@@ -107,10 +108,38 @@ def _pad_to(x, length, axis):
     return jnp.pad(x, widths)
 
 
+def _compatible_blocks(blk_q: int, blk_k: int) -> tuple[int, int]:
+    """Shrink the smaller block to gcd when neither divides the other.
+
+    Rounding the padded length to max(blk_q, blk_k) alone is wrong when the
+    clamped block sizes differ and the larger is not a multiple of the smaller
+    (e.g. L=384 with blk_q=384, blk_k=256 gave Lp=384 → num_k silently
+    truncated to 1 and keys 256..383 were never visited). Padding to
+    lcm instead would inflate compute quadratically (384→768 here); shrinking
+    the smaller block to the gcd (≥128 since both are 128-multiples, so still
+    MXU-aligned) keeps the padding minimal at the cost of a shorter inner
+    block."""
+    if max(blk_q, blk_k) % min(blk_q, blk_k):
+        g = math.gcd(blk_q, blk_k)
+        if blk_q < blk_k:
+            blk_q = g
+        else:
+            blk_k = g
+    return blk_q, blk_k
+
+
+def _padded_len(L: int, Lk: int, blk_q: int, blk_k: int) -> int:
+    """Smallest padded sequence length divisible by both block sizes (after
+    _compatible_blocks, lcm == max)."""
+    unit = math.lcm(blk_q, blk_k)
+    return unit * pl.cdiv(max(L, Lk), unit)
+
+
 def _flash_fwd(q, k, v, causal, sm_scale, blk_q, blk_k, interpret):
     B, H, L, D = q.shape
     Lk = k.shape[2]
-    Lp = max(blk_q, blk_k) * pl.cdiv(max(L, Lk), max(blk_q, blk_k))
+    blk_q, blk_k = _compatible_blocks(blk_q, blk_k)
+    Lp = _padded_len(L, Lk, blk_q, blk_k)
     qp = _pad_to(q.reshape(B * H, L, D), Lp, axis=1)
     kp = _pad_to(k.reshape(B * H, Lk, D), Lp, axis=1)
     vp = _pad_to(v.reshape(B * H, Lk, D), Lp, axis=1)
@@ -255,13 +284,17 @@ def _bwd_dkdv_kernel(
             p, do, (((0,), (0,)), ((), ())),  # pᵀ · dO -> [blk_k, D]
             preferred_element_type=jnp.float32,
         )
+        # operand dtypes matched at f32 (like _bwd_dq_kernel's dq matmul):
+        # Mosaic's mixed-precision dot lowering is unverified on real TPUs
         dp = jax.lax.dot_general(
-            do, v_ref[0], (((1,), (1,)), ((), ())),  # dO · Vᵀ -> [blk_q, blk_k]
+            do, v_ref[0].astype(jnp.float32),  # dO · Vᵀ -> [blk_q, blk_k]
+            (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
         ds = p * (dp - delta_ref[0]) * sm_scale
         dk_scratch[:] += jax.lax.dot_general(
-            ds, q, (((0,), (0,)), ((), ())),  # dsᵀ · Q -> [blk_k, D]
+            ds, q.astype(jnp.float32),  # dsᵀ · Q -> [blk_k, D]
+            (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
 
@@ -306,7 +339,7 @@ def _bwd_dq_kernel(
             seq_len_q=seq_len_q, seq_len_k=seq_len_k,
         )
         dp = jax.lax.dot_general(
-            do, v_ref[0], (((1,), (1,)), ((), ())),
+            do, v_ref[0].astype(jnp.float32), (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
         ds = p * (dp - delta_ref[0]) * sm_scale
@@ -324,7 +357,8 @@ def _flash_bwd_pallas(q, k, v, o, lse, do, causal, sm_scale, blk_q, blk_k, inter
     """dq, dk, dv via the two Pallas kernels. All inputs [BH, L(.), D]."""
     BH, L, D = q.shape
     Lk = k.shape[1]
-    Lp = max(blk_q, blk_k) * pl.cdiv(max(L, Lk), max(blk_q, blk_k))
+    blk_q, blk_k = _compatible_blocks(blk_q, blk_k)
+    Lp = _padded_len(L, Lk, blk_q, blk_k)
     qp = _pad_to(q, Lp, 1)
     kp = _pad_to(k, Lp, 1)
     vp = _pad_to(v, Lp, 1)

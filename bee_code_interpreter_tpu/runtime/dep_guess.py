@@ -136,7 +136,16 @@ PYPI_MAP: dict[str, str] = {
     "langchain": "langchain",
     "anthropic": "anthropic",
     "openai": "openai",
+    # namespace-package second-level names (see NAMESPACE_PREFIXES): the
+    # guesser retains "google.X" instead of truncating to the uninstallable
+    # "google", so these keys are reachable.
     "google.protobuf": "protobuf",
+    "google.auth": "google-auth",
+    "google.oauth2": "google-auth",
+    "google.api_core": "google-api-core",
+    "google.generativeai": "google-generativeai",
+    "google.genai": "google-genai",
+    "google.ads": "google-ads",
     # -- databases / storage ----------------------------------------------
     "psycopg2": "psycopg2-binary",
     "MySQLdb": "mysqlclient",
@@ -398,8 +407,28 @@ SKIP: frozenset[str] = frozenset(
 )
 
 
+# PEP 420 namespace packages whose top-level name is NOT an installable
+# distribution: truncating "google.protobuf" to "google" would pip-install the
+# obsolete `google` dist while the user's import stays broken, so the guesser
+# retains one more path component under these prefixes and the map keys on the
+# level that actually identifies a distribution.
+NAMESPACE_PREFIXES: frozenset[str] = frozenset({"google", "google.cloud"})
+
+
+def _retained_name(dotted: str) -> str:
+    """Truncate a dotted module path to the map-lookup key: the top-level name,
+    extended one level at a time while the prefix is a known namespace."""
+    parts = dotted.split(".")
+    keep = 1
+    while keep < len(parts) and ".".join(parts[:keep]) in NAMESPACE_PREFIXES:
+        keep += 1
+    return ".".join(parts[:keep])
+
+
 def guessed_imports(source_code: str) -> set[str]:
-    """Top-level module names imported (absolutely) anywhere in the source."""
+    """Module names imported (absolutely) anywhere in the source, truncated to
+    the top level — except under namespace packages, where one more component
+    is retained (``google.protobuf``, ``google.cloud.storage``)."""
     try:
         tree = ast.parse(source_code)
     except SyntaxError:
@@ -407,9 +436,17 @@ def guessed_imports(source_code: str) -> set[str]:
     names: set[str] = set()
     for node in ast.walk(tree):
         if isinstance(node, ast.Import):
-            names.update(alias.name.split(".")[0] for alias in node.names)
+            names.update(_retained_name(alias.name) for alias in node.names)
         elif isinstance(node, ast.ImportFrom) and node.level == 0 and node.module:
-            names.add(node.module.split(".")[0])
+            if node.module in NAMESPACE_PREFIXES:
+                # `from google.cloud import storage` — the imported names are
+                # the level that identifies the distribution.
+                names.update(
+                    _retained_name(f"{node.module}.{alias.name}")
+                    for alias in node.names
+                )
+            else:
+                names.add(_retained_name(node.module))
     return names
 
 
@@ -427,9 +464,14 @@ def guess_dependencies(
     deps: set[str] = set()
     pre = {_normalize(p) for p in preinstalled}
     for mod in guessed_imports(source_code):
-        if mod in sys.stdlib_module_names or mod in SKIP or mod in extra_skip:
+        top = mod.split(".", 1)[0]
+        if top in sys.stdlib_module_names or top in SKIP or top in extra_skip:
             continue
-        pkg = PYPI_MAP.get(mod, mod)
+        if mod in NAMESPACE_PREFIXES:
+            continue  # bare `import google` — the namespace itself installs nothing
+        # Unmapped namespace-package names fall back to dots→dashes, which is
+        # the actual convention for e.g. google.cloud.storage → google-cloud-storage.
+        pkg = PYPI_MAP.get(mod, mod.replace(".", "-"))
         if _normalize(pkg) in pre or _normalize(mod) in pre:
             continue
         deps.add(pkg)

@@ -58,6 +58,8 @@ def create_app(core: ExecutorCore) -> web.Application:
 
     async def execute(request: web.Request) -> web.Response:
         body = await request.json()
+        loop = asyncio.get_running_loop()
+        t0 = loop.time()
         outcome = await core.execute(
             source_code=body["source_code"],
             env=body.get("env") or {},
@@ -69,6 +71,8 @@ def create_app(core: ExecutorCore) -> web.Application:
                 "stderr": outcome.stderr,
                 "exit_code": outcome.exit_code,
                 "files": outcome.files,
+                # additive diagnostic, mirrors the C++ server's field
+                "duration_ms": (loop.time() - t0) * 1000,
             }
         )
 

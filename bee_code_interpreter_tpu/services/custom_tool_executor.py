@@ -28,6 +28,7 @@ import ast
 import importlib
 import json
 import re
+import textwrap
 from dataclasses import dataclass, field
 from typing import Any
 
@@ -184,8 +185,11 @@ class CustomToolExecutor:
     def _parse_validated(
         self, tool_source_code: str
     ) -> tuple[CustomTool, list[ast.Import | ast.ImportFrom]]:
+        # Uniformly indented source (an agent lifting a method out of a larger
+        # file) must parse — the reference dedents before parsing
+        # (/root/reference/src/code_interpreter/services/custom_tool_executor.py:59).
         try:
-            tree = ast.parse(tool_source_code)
+            tree = ast.parse(textwrap.dedent(tool_source_code))
         except SyntaxError as e:
             raise CustomToolParseError([f"Syntax error: {e.msg} (line {e.lineno})"]) from e
 
@@ -306,6 +310,7 @@ class CustomToolExecutor:
         env: dict[str, str] | None = None,
     ) -> Any:
         """Run the tool in the sandbox; returns the (JSON-decodable) output value."""
+        tool_source_code = textwrap.dedent(tool_source_code)
         tool, imports = self._parse_validated(tool_source_code)
         import_lines = "\n".join(ast.unparse(n) for n in imports)
 

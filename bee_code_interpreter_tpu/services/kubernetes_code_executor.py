@@ -24,9 +24,12 @@ Lifecycle (mirrors reference :151-264, generalized to groups):
 
 Execution drives all workers SPMD-style: input files are uploaded to every
 worker, ``POST /execute`` fires on all workers concurrently (every JAX process
-must run the same program), and the result is worker 0's stdout/stderr/files
-(JAX convention: process 0 owns I/O), with exit_code the first nonzero across
-workers. Changed files are streamed back into content-addressed storage.
+must run the same program), and the result is worker 0's stdout/stderr (JAX
+convention: process 0 owns I/O), with exit_code the first nonzero across
+workers. Changed files are the **union across the gang** — per-host outputs
+(e.g. orbax sharded checkpoint shards) exist only on their writer, so each
+path is downloaded from the first worker that reported it (worker 0 wins
+collisions on shared names) and streamed into content-addressed storage.
 
 Retries: 3 attempts with exponential backoff on both execute and spawn
 (tenacity; reference :75-79, :191-195).
@@ -153,14 +156,25 @@ class KubernetesCodeExecutor(ExecutorHttpDriver):
             exit_code = next(
                 (r["exit_code"] for r in responses if r["exit_code"] != 0), 0
             )
-            out_files: dict[str, str] = {}
-            for path, object_id in zip(
-                primary["files"],
-                await asyncio.gather(
-                    *(self._download_file(addrs[0], p) for p in primary["files"])
-                ),
-            ):
-                out_files[path] = object_id
+            # Union changed files across the gang: a per-host output (orbax
+            # checkpoint shard, per-process log) exists only on its writer.
+            # Iteration order makes worker 0 win collisions on shared names
+            # (process-0-owns-I/O convention).
+            path_owner: dict[str, str] = {}
+            for addr, response in zip(addrs, responses):
+                for path in response["files"]:
+                    path_owner.setdefault(path, addr)
+            out_files = dict(
+                zip(
+                    path_owner,
+                    await asyncio.gather(
+                        *(
+                            self._download_file(addr, path)
+                            for path, addr in path_owner.items()
+                        )
+                    ),
+                )
+            )
             return Result(
                 stdout=primary["stdout"],
                 stderr=primary["stderr"],

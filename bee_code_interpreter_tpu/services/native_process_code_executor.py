@@ -124,6 +124,12 @@ class NativeProcessCodeExecutor(ExecutorHttpDriver):
         )
         self._stdlib_file_path: str | None = None
         self._stdlib_lock = asyncio.Lock()
+        # Per-request phase breakdown of the most recent execute() (diagnostic
+        # surface for bench.py / scripts/measure-latency.py: lets a latency
+        # regression be attributed to acquire/upload/server/download/overhead
+        # instead of guessed at). Overwritten per request; read it before
+        # issuing the next one.
+        self.last_execute_phases: dict[str, float | bool] = {}
 
     async def _stdlib_file(self) -> str | None:
         """Stdlib module list for the dep guesser, generated once per service
@@ -188,16 +194,22 @@ class NativeProcessCodeExecutor(ExecutorHttpDriver):
     ) -> Result:
         files = files or {}
         env = env or {}
+        perf = asyncio.get_running_loop().time
+        t_start = perf()
+        was_warm = bool(self._queue)
         async with self.sandbox() as box:
+            t_acquired = perf()
             await asyncio.gather(
                 *(
                     self._upload_file(box.addr, path, object_id)
                     for path, object_id in files.items()
                 )
             )
+            t_uploaded = perf()
             response = await self._post_execute(
                 box.addr, source_code, env, self._effective_timeout(timeout_s)
             )
+            t_executed = perf()
             out_files: dict[str, str] = {}
             for path, object_id in zip(
                 response["files"],
@@ -206,6 +218,22 @@ class NativeProcessCodeExecutor(ExecutorHttpDriver):
                 ),
             ):
                 out_files[path] = object_id
+            t_done = perf()
+            # sandbox_ms is the server-reported subprocess wall time; the gap
+            # post_execute_ms − sandbox_ms is pure control-plane + HTTP
+            # overhead — where event-loop contention (e.g. pool refills)
+            # shows up.
+            sandbox_ms = float(response.get("duration_ms") or 0.0)
+            self.last_execute_phases = {
+                "acquire_ms": (t_acquired - t_start) * 1000,
+                "warm_pop": was_warm,
+                "upload_ms": (t_uploaded - t_acquired) * 1000,
+                "post_execute_ms": (t_executed - t_uploaded) * 1000,
+                "sandbox_ms": sandbox_ms,
+                "overhead_ms": (t_executed - t_uploaded) * 1000 - sandbox_ms,
+                "download_ms": (t_done - t_executed) * 1000,
+                "total_ms": (t_done - t_start) * 1000,
+            }
             return Result(
                 stdout=response["stdout"],
                 stderr=response["stderr"],
@@ -330,9 +358,9 @@ class NativeProcessCodeExecutor(ExecutorHttpDriver):
         )
         box = NativeSandbox(proc=proc, addr=addr, workspace=workspace)
         try:
-            deadline = (
-                asyncio.get_running_loop().time() + cfg.pod_ready_timeout_s
-            )
+            loop = asyncio.get_running_loop()
+            deadline = loop.time() + cfg.pod_ready_timeout_s
+            warm_deadline: float | None = None  # set at first healthy
             while True:
                 if proc.poll() is not None:
                     raise RuntimeError(
@@ -341,10 +369,21 @@ class NativeProcessCodeExecutor(ExecutorHttpDriver):
                 try:
                     response = await self._http.get(f"http://{addr}/healthz")
                     if response.status_code == 200:
-                        return box
-                except httpx.TransportError:
+                        # Best-effort: hold the sandbox back until its warm
+                        # worker finished preloading, so requests never pay
+                        # the preload wait. A slow preload (up to 15 s past
+                        # healthy, or the ready deadline if sooner) queues the
+                        # healthy-but-cold sandbox anyway — the server's own
+                        # warm-wait/cold-fallback covers it.
+                        if warm_deadline is None:
+                            warm_deadline = min(loop.time() + 15.0, deadline)
+                        if response.json().get("warm", True):
+                            return box
+                        if loop.time() > warm_deadline:
+                            return box
+                except (httpx.TransportError, ValueError):
                     pass
-                if asyncio.get_running_loop().time() > deadline:
+                if loop.time() > deadline:
                     raise RuntimeError(
                         f"native executor on {addr} never became ready"
                     )

@@ -162,6 +162,11 @@ class Storage:
         reader touching the object in the instant it is renamed aside — is
         surfaced to that reader as a missing object, the same outcome S3
         lifecycle rules produce.
+
+        A crash between the rename-aside and its resolution would otherwise
+        strand the object as ``.tmp-sweep-*`` forever (every future sweep
+        skips ``.tmp-`` names), so each sweep first recovers orphaned guards:
+        put fresh ones back under their public name, unlink expired ones.
         """
 
         def _sweep_sync() -> int:
@@ -171,6 +176,25 @@ class Storage:
                 return 0
             cutoff = time.time() - max_age_s
             removed = 0
+            for entry in self._root.iterdir():
+                if not entry.name.startswith(".tmp-sweep-"):
+                    continue
+                public = self._root / entry.name.removeprefix(".tmp-sweep-")
+                try:
+                    if entry.stat().st_mtime >= cutoff:
+                        # A live object a crashed sweep renamed aside. Restore
+                        # no-clobber (link fails with EEXIST): a fresh write
+                        # that recreated the public name is newer — prefer it.
+                        try:
+                            os.link(entry, public)
+                        except FileExistsError:
+                            pass
+                        entry.unlink()
+                    else:
+                        entry.unlink()
+                        removed += 1
+                except OSError:
+                    continue
             for entry in self._root.iterdir():
                 try:
                     if entry.name.startswith(".tmp-"):

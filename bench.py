@@ -106,6 +106,59 @@ RECORDED_CPU_GFLOPS = 120.0
 LATENCY_PAYLOAD = "print(21 * 2)"
 
 
+def probe_tpu(timeout_s: float = 75.0) -> dict:
+    """Bounded out-of-process probe of the JAX accelerator backend.
+
+    Two rounds of driver artifacts couldn't distinguish "chip absent" from
+    "backend init hung" from "payload too slow" (VERDICT r2 weak #1); this
+    records which. A hung tunnel hangs the subprocess, not the bench.
+    """
+    t0 = time.time()
+    try:
+        out = subprocess.run(
+            [
+                sys.executable,
+                "-c",
+                "import jax; ds = jax.devices(); "
+                "print('PROBE', ds[0].platform, len(ds))",
+            ],
+            capture_output=True,
+            text=True,
+            timeout=timeout_s,
+        )
+        seconds = round(time.time() - t0, 1)
+        for line in out.stdout.splitlines():
+            if line.startswith("PROBE "):
+                _, platform, count = line.split()
+                return {
+                    "ok": True,
+                    "seconds": seconds,
+                    "platform": platform,
+                    "device_count": int(count),
+                }
+        return {
+            "ok": False,
+            "seconds": seconds,
+            "error": f"probe exited {out.returncode} without a device line",
+            "stderr_tail": out.stderr[-400:],
+        }
+    except subprocess.TimeoutExpired as e:
+        return {
+            "ok": False,
+            "seconds": round(time.time() - t0, 1),
+            "error": f"jax.devices() hung past {timeout_s:.0f}s (wedged TPU tunnel)",
+            "stderr_tail": ((e.stderr or b"").decode("utf-8", "replace"))[-400:],
+        }
+
+
+class PayloadError(RuntimeError):
+    """Payload failure carrying the sandbox stderr for the bench artifact."""
+
+    def __init__(self, msg: str, stderr: str = "") -> None:
+        super().__init__(msg)
+        self.stderr = stderr
+
+
 async def run_payload(
     source: str, env: dict[str, str], timeout_s: float
 ) -> float:
@@ -125,11 +178,13 @@ async def run_payload(
     result = await executor.execute(source, env=env)
     if result.exit_code != 0:
         print(result.stderr, file=sys.stderr)
-        raise RuntimeError(f"payload failed (exit {result.exit_code})")
+        raise PayloadError(
+            f"payload failed (exit {result.exit_code})", stderr=result.stderr
+        )
     for line in result.stdout.splitlines():
         if line.startswith("RESULT_GFLOPS"):
             return float(line.split()[1])
-    raise RuntimeError(f"no result in stdout: {result.stdout!r}")
+    raise PayloadError(f"no result in stdout: {result.stdout!r}")
 
 
 def scrub_tunnel_vars() -> None:
@@ -164,10 +219,14 @@ def ensure_native_binary() -> Path | None:
     return binary
 
 
-async def measure_warm_latency_p50_ms(binary: Path, n: int = 20) -> float | None:
-    """p50 of a trivial execute through the warm native-executor pool
-    (BASELINE.md north-star #3; scripts/measure-latency.py is the full
-    percentile harness)."""
+async def measure_warm_latency_p50_ms(
+    binary: Path, n: int = 20
+) -> tuple[float, dict] | None:
+    """p50 of a trivial execute through the warm native-executor pool, plus a
+    per-phase p50 breakdown (acquire / upload / POST / in-sandbox / overhead /
+    download) so a regressed number names its phase instead of inviting
+    guesses about host load (VERDICT r2 weak #2). scripts/measure-latency.py
+    is the full percentile harness."""
     from bee_code_interpreter_tpu.config import Config
     from bee_code_interpreter_tpu.services.native_process_code_executor import (
         NativeProcessCodeExecutor,
@@ -186,32 +245,109 @@ async def measure_warm_latency_p50_ms(binary: Path, n: int = 20) -> float | None
     )
     try:
         await executor.fill_sandbox_queue()
-        samples = []
-        for _ in range(n):
+        samples: list[float] = []
+        phase_samples: list[dict] = []
+        for i in range(n):
+            if i:
+                # Pace requests: this measures warm-pool REQUEST latency, not
+                # saturated throughput (back-to-back requests outrun the
+                # refill pipeline and every pop hits a sandbox whose warm
+                # interpreter is still preloading — that's a throughput
+                # ceiling, a different metric). The sleep is excluded from
+                # the samples.
+                await asyncio.sleep(0.35)
             t0 = time.perf_counter()
             result = await executor.execute(LATENCY_PAYLOAD)
             if result.stdout != "42\n":
                 raise RuntimeError(f"latency payload failed: {result.stderr}")
             samples.append(time.perf_counter() - t0)
-        return statistics.median(samples) * 1000
+            phase_samples.append(dict(executor.last_execute_phases))
+        phases_p50 = {
+            key: round(
+                statistics.median(
+                    float(p.get(key, 0.0)) for p in phase_samples
+                ),
+                1,
+            )
+            for key in (
+                "acquire_ms",
+                "upload_ms",
+                "post_execute_ms",
+                "sandbox_ms",
+                "overhead_ms",
+                "download_ms",
+            )
+        }
+        phases_p50["warm_pop_rate"] = round(
+            sum(1 for p in phase_samples if p.get("warm_pop")) / len(phase_samples),
+            2,
+        )
+        return statistics.median(samples) * 1000, phases_p50
     finally:
         executor.shutdown()
+
+
+def diagnose_tpu_failure(probe: dict, attempts: list[dict]) -> str:
+    """Machine-readable reason the headline number is absent, naming the
+    failing stage (probe vs init vs payload) — VERDICT r2 next-round #1."""
+    if not probe.get("ok"):
+        return f"tpu_backend_unreachable: {probe.get('error', 'probe failed')}"
+    if probe.get("platform") != "tpu":
+        return (
+            f"no_tpu_device: jax backend here is '{probe.get('platform')}' "
+            f"({probe.get('device_count')} devices)"
+        )
+    last = attempts[-1] if attempts else {}
+    text = (last.get("error", "") + " " + last.get("stderr_tail", "")).lower()
+    if "timed out" in text or "exit -1" in text:
+        return (
+            "payload_timeout: chip probed ok but the matmul payload exceeded "
+            "its budget (backend init or compile hung in-sandbox)"
+        )
+    return f"payload_error: {last.get('error', 'unknown')}"
 
 
 def main() -> None:
     # --- 1. the headline TPU number (runs first; ambient accelerator env —
     # including any tunnel plugin vars — flows through the executor's
     # passthrough so the payload sees the real chip) -----------------------
-    # Budgets sized so the worst case (wedged tunnel: TPU payload burns its
-    # full timeout) still leaves room for the CPU + latency measurements
-    # inside a ~600 s driver window. A healthy chip needs ~90 s (init ~20-40,
-    # compile ~20-40, 4 timed chains ~25).
+    # Self-diagnosing: a bounded out-of-process probe records whether the
+    # backend is reachable at all, then the payload gets up to 3 attempts
+    # spread across the window (a wedged tunnel can recover); every failure
+    # lands in the JSON with its stderr tail. Budgets sized so the worst case
+    # (probe 75 s + attempts 210+90+60 s) still leaves room for the CPU +
+    # latency measurements inside the driver window. A healthy chip needs
+    # ~90 s (init ~20-40, compile ~20-40, 4 timed chains ~25).
+    tpu_probe = probe_tpu()
+    print(f"tpu probe: {tpu_probe}", file=sys.stderr)
+    chip_likely = tpu_probe.get("ok") and tpu_probe.get("platform") == "tpu"
+    # An unreachable/CPU probe still gets one bounded attempt — tunnels recover
+    attempt_budgets = [210.0, 90.0, 60.0] if chip_likely else [90.0]
+
     tpu_gflops: float | None = None
-    try:
-        tpu_gflops = asyncio.run(run_payload(TPU_PAYLOAD, {}, timeout_s=300.0))
-        print(f"tpu: {tpu_gflops:.1f} GFLOPS", file=sys.stderr)
-    except Exception as e:
-        print(f"tpu payload failed: {e}", file=sys.stderr)
+    tpu_attempts: list[dict] = []
+    for timeout_s in attempt_budgets:
+        t0 = time.time()
+        try:
+            tpu_gflops = asyncio.run(
+                run_payload(TPU_PAYLOAD, {}, timeout_s=timeout_s)
+            )
+            tpu_attempts.append(
+                {"ok": True, "seconds": round(time.time() - t0, 1)}
+            )
+            print(f"tpu: {tpu_gflops:.1f} GFLOPS", file=sys.stderr)
+            break
+        except Exception as e:
+            entry: dict = {
+                "ok": False,
+                "seconds": round(time.time() - t0, 1),
+                "error": str(e)[:300],
+            }
+            stderr_tail = getattr(e, "stderr", "")
+            if stderr_tail:
+                entry["stderr_tail"] = stderr_tail[-400:]
+            tpu_attempts.append(entry)
+            print(f"tpu payload attempt failed: {e}", file=sys.stderr)
 
     # --- 2. CPU baseline (guarded: can only degrade vs_baseline) ----------
     scrub_tunnel_vars()
@@ -237,14 +373,20 @@ def main() -> None:
 
     # --- 3. warm-pool execute latency (guarded; extra field) --------------
     latency_p50_ms: float | None = None
+    latency_phases: dict | None = None
     binary = ensure_native_binary()
     if binary is not None:
         try:
-            latency_p50_ms = asyncio.run(
+            measured = asyncio.run(
                 asyncio.wait_for(measure_warm_latency_p50_ms(binary), timeout=90.0)
             )
-            if latency_p50_ms is not None:
-                print(f"warm execute p50: {latency_p50_ms:.1f} ms", file=sys.stderr)
+            if measured is not None:
+                latency_p50_ms, latency_phases = measured
+                print(
+                    f"warm execute p50: {latency_p50_ms:.1f} ms "
+                    f"(phases {latency_phases})",
+                    file=sys.stderr,
+                )
         except Exception as e:
             print(f"latency measurement failed: {e}", file=sys.stderr)
 
@@ -255,16 +397,21 @@ def main() -> None:
             "unit": "GFLOPS",
             "vs_baseline": round(tpu_gflops / cpu_gflops, 2),
         }
-    else:  # no chip reachable: report the CPU path honestly
+    else:  # no chip reachable: report the CPU path honestly, with the reason
         result = {
             "metric": "dense matmul GFLOPS via /v1/execute (CPU fallback - no TPU reachable)",
             "value": round(cpu_gflops, 1),
             "unit": "GFLOPS",
             "vs_baseline": 1.0,
+            "tpu_diagnosis": diagnose_tpu_failure(tpu_probe, tpu_attempts),
         }
+    result["tpu_probe"] = tpu_probe
+    result["tpu_attempts"] = tpu_attempts
     result["latency_warm_p50_ms"] = (
         round(latency_p50_ms, 1) if latency_p50_ms is not None else None
     )
+    if latency_phases is not None:
+        result["latency_phases_p50"] = latency_phases
     result["cpu_baseline_gflops"] = round(cpu_gflops, 1)
     # "recorded" = the live CPU run failed and vs_baseline uses the recorded
     # machine-class figure — a constant must never masquerade as a measurement

@@ -51,20 +51,88 @@ inline const std::set<std::string>& builtin_skip() {
   return skip;
 }
 
-// Top-level module names from absolute `import X` / `from X import ...`
-// statements. A line-based scan is sufficient for dependency *guessing*
-// (imports hidden behind exec/getattr are out of scope, same as upm).
+// PEP 420 namespace packages whose top-level name is NOT an installable
+// distribution (mirrors dep_guess.py NAMESPACE_PREFIXES): retain one more
+// path component under these so the map can key on the level that actually
+// identifies a distribution ("google.protobuf" -> protobuf).
+inline const std::set<std::string>& namespace_prefixes() {
+  static const std::set<std::string> prefixes = {"google", "google.cloud"};
+  return prefixes;
+}
+
+// Truncate a dotted module path to the map-lookup key: the top-level name,
+// extended one level at a time while the prefix is a known namespace.
+inline std::string retained_name(const std::string& dotted) {
+  size_t end = dotted.find('.');
+  while (end != std::string::npos &&
+         namespace_prefixes().count(dotted.substr(0, end))) {
+    end = dotted.find('.', end + 1);
+  }
+  return dotted.substr(0, end);
+}
+
+// Module names from absolute `import X` / `from X import ...` statements,
+// truncated to the top level — except under namespace packages, where one
+// more component is retained. A line-based scan is sufficient for dependency
+// *guessing* (imports hidden behind exec/getattr are out of scope, same as upm).
 inline std::set<std::string> guessed_imports(const std::string& source) {
   static const std::regex import_re(R"(^\s*import\s+(.+?)\s*$)");
-  static const std::regex from_re(R"(^\s*from\s+([A-Za-z_][\w.]*)\s+import\b)");
+  static const std::regex from_re(
+      R"(^\s*from\s+([A-Za-z_][\w.]*)\s+import\b\s*(.*))");
+  static const std::regex import_start_re(R"(^\s*(from|import)\b)");
   std::set<std::string> names;
   std::istringstream stream(source);
   std::string line;
+  auto paren_balance = [](const std::string& s) {
+    int b = 0;
+    for (char c : s) {
+      if (c == '(') ++b;
+      else if (c == ')') --b;
+    }
+    return b;
+  };
   while (std::getline(stream, line)) {
+    // Join parenthesized continuations so
+    // `from google.cloud import (\n  storage,\n  bigquery,\n)` scans as one
+    // logical line (the Python AST oracle sees it that way for free). Gated
+    // on lines that actually START with from/import — an unbalanced '(' in
+    // an arbitrary line (string literal, comment) must not swallow genuine
+    // import lines after it.
+    if (std::regex_search(line, import_start_re)) {
+      int balance = paren_balance(line);
+      std::string next;
+      while (balance > 0 && std::getline(stream, next)) {
+        line += " " + next;
+        balance += paren_balance(next);
+      }
+    }
     std::smatch m;
     if (std::regex_search(line, m, from_re)) {
       std::string mod = m[1].str();
-      names.insert(mod.substr(0, mod.find('.')));
+      if (namespace_prefixes().count(mod)) {
+        // `from google.cloud import storage, bigquery` — the imported names
+        // are the level that identifies the distribution.
+        std::string rest = m[2].str();
+        auto hash = rest.find('#');
+        if (hash != std::string::npos) rest.resize(hash);
+        std::istringstream parts(rest);
+        std::string part;
+        while (std::getline(parts, part, ',')) {
+          part.erase(std::remove_if(part.begin(), part.end(),
+                                    [](char c) { return c == '(' || c == ')'; }),
+                     part.end());
+          std::istringstream words(part);
+          std::string name;
+          words >> name;  // first token; ignores "as alias"
+          if (name.empty() || name == "*") continue;
+          bool valid = true;
+          for (char c : name)
+            if (!(isalnum(static_cast<unsigned char>(c)) || c == '_')) valid = false;
+          if (valid) names.insert(retained_name(mod + "." + name));
+        }
+      } else {
+        names.insert(retained_name(mod));
+      }
     } else if (std::regex_match(line, m, import_re)) {
       // "import a.b as c, d" -> a, d ; strip trailing comments
       std::string rest = m[1].str();
@@ -84,7 +152,7 @@ inline std::set<std::string> guessed_imports(const std::string& source) {
             break;
           }
         }
-        if (valid) names.insert(mod.substr(0, mod.find('.')));
+        if (valid) names.insert(retained_name(mod));
       }
     }
   }
@@ -99,9 +167,19 @@ struct Guesser {
   std::vector<std::string> guess(const std::string& source) const {
     std::vector<std::string> deps;
     for (const auto& mod : guessed_imports(source)) {
-      if (stdlib.count(mod) || builtin_skip().count(mod)) continue;
+      std::string top = mod.substr(0, mod.find('.'));
+      if (stdlib.count(top) || builtin_skip().count(top)) continue;
+      if (namespace_prefixes().count(mod)) continue;  // bare `import google`
       auto it = pypi_map.find(mod);
-      std::string pkg = it == pypi_map.end() ? mod : it->second;
+      std::string pkg;
+      if (it != pypi_map.end()) {
+        pkg = it->second;
+      } else {
+        // Unmapped namespace names fall back to dots→dashes — the actual
+        // convention for e.g. google.cloud.storage → google-cloud-storage.
+        pkg = mod;
+        std::replace(pkg.begin(), pkg.end(), '.', '-');
+      }
       if (preinstalled.count(normalize(pkg)) || preinstalled.count(normalize(mod)))
         continue;
       deps.push_back(pkg);

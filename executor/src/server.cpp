@@ -146,13 +146,21 @@ for _m in os.environ.pop("APP_PRESTART_IMPORTS", "numpy").split(","):
         except Exception:
             pass
 _preload_done.set()
+# Preload-done byte ('P') on the status pipe: lets the server tell a ready
+# worker from one still importing — a request that doesn't need the preloaded
+# modules runs cold immediately instead of blocking on the import.
+try:
+    os.write(3, b"P")
+except OSError:
+    pass
 
 _req = json.loads(sys.stdin.readline())
 # Started byte on the status pipe: the server now knows user code WILL run,
-# so it must never cold-retry this request (side effects would double).
+# so it must never cold-retry this request (side effects would double). The
+# pipe stays open — the exit-code report ("X<code>") follows when user code
+# finishes.
 try:
     os.write(3, b"S")
-    os.close(3)
 except OSError:
     pass
 os.dup2(_saved_out, 1)
@@ -196,14 +204,61 @@ _g = {
     "__package__": None,
     "__spec__": None,
 }
+# Exit-code report, registered BEFORE user code so it runs LAST among atexit
+# handlers (atexit is LIFO): flush + report the script's exit code on the
+# status pipe and close stdio, so the server can respond while interpreter
+# finalization (slow with a scientific stack loaded) continues behind it.
+#
+# The report runs before finalization's own io flush, so a file handle user
+# code left open (module-global `f = open(...); f.write(...)`) would still
+# hold buffered bytes when the server snapshots the workspace. builtins.open
+# is wrapped to track live file objects (weakly); the reporter flushes the
+# writable ones first.
+import atexit, builtins, weakref
+_open_files = weakref.WeakSet()
+_orig_open = builtins.open
+def _tracking_open(*_a, **_kw):
+    _f = _orig_open(*_a, **_kw)
+    try:
+        _open_files.add(_f)
+    except TypeError:
+        pass
+    return _f
+builtins.open = _tracking_open
+_exit_state = {"code": 0}
+def _report_exit():
+    for _f in list(_open_files):
+        try:
+            if not _f.closed and _f.writable():
+                _f.flush()
+        except Exception:
+            pass
+    try:
+        sys.stdout.flush(); sys.stderr.flush()
+    except Exception:
+        pass
+    try:
+        os.write(3, ("X%d\n" % _exit_state["code"]).encode())
+        os.close(3)
+    except OSError:
+        pass
+    for _fd in (1, 2):
+        try:
+            os.close(_fd)
+        except OSError:
+            pass
+atexit.register(_report_exit)
 try:
     exec(compile(_code, _req["script"], "exec"), _g)
-except SystemExit:
+except SystemExit as _se:
+    _c = _se.code
+    _exit_state["code"] = _c if isinstance(_c, int) else (0 if _c is None else 1)
     raise
 except BaseException:
     import traceback
     _tp, _e, _tb = sys.exc_info()
     traceback.print_exception(_tp, _e, _tb.tb_next)  # drop bootstrap frame
+    _exit_state["code"] = 1
     sys.exit(1)
 )PY";
 
@@ -254,7 +309,34 @@ class Executor {
 
   minihttp::Response handle(const minihttp::Request& req) {
     if (req.path == "/healthz") {
-      return {200, "application/json", "{\"status\":\"ok\"}", {}};
+      // "warm": the pre-started worker finished its preload ('P' on the
+      // status pipe) — the pool queues sandboxes only once warm (best
+      // effort), keeping the preload wait off the request path. True when
+      // prestart is disabled or the worker was already claimed.
+      bool warm = true;
+      {
+        std::lock_guard<std::mutex> lock(prestart_mutex_);
+        if (prestart_.valid() && !prestart_warm_seen_) {
+          pollfd p{prestart_.status_fd, POLLIN, 0};
+          if (poll(&p, 1, 0) > 0 && (p.revents & (POLLIN | POLLHUP))) {
+            char b = 0;
+            ssize_t n = read(prestart_.status_fd, &b, 1);
+            if (n == 1 && b == 'P') {
+              prestart_warm_seen_ = true;
+            } else if (n == 0) {
+              // EOF before 'P': the worker died preloading (e.g. its hung-
+              // preload guard fired). Cold fallback is as warm as this
+              // sandbox gets — report warm so the pool stops holding it.
+              prestart_warm_seen_ = true;
+            }
+          }
+          warm = prestart_warm_seen_;
+        }
+      }
+      return {200, "application/json",
+              std::string("{\"status\":\"ok\",\"warm\":") +
+                  (warm ? "true" : "false") + "}",
+              {}};
     }
     if (req.path.rfind("/workspace/", 0) == 0) {
       auto real = workspace::resolve(config_.workspace_root, req.path);
@@ -265,6 +347,13 @@ class Executor {
     }
     if (req.path == "/execute" && req.method == "POST") return execute(req.body);
     return {404, "application/json", "{}", {}};
+  }
+
+  // --guess CLI mode only: run the guesser exactly as a request would
+  // (including lazy stdlib loading), without the install step.
+  std::vector<std::string> guess_for_debug(const std::string& source) {
+    std::call_once(stdlib_loaded_, [this] { load_stdlib(); });
+    return guesser_.guess(source);
   }
 
   void warmup() {
@@ -315,7 +404,11 @@ class Executor {
 
     auto before = workspace::snapshot(config_.workspace_root);
     std::string pip_notes = ensure_dependencies(source);
+    auto t0 = std::chrono::steady_clock::now();
     auto result = run_python(source, request_env, timeout);
+    double run_ms = std::chrono::duration<double, std::milli>(
+                        std::chrono::steady_clock::now() - t0)
+                        .count();
     auto after = workspace::snapshot(config_.workspace_root);
 
     minijson::Array files;
@@ -331,6 +424,11 @@ class Executor {
         {"stderr", stderr_out},
         {"exit_code", result.exit_code},
         {"files", std::move(files)},
+        // Additive diagnostic: in-sandbox wall time of the user subprocess.
+        // Client-side (POST latency − duration_ms) isolates control-plane
+        // overhead (event-loop contention, refill interference) from the
+        // sandbox's own run time without a wire-contract break.
+        {"duration_ms", run_ms},
     };
     return {200, "application/json", minijson::dump(minijson::Value(std::move(resp))), {}};
   }
@@ -393,6 +491,12 @@ class Executor {
       // after that the pid may be recycled, so never signal it again.
       const bool was_alive = worker.alive();
       bool kill_worker = false;
+      // Always prefer the warm worker, even mid-preload: a cold interpreter
+      // is not reliably cheap (a host sitecustomize that registers an
+      // accelerator plugin costs ~600 ms per python startup — measured), so
+      // blocking on the remaining preload is the bounded-loss choice. The
+      // pool keeps this path rare by only queueing sandboxes whose preload
+      // has finished (the /healthz "warm" field).
       if (was_alive &&
           send_prestart_request(worker, script.string(), request_env)) {
         // Phase 1: wait for the started byte — written right before user
@@ -408,17 +512,18 @@ class Executor {
             std::max(0.0, preload_deadline_s_ - since_spawn) + 2.0;
         const auto t0 = std::chrono::steady_clock::now();
         if (subprocess::wait_for_status_byte(
-                worker.status_fd, std::min(timeout_s, guard_remaining))) {
-          close(worker.status_fd);
-          worker.status_fd = -1;
-          // Charge the phase-1 wait against the request budget: collect()
-          // must not restart a full budget or the warm path could run for
-          // guard+timeout, past what the control-plane client waits for.
+                worker.status_fd, std::min(timeout_s, guard_remaining), 'S')) {
+          // status_fd stays open: the exit-code report ("X<code>") arrives
+          // on it when user code finishes. Charge the phase-1 wait against
+          // the request budget: collect_warm() must not restart a full
+          // budget or the warm path could run for guard+timeout, past what
+          // the control-plane client waits for.
           const double waited =
               std::chrono::duration<double>(std::chrono::steady_clock::now() -
                                             t0)
                   .count();
-          result = subprocess::collect(worker, std::max(0.5, timeout_s - waited));
+          result =
+              subprocess::collect_warm(worker, std::max(0.5, timeout_s - waited));
           ran_warm = true;
         } else {
           // preload never finished: cold-retry with the remaining budget
@@ -445,7 +550,7 @@ class Executor {
           // double-execute it. One final drain of the (now-EOF'd) status
           // pipe tells us for certain.
           started_after_deadline =
-              subprocess::wait_for_status_byte(worker.status_fd, 0.05);
+              subprocess::wait_for_status_byte(worker.status_fd, 0.05, 'S');
         }
         worker.close_fds();
       }
@@ -585,13 +690,28 @@ class Executor {
   std::mutex installed_mutex_;
   subprocess::Child prestart_;
   std::mutex prestart_mutex_;
+  bool prestart_warm_seen_ = false;
   std::chrono::steady_clock::time_point prestart_spawned_at_;
   double preload_deadline_s_ = 45.0;
 };
 
 }  // namespace
 
-int main() {
+int main(int argc, char** argv) {
+  // Debug/parity mode: `executor-server --guess < source.py` prints the
+  // guessed PyPI deps one per line (stdlib set from APP_STDLIB_FILE or the
+  // interpreter, map from APP_PYPI_MAP). Lets tests pin the native guesser
+  // against the Python oracle without booting the HTTP server.
+  if (argc > 1 && std::string(argv[1]) == "--guess") {
+    ExecutorConfig config;
+    Executor executor(config);
+    std::stringstream source;
+    source << std::cin.rdbuf();
+    for (const auto& dep : executor.guess_for_debug(source.str()))
+      std::cout << dep << "\n";
+    return 0;
+  }
+
   // A dead pre-started worker must surface as a failed write (→ cold-path
   // fallback), not a fatal SIGPIPE.
   signal(SIGPIPE, SIG_IGN);

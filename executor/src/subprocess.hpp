@@ -14,10 +14,12 @@
 #include <sys/wait.h>
 #include <unistd.h>
 
+#include <algorithm>
 #include <chrono>
 #include <cstring>
 #include <map>
 #include <string>
+#include <thread>
 #include <vector>
 
 namespace subprocess {
@@ -62,7 +64,11 @@ struct Child {
 
 // Block up to timeout_s for one byte on a status fd. True iff a byte arrived;
 // false on EOF (writer died without reporting) or deadline.
-inline bool wait_for_status_byte(int fd, double timeout_s) {
+// Waits for a specific status byte on the pipe, skipping earlier protocol
+// bytes (the warm worker writes 'P' at preload-done, then 'S' right before
+// user code runs; a caller waiting for 'S' must tolerate an unconsumed 'P').
+// expected == 0 accepts any byte.
+inline bool wait_for_status_byte(int fd, double timeout_s, char expected = 0) {
   if (fd < 0) return false;
   auto deadline = std::chrono::steady_clock::now() +
                   std::chrono::duration<double>(timeout_s);
@@ -70,17 +76,21 @@ inline bool wait_for_status_byte(int fd, double timeout_s) {
     auto remaining = std::chrono::duration_cast<std::chrono::milliseconds>(
                          deadline - std::chrono::steady_clock::now())
                          .count();
-    if (remaining <= 0) return false;
     pollfd p{fd, POLLIN, 0};
-    int rc = poll(&p, 1, static_cast<int>(std::min<long long>(remaining, 1000)));
+    int rc = poll(&p, 1,
+                  static_cast<int>(std::clamp<long long>(remaining, 0, 1000)));
     if (rc < 0) return false;
     if (p.revents & (POLLIN | POLLHUP)) {
       char b;
       ssize_t n = read(fd, &b, 1);
-      if (n == 1) return true;
+      if (n == 1) {
+        if (expected == 0 || b == expected) return true;
+        continue;  // earlier protocol byte; keep draining
+      }
       if (n == 0) return false;  // EOF: writer exited silently
       if (errno != EAGAIN && errno != EINTR) return false;
     }
+    if (remaining <= 0) return false;
   }
 }
 
@@ -222,6 +232,115 @@ inline RunResult collect(Child child, double timeout_s) {
     result.exit_code = WEXITSTATUS(status);
   } else if (WIFSIGNALED(status)) {
     result.exit_code = -WTERMSIG(status);
+  }
+  return result;
+}
+
+// Warm-worker collect: the bootstrap reports the script's exit code on the
+// status pipe ("X<code>\n") and closes its stdio as soon as user code and
+// user atexit handlers finish, so the response doesn't wait out interpreter
+// finalization (~100 ms with a scientific stack loaded — measured as the
+// whole warm-path latency floor). The zombie is reaped on a detached thread.
+// Falls back to a blocking reap when the worker dies without reporting
+// (crash/signal/user closed fd 3).
+inline RunResult collect_warm(Child child, double timeout_s) {
+  if (!child.valid()) return {"", "spawn failed", -1, false};
+  if (child.stdin_fd >= 0) { close(child.stdin_fd); child.stdin_fd = -1; }
+  int out_pipe0 = child.out_fd, err_pipe0 = child.err_fd;
+  pid_t pid = child.pid;
+
+  RunResult result;
+  auto deadline = std::chrono::steady_clock::now() +
+                  std::chrono::duration<double>(timeout_s);
+  bool out_open = true, err_open = true;
+  char buf[1 << 16];
+  while (out_open || err_open) {
+    auto remaining = std::chrono::duration_cast<std::chrono::milliseconds>(
+                         deadline - std::chrono::steady_clock::now())
+                         .count();
+    if (remaining <= 0) {
+      result.timed_out = true;
+      kill(-pid, SIGKILL);
+      break;
+    }
+    pollfd fds[2];
+    nfds_t nfds = 0;
+    if (out_open) fds[nfds++] = {out_pipe0, POLLIN, 0};
+    if (err_open) fds[nfds++] = {err_pipe0, POLLIN, 0};
+    int rc = poll(fds, nfds, static_cast<int>(std::min<long long>(remaining, 1000)));
+    if (rc < 0) break;
+    for (nfds_t i = 0; i < nfds; ++i) {
+      if (!(fds[i].revents & (POLLIN | POLLHUP))) continue;
+      ssize_t n = read(fds[i].fd, buf, sizeof buf);
+      bool is_out = fds[i].fd == out_pipe0;
+      if (n > 0) {
+        (is_out ? result.out : result.err).append(buf, static_cast<size_t>(n));
+      } else if (n == 0 || (n < 0 && errno != EAGAIN)) {
+        if (is_out) out_open = false; else err_open = false;
+      }
+    }
+  }
+  close(out_pipe0);
+  close(err_pipe0);
+
+  if (result.timed_out) {
+    if (child.status_fd >= 0) { close(child.status_fd); child.status_fd = -1; }
+    int status = 0;
+    waitpid(pid, &status, 0);
+    result.out.clear();
+    result.err = kTimeoutMessage;
+    result.exit_code = -1;
+    return result;
+  }
+
+  // Exit-code line ("X<code>\n") — normally already buffered when the pipes
+  // EOF'd. Bounded by the REQUEST deadline, not a flat grace: user code that
+  // closes its own stdio (both pipes EOF immediately) and keeps running must
+  // still be limited by the execution timeout, and the fallback reap below
+  // must never block on a live worker.
+  std::string line;
+  bool got_code = false;
+  if (child.status_fd >= 0) {
+    while (std::chrono::steady_clock::now() < deadline) {
+      pollfd p{child.status_fd, POLLIN, 0};
+      if (poll(&p, 1, 100) <= 0) continue;
+      if (!(p.revents & (POLLIN | POLLHUP))) continue;
+      char b;
+      ssize_t n = read(child.status_fd, &b, 1);
+      if (n <= 0) break;  // EOF: worker exited without reporting
+      if (b == '\n') {
+        got_code = !line.empty() && line[0] == 'X';
+        break;
+      }
+      line.push_back(b);
+    }
+    close(child.status_fd);
+    child.status_fd = -1;
+  }
+  if (got_code) {
+    result.exit_code = atoi(line.c_str() + 1);
+    std::thread([pid] {
+      int status = 0;
+      waitpid(pid, &status, 0);
+    }).detach();
+  } else {
+    // No report: crashed worker (already dead — kill is a no-op) or stdio
+    // closed by user code and the deadline elapsed (still running — kill
+    // enforces the budget). Either way the reap below cannot block.
+    const bool deadline_hit = std::chrono::steady_clock::now() >= deadline;
+    kill(-pid, SIGKILL);
+    int status = 0;
+    waitpid(pid, &status, 0);
+    if (deadline_hit) {
+      result.out.clear();
+      result.err = kTimeoutMessage;
+      result.exit_code = -1;
+      result.timed_out = true;
+    } else if (WIFEXITED(status)) {
+      result.exit_code = WEXITSTATUS(status);
+    } else if (WIFSIGNALED(status)) {
+      result.exit_code = -WTERMSIG(status);
+    }
   }
   return result;
 }

@@ -198,3 +198,26 @@ async def test_async_tool_supported(tool_executor):
         '{"a": 2, "b": 3}',
     )
     assert out == 5
+
+
+def test_parse_indented_tool_source(tool_executor):
+    # A uniformly indented tool (an agent lifting a function out of a larger
+    # file) parses on the reference via textwrap.dedent
+    # (its custom_tool_executor.py:59) and must parse here too.
+    tool = tool_executor.parse(
+        "    def shifted(a: int) -> int:\n"
+        '        """Doubles.\n\n        :param a: value\n        :return: doubled\n        """\n'
+        "        return a * 2\n"
+    )
+    assert tool.name == "shifted"
+    assert tool.input_schema["properties"]["a"]["type"] == "integer"
+
+
+async def test_execute_indented_tool_source(tool_executor):
+    out = await tool_executor.execute(
+        "    import math\n"
+        "    def hypot_tool(a: float, b: float) -> float:\n"
+        "        return math.hypot(a, b)\n",
+        '{"a": 3, "b": 4}',
+    )
+    assert out == 5.0

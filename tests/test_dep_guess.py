@@ -70,6 +70,35 @@ def test_image_skip_file_blocks_os_and_accel_names():
     assert guess_dependencies(src, preinstalled=pre) == []
 
 
+def test_namespace_package_imports_resolve_past_top_level():
+    # `import google.protobuf` must NOT install the obsolete `google` dist:
+    # the guesser retains the second level so the map entry is reachable
+    # (ADVICE r2: first-dot truncation made "google.protobuf" a dead row).
+    src = (
+        "import google.protobuf\n"
+        "from google.protobuf import json_format\n"
+        "import google.generativeai as genai\n"
+        "from google.cloud import storage\n"
+        "from google import auth\n"
+    )
+    assert guessed_imports(src) == {
+        "google.protobuf",
+        "google.generativeai",
+        "google.cloud.storage",
+        "google.auth",
+    }
+    assert guess_dependencies(src) == [
+        "google-auth",
+        "google-cloud-storage",
+        "google-generativeai",
+        "protobuf",
+    ]
+
+
+def test_bare_namespace_import_installs_nothing():
+    assert guess_dependencies("import google\n") == []
+
+
 def test_pypi_map_tsv_in_sync_with_oracle():
     # The C++ server loads executor/pypi_map.tsv; it must match the Python
     # oracle exactly (regenerate with scripts/generate-pypi-map.py).

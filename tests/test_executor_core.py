@@ -150,21 +150,35 @@ def test_snapshot_diff(tmp_path):
 
 
 async def test_timeout_kills_grandchildren(tmp_path):
-    core = make_core(tmp_path, default_timeout_s=1.0)
+    # 3 s budget: interpreter startup alone costs ~0.6 s on hosts whose
+    # sitecustomize registers an accelerator plugin; the timeout must fire
+    # after the payload has written pid.txt, not during python boot.
+    core = make_core(tmp_path, default_timeout_s=3.0)
+    marker = "grandchild-timeout-probe"
     out = await core.execute(
         "import subprocess, sys, time\n"
         "p = subprocess.Popen([sys.executable, '-c', "
-        "'import time; time.sleep(60); open(\"orphan.txt\",\"w\").write(\"x\")'])\n"
+        f"'_ = \"{marker}\"; import time; time.sleep(60)'])\n"
         "open('pid.txt','w').write(str(p.pid))\n"
         "time.sleep(60)\n"
     )
     assert out.exit_code == -1
     pid = int((core.workspace / "pid.txt").read_text())
     import time
-    for _ in range(20):  # grandchild should be gone promptly
+    from pathlib import Path
+
+    def grandchild_alive() -> bool:
+        # pid-identity check: with pid_max 32768 a busy host recycles pids
+        # within a suite run, so a bare os.kill(pid, 0) probe can hit an
+        # unrelated process and report a phantom survivor.
         try:
-            os.kill(pid, 0)
-        except ProcessLookupError:
+            cmdline = Path(f"/proc/{pid}/cmdline").read_bytes()
+        except (FileNotFoundError, ProcessLookupError):
+            return False
+        return marker.encode() in cmdline
+
+    for _ in range(20):  # grandchild should be gone promptly
+        if not grandchild_alive():
             break
         time.sleep(0.1)
     else:

@@ -138,3 +138,32 @@ def test_cross_attention_bwd_different_kv_length():
     want = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
     for g, w, name in zip(got, want, ("dq", "dk", "dv")):
         assert jnp.allclose(g, w, atol=1e-4, rtol=1e-4), name
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_mismatched_block_sizes_visit_all_keys(causal):
+    # Regression (ADVICE r2): L=384 with block_q=1024, block_k=256 rounded the
+    # padded length to 384, silently truncating num_k to 1 — keys 256..383
+    # were never visited. The padded length must be a common multiple of both
+    # (clamped) block sizes.
+    B, H, L, D = 1, 2, 384, 32
+    q, k, v = (rand((B, H, L, D), i) for i in range(3))
+    out = flash_attention(q, k, v, causal, None, 1024, 256)
+    ref = reference_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+
+def test_mismatched_block_sizes_grads():
+    B, H, L, D = 1, 1, 384, 16
+    q, k, v = (rand((B, H, L, D), i) for i in range(3))
+
+    def loss(q, k, v):
+        return (flash_attention(q, k, v, True, None, 1024, 256) ** 2).sum()
+
+    def ref_loss(q, k, v):
+        return (reference_attention(q, k, v, causal=True) ** 2).sum()
+
+    got = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+    want = jax.grad(ref_loss, argnums=(0, 1, 2))(q, k, v)
+    for g, w in zip(got, want):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(w), atol=5e-4, rtol=5e-4)

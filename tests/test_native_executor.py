@@ -76,14 +76,32 @@ def native(tmp_path):
 
 
 def test_healthz(native):
-    assert httpx.get(native.base + "/healthz").json() == {"status": "ok"}
+    body = httpx.get(native.base + "/healthz").json()
+    assert body["status"] == "ok"
+    # "warm" reports whether the pre-started worker finished preloading;
+    # it flips true (and stays true) within the preload budget
+    assert isinstance(body["warm"], bool)
+    deadline = time.time() + 30
+    while not httpx.get(native.base + "/healthz").json()["warm"]:
+        assert time.time() < deadline, "worker never reported warm"
+        time.sleep(0.1)
+
+
+def strip_diagnostics(response: dict) -> dict:
+    """Drop additive diagnostic fields, asserting their shape; what remains is
+    the reference wire contract and is compared exactly."""
+    duration = response.pop("duration_ms")
+    assert isinstance(duration, (int, float)) and duration >= 0
+    return response
 
 
 def test_execute_basic(native):
     r = httpx.post(
         native.base + "/execute", json={"source_code": "print(21 * 2)"}
     ).json()
-    assert r == {"stdout": "42\n", "stderr": "", "exit_code": 0, "files": []}
+    assert strip_diagnostics(r) == {
+        "stdout": "42\n", "stderr": "", "exit_code": 0, "files": [],
+    }
 
 
 def test_upload_execute_download_roundtrip(native):
@@ -255,7 +273,7 @@ def test_consecutive_executes_after_warm_worker_consumed(native):
             native.base + "/execute",
             json={"source_code": f"print('{expected}')"},
         ).json()
-        assert r == {
+        assert strip_diagnostics(r) == {
             "stdout": f"{expected}\n", "stderr": "", "exit_code": 0, "files": [],
         }
 
@@ -270,7 +288,9 @@ def test_prestart_disabled_parity(tmp_path):
                 "env": {"X": "y"},
             },
         ).json()
-        assert r == {"stdout": "y 42\n", "stderr": "", "exit_code": 0, "files": []}
+        assert strip_diagnostics(r) == {
+            "stdout": "y 42\n", "stderr": "", "exit_code": 0, "files": [],
+        }
     finally:
         server.stop()
 
@@ -616,3 +636,97 @@ async def test_pod_group_runs_cross_process_collective(tmp_path, storage):
     finally:
         for s in servers:
             s.stop()
+
+
+def test_guess_cli_matches_python_oracle(tmp_path):
+    # The native guesser and the Python oracle must agree — including on
+    # namespace packages, where first-dot truncation used to make every
+    # google.* map row unreachable (ADVICE r2).
+    from bee_code_interpreter_tpu.runtime.dep_guess import guess_dependencies
+
+    sources = [
+        "import numpy\nimport cv2\nfrom PIL import Image\nimport cowsay\n",
+        "import google.protobuf\nfrom google.protobuf import json_format\n",
+        "from google.cloud import storage, bigquery\nimport google\n",
+        "from google import auth\nimport google.generativeai as genai\n",
+        "import yaml, requests\nfrom bs4 import BeautifulSoup\n",
+        "from google.cloud import (storage, bigquery)\n",
+        "from google.cloud import (storage)\n",
+        "from google.cloud import (\n    storage,\n    bigquery,\n)\n",
+        # an unbalanced '(' inside a string literal must not swallow the
+        # genuine import on the next line
+        'print("to import, call f(x")\nimport numpy\n',
+        "from numpy import(array)\n",  # no space after import
+    ]
+    stdlib_file = tmp_path / "stdlib_names.txt"
+    stdlib_file.write_text("\n".join(sorted(sys.stdlib_module_names)) + "\n")
+    for source in sources:
+        out = subprocess.run(
+            [str(BINARY), "--guess"],
+            input=source,
+            capture_output=True,
+            text=True,
+            timeout=30,
+            env={
+                "PATH": "/usr/local/bin:/usr/bin:/bin",
+                "APP_PYPI_MAP": str(EXECUTOR_DIR / "pypi_map.tsv"),
+                "APP_STDLIB_FILE": str(stdlib_file),
+                "APP_PRESTART": "0",
+                "APP_WORKSPACE": str(tmp_path / "ws"),
+            },
+        )
+        assert out.returncode == 0, out.stderr
+        native_deps = [l for l in out.stdout.splitlines() if l]
+        assert native_deps == guess_dependencies(source), source
+
+
+def test_warm_exit_report_flushes_unclosed_files(native):
+    # The warm worker reports its exit code before interpreter finalization;
+    # a module-global file handle user code never closed must still have its
+    # buffered bytes on disk when the server snapshots the workspace.
+    r = httpx.post(
+        native.base + "/execute",
+        json={
+            "source_code": (
+                "f = open('left-open.txt', 'w')\n"
+                "f.write('buffered data that only finalization would flush')\n"
+            )
+        },
+    ).json()
+    assert r["exit_code"] == 0
+    assert r["files"] == ["/workspace/left-open.txt"]
+    body = httpx.get(native.base + "/workspace/left-open.txt")
+    assert body.text == "buffered data that only finalization would flush"
+
+
+def test_stdio_closed_payload_still_bounded_by_timeout(native):
+    # User code that closes its own stdout/stderr EOFs both pipes instantly;
+    # the server must still enforce the execution timeout instead of blocking
+    # forever on the reap (review r3 finding).
+    t0 = time.time()
+    r = httpx.post(
+        native.base + "/execute",
+        json={
+            "source_code": (
+                "import os, time\n"
+                "os.close(1)\nos.close(2)\n"
+                "time.sleep(60)\n"
+            ),
+            "timeout": 2,
+        },
+        timeout=30,
+    ).json()
+    assert r["exit_code"] == -1
+    assert r["stderr"] == "Execution timed out"
+    assert time.time() - t0 < 15
+
+
+def test_os_exit_payload_reports_real_code(native):
+    # os._exit skips atexit (no exit-code report line); the fallback reap
+    # must still return the real code promptly.
+    r = httpx.post(
+        native.base + "/execute",
+        json={"source_code": "import os\nos._exit(5)"},
+        timeout=30,
+    ).json()
+    assert r["exit_code"] == 5

@@ -205,8 +205,16 @@ async def test_concurrent_executes_pool_accounting(storage, tmp_path, native_bin
         )
         assert [r.stdout for r in results] == [f"{i * 10}\n" for i in range(10)]
         assert all(r.exit_code == 0 for r in results)
-        # let in-flight refills settle, then check the invariant
+        # let in-flight refills settle (spawns now hold sandboxes back until
+        # their warm worker preloads, so give the pipeline time), then check
+        # the invariant
         await executor.fill_sandbox_queue()
+        deadline = asyncio.get_running_loop().time() + 30
+        while (
+            executor.pool_ready_count == 0
+            and asyncio.get_running_loop().time() < deadline
+        ):
+            await asyncio.sleep(0.1)
         assert (
             executor.pool_ready_count + executor.pool_spawning_count
             <= config.executor_pod_queue_target_length

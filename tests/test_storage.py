@@ -81,6 +81,46 @@ async def test_sweep_skips_inflight_writes(storage: Storage, tmp_path):
     assert await storage.exists(w.hash)
 
 
+async def test_sweep_recovers_orphaned_guards(storage: Storage, tmp_path):
+    # A sweep that crashed between rename-aside and resolution leaves
+    # .tmp-sweep-<id> entries; the next sweep must restore fresh ones under
+    # their public name and unlink expired ones (ADVICE r2: otherwise a
+    # permanent disk leak every future sweep skips).
+    import os
+    import time
+
+    root = tmp_path / "objects"
+    live_id = await storage.write(b"live object a crashed sweep set aside")
+    (root / live_id).rename(root / f".tmp-sweep-{live_id}")
+    dead_id = await storage.write(b"expired object a crashed sweep set aside")
+    dead_guard = root / f".tmp-sweep-{dead_id}"
+    (root / dead_id).rename(dead_guard)
+    past = time.time() - 10_000
+    os.utime(dead_guard, (past, past))
+
+    removed = await storage.sweep(max_age_s=500)
+    assert removed == 1
+    assert await storage.read(live_id) == b"live object a crashed sweep set aside"
+    assert not await storage.exists(dead_id)
+    assert [p for p in root.iterdir() if p.name.startswith(".tmp-sweep-")] == []
+
+
+async def test_sweep_orphan_recovery_prefers_newer_public_write(
+    storage: Storage, tmp_path
+):
+    # If an identical-content write recreated the public name after the crash,
+    # the restore must not clobber it — the orphan is simply dropped.
+    root = tmp_path / "objects"
+    object_id = await storage.write(b"v1 content")
+    (root / object_id).rename(root / f".tmp-sweep-{object_id}")
+    # content-addressed: same bytes recreate the same public name
+    assert await storage.write(b"v1 content") == object_id
+
+    await storage.sweep(max_age_s=500)
+    assert await storage.read(object_id) == b"v1 content"
+    assert [p for p in root.iterdir() if p.name.startswith(".tmp-sweep-")] == []
+
+
 async def test_read_refreshes_ttl(tmp_path):
     # A session that only restores a file (never rewrites it) must keep it
     # alive under the TTL sweep: reads mark use. Touch-on-read is opt-in —
