@@ -108,6 +108,17 @@ class Config(BaseModel):
     # executor (the test fake the reference never had; SURVEY.md §4) is used.
     local_executor_binary: str | None = None
     local_workspace_root: str = "./.tmp/workspaces"
+    # Opt-in native-mode hardening: spawn each sandbox server inside its own
+    # mount namespace (unshare) with the object-storage root overmounted by
+    # an empty tmpfs, and the capability bounding set emptied (setpriv) so
+    # user code cannot umount its way back to other sessions' files. Without
+    # setpriv on PATH the overmount only guards against accidental access.
+    # This is a mitigation, NOT an isolation boundary — native mode still
+    # runs user code as the service's own user on a shared kernel; for
+    # untrusted multi-tenant input use the Kubernetes backend (single-use
+    # pod + optional gVisor via executor_pod_spec_extra). See
+    # docs/architecture.md "Isolation and trust model".
+    sandbox_unshare: bool = False
     # Disable auto `pip install` of guessed deps (tests / air-gapped envs).
     disable_dep_install: bool = False
     # Directory prepended to every sandbox process's PYTHONPATH so the

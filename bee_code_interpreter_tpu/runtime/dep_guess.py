@@ -387,6 +387,241 @@ PYPI_MAP: dict[str, str] = {
     "mss": "mss",
 }
 
+# Long-tail import aliases (the reference ships upm's full pypi_map.sqlite,
+# thousands of rows, its executor/Dockerfile:124-126; this environment has no
+# egress to fetch it, so the tail is curated: every entry below is a real
+# import-name → distribution-name divergence, several harvested from installed
+# package metadata by `scripts/generate-pypi-map.py --harvest`).
+PYPI_MAP.update({
+    # -- verified from installed-dist metadata ---------------------------
+    "Box2D": "box2d-py",
+    "OpenGL": "PyOpenGL",
+    "absl": "absl-py",
+    "clang": "libclang",
+    "elftools": "pyelftools",
+    "grpc_status": "grpcio-status",
+    "grpc_tools": "grpcio-tools",
+    # (orbax / haiku deliberately absent: those imports are in SKIP — the
+    # pinned accelerator stack must never be auto-installed)
+    "markdown_it": "markdown-it-py",
+    "opentelemetry": "opentelemetry-api",
+    "proto": "proto-plus",
+    "pythonjsonlogger": "python-json-logger",
+    "rpds": "rpds-py",
+    "tlz": "toolz",
+    "tree": "dm-tree",
+    "vertexai": "google-cloud-aiplatform",
+    # -- classic traps (import name != dist name) ------------------------
+    "MeCab": "mecab-python3",
+    "RPi": "RPi.GPIO",
+    "airflow": "apache-airflow",
+    "alpha_vantage": "alpha-vantage",
+    "ansible_runner": "ansible-runner",
+    "barcode": "python-barcode",
+    "binance": "python-binance",
+    "bluetooth": "PyBluez",
+    "brownie": "eth-brownie",
+    "can": "python-can",
+    "capnp": "pycapnp",
+    "cpuinfo": "py-cpuinfo",
+    "daemon": "python-daemon",
+    "darts": "u8darts",
+    "decouple": "python-decouple",
+    "digitalocean": "python-digitalocean",
+    "dns": "dnspython",
+    "ee": "earthengine-api",
+    "eyed3": "eyeD3",
+    "factory": "factory-boy",
+    "faiss": "faiss-cpu",
+    "finnhub": "finnhub-python",
+    "fireworks": "fireworks-ai",
+    "flash_attn": "flash-attn",
+    "fluidsynth": "pyFluidSynth",
+    "gin": "gin-config",
+    "hydra": "hydra-core",
+    "imblearn": "imbalanced-learn",
+    "impala": "impyla",
+    "llama_cpp": "llama-cpp-python",
+    "mega": "mega.py",
+    "midiutil": "MIDIUtil",
+    "nasdaqdatalink": "Nasdaq-Data-Link",
+    "nio": "matrix-nio",
+    "office365": "Office365-REST-Python-Client",
+    "opensearchpy": "opensearch-py",
+    "paddle": "paddlepaddle",
+    "piptools": "pip-tools",
+    "polygon": "polygon-api-client",
+    "pyannote": "pyannote.audio",
+    "pythoncom": "pywin32",
+    "pywintypes": "pywin32",
+    "rapidjson": "python-rapidjson",
+    "rocksdb": "python-rocksdb",
+    "skbio": "scikit-bio",
+    "slack": "slackclient",
+    "snappy": "python-snappy",
+    "speedtest": "speedtest-cli",
+    "spellchecker": "pyspellchecker",
+    "talib": "TA-Lib",
+    "tortoise": "tortoise-orm",
+    "vcr": "vcrpy",
+    "vcf": "PyVCF3",
+    "weaviate": "weaviate-client",
+    "webview": "pywebview",
+    "whois": "python-whois",
+    "win32api": "pywin32",
+    "win32clipboard": "pywin32",
+    "win32com": "pywin32",
+    "win32con": "pywin32",
+    "win32event": "pywin32",
+    "win32file": "pywin32",
+    "win32gui": "pywin32",
+    "win32process": "pywin32",
+    "win32ui": "pywin32",
+    "zipline": "zipline-reloaded",
+    # -- flask / django ecosystem ----------------------------------------
+    "allauth": "django-allauth",
+    "colorfield": "django-colorfield",
+    "crispy_forms": "django-crispy-forms",
+    "debug_toolbar": "django-debug-toolbar",
+    "django_celery_beat": "django-celery-beat",
+    "django_celery_results": "django-celery-results",
+    "django_extensions": "django-extensions",
+    "django_filters": "django-filter",
+    "environ": "django-environ",
+    "flask_admin": "Flask-Admin",
+    "flask_apscheduler": "Flask-APScheduler",
+    "flask_babel": "Flask-Babel",
+    "flask_bcrypt": "Flask-Bcrypt",
+    "flask_caching": "Flask-Caching",
+    "flask_compress": "Flask-Compress",
+    "flask_jwt_extended": "Flask-JWT-Extended",
+    "flask_limiter": "Flask-Limiter",
+    "flask_mail": "Flask-Mail",
+    "flask_marshmallow": "flask-marshmallow",
+    "flask_session": "Flask-Session",
+    "flask_socketio": "Flask-SocketIO",
+    "flask_talisman": "flask-talisman",
+    "import_export": "django-import-export",
+    "knox": "django-rest-knox",
+    "mptt": "django-mptt",
+    "oauth2_provider": "django-oauth-toolkit",
+    "phonenumber_field": "django-phonenumber-field",
+    "rest_framework_simplejwt": "djangorestframework-simplejwt",
+    "silk": "django-silk",
+    "simple_history": "django-simple-history",
+    "storages": "django-storages",
+    "taggit": "django-taggit",
+    # -- web / http extras -----------------------------------------------
+    "aiohttp_cors": "aiohttp-cors",
+    "aiohttp_jinja2": "aiohttp-jinja2",
+    "deep_translator": "deep-translator",
+    "fastapi_pagination": "fastapi-pagination",
+    "fastapi_users": "fastapi-users",
+    "googlesearch": "googlesearch-python",
+    "httpx_sse": "httpx-sse",
+    "linkedin_api": "linkedin-api",
+    "lxml_html_clean": "lxml-html-clean",
+    "mechanicalsoup": "MechanicalSoup",
+    "requests_cache": "requests-cache",
+    "requests_html": "requests-html",
+    "seleniumwire": "selenium-wire",
+    "sse_starlette": "sse-starlette",
+    "undetected_chromedriver": "undetected-chromedriver",
+    "webdriver_manager": "webdriver-manager",
+    # -- data / ML -------------------------------------------------------
+    "category_encoders": "category-encoders",
+    "efficientnet_pytorch": "efficientnet-pytorch",
+    "feature_engine": "feature-engine",
+    "keras_cv": "keras-cv",
+    "keras_nlp": "keras-nlp",
+    "keras_tuner": "keras-tuner",
+    "ml_collections": "ml-collections",
+    "mlx_lm": "mlx-lm",
+    "pandas_profiling": "pandas-profiling",
+    "pytorch_lightning": "pytorch-lightning",
+    "sb3_contrib": "sb3-contrib",
+    "scikit_posthocs": "scikit-posthocs",
+    "segmentation_models_pytorch": "segmentation-models-pytorch",
+    "sklearn_pandas": "sklearn-pandas",
+    "stable_baselines3": "stable-baselines3",
+    "tensorflow_addons": "tensorflow-addons",
+    "tensorflow_datasets": "tensorflow-datasets",
+    "tensorflow_hub": "tensorflow-hub",
+    "tensorflow_probability": "tensorflow-probability",
+    "tensorflow_text": "tensorflow-text",
+    "tflite_runtime": "tflite-runtime",
+    "ydata_profiling": "ydata-profiling",
+    # -- LLM / vector stores ---------------------------------------------
+    "langchain_anthropic": "langchain-anthropic",
+    "langchain_community": "langchain-community",
+    "langchain_core": "langchain-core",
+    "langchain_openai": "langchain-openai",
+    "llama_index": "llama-index",
+    "qdrant_client": "qdrant-client",
+    "rank_bm25": "rank-bm25",
+    "semantic_kernel": "semantic-kernel",
+    # -- NLP / text ------------------------------------------------------
+    "bert_score": "bert-score",
+    "camel_tools": "camel-tools",
+    "email_reply_parser": "email-reply-parser",
+    "imap_tools": "imap-tools",
+    "indic_transliteration": "indic-transliteration",
+    "korean_lunar_calendar": "korean-lunar-calendar",
+    "mailparser": "mail-parser",
+    "rouge_score": "rouge-score",
+    # -- imaging / media -------------------------------------------------
+    "blend_modes": "blend-modes",
+    "imagehash": "ImageHash",
+    "perlin_noise": "perlin-noise",
+    "psd_tools": "psd-tools",
+    "pydrive": "PyDrive",
+    "pydrive2": "PyDrive2",
+    "pyrebase": "Pyrebase4",
+    "sv_ttk": "sv-ttk",
+    # -- infra / db ------------------------------------------------------
+    "clickhouse_connect": "clickhouse-connect",
+    "clickhouse_driver": "clickhouse-driver",
+    "cron_descriptor": "cron-descriptor",
+    "elasticsearch_dsl": "elasticsearch-dsl",
+    "firebase_admin": "firebase-admin",
+    "ibm_db": "ibm-db",
+    "influxdb_client": "influxdb-client",
+    "jsonpath_ng": "jsonpath-ng",
+    "linode_api4": "linode-api4",
+    "mailjet_rest": "mailjet-rest",
+    "matrix_client": "matrix-client",
+    "model_bakery": "model-bakery",
+    "prometheus_api_client": "prometheus-api-client",
+    "pykube": "pykube-ng",
+    "slack_bolt": "slack-bolt",
+    "vertica_python": "vertica-python",
+    # -- finance ---------------------------------------------------------
+    "alpaca": "alpaca-py",
+    "forex_python": "forex-python",
+    "pandas_market_calendars": "pandas-market-calendars",
+    "tradingview_ta": "tradingview-ta",
+    "yahoo_fin": "yahoo-fin",
+    # -- crypto / eth ----------------------------------------------------
+    "eth_abi": "eth-abi",
+    "eth_keys": "eth-keys",
+    "eth_typing": "eth-typing",
+    "eth_utils": "eth-utils",
+    "slither": "slither-analyzer",
+    # -- dev tools -------------------------------------------------------
+    "discord_webhook": "discord-webhook",
+    "do_mpc": "do-mpc",
+    "great_tables": "great-tables",
+    "json_repair": "json-repair",
+    "pre_commit": "pre-commit",
+    "pytest_asyncio": "pytest-asyncio",
+    "pytest_cov": "pytest-cov",
+    "pytest_mock": "pytest-mock",
+    "time_machine": "time-machine",
+    # -- science ---------------------------------------------------------
+    "chembl_webresource_client": "chembl-webresource-client",
+    "hijri_converter": "hijri-converter",
+})
+
 # Names that must never be pip-installed: provided by the OS/image, or aliases
 # whose pip name collides with an unrelated/broken dist (reference:
 # executor/requirements-skip.txt:1-26). The TPU image additionally pins the

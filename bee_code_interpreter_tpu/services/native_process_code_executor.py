@@ -344,13 +344,55 @@ class NativeProcessCodeExecutor(ExecutorHttpDriver):
         if stdlib_file:
             env["APP_STDLIB_FILE"] = stdlib_file
 
+        argv: list[str] = [str(self._binary)]
+        if cfg.sandbox_unshare:
+            # Mount-namespace hardening: the server (and every python child
+            # it spawns) sees an empty tmpfs where the object-storage root
+            # is, so user code cannot read other sessions' files. Mount-ns
+            # only: a net namespace would cut the loopback HTTP transport,
+            # and a pid namespace breaks the APP_PARENT_PID watchdog (k8s
+            # mode provides those via pod isolation instead).
+            #
+            # The process holding the namespace has CAP_SYS_ADMIN over it
+            # (real or userns-mapped root), so user code could umount2() the
+            # tmpfs and uncover the real directory — after the mount, the
+            # capability bounding set is emptied (setpriv) so no descendant
+            # can ever regain it; verified by the umount-bypass test. If
+            # setpriv is missing the overmount still guards against
+            # accidental access but a deliberate umount bypasses it — warn.
+            storage_root = Path(cfg.file_storage_path).resolve()
+            storage_root.mkdir(parents=True, exist_ok=True)  # mount target
+            env["BCI_HIDE_DIR"] = str(storage_root)
+            lockdown = (
+                ["setpriv", "--bounding-set", "-all"]
+                if shutil.which("setpriv")
+                else []
+            )
+            if not lockdown:
+                logger.warning(
+                    "sandbox_unshare: setpriv not found - the storage "
+                    "overmount cannot be capability-locked and deliberate "
+                    "user code could umount it"
+                )
+            argv = [
+                "unshare",
+                "--mount",
+                *([] if os.geteuid() == 0 else ["--map-root-user"]),
+                "sh",
+                "-c",
+                'mount -t tmpfs tmpfs "$BCI_HIDE_DIR" && exec "$@"',
+                "sh",
+                *lockdown,
+                str(self._binary),
+            ]
+
         # Off-loop spawn: even vfork costs ~ms, and refills run concurrently
         # with in-flight requests.
         proc = await asyncio.get_running_loop().run_in_executor(
             self._spawn_pool,
             functools.partial(
                 subprocess.Popen,
-                [str(self._binary)],
+                argv,
                 env=env,
                 stdout=subprocess.DEVNULL,
                 stderr=subprocess.DEVNULL,

@@ -20,7 +20,48 @@ from bee_code_interpreter_tpu.runtime.dep_guess import PYPI_MAP  # noqa: E402
 OUT = REPO / "executor" / "pypi_map.tsv"
 
 
+def harvest() -> None:
+    """Print import→dist rows mined from the *installed* environment's
+    package metadata (top_level.txt / RECORD) where the import name differs
+    from the distribution name — candidates for PYPI_MAP, to be reviewed by
+    hand (metadata contains junk like `examples` or `docs` top-levels)."""
+    import importlib.metadata as md
+
+    from bee_code_interpreter_tpu.runtime.dep_guess import _normalize as norm
+
+    rows: dict[str, str] = {}
+    for dist in md.distributions():
+        name = dist.metadata["Name"]
+        if not name:
+            continue
+        tops: set[str] = set()
+        try:
+            top_txt = dist.read_text("top_level.txt")
+            if top_txt:
+                tops.update(t.strip() for t in top_txt.splitlines() if t.strip())
+        except Exception:
+            pass
+        if not tops and dist.files:
+            for f in dist.files:
+                top = f.parts[0]
+                if top.endswith(".py"):
+                    top = top[:-3]
+                if top.isidentifier():
+                    tops.add(top)
+        for top in tops:
+            if top.startswith("_") or not top.isidentifier():
+                continue
+            if norm(top) != norm(name):
+                rows[top] = name
+    for imp in sorted(rows):
+        print(f"{imp}\t{rows[imp]}")
+    print(f"# {len(rows)} candidate rows (review before merging)", file=sys.stderr)
+
+
 def main() -> None:
+    if "--harvest" in sys.argv:
+        harvest()
+        return
     lines = [
         "# import-name -> PyPI distribution name "
         "(generated from runtime/dep_guess.py PYPI_MAP "

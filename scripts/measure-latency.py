@@ -56,11 +56,24 @@ async def bench_warm(n: int) -> list[float]:
     try:
         await executor.fill_sandbox_queue()
         samples = []
-        for _ in range(n):
+        phases: list[dict] = []
+        for i in range(n):
+            if i:
+                # measure request latency, not saturated throughput: give the
+                # refill pipeline room so pops hit preload-complete sandboxes
+                await asyncio.sleep(0.35)
             t0 = time.perf_counter()
             r = await executor.execute(PAYLOAD)
             assert r.stdout == "42\n", r.stderr
             samples.append(time.perf_counter() - t0)
+            phases.append(dict(executor.last_execute_phases))
+        keys = ("acquire_ms", "upload_ms", "post_execute_ms", "sandbox_ms",
+                "overhead_ms", "download_ms")
+        p50s = {
+            k: statistics.median(float(p.get(k, 0.0)) for p in phases)
+            for k in keys
+        }
+        print("warm phases p50: " + "  ".join(f"{k}={v:.1f}" for k, v in p50s.items()))
         return samples
     finally:
         executor.shutdown()
