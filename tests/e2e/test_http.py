@@ -222,6 +222,23 @@ def test_execute_custom_tool(client):
     assert response.json()["tool_output_json"] == "3"
 
 
+def test_execute_custom_tool_indented_source(client):
+    # Uniformly indented tool source (an agent lifting a method out of a
+    # class) must dedent-parse and execute — reference
+    # custom_tool_executor.py:59 textwrap.dedent behavior.
+    response = client.post(
+        "/v1/execute-custom-tool",
+        json={
+            "tool_source_code": (
+                "    def doubler(a: int) -> int:\n        return a * 2"
+            ),
+            "tool_input_json": '{"a": 21}',
+        },
+    )
+    response.raise_for_status()
+    assert response.json()["tool_output_json"] == "42"
+
+
 def test_execute_custom_tool_datetime_coercion(client):
     response = client.post(
         "/v1/execute-custom-tool",
