@@ -1,0 +1,167 @@
+"""Mixture-of-Experts MLP with expert parallelism over an ``ep`` mesh axis.
+
+TPU-first design (GShard / Mesh-TensorFlow capacity-based dense dispatch —
+NOT a ragged/sort-based CUDA-style implementation):
+
+- Routing produces static-shaped **dispatch** and **combine** tensors
+  ``[G, E, C]`` (tokens × experts × capacity slots); token movement is plain
+  einsums. No dynamic shapes, no sorting — everything lowers to MXU matmuls
+  and XLA keeps the program fully static.
+- Expert weights carry a leading ``[n_experts]`` axis sharded over the mesh's
+  ``ep`` axis (PartitionSpec ``P('ep', ...)``); the dispatch/combine einsums
+  contract the token dimension (sharded over dp/fsdp) against the expert
+  dimension (sharded over ep), so **GSPMD inserts the all-to-alls over ICI**
+  — the same collective pattern a hand-written MoE would issue, without any
+  hand-written communication.
+- Tokens over capacity are *dropped* (contribute zero; the residual
+  connection carries them), the standard trade for static shapes on TPU.
+- An auxiliary load-balancing loss (Shazeer-style: E · Σ_e fraction_e ·
+  mean-prob_e) keeps routing from collapsing; the transformer adds it to the
+  training loss scaled by ``moe_aux_weight``.
+
+The reference (a code-execution service) has no MoE; this module exists for
+the framework's model-family/parallelism completeness: the full dp × ep × tp
+training step is exercised on virtual devices by tests/test_moe.py and the
+driver's ``dryrun_multichip``.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+Params = dict
+
+
+def init_moe_params(
+    key: jax.Array,
+    d_model: int,
+    ff_dim: int,
+    n_experts: int,
+) -> Params:
+    """Router + per-expert SwiGLU weights (f32 masters, [E, ...] stacked)."""
+    k_router, k_gate, k_up, k_down = jax.random.split(key, 4)
+
+    def dense(key, fan_in, *shape):
+        return jax.random.normal(key, shape, dtype=jnp.float32) / math.sqrt(fan_in)
+
+    return {
+        "router": dense(k_router, d_model, d_model, n_experts),
+        "we_gate": dense(k_gate, d_model, n_experts, d_model, ff_dim),
+        "we_up": dense(k_up, d_model, n_experts, d_model, ff_dim),
+        "we_down": dense(k_down, ff_dim, n_experts, ff_dim, d_model),
+    }
+
+
+def expert_capacity(
+    n_tokens: int, n_experts: int, top_k: int, capacity_factor: float
+) -> int:
+    """Per-expert capacity slots, rounded up to 8 (sublane-friendly tiles)."""
+    raw = capacity_factor * n_tokens * top_k / n_experts
+    return max(8, int(math.ceil(raw / 8)) * 8)
+
+
+def _route_group(
+    xf: jax.Array,  # [g, D] one routing group
+    router: jax.Array,  # [D, E]
+    *,
+    n_experts: int,
+    top_k: int,
+    capacity: int,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Per-group dispatch/combine tensors [g, E, C] + per-group aux loss.
+
+    GShard position-in-expert assignment: earlier tokens (and earlier top-k
+    choices) win capacity slots; losers are dropped (combine weight zero —
+    the residual stream carries them unchanged). Routing math stays in f32
+    (softmax over expert logits is precision-sensitive).
+    """
+    logits = jnp.einsum(
+        "gd,de->ge", xf.astype(jnp.float32), router.astype(jnp.float32)
+    )
+    probs = jax.nn.softmax(logits, axis=-1)
+
+    gate_vals, gate_idx = lax.top_k(probs, top_k)  # [g, k]
+    # renormalize the kept gates so the combine weights sum to 1 per token
+    gate_vals = gate_vals / jnp.maximum(
+        gate_vals.sum(axis=-1, keepdims=True), 1e-9
+    )
+
+    C = capacity
+    dispatch = jnp.zeros((xf.shape[0], n_experts, C), dtype=jnp.float32)
+    combine = jnp.zeros_like(dispatch)
+    filled = jnp.zeros((n_experts,), dtype=jnp.int32)
+    for j in range(top_k):
+        onehot = jax.nn.one_hot(gate_idx[:, j], n_experts, dtype=jnp.int32)
+        position = jnp.cumsum(onehot, axis=0) - onehot + filled[None, :]
+        filled = filled + onehot.sum(axis=0)
+        slot = (position * onehot).sum(axis=-1)  # position in chosen expert
+        keep = (slot < C).astype(jnp.float32)
+        slot_oh = jax.nn.one_hot(slot, C, dtype=jnp.float32)
+        pair = onehot.astype(jnp.float32)[:, :, None] * slot_oh[:, None, :]
+        dispatch = dispatch + pair * keep[:, None, None]
+        combine = combine + pair * (gate_vals[:, j] * keep)[:, None, None]
+
+    # Load balancing (Shazeer): E · Σ_e (fraction of tokens routed to e) ·
+    # (mean router prob of e). Uses the top-1 assignment for the fraction.
+    top1 = jax.nn.one_hot(gate_idx[:, 0], n_experts, dtype=jnp.float32)
+    aux = n_experts * jnp.sum(top1.mean(axis=0) * probs.mean(axis=0))
+    return dispatch, combine, aux
+
+
+def moe_mlp(
+    params: Params,
+    x: jax.Array,  # [B, L, D]
+    *,
+    n_experts: int,
+    top_k: int = 2,
+    capacity_factor: float = 1.25,
+    dtype=jnp.bfloat16,
+    group_size: int = 1024,
+) -> tuple[jax.Array, jax.Array]:
+    """Returns (output [B, L, D], aux load-balancing loss scalar f32).
+
+    Tokens are routed in fixed-size **groups** (GShard's group dimension):
+    dispatch/combine memory is ``G · E · C_group`` with ``C_group`` set by
+    the group size, i.e. linear in the global token count — without the
+    group axis it is quadratic (capacity itself grows with G). Groups also
+    bound the all-to-all message sizes. When the token count doesn't divide
+    into groups, routing falls back to one global group.
+    """
+    B, L, D = x.shape
+    G = B * L
+    xf = x.reshape(G, D)
+
+    n_groups = max(1, G // group_size)
+    if G % n_groups != 0:
+        n_groups = 1
+    g = G // n_groups
+    C = expert_capacity(g, n_experts, top_k, capacity_factor)
+
+    xg = xf.reshape(n_groups, g, D)
+    dispatch, combine, aux = jax.vmap(
+        lambda xs: _route_group(
+            xs, params["router"], n_experts=n_experts, top_k=top_k, capacity=C
+        )
+    )(xg)  # [n, g, E, C] ×2, [n]
+
+    # token → expert movement: contraction over the (dp-sharded) token dim
+    # against the (ep-sharded) expert dim — GSPMD's all-to-all lives here.
+    # The group axis rides along as a batch dim into the expert matmuls
+    # ([E, n·C, D] worth of rows per expert).
+    expert_in = jnp.einsum(
+        "ngec,ngd->necd", dispatch.astype(dtype), xg.astype(dtype)
+    )  # [n, E, C, D]
+    gate = jnp.einsum("necd,edf->necf", expert_in, params["we_gate"].astype(dtype))
+    up = jnp.einsum("necd,edf->necf", expert_in, params["we_up"].astype(dtype))
+    expert_out = jnp.einsum(
+        "necf,efd->necd", jax.nn.silu(gate) * up, params["we_down"].astype(dtype)
+    )  # [n, E, C, D]
+    out = jnp.einsum(
+        "ngec,necd->ngd", combine.astype(dtype), expert_out
+    )  # [n, g, D]
+
+    return out.reshape(B, L, D), aux.mean()

@@ -56,6 +56,14 @@ class TransformerConfig:
     rope_theta: float = 500000.0
     dtype: Any = jnp.bfloat16
     z_loss: float = 1e-4
+    # Mixture-of-Experts: n_experts > 0 replaces every layer's dense SwiGLU
+    # MLP with an expert-parallel MoE MLP (models/moe.py — GShard-style
+    # dense dispatch; expert weights shard over the mesh's "ep" axis).
+    n_experts: int = 0
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 1.25
+    moe_aux_weight: float = 1e-2
+    moe_group_size: int = 1024  # GShard routing-group size (memory bound)
 
     @property
     def kv_heads(self) -> int:
@@ -80,10 +88,23 @@ class TransformerConfig:
                    max_seq_len=128, d_ff=128)
 
     @classmethod
+    def tiny_moe(cls) -> "TransformerConfig":
+        """Test/dry-run MoE size (4 experts, top-2 routing)."""
+        return cls(vocab_size=256, d_model=64, n_layers=2, n_heads=4,
+                   max_seq_len=128, d_ff=128, n_experts=4)
+
+    @classmethod
     def llama3_8b(cls) -> "TransformerConfig":
         """The BASELINE.json flagship config (Llama-3-8B shapes)."""
         return cls(vocab_size=128256, d_model=4096, n_layers=32, n_heads=32,
                    n_kv_heads=8, d_ff=14336, max_seq_len=8192)
+
+    @classmethod
+    def mixtral_8x7b(cls) -> "TransformerConfig":
+        """Flagship MoE config (Mixtral-8x7B shapes: 8 experts, top-2)."""
+        return cls(vocab_size=32000, d_model=4096, n_layers=32, n_heads=32,
+                   n_kv_heads=8, d_ff=14336, max_seq_len=8192,
+                   n_experts=8, moe_top_k=2)
 
 
 # ---------------------------------------------------------------- components
@@ -120,17 +141,23 @@ def init_params(config: TransformerConfig, key: jax.Array) -> Params:
     def layer(key):
         ks = jax.random.split(key, 7)
         dh, kvh = c.head_dim, c.kv_heads
-        return {
+        out = {
             "ln1": jnp.ones((c.d_model,), jnp.float32),
             "wq": dense(ks[0], c.d_model, c.d_model, c.n_heads * dh),
             "wk": dense(ks[1], c.d_model, c.d_model, kvh * dh),
             "wv": dense(ks[2], c.d_model, c.d_model, kvh * dh),
             "wo": dense(ks[3], c.n_heads * dh, c.n_heads * dh, c.d_model),
             "ln2": jnp.ones((c.d_model,), jnp.float32),
-            "w_gate": dense(ks[4], c.d_model, c.d_model, c.ff_dim),
-            "w_up": dense(ks[5], c.d_model, c.d_model, c.ff_dim),
-            "w_down": dense(ks[6], c.ff_dim, c.ff_dim, c.d_model),
         }
+        if c.n_experts:
+            from bee_code_interpreter_tpu.models.moe import init_moe_params
+
+            out["moe"] = init_moe_params(ks[4], c.d_model, c.ff_dim, c.n_experts)
+        else:
+            out["w_gate"] = dense(ks[4], c.d_model, c.d_model, c.ff_dim)
+            out["w_up"] = dense(ks[5], c.d_model, c.d_model, c.ff_dim)
+            out["w_down"] = dense(ks[6], c.ff_dim, c.ff_dim, c.d_model)
+        return out
 
     layer_keys = jax.random.split(k_layers, c.n_layers)
     stacked = jax.vmap(layer)(layer_keys)
@@ -143,21 +170,31 @@ def init_params(config: TransformerConfig, key: jax.Array) -> Params:
 
 
 def param_specs(config: TransformerConfig, mesh: Mesh) -> Params:
-    """Megatron-style PartitionSpecs over whichever of (fsdp, tp) exist."""
+    """Megatron-style PartitionSpecs over whichever of (fsdp, tp, ep) exist."""
     tp = "tp" if "tp" in mesh.axis_names else None
     fsdp = "fsdp" if "fsdp" in mesh.axis_names else None
+    ep = "ep" if "ep" in mesh.axis_names else None
 
     col = P(fsdp, tp)      # [d_in, d_out/tp] column-parallel
     row = P(tp, fsdp)      # [d_in/tp, d_out] row-parallel
     rep = P()
     layer = {
-        "ln1": P(None), "ln2": P(None),
+        "ln1": _stack(rep), "ln2": _stack(rep),
         "wq": _stack(col), "wk": _stack(col), "wv": _stack(col),
         "wo": _stack(row),
-        "w_gate": _stack(col), "w_up": _stack(col), "w_down": _stack(row),
     }
-    layer["ln1"] = _stack(rep)
-    layer["ln2"] = _stack(rep)
+    if config.n_experts:
+        # expert axis over ep, expert-internal matmuls Megatron-style
+        layer["moe"] = {
+            "router": _stack(P(None, None)),  # small; replicated
+            "we_gate": _stack(P(ep, fsdp, tp)),
+            "we_up": _stack(P(ep, fsdp, tp)),
+            "we_down": _stack(P(ep, tp, fsdp)),
+        }
+    else:
+        layer["w_gate"] = _stack(col)
+        layer["w_up"] = _stack(col)
+        layer["w_down"] = _stack(row)
     return {
         "embed": P(tp, None),     # vocab-sharded embedding
         "layers": layer,
@@ -225,12 +262,15 @@ def forward(
     config: TransformerConfig,
     mesh: Mesh | None = None,
     return_kv: bool = False,
-) -> jax.Array | tuple[jax.Array, tuple[jax.Array, jax.Array]]:
+    return_aux: bool = False,
+) -> jax.Array | tuple:
     """Returns logits [B, L, vocab] (f32).
 
     With ``return_kv`` (the prefill half of cached decoding), also returns the
     per-layer post-RoPE K/V stacked [n_layers, B, kv_heads, L, head_dim] —
     pre-GQA-broadcast, so the cache stores kv_heads not n_heads.
+    With ``return_aux`` (MoE training), also returns the summed per-layer
+    load-balancing auxiliary loss (0.0 for dense configs).
     """
     c = config
     use_ring = mesh is not None and "sp" in mesh.axis_names and (
@@ -280,20 +320,36 @@ def forward(
         )
 
         y = rms_norm(h, layer["ln2"])
-        gate = jnp.einsum("bld,df->blf", y, layer["w_gate"].astype(c.dtype))
-        up = jnp.einsum("bld,df->blf", y, layer["w_up"].astype(c.dtype))
-        mlp = jnp.einsum(
-            "blf,fd->bld", jax.nn.silu(gate) * up, layer["w_down"].astype(c.dtype)
-        )
-        h = h + constrain(mlp, batch_ax, sp, None)
-        return h, kv_out
+        if c.n_experts:
+            from bee_code_interpreter_tpu.models.moe import moe_mlp
 
-    h, kv = lax.scan(layer_step, h, params["layers"])
+            mlp, aux = moe_mlp(
+                layer["moe"], y,
+                n_experts=c.n_experts, top_k=c.moe_top_k,
+                capacity_factor=c.moe_capacity_factor, dtype=c.dtype,
+                group_size=c.moe_group_size,
+            )
+        else:
+            gate = jnp.einsum("bld,df->blf", y, layer["w_gate"].astype(c.dtype))
+            up = jnp.einsum("bld,df->blf", y, layer["w_up"].astype(c.dtype))
+            mlp = jnp.einsum(
+                "blf,fd->bld", jax.nn.silu(gate) * up, layer["w_down"].astype(c.dtype)
+            )
+            aux = jnp.float32(0.0)
+        h = h + constrain(mlp, batch_ax, sp, None)
+        return h, (kv_out, aux)
+
+    h, (kv, aux_layers) = lax.scan(layer_step, h, params["layers"])
     h = rms_norm(h, params["ln_f"])
     logits = jnp.einsum("bld,dv->blv", h, params["lm_head"].astype(c.dtype))
     logits = logits.astype(jnp.float32)
+    extras = []
     if return_kv:
-        return logits, kv
+        extras.append(kv)
+    if return_aux:
+        extras.append(aux_layers.sum())
+    if extras:
+        return (logits, *extras)
     return logits
 
 
@@ -355,11 +411,21 @@ def decode_step(
         h = h + jnp.einsum("blk,kd->bld", attn, layer["wo"].astype(c.dtype))
 
         y = rms_norm(h, layer["ln2"])
-        gate = jnp.einsum("bld,df->blf", y, layer["w_gate"].astype(c.dtype))
-        up = jnp.einsum("bld,df->blf", y, layer["w_up"].astype(c.dtype))
-        mlp = jnp.einsum(
-            "blf,fd->bld", jax.nn.silu(gate) * up, layer["w_down"].astype(c.dtype)
-        )
+        if c.n_experts:
+            from bee_code_interpreter_tpu.models.moe import moe_mlp
+
+            mlp, _ = moe_mlp(
+                layer["moe"], y,
+                n_experts=c.n_experts, top_k=c.moe_top_k,
+                capacity_factor=c.moe_capacity_factor, dtype=c.dtype,
+                group_size=c.moe_group_size,
+            )
+        else:
+            gate = jnp.einsum("bld,df->blf", y, layer["w_gate"].astype(c.dtype))
+            up = jnp.einsum("bld,df->blf", y, layer["w_up"].astype(c.dtype))
+            mlp = jnp.einsum(
+                "blf,fd->bld", jax.nn.silu(gate) * up, layer["w_down"].astype(c.dtype)
+            )
         h = h + mlp
         return h, (k_layer, v_layer)
 
@@ -381,7 +447,9 @@ def loss_fn(
     config: TransformerConfig,
     mesh: Mesh | None = None,
 ) -> jax.Array:
-    logits = forward(params, batch["tokens"], config, mesh)
+    logits, aux = forward(
+        params, batch["tokens"], config, mesh, return_aux=True
+    )
     logz = jax.scipy.special.logsumexp(logits, axis=-1)
     target_logit = jnp.take_along_axis(
         logits, batch["targets"][..., None], axis=-1
@@ -389,7 +457,8 @@ def loss_fn(
     nll = logz - target_logit
     # z-loss keeps logits from drifting (stability at bf16)
     loss = nll + config.z_loss * logz**2
-    return loss.mean()
+    # MoE load-balancing term (0.0 for dense configs)
+    return loss.mean() + config.moe_aux_weight * aux
 
 
 class Transformer:

@@ -14,6 +14,7 @@ Axis conventions (used by models/, ops/ and the flagship train step):
 - ``tp``   tensor parallel (Megatron column/row splits)
 - ``sp``   sequence/context parallel (ring attention over ICI)
 - ``ep``   expert parallel (MoE)
+- ``pp``   pipeline parallel (GPipe microbatch schedule over ppermute)
 """
 
 from bee_code_interpreter_tpu.parallel.mesh import (  # noqa: F401
@@ -22,6 +23,9 @@ from bee_code_interpreter_tpu.parallel.mesh import (  # noqa: F401
     initialize_distributed,
     local_device_count,
     make_mesh,
+)
+from bee_code_interpreter_tpu.parallel.pipeline import (  # noqa: F401
+    spmd_pipeline,
 )
 from bee_code_interpreter_tpu.parallel.ring_attention import (  # noqa: F401
     ring_attention,

@@ -215,6 +215,33 @@ class ExecutorCore:
                 env["PYTHONPATH"] = self.shim_dir + (
                     os.pathsep + existing if existing else ""
                 )
+        # Hermetic-CPU opt-out: a request env can't REMOVE inherited vars, so
+        # BCI_SCRUB_ACCELERATOR=1 asks the sandbox to drop the tunnel-plugin
+        # vars whose mere presence hooks jax backend init (even under
+        # JAX_PLATFORMS=cpu) — without it, a wedged TPU tunnel turns every
+        # CPU-pinned payload into an execution timeout. The host PYTHONPATH
+        # is dropped too (keeping the shim + request-supplied entries): a
+        # host sitecustomize chain can force-register the tunnel platform
+        # independent of any env var.
+        if env.get("BCI_SCRUB_ACCELERATOR") == "1":
+            from bee_code_interpreter_tpu.utils.envscrub import (
+                TUNNEL_PLUGIN_PREFIXES,
+            )
+
+            for key in [
+                k for k in env if k.startswith(TUNNEL_PLUGIN_PREFIXES)
+            ]:
+                env.pop(key)
+            parts = [self.shim_dir] if self.shim_dir else []
+            parts += [
+                p
+                for p in request_env.get("PYTHONPATH", "").split(os.pathsep)
+                if p and p not in parts
+            ]
+            if parts:
+                env["PYTHONPATH"] = os.pathsep.join(parts)
+            else:
+                env.pop("PYTHONPATH", None)
         return env
 
     async def execute(

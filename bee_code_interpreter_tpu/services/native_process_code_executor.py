@@ -340,6 +340,11 @@ class NativeProcessCodeExecutor(ExecutorHttpDriver):
             env["APP_JAX_CACHE_DIR"] = cfg.jax_cache_dir
         env["APP_DIE_WITH_PARENT"] = "1"  # server watches us via PDEATHSIG+ppid
         env["APP_PARENT_PID"] = str(os.getpid())
+        # Hermetic-mode scrub prefixes: envscrub.py is the single source of
+        # truth; the C++ server's built-in list is only a fallback.
+        from bee_code_interpreter_tpu.utils.envscrub import TUNNEL_PLUGIN_PREFIXES
+
+        env["APP_SCRUB_PREFIXES"] = ",".join(TUNNEL_PLUGIN_PREFIXES)
         stdlib_file = await self._stdlib_file()
         if stdlib_file:
             env["APP_STDLIB_FILE"] = stdlib_file

@@ -168,6 +168,9 @@ os.dup2(_saved_err, 2)
 os.close(_saved_out); os.close(_saved_err); os.close(_devnull)
 
 os.environ.update(_req.get("env", {}))
+# (Hermetic requests — BCI_SCRUB_ACCELERATOR=1 — never reach this worker:
+# the server routes them cold, since this interpreter already executed the
+# host sitecustomize chain at spawn.)
 # The preload imported numpy before the request env existed, so the reroute
 # proxies were installed regardless of the request's BCI_XLA_REROUTE. The
 # proxies re-check the env per call, but a request that opted out deserves a
@@ -477,8 +480,17 @@ class Executor {
     }
 
     subprocess::RunResult result;
+    // Hermetic requests (BCI_SCRUB_ACCELERATOR=1) must run COLD — the warm
+    // worker's interpreter already executed the host sitecustomize chain at
+    // spawn, and whatever platform hooks it installed cannot be uninstalled
+    // retroactively. The worker is not claimed at all: it stays warm for a
+    // later normal request (sandboxes are single-use in production, but the
+    // server must not de-warm itself on the first hermetic probe).
+    auto hermetic_it = request_env.find("BCI_SCRUB_ACCELERATOR");
+    const bool hermetic =
+        hermetic_it != request_env.end() && hermetic_it->second == "1";
     subprocess::Child worker;
-    {
+    if (!hermetic) {
       // Claim the pre-started worker (single-use, like the sandbox itself).
       std::lock_guard<std::mutex> lock(prestart_mutex_);
       worker = prestart_;
@@ -643,6 +655,47 @@ class Executor {
       auto it = env.find("PYTHONPATH");
       env["PYTHONPATH"] =
           merge_shim_pythonpath(it == env.end() ? "" : it->second);
+    }
+    // Hermetic-CPU opt-out: a request env can't REMOVE inherited vars, so
+    // BCI_SCRUB_ACCELERATOR=1 drops the tunnel-plugin vars whose mere
+    // presence hooks jax backend init even under JAX_PLATFORMS=cpu, and
+    // rebuilds PYTHONPATH from the shim + request-supplied entries only —
+    // a host sitecustomize chain can force-register the tunnel platform
+    // independent of env vars. The prefix list comes from the control plane
+    // (APP_SCRUB_PREFIXES, sourced from utils/envscrub.py — the single
+    // source of truth); the literal below is only the no-control-plane
+    // fallback.
+    auto scrub = env.find("BCI_SCRUB_ACCELERATOR");
+    if (scrub != env.end() && scrub->second == "1") {
+      std::vector<std::string> prefixes;
+      {
+        std::string spec = env_or("APP_SCRUB_PREFIXES", "PALLAS_,AXON_");
+        std::istringstream parts(spec);
+        std::string part;
+        while (std::getline(parts, part, ','))
+          if (!part.empty()) prefixes.push_back(part);
+      }
+      for (auto it2 = env.begin(); it2 != env.end();) {
+        bool drop = false;
+        for (const auto& prefix : prefixes)
+          if (it2->first.rfind(prefix, 0) == 0) drop = true;
+        if (drop) {
+          it2 = env.erase(it2);
+        } else {
+          ++it2;
+        }
+      }
+      std::string hermetic_path = config_.shim_dir;
+      auto req_pp = request_env.find("PYTHONPATH");
+      if (req_pp != request_env.end() && !req_pp->second.empty()) {
+        hermetic_path += hermetic_path.empty() ? req_pp->second
+                                               : ":" + req_pp->second;
+      }
+      if (hermetic_path.empty()) {
+        env.erase("PYTHONPATH");
+      } else {
+        env["PYTHONPATH"] = hermetic_path;
+      }
     }
     return env;
   }

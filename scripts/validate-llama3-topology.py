@@ -99,9 +99,11 @@ def per_device_state_bytes(config, mesh: Mesh, with_optimizer: bool) -> int:
     return total
 
 
-def validate_train(axes: dict[str, int]) -> dict:
+def validate_train(
+    axes: dict[str, int], config=None, case: str = "train"
+) -> dict:
     mesh = build_mesh(axes)
-    config = T.TransformerConfig.llama3_8b()
+    config = config or T.TransformerConfig.llama3_8b()
     model = T.Transformer(config, mesh)
 
     params_shape = jax.eval_shape(
@@ -152,7 +154,7 @@ def validate_train(axes: dict[str, int]) -> dict:
         f"v5e HBM on mesh {axes}"
     )
     return {
-        "case": "train",
+        "case": case,
         "mesh": axes,
         "batch": [B, L],
         "per_device_state_gib": round(state_bytes / 2**30, 2),
@@ -222,6 +224,15 @@ def main() -> None:
         sys.exit(2)
     print(json.dumps(validate_train({"fsdp": 8, "tp": 8})))
     print(json.dumps(validate_decode({"dp": 2, "sp": 4, "tp": 8})))
+    print(
+        json.dumps(
+            validate_train(
+                {"fsdp": 2, "ep": 8, "tp": 4},
+                config=T.TransformerConfig.mixtral_8x7b(),
+                case="train_moe",
+            )
+        )
+    )
 
 
 if __name__ == "__main__":

@@ -223,3 +223,21 @@ async def test_xonsh_shellisms_are_a_documented_delta(tmp_path):
     )
     assert supported.exit_code == 0
     assert supported.stdout == "shell-works\n"
+
+
+async def test_request_accelerator_scrub_optout(tmp_path, monkeypatch):
+    # BCI_SCRUB_ACCELERATOR=1 must drop tunnel-plugin vars from the sandbox
+    # env (a request can't REMOVE inherited vars any other way; without this
+    # a wedged TPU tunnel turns every CPU-pinned payload into a timeout).
+    monkeypatch.setenv("PALLAS_TUNNEL_TARGET", "grpc://wedged:1")
+    monkeypatch.setenv("AXON_POOL_KEY", "abc")
+    core = make_core(tmp_path)
+    probe = (
+        "import os\n"
+        "print(sorted(k for k in os.environ"
+        " if k.startswith(('PALLAS_', 'AXON_'))))\n"
+    )
+    r_default = await core.execute(probe)
+    assert "PALLAS_TUNNEL_TARGET" in r_default.stdout  # passthrough by default
+    r_scrubbed = await core.execute(probe, env={"BCI_SCRUB_ACCELERATOR": "1"})
+    assert r_scrubbed.stdout == "[]\n"

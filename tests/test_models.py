@@ -214,3 +214,6 @@ def test_llama3_8b_lowering_at_baseline_topology():
     assert by_case["train"]["per_device_state_gib"] < 16
     assert by_case["decode"]["prefill_lowered"]
     assert by_case["decode"]["decode_lowered"]
+    # flagship MoE (Mixtral-8x7B shapes) over fsdp x ep x tp
+    assert by_case["train_moe"]["lowered"]
+    assert by_case["train_moe"]["per_device_state_gib"] < 16

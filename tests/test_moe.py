@@ -1,0 +1,157 @@
+"""Mixture-of-Experts layer + expert-parallel transformer (virtual devices).
+
+Covers models/moe.py (GShard-style dense dispatch) standalone and integrated:
+single-expert oracle equivalence, capacity-drop behavior, load-balance aux,
+and a full dp x ep x tp sharded train step on the 8-virtual-device mesh.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bee_code_interpreter_tpu.models import transformer as T
+from bee_code_interpreter_tpu.models.moe import (
+    expert_capacity,
+    init_moe_params,
+    moe_mlp,
+)
+from bee_code_interpreter_tpu.parallel import make_mesh
+
+
+def test_single_expert_matches_dense_swiglu():
+    # n_experts=1, top_k=1, ample capacity: every token goes to the one
+    # expert with gate weight 1.0, so the MoE MLP must equal a plain SwiGLU
+    # MLP using that expert's weights — an exact dense oracle.
+    d_model, ff = 32, 64
+    params = init_moe_params(jax.random.PRNGKey(0), d_model, ff, n_experts=1)
+    x = jax.random.normal(jax.random.PRNGKey(1), (2, 8, d_model), jnp.float32)
+
+    out, aux = moe_mlp(
+        params, x, n_experts=1, top_k=1, capacity_factor=2.0, dtype=jnp.float32
+    )
+    w_gate = params["we_gate"][0]
+    w_up = params["we_up"][0]
+    w_down = params["we_down"][0]
+    dense = jnp.einsum(
+        "blf,fd->bld",
+        jax.nn.silu(jnp.einsum("bld,df->blf", x, w_gate))
+        * jnp.einsum("bld,df->blf", x, w_up),
+        w_down,
+    )
+    np.testing.assert_allclose(np.asarray(out), np.asarray(dense), atol=1e-5, rtol=1e-5)
+    # one expert: fraction=1, mean_prob=1 -> aux == n_experts * 1 * 1 == 1
+    assert abs(float(aux) - 1.0) < 1e-5
+
+
+def test_capacity_drops_overflow_tokens():
+    # Capacity 8 slots (the rounding floor) with 64 tokens routed by top-1:
+    # at most C tokens per expert contribute; the rest must come out as
+    # exactly zero (the residual stream carries them).
+    d_model, ff, E = 16, 32, 2
+    params = init_moe_params(jax.random.PRNGKey(0), d_model, ff, n_experts=E)
+    x = jax.random.normal(jax.random.PRNGKey(1), (1, 64, d_model), jnp.float32)
+    out, _ = moe_mlp(
+        params, x, n_experts=E, top_k=1, capacity_factor=0.01, dtype=jnp.float32
+    )
+    per_token = np.abs(np.asarray(out[0])).sum(axis=-1)
+    C = expert_capacity(64, E, 1, 0.01)
+    assert C == 8
+    nonzero = int((per_token > 1e-9).sum())
+    assert nonzero <= E * C  # dropped tokens contribute exactly zero
+    assert nonzero > 0  # ...but the winners did run
+
+
+def test_capacity_rounding():
+    assert expert_capacity(128, 4, 2, 1.0) == 64
+    assert expert_capacity(10, 8, 1, 1.0) == 8  # floor
+
+
+def test_moe_transformer_forward_and_decode_agree():
+    # generate (full re-encode) and generate_cached (prefill + decode_step)
+    # must produce identical tokens for an MoE config: routing runs in both
+    # paths and must be consistent.
+    config = T.TransformerConfig.tiny_moe()
+    model = T.Transformer(config)
+    params = model.init(jax.random.PRNGKey(0))
+    prompt = jax.random.randint(jax.random.PRNGKey(1), (2, 8), 0, config.vocab_size)
+    full = model.generate(params, prompt, max_new_tokens=6)
+    cached = model.generate_cached(params, prompt, max_new_tokens=6)
+    np.testing.assert_array_equal(np.asarray(full), np.asarray(cached))
+
+
+def test_moe_train_step_dp_ep_tp_sharded():
+    # The full expert-parallel training step on the virtual 8-device mesh:
+    # batch over dp, experts over ep, attention/MLP matmuls over tp. GSPMD
+    # inserts the dispatch/combine all-to-alls; loss must be finite and
+    # decrease over a few steps.
+    mesh = make_mesh({"dp": 2, "ep": 2, "tp": 2}, devices=jax.devices()[:8])
+    config = T.TransformerConfig.tiny_moe()
+    model = T.Transformer(config, mesh)
+    params = model.init(jax.random.PRNGKey(0))
+
+    # expert weights actually landed on the ep axis
+    spec = T.param_specs(config, mesh)["layers"]["moe"]["we_gate"]
+    assert "ep" in jax.tree.leaves(spec, is_leaf=lambda x: x is not None) or (
+        "ep" in [a for part in spec if part for a in (part if isinstance(part, tuple) else (part,))]
+    )
+
+    optimizer = model.make_optimizer(1e-2)
+    opt_state = optimizer.init(params)
+    step = model.make_train_step(optimizer)
+
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 33), 0, config.vocab_size)
+    batch = jax.device_put(
+        {"tokens": tokens[:, :-1], "targets": tokens[:, 1:]},
+        model.batch_sharding(),
+    )
+    losses = []
+    for _ in range(5):
+        params, opt_state, loss = step(params, opt_state, batch)
+        losses.append(float(loss))
+    assert all(np.isfinite(losses)), losses
+    assert losses[-1] < losses[0], losses
+
+
+def test_moe_aux_loss_feeds_training():
+    config = T.TransformerConfig.tiny_moe()
+    params = T.init_params(config, jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 17), 0, config.vocab_size)
+    batch = {"tokens": tokens[:, :-1], "targets": tokens[:, 1:]}
+    base = T.loss_fn(params, batch, config)
+    # the aux term responds to the weight knob
+    import dataclasses
+
+    heavier = dataclasses.replace(config, moe_aux_weight=1.0)
+    assert float(T.loss_fn(params, batch, heavier)) > float(base)
+
+
+def test_grouped_routing_matches_single_group_with_ample_capacity():
+    # With capacity ample enough that no token is dropped in either layout,
+    # grouped routing (the memory-bounding GShard group axis) must produce
+    # the same output as one global group.
+    d_model, ff, E = 16, 32, 4
+    params = init_moe_params(jax.random.PRNGKey(0), d_model, ff, E)
+    x = jax.random.normal(jax.random.PRNGKey(1), (2, 64, d_model), jnp.float32)
+    kwargs = dict(n_experts=E, top_k=2, capacity_factor=8.0, dtype=jnp.float32)
+    out_grouped, aux_g = moe_mlp(params, x, group_size=32, **kwargs)
+    out_single, aux_s = moe_mlp(params, x, group_size=1 << 30, **kwargs)
+    np.testing.assert_allclose(
+        np.asarray(out_grouped), np.asarray(out_single), atol=1e-5, rtol=1e-5
+    )
+    # aux is a mean over groups of identically-distributed terms; both stay O(1)
+    assert 0.5 < float(aux_g) < float(E)
+    assert 0.5 < float(aux_s) < float(E)
+
+
+def test_group_capacity_is_bounded_by_group_size_not_global():
+    # The memory bound: dispatch memory is G*E*C where C follows the GROUP
+    # size — constant as the global token count grows (without the group
+    # axis C itself grows with G, making dispatch quadratic; review r3).
+    per_group = expert_capacity(1024, 8, 2, 1.25)
+    single_group_16x = expert_capacity(16384, 8, 2, 1.25)
+    assert single_group_16x >= 16 * per_group - 8 * 16  # C grew ~16x ungrouped
+    # grouped dispatch at G=16384: 16 groups x [1024, 8, per_group] stays
+    # 16x smaller than the single-group [16384, 8, single_group_16x]
+    grouped_elems = 16 * 1024 * 8 * per_group
+    single_elems = 16384 * 8 * single_group_16x
+    assert grouped_elems * 8 <= single_elems

@@ -730,3 +730,35 @@ def test_os_exit_payload_reports_real_code(native):
         timeout=30,
     ).json()
     assert r["exit_code"] == 5
+
+
+def test_request_accelerator_scrub_native(tmp_path):
+    # BCI_SCRUB_ACCELERATOR=1 drops tunnel-plugin vars in the native server
+    # too — on the warm path (bootstrap scrub) and the cold path (base_env).
+    probe = (
+        "import os\n"
+        "print(sorted(k for k in os.environ"
+        " if k.startswith(('PALLAS_', 'AXON_'))))\n"
+    )
+    server = NativeExecutor(
+        tmp_path / "ws",
+        extra_env={"PALLAS_TUNNEL_TARGET": "grpc://wedged:1", "AXON_POOL_KEY": "x"},
+    )
+    try:
+        # hermetic requests always run cold (base_env scrub) and do NOT
+        # consume the pre-started worker
+        for _ in range(2):
+            r = httpx.post(
+                server.base + "/execute",
+                json={"source_code": probe, "env": {"BCI_SCRUB_ACCELERATOR": "1"}},
+                timeout=60,
+            ).json()
+            assert r["stdout"] == "[]\n", r
+        # without the opt-out the vars pass through — and this request is
+        # served by the warm worker the hermetic probes left untouched
+        r3 = httpx.post(
+            server.base + "/execute", json={"source_code": probe}, timeout=60
+        ).json()
+        assert "PALLAS_TUNNEL_TARGET" in r3["stdout"], r3
+    finally:
+        server.stop()
