@@ -529,7 +529,11 @@ class Transformer:
     ) -> jax.Array:
         """Greedy decode with a KV cache: one O(L^2) prefill, then
         ``max_new_tokens - 1`` O(L) incremental steps (decode_step). Output
-        is pinned equal to ``generate`` by tests/test_models.py."""
+        is pinned equal to ``generate`` by tests/test_models.py. For MoE
+        configs the equality holds only drop-free (ample capacity): under
+        capacity pressure the full forward routes tokens in competition
+        while decode routes each token alone — inherent to capacity-based
+        MoE (tests/test_moe.py)."""
         c = self.config
         B, L = prompt.shape
         total = L + max_new_tokens

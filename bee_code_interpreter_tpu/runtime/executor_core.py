@@ -225,13 +225,10 @@ class ExecutorCore:
         # independent of any env var.
         if env.get("BCI_SCRUB_ACCELERATOR") == "1":
             from bee_code_interpreter_tpu.utils.envscrub import (
-                TUNNEL_PLUGIN_PREFIXES,
+                scrub_tunnel_plugin_vars,
             )
 
-            for key in [
-                k for k in env if k.startswith(TUNNEL_PLUGIN_PREFIXES)
-            ]:
-                env.pop(key)
+            scrub_tunnel_plugin_vars(env)
             parts = [self.shim_dir] if self.shim_dir else []
             parts += [
                 p

@@ -178,3 +178,20 @@ async def test_execute_custom_tool_oneof_error(grpc_addr):
     )
     assert response.WhichOneof("response") == "error"
     assert "it broke" in response.error.stderr
+
+
+async def test_execute_custom_tool_indented_source(grpc_addr):
+    # Parity with the HTTP case: uniformly indented tool source dedents
+    # (reference custom_tool_executor.py:59).
+    response = await call(
+        grpc_addr,
+        "ExecuteCustomTool",
+        pb.ExecuteCustomToolRequest(
+            tool_source_code=(
+                "    def doubler(a: int) -> int:\n        return a * 2"
+            ),
+            tool_input_json='{"a": 21}',
+        ),
+    )
+    assert response.WhichOneof("response") == "success"
+    assert response.success.tool_output_json == "42"

@@ -66,17 +66,57 @@ def test_capacity_rounding():
     assert expert_capacity(10, 8, 1, 1.0) == 8  # floor
 
 
-def test_moe_transformer_forward_and_decode_agree():
-    # generate (full re-encode) and generate_cached (prefill + decode_step)
-    # must produce identical tokens for an MoE config: routing runs in both
-    # paths and must be consistent.
-    config = T.TransformerConfig.tiny_moe()
-    model = T.Transformer(config)
-    params = model.init(jax.random.PRNGKey(0))
-    prompt = jax.random.randint(jax.random.PRNGKey(1), (2, 8), 0, config.vocab_size)
-    full = model.generate(params, prompt, max_new_tokens=6)
-    cached = model.generate_cached(params, prompt, max_new_tokens=6)
-    np.testing.assert_array_equal(np.asarray(full), np.asarray(cached))
+def test_moe_prefill_and_decode_logits_agree_dropfree():
+    # Cached decode must route consistently with the full forward. The
+    # comparison is drop-free (ample capacity) and at the LOGITS level:
+    # under capacity pressure the full forward routes tokens in competition
+    # across all positions/rows while decode routes each token alone — an
+    # inherent property of capacity-based MoE (review r3 reproduced token
+    # mismatches at the default factor) — and even drop-free, summation-order
+    # differences make token-exactness a coin flip at near-ties.
+    import dataclasses
+
+    config = dataclasses.replace(
+        T.TransformerConfig.tiny_moe(),
+        moe_capacity_factor=8.0,
+        dtype=jnp.float32,
+    )
+    B, L_pre, L_total = 2, 8, 12
+    params = T.init_params(config, jax.random.PRNGKey(0))
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(1), (B, L_total), 0, config.vocab_size
+    )
+
+    logits_full = T.forward(params, tokens, config)
+
+    logits_pre, (k_pre, v_pre) = T.forward(
+        params, tokens[:, :L_pre], config, return_kv=True
+    )
+    c = config
+    k_cache = jnp.zeros(
+        (c.n_layers, B, c.kv_heads, L_total, c.head_dim), c.dtype
+    )
+    v_cache = jnp.zeros_like(k_cache)
+    k_cache = k_cache.at[:, :, :, :L_pre, :].set(k_pre.astype(c.dtype))
+    v_cache = v_cache.at[:, :, :, :L_pre, :].set(v_pre.astype(c.dtype))
+    np.testing.assert_allclose(
+        np.asarray(logits_pre),
+        np.asarray(logits_full[:, :L_pre]),
+        atol=1e-4,
+        rtol=1e-4,
+    )
+
+    cache = (k_cache, v_cache)
+    for pos in range(L_pre, L_total):
+        step_logits, cache = T.decode_step(
+            params, tokens[:, pos : pos + 1], jnp.int32(pos), cache, c
+        )
+        np.testing.assert_allclose(
+            np.asarray(step_logits[:, 0]),
+            np.asarray(logits_full[:, pos]),
+            atol=1e-4,
+            rtol=1e-4,
+        )
 
 
 def test_moe_train_step_dp_ep_tp_sharded():
