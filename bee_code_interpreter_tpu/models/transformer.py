@@ -248,6 +248,63 @@ def _attention(q, k, v, mesh: Mesh | None):
     return fn(q, k, v)
 
 
+def _layer_apply(
+    h: jax.Array,  # [B, L, D]
+    layer: Params,
+    config: TransformerConfig,
+    positions: jax.Array,  # [B, L]
+    *,
+    mesh: Mesh | None = None,
+    constrain=lambda x: x,
+    return_kv: bool = False,
+) -> tuple[jax.Array, tuple | None, jax.Array]:
+    """One decoder layer — THE single source of the layer math, shared by
+    ``forward`` (mesh attention + sharding constraints via the hooks) and
+    ``forward_pipelined`` (single-shard defaults). Returns
+    (h, kv_out | None, aux-loss scalar)."""
+    c = config
+    B, L = h.shape[0], h.shape[1]
+    x = rms_norm(h, layer["ln1"])
+    dh, nh, kvh = c.head_dim, c.n_heads, c.kv_heads
+
+    def proj(w, heads):
+        out = jnp.einsum("bld,dk->blk", x, w.astype(c.dtype))
+        return out.reshape(B, L, heads, dh).transpose(0, 2, 1, 3)
+
+    q = rope(proj(layer["wq"], nh), positions, c.rope_theta)
+    k = rope(proj(layer["wk"], kvh), positions, c.rope_theta)
+    v = proj(layer["wv"], kvh)
+    kv_out = (k, v) if return_kv else None
+    if kvh != nh:  # grouped-query: broadcast kv heads
+        rep = nh // kvh
+        k = jnp.repeat(k, rep, axis=1)
+        v = jnp.repeat(v, rep, axis=1)
+
+    attn = _attention(q, k, v, mesh)
+    attn = attn.transpose(0, 2, 1, 3).reshape(B, L, nh * dh)
+    h = h + constrain(jnp.einsum("blk,kd->bld", attn, layer["wo"].astype(c.dtype)))
+
+    y = rms_norm(h, layer["ln2"])
+    if c.n_experts:
+        from bee_code_interpreter_tpu.models.moe import moe_mlp
+
+        mlp, aux = moe_mlp(
+            layer["moe"], y,
+            n_experts=c.n_experts, top_k=c.moe_top_k,
+            capacity_factor=c.moe_capacity_factor, dtype=c.dtype,
+            group_size=c.moe_group_size,
+        )
+    else:
+        gate = jnp.einsum("bld,df->blf", y, layer["w_gate"].astype(c.dtype))
+        up = jnp.einsum("bld,df->blf", y, layer["w_up"].astype(c.dtype))
+        mlp = jnp.einsum(
+            "blf,fd->bld", jax.nn.silu(gate) * up, layer["w_down"].astype(c.dtype)
+        )
+        aux = jnp.float32(0.0)
+    h = h + constrain(mlp)
+    return h, kv_out, aux
+
+
 def _batch_axes(mesh: Mesh | None):
     """Activation batch dim shards over every data-parallel-ish axis present."""
     if mesh is None:
@@ -296,47 +353,12 @@ def forward(
     h = constrain(h, batch_ax, sp, None)
 
     def layer_step(h, layer):
-        x = rms_norm(h, layer["ln1"])
-        dh, nh, kvh = c.head_dim, c.n_heads, c.kv_heads
-
-        def proj(w, heads):
-            out = jnp.einsum("bld,dk->blk", x, w.astype(c.dtype))
-            return out.reshape(B, L, heads, dh).transpose(0, 2, 1, 3)
-
-        q = rope(proj(layer["wq"], nh), positions, c.rope_theta)
-        k = rope(proj(layer["wk"], kvh), positions, c.rope_theta)
-        v = proj(layer["wv"], kvh)
-        kv_out = (k, v) if return_kv else None
-        if kvh != nh:  # grouped-query: broadcast kv heads
-            rep = nh // kvh
-            k = jnp.repeat(k, rep, axis=1)
-            v = jnp.repeat(v, rep, axis=1)
-
-        attn = _attention(q, k, v, mesh)
-        attn = attn.transpose(0, 2, 1, 3).reshape(B, L, nh * dh)
-        h = h + constrain(
-            jnp.einsum("blk,kd->bld", attn, layer["wo"].astype(c.dtype)),
-            batch_ax, sp, None,
+        h, kv_out, aux = _layer_apply(
+            h, layer, c, positions,
+            mesh=mesh,
+            constrain=lambda x: constrain(x, batch_ax, sp, None),
+            return_kv=return_kv,
         )
-
-        y = rms_norm(h, layer["ln2"])
-        if c.n_experts:
-            from bee_code_interpreter_tpu.models.moe import moe_mlp
-
-            mlp, aux = moe_mlp(
-                layer["moe"], y,
-                n_experts=c.n_experts, top_k=c.moe_top_k,
-                capacity_factor=c.moe_capacity_factor, dtype=c.dtype,
-                group_size=c.moe_group_size,
-            )
-        else:
-            gate = jnp.einsum("bld,df->blf", y, layer["w_gate"].astype(c.dtype))
-            up = jnp.einsum("bld,df->blf", y, layer["w_up"].astype(c.dtype))
-            mlp = jnp.einsum(
-                "blf,fd->bld", jax.nn.silu(gate) * up, layer["w_down"].astype(c.dtype)
-            )
-            aux = jnp.float32(0.0)
-        h = h + constrain(mlp, batch_ax, sp, None)
         return h, (kv_out, aux)
 
     h, (kv, aux_layers) = lax.scan(layer_step, h, params["layers"])
@@ -351,6 +373,65 @@ def forward(
     if extras:
         return (logits, *extras)
     return logits
+
+
+# -------------------------------------------------------------- pipelined fwd
+
+
+def forward_pipelined(
+    params: Params,
+    tokens: jax.Array,  # [B, L] int32
+    config: TransformerConfig,
+    mesh: Mesh,
+    n_microbatches: int,
+) -> jax.Array:
+    """Pipeline-parallel forward: the layer stack sharded over the mesh's
+    ``pp`` axis, microbatches (batch-dim splits) streamed through the GPipe
+    schedule (parallel/pipeline.py); batch additionally shards over dp/fsdp
+    axes when present. Embedding / final norm / lm head run outside the
+    pipeline. Differentiable — ``jax.grad`` through this is pipeline-parallel
+    training. tp/sp inside stages would need nested shard_map; use the
+    non-pipelined ``forward`` for those axes instead.
+
+    Dense configs only: MoE would need the load-balancing aux loss threaded
+    through the pipeline carry (silently dropping it trains experts toward
+    collapse), and per-microbatch routing pools differ from the full-batch
+    forward's under capacity pressure."""
+    from bee_code_interpreter_tpu.parallel.pipeline import spmd_pipeline
+
+    if config.n_experts:
+        raise NotImplementedError(
+            "forward_pipelined supports dense configs only: the MoE aux loss "
+            "is not threaded through the pipeline carry (use forward with an "
+            "ep/tp mesh for MoE)"
+        )
+    c = config
+    B, L = tokens.shape
+    if B % n_microbatches != 0:
+        raise ValueError(
+            f"batch {B} not divisible into {n_microbatches} microbatches"
+        )
+
+    h = params["embed"].astype(c.dtype)[tokens]  # [B, L, D]
+
+    batch_axes = tuple(a for a in ("dp", "fsdp") if a in mesh.axis_names)
+
+    def stage(h, layer):
+        # batch-dim microbatching: absolute positions are simply 0..L-1 for
+        # every row, whatever shard of the batch this stage holds
+        pos = jnp.broadcast_to(
+            jnp.arange(h.shape[1], dtype=jnp.int32), h.shape[:2]
+        )
+        h, _, _ = _layer_apply(h, layer, c, pos)
+        return h
+
+    h = spmd_pipeline(
+        stage, params["layers"], h,
+        mesh=mesh, n_microbatches=n_microbatches, batch_axes=batch_axes,
+    )
+    h = rms_norm(h, params["ln_f"])
+    logits = jnp.einsum("bld,dv->blv", h, params["lm_head"].astype(c.dtype))
+    return logits.astype(jnp.float32)
 
 
 # ------------------------------------------------------------- cached decode
