@@ -35,11 +35,7 @@ import optax
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from bee_code_interpreter_tpu.ops.flash_attention import flash_attention
-from bee_code_interpreter_tpu.parallel.ring_attention import (
-    reference_attention,
-    ring_attention,
-)
+from bee_code_interpreter_tpu.parallel.ring_attention import ring_attention
 
 Params = dict[str, Any]
 
@@ -64,6 +60,11 @@ class TransformerConfig:
     moe_capacity_factor: float = 1.25
     moe_aux_weight: float = 1e-2
     moe_group_size: int = 1024  # GShard routing-group size (memory bound)
+    # Sequence-parallel attention strategy when the mesh has sp > 1:
+    # "ring" rotates compact K/V over ppermute (parallel/ring_attention.py);
+    # "ulysses" re-shards heads<->sequence with all-to-alls and runs the
+    # local flash kernel on the full sequence (parallel/ulysses.py).
+    sp_attention: str = "ring"
 
     @property
     def kv_heads(self) -> int:
@@ -219,29 +220,60 @@ def shard_params(params: Params, config: TransformerConfig, mesh: Mesh) -> Param
 
 
 def _local_attention(q, k, v):
-    """Single-shard attention: Pallas flash on TPU, reference elsewhere."""
-    if jax.devices()[0].platform == "tpu":
-        return flash_attention(q, k, v)
-    return reference_attention(q, k, v, causal=True)
+    """Single-shard causal attention — the shared ops-level platform
+    dispatch (Pallas flash on TPU, reference elsewhere; GQA-native)."""
+    from bee_code_interpreter_tpu.ops.flash_attention import local_attention
+
+    return local_attention(q, k, v, causal=True)
 
 
-def _attention(q, k, v, mesh: Mesh | None):
-    """[B, H, L, D] causal attention.
+def _attention(q, k, v, mesh: Mesh | None, sp_attention: str = "ring"):
+    """Causal attention; q [B, H, L, D], k/v [B, KVH, L, D] (KVH ≤ H).
+
+    K/V stay compact through the whole path (flash kernel index-maps KV
+    heads, the ring rotates KVH-sized blocks) — GQA never materializes the
+    head broadcast, saving H/KVH × KV HBM/ICI traffic.
 
     With a mesh, runs inside shard_map — batch over dp, heads over tp,
     sequence over sp. Manual SPMD is required here anyway: GSPMD cannot
-    partition a pallas_call, and the sp > 1 path needs the ppermute ring.
+    partition a pallas_call, and the sp > 1 path needs explicit collectives
+    (the ppermute ring, or Ulysses' all-to-alls per ``sp_attention``).
     """
+    if sp_attention not in ("ring", "ulysses"):
+        raise ValueError(
+            f"sp_attention must be 'ring' or 'ulysses', got {sp_attention!r}"
+        )
     if mesh is None:
         return _local_attention(q, k, v)
     axes = mesh.axis_names
     tp = "tp" if "tp" in axes else None
     has_sp = "sp" in axes and mesh.shape["sp"] > 1
     sp = "sp" if has_sp else None
+    if tp is not None and k.shape[1] % mesh.shape["tp"] != 0:
+        # KV heads don't split over tp: broadcast up — but only to
+        # lcm(KVH, tp), the minimal multiple that shards evenly (both divide
+        # n_heads, so the lcm does too and group-major q→kv pairing is
+        # preserved); repeating all the way to n_heads would multiply KV
+        # HBM/ICI traffic in exactly the KV-bandwidth-bound regime the
+        # compact-GQA path exists for
+        rep = math.lcm(k.shape[1], mesh.shape["tp"]) // k.shape[1]
+        k = jnp.repeat(k, rep, axis=1)
+        v = jnp.repeat(v, rep, axis=1)
     spec = P(_batch_axes(mesh), tp, sp, None)
 
     if has_sp:
-        local = functools.partial(ring_attention, axis_name="sp", causal=True)
+        if sp_attention == "ulysses":
+            from bee_code_interpreter_tpu.parallel.ulysses import (
+                ulysses_attention,
+            )
+
+            local = functools.partial(
+                ulysses_attention, axis_name="sp", causal=True
+            )
+        else:
+            local = functools.partial(
+                ring_attention, axis_name="sp", causal=True
+            )
     else:
         local = _local_attention
     fn = jax.shard_map(local, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
@@ -275,12 +307,8 @@ def _layer_apply(
     k = rope(proj(layer["wk"], kvh), positions, c.rope_theta)
     v = proj(layer["wv"], kvh)
     kv_out = (k, v) if return_kv else None
-    if kvh != nh:  # grouped-query: broadcast kv heads
-        rep = nh // kvh
-        k = jnp.repeat(k, rep, axis=1)
-        v = jnp.repeat(v, rep, axis=1)
-
-    attn = _attention(q, k, v, mesh)
+    # GQA-native: compact k/v go in as-is
+    attn = _attention(q, k, v, mesh, c.sp_attention)
     attn = attn.transpose(0, 2, 1, 3).reshape(B, L, nh * dh)
     h = h + constrain(jnp.einsum("blk,kd->bld", attn, layer["wo"].astype(c.dtype)))
 
@@ -384,7 +412,8 @@ def forward_pipelined(
     config: TransformerConfig,
     mesh: Mesh,
     n_microbatches: int,
-) -> jax.Array:
+    return_aux: bool = False,
+) -> jax.Array | tuple:
     """Pipeline-parallel forward: the layer stack sharded over the mesh's
     ``pp`` axis, microbatches (batch-dim splits) streamed through the GPipe
     schedule (parallel/pipeline.py); batch additionally shards over dp/fsdp
@@ -393,19 +422,25 @@ def forward_pipelined(
     training. tp/sp inside stages would need nested shard_map; use the
     non-pipelined ``forward`` for those axes instead.
 
-    Dense configs only: MoE would need the load-balancing aux loss threaded
-    through the pipeline carry (silently dropping it trains experts toward
-    collapse), and per-microbatch routing pools differ from the full-batch
-    forward's under capacity pressure."""
+    MoE configs ride the pipeline's aux carry: each stage returns its
+    layers' load-balancing loss, masked to real (non-bubble) ticks and
+    averaged over microbatches (``with_aux`` in spmd_pipeline) — equal to a
+    sequential per-microbatch forward. Note routing pools are per
+    microbatch: under capacity pressure tokens compete within their
+    microbatch, not the full batch, so logits match the non-pipelined
+    ``forward`` only drop-free (ample capacity) — the same caveat as cached
+    decode (see ``generate_cached``)."""
     from bee_code_interpreter_tpu.parallel.pipeline import spmd_pipeline
 
-    if config.n_experts:
-        raise NotImplementedError(
-            "forward_pipelined supports dense configs only: the MoE aux loss "
-            "is not threaded through the pipeline carry (use forward with an "
-            "ep/tp mesh for MoE)"
-        )
     c = config
+    if c.n_experts and not return_aux:
+        # training MoE without the load-balancing term drives experts toward
+        # collapse; fail loudly rather than silently discard it (inference
+        # callers pass return_aux=True and drop the scalar)
+        raise ValueError(
+            "MoE configs require return_aux=True on forward_pipelined: the "
+            "load-balancing aux loss must reach the objective"
+        )
     B, L = tokens.shape
     if B % n_microbatches != 0:
         raise ValueError(
@@ -422,16 +457,20 @@ def forward_pipelined(
         pos = jnp.broadcast_to(
             jnp.arange(h.shape[1], dtype=jnp.int32), h.shape[:2]
         )
-        h, _, _ = _layer_apply(h, layer, c, pos)
-        return h
+        h, _, aux = _layer_apply(h, layer, c, pos)
+        return h, aux
 
-    h = spmd_pipeline(
+    h, aux = spmd_pipeline(
         stage, params["layers"], h,
         mesh=mesh, n_microbatches=n_microbatches, batch_axes=batch_axes,
+        with_aux=True,
     )
     h = rms_norm(h, params["ln_f"])
     logits = jnp.einsum("bld,dv->blv", h, params["lm_head"].astype(c.dtype))
-    return logits.astype(jnp.float32)
+    logits = logits.astype(jnp.float32)
+    if return_aux:
+        return logits, aux
+    return logits
 
 
 # ------------------------------------------------------------- cached decode
@@ -475,20 +514,19 @@ def decode_step(
         k_layer = lax.dynamic_update_slice(k_layer, k_new, (0, 0, pos, 0))
         v_layer = lax.dynamic_update_slice(v_layer, v_new, (0, 0, pos, 0))
 
-        k, v = k_layer, v_layer
-        if kvh != nh:
-            rep = nh // kvh
-            k = jnp.repeat(k, rep, axis=1)
-            v = jnp.repeat(v, rep, axis=1)
-
+        # grouped-query decode: q regrouped [B, kvh, rep, Dh] so the einsums
+        # broadcast over the compact cache — the decode step is KV-cache-
+        # bandwidth-bound, and this reads kvh heads of HBM, not nh
+        rep = nh // kvh
+        qg = q[:, :, 0, :].reshape(B, kvh, rep, dh).astype(jnp.float32)
         scores = jnp.einsum(
-            "bhqd,bhkd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32)
+            "bgrd,bgsd->bgrs", qg, k_layer.astype(jnp.float32)
         ) / math.sqrt(dh)
         visible = jnp.arange(max_len) <= pos  # [max]
         scores = jnp.where(visible[None, None, None, :], scores, -jnp.inf)
         weights = jax.nn.softmax(scores, axis=-1).astype(c.dtype)
-        attn = jnp.einsum("bhqk,bhkd->bhqd", weights, v)  # [B,nh,1,Dh]
-        attn = attn.transpose(0, 2, 1, 3).reshape(B, 1, nh * dh)
+        attn = jnp.einsum("bgrs,bgsd->bgrd", weights, v_layer)  # [B,kvh,rep,Dh]
+        attn = attn.reshape(B, 1, nh * dh)
         h = h + jnp.einsum("blk,kd->bld", attn, layer["wo"].astype(c.dtype))
 
         y = rms_norm(h, layer["ln2"])

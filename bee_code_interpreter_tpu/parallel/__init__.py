@@ -30,3 +30,7 @@ from bee_code_interpreter_tpu.parallel.pipeline import (  # noqa: F401
 from bee_code_interpreter_tpu.parallel.ring_attention import (  # noqa: F401
     ring_attention,
 )
+from bee_code_interpreter_tpu.parallel.ulysses import (  # noqa: F401
+    ulysses_attention,
+    ulysses_attention_sharded,
+)

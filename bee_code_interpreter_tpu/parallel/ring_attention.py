@@ -32,21 +32,24 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 def _block_attend(q, k, v, m, l, o, sm_scale, mask):
     """One online-softmax accumulation step against a K/V block.
 
-    q: [B, H, Lq, D]; k, v: [B, H, Lk, D]; m, l: [B, H, Lq, 1]; o like q
-    (all float32 accumulators). mask: [Lq, Lk] additive (-inf) or None.
+    q, o: [B, G, R, Lq, D]; k, v: [B, G, Lk, D]; m, l: [B, G, R, Lq, 1]
+    (all float32 accumulators) — G = KV heads, R = query heads per KV head
+    (R == 1 when not grouped-query; the einsums broadcast K/V over R, so the
+    compact KV block is what rotates the ring). mask: [Lq, Lk] additive
+    (-inf) or None.
     """
     scores = jnp.einsum(
-        "bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32
+        "bgrqd,bgkd->bgrqk", q, k, preferred_element_type=jnp.float32
     ) * sm_scale
     if mask is not None:
         scores = scores + mask
-    block_max = jnp.max(scores, axis=-1, keepdims=True)  # [B,H,Lq,1]
+    block_max = jnp.max(scores, axis=-1, keepdims=True)  # [B,G,R,Lq,1]
     new_m = jnp.maximum(m, block_max)
     # rescale previous accumulator to the new max
     correction = jnp.exp(m - new_m)
-    p = jnp.exp(scores - new_m)  # [B,H,Lq,Lk]
+    p = jnp.exp(scores - new_m)  # [B,G,R,Lq,Lk]
     new_l = l * correction + jnp.sum(p, axis=-1, keepdims=True)
-    pv = jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32))
+    pv = jnp.einsum("bgrqk,bgkd->bgrqd", p, v.astype(jnp.float32))
     new_o = o * correction + pv
     return new_m, new_l, new_o
 
@@ -62,18 +65,24 @@ def ring_attention(
 ) -> jax.Array:
     """Exact attention with K/V rotating around the ``axis_name`` ring.
 
-    Shapes (per device): q, k, v: [B, H, L_local, D]. Returns [B, H, L_local, D]
-    in q's dtype. Must run inside shard_map with ``axis_name`` bound.
+    Shapes (per device): q: [B, H, L_local, D]; k, v: [B, KVH, L_local, D]
+    with ``H % KVH == 0`` — grouped-query KV stays compact, so the ring
+    rotates (and each hop's ppermute moves) KVH heads of K/V, not H. Returns
+    [B, H, L_local, D] in q's dtype. Must run inside shard_map with
+    ``axis_name`` bound.
     """
     orig_dtype = q.dtype
     B, H, Lq, D = q.shape
+    KVH = k.shape[1]
+    if H % KVH != 0:
+        raise ValueError(f"n_heads {H} not a multiple of kv_heads {KVH}")
     Lk = k.shape[2]
     sm_scale = sm_scale if sm_scale is not None else D ** -0.5
 
     n = lax.axis_size(axis_name)
     my_idx = lax.axis_index(axis_name)
 
-    qf = q.astype(jnp.float32)
+    qf = q.astype(jnp.float32).reshape(B, KVH, H // KVH, Lq, D)
     # derive accumulators from qf so they carry the same varying-axes type as
     # the data (shard_map vma typing: plain constants are "unvarying" and make
     # lax.cond branches disagree, whatever the surrounding mesh axes are)
@@ -122,7 +131,7 @@ def ring_attention(
     m, l, o, _, _ = lax.fori_loop(0, n, body, (m0, l0, o0, k, v))
     # guard fully-masked rows (shouldn't occur: every query sees its own block)
     out = o / jnp.maximum(l, 1e-30)
-    return out.astype(orig_dtype)
+    return out.reshape(B, H, Lq, D).astype(orig_dtype)
 
 
 def ring_attention_sharded(
@@ -147,7 +156,12 @@ def ring_attention_sharded(
 
 
 def reference_attention(q, k, v, *, causal=True):
-    """O(L²)-memory reference for tests."""
+    """O(L²)-memory reference for tests. Accepts grouped-query K/V
+    ([B, KVH, L, D] with KVH dividing q's head count) by broadcasting."""
+    if k.shape[1] != q.shape[1]:
+        rep = q.shape[1] // k.shape[1]
+        k = jnp.repeat(k, rep, axis=1)
+        v = jnp.repeat(v, rep, axis=1)
     scores = jnp.einsum(
         "bhqd,bhkd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32),
         preferred_element_type=jnp.float32,

@@ -1,0 +1,108 @@
+"""Ulysses-style sequence parallelism: all-to-all head/sequence exchange.
+
+The second canonical long-context strategy next to ring attention
+(parallel/ring_attention.py). Where the ring keeps queries resident and
+rotates K/V around the ``sp`` axis in sp-1 ppermute hops, Ulysses
+(DeepSpeed-Ulysses / all-to-all context parallelism) re-shards once: an
+``all_to_all`` turns the sequence-sharded [B, H, L/sp, D] activations into
+head-sharded [B, H/sp, L, D] — each device then holds the FULL sequence for
+a slice of heads, runs an ordinary (here: Pallas flash, GQA-native) local
+attention, and a second all-to-all restores sequence sharding.
+
+Trade-off, TPU terms: the ring moves (sp-1)/sp of K+V over neighbour ICI
+links and needs the online-softmax accumulation; Ulysses moves q+k+v+out
+once each through all-to-alls (cheap on a torus, but all-pairs) and runs the
+unmodified single-device kernel — better when heads are plentiful and the
+per-device sequence is short, and it composes with the flash kernel's causal
+block-skipping, which the ring's per-hop blocks cannot exploit across
+devices. sp must divide the head count (asserted); grouped-query K/V stays
+compact when sp also divides kv_heads, otherwise it is broadcast up first.
+
+``models/transformer.py`` selects between the two via
+``TransformerConfig.sp_attention`` ("ring" | "ulysses").
+
+The reference has no parallelism of any kind (SURVEY.md §2 "Parallelism
+strategies"); this module is part of the framework's first-class
+long-context story (SURVEY.md §5).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def ulysses_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    axis_name: str = "sp",
+    causal: bool = True,
+    local_attention=None,
+) -> jax.Array:
+    """All-to-all sequence-parallel attention. Must run inside shard_map.
+
+    Per-device shapes: q [B, H, L/sp, D]; k, v [B, KVH, L/sp, D] with KVH ≤ H
+    (grouped-query). Returns [B, H, L/sp, D]. ``local_attention(q, k, v)``
+    runs on the gathered [B, heads/sp, L, D] blocks and defaults to the
+    GQA-native Pallas flash kernel on TPU (reference attention elsewhere).
+    """
+    if local_attention is None:
+        # the shared ops-level dispatch: Pallas flash on TPU in either
+        # causal mode (the gathered full sequence is exactly where O(L²)
+        # reference memory would blow up), reference einsum off-TPU
+        from bee_code_interpreter_tpu.ops.flash_attention import (
+            local_attention as _dispatch,
+        )
+
+        local_attention = functools.partial(_dispatch, causal=causal)
+    sp = lax.axis_size(axis_name)
+    B, H, Lloc, D = q.shape
+    KVH = k.shape[1]
+    if H % sp != 0:
+        raise ValueError(f"sp={sp} must divide n_heads {H} for ulysses")
+    if KVH % sp != 0:
+        # too few KV heads to scatter: broadcast up to the query head count
+        # (the ring path keeps them compact; prefer ring when KVH < sp)
+        rep = H // KVH
+        k = jnp.repeat(k, rep, axis=1)
+        v = jnp.repeat(v, rep, axis=1)
+
+    # head-scatter / sequence-gather: [B, h, L/sp, D] -> [B, h/sp, L, D].
+    # Sequence blocks concatenate in sp-rank order — the same contiguous
+    # layout the sequence sharding put them in.
+    a2a = functools.partial(
+        lax.all_to_all, axis_name=axis_name, split_axis=1, concat_axis=2,
+        tiled=True,
+    )
+    out = local_attention(a2a(q), a2a(k), a2a(v))  # [B, H/sp, L, D]
+    # inverse exchange: sequence-scatter / head-gather
+    return lax.all_to_all(
+        out, axis_name=axis_name, split_axis=2, concat_axis=1, tiled=True
+    )
+
+
+def ulysses_attention_sharded(
+    mesh: Mesh,
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    axis_name: str = "sp",
+    causal: bool = True,
+) -> jax.Array:
+    """Standalone entry: shards [B, H, L, D] inputs over ``axis_name`` on L
+    and runs the exchange. For use outside an existing shard_map context."""
+    spec = P(None, None, axis_name, None)
+    fn = jax.shard_map(
+        functools.partial(ulysses_attention, axis_name=axis_name, causal=causal),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+    )
+    return fn(q, k, v)

@@ -4,15 +4,22 @@
 CI exercises the kernels in Pallas interpreter mode only; this script is the
 hardware proof: Mosaic-lowers the forward AND backward kernels on the
 attached chip, checks numerics against the jax reference, and reports
-achieved TFLOPS vs XLA's own fused attention.
+achieved TFLOPS vs XLA's own fused attention, plus the grouped-query (GQA)
+cases where the kernels read the compact KV heads directly.
 
-Usage:  python scripts/bench-flash-attention.py  (needs a reachable TPU)
+Timing method: N data-dependent kernel applications chained inside ONE jit
+(the output feeds the next call's query), a single scalar readback at the
+end. Per-call device→host readbacks are NOT a usable clock here — a tunnel
+round-trip measured ~70 ms this session, swamping ~10 ms kernels — and
+block_until_ready is not a reliable barrier through the tunnel at all
+(measured: apparent PFLOPS).
+
+Usage:  python scripts/bench-flash-attention.py  [--sweep]
 Prints one JSON line per case; exits 2 if no TPU.
 """
 
 from __future__ import annotations
 
-import functools
 import json
 import sys
 import time
@@ -24,7 +31,7 @@ if str(REPO) not in sys.path:
 
 import jax
 import jax.numpy as jnp
-import numpy as np
+from jax import lax
 
 from bee_code_interpreter_tpu.ops.flash_attention import flash_attention
 from bee_code_interpreter_tpu.parallel.ring_attention import reference_attention
@@ -36,23 +43,76 @@ def attention_flops(B: int, H: int, L: int, D: int, causal: bool) -> float:
     return flops / 2 if causal else flops
 
 
-def timed_scalar(fn, q, k, v, iters: int = 4) -> float:
-    """Per-call seconds with a scalar host readback per call.
-
-    block_until_ready is not a reliable completion barrier through a TPU
-    tunnel (measured: apparent PFLOPS); a device→host readback is. ``fn``
-    must return a scalar. Per-call readback latency (~ms) is noise next to
-    the multi-ms attention calls being measured.
-    """
-    jit_fn = jax.jit(fn)
-    float(jit_fn(q, k, v))  # compile + warm
+def _best_of(f, q, k, v, reps: int = 3) -> float:
+    float(f(q, k, v))  # compile + warm
     best = float("inf")
-    for _ in range(3):
+    for _ in range(reps):
         t0 = time.perf_counter()
-        for _ in range(iters):
-            float(jit_fn(q, k, v))
-        best = min(best, (time.perf_counter() - t0) / iters)
+        float(f(q, k, v))
+        best = min(best, time.perf_counter() - t0)
     return best
+
+
+def _timed_chain(make_f, q, k, v, n_chain: int) -> float:
+    """Per-call seconds from the difference of an n_chain-long and a 1-long
+    chain: (t_N - t_1) / (N - 1) cancels the per-measurement fixed cost —
+    dispatch plus the readback RTT, which would otherwise add RTT/N to every
+    call (~9 ms at the ~70 ms RTT measured through the tunnel this session,
+    not negligible against ~10 ms kernels)."""
+    t_n = _best_of(make_f(n_chain), q, k, v)
+    t_1 = _best_of(make_f(1), q, k, v)
+    assert t_n > t_1 * 1.2, (
+        f"clock failed: {n_chain}-chain {t_n*1e3:.1f} ms not meaningfully "
+        f"above 1-chain {t_1*1e3:.1f} ms — RTT jitter swamped the kernel; "
+        "rerun or raise n_chain"
+    )
+    return (t_n - t_1) / (n_chain - 1)
+
+
+def timed_fwd(attn, q, k, v, n_chain: int = 8) -> float:
+    """Per-call seconds for ``attn(q, k, v) -> [B, H, L, D]``: the output is
+    the next call's query, so the chain cannot be reordered or elided."""
+
+    def make_f(length):
+        @jax.jit
+        def f(q, k, v):
+            def body(c, _):
+                return attn(c, k, v), None
+
+            c, _ = lax.scan(body, q, None, length=length)
+            return c.astype(jnp.float32).sum()
+
+        return f
+
+    return _timed_chain(make_f, q, k, v, n_chain)
+
+
+def timed_fwd_bwd(loss, q, k, v, n_chain: int = 8) -> float:
+    """Per-call seconds for one value_and_grad of ``loss`` wrt (q, k, v):
+    chained as gradient-descent steps on all three operands, so dq, dk AND
+    dv are all live (grad wrt q alone would let XLA prune the dk/dv work —
+    a skewed comparison against the opaque custom_vjp kernel, which always
+    computes all three)."""
+
+    def make_f(length):
+        @jax.jit
+        def f(q, k, v):
+            def body(carry, _):
+                q, k, v = carry
+                dq, dk, dv = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+                s = 1e-3
+                return (
+                    (q - s * dq).astype(q.dtype),
+                    (k - s * dk.astype(jnp.float32)).astype(k.dtype),
+                    (v - s * dv.astype(jnp.float32)).astype(v.dtype),
+                ), None
+
+            (q, _, _), _ = lax.scan(body, (q, k, v), None, length=length)
+            return q.astype(jnp.float32).sum()
+
+        return f
+
+    return _timed_chain(make_f, q, k, v, n_chain)
 
 
 def main() -> None:
@@ -68,12 +128,7 @@ def main() -> None:
         print(f"no TPU: {probe}", file=sys.stderr)
         sys.exit(2)
 
-    B, H, L, D = 4, 16, 4096, 128
     causal = True
-    q, k, v = (
-        jax.random.normal(jax.random.PRNGKey(i), (B, H, L, D), dtype=jnp.bfloat16)
-        for i in range(3)
-    )
 
     # --- correctness on hardware (fwd + bwd Mosaic lowering) -------------
     small = tuple(
@@ -99,35 +154,53 @@ def main() -> None:
     # bf16 tolerance: values are O(sqrt(D)) after softmax-weighted sums
     assert fwd_err < 0.1, f"forward kernel diverges on hardware: {fwd_err}"
     assert bwd_err < 1.0, f"backward kernel diverges on hardware: {bwd_err}"
+
+    # GQA on silicon: compact KV vs the broadcast reference
+    qg = jax.random.normal(jax.random.PRNGKey(7), (1, 8, 512, 64), jnp.bfloat16)
+    kg, vg = (
+        jax.random.normal(jax.random.PRNGKey(8 + i), (1, 2, 512, 64), jnp.bfloat16)
+        for i in range(2)
+    )
+    out_gqa = flash_attention(qg, kg, vg, causal, None, 256, 256, False)
+    ref_gqa = reference_attention(
+        qg, jnp.repeat(kg, 4, 1), jnp.repeat(vg, 4, 1), causal=True
+    )
+    gqa_err = float(
+        jnp.max(jnp.abs(out_gqa.astype(jnp.float32) - ref_gqa.astype(jnp.float32)))
+    )
+    assert gqa_err < 0.1, f"GQA forward diverges on hardware: {gqa_err}"
     print(
         json.dumps({"case": "hardware_numerics", "fwd_max_err": round(fwd_err, 4),
-                    "bwd_max_err": round(bwd_err, 4)})
+                    "bwd_max_err": round(bwd_err, 4),
+                    "gqa_fwd_max_err": round(gqa_err, 4)})
     )
 
-    # --- forward throughput ----------------------------------------------
+    # --- forward throughput (MHA) ----------------------------------------
+    B, H, L, D = 4, 16, 4096, 128
+    q, k, v = (
+        jax.random.normal(jax.random.PRNGKey(i), (B, H, L, D), dtype=jnp.bfloat16)
+        for i in range(3)
+    )
     flops = attention_flops(B, H, L, D, causal)
     if "--sweep" in sys.argv:
         for bq, bk in [(256, 256), (512, 512), (512, 1024), (1024, 512),
                        (1024, 1024), (1024, 2048)]:
-            t = timed_scalar(
+            t = timed_fwd(
                 lambda x, k, v, bq=bq, bk=bk: flash_attention(
                     x, k, v, causal, None, bq, bk, False
-                ).astype(jnp.float32).sum(),
+                ),
                 q, k, v,
             )
             print(json.dumps({
                 "case": "forward_sweep", "block_q": bq, "block_k": bk,
                 "tflops": round(flops / t / 1e12, 1),
             }))
-    t_flash = timed_scalar(
-        lambda x, k, v: flash_attention(
-            x, k, v, causal, None, 1024, 1024, False
-        ).astype(jnp.float32).sum(),
+    t_flash = timed_fwd(
+        lambda x, k, v: flash_attention(x, k, v, causal, None, 1024, 1024, False),
         q, k, v,
     )
-    t_xla = timed_scalar(
-        lambda x, k, v: reference_attention(x, k, v, causal=causal)
-        .astype(jnp.float32).sum(),
+    t_xla = timed_fwd(
+        lambda x, k, v: reference_attention(x, k, v, causal=causal).astype(x.dtype),
         q, k, v,
     )
     print(
@@ -142,22 +215,37 @@ def main() -> None:
         )
     )
 
-    # --- train-step (fwd+bwd) throughput (~3x fwd flops) ------------------
-    # All three grads on BOTH sides: with argnums=0 alone, XLA prunes the
-    # dk/dv computation at transpose time while the opaque custom_vjp kernel
-    # always computes all three — a skewed comparison.
-    def grad_sum(loss):
-        def fn(x, k, v):
-            dq, dk, dv = jax.grad(loss, argnums=(0, 1, 2))(x, k, v)
-            return (
-                dq.astype(jnp.float32).sum()
-                + dk.astype(jnp.float32).sum()
-                + dv.astype(jnp.float32).sum()
-            )
-        return fn
+    # --- forward throughput (GQA, llama3-8b head geometry) ----------------
+    KVH = 8
+    Bg, Hg = 4, 32
+    qG = jax.random.normal(jax.random.PRNGKey(10), (Bg, Hg, L, D), jnp.bfloat16)
+    kG, vG = (
+        jax.random.normal(jax.random.PRNGKey(11 + i), (Bg, KVH, L, D), jnp.bfloat16)
+        for i in range(2)
+    )
+    flops_g = attention_flops(Bg, Hg, L, D, causal)
+    t_gqa = timed_fwd(lambda x, k, v: flash_attention(x, k, v, causal), qG, kG, vG)
+    t_rep = timed_fwd(
+        lambda x, k, v: flash_attention(
+            x, jnp.repeat(k, Hg // KVH, 1), jnp.repeat(v, Hg // KVH, 1), causal
+        ),
+        qG, kG, vG,
+    )
+    print(
+        json.dumps(
+            {
+                "case": "forward_gqa",
+                "shape": [Bg, Hg, L, D], "kv_heads": KVH,
+                "gqa_native_tflops": round(flops_g / t_gqa / 1e12, 1),
+                "repeat_kv_tflops": round(flops_g / t_rep / 1e12, 1),
+                "speedup_vs_repeat": round(t_rep / t_gqa, 2),
+            }
+        )
+    )
 
-    t_gflash = timed_scalar(grad_sum(loss_flash), q, k, v)
-    t_gref = timed_scalar(grad_sum(loss_ref), q, k, v)
+    # --- train-step (fwd+bwd) throughput (~3x fwd flops) ------------------
+    t_gflash = timed_fwd_bwd(loss_flash, q, k, v)
+    t_gref = timed_fwd_bwd(loss_ref, q, k, v)
     print(
         json.dumps(
             {
@@ -166,6 +254,20 @@ def main() -> None:
                 "flash_tflops": round(3 * flops / t_gflash / 1e12, 1),
                 "xla_ref_tflops": round(3 * flops / t_gref / 1e12, 1),
                 "speedup_vs_xla": round(t_gref / t_gflash, 2),
+            }
+        )
+    )
+
+    def loss_gqa(q, k, v):
+        return (flash_attention(q, k, v, causal).astype(jnp.float32) ** 2).sum()
+
+    t_ggqa = timed_fwd_bwd(loss_gqa, qG, kG, vG, n_chain=4)
+    print(
+        json.dumps(
+            {
+                "case": "forward+backward_gqa",
+                "shape": [Bg, Hg, L, D], "kv_heads": KVH,
+                "gqa_native_tflops": round(3 * flops_g / t_ggqa / 1e12, 1),
             }
         )
     )

@@ -88,7 +88,9 @@ def test_pallas_bwd_matches_blockwise_oracle():
             causal, sm_scale, 128, 128, True,
         )
         o = o4.reshape(B * H, L, D)
-        got = _flash_bwd_pallas(q, k, v, o, lse, do, causal, sm_scale, 128, 128, True)
+        got = _flash_bwd_pallas(
+            q, k, v, o, lse, do, causal, sm_scale, 128, 128, True, H, H
+        )
         want = _attention_bwd_blockwise(q, k, v, o, lse, do, causal, sm_scale, 128)
         for g, w, name in zip(got, want, ("dq", "dk", "dv")):
             assert jnp.allclose(g, w, atol=2e-4, rtol=2e-4), (causal, name)
@@ -167,3 +169,49 @@ def test_mismatched_block_sizes_grads():
     want = jax.grad(ref_loss, argnums=(0, 1, 2))(q, k, v)
     for g, w in zip(got, want):
         np.testing.assert_allclose(np.asarray(g), np.asarray(w), atol=5e-4, rtol=5e-4)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_gqa_forward_matches_broadcast_reference(causal):
+    # Grouped-query K/V ([B, KVH, L, D], KVH < H) must equal attention against
+    # the materialized jnp.repeat broadcast — the kernel index-maps KV heads
+    # instead of broadcasting, so head→kv-head pairing is what's under test.
+    B, H, KVH, L, D = 2, 8, 2, 192, 32
+    q = rand((B, H, L, D), 0)
+    k = rand((B, KVH, L, D), 1)
+    v = rand((B, KVH, L, D), 2)
+    out = flash_attention(q, k, v, causal)
+    rep = H // KVH
+    ref = reference_attention(
+        q, jnp.repeat(k, rep, axis=1), jnp.repeat(v, rep, axis=1), causal=causal
+    )
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+
+def test_gqa_grads_match_broadcast_reference():
+    # dk/dv come back compact [B, KVH, L, D]: the dkdv kernel's sequential
+    # grid runs over rep·q-blocks, accumulating the group's query heads in
+    # VMEM. The reference gradient is the broadcast one segment-summed.
+    B, H, KVH, L, D = 1, 4, 2, 160, 16
+    q = rand((B, H, L, D), 3)
+    k = rand((B, KVH, L, D), 4)
+    v = rand((B, KVH, L, D), 5)
+    rep = H // KVH
+
+    def loss(q, k, v):
+        return (flash_attention(q, k, v, True) ** 2).sum()
+
+    def ref_loss(q, kf, vf):
+        return (reference_attention(q, kf, vf, causal=True) ** 2).sum()
+
+    dq, dk, dv = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+    assert dk.shape == (B, KVH, L, D) and dv.shape == (B, KVH, L, D)
+    dq_ref, dk_full, dv_full = jax.grad(ref_loss, argnums=(0, 1, 2))(
+        q, jnp.repeat(k, rep, axis=1), jnp.repeat(v, rep, axis=1)
+    )
+    dk_ref = dk_full.reshape(B, KVH, rep, L, D).sum(axis=2)
+    dv_ref = dv_full.reshape(B, KVH, rep, L, D).sum(axis=2)
+    for g, w, name in zip((dq, dk, dv), (dq_ref, dk_ref, dv_ref), ("dq", "dk", "dv")):
+        np.testing.assert_allclose(
+            np.asarray(g), np.asarray(w), atol=5e-4, rtol=5e-4, err_msg=name
+        )

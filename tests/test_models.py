@@ -217,3 +217,34 @@ def test_llama3_8b_lowering_at_baseline_topology():
     # flagship MoE (Mixtral-8x7B shapes) over fsdp x ep x tp
     assert by_case["train_moe"]["lowered"]
     assert by_case["train_moe"]["per_device_state_gib"] < 16
+
+
+def test_gqa_partial_broadcast_when_tp_exceeds_kv_heads():
+    # kv_heads=2 on a tp=4 mesh: K/V broadcast to lcm(2,4)=4 heads (the
+    # minimal multiple that shards over tp), NOT all the way to n_heads=8 —
+    # and group-major q→kv pairing must survive, i.e. the sharded forward
+    # equals the single-shard one.
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from bee_code_interpreter_tpu.models.transformer import (
+        TransformerConfig,
+        forward,
+        init_params,
+        shard_params,
+    )
+    from bee_code_interpreter_tpu.parallel.mesh import make_mesh
+
+    config = dataclasses.replace(
+        TransformerConfig.tiny(), dtype=jnp.float32, n_heads=8, n_kv_heads=2
+    )
+    mesh = make_mesh({"tp": 4}, devices=jax.devices()[:4])
+    params = init_params(config, jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 24), 0, config.vocab_size)
+
+    want = forward(params, tokens, config)  # mesh=None
+    got = forward(shard_params(params, config, mesh), tokens, config, mesh)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-4, rtol=2e-4)

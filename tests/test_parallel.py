@@ -92,3 +92,21 @@ def test_ring_attention_inside_jit_compiles_once():
 
     out = fn(q, k, v)
     assert out.shape == (B, H, L, D)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_ring_attention_gqa_compact_kv(causal):
+    # Grouped-query: the ring rotates the compact [B, KVH, L/sp, D] K/V
+    # blocks (KVH/H of the ppermute bytes) and must still equal the
+    # broadcast reference.
+    mesh = make_mesh({"sp": 4})
+    B, H, KVH, L, D = 1, 4, 2, 64, 16
+    q = rand((B, H, L, D), 0)
+    k = rand((B, KVH, L, D), 1)
+    v = rand((B, KVH, L, D), 2)
+    out = ring_attention_sharded(mesh, q, k, v, causal=causal)
+    rep = H // KVH
+    ref = reference_attention(
+        q, jnp.repeat(k, rep, axis=1), jnp.repeat(v, rep, axis=1), causal=causal
+    )
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
