@@ -11,3 +11,7 @@ from bee_code_interpreter_tpu.models.transformer import (  # noqa: F401
     TransformerConfig,
 )
 from bee_code_interpreter_tpu.models.mnist import MnistMlp  # noqa: F401
+from bee_code_interpreter_tpu.models.vision import (  # noqa: F401
+    ResNet,
+    ResNetConfig,
+)

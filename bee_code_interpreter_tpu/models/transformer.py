@@ -334,11 +334,11 @@ def _layer_apply(
 
 
 def _batch_axes(mesh: Mesh | None):
-    """Activation batch dim shards over every data-parallel-ish axis present."""
-    if mesh is None:
-        return None
-    axes = tuple(a for a in ("dp", "fsdp") if a in mesh.axis_names)
-    return axes or None
+    """Activation batch dim shards over every data-parallel-ish axis present
+    (shared policy: parallel.mesh.batch_axes)."""
+    from bee_code_interpreter_tpu.parallel.mesh import batch_axes
+
+    return batch_axes(mesh)
 
 
 def forward(

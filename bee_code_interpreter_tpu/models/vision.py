@@ -1,0 +1,226 @@
+"""ResNet-style vision model family, TPU-first.
+
+Complements the BASELINE.json vision payload (examples/resnet50-torch-xla.py
+drives torch-xla *through the sandbox*) with a native-JAX path a sandboxed
+agent can import directly. Design choices are TPU choices, not a port of the
+torchvision graph:
+
+- **NHWC layout** end-to-end — the layout XLA:TPU convolutions are native
+  in (no transposes at every conv like NCHW would cost).
+- **bf16 compute, f32 master params** — convs ride the MXU at full rate;
+  the softmax/cross-entropy head stays f32.
+- **GroupNorm instead of BatchNorm**: normalization is per-sample, so there
+  is no cross-device batch-statistics psum in the forward and no mutable
+  running-stats state threaded through train/eval — the whole model stays a
+  pure function of (params, x), SPMD-sharding over ``dp``/``fsdp`` without
+  the sync-BN machinery data-parallel BatchNorm needs.
+- **Static everything**: stage layout fixed at trace time; the only scan is
+  over homogeneous blocks where depth makes compile time matter.
+
+``ResNetConfig.resnet50()`` matches the classic 50-layer bottleneck shape
+(3-4-6-3, width 64, 1000 classes); ``tiny()`` is the test/dry-run size.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+Params = dict[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class ResNetConfig:
+    num_classes: int = 1000
+    stage_sizes: tuple[int, ...] = (3, 4, 6, 3)  # resnet50 bottleneck depths
+    width: int = 64  # stem channels; stage c is width * 2**c (x4 expanded)
+    norm_groups: int = 32
+    dtype: Any = jnp.bfloat16
+
+    @classmethod
+    def resnet50(cls) -> "ResNetConfig":
+        return cls()
+
+    @classmethod
+    def tiny(cls) -> "ResNetConfig":
+        """Test/dry-run size (2 stages, 8-wide stem)."""
+        return cls(num_classes=10, stage_sizes=(1, 1), width=8, norm_groups=4)
+
+
+# ---------------------------------------------------------------- primitives
+
+
+def conv(x: jax.Array, w: jax.Array, stride: int = 1) -> jax.Array:
+    """NHWC x HWIO -> NHWC, SAME padding."""
+    return lax.conv_general_dilated(
+        x, w, window_strides=(stride, stride), padding="SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )
+
+
+def group_norm(x: jax.Array, scale: jax.Array, bias: jax.Array, groups: int) -> jax.Array:
+    """Per-sample normalization over (H, W, C/groups); f32 statistics."""
+    N, H, W, C = x.shape
+    g = min(groups, C)
+    xf = x.astype(jnp.float32).reshape(N, H, W, g, C // g)
+    mean = xf.mean(axis=(1, 2, 4), keepdims=True)
+    var = xf.var(axis=(1, 2, 4), keepdims=True)
+    xf = (xf - mean) * lax.rsqrt(var + 1e-5)
+    xf = xf.reshape(N, H, W, C)
+    return (xf * scale.astype(jnp.float32) + bias.astype(jnp.float32)).astype(x.dtype)
+
+
+# ------------------------------------------------------------------- weights
+
+
+def _conv_init(key, kh, kw, c_in, c_out):
+    fan_in = kh * kw * c_in
+    return jax.random.normal(key, (kh, kw, c_in, c_out), jnp.float32) * math.sqrt(
+        2.0 / fan_in
+    )
+
+
+def _norm_init(c):
+    return {"scale": jnp.ones((c,), jnp.float32), "bias": jnp.zeros((c,), jnp.float32)}
+
+
+def _block_init(key, c_in, c_mid, stride):
+    """Bottleneck: 1x1 reduce -> 3x3 (stride) -> 1x1 expand (x4)."""
+    ks = jax.random.split(key, 4)
+    c_out = 4 * c_mid
+    p = {
+        "conv1": _conv_init(ks[0], 1, 1, c_in, c_mid), "n1": _norm_init(c_mid),
+        "conv2": _conv_init(ks[1], 3, 3, c_mid, c_mid), "n2": _norm_init(c_mid),
+        "conv3": _conv_init(ks[2], 1, 1, c_mid, c_out), "n3": _norm_init(c_out),
+    }
+    if stride != 1 or c_in != c_out:
+        p["proj"] = _conv_init(ks[3], 1, 1, c_in, c_out)
+        p["nproj"] = _norm_init(c_out)
+    return p
+
+
+def init_params(config: ResNetConfig, key: jax.Array) -> Params:
+    c = config
+    keys = jax.random.split(key, 2 + len(c.stage_sizes))
+    params: Params = {
+        "stem": _conv_init(keys[0], 7, 7, 3, c.width),
+        "stem_norm": _norm_init(c.width),
+    }
+    c_in = c.width
+    for s, depth in enumerate(c.stage_sizes):
+        c_mid = c.width * (2 ** s)
+        bkeys = jax.random.split(keys[1 + s], depth)
+        blocks = []
+        for b in range(depth):
+            stride = 2 if (b == 0 and s > 0) else 1
+            blocks.append(_block_init(bkeys[b], c_in, c_mid, stride))
+            c_in = 4 * c_mid
+        params[f"stage{s}"] = blocks
+    params["fc"] = {
+        "w": jax.random.normal(keys[-1], (c_in, c.num_classes), jnp.float32)
+        / math.sqrt(c_in),
+        "b": jnp.zeros((c.num_classes,), jnp.float32),
+    }
+    return params
+
+
+# ------------------------------------------------------------------- forward
+
+
+def _block_apply(x, p, config, stride):
+    g = config.norm_groups
+    dt = config.dtype
+    y = jax.nn.relu(group_norm(conv(x, p["conv1"].astype(dt)), **p["n1"], groups=g))
+    y = jax.nn.relu(
+        group_norm(conv(y, p["conv2"].astype(dt), stride), **p["n2"], groups=g)
+    )
+    y = group_norm(conv(y, p["conv3"].astype(dt)), **p["n3"], groups=g)
+    shortcut = x
+    if "proj" in p:
+        shortcut = group_norm(
+            conv(x, p["proj"].astype(dt), stride), **p["nproj"], groups=g
+        )
+    return jax.nn.relu(y + shortcut)
+
+
+def forward(
+    params: Params,
+    images: jax.Array,  # [N, H, W, 3] (any float dtype)
+    config: ResNetConfig,
+    mesh: Mesh | None = None,
+) -> jax.Array:
+    """Returns logits [N, num_classes] (f32)."""
+    c = config
+    x = images.astype(c.dtype)
+
+    def constrain(x):
+        if mesh is None:
+            return x
+        from bee_code_interpreter_tpu.parallel.mesh import batch_axes
+
+        return lax.with_sharding_constraint(
+            x, NamedSharding(mesh, P(batch_axes(mesh), None, None, None))
+        )
+
+    x = constrain(x)
+    x = conv(x, params["stem"].astype(c.dtype), stride=2)
+    x = jax.nn.relu(group_norm(x, **params["stem_norm"], groups=c.norm_groups))
+    x = lax.reduce_window(
+        x, -jnp.inf, lax.max, (1, 3, 3, 1), (1, 2, 2, 1), "SAME"
+    )
+    for s, depth in enumerate(c.stage_sizes):
+        for b in range(depth):
+            stride = 2 if (b == 0 and s > 0) else 1
+            x = constrain(_block_apply(x, params[f"stage{s}"][b], c, stride))
+    x = x.mean(axis=(1, 2)).astype(jnp.float32)  # global average pool
+    return x @ params["fc"]["w"] + params["fc"]["b"]
+
+
+# ---------------------------------------------------------------- train step
+
+
+def loss_fn(params, batch, config, mesh=None):
+    logits = forward(params, batch["images"], config, mesh)
+    return optax.softmax_cross_entropy_with_integer_labels(
+        logits, batch["labels"]
+    ).mean()
+
+
+class ResNet:
+    """Config + mesh bundle mirroring models.transformer.Transformer."""
+
+    def __init__(self, config: ResNetConfig, mesh: Mesh | None = None) -> None:
+        self.config = config
+        self.mesh = mesh
+
+    def init(self, key: jax.Array) -> Params:
+        return init_params(self.config, key)
+
+    def apply(self, params: Params, images: jax.Array) -> jax.Array:
+        return forward(params, images, self.config, self.mesh)
+
+    def make_train_step(self, optimizer=None):
+        optimizer = optimizer or optax.sgd(0.1, momentum=0.9)
+
+        def train_step(params, opt_state, batch):
+            loss, grads = jax.value_and_grad(loss_fn)(
+                params, batch, self.config, self.mesh
+            )
+            updates, opt_state = optimizer.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state, loss
+
+        return jax.jit(train_step, donate_argnums=(0, 1))
+
+    def batch_sharding(self) -> NamedSharding | None:
+        if self.mesh is None:
+            return None
+        from bee_code_interpreter_tpu.parallel.mesh import batch_axes
+
+        return NamedSharding(self.mesh, P(batch_axes(self.mesh)))

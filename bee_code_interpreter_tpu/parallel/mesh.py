@@ -107,5 +107,15 @@ def batch_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P("dp", seq_axis))
 
 
+def batch_axes(mesh: Mesh | None) -> tuple[str, ...] | None:
+    """The data-parallel-ish axes an activation batch dim shards over —
+    the ONE policy for which mesh axes count as batch (models/transformer
+    and models/vision both key off this)."""
+    if mesh is None:
+        return None
+    axes = tuple(a for a in ("dp", "fsdp") if a in mesh.axis_names)
+    return axes or None
+
+
 def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())

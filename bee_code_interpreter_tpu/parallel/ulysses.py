@@ -29,6 +29,7 @@ long-context story (SURVEY.md §5).
 from __future__ import annotations
 
 import functools
+import math
 
 import jax
 import jax.numpy as jnp
@@ -67,9 +68,12 @@ def ulysses_attention(
     if H % sp != 0:
         raise ValueError(f"sp={sp} must divide n_heads {H} for ulysses")
     if KVH % sp != 0:
-        # too few KV heads to scatter: broadcast up to the query head count
-        # (the ring path keeps them compact; prefer ring when KVH < sp)
-        rep = H // KVH
+        # KV heads don't scatter over sp: broadcast up — only to
+        # lcm(KVH, sp), the minimal multiple that shards evenly (both divide
+        # H, so the lcm does too and group-major q→kv pairing is preserved —
+        # same argument as the tp-lcm broadcast in models/transformer.py).
+        # The ring path keeps KV fully compact; prefer ring when KVH < sp.
+        rep = math.lcm(KVH, sp) // KVH
         k = jnp.repeat(k, rep, axis=1)
         v = jnp.repeat(v, rep, axis=1)
 

@@ -1,0 +1,175 @@
+#!/usr/bin/env python
+"""KV-cached decode throughput on the real TPU chip.
+
+Two measurements:
+
+1. ``decode``: tokens/sec of the full incremental decode loop
+   (models/transformer.decode_step — one lax.scan-compiled program updating
+   the cache in place) on a ~1B-param llama-shaped config sized for one
+   v5e chip's HBM.
+2. ``decode_attention``: the attention inner loop in isolation — the
+   grouped-query einsum (reads the compact [B, KVH, S, D] cache once)
+   against the jnp.repeat broadcast variant it replaced. Decode is
+   KV-cache-bandwidth-bound, so the repeat variant's H/KVH× extra HBM
+   traffic is the whole story.
+
+Timing: the decode loop is naturally self-chaining (each step consumes the
+previous cache/token), so one jit + one scalar readback measures N real
+steps — the same RTT-proof structure as scripts/bench-flash-attention.py
+(per-call readbacks measured ~70 ms through the tunnel; see BASELINE.md
+timing note).
+
+Usage:  python scripts/bench-decode.py   (needs a reachable TPU; exits 2 if none)
+Prints one JSON line per case.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import math
+import sys
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+if str(REPO) not in sys.path:
+    sys.path.insert(0, str(REPO))
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def main() -> None:
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location("bench", REPO / "bench.py")
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+    probe = bench.probe_tpu()
+    if not probe.get("ok") or probe.get("platform") != "tpu":
+        print(f"no TPU: {probe}", file=sys.stderr)
+        sys.exit(2)
+
+    from bee_code_interpreter_tpu.models.transformer import (
+        TransformerConfig,
+        decode_step,
+        forward,
+        init_params,
+    )
+
+    # ~1.1B params (f32 masters ~4.4 GB + bf16 cache) — fits one v5e chip
+    config = TransformerConfig(
+        vocab_size=32000, d_model=2048, n_layers=16, n_heads=16,
+        n_kv_heads=4, d_ff=5632, max_seq_len=2048,
+    )
+    B, L_prompt, ctx = 8, 128, 2048
+    params = init_params(config, jax.random.PRNGKey(0))
+    n_params = sum(x.size for x in jax.tree.leaves(params))
+    prompt = jax.random.randint(jax.random.PRNGKey(1), (B, L_prompt), 0, 32000)
+
+    # prefill once to seed the cache
+    logits, (k_pre, v_pre) = forward(params, prompt, config, None, return_kv=True)
+    c = config
+    k_cache = jnp.zeros((c.n_layers, B, c.kv_heads, ctx, c.head_dim), c.dtype)
+    v_cache = jnp.zeros_like(k_cache)
+    k_cache = k_cache.at[:, :, :, :L_prompt, :].set(k_pre.astype(c.dtype))
+    v_cache = v_cache.at[:, :, :, :L_prompt, :].set(v_pre.astype(c.dtype))
+    first = jnp.argmax(logits[:, -1:, :], axis=-1).astype(jnp.int32)
+
+    def decode_n(n_steps):
+        @jax.jit
+        def f(tok, cache):
+            def body(carry, pos):
+                tok, cache = carry
+                lg, cache = decode_step(params, tok, pos, cache, config)
+                nxt = jnp.argmax(lg[:, -1:, :], axis=-1).astype(jnp.int32)
+                return (nxt, cache), None
+
+            (tok, _), _ = lax.scan(
+                body, (tok, cache),
+                jnp.arange(L_prompt, L_prompt + n_steps, dtype=jnp.int32),
+            )
+            return tok.astype(jnp.float32).sum()
+
+        return f
+
+    def best_of(f, *args, reps=3):
+        float(f(*args))  # compile + warm
+        best = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            float(f(*args))
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    N = 64
+    t_n = best_of(decode_n(N), first, (k_cache, v_cache))
+    t_1 = best_of(decode_n(1), first, (k_cache, v_cache))
+    per_step = max(t_n - t_1, 1e-9) / (N - 1)
+    toks_sec = B / per_step
+    # decode is HBM-bound: each step streams params (bf16 at compute) + cache
+    approx_bytes = 2 * n_params + 2 * k_cache.size * 2
+    print(json.dumps({
+        "case": "decode",
+        "config": {"d_model": c.d_model, "n_layers": c.n_layers,
+                   "heads": f"{c.n_heads}/{c.kv_heads}", "batch": B,
+                   "ctx": ctx, "params": n_params},
+        "per_step_ms": round(per_step * 1e3, 3),
+        "tokens_per_sec": round(toks_sec, 1),
+        "approx_hbm_gbps": round(approx_bytes / per_step / 1e9, 1),
+    }))
+
+    # --- attention-only: grouped einsum vs repeat broadcast ---------------
+    kvh, nh, dh, S = 8, 32, 128, 8192
+    rep = nh // kvh
+    kc = jax.random.normal(jax.random.PRNGKey(2), (B, kvh, S, dh), jnp.bfloat16)
+    vc = jax.random.normal(jax.random.PRNGKey(3), (B, kvh, S, dh), jnp.bfloat16)
+    q0 = jax.random.normal(jax.random.PRNGKey(4), (B, nh, dh), jnp.bfloat16)
+
+    def grouped(q, k, v):
+        qg = q.reshape(B, kvh, rep, dh).astype(jnp.float32)
+        s = jnp.einsum("bgrd,bgsd->bgrs", qg, k.astype(jnp.float32)) / math.sqrt(dh)
+        w = jax.nn.softmax(s, axis=-1).astype(k.dtype)
+        return jnp.einsum("bgrs,bgsd->bgrd", w, v).reshape(B, nh, dh)
+
+    def repeated(q, k, v):
+        kf = jnp.repeat(k, rep, axis=1)
+        vf = jnp.repeat(v, rep, axis=1)
+        s = jnp.einsum(
+            "bhd,bhsd->bhs", q.astype(jnp.float32), kf.astype(jnp.float32)
+        ) / math.sqrt(dh)
+        w = jax.nn.softmax(s, axis=-1).astype(k.dtype)
+        return jnp.einsum("bhs,bhsd->bhd", w, vf)
+
+    def chain(attn, n):
+        @jax.jit
+        def f(q, k, v):
+            def body(c, _):
+                return attn(c, k, v).astype(q.dtype), None
+
+            c, _ = lax.scan(body, q, None, length=n)
+            return c.astype(jnp.float32).sum()
+
+        return f
+
+    M = 32
+    results = {}
+    for name, fn in (("grouped", grouped), ("repeat", repeated)):
+        t_m = best_of(chain(fn, M), q0, kc, vc)
+        t_1 = best_of(chain(fn, 1), q0, kc, vc)
+        results[name] = max(t_m - t_1, 1e-9) / (M - 1)
+    cache_bytes = 2 * kvh * S * dh * B * 2  # k+v, bf16
+    print(json.dumps({
+        "case": "decode_attention",
+        "shape": {"batch": B, "heads": f"{nh}/{kvh}", "cache_len": S, "head_dim": dh},
+        "grouped_us": round(results["grouped"] * 1e6, 1),
+        "repeat_us": round(results["repeat"] * 1e6, 1),
+        "speedup": round(results["repeat"] / results["grouped"], 2),
+        "grouped_cache_gbps": round(cache_bytes / results["grouped"] / 1e9, 1),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -110,3 +110,19 @@ def test_transformer_forward_ulysses_matches_ring():
     np.testing.assert_allclose(
         np.asarray(ring), np.asarray(uly), atol=2e-4, rtol=2e-4
     )
+
+
+def test_gqa_partial_lcm_broadcast():
+    # KVH=2, sp=4, H=8: K/V broadcast to lcm(2,4)=4 heads (1 per device),
+    # NOT all the way to 8 — group-major pairing must survive.
+    mesh = make_mesh({"sp": 4})
+    B, H, KVH, L, D = 1, 8, 2, 64, 16
+    q = rand((B, H, L, D), 6)
+    k = rand((B, KVH, L, D), 7)
+    v = rand((B, KVH, L, D), 8)
+    out = ulysses_attention_sharded(mesh, q, k, v, causal=True)
+    rep = H // KVH
+    ref = reference_attention(
+        q, jnp.repeat(k, rep, axis=1), jnp.repeat(v, rep, axis=1), causal=True
+    )
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
