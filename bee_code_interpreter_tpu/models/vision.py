@@ -14,8 +14,10 @@ torchvision graph:
   running-stats state threaded through train/eval — the whole model stays a
   pure function of (params, x), SPMD-sharding over ``dp``/``fsdp`` without
   the sync-BN machinery data-parallel BatchNorm needs.
-- **Static everything**: stage layout fixed at trace time; the only scan is
-  over homogeneous blocks where depth makes compile time matter.
+- **Static everything**: stage layout fixed at trace time. Blocks are
+  unrolled (heterogeneous channel widths/strides rule out a single scanned
+  body; at ResNet depths the HLO stays small — the transformer, 32+ uniform
+  layers, is where the scan-over-layers trick lives).
 
 ``ResNetConfig.resnet50()`` matches the classic 50-layer bottleneck shape
 (3-4-6-3, width 64, 1000 classes); ``tiny()`` is the test/dry-run size.
@@ -66,9 +68,14 @@ def conv(x: jax.Array, w: jax.Array, stride: int = 1) -> jax.Array:
 
 
 def group_norm(x: jax.Array, scale: jax.Array, bias: jax.Array, groups: int) -> jax.Array:
-    """Per-sample normalization over (H, W, C/groups); f32 statistics."""
+    """Per-sample normalization over (H, W, C/groups); f32 statistics.
+    ``groups`` is clamped to the largest divisor of C not exceeding it, so
+    any channel count works (C=48 with groups=32 normalizes in 16 groups
+    rather than crashing the reshape)."""
     N, H, W, C = x.shape
     g = min(groups, C)
+    while C % g:
+        g -= 1
     xf = x.astype(jnp.float32).reshape(N, H, W, g, C // g)
     mean = xf.mean(axis=(1, 2, 4), keepdims=True)
     var = xf.var(axis=(1, 2, 4), keepdims=True)
@@ -78,6 +85,12 @@ def group_norm(x: jax.Array, scale: jax.Array, bias: jax.Array, groups: int) -> 
 
 
 # ------------------------------------------------------------------- weights
+
+
+def _block_stride(stage: int, block: int) -> int:
+    """Downsampling policy — THE single source for both init (which decides
+    projection shortcuts from it) and forward (which convolves with it)."""
+    return 2 if (block == 0 and stage > 0) else 1
 
 
 def _conv_init(key, kh, kw, c_in, c_out):
@@ -119,8 +132,7 @@ def init_params(config: ResNetConfig, key: jax.Array) -> Params:
         bkeys = jax.random.split(keys[1 + s], depth)
         blocks = []
         for b in range(depth):
-            stride = 2 if (b == 0 and s > 0) else 1
-            blocks.append(_block_init(bkeys[b], c_in, c_mid, stride))
+            blocks.append(_block_init(bkeys[b], c_in, c_mid, _block_stride(s, b)))
             c_in = 4 * c_mid
         params[f"stage{s}"] = blocks
     params["fc"] = {
@@ -177,8 +189,9 @@ def forward(
     )
     for s, depth in enumerate(c.stage_sizes):
         for b in range(depth):
-            stride = 2 if (b == 0 and s > 0) else 1
-            x = constrain(_block_apply(x, params[f"stage{s}"][b], c, stride))
+            x = constrain(
+                _block_apply(x, params[f"stage{s}"][b], c, _block_stride(s, b))
+            )
     x = x.mean(axis=(1, 2)).astype(jnp.float32)  # global average pool
     return x @ params["fc"]["w"] + params["fc"]["b"]
 

@@ -105,6 +105,57 @@ RECORDED_CPU_GFLOPS = 120.0
 
 LATENCY_PAYLOAD = "print(21 * 2)"
 
+# Guarded extra evidence: the Pallas flash-attention kernel vs XLA's own
+# fused attention, through the same execution path — so the kernel claims in
+# BASELINE.md stop being builder-session-only. Small shape (compile + two
+# timed chains ≈ 45-75 s on a healthy chip); timing by the (t_N - t_1)/(N-1)
+# chain difference, which cancels the device->host readback RTT exactly
+# (BASELINE.md round-3 timing note: the RTT hit ~70 ms through a tunnel).
+FLASH_PAYLOAD = """
+import time
+import jax, jax.numpy as jnp
+from jax import lax
+from bee_code_interpreter_tpu.ops.flash_attention import flash_attention
+from bee_code_interpreter_tpu.parallel.ring_attention import reference_attention
+
+B, H, L, D = 2, 8, 2048, 128
+N = 8
+q, k, v = (jax.random.normal(jax.random.PRNGKey(i), (B, H, L, D), jnp.bfloat16)
+           for i in range(3))
+
+def chain(attn, length):
+    @jax.jit
+    def f(q, k, v):
+        def body(c, _):
+            return attn(c, k, v), None
+        c, _ = lax.scan(body, q, None, length=length)
+        return c.astype(jnp.float32).sum()
+    return f
+
+def per_call(attn):
+    def best_of(f):
+        float(f(q, k, v))
+        best = float("inf")
+        for _ in range(2):
+            t0 = time.time()
+            float(f(q, k, v))
+            best = min(best, time.time() - t0)
+        return best
+    t_n = best_of(chain(attn, N))
+    t_1 = best_of(chain(attn, 1))
+    # clock sanity: RTT jitter making t_1 >= t_n must fail the payload (the
+    # bench omits the field) rather than record absurd TFLOPS as evidence
+    assert t_n > t_1 * 1.2, f"clock failed: t_{N}={t_n:.4f}s t_1={t_1:.4f}s"
+    return (t_n - t_1) / (N - 1)
+
+t_fl = per_call(lambda q, k, v: flash_attention(q, k, v, True))
+t_xl = per_call(
+    lambda q, k, v: reference_attention(q, k, v, causal=True).astype(q.dtype)
+)
+flops = 2 * B * H * L * L * D  # causal: half of 4*B*H*L*L*D
+print(f"RESULT_FLASH {flops / t_fl / 1e12:.2f} {flops / t_xl / 1e12:.2f}")
+"""
+
 
 def probe_tpu(timeout_s: float = 75.0) -> dict:
     """Bounded out-of-process probe of the JAX accelerator backend.
@@ -160,8 +211,18 @@ class PayloadError(RuntimeError):
 
 
 async def run_payload(
-    source: str, env: dict[str, str], timeout_s: float
+    source: str, env: dict[str, str], timeout_s: float,
+    marker: str = "RESULT_GFLOPS",
 ) -> float:
+    values = await run_payload_values(source, env, timeout_s, marker)
+    return values[0]
+
+
+async def run_payload_values(
+    source: str, env: dict[str, str], timeout_s: float, marker: str
+) -> list[float]:
+    """Execute through the service path; return the floats following
+    ``marker`` on the payload's result line."""
     from bee_code_interpreter_tpu.services.local_code_executor import (
         LocalCodeExecutor,
     )
@@ -182,8 +243,8 @@ async def run_payload(
             f"payload failed (exit {result.exit_code})", stderr=result.stderr
         )
     for line in result.stdout.splitlines():
-        if line.startswith("RESULT_GFLOPS"):
-            return float(line.split()[1])
+        if line.startswith(marker):
+            return [float(tok) for tok in line.split()[1:]]
     raise PayloadError(f"no result in stdout: {result.stdout!r}")
 
 
@@ -349,6 +410,27 @@ def main() -> None:
             tpu_attempts.append(entry)
             print(f"tpu payload attempt failed: {e}", file=sys.stderr)
 
+    # --- 1b. flash-attention kernel evidence (guarded; extra field only;
+    # runs only when the headline already landed, so it can never cost the
+    # main metric its window) ----------------------------------------------
+    flash: dict | None = None
+    if tpu_gflops is not None and chip_likely:
+        try:
+            fl, xl = asyncio.run(
+                run_payload_values(
+                    FLASH_PAYLOAD, {}, timeout_s=120.0, marker="RESULT_FLASH"
+                )
+            )
+            flash = {
+                "tflops": fl,
+                "xla_ref_tflops": xl,
+                "speedup_vs_xla": round(fl / xl, 2),
+                "shape": "B2 H8 L2048 D128 bf16 causal",
+            }
+            print(f"flash attention: {flash}", file=sys.stderr)
+        except Exception as e:
+            print(f"flash case failed (field omitted): {e}", file=sys.stderr)
+
     # --- 2. CPU baseline (guarded: can only degrade vs_baseline) ----------
     scrub_tunnel_vars()
     cpu_gflops: float | None = None
@@ -407,6 +489,8 @@ def main() -> None:
         }
     result["tpu_probe"] = tpu_probe
     result["tpu_attempts"] = tpu_attempts
+    if flash is not None:
+        result["flash_attention"] = flash
     result["latency_warm_p50_ms"] = (
         round(latency_p50_ms, 1) if latency_p50_ms is not None else None
     )

@@ -25,7 +25,6 @@ Prints one JSON line per case.
 
 from __future__ import annotations
 
-import dataclasses
 import json
 import math
 import sys
@@ -104,10 +103,19 @@ def main() -> None:
             best = min(best, time.perf_counter() - t0)
         return best
 
+    def chain_diff(t_n, t_1, n):
+        # same clock-sanity guard as bench-flash-attention._timed_chain:
+        # RTT jitter making t_1 >= t_n must abort, not print absurd numbers
+        assert t_n > t_1 * 1.2, (
+            f"clock failed: {n}-chain {t_n*1e3:.1f} ms not meaningfully above "
+            f"1-chain {t_1*1e3:.1f} ms — RTT jitter swamped the kernel; rerun"
+        )
+        return (t_n - t_1) / (n - 1)
+
     N = 64
     t_n = best_of(decode_n(N), first, (k_cache, v_cache))
     t_1 = best_of(decode_n(1), first, (k_cache, v_cache))
-    per_step = max(t_n - t_1, 1e-9) / (N - 1)
+    per_step = chain_diff(t_n, t_1, N)
     toks_sec = B / per_step
     # decode is HBM-bound: each step streams params (bf16 at compute) + cache
     approx_bytes = 2 * n_params + 2 * k_cache.size * 2
@@ -159,7 +167,7 @@ def main() -> None:
     for name, fn in (("grouped", grouped), ("repeat", repeated)):
         t_m = best_of(chain(fn, M), q0, kc, vc)
         t_1 = best_of(chain(fn, 1), q0, kc, vc)
-        results[name] = max(t_m - t_1, 1e-9) / (M - 1)
+        results[name] = chain_diff(t_m, t_1, M)
     cache_bytes = 2 * kvh * S * dh * B * 2  # k+v, bf16
     print(json.dumps({
         "case": "decode_attention",

@@ -56,3 +56,20 @@ def test_probe_runs_against_this_interpreter():
     assert result["ok"], result
     assert result["platform"] in ("cpu", "tpu")
     assert result["device_count"] >= 1
+
+
+def test_payloads_are_valid_python():
+    # The TPU/flash payloads only execute on a healthy chip — a syntax error
+    # would otherwise surface for the first time inside the driver's window.
+    for name in ("TPU_PAYLOAD", "CPU_PAYLOAD", "FLASH_PAYLOAD"):
+        compile(getattr(bench, name), f"<{name}>", "exec")
+
+
+def test_run_payload_values_parses_marker_floats():
+    import asyncio
+
+    src = "print('RESULT_FLASH 12.5 3.25')"
+    vals = asyncio.run(
+        bench.run_payload_values(src, {}, timeout_s=30.0, marker="RESULT_FLASH")
+    )
+    assert vals == [12.5, 3.25]

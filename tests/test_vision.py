@@ -72,3 +72,14 @@ def test_single_vs_sharded_forward_agree():
     a = forward(params, x, config)
     b = forward(params, x, config, mesh)
     np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-2, rtol=2e-2)
+
+
+def test_group_norm_non_divisible_channels():
+    # width=48 with default norm_groups=32: groups clamp to the largest
+    # divisor of C (16), not crash the reshape.
+    from bee_code_interpreter_tpu.models.vision import group_norm
+
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 4, 4, 48))
+    out = group_norm(x, jnp.ones((48,)), jnp.zeros((48,)), groups=32)
+    assert out.shape == x.shape
+    assert bool(jnp.isfinite(out).all())
