@@ -107,19 +107,24 @@ LATENCY_PAYLOAD = "print(21 * 2)"
 
 # Guarded extra evidence: the Pallas flash-attention kernel vs XLA's own
 # fused attention, through the same execution path — so the kernel claims in
-# BASELINE.md stop being builder-session-only. Small shape (compile + two
-# timed chains ≈ 45-75 s on a healthy chip); timing by the (t_N - t_1)/(N-1)
-# chain difference, which cancels the device->host readback RTT exactly
-# (BASELINE.md round-3 timing note: the RTT hit ~70 ms through a tunnel).
+# BASELINE.md stop being builder-session-only. Timing by the
+# (t_N - t_1)/(N-1) chain difference (utils/benchclock.py), which cancels
+# the device->host readback RTT exactly. Shape and chain length are sized so
+# the chain DOMINATES the ~70 ms tunnel RTT (flash ≈ 2.8 ms/call at the
+# measured 99 TFLOPS → 31 extra calls ≈ 87 ms >> 1.2x guard margin) — a
+# smaller shape would trip the sanity guard on every tunneled run and the
+# field could never land. Cost on a healthy chip: 4 jit compiles (~25 s
+# each worst-case) + ~4 s of timed chains, inside the 240 s budget.
 FLASH_PAYLOAD = """
 import time
 import jax, jax.numpy as jnp
 from jax import lax
 from bee_code_interpreter_tpu.ops.flash_attention import flash_attention
 from bee_code_interpreter_tpu.parallel.ring_attention import reference_attention
+from bee_code_interpreter_tpu.utils.benchclock import chain_diff
 
-B, H, L, D = 2, 8, 2048, 128
-N = 8
+B, H, L, D = 4, 16, 4096, 128
+N = 32
 q, k, v = (jax.random.normal(jax.random.PRNGKey(i), (B, H, L, D), jnp.bfloat16)
            for i in range(3))
 
@@ -132,25 +137,21 @@ def chain(attn, length):
         return c.astype(jnp.float32).sum()
     return f
 
-def per_call(attn):
+def per_call(attn, what):
     def best_of(f):
         float(f(q, k, v))
         best = float("inf")
         for _ in range(2):
-            t0 = time.time()
+            t0 = time.perf_counter()
             float(f(q, k, v))
-            best = min(best, time.time() - t0)
+            best = min(best, time.perf_counter() - t0)
         return best
-    t_n = best_of(chain(attn, N))
-    t_1 = best_of(chain(attn, 1))
-    # clock sanity: RTT jitter making t_1 >= t_n must fail the payload (the
-    # bench omits the field) rather than record absurd TFLOPS as evidence
-    assert t_n > t_1 * 1.2, f"clock failed: t_{N}={t_n:.4f}s t_1={t_1:.4f}s"
-    return (t_n - t_1) / (N - 1)
+    return chain_diff(best_of(chain(attn, N)), best_of(chain(attn, 1)), N, what)
 
-t_fl = per_call(lambda q, k, v: flash_attention(q, k, v, True))
+t_fl = per_call(lambda q, k, v: flash_attention(q, k, v, True), "flash")
 t_xl = per_call(
-    lambda q, k, v: reference_attention(q, k, v, causal=True).astype(q.dtype)
+    lambda q, k, v: reference_attention(q, k, v, causal=True).astype(q.dtype),
+    "xla",
 )
 flops = 2 * B * H * L * L * D  # causal: half of 4*B*H*L*L*D
 print(f"RESULT_FLASH {flops / t_fl / 1e12:.2f} {flops / t_xl / 1e12:.2f}")
@@ -418,14 +419,14 @@ def main() -> None:
         try:
             fl, xl = asyncio.run(
                 run_payload_values(
-                    FLASH_PAYLOAD, {}, timeout_s=120.0, marker="RESULT_FLASH"
+                    FLASH_PAYLOAD, {}, timeout_s=240.0, marker="RESULT_FLASH"
                 )
             )
             flash = {
                 "tflops": fl,
                 "xla_ref_tflops": xl,
                 "speedup_vs_xla": round(fl / xl, 2),
-                "shape": "B2 H8 L2048 D128 bf16 causal",
+                "shape": "B4 H16 L4096 D128 bf16 causal",
             }
             print(f"flash attention: {flash}", file=sys.stderr)
         except Exception as e:

@@ -1,0 +1,46 @@
+# Native-JAX ResNet training through the sandbox — the framework-side
+# counterpart to resnet50-torch-xla.py (which drives torch-xla). Uses the
+# bundled models/vision.py family: NHWC, bf16 convs on the MXU, GroupNorm
+# (no cross-device batch-stat sync), data-parallel over every local device.
+import time
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from bee_code_interpreter_tpu.models.vision import ResNet, ResNetConfig
+from bee_code_interpreter_tpu.parallel import make_mesh
+
+n_dev = len(jax.devices())
+mesh = make_mesh({"dp": n_dev})
+config = ResNetConfig.resnet50() if jax.devices()[0].platform == "tpu" else (
+    ResNetConfig.tiny()
+)
+model = ResNet(config, mesh)
+params = model.init(jax.random.PRNGKey(0))
+
+optimizer = optax.sgd(0.1, momentum=0.9)
+opt_state = optimizer.init(params)
+step = model.make_train_step(optimizer)
+
+B = 8 * n_dev
+size = 224 if jax.devices()[0].platform == "tpu" else 32
+batch = {
+    "images": jax.device_put(
+        jax.random.normal(jax.random.PRNGKey(1), (B, size, size, 3)),
+        model.batch_sharding(),
+    ),
+    "labels": jax.device_put(
+        jax.random.randint(jax.random.PRNGKey(2), (B,), 0, config.num_classes),
+        model.batch_sharding(),
+    ),
+}
+
+params, opt_state, loss = step(params, opt_state, batch)  # compile + step 0
+t0 = time.time()
+steps = 5
+for _ in range(steps):
+    params, opt_state, loss = step(params, opt_state, batch)
+dt = time.time() - t0
+print(f"resnet train: {steps} steps of batch {B} in {dt:.2f}s "
+      f"({steps * B / dt:.1f} img/s), loss {float(loss):.4f}")

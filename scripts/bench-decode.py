@@ -103,14 +103,7 @@ def main() -> None:
             best = min(best, time.perf_counter() - t0)
         return best
 
-    def chain_diff(t_n, t_1, n):
-        # same clock-sanity guard as bench-flash-attention._timed_chain:
-        # RTT jitter making t_1 >= t_n must abort, not print absurd numbers
-        assert t_n > t_1 * 1.2, (
-            f"clock failed: {n}-chain {t_n*1e3:.1f} ms not meaningfully above "
-            f"1-chain {t_1*1e3:.1f} ms — RTT jitter swamped the kernel; rerun"
-        )
-        return (t_n - t_1) / (n - 1)
+    from bee_code_interpreter_tpu.utils.benchclock import chain_diff
 
     N = 64
     t_n = best_of(decode_n(N), first, (k_cache, v_cache))

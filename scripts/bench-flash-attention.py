@@ -58,15 +58,14 @@ def _timed_chain(make_f, q, k, v, n_chain: int) -> float:
     chain: (t_N - t_1) / (N - 1) cancels the per-measurement fixed cost —
     dispatch plus the readback RTT, which would otherwise add RTT/N to every
     call (~9 ms at the ~70 ms RTT measured through the tunnel this session,
-    not negligible against ~10 ms kernels)."""
+    not negligible against ~10 ms kernels). Difference + sanity guard live
+    in utils/benchclock.chain_diff (shared with bench-decode and bench.py's
+    flash payload)."""
+    from bee_code_interpreter_tpu.utils.benchclock import chain_diff
+
     t_n = _best_of(make_f(n_chain), q, k, v)
     t_1 = _best_of(make_f(1), q, k, v)
-    assert t_n > t_1 * 1.2, (
-        f"clock failed: {n_chain}-chain {t_n*1e3:.1f} ms not meaningfully "
-        f"above 1-chain {t_1*1e3:.1f} ms — RTT jitter swamped the kernel; "
-        "rerun or raise n_chain"
-    )
-    return (t_n - t_1) / (n_chain - 1)
+    return chain_diff(t_n, t_1, n_chain)
 
 
 def timed_fwd(attn, q, k, v, n_chain: int = 8) -> float:

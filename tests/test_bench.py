@@ -73,3 +73,16 @@ def test_run_payload_values_parses_marker_floats():
         bench.run_payload_values(src, {}, timeout_s=30.0, marker="RESULT_FLASH")
     )
     assert vals == [12.5, 3.25]
+
+
+def test_benchclock_chain_diff_guard():
+    # The shared chained-clock: exact difference when the chain dominates,
+    # loud failure when readback-RTT jitter swamps it (a floored difference
+    # would print absurd TFLOPS as evidence).
+    import pytest
+
+    from bee_code_interpreter_tpu.utils.benchclock import chain_diff
+
+    assert abs(chain_diff(1.0, 0.1, 10) - 0.1) < 1e-12
+    with pytest.raises(AssertionError, match="clock failed"):
+        chain_diff(0.105, 0.100, 10)
