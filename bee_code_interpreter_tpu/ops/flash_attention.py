@@ -115,6 +115,16 @@ def _fwd_kernel(
         lse_ref[0, 0] = m_scratch[:] + jnp.log(l)  # [blk_q, 1]
 
 
+def _vma(*arrays) -> frozenset:
+    """Union of the operands' varying-manual-axes — pallas_call inside
+    shard_map (check_vma=True) requires out_shape to declare how outputs
+    vary over mesh axes; outside shard_map this is the empty set."""
+    out: frozenset = frozenset()
+    for a in arrays:
+        out = out | getattr(jax.typeof(a), "vma", frozenset())
+    return out
+
+
 def _pad_to(x, length, axis):
     pad = length - x.shape[axis]
     if pad == 0:
@@ -184,8 +194,10 @@ def _flash_fwd(q, k, v, causal, sm_scale, blk_q, blk_k, interpret):
             pl.BlockSpec((1, 1, blk_q, 1), lambda b, r, i, j: (b, r, i, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((B * KVH, rep, Lp, D), q.dtype),
-            jax.ShapeDtypeStruct((B * KVH, rep, Lp, 1), jnp.float32),
+            # vma: inside shard_map the outputs vary over the same mesh axes
+            # as the operands (required by check_vma; empty set elsewhere)
+            jax.ShapeDtypeStruct((B * KVH, rep, Lp, D), q.dtype, vma=_vma(q, k)),
+            jax.ShapeDtypeStruct((B * KVH, rep, Lp, 1), jnp.float32, vma=_vma(q, k)),
         ],
         scratch_shapes=[
             pltpu.VMEM((blk_q, 1), jnp.float32),
@@ -383,10 +395,16 @@ def _bwd_dq_kernel(
 
 def _flash_bwd_pallas(
     q, k, v, o, lse, do, causal, sm_scale, blk_q, blk_k, interpret,
-    H: int, KVH: int,
+    H: int, KVH: int, g_lse=None,
 ):
     """dq, dk, dv via the two Pallas kernels. q/o/do/lse are [B·H, L, D];
-    k/v are [B·KVH, Lk, D] (GQA when KVH < H); dk/dv come back compact."""
+    k/v are [B·KVH, Lk, D] (GQA when KVH < H); dk/dv come back compact.
+
+    ``g_lse`` ([B·H, L] or None) is the cotangent of the forward's
+    log-sum-exp output (flash_attention_with_lse): since ∂lse_i/∂S_ij = P_ij
+    exactly, it enters the FlashAttention-2 backward as
+    dS = P ∘ (dP − delta + g_lse) — i.e. a pure shift of delta, with zero
+    kernel changes."""
     BH, L, D = q.shape
     BKV = k.shape[0]
     Lk = k.shape[1]
@@ -401,6 +419,8 @@ def _flash_bwd_pallas(
     delta = jnp.sum(
         do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1
     )  # [BH, L]
+    if g_lse is not None:
+        delta = delta - g_lse.astype(jnp.float32)
     deltap = _pad_to(delta, Lp, 1)[..., None]  # [BH, Lp, 1]
     lsep = _pad_to(lse, Lp, 1)[..., None]
 
@@ -430,8 +450,8 @@ def _flash_bwd_pallas(
         in_specs=[q_spec, kv_spec, kv_spec, q_spec, stat_spec, stat_spec],
         out_specs=[kv_spec, kv_spec],
         out_shape=[
-            jax.ShapeDtypeStruct((BKV, Lp, D), k.dtype),
-            jax.ShapeDtypeStruct((BKV, Lp, D), v.dtype),
+            jax.ShapeDtypeStruct((BKV, Lp, D), k.dtype, vma=_vma(q, k, do)),
+            jax.ShapeDtypeStruct((BKV, Lp, D), v.dtype, vma=_vma(q, k, do)),
         ],
         scratch_shapes=[
             pltpu.VMEM((blk_k, D), jnp.float32),
@@ -455,7 +475,9 @@ def _flash_bwd_pallas(
         grid=(BKV, rep, num_q, num_k),
         in_specs=[q_spec2, kv_spec2, kv_spec2, q_spec2, stat_spec2, stat_spec2],
         out_specs=q_spec2,
-        out_shape=jax.ShapeDtypeStruct((BKV, rep, Lp, D), q.dtype),
+        out_shape=jax.ShapeDtypeStruct(
+            (BKV, rep, Lp, D), q.dtype, vma=_vma(q, k, do)
+        ),
         scratch_shapes=[pltpu.VMEM((blk_q, D), jnp.float32)],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
@@ -512,7 +534,10 @@ def _flash_fwd_rule(q, k, v, causal, sm_scale, block_q, block_k, interpret):
     return out, (q, k, v, out, lse)
 
 
-def _flash_bwd_rule(causal, sm_scale, block_q, block_k, interpret, residuals, g):
+def _bwd_impl(causal, sm_scale, block_q, block_k, interpret, residuals, g_out,
+              g_lse=None):
+    """Shared backward plumbing for both VJP rules (g_lse is the lse
+    cotangent of the with_lse variant; None for plain flash_attention)."""
     q, k, v, out, lse = residuals
     sm_scale, interpret = _resolve(q, sm_scale, interpret)
     B, H, L, D = q.shape
@@ -526,8 +551,9 @@ def _flash_bwd_rule(causal, sm_scale, block_q, block_k, interpret, residuals, g)
     dq, dk, dv = _flash_bwd_pallas(
         q.reshape(B * H, L, D), k.reshape(B * KVH, Lk, D),
         v.reshape(B * KVH, Lk, D),
-        out.reshape(B * H, L, D), lse, g.reshape(B * H, L, D),
+        out.reshape(B * H, L, D), lse, g_out.reshape(B * H, L, D),
         causal, sm_scale, blk_q, blk_k, interpret, H, KVH,
+        g_lse=None if g_lse is None else g_lse.reshape(B * H, L),
     )
     return (
         dq.reshape(B, H, L, D),
@@ -536,7 +562,52 @@ def _flash_bwd_rule(causal, sm_scale, block_q, block_k, interpret, residuals, g)
     )
 
 
+def _flash_bwd_rule(causal, sm_scale, block_q, block_k, interpret, residuals, g):
+    return _bwd_impl(causal, sm_scale, block_q, block_k, interpret, residuals, g)
+
+
 flash_attention.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def flash_attention_with_lse(
+    q, k, v,
+    causal: bool = True,
+    sm_scale: float | None = None,
+    block_q: int = 1024,
+    block_k: int = 1024,
+    interpret: bool | None = None,
+):
+    """Like ``flash_attention`` but also returns the per-row log-sum-exp
+    ([B, H, L] f32) of the (scaled, masked) scores — the quantity needed to
+    combine attention over key blocks computed separately (ring attention's
+    per-hop kernel calls merge on it). Fully differentiable, INCLUDING
+    through the lse output: its cotangent folds into the backward's delta
+    shift (see _flash_bwd_pallas)."""
+    (out, lse), _ = _with_lse_fwd_rule(
+        q, k, v, causal, sm_scale, block_q, block_k, interpret
+    )
+    return out, lse
+
+
+def _with_lse_fwd_rule(q, k, v, causal, sm_scale, block_q, block_k, interpret):
+    out, res = _flash_fwd_rule(
+        q, k, v, causal, sm_scale, block_q, block_k, interpret
+    )
+    lse = res[4]  # [B·H, L]
+    B, H, L, _ = q.shape
+    return (out, lse.reshape(B, H, L)), res
+
+
+def _with_lse_bwd_rule(causal, sm_scale, block_q, block_k, interpret, residuals, g):
+    g_out, g_lse = g
+    return _bwd_impl(
+        causal, sm_scale, block_q, block_k, interpret, residuals, g_out,
+        g_lse=g_lse,
+    )
+
+
+flash_attention_with_lse.defvjp(_with_lse_fwd_rule, _with_lse_bwd_rule)
 
 
 def _round_up(n: int, to: int = 128) -> int:

@@ -62,6 +62,7 @@ def ring_attention(
     axis_name: str = "sp",
     causal: bool = True,
     sm_scale: float | None = None,
+    use_flash: bool | None = None,
 ) -> jax.Array:
     """Exact attention with K/V rotating around the ``axis_name`` ring.
 
@@ -70,7 +71,20 @@ def ring_attention(
     rotates (and each hop's ppermute moves) KVH heads of K/V, not H. Returns
     [B, H, L_local, D] in q's dtype. Must run inside shard_map with
     ``axis_name`` bound.
+
+    ``use_flash`` (default: on TPU) runs each hop through the Pallas flash
+    kernel (ops/flash_attention.flash_attention_with_lse) and merges hops on
+    their log-sum-exp — the MXU-tiled kernel replaces the jax-level einsum
+    accumulation, and the same-block hop gets the kernel's causal
+    block-skipping. Differentiable either way (the lse outputs carry real
+    gradients; the kernel's VJP folds them into its delta shift).
     """
+    if use_flash is None:
+        use_flash = jax.devices()[0].platform == "tpu"
+    if use_flash:
+        return _ring_attention_flash(
+            q, k, v, axis_name=axis_name, causal=causal, sm_scale=sm_scale
+        )
     orig_dtype = q.dtype
     B, H, Lq, D = q.shape
     KVH = k.shape[1]
@@ -134,6 +148,87 @@ def ring_attention(
     return out.reshape(B, H, Lq, D).astype(orig_dtype)
 
 
+def _ring_attention_flash(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    axis_name: str,
+    causal: bool,
+    sm_scale: float | None,
+) -> jax.Array:
+    """Ring attention with the Pallas flash kernel per hop.
+
+    Each hop computes a *normalized* attention block plus its log-sum-exp;
+    hops merge in the standard lse algebra — running
+    (m = max lse, s = Σ e^{lse−m}, o = Σ out·e^{lse−m}), final o/s. The
+    causal structure is per block pair exactly as the einsum ring: earlier
+    blocks attend fully (kernel causal=False), the own block triangularly
+    (causal=True), later blocks are skipped. lax.cond keeps both kernel
+    variants compiled once; the skip branch costs nothing but the carry.
+    """
+    from bee_code_interpreter_tpu.ops.flash_attention import (
+        flash_attention_with_lse,
+    )
+
+    orig_dtype = q.dtype
+    B, H, Lq, D = q.shape
+    n = lax.axis_size(axis_name)
+    my_idx = lax.axis_index(axis_name)
+
+    NEG = jnp.float32(-1e30)  # not -inf: (-inf) - (-inf) would NaN the scale
+    m0 = jnp.full((B, H, Lq, 1), NEG) + jnp.zeros_like(
+        q[..., :1], dtype=jnp.float32
+    )  # derive vma from q (shard_map typing), value NEG
+    s0 = jnp.zeros_like(m0)
+    o0 = jnp.zeros_like(q, dtype=jnp.float32)
+
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def body(step, carry):
+        m, s, o, k_blk, v_blk = carry
+        k_idx = (my_idx - step) % n
+
+        def attend(args):
+            m, s, o = args
+
+            def own_block(_):
+                return flash_attention_with_lse(q, k_blk, v_blk, True, sm_scale)
+
+            def earlier_block(_):
+                return flash_attention_with_lse(q, k_blk, v_blk, False, sm_scale)
+
+            if causal:
+                out_blk, lse_blk = lax.cond(
+                    k_idx == my_idx, own_block, earlier_block, None
+                )
+            else:
+                out_blk, lse_blk = earlier_block(None)
+            lse_blk = lse_blk[..., None]  # [B, H, Lq, 1]
+            m_new = jnp.maximum(m, lse_blk)
+            scale_old = jnp.exp(m - m_new)
+            scale_blk = jnp.exp(lse_blk - m_new)
+            o = o * scale_old + out_blk.astype(jnp.float32) * scale_blk
+            s = s * scale_old + scale_blk
+            return m_new, s, o
+
+        def skip(args):
+            return args
+
+        if causal:
+            m, s, o = lax.cond(k_idx > my_idx, skip, attend, (m, s, o))
+        else:
+            m, s, o = attend((m, s, o))
+
+        k_next = lax.ppermute(k_blk, axis_name, perm)
+        v_next = lax.ppermute(v_blk, axis_name, perm)
+        return m, s, o, k_next, v_next
+
+    m, s, o, _, _ = lax.fori_loop(0, n, body, (m0, s0, o0, k, v))
+    out = o / jnp.maximum(s, 1e-30)
+    return out.astype(orig_dtype)
+
+
 def ring_attention_sharded(
     mesh: Mesh,
     q: jax.Array,
@@ -142,15 +237,25 @@ def ring_attention_sharded(
     *,
     axis_name: str = "sp",
     causal: bool = True,
+    sm_scale: float | None = None,
+    use_flash: bool | None = None,
 ) -> jax.Array:
     """Standalone entry: shards [B, H, L, D] inputs over ``axis_name`` on L
-    and runs the ring. For use outside an existing shard_map context."""
+    and runs the ring. For use outside an existing shard_map context.
+    ``sm_scale``/``use_flash`` forward to ``ring_attention`` (so the einsum
+    fallback or the flash-hop path can be forced from here too)."""
     spec = P(None, None, axis_name, None)
+    # check_vma=False: the flash-hop path (TPU default) runs pallas_call
+    # under shard_map — see models/transformer._attention
     fn = jax.shard_map(
-        functools.partial(ring_attention, axis_name=axis_name, causal=causal),
+        functools.partial(
+            ring_attention, axis_name=axis_name, causal=causal,
+            sm_scale=sm_scale, use_flash=use_flash,
+        ),
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
+        check_vma=False,
     )
     return fn(q, k, v)
 

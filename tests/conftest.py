@@ -87,3 +87,32 @@ def local_executor(storage, tmp_path):
         disable_dep_install=True,
         execution_timeout_s=30.0,
     )
+
+
+@pytest.fixture
+def http_app(local_executor):
+    """The aiohttp app over the local executor — the in-process service
+    surface example/baseline-config tests drive payloads through."""
+    from bee_code_interpreter_tpu.api.http_server import create_http_server
+    from bee_code_interpreter_tpu.services.custom_tool_executor import (
+        CustomToolExecutor,
+    )
+
+    return create_http_server(
+        code_executor=local_executor,
+        custom_tool_executor=CustomToolExecutor(code_executor=local_executor),
+    )
+
+
+async def post_execute(app, payload: dict) -> dict:
+    """POST /v1/execute against an in-process app; asserts HTTP 200."""
+    from aiohttp.test_utils import TestClient, TestServer
+
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    try:
+        resp = await client.post("/v1/execute", json=payload)
+        assert resp.status == 200, await resp.text()
+        return await resp.json()
+    finally:
+        await client.close()

@@ -23,31 +23,12 @@ from pathlib import Path
 import pytest
 from aiohttp.test_utils import TestClient, TestServer
 
-from bee_code_interpreter_tpu.api.http_server import create_http_server
 from bee_code_interpreter_tpu.runtime.dep_guess import guess_dependencies
-from bee_code_interpreter_tpu.services.custom_tool_executor import CustomToolExecutor
+
+from tests.conftest import post_execute  # http_app fixture comes from conftest
 
 REPO = Path(__file__).resolve().parent.parent
 EXAMPLES = REPO / "examples"
-
-
-@pytest.fixture
-def http_app(local_executor):
-    return create_http_server(
-        code_executor=local_executor,
-        custom_tool_executor=CustomToolExecutor(code_executor=local_executor),
-    )
-
-
-async def post_execute(app, payload: dict) -> dict:
-    client = TestClient(TestServer(app))
-    await client.start_server()
-    try:
-        resp = await client.post("/v1/execute", json=payload)
-        assert resp.status == 200, await resp.text()
-        return await resp.json()
-    finally:
-        await client.close()
 
 
 async def test_config1_benchmark_numpy_via_execute(http_app):
