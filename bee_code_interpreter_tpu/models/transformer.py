@@ -65,6 +65,10 @@ class TransformerConfig:
     # "ulysses" re-shards heads<->sequence with all-to-alls and runs the
     # local flash kernel on the full sequence (parallel/ulysses.py).
     sp_attention: str = "ring"
+    # Decode KV-cache storage: "bf16" (compute dtype) or "int8" (symmetric
+    # per-token/head absmax quantization, ops/kv_cache.py — halves the bytes
+    # the bandwidth-bound decode loop streams per step).
+    kv_cache_dtype: str = "bf16"
 
     @property
     def kv_heads(self) -> int:
@@ -276,13 +280,15 @@ def _attention(q, k, v, mesh: Mesh | None, sp_attention: str = "ring"):
             )
     else:
         local = _local_attention
-    # check_vma=False: pallas_call under shard_map's vma checking hits a
-    # jax-internal lowering limitation (see tests/test_parallel.py flash-ring
-    # cases); outputs genuinely follow out_specs, so the check adds nothing
-    # here.
+    # pallas_call under shard_map's vma checking hits a jax-internal lowering
+    # limitation (see tests/test_parallel.py flash-ring cases); every TPU
+    # branch here runs the flash kernel (local, flash-hop ring, or inside
+    # ulysses), so disable the check exactly there and keep it for the
+    # kernel-free CPU paths.
+    uses_pallas = jax.devices()[0].platform == "tpu"
     fn = jax.shard_map(
         local, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
-        check_vma=False,
+        check_vma=not uses_pallas,
     )
     return fn(q, k, v)
 
@@ -483,13 +489,53 @@ def forward_pipelined(
 # ------------------------------------------------------------- cached decode
 
 
+def init_decode_cache(
+    config: TransformerConfig,
+    B: int,
+    total_len: int,
+    k_pre: jax.Array,  # [n_layers, B, kvh, L_prompt, Dh] (prefill K)
+    v_pre: jax.Array,
+) -> dict:
+    """Allocate the full-length decode cache and seed it with the prefill
+    K/V. Layout depends on ``kv_cache_dtype``: bf16 stores values directly;
+    int8 stores quantized values + per-(token, head) scales
+    (ops/kv_cache.py)."""
+    c = config
+    L = k_pre.shape[3]
+    if c.kv_cache_dtype == "int8":
+        from bee_code_interpreter_tpu.ops.kv_cache import quantize
+
+        shape = (c.n_layers, B, c.kv_heads, total_len, c.head_dim)
+        cache = {
+            "k": jnp.zeros(shape, jnp.int8),
+            "v": jnp.zeros(shape, jnp.int8),
+            "k_s": jnp.zeros(shape[:-1] + (1,), jnp.float32),
+            "v_s": jnp.zeros(shape[:-1] + (1,), jnp.float32),
+        }
+        kq, ks = quantize(k_pre)
+        vq, vs = quantize(v_pre)
+        cache["k"] = cache["k"].at[:, :, :, :L, :].set(kq)
+        cache["v"] = cache["v"].at[:, :, :, :L, :].set(vq)
+        cache["k_s"] = cache["k_s"].at[:, :, :, :L, :].set(ks)
+        cache["v_s"] = cache["v_s"].at[:, :, :, :L, :].set(vs)
+        return cache
+    shape = (c.n_layers, B, c.kv_heads, total_len, c.head_dim)
+    k_cache = jnp.zeros(shape, c.dtype).at[:, :, :, :L, :].set(
+        k_pre.astype(c.dtype)
+    )
+    v_cache = jnp.zeros(shape, c.dtype).at[:, :, :, :L, :].set(
+        v_pre.astype(c.dtype)
+    )
+    return {"k": k_cache, "v": v_cache}
+
+
 def decode_step(
     params: Params,
     token: jax.Array,  # [B, 1] int32 — the token just produced/fed
     pos: jax.Array,  # scalar int32: its position in the sequence
-    cache: tuple[jax.Array, jax.Array],  # k,v [n_layers, B, kvh, max, Dh]
+    cache: dict,  # init_decode_cache layout; leaves [n_layers, B, kvh, max, ·]
     config: TransformerConfig,
-) -> tuple[jax.Array, tuple[jax.Array, jax.Array]]:
+) -> tuple[jax.Array, dict]:
     """One incremental decode step: O(L) attention against the cache instead
     of the O(L^2) full re-encode (the round-1 generate). Static shapes: the
     cache is allocated at its final length and masked by position, so the
@@ -497,17 +543,20 @@ def decode_step(
 
     Runs with plain einsum attention (no pallas/shard_map): a 1-token query
     is MXU-trivial and GSPMD can shard these einsums over tp on its own.
+    With ``kv_cache_dtype="int8"`` the cache stays int8 in HBM (half the
+    bytes the bandwidth-bound loop streams); dequantization rides the
+    attention einsums' operand pipeline.
     """
     c = config
-    k_cache, v_cache = cache
+    quant = c.kv_cache_dtype == "int8"
     B = token.shape[0]
-    max_len = k_cache.shape[3]
+    max_len = cache["k"].shape[3]
     positions = jnp.full((B, 1), pos, dtype=jnp.int32)
 
     h = params["embed"].astype(c.dtype)[token[:, 0]][:, None, :]  # [B, 1, D]
 
     def layer_step(h, scanned):
-        layer, k_layer, v_layer = scanned  # caches: [B, kvh, max, Dh]
+        layer, c_layer = scanned  # cache leaves: [B, kvh, max, ·]
         x = rms_norm(h, layer["ln1"])
         dh, nh, kvh = c.head_dim, c.n_heads, c.kv_heads
 
@@ -518,22 +567,45 @@ def decode_step(
         q = rope(proj(layer["wq"], nh), positions, c.rope_theta)  # [B,nh,1,Dh]
         k_new = rope(proj(layer["wk"], kvh), positions, c.rope_theta)
         v_new = proj(layer["wv"], kvh)
-        k_layer = lax.dynamic_update_slice(k_layer, k_new, (0, 0, pos, 0))
-        v_layer = lax.dynamic_update_slice(v_layer, v_new, (0, 0, pos, 0))
+        if quant:
+            from bee_code_interpreter_tpu.ops.kv_cache import (
+                dequantize,
+                quantize,
+            )
+
+            kq, ks = quantize(k_new)
+            vq, vs = quantize(v_new)
+            c_layer = {
+                "k": lax.dynamic_update_slice(c_layer["k"], kq, (0, 0, pos, 0)),
+                "v": lax.dynamic_update_slice(c_layer["v"], vq, (0, 0, pos, 0)),
+                "k_s": lax.dynamic_update_slice(
+                    c_layer["k_s"], ks, (0, 0, pos, 0)
+                ),
+                "v_s": lax.dynamic_update_slice(
+                    c_layer["v_s"], vs, (0, 0, pos, 0)
+                ),
+            }
+            kf = dequantize(c_layer["k"], c_layer["k_s"])
+            vf = dequantize(c_layer["v"], c_layer["v_s"], c.dtype)
+        else:
+            c_layer = {
+                "k": lax.dynamic_update_slice(c_layer["k"], k_new, (0, 0, pos, 0)),
+                "v": lax.dynamic_update_slice(c_layer["v"], v_new, (0, 0, pos, 0)),
+            }
+            kf = c_layer["k"].astype(jnp.float32)
+            vf = c_layer["v"]
 
         # grouped-query decode: q regrouped [B, kvh, rep, Dh] so the einsums
         # broadcast over the compact cache — the decode step is KV-cache-
         # bandwidth-bound, and this reads kvh heads of HBM, not nh
         rep = nh // kvh
         qg = q[:, :, 0, :].reshape(B, kvh, rep, dh).astype(jnp.float32)
-        scores = jnp.einsum(
-            "bgrd,bgsd->bgrs", qg, k_layer.astype(jnp.float32)
-        ) / math.sqrt(dh)
+        scores = jnp.einsum("bgrd,bgsd->bgrs", qg, kf) / math.sqrt(dh)
         visible = jnp.arange(max_len) <= pos  # [max]
         scores = jnp.where(visible[None, None, None, :], scores, -jnp.inf)
-        weights = jax.nn.softmax(scores, axis=-1).astype(c.dtype)
-        attn = jnp.einsum("bgrs,bgsd->bgrd", weights, v_layer)  # [B,kvh,rep,Dh]
-        attn = attn.reshape(B, 1, nh * dh)
+        weights = jax.nn.softmax(scores, axis=-1).astype(vf.dtype)
+        attn = jnp.einsum("bgrs,bgsd->bgrd", weights, vf)  # [B,kvh,rep,Dh]
+        attn = attn.astype(c.dtype).reshape(B, 1, nh * dh)
         h = h + jnp.einsum("blk,kd->bld", attn, layer["wo"].astype(c.dtype))
 
         y = rms_norm(h, layer["ln2"])
@@ -553,15 +625,12 @@ def decode_step(
                 "blf,fd->bld", jax.nn.silu(gate) * up, layer["w_down"].astype(c.dtype)
             )
         h = h + mlp
-        return h, (k_layer, v_layer)
+        return h, c_layer
 
-    k_cache_t, v_cache_t = k_cache, v_cache
-    h, (k_cache, v_cache) = lax.scan(
-        layer_step, h, (params["layers"], k_cache_t, v_cache_t)
-    )
+    h, cache = lax.scan(layer_step, h, (params["layers"], cache))
     h = rms_norm(h, params["ln_f"])
     logits = jnp.einsum("bld,dv->blv", h, params["lm_head"].astype(c.dtype))
-    return logits.astype(jnp.float32), (k_cache, v_cache)
+    return logits.astype(jnp.float32), cache
 
 
 # ---------------------------------------------------------------- loss/train
@@ -667,10 +736,7 @@ class Transformer:
         logits, (k_pre, v_pre) = forward(
             params, prompt, c, self.mesh, return_kv=True
         )
-        k_cache = jnp.zeros((c.n_layers, B, c.kv_heads, total, c.head_dim), c.dtype)
-        v_cache = jnp.zeros_like(k_cache)
-        k_cache = k_cache.at[:, :, :, :L, :].set(k_pre.astype(c.dtype))
-        v_cache = v_cache.at[:, :, :, :L, :].set(v_pre.astype(c.dtype))
+        cache = init_decode_cache(c, B, total, k_pre, v_pre)
 
         first = jnp.argmax(logits[:, L - 1 : L, :], axis=-1).astype(jnp.int32)
         tokens = (
@@ -688,7 +754,7 @@ class Transformer:
 
         (tokens, _, _), _ = lax.scan(
             step,
-            (tokens, first, (k_cache, v_cache)),
+            (tokens, first, cache),
             jnp.arange(L, total - 1, dtype=jnp.int32),
         )
         return tokens

@@ -245,8 +245,12 @@ def ring_attention_sharded(
     ``sm_scale``/``use_flash`` forward to ``ring_attention`` (so the einsum
     fallback or the flash-hop path can be forced from here too)."""
     spec = P(None, None, axis_name, None)
-    # check_vma=False: the flash-hop path (TPU default) runs pallas_call
-    # under shard_map — see models/transformer._attention
+    # the flash-hop path runs pallas_call under shard_map, which vma
+    # checking cannot lower yet — disable the check exactly when that path
+    # is taken (see models/transformer._attention)
+    flash = use_flash if use_flash is not None else (
+        jax.devices()[0].platform == "tpu"
+    )
     fn = jax.shard_map(
         functools.partial(
             ring_attention, axis_name=axis_name, causal=causal,
@@ -255,7 +259,7 @@ def ring_attention_sharded(
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
-        check_vma=False,
+        check_vma=not flash,
     )
     return fn(q, k, v)
 

@@ -25,6 +25,7 @@ Prints one JSON line per case.
 
 from __future__ import annotations
 
+import dataclasses
 import json
 import math
 import sys
@@ -55,6 +56,7 @@ def main() -> None:
         TransformerConfig,
         decode_step,
         forward,
+        init_decode_cache,
         init_params,
     )
 
@@ -71,18 +73,14 @@ def main() -> None:
     # prefill once to seed the cache
     logits, (k_pre, v_pre) = forward(params, prompt, config, None, return_kv=True)
     c = config
-    k_cache = jnp.zeros((c.n_layers, B, c.kv_heads, ctx, c.head_dim), c.dtype)
-    v_cache = jnp.zeros_like(k_cache)
-    k_cache = k_cache.at[:, :, :, :L_prompt, :].set(k_pre.astype(c.dtype))
-    v_cache = v_cache.at[:, :, :, :L_prompt, :].set(v_pre.astype(c.dtype))
     first = jnp.argmax(logits[:, -1:, :], axis=-1).astype(jnp.int32)
 
-    def decode_n(n_steps):
+    def decode_n(cfg, n_steps):
         @jax.jit
         def f(tok, cache):
             def body(carry, pos):
                 tok, cache = carry
-                lg, cache = decode_step(params, tok, pos, cache, config)
+                lg, cache = decode_step(params, tok, pos, cache, cfg)
                 nxt = jnp.argmax(lg[:, -1:, :], axis=-1).astype(jnp.int32)
                 return (nxt, cache), None
 
@@ -106,20 +104,34 @@ def main() -> None:
     from bee_code_interpreter_tpu.utils.benchclock import chain_diff
 
     N = 64
-    t_n = best_of(decode_n(N), first, (k_cache, v_cache))
-    t_1 = best_of(decode_n(1), first, (k_cache, v_cache))
-    per_step = chain_diff(t_n, t_1, N)
-    toks_sec = B / per_step
+    per_step = {}
+    for name in ("bf16", "int8"):
+        cfg = dataclasses.replace(config, kv_cache_dtype=name)
+        cache0 = init_decode_cache(cfg, B, ctx, k_pre, v_pre)
+        t_n = best_of(decode_n(cfg, N), first, cache0)
+        t_1 = best_of(decode_n(cfg, 1), first, cache0)
+        per_step[name] = chain_diff(t_n, t_1, N)
     # decode is HBM-bound: each step streams params (bf16 at compute) + cache
-    approx_bytes = 2 * n_params + 2 * k_cache.size * 2
+    cache_bytes = {
+        "bf16": 2 * c.n_layers * B * c.kv_heads * ctx * c.head_dim * 2,
+        "int8": 2 * c.n_layers * B * c.kv_heads * ctx * (c.head_dim + 4),
+    }
     print(json.dumps({
         "case": "decode",
         "config": {"d_model": c.d_model, "n_layers": c.n_layers,
                    "heads": f"{c.n_heads}/{c.kv_heads}", "batch": B,
                    "ctx": ctx, "params": n_params},
-        "per_step_ms": round(per_step * 1e3, 3),
-        "tokens_per_sec": round(toks_sec, 1),
-        "approx_hbm_gbps": round(approx_bytes / per_step / 1e9, 1),
+        "per_step_ms": round(per_step["bf16"] * 1e3, 3),
+        "tokens_per_sec": round(B / per_step["bf16"], 1),
+        "int8_cache_per_step_ms": round(per_step["int8"] * 1e3, 3),
+        "int8_cache_tokens_per_sec": round(B / per_step["int8"], 1),
+        "int8_speedup": round(per_step["bf16"] / per_step["int8"], 2),
+        "approx_hbm_gbps": round(
+            (2 * n_params + cache_bytes["bf16"]) / per_step["bf16"] / 1e9, 1
+        ),
+        "int8_approx_hbm_gbps": round(
+            (2 * n_params + cache_bytes["int8"]) / per_step["int8"] / 1e9, 1
+        ),
     }))
 
     # --- attention-only: grouped einsum vs repeat broadcast ---------------

@@ -194,10 +194,10 @@ def validate_decode(axes: dict[str, int]) -> dict:
 
     # Incremental decode against the cache
     cache_shape = (config.n_layers, B, config.kv_heads, L + 64, config.head_dim)
-    cache_sds = (
-        jax.ShapeDtypeStruct(cache_shape, config.dtype),
-        jax.ShapeDtypeStruct(cache_shape, config.dtype),
-    )
+    cache_sds = {
+        "k": jax.ShapeDtypeStruct(cache_shape, config.dtype),
+        "v": jax.ShapeDtypeStruct(cache_shape, config.dtype),
+    }
     token_sds = jax.ShapeDtypeStruct((B, 1), jnp.int32)
     pos_sds = jax.ShapeDtypeStruct((), jnp.int32)
     decode = jax.jit(

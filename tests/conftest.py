@@ -103,16 +103,3 @@ def http_app(local_executor):
         custom_tool_executor=CustomToolExecutor(code_executor=local_executor),
     )
 
-
-async def post_execute(app, payload: dict) -> dict:
-    """POST /v1/execute against an in-process app; asserts HTTP 200."""
-    from aiohttp.test_utils import TestClient, TestServer
-
-    client = TestClient(TestServer(app))
-    await client.start_server()
-    try:
-        resp = await client.post("/v1/execute", json=payload)
-        assert resp.status == 200, await resp.text()
-        return await resp.json()
-    finally:
-        await client.close()

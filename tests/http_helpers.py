@@ -1,0 +1,17 @@
+"""Plain helper(s) for tests that drive payloads through the in-process
+HTTP app (the ``http_app`` fixture lives in conftest.py; importing helpers
+from conftest would double-import it — a pytest anti-pattern)."""
+
+
+async def post_execute(app, payload: dict) -> dict:
+    """POST /v1/execute against an in-process app; asserts HTTP 200."""
+    from aiohttp.test_utils import TestClient, TestServer
+
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    try:
+        resp = await client.post("/v1/execute", json=payload)
+        assert resp.status == 200, await resp.text()
+        return await resp.json()
+    finally:
+        await client.close()

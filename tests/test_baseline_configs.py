@@ -25,7 +25,7 @@ from aiohttp.test_utils import TestClient, TestServer
 
 from bee_code_interpreter_tpu.runtime.dep_guess import guess_dependencies
 
-from tests.conftest import post_execute  # http_app fixture comes from conftest
+from tests.http_helpers import post_execute  # http_app fixture: conftest
 
 REPO = Path(__file__).resolve().parent.parent
 EXAMPLES = REPO / "examples"

@@ -4,7 +4,7 @@ examples must be runnable artifacts, not documentation."""
 
 from pathlib import Path
 
-from tests.conftest import post_execute  # http_app fixture comes from conftest
+from tests.http_helpers import post_execute  # http_app fixture: conftest
 
 REPO = Path(__file__).resolve().parent.parent
 EXAMPLES = REPO / "examples"

@@ -1,0 +1,105 @@
+"""Int8 KV cache: quantization primitives + decode-path accuracy."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bee_code_interpreter_tpu.models import transformer as T
+from bee_code_interpreter_tpu.ops.kv_cache import dequantize, quantize
+
+
+def test_quantize_roundtrip_error_bound():
+    x = jax.random.normal(jax.random.PRNGKey(0), (4, 8, 64)) * 3.0
+    q, s = quantize(x)
+    assert q.dtype == jnp.int8
+    assert s.shape == (4, 8, 1)
+    back = dequantize(q, s)
+    # symmetric absmax: error per element ≤ absmax/127 (half a step after round)
+    bound = np.asarray(jnp.max(jnp.abs(x), axis=-1, keepdims=True)) / 127.0
+    err = np.abs(np.asarray(back) - np.asarray(x))
+    assert (err <= bound * 0.5 + 1e-6).all()
+
+
+def test_quantize_zero_rows():
+    x = jnp.zeros((2, 3, 16))
+    q, s = quantize(x)
+    assert (np.asarray(q) == 0).all()
+    assert (np.asarray(dequantize(q, s)) == 0).all()
+
+
+def test_int8_cache_decode_agrees_where_margin_allows():
+    # Greedy tokens from the int8 cache must match the bf16 cache wherever
+    # the bf16 argmax margin (top1 − top2 logit) exceeds the quantization
+    # drift — with an untrained random model many positions are near-ties,
+    # so an unconditional token-equality pin would be testing noise. The
+    # margin-gated positions are exactly where a trained model lives.
+    config = dataclasses.replace(
+        T.TransformerConfig.tiny(), dtype=jnp.float32, n_kv_heads=2
+    )
+    int8_config = dataclasses.replace(config, kv_cache_dtype="int8")
+    params = T.init_params(config, jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 13), 0, config.vocab_size)
+    L_pre = 7
+
+    _, (k_pre, v_pre) = T.forward(params, tokens[:, :L_pre], config, return_kv=True)
+    cache16 = T.init_decode_cache(config, 2, 13, k_pre, v_pre)
+    cache8 = T.init_decode_cache(int8_config, 2, 13, k_pre, v_pre)
+
+    checked = 0
+    for pos in range(L_pre, 13):
+        lg16, cache16 = T.decode_step(
+            params, tokens[:, pos : pos + 1], jnp.int32(pos), cache16, config
+        )
+        lg8, cache8 = T.decode_step(
+            params, tokens[:, pos : pos + 1], jnp.int32(pos), cache8, int8_config
+        )
+        top2 = jnp.sort(lg16[:, 0], axis=-1)[:, -2:]
+        margin = np.asarray(top2[:, 1] - top2[:, 0])  # [B]
+        same = np.asarray(
+            jnp.argmax(lg16[:, 0], -1) == jnp.argmax(lg8[:, 0], -1)
+        )
+        for b in range(2):
+            if margin[b] > 0.5:  # far above the measured int8 drift (~0.2)
+                assert same[b], (pos, b, float(margin[b]))
+                checked += 1
+    assert checked > 0  # the gate must have exercised something
+
+
+def test_int8_cache_logit_drift_bounded():
+    config = dataclasses.replace(T.TransformerConfig.tiny(), dtype=jnp.float32)
+    int8_config = dataclasses.replace(config, kv_cache_dtype="int8")
+    params = T.init_params(config, jax.random.PRNGKey(2))
+    tokens = jax.random.randint(jax.random.PRNGKey(3), (1, 12), 0, config.vocab_size)
+    L_pre = 8
+
+    _, (k_pre, v_pre) = T.forward(params, tokens[:, :L_pre], config, return_kv=True)
+    logits_full = T.forward(params, tokens, config)
+
+    for cfg in (config, int8_config):
+        cache = T.init_decode_cache(cfg, 1, 12, k_pre, v_pre)
+        worst = 0.0
+        for pos in range(L_pre, 12):
+            step_logits, cache = T.decode_step(
+                params, tokens[:, pos : pos + 1], jnp.int32(pos), cache, cfg
+            )
+            worst = max(
+                worst,
+                float(jnp.max(jnp.abs(step_logits[:, 0] - logits_full[:, pos]))),
+            )
+        # bf16 path is (near-)exact; int8 drift stays small relative to
+        # logit scale (~10 for the tiny model)
+        limit = 1e-3 if cfg.kv_cache_dtype == "bf16" else 0.2
+        assert worst < limit, (cfg.kv_cache_dtype, worst)
+
+
+def test_int8_cache_is_actually_int8():
+    config = dataclasses.replace(
+        T.TransformerConfig.tiny(), kv_cache_dtype="int8"
+    )
+    k_pre = jnp.ones((config.n_layers, 1, config.kv_heads, 4, config.head_dim))
+    cache = T.init_decode_cache(config, 1, 8, k_pre, k_pre)
+    assert cache["k"].dtype == jnp.int8
+    assert cache["v"].dtype == jnp.int8
+    assert cache["k_s"].dtype == jnp.float32

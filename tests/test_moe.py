@@ -93,12 +93,7 @@ def test_moe_prefill_and_decode_logits_agree_dropfree():
         params, tokens[:, :L_pre], config, return_kv=True
     )
     c = config
-    k_cache = jnp.zeros(
-        (c.n_layers, B, c.kv_heads, L_total, c.head_dim), c.dtype
-    )
-    v_cache = jnp.zeros_like(k_cache)
-    k_cache = k_cache.at[:, :, :, :L_pre, :].set(k_pre.astype(c.dtype))
-    v_cache = v_cache.at[:, :, :, :L_pre, :].set(v_pre.astype(c.dtype))
+    cache = T.init_decode_cache(c, B, L_total, k_pre, v_pre)
     np.testing.assert_allclose(
         np.asarray(logits_pre),
         np.asarray(logits_full[:, :L_pre]),
@@ -106,7 +101,6 @@ def test_moe_prefill_and_decode_logits_agree_dropfree():
         rtol=1e-4,
     )
 
-    cache = (k_cache, v_cache)
     for pos in range(L_pre, L_total):
         step_logits, cache = T.decode_step(
             params, tokens[:, pos : pos + 1], jnp.int32(pos), cache, c
