@@ -15,3 +15,7 @@ from bee_code_interpreter_tpu.models.vision import (  # noqa: F401
     ResNet,
     ResNetConfig,
 )
+from bee_code_interpreter_tpu.models.vit import (  # noqa: F401
+    ViT,
+    ViTConfig,
+)

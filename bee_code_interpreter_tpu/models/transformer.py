@@ -223,15 +223,18 @@ def shard_params(params: Params, config: TransformerConfig, mesh: Mesh) -> Param
 # ------------------------------------------------------------------- forward
 
 
-def _local_attention(q, k, v):
-    """Single-shard causal attention — the shared ops-level platform
-    dispatch (Pallas flash on TPU, reference elsewhere; GQA-native)."""
+def _local_attention(q, k, v, causal: bool = True):
+    """Single-shard attention — the shared ops-level platform dispatch
+    (Pallas flash on TPU, reference elsewhere; GQA-native)."""
     from bee_code_interpreter_tpu.ops.flash_attention import local_attention
 
-    return local_attention(q, k, v, causal=True)
+    return local_attention(q, k, v, causal=causal)
 
 
-def _attention(q, k, v, mesh: Mesh | None, sp_attention: str = "ring"):
+def _attention(
+    q, k, v, mesh: Mesh | None, sp_attention: str = "ring",
+    causal: bool = True,
+):
     """Causal attention; q [B, H, L, D], k/v [B, KVH, L, D] (KVH ≤ H).
 
     K/V stay compact through the whole path (flash kernel index-maps KV
@@ -248,7 +251,7 @@ def _attention(q, k, v, mesh: Mesh | None, sp_attention: str = "ring"):
             f"sp_attention must be 'ring' or 'ulysses', got {sp_attention!r}"
         )
     if mesh is None:
-        return _local_attention(q, k, v)
+        return _local_attention(q, k, v, causal)
     axes = mesh.axis_names
     tp = "tp" if "tp" in axes else None
     has_sp = "sp" in axes and mesh.shape["sp"] > 1
@@ -272,20 +275,22 @@ def _attention(q, k, v, mesh: Mesh | None, sp_attention: str = "ring"):
             )
 
             local = functools.partial(
-                ulysses_attention, axis_name="sp", causal=True
+                ulysses_attention, axis_name="sp", causal=causal
             )
         else:
             local = functools.partial(
-                ring_attention, axis_name="sp", causal=True
+                ring_attention, axis_name="sp", causal=causal
             )
     else:
-        local = _local_attention
+        local = functools.partial(_local_attention, causal=causal)
     # pallas_call under shard_map's vma checking hits a jax-internal lowering
-    # limitation (see tests/test_parallel.py flash-ring cases); every TPU
-    # branch here runs the flash kernel (local, flash-hop ring, or inside
-    # ulysses), so disable the check exactly there and keep it for the
-    # kernel-free CPU paths.
-    uses_pallas = jax.devices()[0].platform == "tpu"
+    # limitation (see tests/test_parallel.py flash-ring cases); every
+    # uses_flash() branch here runs the kernel (local, flash-hop ring, or
+    # inside ulysses), so disable the check exactly there and keep it for
+    # the kernel-free CPU paths.
+    from bee_code_interpreter_tpu.ops.flash_attention import uses_flash
+
+    uses_pallas = uses_flash()
     fn = jax.shard_map(
         local, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
         check_vma=not uses_pallas,

@@ -1,0 +1,237 @@
+"""Vision Transformer (encoder) family, TPU-first.
+
+Third pillar of the model family next to the llama-style decoder
+(models/transformer.py) and the conv ResNet (models/vision.py): it is the
+bidirectional-attention consumer of the shared attention stack — patches
+attend all-to-all through the same `_attention` dispatch the decoder uses
+(Pallas flash kernel with ``causal=False`` on TPU, ring/Ulysses over an
+``sp`` mesh axis for very long token grids, reference einsum on CPU).
+
+TPU-first choices:
+
+- **Patchify as one conv** (`P×P` kernel, stride `P`, NHWC) — a single
+  MXU-shaped contraction instead of reshape gymnastics.
+- **Scan over uniform blocks**: ViT blocks are homogeneous (unlike the
+  ResNet's widening stages), so per-layer params stack on a leading
+  ``[n_layers]`` axis and the encoder body is one ``lax.scan`` — compile
+  time flat in depth, same trick as the decoder.
+- **bf16 compute / f32 masters**, Megatron column/row PartitionSpecs over
+  ``fsdp``/``tp`` mesh axes, activations constrained on (batch, tokens).
+- **Global-average-pool head** (no CLS token): one less ragged token, and
+  the pooled reduction fuses into the head matmul.
+
+``ViTConfig.vit_b16()`` reproduces the ViT-Base/16 shape (12×768, ~86M
+params, pinned by tests/test_vit.py); ``tiny()`` is the CI size.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from bee_code_interpreter_tpu.models.transformer import _attention, rms_norm
+from bee_code_interpreter_tpu.parallel.mesh import batch_axes
+
+Params = dict[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class ViTConfig:
+    image_size: int = 224
+    patch_size: int = 16
+    d_model: int = 768
+    n_layers: int = 12
+    n_heads: int = 12
+    d_ff: int = 3072
+    num_classes: int = 1000
+    dtype: Any = jnp.bfloat16
+    sp_attention: str = "ring"  # sequence-parallel strategy over sp meshes
+
+    @property
+    def n_patches(self) -> int:
+        return (self.image_size // self.patch_size) ** 2
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+    @classmethod
+    def vit_b16(cls) -> "ViTConfig":
+        """The classic ViT-Base/16 shape (~86M params)."""
+        return cls()
+
+    @classmethod
+    def tiny(cls) -> "ViTConfig":
+        return cls(image_size=32, patch_size=8, d_model=64, n_layers=2,
+                   n_heads=4, d_ff=128, num_classes=10)
+
+
+# ------------------------------------------------------------------- weights
+
+
+def init_params(config: ViTConfig, key: jax.Array) -> Params:
+    c = config
+    k_patch, k_pos, k_layers, k_head = jax.random.split(key, 4)
+
+    def dense(key, fan_in, *shape):
+        return jax.random.normal(key, shape, dtype=jnp.float32) / math.sqrt(fan_in)
+
+    def layer(key):
+        ks = jax.random.split(key, 6)
+        return {
+            "ln1": jnp.ones((c.d_model,), jnp.float32),
+            "wq": dense(ks[0], c.d_model, c.d_model, c.d_model),
+            "wk": dense(ks[1], c.d_model, c.d_model, c.d_model),
+            "wv": dense(ks[2], c.d_model, c.d_model, c.d_model),
+            "wo": dense(ks[3], c.d_model, c.d_model, c.d_model),
+            "ln2": jnp.ones((c.d_model,), jnp.float32),
+            "w_up": dense(ks[4], c.d_model, c.d_model, c.d_ff),
+            "w_down": dense(ks[5], c.d_ff, c.d_ff, c.d_model),
+        }
+
+    p = c.patch_size
+    return {
+        "patch_embed": dense(k_patch, p * p * 3, p, p, 3, c.d_model),  # HWIO
+        "pos_embed": 0.02 * jax.random.normal(
+            k_pos, (c.n_patches, c.d_model), jnp.float32
+        ),
+        "layers": jax.vmap(layer)(jax.random.split(k_layers, c.n_layers)),
+        "ln_f": jnp.ones((c.d_model,), jnp.float32),
+        "head": {
+            "w": dense(k_head, c.d_model, c.d_model, c.num_classes),
+            "b": jnp.zeros((c.num_classes,), jnp.float32),
+        },
+    }
+
+
+def param_specs(config: ViTConfig, mesh: Mesh) -> Params:
+    """Megatron col/row specs over whichever of (fsdp, tp) exist."""
+    tp = "tp" if "tp" in mesh.axis_names else None
+    fsdp = "fsdp" if "fsdp" in mesh.axis_names else None
+    col = P(None, fsdp, tp)   # stacked [n_layers, d_in, d_out/tp]
+    row = P(None, tp, fsdp)
+    rep = P(None)
+    return {
+        "patch_embed": P(None, None, None, tp),
+        "pos_embed": P(),
+        "layers": {
+            "ln1": rep, "ln2": rep,
+            "wq": col, "wk": col, "wv": col, "wo": row,
+            "w_up": col, "w_down": row,
+        },
+        "ln_f": P(),
+        # head stays replicated: [d_model, num_classes] is tiny and
+        # num_classes rarely divides tp
+        "head": {"w": P(None, None), "b": P()},
+    }
+
+
+def shard_params(params: Params, config: ViTConfig, mesh: Mesh) -> Params:
+    specs = param_specs(config, mesh)
+    return jax.tree.map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), params, specs,
+    )
+
+
+# ------------------------------------------------------------------- forward
+
+
+def forward(
+    params: Params,
+    images: jax.Array,  # [B, H, W, 3]
+    config: ViTConfig,
+    mesh: Mesh | None = None,
+) -> jax.Array:
+    """Returns logits [B, num_classes] (f32)."""
+    c = config
+    B = images.shape[0]
+
+    def constrain(x):
+        if mesh is None:
+            return x
+        sp = "sp" if "sp" in mesh.axis_names else None
+        return lax.with_sharding_constraint(
+            x, NamedSharding(mesh, P(batch_axes(mesh), sp, None))
+        )
+
+    # patchify: one strided conv, NHWC x HWIO -> [B, H/P, W/P, D] -> tokens
+    x = lax.conv_general_dilated(
+        images.astype(c.dtype), params["patch_embed"].astype(c.dtype),
+        window_strides=(c.patch_size, c.patch_size), padding="VALID",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    ).reshape(B, c.n_patches, c.d_model)
+    h = constrain(x + params["pos_embed"].astype(c.dtype))
+
+    def block(h, layer):
+        x = rms_norm(h, layer["ln1"])
+        dh, nh = c.head_dim, c.n_heads
+
+        def proj(w):
+            out = jnp.einsum("btd,dk->btk", x, w.astype(c.dtype))
+            return out.reshape(B, -1, nh, dh).transpose(0, 2, 1, 3)
+
+        q, k, v = proj(layer["wq"]), proj(layer["wk"]), proj(layer["wv"])
+        attn = _attention(q, k, v, mesh, c.sp_attention, causal=False)
+        attn = attn.transpose(0, 2, 1, 3).reshape(B, -1, nh * dh)
+        h = h + constrain(
+            jnp.einsum("btk,kd->btd", attn, layer["wo"].astype(c.dtype))
+        )
+        y = rms_norm(h, layer["ln2"])
+        up = jnp.einsum("btd,df->btf", y, layer["w_up"].astype(c.dtype))
+        mlp = jnp.einsum(
+            "btf,fd->btd", jax.nn.gelu(up), layer["w_down"].astype(c.dtype)
+        )
+        return h + constrain(mlp), None
+
+    h, _ = lax.scan(block, h, params["layers"])
+    h = rms_norm(h, params["ln_f"])
+    pooled = h.mean(axis=1).astype(jnp.float32)  # global average pool
+    return pooled @ params["head"]["w"] + params["head"]["b"]
+
+
+def loss_fn(params, batch, config, mesh=None):
+    logits = forward(params, batch["images"], config, mesh)
+    return optax.softmax_cross_entropy_with_integer_labels(
+        logits, batch["labels"]
+    ).mean()
+
+
+class ViT:
+    """Config + mesh bundle mirroring Transformer/ResNet."""
+
+    def __init__(self, config: ViTConfig, mesh: Mesh | None = None) -> None:
+        self.config = config
+        self.mesh = mesh
+
+    def init(self, key: jax.Array) -> Params:
+        params = init_params(self.config, key)
+        if self.mesh is not None:
+            params = shard_params(params, self.config, self.mesh)
+        return params
+
+    def apply(self, params: Params, images: jax.Array) -> jax.Array:
+        return forward(params, images, self.config, self.mesh)
+
+    def make_train_step(self, optimizer=None):
+        optimizer = optimizer or optax.adamw(3e-4, weight_decay=0.05)
+
+        def train_step(params, opt_state, batch):
+            loss, grads = jax.value_and_grad(loss_fn)(
+                params, batch, self.config, self.mesh
+            )
+            updates, opt_state = optimizer.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state, loss
+
+        return jax.jit(train_step, donate_argnums=(0, 1))
+
+    def batch_sharding(self) -> NamedSharding | None:
+        if self.mesh is None:
+            return None
+        return NamedSharding(self.mesh, P(batch_axes(self.mesh)))

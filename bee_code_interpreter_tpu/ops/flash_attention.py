@@ -614,6 +614,14 @@ def _round_up(n: int, to: int = 128) -> int:
     return max(to, ((n + to - 1) // to) * to)
 
 
+def uses_flash() -> bool:
+    """Whether the Pallas kernel path is active on this backend — THE single
+    predicate behind local_attention's dispatch, ring_attention's use_flash
+    default, and the shard_map check_vma decisions (which must track the
+    kernel path exactly: vma checking cannot lower pallas_call yet)."""
+    return jax.devices()[0].platform == "tpu"
+
+
 def local_attention(q, k, v, causal: bool = True):
     """Single-device attention with platform dispatch: the Pallas flash
     kernel on TPU, the dense reference elsewhere (CPU tests). Both are
@@ -621,7 +629,7 @@ def local_attention(q, k, v, causal: bool = True):
     dispatch — models/transformer.py and parallel/ulysses.py both route
     through it, so backend policy can't silently diverge between the
     sp-attention strategies."""
-    if jax.devices()[0].platform == "tpu":
+    if uses_flash():
         return flash_attention(q, k, v, causal)
     from bee_code_interpreter_tpu.parallel.ring_attention import (
         reference_attention,

@@ -80,7 +80,9 @@ def ring_attention(
     gradients; the kernel's VJP folds them into its delta shift).
     """
     if use_flash is None:
-        use_flash = jax.devices()[0].platform == "tpu"
+        from bee_code_interpreter_tpu.ops.flash_attention import uses_flash
+
+        use_flash = uses_flash()
     if use_flash:
         return _ring_attention_flash(
             q, k, v, axis_name=axis_name, causal=causal, sm_scale=sm_scale
@@ -248,9 +250,9 @@ def ring_attention_sharded(
     # the flash-hop path runs pallas_call under shard_map, which vma
     # checking cannot lower yet — disable the check exactly when that path
     # is taken (see models/transformer._attention)
-    flash = use_flash if use_flash is not None else (
-        jax.devices()[0].platform == "tpu"
-    )
+    from bee_code_interpreter_tpu.ops.flash_attention import uses_flash
+
+    flash = use_flash if use_flash is not None else uses_flash()
     fn = jax.shard_map(
         functools.partial(
             ring_attention, axis_name=axis_name, causal=causal,
