@@ -235,7 +235,8 @@ def _attention(
     q, k, v, mesh: Mesh | None, sp_attention: str = "ring",
     causal: bool = True,
 ):
-    """Causal attention; q [B, H, L, D], k/v [B, KVH, L, D] (KVH ≤ H).
+    """Attention (causal by default; ``causal=False`` for encoders — the
+    ViT path); q [B, H, L, D], k/v [B, KVH, L, D] (KVH ≤ H).
 
     K/V stay compact through the whole path (flash kernel index-maps KV
     heads, the ring rotates KVH-sized blocks) — GQA never materializes the
@@ -638,6 +639,45 @@ def decode_step(
     return logits.astype(jnp.float32), cache
 
 
+# ----------------------------------------------------------------- sampling
+
+
+def sample_logits(
+    logits: jax.Array,  # [B, V] f32
+    key: jax.Array,
+    temperature: float = 0.0,
+    top_k: int | None = None,
+    top_p: float | None = None,
+) -> jax.Array:
+    """Next-token selection: greedy at ``temperature == 0`` (exact argmax),
+    otherwise categorical over temperature-scaled logits with optional
+    top-k then top-p (nucleus) filtering. All filters are static-shape
+    (mask-to--inf, no dynamic vocab slicing) so the decode loop stays one
+    compiled program. Returns [B, 1] int32."""
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)[:, None]
+    x = logits / temperature
+    if top_k is not None:
+        kth = lax.top_k(x, top_k)[0][:, -1:]  # [B, 1] k-th largest
+        x = jnp.where(x >= kth, x, -jnp.inf)
+    if top_p is not None:
+        # nucleus: keep the smallest prefix of the descending-prob order
+        # whose mass reaches top_p (always at least the top token)
+        sort_idx = jnp.argsort(-x, axis=-1)
+        sorted_x = jnp.take_along_axis(x, sort_idx, axis=-1)
+        probs = jax.nn.softmax(sorted_x, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        keep_sorted = (cum - probs) < top_p  # mass BEFORE this token < p
+        # position 0 of the descending order is the top token: always
+        # eligible, so degenerate top_p (<= 0) cannot mask the whole vocab
+        keep_sorted = keep_sorted.at[:, 0].set(True)
+        keep = jnp.zeros_like(keep_sorted).at[
+            jnp.arange(x.shape[0])[:, None], sort_idx
+        ].set(keep_sorted)
+        x = jnp.where(keep, x, -jnp.inf)
+    return jax.random.categorical(key, x, axis=-1).astype(jnp.int32)[:, None]
+
+
 # ---------------------------------------------------------------- loss/train
 
 
@@ -725,25 +765,41 @@ class Transformer:
         return tokens
 
     def generate_cached(
-        self, params: Params, prompt: jax.Array, max_new_tokens: int = 32
+        self,
+        params: Params,
+        prompt: jax.Array,
+        max_new_tokens: int = 32,
+        *,
+        temperature: float = 0.0,
+        top_k: int | None = None,
+        top_p: float | None = None,
+        key: jax.Array | None = None,
     ) -> jax.Array:
-        """Greedy decode with a KV cache: one O(L^2) prefill, then
-        ``max_new_tokens - 1`` O(L) incremental steps (decode_step). Output
-        is pinned equal to ``generate`` by tests/test_models.py. For MoE
-        configs the equality holds only drop-free (ample capacity): under
-        capacity pressure the full forward routes tokens in competition
-        while decode routes each token alone — inherent to capacity-based
-        MoE (tests/test_moe.py)."""
+        """KV-cached decode: one O(L^2) prefill, then ``max_new_tokens - 1``
+        O(L) incremental steps (decode_step). Default is greedy
+        (``temperature=0``) and pinned equal to ``generate`` by
+        tests/test_models.py; ``temperature``/``top_k``/``top_p`` select
+        sampled decoding (``sample_logits``; ``key`` defaults to PRNGKey(0)
+        and is split per step, so a fixed key is fully deterministic). For
+        MoE configs greedy equality holds only drop-free (ample capacity):
+        under capacity pressure the full forward routes tokens in
+        competition while decode routes each token alone — inherent to
+        capacity-based MoE (tests/test_moe.py)."""
         c = self.config
         B, L = prompt.shape
         total = L + max_new_tokens
+        if key is None:
+            key = jax.random.PRNGKey(0)
 
         logits, (k_pre, v_pre) = forward(
             params, prompt, c, self.mesh, return_kv=True
         )
         cache = init_decode_cache(c, B, total, k_pre, v_pre)
 
-        first = jnp.argmax(logits[:, L - 1 : L, :], axis=-1).astype(jnp.int32)
+        key, sub = jax.random.split(key)
+        first = sample_logits(
+            logits[:, L - 1, :], sub, temperature, top_k, top_p
+        )
         tokens = (
             jnp.zeros((B, total), dtype=jnp.int32)
             .at[:, :L].set(prompt)
@@ -751,15 +807,18 @@ class Transformer:
         )
 
         def step(carry, pos):
-            tokens, current, cache = carry
+            tokens, current, cache, key = carry
             step_logits, cache = decode_step(params, current, pos, cache, c)
-            next_tok = jnp.argmax(step_logits[:, -1:, :], axis=-1).astype(jnp.int32)
+            key, sub = jax.random.split(key)
+            next_tok = sample_logits(
+                step_logits[:, -1, :], sub, temperature, top_k, top_p
+            )
             tokens = lax.dynamic_update_slice(tokens, next_tok, (0, pos + 1))
-            return (tokens, next_tok, cache), None
+            return (tokens, next_tok, cache, key), None
 
-        (tokens, _, _), _ = lax.scan(
+        (tokens, _, _, _), _ = lax.scan(
             step,
-            (tokens, first, cache),
+            (tokens, first, cache, key),
             jnp.arange(L, total - 1, dtype=jnp.int32),
         )
         return tokens

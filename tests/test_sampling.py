@@ -1,0 +1,105 @@
+"""Sampled decoding: temperature / top-k / top-p on the cached generator."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bee_code_interpreter_tpu.models import transformer as T
+from bee_code_interpreter_tpu.models.transformer import sample_logits
+
+
+def rand_logits(B=4, V=32, key=0):
+    return jax.random.normal(jax.random.PRNGKey(key), (B, V)) * 3.0
+
+
+def test_temperature_zero_is_argmax():
+    logits = rand_logits()
+    out = sample_logits(logits, jax.random.PRNGKey(1), temperature=0.0)
+    np.testing.assert_array_equal(
+        np.asarray(out[:, 0]), np.asarray(jnp.argmax(logits, -1))
+    )
+
+
+def test_top_k_support_containment():
+    # Every sampled token must be among the k largest logits.
+    logits = rand_logits(B=8, V=64, key=2)
+    topk = np.asarray(jnp.argsort(-logits, axis=-1)[:, :5])
+    for i in range(20):
+        out = np.asarray(
+            sample_logits(
+                logits, jax.random.PRNGKey(i), temperature=1.0, top_k=5
+            )
+        )
+        for b in range(8):
+            assert out[b, 0] in topk[b], (b, out[b, 0])
+
+
+def test_top_k_one_is_greedy_at_any_temperature():
+    logits = rand_logits(key=3)
+    out = sample_logits(logits, jax.random.PRNGKey(9), temperature=5.0, top_k=1)
+    np.testing.assert_array_equal(
+        np.asarray(out[:, 0]), np.asarray(jnp.argmax(logits, -1))
+    )
+
+
+def test_top_p_nucleus_containment():
+    # Sampled tokens must lie in the smallest prefix (by descending prob)
+    # whose mass reaches p — and the top token is always eligible.
+    logits = rand_logits(B=8, V=64, key=4)
+    probs = np.asarray(jax.nn.softmax(logits, axis=-1))
+    order = np.argsort(-probs, axis=-1)
+    for i in range(20):
+        out = np.asarray(
+            sample_logits(
+                logits, jax.random.PRNGKey(100 + i), temperature=1.0, top_p=0.5
+            )
+        )
+        for b in range(8):
+            sorted_p = probs[b][order[b]]
+            keep_n = int(np.searchsorted(np.cumsum(sorted_p), 0.5) + 1)
+            nucleus = set(order[b][:keep_n].tolist())
+            assert out[b, 0] in nucleus, (b, out[b, 0], keep_n)
+
+
+def test_sharp_distribution_top_p_forces_top_token():
+    logits = jnp.array([[10.0, 0.0, -1.0, -2.0]])
+    for i in range(10):
+        out = sample_logits(
+            logits, jax.random.PRNGKey(i), temperature=1.0, top_p=0.9
+        )
+        assert int(out[0, 0]) == 0
+
+
+def test_generate_cached_sampling_deterministic_and_default_greedy():
+    config = dataclasses.replace(T.TransformerConfig.tiny(), dtype=jnp.float32)
+    model = T.Transformer(config)
+    params = model.init(jax.random.PRNGKey(0))
+    prompt = jax.random.randint(jax.random.PRNGKey(1), (2, 5), 0, config.vocab_size)
+
+    greedy = model.generate_cached(params, prompt, max_new_tokens=5)
+    uncached = model.generate(params, prompt, max_new_tokens=5)
+    assert (greedy == uncached).all()  # default stays pinned to greedy
+
+    k = jax.random.PRNGKey(7)
+    a = model.generate_cached(
+        params, prompt, max_new_tokens=5, temperature=1.0, top_k=8, key=k
+    )
+    b = model.generate_cached(
+        params, prompt, max_new_tokens=5, temperature=1.0, top_k=8, key=k
+    )
+    assert (a == b).all()  # fixed key → fully deterministic
+    assert a.shape == greedy.shape
+    # prompt region untouched
+    np.testing.assert_array_equal(np.asarray(a[:, :5]), np.asarray(prompt))
+
+
+def test_top_p_degenerate_keeps_top_token():
+    # top_p=0.0 must still sample the top token, never an all-masked vocab
+    # collapsing to token id 0.
+    logits = jnp.array([[0.0, 5.0, 1.0]])  # top token is id 1, not 0
+    out = sample_logits(
+        logits, jax.random.PRNGKey(0), temperature=1.0, top_p=0.0
+    )
+    assert int(out[0, 0]) == 1
