@@ -1,0 +1,120 @@
+"""LoRA (low-rank adaptation) fine-tuning for the transformer family.
+
+Fits the functional design with zero model edits: LoRA state is a separate
+small pytree of stacked per-layer ``A [n_layers, d_in, r]`` / ``B
+[n_layers, r, d_out]`` factors for chosen projections, and ``merge_lora``
+produces an ordinary params pytree with ``W + (alpha/r)·A@B`` folded in —
+the merged weights feed the unchanged ``forward``/``decode_step``/pipeline
+paths, shard under the same Megatron PartitionSpecs, and the merge einsum
+is one extra [d_in, r]×[r, d_out] matmul per layer at trace time (fused by
+XLA into the parameter cast it already does).
+
+Training differentiates the loss **through the merge** with respect to the
+LoRA factors only (``jax.grad`` argnum on the lora pytree) — the base stays
+frozen and no optimizer state is allocated for it, which is the point:
+AdamW moments for an 8B model cost 2×32 GB f32, while rank-16 LoRA state
+fits in tens of MB.
+
+``B`` is zero-initialized (standard LoRA): the adapted model starts exactly
+equal to the base, pinned by tests/test_lora.py.
+
+The reference has no training of any kind (SURVEY.md §2); this module is
+framework completeness: sandboxed agents fine-tune the bundled families
+without shipping a second copy of the model code.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from bee_code_interpreter_tpu.models.transformer import (
+    TransformerConfig,
+    loss_fn,
+)
+
+Params = dict[str, Any]
+
+DEFAULT_TARGETS = ("wq", "wv")  # the classic LoRA placement
+
+
+def init_lora(
+    config: TransformerConfig,
+    key: jax.Array,
+    rank: int = 8,
+    targets: tuple[str, ...] = DEFAULT_TARGETS,
+) -> Params:
+    """LoRA state: per-target stacked A (gaussian / sqrt(d)) and B (zeros).
+
+    Shapes follow the base layer weights: target ``w`` of stacked shape
+    [n_layers, d_in, d_out] gets A [n_layers, d_in, r], B
+    [n_layers, r, d_out]. The scale (alpha/rank) is a static argument of
+    ``merge_lora``/``make_lora_train_step``, NOT a pytree leaf — leaves are
+    what optimizers update.
+    """
+    c = config
+    dims = {
+        "wq": (c.d_model, c.n_heads * c.head_dim),
+        "wk": (c.d_model, c.kv_heads * c.head_dim),
+        "wv": (c.d_model, c.kv_heads * c.head_dim),
+        "wo": (c.n_heads * c.head_dim, c.d_model),
+    }
+    if not c.n_experts:
+        dims.update({
+            "w_gate": (c.d_model, c.ff_dim),
+            "w_up": (c.d_model, c.ff_dim),
+            "w_down": (c.ff_dim, c.d_model),
+        })
+    unknown = set(targets) - set(dims)
+    if unknown:
+        raise ValueError(f"no LoRA target(s) {sorted(unknown)}; have {sorted(dims)}")
+    keys = jax.random.split(key, len(targets))
+    state: Params = {}
+    for t, k in zip(targets, keys):
+        d_in, d_out = dims[t]
+        state[t] = {
+            "A": jax.random.normal(k, (c.n_layers, d_in, rank), jnp.float32)
+            / math.sqrt(d_in),
+            "B": jnp.zeros((c.n_layers, rank, d_out), jnp.float32),
+        }
+    return state
+
+
+def merge_lora(params: Params, lora: Params, scale: float = 1.0) -> Params:
+    """Base params with ``W + scale·A@B`` folded into each target — an
+    ordinary params pytree for the unchanged forward/decode paths.
+    ``scale`` is the standard alpha/rank."""
+    layers = dict(params["layers"])
+    for t, ab in lora.items():
+        delta = jnp.einsum("lir,lro->lio", ab["A"], ab["B"]) * scale
+        layers[t] = params["layers"][t] + delta
+    return {**params, "layers": layers}
+
+
+def make_lora_train_step(
+    config: TransformerConfig,
+    optimizer=None,
+    mesh=None,
+    scale: float = 1.0,
+):
+    """Jitted step updating ONLY the LoRA factors; base params are frozen
+    (no gradient, no optimizer state). Returns (step, optimizer)."""
+    optimizer = optimizer or optax.adamw(1e-3)
+
+    def lora_loss(lora, params, batch):
+        return loss_fn(merge_lora(params, lora, scale), batch, config, mesh)
+
+    def step(lora, opt_state, params, batch):
+        loss, grads = jax.value_and_grad(lora_loss)(lora, params, batch)
+        updates, opt_state = optimizer.update(grads, opt_state, lora)
+        return optax.apply_updates(lora, updates), opt_state, loss
+
+    return jax.jit(step, donate_argnums=(0, 1)), optimizer
+
+
+def lora_param_count(lora: Params) -> int:
+    return sum(x.size for ab in lora.values() for x in ab.values())

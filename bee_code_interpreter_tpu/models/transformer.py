@@ -658,6 +658,8 @@ def sample_logits(
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)[:, None]
     x = logits / temperature
     if top_k is not None:
+        if top_k < 1:
+            raise ValueError(f"top_k must be >= 1, got {top_k}")
         kth = lax.top_k(x, top_k)[0][:, -1:]  # [B, 1] k-th largest
         x = jnp.where(x >= kth, x, -jnp.inf)
     if top_p is not None:

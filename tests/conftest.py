@@ -78,15 +78,26 @@ def storage(tmp_path):
 
 
 @pytest.fixture
-def local_executor(storage, tmp_path):
+def local_executor_factory(storage, tmp_path):
+    """One construction site for the test LocalCodeExecutor; tests that
+    need a different execution timeout call the factory instead of
+    re-building the executor (keeping constructor changes in one place)."""
     from bee_code_interpreter_tpu.services.local_code_executor import LocalCodeExecutor
 
-    return LocalCodeExecutor(
-        storage=storage,
-        workspace_root=tmp_path / "workspaces",
-        disable_dep_install=True,
-        execution_timeout_s=30.0,
-    )
+    def make(execution_timeout_s: float = 30.0):
+        return LocalCodeExecutor(
+            storage=storage,
+            workspace_root=tmp_path / "workspaces",
+            disable_dep_install=True,
+            execution_timeout_s=execution_timeout_s,
+        )
+
+    return make
+
+
+@pytest.fixture
+def local_executor(local_executor_factory):
+    return local_executor_factory()
 
 
 @pytest.fixture

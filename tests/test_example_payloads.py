@@ -4,10 +4,20 @@ examples must be runnable artifacts, not documentation."""
 
 from pathlib import Path
 
+import pytest
+
 from tests.http_helpers import post_execute  # http_app fixture: conftest
 
 REPO = Path(__file__).resolve().parent.parent
 EXAMPLES = REPO / "examples"
+
+
+@pytest.fixture
+def local_executor(local_executor_factory):
+    # Overrides conftest's 30s-capped executor: these payloads jit-compile
+    # real models, and on a loaded box (e.g. a parallel pytest run) the
+    # compile alone can blow a 30s budget — a flake, not a regression.
+    return local_executor_factory(execution_timeout_s=600.0)
 
 
 async def test_resnet_train_example(http_app):

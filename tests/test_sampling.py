@@ -103,3 +103,12 @@ def test_top_p_degenerate_keeps_top_token():
         logits, jax.random.PRNGKey(0), temperature=1.0, top_p=0.0
     )
     assert int(out[0, 0]) == 1
+
+
+def test_top_k_zero_rejected():
+    import pytest
+
+    with pytest.raises(ValueError, match="top_k must be >= 1"):
+        sample_logits(
+            rand_logits(), jax.random.PRNGKey(0), temperature=1.0, top_k=0
+        )
