@@ -57,18 +57,20 @@ def init_lora(
     what optimizers update.
     """
     c = config
+    # shapes derive from init_params itself (abstract eval — no arrays are
+    # materialized): the stacked [n_layers, d_in, d_out] projections are the
+    # LoRA-able targets, and there is exactly one source of truth for their
+    # layout
+    from bee_code_interpreter_tpu.models.transformer import init_params
+
+    abstract = jax.eval_shape(
+        lambda k: init_params(c, k), jax.random.PRNGKey(0)
+    )["layers"]
     dims = {
-        "wq": (c.d_model, c.n_heads * c.head_dim),
-        "wk": (c.d_model, c.kv_heads * c.head_dim),
-        "wv": (c.d_model, c.kv_heads * c.head_dim),
-        "wo": (c.n_heads * c.head_dim, c.d_model),
+        name: leaf.shape[1:]
+        for name, leaf in abstract.items()
+        if hasattr(leaf, "ndim") and leaf.ndim == 3
     }
-    if not c.n_experts:
-        dims.update({
-            "w_gate": (c.d_model, c.ff_dim),
-            "w_up": (c.d_model, c.ff_dim),
-            "w_down": (c.ff_dim, c.d_model),
-        })
     unknown = set(targets) - set(dims)
     if unknown:
         raise ValueError(f"no LoRA target(s) {sorted(unknown)}; have {sorted(dims)}")

@@ -654,12 +654,14 @@ def sample_logits(
     top-k then top-p (nucleus) filtering. All filters are static-shape
     (mask-to--inf, no dynamic vocab slicing) so the decode loop stays one
     compiled program. Returns [B, 1] int32."""
+    if top_k is not None and top_k < 1:
+        # validated regardless of temperature: a config tested greedy-first
+        # must fail fast, not only when sampling is later enabled
+        raise ValueError(f"top_k must be >= 1, got {top_k}")
     if temperature == 0.0:
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)[:, None]
     x = logits / temperature
     if top_k is not None:
-        if top_k < 1:
-            raise ValueError(f"top_k must be >= 1, got {top_k}")
         kth = lax.top_k(x, top_k)[0][:, -1:]  # [B, 1] k-th largest
         x = jnp.where(x >= kth, x, -jnp.inf)
     if top_p is not None:

@@ -112,3 +112,12 @@ def test_top_k_zero_rejected():
         sample_logits(
             rand_logits(), jax.random.PRNGKey(0), temperature=1.0, top_k=0
         )
+
+
+def test_top_k_zero_rejected_even_greedy():
+    import pytest
+
+    with pytest.raises(ValueError, match="top_k must be >= 1"):
+        sample_logits(
+            rand_logits(), jax.random.PRNGKey(0), temperature=0.0, top_k=0
+        )
