@@ -19,3 +19,6 @@ from bee_code_interpreter_tpu.models.vit import (  # noqa: F401
     ViT,
     ViTConfig,
 )
+from bee_code_interpreter_tpu.models.speculative import (  # noqa: F401
+    speculative_generate,
+)

@@ -1,0 +1,149 @@
+"""Speculative decoding (greedy draft-verify) for the transformer family.
+
+A small draft model proposes ``gamma`` greedy tokens from its own KV cache
+(``decode_step`` ×γ — cheap), then the target model scores the whole window
+in ONE cached forward (``decode_window``) and commits the longest prefix on
+which the draft matched its own greedy choice, plus the target's correction
+token. Greedy verification is **exact**: the output equals the target's own
+greedy decode token-for-token, for ANY draft — the draft only changes how
+many target forwards are needed (pinned by tests/test_speculative.py with
+both a perfect draft and an unrelated random draft).
+
+TPU-first mechanics:
+
+- One compiled program: the outer accept loop is a ``lax.while_loop`` over
+  a cursor into a statically-sized token buffer (padded by γ+2 so the
+  fixed-width window writes never clamp near the end); the per-round accept
+  length is data-dependent, the shapes never are.
+- **No cache rewind**: rejected draft positions do write K/V into both
+  caches, but every cache read is masked by query position (``s ≤ p``), so
+  stale entries beyond the committed cursor are invisible until the real
+  token overwrites them. Rewind logic — the fiddly part of most
+  implementations — falls out of the position-masked cache design.
+- **Lockstep batches**: the committed length per round is the minimum
+  accept length over the batch. Rows that matched further simply recommit
+  the same tokens next round — still exact, keeps every cache update a
+  single scalar-position slice.
+
+The draft can be any Transformer config/params sharing the vocab (typically
+fewer layers / smaller d_model).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from bee_code_interpreter_tpu.models.transformer import (
+    TransformerConfig,
+    decode_step,
+    decode_window,
+    forward,
+    init_decode_cache,
+)
+
+
+def speculative_generate(
+    target_params,
+    target_config: TransformerConfig,
+    draft_params,
+    draft_config: TransformerConfig,
+    prompt: jax.Array,  # [B, L] int32
+    max_new_tokens: int = 32,
+    gamma: int = 4,
+) -> jax.Array:
+    """Greedy decode of the TARGET model, accelerated by the draft.
+
+    Returns [B, L + max_new_tokens] — token-for-token equal to
+    ``Transformer(target_config).generate_cached(target_params, prompt,
+    max_new_tokens)``.
+    """
+    tc, dc = target_config, draft_config
+    if tc.vocab_size != dc.vocab_size:
+        raise ValueError("target and draft must share a vocabulary")
+    if tc.n_experts:
+        # capacity-based MoE routing depends on the routing-pool size: the
+        # verify window routes B·(γ+1) tokens where plain greedy decode
+        # routes B·1, so under capacity pressure the two can drop different
+        # tokens and the exactness guarantee breaks. Refuse rather than be
+        # silently approximate (same stance as forward_pipelined's aux
+        # guard); MoE DRAFTS are fine — drafts only propose.
+        raise NotImplementedError(
+            "speculative_generate requires a dense target (MoE routing "
+            "pools differ between the verify window and plain decode); "
+            "use Transformer.generate_cached for MoE targets"
+        )
+    if tc.kv_cache_dtype != "bf16":
+        # fail before the two O(L²) prefills, not at the first verify
+        raise NotImplementedError(
+            "speculative_generate supports the bf16 target cache "
+            "(decode_window does not take the int8 layout)"
+        )
+    B, L = prompt.shape
+    if max_new_tokens < 1:
+        raise ValueError("max_new_tokens must be >= 1")
+    # window writes are fixed-width γ+1 starting at pos+1; pad so the last
+    # round's write stays in bounds (dynamic_update_slice clamps the start
+    # index when an update would overflow — which would silently shift the
+    # write onto committed tokens)
+    buf = L + max_new_tokens + gamma + 2
+
+    t_logits, (tk, tv) = forward(target_params, prompt, tc, return_kv=True)
+    target_cache = init_decode_cache(tc, B, buf, tk, tv)
+    _, (dk, dv) = forward(draft_params, prompt, dc, return_kv=True)
+    draft_cache = init_decode_cache(dc, B, buf, dk, dv)
+
+    first = jnp.argmax(t_logits[:, L - 1, :], axis=-1).astype(jnp.int32)
+    tokens = (
+        jnp.zeros((B, buf), dtype=jnp.int32)
+        .at[:, :L].set(prompt)
+        .at[:, L].set(first)
+    )
+    last = L + max_new_tokens - 1  # buffer index of the final token
+
+    def round_body(state):
+        tokens, pos, target_cache, draft_cache = state
+        current = lax.dynamic_slice_in_dim(tokens, pos, 1, axis=1)  # [B, 1]
+
+        # --- draft proposes γ greedy tokens from (current, pos) ----------
+        def draft_step(carry, _):
+            tok, p, cache = carry
+            lg, cache = decode_step(draft_params, tok, p, cache, dc)
+            nxt = jnp.argmax(lg[:, -1:, :], axis=-1).astype(jnp.int32)
+            return (nxt, p + 1, cache), nxt
+
+        (_, _, draft_cache), drafts = lax.scan(
+            draft_step, (current, pos, draft_cache), None, length=gamma
+        )
+        drafts = drafts[:, :, 0].T  # [γ, B, 1] -> [B, γ]
+
+        # --- target verifies the whole window in one forward --------------
+        window = jnp.concatenate([current, drafts], axis=1)  # [B, γ+1]
+        t_logits, target_cache = decode_window(
+            target_params, window, pos, target_cache, tc
+        )
+        t_pred = jnp.argmax(t_logits, axis=-1).astype(jnp.int32)  # [B, γ+1]
+
+        # longest leading run where the draft equals the target's greedy
+        # choice; lockstep across the batch (min) keeps positions scalar
+        match = (drafts == t_pred[:, :gamma]).astype(jnp.int32)  # [B, γ]
+        lead = jnp.cumprod(match, axis=1)
+        n = jnp.min(lead.sum(axis=1)).astype(jnp.int32)  # scalar in [0, γ]
+
+        # commit drafts[:, :n] at pos+1.. and the target's token at pos+n+1;
+        # slots beyond n get the bonus value too — they sit past the cursor,
+        # invisible and overwritten by later rounds
+        bonus = jnp.take_along_axis(t_pred, jnp.full((B, 1), n), axis=1)
+        idx = jnp.arange(gamma + 1, dtype=jnp.int32)[None, :]
+        vals = jnp.where(idx < n, jnp.pad(drafts, ((0, 0), (0, 1))), bonus)
+        tokens = lax.dynamic_update_slice(tokens, vals, (0, pos + 1))
+        return tokens, pos + n + 1, target_cache, draft_cache
+
+    def cond(state):
+        return state[1] < last
+
+    tokens, _, _, _ = lax.while_loop(
+        cond, round_body, (tokens, jnp.int32(L), target_cache, draft_cache)
+    )
+    return tokens[:, : L + max_new_tokens]

@@ -552,9 +552,14 @@ def decode_step(
     With ``kv_cache_dtype="int8"`` the cache stays int8 in HBM (half the
     bytes the bandwidth-bound loop streams); dequantization rides the
     attention einsums' operand pipeline.
+
+    The bf16 path IS ``decode_window`` with W=1 (one layer body, no second
+    copy to drift); this function keeps only the int8-cache body, which
+    quantizes the new token's K/V per row.
     """
     c = config
-    quant = c.kv_cache_dtype == "int8"
+    if c.kv_cache_dtype != "int8":
+        return decode_window(params, token, pos, cache, config)
     B = token.shape[0]
     max_len = cache["k"].shape[3]
     positions = jnp.full((B, 1), pos, dtype=jnp.int32)
@@ -573,33 +578,25 @@ def decode_step(
         q = rope(proj(layer["wq"], nh), positions, c.rope_theta)  # [B,nh,1,Dh]
         k_new = rope(proj(layer["wk"], kvh), positions, c.rope_theta)
         v_new = proj(layer["wv"], kvh)
-        if quant:
-            from bee_code_interpreter_tpu.ops.kv_cache import (
-                dequantize,
-                quantize,
-            )
+        from bee_code_interpreter_tpu.ops.kv_cache import (
+            dequantize,
+            quantize,
+        )
 
-            kq, ks = quantize(k_new)
-            vq, vs = quantize(v_new)
-            c_layer = {
-                "k": lax.dynamic_update_slice(c_layer["k"], kq, (0, 0, pos, 0)),
-                "v": lax.dynamic_update_slice(c_layer["v"], vq, (0, 0, pos, 0)),
-                "k_s": lax.dynamic_update_slice(
-                    c_layer["k_s"], ks, (0, 0, pos, 0)
-                ),
-                "v_s": lax.dynamic_update_slice(
-                    c_layer["v_s"], vs, (0, 0, pos, 0)
-                ),
-            }
-            kf = dequantize(c_layer["k"], c_layer["k_s"])
-            vf = dequantize(c_layer["v"], c_layer["v_s"], c.dtype)
-        else:
-            c_layer = {
-                "k": lax.dynamic_update_slice(c_layer["k"], k_new, (0, 0, pos, 0)),
-                "v": lax.dynamic_update_slice(c_layer["v"], v_new, (0, 0, pos, 0)),
-            }
-            kf = c_layer["k"].astype(jnp.float32)
-            vf = c_layer["v"]
+        kq, ks = quantize(k_new)
+        vq, vs = quantize(v_new)
+        c_layer = {
+            "k": lax.dynamic_update_slice(c_layer["k"], kq, (0, 0, pos, 0)),
+            "v": lax.dynamic_update_slice(c_layer["v"], vq, (0, 0, pos, 0)),
+            "k_s": lax.dynamic_update_slice(
+                c_layer["k_s"], ks, (0, 0, pos, 0)
+            ),
+            "v_s": lax.dynamic_update_slice(
+                c_layer["v_s"], vs, (0, 0, pos, 0)
+            ),
+        }
+        kf = dequantize(c_layer["k"], c_layer["k_s"])
+        vf = dequantize(c_layer["v"], c_layer["v_s"], c.dtype)
 
         # grouped-query decode: q regrouped [B, kvh, rep, Dh] so the einsums
         # broadcast over the compact cache — the decode step is KV-cache-
@@ -629,6 +626,95 @@ def decode_step(
             up = jnp.einsum("bld,df->blf", y, layer["w_up"].astype(c.dtype))
             mlp = jnp.einsum(
                 "blf,fd->bld", jax.nn.silu(gate) * up, layer["w_down"].astype(c.dtype)
+            )
+        h = h + mlp
+        return h, c_layer
+
+    h, cache = lax.scan(layer_step, h, (params["layers"], cache))
+    h = rms_norm(h, params["ln_f"])
+    logits = jnp.einsum("bld,dv->blv", h, params["lm_head"].astype(c.dtype))
+    return logits.astype(jnp.float32), cache
+
+
+def decode_window(
+    params: Params,
+    tokens: jax.Array,  # [B, W] int32 — W consecutive tokens
+    pos0: jax.Array,  # scalar int32: position of tokens[:, 0]
+    cache: dict,  # init_decode_cache layout
+    config: TransformerConfig,
+) -> tuple[jax.Array, dict]:
+    """Multi-token cached decode: like ``decode_step`` but for a window of
+    ``W`` consecutive tokens at positions ``pos0..pos0+W-1`` — one forward
+    over the window with causal masking against the (updated) cache. This
+    is speculative decoding's verify step: the target model scores a
+    drafted window in ONE pass instead of W sequential steps.
+
+    Static shapes throughout (W is static; ``pos0`` is dynamic); the
+    bf16 cache layout only (the int8 path quantizes per token row — use
+    ``decode_step`` for it).
+    """
+    c = config
+    if c.kv_cache_dtype != "bf16":
+        raise NotImplementedError(
+            "decode_window supports the bf16 cache layout; speculative "
+            "decoding with int8 caches would quantize the window per row"
+        )
+    B, W = tokens.shape
+    max_len = cache["k"].shape[3]
+    positions = pos0 + jnp.arange(W, dtype=jnp.int32)[None, :]  # [1, W]
+    positions = jnp.broadcast_to(positions, (B, W))
+
+    h = params["embed"].astype(c.dtype)[tokens]  # [B, W, D]
+
+    def layer_step(h, scanned):
+        layer, c_layer = scanned
+        x = rms_norm(h, layer["ln1"])
+        dh, nh, kvh = c.head_dim, c.n_heads, c.kv_heads
+
+        def proj(w, heads):
+            out = jnp.einsum("bld,dk->blk", x, w.astype(c.dtype))
+            return out.reshape(B, W, heads, dh).transpose(0, 2, 1, 3)
+
+        q = rope(proj(layer["wq"], nh), positions, c.rope_theta)  # [B,nh,W,Dh]
+        k_new = rope(proj(layer["wk"], kvh), positions, c.rope_theta)
+        v_new = proj(layer["wv"], kvh)
+        c_layer = {
+            "k": lax.dynamic_update_slice(c_layer["k"], k_new, (0, 0, pos0, 0)),
+            "v": lax.dynamic_update_slice(c_layer["v"], v_new, (0, 0, pos0, 0)),
+        }
+
+        rep = nh // kvh
+        qg = q.reshape(B, kvh, rep, W, dh).astype(jnp.float32)
+        kf = c_layer["k"].astype(jnp.float32)
+        scores = jnp.einsum("bgrwd,bgsd->bgrws", qg, kf) / math.sqrt(dh)
+        # row w (position pos0+w) sees cache positions s <= pos0+w
+        visible = (
+            jnp.arange(max_len)[None, :] <= (pos0 + jnp.arange(W))[:, None]
+        )  # [W, max]
+        scores = jnp.where(
+            visible[None, None, None, :, :], scores, -jnp.inf
+        )
+        weights = jax.nn.softmax(scores, axis=-1).astype(c.dtype)
+        attn = jnp.einsum("bgrws,bgsd->bgrwd", weights, c_layer["v"])
+        attn = attn.transpose(0, 3, 1, 2, 4).reshape(B, W, nh * dh)
+        h = h + jnp.einsum("blk,kd->bld", attn, layer["wo"].astype(c.dtype))
+
+        y = rms_norm(h, layer["ln2"])
+        if c.n_experts:
+            from bee_code_interpreter_tpu.models.moe import moe_mlp
+
+            mlp, _ = moe_mlp(
+                layer["moe"], y,
+                n_experts=c.n_experts, top_k=c.moe_top_k,
+                capacity_factor=c.moe_capacity_factor, dtype=c.dtype,
+                group_size=c.moe_group_size,
+            )
+        else:
+            gate = jnp.einsum("bld,df->blf", y, layer["w_gate"].astype(c.dtype))
+            up = jnp.einsum("bld,df->blf", y, layer["w_up"].astype(c.dtype))
+            mlp = jnp.einsum(
+                "blf,fd->bld", jax.nn.silu(gate) * up,
+                layer["w_down"].astype(c.dtype),
             )
         h = h + mlp
         return h, c_layer

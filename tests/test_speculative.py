@@ -1,0 +1,128 @@
+"""Speculative decoding: exactness vs target-greedy for any draft."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from bee_code_interpreter_tpu.models import transformer as T
+from bee_code_interpreter_tpu.models.speculative import speculative_generate
+
+
+def cfg(**kw):
+    return dataclasses.replace(
+        T.TransformerConfig.tiny(), dtype=jnp.float32, **kw
+    )
+
+
+def test_perfect_draft_matches_target_greedy():
+    # draft == target: every proposal is accepted; output must equal the
+    # target's own greedy decode exactly.
+    config = cfg()
+    params = T.init_params(config, jax.random.PRNGKey(0))
+    prompt = jax.random.randint(jax.random.PRNGKey(1), (2, 6), 0, config.vocab_size)
+
+    want = T.Transformer(config).generate_cached(params, prompt, max_new_tokens=9)
+    got = speculative_generate(
+        params, config, params, config, prompt, max_new_tokens=9, gamma=3
+    )
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_unrelated_draft_still_exact():
+    # Exactness is draft-independent: a random different-architecture draft
+    # (fewer layers, different d_model) must yield the same tokens as the
+    # target's greedy decode — the draft only changes the round count.
+    config = cfg(n_kv_heads=2)
+    draft_config = cfg(n_layers=1, d_model=32, n_heads=2, d_ff=64)
+    params = T.init_params(config, jax.random.PRNGKey(0))
+    draft_params = T.init_params(draft_config, jax.random.PRNGKey(42))
+    prompt = jax.random.randint(jax.random.PRNGKey(2), (2, 5), 0, config.vocab_size)
+
+    want = T.Transformer(config).generate_cached(params, prompt, max_new_tokens=8)
+    for gamma in (1, 2, 4):
+        got = speculative_generate(
+            params, config, draft_params, draft_config, prompt,
+            max_new_tokens=8, gamma=gamma,
+        )
+        np.testing.assert_array_equal(
+            np.asarray(got), np.asarray(want), err_msg=f"gamma={gamma}"
+        )
+
+
+def test_single_token_and_window_overrun():
+    # max_new_tokens smaller than gamma exercises the padded-buffer path
+    # (fixed-width window writes near the end of the buffer).
+    config = cfg()
+    params = T.init_params(config, jax.random.PRNGKey(0))
+    prompt = jax.random.randint(jax.random.PRNGKey(3), (1, 4), 0, config.vocab_size)
+    want = T.Transformer(config).generate_cached(params, prompt, max_new_tokens=2)
+    got = speculative_generate(
+        params, config, params, config, prompt, max_new_tokens=2, gamma=5
+    )
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_vocab_mismatch_rejected():
+    config = cfg()
+    draft_config = cfg(vocab_size=128)
+    params = T.init_params(config, jax.random.PRNGKey(0))
+    draft_params = T.init_params(draft_config, jax.random.PRNGKey(1))
+    with pytest.raises(ValueError, match="share a vocabulary"):
+        speculative_generate(
+            params, config, draft_params, draft_config,
+            jnp.zeros((1, 4), jnp.int32),
+        )
+
+
+def test_decode_window_matches_sequential_steps():
+    # The verify primitive itself: one W-token window forward must equal W
+    # sequential decode_steps (same cache evolution, same logits).
+    config = cfg(n_kv_heads=2)
+    params = T.init_params(config, jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(4), (2, 12), 0, config.vocab_size)
+    L_pre, W = 6, 4
+
+    _, (k_pre, v_pre) = T.forward(params, tokens[:, :L_pre], config, return_kv=True)
+    cache_a = T.init_decode_cache(config, 2, 16, k_pre, v_pre)
+    cache_b = jax.tree.map(jnp.copy, cache_a)
+
+    win_logits, cache_a = T.decode_window(
+        params, tokens[:, L_pre : L_pre + W], jnp.int32(L_pre), cache_a, config
+    )
+    for i in range(W):
+        step_logits, cache_b = T.decode_step(
+            params, tokens[:, L_pre + i : L_pre + i + 1],
+            jnp.int32(L_pre + i), cache_b, config,
+        )
+        np.testing.assert_allclose(
+            np.asarray(win_logits[:, i]), np.asarray(step_logits[:, 0]),
+            atol=1e-4, rtol=1e-4, err_msg=f"row {i}",
+        )
+    for a, b in zip(jax.tree.leaves(cache_a), jax.tree.leaves(cache_b)):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-5, rtol=1e-5
+        )
+
+
+def test_moe_target_rejected():
+    config = dataclasses.replace(cfg(), n_experts=4)
+    params = T.init_params(config, jax.random.PRNGKey(0))
+    draft = cfg()
+    draft_params = T.init_params(draft, jax.random.PRNGKey(1))
+    with pytest.raises(NotImplementedError, match="dense target"):
+        speculative_generate(
+            params, config, draft_params, draft,
+            jnp.zeros((1, 4), jnp.int32),
+        )
+
+
+def test_int8_target_cache_rejected_early():
+    config = dataclasses.replace(cfg(), kv_cache_dtype="int8")
+    params = T.init_params(config, jax.random.PRNGKey(0))
+    with pytest.raises(NotImplementedError, match="bf16 target cache"):
+        speculative_generate(
+            params, config, params, config, jnp.zeros((1, 4), jnp.int32),
+        )
