@@ -7,7 +7,12 @@ which the draft matched its own greedy choice, plus the target's correction
 token. Greedy verification is **exact**: the output equals the target's own
 greedy decode token-for-token, for ANY draft — the draft only changes how
 many target forwards are needed (pinned by tests/test_speculative.py with
-both a perfect draft and an unrelated random draft).
+both a perfect draft and an unrelated random draft). One caveat: "the
+target's greedy decode" here means argmax of the window forward's logits,
+which agree with single-step decode only up to rounding (same math,
+different contraction shapes); at f32 the difference is ~1e-6 and argmax
+flips are vanishing, at bf16 a near-tied argmax can land differently —
+rounding noise, not an algorithmic divergence.
 
 TPU-first mechanics:
 

@@ -332,24 +332,33 @@ def _layer_apply(
     h = h + constrain(jnp.einsum("blk,kd->bld", attn, layer["wo"].astype(c.dtype)))
 
     y = rms_norm(h, layer["ln2"])
+    mlp, aux = _mlp_block(y, layer, c)
+    h = h + constrain(mlp)
+    return h, kv_out, aux
+
+
+def _mlp_block(
+    y: jax.Array, layer: Params, config: TransformerConfig
+) -> tuple[jax.Array, jax.Array]:
+    """The post-attention MLP (dense SwiGLU or MoE) — ONE copy shared by
+    _layer_apply, the int8 decode_step body, and decode_window. Returns
+    (mlp_out, aux) with aux = 0.0 for dense configs (decode paths drop it)."""
+    c = config
     if c.n_experts:
         from bee_code_interpreter_tpu.models.moe import moe_mlp
 
-        mlp, aux = moe_mlp(
+        return moe_mlp(
             layer["moe"], y,
             n_experts=c.n_experts, top_k=c.moe_top_k,
             capacity_factor=c.moe_capacity_factor, dtype=c.dtype,
             group_size=c.moe_group_size,
         )
-    else:
-        gate = jnp.einsum("bld,df->blf", y, layer["w_gate"].astype(c.dtype))
-        up = jnp.einsum("bld,df->blf", y, layer["w_up"].astype(c.dtype))
-        mlp = jnp.einsum(
-            "blf,fd->bld", jax.nn.silu(gate) * up, layer["w_down"].astype(c.dtype)
-        )
-        aux = jnp.float32(0.0)
-    h = h + constrain(mlp)
-    return h, kv_out, aux
+    gate = jnp.einsum("bld,df->blf", y, layer["w_gate"].astype(c.dtype))
+    up = jnp.einsum("bld,df->blf", y, layer["w_up"].astype(c.dtype))
+    mlp = jnp.einsum(
+        "blf,fd->bld", jax.nn.silu(gate) * up, layer["w_down"].astype(c.dtype)
+    )
+    return mlp, jnp.float32(0.0)
 
 
 def _batch_axes(mesh: Mesh | None):
@@ -612,21 +621,7 @@ def decode_step(
         h = h + jnp.einsum("blk,kd->bld", attn, layer["wo"].astype(c.dtype))
 
         y = rms_norm(h, layer["ln2"])
-        if c.n_experts:
-            from bee_code_interpreter_tpu.models.moe import moe_mlp
-
-            mlp, _ = moe_mlp(
-                layer["moe"], y,
-                n_experts=c.n_experts, top_k=c.moe_top_k,
-                capacity_factor=c.moe_capacity_factor, dtype=c.dtype,
-                group_size=c.moe_group_size,
-            )
-        else:
-            gate = jnp.einsum("bld,df->blf", y, layer["w_gate"].astype(c.dtype))
-            up = jnp.einsum("bld,df->blf", y, layer["w_up"].astype(c.dtype))
-            mlp = jnp.einsum(
-                "blf,fd->bld", jax.nn.silu(gate) * up, layer["w_down"].astype(c.dtype)
-            )
+        mlp, _ = _mlp_block(y, layer, c)
         h = h + mlp
         return h, c_layer
 
@@ -700,22 +695,7 @@ def decode_window(
         h = h + jnp.einsum("blk,kd->bld", attn, layer["wo"].astype(c.dtype))
 
         y = rms_norm(h, layer["ln2"])
-        if c.n_experts:
-            from bee_code_interpreter_tpu.models.moe import moe_mlp
-
-            mlp, _ = moe_mlp(
-                layer["moe"], y,
-                n_experts=c.n_experts, top_k=c.moe_top_k,
-                capacity_factor=c.moe_capacity_factor, dtype=c.dtype,
-                group_size=c.moe_group_size,
-            )
-        else:
-            gate = jnp.einsum("bld,df->blf", y, layer["w_gate"].astype(c.dtype))
-            up = jnp.einsum("bld,df->blf", y, layer["w_up"].astype(c.dtype))
-            mlp = jnp.einsum(
-                "blf,fd->bld", jax.nn.silu(gate) * up,
-                layer["w_down"].astype(c.dtype),
-            )
+        mlp, _ = _mlp_block(y, layer, c)
         h = h + mlp
         return h, c_layer
 

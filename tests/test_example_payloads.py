@@ -32,6 +32,13 @@ async def test_resnet_train_example(http_app):
     assert "img/s" in body["stdout"]
 
 
+async def test_speculative_decode_example(http_app):
+    source = (EXAMPLES / "speculative-decode.py").read_text()
+    body = await post_execute(http_app, {"source_code": source, "timeout": 600})
+    assert body["exit_code"] == 0, body["stderr"]
+    assert "exact-vs-greedy True" in body["stdout"]
+
+
 async def test_checkpoint_resume_example(http_app):
     # The checkpoint lands under /workspace, so the response's file map must
     # carry the checkpoint artifacts — that is the resume contract (pass the
