@@ -13,13 +13,18 @@ Design (idiomatic JAX/XLA, not a port of anything):
   (column-parallel wq/wk/wv/w_gate/w_up, row-parallel wo/w_down, replicated
   norms) over the mesh axes that exist; activations are constrained to
   P('dp', 'sp') on (batch, sequence). XLA inserts the all-reduces over ICI.
-- **Ring attention** (parallel/ring_attention.py) when the mesh has sp > 1:
-  attention runs inside shard_map with K/V rotating over the sp ring —
-  long-context is a first-class path, not a fallback. On sp == 1 meshes the
-  Pallas flash kernel (ops/flash_attention.py) is used on TPU.
+- **Sequence parallelism** when the mesh has sp > 1: the ppermute ring
+  (parallel/ring_attention.py — flash kernel per hop on TPU) or Ulysses
+  all-to-all (parallel/ulysses.py), per ``sp_attention`` — long-context is
+  a first-class path, not a fallback. On sp == 1 meshes the GQA-native
+  Pallas flash kernel (ops/flash_attention.py) runs directly on TPU.
 
-Components: RMSNorm, RoPE, grouped multi-head attention, SwiGLU MLP,
-next-token cross-entropy with z-loss, AdamW train step, greedy generation.
+Components: RMSNorm, RoPE, grouped multi-head attention (K/V never
+broadcast — compact through kernels, ring, decode), SwiGLU or MoE MLP
+(one ``_mlp_block``), next-token cross-entropy with z-loss, AdamW train
+step, pipelined forward, KV-cached decode (bf16 or int8 cache),
+temperature/top-k/top-p sampling, and the decode_window verify primitive
+behind models/speculative.py.
 """
 
 from __future__ import annotations

@@ -30,11 +30,17 @@ draft_params = T.init_params(draft_config, jax.random.PRNGKey(1))
 prompt = jax.random.randint(jax.random.PRNGKey(2), (1, 16), 0, config.vocab_size)
 n_new = 32 if on_tpu else 8
 
+def run():
+    return speculative_generate(
+        params, config, draft_params, draft_config, prompt,
+        max_new_tokens=n_new, gamma=4,
+    )
+
+spec = run()  # warm: trace + compile happens here, not in the timed call
+jax.block_until_ready(spec)
 t0 = time.time()
-spec = speculative_generate(
-    params, config, draft_params, draft_config, prompt,
-    max_new_tokens=n_new, gamma=4,
-)
+spec = run()
+jax.block_until_ready(spec)
 spec_s = time.time() - t0
 
 greedy = T.Transformer(config).generate_cached(params, prompt, max_new_tokens=n_new)
