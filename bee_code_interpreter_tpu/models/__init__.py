@@ -22,3 +22,4 @@ from bee_code_interpreter_tpu.models.vit import (  # noqa: F401
 from bee_code_interpreter_tpu.models.speculative import (  # noqa: F401
     speculative_generate,
 )
+from bee_code_interpreter_tpu.models.beam import beam_search  # noqa: F401

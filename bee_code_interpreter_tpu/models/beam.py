@@ -1,0 +1,110 @@
+"""Beam-search decoding over the KV-cached decode path.
+
+Completes the decode-API family (greedy / sampled / speculative / beam).
+TPU-first mechanics: beams ride the batch dimension — the cache is tiled to
+``B·W`` rows once after prefill, every step is one ``decode_step`` over all
+beams, and beam reordering is a batched gather on the cache's batch axis
+(``jnp.take``; the standard trade — exact search bookkeeping for one
+gather's worth of HBM traffic per step). The whole loop is a ``lax.scan``
+with static shapes; ``beam_size=1`` degenerates to greedy and is pinned
+token-exact against ``generate_cached`` by tests/test_beam.py.
+
+No EOS semantics: the framework is tokenizer-free (sandboxed users bring
+their own vocabulary), so beams are compared by total log-probability at a
+fixed length. Length-normalization (``length_penalty``) divides by
+``(new_tokens)**alpha`` at the final ranking only, the common simple form.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from bee_code_interpreter_tpu.models.transformer import (
+    TransformerConfig,
+    decode_step,
+    forward,
+    init_decode_cache,
+)
+
+
+def beam_search(
+    params,
+    config: TransformerConfig,
+    prompt: jax.Array,  # [B, L] int32
+    max_new_tokens: int = 32,
+    beam_size: int = 4,
+    length_penalty: float = 0.0,
+    return_all: bool = False,
+):
+    """Highest-log-prob continuation under beam search.
+
+    Returns [B, L + max_new_tokens] (the best beam), or with
+    ``return_all`` a tuple of ([B, W, L + max_new_tokens] sequences sorted
+    best-first, [B, W] scores).
+    """
+    c = config
+    W = beam_size
+    if W < 1:
+        raise ValueError(f"beam_size must be >= 1, got {beam_size}")
+    if max_new_tokens < 1:
+        # 0 would silently drop the first-token scatter (OOB writes are
+        # dropped under jit) and make length_penalty divide by zero
+        raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
+    B, L = prompt.shape
+    total = L + max_new_tokens
+
+    logits, (k_pre, v_pre) = forward(params, prompt, c, return_kv=True)
+    cache = init_decode_cache(c, B, total, k_pre, v_pre)
+    # beams ride the batch dim: tile cache rows B -> B*W (beam-major per row)
+    cache = jax.tree.map(
+        lambda x: jnp.repeat(x, W, axis=1), cache
+    )  # leaves [n_layers, B*W, ...]
+
+    # first expansion: top-W distinct first tokens per row
+    lp0 = jax.nn.log_softmax(logits[:, L - 1, :], axis=-1)  # [B, V]
+    scores, first = lax.top_k(lp0, W)  # [B, W]
+    seqs = jnp.zeros((B, W, total), jnp.int32)
+    seqs = seqs.at[:, :, :L].set(prompt[:, None, :])
+    seqs = seqs.at[:, :, L].set(first)
+    current = first.reshape(B * W, 1)
+
+    V = c.vocab_size
+
+    def step(carry, pos):
+        seqs, scores, current, cache = carry
+        step_logits, cache = decode_step(params, current, pos, cache, c)
+        lp = jax.nn.log_softmax(step_logits[:, 0, :], axis=-1)  # [B*W, V]
+        joint = scores[:, :, None] + lp.reshape(B, W, V)  # [B, W, V]
+        scores, flat = lax.top_k(joint.reshape(B, W * V), W)  # [B, W]
+        beam_idx = flat // V  # [B, W] which parent beam
+        token = (flat % V).astype(jnp.int32)
+
+        # reorder histories and caches to the winning parents
+        seqs = jnp.take_along_axis(seqs, beam_idx[:, :, None], axis=1)
+        seqs = seqs.at[:, :, pos + 1].set(token)
+        flat_parent = (
+            jnp.arange(B, dtype=jnp.int32)[:, None] * W + beam_idx
+        ).reshape(B * W)
+        cache = jax.tree.map(
+            lambda x: jnp.take(x, flat_parent, axis=1), cache
+        )
+        return (seqs, scores, token.reshape(B * W, 1), cache), None
+
+    (seqs, scores, _, _), _ = lax.scan(
+        step,
+        (seqs, scores, current, cache),
+        jnp.arange(L, total - 1, dtype=jnp.int32),
+    )
+
+    if length_penalty:
+        ranked = scores / (max_new_tokens ** length_penalty)
+    else:
+        ranked = scores
+    order = jnp.argsort(-ranked, axis=1)  # best first
+    seqs = jnp.take_along_axis(seqs, order[:, :, None], axis=1)
+    scores = jnp.take_along_axis(ranked, order, axis=1)
+    if return_all:
+        return seqs, scores
+    return seqs[:, 0]
