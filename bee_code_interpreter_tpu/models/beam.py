@@ -11,8 +11,9 @@ token-exact against ``generate_cached`` by tests/test_beam.py.
 
 No EOS semantics: the framework is tokenizer-free (sandboxed users bring
 their own vocabulary), so beams are compared by total log-probability at a
-fixed length. Length-normalization (``length_penalty``) divides by
-``(new_tokens)**alpha`` at the final ranking only, the common simple form.
+fixed length — which is also why there is no length-penalty knob: with
+every beam the same length it could only rescale all scores by one
+constant, never change the ranking.
 """
 
 from __future__ import annotations
@@ -35,7 +36,6 @@ def beam_search(
     prompt: jax.Array,  # [B, L] int32
     max_new_tokens: int = 32,
     beam_size: int = 4,
-    length_penalty: float = 0.0,
     return_all: bool = False,
 ):
     """Highest-log-prob continuation under beam search.
@@ -45,12 +45,21 @@ def beam_search(
     best-first, [B, W] scores).
     """
     c = config
+    if c.n_experts:
+        # capacity-based MoE routes all B·W beam rows in one competing pool,
+        # so a beam's tokens/score would depend on which sibling beams share
+        # the batch and the score-equals-rescoring pin breaks — same
+        # routing-pool-size hazard speculative_generate refuses
+        raise NotImplementedError(
+            "beam_search requires a dense config (MoE routing pools couple "
+            "sibling beams); use Transformer.generate_cached for MoE"
+        )
     W = beam_size
     if W < 1:
         raise ValueError(f"beam_size must be >= 1, got {beam_size}")
     if max_new_tokens < 1:
         # 0 would silently drop the first-token scatter (OOB writes are
-        # dropped under jit) and make length_penalty divide by zero
+        # dropped under jit) and return scores for a token not in the output
         raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
     B, L = prompt.shape
     total = L + max_new_tokens
@@ -98,13 +107,9 @@ def beam_search(
         jnp.arange(L, total - 1, dtype=jnp.int32),
     )
 
-    if length_penalty:
-        ranked = scores / (max_new_tokens ** length_penalty)
-    else:
-        ranked = scores
-    order = jnp.argsort(-ranked, axis=1)  # best first
+    order = jnp.argsort(-scores, axis=1)  # best first
     seqs = jnp.take_along_axis(seqs, order[:, :, None], axis=1)
-    scores = jnp.take_along_axis(ranked, order, axis=1)
+    scores = jnp.take_along_axis(scores, order, axis=1)
     if return_all:
         return seqs, scores
     return seqs[:, 0]

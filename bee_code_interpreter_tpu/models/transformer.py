@@ -710,6 +710,71 @@ def decode_window(
     return logits.astype(jnp.float32), cache
 
 
+def prefill_chunked(
+    params: Params,
+    prompt: jax.Array,  # [B, L] int32
+    config: TransformerConfig,
+    total_len: int,
+    chunk: int = 512,
+) -> tuple[jax.Array, dict]:
+    """Build the decode cache by streaming the prompt through
+    ``decode_window`` in fixed-size chunks instead of one O(L²) forward —
+    activation memory is bounded by the chunk (attention scores are
+    [B, H, chunk, L] instead of [B, H, L, L]), the standard long-prompt
+    prefill. Returns (last-position logits [B, vocab], cache) — exactly
+    what starting decode needs; per-chunk causality is decode_window's
+    position masking, so the result is pinned equal to the full forward
+    (tests/test_chunked_prefill.py).
+
+    Full chunks run under one ``lax.scan`` (one compile); a static
+    remainder chunk (L % chunk) adds at most one more.
+    """
+    c = config
+    if c.kv_cache_dtype != "bf16":
+        raise NotImplementedError(
+            "prefill_chunked builds the bf16 cache layout (decode_window)"
+        )
+    B, L = prompt.shape
+    if total_len < L:
+        # an undersized cache would be silently corrupted: clamped
+        # dynamic_update_slice writes shift later chunks onto earlier rows
+        raise ValueError(
+            f"total_len ({total_len}) must cover the prompt length ({L})"
+        )
+    shape = (c.n_layers, B, c.kv_heads, total_len, c.head_dim)
+    cache = {
+        "k": jnp.zeros(shape, c.dtype),
+        "v": jnp.zeros(shape, c.dtype),
+    }
+
+    n_full, rem = divmod(L, chunk)
+    last_logits = None
+    if n_full:
+        chunks = prompt[:, : n_full * chunk].reshape(B, n_full, chunk)
+
+        def body(cache, x):
+            toks, pos0 = x
+            logits, cache = decode_window(params, toks, pos0, cache, c)
+            return cache, logits[:, -1, :]
+
+        cache, last_per_chunk = lax.scan(
+            body,
+            cache,
+            (
+                chunks.transpose(1, 0, 2),  # [n_full, B, chunk]
+                jnp.arange(n_full, dtype=jnp.int32) * chunk,
+            ),
+        )
+        last_logits = last_per_chunk[-1]
+    if rem:
+        logits, cache = decode_window(
+            params, prompt[:, n_full * chunk :], jnp.int32(n_full * chunk),
+            cache, c,
+        )
+        last_logits = logits[:, -1, :]
+    return last_logits, cache
+
+
 # ----------------------------------------------------------------- sampling
 
 
@@ -849,13 +914,17 @@ class Transformer:
         top_k: int | None = None,
         top_p: float | None = None,
         key: jax.Array | None = None,
+        eos_id: int | None = None,
     ) -> jax.Array:
         """KV-cached decode: one O(L^2) prefill, then ``max_new_tokens - 1``
         O(L) incremental steps (decode_step). Default is greedy
         (``temperature=0``) and pinned equal to ``generate`` by
         tests/test_models.py; ``temperature``/``top_k``/``top_p`` select
         sampled decoding (``sample_logits``; ``key`` defaults to PRNGKey(0)
-        and is split per step, so a fixed key is fully deterministic). For
+        and is split per step, so a fixed key is fully deterministic).
+        ``eos_id`` freezes a row once it emits that token — every later
+        position repeats ``eos_id`` (static shapes: the loop always runs
+        ``max_new_tokens`` steps; finished rows just stop changing). For
         MoE configs greedy equality holds only drop-free (ample capacity):
         under capacity pressure the full forward routes tokens in
         competition while decode routes each token alone — inherent to
@@ -881,19 +950,27 @@ class Transformer:
             .at[:, L : L + 1].set(first)
         )
 
+        done0 = (
+            (first == eos_id) if eos_id is not None
+            else jnp.zeros_like(first, dtype=bool)
+        )
+
         def step(carry, pos):
-            tokens, current, cache, key = carry
+            tokens, current, cache, key, done = carry
             step_logits, cache = decode_step(params, current, pos, cache, c)
             key, sub = jax.random.split(key)
             next_tok = sample_logits(
                 step_logits[:, -1, :], sub, temperature, top_k, top_p
             )
+            if eos_id is not None:
+                next_tok = jnp.where(done, jnp.int32(eos_id), next_tok)
+                done = done | (next_tok == eos_id)
             tokens = lax.dynamic_update_slice(tokens, next_tok, (0, pos + 1))
-            return (tokens, next_tok, cache, key), None
+            return (tokens, next_tok, cache, key, done), None
 
-        (tokens, _, _, _), _ = lax.scan(
+        (tokens, _, _, _, _), _ = lax.scan(
             step,
-            (tokens, first, cache, key),
+            (tokens, first, cache, key, done0),
             jnp.arange(L, total - 1, dtype=jnp.int32),
         )
         return tokens

@@ -73,20 +73,11 @@ def test_beam_size_validated():
         beam_search(params, config, jnp.zeros((1, 4), jnp.int32), beam_size=0)
 
 
-def test_length_penalty_rescales_ranking():
-    config = cfg()
+def test_moe_config_rejected():
+    config = dataclasses.replace(cfg(), n_experts=4)
     params = T.init_params(config, jax.random.PRNGKey(0))
-    prompt = jax.random.randint(jax.random.PRNGKey(4), (1, 4), 0, config.vocab_size)
-    _, raw = beam_search(
-        params, config, prompt, max_new_tokens=4, beam_size=2, return_all=True
-    )
-    _, pen = beam_search(
-        params, config, prompt, max_new_tokens=4, beam_size=2,
-        length_penalty=1.0, return_all=True,
-    )
-    np.testing.assert_allclose(
-        np.asarray(pen), np.asarray(raw) / 4.0, atol=1e-5, rtol=1e-5
-    )
+    with pytest.raises(NotImplementedError, match="dense config"):
+        beam_search(params, config, jnp.zeros((1, 4), jnp.int32))
 
 
 def test_zero_max_new_tokens_rejected():

@@ -1,0 +1,92 @@
+"""Chunked prefill and EOS stop tokens."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from bee_code_interpreter_tpu.models import transformer as T
+
+
+def cfg(**kw):
+    return dataclasses.replace(T.TransformerConfig.tiny(), dtype=jnp.float32, **kw)
+
+
+@pytest.mark.parametrize("L,chunk", [(24, 8), (20, 8), (7, 16), (16, 16)])
+def test_chunked_prefill_matches_full_forward(L, chunk):
+    # cache + final logits must equal the one-shot forward, across exact
+    # multiples, a remainder chunk, and a single partial chunk.
+    config = cfg(n_kv_heads=2)
+    params = T.init_params(config, jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, L), 0, config.vocab_size)
+    total = L + 4
+
+    logits_full, (k_pre, v_pre) = T.forward(params, tokens, config, return_kv=True)
+    want_cache = T.init_decode_cache(config, 2, total, k_pre, v_pre)
+
+    last, cache = T.prefill_chunked(params, tokens, config, total, chunk=chunk)
+    np.testing.assert_allclose(
+        np.asarray(last), np.asarray(logits_full[:, -1, :]), atol=1e-4, rtol=1e-4
+    )
+    for a, b in zip(jax.tree.leaves(cache), jax.tree.leaves(want_cache)):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-4, rtol=1e-4
+        )
+
+
+def test_chunked_prefill_then_decode_matches_generate():
+    # End-to-end: seed the cache chunked, then greedy-decode with
+    # decode_step — tokens must match generate_cached (whole-prompt prefill).
+    config = cfg()
+    params = T.init_params(config, jax.random.PRNGKey(0))
+    prompt = jax.random.randint(jax.random.PRNGKey(2), (1, 10), 0, config.vocab_size)
+    n_new = 5
+    want = T.Transformer(config).generate_cached(params, prompt, n_new)
+
+    last, cache = T.prefill_chunked(
+        params, prompt, config, 10 + n_new, chunk=4
+    )
+    tok = jnp.argmax(last, axis=-1).astype(jnp.int32)[:, None]
+    out = [tok]
+    for i in range(n_new - 1):
+        lg, cache = T.decode_step(params, tok, jnp.int32(10 + i), cache, config)
+        tok = jnp.argmax(lg[:, -1, :], axis=-1).astype(jnp.int32)[:, None]
+        out.append(tok)
+    got = jnp.concatenate(out, axis=1)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want[:, 10:]))
+
+
+def test_eos_freezes_row():
+    # Pick eos_id = the token greedy emits at step 3; everything after must
+    # repeat it, while the pre-EOS prefix is unchanged.
+    config = cfg()
+    params = T.init_params(config, jax.random.PRNGKey(0))
+    prompt = jax.random.randint(jax.random.PRNGKey(3), (1, 5), 0, config.vocab_size)
+    free = T.Transformer(config).generate_cached(params, prompt, 8)
+    eos = int(free[0, 5 + 2])  # the 3rd generated token
+
+    out = T.Transformer(config).generate_cached(params, prompt, 8, eos_id=eos)
+    got = np.asarray(out[0, 5:])
+    want_prefix = np.asarray(free[0, 5 : 5 + 3])  # up to and incl. the eos
+    np.testing.assert_array_equal(got[:3], want_prefix)
+    assert (got[2:] == eos).all(), got
+
+
+def test_eos_in_first_token():
+    config = cfg()
+    params = T.init_params(config, jax.random.PRNGKey(0))
+    prompt = jax.random.randint(jax.random.PRNGKey(4), (1, 5), 0, config.vocab_size)
+    free = T.Transformer(config).generate_cached(params, prompt, 6)
+    eos = int(free[0, 5])  # the very first generated token
+    out = T.Transformer(config).generate_cached(params, prompt, 6, eos_id=eos)
+    assert (np.asarray(out[0, 5:]) == eos).all()
+
+
+def test_undersized_total_len_rejected():
+    config = cfg()
+    params = T.init_params(config, jax.random.PRNGKey(0))
+    prompt = jnp.zeros((1, 12), jnp.int32)
+    with pytest.raises(ValueError, match="must cover the prompt"):
+        T.prefill_chunked(params, prompt, config, total_len=8, chunk=4)
