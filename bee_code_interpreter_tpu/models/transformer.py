@@ -74,6 +74,11 @@ class TransformerConfig:
     # per-token/head absmax quantization, ops/kv_cache.py — halves the bytes
     # the bandwidth-bound decode loop streams per step).
     kv_cache_dtype: str = "bf16"
+    # Sliding-window attention (Mistral-style): each query attends only the
+    # last `sliding_window` positions. None = full causal attention. The
+    # flash kernels skip fully-out-of-window blocks; single-shard/tp meshes
+    # only (the sp ring/Ulysses paths don't thread the window).
+    sliding_window: int | None = None
 
     @property
     def kv_heads(self) -> int:
@@ -228,17 +233,17 @@ def shard_params(params: Params, config: TransformerConfig, mesh: Mesh) -> Param
 # ------------------------------------------------------------------- forward
 
 
-def _local_attention(q, k, v, causal: bool = True):
+def _local_attention(q, k, v, causal: bool = True, window: int | None = None):
     """Single-shard attention — the shared ops-level platform dispatch
     (Pallas flash on TPU, reference elsewhere; GQA-native)."""
     from bee_code_interpreter_tpu.ops.flash_attention import local_attention
 
-    return local_attention(q, k, v, causal=causal)
+    return local_attention(q, k, v, causal=causal, window=window)
 
 
 def _attention(
     q, k, v, mesh: Mesh | None, sp_attention: str = "ring",
-    causal: bool = True,
+    causal: bool = True, window: int | None = None,
 ):
     """Attention (causal by default; ``causal=False`` for encoders — the
     ViT path); q [B, H, L, D], k/v [B, KVH, L, D] (KVH ≤ H).
@@ -257,10 +262,15 @@ def _attention(
             f"sp_attention must be 'ring' or 'ulysses', got {sp_attention!r}"
         )
     if mesh is None:
-        return _local_attention(q, k, v, causal)
+        return _local_attention(q, k, v, causal, window)
     axes = mesh.axis_names
     tp = "tp" if "tp" in axes else None
     has_sp = "sp" in axes and mesh.shape["sp"] > 1
+    if window is not None and has_sp:
+        raise NotImplementedError(
+            "sliding_window is not threaded through the sp ring/Ulysses "
+            "paths; use a dp/fsdp/tp mesh"
+        )
     sp = "sp" if has_sp else None
     if tp is not None and k.shape[1] % mesh.shape["tp"] != 0:
         # KV heads don't split over tp: broadcast up — but only to
@@ -288,7 +298,7 @@ def _attention(
                 ring_attention, axis_name="sp", causal=causal
             )
     else:
-        local = functools.partial(_local_attention, causal=causal)
+        local = functools.partial(_local_attention, causal=causal, window=window)
     # pallas_call under shard_map's vma checking hits a jax-internal lowering
     # limitation (see tests/test_parallel.py flash-ring cases); every
     # uses_flash() branch here runs the kernel (local, flash-hop ring, or
@@ -332,7 +342,7 @@ def _layer_apply(
     v = proj(layer["wv"], kvh)
     kv_out = (k, v) if return_kv else None
     # GQA-native: compact k/v go in as-is
-    attn = _attention(q, k, v, mesh, c.sp_attention)
+    attn = _attention(q, k, v, mesh, c.sp_attention, window=c.sliding_window)
     attn = attn.transpose(0, 2, 1, 3).reshape(B, L, nh * dh)
     h = h + constrain(jnp.einsum("blk,kd->bld", attn, layer["wo"].astype(c.dtype)))
 
@@ -619,6 +629,8 @@ def decode_step(
         qg = q[:, :, 0, :].reshape(B, kvh, rep, dh).astype(jnp.float32)
         scores = jnp.einsum("bgrd,bgsd->bgrs", qg, kf) / math.sqrt(dh)
         visible = jnp.arange(max_len) <= pos  # [max]
+        if c.sliding_window is not None:
+            visible &= jnp.arange(max_len) > pos - c.sliding_window
         scores = jnp.where(visible[None, None, None, :], scores, -jnp.inf)
         weights = jax.nn.softmax(scores, axis=-1).astype(vf.dtype)
         attn = jnp.einsum("bgrs,bgsd->bgrd", weights, vf)  # [B,kvh,rep,Dh]
@@ -687,10 +699,14 @@ def decode_window(
         qg = q.reshape(B, kvh, rep, W, dh).astype(jnp.float32)
         kf = c_layer["k"].astype(jnp.float32)
         scores = jnp.einsum("bgrwd,bgsd->bgrws", qg, kf) / math.sqrt(dh)
-        # row w (position pos0+w) sees cache positions s <= pos0+w
-        visible = (
-            jnp.arange(max_len)[None, :] <= (pos0 + jnp.arange(W))[:, None]
-        )  # [W, max]
+        # row w (position pos0+w) sees cache positions s <= pos0+w (and
+        # within the sliding window when configured)
+        row_pos = (pos0 + jnp.arange(W))[:, None]  # [W, 1]
+        visible = jnp.arange(max_len)[None, :] <= row_pos  # [W, max]
+        if c.sliding_window is not None:
+            visible &= (
+                jnp.arange(max_len)[None, :] > row_pos - c.sliding_window
+            )
         scores = jnp.where(
             visible[None, None, None, :, :], scores, -jnp.inf
         )

@@ -50,6 +50,7 @@ def _fwd_kernel(
     o_ref, lse_ref,       # [1, 1, blk_q, D], [1, 1, blk_q, 1]
     m_scratch, l_scratch, acc_scratch,  # VMEM f32: [blk_q,1],[blk_q,1],[blk_q,D]
     *, sm_scale: float, causal: bool, blk_q: int, blk_k: int, seq_len: int,
+    window: int | None = None,
 ):
     """Grid (B·KVH, rep, q-blocks, k-blocks): q is viewed [B·KVH, rep, L, D]
     (group-major head order) so grouped-query KV sharing is pure grid
@@ -70,10 +71,13 @@ def _fwd_kernel(
     q_start = i * blk_q
     k_start = j * blk_k
 
-    # causal: skip blocks where every key index > every query index
+    # causal: skip blocks where every key index > every query index;
+    # sliding window additionally skips blocks entirely below the window
     should_compute = True
     if causal:
         should_compute = k_start <= q_start + blk_q - 1
+    if window is not None:
+        should_compute &= k_start + blk_k - 1 >= q_start - (window - 1)
 
     @pl.when(should_compute)
     def _compute():
@@ -91,6 +95,8 @@ def _fwd_kernel(
         mask = col < seq_len  # padded keys never attend
         if causal:
             mask = mask & (row >= col)
+        if window is not None:
+            mask = mask & (row - col < window)
         scores = jnp.where(mask, scores, NEG_INF)
 
         m_prev = m_scratch[:]                      # [blk_q, 1]
@@ -161,7 +167,7 @@ def _padded_len(L: int, Lk: int, blk_q: int, blk_k: int) -> int:
     return unit * pl.cdiv(max(L, Lk), unit)
 
 
-def _flash_fwd(q, k, v, causal, sm_scale, blk_q, blk_k, interpret):
+def _flash_fwd(q, k, v, causal, sm_scale, blk_q, blk_k, interpret, window=None):
     B, H, L, D = q.shape
     KVH = k.shape[1]
     rep = H // KVH
@@ -177,7 +183,7 @@ def _flash_fwd(q, k, v, causal, sm_scale, blk_q, blk_k, interpret):
     grid = (B * KVH, rep, Lp // blk_q, Lp // blk_k)
     kernel = functools.partial(
         _fwd_kernel, sm_scale=sm_scale, causal=causal,
-        blk_q=blk_q, blk_k=blk_k, seq_len=Lk,
+        blk_q=blk_q, blk_k=blk_k, seq_len=Lk, window=window,
     )
     out, lse = pl.pallas_call(
         kernel,
@@ -262,7 +268,8 @@ def _attention_bwd_blockwise(q, k, v, o, lse, do, causal, sm_scale, blk_k):
 # ------------------------------------------------------------ pallas backward
 
 
-def _bwd_p_block(q, k, lse_col, row, col, *, sm_scale, causal, seq_len_q, seq_len_k):
+def _bwd_p_block(q, k, lse_col, row, col, *, sm_scale, causal, seq_len_q,
+                 seq_len_k, window=None):
     """Recompute the probability block P = exp(S - lse) with validity masking.
 
     Padded-row lse is garbage (the forward never normalized those rows), so P
@@ -275,6 +282,8 @@ def _bwd_p_block(q, k, lse_col, row, col, *, sm_scale, causal, seq_len_q, seq_le
     mask = (row < seq_len_q) & (col < seq_len_k)
     if causal:
         mask = mask & (row >= col)
+    if window is not None:
+        mask = mask & (row - col < window)
     p = jnp.where(mask, jnp.exp(scores - lse_col), 0.0)
     return p, mask
 
@@ -284,7 +293,7 @@ def _bwd_dkdv_kernel(
     dk_ref, dv_ref,
     dk_scratch, dv_scratch,  # VMEM f32 [blk_k, D]
     *, sm_scale: float, causal: bool, blk_q: int, blk_k: int,
-    seq_len_q: int, seq_len_k: int,
+    seq_len_q: int, seq_len_k: int, window: int | None = None,
 ):
     """Grid (B·KVH, k-blocks, rep, q-blocks): the two sequential dimensions
     run over the ``rep`` query heads sharing this KV head and their
@@ -307,6 +316,8 @@ def _bwd_dkdv_kernel(
     should_compute = True
     if causal:  # skip q-blocks entirely above the diagonal
         should_compute = q_start + blk_q - 1 >= k_start
+    if window is not None:  # skip q-blocks entirely above the window
+        should_compute &= q_start - (k_start + blk_k - 1) <= window - 1
 
     @pl.when(should_compute)
     def _compute():
@@ -317,7 +328,7 @@ def _bwd_dkdv_kernel(
         col = k_start + lax.broadcasted_iota(jnp.int32, (blk_q, blk_k), 1)
         p, _ = _bwd_p_block(
             q, k, lse_ref[0, 0], row, col, sm_scale=sm_scale, causal=causal,
-            seq_len_q=seq_len_q, seq_len_k=seq_len_k,
+            seq_len_q=seq_len_q, seq_len_k=seq_len_k, window=window,
         )
         dv_scratch[:] += jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())),  # pᵀ · dO -> [blk_k, D]
@@ -348,7 +359,7 @@ def _bwd_dq_kernel(
     dq_ref,
     dq_scratch,  # VMEM f32 [blk_q, D]
     *, sm_scale: float, causal: bool, blk_q: int, blk_k: int,
-    seq_len_q: int, seq_len_k: int,
+    seq_len_q: int, seq_len_k: int, window: int | None = None,
 ):
     """Grid (B·KVH, rep, q-blocks, k-blocks): k iterated sequentially, dQ
     for this q-block accumulates in VMEM across k steps. Division-free index
@@ -366,6 +377,8 @@ def _bwd_dq_kernel(
     should_compute = True
     if causal:
         should_compute = k_start <= q_start + blk_q - 1
+    if window is not None:  # skip k-blocks entirely below the window
+        should_compute &= k_start + blk_k - 1 >= q_start - (window - 1)
 
     @pl.when(should_compute)
     def _compute():
@@ -376,7 +389,7 @@ def _bwd_dq_kernel(
         col = k_start + lax.broadcasted_iota(jnp.int32, (blk_q, blk_k), 1)
         p, _ = _bwd_p_block(
             q, k, lse_ref[0, 0], row, col, sm_scale=sm_scale, causal=causal,
-            seq_len_q=seq_len_q, seq_len_k=seq_len_k,
+            seq_len_q=seq_len_q, seq_len_k=seq_len_k, window=window,
         )
         dp = jax.lax.dot_general(
             do, v_ref[0].astype(jnp.float32), (((1,), (1,)), ((), ())),
@@ -395,7 +408,7 @@ def _bwd_dq_kernel(
 
 def _flash_bwd_pallas(
     q, k, v, o, lse, do, causal, sm_scale, blk_q, blk_k, interpret,
-    H: int, KVH: int, g_lse=None,
+    H: int, KVH: int, g_lse=None, window=None,
 ):
     """dq, dk, dv via the two Pallas kernels. q/o/do/lse are [B·H, L, D];
     k/v are [B·KVH, Lk, D] (GQA when KVH < H); dk/dv come back compact.
@@ -442,7 +455,7 @@ def _flash_bwd_pallas(
     stat_spec = pl.BlockSpec((1, 1, blk_q, 1), lambda b, j, r, i: (b, r, i, 0))
     dkdv = functools.partial(
         _bwd_dkdv_kernel, sm_scale=sm_scale, causal=causal,
-        blk_q=blk_q, blk_k=blk_k, seq_len_q=L, seq_len_k=Lk,
+        blk_q=blk_q, blk_k=blk_k, seq_len_q=L, seq_len_k=Lk, window=window,
     )
     dk, dv = pl.pallas_call(
         dkdv,
@@ -468,7 +481,7 @@ def _flash_bwd_pallas(
     stat_spec2 = pl.BlockSpec((1, 1, blk_q, 1), lambda b, r, i, j: (b, r, i, 0))
     dqk = functools.partial(
         _bwd_dq_kernel, sm_scale=sm_scale, causal=causal,
-        blk_q=blk_q, blk_k=blk_k, seq_len_q=L, seq_len_k=Lk,
+        blk_q=blk_q, blk_k=blk_k, seq_len_q=L, seq_len_k=Lk, window=window,
     )
     dq = pl.pallas_call(
         dqk,
@@ -488,7 +501,7 @@ def _flash_bwd_pallas(
     return dq.reshape(BH, Lp, D)[:, :L], dk[:, :Lk], dv[:, :Lk]
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
 def flash_attention(
     q, k, v,
     causal: bool = True,
@@ -496,6 +509,7 @@ def flash_attention(
     block_q: int = 1024,
     block_k: int = 1024,
     interpret: bool | None = None,
+    window: int | None = None,
 ):
     """Flash attention over [B, H, L, D] tensors. Differentiable.
 
@@ -510,7 +524,9 @@ def flash_attention(
     clamp the block to the padded length. ``interpret=None`` auto-selects
     Pallas interpreter mode off-TPU.
     """
-    out, _ = _flash_fwd_rule(q, k, v, causal, sm_scale, block_q, block_k, interpret)
+    out, _ = _flash_fwd_rule(
+        q, k, v, causal, sm_scale, block_q, block_k, interpret, window
+    )
     return out
 
 
@@ -522,20 +538,28 @@ def _resolve(q, sm_scale, interpret):
     return sm_scale, interpret
 
 
-def _flash_fwd_rule(q, k, v, causal, sm_scale, block_q, block_k, interpret):
+def _flash_fwd_rule(q, k, v, causal, sm_scale, block_q, block_k, interpret,
+                    window=None):
     sm_scale, interpret = _resolve(q, sm_scale, interpret)
     B, H, L, D = q.shape
     KVH = k.shape[1]
     if H % KVH != 0:
         raise ValueError(f"n_heads {H} not a multiple of kv_heads {KVH}")
+    if window is not None:
+        if not causal:
+            raise ValueError("window requires causal=True (sliding window)")
+        if window < 1:
+            raise ValueError(f"window must be >= 1, got {window}")
     blk_q = min(block_q, _round_up(L))
     blk_k = min(block_k, _round_up(k.shape[2]))
-    out, lse = _flash_fwd(q, k, v, causal, sm_scale, blk_q, blk_k, interpret)
+    out, lse = _flash_fwd(
+        q, k, v, causal, sm_scale, blk_q, blk_k, interpret, window
+    )
     return out, (q, k, v, out, lse)
 
 
 def _bwd_impl(causal, sm_scale, block_q, block_k, interpret, residuals, g_out,
-              g_lse=None):
+              g_lse=None, window=None):
     """Shared backward plumbing for both VJP rules (g_lse is the lse
     cotangent of the with_lse variant; None for plain flash_attention)."""
     q, k, v, out, lse = residuals
@@ -554,6 +578,7 @@ def _bwd_impl(causal, sm_scale, block_q, block_k, interpret, residuals, g_out,
         out.reshape(B * H, L, D), lse, g_out.reshape(B * H, L, D),
         causal, sm_scale, blk_q, blk_k, interpret, H, KVH,
         g_lse=None if g_lse is None else g_lse.reshape(B * H, L),
+        window=window,
     )
     return (
         dq.reshape(B, H, L, D),
@@ -562,8 +587,10 @@ def _bwd_impl(causal, sm_scale, block_q, block_k, interpret, residuals, g_out,
     )
 
 
-def _flash_bwd_rule(causal, sm_scale, block_q, block_k, interpret, residuals, g):
-    return _bwd_impl(causal, sm_scale, block_q, block_k, interpret, residuals, g)
+def _flash_bwd_rule(causal, sm_scale, block_q, block_k, interpret, window,
+                    residuals, g):
+    return _bwd_impl(causal, sm_scale, block_q, block_k, interpret, residuals,
+                     g, window=window)
 
 
 flash_attention.defvjp(_flash_fwd_rule, _flash_bwd_rule)
@@ -622,7 +649,7 @@ def uses_flash() -> bool:
     return jax.devices()[0].platform == "tpu"
 
 
-def local_attention(q, k, v, causal: bool = True):
+def local_attention(q, k, v, causal: bool = True, window: int | None = None):
     """Single-device attention with platform dispatch: the Pallas flash
     kernel on TPU, the dense reference elsewhere (CPU tests). Both are
     GQA-native (K/V may carry fewer heads than q). The ONE home for this
@@ -630,9 +657,9 @@ def local_attention(q, k, v, causal: bool = True):
     through it, so backend policy can't silently diverge between the
     sp-attention strategies."""
     if uses_flash():
-        return flash_attention(q, k, v, causal)
+        return flash_attention(q, k, v, causal, window=window)
     from bee_code_interpreter_tpu.parallel.ring_attention import (
         reference_attention,
     )
 
-    return reference_attention(q, k, v, causal=causal)
+    return reference_attention(q, k, v, causal=causal, window=window)

@@ -266,9 +266,11 @@ def ring_attention_sharded(
     return fn(q, k, v)
 
 
-def reference_attention(q, k, v, *, causal=True):
+def reference_attention(q, k, v, *, causal=True, window=None):
     """O(L²)-memory reference for tests. Accepts grouped-query K/V
-    ([B, KVH, L, D] with KVH dividing q's head count) by broadcasting."""
+    ([B, KVH, L, D] with KVH dividing q's head count) by broadcasting;
+    ``window`` masks keys more than window-1 positions behind the query
+    (sliding-window attention; requires causal)."""
     if k.shape[1] != q.shape[1]:
         rep = q.shape[1] // k.shape[1]
         k = jnp.repeat(k, rep, axis=1)
@@ -277,10 +279,17 @@ def reference_attention(q, k, v, *, causal=True):
         "bhqd,bhkd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32),
         preferred_element_type=jnp.float32,
     ) * (q.shape[-1] ** -0.5)
+    if window is not None and not causal:
+        # mirror the flash kernel's validation: local_attention must behave
+        # identically across platforms
+        raise ValueError("window requires causal=True (sliding window)")
     if causal:
         Lq, Lk = scores.shape[-2:]
         row = lax.broadcasted_iota(jnp.int32, (Lq, Lk), 0)
         col = lax.broadcasted_iota(jnp.int32, (Lq, Lk), 1)
-        scores = jnp.where(row >= col, scores, -jnp.inf)
+        mask = row >= col
+        if window is not None:
+            mask = mask & (row - col < window)
+        scores = jnp.where(mask, scores, -jnp.inf)
     weights = jax.nn.softmax(scores, axis=-1)
     return jnp.einsum("bhqk,bhkd->bhqd", weights, v.astype(jnp.float32)).astype(q.dtype)
