@@ -65,6 +65,11 @@ class TransformerConfig:
     moe_capacity_factor: float = 1.25
     moe_aux_weight: float = 1e-2
     moe_group_size: int = 1024  # GShard routing-group size (memory bound)
+    # RoPE linear position interpolation (context extension): effective
+    # position = position / rope_scaling. 1.0 = off; e.g. 4.0 runs a model
+    # trained at max_seq_len L with positions compressed from 4L into the
+    # trained range.
+    rope_scaling: float = 1.0
     # Sequence-parallel attention strategy when the mesh has sp > 1:
     # "ring" rotates compact K/V over ppermute (parallel/ring_attention.py);
     # "ulysses" re-shards heads<->sequence with all-to-alls and runs the
@@ -131,11 +136,21 @@ def rms_norm(x: jax.Array, scale: jax.Array, eps: float = 1e-5) -> jax.Array:
     return (norm * scale.astype(jnp.float32)).astype(x.dtype)
 
 
-def rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
-    """Rotary embeddings over [B, H, L, D_head] with positions [B, L]."""
+def rope(
+    x: jax.Array, positions: jax.Array, theta: float, scaling: float = 1.0
+) -> jax.Array:
+    """Rotary embeddings over [B, H, L, D_head] with positions [B, L].
+
+    ``scaling`` > 1 is linear position interpolation (Chen et al. — effective
+    position = position / scaling), the simple context-extension recipe: a
+    model trained at L runs at scaling·L with positions compressed back into
+    the trained range."""
+    if scaling <= 0:
+        raise ValueError(f"rope scaling must be > 0, got {scaling}")
     d = x.shape[-1]
     freqs = theta ** (-jnp.arange(0, d, 2, dtype=jnp.float32) / d)  # [d/2]
-    angles = positions[:, None, :, None].astype(jnp.float32) * freqs  # [B,1,L,d/2]
+    scaled = positions.astype(jnp.float32) / scaling
+    angles = scaled[:, None, :, None] * freqs  # [B,1,L,d/2]
     cos, sin = jnp.cos(angles), jnp.sin(angles)
     x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
     rotated = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
@@ -337,8 +352,8 @@ def _layer_apply(
         out = jnp.einsum("bld,dk->blk", x, w.astype(c.dtype))
         return out.reshape(B, L, heads, dh).transpose(0, 2, 1, 3)
 
-    q = rope(proj(layer["wq"], nh), positions, c.rope_theta)
-    k = rope(proj(layer["wk"], kvh), positions, c.rope_theta)
+    q = rope(proj(layer["wq"], nh), positions, c.rope_theta, c.rope_scaling)
+    k = rope(proj(layer["wk"], kvh), positions, c.rope_theta, c.rope_scaling)
     v = proj(layer["wv"], kvh)
     kv_out = (k, v) if return_kv else None
     # GQA-native: compact k/v go in as-is
@@ -599,8 +614,10 @@ def decode_step(
             out = jnp.einsum("bld,dk->blk", x, w.astype(c.dtype))
             return out.reshape(B, 1, heads, dh).transpose(0, 2, 1, 3)
 
-        q = rope(proj(layer["wq"], nh), positions, c.rope_theta)  # [B,nh,1,Dh]
-        k_new = rope(proj(layer["wk"], kvh), positions, c.rope_theta)
+        q = rope(
+            proj(layer["wq"], nh), positions, c.rope_theta, c.rope_scaling
+        )  # [B,nh,1,Dh]
+        k_new = rope(proj(layer["wk"], kvh), positions, c.rope_theta, c.rope_scaling)
         v_new = proj(layer["wv"], kvh)
         from bee_code_interpreter_tpu.ops.kv_cache import (
             dequantize,
@@ -687,8 +704,10 @@ def decode_window(
             out = jnp.einsum("bld,dk->blk", x, w.astype(c.dtype))
             return out.reshape(B, W, heads, dh).transpose(0, 2, 1, 3)
 
-        q = rope(proj(layer["wq"], nh), positions, c.rope_theta)  # [B,nh,W,Dh]
-        k_new = rope(proj(layer["wk"], kvh), positions, c.rope_theta)
+        q = rope(
+            proj(layer["wq"], nh), positions, c.rope_theta, c.rope_scaling
+        )  # [B,nh,W,Dh]
+        k_new = rope(proj(layer["wk"], kvh), positions, c.rope_theta, c.rope_scaling)
         v_new = proj(layer["wv"], kvh)
         c_layer = {
             "k": lax.dynamic_update_slice(c_layer["k"], k_new, (0, 0, pos0, 0)),

@@ -248,3 +248,50 @@ def test_gqa_partial_broadcast_when_tp_exceeds_kv_heads():
     want = forward(params, tokens, config)  # mesh=None
     got = forward(shard_params(params, config, mesh), tokens, config, mesh)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-4, rtol=2e-4)
+
+
+def test_rope_scaling_context_extension():
+    # Linear position interpolation: scaling=s must equal running rope at
+    # positions/s, the identity the context-extension recipe rests on; and
+    # the cached decode stays consistent under a scaled config.
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from bee_code_interpreter_tpu.models.transformer import (
+        Transformer,
+        TransformerConfig,
+        rope,
+    )
+
+    x = jax.random.normal(jax.random.PRNGKey(0), (1, 2, 8, 16))
+    pos = jnp.arange(8, dtype=jnp.int32)[None, :] * 4
+    a = rope(x, pos, 10000.0, scaling=4.0)
+    b = rope(x, (pos / 4).astype(jnp.float32), 10000.0)
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6, rtol=1e-6)
+
+    config = dataclasses.replace(
+        TransformerConfig.tiny(), dtype=jnp.float32, rope_scaling=4.0
+    )
+    model = Transformer(config)
+    params = model.init(jax.random.PRNGKey(1))
+    prompt = jax.random.randint(jax.random.PRNGKey(2), (1, 6), 0, config.vocab_size)
+    assert (
+        model.generate(params, prompt, 5)
+        == model.generate_cached(params, prompt, 5)
+    ).all()
+
+
+def test_rope_scaling_validated():
+    import jax
+    import jax.numpy as jnp
+    import pytest
+
+    from bee_code_interpreter_tpu.models.transformer import rope
+
+    x = jnp.zeros((1, 1, 4, 8))
+    pos = jnp.arange(4, dtype=jnp.int32)[None, :]
+    with pytest.raises(ValueError, match="rope scaling must be > 0"):
+        rope(x, pos, 10000.0, scaling=0.0)

@@ -124,7 +124,8 @@ def test_windowed_int8_cache_decode_consistent():
     prompt = jax.random.randint(jax.random.PRNGKey(3), (1, 9), 0, config.vocab_size)
     b16 = model16.generate_cached(params, prompt, max_new_tokens=6)
     i8 = model8.generate_cached(params, prompt, max_new_tokens=6)
-    # margin-gated agreement (same approach as tests/test_kv_cache.py):
-    # require at least the first generated token to agree, and shapes equal
-    assert i8.shape == b16.shape
-    assert int(i8[0, 9]) == int(b16[0, 9])
+    # full-token equality: deterministic for this seed, and the decode
+    # tokens (indices 10..) are the ones that exercise the int8 branch's
+    # window mask — a first-token-only check would be vacuous (it comes
+    # from the shared full-precision prefill)
+    assert (i8 == b16).all(), (i8, b16)
