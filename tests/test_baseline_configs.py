@@ -31,6 +31,15 @@ REPO = Path(__file__).resolve().parent.parent
 EXAMPLES = REPO / "examples"
 
 
+@pytest.fixture
+def local_executor(local_executor_factory):
+    # Overrides conftest's 30s-capped executor (same as
+    # tests/test_example_payloads.py): the BERT/CNN payloads jit-compile
+    # real models, and on a loaded box — e.g. this file running while
+    # another pytest process hogs the cores — compile alone can blow 30s.
+    return local_executor_factory(execution_timeout_s=600.0)
+
+
 async def test_config1_benchmark_numpy_via_execute(http_app):
     # The headline payload, downsized 100x so CI measures the path, not the
     # host (bench.py runs it at full size against the real chip).

@@ -256,15 +256,7 @@ def test_rope_scaling_context_extension():
     # the cached decode stays consistent under a scaled config.
     import dataclasses
 
-    import jax
-    import jax.numpy as jnp
-    import numpy as np
-
-    from bee_code_interpreter_tpu.models.transformer import (
-        Transformer,
-        TransformerConfig,
-        rope,
-    )
+    from bee_code_interpreter_tpu.models.transformer import rope
 
     x = jax.random.normal(jax.random.PRNGKey(0), (1, 2, 8, 16))
     pos = jnp.arange(8, dtype=jnp.int32)[None, :] * 4
@@ -285,10 +277,6 @@ def test_rope_scaling_context_extension():
 
 
 def test_rope_scaling_validated():
-    import jax
-    import jax.numpy as jnp
-    import pytest
-
     from bee_code_interpreter_tpu.models.transformer import rope
 
     x = jnp.zeros((1, 1, 4, 8))
