@@ -42,33 +42,20 @@ Params = dict[str, Any]
 DEFAULT_TARGETS = ("wq", "wv")  # the classic LoRA placement
 
 
-def init_lora(
-    config: TransformerConfig,
+def init_lora_from_layers(
+    layers: Params,  # a "layers" pytree: stacked [n_layers, ...] leaves
     key: jax.Array,
     rank: int = 8,
     targets: tuple[str, ...] = DEFAULT_TARGETS,
 ) -> Params:
-    """LoRA state: per-target stacked A (gaussian / sqrt(d)) and B (zeros).
-
-    Shapes follow the base layer weights: target ``w`` of stacked shape
-    [n_layers, d_in, d_out] gets A [n_layers, d_in, r], B
-    [n_layers, r, d_out]. The scale (alpha/rank) is a static argument of
-    ``merge_lora``/``make_lora_train_step``, NOT a pytree leaf — leaves are
-    what optimizers update.
-    """
-    c = config
-    # shapes derive from init_params itself (abstract eval — no arrays are
-    # materialized): the stacked [n_layers, d_in, d_out] projections are the
-    # LoRA-able targets, and there is exactly one source of truth for their
-    # layout
-    from bee_code_interpreter_tpu.models.transformer import init_params
-
-    abstract = jax.eval_shape(
-        lambda k: init_params(c, k), jax.random.PRNGKey(0)
-    )["layers"]
+    """LoRA state for ANY stacked-layer family (transformer, ViT, ...):
+    per-target stacked A (gaussian / sqrt(d)) and B (zeros), with shapes
+    read off the layer pytree itself — every [n_layers, d_in, d_out]
+    projection is a valid target. Pass concrete params or an abstract
+    ``jax.eval_shape`` pytree; only shapes are read."""
     dims = {
-        name: leaf.shape[1:]
-        for name, leaf in abstract.items()
+        name: leaf.shape
+        for name, leaf in layers.items()
         if hasattr(leaf, "ndim") and leaf.ndim == 3
     }
     unknown = set(targets) - set(dims)
@@ -77,13 +64,33 @@ def init_lora(
     keys = jax.random.split(key, len(targets))
     state: Params = {}
     for t, k in zip(targets, keys):
-        d_in, d_out = dims[t]
+        n_layers, d_in, d_out = dims[t]
         state[t] = {
-            "A": jax.random.normal(k, (c.n_layers, d_in, rank), jnp.float32)
+            "A": jax.random.normal(k, (n_layers, d_in, rank), jnp.float32)
             / math.sqrt(d_in),
-            "B": jnp.zeros((c.n_layers, rank, d_out), jnp.float32),
+            "B": jnp.zeros((n_layers, rank, d_out), jnp.float32),
         }
     return state
+
+
+def init_lora(
+    config: TransformerConfig,
+    key: jax.Array,
+    rank: int = 8,
+    targets: tuple[str, ...] = DEFAULT_TARGETS,
+) -> Params:
+    """Transformer-config convenience wrapper over ``init_lora_from_layers``
+    (shapes derive from init_params via abstract eval — no arrays are
+    materialized; one source of truth for the layout). The scale
+    (alpha/rank) is a static argument of ``merge_lora``/
+    ``make_lora_train_step``, NOT a pytree leaf — leaves are what
+    optimizers update."""
+    from bee_code_interpreter_tpu.models.transformer import init_params
+
+    abstract = jax.eval_shape(
+        lambda k: init_params(config, k), jax.random.PRNGKey(0)
+    )["layers"]
+    return init_lora_from_layers(abstract, key, rank=rank, targets=targets)
 
 
 def merge_lora(params: Params, lora: Params, scale: float = 1.0) -> Params:
