@@ -782,6 +782,8 @@ def prefill_chunked(
         "v": jnp.zeros(shape, c.dtype),
     }
 
+    if chunk < 1:
+        raise ValueError(f"chunk must be >= 1, got {chunk}")
     n_full, rem = divmod(L, chunk)
     last_logits = None
     if n_full:
@@ -950,6 +952,7 @@ class Transformer:
         top_p: float | None = None,
         key: jax.Array | None = None,
         eos_id: int | None = None,
+        prefill_chunk: int | None = None,
     ) -> jax.Array:
         """KV-cached decode: one O(L^2) prefill, then ``max_new_tokens - 1``
         O(L) incremental steps (decode_step). Default is greedy
@@ -959,7 +962,10 @@ class Transformer:
         and is split per step, so a fixed key is fully deterministic).
         ``eos_id`` freezes a row once it emits that token — every later
         position repeats ``eos_id`` (static shapes: the loop always runs
-        ``max_new_tokens`` steps; finished rows just stop changing). For
+        ``max_new_tokens`` steps; finished rows just stop changing).
+        ``prefill_chunk`` streams the prompt through ``prefill_chunked``
+        instead of one O(L²) forward (long prompts in bounded memory;
+        bf16 cache, single-shard only). For
         MoE configs greedy equality holds only drop-free (ample capacity):
         under capacity pressure the full forward routes tokens in
         competition while decode routes each token alone — inherent to
@@ -970,15 +976,24 @@ class Transformer:
         if key is None:
             key = jax.random.PRNGKey(0)
 
-        logits, (k_pre, v_pre) = forward(
-            params, prompt, c, self.mesh, return_kv=True
-        )
-        cache = init_decode_cache(c, B, total, k_pre, v_pre)
+        if prefill_chunk is not None:
+            if self.mesh is not None:
+                raise NotImplementedError(
+                    "prefill_chunk is single-shard (decode_window takes no "
+                    "mesh); use the full prefill on meshes"
+                )
+            last_logits, cache = prefill_chunked(
+                params, prompt, c, total, chunk=prefill_chunk
+            )
+        else:
+            logits, (k_pre, v_pre) = forward(
+                params, prompt, c, self.mesh, return_kv=True
+            )
+            cache = init_decode_cache(c, B, total, k_pre, v_pre)
+            last_logits = logits[:, L - 1, :]
 
         key, sub = jax.random.split(key)
-        first = sample_logits(
-            logits[:, L - 1, :], sub, temperature, top_k, top_p
-        )
+        first = sample_logits(last_logits, sub, temperature, top_k, top_p)
         tokens = (
             jnp.zeros((B, total), dtype=jnp.int32)
             .at[:, :L].set(prompt)

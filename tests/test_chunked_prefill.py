@@ -90,3 +90,35 @@ def test_undersized_total_len_rejected():
     prompt = jnp.zeros((1, 12), jnp.int32)
     with pytest.raises(ValueError, match="must cover the prompt"):
         T.prefill_chunked(params, prompt, config, total_len=8, chunk=4)
+
+
+def test_generate_cached_with_prefill_chunk():
+    # the integrated path: generate_cached(prefill_chunk=N) must produce the
+    # same tokens as the full-prefill path, sampling and eos included.
+    config = cfg(n_kv_heads=2)
+    model = T.Transformer(config)
+    params = model.init(jax.random.PRNGKey(0))
+    prompt = jax.random.randint(jax.random.PRNGKey(5), (2, 11), 0, config.vocab_size)
+    full = model.generate_cached(params, prompt, max_new_tokens=6)
+    chunked = model.generate_cached(
+        params, prompt, max_new_tokens=6, prefill_chunk=4
+    )
+    np.testing.assert_array_equal(np.asarray(chunked), np.asarray(full))
+
+    k = jax.random.PRNGKey(7)
+    a = model.generate_cached(
+        params, prompt, max_new_tokens=6, temperature=1.0, top_k=8, key=k
+    )
+    b = model.generate_cached(
+        params, prompt, max_new_tokens=6, temperature=1.0, top_k=8, key=k,
+        prefill_chunk=4,
+    )
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_chunk_size_validated():
+    config = cfg()
+    params = T.init_params(config, jax.random.PRNGKey(0))
+    prompt = jnp.zeros((1, 8), jnp.int32)
+    with pytest.raises(ValueError, match="chunk must be >= 1"):
+        T.prefill_chunked(params, prompt, config, 12, chunk=0)
