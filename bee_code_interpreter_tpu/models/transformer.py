@@ -776,14 +776,14 @@ def prefill_chunked(
         raise ValueError(
             f"total_len ({total_len}) must cover the prompt length ({L})"
         )
+    if chunk < 1:
+        raise ValueError(f"chunk must be >= 1, got {chunk}")
     shape = (c.n_layers, B, c.kv_heads, total_len, c.head_dim)
     cache = {
         "k": jnp.zeros(shape, c.dtype),
         "v": jnp.zeros(shape, c.dtype),
     }
 
-    if chunk < 1:
-        raise ValueError(f"chunk must be >= 1, got {chunk}")
     n_full, rem = divmod(L, chunk)
     last_logits = None
     if n_full:

@@ -1,5 +1,8 @@
 # Long-context demo: exact attention over a sequence sharded across all
 # devices with K/V rotating on the ICI ring (parallel/ring_attention.py).
+# On TPU each hop runs the Pallas flash kernel (hops merge on their
+# log-sum-exp); grouped-query K/V stays compact, so the ring moves
+# KVH/H of the bytes a broadcast layout would.
 import jax
 import jax.numpy as jnp
 
@@ -8,10 +11,11 @@ from bee_code_interpreter_tpu.parallel.ring_attention import ring_attention_shar
 
 n = len(jax.devices())
 mesh = make_mesh({"sp": n})
-B, H, L, D = 1, 8, 1024 * n, 128  # L/n per device — scales with the ring
-q, k, v = (
-    jax.random.normal(jax.random.PRNGKey(i), (B, H, L, D), dtype=jnp.bfloat16)
-    for i in range(3)
+B, H, KVH, L, D = 1, 8, 2, 1024 * n, 128  # L/n per device; compact GQA K/V
+q = jax.random.normal(jax.random.PRNGKey(0), (B, H, L, D), dtype=jnp.bfloat16)
+k, v = (
+    jax.random.normal(jax.random.PRNGKey(i), (B, KVH, L, D), dtype=jnp.bfloat16)
+    for i in (1, 2)
 )
 out = ring_attention_sharded(mesh, q, k, v, causal=True)
 print(f"ring attention over {n} device(s): out {out.shape} {out.dtype}")
