@@ -1,0 +1,130 @@
+"""Hardware-evidence ledger: ``TPU_EVIDENCE.jsonl`` at the repo root.
+
+Three rounds of driver benchmarks raced a TPU tunnel that flips between
+healthy and wedged within a session (BASELINE.md rounds 1-3): numbers
+captured while healthy kept vanishing from the record because the
+end-of-round driver run happened to land on a wedged window. The fix is to
+stop treating hardware numbers as point-in-time measurements: every
+hardware-touching script appends its successful measurements HERE the
+moment they are captured — timestamped, git-attributed, machine-readable —
+and bench.py embeds the latest ledger entries in its output, so even a
+driver run that finds the tunnel wedged carries dated hardware evidence.
+
+Append is a single ``O_APPEND`` write (atomic on POSIX for our line sizes),
+so concurrent scripts can record without a lock. Reads tolerate a torn or
+hand-edited line by skipping it.
+
+The reference has no analogue (it publishes no numbers at all — SURVEY §6);
+this subsystem exists because the rebuild's own bar is *measured* evidence.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+from datetime import datetime, timezone
+from pathlib import Path
+from typing import Any
+
+REPO_ROOT = Path(__file__).resolve().parents[2]
+DEFAULT_LEDGER = REPO_ROOT / "TPU_EVIDENCE.jsonl"
+
+
+def _git_sha(cwd: Path) -> str | None:
+    try:
+        out = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"],
+            cwd=cwd, capture_output=True, text=True, timeout=10,
+        )
+    except (OSError, subprocess.TimeoutExpired):
+        return None
+    sha = out.stdout.strip()
+    return sha if out.returncode == 0 and sha else None
+
+
+def ledger_path() -> Path:
+    """Ledger location; ``BCI_EVIDENCE_PATH`` overrides (tests point it at
+    a tmpdir so they never dirty the real ledger)."""
+    override = os.environ.get("BCI_EVIDENCE_PATH")
+    return Path(override) if override else DEFAULT_LEDGER
+
+
+def record(case: str, payload: dict[str, Any], *, script: str,
+           path: Path | None = None) -> dict[str, Any]:
+    """Append one measurement to the ledger; returns the full entry.
+
+    ``case`` names the measurement (stable across rounds, e.g.
+    ``dense_matmul``); ``script`` names the producer (e.g. ``bench.py``);
+    ``payload`` is the measurement JSON itself, kept verbatim under
+    ``data`` so the ledger never loses detail a future reader wants.
+
+    NEVER raises: the ledger is a side channel — a read-only checkout or a
+    full disk must not turn an already-successful hardware measurement into
+    a failed script (the measurement is on stdout either way). A failed
+    append is reported on stderr and in the returned entry.
+    """
+    target = path or ledger_path()
+    entry = {
+        "ts": datetime.now(timezone.utc).isoformat(timespec="seconds"),
+        "unix_ts": round(time.time(), 1),
+        "git_sha": _git_sha(target.parent if target.parent.is_dir() else REPO_ROOT),
+        "script": script,
+        "case": case,
+        "data": payload,
+    }
+    try:
+        line = (json.dumps(entry, separators=(",", ":")) + "\n").encode()
+        fd = os.open(target, os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644)
+        try:
+            os.write(fd, line)
+        finally:
+            os.close(fd)
+    except Exception as e:
+        print(f"evidence ledger append failed ({target}): {e}",
+              file=sys.stderr)
+        entry["ledger_error"] = str(e)
+    return entry
+
+
+def emit(case: str, payload: dict[str, Any], *, script: str) -> None:
+    """Print the measurement as the script's stdout JSON line AND append it
+    to the ledger — the ONE copy of the print-then-record pattern every
+    hardware script uses, so stdout and ledger formats cannot drift."""
+    print(json.dumps({"case": case, **payload}))
+    record(case, payload, script=script)
+
+
+def read_all(path: Path | None = None) -> list[dict[str, Any]]:
+    """All well-formed ledger entries, in file order."""
+    target = path or ledger_path()
+    if not target.exists():
+        return []
+    entries: list[dict[str, Any]] = []
+    for raw in target.read_text().splitlines():
+        raw = raw.strip()
+        if not raw:
+            continue
+        try:
+            entry = json.loads(raw)
+        except json.JSONDecodeError:
+            continue  # torn/hand-edited line: skip, never crash a bench run
+        if isinstance(entry, dict) and "case" in entry:
+            entries.append(entry)
+    return entries
+
+
+def latest_per_case(path: Path | None = None) -> list[dict[str, Any]]:
+    """The newest entry for each distinct ``case``, oldest-case first.
+
+    This is what bench.py embeds: one line per kind of hardware proof
+    (dense matmul, flash kernel, decode, shard_map lowering, MFU, ...),
+    each carrying its own timestamp and git SHA, compact enough for a
+    BENCH_r*.json artifact.
+    """
+    newest: dict[str, dict[str, Any]] = {}
+    for entry in read_all(path):
+        newest[entry["case"]] = entry  # file order == append order
+    return list(newest.values())

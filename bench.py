@@ -14,6 +14,15 @@ Prints ONE JSON line:
 
 Extra detail lines go to stderr.
 
+Capture-on-healthy (round-3 lesson): the TPU tunnel flips healthy<->wedged
+within sessions, so the probe is PATIENT — re-probing on a cadence up to
+``BCI_BENCH_TPU_PATIENCE_S`` (default 20 min) and measuring the moment a
+probe succeeds — and every successful hardware measurement (from this
+script and from scripts/bench-*.py / validate-*.py) is appended to the
+``TPU_EVIDENCE.jsonl`` ledger, whose latest entries ride along in this
+output's ``hardware_evidence`` field. A SIGTERM mid-patience still emits
+the complete fallback artifact.
+
 Ordering and guards (round-1 lesson, BENCH_r01.json rc=1): the TPU
 measurement — the number this benchmark exists to produce — runs FIRST and
 nothing that happens to the auxiliary measurements can take it down. The CPU
@@ -30,6 +39,7 @@ from __future__ import annotations
 import asyncio
 import json
 import os
+import signal
 import statistics
 import subprocess
 import sys
@@ -41,6 +51,17 @@ REPO = Path(__file__).resolve().parent
 if str(REPO) not in sys.path:
     sys.path.insert(0, str(REPO))
 SHIM_DIR = REPO / "bee_code_interpreter_tpu" / "runtime" / "shim"
+
+# Capture-on-healthy (VERDICT r3 next-round #1): the tunnel to the chip
+# provably flips healthy<->wedged within a session, so a single 75 s probe +
+# one attempt is mis-sized patience. When the first probe fails, bench.py now
+# keeps re-probing on a cadence — bounded, out-of-process — up to this
+# ceiling, and runs the payload the moment a probe succeeds. Every probe and
+# attempt lands in the output JSON. A SIGTERM/SIGINT during the wait still
+# emits a complete fallback artifact (see _install_kill_safe_emit), so a
+# driver timeout can shorten the patience but never produce an empty record.
+TPU_PATIENCE_S = float(os.environ.get("BCI_BENCH_TPU_PATIENCE_S", "1200"))
+TPU_PROBE_INTERVAL_S = float(os.environ.get("BCI_BENCH_TPU_PROBE_INTERVAL_S", "45"))
 
 N = 32768
 ITERS = 16
@@ -58,7 +79,8 @@ import jax, jax.numpy as jnp
 from jax import lax
 
 n, iters = {N}, {ITERS}
-if jax.devices()[0].platform == "cpu":
+on_tpu = jax.devices()[0].platform == "tpu"
+if not on_tpu:
     n, iters = 1024, 4  # no accelerator: validate mechanics only
 a = jax.random.normal(jax.random.PRNGKey(0), (n, n), dtype=jnp.bfloat16)
 
@@ -75,7 +97,9 @@ for _ in range(3):
     t0 = time.time()
     float(chain(a))
     best = min(best, time.time() - t0)
-print(f"RESULT_GFLOPS {{2 * n**3 * iters / best / 1e9:.1f}}")
+# second field: 1 iff the payload actually ran on a TPU — the harness must
+# never headline a CPU-mechanics run as the per-chip number
+print(f"RESULT_GFLOPS {{2 * n**3 * iters / best / 1e9:.1f}} {{1 if on_tpu else 0}}")
 """
 
 # Host-CPU baseline: the same kernel as the TPU chain — one-time 1/128
@@ -224,6 +248,16 @@ async def run_payload_values(
 ) -> list[float]:
     """Execute through the service path; return the floats following
     ``marker`` on the payload's result line."""
+    return (await run_payload_multi(source, env, timeout_s, (marker,)))[marker]
+
+
+async def run_payload_multi(
+    source: str, env: dict[str, str], timeout_s: float,
+    markers: tuple[str, ...],
+) -> dict[str, list[float]]:
+    """Execute ONCE through the service path; return the floats following
+    each ``marker`` line (one executor run can carry several measurements —
+    scripts/bench-mfu.py's train + decode share a payload)."""
     from bee_code_interpreter_tpu.services.local_code_executor import (
         LocalCodeExecutor,
     )
@@ -243,10 +277,17 @@ async def run_payload_values(
         raise PayloadError(
             f"payload failed (exit {result.exit_code})", stderr=result.stderr
         )
+    out: dict[str, list[float]] = {}
     for line in result.stdout.splitlines():
-        if line.startswith(marker):
-            return [float(tok) for tok in line.split()[1:]]
-    raise PayloadError(f"no result in stdout: {result.stdout!r}")
+        for marker in markers:
+            if line.startswith(marker):
+                out[marker] = [float(tok) for tok in line.split()[1:]]
+    missing = [m for m in markers if m not in out]
+    if missing:
+        raise PayloadError(
+            f"no {missing} in stdout: {result.stdout!r}"
+        )
+    return out
 
 
 def scrub_tunnel_vars() -> None:
@@ -349,17 +390,29 @@ async def measure_warm_latency_p50_ms(
         executor.shutdown()
 
 
-def diagnose_tpu_failure(probe: dict, attempts: list[dict]) -> str:
+def diagnose_tpu_failure(probes: list[dict], attempts: list[dict]) -> str:
     """Machine-readable reason the headline number is absent, naming the
     failing stage (probe vs init vs payload) — VERDICT r2 next-round #1."""
-    if not probe.get("ok"):
-        return f"tpu_backend_unreachable: {probe.get('error', 'probe failed')}"
-    if probe.get("platform") != "tpu":
+    probe = probes[-1] if probes else {}
+    healthy = [p for p in probes if p.get("ok")]
+    if not healthy:
+        window = probes[-1].get("at_s", 0.0) if probes else 0.0
+        return (
+            f"tpu_backend_unreachable: {probe.get('error', 'probe failed')} "
+            f"({len(probes)} probes over {window:.0f}s, none healthy)"
+        )
+    if all(p.get("platform") != "tpu" for p in healthy):
         return (
             f"no_tpu_device: jax backend here is '{probe.get('platform')}' "
             f"({probe.get('device_count')} devices)"
         )
     last = attempts[-1] if attempts else {}
+    if last.get("payload_platform") == "cpu":
+        return (
+            "payload_ran_on_cpu: the probe saw a TPU backend but the "
+            "executor sandbox ran the payload on CPU (accelerator env not "
+            "passed through / probe-executor platform mismatch)"
+        )
     text = (last.get("error", "") + " " + last.get("stderr_tail", "")).lower()
     if "timed out" in text or "exit -1" in text:
         return (
@@ -369,47 +422,193 @@ def diagnose_tpu_failure(probe: dict, attempts: list[dict]) -> str:
     return f"payload_error: {last.get('error', 'unknown')}"
 
 
+def compact_probes(probes: list[dict]) -> list[dict]:
+    """Probe history sized for a BENCH artifact: stderr tails only on the
+    last entry, middle of a long wait elided (first 2 + last 6 kept)."""
+    out = []
+    for p in probes:
+        p = dict(p)
+        p.pop("stderr_tail", None)
+        out.append(p)
+    if probes and "stderr_tail" in probes[-1]:
+        out[-1]["stderr_tail"] = probes[-1]["stderr_tail"]
+    if len(out) > 8:
+        elided = len(out) - 8
+        out = out[:2] + [{"elided_probes": elided}] + out[-6:]
+    return out
+
+
+def hardware_evidence() -> list[dict]:
+    """Latest TPU_EVIDENCE.jsonl entry per case — dated, git-attributed
+    measurements captured whenever the tunnel was healthy, embedded so even
+    a wedged driver run carries hardware evidence (VERDICT r3 #1b)."""
+    try:
+        from bee_code_interpreter_tpu.utils import evidence
+
+        return evidence.latest_per_case()
+    except Exception as e:  # the ledger must never take down the bench
+        return [{"error": f"ledger unreadable: {e}"}]
+
+
+def record_evidence(case: str, payload: dict) -> None:
+    try:
+        from bee_code_interpreter_tpu.utils import evidence
+
+        evidence.record(case, payload, script="bench.py")
+    except Exception as e:
+        print(f"evidence ledger append failed: {e}", file=sys.stderr)
+
+
+def _install_kill_safe_emit(state: dict) -> None:
+    """If the driver kills a patient bench run mid-wait (SIGTERM/SIGINT),
+    emit the complete CPU-fallback artifact — probes so far, diagnosis,
+    ledger evidence — instead of dying with no output. The one JSON line is
+    the whole contract; a timeout must shorten the patience, not void it."""
+
+    def emit_and_die(signum: int, frame) -> None:
+        if state.get("emitted"):
+            os._exit(1)
+        state["emitted"] = True
+        tpu_gflops = state.get("tpu_gflops")
+        if tpu_gflops is not None:  # headline landed; only auxiliaries lost
+            result = {
+                "metric": "dense matmul GFLOPS/chip via /v1/execute "
+                          "(bf16 32768^3 jit chain)",
+                "value": round(tpu_gflops, 1),
+                "unit": "GFLOPS",
+                "vs_baseline": round(tpu_gflops / RECORDED_CPU_GFLOPS, 2),
+                "note": f"killed_by_signal_{signum} before aux measurements; "
+                        "vs_baseline uses the recorded CPU figure",
+            }
+        else:
+            result = {
+                "metric": "dense matmul GFLOPS via /v1/execute "
+                          "(CPU fallback - no TPU reachable)",
+                "value": RECORDED_CPU_GFLOPS,
+                "unit": "GFLOPS",
+                "vs_baseline": 1.0,
+                "tpu_diagnosis": (
+                    f"killed_by_signal_{signum}_during_patience: "
+                    + diagnose_tpu_failure(state["probes"], state["attempts"])
+                ),
+            }
+        result.update(
+            tpu_probes=compact_probes(state["probes"]),
+            tpu_attempts=state["attempts"],
+            latency_warm_p50_ms=None,
+            cpu_baseline_gflops=RECORDED_CPU_GFLOPS,
+            cpu_baseline_source="recorded",
+            hardware_evidence=hardware_evidence(),
+        )
+        print(json.dumps(result), flush=True)
+        os._exit(1)
+
+    signal.signal(signal.SIGTERM, emit_and_die)
+    signal.signal(signal.SIGINT, emit_and_die)
+
+
+def _attempt_tpu_payload(state: dict, timeout_s: float) -> float | None:
+    """One bounded run of the TPU payload through the service path. Returns
+    GFLOPS only if the payload itself reports it ran ON a TPU — a
+    CPU-mechanics run must never masquerade as the per-chip headline."""
+    t0 = time.time()
+    try:
+        values = asyncio.run(
+            run_payload_values(
+                TPU_PAYLOAD, {}, timeout_s=timeout_s, marker="RESULT_GFLOPS"
+            )
+        )
+        gflops, on_tpu = values[0], bool(values[1]) if len(values) > 1 else False
+        entry = {
+            "ok": on_tpu,
+            "seconds": round(time.time() - t0, 1),
+            "payload_platform": "tpu" if on_tpu else "cpu",
+        }
+        state["attempts"].append(entry)
+        if on_tpu:
+            print(f"tpu: {gflops:.1f} GFLOPS", file=sys.stderr)
+            return gflops
+        print(
+            f"payload ran but on CPU ({gflops:.1f} GFLOPS) - not the "
+            "headline", file=sys.stderr,
+        )
+        return None
+    except Exception as e:
+        entry = {
+            "ok": False,
+            "seconds": round(time.time() - t0, 1),
+            "error": str(e)[:300],
+        }
+        stderr_tail = getattr(e, "stderr", "")
+        if stderr_tail:
+            entry["stderr_tail"] = stderr_tail[-400:]
+        state["attempts"].append(entry)
+        print(f"tpu payload attempt failed: {e}", file=sys.stderr)
+        return None
+
+
+def patient_tpu_capture(state: dict, patience_s: float) -> float | None:
+    """Probe → measure loop: re-probe the tunnel on a cadence up to
+    ``patience_s``, running the payload the moment a probe succeeds; a
+    failed payload attempt (tunnels can wedge mid-run) resumes probing.
+    Every probe/attempt is appended to ``state`` and ends up in the JSON.
+    A CPU-only backend gets one bounded payload attempt (the sandbox env can
+    differ from the probe's — the payload itself reports its platform) and
+    returns without burning the patience; so does an exhausted wait."""
+    t_start = time.time()
+    deadline = t_start + patience_s
+    while True:
+        probe = probe_tpu()
+        probe["at_s"] = round(time.time() - t_start, 1)
+        state["probes"].append(probe)
+        print(f"tpu probe: {probe}", file=sys.stderr)
+        if probe.get("ok") and probe.get("platform") != "tpu":
+            # real backend, no chip: waiting cannot help — but the payload
+            # runs through the executor, whose env (accelerator
+            # passthrough) is not guaranteed identical to the probe's
+            return _attempt_tpu_payload(state, 90.0)
+        if probe.get("ok"):
+            for timeout_s in (210.0, 90.0):
+                gflops = _attempt_tpu_payload(state, timeout_s)
+                if gflops is not None:
+                    return gflops
+        now = time.time()
+        if now >= deadline:
+            if not state["attempts"]:  # never even tried: one last bounded go
+                return _attempt_tpu_payload(state, 90.0)
+            return None
+        wait = min(TPU_PROBE_INTERVAL_S, deadline - now)
+        print(
+            f"tpu wedged; re-probing in {wait:.0f}s "
+            f"({deadline - now:.0f}s of patience left)",
+            file=sys.stderr,
+        )
+        time.sleep(wait)
+
+
 def main() -> None:
     # --- 1. the headline TPU number (runs first; ambient accelerator env —
     # including any tunnel plugin vars — flows through the executor's
-    # passthrough so the payload sees the real chip) -----------------------
-    # Self-diagnosing: a bounded out-of-process probe records whether the
-    # backend is reachable at all, then the payload gets up to 3 attempts
-    # spread across the window (a wedged tunnel can recover); every failure
-    # lands in the JSON with its stderr tail. Budgets sized so the worst case
-    # (probe 75 s + attempts 210+90+60 s) still leaves room for the CPU +
-    # latency measurements inside the driver window. A healthy chip needs
-    # ~90 s (init ~20-40, compile ~20-40, 4 timed chains ~25).
-    tpu_probe = probe_tpu()
-    print(f"tpu probe: {tpu_probe}", file=sys.stderr)
-    chip_likely = tpu_probe.get("ok") and tpu_probe.get("platform") == "tpu"
-    # An unreachable/CPU probe still gets one bounded attempt — tunnels recover
-    attempt_budgets = [210.0, 90.0, 60.0] if chip_likely else [90.0]
-
-    tpu_gflops: float | None = None
-    tpu_attempts: list[dict] = []
-    for timeout_s in attempt_budgets:
-        t0 = time.time()
-        try:
-            tpu_gflops = asyncio.run(
-                run_payload(TPU_PAYLOAD, {}, timeout_s=timeout_s)
-            )
-            tpu_attempts.append(
-                {"ok": True, "seconds": round(time.time() - t0, 1)}
-            )
-            print(f"tpu: {tpu_gflops:.1f} GFLOPS", file=sys.stderr)
-            break
-        except Exception as e:
-            entry: dict = {
-                "ok": False,
-                "seconds": round(time.time() - t0, 1),
-                "error": str(e)[:300],
-            }
-            stderr_tail = getattr(e, "stderr", "")
-            if stderr_tail:
-                entry["stderr_tail"] = stderr_tail[-400:]
-            tpu_attempts.append(entry)
-            print(f"tpu payload attempt failed: {e}", file=sys.stderr)
+    # passthrough so the payload sees the real chip). Patient: see
+    # patient_tpu_capture. A healthy chip needs ~90 s total (init ~20-40,
+    # compile ~20-40, 4 timed chains ~25); a wedged tunnel costs up to
+    # TPU_PATIENCE_S before the CPU fallback, with a kill-safe artifact if
+    # the driver's clock is shorter than ours.
+    state: dict = {"probes": [], "attempts": [], "emitted": False}
+    _install_kill_safe_emit(state)
+    tpu_gflops = patient_tpu_capture(state, TPU_PATIENCE_S)
+    state["tpu_gflops"] = tpu_gflops
+    tpu_probes: list[dict] = state["probes"]
+    tpu_attempts: list[dict] = state["attempts"]
+    chip_likely = any(
+        p.get("ok") and p.get("platform") == "tpu" for p in tpu_probes
+    )
+    if tpu_gflops is not None:
+        record_evidence(
+            "dense_matmul",
+            {"gflops": round(tpu_gflops, 1),
+             "payload": "bf16 32768^3 jit chain via /v1/execute"},
+        )
 
     # --- 1b. flash-attention kernel evidence (guarded; extra field only;
     # runs only when the headline already landed, so it can never cost the
@@ -422,13 +621,17 @@ def main() -> None:
                     FLASH_PAYLOAD, {}, timeout_s=240.0, marker="RESULT_FLASH"
                 )
             )
+            # The comparator is reference_attention compiled by XLA (a naive
+            # einsum+softmax), NOT a tuned fused-attention lowering — the
+            # field name says exactly that (ADVICE r3 #3).
             flash = {
                 "tflops": fl,
                 "xla_ref_tflops": xl,
-                "speedup_vs_xla": round(fl / xl, 2),
+                "speedup_vs_xla_ref": round(fl / xl, 2),
                 "shape": "B4 H16 L4096 D128 bf16 causal",
             }
             print(f"flash attention: {flash}", file=sys.stderr)
+            record_evidence("flash_attention", flash)
         except Exception as e:
             print(f"flash case failed (field omitted): {e}", file=sys.stderr)
 
@@ -486,9 +689,9 @@ def main() -> None:
             "value": round(cpu_gflops, 1),
             "unit": "GFLOPS",
             "vs_baseline": 1.0,
-            "tpu_diagnosis": diagnose_tpu_failure(tpu_probe, tpu_attempts),
+            "tpu_diagnosis": diagnose_tpu_failure(tpu_probes, tpu_attempts),
         }
-    result["tpu_probe"] = tpu_probe
+    result["tpu_probes"] = compact_probes(tpu_probes)
     result["tpu_attempts"] = tpu_attempts
     if flash is not None:
         result["flash_attention"] = flash
@@ -501,7 +704,17 @@ def main() -> None:
     # "recorded" = the live CPU run failed and vs_baseline uses the recorded
     # machine-class figure — a constant must never masquerade as a measurement
     result["cpu_baseline_source"] = cpu_source
-    print(json.dumps(result))
+    # Dated, git-attributed measurements from healthy-tunnel windows — the
+    # capture-on-healthy ledger rides along in every artifact.
+    result["hardware_evidence"] = hardware_evidence()
+    # Committed to emitting: neutralize the kill-safe handler BEFORE the
+    # print (a SIGTERM interleaving a second JSON line into a half-written
+    # one would corrupt the artifact; ignoring it for the final write keeps
+    # the one-line contract either way).
+    signal.signal(signal.SIGTERM, signal.SIG_IGN)
+    signal.signal(signal.SIGINT, signal.SIG_IGN)
+    state["emitted"] = True
+    print(json.dumps(result), flush=True)
 
 
 if __name__ == "__main__":

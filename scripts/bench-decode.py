@@ -52,6 +52,12 @@ def main() -> None:
         print(f"no TPU: {probe}", file=sys.stderr)
         sys.exit(2)
 
+    import functools
+
+    from bee_code_interpreter_tpu.utils import evidence
+
+    emit = functools.partial(evidence.emit, script="scripts/bench-decode.py")
+
     from bee_code_interpreter_tpu.models.transformer import (
         TransformerConfig,
         decode_step,
@@ -116,8 +122,7 @@ def main() -> None:
         "bf16": 2 * c.n_layers * B * c.kv_heads * ctx * c.head_dim * 2,
         "int8": 2 * c.n_layers * B * c.kv_heads * ctx * (c.head_dim + 4),
     }
-    print(json.dumps({
-        "case": "decode",
+    emit("decode", {
         "config": {"d_model": c.d_model, "n_layers": c.n_layers,
                    "heads": f"{c.n_heads}/{c.kv_heads}", "batch": B,
                    "ctx": ctx, "params": n_params},
@@ -132,7 +137,7 @@ def main() -> None:
         "int8_approx_hbm_gbps": round(
             (2 * n_params + cache_bytes["int8"]) / per_step["int8"] / 1e9, 1
         ),
-    }))
+    })
 
     # --- speculative decoding: tokens/sec with a small draft ---------------
     from bee_code_interpreter_tpu.models.speculative import speculative_generate
@@ -161,8 +166,7 @@ def main() -> None:
     t_small = best_of(run_spec_n(n_spec_small), prompt)
     per_token_spec = chain_diff(t_big, t_small, n_spec - n_spec_small + 1)
     spec_toks_sec = B / per_token_spec
-    print(json.dumps({
-        "case": "speculative_decode",
+    emit("speculative_decode", {
         "draft": {"n_layers": draft_config.n_layers, "d_ff": draft_config.d_ff},
         "gamma": 4,
         "tokens_per_sec": round(spec_toks_sec, 1),
@@ -172,7 +176,7 @@ def main() -> None:
         ),
         "note": "random weights: draft-acceptance is adversarially low; a "
                 "distilled draft on a trained target accepts far more",
-    }))
+    })
 
     # --- attention-only: grouped einsum vs repeat broadcast ---------------
     kvh, nh, dh, S = 8, 32, 128, 8192
@@ -214,14 +218,13 @@ def main() -> None:
         t_1 = best_of(chain(fn, 1), q0, kc, vc)
         results[name] = chain_diff(t_m, t_1, M)
     cache_bytes = 2 * kvh * S * dh * B * 2  # k+v, bf16
-    print(json.dumps({
-        "case": "decode_attention",
+    emit("decode_attention", {
         "shape": {"batch": B, "heads": f"{nh}/{kvh}", "cache_len": S, "head_dim": dh},
         "grouped_us": round(results["grouped"] * 1e6, 1),
         "repeat_us": round(results["repeat"] * 1e6, 1),
         "speedup": round(results["repeat"] / results["grouped"], 2),
         "grouped_cache_gbps": round(cache_bytes / results["grouped"] / 1e9, 1),
-    }))
+    })
 
 
 if __name__ == "__main__":

@@ -1,0 +1,135 @@
+#!/usr/bin/env python
+"""Watch the TPU tunnel and run the full hardware battery the moment it is
+healthy — the capture-on-healthy process (VERDICT r3 next-round #1/#2).
+
+The tunnel to the chip flips between healthy and wedged within sessions
+(BASELINE.md rounds 1-3), so hardware evidence cannot be a point-in-time
+measurement taken whenever a driver happens to run. This watcher probes on a
+cadence (bounded, out-of-process — a wedged tunnel hangs the probe
+subprocess, never the watcher) and, on the first healthy probe, runs every
+hardware-touching script in sequence:
+
+  1. bench.py (short patience — the headline dense-matmul GFLOPS + flash)
+  2. scripts/validate-shardmap-pallas.py  (Mosaic-under-shard_map proof)
+  3. scripts/bench-flash-attention.py     (kernel TFLOPS vs 2 XLA baselines)
+  4. scripts/bench-decode.py              (decode tok/s, int8, speculative)
+  5. scripts/bench-mfu.py                 (flagship MFU via the service path)
+
+Each script appends its own measurements to TPU_EVIDENCE.jsonl (see
+utils/evidence.py), so one healthy window yields a dated, git-attributed
+ledger that bench.py embeds in every later artifact even if the tunnel is
+wedged again by then. Scripts exiting 2 (chip vanished mid-battery) put the
+watcher back into its probe loop.
+
+Usage:
+  python scripts/capture-on-healthy.py              # until battery completes
+  python scripts/capture-on-healthy.py --forever    # keep re-capturing
+  python scripts/capture-on-healthy.py --interval 120 --max-hours 10
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+# (argv, per-script timeout seconds). Generous: one compile can take ~40 s
+# through the tunnel and the decode/MFU scripts compile several programs.
+BATTERY: list[tuple[list[str], float]] = [
+    ([sys.executable, str(REPO / "bench.py")], 900.0),
+    ([sys.executable, str(REPO / "scripts" / "validate-shardmap-pallas.py")], 600.0),
+    ([sys.executable, str(REPO / "scripts" / "bench-flash-attention.py")], 1200.0),
+    ([sys.executable, str(REPO / "scripts" / "bench-decode.py")], 1500.0),
+    ([sys.executable, str(REPO / "scripts" / "bench-mfu.py")], 1500.0),
+]
+
+
+def load_probe():
+    spec = importlib.util.spec_from_file_location("bench", REPO / "bench.py")
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+    return bench.probe_tpu
+
+
+def log(msg: str) -> None:
+    print(f"[capture {time.strftime('%H:%M:%S')}] {msg}", flush=True)
+
+
+def run_battery() -> bool:
+    """Run every battery script; True iff all succeeded (exit 0)."""
+    all_ok = True
+    for argv, timeout_s in BATTERY:
+        name = Path(argv[-1]).name
+        if not Path(argv[-1]).exists():
+            log(f"{name}: missing, skipped")
+            continue
+        log(f"running {name} (timeout {timeout_s:.0f}s)")
+        env = dict(os.environ)
+        if name == "bench.py":
+            # The watcher IS the patience; bench itself should not re-wait.
+            env["BCI_BENCH_TPU_PATIENCE_S"] = "90"
+        t0 = time.time()
+        try:
+            out = subprocess.run(
+                argv, capture_output=True, text=True,
+                timeout=timeout_s, cwd=REPO, env=env,
+            )
+        except subprocess.TimeoutExpired:
+            log(f"{name}: TIMED OUT after {timeout_s:.0f}s (tunnel wedged mid-run?)")
+            all_ok = False
+            continue
+        dt = time.time() - t0
+        for line in out.stdout.splitlines():
+            log(f"{name}: {line}")
+        if out.returncode == 2:
+            log(f"{name}: chip unreachable (exit 2) after {dt:.0f}s — back to probing")
+            return False
+        if out.returncode != 0:
+            log(f"{name}: FAILED exit {out.returncode} after {dt:.0f}s; "
+                f"stderr tail: {out.stderr[-500:]}")
+            all_ok = False
+        else:
+            log(f"{name}: ok in {dt:.0f}s")
+    return all_ok
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--interval", type=float, default=90.0,
+                    help="seconds between probes while wedged")
+    ap.add_argument("--max-hours", type=float, default=12.0)
+    ap.add_argument("--forever", action="store_true",
+                    help="keep re-capturing after a successful battery "
+                         "(cooldown = 10x interval)")
+    args = ap.parse_args()
+
+    probe_tpu = load_probe()
+    deadline = time.time() + args.max_hours * 3600
+    captures = 0
+    while time.time() < deadline:
+        probe = probe_tpu()
+        log(f"probe: {json.dumps(probe)}")
+        if probe.get("ok") and probe.get("platform") == "tpu":
+            log("tunnel HEALTHY — running battery")
+            if run_battery():
+                captures += 1
+                log(f"battery complete (capture #{captures})")
+                if not args.forever:
+                    return
+                time.sleep(args.interval * 10)
+                continue
+            log("battery incomplete — resuming probe loop")
+        time.sleep(args.interval)
+    log(f"max-hours reached; {captures} complete captures")
+    sys.exit(0 if captures else 3)
+
+
+if __name__ == "__main__":
+    main()

@@ -12,6 +12,7 @@ chip it builds a 1-device mesh and runs
 1. the local flash kernel inside shard_map (the tp path's structure),
 2. the flash-hop ring (1-hop degenerate ring: lax.ppermute + the causal
    kernel + lse merge machinery all lower),
+2b. the Ulysses standalone entry (all_to_all + flash in one shard_map),
 3. a tiny sharded transformer forward on the same mesh,
 
 each checked against its unsharded reference. Exits 2 without a TPU,
@@ -77,6 +78,17 @@ def main() -> None:
         (fn_ring(q, k, v) - ref).astype(jnp.float32)
     )))
 
+    # 2b. Ulysses standalone entry (flash under shard_map via all_to_all —
+    # the exact path ADVICE r3 flagged as never lowered on silicon)
+    from bee_code_interpreter_tpu.parallel.ulysses import (
+        ulysses_attention_sharded,
+    )
+
+    # Ulysses scatters heads over sp; KVH=2 divides sp=1 trivially here, the
+    # lowering (all_to_all + pallas_call under one shard_map) is the point.
+    out_uly = ulysses_attention_sharded(mesh, q, k, v, causal=True)
+    err_uly = float(jnp.max(jnp.abs((out_uly - ref).astype(jnp.float32))))
+
     # 3. sharded tiny transformer forward on the mesh vs mesh=None
     import dataclasses
 
@@ -91,15 +103,24 @@ def main() -> None:
     lg_none = forward(params, tokens, cfg, None)
     err_fwd = float(jnp.max(jnp.abs(lg_mesh - lg_none)))
 
-    ok = err_local < 1e-2 and err_ring < 1e-2 and err_fwd < 1e-2
-    print(json.dumps({
-        "case": "shardmap_pallas_mosaic",
+    ok = (err_local < 1e-2 and err_ring < 1e-2 and err_uly < 1e-2
+          and err_fwd < 1e-2)
+    payload = {
         "local_in_shardmap_err": round(err_local, 6),
         "flash_hop_ring_err": round(err_ring, 6),
+        "ulysses_sharded_err": round(err_uly, 6),
         "sharded_forward_err": round(err_fwd, 6),
         "ok": ok,
-    }))
-    if not ok:
+    }
+    if ok:
+        from bee_code_interpreter_tpu.utils import evidence
+
+        evidence.emit(
+            "shardmap_pallas_mosaic", payload,
+            script="scripts/validate-shardmap-pallas.py",
+        )
+    else:
+        print(json.dumps({"case": "shardmap_pallas_mosaic", **payload}))
         sys.exit(1)
 
 

@@ -1,0 +1,82 @@
+"""TPU_EVIDENCE.jsonl ledger: append-only hardware evidence that survives a
+wedged tunnel (VERDICT r3 next-round #1b). Tests point the ledger at a
+tmpdir via BCI_EVIDENCE_PATH so they never dirty the real one."""
+
+import json
+
+import pytest
+
+from bee_code_interpreter_tpu.utils import evidence
+
+
+@pytest.fixture
+def ledger(tmp_path, monkeypatch):
+    path = tmp_path / "ledger.jsonl"
+    monkeypatch.setenv("BCI_EVIDENCE_PATH", str(path))
+    return path
+
+
+def test_record_appends_timestamped_attributed_entry(ledger):
+    entry = evidence.record(
+        "dense_matmul", {"gflops": 185134.0}, script="bench.py"
+    )
+    assert entry["case"] == "dense_matmul"
+    assert entry["data"] == {"gflops": 185134.0}
+    assert entry["script"] == "bench.py"
+    assert entry["ts"].endswith("+00:00")  # UTC, attributable
+    on_disk = [json.loads(l) for l in ledger.read_text().splitlines()]
+    assert on_disk == [entry]
+
+
+def test_record_is_append_only(ledger):
+    evidence.record("a", {"v": 1}, script="s")
+    evidence.record("b", {"v": 2}, script="s")
+    assert len(ledger.read_text().splitlines()) == 2
+
+
+def test_latest_per_case_keeps_newest_and_skips_torn_lines(ledger):
+    evidence.record("decode", {"tokens_per_sec": 100}, script="s")
+    evidence.record("dense_matmul", {"gflops": 1.0}, script="s")
+    with ledger.open("a") as f:
+        f.write('{"torn json\n')  # a crashed writer must not break readers
+    evidence.record("decode", {"tokens_per_sec": 200}, script="s")
+    latest = evidence.latest_per_case()
+    by_case = {e["case"]: e["data"] for e in latest}
+    assert by_case == {
+        "decode": {"tokens_per_sec": 200},
+        "dense_matmul": {"gflops": 1.0},
+    }
+
+
+def test_read_all_missing_file_is_empty(ledger):
+    assert evidence.read_all() == []
+    assert evidence.latest_per_case() == []
+
+
+def test_record_never_raises_on_unwritable_path(tmp_path, monkeypatch):
+    # The ledger is a side channel: an unwritable target must not turn an
+    # already-successful hardware measurement into a failed script.
+    monkeypatch.setenv(
+        "BCI_EVIDENCE_PATH", str(tmp_path / "no" / "such" / "dir" / "l.jsonl")
+    )
+    entry = evidence.record("decode", {"tps": 1}, script="s")
+    assert "ledger_error" in entry
+    assert entry["case"] == "decode"
+
+
+def test_bench_embeds_ledger(ledger):
+    # bench.py's hardware_evidence() is the embed point: a wedged driver run
+    # must still carry the dated ledger entries.
+    import importlib.util
+    import sys
+    from pathlib import Path
+
+    repo = Path(__file__).resolve().parent.parent
+    spec = importlib.util.spec_from_file_location("bench", repo / "bench.py")
+    bench = importlib.util.module_from_spec(spec)
+    sys.modules["bench_for_evidence_test"] = bench
+    spec.loader.exec_module(bench)
+    evidence.record("flash_attention", {"tflops": 99.3}, script="bench.py")
+    embedded = bench.hardware_evidence()
+    assert [e["case"] for e in embedded] == ["flash_attention"]
+    assert embedded[0]["data"]["tflops"] == 99.3
