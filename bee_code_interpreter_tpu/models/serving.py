@@ -1,0 +1,234 @@
+"""Continuous batching over the paged KV cache.
+
+The serving loop the paged cache exists for: requests of heterogeneous
+lengths share one decode batch and one physical page pool. A request is
+admitted into a free batch row the moment one exists (no waiting for the
+whole batch to drain — "continuous" as opposed to static batching), its
+prompt is prefilled into freshly allocated pages, and every ``step()``
+advances ALL active rows by one token through a single compiled
+``decode_step_paged`` program. Finished rows (EOS or budget) free their
+pages immediately for the next admission.
+
+TPU-first split of responsibilities:
+
+- **Device**: one jitted fixed-shape program per step — [max_batch]-wide
+  regardless of how many rows are live (idle rows compute into a reserved
+  scratch page and are ignored). Shapes never depend on occupancy, so the
+  program compiles once.
+- **Host**: integer bookkeeping only — the free-page stack, block tables,
+  row admission/retirement. Mutating a block table or recycling pages is
+  numpy work between steps, never a re-trace.
+
+Greedy decoding matches ``Transformer.generate_cached`` token-for-token
+per request (pinned by tests/test_serving.py) — batching other requests
+alongside cannot change a request's output, which is the correctness bar
+for continuous batching.
+
+The reference has no model serving at all (SURVEY §2); within this rebuild
+the batcher is the library-level analogue of the service's warm sandbox
+pool: admit, run isolated, recycle.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bee_code_interpreter_tpu.models.transformer import (
+    TransformerConfig,
+    decode_step_paged,
+    forward,
+)
+from bee_code_interpreter_tpu.ops.paged_kv_cache import alloc_paged_cache
+
+# physical page 0 is the scratch page: idle rows' block tables point at it,
+# so their (masked, ignored) reads and writes never touch a live request's
+# pages; the allocator never hands it out.
+_SCRATCH_PAGE = 0
+
+
+class ContinuousBatcher:
+    """Admit → step → collect loop over ``decode_step_paged``.
+
+    ``max_batch`` bounds concurrent requests; ``n_pages``/``page_size``
+    size the shared pool; ``max_pages_per_seq`` is the block-table width
+    (the static gather width per step, so it bounds prompt+generation
+    length at ``max_pages_per_seq * page_size``).
+    """
+
+    def __init__(
+        self,
+        params,
+        config: TransformerConfig,
+        *,
+        max_batch: int = 8,
+        n_pages: int = 64,
+        page_size: int = 16,
+        max_pages_per_seq: int = 8,
+        eos_id: int | None = None,
+    ) -> None:
+        if config.kv_cache_dtype != "bf16":
+            raise NotImplementedError(
+                "the paged pool stores the direct-value (bf16) layout; an "
+                "int8 paged pool would add scale planes per page"
+            )
+        self.params = params
+        self.config = config
+        self.page_size = page_size
+        self.eos_id = eos_id
+        self.max_len = max_pages_per_seq * page_size
+        self.cache = alloc_paged_cache(config, n_pages, page_size)
+        self.block_table = np.full(
+            (max_batch, max_pages_per_seq), _SCRATCH_PAGE, dtype=np.int32
+        )
+        self.pos = np.zeros(max_batch, dtype=np.int32)
+        self.active = np.zeros(max_batch, dtype=bool)
+        self.current = np.zeros((max_batch, 1), dtype=np.int32)
+        self.budget = np.zeros(max_batch, dtype=np.int32)
+        # rows are recycled; request ids are forever — results are keyed by
+        # the id submit() returned, not by the row that happened to host it
+        self.row_request = np.full(max_batch, -1, dtype=np.int64)
+        self.results: dict[int, list[int]] = {}
+        self.done: dict[int, bool] = {}
+        self._next_request_id = 0
+        self.free_pages = list(range(n_pages - 1, _SCRATCH_PAGE, -1))
+        # donate the pool: without aliasing, every decoded token would pay
+        # a full page-pool HBM copy (precedent: make_train_step's donation)
+        self._decode = jax.jit(
+            functools.partial(decode_step_paged, config=config),
+            donate_argnums=(3,),
+        )
+        self._prefill = jax.jit(
+            functools.partial(forward, config=config, return_kv=True)
+        )
+
+    # ------------------------------------------------------------- admission
+    def has_free_row(self) -> bool:
+        return bool((~self.active).any())
+
+    def submit(self, prompt, max_new_tokens: int) -> int:
+        """Prefill ``prompt`` into freshly allocated pages and return a
+        REQUEST id (stable across row recycling). Raises if no free row or
+        not enough free pages (callers queue and retry after a step frees
+        capacity)."""
+        prompt = np.asarray(prompt, dtype=np.int32).reshape(-1)
+        L = int(prompt.shape[0])
+        if L < 1:
+            raise ValueError("prompt must be non-empty")
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        total = L + max_new_tokens
+        if total > self.max_len:
+            raise ValueError(
+                f"prompt+generation ({total}) exceeds the block table's "
+                f"budget ({self.max_len})"
+            )
+        free_rows = np.flatnonzero(~self.active)
+        if free_rows.size == 0:
+            raise RuntimeError("no free batch row (step() until one frees)")
+        n_need = -(-total // self.page_size)  # ceil
+        if n_need > len(self.free_pages):
+            raise RuntimeError(
+                f"page pool exhausted ({n_need} needed, "
+                f"{len(self.free_pages)} free)"
+            )
+        row = int(free_rows[0])
+        pages = [self.free_pages.pop() for _ in range(n_need)]
+        self.block_table[row, :] = _SCRATCH_PAGE
+        self.block_table[row, :n_need] = pages
+
+        # prefill: exact O(L^2) forward, then ONE batched scatter per pool
+        # (a per-page .at loop would rebuild the whole pool per page). The
+        # pad tail writes zeros into slots this sequence owns anyway —
+        # masked by s <= pos until real tokens overwrite them.
+        logits, (k_pre, v_pre) = self._prefill(self.params, prompt[None, :])
+        ps = self.page_size
+        n_prompt_pages = -(-L // ps)
+        pages_arr = jnp.asarray(pages[:n_prompt_pages], dtype=jnp.int32)
+
+        def paged_view(x, dtype):  # [n_layers, 1, kvh, L, dh] -> per-page
+            x = x[:, 0, :, :, :]
+            pad = n_prompt_pages * ps - L
+            x = jnp.pad(x, ((0, 0), (0, 0), (0, pad), (0, 0)))
+            nl, kvh, _, dh = x.shape
+            return (
+                x.reshape(nl, kvh, n_prompt_pages, ps, dh)
+                .transpose(0, 2, 1, 3, 4).astype(dtype)
+            )  # [n_layers, P, kvh, ps, dh]
+
+        self.cache = {
+            "k": self.cache["k"].at[:, pages_arr].set(
+                paged_view(k_pre, self.cache["k"].dtype)
+            ),
+            "v": self.cache["v"].at[:, pages_arr].set(
+                paged_view(v_pre, self.cache["v"].dtype)
+            ),
+        }
+        first = int(jnp.argmax(logits[0, L - 1, :]))
+        req = self._next_request_id
+        self._next_request_id += 1
+        self.pos[row] = L
+        self.current[row, 0] = first
+        self.budget[row] = max_new_tokens
+        self.row_request[row] = req
+        self.results[req] = [first]
+        self.done[req] = False
+        self.active[row] = True
+        self._retire_if_done(row)
+        return req
+
+    # ----------------------------------------------------------------- step
+    def step(self) -> None:
+        """Advance every active row by one token (one compiled program)."""
+        if not self.active.any():
+            return
+        logits, self.cache = self._decode(
+            self.params,
+            jnp.asarray(self.current),
+            jnp.asarray(self.pos),
+            self.cache,
+            jnp.asarray(self.block_table),
+        )
+        nxt = np.asarray(jnp.argmax(logits[:, -1, :], axis=-1), dtype=np.int32)
+        for row in np.flatnonzero(self.active):
+            self.pos[row] += 1
+            self.current[row, 0] = nxt[row]
+            self.results[int(self.row_request[row])].append(int(nxt[row]))
+            self._retire_if_done(int(row))
+
+    def _retire_if_done(self, row: int) -> None:
+        req = int(self.row_request[row])
+        out = self.results[req]
+        done = len(out) >= self.budget[row] or (
+            self.eos_id is not None and out[-1] == self.eos_id
+        )
+        if done:
+            self.active[row] = False
+            self.done[req] = True
+            self.row_request[row] = -1
+            used = set(self.block_table[row].tolist()) - {_SCRATCH_PAGE}
+            self.free_pages.extend(sorted(used, reverse=True))
+            self.block_table[row, :] = _SCRATCH_PAGE
+            # pos stays for inspection; scratch-page writes are masked
+
+    # -------------------------------------------------------------- results
+    def is_done(self, request_id: int) -> bool:
+        return self.done.get(request_id, False)
+
+    def result(self, request_id: int) -> list[int]:
+        """Generated tokens for a request (first token included)."""
+        if request_id not in self.results:
+            raise KeyError(f"unknown request {request_id}")
+        if not self.done[request_id]:
+            raise RuntimeError(f"request {request_id} still decoding")
+        return list(self.results[request_id])
+
+    def run_to_completion(self, max_steps: int = 10_000) -> None:
+        for _ in range(max_steps):
+            if not self.active.any():
+                return
+            self.step()
+        raise RuntimeError("run_to_completion exceeded max_steps")

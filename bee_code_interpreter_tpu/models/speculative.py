@@ -24,7 +24,10 @@ TPU-first mechanics:
   caches, but every cache read is masked by query position (``s ≤ p``), so
   stale entries beyond the committed cursor are invisible until the real
   token overwrites them. Rewind logic — the fiddly part of most
-  implementations — falls out of the position-masked cache design.
+  implementations — falls out of the position-masked cache design. This
+  holds for the int8 target cache too: quantization scales are per
+  (token, head) row, so a stale row's scale is overwritten with its row
+  and never contaminates neighbours.
 - **Lockstep batches**: the committed length per round is the minimum
   accept length over the batch. Rows that matched further simply recommit
   the same tokens next round — still exact, keeps every cache update a
@@ -78,12 +81,6 @@ def speculative_generate(
             "speculative_generate requires a dense target (MoE routing "
             "pools differ between the verify window and plain decode); "
             "use Transformer.generate_cached for MoE targets"
-        )
-    if tc.kv_cache_dtype != "bf16":
-        # fail before the two O(L²) prefills, not at the first verify
-        raise NotImplementedError(
-            "speculative_generate supports the bf16 target cache "
-            "(decode_window does not take the int8 layout)"
         )
     B, L = prompt.shape
     if max_new_tokens < 1:

@@ -281,11 +281,6 @@ def _attention(
     axes = mesh.axis_names
     tp = "tp" if "tp" in axes else None
     has_sp = "sp" in axes and mesh.shape["sp"] > 1
-    if window is not None and has_sp:
-        raise NotImplementedError(
-            "sliding_window is not threaded through the sp ring/Ulysses "
-            "paths; use a dp/fsdp/tp mesh"
-        )
     sp = "sp" if has_sp else None
     if tp is not None and k.shape[1] % mesh.shape["tp"] != 0:
         # KV heads don't split over tp: broadcast up — but only to
@@ -300,17 +295,21 @@ def _attention(
     spec = P(_batch_axes(mesh), tp, sp, None)
 
     if has_sp:
+        # sliding_window rides both sp strategies: the ring masks per hop in
+        # global offsets (parallel/ring_attention.py), Ulysses applies the
+        # ordinary local mask after its sequence gather (parallel/ulysses.py)
         if sp_attention == "ulysses":
             from bee_code_interpreter_tpu.parallel.ulysses import (
                 ulysses_attention,
             )
 
             local = functools.partial(
-                ulysses_attention, axis_name="sp", causal=causal
+                ulysses_attention, axis_name="sp", causal=causal,
+                window=window,
             )
         else:
             local = functools.partial(
-                ring_attention, axis_name="sp", causal=causal
+                ring_attention, axis_name="sp", causal=causal, window=window
             )
     else:
         local = functools.partial(_local_attention, causal=causal, window=window)
@@ -371,8 +370,9 @@ def _mlp_block(
     y: jax.Array, layer: Params, config: TransformerConfig
 ) -> tuple[jax.Array, jax.Array]:
     """The post-attention MLP (dense SwiGLU or MoE) — ONE copy shared by
-    _layer_apply, the int8 decode_step body, and decode_window. Returns
-    (mlp_out, aux) with aux = 0.0 for dense configs (decode paths drop it)."""
+    _layer_apply, decode_window (and through it decode_step), and
+    decode_step_paged. Returns (mlp_out, aux) with aux = 0.0 for dense
+    configs (decode paths drop it)."""
     c = config
     if c.n_experts:
         from bee_code_interpreter_tpu.models.moe import moe_mlp
@@ -534,6 +534,24 @@ def forward_pipelined(
 # ------------------------------------------------------------- cached decode
 
 
+def alloc_decode_cache(
+    config: TransformerConfig, B: int, total_len: int
+) -> dict:
+    """Zeroed decode cache in the configured layout. bf16 stores values
+    directly; int8 adds per-(token, head) scale leaves — the presence of
+    scales is what selects the quantized strategy in ops/kv_cache.py."""
+    c = config
+    shape = (c.n_layers, B, c.kv_heads, total_len, c.head_dim)
+    if c.kv_cache_dtype == "int8":
+        return {
+            "k": jnp.zeros(shape, jnp.int8),
+            "v": jnp.zeros(shape, jnp.int8),
+            "k_s": jnp.zeros(shape[:-1] + (1,), jnp.float32),
+            "v_s": jnp.zeros(shape[:-1] + (1,), jnp.float32),
+        }
+    return {"k": jnp.zeros(shape, c.dtype), "v": jnp.zeros(shape, c.dtype)}
+
+
 def init_decode_cache(
     config: TransformerConfig,
     B: int,
@@ -542,36 +560,12 @@ def init_decode_cache(
     v_pre: jax.Array,
 ) -> dict:
     """Allocate the full-length decode cache and seed it with the prefill
-    K/V. Layout depends on ``kv_cache_dtype``: bf16 stores values directly;
-    int8 stores quantized values + per-(token, head) scales
-    (ops/kv_cache.py)."""
-    c = config
-    L = k_pre.shape[3]
-    if c.kv_cache_dtype == "int8":
-        from bee_code_interpreter_tpu.ops.kv_cache import quantize
+    K/V through the same append strategy the decode bodies use."""
+    from bee_code_interpreter_tpu.ops.kv_cache import cache_append
 
-        shape = (c.n_layers, B, c.kv_heads, total_len, c.head_dim)
-        cache = {
-            "k": jnp.zeros(shape, jnp.int8),
-            "v": jnp.zeros(shape, jnp.int8),
-            "k_s": jnp.zeros(shape[:-1] + (1,), jnp.float32),
-            "v_s": jnp.zeros(shape[:-1] + (1,), jnp.float32),
-        }
-        kq, ks = quantize(k_pre)
-        vq, vs = quantize(v_pre)
-        cache["k"] = cache["k"].at[:, :, :, :L, :].set(kq)
-        cache["v"] = cache["v"].at[:, :, :, :L, :].set(vq)
-        cache["k_s"] = cache["k_s"].at[:, :, :, :L, :].set(ks)
-        cache["v_s"] = cache["v_s"].at[:, :, :, :L, :].set(vs)
-        return cache
-    shape = (c.n_layers, B, c.kv_heads, total_len, c.head_dim)
-    k_cache = jnp.zeros(shape, c.dtype).at[:, :, :, :L, :].set(
-        k_pre.astype(c.dtype)
+    return cache_append(
+        alloc_decode_cache(config, B, total_len), k_pre, v_pre, 0
     )
-    v_cache = jnp.zeros(shape, c.dtype).at[:, :, :, :L, :].set(
-        v_pre.astype(c.dtype)
-    )
-    return {"k": k_cache, "v": v_cache}
 
 
 def decode_step(
@@ -592,77 +586,11 @@ def decode_step(
     bytes the bandwidth-bound loop streams); dequantization rides the
     attention einsums' operand pipeline.
 
-    The bf16 path IS ``decode_window`` with W=1 (one layer body, no second
-    copy to drift); this function keeps only the int8-cache body, which
-    quantizes the new token's K/V per row.
+    This IS ``decode_window`` with W=1 for both cache layouts — ONE layer
+    body (cache strategy selected by ops/kv_cache.cache_append/cache_read),
+    so the int8 and bf16 decode math cannot drift apart.
     """
-    c = config
-    if c.kv_cache_dtype != "int8":
-        return decode_window(params, token, pos, cache, config)
-    B = token.shape[0]
-    max_len = cache["k"].shape[3]
-    positions = jnp.full((B, 1), pos, dtype=jnp.int32)
-
-    h = params["embed"].astype(c.dtype)[token[:, 0]][:, None, :]  # [B, 1, D]
-
-    def layer_step(h, scanned):
-        layer, c_layer = scanned  # cache leaves: [B, kvh, max, ·]
-        x = rms_norm(h, layer["ln1"])
-        dh, nh, kvh = c.head_dim, c.n_heads, c.kv_heads
-
-        def proj(w, heads):
-            out = jnp.einsum("bld,dk->blk", x, w.astype(c.dtype))
-            return out.reshape(B, 1, heads, dh).transpose(0, 2, 1, 3)
-
-        q = rope(
-            proj(layer["wq"], nh), positions, c.rope_theta, c.rope_scaling
-        )  # [B,nh,1,Dh]
-        k_new = rope(proj(layer["wk"], kvh), positions, c.rope_theta, c.rope_scaling)
-        v_new = proj(layer["wv"], kvh)
-        from bee_code_interpreter_tpu.ops.kv_cache import (
-            dequantize,
-            quantize,
-        )
-
-        kq, ks = quantize(k_new)
-        vq, vs = quantize(v_new)
-        c_layer = {
-            "k": lax.dynamic_update_slice(c_layer["k"], kq, (0, 0, pos, 0)),
-            "v": lax.dynamic_update_slice(c_layer["v"], vq, (0, 0, pos, 0)),
-            "k_s": lax.dynamic_update_slice(
-                c_layer["k_s"], ks, (0, 0, pos, 0)
-            ),
-            "v_s": lax.dynamic_update_slice(
-                c_layer["v_s"], vs, (0, 0, pos, 0)
-            ),
-        }
-        kf = dequantize(c_layer["k"], c_layer["k_s"])
-        vf = dequantize(c_layer["v"], c_layer["v_s"], c.dtype)
-
-        # grouped-query decode: q regrouped [B, kvh, rep, Dh] so the einsums
-        # broadcast over the compact cache — the decode step is KV-cache-
-        # bandwidth-bound, and this reads kvh heads of HBM, not nh
-        rep = nh // kvh
-        qg = q[:, :, 0, :].reshape(B, kvh, rep, dh).astype(jnp.float32)
-        scores = jnp.einsum("bgrd,bgsd->bgrs", qg, kf) / math.sqrt(dh)
-        visible = jnp.arange(max_len) <= pos  # [max]
-        if c.sliding_window is not None:
-            visible &= jnp.arange(max_len) > pos - c.sliding_window
-        scores = jnp.where(visible[None, None, None, :], scores, -jnp.inf)
-        weights = jax.nn.softmax(scores, axis=-1).astype(vf.dtype)
-        attn = jnp.einsum("bgrs,bgsd->bgrd", weights, vf)  # [B,kvh,rep,Dh]
-        attn = attn.astype(c.dtype).reshape(B, 1, nh * dh)
-        h = h + jnp.einsum("blk,kd->bld", attn, layer["wo"].astype(c.dtype))
-
-        y = rms_norm(h, layer["ln2"])
-        mlp, _ = _mlp_block(y, layer, c)
-        h = h + mlp
-        return h, c_layer
-
-    h, cache = lax.scan(layer_step, h, (params["layers"], cache))
-    h = rms_norm(h, params["ln_f"])
-    logits = jnp.einsum("bld,dv->blv", h, params["lm_head"].astype(c.dtype))
-    return logits.astype(jnp.float32), cache
+    return decode_window(params, token, pos, cache, config)
 
 
 def decode_window(
@@ -671,6 +599,7 @@ def decode_window(
     pos0: jax.Array,  # scalar int32: position of tokens[:, 0]
     cache: dict,  # init_decode_cache layout
     config: TransformerConfig,
+    mesh: Mesh | None = None,
 ) -> tuple[jax.Array, dict]:
     """Multi-token cached decode: like ``decode_step`` but for a window of
     ``W`` consecutive tokens at positions ``pos0..pos0+W-1`` — one forward
@@ -678,22 +607,31 @@ def decode_window(
     is speculative decoding's verify step: the target model scores a
     drafted window in ONE pass instead of W sequential steps.
 
-    Static shapes throughout (W is static; ``pos0`` is dynamic); the
-    bf16 cache layout only (the int8 path quantizes per token row — use
-    ``decode_step`` for it).
+    Static shapes throughout (W is static; ``pos0`` is dynamic). Both cache
+    layouts: the int8 strategy quantizes the window per (token, head) row —
+    each row's scale is independent, so a window append is bit-identical to
+    W single-step appends and the speculative verify stays exact over the
+    quantized cache.
+
+    ``mesh``: decode attention is plain einsums, so GSPMD shards them from
+    the param shardings on its own; the constraint here just pins the
+    activation batch to the data axes (same annotation level as ``forward``)
+    so a chunked prefill on a sharded model lays out like the decode loop.
     """
     c = config
-    if c.kv_cache_dtype != "bf16":
-        raise NotImplementedError(
-            "decode_window supports the bf16 cache layout; speculative "
-            "decoding with int8 caches would quantize the window per row"
-        )
     B, W = tokens.shape
     max_len = cache["k"].shape[3]
     positions = pos0 + jnp.arange(W, dtype=jnp.int32)[None, :]  # [1, W]
     positions = jnp.broadcast_to(positions, (B, W))
 
-    h = params["embed"].astype(c.dtype)[tokens]  # [B, W, D]
+    def constrain(x):
+        if mesh is None:
+            return x
+        return lax.with_sharding_constraint(
+            x, NamedSharding(mesh, P(_batch_axes(mesh), None, None))
+        )
+
+    h = constrain(params["embed"].astype(c.dtype)[tokens])  # [B, W, D]
 
     def layer_step(h, scanned):
         layer, c_layer = scanned
@@ -709,14 +647,16 @@ def decode_window(
         )  # [B,nh,W,Dh]
         k_new = rope(proj(layer["wk"], kvh), positions, c.rope_theta, c.rope_scaling)
         v_new = proj(layer["wv"], kvh)
-        c_layer = {
-            "k": lax.dynamic_update_slice(c_layer["k"], k_new, (0, 0, pos0, 0)),
-            "v": lax.dynamic_update_slice(c_layer["v"], v_new, (0, 0, pos0, 0)),
-        }
+        from bee_code_interpreter_tpu.ops.kv_cache import (
+            cache_append,
+            cache_read,
+        )
+
+        c_layer = cache_append(c_layer, k_new, v_new, pos0)
+        kf, vf = cache_read(c_layer, c.dtype)  # kf f32, vf c.dtype
 
         rep = nh // kvh
         qg = q.reshape(B, kvh, rep, W, dh).astype(jnp.float32)
-        kf = c_layer["k"].astype(jnp.float32)
         scores = jnp.einsum("bgrwd,bgsd->bgrws", qg, kf) / math.sqrt(dh)
         # row w (position pos0+w) sees cache positions s <= pos0+w (and
         # within the sliding window when configured)
@@ -730,8 +670,87 @@ def decode_window(
             visible[None, None, None, :, :], scores, -jnp.inf
         )
         weights = jax.nn.softmax(scores, axis=-1).astype(c.dtype)
-        attn = jnp.einsum("bgrws,bgsd->bgrwd", weights, c_layer["v"])
+        attn = jnp.einsum("bgrws,bgsd->bgrwd", weights, vf)
         attn = attn.transpose(0, 3, 1, 2, 4).reshape(B, W, nh * dh)
+        h = h + constrain(
+            jnp.einsum("blk,kd->bld", attn, layer["wo"].astype(c.dtype))
+        )
+
+        y = rms_norm(h, layer["ln2"])
+        mlp, _ = _mlp_block(y, layer, c)
+        h = h + constrain(mlp)
+        return h, c_layer
+
+    h, cache = lax.scan(layer_step, h, (params["layers"], cache))
+    h = rms_norm(h, params["ln_f"])
+    logits = jnp.einsum("bld,dv->blv", h, params["lm_head"].astype(c.dtype))
+    return logits.astype(jnp.float32), cache
+
+
+def decode_step_paged(
+    params: Params,
+    token: jax.Array,  # [B, 1] int32 — each row's current token
+    pos: jax.Array,  # [B] int32 — PER-ROW positions (heterogeneous lengths)
+    cache: dict,  # ops/paged_kv_cache.alloc_paged_cache pool
+    block_table: jax.Array,  # [B, P] int32 logical block -> physical page
+    config: TransformerConfig,
+) -> tuple[jax.Array, dict]:
+    """One incremental decode step over the PAGED cache — the serving-side
+    sibling of ``decode_step``: rows carry their own positions (so a batch
+    can mix requests at different lengths — continuous batching,
+    models/serving.py) and K/V live in a shared page pool indirected
+    through ``block_table`` (ops/paged_kv_cache.py).
+
+    The layer math is decode_window's W=1 grouped-query einsums verbatim;
+    only the cache indexing differs, so paged-vs-contiguous equality is an
+    indexing property (pinned by tests/test_paged_kv_cache.py, including
+    permuted page tables). bf16 pool layout; rows whose slot would exceed
+    the table's page budget are a scheduler bug (the scatter clamps).
+    """
+    from bee_code_interpreter_tpu.ops.paged_kv_cache import (
+        paged_append,
+        paged_read,
+    )
+
+    c = config
+    B = token.shape[0]
+    page_size = cache["k"].shape[3]
+    S = block_table.shape[1] * page_size
+    positions = pos[:, None]  # [B, 1]
+    page_idx = jnp.take_along_axis(
+        block_table, (pos // page_size)[:, None], axis=1
+    )[:, 0]
+    slot_idx = pos % page_size
+
+    h = params["embed"].astype(c.dtype)[token[:, 0]][:, None, :]  # [B, 1, D]
+
+    def layer_step(h, scanned):
+        layer, c_layer = scanned  # pool slices [n_pages, kvh, ps, dh]
+        x = rms_norm(h, layer["ln1"])
+        dh, nh, kvh = c.head_dim, c.n_heads, c.kv_heads
+
+        def proj(w, heads):
+            out = jnp.einsum("bld,dk->blk", x, w.astype(c.dtype))
+            return out.reshape(B, 1, heads, dh).transpose(0, 2, 1, 3)
+
+        q = rope(proj(layer["wq"], nh), positions, c.rope_theta, c.rope_scaling)
+        k_new = rope(proj(layer["wk"], kvh), positions, c.rope_theta, c.rope_scaling)
+        v_new = proj(layer["wv"], kvh)
+        c_layer = paged_append(
+            c_layer, k_new[:, :, 0, :], v_new[:, :, 0, :], page_idx, slot_idx
+        )
+        kf, vf = paged_read(c_layer, block_table)  # [B, kvh, S, dh]
+
+        rep = nh // kvh
+        qg = q[:, :, 0, :].reshape(B, kvh, rep, dh).astype(jnp.float32)
+        scores = jnp.einsum("bgrd,bgsd->bgrs", qg, kf) / math.sqrt(dh)
+        visible = jnp.arange(S)[None, :] <= pos[:, None]  # [B, S]
+        if c.sliding_window is not None:
+            visible &= jnp.arange(S)[None, :] > pos[:, None] - c.sliding_window
+        scores = jnp.where(visible[:, None, None, :], scores, -jnp.inf)
+        weights = jax.nn.softmax(scores, axis=-1).astype(c.dtype)
+        attn = jnp.einsum("bgrs,bgsd->bgrd", weights, vf)
+        attn = attn.astype(c.dtype).reshape(B, 1, nh * dh)
         h = h + jnp.einsum("blk,kd->bld", attn, layer["wo"].astype(c.dtype))
 
         y = rms_norm(h, layer["ln2"])
@@ -751,6 +770,7 @@ def prefill_chunked(
     config: TransformerConfig,
     total_len: int,
     chunk: int = 512,
+    mesh: Mesh | None = None,
 ) -> tuple[jax.Array, dict]:
     """Build the decode cache by streaming the prompt through
     ``decode_window`` in fixed-size chunks instead of one O(L²) forward —
@@ -765,11 +785,11 @@ def prefill_chunked(
     remainder chunk (L % chunk) adds at most one more.
     """
     c = config
-    if c.kv_cache_dtype != "bf16":
-        raise NotImplementedError(
-            "prefill_chunked builds the bf16 cache layout (decode_window)"
-        )
     B, L = prompt.shape
+    if L == 0:
+        # an empty prompt yields no last_logits to start decode from; fail
+        # here, not later in sample_logits with an opaque None error
+        raise ValueError("prompt must be non-empty (L >= 1)")
     if total_len < L:
         # an undersized cache would be silently corrupted: clamped
         # dynamic_update_slice writes shift later chunks onto earlier rows
@@ -778,11 +798,7 @@ def prefill_chunked(
         )
     if chunk < 1:
         raise ValueError(f"chunk must be >= 1, got {chunk}")
-    shape = (c.n_layers, B, c.kv_heads, total_len, c.head_dim)
-    cache = {
-        "k": jnp.zeros(shape, c.dtype),
-        "v": jnp.zeros(shape, c.dtype),
-    }
+    cache = alloc_decode_cache(c, B, total_len)
 
     n_full, rem = divmod(L, chunk)
     last_logits = None
@@ -791,7 +807,7 @@ def prefill_chunked(
 
         def body(cache, x):
             toks, pos0 = x
-            logits, cache = decode_window(params, toks, pos0, cache, c)
+            logits, cache = decode_window(params, toks, pos0, cache, c, mesh)
             return cache, logits[:, -1, :]
 
         cache, last_per_chunk = lax.scan(
@@ -806,7 +822,7 @@ def prefill_chunked(
     if rem:
         logits, cache = decode_window(
             params, prompt[:, n_full * chunk :], jnp.int32(n_full * chunk),
-            cache, c,
+            cache, c, mesh,
         )
         last_logits = logits[:, -1, :]
     return last_logits, cache
@@ -965,7 +981,10 @@ class Transformer:
         ``max_new_tokens`` steps; finished rows just stop changing).
         ``prefill_chunk`` streams the prompt through ``prefill_chunked``
         instead of one O(L²) forward (long prompts in bounded memory;
-        bf16 cache, single-shard only). For
+        either cache layout — note the int8 cache's prefill attention reads
+        progressively quantized K/V, the same semantics incremental decode
+        has, where the full prefill attends in exact bf16 before
+        quantizing). For
         MoE configs greedy equality holds only drop-free (ample capacity):
         under capacity pressure the full forward routes tokens in
         competition while decode routes each token alone — inherent to
@@ -977,13 +996,8 @@ class Transformer:
             key = jax.random.PRNGKey(0)
 
         if prefill_chunk is not None:
-            if self.mesh is not None:
-                raise NotImplementedError(
-                    "prefill_chunk is single-shard (decode_window takes no "
-                    "mesh); use the full prefill on meshes"
-                )
             last_logits, cache = prefill_chunked(
-                params, prompt, c, total, chunk=prefill_chunk
+                params, prompt, c, total, chunk=prefill_chunk, mesh=self.mesh
             )
         else:
             logits, (k_pre, v_pre) = forward(

@@ -596,7 +596,7 @@ def _flash_bwd_rule(causal, sm_scale, block_q, block_k, interpret, window,
 flash_attention.defvjp(_flash_fwd_rule, _flash_bwd_rule)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
 def flash_attention_with_lse(
     q, k, v,
     causal: bool = True,
@@ -604,33 +604,37 @@ def flash_attention_with_lse(
     block_q: int = 1024,
     block_k: int = 1024,
     interpret: bool | None = None,
+    window: int | None = None,
 ):
     """Like ``flash_attention`` but also returns the per-row log-sum-exp
     ([B, H, L] f32) of the (scaled, masked) scores — the quantity needed to
     combine attention over key blocks computed separately (ring attention's
     per-hop kernel calls merge on it). Fully differentiable, INCLUDING
     through the lse output: its cotangent folds into the backward's delta
-    shift (see _flash_bwd_pallas)."""
+    shift (see _flash_bwd_pallas). ``window`` is the same sliding-window
+    masking as ``flash_attention`` (the ring's own-block hop uses it)."""
     (out, lse), _ = _with_lse_fwd_rule(
-        q, k, v, causal, sm_scale, block_q, block_k, interpret
+        q, k, v, causal, sm_scale, block_q, block_k, interpret, window
     )
     return out, lse
 
 
-def _with_lse_fwd_rule(q, k, v, causal, sm_scale, block_q, block_k, interpret):
+def _with_lse_fwd_rule(q, k, v, causal, sm_scale, block_q, block_k, interpret,
+                       window=None):
     out, res = _flash_fwd_rule(
-        q, k, v, causal, sm_scale, block_q, block_k, interpret
+        q, k, v, causal, sm_scale, block_q, block_k, interpret, window
     )
     lse = res[4]  # [B·H, L]
     B, H, L, _ = q.shape
     return (out, lse.reshape(B, H, L)), res
 
 
-def _with_lse_bwd_rule(causal, sm_scale, block_q, block_k, interpret, residuals, g):
+def _with_lse_bwd_rule(causal, sm_scale, block_q, block_k, interpret, window,
+                       residuals, g):
     g_out, g_lse = g
     return _bwd_impl(
         causal, sm_scale, block_q, block_k, interpret, residuals, g_out,
-        g_lse=g_lse,
+        g_lse=g_lse, window=window,
     )
 
 

@@ -1,0 +1,98 @@
+"""Paged KV cache: block-table indirection over a shared page pool.
+
+The modern serving primitive (vLLM's PagedAttention, here TPU-first):
+instead of one contiguous [B, kvh, max_len, dh] cache per batch — which
+reserves worst-case length for every row — K/V live in fixed-size PAGES
+drawn from one pool, and each sequence maps logical block → physical page
+through a small int32 block table. Heterogeneous-length requests then share
+the pool densely: a 100-token and a 4000-token request cost pages
+proportional to their actual lengths, and a finished request's pages are
+recycled immediately (models/serving.py does the recycling — continuous
+batching).
+
+TPU-first constraints shape the layout:
+
+- **Static shapes everywhere.** The pool, the block table, and the gather
+  in ``paged_read`` are all fixed-size; "allocation" is host-side integer
+  bookkeeping between steps, never a traced shape change.
+- **Gather/scatter ride XLA.** ``paged_read`` is one advanced-indexing
+  gather (lowered to a single dynamic-gather HLO) producing the same
+  [B, kvh, S, dh] view the contiguous attention einsums consume — the
+  decode layer math is UNCHANGED (models/transformer.decode_step_paged
+  reuses the grouped-query einsums), so paged-vs-contiguous equality is a
+  pure indexing property, pinned by tests/test_paged_kv_cache.py.
+- **Page size is a multiple of the lane tile.** Pages are [kvh, page_size,
+  dh] slabs; dh is contiguous and page_size defaults to a multiple of 8 so
+  gathered slabs keep the (8, 128) tiling XLA wants.
+
+The reference has no serving stack at all (SURVEY §2); this module is part
+of the rebuild's decode family next to the int8 cache (ops/kv_cache.py).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def alloc_paged_cache(config, n_pages: int, page_size: int) -> dict:
+    """Zeroed page pool: k/v [n_layers, n_pages, kvh, page_size, dh].
+
+    One pool serves every layer by giving each layer its own leading-axis
+    slice of every page — a sequence's page i holds layer ℓ's tokens at
+    ``pages[ℓ, page]``, so the block table is shared across layers (one
+    table per sequence, not per layer — same trick as the stacked
+    contiguous cache).
+    """
+    c = config
+    if page_size < 1:
+        raise ValueError(f"page_size must be >= 1, got {page_size}")
+    shape = (c.n_layers, n_pages, c.kv_heads, page_size, c.head_dim)
+    return {"k": jnp.zeros(shape, c.dtype), "v": jnp.zeros(shape, c.dtype)}
+
+
+def paged_append(
+    c_layer: dict,  # one layer's pool slice: [n_pages, kvh, ps, dh]
+    k_new: jax.Array,  # [B, kvh, dh] — one token per row
+    v_new: jax.Array,
+    page_idx: jax.Array,  # [B] int32 physical page per row
+    slot_idx: jax.Array,  # [B] int32 slot within the page
+) -> dict:
+    """Scatter one new token's K/V per batch row into its (page, slot).
+
+    Rows of a batch may land in arbitrary distinct pages — the scatter is
+    one XLA scatter op. Two rows writing the same (page, slot) is a
+    scheduler bug (pages are owned by one sequence); last-writer-wins as
+    with any scatter.
+    """
+    dtype = c_layer["k"].dtype
+    return {
+        "k": c_layer["k"].at[page_idx, :, slot_idx, :].set(
+            k_new.astype(dtype)
+        ),
+        "v": c_layer["v"].at[page_idx, :, slot_idx, :].set(
+            v_new.astype(dtype)
+        ),
+    }
+
+
+def paged_read(
+    c_layer: dict,  # [n_pages, kvh, ps, dh]
+    block_table: jax.Array,  # [B, P] int32 logical block -> physical page
+) -> tuple[jax.Array, jax.Array]:
+    """Gather each row's pages into the contiguous [B, kvh, P·ps, dh] view
+    the attention einsums consume. K comes back f32 (scores operand), V in
+    the pool dtype — the same contract as ops/kv_cache.cache_read."""
+    B, P = block_table.shape
+    n_pages, kvh, ps, dh = c_layer["k"].shape
+
+    def view(x, dtype):
+        g = x[block_table]  # [B, P, kvh, ps, dh]
+        return (
+            g.transpose(0, 2, 1, 3, 4).reshape(B, kvh, P * ps, dh)
+            .astype(dtype)
+        )
+
+    return view(c_layer["k"], jnp.float32), view(
+        c_layer["v"], c_layer["v"].dtype
+    )

@@ -63,6 +63,7 @@ def ring_attention(
     causal: bool = True,
     sm_scale: float | None = None,
     use_flash: bool | None = None,
+    window: int | None = None,
 ) -> jax.Array:
     """Exact attention with K/V rotating around the ``axis_name`` ring.
 
@@ -78,14 +79,27 @@ def ring_attention(
     accumulation, and the same-block hop gets the kernel's causal
     block-skipping. Differentiable either way (the lse outputs carry real
     gradients; the kernel's VJP folds them into its delta shift).
+
+    ``window`` (requires ``causal``) is sliding-window attention in GLOBAL
+    positions: query at global position p sees keys in (p - window, p].
+    Block structure per hop, with delta = (my_idx - k_idx) · L_local the
+    query-block/key-block global offset: hops entirely below the window
+    (delta ≥ window + L_local - 1) are skipped like future blocks — a
+    window spanning w/L_local blocks turns the ring's O(sp) attended hops
+    into O(w/L_local) while still paying sp-1 ppermutes; the own block uses
+    the local causal+window mask; straddling hops mask rows to
+    row - col < window - delta.
     """
+    if window is not None and not causal:
+        raise ValueError("window requires causal=True (sliding window)")
     if use_flash is None:
         from bee_code_interpreter_tpu.ops.flash_attention import uses_flash
 
         use_flash = uses_flash()
     if use_flash:
         return _ring_attention_flash(
-            q, k, v, axis_name=axis_name, causal=causal, sm_scale=sm_scale
+            q, k, v, axis_name=axis_name, causal=causal, sm_scale=sm_scale,
+            window=window,
         )
     orig_dtype = q.dtype
     B, H, Lq, D = q.shape
@@ -107,10 +121,13 @@ def ring_attention(
     o0 = jnp.zeros_like(qf)
 
     causal_mask = None
+    row = lax.broadcasted_iota(jnp.int32, (Lq, Lk), 0)
+    col = lax.broadcasted_iota(jnp.int32, (Lq, Lk), 1)
     if causal:
-        row = lax.broadcasted_iota(jnp.int32, (Lq, Lk), 0)
-        col = lax.broadcasted_iota(jnp.int32, (Lq, Lk), 1)
-        causal_mask = jnp.where(row >= col, 0.0, -jnp.inf).astype(jnp.float32)
+        visible = row >= col
+        if window is not None:  # own block: local offsets == global offsets
+            visible &= row - col < window
+        causal_mask = jnp.where(visible, 0.0, -jnp.inf).astype(jnp.float32)
 
     # send to next ring member; after `step` hops we hold block (my_idx - step)
     perm = [(i, (i + 1) % n) for i in range(n)]
@@ -122,12 +139,19 @@ def ring_attention(
         def attend(args):
             m, l, o = args
             if causal:
-                # same block: triangular mask; earlier block: no mask
+                # same block: triangular (+window) mask; earlier block: no
+                # mask, or the window-straddle mask in global offsets
                 def same_block(_):
                     return _block_attend(qf, k_blk, v_blk, m, l, o, sm_scale, causal_mask)
 
                 def earlier_block(_):
-                    return _block_attend(qf, k_blk, v_blk, m, l, o, sm_scale, None)
+                    mask = None
+                    if window is not None:
+                        delta = (my_idx - k_idx) * Lq  # global row - col shift
+                        mask = jnp.where(
+                            row - col < window - delta, 0.0, -jnp.inf
+                        ).astype(jnp.float32)
+                    return _block_attend(qf, k_blk, v_blk, m, l, o, sm_scale, mask)
 
                 return lax.cond(k_idx == my_idx, same_block, earlier_block, None)
             return _block_attend(qf, k_blk, v_blk, m, l, o, sm_scale, None)
@@ -136,7 +160,12 @@ def ring_attention(
             return args
 
         if causal:
-            m, l, o = lax.cond(k_idx > my_idx, skip, attend, (m, l, o))
+            skip_pred = k_idx > my_idx  # future block
+            if window is not None:
+                # entirely below the window: min global offset over the
+                # block, (my_idx - k_idx)·L - (L-1), already >= window
+                skip_pred |= (my_idx - k_idx) * Lq - (Lq - 1) >= window
+            m, l, o = lax.cond(skip_pred, skip, attend, (m, l, o))
         else:
             m, l, o = attend((m, l, o))
 
@@ -158,6 +187,7 @@ def _ring_attention_flash(
     axis_name: str,
     causal: bool,
     sm_scale: float | None,
+    window: int | None = None,
 ) -> jax.Array:
     """Ring attention with the Pallas flash kernel per hop.
 
@@ -168,6 +198,15 @@ def _ring_attention_flash(
     blocks attend fully (kernel causal=False), the own block triangularly
     (causal=True), later blocks are skipped. lax.cond keeps both kernel
     variants compiled once; the skip branch costs nothing but the carry.
+
+    ``window`` rides the same structure: the own block uses the kernel's
+    causal+window masking (static width — same offsets as local attention);
+    hops fully inside the window run the plain non-causal kernel; hops the
+    window boundary straddles (at most ceil(window/L_local) of them) run a
+    jax-level masked softmax block — its mask width (window − delta) is
+    device-dependent, which a static kernel parameter cannot express — and
+    merge on lse exactly like kernel hops; hops entirely below the window
+    are skipped like future blocks.
     """
     from bee_code_interpreter_tpu.ops.flash_attention import (
         flash_attention_with_lse,
@@ -175,8 +214,11 @@ def _ring_attention_flash(
 
     orig_dtype = q.dtype
     B, H, Lq, D = q.shape
+    KVH = k.shape[1]
+    Lk = k.shape[2]
     n = lax.axis_size(axis_name)
     my_idx = lax.axis_index(axis_name)
+    scale = sm_scale if sm_scale is not None else D ** -0.5
 
     NEG = jnp.float32(-1e30)  # not -inf: (-inf) - (-inf) would NaN the scale
     m0 = jnp.full((B, H, Lq, 1), NEG) + jnp.zeros_like(
@@ -187,6 +229,32 @@ def _ring_attention_flash(
 
     perm = [(i, (i + 1) % n) for i in range(n)]
 
+    def boundary_block(k_idx, k_blk, v_blk):
+        """One jax-level online-softmax block with the window-straddle mask
+        (row − col < window − delta in global offsets), returned as
+        (normalized out, lse) so it merges like a kernel hop. Fully-masked
+        rows surface as lse ≈ −1e30 and merge to weight 0."""
+        delta = (my_idx - k_idx) * Lq
+        qf = q.astype(jnp.float32).reshape(B, KVH, H // KVH, Lq, D)
+        scores = jnp.einsum(
+            "bgrqd,bgkd->bgrqk", qf, k_blk.astype(jnp.float32),
+            preferred_element_type=jnp.float32,
+        ) * scale
+        row = lax.broadcasted_iota(jnp.int32, (Lq, Lk), 0)
+        col = lax.broadcasted_iota(jnp.int32, (Lq, Lk), 1)
+        scores = jnp.where(row - col < window - delta, scores, NEG)
+        m_b = jnp.max(scores, axis=-1, keepdims=True)
+        p = jnp.exp(scores - m_b)
+        l_b = jnp.sum(p, axis=-1, keepdims=True)  # >= 1: some e^0 survives
+        out = jnp.einsum(
+            "bgrqk,bgkd->bgrqd", p, v_blk.astype(jnp.float32)
+        ) / l_b
+        lse = (m_b + jnp.log(l_b))[..., 0]  # [B, KVH, rep, Lq]
+        return (
+            out.reshape(B, H, Lq, D).astype(orig_dtype),
+            lse.reshape(B, H, Lq),
+        )
+
     def body(step, carry):
         m, s, o, k_blk, v_blk = carry
         k_idx = (my_idx - step) % n
@@ -195,10 +263,26 @@ def _ring_attention_flash(
             m, s, o = args
 
             def own_block(_):
-                return flash_attention_with_lse(q, k_blk, v_blk, True, sm_scale)
+                return flash_attention_with_lse(
+                    q, k_blk, v_blk, True, sm_scale, window=window
+                )
 
             def earlier_block(_):
-                return flash_attention_with_lse(q, k_blk, v_blk, False, sm_scale)
+                if window is None:
+                    return flash_attention_with_lse(q, k_blk, v_blk, False, sm_scale)
+
+                def full_block(_):
+                    return flash_attention_with_lse(q, k_blk, v_blk, False, sm_scale)
+
+                # fully visible iff even the largest offset, delta + (L-1),
+                # is inside the window
+                delta = (my_idx - k_idx) * Lq
+                return lax.cond(
+                    delta + Lq - 1 < window,
+                    full_block,
+                    lambda _: boundary_block(k_idx, k_blk, v_blk),
+                    None,
+                )
 
             if causal:
                 out_blk, lse_blk = lax.cond(
@@ -218,7 +302,10 @@ def _ring_attention_flash(
             return args
 
         if causal:
-            m, s, o = lax.cond(k_idx > my_idx, skip, attend, (m, s, o))
+            skip_pred = k_idx > my_idx
+            if window is not None:
+                skip_pred |= (my_idx - k_idx) * Lq - (Lq - 1) >= window
+            m, s, o = lax.cond(skip_pred, skip, attend, (m, s, o))
         else:
             m, s, o = attend((m, s, o))
 
@@ -241,11 +328,12 @@ def ring_attention_sharded(
     causal: bool = True,
     sm_scale: float | None = None,
     use_flash: bool | None = None,
+    window: int | None = None,
 ) -> jax.Array:
     """Standalone entry: shards [B, H, L, D] inputs over ``axis_name`` on L
     and runs the ring. For use outside an existing shard_map context.
-    ``sm_scale``/``use_flash`` forward to ``ring_attention`` (so the einsum
-    fallback or the flash-hop path can be forced from here too)."""
+    ``sm_scale``/``use_flash``/``window`` forward to ``ring_attention`` (so
+    the einsum fallback or the flash-hop path can be forced from here too)."""
     spec = P(None, None, axis_name, None)
     # the flash-hop path runs pallas_call under shard_map, which vma
     # checking cannot lower yet — disable the check exactly when that path
@@ -256,7 +344,7 @@ def ring_attention_sharded(
     fn = jax.shard_map(
         functools.partial(
             ring_attention, axis_name=axis_name, causal=causal,
-            sm_scale=sm_scale, use_flash=use_flash,
+            sm_scale=sm_scale, use_flash=use_flash, window=window,
         ),
         mesh=mesh,
         in_specs=(spec, spec, spec),

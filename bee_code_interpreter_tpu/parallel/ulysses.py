@@ -45,6 +45,8 @@ def ulysses_attention(
     axis_name: str = "sp",
     causal: bool = True,
     local_attention=None,
+    window: int | None = None,
+    use_flash: bool | None = None,
 ) -> jax.Array:
     """All-to-all sequence-parallel attention. Must run inside shard_map.
 
@@ -52,16 +54,47 @@ def ulysses_attention(
     (grouped-query). Returns [B, H, L/sp, D]. ``local_attention(q, k, v)``
     runs on the gathered [B, heads/sp, L, D] blocks and defaults to the
     GQA-native Pallas flash kernel on TPU (reference attention elsewhere).
+
+    ``window`` (sliding-window attention, requires ``causal``) falls out
+    structurally: after the all-to-all each device holds the FULL sequence
+    for its head slice, so global positions equal local positions and the
+    ordinary local window mask is exact — no per-hop geometry like the ring.
     """
     if local_attention is None:
         # the shared ops-level dispatch: Pallas flash on TPU in either
         # causal mode (the gathered full sequence is exactly where O(L²)
-        # reference memory would blow up), reference einsum off-TPU
-        from bee_code_interpreter_tpu.ops.flash_attention import (
-            local_attention as _dispatch,
-        )
+        # reference memory would blow up), reference einsum off-TPU.
+        # ``use_flash`` FORCES a path (mirroring ring_attention's knob —
+        # True must actually run the kernel, not just flip check_vma):
+        if use_flash is None:
+            from bee_code_interpreter_tpu.ops.flash_attention import (
+                local_attention as _dispatch,
+            )
 
-        local_attention = functools.partial(_dispatch, causal=causal)
+            local_attention = functools.partial(
+                _dispatch, causal=causal, window=window
+            )
+        elif use_flash:
+            from bee_code_interpreter_tpu.ops.flash_attention import (
+                flash_attention,
+            )
+
+            local_attention = lambda q, k, v: flash_attention(  # noqa: E731
+                q, k, v, causal, window=window
+            )
+        else:
+            from bee_code_interpreter_tpu.parallel.ring_attention import (
+                reference_attention,
+            )
+
+            local_attention = functools.partial(
+                reference_attention, causal=causal, window=window
+            )
+    elif window is not None or use_flash is not None:
+        raise ValueError(
+            "window/use_flash with a custom local_attention: fold them into "
+            "the callable instead (the default dispatch handles them)"
+        )
     sp = lax.axis_size(axis_name)
     B, H, Lloc, D = q.shape
     KVH = k.shape[1]
@@ -99,14 +132,30 @@ def ulysses_attention_sharded(
     *,
     axis_name: str = "sp",
     causal: bool = True,
+    use_flash: bool | None = None,
+    window: int | None = None,
 ) -> jax.Array:
     """Standalone entry: shards [B, H, L, D] inputs over ``axis_name`` on L
-    and runs the exchange. For use outside an existing shard_map context."""
+    and runs the exchange. For use outside an existing shard_map context.
+
+    ``use_flash`` mirrors ring_attention_sharded: when the local attention
+    will dispatch to the Pallas flash kernel (the TPU default), the vma
+    checker must be disabled — pallas_call cannot lower under it (ADVICE r3:
+    without this the standalone entry failed on real TPU while CPU tests
+    passed, because uses_flash() is false off-TPU).
+    """
+    from bee_code_interpreter_tpu.ops.flash_attention import uses_flash
+
+    flash = use_flash if use_flash is not None else uses_flash()
     spec = P(None, None, axis_name, None)
     fn = jax.shard_map(
-        functools.partial(ulysses_attention, axis_name=axis_name, causal=causal),
+        functools.partial(
+            ulysses_attention, axis_name=axis_name, causal=causal,
+            window=window, use_flash=use_flash,
+        ),
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
+        check_vma=not flash,
     )
     return fn(q, k, v)

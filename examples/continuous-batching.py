@@ -1,0 +1,67 @@
+# Continuous batching through the sandbox: requests of different lengths
+# share one decode batch and one paged KV pool (models/serving.py over
+# ops/paged_kv_cache.py). Three prompts are admitted as rows free up; each
+# result must equal that prompt's solo greedy decode — batching other
+# requests alongside cannot change an answer.
+#
+# f32 so the equality assert is trustworthy (same reasoning as
+# speculative-decode.py: bf16 near-tie argmax flips are rounding noise).
+import dataclasses
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bee_code_interpreter_tpu.models import transformer as T
+from bee_code_interpreter_tpu.models.serving import ContinuousBatcher
+
+on_tpu = jax.devices()[0].platform == "tpu"
+config = dataclasses.replace(
+    T.TransformerConfig(
+        vocab_size=32000, d_model=1024, n_layers=8, n_heads=16,
+        n_kv_heads=4, max_seq_len=2048,
+    ) if on_tpu else T.TransformerConfig.tiny(),
+    dtype=jnp.float32,
+)
+params = T.init_params(config, jax.random.PRNGKey(0))
+model = T.Transformer(config)
+
+lengths = [5, 11, 8]
+new_tokens = 12
+prompts = [
+    np.asarray(jax.random.randint(jax.random.PRNGKey(i + 1), (L,), 0,
+                                  config.vocab_size))
+    for i, L in enumerate(lengths)
+]
+solo = [
+    np.asarray(model.generate_cached(
+        params, jnp.asarray(p)[None, :], max_new_tokens=new_tokens
+    )[0, len(p):]).tolist()
+    for p in prompts
+]
+
+batcher = ContinuousBatcher(
+    params, config, max_batch=2, n_pages=32, page_size=8,
+    max_pages_per_seq=4,
+)
+t0 = time.time()
+pending = list(enumerate(prompts))
+requests: dict[int, int] = {}
+steps = 0
+while pending or any(not batcher.is_done(r) for r in requests.values()):
+    while pending and batcher.has_free_row():
+        idx, prompt = pending[0]
+        try:
+            requests[idx] = batcher.submit(prompt, new_tokens)
+        except RuntimeError:
+            break  # pages exhausted: decode until some free
+        pending.pop(0)
+    batcher.step()
+    steps += 1
+
+for idx in range(len(prompts)):
+    got = batcher.result(requests[idx])
+    assert got == solo[idx], (idx, got, solo[idx])
+print(f"continuous batching OK: {len(prompts)} requests over max_batch=2, "
+      f"{steps} steps, {time.time() - t0:.1f}s, outputs == solo decode")

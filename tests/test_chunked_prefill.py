@@ -92,6 +92,52 @@ def test_undersized_total_len_rejected():
         T.prefill_chunked(params, prompt, config, total_len=8, chunk=4)
 
 
+def test_int8_prefill_chunk_invariant():
+    # int8 chunked prefill is chunk-size-invariant: every row's K/V is
+    # quantized per row on append and every read is dequantized, so the
+    # cache evolution doesn't depend on how the prompt was windowed. (It is
+    # NOT pinned against the full forward — full prefill attends in exact
+    # precision before quantizing, chunked attends over the progressively
+    # quantized cache, the same semantics incremental decode has.)
+    config = cfg(n_kv_heads=2, kv_cache_dtype="int8")
+    params = T.init_params(config, jax.random.PRNGKey(0))
+    prompt = jax.random.randint(jax.random.PRNGKey(3), (2, 13), 0, config.vocab_size)
+
+    lg_a, cache_a = T.prefill_chunked(params, prompt, config, 16, chunk=13)
+    lg_b, cache_b = T.prefill_chunked(params, prompt, config, 16, chunk=4)
+    np.testing.assert_allclose(
+        np.asarray(lg_a), np.asarray(lg_b), atol=1e-4, rtol=1e-4
+    )
+    for a, b in zip(jax.tree.leaves(cache_a), jax.tree.leaves(cache_b)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_generate_cached_int8_with_prefill_chunk():
+    # End-to-end: the int8 + prefill_chunk combination decodes and the
+    # result is chunk-invariant (chunk >= L degenerates to one window).
+    config = cfg(n_kv_heads=2, kv_cache_dtype="int8")
+    model = T.Transformer(config)
+    params = model.init(jax.random.PRNGKey(0))
+    prompt = jax.random.randint(jax.random.PRNGKey(5), (2, 11), 0, config.vocab_size)
+    one_window = model.generate_cached(
+        params, prompt, max_new_tokens=6, prefill_chunk=11
+    )
+    chunked = model.generate_cached(
+        params, prompt, max_new_tokens=6, prefill_chunk=4
+    )
+    np.testing.assert_array_equal(np.asarray(chunked), np.asarray(one_window))
+
+
+def test_empty_prompt_rejected():
+    # L == 0 has no last_logits to start decode from — fail fast at entry
+    # instead of an opaque None crash later in sample_logits (ADVICE r3).
+    config = cfg()
+    params = T.init_params(config, jax.random.PRNGKey(0))
+    prompt = jnp.zeros((1, 0), jnp.int32)
+    with pytest.raises(ValueError, match="non-empty"):
+        T.prefill_chunked(params, prompt, config, total_len=8, chunk=4)
+
+
 def test_generate_cached_with_prefill_chunk():
     # the integrated path: generate_cached(prefill_chunk=N) must produce the
     # same tokens as the full-prefill path, sampling and eos included.
@@ -114,6 +160,51 @@ def test_generate_cached_with_prefill_chunk():
         prefill_chunk=4,
     )
     np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_generate_cached_prefill_chunk_on_mesh():
+    # The round-4 matrix close (VERDICT r3 #5a): long prompts on sharded
+    # models — chunked prefill under a dp x tp mesh must reproduce the
+    # single-device chunked path token-for-token (GSPMD shards the
+    # decode_window einsums from the param shardings; the constraint pins
+    # the activation batch to dp).
+    from bee_code_interpreter_tpu.parallel.mesh import make_mesh
+
+    config = cfg(n_kv_heads=2)
+    params = T.init_params(config, jax.random.PRNGKey(0))
+    prompt = jax.random.randint(jax.random.PRNGKey(5), (2, 11), 0, config.vocab_size)
+    want = T.Transformer(config).generate_cached(
+        params, prompt, max_new_tokens=6, prefill_chunk=4
+    )
+    mesh = make_mesh({"dp": 2, "tp": 2}, devices=jax.devices()[:4])
+    sharded = T.shard_params(params, config, mesh)
+    got = T.Transformer(config, mesh).generate_cached(
+        sharded, prompt, max_new_tokens=6, prefill_chunk=4
+    )
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_prefill_chunked_fsdp_mesh_matches():
+    # fsdp shards the same batch dim the constraint names; the cache and
+    # last-logits must agree with the unsharded chunked prefill.
+    from bee_code_interpreter_tpu.parallel.mesh import make_mesh
+
+    config = cfg(n_kv_heads=2)
+    params = T.init_params(config, jax.random.PRNGKey(0))
+    prompt = jax.random.randint(jax.random.PRNGKey(6), (2, 10), 0, config.vocab_size)
+    lg_want, cache_want = T.prefill_chunked(params, prompt, config, 12, chunk=4)
+    mesh = make_mesh({"fsdp": 2}, devices=jax.devices()[:2])
+    lg_got, cache_got = T.prefill_chunked(
+        T.shard_params(params, config, mesh), prompt, config, 12, chunk=4,
+        mesh=mesh,
+    )
+    np.testing.assert_allclose(
+        np.asarray(lg_got), np.asarray(lg_want), atol=1e-4, rtol=1e-4
+    )
+    for a, b in zip(jax.tree.leaves(cache_got), jax.tree.leaves(cache_want)):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-4, rtol=1e-4
+        )
 
 
 def test_chunk_size_validated():

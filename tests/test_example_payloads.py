@@ -39,6 +39,14 @@ async def test_speculative_decode_example(http_app):
     assert "exact-vs-greedy True" in body["stdout"]
 
 
+async def test_continuous_batching_example(http_app):
+    source = (EXAMPLES / "continuous-batching.py").read_text()
+    body = await post_execute(http_app, {"source_code": source, "timeout": 600})
+    assert body["exit_code"] == 0, body["stderr"]
+    assert "continuous batching OK" in body["stdout"]
+    assert "outputs == solo decode" in body["stdout"]
+
+
 async def test_checkpoint_resume_example(http_app):
     # The checkpoint lands under /workspace, so the response's file map must
     # carry the checkpoint artifacts — that is the resume contract (pass the

@@ -1,0 +1,151 @@
+"""Paged KV cache: decode over a block-table-indirected page pool must be
+an indexing-only change — logits equal to the contiguous decode_step for
+any page placement."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from bee_code_interpreter_tpu.models import transformer as T
+from bee_code_interpreter_tpu.ops.paged_kv_cache import (
+    alloc_paged_cache,
+    paged_read,
+)
+
+
+def cfg(**kw):
+    return dataclasses.replace(
+        T.TransformerConfig.tiny(), dtype=jnp.float32, n_kv_heads=2, **kw
+    )
+
+
+def seed_pages(cache, k_pre, v_pre, block_table, page_size):
+    """Host-side prefill copy: logical block j of row b -> physical page
+    block_table[b, j] (what serving.ContinuousBatcher.submit does)."""
+    L = k_pre.shape[3]
+    B = k_pre.shape[1]
+    for b in range(B):
+        for j in range(-(-L // page_size)):
+            lo, hi = j * page_size, min((j + 1) * page_size, L)
+            page = int(block_table[b, j])
+            cache = {
+                "k": cache["k"].at[:, page, :, : hi - lo, :].set(
+                    k_pre[:, b, :, lo:hi, :]
+                ),
+                "v": cache["v"].at[:, page, :, : hi - lo, :].set(
+                    v_pre[:, b, :, lo:hi, :]
+                ),
+            }
+    return cache
+
+
+@pytest.mark.parametrize("table", ["identity", "permuted"])
+def test_paged_decode_matches_contiguous(table):
+    # Same prompt in both caches; 4 decode steps; logits must agree at
+    # every step regardless of which physical pages back the sequence.
+    config = cfg()
+    params = T.init_params(config, jax.random.PRNGKey(0))
+    B, L, ps, P = 2, 11, 4, 6
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (B, L + 5), 0,
+                                config.vocab_size)
+    _, (k_pre, v_pre) = T.forward(params, tokens[:, :L], config, return_kv=True)
+
+    contiguous = T.init_decode_cache(config, B, P * ps, k_pre, v_pre)
+    paged = alloc_paged_cache(config, n_pages=1 + B * P, page_size=ps)
+    if table == "identity":
+        bt = np.arange(1, 1 + B * P).reshape(B, P).astype(np.int32)
+    else:
+        rng = np.random.RandomState(7)
+        bt = (1 + rng.permutation(B * P)).reshape(B, P).astype(np.int32)
+    paged = seed_pages(paged, k_pre, v_pre, bt, ps)
+    bt = jnp.asarray(bt)
+
+    cur = tokens[:, L : L + 1]
+    for i in range(4):
+        pos = jnp.int32(L + i)
+        lg_c, contiguous = T.decode_step(params, cur, pos, contiguous, config)
+        lg_p, paged = T.decode_step_paged(
+            params, cur, jnp.full((B,), pos), paged, bt, config
+        )
+        np.testing.assert_allclose(
+            np.asarray(lg_p), np.asarray(lg_c), atol=1e-4, rtol=1e-4,
+            err_msg=f"step {i} table={table}",
+        )
+        cur = jnp.argmax(lg_c[:, -1:, :], axis=-1).astype(jnp.int32)
+
+
+def test_heterogeneous_positions():
+    # Two rows at DIFFERENT lengths in one paged batch — each must match
+    # its own single-row contiguous decode (the property continuous
+    # batching rests on).
+    config = cfg()
+    params = T.init_params(config, jax.random.PRNGKey(0))
+    ps, P = 4, 5
+    lens = [3, 9]
+    prompts = [
+        jax.random.randint(jax.random.PRNGKey(10 + i), (1, L), 0,
+                           config.vocab_size)
+        for i, L in enumerate(lens)
+    ]
+
+    paged = alloc_paged_cache(config, n_pages=1 + 2 * P, page_size=ps)
+    bt = np.full((2, P), 0, np.int32)
+    singles = []
+    curs = []
+    for b, (L, prompt) in enumerate(zip(lens, prompts)):
+        logits, (k_pre, v_pre) = T.forward(
+            params, prompt, config, return_kv=True
+        )
+        bt[b] = np.arange(1 + b * P, 1 + (b + 1) * P)
+        paged = seed_pages(
+            paged, k_pre, v_pre, bt[b : b + 1], ps
+        )
+        singles.append(T.init_decode_cache(config, 1, P * ps, k_pre, v_pre))
+        curs.append(jnp.argmax(logits[:, -1:, :], axis=-1).astype(jnp.int32))
+    bt = jnp.asarray(bt)
+
+    pos = np.array(lens, np.int32)
+    cur = jnp.concatenate(curs, axis=0)
+    for i in range(3):
+        lg_p, paged = T.decode_step_paged(
+            params, cur, jnp.asarray(pos), paged, bt, config
+        )
+        nxt = []
+        for b in range(2):
+            lg_s, singles[b] = T.decode_step(
+                params, cur[b : b + 1], jnp.int32(int(pos[b])),
+                singles[b], config,
+            )
+            np.testing.assert_allclose(
+                np.asarray(lg_p[b]), np.asarray(lg_s[0]),
+                atol=1e-4, rtol=1e-4, err_msg=f"row {b} step {i}",
+            )
+            nxt.append(jnp.argmax(lg_s[:, -1:, :], axis=-1).astype(jnp.int32))
+        cur = jnp.concatenate(nxt, axis=0)
+        pos = pos + 1
+
+
+def test_paged_read_layout():
+    # The gather view reassembles logical order from scattered pages.
+    config = cfg(n_layers=1)
+    cache = alloc_paged_cache(config, n_pages=4, page_size=2)
+    kvh, dh = config.kv_heads, config.head_dim
+    vals = jnp.arange(4 * kvh * 2 * dh, dtype=jnp.float32).reshape(
+        4, kvh, 2, dh
+    )
+    cache = {"k": cache["k"].at[0].set(vals), "v": cache["v"].at[0].set(vals)}
+    bt = jnp.asarray([[3, 1]], jnp.int32)  # logical 0 -> page 3, 1 -> page 1
+    kf, vf = paged_read(
+        {"k": cache["k"][0], "v": cache["v"][0]}, bt
+    )
+    assert kf.shape == (1, kvh, 4, dh)
+    np.testing.assert_array_equal(np.asarray(kf[0, :, :2]), np.asarray(vals[3]))
+    np.testing.assert_array_equal(np.asarray(kf[0, :, 2:]), np.asarray(vals[1]))
+
+
+def test_alloc_validates_page_size():
+    with pytest.raises(ValueError, match="page_size"):
+        alloc_paged_cache(cfg(), n_pages=4, page_size=0)

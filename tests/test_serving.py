@@ -1,0 +1,118 @@
+"""Continuous batching: per-request outputs must be independent of what
+else shares the batch, and pages must recycle."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from bee_code_interpreter_tpu.models import transformer as T
+from bee_code_interpreter_tpu.models.serving import ContinuousBatcher
+
+
+def cfg(**kw):
+    return dataclasses.replace(
+        T.TransformerConfig.tiny(), dtype=jnp.float32, n_kv_heads=2, **kw
+    )
+
+
+def reference_tokens(params, config, prompt, n):
+    """The target each request must reproduce: the model's own greedy
+    cached decode, run solo."""
+    out = T.Transformer(config).generate_cached(
+        params, jnp.asarray(prompt)[None, :], max_new_tokens=n
+    )
+    return np.asarray(out[0, len(prompt):]).tolist()
+
+
+def test_staggered_requests_match_solo_decode():
+    # Three prompts of different lengths admitted at different times; each
+    # result must equal that prompt's solo greedy decode token-for-token.
+    config = cfg()
+    params = T.init_params(config, jax.random.PRNGKey(0))
+    prompts = [
+        np.asarray(jax.random.randint(jax.random.PRNGKey(i + 1), (L,), 0,
+                                      config.vocab_size))
+        for i, L in enumerate([3, 7, 5])
+    ]
+    want = [reference_tokens(params, config, p, 6) for p in prompts]
+
+    b = ContinuousBatcher(
+        params, config, max_batch=2, n_pages=16, page_size=4,
+        max_pages_per_seq=4,
+    )
+    r0 = b.submit(prompts[0], 6)
+    r1 = b.submit(prompts[1], 6)
+    b.step(); b.step()
+    # batch full: third request waits until a row frees
+    with pytest.raises(RuntimeError, match="no free batch row"):
+        b.submit(prompts[2], 6)
+    b.run_to_completion()
+    r2 = b.submit(prompts[2], 6)  # admitted into a recycled row + pages
+    b.run_to_completion()
+
+    assert b.result(r0) == want[0]
+    assert b.result(r1) == want[1]
+    assert b.result(r2) == want[2]
+
+
+def test_pages_recycle():
+    config = cfg()
+    params = T.init_params(config, jax.random.PRNGKey(0))
+    b = ContinuousBatcher(
+        params, config, max_batch=1, n_pages=5, page_size=4,
+        max_pages_per_seq=4,
+    )
+    free0 = len(b.free_pages)
+    prompt = np.asarray([1, 2, 3, 4, 5])
+    row = b.submit(prompt, 4)
+    assert len(b.free_pages) < free0  # pages held while decoding
+    b.run_to_completion()
+    assert b.is_done(row)
+    assert len(b.free_pages) == free0  # all pages back after retirement
+
+
+def test_budget_and_pool_validation():
+    config = cfg()
+    params = T.init_params(config, jax.random.PRNGKey(0))
+    b = ContinuousBatcher(
+        params, config, max_batch=2, n_pages=3, page_size=4,
+        max_pages_per_seq=2,
+    )
+    with pytest.raises(ValueError, match="exceeds the block table"):
+        b.submit(np.arange(1, 8), 4)  # 7 + 4 > 2*4
+    with pytest.raises(ValueError, match="max_new_tokens"):
+        b.submit(np.arange(1, 4), 0)  # asking for zero tokens is a bug
+    with pytest.raises(RuntimeError, match="page pool exhausted"):
+        b.submit(np.arange(1, 6), 3)  # needs 2 pages, pool has (3-1)=2... ok
+        b.submit(np.arange(1, 6), 3)  # second request: pool empty
+
+
+def test_eos_retires_early():
+    config = cfg()
+    params = T.init_params(config, jax.random.PRNGKey(0))
+    prompt = np.asarray([1, 2, 3])
+    solo = reference_tokens(params, config, prompt, 8)
+    # pick an eos value whose FIRST occurrence is past the first token, so
+    # the stop is genuinely early and genuinely at that position
+    stop_at = next(
+        (i for i in range(1, len(solo)) if solo[i] not in solo[:i]), None
+    )
+    if stop_at is None:
+        pytest.skip("greedy output has no late first-occurrence token")
+    b = ContinuousBatcher(
+        params, config, max_batch=1, n_pages=8, page_size=4,
+        max_pages_per_seq=3, eos_id=solo[stop_at],
+    )
+    req = b.submit(prompt, 8)
+    b.run_to_completion()
+    assert b.result(req) == solo[: stop_at + 1]  # stopped at eos, prefix identical
+
+
+def test_int8_pool_rejected():
+    config = cfg(kv_cache_dtype="int8")
+    params = T.init_params(config, jax.random.PRNGKey(0))
+    with pytest.raises(NotImplementedError, match="paged pool"):
+        ContinuousBatcher(params, config)

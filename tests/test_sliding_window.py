@@ -96,15 +96,23 @@ def test_windowed_chunked_prefill_matches_forward():
     )
 
 
-def test_window_rejected_on_sp_mesh():
+@pytest.mark.parametrize("sp_attention", ["ring", "ulysses"])
+def test_windowed_forward_on_sp_mesh_matches_single(sp_attention):
+    # The round-4 matrix close (VERDICT r3 #5b): sliding_window through
+    # both sp strategies. window=6 with L_local=8 makes the ring's window
+    # boundary straddle the block edge (the hard per-hop-mask case);
+    # Ulysses applies the local mask after its gather.
     from bee_code_interpreter_tpu.parallel.mesh import make_mesh
 
-    config = windowed_cfg()
+    config = dataclasses.replace(windowed_cfg(), sp_attention=sp_attention)
     mesh = make_mesh({"sp": 2}, devices=jax.devices()[:2])
     params = T.init_params(config, jax.random.PRNGKey(0))
-    tokens = jnp.zeros((2, 16), jnp.int32)
-    with pytest.raises(NotImplementedError, match="sliding_window"):
-        T.forward(params, tokens, config, mesh)
+    tokens = jax.random.randint(jax.random.PRNGKey(4), (2, 16), 0, config.vocab_size)
+    sharded = T.forward(params, tokens, config, mesh)
+    single = T.forward(params, tokens, config, None)
+    np.testing.assert_allclose(
+        np.asarray(sharded), np.asarray(single), atol=1e-4, rtol=1e-4
+    )
 
 
 def test_reference_window_requires_causal_like_flash():

@@ -77,10 +77,13 @@ def test_vocab_mismatch_rejected():
         )
 
 
-def test_decode_window_matches_sequential_steps():
+@pytest.mark.parametrize("kv_cache_dtype", ["bf16", "int8"])
+def test_decode_window_matches_sequential_steps(kv_cache_dtype):
     # The verify primitive itself: one W-token window forward must equal W
-    # sequential decode_steps (same cache evolution, same logits).
-    config = cfg(n_kv_heads=2)
+    # sequential decode_steps (same cache evolution, same logits). For int8
+    # this is what makes speculative decoding exact over the quantized
+    # cache: per-row scales mean a window append == W single appends.
+    config = cfg(n_kv_heads=2, kv_cache_dtype=kv_cache_dtype)
     params = T.init_params(config, jax.random.PRNGKey(0))
     tokens = jax.random.randint(jax.random.PRNGKey(4), (2, 12), 0, config.vocab_size)
     L_pre, W = 6, 4
@@ -119,10 +122,20 @@ def test_moe_target_rejected():
         )
 
 
-def test_int8_target_cache_rejected_early():
-    config = dataclasses.replace(cfg(), kv_cache_dtype="int8")
+def test_int8_target_cache_exact():
+    # The round-4 matrix close (VERDICT r3 #5c): speculative decoding over
+    # an int8 target cache must equal the target's own int8-cache greedy
+    # decode — the unified decode_window quantizes the verify window per
+    # row, so the cache evolves identically either way.
+    config = cfg(n_kv_heads=2, kv_cache_dtype="int8")
+    draft_config = cfg(n_layers=1, d_model=32, n_heads=2, d_ff=64)
     params = T.init_params(config, jax.random.PRNGKey(0))
-    with pytest.raises(NotImplementedError, match="bf16 target cache"):
-        speculative_generate(
-            params, config, params, config, jnp.zeros((1, 4), jnp.int32),
-        )
+    draft_params = T.init_params(draft_config, jax.random.PRNGKey(42))
+    prompt = jax.random.randint(jax.random.PRNGKey(2), (2, 5), 0, config.vocab_size)
+
+    want = T.Transformer(config).generate_cached(params, prompt, max_new_tokens=8)
+    got = speculative_generate(
+        params, config, draft_params, draft_config, prompt,
+        max_new_tokens=8, gamma=3,
+    )
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
