@@ -49,7 +49,12 @@ def beam_search(
         # capacity-based MoE routes all B·W beam rows in one competing pool,
         # so a beam's tokens/score would depend on which sibling beams share
         # the batch and the score-equals-rescoring pin breaks — same
-        # routing-pool-size hazard speculative_generate refuses
+        # routing-pool-size hazard speculative_generate refuses. This is a
+        # property of capacity-based routing, not a missing feature:
+        # tests/test_beam.py::test_moe_routing_pool_coupling_demonstrated
+        # PROVES it (identical rows, different logits by pool position once
+        # capacity saturates); decoupling would need per-beam routing pools,
+        # which forfeits the batched expert matmul the MoE path exists for
         raise NotImplementedError(
             "beam_search requires a dense config (MoE routing pools couple "
             "sibling beams); use Transformer.generate_cached for MoE"

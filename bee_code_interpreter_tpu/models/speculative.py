@@ -76,7 +76,9 @@ def speculative_generate(
         # routes B·1, so under capacity pressure the two can drop different
         # tokens and the exactness guarantee breaks. Refuse rather than be
         # silently approximate (same stance as forward_pipelined's aux
-        # guard); MoE DRAFTS are fine — drafts only propose.
+        # guard); MoE DRAFTS are fine — drafts only propose. The hazard is
+        # proven executable in tests/test_beam.py::
+        # test_moe_routing_pool_coupling_demonstrated.
         raise NotImplementedError(
             "speculative_generate requires a dense target (MoE routing "
             "pools differ between the verify window and plain decode); "

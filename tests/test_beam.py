@@ -80,6 +80,52 @@ def test_moe_config_rejected():
         beam_search(params, config, jnp.zeros((1, 4), jnp.int32))
 
 
+def test_moe_routing_pool_coupling_demonstrated():
+    # The RECORDED JUSTIFICATION for the MoE refusal above, as an executable
+    # proof rather than a docstring sentence: capacity-based MoE routes all
+    # batch rows in one competing pool, so rows with IDENTICAL inputs get
+    # different outputs purely by pool position once capacity is exceeded —
+    # exactly what would couple sibling beams (a beam's score would depend
+    # on which siblings share the batch, breaking score==rescoring).
+    #
+    # Construction: 16 identical decode rows all want the same top-2
+    # experts; capacity_factor=0.25 gives each expert max(8, ...) = 8 slots,
+    # so half the rows are dropped to the residual path while the first
+    # rows route — same token, same cache, different logits.
+    import numpy as np
+
+    config = dataclasses.replace(
+        cfg(), n_experts=4, moe_capacity_factor=0.25, n_kv_heads=2
+    )
+    params = T.init_params(config, jax.random.PRNGKey(0))
+    prompt = jax.random.randint(jax.random.PRNGKey(1), (1, 5), 0, config.vocab_size)
+    _, (k_pre, v_pre) = T.forward(params, prompt, config, return_kv=True)
+    tok = jnp.full((1, 1), 7, jnp.int32)
+
+    solo_cache = T.init_decode_cache(config, 1, 8, k_pre, v_pre)
+    lg_solo, _ = T.decode_step(params, tok, jnp.int32(5), solo_cache, config)
+
+    W = 16
+    pool_cache = jax.tree.map(
+        lambda x: jnp.repeat(x, W, axis=1),
+        T.init_decode_cache(config, 1, 8, k_pre, v_pre),
+    )
+    lg_pool, _ = T.decode_step(
+        params, jnp.tile(tok, (W, 1)), jnp.int32(5), pool_cache, config
+    )
+    per_row_dev = np.asarray(
+        jnp.max(jnp.abs(lg_pool - lg_solo[0]), axis=(1, 2))
+    )
+    # some row must diverge from its own solo decode (dropped routing) —
+    # if this ever stops holding, the refusal in beam_search (and
+    # speculative_generate) should be revisited
+    assert per_row_dev.max() > 1e-3, per_row_dev
+    # and the divergence is positional, not noise: identical inputs gave
+    # unequal outputs WITHIN one batch
+    row_spread = float(jnp.max(jnp.abs(lg_pool - lg_pool[:1])))
+    assert row_spread > 1e-3, row_spread
+
+
 def test_zero_max_new_tokens_rejected():
     config = cfg()
     params = T.init_params(config, jax.random.PRNGKey(0))
