@@ -42,7 +42,10 @@ from bee_code_interpreter_tpu.models.transformer import (
     decode_step_paged,
     forward,
 )
-from bee_code_interpreter_tpu.ops.paged_kv_cache import alloc_paged_cache
+from bee_code_interpreter_tpu.ops.paged_kv_cache import (
+    alloc_paged_cache,
+    seed_prefill,
+)
 
 # physical page 0 is the scratch page: idle rows' block tables point at it,
 # so their (masked, ignored) reads and writes never touch a live request's
@@ -70,11 +73,6 @@ class ContinuousBatcher:
         max_pages_per_seq: int = 8,
         eos_id: int | None = None,
     ) -> None:
-        if config.kv_cache_dtype != "bf16":
-            raise NotImplementedError(
-                "the paged pool stores the direct-value (bf16) layout; an "
-                "int8 paged pool would add scale planes per page"
-            )
         self.params = params
         self.config = config
         self.page_size = page_size
@@ -140,33 +138,16 @@ class ContinuousBatcher:
         self.block_table[row, :] = _SCRATCH_PAGE
         self.block_table[row, :n_need] = pages
 
-        # prefill: exact O(L^2) forward, then ONE batched scatter per pool
-        # (a per-page .at loop would rebuild the whole pool per page). The
-        # pad tail writes zeros into slots this sequence owns anyway —
-        # masked by s <= pos until real tokens overwrite them.
+        # prefill: exact O(L^2) forward, then the shared one-scatter-per-
+        # leaf page seeding (ops/paged_kv_cache.seed_prefill — the equality
+        # tests call the same function, so the tested path IS this path)
         logits, (k_pre, v_pre) = self._prefill(self.params, prompt[None, :])
-        ps = self.page_size
-        n_prompt_pages = -(-L // ps)
-        pages_arr = jnp.asarray(pages[:n_prompt_pages], dtype=jnp.int32)
-
-        def paged_view(x, dtype):  # [n_layers, 1, kvh, L, dh] -> per-page
-            x = x[:, 0, :, :, :]
-            pad = n_prompt_pages * ps - L
-            x = jnp.pad(x, ((0, 0), (0, 0), (0, pad), (0, 0)))
-            nl, kvh, _, dh = x.shape
-            return (
-                x.reshape(nl, kvh, n_prompt_pages, ps, dh)
-                .transpose(0, 2, 1, 3, 4).astype(dtype)
-            )  # [n_layers, P, kvh, ps, dh]
-
-        self.cache = {
-            "k": self.cache["k"].at[:, pages_arr].set(
-                paged_view(k_pre, self.cache["k"].dtype)
-            ),
-            "v": self.cache["v"].at[:, pages_arr].set(
-                paged_view(v_pre, self.cache["v"].dtype)
-            ),
-        }
+        n_prompt_pages = -(-L // self.page_size)
+        self.cache = seed_prefill(
+            self.cache,
+            jnp.asarray(pages[:n_prompt_pages], dtype=jnp.int32),
+            k_pre[:, 0], v_pre[:, 0],
+        )
         first = int(jnp.argmax(logits[0, L - 1, :]))
         req = self._next_request_id
         self._next_request_id += 1

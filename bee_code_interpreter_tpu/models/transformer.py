@@ -704,8 +704,10 @@ def decode_step_paged(
     The layer math is decode_window's W=1 grouped-query einsums verbatim;
     only the cache indexing differs, so paged-vs-contiguous equality is an
     indexing property (pinned by tests/test_paged_kv_cache.py, including
-    permuted page tables). bf16 pool layout; rows whose slot would exceed
-    the table's page budget are a scheduler bug (the scatter clamps).
+    permuted page tables). Both pool layouts — int8 pools carry per-row
+    scale planes per page and append/read quantize exactly like the
+    contiguous strategy. Rows whose slot would exceed the table's page
+    budget are a scheduler bug (the scatter clamps).
     """
     from bee_code_interpreter_tpu.ops.paged_kv_cache import (
         paged_append,
@@ -739,7 +741,7 @@ def decode_step_paged(
         c_layer = paged_append(
             c_layer, k_new[:, :, 0, :], v_new[:, :, 0, :], page_idx, slot_idx
         )
-        kf, vf = paged_read(c_layer, block_table)  # [B, kvh, S, dh]
+        kf, vf = paged_read(c_layer, block_table, c.dtype)  # [B, kvh, S, dh]
 
         rep = nh // kvh
         qg = q[:, :, 0, :].reshape(B, kvh, rep, dh).astype(jnp.float32)

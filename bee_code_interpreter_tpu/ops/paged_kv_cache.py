@@ -34,6 +34,8 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from bee_code_interpreter_tpu.ops.kv_cache import quantize
+
 
 def alloc_paged_cache(config, n_pages: int, page_size: int) -> dict:
     """Zeroed page pool: k/v [n_layers, n_pages, kvh, page_size, dh].
@@ -43,11 +45,24 @@ def alloc_paged_cache(config, n_pages: int, page_size: int) -> dict:
     ``pages[ℓ, page]``, so the block table is shared across layers (one
     table per sequence, not per layer — same trick as the stacked
     contiguous cache).
+
+    ``kv_cache_dtype="int8"`` stores int8 values plus per-(token, head)
+    scale planes per page — the same self-describing layout convention as
+    the contiguous cache (ops/kv_cache.py): scale leaves present selects
+    the quantized strategy in append/read, and the decode bandwidth halves
+    on top of paging's density win.
     """
     c = config
     if page_size < 1:
         raise ValueError(f"page_size must be >= 1, got {page_size}")
     shape = (c.n_layers, n_pages, c.kv_heads, page_size, c.head_dim)
+    if c.kv_cache_dtype == "int8":
+        return {
+            "k": jnp.zeros(shape, jnp.int8),
+            "v": jnp.zeros(shape, jnp.int8),
+            "k_s": jnp.zeros(shape[:-1] + (1,), jnp.float32),
+            "v_s": jnp.zeros(shape[:-1] + (1,), jnp.float32),
+        }
     return {"k": jnp.zeros(shape, c.dtype), "v": jnp.zeros(shape, c.dtype)}
 
 
@@ -63,8 +78,19 @@ def paged_append(
     Rows of a batch may land in arbitrary distinct pages — the scatter is
     one XLA scatter op. Two rows writing the same (page, slot) is a
     scheduler bug (pages are owned by one sequence); last-writer-wins as
-    with any scatter.
+    with any scatter. The int8 layout quantizes per (token, head) row —
+    identical semantics to the contiguous cache_append, so paged int8
+    decode equals contiguous int8 decode.
     """
+    if "k_s" in c_layer:
+        kq, ks = quantize(k_new)  # [B, kvh, dh] -> values + [B, kvh, 1]
+        vq, vs = quantize(v_new)
+        return {
+            "k": c_layer["k"].at[page_idx, :, slot_idx, :].set(kq),
+            "v": c_layer["v"].at[page_idx, :, slot_idx, :].set(vq),
+            "k_s": c_layer["k_s"].at[page_idx, :, slot_idx, :].set(ks),
+            "v_s": c_layer["v_s"].at[page_idx, :, slot_idx, :].set(vs),
+        }
     dtype = c_layer["k"].dtype
     return {
         "k": c_layer["k"].at[page_idx, :, slot_idx, :].set(
@@ -79,20 +105,75 @@ def paged_append(
 def paged_read(
     c_layer: dict,  # [n_pages, kvh, ps, dh]
     block_table: jax.Array,  # [B, P] int32 logical block -> physical page
+    dtype,  # V compute dtype — required, matching cache_read's contract
 ) -> tuple[jax.Array, jax.Array]:
     """Gather each row's pages into the contiguous [B, kvh, P·ps, dh] view
     the attention einsums consume. K comes back f32 (scores operand), V in
-    the pool dtype — the same contract as ops/kv_cache.cache_read."""
+    ``dtype`` — the same contract as ops/kv_cache.cache_read; int8 pools
+    dequantize after the gather (scales gathered alongside)."""
     B, P = block_table.shape
     n_pages, kvh, ps, dh = c_layer["k"].shape
 
-    def view(x, dtype):
-        g = x[block_table]  # [B, P, kvh, ps, dh]
+    def view(x, out_dtype):
+        g = x[block_table]  # [B, P, kvh, ps, last]
+        last = x.shape[-1]
         return (
-            g.transpose(0, 2, 1, 3, 4).reshape(B, kvh, P * ps, dh)
-            .astype(dtype)
+            g.transpose(0, 2, 1, 3, 4).reshape(B, kvh, P * ps, last)
+            .astype(out_dtype)
         )
 
-    return view(c_layer["k"], jnp.float32), view(
-        c_layer["v"], c_layer["v"].dtype
-    )
+    if "k_s" in c_layer:
+        from bee_code_interpreter_tpu.ops.kv_cache import dequantize
+
+        return (
+            dequantize(view(c_layer["k"], jnp.int8), view(c_layer["k_s"], jnp.float32)),
+            dequantize(view(c_layer["v"], jnp.int8), view(c_layer["v_s"], jnp.float32), dtype),
+        )
+    return view(c_layer["k"], jnp.float32), view(c_layer["v"], dtype)
+
+
+def seed_prefill(
+    cache: dict,  # full pool: leaves [n_layers, n_pages, ...]
+    pages: jax.Array,  # [P] int32 physical pages covering ceil(L/ps)
+    k_pre: jax.Array,  # [n_layers, kvh, L, dh] — one sequence's prefill K
+    v_pre: jax.Array,
+) -> dict:
+    """Write one sequence's prefill K/V into its pages — ONE batched
+    scatter per pool leaf; the single copy of the prefill-seeding logic
+    (serving.ContinuousBatcher.submit and the equality tests both call
+    this, so the tested path IS the served path). int8 pools quantize per
+    (token, head) row, identical to cache_append's semantics; the pad tail
+    quantizes to scale-0 exact zeros and stays masked by ``s <= pos``."""
+    ps = cache["k"].shape[3]
+    n_pages_used = int(pages.shape[0])
+    L = k_pre.shape[2]
+    if L > n_pages_used * ps:
+        raise ValueError(
+            f"prefill length {L} exceeds {n_pages_used} pages of {ps}"
+        )
+
+    def page_view(x):  # [n_layers, kvh, L, dh] -> [n_layers, P, kvh, ps, dh]
+        x = jnp.pad(
+            x, ((0, 0), (0, 0), (0, n_pages_used * ps - L), (0, 0))
+        )
+        nl, kvh, _, dh = x.shape
+        return x.reshape(nl, kvh, n_pages_used, ps, dh).transpose(0, 2, 1, 3, 4)
+
+    def put(cache, name, sname, pre):
+        vals = page_view(pre)
+        if sname in cache:
+            q, s = quantize(vals)
+            return {
+                **cache,
+                name: cache[name].at[:, pages].set(q),
+                sname: cache[sname].at[:, pages].set(s),
+            }
+        return {
+            **cache,
+            name: cache[name].at[:, pages].set(
+                vals.astype(cache[name].dtype)
+            ),
+        }
+
+    cache = put(cache, "k", "k_s", k_pre)
+    return put(cache, "v", "v_s", v_pre)

@@ -23,30 +23,31 @@ def cfg(**kw):
 
 
 def seed_pages(cache, k_pre, v_pre, block_table, page_size):
-    """Host-side prefill copy: logical block j of row b -> physical page
-    block_table[b, j] (what serving.ContinuousBatcher.submit does)."""
+    """Prefill seeding THROUGH the shared primitive serving uses
+    (ops/paged_kv_cache.seed_prefill), one sequence at a time — the
+    equality tests pin the exact code path ContinuousBatcher.submit runs."""
+    from bee_code_interpreter_tpu.ops.paged_kv_cache import seed_prefill
+
     L = k_pre.shape[3]
     B = k_pre.shape[1]
+    n_pages = -(-L // page_size)
     for b in range(B):
-        for j in range(-(-L // page_size)):
-            lo, hi = j * page_size, min((j + 1) * page_size, L)
-            page = int(block_table[b, j])
-            cache = {
-                "k": cache["k"].at[:, page, :, : hi - lo, :].set(
-                    k_pre[:, b, :, lo:hi, :]
-                ),
-                "v": cache["v"].at[:, page, :, : hi - lo, :].set(
-                    v_pre[:, b, :, lo:hi, :]
-                ),
-            }
+        cache = seed_prefill(
+            cache,
+            jnp.asarray(block_table[b, :n_pages], dtype=jnp.int32),
+            k_pre[:, b], v_pre[:, b],
+        )
     return cache
 
 
 @pytest.mark.parametrize("table", ["identity", "permuted"])
-def test_paged_decode_matches_contiguous(table):
+@pytest.mark.parametrize("kv_cache_dtype", ["bf16", "int8"])
+def test_paged_decode_matches_contiguous(table, kv_cache_dtype):
     # Same prompt in both caches; 4 decode steps; logits must agree at
     # every step regardless of which physical pages back the sequence.
-    config = cfg()
+    # int8 pools quantize per row exactly like the contiguous strategy, so
+    # the equality holds there too (scale planes gathered with the pages).
+    config = cfg(kv_cache_dtype=kv_cache_dtype)
     params = T.init_params(config, jax.random.PRNGKey(0))
     B, L, ps, P = 2, 11, 4, 6
     tokens = jax.random.randint(jax.random.PRNGKey(1), (B, L + 5), 0,
@@ -139,7 +140,7 @@ def test_paged_read_layout():
     cache = {"k": cache["k"].at[0].set(vals), "v": cache["v"].at[0].set(vals)}
     bt = jnp.asarray([[3, 1]], jnp.int32)  # logical 0 -> page 3, 1 -> page 1
     kf, vf = paged_read(
-        {"k": cache["k"][0], "v": cache["v"][0]}, bt
+        {"k": cache["k"][0], "v": cache["v"][0]}, bt, jnp.float32
     )
     assert kf.shape == (1, kvh, 4, dh)
     np.testing.assert_array_equal(np.asarray(kf[0, :, :2]), np.asarray(vals[3]))

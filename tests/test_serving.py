@@ -111,8 +111,22 @@ def test_eos_retires_early():
     assert b.result(req) == solo[: stop_at + 1]  # stopped at eos, prefix identical
 
 
-def test_int8_pool_rejected():
+def test_int8_pool_matches_solo_int8_decode():
+    # The int8 paged pool (scale planes per page) must reproduce the solo
+    # int8 contiguous decode — both quantize per (token, head) row, so the
+    # cache evolutions are identical.
     config = cfg(kv_cache_dtype="int8")
     params = T.init_params(config, jax.random.PRNGKey(0))
-    with pytest.raises(NotImplementedError, match="paged pool"):
-        ContinuousBatcher(params, config)
+    prompts = [
+        np.asarray(jax.random.randint(jax.random.PRNGKey(i + 30), (L,), 0,
+                                      config.vocab_size))
+        for i, L in enumerate([4, 9])
+    ]
+    want = [reference_tokens(params, config, p, 5) for p in prompts]
+    b = ContinuousBatcher(
+        params, config, max_batch=2, n_pages=16, page_size=4,
+        max_pages_per_seq=4,
+    )
+    reqs = [b.submit(p, 5) for p in prompts]
+    b.run_to_completion()
+    assert [b.result(r) for r in reqs] == want
