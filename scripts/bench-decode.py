@@ -81,12 +81,17 @@ def main() -> None:
     c = config
     first = jnp.argmax(logits[:, -1:, :], axis=-1).astype(jnp.int32)
 
-    def decode_n(cfg, n_steps):
+    def decode_chain(step_fn, n_steps):
+        """The ONE chained-decode loop both the contiguous and paged
+        measurements compile — structurally identical by construction, so
+        their comparison prices only the cache indexing.
+        ``step_fn(tok, pos, cache) -> (logits, cache)``."""
+
         @jax.jit
         def f(tok, cache):
             def body(carry, pos):
                 tok, cache = carry
-                lg, cache = decode_step(params, tok, pos, cache, cfg)
+                lg, cache = step_fn(tok, pos, cache)
                 nxt = jnp.argmax(lg[:, -1:, :], axis=-1).astype(jnp.int32)
                 return (nxt, cache), None
 
@@ -97,6 +102,12 @@ def main() -> None:
             return tok.astype(jnp.float32).sum()
 
         return f
+
+    def decode_n(cfg, n_steps):
+        return decode_chain(
+            lambda tok, pos, cache: decode_step(params, tok, pos, cache, cfg),
+            n_steps,
+        )
 
     def best_of(f, *args, reps=3):
         float(f(*args))  # compile + warm
@@ -136,6 +147,50 @@ def main() -> None:
         ),
         "int8_approx_hbm_gbps": round(
             (2 * n_params + cache_bytes["int8"]) / per_step["int8"] / 1e9, 1
+        ),
+    })
+
+    # --- paged cache: the serving layout's cost vs the contiguous cache ----
+    # Same config, same step count; the delta prices the block-table
+    # gather/scatter indirection (the capacity win — densely shared pages
+    # across heterogeneous requests — is free only if this tax is small).
+    from bee_code_interpreter_tpu.models.transformer import decode_step_paged
+    from bee_code_interpreter_tpu.ops.paged_kv_cache import (
+        alloc_paged_cache,
+        seed_prefill,
+    )
+
+    import math as _math
+
+    ps = 64
+    P = ctx // ps
+    paged0 = alloc_paged_cache(config, n_pages=1 + B * P, page_size=ps)
+    bt = (1 + jnp.arange(B * P, dtype=jnp.int32)).reshape(B, P)
+    n_prompt_pages = _math.ceil(L_prompt / ps)
+    for b in range(B):
+        # seed only the pages the prompt occupies (the rest are already
+        # zero; scattering them again is pure setup traffic)
+        paged0 = seed_prefill(
+            paged0, bt[b, :n_prompt_pages], k_pre[:, b], v_pre[:, b]
+        )
+
+    def decode_paged_n(n_steps):
+        return decode_chain(
+            lambda tok, pos, cache: decode_step_paged(
+                params, tok, jnp.full((B,), pos), cache, bt, config
+            ),
+            n_steps,
+        )
+
+    t_pn = best_of(decode_paged_n(N), first, paged0)
+    t_p1 = best_of(decode_paged_n(1), first, paged0)
+    per_step_paged = chain_diff(t_pn, t_p1, N)
+    emit("paged_decode", {
+        "page_size": ps, "pages_per_seq": P,
+        "per_step_ms": round(per_step_paged * 1e3, 3),
+        "tokens_per_sec": round(B / per_step_paged, 1),
+        "overhead_vs_contiguous": round(
+            per_step_paged / per_step["bf16"] - 1.0, 3
         ),
     })
 

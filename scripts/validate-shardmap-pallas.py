@@ -78,6 +78,27 @@ def main() -> None:
         (fn_ring(q, k, v) - ref).astype(jnp.float32)
     )))
 
+    # 2a. windowed flash-hop ring: on the 1-device mesh only the own-block
+    # hop runs, which is exactly the Pallas-specific part of the round-4
+    # window-through-sp path — the kernel's window masking lowering under
+    # shard_map. (The boundary-straddle hop is plain jax einsum math,
+    # multi-hop geometry is pinned on the CPU mesh by tests/test_parallel.)
+    fn_ring_win = jax.shard_map(
+        functools.partial(
+            ring_attention, axis_name="sp", use_flash=True, window=300
+        ),
+        mesh=mesh, in_specs=(spec4, spec4, spec4), out_specs=spec4,
+        check_vma=False,
+    )
+    from bee_code_interpreter_tpu.parallel.ring_attention import (
+        reference_attention,
+    )
+
+    ref_win = reference_attention(q, k, v, causal=True, window=300)
+    err_ring_win = float(jnp.max(jnp.abs(
+        (fn_ring_win(q, k, v) - ref_win).astype(jnp.float32)
+    )))
+
     # 2b. Ulysses standalone entry (flash under shard_map via all_to_all —
     # the exact path ADVICE r3 flagged as never lowered on silicon)
     from bee_code_interpreter_tpu.parallel.ulysses import (
@@ -103,11 +124,12 @@ def main() -> None:
     lg_none = forward(params, tokens, cfg, None)
     err_fwd = float(jnp.max(jnp.abs(lg_mesh - lg_none)))
 
-    ok = (err_local < 1e-2 and err_ring < 1e-2 and err_uly < 1e-2
-          and err_fwd < 1e-2)
+    ok = (err_local < 1e-2 and err_ring < 1e-2 and err_ring_win < 1e-2
+          and err_uly < 1e-2 and err_fwd < 1e-2)
     payload = {
         "local_in_shardmap_err": round(err_local, 6),
         "flash_hop_ring_err": round(err_ring, 6),
+        "windowed_ring_err": round(err_ring_win, 6),
         "ulysses_sharded_err": round(err_uly, 6),
         "sharded_forward_err": round(err_fwd, 6),
         "ok": ok,
