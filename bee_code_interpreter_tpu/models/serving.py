@@ -24,6 +24,12 @@ per request (pinned by tests/test_serving.py) — batching other requests
 alongside cannot change a request's output, which is the correctness bar
 for continuous batching.
 
+Sampling is PER REQUEST (temperature / top-k / top-p / seed — the
+heterogeneity serving actually needs) and runs host-side on the step's
+logits: the device program stays one fixed-shape greedy-agnostic forward,
+while each row draws from its own seeded ``numpy`` Generator — fully
+deterministic per request and independent of what shares the batch.
+
 The reference has no model serving at all (SURVEY §2); within this rebuild
 the batcher is the library-level analogue of the service's warm sandbox
 pool: admit, run isolated, recycle.
@@ -32,6 +38,7 @@ pool: admit, run isolated, recycle.
 from __future__ import annotations
 
 import functools
+from dataclasses import dataclass
 
 import jax
 import jax.numpy as jnp
@@ -51,6 +58,56 @@ from bee_code_interpreter_tpu.ops.paged_kv_cache import (
 # so their (masked, ignored) reads and writes never touch a live request's
 # pages; the allocator never hands it out.
 _SCRATCH_PAGE = 0
+
+
+@dataclass(frozen=True)
+class SamplingParams:
+    """Per-request decoding knobs — the same semantics as
+    ``transformer.sample_logits`` (greedy at temperature 0; otherwise
+    categorical over temperature-scaled logits with top-k, then
+    smallest-set-above-top-p filtering, always keeping at least the top
+    token), drawn from a per-request seeded generator so a request's
+    output never depends on its batch-mates."""
+
+    temperature: float = 0.0
+    top_k: int | None = None
+    top_p: float | None = None
+    seed: int = 0
+
+    def __post_init__(self) -> None:
+        # same fail-fast rule as sample_logits: validated regardless of
+        # temperature, so a greedy-tested config can't blow up later
+        if self.top_k is not None and self.top_k < 1:
+            raise ValueError(f"top_k must be >= 1, got {self.top_k}")
+        if self.temperature < 0.0:
+            raise ValueError(
+                f"temperature must be >= 0, got {self.temperature}"
+            )
+
+
+def sample_host(
+    logits: np.ndarray,  # [V] f32
+    params: SamplingParams,
+    rng: np.random.Generator,
+) -> int:
+    """One host-side draw mirroring ``sample_logits`` for a single row."""
+    if params.temperature <= 0.0:
+        return int(np.argmax(logits))
+    lg = logits.astype(np.float64) / params.temperature
+    if params.top_k is not None:
+        kth = np.partition(lg, -params.top_k)[-params.top_k]
+        lg = np.where(lg < kth, -np.inf, lg)
+    if params.top_p is not None:
+        order = np.argsort(-lg)
+        probs = np.exp(lg[order] - lg[order[0]])
+        probs /= probs.sum()
+        keep = np.cumsum(probs) - probs < params.top_p  # smallest set > p
+        keep[0] = True  # at least the top token (sample_logits parity:
+        # top_p <= 0 would otherwise mask the whole vocab into NaNs)
+        lg[order[~keep]] = -np.inf
+    probs = np.exp(lg - lg.max())
+    probs /= probs.sum()
+    return int(rng.choice(logits.shape[0], p=probs))
 
 
 class ContinuousBatcher:
@@ -91,6 +148,8 @@ class ContinuousBatcher:
         self.row_request = np.full(max_batch, -1, dtype=np.int64)
         self.results: dict[int, list[int]] = {}
         self.done: dict[int, bool] = {}
+        self.row_sampling: list[SamplingParams | None] = [None] * max_batch
+        self.row_rng: list[np.random.Generator | None] = [None] * max_batch
         self._next_request_id = 0
         self.free_pages = list(range(n_pages - 1, _SCRATCH_PAGE, -1))
         # donate the pool: without aliasing, every decoded token would pay
@@ -107,11 +166,17 @@ class ContinuousBatcher:
     def has_free_row(self) -> bool:
         return bool((~self.active).any())
 
-    def submit(self, prompt, max_new_tokens: int) -> int:
+    def submit(
+        self,
+        prompt,
+        max_new_tokens: int,
+        sampling: SamplingParams | None = None,
+    ) -> int:
         """Prefill ``prompt`` into freshly allocated pages and return a
-        REQUEST id (stable across row recycling). Raises if no free row or
-        not enough free pages (callers queue and retry after a step frees
-        capacity)."""
+        REQUEST id (stable across row recycling). ``sampling`` defaults to
+        greedy; a fixed seed makes the request fully deterministic. Raises
+        if no free row or not enough free pages (callers queue and retry
+        after a step frees capacity)."""
         prompt = np.asarray(prompt, dtype=np.int32).reshape(-1)
         L = int(prompt.shape[0])
         if L < 1:
@@ -148,13 +213,19 @@ class ContinuousBatcher:
             jnp.asarray(pages[:n_prompt_pages], dtype=jnp.int32),
             k_pre[:, 0], v_pre[:, 0],
         )
-        first = int(jnp.argmax(logits[0, L - 1, :]))
+        sampling = sampling or SamplingParams()
+        rng = np.random.default_rng(sampling.seed)
+        first = sample_host(
+            np.asarray(logits[0, L - 1, :], dtype=np.float32), sampling, rng
+        )
         req = self._next_request_id
         self._next_request_id += 1
         self.pos[row] = L
         self.current[row, 0] = first
         self.budget[row] = max_new_tokens
         self.row_request[row] = req
+        self.row_sampling[row] = sampling
+        self.row_rng[row] = rng
         self.results[req] = [first]
         self.done[req] = False
         self.active[row] = True
@@ -173,11 +244,30 @@ class ContinuousBatcher:
             self.cache,
             jnp.asarray(self.block_table),
         )
-        nxt = np.asarray(jnp.argmax(logits[:, -1, :], axis=-1), dtype=np.int32)
-        for row in np.flatnonzero(self.active):
+        active_rows = np.flatnonzero(self.active)
+        any_sampled = any(
+            self.row_sampling[row].temperature > 0.0 for row in active_rows
+        )
+        # the common all-greedy case reduces on device and moves B int32s;
+        # the full [max_batch, V] logits cross to host only when some
+        # active row actually samples
+        greedy = np.asarray(
+            jnp.argmax(logits[:, -1, :], axis=-1), dtype=np.int32
+        )
+        lg = (
+            np.asarray(logits[:, -1, :], dtype=np.float32)
+            if any_sampled else None
+        )
+        for row in active_rows:
+            if self.row_sampling[row].temperature > 0.0:
+                nxt = sample_host(
+                    lg[row], self.row_sampling[row], self.row_rng[row]
+                )
+            else:
+                nxt = int(greedy[row])
             self.pos[row] += 1
-            self.current[row, 0] = nxt[row]
-            self.results[int(self.row_request[row])].append(int(nxt[row]))
+            self.current[row, 0] = nxt
+            self.results[int(self.row_request[row])].append(nxt)
             self._retire_if_done(int(row))
 
     def _retire_if_done(self, row: int) -> None:
@@ -190,6 +280,8 @@ class ContinuousBatcher:
             self.active[row] = False
             self.done[req] = True
             self.row_request[row] = -1
+            self.row_sampling[row] = None
+            self.row_rng[row] = None
             used = set(self.block_table[row].tolist()) - {_SCRATCH_PAGE}
             self.free_pages.extend(sorted(used, reverse=True))
             self.block_table[row, :] = _SCRATCH_PAGE
