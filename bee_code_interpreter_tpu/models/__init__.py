@@ -23,3 +23,7 @@ from bee_code_interpreter_tpu.models.speculative import (  # noqa: F401
     speculative_generate,
 )
 from bee_code_interpreter_tpu.models.beam import beam_search  # noqa: F401
+from bee_code_interpreter_tpu.models.serving import (  # noqa: F401
+    ContinuousBatcher,
+    SamplingParams,
+)

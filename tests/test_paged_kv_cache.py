@@ -40,17 +40,13 @@ def seed_pages(cache, k_pre, v_pre, block_table, page_size):
     return cache
 
 
-@pytest.mark.parametrize("table", ["identity", "permuted"])
-@pytest.mark.parametrize("kv_cache_dtype", ["bf16", "int8"])
-def test_paged_decode_matches_contiguous(table, kv_cache_dtype):
-    # Same prompt in both caches; 4 decode steps; logits must agree at
-    # every step regardless of which physical pages back the sequence.
-    # int8 pools quantize per row exactly like the contiguous strategy, so
-    # the equality holds there too (scale planes gathered with the pages).
-    config = cfg(kv_cache_dtype=kv_cache_dtype)
+def assert_paged_matches_contiguous(config, table="identity", *, B=2, L=11,
+                                    ps=4, P=6, steps=4):
+    """THE paged-vs-contiguous equality loop (single copy): seed both
+    caches from one prefill, decode ``steps`` tokens through each path,
+    assert per-step logit equality."""
     params = T.init_params(config, jax.random.PRNGKey(0))
-    B, L, ps, P = 2, 11, 4, 6
-    tokens = jax.random.randint(jax.random.PRNGKey(1), (B, L + 5), 0,
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (B, L + steps + 1), 0,
                                 config.vocab_size)
     _, (k_pre, v_pre) = T.forward(params, tokens[:, :L], config, return_kv=True)
 
@@ -65,7 +61,7 @@ def test_paged_decode_matches_contiguous(table, kv_cache_dtype):
     bt = jnp.asarray(bt)
 
     cur = tokens[:, L : L + 1]
-    for i in range(4):
+    for i in range(steps):
         pos = jnp.int32(L + i)
         lg_c, contiguous = T.decode_step(params, cur, pos, contiguous, config)
         lg_p, paged = T.decode_step_paged(
@@ -76,6 +72,16 @@ def test_paged_decode_matches_contiguous(table, kv_cache_dtype):
             err_msg=f"step {i} table={table}",
         )
         cur = jnp.argmax(lg_c[:, -1:, :], axis=-1).astype(jnp.int32)
+
+
+@pytest.mark.parametrize("table", ["identity", "permuted"])
+@pytest.mark.parametrize("kv_cache_dtype", ["bf16", "int8"])
+def test_paged_decode_matches_contiguous(table, kv_cache_dtype):
+    # Same prompt in both caches; logits must agree at every step
+    # regardless of which physical pages back the sequence. int8 pools
+    # quantize per row exactly like the contiguous strategy, so the
+    # equality holds there too (scale planes gathered with the pages).
+    assert_paged_matches_contiguous(cfg(kv_cache_dtype=kv_cache_dtype), table)
 
 
 def test_heterogeneous_positions():
@@ -127,6 +133,14 @@ def test_heterogeneous_positions():
             nxt.append(jnp.argmax(lg_s[:, -1:, :], axis=-1).astype(jnp.int32))
         cur = jnp.concatenate(nxt, axis=0)
         pos = pos + 1
+
+
+def test_paged_decode_sliding_window_matches_contiguous():
+    # paged x sliding_window: the per-row window mask composes with the
+    # block-table gather exactly as with the contiguous cache.
+    assert_paged_matches_contiguous(
+        cfg(sliding_window=5), B=2, L=9, ps=4, P=4, steps=3
+    )
 
 
 def test_paged_read_layout():
