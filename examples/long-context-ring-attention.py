@@ -20,3 +20,12 @@ k, v = (
 out = ring_attention_sharded(mesh, q, k, v, causal=True)
 print(f"ring attention over {n} device(s): out {out.shape} {out.dtype}")
 print(f"finite: {bool(jnp.isfinite(out.astype(jnp.float32)).all())}")
+
+# Sliding-window variant: hops entirely below the window are skipped like
+# future blocks, so a window spanning w/L_local blocks attends O(w/L_local)
+# of the sp hops instead of all of them — the long-context win compounds
+# with Mistral-style local attention.
+w = L // max(2, n)
+out_w = ring_attention_sharded(mesh, q, k, v, causal=True, window=w)
+print(f"windowed (w={w}): out {out_w.shape}, "
+      f"finite: {bool(jnp.isfinite(out_w.astype(jnp.float32)).all())}")

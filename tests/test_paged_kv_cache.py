@@ -135,11 +135,14 @@ def test_heterogeneous_positions():
         pos = pos + 1
 
 
-def test_paged_decode_sliding_window_matches_contiguous():
+@pytest.mark.parametrize("table", ["identity", "permuted"])
+def test_paged_decode_sliding_window_matches_contiguous(table):
     # paged x sliding_window: the per-row window mask composes with the
-    # block-table gather exactly as with the contiguous cache.
+    # block-table gather exactly as with the contiguous cache — the mask
+    # must apply in LOGICAL order, so the permuted table is the case that
+    # would catch physical-order masking.
     assert_paged_matches_contiguous(
-        cfg(sliding_window=5), B=2, L=9, ps=4, P=4, steps=3
+        cfg(sliding_window=5), table, B=2, L=9, ps=4, P=4, steps=3
     )
 
 
