@@ -85,6 +85,31 @@ class SamplingParams:
             )
 
 
+def filtered_probs_host(
+    logits: np.ndarray, params: SamplingParams
+) -> np.ndarray:
+    """The numpy mirror of ``transformer.filter_logits`` + softmax for one
+    row — pure host math so the decode loop never dispatches per-row jax
+    ops through a (possibly tunneled) device. Tie semantics match the
+    device filter exactly (top-k keeps >= kth; nucleus order is a stable
+    descending argsort; top token always kept) — pinned by
+    tests/test_serving.py::test_host_filter_parity_with_device."""
+    lg = logits.astype(np.float64) / params.temperature
+    if params.top_k is not None:
+        kth = np.partition(lg, -params.top_k)[-params.top_k]
+        lg = np.where(lg < kth, -np.inf, lg)
+    if params.top_p is not None:
+        order = np.argsort(-lg, kind="stable")
+        probs = np.exp(lg[order] - lg[order[0]])
+        probs /= probs.sum()
+        keep = np.cumsum(probs) - probs < params.top_p  # smallest set > p
+        keep[0] = True  # at least the top token (device-filter parity:
+        # top_p <= 0 would otherwise mask the whole vocab into NaNs)
+        lg[order[~keep]] = -np.inf
+    probs = np.exp(lg - lg.max())
+    return probs / probs.sum()
+
+
 def sample_host(
     logits: np.ndarray,  # [V] f32
     params: SamplingParams,
@@ -93,20 +118,7 @@ def sample_host(
     """One host-side draw mirroring ``sample_logits`` for a single row."""
     if params.temperature <= 0.0:
         return int(np.argmax(logits))
-    lg = logits.astype(np.float64) / params.temperature
-    if params.top_k is not None:
-        kth = np.partition(lg, -params.top_k)[-params.top_k]
-        lg = np.where(lg < kth, -np.inf, lg)
-    if params.top_p is not None:
-        order = np.argsort(-lg)
-        probs = np.exp(lg[order] - lg[order[0]])
-        probs /= probs.sum()
-        keep = np.cumsum(probs) - probs < params.top_p  # smallest set > p
-        keep[0] = True  # at least the top token (sample_logits parity:
-        # top_p <= 0 would otherwise mask the whole vocab into NaNs)
-        lg[order[~keep]] = -np.inf
-    probs = np.exp(lg - lg.max())
-    probs /= probs.sum()
+    probs = filtered_probs_host(logits, params)
     return int(rng.choice(logits.shape[0], p=probs))
 
 
@@ -203,21 +215,38 @@ class ContinuousBatcher:
         self.block_table[row, :] = _SCRATCH_PAGE
         self.block_table[row, :n_need] = pages
 
-        # prefill: exact O(L^2) forward, then the shared one-scatter-per-
-        # leaf page seeding (ops/paged_kv_cache.seed_prefill — the equality
-        # tests call the same function, so the tested path IS this path)
-        logits, (k_pre, v_pre) = self._prefill(self.params, prompt[None, :])
-        n_prompt_pages = -(-L // self.page_size)
-        self.cache = seed_prefill(
-            self.cache,
-            jnp.asarray(pages[:n_prompt_pages], dtype=jnp.int32),
-            k_pre[:, 0], v_pre[:, 0],
-        )
-        sampling = sampling or SamplingParams()
-        rng = np.random.default_rng(sampling.seed)
-        first = sample_host(
-            np.asarray(logits[0, L - 1, :], dtype=np.float32), sampling, rng
-        )
+        try:
+            # prefill: exact O(L^2) forward, then the shared one-scatter-
+            # per-leaf page seeding (ops/paged_kv_cache.seed_prefill — the
+            # equality tests call the same function, so the tested path IS
+            # this path). The prompt is PADDED to a whole number of pages
+            # before the jitted forward: distinct prompt lengths would
+            # otherwise each pay a full XLA recompile inside submit(); pad
+            # tokens are causal-masked for every row < L, so logits[L-1]
+            # and K/V[:L] are exact, and the compile count is bounded by
+            # max_pages_per_seq instead of max_len.
+            n_prompt_pages = -(-L // self.page_size)
+            Lp = n_prompt_pages * self.page_size
+            padded = np.zeros(Lp, dtype=np.int32)
+            padded[:L] = prompt
+            logits, (k_pre, v_pre) = self._prefill(self.params, padded[None, :])
+            self.cache = seed_prefill(
+                self.cache,
+                jnp.asarray(pages[:n_prompt_pages], dtype=jnp.int32),
+                k_pre[:, 0, :, :L, :], v_pre[:, 0, :, :L, :],
+            )
+            sampling = sampling or SamplingParams()
+            rng = np.random.default_rng(sampling.seed)
+            first = sample_host(
+                np.asarray(logits[0, L - 1, :], dtype=np.float32), sampling, rng
+            )
+        except BaseException:
+            # a failed admission (prefill OOM, bad sampling params, ...)
+            # must not leak its pages: the row never activated, so nothing
+            # else will ever return them to the pool
+            self.block_table[row, :] = _SCRATCH_PAGE
+            self.free_pages.extend(reversed(pages))
+            raise
         req = self._next_request_id
         self._next_request_id += 1
         self.pos[row] = L
@@ -292,12 +321,26 @@ class ContinuousBatcher:
         return self.done.get(request_id, False)
 
     def result(self, request_id: int) -> list[int]:
-        """Generated tokens for a request (first token included)."""
+        """Generated tokens for a request (first token included). Results
+        are held until ``release`` — a long-running server should release
+        each consumed result or host memory grows with request count."""
         if request_id not in self.results:
+            if self.done.get(request_id):
+                raise KeyError(f"request {request_id} was released")
             raise KeyError(f"unknown request {request_id}")
         if not self.done[request_id]:
             raise RuntimeError(f"request {request_id} still decoding")
         return list(self.results[request_id])
+
+    def release(self, request_id: int) -> None:
+        """Drop a finished request's stored result (pages were already
+        recycled at retirement; this frees the host-side token list). The
+        done-flag is kept — a bool per request — so ``is_done`` stays True
+        and a poller can't spin forever on a released id; ``result`` then
+        reports 'released', not 'unknown'."""
+        if request_id in self.done and not self.done[request_id]:
+            raise RuntimeError(f"request {request_id} still decoding")
+        self.results.pop(request_id, None)
 
     def run_to_completion(self, max_steps: int = 10_000) -> None:
         for _ in range(max_steps):

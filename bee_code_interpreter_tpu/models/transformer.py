@@ -851,13 +851,25 @@ def sample_logits(
         raise ValueError(f"top_k must be >= 1, got {top_k}")
     if temperature == 0.0:
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)[:, None]
-    x = logits / temperature
+    x = filter_logits(logits / temperature, top_k, top_p)
+    return jax.random.categorical(key, x, axis=-1).astype(jnp.int32)[:, None]
+
+
+def filter_logits(
+    x: jax.Array,  # [B, V] temperature-scaled logits
+    top_k: int | None = None,
+    top_p: float | None = None,
+) -> jax.Array:
+    """THE definition of the sampling filters (mask-to--inf): top-k, then
+    nucleus — keep the smallest prefix of the descending-prob order whose
+    mass reaches ``top_p``, always at least the top token. ``sample_logits``
+    draws from this on device; serving's host-side sampler mirrors it in
+    numpy with parity pinned against this function
+    (tests/test_serving.py::test_host_filter_parity_with_device)."""
     if top_k is not None:
         kth = lax.top_k(x, top_k)[0][:, -1:]  # [B, 1] k-th largest
         x = jnp.where(x >= kth, x, -jnp.inf)
     if top_p is not None:
-        # nucleus: keep the smallest prefix of the descending-prob order
-        # whose mass reaches top_p (always at least the top token)
         sort_idx = jnp.argsort(-x, axis=-1)
         sorted_x = jnp.take_along_axis(x, sort_idx, axis=-1)
         probs = jax.nn.softmax(sorted_x, axis=-1)
@@ -870,7 +882,7 @@ def sample_logits(
             jnp.arange(x.shape[0])[:, None], sort_idx
         ].set(keep_sorted)
         x = jnp.where(keep, x, -jnp.inf)
-    return jax.random.categorical(key, x, axis=-1).astype(jnp.int32)[:, None]
+    return x
 
 
 # ---------------------------------------------------------------- loss/train

@@ -90,8 +90,13 @@ def ring_attention(
     the local causal+window mask; straddling hops mask rows to
     row - col < window - delta.
     """
-    if window is not None and not causal:
-        raise ValueError("window requires causal=True (sliding window)")
+    if window is not None:
+        if not causal:
+            raise ValueError("window requires causal=True (sliding window)")
+        if window < 1:
+            # the einsum path would otherwise mask every row of the own
+            # block and emit silent NaNs where the flash kernel raises
+            raise ValueError(f"window must be >= 1, got {window}")
     if use_flash is None:
         from bee_code_interpreter_tpu.ops.flash_attention import uses_flash
 
@@ -371,6 +376,8 @@ def reference_attention(q, k, v, *, causal=True, window=None):
         # mirror the flash kernel's validation: local_attention must behave
         # identically across platforms
         raise ValueError("window requires causal=True (sliding window)")
+    if window is not None and window < 1:
+        raise ValueError(f"window must be >= 1, got {window}")
     if causal:
         Lq, Lk = scores.shape[-2:]
         row = lax.broadcasted_iota(jnp.int32, (Lq, Lk), 0)

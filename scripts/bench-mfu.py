@@ -93,7 +93,13 @@ def best_of(f, *args, reps=2):
 t_n = best_of(train_chain({N_TRAIN}), params, opt_state, batch)
 t_1 = best_of(train_chain(1), params, opt_state, batch)
 per_step = chain_diff(t_n, t_1, {N_TRAIN}, "train")
-flops_per_step = (6 * n_params + 12 * config.n_layers * L * config.d_model) * B * L
+# 6N counts only MATMUL params: the embedding table is a gather (no
+# matmul flops), so it is excluded; the untied lm_head IS a matmul and
+# stays. Counting the embed would inflate MFU ~10% at this config.
+n_matmul_params = n_params - config.vocab_size * config.d_model
+flops_per_step = (
+    6 * n_matmul_params + 12 * config.n_layers * L * config.d_model
+) * B * L
 print(f"RESULT_TRAIN {{per_step * 1e3:.2f}} {{flops_per_step / per_step / 1e12:.4f}} {{n_params}}")
 
 # --- decode tokens/sec on the same config -------------------------------

@@ -195,6 +195,18 @@ def test_ring_window_requires_causal():
         ring_attention_sharded(mesh, q, k, v, causal=False, window=8)
 
 
+def test_ring_window_must_be_positive():
+    # window=0 would mask every row of the own block: the einsum path used
+    # to emit silent NaNs where the flash kernel raised — both now raise.
+    mesh = make_mesh({"sp": 2})
+    q, k, v = (rand((1, 2, 32, 16), i) for i in range(3))
+    for use_flash in (False, True):
+        with pytest.raises(ValueError, match="window must be >= 1"):
+            ring_attention_sharded(
+                mesh, q, k, v, causal=True, window=0, use_flash=use_flash
+            )
+
+
 @pytest.mark.parametrize("causal", [True, False])
 def test_ring_attention_flash_hops_match_reference(causal):
     # The Pallas-kernel-per-hop ring (TPU default) vs the dense reference —

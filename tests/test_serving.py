@@ -184,6 +184,89 @@ def test_sampling_params_validated():
         SamplingParams(temperature=-1.0)
 
 
+def test_host_filter_parity_with_device():
+    # The host sampler must draw from EXACTLY the distribution the device
+    # filter defines — not just on degenerate cases: random logits (with
+    # planted ties to exercise tie semantics) across a top-k/top-p grid,
+    # comparing the full filtered probability vectors.
+    from bee_code_interpreter_tpu.models.serving import (
+        SamplingParams,
+        filtered_probs_host,
+    )
+    from bee_code_interpreter_tpu.models.transformer import filter_logits
+
+    rng = np.random.default_rng(0)
+    V = 64
+    for trial in range(4):
+        logits = rng.normal(size=V).astype(np.float32)
+        logits[5] = logits[9]  # planted tie
+        for temperature in (0.5, 1.3):
+            for top_k in (None, 1, 7, V):
+                for top_p in (None, 0.0, 0.3, 0.95, 1.0):
+                    params = SamplingParams(
+                        temperature=temperature, top_k=top_k, top_p=top_p
+                    )
+                    host = filtered_probs_host(logits, params)
+                    dev = np.asarray(
+                        jax.nn.softmax(
+                            filter_logits(
+                                jnp.asarray(logits)[None, :] / temperature,
+                                top_k, top_p,
+                            ),
+                            axis=-1,
+                        )[0]
+                    )
+                    np.testing.assert_allclose(
+                        host, dev, atol=1e-6, rtol=1e-5,
+                        err_msg=f"t={temperature} k={top_k} p={top_p}",
+                    )
+
+
+def test_failed_submit_does_not_leak_pages():
+    # An admission that fails AFTER pages were allocated (here: top_k
+    # larger than the vocab blows up in the first-token draw) must return
+    # its pages and leave the row free — otherwise repeated failures drain
+    # the pool permanently.
+    from bee_code_interpreter_tpu.models.serving import SamplingParams
+
+    config = cfg()
+    params = T.init_params(config, jax.random.PRNGKey(0))
+    b = ContinuousBatcher(
+        params, config, max_batch=1, n_pages=8, page_size=4,
+        max_pages_per_seq=4,
+    )
+    free0 = len(b.free_pages)
+    bad = SamplingParams(temperature=1.0, top_k=config.vocab_size + 1)
+    for _ in range(3):
+        with pytest.raises(Exception):
+            b.submit(np.asarray([1, 2, 3]), 4, sampling=bad)
+    assert len(b.free_pages) == free0
+    assert not b.active.any()
+    # the pool still admits a good request afterwards
+    req = b.submit(np.asarray([1, 2, 3]), 4)
+    b.run_to_completion()
+    assert b.result(req) == reference_tokens(params, config, [1, 2, 3], 4)
+
+
+def test_release_frees_results():
+    config = cfg()
+    params = T.init_params(config, jax.random.PRNGKey(0))
+    b = ContinuousBatcher(
+        params, config, max_batch=1, n_pages=8, page_size=4,
+        max_pages_per_seq=4,
+    )
+    req = b.submit(np.asarray([5, 6]), 3)
+    with pytest.raises(RuntimeError, match="still decoding"):
+        b.release(req)
+    b.run_to_completion()
+    b.result(req)
+    b.release(req)
+    assert req not in b.results
+    assert b.is_done(req)  # terminal state stays observable after release
+    with pytest.raises(KeyError, match="released"):
+        b.result(req)
+
+
 def test_int8_pool_matches_solo_int8_decode():
     # The int8 paged pool (scale planes per page) must reproduce the solo
     # int8 contiguous decode — both quantize per (token, head) row, so the
