@@ -48,9 +48,11 @@ from bee_code_interpreter_tpu.models.transformer import (
     TransformerConfig,
     decode_step_paged,
     forward,
+    prefill_chunked,
 )
 from bee_code_interpreter_tpu.ops.paged_kv_cache import (
     alloc_paged_cache,
+    seed_from_contiguous,
     seed_prefill,
 )
 
@@ -173,6 +175,13 @@ class ContinuousBatcher:
         self._prefill = jax.jit(
             functools.partial(forward, config=config, return_kv=True)
         )
+        # chunked admission compiles once per (total_len, chunk, L) shape —
+        # without the jit the remainder window would dispatch op-by-op
+        # eagerly on every submit
+        self._prefill_chunked = jax.jit(
+            functools.partial(prefill_chunked, config=config),
+            static_argnames=("total_len", "chunk"),
+        )
 
     # ------------------------------------------------------------- admission
     def has_free_row(self) -> bool:
@@ -183,12 +192,22 @@ class ContinuousBatcher:
         prompt,
         max_new_tokens: int,
         sampling: SamplingParams | None = None,
+        prefill_chunk: int | None = None,
     ) -> int:
         """Prefill ``prompt`` into freshly allocated pages and return a
         REQUEST id (stable across row recycling). ``sampling`` defaults to
         greedy; a fixed seed makes the request fully deterministic. Raises
         if no free row or not enough free pages (callers queue and retry
-        after a step frees capacity)."""
+        after a step frees capacity).
+
+        ``prefill_chunk`` admits through ``prefill_chunked`` instead of the
+        one-shot O(L²) forward — activation memory bounded by the chunk,
+        the long-prompt admission path. The chunked cache is built in the
+        pool's own layout and copied into pages VERBATIM (int8 rows are
+        quantized once, never re-quantized), so a chunked admission decodes
+        exactly like prefill_chunked + contiguous decode. Trade-off: each
+        distinct (full-chunks, remainder) shape compiles once, vs the
+        padded one-shot path's max_pages_per_seq-bounded compile count."""
         prompt = np.asarray(prompt, dtype=np.int32).reshape(-1)
         L = int(prompt.shape[0])
         if L < 1:
@@ -216,30 +235,48 @@ class ContinuousBatcher:
         self.block_table[row, :n_need] = pages
 
         try:
-            # prefill: exact O(L^2) forward, then the shared one-scatter-
-            # per-leaf page seeding (ops/paged_kv_cache.seed_prefill — the
-            # equality tests call the same function, so the tested path IS
-            # this path). The prompt is PADDED to a whole number of pages
-            # before the jitted forward: distinct prompt lengths would
-            # otherwise each pay a full XLA recompile inside submit(); pad
-            # tokens are causal-masked for every row < L, so logits[L-1]
-            # and K/V[:L] are exact, and the compile count is bounded by
-            # max_pages_per_seq instead of max_len.
             n_prompt_pages = -(-L // self.page_size)
-            Lp = n_prompt_pages * self.page_size
-            padded = np.zeros(Lp, dtype=np.int32)
-            padded[:L] = prompt
-            logits, (k_pre, v_pre) = self._prefill(self.params, padded[None, :])
-            self.cache = seed_prefill(
-                self.cache,
-                jnp.asarray(pages[:n_prompt_pages], dtype=jnp.int32),
-                k_pre[:, 0, :, :L, :], v_pre[:, 0, :, :L, :],
+            pages_arr = jnp.asarray(
+                pages[:n_prompt_pages], dtype=jnp.int32
             )
+            if prefill_chunk is not None:
+                # bounded-memory admission: the chunked prefill builds the
+                # cache in the pool's layout; copy its leaves verbatim
+                last_logits, contig = self._prefill_chunked(
+                    self.params, prompt[None, :],
+                    total_len=n_prompt_pages * self.page_size,
+                    chunk=prefill_chunk,
+                )
+                self.cache = seed_from_contiguous(
+                    self.cache, pages_arr,
+                    {name: x[:, 0] for name, x in contig.items()},
+                )
+                last_row = np.asarray(last_logits[0], dtype=np.float32)
+            else:
+                # one-shot prefill: exact O(L^2) forward, then the shared
+                # one-scatter-per-leaf page seeding (seed_prefill — the
+                # equality tests call the same function, so the tested
+                # path IS this path). The prompt is PADDED to a whole
+                # number of pages before the jitted forward: distinct
+                # prompt lengths would otherwise each pay a full XLA
+                # recompile inside submit(); pad tokens are causal-masked
+                # for every row < L, so logits[L-1] and K/V[:L] are exact,
+                # and the compile count is bounded by max_pages_per_seq
+                # instead of max_len.
+                Lp = n_prompt_pages * self.page_size
+                padded = np.zeros(Lp, dtype=np.int32)
+                padded[:L] = prompt
+                logits, (k_pre, v_pre) = self._prefill(
+                    self.params, padded[None, :]
+                )
+                self.cache = seed_prefill(
+                    self.cache, pages_arr,
+                    k_pre[:, 0, :, :L, :], v_pre[:, 0, :, :L, :],
+                )
+                last_row = np.asarray(logits[0, L - 1, :], dtype=np.float32)
             sampling = sampling or SamplingParams()
             rng = np.random.default_rng(sampling.seed)
-            first = sample_host(
-                np.asarray(logits[0, L - 1, :], dtype=np.float32), sampling, rng
-            )
+            first = sample_host(last_row, sampling, rng)
         except BaseException:
             # a failed admission (prefill OOM, bad sampling params, ...)
             # must not leak its pages: the row never activated, so nothing

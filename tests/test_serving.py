@@ -267,6 +267,49 @@ def test_release_frees_results():
         b.result(req)
 
 
+def test_chunked_admission_matches_one_shot():
+    # submit(prefill_chunk=...) — the bounded-memory long-prompt admission —
+    # must produce the same tokens as the one-shot O(L^2) admission (f32
+    # config: the chunked prefill is pinned exactly equal to the full
+    # forward, so the whole request pipeline must agree).
+    config = cfg()
+    params = T.init_params(config, jax.random.PRNGKey(0))
+    prompt = np.asarray(jax.random.randint(jax.random.PRNGKey(21), (11,), 0,
+                                           config.vocab_size))
+
+    def run(**kw):
+        b = ContinuousBatcher(
+            params, config, max_batch=1, n_pages=16, page_size=4,
+            max_pages_per_seq=4,
+        )
+        r = b.submit(prompt, 5, **kw)
+        b.run_to_completion()
+        return b.result(r)
+
+    assert run(prefill_chunk=4) == run()
+
+
+def test_chunked_admission_int8_matches_generate_cached():
+    # int8 + chunked admission: the pool is seeded by VERBATIM copy of the
+    # chunked cache's int8 leaves (never re-quantized), so the batcher
+    # equals generate_cached(prefill_chunk=...) on the same config.
+    config = cfg(kv_cache_dtype="int8")
+    params = T.init_params(config, jax.random.PRNGKey(0))
+    prompt = np.asarray(jax.random.randint(jax.random.PRNGKey(22), (9,), 0,
+                                           config.vocab_size))
+    want = np.asarray(T.Transformer(config).generate_cached(
+        params, jnp.asarray(prompt)[None, :], max_new_tokens=4,
+        prefill_chunk=4,
+    )[0, len(prompt):]).tolist()
+    b = ContinuousBatcher(
+        params, config, max_batch=1, n_pages=16, page_size=4,
+        max_pages_per_seq=4,
+    )
+    r = b.submit(prompt, 4, prefill_chunk=4)
+    b.run_to_completion()
+    assert b.result(r) == want
+
+
 def test_int8_pool_matches_solo_int8_decode():
     # The int8 paged pool (scale planes per page) must reproduce the solo
     # int8 contiguous decode — both quantize per (token, head) row, so the
