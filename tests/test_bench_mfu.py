@@ -30,12 +30,13 @@ def test_tiny_payload_runs_end_to_end():
     tiny = dict(vocab_size=64, d_model=16, n_layers=1, n_heads=2,
                 n_kv_heads=2, d_ff=32, max_seq_len=64)
     src = bench_mfu.build_payload(
-        CONFIG=tiny, B=1, L=16, N_TRAIN=3, B_DEC=1, L_PROMPT=4, N_DEC=12
+        CONFIG=tiny, B=1, L=16, N_TRAIN=6, B_DEC=1, L_PROMPT=4, N_DEC=24
     )
     # chain_diff's jitter guard can legitimately trip at toy shapes on a
-    # loaded box; mechanics (payload runs, markers parse) are the point, so
-    # retry once before failing.
-    for attempt in range(2):
+    # loaded box (e.g. the full suite running in parallel); mechanics
+    # (payload runs, markers parse) are the point, so chains are long for
+    # margin and the whole payload retries before failing.
+    for attempt in range(3):
         try:
             results = asyncio.run(
                 bench.run_payload_multi(
