@@ -47,6 +47,7 @@ import numpy as np
 from bee_code_interpreter_tpu.models.transformer import (
     TransformerConfig,
     decode_step_paged,
+    decode_window_paged,
     forward,
     prefill_chunked,
 )
@@ -143,12 +144,43 @@ class ContinuousBatcher:
         page_size: int = 16,
         max_pages_per_seq: int = 8,
         eos_id: int | None = None,
+        draft_params=None,
+        draft_config: TransformerConfig | None = None,
+        gamma: int = 4,
     ) -> None:
+        """``draft_params``/``draft_config`` switch the batcher into
+        SPECULATIVE mode: every step, the draft proposes ``gamma`` greedy
+        tokens per active row (its own paged pool, same pages), the target
+        scores each row's window in ONE ``decode_window_paged`` pass, and
+        each row commits its own accept length — per-row cursors mean no
+        lockstep minimum across the batch (the continuous-batching
+        advantage over ``speculative_generate``'s static batch). Exactness
+        per request is the same greedy draft-verify guarantee, pinned by
+        tests/test_serving.py. Speculative rows must decode greedily
+        (draft-verify with sampling is rejection-sampling territory)."""
         self.params = params
         self.config = config
         self.page_size = page_size
         self.eos_id = eos_id
         self.max_len = max_pages_per_seq * page_size
+        self.draft_params = draft_params
+        self.draft_config = draft_config
+        self.gamma = gamma
+        if (draft_params is None) != (draft_config is None):
+            raise ValueError(
+                "speculative mode needs BOTH draft_params and draft_config"
+            )
+        if draft_config is not None:
+            if draft_config.vocab_size != config.vocab_size:
+                raise ValueError("target and draft must share a vocabulary")
+            if config.n_experts:
+                # same routing-pool hazard speculative_generate refuses:
+                # tests/test_beam.py::test_moe_routing_pool_coupling_demonstrated
+                raise NotImplementedError(
+                    "speculative serving requires a dense target"
+                )
+            if gamma < 1:
+                raise ValueError(f"gamma must be >= 1, got {gamma}")
         self.cache = alloc_paged_cache(config, n_pages, page_size)
         self.block_table = np.full(
             (max_batch, max_pages_per_seq), _SCRATCH_PAGE, dtype=np.int32
@@ -182,6 +214,23 @@ class ContinuousBatcher:
             functools.partial(prefill_chunked, config=config),
             static_argnames=("total_len", "chunk"),
         )
+        if draft_config is not None:
+            # the draft's own paged pool, addressed by the SAME block
+            # tables/pages (one allocation covers both models' K/V)
+            self.draft_cache = alloc_paged_cache(
+                draft_config, n_pages, page_size
+            )
+            self._draft_decode = jax.jit(
+                functools.partial(decode_step_paged, config=draft_config),
+                donate_argnums=(3,),
+            )
+            self._draft_prefill = jax.jit(
+                functools.partial(forward, config=draft_config, return_kv=True)
+            )
+            self._verify = jax.jit(
+                functools.partial(decode_window_paged, config=config),
+                donate_argnums=(3,),
+            )
 
     # ------------------------------------------------------------- admission
     def has_free_row(self) -> bool:
@@ -214,11 +263,25 @@ class ContinuousBatcher:
             raise ValueError("prompt must be non-empty")
         if max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1")
-        total = L + max_new_tokens
+        speculative = self.draft_params is not None
+        if speculative and sampling is not None and sampling.temperature > 0:
+            raise ValueError(
+                "speculative serving decodes greedily (draft-verify with "
+                "sampling needs rejection sampling, not implemented)"
+            )
+        # speculative rounds write draft/verify K/V past the budget before
+        # truncation — those slots must be OWNED pages (a scratch-page read
+        # inside the still-visible window would corrupt the verify). An
+        # active row's cursor is at most L + budget - 2 (rows at budget
+        # retire), so the deepest window write is cursor + gamma:
+        # overshoot = gamma - 1 slots beyond L + budget.
+        overshoot = self.gamma - 1 if speculative else 0
+        total = L + max_new_tokens + overshoot
         if total > self.max_len:
             raise ValueError(
-                f"prompt+generation ({total}) exceeds the block table's "
-                f"budget ({self.max_len})"
+                f"prompt+generation ({total}, incl. speculative overshoot "
+                f"{overshoot}) exceeds the block table's budget "
+                f"({self.max_len})"
             )
         free_rows = np.flatnonzero(~self.active)
         if free_rows.size == 0:
@@ -239,6 +302,26 @@ class ContinuousBatcher:
             pages_arr = jnp.asarray(
                 pages[:n_prompt_pages], dtype=jnp.int32
             )
+            # the prompt padded to a whole number of pages — shared by the
+            # one-shot target prefill and the draft prefill (one copy: a
+            # divergent pad between the two would desync their caches)
+            Lp = n_prompt_pages * self.page_size
+            padded = np.zeros(Lp, dtype=np.int32)
+            padded[:L] = prompt
+            # zero ALL allocated pages first: recycled pages hold a previous
+            # request's K/V, and speculative drafting can read one
+            # not-yet-written slot inside its visible window (the
+            # full-accept gap below) — zeros make that read deterministic
+            # and pool-history-independent, matching the contiguous
+            # speculative_generate's zero-initialized cache
+            all_pages = jnp.asarray(pages, dtype=jnp.int32)
+            for pool_name in ("cache",) + (
+                ("draft_cache",) if speculative else ()
+            ):
+                pool = getattr(self, pool_name)
+                setattr(self, pool_name, {
+                    name: x.at[:, all_pages].set(0) for name, x in pool.items()
+                })
             if prefill_chunk is not None:
                 # bounded-memory admission: the chunked prefill builds the
                 # cache in the pool's layout; copy its leaves verbatim
@@ -256,16 +339,11 @@ class ContinuousBatcher:
                 # one-shot prefill: exact O(L^2) forward, then the shared
                 # one-scatter-per-leaf page seeding (seed_prefill — the
                 # equality tests call the same function, so the tested
-                # path IS this path). The prompt is PADDED to a whole
-                # number of pages before the jitted forward: distinct
-                # prompt lengths would otherwise each pay a full XLA
-                # recompile inside submit(); pad tokens are causal-masked
-                # for every row < L, so logits[L-1] and K/V[:L] are exact,
-                # and the compile count is bounded by max_pages_per_seq
-                # instead of max_len.
-                Lp = n_prompt_pages * self.page_size
-                padded = np.zeros(Lp, dtype=np.int32)
-                padded[:L] = prompt
+                # path IS this path). The padded prompt bounds the compile
+                # count: pad tokens are causal-masked for every row < L,
+                # so logits[L-1] and K/V[:L] are exact, and distinct
+                # prompt lengths share a program per page count instead of
+                # one per length.
                 logits, (k_pre, v_pre) = self._prefill(
                     self.params, padded[None, :]
                 )
@@ -274,6 +352,17 @@ class ContinuousBatcher:
                     k_pre[:, 0, :, :L, :], v_pre[:, 0, :, :L, :],
                 )
                 last_row = np.asarray(logits[0, L - 1, :], dtype=np.float32)
+            if speculative:
+                # draft prefill into ITS pool at the same pages (the draft
+                # is small — the padded one-shot prefill is fine even when
+                # the target admission was chunked)
+                _, (dk, dv) = self._draft_prefill(
+                    self.draft_params, padded[None, :]
+                )
+                self.draft_cache = seed_prefill(
+                    self.draft_cache, pages_arr,
+                    dk[:, 0, :, :L, :], dv[:, 0, :, :L, :],
+                )
             sampling = sampling or SamplingParams()
             rng = np.random.default_rng(sampling.seed)
             first = sample_host(last_row, sampling, rng)
@@ -300,8 +389,13 @@ class ContinuousBatcher:
 
     # ----------------------------------------------------------------- step
     def step(self) -> None:
-        """Advance every active row by one token (one compiled program)."""
+        """Advance every active row — by one token (plain mode, one
+        compiled program), or by its own accept length (speculative
+        mode)."""
         if not self.active.any():
+            return
+        if self.draft_params is not None:
+            self._step_speculative()
             return
         logits, self.cache = self._decode(
             self.params,
@@ -335,6 +429,66 @@ class ContinuousBatcher:
             self.current[row, 0] = nxt
             self.results[int(self.row_request[row])].append(nxt)
             self._retire_if_done(int(row))
+
+    def _step_speculative(self) -> None:
+        """One draft-propose / target-verify / per-row-commit round.
+
+        The draft runs γ paged decode steps (each one compiled program over
+        the whole batch); the target scores every row's (current + drafts)
+        window in ONE ``decode_window_paged``; each row then commits its
+        own longest matching prefix plus the target's correction token —
+        rows never wait for each other (no lockstep minimum). Rejected
+        draft positions stay in both pools as stale K/V, invisible behind
+        each row's cursor until overwritten — the same no-rewind masking
+        argument as ``speculative_generate``, applied per row.
+
+        Known draft-quality (not correctness) gap, shared with the
+        contiguous ``speculative_generate``: on a fully-accepted round the
+        DRAFT pool never receives K/V for the last accepted draft token
+        (the loop feeds it forward without appending), so later draft
+        steps see zeros at that slot (pages are zeroed at admission —
+        deterministic, pool-history-independent). The target verify is
+        unaffected; only draft acceptance on those rows can dip."""
+        bt = jnp.asarray(self.block_table)
+        pos_dev = jnp.asarray(self.pos)
+        cur = jnp.asarray(self.current)
+
+        drafts = []
+        tok, p = cur, pos_dev
+        for _ in range(self.gamma):
+            lg, self.draft_cache = self._draft_decode(
+                self.draft_params, tok, p, self.draft_cache, bt
+            )
+            tok = jnp.argmax(lg[:, -1:, :], axis=-1).astype(jnp.int32)
+            drafts.append(tok)
+            p = p + 1
+        drafts_dev = jnp.concatenate(drafts, axis=1)  # [B, gamma]
+
+        window = jnp.concatenate([cur, drafts_dev], axis=1)  # [B, gamma+1]
+        t_logits, self.cache = self._verify(
+            self.params, window, pos_dev, self.cache, bt
+        )
+        t_pred = np.asarray(
+            jnp.argmax(t_logits, axis=-1), dtype=np.int32
+        )  # [B, gamma+1]
+        drafts_np = np.asarray(drafts_dev, dtype=np.int32)
+
+        for row in np.flatnonzero(self.active):
+            match = drafts_np[row] == t_pred[row, : self.gamma]
+            n = int(np.argmin(match)) if not match.all() else self.gamma
+            commit = [*drafts_np[row, :n].tolist(), int(t_pred[row, n])]
+            req = int(self.row_request[row])
+            out = self.results[req]
+            for tok_committed in commit:
+                out.append(int(tok_committed))
+                if len(out) >= self.budget[row] or (
+                    self.eos_id is not None
+                    and tok_committed == self.eos_id
+                ):
+                    break  # later commits would exceed the stop — drop them
+            self.pos[row] += n + 1
+            self.current[row, 0] = int(t_pred[row, n])
+            self._retire_if_done(row)
 
     def _retire_if_done(self, row: int) -> None:
         req = int(self.row_request[row])

@@ -696,18 +696,34 @@ def decode_step_paged(
     config: TransformerConfig,
 ) -> tuple[jax.Array, dict]:
     """One incremental decode step over the PAGED cache — the serving-side
-    sibling of ``decode_step``: rows carry their own positions (so a batch
-    can mix requests at different lengths — continuous batching,
-    models/serving.py) and K/V live in a shared page pool indirected
-    through ``block_table`` (ops/paged_kv_cache.py).
+    sibling of ``decode_step``. This IS ``decode_window_paged`` with W=1
+    (one body, mirroring the contiguous decode_step/decode_window
+    unification)."""
+    return decode_window_paged(params, token, pos, cache, block_table, config)
 
-    The layer math is decode_window's W=1 grouped-query einsums verbatim;
-    only the cache indexing differs, so paged-vs-contiguous equality is an
-    indexing property (pinned by tests/test_paged_kv_cache.py, including
-    permuted page tables). Both pool layouts — int8 pools carry per-row
-    scale planes per page and append/read quantize exactly like the
-    contiguous strategy. Rows whose slot would exceed the table's page
-    budget are a scheduler bug (the scatter clamps).
+
+def decode_window_paged(
+    params: Params,
+    tokens: jax.Array,  # [B, W] int32 — W consecutive tokens per row
+    pos0: jax.Array,  # [B] int32 — PER-ROW position of tokens[:, 0]
+    cache: dict,  # ops/paged_kv_cache.alloc_paged_cache pool
+    block_table: jax.Array,  # [B, P] int32 logical block -> physical page
+    config: TransformerConfig,
+) -> tuple[jax.Array, dict]:
+    """Multi-token cached decode over the PAGED pool with PER-ROW window
+    positions — the verify primitive for speculative decoding INSIDE
+    continuous batching: each row scores its own drafted window at its own
+    cursor in one pass, rows at heterogeneous lengths together
+    (models/serving.py). The serving-side sibling of ``decode_window``.
+
+    The layer math is decode_window's grouped-query einsums verbatim; only
+    the cache indexing differs (a row's W tokens may straddle a page
+    boundary — one scatter either way), so paged-vs-contiguous equality is
+    an indexing property (pinned by tests/test_paged_kv_cache.py,
+    including permuted page tables). Both pool layouts — int8 pools carry
+    per-row scale planes per page and append/read quantize exactly like
+    the contiguous strategy. Rows whose slots would exceed the table's
+    page budget are a scheduler bug (the scatter clamps).
     """
     from bee_code_interpreter_tpu.ops.paged_kv_cache import (
         paged_append,
@@ -715,16 +731,16 @@ def decode_step_paged(
     )
 
     c = config
-    B = token.shape[0]
+    B, W = tokens.shape
     page_size = cache["k"].shape[3]
     S = block_table.shape[1] * page_size
-    positions = pos[:, None]  # [B, 1]
+    positions = pos0[:, None] + jnp.arange(W, dtype=jnp.int32)[None, :]  # [B, W]
     page_idx = jnp.take_along_axis(
-        block_table, (pos // page_size)[:, None], axis=1
-    )[:, 0]
-    slot_idx = pos % page_size
+        block_table, positions // page_size, axis=1
+    )  # [B, W]
+    slot_idx = positions % page_size
 
-    h = params["embed"].astype(c.dtype)[token[:, 0]][:, None, :]  # [B, 1, D]
+    h = params["embed"].astype(c.dtype)[tokens]  # [B, W, D]
 
     def layer_step(h, scanned):
         layer, c_layer = scanned  # pool slices [n_pages, kvh, ps, dh]
@@ -733,26 +749,36 @@ def decode_step_paged(
 
         def proj(w, heads):
             out = jnp.einsum("bld,dk->blk", x, w.astype(c.dtype))
-            return out.reshape(B, 1, heads, dh).transpose(0, 2, 1, 3)
+            return out.reshape(B, W, heads, dh).transpose(0, 2, 1, 3)
 
         q = rope(proj(layer["wq"], nh), positions, c.rope_theta, c.rope_scaling)
         k_new = rope(proj(layer["wk"], kvh), positions, c.rope_theta, c.rope_scaling)
         v_new = proj(layer["wv"], kvh)
         c_layer = paged_append(
-            c_layer, k_new[:, :, 0, :], v_new[:, :, 0, :], page_idx, slot_idx
+            c_layer,
+            k_new.transpose(0, 2, 1, 3),  # [B, W, kvh, dh]
+            v_new.transpose(0, 2, 1, 3),
+            page_idx, slot_idx,
         )
         kf, vf = paged_read(c_layer, block_table, c.dtype)  # [B, kvh, S, dh]
 
         rep = nh // kvh
-        qg = q[:, :, 0, :].reshape(B, kvh, rep, dh).astype(jnp.float32)
-        scores = jnp.einsum("bgrd,bgsd->bgrs", qg, kf) / math.sqrt(dh)
-        visible = jnp.arange(S)[None, :] <= pos[:, None]  # [B, S]
+        qg = q.reshape(B, kvh, rep, W, dh).astype(jnp.float32)
+        scores = jnp.einsum("bgrwd,bgsd->bgrws", qg, kf) / math.sqrt(dh)
+        # row (b, w) sees cache positions s <= pos0_b + w (and within the
+        # sliding window when configured)
+        visible = (
+            jnp.arange(S)[None, None, :] <= positions[:, :, None]
+        )  # [B, W, S]
         if c.sliding_window is not None:
-            visible &= jnp.arange(S)[None, :] > pos[:, None] - c.sliding_window
-        scores = jnp.where(visible[:, None, None, :], scores, -jnp.inf)
+            visible &= (
+                jnp.arange(S)[None, None, :]
+                > positions[:, :, None] - c.sliding_window
+            )
+        scores = jnp.where(visible[:, None, None, :, :], scores, -jnp.inf)
         weights = jax.nn.softmax(scores, axis=-1).astype(c.dtype)
-        attn = jnp.einsum("bgrs,bgsd->bgrd", weights, vf)
-        attn = attn.astype(c.dtype).reshape(B, 1, nh * dh)
+        attn = jnp.einsum("bgrws,bgsd->bgrwd", weights, vf)
+        attn = attn.transpose(0, 3, 1, 2, 4).reshape(B, W, nh * dh)
         h = h + jnp.einsum("blk,kd->bld", attn, layer["wo"].astype(c.dtype))
 
         y = rms_norm(h, layer["ln2"])

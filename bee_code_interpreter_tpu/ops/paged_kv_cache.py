@@ -68,22 +68,25 @@ def alloc_paged_cache(config, n_pages: int, page_size: int) -> dict:
 
 def paged_append(
     c_layer: dict,  # one layer's pool slice: [n_pages, kvh, ps, dh]
-    k_new: jax.Array,  # [B, kvh, dh] — one token per row
+    k_new: jax.Array,  # [B, W, kvh, dh] — W consecutive tokens per row
     v_new: jax.Array,
-    page_idx: jax.Array,  # [B] int32 physical page per row
-    slot_idx: jax.Array,  # [B] int32 slot within the page
+    page_idx: jax.Array,  # [B, W] int32 physical page per (row, token)
+    slot_idx: jax.Array,  # [B, W] int32 slot within the page
 ) -> dict:
-    """Scatter one new token's K/V per batch row into its (page, slot).
+    """Scatter W new tokens' K/V per batch row into their (page, slot)s.
 
-    Rows of a batch may land in arbitrary distinct pages — the scatter is
-    one XLA scatter op. Two rows writing the same (page, slot) is a
+    Rows of a batch may land in arbitrary distinct pages, and a row's W
+    tokens may straddle a page boundary — the scatter is one XLA scatter
+    op either way. Two (row, token)s writing the same (page, slot) is a
     scheduler bug (pages are owned by one sequence); last-writer-wins as
     with any scatter. The int8 layout quantizes per (token, head) row —
     identical semantics to the contiguous cache_append, so paged int8
-    decode equals contiguous int8 decode.
+    decode equals contiguous int8 decode (and a window append is
+    bit-identical to W single appends, which keeps paged speculative
+    verify exact).
     """
     if "k_s" in c_layer:
-        kq, ks = quantize(k_new)  # [B, kvh, dh] -> values + [B, kvh, 1]
+        kq, ks = quantize(k_new)  # [B, W, kvh, dh] -> values + [B, W, kvh, 1]
         vq, vs = quantize(v_new)
         return {
             "k": c_layer["k"].at[page_idx, :, slot_idx, :].set(kq),

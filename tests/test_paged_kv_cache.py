@@ -146,6 +146,76 @@ def test_paged_decode_sliding_window_matches_contiguous(table):
     )
 
 
+@pytest.mark.parametrize("kv_cache_dtype", ["bf16", "int8"])
+def test_paged_window_matches_sequential_steps(kv_cache_dtype):
+    # The paged verify primitive: one W-token window (crossing a page
+    # boundary) must equal W sequential paged steps — same cache
+    # evolution, same logits — for both pool layouts. This is what makes
+    # speculative decoding inside continuous batching exact.
+    config = cfg(kv_cache_dtype=kv_cache_dtype)
+    params = T.init_params(config, jax.random.PRNGKey(0))
+    B, L, ps, P, W = 2, 6, 4, 4, 4  # window spans slots 6..9: pages 1..2
+    tokens = jax.random.randint(jax.random.PRNGKey(3), (B, L + W), 0,
+                                config.vocab_size)
+    _, (k_pre, v_pre) = T.forward(params, tokens[:, :L], config, return_kv=True)
+    paged_a = alloc_paged_cache(config, n_pages=1 + B * P, page_size=ps)
+    bt = np.arange(1, 1 + B * P).reshape(B, P).astype(np.int32)
+    paged_a = seed_pages(paged_a, k_pre, v_pre, bt, ps)
+    paged_b = jax.tree.map(jnp.copy, paged_a)
+    bt = jnp.asarray(bt)
+
+    win_logits, paged_a = T.decode_window_paged(
+        params, tokens[:, L:], jnp.full((B,), L), paged_a, bt, config
+    )
+    for i in range(W):
+        step_logits, paged_b = T.decode_step_paged(
+            params, tokens[:, L + i : L + i + 1], jnp.full((B,), L + i),
+            paged_b, bt, config,
+        )
+        np.testing.assert_allclose(
+            np.asarray(win_logits[:, i]), np.asarray(step_logits[:, 0]),
+            atol=1e-4, rtol=1e-4, err_msg=f"row {i}",
+        )
+    for a, b in zip(jax.tree.leaves(paged_a), jax.tree.leaves(paged_b)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_paged_window_heterogeneous_positions():
+    # Two rows verify windows at DIFFERENT cursors in one call — each must
+    # match its own contiguous decode_window (per-row speculative verify).
+    config = cfg()
+    params = T.init_params(config, jax.random.PRNGKey(0))
+    ps, P, W = 4, 5, 3
+    lens = [3, 8]
+    paged = alloc_paged_cache(config, n_pages=1 + 2 * P, page_size=ps)
+    bt = np.zeros((2, P), np.int32)
+    contigs = []
+    wins = []
+    for b, L in enumerate(lens):
+        prompt = jax.random.randint(jax.random.PRNGKey(40 + b), (1, L), 0,
+                                    config.vocab_size)
+        _, (k_pre, v_pre) = T.forward(params, prompt, config, return_kv=True)
+        bt[b] = np.arange(1 + b * P, 1 + (b + 1) * P)
+        paged = seed_pages(paged, k_pre, v_pre, bt[b : b + 1], ps)
+        contigs.append(T.init_decode_cache(config, 1, P * ps, k_pre, v_pre))
+        wins.append(jax.random.randint(jax.random.PRNGKey(50 + b), (1, W), 0,
+                                       config.vocab_size))
+    bt = jnp.asarray(bt)
+
+    lg_p, _ = T.decode_window_paged(
+        params, jnp.concatenate(wins, axis=0),
+        jnp.asarray(lens, jnp.int32), paged, bt, config,
+    )
+    for b, L in enumerate(lens):
+        lg_c, _ = T.decode_window(
+            params, wins[b], jnp.int32(L), contigs[b], config
+        )
+        np.testing.assert_allclose(
+            np.asarray(lg_p[b]), np.asarray(lg_c[0]),
+            atol=1e-4, rtol=1e-4, err_msg=f"row {b}",
+        )
+
+
 def test_paged_read_layout():
     # The gather view reassembles logical order from scattered pages.
     config = cfg(n_layers=1)

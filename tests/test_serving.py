@@ -310,6 +310,146 @@ def test_chunked_admission_int8_matches_generate_cached():
     assert b.result(r) == want
 
 
+def draft_cfg():
+    return dataclasses.replace(
+        T.TransformerConfig.tiny(), dtype=jnp.float32, n_layers=1,
+        d_model=32, n_heads=2, d_ff=64,
+    )
+
+
+def test_speculative_serving_matches_solo_greedy():
+    # Speculative continuous batching: staggered heterogeneous requests,
+    # an unrelated random draft, per-row accept lengths — every request
+    # must equal its solo greedy decode token-for-token.
+    config = cfg()
+    params = T.init_params(config, jax.random.PRNGKey(0))
+    dparams = T.init_params(draft_cfg(), jax.random.PRNGKey(42))
+    prompts = [
+        np.asarray(jax.random.randint(jax.random.PRNGKey(60 + i), (L,), 0,
+                                      config.vocab_size))
+        for i, L in enumerate([3, 7, 5])
+    ]
+    want = [reference_tokens(params, config, p, 6) for p in prompts]
+
+    b = ContinuousBatcher(
+        params, config, max_batch=2, n_pages=24, page_size=4,
+        max_pages_per_seq=6, draft_params=dparams, draft_config=draft_cfg(),
+        gamma=3,
+    )
+    r0 = b.submit(prompts[0], 6)
+    r1 = b.submit(prompts[1], 6)
+    b.step()
+    with pytest.raises(RuntimeError, match="no free batch row"):
+        b.submit(prompts[2], 6)
+    b.run_to_completion()
+    r2 = b.submit(prompts[2], 6)
+    b.run_to_completion()
+    assert [b.result(r) for r in (r0, r1, r2)] == want
+
+
+def test_speculative_serving_perfect_draft_fewer_rounds():
+    # draft == target: every proposal accepted, so a request finishes in
+    # ~max_new/(gamma+1) rounds instead of max_new — and stays exact.
+    config = cfg()
+    params = T.init_params(config, jax.random.PRNGKey(0))
+    prompt = np.asarray([5, 3, 8, 2])
+    want = reference_tokens(params, config, prompt, 8)
+    b = ContinuousBatcher(
+        params, config, max_batch=1, n_pages=16, page_size=4,
+        max_pages_per_seq=4, draft_params=params, draft_config=config,
+        gamma=3,
+    )
+    r = b.submit(prompt, 8)
+    rounds = 0
+    while not b.is_done(r):
+        b.step()
+        rounds += 1
+    assert b.result(r) == want
+    assert rounds <= 3  # ceil((8-1)/(gamma+1)) = 2 plus slack
+
+
+def test_speculative_rounds_pool_history_independent():
+    # Pages are zeroed at admission, so a request's round count (draft
+    # acceptance) must not depend on what a PREVIOUS request left in the
+    # recycled pages — throughput isolation, not just output isolation.
+    config = cfg()
+    params = T.init_params(config, jax.random.PRNGKey(0))
+    dparams = T.init_params(draft_cfg(), jax.random.PRNGKey(42))
+    prompt = np.asarray([6, 2, 9, 1])
+
+    def make():
+        return ContinuousBatcher(
+            params, config, max_batch=1, n_pages=16, page_size=4,
+            max_pages_per_seq=4, draft_params=dparams,
+            draft_config=draft_cfg(), gamma=3,
+        )
+
+    def run(b):
+        r = b.submit(prompt, 6)
+        n = 0
+        while not b.is_done(r):
+            b.step()
+            n += 1
+        return b.result(r), n
+
+    out_fresh, n_fresh = run(make())
+    dirty = make()
+    r0 = dirty.submit(np.asarray([8, 8, 8, 8, 8, 8, 8]), 6)  # dirty the pool
+    dirty.run_to_completion()
+    out_reused, n_reused = run(dirty)
+    assert out_fresh == out_reused
+    assert n_fresh == n_reused
+
+
+def test_speculative_serving_validations():
+    config = cfg()
+    params = T.init_params(config, jax.random.PRNGKey(0))
+    dparams = T.init_params(draft_cfg(), jax.random.PRNGKey(1))
+    with pytest.raises(ValueError, match="share a vocabulary"):
+        ContinuousBatcher(
+            params, config,
+            draft_params=dparams,
+            draft_config=dataclasses.replace(draft_cfg(), vocab_size=17),
+        )
+    with pytest.raises(ValueError, match="BOTH draft_params"):
+        ContinuousBatcher(params, config, draft_params=dparams)
+    with pytest.raises(ValueError, match="gamma"):
+        ContinuousBatcher(
+            params, config, draft_params=dparams, draft_config=draft_cfg(),
+            gamma=0,
+        )
+    from bee_code_interpreter_tpu.models.serving import SamplingParams
+
+    b = ContinuousBatcher(
+        params, config, max_batch=1, n_pages=16, page_size=4,
+        max_pages_per_seq=4, draft_params=dparams, draft_config=draft_cfg(),
+    )
+    with pytest.raises(ValueError, match="greedily"):
+        b.submit(np.asarray([1, 2]), 3,
+                 sampling=SamplingParams(temperature=1.0))
+
+
+def test_speculative_serving_eos_stops_early():
+    config = cfg()
+    params = T.init_params(config, jax.random.PRNGKey(0))
+    dparams = T.init_params(draft_cfg(), jax.random.PRNGKey(7))
+    prompt = np.asarray([4, 9, 2])
+    solo = reference_tokens(params, config, prompt, 8)
+    stop_at = next(
+        (i for i in range(1, len(solo)) if solo[i] not in solo[:i]), None
+    )
+    if stop_at is None:
+        pytest.skip("greedy output has no late first-occurrence token")
+    b = ContinuousBatcher(
+        params, config, max_batch=1, n_pages=16, page_size=4,
+        max_pages_per_seq=6, draft_params=dparams, draft_config=draft_cfg(),
+        eos_id=solo[stop_at], gamma=3,
+    )
+    r = b.submit(prompt, 8)
+    b.run_to_completion()
+    assert b.result(r) == solo[: stop_at + 1]
+
+
 def test_int8_pool_matches_solo_int8_decode():
     # The int8 paged pool (scale planes per page) must reproduce the solo
     # int8 contiguous decode — both quantize per (token, head) row, so the
