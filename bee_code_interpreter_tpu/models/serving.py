@@ -308,20 +308,22 @@ class ContinuousBatcher:
             Lp = n_prompt_pages * self.page_size
             padded = np.zeros(Lp, dtype=np.int32)
             padded[:L] = prompt
-            # zero ALL allocated pages first: recycled pages hold a previous
-            # request's K/V, and speculative drafting can read one
-            # not-yet-written slot inside its visible window (the
+            # zero the DRAFT pool's allocated pages: recycled pages hold a
+            # previous request's K/V, and only speculative drafting can
+            # read a not-yet-written slot inside its visible window (the
             # full-accept gap below) — zeros make that read deterministic
             # and pool-history-independent, matching the contiguous
-            # speculative_generate's zero-initialized cache
-            all_pages = jnp.asarray(pages, dtype=jnp.int32)
-            for pool_name in ("cache",) + (
-                ("draft_cache",) if speculative else ()
-            ):
-                pool = getattr(self, pool_name)
-                setattr(self, pool_name, {
-                    name: x.at[:, all_pages].set(0) for name, x in pool.items()
-                })
+            # speculative_generate's zero-initialized cache. The target
+            # pool needs no zeroing: plain decode and the verify only read
+            # slots already written (prefill-seeded or appended by the
+            # very window doing the reading; the rest are masked), so
+            # zeroing it would just copy the whole pool per admission.
+            if speculative:
+                all_pages = jnp.asarray(pages, dtype=jnp.int32)
+                self.draft_cache = {
+                    name: x.at[:, all_pages].set(0)
+                    for name, x in self.draft_cache.items()
+                }
             if prefill_chunk is not None:
                 # bounded-memory admission: the chunked prefill builds the
                 # cache in the pool's layout; copy its leaves verbatim

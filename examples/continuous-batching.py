@@ -65,3 +65,25 @@ for idx in range(len(prompts)):
     assert got == solo[idx], (idx, got, solo[idx])
 print(f"continuous batching OK: {len(prompts)} requests over max_batch=2, "
       f"{steps} steps, {time.time() - t0:.1f}s, outputs == solo decode")
+
+# --- speculative mode: a small draft proposes, each row commits its OWN
+# accept length per round (no lockstep minimum across the batch) — output
+# still exactly equals the solo greedy decode.
+draft_config = dataclasses.replace(
+    config, n_layers=1, d_model=32, n_heads=2, n_kv_heads=2, d_ff=64,
+)
+draft_params = T.init_params(draft_config, jax.random.PRNGKey(9))
+spec = ContinuousBatcher(
+    params, config, max_batch=2, n_pages=32, page_size=8,
+    max_pages_per_seq=4, draft_params=draft_params,
+    draft_config=draft_config, gamma=3,
+)
+reqs = [spec.submit(p, new_tokens) for p in prompts[:2]]
+rounds = 0
+while not all(spec.is_done(r) for r in reqs):
+    spec.step()
+    rounds += 1
+for i, r in enumerate(reqs):
+    assert spec.result(r) == solo[i], (i, spec.result(r), solo[i])
+print(f"speculative serving OK: {len(reqs)} requests, {rounds} rounds for "
+      f"{new_tokens} tokens each (gamma=3), outputs == solo decode")

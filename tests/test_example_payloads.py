@@ -44,6 +44,7 @@ async def test_continuous_batching_example(http_app):
     body = await post_execute(http_app, {"source_code": source, "timeout": 600})
     assert body["exit_code"] == 0, body["stderr"]
     assert "continuous batching OK" in body["stdout"]
+    assert "speculative serving OK" in body["stdout"]
     assert "outputs == solo decode" in body["stdout"]
 
 
