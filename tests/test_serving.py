@@ -368,6 +368,25 @@ def test_speculative_serving_perfect_draft_fewer_rounds():
     assert rounds <= 3  # ceil((8-1)/(gamma+1)) = 2 plus slack
 
 
+def test_speculative_serving_int8_target():
+    # The full stack composed: speculative + paged + int8 target pool must
+    # equal the solo int8 greedy decode (the draft stays bf16 — drafts
+    # only propose).
+    config = cfg(kv_cache_dtype="int8")
+    params = T.init_params(config, jax.random.PRNGKey(0))
+    dparams = T.init_params(draft_cfg(), jax.random.PRNGKey(42))
+    prompt = np.asarray([7, 1, 6, 3, 9])
+    want = reference_tokens(params, config, prompt, 6)
+    b = ContinuousBatcher(
+        params, config, max_batch=1, n_pages=16, page_size=4,
+        max_pages_per_seq=4, draft_params=dparams, draft_config=draft_cfg(),
+        gamma=3,
+    )
+    r = b.submit(prompt, 6)
+    b.run_to_completion()
+    assert b.result(r) == want
+
+
 def test_speculative_rounds_pool_history_independent():
     # Pages are zeroed at admission, so a request's round count (draft
     # acceptance) must not depend on what a PREVIOUS request left in the
