@@ -61,7 +61,11 @@ SHIM_DIR = REPO / "bee_code_interpreter_tpu" / "runtime" / "shim"
 # emits a complete fallback artifact (see _install_kill_safe_emit), so a
 # driver timeout can shorten the patience but never produce an empty record.
 TPU_PATIENCE_S = float(os.environ.get("BCI_BENCH_TPU_PATIENCE_S", "1200"))
-TPU_PROBE_INTERVAL_S = float(os.environ.get("BCI_BENCH_TPU_PROBE_INTERVAL_S", "45"))
+# Gentle cadence (round-4 discovery, scripts/tpu-oneshot.py): killed probe
+# clients appear to HOLD the tunnel wedged — a 45-60 s probe storm prevents
+# the very recovery it is waiting for. 180 s gives the tunnel quiet time
+# while still catching a window inside the default patience.
+TPU_PROBE_INTERVAL_S = float(os.environ.get("BCI_BENCH_TPU_PROBE_INTERVAL_S", "180"))
 
 N = 32768
 ITERS = 16

@@ -41,23 +41,10 @@ import jax.numpy as jnp
 from jax import lax
 
 
-def main() -> None:
-    import importlib.util
-
-    spec = importlib.util.spec_from_file_location("bench", REPO / "bench.py")
-    bench = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(bench)
-    probe = bench.probe_tpu()
-    if not probe.get("ok") or probe.get("platform") != "tpu":
-        print(f"no TPU: {probe}", file=sys.stderr)
-        sys.exit(2)
-
-    import functools
-
-    from bee_code_interpreter_tpu.utils import evidence
-
-    emit = functools.partial(evidence.emit, script="scripts/bench-decode.py")
-
+def run_measurements(emit) -> None:
+    """All decode measurements, run inside an already-initialized jax
+    process — callable from scripts/tpu-oneshot.py so one tunnel client
+    captures the whole battery (see that script's docstring)."""
     from bee_code_interpreter_tpu.models.transformer import (
         TransformerConfig,
         decode_step,
@@ -280,6 +267,25 @@ def main() -> None:
         "speedup": round(results["repeat"] / results["grouped"], 2),
         "grouped_cache_gbps": round(cache_bytes / results["grouped"] / 1e9, 1),
     })
+
+
+def main() -> None:
+    import functools
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location("bench", REPO / "bench.py")
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+    probe = bench.probe_tpu()
+    if not probe.get("ok") or probe.get("platform") != "tpu":
+        print(f"no TPU: {probe}", file=sys.stderr)
+        sys.exit(2)
+
+    from bee_code_interpreter_tpu.utils import evidence
+
+    run_measurements(
+        functools.partial(evidence.emit, script="scripts/bench-decode.py")
+    )
 
 
 if __name__ == "__main__":

@@ -117,27 +117,12 @@ def timed_fwd_bwd(loss, q, k, v, n_chain: int = 8) -> float:
     return _timed_chain(make_f, q, k, v, n_chain)
 
 
-def main() -> None:
-    # Bounded out-of-process probe (bench.py's): a wedged tunnel must produce
-    # the exit-2 diagnostic, not hang this process on jax.devices().
-    import importlib.util
-
-    spec = importlib.util.spec_from_file_location("bench", REPO / "bench.py")
-    bench = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(bench)
-    probe = bench.probe_tpu()
-    if not probe.get("ok") or probe.get("platform") != "tpu":
-        print(f"no TPU: {probe}", file=sys.stderr)
-        sys.exit(2)
-
-    import functools
-
-    from bee_code_interpreter_tpu.utils import evidence
-
-    emit = functools.partial(
-        evidence.emit, script="scripts/bench-flash-attention.py"
-    )
-
+def run_measurements(emit, sweep: bool = False) -> None:
+    """Every hardware measurement, run inside an ALREADY-initialized jax
+    process. Factored out of main() so scripts/tpu-oneshot.py can run the
+    whole battery as ONE tunnel client: the tunnel serves (at best) one
+    client per healthy window, so the probe-then-measure-in-a-new-process
+    pattern is exactly how previous rounds lost their windows."""
     causal = True
 
     # --- correctness on hardware (fwd + bwd Mosaic lowering) -------------
@@ -190,7 +175,7 @@ def main() -> None:
         for i in range(3)
     )
     flops = attention_flops(B, H, L, D, causal)
-    if "--sweep" in sys.argv:
+    if sweep:
         for bq, bk in [(256, 256), (512, 512), (512, 1024), (1024, 512),
                        (1024, 1024), (1024, 2048)]:
             t = timed_fwd(
@@ -271,6 +256,30 @@ def main() -> None:
         "shape": [Bg, Hg, L, D], "kv_heads": KVH,
         "gqa_native_tflops": round(3 * flops_g / t_ggqa / 1e12, 1),
     })
+
+
+def main() -> None:
+    # Bounded out-of-process probe (bench.py's): a wedged tunnel must produce
+    # the exit-2 diagnostic, not hang this process on jax.devices().
+    import functools
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location("bench", REPO / "bench.py")
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+    probe = bench.probe_tpu()
+    if not probe.get("ok") or probe.get("platform") != "tpu":
+        print(f"no TPU: {probe}", file=sys.stderr)
+        sys.exit(2)
+
+    from bee_code_interpreter_tpu.utils import evidence
+
+    run_measurements(
+        functools.partial(
+            evidence.emit, script="scripts/bench-flash-attention.py"
+        ),
+        sweep="--sweep" in sys.argv,
+    )
 
 
 if __name__ == "__main__":

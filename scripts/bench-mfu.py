@@ -131,6 +131,56 @@ print(f"RESULT_DECODE {{per_tok * 1e3:.3f}} {{Bd / per_tok:.1f}}")
 """
 
 
+def _parse_results(stdout: str) -> dict[str, list[float]]:
+    out: dict[str, list[float]] = {}
+    for line in stdout.splitlines():
+        for marker in ("RESULT_TRAIN", "RESULT_DECODE"):
+            if line.startswith(marker):
+                out[marker] = [float(tok) for tok in line.split()[1:]]
+    missing = [m for m in ("RESULT_TRAIN", "RESULT_DECODE") if m not in out]
+    if missing:
+        raise RuntimeError(f"no {missing} in payload stdout: {stdout!r}")
+    return out
+
+
+def _emit_results(emit, results: dict[str, list[float]], via: str) -> None:
+    per_step_ms, achieved_tflops, n_params = results["RESULT_TRAIN"][:3]
+    emit("mfu_train", {
+        "config": {**CONFIG, "batch": B, "seq_len": L,
+                   "params": int(n_params)},
+        "per_step_ms": round(per_step_ms, 1),
+        "achieved_tflops": round(achieved_tflops, 1),
+        "mfu": round(achieved_tflops * 1e12 / V5E_BF16_PEAK_FLOPS, 3),
+        "peak_flops": V5E_BF16_PEAK_FLOPS,
+        "optimizer": "adamw",
+        "via": via,
+    })
+    per_tok_ms, toks_per_sec = results["RESULT_DECODE"][:2]
+    emit("service_decode" if via.startswith("service") else "mfu_decode", {
+        "config": {**CONFIG, "batch": B_DEC, "prompt_len": L_PROMPT},
+        "per_step_ms": round(per_tok_ms, 3),
+        "tokens_per_sec": round(toks_per_sec, 1),
+        "via": via,
+    })
+
+
+def run_inprocess(emit) -> None:
+    """The same train-MFU + decode payload, exec'd INSIDE an
+    already-initialized jax process — scripts/tpu-oneshot.py's one-client
+    battery path. The ``via`` field says in-process so it can never be
+    mistaken for the service-path row; main() (the service-path run) is
+    attempted separately when the tunnel tolerates more than one client."""
+    import contextlib
+    import io
+
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        exec(compile(build_payload(), "<mfu-payload>", "exec"),
+             {"__name__": "__mfu_payload__"})
+    _emit_results(emit, _parse_results(buf.getvalue()),
+                  via="in-process one-client battery")
+
+
 def main() -> None:
     spec = importlib.util.spec_from_file_location("bench", REPO / "bench.py")
     bench = importlib.util.module_from_spec(spec)
@@ -152,24 +202,7 @@ def main() -> None:
             build_payload(), {}, 1200.0, ("RESULT_TRAIN", "RESULT_DECODE")
         )
     )
-    per_step_ms, achieved_tflops, n_params = results["RESULT_TRAIN"][:3]
-    emit("mfu_train", {
-        "config": {**CONFIG, "batch": B, "seq_len": L,
-                   "params": int(n_params)},
-        "per_step_ms": round(per_step_ms, 1),
-        "achieved_tflops": round(achieved_tflops, 1),
-        "mfu": round(achieved_tflops * 1e12 / V5E_BF16_PEAK_FLOPS, 3),
-        "peak_flops": V5E_BF16_PEAK_FLOPS,
-        "optimizer": "adamw",
-        "via": "service execution path",
-    })
-    per_tok_ms, toks_per_sec = results["RESULT_DECODE"][:2]
-    emit("service_decode", {
-        "config": {**CONFIG, "batch": B_DEC, "prompt_len": L_PROMPT},
-        "per_step_ms": round(per_tok_ms, 3),
-        "tokens_per_sec": round(toks_per_sec, 1),
-        "via": "service execution path",
-    })
+    _emit_results(emit, results, via="service execution path")
 
 
 if __name__ == "__main__":

@@ -1,37 +1,34 @@
 #!/usr/bin/env python
-"""Watch the TPU tunnel and run the full hardware battery the moment it is
-healthy — the capture-on-healthy process (VERDICT r3 next-round #1/#2).
+"""Patient TPU capture loop around the one-client battery.
 
-The tunnel to the chip flips between healthy and wedged within sessions
-(BASELINE.md rounds 1-3), so hardware evidence cannot be a point-in-time
-measurement taken whenever a driver happens to run. This watcher probes on a
-cadence (bounded, out-of-process — a wedged tunnel hangs the probe
-subprocess, never the watcher) and, on the first healthy probe, runs every
-hardware-touching script in sequence:
+Round-4 discovery (see scripts/tpu-oneshot.py): the tunnel serves at best
+one jax client per healthy window, killed clients appear to hold it wedged,
+and it recovered only after ~5.4 h of complete quiet. The round-3 design —
+a 60-90 s probe cadence, each hung probe killed at 75 s, then five separate
+measurement processes — is exactly wrong for that behavior: the probe storm
+PREVENTS recovery and the throwaway probe client burns the window.
 
-  1. bench.py (short patience — the headline dense-matmul GFLOPS + flash)
-  2. scripts/validate-shardmap-pallas.py  (Mosaic-under-shard_map proof)
-  3. scripts/bench-flash-attention.py     (kernel TFLOPS vs 2 XLA baselines)
-  4. scripts/bench-decode.py              (decode tok/s, int8, speculative)
-  5. scripts/bench-mfu.py                 (flagship MFU via the service path)
+This loop therefore:
 
-Each script appends its own measurements to TPU_EVIDENCE.jsonl (see
-utils/evidence.py), so one healthy window yields a dated, git-attributed
-ledger that bench.py embeds in every later artifact even if the tunnel is
-wedged again by then. Scripts exiting 2 (chip vanished mid-battery) put the
-watcher back into its probe loop.
+  1. Launches ``scripts/tpu-oneshot.py`` directly — its jax init IS the
+     probe; on success the same process captures every measurement into
+     TPU_EVIDENCE.jsonl. No separate probe client.
+  2. Sleeps a LONG, escalating interval between attempts (default start
+     10 min, x1.5 up to 45 min) so a recovering tunnel gets real quiet time.
+  3. After a successful battery, runs the service-path follow-ups — bench.py
+     (the /v1/execute headline) and scripts/bench-mfu.py (service-path MFU
+     row) — which need fresh sandbox-subprocess clients and so only make
+     sense once a window has proven healthy.
 
 Usage:
-  python scripts/capture-on-healthy.py              # until battery completes
-  python scripts/capture-on-healthy.py --forever    # keep re-capturing
-  python scripts/capture-on-healthy.py --interval 120 --max-hours 10
+  python scripts/capture-on-healthy.py                  # until one capture
+  python scripts/capture-on-healthy.py --forever        # keep re-capturing
+  python scripts/capture-on-healthy.py --interval 300 --max-hours 10
 """
 
 from __future__ import annotations
 
 import argparse
-import importlib.util
-import json
 import os
 import subprocess
 import sys
@@ -40,41 +37,47 @@ from pathlib import Path
 
 REPO = Path(__file__).resolve().parent.parent
 
-# (argv, per-script timeout seconds). Generous: one compile can take ~40 s
-# through the tunnel and the decode/MFU scripts compile several programs.
-BATTERY: list[tuple[list[str], float]] = [
+ONESHOT = REPO / "scripts" / "tpu-oneshot.py"
+# Follow-ups spawn sandbox subprocesses (fresh tunnel clients); run only
+# after the one-client battery proved the window healthy.
+FOLLOWUPS: list[tuple[list[str], float]] = [
     ([sys.executable, str(REPO / "bench.py")], 900.0),
-    ([sys.executable, str(REPO / "scripts" / "validate-shardmap-pallas.py")], 600.0),
-    ([sys.executable, str(REPO / "scripts" / "bench-flash-attention.py")], 1200.0),
-    ([sys.executable, str(REPO / "scripts" / "bench-decode.py")], 1500.0),
     ([sys.executable, str(REPO / "scripts" / "bench-mfu.py")], 1500.0),
 ]
-
-
-def load_probe():
-    spec = importlib.util.spec_from_file_location("bench", REPO / "bench.py")
-    bench = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(bench)
-    return bench.probe_tpu
 
 
 def log(msg: str) -> None:
     print(f"[capture {time.strftime('%H:%M:%S')}] {msg}", flush=True)
 
 
-def run_battery() -> bool:
-    """Run every battery script; True iff all succeeded (exit 0)."""
-    all_ok = True
-    for argv, timeout_s in BATTERY:
+def run_oneshot(timeout_s: float) -> int:
+    """One battery attempt. The oneshot self-exits on a hung init (3) or a
+    mid-run stall (4); the outer timeout is a backstop only."""
+    log(f"launching one-client battery (backstop timeout {timeout_s:.0f}s)")
+    t0 = time.time()
+    try:
+        out = subprocess.run(
+            [sys.executable, str(ONESHOT)], capture_output=True, text=True,
+            timeout=timeout_s, cwd=REPO,
+        )
+    except subprocess.TimeoutExpired:
+        log("oneshot: backstop timeout — watchdog failed? treating as stall")
+        return 4
+    dt = time.time() - t0
+    for line in (out.stdout + out.stderr).splitlines():
+        log(f"oneshot: {line}")
+    log(f"oneshot: exit {out.returncode} after {dt:.0f}s")
+    return out.returncode
+
+
+def run_followups() -> None:
+    for argv, timeout_s in FOLLOWUPS:
         name = Path(argv[-1]).name
-        if not Path(argv[-1]).exists():
-            log(f"{name}: missing, skipped")
-            continue
-        log(f"running {name} (timeout {timeout_s:.0f}s)")
+        log(f"running follow-up {name} (timeout {timeout_s:.0f}s)")
         env = dict(os.environ)
         if name == "bench.py":
-            # The watcher IS the patience; bench itself should not re-wait.
-            env["BCI_BENCH_TPU_PATIENCE_S"] = "90"
+            # The loop is the patience; bench itself should not re-wait long.
+            env["BCI_BENCH_TPU_PATIENCE_S"] = "180"
         t0 = time.time()
         try:
             out = subprocess.run(
@@ -82,51 +85,51 @@ def run_battery() -> bool:
                 timeout=timeout_s, cwd=REPO, env=env,
             )
         except subprocess.TimeoutExpired:
-            log(f"{name}: TIMED OUT after {timeout_s:.0f}s (tunnel wedged mid-run?)")
-            all_ok = False
+            log(f"{name}: TIMED OUT after {timeout_s:.0f}s (window closed?)")
             continue
-        dt = time.time() - t0
         for line in out.stdout.splitlines():
             log(f"{name}: {line}")
-        if out.returncode == 2:
-            log(f"{name}: chip unreachable (exit 2) after {dt:.0f}s — back to probing")
-            return False
-        if out.returncode != 0:
-            log(f"{name}: FAILED exit {out.returncode} after {dt:.0f}s; "
-                f"stderr tail: {out.stderr[-500:]}")
-            all_ok = False
-        else:
-            log(f"{name}: ok in {dt:.0f}s")
-    return all_ok
+        log(f"{name}: exit {out.returncode} after {time.time() - t0:.0f}s")
 
 
 def main() -> None:
     ap = argparse.ArgumentParser()
-    ap.add_argument("--interval", type=float, default=90.0,
-                    help="seconds between probes while wedged")
+    ap.add_argument("--interval", type=float, default=600.0,
+                    help="starting seconds between battery attempts")
+    ap.add_argument("--max-interval", type=float, default=2700.0)
     ap.add_argument("--max-hours", type=float, default=12.0)
     ap.add_argument("--forever", action="store_true",
-                    help="keep re-capturing after a successful battery "
-                         "(cooldown = 10x interval)")
+                    help="keep re-capturing after a successful battery")
+    ap.add_argument("--skip-followups", action="store_true",
+                    help="one-client battery only (no sandbox-path runs)")
     args = ap.parse_args()
 
-    probe_tpu = load_probe()
     deadline = time.time() + args.max_hours * 3600
+    interval = args.interval
     captures = 0
     while time.time() < deadline:
-        probe = probe_tpu()
-        log(f"probe: {json.dumps(probe)}")
-        if probe.get("ok") and probe.get("platform") == "tpu":
-            log("tunnel HEALTHY — running battery")
-            if run_battery():
+        rc = run_oneshot(timeout_s=3600.0)
+        if rc == 0 or rc == 5:
+            # Even an all-cases-failed battery proved the tunnel serves
+            # clients right now — the follow-ups may still land.
+            if rc == 0:
                 captures += 1
                 log(f"battery complete (capture #{captures})")
-                if not args.forever:
-                    return
-                time.sleep(args.interval * 10)
-                continue
-            log("battery incomplete — resuming probe loop")
-        time.sleep(args.interval)
+            if not args.skip_followups:
+                run_followups()
+            if rc == 0 and not args.forever:
+                return
+            interval = args.interval  # healthy-ish: reset the backoff
+        elif rc == 2:
+            log("backend is not TPU here; nothing to capture")
+            sys.exit(2)
+        else:  # 3 = init hung, 4 = stalled mid-run: give the tunnel quiet
+            log(f"tunnel wedged (exit {rc}); quiet for {interval:.0f}s")
+        remaining = deadline - time.time()
+        if remaining <= 0:
+            break
+        time.sleep(min(interval, max(remaining, 1.0)))
+        interval = min(interval * 1.5, args.max_interval)
     log(f"max-hours reached; {captures} complete captures")
     sys.exit(0 if captures else 3)
 

@@ -1,0 +1,192 @@
+#!/usr/bin/env python
+"""ONE-CLIENT TPU capture battery: every hardware measurement in one process.
+
+Why this exists (round-4 discovery): the TPU tunnel serves — at best — one
+jax client per healthy window. Observed this session: a bounded probe
+succeeded in 2.8 s after hours of idleness; a second client started 9 s
+later (after the first exited CLEANLY) hung past 75 s; and every client
+since hung too. Under that behavior the previous architecture — probe in a
+subprocess, then measure in a fresh process, across five separate scripts —
+burns the whole healthy window on the throwaway probe. Worse, a probe loop
+on a 60 s cadence (each hung probe killed at 75 s) appears to HOLD the
+tunnel wedged: the round-4 first session logged 126 consecutive hung probes
+over ~6 h, and the tunnel recovered only after ~5.4 h of complete quiet.
+
+So this script is both the probe AND the battery:
+
+  - Its own ``jax.devices()`` is the probe. If init doesn't complete within
+    ``BCI_ONESHOT_INIT_TIMEOUT_S`` (default 150 s), a watchdog thread exits
+    3 — the caller (scripts/capture-on-healthy.py) sleeps a LONG interval
+    and retries. No separate probe client ever touches the tunnel.
+  - On success it runs EVERY measurement in this one process, appending each
+    to TPU_EVIDENCE.jsonl the moment it lands (utils/evidence.py), most
+    valuable first, so a tunnel that wedges mid-battery still leaves a
+    partial ledger:
+      1. dense-matmul chain (the north-star payload math, in-process)
+      2. flash-attention numerics + throughput (bench-flash-attention)
+      3. Pallas-under-shard_map Mosaic validation (validate-shardmap-pallas)
+      4. KV-decode battery: bf16/int8, paged, speculative (bench-decode)
+      5. flagship train MFU + decode (bench-mfu payload, exec'd in-process)
+  - A deadman watchdog exits 4 if any single case stalls past
+    ``BCI_ONESHOT_STALL_S`` (default 900 s) — a mid-run wedge must not hold
+    a zombie client open all night (that blocks the tunnel's own recovery).
+
+Service-path variants (bench.py's /v1/execute headline, bench-mfu's service
+row) need fresh sandbox processes = more clients; the caller runs those
+AFTER this battery exits, when the window has already proven healthy.
+
+Exit codes: 0 = battery complete; 2 = backend is not TPU; 3 = init hung
+(wedged tunnel); 4 = stalled mid-battery; 5 = every case failed.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import json
+import os
+import sys
+import threading
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+if str(REPO) not in sys.path:
+    sys.path.insert(0, str(REPO))
+
+INIT_TIMEOUT_S = float(os.environ.get("BCI_ONESHOT_INIT_TIMEOUT_S", "150"))
+STALL_TIMEOUT_S = float(os.environ.get("BCI_ONESHOT_STALL_S", "900"))
+
+_progress = {"mark": time.time(), "stage": "init"}
+
+
+def _bump(stage: str) -> None:
+    _progress["mark"] = time.time()
+    _progress["stage"] = stage
+    print(f"[oneshot {time.strftime('%H:%M:%S')}] {stage}",
+          file=sys.stderr, flush=True)
+
+
+def _watchdog() -> None:
+    while True:
+        time.sleep(5)
+        stalled = time.time() - _progress["mark"]
+        limit = INIT_TIMEOUT_S if _progress["stage"] == "init" else STALL_TIMEOUT_S
+        if stalled > limit:
+            code = 3 if _progress["stage"] == "init" else 4
+            print(
+                f"[oneshot] watchdog: stage '{_progress['stage']}' stalled "
+                f"{stalled:.0f}s (limit {limit:.0f}s) — exit {code}",
+                file=sys.stderr, flush=True,
+            )
+            os._exit(code)
+
+
+def _load_script(name: str):
+    """Import a dashed-name sibling script as a module."""
+    spec = importlib.util.spec_from_file_location(
+        name.replace("-", "_"), REPO / "scripts" / f"{name}.py"
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _dense_matmul(emit) -> None:
+    """The north-star payload math (bench.py's TPU_PAYLOAD: bf16 32768^3
+    jit matmul chain), measured in-process. bench.py's own run drives the
+    identical chain through /v1/execute; this entry exists so the number
+    cannot be lost to a window too short for a sandbox subprocess."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    n, iters = 32768, 16
+    a = jax.random.normal(jax.random.PRNGKey(0), (n, n), dtype=jnp.bfloat16)
+
+    @jax.jit
+    def chain(a):
+        a = a * jnp.bfloat16(1 / 128)
+
+        def body(i, x):
+            return a @ x
+
+        return lax.fori_loop(0, iters, body, a).sum()
+
+    float(chain(a))  # compile + warm
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.time()
+        float(chain(a))
+        best = min(best, time.time() - t0)
+    emit("dense_matmul_inprocess", {
+        "gflops": round(2 * n**3 * iters / best / 1e9, 1),
+        "payload": "bf16 32768^3 jit chain, in-process one-client battery",
+    })
+
+
+def main() -> None:
+    threading.Thread(target=_watchdog, daemon=True).start()
+
+    t0 = time.time()
+    import jax  # the probe IS the init
+
+    devices = jax.devices()
+    init_s = round(time.time() - t0, 1)
+    if devices[0].platform != "tpu":
+        print(f"backend is {devices[0].platform}, not tpu", file=sys.stderr)
+        sys.exit(2)
+    _bump(f"connected ({init_s}s, {devices[0]})")
+
+    import functools
+
+    from bee_code_interpreter_tpu.utils import evidence
+
+    evidence.record(
+        "tunnel_health",
+        {"init_seconds": init_s, "device": str(devices[0]),
+         "note": "healthy window: jax client initialized"},
+        script="scripts/tpu-oneshot.py",
+    )
+
+    def emit_for(script: str):
+        return functools.partial(evidence.emit, script=script)
+
+    flash = _load_script("bench-flash-attention")
+    shardmap = _load_script("validate-shardmap-pallas")
+    decode = _load_script("bench-decode")
+    mfu = _load_script("bench-mfu")
+
+    cases = [
+        ("dense_matmul", lambda: _dense_matmul(emit_for("scripts/tpu-oneshot.py"))),
+        ("flash", lambda: flash.run_measurements(
+            emit_for("scripts/bench-flash-attention.py"))),
+        ("shardmap_pallas", lambda: shardmap.run_measurements(
+            emit_for("scripts/validate-shardmap-pallas.py"))),
+        ("decode", lambda: decode.run_measurements(
+            emit_for("scripts/bench-decode.py"))),
+        ("mfu_inprocess", lambda: mfu.run_inprocess(
+            emit_for("scripts/bench-mfu.py"))),
+    ]
+    failures: list[str] = []
+    for name, run in cases:
+        _bump(f"case {name}")
+        try:
+            run()
+            _bump(f"case {name} done")
+        except Exception as e:  # one case must not cost the rest the window
+            failures.append(name)
+            print(f"[oneshot] case {name} FAILED: {e!r}", file=sys.stderr,
+                  flush=True)
+    _bump("battery complete")
+    print(json.dumps({
+        "oneshot": "complete",
+        "init_seconds": init_s,
+        "cases_ok": [n for n, _ in cases if n not in failures],
+        "cases_failed": failures,
+    }), flush=True)
+    if len(failures) == len(cases):
+        sys.exit(5)
+
+
+if __name__ == "__main__":
+    main()

@@ -35,17 +35,10 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 
-def main() -> None:
-    import importlib.util
-
-    spec = importlib.util.spec_from_file_location("bench", REPO / "bench.py")
-    bench = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(bench)
-    probe = bench.probe_tpu()
-    if not probe.get("ok") or probe.get("platform") != "tpu":
-        print(f"no TPU: {probe}", file=sys.stderr)
-        sys.exit(2)
-
+def run_measurements(emit) -> bool:
+    """The full validation, inside an already-initialized jax process —
+    callable from scripts/tpu-oneshot.py so one tunnel client captures the
+    whole battery. Returns True iff every case matched its reference."""
     from bee_code_interpreter_tpu.ops.flash_attention import flash_attention
     from bee_code_interpreter_tpu.parallel.ring_attention import ring_attention
 
@@ -135,14 +128,32 @@ def main() -> None:
         "ok": ok,
     }
     if ok:
-        from bee_code_interpreter_tpu.utils import evidence
-
-        evidence.emit(
-            "shardmap_pallas_mosaic", payload,
-            script="scripts/validate-shardmap-pallas.py",
-        )
+        emit("shardmap_pallas_mosaic", payload)
     else:
         print(json.dumps({"case": "shardmap_pallas_mosaic", **payload}))
+    return ok
+
+
+def main() -> None:
+    import functools
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location("bench", REPO / "bench.py")
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+    probe = bench.probe_tpu()
+    if not probe.get("ok") or probe.get("platform") != "tpu":
+        print(f"no TPU: {probe}", file=sys.stderr)
+        sys.exit(2)
+
+    from bee_code_interpreter_tpu.utils import evidence
+
+    ok = run_measurements(
+        functools.partial(
+            evidence.emit, script="scripts/validate-shardmap-pallas.py"
+        )
+    )
+    if not ok:
         sys.exit(1)
 
 

@@ -29,6 +29,18 @@ from bee_code_interpreter_tpu.utils.envscrub import (  # noqa: E402
 )
 
 scrub_tunnel_plugin_vars()
+
+# Sandbox subprocesses must import bee_code_interpreter_tpu the way the
+# executor IMAGE guarantees (its Dockerfile installs the package). On the CPU
+# test harness nothing installs it, and the ambient PYTHONPATH is the host's
+# (this round it held only the tunnel plugin's site dir — examples importing
+# the package failed with ModuleNotFoundError): mirror the image guarantee by
+# putting the repo root on the PYTHONPATH every _child_env inherits.
+_repo_root = str(Path(__file__).resolve().parent.parent)
+_pp = os.environ.get("PYTHONPATH", "")
+if _repo_root not in _pp.split(os.pathsep):
+    os.environ["PYTHONPATH"] = _pp + (os.pathsep if _pp else "") + _repo_root
+
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()

@@ -1,0 +1,87 @@
+"""The one-client capture surface (round-4 tunnel discovery).
+
+scripts/tpu-oneshot.py runs every hardware measurement inside ONE jax
+client because the tunnel serves at best one client per healthy window.
+These tests pin the import surface the oneshot battery depends on —
+``run_measurements(emit)`` on each measurement script, ``run_inprocess`` on
+the MFU script — and the oneshot's own platform gate, so a rename cannot
+silently drop a case from the battery.
+"""
+
+import importlib.util
+import inspect
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def load(name, path):
+    spec = importlib.util.spec_from_file_location(name, path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[name] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_measurement_scripts_expose_run_measurements():
+    for script in ("bench-flash-attention", "bench-decode",
+                   "validate-shardmap-pallas"):
+        mod = load(script.replace("-", "_"), REPO / "scripts" / f"{script}.py")
+        fn = getattr(mod, "run_measurements", None)
+        assert callable(fn), f"{script}.py lost run_measurements"
+        params = list(inspect.signature(fn).parameters)
+        assert params[0] == "emit", f"{script}.py run_measurements signature"
+
+
+def test_mfu_script_exposes_run_inprocess_and_parsers():
+    mfu = load("bench_mfu_surface", REPO / "scripts" / "bench-mfu.py")
+    assert callable(mfu.run_inprocess)
+    results = mfu._parse_results(
+        "noise\nRESULT_TRAIN 12.5 80.0 123456\nRESULT_DECODE 1.5 666.7\n"
+    )
+    assert results["RESULT_TRAIN"] == [12.5, 80.0, 123456.0]
+    assert results["RESULT_DECODE"] == [1.5, 666.7]
+    try:
+        mfu._parse_results("RESULT_TRAIN 1 2 3\n")  # decode marker missing
+    except RuntimeError as e:
+        assert "RESULT_DECODE" in str(e)
+    else:
+        raise AssertionError("missing marker must raise")
+
+
+def test_mfu_emit_results_separates_service_and_inprocess_cases():
+    mfu = load("bench_mfu_cases", REPO / "scripts" / "bench-mfu.py")
+    results = {"RESULT_TRAIN": [10.0, 50.0, 1000.0],
+               "RESULT_DECODE": [2.0, 500.0]}
+    seen = []
+
+    def emit(case, payload):
+        seen.append((case, payload))
+
+    mfu._emit_results(emit, results, via="service execution path")
+    mfu._emit_results(emit, results, via="in-process one-client battery")
+    cases = [c for c, _ in seen]
+    # the service-path decode row and the in-process one must never share a
+    # ledger case (latest_per_case would let one mask the other's provenance)
+    assert cases == ["mfu_train", "service_decode", "mfu_train", "mfu_decode"]
+    assert seen[0][1]["via"] == "service execution path"
+    assert seen[2][1]["via"] == "in-process one-client battery"
+    assert seen[0][1]["mfu"] > 0
+
+
+def test_oneshot_exits_2_on_non_tpu_backend(tmp_path):
+    """On a CPU backend the oneshot must exit 2 (nothing to capture) without
+    touching the real evidence ledger — the same process-level gate the
+    patient loop keys off."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["BCI_EVIDENCE_PATH"] = str(tmp_path / "ledger.jsonl")
+    out = subprocess.run(
+        [sys.executable, str(REPO / "scripts" / "tpu-oneshot.py")],
+        capture_output=True, text=True, timeout=120, env=env, cwd=REPO,
+    )
+    assert out.returncode == 2, (out.stdout, out.stderr)
+    assert not (tmp_path / "ledger.jsonl").exists()
