@@ -38,6 +38,8 @@ pool: admit, run isolated, recycle.
 from __future__ import annotations
 
 import functools
+import hashlib
+from collections import OrderedDict
 from dataclasses import dataclass
 
 import jax
@@ -147,6 +149,7 @@ class ContinuousBatcher:
         draft_params=None,
         draft_config: TransformerConfig | None = None,
         gamma: int = 4,
+        prefix_cache: bool = False,
     ) -> None:
         """``draft_params``/``draft_config`` switch the batcher into
         SPECULATIVE mode: every step, the draft proposes ``gamma`` greedy
@@ -157,7 +160,14 @@ class ContinuousBatcher:
         advantage over ``speculative_generate``'s static batch). Exactness
         per request is the same greedy draft-verify guarantee, pinned by
         tests/test_serving.py. Speculative rows must decode greedily
-        (draft-verify with sampling is rejection-sampling territory)."""
+        (draft-verify with sampling is rejection-sampling territory).
+
+        ``prefix_cache=True`` turns on vLLM-style prompt prefix caching:
+        full prompt pages are content-addressed by chain hash and shared
+        across requests (refcounted, LRU-evicted under pool pressure, kept
+        alive past retirement for repeat prompts), and a hit admits through
+        a suffix-only prefill — per-request outputs are unchanged, pinned
+        by tests/test_prefix_cache.py."""
         self.params = params
         self.config = config
         self.page_size = page_size
@@ -166,6 +176,18 @@ class ContinuousBatcher:
         self.draft_params = draft_params
         self.draft_config = draft_config
         self.gamma = gamma
+        if prefix_cache and config.n_experts:
+            # capacity-based MoE routing pools couple tokens that share a
+            # forward pass: the suffix-only prefill routes W tokens where
+            # the full prefill routes L, so shared-prefix K/V would stop
+            # being the K/V an unshared admission computes — the same
+            # routing-pool hazard beam/speculative refuse
+            # (tests/test_beam.py::test_moe_routing_pool_coupling_demonstrated)
+            raise NotImplementedError(
+                "prefix_cache requires a dense config (MoE routing pools "
+                "differ between suffix-only and full prefill)"
+            )
+        self.prefix_cache_enabled = prefix_cache
         if (draft_params is None) != (draft_config is None):
             raise ValueError(
                 "speculative mode needs BOTH draft_params and draft_config"
@@ -198,6 +220,21 @@ class ContinuousBatcher:
         self.row_rng: list[np.random.Generator | None] = [None] * max_batch
         self._next_request_id = 0
         self.free_pages = list(range(n_pages - 1, _SCRATCH_PAGE, -1))
+        # Prefix cache (vLLM-style, host-side bookkeeping only): pages
+        # holding a FULL page of prompt K/V are content-addressed by the
+        # chain hash of their tokens-so-far and shared across requests via
+        # refcounts; refcount-0 cached pages park in an LRU instead of the
+        # free list and are evicted only under pool pressure, so a repeat
+        # prompt arriving after the first finished still hits. Only pages
+        # fully inside [0, L) are ever shared — the decode cursor starts at
+        # L, so shared pages are write-free by construction.
+        self.page_ref = np.zeros(n_pages, dtype=np.int32)
+        self.prefix_index: dict[bytes, int] = {}
+        self.page_hash: dict[int, bytes] = {}
+        self.evictable: OrderedDict[int, None] = OrderedDict()
+        self.prefix_stats = {
+            "lookups": 0, "hits": 0, "pages_reused": 0, "evictions": 0,
+        }
         # donate the pool: without aliasing, every decoded token would pay
         # a full page-pool HBM copy (precedent: make_train_step's donation)
         self._decode = jax.jit(
@@ -214,6 +251,12 @@ class ContinuousBatcher:
             functools.partial(prefill_chunked, config=config),
             static_argnames=("total_len", "chunk"),
         )
+        # suffix-only admission windows (prefix-cache hits); compiles once
+        # per page-aligned window width, bounded by max_pages_per_seq
+        self._window = jax.jit(
+            functools.partial(decode_window_paged, config=config),
+            donate_argnums=(3,),
+        )
         if draft_config is not None:
             # the draft's own paged pool, addressed by the SAME block
             # tables/pages (one allocation covers both models' K/V)
@@ -229,6 +272,10 @@ class ContinuousBatcher:
             )
             self._verify = jax.jit(
                 functools.partial(decode_window_paged, config=config),
+                donate_argnums=(3,),
+            )
+            self._draft_window = jax.jit(
+                functools.partial(decode_window_paged, config=draft_config),
                 donate_argnums=(3,),
             )
 
@@ -287,83 +334,64 @@ class ContinuousBatcher:
         if free_rows.size == 0:
             raise RuntimeError("no free batch row (step() until one frees)")
         n_need = -(-total // self.page_size)  # ceil
-        if n_need > len(self.free_pages):
+        # Prefix match BEFORE allocating: matched pages come from the index
+        # (a ref, not an allocation). The match is capped at (L-1)//ps full
+        # pages so at least one suffix token remains — the admission must
+        # still produce last-prompt-token logits to sample from.
+        matched = 0
+        hashes: list[bytes] = []
+        shared: list[int] = []
+        if self.prefix_cache_enabled:
+            hashes = self._chain_hashes(prompt)
+            self.prefix_stats["lookups"] += 1
+            for i in range(min(len(hashes), (L - 1) // self.page_size)):
+                page = self.prefix_index.get(hashes[i])
+                if page is None:
+                    break
+                shared.append(page)
+            matched = len(shared)
+        # acquire refs on shared pages BEFORE measuring availability: a
+        # matched page parked in the evictable LRU must neither count
+        # toward the fresh-page budget nor be pickable by the allocator's
+        # eviction. Refs are released if the capacity check then fails.
+        for page in shared:
+            self.page_ref[page] += 1
+            self.evictable.pop(page, None)
+        available = len(self.free_pages) + len(self.evictable)
+        if n_need - matched > available:
+            for page in reversed(shared):
+                self._release_page(page)
             raise RuntimeError(
-                f"page pool exhausted ({n_need} needed, "
-                f"{len(self.free_pages)} free)"
+                f"page pool exhausted ({n_need - matched} needed, "
+                f"{available} free)"
             )
+        if matched:
+            self.prefix_stats["hits"] += 1
+            self.prefix_stats["pages_reused"] += matched
         row = int(free_rows[0])
-        pages = [self.free_pages.pop() for _ in range(n_need)]
+        pages = shared + [self._alloc_page() for _ in range(n_need - matched)]
         self.block_table[row, :] = _SCRATCH_PAGE
         self.block_table[row, :n_need] = pages
 
         try:
-            n_prompt_pages = -(-L // self.page_size)
-            pages_arr = jnp.asarray(
-                pages[:n_prompt_pages], dtype=jnp.int32
-            )
-            # the prompt padded to a whole number of pages — shared by the
-            # one-shot target prefill and the draft prefill (one copy: a
-            # divergent pad between the two would desync their caches)
-            Lp = n_prompt_pages * self.page_size
-            padded = np.zeros(Lp, dtype=np.int32)
-            padded[:L] = prompt
-            # zero the DRAFT pool's allocated pages: recycled pages hold a
-            # previous request's K/V, and only speculative drafting can
-            # read a not-yet-written slot inside its visible window (the
-            # full-accept gap below) — zeros make that read deterministic
-            # and pool-history-independent, matching the contiguous
-            # speculative_generate's zero-initialized cache. The target
-            # pool needs no zeroing: plain decode and the verify only read
-            # slots already written (prefill-seeded or appended by the
-            # very window doing the reading; the rest are masked), so
-            # zeroing it would just copy the whole pool per admission.
-            if speculative:
-                all_pages = jnp.asarray(pages, dtype=jnp.int32)
-                self.draft_cache = {
-                    name: x.at[:, all_pages].set(0)
-                    for name, x in self.draft_cache.items()
-                }
-            if prefill_chunk is not None:
-                # bounded-memory admission: the chunked prefill builds the
-                # cache in the pool's layout; copy its leaves verbatim
-                last_logits, contig = self._prefill_chunked(
-                    self.params, prompt[None, :],
-                    total_len=n_prompt_pages * self.page_size,
-                    chunk=prefill_chunk,
+            if matched:
+                # shared-prefix admission: the first ``matched`` pages
+                # already hold this prompt's K/V (both pools in
+                # speculative mode); only the suffix runs through the
+                # model. Zero the FRESH draft pages only — matched pages
+                # hold valid draft prefix K/V other rows may be sharing.
+                if speculative:
+                    fresh_arr = jnp.asarray(pages[matched:], dtype=jnp.int32)
+                    self.draft_cache = {
+                        name: x.at[:, fresh_arr].set(0)
+                        for name, x in self.draft_cache.items()
+                    }
+                last_row = self._suffix_admit(
+                    row, prompt, matched, speculative, prefill_chunk
                 )
-                self.cache = seed_from_contiguous(
-                    self.cache, pages_arr,
-                    {name: x[:, 0] for name, x in contig.items()},
-                )
-                last_row = np.asarray(last_logits[0], dtype=np.float32)
             else:
-                # one-shot prefill: exact O(L^2) forward, then the shared
-                # one-scatter-per-leaf page seeding (seed_prefill — the
-                # equality tests call the same function, so the tested
-                # path IS this path). The padded prompt bounds the compile
-                # count: pad tokens are causal-masked for every row < L,
-                # so logits[L-1] and K/V[:L] are exact, and distinct
-                # prompt lengths share a program per page count instead of
-                # one per length.
-                logits, (k_pre, v_pre) = self._prefill(
-                    self.params, padded[None, :]
-                )
-                self.cache = seed_prefill(
-                    self.cache, pages_arr,
-                    k_pre[:, 0, :, :L, :], v_pre[:, 0, :, :L, :],
-                )
-                last_row = np.asarray(logits[0, L - 1, :], dtype=np.float32)
-            if speculative:
-                # draft prefill into ITS pool at the same pages (the draft
-                # is small — the padded one-shot prefill is fine even when
-                # the target admission was chunked)
-                _, (dk, dv) = self._draft_prefill(
-                    self.draft_params, padded[None, :]
-                )
-                self.draft_cache = seed_prefill(
-                    self.draft_cache, pages_arr,
-                    dk[:, 0, :, :L, :], dv[:, 0, :, :L, :],
+                last_row = self._full_admit(
+                    prompt, pages, L, speculative, prefill_chunk
                 )
             sampling = sampling or SamplingParams()
             rng = np.random.default_rng(sampling.seed)
@@ -371,10 +399,35 @@ class ContinuousBatcher:
         except BaseException:
             # a failed admission (prefill OOM, bad sampling params, ...)
             # must not leak its pages: the row never activated, so nothing
-            # else will ever return them to the pool
+            # else will ever return them to the pool. Shared pages drop the
+            # acquired ref (back to the LRU if nobody else holds them);
+            # fresh ones go straight back to the free list.
             self.block_table[row, :] = _SCRATCH_PAGE
-            self.free_pages.extend(reversed(pages))
+            for page in reversed(pages):
+                self._release_page(page)
             raise
+        if self.prefix_cache_enabled:
+            # index every page fully inside [0, L): those pages are
+            # write-free for the rest of this request's life (the decode
+            # cursor starts at L), so their K/V is shareable from now on.
+            # Matched pages re-register as a no-op; last-writer-wins when
+            # two in-flight admissions computed the same chunk.
+            for j in range(L // self.page_size):
+                page = int(pages[j])
+                prev = self.prefix_index.get(hashes[j])
+                if prev == page:
+                    continue
+                if prev is not None:
+                    # displaced duplicate (two in-flight admissions computed
+                    # the same chunk): drop its cache identity so the
+                    # index/page_hash bijection holds; if it was parked
+                    # awaiting reuse, nothing can hit it anymore — free it
+                    self.page_hash.pop(prev, None)
+                    if prev in self.evictable:
+                        del self.evictable[prev]
+                        self.free_pages.append(prev)
+                self.prefix_index[hashes[j]] = page
+                self.page_hash[page] = hashes[j]
         req = self._next_request_id
         self._next_request_id += 1
         self.pos[row] = L
@@ -388,6 +441,171 @@ class ContinuousBatcher:
         self.active[row] = True
         self._retire_if_done(row)
         return req
+
+    # ------------------------------------------------- admission sub-paths
+    def _full_admit(self, prompt, pages, L, speculative, prefill_chunk):
+        """Whole-prompt admission (no prefix hit): one-shot or chunked
+        prefill into this row's pages; returns the last prompt token's
+        logits row."""
+        n_prompt_pages = -(-L // self.page_size)
+        pages_arr = jnp.asarray(pages[:n_prompt_pages], dtype=jnp.int32)
+        # the prompt padded to a whole number of pages — shared by the
+        # one-shot target prefill and the draft prefill (one copy: a
+        # divergent pad between the two would desync their caches)
+        Lp = n_prompt_pages * self.page_size
+        padded = np.zeros(Lp, dtype=np.int32)
+        padded[:L] = prompt
+        # zero the DRAFT pool's allocated pages: recycled pages hold a
+        # previous request's K/V, and only speculative drafting can
+        # read a not-yet-written slot inside its visible window (the
+        # full-accept gap below) — zeros make that read deterministic
+        # and pool-history-independent, matching the contiguous
+        # speculative_generate's zero-initialized cache. The target
+        # pool needs no zeroing: plain decode and the verify only read
+        # slots already written (prefill-seeded or appended by the
+        # very window doing the reading; the rest are masked), so
+        # zeroing it would just copy the whole pool per admission.
+        if speculative:
+            all_pages = jnp.asarray(pages, dtype=jnp.int32)
+            self.draft_cache = {
+                name: x.at[:, all_pages].set(0)
+                for name, x in self.draft_cache.items()
+            }
+        if prefill_chunk is not None:
+            # bounded-memory admission: the chunked prefill builds the
+            # cache in the pool's layout; copy its leaves verbatim
+            last_logits, contig = self._prefill_chunked(
+                self.params, prompt[None, :],
+                total_len=n_prompt_pages * self.page_size,
+                chunk=prefill_chunk,
+            )
+            self.cache = seed_from_contiguous(
+                self.cache, pages_arr,
+                {name: x[:, 0] for name, x in contig.items()},
+            )
+            last_row = np.asarray(last_logits[0], dtype=np.float32)
+        else:
+            # one-shot prefill: exact O(L^2) forward, then the shared
+            # one-scatter-per-leaf page seeding (seed_prefill — the
+            # equality tests call the same function, so the tested
+            # path IS this path). The padded prompt bounds the compile
+            # count: pad tokens are causal-masked for every row < L,
+            # so logits[L-1] and K/V[:L] are exact, and distinct
+            # prompt lengths share a program per page count instead of
+            # one per length.
+            logits, (k_pre, v_pre) = self._prefill(
+                self.params, padded[None, :]
+            )
+            self.cache = seed_prefill(
+                self.cache, pages_arr,
+                k_pre[:, 0, :, :L, :], v_pre[:, 0, :, :L, :],
+            )
+            last_row = np.asarray(logits[0, L - 1, :], dtype=np.float32)
+        if speculative:
+            # draft prefill into ITS pool at the same pages (the draft
+            # is small — the padded one-shot prefill is fine even when
+            # the target admission was chunked)
+            _, (dk, dv) = self._draft_prefill(
+                self.draft_params, padded[None, :]
+            )
+            self.draft_cache = seed_prefill(
+                self.draft_cache, pages_arr,
+                dk[:, 0, :, :L, :], dv[:, 0, :, :L, :],
+            )
+        return last_row
+
+    def _suffix_admit(self, row, prompt, matched, speculative, prefill_chunk):
+        """Admission with ``matched`` prefix pages already holding this
+        prompt's K/V: only the suffix runs through the model, as
+        consecutive ``decode_window_paged`` windows that append suffix K/V
+        into the row's fresh pages while attending to the shared prefix
+        through the block table — the paged analogue of chunked prefill
+        (``prefill_chunk`` bounds the window width the same way).
+
+        Windows are page-aligned (every width a multiple of page_size), so
+        the compile count stays bounded by max_pages_per_seq — the same
+        bound as the padded one-shot path. Pad tokens in the final window
+        write garbage K/V at positions >= L, which is safe for the same
+        reason the speculative window's rejected drafts are: those slots
+        sit beyond the cursor, are causally invisible until the cursor
+        reaches them, and every decode write lands before the read that
+        could see it. In speculative mode the draft pool replays the same
+        windows so both caches stay in lockstep.
+
+        Returns the last prompt token's logits row."""
+        ps = self.page_size
+        L = int(prompt.shape[0])
+        start = matched * ps
+        if prefill_chunk is not None and prefill_chunk < 1:
+            raise ValueError(f"chunk must be >= 1, got {prefill_chunk}")
+        chunk_pages = (
+            max(1, prefill_chunk // ps) if prefill_chunk is not None
+            else self.block_table.shape[1]
+        )
+        suffix = np.zeros((-(-(L - start) // ps)) * ps, dtype=np.int32)
+        suffix[: L - start] = prompt[start:]
+        bt_row = jnp.asarray(self.block_table[row:row + 1])
+        last_row = None
+        pos = start
+        for off in range(0, len(suffix), chunk_pages * ps):
+            win = suffix[off: off + chunk_pages * ps]
+            win_arr = jnp.asarray(win[None, :])
+            pos_arr = jnp.asarray([pos], dtype=jnp.int32)
+            logits, self.cache = self._window(
+                self.params, win_arr, pos_arr, self.cache, bt_row
+            )
+            if speculative:
+                _, self.draft_cache = self._draft_window(
+                    self.draft_params, win_arr, pos_arr,
+                    self.draft_cache, bt_row,
+                )
+            idx = L - 1 - pos  # last REAL token's index within this window
+            if 0 <= idx < win.shape[0]:
+                last_row = np.asarray(logits[0, idx], dtype=np.float32)
+            pos += int(win.shape[0])
+        return last_row
+
+    # -------------------------------------------------- prefix-cache pages
+    def _chain_hashes(self, prompt: np.ndarray) -> list[bytes]:
+        """Chain hash after each FULL page of the prompt: ``hashes[i]``
+        commits to tokens [0, (i+1)*page_size) — a page is reusable only
+        when its entire history matches, which is what makes shared K/V
+        position-exact (prefixes always align at position 0)."""
+        h = hashlib.blake2b(digest_size=16)
+        out: list[bytes] = []
+        ps = self.page_size
+        for i in range(len(prompt) // ps):
+            h.update(prompt[i * ps:(i + 1) * ps].astype(np.int32).tobytes())
+            out.append(h.digest())
+        return out
+
+    def _alloc_page(self) -> int:
+        """One fresh page: free list first, then LRU eviction of a
+        refcount-0 cached prefix page (its index entry dies with it).
+        Callers check capacity up front, so exhaustion here is a bug."""
+        if self.free_pages:
+            page = self.free_pages.pop()
+        else:
+            page, _ = self.evictable.popitem(last=False)  # LRU victim
+            h = self.page_hash.pop(page, None)
+            if h is not None and self.prefix_index.get(h) == page:
+                del self.prefix_index[h]
+            self.prefix_stats["evictions"] += 1
+        self.page_ref[page] = 1
+        return page
+
+    def _release_page(self, page: int) -> None:
+        """Drop one reference. At refcount 0 an indexed prefix page parks
+        in the LRU (K/V kept for future hits); anything else is freed."""
+        self.page_ref[page] -= 1
+        if self.page_ref[page] > 0:
+            return
+        h = self.page_hash.get(page)
+        if h is not None and self.prefix_index.get(h) == page:
+            self.evictable[page] = None  # MRU end
+        else:
+            self.page_hash.pop(page, None)
+            self.free_pages.append(page)
 
     # ----------------------------------------------------------------- step
     def step(self) -> None:
@@ -505,7 +723,8 @@ class ContinuousBatcher:
             self.row_sampling[row] = None
             self.row_rng[row] = None
             used = set(self.block_table[row].tolist()) - {_SCRATCH_PAGE}
-            self.free_pages.extend(sorted(used, reverse=True))
+            for page in sorted(used, reverse=True):
+                self._release_page(page)
             self.block_table[row, :] = _SCRATCH_PAGE
             # pos stays for inspection; scratch-page writes are masked
 

@@ -89,6 +89,8 @@ def run_followups() -> None:
             continue
         for line in out.stdout.splitlines():
             log(f"{name}: {line}")
+        if out.returncode != 0:
+            log(f"{name}: stderr tail: {out.stderr[-500:]}")
         log(f"{name}: exit {out.returncode} after {time.time() - t0:.0f}s")
 
 
@@ -108,13 +110,21 @@ def main() -> None:
     interval = args.interval
     captures = 0
     while time.time() < deadline:
-        rc = run_oneshot(timeout_s=3600.0)
-        if rc == 0 or rc == 5:
-            # Even an all-cases-failed battery proved the tunnel serves
-            # clients right now — the follow-ups may still land.
+        # Backstop > the oneshot's own watchdog-permitted worst case
+        # (init 150s + 5 cases x 900s stall limit = 4650s): the backstop
+        # must never SIGKILL a battery the child's watchdog considers
+        # healthy — a hard-killed client is the tunnel-wedging pattern
+        # this whole design exists to avoid.
+        rc = run_oneshot(timeout_s=5400.0)
+        if rc in (0, 5, 6):
+            # Even a partially/fully failed battery proved the tunnel
+            # serves clients right now — the follow-ups may still land,
+            # and a partial battery (6) is worth retrying for the rest.
             if rc == 0:
                 captures += 1
                 log(f"battery complete (capture #{captures})")
+            else:
+                log("battery partial/failed — will keep trying")
             if not args.skip_followups:
                 run_followups()
             if rc == 0 and not args.forever:

@@ -36,7 +36,8 @@ row) need fresh sandbox processes = more clients; the caller runs those
 AFTER this battery exits, when the window has already proven healthy.
 
 Exit codes: 0 = battery complete; 2 = backend is not TPU; 3 = init hung
-(wedged tunnel); 4 = stalled mid-battery; 5 = every case failed.
+(wedged tunnel); 4 = stalled mid-battery; 5 = every case failed; 6 = some
+cases failed (the caller should keep trying for the rest).
 """
 
 from __future__ import annotations
@@ -81,26 +82,28 @@ def _watchdog() -> None:
             os._exit(code)
 
 
-def _load_script(name: str):
-    """Import a dashed-name sibling script as a module."""
-    spec = importlib.util.spec_from_file_location(
-        name.replace("-", "_"), REPO / "scripts" / f"{name}.py"
-    )
+def _load_script(name: str, *, root: bool = False):
+    """Import a dashed-name sibling script — or, with ``root=True``, a
+    repo-root module like bench.py — as a module."""
+    path = (REPO if root else REPO / "scripts") / f"{name}.py"
+    spec = importlib.util.spec_from_file_location(name.replace("-", "_"), path)
     mod = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(mod)
     return mod
 
 
 def _dense_matmul(emit) -> None:
-    """The north-star payload math (bench.py's TPU_PAYLOAD: bf16 32768^3
-    jit matmul chain), measured in-process. bench.py's own run drives the
-    identical chain through /v1/execute; this entry exists so the number
-    cannot be lost to a window too short for a sandbox subprocess."""
+    """The north-star payload math (bench.py's TPU_PAYLOAD: bf16 matmul
+    chain), measured in-process. bench.py's own run drives the identical
+    chain through /v1/execute; this entry exists so the number cannot be
+    lost to a window too short for a sandbox subprocess. Shape constants
+    come off bench.py itself so the two can never silently diverge."""
     import jax
     import jax.numpy as jnp
     from jax import lax
 
-    n, iters = 32768, 16
+    bench = _load_script("bench", root=True)
+    n, iters = bench.N, bench.ITERS
     a = jax.random.normal(jax.random.PRNGKey(0), (n, n), dtype=jnp.bfloat16)
 
     @jax.jit
@@ -120,7 +123,7 @@ def _dense_matmul(emit) -> None:
         best = min(best, time.time() - t0)
     emit("dense_matmul_inprocess", {
         "gflops": round(2 * n**3 * iters / best / 1e9, 1),
-        "payload": "bf16 32768^3 jit chain, in-process one-client battery",
+        "payload": f"bf16 {n}^3 jit chain, in-process one-client battery",
     })
 
 
@@ -156,12 +159,20 @@ def main() -> None:
     decode = _load_script("bench-decode")
     mfu = _load_script("bench-mfu")
 
+    def run_shardmap():
+        # run_measurements returns False on a numerics mismatch (it prints
+        # its JSON instead of raising) — surface that as a case failure, not
+        # a silent pass
+        if shardmap.run_measurements(
+            emit_for("scripts/validate-shardmap-pallas.py")
+        ) is False:
+            raise RuntimeError("shard_map validation numerics mismatch")
+
     cases = [
         ("dense_matmul", lambda: _dense_matmul(emit_for("scripts/tpu-oneshot.py"))),
         ("flash", lambda: flash.run_measurements(
             emit_for("scripts/bench-flash-attention.py"))),
-        ("shardmap_pallas", lambda: shardmap.run_measurements(
-            emit_for("scripts/validate-shardmap-pallas.py"))),
+        ("shardmap_pallas", run_shardmap),
         ("decode", lambda: decode.run_measurements(
             emit_for("scripts/bench-decode.py"))),
         ("mfu_inprocess", lambda: mfu.run_inprocess(
@@ -186,6 +197,8 @@ def main() -> None:
     }), flush=True)
     if len(failures) == len(cases):
         sys.exit(5)
+    if failures:
+        sys.exit(6)  # partial: the caller should keep trying for the rest
 
 
 if __name__ == "__main__":

@@ -1,0 +1,254 @@
+"""Prefix caching in the continuous batcher (models/serving.py).
+
+The correctness bar is the same as for continuous batching itself: enabling
+the prefix cache must not change ANY request's output, token for token —
+hits only change which physical pages hold the prompt K/V and how much of
+the prompt runs through the model at admission. On top of the equality
+pins, these tests exercise the cache-management machinery itself:
+refcounts, persistence past retirement, LRU eviction under pool pressure,
+and the rollback path.
+
+The reference has no serving stack at all (SURVEY §2); vLLM-style prefix
+caching is part of this rebuild's decode family.
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+import jax
+
+from bee_code_interpreter_tpu.models.serving import (
+    ContinuousBatcher,
+    SamplingParams,
+)
+from bee_code_interpreter_tpu.models.transformer import (
+    TransformerConfig,
+    init_params,
+)
+
+CFG = dataclasses.replace(TransformerConfig.tiny(), n_kv_heads=2)
+PARAMS = init_params(CFG, jax.random.PRNGKey(0))
+PS = 4  # page size used throughout — small so prompts span several pages
+
+
+def make_batcher(prefix_cache=True, **kw):
+    kw.setdefault("max_batch", 3)
+    kw.setdefault("n_pages", 40)
+    kw.setdefault("page_size", PS)
+    kw.setdefault("max_pages_per_seq", 8)
+    return ContinuousBatcher(
+        PARAMS, CFG, prefix_cache=prefix_cache, **kw
+    )
+
+
+def run_one(b, prompt, n=5, **kw):
+    r = b.submit(prompt, n, **kw)
+    b.run_to_completion()
+    return b.result(r)
+
+
+PROMPT = [5, 3, 7, 2, 9, 4, 1, 8, 6, 2]  # 10 tokens = 2 full pages + 2
+
+
+def test_repeat_prompt_hits_and_output_is_unchanged():
+    plain = make_batcher(prefix_cache=False)
+    want = run_one(plain, PROMPT)
+
+    b = make_batcher()
+    assert run_one(b, PROMPT) == want  # miss: full admission
+    assert b.prefix_stats["hits"] == 0
+    assert run_one(b, PROMPT) == want  # hit: suffix-only admission
+    assert b.prefix_stats["hits"] == 1
+    assert b.prefix_stats["pages_reused"] == 2  # both full pages
+
+
+def test_hit_persists_past_retirement_and_release():
+    b = make_batcher()
+    r = b.submit(PROMPT, 4)
+    b.run_to_completion()
+    b.result(r)
+    b.release(r)
+    assert len(b.evictable) > 0  # cached pages parked, not freed
+    want = run_one(make_batcher(prefix_cache=False), PROMPT, 4)
+    assert run_one(b, PROMPT, 4) == want
+    assert b.prefix_stats["hits"] == 1
+
+
+def test_diverging_prompt_shares_only_the_common_prefix():
+    other = PROMPT[:8] + [9, 9, 3, 1]  # same 2 full pages, different tail
+    plain = make_batcher(prefix_cache=False)
+    want_a, want_b = run_one(plain, PROMPT), run_one(plain, other)
+
+    b = make_batcher()
+    assert run_one(b, PROMPT) == want_a
+    assert run_one(b, other) == want_b
+    assert b.prefix_stats["pages_reused"] == 2
+
+
+def test_shared_pages_survive_sibling_retirement():
+    """Two active rows share prefix pages; the first retiring must not
+    free pages the second still reads (refcount, not ownership)."""
+    plain = make_batcher(prefix_cache=False)
+    w_short = run_one(plain, PROMPT, 2)
+    plain2 = make_batcher(prefix_cache=False)
+    w_long = run_one(plain2, PROMPT, 12)
+
+    b = make_batcher()
+    run_one(b, PROMPT, 2)  # populate the index
+    r_long = b.submit(PROMPT, 12)   # hit: shares the 2 prefix pages
+    r_short = b.submit(PROMPT, 2)   # hit: shares them too
+    b.run_to_completion()           # short retires many steps early
+    assert b.result(r_short) == w_short
+    assert b.result(r_long) == w_long
+    # while nothing is active the prefix pages sit in the LRU, not free
+    assert (b.page_ref > 0).sum() == 0
+    assert len(b.evictable) > 0
+
+
+def test_eviction_under_pool_pressure():
+    # pool sized so cached pages MUST be evicted to admit new prompts
+    b = make_batcher(n_pages=12, max_pages_per_seq=4)
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(0, CFG.vocab_size, 9).tolist() for _ in range(6)]
+    for p in prompts:
+        run_one(b, p, 3)
+    assert b.prefix_stats["evictions"] > 0
+    # evicted entries are really gone from the index
+    assert len(b.prefix_index) == len(b.page_hash)
+    live = set(b.prefix_index.values())
+    assert live.isdisjoint(set(b.free_pages))
+    # and the machinery still admits + decodes correctly after evictions
+    want = run_one(make_batcher(prefix_cache=False), PROMPT, 3)
+    assert run_one(b, PROMPT, 3) == want
+
+
+def test_page_accounting_conserves_the_pool():
+    b = make_batcher()
+    n_total = 40 - 1  # minus the scratch page
+    for prompt in (PROMPT, PROMPT, PROMPT[:8] + [1, 2, 3]):
+        run_one(b, prompt, 3)
+        held = (b.page_ref > 0).sum()
+        assert len(b.free_pages) + len(b.evictable) + held == n_total
+
+
+def test_sampled_requests_hit_deterministically():
+    """Sampled requests on the hit path: same seed -> same output, every
+    time. (Unlike greedy, sampled output is NOT pinned against the
+    unshared path: the suffix-only admission is a different XLA program
+    than the full prefill, and a temperature draw can tip on an
+    ULP-different logit. The distribution is unchanged — greedy equality
+    everywhere else in this file is the correctness pin.)"""
+    sp = SamplingParams(temperature=0.8, top_k=5, seed=13)
+    b = make_batcher()
+    run_one(b, PROMPT, 2)
+    first = run_one(b, PROMPT, 6, sampling=sp)
+    assert b.prefix_stats["hits"] == 1
+    again = run_one(b, PROMPT, 6, sampling=sp)
+    assert again == first
+    assert b.prefix_stats["hits"] == 2
+
+
+def test_chunked_suffix_admission_matches():
+    """A prefix hit combined with chunked admission: the suffix windows are
+    chunk-bounded and the output still matches the unshared path."""
+    long_prompt = (PROMPT * 2)[:17]  # 4 full pages + 1
+    plain = make_batcher(prefix_cache=False)
+    want = run_one(plain, long_prompt, 4)
+    b = make_batcher()
+    run_one(b, long_prompt, 4)
+    assert run_one(b, long_prompt, 4, prefill_chunk=PS) == want
+    assert b.prefix_stats["hits"] == 1
+    assert b.prefix_stats["pages_reused"] == 4
+
+
+def test_page_aligned_prompt_keeps_one_suffix_token():
+    """An exactly page-aligned repeat prompt must still produce last-token
+    logits: the match is capped so the final page re-runs as suffix. The
+    recomputed final page then DISPLACES the original index entry
+    (last-writer-wins) — the displaced page must lose its cache identity
+    and return to the free list, keeping index<->page_hash a bijection."""
+    aligned = PROMPT[:8]  # exactly 2 pages
+    plain = make_batcher(prefix_cache=False)
+    want = run_one(plain, aligned, 4)
+    b = make_batcher()
+    run_one(b, aligned, 4)
+    assert run_one(b, aligned, 4) == want
+    assert b.prefix_stats["pages_reused"] == 1  # capped at (L-1)//ps
+    assert len(b.prefix_index) == len(b.page_hash)
+    assert set(b.page_hash) == set(b.prefix_index.values())
+    live = set(b.prefix_index.values())
+    assert live.isdisjoint(set(b.free_pages))
+
+
+def test_exhaustion_with_parked_prefix_pages_raises_cleanly():
+    """Matched pages parked in the LRU must not count toward the
+    fresh-page budget: an admission that matches them but cannot get
+    enough fresh pages raises the pool-exhausted error, releases its
+    acquired refs, and leaves the pool able to serve the next request."""
+    # usable pages: 4 (5 minus scratch). First run uses all 4 then parks
+    # the 2 prefix pages in the LRU and frees the rest.
+    b = make_batcher(n_pages=5, max_pages_per_seq=8)
+    run_one(b, PROMPT, 3)  # total 13 -> 4 pages
+    assert len(b.free_pages) + len(b.evictable) == 4
+    # repeat prompt, bigger budget: matched=2, needs 4 fresh, only 2 exist
+    with pytest.raises(RuntimeError, match="page pool exhausted"):
+        b.submit(PROMPT, 12)
+    assert (b.page_ref > 0).sum() == 0  # acquired refs were released
+    assert len(b.free_pages) + len(b.evictable) == 4  # nothing leaked
+    # and the pool still serves a request that fits
+    want = run_one(make_batcher(prefix_cache=False), PROMPT, 3)
+    assert run_one(b, PROMPT, 3) == want
+
+
+def test_int8_pool_sharing_matches():
+    cfg = dataclasses.replace(CFG, kv_cache_dtype="int8")
+    params = init_params(cfg, jax.random.PRNGKey(1))
+    plain = ContinuousBatcher(
+        params, cfg, max_batch=2, n_pages=40, page_size=PS,
+        max_pages_per_seq=8, prefix_cache=False,
+    )
+    want = run_one(plain, PROMPT, 5)
+    b = ContinuousBatcher(
+        params, cfg, max_batch=2, n_pages=40, page_size=PS,
+        max_pages_per_seq=8, prefix_cache=True,
+    )
+    assert run_one(b, PROMPT, 5) == want
+    assert run_one(b, PROMPT, 5) == want
+    assert b.prefix_stats["hits"] == 1
+
+
+def test_speculative_serving_with_prefix_cache_matches():
+    draft_cfg = dataclasses.replace(CFG, n_layers=1)
+    draft = init_params(draft_cfg, jax.random.PRNGKey(2))
+
+    def batcher(prefix_cache):
+        return ContinuousBatcher(
+            PARAMS, CFG, max_batch=2, n_pages=40, page_size=PS,
+            max_pages_per_seq=8, draft_params=draft,
+            draft_config=draft_cfg, gamma=3, prefix_cache=prefix_cache,
+        )
+
+    want = run_one(batcher(False), PROMPT, 6)
+    b = batcher(True)
+    assert run_one(b, PROMPT, 6) == want
+    assert run_one(b, PROMPT, 6) == want  # hit path, drafts replay suffix
+    assert b.prefix_stats["hits"] == 1
+
+
+def test_moe_config_refuses_prefix_cache():
+    cfg = TransformerConfig.tiny_moe()
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    with pytest.raises(NotImplementedError, match="routing pools"):
+        ContinuousBatcher(params, cfg, prefix_cache=True)
+
+
+def test_short_prompt_never_shares():
+    b = make_batcher()
+    short = PROMPT[:3]  # under one page: nothing indexable
+    want = run_one(make_batcher(prefix_cache=False), short, 3)
+    assert run_one(b, short, 3) == want
+    assert run_one(b, short, 3) == want
+    assert b.prefix_stats["hits"] == 0
+    assert not b.prefix_index
