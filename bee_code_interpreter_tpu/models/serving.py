@@ -270,10 +270,10 @@ class ContinuousBatcher:
             self._draft_prefill = jax.jit(
                 functools.partial(forward, config=draft_config, return_kv=True)
             )
-            self._verify = jax.jit(
-                functools.partial(decode_window_paged, config=config),
-                donate_argnums=(3,),
-            )
+            # the verify pass IS a window over the target pool — one jit
+            # wrapper (self._window) so a suffix-admission width that
+            # happens to equal gamma+1 reuses the compiled program
+            self._verify = self._window
             self._draft_window = jax.jit(
                 functools.partial(decode_window_paged, config=draft_config),
                 donate_argnums=(3,),

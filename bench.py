@@ -552,16 +552,33 @@ def _attempt_tpu_payload(state: dict, timeout_s: float) -> float | None:
 
 
 def patient_tpu_capture(state: dict, patience_s: float) -> float | None:
-    """Probe → measure loop: re-probe the tunnel on a cadence up to
-    ``patience_s``, running the payload the moment a probe succeeds; a
-    failed payload attempt (tunnels can wedge mid-run) resumes probing.
-    Every probe/attempt is appended to ``state`` and ends up in the JSON.
-    A CPU-only backend gets one bounded payload attempt (the sandbox env can
-    differ from the probe's — the payload itself reports its platform) and
-    returns without burning the patience; so does an exhausted wait."""
+    """PAYLOAD-FIRST measure loop (round-4 tunnel discovery: the tunnel may
+    serve only ONE jax client per healthy window, and a killed client holds
+    it wedged — so the first client must BE the measurement; a throwaway
+    jax.devices() probe can burn the whole window). One bounded payload
+    attempt runs immediately: on a healthy chip the headline lands with no
+    probe at all. A payload that completes ON CPU means the sandbox env has
+    no TPU — waiting cannot help, so one diagnostic probe is recorded and
+    the capture returns. Only after a failed (hung/errored) attempt does
+    the probe loop take over: re-probing on a gentle cadence up to
+    ``patience_s``, re-attempting whenever a probe succeeds. Every
+    probe/attempt is appended to ``state`` and ends up in the JSON."""
     t_start = time.time()
     deadline = t_start + patience_s
-    while True:
+    # the first attempt respects a short patience budget (capture-on-healthy
+    # runs bench with BCI_BENCH_TPU_PATIENCE_S=180) but never goes below the
+    # time a healthy chip actually needs (init+compile can take ~90 s)
+    gflops = _attempt_tpu_payload(state, min(210.0, max(patience_s, 90.0)))
+    if gflops is not None:
+        return gflops
+    if state["attempts"] and (
+        state["attempts"][-1].get("payload_platform") == "cpu"
+    ):
+        probe = probe_tpu()
+        probe["at_s"] = round(time.time() - t_start, 1)
+        state["probes"].append(probe)
+        return None
+    while time.time() < deadline:
         probe = probe_tpu()
         probe["at_s"] = round(time.time() - t_start, 1)
         state["probes"].append(probe)
@@ -578,8 +595,6 @@ def patient_tpu_capture(state: dict, patience_s: float) -> float | None:
                     return gflops
         now = time.time()
         if now >= deadline:
-            if not state["attempts"]:  # never even tried: one last bounded go
-                return _attempt_tpu_payload(state, 90.0)
             return None
         wait = min(TPU_PROBE_INTERVAL_S, deadline - now)
         print(
@@ -588,6 +603,7 @@ def patient_tpu_capture(state: dict, patience_s: float) -> float | None:
             file=sys.stderr,
         )
         time.sleep(wait)
+    return None
 
 
 def main() -> None:

@@ -112,9 +112,25 @@ def test_patient_capture_divergent_env_payload_wins(monkeypatch):
     assert state["attempts"][0]["payload_platform"] == "tpu"
 
 
+def test_patient_capture_payload_first_wins_without_probing(monkeypatch):
+    # Round-4 tunnel discovery: the first client must BE the measurement.
+    # On a healthy chip the payload-first attempt lands the headline and NO
+    # probe client ever touches the tunnel.
+    def fail_probe(timeout_s=75.0):
+        raise AssertionError("no probe may run when the payload lands")
+
+    monkeypatch.setattr(bench, "probe_tpu", fail_probe)
+    monkeypatch.setattr(bench, "run_payload_values", _fake_values([185000.0, 1]))
+    state = {"probes": [], "attempts": []}
+    assert bench.patient_tpu_capture(state, patience_s=600.0) == 185000.0
+    assert state["probes"] == []
+    assert state["attempts"][0]["ok"] is True
+
+
 def test_patient_capture_measures_on_recovery(monkeypatch):
-    # Wedged, wedged, healthy → the payload runs on the healthy probe and
-    # every probe lands in state. Sleeps are stubbed so the test is instant.
+    # Payload-first attempt fails on the wedged tunnel; then wedged,
+    # wedged, healthy probes → the payload re-runs on the healthy probe.
+    # Sleeps are stubbed so the test is instant.
     seq = [
         {"ok": False, "seconds": 75.0, "error": "hung"},
         {"ok": False, "seconds": 75.0, "error": "hung"},
@@ -122,20 +138,28 @@ def test_patient_capture_measures_on_recovery(monkeypatch):
     ]
     monkeypatch.setattr(bench, "probe_tpu", lambda timeout_s=75.0: seq.pop(0))
     monkeypatch.setattr(bench.time, "sleep", lambda s: None)
-    monkeypatch.setattr(bench, "run_payload_values", _fake_values([185000.0, 1]))
+    results = [bench.PayloadError("payload failed (exit -1)"), [185000.0, 1]]
+
+    async def fake(source, env, timeout_s, marker="RESULT_GFLOPS"):
+        r = results.pop(0)
+        if isinstance(r, Exception):
+            raise r
+        return list(r)
+
+    monkeypatch.setattr(bench, "run_payload_values", fake)
     state = {"probes": [], "attempts": []}
     got = bench.patient_tpu_capture(state, patience_s=600.0)
     assert got == 185000.0
     assert len(state["probes"]) == 3
-    assert state["attempts"][0]["ok"] is True
-    assert state["attempts"][0]["payload_platform"] == "tpu"
+    assert state["attempts"][0]["ok"] is False  # the payload-first attempt
+    assert state["attempts"][1]["ok"] is True
+    assert state["attempts"][1]["payload_platform"] == "tpu"
 
 
 def test_patient_capture_respects_deadline(monkeypatch):
-    # Permanently wedged tunnel: the loop must stop at the patience ceiling,
-    # not spin forever — then fire one last bounded attempt (the payload
-    # could still land; its platform report gates acceptance). Clock is
-    # virtual (sleep advances it).
+    # Permanently wedged tunnel: the payload-first attempt fails, the probe
+    # loop must stop at the patience ceiling, not spin forever. Clock is
+    # virtual (sleep/probe/payload advance it).
     now = [0.0]
     monkeypatch.setattr(bench.time, "time", lambda: now[0])
 
@@ -149,13 +173,18 @@ def test_patient_capture_respects_deadline(monkeypatch):
         return {"ok": False, "seconds": 75.0, "error": "hung"}
 
     monkeypatch.setattr(bench, "probe_tpu", fake_probe)
-    monkeypatch.setattr(bench, "run_payload_values", _fake_values([98.0, 0]))
+
+    async def always_wedged(source, env, timeout_s, marker="RESULT_GFLOPS"):
+        now[0] += timeout_s
+        raise bench.PayloadError("payload failed (exit -1)")
+
+    monkeypatch.setattr(bench, "run_payload_values", always_wedged)
     state = {"probes": [], "attempts": []}
     assert bench.patient_tpu_capture(state, patience_s=400.0) is None
-    # 75s probe + 45s sleep per lap → ceiling hit after ~4 probes
-    assert 3 <= len(state["probes"]) <= 5
-    assert len(state["attempts"]) == 1  # the last-chance attempt ran
-    assert state["attempts"][0]["payload_platform"] == "cpu"
+    # 75s probe + interval sleep per lap → ceiling hit, loop stops
+    assert 1 <= len(state["probes"]) <= 5
+    assert len(state["attempts"]) == 1  # the payload-first attempt
+    assert state["attempts"][0]["ok"] is False
 
 
 def test_probe_runs_against_this_interpreter():
