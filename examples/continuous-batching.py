@@ -87,3 +87,19 @@ for i, r in enumerate(reqs):
     assert spec.result(r) == solo[i], (i, spec.result(r), solo[i])
 print(f"speculative serving OK: {len(reqs)} requests, {rounds} rounds for "
       f"{new_tokens} tokens each (gamma=3), outputs == solo decode")
+
+# --- prefix caching: a repeat prompt hits the page index and admits via a
+# suffix-only prefill — shared pages are reused (refcounted, kept past
+# retirement), and the greedy output is exactly the solo decode still.
+pc = ContinuousBatcher(
+    params, config, max_batch=2, n_pages=32, page_size=8,
+    max_pages_per_seq=4, prefix_cache=True,
+)
+r1 = pc.submit(prompts[1], new_tokens)
+pc.run_to_completion()
+r2 = pc.submit(prompts[1], new_tokens)
+pc.run_to_completion()
+assert pc.result(r1) == pc.result(r2) == solo[1]
+s = pc.prefix_stats
+print(f"prefix caching OK: repeat prompt hits={s['hits']} pages_reused="
+      f"{s['pages_reused']}, outputs == solo decode")

@@ -45,6 +45,7 @@ async def test_continuous_batching_example(http_app):
     assert body["exit_code"] == 0, body["stderr"]
     assert "continuous batching OK" in body["stdout"]
     assert "speculative serving OK" in body["stdout"]
+    assert "prefix caching OK" in body["stdout"]
     assert "outputs == solo decode" in body["stdout"]
 
 
