@@ -72,12 +72,22 @@ class SamplingParams:
     categorical over temperature-scaled logits with top-k, then
     smallest-set-above-top-p filtering, always keeping at least the top
     token), drawn from a per-request seeded generator so a request's
-    output never depends on its batch-mates."""
+    output never depends on its batch-mates.
+
+    ``stop_sequences`` are token-id sequences: generation retires the
+    moment the output ends with any of them, and the matched sequence is
+    TRIMMED from the result (the common serving-API contract; ``eos_id``
+    stays in the output by comparison). ``logprobs=True`` records the
+    model's log-probability of each emitted token — under the UNFILTERED
+    distribution (log-softmax of the raw logits row), so a sampled
+    token's report doesn't change with top-k/top-p settings."""
 
     temperature: float = 0.0
     top_k: int | None = None
     top_p: float | None = None
     seed: int = 0
+    stop_sequences: tuple[tuple[int, ...], ...] = ()
+    logprobs: bool = False
 
     def __post_init__(self) -> None:
         # same fail-fast rule as sample_logits: validated regardless of
@@ -88,6 +98,23 @@ class SamplingParams:
             raise ValueError(
                 f"temperature must be >= 0, got {self.temperature}"
             )
+        # normalize so callers can pass lists; frozen dataclass needs
+        # object.__setattr__ for the canonicalized copy
+        object.__setattr__(
+            self, "stop_sequences",
+            tuple(tuple(int(t) for t in s) for s in self.stop_sequences),
+        )
+        if any(len(s) == 0 for s in self.stop_sequences):
+            raise ValueError("stop sequences must be non-empty")
+
+
+def logprob_of(logits: np.ndarray, token: int) -> float:
+    """log P(token) under the raw (unfiltered) logits row — stable
+    log-softmax in f64, the one copy both the plain and speculative steps
+    use so reported logprobs cannot drift between paths."""
+    lg = logits.astype(np.float64)
+    m = lg.max()
+    return float(lg[token] - m - np.log(np.exp(lg - m).sum()))
 
 
 def filtered_probs_host(
@@ -215,7 +242,9 @@ class ContinuousBatcher:
         # the id submit() returned, not by the row that happened to host it
         self.row_request = np.full(max_batch, -1, dtype=np.int64)
         self.results: dict[int, list[int]] = {}
+        self.results_logprobs: dict[int, list[float]] = {}
         self.done: dict[int, bool] = {}
+        self.finish: dict[int, str] = {}  # request -> eos | stop | length
         self.row_sampling: list[SamplingParams | None] = [None] * max_batch
         self.row_rng: list[np.random.Generator | None] = [None] * max_batch
         self._next_request_id = 0
@@ -437,6 +466,8 @@ class ContinuousBatcher:
         self.row_sampling[row] = sampling
         self.row_rng[row] = rng
         self.results[req] = [first]
+        if sampling.logprobs:
+            self.results_logprobs[req] = [logprob_of(last_row, first)]
         self.done[req] = False
         self.active[row] = True
         self._retire_if_done(row)
@@ -628,26 +659,31 @@ class ContinuousBatcher:
         any_sampled = any(
             self.row_sampling[row].temperature > 0.0 for row in active_rows
         )
-        # the common all-greedy case reduces on device and moves B int32s;
-        # the full [max_batch, V] logits cross to host only when some
-        # active row actually samples
+        # the common all-greedy-no-logprobs case reduces on device and
+        # moves B int32s; the full [max_batch, V] logits cross to host only
+        # when some active row actually samples or records logprobs
+        need_rows = any_sampled or any(
+            self.row_sampling[row].logprobs for row in active_rows
+        )
         greedy = np.asarray(
             jnp.argmax(logits[:, -1, :], axis=-1), dtype=np.int32
         )
         lg = (
             np.asarray(logits[:, -1, :], dtype=np.float32)
-            if any_sampled else None
+            if need_rows else None
         )
         for row in active_rows:
-            if self.row_sampling[row].temperature > 0.0:
-                nxt = sample_host(
-                    lg[row], self.row_sampling[row], self.row_rng[row]
-                )
+            sp = self.row_sampling[row]
+            if sp.temperature > 0.0:
+                nxt = sample_host(lg[row], sp, self.row_rng[row])
             else:
                 nxt = int(greedy[row])
             self.pos[row] += 1
             self.current[row, 0] = nxt
-            self.results[int(self.row_request[row])].append(nxt)
+            req = int(self.row_request[row])
+            self.results[req].append(nxt)
+            if sp.logprobs:
+                self.results_logprobs[req].append(logprob_of(lg[row], nxt))
             self._retire_if_done(int(row))
 
     def _step_speculative(self) -> None:
@@ -692,31 +728,66 @@ class ContinuousBatcher:
             jnp.argmax(t_logits, axis=-1), dtype=np.int32
         )  # [B, gamma+1]
         drafts_np = np.asarray(drafts_dev, dtype=np.int32)
+        active_rows = np.flatnonzero(self.active)
+        # full verify logits cross to host only when some row records
+        # logprobs (commit[j]'s distribution is t_logits[row, j] — the
+        # target's prediction for the token following window position j)
+        t_np = (
+            np.asarray(t_logits, dtype=np.float32)
+            if any(self.row_sampling[row].logprobs for row in active_rows)
+            else None
+        )
 
-        for row in np.flatnonzero(self.active):
+        for row in active_rows:
             match = drafts_np[row] == t_pred[row, : self.gamma]
             n = int(np.argmin(match)) if not match.all() else self.gamma
             commit = [*drafts_np[row, :n].tolist(), int(t_pred[row, n])]
             req = int(self.row_request[row])
             out = self.results[req]
-            for tok_committed in commit:
+            lp = (
+                self.results_logprobs.get(req)
+                if self.row_sampling[row].logprobs else None
+            )
+            for j, tok_committed in enumerate(commit):
                 out.append(int(tok_committed))
-                if len(out) >= self.budget[row] or (
-                    self.eos_id is not None
-                    and tok_committed == self.eos_id
-                ):
+                if lp is not None:
+                    lp.append(logprob_of(t_np[row, j], int(tok_committed)))
+                if self._done_reason(row, out) is not None:
                     break  # later commits would exceed the stop — drop them
             self.pos[row] += n + 1
             self.current[row, 0] = int(t_pred[row, n])
             self._retire_if_done(row)
 
+    def _done_reason(self, row: int, out: list[int]) -> tuple[str, int] | None:
+        """(finish_reason, tokens_to_trim) once a row's output is complete,
+        else None — the ONE copy of the stop logic, shared by the plain
+        retire path and the speculative commit loop so the two cannot
+        drift. Precedence: eos (the model's own stop, kept in the output),
+        then a stop sequence (trimmed from the output), then the length
+        budget."""
+        if self.eos_id is not None and out and out[-1] == self.eos_id:
+            return "eos", 0
+        sp = self.row_sampling[row]
+        if sp is not None:
+            for s in sp.stop_sequences:
+                if len(out) >= len(s) and tuple(out[-len(s):]) == s:
+                    return "stop", len(s)
+        if len(out) >= self.budget[row]:
+            return "length", 0
+        return None
+
     def _retire_if_done(self, row: int) -> None:
         req = int(self.row_request[row])
         out = self.results[req]
-        done = len(out) >= self.budget[row] or (
-            self.eos_id is not None and out[-1] == self.eos_id
-        )
-        if done:
+        verdict = self._done_reason(row, out)
+        if verdict is not None:
+            reason, trim = verdict
+            if trim:
+                del out[len(out) - trim:]
+                lp = self.results_logprobs.get(req)
+                if lp is not None:
+                    del lp[len(lp) - trim:]
+            self.finish[req] = reason
             self.active[row] = False
             self.done[req] = True
             self.row_request[row] = -1
@@ -744,15 +815,44 @@ class ContinuousBatcher:
             raise RuntimeError(f"request {request_id} still decoding")
         return list(self.results[request_id])
 
+    def result_logprobs(self, request_id: int) -> list[float]:
+        """Per-token log-probabilities for a finished request that was
+        submitted with ``SamplingParams(logprobs=True)`` — same length and
+        order as ``result`` (trimmed stop sequences drop their logprobs
+        too). Unfiltered-distribution semantics: see SamplingParams."""
+        if request_id not in self.done:
+            raise KeyError(f"unknown request {request_id}")
+        if request_id not in self.results_logprobs:
+            if self.done[request_id] and request_id not in self.results:
+                raise KeyError(f"request {request_id} was released")
+            raise KeyError(
+                f"request {request_id} did not record logprobs "
+                "(submit with SamplingParams(logprobs=True))"
+            )
+        if not self.done[request_id]:
+            raise RuntimeError(f"request {request_id} still decoding")
+        return list(self.results_logprobs[request_id])
+
+    def finish_reason(self, request_id: int) -> str:
+        """'eos' | 'stop' | 'length' for a finished request; survives
+        ``release`` (a string per request, like the done-flag)."""
+        if request_id not in self.finish:
+            if self.done.get(request_id) is False:
+                raise RuntimeError(f"request {request_id} still decoding")
+            raise KeyError(f"unknown request {request_id}")
+        return self.finish[request_id]
+
     def release(self, request_id: int) -> None:
         """Drop a finished request's stored result (pages were already
         recycled at retirement; this frees the host-side token list). The
-        done-flag is kept — a bool per request — so ``is_done`` stays True
-        and a poller can't spin forever on a released id; ``result`` then
-        reports 'released', not 'unknown'."""
+        done-flag and finish reason are kept — small per-request scalars —
+        so ``is_done``/``finish_reason`` stay observable and a poller
+        can't spin forever on a released id; ``result`` then reports
+        'released', not 'unknown'."""
         if request_id in self.done and not self.done[request_id]:
             raise RuntimeError(f"request {request_id} still decoding")
         self.results.pop(request_id, None)
+        self.results_logprobs.pop(request_id, None)
 
     def run_to_completion(self, max_steps: int = 10_000) -> None:
         for _ in range(max_steps):

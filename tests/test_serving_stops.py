@@ -1,0 +1,185 @@
+"""Stop sequences, finish reasons, and per-token logprobs in the
+continuous batcher — the request-level serving contract on top of the
+decode machinery (models/serving.py).
+
+Semantics pinned here: a matched stop sequence retires the request and is
+TRIMMED from the result (eos, the model's own stop, stays in); finish
+reasons are 'eos' | 'stop' | 'length'; logprobs report the UNFILTERED
+model distribution (log-softmax of the raw logits row), so the same token
+reports the same value whatever top-k/top-p produced it, and they are
+identical between the plain and speculative paths (same tokens, same
+target distributions).
+"""
+
+import dataclasses
+import math
+
+import numpy as np
+import pytest
+
+import jax
+
+from bee_code_interpreter_tpu.models.serving import (
+    ContinuousBatcher,
+    SamplingParams,
+    logprob_of,
+)
+from bee_code_interpreter_tpu.models.transformer import (
+    TransformerConfig,
+    init_params,
+)
+
+CFG = dataclasses.replace(TransformerConfig.tiny(), n_kv_heads=2)
+PARAMS = init_params(CFG, jax.random.PRNGKey(0))
+PROMPT = [5, 3, 7, 2, 9, 4, 1, 8]
+
+
+def make_batcher(**kw):
+    kw.setdefault("max_batch", 2)
+    kw.setdefault("n_pages", 32)
+    kw.setdefault("page_size", 4)
+    kw.setdefault("max_pages_per_seq", 8)
+    return ContinuousBatcher(PARAMS, CFG, **kw)
+
+
+def run_one(b, prompt, n, **kw):
+    r = b.submit(prompt, n, **kw)
+    b.run_to_completion()
+    return r
+
+
+def greedy_tokens(n):
+    b = make_batcher()
+    return b.result(run_one(b, PROMPT, n))
+
+
+def test_stop_sequence_trims_and_reports_stop():
+    want = greedy_tokens(10)
+    # stop on the 4th+5th greedy tokens: result must be the first three
+    stop = (want[3], want[4])
+    b = make_batcher()
+    r = run_one(b, PROMPT, 10, sampling=SamplingParams(stop_sequences=(stop,)))
+    assert b.result(r) == want[:3]
+    assert b.finish_reason(r) == "stop"
+
+
+def test_first_token_stop_can_empty_the_result():
+    want = greedy_tokens(3)
+    b = make_batcher()
+    r = run_one(b, PROMPT, 3,
+                sampling=SamplingParams(stop_sequences=((want[0],),)))
+    assert b.result(r) == []
+    assert b.finish_reason(r) == "stop"
+
+
+def test_finish_reasons_length_and_eos():
+    want = greedy_tokens(6)
+    b = make_batcher()
+    r = run_one(b, PROMPT, 6)
+    assert b.finish_reason(r) == "length"
+    # eos: pick the 3rd greedy token as eos; it stays in the output
+    b2 = make_batcher(eos_id=want[2])
+    r2 = run_one(b2, PROMPT, 6)
+    assert b2.result(r2) == want[:3]
+    assert b2.finish_reason(r2) == "eos"
+    # finish reason survives release; still-decoding raises
+    b2.release(r2)
+    assert b2.finish_reason(r2) == "eos"
+    with pytest.raises(KeyError):
+        b.finish_reason(999)
+
+
+def test_eos_wins_over_stop_sequence():
+    want = greedy_tokens(6)
+    b = make_batcher(eos_id=want[2])
+    r = run_one(b, PROMPT, 6,
+                sampling=SamplingParams(stop_sequences=((want[2],),)))
+    assert b.result(r) == want[:3]  # eos kept, not trimmed
+    assert b.finish_reason(r) == "eos"
+
+
+def test_greedy_logprobs_match_manual_log_softmax():
+    n = 5
+    want = greedy_tokens(n)
+    b = make_batcher()
+    r = run_one(b, PROMPT, n, sampling=SamplingParams(logprobs=True))
+    assert b.result(r) == want
+    lps = b.result_logprobs(r)
+    assert len(lps) == n
+    # greedy tokens are each row's argmax -> every logprob is the max
+    # log-softmax entry, finite and <= 0
+    assert all(math.isfinite(x) and x <= 0.0 for x in lps)
+    # spot-check the helper against numpy on a synthetic row
+    row = np.array([0.1, 2.0, -1.0, 0.5], dtype=np.float32)
+    want_lp = float(
+        np.log(np.exp(row.astype(np.float64) - row.max())
+               / np.exp(row.astype(np.float64) - row.max()).sum())[1]
+    )
+    assert abs(logprob_of(row, 1) - want_lp) < 1e-12
+
+
+def test_logprobs_are_unfiltered_under_sampling():
+    """A top-k=1 sampled request emits the greedy tokens; its logprobs
+    must equal the greedy request's (the filter never changes the
+    report)."""
+    n = 5
+    b = make_batcher()
+    r_greedy = run_one(b, PROMPT, n, sampling=SamplingParams(logprobs=True))
+    greedy_lps = b.result_logprobs(r_greedy)
+    b2 = make_batcher()
+    r_k1 = run_one(
+        b2, PROMPT, n,
+        sampling=SamplingParams(temperature=0.7, top_k=1, logprobs=True,
+                                seed=3),
+    )
+    assert b2.result(r_k1) == b.result(r_greedy)
+    np.testing.assert_allclose(b2.result_logprobs(r_k1), greedy_lps,
+                               rtol=1e-5)
+
+
+def test_speculative_logprobs_and_stops_match_plain():
+    draft_cfg = dataclasses.replace(CFG, n_layers=1)
+    draft = init_params(draft_cfg, jax.random.PRNGKey(2))
+    n = 8
+    want = greedy_tokens(n)
+    stop = (want[4], want[5])
+    sp = SamplingParams(stop_sequences=(stop,), logprobs=True)
+
+    plain = make_batcher()
+    r_p = run_one(plain, PROMPT, n, sampling=sp)
+
+    b = ContinuousBatcher(
+        PARAMS, CFG, max_batch=2, n_pages=32, page_size=4,
+        max_pages_per_seq=8, draft_params=draft, draft_config=draft_cfg,
+        gamma=3,
+    )
+    r_s = run_one(b, PROMPT, n, sampling=sp)
+    assert b.result(r_s) == plain.result(r_p) == want[:4]
+    assert b.finish_reason(r_s) == plain.finish_reason(r_p) == "stop"
+    # same tokens, same target distributions -> same logprobs (the verify
+    # window and the single-step program differ only at the ULP level)
+    np.testing.assert_allclose(
+        b.result_logprobs(r_s), plain.result_logprobs(r_p), atol=1e-3
+    )
+
+
+def test_logprobs_released_and_unrecorded_requests_raise():
+    b = make_batcher()
+    r_plain = run_one(b, PROMPT, 3)
+    with pytest.raises(KeyError, match="did not record"):
+        b.result_logprobs(r_plain)
+    r_lp = run_one(b, PROMPT, 3, sampling=SamplingParams(logprobs=True))
+    assert len(b.result_logprobs(r_lp)) == 3
+    b.release(r_lp)
+    with pytest.raises(KeyError, match="released"):
+        b.result_logprobs(r_lp)
+
+
+def test_empty_stop_sequence_rejected():
+    with pytest.raises(ValueError, match="non-empty"):
+        SamplingParams(stop_sequences=((),))
+
+
+def test_unknown_request_logprobs_says_unknown():
+    with pytest.raises(KeyError, match="unknown request"):
+        make_batcher().result_logprobs(999)
