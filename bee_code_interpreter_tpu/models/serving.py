@@ -24,6 +24,16 @@ per request (pinned by tests/test_serving.py) — batching other requests
 alongside cannot change a request's output, which is the correctness bar
 for continuous batching.
 
+That bar applies to DENSE configs. Capacity-based MoE routing pools couple
+whatever tokens share a forward pass (an inherent property of the GShard
+scheme — tests/test_moe.py documents that even solo decode-vs-forward only
+matches drop-free), so MoE requests here route against their batch-mates
+and the padded admission prompt: outputs are deterministic per pool state
+but not pinned equal to solo decode. Speculative mode and the prefix cache
+refuse MoE outright because their guarantees are exactness claims; plain
+serving keeps MoE usable under the same documented caveat as the rest of
+the decode family (pinned deterministic by tests/test_serving_stops.py).
+
 Sampling is PER REQUEST (temperature / top-k / top-p / seed — the
 heterogeneity serving actually needs) and runs host-side on the step's
 logits: the device program stays one fixed-shape greedy-agnostic forward,
