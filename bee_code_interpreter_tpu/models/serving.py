@@ -90,7 +90,19 @@ class SamplingParams:
     stays in the output by comparison). ``logprobs=True`` records the
     model's log-probability of each emitted token — under the UNFILTERED
     distribution (log-softmax of the raw logits row), so a sampled
-    token's report doesn't change with top-k/top-p settings."""
+    token's report doesn't change with top-k/top-p settings — and that
+    stays true under bias/constraints: the report is always the MODEL's
+    probability of the emitted token, however the sampler was steered.
+
+    ``logit_bias`` maps token id -> additive bias on the raw logits
+    before selection (the OpenAI-style knob: strongly negative bans a
+    token, strongly positive forces it). ``allowed_tokens`` is the
+    grammar hook: a callable receiving the tokens GENERATED SO FAR for
+    this request (prompt excluded) and returning the iterable of token
+    ids currently permitted, or None for "unconstrained this step" —
+    everything else is masked to -inf. A grammar/JSON engine plugs in by
+    closing over its own parser state. Both run host-side per row; the
+    device program stays constraint-agnostic and fixed-shape."""
 
     temperature: float = 0.0
     top_k: int | None = None
@@ -98,6 +110,8 @@ class SamplingParams:
     seed: int = 0
     stop_sequences: tuple[tuple[int, ...], ...] = ()
     logprobs: bool = False
+    logit_bias: tuple[tuple[int, float], ...] = ()
+    allowed_tokens: object = None  # Callable[[list[int]], Iterable[int] | None]
 
     def __post_init__(self) -> None:
         # same fail-fast rule as sample_logits: validated regardless of
@@ -108,14 +122,31 @@ class SamplingParams:
             raise ValueError(
                 f"temperature must be >= 0, got {self.temperature}"
             )
-        # normalize so callers can pass lists; frozen dataclass needs
-        # object.__setattr__ for the canonicalized copy
+        # normalize so callers can pass lists/dicts; frozen dataclass needs
+        # object.__setattr__ for the canonicalized copies
         object.__setattr__(
             self, "stop_sequences",
             tuple(tuple(int(t) for t in s) for s in self.stop_sequences),
         )
         if any(len(s) == 0 for s in self.stop_sequences):
             raise ValueError("stop sequences must be non-empty")
+        bias = self.logit_bias
+        if isinstance(bias, dict):
+            bias = tuple(sorted(bias.items()))
+        object.__setattr__(
+            self, "logit_bias",
+            tuple((int(t), float(b)) for t, b in bias),
+        )
+        if self.allowed_tokens is not None and not callable(
+            self.allowed_tokens
+        ):
+            raise ValueError("allowed_tokens must be callable or None")
+
+    @property
+    def steered(self) -> bool:
+        """True when selection needs the full logits row on host (bias or
+        constraint active) even for a greedy request."""
+        return bool(self.logit_bias) or self.allowed_tokens is not None
 
 
 def logprob_of(logits: np.ndarray, token: int) -> float:
@@ -162,6 +193,49 @@ def sample_host(
         return int(np.argmax(logits))
     probs = filtered_probs_host(logits, params)
     return int(rng.choice(logits.shape[0], p=probs))
+
+
+class ConstraintExhausted(Exception):
+    """The ``allowed_tokens`` constraint permits no continuation — a
+    grammar reaching its terminal state. NORMAL control flow, not an
+    error: the batcher retires the request with finish reason
+    'constraint' (empty output if it happens at admission)."""
+
+
+def choose_host(
+    logits: np.ndarray,  # [V] f32 — RAW model logits for this row
+    params: SamplingParams,
+    rng: np.random.Generator,
+    generated: list[int],
+) -> int:
+    """Full per-row selection: apply ``logit_bias`` and the
+    ``allowed_tokens`` constraint to a copy of the raw row, then greedy
+    argmax or the ``sample_host`` draw. ``generated`` is this request's
+    output so far (prompt excluded) — the constraint callable's input.
+    Raises ConstraintExhausted when the constraint returns an empty set
+    (grammar complete), ValueError on out-of-vocab ids."""
+    if params.steered:
+        logits = logits.astype(np.float64, copy=True)
+        for token, bias in params.logit_bias:
+            logits[token] += bias
+        if params.allowed_tokens is not None:
+            allowed = params.allowed_tokens(list(generated))
+            if allowed is not None:
+                idx = np.fromiter(
+                    (int(t) for t in allowed), dtype=np.int64
+                )
+                if idx.size == 0:
+                    raise ConstraintExhausted(
+                        "allowed_tokens permits no continuation"
+                    )
+                if (idx < 0).any() or (idx >= logits.shape[0]).any():
+                    raise ValueError(
+                        "allowed_tokens returned out-of-vocab token ids"
+                    )
+                mask = np.full(logits.shape, -np.inf)
+                mask[idx] = 0.0
+                logits = logits + mask
+    return sample_host(logits, params, rng)
 
 
 class ContinuousBatcher:
@@ -254,7 +328,9 @@ class ContinuousBatcher:
         self.results: dict[int, list[int]] = {}
         self.results_logprobs: dict[int, list[float]] = {}
         self.done: dict[int, bool] = {}
-        self.finish: dict[int, str] = {}  # request -> eos | stop | length
+        # request -> eos | stop | length | constraint | error
+        self.finish: dict[int, str] = {}
+        self.errors: dict[int, str] = {}  # request -> repr of callable error
         self.row_sampling: list[SamplingParams | None] = [None] * max_batch
         self.row_rng: list[np.random.Generator | None] = [None] * max_batch
         self._next_request_id = 0
@@ -355,6 +431,11 @@ class ContinuousBatcher:
                 "speculative serving decodes greedily (draft-verify with "
                 "sampling needs rejection sampling, not implemented)"
             )
+        if speculative and sampling is not None and sampling.steered:
+            raise ValueError(
+                "speculative serving cannot apply logit_bias/allowed_tokens "
+                "(draft-verify commits the target's unsteered argmax tokens)"
+            )
         # speculative rounds write draft/verify K/V past the budget before
         # truncation — those slots must be OWNED pages (a scratch-page read
         # inside the still-visible window would corrupt the verify). An
@@ -434,13 +515,30 @@ class ContinuousBatcher:
                 )
             sampling = sampling or SamplingParams()
             rng = np.random.default_rng(sampling.seed)
-            first = sample_host(last_row, sampling, rng)
+            first = choose_host(last_row, sampling, rng, [])
+        except ConstraintExhausted:
+            # the constraint permits no FIRST token: the request is
+            # complete with an empty output (grammar terminal at step 0) —
+            # a finished request, not an error; pages go straight back
+            self.block_table[row, :] = _SCRATCH_PAGE
+            for page in reversed(pages):
+                self._release_page(page)
+            req = self._next_request_id
+            self._next_request_id += 1
+            self.results[req] = []
+            if sampling.logprobs:
+                self.results_logprobs[req] = []
+            self.done[req] = True
+            self.finish[req] = "constraint"
+            return req
         except BaseException:
             # a failed admission (prefill OOM, bad sampling params, ...)
             # must not leak its pages: the row never activated, so nothing
             # else will ever return them to the pool. Shared pages drop the
             # acquired ref (back to the LRU if nobody else holds them);
-            # fresh ones go straight back to the free list.
+            # fresh ones go straight back to the free list. (Unlike
+            # mid-decode, a user-callable error here PROPAGATES: submit is
+            # synchronous and no request id exists yet.)
             self.block_table[row, :] = _SCRATCH_PAGE
             for page in reversed(pages):
                 self._release_page(page)
@@ -671,9 +769,11 @@ class ContinuousBatcher:
         )
         # the common all-greedy-no-logprobs case reduces on device and
         # moves B int32s; the full [max_batch, V] logits cross to host only
-        # when some active row actually samples or records logprobs
+        # when some active row samples, records logprobs, or is steered by
+        # bias/constraints
         need_rows = any_sampled or any(
-            self.row_sampling[row].logprobs for row in active_rows
+            self.row_sampling[row].logprobs or self.row_sampling[row].steered
+            for row in active_rows
         )
         greedy = np.asarray(
             jnp.argmax(logits[:, -1, :], axis=-1), dtype=np.int32
@@ -684,16 +784,33 @@ class ContinuousBatcher:
         )
         for row in active_rows:
             sp = self.row_sampling[row]
-            if sp.temperature > 0.0:
-                nxt = sample_host(lg[row], sp, self.row_rng[row])
+            req_row = int(self.row_request[row])
+            if sp.temperature > 0.0 or sp.steered:
+                try:
+                    nxt = choose_host(
+                        lg[row], sp, self.row_rng[row], self.results[req_row]
+                    )
+                except ConstraintExhausted:
+                    # grammar terminal state: the request is complete as-is
+                    self._retire(int(row), "constraint")
+                    continue
+                except Exception as e:
+                    # a buggy user callable must not wedge the whole batch
+                    # (request isolation is continuous batching's promise):
+                    # the row retires with the error recorded, batch-mates
+                    # keep decoding
+                    self.errors[req_row] = repr(e)
+                    self._retire(int(row), "error")
+                    continue
             else:
                 nxt = int(greedy[row])
             self.pos[row] += 1
             self.current[row, 0] = nxt
-            req = int(self.row_request[row])
-            self.results[req].append(nxt)
+            self.results[req_row].append(nxt)
             if sp.logprobs:
-                self.results_logprobs[req].append(logprob_of(lg[row], nxt))
+                self.results_logprobs[req_row].append(
+                    logprob_of(lg[row], nxt)
+                )
             self._retire_if_done(int(row))
 
     def _step_speculative(self) -> None:
@@ -787,27 +904,32 @@ class ContinuousBatcher:
         return None
 
     def _retire_if_done(self, row: int) -> None:
+        verdict = self._done_reason(row, self.results[int(self.row_request[row])])
+        if verdict is not None:
+            self._retire(row, *verdict)
+
+    def _retire(self, row: int, reason: str, trim: int = 0) -> None:
+        """Retire a row unconditionally: trim, record the finish reason,
+        free the row and its pages. The _retire_if_done path and the
+        constraint-terminal/callable-error paths all land here."""
         req = int(self.row_request[row])
         out = self.results[req]
-        verdict = self._done_reason(row, out)
-        if verdict is not None:
-            reason, trim = verdict
-            if trim:
-                del out[len(out) - trim:]
-                lp = self.results_logprobs.get(req)
-                if lp is not None:
-                    del lp[len(lp) - trim:]
-            self.finish[req] = reason
-            self.active[row] = False
-            self.done[req] = True
-            self.row_request[row] = -1
-            self.row_sampling[row] = None
-            self.row_rng[row] = None
-            used = set(self.block_table[row].tolist()) - {_SCRATCH_PAGE}
-            for page in sorted(used, reverse=True):
-                self._release_page(page)
-            self.block_table[row, :] = _SCRATCH_PAGE
-            # pos stays for inspection; scratch-page writes are masked
+        if trim:
+            del out[len(out) - trim:]
+            lp = self.results_logprobs.get(req)
+            if lp is not None:
+                del lp[len(lp) - trim:]
+        self.finish[req] = reason
+        self.active[row] = False
+        self.done[req] = True
+        self.row_request[row] = -1
+        self.row_sampling[row] = None
+        self.row_rng[row] = None
+        used = set(self.block_table[row].tolist()) - {_SCRATCH_PAGE}
+        for page in sorted(used, reverse=True):
+            self._release_page(page)
+        self.block_table[row, :] = _SCRATCH_PAGE
+        # pos stays for inspection; scratch-page writes are masked
 
     # -------------------------------------------------------------- results
     def is_done(self, request_id: int) -> bool:
@@ -843,9 +965,15 @@ class ContinuousBatcher:
             raise RuntimeError(f"request {request_id} still decoding")
         return list(self.results_logprobs[request_id])
 
+    def request_error(self, request_id: int) -> str | None:
+        """repr of the user-callable exception that retired a request with
+        finish reason 'error', else None. Survives ``release``."""
+        return self.errors.get(request_id)
+
     def finish_reason(self, request_id: int) -> str:
-        """'eos' | 'stop' | 'length' for a finished request; survives
-        ``release`` (a string per request, like the done-flag)."""
+        """'eos' | 'stop' | 'length' | 'constraint' | 'error' for a
+        finished request; survives ``release`` (a string per request,
+        like the done-flag)."""
         if request_id not in self.finish:
             if self.done.get(request_id) is False:
                 raise RuntimeError(f"request {request_id} still decoding")

@@ -206,3 +206,172 @@ def test_moe_serving_is_deterministic_not_solo_pinned():
     first, second = run(), run()
     assert first == second
     assert all(len(out) == 5 for out in first)
+
+
+# ------------------------- logit_bias / allowed_tokens (constrained decode)
+
+def test_logit_bias_bans_and_forces():
+    want = greedy_tokens(4)
+    # ban the greedy first token: output must start differently
+    b = make_batcher()
+    r = run_one(b, PROMPT, 4,
+                sampling=SamplingParams(logit_bias={want[0]: -1e9}))
+    banned = b.result(r)
+    # the bias applies at EVERY step, not just admission
+    assert want[0] not in banned
+    # force an arbitrary token everywhere with a huge positive bias
+    b2 = make_batcher()
+    r2 = run_one(b2, PROMPT, 4, sampling=SamplingParams(logit_bias={7: 1e9}))
+    assert b2.result(r2) == [7, 7, 7, 7]
+
+
+def test_allowed_tokens_masks_greedy_to_the_set():
+    allowed_set = [2, 3, 5, 7, 11, 13]
+    b = make_batcher()
+    r = run_one(b, PROMPT, 6,
+                sampling=SamplingParams(
+                    allowed_tokens=lambda generated: allowed_set))
+    assert all(t in allowed_set for t in b.result(r))
+
+
+def test_allowed_tokens_sees_generated_prefixes():
+    seen = []
+
+    def constraint(generated):
+        seen.append(list(generated))
+        return None  # unconstrained: output must equal plain greedy
+
+    b = make_batcher()
+    r = run_one(b, PROMPT, 4,
+                sampling=SamplingParams(allowed_tokens=constraint))
+    out = b.result(r)
+    assert out == greedy_tokens(4)
+    assert seen == [out[:i] for i in range(4)]
+
+
+def test_grammar_style_constraint_drives_a_sequence():
+    """A stateful grammar: after token A only B is legal, after B only A —
+    the closure-over-parser-state pattern a JSON engine would use."""
+    A, B = 9, 17
+
+    def alternate(generated):
+        if not generated:
+            return [A]
+        return [B] if generated[-1] == A else [A]
+
+    b = make_batcher()
+    r = run_one(b, PROMPT, 6,
+                sampling=SamplingParams(allowed_tokens=alternate))
+    assert b.result(r) == [A, B, A, B, A, B]
+
+
+def test_sampled_constrained_draws_stay_in_set_and_are_seeded():
+    allowed_set = [1, 2, 3, 4]
+    sp = SamplingParams(temperature=1.5, seed=11,
+                        allowed_tokens=lambda g: allowed_set)
+    b = make_batcher()
+    out1 = b.result(run_one(b, PROMPT, 8, sampling=sp))
+    b2 = make_batcher()
+    out2 = b2.result(run_one(b2, PROMPT, 8, sampling=sp))
+    assert out1 == out2  # same seed, same draws
+    assert all(t in allowed_set for t in out1)
+    assert len(set(out1)) > 1  # hot temperature actually explores the set
+
+
+def test_logprobs_report_model_distribution_even_when_steered():
+    b = make_batcher()
+    r = run_one(b, PROMPT, 3,
+                sampling=SamplingParams(logit_bias={7: 1e9}, logprobs=True))
+    assert b.result(r) == [7, 7, 7]
+    # 7 is (whp) not the model's argmax: its raw logprob is well below 0,
+    # proving the report ignores the bias that forced it
+    assert all(lp < -0.5 for lp in b.result_logprobs(r))
+
+
+def test_speculative_refuses_steering():
+    draft_cfg = dataclasses.replace(CFG, n_layers=1)
+    draft = init_params(draft_cfg, jax.random.PRNGKey(2))
+    b = ContinuousBatcher(
+        PARAMS, CFG, max_batch=2, n_pages=32, page_size=4,
+        max_pages_per_seq=8, draft_params=draft, draft_config=draft_cfg,
+    )
+    with pytest.raises(ValueError, match="unsteered argmax"):
+        b.submit(PROMPT, 4, sampling=SamplingParams(logit_bias={1: 5.0}))
+    with pytest.raises(ValueError, match="unsteered argmax"):
+        b.submit(PROMPT, 4,
+                 sampling=SamplingParams(allowed_tokens=lambda g: [1]))
+
+
+def test_terminal_constraint_at_admission_completes_empty():
+    """A grammar already in its terminal state at step 0 is a FINISHED
+    request with an empty output — not an error, and no leaked pages."""
+    b = make_batcher()
+    free0 = len(b.free_pages)
+    r = b.submit(PROMPT, 4,
+                 sampling=SamplingParams(allowed_tokens=lambda g: [],
+                                         logprobs=True))
+    assert b.is_done(r)
+    assert b.result(r) == []
+    assert b.result_logprobs(r) == []
+    assert b.finish_reason(r) == "constraint"
+    assert len(b.free_pages) == free0  # nothing leaked
+
+
+def test_terminal_constraint_mid_decode_retires_cleanly():
+    """A grammar completing after 3 tokens retires the request with
+    finish reason 'constraint'; its batch-mate keeps decoding."""
+    A, B_tok = 9, 17
+
+    def three_then_done(generated):
+        if len(generated) >= 3:
+            return []
+        return [A] if len(generated) % 2 == 0 else [B_tok]
+
+    b = make_batcher()
+    r_grammar = b.submit(
+        PROMPT, 10,
+        sampling=SamplingParams(allowed_tokens=three_then_done),
+    )
+    r_plain = b.submit([3, 1, 4, 1, 5], 6)
+    b.run_to_completion()
+    assert b.result(r_grammar) == [A, B_tok, A]
+    assert b.finish_reason(r_grammar) == "constraint"
+    assert len(b.result(r_plain)) == 6  # batch-mate unaffected
+    assert b.finish_reason(r_plain) == "length"
+    assert (b.page_ref > 0).sum() == 0  # all pages back
+
+
+def test_buggy_constraint_retires_with_error_not_wedge():
+    """A user callable that raises mid-decode retires ITS row with finish
+    reason 'error' (message recorded); the batch keeps serving."""
+
+    def explode_after_two(generated):
+        if len(generated) >= 2:
+            raise KeyError("grammar state corrupted")
+        return None
+
+    b = make_batcher()
+    r_bad = b.submit(
+        PROMPT, 8, sampling=SamplingParams(allowed_tokens=explode_after_two)
+    )
+    r_ok = b.submit([3, 1, 4, 1, 5], 6)
+    b.run_to_completion()
+    assert b.finish_reason(r_bad) == "error"
+    assert "grammar state corrupted" in b.request_error(r_bad)
+    assert len(b.result(r_bad)) == 2  # tokens before the failure kept
+    assert len(b.result(r_ok)) == 6
+    assert b.request_error(r_ok) is None
+    assert (b.page_ref > 0).sum() == 0
+
+
+def test_out_of_vocab_constraint_is_an_error():
+    b = make_batcher()
+    r = b.submit(
+        PROMPT, 4,
+        sampling=SamplingParams(
+            allowed_tokens=lambda g: [10**9] if g else None
+        ),
+    )
+    b.run_to_completion()
+    assert b.finish_reason(r) == "error"
+    assert "out-of-vocab" in b.request_error(r)
