@@ -125,5 +125,42 @@ def make_lora_train_step(
     return jax.jit(step, donate_argnums=(0, 1)), optimizer
 
 
+def stack_lora_bank(adapters: list[Params]) -> Params:
+    """Stack adapters into the multi-LoRA serving bank
+    (``models/serving.py``): per target ``A [n_layers, n_adapters+1, d_in,
+    r]`` / ``B [n_layers, n_adapters+1, r, d_out]``, with index 0 an
+    ALL-ZEROS base adapter (identity delta) so un-adapted rows run the
+    same compiled program, and user adapters at 1..n in order. The layer
+    axis leads so the decode scan slices it alongside params/cache. All
+    adapters must share targets, rank, and shapes — heterogeneous ranks
+    would need per-adapter padding, refused instead."""
+    if not adapters:
+        raise ValueError("need at least one adapter")
+    targets = set(adapters[0])
+    for a in adapters[1:]:
+        if set(a) != targets:
+            raise ValueError(
+                f"adapters must share targets: {sorted(targets)} vs "
+                f"{sorted(a)}"
+            )
+    bank: Params = {}
+    for t in sorted(targets):
+        for leaf in ("A", "B"):
+            shapes = {a[t][leaf].shape for a in adapters}
+            if len(shapes) != 1:
+                raise ValueError(
+                    f"adapters disagree on {t}/{leaf} shape: {shapes}"
+                )
+        bank[t] = {
+            leaf: jnp.stack(
+                [jnp.zeros_like(adapters[0][t][leaf])]
+                + [a[t][leaf] for a in adapters],
+                axis=1,
+            )
+            for leaf in ("A", "B")
+        }
+    return bank
+
+
 def lora_param_count(lora: Params) -> int:
     return sum(x.size for ab in lora.values() for x in ab.values())

@@ -261,6 +261,8 @@ class ContinuousBatcher:
         draft_config: TransformerConfig | None = None,
         gamma: int = 4,
         prefix_cache: bool = False,
+        adapters: list | None = None,
+        lora_scale: float = 1.0,
     ) -> None:
         """``draft_params``/``draft_config`` switch the batcher into
         SPECULATIVE mode: every step, the draft proposes ``gamma`` greedy
@@ -278,7 +280,20 @@ class ContinuousBatcher:
         across requests (refcounted, LRU-evicted under pool pressure, kept
         alive past retirement for repeat prompts), and a hit admits through
         a suffix-only prefill — per-request outputs are unchanged, pinned
-        by tests/test_prefix_cache.py."""
+        by tests/test_prefix_cache.py.
+
+        ``adapters`` turns on MULTI-LoRA serving (S-LoRA style): a list of
+        LoRA pytrees (``models/lora.py``, attention-projection targets)
+        stacked into one device bank; ``submit(adapter=i)`` serves request
+        rows under adapter i — heterogeneous adapters decode together in
+        one compiled program, the shared base weights streaming from HBM
+        once for the whole batch. Admission prefills through
+        ``merge_lora`` (the delta folded for the O(L) pass), decode
+        applies the delta unmerged per row; both use ``lora_scale``
+        (alpha/rank). The prefix cache keys pages by (adapter, tokens), so
+        requests under different adapters never share K/V. Pinned equal to
+        solo decode on the merged params by
+        tests/test_multilora_serving.py."""
         self.params = params
         self.config = config
         self.page_size = page_size
@@ -299,6 +314,23 @@ class ContinuousBatcher:
                 "differ between suffix-only and full prefill)"
             )
         self.prefix_cache_enabled = prefix_cache
+        self.lora_scale = float(lora_scale)
+        # only the stacked bank is kept: holding the original adapter
+        # pytrees too would double adapter memory for the server's life
+        self.n_adapters = len(adapters) if adapters else 0
+        if adapters:
+            from bee_code_interpreter_tpu.models.lora import stack_lora_bank
+
+            self.lora_bank = stack_lora_bank(list(adapters))
+            unknown = set(self.lora_bank) - {"wq", "wk", "wv", "wo"}
+            if unknown:
+                raise ValueError(
+                    f"serving adapters target {sorted(unknown)}; the decode "
+                    "path supports attention projections (wq/wk/wv/wo) only"
+                )
+        else:
+            self.lora_bank = None
+        self.row_adapter = np.zeros(max_batch, dtype=np.int32)
         if (draft_params is None) != (draft_config is None):
             raise ValueError(
                 "speculative mode needs BOTH draft_params and draft_config"
@@ -353,7 +385,9 @@ class ContinuousBatcher:
         # donate the pool: without aliasing, every decoded token would pay
         # a full page-pool HBM copy (precedent: make_train_step's donation)
         self._decode = jax.jit(
-            functools.partial(decode_step_paged, config=config),
+            functools.partial(
+                decode_step_paged, config=config, lora_scale=self.lora_scale
+            ),
             donate_argnums=(3,),
         )
         self._prefill = jax.jit(
@@ -369,9 +403,33 @@ class ContinuousBatcher:
         # suffix-only admission windows (prefix-cache hits); compiles once
         # per page-aligned window width, bounded by max_pages_per_seq
         self._window = jax.jit(
-            functools.partial(decode_window_paged, config=config),
+            functools.partial(
+                decode_window_paged, config=config, lora_scale=self.lora_scale
+            ),
             donate_argnums=(3,),
         )
+        if self.lora_bank is not None:
+            # admission prefill under an adapter: the delta is FOLDED
+            # (merge_lora) for the O(L) pass — one rank-r outer product per
+            # target vs L tokens' worth of per-token delta einsums — then
+            # K/V seed pages exactly like the base path. The zero adapter
+            # (index 0) merges to the base params, so un-adapted rows share
+            # this same program.
+            from bee_code_interpreter_tpu.models.lora import merge_lora
+
+            self._prefill_lora = jax.jit(
+                lambda p, lo, t: forward(
+                    merge_lora(p, lo, self.lora_scale), t, config,
+                    return_kv=True,
+                )
+            )
+            self._prefill_chunked_lora = jax.jit(
+                lambda p, lo, t, total_len, chunk: prefill_chunked(
+                    merge_lora(p, lo, self.lora_scale), t, config=config,
+                    total_len=total_len, chunk=chunk,
+                ),
+                static_argnames=("total_len", "chunk"),
+            )
         if draft_config is not None:
             # the draft's own paged pool, addressed by the SAME block
             # tables/pages (one allocation covers both models' K/V)
@@ -404,6 +462,7 @@ class ContinuousBatcher:
         max_new_tokens: int,
         sampling: SamplingParams | None = None,
         prefill_chunk: int | None = None,
+        adapter: int | None = None,
     ) -> int:
         """Prefill ``prompt`` into freshly allocated pages and return a
         REQUEST id (stable across row recycling). ``sampling`` defaults to
@@ -418,13 +477,28 @@ class ContinuousBatcher:
         quantized once, never re-quantized), so a chunked admission decodes
         exactly like prefill_chunked + contiguous decode. Trade-off: each
         distinct (full-chunks, remainder) shape compiles once, vs the
-        padded one-shot path's max_pages_per_seq-bounded compile count."""
+        padded one-shot path's max_pages_per_seq-bounded compile count.
+
+        ``adapter`` serves this request under the i-th LoRA adapter the
+        batcher was constructed with (None = the base model)."""
         prompt = np.asarray(prompt, dtype=np.int32).reshape(-1)
         L = int(prompt.shape[0])
         if L < 1:
             raise ValueError("prompt must be non-empty")
         if max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1")
+        if adapter is not None:
+            if self.lora_bank is None:
+                raise ValueError(
+                    "no adapters configured (pass adapters= at construction)"
+                )
+            if not 0 <= adapter < self.n_adapters:
+                raise ValueError(
+                    f"adapter {adapter} out of range "
+                    f"(have {self.n_adapters})"
+                )
+        # internal index: 0 is the all-zeros base adapter in the bank
+        adapter_internal = 0 if adapter is None else adapter + 1
         speculative = self.draft_params is not None
         if speculative and sampling is not None and sampling.temperature > 0:
             raise ValueError(
@@ -462,7 +536,7 @@ class ContinuousBatcher:
         hashes: list[bytes] = []
         shared: list[int] = []
         if self.prefix_cache_enabled:
-            hashes = self._chain_hashes(prompt)
+            hashes = self._chain_hashes(prompt, adapter_internal)
             self.prefix_stats["lookups"] += 1
             for i in range(min(len(hashes), (L - 1) // self.page_size)):
                 page = self.prefix_index.get(hashes[i])
@@ -507,11 +581,13 @@ class ContinuousBatcher:
                         for name, x in self.draft_cache.items()
                     }
                 last_row = self._suffix_admit(
-                    row, prompt, matched, speculative, prefill_chunk
+                    row, prompt, matched, speculative, prefill_chunk,
+                    adapter_internal,
                 )
             else:
                 last_row = self._full_admit(
-                    prompt, pages, L, speculative, prefill_chunk
+                    prompt, pages, L, speculative, prefill_chunk,
+                    adapter_internal,
                 )
             sampling = sampling or SamplingParams()
             rng = np.random.default_rng(sampling.seed)
@@ -570,6 +646,7 @@ class ContinuousBatcher:
         self.pos[row] = L
         self.current[row, 0] = first
         self.budget[row] = max_new_tokens
+        self.row_adapter[row] = adapter_internal
         self.row_request[row] = req
         self.row_sampling[row] = sampling
         self.row_rng[row] = rng
@@ -582,10 +659,13 @@ class ContinuousBatcher:
         return req
 
     # ------------------------------------------------- admission sub-paths
-    def _full_admit(self, prompt, pages, L, speculative, prefill_chunk):
+    def _full_admit(self, prompt, pages, L, speculative, prefill_chunk,
+                    adapter_internal=0):
         """Whole-prompt admission (no prefix hit): one-shot or chunked
         prefill into this row's pages; returns the last prompt token's
-        logits row."""
+        logits row. With a lora bank, the prefill runs on merge_lora'd
+        params for the row's adapter (index 0 merges the zero adapter =
+        the base)."""
         n_prompt_pages = -(-L // self.page_size)
         pages_arr = jnp.asarray(pages[:n_prompt_pages], dtype=jnp.int32)
         # the prompt padded to a whole number of pages — shared by the
@@ -613,11 +693,19 @@ class ContinuousBatcher:
         if prefill_chunk is not None:
             # bounded-memory admission: the chunked prefill builds the
             # cache in the pool's layout; copy its leaves verbatim
-            last_logits, contig = self._prefill_chunked(
-                self.params, prompt[None, :],
-                total_len=n_prompt_pages * self.page_size,
-                chunk=prefill_chunk,
-            )
+            if self.lora_bank is not None:
+                last_logits, contig = self._prefill_chunked_lora(
+                    self.params, self._adapter_slice(adapter_internal),
+                    prompt[None, :],
+                    total_len=n_prompt_pages * self.page_size,
+                    chunk=prefill_chunk,
+                )
+            else:
+                last_logits, contig = self._prefill_chunked(
+                    self.params, prompt[None, :],
+                    total_len=n_prompt_pages * self.page_size,
+                    chunk=prefill_chunk,
+                )
             self.cache = seed_from_contiguous(
                 self.cache, pages_arr,
                 {name: x[:, 0] for name, x in contig.items()},
@@ -632,9 +720,15 @@ class ContinuousBatcher:
             # so logits[L-1] and K/V[:L] are exact, and distinct
             # prompt lengths share a program per page count instead of
             # one per length.
-            logits, (k_pre, v_pre) = self._prefill(
-                self.params, padded[None, :]
-            )
+            if self.lora_bank is not None:
+                logits, (k_pre, v_pre) = self._prefill_lora(
+                    self.params, self._adapter_slice(adapter_internal),
+                    padded[None, :],
+                )
+            else:
+                logits, (k_pre, v_pre) = self._prefill(
+                    self.params, padded[None, :]
+                )
             self.cache = seed_prefill(
                 self.cache, pages_arr,
                 k_pre[:, 0, :, :L, :], v_pre[:, 0, :, :L, :],
@@ -653,7 +747,8 @@ class ContinuousBatcher:
             )
         return last_row
 
-    def _suffix_admit(self, row, prompt, matched, speculative, prefill_chunk):
+    def _suffix_admit(self, row, prompt, matched, speculative, prefill_chunk,
+                      adapter_internal=0):
         """Admission with ``matched`` prefix pages already holding this
         prompt's K/V: only the suffix runs through the model, as
         consecutive ``decode_window_paged`` windows that append suffix K/V
@@ -691,7 +786,8 @@ class ContinuousBatcher:
             win_arr = jnp.asarray(win[None, :])
             pos_arr = jnp.asarray([pos], dtype=jnp.int32)
             logits, self.cache = self._window(
-                self.params, win_arr, pos_arr, self.cache, bt_row
+                self.params, win_arr, pos_arr, self.cache, bt_row,
+                **self._lora_kwargs(np.array([adapter_internal])),
             )
             if speculative:
                 _, self.draft_cache = self._draft_window(
@@ -704,13 +800,37 @@ class ContinuousBatcher:
             pos += int(win.shape[0])
         return last_row
 
+    # ------------------------------------------------------------ multi-LoRA
+    def _lora_kwargs(self, adapter_rows: np.ndarray) -> dict:
+        """Extra kwargs for the paged decode/window programs when a lora
+        bank is configured; empty (the untouched base path) otherwise."""
+        if self.lora_bank is None:
+            return {}
+        return {
+            "lora_bank": self.lora_bank,
+            "adapter_idx": jnp.asarray(adapter_rows, dtype=jnp.int32),
+        }
+
+    def _adapter_slice(self, adapter_internal: int) -> dict:
+        """One adapter's plain LoRA pytree sliced out of the bank (for the
+        merge_lora'd admission prefill). Index 0 is the zero adapter."""
+        return {
+            t: {"A": ab["A"][:, adapter_internal],
+                "B": ab["B"][:, adapter_internal]}
+            for t, ab in self.lora_bank.items()
+        }
+
     # -------------------------------------------------- prefix-cache pages
-    def _chain_hashes(self, prompt: np.ndarray) -> list[bytes]:
+    def _chain_hashes(self, prompt: np.ndarray,
+                      adapter_internal: int = 0) -> list[bytes]:
         """Chain hash after each FULL page of the prompt: ``hashes[i]``
         commits to tokens [0, (i+1)*page_size) — a page is reusable only
         when its entire history matches, which is what makes shared K/V
-        position-exact (prefixes always align at position 0)."""
+        position-exact (prefixes always align at position 0). The adapter
+        index salts the chain: K/V under different LoRA adapters are
+        different values, so they must never share pages."""
         h = hashlib.blake2b(digest_size=16)
+        h.update(int(adapter_internal).to_bytes(8, "little"))
         out: list[bytes] = []
         ps = self.page_size
         for i in range(len(prompt) // ps):
@@ -762,6 +882,7 @@ class ContinuousBatcher:
             jnp.asarray(self.pos),
             self.cache,
             jnp.asarray(self.block_table),
+            **self._lora_kwargs(self.row_adapter),
         )
         active_rows = np.flatnonzero(self.active)
         any_sampled = any(
@@ -849,7 +970,8 @@ class ContinuousBatcher:
 
         window = jnp.concatenate([cur, drafts_dev], axis=1)  # [B, gamma+1]
         t_logits, self.cache = self._verify(
-            self.params, window, pos_dev, self.cache, bt
+            self.params, window, pos_dev, self.cache, bt,
+            **self._lora_kwargs(self.row_adapter),
         )
         t_pred = np.asarray(
             jnp.argmax(t_logits, axis=-1), dtype=np.int32
@@ -925,6 +1047,7 @@ class ContinuousBatcher:
         self.row_request[row] = -1
         self.row_sampling[row] = None
         self.row_rng[row] = None
+        self.row_adapter[row] = 0
         used = set(self.block_table[row].tolist()) - {_SCRATCH_PAGE}
         for page in sorted(used, reverse=True):
             self._release_page(page)

@@ -694,12 +694,18 @@ def decode_step_paged(
     cache: dict,  # ops/paged_kv_cache.alloc_paged_cache pool
     block_table: jax.Array,  # [B, P] int32 logical block -> physical page
     config: TransformerConfig,
+    lora_bank: dict | None = None,
+    adapter_idx: jax.Array | None = None,
+    lora_scale: float = 1.0,
 ) -> tuple[jax.Array, dict]:
     """One incremental decode step over the PAGED cache — the serving-side
     sibling of ``decode_step``. This IS ``decode_window_paged`` with W=1
     (one body, mirroring the contiguous decode_step/decode_window
     unification)."""
-    return decode_window_paged(params, token, pos, cache, block_table, config)
+    return decode_window_paged(
+        params, token, pos, cache, block_table, config,
+        lora_bank, adapter_idx, lora_scale,
+    )
 
 
 def decode_window_paged(
@@ -709,6 +715,9 @@ def decode_window_paged(
     cache: dict,  # ops/paged_kv_cache.alloc_paged_cache pool
     block_table: jax.Array,  # [B, P] int32 logical block -> physical page
     config: TransformerConfig,
+    lora_bank: dict | None = None,  # {target: {A: [n_layers, n_adapters, d, r], B: ...}}
+    adapter_idx: jax.Array | None = None,  # [B] int32 per-row adapter
+    lora_scale: float = 1.0,
 ) -> tuple[jax.Array, dict]:
     """Multi-token cached decode over the PAGED pool with PER-ROW window
     positions — the verify primitive for speculative decoding INSIDE
@@ -724,6 +733,16 @@ def decode_window_paged(
     per-row scale planes per page and append/read quantize exactly like
     the contiguous strategy. Rows whose slots would exceed the table's
     page budget are a scheduler bug (the scatter clamps).
+
+    ``lora_bank`` enables MULTI-LoRA serving (S-LoRA style): a stacked
+    bank of adapters for the attention projections, with ``adapter_idx``
+    selecting each row's adapter — heterogeneous adapters decode together
+    in ONE compiled program. The delta is applied unmerged
+    (``x@A[idx]@B[idx]·scale`` — two rank-r einsums per target, tiny next
+    to the base matmul), so the shared base weights stream from HBM once
+    for the whole batch regardless of how many adapters ride on it.
+    ``lora_bank is None`` is a static (trace-time) branch: the base path
+    is untouched. Pinned by tests/test_multilora_serving.py.
     """
     from bee_code_interpreter_tpu.ops.paged_kv_cache import (
         paged_append,
@@ -732,6 +751,15 @@ def decode_window_paged(
 
     c = config
     B, W = tokens.shape
+    if lora_bank is not None:
+        if adapter_idx is None:
+            raise ValueError("lora_bank needs adapter_idx")
+        unknown = set(lora_bank) - {"wq", "wk", "wv", "wo"}
+        if unknown:
+            raise ValueError(
+                f"lora_bank targets {sorted(unknown)} unsupported in the "
+                "decode path (attention projections only)"
+            )
     page_size = cache["k"].shape[3]
     S = block_table.shape[1] * page_size
     positions = pos0[:, None] + jnp.arange(W, dtype=jnp.int32)[None, :]  # [B, W]
@@ -743,17 +771,33 @@ def decode_window_paged(
     h = params["embed"].astype(c.dtype)[tokens]  # [B, W, D]
 
     def layer_step(h, scanned):
-        layer, c_layer = scanned  # pool slices [n_pages, kvh, ps, dh]
+        if lora_bank is None:
+            layer, c_layer = scanned  # pool slices [n_pages, kvh, ps, dh]
+            lora_layer = {}
+        else:
+            layer, c_layer, lora_layer = scanned
         x = rms_norm(h, layer["ln1"])
         dh, nh, kvh = c.head_dim, c.n_heads, c.kv_heads
 
-        def proj(w, heads):
+        def lora_delta(x_in, name):
+            if name not in lora_layer:
+                return None
+            Ab = lora_layer[name]["A"][adapter_idx].astype(c.dtype)  # [B,d,r]
+            Bb = lora_layer[name]["B"][adapter_idx].astype(c.dtype)  # [B,r,o]
+            return jnp.einsum(
+                "blr,bro->blo", jnp.einsum("bld,bdr->blr", x_in, Ab), Bb
+            ) * jnp.asarray(lora_scale, c.dtype)
+
+        def proj(w, heads, name):
             out = jnp.einsum("bld,dk->blk", x, w.astype(c.dtype))
+            delta = lora_delta(x, name)
+            if delta is not None:
+                out = out + delta
             return out.reshape(B, W, heads, dh).transpose(0, 2, 1, 3)
 
-        q = rope(proj(layer["wq"], nh), positions, c.rope_theta, c.rope_scaling)
-        k_new = rope(proj(layer["wk"], kvh), positions, c.rope_theta, c.rope_scaling)
-        v_new = proj(layer["wv"], kvh)
+        q = rope(proj(layer["wq"], nh, "wq"), positions, c.rope_theta, c.rope_scaling)
+        k_new = rope(proj(layer["wk"], kvh, "wk"), positions, c.rope_theta, c.rope_scaling)
+        v_new = proj(layer["wv"], kvh, "wv")
         c_layer = paged_append(
             c_layer,
             k_new.transpose(0, 2, 1, 3),  # [B, W, kvh, dh]
@@ -779,14 +823,22 @@ def decode_window_paged(
         weights = jax.nn.softmax(scores, axis=-1).astype(c.dtype)
         attn = jnp.einsum("bgrws,bgsd->bgrwd", weights, vf)
         attn = attn.transpose(0, 3, 1, 2, 4).reshape(B, W, nh * dh)
-        h = h + jnp.einsum("blk,kd->bld", attn, layer["wo"].astype(c.dtype))
+        o = jnp.einsum("blk,kd->bld", attn, layer["wo"].astype(c.dtype))
+        delta_o = lora_delta(attn, "wo")
+        if delta_o is not None:
+            o = o + delta_o
+        h = h + o
 
         y = rms_norm(h, layer["ln2"])
         mlp, _ = _mlp_block(y, layer, c)
         h = h + mlp
         return h, c_layer
 
-    h, cache = lax.scan(layer_step, h, (params["layers"], cache))
+    scanned = (
+        (params["layers"], cache) if lora_bank is None
+        else (params["layers"], cache, lora_bank)
+    )
+    h, cache = lax.scan(layer_step, h, scanned)
     h = rms_norm(h, params["ln_f"])
     logits = jnp.einsum("bld,dv->blv", h, params["lm_head"].astype(c.dtype))
     return logits.astype(jnp.float32), cache
