@@ -360,7 +360,7 @@ class ContinuousBatcher:
         self.results: dict[int, list[int]] = {}
         self.results_logprobs: dict[int, list[float]] = {}
         self.done: dict[int, bool] = {}
-        # request -> eos | stop | length | constraint | error
+        # request -> eos | stop | length | constraint | error | cancelled
         self.finish: dict[int, str] = {}
         self.errors: dict[int, str] = {}  # request -> repr of callable error
         self.row_sampling: list[SamplingParams | None] = [None] * max_batch
@@ -1094,7 +1094,8 @@ class ContinuousBatcher:
         return self.errors.get(request_id)
 
     def finish_reason(self, request_id: int) -> str:
-        """'eos' | 'stop' | 'length' | 'constraint' | 'error' for a
+        """'eos' | 'stop' | 'length' | 'constraint' | 'error' |
+        'cancelled' for a
         finished request; survives ``release`` (a string per request,
         like the done-flag)."""
         if request_id not in self.finish:
@@ -1102,6 +1103,18 @@ class ContinuousBatcher:
                 raise RuntimeError(f"request {request_id} still decoding")
             raise KeyError(f"unknown request {request_id}")
         return self.finish[request_id]
+
+    def cancel(self, request_id: int) -> None:
+        """Abort a still-decoding request: its row and pages free
+        immediately (the next admission can use them), the tokens
+        generated so far stay readable via ``result``, and
+        ``finish_reason`` reports 'cancelled'. Cancelling a finished or
+        released request is a no-op (the cancel raced completion — the
+        caller shouldn't have to care who won)."""
+        for row in np.flatnonzero(self.active):
+            if int(self.row_request[row]) == request_id:
+                self._retire(int(row), "cancelled")
+                return
 
     def release(self, request_id: int) -> None:
         """Drop a finished request's stored result (pages were already

@@ -181,6 +181,57 @@ def run_measurements(emit) -> None:
         ),
     })
 
+    # --- multi-LoRA serving: heterogeneous adapters riding the same paged
+    # program (models/serving.py). The delta is unmerged per row, so the
+    # overhead prices two rank-r einsums per target per layer — the
+    # S-LoRA-style claim that N adapters share one base-weight HBM stream
+    # is only real if this tax is small.
+    from bee_code_interpreter_tpu.models.lora import (
+        init_lora,
+        stack_lora_bank,
+    )
+
+    n_adapters, rank = 8, 16
+    adapters = [
+        {
+            t: {
+                "A": ab["A"],
+                "B": jax.random.normal(
+                    jax.random.PRNGKey(200 + i), ab["B"].shape, jnp.float32
+                ) * 0.02,
+            }
+            for t, ab in init_lora(
+                config, jax.random.PRNGKey(100 + i), rank=rank
+            ).items()
+        }
+        for i in range(n_adapters)
+    ]
+    bank = stack_lora_bank(adapters)
+    # every row under a different adapter (row 0 the base) — the served mix
+    ad_idx = jnp.arange(B, dtype=jnp.int32) % (n_adapters + 1)
+
+    def decode_lora_n(n_steps):
+        return decode_chain(
+            lambda tok, pos, cache: decode_step_paged(
+                params, tok, jnp.full((B,), pos), cache, bt, config,
+                lora_bank=bank, adapter_idx=ad_idx,
+            ),
+            n_steps,
+        )
+
+    t_ln = best_of(decode_lora_n(N), first, paged0)
+    t_l1 = best_of(decode_lora_n(1), first, paged0)
+    per_step_lora = chain_diff(t_ln, t_l1, N)
+    emit("multilora_decode", {
+        "n_adapters": n_adapters, "rank": rank,
+        "targets": sorted(bank),
+        "per_step_ms": round(per_step_lora * 1e3, 3),
+        "tokens_per_sec": round(B / per_step_lora, 1),
+        "overhead_vs_paged": round(
+            per_step_lora / per_step_paged - 1.0, 3
+        ),
+    })
+
     # --- speculative decoding: tokens/sec with a small draft ---------------
     from bee_code_interpreter_tpu.models.speculative import speculative_generate
 

@@ -375,3 +375,25 @@ def test_out_of_vocab_constraint_is_an_error():
     b.run_to_completion()
     assert b.finish_reason(r) == "error"
     assert "out-of-vocab" in b.request_error(r)
+
+
+def test_cancel_frees_the_row_and_keeps_partial_output():
+    b = make_batcher()
+    r_cancel = b.submit(PROMPT, 20)
+    r_keep = b.submit([3, 1, 4, 1, 5], 6)
+    b.step()
+    b.step()
+    free_before = len(b.free_pages)
+    b.cancel(r_cancel)
+    assert b.is_done(r_cancel)
+    assert b.finish_reason(r_cancel) == "cancelled"
+    assert len(b.result(r_cancel)) == 3  # first token + two steps
+    assert len(b.free_pages) > free_before  # pages back immediately
+    # the freed row is admittable again while the batch-mate finishes
+    r_new = b.submit(PROMPT, 4)
+    b.run_to_completion()
+    assert len(b.result(r_keep)) == 6
+    assert b.result(r_new) == greedy_tokens(4)
+    # cancelling a finished request is a no-op, not an error
+    b.cancel(r_keep)
+    assert b.finish_reason(r_keep) == "length"
