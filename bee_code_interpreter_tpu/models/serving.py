@@ -1110,11 +1110,14 @@ class ContinuousBatcher:
         generated so far stay readable via ``result``, and
         ``finish_reason`` reports 'cancelled'. Cancelling a finished or
         released request is a no-op (the cancel raced completion — the
-        caller shouldn't have to care who won)."""
+        caller shouldn't have to care who won); an id the batcher never
+        issued raises KeyError like every other request API."""
         for row in np.flatnonzero(self.active):
             if int(self.row_request[row]) == request_id:
                 self._retire(int(row), "cancelled")
                 return
+        if request_id not in self.done:
+            raise KeyError(f"unknown request {request_id}")
 
     def release(self, request_id: int) -> None:
         """Drop a finished request's stored result (pages were already

@@ -1,0 +1,109 @@
+# The request-level serving surface of the continuous batcher
+# (models/serving.py) in one runnable tour: stop sequences + finish
+# reasons, per-token logprobs, logit_bias, the allowed_tokens grammar
+# hook, request cancellation, and multi-LoRA serving (per-request
+# adapters in one compiled batch).
+#
+# f32 so the equality asserts are trustworthy (same reasoning as
+# speculative-decode.py: bf16 near-tie argmax flips are rounding noise).
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bee_code_interpreter_tpu.models import transformer as T
+from bee_code_interpreter_tpu.models.lora import init_lora, merge_lora
+from bee_code_interpreter_tpu.models.serving import (
+    ContinuousBatcher,
+    SamplingParams,
+)
+
+config = dataclasses.replace(
+    T.TransformerConfig.tiny(), n_kv_heads=2, dtype=jnp.float32,
+)
+params = T.init_params(config, jax.random.PRNGKey(0))
+model = T.Transformer(config)
+prompt = [5, 3, 7, 2, 9, 4, 1, 8]
+
+
+def solo(p, n):
+    out = model.generate_cached(
+        p, jnp.asarray(prompt, dtype=jnp.int32)[None, :], max_new_tokens=n
+    )
+    return np.asarray(out[0, len(prompt):]).tolist()
+
+
+def batcher(**kw):
+    return ContinuousBatcher(
+        params, config, max_batch=2, n_pages=32, page_size=4,
+        max_pages_per_seq=8, **kw,
+    )
+
+
+# --- stop sequences, finish reasons, logprobs ---------------------------
+want = solo(params, 8)
+b = batcher()
+r = b.submit(prompt, 8, sampling=SamplingParams(
+    stop_sequences=((want[3], want[4]),), logprobs=True))
+b.run_to_completion()
+assert b.result(r) == want[:3]          # matched stop trimmed
+assert b.finish_reason(r) == "stop"
+lps = b.result_logprobs(r)
+assert len(lps) == 3 and all(lp <= 0.0 for lp in lps)
+print(f"stops+logprobs OK: trimmed at the stop sequence, "
+      f"finish={b.finish_reason(r)}, logprobs={[round(x, 2) for x in lps]}")
+
+# --- constrained decoding: a two-state grammar + a forced token ---------
+A, B_tok = 9, 17
+
+
+def alternate(generated):
+    if not generated:
+        return [A]
+    return [B_tok] if generated[-1] == A else [A]
+
+
+b = batcher()
+r_grammar = b.submit(prompt, 6, sampling=SamplingParams(
+    allowed_tokens=alternate))
+r_forced = b.submit(prompt, 3, sampling=SamplingParams(
+    logit_bias={7: 1e9}))
+b.run_to_completion()
+assert b.result(r_grammar) == [A, B_tok, A, B_tok, A, B_tok]
+assert b.result(r_forced) == [7, 7, 7]
+print("constrained decoding OK: grammar hook drove A/B alternation, "
+      "logit_bias forced a token")
+
+# --- cancellation -------------------------------------------------------
+b = batcher()
+r_cancel = b.submit(prompt, 20)
+b.step()
+b.cancel(r_cancel)
+assert b.finish_reason(r_cancel) == "cancelled"
+assert len(b.result(r_cancel)) == 2  # first token + one step, kept
+print("cancel OK: pages freed mid-decode, partial output kept")
+
+# --- multi-LoRA: two adapters and the base in ONE batch -----------------
+def adapter(seed):
+    lora = init_lora(config, jax.random.PRNGKey(seed), rank=4)
+    return {t: {"A": ab["A"],
+                "B": jax.random.normal(jax.random.PRNGKey(seed + 50),
+                                       ab["B"].shape, jnp.float32) * 0.3}
+            for t, ab in lora.items()}
+
+
+adapters = [adapter(1), adapter(2)]
+mb = ContinuousBatcher(
+    params, config, max_batch=3, n_pages=40, page_size=4,
+    max_pages_per_seq=8, adapters=adapters, lora_scale=2.0,
+)
+r0 = mb.submit(prompt, 5, adapter=0)
+r1 = mb.submit(prompt, 5, adapter=1)
+rb = mb.submit(prompt, 5)
+mb.run_to_completion()
+assert mb.result(r0) == solo(merge_lora(params, adapters[0], 2.0), 5)
+assert mb.result(r1) == solo(merge_lora(params, adapters[1], 2.0), 5)
+assert mb.result(rb) == solo(params, 5)
+print("multi-LoRA OK: 2 adapters + base served in one batch, each equal "
+      "to its merged-params solo decode")

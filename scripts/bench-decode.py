@@ -207,8 +207,10 @@ def run_measurements(emit) -> None:
         for i in range(n_adapters)
     ]
     bank = stack_lora_bank(adapters)
-    # every row under a different adapter (row 0 the base) — the served mix
-    ad_idx = jnp.arange(B, dtype=jnp.int32) % (n_adapters + 1)
+    # all-adapter mix: every row under a DIFFERENT adapter (1..8; per-step
+    # cost is index-independent, but the labeled claim is 8 adapters/batch
+    # so all 8 must actually be in the batch)
+    ad_idx = 1 + jnp.arange(B, dtype=jnp.int32) % n_adapters
 
     def decode_lora_n(n_steps):
         return decode_chain(

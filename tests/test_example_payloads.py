@@ -60,3 +60,12 @@ async def test_checkpoint_resume_example(http_app):
     assert body["exit_code"] == 0, body["stderr"]
     assert "state-exact True" in body["stdout"]
     assert any("ckpt/3/" in path for path in body["files"]), body["files"]
+
+
+async def test_serving_features_example(http_app):
+    source = (EXAMPLES / "serving-features.py").read_text()
+    body = await post_execute(http_app, {"source_code": source, "timeout": 600})
+    assert body["exit_code"] == 0, body["stderr"]
+    for marker in ("stops+logprobs OK", "constrained decoding OK",
+                   "cancel OK", "multi-LoRA OK"):
+        assert marker in body["stdout"]

@@ -397,3 +397,8 @@ def test_cancel_frees_the_row_and_keeps_partial_output():
     # cancelling a finished request is a no-op, not an error
     b.cancel(r_keep)
     assert b.finish_reason(r_keep) == "length"
+
+
+def test_cancel_unknown_id_raises():
+    with pytest.raises(KeyError, match="unknown request"):
+        make_batcher().cancel(999)
