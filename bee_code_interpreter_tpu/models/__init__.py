@@ -27,3 +27,4 @@ from bee_code_interpreter_tpu.models.serving import (  # noqa: F401
     ContinuousBatcher,
     SamplingParams,
 )
+from bee_code_interpreter_tpu.models.engine import Engine  # noqa: F401

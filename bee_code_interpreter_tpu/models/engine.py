@@ -1,0 +1,266 @@
+"""Serving engine: a request queue in front of the continuous batcher.
+
+``ContinuousBatcher`` (models/serving.py) is deliberately mechanism-only:
+``submit`` raises when no row or not enough pages are free, and every
+example had to hand-roll the same admit-when-capacity-frees loop around
+it. This module is that loop as library code:
+
+- ``submit`` ALWAYS accepts (up to an optional queue bound) and returns a
+  ticket; admission into the batcher happens inside ``step`` the moment a
+  row AND enough pages are free — page-pool exhaustion is backpressure,
+  not an error.
+- Admission order is (priority desc, arrival order) — a plain FCFS queue
+  unless priorities are used. Head-of-line blocking is intentional: a
+  large request at the head is not starved by small ones behind it
+  (admitting out of order would let it wait forever under load).
+- ``new_tokens`` is the STREAMING read: tokens appended since the last
+  call for that ticket — poll it between steps to stream a response out.
+- ``cancel`` works on queued tickets (dropped before ever touching the
+  device, finish reason 'cancelled') and on admitted ones (proxied to the
+  batcher, pages freed mid-decode).
+
+The engine is host-side orchestration only — everything the device
+executes is still the batcher's fixed-shape programs. The reference has
+no serving stack at all (SURVEY §2).
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from bee_code_interpreter_tpu.models.serving import (
+    ContinuousBatcher,
+    SamplingParams,
+)
+
+
+@dataclass
+class _Queued:
+    prompt: object
+    max_new_tokens: int
+    sampling: SamplingParams | None
+    prefill_chunk: int | None
+    adapter: int | None
+    pages_needed: int = field(default=0)
+
+
+class Engine:
+    """Queue + admission loop over a ``ContinuousBatcher``.
+
+    ``max_queue`` bounds accepted-but-not-admitted requests (None =
+    unbounded); ``submit`` raises RuntimeError at the bound — the one
+    overload signal the caller must handle.
+    """
+
+    def __init__(self, batcher: ContinuousBatcher,
+                 max_queue: int | None = None) -> None:
+        self.batcher = batcher
+        self.max_queue = max_queue
+        # heap entries: (-priority, arrival seq, ticket, request);
+        # cancellation of a queued ticket is LAZY — the ticket leaves
+        # self._queued and its entry is skipped when it surfaces
+        self._heap: list[tuple[int, int, int, _Queued]] = []
+        self._seq = itertools.count()
+        self._ticket = itertools.count()
+        # ticket -> batcher request id (admitted), 'queued',
+        # 'cancelled', or ('error', msg) for an admission-time failure
+        self._state: dict[int, object] = {}
+        self._queued: set[int] = set()
+        self._stream_cursor: dict[int, int] = {}
+        self._holdback: dict[int, int] = {}
+
+    # ------------------------------------------------------------- intake
+    def submit(
+        self,
+        prompt,
+        max_new_tokens: int,
+        sampling: SamplingParams | None = None,
+        prefill_chunk: int | None = None,
+        adapter: int | None = None,
+        priority: int = 0,
+    ) -> int:
+        """Accept a request and return a ticket. Everything
+        capacity-independent (empty prompt, budget > block table, pages >
+        the whole pool, speculative sampling constraints, adapter range)
+        fails HERE via the batcher's own ``validate_request`` — a queued
+        request must not explode minutes later on an error the caller
+        could have seen at submit."""
+        prompt = np.asarray(prompt, dtype=np.int32).reshape(-1)
+        pages_needed = self.batcher.validate_request(
+            prompt, max_new_tokens, sampling=sampling, adapter=adapter
+        )
+        if self.max_queue is not None and len(self._queued) >= self.max_queue:
+            raise RuntimeError(f"queue full ({self.max_queue})")
+        req = _Queued(
+            prompt, max_new_tokens, sampling, prefill_chunk, adapter,
+            pages_needed=pages_needed,
+        )
+        ticket = next(self._ticket)
+        heapq.heappush(self._heap, (-priority, next(self._seq), ticket, req))
+        self._state[ticket] = "queued"
+        self._queued.add(ticket)
+        self._stream_cursor[ticket] = 0
+        # streaming holdback: while the request is live, the last
+        # (max stop length - 1) tokens stay unstreamed — a stop sequence
+        # completing later would TRIM tokens the stream had already
+        # emitted otherwise. At retirement the remainder flushes post-trim.
+        stops = sampling.stop_sequences if sampling is not None else ()
+        self._holdback[ticket] = max((len(s) for s in stops), default=1) - 1
+        return ticket
+
+    # -------------------------------------------------------------- admit
+    def _admit_ready(self) -> None:
+        while self._heap:
+            neg_prio, seq, ticket, req = self._heap[0]
+            if ticket not in self._queued:  # cancelled while queued
+                heapq.heappop(self._heap)
+                continue
+            if not self.batcher.has_free_row():
+                return
+            # page backpressure: strictly FCFS-within-priority — the head
+            # waits for ITS pages; smaller requests behind it do not jump
+            available = (
+                len(self.batcher.free_pages) + len(self.batcher.evictable)
+            )
+            if req.pages_needed > available:
+                return
+            heapq.heappop(self._heap)
+            self._queued.discard(ticket)
+            try:
+                rid = self.batcher.submit(
+                    req.prompt, req.max_new_tokens, sampling=req.sampling,
+                    prefill_chunk=req.prefill_chunk, adapter=req.adapter,
+                )
+            except RuntimeError:
+                # capacity race (e.g. prefix-matched pages changed the
+                # arithmetic): put it back and stop admitting this step
+                heapq.heappush(self._heap, (neg_prio, seq, ticket, req))
+                self._queued.add(ticket)
+                return
+            except Exception as e:
+                # validate_request ran at intake, so this "cannot happen";
+                # if it does anyway (validation drift), fail the ticket
+                # loudly-but-locally instead of wedging it in 'queued'
+                # forever and taking the whole step loop down
+                self._state[ticket] = ("error", repr(e))
+                continue
+            self._state[ticket] = rid
+
+    # --------------------------------------------------------------- step
+    def step(self) -> None:
+        """Admit whatever fits, then advance the batch one round."""
+        self._admit_ready()
+        self.batcher.step()
+        self._admit_ready()  # rows/pages freed by retirements this step
+
+    def run_to_completion(self, max_steps: int = 100_000) -> None:
+        for _ in range(max_steps):
+            if not self._queued and not self.batcher.active.any():
+                return
+            self.step()
+        raise RuntimeError("run_to_completion exceeded max_steps")
+
+    @property
+    def pending(self) -> int:
+        """Accepted-but-not-admitted request count (queue depth)."""
+        return len(self._queued)
+
+    # ------------------------------------------------------------ results
+    def _rid(self, ticket: int):
+        if ticket not in self._state:
+            raise KeyError(f"unknown ticket {ticket}")
+        return self._state[ticket]
+
+    def is_done(self, ticket: int) -> bool:
+        rid = self._rid(ticket)
+        if rid == "queued":
+            return False
+        if rid == "cancelled" or isinstance(rid, tuple):
+            return True
+        return self.batcher.is_done(rid)
+
+    def result(self, ticket: int) -> list[int]:
+        rid = self._rid(ticket)
+        if rid == "queued":
+            raise RuntimeError(f"ticket {ticket} still queued")
+        if rid == "cancelled" or isinstance(rid, tuple):
+            return []
+        return self.batcher.result(rid)
+
+    def result_logprobs(self, ticket: int) -> list[float]:
+        rid = self._rid(ticket)
+        if rid == "queued":
+            raise RuntimeError(f"ticket {ticket} still queued")
+        if rid == "cancelled" or isinstance(rid, tuple):
+            return []
+        return self.batcher.result_logprobs(rid)
+
+    def finish_reason(self, ticket: int) -> str:
+        rid = self._rid(ticket)
+        if rid == "queued":
+            raise RuntimeError(f"ticket {ticket} still queued")
+        if rid == "cancelled":
+            return "cancelled"
+        if isinstance(rid, tuple):
+            return "error"
+        return self.batcher.finish_reason(rid)
+
+    def ticket_error(self, ticket: int) -> str | None:
+        """repr of an admission-time failure (finish reason 'error' from
+        the engine itself) or the batcher's recorded callable error."""
+        rid = self._rid(ticket)
+        if isinstance(rid, tuple):
+            return rid[1]
+        if rid in ("queued", "cancelled"):
+            return None
+        return self.batcher.request_error(rid)
+
+    def new_tokens(self, ticket: int) -> list[int]:
+        """STREAMING read: tokens appended for this ticket since the last
+        ``new_tokens`` call (empty while queued). Poll between steps to
+        stream a response; the final chunk lands no later than the step
+        that finishes the request. While the request is live, the last
+        (max stop length - 1) tokens are held back so a stop sequence
+        completing later can never trim a token the stream already
+        emitted — the stream's concatenation always equals ``result``."""
+        rid = self._rid(ticket)
+        if rid in ("queued", "cancelled") or isinstance(rid, tuple):
+            return []
+        tokens = self.batcher.results.get(rid)
+        if tokens is None:  # released
+            return []
+        limit = (
+            len(tokens) if self.batcher.is_done(rid)
+            else max(0, len(tokens) - self._holdback[ticket])
+        )
+        cursor = self._stream_cursor[ticket]
+        if limit <= cursor:
+            return []
+        self._stream_cursor[ticket] = limit
+        return list(tokens[cursor:limit])
+
+    def cancel(self, ticket: int) -> None:
+        """Cancel queued (never touches the device) or admitted (pages
+        freed mid-decode) work; racing completion is a no-op."""
+        rid = self._rid(ticket)
+        if rid == "queued":
+            self._queued.discard(ticket)  # heap entry skipped lazily
+            self._state[ticket] = "cancelled"
+            self._stream_cursor.pop(ticket, None)
+            self._holdback.pop(ticket, None)
+            return
+        if rid != "cancelled" and not isinstance(rid, tuple):
+            self.batcher.cancel(rid)
+
+    def release(self, ticket: int) -> None:
+        rid = self._rid(ticket)
+        if rid == "queued":
+            raise RuntimeError(f"ticket {ticket} still queued")
+        if rid != "cancelled" and not isinstance(rid, tuple):
+            self.batcher.release(rid)
+        self._stream_cursor.pop(ticket, None)
+        self._holdback.pop(ticket, None)

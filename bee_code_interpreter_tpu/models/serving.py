@@ -456,6 +456,71 @@ class ContinuousBatcher:
     def has_free_row(self) -> bool:
         return bool((~self.active).any())
 
+    def validate_request(
+        self,
+        prompt,
+        max_new_tokens: int,
+        sampling: SamplingParams | None = None,
+        adapter: int | None = None,
+    ) -> int:
+        """Capacity-independent request validation; returns the page count
+        the request will need. The ONE copy of the admission arithmetic:
+        ``submit`` calls it first, and the serving engine
+        (models/engine.py) calls it at intake so a queued request can
+        never explode minutes later on an error the caller could have
+        seen at submit. Anything that passes here can fail admission only
+        TRANSIENTLY (rows/pages busy — RuntimeError), never permanently.
+        """
+        prompt = np.asarray(prompt, dtype=np.int32).reshape(-1)
+        L = int(prompt.shape[0])
+        if L < 1:
+            raise ValueError("prompt must be non-empty")
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        if adapter is not None:
+            if self.lora_bank is None:
+                raise ValueError(
+                    "no adapters configured (pass adapters= at construction)"
+                )
+            if not 0 <= adapter < self.n_adapters:
+                raise ValueError(
+                    f"adapter {adapter} out of range "
+                    f"(have {self.n_adapters})"
+                )
+        speculative = self.draft_params is not None
+        if speculative and sampling is not None and sampling.temperature > 0:
+            raise ValueError(
+                "speculative serving decodes greedily (draft-verify with "
+                "sampling needs rejection sampling, not implemented)"
+            )
+        if speculative and sampling is not None and sampling.steered:
+            raise ValueError(
+                "speculative serving cannot apply logit_bias/allowed_tokens "
+                "(draft-verify commits the target's unsteered argmax tokens)"
+            )
+        # speculative rounds write draft/verify K/V past the budget before
+        # truncation — those slots must be OWNED pages (a scratch-page read
+        # inside the still-visible window would corrupt the verify). An
+        # active row's cursor is at most L + budget - 2 (rows at budget
+        # retire), so the deepest window write is cursor + gamma:
+        # overshoot = gamma - 1 slots beyond L + budget.
+        overshoot = self.gamma - 1 if speculative else 0
+        total = L + max_new_tokens + overshoot
+        if total > self.max_len:
+            raise ValueError(
+                f"prompt+generation ({total}, incl. speculative overshoot "
+                f"{overshoot}) exceeds the block table's budget "
+                f"({self.max_len})"
+            )
+        n_need = -(-total // self.page_size)  # ceil
+        usable = self.page_ref.shape[0] - 1  # minus the scratch page
+        if n_need > usable:
+            raise ValueError(
+                f"request needs {n_need} pages but the pool only has "
+                f"{usable} (a permanent misfit, not backpressure)"
+            )
+        return n_need
+
     def submit(
         self,
         prompt,
@@ -482,52 +547,16 @@ class ContinuousBatcher:
         ``adapter`` serves this request under the i-th LoRA adapter the
         batcher was constructed with (None = the base model)."""
         prompt = np.asarray(prompt, dtype=np.int32).reshape(-1)
+        n_need = self.validate_request(
+            prompt, max_new_tokens, sampling=sampling, adapter=adapter
+        )
         L = int(prompt.shape[0])
-        if L < 1:
-            raise ValueError("prompt must be non-empty")
-        if max_new_tokens < 1:
-            raise ValueError("max_new_tokens must be >= 1")
-        if adapter is not None:
-            if self.lora_bank is None:
-                raise ValueError(
-                    "no adapters configured (pass adapters= at construction)"
-                )
-            if not 0 <= adapter < self.n_adapters:
-                raise ValueError(
-                    f"adapter {adapter} out of range "
-                    f"(have {self.n_adapters})"
-                )
         # internal index: 0 is the all-zeros base adapter in the bank
         adapter_internal = 0 if adapter is None else adapter + 1
         speculative = self.draft_params is not None
-        if speculative and sampling is not None and sampling.temperature > 0:
-            raise ValueError(
-                "speculative serving decodes greedily (draft-verify with "
-                "sampling needs rejection sampling, not implemented)"
-            )
-        if speculative and sampling is not None and sampling.steered:
-            raise ValueError(
-                "speculative serving cannot apply logit_bias/allowed_tokens "
-                "(draft-verify commits the target's unsteered argmax tokens)"
-            )
-        # speculative rounds write draft/verify K/V past the budget before
-        # truncation — those slots must be OWNED pages (a scratch-page read
-        # inside the still-visible window would corrupt the verify). An
-        # active row's cursor is at most L + budget - 2 (rows at budget
-        # retire), so the deepest window write is cursor + gamma:
-        # overshoot = gamma - 1 slots beyond L + budget.
-        overshoot = self.gamma - 1 if speculative else 0
-        total = L + max_new_tokens + overshoot
-        if total > self.max_len:
-            raise ValueError(
-                f"prompt+generation ({total}, incl. speculative overshoot "
-                f"{overshoot}) exceeds the block table's budget "
-                f"({self.max_len})"
-            )
         free_rows = np.flatnonzero(~self.active)
         if free_rows.size == 0:
             raise RuntimeError("no free batch row (step() until one frees)")
-        n_need = -(-total // self.page_size)  # ceil
         # Prefix match BEFORE allocating: matched pages come from the index
         # (a ref, not an allocation). The match is capped at (L-1)//ps full
         # pages so at least one suffix token remains — the admission must

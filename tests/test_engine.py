@@ -1,0 +1,210 @@
+"""The serving engine (models/engine.py): queueing, priorities, page
+backpressure, streaming reads, and cancellation over the continuous
+batcher — the admit-when-capacity-frees loop as library code, pinned
+against the same solo-decode bar as the batcher itself."""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+import jax
+
+from bee_code_interpreter_tpu.models.engine import Engine
+from bee_code_interpreter_tpu.models.serving import (
+    ContinuousBatcher,
+    SamplingParams,
+)
+from bee_code_interpreter_tpu.models.transformer import (
+    TransformerConfig,
+    init_params,
+)
+
+CFG = dataclasses.replace(TransformerConfig.tiny(), n_kv_heads=2)
+PARAMS = init_params(CFG, jax.random.PRNGKey(0))
+PROMPT = [5, 3, 7, 2, 9, 4, 1, 8]
+
+
+def make_engine(max_queue=None, **kw):
+    kw.setdefault("max_batch", 2)
+    kw.setdefault("n_pages", 24)
+    kw.setdefault("page_size", 4)
+    kw.setdefault("max_pages_per_seq", 8)
+    return Engine(ContinuousBatcher(PARAMS, CFG, **kw), max_queue=max_queue)
+
+
+def greedy(prompt, n):
+    b = ContinuousBatcher(PARAMS, CFG, max_batch=1, n_pages=24, page_size=4,
+                          max_pages_per_seq=8)
+    r = b.submit(prompt, n)
+    b.run_to_completion()
+    return b.result(r)
+
+
+def test_overload_queues_and_everyone_finishes_solo_equal():
+    """6 requests into a 2-row batch: the queue absorbs the overload and
+    every output still equals its solo decode."""
+    eng = make_engine()
+    prompts = [
+        [int(x) for x in np.random.default_rng(i).integers(0, 200, 5 + i)]
+        for i in range(6)
+    ]
+    tickets = [eng.submit(p, 4) for p in prompts]
+    assert eng.pending >= 4  # only 2 rows: the rest queued
+    eng.run_to_completion()
+    for t, p in zip(tickets, prompts):
+        assert eng.result(t) == greedy(p, 4)
+        assert eng.finish_reason(t) == "length"
+    assert eng.pending == 0
+
+
+def test_priority_admits_before_earlier_arrivals():
+    # all three are queued before the first step (admission happens in
+    # step, not submit): the high-priority one admits first, the other
+    # two in arrival order
+    eng = make_engine(max_batch=1)
+    t_first = eng.submit(PROMPT, 3)
+    t_normal = eng.submit([1, 2, 3], 3)
+    t_urgent = eng.submit([4, 5, 6], 3, priority=5)
+    order = []
+    seen = set()
+    for _ in range(60):
+        eng.step()
+        for t in (t_first, t_normal, t_urgent):
+            if eng.is_done(t) and t not in seen:
+                seen.add(t)
+                order.append(t)
+        if len(seen) == 3:
+            break
+    assert order == [t_urgent, t_first, t_normal]
+    assert eng.result(t_urgent) == greedy([4, 5, 6], 3)
+
+
+def test_page_backpressure_without_head_of_line_bypass():
+    """A big request at the head waits for ITS pages; the small one behind
+    it does NOT jump the line (no starvation of large requests)."""
+    eng = make_engine(max_batch=2, n_pages=9, max_pages_per_seq=8)
+    # 4 usable pages (9 minus scratch... 8): hold most of the pool
+    t_hold = eng.submit(PROMPT, 12)        # 8+12=20 -> 5 pages
+    t_big = eng.submit(PROMPT, 8)          # 8+8=16 -> 4 pages: must wait
+    t_small = eng.submit([1, 2], 2)        # 1 page: arrives later
+    eng.step()
+    # the big request is still queued AND the small one behind it too
+    assert not eng.is_done(t_big)
+    assert eng.pending == 2
+    eng.run_to_completion()
+    assert eng.result(t_hold) == greedy(PROMPT, 12)
+    assert eng.result(t_big) == greedy(PROMPT, 8)
+    assert eng.result(t_small) == greedy([1, 2], 2)
+
+
+def test_streaming_reads_concatenate_to_result():
+    eng = make_engine()
+    t = eng.submit(PROMPT, 6)
+    streamed = []
+    for _ in range(40):
+        streamed += eng.new_tokens(t)
+        if eng.is_done(t):
+            break
+        eng.step()
+    streamed += eng.new_tokens(t)
+    assert streamed == eng.result(t)
+    # incremental: the stream arrived in more than one chunk
+    assert len(streamed) == 6
+
+
+def test_streaming_holdback_never_disowns_under_stop_trim():
+    want = greedy(PROMPT, 10)
+    stop = (want[3], want[4])
+    eng = make_engine()
+    t = eng.submit(PROMPT, 10,
+                   sampling=SamplingParams(stop_sequences=(stop,)))
+    streamed = []
+    for _ in range(40):
+        streamed += eng.new_tokens(t)
+        if eng.is_done(t):
+            break
+        # every token streamed so far must survive into the final result
+        assert streamed == want[:len(streamed)][:3]
+        eng.step()
+    streamed += eng.new_tokens(t)
+    assert streamed == eng.result(t) == want[:3]
+    assert eng.finish_reason(t) == "stop"
+
+
+def test_cancel_queued_and_admitted():
+    eng = make_engine(max_batch=1)
+    t_active = eng.submit(PROMPT, 10)
+    t_queued = eng.submit([1, 2, 3], 5)
+    eng.cancel(t_queued)                      # never touches the device
+    assert eng.is_done(t_queued)
+    assert eng.finish_reason(t_queued) == "cancelled"
+    assert eng.result(t_queued) == []
+    eng.step()
+    eng.cancel(t_active)                      # mid-decode
+    assert eng.finish_reason(t_active) == "cancelled"
+    assert len(eng.result(t_active)) >= 1
+    # the queue entry was lazily dropped; nothing admits it later
+    t_next = eng.submit([7, 7], 3)
+    eng.run_to_completion()
+    assert eng.result(t_next) == greedy([7, 7], 3)
+    assert eng.pending == 0
+
+
+def test_queue_bound_and_validation_at_submit():
+    eng = make_engine(max_queue=1, max_batch=1)
+    eng.submit(PROMPT, 3)          # admitted at first step... still queued
+    eng.step()
+    eng.submit([1, 2], 3)          # queue slot 1
+    with pytest.raises(RuntimeError, match="queue full"):
+        eng.submit([3, 4], 3)
+    # validation errors fire at submit, not at admission
+    with pytest.raises(ValueError, match="exceeds the block table"):
+        eng.submit(PROMPT, 1000)
+    with pytest.raises(ValueError, match="non-empty"):
+        eng.submit([], 3)
+    with pytest.raises(KeyError, match="unknown ticket"):
+        eng.result(12345)
+
+
+def test_release_and_logprobs_proxy():
+    eng = make_engine()
+    t = eng.submit(PROMPT, 3, sampling=SamplingParams(logprobs=True))
+    eng.run_to_completion()
+    assert len(eng.result_logprobs(t)) == 3
+    eng.release(t)
+    assert eng.is_done(t)
+    assert eng.new_tokens(t) == []  # released: stream is empty, not an error
+
+
+def test_intake_validation_is_the_batchers():
+    """Engine.submit runs the batcher's validate_request: speculative
+    constraints and permanent pool misfits fail at INTAKE, never wedge a
+    queued ticket later."""
+    draft_cfg = dataclasses.replace(CFG, n_layers=1)
+    draft = init_params(draft_cfg, jax.random.PRNGKey(2))
+    spec = Engine(ContinuousBatcher(
+        PARAMS, CFG, max_batch=1, n_pages=24, page_size=4,
+        max_pages_per_seq=8, draft_params=draft, draft_config=draft_cfg,
+    ))
+    with pytest.raises(ValueError, match="decodes greedily"):
+        spec.submit(PROMPT, 3, sampling=SamplingParams(temperature=0.7))
+    with pytest.raises(ValueError, match="unsteered argmax"):
+        spec.submit(PROMPT, 3, sampling=SamplingParams(logit_bias={1: 5.0}))
+    # a request that can NEVER fit the pool is a ValueError at submit,
+    # not an eternally-queued head-of-line blocker
+    tiny_pool = make_engine(n_pages=4, max_pages_per_seq=8)
+    with pytest.raises(ValueError, match="permanent misfit"):
+        tiny_pool.submit(PROMPT, 12)  # 5 pages, pool has 3 usable
+
+
+def test_release_and_cancel_drop_streaming_state():
+    eng = make_engine()
+    t = eng.submit(PROMPT, 3)
+    eng.run_to_completion()
+    eng.result(t)
+    eng.release(t)
+    assert t not in eng._holdback and t not in eng._stream_cursor
+    t2 = eng.submit(PROMPT, 3)
+    eng.cancel(t2)  # cancelled while queued
+    assert t2 not in eng._holdback and t2 not in eng._stream_cursor

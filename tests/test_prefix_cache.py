@@ -187,16 +187,23 @@ def test_exhaustion_with_parked_prefix_pages_raises_cleanly():
     fresh-page budget: an admission that matches them but cannot get
     enough fresh pages raises the pool-exhausted error, releases its
     acquired refs, and leaves the pool able to serve the next request."""
-    # usable pages: 4 (5 minus scratch). First run uses all 4 then parks
-    # the 2 prefix pages in the LRU and frees the rest.
-    b = make_batcher(n_pages=5, max_pages_per_seq=8)
-    run_one(b, PROMPT, 3)  # total 13 -> 4 pages
-    assert len(b.free_pages) + len(b.evictable) == 4
-    # repeat prompt, bigger budget: matched=2, needs 4 fresh, only 2 exist
+    # usable pages: 6 (7 minus scratch). Park the 2 PROMPT prefix pages,
+    # then let an ACTIVE request hold the other 4 — a repeat PROMPT that
+    # fits the pool statically (validate_request passes) must still hit
+    # TRANSIENT exhaustion: its 2 matched pages leave 4 fresh needed with
+    # 0 actually free.
+    b = make_batcher(n_pages=7, max_pages_per_seq=8)
+    run_one(b, PROMPT, 3)  # total 13 -> 4 pages; retires, 2 parked
+    holder = b.submit([9, 8, 9, 8, 9], 10)  # total 15 -> 4 pages, ACTIVE
+    assert len(b.free_pages) == 0 and len(b.evictable) == 2
     with pytest.raises(RuntimeError, match="page pool exhausted"):
-        b.submit(PROMPT, 12)
-    assert (b.page_ref > 0).sum() == 0  # acquired refs were released
-    assert len(b.free_pages) + len(b.evictable) == 4  # nothing leaked
+        b.submit(PROMPT, 12)  # 6 pages <= 6 usable, but none free
+    # acquired refs were released: only the holder's 4 pages are held,
+    # and the 2 matched pages are parked again
+    assert (b.page_ref > 0).sum() == 4
+    assert len(b.evictable) == 2
+    b.run_to_completion()
+    b.result(holder)
     # and the pool still serves a request that fits
     want = run_one(make_batcher(prefix_cache=False), PROMPT, 3)
     assert run_one(b, PROMPT, 3) == want
