@@ -93,6 +93,8 @@ class Engine:
         pages_needed = self.batcher.validate_request(
             prompt, max_new_tokens, sampling=sampling, adapter=adapter
         )
+        if prefill_chunk is not None and prefill_chunk < 1:
+            raise ValueError(f"chunk must be >= 1, got {prefill_chunk}")
         if self.max_queue is not None and len(self._queued) >= self.max_queue:
             raise RuntimeError(f"queue full ({self.max_queue})")
         req = _Queued(
@@ -122,11 +124,17 @@ class Engine:
             if not self.batcher.has_free_row():
                 return
             # page backpressure: strictly FCFS-within-priority — the head
-            # waits for ITS pages; smaller requests behind it do not jump
+            # waits for ITS pages; smaller requests behind it do not jump.
+            # Prefix-cache credit counts: pages the submission would REUSE
+            # (held by sharing rows or parked) need no fresh allocation,
+            # so ignoring them would stall admissions the batcher accepts.
             available = (
                 len(self.batcher.free_pages) + len(self.batcher.evictable)
             )
-            if req.pages_needed > available:
+            fresh_needed = req.pages_needed - self.batcher.prefix_credit(
+                req.prompt, req.adapter
+            )
+            if fresh_needed > available:
                 return
             heapq.heappop(self._heap)
             self._queued.discard(ticket)

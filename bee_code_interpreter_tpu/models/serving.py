@@ -565,13 +565,8 @@ class ContinuousBatcher:
         hashes: list[bytes] = []
         shared: list[int] = []
         if self.prefix_cache_enabled:
-            hashes = self._chain_hashes(prompt, adapter_internal)
             self.prefix_stats["lookups"] += 1
-            for i in range(min(len(hashes), (L - 1) // self.page_size)):
-                page = self.prefix_index.get(hashes[i])
-                if page is None:
-                    break
-                shared.append(page)
+            hashes, shared = self._prefix_match(prompt, adapter_internal)
             matched = len(shared)
         # acquire refs on shared pages BEFORE measuring availability: a
         # matched page parked in the evictable LRU must neither count
@@ -850,6 +845,34 @@ class ContinuousBatcher:
         }
 
     # -------------------------------------------------- prefix-cache pages
+    def _prefix_match(
+        self, prompt: np.ndarray, adapter_internal: int
+    ) -> tuple[list[bytes], list[int]]:
+        """(chain hashes, currently-matched prefix pages) for a would-be
+        submission — the ONE copy of the match walk, shared by ``submit``
+        and ``prefix_credit``. The match is capped at (L-1)//ps full pages
+        so at least one suffix token remains."""
+        hashes = self._chain_hashes(prompt, adapter_internal)
+        shared: list[int] = []
+        limit = min(len(hashes), (int(prompt.shape[0]) - 1) // self.page_size)
+        for i in range(limit):
+            page = self.prefix_index.get(hashes[i])
+            if page is None:
+                break
+            shared.append(page)
+        return hashes, shared
+
+    def prefix_credit(self, prompt, adapter: int | None = None) -> int:
+        """Full prompt pages a submission would reuse from the prefix
+        index RIGHT NOW (0 with the cache off) — capacity planners
+        (models/engine.py) subtract this from a request's page need so
+        backpressure doesn't stall admissions the batcher would accept."""
+        if not self.prefix_cache_enabled:
+            return 0
+        prompt = np.asarray(prompt, dtype=np.int32).reshape(-1)
+        adapter_internal = 0 if adapter is None else adapter + 1
+        return len(self._prefix_match(prompt, adapter_internal)[1])
+
     def _chain_hashes(self, prompt: np.ndarray,
                       adapter_internal: int = 0) -> list[bytes]:
         """Chain hash after each FULL page of the prompt: ``hashes[i]``

@@ -208,3 +208,29 @@ def test_release_and_cancel_drop_streaming_state():
     t2 = eng.submit(PROMPT, 3)
     eng.cancel(t2)  # cancelled while queued
     assert t2 not in eng._holdback and t2 not in eng._stream_cursor
+
+
+def test_intake_validates_prefill_chunk():
+    with pytest.raises(ValueError, match="chunk must be"):
+        make_engine().submit(PROMPT, 3, prefill_chunk=0)
+
+
+def test_admission_counts_prefix_credit():
+    """A repeat prompt whose prefix pages are held by an ACTIVE sharing
+    row must admit on its fresh-page need alone — ignoring the credit
+    would stall it (and everything queued behind it) until the sharer
+    retires."""
+    long_prompt = PROMPT + [6, 2, 7, 1]  # 12 tokens: 2 matchable pages
+    eng = Engine(ContinuousBatcher(
+        PARAMS, CFG, max_batch=2, n_pages=7, page_size=4,
+        max_pages_per_seq=8, prefix_cache=True,
+    ))
+    t1 = eng.submit(long_prompt, 4)  # 12+4=16 -> 4 pages
+    eng.step()                       # t1 admitted; 2 of 6 usable pages free
+    assert eng.batcher.prefix_credit(long_prompt) == 2
+    t2 = eng.submit(long_prompt, 4)  # needs 4, credit 2 -> 2 fresh: fits NOW
+    eng.step()
+    assert eng.pending == 0          # admitted while t1 still active
+    assert eng.batcher.prefix_stats["hits"] == 1
+    eng.run_to_completion()
+    assert eng.result(t1) == eng.result(t2) == greedy(long_prompt, 4)
