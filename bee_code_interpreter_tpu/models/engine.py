@@ -227,6 +227,16 @@ class Engine:
             return None
         return self.batcher.request_error(rid)
 
+    def partial_result(self, ticket: int) -> list[int]:
+        """Tokens generated so far — safe at ANY time (empty while queued,
+        after cancellation of queued work, on an admission failure, or
+        after release). The streaming and text layers build on this
+        instead of poking at internal state."""
+        rid = self._rid(ticket)
+        if rid in ("queued", "cancelled") or isinstance(rid, tuple):
+            return []
+        return list(self.batcher.results.get(rid, ()))
+
     def new_tokens(self, ticket: int) -> list[int]:
         """STREAMING read: tokens appended for this ticket since the last
         ``new_tokens`` call (empty while queued). Poll between steps to
@@ -235,14 +245,11 @@ class Engine:
         (max stop length - 1) tokens are held back so a stop sequence
         completing later can never trim a token the stream already
         emitted — the stream's concatenation always equals ``result``."""
-        rid = self._rid(ticket)
-        if rid in ("queued", "cancelled") or isinstance(rid, tuple):
-            return []
-        tokens = self.batcher.results.get(rid)
-        if tokens is None:  # released
+        tokens = self.partial_result(ticket)
+        if not tokens:
             return []
         limit = (
-            len(tokens) if self.batcher.is_done(rid)
+            len(tokens) if self.is_done(ticket)
             else max(0, len(tokens) - self._holdback[ticket])
         )
         cursor = self._stream_cursor[ticket]

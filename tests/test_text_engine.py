@@ -1,0 +1,203 @@
+"""Text-level serving (models/text.py): stop strings across token
+boundaries, streaming with holdback, and finish-reason semantics — over a
+hermetic character tokenizer (the TextEngine contract is a tokenizer
+PROTOCOL: encode/decode; HF tokenizers satisfy it, tests don't need
+one)."""
+
+import dataclasses
+
+import pytest
+
+import jax
+
+from bee_code_interpreter_tpu.models.engine import Engine
+from bee_code_interpreter_tpu.models.serving import (
+    ContinuousBatcher,
+    SamplingParams,
+)
+from bee_code_interpreter_tpu.models.text import TextEngine
+from bee_code_interpreter_tpu.models.transformer import (
+    TransformerConfig,
+    init_params,
+)
+
+CFG = dataclasses.replace(TransformerConfig.tiny(), n_kv_heads=2)
+PARAMS = init_params(CFG, jax.random.PRNGKey(0))
+
+
+class CharTokenizer:
+    """chr/ord with a printable offset: hermetic, prefix-stable."""
+
+    def encode(self, text):
+        return [ord(ch) % CFG.vocab_size for ch in text]
+
+    def decode(self, tokens):
+        return "".join(chr(32 + (t % 94)) for t in tokens)
+
+
+def make_text_engine(**kw):
+    kw.setdefault("max_batch", 2)
+    kw.setdefault("n_pages", 24)
+    kw.setdefault("page_size", 4)
+    kw.setdefault("max_pages_per_seq", 8)
+    return TextEngine(
+        Engine(ContinuousBatcher(PARAMS, CFG, **kw)), CharTokenizer()
+    )
+
+
+PROMPT_TEXT = "hello tpu"
+
+
+def completion(n=10, **kw):
+    te = make_text_engine()
+    t = te.submit(PROMPT_TEXT, n, **kw)
+    te.run_to_completion()
+    return te, t
+
+
+def test_plain_completion_decodes_all_tokens():
+    te, t = completion(8)
+    assert len(te.text(t)) == 8
+    assert te.finish_reason(t) == "length"
+
+
+def test_stop_string_truncates_and_frees_pages():
+    te_full, t_full = completion(10)
+    full = te_full.text(t_full)
+    stop = full[4:6]  # chars 5-6 of the greedy completion
+    te, t = completion(10, stop=(stop,))
+    assert te.text(t) == full[: full.find(stop)]
+    assert te.finish_reason(t) == "stop"
+    # the underlying request was cancelled: its pages are free again
+    assert (te.engine.batcher.page_ref > 0).sum() == 0
+
+
+def test_multiple_stops_earliest_wins():
+    te_full, t_full = completion(10)
+    full = te_full.text(t_full)
+    early, late = full[2], full[6]
+    te, t = completion(10, stop=(late, early))
+    cut = min(full.find(early), full.find(late))
+    assert te.text(t) == full[:cut]
+
+
+def test_streaming_equals_text_and_respects_holdback():
+    te_full, t_full = completion(10)
+    full = te_full.text(t_full)
+    stop = full[5:8]  # 3-char stop -> holdback 2
+    te = make_text_engine()
+    t = te.submit(PROMPT_TEXT, 10, stop=(stop,))
+    streamed = ""
+    for _ in range(40):
+        streamed += te.new_text(t)
+        if te.is_done(t):
+            break
+        # nothing emitted may ever be clawed back by the eventual stop
+        assert streamed == full[: len(streamed)]
+        te.step()
+    streamed += te.new_text(t)
+    assert streamed == te.text(t) == full[:5]
+
+
+def test_stop_inside_prompt_is_not_matched():
+    """Stops apply to the COMPLETION, not the prompt text."""
+    te = make_text_engine()
+    t = te.submit(PROMPT_TEXT, 5, stop=(PROMPT_TEXT[:3],))
+    te.run_to_completion()
+    # may or may not stop depending on the completion, but it must not
+    # be the empty string purely because the PROMPT contained the stop
+    reason = te.finish_reason(t)
+    assert reason in ("stop", "length")
+    if reason == "length":
+        assert len(te.text(t)) == 5
+
+
+def test_sampling_and_engine_kwargs_pass_through():
+    te = make_text_engine()
+    t = te.submit(
+        PROMPT_TEXT, 6,
+        sampling=SamplingParams(temperature=0.9, seed=7), priority=2,
+    )
+    te.run_to_completion()
+    first = te.text(t)
+    te2 = make_text_engine()
+    t2 = te2.submit(
+        PROMPT_TEXT, 6, sampling=SamplingParams(temperature=0.9, seed=7)
+    )
+    te2.run_to_completion()
+    assert te2.text(t2) == first  # same seed -> same text
+
+
+def test_validation():
+    te = make_text_engine()
+    with pytest.raises(ValueError, match="non-empty"):
+        te.submit(PROMPT_TEXT, 5, stop=("",))
+    with pytest.raises(TypeError, match="tokenizer"):
+        TextEngine(te.engine, object())
+    with pytest.raises(KeyError, match="unknown ticket"):
+        te.text(999)
+    t = te.submit(PROMPT_TEXT, 3)
+    with pytest.raises(RuntimeError, match="still generating"):
+        te.text(t)
+
+
+def test_release_keeps_reason_drops_text():
+    te, t = completion(8)
+    assert te.finish_reason(t) == "length"
+    te.release(t)
+    assert te.finish_reason(t) == "length"  # recorded, survives release
+    with pytest.raises(KeyError):
+        te.text(t)
+    assert t not in te._final and t not in te._emitted
+
+
+def test_stop_reason_survives_release_of_cancelled_request():
+    te_full, t_full = completion(10)
+    full = te_full.text(t_full)
+    te, t = completion(10, stop=(full[4:6],))
+    assert te.finish_reason(t) == "stop"
+    te.release(t)
+    assert te.finish_reason(t) == "stop"
+
+
+class UnstableTailTokenizer(CharTokenizer):
+    """Byte-level-BPE-shaped: token 77 is a CONTINUATION — alone at the
+    tail it decodes to U+FFFD; followed by any token the pair becomes
+    one character. Decodes are prefix-stable except for that tail."""
+
+    def decode(self, tokens):
+        out = []
+        i = 0
+        while i < len(tokens):
+            if tokens[i] == 77:
+                if i + 1 < len(tokens):
+                    out.append("@")  # the completed pair
+                    i += 2
+                    continue
+                out.append("�")  # incomplete at the tail
+                i += 1
+                continue
+            out.append(chr(32 + (tokens[i] % 94)))
+            i += 1
+        return "".join(out)
+
+
+def test_streaming_holds_back_unstable_decode_tail():
+    """A U+FFFD decode tail (incomplete byte-level sequence) must not be
+    streamed: the stream's concatenation equals text() even though the
+    tail later re-decodes to a different character."""
+    te = TextEngine(
+        Engine(ContinuousBatcher(PARAMS, CFG, max_batch=1, n_pages=24,
+                                 page_size=4, max_pages_per_seq=8)),
+        UnstableTailTokenizer(),
+    )
+    t = te.submit(PROMPT_TEXT, 8)
+    streamed = ""
+    for _ in range(40):
+        streamed += te.new_text(t)
+        assert "�" not in streamed  # never emit a torn character
+        if te.is_done(t):
+            break
+        te.step()
+    streamed += te.new_text(t)
+    assert streamed == te.text(t)
