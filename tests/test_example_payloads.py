@@ -69,3 +69,11 @@ async def test_serving_features_example(http_app):
     for marker in ("stops+logprobs OK", "constrained decoding OK",
                    "cancel OK", "multi-LoRA OK"):
         assert marker in body["stdout"]
+
+
+async def test_hf_weights_text_serving_example(http_app):
+    source = (EXAMPLES / "hf-weights-text-serving.py").read_text()
+    body = await post_execute(http_app, {"source_code": source, "timeout": 600})
+    assert body["exit_code"] == 0, body["stderr"]
+    for marker in ("hf parity OK", "text serving OK", "stop strings OK"):
+        assert marker in body["stdout"]
