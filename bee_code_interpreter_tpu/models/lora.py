@@ -97,6 +97,14 @@ def merge_lora(params: Params, lora: Params, scale: float = 1.0) -> Params:
     """Base params with ``W + scale·A@B`` folded into each target — an
     ordinary params pytree for the unchanged forward/decode paths.
     ``scale`` is the standard alpha/rank."""
+    from bee_code_interpreter_tpu.ops.weight_quant import is_quantized
+
+    if any(is_quantized(params["layers"].get(t)) for t in lora):
+        # folding a rank-r delta into int8 would re-quantize the base on
+        # every merge; the supported order is merge THEN quantize
+        raise NotImplementedError(
+            "merge_lora needs fp base weights (quantize AFTER merging)"
+        )
     layers = dict(params["layers"])
     for t, ab in lora.items():
         delta = jnp.einsum("lir,lro->lio", ab["A"], ab["B"]) * scale

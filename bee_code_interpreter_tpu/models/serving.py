@@ -319,6 +319,19 @@ class ContinuousBatcher:
         # pytrees too would double adapter memory for the server's life
         self.n_adapters = len(adapters) if adapters else 0
         if adapters:
+            from bee_code_interpreter_tpu.ops.weight_quant import (
+                any_quantized,
+            )
+
+            if any_quantized(params):
+                # adapter admission prefills through merge_lora, which adds
+                # the rank-r delta into fp base weights; folding into int8
+                # would re-quantize per admission. Quantize AFTER merging,
+                # or serve adapters on the fp base.
+                raise NotImplementedError(
+                    "multi-LoRA serving needs fp base weights "
+                    "(weight-only-quantized params refuse adapters)"
+                )
             from bee_code_interpreter_tpu.models.lora import stack_lora_bank
 
             self.lora_bank = stack_lora_bank(list(adapters))

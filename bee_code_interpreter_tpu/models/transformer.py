@@ -157,6 +157,22 @@ def rope(
     return rotated.astype(x.dtype)
 
 
+def qeinsum(spec: str, x: jax.Array, leaf, dtype) -> jax.Array:
+    """Einsum against a weight LEAF that is either a plain array or a
+    weight-only-int8 dict ({"q", "s"} — ops/weight_quant.py). Quantized
+    leaves compute ``(x @ q) * s``: the per-out-channel scale applied as
+    the matmul epilogue (exact algebra), so the int8→compute-dtype convert
+    fuses into the dot and no dequantized copy materializes. The ONE
+    dispatch point every dense projection in forward/decode shares, which
+    is why the quantized pytree is a drop-in everywhere at once."""
+    from bee_code_interpreter_tpu.ops.weight_quant import is_quantized
+
+    if is_quantized(leaf):
+        y = jnp.einsum(spec, x, leaf["q"].astype(dtype))
+        return (y * leaf["s"]).astype(dtype)
+    return jnp.einsum(spec, x, leaf.astype(dtype))
+
+
 # ------------------------------------------------------------------- weights
 
 
@@ -238,6 +254,17 @@ def _stack(spec: P) -> P:
 
 
 def shard_params(params: Params, config: TransformerConfig, mesh: Mesh) -> Params:
+    from bee_code_interpreter_tpu.ops.weight_quant import any_quantized
+
+    if any_quantized(params):
+        # the Megatron spec table maps one PartitionSpec per fp leaf; a
+        # {'q','s'} pair needs its own (spec, out-axis-only spec) pair —
+        # not built yet. Refuse clearly: quantized pytrees are the
+        # SINGLE-CHIP serving path; shard fp weights for multi-chip.
+        raise NotImplementedError(
+            "shard_params needs fp weights (weight-only-quantized pytrees "
+            "are single-chip serving params; shard the fp pytree instead)"
+        )
     specs = param_specs(config, mesh)
     return jax.tree.map(
         lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), params, specs,
@@ -348,7 +375,7 @@ def _layer_apply(
     dh, nh, kvh = c.head_dim, c.n_heads, c.kv_heads
 
     def proj(w, heads):
-        out = jnp.einsum("bld,dk->blk", x, w.astype(c.dtype))
+        out = qeinsum("bld,dk->blk", x, w, c.dtype)
         return out.reshape(B, L, heads, dh).transpose(0, 2, 1, 3)
 
     q = rope(proj(layer["wq"], nh), positions, c.rope_theta, c.rope_scaling)
@@ -358,7 +385,7 @@ def _layer_apply(
     # GQA-native: compact k/v go in as-is
     attn = _attention(q, k, v, mesh, c.sp_attention, window=c.sliding_window)
     attn = attn.transpose(0, 2, 1, 3).reshape(B, L, nh * dh)
-    h = h + constrain(jnp.einsum("blk,kd->bld", attn, layer["wo"].astype(c.dtype)))
+    h = h + constrain(qeinsum("blk,kd->bld", attn, layer["wo"], c.dtype))
 
     y = rms_norm(h, layer["ln2"])
     mlp, aux = _mlp_block(y, layer, c)
@@ -383,11 +410,9 @@ def _mlp_block(
             capacity_factor=c.moe_capacity_factor, dtype=c.dtype,
             group_size=c.moe_group_size,
         )
-    gate = jnp.einsum("bld,df->blf", y, layer["w_gate"].astype(c.dtype))
-    up = jnp.einsum("bld,df->blf", y, layer["w_up"].astype(c.dtype))
-    mlp = jnp.einsum(
-        "blf,fd->bld", jax.nn.silu(gate) * up, layer["w_down"].astype(c.dtype)
-    )
+    gate = qeinsum("bld,df->blf", y, layer["w_gate"], c.dtype)
+    up = qeinsum("bld,df->blf", y, layer["w_up"], c.dtype)
+    mlp = qeinsum("blf,fd->bld", jax.nn.silu(gate) * up, layer["w_down"], c.dtype)
     return mlp, jnp.float32(0.0)
 
 
@@ -449,7 +474,7 @@ def forward(
 
     h, (kv, aux_layers) = lax.scan(layer_step, h, params["layers"])
     h = rms_norm(h, params["ln_f"])
-    logits = jnp.einsum("bld,dv->blv", h, params["lm_head"].astype(c.dtype))
+    logits = qeinsum("bld,dv->blv", h, params["lm_head"], c.dtype)
     logits = logits.astype(jnp.float32)
     extras = []
     if return_kv:
@@ -524,7 +549,7 @@ def forward_pipelined(
         with_aux=True,
     )
     h = rms_norm(h, params["ln_f"])
-    logits = jnp.einsum("bld,dv->blv", h, params["lm_head"].astype(c.dtype))
+    logits = qeinsum("bld,dv->blv", h, params["lm_head"], c.dtype)
     logits = logits.astype(jnp.float32)
     if return_aux:
         return logits, aux
@@ -639,7 +664,7 @@ def decode_window(
         dh, nh, kvh = c.head_dim, c.n_heads, c.kv_heads
 
         def proj(w, heads):
-            out = jnp.einsum("bld,dk->blk", x, w.astype(c.dtype))
+            out = qeinsum("bld,dk->blk", x, w, c.dtype)
             return out.reshape(B, W, heads, dh).transpose(0, 2, 1, 3)
 
         q = rope(
@@ -673,7 +698,7 @@ def decode_window(
         attn = jnp.einsum("bgrws,bgsd->bgrwd", weights, vf)
         attn = attn.transpose(0, 3, 1, 2, 4).reshape(B, W, nh * dh)
         h = h + constrain(
-            jnp.einsum("blk,kd->bld", attn, layer["wo"].astype(c.dtype))
+            qeinsum("blk,kd->bld", attn, layer["wo"], c.dtype)
         )
 
         y = rms_norm(h, layer["ln2"])
@@ -683,7 +708,7 @@ def decode_window(
 
     h, cache = lax.scan(layer_step, h, (params["layers"], cache))
     h = rms_norm(h, params["ln_f"])
-    logits = jnp.einsum("bld,dv->blv", h, params["lm_head"].astype(c.dtype))
+    logits = qeinsum("bld,dv->blv", h, params["lm_head"], c.dtype)
     return logits.astype(jnp.float32), cache
 
 
@@ -789,7 +814,7 @@ def decode_window_paged(
             ) * jnp.asarray(lora_scale, c.dtype)
 
         def proj(w, heads, name):
-            out = jnp.einsum("bld,dk->blk", x, w.astype(c.dtype))
+            out = qeinsum("bld,dk->blk", x, w, c.dtype)
             delta = lora_delta(x, name)
             if delta is not None:
                 out = out + delta
@@ -823,7 +848,7 @@ def decode_window_paged(
         weights = jax.nn.softmax(scores, axis=-1).astype(c.dtype)
         attn = jnp.einsum("bgrws,bgsd->bgrwd", weights, vf)
         attn = attn.transpose(0, 3, 1, 2, 4).reshape(B, W, nh * dh)
-        o = jnp.einsum("blk,kd->bld", attn, layer["wo"].astype(c.dtype))
+        o = qeinsum("blk,kd->bld", attn, layer["wo"], c.dtype)
         delta_o = lora_delta(attn, "wo")
         if delta_o is not None:
             o = o + delta_o
@@ -840,7 +865,7 @@ def decode_window_paged(
     )
     h, cache = lax.scan(layer_step, h, scanned)
     h = rms_norm(h, params["ln_f"])
-    logits = jnp.einsum("bld,dv->blv", h, params["lm_head"].astype(c.dtype))
+    logits = qeinsum("bld,dv->blv", h, params["lm_head"], c.dtype)
     return logits.astype(jnp.float32), cache
 
 

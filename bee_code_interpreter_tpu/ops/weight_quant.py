@@ -1,0 +1,96 @@
+"""Weight-only int8 quantization for the decode family.
+
+Decode is HBM-bandwidth-bound: every step streams the full parameter set
+to produce one token per row, so halving the bytes per weight is (up to)
+a 2x decode speedup before any kernel work. This module quantizes the
+matmul weights to symmetric per-OUT-CHANNEL int8:
+
+    W[..., in, out]  ->  {"q": int8 same shape, "s": f32 [..., out]}
+    with  W ≈ q * s[..., None, :],  s = max|W| per out column / 127
+
+and the compute path (``transformer.qeinsum``) evaluates
+
+    y = (x @ q.astype(compute_dtype)) * s
+
+— the scale applied as a matmul EPILOGUE, exact algebra for per-out
+scales, so the int8→bf16 convert fuses into the dot read and no
+dequantized weight copy ever materializes in HBM. Activations and the
+KV cache are untouched (w8a16; the int8 KV cache in ops/kv_cache.py
+composes independently).
+
+What quantizes: the seven dense projection weights per layer and the
+lm_head. What doesn't: embeddings (a gather, not a matmul), norms
+(1-D), MoE expert weights (expert matmuls route through moe.py), LoRA
+banks (rank-r deltas are tiny and applied on the raw activations —
+multi-LoRA serving composes with a quantized base). Quantize AFTER
+``merge_lora`` if folding adapters.
+
+Every decode/forward path takes the quantized pytree interchangeably
+with the fp one (the ``qeinsum`` dispatch is per leaf), so the
+cross-path exactness pins (decode == forward, batched == solo) hold
+verbatim ON the quantized model; closeness TO the fp model is a
+quantization-quality property, tested with tolerances. The reference
+has no model runtime at all (SURVEY §2).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax.numpy as jnp
+
+Params = dict[str, Any]
+
+DEFAULT_TARGETS = (
+    "wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down", "lm_head",
+)
+
+
+def is_quantized(leaf) -> bool:
+    """THE quantized-leaf predicate — the schema lives here; every
+    consumer (qeinsum dispatch, serving/lora guards, sharding refusal)
+    imports this instead of duck-typing the dict shape itself."""
+    return isinstance(leaf, dict) and "q" in leaf and "s" in leaf
+
+
+def any_quantized(params: Params) -> bool:
+    layers = params.get("layers", {})
+    return is_quantized(params.get("lm_head")) or any(
+        is_quantized(leaf) for leaf in layers.values()
+    )
+
+
+def quantize_weight(w: jnp.ndarray) -> dict:
+    """One weight [..., d_in, d_out] -> {"q": int8, "s": f32 [..., d_out]}
+    (symmetric, per out column; stacked [n_layers, ...] leaves keep their
+    leading axis on both leaves, so lax.scan slices them together)."""
+    amax = jnp.max(jnp.abs(w), axis=-2, keepdims=True)
+    s = jnp.where(amax > 0, amax / 127.0, 1.0).astype(jnp.float32)
+    q = (
+        jnp.clip(jnp.round(w.astype(jnp.float32) / s), -127, 127)
+        .astype(jnp.int8)
+    )
+    return {"q": q, "s": s.squeeze(-2)}
+
+
+def quantize_weights(
+    params: Params, targets: tuple[str, ...] = DEFAULT_TARGETS
+) -> Params:
+    """The params pytree with every ``targets`` matmul weight quantized —
+    drop-in for forward/decode/serving (see module docstring)."""
+    out = dict(params)
+    out["layers"] = {
+        name: quantize_weight(leaf) if name in targets else leaf
+        for name, leaf in params["layers"].items()
+    }
+    if "lm_head" in targets and "lm_head" in params:
+        out["lm_head"] = quantize_weight(params["lm_head"])
+    return out
+
+
+def quantized_nbytes(params: Params) -> int:
+    """Total bytes of every array leaf (dicts included) — the memory
+    claim's receipt."""
+    import jax
+
+    return sum(x.nbytes for x in jax.tree.leaves(params))

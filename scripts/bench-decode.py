@@ -181,6 +181,42 @@ def run_measurements(emit) -> None:
         ),
     })
 
+    # --- weight-only int8: decode streams half the parameter bytes ------
+    # (x @ q)*s epilogue form — ops/weight_quant.py; the win is pure HBM
+    # bandwidth, so the speedup is the honest measure of how much of the
+    # decode step the parameter stream actually is.
+    from bee_code_interpreter_tpu.ops.weight_quant import quantize_weights
+
+    qparams = quantize_weights(params)
+    results_q = {}
+    for name in ("bf16", "int8"):
+        cfg = dataclasses.replace(config, kv_cache_dtype=name)
+        cache0 = init_decode_cache(cfg, B, ctx, k_pre, v_pre)
+
+        def decode_q_n(n_steps, cfg=cfg):
+            return decode_chain(
+                lambda tok, pos, cache: decode_step(
+                    qparams, tok, pos, cache, cfg
+                ),
+                n_steps,
+            )
+
+        t_qn = best_of(decode_q_n(N), first, cache0)
+        t_q1 = best_of(decode_q_n(1), first, cache0)
+        results_q[name] = chain_diff(t_qn, t_q1, N)
+    emit("w8a16_decode", {
+        "per_step_ms": round(results_q["bf16"] * 1e3, 3),
+        "tokens_per_sec": round(B / results_q["bf16"], 1),
+        "speedup_vs_fp_weights": round(
+            per_step["bf16"] / results_q["bf16"], 2
+        ),
+        "with_int8_kv_per_step_ms": round(results_q["int8"] * 1e3, 3),
+        "with_int8_kv_tokens_per_sec": round(B / results_q["int8"], 1),
+        "with_int8_kv_speedup_vs_fp_bf16": round(
+            per_step["bf16"] / results_q["int8"], 2
+        ),
+    })
+
     # --- multi-LoRA serving: heterogeneous adapters riding the same paged
     # program (models/serving.py). The delta is unmerged per row, so the
     # overhead prices two rank-r einsums per target per layer — the

@@ -1,0 +1,142 @@
+"""Weight-only int8 quantization (ops/weight_quant.py + the qeinsum
+dispatch in models/transformer.py).
+
+Two distinct claims, tested separately:
+
+1. EXACTNESS ACROSS PATHS on the same quantized pytree: forward, cached
+   decode, and the paged batcher all route weights through the one
+   qeinsum dispatch, so the cross-path pins (decode == forward token
+   stream, batched == solo) hold verbatim on the quantized model.
+2. CLOSENESS TO THE FP MODEL: a quantization-quality property — int8
+   per-out-channel keeps logits near and argmax mostly unchanged; it is
+   never exact and is asserted with tolerances.
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from bee_code_interpreter_tpu.models.serving import ContinuousBatcher
+from bee_code_interpreter_tpu.models.transformer import (
+    Transformer,
+    TransformerConfig,
+    forward,
+    init_params,
+    qeinsum,
+)
+from bee_code_interpreter_tpu.ops.weight_quant import (
+    quantize_weight,
+    quantize_weights,
+    quantized_nbytes,
+)
+
+CFG = dataclasses.replace(TransformerConfig.tiny(), n_kv_heads=2)
+PARAMS = init_params(CFG, jax.random.PRNGKey(0))
+QPARAMS = quantize_weights(PARAMS)
+PROMPT = [5, 3, 7, 2, 9, 4, 1, 8]
+TOKENS = jnp.asarray([PROMPT], dtype=jnp.int32)
+
+
+def test_qeinsum_epilogue_is_exact_algebra():
+    w = jax.random.normal(jax.random.PRNGKey(1), (32, 16), jnp.float32)
+    x = jax.random.normal(jax.random.PRNGKey(2), (2, 4, 32), jnp.float32)
+    leaf = quantize_weight(w)
+    got = qeinsum("bld,dk->blk", x, leaf, jnp.float32)
+    # dequantize-first oracle: x @ (q * s) — per-out scales commute with
+    # the contraction, so the epilogue form must match to float noise
+    dequant = leaf["q"].astype(jnp.float32) * leaf["s"][None, :]
+    want = jnp.einsum("bld,dk->blk", x, dequant)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_quantization_halves_weight_bytes():
+    fp = quantized_nbytes(PARAMS)
+    q = quantized_nbytes(QPARAMS)
+    # embeddings/norms stay fp; the seven projections + lm_head drop 4x
+    # (f32 masters -> int8+scales), so total must shrink well past half
+    assert q < 0.5 * fp
+    leaf = QPARAMS["layers"]["wq"]
+    assert leaf["q"].dtype == jnp.int8
+    assert leaf["s"].dtype == jnp.float32
+    assert leaf["q"].shape == PARAMS["layers"]["wq"].shape
+    assert leaf["s"].shape == PARAMS["layers"]["wq"].shape[:-2] + (
+        PARAMS["layers"]["wq"].shape[-1],
+    )
+    # non-targets untouched
+    assert not isinstance(QPARAMS["layers"]["ln1"], dict)
+    assert not isinstance(QPARAMS["embed"], dict)
+
+
+def test_quantized_model_is_close_to_fp():
+    f32 = dataclasses.replace(CFG, dtype=jnp.float32)
+    lg_fp = np.asarray(forward(PARAMS, TOKENS, f32))
+    lg_q = np.asarray(forward(QPARAMS, TOKENS, f32))
+    # quality, not exactness: logits near, argmax mostly unchanged
+    scale = np.abs(lg_fp).max()
+    assert np.abs(lg_q - lg_fp).max() < 0.25 * scale
+    agree = (lg_q.argmax(-1) == lg_fp.argmax(-1)).mean()
+    assert agree >= 0.75, agree
+
+
+def test_cross_path_exactness_on_quantized_params():
+    """generate_cached and the paged batcher on the SAME qparams produce
+    identical tokens — the serving pins hold verbatim quantized."""
+    model = Transformer(CFG)
+    solo = np.asarray(model.generate_cached(
+        QPARAMS, TOKENS, max_new_tokens=6
+    )[0, len(PROMPT):]).tolist()
+    b = ContinuousBatcher(QPARAMS, CFG, max_batch=2, n_pages=24,
+                          page_size=4, max_pages_per_seq=8)
+    r = b.submit(PROMPT, 6)
+    r2 = b.submit([3, 1, 4, 1, 5], 4)  # a batch-mate changes nothing
+    b.run_to_completion()
+    assert b.result(r) == solo
+    assert len(b.result(r2)) == 4
+
+
+def test_quantized_with_int8_kv_cache_and_prefix_cache():
+    cfg = dataclasses.replace(CFG, kv_cache_dtype="int8")
+    qp = quantize_weights(init_params(cfg, jax.random.PRNGKey(3)))
+
+    def run():
+        b = ContinuousBatcher(qp, cfg, max_batch=2, n_pages=24,
+                              page_size=4, max_pages_per_seq=8,
+                              prefix_cache=True)
+        out = []
+        for _ in range(2):
+            req = b.submit(PROMPT, 5)
+            b.run_to_completion()
+            out.append(b.result(req))
+        return out, b.prefix_stats["hits"]
+
+    first, hits = run()
+    second, _ = run()
+    assert first == second          # deterministic
+    assert first[0] == first[1]     # prefix hit changes nothing
+    assert hits == 1
+
+
+def test_quantized_refuses_adapters():
+    from bee_code_interpreter_tpu.models.lora import init_lora
+
+    lora = init_lora(CFG, jax.random.PRNGKey(5), rank=4)
+    with pytest.raises(NotImplementedError, match="fp base"):
+        ContinuousBatcher(QPARAMS, CFG, adapters=[lora])
+
+
+def test_sharding_and_merge_refuse_quantized_with_clear_errors():
+    from bee_code_interpreter_tpu.models.lora import init_lora, merge_lora
+    from bee_code_interpreter_tpu.models.transformer import shard_params
+    from bee_code_interpreter_tpu.parallel import make_mesh
+
+    lora = init_lora(CFG, jax.random.PRNGKey(5), rank=4)
+    with pytest.raises(NotImplementedError, match="quantize AFTER merging"):
+        merge_lora(QPARAMS, lora)
+    mesh = make_mesh({"tp": 2}, devices=jax.devices()[:2])
+    with pytest.raises(NotImplementedError, match="single-chip"):
+        shard_params(QPARAMS, CFG, mesh)
