@@ -238,6 +238,49 @@ def choose_host(
     return sample_host(logits, params, rng)
 
 
+def rejection_sample_commit(
+    proposals,  # gamma draft proposals, x_g ~ q_dists[g]
+    q_dists,  # gamma FILTERED draft distributions [V]
+    p_fn,  # g -> FILTERED target distribution [V], g in [0, gamma]
+    rng: np.random.Generator,
+) -> tuple[list[int], int]:
+    """Leviathan et al. rejection sampling for one verify window: accept
+    proposal x with probability min(1, p(x)/q(x)); the first rejection
+    resamples from normalize(max(p - q, 0)); a fully-accepted window
+    draws its bonus token from the last target distribution. Returns
+    (committed tokens, accepted proposal count). Target distributions
+    come through ``p_fn`` LAZILY — a rejection at position k never pays
+    for the filters beyond k+1. Acceptance uses strict ``<`` so a token
+    outside the target's filtered support (p(x) == 0) can never commit,
+    whatever ``rng.random()`` returns.
+
+    The guarantee — each committed token is distributed EXACTLY per its
+    target distribution, whatever the draft proposed — is pinned
+    distributionally by tests/test_speculative_sampling.py against this
+    function directly (end-to-end token marginals mix too many
+    conditionals for statistical power)."""
+    commit: list[int] = []
+    n = 0
+    for g, x in enumerate(proposals):
+        p_dist, q_dist = p_fn(g), q_dists[g]
+        x = int(x)
+        if q_dist[x] > 0 and rng.random() < min(
+            1.0, float(p_dist[x] / q_dist[x])
+        ):
+            commit.append(x)
+            n += 1
+            continue
+        resid = np.maximum(p_dist - q_dist, 0.0)
+        total = float(resid.sum())
+        if total <= 0.0:  # p == q pointwise: resample from p directly
+            resid, total = p_dist, float(p_dist.sum())
+        commit.append(int(rng.choice(resid.shape[0], p=resid / total)))
+        return commit, n
+    p_last = p_fn(len(proposals))
+    commit.append(int(rng.choice(p_last.shape[0], p=p_last)))
+    return commit, n
+
+
 class ContinuousBatcher:
     """Admit → step → collect loop over ``decode_step_paged``.
 
@@ -270,10 +313,13 @@ class ContinuousBatcher:
         scores each row's window in ONE ``decode_window_paged`` pass, and
         each row commits its own accept length — per-row cursors mean no
         lockstep minimum across the batch (the continuous-batching
-        advantage over ``speculative_generate``'s static batch). Exactness
-        per request is the same greedy draft-verify guarantee, pinned by
-        tests/test_serving.py. Speculative rows must decode greedily
-        (draft-verify with sampling is rejection-sampling territory).
+        advantage over ``speculative_generate``'s static batch). Greedy
+        rows carry the exact draft-verify guarantee (pinned by
+        tests/test_serving.py); sampled rows decode via REJECTION
+        SAMPLING (see ``_step_speculative_sampled``) — distributed
+        exactly as plain sampled decoding from the target. Bias and
+        allowed_tokens constraints remain unsupported in speculative
+        mode.
 
         ``prefix_cache=True`` turns on vLLM-style prompt prefix caching:
         full prompt pages are content-addressed by chain hash and shared
@@ -501,11 +547,6 @@ class ContinuousBatcher:
                     f"(have {self.n_adapters})"
                 )
         speculative = self.draft_params is not None
-        if speculative and sampling is not None and sampling.temperature > 0:
-            raise ValueError(
-                "speculative serving decodes greedily (draft-verify with "
-                "sampling needs rejection sampling, not implemented)"
-            )
         if speculative and sampling is not None and sampling.steered:
             raise ValueError(
                 "speculative serving cannot apply logit_bias/allowed_tokens "
@@ -1005,11 +1046,17 @@ class ContinuousBatcher:
         The draft runs γ paged decode steps (each one compiled program over
         the whole batch); the target scores every row's (current + drafts)
         window in ONE ``decode_window_paged``; each row then commits its
-        own longest matching prefix plus the target's correction token —
-        rows never wait for each other (no lockstep minimum). Rejected
-        draft positions stay in both pools as stale K/V, invisible behind
-        each row's cursor until overwritten — the same no-rewind masking
-        argument as ``speculative_generate``, applied per row.
+        own accepted prefix plus a correction token — rows never wait for
+        each other (no lockstep minimum). Rejected draft positions stay in
+        both pools as stale K/V, invisible behind each row's cursor until
+        overwritten — the same no-rewind masking argument as
+        ``speculative_generate``, applied per row.
+
+        An all-greedy batch runs the exact argmax draft-verify with the
+        draft loop fully on device; the moment any active row samples, the
+        round routes through ``_step_speculative_sampled`` (rejection
+        sampling, host-in-the-loop proposals) for the whole batch — greedy
+        rows keep argmax semantics there, token for token.
 
         Known draft-quality (not correctness) gap, shared with the
         contiguous ``speculative_generate``: on a fully-accepted round the
@@ -1018,6 +1065,12 @@ class ContinuousBatcher:
         steps see zeros at that slot (pages are zeroed at admission —
         deterministic, pool-history-independent). The target verify is
         unaffected; only draft acceptance on those rows can dip."""
+        active_rows = np.flatnonzero(self.active)
+        if any(
+            self.row_sampling[row].temperature > 0.0 for row in active_rows
+        ):
+            self._step_speculative_sampled(active_rows)
+            return
         bt = jnp.asarray(self.block_table)
         pos_dev = jnp.asarray(self.pos)
         cur = jnp.asarray(self.current)
@@ -1042,7 +1095,6 @@ class ContinuousBatcher:
             jnp.argmax(t_logits, axis=-1), dtype=np.int32
         )  # [B, gamma+1]
         drafts_np = np.asarray(drafts_dev, dtype=np.int32)
-        active_rows = np.flatnonzero(self.active)
         # full verify logits cross to host only when some row records
         # logprobs (commit[j]'s distribution is t_logits[row, j] — the
         # target's prediction for the token following window position j)
@@ -1056,21 +1108,103 @@ class ContinuousBatcher:
             match = drafts_np[row] == t_pred[row, : self.gamma]
             n = int(np.argmin(match)) if not match.all() else self.gamma
             commit = [*drafts_np[row, :n].tolist(), int(t_pred[row, n])]
-            req = int(self.row_request[row])
-            out = self.results[req]
-            lp = (
-                self.results_logprobs.get(req)
-                if self.row_sampling[row].logprobs else None
+            self._commit_row(row, commit, n, t_np)
+
+    def _commit_row(self, row, commit, n, t_np) -> None:
+        """Land one speculative round's committed tokens for a row —
+        per-token stop checks, logprobs off the verify logits, cursor
+        advance by accepted+1, retirement. The ONE copy shared by the
+        greedy and sampled rounds so their semantics cannot drift."""
+        sp = self.row_sampling[row]
+        req = int(self.row_request[row])
+        out = self.results[req]
+        lp = self.results_logprobs.get(req) if sp.logprobs else None
+        for j, tok_committed in enumerate(commit):
+            out.append(int(tok_committed))
+            if lp is not None:
+                lp.append(logprob_of(t_np[row, j], int(tok_committed)))
+            if self._done_reason(row, out) is not None:
+                break  # later commits would exceed the stop — drop them
+        self.pos[row] += n + 1
+        self.current[row, 0] = int(commit[-1])
+        self._retire_if_done(row)
+
+    def _step_speculative_sampled(self, active_rows) -> None:
+        """Speculative round with SAMPLED rows: rejection sampling
+        (Leviathan et al., "Fast Inference from Transformers via
+        Speculative Decoding"). Per position, with p and q the row's
+        FILTERED target/draft distributions (temperature + top-k/top-p
+        applied to both via the one ``filtered_probs_host``):
+
+        - the proposal x ~ q is accepted with probability min(1, p(x)/q(x));
+        - the first rejection resamples from normalize(max(p - q, 0));
+        - a fully-accepted window draws its bonus token from the target's
+          last distribution.
+
+        The committed stream is distributed exactly as plain sampled
+        decoding from the target — the distributional pin lives in
+        tests/test_speculative_sampling.py; same-seed determinism and
+        batch-mate isolation are pinned there too. Greedy rows in the
+        same batch keep the exact argmax draft-verify semantics.
+
+        Proposals are sampled host-side from each draft step's logits
+        with the row's own seeded generator, so the draft loop pays one
+        device->host [B, V] transfer per gamma — the target still scores
+        the whole window in ONE pass, which is the speedup that matters."""
+        bt = jnp.asarray(self.block_table)
+        pos_dev = jnp.asarray(self.pos)
+        cur = jnp.asarray(self.current)
+        B = self.current.shape[0]
+        gamma = self.gamma
+
+        drafts_np = np.zeros((B, gamma), dtype=np.int32)
+        q_dists: dict[int, list] = {int(r): [] for r in active_rows}
+        tok, p = cur, pos_dev
+        for g in range(gamma):
+            lg, self.draft_cache = self._draft_decode(
+                self.draft_params, tok, p, self.draft_cache, bt
             )
-            for j, tok_committed in enumerate(commit):
-                out.append(int(tok_committed))
-                if lp is not None:
-                    lp.append(logprob_of(t_np[row, j], int(tok_committed)))
-                if self._done_reason(row, out) is not None:
-                    break  # later commits would exceed the stop — drop them
-            self.pos[row] += n + 1
-            self.current[row, 0] = int(t_pred[row, n])
-            self._retire_if_done(row)
+            lg_np = np.asarray(lg[:, -1, :], dtype=np.float32)
+            # one transfer per step: greedy + idle rows propose host argmax
+            drafts_np[:, g] = lg_np.argmax(-1).astype(np.int32)
+            for row in active_rows:
+                sp = self.row_sampling[row]
+                if sp.temperature > 0.0:
+                    q = filtered_probs_host(lg_np[row], sp)
+                    drafts_np[row, g] = int(
+                        self.row_rng[row].choice(q.shape[0], p=q)
+                    )
+                    q_dists[int(row)].append(q)
+                else:
+                    q_dists[int(row)].append(None)
+            tok = jnp.asarray(drafts_np[:, g: g + 1])
+            p = p + 1
+
+        window = jnp.concatenate([cur, jnp.asarray(drafts_np)], axis=1)
+        t_logits, self.cache = self._verify(
+            self.params, window, pos_dev, self.cache, bt,
+            **self._lora_kwargs(self.row_adapter),
+        )
+        t_np = np.asarray(t_logits, dtype=np.float32)  # [B, gamma+1, V]
+
+        for row in active_rows:
+            sp = self.row_sampling[row]
+            rng = self.row_rng[row]
+            if sp.temperature <= 0.0:
+                preds = t_np[row].argmax(-1).astype(np.int32)
+                match = drafts_np[row] == preds[:gamma]
+                n = int(np.argmin(match)) if not match.all() else gamma
+                commit = [*drafts_np[row, :n].tolist(), int(preds[n])]
+            else:
+                commit, n = rejection_sample_commit(
+                    drafts_np[row].tolist(),
+                    q_dists[int(row)],
+                    lambda g, row=row, sp=sp: filtered_probs_host(
+                        t_np[row, g], sp
+                    ),
+                    rng,
+                )
+            self._commit_row(row, commit, n, t_np)
 
     def _done_reason(self, row: int, out: list[int]) -> tuple[str, int] | None:
         """(finish_reason, tokens_to_trim) once a row's output is complete,

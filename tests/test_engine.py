@@ -187,10 +187,13 @@ def test_intake_validation_is_the_batchers():
         PARAMS, CFG, max_batch=1, n_pages=24, page_size=4,
         max_pages_per_seq=8, draft_params=draft, draft_config=draft_cfg,
     ))
-    with pytest.raises(ValueError, match="decodes greedily"):
-        spec.submit(PROMPT, 3, sampling=SamplingParams(temperature=0.7))
     with pytest.raises(ValueError, match="unsteered argmax"):
         spec.submit(PROMPT, 3, sampling=SamplingParams(logit_bias={1: 5.0}))
+    # sampled speculative is SUPPORTED (rejection sampling) — intake
+    # accepts it and the request completes
+    t = spec.submit(PROMPT, 3, sampling=SamplingParams(temperature=0.7))
+    spec.run_to_completion()
+    assert len(spec.result(t)) == 3
     # a request that can NEVER fit the pool is a ValueError at submit,
     # not an eternally-queued head-of-line blocker
     tiny_pool = make_engine(n_pages=4, max_pages_per_seq=8)

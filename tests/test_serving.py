@@ -443,9 +443,15 @@ def test_speculative_serving_validations():
         params, config, max_batch=1, n_pages=16, page_size=4,
         max_pages_per_seq=4, draft_params=dparams, draft_config=draft_cfg(),
     )
-    with pytest.raises(ValueError, match="greedily"):
+    # sampled speculative is supported since round 4 (rejection sampling,
+    # tests/test_speculative_sampling.py); steering is still refused
+    with pytest.raises(ValueError, match="unsteered argmax"):
         b.submit(np.asarray([1, 2]), 3,
+                 sampling=SamplingParams(logit_bias={1: 5.0}))
+    r = b.submit(np.asarray([1, 2]), 3,
                  sampling=SamplingParams(temperature=1.0))
+    b.run_to_completion()
+    assert len(b.result(r)) == 3
 
 
 def test_speculative_serving_eos_stops_early():

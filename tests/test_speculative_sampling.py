@@ -1,0 +1,186 @@
+"""Speculative decoding with SAMPLING in the continuous batcher
+(rejection sampling, models/serving._step_speculative_sampled).
+
+The headline claim is distributional: the committed stream of a sampled
+speculative request is distributed exactly as plain sampled decoding from
+the target — rejection sampling's guarantee. That cannot be pinned
+token-for-token (the rng is consumed differently), and end-to-end token
+marginals mix too many first-token conditionals for statistical power at
+test-sized n, so this file pins:
+
+1. the token LAW of the rejection kernel itself, with 20k synthetic
+   trials against adversarially different p/q and a skew control that
+   proves the tolerance bites (the algorithm-level guarantee);
+2. same-seed determinism end to end;
+3. greedy rows batched WITH sampled rows keep the exact draft-verify
+   token stream (batch-mate isolation), and top_k=1 sampling reduces to
+   it exactly;
+4. stops/logprobs/finish reasons compose; pages are conserved.
+"""
+
+import dataclasses
+from collections import Counter
+
+import numpy as np
+import pytest
+
+import jax
+
+from bee_code_interpreter_tpu.models.serving import (
+    ContinuousBatcher,
+    SamplingParams,
+)
+from bee_code_interpreter_tpu.models.transformer import (
+    TransformerConfig,
+    init_params,
+)
+
+CFG = dataclasses.replace(TransformerConfig.tiny(), n_kv_heads=2)
+PARAMS = init_params(CFG, jax.random.PRNGKey(0))
+DRAFT_CFG = dataclasses.replace(CFG, n_layers=1)
+DRAFT = init_params(DRAFT_CFG, jax.random.PRNGKey(2))
+PROMPT = [5, 3, 7, 2, 9, 4, 1, 8]
+
+
+def make_batcher(speculative=True, **kw):
+    kw.setdefault("max_batch", 2)
+    kw.setdefault("n_pages", 32)
+    kw.setdefault("page_size", 4)
+    kw.setdefault("max_pages_per_seq", 8)
+    if speculative:
+        kw.update(draft_params=DRAFT, draft_config=DRAFT_CFG, gamma=3)
+    return ContinuousBatcher(PARAMS, CFG, **kw)
+
+
+def run_one(b, n, sampling):
+    r = b.submit(PROMPT, n, sampling=sampling)
+    b.run_to_completion()
+    return b.result(r)
+
+
+def _norm(v):
+    return v / v.sum()
+
+
+def test_rejection_kernel_token_law():
+    """The distributional guarantee, pinned at the algorithm level where
+    statistical power is cheap (end-to-end token marginals mix too many
+    first-token conditionals to distinguish anything at test-sized n):
+    across 20k trials with ADVERSARIALLY different synthetic p/q, the
+    first committed token's law equals p0 (TV < 0.02, sampling noise at
+    this n/support is ~0.01), the second committed token given an accept
+    equals p1, and a skew control shows the tolerance bites."""
+    from bee_code_interpreter_tpu.models.serving import (
+        rejection_sample_commit,
+    )
+
+    V, gamma, n_trials = 12, 3, 20_000
+    master = np.random.default_rng(0)
+    p_dists = [_norm(master.random(V) + 0.05) for _ in range(gamma + 1)]
+    q_dists = [_norm(master.random(V) ** 2 + 0.01) for _ in range(gamma)]
+
+    first = Counter()
+    second = Counter()
+    accepted_any = 0
+    for i in range(n_trials):
+        rng = np.random.default_rng(1000 + i)
+        proposals = [int(rng.choice(V, p=q)) for q in q_dists]
+        commit, n = rejection_sample_commit(
+            proposals, q_dists, lambda g: p_dists[g], rng
+        )
+        first[commit[0]] += 1
+        if n >= 1:
+            accepted_any += 1
+            second[commit[1]] += 1
+
+    emp0 = np.array([first[t] for t in range(V)]) / n_trials
+    tv0 = 0.5 * np.abs(emp0 - p_dists[0]).sum()
+    assert tv0 < 0.02, tv0
+    emp1 = np.array([second[t] for t in range(V)]) / max(accepted_any, 1)
+    tv1 = 0.5 * np.abs(emp1 - p_dists[1]).sum()
+    assert tv1 < 0.03, tv1
+    # control: the same tolerance rejects the DRAFT's law — the kernel is
+    # provably not just passing proposals through
+    tv_q = 0.5 * np.abs(emp0 - q_dists[0]).sum()
+    assert tv_q > 0.05, tv_q
+    # and acceptance actually happens (the speedup exists)
+    assert 0.2 < accepted_any / n_trials < 0.98
+
+
+def test_rejection_kernel_identical_dists_always_accepts():
+    from bee_code_interpreter_tpu.models.serving import (
+        rejection_sample_commit,
+    )
+
+    V, gamma = 8, 4
+    master = np.random.default_rng(3)
+    dists = [_norm(master.random(V) + 0.1) for _ in range(gamma + 1)]
+    for i in range(200):
+        rng = np.random.default_rng(i)
+        proposals = [int(rng.choice(V, p=q)) for q in dists[:gamma]]
+        commit, n = rejection_sample_commit(
+            proposals, dists[:gamma], lambda g: dists[g], rng
+        )
+        # p == q: min(1, p/q) == 1 at every proposed token
+        assert n == gamma
+        assert commit[:gamma] == proposals
+        assert len(commit) == gamma + 1
+
+
+def test_same_seed_is_deterministic():
+    sp = SamplingParams(temperature=0.9, top_k=20, seed=42)
+    out1 = run_one(make_batcher(), 8, sp)
+    out2 = run_one(make_batcher(), 8, sp)
+    assert out1 == out2
+    assert len(out1) == 8
+
+
+def test_greedy_batchmate_keeps_exact_draft_verify():
+    want = run_one(make_batcher(), 6, SamplingParams())  # all-greedy path
+    b = make_batcher()
+    r_greedy = b.submit(PROMPT, 6)
+    r_sampled = b.submit([3, 1, 4, 1, 5], 6,
+                         sampling=SamplingParams(temperature=1.0, seed=3))
+    b.run_to_completion()
+    assert b.result(r_greedy) == want  # sampled batch-mate changes nothing
+    assert len(b.result(r_sampled)) == 6
+
+
+def test_top_k_filter_applies_to_both_sides():
+    """top_k=1 sampling is greedy-by-filter: accepted proposals and
+    resamples can only ever pick the target argmax, so the output equals
+    the greedy stream exactly."""
+    want = run_one(make_batcher(), 6, SamplingParams())
+    got = run_one(make_batcher(), 6,
+                  SamplingParams(temperature=0.8, top_k=1, seed=11))
+    assert got == want
+
+
+def test_stops_logprobs_and_reasons_compose():
+    sp = SamplingParams(temperature=1.0, seed=5, logprobs=True)
+    b = make_batcher()
+    r = b.submit(PROMPT, 8, sampling=sp)
+    b.run_to_completion()
+    out = b.result(r)
+    lps = b.result_logprobs(r)
+    assert len(lps) == len(out) == 8
+    assert all(np.isfinite(lps))
+    assert b.finish_reason(r) == "length"
+    # stop sequence on the deterministic (seeded) sampled stream
+    stop = (out[3], out[4])
+    b2 = make_batcher()
+    r2 = b2.submit(PROMPT, 8, sampling=dataclasses.replace(
+        sp, stop_sequences=(stop,)))
+    b2.run_to_completion()
+    assert b2.result(r2) == out[:3]
+    assert b2.finish_reason(r2) == "stop"
+    assert len(b2.result_logprobs(r2)) == 3
+
+
+def test_pages_accounted_after_sampled_speculative():
+    b = make_batcher()
+    free0 = len(b.free_pages)
+    for seed in range(4):
+        run_one(b, 5, SamplingParams(temperature=1.1, seed=seed))
+    assert len(b.free_pages) == free0
+    assert not b.active.any()
