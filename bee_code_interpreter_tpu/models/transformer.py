@@ -254,21 +254,29 @@ def _stack(spec: P) -> P:
 
 
 def shard_params(params: Params, config: TransformerConfig, mesh: Mesh) -> Params:
-    from bee_code_interpreter_tpu.ops.weight_quant import any_quantized
+    """Place params per ``param_specs``. Weight-only-quantized leaves
+    ({'q','s'} — ops/weight_quant.py) shard too: q takes the fp weight's
+    spec verbatim, and s (per-out-channel, shape = weight shape minus the
+    contracted axis) takes the spec with the d_in axis dropped — so a
+    tp-column-sharded weight keeps its scales on the same shards and
+    qeinsum's epilogue multiply stays local (no collective)."""
+    from bee_code_interpreter_tpu.ops.weight_quant import is_quantized
 
-    if any_quantized(params):
-        # the Megatron spec table maps one PartitionSpec per fp leaf; a
-        # {'q','s'} pair needs its own (spec, out-axis-only spec) pair —
-        # not built yet. Refuse clearly: quantized pytrees are the
-        # SINGLE-CHIP serving path; shard fp weights for multi-chip.
-        raise NotImplementedError(
-            "shard_params needs fp weights (weight-only-quantized pytrees "
-            "are single-chip serving params; shard the fp pytree instead)"
-        )
     specs = param_specs(config, mesh)
+
+    def place(x, spec):
+        if is_quantized(x):
+            s_spec = P(*spec[:-2], spec[-1])
+            return {
+                "q": jax.device_put(x["q"], NamedSharding(mesh, spec)),
+                "s": jax.device_put(x["s"], NamedSharding(mesh, s_spec)),
+            }
+        return jax.device_put(x, NamedSharding(mesh, spec))
+
     return jax.tree.map(
-        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), params, specs,
-        is_leaf=lambda x: isinstance(x, jnp.ndarray) or hasattr(x, "shape"),
+        place, params, specs,
+        is_leaf=lambda x: is_quantized(x)
+        or isinstance(x, jnp.ndarray) or hasattr(x, "shape"),
     )
 
 

@@ -129,14 +129,30 @@ def test_quantized_refuses_adapters():
         ContinuousBatcher(QPARAMS, CFG, adapters=[lora])
 
 
-def test_sharding_and_merge_refuse_quantized_with_clear_errors():
+def test_merge_refuses_quantized_with_clear_error():
     from bee_code_interpreter_tpu.models.lora import init_lora, merge_lora
-    from bee_code_interpreter_tpu.models.transformer import shard_params
-    from bee_code_interpreter_tpu.parallel import make_mesh
 
     lora = init_lora(CFG, jax.random.PRNGKey(5), rank=4)
     with pytest.raises(NotImplementedError, match="quantize AFTER merging"):
         merge_lora(QPARAMS, lora)
+
+
+def test_quantized_params_shard_and_match_unsharded():
+    """tp-sharded quantized forward == unsharded quantized forward: q
+    takes the fp weight's Megatron spec, the per-out scales ride the same
+    shards (d_in axis dropped from the spec), so the qeinsum epilogue
+    stays local."""
+    from bee_code_interpreter_tpu.models.transformer import shard_params
+    from bee_code_interpreter_tpu.parallel import make_mesh
+
     mesh = make_mesh({"tp": 2}, devices=jax.devices()[:2])
-    with pytest.raises(NotImplementedError, match="single-chip"):
-        shard_params(QPARAMS, CFG, mesh)
+    sharded = shard_params(QPARAMS, CFG, mesh)
+    leaf = sharded["layers"]["wq"]
+    assert leaf["q"].sharding.spec[-1] == "tp"
+    assert leaf["s"].sharding.spec[-1] == "tp"
+    # f32 compute so the only difference is the tp reduction split (bf16
+    # reduction-order noise would need a sloppy tolerance)
+    f32 = dataclasses.replace(CFG, dtype=jnp.float32)
+    lg_sharded = np.asarray(forward(sharded, TOKENS, f32, mesh))
+    lg_local = np.asarray(forward(QPARAMS, TOKENS, f32, None))
+    np.testing.assert_allclose(lg_sharded, lg_local, atol=1e-4, rtol=1e-4)
