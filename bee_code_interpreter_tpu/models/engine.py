@@ -177,6 +177,18 @@ class Engine:
         """Accepted-but-not-admitted request count (queue depth)."""
         return len(self._queued)
 
+    @property
+    def stats(self) -> dict:
+        """The batcher's operator counters plus queue depth, with the
+        request counts at TICKET level (a queued ticket exists before the
+        batcher ever sees it)."""
+        st = {**self.batcher.stats, "queued": len(self._queued)}
+        st["requests_submitted"] = len(self._state)
+        st["requests_finished"] = sum(
+            1 for t in self._state if self.is_done(t)
+        )
+        return st
+
     # ------------------------------------------------------------ results
     def _rid(self, ticket: int):
         if ticket not in self._state:

@@ -425,6 +425,7 @@ class ContinuousBatcher:
         self.row_sampling: list[SamplingParams | None] = [None] * max_batch
         self.row_rng: list[np.random.Generator | None] = [None] * max_batch
         self._next_request_id = 0
+        self.n_tokens_generated = 0
         self.free_pages = list(range(n_pages - 1, _SCRATCH_PAGE, -1))
         # Prefix cache (vLLM-style, host-side bookkeeping only): pages
         # holding a FULL page of prompt K/V are content-addressed by the
@@ -729,6 +730,7 @@ class ContinuousBatcher:
         self.row_sampling[row] = sampling
         self.row_rng[row] = rng
         self.results[req] = [first]
+        self.n_tokens_generated += 1
         if sampling.logprobs:
             self.results_logprobs[req] = [logprob_of(last_row, first)]
         self.done[req] = False
@@ -1034,6 +1036,7 @@ class ContinuousBatcher:
             self.pos[row] += 1
             self.current[row, 0] = nxt
             self.results[req_row].append(nxt)
+            self.n_tokens_generated += 1
             if sp.logprobs:
                 self.results_logprobs[req_row].append(
                     logprob_of(lg[row], nxt)
@@ -1121,6 +1124,7 @@ class ContinuousBatcher:
         lp = self.results_logprobs.get(req) if sp.logprobs else None
         for j, tok_committed in enumerate(commit):
             out.append(int(tok_committed))
+            self.n_tokens_generated += 1
             if lp is not None:
                 lp.append(logprob_of(t_np[row, j], int(tok_committed)))
             if self._done_reason(row, out) is not None:
@@ -1254,6 +1258,24 @@ class ContinuousBatcher:
         # pos stays for inspection; scratch-page writes are masked
 
     # -------------------------------------------------------------- results
+    @property
+    def stats(self) -> dict:
+        """Operator counters — occupancy, page accounting, lifetime
+        totals, prefix-cache stats. Cheap to read every scrape; a serving
+        loop exports these however it likes (the service's Prometheus
+        registry, logs, ...)."""
+        return {
+            "active_rows": int(self.active.sum()),
+            "max_batch": int(self.active.shape[0]),
+            "free_pages": len(self.free_pages),
+            "parked_pages": len(self.evictable),
+            "held_pages": int((self.page_ref > 0).sum()),
+            "requests_submitted": self._next_request_id,
+            "requests_finished": sum(1 for v in self.done.values() if v),
+            "tokens_generated": self.n_tokens_generated,
+            "prefix_cache": dict(self.prefix_stats),
+        }
+
     def is_done(self, request_id: int) -> bool:
         return self.done.get(request_id, False)
 

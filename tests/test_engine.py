@@ -237,3 +237,20 @@ def test_admission_counts_prefix_credit():
     assert eng.batcher.prefix_stats["hits"] == 1
     eng.run_to_completion()
     assert eng.result(t1) == eng.result(t2) == greedy(long_prompt, 4)
+
+
+def test_stats_surface():
+    eng = make_engine(max_batch=1)
+    t1 = eng.submit(PROMPT, 3)
+    t2 = eng.submit([1, 2, 3], 3)
+    eng.step()
+    st = eng.stats
+    assert st["active_rows"] == 1 and st["queued"] == 1
+    assert st["requests_submitted"] == 2
+    eng.run_to_completion()
+    st = eng.stats
+    assert st["requests_finished"] == 2
+    assert st["tokens_generated"] == 6
+    assert st["active_rows"] == 0 and st["queued"] == 0
+    assert st["held_pages"] == 0
+    assert eng.result(t1) and eng.result(t2)
