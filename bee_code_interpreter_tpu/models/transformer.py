@@ -84,6 +84,13 @@ class TransformerConfig:
     # flash kernels skip fully-out-of-window blocks; single-shard/tp meshes
     # only (the sp ring/Ulysses paths don't thread the window).
     sliding_window: int | None = None
+    # Single-token paged decode through the Pallas paged-attention kernel
+    # (ops/paged_attention.py): pages read IN PLACE via scalar-prefetched
+    # block tables instead of paged_read's gather (which materializes a
+    # contiguous cache copy every step). Applies to decode_step_paged
+    # (W == 1) on bf16 pools with full causal attention; other shapes and
+    # the int8 pool keep the einsum path.
+    paged_attention_kernel: bool = False
 
     @property
     def kv_heads(self) -> int:
@@ -837,25 +844,40 @@ def decode_window_paged(
             v_new.transpose(0, 2, 1, 3),
             page_idx, slot_idx,
         )
-        kf, vf = paged_read(c_layer, block_table, c.dtype)  # [B, kvh, S, dh]
-
-        rep = nh // kvh
-        qg = q.reshape(B, kvh, rep, W, dh).astype(jnp.float32)
-        scores = jnp.einsum("bgrwd,bgsd->bgrws", qg, kf) / math.sqrt(dh)
-        # row (b, w) sees cache positions s <= pos0_b + w (and within the
-        # sliding window when configured)
-        visible = (
-            jnp.arange(S)[None, None, :] <= positions[:, :, None]
-        )  # [B, W, S]
-        if c.sliding_window is not None:
-            visible &= (
-                jnp.arange(S)[None, None, :]
-                > positions[:, :, None] - c.sliding_window
+        if (
+            c.paged_attention_kernel and W == 1
+            and "k_s" not in c_layer and c.sliding_window is None
+        ):
+            # in-place page reads: no gathered cache copy (see the config
+            # field / ops/paged_attention.py)
+            from bee_code_interpreter_tpu.ops.paged_attention import (
+                paged_decode_attention,
             )
-        scores = jnp.where(visible[:, None, None, :, :], scores, -jnp.inf)
-        weights = jax.nn.softmax(scores, axis=-1).astype(c.dtype)
-        attn = jnp.einsum("bgrws,bgsd->bgrwd", weights, vf)
-        attn = attn.transpose(0, 3, 1, 2, 4).reshape(B, W, nh * dh)
+
+            attn = paged_decode_attention(
+                q[:, :, 0, :], c_layer["k"], c_layer["v"], block_table,
+                positions[:, 0] + 1,
+            ).reshape(B, 1, nh * dh).astype(c.dtype)
+        else:
+            kf, vf = paged_read(c_layer, block_table, c.dtype)  # [B,kvh,S,dh]
+
+            rep = nh // kvh
+            qg = q.reshape(B, kvh, rep, W, dh).astype(jnp.float32)
+            scores = jnp.einsum("bgrwd,bgsd->bgrws", qg, kf) / math.sqrt(dh)
+            # row (b, w) sees cache positions s <= pos0_b + w (and within
+            # the sliding window when configured)
+            visible = (
+                jnp.arange(S)[None, None, :] <= positions[:, :, None]
+            )  # [B, W, S]
+            if c.sliding_window is not None:
+                visible &= (
+                    jnp.arange(S)[None, None, :]
+                    > positions[:, :, None] - c.sliding_window
+                )
+            scores = jnp.where(visible[:, None, None, :, :], scores, -jnp.inf)
+            weights = jax.nn.softmax(scores, axis=-1).astype(c.dtype)
+            attn = jnp.einsum("bgrws,bgsd->bgrwd", weights, vf)
+            attn = attn.transpose(0, 3, 1, 2, 4).reshape(B, W, nh * dh)
         o = qeinsum("blk,kd->bld", attn, layer["wo"], c.dtype)
         delta_o = lora_delta(attn, "wo")
         if delta_o is not None:

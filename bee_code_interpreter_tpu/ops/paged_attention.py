@@ -1,0 +1,170 @@
+"""Pallas paged-attention decode kernel: K/V pages read IN PLACE.
+
+The einsum decode path (``ops/paged_kv_cache.paged_read`` +
+``models/transformer.decode_window_paged``) gathers each row's pages into
+a contiguous [B, kvh, S, dh] view before the attention einsums — on TPU
+that gather MATERIALIZES a full copy of the visible cache in HBM every
+decode step, doubling the traffic of the already-bandwidth-bound loop.
+This kernel removes the copy: the page pool is an input whose BlockSpec
+index map reads the block table through Pallas SCALAR PREFETCH
+(``pltpu.PrefetchScalarGridSpec``), so each grid step DMAs exactly one
+physical page from wherever it lives — the indirection costs an index
+lookup, not a gather.
+
+Structure — the flash forward kernel's online softmax specialized to
+decode (one query token per row):
+
+- grid (B, kvh, P): pages sequential innermost, the per-(row, kv-head)
+  running max/normalizer/accumulator in VMEM scratch across page steps;
+- GQA-native: the ``rep = nh/kvh`` query heads sharing a KV head form the
+  kernel's row block (padded to the 8-row sublane tile when rep < 8);
+- per-row visible lengths ride the second scalar-prefetch operand: pages
+  at or beyond a row's length are skipped by predication, slots past the
+  length inside the boundary page are masked to -inf.
+
+bf16/f32 pools only — the int8 pool's per-slot scale planes stay on the
+einsum path (dequantization there rides the gather it already pays).
+CPU tests run the kernel in Pallas interpreter mode against the grouped
+einsum oracle (tests/test_paged_attention.py); Mosaic lowering and the
+HBM win are measured on hardware by scripts/bench-decode.py.
+
+The reference has no kernels at all (SURVEY §2); within this rebuild the
+kernel is the serving-side sibling of ops/flash_attention.py.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _kernel(
+    bt_ref,        # scalar prefetch: [B, P] block table (int32)
+    len_ref,       # scalar prefetch: [B] visible lengths (int32)
+    q_ref,         # [1, 1, rep_p, dh]
+    k_ref,         # [1, 1, ps, dh] — the page selected by the index map
+    v_ref,         # [1, 1, ps, dh]
+    o_ref,         # [1, 1, rep_p, dh]
+    m_s, l_s, acc_s,  # VMEM f32: [rep_p, 1], [rep_p, 1], [rep_p, dh]
+    *, ps: int, sm_scale: float,
+):
+    b = pl.program_id(0)
+    p = pl.program_id(2)
+    num_pages = pl.num_programs(2)
+
+    @pl.when(p == 0)
+    def _init():
+        m_s[:] = jnp.full_like(m_s, NEG_INF)
+        l_s[:] = jnp.zeros_like(l_s)
+        acc_s[:] = jnp.zeros_like(acc_s)
+
+    length = len_ref[b]
+    base = p * ps
+
+    @pl.when(base < length)
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32)        # [rep_p, dh]
+        k = k_ref[0, 0].astype(jnp.float32)        # [ps, dh]
+        v = v_ref[0, 0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * sm_scale                               # [rep_p, ps]
+        slot = base + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where(slot < length, s, NEG_INF)
+
+        m_prev, l_prev = m_s[:], l_s[:]
+        m_new = jnp.maximum(m_prev, s.max(axis=-1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        pexp = jnp.exp(s - m_new)
+        l_s[:] = l_prev * alpha + pexp.sum(axis=-1, keepdims=True)
+        acc_s[:] = acc_s[:] * alpha + jax.lax.dot_general(
+            pexp, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_s[:] = m_new
+
+    @pl.when(p == num_pages - 1)
+    def _finalize():
+        o_ref[0, 0] = (
+            acc_s[:] / jnp.maximum(l_s[:], 1e-30)
+        ).astype(o_ref.dtype)
+
+
+def paged_decode_attention(
+    q: jax.Array,            # [B, nh, dh] — ONE query token per row
+    k_pages: jax.Array,      # [n_pages, kvh, ps, dh] — one layer's pool
+    v_pages: jax.Array,
+    block_table: jax.Array,  # [B, P] int32 logical block -> physical page
+    lengths: jax.Array,      # [B] int32 visible length per row (pos + 1)
+    sm_scale: float | None = None,
+    interpret: bool | None = None,
+) -> jax.Array:              # [B, nh, dh]
+    """Single-token paged attention with in-place page reads (module
+    docstring). GQA-native: ``nh % kvh == 0``; bf16/f32 pools."""
+    B, nh, dh = q.shape
+    n_pages, kvh, ps, _ = k_pages.shape
+    P = block_table.shape[1]
+    if nh % kvh:
+        raise ValueError(f"n_heads {nh} not a multiple of kv_heads {kvh}")
+    rep = nh // kvh
+    rep_p = max(8, -(-rep // 8) * 8)  # query rows padded to the sublane tile
+    if sm_scale is None:
+        sm_scale = dh ** -0.5
+    if interpret is None:
+        interpret = jax.devices()[0].platform != "tpu"
+
+    # group-major view [B, kvh, rep, dh], zero-padded to rep_p rows
+    qg = q.reshape(B, kvh, rep, dh)
+    if rep_p != rep:
+        qg = jnp.pad(qg, ((0, 0), (0, 0), (0, rep_p - rep), (0, 0)))
+
+    grid = (B, kvh, P)
+    out = pl.pallas_call(
+        functools.partial(_kernel, ps=ps, sm_scale=float(sm_scale)),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec(
+                    (1, 1, rep_p, dh), lambda b, h, p, bt, lens: (b, h, 0, 0)
+                ),
+                # THE point: the page index comes from the prefetched
+                # block table, over the pool's NATIVE layout — the DMA
+                # reads the physical page in place (any relayout of the
+                # pool here would itself be the copy this kernel exists
+                # to avoid)
+                pl.BlockSpec(
+                    (1, 1, ps, dh),
+                    lambda b, h, p, bt, lens: (bt[b, p], h, 0, 0),
+                ),
+                pl.BlockSpec(
+                    (1, 1, ps, dh),
+                    lambda b, h, p, bt, lens: (bt[b, p], h, 0, 0),
+                ),
+            ],
+            out_specs=pl.BlockSpec(
+                (1, 1, rep_p, dh), lambda b, h, p, bt, lens: (b, h, 0, 0)
+            ),
+            scratch_shapes=[
+                pltpu.VMEM((rep_p, 1), jnp.float32),
+                pltpu.VMEM((rep_p, 1), jnp.float32),
+                pltpu.VMEM((rep_p, dh), jnp.float32),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((B, kvh, rep_p, dh), q.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ) if not interpret else None,
+        interpret=interpret,
+    )(
+        block_table.astype(jnp.int32), lengths.astype(jnp.int32),
+        qg, k_pages, v_pages,
+    )
+    return out[:, :, :rep].reshape(B, nh, dh)

@@ -181,6 +181,30 @@ def run_measurements(emit) -> None:
         ),
     })
 
+    # --- paged-attention kernel: in-place page reads vs the gather -------
+    # (ops/paged_attention.py — the gather materializes a contiguous cache
+    # copy per step; the kernel's speedup measures that copy's cost)
+    kernel_cfg = dataclasses.replace(config, paged_attention_kernel=True)
+
+    def decode_paged_kernel_n(n_steps):
+        return decode_chain(
+            lambda tok, pos, cache: decode_step_paged(
+                params, tok, jnp.full((B,), pos), cache, bt, kernel_cfg
+            ),
+            n_steps,
+        )
+
+    t_kn = best_of(decode_paged_kernel_n(N), first, paged0)
+    t_k1 = best_of(decode_paged_kernel_n(1), first, paged0)
+    per_step_kernel = chain_diff(t_kn, t_k1, N)
+    emit("paged_attention_kernel", {
+        "per_step_ms": round(per_step_kernel * 1e3, 3),
+        "tokens_per_sec": round(B / per_step_kernel, 1),
+        "speedup_vs_gather_path": round(
+            per_step_paged / per_step_kernel, 2
+        ),
+    })
+
     # --- weight-only int8: decode streams half the parameter bytes ------
     # (x @ q)*s epilogue form — ops/weight_quant.py; the win is pure HBM
     # bandwidth, so the speedup is the honest measure of how much of the
