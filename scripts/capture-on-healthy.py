@@ -110,12 +110,12 @@ def main() -> None:
     interval = args.interval
     captures = 0
     while time.time() < deadline:
-        # Backstop > the oneshot's own watchdog-permitted worst case
-        # (init 150s + 5 cases x 900s stall limit = 4650s): the backstop
-        # must never SIGKILL a battery the child's watchdog considers
-        # healthy — a hard-killed client is the tunnel-wedging pattern
-        # this whole design exists to avoid.
-        rc = run_oneshot(timeout_s=5400.0)
+        # Backstop > the oneshot's realistic worst case (~45 min of real
+        # measurements + one 1800s stall before its own watchdog fires):
+        # the backstop must never SIGKILL a battery the child's watchdog
+        # considers healthy — a hard-killed client is the tunnel-wedging
+        # pattern this whole design exists to avoid.
+        rc = run_oneshot(timeout_s=7200.0)
         if rc in (0, 5, 6):
             # Even a partially/fully failed battery proved the tunnel
             # serves clients right now — the follow-ups may still land,

@@ -28,7 +28,9 @@ So this script is both the probe AND the battery:
       4. KV-decode battery: bf16/int8, paged, speculative (bench-decode)
       5. flagship train MFU + decode (bench-mfu payload, exec'd in-process)
   - A deadman watchdog exits 4 if any single case stalls past
-    ``BCI_ONESHOT_STALL_S`` (default 900 s) — a mid-run wedge must not hold
+    ``BCI_ONESHOT_STALL_S`` (default 1800 s — the decode case alone jit-
+    compiles ~20 programs at ~20-40 s each through the tunnel) — a
+    mid-run wedge must not hold
     a zombie client open all night (that blocks the tunnel's own recovery).
 
 Service-path variants (bench.py's /v1/execute headline, bench-mfu's service
@@ -55,7 +57,7 @@ if str(REPO) not in sys.path:
     sys.path.insert(0, str(REPO))
 
 INIT_TIMEOUT_S = float(os.environ.get("BCI_ONESHOT_INIT_TIMEOUT_S", "150"))
-STALL_TIMEOUT_S = float(os.environ.get("BCI_ONESHOT_STALL_S", "900"))
+STALL_TIMEOUT_S = float(os.environ.get("BCI_ONESHOT_STALL_S", "1800"))
 
 _progress = {"mark": time.time(), "stage": "init"}
 
