@@ -28,7 +28,7 @@ from __future__ import annotations
 
 import heapq
 import itertools
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 
 import numpy as np
 
@@ -45,7 +45,7 @@ class _Queued:
     sampling: SamplingParams | None
     prefill_chunk: int | None
     adapter: int | None
-    pages_needed: int = field(default=0)
+    pages_needed: int
 
 
 class Engine:

@@ -52,6 +52,10 @@ class TextEngine:
         self._final: dict[int, str | None] = {}  # fixed text (None = live)
         self._reason: dict[int, str] = {}
         self._live: set[int] = set()
+        # memo: ticket -> (token count, decoded text). _scan and new_text
+        # both need the decode every step; without the memo each request
+        # pays O(len^2) tokenizer work over its lifetime.
+        self._decode_memo: dict[int, tuple[int, str]] = {}
 
     # ------------------------------------------------------------- intake
     def submit(
@@ -79,7 +83,14 @@ class TextEngine:
     # --------------------------------------------------------------- step
     def _decoded(self, ticket: int) -> str:
         tokens = self.engine.partial_result(ticket)
-        return self.tokenizer.decode(tokens) if tokens else ""
+        if not tokens:
+            return ""
+        memo = self._decode_memo.get(ticket)
+        if memo is not None and memo[0] == len(tokens):
+            return memo[1]
+        text = self.tokenizer.decode(tokens)
+        self._decode_memo[ticket] = (len(tokens), text)
+        return text
 
     @staticmethod
     def _stable(text: str) -> str:
@@ -135,10 +146,11 @@ class TextEngine:
         the long-running-server hygiene the engine/batcher layers already
         require. ``finish_reason`` stays observable (a string per
         ticket); ``text`` does not."""
-        if self._final.get(ticket) is None and ticket in self._final:
+        if ticket in self._final and self._final[ticket] is None:
             raise RuntimeError(f"ticket {ticket} still generating")
         self.engine.release(ticket)
-        for d in (self._stops, self._holdback, self._emitted, self._final):
+        for d in (self._stops, self._holdback, self._emitted, self._final,
+                  self._decode_memo):
             d.pop(ticket, None)
         self._live.discard(ticket)
 

@@ -109,7 +109,7 @@ def paged_decode_attention(
     """Single-token paged attention with in-place page reads (module
     docstring). GQA-native: ``nh % kvh == 0``; bf16/f32 pools."""
     B, nh, dh = q.shape
-    n_pages, kvh, ps, _ = k_pages.shape
+    _, kvh, ps, _ = k_pages.shape
     P = block_table.shape[1]
     if nh % kvh:
         raise ValueError(f"n_heads {nh} not a multiple of kv_heads {kvh}")

@@ -37,6 +37,7 @@ from __future__ import annotations
 
 from typing import Any
 
+import jax
 import jax.numpy as jnp
 
 Params = dict[str, Any]
@@ -91,6 +92,4 @@ def quantize_weights(
 def quantized_nbytes(params: Params) -> int:
     """Total bytes of every array leaf (dicts included) — the memory
     claim's receipt."""
-    import jax
-
     return sum(x.nbytes for x in jax.tree.leaves(params))
