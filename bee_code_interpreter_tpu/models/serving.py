@@ -333,13 +333,13 @@ class ContinuousBatcher:
         stacked into one device bank; ``submit(adapter=i)`` serves request
         rows under adapter i — heterogeneous adapters decode together in
         one compiled program, the shared base weights streaming from HBM
-        once for the whole batch. Admission prefills through
-        ``merge_lora`` (the delta folded for the O(L) pass), decode
-        applies the delta unmerged per row; both use ``lora_scale``
-        (alpha/rank). The prefix cache keys pages by (adapter, tokens), so
-        requests under different adapters never share K/V. Pinned equal to
-        solo decode on the merged params by
-        tests/test_multilora_serving.py."""
+        once for the whole batch. Adapter admissions prefill through the
+        page-aligned window path (lora- AND quantization-aware — adapters
+        serve on a weight-only-int8 base too); decode applies the delta
+        unmerged per row; both use ``lora_scale`` (alpha/rank). The
+        prefix cache keys pages by (adapter, tokens), so requests under
+        different adapters never share K/V. Pinned equal to solo decode
+        on the merged params by tests/test_multilora_serving.py."""
         self.params = params
         self.config = config
         self.page_size = page_size
@@ -365,19 +365,6 @@ class ContinuousBatcher:
         # pytrees too would double adapter memory for the server's life
         self.n_adapters = len(adapters) if adapters else 0
         if adapters:
-            from bee_code_interpreter_tpu.ops.weight_quant import (
-                any_quantized,
-            )
-
-            if any_quantized(params):
-                # adapter admission prefills through merge_lora, which adds
-                # the rank-r delta into fp base weights; folding into int8
-                # would re-quantize per admission. Quantize AFTER merging,
-                # or serve adapters on the fp base.
-                raise NotImplementedError(
-                    "multi-LoRA serving needs fp base weights "
-                    "(weight-only-quantized params refuse adapters)"
-                )
             from bee_code_interpreter_tpu.models.lora import stack_lora_bank
 
             self.lora_bank = stack_lora_bank(list(adapters))
@@ -468,28 +455,6 @@ class ContinuousBatcher:
             ),
             donate_argnums=(3,),
         )
-        if self.lora_bank is not None:
-            # admission prefill under an adapter: the delta is FOLDED
-            # (merge_lora) for the O(L) pass — one rank-r outer product per
-            # target vs L tokens' worth of per-token delta einsums — then
-            # K/V seed pages exactly like the base path. The zero adapter
-            # (index 0) merges to the base params, so un-adapted rows share
-            # this same program.
-            from bee_code_interpreter_tpu.models.lora import merge_lora
-
-            self._prefill_lora = jax.jit(
-                lambda p, lo, t: forward(
-                    merge_lora(p, lo, self.lora_scale), t, config,
-                    return_kv=True,
-                )
-            )
-            self._prefill_chunked_lora = jax.jit(
-                lambda p, lo, t, total_len, chunk: prefill_chunked(
-                    merge_lora(p, lo, self.lora_scale), t, config=config,
-                    total_len=total_len, chunk=chunk,
-                ),
-                static_argnames=("total_len", "chunk"),
-            )
         if draft_config is not None:
             # the draft's own paged pool, addressed by the SAME block
             # tables/pages (one allocation covers both models' K/V)
@@ -647,12 +612,20 @@ class ContinuousBatcher:
         self.block_table[row, :n_need] = pages
 
         try:
-            if matched:
-                # shared-prefix admission: the first ``matched`` pages
-                # already hold this prompt's K/V (both pools in
-                # speculative mode); only the suffix runs through the
-                # model. Zero the FRESH draft pages only — matched pages
-                # hold valid draft prefix K/V other rows may be sharing.
+            if matched or adapter_internal > 0:
+                # Window-prefill admissions: shared-prefix hits AND every
+                # adapter admission (matched == 0 makes the whole prompt
+                # the suffix). decode_window_paged is lora- and
+                # quantization-aware, so ONE mechanism covers every
+                # combination — including adapters on a weight-only-int8
+                # base, which the old merge_lora-based admission could
+                # not serve. Base rows (adapter_internal == 0) without a
+                # hit keep the one-shot forward + bulk seeding
+                # (_full_admit): the same program family as
+                # generate_cached's prefill, which the solo-equality pins
+                # rely on bitwise at bf16.
+                # Zero only the FRESH draft pages — matched pages hold
+                # valid draft prefix K/V other rows may be sharing.
                 if speculative:
                     fresh_arr = jnp.asarray(pages[matched:], dtype=jnp.int32)
                     self.draft_cache = {
@@ -665,8 +638,7 @@ class ContinuousBatcher:
                 )
             else:
                 last_row = self._full_admit(
-                    prompt, pages, L, speculative, prefill_chunk,
-                    adapter_internal,
+                    prompt, pages, L, speculative, prefill_chunk
                 )
             sampling = sampling or SamplingParams()
             rng = np.random.default_rng(sampling.seed)
@@ -739,13 +711,10 @@ class ContinuousBatcher:
         return req
 
     # ------------------------------------------------- admission sub-paths
-    def _full_admit(self, prompt, pages, L, speculative, prefill_chunk,
-                    adapter_internal=0):
-        """Whole-prompt admission (no prefix hit): one-shot or chunked
-        prefill into this row's pages; returns the last prompt token's
-        logits row. With a lora bank, the prefill runs on merge_lora'd
-        params for the row's adapter (index 0 merges the zero adapter =
-        the base)."""
+    def _full_admit(self, prompt, pages, L, speculative, prefill_chunk):
+        """Whole-prompt BASE admission (no prefix hit, no adapters — those
+        route through ``_suffix_admit``): one-shot or chunked prefill into
+        this row's pages; returns the last prompt token's logits row."""
         n_prompt_pages = -(-L // self.page_size)
         pages_arr = jnp.asarray(pages[:n_prompt_pages], dtype=jnp.int32)
         # the prompt padded to a whole number of pages — shared by the
@@ -773,19 +742,11 @@ class ContinuousBatcher:
         if prefill_chunk is not None:
             # bounded-memory admission: the chunked prefill builds the
             # cache in the pool's layout; copy its leaves verbatim
-            if self.lora_bank is not None:
-                last_logits, contig = self._prefill_chunked_lora(
-                    self.params, self._adapter_slice(adapter_internal),
-                    prompt[None, :],
-                    total_len=n_prompt_pages * self.page_size,
-                    chunk=prefill_chunk,
-                )
-            else:
-                last_logits, contig = self._prefill_chunked(
-                    self.params, prompt[None, :],
-                    total_len=n_prompt_pages * self.page_size,
-                    chunk=prefill_chunk,
-                )
+            last_logits, contig = self._prefill_chunked(
+                self.params, prompt[None, :],
+                total_len=n_prompt_pages * self.page_size,
+                chunk=prefill_chunk,
+            )
             self.cache = seed_from_contiguous(
                 self.cache, pages_arr,
                 {name: x[:, 0] for name, x in contig.items()},
@@ -800,15 +761,9 @@ class ContinuousBatcher:
             # so logits[L-1] and K/V[:L] are exact, and distinct
             # prompt lengths share a program per page count instead of
             # one per length.
-            if self.lora_bank is not None:
-                logits, (k_pre, v_pre) = self._prefill_lora(
-                    self.params, self._adapter_slice(adapter_internal),
-                    padded[None, :],
-                )
-            else:
-                logits, (k_pre, v_pre) = self._prefill(
-                    self.params, padded[None, :]
-                )
+            logits, (k_pre, v_pre) = self._prefill(
+                self.params, padded[None, :]
+            )
             self.cache = seed_prefill(
                 self.cache, pages_arr,
                 k_pre[:, 0, :, :L, :], v_pre[:, 0, :, :L, :],
@@ -829,8 +784,9 @@ class ContinuousBatcher:
 
     def _suffix_admit(self, row, prompt, matched, speculative, prefill_chunk,
                       adapter_internal=0):
-        """Admission with ``matched`` prefix pages already holding this
-        prompt's K/V: only the suffix runs through the model, as
+        """Window-prefill admission — prefix-cache hits (``matched`` > 0:
+        only the suffix runs through the model) AND every adapter
+        admission (``matched`` == 0: the whole prompt is the suffix) — as
         consecutive ``decode_window_paged`` windows that append suffix K/V
         into the row's fresh pages while attending to the shared prefix
         through the block table — the paged analogue of chunked prefill
@@ -889,15 +845,6 @@ class ContinuousBatcher:
         return {
             "lora_bank": self.lora_bank,
             "adapter_idx": jnp.asarray(adapter_rows, dtype=jnp.int32),
-        }
-
-    def _adapter_slice(self, adapter_internal: int) -> dict:
-        """One adapter's plain LoRA pytree sliced out of the bank (for the
-        merge_lora'd admission prefill). Index 0 is the zero adapter."""
-        return {
-            t: {"A": ab["A"][:, adapter_internal],
-                "B": ab["B"][:, adapter_internal]}
-            for t, ab in self.lora_bank.items()
         }
 
     # -------------------------------------------------- prefix-cache pages

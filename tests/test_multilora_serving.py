@@ -227,3 +227,25 @@ def test_bf16_within_batcher_determinism():
     first, second = run(), run()
     assert first == second
     assert first[0] != first[1]  # the adapter visibly changes the output
+
+
+def test_bf16_base_rows_in_adapter_batcher_stay_solo_exact():
+    """A base (adapter=None) non-hit admission in an adapter-enabled
+    batcher keeps the one-shot _full_admit path — bitwise the program
+    family solo generate_cached prefills with — so its bf16 output stays
+    token-exact against a plain batcher (the window path would differ in
+    final ulps and could flip near-ties)."""
+    cfg = dataclasses.replace(TransformerConfig.tiny(), n_kv_heads=2)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    adapters = [trained_adapter(1)]
+
+    def run(with_adapters):
+        kw = dict(max_batch=2, n_pages=40, page_size=4, max_pages_per_seq=8)
+        if with_adapters:
+            kw.update(adapters=adapters, lora_scale=SCALE)
+        b = ContinuousBatcher(params, cfg, **kw)
+        r = b.submit(PROMPT, 6)  # base row
+        b.run_to_completion()
+        return b.result(r)
+
+    assert run(True) == run(False)

@@ -121,12 +121,37 @@ def test_quantized_with_int8_kv_cache_and_prefix_cache():
     assert hits == 1
 
 
-def test_quantized_refuses_adapters():
+def test_quantized_base_serves_adapters():
+    """Multi-LoRA on a weight-only-int8 base: adapter admissions route
+    through the (quantization-aware) window prefill, so the combination
+    serves. Pins: the zero-delta adapter is the quantized base EXACTLY, a
+    real adapter visibly changes the output, and both are deterministic."""
     from bee_code_interpreter_tpu.models.lora import init_lora
 
-    lora = init_lora(CFG, jax.random.PRNGKey(5), rank=4)
-    with pytest.raises(NotImplementedError, match="fp base"):
-        ContinuousBatcher(QPARAMS, CFG, adapters=[lora])
+    zero = init_lora(CFG, jax.random.PRNGKey(5), rank=4)  # B == 0: identity
+    real = {
+        t: {"A": ab["A"],
+            "B": jax.random.normal(jax.random.PRNGKey(6), ab["B"].shape,
+                                   jnp.float32) * 0.3}
+        for t, ab in zero.items()
+    }
+
+    def run(adapter):
+        b = ContinuousBatcher(QPARAMS, CFG, max_batch=2, n_pages=24,
+                              page_size=4, max_pages_per_seq=8,
+                              adapters=[zero, real], lora_scale=2.0)
+        r = b.submit(PROMPT, 5, adapter=adapter)
+        b.run_to_completion()
+        return b.result(r)
+
+    base = ContinuousBatcher(QPARAMS, CFG, max_batch=2, n_pages=24,
+                             page_size=4, max_pages_per_seq=8)
+    rb = base.submit(PROMPT, 5)
+    base.run_to_completion()
+    assert run(0) == base.result(rb)   # zero delta == quantized base
+    adapted = run(1)
+    assert adapted != base.result(rb)  # the adapter actually acts
+    assert run(1) == adapted           # deterministic
 
 
 def test_merge_refuses_quantized_with_clear_error():
