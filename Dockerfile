@@ -14,8 +14,7 @@ RUN apt-get update \
 WORKDIR /app
 COPY pyproject.toml ./
 COPY bee_code_interpreter_tpu ./bee_code_interpreter_tpu
-RUN pip install --no-cache-dir aiohttp grpcio protobuf pydantic httpx tenacity \
-    && pip install --no-cache-dir --no-deps .
+RUN pip install --no-cache-dir .
 
 RUN mkdir -p /storage && chmod 777 /storage
 ENV APP_FILE_STORAGE_PATH=/storage

@@ -93,7 +93,7 @@ class ApplicationContext:
         )
 
         executor = KubernetesCodeExecutor(
-            kubectl=Kubectl(),
+            kubectl=Kubectl(kubectl_path=self.config.kubectl_path),
             storage=self.storage,
             config=self.config,
         )

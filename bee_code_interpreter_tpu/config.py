@@ -68,6 +68,10 @@ class Config(BaseModel):
     executor_pod_queue_target_length: int = 5
     executor_pod_name_prefix: str = "code-executor-"
     executor_port: int = 8000
+    # kubectl binary the service shells out to (APP_KUBECTL_PATH). Lets a
+    # deployment pin a versioned binary, and the e2e suite point the REAL
+    # kubernetes executor at a fake cluster CLI.
+    kubectl_path: str = "kubectl"
     # Per-execution wall-clock timeout, plumbed through to the sandbox executor
     # (the reference hardcoded 60s in the executor and never set the request
     # field: executor/server.rs:151, kubernetes_code_executor.py:117-123).

@@ -33,6 +33,7 @@ from dataclasses import dataclass
 import numpy as np
 
 from bee_code_interpreter_tpu.models.serving import (
+    CapacityError,
     ContinuousBatcher,
     SamplingParams,
 )
@@ -143,9 +144,14 @@ class Engine:
                     req.prompt, req.max_new_tokens, sampling=req.sampling,
                     prefill_chunk=req.prefill_chunk, adapter=req.adapter,
                 )
-            except RuntimeError:
+            except CapacityError:
                 # capacity race (e.g. prefix-matched pages changed the
-                # arithmetic): put it back and stop admitting this step
+                # arithmetic): put it back and stop admitting this step.
+                # Only the batcher's own backpressure signal requeues —
+                # a bare RuntimeError here could be jaxlib's
+                # XlaRuntimeError (device OOM/failure during admission
+                # prefill), which must become an error ticket below, not
+                # an infinite requeue loop against a failing device.
                 heapq.heappush(self._heap, (neg_prio, seq, ticket, req))
                 self._queued.add(ticket)
                 return

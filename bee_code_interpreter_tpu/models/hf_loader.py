@@ -72,6 +72,18 @@ def config_from_hf(hf_config, dtype=jnp.bfloat16) -> TransformerConfig:
                 "position interpolation maps onto our rope scaling)"
             )
         rope_scaling = float(scaling["factor"])
+    derived_head_dim = hf_config.hidden_size // hf_config.num_attention_heads
+    explicit_head_dim = getattr(hf_config, "head_dim", None)
+    if explicit_head_dim not in (None, derived_head_dim):
+        # our attention derives head_dim from hidden_size // n_heads; a
+        # checkpoint with a non-derived head_dim (increasingly common in
+        # HF Llama-family configs) would otherwise pass construction and
+        # fail later with an opaque reshape error
+        raise ValueError(
+            f"head_dim {explicit_head_dim} != hidden_size // "
+            f"num_attention_heads ({derived_head_dim}); non-derived head "
+            "dims unsupported — refusing a silently wrong load"
+        )
     return TransformerConfig(
         vocab_size=hf_config.vocab_size,
         d_model=hf_config.hidden_size,

@@ -202,6 +202,16 @@ class ConstraintExhausted(Exception):
     'constraint' (empty output if it happens at admission)."""
 
 
+class CapacityError(RuntimeError):
+    """``submit`` found no free row / not enough free pages RIGHT NOW —
+    transient backpressure, retryable after a ``step`` frees capacity.
+    Subclasses RuntimeError for callers that catch broadly, but exists so
+    the serving engine can requeue on capacity alone: jaxlib's
+    XlaRuntimeError also subclasses RuntimeError, and a device failure
+    during admission prefill must reach the error-ticket path, not spin
+    in the queue forever."""
+
+
 def choose_host(
     logits: np.ndarray,  # [V] f32 — RAW model logits for this row
     params: SamplingParams,
@@ -494,7 +504,7 @@ class ContinuousBatcher:
         (models/engine.py) calls it at intake so a queued request can
         never explode minutes later on an error the caller could have
         seen at submit. Anything that passes here can fail admission only
-        TRANSIENTLY (rows/pages busy — RuntimeError), never permanently.
+        TRANSIENTLY (rows/pages busy — CapacityError), never permanently.
         """
         prompt = np.asarray(prompt, dtype=np.int32).reshape(-1)
         L = int(prompt.shape[0])
@@ -576,7 +586,9 @@ class ContinuousBatcher:
         speculative = self.draft_params is not None
         free_rows = np.flatnonzero(~self.active)
         if free_rows.size == 0:
-            raise RuntimeError("no free batch row (step() until one frees)")
+            raise CapacityError(
+                "no free batch row (step() until one frees)"
+            )
         # Prefix match BEFORE allocating: matched pages come from the index
         # (a ref, not an allocation). The match is capped at (L-1)//ps full
         # pages so at least one suffix token remains — the admission must
@@ -599,7 +611,7 @@ class ContinuousBatcher:
         if n_need - matched > available:
             for page in reversed(shared):
                 self._release_page(page)
-            raise RuntimeError(
+            raise CapacityError(
                 f"page pool exhausted ({n_need - matched} needed, "
                 f"{available} free)"
             )

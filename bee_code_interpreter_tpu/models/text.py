@@ -139,7 +139,10 @@ class TextEngine:
 
     # ------------------------------------------------------------ results
     def is_done(self, ticket: int) -> bool:
-        return self._final.get(ticket) is not None
+        # keyed on _reason, which release() retains: the done-flag must
+        # survive release (the engine/batcher layers uphold the same
+        # contract) or a poller on a released ticket spins forever
+        return ticket in self._reason
 
     def release(self, ticket: int) -> None:
         """Drop this ticket's text state AND the underlying request's —
@@ -156,6 +159,8 @@ class TextEngine:
 
     def text(self, ticket: int) -> str:
         if ticket not in self._final:
+            if ticket in self._reason:
+                raise KeyError(f"ticket {ticket} released")
             raise KeyError(f"unknown ticket {ticket}")
         final = self._final[ticket]
         if final is None:
@@ -179,6 +184,8 @@ class TextEngine:
         later stop match can never claw back emitted text. The
         concatenation of every chunk equals ``text()``."""
         if ticket not in self._final:
+            if ticket in self._reason:
+                raise KeyError(f"ticket {ticket} released")
             raise KeyError(f"unknown ticket {ticket}")
         emitted = self._emitted[ticket]
         final = self._final[ticket]

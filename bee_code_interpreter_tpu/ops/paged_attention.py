@@ -109,7 +109,7 @@ def paged_decode_attention(
     """Single-token paged attention with in-place page reads (module
     docstring). GQA-native: ``nh % kvh == 0``; bf16/f32 pools."""
     B, nh, dh = q.shape
-    _, kvh, ps, _ = k_pages.shape
+    n_pages, kvh, ps, _ = k_pages.shape
     P = block_table.shape[1]
     if nh % kvh:
         raise ValueError(f"n_heads {nh} not a multiple of kv_heads {kvh}")
@@ -140,13 +140,22 @@ def paged_decode_attention(
                 # reads the physical page in place (any relayout of the
                 # pool here would itself be the copy this kernel exists
                 # to avoid)
+                # the index is clamped to the pool: entries at/past a
+                # row's visible length have their compute predicated off
+                # but the DMA still issues, and a sentinel like -1 (a
+                # common block-table convention) would read out of bounds
+                # in the Mosaic path while passing interpreter-mode tests
                 pl.BlockSpec(
                     (1, 1, ps, dh),
-                    lambda b, h, p, bt, lens: (bt[b, p], h, 0, 0),
+                    lambda b, h, p, bt, lens: (
+                        jnp.clip(bt[b, p], 0, n_pages - 1), h, 0, 0
+                    ),
                 ),
                 pl.BlockSpec(
                     (1, 1, ps, dh),
-                    lambda b, h, p, bt, lens: (bt[b, p], h, 0, 0),
+                    lambda b, h, p, bt, lens: (
+                        jnp.clip(bt[b, p], 0, n_pages - 1), h, 0, 0
+                    ),
                 ),
             ],
             out_specs=pl.BlockSpec(

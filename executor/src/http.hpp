@@ -14,7 +14,9 @@
 #include <sys/socket.h>
 #include <unistd.h>
 
+#include <algorithm>
 #include <atomic>
+#include <cstdio>
 #include <cstring>
 #include <functional>
 #include <map>
@@ -29,7 +31,8 @@ struct Request {
   std::string method;
   std::string path;  // percent-decoded, query stripped
   std::map<std::string, std::string> headers;  // lower-cased keys
-  std::string body;
+  std::string body;       // buffered body (empty when streamed to disk)
+  std::string body_file;  // when non-empty, body was streamed to this path
 };
 
 struct Response {
@@ -40,6 +43,14 @@ struct Response {
 };
 
 using Handler = std::function<Response(const Request&)>;
+
+// Called once the request line + headers are parsed. Returning a file path
+// streams the body to that file chunk-by-chunk as it arrives (the request's
+// `body_file` is set, `body` stays empty) — a large workspace restore costs
+// disk, not resident memory (the reference executor streams uploads the same
+// way, server.rs:83-86). Returning nullopt buffers the body in RAM as before.
+using SinkSelector =
+    std::function<std::optional<std::string>(const Request&)>;
 
 inline std::string status_text(int code) {
   switch (code) {
@@ -55,7 +66,8 @@ inline std::string status_text(int code) {
 
 class Server {
  public:
-  explicit Server(Handler handler) : handler_(std::move(handler)) {}
+  explicit Server(Handler handler, SinkSelector sink = nullptr)
+      : handler_(std::move(handler)), sink_(std::move(sink)) {}
 
   // Binds and listens; returns the bound port (for ":0" style tests).
   int bind(const std::string& host, int port) {
@@ -154,19 +166,104 @@ class Server {
     }
 
     // -- body --
+    // One parser per framing, writing through a sink: append-to-string for
+    // buffered bodies, fwrite-to-disk when the selector claims the request
+    // (resident memory stays one recv buffer regardless of body size, and
+    // kMaxBody acts as a disk quota instead of a RAM cap).
+    std::optional<std::string> sink_path;
+    if (sink_) sink_path = sink_(req);
     auto te = req.headers.find("transfer-encoding");
-    if (te != req.headers.end() && te->second.find("chunked") != std::string::npos) {
-      return read_chunked_body(fd, buffer, req.body);
-    }
+    bool chunked = te != req.headers.end() &&
+                   te->second.find("chunked") != std::string::npos;
     auto cl = req.headers.find("content-length");
-    size_t content_length = cl == req.headers.end() ? 0 : std::stoull(cl->second);
-    if (content_length > kMaxBody) return false;
-    while (buffer.size() < content_length) {
-      if (!fill(fd, buffer)) return false;
+    size_t content_length = 0;
+    if (cl != req.headers.end()) {
+      try {
+        content_length = std::stoull(cl->second);
+      } catch (const std::exception&) {
+        return false;  // garbage Content-Length: drop the connection
+      }
     }
-    req.body = buffer.substr(0, content_length);
-    buffer.erase(0, content_length);
+
+    bool ok;
+    if (sink_path) {
+      FILE* out = fopen(sink_path->c_str(), "wb");
+      if (!out) return false;
+      auto write_file = [out](const char* data, size_t n) {
+        return fwrite(data, 1, n, out) == n;
+      };
+      ok = chunked ? read_chunked_body(fd, buffer, write_file)
+                   : read_length_body(fd, buffer, content_length, write_file);
+      if (fclose(out) != 0) ok = false;
+      if (!ok) {
+        ::remove(sink_path->c_str());  // never leave a torn part-file behind
+        return false;
+      }
+      req.body_file = *sink_path;
+      return true;
+    }
+    auto write_mem = [&req](const char* data, size_t n) {
+      req.body.append(data, n);
+      return true;
+    };
+    ok = chunked ? read_chunked_body(fd, buffer, write_mem)
+                 : read_length_body(fd, buffer, content_length, write_mem);
+    return ok;
+  }
+
+  using BodySink = std::function<bool(const char*, size_t)>;
+
+  bool read_length_body(int fd, std::string& buffer, size_t remaining,
+                        const BodySink& write) {
+    if (remaining > kMaxBody) return false;
+    while (remaining > 0) {
+      if (buffer.empty() && !fill(fd, buffer)) return false;
+      size_t take = std::min(remaining, buffer.size());
+      if (!write(buffer.data(), take)) return false;
+      buffer.erase(0, take);
+      remaining -= take;
+    }
     return true;
+  }
+
+  bool read_chunked_body(int fd, std::string& buffer, const BodySink& write) {
+    size_t total = 0;
+    while (true) {
+      size_t eol;
+      while ((eol = buffer.find("\r\n")) == std::string::npos) {
+        if (!fill(fd, buffer)) return false;
+      }
+      size_t chunk_size;
+      try {
+        chunk_size = std::stoull(buffer.substr(0, eol), nullptr, 16);
+      } catch (const std::exception&) {
+        return false;  // garbage chunk-size line
+      }
+      buffer.erase(0, eol + 2);
+      if (chunk_size == 0) {
+        // trailer section ends with CRLF
+        while (buffer.find("\r\n") == std::string::npos) {
+          if (!fill(fd, buffer)) return false;
+        }
+        buffer.erase(0, buffer.find("\r\n") + 2);
+        return true;
+      }
+      total += chunk_size;
+      if (total > kMaxBody) return false;
+      size_t remaining = chunk_size;
+      while (remaining > 0) {
+        if (buffer.empty() && !fill(fd, buffer)) return false;
+        size_t take = std::min(remaining, buffer.size());
+        if (!write(buffer.data(), take)) return false;
+        buffer.erase(0, take);
+        remaining -= take;
+      }
+      // trailing CRLF after the chunk data
+      while (buffer.size() < 2) {
+        if (!fill(fd, buffer)) return false;
+      }
+      buffer.erase(0, 2);
+    }
   }
 
   bool read_chunked_body(int fd, std::string& buffer, std::string& body) {
@@ -268,6 +365,7 @@ class Server {
   static constexpr size_t kMaxBody = 1ull << 30;  // 1 GiB, matches control plane
 
   Handler handler_;
+  SinkSelector sink_;
   int fd_ = -1;
   std::atomic<bool> stopping_{false};
 };

@@ -344,7 +344,7 @@ class Executor {
     if (req.path.rfind("/workspace/", 0) == 0) {
       auto real = workspace::resolve(config_.workspace_root, req.path);
       if (!real) return {400, "application/json", "{\"detail\":\"path escapes workspace\"}", {}};
-      if (req.method == "PUT") return upload(*real, req.body);
+      if (req.method == "PUT") return upload(*real, req);
       if (req.method == "GET") return download(*real);
       return {405, "application/json", "{}", {}};
     }
@@ -373,13 +373,39 @@ class Executor {
         base_env({}), config_.workspace_root.string(), 300.0);
   }
 
- private:
-  minihttp::Response upload(const fs::path& real, const std::string& body) {
+  // Body-sink selector (runs in minihttp before the body is read): PUT
+  // /workspace/... bodies stream straight to a part-file next to their
+  // destination — a workspace restore costs disk, not resident memory
+  // (parity with the reference's chunk-by-chunk upload, server.rs:83-86).
+  // The same-directory part-file makes the final publish an atomic rename.
+  std::optional<std::string> upload_sink(const minihttp::Request& req) {
+    if (req.method != "PUT" || req.path.rfind("/workspace/", 0) != 0)
+      return std::nullopt;
+    auto real = workspace::resolve(config_.workspace_root, req.path);
+    if (!real) return std::nullopt;  // handler will 400; body stays bounded
     std::error_code ec;
+    fs::create_directories(real->parent_path(), ec);
+    if (ec) return std::nullopt;
+    static std::atomic<uint64_t> seq{0};
+    return real->string() + ".__bci_part." + std::to_string(getpid()) + "." +
+           std::to_string(seq.fetch_add(1));
+  }
+
+ private:
+  minihttp::Response upload(const fs::path& real, const minihttp::Request& req) {
+    std::error_code ec;
+    if (!req.body_file.empty()) {
+      fs::rename(req.body_file, real, ec);
+      if (ec) {
+        fs::remove(req.body_file, ec);
+        return {500, "application/json", "{\"detail\":\"rename failed\"}", {}};
+      }
+      return {204, "application/json", "", {}};
+    }
     fs::create_directories(real.parent_path(), ec);
     std::ofstream out(real, std::ios::binary | std::ios::trunc);
     if (!out) return {500, "application/json", "{\"detail\":\"open failed\"}", {}};
-    out.write(body.data(), static_cast<std::streamsize>(body.size()));
+    out.write(req.body.data(), static_cast<std::streamsize>(req.body.size()));
     return {204, "application/json", "", {}};
   }
 
@@ -808,7 +834,8 @@ int main(int argc, char** argv) {
   int port = std::stoi(listen.substr(colon + 1));
 
   minihttp::Server server(
-      [&executor](const minihttp::Request& req) { return executor.handle(req); });
+      [&executor](const minihttp::Request& req) { return executor.handle(req); },
+      [&executor](const minihttp::Request& req) { return executor.upload_sink(req); });
   int bound = server.bind(host, port);
   std::cout << "executor-server listening on " << host << ":" << bound << std::endl;
   server.serve_forever();

@@ -38,10 +38,12 @@ class Service:
         self.log = log
 
 
-# The whole e2e suite runs once per local backend: the pure-Python in-process
-# executor and (toolchain permitting) the native C++ executor-server pool —
-# both must present identical behavior through the service API.
-@pytest.fixture(scope="session", params=["python", "native"])
+# The whole e2e suite runs once per backend: the pure-Python in-process
+# executor, (toolchain permitting) the native C++ executor-server pool, and
+# the REAL kubernetes executor fronted by a fake cluster CLI
+# (fake_kubectl.py) whose "pods" are native executor processes on distinct
+# loopback IPs — all must present identical behavior through the service API.
+@pytest.fixture(scope="session", params=["python", "native", "kubernetes"])
 def service(request, tmp_path_factory, native_binary):
     tmp = tmp_path_factory.mktemp(f"e2e-{request.param}")
     http_port, grpc_port = _free_port(), _free_port()
@@ -64,6 +66,18 @@ def service(request, tmp_path_factory, native_binary):
         env["APP_LOCAL_EXECUTOR_BINARY"] = str(native_binary)
         # Keep warm-pool startup cheap for the test session.
         env["APP_EXECUTOR_POD_QUEUE_TARGET_LENGTH"] = "2"
+    if request.param == "kubernetes":
+        if native_binary is None:
+            pytest.skip("native toolchain unavailable")
+        env.update(
+            APP_EXECUTOR_BACKEND="kubernetes",
+            APP_KUBECTL_PATH=str(Path(__file__).parent / "fake_kubectl.py"),
+            APP_EXECUTOR_POD_QUEUE_TARGET_LENGTH="2",
+            # wait --for=condition=Ready polls /healthz; pods boot in ~ms
+            APP_POD_READY_TIMEOUT_S="30",
+            FAKE_KUBECTL_STATE=str(tmp / "cluster"),
+            FAKE_KUBECTL_EXECUTOR_BINARY=str(native_binary),
+        )
     log = open(log_path, "wb")
     proc = subprocess.Popen(
         [sys.executable, "-m", "bee_code_interpreter_tpu"],
@@ -108,3 +122,15 @@ def service(request, tmp_path_factory, native_binary):
         except subprocess.TimeoutExpired:
             proc.kill()
         log.close()
+        if request.param == "kubernetes":
+            # fake pods run detached (a real cluster outlives its clients);
+            # sweep any the service didn't get to delete
+            import json as _json
+            import signal as _signal
+
+            for rec_path in (tmp / "cluster").glob("pod-*.json"):
+                try:
+                    pid = _json.loads(rec_path.read_text())["pid"]
+                    os.killpg(os.getpgid(pid), _signal.SIGKILL)
+                except (OSError, ValueError, KeyError):
+                    pass

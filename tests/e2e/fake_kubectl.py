@@ -1,0 +1,183 @@
+#!/usr/bin/env python3
+"""A ``kubectl`` CLI impostor backed by real native executor processes.
+
+The e2e suite boots the ACTUAL service with ``APP_EXECUTOR_BACKEND=kubernetes``
+and ``APP_KUBECTL_PATH`` pointing here — the full KubernetesCodeExecutor code
+path (manifest build, gang spawn, ``wait --for=condition=Ready``, pod-IP
+addressing, delete-on-failure) runs unmodified, while "pods" are
+executor-server processes bound to distinct loopback IPs (Linux routes all of
+127/8 to lo, so every pod keeps the REAL ``podIP:executor_port`` addressing).
+
+Implements exactly the subcommand surface services/kubectl.py emits:
+
+    create -f - --output=json     spawn a pod process from the stdin manifest
+    wait pod/N --for=... --timeout=..s   poll the pod's /healthz
+    get pod N --output=json       pod JSON with status.podIP
+    delete pod N ...              kill the process
+
+State (pod records, IP allocator) lives under $FAKE_KUBECTL_STATE; the
+executor binary comes from $FAKE_KUBECTL_EXECUTOR_BINARY.
+"""
+
+from __future__ import annotations
+
+import fcntl
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+from pathlib import Path
+
+STATE = Path(os.environ["FAKE_KUBECTL_STATE"])
+BINARY = os.environ["FAKE_KUBECTL_EXECUTOR_BINARY"]
+
+
+def flags_and_args(argv: list[str]) -> tuple[dict[str, str], list[str]]:
+    flags, args = {}, []
+    for a in argv:
+        if a.startswith("--"):
+            key, _, value = a[2:].partition("=")
+            flags[key] = value
+        else:
+            args.append(a)
+    return flags, args
+
+
+def record_path(name: str) -> Path:
+    return STATE / f"pod-{name}.json"
+
+
+def alloc_ip() -> str:
+    """Next unused loopback IP (127.1.x.y), under an exclusive lock."""
+    counter = STATE / "ip-counter"
+    with open(STATE / ".lock", "w") as lock:
+        fcntl.flock(lock, fcntl.LOCK_EX)
+        n = int(counter.read_text()) if counter.exists() else 0
+        counter.write_text(str(n + 1))
+    n += 2  # start at 127.1.0.2
+    return f"127.1.{n // 256}.{n % 256}"
+
+
+def pod_json(name: str, ip: str, phase: str = "Running") -> dict:
+    return {
+        "apiVersion": "v1",
+        "kind": "Pod",
+        "metadata": {"name": name, "uid": f"fake-uid-{name}"},
+        "status": {
+            "podIP": ip,
+            "phase": phase,
+            "conditions": [{"type": "Ready", "status": "True"}],
+        },
+    }
+
+
+def create() -> int:
+    manifest = json.loads(sys.stdin.read())
+    name = manifest["metadata"]["name"]
+    container = manifest["spec"]["containers"][0]
+    env = {e["name"]: e["value"] for e in container.get("env", [])}
+    ip = alloc_ip()
+    port = env.get("APP_LISTEN_ADDR", "0.0.0.0:8000").rsplit(":", 1)[1]
+    workspace = STATE / "ws" / name
+    workspace.mkdir(parents=True, exist_ok=True)
+    env.update(
+        APP_LISTEN_ADDR=f"{ip}:{port}",
+        APP_WORKSPACE=str(workspace),
+        APP_DISABLE_DEP_INSTALL="1",
+        PATH=os.environ.get("PATH", "/usr/bin:/bin"),
+        JAX_PLATFORMS="cpu",
+    )
+    log = open(STATE / f"pod-{name}.log", "wb")
+    proc = subprocess.Popen(
+        [BINARY], env=env, stdout=log, stderr=subprocess.STDOUT,
+        start_new_session=True,  # survives this kubectl process exiting
+    )
+    record = {"name": name, "ip": ip, "port": int(port), "pid": proc.pid,
+              "manifest": manifest}
+    record_path(name).write_text(json.dumps(record))
+    print(json.dumps(pod_json(name, ip, phase="Pending")))
+    return 0
+
+
+def wait(args: list[str], flags: dict[str, str]) -> int:
+    target = args[0]  # "pod/NAME"
+    name = target.split("/", 1)[1]
+    timeout = float(flags.get("timeout", "60s").rstrip("s"))
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        path = record_path(name)
+        if path.exists():
+            rec = json.loads(path.read_text())
+            try:
+                with urllib.request.urlopen(
+                    f"http://{rec['ip']}:{rec['port']}/healthz", timeout=1
+                ) as resp:
+                    if resp.status == 200:
+                        print(json.dumps(pod_json(name, rec["ip"])))
+                        return 0
+            except (urllib.error.URLError, OSError):
+                pass
+        time.sleep(0.1)
+    print(f"error: timed out waiting for the condition on {target}",
+          file=sys.stderr)
+    return 1
+
+
+def get(args: list[str]) -> int:
+    kind, name = args[0], args[1]
+    if kind != "pod":
+        print(f"error: unsupported kind {kind}", file=sys.stderr)
+        return 1
+    path = record_path(name)
+    if not path.exists():
+        print(f'Error from server (NotFound): pods "{name}" not found',
+              file=sys.stderr)
+        return 1
+    rec = json.loads(path.read_text())
+    print(json.dumps(pod_json(name, rec["ip"])))
+    return 0
+
+
+def delete(args: list[str], flags: dict[str, str]) -> int:
+    kind, name = args[0], args[1]
+    path = record_path(name)
+    if not path.exists():
+        if flags.get("ignore-not-found") == "true":
+            print("{}")
+            return 0
+        print(f'Error from server (NotFound): pods "{name}" not found',
+              file=sys.stderr)
+        return 1
+    rec = json.loads(path.read_text())
+    try:
+        os.killpg(os.getpgid(rec["pid"]), signal.SIGKILL)
+    except (ProcessLookupError, PermissionError):
+        pass
+    path.unlink(missing_ok=True)
+    print(json.dumps({"kind": "Status", "status": "Success"}))
+    return 0
+
+
+def main() -> int:
+    STATE.mkdir(parents=True, exist_ok=True)
+    command = sys.argv[1]
+    flags, args = flags_and_args(sys.argv[2:])
+    if command == "create":
+        return create()
+    if command == "wait":
+        return wait(args, flags)
+    if command == "get":
+        return get(args)
+    if command == "delete":
+        return delete(args, flags)
+    print(f"error: fake kubectl does not implement {command!r}",
+          file=sys.stderr)
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

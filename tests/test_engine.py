@@ -254,3 +254,45 @@ def test_stats_surface():
     assert st["active_rows"] == 0 and st["queued"] == 0
     assert st["held_pages"] == 0
     assert eng.result(t1) and eng.result(t2)
+
+
+def test_device_failure_during_admission_becomes_error_ticket():
+    """Only the batcher's CapacityError requeues; any other RuntimeError
+    (jaxlib's XlaRuntimeError subclasses RuntimeError — a device OOM
+    during admission prefill) must reach the error-ticket path instead of
+    being retried against a failing device forever."""
+    eng = make_engine()
+
+    def boom(*a, **kw):
+        raise RuntimeError("INTERNAL: XLA allocation failed")
+
+    eng.batcher.submit = boom
+    t = eng.submit(PROMPT, 3)
+    eng.step()  # must not spin: the failure lands on the ticket
+    assert eng.is_done(t)
+    assert eng.finish_reason(t) == "error"
+    assert "XLA allocation failed" in eng.ticket_error(t)
+
+
+def test_capacity_error_requeues_not_errors():
+    """The capacity signal itself still requeues: a one-shot CapacityError
+    from submit leaves the ticket queued, and it completes once the
+    batcher accepts it."""
+    from bee_code_interpreter_tpu.models.serving import CapacityError
+
+    eng = make_engine()
+    real_submit = eng.batcher.submit
+    calls = {"n": 0}
+
+    def flaky(*a, **kw):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise CapacityError("page pool exhausted (transient)")
+        return real_submit(*a, **kw)
+
+    eng.batcher.submit = flaky
+    t = eng.submit(PROMPT, 3)
+    eng.step()
+    assert not eng.is_done(t)  # requeued, not failed
+    eng.run_to_completion()
+    assert eng.result(t) == greedy(PROMPT, 3)

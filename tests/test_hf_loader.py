@@ -133,3 +133,21 @@ def test_hidden_act_and_mlp_bias_refused():
     cfg = transformers.LlamaConfig(rms_norm_eps=1e-5, mlp_bias=True)
     with pytest.raises(ValueError, match="mlp_bias"):
         config_from_hf(cfg)
+
+
+def test_non_derived_head_dim_refused():
+    """Checkpoints with an explicit head_dim != hidden_size // n_heads
+    (increasingly common in HF Llama-family configs) must refuse at
+    config construction, not fail later with an opaque reshape error."""
+    cfg = transformers.LlamaConfig(
+        rms_norm_eps=1e-5, hidden_size=64, num_attention_heads=4,
+        head_dim=32,
+    )
+    with pytest.raises(ValueError, match="head_dim"):
+        config_from_hf(cfg)
+    # a derived (or absent) head_dim still loads
+    cfg = transformers.LlamaConfig(
+        rms_norm_eps=1e-5, hidden_size=64, num_attention_heads=4,
+        head_dim=16,
+    )
+    assert config_from_hf(cfg).d_model == 64

@@ -174,3 +174,23 @@ def test_validation():
             jnp.zeros((4, 2, 8, 128)), jnp.zeros((1, 2), jnp.int32),
             jnp.ones((1,), jnp.int32),
         )
+
+
+def test_sentinel_block_table_entries_are_harmless():
+    """-1 is a common block-table convention for 'no page'. Entries at or
+    beyond a row's visible length have their compute predicated off, but
+    the DMA still issues — the kernel clamps the index so a sentinel
+    reads in-bounds (identical output, no OOB in the Mosaic path)."""
+    q, kp, vp, bt, lengths = make_case(
+        jax.random.PRNGKey(7), B=2, nh=4, kvh=2, ps=8, P=4, n_pages=16
+    )
+    lengths = jnp.asarray([5, 9], dtype=jnp.int32)  # rows use 1 / 2 pages
+    base = paged_decode_attention(q, kp, vp, bt, lengths)
+    bt_sent = np.asarray(bt).copy()
+    bt_sent[0, 1:] = -1  # pages past the visible length
+    bt_sent[1, 2:] = -1
+    out = paged_decode_attention(
+        q, kp, vp, jnp.asarray(bt_sent, dtype=jnp.int32), lengths
+    )
+    np.testing.assert_allclose(np.asarray(base), np.asarray(out),
+                               atol=1e-6, rtol=1e-6)

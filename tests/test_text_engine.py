@@ -201,3 +201,17 @@ def test_streaming_holds_back_unstable_decode_tail():
         te.step()
     streamed += te.new_text(t)
     assert streamed == te.text(t)
+
+
+def test_is_done_and_text_survive_release():
+    """A poller on a released ticket must not spin: is_done stays True
+    after release (keyed on the retained reason), and text() names the
+    release instead of claiming the ticket is unknown."""
+    te, t = completion(8)
+    te.release(t)
+    assert te.is_done(t)  # done-flag survives release
+    with pytest.raises(KeyError, match="released"):
+        te.text(t)
+    with pytest.raises(KeyError, match="released"):
+        te.new_text(t)
+    assert not te.is_done(999_999)  # truly unknown stays not-done
