@@ -29,6 +29,14 @@ _original_import = builtins.__import__
 # processes that never use it. Defer: the module stays in sys.modules and gets
 # patched at the first post-site import statement instead.
 _deferring = False
+# Set for real once the shadowed sitecustomize (if any) is located, below;
+# must exist before the __import__ patch is installed.
+_chain_pending = False
+_chain_finder = None
+
+import threading as _threading
+
+_chain_lock = _threading.Lock()
 
 
 def _patch_numpy(numpy):
@@ -125,6 +133,33 @@ _PATCHES = {
 }
 
 
+# Accelerator-adjacent top-level imports that must see the image's own site
+# hooks (PJRT plugin registration) before they initialize. Anything else
+# (numpy, pandas, requests, …) runs fine without them — which is what makes
+# the deferred chain safe.
+_CHAIN_TRIGGERS = {
+    "jax", "jaxlib", "flax", "optax", "orbax", "torch", "torch_xla",
+    "tensorflow", "axon",
+}
+
+
+class _ChainTriggerFinder:
+    """Meta-path tripwire: fire the deferred chain on the first attempt to
+    import an accelerator library, whatever the import mechanism — a meta
+    importer sees importlib.import_module and entry-point loaders too,
+    which a builtins.__import__ patch alone would miss. Never provides a
+    module itself (find_spec always defers to the real finders)."""
+
+    def find_spec(self, fullname, path=None, target=None):
+        if (
+            _chain_pending
+            and not _deferring
+            and fullname.partition(".")[0] in _CHAIN_TRIGGERS
+        ):
+            _exec_chained_sitecustomize()
+        return None
+
+
 def _import(name, globals=None, locals=None, fromlist=(), level=0):
     module = _original_import(name, globals, locals, fromlist, level)
     if _deferring:
@@ -150,18 +185,15 @@ def _import(name, globals=None, locals=None, fromlist=(), level=0):
 builtins.__import__ = _import
 
 
-def _chain_load_next_sitecustomize():
-    """Execute the next sitecustomize.py further down sys.path.
+def _find_next_sitecustomize():
+    """Path of the next sitecustomize.py further down sys.path, if any.
 
-    Python imports only the *first* sitecustomize it finds; since this shim is
-    prepended to PYTHONPATH it would otherwise shadow the sandbox image's own
-    site hooks (e.g. the PJRT/TPU plugin registration some images perform
-    there). Cooperate instead of replacing.
-    """
-    import importlib.util
+    Python imports only the *first* sitecustomize it finds; since this shim
+    is prepended to PYTHONPATH it would otherwise shadow the sandbox image's
+    own site hooks (e.g. the PJRT/TPU plugin registration some images
+    perform there). Cooperate instead of replacing."""
     import os
 
-    global _deferring
     here = os.path.dirname(os.path.abspath(__file__))
     for entry in sys.path:
         try:
@@ -172,10 +204,35 @@ def _chain_load_next_sitecustomize():
                 continue
         except OSError:
             continue
+        # abspath NOW: relative sys.path entries must not break the chain
+        # after user code chdirs before its first accelerator import
+        return os.path.abspath(candidate)
+    return None
+
+
+_chain_path = _find_next_sitecustomize()
+_chain_pending = _chain_path is not None
+_chain_finder = None
+if _chain_pending:
+    _chain_finder = _ChainTriggerFinder()
+    sys.meta_path.insert(0, _chain_finder)
+
+
+def _exec_chained_sitecustomize():
+    global _deferring, _chain_pending
+    with _chain_lock:
+        # re-check under the lock: two threads importing different
+        # accelerator libs concurrently must not run the chain twice
+        # (duplicate PJRT registration / atexit hooks)
+        if not _chain_pending:
+            return
+        _chain_pending = False
+        import importlib.util
+
         try:
             _deferring = True
             spec = importlib.util.spec_from_file_location(
-                "_chained_sitecustomize", candidate
+                "_chained_sitecustomize", _chain_path
             )
             module = importlib.util.module_from_spec(spec)
             spec.loader.exec_module(module)
@@ -183,7 +240,21 @@ def _chain_load_next_sitecustomize():
             pass
         finally:
             _deferring = False
-        break  # only the first shadowed one, matching Python's own behavior
+    if _chain_finder is not None:
+        try:
+            sys.meta_path.remove(_chain_finder)
+        except ValueError:
+            pass
 
 
-_chain_load_next_sitecustomize()
+# The image's site hooks exist to prime accelerator plugins — work worth
+# ~1 s of jax import in this image's case. Paying that on EVERY interpreter
+# start taxes the pool-refill rate (and with it warm latency) for the many
+# payloads that never touch an accelerator, so by default the chain is
+# DEFERRED to the first accelerator-adjacent import (see _CHAIN_TRIGGERS in
+# _import). BCI_EAGER_CHAIN=1 restores start-time chaining for images whose
+# hooks do more than accelerator setup.
+import os as _os
+
+if _chain_pending and _os.environ.get("BCI_EAGER_CHAIN") == "1":
+    _exec_chained_sitecustomize()

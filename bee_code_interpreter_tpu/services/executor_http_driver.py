@@ -57,11 +57,24 @@ class ExecutorHttpDriver:
         return min(timeout_s, bound)
 
     async def _post_execute(
-        self, addr: str, source_code: str, env: dict[str, str], timeout_s: float
+        self,
+        addr: str,
+        source_code: str,
+        env: dict[str, str],
+        timeout_s: float,
+        client_timeout_s: float | None = None,
     ) -> dict:
+        """``client_timeout_s`` overrides the shared client's read timeout
+        for this one request — used when the sandbox was dispatched before
+        its warm worker finished preloading, so the preload tail counts
+        against the HTTP budget and needs headroom over ``timeout_s``."""
+        kwargs: dict = {}
+        if client_timeout_s is not None:
+            kwargs["timeout"] = client_timeout_s
         response = await self._http.post(
             f"http://{addr}/execute",
             json={"source_code": source_code, "env": env, "timeout": timeout_s},
+            **kwargs,
         )
         if response.status_code != 200:
             raise RuntimeError(

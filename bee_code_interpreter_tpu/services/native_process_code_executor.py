@@ -77,6 +77,10 @@ class NativeSandbox:
     proc: subprocess.Popen
     addr: str  # 127.0.0.1:port
     workspace: Path
+    # Dispatched at first-healthy, before its warm worker finished
+    # preloading: the server gates the execute internally, so the preload
+    # tail counts against the HTTP request and needs timeout headroom.
+    overlap_dispatch: bool = False
 
     def destroy(self) -> None:
         if self.proc.poll() is None:
@@ -111,6 +115,16 @@ class NativeProcessCodeExecutor(ExecutorHttpDriver):
         self._queue: deque[NativeSandbox] = deque()
         self._spawning_count = 0
         self._fill_lock = asyncio.Lock()
+        # Background refills are CPU-bound (each spawn boots a python warm
+        # worker through its preload imports); unbounded concurrency lets a
+        # burst of refills starve the serving path's event loop — on a
+        # small host that showed up as multi-second acquire stalls and
+        # inflated control-plane overhead. Request-blocking spawns (pool
+        # empty) bypass this gate on purpose: the waiting request IS the
+        # priority.
+        self._refill_gate = asyncio.Semaphore(
+            max(1, (os.cpu_count() or 2) - 1)
+        )
         self._closed = False
         # The event loop holds only weak refs to tasks; fire-and-forget refills
         # must be anchored here or GC can cancel them mid-spawn.
@@ -207,7 +221,19 @@ class NativeProcessCodeExecutor(ExecutorHttpDriver):
             )
             t_uploaded = perf()
             response = await self._post_execute(
-                box.addr, source_code, env, self._effective_timeout(timeout_s)
+                box.addr,
+                source_code,
+                env,
+                self._effective_timeout(timeout_s),
+                # preload budget (matches the pooled warm-wait bound) on top
+                # of the client timeout for overlap-dispatched sandboxes —
+                # a near-limit execution must not lose its margin to the
+                # preload it overlapped
+                client_timeout_s=(
+                    self._config.executor_http_timeout_s + 15.0
+                    if box.overlap_dispatch
+                    else None
+                ),
             )
             t_executed = perf()
             out_files: dict[str, str] = {}
@@ -257,7 +283,11 @@ class NativeProcessCodeExecutor(ExecutorHttpDriver):
             logger.warning("Warm sandbox on %s died in queue; discarding", candidate.addr)
             candidate.destroy()
         if box is None:
-            box = await self.spawn_sandbox()
+            # Pool drained: dispatch at first healthy instead of polling for
+            # preload-done — the server queues the execute until its warm
+            # worker is ready (or falls back cold), so the request overlaps
+            # with the tail of the preload rather than waiting it out here.
+            box = await self.spawn_sandbox(wait_warm=False)
         self._spawn_background(self.fill_sandbox_queue())
         try:
             yield box
@@ -297,7 +327,8 @@ class NativeProcessCodeExecutor(ExecutorHttpDriver):
 
     async def _spawn_into_queue(self) -> bool:
         try:
-            box = await self.spawn_sandbox()
+            async with self._refill_gate:
+                box = await self.spawn_sandbox()
         except Exception:
             logger.exception("Sandbox spawn failed")
             return False
@@ -315,7 +346,7 @@ class NativeProcessCodeExecutor(ExecutorHttpDriver):
         wait=wait_exponential(min=0.2, max=2),
         reraise=True,
     )
-    async def spawn_sandbox(self) -> NativeSandbox:
+    async def spawn_sandbox(self, wait_warm: bool = True) -> NativeSandbox:
         cfg = self._config
         port = _free_port()
         addr = f"127.0.0.1:{port}"
@@ -403,7 +434,10 @@ class NativeProcessCodeExecutor(ExecutorHttpDriver):
                 stderr=subprocess.DEVNULL,
             ),
         )
-        box = NativeSandbox(proc=proc, addr=addr, workspace=workspace)
+        box = NativeSandbox(
+            proc=proc, addr=addr, workspace=workspace,
+            overlap_dispatch=not wait_warm,
+        )
         try:
             loop = asyncio.get_running_loop()
             deadline = loop.time() + cfg.pod_ready_timeout_s
@@ -422,6 +456,8 @@ class NativeProcessCodeExecutor(ExecutorHttpDriver):
                         # healthy, or the ready deadline if sooner) queues the
                         # healthy-but-cold sandbox anyway — the server's own
                         # warm-wait/cold-fallback covers it.
+                        if not wait_warm:
+                            return box
                         if warm_deadline is None:
                             warm_deadline = min(loop.time() + 15.0, deadline)
                         if response.json().get("warm", True):

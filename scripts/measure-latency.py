@@ -69,11 +69,15 @@ async def bench_warm(n: int) -> list[float]:
             phases.append(dict(executor.last_execute_phases))
         keys = ("acquire_ms", "upload_ms", "post_execute_ms", "sandbox_ms",
                 "overhead_ms", "download_ms")
-        p50s = {
-            k: statistics.median(float(p.get(k, 0.0)) for p in phases)
-            for k in keys
-        }
-        print("warm phases p50: " + "  ".join(f"{k}={v:.1f}" for k, v in p50s.items()))
+        for q in (50, 90):
+            row = {
+                k: pct([float(p.get(k, 0.0)) for p in phases], q)
+                for k in keys
+            }
+            print(
+                f"warm phases p{q}: "
+                + "  ".join(f"{k}={v:.1f}" for k, v in row.items())
+            )
         return samples
     finally:
         executor.shutdown()

@@ -326,3 +326,65 @@ async def test_sandbox_unshare_hides_storage_root(storage, tmp_path, native_bina
             assert "visible []" in r3.stdout, r3.stdout
     finally:
         executor.shutdown()
+
+
+async def test_background_refill_concurrency_is_bounded(native_executor):
+    """Refill spawns are CPU-bound (each boots a python warm worker); they
+    go through a semaphore so a burst cannot starve the serving path. The
+    request-blocking spawn (pool empty) deliberately bypasses the gate."""
+    live = 0
+    high_water = 0
+    real_spawn = native_executor.spawn_sandbox
+
+    async def counting_spawn(wait_warm=True):
+        nonlocal live, high_water
+        live += 1
+        high_water = max(high_water, live)
+        try:
+            return await real_spawn(wait_warm=wait_warm)
+        finally:
+            live -= 1
+
+    native_executor.spawn_sandbox = counting_spawn
+    native_executor._refill_gate = asyncio.Semaphore(1)
+    native_executor._config.executor_pod_queue_target_length = 4
+    await native_executor.fill_sandbox_queue()
+    assert native_executor.pool_ready_count == 4
+    assert high_water == 1  # gate held refills to one at a time
+
+
+async def test_drained_pool_dispatches_before_preload_completes(
+    storage, tmp_path, native_binary
+):
+    """With an empty pool, execute() must not sit in the healthz poll loop
+    waiting for preload-done — the server itself gates dispatch on its warm
+    worker, so the request overlaps the preload tail instead."""
+    from bee_code_interpreter_tpu.services.native_process_code_executor import (
+        NativeProcessCodeExecutor,
+    )
+
+    config = Config(
+        executor_backend="local",
+        local_executor_binary=str(native_binary),
+        local_workspace_root=str(tmp_path / "ws"),
+        disable_dep_install=True,
+        executor_pod_queue_target_length=0,  # never any warm pool
+        execution_timeout_s=30.0,
+        pod_ready_timeout_s=20.0,
+        shim_dir="none",
+    )
+    executor = NativeProcessCodeExecutor(storage=storage, config=config)
+    try:
+        seen: list[bool] = []
+        real_spawn = executor.spawn_sandbox
+
+        async def recording_spawn(wait_warm=True):
+            seen.append(wait_warm)
+            return await real_spawn(wait_warm=wait_warm)
+
+        executor.spawn_sandbox = recording_spawn
+        result = await executor.execute("print(6 * 7)")
+        assert result.stdout == "42\n" and result.exit_code == 0
+        assert seen and seen[0] is False  # request path skipped the warm wait
+    finally:
+        executor.shutdown()

@@ -105,11 +105,14 @@ async def test_midscript_optout_takes_effect(shimmed_executor):
 
 
 async def test_chainloaded_sitecustomize_defers_patch(shimmed_executor, tmp_path):
-    # Round-1 weak #1 root cause: the image's own (chained) sitecustomize
-    # imported numpy during platform init and the import hook installed the
-    # reroute right then — before the request env was even visible. Imports
-    # made while the chained sitecustomize executes must NOT trigger patches;
-    # the first user-level import still must.
+    # Two deferral layers under test. (1) The chained (image) sitecustomize
+    # itself no longer runs at interpreter start — it costs ~1 s of
+    # accelerator-plugin import in real images, so it fires at the first
+    # accelerator-adjacent import (here: a torch_xla attempt; even a failing
+    # import must trigger it first). (2) Round-1 weak #1 root cause: imports
+    # made WHILE the chained sitecustomize executes are platform
+    # infrastructure and must not trigger patches; the first user-level
+    # import still must.
     site_dir = tmp_path / "image-site"
     site_dir.mkdir()
     (site_dir / "sitecustomize.py").write_text(
@@ -121,11 +124,39 @@ async def test_chainloaded_sitecustomize_defers_patch(shimmed_executor, tmp_path
         "         bool(getattr(np, '__bci_xla_rerouted__', False))}, f)\n"
     )
     result = await shimmed_executor.execute(
-        "import json\n"
-        "import numpy as np\n"  # the *user* import: patch applies here
+        "import json, os\n"
+        "print(os.path.exists('chainprobe.json'))\n"  # chain still deferred
+        "try:\n"
+        "    import torch_xla\n"  # accelerator-adjacent: fires the chain
+        "except ImportError:\n"
+        "    pass\n"
         "probe = json.load(open('chainprobe.json'))\n"
+        "import numpy as np\n"  # the *user* import: patch applies here
         "print(probe['proxied_during_chain'])\n"
         "print(bool(getattr(np, '__bci_xla_rerouted__', False)))\n",
+        env={"JAX_PLATFORMS": "cpu", "PYTHONPATH": str(site_dir)},
+    )
+    assert result.exit_code == 0, result.stderr
+    assert result.stdout == "False\nFalse\nTrue\n"
+
+
+async def test_chain_fires_via_importlib_too(shimmed_executor, tmp_path):
+    # importlib.import_module bypasses builtins.__import__ entirely — the
+    # chain tripwire is a meta-path finder precisely so plugin/entry-point
+    # style loading still primes the image's site hooks first.
+    site_dir = tmp_path / "image-site"
+    site_dir.mkdir()
+    (site_dir / "sitecustomize.py").write_text(
+        "with open('chained.flag', 'w') as f:\n    f.write('yes')\n"
+    )
+    result = await shimmed_executor.execute(
+        "import importlib, os\n"
+        "print(os.path.exists('chained.flag'))\n"
+        "try:\n"
+        "    importlib.import_module('torch_xla')\n"
+        "except ImportError:\n"
+        "    pass\n"
+        "print(os.path.exists('chained.flag'))\n",
         env={"JAX_PLATFORMS": "cpu", "PYTHONPATH": str(site_dir)},
     )
     assert result.exit_code == 0, result.stderr
