@@ -45,7 +45,7 @@ def beam_search(
     best-first, [B, W] scores).
     """
     c = config
-    if c.n_experts:
+    if not c.moe_exact:
         # capacity-based MoE routes all B·W beam rows in one competing pool,
         # so a beam's tokens/score would depend on which sibling beams share
         # the batch and the score-equals-rescoring pin breaks — same
@@ -55,9 +55,14 @@ def beam_search(
         # PROVES it (identical rows, different logits by pool position once
         # capacity saturates); decoupling would need per-beam routing pools,
         # which forfeits the batched expert matmul the MoE path exists for
+        # (moe_exact — dropless + per-token groups — removes the
+        # competition: no eviction → per-token independent routing →
+        # sibling beams decouple bitwise)
         raise NotImplementedError(
-            "beam_search requires a dense config (MoE routing pools couple "
-            "sibling beams); use Transformer.generate_cached for MoE"
+            "beam_search requires a moe_exact config — dense, or MoE with "
+            "moe_dropless + moe_group_size=1 (capacity routing pools "
+            "couple sibling beams); use Transformer.generate_cached for "
+            "capacity-routed MoE"
         )
     W = beam_size
     if W < 1:

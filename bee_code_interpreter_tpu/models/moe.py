@@ -57,9 +57,23 @@ def init_moe_params(
 
 
 def expert_capacity(
-    n_tokens: int, n_experts: int, top_k: int, capacity_factor: float
+    n_tokens: int,
+    n_experts: int,
+    top_k: int,
+    capacity_factor: float,
+    dropless: bool = False,
 ) -> int:
-    """Per-expert capacity slots, rounded up to 8 (sublane-friendly tiles)."""
+    """Per-expert capacity slots, rounded up to 8 (sublane-friendly tiles).
+
+    ``dropless`` sizes capacity to the worst case — every token in the group
+    choosing this expert — so no token can ever be evicted. That makes
+    routing per-token independent: a token's expert assignment and combine
+    weights depend only on its own router logits, never on batch-mates
+    competing for slots. Cost: dispatch/combine grow to [g, E, g] per group
+    (quadratic in group size) — affordable for decode-sized groups, which is
+    what serving-exactness needs it for."""
+    if dropless:
+        return max(8, -(-n_tokens // 8) * 8)
     raw = capacity_factor * n_tokens * top_k / n_experts
     return max(8, int(math.ceil(raw / 8)) * 8)
 
@@ -121,6 +135,7 @@ def moe_mlp(
     capacity_factor: float = 1.25,
     dtype=jnp.bfloat16,
     group_size: int = 1024,
+    dropless: bool = False,
 ) -> tuple[jax.Array, jax.Array]:
     """Returns (output [B, L, D], aux load-balancing loss scalar f32).
 
@@ -139,7 +154,7 @@ def moe_mlp(
     if G % n_groups != 0:
         n_groups = 1
     g = G // n_groups
-    C = expert_capacity(g, n_experts, top_k, capacity_factor)
+    C = expert_capacity(g, n_experts, top_k, capacity_factor, dropless)
 
     xg = xf.reshape(n_groups, g, D)
     dispatch, combine, aux = jax.vmap(

@@ -24,15 +24,24 @@ per request (pinned by tests/test_serving.py) — batching other requests
 alongside cannot change a request's output, which is the correctness bar
 for continuous batching.
 
-That bar applies to DENSE configs. Capacity-based MoE routing pools couple
-whatever tokens share a forward pass (an inherent property of the GShard
-scheme — tests/test_moe.py documents that even solo decode-vs-forward only
-matches drop-free), so MoE requests here route against their batch-mates
-and the padded admission prompt: outputs are deterministic per pool state
-but not pinned equal to solo decode. Speculative mode and the prefix cache
-refuse MoE outright because their guarantees are exactness claims; plain
-serving keeps MoE usable under the same documented caveat as the rest of
+That bar applies to every ``config.moe_exact`` config — dense, or MoE
+with ``moe_dropless`` + ``moe_group_size=1``.
+Capacity-based MoE routing pools couple whatever tokens share a forward
+pass (an inherent property of the GShard scheme — tests/test_moe.py
+documents that even solo decode-vs-forward only matches drop-free), so
+capacity-routed MoE requests here route against their batch-mates and the
+padded admission prompt: outputs are deterministic per pool state but not
+pinned equal to solo decode. Speculative mode and the prefix cache refuse
+capacity-routed MoE because their guarantees are exactness claims; plain
+serving keeps it usable under the same documented caveat as the rest of
 the decode family (pinned deterministic by tests/test_serving_stops.py).
+With ``moe_dropless`` (worst-case expert capacity: no token can ever be
+evicted) plus per-token routing groups (``moe_group_size=1``, making pool
+size a mere batch dim of the expert einsums) routing is bitwise per-token
+independent, the solo-equality pin holds (tests/test_serving.py), and
+every serving feature accepts the config. The price is every token paying
+all E experts' MLPs — an inference-exactness configuration, not a
+training one.
 
 Sampling is PER REQUEST (temperature / top-k / top-p / seed — the
 heterogeneity serving actually needs) and runs host-side on the step's
@@ -358,16 +367,19 @@ class ContinuousBatcher:
         self.draft_params = draft_params
         self.draft_config = draft_config
         self.gamma = gamma
-        if prefix_cache and config.n_experts:
+        if prefix_cache and not config.moe_exact:
             # capacity-based MoE routing pools couple tokens that share a
             # forward pass: the suffix-only prefill routes W tokens where
             # the full prefill routes L, so shared-prefix K/V would stop
             # being the K/V an unshared admission computes — the same
             # routing-pool hazard beam/speculative refuse
-            # (tests/test_beam.py::test_moe_routing_pool_coupling_demonstrated)
+            # (tests/test_beam.py::test_moe_routing_pool_coupling_demonstrated).
+            # moe_exact (dropless + per-token groups) removes the coupling
+            # bitwise, so those configs pass.
             raise NotImplementedError(
-                "prefix_cache requires a dense config (MoE routing pools "
-                "differ between suffix-only and full prefill)"
+                "prefix_cache requires a moe_exact config — dense, or MoE "
+                "with moe_dropless + moe_group_size=1 (capacity routing "
+                "pools differ between suffix-only and full prefill)"
             )
         self.prefix_cache_enabled = prefix_cache
         self.lora_scale = float(lora_scale)
@@ -394,11 +406,14 @@ class ContinuousBatcher:
         if draft_config is not None:
             if draft_config.vocab_size != config.vocab_size:
                 raise ValueError("target and draft must share a vocabulary")
-            if config.n_experts:
+            if not config.moe_exact:
                 # same routing-pool hazard speculative_generate refuses:
                 # tests/test_beam.py::test_moe_routing_pool_coupling_demonstrated
+                # (moe_exact targets route per-token independently, so the
+                # verify window and plain decode agree bitwise)
                 raise NotImplementedError(
-                    "speculative serving requires a dense target"
+                    "speculative serving requires a moe_exact target — "
+                    "dense, or MoE with moe_dropless + moe_group_size=1"
                 )
             if gamma < 1:
                 raise ValueError(f"gamma must be >= 1, got {gamma}")

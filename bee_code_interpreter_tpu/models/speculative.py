@@ -70,7 +70,7 @@ def speculative_generate(
     tc, dc = target_config, draft_config
     if tc.vocab_size != dc.vocab_size:
         raise ValueError("target and draft must share a vocabulary")
-    if tc.n_experts:
+    if not tc.moe_exact:
         # capacity-based MoE routing depends on the routing-pool size: the
         # verify window routes B·(γ+1) tokens where plain greedy decode
         # routes B·1, so under capacity pressure the two can drop different
@@ -79,10 +79,15 @@ def speculative_generate(
         # guard); MoE DRAFTS are fine — drafts only propose. The hazard is
         # proven executable in tests/test_beam.py::
         # test_moe_routing_pool_coupling_demonstrated.
+        # (moe_exact targets — dropless + per-token groups — route each
+        # token independently: window size stops mattering and the
+        # exactness guarantee holds bitwise)
         raise NotImplementedError(
-            "speculative_generate requires a dense target (MoE routing "
+            "speculative_generate requires a moe_exact target — dense, or "
+            "MoE with moe_dropless + moe_group_size=1 (capacity routing "
             "pools differ between the verify window and plain decode); "
-            "use Transformer.generate_cached for MoE targets"
+            "use Transformer.generate_cached for capacity-routed MoE "
+            "targets"
         )
     B, L = prompt.shape
     if max_new_tokens < 1:

@@ -65,6 +65,16 @@ class TransformerConfig:
     moe_capacity_factor: float = 1.25
     moe_aux_weight: float = 1e-2
     moe_group_size: int = 1024  # GShard routing-group size (memory bound)
+    # Dropless routing: capacity sized to the worst case so no token is ever
+    # evicted — routing becomes per-token independent. With
+    # moe_group_size=1 on top (each token routes in its own group, so the
+    # expert einsums see pool size only as a batch dim) the forward is
+    # BITWISE batch-independent, which restores the batch-isolation /
+    # solo-equality bar for SERVING MoE configs — see `moe_exact` below;
+    # the guards in serving/beam/speculative key on it. Cost: every token
+    # pays all E experts' MLPs (E/top_k × the routed FLOPs) — the price of
+    # exactness, not the training configuration.
+    moe_dropless: bool = False
     # RoPE linear position interpolation (context extension): effective
     # position = position / rope_scaling. 1.0 = off; e.g. 4.0 runs a model
     # trained at max_seq_len L with positions compressed from 4L into the
@@ -107,6 +117,21 @@ class TransformerConfig:
         # SwiGLU sizing, rounded to 256 for MXU-friendly tiles
         raw = int(8 * self.d_model / 3)
         return (raw + 255) // 256 * 256
+
+    @property
+    def moe_exact(self) -> bool:
+        """True when per-request outputs are bitwise independent of batch
+        composition — dense configs always; MoE configs under dropless
+        per-token routing (moe_dropless + moe_group_size=1: no capacity
+        eviction, and the expert einsums see the pool only as a batch
+        dim). The exactness-claiming features (serving solo-equality,
+        prefix cache, speculative verify, beam rescoring) key on this;
+        dropless with larger groups is deterministic and ulp-stable but
+        reduction tiling varies with pool shape, so near-exact logit ties
+        could flip a token."""
+        return self.n_experts == 0 or (
+            self.moe_dropless and self.moe_group_size == 1
+        )
 
     @classmethod
     def tiny(cls) -> "TransformerConfig":
@@ -423,7 +448,7 @@ def _mlp_block(
             layer["moe"], y,
             n_experts=c.n_experts, top_k=c.moe_top_k,
             capacity_factor=c.moe_capacity_factor, dtype=c.dtype,
-            group_size=c.moe_group_size,
+            group_size=c.moe_group_size, dropless=c.moe_dropless,
         )
     gate = qeinsum("bld,df->blf", y, layer["w_gate"], c.dtype)
     up = qeinsum("bld,df->blf", y, layer["w_up"], c.dtype)

@@ -12,6 +12,18 @@ as a latency driver).
 The C++ executor implements the same algorithm (executor/dep_guess.cpp) against
 the same table file so both executors agree; this module is also the unit-test
 oracle for that file format.
+
+Coverage stance vs upm's pypi_map.sqlite (reference executor/Dockerfile:124-126):
+upm ships a full PyPI-derived table; this environment has no egress, so that
+table cannot be fetched or diffed against. What IS guaranteed, by tests:
+~600 curated entries covering every rename in the executor image's own stack
+(harvested from installed-dist metadata via ``scripts/generate-pypi-map.py
+--harvest``) plus the high-traffic aliases LLM-generated code hits; C++/Python
+parity over the ENTIRE map (tests/test_native_executor.py); and identity
+fallback for everything else — pip normalizes case/underscore itself, so only
+true renames belong here. A wrong invented mapping would pip-install the wrong
+package (dependency-confusion shaped), which is why the long tail is curated
+rather than bulk-generated.
 """
 
 from __future__ import annotations
@@ -188,7 +200,6 @@ PYPI_MAP: dict[str, str] = {
     "sendgrid": "sendgrid",
     "boto3": "boto3",
     "botocore": "botocore",
-    "azure": "azure",
     "kubernetes": "kubernetes",
     "docker": "docker",
     "kafka": "kafka-python",
@@ -401,8 +412,9 @@ PYPI_MAP.update({
     "elftools": "pyelftools",
     "grpc_status": "grpcio-status",
     "grpc_tools": "grpcio-tools",
-    # (orbax / haiku deliberately absent: those imports are in SKIP — the
-    # pinned accelerator stack must never be auto-installed)
+    # (orbax / haiku map entries exist for completeness below, but those
+    # imports are in SKIP — the pinned accelerator stack must never be
+    # auto-installed; SKIP wins before the map is consulted)
     "markdown_it": "markdown-it-py",
     "opentelemetry": "opentelemetry-api",
     "proto": "proto-plus",
@@ -620,6 +632,51 @@ PYPI_MAP.update({
     # -- science ---------------------------------------------------------
     "chembl_webresource_client": "chembl-webresource-client",
     "hijri_converter": "hijri-converter",
+    # -- long-tail renames (r5): harvested from installed-dist metadata
+    # (scripts/generate-pypi-map.py --harvest) plus curated well-known
+    # import!=dist pairs. Only REAL renames are listed — pip normalizes
+    # case/underscore/dash itself, so identity entries add nothing.
+    "haiku": "dm-haiku",
+    "functorch": "torch",
+    "orbax": "orbax-checkpoint",
+    "pasta": "google-pasta",
+    "xdist": "pytest-xdist",
+    "Xlib": "python-xlib",
+    "vlc": "python-vlc",
+    "apiclient": "google-api-python-client",  # legacy alias still in tutorials
+    "z3": "z3-solver",
+    "pysat": "python-sat",
+    "arango": "python-arango",
+    "pulsar": "pulsar-client",
+    "stomp": "stomp.py",
+    "ldap": "python-ldap",
+    "saml2": "pysaml2",
+    "onelogin": "python3-saml",
+    "mastodon": "Mastodon.py",
+    "ax": "ax-platform",
+    "skopt": "scikit-optimize",
+    "bayes_opt": "bayesian-optimization",
+    "graphql": "graphql-core",
+    "stdnum": "python-stdnum",
+    "doctr": "python-doctr",
+    "antlr4": "antlr4-python3-runtime",
+    "keystone": "keystone-engine",
+    "pwn": "pwntools",
+    "miio": "python-miio",
+    "kasa": "python-kasa",
+    "board": "Adafruit-Blinka",
+    "busio": "Adafruit-Blinka",
+    "iris": "scitools-iris",
+    "allel": "scikit-allel",
+    "libarchive": "libarchive-c",
+    "lru": "lru-dict",
+    "benedict": "python-benedict",
+    "telebot": "pyTelegramBotAPI",
+    "facebook": "facebook-sdk",
+    "atlassian": "atlassian-python-api",
+    "trello": "py-trello",
+    "shopify": "ShopifyAPI",
+    "plaid": "plaid-python",
 })
 
 # Names that must never be pip-installed: provided by the OS/image, or aliases
@@ -630,8 +687,10 @@ PYPI_MAP.update({
 SKIP: frozenset[str] = frozenset(
     {
         # accelerator stack — pinned in the image, never reinstall
-        "jax", "jaxlib", "libtpu", "torch", "torch_xla", "flax", "optax",
-        "orbax", "chex", "haiku", "pallas",
+        # (functorch ships inside torch: its map entry resolves to torch,
+        # which must stay pinned, so the import is skipped outright)
+        "jax", "jaxlib", "libtpu", "torch", "torch_xla", "functorch",
+        "flax", "optax", "orbax", "chex", "haiku", "pallas",
         # OS-package-provided tools that upm-style guessers misattribute.
         # NOT "ffmpeg": that import is a real pip dist (ffmpeg-python) and
         # PYPI_MAP redirects it — skipping here would block the install.
@@ -647,7 +706,17 @@ SKIP: frozenset[str] = frozenset(
 # obsolete `google` dist while the user's import stays broken, so the guesser
 # retains one more path component under these prefixes and the map keys on the
 # level that actually identifies a distribution.
-NAMESPACE_PREFIXES: frozenset[str] = frozenset({"google", "google.cloud"})
+NAMESPACE_PREFIXES: frozenset[str] = frozenset({
+    "google", "google.cloud",
+    # azure is a pure PEP-420 namespace: the top level installs nothing and
+    # each second-level (or keyvault/mgmt/storage third-level) component is
+    # its own distribution, named by the dots→dashes convention the
+    # unmapped-namespace fallback already applies (azure.storage.blob →
+    # azure-storage-blob).
+    "azure", "azure.storage", "azure.keyvault", "azure.mgmt",
+    "azure.search", "azure.ai", "azure.data", "azure.communication",
+    "azure.monitor", "azure.iot", "azure.synapse",
+})
 
 
 def _retained_name(dotted: str) -> str:

@@ -42,8 +42,8 @@ inline std::string normalize(std::string name) {
 // (mirrors dep_guess.py SKIP; reference requirements-skip.txt:1-26).
 inline const std::set<std::string>& builtin_skip() {
   static const std::set<std::string> skip = {
-      "jax", "jaxlib", "libtpu", "torch", "torch_xla", "flax", "optax",
-      "orbax", "chex", "haiku", "pallas",
+      "jax", "jaxlib", "libtpu", "torch", "torch_xla", "functorch",
+      "flax", "optax", "orbax", "chex", "haiku", "pallas",
       // NOT "ffmpeg": that import maps to the real ffmpeg-python dist.
       "pandoc", "magick", "imagemagick",
       "bee_code_interpreter_tpu",
@@ -56,7 +56,14 @@ inline const std::set<std::string>& builtin_skip() {
 // path component under these so the map can key on the level that actually
 // identifies a distribution ("google.protobuf" -> protobuf).
 inline const std::set<std::string>& namespace_prefixes() {
-  static const std::set<std::string> prefixes = {"google", "google.cloud"};
+  static const std::set<std::string> prefixes = {
+      "google", "google.cloud",
+      // azure: pure PEP-420 namespace; per-component dists follow the
+      // dots->dashes convention the unmapped fallback applies
+      "azure", "azure.storage", "azure.keyvault", "azure.mgmt",
+      "azure.search", "azure.ai", "azure.data", "azure.communication",
+      "azure.monitor", "azure.iot", "azure.synapse",
+  };
   return prefixes;
 }
 

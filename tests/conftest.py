@@ -126,3 +126,31 @@ def http_app(local_executor):
         custom_tool_executor=CustomToolExecutor(code_executor=local_executor),
     )
 
+
+
+# ---------------------------------------------------------------- fast lane
+# The model/serving/parallelism suites jit-compile dozens of programs and the
+# e2e suites boot real services — together they dominate the ~35 min full
+# run. `pytest -m "not slow"` is the inner loop: service + executor contract
+# tests in a few minutes. The full suite is unchanged (markers only).
+SLOW_TEST_MODULES = {
+    "test_baseline_configs", "test_beam", "test_bench", "test_bench_mfu",
+    "test_checkpoint", "test_chunked_prefill", "test_engine",
+    "test_example_payloads", "test_flash_attention", "test_hf_loader",
+    "test_kv_cache", "test_local_code_executor", "test_lora", "test_models",
+    "test_moe", "test_multihost_distributed", "test_multilora_serving",
+    "test_paged_attention", "test_paged_kv_cache", "test_parallel",
+    "test_pipeline", "test_pipeline_transformer", "test_prefix_cache",
+    "test_serving", "test_serving_stops", "test_sliding_window",
+    "test_speculative", "test_speculative_sampling", "test_text_engine",
+    "test_ulysses", "test_vision", "test_vit", "test_weight_quant",
+    "test_xla_reroute",
+}
+
+
+def pytest_collection_modifyitems(config, items):
+    for item in items:
+        module = item.nodeid.split("::", 1)[0]
+        name = Path(module).stem
+        if name in SLOW_TEST_MODULES or "/e2e/" in module:
+            item.add_marker(pytest.mark.slow)

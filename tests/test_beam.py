@@ -76,7 +76,7 @@ def test_beam_size_validated():
 def test_moe_config_rejected():
     config = dataclasses.replace(cfg(), n_experts=4)
     params = T.init_params(config, jax.random.PRNGKey(0))
-    with pytest.raises(NotImplementedError, match="dense config"):
+    with pytest.raises(NotImplementedError, match="moe_exact"):
         beam_search(params, config, jnp.zeros((1, 4), jnp.int32))
 
 
@@ -133,3 +133,25 @@ def test_zero_max_new_tokens_rejected():
         beam_search(
             params, config, jnp.zeros((1, 4), jnp.int32), max_new_tokens=0
         )
+
+
+def test_moe_dropless_beam_accepted_and_beam_one_equals_greedy():
+    """moe_dropless removes the sibling-beam coupling (no eviction → per-
+    token independent routing): beam search accepts the config and the
+    beam=1 ≡ greedy pin holds exactly like the dense case."""
+    config = dataclasses.replace(
+        T.TransformerConfig.tiny_moe(), moe_dropless=True,
+        moe_group_size=1, dtype=jnp.float32
+    )
+    params = T.init_params(config, jax.random.PRNGKey(0))
+    prompt = jax.random.randint(jax.random.PRNGKey(1), (2, 5), 0,
+                                config.vocab_size)
+    want = T.Transformer(config).generate_cached(params, prompt,
+                                                 max_new_tokens=5)
+    got = beam_search(params, config, prompt, max_new_tokens=5, beam_size=1)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+    # wider beams run too (the guard is fully lifted, not special-cased)
+    seqs, scores = beam_search(params, config, prompt, max_new_tokens=3,
+                               beam_size=3, return_all=True)
+    assert seqs.shape == (2, 3, prompt.shape[1] + 3)
+    assert bool(np.all(np.diff(np.asarray(scores), axis=1) <= 1e-6))

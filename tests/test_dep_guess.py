@@ -140,4 +140,52 @@ def test_map_size_floor():
     # alone (~340 rows) would silently reopen the alias gap.
     from bee_code_interpreter_tpu.runtime.dep_guess import PYPI_MAP
 
-    assert len(PYPI_MAP) >= 550
+    assert len(PYPI_MAP) >= 590
+
+
+def test_azure_namespace_resolves_per_component():
+    # azure is a pure PEP-420 namespace: the bare import installs nothing,
+    # every component maps by the dots->dashes convention, down to the
+    # keyvault/mgmt/storage third level.
+    src = (
+        "import azure\n"
+        "from azure.identity import DefaultAzureCredential\n"
+        "from azure.storage.blob import BlobServiceClient\n"
+        "import azure.cosmos\n"
+        "from azure.keyvault.secrets import SecretClient\n"
+        "import azure.mgmt.compute\n"
+    )
+    assert guess_dependencies(src) == [
+        "azure-cosmos", "azure-identity", "azure-keyvault-secrets",
+        "azure-mgmt-compute", "azure-storage-blob",
+    ]
+    # third-level namespaces beyond storage/keyvault/mgmt (review r5: the
+    # two-level truncation resolved these to real-but-deprecated dists)
+    deep = (
+        "from azure.search.documents import SearchClient\n"
+        "import azure.ai.ml\n"
+        "from azure.data.tables import TableClient\n"
+        "import azure.monitor.query\n"
+        "import azure.iot.device\n"
+    )
+    assert guess_dependencies(deep) == [
+        "azure-ai-ml", "azure-data-tables", "azure-iot-device",
+        "azure-monitor-query", "azure-search-documents",
+    ]
+
+
+def test_r5_long_tail_aliases_resolve():
+    src = (
+        "import pwn\nimport z3\nimport skopt\nimport telebot\n"
+        "import board, busio\n"
+    )
+    assert guess_dependencies(src) == [
+        "Adafruit-Blinka", "pwntools", "pyTelegramBotAPI",
+        "scikit-optimize", "z3-solver",
+    ]
+    # haiku maps to dm-haiku but sits in the accelerator-stack SKIP set
+    # (image-pinned); the alias must never trigger a reinstall
+    assert guess_dependencies("import haiku\n") == []
+    # functorch resolves to torch, which is pinned: SKIP must win even
+    # when the deployment's preinstalled set omits torch (review r5)
+    assert guess_dependencies("import functorch\n") == []

@@ -189,3 +189,57 @@ def test_group_capacity_is_bounded_by_group_size_not_global():
     grouped_elems = 16 * 1024 * 8 * per_group
     single_elems = 16384 * 8 * single_group_16x
     assert grouped_elems * 8 <= single_elems
+
+
+def test_dropless_capacity_never_drops():
+    # Worst case: a router so biased every token top-1s the same expert.
+    # Dropless capacity must carry all of them (dispatch mass == top_k per
+    # token); the default factor provably drops in the same setup.
+    E, g, D = 4, 32, 16
+    params = init_moe_params(jax.random.PRNGKey(0), D, 32, E)
+    params["router"] = params["router"].at[:].set(0.0)
+    params["router"] = params["router"].at[:, 0].set(10.0)  # everyone → e0
+    x = jax.random.normal(jax.random.PRNGKey(1), (1, g, D), jnp.float32)
+    from bee_code_interpreter_tpu.models.moe import _route_group, expert_capacity
+
+    xf = x.reshape(g, D)
+    C_drop = expert_capacity(g, E, 2, 1.25)
+    C_free = expert_capacity(g, E, 2, 1.25, dropless=True)
+    assert C_free >= g  # every token fits even if all pick one expert
+    d_drop, _, _ = _route_group(xf, params["router"], n_experts=E, top_k=2,
+                                capacity=C_drop)
+    d_free, _, _ = _route_group(xf, params["router"], n_experts=E, top_k=2,
+                                capacity=C_free)
+    per_token_drop = np.asarray(jnp.sum(d_drop, axis=(1, 2)))
+    per_token_free = np.asarray(jnp.sum(d_free, axis=(1, 2)))
+    assert (per_token_drop < 2).any()  # default factor drops here
+    np.testing.assert_array_equal(per_token_free, np.full(g, 2.0))
+
+
+def test_dropless_routing_is_batch_independent():
+    # The serving-exactness property at its root: a row's forward output
+    # must not change when other rows join the routing pool. With per-token
+    # groups (moe_group_size=1) the pool size is only a batch dim of the
+    # expert einsums, so equality is BITWISE (config.moe_exact). With a
+    # shared group, capacity scales with the pool, reduction tiling varies
+    # with the shape, and equality holds only to reduction-order ulps —
+    # which is why moe_exact requires the per-token grouping.
+    import dataclasses as dc
+
+    toks_key, init_key = jax.random.PRNGKey(1), jax.random.PRNGKey(0)
+    for group_size, exact in ((1, True), (1024, False)):
+        config = dc.replace(T.TransformerConfig.tiny_moe(),
+                            moe_dropless=True, moe_group_size=group_size,
+                            dtype=jnp.float32)
+        assert config.moe_exact is exact
+        params = T.init_params(config, init_key)
+        toks = jax.random.randint(toks_key, (4, 6), 0, config.vocab_size)
+        solo = T.forward(params, toks[:1], config)
+        batch = T.forward(params, toks, config)
+        if exact:
+            np.testing.assert_array_equal(np.asarray(solo[0]),
+                                          np.asarray(batch[0]))
+        else:
+            np.testing.assert_allclose(np.asarray(solo[0]),
+                                       np.asarray(batch[0]),
+                                       atol=1e-5, rtol=1e-4)

@@ -494,3 +494,62 @@ def test_int8_pool_matches_solo_int8_decode():
     reqs = [b.submit(p, 5) for p in prompts]
     b.run_to_completion()
     assert [b.result(r) for r in reqs] == want
+
+
+def moe_dropless_cfg():
+    return dataclasses.replace(
+        T.TransformerConfig.tiny_moe(), moe_dropless=True,
+        moe_group_size=1, dtype=jnp.float32
+    )
+
+
+def test_moe_dropless_serving_matches_solo_decode():
+    """With dropless routing no token can be evicted, so routing is per-
+    token independent and the batcher's solo-equality bar — previously
+    dense-only — extends to MoE: each request's output equals its own solo
+    greedy decode, whatever shares the batch."""
+    config = moe_dropless_cfg()
+    params = T.init_params(config, jax.random.PRNGKey(0))
+    prompts = [
+        np.asarray(jax.random.randint(jax.random.PRNGKey(i + 1), (L,), 0,
+                                      config.vocab_size))
+        for i, L in enumerate([3, 7, 5])
+    ]
+    want = [reference_tokens(params, config, p, 5) for p in prompts]
+    b = ContinuousBatcher(
+        params, config, max_batch=2, n_pages=32, page_size=4,
+        max_pages_per_seq=8,
+    )
+    r0 = b.submit(prompts[0], 5)
+    b.step()  # staggered admission: r1 joins mid-decode of r0
+    r1 = b.submit(prompts[1], 5)
+    b.run_to_completion()
+    r2 = b.submit(prompts[2], 5)
+    b.run_to_completion()
+    assert b.result(r0) == want[0]
+    assert b.result(r1) == want[1]
+    assert b.result(r2) == want[2]
+
+
+def test_moe_dropless_prefix_cache_accepted_and_exact():
+    """The prefix-cache guard lifts for dropless configs: shared-prefix
+    admissions reuse pages AND still reproduce solo decode exactly."""
+    config = moe_dropless_cfg()
+    params = T.init_params(config, jax.random.PRNGKey(0))
+    shared = np.asarray(jax.random.randint(jax.random.PRNGKey(9), (8,), 0,
+                                           config.vocab_size))
+    p1 = np.concatenate([shared, [1, 2]])
+    p2 = np.concatenate([shared, [3]])
+    want1 = reference_tokens(params, config, p1, 4)
+    want2 = reference_tokens(params, config, p2, 4)
+    b = ContinuousBatcher(
+        params, config, max_batch=2, n_pages=32, page_size=4,
+        max_pages_per_seq=8, prefix_cache=True,
+    )
+    r1 = b.submit(p1, 4)
+    b.run_to_completion()
+    r2 = b.submit(p2, 4)  # shares the prefix pages of r1
+    b.run_to_completion()
+    assert b.prefix_stats["hits"] >= 1
+    assert b.result(r1) == want1
+    assert b.result(r2) == want2

@@ -115,7 +115,7 @@ def test_moe_target_rejected():
     params = T.init_params(config, jax.random.PRNGKey(0))
     draft = cfg()
     draft_params = T.init_params(draft, jax.random.PRNGKey(1))
-    with pytest.raises(NotImplementedError, match="dense target"):
+    with pytest.raises(NotImplementedError, match="moe_exact"):
         speculative_generate(
             params, config, draft_params, draft,
             jnp.zeros((1, 4), jnp.int32),
@@ -137,5 +137,27 @@ def test_int8_target_cache_exact():
     got = speculative_generate(
         params, config, draft_params, draft_config, prompt,
         max_new_tokens=8, gamma=3,
+    )
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_moe_dropless_target_accepted_and_exact():
+    """A moe_dropless target routes per-token independently, so the verify
+    window's pool size stops mattering: speculative output must equal the
+    target's own greedy decode, token for token."""
+    config = dataclasses.replace(
+        T.TransformerConfig.tiny_moe(), moe_dropless=True,
+        moe_group_size=1, dtype=jnp.float32
+    )
+    params = T.init_params(config, jax.random.PRNGKey(0))
+    draft = cfg()
+    draft_params = T.init_params(draft, jax.random.PRNGKey(1))
+    prompt = jax.random.randint(jax.random.PRNGKey(2), (2, 5), 0,
+                                config.vocab_size)
+    want = T.Transformer(config).generate_cached(params, prompt,
+                                                 max_new_tokens=6)
+    got = speculative_generate(
+        params, config, draft_params, draft, prompt, max_new_tokens=6,
+        gamma=3,
     )
     np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
