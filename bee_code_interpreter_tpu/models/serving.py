@@ -325,6 +325,7 @@ class ContinuousBatcher:
         prefix_cache: bool = False,
         adapters: list | None = None,
         lora_scale: float = 1.0,
+        mesh=None,
     ) -> None:
         """``draft_params``/``draft_config`` switch the batcher into
         SPECULATIVE mode: every step, the draft proposes ``gamma`` greedy
@@ -358,8 +359,38 @@ class ContinuousBatcher:
         unmerged per row; both use ``lora_scale`` (alpha/rank). The
         prefix cache keys pages by (adapter, tokens), so requests under
         different adapters never share K/V. Pinned equal to solo decode
-        on the merged params by tests/test_multilora_serving.py."""
+        on the merged params by tests/test_multilora_serving.py.
+
+        ``mesh`` turns on TENSOR-PARALLEL serving: params shard under the
+        Megatron specs (``transformer.shard_params``) and the K/V page
+        pool shards its head axis over the mesh's ``tp`` axis; the decode
+        /prefill/window programs compile under GSPMD, which inserts the
+        tp collectives (row-parallel psum, vocab-sharded logits gather)
+        — the host-side scheduling loop is unchanged. Requires
+        ``kv_heads % tp == 0`` (and the draft's, in speculative mode);
+        block tables and token streams stay replicated. The solo-equality
+        bar holds WITHIN a mesh (row independence is sharding-invariant);
+        cross-mesh token equality additionally holds in the pinned test
+        configs but reduction-order ulps make it environment-pinned, not
+        guaranteed (tests/test_serving_mesh.py)."""
         self.params = params
+        self.mesh = mesh
+        if mesh is not None:
+            from bee_code_interpreter_tpu.models.transformer import (
+                shard_params,
+            )
+
+            tp = mesh.shape.get("tp", 1)
+            if config.kv_heads % tp:
+                raise ValueError(
+                    f"kv_heads {config.kv_heads} not divisible by tp={tp}"
+                )
+            if draft_config is not None and draft_config.kv_heads % tp:
+                raise ValueError(
+                    f"draft kv_heads {draft_config.kv_heads} not divisible "
+                    f"by tp={tp}"
+                )
+            self.params = shard_params(params, config, mesh)
         self.config = config
         self.page_size = page_size
         self.eos_id = eos_id
@@ -418,6 +449,8 @@ class ContinuousBatcher:
             if gamma < 1:
                 raise ValueError(f"gamma must be >= 1, got {gamma}")
         self.cache = alloc_paged_cache(config, n_pages, page_size)
+        if mesh is not None:
+            self.cache = self._shard_pool(self.cache)
         self.block_table = np.full(
             (max_batch, max_pages_per_seq), _SCRATCH_PAGE, dtype=np.int32
         )
@@ -486,6 +519,11 @@ class ContinuousBatcher:
             self.draft_cache = alloc_paged_cache(
                 draft_config, n_pages, page_size
             )
+            if mesh is not None:
+                self.draft_params = shard_params(
+                    draft_params, draft_config, mesh
+                )
+                self.draft_cache = self._shard_pool(self.draft_cache)
             self._draft_decode = jax.jit(
                 functools.partial(decode_step_paged, config=draft_config),
                 donate_argnums=(3,),
@@ -501,6 +539,20 @@ class ContinuousBatcher:
                 functools.partial(decode_window_paged, config=draft_config),
                 donate_argnums=(3,),
             )
+
+    def _shard_pool(self, pool: dict) -> dict:
+        """Shard a page pool's kv-head axis over the mesh's tp axis (axis 2
+        of [n_layers, n_pages, kvh, ps, dh]; the int8 scale planes share
+        the leading dims, so the one spec covers every leaf). A mesh
+        without a tp axis replicates the pool — matching param_specs'
+        whichever-axes-exist stance."""
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        tp = "tp" if "tp" in self.mesh.axis_names else None
+        spec = NamedSharding(
+            self.mesh, PartitionSpec(None, None, tp, None, None)
+        )
+        return {k: jax.device_put(v, spec) for k, v in pool.items()}
 
     # ------------------------------------------------------------- admission
     def has_free_row(self) -> bool:

@@ -17,6 +17,7 @@ import socket
 import subprocess
 import sys
 import time
+from contextlib import contextmanager
 from pathlib import Path
 
 import pytest
@@ -38,46 +39,25 @@ class Service:
         self.log = log
 
 
-# The whole e2e suite runs once per backend: the pure-Python in-process
-# executor, (toolchain permitting) the native C++ executor-server pool, and
-# the REAL kubernetes executor fronted by a fake cluster CLI
-# (fake_kubectl.py) whose "pods" are native executor processes on distinct
-# loopback IPs — all must present identical behavior through the service API.
-@pytest.fixture(scope="session", params=["python", "native", "kubernetes"])
-def service(request, tmp_path_factory, native_binary):
-    tmp = tmp_path_factory.mktemp(f"e2e-{request.param}")
+@contextmanager
+def booted_service(tmp: Path, env_overrides: dict[str, str]):
+    """Boot the real service, gate on the gRPC health check (exactly like
+    the reference's `poe test`), yield a :class:`Service`, tear down. When
+    the overrides carry ``FAKE_KUBECTL_STATE``, any detached fake-cluster
+    pods the service didn't get to delete are swept at exit (a real cluster
+    outlives its clients; the fake must not leak processes)."""
     http_port, grpc_port = _free_port(), _free_port()
     log_path = tmp / "service.log"
-
     env = dict(os.environ)
     env.update(
-        APP_EXECUTOR_BACKEND="local",
         APP_HTTP_LISTEN_ADDR=f"127.0.0.1:{http_port}",
         APP_GRPC_LISTEN_ADDR=f"127.0.0.1:{grpc_port}",
         APP_FILE_STORAGE_PATH=str(tmp / "files"),
-        APP_LOCAL_WORKSPACE_ROOT=str(tmp / "workspaces"),
         APP_DISABLE_DEP_INSTALL="1",
         # Sandbox subprocesses must stay on the virtual CPU mesh in CI.
         JAX_PLATFORMS="cpu",
     )
-    if request.param == "native":
-        if native_binary is None:
-            pytest.skip("native toolchain unavailable")
-        env["APP_LOCAL_EXECUTOR_BINARY"] = str(native_binary)
-        # Keep warm-pool startup cheap for the test session.
-        env["APP_EXECUTOR_POD_QUEUE_TARGET_LENGTH"] = "2"
-    if request.param == "kubernetes":
-        if native_binary is None:
-            pytest.skip("native toolchain unavailable")
-        env.update(
-            APP_EXECUTOR_BACKEND="kubernetes",
-            APP_KUBECTL_PATH=str(Path(__file__).parent / "fake_kubectl.py"),
-            APP_EXECUTOR_POD_QUEUE_TARGET_LENGTH="2",
-            # wait --for=condition=Ready polls /healthz; pods boot in ~ms
-            APP_POD_READY_TIMEOUT_S="30",
-            FAKE_KUBECTL_STATE=str(tmp / "cluster"),
-            FAKE_KUBECTL_EXECUTOR_BINARY=str(native_binary),
-        )
+    env.update(env_overrides)
     log = open(log_path, "wb")
     proc = subprocess.Popen(
         [sys.executable, "-m", "bee_code_interpreter_tpu"],
@@ -87,7 +67,6 @@ def service(request, tmp_path_factory, native_binary):
         stderr=subprocess.STDOUT,
     )
 
-    # Gate on the health check exactly like the reference's `poe test`.
     from bee_code_interpreter_tpu import health_check
 
     deadline = time.monotonic() + 60
@@ -122,15 +101,48 @@ def service(request, tmp_path_factory, native_binary):
         except subprocess.TimeoutExpired:
             proc.kill()
         log.close()
-        if request.param == "kubernetes":
-            # fake pods run detached (a real cluster outlives its clients);
-            # sweep any the service didn't get to delete
+        cluster = env_overrides.get("FAKE_KUBECTL_STATE")
+        if cluster:
             import json as _json
             import signal as _signal
 
-            for rec_path in (tmp / "cluster").glob("pod-*.json"):
+            for rec_path in Path(cluster).glob("pod-*.json"):
                 try:
                     pid = _json.loads(rec_path.read_text())["pid"]
                     os.killpg(os.getpgid(pid), _signal.SIGKILL)
                 except (OSError, ValueError, KeyError):
                     pass
+
+
+# The whole e2e suite runs once per backend: the pure-Python in-process
+# executor, (toolchain permitting) the native C++ executor-server pool, and
+# the REAL kubernetes executor fronted by a fake cluster CLI
+# (fake_kubectl.py) whose "pods" are native executor processes on distinct
+# loopback IPs — all must present identical behavior through the service API.
+@pytest.fixture(scope="session", params=["python", "native", "kubernetes"])
+def service(request, tmp_path_factory, native_binary):
+    tmp = tmp_path_factory.mktemp(f"e2e-{request.param}")
+    overrides = {
+        "APP_EXECUTOR_BACKEND": "local",
+        "APP_LOCAL_WORKSPACE_ROOT": str(tmp / "workspaces"),
+    }
+    if request.param == "native":
+        if native_binary is None:
+            pytest.skip("native toolchain unavailable")
+        overrides["APP_LOCAL_EXECUTOR_BINARY"] = str(native_binary)
+        # Keep warm-pool startup cheap for the test session.
+        overrides["APP_EXECUTOR_POD_QUEUE_TARGET_LENGTH"] = "2"
+    if request.param == "kubernetes":
+        if native_binary is None:
+            pytest.skip("native toolchain unavailable")
+        overrides.update(
+            APP_EXECUTOR_BACKEND="kubernetes",
+            APP_KUBECTL_PATH=str(Path(__file__).parent / "fake_kubectl.py"),
+            APP_EXECUTOR_POD_QUEUE_TARGET_LENGTH="2",
+            # wait --for=condition=Ready polls /healthz; pods boot in ~ms
+            APP_POD_READY_TIMEOUT_S="30",
+            FAKE_KUBECTL_STATE=str(tmp / "cluster"),
+            FAKE_KUBECTL_EXECUTOR_BINARY=str(native_binary),
+        )
+    with booted_service(tmp, overrides) as svc:
+        yield svc

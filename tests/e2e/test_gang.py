@@ -1,0 +1,116 @@
+"""Multi-host pod GROUPS behind the live service.
+
+The reference's operational unit is one pod per execution; this rebuild's
+kubernetes executor schedules pod *groups* (one executor per TPU host of a
+slice, SURVEY.md §2 parallelism). Here the REAL service runs with
+``tpu_hosts_per_slice=2`` against the fake cluster CLI, so every execution
+gang-spawns two real executor processes: worker-0 first (its pod IP becomes
+the baked-in jax.distributed coordinator address), then worker-1; the
+execute fans out SPMD to both; stdout is worker 0's; changed files are the
+union across the gang."""
+
+from __future__ import annotations
+
+import json
+import time
+from pathlib import Path
+
+import httpx
+import pytest
+
+from tests.e2e.conftest import booted_service
+
+
+@pytest.fixture(scope="module")
+def gang_service(tmp_path_factory, native_binary):
+    if native_binary is None:
+        pytest.skip("native toolchain unavailable")
+    tmp = tmp_path_factory.mktemp("e2e-gang")
+    overrides = {
+        "APP_EXECUTOR_BACKEND": "kubernetes",
+        "APP_KUBECTL_PATH": str(Path(__file__).parent / "fake_kubectl.py"),
+        "APP_EXECUTOR_POD_QUEUE_TARGET_LENGTH": "1",
+        "APP_POD_READY_TIMEOUT_S": "30",
+        "APP_TPU_HOSTS_PER_SLICE": "2",
+        "FAKE_KUBECTL_STATE": str(tmp / "cluster"),
+        "FAKE_KUBECTL_EXECUTOR_BINARY": str(native_binary),
+    }
+    with booted_service(tmp, overrides) as svc:
+        yield svc, tmp / "cluster"
+
+
+def test_gang_executes_and_reports_worker0_stdout(gang_service):
+    service, cluster = gang_service
+    r = httpx.post(
+        f"{service.http_url}/v1/execute",
+        json={"source_code":
+              "import os\nprint('worker', os.environ.get('TPU_WORKER_ID'))"},
+        timeout=120,
+    )
+    r.raise_for_status()
+    body = r.json()
+    assert body["exit_code"] == 0
+    # SPMD fan-out ran on both workers; the response carries worker 0's IO
+    assert body["stdout"] == "worker 0\n"
+
+
+def test_gang_spawns_pairs_with_baked_coordinator(gang_service):
+    service, cluster = gang_service
+    # force at least one execution so pod records exist and rotate
+    httpx.post(
+        f"{service.http_url}/v1/execute",
+        json={"source_code": "print(1)"}, timeout=120,
+    ).raise_for_status()
+    # warm pool refills with fresh groups: inspect the recorded manifests
+    deadline = time.monotonic() + 30
+    workers = {}
+    while time.monotonic() < deadline:
+        workers = {}
+        for rec in cluster.glob("pod-*.json"):
+            data = json.loads(rec.read_text())
+            env = {e["name"]: e["value"]
+                   for e in data["manifest"]["spec"]["containers"][0]["env"]}
+            workers.setdefault(env.get("TPU_WORKER_ID"), []).append(
+                (data, env)
+            )
+        if workers.get("0") and workers.get("1"):
+            break
+        time.sleep(0.5)
+    assert workers.get("0") and workers.get("1"), "no full gang alive"
+    # every worker knows the gang size...
+    for _, env in workers["0"] + workers["1"]:
+        assert env["JAX_NUM_PROCESSES"] == "2"
+    # ...and worker-1's coordinator address is worker-0's ACTUAL pod IP
+    w0_ips = {data["ip"] for data, _ in workers["0"]}
+    for _, env in workers["1"]:
+        coord_ip = env["JAX_COORDINATOR_ADDRESS"].split(":")[0]
+        assert coord_ip in w0_ips
+
+
+def test_gang_union_file_downloads(gang_service):
+    service, cluster = gang_service
+    # each worker writes a distinct file; the snapshot must carry BOTH
+    # (per-host outputs exist only on their writer)
+    r = httpx.post(
+        f"{service.http_url}/v1/execute",
+        json={"source_code":
+              "import os\n"
+              "w = os.environ.get('TPU_WORKER_ID', '0')\n"
+              "open(f'out-{w}.txt', 'w').write(f'from {w}')\n"
+              "print('ok')"},
+        timeout=120,
+    )
+    r.raise_for_status()
+    body = r.json()
+    assert body["exit_code"] == 0
+    assert set(body["files"]) == {"/workspace/out-0.txt", "/workspace/out-1.txt"}
+    # round-trip: restore both into a fresh gang and read them back
+    r2 = httpx.post(
+        f"{service.http_url}/v1/execute",
+        json={"source_code":
+              "print(open('out-0.txt').read(), open('out-1.txt').read())",
+              "files": body["files"]},
+        timeout=120,
+    )
+    r2.raise_for_status()
+    assert r2.json()["stdout"] == "from 0 from 1\n"
