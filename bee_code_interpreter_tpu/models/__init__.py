@@ -28,6 +28,9 @@ from bee_code_interpreter_tpu.models.serving import (  # noqa: F401
     SamplingParams,
 )
 from bee_code_interpreter_tpu.models.engine import Engine  # noqa: F401
+from bee_code_interpreter_tpu.models.replicated import (  # noqa: F401
+    ReplicatedEngine,
+)
 from bee_code_interpreter_tpu.models.text import TextEngine  # noqa: F401
 from bee_code_interpreter_tpu.models.hf_loader import (  # noqa: F401
     config_from_hf,

@@ -1,0 +1,253 @@
+"""Data-parallel serving: a router over N engine replicas.
+
+Tensor parallelism (``ContinuousBatcher(mesh=...)``) scales one model
+instance ACROSS chips; this module scales throughput by running N
+independent replicas — each its own ``Engine`` over its own batcher, placed
+on its own device (or its own tp sub-mesh) — behind one submit/step/result
+surface. The dp × tp product is the standard serving topology (one replica
+per tp-group, a router in front); the reference has no serving stack at all
+(SURVEY §2).
+
+Routing is least-outstanding by default. With ``prefix_affinity=True``
+requests are STICKY by prompt prefix: the first block-sized chunk of the
+prompt hashes to a preferred replica, so repeat prompts land where their
+prefix-cache pages live (affinity yields to load when the preferred replica
+is more than ``affinity_slack`` requests busier than the idlest — a cache
+hit is not worth unbounded queueing).
+
+Host-side only: each replica's device work is exactly the single-engine
+path, stepped in turn from this one loop. Production deployments run one
+process per replica and an RPC router; this in-process form is the
+library-level mechanism (and the virtual-device test target:
+tests/test_replicated.py drives 2 replicas × tp=2 over 4 devices).
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+import numpy as np
+
+from bee_code_interpreter_tpu.models.engine import Engine
+from bee_code_interpreter_tpu.models.serving import (
+    ContinuousBatcher,
+    SamplingParams,
+)
+
+
+class ReplicatedEngine:
+    def __init__(
+        self,
+        engines: list[Engine],
+        prefix_affinity: bool = False,
+        affinity_slack: int = 4,
+    ) -> None:
+        if not engines:
+            raise ValueError("need at least one engine replica")
+        self.engines = engines
+        self.prefix_affinity = prefix_affinity
+        self.affinity_slack = affinity_slack
+        self._ticket = 0
+        self._submitted = 0  # monotonic, unlike the live-ticket map
+        # global ticket -> (replica index, replica-local ticket)
+        self._where: dict[int, tuple[int, int]] = {}
+
+    # ------------------------------------------------------------ building
+
+    @classmethod
+    def build(
+        cls,
+        params,
+        config,
+        n_replicas: int,
+        meshes: list | None = None,
+        prefix_affinity: bool = False,
+        affinity_slack: int = 4,
+        max_queue: int | None = None,
+        **batcher_kw,
+    ) -> "ReplicatedEngine":
+        """N fresh replicas from one host copy of the params.
+
+        ``meshes`` places each replica (one mesh per replica — single-device
+        meshes for plain dp, tp meshes over disjoint device subsets for
+        dp × tp). Default: one single-device mesh per replica over the
+        first ``n_replicas`` devices, i.e. pure data parallelism."""
+        import jax
+        from jax.sharding import Mesh
+
+        if meshes is None:
+            devices = jax.devices()
+            if len(devices) < n_replicas:
+                raise ValueError(
+                    f"{n_replicas} replicas need {n_replicas} devices, "
+                    f"have {len(devices)}"
+                )
+            meshes = [
+                Mesh(np.array(devices[i : i + 1]), ("tp",))
+                for i in range(n_replicas)
+            ]
+        if len(meshes) != n_replicas:
+            raise ValueError(
+                f"got {len(meshes)} meshes for {n_replicas} replicas"
+            )
+        engines = [
+            Engine(
+                ContinuousBatcher(params, config, mesh=mesh, **batcher_kw),
+                max_queue=max_queue,
+            )
+            for mesh in meshes
+        ]
+        return cls(
+            engines,
+            prefix_affinity=prefix_affinity,
+            affinity_slack=affinity_slack,
+        )
+
+    # ------------------------------------------------------------- routing
+
+    def _outstanding(self, i: int) -> int:
+        # O(1): queue depth + occupied rows. (Engine.stats would work but
+        # iterates every ticket ever submitted — wrong cost for a routing
+        # hot path.)
+        engine = self.engines[i]
+        return engine.pending + int(engine.batcher.active.sum())
+
+    def _route_order(self, prompt: np.ndarray) -> list[int]:
+        """Replica indices in routing-preference order: least-outstanding
+        first (affinity-preferred first when it's within the slack); later
+        entries are the fallbacks when a replica's queue bound rejects."""
+        loads = [self._outstanding(i) for i in range(len(self.engines))]
+        order = sorted(range(len(self.engines)), key=lambda i: loads[i])
+        if self.prefix_affinity:
+            page = self.engines[0].batcher.page_size
+            digest = hashlib.blake2b(
+                prompt[:page].tobytes(), digest_size=8
+            ).digest()
+            preferred = int.from_bytes(digest, "big") % len(self.engines)
+            if loads[preferred] <= loads[order[0]] + self.affinity_slack:
+                order.remove(preferred)
+                order.insert(0, preferred)
+        return order
+
+    def _route(self, prompt: np.ndarray) -> int:
+        return self._route_order(prompt)[0]
+
+    # -------------------------------------------------------------- intake
+
+    def submit(
+        self,
+        prompt,
+        max_new_tokens: int,
+        sampling: SamplingParams | None = None,
+        **engine_kwargs,
+    ) -> int:
+        prompt = np.asarray(prompt, dtype=np.int32).reshape(-1)
+        # A full queue on the routed replica must not reject a request
+        # another replica could take: try in preference order. Validation
+        # errors (ValueError/NotImplementedError) propagate immediately —
+        # they fail identically on every replica.
+        last_full: RuntimeError | None = None
+        for replica in self._route_order(prompt):
+            try:
+                local = self.engines[replica].submit(
+                    prompt, max_new_tokens, sampling=sampling,
+                    **engine_kwargs,
+                )
+            except RuntimeError as e:  # queue full on this replica
+                last_full = e
+                continue
+            ticket = self._ticket
+            self._ticket += 1
+            self._where[ticket] = (replica, local)
+            self._submitted += 1
+            return ticket
+        raise RuntimeError(
+            f"every replica's queue is full ({last_full})"
+        ) from last_full
+
+    # --------------------------------------------------------------- step
+
+    def step(self) -> None:
+        for engine in self.engines:
+            engine.step()
+
+    def run_to_completion(self, max_steps: int = 100_000) -> None:
+        for _ in range(max_steps):
+            if all(
+                engine.pending == 0 and not engine.batcher.active.any()
+                for engine in self.engines
+            ):
+                return
+            self.step()
+        raise RuntimeError("run_to_completion exceeded max_steps")
+
+    # ------------------------------------------------------------- results
+
+    def _local(self, ticket: int) -> tuple[Engine, int]:
+        if ticket not in self._where:
+            raise KeyError(f"unknown ticket {ticket}")
+        replica, local = self._where[ticket]
+        return self.engines[replica], local
+
+    def replica_of(self, ticket: int) -> int:
+        """Which replica a ticket landed on (observability/testing)."""
+        if ticket not in self._where:
+            raise KeyError(f"unknown ticket {ticket}")
+        return self._where[ticket][0]
+
+    def is_done(self, ticket: int) -> bool:
+        engine, local = self._local(ticket)
+        return engine.is_done(local)
+
+    def result(self, ticket: int) -> list[int]:
+        engine, local = self._local(ticket)
+        return engine.result(local)
+
+    def result_logprobs(self, ticket: int) -> list[float]:
+        engine, local = self._local(ticket)
+        return engine.result_logprobs(local)
+
+    def finish_reason(self, ticket: int) -> str:
+        engine, local = self._local(ticket)
+        return engine.finish_reason(local)
+
+    def ticket_error(self, ticket: int) -> str | None:
+        engine, local = self._local(ticket)
+        return engine.ticket_error(local)
+
+    def partial_result(self, ticket: int) -> list[int]:
+        engine, local = self._local(ticket)
+        return engine.partial_result(local)
+
+    def new_tokens(self, ticket: int) -> list[int]:
+        engine, local = self._local(ticket)
+        return engine.new_tokens(local)
+
+    def cancel(self, ticket: int) -> None:
+        engine, local = self._local(ticket)
+        engine.cancel(local)
+
+    def release(self, ticket: int) -> None:
+        engine, local = self._local(ticket)
+        engine.release(local)
+        del self._where[ticket]
+
+    # -------------------------------------------------------------- stats
+
+    @property
+    def pending(self) -> int:
+        return sum(engine.pending for engine in self.engines)
+
+    @property
+    def stats(self) -> dict:
+        """Aggregate counters plus a per-replica breakdown."""
+        per = [engine.stats for engine in self.engines]
+        agg = {
+            "replicas": len(per),
+            "queued": sum(s["queued"] for s in per),
+            "active_rows": sum(s["active_rows"] for s in per),
+            "requests_submitted": self._submitted,  # monotonic
+            "live_tickets": len(self._where),  # shrinks on release
+            "per_replica": per,
+        }
+        return agg

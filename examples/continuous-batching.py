@@ -103,3 +103,31 @@ assert pc.result(r1) == pc.result(r2) == solo[1]
 s = pc.prefix_stats
 print(f"prefix caching OK: repeat prompt hits={s['hits']} pages_reused="
       f"{s['pages_reused']}, outputs == solo decode")
+
+# --- dp × tp serving: two engine replicas, each tensor-parallel over its
+# own pair of devices, behind one router — the standard serving topology,
+# exercised right here on the virtual device mesh.
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from bee_code_interpreter_tpu.models.replicated import ReplicatedEngine
+
+if len(jax.devices()) >= 4:
+    meshes = [
+        Mesh(np.array(jax.devices()[0:2]), ("tp",)),
+        Mesh(np.array(jax.devices()[2:4]), ("tp",)),
+    ]
+    rep = ReplicatedEngine.build(
+        params, config, 2, meshes=meshes,
+        max_batch=2, n_pages=32, page_size=8, max_pages_per_seq=4,
+    )
+    rtix = [rep.submit(p, new_tokens) for p in prompts]
+    rep.run_to_completion()
+    for i, t in enumerate(rtix):
+        assert rep.result(t) == solo[i], (i, rep.result(t), solo[i])
+    replicas_used = {rep.replica_of(t) for t in rtix}
+    print(f"dp x tp serving OK: {len(rtix)} requests over 2 replicas x tp=2 "
+          f"(replicas used: {sorted(replicas_used)}), outputs == solo decode")
+else:
+    print("dp x tp serving SKIPPED: needs >= 4 devices")

@@ -249,3 +249,22 @@ def test_bf16_base_rows_in_adapter_batcher_stay_solo_exact():
         return b.result(r)
 
     assert run(True) == run(False)
+
+
+def test_adapters_serve_under_tp_mesh_solo_equal():
+    """Multi-LoRA on a tensor-parallel batcher: the adapter bank stays
+    replicated (correctness-first; GSPMD reshards the small delta einsums
+    as needed) while base params and the page pool shard over tp — each
+    adapter row must still equal solo decode on its merged params."""
+    from jax.sharding import Mesh
+
+    mesh = Mesh(np.array(jax.devices()[:2]), ("tp",))
+    n = 5
+    want_0 = solo(merge_lora(PARAMS, ADAPTERS[0], SCALE), PROMPT, n)
+    want_base = solo(PARAMS, [9, 8, 7], n)
+    b = make_batcher(mesh=mesh)
+    r0 = b.submit(PROMPT, n, adapter=0)
+    rb = b.submit([9, 8, 7], n)
+    b.run_to_completion()
+    assert b.result(r0) == want_0
+    assert b.result(rb) == want_base

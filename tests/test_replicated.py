@@ -1,0 +1,164 @@
+"""Data-parallel serving replicas (models/replicated.py): routing, dp × tp
+placement over the virtual device mesh, prefix affinity, and the same
+solo-equality bar as every other serving layer."""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+from bee_code_interpreter_tpu.models import transformer as T
+from bee_code_interpreter_tpu.models.replicated import ReplicatedEngine
+
+CFG = dataclasses.replace(
+    T.TransformerConfig.tiny(), dtype=jnp.float32, n_kv_heads=2
+)
+PARAMS = T.init_params(CFG, jax.random.PRNGKey(0))
+
+
+def solo(prompt, n):
+    out = T.Transformer(CFG).generate_cached(
+        PARAMS, jnp.asarray(prompt, dtype=jnp.int32)[None, :],
+        max_new_tokens=n,
+    )
+    return np.asarray(out[0, len(prompt):]).tolist()
+
+
+def build(n_replicas=2, **kw):
+    kw.setdefault("max_batch", 2)
+    kw.setdefault("n_pages", 24)
+    kw.setdefault("page_size", 4)
+    kw.setdefault("max_pages_per_seq", 6)
+    return ReplicatedEngine.build(PARAMS, CFG, n_replicas, **kw)
+
+
+def test_replicas_spread_load_and_stay_solo_equal():
+    eng = build(2)
+    prompts = [
+        [int(x) for x in np.random.default_rng(i).integers(0, 200, 4 + i)]
+        for i in range(6)
+    ]
+    tickets = [eng.submit(p, 4) for p in prompts]
+    # least-outstanding routing with 2-row replicas must use both
+    assert {eng.replica_of(t) for t in tickets} == {0, 1}
+    eng.run_to_completion()
+    for t, p in zip(tickets, prompts):
+        assert eng.result(t) == solo(p, 4)
+        assert eng.finish_reason(t) == "length"
+    st = eng.stats
+    assert st["replicas"] == 2 and st["active_rows"] == 0
+
+
+def test_replicas_live_on_distinct_devices():
+    eng = build(2)
+    devs = [
+        next(iter(e.batcher.cache["k"].sharding.device_set))
+        for e in eng.engines
+    ]
+    assert devs[0] != devs[1]
+
+
+def test_dp_times_tp_replicas():
+    # 2 replicas × tp=2 over 4 distinct virtual devices — the standard
+    # serving topology, entirely in-process
+    devices = jax.devices()
+    meshes = [
+        Mesh(np.array(devices[0:2]), ("tp",)),
+        Mesh(np.array(devices[2:4]), ("tp",)),
+    ]
+    eng = build(2, meshes=meshes)
+    p1, p2 = [5, 3, 7, 2, 9, 4, 1, 8], [1, 2, 3]
+    t1, t2 = eng.submit(p1, 5), eng.submit(p2, 5)
+    eng.run_to_completion()
+    assert eng.result(t1) == solo(p1, 5)
+    assert eng.result(t2) == solo(p2, 5)
+    used = set()
+    for e in eng.engines:
+        shard_devs = e.batcher.cache["k"].sharding.device_set
+        assert len(shard_devs) == 2  # tp really sharded within the replica
+        used |= shard_devs
+    assert len(used) == 4  # replicas on disjoint device pairs
+
+
+def test_prefix_affinity_routes_repeats_to_same_replica():
+    eng = build(2, prefix_affinity=True, prefix_cache=True)
+    prompt = [7] * 9  # > 2 pages: a cacheable full-page prefix
+    t1 = eng.submit(prompt, 3)
+    eng.run_to_completion()
+    t2 = eng.submit(prompt, 3)
+    eng.run_to_completion()
+    assert eng.replica_of(t1) == eng.replica_of(t2)
+    hits = eng.engines[eng.replica_of(t2)].batcher.prefix_stats["hits"]
+    assert hits >= 1  # the repeat actually reused pages
+    assert eng.result(t1) == eng.result(t2) == solo(prompt, 3)
+
+
+def test_affinity_yields_to_load():
+    eng = build(2, prefix_affinity=True, affinity_slack=0, prefix_cache=True)
+    prompt = [7] * 9
+    preferred = eng._route(np.asarray(prompt, dtype=np.int32))
+    # saturate the preferred replica's queue beyond the slack
+    for _ in range(4):
+        eng.engines[preferred].submit([1, 2, 3], 3)
+    routed = eng._route(np.asarray(prompt, dtype=np.int32))
+    assert routed != preferred
+    eng.run_to_completion()
+
+
+def test_streaming_and_cancel_pass_through():
+    eng = build(2)
+    t = eng.submit([5, 3, 7, 2], 6)
+    seen: list[int] = []
+    for _ in range(60):
+        eng.step()
+        seen += eng.new_tokens(t)
+        if eng.is_done(t):
+            break
+    seen += eng.new_tokens(t)
+    assert seen == eng.result(t) == solo([5, 3, 7, 2], 6)
+    t2 = eng.submit([1, 2, 3], 15)
+    eng.step()
+    eng.cancel(t2)
+    eng.run_to_completion()
+    assert eng.finish_reason(t2) == "cancelled"
+    eng.release(t2)
+    with pytest.raises(KeyError):
+        eng.result(t2)
+
+
+def test_build_validates_replica_count():
+    with pytest.raises(ValueError, match="devices"):
+        ReplicatedEngine.build(PARAMS, CFG, 99)
+    with pytest.raises(ValueError, match="at least one"):
+        ReplicatedEngine([])
+
+
+def test_full_queue_falls_back_to_other_replica():
+    # max_queue bounds the pre-admission queue (admission happens in step,
+    # not submit): with max_queue=1, each replica takes ONE ticket before
+    # any step. The router must spill the second onto the other replica
+    # rather than reject, and only reject when every replica is full.
+    eng = build(2, max_queue=1)
+    t1 = eng.submit([1, 2, 3], 3)
+    t2 = eng.submit([1, 2, 3], 3)  # first replica full: falls back
+    assert eng.replica_of(t1) != eng.replica_of(t2)
+    with pytest.raises(RuntimeError, match="every replica"):
+        eng.submit([1, 2, 3], 3)  # now genuinely everyone is full
+    eng.run_to_completion()
+    assert eng.result(t1) == eng.result(t2) == solo([1, 2, 3], 3)
+
+
+def test_stats_distinguish_monotonic_from_live():
+    eng = build(2)
+    t1 = eng.submit([1, 2, 3], 3)
+    t2 = eng.submit([4, 5, 6], 3)
+    eng.run_to_completion()
+    eng.release(t1)
+    st = eng.stats
+    assert st["requests_submitted"] == 2  # monotonic
+    assert st["live_tickets"] == 1  # t2 still held
+    assert eng.result(t2) == solo([4, 5, 6], 3)
