@@ -540,6 +540,105 @@ class ContinuousBatcher:
                 donate_argnums=(3,),
             )
 
+    # ----------------------------------------------------- snapshot/resume
+
+    _HOST_STATE = (
+        "block_table", "pos", "active", "current", "budget", "row_request",
+        "row_adapter", "page_ref", "results", "results_logprobs", "done",
+        "finish", "errors", "row_sampling", "row_rng", "_next_request_id",
+        "n_tokens_generated", "free_pages", "prefix_index", "page_hash",
+        "prefix_stats",
+    )
+
+    def _geometry(self) -> dict:
+        """The ONE compatibility contract between a snapshot and the
+        batcher restoring it: everything that changes what in-flight rows
+        mean. eos_id/gamma/lora_scale/prefix-cache mode are behavioral, not
+        just shapes — e.g. a different gamma changes how far past budget
+        speculative rows may write, and a different eos_id changes when
+        restored rows retire."""
+        return {
+            "config": self.config,
+            "draft_config": self.draft_config,
+            "n_pages": int(self.page_ref.shape[0]),
+            "page_size": self.page_size,
+            "max_batch": int(self.active.shape[0]),
+            "max_pages_per_seq": int(self.block_table.shape[1]),
+            "n_adapters": self.n_adapters,
+            "eos_id": self.eos_id,
+            "gamma": self.gamma,
+            "lora_scale": self.lora_scale,
+            "prefix_cache": self.prefix_cache_enabled,
+        }
+
+    def state_dict(self) -> dict:
+        """Everything needed to resume serving mid-decode on a fresh
+        batcher — the preemption-recovery primitive for serving the way
+        ``utils/checkpoint.py`` is for training (preemptible TPU slices
+        make this a first-class need). Device pools come back as host
+        numpy; host bookkeeping is copied (numpy arrays, request maps,
+        per-row rng states). The receiving batcher must be constructed
+        with the same config and pool geometry — ``load_state_dict``
+        verifies. NOTE for disk persistence: the dict pickles cleanly
+        unless a live request carries a callable ``allowed_tokens``
+        constraint (functions don't serialize; seed/bias/stop-based
+        sampling all do).
+        """
+        import copy
+
+        # copy=True: the decode jits DONATE the pool buffer, so a zero-copy
+        # view (np.asarray can return one on CPU) would alias memory the
+        # very next step() invalidates — the periodic-checkpoint pattern
+        # must leave the snapshot owning its bytes
+        snap_leaf = lambda x: np.array(x, copy=True)  # noqa: E731
+        device = {"cache": jax.tree.map(snap_leaf, self.cache)}
+        if self.draft_config is not None:
+            device["draft_cache"] = jax.tree.map(snap_leaf, self.draft_cache)
+        host = {
+            name: copy.deepcopy(getattr(self, name))
+            for name in self._HOST_STATE
+        }
+        host["evictable"] = list(self.evictable)  # LRU order, oldest first
+        return {"device": device, "host": host, "meta": self._geometry()}
+
+    def load_state_dict(self, state: dict) -> None:
+        """Adopt a snapshot taken by ``state_dict``. Decode then continues
+        exactly where the snapshot stopped (pinned by
+        tests/test_serving.py::test_snapshot_resume_*): same tokens, same
+        logprobs, same page accounting."""
+        import copy
+
+        meta = state["meta"]
+        mine = self._geometry()
+        if set(meta) != set(mine):
+            raise ValueError(
+                "snapshot geometry keys differ from this build's "
+                f"({sorted(set(meta) ^ set(mine))}) — version skew"
+            )
+        for key, want in meta.items():
+            if mine[key] != want:
+                raise ValueError(
+                    f"snapshot geometry mismatch on {key!r}: snapshot has "
+                    f"{want}, this batcher has {mine[key]}"
+                )
+        cache = {
+            k: jnp.asarray(v) for k, v in state["device"]["cache"].items()
+        }
+        self.cache = self._shard_pool(cache) if self.mesh is not None else cache
+        if self.draft_config is not None:
+            draft = {
+                k: jnp.asarray(v)
+                for k, v in state["device"]["draft_cache"].items()
+            }
+            self.draft_cache = (
+                self._shard_pool(draft) if self.mesh is not None else draft
+            )
+        for name in self._HOST_STATE:
+            setattr(self, name, copy.deepcopy(state["host"][name]))
+        self.evictable = OrderedDict(
+            (page, None) for page in state["host"]["evictable"]
+        )
+
     def _shard_pool(self, pool: dict) -> dict:
         """Shard a page pool's kv-head axis over the mesh's tp axis (axis 2
         of [n_layers, n_pages, kvh, ps, dh]; the int8 scale planes share

@@ -162,3 +162,25 @@ def test_stats_distinguish_monotonic_from_live():
     assert st["requests_submitted"] == 2  # monotonic
     assert st["live_tickets"] == 1  # t2 still held
     assert eng.result(t2) == solo([4, 5, 6], 3)
+
+
+def test_text_engine_composes_over_replicas():
+    """TextEngine consumes the Engine surface only — a ReplicatedEngine
+    drops in unchanged, giving text-level serving over dp replicas."""
+    from bee_code_interpreter_tpu.models.text import TextEngine
+
+    class CharTokenizer:
+        def encode(self, text):
+            return [ord(ch) % CFG.vocab_size for ch in text]
+
+        def decode(self, tokens):
+            return "".join(chr(32 + (t % 94)) for t in tokens)
+
+    te = TextEngine(build(2), CharTokenizer())
+    t1 = te.submit("hello world", 6)
+    t2 = te.submit("other prompt", 6)
+    te.run_to_completion()
+    tok = CharTokenizer()
+    want1 = tok.decode(solo(tok.encode("hello world"), 6))
+    assert te.text(t1) == want1
+    assert te.finish_reason(t1) == te.finish_reason(t2) == "length"

@@ -9,7 +9,10 @@ import numpy as np
 import pytest
 
 from bee_code_interpreter_tpu.models import transformer as T
-from bee_code_interpreter_tpu.models.serving import ContinuousBatcher
+from bee_code_interpreter_tpu.models.serving import (
+    ContinuousBatcher,
+    SamplingParams,
+)
 
 
 def cfg(**kw):
@@ -553,3 +556,118 @@ def test_moe_dropless_prefix_cache_accepted_and_exact():
     assert b.prefix_stats["hits"] >= 1
     assert b.result(r1) == want1
     assert b.result(r2) == want2
+
+
+def test_snapshot_resume_matches_uninterrupted_run():
+    """Preemption recovery: snapshot mid-decode, restore into a FRESH
+    batcher (fresh jits, fresh pools), finish there — tokens, logprobs,
+    finish reasons, and page accounting must equal the uninterrupted run,
+    including a request admitted only after the restore."""
+    config = cfg()
+    params = T.init_params(config, jax.random.PRNGKey(0))
+    prompts = [[5, 3, 7, 2, 9, 4, 1, 8], [1, 2, 3], [4, 4, 2, 6]]
+
+    def make():
+        return ContinuousBatcher(
+            params, config, max_batch=2, n_pages=24, page_size=4,
+            max_pages_per_seq=6,
+        )
+
+    # uninterrupted reference
+    ref = make()
+    r0 = ref.submit(prompts[0], 6, sampling=SamplingParams(
+        temperature=0.8, top_k=40, seed=7, logprobs=True))
+    r1 = ref.submit(prompts[1], 6)
+    for _ in range(3):
+        ref.step()
+    ref.run_to_completion()
+    r2 = ref.submit(prompts[2], 5)
+    ref.run_to_completion()
+
+    # interrupted run: 3 steps, snapshot, resume elsewhere
+    a = make()
+    a0 = a.submit(prompts[0], 6, sampling=SamplingParams(
+        temperature=0.8, top_k=40, seed=7, logprobs=True))
+    a1 = a.submit(prompts[1], 6)
+    for _ in range(3):
+        a.step()
+    snap = a.state_dict()
+    del a  # the preempted host is gone
+
+    b = make()
+    b.load_state_dict(snap)
+    b.run_to_completion()
+    b2 = b.submit(prompts[2], 5)  # post-restore admission reuses pages
+    b.run_to_completion()
+
+    assert b.result(a0) == ref.result(r0)
+    assert b.result_logprobs(a0) == ref.result_logprobs(r0)
+    assert b.result(a1) == ref.result(r1)
+    assert b.result(b2) == ref.result(r2)
+    assert b.finish_reason(a0) == ref.finish_reason(r0)
+    assert sorted(b.free_pages) == sorted(ref.free_pages)
+
+
+def test_snapshot_survives_pickle_and_geometry_is_checked():
+    import pickle
+
+    config = cfg()
+    params = T.init_params(config, jax.random.PRNGKey(0))
+    b1 = ContinuousBatcher(
+        params, config, max_batch=2, n_pages=24, page_size=4,
+        max_pages_per_seq=6,
+    )
+    r = b1.submit([5, 3, 7, 2], 4, sampling=SamplingParams(seed=3))
+    b1.step()
+    blob = pickle.dumps(b1.state_dict())  # disk-persistable
+    want = None
+    b1.run_to_completion()
+    want = b1.result(r)
+
+    b2 = ContinuousBatcher(
+        params, config, max_batch=2, n_pages=24, page_size=4,
+        max_pages_per_seq=6,
+    )
+    b2.load_state_dict(pickle.loads(blob))
+    b2.run_to_completion()
+    assert b2.result(r) == want
+
+    wrong = ContinuousBatcher(
+        params, config, max_batch=2, n_pages=32, page_size=4,
+        max_pages_per_seq=6,
+    )
+    with pytest.raises(ValueError, match="geometry mismatch"):
+        wrong.load_state_dict(pickle.loads(blob))
+
+
+def test_snapshot_while_serving_continues_is_stable():
+    """Periodic-checkpoint pattern: the snapshot must own its memory — the
+    decode jits donate the pool buffer, so further step()s after
+    state_dict() must not corrupt an earlier snapshot."""
+    config = cfg()
+    params = T.init_params(config, jax.random.PRNGKey(0))
+    kw = dict(max_batch=2, n_pages=24, page_size=4, max_pages_per_seq=6)
+    a = ContinuousBatcher(params, config, **kw)
+    r = a.submit([5, 3, 7, 2, 9], 6)
+    for _ in range(2):
+        a.step()
+    snap = a.state_dict()
+    frozen = {k: v.copy() for k, v in snap["device"]["cache"].items()}
+    a.run_to_completion()  # keeps serving; donates the pool repeatedly
+    want = a.result(r)
+    for k in frozen:
+        np.testing.assert_array_equal(frozen[k], snap["device"]["cache"][k])
+    b = ContinuousBatcher(params, config, **kw)
+    b.load_state_dict(snap)
+    b.run_to_completion()
+    assert b.result(r) == want
+
+
+def test_snapshot_geometry_checks_behavioral_fields():
+    config = cfg()
+    params = T.init_params(config, jax.random.PRNGKey(0))
+    kw = dict(max_batch=2, n_pages=24, page_size=4, max_pages_per_seq=6)
+    snap = ContinuousBatcher(params, config, eos_id=2, **kw).state_dict()
+    other = ContinuousBatcher(params, config, eos_id=None, **kw)
+    with pytest.raises(ValueError, match="eos_id"):
+        other.load_state_dict(snap)

@@ -120,3 +120,31 @@ def test_tp_requires_divisible_kv_heads():
             params, config, max_batch=2, n_pages=16, page_size=4,
             max_pages_per_seq=4, mesh=tp_mesh(),
         )
+
+
+def test_snapshot_restores_across_topologies():
+    """Preemption recovery composes with resharding: a snapshot taken on a
+    single-device batcher resumes on a tp=2 batcher (the pool is resharded
+    on load) — the serving analogue of utils/checkpoint.py's
+    cross-topology restore."""
+    config = cfg()
+    params = T.init_params(config, jax.random.PRNGKey(0))
+    want = solo(params, config, PROMPT, 6)
+
+    a = ContinuousBatcher(
+        params, config, max_batch=2, n_pages=16, page_size=4,
+        max_pages_per_seq=4,
+    )
+    r = a.submit(PROMPT, 6)
+    for _ in range(2):
+        a.step()
+    snap = a.state_dict()
+
+    b = ContinuousBatcher(
+        params, config, max_batch=2, n_pages=16, page_size=4,
+        max_pages_per_seq=4, mesh=tp_mesh(),
+    )
+    b.load_state_dict(snap)
+    b.run_to_completion()
+    assert b.result(r) == want
+    assert len(b.cache["k"].sharding.device_set) == 2  # resharded on load
