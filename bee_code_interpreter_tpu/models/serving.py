@@ -385,6 +385,29 @@ class ContinuousBatcher:
                 raise ValueError(
                     f"kv_heads {config.kv_heads} not divisible by tp={tp}"
                 )
+            sp = mesh.shape.get("sp", 1)
+            if sp > 1 and page_size % sp:
+                # padded admission widths are page multiples; the sp
+                # attention chunks the sequence axis sp ways, so every
+                # admission width must divide
+                raise ValueError(
+                    f"page_size {page_size} not divisible by sp={sp} "
+                    "(sp admission chunks the padded prompt)"
+                )
+            if sp > 1 and config.sp_attention == "ulysses":
+                # Ulysses all-to-alls the HEAD axis: validate its
+                # divisibility at construction, not at the first submit's
+                # jit trace (a server must refuse a config it can never
+                # admit under)
+                for name, heads in (
+                    ("n_heads", config.n_heads),
+                    ("kv_heads", config.kv_heads),
+                ):
+                    if heads % sp:
+                        raise ValueError(
+                            f"{name} {heads} not divisible by sp={sp} "
+                            "(ulysses sp admission shards heads)"
+                        )
             if draft_config is not None and draft_config.kv_heads % tp:
                 raise ValueError(
                     f"draft kv_heads {draft_config.kv_heads} not divisible "
@@ -495,8 +518,19 @@ class ContinuousBatcher:
             ),
             donate_argnums=(3,),
         )
+        # Admission prefill. With a mesh the full forward runs under it —
+        # in particular an ``sp`` axis shards the attention over the
+        # sequence axis (ring or Ulysses per ``config.sp_attention``, via
+        # transformer.forward), which is the LONG-CONTEXT admission path:
+        # prefill activation memory and attention FLOPs spread across sp,
+        # then the K/V reshards into the (tp-sharded) page pool. Decode
+        # itself stays single-token and ignores sp. ``prefill_chunk``
+        # remains the single-chip activation-memory tool; sp admission is
+        # the multi-chip one.
         self._prefill = jax.jit(
-            functools.partial(forward, config=config, return_kv=True)
+            functools.partial(
+                forward, config=config, return_kv=True, mesh=mesh
+            )
         )
         # chunked admission compiles once per (total_len, chunk, L) shape —
         # without the jit the remainder window would dispatch op-by-op
@@ -529,7 +563,9 @@ class ContinuousBatcher:
                 donate_argnums=(3,),
             )
             self._draft_prefill = jax.jit(
-                functools.partial(forward, config=draft_config, return_kv=True)
+                functools.partial(
+                    forward, config=draft_config, return_kv=True, mesh=mesh
+                )
             )
             # the verify pass IS a window over the target pool — one jit
             # wrapper (self._window) so a suffix-admission width that

@@ -148,3 +148,61 @@ def test_snapshot_restores_across_topologies():
     b.run_to_completion()
     assert b.result(r) == want
     assert len(b.cache["k"].sharding.device_set) == 2  # resharded on load
+
+
+def test_sp_ring_admission_matches_unsharded_solo():
+    """Long-context admission: with an sp axis in the mesh, the one-shot
+    prefill rings the attention across devices (forward's sequence
+    parallelism) and the K/V reshards into the page pool — outputs must
+    still equal unsharded solo decode."""
+    config = cfg()
+    params = T.init_params(config, jax.random.PRNGKey(0))
+    long_prompt = [int(x) for x in
+                   np.random.default_rng(0).integers(0, 200, 21)]
+    want = solo(params, config, long_prompt, 5)
+    mesh = Mesh(np.array(jax.devices()[:4]).reshape(2, 2), ("sp", "tp"))
+    b = ContinuousBatcher(
+        params, config, max_batch=2, n_pages=32, page_size=4,
+        max_pages_per_seq=8, mesh=mesh,
+    )
+    r = b.submit(long_prompt, 5)
+    b.run_to_completion()
+    assert b.result(r) == want
+
+
+def test_sp_requires_divisible_page_size():
+    config = cfg()
+    params = T.init_params(config, jax.random.PRNGKey(0))
+    mesh = Mesh(np.array(jax.devices()[:2]).reshape(2, 1), ("sp", "tp"))
+    with pytest.raises(ValueError, match="page_size"):
+        ContinuousBatcher(
+            params, config, max_batch=2, n_pages=16, page_size=3,
+            max_pages_per_seq=4, mesh=mesh,
+        )
+
+
+def test_ulysses_sp_admission_validated_and_matches_solo():
+    """sp admission under Ulysses: head divisibility refuses at
+    construction (not at the first submit's trace), and a valid config
+    still matches unsharded solo decode."""
+    bad = cfg(sp_attention="ulysses")  # kv_heads=2, sp=4 below: refuses
+    params_bad = T.init_params(bad, jax.random.PRNGKey(0))
+    mesh4 = Mesh(np.array(jax.devices()[:4]).reshape(4, 1), ("sp", "tp"))
+    with pytest.raises(ValueError, match="ulysses"):
+        ContinuousBatcher(
+            params_bad, bad, max_batch=2, n_pages=32, page_size=4,
+            max_pages_per_seq=8, mesh=mesh4,
+        )
+    good = cfg(sp_attention="ulysses")  # sp=2 divides both head counts
+    params = T.init_params(good, jax.random.PRNGKey(0))
+    long_prompt = [int(x) for x in
+                   np.random.default_rng(3).integers(0, 200, 13)]
+    want = solo(params, good, long_prompt, 4)
+    mesh2 = Mesh(np.array(jax.devices()[:2]).reshape(2, 1), ("sp", "tp"))
+    b = ContinuousBatcher(
+        params, good, max_batch=2, n_pages=32, page_size=4,
+        max_pages_per_seq=8, mesh=mesh2,
+    )
+    r = b.submit(long_prompt, 4)
+    b.run_to_completion()
+    assert b.result(r) == want
