@@ -27,7 +27,6 @@ no serving stack at all (SURVEY §2).
 from __future__ import annotations
 
 import heapq
-import itertools
 from dataclasses import dataclass
 
 import numpy as np
@@ -65,14 +64,51 @@ class Engine:
         # cancellation of a queued ticket is LAZY — the ticket leaves
         # self._queued and its entry is skipped when it surfaces
         self._heap: list[tuple[int, int, int, _Queued]] = []
-        self._seq = itertools.count()
-        self._ticket = itertools.count()
+        self._next_seq = 0
+        self._next_ticket = 0
         # ticket -> batcher request id (admitted), 'queued',
         # 'cancelled', or ('error', msg) for an admission-time failure
         self._state: dict[int, object] = {}
         self._queued: set[int] = set()
         self._stream_cursor: dict[int, int] = {}
         self._holdback: dict[int, int] = {}
+
+    # ----------------------------------------------------- snapshot/resume
+
+    def state_dict(self) -> dict:
+        """The engine's full serving state: the batcher snapshot (device
+        pool + in-flight rows, serving.ContinuousBatcher.state_dict) plus
+        the queue — tickets not yet admitted resume queued, in their
+        original (priority, arrival) order. Same persistence caveat as the
+        batcher's: pickles unless a request carries callable constraints."""
+        import copy
+
+        return {
+            "batcher": self.batcher.state_dict(),
+            "heap": copy.deepcopy(self._heap),
+            "state": copy.deepcopy(self._state),
+            "queued": set(self._queued),
+            "stream_cursor": dict(self._stream_cursor),
+            "holdback": dict(self._holdback),
+            "next_seq": self._next_seq,
+            "next_ticket": self._next_ticket,
+        }
+
+    def load_state_dict(self, state: dict) -> None:
+        import copy
+
+        self.batcher.load_state_dict(state["batcher"])
+        self._heap = copy.deepcopy(state["heap"])
+        heapq.heapify(self._heap)
+        self._state = copy.deepcopy(state["state"])
+        self._queued = set(state["queued"])
+        self._stream_cursor = dict(state["stream_cursor"])
+        self._holdback = dict(state["holdback"])
+        self._next_seq = state["next_seq"]
+        self._next_ticket = state["next_ticket"]
+        # max_queue is POLICY, not serving state: the receiving engine's
+        # configured bound stays (a snapshot must not smuggle in an old
+        # overload policy)
 
     # ------------------------------------------------------------- intake
     def submit(
@@ -102,8 +138,11 @@ class Engine:
             prompt, max_new_tokens, sampling, prefill_chunk, adapter,
             pages_needed=pages_needed,
         )
-        ticket = next(self._ticket)
-        heapq.heappush(self._heap, (-priority, next(self._seq), ticket, req))
+        ticket = self._next_ticket
+        self._next_ticket += 1
+        seq = self._next_seq
+        self._next_seq += 1
+        heapq.heappush(self._heap, (-priority, seq, ticket, req))
         self._state[ticket] = "queued"
         self._queued.add(ticket)
         self._stream_cursor[ticket] = 0

@@ -10,7 +10,9 @@ reference's CPU path is the comparison baseline").
 
 Prints ONE JSON line:
     {"metric": ..., "value": N, "unit": "GFLOPS", "vs_baseline": N,
-     "latency_warm_p50_ms": N | null, "cpu_baseline_gflops": N}
+     "latency_warm_p50_ms": N | null, "cpu_baseline_gflops": N,
+     "serving_smoke": {...} when the continuous-batching stack ran
+     solo-equal through the service path, "hardware_evidence": [...]}
 
 Extra detail lines go to stderr.
 
@@ -183,6 +185,41 @@ t_xl = per_call(
 )
 flops = 2 * B * H * L * L * D  # causal: half of 4*B*H*L*L*D
 print(f"RESULT_FLASH {flops / t_fl / 1e12:.2f} {flops / t_xl / 1e12:.2f}")
+"""
+
+# Serving-stack smoke through the service path: a tiny continuous-batching
+# run (admission + paged decode + retirement) whose outputs are asserted
+# equal to solo decode INSIDE the payload, reporting steady-state tokens/s
+# on already-compiled programs. CPU-pinned: the point is proving the
+# serving stack end-to-end in every artifact, not a hardware number (the
+# hardware serving battery is scripts/bench-decode.py's ledger rows).
+SERVING_PAYLOAD = """
+import dataclasses, time
+import jax, jax.numpy as jnp, numpy as np
+from bee_code_interpreter_tpu.models import transformer as T
+from bee_code_interpreter_tpu.models.serving import ContinuousBatcher
+
+cfg = dataclasses.replace(T.TransformerConfig.tiny(), dtype=jnp.float32,
+                          n_kv_heads=2)
+params = T.init_params(cfg, jax.random.PRNGKey(0))
+prompts = [[int(x) for x in np.random.default_rng(i).integers(0, 200, 5 + i)]
+           for i in range(4)]
+want = []
+for p in prompts:
+    out = T.Transformer(cfg).generate_cached(
+        params, jnp.asarray(p)[None], max_new_tokens=8)
+    want.append(np.asarray(out[0, len(p):]).tolist())
+b = ContinuousBatcher(params, cfg, max_batch=4, n_pages=32, page_size=4,
+                      max_pages_per_seq=6)
+tix = [b.submit(p, 8) for p in prompts]
+b.run_to_completion()  # includes every compile
+assert all(b.result(t) == w for t, w in zip(tix, want)), "solo-equality broke"
+t0 = time.perf_counter()  # steady state: rows + pages recycle, no re-trace
+tix = [b.submit(p, 8) for p in prompts]
+b.run_to_completion()
+dt = time.perf_counter() - t0
+assert all(b.result(t) == w for t, w in zip(tix, want)), "solo-equality broke"
+print("RESULT_SERVING", 4 * 8 / dt)
 """
 
 
@@ -696,6 +733,22 @@ def main() -> None:
         except Exception as e:
             print(f"latency measurement failed: {e}", file=sys.stderr)
 
+    # --- 3b. serving-stack smoke (guarded; extra field only) ---------------
+    serving_smoke: dict | None = None
+    try:
+        toks = asyncio.run(run_payload_values(
+            SERVING_PAYLOAD, {"JAX_PLATFORMS": "cpu"}, timeout_s=300.0,
+            marker="RESULT_SERVING",
+        ))[0]
+        serving_smoke = {
+            "tokens_per_s": round(toks, 1),
+            "config": "tiny f32, 4 rows, paged pool, cpu",
+            "solo_equal": True,  # asserted inside the payload
+        }
+        print(f"serving smoke: {serving_smoke}", file=sys.stderr)
+    except Exception as e:
+        print(f"serving smoke failed (field omitted): {e}", file=sys.stderr)
+
     if tpu_gflops is not None:
         result = {
             "metric": "dense matmul GFLOPS/chip via /v1/execute (bf16 32768^3 jit chain)",
@@ -720,6 +773,8 @@ def main() -> None:
     )
     if latency_phases is not None:
         result["latency_phases_p50"] = latency_phases
+    if serving_smoke is not None:
+        result["serving_smoke"] = serving_smoke
     result["cpu_baseline_gflops"] = round(cpu_gflops, 1)
     # "recorded" = the live CPU run failed and vs_baseline uses the recorded
     # machine-class figure — a constant must never masquerade as a measurement

@@ -296,3 +296,30 @@ def test_capacity_error_requeues_not_errors():
     assert not eng.is_done(t)  # requeued, not failed
     eng.run_to_completion()
     assert eng.result(t) == greedy(PROMPT, 3)
+
+
+def test_engine_snapshot_resume_with_queued_requests():
+    """Engine-level preemption recovery: a snapshot taken with requests
+    BOTH in flight and still queued resumes on a fresh engine — queued
+    tickets admit in their original priority/arrival order and every
+    output equals the uninterrupted run's."""
+    import pickle
+
+    def run(interrupt: bool):
+        eng = make_engine(max_batch=1)
+        t0 = eng.submit(PROMPT, 4)
+        t1 = eng.submit([1, 2, 3], 4)
+        t2 = eng.submit([4, 5, 6], 4, priority=5)
+        for _ in range(2):
+            eng.step()
+        if interrupt:
+            snap = pickle.dumps(eng.state_dict())
+            del eng
+            eng = make_engine(max_batch=1)
+            eng.load_state_dict(pickle.loads(snap))
+            t3 = eng.submit([9, 9], 3)  # fresh ticket ids keep counting
+            assert t3 > t2
+        eng.run_to_completion()
+        return {t: eng.result(t) for t in (t0, t1, t2)}
+
+    assert run(interrupt=True) == run(interrupt=False)
