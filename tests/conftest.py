@@ -141,8 +141,8 @@ SLOW_TEST_MODULES = {
     "test_moe", "test_multihost_distributed", "test_multilora_serving",
     "test_paged_attention", "test_paged_kv_cache", "test_parallel",
     "test_pipeline", "test_pipeline_transformer", "test_prefix_cache",
-    "test_replicated", "test_serving", "test_serving_mesh",
-    "test_serving_stops",
+    "test_replicated", "test_serving", "test_serving_fuzz",
+    "test_serving_mesh", "test_serving_stops",
     "test_sliding_window",
     "test_speculative", "test_speculative_sampling", "test_text_engine",
     "test_ulysses", "test_vision", "test_vit", "test_weight_quant",

@@ -893,3 +893,71 @@ def test_guess_parity_on_azure_namespace(tmp_path):
         "azure-cosmos", "azure-identity", "azure-keyvault-secrets",
         "azure-mgmt-compute", "azure-storage-blob",
     ]
+
+
+def _raw_http(native, payload: bytes, recv_bytes: int = 4096) -> bytes:
+    with socket.create_connection((native.ip, native.port), timeout=10) as s:
+        s.sendall(payload)
+        s.settimeout(10)
+        out = b""
+        try:
+            while len(out) < recv_bytes:
+                chunk = s.recv(4096)
+                if not chunk:
+                    break
+                out += chunk
+        except (socket.timeout, ConnectionResetError, BrokenPipeError):
+            pass  # dropping a hostile connection (even mid-send) is legal
+        return out
+
+
+def test_malformed_requests_do_not_kill_the_server(native):
+    """Parser hostility battery: garbage request lines, absurd and
+    non-numeric Content-Length, garbage chunk-size lines, oversized
+    headers. Each must at worst drop that connection — the server (a
+    detached-thread-per-connection design where an escaped exception
+    would abort the whole process) stays healthy throughout."""
+    cases = [
+        b"NONSENSE\r\n\r\n",                                  # no method/path
+        b"GET /healthz HTTP/1.1\r\nContent-Length: banana\r\n\r\n",
+        b"POST /execute HTTP/1.1\r\nContent-Length: 99999999999999999999\r\n\r\n",
+        b"POST /execute HTTP/1.1\r\nTransfer-Encoding: chunked\r\n\r\nZZZ\r\n",
+        b"PUT /workspace/x HTTP/1.1\r\nTransfer-Encoding: chunked\r\n\r\n-5\r\n",
+        b"GET /" + b"A" * (2 << 20) + b" HTTP/1.1\r\n\r\n",   # header flood
+        b"POST /execute HTTP/1.1\r\nContent-Length: 10\r\n\r\nshort",  # truncated
+    ]
+    for payload in cases:
+        _raw_http(native, payload)
+        # server must still answer a well-formed request afterwards
+        r = httpx.get(native.base + "/healthz", timeout=5)
+        assert r.status_code == 200, payload[:40]
+
+
+def test_keepalive_pipelined_requests(native):
+    """Two requests on one connection (keep-alive): both answered, bytes
+    carried over between requests parse correctly."""
+    req = (
+        b"GET /healthz HTTP/1.1\r\nHost: x\r\n\r\n"
+        b"GET /healthz HTTP/1.1\r\nHost: x\r\nConnection: close\r\n\r\n"
+    )
+    out = _raw_http(native, req, recv_bytes=1 << 16)
+    assert out.count(b"HTTP/1.1 200") == 2
+
+
+def test_streamed_upload_interrupted_leaves_no_part_file(native):
+    """A client that dies mid-upload must not leave a torn part-file (or a
+    phantom destination) in the workspace."""
+    with socket.create_connection((native.ip, native.port), timeout=10) as s:
+        s.sendall(
+            b"PUT /workspace/torn.bin HTTP/1.1\r\n"
+            b"Content-Length: 1000000\r\n\r\n" + b"x" * 1000
+        )
+        # abandon the connection with 999000 bytes owed
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        leftovers = list(native.workspace.iterdir())
+        if not leftovers:
+            break
+        time.sleep(0.1)
+    assert list(native.workspace.iterdir()) == []
+    assert httpx.get(native.base + "/healthz").status_code == 200
