@@ -46,6 +46,7 @@ class _Queued:
     prefill_chunk: int | None
     adapter: int | None
     pages_needed: int
+    interleave_admission: int | None = None
 
 
 class Engine:
@@ -119,6 +120,7 @@ class Engine:
         prefill_chunk: int | None = None,
         adapter: int | None = None,
         priority: int = 0,
+        interleave_admission: int | None = None,
     ) -> int:
         """Accept a request and return a ticket. Everything
         capacity-independent (empty prompt, budget > block table, pages >
@@ -132,11 +134,19 @@ class Engine:
         )
         if prefill_chunk is not None and prefill_chunk < 1:
             raise ValueError(f"chunk must be >= 1, got {prefill_chunk}")
+        if interleave_admission is not None:
+            ps = self.batcher.page_size
+            if interleave_admission < ps or interleave_admission % ps:
+                raise ValueError(
+                    f"interleave_admission must be a positive multiple of "
+                    f"page_size ({ps}), got {interleave_admission}"
+                )
         if self.max_queue is not None and len(self._queued) >= self.max_queue:
             raise RuntimeError(f"queue full ({self.max_queue})")
         req = _Queued(
             prompt, max_new_tokens, sampling, prefill_chunk, adapter,
             pages_needed=pages_needed,
+            interleave_admission=interleave_admission,
         )
         ticket = self._next_ticket
         self._next_ticket += 1
@@ -182,6 +192,7 @@ class Engine:
                 rid = self.batcher.submit(
                     req.prompt, req.max_new_tokens, sampling=req.sampling,
                     prefill_chunk=req.prefill_chunk, adapter=req.adapter,
+                    interleave_admission=req.interleave_admission,
                 )
             except CapacityError:
                 # capacity race (e.g. prefix-matched pages changed the
@@ -212,7 +223,7 @@ class Engine:
 
     def run_to_completion(self, max_steps: int = 100_000) -> None:
         for _ in range(max_steps):
-            if not self._queued and not self.batcher.active.any():
+            if not self._queued and not self.batcher.busy:
                 return
             self.step()
         raise RuntimeError("run_to_completion exceeded max_steps")

@@ -110,7 +110,11 @@ class ReplicatedEngine:
         # iterates every ticket ever submitted — wrong cost for a routing
         # hot path.)
         engine = self.engines[i]
-        return engine.pending + int(engine.batcher.active.sum())
+        return (
+            engine.pending
+            + int(engine.batcher.active.sum())
+            + len(engine.batcher.prefill_state)
+        )
 
     def _route_order(self, prompt: np.ndarray) -> list[int]:
         """Replica indices in routing-preference order: least-outstanding
@@ -174,7 +178,7 @@ class ReplicatedEngine:
     def run_to_completion(self, max_steps: int = 100_000) -> None:
         for _ in range(max_steps):
             if all(
-                engine.pending == 0 and not engine.batcher.active.any()
+                engine.pending == 0 and not engine.batcher.busy
                 for engine in self.engines
             ):
                 return

@@ -510,6 +510,9 @@ class ContinuousBatcher:
         self.prefix_stats = {
             "lookups": 0, "hits": 0, "pages_reused": 0, "evictions": 0,
         }
+        # row -> in-progress interleaved admission (see submit's
+        # interleave_admission): the row is occupied but not yet active
+        self.prefill_state: dict[int, dict] = {}
         # donate the pool: without aliasing, every decoded token would pay
         # a full page-pool HBM copy (precedent: make_train_step's donation)
         self._decode = jax.jit(
@@ -583,7 +586,7 @@ class ContinuousBatcher:
         "row_adapter", "page_ref", "results", "results_logprobs", "done",
         "finish", "errors", "row_sampling", "row_rng", "_next_request_id",
         "n_tokens_generated", "free_pages", "prefix_index", "page_hash",
-        "prefix_stats",
+        "prefix_stats", "prefill_state",
     )
 
     def _geometry(self) -> dict:
@@ -691,7 +694,16 @@ class ContinuousBatcher:
 
     # ------------------------------------------------------------- admission
     def has_free_row(self) -> bool:
-        return bool((~self.active).any())
+        free = ~self.active
+        for row in self.prefill_state:
+            free[row] = False
+        return bool(free.any())
+
+    @property
+    def busy(self) -> bool:
+        """Rows decoding OR admissions still interleaving — the loop-until
+        condition for ``run_to_completion`` at every layer."""
+        return bool(self.active.any()) or bool(self.prefill_state)
 
     def validate_request(
         self,
@@ -760,12 +772,25 @@ class ContinuousBatcher:
         sampling: SamplingParams | None = None,
         prefill_chunk: int | None = None,
         adapter: int | None = None,
+        interleave_admission: int | None = None,
     ) -> int:
         """Prefill ``prompt`` into freshly allocated pages and return a
         REQUEST id (stable across row recycling). ``sampling`` defaults to
         greedy; a fixed seed makes the request fully deterministic. Raises
         if no free row or not enough free pages (callers queue and retry
         after a step frees capacity).
+
+        ``interleave_admission`` (a page-multiple window width) admits the
+        prompt INCREMENTALLY: submit allocates the row and pages but runs
+        no model; each subsequent ``step`` advances the prefill by one
+        window BEFORE decoding, so other rows keep producing tokens while
+        a long prompt admits (Sarathi-style chunked-prefill interleaving —
+        a one-shot admission stalls the whole batch for its prefill). The
+        windows are exactly the suffix-admission program family, so the
+        result is identical to the blocking admission; until the prefill
+        completes the request has no tokens and the row's block-table
+        entry stays on the scratch page (decode steps cannot touch the
+        half-written pages).
 
         ``prefill_chunk`` admits through ``prefill_chunked`` instead of the
         one-shot O(L²) forward — activation memory bounded by the chunk,
@@ -786,7 +811,19 @@ class ContinuousBatcher:
         # internal index: 0 is the all-zeros base adapter in the bank
         adapter_internal = 0 if adapter is None else adapter + 1
         speculative = self.draft_params is not None
-        free_rows = np.flatnonzero(~self.active)
+        if interleave_admission is not None:
+            if (
+                interleave_admission < self.page_size
+                or interleave_admission % self.page_size
+            ):
+                raise ValueError(
+                    f"interleave_admission must be a positive multiple of "
+                    f"page_size ({self.page_size}), got {interleave_admission}"
+                )
+        occupied = self.active.copy()
+        for r in self.prefill_state:
+            occupied[r] = True
+        free_rows = np.flatnonzero(~occupied)
         if free_rows.size == 0:
             raise CapacityError(
                 "no free batch row (step() until one frees)"
@@ -822,6 +859,46 @@ class ContinuousBatcher:
             self.prefix_stats["pages_reused"] += matched
         row = int(free_rows[0])
         pages = shared + [self._alloc_page() for _ in range(n_need - matched)]
+
+        if interleave_admission is not None:
+            # Deferred admission: no model runs now. The block-table row
+            # stays on the scratch page so interleaved decode steps can't
+            # write into the half-filled pages; the windows carry their
+            # own table (see _advance_prefills). Speculative draft pages
+            # zero now for the same reason the blocking path zeros them.
+            if speculative:
+                # only the FRESH pages: matched prefix pages hold valid
+                # draft K/V that other rows may be sharing right now
+                fresh_arr = jnp.asarray(pages[matched:], dtype=jnp.int32)
+                self.draft_cache = {
+                    name: x.at[:, fresh_arr].set(0)
+                    for name, x in self.draft_cache.items()
+                }
+            start = matched * self.page_size
+            suffix = np.zeros(
+                (-(-(L - start) // self.page_size)) * self.page_size,
+                dtype=np.int32,
+            )
+            suffix[: L - start] = prompt[start:]
+            bt_row = np.full(
+                (1, self.block_table.shape[1]), _SCRATCH_PAGE, dtype=np.int32
+            )
+            bt_row[0, :n_need] = pages
+            req = self._next_request_id
+            self._next_request_id += 1
+            self.results[req] = []
+            self.done[req] = False
+            self.prefill_state[row] = {
+                "req": req, "prompt": prompt, "pages": pages,
+                "hashes": hashes, "suffix": suffix, "pos": start,
+                "start": start, "L": L,
+                "bt_row": bt_row, "width": interleave_admission,
+                "sampling": sampling, "max_new_tokens": max_new_tokens,
+                "adapter_internal": adapter_internal,
+                "speculative": speculative, "last_row": None,
+            }
+            return req
+
         self.block_table[row, :] = _SCRATCH_PAGE
         self.block_table[row, :n_need] = pages
 
@@ -854,24 +931,6 @@ class ContinuousBatcher:
                 last_row = self._full_admit(
                     prompt, pages, L, speculative, prefill_chunk
                 )
-            sampling = sampling or SamplingParams()
-            rng = np.random.default_rng(sampling.seed)
-            first = choose_host(last_row, sampling, rng, [])
-        except ConstraintExhausted:
-            # the constraint permits no FIRST token: the request is
-            # complete with an empty output (grammar terminal at step 0) —
-            # a finished request, not an error; pages go straight back
-            self.block_table[row, :] = _SCRATCH_PAGE
-            for page in reversed(pages):
-                self._release_page(page)
-            req = self._next_request_id
-            self._next_request_id += 1
-            self.results[req] = []
-            if sampling.logprobs:
-                self.results_logprobs[req] = []
-            self.done[req] = True
-            self.finish[req] = "constraint"
-            return req
         except BaseException:
             # a failed admission (prefill OOM, bad sampling params, ...)
             # must not leak its pages: the row never activated, so nothing
@@ -884,6 +943,55 @@ class ContinuousBatcher:
             for page in reversed(pages):
                 self._release_page(page)
             raise
+        return self._activate_row(
+            row, last_row, prompt, pages, hashes, L, sampling,
+            max_new_tokens, adapter_internal,
+        )
+
+    def _activate_row(
+        self, row, last_row, prompt, pages, hashes, L, sampling,
+        max_new_tokens, adapter_internal, req=None,
+    ) -> int:
+        """Admission epilogue, shared by the blocking path and interleaved
+        finalization: register prefix pages, sample the first token,
+        activate the row. ``req`` is pre-allocated on the interleaved path
+        (the caller got an id at submit); None allocates one."""
+        sampling = sampling or SamplingParams()
+        rng = np.random.default_rng(sampling.seed)
+        try:
+            first = choose_host(last_row, sampling, rng, [])
+        except ConstraintExhausted:
+            # the constraint permits no FIRST token: the request is
+            # complete with an empty output (grammar terminal at step 0) —
+            # a finished request, not an error; pages go straight back
+            self.block_table[row, :] = _SCRATCH_PAGE
+            for page in reversed(pages):
+                self._release_page(page)
+            if req is None:
+                req = self._next_request_id
+                self._next_request_id += 1
+            self.results[req] = []
+            if sampling.logprobs:
+                self.results_logprobs[req] = []
+            self.done[req] = True
+            self.finish[req] = "constraint"
+            return req
+        except BaseException as _activation_error:
+            # user-callable failure at the first token: release the pages
+            # either way; blocking submit PROPAGATES (no id exists from
+            # the caller's view), interleaved finalization records the
+            # error on the ticket (submit returned long ago)
+            self.block_table[row, :] = _SCRATCH_PAGE
+            for page in reversed(pages):
+                self._release_page(page)
+            if req is None:
+                raise
+            self.done[req] = True
+            self.finish[req] = "error"
+            if sampling.logprobs:
+                self.results_logprobs[req] = []
+            self.errors[req] = repr(_activation_error)
+            return req
         if self.prefix_cache_enabled:
             # index every page fully inside [0, L): those pages are
             # write-free for the rest of this request's life (the decode
@@ -906,8 +1014,9 @@ class ContinuousBatcher:
                         self.free_pages.append(prev)
                 self.prefix_index[hashes[j]] = page
                 self.page_hash[page] = hashes[j]
-        req = self._next_request_id
-        self._next_request_id += 1
+        if req is None:
+            req = self._next_request_id
+            self._next_request_id += 1
         self.pos[row] = L
         self.current[row, 0] = first
         self.budget[row] = max_new_tokens
@@ -923,6 +1032,48 @@ class ContinuousBatcher:
         self.active[row] = True
         self._retire_if_done(row)
         return req
+
+    def _advance_prefills(self) -> None:
+        """One window of interleaved admission per prefilling row, run at
+        the top of every ``step`` — the windows are the suffix-admission
+        program family over the record's OWN block table (the global table
+        keeps the row on the scratch page until activation)."""
+        for row in sorted(self.prefill_state):
+            rec = self.prefill_state[row]
+            # suffix-relative offset of the next window (pos is absolute;
+            # the suffix array starts at the absolute position rec["start"],
+            # i.e. right after any prefix-cache hit — NOT at L minus the
+            # padded suffix length)
+            done_tokens = rec["pos"] - rec["start"]
+            win = rec["suffix"][done_tokens: done_tokens + rec["width"]]
+            bt_row = jnp.asarray(rec["bt_row"])
+            win_arr = jnp.asarray(win[None, :])
+            pos_arr = jnp.asarray([rec["pos"]], dtype=np.int32)
+            logits, self.cache = self._window(
+                self.params, win_arr, pos_arr, self.cache, bt_row,
+                **self._lora_kwargs(np.array([rec["adapter_internal"]])),
+            )
+            if rec["speculative"]:
+                _, self.draft_cache = self._draft_window(
+                    self.draft_params, win_arr, pos_arr,
+                    self.draft_cache, bt_row,
+                )
+            idx = rec["L"] - 1 - rec["pos"]  # last REAL token in window?
+            if 0 <= idx < win.shape[0]:
+                rec["last_row"] = np.asarray(logits[0, idx], dtype=np.float32)
+            rec["pos"] += int(win.shape[0])
+            if done_tokens + rec["width"] >= len(rec["suffix"]):
+                # prefill complete: publish the pages and activate
+                del self.prefill_state[row]
+                n_need = len(rec["pages"])
+                self.block_table[row, :] = _SCRATCH_PAGE
+                self.block_table[row, :n_need] = rec["pages"]
+                self._activate_row(
+                    row, rec["last_row"], rec["prompt"], rec["pages"],
+                    rec["hashes"], rec["L"], rec["sampling"],
+                    rec["max_new_tokens"], rec["adapter_internal"],
+                    req=rec["req"],
+                )
 
     # ------------------------------------------------- admission sub-paths
     def _full_admit(self, prompt, pages, L, speculative, prefill_chunk):
@@ -1139,7 +1290,10 @@ class ContinuousBatcher:
     def step(self) -> None:
         """Advance every active row — by one token (plain mode, one
         compiled program), or by its own accept length (speculative
-        mode)."""
+        mode). Interleaved admissions advance one window first, so their
+        prefill and the batch's decode share the step cadence."""
+        if self.prefill_state:
+            self._advance_prefills()
         if not self.active.any():
             return
         if self.draft_params is not None:
@@ -1427,6 +1581,7 @@ class ContinuousBatcher:
         registry, logs, ...)."""
         return {
             "active_rows": int(self.active.sum()),
+            "prefilling_rows": len(self.prefill_state),
             "max_batch": int(self.active.shape[0]),
             "free_pages": len(self.free_pages),
             "parked_pages": len(self.evictable),
@@ -1498,6 +1653,18 @@ class ContinuousBatcher:
             if int(self.row_request[row]) == request_id:
                 self._retire(int(row), "cancelled")
                 return
+        for row, rec in list(self.prefill_state.items()):
+            if rec["req"] == request_id:
+                # admission still interleaving: free the pages (shared
+                # ones drop their ref), keep the empty result readable
+                del self.prefill_state[row]
+                for page in reversed(rec["pages"]):
+                    self._release_page(page)
+                self.done[request_id] = True
+                self.finish[request_id] = "cancelled"
+                if rec["sampling"] is not None and rec["sampling"].logprobs:
+                    self.results_logprobs[request_id] = []
+                return
         if request_id not in self.done:
             raise KeyError(f"unknown request {request_id}")
 
@@ -1515,7 +1682,7 @@ class ContinuousBatcher:
 
     def run_to_completion(self, max_steps: int = 10_000) -> None:
         for _ in range(max_steps):
-            if not self.active.any():
+            if not self.busy:
                 return
             self.step()
         raise RuntimeError("run_to_completion exceeded max_steps")

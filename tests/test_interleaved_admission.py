@@ -1,0 +1,200 @@
+"""Sarathi-style interleaved chunked admission: a long prompt's prefill
+advances one window per step while other rows keep decoding — the result
+must be IDENTICAL to the blocking admission (same window program family),
+and the scheduler bookkeeping (occupancy, pages, cancel, snapshot) must
+treat a prefilling row as occupied-but-not-active."""
+
+import dataclasses
+import pickle
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from bee_code_interpreter_tpu.models import transformer as T
+from bee_code_interpreter_tpu.models.engine import Engine
+from bee_code_interpreter_tpu.models.serving import (
+    ContinuousBatcher,
+    SamplingParams,
+)
+
+CFG = dataclasses.replace(
+    T.TransformerConfig.tiny(), dtype=jnp.float32, n_kv_heads=2
+)
+PARAMS = T.init_params(CFG, jax.random.PRNGKey(0))
+LONG = [int(x) for x in np.random.default_rng(7).integers(0, 200, 21)]
+SHORT = [5, 3, 7, 2]
+
+
+def make(**kw):
+    kw.setdefault("max_batch", 2)
+    kw.setdefault("n_pages", 32)
+    kw.setdefault("page_size", 4)
+    kw.setdefault("max_pages_per_seq", 8)
+    return ContinuousBatcher(PARAMS, CFG, **kw)
+
+
+def solo(prompt, n, sampling=None):
+    b = make(max_batch=1)
+    r = b.submit(prompt, n, sampling=sampling)
+    b.run_to_completion()
+    return b.result(r)
+
+
+def test_interleaved_matches_blocking_and_solo():
+    want = solo(LONG, 5)
+    b = make()
+    r = b.submit(LONG, 5, interleave_admission=8)
+    assert b.results[r] == []  # nothing yet: no model ran at submit
+    assert b.stats["prefilling_rows"] == 1
+    b.run_to_completion()
+    assert b.result(r) == want
+    assert b.finish_reason(r) == "length"
+    assert b.stats["prefilling_rows"] == 0
+
+
+def test_interleaved_sampled_with_logprobs_matches_blocking():
+    sp = SamplingParams(temperature=0.7, top_k=30, seed=11, logprobs=True)
+    blocking = make()
+    rb = blocking.submit(LONG, 5, sampling=sp)
+    blocking.run_to_completion()
+    b = make()
+    r = b.submit(LONG, 5, sampling=sp, interleave_admission=4)
+    b.run_to_completion()
+    assert b.result(r) == blocking.result(rb)
+    # logprobs agree to reduction-order ulps: the window family and the
+    # one-shot prefill are numerically distinct programs (tokens are
+    # pinned exact; the drift lives below sampling resolution)
+    assert b.result_logprobs(r) == pytest.approx(
+        blocking.result_logprobs(rb), rel=1e-4
+    )
+
+
+def test_other_rows_keep_decoding_during_admission():
+    """The point of interleaving: a short request decodes a token on every
+    step while the long prompt's prefill is still windowing in."""
+    b = make()
+    r_short = b.submit(SHORT, 8)
+    r_long = b.submit(LONG, 4, interleave_admission=4)  # 6 windows of 4
+    produced = []
+    while b.prefill_state:
+        before = len(b.results[r_short])
+        b.step()
+        produced.append(len(b.results[r_short]) - before)
+    # every interleave step also advanced the short row (until it retired)
+    live_steps = [d for d in produced if d >= 0]
+    assert sum(produced) > 0
+    assert all(d == 1 for d in produced[: min(len(produced), 7)])
+    b.run_to_completion()
+    assert b.result(r_short) == solo(SHORT, 8)
+    assert b.result(r_long) == solo(LONG, 4)
+
+
+def test_interleaved_registers_prefix_pages():
+    b = make(prefix_cache=True)
+    r1 = b.submit(LONG, 4, interleave_admission=4)
+    b.run_to_completion()
+    r2 = b.submit(LONG, 4)  # repeat: must hit the pages the windows wrote
+    b.run_to_completion()
+    assert b.prefix_stats["hits"] >= 1
+    assert b.result(r1) == b.result(r2) == solo(LONG, 4)
+
+
+def test_cancel_mid_prefill_releases_everything():
+    b = make()
+    r = b.submit(LONG, 4, interleave_admission=4)
+    b.step()  # one window in
+    assert b.prefill_state
+    b.cancel(r)
+    assert not b.prefill_state
+    assert b.finish_reason(r) == "cancelled"
+    assert b.result(r) == []
+    b.run_to_completion()
+    assert int(b.stats["held_pages"]) == 0
+    # the freed row and pages admit a fresh request
+    r2 = b.submit(LONG, 4)
+    b.run_to_completion()
+    assert b.result(r2) == solo(LONG, 4)
+
+
+def test_snapshot_mid_prefill_resumes_exactly():
+    want = solo(LONG, 5)
+    a = make()
+    r = a.submit(LONG, 5, interleave_admission=4)
+    a.step(); a.step()  # part-way through the windows
+    snap = pickle.dumps(a.state_dict())
+    del a
+    b = make()
+    b.load_state_dict(pickle.loads(snap))
+    assert b.prefill_state  # resumed mid-admission
+    b.run_to_completion()
+    assert b.result(r) == want
+
+
+def test_speculative_interleaved_matches_solo():
+    draft_cfg = dataclasses.replace(CFG, n_layers=1)
+    draft_params = T.init_params(draft_cfg, jax.random.PRNGKey(1))
+    want_b = make(
+        draft_params=draft_params, draft_config=draft_cfg, gamma=3,
+    )
+    rb = want_b.submit(LONG, 5)
+    want_b.run_to_completion()
+    b = make(draft_params=draft_params, draft_config=draft_cfg, gamma=3)
+    r = b.submit(LONG, 5, interleave_admission=4)
+    b.run_to_completion()
+    assert b.result(r) == want_b.result(rb) == solo(LONG, 5)
+
+
+def test_width_validated_and_row_occupancy():
+    b = make()
+    with pytest.raises(ValueError, match="interleave_admission"):
+        b.submit(LONG, 4, interleave_admission=3)  # not a page multiple
+    r1 = b.submit(LONG, 4, interleave_admission=4)
+    r2 = b.submit(SHORT, 4)  # second row
+    with pytest.raises(RuntimeError, match="no free batch row"):
+        b.submit(SHORT, 4)  # prefilling row counts as occupied
+    b.run_to_completion()
+    assert b.result(r1) == solo(LONG, 4)
+    assert b.result(r2) == solo(SHORT, 4)
+
+
+def test_engine_passthrough():
+    want = solo(LONG, 4)
+    eng = Engine(make())
+    t = eng.submit(LONG, 4, interleave_admission=4)
+    eng.run_to_completion()
+    assert eng.result(t) == want
+
+
+def test_engine_validates_width_eagerly():
+    eng = Engine(make())
+    with pytest.raises(ValueError, match="interleave_admission"):
+        eng.submit(LONG, 4, interleave_admission=3)  # fails AT submit
+
+
+def test_interleaved_speculative_preserves_shared_draft_prefix():
+    """Zeroing discipline under speculative + prefix cache: an interleaved
+    admission hitting a shared prefix must zero only its FRESH draft
+    pages — wiping the matched pages would corrupt the draft K/V a
+    decoding batch-mate is reading right now."""
+    draft_cfg = dataclasses.replace(CFG, n_layers=1)
+    draft_params = T.init_params(draft_cfg, jax.random.PRNGKey(1))
+
+    def spec(**kw):
+        return make(draft_params=draft_params, draft_config=draft_cfg,
+                    gamma=3, prefix_cache=True, **kw)
+
+    solo_b = spec(max_batch=1)
+    rs = solo_b.submit(LONG, 6)
+    solo_b.run_to_completion()
+    want = solo_b.result(rs)
+
+    b = spec()
+    r1 = b.submit(LONG, 6)  # registers the prefix pages
+    b.step()  # r1 mid-decode, sharing its prefix
+    r2 = b.submit(LONG, 6, interleave_admission=4)  # hits the prefix
+    b.run_to_completion()
+    assert b.result(r1) == want  # batch-mate untouched by the admission
+    assert b.result(r2) == want
