@@ -130,17 +130,11 @@ class Engine:
         could have seen at submit."""
         prompt = np.asarray(prompt, dtype=np.int32).reshape(-1)
         pages_needed = self.batcher.validate_request(
-            prompt, max_new_tokens, sampling=sampling, adapter=adapter
+            prompt, max_new_tokens, sampling=sampling, adapter=adapter,
+            interleave_admission=interleave_admission,
         )
         if prefill_chunk is not None and prefill_chunk < 1:
             raise ValueError(f"chunk must be >= 1, got {prefill_chunk}")
-        if interleave_admission is not None:
-            ps = self.batcher.page_size
-            if interleave_admission < ps or interleave_admission % ps:
-                raise ValueError(
-                    f"interleave_admission must be a positive multiple of "
-                    f"page_size ({ps}), got {interleave_admission}"
-                )
         if self.max_queue is not None and len(self._queued) >= self.max_queue:
             raise RuntimeError(f"queue full ({self.max_queue})")
         req = _Queued(

@@ -711,6 +711,7 @@ class ContinuousBatcher:
         max_new_tokens: int,
         sampling: SamplingParams | None = None,
         adapter: int | None = None,
+        interleave_admission: int | None = None,
     ) -> int:
         """Capacity-independent request validation; returns the page count
         the request will need. The ONE copy of the admission arithmetic:
@@ -724,6 +725,14 @@ class ContinuousBatcher:
         L = int(prompt.shape[0])
         if L < 1:
             raise ValueError("prompt must be non-empty")
+        if interleave_admission is not None and (
+            interleave_admission < self.page_size
+            or interleave_admission % self.page_size
+        ):
+            raise ValueError(
+                f"interleave_admission must be a positive multiple of "
+                f"page_size ({self.page_size}), got {interleave_admission}"
+            )
         if max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1")
         if adapter is not None:
@@ -805,21 +814,13 @@ class ContinuousBatcher:
         batcher was constructed with (None = the base model)."""
         prompt = np.asarray(prompt, dtype=np.int32).reshape(-1)
         n_need = self.validate_request(
-            prompt, max_new_tokens, sampling=sampling, adapter=adapter
+            prompt, max_new_tokens, sampling=sampling, adapter=adapter,
+            interleave_admission=interleave_admission,
         )
         L = int(prompt.shape[0])
         # internal index: 0 is the all-zeros base adapter in the bank
         adapter_internal = 0 if adapter is None else adapter + 1
         speculative = self.draft_params is not None
-        if interleave_admission is not None:
-            if (
-                interleave_admission < self.page_size
-                or interleave_admission % self.page_size
-            ):
-                raise ValueError(
-                    f"interleave_admission must be a positive multiple of "
-                    f"page_size ({self.page_size}), got {interleave_admission}"
-                )
         occupied = self.active.copy()
         for r in self.prefill_state:
             occupied[r] = True
@@ -957,8 +958,10 @@ class ContinuousBatcher:
         activate the row. ``req`` is pre-allocated on the interleaved path
         (the caller got an id at submit); None allocates one."""
         sampling = sampling or SamplingParams()
-        rng = np.random.default_rng(sampling.seed)
         try:
+            # rng construction INSIDE the protected region: a bad seed
+            # must release the pages like any other first-token failure
+            rng = np.random.default_rng(sampling.seed)
             first = choose_host(last_row, sampling, rng, [])
         except ConstraintExhausted:
             # the constraint permits no FIRST token: the request is

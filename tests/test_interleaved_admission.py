@@ -84,7 +84,6 @@ def test_other_rows_keep_decoding_during_admission():
         b.step()
         produced.append(len(b.results[r_short]) - before)
     # every interleave step also advanced the short row (until it retired)
-    live_steps = [d for d in produced if d >= 0]
     assert sum(produced) > 0
     assert all(d == 1 for d in produced[: min(len(produced), 7)])
     b.run_to_completion()
@@ -198,3 +197,20 @@ def test_interleaved_speculative_preserves_shared_draft_prefix():
     b.run_to_completion()
     assert b.result(r1) == want  # batch-mate untouched by the admission
     assert b.result(r2) == want
+
+
+def test_bad_seed_releases_pages_even_at_activation():
+    """A first-token failure AFTER the pages were allocated (e.g. a bad
+    rng seed surfacing at activation) must release them — on the blocking
+    path by propagating post-release, on the interleaved path by failing
+    the ticket without crashing the step loop."""
+    b = make()
+    with pytest.raises(ValueError):
+        b.submit(SHORT, 4, sampling=SamplingParams(seed=-1))
+    assert int(b.stats["held_pages"]) == 0  # blocking path released
+    r = b.submit(SHORT, 4, sampling=SamplingParams(seed=-1),
+                 interleave_admission=4)
+    b.run_to_completion()  # the failure lands on the ticket, loop survives
+    assert b.finish_reason(r) == "error"
+    assert "ValueError" in b.request_error(r)
+    assert int(b.stats["held_pages"]) == 0
