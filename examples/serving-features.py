@@ -107,3 +107,39 @@ assert mb.result(r1) == solo(merge_lora(params, adapters[1], 2.0), 5)
 assert mb.result(rb) == solo(params, 5)
 print("multi-LoRA OK: 2 adapters + base served in one batch, each equal "
       "to its merged-params solo decode")
+
+# --- interleaved admission + snapshot/resume ----------------------------
+# A long prompt admits one window per step while a short request keeps
+# decoding; mid-way through, the whole serving state snapshots, and a
+# fresh batcher resumes it to the same tokens.
+import pickle
+
+long_prompt = [int(x) for x in np.random.default_rng(3).integers(
+    0, config.vocab_size, 21)]
+ib = ContinuousBatcher(
+    params, config, max_batch=2, n_pages=40, page_size=4,
+    max_pages_per_seq=8,
+)
+r_short = ib.submit(prompt, 8)
+r_long = ib.submit(long_prompt, 4, interleave_admission=4)
+interleave_steps = 0
+while ib.prefill_state:
+    ib.step()
+    interleave_steps += 1
+snap = pickle.dumps(ib.state_dict())
+resumed = ContinuousBatcher(
+    params, config, max_batch=2, n_pages=40, page_size=4,
+    max_pages_per_seq=8,
+)
+resumed.load_state_dict(pickle.loads(snap))
+resumed.run_to_completion()
+long_ref = model.generate_cached(
+    params, jnp.asarray(long_prompt, dtype=jnp.int32)[None, :],
+    max_new_tokens=4,
+)
+assert resumed.result(r_long) == np.asarray(
+    long_ref[0, len(long_prompt):]).tolist()
+assert resumed.result(r_short) == want  # the solo decode from the top
+print(f"interleaved admission OK: {interleave_steps} windows while the "
+      "short request kept decoding; snapshot resumed on a fresh batcher, "
+      "outputs == solo decode")
