@@ -1,6 +1,6 @@
 # Service (control plane) image. Parity with the reference's service image
 # (Dockerfile:1-20): python runtime + kubectl + storage dir; our dependencies
-# are pure-pip (aiohttp/grpcio/pydantic/httpx/tenacity).
+# are pure-pip (aiohttp/grpcio/pydantic/httpx).
 FROM python:3.12-slim AS runtime
 
 RUN apt-get update \

@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+from contextlib import nullcontext
 
 import grpc
 import grpc.aio
@@ -25,12 +26,20 @@ from pydantic import ValidationError
 from bee_code_interpreter_tpu.api import models as api_models
 from bee_code_interpreter_tpu.proto import code_interpreter_pb2 as pb
 from bee_code_interpreter_tpu.proto import health_pb2, reflection_pb2
+from bee_code_interpreter_tpu.resilience import (
+    AdmissionController,
+    AdmissionRejected,
+    BreakerOpenError,
+    Deadline,
+    DeadlineExceeded,
+)
 from bee_code_interpreter_tpu.services.code_executor import CodeExecutor
 from bee_code_interpreter_tpu.services.custom_tool_executor import (
     CustomToolExecuteError,
     CustomToolExecutor,
     CustomToolParseError,
 )
+from bee_code_interpreter_tpu.utils.metrics import Registry
 from bee_code_interpreter_tpu.utils.request_id import new_request_id
 
 logger = logging.getLogger(__name__)
@@ -65,13 +74,88 @@ async def _validated(context: grpc.aio.ServicerContext, model_cls, **fields):
 
 
 class CodeInterpreterServicer:
-    """RPC implementations (reference code_interpreter_servicer.py:33-135)."""
+    """RPC implementations (reference code_interpreter_servicer.py:33-135).
+
+    Resilience contract (docs/resilience.md): sandbox-bound RPCs get a
+    ``Deadline`` — the service budget capped by the client's own gRPC
+    deadline when one is attached — propagated through the executor; a blown
+    deadline aborts DEADLINE_EXCEEDED. When an ``AdmissionController`` is
+    wired in, overload sheds as RESOURCE_EXHAUSTED with a ``retry-after-s``
+    hint in the trailing metadata.
+    """
 
     def __init__(
-        self, code_executor: CodeExecutor, custom_tool_executor: CustomToolExecutor
+        self,
+        code_executor: CodeExecutor,
+        custom_tool_executor: CustomToolExecutor,
+        admission: AdmissionController | None = None,
+        request_deadline_s: float | None = None,
+        metrics: Registry | None = None,
     ) -> None:
         self._code_executor = code_executor
         self._custom_tool_executor = custom_tool_executor
+        self._admission = admission
+        self._request_deadline_s = request_deadline_s
+        self._deadline_exceeded_total = (
+            metrics.counter(
+                "bci_deadline_exceeded_total",
+                "Requests that ran out of their edge deadline",
+            )
+            if metrics is not None
+            else None
+        )
+
+    def _new_deadline(self, context: grpc.aio.ServicerContext) -> Deadline | None:
+        budget = self._request_deadline_s
+        client_remaining = context.time_remaining()
+        if client_remaining is not None:
+            # `is not None`, not truthiness: an already-expired client
+            # deadline reads 0.0, which must become an immediately-expired
+            # Deadline (abort DEADLINE_EXCEEDED), not "no deadline at all".
+            budget = (
+                min(budget, client_remaining)
+                if budget is not None
+                else client_remaining
+            )
+        return Deadline.after(budget) if budget is not None else None
+
+    async def _with_resilience(self, context: grpc.aio.ServicerContext, run):
+        """Run a sandbox-bound RPC body under the edge deadline and the
+        admission gate, mapping the shared shed/deadline abort contract
+        (docs/resilience.md) — the one place it is spelled for gRPC.
+        ``run(deadline)`` returns the success response."""
+        deadline = self._new_deadline(context)
+        try:
+            async with (
+                self._admission.admit(deadline)
+                if self._admission is not None
+                else nullcontext()
+            ):
+                return await run(deadline)
+        except AdmissionRejected as e:
+            context.set_trailing_metadata(
+                (("retry-after-s", f"{e.retry_after_s:g}"),)
+            )
+            await context.abort(
+                grpc.StatusCode.RESOURCE_EXHAUSTED,
+                f"service overloaded ({e.reason}); retry in {e.retry_after_s:g}s",
+            )
+        except DeadlineExceeded:
+            if self._deadline_exceeded_total is not None:
+                self._deadline_exceeded_total.inc(transport="grpc")
+            await context.abort(
+                grpc.StatusCode.DEADLINE_EXCEEDED, "request deadline exceeded"
+            )
+        except BreakerOpenError as e:
+            # Open breaker, no fallback: retryable overload, not an internal
+            # error — UNAVAILABLE with the breaker's retry hint.
+            context.set_trailing_metadata(
+                (("retry-after-s", f"{e.retry_after_s:g}"),)
+            )
+            await context.abort(
+                grpc.StatusCode.UNAVAILABLE,
+                f"backend temporarily unavailable; retry in {e.retry_after_s:g}s",
+            )
 
     async def Execute(
         self, request: pb.ExecuteRequest, context: grpc.aio.ServicerContext
@@ -88,18 +172,23 @@ class CodeInterpreterServicer:
             timeout=request.timeout or None,  # proto default 0 = unset
         )
         logger.info("Executing code: %s", validated.source_code)
-        result = await self._code_executor.execute(
-            source_code=validated.source_code,
-            files=validated.files,
-            env=validated.env,  # env forwarded, unlike reference (:67-70)
-            timeout_s=validated.timeout,
-        )
-        return pb.ExecuteResponse(
-            stdout=result.stdout,
-            stderr=result.stderr,
-            exit_code=result.exit_code,
-            files=result.files,
-        )
+
+        async def run(deadline):
+            result = await self._code_executor.execute(
+                source_code=validated.source_code,
+                files=validated.files,
+                env=validated.env,  # env forwarded, unlike reference (:67-70)
+                timeout_s=validated.timeout,
+                deadline=deadline,
+            )
+            return pb.ExecuteResponse(
+                stdout=result.stdout,
+                stderr=result.stderr,
+                exit_code=result.exit_code,
+                files=result.files,
+            )
+
+        return await self._with_resilience(context, run)
 
     async def ParseCustomTool(
         self, request: pb.ParseCustomToolRequest, context: grpc.aio.ServicerContext
@@ -141,23 +230,29 @@ class CodeInterpreterServicer:
             tool_input_json=request.tool_input_json,
             env=dict(request.env),
         )
-        try:
-            output = await self._custom_tool_executor.execute(
-                tool_source_code=validated.tool_source_code,
-                tool_input_json=validated.tool_input_json,
-                env=validated.env,
-            )
-        except CustomToolParseError as e:
-            await context.abort(grpc.StatusCode.INVALID_ARGUMENT, "; ".join(e.error_messages))
-        except CustomToolExecuteError as e:
+        async def run(deadline):
+            try:
+                output = await self._custom_tool_executor.execute(
+                    tool_source_code=validated.tool_source_code,
+                    tool_input_json=validated.tool_input_json,
+                    env=validated.env,
+                    deadline=deadline,
+                )
+            except CustomToolParseError as e:
+                await context.abort(
+                    grpc.StatusCode.INVALID_ARGUMENT, "; ".join(e.error_messages)
+                )
+            except CustomToolExecuteError as e:
+                return pb.ExecuteCustomToolResponse(
+                    error=pb.ExecuteCustomToolResponse.ErrorResponse(stderr=e.stderr)
+                )
             return pb.ExecuteCustomToolResponse(
-                error=pb.ExecuteCustomToolResponse.ErrorResponse(stderr=e.stderr)
+                success=pb.ExecuteCustomToolResponse.SuccessResponse(
+                    tool_output_json=json.dumps(output)
+                )
             )
-        return pb.ExecuteCustomToolResponse(
-            success=pb.ExecuteCustomToolResponse.SuccessResponse(
-                tool_output_json=json.dumps(output)
-            )
-        )
+
+        return await self._with_resilience(context, run)
 
 
 HEALTH_SERVICE_NAME = "grpc.health.v1.Health"
@@ -386,8 +481,17 @@ class GrpcServer:
         tls_cert: bytes | None = None,
         tls_cert_key: bytes | None = None,
         tls_ca_cert: bytes | None = None,
+        admission: AdmissionController | None = None,
+        request_deadline_s: float | None = None,
+        metrics: Registry | None = None,
     ) -> None:
-        self._servicer = CodeInterpreterServicer(code_executor, custom_tool_executor)
+        self._servicer = CodeInterpreterServicer(
+            code_executor,
+            custom_tool_executor,
+            admission=admission,
+            request_deadline_s=request_deadline_s,
+            metrics=metrics,
+        )
         self.health = HealthServicer()
         self._tls_cert = tls_cert
         self._tls_cert_key = tls_cert_key

@@ -9,17 +9,32 @@ aiohttp is the asyncio-native equivalent and shares the event loop with the
 gRPC server exactly as the reference's uvicorn does (reference __main__.py:24-34).
 
 Request validation errors (pydantic) return 422 like FastAPI would.
+
+Resilience contract (docs/resilience.md): each sandbox-bound request gets a
+``Deadline`` (``APP_REQUEST_DEADLINE_S``) propagated to the executor — a
+blown deadline is 504. When an ``AdmissionController`` is wired in, requests
+past the in-flight + queue bounds are shed as 429 with a ``Retry-After``
+header instead of queueing unboundedly.
 """
 
 from __future__ import annotations
 
 import json
 import logging
+import math
+from contextlib import nullcontext
 
 import pydantic
 from aiohttp import web
 
 from bee_code_interpreter_tpu.api import models
+from bee_code_interpreter_tpu.resilience import (
+    AdmissionController,
+    AdmissionRejected,
+    BreakerOpenError,
+    Deadline,
+    DeadlineExceeded,
+)
 from bee_code_interpreter_tpu.services.code_executor import CodeExecutor
 from bee_code_interpreter_tpu.services.custom_tool_executor import (
     CustomToolExecuteError,
@@ -32,10 +47,16 @@ from bee_code_interpreter_tpu.utils.request_id import new_request_id
 logger = logging.getLogger(__name__)
 
 
+def _retry_after_header(e: AdmissionRejected | BreakerOpenError) -> dict[str, str]:
+    return {"Retry-After": str(max(1, math.ceil(e.retry_after_s)))}
+
+
 def create_http_server(
     code_executor: CodeExecutor,
     custom_tool_executor: CustomToolExecutor,
     metrics: Registry | None = None,
+    admission: AdmissionController | None = None,
+    request_deadline_s: float | None = None,
 ) -> web.Application:
     app = web.Application(client_max_size=1 << 30)
     metrics = metrics or Registry()
@@ -45,6 +66,43 @@ def create_http_server(
     request_seconds = metrics.histogram(
         "bci_http_request_seconds", "HTTP request latency by route"
     )
+    deadline_exceeded_total = metrics.counter(
+        "bci_deadline_exceeded_total",
+        "Requests that ran out of their edge deadline",
+    )
+
+    async def with_resilience(run):
+        """Run a sandbox-bound handler body under the edge deadline and the
+        admission gate, mapping the shared shed/deadline response contract
+        (docs/resilience.md) — the one place it is spelled for HTTP.
+        ``run(deadline)`` returns the success response."""
+        deadline = Deadline.after(request_deadline_s) if request_deadline_s else None
+        try:
+            async with (
+                admission.admit(deadline) if admission is not None else nullcontext()
+            ):
+                return await run(deadline)
+        except AdmissionRejected as e:
+            logger.warning("Request shed: %s", e)
+            return web.json_response(
+                {"detail": "Service overloaded; retry later"},
+                status=429,
+                headers=_retry_after_header(e),
+            )
+        except DeadlineExceeded as e:
+            deadline_exceeded_total.inc(transport="http")
+            logger.warning("Request deadline exceeded: %s", e)
+            return web.json_response({"detail": "Deadline exceeded"}, status=504)
+        except BreakerOpenError as e:
+            # Open breaker and no fallback configured: this is retryable
+            # overload (the breaker knows when it will probe again), not a
+            # server bug — 503 + Retry-After, never a generic 500.
+            logger.warning("Request rejected by open breaker: %s", e)
+            return web.json_response(
+                {"detail": "Backend temporarily unavailable; retry later"},
+                status=503,
+                headers=_retry_after_header(e),
+            )
 
     @web.middleware
     async def request_id_middleware(request: web.Request, handler):
@@ -81,22 +139,31 @@ def create_http_server(
             ) from e
 
     async def execute(request: web.Request) -> web.Response:
-        req = await parse_body(request, models.ExecuteRequest)
-        logger.info("Executing code: %s", req.source_code)
-        try:
-            result = await code_executor.execute(
-                source_code=req.source_code,
-                files=req.files,
-                env=req.env,
-                timeout_s=req.timeout,
+        # Admission runs BEFORE the body is read: a shed request must cost a
+        # queue check, not a (up to client_max_size) body read + pydantic
+        # parse. The deadline covers the body read too.
+        async def run(deadline):
+            req = await parse_body(request, models.ExecuteRequest)
+            logger.info("Executing code: %s", req.source_code)
+            try:
+                result = await code_executor.execute(
+                    source_code=req.source_code,
+                    files=req.files,
+                    env=req.env,
+                    timeout_s=req.timeout,
+                    deadline=deadline,
+                )
+            except (DeadlineExceeded, BreakerOpenError):
+                raise  # handled by the shared resilience contract (504/503)
+            except Exception:
+                logger.exception("Execution failed")
+                return web.json_response({"detail": "Execution failed"}, status=500)
+            logger.info("Execution result: exit_code=%s", result.exit_code)
+            return web.json_response(
+                models.ExecuteResponse(**result.model_dump()).model_dump()
             )
-        except Exception:
-            logger.exception("Execution failed")
-            return web.json_response({"detail": "Execution failed"}, status=500)
-        logger.info("Execution result: exit_code=%s", result.exit_code)
-        return web.json_response(
-            models.ExecuteResponse(**result.model_dump()).model_dump()
-        )
+
+        return await with_resilience(run)
 
     async def parse_custom_tool(request: web.Request) -> web.Response:
         req = await parse_body(request, models.ParseCustomToolRequest)
@@ -113,20 +180,28 @@ def create_http_server(
         )
 
     async def execute_custom_tool(request: web.Request) -> web.Response:
-        req = await parse_body(request, models.ExecuteCustomToolRequest)
-        try:
-            output = await custom_tool_executor.execute(
-                tool_source_code=req.tool_source_code,
-                tool_input_json=req.tool_input_json,
-                env=req.env,
+        async def run(deadline):
+            req = await parse_body(request, models.ExecuteCustomToolRequest)
+            try:
+                output = await custom_tool_executor.execute(
+                    tool_source_code=req.tool_source_code,
+                    tool_input_json=req.tool_input_json,
+                    env=req.env,
+                    deadline=deadline,
+                )
+            except CustomToolParseError as e:
+                return web.json_response(
+                    {"error_messages": e.error_messages}, status=400
+                )
+            except CustomToolExecuteError as e:
+                return web.json_response({"stderr": e.stderr}, status=400)
+            return web.json_response(
+                models.ExecuteCustomToolResponse(
+                    tool_output_json=json.dumps(output)
+                ).model_dump()
             )
-        except CustomToolParseError as e:
-            return web.json_response({"error_messages": e.error_messages}, status=400)
-        except CustomToolExecuteError as e:
-            return web.json_response({"stderr": e.stderr}, status=400)
-        return web.json_response(
-            models.ExecuteCustomToolResponse(tool_output_json=json.dumps(output)).model_dump()
-        )
+
+        return await with_resilience(run)
 
     async def healthz(_request: web.Request) -> web.Response:
         return web.json_response({"status": "ok"})

@@ -54,6 +54,32 @@ class ApplicationContext:
         return self._storage_sweeper_task
 
     @cached_property
+    def admission(self):
+        """Edge admission gate shared by the HTTP and gRPC servers: one
+        in-flight/queue budget for the whole service, not per transport."""
+        from bee_code_interpreter_tpu.resilience import AdmissionController
+
+        return AdmissionController(
+            max_in_flight=self.config.admission_max_in_flight,
+            max_queue=self.config.admission_max_queue,
+            retry_after_s=self.config.admission_retry_after_s,
+            metrics=self.metrics,
+        )
+
+    def _build_local_executor(self):
+        from bee_code_interpreter_tpu.services.local_code_executor import (
+            LocalCodeExecutor,
+        )
+
+        return LocalCodeExecutor(
+            storage=self.storage,
+            workspace_root=self.config.local_workspace_root,
+            disable_dep_install=self.config.disable_dep_install,
+            execution_timeout_s=self.config.execution_timeout_s,
+            shim_dir=self.config.resolved_shim_dir(),
+        )
+
+    @cached_property
     def code_executor(self):
         if self.config.executor_backend == "local":
             # With a native binary configured, sandboxes are real executor-server
@@ -76,17 +102,8 @@ class ApplicationContext:
                     # anchored on the executor's task set (loop refs are weak)
                     executor._spawn_background(executor.fill_sandbox_queue())
                 return executor
-            from bee_code_interpreter_tpu.services.local_code_executor import (
-                LocalCodeExecutor,
-            )
-
-            return LocalCodeExecutor(
-                storage=self.storage,
-                workspace_root=self.config.local_workspace_root,
-                disable_dep_install=self.config.disable_dep_install,
-                execution_timeout_s=self.config.execution_timeout_s,
-                shim_dir=self.config.resolved_shim_dir(),
-            )
+            return self._build_local_executor()
+        from bee_code_interpreter_tpu.resilience import ResilientCodeExecutor
         from bee_code_interpreter_tpu.services.kubectl import Kubectl
         from bee_code_interpreter_tpu.services.kubernetes_code_executor import (
             KubernetesCodeExecutor,
@@ -96,8 +113,10 @@ class ApplicationContext:
             kubectl=Kubectl(kubectl_path=self.config.kubectl_path),
             storage=self.storage,
             config=self.config,
+            metrics=self.metrics,
         )
         self._register_pool_gauges(executor)
+        self._register_breaker_gauges(executor)
         # Pool warmup starts as soon as the executor exists (reference
         # application_context.py:83). Outside a running loop (e.g. tests
         # constructing the context), warmup is deferred — the pool refills on
@@ -109,7 +128,13 @@ class ApplicationContext:
         else:
             # anchored on the executor's task set (loop refs are weak)
             executor._spawn_background(executor.fill_executor_pod_queue())
-        return executor
+        # Graceful degradation: with APP_FALLBACK_TO_LOCAL=true, requests are
+        # served by the local in-process executor while the Kubernetes
+        # backend's breaker is open (docs/resilience.md).
+        fallback = self._build_local_executor() if self.config.fallback_to_local else None
+        return ResilientCodeExecutor(
+            primary=executor, fallback=fallback, metrics=self.metrics
+        )
 
     def _register_pool_gauges(self, executor) -> None:
         self.metrics.gauge(
@@ -123,6 +148,15 @@ class ApplicationContext:
             lambda: executor.pool_spawning_count,
         )
 
+    def _register_breaker_gauges(self, executor) -> None:
+        for breaker in (executor.spawn_breaker, executor.http_breaker):
+            self.metrics.gauge(
+                "bci_breaker_state",
+                "Circuit breaker state (0=closed, 1=open, 2=half-open)",
+                (lambda b: lambda: int(b.state))(breaker),
+                breaker=breaker.name,
+            )
+
     @cached_property
     def custom_tool_executor(self) -> CustomToolExecutor:
         return CustomToolExecutor(code_executor=self.code_executor)
@@ -135,6 +169,8 @@ class ApplicationContext:
             code_executor=self.code_executor,
             custom_tool_executor=self.custom_tool_executor,
             metrics=self.metrics,
+            admission=self.admission,
+            request_deadline_s=self.config.request_deadline_s,
         )
 
     @cached_property
@@ -147,4 +183,7 @@ class ApplicationContext:
             tls_cert=self.config.grpc_tls_cert,
             tls_cert_key=self.config.grpc_tls_cert_key,
             tls_ca_cert=self.config.grpc_tls_ca_cert,
+            admission=self.admission,
+            request_deadline_s=self.config.request_deadline_s,
+            metrics=self.metrics,
         )

@@ -81,6 +81,36 @@ class Config(BaseModel):
     # Cold pod spawn readiness bound (reference kubernetes_code_executor.py:239-241).
     pod_ready_timeout_s: float = 60.0
 
+    # --- resilience (new; see docs/resilience.md) ---
+    # Total wall-clock budget per request, created as a Deadline at the API
+    # edge and propagated through spawn/upload/execute/download so the sum of
+    # all downstream work is bounded — not each step independently.
+    request_deadline_s: float = Field(default=120.0, gt=0)
+    # When the Kubernetes backend's circuit breaker is open, serve requests
+    # with the local in-process executor instead of failing (degraded
+    # isolation, preserved availability). APP_FALLBACK_TO_LOCAL=true.
+    fallback_to_local: bool = False
+    # Circuit breakers around pod-group spawn and the executor HTTP data
+    # plane: trip OPEN once failure_rate_threshold is hit across the last
+    # `window` calls (given at least min_calls outcomes); probe again after
+    # cooldown_s with up to half_open_max_calls concurrent half-open calls.
+    breaker_window: int = Field(default=10, ge=1)
+    breaker_failure_rate_threshold: float = Field(default=0.5, gt=0, le=1)
+    breaker_min_calls: int = Field(default=4, ge=1)
+    breaker_cooldown_s: float = Field(default=30.0, gt=0)
+    breaker_half_open_max_calls: int = Field(default=1, ge=1)
+    # Admission control at the edge: max_in_flight requests execute, up to
+    # admission_max_queue wait (deadline-bounded); the rest shed as HTTP 429
+    # / gRPC RESOURCE_EXHAUSTED with a Retry-After of admission_retry_after_s.
+    admission_max_in_flight: int = Field(default=64, ge=1)
+    admission_max_queue: int = Field(default=128, ge=0)
+    admission_retry_after_s: float = Field(default=1.0, gt=0)
+    # Transient-failure retry schedule for executor spawn and data-plane
+    # calls (the seed hardcoded tenacity's 3×/4-10s at import time).
+    executor_retry_attempts: int = Field(default=3, ge=1)
+    executor_retry_wait_min_s: float = Field(default=4.0, gt=0)
+    executor_retry_wait_max_s: float = Field(default=10.0, gt=0)
+
     # --- object storage (reference config.py:74) ---
     file_storage_path: str = "./.tmp/files"
     # Optional TTL sweep of stored objects (the reference leaves cleanup to

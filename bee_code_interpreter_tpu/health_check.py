@@ -3,11 +3,20 @@
 Reference: health_check.py:25-53 — Execute("print(21 * 2)") must return stdout
 "42\\n". Used as the k8s liveness command and as the gate before the e2e suite.
 
-    python -m bee_code_interpreter_tpu.health_check [addr]
+The seed made a single 120 s attempt with no connect timeout, so a probe
+against a booting (or dead) service either hung or died with a raw traceback.
+Now: a per-attempt deadline (``--timeout``), retry-with-backoff on transient
+gRPC statuses (``UNAVAILABLE`` — connection refused/reset — and
+``DEADLINE_EXCEEDED``), and a clear nonzero-exit message when the service
+stays unreachable.
+
+    python -m bee_code_interpreter_tpu.health_check [addr] \\
+        [--timeout S] [--attempts N] [--backoff S]
 """
 
 from __future__ import annotations
 
+import argparse
 import asyncio
 import os
 import sys
@@ -16,9 +25,12 @@ import grpc.aio
 
 from bee_code_interpreter_tpu.api.grpc_server import service_stubs
 from bee_code_interpreter_tpu.proto import code_interpreter_pb2 as pb
+from bee_code_interpreter_tpu.resilience import RetryPolicy
+
+RETRYABLE_STATUS = (grpc.StatusCode.UNAVAILABLE, grpc.StatusCode.DEADLINE_EXCEEDED)
 
 
-async def check(addr: str) -> None:
+def _channel(addr: str) -> grpc.aio.Channel:
     cert = os.environ.get("APP_GRPC_TLS_CERT")
     key = os.environ.get("APP_GRPC_TLS_CERT_KEY")
     ca = os.environ.get("APP_GRPC_TLS_CA_CERT")
@@ -28,23 +40,97 @@ async def check(addr: str) -> None:
             private_key=key.encode(),
             certificate_chain=cert.encode(),
         )
-        channel = grpc.aio.secure_channel(addr, creds)
-    else:
-        channel = grpc.aio.insecure_channel(addr)
-    async with channel:
+        return grpc.aio.secure_channel(addr, creds)
+    return grpc.aio.insecure_channel(addr)
+
+
+async def _attempt(addr: str, timeout: float) -> None:
+    async with _channel(addr) as channel:
         stubs = service_stubs(channel)
+        # The RPC deadline doubles as the connect timeout: a dead endpoint
+        # fails the attempt instead of hanging the probe.
         response = await stubs["Execute"](
-            pb.ExecuteRequest(source_code="print(21 * 2)"), timeout=120
+            pb.ExecuteRequest(source_code="print(21 * 2)"), timeout=timeout
         )
     assert response.stdout == "42\n", f"unexpected stdout: {response.stdout!r}"
     assert response.exit_code == 0, f"unexpected exit code: {response.exit_code}"
 
 
+async def check(
+    addr: str, timeout: float = 120.0, attempts: int = 3, backoff: float = 2.0
+) -> None:
+    policy = RetryPolicy(attempts=attempts, wait_min_s=backoff, wait_max_s=backoff * 8)
+    last: grpc.aio.AioRpcError | None = None
+    for attempt in range(1, attempts + 1):
+        try:
+            await _attempt(addr, timeout)
+            return
+        except grpc.aio.AioRpcError as e:
+            if e.code() not in RETRYABLE_STATUS:
+                raise
+            last = e
+            if attempt < attempts:
+                sleep_s = policy.backoff_s(attempt)
+                print(
+                    f"attempt {attempt}/{attempts}: gRPC {e.code().name} "
+                    f"({e.details()}); retrying in {sleep_s:g}s",
+                    file=sys.stderr,
+                )
+                await asyncio.sleep(sleep_s)
+    assert last is not None
+    raise last
+
+
 def main() -> None:
-    addr = sys.argv[1] if len(sys.argv) > 1 else os.environ.get(
-        "APP_GRPC_ADDR", "localhost:50051"
+    parser = argparse.ArgumentParser(
+        description="End-to-end gRPC health check (Execute must return 42)."
     )
-    asyncio.run(check(addr))
+    parser.add_argument(
+        "addr",
+        nargs="?",
+        default=os.environ.get("APP_GRPC_ADDR", "localhost:50051"),
+    )
+    parser.add_argument(
+        "--timeout",
+        type=float,
+        default=float(os.environ.get("APP_HEALTH_TIMEOUT_S", "120")),
+        help="per-attempt RPC deadline in seconds (also bounds connect)",
+    )
+    parser.add_argument(
+        "--attempts", type=int, default=3, help="total attempts before giving up"
+    )
+    parser.add_argument(
+        "--backoff",
+        type=float,
+        default=2.0,
+        help="initial retry backoff in seconds (doubles per attempt)",
+    )
+    args = parser.parse_args()
+    try:
+        asyncio.run(
+            check(
+                args.addr,
+                timeout=args.timeout,
+                attempts=args.attempts,
+                backoff=args.backoff,
+            )
+        )
+    except grpc.aio.AioRpcError as e:
+        if e.code() is grpc.StatusCode.UNAVAILABLE:
+            print(
+                f"UNHEALTHY: service at {args.addr} unreachable after "
+                f"{args.attempts} attempt(s): gRPC UNAVAILABLE ({e.details()})",
+                file=sys.stderr,
+            )
+        else:
+            print(
+                f"UNHEALTHY: gRPC {e.code().name} from {args.addr}: {e.details()}",
+                file=sys.stderr,
+            )
+        sys.exit(2)
+    except AssertionError as e:
+        print(f"UNHEALTHY: {e}", file=sys.stderr)
+        sys.exit(1)
     print("healthy")
 
 

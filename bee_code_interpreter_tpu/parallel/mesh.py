@@ -31,8 +31,20 @@ def initialize_distributed() -> bool:
     # Idempotency must NOT be probed via jax.process_count(): that call
     # initializes the XLA backend, after which jax.distributed.initialize
     # refuses to run at all (caught by tests/test_multihost_distributed.py).
-    # is_initialized() checks the coordination client without touching XLA.
-    if jax.distributed.is_initialized():
+    # is_initialized() checks the coordination client without touching XLA —
+    # but only newer jax exposes it publicly; otherwise probe the internal
+    # coordination state the same way is_initialized() does.
+    is_initialized = getattr(jax.distributed, "is_initialized", None)
+    if is_initialized is not None:
+        initialized = is_initialized()
+    else:
+        try:
+            from jax._src.distributed import global_state
+
+            initialized = global_state.client is not None
+        except Exception:
+            initialized = False
+    if initialized:
         return True
     jax.distributed.initialize(
         coordinator_address=os.environ["JAX_COORDINATOR_ADDRESS"],

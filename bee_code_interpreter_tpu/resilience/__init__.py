@@ -1,0 +1,45 @@
+"""Resilience subsystem: deadline propagation, retries, circuit breaking,
+admission control, and graceful degradation (see docs/resilience.md).
+
+The API edge creates a ``Deadline`` per request and passes it down; executors
+retry transient failures under ``RetryPolicy``; ``CircuitBreaker`` trips on
+sustained failure of pod spawn or the executor data plane; the
+``AdmissionController`` sheds load once in-flight + queue bounds are hit; and
+``ResilientCodeExecutor`` routes around an open breaker to the local fallback.
+"""
+
+from bee_code_interpreter_tpu.resilience.admission import (
+    AdmissionController,
+    AdmissionRejected,
+)
+from bee_code_interpreter_tpu.resilience.circuit_breaker import (
+    BreakerOpenError,
+    BreakerState,
+    CircuitBreaker,
+)
+from bee_code_interpreter_tpu.resilience.deadline import Deadline, DeadlineExceeded
+from bee_code_interpreter_tpu.resilience.errors import (
+    SandboxError,
+    SandboxFatalError,
+    SandboxTransientError,
+    classify_http_status,
+)
+from bee_code_interpreter_tpu.resilience.executor import ResilientCodeExecutor
+from bee_code_interpreter_tpu.resilience.retry import RetryPolicy, retryable
+
+__all__ = [
+    "AdmissionController",
+    "AdmissionRejected",
+    "BreakerOpenError",
+    "BreakerState",
+    "CircuitBreaker",
+    "Deadline",
+    "DeadlineExceeded",
+    "ResilientCodeExecutor",
+    "RetryPolicy",
+    "SandboxError",
+    "SandboxFatalError",
+    "SandboxTransientError",
+    "classify_http_status",
+    "retryable",
+]

@@ -1,0 +1,137 @@
+"""Admission control: bounded in-flight work + bounded, deadline-aware queue.
+
+The seed accepted every request and let them pile up inside the executor
+(unbounded queueing → every client times out). This gate enforces the
+standard load-shedding contract instead:
+
+- up to ``max_in_flight`` requests execute concurrently;
+- up to ``max_queue`` more wait, each bounded by its own request deadline
+  (or ``default_wait_s`` when the edge didn't attach one);
+- everything beyond that — and any waiter whose deadline would expire in the
+  queue — is shed *immediately* with ``AdmissionRejected`` carrying a
+  retry-after hint. The HTTP edge maps this to 429 + ``Retry-After``; the
+  gRPC edge to ``RESOURCE_EXHAUSTED``. Nothing ever hangs.
+
+Slot handoff is direct: a releasing request transfers its slot to the oldest
+live waiter without decrementing the in-flight count, so a burst can never
+overshoot ``max_in_flight``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from collections import deque
+from contextlib import asynccontextmanager
+
+
+class AdmissionRejected(Exception):
+    def __init__(self, reason: str, retry_after_s: float) -> None:
+        super().__init__(f"request shed: {reason} (retry in {retry_after_s:.1f}s)")
+        self.reason = reason
+        self.retry_after_s = max(0.0, retry_after_s)
+
+
+class AdmissionController:
+    def __init__(
+        self,
+        max_in_flight: int = 64,
+        max_queue: int = 128,
+        default_wait_s: float = 30.0,
+        retry_after_s: float = 1.0,
+        metrics=None,
+    ) -> None:
+        self._max_in_flight = max(1, max_in_flight)
+        self._max_queue = max(0, max_queue)
+        self._default_wait_s = default_wait_s
+        self._retry_after_s = retry_after_s
+        self._in_flight = 0
+        self._waiters: deque[asyncio.Future] = deque()
+        self._shed_total = None
+        self._admitted_total = None
+        if metrics is not None:
+            self._shed_total = metrics.counter(
+                "bci_admission_shed_total", "Requests shed by admission control"
+            )
+            self._admitted_total = metrics.counter(
+                "bci_admission_admitted_total", "Requests admitted past the gate"
+            )
+            metrics.gauge(
+                "bci_admission_in_flight",
+                "Requests currently executing past admission",
+                lambda: self._in_flight,
+            )
+            metrics.gauge(
+                "bci_admission_queue_depth",
+                "Requests waiting in the admission queue",
+                lambda: len(self._waiters),
+            )
+
+    @property
+    def in_flight(self) -> int:
+        return self._in_flight
+
+    @property
+    def queue_depth(self) -> int:
+        return len(self._waiters)
+
+    def _shed(self, reason: str) -> None:
+        if self._shed_total is not None:
+            self._shed_total.inc(reason=reason)
+        raise AdmissionRejected(reason, self._retry_after_s)
+
+    @asynccontextmanager
+    async def admit(self, deadline=None):
+        await self._acquire(deadline)
+        try:
+            yield
+        finally:
+            self._release()
+
+    async def _acquire(self, deadline) -> None:
+        if self._in_flight < self._max_in_flight and not self._waiters:
+            self._in_flight += 1
+            self._admitted()
+            return
+        if len(self._waiters) >= self._max_queue:
+            self._shed("queue_full")
+        timeout = self._default_wait_s
+        if deadline is not None:
+            timeout = min(timeout, deadline.remaining())
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._waiters.append(fut)
+        try:
+            await asyncio.wait_for(fut, timeout)
+        except (asyncio.TimeoutError, TimeoutError):
+            self._abandon_wait(fut)
+            self._shed("queue_timeout")
+        except asyncio.CancelledError:
+            # Client disconnected while queued: the dead future must not keep
+            # consuming a queue slot (it would shed healthy traffic as
+            # queue_full long after the client left).
+            self._abandon_wait(fut)
+            raise
+        else:
+            # Slot transferred by _release(); in-flight already accounts us.
+            self._admitted()
+
+    def _abandon_wait(self, fut: asyncio.Future) -> None:
+        """Withdraw a waiter that will not proceed, returning any slot the
+        grant-vs-abandon race already transferred to it."""
+        try:
+            self._waiters.remove(fut)
+        except ValueError:
+            pass
+        if fut.done() and not fut.cancelled():
+            self._release()
+
+    def _admitted(self) -> None:
+        if self._admitted_total is not None:
+            self._admitted_total.inc()
+
+    def _release(self) -> None:
+        while self._waiters:
+            fut = self._waiters.popleft()
+            if not fut.done():
+                fut.set_result(None)  # direct handoff: in-flight unchanged
+                return
+        self._in_flight -= 1

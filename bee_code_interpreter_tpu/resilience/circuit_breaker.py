@@ -1,0 +1,179 @@
+"""Failure-rate circuit breaker: closed → open → half-open.
+
+Wraps the two places the Kubernetes backend can melt down under load — pod
+group spawn (apiserver / scheduler trouble) and the executor HTTP data plane
+(pod network / sandbox trouble). While OPEN, calls fail immediately with
+``BreakerOpenError`` carrying a retry-after hint, instead of queueing behind
+a backend that is down; the service layer uses that signal to degrade to the
+local executor (``APP_FALLBACK_TO_LOCAL``).
+
+State machine:
+
+- CLOSED: outcomes are recorded in a sliding window of the last ``window``
+  calls. Once at least ``min_calls`` outcomes exist and the failure rate
+  reaches ``failure_rate_threshold``, the breaker trips OPEN.
+- OPEN: every call is rejected until ``cooldown_s`` elapses.
+- HALF_OPEN: up to ``half_open_max_calls`` concurrent probes are let through.
+  A probe success closes the breaker (window reset); a failure re-opens it
+  and restarts the cooldown.
+
+The clock is injectable for deterministic tests (``tests/chaos.ManualClock``).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import enum
+import time
+from collections import deque
+from contextlib import asynccontextmanager
+from typing import Callable
+
+from bee_code_interpreter_tpu.resilience.deadline import DeadlineExceeded
+
+
+class BreakerState(enum.IntEnum):
+    CLOSED = 0
+    OPEN = 1
+    HALF_OPEN = 2
+
+
+class BreakerOpenError(Exception):
+    """Rejected fast because the breaker is open.
+
+    Not a ``RuntimeError`` on purpose: retry policies must never retry it
+    (the whole point is to stop hammering a down backend), and the service
+    layer catches it specifically to route to the fallback executor.
+    """
+
+    def __init__(self, name: str, retry_after_s: float) -> None:
+        super().__init__(
+            f"circuit breaker {name!r} is open; retry in {retry_after_s:.1f}s"
+        )
+        self.name = name
+        self.retry_after_s = max(0.0, retry_after_s)
+
+
+class CircuitBreaker:
+    def __init__(
+        self,
+        name: str,
+        *,
+        window: int = 10,
+        failure_rate_threshold: float = 0.5,
+        min_calls: int = 4,
+        cooldown_s: float = 30.0,
+        half_open_max_calls: int = 1,
+        clock: Callable[[], float] = time.monotonic,
+        is_failure: Callable[[BaseException], bool] | None = None,
+        on_transition: Callable[[str, BreakerState], None] | None = None,
+    ) -> None:
+        self.name = name
+        self._window: deque[bool] = deque(maxlen=max(1, window))
+        self._failure_rate_threshold = failure_rate_threshold
+        self._min_calls = max(1, min_calls)
+        self._cooldown_s = cooldown_s
+        self._half_open_max_calls = max(1, half_open_max_calls)
+        self._clock = clock
+        self._is_failure = is_failure or (lambda e: True)
+        # Public so a host (e.g. KubernetesCodeExecutor) can attach its metrics
+        # recorder to an externally constructed breaker.
+        self.on_transition = on_transition
+        self._state = BreakerState.CLOSED
+        self._opened_at = 0.0
+        self._half_open_inflight = 0
+
+    # ------------------------------------------------------------------ state
+
+    @property
+    def state(self) -> BreakerState:
+        """Effective state (reports HALF_OPEN once the cooldown has elapsed,
+        without waiting for the next call to observe it)."""
+        if (
+            self._state is BreakerState.OPEN
+            and self._clock() >= self._opened_at + self._cooldown_s
+        ):
+            return BreakerState.HALF_OPEN
+        return self._state
+
+    def _transition(self, new: BreakerState) -> None:
+        if new is self._state:
+            return
+        self._state = new
+        if self.on_transition is not None:
+            self.on_transition(self.name, new)
+
+    def _trip(self) -> None:
+        self._opened_at = self._clock()
+        self._window.clear()
+        self._half_open_inflight = 0
+        self._transition(BreakerState.OPEN)
+
+    # ------------------------------------------------------------------ calls
+
+    def before_call(self) -> None:
+        """Gate a call; raises ``BreakerOpenError`` when it must not proceed.
+        In half-open state this reserves one of the probe slots."""
+        if self._state is BreakerState.OPEN:
+            now = self._clock()
+            reopen_at = self._opened_at + self._cooldown_s
+            if now < reopen_at:
+                raise BreakerOpenError(self.name, reopen_at - now)
+            self._half_open_inflight = 0
+            self._transition(BreakerState.HALF_OPEN)
+        if self._state is BreakerState.HALF_OPEN:
+            if self._half_open_inflight >= self._half_open_max_calls:
+                raise BreakerOpenError(self.name, self._cooldown_s)
+            self._half_open_inflight += 1
+
+    def record_success(self) -> None:
+        if self._state is BreakerState.HALF_OPEN:
+            self._half_open_inflight = max(0, self._half_open_inflight - 1)
+            self._window.clear()
+            self._transition(BreakerState.CLOSED)
+            return
+        self._window.append(True)
+
+    def record_failure(self) -> None:
+        if self._state is BreakerState.HALF_OPEN:
+            self._half_open_inflight = max(0, self._half_open_inflight - 1)
+            self._trip()
+            return
+        if self._state is BreakerState.OPEN:
+            return
+        self._window.append(False)
+        if len(self._window) >= self._min_calls:
+            failures = sum(1 for ok in self._window if not ok)
+            if failures / len(self._window) >= self._failure_rate_threshold:
+                self._trip()
+
+    def record_abandoned(self) -> None:
+        """A call ended without a verdict on backend health (e.g. the client
+        disconnected): release any half-open probe slot, record nothing."""
+        if self._state is BreakerState.HALF_OPEN:
+            self._half_open_inflight = max(0, self._half_open_inflight - 1)
+
+    @asynccontextmanager
+    async def guard(self):
+        """``async with breaker.guard(): ...`` — gates the call and records
+        its outcome. Exceptions the ``is_failure`` predicate rejects (e.g. a
+        4xx ``SandboxFatalError``: the backend *answered*) count as successes
+        for breaker purposes. ``CancelledError`` and ``DeadlineExceeded`` are
+        client-driven — the caller's budget ran out, which says nothing about
+        backend health — and count as neither: a few impatient clients must
+        not trip the breaker for everyone. Genuine backend hangs still count,
+        because they blow the *config-level* bounds (pod_ready_timeout_s /
+        executor_http_timeout_s) and surface as transient/runtime errors."""
+        self.before_call()
+        try:
+            yield
+        except BaseException as e:
+            if isinstance(e, (asyncio.CancelledError, DeadlineExceeded)):
+                self.record_abandoned()
+            elif self._is_failure(e):
+                self.record_failure()
+            else:
+                self.record_success()
+            raise
+        else:
+            self.record_success()

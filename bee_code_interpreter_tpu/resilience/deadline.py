@@ -1,0 +1,89 @@
+"""Request deadline propagation.
+
+A ``Deadline`` is created once at the API edge (HTTP or gRPC handler) and
+passed down the whole vertical stack — pod-group spawn, workspace upload,
+``POST /execute``, download — so every downstream operation budgets against
+*the same clock* instead of each holding an independent fixed timeout. The
+classic failure this prevents: a 60 s pod spawn followed by a 60 s execute
+"succeeding" 100 s after the client gave up at 30 s.
+
+The clock is injectable (``clock=time.monotonic`` by default) so breaker and
+deadline unit tests are deterministic. ``run()`` — the hard wall-clock bound —
+always uses the event loop's real clock, because it must actually cancel work.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Awaitable, Callable, TypeVar
+
+T = TypeVar("T")
+
+
+class DeadlineExceeded(Exception):
+    """The edge deadline for this request ran out.
+
+    Deliberately NOT a ``RuntimeError``: retry policies retry RuntimeErrors
+    (spawn) and transient sandbox errors, and a blown deadline must never be
+    retried — there is no budget left to retry into.
+    """
+
+    def __init__(self, what: str = "request") -> None:
+        super().__init__(f"deadline exceeded during {what}")
+        self.what = what
+
+
+class Deadline:
+    """Monotonic absolute deadline with a shrinking ``remaining()`` budget."""
+
+    def __init__(
+        self, seconds: float, clock: Callable[[], float] = time.monotonic
+    ) -> None:
+        self.budget_s = seconds
+        self._clock = clock
+        self._expires_at = clock() + seconds
+
+    @classmethod
+    def after(
+        cls, seconds: float, clock: Callable[[], float] = time.monotonic
+    ) -> "Deadline":
+        return cls(seconds, clock=clock)
+
+    def remaining(self) -> float:
+        """Seconds left; never negative."""
+        return max(0.0, self._expires_at - self._clock())
+
+    @property
+    def expired(self) -> bool:
+        return self.remaining() <= 0.0
+
+    def check(self, what: str = "request") -> None:
+        """Raise ``DeadlineExceeded`` if the budget is gone (pre-flight gate:
+        don't start an operation there is no time to finish)."""
+        if self.expired:
+            raise DeadlineExceeded(what)
+
+    def clamp(self, timeout_s: float | None) -> float:
+        """An operation-local timeout, never past the deadline."""
+        remaining = self.remaining()
+        if timeout_s is None:
+            return remaining
+        return min(timeout_s, remaining)
+
+    async def run(self, awaitable: Awaitable[T], what: str = "request") -> T:
+        """Await with a hard bound at the deadline; the awaited work is
+        cancelled (cleanup handlers run) and ``DeadlineExceeded`` raised when
+        the budget runs out."""
+        if self.expired:
+            close = getattr(awaitable, "close", None)
+            if close is not None:
+                close()  # never-started coroutine: don't leave it dangling
+            raise DeadlineExceeded(what)
+        try:
+            return await asyncio.wait_for(awaitable, timeout=self.remaining())
+        except (asyncio.TimeoutError, TimeoutError) as e:
+            raise DeadlineExceeded(what) from e
+
+    def __repr__(self) -> str:  # debugging/log ergonomics
+        return f"Deadline(remaining={self.remaining():.3f}s of {self.budget_s:.3f}s)"

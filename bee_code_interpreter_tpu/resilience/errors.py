@@ -1,0 +1,40 @@
+"""Typed sandbox data-plane errors.
+
+The reference (and our seed) raised bare ``RuntimeError`` for every executor
+HTTP failure, which made its retry layer re-attempt non-retryable failures —
+a sandbox answering 400 is *working* and will answer 400 again. These classes
+split the space the way every production RPC stack does:
+
+- ``SandboxTransientError`` — the backend may recover: 5xx responses, request
+  timeouts, connection resets/refusals. Worth retrying — with the caveat that
+  retrying a failure observed AFTER ``/execute`` was dispatched gives
+  at-least-once execution semantics for the user's code (the reference
+  behaved the same way; see docs/resilience.md).
+- ``SandboxFatalError`` — the backend answered authoritatively with a client
+  error (4xx) or an otherwise non-retryable response. Retrying burns budget
+  and latency for an identical answer.
+
+Both subclass ``RuntimeError`` so pre-existing ``except RuntimeError`` call
+sites keep working; retry policies narrow on the transient subclass only.
+"""
+
+from __future__ import annotations
+
+
+class SandboxError(RuntimeError):
+    """Base class for executor data-plane failures."""
+
+
+class SandboxTransientError(SandboxError):
+    """Retryable failure: 5xx, timeout, connect error, connection reset."""
+
+
+class SandboxFatalError(SandboxError):
+    """Non-retryable failure: the sandbox answered, and the answer is no."""
+
+
+def classify_http_status(status: int, what: str) -> "SandboxError":
+    """Build the right error for a non-success executor HTTP status."""
+    if status >= 500:
+        return SandboxTransientError(f"{what}: HTTP {status}")
+    return SandboxFatalError(f"{what}: HTTP {status}")

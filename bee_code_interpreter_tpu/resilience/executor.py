@@ -1,0 +1,87 @@
+"""Deadline-enforcing, breaker-aware front over the primary code executor.
+
+This is the graceful-degradation seam: when the Kubernetes backend's spawn
+(or data-plane) breaker is open and a local fallback executor is configured
+(``APP_FALLBACK_TO_LOCAL=true``), requests are served by the local
+native-process path instead of failing — degraded isolation, preserved
+availability. The edge deadline is also enforced here as a *hard* wall-clock
+bound (``Deadline.run``): downstream code already budgets each call with
+``remaining()``, and this wrapper guarantees the sum cannot drift past the
+edge promise even through retries and teardown.
+"""
+
+from __future__ import annotations
+
+import logging
+
+from bee_code_interpreter_tpu.resilience.circuit_breaker import BreakerOpenError
+from bee_code_interpreter_tpu.resilience.deadline import Deadline
+from bee_code_interpreter_tpu.services.code_executor import Result
+from bee_code_interpreter_tpu.utils.validation import AbsolutePath, Hash
+
+logger = logging.getLogger(__name__)
+
+
+class ResilientCodeExecutor:
+    def __init__(
+        self,
+        primary,
+        fallback=None,
+        metrics=None,
+        fallback_breakers: tuple[str, ...] = ("k8s-spawn",),
+    ) -> None:
+        self.primary = primary
+        self.fallback = fallback
+        # Only breakers that reject BEFORE user code is dispatched are safe
+        # to fall back from: the spawn breaker fires during sandbox
+        # acquisition. The data-plane breaker can open mid-request — after
+        # /execute already ran on the pod — and re-running side-effectful
+        # user code locally would execute it twice.
+        self._fallback_breakers = frozenset(fallback_breakers)
+        self._fallback_total = None
+        if metrics is not None:
+            self._fallback_total = metrics.counter(
+                "bci_executor_fallback_total",
+                "Executions routed to the local fallback while a breaker was open",
+            )
+
+    async def execute(
+        self,
+        source_code: str,
+        files: dict[AbsolutePath, Hash] | None = None,
+        env: dict[str, str] | None = None,
+        timeout_s: float | None = None,
+        deadline: Deadline | None = None,
+    ) -> Result:
+        # Blown deadlines are counted once, at the API edge (the
+        # bci_deadline_exceeded_total{transport=...} counter) — not here too.
+        inner = self._execute(source_code, files, env, timeout_s, deadline)
+        if deadline is None:
+            return await inner
+        return await deadline.run(inner, what="execute")
+
+    async def _execute(self, source_code, files, env, timeout_s, deadline) -> Result:
+        try:
+            return await self.primary.execute(
+                source_code=source_code,
+                files=files,
+                env=env,
+                timeout_s=timeout_s,
+                deadline=deadline,
+            )
+        except BreakerOpenError as e:
+            if self.fallback is None or e.name not in self._fallback_breakers:
+                raise
+            logger.warning(
+                "Breaker %r open (%s); degrading to the local fallback executor",
+                e.name, e,
+            )
+            if self._fallback_total is not None:
+                self._fallback_total.inc()
+            return await self.fallback.execute(
+                source_code=source_code,
+                files=files,
+                env=env,
+                timeout_s=timeout_s,
+                deadline=deadline,
+            )

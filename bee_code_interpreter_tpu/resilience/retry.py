@@ -1,0 +1,77 @@
+"""Config-driven async retry with exponential backoff.
+
+In-repo replacement for the tenacity decorators the seed used (the library
+isn't available in every runtime image, and its per-class decorators froze
+the backoff schedule at import time — untestable and untunable). Policies
+live on the *instance* (built from ``Config``), so deployments tune attempts
+and backoff via env and tests can observe real schedules in milliseconds.
+
+Deadline-aware: when the wrapped call received a ``deadline=`` kwarg, the
+retry loop refuses to sleep past it — the last error is re-raised instead of
+burning budget waiting out a backoff that cannot complete.
+
+``functools.wraps`` preserves ``__wrapped__``, so tests can keep calling
+``executor.spawn_pod_group.__wrapped__(executor)`` to bypass retries.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import functools
+import logging
+from dataclasses import dataclass
+
+from bee_code_interpreter_tpu.resilience.deadline import Deadline
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass(frozen=True)
+class RetryPolicy:
+    """Exponential backoff: ``wait_min_s * 2**(attempt-1)`` capped at
+    ``wait_max_s``, for ``attempts`` total tries on ``retry_on`` errors."""
+
+    attempts: int = 3
+    wait_min_s: float = 4.0
+    wait_max_s: float = 10.0
+    retry_on: tuple[type[BaseException], ...] = (Exception,)
+
+    def backoff_s(self, attempt: int) -> float:
+        return min(self.wait_min_s * (2 ** (attempt - 1)), self.wait_max_s)
+
+
+def retryable(policy_attr: str, op: str):
+    """Decorate an async method; the policy is read from ``self.<policy_attr>``
+    at call time. If the instance defines ``_on_retry_backoff(op, attempt,
+    sleep_s, exc)`` it is invoked before each backoff sleep (metrics/tests)."""
+
+    def decorate(fn):
+        @functools.wraps(fn)
+        async def wrapper(self, *args, **kwargs):
+            policy: RetryPolicy = getattr(self, policy_attr)
+            deadline: Deadline | None = kwargs.get("deadline")
+            attempt = 0
+            while True:
+                attempt += 1
+                try:
+                    return await fn(self, *args, **kwargs)
+                except policy.retry_on as e:
+                    if attempt >= policy.attempts:
+                        raise
+                    sleep_s = policy.backoff_s(attempt)
+                    if deadline is not None and deadline.remaining() <= sleep_s:
+                        # No budget to wait out the backoff AND re-attempt:
+                        # surface the real failure now, not a later timeout.
+                        raise
+                    record = getattr(self, "_on_retry_backoff", None)
+                    if record is not None:
+                        record(op, attempt, sleep_s, e)
+                    logger.warning(
+                        "%s attempt %d/%d failed (%s); retrying in %.2fs",
+                        op, attempt, policy.attempts, e, sleep_s,
+                    )
+                    await asyncio.sleep(sleep_s)
+
+        return wrapper
+
+    return decorate

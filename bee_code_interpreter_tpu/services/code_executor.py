@@ -35,4 +35,5 @@ class CodeExecutor(Protocol):
         files: dict[AbsolutePath, Hash] | None = None,
         env: dict[str, str] | None = None,
         timeout_s: float | None = None,
+        deadline=None,  # resilience.Deadline created at the API edge
     ) -> Result: ...

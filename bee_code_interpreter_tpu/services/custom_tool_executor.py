@@ -308,6 +308,7 @@ class CustomToolExecutor:
         tool_source_code: str,
         tool_input_json: str,
         env: dict[str, str] | None = None,
+        deadline=None,
     ) -> Any:
         """Run the tool in the sandbox; returns the (JSON-decodable) output value."""
         tool_source_code = textwrap.dedent(tool_source_code)
@@ -355,7 +356,9 @@ def _default(o):
 
 print(_json.dumps(_result, default=_default))
 """
-        result = await self._code_executor.execute(source_code=wrapper, env=env or {})
+        result = await self._code_executor.execute(
+            source_code=wrapper, env=env or {}, deadline=deadline
+        )
         if result.exit_code != 0:
             raise CustomToolExecuteError(result.stderr)
         return json.loads(result.stdout)

@@ -7,13 +7,31 @@ snapshot and ``POST /execute`` for the run. This mixin holds the driver side of
 that contract (reference kubernetes_code_executor.py:95-142), addressed by
 ``host:port`` so the transport is identical whether the sandbox is across the
 pod network or on localhost.
+
+Resilience semantics (docs/resilience.md):
+
+- Failures are *typed*: 5xx / timeouts / connection errors raise
+  ``SandboxTransientError`` (retryable); 4xx raises ``SandboxFatalError``
+  (the sandbox answered — retrying cannot change the answer).
+- Every call accepts the request ``Deadline``; the per-call HTTP timeout is
+  the deadline's remaining budget, never an independent fixed number.
+- A backend may set ``self._http_breaker``; each call is then gated and its
+  outcome recorded, with fatal (4xx) responses counting as breaker successes.
 """
 
 from __future__ import annotations
 
+from contextlib import nullcontext
+
 import httpx
 
 from bee_code_interpreter_tpu.config import Config
+from bee_code_interpreter_tpu.resilience import (
+    CircuitBreaker,
+    Deadline,
+    SandboxTransientError,
+    classify_http_status,
+)
 from bee_code_interpreter_tpu.services.storage import Storage
 from bee_code_interpreter_tpu.utils.validation import Hash
 
@@ -24,28 +42,67 @@ class ExecutorHttpDriver:
     _http: httpx.AsyncClient
     _storage: Storage
     _config: Config
+    _http_breaker: CircuitBreaker | None = None  # backends may install one
 
-    async def _upload_file(self, addr: str, path: str, object_id: Hash) -> None:
+    def _data_plane_guard(self):
+        breaker = getattr(self, "_http_breaker", None)
+        return breaker.guard() if breaker is not None else nullcontext()
+
+    def _deadline_kwargs(self, deadline: Deadline | None, what: str) -> dict:
+        """Per-call httpx timeout: the CONFIGURED per-call bound, shrunk to
+        the remaining deadline budget — never replaced by it. A bare
+        ``remaining()`` would let one black-holed pod consume the whole
+        request deadline and starve the retry of its second attempt."""
+        if deadline is None:
+            return {}
+        deadline.check(what)
+        return {"timeout": deadline.clamp(self._config.executor_http_timeout_s)}
+
+    async def _upload_file(
+        self,
+        addr: str,
+        path: str,
+        object_id: Hash,
+        deadline: Deadline | None = None,
+    ) -> None:
         async def body():
             async with self._storage.reader(object_id) as reader:
                 async for chunk in reader:
                     yield chunk
 
-        response = await self._http.put(self._sandbox_url(addr, path), content=body())
-        if response.status_code >= 300:
-            raise RuntimeError(f"file upload to {addr} failed: {response.status_code}")
+        what = f"file upload to {addr}"
+        kwargs = self._deadline_kwargs(deadline, what)
+        async with self._data_plane_guard():
+            try:
+                response = await self._http.put(
+                    self._sandbox_url(addr, path), content=body(), **kwargs
+                )
+            except httpx.TimeoutException as e:
+                raise SandboxTransientError(f"{what} timed out: {e}") from e
+            except httpx.TransportError as e:
+                raise SandboxTransientError(f"{what} failed: {e}") from e
+            if response.status_code >= 300:
+                raise classify_http_status(response.status_code, what)
 
-    async def _download_file(self, addr: str, path: str) -> Hash:
-        async with self._storage.writer() as writer:
-            async with self._http.stream(
-                "GET", self._sandbox_url(addr, path)
-            ) as response:
-                if response.status_code >= 300:
-                    raise RuntimeError(
-                        f"file download from {addr} failed: {response.status_code}"
-                    )
-                async for chunk in response.aiter_bytes():
-                    await writer.write(chunk)
+    async def _download_file(
+        self, addr: str, path: str, deadline: Deadline | None = None
+    ) -> Hash:
+        what = f"file download from {addr}"
+        kwargs = self._deadline_kwargs(deadline, what)
+        async with self._data_plane_guard():
+            try:
+                async with self._storage.writer() as writer:
+                    async with self._http.stream(
+                        "GET", self._sandbox_url(addr, path), **kwargs
+                    ) as response:
+                        if response.status_code >= 300:
+                            raise classify_http_status(response.status_code, what)
+                        async for chunk in response.aiter_bytes():
+                            await writer.write(chunk)
+            except httpx.TimeoutException as e:
+                raise SandboxTransientError(f"{what} timed out: {e}") from e
+            except httpx.TransportError as e:
+                raise SandboxTransientError(f"{what} failed: {e}") from e
         return writer.hash
 
     def _effective_timeout(self, timeout_s: float | None) -> float:
@@ -63,23 +120,40 @@ class ExecutorHttpDriver:
         env: dict[str, str],
         timeout_s: float,
         client_timeout_s: float | None = None,
+        deadline: Deadline | None = None,
     ) -> dict:
         """``client_timeout_s`` overrides the shared client's read timeout
         for this one request — used when the sandbox was dispatched before
         its warm worker finished preloading, so the preload tail counts
         against the HTTP budget and needs headroom over ``timeout_s``."""
+        what = f"execute on {addr}"
         kwargs: dict = {}
         if client_timeout_s is not None:
             kwargs["timeout"] = client_timeout_s
-        response = await self._http.post(
-            f"http://{addr}/execute",
-            json={"source_code": source_code, "env": env, "timeout": timeout_s},
-            **kwargs,
-        )
-        if response.status_code != 200:
-            raise RuntimeError(
-                f"execute on {addr} failed: {response.status_code} {response.text}"
+        if deadline is not None:
+            deadline.check(what)
+            # The sandbox-side execution timeout and the HTTP read timeout
+            # both shrink to the remaining request budget (the read timeout
+            # keeps its configured per-call bound as the ceiling).
+            timeout_s = deadline.clamp(timeout_s)
+            kwargs["timeout"] = deadline.clamp(
+                kwargs.get("timeout", self._config.executor_http_timeout_s)
             )
+        async with self._data_plane_guard():
+            try:
+                response = await self._http.post(
+                    f"http://{addr}/execute",
+                    json={"source_code": source_code, "env": env, "timeout": timeout_s},
+                    **kwargs,
+                )
+            except httpx.TimeoutException as e:
+                raise SandboxTransientError(f"{what} timed out: {e}") from e
+            except httpx.TransportError as e:
+                raise SandboxTransientError(f"{what} failed: {e}") from e
+            if response.status_code != 200:
+                raise classify_http_status(
+                    response.status_code, f"{what} ({response.text[:200]})"
+                )
         return response.json()
 
     def _sandbox_url(self, addr: str, logical_path: str) -> str:

@@ -31,8 +31,12 @@ workers. Changed files are the **union across the gang** — per-host outputs
 path is downloaded from the first worker that reported it (worker 0 wins
 collisions on shared names) and streamed into content-addressed storage.
 
-Retries: 3 attempts with exponential backoff on both execute and spawn
-(tenacity; reference :75-79, :191-195).
+Resilience (docs/resilience.md): the request ``Deadline`` bounds every
+downstream call; transient data-plane failures retry under a config-driven
+``RetryPolicy`` (5xx/timeouts only — a 4xx is final); spawn and the HTTP data
+plane each sit behind a ``CircuitBreaker`` so a flapping apiserver or pod
+network fails fast (and can degrade to the local executor) instead of
+queueing unboundedly.
 """
 
 from __future__ import annotations
@@ -46,18 +50,23 @@ from contextlib import asynccontextmanager
 from dataclasses import dataclass
 
 import httpx
-from tenacity import (
-    retry,
-    retry_if_exception_type,
-    stop_after_attempt,
-    wait_exponential,
-)
 
 from bee_code_interpreter_tpu.config import Config
+from bee_code_interpreter_tpu.resilience import (
+    BreakerState,
+    CircuitBreaker,
+    Deadline,
+    DeadlineExceeded,
+    RetryPolicy,
+    SandboxFatalError,
+    SandboxTransientError,
+    retryable,
+)
 from bee_code_interpreter_tpu.services.code_executor import Result
 from bee_code_interpreter_tpu.services.executor_http_driver import ExecutorHttpDriver
 from bee_code_interpreter_tpu.services.kubectl import Kubectl
 from bee_code_interpreter_tpu.services.storage import Storage
+from bee_code_interpreter_tpu.utils.metrics import Registry
 from bee_code_interpreter_tpu.utils.validation import AbsolutePath, Hash
 
 logger = logging.getLogger(__name__)
@@ -88,6 +97,10 @@ class KubernetesCodeExecutor(ExecutorHttpDriver):
         storage: Storage,
         config: Config,
         http_client: httpx.AsyncClient | None = None,
+        metrics: Registry | None = None,
+        spawn_breaker: CircuitBreaker | None = None,
+        http_breaker: CircuitBreaker | None = None,
+        ip_poll_interval_s: float = 1.0,
     ) -> None:
         self._kubectl = kubectl
         self._storage = storage
@@ -99,9 +112,78 @@ class KubernetesCodeExecutor(ExecutorHttpDriver):
         self._spawning_count = 0
         self._fill_lock = asyncio.Lock()
         self._self_pod: dict | None = None
+        self._ip_poll_interval_s = ip_poll_interval_s
         # The event loop holds only weak refs to tasks; fire-and-forget refills
         # and deletions must be anchored here or GC can cancel them mid-flight.
         self._background_tasks: set[asyncio.Task] = set()
+
+        self._metrics = metrics
+        self._retry_counter = (
+            metrics.counter(
+                "bci_executor_retry_attempts_total",
+                "Retry attempts by operation",
+            )
+            if metrics is not None
+            else None
+        )
+        self._breaker_transitions = (
+            metrics.counter(
+                "bci_breaker_transitions_total",
+                "Circuit breaker state transitions",
+            )
+            if metrics is not None
+            else None
+        )
+        # Recent (op, sleep_s) backoffs, for tests/diagnostics of the schedule.
+        self.retry_backoffs: list[tuple[str, float]] = []
+
+        self._execute_retry = RetryPolicy(
+            attempts=config.executor_retry_attempts,
+            wait_min_s=config.executor_retry_wait_min_s,
+            wait_max_s=config.executor_retry_wait_max_s,
+            retry_on=(SandboxTransientError,),
+        )
+        self._spawn_retry = RetryPolicy(
+            attempts=config.executor_retry_attempts,
+            wait_min_s=config.executor_retry_wait_min_s,
+            wait_max_s=config.executor_retry_wait_max_s,
+            retry_on=(RuntimeError,),
+        )
+        self.spawn_breaker = spawn_breaker or self._make_breaker("k8s-spawn")
+        self.http_breaker = http_breaker or self._make_breaker(
+            "k8s-http",
+            # A 4xx means the sandbox answered — the data plane is healthy.
+            is_failure=lambda e: not isinstance(e, SandboxFatalError),
+        )
+        self._http_breaker = self.http_breaker  # ExecutorHttpDriver hook
+        for breaker in (self.spawn_breaker, self.http_breaker):
+            # Externally constructed breakers (tests inject a ManualClock)
+            # still get transitions recorded into this executor's metrics.
+            if breaker.on_transition is None:
+                breaker.on_transition = self._record_breaker_transition
+
+    def _make_breaker(self, name: str, **kwargs) -> CircuitBreaker:
+        cfg = self._config
+        return CircuitBreaker(
+            name,
+            window=cfg.breaker_window,
+            failure_rate_threshold=cfg.breaker_failure_rate_threshold,
+            min_calls=cfg.breaker_min_calls,
+            cooldown_s=cfg.breaker_cooldown_s,
+            half_open_max_calls=cfg.breaker_half_open_max_calls,
+            on_transition=self._record_breaker_transition,
+            **kwargs,
+        )
+
+    def _record_breaker_transition(self, name: str, state: BreakerState) -> None:
+        logger.warning("Circuit breaker %r -> %s", name, state.name)
+        if self._breaker_transitions is not None:
+            self._breaker_transitions.inc(breaker=name, to=state.name.lower())
+
+    def _on_retry_backoff(self, op, attempt, sleep_s, exc) -> None:
+        self.retry_backoffs.append((op, sleep_s))
+        if self._retry_counter is not None:
+            self._retry_counter.inc(op=op)
 
     @property
     def pool_ready_count(self) -> int:
@@ -115,29 +197,27 @@ class KubernetesCodeExecutor(ExecutorHttpDriver):
 
     # ------------------------------------------------------------- execution
 
-    @retry(
-        retry=retry_if_exception_type(RuntimeError),
-        stop=stop_after_attempt(3),
-        wait=wait_exponential(min=4, max=10),
-        reraise=True,
-    )
+    @retryable("_execute_retry", op="execute")
     async def execute(
         self,
         source_code: str,
         files: dict[AbsolutePath, Hash] | None = None,
         env: dict[str, str] | None = None,
         timeout_s: float | None = None,
+        deadline: Deadline | None = None,
     ) -> Result:
         files = files or {}
         env = env or {}
-        async with self.executor_pod_group() as group:
+        if deadline is not None:
+            deadline.check("execute")
+        async with self.executor_pod_group(deadline=deadline) as group:
             addrs = [
                 f"{ip}:{self._config.executor_port}" for ip in group.pod_ips
             ]
             # Restore the workspace snapshot on every worker (SPMD inputs).
             await asyncio.gather(
                 *(
-                    self._upload_file(addr, path, object_id)
+                    self._upload_file(addr, path, object_id, deadline=deadline)
                     for addr in addrs
                     for path, object_id in files.items()
                 )
@@ -147,7 +227,11 @@ class KubernetesCodeExecutor(ExecutorHttpDriver):
             responses = await asyncio.gather(
                 *(
                     self._post_execute(
-                        addr, source_code, env, self._effective_timeout(timeout_s)
+                        addr,
+                        source_code,
+                        env,
+                        self._effective_timeout(timeout_s),
+                        deadline=deadline,
                     )
                     for addr in addrs
                 )
@@ -169,7 +253,7 @@ class KubernetesCodeExecutor(ExecutorHttpDriver):
                     path_owner,
                     await asyncio.gather(
                         *(
-                            self._download_file(addr, path)
+                            self._download_file(addr, path, deadline=deadline)
                             for path, addr in path_owner.items()
                         )
                     ),
@@ -185,7 +269,7 @@ class KubernetesCodeExecutor(ExecutorHttpDriver):
     # ------------------------------------------------------------------ pool
 
     @asynccontextmanager
-    async def executor_pod_group(self):
+    async def executor_pod_group(self, deadline: Deadline | None = None):
         """Pop a warm group or spawn one; single-use teardown + async refill
         (reference executor_pod ctx-mgr :248-264).
 
@@ -193,11 +277,14 @@ class KubernetesCodeExecutor(ExecutorHttpDriver):
         group is health-probed before use — a group whose pod was preempted or
         OOM-killed while queued is torn down and skipped instead of burning a
         request attempt on it.
+
+        On-demand spawns (pool empty) go through the spawn circuit breaker
+        and are hard-bounded by the request deadline.
         """
         group = None
         while group is None:
             if not self._queue:
-                group = await self.spawn_pod_group()  # freshly Ready: trust it
+                group = await self._spawn_guarded(deadline)
                 break
             candidate = self._queue.popleft()
             if await self._group_healthy(candidate):
@@ -215,6 +302,18 @@ class KubernetesCodeExecutor(ExecutorHttpDriver):
         finally:
             for pod_name in group.pod_names:
                 self._spawn_background(self._delete_pod(pod_name))
+
+    async def _spawn_guarded(self, deadline: Deadline | None) -> PodGroup:
+        """Request-path spawn: breaker-gated and deadline-bounded. A hang or
+        failure anywhere in the spawn (create, IP wait, readiness) counts
+        against the breaker; while OPEN the caller gets BreakerOpenError
+        immediately, which the service layer can turn into local fallback."""
+        async with self.spawn_breaker.guard():
+            if deadline is None:
+                return await self.spawn_pod_group()
+            return await deadline.run(
+                self.spawn_pod_group(deadline=deadline), what="pod group spawn"
+            )
 
     async def _group_healthy(self, group: PodGroup) -> bool:
         """Every worker answers /healthz (sub-second; runs on the pod network)."""
@@ -264,22 +363,28 @@ class KubernetesCodeExecutor(ExecutorHttpDriver):
 
     async def _spawn_into_queue(self) -> bool:
         try:
+            if self.spawn_breaker.state is not BreakerState.CLOSED:
+                # Backend suspect (OPEN or probing HALF_OPEN): background
+                # refills pause entirely — no hammering a down apiserver, and
+                # recovery probing stays request-driven (a refill must neither
+                # consume the half-open probe slot nor mask the serving path).
+                return False
             group = await self.spawn_pod_group()
         except Exception:
             logger.exception("Pod group spawn failed")
+            # Refills feed the breaker only while it is CLOSED.
+            if self.spawn_breaker.state is BreakerState.CLOSED:
+                self.spawn_breaker.record_failure()
             return False
         finally:
             self._spawning_count -= 1
+        if self.spawn_breaker.state is BreakerState.CLOSED:
+            self.spawn_breaker.record_success()
         self._queue.append(group)
         return True
 
-    @retry(
-        retry=retry_if_exception_type(RuntimeError),
-        stop=stop_after_attempt(3),
-        wait=wait_exponential(min=4, max=10),
-        reraise=True,
-    )
-    async def spawn_pod_group(self) -> PodGroup:
+    @retryable("_spawn_retry", op="spawn")
+    async def spawn_pod_group(self, deadline: Deadline | None = None) -> PodGroup:
         """Create a gang of executor pods, all-or-nothing Ready
         (reference spawn_executor_pod :196-246, generalized)."""
         n = max(1, self._config.tpu_hosts_per_slice)
@@ -293,7 +398,7 @@ class KubernetesCodeExecutor(ExecutorHttpDriver):
             created.append(w0_name)
             coordinator_ip = None
             if n > 1:
-                coordinator_ip = await self._wait_pod_ip(w0_name)
+                coordinator_ip = await self._wait_pod_ip(w0_name, deadline=deadline)
                 await asyncio.gather(
                     *(
                         self._create_worker_pod(
@@ -308,13 +413,16 @@ class KubernetesCodeExecutor(ExecutorHttpDriver):
                 )
                 created.extend(f"{name}-w{i}" for i in range(1, n))
 
+            ready_timeout = self._config.pod_ready_timeout_s
+            if deadline is not None:
+                ready_timeout = deadline.clamp(ready_timeout)
             # Gang readiness: every member Ready or the whole group dies.
             await asyncio.gather(
                 *(
                     self._kubectl.wait(
                         f"pod/{pod_name}",
                         for_="condition=Ready",
-                        timeout=f"{int(self._config.pod_ready_timeout_s)}s",
+                        timeout=f"{int(ready_timeout)}s",
                     )
                     for pod_name in created
                 )
@@ -323,10 +431,17 @@ class KubernetesCodeExecutor(ExecutorHttpDriver):
                 *(self._kubectl.get("pod", pod_name) for pod_name in created)
             )
             return PodGroup(name=name, pods=list(pods))
-        except Exception as e:
-            # Delete-on-failure (reference :242-246), for every member.
+        except BaseException as e:
+            # Delete-on-failure (reference :242-246), for every member — also
+            # on cancellation (the deadline bound cancels a hung spawn).
             for pod_name in created:
                 asyncio.ensure_future(self._delete_pod(pod_name))
+            if isinstance(e, DeadlineExceeded) or not isinstance(e, Exception):
+                # DeadlineExceeded and bare BaseExceptions (CancelledError,
+                # KeyboardInterrupt, SystemExit) must keep their type: wrapping
+                # them in RuntimeError would make the spawn retry policy
+                # swallow a Ctrl-C and re-attempt with multi-second backoffs.
+                raise
             raise RuntimeError(f"spawning pod group {name} failed: {e}") from e
 
     async def _create_worker_pod(
@@ -412,13 +527,20 @@ class KubernetesCodeExecutor(ExecutorHttpDriver):
 
         await self._kubectl.create("-f", "-", _input=_json.dumps(manifest))
 
-    async def _wait_pod_ip(self, pod_name: str, attempts: int = 60) -> str:
+    async def _wait_pod_ip(
+        self,
+        pod_name: str,
+        attempts: int = 60,
+        deadline: Deadline | None = None,
+    ) -> str:
         for _ in range(attempts):
+            if deadline is not None:
+                deadline.check(f"waiting for pod {pod_name} IP")
             pod = await self._kubectl.get("pod", pod_name)
             ip = pod.get("status", {}).get("podIP")
             if ip:
                 return ip
-            await asyncio.sleep(1)
+            await asyncio.sleep(self._ip_poll_interval_s)
         raise RuntimeError(f"pod {pod_name} never got an IP")
 
     async def _owner_references(self) -> list[dict]:

@@ -17,6 +17,7 @@ import secrets
 import shutil
 from pathlib import Path
 
+from bee_code_interpreter_tpu.resilience import Deadline
 from bee_code_interpreter_tpu.runtime.executor_core import ExecutorCore
 from bee_code_interpreter_tpu.services.code_executor import Result
 from bee_code_interpreter_tpu.services.storage import Storage
@@ -71,8 +72,16 @@ class LocalCodeExecutor:
         files: dict[AbsolutePath, Hash] | None = None,
         env: dict[str, str] | None = None,
         timeout_s: float | None = None,
+        deadline: Deadline | None = None,
     ) -> Result:
         files = files or {}
+        if deadline is not None:
+            # The subprocess timeout shrinks to the remaining request budget,
+            # so a late-arriving execution can't run past the edge promise.
+            deadline.check("execute")
+            timeout_s = deadline.clamp(
+                self._clamp_timeout(timeout_s) or self._execution_timeout_s
+            )
         workspace = self._workspace_root / secrets.token_hex(8)
         core = ExecutorCore(
             workspace=workspace,

@@ -34,14 +34,14 @@ from dataclasses import dataclass
 from pathlib import Path
 
 import httpx
-from tenacity import (
-    retry,
-    retry_if_exception_type,
-    stop_after_attempt,
-    wait_exponential,
-)
 
 from bee_code_interpreter_tpu.config import Config
+from bee_code_interpreter_tpu.resilience import (
+    Deadline,
+    RetryPolicy,
+    SandboxTransientError,
+    retryable,
+)
 from bee_code_interpreter_tpu.services.code_executor import Result
 from bee_code_interpreter_tpu.services.executor_http_driver import ExecutorHttpDriver
 from bee_code_interpreter_tpu.services.storage import Storage
@@ -138,6 +138,20 @@ class NativeProcessCodeExecutor(ExecutorHttpDriver):
         )
         self._stdlib_file_path: str | None = None
         self._stdlib_lock = asyncio.Lock()
+        # Native sandboxes are local: startup/IPC failures settle fast, so the
+        # backoff floor is 20x tighter than the pod path's.
+        self._execute_retry = RetryPolicy(
+            attempts=config.executor_retry_attempts,
+            wait_min_s=0.2,
+            wait_max_s=2.0,
+            retry_on=(SandboxTransientError,),
+        )
+        self._spawn_retry = RetryPolicy(
+            attempts=config.executor_retry_attempts,
+            wait_min_s=0.2,
+            wait_max_s=2.0,
+            retry_on=(RuntimeError,),
+        )
         # Per-request phase breakdown of the most recent execute() (diagnostic
         # surface for bench.py / scripts/measure-latency.py: lets a latency
         # regression be attributed to acquire/upload/server/download/overhead
@@ -193,29 +207,27 @@ class NativeProcessCodeExecutor(ExecutorHttpDriver):
 
     # ------------------------------------------------------------- execution
 
-    @retry(
-        retry=retry_if_exception_type(RuntimeError),
-        stop=stop_after_attempt(3),
-        wait=wait_exponential(min=0.2, max=2),
-        reraise=True,
-    )
+    @retryable("_execute_retry", op="execute")
     async def execute(
         self,
         source_code: str,
         files: dict[AbsolutePath, Hash] | None = None,
         env: dict[str, str] | None = None,
         timeout_s: float | None = None,
+        deadline: Deadline | None = None,
     ) -> Result:
         files = files or {}
         env = env or {}
+        if deadline is not None:
+            deadline.check("execute")
         perf = asyncio.get_running_loop().time
         t_start = perf()
         was_warm = bool(self._queue)
-        async with self.sandbox() as box:
+        async with self.sandbox(deadline=deadline) as box:
             t_acquired = perf()
             await asyncio.gather(
                 *(
-                    self._upload_file(box.addr, path, object_id)
+                    self._upload_file(box.addr, path, object_id, deadline=deadline)
                     for path, object_id in files.items()
                 )
             )
@@ -234,13 +246,17 @@ class NativeProcessCodeExecutor(ExecutorHttpDriver):
                     if box.overlap_dispatch
                     else None
                 ),
+                deadline=deadline,
             )
             t_executed = perf()
             out_files: dict[str, str] = {}
             for path, object_id in zip(
                 response["files"],
                 await asyncio.gather(
-                    *(self._download_file(box.addr, p) for p in response["files"])
+                    *(
+                        self._download_file(box.addr, p, deadline=deadline)
+                        for p in response["files"]
+                    )
                 ),
             ):
                 out_files[path] = object_id
@@ -270,7 +286,7 @@ class NativeProcessCodeExecutor(ExecutorHttpDriver):
     # ------------------------------------------------------------------ pool
 
     @asynccontextmanager
-    async def sandbox(self):
+    async def sandbox(self, deadline: Deadline | None = None):
         """Pop a warm server or spawn one; single-use teardown + async refill.
         A sandbox whose process died while queued (OOM, crash) is discarded,
         not handed to a request."""
@@ -287,7 +303,10 @@ class NativeProcessCodeExecutor(ExecutorHttpDriver):
             # preload-done — the server queues the execute until its warm
             # worker is ready (or falls back cold), so the request overlaps
             # with the tail of the preload rather than waiting it out here.
-            box = await self.spawn_sandbox(wait_warm=False)
+            spawn = self.spawn_sandbox(wait_warm=False)
+            box = await (
+                deadline.run(spawn, what="sandbox spawn") if deadline else spawn
+            )
         self._spawn_background(self.fill_sandbox_queue())
         try:
             yield box
@@ -340,12 +359,7 @@ class NativeProcessCodeExecutor(ExecutorHttpDriver):
         self._queue.append(box)
         return True
 
-    @retry(
-        retry=retry_if_exception_type(RuntimeError),
-        stop=stop_after_attempt(3),
-        wait=wait_exponential(min=0.2, max=2),
-        reraise=True,
-    )
+    @retryable("_spawn_retry", op="spawn")
     async def spawn_sandbox(self, wait_warm: bool = True) -> NativeSandbox:
         cfg = self._config
         port = _free_port()
@@ -471,7 +485,9 @@ class NativeProcessCodeExecutor(ExecutorHttpDriver):
                         f"native executor on {addr} never became ready"
                     )
                 await asyncio.sleep(0.05)
-        except Exception:
+        except BaseException:
+            # BaseException: a deadline-driven cancel must also reap the
+            # half-started sandbox process, not leak it.
             box.destroy()
             raise
 

@@ -53,18 +53,30 @@ class Counter:
 
 
 class Gauge:
-    """A gauge read from a callback at scrape time (pool sizes, queue depths)."""
+    """Gauges read from callbacks at scrape time (pool sizes, queue depths,
+    breaker states). One ``Gauge`` object per metric name; each label set
+    maps to its own callback (e.g. ``bci_breaker_state{breaker="k8s-spawn"}``).
 
-    def __init__(self, name: str, help_text: str, fn: Callable[[], float]) -> None:
-        self.name, self.help, self._fn = name, help_text, fn
+    A raising callback — a pool property read during executor teardown, say —
+    must never abort the whole ``/metrics`` exposition: the failure is
+    contained to that one sample, emitted as ``NaN``."""
+
+    def __init__(self, name: str, help_text: str) -> None:
+        self.name, self.help = name, help_text
+        self._fns: dict[tuple, Callable[[], float]] = {}
+
+    def set_fn(self, fn: Callable[[], float], **labels: str) -> None:
+        self._fns[tuple(sorted(labels.items()))] = fn
 
     def collect(self) -> Iterable[str]:
         yield f"# HELP {self.name} {self.help}"
         yield f"# TYPE {self.name} gauge"
-        try:
-            yield f"{self.name} {_fmt_num(self._fn())}"
-        except Exception:
-            yield f"{self.name} NaN"
+        for key, fn in sorted(self._fns.items()):
+            try:
+                value = _fmt_num(fn())
+            except Exception:
+                value = "NaN"
+            yield f"{self.name}{_fmt_labels(dict(key))} {value}"
 
 
 class Histogram:
@@ -118,28 +130,42 @@ class _Timer:
 
 
 class Registry:
-    def __init__(self) -> None:
-        self._metrics: list[Counter | Gauge | Histogram] = []
+    """Metrics are deduplicated by name: asking twice for the same counter
+    (e.g. two components sharing ``bci_breaker_transitions_total``) returns
+    the same object, so the exposition never emits duplicate metric blocks."""
 
-    def counter(self, name: str, help_text: str) -> Counter:
-        m = Counter(name, help_text)
-        self._metrics.append(m)
+    def __init__(self) -> None:
+        self._metrics: dict[str, Counter | Gauge | Histogram] = {}
+
+    def _get_or_create(self, name: str, factory):
+        existing = self._metrics.get(name)
+        if existing is not None:
+            return existing
+        m = factory()
+        self._metrics[name] = m
         return m
 
-    def gauge(self, name: str, help_text: str, fn: Callable[[], float]) -> Gauge:
-        m = Gauge(name, help_text, fn)
-        self._metrics.append(m)
+    def counter(self, name: str, help_text: str) -> Counter:
+        return self._get_or_create(name, lambda: Counter(name, help_text))
+
+    def gauge(
+        self, name: str, help_text: str, fn: Callable[[], float], **labels: str
+    ) -> Gauge:
+        m = self._get_or_create(name, lambda: Gauge(name, help_text))
+        m.set_fn(fn, **labels)
         return m
 
     def histogram(
         self, name: str, help_text: str, buckets: tuple[float, ...] = DEFAULT_BUCKETS
     ) -> Histogram:
-        m = Histogram(name, help_text, buckets)
-        self._metrics.append(m)
-        return m
+        return self._get_or_create(name, lambda: Histogram(name, help_text, buckets))
 
     def expose(self) -> str:
         lines: list[str] = []
-        for m in self._metrics:
-            lines.extend(m.collect())
+        for m in self._metrics.values():
+            try:
+                lines.extend(m.collect())
+            except Exception:
+                # One misbehaving metric must not take down the whole scrape.
+                lines.append(f"# {m.name} failed to collect")
         return "\n".join(lines) + "\n"

@@ -1,0 +1,196 @@
+#!/usr/bin/env python3
+"""End-to-end chaos smoke: the resilience stack under injected faults, no
+cluster required (docs/resilience.md).
+
+Drives the REAL KubernetesCodeExecutor against the in-repo fake cluster
+(tests/fakes.py) with scripted faults (tests/chaos.py), wrapped by the real
+ResilientCodeExecutor / AdmissionController — i.e. the exact production
+wiring minus kubectl. Scenarios:
+
+  1. healthy path         — execute through a (fake) pod, stdout round-trip
+  2. deadline bound       — 10 s spawn hang vs a 0.5 s edge deadline
+  3. breaker + fallback   — spawn failures trip the breaker; requests degrade
+                            to the local executor; cooldown half-opens and
+                            the breaker closes on a healthy probe
+  4. admission shedding   — in-flight + queue full -> immediate shed
+
+Exits nonzero if any scenario misbehaves. Usage:
+
+    python scripts/chaos_smoke.py
+"""
+
+from __future__ import annotations
+
+import asyncio
+import sys
+import tempfile
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+from bee_code_interpreter_tpu.config import Config  # noqa: E402
+from bee_code_interpreter_tpu.resilience import (  # noqa: E402
+    AdmissionController,
+    AdmissionRejected,
+    BreakerState,
+    CircuitBreaker,
+    Deadline,
+    DeadlineExceeded,
+    ResilientCodeExecutor,
+)
+from bee_code_interpreter_tpu.services.kubernetes_code_executor import (  # noqa: E402
+    KubernetesCodeExecutor,
+)
+from bee_code_interpreter_tpu.services.local_code_executor import (  # noqa: E402
+    LocalCodeExecutor,
+)
+from bee_code_interpreter_tpu.services.storage import Storage  # noqa: E402
+from bee_code_interpreter_tpu.utils.metrics import Registry  # noqa: E402
+from tests.chaos import ChaosKubectl, Fail, FaultPlan, Hang, ManualClock  # noqa: E402
+from tests.fakes import FakeExecutorPods  # noqa: E402
+
+PASS, FAIL = "PASS", "FAIL"
+failures: list[str] = []
+
+
+def report(name: str, ok: bool, detail: str = "") -> None:
+    print(f"[{PASS if ok else FAIL}] {name}" + (f" — {detail}" if detail else ""))
+    if not ok:
+        failures.append(name)
+
+
+def make_stack(tmp: Path, storage, metrics: Registry, clock: ManualClock):
+    """One production-shaped stack (fake cluster + real resilience wiring).
+    Each scenario gets a fresh one so breaker windows don't bleed across."""
+    faults = FaultPlan()
+    pods = FakeExecutorPods(tmp / f"pods-{id(faults):x}", faults=faults)
+    config = Config(
+        executor_backend="kubernetes",
+        executor_port=pods.port,
+        executor_pod_queue_target_length=0,
+        pod_ready_timeout_s=5,
+        executor_retry_attempts=1,
+    )
+    spawn_breaker = CircuitBreaker(
+        "k8s-spawn", window=4, failure_rate_threshold=0.5, min_calls=2,
+        cooldown_s=30.0, clock=clock,
+    )
+    k8s = KubernetesCodeExecutor(
+        kubectl=ChaosKubectl(pods, faults),
+        storage=storage,
+        config=config,
+        metrics=metrics,
+        spawn_breaker=spawn_breaker,
+        ip_poll_interval_s=0.02,
+    )
+    fallback = LocalCodeExecutor(
+        storage=storage, workspace_root=tmp / "fallback-ws", disable_dep_install=True
+    )
+    executor = ResilientCodeExecutor(k8s, fallback=fallback, metrics=metrics)
+    return executor, spawn_breaker, faults, pods
+
+
+async def main() -> int:
+    tmp = Path(tempfile.mkdtemp(prefix="chaos-smoke-"))
+    storage = Storage(tmp / "objects")
+    clock = ManualClock()
+    metrics = Registry()
+    executor, spawn_breaker, faults, pods = make_stack(tmp, storage, metrics, clock)
+    executor2, breaker2, faults2, pods2 = make_stack(tmp, storage, metrics, clock)
+
+    try:
+        # 1. healthy path
+        result = await executor.execute("print(21 * 2)")
+        report("healthy execute via fake pod", result.stdout == "42\n")
+
+        # 2. deadline bounds a hung spawn
+        faults.script("pod_wait", Hang(10.0))
+        t0 = time.monotonic()
+        try:
+            await executor.execute("print(1)", deadline=Deadline.after(0.5))
+            report("deadline bound over hung spawn", False, "no DeadlineExceeded")
+        except DeadlineExceeded:
+            elapsed = time.monotonic() - t0
+            report(
+                "deadline bound over hung spawn",
+                elapsed < 0.55,
+                f"elapsed {elapsed * 1000:.0f}ms for a 500ms deadline",
+            )
+
+        # 3. breaker trips -> fallback serves -> half-open -> closed
+        #    (fresh stack: its breaker window starts clean)
+        faults2.script("pod_create", Fail("apiserver down"), Fail("apiserver down"))
+        for _ in range(2):
+            try:
+                await executor2.execute("print('down')")
+            except RuntimeError:
+                pass
+        report(
+            "breaker opens at failure rate",
+            breaker2.state is BreakerState.OPEN,
+            f"state={breaker2.state.name}",
+        )
+        result = await executor2.execute("print('degraded but alive')")
+        report(
+            "open breaker degrades to local fallback",
+            result.stdout == "degraded but alive\n",
+        )
+        clock.advance(31.0)
+        result = await executor2.execute("print('recovered')")
+        report(
+            "half-open probe recovers to pods",
+            result.stdout == "recovered\n"
+            and breaker2.state is BreakerState.CLOSED,
+            f"state={breaker2.state.name}",
+        )
+
+        # 4. admission shedding never hangs
+        admission = AdmissionController(
+            max_in_flight=1, max_queue=0, retry_after_s=2.0, metrics=metrics
+        )
+        release = asyncio.Event()
+
+        async def hold():
+            async with admission.admit():
+                await release.wait()
+
+        holder = asyncio.create_task(hold())
+        await asyncio.sleep(0.01)
+        t0 = time.monotonic()
+        try:
+            async with admission.admit():
+                pass
+            report("admission sheds when full", False, "not shed")
+        except AdmissionRejected as e:
+            report(
+                "admission sheds when full",
+                time.monotonic() - t0 < 0.1,
+                f"reason={e.reason} retry_after={e.retry_after_s:g}s",
+            )
+        release.set()
+        await holder
+
+        text = metrics.expose()
+        wanted = [
+            "bci_executor_fallback_total 1",
+            'bci_breaker_transitions_total{breaker="k8s-spawn",to="open"}',
+            'bci_admission_shed_total{reason="queue_full"} 1',
+        ]
+        missing = [w for w in wanted if w not in text]
+        report("resilience counters in /metrics", not missing, str(missing or "all present"))
+    finally:
+        await pods.close()
+        await pods2.close()
+
+    print()
+    if failures:
+        print(f"chaos smoke FAILED: {len(failures)} scenario(s): {failures}")
+        return 1
+    print("chaos smoke passed: deadline, breaker, fallback, admission all behaved")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(asyncio.run(main()))

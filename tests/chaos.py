@@ -1,0 +1,156 @@
+"""Deterministic fault-injection harness (not a test module).
+
+Scripts failures into the cluster seam the fakes already provide, so every
+retry, breaker transition, fallback route, and shed path is exercised by
+fast tier-1 tests — no real cluster, no randomness, no sleeps longer than
+the deadline under test:
+
+- ``FaultPlan`` holds per-operation FIFO scripts of behaviors. Operations:
+  ``pod_create`` / ``pod_wait`` / ``pod_ip`` (control plane, consumed by
+  ``ChaosKubectl``) and ``upload`` / ``execute`` / ``download`` (data plane,
+  consumed by the ``FakeExecutorPods`` fault middleware). Each incoming call
+  pops exactly one behavior — or ``None`` (healthy) when the script is empty
+  — so a test's timeline is fully determined by what it scripted.
+- Behaviors: ``Ok`` (explicit no-op placeholder, e.g. "worker 0 fine, worker
+  1 fails"), ``Fail`` (control-plane error), ``Hang(seconds)`` (slow
+  apiserver / slow sandbox), ``HttpStatus(status)`` (5xx/4xx data-plane
+  answer), ``Reset`` (TCP connection torn down mid-request), ``NoIP``
+  (pod-IP flap: the pod exists but status.podIP is empty for one poll).
+- ``ManualClock`` drives ``Deadline`` and ``CircuitBreaker`` time
+  deterministically (cooldowns advance by assignment, not sleeping).
+
+Used by tests/test_chaos_kubernetes.py, tests/test_kubernetes_code_executor.py
+and scripts/chaos_smoke.py (see docs/resilience.md).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from collections import defaultdict, deque
+from dataclasses import dataclass
+
+from aiohttp import web
+
+from tests.fakes import FakeKubectl
+
+
+# ------------------------------------------------------------------ behaviors
+
+
+@dataclass
+class Ok:
+    """Explicit healthy placeholder (consumes one script slot)."""
+
+
+@dataclass
+class Fail:
+    message: str = "injected failure"
+
+
+@dataclass
+class Hang:
+    seconds: float = 10.0
+
+
+@dataclass
+class HttpStatus:
+    status: int = 503
+
+
+@dataclass
+class Reset:
+    """Close the TCP connection without sending a response."""
+
+
+@dataclass
+class NoIP:
+    """Pod-IP flap: one ``kubectl get`` sees the pod without status.podIP."""
+
+
+class ManualClock:
+    """Deterministic monotonic clock for Deadline/CircuitBreaker tests."""
+
+    def __init__(self, start: float = 0.0) -> None:
+        self.now = start
+
+    def __call__(self) -> float:
+        return self.now
+
+    def advance(self, dt: float) -> None:
+        self.now += dt
+
+
+class FaultPlan:
+    """Per-operation FIFO scripts of behaviors; ``log`` records consumption."""
+
+    def __init__(self) -> None:
+        self._scripts: dict[str, deque] = defaultdict(deque)
+        self.log: list[tuple[str, object]] = []
+
+    def script(self, op: str, *behaviors) -> "FaultPlan":
+        self._scripts[op].extend(behaviors)
+        return self
+
+    def take(self, op: str):
+        queue = self._scripts[op]
+        behavior = queue.popleft() if queue else None
+        if behavior is not None:
+            self.log.append((op, behavior))
+        return behavior
+
+    def pending(self, op: str) -> int:
+        return len(self._scripts[op])
+
+    async def apply_http(self, op: str, request) -> web.Response | None:
+        """Data-plane injection hook (FakeExecutorPods middleware). Returns a
+        response to short-circuit with, or None to proceed to the handler."""
+        behavior = self.take(op)
+        if behavior is None or isinstance(behavior, Ok):
+            return None
+        if isinstance(behavior, Hang):
+            await asyncio.sleep(behavior.seconds)
+            return None
+        if isinstance(behavior, HttpStatus):
+            return web.Response(status=behavior.status, text="chaos: injected status")
+        if isinstance(behavior, Reset):
+            if request.transport is not None:
+                request.transport.close()
+            # The transport is gone; aiohttp drops the connection and the
+            # client observes a reset rather than this response.
+            return web.Response(status=500, text="chaos: reset")
+        raise AssertionError(f"behavior {behavior!r} not valid for op {op!r}")
+
+
+class ChaosKubectl(FakeKubectl):
+    """FakeKubectl with scripted control-plane faults: create errors, spawn
+    hangs (slow readiness), and pod-IP flaps."""
+
+    def __init__(self, pods, faults: FaultPlan) -> None:
+        super().__init__(pods)
+        self.faults = faults
+
+    async def _control_plane(self, op: str) -> None:
+        behavior = self.faults.take(op)
+        if behavior is None or isinstance(behavior, Ok):
+            return
+        if isinstance(behavior, Hang):
+            await asyncio.sleep(behavior.seconds)
+            return
+        if isinstance(behavior, Fail):
+            raise RuntimeError(f"chaos {op}: {behavior.message}")
+        raise AssertionError(f"behavior {behavior!r} not valid for op {op!r}")
+
+    async def create(self, *args, _input=None, **kwargs):
+        await self._control_plane("pod_create")
+        return await super().create(*args, _input=_input, **kwargs)
+
+    async def wait(self, target, **kwargs):
+        await self._control_plane("pod_wait")
+        return await super().wait(target, **kwargs)
+
+    async def get(self, kind, name, **kwargs):
+        pod = await super().get(kind, name, **kwargs)
+        behavior = self.faults.take("pod_ip")
+        if isinstance(behavior, NoIP):
+            return {**pod, "status": {**pod["status"], "podIP": None}}
+        return pod

@@ -23,11 +23,19 @@ def free_port() -> int:
 class FakeExecutorPods:
     """Real executor HTTP servers, one per simulated pod, each on its own
     loopback IP (127.1.0.x) sharing a single port — so the executor driver can
-    address them exactly like pods on a pod network."""
+    address them exactly like pods on a pod network.
 
-    def __init__(self, workspace_root: Path, port: int | None = None) -> None:
+    Set ``self.faults`` to a ``tests.chaos.FaultPlan`` to inject scripted
+    data-plane failures (5xx, hangs, connection resets) on the upload /
+    execute / download routes — the deterministic chaos seam the resilience
+    tests drive (tests/chaos.py)."""
+
+    def __init__(
+        self, workspace_root: Path, port: int | None = None, faults=None
+    ) -> None:
         self.workspace_root = workspace_root
         self.port = port or free_port()
+        self.faults = faults
         self._runners: dict[str, web.AppRunner] = {}
         self.cores: dict[str, ExecutorCore] = {}
         self.execute_counts: dict[str, int] = {}
@@ -48,7 +56,22 @@ class FakeExecutorPods:
                 self.execute_counts[ip] = self.execute_counts.get(ip, 0) + 1
             return await handler(request)
 
+        @web.middleware
+        async def inject_faults(request, handler):
+            if self.faults is not None:
+                op = None
+                if request.path == "/execute":
+                    op = "execute"
+                elif request.path.startswith("/workspace"):
+                    op = "upload" if request.method == "PUT" else "download"
+                if op is not None:
+                    response = await self.faults.apply_http(op, request)
+                    if response is not None:
+                        return response
+            return await handler(request)
+
         app.middlewares.append(count_executes)
+        app.middlewares.append(inject_faults)
         runner = web.AppRunner(app)
         await runner.setup()
         site = web.TCPSite(runner, ip, self.port)

@@ -1,0 +1,267 @@
+"""Admission control at the API edge (ISSUE 1 acceptance (c)): once the
+in-flight bound plus queue is full, requests shed as HTTP 429 with
+``Retry-After`` / gRPC RESOURCE_EXHAUSTED — never hang — and the shed/queue
+counters are visible in /metrics. Deadline-exceeded maps to 504 /
+DEADLINE_EXCEEDED."""
+
+import asyncio
+
+import grpc.aio
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from bee_code_interpreter_tpu.api.grpc_server import GrpcServer, service_stubs
+from bee_code_interpreter_tpu.api.http_server import create_http_server
+from bee_code_interpreter_tpu.proto import code_interpreter_pb2 as pb
+from bee_code_interpreter_tpu.resilience import (
+    AdmissionController,
+    Deadline,
+    DeadlineExceeded,
+)
+from bee_code_interpreter_tpu.services.code_executor import Result
+from bee_code_interpreter_tpu.services.custom_tool_executor import CustomToolExecutor
+from bee_code_interpreter_tpu.utils.metrics import Registry
+
+pytestmark = pytest.mark.chaos
+
+
+class GatedExecutor:
+    """Executor whose executions block until released — lets a test hold the
+    in-flight slots at a precise point."""
+
+    def __init__(self):
+        self.release = asyncio.Event()
+        self.started = 0
+
+    async def execute(self, source_code, files=None, env=None, timeout_s=None,
+                      deadline=None):
+        self.started += 1
+        await self.release.wait()
+        return Result(stdout="done\n", stderr="", exit_code=0, files={})
+
+
+def make_app(executor, admission, metrics, request_deadline_s=30.0):
+    return create_http_server(
+        code_executor=executor,
+        custom_tool_executor=CustomToolExecutor(code_executor=executor),
+        metrics=metrics,
+        admission=admission,
+        request_deadline_s=request_deadline_s,
+    )
+
+
+async def test_http_sheds_429_with_retry_after_once_full():
+    metrics = Registry()
+    gated = GatedExecutor()
+    admission = AdmissionController(
+        max_in_flight=1, max_queue=1, retry_after_s=7.0, metrics=metrics
+    )
+    client = TestClient(TestServer(make_app(gated, admission, metrics)))
+    await client.start_server()
+    try:
+        body = {"source_code": "print(1)"}
+        t1 = asyncio.create_task(client.post("/v1/execute", json=body))
+        while gated.started < 1:
+            await asyncio.sleep(0.01)  # t1 holds the in-flight slot
+        t2 = asyncio.create_task(client.post("/v1/execute", json=body))
+        while admission.queue_depth < 1:
+            await asyncio.sleep(0.01)  # t2 is queued
+
+        # Third request: in-flight + queue full -> shed immediately.
+        resp = await client.post("/v1/execute", json=body)
+        assert resp.status == 429
+        assert resp.headers["Retry-After"] == "7"
+        assert "overloaded" in (await resp.json())["detail"]
+
+        # Counters visible on /metrics while the congestion is live.
+        text = await (await client.get("/metrics")).text()
+        assert 'bci_admission_shed_total{reason="queue_full"} 1' in text
+        assert "bci_admission_in_flight 1" in text
+        assert "bci_admission_queue_depth 1" in text
+
+        # The held and queued requests complete normally once released:
+        # shedding shed *only* the overflow.
+        gated.release.set()
+        r1, r2 = await asyncio.gather(t1, t2)
+        assert r1.status == 200 and r2.status == 200
+        assert (await r1.json())["stdout"] == "done\n"
+    finally:
+        await client.close()
+
+
+async def test_http_queued_request_sheds_at_deadline_never_hangs():
+    metrics = Registry()
+    gated = GatedExecutor()  # never released while we measure
+    admission = AdmissionController(
+        max_in_flight=1, max_queue=8, retry_after_s=2.0, metrics=metrics
+    )
+    client = TestClient(
+        TestServer(make_app(gated, admission, metrics, request_deadline_s=0.2))
+    )
+    await client.start_server()
+    try:
+        body = {"source_code": "print(1)"}
+        t1 = asyncio.create_task(client.post("/v1/execute", json=body))
+        while gated.started < 1:
+            await asyncio.sleep(0.01)
+        # Queued behind a stuck request: must come back 429 at its deadline,
+        # not hang for as long as the stuck request does.
+        resp = await asyncio.wait_for(
+            client.post("/v1/execute", json=body), timeout=2.0
+        )
+        assert resp.status == 429
+        assert 'bci_admission_shed_total{reason="queue_timeout"} 1' in metrics.expose()
+        gated.release.set()
+        assert (await t1).status == 200
+    finally:
+        await client.close()
+
+
+async def test_http_deadline_exceeded_maps_to_504():
+    class Exceeding:
+        async def execute(self, source_code, files=None, env=None,
+                          timeout_s=None, deadline=None):
+            raise DeadlineExceeded("execute")
+
+    metrics = Registry()
+    app = make_app(Exceeding(), admission=None, metrics=metrics)
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    try:
+        resp = await client.post("/v1/execute", json={"source_code": "print(1)"})
+        assert resp.status == 504
+        assert (await resp.json())["detail"] == "Deadline exceeded"
+        assert (
+            'bci_deadline_exceeded_total{transport="http"} 1' in metrics.expose()
+        )
+    finally:
+        await client.close()
+
+
+async def test_http_open_breaker_maps_to_503_with_retry_after():
+    from bee_code_interpreter_tpu.resilience import BreakerOpenError
+
+    class Open:
+        async def execute(self, source_code, files=None, env=None,
+                          timeout_s=None, deadline=None):
+            raise BreakerOpenError("k8s-spawn", 12.0)
+
+    client = TestClient(TestServer(make_app(Open(), admission=None, metrics=Registry())))
+    await client.start_server()
+    try:
+        resp = await client.post("/v1/execute", json={"source_code": "print(1)"})
+        assert resp.status == 503
+        assert resp.headers["Retry-After"] == "12"
+        assert "unavailable" in (await resp.json())["detail"]
+    finally:
+        await client.close()
+
+
+async def test_grpc_open_breaker_maps_to_unavailable():
+    from bee_code_interpreter_tpu.resilience import BreakerOpenError
+
+    class Open:
+        async def execute(self, source_code, files=None, env=None,
+                          timeout_s=None, deadline=None):
+            raise BreakerOpenError("k8s-spawn", 12.0)
+
+    server = GrpcServer(
+        code_executor=Open(),
+        custom_tool_executor=CustomToolExecutor(code_executor=Open()),
+        request_deadline_s=30.0,
+    )
+    port = await server.start("127.0.0.1:0")
+    try:
+        async with grpc.aio.insecure_channel(f"127.0.0.1:{port}") as channel:
+            stubs = service_stubs(channel)
+            with pytest.raises(grpc.aio.AioRpcError) as exc:
+                await stubs["Execute"](pb.ExecuteRequest(source_code="print(1)"))
+            assert exc.value.code() == grpc.StatusCode.UNAVAILABLE
+            assert "retry" in exc.value.details()
+    finally:
+        await server.stop(None)
+
+
+async def test_grpc_sheds_resource_exhausted_once_full():
+    gated = GatedExecutor()
+    admission = AdmissionController(max_in_flight=1, max_queue=0, retry_after_s=3.0)
+    server = GrpcServer(
+        code_executor=gated,
+        custom_tool_executor=CustomToolExecutor(code_executor=gated),
+        admission=admission,
+        request_deadline_s=30.0,
+    )
+    port = await server.start("127.0.0.1:0")
+    try:
+        async with grpc.aio.insecure_channel(f"127.0.0.1:{port}") as channel:
+            stubs = service_stubs(channel)
+            req = pb.ExecuteRequest(source_code="print(1)")
+
+            async def first_call():
+                return await stubs["Execute"](req)
+
+            t1 = asyncio.create_task(first_call())
+            while gated.started < 1:
+                await asyncio.sleep(0.01)
+            with pytest.raises(grpc.aio.AioRpcError) as exc:
+                await stubs["Execute"](req)
+            assert exc.value.code() == grpc.StatusCode.RESOURCE_EXHAUSTED
+            assert "retry" in exc.value.details()
+            gated.release.set()
+            resp = await t1
+            assert resp.stdout == "done\n"
+    finally:
+        await server.stop(None)
+
+
+async def test_grpc_deadline_exceeded_status():
+    class Exceeding:
+        async def execute(self, source_code, files=None, env=None,
+                          timeout_s=None, deadline=None):
+            raise DeadlineExceeded("execute")
+
+    server = GrpcServer(
+        code_executor=Exceeding(),
+        custom_tool_executor=CustomToolExecutor(code_executor=Exceeding()),
+        request_deadline_s=30.0,
+    )
+    port = await server.start("127.0.0.1:0")
+    try:
+        async with grpc.aio.insecure_channel(f"127.0.0.1:{port}") as channel:
+            stubs = service_stubs(channel)
+            with pytest.raises(grpc.aio.AioRpcError) as exc:
+                await stubs["Execute"](pb.ExecuteRequest(source_code="print(1)"))
+            assert exc.value.code() == grpc.StatusCode.DEADLINE_EXCEEDED
+    finally:
+        await server.stop(None)
+
+
+async def test_grpc_client_deadline_caps_the_edge_deadline():
+    captured = {}
+
+    class Capturing:
+        async def execute(self, source_code, files=None, env=None,
+                          timeout_s=None, deadline=None):
+            captured["deadline"] = deadline
+            return Result(stdout="", stderr="", exit_code=0, files={})
+
+    server = GrpcServer(
+        code_executor=Capturing(),
+        custom_tool_executor=CustomToolExecutor(code_executor=Capturing()),
+        request_deadline_s=300.0,
+    )
+    port = await server.start("127.0.0.1:0")
+    try:
+        async with grpc.aio.insecure_channel(f"127.0.0.1:{port}") as channel:
+            stubs = service_stubs(channel)
+            await stubs["Execute"](
+                pb.ExecuteRequest(source_code="print(1)"), timeout=5.0
+            )
+        deadline: Deadline = captured["deadline"]
+        assert deadline is not None
+        # budget = min(service 300s, client 5s) -> the client's 5s wins
+        # (small tolerance: time_remaining() is measured wall-clock and can
+        # read a few ms over the client's requested timeout)
+        assert deadline.budget_s < 6.0
+    finally:
+        await server.stop(None)

@@ -1,0 +1,249 @@
+"""Chaos suite: the resilience acceptance criteria under injected faults
+(ISSUE 1): (a) an edge deadline bounds total wall time across
+spawn+upload+execute+download; (b) the spawn breaker opens at the configured
+failure rate, routes to the local fallback while open, and half-opens after
+cooldown — with matching counters in the /metrics exposition. Faults are
+scripted through tests/chaos.py; nothing here talks to a real cluster."""
+
+import asyncio
+import time
+
+import pytest
+
+from bee_code_interpreter_tpu.config import Config
+from bee_code_interpreter_tpu.resilience import (
+    BreakerOpenError,
+    BreakerState,
+    CircuitBreaker,
+    Deadline,
+    DeadlineExceeded,
+    ResilientCodeExecutor,
+    SandboxTransientError,
+)
+from bee_code_interpreter_tpu.services.kubernetes_code_executor import (
+    KubernetesCodeExecutor,
+)
+from bee_code_interpreter_tpu.services.local_code_executor import LocalCodeExecutor
+from bee_code_interpreter_tpu.utils.metrics import Registry
+from tests.chaos import ChaosKubectl, Fail, FaultPlan, Hang, HttpStatus, ManualClock
+from tests.fakes import FakeExecutorPods
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture
+def faults():
+    return FaultPlan()
+
+
+@pytest.fixture
+def pods(tmp_path, faults):
+    return FakeExecutorPods(tmp_path / "pods", faults=faults)
+
+
+def make_executor(pods, storage, faults, *, metrics=None, spawn_breaker=None,
+                  **config_overrides):
+    overrides = dict(
+        executor_backend="kubernetes",
+        executor_port=pods.port,
+        # No warm pool: every execute goes through the faultable spawn path,
+        # so the scripted timeline is exactly the request timeline.
+        executor_pod_queue_target_length=0,
+        pod_ready_timeout_s=5,
+        executor_retry_wait_min_s=0.01,
+        executor_retry_wait_max_s=0.05,
+    )
+    overrides.update(config_overrides)
+    config = Config(**overrides)
+    return KubernetesCodeExecutor(
+        kubectl=ChaosKubectl(pods, faults),
+        storage=storage,
+        config=config,
+        metrics=metrics,
+        spawn_breaker=spawn_breaker,
+        ip_poll_interval_s=0.02,
+    )
+
+
+# --------------------------------------------------- (a) deadline bounding
+
+
+async def test_deadline_bounds_wall_time_over_hung_spawn(pods, storage, faults):
+    # Pod spawn hangs 10s (slow apiserver); the 0.5s edge deadline must bound
+    # the request within 10%, not wait out the hang.
+    faults.script("pod_wait", Hang(10.0))
+    executor = ResilientCodeExecutor(make_executor(pods, storage, faults))
+    try:
+        t0 = time.monotonic()
+        with pytest.raises(DeadlineExceeded):
+            await executor.execute("print(1)", deadline=Deadline.after(0.5))
+        elapsed = time.monotonic() - t0
+        assert elapsed < 0.55, f"deadline 0.5s not honored: took {elapsed:.3f}s"
+    finally:
+        await pods.close()
+
+
+async def test_deadline_bounds_wall_time_over_hung_execute(pods, storage, faults):
+    # Healthy spawn, then the sandbox hangs mid-/execute: the deadline spans
+    # the whole spawn+upload+execute pipeline, not per-call budgets.
+    faults.script("execute", Hang(10.0))
+    executor = ResilientCodeExecutor(make_executor(pods, storage, faults))
+    try:
+        t0 = time.monotonic()
+        with pytest.raises((DeadlineExceeded, SandboxTransientError)):
+            await executor.execute("print(1)", deadline=Deadline.after(1.0))
+        elapsed = time.monotonic() - t0
+        assert elapsed < 1.1, f"deadline 1.0s not honored: took {elapsed:.3f}s"
+    finally:
+        await pods.close()
+
+
+async def test_deadline_leaves_no_leaked_pods(pods, storage, faults):
+    # The pods created before the deadline fired must still be torn down
+    # (cancellation runs the gang delete-on-failure path).
+    faults.script("pod_wait", Hang(10.0))
+    k8s = make_executor(pods, storage, faults)
+    try:
+        with pytest.raises(DeadlineExceeded):
+            await ResilientCodeExecutor(k8s).execute(
+                "print(1)", deadline=Deadline.after(0.3)
+            )
+        for _ in range(5):
+            await asyncio.sleep(0.02)  # let fire-and-forget deletes land
+        kubectl = k8s._kubectl
+        created = {m["metadata"]["name"] for m in kubectl.created_manifests}
+        assert created <= set(kubectl.deleted)
+    finally:
+        await pods.close()
+
+
+# ------------------------------------- (b) breaker + fallback + half-open
+
+
+async def test_spawn_breaker_opens_falls_back_then_recovers(
+    pods, storage, faults, tmp_path
+):
+    clock = ManualClock()
+    metrics = Registry()
+    spawn_breaker = CircuitBreaker(
+        "k8s-spawn", window=4, failure_rate_threshold=0.5, min_calls=2,
+        cooldown_s=30.0, half_open_max_calls=1, clock=clock,
+    )
+    k8s = make_executor(
+        pods, storage, faults,
+        metrics=metrics,
+        spawn_breaker=spawn_breaker,
+        executor_retry_attempts=1,  # 1 spawn attempt per request: scripted 1:1
+    )
+    fallback = LocalCodeExecutor(
+        storage=storage,
+        workspace_root=tmp_path / "fallback-ws",
+        disable_dep_install=True,
+    )
+    resilient = ResilientCodeExecutor(k8s, fallback=fallback, metrics=metrics)
+    kubectl = k8s._kubectl
+    try:
+        # Two spawn failures at 100% failure rate (min_calls=2): breaker opens.
+        faults.script("pod_create", Fail("apiserver down"), Fail("apiserver down"))
+        for _ in range(2):
+            with pytest.raises(RuntimeError):
+                await resilient.execute("print('down')")
+        assert spawn_breaker.state is BreakerState.OPEN
+
+        # While OPEN: no spawn attempted, request served by the local fallback.
+        creates_before = len(kubectl.created_manifests)
+        result = await resilient.execute("print(21 * 2)")
+        assert result.stdout == "42\n"
+        assert len(kubectl.created_manifests) == creates_before  # no k8s call
+        text = metrics.expose()
+        assert "bci_executor_fallback_total 1" in text
+        assert (
+            'bci_breaker_transitions_total{breaker="k8s-spawn",to="open"} 1'
+            in text
+        )
+
+        # Cooldown elapses -> HALF_OPEN; the healthy probe closes the breaker
+        # and the request is served by a real pod again.
+        clock.advance(31.0)
+        assert spawn_breaker.state is BreakerState.HALF_OPEN
+        result = await resilient.execute("print('back')")
+        assert result.stdout == "back\n"
+        assert spawn_breaker.state is BreakerState.CLOSED
+        assert len(kubectl.created_manifests) == creates_before + 1
+        text = metrics.expose()
+        assert (
+            'bci_breaker_transitions_total{breaker="k8s-spawn",to="half_open"} 1'
+            in text
+        )
+        assert (
+            'bci_breaker_transitions_total{breaker="k8s-spawn",to="closed"} 1'
+            in text
+        )
+    finally:
+        await pods.close()
+
+
+async def test_open_breaker_without_fallback_fails_fast(pods, storage, faults):
+    clock = ManualClock()
+    spawn_breaker = CircuitBreaker(
+        "k8s-spawn", window=4, failure_rate_threshold=0.5, min_calls=2,
+        cooldown_s=30.0, clock=clock,
+    )
+    executor = make_executor(
+        pods, storage, faults,
+        spawn_breaker=spawn_breaker, executor_retry_attempts=1,
+    )
+    resilient = ResilientCodeExecutor(executor)  # no fallback configured
+    try:
+        faults.script("pod_create", Fail(), Fail())
+        for _ in range(2):
+            with pytest.raises(RuntimeError):
+                await resilient.execute("print(1)")
+        t0 = time.monotonic()
+        with pytest.raises(BreakerOpenError) as exc:
+            await resilient.execute("print(1)")
+        assert time.monotonic() - t0 < 0.1  # fail-fast, no spawn wait
+        assert exc.value.retry_after_s == pytest.approx(30.0, abs=1.0)
+    finally:
+        await pods.close()
+
+
+async def test_http_breaker_opens_on_sustained_5xx(pods, storage, faults):
+    # The data-plane breaker: sustained 5xx from sandboxes trips k8s-http.
+    executor = make_executor(
+        pods, storage, faults,
+        executor_retry_attempts=1,
+        breaker_min_calls=2, breaker_window=4,
+    )
+    try:
+        faults.script(
+            "execute",
+            HttpStatus(503), HttpStatus(503), HttpStatus(503), HttpStatus(503),
+        )
+        for _ in range(2):
+            with pytest.raises(SandboxTransientError):
+                await executor.execute("print(1)")
+        assert executor.http_breaker.state is BreakerState.OPEN
+        # Next request spawns a pod but the data plane refuses fast.
+        with pytest.raises(BreakerOpenError):
+            await executor.execute("print(1)")
+    finally:
+        await pods.close()
+
+
+async def test_transient_5xx_retried_to_success_with_metrics(
+    pods, storage, faults
+):
+    metrics = Registry()
+    executor = make_executor(pods, storage, faults, metrics=metrics)
+    try:
+        faults.script("execute", HttpStatus(502))  # one bad answer, then healthy
+        result = await executor.execute("print('recovered')")
+        assert result.stdout == "recovered\n"
+        assert [op for op, _ in executor.retry_backoffs] == ["execute"]
+        assert (
+            'bci_executor_retry_attempts_total{op="execute"} 1'
+            in metrics.expose()
+        )
+    finally:
+        await pods.close()

@@ -1,14 +1,21 @@
 """Pod-pool scheduler + remote execution driver against fake kubectl and real
-in-process executor servers (unit coverage the reference lacks; SURVEY.md §4)."""
+in-process executor servers (unit coverage the reference lacks; SURVEY.md §4).
+The retry/teardown paths are exercised through the deterministic
+fault-injection harness (tests/chaos.py)."""
 
 import asyncio
 
 import pytest
 
 from bee_code_interpreter_tpu.config import Config
+from bee_code_interpreter_tpu.resilience import (
+    SandboxFatalError,
+    SandboxTransientError,
+)
 from bee_code_interpreter_tpu.services.kubernetes_code_executor import (
     KubernetesCodeExecutor,
 )
+from tests.chaos import ChaosKubectl, Fail, FaultPlan, HttpStatus, NoIP, Ok
 from tests.fakes import FakeExecutorPods, FakeKubectl
 
 
@@ -17,16 +24,18 @@ def pods(tmp_path):
     return FakeExecutorPods(tmp_path / "pods")
 
 
-def make_executor(pods, storage, **config_overrides):
-    config = Config(
+def make_executor(pods, storage, *, faults=None, **config_overrides):
+    defaults = dict(
         executor_backend="kubernetes",
         executor_port=pods.port,
         executor_pod_queue_target_length=2,
         pod_ready_timeout_s=5,
-        **config_overrides,
     )
+    defaults.update(config_overrides)
+    config = Config(**defaults)
+    kubectl = ChaosKubectl(pods, faults) if faults is not None else FakeKubectl(pods)
     return KubernetesCodeExecutor(
-        kubectl=FakeKubectl(pods), storage=storage, config=config
+        kubectl=kubectl, storage=storage, config=config, ip_poll_interval_s=0.02
     )
 
 
@@ -179,6 +188,124 @@ async def test_preempted_warm_group_discarded_not_used(pods, storage):
         await drain_tasks()
         # the preempted group was torn down, not reused
         assert victim.pod_names[0] in kubectl.deleted
+    finally:
+        await pods.close()
+
+
+# ----------------------------------------------------- retry paths (chaos)
+
+
+async def test_execute_retry_backoff_schedule_observed(pods, storage):
+    # Two 5xx answers, then healthy: the execute retry walks the exponential
+    # schedule wait_min * 2**(n-1) and the request still succeeds.
+    faults = FaultPlan().script("execute", HttpStatus(503), HttpStatus(502))
+    pods.faults = faults
+    executor = make_executor(
+        pods, storage, faults=faults,
+        executor_retry_wait_min_s=0.01, executor_retry_wait_max_s=0.04,
+    )
+    try:
+        result = await executor.execute("print('survived')")
+        assert result.stdout == "survived\n"
+        assert [
+            (op, pytest.approx(s)) for op, s in executor.retry_backoffs
+        ] == [("execute", 0.01), ("execute", 0.02)]
+    finally:
+        await pods.close()
+
+
+async def test_spawn_retry_backoff_schedule_observed(pods, storage):
+    # Spawn fails twice (apiserver flake), succeeds on the third attempt —
+    # all inside ONE execute call, via the spawn retry policy.
+    faults = FaultPlan().script("pod_create", Fail(), Fail())
+    pods.faults = faults
+    executor = make_executor(
+        pods, storage, faults=faults,
+        executor_retry_wait_min_s=0.01, executor_retry_wait_max_s=0.04,
+        executor_pod_queue_target_length=0,
+    )
+    try:
+        result = await executor.execute("print('third time lucky')")
+        assert result.stdout == "third time lucky\n"
+        assert [op for op, _ in executor.retry_backoffs] == ["spawn", "spawn"]
+    finally:
+        await pods.close()
+
+
+async def test_fatal_4xx_not_retried(pods, storage):
+    # A 400 from the sandbox is final: exactly one /execute request, no
+    # backoff burned, SandboxFatalError surfaced.
+    faults = FaultPlan().script("execute", HttpStatus(400))
+    pods.faults = faults
+    executor = make_executor(pods, storage, faults=faults)
+    try:
+        with pytest.raises(SandboxFatalError):
+            await executor.execute("print(1)")
+        assert sum(pods.execute_counts.values()) == 1
+        assert executor.retry_backoffs == []
+    finally:
+        await pods.close()
+
+
+async def test_single_use_teardown_on_mid_execute_failure(pods, storage):
+    # A group whose execution failed mid-flight is still torn down (single-use
+    # hygiene holds on the failure path, not just on success).
+    faults = FaultPlan().script("execute", HttpStatus(503))
+    pods.faults = faults
+    executor = make_executor(
+        pods, storage, faults=faults, executor_retry_attempts=1,
+    )
+    kubectl = executor._kubectl
+    try:
+        with pytest.raises(SandboxTransientError):
+            await executor.execute("print(1)")
+        await drain_tasks()
+        created = {m["metadata"]["name"] for m in kubectl.created_manifests}
+        # every group created for (or refilled around) the failed request that
+        # is not sitting warm in the queue has been deleted
+        warm = {name for g in executor._queue for name in g.pod_names}
+        assert created - warm <= set(kubectl.deleted)
+        assert len(created - warm) >= 1
+    finally:
+        await pods.close()
+
+
+async def test_gang_teardown_on_partial_spawn_failure_chaos(pods, storage):
+    # Worker 0 creates fine, worker 1's create errors: every created member
+    # of the failed gang is deleted (all-or-nothing spawn), driven through
+    # the chaos harness instead of monkeypatching.
+    faults = FaultPlan().script("pod_create", Ok(), Fail("worker 1 rejected"))
+    pods.faults = faults
+    executor = make_executor(
+        pods, storage, faults=faults,
+        tpu_hosts_per_slice=2, executor_pod_queue_target_length=0,
+        executor_retry_attempts=1,
+    )
+    kubectl = executor._kubectl
+    try:
+        with pytest.raises(RuntimeError):
+            await executor.execute("print(1)")
+        await drain_tasks()
+        created = {m["metadata"]["name"] for m in kubectl.created_manifests}
+        assert created  # w0 was created...
+        assert created <= set(kubectl.deleted)  # ...and torn down with the gang
+    finally:
+        await pods.close()
+
+
+async def test_pod_ip_flap_retried_within_spawn(pods, storage):
+    # status.podIP empty on the first two polls (pod scheduled, IP not yet
+    # assigned): the IP wait polls through the flap without failing the spawn.
+    faults = FaultPlan().script("pod_ip", NoIP(), NoIP())
+    pods.faults = faults
+    executor = make_executor(
+        pods, storage, faults=faults,
+        tpu_hosts_per_slice=2, executor_pod_queue_target_length=0,
+    )
+    try:
+        result = await executor.execute("print('flap survived')")
+        assert result.stdout == "flap survived\n"
+        assert faults.pending("pod_ip") == 0  # the flap was actually consumed
     finally:
         await pods.close()
 

@@ -43,6 +43,47 @@ def test_gauge_reads_callback_at_scrape():
     assert "pool_size 4" in reg.expose()
 
 
+def test_raising_gauge_emits_nan_without_aborting_scrape():
+    # A callback that raises (e.g. a pool property read during executor
+    # teardown) must cost only its own sample, never the whole exposition.
+    reg = Registry()
+    c = reg.counter("ok_total", "fine")
+    c.inc()
+
+    def boom():
+        raise RuntimeError("pool torn down")
+
+    reg.gauge("broken_gauge", "raises at scrape", boom)
+    reg.gauge("healthy_gauge", "fine", lambda: 7)
+    text = reg.expose()
+    assert "broken_gauge NaN" in text
+    assert "healthy_gauge 7" in text
+    assert "ok_total 1" in text  # the rest of the exposition survived
+
+
+def test_labeled_gauges_share_one_metric_block():
+    reg = Registry()
+    reg.gauge("breaker_state", "state", lambda: 0, breaker="spawn")
+    reg.gauge("breaker_state", "state", lambda: 2, breaker="http")
+    text = reg.expose()
+    assert text.count("# TYPE breaker_state gauge") == 1
+    assert 'breaker_state{breaker="spawn"} 0' in text
+    assert 'breaker_state{breaker="http"} 2' in text
+
+
+def test_registry_dedupes_by_name():
+    # Two components asking for the same counter share one object — no
+    # duplicate HELP/TYPE blocks, one merged value stream.
+    reg = Registry()
+    a = reg.counter("shared_total", "shared")
+    b = reg.counter("shared_total", "shared")
+    assert a is b
+    a.inc(); b.inc()
+    text = reg.expose()
+    assert text.count("# TYPE shared_total counter") == 1
+    assert "shared_total 2" in text
+
+
 async def test_metrics_endpoint_counts_requests(local_executor):
     app = create_http_server(
         code_executor=local_executor,

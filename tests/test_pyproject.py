@@ -8,8 +8,12 @@ install from the metadata rather than a hand-kept list."""
 
 import ast
 import sys
-import tomllib
 from pathlib import Path
+
+import pytest
+
+# tomllib landed in 3.11; on older interpreters skip (don't error) collection.
+tomllib = pytest.importorskip("tomllib")
 
 REPO = Path(__file__).resolve().parent.parent
 PKG = REPO / "bee_code_interpreter_tpu"

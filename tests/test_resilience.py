@@ -1,0 +1,504 @@
+"""Unit coverage for the resilience subsystem (docs/resilience.md):
+Deadline, RetryPolicy/retryable, CircuitBreaker, AdmissionController, and
+the typed sandbox-error taxonomy. Time-dependent pieces run on ManualClock;
+anything that really sleeps uses sub-100ms budgets."""
+
+import asyncio
+import time
+
+import pytest
+
+from bee_code_interpreter_tpu.resilience import (
+    AdmissionController,
+    AdmissionRejected,
+    BreakerOpenError,
+    BreakerState,
+    CircuitBreaker,
+    Deadline,
+    DeadlineExceeded,
+    ResilientCodeExecutor,
+    RetryPolicy,
+    SandboxError,
+    SandboxFatalError,
+    SandboxTransientError,
+    classify_http_status,
+    retryable,
+)
+from bee_code_interpreter_tpu.services.code_executor import Result
+from bee_code_interpreter_tpu.utils.metrics import Registry
+from tests.chaos import ManualClock
+
+
+# ----------------------------------------------------------------- deadline
+
+
+def test_deadline_remaining_shrinks_with_clock():
+    clock = ManualClock()
+    d = Deadline.after(10.0, clock=clock)
+    assert d.remaining() == pytest.approx(10.0)
+    clock.advance(4.0)
+    assert d.remaining() == pytest.approx(6.0)
+    assert not d.expired
+    clock.advance(7.0)
+    assert d.remaining() == 0.0  # clamped, never negative
+    assert d.expired
+    with pytest.raises(DeadlineExceeded):
+        d.check("unit test")
+
+
+def test_deadline_clamp_caps_operation_timeouts():
+    clock = ManualClock()
+    d = Deadline.after(5.0, clock=clock)
+    assert d.clamp(60.0) == pytest.approx(5.0)  # op budget > deadline
+    assert d.clamp(2.0) == pytest.approx(2.0)  # op budget < deadline
+    assert d.clamp(None) == pytest.approx(5.0)  # no op budget: the deadline
+
+
+async def test_deadline_run_bounds_and_cancels():
+    d = Deadline.after(0.05)
+    cancelled = asyncio.Event()
+
+    async def hang():
+        try:
+            await asyncio.sleep(10)
+        except asyncio.CancelledError:
+            cancelled.set()
+            raise
+
+    t0 = time.monotonic()
+    with pytest.raises(DeadlineExceeded):
+        await d.run(hang(), what="hang")
+    assert time.monotonic() - t0 < 1.0
+    assert cancelled.is_set()  # the hung work was cancelled, not leaked
+
+
+async def test_deadline_run_passes_result_and_errors_through():
+    async def ok():
+        return 42
+
+    async def boom():
+        raise ValueError("boom")
+
+    d = Deadline.after(5.0)
+    assert await d.run(ok()) == 42
+    with pytest.raises(ValueError):
+        await d.run(boom())
+
+
+# ------------------------------------------------------------------- errors
+
+
+def test_error_taxonomy():
+    # RuntimeError subclassing keeps legacy `except RuntimeError` sites alive.
+    assert issubclass(SandboxTransientError, RuntimeError)
+    assert issubclass(SandboxFatalError, RuntimeError)
+    assert issubclass(SandboxTransientError, SandboxError)
+    assert isinstance(classify_http_status(503, "x"), SandboxTransientError)
+    assert isinstance(classify_http_status(500, "x"), SandboxTransientError)
+    assert isinstance(classify_http_status(404, "x"), SandboxFatalError)
+    assert isinstance(classify_http_status(400, "x"), SandboxFatalError)
+    # DeadlineExceeded / BreakerOpenError are NOT RuntimeErrors: retry
+    # policies keyed on RuntimeError must never re-attempt them.
+    assert not issubclass(DeadlineExceeded, RuntimeError)
+    assert not issubclass(BreakerOpenError, RuntimeError)
+
+
+# -------------------------------------------------------------------- retry
+
+
+class _Flaky:
+    """Host object for the retryable decorator."""
+
+    def __init__(self, failures, policy):
+        self._failures = failures
+        self._policy = policy
+        self.calls = 0
+        self.backoffs = []
+
+    def _on_retry_backoff(self, op, attempt, sleep_s, exc):
+        self.backoffs.append((op, attempt, sleep_s))
+
+    @retryable("_policy", op="unit")
+    async def work(self, deadline=None):
+        self.calls += 1
+        if self.calls <= self._failures:
+            raise SandboxTransientError(f"flake #{self.calls}")
+        return "done"
+
+
+async def test_retry_succeeds_after_transient_failures_with_schedule():
+    policy = RetryPolicy(
+        attempts=3, wait_min_s=0.01, wait_max_s=0.04, retry_on=(SandboxTransientError,)
+    )
+    flaky = _Flaky(failures=2, policy=policy)
+    assert await flaky.work() == "done"
+    assert flaky.calls == 3
+    # exponential: wait_min * 2**(attempt-1), capped at wait_max
+    assert [s for _, _, s in flaky.backoffs] == [pytest.approx(0.01), pytest.approx(0.02)]
+
+
+async def test_retry_exhausts_attempts_and_reraises():
+    policy = RetryPolicy(
+        attempts=2, wait_min_s=0.01, wait_max_s=0.01, retry_on=(SandboxTransientError,)
+    )
+    flaky = _Flaky(failures=10, policy=policy)
+    with pytest.raises(SandboxTransientError):
+        await flaky.work()
+    assert flaky.calls == 2
+
+
+async def test_retry_does_not_retry_non_matching_errors():
+    policy = RetryPolicy(
+        attempts=3, wait_min_s=0.01, wait_max_s=0.01, retry_on=(SandboxTransientError,)
+    )
+
+    class Fatal(_Flaky):
+        @retryable("_policy", op="unit")
+        async def work(self, deadline=None):
+            self.calls += 1
+            raise SandboxFatalError("HTTP 400")
+
+    fatal = Fatal(failures=0, policy=policy)
+    with pytest.raises(SandboxFatalError):
+        await fatal.work()
+    assert fatal.calls == 1
+
+
+async def test_retry_stops_when_deadline_cannot_cover_backoff():
+    policy = RetryPolicy(
+        attempts=5, wait_min_s=1.0, wait_max_s=1.0, retry_on=(SandboxTransientError,)
+    )
+    flaky = _Flaky(failures=10, policy=policy)
+    t0 = time.monotonic()
+    with pytest.raises(SandboxTransientError):
+        await flaky.work(deadline=Deadline.after(0.05))
+    # no budget for the 1s backoff: re-raised immediately, single attempt
+    assert flaky.calls == 1
+    assert time.monotonic() - t0 < 0.5
+
+
+def test_retry_preserves_wrapped_for_bypass():
+    assert _Flaky.work.__wrapped__.__name__ == "work"
+
+
+# ------------------------------------------------------------------ breaker
+
+
+def _breaker(clock, **kwargs):
+    defaults = dict(
+        window=4, failure_rate_threshold=0.5, min_calls=2, cooldown_s=30.0,
+        half_open_max_calls=1, clock=clock,
+    )
+    defaults.update(kwargs)
+    return CircuitBreaker("unit", **defaults)
+
+
+def test_breaker_full_lifecycle():
+    clock = ManualClock()
+    transitions = []
+    b = _breaker(clock, on_transition=lambda name, s: transitions.append(s))
+    assert b.state is BreakerState.CLOSED
+
+    # One failure of one call: below min_calls, stays closed.
+    b.before_call(); b.record_failure()
+    assert b.state is BreakerState.CLOSED
+
+    # Second failure: rate 2/2 >= 0.5 with min_calls=2 -> OPEN.
+    b.before_call(); b.record_failure()
+    assert b.state is BreakerState.OPEN
+    with pytest.raises(BreakerOpenError) as exc:
+        b.before_call()
+    assert exc.value.retry_after_s == pytest.approx(30.0)
+
+    # Cooldown elapses: HALF_OPEN, one probe allowed.
+    clock.advance(31.0)
+    assert b.state is BreakerState.HALF_OPEN
+    b.before_call()  # the probe slot
+    with pytest.raises(BreakerOpenError):
+        b.before_call()  # second concurrent probe rejected
+    b.record_success()
+    assert b.state is BreakerState.CLOSED
+    assert transitions == [
+        BreakerState.OPEN, BreakerState.HALF_OPEN, BreakerState.CLOSED,
+    ]
+
+
+def test_breaker_half_open_failure_reopens():
+    clock = ManualClock()
+    b = _breaker(clock)
+    b.before_call(); b.record_failure()
+    b.before_call(); b.record_failure()
+    clock.advance(31.0)
+    b.before_call()  # half-open probe
+    b.record_failure()
+    assert b.state is BreakerState.OPEN
+    with pytest.raises(BreakerOpenError):
+        b.before_call()
+    # and the cooldown restarted from the probe failure
+    clock.advance(29.0)
+    with pytest.raises(BreakerOpenError):
+        b.before_call()
+    clock.advance(2.0)
+    b.before_call()  # half-open again
+
+
+def test_breaker_successes_keep_it_closed():
+    clock = ManualClock()
+    b = _breaker(clock)
+    for _ in range(10):
+        b.before_call(); b.record_success()
+    b.before_call(); b.record_failure()  # 1 failure in window of 4: 0.25 < 0.5
+    assert b.state is BreakerState.CLOSED
+
+
+async def test_breaker_guard_classifies_with_is_failure():
+    clock = ManualClock()
+    b = _breaker(
+        clock, is_failure=lambda e: not isinstance(e, SandboxFatalError)
+    )
+    # 4xx answers are breaker-successes: the backend is responsive.
+    for _ in range(5):
+        with pytest.raises(SandboxFatalError):
+            async with b.guard():
+                raise SandboxFatalError("HTTP 400")
+    assert b.state is BreakerState.CLOSED
+    # transient failures trip it
+    for _ in range(2):
+        with pytest.raises(SandboxTransientError):
+            async with b.guard():
+                raise SandboxTransientError("HTTP 503")
+    assert b.state is BreakerState.OPEN
+
+
+async def test_breaker_guard_deadline_exceeded_is_neutral():
+    # A blown *request* deadline is the client's budget running out, not a
+    # backend verdict: impatient clients must not trip the breaker.
+    clock = ManualClock()
+    b = _breaker(clock)
+    for _ in range(5):
+        with pytest.raises(DeadlineExceeded):
+            async with b.guard():
+                raise DeadlineExceeded("pod group spawn")
+    assert b.state is BreakerState.CLOSED
+
+
+async def test_breaker_guard_cancellation_is_neutral():
+    # A client disconnect (CancelledError) says nothing about backend health:
+    # no failure recorded, and a half-open probe slot is released.
+    clock = ManualClock()
+    b = _breaker(clock)
+    for _ in range(3):
+        with pytest.raises(asyncio.CancelledError):
+            async with b.guard():
+                raise asyncio.CancelledError()
+    assert b.state is BreakerState.CLOSED
+    # even paired with real outcomes, the cancels never entered the window:
+    # [T, T, F] is 1/3 < 0.5 -> still closed
+    b.before_call(); b.record_success()
+    b.before_call(); b.record_success()
+    b.before_call(); b.record_failure()
+    assert b.state is BreakerState.CLOSED
+
+    # half-open: a cancelled probe frees the slot for the next probe
+    b.before_call(); b.record_failure()  # [T,T,F,F] -> 2/4 >= 0.5: OPEN
+    assert b.state is BreakerState.OPEN
+    clock.advance(31.0)
+    with pytest.raises(asyncio.CancelledError):
+        async with b.guard():
+            raise asyncio.CancelledError()
+    b.before_call()  # slot available again, not BreakerOpenError
+    b.record_success()
+    assert b.state is BreakerState.CLOSED
+
+
+# ---------------------------------------------------------------- admission
+
+
+async def test_admission_fast_path_and_release():
+    a = AdmissionController(max_in_flight=2, max_queue=0)
+    async with a.admit():
+        assert a.in_flight == 1
+        async with a.admit():
+            assert a.in_flight == 2
+    assert a.in_flight == 0
+
+
+async def test_admission_sheds_when_queue_full():
+    a = AdmissionController(max_in_flight=1, max_queue=0, retry_after_s=7.0)
+    async with a.admit():
+        with pytest.raises(AdmissionRejected) as exc:
+            async with a.admit():
+                pass
+    assert exc.value.reason == "queue_full"
+    assert exc.value.retry_after_s == pytest.approx(7.0)
+
+
+async def test_admission_queues_then_grants_fifo():
+    a = AdmissionController(max_in_flight=1, max_queue=4)
+    order = []
+
+    release = asyncio.Event()
+
+    async def holder():
+        async with a.admit():
+            order.append("holder")
+            await release.wait()
+
+    async def waiter(tag):
+        async with a.admit():
+            order.append(tag)
+
+    h = asyncio.create_task(holder())
+    await asyncio.sleep(0.01)
+    w1 = asyncio.create_task(waiter("w1"))
+    w2 = asyncio.create_task(waiter("w2"))
+    await asyncio.sleep(0.01)
+    assert a.queue_depth == 2
+    release.set()
+    await asyncio.gather(h, w1, w2)
+    assert order == ["holder", "w1", "w2"]  # FIFO handoff
+    assert a.in_flight == 0 and a.queue_depth == 0
+
+
+async def test_admission_waiter_sheds_at_deadline_never_hangs():
+    a = AdmissionController(max_in_flight=1, max_queue=4)
+    release = asyncio.Event()
+
+    async def holder():
+        async with a.admit():
+            await release.wait()
+
+    h = asyncio.create_task(holder())
+    await asyncio.sleep(0.01)
+    t0 = time.monotonic()
+    with pytest.raises(AdmissionRejected) as exc:
+        async with a.admit(Deadline.after(0.05)):
+            pass
+    assert exc.value.reason == "queue_timeout"
+    assert time.monotonic() - t0 < 1.0
+    release.set()
+    await h
+    assert a.in_flight == 0 and a.queue_depth == 0
+
+
+async def test_admission_cancelled_waiter_frees_its_queue_slot():
+    # A queued client that disconnects must not keep consuming a queue slot
+    # (it would shed healthy traffic as queue_full long after it left).
+    a = AdmissionController(max_in_flight=1, max_queue=1)
+    release = asyncio.Event()
+
+    async def holder():
+        async with a.admit():
+            await release.wait()
+
+    h = asyncio.create_task(holder())
+    await asyncio.sleep(0.01)
+
+    async def waiter():
+        async with a.admit():
+            pass
+
+    w = asyncio.create_task(waiter())
+    await asyncio.sleep(0.01)
+    assert a.queue_depth == 1
+    w.cancel()
+    with pytest.raises(asyncio.CancelledError):
+        await w
+    assert a.queue_depth == 0  # the dead future was withdrawn
+
+    # the freed slot is usable: a new waiter queues instead of being shed
+    w2 = asyncio.create_task(waiter())
+    await asyncio.sleep(0.01)
+    assert a.queue_depth == 1
+    release.set()
+    await asyncio.gather(h, w2)
+    assert a.in_flight == 0 and a.queue_depth == 0
+
+
+async def test_admission_never_exceeds_max_in_flight_under_burst():
+    a = AdmissionController(max_in_flight=3, max_queue=64)
+    peak = 0
+    active = 0
+
+    async def job():
+        nonlocal peak, active
+        async with a.admit(Deadline.after(5.0)):
+            active += 1
+            peak = max(peak, active)
+            await asyncio.sleep(0.001)
+            active -= 1
+
+    await asyncio.gather(*(job() for _ in range(20)))
+    assert peak <= 3
+    assert a.in_flight == 0 and a.queue_depth == 0
+
+
+async def test_admission_metrics_exported():
+    reg = Registry()
+    a = AdmissionController(max_in_flight=1, max_queue=0, metrics=reg)
+    async with a.admit():
+        with pytest.raises(AdmissionRejected):
+            async with a.admit():
+                pass
+        text = reg.expose()
+        assert 'bci_admission_shed_total{reason="queue_full"} 1' in text
+        assert "bci_admission_in_flight 1" in text
+    assert "bci_admission_in_flight 0" in reg.expose()
+
+
+# -------------------------------------------------- resilient executor unit
+
+
+class _StubExecutor:
+    def __init__(self, error=None):
+        self.error = error
+        self.calls = 0
+
+    async def execute(self, source_code, files=None, env=None, timeout_s=None,
+                      deadline=None):
+        self.calls += 1
+        if self.error is not None:
+            raise self.error
+        return Result(stdout="stub\n", stderr="", exit_code=0, files={})
+
+
+async def test_resilient_executor_falls_back_on_open_breaker():
+    reg = Registry()
+    primary = _StubExecutor(error=BreakerOpenError("k8s-spawn", 30.0))
+    fallback = _StubExecutor()
+    r = ResilientCodeExecutor(primary, fallback=fallback, metrics=reg)
+    result = await r.execute("print(1)")
+    assert result.stdout == "stub\n"
+    assert primary.calls == 1 and fallback.calls == 1
+    assert "bci_executor_fallback_total 1" in reg.expose()
+
+
+async def test_resilient_executor_no_fallback_for_data_plane_breaker():
+    # The http breaker can open mid-request, AFTER user code already ran on
+    # the pod — falling back would execute side-effectful code twice.
+    primary = _StubExecutor(error=BreakerOpenError("k8s-http", 30.0))
+    fallback = _StubExecutor()
+    r = ResilientCodeExecutor(primary, fallback=fallback)
+    with pytest.raises(BreakerOpenError):
+        await r.execute("print(1)")
+    assert fallback.calls == 0
+
+
+async def test_resilient_executor_reraises_without_fallback():
+    primary = _StubExecutor(error=BreakerOpenError("k8s-spawn", 30.0))
+    r = ResilientCodeExecutor(primary)
+    with pytest.raises(BreakerOpenError):
+        await r.execute("print(1)")
+
+
+async def test_resilient_executor_enforces_deadline_hard_bound():
+    class Slow:
+        async def execute(self, source_code, files=None, env=None,
+                          timeout_s=None, deadline=None):
+            await asyncio.sleep(10)
+
+    r = ResilientCodeExecutor(Slow())
+    t0 = time.monotonic()
+    with pytest.raises(DeadlineExceeded):
+        await r.execute("print(1)", deadline=Deadline.after(0.05))
+    assert time.monotonic() - t0 < 1.0
