@@ -24,6 +24,7 @@ from google.protobuf import descriptor_pb2, descriptor_pool
 from pydantic import ValidationError
 
 from bee_code_interpreter_tpu.api import models as api_models
+from bee_code_interpreter_tpu.observability import Tracer, parse_traceparent
 from bee_code_interpreter_tpu.proto import code_interpreter_pb2 as pb
 from bee_code_interpreter_tpu.proto import health_pb2, reflection_pb2
 from bee_code_interpreter_tpu.resilience import (
@@ -91,11 +92,13 @@ class CodeInterpreterServicer:
         admission: AdmissionController | None = None,
         request_deadline_s: float | None = None,
         metrics: Registry | None = None,
+        tracer: Tracer | None = None,
     ) -> None:
         self._code_executor = code_executor
         self._custom_tool_executor = custom_tool_executor
         self._admission = admission
         self._request_deadline_s = request_deadline_s
+        self._tracer = tracer or Tracer(metrics=metrics)
         self._deadline_exceeded_total = (
             metrics.counter(
                 "bci_deadline_exceeded_total",
@@ -103,6 +106,21 @@ class CodeInterpreterServicer:
             )
             if metrics is not None
             else None
+        )
+
+    def _trace_rpc(self, method: str, context: grpc.aio.ServicerContext, rid: str):
+        """Root a trace for one RPC, continuing an inbound ``traceparent``
+        when the client attached one as invocation metadata (the gRPC
+        spelling of the HTTP header contract)."""
+        metadata = {
+            k.lower(): v for k, v in (context.invocation_metadata() or ())
+        }
+        inbound = parse_traceparent(metadata.get("traceparent"))
+        return self._tracer.trace(
+            f"grpc:{method}",
+            trace_id=inbound[0] if inbound else None,
+            parent_span_id=inbound[1] if inbound else None,
+            request_id=rid,
         )
 
     def _new_deadline(self, context: grpc.aio.ServicerContext) -> Deadline | None:
@@ -160,7 +178,7 @@ class CodeInterpreterServicer:
     async def Execute(
         self, request: pb.ExecuteRequest, context: grpc.aio.ServicerContext
     ) -> pb.ExecuteResponse:
-        new_request_id()
+        rid = new_request_id()
         if not request.source_code:
             await context.abort(grpc.StatusCode.INVALID_ARGUMENT, "source_code is required")
         validated = await _validated(
@@ -188,7 +206,8 @@ class CodeInterpreterServicer:
                 files=result.files,
             )
 
-        return await self._with_resilience(context, run)
+        with self._trace_rpc("Execute", context, rid):
+            return await self._with_resilience(context, run)
 
     async def ParseCustomTool(
         self, request: pb.ParseCustomToolRequest, context: grpc.aio.ServicerContext
@@ -220,7 +239,7 @@ class CodeInterpreterServicer:
     async def ExecuteCustomTool(
         self, request: pb.ExecuteCustomToolRequest, context: grpc.aio.ServicerContext
     ) -> pb.ExecuteCustomToolResponse:
-        new_request_id()
+        rid = new_request_id()
         import json
 
         validated = await _validated(
@@ -252,7 +271,8 @@ class CodeInterpreterServicer:
                 )
             )
 
-        return await self._with_resilience(context, run)
+        with self._trace_rpc("ExecuteCustomTool", context, rid):
+            return await self._with_resilience(context, run)
 
 
 HEALTH_SERVICE_NAME = "grpc.health.v1.Health"
@@ -484,6 +504,7 @@ class GrpcServer:
         admission: AdmissionController | None = None,
         request_deadline_s: float | None = None,
         metrics: Registry | None = None,
+        tracer: Tracer | None = None,
     ) -> None:
         self._servicer = CodeInterpreterServicer(
             code_executor,
@@ -491,6 +512,7 @@ class GrpcServer:
             admission=admission,
             request_deadline_s=request_deadline_s,
             metrics=metrics,
+            tracer=tracer,
         )
         self.health = HealthServicer()
         self._tls_cert = tls_cert

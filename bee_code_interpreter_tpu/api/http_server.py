@@ -15,6 +15,13 @@ Resilience contract (docs/resilience.md): each sandbox-bound request gets a
 blown deadline is 504. When an ``AdmissionController`` is wired in, requests
 past the in-flight + queue bounds are shed as 429 with a ``Retry-After``
 header instead of queueing unboundedly.
+
+Observability contract (docs/observability.md): every ``/v1`` POST roots a
+trace next to its request id (continuing an inbound ``traceparent`` when the
+caller sent one); finished traces are retained in a bounded store and served
+from ``GET /v1/traces`` + ``GET /v1/traces/{trace_id}``; ``/v1/execute``
+responses carry the ``trace_id`` and a per-stage ``timings_ms`` breakdown so
+clients can self-report where their time went.
 """
 
 from __future__ import annotations
@@ -28,6 +35,12 @@ import pydantic
 from aiohttp import web
 
 from bee_code_interpreter_tpu.api import models
+from bee_code_interpreter_tpu.observability import (
+    REQUEST_ID_HEADER,
+    Tracer,
+    current_trace,
+    parse_traceparent,
+)
 from bee_code_interpreter_tpu.resilience import (
     AdmissionController,
     AdmissionRejected,
@@ -41,7 +54,7 @@ from bee_code_interpreter_tpu.services.custom_tool_executor import (
     CustomToolExecutor,
     CustomToolParseError,
 )
-from bee_code_interpreter_tpu.utils.metrics import Registry
+from bee_code_interpreter_tpu.utils.metrics import PROMETHEUS_CONTENT_TYPE, Registry
 from bee_code_interpreter_tpu.utils.request_id import new_request_id
 
 logger = logging.getLogger(__name__)
@@ -57,9 +70,11 @@ def create_http_server(
     metrics: Registry | None = None,
     admission: AdmissionController | None = None,
     request_deadline_s: float | None = None,
+    tracer: Tracer | None = None,
 ) -> web.Application:
     app = web.Application(client_max_size=1 << 30)
     metrics = metrics or Registry()
+    tracer = tracer or Tracer(metrics=metrics)
     requests_total = metrics.counter(
         "bci_http_requests_total", "HTTP requests by route and status"
     )
@@ -75,7 +90,8 @@ def create_http_server(
         """Run a sandbox-bound handler body under the edge deadline and the
         admission gate, mapping the shared shed/deadline response contract
         (docs/resilience.md) — the one place it is spelled for HTTP.
-        ``run(deadline)`` returns the success response."""
+        ``run(deadline)`` returns the success response. The admission gate
+        traces its own acquire as the ``admission`` stage span."""
         deadline = Deadline.after(request_deadline_s) if request_deadline_s else None
         try:
             async with (
@@ -106,7 +122,7 @@ def create_http_server(
 
     @web.middleware
     async def request_id_middleware(request: web.Request, handler):
-        new_request_id()
+        rid = new_request_id()
         # label by the *matched* route template, never the raw path: raw paths
         # are attacker-controlled (unbounded label cardinality + exposition
         # injection via percent-decoded quotes)
@@ -115,16 +131,37 @@ def create_http_server(
         match_info = request.match_info
         resource = match_info.route.resource if match_info is not None else None
         route = resource.canonical if resource is not None else "unmatched"
-        with request_seconds.time(route=route):
-            try:
-                response = await handler(request)
-            except web.HTTPException as e:
-                requests_total.inc(route=route, status=str(e.status))
-                raise
-            except Exception:
-                requests_total.inc(route=route, status="500")
-                raise
+        # Trace the sandbox-bound POSTs only: GET /metrics, /healthz and the
+        # trace-inspection API itself would drown the store in self-traffic.
+        traced = request.method == "POST" and route.startswith("/v1/")
+        inbound = (
+            parse_traceparent(request.headers.get("traceparent"))
+            if traced
+            else None
+        )
+        trace_ctx = (
+            tracer.trace(
+                route,
+                trace_id=inbound[0] if inbound else None,
+                parent_span_id=inbound[1] if inbound else None,
+                request_id=rid,
+            )
+            if traced
+            else nullcontext()
+        )
+        with trace_ctx:
+            with request_seconds.time(route=route):
+                try:
+                    response = await handler(request)
+                except web.HTTPException as e:
+                    requests_total.inc(route=route, status=str(e.status))
+                    e.headers.setdefault(REQUEST_ID_HEADER, rid)
+                    raise
+                except Exception:
+                    requests_total.inc(route=route, status="500")
+                    raise
         requests_total.inc(route=route, status=str(response.status))
+        response.headers.setdefault(REQUEST_ID_HEADER, rid)
         return response
 
     app.middlewares.append(request_id_middleware)
@@ -159,8 +196,17 @@ def create_http_server(
                 logger.exception("Execution failed")
                 return web.json_response({"detail": "Execution failed"}, status=500)
             logger.info("Execution result: exit_code=%s", result.exit_code)
+            # Per-stage timing breakdown off the request's own trace: the
+            # stage spans have all finished by now (the root closes with the
+            # middleware), so agents/benchmarks can self-report where the
+            # time went without a second round-trip to /v1/traces.
+            trace = current_trace()
             return web.json_response(
-                models.ExecuteResponse(**result.model_dump()).model_dump()
+                models.ExecuteResponse(
+                    **result.model_dump(),
+                    trace_id=trace.trace_id if trace is not None else None,
+                    timings_ms=trace.stage_ms() if trace is not None else None,
+                ).model_dump()
             )
 
         return await with_resilience(run)
@@ -207,13 +253,31 @@ def create_http_server(
         return web.json_response({"status": "ok"})
 
     async def metrics_endpoint(_request: web.Request) -> web.Response:
+        # The exposition-format content type (version parameter included) so
+        # Prometheus scrapers negotiate the parser instead of guessing.
         return web.Response(
-            text=metrics.expose(), content_type="text/plain", charset="utf-8"
+            body=metrics.expose().encode("utf-8"),
+            headers={"Content-Type": PROMETHEUS_CONTENT_TYPE},
         )
+
+    async def list_traces(_request: web.Request) -> web.Response:
+        return web.json_response(
+            {"traces": [t.summary() for t in tracer.store.traces()]}
+        )
+
+    async def get_trace(request: web.Request) -> web.Response:
+        trace = tracer.store.get(request.match_info["trace_id"])
+        if trace is None:
+            return web.json_response(
+                {"detail": "unknown or evicted trace"}, status=404
+            )
+        return web.json_response(trace.to_dict())
 
     app.router.add_post("/v1/execute", execute)
     app.router.add_post("/v1/parse-custom-tool", parse_custom_tool)
     app.router.add_post("/v1/execute-custom-tool", execute_custom_tool)
     app.router.add_get("/healthz", healthz)
     app.router.add_get("/metrics", metrics_endpoint)
+    app.router.add_get("/v1/traces", list_traces)
+    app.router.add_get("/v1/traces/{trace_id}", get_trace)
     return app

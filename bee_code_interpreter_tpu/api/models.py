@@ -23,6 +23,12 @@ class ExecuteResponse(BaseModel):
     stderr: str
     exit_code: int
     files: dict[AbsolutePath, Hash]
+    # Observability additions (docs/observability.md): the request's trace id
+    # (retrievable at GET /v1/traces/{trace_id} while retained) and the
+    # per-stage timing breakdown (stage name → milliseconds) off the same
+    # trace, so clients/benchmarks can attribute latency without scraping.
+    trace_id: str | None = None
+    timings_ms: dict[str, float] | None = None
 
 
 class ParseCustomToolRequest(BaseModel):

@@ -13,6 +13,7 @@ import logging.config
 from functools import cached_property
 
 from bee_code_interpreter_tpu.config import Config
+from bee_code_interpreter_tpu.observability import Tracer, TraceStore
 from bee_code_interpreter_tpu.services.custom_tool_executor import CustomToolExecutor
 from bee_code_interpreter_tpu.services.storage import Storage
 from bee_code_interpreter_tpu.utils.metrics import Registry
@@ -22,9 +23,18 @@ from bee_code_interpreter_tpu.utils.request_id import install_request_id_filter
 class ApplicationContext:
     def __init__(self, config: Config | None = None) -> None:
         self.config = config or Config.from_env()
-        logging.config.dictConfig(self.config.logging_config)
+        # resolved config applies APP_LOG_FORMAT=json (structured one-line
+        # records); the request-id filter also stamps trace/span ids now.
+        logging.config.dictConfig(self.config.resolved_logging_config())
         install_request_id_filter()
         self.metrics = Registry()
+        # One tracer + retention store shared by both transports: a trace is
+        # a service-level object, whichever edge rooted it.
+        self.trace_store = TraceStore(
+            max_traces=self.config.trace_max_traces,
+            slowest_keep=self.config.trace_slowest_keep,
+        )
+        self.tracer = Tracer(store=self.trace_store, metrics=self.metrics)
 
     @cached_property
     def storage(self) -> Storage:
@@ -171,6 +181,7 @@ class ApplicationContext:
             metrics=self.metrics,
             admission=self.admission,
             request_deadline_s=self.config.request_deadline_s,
+            tracer=self.tracer,
         )
 
     @cached_property
@@ -186,4 +197,5 @@ class ApplicationContext:
             admission=self.admission,
             request_deadline_s=self.config.request_deadline_s,
             metrics=self.metrics,
+            tracer=self.tracer,
         )

@@ -111,6 +111,19 @@ class Config(BaseModel):
     executor_retry_wait_min_s: float = Field(default=4.0, gt=0)
     executor_retry_wait_max_s: float = Field(default=10.0, gt=0)
 
+    # --- observability (new; see docs/observability.md) ---
+    # APP_LOG_FORMAT=json swaps the default text formatter for one-line JSON
+    # records carrying request_id/trace_id/span_id (structured-log schema in
+    # docs/observability.md). Only the default formatter is swapped; a custom
+    # APP_LOGGING_CONFIG is taken verbatim.
+    log_format: Literal["text", "json"] = "text"
+    # Finished traces retained in memory for GET /v1/traces: a ring of the
+    # most recent trace_max_traces, of which trace_slowest_keep slots are
+    # reserved for the slowest requests seen (the outliers worth inspecting
+    # are exactly the ones a plain ring evicts first under load).
+    trace_max_traces: int = Field(default=256, ge=1)
+    trace_slowest_keep: int = Field(default=32, ge=0)
+
     # --- object storage (reference config.py:74) ---
     file_storage_path: str = "./.tmp/files"
     # Optional TTL sweep of stored objects (the reference leaves cleanup to
@@ -172,6 +185,20 @@ class Config(BaseModel):
         return str(Path(__file__).resolve().parent / "runtime" / "shim")
 
     logging_config: dict[str, Any] = Field(default_factory=_default_logging_config)
+
+    def resolved_logging_config(self) -> dict[str, Any]:
+        """``logging_config`` with ``log_format`` applied: json mode swaps
+        the ``default`` formatter for the structured JsonLogFormatter.
+        A deployment that injected its own APP_LOGGING_CONFIG without a
+        ``default`` formatter is left untouched."""
+        import copy
+
+        cfg = copy.deepcopy(self.logging_config)
+        if self.log_format == "json" and "default" in cfg.get("formatters", {}):
+            cfg["formatters"]["default"] = {
+                "()": "bee_code_interpreter_tpu.observability.logging.JsonLogFormatter",
+            }
+        return cfg
 
     @classmethod
     def from_env(cls, env: dict[str, str] | None = None, prefix: str = "APP_") -> "Config":

@@ -27,6 +27,7 @@ no serving stack at all (SURVEY §2).
 from __future__ import annotations
 
 import heapq
+import time
 from dataclasses import dataclass
 
 import numpy as np
@@ -58,9 +59,32 @@ class Engine:
     """
 
     def __init__(self, batcher: ContinuousBatcher,
-                 max_queue: int | None = None) -> None:
+                 max_queue: int | None = None, metrics=None) -> None:
         self.batcher = batcher
         self.max_queue = max_queue
+        # Queue-level instrumentation (docs/observability.md): the batcher
+        # covers decode cadence; the engine covers what happens BEFORE a
+        # request reaches a batch row — depth, wait, capacity bounce-backs.
+        self._metrics = metrics
+        self._ticket_submit_t: dict[int, float] = {}
+        if metrics is not None:
+            self._queue_wait_seconds = metrics.histogram(
+                "bci_serving_queue_wait_seconds",
+                "Ticket wait from engine submit to batcher admission",
+            )
+            self._requeues_total = metrics.counter(
+                "bci_serving_requeues_total",
+                "Admissions bounced back to the queue by a capacity race",
+            )
+            self._rejected_total = metrics.counter(
+                "bci_serving_queue_rejected_total",
+                "Submissions rejected at the queue bound",
+            )
+            metrics.gauge(
+                "bci_serving_queue_depth",
+                "Accepted-but-not-admitted tickets",
+                lambda: len(self._queued),
+            )
         # heap entries: (-priority, arrival seq, ticket, request);
         # cancellation of a queued ticket is LAZY — the ticket leaves
         # self._queued and its entry is skipped when it surfaces
@@ -136,6 +160,8 @@ class Engine:
         if prefill_chunk is not None and prefill_chunk < 1:
             raise ValueError(f"chunk must be >= 1, got {prefill_chunk}")
         if self.max_queue is not None and len(self._queued) >= self.max_queue:
+            if self._metrics is not None:
+                self._rejected_total.inc()
             raise RuntimeError(f"queue full ({self.max_queue})")
         req = _Queued(
             prompt, max_new_tokens, sampling, prefill_chunk, adapter,
@@ -156,6 +182,8 @@ class Engine:
         # emitted otherwise. At retirement the remainder flushes post-trim.
         stops = sampling.stop_sequences if sampling is not None else ()
         self._holdback[ticket] = max((len(s) for s in stops), default=1) - 1
+        if self._metrics is not None:
+            self._ticket_submit_t[ticket] = time.monotonic()
         return ticket
 
     # -------------------------------------------------------------- admit
@@ -198,6 +226,8 @@ class Engine:
                 # an infinite requeue loop against a failing device.
                 heapq.heappush(self._heap, (neg_prio, seq, ticket, req))
                 self._queued.add(ticket)
+                if self._metrics is not None:
+                    self._requeues_total.inc()
                 return
             except Exception as e:
                 # validate_request ran at intake, so this "cannot happen";
@@ -205,8 +235,13 @@ class Engine:
                 # loudly-but-locally instead of wedging it in 'queued'
                 # forever and taking the whole step loop down
                 self._state[ticket] = ("error", repr(e))
+                self._ticket_submit_t.pop(ticket, None)
                 continue
             self._state[ticket] = rid
+            if self._metrics is not None:
+                t0 = self._ticket_submit_t.pop(ticket, None)
+                if t0 is not None:
+                    self._queue_wait_seconds.observe(time.monotonic() - t0)
 
     # --------------------------------------------------------------- step
     def step(self) -> None:
@@ -329,6 +364,7 @@ class Engine:
             self._state[ticket] = "cancelled"
             self._stream_cursor.pop(ticket, None)
             self._holdback.pop(ticket, None)
+            self._ticket_submit_t.pop(ticket, None)
             return
         if rid != "cancelled" and not isinstance(rid, tuple):
             self.batcher.cancel(rid)

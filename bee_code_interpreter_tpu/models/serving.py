@@ -58,7 +58,8 @@ from __future__ import annotations
 
 import functools
 import hashlib
-from collections import OrderedDict
+import time
+from collections import OrderedDict, deque
 from dataclasses import dataclass
 
 import jax
@@ -326,6 +327,7 @@ class ContinuousBatcher:
         adapters: list | None = None,
         lora_scale: float = 1.0,
         mesh=None,
+        metrics=None,
     ) -> None:
         """``draft_params``/``draft_config`` switch the batcher into
         SPECULATIVE mode: every step, the draft proposes ``gamma`` greedy
@@ -579,6 +581,88 @@ class ContinuousBatcher:
                 donate_argnums=(3,),
             )
 
+        # Serving-engine instrumentation (docs/observability.md): ``metrics``
+        # is a utils.metrics Registry; None keeps the batcher metrics-free
+        # (zero overhead on the hot loop). TTFT and inter-token latency are
+        # the serving-quality numbers (Orca-style per-stage visibility);
+        # occupancy/pages/tokens-per-second are the capacity ones.
+        self._metrics = metrics
+        self._t_submit: float | None = None
+        if metrics is not None:
+            from bee_code_interpreter_tpu.utils.metrics import (
+                TOKEN_LATENCY_BUCKETS,
+            )
+
+            self._ttft_seconds = metrics.histogram(
+                "bci_serving_ttft_seconds",
+                "Time from submit to a request's first generated token",
+                buckets=TOKEN_LATENCY_BUCKETS,
+            )
+            self._inter_token_seconds = metrics.histogram(
+                "bci_serving_inter_token_seconds",
+                "Per-row latency between consecutive generated tokens",
+                buckets=TOKEN_LATENCY_BUCKETS,
+            )
+            self._step_seconds = metrics.histogram(
+                "bci_serving_step_seconds",
+                "Wall time of one batcher step",
+                buckets=TOKEN_LATENCY_BUCKETS,
+            )
+            self._tokens_total = metrics.counter(
+                "bci_serving_tokens_total",
+                "Tokens generated across all requests",
+            )
+            metrics.gauge(
+                "bci_serving_active_rows",
+                "Batch rows currently decoding",
+                lambda: int(self.active.sum()),
+            )
+            metrics.gauge(
+                "bci_serving_batch_occupancy",
+                "Fraction of batch rows decoding (0-1)",
+                lambda: float(self.active.sum()) / float(self.active.shape[0]),
+            )
+            metrics.gauge(
+                "bci_serving_free_pages",
+                "KV-cache pages on the free list",
+                lambda: len(self.free_pages),
+            )
+            metrics.gauge(
+                "bci_serving_tokens_per_second",
+                "Decode throughput over the recent step window",
+                self._tokens_per_second,
+            )
+            self._tokens_counted = 0
+            # (monotonic time, cumulative tokens) samples; the rate gauge
+            # reads the spread so a scrape never pays more than a subtraction
+            self._rate_samples: deque[tuple[float, int]] = deque(maxlen=512)
+
+    # throughput gauge window: samples older than this are dropped at read
+    # time, and a gauge whose newest sample is older reads 0 — an idle
+    # server must not report its last burst's rate forever
+    _RATE_WINDOW_S = 30.0
+
+    def _tokens_per_second(self) -> float:
+        s = self._rate_samples
+        if len(s) < 2:
+            return 0.0
+        now = time.monotonic()
+        if now - s[-1][0] > self._RATE_WINDOW_S:
+            return 0.0
+        while len(s) > 2 and now - s[0][0] > self._RATE_WINDOW_S:
+            s.popleft()
+        (t0, n0), (t1, n1) = s[0], s[-1]
+        return (n1 - n0) / (t1 - t0) if t1 > t0 else 0.0
+
+    def _sync_token_counter(self) -> None:
+        """Advance the Prometheus counter to the lifetime token total —
+        exact whichever path (step, submit-time activation, interleaved
+        finalization) produced the tokens."""
+        delta = self.n_tokens_generated - self._tokens_counted
+        if delta > 0:
+            self._tokens_total.inc(delta)
+            self._tokens_counted = self.n_tokens_generated
+
     # ----------------------------------------------------- snapshot/resume
 
     _HOST_STATE = (
@@ -677,6 +761,17 @@ class ContinuousBatcher:
         self.evictable = OrderedDict(
             (page, None) for page in state["host"]["evictable"]
         )
+        # Metrics are per-process, not serving state: realign the counter
+        # cursor so the restored lifetime total doesn't replay into
+        # Prometheus, clear the throughput window, and drop TTFT anchors —
+        # they are time.monotonic() values from the SNAPSHOTTING process's
+        # clock, meaningless (possibly negative) against ours.
+        self._t_submit = None
+        for rec in self.prefill_state.values():
+            rec.pop("t_submit", None)
+        if self._metrics is not None:
+            self._tokens_counted = self.n_tokens_generated
+            self._rate_samples.clear()
 
     def _shard_pool(self, pool: dict) -> dict:
         """Shard a page pool's kv-head axis over the mesh's tp axis (axis 2
@@ -812,6 +907,7 @@ class ContinuousBatcher:
 
         ``adapter`` serves this request under the i-th LoRA adapter the
         batcher was constructed with (None = the base model)."""
+        t_submit = time.monotonic()  # TTFT anchor (metrics only)
         prompt = np.asarray(prompt, dtype=np.int32).reshape(-1)
         n_need = self.validate_request(
             prompt, max_new_tokens, sampling=sampling, adapter=adapter,
@@ -897,6 +993,7 @@ class ContinuousBatcher:
                 "sampling": sampling, "max_new_tokens": max_new_tokens,
                 "adapter_internal": adapter_internal,
                 "speculative": speculative, "last_row": None,
+                "t_submit": t_submit,
             }
             return req
 
@@ -944,6 +1041,7 @@ class ContinuousBatcher:
             for page in reversed(pages):
                 self._release_page(page)
             raise
+        self._t_submit = t_submit
         return self._activate_row(
             row, last_row, prompt, pages, hashes, L, sampling,
             max_new_tokens, adapter_internal,
@@ -1029,6 +1127,11 @@ class ContinuousBatcher:
         self.row_rng[row] = rng
         self.results[req] = [first]
         self.n_tokens_generated += 1
+        if self._metrics is not None:
+            if self._t_submit is not None:
+                self._ttft_seconds.observe(time.monotonic() - self._t_submit)
+                self._t_submit = None
+            self._sync_token_counter()
         if sampling.logprobs:
             self.results_logprobs[req] = [logprob_of(last_row, first)]
         self.done[req] = False
@@ -1071,6 +1174,7 @@ class ContinuousBatcher:
                 n_need = len(rec["pages"])
                 self.block_table[row, :] = _SCRATCH_PAGE
                 self.block_table[row, :n_need] = rec["pages"]
+                self._t_submit = rec.get("t_submit")
                 self._activate_row(
                     row, rec["last_row"], rec["prompt"], rec["pages"],
                     rec["hashes"], rec["L"], rec["sampling"],
@@ -1294,7 +1398,32 @@ class ContinuousBatcher:
         """Advance every active row — by one token (plain mode, one
         compiled program), or by its own accept length (speculative
         mode). Interleaved admissions advance one window first, so their
-        prefill and the batch's decode share the step cadence."""
+        prefill and the batch's decode share the step cadence.
+
+        With a metrics registry configured, each step also observes its
+        wall time, the per-row inter-token latency (step time scaled by how
+        many tokens each row committed — one in plain mode, the accept
+        length in speculative mode), and the throughput window the
+        tokens-per-second gauge reads."""
+        if self._metrics is None:
+            self._step_inner()
+            return
+        rows_before = int(self.active.sum())
+        tokens_before = self.n_tokens_generated
+        t0 = time.monotonic()
+        self._step_inner()
+        t1 = time.monotonic()
+        produced = self.n_tokens_generated - tokens_before
+        self._step_seconds.observe(t1 - t0)
+        if produced:
+            if rows_before:
+                self._inter_token_seconds.observe(
+                    (t1 - t0) * rows_before / produced
+                )
+            self._rate_samples.append((t1, self.n_tokens_generated))
+        self._sync_token_counter()
+
+    def _step_inner(self) -> None:
         if self.prefill_state:
             self._advance_prefills()
         if not self.active.any():

@@ -1,0 +1,40 @@
+"""Observability: distributed tracing, trace retention, structured logging.
+
+Dependency-free (no OTel SDK in the image), layered like ``resilience/``:
+the primitives live here, the wiring lives at the edges (api/, services/,
+runtime/). See docs/observability.md for the operator-facing contract.
+"""
+
+from bee_code_interpreter_tpu.observability.logging import JsonLogFormatter
+from bee_code_interpreter_tpu.observability.tracing import (
+    REQUEST_ID_HEADER,
+    TRACEPARENT_HEADER,
+    Span,
+    Trace,
+    Tracer,
+    TraceStore,
+    current_ids,
+    current_span,
+    current_trace,
+    format_traceparent,
+    outbound_headers,
+    parse_traceparent,
+    span,
+)
+
+__all__ = [
+    "JsonLogFormatter",
+    "REQUEST_ID_HEADER",
+    "TRACEPARENT_HEADER",
+    "Span",
+    "Trace",
+    "Tracer",
+    "TraceStore",
+    "current_ids",
+    "current_span",
+    "current_trace",
+    "format_traceparent",
+    "outbound_headers",
+    "parse_traceparent",
+    "span",
+]

@@ -1,0 +1,42 @@
+"""Structured (JSON-lines) log formatting.
+
+Opt-in via ``APP_LOG_FORMAT=json``: every record becomes exactly one line
+of JSON carrying the correlation ids the tracing subsystem maintains
+(``request_id``/``trace_id``/``span_id``), so a log pipeline can join pod-
+and edge-side lines on ``trace_id`` without regex heroics. Exceptions are
+folded into the same single line (JSON escapes the newlines) — a stack
+trace must never shear a log stream that is parsed line-by-line.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import time
+
+
+class JsonLogFormatter(logging.Formatter):
+    """One JSON object per record. Correlation ids come from the record
+    attributes the ``RequestIdLoggingFilter`` attaches; records emitted
+    outside any request (startup, background sweeps) carry ``"-"``."""
+
+    def format(self, record: logging.LogRecord) -> str:
+        payload = {
+            "ts": time.strftime(
+                "%Y-%m-%dT%H:%M:%S", time.gmtime(record.created)
+            )
+            + f".{int(record.msecs):03d}Z",
+            "level": record.levelname,
+            "logger": record.name,
+            "message": record.getMessage(),
+            "request_id": getattr(record, "request_id", "-"),
+            "trace_id": getattr(record, "trace_id", "-"),
+            "span_id": getattr(record, "span_id", "-"),
+        }
+        if record.exc_info:
+            payload["exc_info"] = self.formatException(record.exc_info)
+        elif record.exc_text:
+            payload["exc_info"] = record.exc_text
+        if record.stack_info:
+            payload["stack_info"] = self.formatStack(record.stack_info)
+        return json.dumps(payload, ensure_ascii=False, default=str)

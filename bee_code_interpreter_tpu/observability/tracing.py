@@ -1,0 +1,373 @@
+"""Request-scoped distributed tracing on contextvars (Dapper-style).
+
+One ``Trace`` per request, rooted at the HTTP/gRPC edge next to
+``new_request_id()``; child spans mark the stages a slow request could have
+spent its time in (admission wait, pod-group spawn, workspace upload, the
+execute itself, download). The context crosses the network as a W3C
+``traceparent`` header plus ``X-Request-Id``, so the executor server
+continues the same trace inside the pod and its log lines correlate with
+the edge request that caused them.
+
+Design constraints that shaped this module:
+
+- **contextvars, not thread-locals**: the service is one asyncio loop with
+  interleaved requests; a span started in one request's task must be
+  invisible to every other in-flight request, including across ``await``
+  boundaries and ``asyncio.gather`` fan-outs (children copy the context).
+- **No-op off the request path**: ``span()`` with no active trace yields
+  ``None`` and touches nothing, so library code (executors, drivers) can be
+  instrumented unconditionally — direct/test callers pay two ContextVar
+  reads per stage, nothing more.
+- **Traces are retained, not shipped**: finished traces land in a bounded
+  in-memory :class:`TraceStore` (with a reserved slice for the slowest
+  requests, which are exactly the ones worth inspecting after the fact)
+  and are served as JSON from ``GET /v1/traces``. No collector required.
+- **Spans feed the metrics registry**: every finished child span is also
+  observed into the ``bci_stage_seconds{stage=...}`` histogram, so the
+  Prometheus view and the per-request trace view agree by construction.
+"""
+
+from __future__ import annotations
+
+import heapq
+import secrets
+import threading
+import time
+import uuid
+from collections import deque
+from contextlib import contextmanager
+from contextvars import ContextVar
+from dataclasses import dataclass, field
+
+TRACEPARENT_HEADER = "traceparent"
+REQUEST_ID_HEADER = "X-Request-Id"
+
+_current_trace: ContextVar["Trace | None"] = ContextVar("bci_trace", default=None)
+_current_span: ContextVar["Span | None"] = ContextVar("bci_span", default=None)
+
+
+def _new_trace_id() -> str:
+    return uuid.uuid4().hex  # 32 lowercase hex chars, W3C trace-id shaped
+
+
+def _new_span_id() -> str:
+    return secrets.token_hex(8)  # 16 lowercase hex chars
+
+
+def format_traceparent(trace_id: str, span_id: str) -> str:
+    """W3C trace-context header: version 00, sampled flag set."""
+    return f"00-{trace_id}-{span_id}-01"
+
+
+def parse_traceparent(header: str | None) -> tuple[str, str] | None:
+    """(trace_id, parent_span_id) from a ``traceparent`` header, or None for
+    anything malformed — a bad header from an arbitrary client must degrade
+    to "start a fresh trace", never to an error."""
+    if not header:
+        return None
+    parts = header.strip().split("-")
+    if len(parts) < 4:
+        return None
+    version, trace_id, span_id = parts[0], parts[1], parts[2]
+    if version == "ff" or len(version) != 2:
+        return None
+    if len(trace_id) != 32 or len(span_id) != 16:
+        return None
+    try:
+        int(version, 16), int(trace_id, 16), int(span_id, 16)
+    except ValueError:
+        return None
+    if trace_id == "0" * 32 or span_id == "0" * 16:
+        return None
+    return trace_id, span_id
+
+
+@dataclass
+class Span:
+    trace_id: str
+    span_id: str
+    parent_id: str | None
+    name: str
+    start_unix: float
+    start_mono: float
+    duration_s: float | None = None
+    status: str = "ok"
+    attributes: dict[str, str] = field(default_factory=dict)
+
+    @property
+    def duration_ms(self) -> float | None:
+        return None if self.duration_s is None else self.duration_s * 1000.0
+
+    def to_dict(self) -> dict:
+        return {
+            "trace_id": self.trace_id,
+            "span_id": self.span_id,
+            "parent_id": self.parent_id,
+            "name": self.name,
+            "start_unix": self.start_unix,
+            "duration_ms": self.duration_ms,
+            "status": self.status,
+            "attributes": dict(self.attributes),
+        }
+
+
+class Trace:
+    """One request's spans. Created by :meth:`Tracer.trace`; child spans
+    attach through the module-level :func:`span` via the ambient context."""
+
+    def __init__(
+        self,
+        tracer: "Tracer | None",
+        name: str,
+        trace_id: str | None = None,
+        parent_span_id: str | None = None,
+        request_id: str | None = None,
+    ) -> None:
+        self._tracer = tracer
+        self.trace_id = trace_id or _new_trace_id()
+        self.request_id = request_id
+        self.spans: list[Span] = []
+        self.root = self.start_span(name, parent_id=parent_span_id)
+
+    def start_span(
+        self, name: str, parent_id: str | None, attributes: dict | None = None
+    ) -> Span:
+        s = Span(
+            trace_id=self.trace_id,
+            span_id=_new_span_id(),
+            parent_id=parent_id,
+            name=name,
+            start_unix=time.time(),
+            start_mono=time.monotonic(),
+            attributes={k: str(v) for k, v in (attributes or {}).items()},
+        )
+        self.spans.append(s)
+        return s
+
+    def end_span(self, s: Span, status: str = "ok", error: str | None = None) -> None:
+        if s.duration_s is not None:
+            return  # already ended (error path raced the normal path)
+        s.duration_s = time.monotonic() - s.start_mono
+        s.status = status
+        if error is not None:
+            s.attributes["error"] = error
+        if self._tracer is not None:
+            self._tracer._on_span_end(self, s)
+
+    @property
+    def name(self) -> str:
+        return self.root.name
+
+    @property
+    def status(self) -> str:
+        return self.root.status
+
+    @property
+    def duration_s(self) -> float:
+        if self.root.duration_s is not None:
+            return self.root.duration_s
+        return time.monotonic() - self.root.start_mono
+
+    def stage_ms(self) -> dict[str, float]:
+        """stage name → total milliseconds across the trace's FINISHED child
+        spans. Repeated stages (per-worker uploads, retry attempts) sum —
+        for concurrent fan-outs that is aggregate stage time, which can
+        exceed the wall-clock the stage occupied."""
+        out: dict[str, float] = {}
+        for s in self.spans:
+            if s is self.root or s.duration_s is None:
+                continue
+            out[s.name] = out.get(s.name, 0.0) + s.duration_s * 1000.0
+        return out
+
+    def summary(self) -> dict:
+        return {
+            "trace_id": self.trace_id,
+            "name": self.name,
+            "request_id": self.request_id,
+            "start_unix": self.root.start_unix,
+            "duration_ms": self.duration_s * 1000.0,
+            "status": self.status,
+            "n_spans": len(self.spans),
+        }
+
+    def to_dict(self) -> dict:
+        return {
+            **self.summary(),
+            "stage_ms": self.stage_ms(),
+            "spans": [s.to_dict() for s in self.spans],
+        }
+
+
+class TraceStore:
+    """Bounded retention for finished traces: a FIFO ring of the most recent
+    ones plus a reserved slice that always keeps the slowest-N seen — the
+    requests an operator actually goes looking for are the outliers, and a
+    plain ring would have evicted them minutes ago under load."""
+
+    def __init__(self, max_traces: int = 256, slowest_keep: int = 32) -> None:
+        slowest_keep = max(0, min(slowest_keep, max_traces - 1))
+        self._recent: deque[Trace] = deque(maxlen=max(1, max_traces - slowest_keep))
+        self._slowest_keep = slowest_keep
+        # min-heap of (duration_s, seq, trace): the fastest of the kept-slow
+        # set sits at the top and is the one displaced by a slower arrival
+        self._slowest: list[tuple[float, int, Trace]] = []
+        self._seq = 0
+        self._lock = threading.Lock()
+
+    def add(self, trace: Trace) -> None:
+        with self._lock:
+            self._recent.append(trace)
+            if self._slowest_keep:
+                self._seq += 1
+                entry = (trace.duration_s, self._seq, trace)
+                if len(self._slowest) < self._slowest_keep:
+                    heapq.heappush(self._slowest, entry)
+                elif entry[0] > self._slowest[0][0]:
+                    heapq.heapreplace(self._slowest, entry)
+
+    def get(self, trace_id: str) -> Trace | None:
+        with self._lock:
+            for t in self._recent:
+                if t.trace_id == trace_id:
+                    return t
+            for _, _, t in self._slowest:
+                if t.trace_id == trace_id:
+                    return t
+        return None
+
+    def traces(self) -> list[Trace]:
+        """All retained traces (recent ∪ slowest, deduplicated), newest
+        first."""
+        with self._lock:
+            seen: dict[str, Trace] = {}
+            for t in list(self._recent) + [t for _, _, t in self._slowest]:
+                seen.setdefault(t.trace_id, t)
+        return sorted(
+            seen.values(), key=lambda t: t.root.start_unix, reverse=True
+        )
+
+    def __len__(self) -> int:
+        return len(self.traces())
+
+
+class Tracer:
+    """Trace factory bound to a :class:`TraceStore` and (optionally) the
+    metrics registry. One per process edge; the executors never see it —
+    they attach through the ambient context via :func:`span`."""
+
+    def __init__(self, store: TraceStore | None = None, metrics=None) -> None:
+        self.store = store or TraceStore()
+        self._stage_seconds = (
+            metrics.histogram(
+                "bci_stage_seconds",
+                "Per-request stage latency, from trace spans",
+            )
+            if metrics is not None
+            else None
+        )
+
+    def _on_span_end(self, trace: Trace, s: Span) -> None:
+        if self._stage_seconds is not None and s is not trace.root:
+            self._stage_seconds.observe(s.duration_s, stage=s.name)
+
+    @contextmanager
+    def trace(
+        self,
+        name: str,
+        trace_id: str | None = None,
+        parent_span_id: str | None = None,
+        request_id: str | None = None,
+    ):
+        """Root a new trace (or continue an inbound one when
+        ``trace_id``/``parent_span_id`` came off a ``traceparent`` header),
+        make it the ambient trace for the duration, and land it in the
+        store on exit — error or not; failed requests are the ones most
+        worth inspecting."""
+        t = Trace(
+            self,
+            name,
+            trace_id=trace_id,
+            parent_span_id=parent_span_id,
+            request_id=request_id,
+        )
+        trace_token = _current_trace.set(t)
+        span_token = _current_span.set(t.root)
+        try:
+            yield t
+        except BaseException as e:
+            t.end_span(t.root, status="error", error=repr(e))
+            raise
+        else:
+            t.end_span(t.root)
+        finally:
+            _current_span.reset(span_token)
+            _current_trace.reset(trace_token)
+            self.store.add(t)
+
+
+@contextmanager
+def span(name: str, **attributes):
+    """Child span under the ambient trace; a no-op (yields ``None``) when no
+    trace is active, so instrumented library code costs nothing off the
+    request path."""
+    trace = _current_trace.get()
+    if trace is None:
+        yield None
+        return
+    parent = _current_span.get()
+    s = trace.start_span(
+        name, parent.span_id if parent is not None else None, attributes
+    )
+    token = _current_span.set(s)
+    try:
+        yield s
+    except BaseException as e:
+        trace.end_span(s, status="error", error=repr(e))
+        raise
+    else:
+        trace.end_span(s)
+    finally:
+        _current_span.reset(token)
+
+
+def current_trace() -> Trace | None:
+    return _current_trace.get()
+
+
+def current_span() -> Span | None:
+    return _current_span.get()
+
+
+def current_ids() -> tuple[str, str]:
+    """(trace_id, span_id) of the ambient span, or ("-", "-") — the logging
+    filter's read, shaped to never raise."""
+    s = _current_span.get()
+    if s is None:
+        return "-", "-"
+    return s.trace_id, s.span_id
+
+
+def outbound_headers() -> dict[str, str]:
+    """Headers propagating the ambient context to a sandbox: ``traceparent``
+    (when a trace is active) and ``X-Request-Id`` (whenever one is set —
+    request-id correlation must survive even with tracing off)."""
+    headers: dict[str, str] = {}
+    trace = _current_trace.get()
+    request_id = None
+    if trace is not None:
+        s = _current_span.get() or trace.root
+        headers[TRACEPARENT_HEADER] = format_traceparent(trace.trace_id, s.span_id)
+        request_id = trace.request_id
+    if request_id is None:
+        # lazy import: utils.request_id imports this module for the logging
+        # filter, so the reverse edge must not exist at import time
+        from bee_code_interpreter_tpu.utils.request_id import (
+            request_id_context_var,
+        )
+
+        rid = request_id_context_var.get()
+        request_id = rid if rid != "-" else None
+    if request_id:
+        headers[REQUEST_ID_HEADER] = request_id
+    return headers

@@ -23,6 +23,8 @@ import asyncio
 from collections import deque
 from contextlib import asynccontextmanager
 
+from bee_code_interpreter_tpu.observability import span as trace_span
+
 
 class AdmissionRejected(Exception):
     def __init__(self, reason: str, retry_after_s: float) -> None:
@@ -81,7 +83,11 @@ class AdmissionController:
 
     @asynccontextmanager
     async def admit(self, deadline=None):
-        await self._acquire(deadline)
+        # The trace stage span covers ONLY the acquire (the queue wait a
+        # slow request may have paid); the admitted body's time belongs to
+        # its own stages. One instrumentation site serves every edge.
+        with trace_span("admission"):
+            await self._acquire(deadline)
         try:
             yield
         finally:

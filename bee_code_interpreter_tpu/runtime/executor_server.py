@@ -19,22 +19,72 @@ entrypoint where the C++ binary isn't built. Run:
 
 Env: APP_LISTEN_ADDR (default 0.0.0.0:8000), APP_WORKSPACE (default
 /workspace), APP_REQUIREMENTS / APP_REQUIREMENTS_SKIP (preinstalled-set files,
-reference server.rs:198-201), APP_DISABLE_DEP_INSTALL, APP_SHIM_DIR.
+reference server.rs:198-201), APP_DISABLE_DEP_INSTALL, APP_SHIM_DIR,
+APP_LOG_FORMAT (``json`` for structured one-line records).
+
+Observability (docs/observability.md): the control plane sends a W3C
+``traceparent`` plus ``X-Request-Id`` on every data-plane call; this server
+adopts both — the request id lands on every pod-side log record, the trace
+continues under the same trace_id (server-side spans retained in a small
+local store), and the id is echoed back in the response headers.
 """
 
 from __future__ import annotations
 
 import asyncio
+import logging
 import os
 
 from aiohttp import web
 
+from bee_code_interpreter_tpu.observability import (
+    REQUEST_ID_HEADER,
+    JsonLogFormatter,
+    Tracer,
+    TraceStore,
+    parse_traceparent,
+)
 from bee_code_interpreter_tpu.runtime.dep_guess import load_requirements_set
 from bee_code_interpreter_tpu.runtime.executor_core import ExecutorCore
+from bee_code_interpreter_tpu.utils.request_id import (
+    RequestIdLoggingFilter,
+    request_id_context_var,
+)
+
+logger = logging.getLogger(__name__)
 
 
-def create_app(core: ExecutorCore) -> web.Application:
+def create_app(core: ExecutorCore, tracer: Tracer | None = None) -> web.Application:
     app = web.Application(client_max_size=1 << 30)
+    # Pod-local retention only: the edge's store is the one an operator
+    # queries; this one exists so in-pod spans/logs still correlate when a
+    # pod is inspected directly.
+    tracer = tracer or Tracer(store=TraceStore(max_traces=64, slowest_keep=8))
+
+    @web.middleware
+    async def trace_context_middleware(request: web.Request, handler):
+        rid = request.headers.get(REQUEST_ID_HEADER)
+        if rid:
+            # Adopt the edge's id: every log record this request produces
+            # (dep install, subprocess failures) correlates with the edge.
+            request_id_context_var.set(rid)
+        ctx = parse_traceparent(request.headers.get("traceparent"))
+        if ctx is not None:
+            trace_id, parent_span_id = ctx
+            with tracer.trace(
+                f"executor:{request.path}",
+                trace_id=trace_id,
+                parent_span_id=parent_span_id,
+                request_id=rid,
+            ):
+                response = await handler(request)
+        else:
+            response = await handler(request)
+        if rid:
+            response.headers.setdefault(REQUEST_ID_HEADER, rid)
+        return response
+
+    app.middlewares.append(trace_context_middleware)
 
     async def upload_file(request: web.Request) -> web.Response:
         try:
@@ -60,11 +110,13 @@ def create_app(core: ExecutorCore) -> web.Application:
         body = await request.json()
         loop = asyncio.get_running_loop()
         t0 = loop.time()
+        logger.info("Executing sandboxed code (%d bytes)", len(body["source_code"]))
         outcome = await core.execute(
             source_code=body["source_code"],
             env=body.get("env") or {},
             timeout_s=body.get("timeout"),
         )
+        logger.info("Sandboxed execution finished: exit_code=%s", outcome.exit_code)
         return web.json_response(
             {
                 "stdout": outcome.stdout,
@@ -100,7 +152,28 @@ def core_from_env() -> ExecutorCore:
     )
 
 
+def setup_logging() -> None:
+    """Pod-side logging: request-id/trace-id on every record via the shared
+    filter; APP_LOG_FORMAT=json matches the control plane's structured
+    schema so both sides of a trace parse with the same pipeline."""
+    handler = logging.StreamHandler()
+    if os.environ.get("APP_LOG_FORMAT", "").lower() == "json":
+        handler.setFormatter(JsonLogFormatter())
+    else:
+        handler.setFormatter(
+            logging.Formatter(
+                "%(asctime)s [%(levelname)s] [%(request_id)s] "
+                "[%(trace_id)s] %(name)s: %(message)s"
+            )
+        )
+    handler.addFilter(RequestIdLoggingFilter())
+    root = logging.getLogger()
+    root.handlers = [handler]
+    root.setLevel(logging.INFO)
+
+
 def main() -> None:
+    setup_logging()
     core = core_from_env()
     listen = os.environ.get("APP_LISTEN_ADDR", "0.0.0.0:8000")
     host, _, port = listen.rpartition(":")

@@ -17,6 +17,12 @@ Resilience semantics (docs/resilience.md):
   the deadline's remaining budget, never an independent fixed number.
 - A backend may set ``self._http_breaker``; each call is then gated and its
   outcome recorded, with fatal (4xx) responses counting as breaker successes.
+
+Observability (docs/observability.md): each call runs under a trace stage
+span (``upload``/``execute``/``download``), and every request carries the
+W3C ``traceparent`` plus ``X-Request-Id`` headers so the executor server
+continues the same trace inside the pod and its logs correlate back to the
+edge request.
 """
 
 from __future__ import annotations
@@ -26,6 +32,7 @@ from contextlib import nullcontext
 import httpx
 
 from bee_code_interpreter_tpu.config import Config
+from bee_code_interpreter_tpu.observability import outbound_headers, span
 from bee_code_interpreter_tpu.resilience import (
     CircuitBreaker,
     Deadline,
@@ -72,37 +79,47 @@ class ExecutorHttpDriver:
 
         what = f"file upload to {addr}"
         kwargs = self._deadline_kwargs(deadline, what)
-        async with self._data_plane_guard():
-            try:
-                response = await self._http.put(
-                    self._sandbox_url(addr, path), content=body(), **kwargs
-                )
-            except httpx.TimeoutException as e:
-                raise SandboxTransientError(f"{what} timed out: {e}") from e
-            except httpx.TransportError as e:
-                raise SandboxTransientError(f"{what} failed: {e}") from e
-            if response.status_code >= 300:
-                raise classify_http_status(response.status_code, what)
+        with span("upload", addr=addr, path=path):
+            async with self._data_plane_guard():
+                try:
+                    response = await self._http.put(
+                        self._sandbox_url(addr, path),
+                        content=body(),
+                        headers=outbound_headers(),
+                        **kwargs,
+                    )
+                except httpx.TimeoutException as e:
+                    raise SandboxTransientError(f"{what} timed out: {e}") from e
+                except httpx.TransportError as e:
+                    raise SandboxTransientError(f"{what} failed: {e}") from e
+                if response.status_code >= 300:
+                    raise classify_http_status(response.status_code, what)
 
     async def _download_file(
         self, addr: str, path: str, deadline: Deadline | None = None
     ) -> Hash:
         what = f"file download from {addr}"
         kwargs = self._deadline_kwargs(deadline, what)
-        async with self._data_plane_guard():
-            try:
-                async with self._storage.writer() as writer:
-                    async with self._http.stream(
-                        "GET", self._sandbox_url(addr, path), **kwargs
-                    ) as response:
-                        if response.status_code >= 300:
-                            raise classify_http_status(response.status_code, what)
-                        async for chunk in response.aiter_bytes():
-                            await writer.write(chunk)
-            except httpx.TimeoutException as e:
-                raise SandboxTransientError(f"{what} timed out: {e}") from e
-            except httpx.TransportError as e:
-                raise SandboxTransientError(f"{what} failed: {e}") from e
+        with span("download", addr=addr, path=path):
+            async with self._data_plane_guard():
+                try:
+                    async with self._storage.writer() as writer:
+                        async with self._http.stream(
+                            "GET",
+                            self._sandbox_url(addr, path),
+                            headers=outbound_headers(),
+                            **kwargs,
+                        ) as response:
+                            if response.status_code >= 300:
+                                raise classify_http_status(
+                                    response.status_code, what
+                                )
+                            async for chunk in response.aiter_bytes():
+                                await writer.write(chunk)
+                except httpx.TimeoutException as e:
+                    raise SandboxTransientError(f"{what} timed out: {e}") from e
+                except httpx.TransportError as e:
+                    raise SandboxTransientError(f"{what} failed: {e}") from e
         return writer.hash
 
     def _effective_timeout(self, timeout_s: float | None) -> float:
@@ -139,21 +156,27 @@ class ExecutorHttpDriver:
             kwargs["timeout"] = deadline.clamp(
                 kwargs.get("timeout", self._config.executor_http_timeout_s)
             )
-        async with self._data_plane_guard():
-            try:
-                response = await self._http.post(
-                    f"http://{addr}/execute",
-                    json={"source_code": source_code, "env": env, "timeout": timeout_s},
-                    **kwargs,
-                )
-            except httpx.TimeoutException as e:
-                raise SandboxTransientError(f"{what} timed out: {e}") from e
-            except httpx.TransportError as e:
-                raise SandboxTransientError(f"{what} failed: {e}") from e
-            if response.status_code != 200:
-                raise classify_http_status(
-                    response.status_code, f"{what} ({response.text[:200]})"
-                )
+        with span("execute", addr=addr):
+            async with self._data_plane_guard():
+                try:
+                    response = await self._http.post(
+                        f"http://{addr}/execute",
+                        json={
+                            "source_code": source_code,
+                            "env": env,
+                            "timeout": timeout_s,
+                        },
+                        headers=outbound_headers(),
+                        **kwargs,
+                    )
+                except httpx.TimeoutException as e:
+                    raise SandboxTransientError(f"{what} timed out: {e}") from e
+                except httpx.TransportError as e:
+                    raise SandboxTransientError(f"{what} failed: {e}") from e
+                if response.status_code != 200:
+                    raise classify_http_status(
+                        response.status_code, f"{what} ({response.text[:200]})"
+                    )
         return response.json()
 
     def _sandbox_url(self, addr: str, logical_path: str) -> str:

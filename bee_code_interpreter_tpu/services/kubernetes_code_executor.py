@@ -52,6 +52,7 @@ from dataclasses import dataclass
 import httpx
 
 from bee_code_interpreter_tpu.config import Config
+from bee_code_interpreter_tpu.observability import span
 from bee_code_interpreter_tpu.resilience import (
     BreakerState,
     CircuitBreaker,
@@ -307,13 +308,19 @@ class KubernetesCodeExecutor(ExecutorHttpDriver):
         """Request-path spawn: breaker-gated and deadline-bounded. A hang or
         failure anywhere in the spawn (create, IP wait, readiness) counts
         against the breaker; while OPEN the caller gets BreakerOpenError
-        immediately, which the service layer can turn into local fallback."""
-        async with self.spawn_breaker.guard():
-            if deadline is None:
-                return await self.spawn_pod_group()
-            return await deadline.run(
-                self.spawn_pod_group(deadline=deadline), what="pod group spawn"
-            )
+        immediately, which the service layer can turn into local fallback.
+
+        The ``spawn`` stage span covers the breaker check too (its state is
+        recorded as a span attribute), so a trace shows whether the request
+        paid a real cold spawn or was rejected at the gate."""
+        with span("spawn", breaker=self.spawn_breaker.state.name.lower()):
+            async with self.spawn_breaker.guard():
+                if deadline is None:
+                    return await self.spawn_pod_group()
+                return await deadline.run(
+                    self.spawn_pod_group(deadline=deadline),
+                    what="pod group spawn",
+                )
 
     async def _group_healthy(self, group: PodGroup) -> bool:
         """Every worker answers /healthz (sub-second; runs on the pod network)."""

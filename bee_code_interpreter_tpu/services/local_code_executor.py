@@ -17,6 +17,7 @@ import secrets
 import shutil
 from pathlib import Path
 
+from bee_code_interpreter_tpu.observability import span
 from bee_code_interpreter_tpu.resilience import Deadline
 from bee_code_interpreter_tpu.runtime.executor_core import ExecutorCore
 from bee_code_interpreter_tpu.services.code_executor import Result
@@ -95,28 +96,33 @@ class LocalCodeExecutor:
         )
         try:
             # Restore the client's workspace snapshot (reference
-            # kubernetes_code_executor.py:100-113, via HTTP PUT; here direct I/O).
-            for logical_path, object_id in files.items():
-                real = core.resolve(logical_path)
-                real.parent.mkdir(parents=True, exist_ok=True)
-                with open(real, "wb") as f:
-                    async with self._storage.reader(object_id) as r:
-                        async for chunk in r:
-                            f.write(chunk)
+            # kubernetes_code_executor.py:100-113, via HTTP PUT; here direct
+            # I/O). Stage spans: restore/execute/snapshot are this backend's
+            # analogue of the pod path's upload/execute/download.
+            with span("restore", files=str(len(files))):
+                for logical_path, object_id in files.items():
+                    real = core.resolve(logical_path)
+                    real.parent.mkdir(parents=True, exist_ok=True)
+                    with open(real, "wb") as f:
+                        async with self._storage.reader(object_id) as r:
+                            async for chunk in r:
+                                f.write(chunk)
 
-            outcome = await core.execute(
-                source_code, env=env, timeout_s=self._clamp_timeout(timeout_s)
-            )
+            with span("execute"):
+                outcome = await core.execute(
+                    source_code, env=env, timeout_s=self._clamp_timeout(timeout_s)
+                )
 
             # Snapshot changed files back (reference :126-142).
             out_files: dict[str, str] = {}
-            for logical_path in outcome.files:
-                real = core.resolve(logical_path)
-                async with self._storage.writer() as w:
-                    with open(real, "rb") as f:
-                        while chunk := f.read(1 << 20):
-                            await w.write(chunk)
-                out_files[logical_path] = w.hash
+            with span("snapshot", files=str(len(outcome.files))):
+                for logical_path in outcome.files:
+                    real = core.resolve(logical_path)
+                    async with self._storage.writer() as w:
+                        with open(real, "rb") as f:
+                            while chunk := f.read(1 << 20):
+                                await w.write(chunk)
+                    out_files[logical_path] = w.hash
             return Result(
                 stdout=outcome.stdout,
                 stderr=outcome.stderr,

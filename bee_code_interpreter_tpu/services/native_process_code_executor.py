@@ -36,6 +36,7 @@ from pathlib import Path
 import httpx
 
 from bee_code_interpreter_tpu.config import Config
+from bee_code_interpreter_tpu.observability import span
 from bee_code_interpreter_tpu.resilience import (
     Deadline,
     RetryPolicy,
@@ -303,10 +304,13 @@ class NativeProcessCodeExecutor(ExecutorHttpDriver):
             # preload-done — the server queues the execute until its warm
             # worker is ready (or falls back cold), so the request overlaps
             # with the tail of the preload rather than waiting it out here.
-            spawn = self.spawn_sandbox(wait_warm=False)
-            box = await (
-                deadline.run(spawn, what="sandbox spawn") if deadline else spawn
-            )
+            with span("spawn"):
+                spawn = self.spawn_sandbox(wait_warm=False)
+                box = await (
+                    deadline.run(spawn, what="sandbox spawn")
+                    if deadline
+                    else spawn
+                )
         self._spawn_background(self.fill_sandbox_queue())
         try:
             yield box

@@ -16,6 +16,17 @@ from typing import Callable, Iterable
 # TPU pod spawn (~60s, reference kubernetes_code_executor.py:239-241).
 DEFAULT_BUCKETS = (0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0)
 
+# Token-cadence buckets (seconds) for the serving engine: TTFT and
+# inter-token latency live in the 1ms-10s decade, far below request buckets.
+TOKEN_LATENCY_BUCKETS = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0,
+    10.0,
+)
+
+# The Prometheus text exposition format scrapers negotiate on; a bare
+# ``text/plain`` makes version-aware scrapers fall back to heuristics.
+PROMETHEUS_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
 
 def _escape(value: str) -> str:
     # Prometheus exposition label-value escaping: backslash, quote, newline.
@@ -137,28 +148,44 @@ class Registry:
     def __init__(self) -> None:
         self._metrics: dict[str, Counter | Gauge | Histogram] = {}
 
-    def _get_or_create(self, name: str, factory):
+    @property
+    def metrics(self) -> dict[str, "Counter | Gauge | Histogram"]:
+        """Read-only view of registered metrics by name (conventions lint,
+        introspection)."""
+        return dict(self._metrics)
+
+    def _get_or_create(self, name: str, kind: type, factory):
         existing = self._metrics.get(name)
         if existing is not None:
+            if not isinstance(existing, kind):
+                # Same name, different type: the exposition would emit one
+                # block with the wrong TYPE for half its users — a silent
+                # data bug. Fail at registration, where the blame is local.
+                raise ValueError(
+                    f"metric {name!r} already registered as "
+                    f"{type(existing).__name__}, requested {kind.__name__}"
+                )
             return existing
         m = factory()
         self._metrics[name] = m
         return m
 
     def counter(self, name: str, help_text: str) -> Counter:
-        return self._get_or_create(name, lambda: Counter(name, help_text))
+        return self._get_or_create(name, Counter, lambda: Counter(name, help_text))
 
     def gauge(
         self, name: str, help_text: str, fn: Callable[[], float], **labels: str
     ) -> Gauge:
-        m = self._get_or_create(name, lambda: Gauge(name, help_text))
+        m = self._get_or_create(name, Gauge, lambda: Gauge(name, help_text))
         m.set_fn(fn, **labels)
         return m
 
     def histogram(
         self, name: str, help_text: str, buckets: tuple[float, ...] = DEFAULT_BUCKETS
     ) -> Histogram:
-        return self._get_or_create(name, lambda: Histogram(name, help_text, buckets))
+        return self._get_or_create(
+            name, Histogram, lambda: Histogram(name, help_text, buckets)
+        )
 
     def expose(self) -> str:
         lines: list[str] = []

@@ -1,10 +1,15 @@
-"""Per-request correlation id, injected into every log line.
+"""Per-request correlation ids, injected into every log line.
 
 Reference pattern: a ContextVar set at request entry (http_server.py:84-87,
 code_interpreter_servicer.py:60) read by a logging filter installed on every
 handler (application_context.py:40-53). Propagated onward to the sandbox via
-the ``X-Request-Id`` header so pod-side logs correlate too (SURVEY.md §5
-"Tracing / profiling").
+the ``X-Request-Id`` header (services/executor_http_driver.py sends it on
+upload/execute/download; runtime/executor_server.py adopts and echoes it) so
+pod-side logs correlate too (SURVEY.md §5 "Tracing / profiling").
+
+The same filter also stamps ``trace_id``/``span_id`` from the ambient trace
+context (observability/tracing.py), so text and JSON log formats can both
+join edge- and pod-side lines on the trace.
 """
 
 from __future__ import annotations
@@ -12,6 +17,8 @@ from __future__ import annotations
 import logging
 import uuid
 from contextvars import ContextVar
+
+from bee_code_interpreter_tpu.observability.tracing import current_ids
 
 request_id_context_var: ContextVar[str] = ContextVar("request_id", default="-")
 
@@ -25,6 +32,7 @@ def new_request_id() -> str:
 class RequestIdLoggingFilter(logging.Filter):
     def filter(self, record: logging.LogRecord) -> bool:
         record.request_id = request_id_context_var.get()
+        record.trace_id, record.span_id = current_ids()
         return True
 
 

@@ -2,7 +2,11 @@
 """Measure /v1/execute latency percentiles (BASELINE.md north-star #3).
 
 Drives the trivial health-check payload (``print(21 * 2)``) through two
-execution backends and reports p50/p90:
+execution backends and reports p50/p95/p99 PER STAGE (spawn/upload/execute/
+download on the warm path; restore/execute/snapshot on the cold path) from
+the tracing subsystem's per-request stage spans (docs/observability.md) —
+a latency regression is attributed to the stage that moved, not guessed at
+from a single end-to-end number.
 
 - **warm**: NativeProcessCodeExecutor — warm pool of C++ sandbox servers, the
   TPU-native analogue of the reference's warm pod queue
@@ -29,13 +33,37 @@ sys.path.insert(0, str(REPO))
 
 PAYLOAD = "print(21 * 2)"
 
+# Stage display order; stages a backend never produced are omitted.
+STAGE_ORDER = (
+    "spawn", "restore", "upload", "execute", "snapshot", "download",
+)
+
 
 def pct(samples: list[float], q: float) -> float:
     return statistics.quantiles(samples, n=100)[int(q) - 1]
 
 
-async def bench_warm(n: int) -> list[float]:
+def report_stages(name: str, stages: list[dict[str, float]],
+                  totals_ms: list[float]) -> None:
+    """p50/p95/p99 per stage (milliseconds). A request that skipped a stage
+    (warm pop → no spawn; no files → no upload/download) contributes 0 to
+    that stage, so the percentiles describe what clients actually pay."""
+    seen = [s for s in STAGE_ORDER if any(s in d for d in stages)]
+    print(f"{name}: n={len(totals_ms)}  (stage ms, then total)")
+    for stage in [*seen, "total"]:
+        vals = (
+            totals_ms if stage == "total"
+            else [float(d.get(stage, 0.0)) for d in stages]
+        )
+        print(
+            f"  {stage:>9}: p50={pct(vals, 50):8.1f}  "
+            f"p95={pct(vals, 95):8.1f}  p99={pct(vals, 99):8.1f}"
+        )
+
+
+async def bench_warm(n: int) -> tuple[list[dict], list[float]]:
     from bee_code_interpreter_tpu.config import Config
+    from bee_code_interpreter_tpu.observability import Tracer
     from bee_code_interpreter_tpu.services.native_process_code_executor import (
         NativeProcessCodeExecutor,
     )
@@ -53,9 +81,11 @@ async def bench_warm(n: int) -> list[float]:
         config=config,
         binary=REPO / "executor" / "build" / "executor-server",
     )
+    tracer = Tracer()
     try:
         await executor.fill_sandbox_queue()
-        samples = []
+        stages: list[dict] = []
+        totals: list[float] = []
         phases: list[dict] = []
         for i in range(n):
             if i:
@@ -63,10 +93,15 @@ async def bench_warm(n: int) -> list[float]:
                 # refill pipeline room so pops hit preload-complete sandboxes
                 await asyncio.sleep(0.35)
             t0 = time.perf_counter()
-            r = await executor.execute(PAYLOAD)
+            with tracer.trace("measure-latency") as t:
+                r = await executor.execute(PAYLOAD)
             assert r.stdout == "42\n", r.stderr
-            samples.append(time.perf_counter() - t0)
+            totals.append((time.perf_counter() - t0) * 1000)
+            stages.append(t.stage_ms())
             phases.append(dict(executor.last_execute_phases))
+        # the native backend's own internal phase probe, complementary to
+        # the trace stages (it sees inside the HTTP call: sandbox vs
+        # control-plane overhead)
         keys = ("acquire_ms", "upload_ms", "post_execute_ms", "sandbox_ms",
                 "overhead_ms", "download_ms")
         for q in (50, 90):
@@ -78,12 +113,13 @@ async def bench_warm(n: int) -> list[float]:
                 f"warm phases p{q}: "
                 + "  ".join(f"{k}={v:.1f}" for k, v in row.items())
             )
-        return samples
+        return stages, totals
     finally:
         executor.shutdown()
 
 
-async def bench_cold(n: int) -> list[float]:
+async def bench_cold(n: int) -> tuple[list[dict], list[float]]:
+    from bee_code_interpreter_tpu.observability import Tracer
     from bee_code_interpreter_tpu.services.local_code_executor import (
         LocalCodeExecutor,
     )
@@ -95,13 +131,17 @@ async def bench_cold(n: int) -> list[float]:
         workspace_root=tmp / "ws",
         disable_dep_install=True,
     )
-    samples = []
+    tracer = Tracer()
+    stages: list[dict] = []
+    totals: list[float] = []
     for _ in range(n):
         t0 = time.perf_counter()
-        r = await executor.execute(PAYLOAD)
+        with tracer.trace("measure-latency") as t:
+            r = await executor.execute(PAYLOAD)
         assert r.stdout == "42\n", r.stderr
-        samples.append(time.perf_counter() - t0)
-    return samples
+        totals.append((time.perf_counter() - t0) * 1000)
+        stages.append(t.stage_ms())
+    return stages, totals
 
 
 def main() -> None:
@@ -110,11 +150,8 @@ def main() -> None:
 
     subprocess.run(["make", "-C", str(REPO / "executor"), "-s"], check=True)
     for name, fn in (("warm", bench_warm), ("cold", bench_cold)):
-        s = asyncio.run(fn(n))
-        print(
-            f"{name}: n={n} p50={pct(s, 50) * 1000:.1f}ms "
-            f"p90={pct(s, 90) * 1000:.1f}ms min={min(s) * 1000:.1f}ms"
-        )
+        stages, totals = asyncio.run(fn(n))
+        report_stages(name, stages, totals)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,106 @@
+"""Metric-naming conventions lint (tier-1): every metric the service can
+register must carry the ``bci_`` namespace prefix, non-empty HELP text, and
+unit-suffixed names where the type implies a unit (counters ``_total``,
+histograms ``_seconds``/``_bytes``). The registry itself must refuse a name
+re-registered as a different metric type — the duplicate-registration bug
+class where two components silently share one exposition block with the
+wrong TYPE line."""
+
+import pytest
+
+from bee_code_interpreter_tpu.utils.metrics import (
+    Counter,
+    Gauge,
+    Histogram,
+    Registry,
+)
+
+
+def build_service_registry(tmp_path) -> Registry:
+    """Assemble the registry the way the composition root does — kubernetes
+    backend with local fallback, both transports, admission, tracing — so
+    the lint sees every metric the service can register."""
+    from bee_code_interpreter_tpu.application_context import ApplicationContext
+    from bee_code_interpreter_tpu.config import Config
+
+    ctx = ApplicationContext(
+        Config(
+            executor_backend="kubernetes",
+            fallback_to_local=True,
+            file_storage_path=str(tmp_path / "objects"),
+            local_workspace_root=str(tmp_path / "ws"),
+            disable_dep_install=True,
+        )
+    )
+    _ = ctx.code_executor  # registers executor, breaker, pool, fallback
+    _ = ctx.admission
+    _ = ctx.http_server
+    _ = ctx.grpc_server
+    return ctx.metrics
+
+
+def register_serving_metrics(registry: Registry) -> None:
+    """The models-layer registrations (batcher + engine), on a tiny CPU
+    config — construction registers everything; no decode needed."""
+    import jax
+
+    from bee_code_interpreter_tpu.models import transformer as T
+    from bee_code_interpreter_tpu.models.engine import Engine
+    from bee_code_interpreter_tpu.models.serving import ContinuousBatcher
+
+    config = T.TransformerConfig.tiny()
+    params = T.init_params(config, jax.random.PRNGKey(0))
+    batcher = ContinuousBatcher(
+        params, config, max_batch=2, n_pages=8, page_size=4,
+        max_pages_per_seq=2, metrics=registry,
+    )
+    Engine(batcher, metrics=registry)
+
+
+def test_every_registered_metric_follows_conventions(tmp_path):
+    registry = build_service_registry(tmp_path)
+    register_serving_metrics(registry)
+    metrics = registry.metrics
+    assert len(metrics) >= 20, sorted(metrics)  # the wiring actually ran
+
+    for name, metric in metrics.items():
+        assert name.startswith("bci_"), (
+            f"{name}: metrics must live in the bci_ namespace"
+        )
+        assert metric.help and metric.help.strip(), (
+            f"{name}: HELP text must be non-empty"
+        )
+        if isinstance(metric, Counter):
+            assert name.endswith("_total"), (
+                f"{name}: counters must end in _total"
+            )
+        elif isinstance(metric, Histogram):
+            assert name.endswith(("_seconds", "_bytes")), (
+                f"{name}: histograms must be unit-suffixed "
+                "(_seconds or _bytes)"
+            )
+        else:
+            assert isinstance(metric, Gauge), f"{name}: unknown metric type"
+            # gauges describe states/counts; they must not masquerade as
+            # counters or timers
+            assert not name.endswith(("_total", "_seconds")), (
+                f"{name}: gauge misusing a counter/histogram unit suffix"
+            )
+
+    # the full exposition renders without error and every metric appears once
+    text = registry.expose()
+    for name in metrics:
+        assert text.count(f"# HELP {name} ") == 1, (
+            f"{name}: duplicate or missing exposition block"
+        )
+
+
+def test_registry_rejects_type_conflicting_reregistration():
+    registry = Registry()
+    registry.counter("bci_things_total", "things")
+    with pytest.raises(ValueError, match="already registered"):
+        registry.histogram("bci_things_total", "things, but a histogram")
+    with pytest.raises(ValueError, match="already registered"):
+        registry.gauge("bci_things_total", "things, but a gauge", lambda: 0)
+    # same name, same type remains a shared object, not an error
+    assert registry.counter("bci_things_total", "things") is not None

@@ -1,0 +1,284 @@
+"""End-to-end observability through the fake-Kubernetes path (ISSUE 2
+acceptance): one Execute yields ONE trace — admission→spawn→upload→execute→
+download under a single trace_id — retrievable at /v1/traces/{trace_id},
+with the same id in the pod-side (fake executor) log records and in the
+response's timing breakdown, and stage durations consistent with the
+end-to-end Prometheus histogram."""
+
+import asyncio
+import logging
+import re
+
+from aiohttp.test_utils import TestClient, TestServer
+
+from bee_code_interpreter_tpu.api.http_server import create_http_server
+from bee_code_interpreter_tpu.config import Config
+from bee_code_interpreter_tpu.observability import Tracer, format_traceparent
+from bee_code_interpreter_tpu.resilience import AdmissionController
+from bee_code_interpreter_tpu.services.custom_tool_executor import (
+    CustomToolExecutor,
+)
+from bee_code_interpreter_tpu.services.kubernetes_code_executor import (
+    KubernetesCodeExecutor,
+)
+from bee_code_interpreter_tpu.utils.metrics import (
+    PROMETHEUS_CONTENT_TYPE,
+    Registry,
+)
+from bee_code_interpreter_tpu.utils.request_id import RequestIdLoggingFilter
+from tests.fakes import FakeExecutorPods, FakeKubectl
+
+POD_LOGGER = "bee_code_interpreter_tpu.runtime.executor_server"
+EDGE_LOGGER = "bee_code_interpreter_tpu.api.http_server"
+
+
+def make_app(pods, storage, metrics, tracer):
+    config = Config(
+        executor_backend="kubernetes",
+        executor_port=pods.port,
+        executor_pod_queue_target_length=0,  # every request spawns on demand
+        pod_ready_timeout_s=5,
+    )
+    executor = KubernetesCodeExecutor(
+        kubectl=FakeKubectl(pods),
+        storage=storage,
+        config=config,
+        metrics=metrics,
+        ip_poll_interval_s=0.02,
+    )
+    return create_http_server(
+        code_executor=executor,
+        custom_tool_executor=CustomToolExecutor(code_executor=executor),
+        metrics=metrics,
+        admission=AdmissionController(metrics=metrics),
+        tracer=tracer,
+    )
+
+
+async def with_client(app, fn):
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    try:
+        return await fn(client)
+    finally:
+        await client.close()
+
+
+def _histogram_sum(text: str, name: str, route: str) -> float:
+    pattern = re.compile(
+        rf'^{name}_sum{{route="{re.escape(route)}"}} ([0-9.e+-]+)$', re.M
+    )
+    m = pattern.search(text)
+    return float(m.group(1)) if m else 0.0
+
+
+async def test_single_execute_yields_one_complete_trace(
+    tmp_path, storage, caplog
+):
+    pods = FakeExecutorPods(tmp_path / "pods")
+    metrics = Registry()
+    tracer = Tracer(metrics=metrics)
+    app = make_app(pods, storage, metrics, tracer)
+    pod_logger = logging.getLogger(POD_LOGGER)
+    log_filter = RequestIdLoggingFilter()
+    pod_logger.addFilter(log_filter)
+
+    async def go(client: TestClient):
+        # request 1 creates a file so request 2 exercises BOTH upload (files
+        # in) and download (changed files out)
+        r1 = await (
+            await client.post(
+                "/v1/execute",
+                json={"source_code": "open('state.txt', 'w').write('x')"},
+            )
+        ).json()
+        assert set(r1["files"]) == {"/workspace/state.txt"}
+
+        before = _histogram_sum(
+            await (await client.get("/metrics")).text(),
+            "bci_http_request_seconds",
+            "/v1/execute",
+        )
+        caplog.clear()
+        with caplog.at_level(logging.INFO, logger=POD_LOGGER):
+            resp = await client.post(
+                "/v1/execute",
+                json={
+                    # sleep makes the execute stage dominate, so the
+                    # stage-sum-vs-histogram bound below is not noise-bound
+                    "source_code": (
+                        "import time; time.sleep(0.2)\n"
+                        "print(open('state.txt').read())\n"
+                        "open('out.txt', 'w').write('y')"
+                    ),
+                    "files": r1["files"],
+                },
+            )
+        body = await resp.json()
+        assert resp.status == 200
+        assert body["stdout"] == "x\n"
+
+        # --- response carries the trace id + per-stage breakdown ---
+        trace_id = body["trace_id"]
+        assert trace_id and len(trace_id) == 32
+        timings = body["timings_ms"]
+        assert {"admission", "spawn", "upload", "execute", "download"} <= set(
+            timings
+        )
+        assert timings["execute"] >= 200.0  # the sleep is visible
+
+        # --- the same trace is retrievable from the inspection API ---
+        listed = await (await client.get("/v1/traces")).json()
+        assert trace_id in {t["trace_id"] for t in listed["traces"]}
+        detail = await (await client.get(f"/v1/traces/{trace_id}")).json()
+        assert detail["trace_id"] == trace_id
+        assert detail["name"] == "/v1/execute"
+        names = {s["name"] for s in detail["spans"]}
+        assert {
+            "/v1/execute", "admission", "spawn", "upload", "execute",
+            "download",
+        } <= names
+        # one trace: every span under the single trace_id
+        assert {s["trace_id"] for s in detail["spans"]} == {trace_id}
+        missing = await client.get("/v1/traces/" + "deadbeef" * 4)
+        assert missing.status == 404
+
+        # --- stage durations agree with the end-to-end histogram ---
+        after = _histogram_sum(
+            await (await client.get("/metrics")).text(),
+            "bci_http_request_seconds",
+            "/v1/execute",
+        )
+        end_to_end_ms = (after - before) * 1000.0
+        stage_sum_ms = sum(
+            timings[k]
+            for k in ("admission", "spawn", "upload", "execute", "download")
+        )
+        assert stage_sum_ms <= end_to_end_ms * 1.001
+        assert stage_sum_ms >= end_to_end_ms * 0.9
+
+        # --- the pod-side executor logs carry the SAME correlation ids ---
+        rid = resp.headers["X-Request-Id"]
+        pod_records = [
+            r for r in caplog.records if r.name == POD_LOGGER
+        ]
+        assert pod_records, "fake executor produced no log records"
+        executing = [
+            r for r in pod_records if "Executing sandboxed code" in r.message
+        ]
+        assert executing
+        for r in executing:
+            assert r.request_id == rid
+            assert r.trace_id == trace_id
+
+        # spans also fed the shared stage histogram (Prometheus and traces
+        # agree on what stages exist)
+        text = await (await client.get("/metrics")).text()
+        for stage in ("admission", "spawn", "upload", "execute", "download"):
+            assert f'bci_stage_seconds_count{{stage="{stage}"}}' in text
+
+    try:
+        await with_client(app, go)
+    finally:
+        pod_logger.removeFilter(log_filter)
+        await pods.close()
+
+
+async def test_inbound_traceparent_continues_the_trace(tmp_path, storage):
+    pods = FakeExecutorPods(tmp_path / "pods")
+    metrics = Registry()
+    tracer = Tracer(metrics=metrics)
+    app = make_app(pods, storage, metrics, tracer)
+
+    async def go(client: TestClient):
+        upstream_trace = "ab" * 16
+        upstream_span = "cd" * 8
+        resp = await client.post(
+            "/v1/execute",
+            json={"source_code": "print(1)"},
+            headers={
+                "traceparent": format_traceparent(upstream_trace, upstream_span)
+            },
+        )
+        body = await resp.json()
+        assert body["trace_id"] == upstream_trace
+        detail = await (
+            await client.get(f"/v1/traces/{upstream_trace}")
+        ).json()
+        root = next(s for s in detail["spans"] if s["name"] == "/v1/execute")
+        assert root["parent_id"] == upstream_span
+
+    try:
+        await with_client(app, go)
+    finally:
+        await pods.close()
+
+
+async def test_concurrent_executes_do_not_cross_contaminate_ids(
+    tmp_path, storage, caplog
+):
+    """Two in-flight executes interleaving on the loop: each one's edge log
+    records must carry its own request/trace ids (satellite: log-correlation
+    coverage at the service level, not just the contextvar level)."""
+    pods = FakeExecutorPods(tmp_path / "pods")
+    metrics = Registry()
+    tracer = Tracer(metrics=metrics)
+    app = make_app(pods, storage, metrics, tracer)
+    edge_logger = logging.getLogger(EDGE_LOGGER)
+    log_filter = RequestIdLoggingFilter()
+    edge_logger.addFilter(log_filter)
+
+    async def go(client: TestClient):
+        async def run(tag: str):
+            resp = await client.post(
+                "/v1/execute",
+                json={
+                    "source_code": (
+                        f"import time; time.sleep(0.05); print('{tag}')"
+                    )
+                },
+            )
+            return tag, await resp.json()
+
+        with caplog.at_level(logging.INFO, logger=EDGE_LOGGER):
+            results = dict(
+                await asyncio.gather(run("alpha"), run("bravo"))
+            )
+        assert results["alpha"]["stdout"] == "alpha\n"
+        assert results["bravo"]["stdout"] == "bravo\n"
+        assert results["alpha"]["trace_id"] != results["bravo"]["trace_id"]
+
+        # every edge record mentioning a tag must carry that request's ids
+        by_tag = {}
+        for r in caplog.records:
+            if r.name != EDGE_LOGGER:
+                continue
+            for tag in ("alpha", "bravo"):
+                if tag in r.message:
+                    by_tag.setdefault(tag, set()).add(r.trace_id)
+        for tag in ("alpha", "bravo"):
+            assert by_tag[tag] == {results[tag]["trace_id"]}, (
+                f"log records for {tag} leaked another request's trace id"
+            )
+
+    try:
+        await with_client(app, go)
+    finally:
+        edge_logger.removeFilter(log_filter)
+        await pods.close()
+
+
+async def test_metrics_content_type_negotiates_exposition_format(
+    local_executor,
+):
+    app = create_http_server(
+        code_executor=local_executor,
+        custom_tool_executor=CustomToolExecutor(code_executor=local_executor),
+    )
+
+    async def go(client: TestClient):
+        resp = await client.get("/metrics")
+        assert resp.status == 200
+        assert resp.headers["Content-Type"] == PROMETHEUS_CONTENT_TYPE
+
+    await with_client(app, go)

@@ -1,0 +1,308 @@
+"""Tracing subsystem: span context propagation, traceparent wire format,
+trace retention, and log correlation (request_id/trace_id must survive await
+boundaries and never cross-contaminate between interleaved requests)."""
+
+import asyncio
+import json
+import logging
+
+import pytest
+
+from bee_code_interpreter_tpu.observability import (
+    JsonLogFormatter,
+    Tracer,
+    TraceStore,
+    current_ids,
+    current_trace,
+    format_traceparent,
+    outbound_headers,
+    parse_traceparent,
+    span,
+)
+from bee_code_interpreter_tpu.utils.request_id import (
+    RequestIdLoggingFilter,
+    new_request_id,
+    request_id_context_var,
+)
+
+# ------------------------------------------------------------- wire format
+
+
+def test_traceparent_roundtrip():
+    header = format_traceparent("ab" * 16, "cd" * 8)
+    assert header == f"00-{'ab' * 16}-{'cd' * 8}-01"
+    assert parse_traceparent(header) == ("ab" * 16, "cd" * 8)
+
+
+@pytest.mark.parametrize(
+    "bad",
+    [
+        None,
+        "",
+        "garbage",
+        "00-short-cdcdcdcdcdcdcdcd-01",
+        f"00-{'zz' * 16}-{'cd' * 8}-01",  # non-hex
+        f"00-{'00' * 16}-{'cd' * 8}-01",  # all-zero trace id
+        f"00-{'ab' * 16}-{'00' * 8}-01",  # all-zero span id
+        f"ff-{'ab' * 16}-{'cd' * 8}-01",  # forbidden version
+    ],
+)
+def test_traceparent_malformed_degrades_to_none(bad):
+    assert parse_traceparent(bad) is None
+
+
+# ----------------------------------------------------------------- spans
+
+
+def test_span_is_noop_without_active_trace():
+    with span("upload") as s:
+        assert s is None
+    assert current_trace() is None
+    assert current_ids() == ("-", "-")
+
+
+def test_trace_nests_spans_and_lands_in_store():
+    tracer = Tracer()
+    with tracer.trace("/v1/execute", request_id="req-1") as t:
+        with span("spawn"):
+            pass
+        with span("execute") as s:
+            assert s.parent_id == t.root.span_id
+        # two spans of the same name sum in the stage breakdown
+        with span("upload"):
+            pass
+        with span("upload"):
+            pass
+    stored = tracer.store.get(t.trace_id)
+    assert stored is t
+    assert {s.name for s in stored.spans} == {
+        "/v1/execute", "spawn", "execute", "upload",
+    }
+    assert len(stored.spans) == 5
+    stages = stored.stage_ms()
+    assert set(stages) == {"spawn", "execute", "upload"}
+    assert stored.root.duration_s is not None
+    assert stored.summary()["request_id"] == "req-1"
+
+
+def test_trace_continues_inbound_context():
+    tracer = Tracer()
+    with tracer.trace(
+        "executor:/execute", trace_id="ab" * 16, parent_span_id="cd" * 8
+    ) as t:
+        assert t.trace_id == "ab" * 16
+        assert t.root.parent_id == "cd" * 8
+
+
+def test_error_span_marked_and_trace_retained():
+    tracer = Tracer()
+    with pytest.raises(RuntimeError):
+        with tracer.trace("/v1/execute") as t:
+            with span("execute"):
+                raise RuntimeError("boom")
+    stored = tracer.store.get(t.trace_id)
+    assert stored.root.status == "error"
+    execute = next(s for s in stored.spans if s.name == "execute")
+    assert execute.status == "error"
+    assert "boom" in execute.attributes["error"]
+
+
+def test_outbound_headers_carry_trace_and_request_id():
+    tracer = Tracer()
+    rid = new_request_id()
+    with tracer.trace("/v1/execute", request_id=rid) as t:
+        with span("execute") as s:
+            headers = outbound_headers()
+    assert headers["X-Request-Id"] == rid
+    assert parse_traceparent(headers["traceparent"]) == (t.trace_id, s.span_id)
+
+
+def test_outbound_headers_request_id_only_without_trace():
+    rid = new_request_id()
+    headers = outbound_headers()
+    assert headers == {"X-Request-Id": rid}
+    request_id_context_var.set("-")
+    assert outbound_headers() == {}
+
+
+def test_stage_spans_feed_metrics_histogram():
+    from bee_code_interpreter_tpu.utils.metrics import Registry
+
+    reg = Registry()
+    tracer = Tracer(metrics=reg)
+    with tracer.trace("/v1/execute"):
+        with span("spawn"):
+            pass
+        with span("execute"):
+            pass
+    text = reg.expose()
+    assert 'bci_stage_seconds_count{stage="spawn"} 1' in text
+    assert 'bci_stage_seconds_count{stage="execute"} 1' in text
+    # the root span is the request, not a stage
+    assert 'stage="/v1/execute"' not in text
+
+
+# ----------------------------------------------------------------- store
+
+
+def test_store_bounded_and_reserves_slowest():
+    store = TraceStore(max_traces=8, slowest_keep=2)
+    builder = Tracer()  # traces built detached, added with pinned durations
+    slow_ids = []
+    for i in range(40):
+        with builder.trace(f"r{i}") as t:
+            pass
+        if i in (3, 5):  # make two early traces the slowest ever seen
+            t.root.duration_s = 10.0 + i
+            slow_ids.append(t.trace_id)
+        else:
+            t.root.duration_s = 0.001
+        store.add(t)
+    retained = {t.trace_id for t in store.traces()}
+    assert len(retained) <= 8
+    # the slowest requests survive 30+ subsequent evictions
+    for trace_id in slow_ids:
+        assert trace_id in retained
+        assert store.get(trace_id) is not None
+    assert store.get("not-a-trace") is None
+
+
+def test_store_add_after_duration_mutation_ordering():
+    # slowest ranking is computed at add() time from the trace duration
+    store = TraceStore(max_traces=4, slowest_keep=1)
+    t_slow = Tracer()  # build traces detached, add manually
+    with t_slow.trace("slow") as slow:
+        pass
+    slow.root.duration_s = 99.0
+    store.add(slow)
+    for i in range(10):
+        with t_slow.trace(f"fast{i}") as fast:
+            pass
+        store.add(fast)
+    assert store.get(slow.trace_id) is not None
+
+
+# ------------------------------------------------- async context isolation
+
+
+async def test_ids_survive_await_boundaries():
+    tracer = Tracer()
+    rid = new_request_id()
+    with tracer.trace("/v1/execute", request_id=rid) as t:
+        with span("execute"):
+            before = (request_id_context_var.get(), *current_ids())
+            await asyncio.sleep(0.01)
+            after = (request_id_context_var.get(), *current_ids())
+    assert before == after
+    assert before[0] == rid
+    assert before[1] == t.trace_id
+
+
+async def test_concurrent_requests_do_not_cross_contaminate():
+    """Two in-flight 'requests' interleaving on one event loop: each task's
+    ambient ids must stay its own across every await."""
+    tracer = Tracer()
+    observed: dict[str, set] = {"a": set(), "b": set()}
+
+    async def request(name: str):
+        rid = new_request_id()
+        with tracer.trace(f"/v1/{name}", request_id=rid) as t:
+            for _ in range(5):
+                with span("execute"):
+                    await asyncio.sleep(0)
+                    observed[name].add(
+                        (request_id_context_var.get(), current_ids()[0])
+                    )
+        return rid, t.trace_id
+
+    (rid_a, tid_a), (rid_b, tid_b) = await asyncio.gather(
+        request("a"), request("b")
+    )
+    assert rid_a != rid_b and tid_a != tid_b
+    assert observed["a"] == {(rid_a, tid_a)}
+    assert observed["b"] == {(rid_b, tid_b)}
+
+
+async def test_gather_fanout_children_share_parent_trace():
+    # asyncio.gather children copy the context: spans started inside each
+    # child attach to the same trace without explicit plumbing (the SPMD
+    # upload/execute fan-out in the kubernetes executor relies on this)
+    tracer = Tracer()
+    with tracer.trace("/v1/execute") as t:
+
+        async def upload(i):
+            with span("upload", worker=str(i)):
+                await asyncio.sleep(0.001)
+
+        await asyncio.gather(*(upload(i) for i in range(3)))
+    assert sum(1 for s in t.spans if s.name == "upload") == 3
+    assert all(
+        s.trace_id == t.trace_id for s in t.spans
+    )
+
+
+# --------------------------------------------------------- log correlation
+
+
+def _make_record(logger_name="test", exc=None):
+    try:
+        if exc is not None:
+            raise exc
+        record = logging.LogRecord(
+            logger_name, logging.INFO, __file__, 1, "hello %s", ("world",),
+            None,
+        )
+    except Exception:
+        import sys
+
+        record = logging.LogRecord(
+            logger_name, logging.ERROR, __file__, 1, "kaboom", (),
+            sys.exc_info(),
+        )
+    RequestIdLoggingFilter().filter(record)
+    return record
+
+
+def test_filter_attaches_all_three_ids():
+    tracer = Tracer()
+    rid = new_request_id()
+    with tracer.trace("/v1/execute", request_id=rid) as t:
+        with span("execute") as s:
+            record = _make_record()
+    assert record.request_id == rid
+    assert record.trace_id == t.trace_id
+    assert record.span_id == s.span_id
+
+
+def test_json_formatter_emits_one_line_valid_json():
+    tracer = Tracer()
+    rid = new_request_id()
+    with tracer.trace("/v1/execute", request_id=rid) as t:
+        record = _make_record()
+    line = JsonLogFormatter().format(record)
+    assert "\n" not in line
+    payload = json.loads(line)
+    assert payload["message"] == "hello world"
+    assert payload["request_id"] == rid
+    assert payload["trace_id"] == t.trace_id
+    assert payload["level"] == "INFO"
+
+
+def test_json_formatter_one_line_under_exception_logging():
+    record = _make_record(exc=ValueError("structured logs must not shear"))
+    line = JsonLogFormatter().format(record)
+    assert "\n" not in line  # stack trace folded into the one JSON line
+    payload = json.loads(line)
+    assert payload["level"] == "ERROR"
+    assert "ValueError" in payload["exc_info"]
+    assert "Traceback" in payload["exc_info"]
+
+
+def test_json_formatter_outside_any_request():
+    line = JsonLogFormatter().format(
+        logging.LogRecord("boot", logging.INFO, __file__, 1, "starting", (), None)
+    )
+    payload = json.loads(line)
+    # no filter ran, no request active: ids degrade to "-" not a crash
+    assert payload["request_id"] == "-"
+    assert payload["trace_id"] == "-"
