@@ -15,6 +15,7 @@ multicallables for health checks and tests.
 from __future__ import annotations
 
 import asyncio
+import json
 import logging
 from contextlib import nullcontext
 
@@ -24,7 +25,15 @@ from google.protobuf import descriptor_pb2, descriptor_pool
 from pydantic import ValidationError
 
 from bee_code_interpreter_tpu.api import models as api_models
-from bee_code_interpreter_tpu.observability import Tracer, parse_traceparent
+from bee_code_interpreter_tpu.observability import (
+    FleetJournal,
+    Tracer,
+    current_trace,
+    find_journal,
+    parse_traceparent,
+    record_usage_at_edge,
+    register_usage_metrics,
+)
 from bee_code_interpreter_tpu.proto import code_interpreter_pb2 as pb
 from bee_code_interpreter_tpu.proto import health_pb2, reflection_pb2
 from bee_code_interpreter_tpu.resilience import (
@@ -106,6 +115,12 @@ class CodeInterpreterServicer:
             )
             if metrics is not None
             else None
+        )
+        # Execution-cost histograms shared with the HTTP edge (registry
+        # dedups by name); the proto ExecuteResponse has no usage field, so
+        # gRPC callers read the figures off the trace span / metrics.
+        self._execution_cpu_seconds, self._execution_peak_rss = (
+            register_usage_metrics(metrics) if metrics is not None else (None, None)
         )
 
     def _trace_rpc(self, method: str, context: grpc.aio.ServicerContext, rid: str):
@@ -199,6 +214,12 @@ class CodeInterpreterServicer:
                 timeout_s=validated.timeout,
                 deadline=deadline,
             )
+            record_usage_at_edge(
+                result.usage,
+                current_trace(),
+                self._execution_cpu_seconds,
+                self._execution_peak_rss,
+            )
             return pb.ExecuteResponse(
                 stdout=result.stdout,
                 stderr=result.stderr,
@@ -273,6 +294,67 @@ class CodeInterpreterServicer:
 
         with self._trace_rpc("ExecuteCustomTool", context, rid):
             return await self._with_resilience(context, run)
+
+
+FLEET_SERVICE_NAME = "code_interpreter.v1.FleetService"
+
+
+class FleetServicer:
+    """The fleet lifecycle journal over gRPC (docs/observability.md): the
+    same snapshot/events payloads ``GET /v1/fleet[/events]`` serves, as
+    JSON-encoded message bytes through a generic handler — the checked-in
+    ``*_pb2`` descriptors cannot grow new message types without protoc,
+    which this environment doesn't have. ``GetFleetEvents`` accepts an
+    optional JSON request body ``{"limit": N}``."""
+
+    def __init__(self, journal: FleetJournal) -> None:
+        self._journal = journal
+
+    async def GetFleet(self, request: bytes, context) -> bytes:
+        return json.dumps(self._journal.snapshot()).encode()
+
+    async def GetFleetEvents(self, request: bytes, context) -> bytes:
+        limit = 100
+        if request:
+            try:
+                # TypeError covers {"limit": null} / {"limit": [1]} — every
+                # malformed shape must be INVALID_ARGUMENT, never UNKNOWN.
+                limit = int(json.loads(request.decode()).get("limit", limit))
+            except (ValueError, TypeError, AttributeError, OverflowError):
+                await context.abort(
+                    grpc.StatusCode.INVALID_ARGUMENT,
+                    'request must be JSON like {"limit": 50}',
+                )
+        return json.dumps(
+            {"events": self._journal.events(limit=max(0, limit))}
+        ).encode()
+
+
+_FLEET_METHODS = ("GetFleet", "GetFleetEvents")
+
+
+def _fleet_handler(servicer: FleetServicer) -> grpc.GenericRpcHandler:
+    passthrough = bytes  # JSON bytes in/out; no generated messages
+    return grpc.method_handlers_generic_handler(
+        FLEET_SERVICE_NAME,
+        {
+            name: grpc.unary_unary_rpc_method_handler(
+                getattr(servicer, name),
+                request_deserializer=passthrough,
+                response_serializer=passthrough,
+            )
+            for name in _FLEET_METHODS
+        },
+    )
+
+
+def fleet_stubs(channel: grpc.aio.Channel | grpc.Channel) -> dict[str, object]:
+    """Client-side multicallables for the fleet RPCs (tooling/tests); send
+    b"" (or JSON bytes) and json.loads the reply."""
+    return {
+        name: channel.unary_unary(f"/{FLEET_SERVICE_NAME}/{name}")
+        for name in _FLEET_METHODS
+    }
 
 
 HEALTH_SERVICE_NAME = "grpc.health.v1.Health"
@@ -505,6 +587,7 @@ class GrpcServer:
         request_deadline_s: float | None = None,
         metrics: Registry | None = None,
         tracer: Tracer | None = None,
+        fleet: FleetJournal | None = None,
     ) -> None:
         self._servicer = CodeInterpreterServicer(
             code_executor,
@@ -514,6 +597,13 @@ class GrpcServer:
             metrics=metrics,
             tracer=tracer,
         )
+        # Mirror the HTTP edge: use the executor backend's own journal when
+        # one exists (find_journal is the one shared discovery rule), else
+        # an (honestly empty) standalone journal. Explicit None checks: an
+        # empty journal is len()==0, hence falsy.
+        if fleet is None:
+            fleet = find_journal(code_executor)
+        self._fleet = fleet if fleet is not None else FleetJournal()
         self.health = HealthServicer()
         self._tls_cert = tls_cert
         self._tls_cert_key = tls_cert_key
@@ -524,11 +614,17 @@ class GrpcServer:
         """Start serving; returns the bound port (useful with ':0')."""
         self._server = grpc.aio.server()
         reflection = ReflectionServicer(
-            (SERVICE_NAME, HEALTH_SERVICE_NAME, REFLECTION_SERVICE_NAME)
+            (
+                SERVICE_NAME,
+                FLEET_SERVICE_NAME,
+                HEALTH_SERVICE_NAME,
+                REFLECTION_SERVICE_NAME,
+            )
         )
         self._server.add_generic_rpc_handlers(
             (
                 _generic_handler(self._servicer),
+                _fleet_handler(FleetServicer(self._fleet)),
                 _health_handler(self.health),
                 _reflection_handler(reflection),
             )

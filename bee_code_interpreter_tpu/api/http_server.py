@@ -19,13 +19,19 @@ header instead of queueing unboundedly.
 Observability contract (docs/observability.md): every ``/v1`` POST roots a
 trace next to its request id (continuing an inbound ``traceparent`` when the
 caller sent one); finished traces are retained in a bounded store and served
-from ``GET /v1/traces`` + ``GET /v1/traces/{trace_id}``; ``/v1/execute``
-responses carry the ``trace_id`` and a per-stage ``timings_ms`` breakdown so
-clients can self-report where their time went.
+from ``GET /v1/traces`` (with ``?limit=``/``?min_duration_ms=`` filtering) +
+``GET /v1/traces/{trace_id}``; ``/v1/execute`` responses carry the
+``trace_id``, a per-stage ``timings_ms`` breakdown, and a per-execution
+``usage`` resource-accounting block. Fleet state (the sandbox pool's
+lifecycle journal) is served at ``GET /v1/fleet`` + ``GET /v1/fleet/events``,
+``GET /healthz?verbose=1`` adds pool/breaker/fleet deep health, and
+``POST /v1/profile`` captures an on-demand ``jax.profiler`` trace of a
+sandbox execution or of N serving-engine steps.
 """
 
 from __future__ import annotations
 
+import asyncio
 import json
 import logging
 import math
@@ -36,10 +42,19 @@ from aiohttp import web
 
 from bee_code_interpreter_tpu.api import models
 from bee_code_interpreter_tpu.observability import (
+    PROFILE_DIR_ENV,
     REQUEST_ID_HEADER,
+    FleetJournal,
+    ProfilerUnavailable,
     Tracer,
     current_trace,
+    find_journal,
+    inject_profile_env,
     parse_traceparent,
+    profile_artifacts,
+    record_usage_at_edge,
+    register_usage_metrics,
+    unwrap_executor,
 )
 from bee_code_interpreter_tpu.resilience import (
     AdmissionController,
@@ -64,6 +79,28 @@ def _retry_after_header(e: AdmissionRejected | BreakerOpenError) -> dict[str, st
     return {"Retry-After": str(max(1, math.ceil(e.retry_after_s)))}
 
 
+def _executor_health(executor) -> dict:
+    """Deep-health view of the executor backend: pool occupancy and breaker
+    states, shaped for ``GET /healthz?verbose=1``. Empty for backends with
+    no pool (the in-process local executor)."""
+    inner = unwrap_executor(executor)
+    info: dict = {}
+    ready = getattr(inner, "pool_ready_count", None)
+    if ready is not None:
+        info["pool"] = {
+            "ready": ready,
+            "spawning": getattr(inner, "pool_spawning_count", 0),
+        }
+    breakers = {}
+    for attr in ("spawn_breaker", "http_breaker"):
+        breaker = getattr(inner, attr, None)
+        if breaker is not None:
+            breakers[breaker.name] = breaker.state.name.lower()
+    if breakers:
+        info["breakers"] = breakers
+    return info
+
+
 def create_http_server(
     code_executor: CodeExecutor,
     custom_tool_executor: CustomToolExecutor,
@@ -71,10 +108,20 @@ def create_http_server(
     admission: AdmissionController | None = None,
     request_deadline_s: float | None = None,
     tracer: Tracer | None = None,
+    fleet: FleetJournal | None = None,
+    profiler=None,  # observability.ServingProfiler for POST /v1/profile
 ) -> web.Application:
     app = web.Application(client_max_size=1 << 30)
     metrics = metrics or Registry()
     tracer = tracer or Tracer(metrics=metrics)
+    # The executor backend's own journal when it has one (pool executors
+    # attach it at construction); an empty journal otherwise so /v1/fleet is
+    # always mounted and answers honestly. Explicit None checks: an empty
+    # journal is len()==0 and must not be replaced for being falsy.
+    if fleet is None:
+        fleet = find_journal(code_executor)
+    if fleet is None:
+        fleet = FleetJournal()
     requests_total = metrics.counter(
         "bci_http_requests_total", "HTTP requests by route and status"
     )
@@ -85,6 +132,7 @@ def create_http_server(
         "bci_deadline_exceeded_total",
         "Requests that ran out of their edge deadline",
     )
+    execution_cpu_seconds, execution_peak_rss = register_usage_metrics(metrics)
 
     async def with_resilience(run):
         """Run a sandbox-bound handler body under the edge deadline and the
@@ -201,12 +249,82 @@ def create_http_server(
             # middleware), so agents/benchmarks can self-report where the
             # time went without a second round-trip to /v1/traces.
             trace = current_trace()
+            # Execution-cost accounting lands at the edge: histograms +
+            # usage.* attributes on the root span, mirroring the response.
+            record_usage_at_edge(
+                result.usage, trace, execution_cpu_seconds, execution_peak_rss
+            )
             return web.json_response(
                 models.ExecuteResponse(
                     **result.model_dump(),
                     trace_id=trace.trace_id if trace is not None else None,
                     timings_ms=trace.stage_ms() if trace is not None else None,
                 ).model_dump()
+            )
+
+        return await with_resilience(run)
+
+    async def profile(request: web.Request) -> web.Response:
+        """On-demand jax.profiler capture (docs/observability.md): drill
+        into a slow request found via /v1/traces without redeploying."""
+
+        async def run(deadline):
+            req = await parse_body(request, models.ProfileRequest)
+            if req.target == "serving":
+                if profiler is None:
+                    return web.json_response(
+                        {"detail": "no serving engine attached to /v1/profile"},
+                        status=501,
+                    )
+                try:
+                    # Off-loop: a capture steps the batcher N times, which
+                    # is device-bound work the event loop must not eat.
+                    captured = await asyncio.to_thread(
+                        profiler.capture, req.steps
+                    )
+                except ProfilerUnavailable as e:
+                    return web.json_response({"detail": str(e)}, status=503)
+                return web.json_response({"target": "serving", **captured})
+
+            if not req.source_code:
+                return web.json_response(
+                    {"detail": "source_code is required for target=sandbox"},
+                    status=422,
+                )
+            env = inject_profile_env(req.env)
+            profile_dir = env[PROFILE_DIR_ENV]
+            try:
+                result = await code_executor.execute(
+                    source_code=req.source_code,
+                    files=req.files,
+                    env=env,
+                    timeout_s=req.timeout,
+                    deadline=deadline,
+                )
+            except (DeadlineExceeded, BreakerOpenError):
+                raise  # shared resilience contract (504/503)
+            except Exception:
+                logger.exception("Profiled execution failed")
+                return web.json_response({"detail": "Execution failed"}, status=500)
+            trace = current_trace()
+            record_usage_at_edge(
+                result.usage, trace, execution_cpu_seconds, execution_peak_rss
+            )
+            return web.json_response(
+                {
+                    "target": "sandbox",
+                    **models.ExecuteResponse(
+                        **result.model_dump(),
+                        trace_id=trace.trace_id if trace is not None else None,
+                        timings_ms=(
+                            trace.stage_ms() if trace is not None else None
+                        ),
+                    ).model_dump(),
+                    "profile_dir": profile_dir,
+                    "profile_files": profile_artifacts(
+                        result.files, profile_dir
+                    ),
+                }
             )
 
         return await with_resilience(run)
@@ -249,8 +367,21 @@ def create_http_server(
 
         return await with_resilience(run)
 
-    async def healthz(_request: web.Request) -> web.Response:
-        return web.json_response({"status": "ok"})
+    async def healthz(request: web.Request) -> web.Response:
+        body: dict = {"status": "ok"}
+        # explicit truthy values only: ?verbose=0 / =false must stay terse
+        if request.query.get("verbose", "").lower() in ("1", "true", "yes", "on"):
+            # Deep health: pool occupancy, breaker states, fleet aggregates
+            # — the "why is it unhealthy" view a bare 200 can't carry.
+            body.update(_executor_health(code_executor))
+            snapshot = fleet.snapshot()
+            body["fleet"] = {
+                "live": snapshot["live"],
+                "by_state": snapshot["by_state"],
+                "utilization": snapshot["utilization"],
+                "executions_total": snapshot["executions_total"],
+            }
+        return web.json_response(body)
 
     async def metrics_endpoint(_request: web.Request) -> web.Response:
         # The exposition-format content type (version parameter included) so
@@ -260,10 +391,38 @@ def create_http_server(
             headers={"Content-Type": PROMETHEUS_CONTENT_TYPE},
         )
 
-    async def list_traces(_request: web.Request) -> web.Response:
-        return web.json_response(
-            {"traces": [t.summary() for t in tracer.store.traces()]}
-        )
+    async def list_traces(request: web.Request) -> web.Response:
+        # ?limit=N caps the response (newest first); ?min_duration_ms=X
+        # keeps only the slow outliers — the query an operator actually
+        # runs, instead of dumping the whole ring every time.
+        try:
+            limit = (
+                int(request.query["limit"])
+                if "limit" in request.query
+                else None
+            )
+            min_duration_ms = (
+                float(request.query["min_duration_ms"])
+                if "min_duration_ms" in request.query
+                else None
+            )
+        except ValueError:
+            return web.json_response(
+                {"detail": "limit and min_duration_ms must be numeric"},
+                status=400,
+            )
+        if limit is not None and limit < 0:
+            return web.json_response(
+                {"detail": "limit must be >= 0"}, status=400
+            )
+        traces = tracer.store.traces()
+        if min_duration_ms is not None:
+            traces = [
+                t for t in traces if t.duration_s * 1000.0 >= min_duration_ms
+            ]
+        if limit is not None:
+            traces = traces[:limit]
+        return web.json_response({"traces": [t.summary() for t in traces]})
 
     async def get_trace(request: web.Request) -> web.Response:
         trace = tracer.store.get(request.match_info["trace_id"])
@@ -273,11 +432,30 @@ def create_http_server(
             )
         return web.json_response(trace.to_dict())
 
+    async def fleet_snapshot(_request: web.Request) -> web.Response:
+        return web.json_response(fleet.snapshot())
+
+    async def fleet_events(request: web.Request) -> web.Response:
+        try:
+            limit = int(request.query.get("limit", "100"))
+        except ValueError:
+            return web.json_response(
+                {"detail": "limit must be an integer"}, status=400
+            )
+        if limit < 0:
+            return web.json_response(
+                {"detail": "limit must be >= 0"}, status=400
+            )
+        return web.json_response({"events": fleet.events(limit=limit)})
+
     app.router.add_post("/v1/execute", execute)
+    app.router.add_post("/v1/profile", profile)
     app.router.add_post("/v1/parse-custom-tool", parse_custom_tool)
     app.router.add_post("/v1/execute-custom-tool", execute_custom_tool)
     app.router.add_get("/healthz", healthz)
     app.router.add_get("/metrics", metrics_endpoint)
     app.router.add_get("/v1/traces", list_traces)
     app.router.add_get("/v1/traces/{trace_id}", get_trace)
+    app.router.add_get("/v1/fleet", fleet_snapshot)
+    app.router.add_get("/v1/fleet/events", fleet_events)
     return app

@@ -2,6 +2,8 @@
 
 from __future__ import annotations
 
+from typing import Literal
+
 from pydantic import BaseModel, Field
 
 from bee_code_interpreter_tpu.utils.validation import AbsolutePath, Hash
@@ -29,6 +31,30 @@ class ExecuteResponse(BaseModel):
     # trace, so clients/benchmarks can attribute latency without scraping.
     trace_id: str | None = None
     timings_ms: dict[str, float] | None = None
+    # Per-execution resource accounting: sandbox cpu/wall/rss + workspace and
+    # data-plane byte deltas (schema in docs/observability.md). The same
+    # figures appear as usage.* attributes on the request's root trace span.
+    usage: dict | None = None
+
+
+class ProfileRequest(BaseModel):
+    """``POST /v1/profile`` (docs/observability.md "Profiling workflow").
+
+    ``target="sandbox"`` runs ``source_code`` like ``/v1/execute`` but with
+    the shim's ``BCI_PROFILE_DIR`` injected, so the jax.profiler trace comes
+    back through the ordinary changed-file map (listed in
+    ``profile_files``). ``target="serving"`` captures ``steps`` serving-engine
+    batcher steps into a control-plane-local trace directory.
+    """
+
+    target: Literal["sandbox", "serving"] = "sandbox"
+    # sandbox mode (same semantics as ExecuteRequest)
+    source_code: str | None = None
+    files: dict[AbsolutePath, Hash] = Field(default_factory=dict)
+    env: dict[str, str] = Field(default_factory=dict)
+    timeout: float | None = Field(default=None, gt=0)
+    # serving mode
+    steps: int = Field(default=10, ge=1, le=1000)
 
 
 class ParseCustomToolRequest(BaseModel):

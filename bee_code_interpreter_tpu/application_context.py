@@ -13,7 +13,7 @@ import logging.config
 from functools import cached_property
 
 from bee_code_interpreter_tpu.config import Config
-from bee_code_interpreter_tpu.observability import Tracer, TraceStore
+from bee_code_interpreter_tpu.observability import FleetJournal, Tracer, TraceStore
 from bee_code_interpreter_tpu.services.custom_tool_executor import CustomToolExecutor
 from bee_code_interpreter_tpu.services.storage import Storage
 from bee_code_interpreter_tpu.utils.metrics import Registry
@@ -35,6 +35,11 @@ class ApplicationContext:
             slowest_keep=self.config.trace_slowest_keep,
         )
         self.tracer = Tracer(store=self.trace_store, metrics=self.metrics)
+        # One fleet journal for the whole service: the pool backend records
+        # sandbox transitions into it; both transports serve it.
+        self.fleet = FleetJournal(
+            metrics=self.metrics, max_events=self.config.fleet_max_events
+        )
 
     @cached_property
     def storage(self) -> Storage:
@@ -101,7 +106,10 @@ class ApplicationContext:
                 )
 
                 executor = NativeProcessCodeExecutor(
-                    storage=self.storage, config=self.config
+                    storage=self.storage,
+                    config=self.config,
+                    metrics=self.metrics,
+                    journal=self.fleet,
                 )
                 self._register_pool_gauges(executor)
                 try:
@@ -124,6 +132,7 @@ class ApplicationContext:
             storage=self.storage,
             config=self.config,
             metrics=self.metrics,
+            journal=self.fleet,
         )
         self._register_pool_gauges(executor)
         self._register_breaker_gauges(executor)
@@ -182,6 +191,7 @@ class ApplicationContext:
             admission=self.admission,
             request_deadline_s=self.config.request_deadline_s,
             tracer=self.tracer,
+            fleet=self.fleet,
         )
 
     @cached_property
@@ -198,4 +208,5 @@ class ApplicationContext:
             request_deadline_s=self.config.request_deadline_s,
             metrics=self.metrics,
             tracer=self.tracer,
+            fleet=self.fleet,
         )

@@ -123,6 +123,9 @@ class Config(BaseModel):
     # are exactly the ones a plain ring evicts first under load).
     trace_max_traces: int = Field(default=256, ge=1)
     trace_slowest_keep: int = Field(default=32, ge=0)
+    # Sandbox lifecycle events retained in the fleet journal for
+    # GET /v1/fleet/events (each pod contributes ~4-6 events per life).
+    fleet_max_events: int = Field(default=512, ge=1)
 
     # --- object storage (reference config.py:74) ---
     file_storage_path: str = "./.tmp/files"

@@ -8,20 +8,25 @@ against a booting (or dead) service either hung or died with a raw traceback.
 Now: a per-attempt deadline (``--timeout``), retry-with-backoff on transient
 gRPC statuses (``UNAVAILABLE`` — connection refused/reset — and
 ``DEADLINE_EXCEEDED``), and a clear nonzero-exit message when the service
-stays unreachable.
+stays unreachable. ``--verbose`` additionally fetches the deep-health view
+(``GET /healthz?verbose=1`` on the HTTP listener: pool occupancy, breaker
+states, fleet aggregates — docs/observability.md) and prints it.
 
     python -m bee_code_interpreter_tpu.health_check [addr] \\
-        [--timeout S] [--attempts N] [--backoff S]
+        [--timeout S] [--attempts N] [--backoff S] \\
+        [--verbose] [--http-addr HOST:PORT]
 """
 
 from __future__ import annotations
 
 import argparse
 import asyncio
+import json
 import os
 import sys
 
 import grpc.aio
+import httpx
 
 from bee_code_interpreter_tpu.api.grpc_server import service_stubs
 from bee_code_interpreter_tpu.proto import code_interpreter_pb2 as pb
@@ -81,6 +86,27 @@ async def check(
     raise last
 
 
+def _default_http_addr() -> str:
+    """The service's own HTTP listener config (APP_HTTP_LISTEN_ADDR — the
+    same env the service reads), with wildcard binds mapped to localhost
+    so the probe dials something connectable."""
+    listen = os.environ.get("APP_HTTP_LISTEN_ADDR", "localhost:50081")
+    host, _, port = listen.rpartition(":")
+    if host in ("", "0.0.0.0", "::", "[::]"):
+        host = "localhost"
+    return f"{host}:{port}"
+
+
+async def verbose_health(http_addr: str, timeout: float = 10.0) -> dict:
+    """The deep-health JSON from ``GET /healthz?verbose=1``."""
+    async with httpx.AsyncClient(timeout=timeout) as client:
+        response = await client.get(
+            f"http://{http_addr}/healthz", params={"verbose": "1"}
+        )
+        response.raise_for_status()
+        return response.json()
+
+
 def main() -> None:
     parser = argparse.ArgumentParser(
         description="End-to-end gRPC health check (Execute must return 42)."
@@ -104,6 +130,18 @@ def main() -> None:
         type=float,
         default=2.0,
         help="initial retry backoff in seconds (doubles per attempt)",
+    )
+    parser.add_argument(
+        "--verbose",
+        action="store_true",
+        help="also fetch GET /healthz?verbose=1 (pool, breakers, fleet) "
+        "from the HTTP listener and print it",
+    )
+    parser.add_argument(
+        "--http-addr",
+        default=_default_http_addr(),
+        help="HTTP listener for the --verbose deep-health view "
+        "(default: derived from APP_HTTP_LISTEN_ADDR)",
     )
     args = parser.parse_args()
     try:
@@ -132,6 +170,16 @@ def main() -> None:
         print(f"UNHEALTHY: {e}", file=sys.stderr)
         sys.exit(1)
     print("healthy")
+    if args.verbose:
+        # Supplementary: the liveness verdict above already printed; a
+        # missing HTTP listener degrades to a note, not a failed probe.
+        try:
+            print(json.dumps(asyncio.run(verbose_health(args.http_addr)), indent=2))
+        except Exception as e:
+            print(
+                f"(verbose view unavailable from {args.http_addr}: {e})",
+                file=sys.stderr,
+            )
 
 
 if __name__ == "__main__":

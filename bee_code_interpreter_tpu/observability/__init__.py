@@ -1,11 +1,34 @@
-"""Observability: distributed tracing, trace retention, structured logging.
+"""Observability: tracing, fleet lifecycle journal, resource accounting,
+profiling, structured logging.
 
 Dependency-free (no OTel SDK in the image), layered like ``resilience/``:
 the primitives live here, the wiring lives at the edges (api/, services/,
 runtime/). See docs/observability.md for the operator-facing contract.
 """
 
+from bee_code_interpreter_tpu.observability.accounting import (
+    TransferAccounting,
+    UsageMeter,
+    collect_transfer,
+    merge_worker_usage,
+    record_transfer,
+    record_usage_at_edge,
+    register_usage_metrics,
+)
+from bee_code_interpreter_tpu.observability.fleet import (
+    FleetJournal,
+    find_journal,
+    unwrap_executor,
+)
 from bee_code_interpreter_tpu.observability.logging import JsonLogFormatter
+from bee_code_interpreter_tpu.observability.profiling import (
+    PROFILE_DIR_ENV,
+    SANDBOX_PROFILE_DIR,
+    ProfilerUnavailable,
+    ServingProfiler,
+    inject_profile_env,
+    profile_artifacts,
+)
 from bee_code_interpreter_tpu.observability.tracing import (
     REQUEST_ID_HEADER,
     TRACEPARENT_HEADER,
@@ -23,8 +46,24 @@ from bee_code_interpreter_tpu.observability.tracing import (
 )
 
 __all__ = [
+    "FleetJournal",
     "JsonLogFormatter",
+    "PROFILE_DIR_ENV",
+    "ProfilerUnavailable",
     "REQUEST_ID_HEADER",
+    "SANDBOX_PROFILE_DIR",
+    "ServingProfiler",
+    "TransferAccounting",
+    "UsageMeter",
+    "collect_transfer",
+    "find_journal",
+    "inject_profile_env",
+    "merge_worker_usage",
+    "profile_artifacts",
+    "record_transfer",
+    "record_usage_at_edge",
+    "register_usage_metrics",
+    "unwrap_executor",
     "TRACEPARENT_HEADER",
     "Span",
     "Trace",

@@ -1,0 +1,223 @@
+"""Per-execution resource accounting (docs/observability.md).
+
+Answers "what did this request cost" at three layers without any external
+agent:
+
+- **In the sandbox** (``runtime/executor_core.py``): every execution is
+  measured with ``resource.getrusage(RUSAGE_CHILDREN)`` deltas + wall clock
+  + workspace byte deltas and returns a ``usage`` block on the wire next to
+  stdout/stderr.
+- **On the data plane** (``services/executor_http_driver.py``): upload and
+  download byte counts are collected into an ambient per-request
+  :class:`TransferAccounting` (contextvars, same no-op-off-the-request-path
+  design as ``tracing.span``), because only the driver sees the streamed
+  bytes.
+- **At the edge** (``api/http_server.py`` / ``api/grpc_server.py``): the
+  merged block lands in ``ExecuteResponse.usage``, on the request's root
+  trace span as ``usage.*`` attributes, and in the
+  ``bci_execution_cpu_seconds`` / ``bci_execution_peak_rss_bytes``
+  histograms — so the per-request view and the Prometheus view agree by
+  construction.
+
+Semantics worth knowing:
+
+- ``cpu_user_s`` / ``cpu_system_s`` are *deltas* over the execution (they
+  include a dependency install the execution triggered — pip time is part
+  of what the request cost).
+- ``max_rss_bytes`` is the kernel's child high-water mark, not a delta
+  (RUSAGE maxrss cannot be differenced); in a single-use sandbox that IS
+  the execution's peak, which is the deployment this exists for. In the
+  in-process local backend (dev / fallback mode) many executions share one
+  measuring process, so overlapping requests can cross-attribute CPU and
+  the RSS figure is the process-lifetime peak — approximate, by design.
+- Gang executions (multi-host pod groups) merge per-worker blocks:
+  CPU sums, RSS takes the max, wall takes the max (SPMD workers run
+  concurrently).
+"""
+
+from __future__ import annotations
+
+import time
+from contextlib import contextmanager
+from contextvars import ContextVar
+from dataclasses import dataclass
+
+try:  # POSIX only; the service targets Linux but must import anywhere
+    import resource as _resource
+except ImportError:  # pragma: no cover - non-POSIX dev machine
+    _resource = None
+
+# RSS buckets (bytes): 16 MiB .. 64 GiB — a python hello-world child sits
+# near the bottom, a TPU-host model load near the top.
+RSS_BUCKETS = tuple(float(1 << p) for p in range(24, 37, 2))
+
+# Keys the edge copies onto the trace root span (attributes are strings).
+_SPAN_USAGE_KEYS = (
+    "wall_s",
+    "cpu_user_s",
+    "cpu_system_s",
+    "max_rss_bytes",
+    "workspace_bytes_written",
+    "files_changed",
+    "uploaded_bytes",
+    "uploaded_files",
+    "downloaded_bytes",
+    "downloaded_files",
+)
+
+
+class UsageMeter:
+    """Measures one sandbox execution: rusage-children delta + wall clock.
+
+    Usage::
+
+        meter = UsageMeter()           # snapshot taken here
+        ... run the subprocess ...
+        usage = meter.finish(...)      # delta + workspace accounting
+    """
+
+    def __init__(self) -> None:
+        self._t0 = time.monotonic()
+        self._ru0 = (
+            _resource.getrusage(_resource.RUSAGE_CHILDREN)
+            if _resource is not None
+            else None
+        )
+
+    def finish(
+        self,
+        workspace_bytes_written: int = 0,
+        files_changed: int = 0,
+        deps_installed: list[str] | None = None,
+    ) -> dict:
+        usage: dict = {
+            "wall_s": time.monotonic() - self._t0,
+            "workspace_bytes_written": workspace_bytes_written,
+            "files_changed": files_changed,
+            "deps_installed": list(deps_installed or []),
+        }
+        if self._ru0 is not None:
+            ru1 = _resource.getrusage(_resource.RUSAGE_CHILDREN)
+            usage["cpu_user_s"] = max(0.0, ru1.ru_utime - self._ru0.ru_utime)
+            usage["cpu_system_s"] = max(0.0, ru1.ru_stime - self._ru0.ru_stime)
+            # ru_maxrss is KiB on Linux; a high-water mark, not a delta.
+            usage["max_rss_bytes"] = ru1.ru_maxrss * 1024
+        return usage
+
+
+def merge_worker_usage(blocks: list[dict | None]) -> dict:
+    """Aggregate per-worker ``usage`` blocks from an SPMD gang into one
+    request-level block: CPU sums (total compute paid), RSS and wall take
+    the max (workers run concurrently), byte counts sum (each worker wrote
+    its own outputs). Missing blocks (an old executor server) drop out."""
+    merged: dict = {}
+    deps: list[str] = []
+    for block in blocks:
+        if not block:
+            continue
+        for key in ("cpu_user_s", "cpu_system_s"):
+            if key in block:
+                merged[key] = merged.get(key, 0.0) + float(block[key])
+        for key in ("max_rss_bytes", "wall_s"):
+            if key in block:
+                merged[key] = max(merged.get(key, 0), block[key])
+        for key in ("workspace_bytes_written", "files_changed"):
+            if key in block:
+                merged[key] = merged.get(key, 0) + int(block[key])
+        for dep in block.get("deps_installed", ()):
+            if dep not in deps:
+                deps.append(dep)
+    if deps or merged:
+        merged["deps_installed"] = deps
+    return merged
+
+
+# ------------------------------------------------- data-plane byte accounting
+
+_current_transfer: ContextVar["TransferAccounting | None"] = ContextVar(
+    "bci_transfer_accounting", default=None
+)
+
+
+@dataclass
+class TransferAccounting:
+    """Bytes/files moved over the sandbox data plane for one execution."""
+
+    uploaded_bytes: int = 0
+    uploaded_files: int = 0
+    downloaded_bytes: int = 0
+    downloaded_files: int = 0
+
+    def as_dict(self) -> dict:
+        return {
+            "uploaded_bytes": self.uploaded_bytes,
+            "uploaded_files": self.uploaded_files,
+            "downloaded_bytes": self.downloaded_bytes,
+            "downloaded_files": self.downloaded_files,
+        }
+
+
+@contextmanager
+def collect_transfer():
+    """Open an ambient transfer-accounting scope for one execution; the
+    HTTP driver's upload/download calls report into it. Scopes nest per
+    asyncio task context, so interleaved requests never cross-count."""
+    acct = TransferAccounting()
+    token = _current_transfer.set(acct)
+    try:
+        yield acct
+    finally:
+        _current_transfer.reset(token)
+
+
+def record_transfer(direction: str, nbytes: int) -> None:
+    """Report one completed data-plane file move into the ambient scope;
+    a no-op when no execution is being accounted (direct driver use)."""
+    acct = _current_transfer.get()
+    if acct is None:
+        return
+    if direction == "upload":
+        acct.uploaded_bytes += nbytes
+        acct.uploaded_files += 1
+    else:
+        acct.downloaded_bytes += nbytes
+        acct.downloaded_files += 1
+
+
+# -------------------------------------------------------------- edge wiring
+
+
+def register_usage_metrics(metrics):
+    """The edge's execution-cost histograms (shared HTTP/gRPC; the registry
+    dedups by name). Returns (cpu_seconds, peak_rss_bytes)."""
+    cpu = metrics.histogram(
+        "bci_execution_cpu_seconds",
+        "Per-execution sandbox CPU time (user+system, children delta)",
+    )
+    rss = metrics.histogram(
+        "bci_execution_peak_rss_bytes",
+        "Per-execution sandbox peak RSS high-water mark",
+        buckets=RSS_BUCKETS,
+    )
+    return cpu, rss
+
+
+def record_usage_at_edge(usage: dict | None, trace, cpu_hist, rss_hist) -> None:
+    """Land one execution's ``usage`` block at the edge: observe the cost
+    histograms and mirror the figures onto the request's root span so the
+    trace view and the response body report identical numbers."""
+    if not usage:
+        return
+    if cpu_hist is not None and (
+        "cpu_user_s" in usage or "cpu_system_s" in usage
+    ):
+        cpu_hist.observe(
+            float(usage.get("cpu_user_s", 0.0))
+            + float(usage.get("cpu_system_s", 0.0))
+        )
+    if rss_hist is not None and usage.get("max_rss_bytes"):
+        rss_hist.observe(float(usage["max_rss_bytes"]))
+    if trace is not None:
+        for key in _SPAN_USAGE_KEYS:
+            if key in usage:
+                trace.root.attributes[f"usage.{key}"] = str(usage[key])

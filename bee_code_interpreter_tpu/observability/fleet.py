@@ -1,0 +1,216 @@
+"""Fleet observability: the sandbox-pool lifecycle journal (docs/observability.md).
+
+The warm pool is the system's core asset, yet gauges alone (`ready`,
+`spawning`) cannot answer "why did the pool drain", "which pod served this
+request", or "what killed pod X at 12:04". This module keeps the missing
+history: every sandbox transition —
+
+    spawning -> ready -> assigned -> executing -> released | reaped | failed
+
+— is recorded as an event (timestamp, reason, spawn latency) in a bounded
+ring shared by both pool backends (``kubernetes_code_executor.py`` and
+``native_process_code_executor.py``), with a live per-pod record while the
+sandbox exists. Served as ``GET /v1/fleet`` (point-in-time snapshot) and
+``GET /v1/fleet/events`` on the HTTP edge, and as the
+``code_interpreter.v1.FleetService`` JSON-over-gRPC methods.
+
+Metrics fed from transitions (same registry the rest of the service uses):
+
+- ``bci_pool_spawn_seconds``       spawn latency histogram (spawning->ready)
+- ``bci_pool_utilization``         busy / live sandboxes (0-1 gauge)
+- ``bci_pod_reaped_total{reason}`` abnormal removals (reaped + failed)
+
+Everything is loop-local control-plane state: no locks, no I/O, O(1) per
+transition.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from dataclasses import dataclass, field
+
+# Canonical lifecycle states. Terminal states drop the pod from the live
+# snapshot; its history stays in the event ring.
+STATES = (
+    "spawning",
+    "ready",
+    "assigned",
+    "executing",
+    "released",
+    "reaped",
+    "failed",
+)
+TERMINAL_STATES = frozenset(("released", "reaped", "failed"))
+BUSY_STATES = frozenset(("assigned", "executing"))
+
+
+def unwrap_executor(executor):
+    """The pool backend behind the resilience front
+    (``ResilientCodeExecutor.primary``) — the object holding the journal,
+    pool counters, and breakers. The ONE unwrap rule shared by every edge
+    (HTTP healthz, journal discovery on both transports), so they can never
+    disagree about which backend they inspect."""
+    return getattr(executor, "primary", executor)
+
+
+def find_journal(executor) -> "FleetJournal | None":
+    """The fleet journal an executor backend records into. Returns None for
+    journal-less backends (the in-process local executor)."""
+    return getattr(unwrap_executor(executor), "journal", None)
+
+
+@dataclass
+class PodRecord:
+    """Live view of one sandbox (pod group or native server process)."""
+
+    name: str
+    state: str
+    workers: int = 1
+    created_mono: float = field(default_factory=time.monotonic)
+    ready_mono: float | None = None
+    spawn_s: float | None = None
+    executions: int = 0
+    last_reason: str | None = None
+
+    def to_dict(self) -> dict:
+        return {
+            "pod": self.name,
+            "state": self.state,
+            "workers": self.workers,
+            "age_s": time.monotonic() - self.created_mono,
+            "spawn_s": self.spawn_s,
+            "executions": self.executions,
+            "reason": self.last_reason,
+        }
+
+
+class FleetJournal:
+    """Bounded lifecycle journal + live pool snapshot for one executor
+    backend. Backends call :meth:`record` at each transition; the API edge
+    reads :meth:`snapshot` / :meth:`events`."""
+
+    def __init__(self, metrics=None, max_events: int = 512) -> None:
+        self._events: deque[dict] = deque(maxlen=max(1, max_events))
+        self._live: dict[str, PodRecord] = {}
+        # Lifetime counters (survive pod eviction from the live map).
+        self.counts: dict[str, int] = {state: 0 for state in STATES}
+        self.executions_total = 0
+        self._spawn_seconds = None
+        self._reaped_total = None
+        if metrics is not None:
+            self._spawn_seconds = metrics.histogram(
+                "bci_pool_spawn_seconds",
+                "Sandbox spawn latency, spawning to ready",
+            )
+            self._reaped_total = metrics.counter(
+                "bci_pod_reaped_total",
+                "Sandboxes removed abnormally (reaped or spawn-failed), by reason",
+            )
+            metrics.gauge(
+                "bci_pool_utilization",
+                "Busy fraction of live sandboxes (assigned+executing over live)",
+                self.utilization,
+            )
+
+    # ------------------------------------------------------------ recording
+
+    def record(
+        self,
+        pod: str,
+        state: str,
+        reason: str | None = None,
+        detail: str | None = None,
+        workers: int | None = None,
+        **attrs,
+    ) -> None:
+        """Record one transition for ``pod``. Unknown states raise — the
+        vocabulary above IS the contract the API and dashboards parse.
+
+        ``reason`` is CATEGORICAL (warm_pop / cold_spawn / single_use /
+        unhealthy / died_in_queue / shutdown / spawn_failed, …) because it
+        becomes a Prometheus label on ``bci_pod_reaped_total`` — free text
+        there would mint one time series per unique failure message.
+        ``detail`` carries the free text (exception string, exit code) on
+        the journal event only."""
+        if state not in STATES:
+            raise ValueError(f"unknown fleet state {state!r}")
+        now = time.monotonic()
+        rec = self._live.get(pod)
+        if rec is None:
+            rec = PodRecord(name=pod, state=state, workers=workers or 1)
+            self._live[pod] = rec
+        rec.state = state
+        rec.last_reason = reason
+        if workers is not None:
+            rec.workers = workers
+        event: dict = {
+            "ts": time.time(),
+            "pod": pod,
+            "state": state,
+            "workers": rec.workers,
+        }
+        if reason is not None:
+            event["reason"] = reason
+        if detail is not None:
+            event["detail"] = detail
+        event.update(attrs)
+
+        self.counts[state] += 1
+        if state == "ready" and rec.ready_mono is None:
+            rec.ready_mono = now
+            rec.spawn_s = now - rec.created_mono
+            event["spawn_s"] = rec.spawn_s
+            if self._spawn_seconds is not None:
+                self._spawn_seconds.observe(rec.spawn_s)
+        elif state == "executing":
+            rec.executions += 1
+            self.executions_total += 1
+        elif state in TERMINAL_STATES:
+            event["executions"] = rec.executions
+            event["age_s"] = now - rec.created_mono
+            self._live.pop(pod, None)
+            if state in ("reaped", "failed") and self._reaped_total is not None:
+                self._reaped_total.inc(reason=reason or state)
+        self._events.append(event)
+
+    # -------------------------------------------------------------- reading
+
+    def utilization(self) -> float:
+        """Busy fraction of live (past-spawn) sandboxes; 0.0 when the pool
+        is empty so a drained pool never reads as NaN."""
+        live = [r for r in self._live.values() if r.state != "spawning"]
+        if not live:
+            return 0.0
+        busy = sum(1 for r in live if r.state in BUSY_STATES)
+        return busy / len(live)
+
+    def snapshot(self) -> dict:
+        """Point-in-time pool view: each live pod (state, age, executions
+        served, spawn latency) plus lifetime aggregates."""
+        pods = sorted(
+            (r.to_dict() for r in self._live.values()),
+            key=lambda d: d["age_s"],
+            reverse=True,
+        )
+        by_state: dict[str, int] = {}
+        for r in self._live.values():
+            by_state[r.state] = by_state.get(r.state, 0) + 1
+        return {
+            "pods": pods,
+            "live": len(pods),
+            "by_state": by_state,
+            "utilization": self.utilization(),
+            "executions_total": self.executions_total,
+            "lifetime": dict(self.counts),
+        }
+
+    def events(self, limit: int | None = None) -> list[dict]:
+        """Most recent transitions, newest first; ``limit`` caps the list."""
+        out = [dict(e) for e in reversed(self._events)]
+        if limit is not None and limit >= 0:
+            out = out[:limit]
+        return out
+
+    def __len__(self) -> int:
+        return len(self._events)

@@ -33,6 +33,7 @@ import sys
 import tempfile
 from pathlib import Path
 
+from bee_code_interpreter_tpu.observability.accounting import UsageMeter
 from bee_code_interpreter_tpu.runtime import dep_guess
 
 # Env the executor forwards from its own environment into every user process,
@@ -83,6 +84,9 @@ class ExecutionOutcome:
     stderr: str
     exit_code: int
     files: list[str]  # logical absolute paths, e.g. "/workspace/plot.png"
+    # Resource accounting (docs/observability.md): getrusage-children deltas,
+    # wall clock, workspace byte deltas, deps installed for THIS execution.
+    usage: dict | None = None
 
 
 def snapshot_workspace(root: Path) -> dict[str, tuple[int, int]]:
@@ -250,8 +254,11 @@ class ExecutorCore:
         env = env or {}
         timeout_s = timeout_s or self.default_timeout_s
         before = snapshot_workspace(self.workspace)
+        # The meter opens before the dep install on purpose: pip time/CPU is
+        # part of what this execution cost the sandbox.
+        meter = UsageMeter()
 
-        _installed, pip_notes = await self.ensure_dependencies(source_code)
+        installed, pip_notes = await self.ensure_dependencies(source_code)
 
         with tempfile.TemporaryDirectory(prefix="exec-") as td:
             script = Path(td) / "script.py"
@@ -291,8 +298,17 @@ class ExecutorCore:
             stderr = pip_notes + ("\n" + stderr if stderr else "")
 
         after = snapshot_workspace(self.workspace)
-        files = [self.logical(rel) for rel in changed_files(before, after)]
-        return ExecutionOutcome(stdout=stdout, stderr=stderr, exit_code=exit_code, files=files)
+        changed = changed_files(before, after)
+        usage = meter.finish(
+            workspace_bytes_written=sum(after[rel][1] for rel in changed),
+            files_changed=len(changed),
+            deps_installed=installed,
+        )
+        files = [self.logical(rel) for rel in changed]
+        return ExecutionOutcome(
+            stdout=stdout, stderr=stderr, exit_code=exit_code, files=files,
+            usage=usage,
+        )
 
     async def warmup(self) -> None:
         """Pre-heat the interpreter/XLA path so the first request doesn't pay it.

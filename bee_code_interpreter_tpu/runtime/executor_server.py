@@ -125,6 +125,11 @@ def create_app(core: ExecutorCore, tracer: Tracer | None = None) -> web.Applicat
                 "files": outcome.files,
                 # additive diagnostic, mirrors the C++ server's field
                 "duration_ms": (loop.time() - t0) * 1000,
+                # per-execution resource accounting (docs/observability.md):
+                # rusage deltas + wall + workspace byte deltas, measured by
+                # ExecutorCore; the control-plane driver propagates this
+                # into ExecuteResponse.usage.
+                "usage": outcome.usage,
             }
         )
 

@@ -25,6 +25,10 @@ class Result(BaseModel):
     stderr: str
     exit_code: int
     files: dict[AbsolutePath, Hash]
+    # Per-execution resource accounting (docs/observability.md): sandbox
+    # rusage/wall/workspace figures merged with the driver's data-plane byte
+    # counts. None from backends that don't measure (e.g. the C++ server).
+    usage: dict | None = None
 
 
 @runtime_checkable

@@ -22,7 +22,9 @@ Observability (docs/observability.md): each call runs under a trace stage
 span (``upload``/``execute``/``download``), and every request carries the
 W3C ``traceparent`` plus ``X-Request-Id`` headers so the executor server
 continues the same trace inside the pod and its logs correlate back to the
-edge request.
+edge request. Completed uploads/downloads report their byte counts into
+the ambient per-execution accounting scope (``observability/accounting.py``)
+so ``ExecuteResponse.usage`` can attribute data-plane traffic per request.
 """
 
 from __future__ import annotations
@@ -32,7 +34,11 @@ from contextlib import nullcontext
 import httpx
 
 from bee_code_interpreter_tpu.config import Config
-from bee_code_interpreter_tpu.observability import outbound_headers, span
+from bee_code_interpreter_tpu.observability import (
+    outbound_headers,
+    record_transfer,
+    span,
+)
 from bee_code_interpreter_tpu.resilience import (
     CircuitBreaker,
     Deadline,
@@ -72,9 +78,13 @@ class ExecutorHttpDriver:
         object_id: Hash,
         deadline: Deadline | None = None,
     ) -> None:
+        sent = 0
+
         async def body():
+            nonlocal sent
             async with self._storage.reader(object_id) as reader:
                 async for chunk in reader:
+                    sent += len(chunk)
                     yield chunk
 
         what = f"file upload to {addr}"
@@ -94,12 +104,15 @@ class ExecutorHttpDriver:
                     raise SandboxTransientError(f"{what} failed: {e}") from e
                 if response.status_code >= 300:
                     raise classify_http_status(response.status_code, what)
+        # Only completed moves count toward the execution's usage block.
+        record_transfer("upload", sent)
 
     async def _download_file(
         self, addr: str, path: str, deadline: Deadline | None = None
     ) -> Hash:
         what = f"file download from {addr}"
         kwargs = self._deadline_kwargs(deadline, what)
+        received = 0
         with span("download", addr=addr, path=path):
             async with self._data_plane_guard():
                 try:
@@ -115,11 +128,13 @@ class ExecutorHttpDriver:
                                     response.status_code, what
                                 )
                             async for chunk in response.aiter_bytes():
+                                received += len(chunk)
                                 await writer.write(chunk)
                 except httpx.TimeoutException as e:
                     raise SandboxTransientError(f"{what} timed out: {e}") from e
                 except httpx.TransportError as e:
                     raise SandboxTransientError(f"{what} failed: {e}") from e
+        record_transfer("download", received)
         return writer.hash
 
     def _effective_timeout(self, timeout_s: float | None) -> float:

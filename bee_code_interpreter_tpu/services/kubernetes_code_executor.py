@@ -52,7 +52,12 @@ from dataclasses import dataclass
 import httpx
 
 from bee_code_interpreter_tpu.config import Config
-from bee_code_interpreter_tpu.observability import span
+from bee_code_interpreter_tpu.observability import (
+    FleetJournal,
+    collect_transfer,
+    merge_worker_usage,
+    span,
+)
 from bee_code_interpreter_tpu.resilience import (
     BreakerState,
     CircuitBreaker,
@@ -102,6 +107,7 @@ class KubernetesCodeExecutor(ExecutorHttpDriver):
         spawn_breaker: CircuitBreaker | None = None,
         http_breaker: CircuitBreaker | None = None,
         ip_poll_interval_s: float = 1.0,
+        journal: FleetJournal | None = None,
     ) -> None:
         self._kubectl = kubectl
         self._storage = storage
@@ -119,6 +125,13 @@ class KubernetesCodeExecutor(ExecutorHttpDriver):
         self._background_tasks: set[asyncio.Task] = set()
 
         self._metrics = metrics
+        # Lifecycle journal (docs/observability.md): every pod-group
+        # transition lands here; served at GET /v1/fleet[/events].
+        # `is None`, not truthiness: an empty journal is len()==0 — falsy —
+        # and replacing the injected one would strand /v1/fleet on a twin.
+        self.journal = (
+            journal if journal is not None else FleetJournal(metrics=metrics)
+        )
         self._retry_counter = (
             metrics.counter(
                 "bci_executor_retry_attempts_total",
@@ -211,6 +224,16 @@ class KubernetesCodeExecutor(ExecutorHttpDriver):
         env = env or {}
         if deadline is not None:
             deadline.check("execute")
+        # Ambient byte-accounting scope for this execution (sync contextvars;
+        # the driver's upload/download calls report into it).
+        with collect_transfer() as transfer:
+            return await self._execute_on_group(
+                source_code, files, env, timeout_s, deadline, transfer
+            )
+
+    async def _execute_on_group(
+        self, source_code, files, env, timeout_s, deadline, transfer
+    ) -> Result:
         async with self.executor_pod_group(deadline=deadline) as group:
             addrs = [
                 f"{ip}:{self._config.executor_port}" for ip in group.pod_ips
@@ -223,6 +246,7 @@ class KubernetesCodeExecutor(ExecutorHttpDriver):
                     for path, object_id in files.items()
                 )
             )
+            self.journal.record(group.name, "executing")
             # Run on all workers concurrently; every JAX process must execute
             # the same program for collectives to rendezvous.
             responses = await asyncio.gather(
@@ -260,11 +284,16 @@ class KubernetesCodeExecutor(ExecutorHttpDriver):
                     ),
                 )
             )
+            # Gang usage: CPU sums, RSS/wall max across workers; the
+            # driver's data-plane byte counts ride in the same block.
+            usage = merge_worker_usage([r.get("usage") for r in responses])
+            usage.update(transfer.as_dict())
             return Result(
                 stdout=primary["stdout"],
                 stderr=primary["stderr"],
                 exit_code=exit_code,
                 files=out_files,
+                usage=usage,
             )
 
     # ------------------------------------------------------------------ pool
@@ -286,21 +315,25 @@ class KubernetesCodeExecutor(ExecutorHttpDriver):
         while group is None:
             if not self._queue:
                 group = await self._spawn_guarded(deadline)
+                self.journal.record(group.name, "assigned", reason="cold_spawn")
                 break
             candidate = self._queue.popleft()
             if await self._group_healthy(candidate):
                 group = candidate
+                self.journal.record(group.name, "assigned", reason="warm_pop")
             else:
                 logger.warning(
                     "Warm pod group %s unhealthy (preempted?); discarding",
                     candidate.name,
                 )
+                self.journal.record(candidate.name, "reaped", reason="unhealthy")
                 for pod_name in candidate.pod_names:
                     self._spawn_background(self._delete_pod(pod_name))
         self._spawn_background(self.fill_executor_pod_queue())
         try:
             yield group
         finally:
+            self.journal.record(group.name, "released", reason="single_use")
             for pod_name in group.pod_names:
                 self._spawn_background(self._delete_pod(pod_name))
 
@@ -397,6 +430,9 @@ class KubernetesCodeExecutor(ExecutorHttpDriver):
         n = max(1, self._config.tpu_hosts_per_slice)
         name = f"{self._config.executor_pod_name_prefix}{secrets.token_hex(3)}"
         created: list[str] = []
+        # Retry attempts use fresh names, so each attempt is its own journal
+        # entry — a flapping apiserver shows up as N failed spawns, not one.
+        self.journal.record(name, "spawning", workers=n)
         try:
             # Worker 0 first: its IP is the jax.distributed coordinator address
             # for the rest of the gang.
@@ -437,8 +473,16 @@ class KubernetesCodeExecutor(ExecutorHttpDriver):
             pods = await asyncio.gather(
                 *(self._kubectl.get("pod", pod_name) for pod_name in created)
             )
+            self.journal.record(name, "ready")
             return PodGroup(name=name, pods=list(pods))
         except BaseException as e:
+            # str() of a bare CancelledError is empty; fall back to the type.
+            self.journal.record(
+                name,
+                "failed",
+                reason="spawn_failed",
+                detail=(str(e) or type(e).__name__)[:200],
+            )
             # Delete-on-failure (reference :242-246), for every member — also
             # on cancellation (the deadline bound cancels a hung spawn).
             for pod_name in created:

@@ -98,7 +98,9 @@ class LocalCodeExecutor:
             # Restore the client's workspace snapshot (reference
             # kubernetes_code_executor.py:100-113, via HTTP PUT; here direct
             # I/O). Stage spans: restore/execute/snapshot are this backend's
-            # analogue of the pod path's upload/execute/download.
+            # analogue of the pod path's upload/execute/download — and the
+            # byte counts land in the same usage-block keys.
+            restored_bytes = 0
             with span("restore", files=str(len(files))):
                 for logical_path, object_id in files.items():
                     real = core.resolve(logical_path)
@@ -106,6 +108,7 @@ class LocalCodeExecutor:
                     with open(real, "wb") as f:
                         async with self._storage.reader(object_id) as r:
                             async for chunk in r:
+                                restored_bytes += len(chunk)
                                 f.write(chunk)
 
             with span("execute"):
@@ -115,19 +118,29 @@ class LocalCodeExecutor:
 
             # Snapshot changed files back (reference :126-142).
             out_files: dict[str, str] = {}
+            snapshot_bytes = 0
             with span("snapshot", files=str(len(outcome.files))):
                 for logical_path in outcome.files:
                     real = core.resolve(logical_path)
                     async with self._storage.writer() as w:
                         with open(real, "rb") as f:
                             while chunk := f.read(1 << 20):
+                                snapshot_bytes += len(chunk)
                                 await w.write(chunk)
                     out_files[logical_path] = w.hash
+            usage = dict(outcome.usage or {})
+            usage.update(
+                uploaded_bytes=restored_bytes,
+                uploaded_files=len(files),
+                downloaded_bytes=snapshot_bytes,
+                downloaded_files=len(out_files),
+            )
             return Result(
                 stdout=outcome.stdout,
                 stderr=outcome.stderr,
                 exit_code=outcome.exit_code,
                 files=out_files,
+                usage=usage,
             )
         finally:
             shutil.rmtree(workspace, ignore_errors=True)

@@ -36,7 +36,12 @@ from pathlib import Path
 import httpx
 
 from bee_code_interpreter_tpu.config import Config
-from bee_code_interpreter_tpu.observability import span
+from bee_code_interpreter_tpu.observability import (
+    FleetJournal,
+    collect_transfer,
+    merge_worker_usage,
+    span,
+)
 from bee_code_interpreter_tpu.resilience import (
     Deadline,
     RetryPolicy,
@@ -78,6 +83,7 @@ class NativeSandbox:
     proc: subprocess.Popen
     addr: str  # 127.0.0.1:port
     workspace: Path
+    name: str = ""  # fleet-journal identity, e.g. "native-43117-a1b2"
     # Dispatched at first-healthy, before its warm worker finished
     # preloading: the server gates the execute internally, so the preload
     # tail counts against the HTTP request and needs timeout headroom.
@@ -100,9 +106,18 @@ class NativeProcessCodeExecutor(ExecutorHttpDriver):
         config: Config,
         binary: str | Path | None = None,
         http_client: httpx.AsyncClient | None = None,
+        metrics=None,
+        journal: FleetJournal | None = None,
     ) -> None:
         self._storage = storage
         self._config = config
+        # Lifecycle journal (docs/observability.md): same transition
+        # vocabulary as the Kubernetes pool, one process per "pod".
+        # `is None`, not truthiness: an empty journal is len()==0 — falsy —
+        # and replacing the injected one would strand /v1/fleet on a twin.
+        self.journal = (
+            journal if journal is not None else FleetJournal(metrics=metrics)
+        )
         self._binary = Path(binary or config.local_executor_binary or "")
         if not self._binary.is_file():
             raise FileNotFoundError(
@@ -224,6 +239,18 @@ class NativeProcessCodeExecutor(ExecutorHttpDriver):
         perf = asyncio.get_running_loop().time
         t_start = perf()
         was_warm = bool(self._queue)
+        # Ambient byte-accounting scope for this execution (sync contextvars;
+        # the driver's upload/download calls report into it).
+        with collect_transfer() as transfer:
+            return await self._execute_on_sandbox(
+                source_code, files, env, timeout_s, deadline,
+                transfer, perf, t_start, was_warm,
+            )
+
+    async def _execute_on_sandbox(
+        self, source_code, files, env, timeout_s, deadline,
+        transfer, perf, t_start, was_warm,
+    ) -> Result:
         async with self.sandbox(deadline=deadline) as box:
             t_acquired = perf()
             await asyncio.gather(
@@ -233,6 +260,7 @@ class NativeProcessCodeExecutor(ExecutorHttpDriver):
                 )
             )
             t_uploaded = perf()
+            self.journal.record(box.name, "executing")
             response = await self._post_execute(
                 box.addr,
                 source_code,
@@ -277,11 +305,17 @@ class NativeProcessCodeExecutor(ExecutorHttpDriver):
                 "download_ms": (t_done - t_executed) * 1000,
                 "total_ms": (t_done - t_start) * 1000,
             }
+            # The C++ server doesn't measure usage (its response has no
+            # block); the Python server does — merge handles either, and the
+            # driver's byte counts are always present.
+            usage = merge_worker_usage([response.get("usage")])
+            usage.update(transfer.as_dict())
             return Result(
                 stdout=response["stdout"],
                 stderr=response["stderr"],
                 exit_code=response["exit_code"],
                 files=out_files,
+                usage=usage,
             )
 
     # ------------------------------------------------------------------ pool
@@ -296,8 +330,15 @@ class NativeProcessCodeExecutor(ExecutorHttpDriver):
             candidate = self._queue.popleft()
             if candidate.proc.poll() is None:
                 box = candidate
+                self.journal.record(box.name, "assigned", reason="warm_pop")
                 break
             logger.warning("Warm sandbox on %s died in queue; discarding", candidate.addr)
+            self.journal.record(
+                candidate.name,
+                "reaped",
+                reason="died_in_queue",
+                detail=f"exit {candidate.proc.returncode}",
+            )
             candidate.destroy()
         if box is None:
             # Pool drained: dispatch at first healthy instead of polling for
@@ -311,10 +352,12 @@ class NativeProcessCodeExecutor(ExecutorHttpDriver):
                     if deadline
                     else spawn
                 )
+            self.journal.record(box.name, "assigned", reason="cold_spawn")
         self._spawn_background(self.fill_sandbox_queue())
         try:
             yield box
         finally:
+            self.journal.record(box.name, "released", reason="single_use")
             # Teardown must not block the response (reference deletes pods
             # fire-and-forget, kubernetes_code_executor.py:262-264).
             asyncio.get_running_loop().run_in_executor(None, box.destroy)
@@ -358,15 +401,41 @@ class NativeProcessCodeExecutor(ExecutorHttpDriver):
         finally:
             self._spawning_count -= 1
         if self._closed:
-            box.destroy()  # raced with shutdown: don't repopulate a dead pool
+            # raced with shutdown: don't repopulate a dead pool
+            self.journal.record(box.name, "reaped", reason="shutdown")
+            box.destroy()
             return False
         self._queue.append(box)
         return True
 
     @retryable("_spawn_retry", op="spawn")
     async def spawn_sandbox(self, wait_warm: bool = True) -> NativeSandbox:
-        cfg = self._config
         port = _free_port()
+        # The port alone is NOT unique: _free_port() releases its probe
+        # socket before the sandbox binds, so concurrent spawns can draw the
+        # same number — two journal records must never share one identity.
+        name = f"native-{port}-{secrets.token_hex(2)}"
+        self.journal.record(name, "spawning")
+        try:
+            return await self._spawn_sandbox(port, name, wait_warm)
+        except BaseException as e:
+            # EVERY spawn failure — mkdir, the stdlib probe, Popen, the
+            # readiness wait, a deadline cancellation — must close the
+            # journal record, or the pod sits in _live as a phantom
+            # 'spawning' forever (and a persistently failing refill loop
+            # would accumulate phantoms without bound).
+            self.journal.record(
+                name,
+                "failed",
+                reason="spawn_failed",
+                detail=(str(e) or type(e).__name__)[:200],
+            )
+            raise
+
+    async def _spawn_sandbox(
+        self, port: int, name: str, wait_warm: bool
+    ) -> NativeSandbox:
+        cfg = self._config
         addr = f"127.0.0.1:{port}"
         workspace = self._workspace_root / secrets.token_hex(8)
         workspace.mkdir(parents=True, exist_ok=True)
@@ -453,7 +522,7 @@ class NativeProcessCodeExecutor(ExecutorHttpDriver):
             ),
         )
         box = NativeSandbox(
-            proc=proc, addr=addr, workspace=workspace,
+            proc=proc, addr=addr, workspace=workspace, name=name,
             overlap_dispatch=not wait_warm,
         )
         try:
@@ -475,13 +544,13 @@ class NativeProcessCodeExecutor(ExecutorHttpDriver):
                         # healthy-but-cold sandbox anyway — the server's own
                         # warm-wait/cold-fallback covers it.
                         if not wait_warm:
-                            return box
+                            return self._spawned_ready(box)
                         if warm_deadline is None:
                             warm_deadline = min(loop.time() + 15.0, deadline)
                         if response.json().get("warm", True):
-                            return box
+                            return self._spawned_ready(box)
                         if loop.time() > warm_deadline:
-                            return box
+                            return self._spawned_ready(box)
                 except (httpx.TransportError, ValueError):
                     pass
                 if loop.time() > deadline:
@@ -491,9 +560,14 @@ class NativeProcessCodeExecutor(ExecutorHttpDriver):
                 await asyncio.sleep(0.05)
         except BaseException:
             # BaseException: a deadline-driven cancel must also reap the
-            # half-started sandbox process, not leak it.
+            # half-started sandbox process, not leak it. (The caller's
+            # journal guard records the 'failed' event.)
             box.destroy()
             raise
+
+    def _spawned_ready(self, box: NativeSandbox) -> NativeSandbox:
+        self.journal.record(box.name, "ready")
+        return box
 
     def shutdown(self) -> None:
         """Kill every warm sandbox (no idle processes left behind).
@@ -503,7 +577,9 @@ class NativeProcessCodeExecutor(ExecutorHttpDriver):
         """
         self._closed = True
         while self._queue:
-            self._queue.popleft().destroy()
+            box = self._queue.popleft()
+            self.journal.record(box.name, "reaped", reason="shutdown")
+            box.destroy()
         # The spawn thread's exit triggers PDEATHSIG in any sandbox it forked
         # — including one currently serving a request. That is the intended
         # contract: shutdown() terminates the backend; an execution still in

@@ -61,6 +61,27 @@ def report(name: str, ok: bool, detail: str = "") -> None:
         failures.append(name)
 
 
+def dump_fleet(tag: str, executor) -> None:
+    """Print the stack's fleet event journal (docs/observability.md) so a
+    chaos run shows WHICH sandboxes died and why, not just pass/fail."""
+    from bee_code_interpreter_tpu.observability import find_journal
+
+    journal = find_journal(executor)
+    events = journal.events()
+    print(f"  fleet journal after '{tag}' ({len(events)} events, oldest first):")
+    for e in reversed(events):
+        line = f"    {e['pod']:<24} -> {e['state']:<9}"
+        if e.get("spawn_s") is not None:
+            line += f" spawn={e['spawn_s'] * 1000:.0f}ms"
+        if e.get("executions") is not None:
+            line += f" execs={e['executions']}"
+        if e.get("reason"):
+            line += f" reason={e['reason']}"
+        if e.get("detail"):
+            line += f" ({e['detail']})"
+        print(line)
+
+
 def make_stack(tmp: Path, storage, metrics: Registry, clock: ManualClock):
     """One production-shaped stack (fake cluster + real resilience wiring).
     Each scenario gets a fresh one so breaker windows don't bleed across."""
@@ -104,6 +125,13 @@ async def main() -> int:
         # 1. healthy path
         result = await executor.execute("print(21 * 2)")
         report("healthy execute via fake pod", result.stdout == "42\n")
+        usage = result.usage or {}
+        report(
+            "execution usage accounted",
+            usage.get("cpu_user_s", 0) > 0 and usage.get("wall_s", 0) > 0,
+            f"cpu={usage.get('cpu_user_s', 0):.3f}s wall={usage.get('wall_s', 0):.3f}s",
+        )
+        dump_fleet("healthy path", executor)
 
         # 2. deadline bounds a hung spawn
         faults.script("pod_wait", Hang(10.0))
@@ -118,6 +146,7 @@ async def main() -> int:
                 elapsed < 0.55,
                 f"elapsed {elapsed * 1000:.0f}ms for a 500ms deadline",
             )
+        dump_fleet("deadline bound", executor)
 
         # 3. breaker trips -> fallback serves -> half-open -> closed
         #    (fresh stack: its breaker window starts clean)
@@ -145,6 +174,7 @@ async def main() -> int:
             and breaker2.state is BreakerState.CLOSED,
             f"state={breaker2.state.name}",
         )
+        dump_fleet("breaker + fallback", executor2)
 
         # 4. admission shedding never hangs
         admission = AdmissionController(

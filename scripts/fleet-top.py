@@ -1,0 +1,111 @@
+#!/usr/bin/env python3
+"""One-shot text rendering of the fleet state (docs/observability.md).
+
+Fetches ``GET /v1/fleet`` (and optionally the recent lifecycle events) from
+a running service and prints a `top`-style table — the quickest answer to
+"what is the pool doing right now" without curl+jq gymnastics.
+
+    python scripts/fleet-top.py [--url http://localhost:50081] [--events N]
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+import httpx
+
+
+def fmt_age(seconds: float | None) -> str:
+    if seconds is None:
+        return "-"
+    if seconds < 60:
+        return f"{seconds:.1f}s"
+    if seconds < 3600:
+        return f"{seconds / 60:.1f}m"
+    return f"{seconds / 3600:.1f}h"
+
+
+def render_snapshot(snap: dict) -> str:
+    lines = []
+    by_state = ", ".join(
+        f"{state}={count}" for state, count in sorted(snap["by_state"].items())
+    ) or "empty"
+    lines.append(
+        f"fleet: {snap['live']} live ({by_state})  "
+        f"utilization={snap['utilization']:.0%}  "
+        f"executions_total={snap['executions_total']}"
+    )
+    lifetime = snap.get("lifetime", {})
+    lines.append(
+        "lifetime: "
+        + "  ".join(
+            f"{state}={lifetime.get(state, 0)}"
+            for state in ("spawning", "ready", "released", "reaped", "failed")
+        )
+    )
+    lines.append("")
+    header = f"{'POD':<28} {'STATE':<10} {'AGE':>7} {'SPAWN':>8} {'WORKERS':>7} {'EXECS':>5}"
+    lines.append(header)
+    lines.append("-" * len(header))
+    for pod in snap["pods"]:
+        spawn = f"{pod['spawn_s'] * 1000:.0f}ms" if pod.get("spawn_s") else "-"
+        lines.append(
+            f"{pod['pod']:<28} {pod['state']:<10} {fmt_age(pod['age_s']):>7} "
+            f"{spawn:>8} {pod['workers']:>7} {pod['executions']:>5}"
+        )
+    if not snap["pods"]:
+        lines.append("(no live sandboxes)")
+    return "\n".join(lines)
+
+
+def render_events(events: list[dict]) -> str:
+    lines = ["", f"recent events (newest first, {len(events)}):"]
+    for e in events:
+        line = f"  {e['pod']:<28} -> {e['state']:<9}"
+        if e.get("spawn_s") is not None:
+            line += f" spawn={e['spawn_s'] * 1000:.0f}ms"
+        if e.get("reason"):
+            line += f" reason={e['reason']}"
+        if e.get("detail"):
+            line += f" ({e['detail']})"
+        lines.append(line)
+    return "\n".join(lines)
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(
+        description="Render GET /v1/fleet as a one-shot text table."
+    )
+    parser.add_argument("--url", default="http://localhost:50081")
+    parser.add_argument(
+        "--events",
+        type=int,
+        default=0,
+        metavar="N",
+        help="also show the last N lifecycle events",
+    )
+    args = parser.parse_args()
+    base = args.url.rstrip("/")
+    try:
+        with httpx.Client(timeout=10.0) as client:
+            snap = client.get(f"{base}/v1/fleet").raise_for_status().json()
+            print(render_snapshot(snap))
+            if args.events > 0:
+                events = (
+                    client.get(
+                        f"{base}/v1/fleet/events",
+                        params={"limit": args.events},
+                    )
+                    .raise_for_status()
+                    .json()["events"]
+                )
+                print(render_events(events))
+    except httpx.HTTPError as e:
+        print(f"fleet-top: cannot reach {base}: {e}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -207,6 +207,7 @@ async def test_server_reflection_list_and_describe(grpc_server):
     # from which the Execute method can be reconstructed.
     from google.protobuf import descriptor_pb2, descriptor_pool
     from bee_code_interpreter_tpu.api.grpc_server import (
+        FLEET_SERVICE_NAME,
         HEALTH_SERVICE_NAME,
         REFLECTION_SERVICE_NAME,
         SERVICE_NAME,
@@ -234,7 +235,12 @@ async def test_server_reflection_list_and_describe(grpc_server):
             assert len(responses) == 3
 
             listed = {s.name for s in responses[0].list_services_response.service}
-            assert listed == {SERVICE_NAME, HEALTH_SERVICE_NAME, REFLECTION_SERVICE_NAME}
+            assert listed == {
+                SERVICE_NAME,
+                FLEET_SERVICE_NAME,
+                HEALTH_SERVICE_NAME,
+                REFLECTION_SERVICE_NAME,
+            }
 
             files = responses[1].file_descriptor_response.file_descriptor_proto
             assert files  # at least the defining file

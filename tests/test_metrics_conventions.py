@@ -63,6 +63,24 @@ def test_every_registered_metric_follows_conventions(tmp_path):
     metrics = registry.metrics
     assert len(metrics) >= 20, sorted(metrics)  # the wiring actually ran
 
+    # The fleet-observability metrics (ISSUE 3) must be part of the wired
+    # surface, so this lint covers their prefix/HELP/unit conventions too:
+    # silently dropping one of them from the composition root would
+    # otherwise pass unnoticed.
+    for required in (
+        "bci_pool_spawn_seconds",
+        "bci_pool_utilization",
+        "bci_pod_reaped_total",
+        "bci_execution_cpu_seconds",
+        "bci_execution_peak_rss_bytes",
+    ):
+        assert required in metrics, f"{required}: not registered by the wiring"
+    assert isinstance(metrics["bci_pool_spawn_seconds"], Histogram)
+    assert isinstance(metrics["bci_pool_utilization"], Gauge)
+    assert isinstance(metrics["bci_pod_reaped_total"], Counter)
+    assert isinstance(metrics["bci_execution_cpu_seconds"], Histogram)
+    assert isinstance(metrics["bci_execution_peak_rss_bytes"], Histogram)
+
     for name, metric in metrics.items():
         assert name.startswith("bci_"), (
             f"{name}: metrics must live in the bci_ namespace"

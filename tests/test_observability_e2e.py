@@ -32,7 +32,10 @@ POD_LOGGER = "bee_code_interpreter_tpu.runtime.executor_server"
 EDGE_LOGGER = "bee_code_interpreter_tpu.api.http_server"
 
 
-def make_app(pods, storage, metrics, tracer):
+def make_stack(pods, storage, metrics, tracer):
+    """(app, executor): the aiohttp edge over the REAL KubernetesCodeExecutor
+    against the fake cluster — the executor is returned so tests can reach
+    its fleet journal / pool directly."""
     config = Config(
         executor_backend="kubernetes",
         executor_port=pods.port,
@@ -46,13 +49,18 @@ def make_app(pods, storage, metrics, tracer):
         metrics=metrics,
         ip_poll_interval_s=0.02,
     )
-    return create_http_server(
+    app = create_http_server(
         code_executor=executor,
         custom_tool_executor=CustomToolExecutor(code_executor=executor),
         metrics=metrics,
         admission=AdmissionController(metrics=metrics),
         tracer=tracer,
     )
+    return app, executor
+
+
+def make_app(pods, storage, metrics, tracer):
+    return make_stack(pods, storage, metrics, tracer)[0]
 
 
 async def with_client(app, fn):
@@ -265,6 +273,293 @@ async def test_concurrent_executes_do_not_cross_contaminate_ids(
         await with_client(app, go)
     finally:
         edge_logger.removeFilter(log_filter)
+        await pods.close()
+
+
+async def test_fleet_usage_and_metrics_tell_one_requests_full_story(
+    tmp_path, storage
+):
+    """ISSUE 3 acceptance: after one request through the fake-k8s path,
+    /v1/fleet/events shows the serving pod's spawn→assigned→executing→
+    released transitions, ExecuteResponse.usage reports nonzero cpu/wall/
+    byte figures that match the trace span's usage.* attributes, and
+    /metrics exposes the new pool + execution histograms."""
+    pods = FakeExecutorPods(tmp_path / "pods")
+    metrics = Registry()
+    tracer = Tracer(metrics=metrics)
+    app, _executor = make_stack(pods, storage, metrics, tracer)
+
+    async def go(client: TestClient):
+        seed = await (
+            await client.post(
+                "/v1/execute",
+                json={"source_code": "open('in.txt', 'w').write('z' * 64)"},
+            )
+        ).json()
+        resp = await client.post(
+            "/v1/execute",
+            json={
+                "source_code": (
+                    "print(open('in.txt').read()[:1])\n"
+                    "open('out.txt', 'w').write('y' * 128)"
+                ),
+                "files": seed["files"],
+            },
+        )
+        body = await resp.json()
+        assert resp.status == 200
+
+        # --- usage: nonzero cpu/wall/byte figures in the response ---
+        usage = body["usage"]
+        assert usage["cpu_user_s"] > 0
+        assert usage["wall_s"] > 0
+        assert usage["max_rss_bytes"] > 0
+        assert usage["uploaded_bytes"] == 64
+        assert usage["downloaded_bytes"] == 128
+        assert usage["workspace_bytes_written"] >= 128
+
+        # --- ...matching the trace root span's usage.* attributes ---
+        detail = await (
+            await client.get(f"/v1/traces/{body['trace_id']}")
+        ).json()
+        root = next(s for s in detail["spans"] if s["parent_id"] is None)
+        for key in (
+            "cpu_user_s", "wall_s", "max_rss_bytes",
+            "uploaded_bytes", "downloaded_bytes",
+        ):
+            assert root["attributes"][f"usage.{key}"] == str(usage[key])
+
+        # --- fleet journal: the serving pod's full story ---
+        events = (
+            await (await client.get("/v1/fleet/events?limit=50")).json()
+        )["events"]
+        pod_names = {e["pod"] for e in events}
+        assert len(pod_names) == 2  # one pod per request
+        by_pod = {}
+        for e in reversed(events):  # chronological
+            by_pod.setdefault(e["pod"], []).append(e["state"])
+        for states in by_pod.values():
+            assert states == [
+                "spawning", "ready", "assigned", "executing", "released",
+            ]
+        snap = await (await client.get("/v1/fleet")).json()
+        assert snap["live"] == 0  # single-use: nothing outlives its request
+        assert snap["executions_total"] == 2
+        assert snap["lifetime"]["released"] == 2
+
+        # --- the new pool + execution metrics are exposed ---
+        text = await (await client.get("/metrics")).text()
+        assert "bci_pool_spawn_seconds_count 2" in text
+        assert "bci_pool_utilization 0" in text
+        assert "bci_execution_cpu_seconds_count 2" in text
+        assert "bci_execution_peak_rss_bytes_count 2" in text
+
+    try:
+        await with_client(app, go)
+    finally:
+        await pods.close()
+
+
+async def test_traces_endpoint_supports_limit_and_min_duration(
+    tmp_path, storage
+):
+    pods = FakeExecutorPods(tmp_path / "pods")
+    metrics = Registry()
+    tracer = Tracer(metrics=metrics)
+    app = make_app(pods, storage, metrics, tracer)
+
+    async def go(client: TestClient):
+        fast = await (
+            await client.post("/v1/execute", json={"source_code": "pass"})
+        ).json()
+        slow = await (
+            await client.post(
+                "/v1/execute",
+                json={"source_code": "import time; time.sleep(0.3)"},
+            )
+        ).json()
+
+        listed = await (await client.get("/v1/traces")).json()
+        assert len(listed["traces"]) == 2
+
+        limited = await (await client.get("/v1/traces?limit=1")).json()
+        assert len(limited["traces"]) == 1
+        # newest first: the slow request came second
+        assert limited["traces"][0]["trace_id"] == slow["trace_id"]
+
+        slow_only = await (
+            await client.get("/v1/traces?min_duration_ms=250")
+        ).json()
+        assert {t["trace_id"] for t in slow_only["traces"]} == {
+            slow["trace_id"]
+        }
+        assert fast["trace_id"] not in {
+            t["trace_id"] for t in slow_only["traces"]
+        }
+
+        both = await (
+            await client.get("/v1/traces?limit=5&min_duration_ms=0")
+        ).json()
+        assert len(both["traces"]) == 2
+
+        for bad in (
+            "/v1/traces?limit=banana",
+            "/v1/traces?min_duration_ms=soup",
+            "/v1/traces?limit=-1",
+        ):
+            assert (await client.get(bad)).status == 400
+
+    try:
+        await with_client(app, go)
+    finally:
+        await pods.close()
+
+
+async def test_healthz_verbose_reports_pool_breakers_and_fleet(
+    tmp_path, storage
+):
+    pods = FakeExecutorPods(tmp_path / "pods")
+    metrics = Registry()
+    tracer = Tracer(metrics=metrics)
+    app = make_app(pods, storage, metrics, tracer)
+
+    async def go(client: TestClient):
+        plain = await (await client.get("/healthz")).json()
+        assert plain == {"status": "ok"}  # terse view unchanged
+        explicit_off = await (await client.get("/healthz?verbose=0")).json()
+        assert explicit_off == {"status": "ok"}  # =0 is not truthy
+
+        await client.post("/v1/execute", json={"source_code": "print(1)"})
+        verbose = await (await client.get("/healthz?verbose=1")).json()
+        assert verbose["status"] == "ok"
+        assert verbose["pool"] == {"ready": 0, "spawning": 0}
+        assert verbose["breakers"] == {
+            "k8s-spawn": "closed", "k8s-http": "closed",
+        }
+        assert verbose["fleet"]["executions_total"] == 1
+        assert verbose["fleet"]["live"] == 0
+
+    try:
+        await with_client(app, go)
+    finally:
+        await pods.close()
+
+
+async def test_profile_sandbox_injects_trace_dir_and_reports_artifacts(
+    local_executor,
+):
+    app = create_http_server(
+        code_executor=local_executor,
+        custom_tool_executor=CustomToolExecutor(code_executor=local_executor),
+    )
+
+    async def go(client: TestClient):
+        resp = await client.post(
+            "/v1/profile",
+            json={
+                "source_code": (
+                    "import os\n"
+                    "d = os.environ['BCI_PROFILE_DIR']\n"
+                    "print(d)\n"
+                    "os.makedirs(os.path.basename(d), exist_ok=True)\n"
+                    "open(os.path.join(os.path.basename(d), 'trace.pb'),"
+                    " 'w').write('fake-trace')"
+                ),
+            },
+        )
+        body = await resp.json()
+        assert resp.status == 200
+        # the shim's env trigger was injected...
+        assert body["stdout"] == "/workspace/.bci-profile\n"
+        assert body["profile_dir"] == "/workspace/.bci-profile"
+        # ...and artifacts written under it ride the changed-file map
+        assert body["profile_files"] == [
+            "/workspace/.bci-profile/trace.pb"
+        ]
+        assert set(body["files"]) == {"/workspace/.bci-profile/trace.pb"}
+        assert body["usage"]["cpu_user_s"] > 0
+
+        # missing source_code for sandbox target is a validation error
+        resp = await client.post("/v1/profile", json={"target": "sandbox"})
+        assert resp.status == 422
+        # serving target without an attached engine is explicit
+        resp = await client.post("/v1/profile", json={"target": "serving"})
+        assert resp.status == 501
+
+    await with_client(app, go)
+
+
+async def test_profile_serving_captures_engine_steps(tmp_path, local_executor):
+    from bee_code_interpreter_tpu.observability import ServingProfiler
+
+    class Stepper:
+        steps = 0
+
+        def step(self):
+            Stepper.steps += 1
+
+    app = create_http_server(
+        code_executor=local_executor,
+        custom_tool_executor=CustomToolExecutor(code_executor=local_executor),
+        profiler=ServingProfiler(Stepper(), trace_root=tmp_path),
+    )
+
+    async def go(client: TestClient):
+        resp = await client.post(
+            "/v1/profile", json={"target": "serving", "steps": 4}
+        )
+        body = await resp.json()
+        assert resp.status == 200
+        assert body["target"] == "serving"
+        assert body["steps"] == 4
+        assert Stepper.steps == 4
+        assert body["trace_dir"].startswith(str(tmp_path))
+
+    await with_client(app, go)
+
+
+async def test_grpc_fleet_service_serves_snapshot_and_events(
+    tmp_path, storage
+):
+    """The gRPC spelling of /v1/fleet: JSON-bytes FleetService methods
+    backed by the same journal the executor records into."""
+    import grpc.aio
+
+    from bee_code_interpreter_tpu.api.grpc_server import GrpcServer, fleet_stubs
+
+    pods = FakeExecutorPods(tmp_path / "pods")
+    config = Config(
+        executor_backend="kubernetes",
+        executor_port=pods.port,
+        executor_pod_queue_target_length=0,
+        pod_ready_timeout_s=5,
+    )
+    executor = KubernetesCodeExecutor(
+        kubectl=FakeKubectl(pods),
+        storage=storage,
+        config=config,
+        ip_poll_interval_s=0.02,
+    )
+    server = GrpcServer(
+        code_executor=executor,
+        custom_tool_executor=CustomToolExecutor(code_executor=executor),
+    )
+    port = await server.start("127.0.0.1:0")
+    try:
+        await executor.execute("print('hi')")
+        import json as _json
+
+        async with grpc.aio.insecure_channel(f"127.0.0.1:{port}") as channel:
+            stubs = fleet_stubs(channel)
+            snap = _json.loads(await stubs["GetFleet"](b""))
+            assert snap["executions_total"] == 1
+            events = _json.loads(
+                await stubs["GetFleetEvents"](_json.dumps({"limit": 2}).encode())
+            )["events"]
+            assert len(events) == 2
+            assert events[0]["state"] == "released"
+    finally:
+        await server.stop(grace=0.1)
         await pods.close()
 
 
