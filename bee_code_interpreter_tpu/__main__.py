@@ -1,7 +1,16 @@
 """Service entry point: HTTP + gRPC servers sharing one asyncio loop.
 
 Reference: __main__.py:22-36 (uvicorn + grpc.aio under aiorun). Here: aiohttp
-AppRunner + grpc.aio, plain asyncio.run with signal-driven shutdown.
+AppRunner + grpc.aio, plain asyncio.run with signal-driven GRACEFUL shutdown
+(docs/resilience.md "Graceful drain"):
+
+1. SIGTERM/SIGINT flips the service into draining mode — new sandbox-bound
+   work is rejected retryably (HTTP 503 + ``Retry-After``, gRPC UNAVAILABLE,
+   health ``NOT_SERVING``) while in-flight executions keep running.
+2. Teardown waits up to ``APP_DRAIN_GRACE_S`` for the in-flight work to
+   finish (a second signal skips the wait).
+3. Servers stop, the supervisor and warm pool are torn down, and the
+   executor's HTTP client is closed deterministically (awaited in-loop).
 """
 
 from __future__ import annotations
@@ -21,7 +30,11 @@ async def main() -> None:
     ctx = ApplicationContext()
 
     host, _, port = ctx.config.http_listen_addr.rpartition(":")
-    runner = web.AppRunner(ctx.http_server)
+    # Short cleanup bound: by the time runner.cleanup() runs, the drain
+    # already waited APP_DRAIN_GRACE_S for in-flight work — aiohttp's 60s
+    # default would let one wedged handler outlive a k8s termination grace
+    # and skip the pool teardown entirely.
+    runner = web.AppRunner(ctx.http_server, shutdown_timeout=3.0)
     await runner.setup()
     site = web.TCPSite(runner, host or "0.0.0.0", int(port))
     await site.start()
@@ -30,7 +43,7 @@ async def main() -> None:
     await ctx.grpc_server.start(ctx.config.grpc_listen_addr)
     logger.info("gRPC server listening on %s", ctx.config.grpc_listen_addr)
 
-    sweeper = ctx.start_storage_sweeper()
+    ctx.start_storage_sweeper()
 
     stop = asyncio.Event()
     loop = asyncio.get_running_loop()
@@ -38,15 +51,44 @@ async def main() -> None:
         loop.add_signal_handler(sig, stop.set)
     await stop.wait()
 
-    if sweeper is not None:
-        sweeper.cancel()
-    await ctx.grpc_server.stop()
+    # Graceful drain: stop admitting, let in-flight executions finish. A
+    # second signal during the grace period forces immediate teardown.
+    ctx.begin_drain()
+    force = asyncio.Event()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        loop.remove_signal_handler(sig)
+        loop.add_signal_handler(sig, force.set)
+    grace_s = ctx.config.drain_grace_s
+    logger.info(
+        "Draining: waiting up to %.0fs for %d in-flight request(s)",
+        grace_s,
+        ctx.drain.in_flight,
+    )
+    wait = asyncio.ensure_future(ctx.drain.wait_idle(grace_s))
+    forced = asyncio.ensure_future(force.wait())
+    done, _ = await asyncio.wait(
+        {wait, forced}, return_when=asyncio.FIRST_COMPLETED
+    )
+    forced.cancel()
+    if wait in done and wait.result():
+        logger.info("Drain complete: no requests in flight")
+    else:
+        wait.cancel()
+        logger.warning(
+            "Drain %s with %d request(s) still in flight; tearing down",
+            "interrupted" if force.is_set() else "grace expired",
+            ctx.drain.in_flight,
+        )
+
+    # Short stop grace for the same reason: the drain wait above is the
+    # real in-flight budget; teardown must stay inside the supervisor's
+    # (k8s terminationGracePeriodSeconds) remaining allowance.
+    await ctx.grpc_server.stop(grace=2.0)
     await runner.cleanup()
-    # Tear down any warm sandboxes (only if the executor was ever built —
-    # touching the cached_property here would needlessly construct it).
-    executor = ctx.__dict__.get("code_executor")
-    if executor is not None and hasattr(executor, "shutdown"):
-        executor.shutdown()
+    # Supervisor, storage sweeper, and warm sandboxes torn down awaited —
+    # the old path scheduled the executor's HTTP-client close as a task the
+    # dying loop could cancel before it ran.
+    await ctx.aclose()
 
 
 def run() -> None:

@@ -102,11 +102,13 @@ class CodeInterpreterServicer:
         request_deadline_s: float | None = None,
         metrics: Registry | None = None,
         tracer: Tracer | None = None,
+        drain=None,  # resilience.DrainController
     ) -> None:
         self._code_executor = code_executor
         self._custom_tool_executor = custom_tool_executor
         self._admission = admission
         self._request_deadline_s = request_deadline_s
+        self._drain = drain
         self._tracer = tracer or Tracer(metrics=metrics)
         self._deadline_exceeded_total = (
             metrics.counter(
@@ -157,14 +159,33 @@ class CodeInterpreterServicer:
         admission gate, mapping the shared shed/deadline abort contract
         (docs/resilience.md) — the one place it is spelled for gRPC.
         ``run(deadline)`` returns the success response."""
+        # Drain check BEFORE admission (mirror of the HTTP edge): a
+        # draining replica rejects new work retryably while in-flight RPCs
+        # (tracked below) run to completion. Health answers NOT_SERVING.
+        if self._drain is not None and self._drain.draining:
+            context.set_trailing_metadata(
+                (("retry-after-s", f"{self._drain.retry_after_s:g}"),)
+            )
+            await context.abort(
+                grpc.StatusCode.UNAVAILABLE,
+                "service draining; retry against another replica",
+            )
         deadline = self._new_deadline(context)
         try:
-            async with (
-                self._admission.admit(deadline)
-                if self._admission is not None
+            # track() covers the admission wait too (mirror of the HTTP
+            # edge): a queued waiter was admitted past the drain check and
+            # WILL execute — teardown must wait for it.
+            with (
+                self._drain.track()
+                if self._drain is not None
                 else nullcontext()
             ):
-                return await run(deadline)
+                async with (
+                    self._admission.admit(deadline)
+                    if self._admission is not None
+                    else nullcontext()
+                ):
+                    return await run(deadline)
         except AdmissionRejected as e:
             context.set_trailing_metadata(
                 (("retry-after-s", f"{e.retry_after_s:g}"),)
@@ -588,6 +609,7 @@ class GrpcServer:
         metrics: Registry | None = None,
         tracer: Tracer | None = None,
         fleet: FleetJournal | None = None,
+        drain=None,  # resilience.DrainController
     ) -> None:
         self._servicer = CodeInterpreterServicer(
             code_executor,
@@ -596,6 +618,7 @@ class GrpcServer:
             request_deadline_s=request_deadline_s,
             metrics=metrics,
             tracer=tracer,
+            drain=drain,
         )
         # Mirror the HTTP edge: use the executor backend's own journal when
         # one exists (find_journal is the one shared discovery rule), else
@@ -609,6 +632,18 @@ class GrpcServer:
         self._tls_cert_key = tls_cert_key
         self._tls_ca_cert = tls_ca_cert
         self._server: grpc.aio.Server | None = None
+        if drain is not None:
+            # The drain's first visible effect on this transport: standard
+            # health probers see NOT_SERVING and stop routing traffic here.
+            drain.on_drain(self.enter_drain)
+
+    def enter_drain(self) -> None:
+        """Flip gRPC health to NOT_SERVING (probers stop routing new traffic
+        here) while in-flight RPCs keep running."""
+        for service in ("", SERVICE_NAME):
+            self.health.set_status(
+                service, health_pb2.HealthCheckResponse.NOT_SERVING
+            )
 
     async def start(self, listen_addr: str) -> int:
         """Start serving; returns the bound port (useful with ':0')."""
@@ -644,12 +679,9 @@ class GrpcServer:
 
     async def stop(self, grace: float = 5.0) -> None:
         if self._server is not None:
-            # Flip health to NOT_SERVING before the drain so probers stop
+            # Flip health to NOT_SERVING before the stop so probers stop
             # routing new traffic here while in-flight RPCs finish.
-            for service in ("", SERVICE_NAME):
-                self.health.set_status(
-                    service, health_pb2.HealthCheckResponse.NOT_SERVING
-                )
+            self.enter_drain()
             await self._server.stop(grace)
 
     async def wait_for_termination(self) -> None:

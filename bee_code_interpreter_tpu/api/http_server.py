@@ -110,6 +110,8 @@ def create_http_server(
     tracer: Tracer | None = None,
     fleet: FleetJournal | None = None,
     profiler=None,  # observability.ServingProfiler for POST /v1/profile
+    drain=None,  # resilience.DrainController for graceful shutdown
+    supervisor=None,  # resilience.PoolSupervisor, surfaced on /v1/fleet
 ) -> web.Application:
     app = web.Application(client_max_size=1 << 30)
     metrics = metrics or Registry()
@@ -140,12 +142,29 @@ def create_http_server(
         (docs/resilience.md) — the one place it is spelled for HTTP.
         ``run(deadline)`` returns the success response. The admission gate
         traces its own acquire as the ``admission`` stage span."""
+        # Drain check BEFORE admission: a draining replica must not queue
+        # new work it has promised to finish — 503 + Retry-After tells the
+        # client (or the balancer) to go elsewhere, while requests already
+        # in flight (tracked below) run to completion.
+        if drain is not None and drain.draining:
+            return web.json_response(
+                {"detail": "Service draining; retry against another replica"},
+                status=503,
+                headers={"Retry-After": str(max(1, math.ceil(drain.retry_after_s)))},
+            )
         deadline = Deadline.after(request_deadline_s) if request_deadline_s else None
         try:
-            async with (
-                admission.admit(deadline) if admission is not None else nullcontext()
-            ):
-                return await run(deadline)
+            # track() covers the admission wait too: a request already
+            # granted (or queued for) a slot when the drain begins was
+            # admitted past the drain check and WILL execute — teardown
+            # must wait for it, not just for bodies already running.
+            with drain.track() if drain is not None else nullcontext():
+                async with (
+                    admission.admit(deadline)
+                    if admission is not None
+                    else nullcontext()
+                ):
+                    return await run(deadline)
         except AdmissionRejected as e:
             logger.warning("Request shed: %s", e)
             return web.json_response(
@@ -368,12 +387,20 @@ def create_http_server(
         return await with_resilience(run)
 
     async def healthz(request: web.Request) -> web.Response:
-        body: dict = {"status": "ok"}
+        # "draining" is a distinct liveness answer (still HTTP 200: the
+        # process is healthy, just finishing up) so preStop hooks and
+        # health_check.py can tell a draining replica from a dead one.
+        draining = drain is not None and drain.draining
+        body: dict = {"status": "draining" if draining else "ok"}
         # explicit truthy values only: ?verbose=0 / =false must stay terse
         if request.query.get("verbose", "").lower() in ("1", "true", "yes", "on"):
             # Deep health: pool occupancy, breaker states, fleet aggregates
             # — the "why is it unhealthy" view a bare 200 can't carry.
             body.update(_executor_health(code_executor))
+            if draining:
+                body["drain_inflight"] = drain.in_flight
+            if supervisor is not None:
+                body["supervisor"] = supervisor.snapshot()
             snapshot = fleet.snapshot()
             body["fleet"] = {
                 "live": snapshot["live"],
@@ -433,7 +460,14 @@ def create_http_server(
         return web.json_response(trace.to_dict())
 
     async def fleet_snapshot(_request: web.Request) -> web.Response:
-        return web.json_response(fleet.snapshot())
+        snap = fleet.snapshot()
+        # Supervisor + drain state ride on the fleet view: "is anything
+        # healing or draining right now" belongs next to "what is the pool
+        # doing" (scripts/fleet-top.py renders both).
+        if supervisor is not None:
+            snap["supervisor"] = supervisor.snapshot()
+        snap["draining"] = bool(drain is not None and drain.draining)
+        return web.json_response(snap)
 
     async def fleet_events(request: web.Request) -> web.Response:
         try:

@@ -40,6 +40,9 @@ class ApplicationContext:
         self.fleet = FleetJournal(
             metrics=self.metrics, max_events=self.config.fleet_max_events
         )
+        # Pool supervisor (resilience/supervisor.py): created with the pool
+        # executor it reconciles, None for the pool-less local backend.
+        self.supervisor = None
 
     @cached_property
     def storage(self) -> Storage:
@@ -67,6 +70,75 @@ class ApplicationContext:
 
         self._storage_sweeper_task = asyncio.create_task(sweeper())
         return self._storage_sweeper_task
+
+    @cached_property
+    def drain(self):
+        """Graceful-drain state shared by both transports and ``__main__``:
+        one flag, one in-flight count, one grace wait for the whole service."""
+        from bee_code_interpreter_tpu.resilience import DrainController
+
+        return DrainController(
+            metrics=self.metrics,
+            retry_after_s=self.config.admission_retry_after_s,
+        )
+
+    def begin_drain(self) -> None:
+        """Flip the service into draining mode (SIGTERM does this via
+        ``__main__``): edges reject new work retryably, gRPC health goes
+        NOT_SERVING, the supervisor stops replenishing the pool. In-flight
+        executions keep running; await ``drain.wait_idle(grace)`` for them."""
+        self.drain.begin()
+
+    async def aclose(self) -> None:
+        """Deterministic teardown for the drain path: stop the supervisor
+        and storage sweeper, then close the executor backend (awaited —
+        never a fire-and-forget task a dying loop can cancel)."""
+        sweeper = getattr(self, "_storage_sweeper_task", None)
+        if sweeper is not None:
+            sweeper.cancel()
+        if self.supervisor is not None:
+            await self.supervisor.stop()
+        executor = self.__dict__.get("code_executor")
+        if executor is not None:
+            from bee_code_interpreter_tpu.observability import unwrap_executor
+
+            backend = unwrap_executor(executor)
+            aclose = getattr(backend, "aclose", None)
+            if aclose is not None:
+                await aclose()
+            elif hasattr(backend, "shutdown"):
+                backend.shutdown()
+
+    def _wrap_pool_executor(self, executor):
+        """Shared pool-backend wiring: the replay/hedge front and the pool
+        supervisor (owned per executor; its loop starts only when one runs —
+        mirroring the warmup deferral below)."""
+        from bee_code_interpreter_tpu.resilience import (
+            HedgingExecutor,
+            PoolSupervisor,
+        )
+
+        cfg = self.config
+        self.supervisor = PoolSupervisor(
+            executor,
+            interval_s=cfg.supervisor_interval_s,
+            execute_hard_cap_s=cfg.resolved_execution_hard_cap_s(),
+            metrics=self.metrics,
+            drain=self.drain,
+        )
+        if cfg.supervisor_interval_s > 0:
+            try:
+                asyncio.get_running_loop()
+            except RuntimeError:
+                pass
+            else:
+                self.supervisor.start()
+        return HedgingExecutor(
+            executor,
+            replay_max=cfg.execution_replay_max,
+            hedge_delay_s=cfg.hedge_delay_s,
+            metrics=self.metrics,
+        )
 
     @cached_property
     def admission(self):
@@ -119,7 +191,7 @@ class ApplicationContext:
                 else:
                     # anchored on the executor's task set (loop refs are weak)
                     executor._spawn_background(executor.fill_sandbox_queue())
-                return executor
+                return self._wrap_pool_executor(executor)
             return self._build_local_executor()
         from bee_code_interpreter_tpu.resilience import ResilientCodeExecutor
         from bee_code_interpreter_tpu.services.kubectl import Kubectl
@@ -152,7 +224,9 @@ class ApplicationContext:
         # backend's breaker is open (docs/resilience.md).
         fallback = self._build_local_executor() if self.config.fallback_to_local else None
         return ResilientCodeExecutor(
-            primary=executor, fallback=fallback, metrics=self.metrics
+            primary=self._wrap_pool_executor(executor),
+            fallback=fallback,
+            metrics=self.metrics,
         )
 
     def _register_pool_gauges(self, executor) -> None:
@@ -192,6 +266,8 @@ class ApplicationContext:
             request_deadline_s=self.config.request_deadline_s,
             tracer=self.tracer,
             fleet=self.fleet,
+            drain=self.drain,
+            supervisor=self.supervisor,
         )
 
     @cached_property
@@ -209,4 +285,5 @@ class ApplicationContext:
             metrics=self.metrics,
             tracer=self.tracer,
             fleet=self.fleet,
+            drain=self.drain,
         )

@@ -110,6 +110,33 @@ class Config(BaseModel):
     executor_retry_attempts: int = Field(default=3, ge=1)
     executor_retry_wait_min_s: float = Field(default=4.0, gt=0)
     executor_retry_wait_max_s: float = Field(default=10.0, gt=0)
+    # --- proactive resilience: supervisor / replay / hedge / drain ---
+    # Pool supervisor reconcile cadence: each sweep health-probes queued warm
+    # sandboxes (reaping dead ones), kills stuck executions, and replenishes
+    # the pool to target. 0 disables the background loop (sweeps can still be
+    # driven manually, e.g. by tests).
+    supervisor_interval_s: float = Field(default=10.0, ge=0)
+    # Warm-sandbox /healthz probe timeout (checkout pre-probe + supervisor
+    # sweeps); on the request path it is additionally clamped to the
+    # remaining checkout deadline.
+    health_probe_timeout_s: float = Field(default=2.0, gt=0)
+    # Stuck-execution watchdog: an execute in flight longer than this hard
+    # wall-clock cap gets its sandbox killed and fails as transient (replay /
+    # retry may still recover it). Unset derives execution_timeout_s +
+    # executor_http_timeout_s — strictly above any legitimate execution.
+    execution_hard_cap_s: float | None = Field(default=None, gt=0)
+    # Max transparent replays of an execution whose sandbox died mid-flight
+    # (safe: single-use sandboxes + content-addressed workspace snapshots;
+    # at-least-once semantics — see docs/resilience.md). 0 disables.
+    execution_replay_max: int = Field(default=1, ge=0)
+    # Opt-in hedged execution: when the primary attempt hasn't finished after
+    # this many seconds, launch the request on a second warm sandbox; first
+    # result wins, the loser is cancelled and reaped. Unset/0 disables.
+    hedge_delay_s: float | None = Field(default=None, ge=0)
+    # Graceful drain: after SIGTERM (or ctx.begin_drain()) the edges reject
+    # new work retryably while in-flight executions get up to this many
+    # seconds to finish before teardown.
+    drain_grace_s: float = Field(default=30.0, ge=0)
 
     # --- observability (new; see docs/observability.md) ---
     # APP_LOG_FORMAT=json swaps the default text formatter for one-line JSON
@@ -178,6 +205,14 @@ class Config(BaseModel):
     # empty values (env_ignore_empty), so APP_SHIM_DIR=none is the way to
     # disable it on a deployment.
     shim_dir: str | None = None
+
+    def resolved_execution_hard_cap_s(self) -> float:
+        """The stuck-execution watchdog cap: explicit when set, otherwise the
+        sum of the sandbox execution bound and the data-plane client timeout
+        — anything still in flight past that is wedged, not slow."""
+        if self.execution_hard_cap_s is not None:
+            return self.execution_hard_cap_s
+        return self.execution_timeout_s + self.executor_http_timeout_s
 
     def resolved_shim_dir(self) -> str | None:
         if self.shim_dir is not None:

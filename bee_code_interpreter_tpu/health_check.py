@@ -34,6 +34,25 @@ from bee_code_interpreter_tpu.resilience import RetryPolicy
 
 RETRYABLE_STATUS = (grpc.StatusCode.UNAVAILABLE, grpc.StatusCode.DEADLINE_EXCEEDED)
 
+# Exit codes: 1 wrong answer, 2 unreachable/unhealthy, 3 draining. The
+# distinct draining code lets k8s preStop / deploy tooling tell "finishing
+# up, don't restart me" from "dead, restart me".
+DRAINING_EXIT = 3
+
+
+def is_draining(verbose_body: dict) -> bool:
+    """True when the deep-health view says the service is in graceful drain
+    (``GET /healthz?verbose=1`` → ``{"status": "draining", ...}``)."""
+    return verbose_body.get("status") == "draining"
+
+
+class ServiceDraining(Exception):
+    """The probe target is in graceful drain (alive, rejecting new work)."""
+
+    def __init__(self, body: dict) -> None:
+        super().__init__("service is draining")
+        self.body = body
+
 
 def _channel(addr: str) -> grpc.aio.Channel:
     cert = os.environ.get("APP_GRPC_TLS_CERT")
@@ -62,7 +81,11 @@ async def _attempt(addr: str, timeout: float) -> None:
 
 
 async def check(
-    addr: str, timeout: float = 120.0, attempts: int = 3, backoff: float = 2.0
+    addr: str,
+    timeout: float = 120.0,
+    attempts: int = 3,
+    backoff: float = 2.0,
+    http_addr: str | None = None,
 ) -> None:
     policy = RetryPolicy(attempts=attempts, wait_min_s=backoff, wait_max_s=backoff * 8)
     last: grpc.aio.AioRpcError | None = None
@@ -73,6 +96,16 @@ async def check(
         except grpc.aio.AioRpcError as e:
             if e.code() not in RETRYABLE_STATUS:
                 raise
+            if e.code() is grpc.StatusCode.UNAVAILABLE and http_addr:
+                # A draining replica answers UNAVAILABLE deterministically:
+                # retrying just burns the whole backoff budget during every
+                # rolling restart. Ask the deep-health view once, now.
+                try:
+                    body = await verbose_health(http_addr, timeout=5.0)
+                except Exception:
+                    body = {}
+                if is_draining(body):
+                    raise ServiceDraining(body) from e
             last = e
             if attempt < attempts:
                 sleep_s = policy.backoff_s(attempt)
@@ -151,8 +184,20 @@ def main() -> None:
                 timeout=args.timeout,
                 attempts=args.attempts,
                 backoff=args.backoff,
+                http_addr=args.http_addr,
             )
         )
+    except ServiceDraining as e:
+        # UNAVAILABLE is what a *draining* replica answers too (it rejects
+        # new work while finishing in-flight executions) — the distinct exit
+        # lets preStop/readiness tooling tell "finishing up" from "dead".
+        print(
+            f"DRAINING: service at {args.addr} is in graceful drain "
+            f"({e.body.get('drain_inflight', 0)} in flight); "
+            "not accepting new work",
+            file=sys.stderr,
+        )
+        sys.exit(DRAINING_EXIT)
     except grpc.aio.AioRpcError as e:
         if e.code() is grpc.StatusCode.UNAVAILABLE:
             print(

@@ -46,12 +46,20 @@ BUSY_STATES = frozenset(("assigned", "executing"))
 
 
 def unwrap_executor(executor):
-    """The pool backend behind the resilience front
-    (``ResilientCodeExecutor.primary``) — the object holding the journal,
-    pool counters, and breakers. The ONE unwrap rule shared by every edge
-    (HTTP healthz, journal discovery on both transports), so they can never
-    disagree about which backend they inspect."""
-    return getattr(executor, "primary", executor)
+    """The pool backend behind the resilience fronts
+    (``ResilientCodeExecutor.primary`` → ``HedgingExecutor.primary`` → the
+    backend) — the object holding the journal, pool counters, and breakers.
+    Recursive because the fronts stack; the ONE unwrap rule shared by every
+    edge (HTTP healthz, journal discovery on both transports), so they can
+    never disagree about which backend they inspect."""
+    seen: set[int] = set()
+    while id(executor) not in seen:
+        seen.add(id(executor))
+        inner = getattr(executor, "primary", None)
+        if inner is None:
+            break
+        executor = inner
+    return executor
 
 
 def find_journal(executor) -> "FleetJournal | None":

@@ -25,7 +25,15 @@ from bee_code_interpreter_tpu.resilience.errors import (
     classify_http_status,
 )
 from bee_code_interpreter_tpu.resilience.executor import ResilientCodeExecutor
+from bee_code_interpreter_tpu.resilience.hedging import HedgingExecutor
 from bee_code_interpreter_tpu.resilience.retry import RetryPolicy, retryable
+from bee_code_interpreter_tpu.resilience.supervisor import (
+    DrainController,
+    InflightExecution,
+    InflightRegistry,
+    PoolSupervisor,
+    journal_sandbox_teardown,
+)
 
 __all__ = [
     "AdmissionController",
@@ -35,11 +43,17 @@ __all__ = [
     "CircuitBreaker",
     "Deadline",
     "DeadlineExceeded",
+    "DrainController",
+    "HedgingExecutor",
+    "InflightExecution",
+    "InflightRegistry",
+    "PoolSupervisor",
     "ResilientCodeExecutor",
     "RetryPolicy",
     "SandboxError",
     "SandboxFatalError",
     "SandboxTransientError",
     "classify_http_status",
+    "journal_sandbox_teardown",
     "retryable",
 ]

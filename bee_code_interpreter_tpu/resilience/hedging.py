@@ -1,0 +1,186 @@
+"""Tail-tolerant execution: transparent replay and opt-in hedging.
+
+Both are safe for the same structural reason: sandboxes are **single-use**
+and the workspace is a **content-addressed snapshot** — every attempt
+restores the identical input state on a fresh sandbox, so re-running is a
+pure re-play of the request, never a resume of half-mutated state. The
+caveat is the one the retry layer already documents (docs/resilience.md):
+user code with non-idempotent *external* side effects can run more than
+once; such workloads should keep replay at 0 and hedging off.
+
+- **Replay**: an execution whose sandbox died mid-flight (the backend
+  surfaced ``SandboxTransientError`` after its own retry budget) is
+  re-launched on a fresh sandbox instead of surfacing a 500 — immediately,
+  with no backoff (the sandbox is *gone*, not overloaded) — bounded by
+  ``APP_EXECUTION_REPLAY_MAX`` and the request deadline. Counted in
+  ``bci_execution_replays_total``.
+
+- **Hedging** (``APP_HEDGE_DELAY_S``, opt-in): when the primary attempt
+  has not finished after the hedge delay, the same request is launched on
+  a second warm sandbox; the first result wins and the loser is cancelled
+  (its sandbox torn down by the pool's single-use contract). Converts
+  p99 stragglers (slow pod, cold cache, flaky node) into ~p50 at the cost
+  of duplicate work. Counted in ``bci_hedge_total{outcome}`` — outcomes
+  ``primary_won`` / ``hedge_won`` / ``both_failed``, incremented only when
+  a hedge actually launched.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+
+from bee_code_interpreter_tpu.resilience.deadline import Deadline
+from bee_code_interpreter_tpu.resilience.errors import SandboxTransientError
+from bee_code_interpreter_tpu.services.code_executor import Result
+from bee_code_interpreter_tpu.utils.validation import AbsolutePath, Hash
+
+logger = logging.getLogger(__name__)
+
+
+class HedgingExecutor:
+    """Replay + hedge front over a pool executor backend.
+
+    Sits *inside* the resilience front (``ResilientCodeExecutor`` wraps
+    this, this wraps the pool backend): breaker-open rejections pass
+    through untouched for the fallback router, and the edge deadline's
+    hard wall-clock bound covers replays and hedges alike.
+    """
+
+    def __init__(
+        self,
+        primary,
+        *,
+        replay_max: int = 1,
+        hedge_delay_s: float | None = None,
+        metrics=None,
+    ) -> None:
+        self.primary = primary
+        self._replay_max = max(0, replay_max)
+        self._hedge_delay_s = (
+            hedge_delay_s if hedge_delay_s is not None and hedge_delay_s > 0 else None
+        )
+        self._replays_total = (
+            metrics.counter(
+                "bci_execution_replays_total",
+                "Executions replayed on a fresh sandbox after the previous one died mid-flight",
+            )
+            if metrics is not None
+            else None
+        )
+        self._hedge_total = (
+            metrics.counter(
+                "bci_hedge_total",
+                "Hedged executions by outcome (counted when a hedge launched)",
+            )
+            if metrics is not None
+            else None
+        )
+
+    @property
+    def journal(self):
+        """The backend's fleet journal (journal-discovery passthrough)."""
+        return getattr(self.primary, "journal", None)
+
+    async def execute(
+        self,
+        source_code: str,
+        files: dict[AbsolutePath, Hash] | None = None,
+        env: dict[str, str] | None = None,
+        timeout_s: float | None = None,
+        deadline: Deadline | None = None,
+    ) -> Result:
+        replays = 0
+        while True:
+            try:
+                return await self._execute_maybe_hedged(
+                    source_code, files, env, timeout_s, deadline
+                )
+            except SandboxTransientError as e:
+                if replays >= self._replay_max:
+                    raise
+                if deadline is not None and deadline.expired:
+                    raise
+                replays += 1
+                if self._replays_total is not None:
+                    self._replays_total.inc()
+                logger.warning(
+                    "Execution attempt died mid-flight (%s); replaying on a "
+                    "fresh sandbox (replay %d/%d)",
+                    e,
+                    replays,
+                    self._replay_max,
+                )
+
+    async def _execute_maybe_hedged(
+        self, source_code, files, env, timeout_s, deadline
+    ) -> Result:
+        if self._hedge_delay_s is None:
+            return await self.primary.execute(
+                source_code=source_code,
+                files=files,
+                env=env,
+                timeout_s=timeout_s,
+                deadline=deadline,
+            )
+
+        def attempt() -> asyncio.Task:
+            return asyncio.ensure_future(
+                self.primary.execute(
+                    source_code=source_code,
+                    files=files,
+                    env=env,
+                    timeout_s=timeout_s,
+                    deadline=deadline,
+                )
+            )
+
+        names: dict[asyncio.Task, str] = {attempt(): "primary"}
+        try:
+            primary_task = next(iter(names))
+            delay = self._hedge_delay_s
+            if deadline is not None and deadline.remaining() <= delay:
+                # No budget for a useful hedge: a second attempt bounded by
+                # the same expiring deadline can never win — don't burn a
+                # second warm sandbox on a doomed request.
+                return await primary_task
+            done, _ = await asyncio.wait({primary_task}, timeout=delay)
+            if done:
+                return primary_task.result()
+            names[attempt()] = "hedge"
+            pending = set(names)
+            first_error: BaseException | None = None
+            while pending:
+                done, pending = await asyncio.wait(
+                    pending, return_when=asyncio.FIRST_COMPLETED
+                )
+                for task in done:
+                    if task.exception() is None:
+                        for loser in pending:
+                            await self._cancel(loser)
+                        outcome = f"{names[task]}_won"
+                        if self._hedge_total is not None:
+                            self._hedge_total.inc(outcome=outcome)
+                        logger.info("Hedged execution resolved: %s", outcome)
+                        return task.result()
+                    if first_error is None:
+                        first_error = task.exception()
+            if self._hedge_total is not None:
+                self._hedge_total.inc(outcome="both_failed")
+            assert first_error is not None
+            raise first_error
+        except asyncio.CancelledError:
+            # Our caller was cancelled (deadline/shutdown): neither attempt
+            # may keep holding a sandbox.
+            for task in names:
+                if not task.done():
+                    await self._cancel(task)
+            raise
+
+    @staticmethod
+    async def _cancel(task: asyncio.Task) -> None:
+        task.cancel()
+        try:
+            await task
+        except BaseException:
+            pass

@@ -1,0 +1,428 @@
+"""Proactive pool resilience: supervisor, stuck-execution watchdog, drain.
+
+PR 1 made the service *react* well to failure (deadlines, breakers,
+admission); this module makes it *heal itself* (docs/resilience.md):
+
+- ``PoolSupervisor`` — a background reconciler owned per pool executor.
+  Each sweep it (1) health-probes the queued warm sandboxes and reaps the
+  dead ones into the fleet journal (``reaped{reason=unhealthy_idle}``),
+  (2) kills any execution that has overrun the hard wall-clock cap (the
+  stuck-execution watchdog: the sandbox is torn down, the waiting request
+  fails as *transient* so the replay/retry layers can recover it), and
+  (3) replenishes the pool to target through the backend's existing
+  breaker-gated refill. Sweep durations land in
+  ``bci_supervisor_probe_seconds``.
+
+- ``InflightRegistry`` — the watchdog's view of executions in flight.
+  Pool backends wrap each sandbox-bound execute in :meth:`track`; the
+  supervisor kills overdue entries via the backend-provided ``kill``
+  callback plus a task cancel, and the registry converts that cancel into
+  a ``SandboxTransientError`` (``reap_reason="hung_execute"``) so the
+  failure is retryable, never a bare CancelledError surfacing as a 500.
+
+- ``DrainController`` — shared graceful-shutdown state. ``begin()`` flips
+  the service into draining mode: both API edges reject *new* sandbox-bound
+  work (HTTP 503 + ``Retry-After``, gRPC UNAVAILABLE and health
+  ``NOT_SERVING`` via registered callbacks) while requests already admitted
+  — tracked through :meth:`track`, exported as ``bci_drain_inflight`` —
+  run to completion; ``wait_idle`` bounds the wait by ``APP_DRAIN_GRACE_S``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from contextlib import contextmanager
+from dataclasses import dataclass
+from typing import Callable
+
+from bee_code_interpreter_tpu.resilience.errors import SandboxTransientError
+
+logger = logging.getLogger(__name__)
+
+
+def journal_sandbox_teardown(journal, sandbox: str, exc: BaseException | None) -> None:
+    """The ONE journal spelling for the end of a sandbox's single use,
+    shared by both pool backends (their context managers classify the same
+    way, and the replay/chaos acceptance asserts on these exact reasons):
+
+    - transient data-plane failure → ``reaped`` with the exception's
+      ``reap_reason`` (``hung_execute`` from the watchdog) or the default
+      ``died_mid_execute``;
+    - cancellation (deadline fired, hedge lost the race) → ``released``
+      with reason ``cancelled``;
+    - anything else, including success → ``released`` / ``single_use``.
+    """
+    if isinstance(exc, SandboxTransientError):
+        journal.record(
+            sandbox,
+            "reaped",
+            reason=getattr(exc, "reap_reason", "died_mid_execute"),
+            detail=str(exc)[:200],
+        )
+    elif isinstance(exc, asyncio.CancelledError):
+        journal.record(sandbox, "released", reason="cancelled")
+    else:
+        journal.record(sandbox, "released", reason="single_use")
+
+
+# ------------------------------------------------------------------ watchdog
+
+
+@dataclass
+class InflightExecution:
+    """One sandbox-bound execution currently in flight."""
+
+    sandbox: str
+    started_mono: float
+    task: asyncio.Task | None
+    kill: Callable[[], None] | None
+    killed: bool = False
+    kill_reason: str = ""
+
+    def age_s(self, now: float) -> float:
+        return now - self.started_mono
+
+
+class InflightRegistry:
+    """Executions in flight on one pool backend, killable by the watchdog.
+
+    ``track`` is a *sync* context manager (no awaits) wrapped around the
+    backend's execute call while it holds a sandbox. ``kill_overdue``
+    (driven by the supervisor sweep) tears the sandbox down via the
+    backend's callback and cancels the tracked task; the injected
+    CancelledError is converted to a ``SandboxTransientError`` carrying
+    ``reap_reason="hung_execute"`` — the request fails *transient* (so
+    retry/replay can still save it) and the fleet journal records why the
+    sandbox died. A cancel the watchdog did NOT inject (client gone,
+    deadline fired) passes through untouched.
+    """
+
+    def __init__(self, clock: Callable[[], float] = time.monotonic) -> None:
+        self._clock = clock
+        self._live: dict[int, InflightExecution] = {}
+        self._seq = 0
+
+    def __len__(self) -> int:
+        return len(self._live)
+
+    @contextmanager
+    def track(self, sandbox: str, kill: Callable[[], None] | None = None):
+        try:
+            task = asyncio.current_task()
+        except RuntimeError:
+            task = None
+        entry = InflightExecution(
+            sandbox=sandbox, started_mono=self._clock(), task=task, kill=kill
+        )
+        self._seq += 1
+        key = self._seq
+        self._live[key] = entry
+        try:
+            yield entry
+        except asyncio.CancelledError:
+            if entry.killed:
+                # Swallowing OUR cancel must also rewind the task's
+                # cancellation count (3.11+), or an enclosing
+                # wait_for/timeout (the edge deadline's hard bound) later
+                # sees a cancellation it never requested and re-raises
+                # CancelledError instead of its TimeoutError mapping.
+                if entry.task is not None and hasattr(entry.task, "uncancel"):
+                    entry.task.uncancel()
+                err = SandboxTransientError(
+                    f"execution on {sandbox} killed by the supervisor watchdog "
+                    f"({entry.kill_reason}) after {entry.age_s(self._clock()):.1f}s"
+                )
+                err.reap_reason = "hung_execute"
+                raise err from None
+            raise
+        finally:
+            self._live.pop(key, None)
+
+    def overdue(self, cap_s: float) -> list[InflightExecution]:
+        now = self._clock()
+        return [
+            e
+            for e in self._live.values()
+            if not e.killed and e.age_s(now) > cap_s
+        ]
+
+    def kill(self, entry: InflightExecution, reason: str = "hung_execute") -> None:
+        """Kill one in-flight execution: sandbox teardown first (so the
+        hung call's transport actually dies), then the task cancel that the
+        tracking context converts into a transient failure."""
+        entry.killed = True
+        entry.kill_reason = reason
+        if entry.kill is not None:
+            try:
+                entry.kill()
+            except Exception:
+                logger.exception(
+                    "Watchdog sandbox-kill callback failed for %s", entry.sandbox
+                )
+        if entry.task is not None:
+            entry.task.cancel()
+
+    def oldest_age_s(self) -> float | None:
+        if not self._live:
+            return None
+        now = self._clock()
+        return max(e.age_s(now) for e in self._live.values())
+
+
+# ---------------------------------------------------------------- supervisor
+
+
+class PoolSupervisor:
+    """Background reconciler for one pool executor (k8s pod groups or native
+    processes). The executor contract is duck-typed:
+
+    - ``reap_unhealthy_idle()`` (async) — probe queued warm sandboxes, reap
+      dead ones, return the count;
+    - ``fill_executor_pod_queue`` / ``fill_sandbox_queue`` (async) — the
+      existing breaker-gated refill to target;
+    - ``inflight`` — an :class:`InflightRegistry` (optional; enables the
+      stuck-execution watchdog).
+
+    Owned per executor, started by the composition root once a loop runs.
+    """
+
+    def __init__(
+        self,
+        executor,
+        *,
+        interval_s: float = 10.0,
+        execute_hard_cap_s: float | None = None,
+        metrics=None,
+        drain: "DrainController | None" = None,
+        clock: Callable[[], float] = time.monotonic,
+    ) -> None:
+        self._executor = executor
+        self._interval_s = max(0.05, interval_s)
+        self._hard_cap_s = execute_hard_cap_s
+        self._drain = drain
+        self._clock = clock
+        self._reap = getattr(executor, "reap_unhealthy_idle", None)
+        self._refill = getattr(
+            executor, "fill_executor_pod_queue", None
+        ) or getattr(executor, "fill_sandbox_queue", None)
+        self._inflight: InflightRegistry | None = getattr(
+            executor, "inflight", None
+        )
+        self._task: asyncio.Task | None = None
+        self._stopped = False
+        self.sweeps_total = 0
+        self.reaped_total = 0
+        self.watchdog_kills_total = 0
+        self.last_sweep_mono: float | None = None
+        self._probe_seconds = (
+            metrics.histogram(
+                "bci_supervisor_probe_seconds",
+                "Pool supervisor sweep duration (idle health probes + watchdog + refill)",
+            )
+            if metrics is not None
+            else None
+        )
+
+    # ------------------------------------------------------------ lifecycle
+
+    @property
+    def running(self) -> bool:
+        return self._task is not None and not self._task.done()
+
+    def start(self) -> asyncio.Task:
+        """Start the reconcile loop (requires a running loop); idempotent."""
+        if self.running:
+            return self._task
+        self._stopped = False
+        self._task = asyncio.get_running_loop().create_task(self._run())
+        return self._task
+
+    async def stop(self) -> None:
+        self._stopped = True
+        task, self._task = self._task, None
+        if task is not None:
+            task.cancel()
+            try:
+                await task
+            except asyncio.CancelledError:
+                pass
+
+    async def _run(self) -> None:
+        while not self._stopped:
+            try:
+                await asyncio.sleep(self._interval_s)
+                await self.sweep_once()
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                # One bad sweep must not end self-healing for the process.
+                logger.exception("Pool supervisor sweep failed")
+
+    # --------------------------------------------------------------- sweeps
+
+    async def sweep_once(self) -> dict:
+        """One reconcile pass: probe idle → watchdog → refill. Exposed for
+        tests and the chaos harness; the background loop calls it on the
+        configured cadence."""
+        t0 = self._clock()
+        reaped = 0
+        if self._reap is not None:
+            reaped = await self._reap()
+        killed = 0
+        if self._inflight is not None and self._hard_cap_s is not None:
+            for entry in self._inflight.overdue(self._hard_cap_s):
+                logger.warning(
+                    "Watchdog: execution on %s exceeded the %.0fs hard cap "
+                    "(%.1fs in flight); killing the sandbox",
+                    entry.sandbox,
+                    self._hard_cap_s,
+                    entry.age_s(self._clock()),
+                )
+                self._inflight.kill(entry)
+                killed += 1
+        duration = self._clock() - t0
+        if self._probe_seconds is not None:
+            self._probe_seconds.observe(duration)
+        draining = self._drain is not None and self._drain.draining
+        if self._refill is not None and not draining:
+            # Replenish through the backend's own breaker-gated refill
+            # (a no-op while the spawn breaker is open) — kicked
+            # fire-and-forget: a degraded apiserver must not stall the
+            # sweep loop (and the next watchdog pass) behind minutes of
+            # spawn retries, nor pollute the probe-duration histogram.
+            refill = self._refill()
+            spawn_background = getattr(self._executor, "_spawn_background", None)
+            if spawn_background is not None:
+                spawn_background(refill)
+            else:
+                await refill
+        self.sweeps_total += 1
+        self.reaped_total += reaped
+        self.watchdog_kills_total += killed
+        self.last_sweep_mono = self._clock()
+        return {
+            "reaped": reaped,
+            "watchdog_killed": killed,
+            "duration_s": duration,
+        }
+
+    def snapshot(self) -> dict:
+        """Operator view for ``GET /v1/fleet`` / ``scripts/fleet-top.py``."""
+        last_age = (
+            self._clock() - self.last_sweep_mono
+            if self.last_sweep_mono is not None
+            else None
+        )
+        return {
+            "running": self.running,
+            "interval_s": self._interval_s,
+            "execute_hard_cap_s": self._hard_cap_s,
+            "sweeps": self.sweeps_total,
+            "reaped": self.reaped_total,
+            "watchdog_kills": self.watchdog_kills_total,
+            "last_sweep_age_s": last_age,
+            "inflight": len(self._inflight) if self._inflight is not None else 0,
+            "inflight_oldest_age_s": (
+                self._inflight.oldest_age_s()
+                if self._inflight is not None
+                else None
+            ),
+        }
+
+
+# --------------------------------------------------------------------- drain
+
+
+class DrainController:
+    """Graceful-drain state shared by both API edges and ``__main__``.
+
+    ``begin()`` is idempotent and fires the registered callbacks exactly
+    once (the gRPC server registers its health flip to ``NOT_SERVING``
+    there). The edges consult :attr:`draining` *before* admission — new
+    sandbox-bound work is rejected retryably — and wrap admitted work in
+    :meth:`track` so ``wait_idle`` (and the ``bci_drain_inflight`` gauge)
+    can see what the teardown must wait for.
+    """
+
+    def __init__(self, metrics=None, retry_after_s: float = 1.0) -> None:
+        self.retry_after_s = max(0.0, retry_after_s)
+        self._draining = False
+        self._in_flight = 0
+        self._callbacks: list[Callable[[], None]] = []
+        self._idle_event: asyncio.Event | None = None
+        if metrics is not None:
+            metrics.gauge(
+                "bci_drain_inflight",
+                "In-flight requests a graceful drain must wait for",
+                lambda: self._in_flight,
+            )
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    @property
+    def in_flight(self) -> int:
+        return self._in_flight
+
+    def on_drain(self, callback: Callable[[], None]) -> None:
+        """Register a callback fired when the drain begins; fired
+        immediately if the drain already began (late-built servers)."""
+        self._callbacks.append(callback)
+        if self._draining:
+            self._fire(callback)
+
+    def _fire(self, callback: Callable[[], None]) -> None:
+        try:
+            callback()
+        except Exception:
+            logger.exception("Drain callback failed")
+
+    def begin(self) -> None:
+        if self._draining:
+            return
+        self._draining = True
+        logger.info(
+            "Drain started: rejecting new work, %d request(s) in flight",
+            self._in_flight,
+        )
+        for callback in self._callbacks:
+            self._fire(callback)
+        self._wake_if_idle()
+
+    @contextmanager
+    def track(self):
+        """Count one admitted request for the duration of its execution."""
+        self._in_flight += 1
+        try:
+            yield
+        finally:
+            self._in_flight -= 1
+            self._wake_if_idle()
+
+    def _wake_if_idle(self) -> None:
+        if self._in_flight == 0 and self._idle_event is not None:
+            self._idle_event.set()
+
+    async def wait_idle(self, grace_s: float) -> bool:
+        """Wait until no tracked request is in flight, bounded by
+        ``grace_s``. Returns True when drained, False when the grace
+        expired with work still running."""
+        loop = asyncio.get_running_loop()
+        deadline = loop.time() + max(0.0, grace_s)
+        while self._in_flight > 0:
+            remaining = deadline - loop.time()
+            if remaining <= 0:
+                return False
+            self._idle_event = asyncio.Event()
+            try:
+                # Short poll ceiling guards the wake-vs-replace race without
+                # busy-waiting.
+                await asyncio.wait_for(
+                    self._idle_event.wait(), timeout=min(remaining, 0.25)
+                )
+            except (asyncio.TimeoutError, TimeoutError):
+                pass
+        return True

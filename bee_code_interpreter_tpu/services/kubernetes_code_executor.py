@@ -63,9 +63,11 @@ from bee_code_interpreter_tpu.resilience import (
     CircuitBreaker,
     Deadline,
     DeadlineExceeded,
+    InflightRegistry,
     RetryPolicy,
     SandboxFatalError,
     SandboxTransientError,
+    journal_sandbox_teardown,
     retryable,
 )
 from bee_code_interpreter_tpu.services.code_executor import Result
@@ -123,6 +125,10 @@ class KubernetesCodeExecutor(ExecutorHttpDriver):
         # The event loop holds only weak refs to tasks; fire-and-forget refills
         # and deletions must be anchored here or GC can cancel them mid-flight.
         self._background_tasks: set[asyncio.Task] = set()
+        # Executions in flight, killable by the supervisor's stuck-execution
+        # watchdog (resilience/supervisor.py).
+        self.inflight = InflightRegistry()
+        self._closed = False
 
         self._metrics = metrics
         # Lifecycle journal (docs/observability.md): every pod-group
@@ -248,19 +254,24 @@ class KubernetesCodeExecutor(ExecutorHttpDriver):
             )
             self.journal.record(group.name, "executing")
             # Run on all workers concurrently; every JAX process must execute
-            # the same program for collectives to rendezvous.
-            responses = await asyncio.gather(
-                *(
-                    self._post_execute(
-                        addr,
-                        source_code,
-                        env,
-                        self._effective_timeout(timeout_s),
-                        deadline=deadline,
+            # the same program for collectives to rendezvous. Tracked so the
+            # supervisor watchdog can kill a wedged group: the kill tears the
+            # pods down and this gather fails as transient (hung_execute).
+            with self.inflight.track(
+                group.name, kill=lambda: self._kill_group(group)
+            ):
+                responses = await asyncio.gather(
+                    *(
+                        self._post_execute(
+                            addr,
+                            source_code,
+                            env,
+                            self._effective_timeout(timeout_s),
+                            deadline=deadline,
+                        )
+                        for addr in addrs
                     )
-                    for addr in addrs
                 )
-            )
             primary = responses[0]
             exit_code = next(
                 (r["exit_code"] for r in responses if r["exit_code"] != 0), 0
@@ -318,7 +329,14 @@ class KubernetesCodeExecutor(ExecutorHttpDriver):
                 self.journal.record(group.name, "assigned", reason="cold_spawn")
                 break
             candidate = self._queue.popleft()
-            if await self._group_healthy(candidate):
+            try:
+                healthy = await self._group_healthy(candidate, deadline=deadline)
+            except DeadlineExceeded:
+                # The request ran out of budget mid-probe: hand the
+                # (unjudged) group back to the pool instead of leaking it.
+                self._queue.appendleft(candidate)
+                raise
+            if healthy:
                 group = candidate
                 self.journal.record(group.name, "assigned", reason="warm_pop")
             else:
@@ -327,15 +345,20 @@ class KubernetesCodeExecutor(ExecutorHttpDriver):
                     candidate.name,
                 )
                 self.journal.record(candidate.name, "reaped", reason="unhealthy")
-                for pod_name in candidate.pod_names:
-                    self._spawn_background(self._delete_pod(pod_name))
+                self._kill_group(candidate)
         self._spawn_background(self.fill_executor_pod_queue())
         try:
             yield group
+        except BaseException as e:
+            # A transient data-plane failure means the sandbox is presumed
+            # dead or wedged (a pod dying mid-execute lands here); the
+            # journal reason is what the replay acceptance asserts on.
+            journal_sandbox_teardown(self.journal, group.name, e)
+            raise
+        else:
+            journal_sandbox_teardown(self.journal, group.name, None)
         finally:
-            self.journal.record(group.name, "released", reason="single_use")
-            for pod_name in group.pod_names:
-                self._spawn_background(self._delete_pod(pod_name))
+            self._kill_group(group)
 
     async def _spawn_guarded(self, deadline: Deadline | None) -> PodGroup:
         """Request-path spawn: breaker-gated and deadline-bounded. A hang or
@@ -355,14 +378,30 @@ class KubernetesCodeExecutor(ExecutorHttpDriver):
                     what="pod group spawn",
                 )
 
-    async def _group_healthy(self, group: PodGroup) -> bool:
-        """Every worker answers /healthz (sub-second; runs on the pod network)."""
+    async def _group_healthy(
+        self, group: PodGroup, deadline: Deadline | None = None
+    ) -> bool:
+        """Every worker answers /healthz (sub-second; runs on the pod
+        network). The probe timeout is ``APP_HEALTH_PROBE_TIMEOUT_S``,
+        clamped on the request path to the remaining checkout deadline so a
+        near-expiry request never spends its whole budget probing."""
+        timeout = self._config.health_probe_timeout_s
+        if deadline is not None:
+            # A probe needs a real floor: clamping to a near-expired budget
+            # would time the probe out instantly and reap a HEALTHY pod —
+            # under overload (when deadlines run short) that turns each
+            # expiring request into a warm-pool destruction event. Out of
+            # budget means the REQUEST is out of time, not the pod.
+            floor = min(timeout, 0.25)
+            if deadline.remaining() <= floor:
+                raise DeadlineExceeded("warm sandbox health probe")
+            timeout = deadline.clamp(timeout)
 
         async def probe(ip: str) -> bool:
             try:
                 response = await self._http.get(
                     f"http://{ip}:{self._config.executor_port}/healthz",
-                    timeout=2.0,
+                    timeout=timeout,
                 )
                 return response.status_code == 200
             except httpx.HTTPError:
@@ -371,6 +410,58 @@ class KubernetesCodeExecutor(ExecutorHttpDriver):
         results = await asyncio.gather(*(probe(ip) for ip in group.pod_ips))
         return all(results)
 
+    def _kill_group(self, group: PodGroup) -> None:
+        """Fire-and-forget deletion of every pod in a group — the one
+        teardown spelling shared by single-use release, idle reaps, the
+        watchdog (where the deletions also break the in-flight /execute
+        transport on a real cluster; the tracked task's cancel guarantees
+        it deterministically), and refill-vs-close races."""
+        for pod_name in group.pod_names:
+            self._spawn_background(self._delete_pod(pod_name))
+
+    async def reap_unhealthy_idle(self) -> int:
+        """Supervisor hook: probe every *queued* warm group and reap the
+        ones that died in place (preemption, OOM, node loss) instead of
+        discovering them at checkout time. Returns the number reaped."""
+        candidates = list(self._queue)
+        if not candidates:
+            return 0
+        # Probe the whole queue concurrently: a mass-death event (node loss)
+        # must not cost one probe timeout PER corpse before healing starts.
+        results = await asyncio.gather(
+            *(self._group_healthy(g) for g in candidates)
+        )
+        reaped = 0
+        for group, healthy in zip(candidates, results):
+            if healthy:
+                continue
+            try:
+                self._queue.remove(group)
+            except ValueError:
+                continue  # checked out by a request while we probed
+            logger.warning(
+                "Supervisor reaping unhealthy idle pod group %s", group.name
+            )
+            self.journal.record(group.name, "reaped", reason="unhealthy_idle")
+            self._kill_group(group)
+            reaped += 1
+        return reaped
+
+    async def aclose(self) -> None:
+        """Drain-path teardown: reap the warm queue (awaited, not
+        fire-and-forget) and close the data-plane client deterministically.
+        The closed flag makes refills still in flight delete their spawned
+        groups instead of repopulating a dead pool."""
+        self._closed = True
+        deletions: list = []
+        while self._queue:
+            group = self._queue.popleft()
+            self.journal.record(group.name, "reaped", reason="shutdown")
+            deletions.extend(self._delete_pod(p) for p in group.pod_names)
+        if deletions:
+            await asyncio.gather(*deletions)
+        await self._http.aclose()
+
     def _spawn_background(self, coro) -> None:
         task = asyncio.ensure_future(coro)
         self._background_tasks.add(task)
@@ -378,6 +469,8 @@ class KubernetesCodeExecutor(ExecutorHttpDriver):
 
     async def fill_executor_pod_queue(self) -> None:
         """Keep the warm queue at target length (reference :151-189)."""
+        if self._closed:
+            return
         async with self._fill_lock:
             missing = (
                 self._config.executor_pod_queue_target_length
@@ -420,6 +513,13 @@ class KubernetesCodeExecutor(ExecutorHttpDriver):
             self._spawning_count -= 1
         if self.spawn_breaker.state is BreakerState.CLOSED:
             self.spawn_breaker.record_success()
+        if self._closed:
+            # raced with teardown: a freshly spawned group appended to a dead
+            # executor's queue would never be deleted — leaked cluster pods
+            # after every graceful restart.
+            self.journal.record(group.name, "reaped", reason="shutdown")
+            self._kill_group(group)
+            return False
         self._queue.append(group)
         return True
 

@@ -44,8 +44,10 @@ from bee_code_interpreter_tpu.observability import (
 )
 from bee_code_interpreter_tpu.resilience import (
     Deadline,
+    InflightRegistry,
     RetryPolicy,
     SandboxTransientError,
+    journal_sandbox_teardown,
     retryable,
 )
 from bee_code_interpreter_tpu.services.code_executor import Result
@@ -145,6 +147,9 @@ class NativeProcessCodeExecutor(ExecutorHttpDriver):
         # The event loop holds only weak refs to tasks; fire-and-forget refills
         # must be anchored here or GC can cancel them mid-spawn.
         self._background_tasks: set[asyncio.Task] = set()
+        # Executions in flight, killable by the supervisor's stuck-execution
+        # watchdog (resilience/supervisor.py).
+        self.inflight = InflightRegistry()
         # Dedicated spawn thread: PR_SET_PDEATHSIG fires when the spawning
         # *thread* exits (prctl(2)), so sandboxes must not be forked from
         # default-executor workers whose lifetime we don't control. This
@@ -261,22 +266,28 @@ class NativeProcessCodeExecutor(ExecutorHttpDriver):
             )
             t_uploaded = perf()
             self.journal.record(box.name, "executing")
-            response = await self._post_execute(
-                box.addr,
-                source_code,
-                env,
-                self._effective_timeout(timeout_s),
-                # preload budget (matches the pooled warm-wait bound) on top
-                # of the client timeout for overlap-dispatched sandboxes —
-                # a near-limit execution must not lose its margin to the
-                # preload it overlapped
-                client_timeout_s=(
-                    self._config.executor_http_timeout_s + 15.0
-                    if box.overlap_dispatch
-                    else None
-                ),
-                deadline=deadline,
-            )
+            # Tracked so the supervisor watchdog can kill a wedged sandbox:
+            # the process kill resets this call's transport, and the task
+            # cancel converts to a transient failure (hung_execute).
+            with self.inflight.track(
+                box.name, kill=lambda: self._kill_sandbox(box)
+            ):
+                response = await self._post_execute(
+                    box.addr,
+                    source_code,
+                    env,
+                    self._effective_timeout(timeout_s),
+                    # preload budget (matches the pooled warm-wait bound) on
+                    # top of the client timeout for overlap-dispatched
+                    # sandboxes — a near-limit execution must not lose its
+                    # margin to the preload it overlapped
+                    client_timeout_s=(
+                        self._config.executor_http_timeout_s + 15.0
+                        if box.overlap_dispatch
+                        else None
+                    ),
+                    deadline=deadline,
+                )
             t_executed = perf()
             out_files: dict[str, str] = {}
             for path, object_id in zip(
@@ -356,8 +367,15 @@ class NativeProcessCodeExecutor(ExecutorHttpDriver):
         self._spawn_background(self.fill_sandbox_queue())
         try:
             yield box
+        except BaseException as e:
+            # Mirror of the pod-group path: a transient failure mid-execute
+            # means the sandbox process is presumed dead/wedged, and the
+            # journal reason is what replay observability keys on.
+            journal_sandbox_teardown(self.journal, box.name, e)
+            raise
+        else:
+            journal_sandbox_teardown(self.journal, box.name, None)
         finally:
-            self.journal.record(box.name, "released", reason="single_use")
             # Teardown must not block the response (reference deletes pods
             # fire-and-forget, kubernetes_code_executor.py:262-264).
             asyncio.get_running_loop().run_in_executor(None, box.destroy)
@@ -366,6 +384,60 @@ class NativeProcessCodeExecutor(ExecutorHttpDriver):
         task = asyncio.ensure_future(coro)
         self._background_tasks.add(task)
         task.add_done_callback(self._background_tasks.discard)
+
+    def _kill_sandbox(self, box: NativeSandbox) -> None:
+        """Watchdog teardown of a wedged sandbox (sync, fire-and-forget):
+        killing the process resets the in-flight HTTP call's transport."""
+        asyncio.get_running_loop().run_in_executor(None, box.destroy)
+
+    async def _sandbox_healthy(self, box: NativeSandbox) -> bool:
+        """The process is alive AND its /healthz answers — a live-but-wedged
+        server (stuck preload, leaked lock) is as dead as a crashed one."""
+        if box.proc.poll() is not None:
+            return False
+        try:
+            response = await self._http.get(
+                f"http://{box.addr}/healthz",
+                timeout=self._config.health_probe_timeout_s,
+            )
+            return response.status_code == 200
+        except httpx.HTTPError:
+            return False
+
+    async def reap_unhealthy_idle(self) -> int:
+        """Supervisor hook: probe every queued warm sandbox and reap the
+        ones that died or wedged in place. Returns the number reaped."""
+        candidates = list(self._queue)
+        if not candidates:
+            return 0
+        # Probe the whole queue concurrently: a mass-death event must not
+        # cost one probe timeout PER corpse before healing starts.
+        results = await asyncio.gather(
+            *(self._sandbox_healthy(b) for b in candidates)
+        )
+        reaped = 0
+        for box, healthy in zip(candidates, results):
+            if healthy:
+                continue
+            try:
+                self._queue.remove(box)
+            except ValueError:
+                continue  # checked out by a request while we probed
+            exited = box.proc.poll() is not None
+            detail = (
+                f"exit {box.proc.returncode}" if exited else "healthz probe failed"
+            )
+            logger.warning(
+                "Supervisor reaping unhealthy idle sandbox %s (%s)",
+                box.name,
+                detail,
+            )
+            self.journal.record(
+                box.name, "reaped", reason="unhealthy_idle", detail=detail
+            )
+            self._kill_sandbox(box)
+            reaped += 1
+        return reaped
 
     async def fill_sandbox_queue(self) -> None:
         if self._closed:
@@ -569,7 +641,7 @@ class NativeProcessCodeExecutor(ExecutorHttpDriver):
         self.journal.record(box.name, "ready")
         return box
 
-    def shutdown(self) -> None:
+    def shutdown(self, close_http: bool = True) -> None:
         """Kill every warm sandbox (no idle processes left behind).
 
         Sets the closed flag first so refills already in flight destroy their
@@ -587,6 +659,11 @@ class NativeProcessCodeExecutor(ExecutorHttpDriver):
         # anyway). Queued sandboxes were destroyed above; in-flight refills
         # see the closed flag and destroy their own.
         self._spawn_pool.shutdown(wait=False)
+        if not close_http:
+            return
+        # Legacy sync path: the aclose can only be scheduled, and a loop shut
+        # down right after may cancel it before it runs. The drain path uses
+        # the deterministic ``aclose()`` instead.
         try:
             loop = asyncio.get_running_loop()
         except RuntimeError:
@@ -595,3 +672,10 @@ class NativeProcessCodeExecutor(ExecutorHttpDriver):
             task = loop.create_task(self._http.aclose())
             self._background_tasks.add(task)
             task.add_done_callback(self._background_tasks.discard)
+
+    async def aclose(self) -> None:
+        """Deterministic drain-path shutdown: tear the pool down, then close
+        the HTTP client *awaited in-loop* — not as a fire-and-forget task the
+        closing loop could cancel before it ever ran."""
+        self.shutdown(close_http=False)
+        await self._http.aclose()

@@ -13,6 +13,13 @@ wiring minus kubectl. Scenarios:
                             to the local executor; cooldown half-opens and
                             the breaker closes on a healthy probe
   4. admission shedding   — in-flight + queue full -> immediate shed
+  5. replay               — a pod dies mid-execute; the request transparently
+                            replays on a fresh sandbox and still succeeds
+  6. supervisor + watchdog— a dead warm sandbox is reaped as unhealthy_idle
+                            and the pool replenished; a hung execute is
+                            watchdog-killed and fails transient
+  7. graceful drain       — draining rejects new work while in-flight work
+                            finishes inside the grace window
 
 Exits nonzero if any scenario misbehaves. Usage:
 
@@ -38,7 +45,11 @@ from bee_code_interpreter_tpu.resilience import (  # noqa: E402
     CircuitBreaker,
     Deadline,
     DeadlineExceeded,
+    DrainController,
+    HedgingExecutor,
+    PoolSupervisor,
     ResilientCodeExecutor,
+    SandboxTransientError,
 )
 from bee_code_interpreter_tpu.services.kubernetes_code_executor import (  # noqa: E402
     KubernetesCodeExecutor,
@@ -109,7 +120,10 @@ def make_stack(tmp: Path, storage, metrics: Registry, clock: ManualClock):
     fallback = LocalCodeExecutor(
         storage=storage, workspace_root=tmp / "fallback-ws", disable_dep_install=True
     )
-    executor = ResilientCodeExecutor(k8s, fallback=fallback, metrics=metrics)
+    # Production shape (application_context.py): resilient front over the
+    # replay/hedge layer over the pool backend.
+    hedged = HedgingExecutor(k8s, replay_max=1, metrics=metrics)
+    executor = ResilientCodeExecutor(hedged, fallback=fallback, metrics=metrics)
     return executor, spawn_breaker, faults, pods
 
 
@@ -202,11 +216,104 @@ async def main() -> int:
         release.set()
         await holder
 
+        # 5. a pod dies mid-execute -> transparent replay on a fresh sandbox
+        #    (fresh stack so breaker windows stay clean)
+        executor3, _, faults3, pods3 = make_stack(tmp, storage, metrics, clock)
+        k8s3 = executor3.primary.primary  # unwrap resilient -> hedging -> pool
+        try:
+            faults3.die_mid_execute()
+            result = await executor3.execute(
+                "print('survived')", deadline=Deadline.after(30)
+            )
+            report(
+                "pod death mid-execute replayed to success",
+                result.stdout == "survived\n",
+            )
+            text = metrics.expose()
+            report(
+                "replay observable in journal + metrics",
+                "bci_execution_replays_total 1" in text
+                and any(
+                    e.get("reason") == "died_mid_execute"
+                    for e in k8s3.journal.events()
+                ),
+            )
+            dump_fleet("replay", executor3)
+
+            # 6a. supervisor reaps a dead warm sandbox and replenishes
+            k8s3._config.executor_pod_queue_target_length = 1
+            await k8s3.fill_executor_pod_queue()
+            victim = k8s3._queue[0]
+            for ip in victim.pod_ips:
+                await pods3.stop_pod(ip)
+            supervisor = PoolSupervisor(
+                k8s3, interval_s=60, execute_hard_cap_s=0.2, metrics=metrics
+            )
+            swept = await supervisor.sweep_once()
+            for _ in range(200):  # refill is kicked fire-and-forget
+                if k8s3.pool_ready_count == 1:
+                    break
+                await asyncio.sleep(0.01)
+            report(
+                "supervisor reaps unhealthy_idle and replenishes",
+                swept["reaped"] == 1 and k8s3.pool_ready_count == 1,
+                f"reaped={swept['reaped']} ready={k8s3.pool_ready_count}",
+            )
+
+            # 6b. a hung execute is watchdog-killed, failing transient
+            faults3.hang_execute(30.0)
+            request = asyncio.ensure_future(
+                executor3.primary.primary.execute("print(1)")
+            )
+            await asyncio.sleep(0.3)
+            swept = await supervisor.sweep_once()
+            try:
+                await request
+                report("watchdog kills hung execute", False, "request succeeded?!")
+            except SandboxTransientError as e:
+                report(
+                    "watchdog kills hung execute",
+                    swept["watchdog_killed"] == 1 and "watchdog" in str(e),
+                    f"killed={swept['watchdog_killed']}",
+                )
+            dump_fleet("supervisor + watchdog", executor3)
+        finally:
+            await pods3.close()
+
+        # 7. graceful drain: in-flight finishes, new work rejected
+        drain = DrainController(metrics=metrics, retry_after_s=1.0)
+        release = asyncio.Event()
+
+        async def inflight_request():
+            with drain.track():
+                await release.wait()
+                return "finished"
+
+        inflight = asyncio.create_task(inflight_request())
+        await asyncio.sleep(0.01)
+        drain.begin()
+        report(
+            "drain rejects new work while tracking in-flight",
+            drain.draining and drain.in_flight == 1,
+            f"in_flight={drain.in_flight}",
+        )
+        grace_expired = not await drain.wait_idle(0.05)
+        release.set()
+        drained = await drain.wait_idle(5.0)
+        report(
+            "drain waits for in-flight work within the grace",
+            grace_expired and drained and await inflight == "finished",
+        )
+
         text = metrics.expose()
         wanted = [
             "bci_executor_fallback_total 1",
             'bci_breaker_transitions_total{breaker="k8s-spawn",to="open"}',
             'bci_admission_shed_total{reason="queue_full"} 1',
+            "bci_execution_replays_total 1",
+            'bci_pod_reaped_total{reason="unhealthy_idle"} 1',
+            'bci_pod_reaped_total{reason="hung_execute"} 1',
+            "bci_supervisor_probe_seconds_count 2",
         ]
         missing = [w for w in wanted if w not in text]
         report("resilience counters in /metrics", not missing, str(missing or "all present"))
@@ -218,7 +325,10 @@ async def main() -> int:
     if failures:
         print(f"chaos smoke FAILED: {len(failures)} scenario(s): {failures}")
         return 1
-    print("chaos smoke passed: deadline, breaker, fallback, admission all behaved")
+    print(
+        "chaos smoke passed: deadline, breaker, fallback, admission, replay, "
+        "supervisor, watchdog, drain all behaved"
+    )
     return 0
 
 

@@ -35,7 +35,19 @@ def render_snapshot(snap: dict) -> str:
         f"fleet: {snap['live']} live ({by_state})  "
         f"utilization={snap['utilization']:.0%}  "
         f"executions_total={snap['executions_total']}"
+        + ("  ** DRAINING **" if snap.get("draining") else "")
     )
+    sup = snap.get("supervisor")
+    if sup:
+        lines.append(
+            "supervisor: "
+            + ("running" if sup.get("running") else "stopped")
+            + f"  last_sweep={fmt_age(sup.get('last_sweep_age_s'))} ago"
+            + f"  sweeps={sup.get('sweeps', 0)}"
+            + f"  reaped={sup.get('reaped', 0)}"
+            + f"  watchdog_kills={sup.get('watchdog_kills', 0)}"
+            + f"  inflight={sup.get('inflight', 0)}"
+        )
     lifetime = snap.get("lifetime", {})
     lines.append(
         "lifetime: "

@@ -67,6 +67,16 @@ class NoIP:
     """Pod-IP flap: one ``kubectl get`` sees the pod without status.podIP."""
 
 
+@dataclass
+class DieMidExecute:
+    """The pod dies mid-``/execute``: the in-flight connection is reset AND
+    the pod's server is torn down, so any later probe of the same sandbox
+    fails too (a ``Reset`` only drops the one connection). Drives the
+    replay acceptance: the executor must observe a transient failure,
+    journal ``reaped{reason=died_mid_execute}``, and replay on a fresh
+    sandbox."""
+
+
 class ManualClock:
     """Deterministic monotonic clock for Deadline/CircuitBreaker tests."""
 
@@ -101,9 +111,25 @@ class FaultPlan:
     def pending(self, op: str) -> int:
         return len(self._scripts[op])
 
-    async def apply_http(self, op: str, request) -> web.Response | None:
+    # Named fault kinds for the proactive-resilience suites (the supervisor
+    # / replay / watchdog acceptance criteria name them by these verbs).
+
+    def die_mid_execute(self) -> "FaultPlan":
+        """Script one pod death mid-``/execute`` (connection reset + the
+        pod's server torn down)."""
+        return self.script("execute", DieMidExecute())
+
+    def hang_execute(self, seconds: float = 30.0) -> "FaultPlan":
+        """Script one ``/execute`` that hangs (stuck sandbox: the watchdog's
+        prey — kill it before the hang outlives the hard cap)."""
+        return self.script("execute", Hang(seconds))
+
+    async def apply_http(self, op: str, request, kill=None) -> web.Response | None:
         """Data-plane injection hook (FakeExecutorPods middleware). Returns a
-        response to short-circuit with, or None to proceed to the handler."""
+        response to short-circuit with, or None to proceed to the handler.
+        ``kill`` is the middleware-provided sync callable that schedules the
+        serving pod's teardown, anchored against GC by the caller (consumed
+        by ``DieMidExecute``)."""
         behavior = self.take(op)
         if behavior is None or isinstance(behavior, Ok):
             return None
@@ -118,6 +144,14 @@ class FaultPlan:
             # The transport is gone; aiohttp drops the connection and the
             # client observes a reset rather than this response.
             return web.Response(status=500, text="chaos: reset")
+        if isinstance(behavior, DieMidExecute):
+            if request.transport is not None:
+                request.transport.close()
+            if kill is not None:
+                # Scheduled, not awaited: the pod teardown must not block
+                # this (already-dead) handler from unwinding.
+                kill()
+            return web.Response(status=500, text="chaos: pod died")
         raise AssertionError(f"behavior {behavior!r} not valid for op {op!r}")
 
 

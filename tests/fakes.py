@@ -36,6 +36,9 @@ class FakeExecutorPods:
         self.workspace_root = workspace_root
         self.port = port or free_port()
         self.faults = faults
+        # Anchors fire-and-forget pod-kill tasks (the loop holds only weak
+        # refs; an unanchored task can be GC-cancelled before it runs).
+        self._background_tasks: set[asyncio.Task] = set()
         self._runners: dict[str, web.AppRunner] = {}
         self.cores: dict[str, ExecutorCore] = {}
         self.execute_counts: dict[str, int] = {}
@@ -65,20 +68,33 @@ class FakeExecutorPods:
                 elif request.path.startswith("/workspace"):
                     op = "upload" if request.method == "PUT" else "download"
                 if op is not None:
-                    response = await self.faults.apply_http(op, request)
+                    response = await self.faults.apply_http(
+                        # kill lets DieMidExecute take this whole pod down,
+                        # not just the one connection.
+                        op, request, kill=lambda: self._kill_pod(ip)
+                    )
                     if response is not None:
                         return response
             return await handler(request)
 
         app.middlewares.append(count_executes)
         app.middlewares.append(inject_faults)
-        runner = web.AppRunner(app)
+        # Short shutdown grace: stop_pod()/close() must not wait out a
+        # scripted Hang(...) still sleeping in a handler.
+        runner = web.AppRunner(app, shutdown_timeout=0.1)
         await runner.setup()
         site = web.TCPSite(runner, ip, self.port)
         await site.start()
         self._runners[ip] = runner
         self.cores[ip] = core
         return ip
+
+    def _kill_pod(self, ip: str) -> None:
+        """Schedule a pod's death (DieMidExecute), anchored so GC cannot
+        cancel the teardown before it runs."""
+        task = asyncio.ensure_future(self.stop_pod(ip))
+        self._background_tasks.add(task)
+        task.add_done_callback(self._background_tasks.discard)
 
     async def stop_pod(self, ip: str) -> None:
         """Simulate preemption: the pod's server vanishes mid-pool."""

@@ -160,6 +160,66 @@ async def test_health_check_protocol(grpc_server):
         await grpc_server.stop(None)
 
 
+async def test_drain_aborts_new_rpcs_and_flips_health_not_serving(
+    local_executor,
+):
+    # Acceptance: after begin_drain, new Execute RPCs abort UNAVAILABLE
+    # with a retry hint while gRPC health answers NOT_SERVING — an in-flight
+    # RPC admitted before the drain still completes.
+    import asyncio
+
+    from bee_code_interpreter_tpu.api.grpc_server import health_stub
+    from bee_code_interpreter_tpu.proto import health_pb2
+    from bee_code_interpreter_tpu.resilience import DrainController
+
+    drain = DrainController(retry_after_s=1.5)
+    server = GrpcServer(
+        code_executor=local_executor,
+        custom_tool_executor=CustomToolExecutor(code_executor=local_executor),
+        drain=drain,
+    )
+    port = await server.start("127.0.0.1:0")
+    try:
+        async with grpc.aio.insecure_channel(f"127.0.0.1:{port}") as channel:
+            stubs = service_stubs(channel)
+            inflight = asyncio.ensure_future(
+                stubs["Execute"](
+                    pb.ExecuteRequest(
+                        source_code="import time; time.sleep(0.6); print('done')"
+                    )
+                )
+            )
+            for _ in range(100):
+                if drain.in_flight > 0:
+                    break
+                await asyncio.sleep(0.01)
+            assert drain.in_flight == 1
+
+            drain.begin()
+            try:
+                await stubs["Execute"](pb.ExecuteRequest(source_code="print(1)"))
+            except grpc.aio.AioRpcError as e:
+                assert e.code() == grpc.StatusCode.UNAVAILABLE
+                assert "draining" in e.details()
+                # Metadata iterates as (key, value) pairs but is not a dict
+                trailing = {k: v for k, v in (e.trailing_metadata() or ())}
+            else:
+                raise AssertionError("expected UNAVAILABLE while draining")
+            assert trailing.get("retry-after-s") == "1.5"
+
+            check = health_stub(channel)
+            for service in ("", "code_interpreter.v1.CodeInterpreterService"):
+                resp = await check(health_pb2.HealthCheckRequest(service=service))
+                assert resp.status == health_pb2.HealthCheckResponse.NOT_SERVING
+
+            # the RPC admitted before the drain still completes
+            resp = await inflight
+            assert resp.stdout == "done\n"
+            assert await drain.wait_idle(1.0) is True
+    finally:
+        await server.stop(None)
+
+
 async def test_invalid_files_rejected_invalid_argument(grpc_server):
     # Transport parity (round-1 missing #2): malformed files keys/hashes must
     # abort INVALID_ARGUMENT on gRPC exactly as pydantic 422s them on HTTP,

@@ -160,3 +160,134 @@ async def test_healthz(http_app):
         assert resp.status == 200
 
     await with_client(http_app, go)
+
+
+# ------------------------------------------------------------ graceful drain
+
+
+async def test_drain_rejects_new_work_while_inflight_completes(local_executor):
+    # Acceptance: after begin_drain, an in-flight execution completes
+    # successfully while concurrent new requests get 503 + Retry-After.
+    import asyncio
+
+    from bee_code_interpreter_tpu.resilience import DrainController
+
+    drain = DrainController(retry_after_s=2.0)
+    app = create_http_server(
+        code_executor=local_executor,
+        custom_tool_executor=CustomToolExecutor(code_executor=local_executor),
+        drain=drain,
+    )
+
+    async def go(client: TestClient):
+        inflight = asyncio.ensure_future(
+            client.post(
+                "/v1/execute",
+                json={
+                    "source_code": "import time; time.sleep(0.6); print('done')"
+                },
+            )
+        )
+        # wait until the slow request is actually tracked in flight
+        for _ in range(100):
+            if drain.in_flight > 0:
+                break
+            await asyncio.sleep(0.01)
+        assert drain.in_flight == 1
+
+        drain.begin()
+        shed = await client.post(
+            "/v1/execute", json={"source_code": "print(1)"}
+        )
+        assert shed.status == 503
+        assert shed.headers["Retry-After"] == "2"
+        assert "draining" in (await shed.json())["detail"].lower()
+
+        # liveness stays green but names the state; verbose carries depth
+        health = await (await client.get("/healthz")).json()
+        assert health["status"] == "draining"
+        verbose = await (
+            await client.get("/healthz", params={"verbose": "1"})
+        ).json()
+        assert verbose["status"] == "draining"
+        assert verbose["drain_inflight"] == 1
+
+        # the in-flight execution is NOT killed by the drain
+        resp = await inflight
+        assert resp.status == 200
+        assert (await resp.json())["stdout"] == "done\n"
+        assert await drain.wait_idle(1.0) is True
+
+    await with_client(app, go)
+
+
+async def test_drain_waits_for_admission_queued_waiters(local_executor):
+    # Review regression: a request QUEUED at the admission gate when the
+    # drain begins was admitted past the drain check and will execute —
+    # wait_idle must count it, or teardown closes the executor under it.
+    import asyncio
+
+    from bee_code_interpreter_tpu.resilience import (
+        AdmissionController,
+        DrainController,
+    )
+
+    admission = AdmissionController(max_in_flight=1, max_queue=4)
+    drain = DrainController()
+    app = create_http_server(
+        code_executor=local_executor,
+        custom_tool_executor=CustomToolExecutor(code_executor=local_executor),
+        admission=admission,
+        drain=drain,
+    )
+
+    async def go(client: TestClient):
+        slow = {"source_code": "import time; time.sleep(0.4); print('ok')"}
+        first = asyncio.ensure_future(client.post("/v1/execute", json=slow))
+        for _ in range(100):
+            if admission.in_flight == 1:
+                break
+            await asyncio.sleep(0.01)
+        queued = asyncio.ensure_future(client.post("/v1/execute", json=slow))
+        for _ in range(100):
+            if drain.in_flight == 2:  # tracked while still QUEUED
+                break
+            await asyncio.sleep(0.01)
+        assert drain.in_flight == 2
+
+        drain.begin()
+        assert await drain.wait_idle(5.0) is True  # waits for BOTH
+        for resp in (await first, await queued):
+            assert resp.status == 200
+            assert (await resp.json())["stdout"] == "ok\n"
+
+    await with_client(app, go)
+
+
+async def test_fleet_snapshot_carries_drain_and_supervisor_state(
+    local_executor,
+):
+    from bee_code_interpreter_tpu.resilience import (
+        DrainController,
+        PoolSupervisor,
+    )
+
+    drain = DrainController()
+    supervisor = PoolSupervisor(local_executor, interval_s=60)
+    app = create_http_server(
+        code_executor=local_executor,
+        custom_tool_executor=CustomToolExecutor(code_executor=local_executor),
+        drain=drain,
+        supervisor=supervisor,
+    )
+
+    async def go(client: TestClient):
+        snap = await (await client.get("/v1/fleet")).json()
+        assert snap["draining"] is False
+        assert snap["supervisor"]["sweeps"] == 0
+        assert snap["supervisor"]["running"] is False
+        drain.begin()
+        snap = await (await client.get("/v1/fleet")).json()
+        assert snap["draining"] is True
+
+    await with_client(app, go)

@@ -73,6 +73,11 @@ def test_every_registered_metric_follows_conventions(tmp_path):
         "bci_pod_reaped_total",
         "bci_execution_cpu_seconds",
         "bci_execution_peak_rss_bytes",
+        # proactive resilience (ISSUE 4): supervisor / replay / hedge / drain
+        "bci_supervisor_probe_seconds",
+        "bci_execution_replays_total",
+        "bci_hedge_total",
+        "bci_drain_inflight",
     ):
         assert required in metrics, f"{required}: not registered by the wiring"
     assert isinstance(metrics["bci_pool_spawn_seconds"], Histogram)
@@ -80,6 +85,10 @@ def test_every_registered_metric_follows_conventions(tmp_path):
     assert isinstance(metrics["bci_pod_reaped_total"], Counter)
     assert isinstance(metrics["bci_execution_cpu_seconds"], Histogram)
     assert isinstance(metrics["bci_execution_peak_rss_bytes"], Histogram)
+    assert isinstance(metrics["bci_supervisor_probe_seconds"], Histogram)
+    assert isinstance(metrics["bci_execution_replays_total"], Counter)
+    assert isinstance(metrics["bci_hedge_total"], Counter)
+    assert isinstance(metrics["bci_drain_inflight"], Gauge)
 
     for name, metric in metrics.items():
         assert name.startswith("bci_"), (

@@ -1,0 +1,501 @@
+"""Proactive resilience (ISSUE 4): pool supervisor self-healing, stuck-
+execution watchdog, transparent replay, hedged execution, and the drain
+controller. Faults are scripted through tests/chaos.py against the in-repo
+fake cluster — no real cluster, no unbounded sleeps."""
+
+import asyncio
+import time
+
+import pytest
+
+from bee_code_interpreter_tpu.config import Config
+from bee_code_interpreter_tpu.resilience import (
+    Deadline,
+    DrainController,
+    HedgingExecutor,
+    InflightRegistry,
+    PoolSupervisor,
+    SandboxTransientError,
+)
+from bee_code_interpreter_tpu.services.kubernetes_code_executor import (
+    KubernetesCodeExecutor,
+)
+from bee_code_interpreter_tpu.utils.metrics import Registry
+from tests.chaos import ChaosKubectl, FaultPlan, Hang, ManualClock
+from tests.fakes import FakeExecutorPods, FakeKubectl
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture
+def faults():
+    return FaultPlan()
+
+
+@pytest.fixture
+def pods(tmp_path, faults):
+    return FakeExecutorPods(tmp_path / "pods", faults=faults)
+
+
+def make_executor(pods, storage, faults, *, metrics=None, **config_overrides):
+    overrides = dict(
+        executor_backend="kubernetes",
+        executor_port=pods.port,
+        executor_pod_queue_target_length=0,
+        pod_ready_timeout_s=5,
+        executor_retry_attempts=1,
+        executor_retry_wait_min_s=0.01,
+        executor_retry_wait_max_s=0.05,
+        health_probe_timeout_s=0.5,
+    )
+    overrides.update(config_overrides)
+    return KubernetesCodeExecutor(
+        kubectl=ChaosKubectl(pods, faults),
+        storage=storage,
+        config=Config(**overrides),
+        metrics=metrics,
+        ip_poll_interval_s=0.02,
+    )
+
+
+# ------------------------------------------------------- supervisor sweeps
+
+
+async def test_supervisor_reaps_unhealthy_idle_and_replenishes(
+    pods, storage, faults
+):
+    # Two warm groups; one dies in place (preemption). The sweep must reap
+    # it as unhealthy_idle and refill the pool back to target — BEFORE any
+    # request has to discover the corpse at checkout time.
+    metrics = Registry()
+    executor = make_executor(
+        pods, storage, faults,
+        metrics=metrics, executor_pod_queue_target_length=2,
+    )
+    supervisor = PoolSupervisor(executor, interval_s=60, metrics=metrics)
+    try:
+        await executor.fill_executor_pod_queue()
+        assert executor.pool_ready_count == 2
+        victim = executor._queue[0]
+        for ip in victim.pod_ips:
+            await pods.stop_pod(ip)
+
+        swept = await supervisor.sweep_once()
+        assert swept["reaped"] == 1
+        for _ in range(200):  # refill is kicked fire-and-forget
+            if executor.pool_ready_count == 2:
+                break
+            await asyncio.sleep(0.01)
+        assert executor.pool_ready_count == 2  # replenished to target
+        reaped = [
+            e for e in executor.journal.events() if e["state"] == "reaped"
+        ]
+        assert [e["pod"] for e in reaped] == [victim.name]
+        assert reaped[0]["reason"] == "unhealthy_idle"
+        text = metrics.expose()
+        assert 'bci_pod_reaped_total{reason="unhealthy_idle"} 1' in text
+        assert "bci_supervisor_probe_seconds_count 1" in text
+        assert supervisor.snapshot()["reaped"] == 1
+    finally:
+        await pods.close()
+
+
+async def test_supervisor_healthy_sweep_reaps_nothing(pods, storage, faults):
+    executor = make_executor(
+        pods, storage, faults, executor_pod_queue_target_length=1
+    )
+    supervisor = PoolSupervisor(executor, interval_s=60)
+    try:
+        await executor.fill_executor_pod_queue()
+        swept = await supervisor.sweep_once()
+        assert swept == {
+            "reaped": 0,
+            "watchdog_killed": 0,
+            "duration_s": swept["duration_s"],
+        }
+        assert executor.pool_ready_count == 1
+    finally:
+        await pods.close()
+
+
+async def test_supervisor_background_loop_sweeps_on_cadence(
+    pods, storage, faults
+):
+    executor = make_executor(pods, storage, faults)
+    supervisor = PoolSupervisor(executor, interval_s=0.05)
+    try:
+        supervisor.start()
+        assert supervisor.running
+        for _ in range(100):
+            if supervisor.sweeps_total >= 2:
+                break
+            await asyncio.sleep(0.02)
+        assert supervisor.sweeps_total >= 2
+        assert supervisor.snapshot()["last_sweep_age_s"] is not None
+    finally:
+        await supervisor.stop()
+        assert not supervisor.running
+        await pods.close()
+
+
+# ------------------------------------------------------------- watchdog
+
+
+async def test_watchdog_kills_stuck_execution_as_transient(
+    pods, storage, faults
+):
+    # The sandbox wedges mid-/execute. The watchdog must kill it: the
+    # request fails TRANSIENT (replayable), the journal says hung_execute,
+    # and the in-flight slot is freed.
+    executor = make_executor(pods, storage, faults)
+    supervisor = PoolSupervisor(
+        executor, interval_s=60, execute_hard_cap_s=0.2
+    )
+    faults.hang_execute(30.0)
+    try:
+        request = asyncio.ensure_future(executor.execute("print(1)"))
+        await asyncio.sleep(0.3)
+        assert len(executor.inflight) == 1
+        swept = await supervisor.sweep_once()
+        assert swept["watchdog_killed"] == 1
+        with pytest.raises(SandboxTransientError, match="watchdog"):
+            await request
+        assert len(executor.inflight) == 0  # slot freed
+        reaped = [
+            e for e in executor.journal.events() if e["state"] == "reaped"
+        ]
+        assert reaped and reaped[0]["reason"] == "hung_execute"
+    finally:
+        await pods.close()
+
+
+async def test_watchdog_spares_executions_under_the_cap(pods, storage, faults):
+    executor = make_executor(pods, storage, faults)
+    supervisor = PoolSupervisor(
+        executor, interval_s=60, execute_hard_cap_s=30.0
+    )
+    try:
+        request = asyncio.ensure_future(executor.execute("print('fine')"))
+        await asyncio.sleep(0)
+        swept = await supervisor.sweep_once()
+        assert swept["watchdog_killed"] == 0
+        result = await request
+        assert result.stdout == "fine\n"
+    finally:
+        await pods.close()
+
+
+def test_inflight_registry_converts_only_watchdog_cancels():
+    async def go():
+        registry = InflightRegistry()
+
+        async def tracked(trigger: asyncio.Event):
+            with registry.track("box-1", kill=None):
+                trigger.set()
+                await asyncio.sleep(30)
+
+        # Watchdog kill -> SandboxTransientError with the hung_execute reason.
+        trigger = asyncio.Event()
+        task = asyncio.ensure_future(tracked(trigger))
+        await trigger.wait()
+        (entry,) = registry.overdue(0.0)
+        registry.kill(entry)
+        with pytest.raises(SandboxTransientError, match="watchdog") as exc:
+            await task
+        assert exc.value.reap_reason == "hung_execute"
+        assert len(registry) == 0
+
+        # A plain cancel (deadline, client gone) passes through untouched.
+        trigger = asyncio.Event()
+        task = asyncio.ensure_future(tracked(trigger))
+        await trigger.wait()
+        task.cancel()
+        with pytest.raises(asyncio.CancelledError):
+            await task
+        assert len(registry) == 0
+
+    asyncio.run(go())
+
+
+# ------------------------------------------------------------------ replay
+
+
+async def test_pod_death_mid_execute_is_replayed_transparently(
+    pods, storage, faults
+):
+    # Acceptance: a pod killed mid-execute still returns a successful
+    # ExecuteResponse via replay within the request deadline, with
+    # reaped{reason=died_mid_execute} + bci_execution_replays_total
+    # observable.
+    metrics = Registry()
+    executor = make_executor(pods, storage, faults, metrics=metrics)
+    hedged = HedgingExecutor(executor, replay_max=1, metrics=metrics)
+    faults.die_mid_execute()
+    try:
+        result = await hedged.execute(
+            "print(21 * 2)", deadline=Deadline.after(30)
+        )
+        assert result.stdout == "42\n"
+        text = metrics.expose()
+        assert "bci_execution_replays_total 1" in text
+        assert 'bci_pod_reaped_total{reason="died_mid_execute"} 1' in text
+        reaped = [
+            e for e in executor.journal.events() if e["state"] == "reaped"
+        ]
+        assert reaped and reaped[0]["reason"] == "died_mid_execute"
+    finally:
+        await pods.close()
+
+
+async def test_replay_budget_and_deadline_bound_it(pods, storage, faults):
+    # Every attempt dies: the replay budget must bound the attempts and the
+    # original transient error must surface (no infinite heal loop).
+    metrics = Registry()
+    executor = make_executor(pods, storage, faults, metrics=metrics)
+    hedged = HedgingExecutor(executor, replay_max=2, metrics=metrics)
+    for _ in range(3):
+        faults.die_mid_execute()
+    try:
+        with pytest.raises(SandboxTransientError):
+            await hedged.execute("print(1)")
+        assert "bci_execution_replays_total 2" in metrics.expose()
+        # an expired deadline stops replays immediately
+        faults.die_mid_execute()
+        clock = ManualClock()
+        expired = Deadline.after(5.0, clock=clock)
+        clock.advance(10.0)
+        with pytest.raises(Exception):
+            await hedged.execute("print(1)", deadline=expired)
+    finally:
+        await pods.close()
+
+
+# ------------------------------------------------------------------ hedging
+
+
+async def test_hedged_execution_second_sandbox_wins(pods, storage, faults):
+    # The first attempt's /execute hangs; after the hedge delay a second
+    # sandbox runs the same request and wins. The loser is cancelled and
+    # its pod journaled out.
+    metrics = Registry()
+    executor = make_executor(pods, storage, faults, metrics=metrics)
+    hedged = HedgingExecutor(
+        executor, replay_max=0, hedge_delay_s=0.1, metrics=metrics
+    )
+    faults.hang_execute(30.0)  # first /execute call hangs; second is healthy
+    try:
+        result = await hedged.execute("print('win')")
+        assert result.stdout == "win\n"
+        assert 'bci_hedge_total{outcome="hedge_won"} 1' in metrics.expose()
+        await asyncio.sleep(0.05)  # let the loser's cancellation land
+        released = [
+            e for e in executor.journal.events() if e["state"] == "released"
+        ]
+        assert any(e["reason"] == "cancelled" for e in released)
+    finally:
+        await pods.close()
+
+
+async def test_near_expired_deadline_does_not_reap_healthy_warm_pool(
+    pods, storage, faults
+):
+    # Review regression: a request arriving with ~no budget left must fail
+    # DeadlineExceeded — NOT instant-timeout the health probe and destroy
+    # every healthy warm group on its way out.
+    from bee_code_interpreter_tpu.resilience import DeadlineExceeded
+
+    executor = make_executor(
+        pods, storage, faults, executor_pod_queue_target_length=2
+    )
+    try:
+        await executor.fill_executor_pod_queue()
+        assert executor.pool_ready_count == 2
+        clock = ManualClock()
+        nearly_gone = Deadline.after(10.0, clock=clock)
+        clock.advance(9.999)  # ~1ms of budget left
+        with pytest.raises(DeadlineExceeded):
+            async with executor.executor_pod_group(deadline=nearly_gone):
+                pass
+        assert executor.pool_ready_count == 2  # pool untouched
+        assert not any(
+            e["state"] == "reaped" for e in executor.journal.events()
+        )
+    finally:
+        await pods.close()
+
+
+async def test_refill_racing_aclose_deletes_spawned_group(
+    pods, storage, faults
+):
+    # Review regression: a refill in flight when aclose() lands must delete
+    # its freshly spawned pods, never append them to the dead pool (leaked
+    # cluster pods after every graceful restart).
+    executor = make_executor(
+        pods, storage, faults, executor_pod_queue_target_length=1
+    )
+    try:
+        refill = asyncio.ensure_future(executor.fill_executor_pod_queue())
+        await asyncio.sleep(0)  # refill reserves its spawn slot
+        await executor.aclose()
+        await refill
+        assert executor.pool_ready_count == 0
+        await asyncio.sleep(0.05)  # let fire-and-forget deletes land
+        kubectl = executor._kubectl
+        created = {m["metadata"]["name"] for m in kubectl.created_manifests}
+        assert created and created <= set(kubectl.deleted)
+        reasons = [
+            e.get("reason")
+            for e in executor.journal.events()
+            if e["state"] == "reaped"
+        ]
+        assert reasons == ["shutdown"]
+    finally:
+        await pods.close()
+
+
+async def test_hedge_suppressed_when_deadline_cannot_cover_the_delay(
+    pods, storage, faults
+):
+    # Review regression: remaining <= hedge_delay must mean "never hedge",
+    # not "hedge immediately" — a second attempt bounded by the same
+    # expiring deadline can never win and just burns a warm sandbox.
+    metrics = Registry()
+    executor = make_executor(pods, storage, faults, metrics=metrics)
+    hedged = HedgingExecutor(
+        executor, replay_max=0, hedge_delay_s=60.0, metrics=metrics
+    )
+    try:
+        result = await hedged.execute(
+            "print('one sandbox')", deadline=Deadline.after(30.0)
+        )
+        assert result.stdout == "one sandbox\n"
+        assert len(pods.execute_counts) == 1  # exactly one pod executed
+        assert "bci_hedge_total{" not in metrics.expose()
+    finally:
+        await pods.close()
+
+
+async def test_fast_primary_never_hedges(pods, storage, faults):
+    metrics = Registry()
+    executor = make_executor(pods, storage, faults, metrics=metrics)
+    hedged = HedgingExecutor(
+        executor, replay_max=0, hedge_delay_s=5.0, metrics=metrics
+    )
+    try:
+        result = await hedged.execute("print('solo')")
+        assert result.stdout == "solo\n"
+        assert "bci_hedge_total{" not in metrics.expose()  # no hedge launched
+        assert len(pods.execute_counts) == 1  # exactly one pod executed
+    finally:
+        await pods.close()
+
+
+# -------------------------------------------------------------------- drain
+
+
+async def test_drain_controller_tracks_and_waits():
+    metrics = Registry()
+    drain = DrainController(metrics=metrics, retry_after_s=2.0)
+    assert not drain.draining
+
+    release = asyncio.Event()
+
+    async def request():
+        with drain.track():
+            await release.wait()
+
+    task = asyncio.ensure_future(request())
+    await asyncio.sleep(0)
+    assert drain.in_flight == 1
+    assert "bci_drain_inflight 1" in metrics.expose()
+
+    flipped: list[str] = []
+    drain.on_drain(lambda: flipped.append("health"))
+    drain.begin()
+    drain.begin()  # idempotent
+    assert drain.draining
+    assert flipped == ["health"]
+    # a late-registered callback (server built after the drain began) fires
+    drain.on_drain(lambda: flipped.append("late"))
+    assert flipped == ["health", "late"]
+
+    # grace expires while the request is still running
+    assert await drain.wait_idle(0.05) is False
+    release.set()
+    await task
+    assert await drain.wait_idle(1.0) is True
+    assert drain.in_flight == 0
+
+
+async def test_supervisor_stops_refilling_during_drain(pods, storage, faults):
+    drain = DrainController()
+    executor = make_executor(
+        pods, storage, faults, executor_pod_queue_target_length=2
+    )
+    supervisor = PoolSupervisor(executor, interval_s=60, drain=drain)
+    try:
+        drain.begin()
+        await supervisor.sweep_once()
+        await asyncio.sleep(0.1)  # would be enough for a (wrongly) kicked refill
+        assert executor.pool_ready_count == 0  # no refill while draining
+    finally:
+        await pods.close()
+
+
+def test_health_check_draining_classification():
+    """Satellite: the liveness probe must map a draining service to its own
+    exit code (3), distinct from dead (2), off the verbose healthz body."""
+    from bee_code_interpreter_tpu.health_check import DRAINING_EXIT, is_draining
+
+    assert DRAINING_EXIT == 3
+    assert is_draining({"status": "draining", "drain_inflight": 2})
+    assert not is_draining({"status": "ok"})
+    assert not is_draining({})
+
+
+# ------------------------------------------------- native deterministic close
+
+
+async def test_native_shutdown_closes_http_client_deterministically(
+    tmp_path, storage
+):
+    """Satellite regression: the old shutdown() scheduled _http.aclose() as
+    a fire-and-forget task the closing loop could cancel before it ran;
+    aclose() must leave the client closed when it returns."""
+    from bee_code_interpreter_tpu.services.native_process_code_executor import (
+        NativeProcessCodeExecutor,
+    )
+
+    config = Config(
+        executor_backend="local",
+        local_workspace_root=str(tmp_path / "ws"),
+        executor_pod_queue_target_length=0,
+        disable_dep_install=True,
+    )
+    executor = NativeProcessCodeExecutor(
+        storage=storage, config=config, binary="/bin/true"
+    )
+    assert not executor._http.is_closed
+    await executor.aclose()
+    assert executor._http.is_closed
+    assert executor._closed
+
+
+async def test_kubernetes_aclose_reaps_queue_and_closes_client(
+    pods, storage, faults
+):
+    executor = make_executor(
+        pods, storage, faults, executor_pod_queue_target_length=1
+    )
+    try:
+        await executor.fill_executor_pod_queue()
+        assert executor.pool_ready_count == 1
+        await executor.aclose()
+        assert executor.pool_ready_count == 0
+        assert executor._http.is_closed
+        reaped = [
+            e for e in executor.journal.events() if e["state"] == "reaped"
+        ]
+        assert reaped and reaped[0]["reason"] == "shutdown"
+    finally:
+        await pods.close()
