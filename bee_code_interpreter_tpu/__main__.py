@@ -44,6 +44,9 @@ async def main() -> None:
     logger.info("gRPC server listening on %s", ctx.config.grpc_listen_addr)
 
     ctx.start_storage_sweeper()
+    # Background OTLP push of traces + metric snapshots (APP_OTLP_ENDPOINT);
+    # no-op when export isn't configured.
+    ctx.start_telemetry_exporter()
 
     stop = asyncio.Event()
     loop = asyncio.get_running_loop()

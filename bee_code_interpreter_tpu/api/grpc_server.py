@@ -17,6 +17,7 @@ from __future__ import annotations
 import asyncio
 import json
 import logging
+import time
 from contextlib import nullcontext
 
 import grpc
@@ -29,6 +30,7 @@ from bee_code_interpreter_tpu.observability import (
     FleetJournal,
     Tracer,
     current_trace,
+    empty_slo_snapshot,
     find_journal,
     parse_traceparent,
     record_usage_at_edge,
@@ -55,6 +57,13 @@ from bee_code_interpreter_tpu.utils.request_id import new_request_id
 logger = logging.getLogger(__name__)
 
 SERVICE_NAME = "code_interpreter.v1.CodeInterpreterService"
+
+# grpc.aio's context.abort unwinds the handler by raising this; an empty
+# tuple (older grpcio without the symbol) simply catches nothing and aborts
+# from run() fall through to the catch-all.
+_ABORT_ERRORS = tuple(
+    t for t in (getattr(grpc.aio, "AbortError", None),) if t is not None
+)
 
 _METHODS: dict[str, tuple[type, type]] = {
     "Execute": (pb.ExecuteRequest, pb.ExecuteResponse),
@@ -103,12 +112,14 @@ class CodeInterpreterServicer:
         metrics: Registry | None = None,
         tracer: Tracer | None = None,
         drain=None,  # resilience.DrainController
+        slo=None,  # observability.SloEngine (shared with the HTTP edge)
     ) -> None:
         self._code_executor = code_executor
         self._custom_tool_executor = custom_tool_executor
         self._admission = admission
         self._request_deadline_s = request_deadline_s
         self._drain = drain
+        self._slo = slo
         self._tracer = tracer or Tracer(metrics=metrics)
         self._deadline_exceeded_total = (
             metrics.counter(
@@ -124,6 +135,26 @@ class CodeInterpreterServicer:
         self._execution_cpu_seconds, self._execution_peak_rss = (
             register_usage_metrics(metrics) if metrics is not None else (None, None)
         )
+
+    def _sample_client_fault(self, start: float) -> None:
+        """A sandbox-bound RPC rejected at validation is the CLIENT's fault:
+        sampled as good, mirroring the HTTP edge's 422 — both transports
+        must compute identical SLIs for identical workloads."""
+        if self._slo is not None:
+            self._slo.record(ok=True, duration_s=time.monotonic() - start)
+
+    async def _validated_sampled(
+        self, context: grpc.aio.ServicerContext, start: float, model_cls, **fields
+    ):
+        """:func:`_validated` for the sandbox-bound RPCs: a validation
+        failure records its (good) SLI sample before aborting."""
+        try:
+            return model_cls(**fields)
+        except ValidationError as e:
+            self._sample_client_fault(start)
+            await context.abort(
+                grpc.StatusCode.INVALID_ARGUMENT, _violation_text(e)
+            )
 
     def _trace_rpc(self, method: str, context: grpc.aio.ServicerContext, rid: str):
         """Root a trace for one RPC, continuing an inbound ``traceparent``
@@ -158,7 +189,12 @@ class CodeInterpreterServicer:
         """Run a sandbox-bound RPC body under the edge deadline and the
         admission gate, mapping the shared shed/deadline abort contract
         (docs/resilience.md) — the one place it is spelled for gRPC.
-        ``run(deadline)`` returns the success response."""
+        ``run(deadline)`` returns the success response.
+
+        SLI recording mirrors the HTTP edge (docs/observability.md "SLOs"):
+        server-side failures (blown deadline, open breaker, internal error)
+        burn availability budget; client-fault aborts raised by ``run``
+        (INVALID_ARGUMENT) count good; shed/drain/cancel are excluded."""
         # Drain check BEFORE admission (mirror of the HTTP edge): a
         # draining replica rejects new work retryably while in-flight RPCs
         # (tracked below) run to completion. Health answers NOT_SERVING.
@@ -171,54 +207,77 @@ class CodeInterpreterServicer:
                 "service draining; retry against another replica",
             )
         deadline = self._new_deadline(context)
+        slo_start = time.monotonic()
+        outcome: bool | None = None
         try:
-            # track() covers the admission wait too (mirror of the HTTP
-            # edge): a queued waiter was admitted past the drain check and
-            # WILL execute — teardown must wait for it.
-            with (
-                self._drain.track()
-                if self._drain is not None
-                else nullcontext()
-            ):
-                async with (
-                    self._admission.admit(deadline)
-                    if self._admission is not None
+            try:
+                # track() covers the admission wait too (mirror of the HTTP
+                # edge): a queued waiter was admitted past the drain check and
+                # WILL execute — teardown must wait for it.
+                with (
+                    self._drain.track()
+                    if self._drain is not None
                     else nullcontext()
                 ):
-                    return await run(deadline)
-        except AdmissionRejected as e:
-            context.set_trailing_metadata(
-                (("retry-after-s", f"{e.retry_after_s:g}"),)
-            )
-            await context.abort(
-                grpc.StatusCode.RESOURCE_EXHAUSTED,
-                f"service overloaded ({e.reason}); retry in {e.retry_after_s:g}s",
-            )
-        except DeadlineExceeded:
-            if self._deadline_exceeded_total is not None:
-                self._deadline_exceeded_total.inc(transport="grpc")
-            await context.abort(
-                grpc.StatusCode.DEADLINE_EXCEEDED, "request deadline exceeded"
-            )
-        except BreakerOpenError as e:
-            # Open breaker, no fallback: retryable overload, not an internal
-            # error — UNAVAILABLE with the breaker's retry hint.
-            context.set_trailing_metadata(
-                (("retry-after-s", f"{e.retry_after_s:g}"),)
-            )
-            await context.abort(
-                grpc.StatusCode.UNAVAILABLE,
-                f"backend temporarily unavailable; retry in {e.retry_after_s:g}s",
-            )
+                    async with (
+                        self._admission.admit(deadline)
+                        if self._admission is not None
+                        else nullcontext()
+                    ):
+                        response = await run(deadline)
+                outcome = True
+                return response
+            except AdmissionRejected as e:
+                context.set_trailing_metadata(
+                    (("retry-after-s", f"{e.retry_after_s:g}"),)
+                )
+                await context.abort(
+                    grpc.StatusCode.RESOURCE_EXHAUSTED,
+                    f"service overloaded ({e.reason}); retry in {e.retry_after_s:g}s",
+                )
+            except DeadlineExceeded:
+                outcome = False
+                if self._deadline_exceeded_total is not None:
+                    self._deadline_exceeded_total.inc(transport="grpc")
+                await context.abort(
+                    grpc.StatusCode.DEADLINE_EXCEEDED, "request deadline exceeded"
+                )
+            except BreakerOpenError as e:
+                # Open breaker, no fallback: retryable overload, not an internal
+                # error — UNAVAILABLE with the breaker's retry hint.
+                outcome = False
+                context.set_trailing_metadata(
+                    (("retry-after-s", f"{e.retry_after_s:g}"),)
+                )
+                await context.abort(
+                    grpc.StatusCode.UNAVAILABLE,
+                    f"backend temporarily unavailable; retry in {e.retry_after_s:g}s",
+                )
+            except asyncio.CancelledError:
+                raise  # client went away: not an SLI sample
+            except _ABORT_ERRORS:
+                outcome = True  # run() aborted INVALID_ARGUMENT: client fault
+                raise
+            except BaseException:
+                outcome = False  # unhandled → gRPC UNKNOWN
+                raise
+        finally:
+            if self._slo is not None and outcome is not None:
+                self._slo.record(
+                    ok=outcome, duration_s=time.monotonic() - slo_start
+                )
 
     async def Execute(
         self, request: pb.ExecuteRequest, context: grpc.aio.ServicerContext
     ) -> pb.ExecuteResponse:
         rid = new_request_id()
+        rpc_start = time.monotonic()
         if not request.source_code:
+            self._sample_client_fault(rpc_start)
             await context.abort(grpc.StatusCode.INVALID_ARGUMENT, "source_code is required")
-        validated = await _validated(
+        validated = await self._validated_sampled(
             context,
+            rpc_start,
             api_models.ExecuteRequest,
             source_code=request.source_code,
             files=dict(request.files),
@@ -282,10 +341,12 @@ class CodeInterpreterServicer:
         self, request: pb.ExecuteCustomToolRequest, context: grpc.aio.ServicerContext
     ) -> pb.ExecuteCustomToolResponse:
         rid = new_request_id()
+        rpc_start = time.monotonic()
         import json
 
-        validated = await _validated(
+        validated = await self._validated_sampled(
             context,
+            rpc_start,
             api_models.ExecuteCustomToolRequest,
             tool_source_code=request.tool_source_code,
             tool_input_json=request.tool_input_json,
@@ -375,6 +436,63 @@ def fleet_stubs(channel: grpc.aio.Channel | grpc.Channel) -> dict[str, object]:
     return {
         name: channel.unary_unary(f"/{FLEET_SERVICE_NAME}/{name}")
         for name in _FLEET_METHODS
+    }
+
+
+OBSERVABILITY_SERVICE_NAME = "code_interpreter.v1.ObservabilityService"
+
+
+class ObservabilityServicer:
+    """SLO state and the one-call debug bundle over gRPC — the transport
+    mirror of ``GET /v1/slo`` / ``GET /v1/debug/bundle``, as JSON message
+    bytes through a generic handler (same protoc-less trick as
+    ``FleetService``)."""
+
+    def __init__(self, slo=None, debug_bundle=None) -> None:
+        self._slo = slo
+        self._debug_bundle = debug_bundle
+
+    async def GetSlo(self, request: bytes, context) -> bytes:
+        snapshot = (
+            self._slo.snapshot() if self._slo is not None else empty_slo_snapshot()
+        )
+        return json.dumps(snapshot).encode()
+
+    async def GetDebugBundle(self, request: bytes, context) -> bytes:
+        if self._debug_bundle is None:
+            await context.abort(
+                grpc.StatusCode.UNIMPLEMENTED,
+                "no debug-bundle builder wired into this server",
+            )
+        return json.dumps(self._debug_bundle()).encode()
+
+
+_OBSERVABILITY_METHODS = ("GetSlo", "GetDebugBundle")
+
+
+def _observability_handler(servicer: ObservabilityServicer) -> grpc.GenericRpcHandler:
+    passthrough = bytes
+    return grpc.method_handlers_generic_handler(
+        OBSERVABILITY_SERVICE_NAME,
+        {
+            name: grpc.unary_unary_rpc_method_handler(
+                getattr(servicer, name),
+                request_deserializer=passthrough,
+                response_serializer=passthrough,
+            )
+            for name in _OBSERVABILITY_METHODS
+        },
+    )
+
+
+def observability_stubs(
+    channel: grpc.aio.Channel | grpc.Channel,
+) -> dict[str, object]:
+    """Client-side multicallables for the SLO/debug-bundle RPCs; send b""
+    and json.loads the reply."""
+    return {
+        name: channel.unary_unary(f"/{OBSERVABILITY_SERVICE_NAME}/{name}")
+        for name in _OBSERVABILITY_METHODS
     }
 
 
@@ -610,6 +728,8 @@ class GrpcServer:
         tracer: Tracer | None = None,
         fleet: FleetJournal | None = None,
         drain=None,  # resilience.DrainController
+        slo=None,  # observability.SloEngine shared with the HTTP edge
+        debug_bundle=None,  # callable -> dict (ApplicationContext builder)
     ) -> None:
         self._servicer = CodeInterpreterServicer(
             code_executor,
@@ -619,7 +739,10 @@ class GrpcServer:
             metrics=metrics,
             tracer=tracer,
             drain=drain,
+            slo=slo,
         )
+        self._slo = slo
+        self._debug_bundle = debug_bundle
         # Mirror the HTTP edge: use the executor backend's own journal when
         # one exists (find_journal is the one shared discovery rule), else
         # an (honestly empty) standalone journal. Explicit None checks: an
@@ -652,6 +775,7 @@ class GrpcServer:
             (
                 SERVICE_NAME,
                 FLEET_SERVICE_NAME,
+                OBSERVABILITY_SERVICE_NAME,
                 HEALTH_SERVICE_NAME,
                 REFLECTION_SERVICE_NAME,
             )
@@ -660,6 +784,11 @@ class GrpcServer:
             (
                 _generic_handler(self._servicer),
                 _fleet_handler(FleetServicer(self._fleet)),
+                _observability_handler(
+                    ObservabilityServicer(
+                        slo=self._slo, debug_bundle=self._debug_bundle
+                    )
+                ),
                 _health_handler(self.health),
                 _reflection_handler(reflection),
             )

@@ -24,9 +24,13 @@ from ``GET /v1/traces`` (with ``?limit=``/``?min_duration_ms=`` filtering) +
 ``trace_id``, a per-stage ``timings_ms`` breakdown, and a per-execution
 ``usage`` resource-accounting block. Fleet state (the sandbox pool's
 lifecycle journal) is served at ``GET /v1/fleet`` + ``GET /v1/fleet/events``,
-``GET /healthz?verbose=1`` adds pool/breaker/fleet deep health, and
-``POST /v1/profile`` captures an on-demand ``jax.profiler`` trace of a
-sandbox execution or of N serving-engine steps.
+``GET /healthz?verbose=1`` adds pool/breaker/fleet deep health (plus SLO
+state when objectives are declared), and ``POST /v1/profile`` captures an
+on-demand ``jax.profiler`` trace of a sandbox execution or of N
+serving-engine steps. ``GET /v1/slo`` reports error-budget burn rates,
+``GET /v1/debug/bundle`` is the one-call incident snapshot, and
+``GET /metrics`` serves OpenMetrics-with-exemplars when the scraper's
+``Accept`` header asks for it.
 """
 
 from __future__ import annotations
@@ -35,6 +39,7 @@ import asyncio
 import json
 import logging
 import math
+import time
 from contextlib import nullcontext
 
 import pydantic
@@ -47,14 +52,16 @@ from bee_code_interpreter_tpu.observability import (
     FleetJournal,
     ProfilerUnavailable,
     Tracer,
+    build_debug_bundle,
     current_trace,
+    empty_slo_snapshot,
+    executor_health,
     find_journal,
     inject_profile_env,
     parse_traceparent,
     profile_artifacts,
     record_usage_at_edge,
     register_usage_metrics,
-    unwrap_executor,
 )
 from bee_code_interpreter_tpu.resilience import (
     AdmissionController,
@@ -69,7 +76,12 @@ from bee_code_interpreter_tpu.services.custom_tool_executor import (
     CustomToolExecutor,
     CustomToolParseError,
 )
-from bee_code_interpreter_tpu.utils.metrics import PROMETHEUS_CONTENT_TYPE, Registry
+from bee_code_interpreter_tpu.utils.metrics import (
+    OPENMETRICS_CONTENT_TYPE,
+    PROMETHEUS_CONTENT_TYPE,
+    Registry,
+    accepts_openmetrics,
+)
 from bee_code_interpreter_tpu.utils.request_id import new_request_id
 
 logger = logging.getLogger(__name__)
@@ -77,28 +89,6 @@ logger = logging.getLogger(__name__)
 
 def _retry_after_header(e: AdmissionRejected | BreakerOpenError) -> dict[str, str]:
     return {"Retry-After": str(max(1, math.ceil(e.retry_after_s)))}
-
-
-def _executor_health(executor) -> dict:
-    """Deep-health view of the executor backend: pool occupancy and breaker
-    states, shaped for ``GET /healthz?verbose=1``. Empty for backends with
-    no pool (the in-process local executor)."""
-    inner = unwrap_executor(executor)
-    info: dict = {}
-    ready = getattr(inner, "pool_ready_count", None)
-    if ready is not None:
-        info["pool"] = {
-            "ready": ready,
-            "spawning": getattr(inner, "pool_spawning_count", 0),
-        }
-    breakers = {}
-    for attr in ("spawn_breaker", "http_breaker"):
-        breaker = getattr(inner, attr, None)
-        if breaker is not None:
-            breakers[breaker.name] = breaker.state.name.lower()
-    if breakers:
-        info["breakers"] = breakers
-    return info
 
 
 def create_http_server(
@@ -112,6 +102,8 @@ def create_http_server(
     profiler=None,  # observability.ServingProfiler for POST /v1/profile
     drain=None,  # resilience.DrainController for graceful shutdown
     supervisor=None,  # resilience.PoolSupervisor, surfaced on /v1/fleet
+    slo=None,  # observability.SloEngine for GET /v1/slo + SLI recording
+    debug_bundle=None,  # callable -> dict (ApplicationContext.build_debug_bundle)
 ) -> web.Application:
     app = web.Application(client_max_size=1 << 30)
     metrics = metrics or Registry()
@@ -141,7 +133,13 @@ def create_http_server(
         admission gate, mapping the shared shed/deadline response contract
         (docs/resilience.md) — the one place it is spelled for HTTP.
         ``run(deadline)`` returns the success response. The admission gate
-        traces its own acquire as the ``admission`` stage span."""
+        traces its own acquire as the ``admission`` stage span.
+
+        Every request that gets past the drain check is also an SLI sample
+        (docs/observability.md "SLOs"): server-side failures (5xx) burn
+        availability budget, client faults (4xx) count good, and deliberate
+        load management (429 shed, drain 503, client cancel) is excluded —
+        ``outcome`` None means "not a sample"."""
         # Drain check BEFORE admission: a draining replica must not queue
         # new work it has promised to finish — 503 + Retry-After tells the
         # client (or the balancer) to go elsewhere, while requests already
@@ -153,39 +151,57 @@ def create_http_server(
                 headers={"Retry-After": str(max(1, math.ceil(drain.retry_after_s)))},
             )
         deadline = Deadline.after(request_deadline_s) if request_deadline_s else None
+        slo_start = time.monotonic()
+        outcome: bool | None = None
         try:
-            # track() covers the admission wait too: a request already
-            # granted (or queued for) a slot when the drain begins was
-            # admitted past the drain check and WILL execute — teardown
-            # must wait for it, not just for bodies already running.
-            with drain.track() if drain is not None else nullcontext():
-                async with (
-                    admission.admit(deadline)
-                    if admission is not None
-                    else nullcontext()
-                ):
-                    return await run(deadline)
-        except AdmissionRejected as e:
-            logger.warning("Request shed: %s", e)
-            return web.json_response(
-                {"detail": "Service overloaded; retry later"},
-                status=429,
-                headers=_retry_after_header(e),
-            )
-        except DeadlineExceeded as e:
-            deadline_exceeded_total.inc(transport="http")
-            logger.warning("Request deadline exceeded: %s", e)
-            return web.json_response({"detail": "Deadline exceeded"}, status=504)
-        except BreakerOpenError as e:
-            # Open breaker and no fallback configured: this is retryable
-            # overload (the breaker knows when it will probe again), not a
-            # server bug — 503 + Retry-After, never a generic 500.
-            logger.warning("Request rejected by open breaker: %s", e)
-            return web.json_response(
-                {"detail": "Backend temporarily unavailable; retry later"},
-                status=503,
-                headers=_retry_after_header(e),
-            )
+            try:
+                # track() covers the admission wait too: a request already
+                # granted (or queued for) a slot when the drain begins was
+                # admitted past the drain check and WILL execute — teardown
+                # must wait for it, not just for bodies already running.
+                with drain.track() if drain is not None else nullcontext():
+                    async with (
+                        admission.admit(deadline)
+                        if admission is not None
+                        else nullcontext()
+                    ):
+                        response = await run(deadline)
+                outcome = response.status < 500
+                return response
+            except AdmissionRejected as e:
+                logger.warning("Request shed: %s", e)
+                return web.json_response(
+                    {"detail": "Service overloaded; retry later"},
+                    status=429,
+                    headers=_retry_after_header(e),
+                )
+            except DeadlineExceeded as e:
+                outcome = False
+                deadline_exceeded_total.inc(transport="http")
+                logger.warning("Request deadline exceeded: %s", e)
+                return web.json_response({"detail": "Deadline exceeded"}, status=504)
+            except BreakerOpenError as e:
+                # Open breaker and no fallback configured: this is retryable
+                # overload (the breaker knows when it will probe again), not a
+                # server bug — 503 + Retry-After, never a generic 500.
+                outcome = False
+                logger.warning("Request rejected by open breaker: %s", e)
+                return web.json_response(
+                    {"detail": "Backend temporarily unavailable; retry later"},
+                    status=503,
+                    headers=_retry_after_header(e),
+                )
+            except asyncio.CancelledError:
+                raise  # client went away: not an SLI sample
+            except web.HTTPException as e:
+                outcome = e.status < 500  # 422 body-validation etc.
+                raise
+            except BaseException:
+                outcome = False  # unhandled → aiohttp's 500
+                raise
+        finally:
+            if slo is not None and outcome is not None:
+                slo.record(ok=outcome, duration_s=time.monotonic() - slo_start)
 
     @web.middleware
     async def request_id_middleware(request: web.Request, handler):
@@ -396,7 +412,7 @@ def create_http_server(
         if request.query.get("verbose", "").lower() in ("1", "true", "yes", "on"):
             # Deep health: pool occupancy, breaker states, fleet aggregates
             # — the "why is it unhealthy" view a bare 200 can't carry.
-            body.update(_executor_health(code_executor))
+            body.update(executor_health(code_executor))
             if draining:
                 body["drain_inflight"] = drain.in_flight
             if supervisor is not None:
@@ -408,15 +424,52 @@ def create_http_server(
                 "utilization": snapshot["utilization"],
                 "executions_total": snapshot["executions_total"],
             }
+            if slo is not None and slo.objectives:
+                # Budget exhaustion is a *health* fact: health_check.py's
+                # --verbose warning exit keys off fast_burn_alerting here.
+                body["slo"] = slo.snapshot()
         return web.json_response(body)
 
-    async def metrics_endpoint(_request: web.Request) -> web.Response:
-        # The exposition-format content type (version parameter included) so
-        # Prometheus scrapers negotiate the parser instead of guessing.
+    async def metrics_endpoint(request: web.Request) -> web.Response:
+        # Content negotiation: OpenMetrics (exemplars + `# EOF`) when the
+        # scraper asks for it (q-values honored), the classic Prometheus
+        # text format (version parameter included, so scrapers pick the
+        # parser) by default.
+        openmetrics = accepts_openmetrics(request.headers.get("Accept", ""))
         return web.Response(
-            body=metrics.expose().encode("utf-8"),
-            headers={"Content-Type": PROMETHEUS_CONTENT_TYPE},
+            body=metrics.expose(openmetrics=openmetrics).encode("utf-8"),
+            headers={
+                "Content-Type": (
+                    OPENMETRICS_CONTENT_TYPE
+                    if openmetrics
+                    else PROMETHEUS_CONTENT_TYPE
+                )
+            },
         )
+
+    async def slo_endpoint(_request: web.Request) -> web.Response:
+        return web.json_response(
+            slo.snapshot() if slo is not None else empty_slo_snapshot()
+        )
+
+    async def debug_bundle_endpoint(_request: web.Request) -> web.Response:
+        # One-call incident snapshot (docs/observability.md "Debug bundle").
+        # The composition root's builder when wired; otherwise assembled
+        # from what this server was handed (standalone/test apps).
+        bundle = (
+            debug_bundle()
+            if debug_bundle is not None
+            else build_debug_bundle(
+                tracer=tracer,
+                fleet=fleet,
+                slo=slo,
+                metrics=metrics,
+                executor=code_executor,
+                supervisor=supervisor,
+                drain=drain,
+            )
+        )
+        return web.json_response(bundle)
 
     async def list_traces(request: web.Request) -> web.Response:
         # ?limit=N caps the response (newest first); ?min_duration_ms=X
@@ -492,4 +545,6 @@ def create_http_server(
     app.router.add_get("/v1/traces/{trace_id}", get_trace)
     app.router.add_get("/v1/fleet", fleet_snapshot)
     app.router.add_get("/v1/fleet/events", fleet_events)
+    app.router.add_get("/v1/slo", slo_endpoint)
+    app.router.add_get("/v1/debug/bundle", debug_bundle_endpoint)
     return app

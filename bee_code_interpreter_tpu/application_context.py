@@ -13,7 +13,14 @@ import logging.config
 from functools import cached_property
 
 from bee_code_interpreter_tpu.config import Config
-from bee_code_interpreter_tpu.observability import FleetJournal, Tracer, TraceStore
+from bee_code_interpreter_tpu.observability import (
+    FleetJournal,
+    SloEngine,
+    TelemetryExporter,
+    Tracer,
+    TraceStore,
+    parse_objectives,
+)
 from bee_code_interpreter_tpu.services.custom_tool_executor import CustomToolExecutor
 from bee_code_interpreter_tpu.services.storage import Storage
 from bee_code_interpreter_tpu.utils.metrics import Registry
@@ -43,6 +50,37 @@ class ApplicationContext:
         # Pool supervisor (resilience/supervisor.py): created with the pool
         # executor it reconciles, None for the pool-less local backend.
         self.supervisor = None
+        # SLO engine: objectives come from config (APP_SLO_AVAILABILITY /
+        # APP_SLO_LATENCY_MS); with none declared it is inert and /v1/slo
+        # answers honestly empty. Both edges record into the ONE engine.
+        self.slo = SloEngine(
+            parse_objectives(
+                self.config.slo_availability, self.config.slo_latency_ms
+            ),
+            metrics=self.metrics,
+            bucket_s=self.config.slo_window_bucket_s,
+        )
+        # Telemetry export: with APP_OTLP_ENDPOINT set, finished traces and
+        # metric snapshots are pushed OTLP/JSON to the collector by a
+        # background exporter (started by __main__ once the loop runs).
+        self.exporter = None
+        if self.config.otlp_endpoint:
+            from bee_code_interpreter_tpu.resilience import RetryPolicy
+
+            self.exporter = TelemetryExporter(
+                self.config.otlp_endpoint,
+                self.metrics,
+                flush_interval_s=self.config.otlp_flush_interval_s,
+                queue_max=self.config.otlp_queue_max,
+                batch_max=self.config.otlp_batch_max,
+                retry=RetryPolicy(
+                    attempts=self.config.otlp_retry_attempts,
+                    wait_min_s=self.config.otlp_retry_wait_min_s,
+                    wait_max_s=self.config.otlp_retry_wait_max_s,
+                ),
+                timeout_s=self.config.otlp_timeout_s,
+            )
+            self.tracer.add_sink(self.exporter.enqueue_trace)
 
     @cached_property
     def storage(self) -> Storage:
@@ -71,6 +109,30 @@ class ApplicationContext:
         self._storage_sweeper_task = asyncio.create_task(sweeper())
         return self._storage_sweeper_task
 
+    def start_telemetry_exporter(self):
+        """Start the background OTLP export loop when one is configured
+        (must be called from a running loop; __main__ does)."""
+        if self.exporter is not None:
+            self.exporter.start()
+        return self.exporter
+
+    def build_debug_bundle(self) -> dict:
+        """The one-call incident snapshot both edges serve — built here so
+        HTTP and gRPC can never disagree about what a bundle contains."""
+        from bee_code_interpreter_tpu.observability import build_debug_bundle
+
+        return build_debug_bundle(
+            tracer=self.tracer,
+            fleet=self.fleet,
+            slo=self.slo,
+            metrics=self.metrics,
+            config=self.config,
+            executor=self.__dict__.get("code_executor"),
+            supervisor=self.supervisor,
+            drain=self.drain,
+            exporter=self.exporter,
+        )
+
     @cached_property
     def drain(self):
         """Graceful-drain state shared by both transports and ``__main__``:
@@ -96,6 +158,9 @@ class ApplicationContext:
         sweeper = getattr(self, "_storage_sweeper_task", None)
         if sweeper is not None:
             sweeper.cancel()
+        if self.exporter is not None:
+            # Final best-effort flush (retry-bounded) before teardown.
+            await self.exporter.stop()
         if self.supervisor is not None:
             await self.supervisor.stop()
         executor = self.__dict__.get("code_executor")
@@ -268,6 +333,8 @@ class ApplicationContext:
             fleet=self.fleet,
             drain=self.drain,
             supervisor=self.supervisor,
+            slo=self.slo,
+            debug_bundle=self.build_debug_bundle,
         )
 
     @cached_property
@@ -286,4 +353,6 @@ class ApplicationContext:
             tracer=self.tracer,
             fleet=self.fleet,
             drain=self.drain,
+            slo=self.slo,
+            debug_bundle=self.build_debug_bundle,
         )

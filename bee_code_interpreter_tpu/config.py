@@ -153,6 +153,36 @@ class Config(BaseModel):
     # Sandbox lifecycle events retained in the fleet journal for
     # GET /v1/fleet/events (each pod contributes ~4-6 events per life).
     fleet_max_events: int = Field(default=512, ge=1)
+    # --- telemetry export (docs/observability.md "Telemetry export") ---
+    # OTLP/HTTP collector base URL (e.g. http://otel-collector:4318): finished
+    # traces and metric snapshots are pushed as OTLP/JSON to
+    # {endpoint}/v1/traces and /v1/metrics by a background exporter. Unset
+    # disables export entirely (the in-memory stores keep working).
+    otlp_endpoint: str | None = None
+    # Export flush cadence; a full batch flushes early.
+    otlp_flush_interval_s: float = Field(default=5.0, gt=0)
+    # Finished traces buffered for export; beyond this, new traces are
+    # DROPPED (accounted in bci_telemetry_dropped_total) — never blocks the
+    # request path.
+    otlp_queue_max: int = Field(default=512, ge=1)
+    # Traces per export POST.
+    otlp_batch_max: int = Field(default=64, ge=1)
+    # Send retry schedule (reuses the resilience backoff); an exhausted batch
+    # is dropped, not retried forever.
+    otlp_retry_attempts: int = Field(default=3, ge=1)
+    otlp_retry_wait_min_s: float = Field(default=0.5, gt=0)
+    otlp_retry_wait_max_s: float = Field(default=5.0, gt=0)
+    # Collector HTTP client timeout per POST.
+    otlp_timeout_s: float = Field(default=10.0, gt=0)
+    # --- SLOs (docs/observability.md "SLOs and burn-rate alerts") ---
+    # Availability objective as a percent of recorded sandbox-bound requests
+    # that must not fail server-side, e.g. 99.5. Unset declares none.
+    slo_availability: float | None = Field(default=None, gt=0, lt=100)
+    # Latency objectives as comma-separable THRESHOLD_MS:PERCENT entries,
+    # e.g. "2000:99" (99% of successful requests within 2s). Unset: none.
+    slo_latency_ms: str | None = None
+    # SLO sliding-window bucket coarseness; windows span 5m..6h.
+    slo_window_bucket_s: float = Field(default=10.0, gt=0)
 
     # --- object storage (reference config.py:74) ---
     file_storage_path: str = "./.tmp/files"
@@ -213,6 +243,23 @@ class Config(BaseModel):
         if self.execution_hard_cap_s is not None:
             return self.execution_hard_cap_s
         return self.execution_timeout_s + self.executor_http_timeout_s
+
+    def redacted_dump(self) -> dict[str, Any]:
+        """``model_dump()`` safe to serve from ``GET /v1/debug/bundle``:
+        secret-shaped fields (TLS material, anything named like a
+        credential) come back as ``"<redacted>"``, and bytes never leak
+        even if a new secret field forgets the naming convention."""
+        markers = ("cert", "key", "token", "secret", "password")
+        out: dict[str, Any] = {}
+        for name, value in self.model_dump().items():
+            if value and (
+                isinstance(value, bytes)
+                or any(marker in name for marker in markers)
+            ):
+                out[name] = "<redacted>"
+            else:
+                out[name] = value
+        return out
 
     def resolved_shim_dir(self) -> str | None:
         if self.shim_dir is not None:

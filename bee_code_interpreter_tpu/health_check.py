@@ -10,7 +10,9 @@ gRPC statuses (``UNAVAILABLE`` — connection refused/reset — and
 ``DEADLINE_EXCEEDED``), and a clear nonzero-exit message when the service
 stays unreachable. ``--verbose`` additionally fetches the deep-health view
 (``GET /healthz?verbose=1`` on the HTTP listener: pool occupancy, breaker
-states, fleet aggregates — docs/observability.md) and prints it.
+states, fleet aggregates, SLO state — docs/observability.md), prints it,
+and exits ``4`` when a fast-window SLO burn-rate alert is firing — alive,
+but spending error budget at page rate.
 
     python -m bee_code_interpreter_tpu.health_check [addr] \\
         [--timeout S] [--attempts N] [--backoff S] \\
@@ -34,10 +36,13 @@ from bee_code_interpreter_tpu.resilience import RetryPolicy
 
 RETRYABLE_STATUS = (grpc.StatusCode.UNAVAILABLE, grpc.StatusCode.DEADLINE_EXCEEDED)
 
-# Exit codes: 1 wrong answer, 2 unreachable/unhealthy, 3 draining. The
-# distinct draining code lets k8s preStop / deploy tooling tell "finishing
-# up, don't restart me" from "dead, restart me".
+# Exit codes: 1 wrong answer, 2 unreachable/unhealthy, 3 draining, 4 SLO
+# fast-burn warning (--verbose only). The distinct draining code lets k8s
+# preStop / deploy tooling tell "finishing up, don't restart me" from
+# "dead, restart me"; the SLO code never fires on the bare probe k8s runs,
+# so readiness stays green while operators see budget exhaustion early.
 DRAINING_EXIT = 3
+SLO_BURN_EXIT = 4
 
 
 def is_draining(verbose_body: dict) -> bool:
@@ -219,12 +224,25 @@ def main() -> None:
         # Supplementary: the liveness verdict above already printed; a
         # missing HTTP listener degrades to a note, not a failed probe.
         try:
-            print(json.dumps(asyncio.run(verbose_health(args.http_addr)), indent=2))
+            body = asyncio.run(verbose_health(args.http_addr))
         except Exception as e:
             print(
                 f"(verbose view unavailable from {args.http_addr}: {e})",
                 file=sys.stderr,
             )
+        else:
+            print(json.dumps(body, indent=2))
+            # The service is alive AND burning error budget at page rate
+            # (both fast windows over threshold — docs/observability.md
+            # "SLOs"): a warning exit k8s never sees (no --verbose on the
+            # probe) but deploy tooling and operators do.
+            if (body.get("slo") or {}).get("fast_burn_alerting"):
+                print(
+                    "WARNING: fast-window SLO burn-rate alert firing; "
+                    "error budget is being spent at page rate",
+                    file=sys.stderr,
+                )
+                sys.exit(SLO_BURN_EXIT)
 
 
 if __name__ == "__main__":

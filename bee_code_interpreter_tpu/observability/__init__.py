@@ -1,5 +1,6 @@
 """Observability: tracing, fleet lifecycle journal, resource accounting,
-profiling, structured logging.
+profiling, structured logging, telemetry export, SLO burn-rate evaluation,
+and the one-call debug bundle.
 
 Dependency-free (no OTel SDK in the image), layered like ``resilience/``:
 the primitives live here, the wiring lives at the edges (api/, services/,
@@ -45,18 +46,47 @@ from bee_code_interpreter_tpu.observability.tracing import (
     span,
 )
 
+# These three import the resilience package (retry policies, breaker/drain
+# types), and resilience/admission.py imports `span` from THIS package — so
+# they must come after the tracing import above has bound it, or a
+# resilience-first import order deadlocks on the partially-initialized module.
+from bee_code_interpreter_tpu.observability.bundle import (  # noqa: E402
+    build_debug_bundle,
+    executor_health,
+)
+from bee_code_interpreter_tpu.observability.export import (  # noqa: E402
+    TelemetryExporter,
+    metrics_payload,
+    spans_payload,
+)
+from bee_code_interpreter_tpu.observability.slo import (  # noqa: E402
+    Objective,
+    SloEngine,
+    empty_slo_snapshot,
+    parse_objectives,
+)
+
 __all__ = [
     "FleetJournal",
     "JsonLogFormatter",
+    "Objective",
     "PROFILE_DIR_ENV",
     "ProfilerUnavailable",
     "REQUEST_ID_HEADER",
     "SANDBOX_PROFILE_DIR",
     "ServingProfiler",
+    "SloEngine",
+    "TelemetryExporter",
     "TransferAccounting",
     "UsageMeter",
+    "build_debug_bundle",
     "collect_transfer",
+    "empty_slo_snapshot",
+    "executor_health",
     "find_journal",
+    "metrics_payload",
+    "parse_objectives",
+    "spans_payload",
     "inject_profile_env",
     "merge_worker_usage",
     "profile_artifacts",

@@ -1,0 +1,102 @@
+"""One-call incident snapshot: everything an operator would otherwise curl.
+
+``GET /v1/debug/bundle`` (and the gRPC ``ObservabilityService/GetDebugBundle``
+spelling) returns a single JSON document — recent + slowest traces, the fleet
+lifecycle journal, SLO state, breaker/pool/supervisor/drain health, telemetry
+exporter state, the redacted config, and a full metrics dump — so an incident
+gets ONE attached artifact instead of five separately-timed curls that never
+quite line up.
+
+Both edges build it through the composition root's
+``ApplicationContext.build_debug_bundle`` so they can never disagree about
+which components are included; a standalone ``create_http_server`` (tests)
+falls back to building from whatever it was handed.
+"""
+
+from __future__ import annotations
+
+import time
+
+from bee_code_interpreter_tpu.observability.fleet import unwrap_executor
+
+
+def executor_health(executor) -> dict:
+    """Deep-health view of the executor backend: pool occupancy and breaker
+    states (the ``GET /healthz?verbose=1`` shape). Empty for backends with
+    no pool (the in-process local executor)."""
+    inner = unwrap_executor(executor)
+    info: dict = {}
+    ready = getattr(inner, "pool_ready_count", None)
+    if ready is not None:
+        info["pool"] = {
+            "ready": ready,
+            "spawning": getattr(inner, "pool_spawning_count", 0),
+        }
+    breakers = {}
+    for attr in ("spawn_breaker", "http_breaker"):
+        breaker = getattr(inner, attr, None)
+        if breaker is not None:
+            breakers[breaker.name] = breaker.state.name.lower()
+    if breakers:
+        info["breakers"] = breakers
+    return info
+
+
+def build_debug_bundle(
+    *,
+    tracer=None,
+    fleet=None,
+    slo=None,
+    metrics=None,
+    config=None,
+    executor=None,
+    supervisor=None,
+    drain=None,
+    exporter=None,
+    recent_traces: int = 50,
+    slowest_traces: int = 10,
+    fleet_events: int = 100,
+) -> dict:
+    """Assemble the bundle from whatever components exist; every section is
+    present (null/empty when its component isn't wired) so consumers parse
+    one stable schema."""
+    bundle: dict = {"generated_unix": time.time()}
+
+    traces = tracer.store.traces() if tracer is not None else []
+    slowest = sorted(traces, key=lambda t: t.duration_s, reverse=True)
+    bundle["traces"] = {
+        "retained": len(traces),
+        # summaries for breadth (newest first), full spans for the outliers
+        # an incident is usually about
+        "recent": [t.summary() for t in traces[:recent_traces]],
+        "slowest": [t.to_dict() for t in slowest[:slowest_traces]],
+    }
+
+    bundle["fleet"] = (
+        {
+            "snapshot": fleet.snapshot(),
+            "events": fleet.events(limit=fleet_events),
+        }
+        if fleet is not None
+        else None
+    )
+
+    from bee_code_interpreter_tpu.observability.slo import empty_slo_snapshot
+
+    bundle["slo"] = slo.snapshot() if slo is not None else empty_slo_snapshot()
+
+    service: dict = {
+        "draining": bool(drain is not None and drain.draining),
+    }
+    if drain is not None:
+        service["drain_inflight"] = drain.in_flight
+    if executor is not None:
+        service.update(executor_health(executor))
+    if supervisor is not None:
+        service["supervisor"] = supervisor.snapshot()
+    bundle["service"] = service
+
+    bundle["telemetry"] = exporter.snapshot() if exporter is not None else None
+    bundle["config"] = config.redacted_dump() if config is not None else None
+    bundle["metrics"] = metrics.expose() if metrics is not None else None
+    return bundle

@@ -1,0 +1,418 @@
+"""Telemetry export: OTLP/JSON-over-HTTP push of traces and metric snapshots.
+
+PRs 2–3 made the observability stack rich but replica-local: traces live in a
+bounded in-memory ring and vanish on restart, metrics are pull-only. This
+module is the fleet-scale half — a background :class:`TelemetryExporter` that
+batches finished traces (fed by a ``Tracer`` sink) and periodic snapshots of
+the whole metrics ``Registry`` into OTLP/JSON payloads and pushes them to the
+collector named by ``APP_OTLP_ENDPOINT`` (``POST {endpoint}/v1/traces`` and
+``/v1/metrics``, the standard OTLP/HTTP paths).
+
+The wire format is hand-rolled (no OTel SDK in the image) but spec-conformant
+in the shapes a collector actually parses: ``resourceSpans`` → ``scopeSpans``
+→ spans with base16 trace/span ids, uint64 nano timestamps as decimal
+strings, and ``resourceMetrics`` with cumulative sums/gauges/histograms.
+
+Operational contract (docs/observability.md "Telemetry export"):
+
+- **Drop, never block.** The request path only ever appends to a bounded
+  deque; a full queue or a dead collector costs the request nothing. Every
+  trace that does not reach the collector is accounted in
+  ``bci_telemetry_dropped_total{signal,reason}`` — exported + dropped +
+  queued always equals enqueued.
+- **Retry with backoff, then drop the batch.** Sends reuse the resilience
+  retry schedule (:class:`~bee_code_interpreter_tpu.resilience.retry.RetryPolicy`);
+  after the attempts are exhausted the batch is dropped (``send_failed``)
+  and the remaining queue waits for the next flush, so one outage never
+  snowballs into a retry storm.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import json
+import logging
+import time
+from collections import deque
+
+from bee_code_interpreter_tpu.resilience.retry import RetryPolicy
+from bee_code_interpreter_tpu.utils.metrics import (
+    Counter,
+    Gauge,
+    Histogram,
+    Registry,
+)
+
+logger = logging.getLogger(__name__)
+
+TRACES_PATH = "/v1/traces"
+METRICS_PATH = "/v1/metrics"
+SCOPE_NAME = "bee_code_interpreter_tpu.observability"
+
+_SPAN_KIND_INTERNAL = 1  # opentelemetry.proto.trace.v1.Span.SpanKind
+_STATUS_OK, _STATUS_ERROR = 1, 2  # Status.StatusCode
+_CUMULATIVE = 2  # AggregationTemporality
+
+
+def _attr(key: str, value) -> dict:
+    return {"key": key, "value": {"stringValue": str(value)}}
+
+
+def _nanos(unix_s: float) -> str:
+    # proto3 JSON maps uint64 to a decimal string; collectors reject numbers.
+    return str(int(unix_s * 1e9))
+
+
+def span_to_otlp(span) -> dict:
+    """One :class:`~..tracing.Span` as an OTLP/JSON span object. Ids are
+    base16 (the OTLP/JSON special case — NOT base64 like other bytes)."""
+    end_unix = span.start_unix + (span.duration_s or 0.0)
+    out = {
+        "traceId": span.trace_id,
+        "spanId": span.span_id,
+        "name": span.name,
+        "kind": _SPAN_KIND_INTERNAL,
+        "startTimeUnixNano": _nanos(span.start_unix),
+        "endTimeUnixNano": _nanos(end_unix),
+        "attributes": [_attr(k, v) for k, v in span.attributes.items()],
+        "status": {
+            "code": _STATUS_ERROR if span.status == "error" else _STATUS_OK
+        },
+    }
+    if span.parent_id is not None:
+        out["parentSpanId"] = span.parent_id
+    return out
+
+
+def spans_payload(traces, service_name: str) -> dict:
+    """A batch of finished traces as one OTLP/JSON ExportTraceServiceRequest."""
+    spans = []
+    for trace in traces:
+        for s in trace.spans:
+            spans.append(span_to_otlp(s))
+    return {
+        "resourceSpans": [
+            {
+                "resource": {
+                    "attributes": [_attr("service.name", service_name)]
+                },
+                "scopeSpans": [
+                    {"scope": {"name": SCOPE_NAME}, "spans": spans}
+                ],
+            }
+        ]
+    }
+
+
+def _counter_otlp(metric: Counter, now: str, start: str) -> dict:
+    return {
+        "sum": {
+            "dataPoints": [
+                {
+                    "attributes": [_attr(k, v) for k, v in key],
+                    # startTimeUnixNano lets cumulative consumers detect
+                    # counter resets across process restarts (OTLP spec)
+                    "startTimeUnixNano": start,
+                    "timeUnixNano": now,
+                    "asDouble": value,
+                }
+                for key, value in sorted(metric._values.items())
+            ],
+            "aggregationTemporality": _CUMULATIVE,
+            "isMonotonic": True,
+        }
+    }
+
+
+def _gauge_otlp(metric: Gauge, now: str) -> dict:
+    points = []
+    for key, fn in sorted(metric._fns.items()):
+        try:
+            value = float(fn())
+        except Exception:
+            continue  # one broken callback must not sink the whole snapshot
+        points.append(
+            {
+                "attributes": [_attr(k, v) for k, v in key],
+                "timeUnixNano": now,
+                "asDouble": value,
+            }
+        )
+    return {"gauge": {"dataPoints": points}}
+
+
+def _histogram_otlp(metric: Histogram, now: str, start: str) -> dict:
+    points = []
+    for key in sorted(metric._totals):
+        cumulative = metric._counts.get(key, [0] * len(metric._buckets))
+        total = metric._totals[key]
+        # Our buckets are Prometheus-cumulative; OTLP wants per-bucket counts
+        # with one overflow bucket beyond the last explicit bound.
+        per_bucket = [
+            c - (cumulative[i - 1] if i else 0)
+            for i, c in enumerate(cumulative)
+        ]
+        per_bucket.append(total - (cumulative[-1] if cumulative else 0))
+        points.append(
+            {
+                "attributes": [_attr(k, v) for k, v in key],
+                "startTimeUnixNano": start,
+                "timeUnixNano": now,
+                "count": str(total),
+                "sum": metric._sums[key],
+                "bucketCounts": [str(c) for c in per_bucket],
+                "explicitBounds": list(metric._buckets),
+            }
+        )
+    return {
+        "histogram": {
+            "dataPoints": points,
+            "aggregationTemporality": _CUMULATIVE,
+        }
+    }
+
+
+def metrics_payload(
+    registry: Registry, service_name: str, start_unix: float | None = None
+) -> dict:
+    """The registry's current state as one OTLP/JSON
+    ExportMetricsServiceRequest. Cumulative temporality, so every sum and
+    histogram point is stamped with ``start_unix`` (when the accumulation
+    began — the exporter passes its construction time) so consumers can
+    detect counter resets across restarts."""
+    now = _nanos(time.time())
+    start = _nanos(start_unix) if start_unix is not None else now
+    metrics = []
+    for name, metric in registry.metrics.items():
+        entry: dict = {"name": name, "description": metric.help}
+        if isinstance(metric, Counter):
+            entry.update(_counter_otlp(metric, now, start))
+        elif isinstance(metric, Gauge):
+            entry.update(_gauge_otlp(metric, now))
+        elif isinstance(metric, Histogram):
+            entry.update(_histogram_otlp(metric, now, start))
+        else:  # pragma: no cover - no fourth metric type exists
+            continue
+        metrics.append(entry)
+    return {
+        "resourceMetrics": [
+            {
+                "resource": {
+                    "attributes": [_attr("service.name", service_name)]
+                },
+                "scopeMetrics": [
+                    {"scope": {"name": SCOPE_NAME}, "metrics": metrics}
+                ],
+            }
+        ]
+    }
+
+
+class TelemetryExporter:
+    """Background push of traces + metric snapshots to an OTLP collector.
+
+    Wire it as a ``Tracer`` sink (:meth:`enqueue_trace`) and :meth:`start`
+    it once a loop is running; :meth:`stop` flushes what it can and closes
+    the HTTP client. ``transport`` (an ``async (path, body_bytes) -> None``)
+    replaces the httpx POST for tests and the chaos harness.
+    """
+
+    def __init__(
+        self,
+        endpoint: str,
+        metrics: Registry,
+        *,
+        service_name: str = "bee-code-interpreter-tpu",
+        flush_interval_s: float = 5.0,
+        queue_max: int = 512,
+        batch_max: int = 64,
+        retry: RetryPolicy | None = None,
+        timeout_s: float = 10.0,
+        transport=None,
+    ) -> None:
+        self._endpoint = endpoint.rstrip("/")
+        self._registry = metrics
+        self._service_name = service_name
+        self._flush_interval_s = flush_interval_s
+        self._queue_max = queue_max
+        self._batch_max = batch_max
+        self._retry = retry or RetryPolicy(
+            attempts=3, wait_min_s=0.5, wait_max_s=5.0
+        )
+        self._timeout_s = timeout_s
+        self._transport = transport
+        self._queue: deque = deque()
+        self._start_unix = time.time()  # cumulative-point start stamp
+        self._wake = asyncio.Event()
+        self._task: asyncio.Task | None = None
+        self._client = None
+        self._stopping = False
+        self._exported_total = metrics.counter(
+            "bci_telemetry_exported_total",
+            "Telemetry successfully pushed to the OTLP collector, by signal",
+        )
+        self._dropped_total = metrics.counter(
+            "bci_telemetry_dropped_total",
+            "Telemetry dropped instead of blocking the request path, "
+            "by signal and reason",
+        )
+        metrics.gauge(
+            "bci_telemetry_queue_depth",
+            "Finished traces waiting for the next export flush",
+            lambda: len(self._queue),
+        )
+
+    # ---------------------------------------------------------- request path
+
+    def enqueue_trace(self, trace) -> None:
+        """Tracer sink: O(1), no I/O, never blocks. A full queue drops the
+        NEW trace (the queued ones are already promised to the collector)
+        and accounts it — backpressure must never reach the request."""
+        if len(self._queue) >= self._queue_max:
+            self._dropped_total.inc(signal="traces", reason="queue_full")
+            return
+        self._queue.append(trace)
+        if len(self._queue) >= self._batch_max:
+            self._wake.set()
+
+    @property
+    def queue_depth(self) -> int:
+        return len(self._queue)
+
+    # ------------------------------------------------------- background loop
+
+    def start(self) -> None:
+        """Start the flush loop (requires a running event loop)."""
+        if self._task is None or self._task.done():
+            self._stopping = False
+            self._task = asyncio.create_task(self._run())
+
+    async def stop(self, timeout_s: float | None = 5.0) -> None:
+        """Final best-effort flush bounded by ``timeout_s`` of wall clock,
+        then close the client. The bound matters at SIGTERM: a blackholed
+        collector (connects that hang until the client timeout) must not
+        stall teardown past the k8s termination grace and leak the warm
+        pool — whatever could not be shipped in time is dropped and
+        accounted (``reason="shutdown"``)."""
+        self._stopping = True
+        self._wake.set()
+        pending = self._task
+        self._task = None
+        if pending is None:
+            pending = asyncio.ensure_future(self.flush_once())
+        try:
+            if timeout_s is None:
+                await pending
+            else:
+                # wait_for cancels the flush on timeout; flush_once pops
+                # batches only after a send resolves, so a cancelled send
+                # leaves its traces queued for the accounting below.
+                await asyncio.wait_for(pending, timeout_s)
+        except asyncio.TimeoutError:
+            pass  # wait_for already cancelled (and awaited) the flush
+        if self._queue:
+            self._dropped_total.inc(
+                len(self._queue), signal="traces", reason="shutdown"
+            )
+            self._queue.clear()
+        if self._client is not None:
+            await self._client.aclose()
+            self._client = None
+
+    async def _run(self) -> None:
+        while not self._stopping:
+            try:
+                await asyncio.wait_for(
+                    self._wake.wait(), timeout=self._flush_interval_s
+                )
+            except asyncio.TimeoutError:
+                pass
+            self._wake.clear()
+            try:
+                await self.flush_once()
+            except Exception:  # defensive: the loop must survive anything
+                logger.exception("telemetry flush failed")
+        await self.flush_once()
+
+    async def flush_once(self) -> dict:
+        """Drain the trace queue in batches, then push one metrics snapshot.
+        A failed batch is dropped (accounted) and ends the trace drain for
+        this flush — the rest of the queue waits for the next interval."""
+        exported = dropped = 0
+        while self._queue:
+            # Peek, send, THEN pop: a cancellation mid-send (the bounded
+            # stop()) leaves the batch queued where shutdown accounting
+            # still sees it — no trace is ever silently lost.
+            batch = list(itertools.islice(self._queue, self._batch_max))
+            payload = spans_payload(batch, self._service_name)
+            sent = await self._push(TRACES_PATH, payload)
+            for _ in batch:
+                self._queue.popleft()
+            if sent:
+                self._exported_total.inc(len(batch), signal="traces")
+                exported += len(batch)
+            else:
+                self._dropped_total.inc(
+                    len(batch), signal="traces", reason="send_failed"
+                )
+                dropped += len(batch)
+                break
+        metrics_ok = await self._push(
+            METRICS_PATH,
+            metrics_payload(
+                self._registry, self._service_name, start_unix=self._start_unix
+            ),
+        )
+        if metrics_ok:
+            self._exported_total.inc(signal="metrics")
+        else:
+            self._dropped_total.inc(signal="metrics", reason="send_failed")
+        return {
+            "traces_exported": exported,
+            "traces_dropped": dropped,
+            "metrics_exported": metrics_ok,
+        }
+
+    async def _push(self, path: str, payload: dict) -> bool:
+        body = json.dumps(payload).encode("utf-8")
+        attempt = 0
+        while True:
+            attempt += 1
+            try:
+                await self._send(path, body)
+                return True
+            except Exception as e:
+                if attempt >= self._retry.attempts:
+                    logger.warning(
+                        "telemetry push to %s%s failed after %d attempt(s): %s",
+                        self._endpoint, path, attempt, e,
+                    )
+                    return False
+                await asyncio.sleep(self._retry.backoff_s(attempt))
+
+    async def _send(self, path: str, body: bytes) -> None:
+        if self._transport is not None:
+            await self._transport(path, body)
+            return
+        import httpx
+
+        if self._client is None:
+            self._client = httpx.AsyncClient(timeout=self._timeout_s)
+        response = await self._client.post(
+            self._endpoint + path,
+            content=body,
+            headers={"Content-Type": "application/json"},
+        )
+        response.raise_for_status()
+
+    # -------------------------------------------------------------- operator
+
+    def snapshot(self) -> dict:
+        """Exporter state for the debug bundle / verbose health."""
+        return {
+            "endpoint": self._endpoint,
+            "queue_depth": len(self._queue),
+            "queue_max": self._queue_max,
+            "running": self._task is not None and not self._task.done(),
+        }

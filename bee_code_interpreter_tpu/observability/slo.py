@@ -1,0 +1,302 @@
+"""SLO engine: config-declared objectives, sliding windows, burn-rate alerts.
+
+Raw counters can say "37 requests failed"; they cannot say "at this rate the
+month's error budget is gone by Thursday". This module computes the latter
+where the data is, per the Google SRE workbook's multi-window multi-burn-rate
+methodology: each objective (availability, or latency-under-threshold) is
+evaluated over sliding windows, and an alert fires only when BOTH windows of
+a pair burn faster than the pair's threshold —
+
+- **page**: 5m AND 1h burning > 14.4× budget (2% of a 30-day budget per hour)
+- **ticket**: 30m AND 6h burning > 6× budget
+
+The long window keeps one bad minute from paging; the short window stops the
+alert promptly once the bleeding stops.
+
+Objectives come from config: ``APP_SLO_AVAILABILITY=99.5`` (percent of
+recorded requests that must not fail server-side) and
+``APP_SLO_LATENCY_MS=2000:99`` (comma-separable ``THRESHOLD_MS:PERCENT``
+entries: 99% of successful requests complete within 2000 ms).
+
+What counts: the edges record every *sandbox-bound* request the service
+accepted. Server-side failures (5xx-equivalents: internal errors, blown
+deadlines, open breakers) are availability-bad; client faults (422/400,
+``INVALID_ARGUMENT``) are good; deliberate load management (429 shed, drain
+503, client cancellation) is EXCLUDED — budget measures the service failing
+work it accepted, not refusing work it never promised. Latency objectives
+measure successful requests only.
+
+Served at ``GET /v1/slo``, summarized in ``GET /healthz?verbose=1``, and
+exported as ``bci_slo_error_budget_remaining_ratio{objective}`` /
+``bci_slo_burn_rate{objective,window}`` gauges.
+
+State is a ring of coarse time buckets (default 10 s) covering the longest
+window (6 h): O(1) per recorded request, ~2 k buckets max, clock-injectable
+so tests hand-compute every number under a manual clock.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+
+# Window name -> seconds. The four windows the alert pairs need; snapshot()
+# reports all of them per objective.
+WINDOWS: dict[str, float] = {"5m": 300.0, "30m": 1800.0, "1h": 3600.0, "6h": 21600.0}
+
+# Multi-window multi-burn-rate pairs (SRE workbook ch. 5, 30-day budget).
+ALERT_POLICIES = (
+    {"severity": "page", "short": "5m", "long": "1h", "burn_threshold": 14.4},
+    {"severity": "ticket", "short": "30m", "long": "6h", "burn_threshold": 6.0},
+)
+
+
+@dataclass(frozen=True)
+class Objective:
+    """One declared objective. ``target`` is the good fraction (0.995);
+    ``threshold_ms`` is set for latency objectives only."""
+
+    name: str
+    kind: str  # "availability" | "latency"
+    target: float
+    threshold_ms: float | None = None
+
+    @property
+    def error_budget(self) -> float:
+        return 1.0 - self.target
+
+
+def parse_objectives(
+    availability_percent: float | None, latency_spec: str | None
+) -> list[Objective]:
+    """Objectives from the raw config fields; raises ``ValueError`` with the
+    offending entry on malformed input (config errors must fail loudly at
+    startup, not silently disable alerting)."""
+    objectives: list[Objective] = []
+    if availability_percent is not None:
+        p = float(availability_percent)
+        if not 0.0 < p < 100.0:
+            raise ValueError(
+                f"APP_SLO_AVAILABILITY must be a percent in (0, 100), got {p!r}"
+            )
+        objectives.append(
+            Objective(name="availability", kind="availability", target=p / 100.0)
+        )
+    for part in (latency_spec or "").split(","):
+        part = part.strip()
+        if not part:
+            continue
+        threshold_raw, sep, percent_raw = part.partition(":")
+        try:
+            if not sep:
+                raise ValueError(part)
+            threshold_ms = float(threshold_raw)
+            percent = float(percent_raw)
+        except ValueError:
+            raise ValueError(
+                f"malformed APP_SLO_LATENCY_MS entry {part!r}; "
+                "expected 'THRESHOLD_MS:PERCENT' like '2000:99'"
+            ) from None
+        if threshold_ms <= 0 or not 0.0 < percent < 100.0:
+            raise ValueError(
+                f"APP_SLO_LATENCY_MS entry {part!r}: threshold must be > 0 ms "
+                "and percent in (0, 100)"
+            )
+        objectives.append(
+            Objective(
+                name=f"latency_{threshold_ms:g}ms",
+                kind="latency",
+                target=percent / 100.0,
+                threshold_ms=threshold_ms,
+            )
+        )
+    return objectives
+
+
+def empty_slo_snapshot() -> dict:
+    """What ``GET /v1/slo`` answers when no objectives are declared."""
+    return {"objectives": [], "alerting": False, "fast_burn_alerting": False}
+
+
+class _Bucket:
+    __slots__ = ("total", "errors", "ok_total", "slow")
+
+    def __init__(self, n_latency: int) -> None:
+        self.total = 0  # recorded requests (excluded ones never get here)
+        self.errors = 0  # availability-bad
+        self.ok_total = 0  # latency denominators count successes only
+        self.slow = [0] * n_latency  # per latency objective
+
+
+class SloEngine:
+    """Sliding-window objective evaluation. Edges call :meth:`record` per
+    recorded request; readers call :meth:`snapshot` / :meth:`burn_rate`."""
+
+    def __init__(
+        self,
+        objectives,
+        metrics=None,
+        clock=time.monotonic,
+        bucket_s: float = 10.0,
+    ) -> None:
+        self._objectives = list(objectives)
+        self._latency = [o for o in self._objectives if o.kind == "latency"]
+        self._latency_index = {o.name: i for i, o in enumerate(self._latency)}
+        self._clock = clock
+        self._bucket_s = bucket_s
+        self._retention_s = max(WINDOWS.values())
+        self._buckets: dict[int, _Bucket] = {}
+        if metrics is not None and self._objectives:
+            for objective in self._objectives:
+                metrics.gauge(
+                    "bci_slo_error_budget_remaining_ratio",
+                    "Error budget left over the 6h window "
+                    "(1=untouched, 0=spent, negative=overspent)",
+                    (lambda o: lambda: self.error_budget_remaining(o))(objective),
+                    objective=objective.name,
+                )
+                for window in WINDOWS:
+                    metrics.gauge(
+                        "bci_slo_burn_rate",
+                        "Error-budget burn rate by objective and window "
+                        "(1=exactly on budget)",
+                        (lambda o, w: lambda: self.burn_rate(o, w))(
+                            objective, window
+                        ),
+                        objective=objective.name,
+                        window=window,
+                    )
+
+    @property
+    def objectives(self) -> tuple[Objective, ...]:
+        return tuple(self._objectives)
+
+    # ------------------------------------------------------------- recording
+
+    def record(self, ok: bool, duration_s: float) -> None:
+        """One request outcome. ``ok=False`` burns availability budget;
+        slow-but-successful requests burn latency budget. Callers simply do
+        not call this for excluded outcomes (shed/drain/cancel)."""
+        if not self._objectives:
+            return
+        idx = int(self._clock() // self._bucket_s)
+        bucket = self._buckets.get(idx)
+        if bucket is None:
+            self._prune(idx)
+            bucket = self._buckets[idx] = _Bucket(len(self._latency))
+        bucket.total += 1
+        if ok:
+            bucket.ok_total += 1
+            for i, objective in enumerate(self._latency):
+                if duration_s * 1000.0 > objective.threshold_ms:
+                    bucket.slow[i] += 1
+        else:
+            bucket.errors += 1
+
+    def _prune(self, now_idx: int) -> None:
+        horizon = now_idx - int(self._retention_s // self._bucket_s) - 1
+        for idx in [i for i in self._buckets if i < horizon]:
+            del self._buckets[idx]
+
+    # --------------------------------------------------------------- reading
+
+    def _window_counts(self, objective: Objective, window_s: float):
+        """(total, bad) over the trailing window. A bucket belongs to the
+        window while any part of its [idx*b, (idx+1)*b) span is inside it."""
+        now = self._clock()
+        total = bad = 0
+        latency_i = self._latency_index.get(objective.name)
+        for idx, bucket in self._buckets.items():
+            if (idx + 1) * self._bucket_s <= now - window_s:
+                continue
+            if objective.kind == "availability":
+                total += bucket.total
+                bad += bucket.errors
+            else:
+                total += bucket.ok_total
+                bad += bucket.slow[latency_i]
+        return total, bad
+
+    def bad_ratio(self, objective: Objective, window_s: float) -> float:
+        total, bad = self._window_counts(objective, window_s)
+        return bad / total if total else 0.0
+
+    def burn_rate(self, objective: Objective, window: str | float) -> float:
+        """bad_ratio / error_budget: 1.0 means burning exactly at the rate
+        that exhausts the budget over the SLO period; 0 with no traffic."""
+        window_s = WINDOWS[window] if isinstance(window, str) else window
+        budget = objective.error_budget
+        if budget <= 0.0:
+            return 0.0
+        return self.bad_ratio(objective, window_s) / budget
+
+    def error_budget_remaining(self, objective: Objective) -> float:
+        """1 - (6h bad ratio / budget): 1 with a clean window, 0 when the
+        budget is exactly spent, negative when overspent."""
+        budget = objective.error_budget
+        if budget <= 0.0:
+            return 1.0
+        return 1.0 - self.bad_ratio(objective, WINDOWS["6h"]) / budget
+
+    def snapshot(self) -> dict:
+        """The ``GET /v1/slo`` document: per objective the window stats,
+        budget remaining, and alert states; top-level rollups for health
+        checks (``fast_burn_alerting`` is the page pair). Walks the bucket
+        ring once per (objective, window) and derives everything else from
+        those counts — snapshot is served per /v1/slo hit, per verbose
+        healthz, and inside every debug bundle."""
+        objectives = []
+        fast_burn = alerting = False
+        for objective in self._objectives:
+            budget = objective.error_budget
+            windows = {}
+            for name, window_s in WINDOWS.items():
+                total, bad = self._window_counts(objective, window_s)
+                ratio = bad / total if total else 0.0
+                windows[name] = {
+                    "total": total,
+                    "bad": bad,
+                    "bad_ratio": ratio,
+                    "burn_rate": ratio / budget if budget > 0.0 else 0.0,
+                }
+            alerts = []
+            for policy in ALERT_POLICIES:
+                short_burn = windows[policy["short"]]["burn_rate"]
+                long_burn = windows[policy["long"]]["burn_rate"]
+                firing = (
+                    short_burn >= policy["burn_threshold"]
+                    and long_burn >= policy["burn_threshold"]
+                )
+                alerts.append(
+                    {
+                        "severity": policy["severity"],
+                        "windows": [policy["short"], policy["long"]],
+                        "burn_threshold": policy["burn_threshold"],
+                        "short_burn_rate": short_burn,
+                        "long_burn_rate": long_burn,
+                        "firing": firing,
+                    }
+                )
+                if firing:
+                    alerting = True
+                    if policy["severity"] == "page":
+                        fast_burn = True
+            objectives.append(
+                {
+                    "name": objective.name,
+                    "kind": objective.kind,
+                    "target": objective.target,
+                    "threshold_ms": objective.threshold_ms,
+                    "error_budget": budget,
+                    "error_budget_remaining_ratio": (
+                        1.0 - windows["6h"]["burn_rate"]
+                    ),
+                    "windows": windows,
+                    "alerts": alerts,
+                }
+            )
+        return {
+            "objectives": objectives,
+            "alerting": alerting,
+            "fast_burn_alerting": fast_burn,
+        }

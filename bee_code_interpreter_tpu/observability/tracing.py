@@ -30,6 +30,7 @@ Design constraints that shaped this module:
 from __future__ import annotations
 
 import heapq
+import logging
 import secrets
 import threading
 import time
@@ -258,6 +259,10 @@ class Tracer:
 
     def __init__(self, store: TraceStore | None = None, metrics=None) -> None:
         self.store = store or TraceStore()
+        # Finished-trace sinks (the telemetry exporter's enqueue, say): each
+        # gets the whole Trace right after it lands in the store. Sinks MUST
+        # be cheap and non-blocking — they run on the request path.
+        self._sinks: list = []
         self._stage_seconds = (
             metrics.histogram(
                 "bci_stage_seconds",
@@ -266,6 +271,10 @@ class Tracer:
             if metrics is not None
             else None
         )
+
+    def add_sink(self, sink) -> None:
+        """Register a callable invoked with each finished :class:`Trace`."""
+        self._sinks.append(sink)
 
     def _on_span_end(self, trace: Trace, s: Span) -> None:
         if self._stage_seconds is not None and s is not trace.root:
@@ -304,6 +313,14 @@ class Tracer:
             _current_span.reset(span_token)
             _current_trace.reset(trace_token)
             self.store.add(t)
+            for sink in self._sinks:
+                # A broken sink must never fail the request it observed.
+                try:
+                    sink(t)
+                except Exception:
+                    logging.getLogger(__name__).exception(
+                        "trace sink %r failed", sink
+                    )
 
 
 @contextmanager

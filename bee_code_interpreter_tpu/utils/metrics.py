@@ -4,6 +4,14 @@ The reference has no metrics at all (SURVEY.md §5 "No Prometheus/OTel"); this
 adds the standard text exposition format (counters, gauges, histograms) without
 requiring prometheus_client in the image. One process-global registry, scraped
 at ``GET /metrics`` on the HTTP server.
+
+Two exposition formats, negotiated on the ``Accept`` header at the endpoint:
+the classic Prometheus text format (``text/plain; version=0.0.4``, the
+default) and OpenMetrics 1.0 (``application/openmetrics-text``), which adds
+the ``# EOF`` terminator and **exemplars** — each histogram bucket remembers
+the ``trace_id``/``span_id`` of the most recent observation made under an
+active trace, so Grafana/Prometheus can jump from a ``bci_stage_seconds``
+spike straight to ``GET /v1/traces/{id}`` (docs/observability.md).
 """
 
 from __future__ import annotations
@@ -26,6 +34,57 @@ TOKEN_LATENCY_BUCKETS = (
 # The Prometheus text exposition format scrapers negotiate on; a bare
 # ``text/plain`` makes version-aware scrapers fall back to heuristics.
 PROMETHEUS_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+# OpenMetrics 1.0: what a scraper sends in ``Accept`` to opt in, and what the
+# endpoint answers with. Only this format carries exemplars.
+OPENMETRICS_MEDIA_TYPE = "application/openmetrics-text"
+OPENMETRICS_CONTENT_TYPE = (
+    "application/openmetrics-text; version=1.0.0; charset=utf-8"
+)
+
+def accepts_openmetrics(accept_header: str) -> bool:
+    """True when the ``Accept`` header asks for the OpenMetrics exposition.
+    A bare substring test would serve OpenMetrics to a client that sent
+    ``application/openmetrics-text;q=0`` (RFC 9110: q=0 means "not
+    acceptable"), so the media-range's q-value is honored."""
+    for entry in accept_header.split(","):
+        media_type, _, params = entry.strip().partition(";")
+        if media_type.strip().lower() != OPENMETRICS_MEDIA_TYPE:
+            continue
+        q = 1.0
+        for param in params.split(";"):
+            name, _, value = param.strip().partition("=")
+            if name.strip().lower() == "q":
+                try:
+                    q = float(value.strip())
+                except ValueError:
+                    q = 0.0  # malformed quality → treat as refused
+        if q > 0.0:
+            return True
+    return False
+
+
+# Resolved lazily on the first traced observation: utils must not import the
+# observability package at module load (observability wires *into* metrics,
+# not the other way around), but exemplars need the ambient trace ids.
+_exemplar_ids: Callable[[], tuple[str, str]] | None = None
+
+
+def _active_trace_ids() -> tuple[str, str] | None:
+    """(trace_id, span_id) of the ambient trace, or None when no trace is
+    active (or tracing is unavailable) — the exemplar hook, shaped to never
+    raise on the observation hot path."""
+    global _exemplar_ids
+    if _exemplar_ids is None:
+        try:
+            from bee_code_interpreter_tpu.observability.tracing import current_ids
+        except Exception:
+            return None
+        _exemplar_ids = current_ids
+    trace_id, span_id = _exemplar_ids()
+    if trace_id == "-":
+        return None
+    return trace_id, span_id
 
 
 def _escape(value: str) -> str:
@@ -56,9 +115,17 @@ class Counter:
     def inc(self, value: float = 1.0, **labels: str) -> None:
         self._values[tuple(sorted(labels.items()))] += value
 
-    def collect(self) -> Iterable[str]:
-        yield f"# HELP {self.name} {self.help}"
-        yield f"# TYPE {self.name} counter"
+    def collect(self, openmetrics: bool = False) -> Iterable[str]:
+        # OpenMetrics names the counter *family* without the _total suffix;
+        # the sample keeps it. The classic format uses the full name both
+        # places — scrapers of each format expect exactly their spelling.
+        family = (
+            self.name[: -len("_total")]
+            if openmetrics and self.name.endswith("_total")
+            else self.name
+        )
+        yield f"# HELP {family} {self.help}"
+        yield f"# TYPE {family} counter"
         for key, v in sorted(self._values.items()):
             yield f"{self.name}{_fmt_labels(dict(key))} {_fmt_num(v)}"
 
@@ -79,7 +146,7 @@ class Gauge:
     def set_fn(self, fn: Callable[[], float], **labels: str) -> None:
         self._fns[tuple(sorted(labels.items()))] = fn
 
-    def collect(self) -> Iterable[str]:
+    def collect(self, openmetrics: bool = False) -> Iterable[str]:
         yield f"# HELP {self.name} {self.help}"
         yield f"# TYPE {self.name} gauge"
         for key, fn in sorted(self._fns.items()):
@@ -99,31 +166,59 @@ class Histogram:
         self._counts: dict[tuple, list[int]] = {}
         self._sums: dict[tuple, float] = defaultdict(float)
         self._totals: dict[tuple, int] = defaultdict(int)
+        # label key -> le string -> (value, trace_id, span_id, unix_ts): the
+        # most recent traced observation per bucket, exposed as an
+        # OpenMetrics exemplar so a dashboard can jump spike -> trace.
+        self._exemplars: dict[tuple, dict[str, tuple[float, str, str, float]]] = {}
 
     def observe(self, value: float, **labels: str) -> None:
         key = tuple(sorted(labels.items()))
         counts = self._counts.setdefault(key, [0] * len(self._buckets))
+        exemplar_le = None
         for i, bound in enumerate(self._buckets):
             if value <= bound:
                 counts[i] += 1
+                if exemplar_le is None:
+                    exemplar_le = f"{bound:g}"
         self._sums[key] += value
         self._totals[key] += 1
+        ids = _active_trace_ids()
+        if ids is not None:
+            self._exemplars.setdefault(key, {})[exemplar_le or "+Inf"] = (
+                value, ids[0], ids[1], time.time(),
+            )
 
     def time(self, **labels: str) -> "_Timer":
         return _Timer(self, labels)
 
-    def collect(self) -> Iterable[str]:
+    def _exemplar_suffix(self, key: tuple, le: str) -> str:
+        ex = self._exemplars.get(key, {}).get(le)
+        if ex is None:
+            return ""
+        value, trace_id, span_id, ts = ex
+        return (
+            f' # {{trace_id="{trace_id}",span_id="{span_id}"}}'
+            f" {_fmt_num(value)} {ts:.3f}"
+        )
+
+    def collect(self, openmetrics: bool = False) -> Iterable[str]:
         yield f"# HELP {self.name} {self.help}"
         yield f"# TYPE {self.name} histogram"
         for key in sorted(self._totals):
             base = dict(key)
             counts = self._counts.get(key, [0] * len(self._buckets))
             for bound, c in zip(self._buckets, counts):
+                le = f"{bound:g}"
                 yield (
                     f"{self.name}_bucket"
-                    f"{_fmt_labels({**base, 'le': f'{bound:g}'})} {c}"
+                    f"{_fmt_labels({**base, 'le': le})} {c}"
+                    + (self._exemplar_suffix(key, le) if openmetrics else "")
                 )
-            yield f"{self.name}_bucket{_fmt_labels({**base, 'le': '+Inf'})} {self._totals[key]}"
+            yield (
+                f"{self.name}_bucket{_fmt_labels({**base, 'le': '+Inf'})} "
+                f"{self._totals[key]}"
+                + (self._exemplar_suffix(key, "+Inf") if openmetrics else "")
+            )
             yield f"{self.name}_sum{_fmt_labels(base)} {_fmt_num(self._sums[key])}"
             yield f"{self.name}_count{_fmt_labels(base)} {self._totals[key]}"
 
@@ -187,12 +282,14 @@ class Registry:
             name, Histogram, lambda: Histogram(name, help_text, buckets)
         )
 
-    def expose(self) -> str:
+    def expose(self, openmetrics: bool = False) -> str:
         lines: list[str] = []
         for m in self._metrics.values():
             try:
-                lines.extend(m.collect())
+                lines.extend(m.collect(openmetrics=openmetrics))
             except Exception:
                 # One misbehaving metric must not take down the whole scrape.
                 lines.append(f"# {m.name} failed to collect")
+        if openmetrics:
+            lines.append("# EOF")
         return "\n".join(lines) + "\n"

@@ -20,6 +20,13 @@ wiring minus kubectl. Scenarios:
                             watchdog-killed and fails transient
   7. graceful drain       — draining rejects new work while in-flight work
                             finishes inside the grace window
+  8. telemetry export     — the OTLP exporter ships spans to a (fake)
+                            collector, which is then killed mid-run: the
+                            exporter degrades to bounded drops (queue never
+                            grows past its cap, the request path is not
+                            slowed) and every trace that missed the
+                            collector is accounted in
+                            bci_telemetry_dropped_total
 
 Exits nonzero if any scenario misbehaves. Usage:
 
@@ -38,6 +45,10 @@ REPO = Path(__file__).resolve().parent.parent
 sys.path.insert(0, str(REPO))
 
 from bee_code_interpreter_tpu.config import Config  # noqa: E402
+from bee_code_interpreter_tpu.observability import (  # noqa: E402
+    TelemetryExporter,
+    Tracer,
+)
 from bee_code_interpreter_tpu.resilience import (  # noqa: E402
     AdmissionController,
     AdmissionRejected,
@@ -49,6 +60,7 @@ from bee_code_interpreter_tpu.resilience import (  # noqa: E402
     HedgingExecutor,
     PoolSupervisor,
     ResilientCodeExecutor,
+    RetryPolicy,
     SandboxTransientError,
 )
 from bee_code_interpreter_tpu.services.kubernetes_code_executor import (  # noqa: E402
@@ -60,7 +72,7 @@ from bee_code_interpreter_tpu.services.local_code_executor import (  # noqa: E40
 from bee_code_interpreter_tpu.services.storage import Storage  # noqa: E402
 from bee_code_interpreter_tpu.utils.metrics import Registry  # noqa: E402
 from tests.chaos import ChaosKubectl, Fail, FaultPlan, Hang, ManualClock  # noqa: E402
-from tests.fakes import FakeExecutorPods  # noqa: E402
+from tests.fakes import FakeCollector, FakeExecutorPods  # noqa: E402
 
 PASS, FAIL = "PASS", "FAIL"
 failures: list[str] = []
@@ -305,6 +317,71 @@ async def main() -> int:
             grace_expired and drained and await inflight == "finished",
         )
 
+        # 8. telemetry export survives its collector dying mid-run
+        #    (fresh registry so the drop accounting is exact)
+        m8 = Registry()
+        tracer = Tracer(metrics=m8)
+        collector = await FakeCollector().start()
+        exporter = TelemetryExporter(
+            collector.endpoint, m8,
+            flush_interval_s=0.05, queue_max=8, batch_max=4,
+            retry=RetryPolicy(attempts=2, wait_min_s=0.01, wait_max_s=0.02),
+        )
+        tracer.add_sink(exporter.enqueue_trace)
+        exporter.start()
+        executor4, _, _, pods4 = make_stack(tmp, storage, m8, clock)
+        enqueued = 0
+        try:
+            async def traced_execute(tag: str) -> float:
+                nonlocal enqueued
+                t0 = time.monotonic()
+                with tracer.trace("/v1/execute"):
+                    result = await executor4.execute(f"print('{tag}')")
+                assert result.stdout == f"{tag}\n"
+                enqueued += 1
+                return time.monotonic() - t0
+
+            pre = [await traced_execute(f"pre{i}") for i in range(3)]
+            for _ in range(200):  # the background loop flushes every 50ms
+                if collector.span_trace_ids():
+                    break
+                await asyncio.sleep(0.02)
+            report(
+                "exporter ships spans while the collector is up",
+                len(collector.span_trace_ids()) >= 1,
+                f"{len(collector.span_trace_ids())} trace(s) received",
+            )
+
+            await collector.stop()  # chaos: collector dies mid-run
+            post = [await traced_execute(f"post{i}") for i in range(8)]
+            report(
+                "collector death leaves the request path unaffected",
+                exporter.queue_depth <= 8
+                and max(post) < max(max(pre) * 3, max(pre) + 0.3),
+                f"queue={exporter.queue_depth}/8 "
+                f"pre_max={max(pre) * 1000:.0f}ms "
+                f"post_max={max(post) * 1000:.0f}ms",
+            )
+
+            await exporter.stop()
+            counters = m8.metrics["bci_telemetry_exported_total"]._values
+            exported = counters.get((("signal", "traces"),), 0)
+            dropped = sum(
+                v
+                for k, v in m8.metrics[
+                    "bci_telemetry_dropped_total"
+                ]._values.items()
+                if ("signal", "traces") in k
+            )
+            report(
+                "every lost batch accounted in bci_telemetry_dropped_total",
+                exported + dropped + exporter.queue_depth == enqueued,
+                f"exported={exported:g} dropped={dropped:g} "
+                f"queued={exporter.queue_depth} of {enqueued} traces",
+            )
+        finally:
+            await pods4.close()
+
         text = metrics.expose()
         wanted = [
             "bci_executor_fallback_total 1",
@@ -327,7 +404,7 @@ async def main() -> int:
         return 1
     print(
         "chaos smoke passed: deadline, breaker, fallback, admission, replay, "
-        "supervisor, watchdog, drain all behaved"
+        "supervisor, watchdog, drain, telemetry export all behaved"
     )
     return 0
 

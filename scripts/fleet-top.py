@@ -1,17 +1,20 @@
 #!/usr/bin/env python3
-"""One-shot text rendering of the fleet state (docs/observability.md).
+"""Text rendering of the fleet state (docs/observability.md).
 
-Fetches ``GET /v1/fleet`` (and optionally the recent lifecycle events) from
-a running service and prints a `top`-style table — the quickest answer to
-"what is the pool doing right now" without curl+jq gymnastics.
+Fetches ``GET /v1/fleet`` (plus ``GET /v1/slo`` and optionally the recent
+lifecycle events) from a running service and prints a `top`-style table —
+the quickest answer to "what is the pool doing right now" without curl+jq
+gymnastics. ``--watch N`` refreshes every N seconds until interrupted.
 
     python scripts/fleet-top.py [--url http://localhost:50081] [--events N]
+        [--watch SECONDS]
 """
 
 from __future__ import annotations
 
 import argparse
 import sys
+import time
 
 import httpx
 
@@ -71,6 +74,31 @@ def render_snapshot(snap: dict) -> str:
     return "\n".join(lines)
 
 
+def render_slo(slo: dict) -> str:
+    """One summary line per service from ``GET /v1/slo``: error budget left
+    and the fast burn rates, with a shout when any alert pair is firing."""
+    objectives = slo.get("objectives") or []
+    if not objectives:
+        return "slo: (no objectives declared)"
+    parts = []
+    for o in objectives:
+        windows = o["windows"]
+        sep = "@" if o.get("threshold_ms") is not None else " "
+        label = f"{o['name']}{sep}{o['target'] * 100:g}%"
+        parts.append(
+            f"{label}: budget={o['error_budget_remaining_ratio']:.0%} left"
+            f" burn 5m={windows['5m']['burn_rate']:.2f}"
+            f" 1h={windows['1h']['burn_rate']:.2f}"
+            f" 6h={windows['6h']['burn_rate']:.2f}"
+        )
+    line = "slo: " + "  |  ".join(parts)
+    if slo.get("fast_burn_alerting"):
+        line += "  ** FAST BURN — PAGE **"
+    elif slo.get("alerting"):
+        line += "  ** BURN ALERT **"
+    return line
+
+
 def render_events(events: list[dict]) -> str:
     lines = ["", f"recent events (newest first, {len(events)}):"]
     for e in events:
@@ -85,9 +113,27 @@ def render_events(events: list[dict]) -> str:
     return "\n".join(lines)
 
 
+def render_once(client: httpx.Client, base: str, events: int) -> None:
+    snap = client.get(f"{base}/v1/fleet").raise_for_status().json()
+    print(render_snapshot(snap))
+    try:
+        # Older replicas without /v1/slo degrade to the no-objectives line.
+        slo = client.get(f"{base}/v1/slo").raise_for_status().json()
+    except httpx.HTTPError:
+        slo = {}
+    print(render_slo(slo))
+    if events > 0:
+        event_list = (
+            client.get(f"{base}/v1/fleet/events", params={"limit": events})
+            .raise_for_status()
+            .json()["events"]
+        )
+        print(render_events(event_list))
+
+
 def main() -> int:
     parser = argparse.ArgumentParser(
-        description="Render GET /v1/fleet as a one-shot text table."
+        description="Render GET /v1/fleet (+ /v1/slo) as a text table."
     )
     parser.add_argument("--url", default="http://localhost:50081")
     parser.add_argument(
@@ -97,26 +143,30 @@ def main() -> int:
         metavar="N",
         help="also show the last N lifecycle events",
     )
+    parser.add_argument(
+        "--watch",
+        type=float,
+        default=0,
+        metavar="SECONDS",
+        help="refresh every N seconds until interrupted (0 = one shot)",
+    )
     args = parser.parse_args()
     base = args.url.rstrip("/")
     try:
         with httpx.Client(timeout=10.0) as client:
-            snap = client.get(f"{base}/v1/fleet").raise_for_status().json()
-            print(render_snapshot(snap))
-            if args.events > 0:
-                events = (
-                    client.get(
-                        f"{base}/v1/fleet/events",
-                        params={"limit": args.events},
-                    )
-                    .raise_for_status()
-                    .json()["events"]
-                )
-                print(render_events(events))
-    except httpx.HTTPError as e:
-        print(f"fleet-top: cannot reach {base}: {e}", file=sys.stderr)
-        return 1
-    return 0
+            while True:
+                try:
+                    render_once(client, base, args.events)
+                except httpx.HTTPError as e:
+                    print(f"fleet-top: cannot reach {base}: {e}", file=sys.stderr)
+                    if args.watch <= 0:
+                        return 1
+                if args.watch <= 0:
+                    return 0
+                time.sleep(args.watch)
+                print(f"\n--- {time.strftime('%H:%M:%S')} ---")
+    except KeyboardInterrupt:
+        return 0
 
 
 if __name__ == "__main__":

@@ -1,0 +1,79 @@
+#!/usr/bin/env python3
+"""Runbook-ready text rendering of ``GET /v1/slo`` (docs/observability.md).
+
+Per declared objective: the error budget remaining, a table of the four
+burn-rate windows (5m/30m/1h/6h), and the state of both multi-window alert
+pairs — the numbers an on-call pastes into an incident doc.
+
+    python scripts/slo-report.py [--url http://localhost:50081]
+
+Exit codes: 0 quiet, 1 unreachable, 3 a slow (ticket) alert firing,
+4 a fast (page) alert firing — scriptable from deploy gates.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+import httpx
+
+
+def render(slo: dict) -> str:
+    objectives = slo.get("objectives") or []
+    if not objectives:
+        return "no SLO objectives declared (set APP_SLO_AVAILABILITY / APP_SLO_LATENCY_MS)"
+    lines: list[str] = []
+    for o in objectives:
+        title = f"objective {o['name']} — target {o['target'] * 100:g}%"
+        if o.get("threshold_ms") is not None:
+            title += f" within {o['threshold_ms']:g}ms"
+        lines.append(title)
+        lines.append(
+            f"  error budget remaining (6h window): "
+            f"{o['error_budget_remaining_ratio']:.1%}"
+        )
+        header = f"  {'WINDOW':<8} {'TOTAL':>8} {'BAD':>6} {'BAD%':>8} {'BURN':>8}"
+        lines.append(header)
+        lines.append("  " + "-" * (len(header) - 2))
+        for window in ("5m", "30m", "1h", "6h"):
+            w = o["windows"][window]
+            lines.append(
+                f"  {window:<8} {w['total']:>8} {w['bad']:>6} "
+                f"{w['bad_ratio']:>8.2%} {w['burn_rate']:>8.2f}"
+            )
+        for alert in o["alerts"]:
+            state = "FIRING" if alert["firing"] else "ok"
+            lines.append(
+                f"  alert[{alert['severity']}] "
+                f"{'&'.join(alert['windows'])} > {alert['burn_threshold']:g}x: "
+                f"{state} (short={alert['short_burn_rate']:.2f} "
+                f"long={alert['long_burn_rate']:.2f})"
+            )
+        lines.append("")
+    return "\n".join(lines).rstrip()
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(
+        description="Render GET /v1/slo burn-rate windows as a text table."
+    )
+    parser.add_argument("--url", default="http://localhost:50081")
+    args = parser.parse_args()
+    base = args.url.rstrip("/")
+    try:
+        with httpx.Client(timeout=10.0) as client:
+            slo = client.get(f"{base}/v1/slo").raise_for_status().json()
+    except httpx.HTTPError as e:
+        print(f"slo-report: cannot reach {base}: {e}", file=sys.stderr)
+        return 1
+    print(render(slo))
+    if slo.get("fast_burn_alerting"):
+        return 4
+    if slo.get("alerting"):
+        return 3
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

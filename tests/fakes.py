@@ -152,3 +152,61 @@ class FakeKubectl:
         self.deleted.append(name)
         self.pods.pop(name, None)
         return {}
+
+
+class FakeCollector:
+    """In-process OTLP/HTTP collector double for the telemetry exporter:
+    records every JSON payload POSTed to ``/v1/traces`` / ``/v1/metrics``.
+    ``fail_next`` makes the next N posts answer 503 (retry coverage);
+    ``stop()`` kills the listener mid-run (the chaos scenario)."""
+
+    def __init__(self, port: int | None = None) -> None:
+        self.port = port or free_port()
+        self.trace_batches: list[dict] = []
+        self.metric_batches: list[dict] = []
+        self.requests = 0
+        self.fail_next = 0
+        self._runner: web.AppRunner | None = None
+
+    @property
+    def endpoint(self) -> str:
+        return f"http://127.0.0.1:{self.port}"
+
+    def span_trace_ids(self) -> set[str]:
+        """Every traceId seen across all received span batches."""
+        return {
+            span["traceId"]
+            for batch in self.trace_batches
+            for rs in batch.get("resourceSpans", [])
+            for ss in rs.get("scopeSpans", [])
+            for span in ss.get("spans", [])
+        }
+
+    async def _handle(self, request: web.Request, sink: list) -> web.Response:
+        self.requests += 1
+        if self.fail_next > 0:
+            self.fail_next -= 1
+            return web.json_response({"detail": "collector overloaded"}, status=503)
+        sink.append(json.loads(await request.read()))
+        return web.json_response({})
+
+    async def start(self) -> "FakeCollector":
+        app = web.Application(client_max_size=1 << 26)
+
+        async def traces(request):
+            return await self._handle(request, self.trace_batches)
+
+        async def metrics(request):
+            return await self._handle(request, self.metric_batches)
+
+        app.router.add_post("/v1/traces", traces)
+        app.router.add_post("/v1/metrics", metrics)
+        self._runner = web.AppRunner(app)
+        await self._runner.setup()
+        await web.TCPSite(self._runner, "127.0.0.1", self.port).start()
+        return self
+
+    async def stop(self) -> None:
+        if self._runner is not None:
+            await self._runner.cleanup()
+            self._runner = None

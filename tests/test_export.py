@@ -1,0 +1,372 @@
+"""Telemetry exporter (observability/export.py): OTLP/JSON wire shape,
+batching, bounded-queue drop accounting, and retry/backoff against a fake
+collector. The invariant under test throughout: every enqueued trace ends up
+exported, dropped-and-accounted, or still queued — never silently lost."""
+
+import json
+import re
+
+import pytest
+
+from bee_code_interpreter_tpu.observability import (
+    TelemetryExporter,
+    Tracer,
+    metrics_payload,
+    span,
+    spans_payload,
+)
+from bee_code_interpreter_tpu.resilience import RetryPolicy
+from bee_code_interpreter_tpu.utils.metrics import Registry
+from tests.fakes import FakeCollector
+
+FAST_RETRY = RetryPolicy(attempts=3, wait_min_s=0.001, wait_max_s=0.002)
+
+
+def make_trace(tracer: Tracer, name: str = "/v1/execute"):
+    with tracer.trace(name, request_id="rid-1") as t:
+        with span("execute", pod="pod-1"):
+            pass
+    return t
+
+
+def counter_value(registry: Registry, name: str, **labels) -> float:
+    metric = registry.metrics[name]
+    return metric._values.get(tuple(sorted(labels.items())), 0.0)
+
+
+class CaptureTransport:
+    """Records (path, payload) per send; scripts failures via ``fail_next``."""
+
+    def __init__(self, fail_next: int = 0) -> None:
+        self.sent: list[tuple[str, dict]] = []
+        self.calls = 0
+        self.fail_next = fail_next
+
+    async def __call__(self, path: str, body: bytes) -> None:
+        self.calls += 1
+        if self.fail_next > 0:
+            self.fail_next -= 1
+            raise RuntimeError("collector unreachable")
+        self.sent.append((path, json.loads(body)))
+
+
+def make_exporter(registry: Registry, transport, **kwargs) -> TelemetryExporter:
+    kwargs.setdefault("retry", FAST_RETRY)
+    kwargs.setdefault("flush_interval_s", 60.0)  # tests flush explicitly
+    return TelemetryExporter(
+        "http://collector.invalid:4318", registry, transport=transport, **kwargs
+    )
+
+
+# ----------------------------------------------------------- wire format
+
+
+def test_spans_payload_is_otlp_json_shaped():
+    """Golden shape test: the hand-rolled payload must look exactly like
+    what an OTLP/HTTP collector parses — resourceSpans/scopeSpans nesting,
+    base16 ids, uint64-nanos-as-strings, stringValue attributes."""
+    tracer = Tracer()
+    trace = make_trace(tracer)
+    payload = spans_payload([trace], service_name="bci-test")
+
+    assert list(payload) == ["resourceSpans"]
+    resource_spans = payload["resourceSpans"]
+    assert len(resource_spans) == 1
+    assert resource_spans[0]["resource"]["attributes"] == [
+        {"key": "service.name", "value": {"stringValue": "bci-test"}}
+    ]
+    scope_spans = resource_spans[0]["scopeSpans"]
+    assert len(scope_spans) == 1
+    assert scope_spans[0]["scope"]["name"] == (
+        "bee_code_interpreter_tpu.observability"
+    )
+    spans = scope_spans[0]["spans"]
+    assert len(spans) == 2  # root + execute
+
+    root = next(s for s in spans if s["name"] == "/v1/execute")
+    child = next(s for s in spans if s["name"] == "execute")
+    assert root["traceId"] == trace.trace_id
+    assert re.fullmatch(r"[0-9a-f]{32}", root["traceId"])
+    assert re.fullmatch(r"[0-9a-f]{16}", root["spanId"])
+    assert "parentSpanId" not in root  # root of a fresh trace
+    assert child["parentSpanId"] == root["spanId"]
+    assert child["traceId"] == trace.trace_id
+    for s in (root, child):
+        assert s["kind"] == 1  # SPAN_KIND_INTERNAL
+        assert s["status"] == {"code": 1}  # STATUS_CODE_OK
+        # uint64 nanos are decimal STRINGS per proto3 JSON
+        assert re.fullmatch(r"\d{19}", s["startTimeUnixNano"])
+        assert int(s["endTimeUnixNano"]) >= int(s["startTimeUnixNano"])
+    assert {"key": "pod", "value": {"stringValue": "pod-1"}} in child[
+        "attributes"
+    ]
+    json.dumps(payload)  # round-trips as plain JSON
+
+
+def test_error_spans_carry_error_status():
+    tracer = Tracer()
+    with pytest.raises(RuntimeError):
+        with tracer.trace("/v1/execute") as t:
+            raise RuntimeError("boom")
+    payload = spans_payload([t], service_name="s")
+    (root,) = payload["resourceSpans"][0]["scopeSpans"][0]["spans"]
+    assert root["status"] == {"code": 2}  # STATUS_CODE_ERROR
+    assert {"key": "error", "value": {"stringValue": "RuntimeError('boom')"}} in (
+        root["attributes"]
+    )
+
+
+def test_metrics_payload_covers_all_three_metric_types():
+    registry = Registry()
+    c = registry.counter("bci_reqs_total", "requests")
+    c.inc(3, route="/x")
+    registry.gauge("bci_depth", "queue depth", lambda: 7.0)
+    h = registry.histogram("bci_lat_seconds", "latency", buckets=(0.1, 1.0))
+    h.observe(0.05)
+    h.observe(0.5)
+    h.observe(5.0)
+
+    payload = metrics_payload(
+        registry, service_name="bci-test", start_unix=1000.0
+    )
+    metrics = payload["resourceMetrics"][0]["scopeMetrics"][0]["metrics"]
+    by_name = {m["name"]: m for m in metrics}
+
+    counter = by_name["bci_reqs_total"]["sum"]
+    assert counter["isMonotonic"] is True
+    assert counter["aggregationTemporality"] == 2  # cumulative
+    (point,) = counter["dataPoints"]
+    assert point["asDouble"] == 3.0
+    assert point["attributes"] == [
+        {"key": "route", "value": {"stringValue": "/x"}}
+    ]
+    # cumulative points carry the accumulation start so consumers can
+    # detect counter resets across restarts
+    assert point["startTimeUnixNano"] == str(int(1000.0 * 1e9))
+    assert int(point["timeUnixNano"]) > int(point["startTimeUnixNano"])
+
+    (gauge_point,) = by_name["bci_depth"]["gauge"]["dataPoints"]
+    assert gauge_point["asDouble"] == 7.0
+
+    (hist_point,) = by_name["bci_lat_seconds"]["histogram"]["dataPoints"]
+    assert hist_point["startTimeUnixNano"] == str(int(1000.0 * 1e9))
+    assert hist_point["count"] == "3"
+    assert hist_point["explicitBounds"] == [0.1, 1.0]
+    # per-bucket (NOT cumulative) with one overflow bucket: 0.05 | 0.5 | 5.0
+    assert hist_point["bucketCounts"] == ["1", "1", "1"]
+    assert hist_point["sum"] == pytest.approx(5.55)
+    json.dumps(payload)
+
+
+# ------------------------------------------------- batching and accounting
+
+
+async def test_flush_batches_traces_and_pushes_metrics():
+    registry = Registry()
+    tracer = Tracer(metrics=registry)
+    transport = CaptureTransport()
+    exporter = make_exporter(registry, transport)
+    tracer.add_sink(exporter.enqueue_trace)
+
+    traces = [make_trace(tracer) for _ in range(5)]
+    assert exporter.queue_depth == 5
+    summary = await exporter.flush_once()
+
+    assert summary["traces_exported"] == 5
+    trace_posts = [p for p in transport.sent if p[0] == "/v1/traces"]
+    assert len(trace_posts) == 1  # one batch, not five posts
+    batch_ids = {
+        s["traceId"]
+        for s in trace_posts[0][1]["resourceSpans"][0]["scopeSpans"][0]["spans"]
+    }
+    assert batch_ids == {t.trace_id for t in traces}
+    # a metrics snapshot rides every flush
+    metric_posts = [p for p in transport.sent if p[0] == "/v1/metrics"]
+    assert len(metric_posts) == 1
+    assert counter_value(
+        registry, "bci_telemetry_exported_total", signal="traces"
+    ) == 5
+    assert counter_value(
+        registry, "bci_telemetry_exported_total", signal="metrics"
+    ) == 1
+    assert exporter.queue_depth == 0
+
+
+async def test_oversize_queue_drains_in_multiple_batches():
+    registry = Registry()
+    tracer = Tracer()
+    transport = CaptureTransport()
+    exporter = make_exporter(registry, transport, batch_max=2)
+    for _ in range(5):
+        exporter.enqueue_trace(make_trace(tracer))
+    await exporter.flush_once()
+    trace_posts = [p for p in transport.sent if p[0] == "/v1/traces"]
+    assert len(trace_posts) == 3  # 2 + 2 + 1
+    assert exporter.queue_depth == 0
+
+
+async def test_bounded_queue_drops_new_traces_and_accounts_them():
+    registry = Registry()
+    tracer = Tracer()
+    exporter = make_exporter(registry, CaptureTransport(), queue_max=2)
+    for _ in range(5):
+        exporter.enqueue_trace(make_trace(tracer))
+    assert exporter.queue_depth == 2  # bounded, never grows past the cap
+    assert counter_value(
+        registry, "bci_telemetry_dropped_total", signal="traces", reason="queue_full"
+    ) == 3
+    await exporter.flush_once()
+    # invariant: enqueued == exported + dropped
+    assert counter_value(
+        registry, "bci_telemetry_exported_total", signal="traces"
+    ) == 2
+
+
+# ------------------------------------------------------- retry and failure
+
+
+async def test_send_retries_with_backoff_then_succeeds():
+    registry = Registry()
+    tracer = Tracer()
+    transport = CaptureTransport(fail_next=2)
+    exporter = make_exporter(registry, transport)
+    exporter.enqueue_trace(make_trace(tracer))
+    summary = await exporter.flush_once()
+    assert summary["traces_exported"] == 1
+    # 2 failures + 1 success for the trace batch, then 1 metrics push
+    assert transport.calls == 4
+    assert counter_value(
+        registry, "bci_telemetry_dropped_total", signal="traces", reason="send_failed"
+    ) == 0
+
+
+async def test_exhausted_retries_drop_the_batch_and_account_it():
+    registry = Registry()
+    tracer = Tracer()
+
+    async def always_down(path, body):
+        raise RuntimeError("connection refused")
+
+    exporter = make_exporter(registry, always_down)
+    for _ in range(3):
+        exporter.enqueue_trace(make_trace(tracer))
+    summary = await exporter.flush_once()
+    assert summary["traces_dropped"] == 3
+    assert exporter.queue_depth == 0
+    assert counter_value(
+        registry, "bci_telemetry_dropped_total", signal="traces", reason="send_failed"
+    ) == 3
+    assert counter_value(
+        registry, "bci_telemetry_dropped_total", signal="metrics", reason="send_failed"
+    ) == 1
+    assert counter_value(
+        registry, "bci_telemetry_exported_total", signal="traces"
+    ) == 0
+
+
+async def test_failed_batch_ends_the_drain_but_keeps_the_rest_queued():
+    """One dead-collector batch must not burn the retry budget once per
+    queued batch: the first failure stops this flush; the remainder waits."""
+    registry = Registry()
+    tracer = Tracer()
+
+    async def always_down(path, body):
+        raise RuntimeError("connection refused")
+
+    exporter = make_exporter(registry, always_down, batch_max=2)
+    for _ in range(6):
+        exporter.enqueue_trace(make_trace(tracer))
+    await exporter.flush_once()
+    assert exporter.queue_depth == 4  # only the first batch was spent
+    assert counter_value(
+        registry, "bci_telemetry_dropped_total", signal="traces", reason="send_failed"
+    ) == 2
+
+
+async def test_stop_is_bounded_against_a_hanging_collector():
+    """SIGTERM teardown must never wait out a blackholed collector: stop()
+    caps the final flush at its timeout and accounts everything still
+    queued as reason="shutdown" — the exported+dropped==enqueued invariant
+    survives even a cancelled in-flight send."""
+    import asyncio
+    import time
+
+    registry = Registry()
+    tracer = Tracer()
+
+    async def blackhole(path, body):
+        await asyncio.sleep(60)
+
+    exporter = make_exporter(registry, blackhole)
+    for _ in range(3):
+        exporter.enqueue_trace(make_trace(tracer))
+    t0 = time.monotonic()
+    await exporter.stop(timeout_s=0.1)
+    assert time.monotonic() - t0 < 2.0
+    assert exporter.queue_depth == 0
+    assert counter_value(
+        registry, "bci_telemetry_dropped_total", signal="traces", reason="shutdown"
+    ) == 3
+    assert counter_value(
+        registry, "bci_telemetry_exported_total", signal="traces"
+    ) == 0
+
+
+# ------------------------------------------------ real HTTP to a collector
+
+
+async def test_exporter_pushes_to_a_real_collector_over_http():
+    """No transport injection: the default httpx path against an in-process
+    OTLP collector — wire bytes, content type, and 503-retry behavior."""
+    collector = await FakeCollector().start()
+    registry = Registry()
+    tracer = Tracer(metrics=registry)
+    exporter = TelemetryExporter(
+        collector.endpoint, registry, retry=FAST_RETRY, flush_interval_s=60.0
+    )
+    try:
+        collector.fail_next = 1  # first post 503s; the retry lands it
+        t1, t2 = make_trace(tracer), make_trace(tracer)
+        exporter.enqueue_trace(t1)
+        exporter.enqueue_trace(t2)
+        summary = await exporter.flush_once()
+        assert summary["traces_exported"] == 2
+        assert collector.span_trace_ids() == {t1.trace_id, t2.trace_id}
+        assert len(collector.metric_batches) == 1
+        metric_names = {
+            m["name"]
+            for m in collector.metric_batches[0]["resourceMetrics"][0][
+                "scopeMetrics"
+            ][0]["metrics"]
+        }
+        assert "bci_telemetry_exported_total" in metric_names
+        assert "bci_stage_seconds" in metric_names
+    finally:
+        await exporter.stop()
+        await collector.stop()
+
+
+async def test_background_loop_flushes_on_interval_and_stop_flushes_tail():
+    import asyncio
+
+    collector = await FakeCollector().start()
+    registry = Registry()
+    tracer = Tracer()
+    exporter = TelemetryExporter(
+        collector.endpoint, registry, retry=FAST_RETRY, flush_interval_s=0.02
+    )
+    try:
+        exporter.start()
+        exporter.enqueue_trace(make_trace(tracer))
+        for _ in range(200):
+            if collector.trace_batches:
+                break
+            await asyncio.sleep(0.01)
+        assert collector.trace_batches, "background loop never flushed"
+        # the tail enqueued after the last interval is flushed by stop()
+        tail = make_trace(tracer)
+        exporter.enqueue_trace(tail)
+        await exporter.stop()
+        assert tail.trace_id in collector.span_trace_ids()
+    finally:
+        await collector.stop()

@@ -269,6 +269,7 @@ async def test_server_reflection_list_and_describe(grpc_server):
     from bee_code_interpreter_tpu.api.grpc_server import (
         FLEET_SERVICE_NAME,
         HEALTH_SERVICE_NAME,
+        OBSERVABILITY_SERVICE_NAME,
         REFLECTION_SERVICE_NAME,
         SERVICE_NAME,
         reflection_stub,
@@ -298,6 +299,7 @@ async def test_server_reflection_list_and_describe(grpc_server):
             assert listed == {
                 SERVICE_NAME,
                 FLEET_SERVICE_NAME,
+                OBSERVABILITY_SERVICE_NAME,
                 HEALTH_SERVICE_NAME,
                 REFLECTION_SERVICE_NAME,
             }

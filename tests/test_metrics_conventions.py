@@ -30,6 +30,10 @@ def build_service_registry(tmp_path) -> Registry:
             file_storage_path=str(tmp_path / "objects"),
             local_workspace_root=str(tmp_path / "ws"),
             disable_dep_install=True,
+            # telemetry export + SLO objectives, so their metrics register
+            otlp_endpoint="http://127.0.0.1:4318",
+            slo_availability=99.5,
+            slo_latency_ms="2000:99",
         )
     )
     _ = ctx.code_executor  # registers executor, breaker, pool, fallback
@@ -78,6 +82,12 @@ def test_every_registered_metric_follows_conventions(tmp_path):
         "bci_execution_replays_total",
         "bci_hedge_total",
         "bci_drain_inflight",
+        # telemetry export + SLOs (ISSUE 5)
+        "bci_telemetry_exported_total",
+        "bci_telemetry_dropped_total",
+        "bci_telemetry_queue_depth",
+        "bci_slo_error_budget_remaining_ratio",
+        "bci_slo_burn_rate",
     ):
         assert required in metrics, f"{required}: not registered by the wiring"
     assert isinstance(metrics["bci_pool_spawn_seconds"], Histogram)
@@ -89,6 +99,11 @@ def test_every_registered_metric_follows_conventions(tmp_path):
     assert isinstance(metrics["bci_execution_replays_total"], Counter)
     assert isinstance(metrics["bci_hedge_total"], Counter)
     assert isinstance(metrics["bci_drain_inflight"], Gauge)
+    assert isinstance(metrics["bci_telemetry_exported_total"], Counter)
+    assert isinstance(metrics["bci_telemetry_dropped_total"], Counter)
+    assert isinstance(metrics["bci_telemetry_queue_depth"], Gauge)
+    assert isinstance(metrics["bci_slo_error_budget_remaining_ratio"], Gauge)
+    assert isinstance(metrics["bci_slo_burn_rate"], Gauge)
 
     for name, metric in metrics.items():
         assert name.startswith("bci_"), (
@@ -120,6 +135,59 @@ def test_every_registered_metric_follows_conventions(tmp_path):
         assert text.count(f"# HELP {name} ") == 1, (
             f"{name}: duplicate or missing exposition block"
         )
+
+
+def test_every_seconds_histogram_carries_exemplars_when_trace_active(tmp_path):
+    """Exemplar lint: an observation made under an active trace must surface
+    that trace's id on the OpenMetrics exposition of EVERY ``bci_*_seconds``
+    histogram — the metric↔trace linkage is only useful if no histogram
+    silently opts out."""
+    import re
+
+    from bee_code_interpreter_tpu.observability import Tracer
+
+    registry = build_service_registry(tmp_path)
+    tracer = Tracer(metrics=registry)
+    histograms = {
+        name: metric
+        for name, metric in registry.metrics.items()
+        if isinstance(metric, Histogram) and name.endswith("_seconds")
+    }
+    assert len(histograms) >= 5, sorted(histograms)
+
+    with tracer.trace("exemplar-lint") as trace:
+        for metric in histograms.values():
+            metric.observe(0.012)
+
+    text = registry.expose(openmetrics=True)
+    for name in histograms:
+        pattern = re.compile(
+            rf'^{name}_bucket{{[^}}]*}} \d+ '
+            rf'# {{trace_id="{trace.trace_id}",span_id="[0-9a-f]{{16}}"}} '
+            rf"[0-9.e+-]+ [0-9.]+$",
+            re.M,
+        )
+        assert pattern.search(text), f"{name}: no exemplar on any bucket"
+    assert text.rstrip().endswith("# EOF")
+
+    # the classic Prometheus format must stay exemplar-free (its parsers
+    # reject the syntax) and observations made OUTSIDE a trace add none
+    classic = registry.expose()
+    assert "trace_id=" not in classic
+    assert "# EOF" not in classic
+    fresh = Registry()
+    fresh.histogram("bci_plain_seconds", "untraced").observe(0.5)
+    assert "trace_id=" not in fresh.expose(openmetrics=True)
+
+
+def test_openmetrics_counter_family_drops_total_suffix():
+    registry = Registry()
+    registry.counter("bci_things_total", "things").inc(2)
+    om = registry.expose(openmetrics=True)
+    assert "# TYPE bci_things counter" in om
+    assert "bci_things_total 2" in om  # the sample keeps the suffix
+    classic = registry.expose()
+    assert "# TYPE bci_things_total counter" in classic
 
 
 def test_registry_rejects_type_conflicting_reregistration():

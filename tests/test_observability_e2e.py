@@ -566,14 +566,194 @@ async def test_grpc_fleet_service_serves_snapshot_and_events(
 async def test_metrics_content_type_negotiates_exposition_format(
     local_executor,
 ):
+    """Regression for BOTH negotiation paths: the classic Prometheus text
+    format stays the default; ``Accept: application/openmetrics-text`` gets
+    OpenMetrics 1.0 with the ``# EOF`` terminator."""
+    from bee_code_interpreter_tpu.utils.metrics import (
+        OPENMETRICS_CONTENT_TYPE,
+    )
+
     app = create_http_server(
         code_executor=local_executor,
         custom_tool_executor=CustomToolExecutor(code_executor=local_executor),
     )
 
     async def go(client: TestClient):
+        # default (no Accept preference): classic Prometheus text format
         resp = await client.get("/metrics")
         assert resp.status == 200
         assert resp.headers["Content-Type"] == PROMETHEUS_CONTENT_TYPE
+        body = await resp.text()
+        assert "# EOF" not in body
+
+        # a Prometheus-style Accept chain asking for OpenMetrics first
+        resp = await client.get(
+            "/metrics",
+            headers={
+                "Accept": (
+                    "application/openmetrics-text; version=1.0.0, "
+                    "text/plain;version=0.0.4;q=0.5"
+                )
+            },
+        )
+        assert resp.status == 200
+        assert resp.headers["Content-Type"] == OPENMETRICS_CONTENT_TYPE
+        body = await resp.text()
+        assert body.rstrip().endswith("# EOF")
+
+        # an explicit text/plain Accept keeps the classic format
+        resp = await client.get(
+            "/metrics", headers={"Accept": "text/plain; version=0.0.4"}
+        )
+        assert resp.headers["Content-Type"] == PROMETHEUS_CONTENT_TYPE
+
+        # q=0 means "not acceptable" (RFC 9110): a client explicitly
+        # REFUSING OpenMetrics must get the classic format
+        resp = await client.get(
+            "/metrics",
+            headers={
+                "Accept": "application/openmetrics-text;q=0, text/plain"
+            },
+        )
+        assert resp.headers["Content-Type"] == PROMETHEUS_CONTENT_TYPE
 
     await with_client(app, go)
+
+
+async def test_export_traces_and_exemplars_tell_one_story(tmp_path, storage):
+    """ISSUE 5 acceptance: one executed request produces an OTLP/JSON span
+    batch whose trace_id matches both /v1/traces/{id} and the exemplar on
+    the bci_stage_seconds OpenMetrics exposition — collector, inspection
+    API, and Prometheus all point at the same trace."""
+    import json as _json
+
+    from bee_code_interpreter_tpu.observability import TelemetryExporter
+    from bee_code_interpreter_tpu.resilience import RetryPolicy
+
+    pods = FakeExecutorPods(tmp_path / "pods")
+    metrics = Registry()
+    tracer = Tracer(metrics=metrics)
+    sent: list[tuple[str, dict]] = []
+
+    async def transport(path, body):
+        sent.append((path, _json.loads(body)))
+
+    exporter = TelemetryExporter(
+        "http://collector.invalid:4318",
+        metrics,
+        transport=transport,
+        flush_interval_s=60.0,  # the test flushes explicitly
+        retry=RetryPolicy(attempts=1, wait_min_s=0.001, wait_max_s=0.002),
+    )
+    tracer.add_sink(exporter.enqueue_trace)
+    app = make_app(pods, storage, metrics, tracer)
+
+    async def go(client: TestClient):
+        body = await (
+            await client.post(
+                "/v1/execute", json={"source_code": "print('exported')"}
+            )
+        ).json()
+        trace_id = body["trace_id"]
+
+        # --- the exported OTLP batch carries the SAME trace ---
+        await exporter.flush_once()
+        trace_posts = [p for p in sent if p[0] == "/v1/traces"]
+        assert len(trace_posts) == 1
+        spans = trace_posts[0][1]["resourceSpans"][0]["scopeSpans"][0]["spans"]
+        assert {s["traceId"] for s in spans} == {trace_id}
+        exported_names = {s["name"] for s in spans}
+        # (no files in/out on this request, so no upload/download stages)
+        assert {"/v1/execute", "spawn", "execute"} <= exported_names
+
+        # --- which is retrievable from the inspection API ---
+        detail = await (await client.get(f"/v1/traces/{trace_id}")).json()
+        assert {s["name"] for s in detail["spans"]} == exported_names
+
+        # --- and is the exemplar on the stage histogram ---
+        om = await (
+            await client.get(
+                "/metrics",
+                headers={"Accept": "application/openmetrics-text"},
+            )
+        ).text()
+        execute_exemplars = re.findall(
+            r'^bci_stage_seconds_bucket\{le="[^"]+",stage="execute"\} \d+ '
+            r'# \{trace_id="([0-9a-f]{32})"',
+            om,
+            re.M,
+        )
+        assert execute_exemplars == [trace_id]
+
+        # drop accounting stayed clean on the happy path
+        assert "bci_telemetry_dropped_total" not in re.sub(
+            r"# (HELP|TYPE)[^\n]*", "", om
+        )
+
+    try:
+        await with_client(app, go)
+    finally:
+        await pods.close()
+
+
+async def test_debug_bundle_is_one_complete_document(tmp_path, storage):
+    """ISSUE 5 acceptance: GET /v1/debug/bundle returns traces, fleet
+    events, SLO state, service health, and the metrics dump in ONE JSON
+    document."""
+    from bee_code_interpreter_tpu.observability import (
+        SloEngine,
+        parse_objectives,
+    )
+
+    pods = FakeExecutorPods(tmp_path / "pods")
+    metrics = Registry()
+    tracer = Tracer(metrics=metrics)
+    slo = SloEngine(parse_objectives(99.5, "2000:99"), metrics=metrics)
+    pods_app, executor = make_stack(pods, storage, metrics, tracer)
+    app = create_http_server(
+        code_executor=executor,
+        custom_tool_executor=CustomToolExecutor(code_executor=executor),
+        metrics=metrics,
+        tracer=tracer,
+        slo=slo,
+    )
+
+    async def go(client: TestClient):
+        body = await (
+            await client.post("/v1/execute", json={"source_code": "print(1)"})
+        ).json()
+
+        resp = await client.get("/v1/debug/bundle")
+        assert resp.status == 200
+        bundle = await resp.json()
+        assert bundle["generated_unix"] > 0
+
+        # traces: the request is in the recent summaries and (being the
+        # only one) in the slowest full dumps
+        recent_ids = {t["trace_id"] for t in bundle["traces"]["recent"]}
+        assert body["trace_id"] in recent_ids
+        assert bundle["traces"]["slowest"][0]["spans"]
+
+        # fleet: the serving pod's lifecycle is in the same document
+        states = {e["state"] for e in bundle["fleet"]["events"]}
+        assert {"spawning", "ready", "executing", "released"} <= states
+        assert bundle["fleet"]["snapshot"]["executions_total"] == 1
+
+        # slo: the request was sampled
+        availability = next(
+            o
+            for o in bundle["slo"]["objectives"]
+            if o["name"] == "availability"
+        )
+        assert availability["windows"]["5m"]["total"] == 1
+
+        # service health + full metrics dump round out the snapshot
+        assert bundle["service"]["breakers"] == {
+            "k8s-spawn": "closed", "k8s-http": "closed",
+        }
+        assert "bci_stage_seconds" in bundle["metrics"]
+
+    try:
+        await with_client(app, go)
+    finally:
+        await pods.close()

@@ -1,0 +1,337 @@
+"""SLO engine (observability/slo.py): objective parsing, sliding-window math
+under a manual clock (every number hand-computed), multi-window burn-rate
+alerting, and the /v1/slo + debug-bundle surfaces on both transports."""
+
+import pytest
+
+from bee_code_interpreter_tpu.observability import (
+    SloEngine,
+    parse_objectives,
+)
+from bee_code_interpreter_tpu.observability.slo import WINDOWS
+from bee_code_interpreter_tpu.utils.metrics import Registry
+from tests.chaos import ManualClock
+
+# ------------------------------------------------------------- parsing
+
+
+def test_parse_objectives_availability_and_latency():
+    objectives = parse_objectives(99.5, "2000:99")
+    assert [(o.name, o.kind) for o in objectives] == [
+        ("availability", "availability"),
+        ("latency_2000ms", "latency"),
+    ]
+    assert objectives[0].target == pytest.approx(0.995)
+    assert objectives[0].error_budget == pytest.approx(0.005)
+    assert objectives[1].target == pytest.approx(0.99)
+    assert objectives[1].threshold_ms == 2000.0
+
+
+def test_parse_objectives_latency_list_and_empty():
+    objectives = parse_objectives(None, "500:95, 2000:99")
+    assert [o.name for o in objectives] == ["latency_500ms", "latency_2000ms"]
+    assert parse_objectives(None, None) == []
+    assert parse_objectives(None, "") == []
+
+
+@pytest.mark.parametrize(
+    "availability,latency",
+    [
+        (0, None),
+        (100, None),
+        (101.5, None),
+        (None, "banana"),
+        (None, "2000"),
+        (None, "2000:"),
+        (None, ":99"),
+        (None, "2000:101"),
+        (None, "-5:99"),
+    ],
+)
+def test_parse_objectives_rejects_malformed(availability, latency):
+    with pytest.raises(ValueError):
+        parse_objectives(availability, latency)
+
+
+# ------------------------------------------------------- window math
+
+
+def availability_engine(clock, target_percent=99.0, **kwargs):
+    return SloEngine(
+        parse_objectives(target_percent, None), clock=clock, **kwargs
+    )
+
+
+def test_availability_burn_rate_hand_computed():
+    clock = ManualClock(start=5.0)
+    engine = availability_engine(clock)  # budget = 0.01
+    (objective,) = engine.objectives
+    for i in range(100):
+        engine.record(ok=i >= 2, duration_s=0.01)  # 2 bad of 100
+
+    # bad_ratio = 2/100 = 0.02; burn = 0.02 / 0.01 = 2.0, in EVERY window
+    for window in WINDOWS:
+        assert engine.burn_rate(objective, window) == pytest.approx(2.0)
+    # budget remaining over 6h: 1 - 0.02/0.01 = -1 (overspent reads negative)
+    assert engine.error_budget_remaining(objective) == pytest.approx(-1.0)
+
+    snap = engine.snapshot()
+    (obj,) = snap["objectives"]
+    assert obj["windows"]["5m"] == {
+        "total": 100,
+        "bad": 2,
+        "bad_ratio": pytest.approx(0.02),
+        "burn_rate": pytest.approx(2.0),
+    }
+
+
+def test_sliding_window_forgets_old_buckets():
+    clock = ManualClock(start=5.0)
+    engine = availability_engine(clock)  # bucket_s=10: events land in idx 0
+    (objective,) = engine.objectives
+    for _ in range(10):
+        engine.record(ok=False, duration_s=0.01)
+
+    # bucket [0,10) stays in the 5m window until now - 300 >= 10
+    clock.advance(300.0)  # now=305: still (barely) inside
+    assert engine.burn_rate(objective, "5m") == pytest.approx(100.0)
+    clock.advance(15.0)  # now=320: outside 5m, inside 1h
+    assert engine.burn_rate(objective, "5m") == 0.0
+    assert engine.burn_rate(objective, "1h") == pytest.approx(100.0)
+    clock.advance(WINDOWS["6h"])  # beyond every window
+    assert engine.burn_rate(objective, "6h") == 0.0
+    assert engine.error_budget_remaining(objective) == pytest.approx(1.0)
+
+
+def test_latency_objective_counts_successes_only():
+    clock = ManualClock(start=5.0)
+    engine = SloEngine(
+        parse_objectives(None, "100:95"), clock=clock
+    )  # budget = 0.05
+    (objective,) = engine.objectives
+    for i in range(20):
+        engine.record(ok=True, duration_s=0.15 if i < 2 else 0.05)
+    for _ in range(5):  # failures burn availability, never latency
+        engine.record(ok=False, duration_s=9.9)
+
+    snap = engine.snapshot()
+    (obj,) = snap["objectives"]
+    # 2 slow of 20 SUCCESSFUL: ratio 0.1, burn 0.1/0.05 = 2
+    assert obj["windows"]["5m"] == {
+        "total": 20,
+        "bad": 2,
+        "bad_ratio": pytest.approx(0.1),
+        "burn_rate": pytest.approx(2.0),
+    }
+
+
+def test_fast_burn_alert_needs_both_windows_over_threshold():
+    clock = ManualClock(start=5.0)
+    engine = availability_engine(clock)  # budget 0.01; page pair needs 14.4x
+    (objective,) = engine.objectives
+    # 20% errors: burn = 0.2/0.01 = 20 >= 14.4 in both 5m and 1h
+    for i in range(10):
+        engine.record(ok=i >= 2, duration_s=0.01)
+    snap = engine.snapshot()
+    page, ticket = snap["objectives"][0]["alerts"]
+    assert page["severity"] == "page" and page["firing"]
+    assert page["windows"] == ["5m", "1h"]
+    # ticket pair: burn 20 >= 6 in 30m and 6h too
+    assert ticket["severity"] == "ticket" and ticket["firing"]
+    assert snap["alerting"] and snap["fast_burn_alerting"]
+
+    # the 5m window slides clear; burn in 1h persists -> page must STOP
+    # (that asymmetry is the whole point of the short window)
+    clock.advance(320.0)
+    for _ in range(100):
+        engine.record(ok=True, duration_s=0.01)
+    snap = engine.snapshot()
+    page, ticket = snap["objectives"][0]["alerts"]
+    assert page["short_burn_rate"] == 0.0
+    assert page["long_burn_rate"] == pytest.approx(2 / 110 / 0.01)
+    assert not page["firing"]
+    assert not snap["fast_burn_alerting"]
+
+
+def test_engine_without_objectives_is_inert():
+    registry = Registry()
+    engine = SloEngine([], metrics=registry)
+    engine.record(ok=False, duration_s=1.0)
+    assert engine.snapshot() == {
+        "objectives": [],
+        "alerting": False,
+        "fast_burn_alerting": False,
+    }
+    assert "bci_slo_burn_rate" not in registry.metrics
+
+
+def test_slo_gauges_reflect_engine_state():
+    registry = Registry()
+    clock = ManualClock(start=5.0)
+    engine = SloEngine(
+        parse_objectives(99.0, "100:95"), metrics=registry, clock=clock
+    )
+    for i in range(100):
+        engine.record(ok=i >= 1, duration_s=0.01)  # 1 bad of 100
+
+    import re
+
+    text = registry.expose()
+
+    def gauge_value(line_prefix: str) -> float:
+        m = re.search(rf"^{re.escape(line_prefix)} (\S+)$", text, re.M)
+        assert m, f"{line_prefix}: not exposed"
+        return float(m.group(1))
+
+    assert gauge_value(
+        'bci_slo_burn_rate{objective="availability",window="5m"}'
+    ) == pytest.approx(1.0)
+    assert gauge_value(
+        'bci_slo_error_budget_remaining_ratio{objective="availability"}'
+    ) == pytest.approx(0.0)
+    assert 'objective="latency_100ms"' in text
+
+
+# ----------------------------------------------------- transport surfaces
+
+
+async def test_http_slo_endpoint_healthz_and_bundle(local_executor):
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from bee_code_interpreter_tpu.api.http_server import create_http_server
+    from bee_code_interpreter_tpu.services.custom_tool_executor import (
+        CustomToolExecutor,
+    )
+
+    registry = Registry()
+    engine = SloEngine(parse_objectives(99.5, "2000:99"), metrics=registry)
+    app = create_http_server(
+        code_executor=local_executor,
+        custom_tool_executor=CustomToolExecutor(code_executor=local_executor),
+        metrics=registry,
+        slo=engine,
+    )
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    try:
+        resp = await client.post(
+            "/v1/execute", json={"source_code": "print(1)"}
+        )
+        assert resp.status == 200
+
+        slo = await (await client.get("/v1/slo")).json()
+        names = {o["name"] for o in slo["objectives"]}
+        assert names == {"availability", "latency_2000ms"}
+        availability = next(
+            o for o in slo["objectives"] if o["name"] == "availability"
+        )
+        # the successful execute was recorded as a good sample
+        assert availability["windows"]["5m"]["total"] == 1
+        assert availability["windows"]["5m"]["bad"] == 0
+        assert availability["error_budget_remaining_ratio"] == 1.0
+        assert slo["alerting"] is False
+
+        verbose = await (await client.get("/healthz?verbose=1")).json()
+        assert verbose["slo"]["fast_burn_alerting"] is False
+        assert {o["name"] for o in verbose["slo"]["objectives"]} == names
+        terse = await (await client.get("/healthz")).json()
+        assert "slo" not in terse
+
+        bundle = await (await client.get("/v1/debug/bundle")).json()
+        assert {o["name"] for o in bundle["slo"]["objectives"]} == names
+        assert bundle["traces"]["retained"] >= 1
+        assert "bci_http_requests_total" in bundle["metrics"]
+    finally:
+        await client.close()
+
+
+async def test_http_records_500_as_bad_and_422_as_good(local_executor):
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from bee_code_interpreter_tpu.api.http_server import create_http_server
+    from bee_code_interpreter_tpu.services.custom_tool_executor import (
+        CustomToolExecutor,
+    )
+
+    class Exploding:
+        async def execute(self, **kwargs):
+            raise RuntimeError("backend on fire")
+
+    engine = SloEngine(parse_objectives(99.0, None))
+    (objective,) = engine.objectives
+    app = create_http_server(
+        code_executor=Exploding(),
+        custom_tool_executor=CustomToolExecutor(code_executor=Exploding()),
+        slo=engine,
+    )
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    try:
+        resp = await client.post(
+            "/v1/execute", json={"source_code": "print(1)"}
+        )
+        assert resp.status == 500
+        total, bad = engine._window_counts(objective, WINDOWS["5m"])
+        assert (total, bad) == (1, 1)
+
+        # a validation error is the CLIENT's fault: sampled, but good
+        resp = await client.post("/v1/execute", json={"nope": True})
+        assert resp.status == 422
+        total, bad = engine._window_counts(objective, WINDOWS["5m"])
+        assert (total, bad) == (2, 1)
+    finally:
+        await client.close()
+
+
+async def test_grpc_records_slo_and_serves_observability_service(
+    local_executor,
+):
+    import grpc.aio
+
+    from bee_code_interpreter_tpu.api.grpc_server import (
+        GrpcServer,
+        observability_stubs,
+        service_stubs,
+    )
+    from bee_code_interpreter_tpu.proto import code_interpreter_pb2 as pb
+    from bee_code_interpreter_tpu.services.custom_tool_executor import (
+        CustomToolExecutor,
+    )
+
+    engine = SloEngine(parse_objectives(99.5, "2000:99"))
+    (availability, _) = engine.objectives
+    server = GrpcServer(
+        code_executor=local_executor,
+        custom_tool_executor=CustomToolExecutor(code_executor=local_executor),
+        slo=engine,
+        debug_bundle=lambda: {"from": "context"},
+    )
+    port = await server.start("127.0.0.1:0")
+    try:
+        import json as _json
+
+        async with grpc.aio.insecure_channel(f"127.0.0.1:{port}") as channel:
+            stubs = service_stubs(channel)
+            response = await stubs["Execute"](
+                pb.ExecuteRequest(source_code="print(21 * 2)")
+            )
+            assert response.stdout == "42\n"
+            total, bad = engine._window_counts(availability, WINDOWS["5m"])
+            assert (total, bad) == (1, 0)
+
+            obs = observability_stubs(channel)
+            slo = _json.loads(await obs["GetSlo"](b""))
+            assert slo["objectives"][0]["windows"]["5m"]["total"] == 1
+            bundle = _json.loads(await obs["GetDebugBundle"](b""))
+            assert bundle == {"from": "context"}
+
+            # a validation reject is the CLIENT's fault: sampled as good,
+            # mirroring the HTTP edge's 422 (identical workloads must
+            # compute identical SLIs on both transports)
+            with pytest.raises(grpc.aio.AioRpcError) as err:
+                await stubs["Execute"](pb.ExecuteRequest(source_code=""))
+            assert err.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+            total, bad = engine._window_counts(availability, WINDOWS["5m"])
+            assert (total, bad) == (2, 0)
+    finally:
+        await server.stop(grace=0.1)
