@@ -1,0 +1,65 @@
+"""Edge-side static analysis: pre-flight code gate, policy engine, and the
+asyncio-control-plane self-lint.
+
+Two halves (docs/analysis.md):
+
+- **Workload analysis** — one AST pass per submission at both API edges
+  (``inspect.py``), evaluated by a config-declared :class:`PolicyEngine`
+  (``policy.py``): syntax errors fail fast as ordinary exit_code=1
+  responses without consuming a warm sandbox, ``deny`` policy hits reject
+  as client faults, and the same pass pre-resolves PyPI deps so the pod
+  can skip its own scan (``context.py`` carries the prediction to the
+  data plane).
+- **Self-analysis** — ``asynclint.py`` turns the same machinery on our own
+  ``api``/``services``/``resilience``/``observability`` packages,
+  enforcing repo asyncio invariants in tier-1.
+
+Layered like ``resilience/`` and ``observability/``: primitives here,
+wiring at the edges (api/, services/, runtime/).
+"""
+
+from bee_code_interpreter_tpu.analysis.asynclint import (
+    LintReport,
+    Suppression,
+    Violation,
+    lint_paths,
+    lint_source,
+)
+from bee_code_interpreter_tpu.analysis.context import (
+    predicted_deps,
+    stash_predicted_deps,
+)
+from bee_code_interpreter_tpu.analysis.inspect import (
+    CallSite,
+    SourceInspection,
+    inspect_source,
+    render_syntax_error,
+)
+from bee_code_interpreter_tpu.analysis.policy import (
+    SHAPES,
+    AnalysisVerdict,
+    Finding,
+    PolicyEngine,
+    WorkloadAnalyzer,
+    split_patterns,
+)
+
+__all__ = [
+    "AnalysisVerdict",
+    "CallSite",
+    "Finding",
+    "LintReport",
+    "PolicyEngine",
+    "SHAPES",
+    "SourceInspection",
+    "Suppression",
+    "Violation",
+    "WorkloadAnalyzer",
+    "inspect_source",
+    "lint_paths",
+    "lint_source",
+    "predicted_deps",
+    "render_syntax_error",
+    "split_patterns",
+    "stash_predicted_deps",
+]

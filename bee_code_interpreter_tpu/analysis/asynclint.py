@@ -1,0 +1,333 @@
+"""AST self-lint for the asyncio control plane (docs/analysis.md "Self-lint").
+
+The same static-analysis machinery that gates workloads at the edge
+(``analysis/inspect.py``'s alias-resolved call names), turned on our own
+packages. The service is ONE event loop; a single blocking call in an
+``async def`` stalls every in-flight request, and a dropped task handle is
+work nothing can cancel at drain. These are repo invariants, so they are
+enforced by a tier-1 test (tests/test_asynclint.py), not a style guide.
+
+Rules:
+
+- ``blocking-call-in-async``  ``time.sleep`` / ``subprocess.run`` (and the
+  rest of the blocking subprocess family) / ``requests.*`` /
+  ``urllib.request.urlopen`` / ``os.system`` / builtin ``open`` where the
+  NEAREST enclosing function is ``async def`` (a sync helper nested inside
+  an async function runs in an executor or a subprocess — that is the
+  sanctioned pattern and is not flagged).
+- ``fire-and-forget-task``    ``asyncio.create_task`` / ``ensure_future`` /
+  ``<loop>.create_task`` as a bare expression statement: the handle is
+  dropped, so the task can never be awaited, cancelled at ``aclose``, or
+  have its exception observed. Retaining it (assignment, return, await,
+  passing it on — e.g. the backends' ``_spawn_background``) satisfies the
+  rule.
+- ``bare-except``             ``except:`` swallows ``CancelledError`` and
+  breaks cooperative cancellation; catch ``Exception`` (or narrower).
+- ``env-bypass``              an ``APP_*`` environment read outside
+  ``config.py``: every service knob must flow through ``Config`` so
+  ``from_env``/docs/configuration.md stay the single source of truth.
+- ``undocumented-metric``     a ``bci_*`` name registered via
+  ``counter``/``histogram``/``gauge`` that does not appear in
+  docs/observability.md — an operator cannot alert on a metric they cannot
+  find.
+
+Suppressions are EXPLICIT: each carries the violating file, the rule, and
+a one-line justification, and a suppression that no longer matches any
+violation is itself an error (``stale_suppressions``) — the list can only
+shrink honestly.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from pathlib import Path
+
+from bee_code_interpreter_tpu.analysis.inspect import (
+    collect_aliases,
+    resolve_call_name,
+)
+
+PACKAGE_ROOT = Path(__file__).resolve().parent.parent
+REPO_ROOT = PACKAGE_ROOT.parent
+DEFAULT_PACKAGES = ("api", "services", "resilience", "observability")
+DEFAULT_DOCS = REPO_ROOT / "docs" / "observability.md"
+
+# Blocking entry points that must not run on the event loop. subprocess.Popen
+# is absent deliberately: constructing it is quick; *communicating* with it
+# blocks, and the blocking spellings are listed.
+BLOCKING_CALLS = frozenset(
+    {
+        "time.sleep",
+        "os.system",
+        "subprocess.run",
+        "subprocess.call",
+        "subprocess.check_call",
+        "subprocess.check_output",
+        "urllib.request.urlopen",
+        "open",
+    }
+)
+BLOCKING_PREFIXES = ("requests.",)
+
+_TASK_SPAWNERS = frozenset({"asyncio.create_task", "asyncio.ensure_future"})
+_TASK_SPAWNER_ATTRS = frozenset({"create_task", "ensure_future"})
+_METRIC_REGISTRARS = frozenset({"counter", "histogram", "gauge"})
+
+
+@dataclass(frozen=True)
+class Violation:
+    path: str  # repo-relative
+    line: int
+    rule: str
+    message: str
+
+    def __str__(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+
+@dataclass(frozen=True)
+class Suppression:
+    """One justified exception. ``path`` is a suffix match against the
+    repo-relative file path; ``rule`` must match exactly."""
+
+    path: str
+    rule: str
+    reason: str
+
+    def matches(self, v: Violation) -> bool:
+        return v.rule == self.rule and v.path.endswith(self.path)
+
+
+# The shipped suppression budget: every entry names WHY the violation is
+# acceptable. Additions need the same one-line justification.
+SUPPRESSIONS: tuple[Suppression, ...] = (
+    Suppression(
+        path="services/local_code_executor.py",
+        rule="blocking-call-in-async",
+        reason=(
+            "dev/test backend: workspace restore/snapshot do chunked I/O on "
+            "local tmp files; per-chunk thread-pool hops would cost more than "
+            "the sync writes they hide (the production pod path streams over "
+            "HTTP instead)"
+        ),
+    ),
+    Suppression(
+        path="services/native_process_code_executor.py",
+        rule="env-bypass",
+        reason=(
+            "APP_PYTHON selects the *sandbox* interpreter for spawned "
+            "executor-server processes (docs/configuration.md); it configures "
+            "the child environment contract, not this service's Config"
+        ),
+    ),
+)
+
+
+@dataclass
+class LintReport:
+    violations: list[Violation] = field(default_factory=list)
+    suppressed: list[tuple[Violation, Suppression]] = field(default_factory=list)
+    stale_suppressions: list[Suppression] = field(default_factory=list)
+    metric_names: set[str] = field(default_factory=set)
+
+    @property
+    def clean(self) -> bool:
+        return not self.violations and not self.stale_suppressions
+
+    def summary(self) -> str:
+        lines = [str(v) for v in self.violations]
+        lines += [
+            f"stale suppression ({s.path} [{s.rule}]): no matching violation"
+            for s in self.stale_suppressions
+        ]
+        return "\n".join(lines) or "clean"
+
+
+class _Linter(ast.NodeVisitor):
+    """One file's AST walk, tracking the nearest-enclosing-function kind."""
+
+    def __init__(self, path: str, aliases: dict[str, str]) -> None:
+        self.path = path
+        self.aliases = aliases
+        self.violations: list[Violation] = []
+        self.metric_sites: list[tuple[str, int]] = []  # (bci name, line)
+        self._async_stack: list[bool] = []  # nearest function is async?
+
+    def _flag(self, node: ast.AST, rule: str, message: str) -> None:
+        self.violations.append(
+            Violation(
+                path=self.path,
+                line=getattr(node, "lineno", 0),
+                rule=rule,
+                message=message,
+            )
+        )
+
+    # --- function scope tracking -----------------------------------------
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self._async_stack.append(False)
+        self.generic_visit(node)
+        self._async_stack.pop()
+
+    def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef) -> None:
+        self._async_stack.append(True)
+        self.generic_visit(node)
+        self._async_stack.pop()
+
+    def visit_Lambda(self, node: ast.Lambda) -> None:
+        self._async_stack.append(False)
+        self.generic_visit(node)
+        self._async_stack.pop()
+
+    @property
+    def _in_async(self) -> bool:
+        return bool(self._async_stack) and self._async_stack[-1]
+
+    # --- rules ------------------------------------------------------------
+    def visit_ExceptHandler(self, node: ast.ExceptHandler) -> None:
+        if node.type is None:
+            self._flag(
+                node,
+                "bare-except",
+                "bare `except:` swallows CancelledError; catch Exception or narrower",
+            )
+        self.generic_visit(node)
+
+    def visit_Expr(self, node: ast.Expr) -> None:
+        # A task spawned as a bare statement: handle dropped on the floor.
+        if isinstance(node.value, ast.Call):
+            name = resolve_call_name(node.value.func, self.aliases)
+            func = node.value.func
+            if name in _TASK_SPAWNERS or (
+                name is None
+                and isinstance(func, ast.Attribute)
+                and func.attr in _TASK_SPAWNER_ATTRS
+            ):
+                spelled = name or f"<…>.{func.attr}"
+                self._flag(
+                    node,
+                    "fire-and-forget-task",
+                    f"{spelled}(...) result discarded: retain the handle so "
+                    "it can be awaited/cancelled (e.g. _spawn_background)",
+                )
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        name = resolve_call_name(node.func, self.aliases)
+        if name is not None:
+            if self._in_async and (
+                name in BLOCKING_CALLS or name.startswith(BLOCKING_PREFIXES)
+            ):
+                self._flag(
+                    node,
+                    "blocking-call-in-async",
+                    f"blocking call {name}() inside async def stalls the "
+                    "event loop; use the asyncio equivalent or an executor",
+                )
+            if name in ("os.getenv", "os.environ.get") and node.args:
+                self._check_env_key(node, node.args[0])
+        # bci_* metric registration site (first positional arg is the name).
+        if (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr in _METRIC_REGISTRARS
+            and node.args
+            and isinstance(node.args[0], ast.Constant)
+            and isinstance(node.args[0].value, str)
+            and node.args[0].value.startswith("bci_")
+        ):
+            self.metric_sites.append((node.args[0].value, node.lineno))
+        self.generic_visit(node)
+
+    def visit_Subscript(self, node: ast.Subscript) -> None:
+        target = resolve_call_name(node.value, self.aliases)
+        if target == "os.environ":
+            self._check_env_key(node, node.slice)
+        self.generic_visit(node)
+
+    def _check_env_key(self, node: ast.AST, key: ast.expr) -> None:
+        if (
+            isinstance(key, ast.Constant)
+            and isinstance(key.value, str)
+            and key.value.startswith("APP_")
+        ):
+            self._flag(
+                node,
+                "env-bypass",
+                f"{key.value} read bypasses config.py; add a Config field "
+                "so from_env and docs/configuration.md stay authoritative",
+            )
+
+
+def _lint_one(source: str, path: str) -> _Linter:
+    tree = ast.parse(source, filename=path)
+    linter = _Linter(path, collect_aliases(tree))
+    linter.visit(tree)
+    return linter
+
+
+def _metric_violations(
+    linter: _Linter, docs_text: str | None
+) -> list[Violation]:
+    if docs_text is None:
+        return []
+    return [
+        Violation(
+            path=linter.path,
+            line=line,
+            rule="undocumented-metric",
+            message=(
+                f"{name} is registered here but not documented "
+                "in docs/observability.md"
+            ),
+        )
+        for name, line in linter.metric_sites
+        if name not in docs_text
+    ]
+
+
+def lint_source(
+    source: str, path: str = "<memory>", docs_text: str | None = None
+) -> list[Violation]:
+    """Lint one source blob. ``docs_text`` enables the undocumented-metric
+    rule (None skips it — unit-testing the other rules shouldn't require a
+    docs corpus)."""
+    linter = _lint_one(source, path)
+    violations = linter.violations + _metric_violations(linter, docs_text)
+    violations.sort(key=lambda v: (v.path, v.line, v.rule))
+    return violations
+
+
+def lint_paths(
+    root: Path | str = PACKAGE_ROOT,
+    packages: tuple[str, ...] = DEFAULT_PACKAGES,
+    docs_path: Path | str | None = DEFAULT_DOCS,
+    suppressions: tuple[Suppression, ...] = SUPPRESSIONS,
+) -> LintReport:
+    """Lint the control-plane packages, apply the suppression list, and
+    report what remains — the tier-1 entry point."""
+    root = Path(root)
+    docs_text: str | None = None
+    if docs_path is not None:
+        docs = Path(docs_path)
+        docs_text = docs.read_text() if docs.exists() else ""
+    report = LintReport()
+    all_violations: list[Violation] = []
+    for package in packages:
+        for py in sorted((root / package).rglob("*.py")):
+            rel = str(py.relative_to(root.parent))
+            linter = _lint_one(py.read_text(), rel)
+            all_violations.extend(linter.violations)
+            all_violations.extend(_metric_violations(linter, docs_text))
+            report.metric_names.update(name for name, _ in linter.metric_sites)
+    used: set[Suppression] = set()
+    for v in all_violations:
+        match = next((s for s in suppressions if s.matches(v)), None)
+        if match is None:
+            report.violations.append(v)
+        else:
+            used.add(match)
+            report.suppressed.append((v, match))
+    report.stale_suppressions = [s for s in suppressions if s not in used]
+    report.violations.sort(key=lambda v: (v.path, v.line, v.rule))
+    return report

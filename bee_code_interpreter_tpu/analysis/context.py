@@ -1,0 +1,39 @@
+"""Ambient carrier for edge dep predictions (docs/analysis.md).
+
+The edge's single AST pass over a submission predicts the PyPI deps the
+sandbox would otherwise discover with its own scan. That prediction must
+reach the data plane without rewriting the ``CodeExecutor`` protocol and
+every resilience front stacked on it — so, like the per-execution transfer
+accounting, it rides the task context: the API edge stashes it right after
+analysis, and whichever driver ends up talking to the sandbox (the HTTP
+data-plane driver for pod/native backends, the in-process local executor)
+reads it from the same context.
+
+contextvars make this per-request by construction: each HTTP/gRPC handler
+runs in its own task, and tasks the resilience layer spawns (hedges,
+replays) copy the context at creation — a prediction can never bleed into
+another request.
+"""
+
+from __future__ import annotations
+
+from contextvars import ContextVar
+
+_predicted_deps: ContextVar[tuple[str, ...] | None] = ContextVar(
+    "bci_predicted_deps", default=None
+)
+
+
+def stash_predicted_deps(deps: list[str] | tuple[str, ...] | None) -> None:
+    """Attach the edge's dep prediction to the current request context.
+    ``None`` clears it — "no claim made", which the sandbox treats as
+    "run your own scan". An EMPTY list is different: it is stashed as an
+    empty tuple, the positive claim "the edge scanned and there is
+    nothing to install", which makes the sandbox skip its scan."""
+    _predicted_deps.set(tuple(deps) if deps is not None else None)
+
+
+def predicted_deps() -> list[str] | None:
+    """The ambient prediction, or None when the edge didn't analyze."""
+    deps = _predicted_deps.get()
+    return list(deps) if deps is not None else None

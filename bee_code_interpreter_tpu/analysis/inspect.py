@@ -1,0 +1,231 @@
+"""Single-pass AST inspection of submitted source (docs/analysis.md).
+
+The edge pays for every admitted submission with a warm single-use sandbox,
+even when the code can never run. ``inspect_source`` is the one AST pass
+that prevents that: parse once, and from the same tree collect everything
+the edge decides on —
+
+- **syntax validity**, with the error rendered in the exact shape the
+  in-sandbox interpreter would have printed to stderr (``File``/caret/
+  ``SyntaxError`` lines), so a fail-fast response is indistinguishable in
+  format from a sandbox run that died at parse;
+- **imports**, truncated by the same namespace-package rules the dep
+  guesser uses (``runtime/dep_guess.py`` — this module feeds the parsed
+  tree straight into it, so the edge never re-parses to predict deps);
+- **call sites**, resolved through import aliases to dotted names
+  (``import subprocess as sp; sp.run(...)`` resolves to
+  ``subprocess.run``) with "inside a loop" marked, so the policy engine
+  can match call *shapes* (``os.fork`` loops), not just names;
+- **absolute path literals**, for path-prefix policy rules.
+
+The alias-resolution machinery is shared with ``analysis/asynclint.py`` —
+the same inspection that gates workloads lints our own control plane.
+"""
+
+from __future__ import annotations
+
+import ast
+import traceback
+from dataclasses import dataclass, field
+
+from bee_code_interpreter_tpu.runtime import dep_guess
+
+# The sandbox writes the submission to <tempdir>/script.py and execs it
+# (runtime/executor_core.py); rendering the edge's syntax error against the
+# same basename keeps the two stderr shapes aligned.
+SCRIPT_FILENAME = "script.py"
+
+
+@dataclass(frozen=True)
+class CallSite:
+    """One resolved call: ``name`` is the alias-resolved dotted target."""
+
+    name: str
+    line: int
+    in_loop: bool
+
+
+@dataclass
+class SourceInspection:
+    """Everything one parse of a submission yields. When ``syntax_error``
+    is set, the collections are empty — there is no tree to walk. When
+    ``analysis_error`` is set the parse itself blew a resource limit
+    (RecursionError/MemoryError on a degenerate-but-maybe-valid program):
+    the edge could not analyze, which is NOT the same as "the sandbox
+    would refuse it" — the policy layer decides what that means."""
+
+    syntax_error: str | None = None  # rendered stderr, in-sandbox shape
+    analysis_error: str | None = None  # parse blew a limit; no claims made
+    imports: set[str] = field(default_factory=set)
+    calls: list[CallSite] = field(default_factory=list)
+    path_literals: set[str] = field(default_factory=set)
+    predicted_deps: list[str] = field(default_factory=list)
+
+    def call_names(self) -> set[str]:
+        return {c.name for c in self.calls}
+
+
+def render_syntax_error(exc: SyntaxError) -> str:
+    """The stderr a ``python script.py`` run of this source would have
+    produced: CPython prints exactly the ``File``/source-line/caret/
+    ``SyntaxError:`` block for a parse failure (no ``Traceback`` header),
+    which is what ``format_exception_only`` renders for SyntaxError."""
+    return "".join(traceback.format_exception_only(type(exc), exc))
+
+
+def collect_aliases(tree: ast.AST) -> dict[str, str]:
+    """{local name: dotted target} for every import binding in the tree —
+    ``import a.b`` binds ``a``→``a``, ``import a.b as c`` binds ``c``→``a.b``,
+    ``from a import b as c`` binds ``c``→``a.b``. Relative imports resolve
+    to nothing useful for policy and are skipped."""
+    aliases: dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                if alias.asname:
+                    aliases[alias.asname] = alias.name
+                else:
+                    aliases[alias.name.split(".", 1)[0]] = alias.name.split(
+                        ".", 1
+                    )[0]
+        elif isinstance(node, ast.ImportFrom) and node.level == 0 and node.module:
+            for alias in node.names:
+                aliases[alias.asname or alias.name] = (
+                    f"{node.module}.{alias.name}"
+                )
+    return aliases
+
+
+def resolve_call_name(
+    func: ast.expr, aliases: dict[str, str] | None = None
+) -> str | None:
+    """Dotted name of a call target, resolved through import aliases.
+    ``None`` when the root isn't a plain name (``self.x()``, ``f()()``,
+    subscripts) — those can't be matched against a module-path policy and
+    must not be guessed at."""
+    parts: list[str] = []
+    node = func
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if not isinstance(node, ast.Name):
+        return None
+    root = node.id
+    if aliases and root in aliases:
+        root = aliases[root]
+    parts.append(root)
+    return ".".join(reversed(parts))
+
+
+_COMPREHENSION_NODES = (ast.ListComp, ast.SetComp, ast.DictComp, ast.GeneratorExp)
+_FUNCTION_NODES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+
+def _walk_calls(tree: ast.AST, aliases: dict[str, str]) -> list[CallSite]:
+    """Call sites with loop context: a call lexically inside a For/While/
+    comprehension body is ``in_loop``. Entering a nested function resets the
+    loop context (the def executes in the loop; its body only runs when
+    called) — a deliberate under-approximation that keeps ``deny`` rules
+    free of false positives.
+
+    Iterative on an explicit stack: ``ast.parse`` accepts expressions far
+    deeper than the interpreter's recursion limit (a 2 KB ``----…x`` chain
+    is a valid program), and the edge gate must never blow the stack on
+    source the sandbox would happily run."""
+    calls: list[CallSite] = []
+    stack: list[tuple[ast.AST, int]] = [(tree, 0)]
+    while stack:
+        node, loop_depth = stack.pop()
+        if isinstance(node, ast.Call):
+            name = resolve_call_name(node.func, aliases)
+            if name is not None:
+                calls.append(
+                    CallSite(
+                        name=name,
+                        line=getattr(node, "lineno", 0),
+                        in_loop=loop_depth > 0,
+                    )
+                )
+        if isinstance(node, (ast.For, ast.AsyncFor)):
+            # The iterable (and target) evaluate ONCE before iteration and
+            # the else-suite ONCE after it — only the body repeats.
+            stack.append((node.target, loop_depth))
+            stack.append((node.iter, loop_depth))
+            stack.extend((child, loop_depth) for child in node.orelse)
+            stack.extend((child, loop_depth + 1) for child in node.body)
+            continue
+        if isinstance(node, ast.While):
+            # The test re-evaluates every iteration; the else-suite runs
+            # at most once.
+            stack.append((node.test, loop_depth + 1))
+            stack.extend((child, loop_depth) for child in node.orelse)
+            stack.extend((child, loop_depth + 1) for child in node.body)
+            continue
+        if isinstance(node, _COMPREHENSION_NODES):
+            # The OUTERMOST iterable evaluates once, eagerly, in the
+            # enclosing scope; the element expression, conditions, and
+            # inner generators run per element.
+            for i, gen in enumerate(node.generators):
+                stack.append((gen.iter, loop_depth if i == 0 else loop_depth + 1))
+                stack.append((gen.target, loop_depth + 1))
+                stack.extend((cond, loop_depth + 1) for cond in gen.ifs)
+            if isinstance(node, ast.DictComp):
+                stack.append((node.key, loop_depth + 1))
+                stack.append((node.value, loop_depth + 1))
+            else:
+                stack.append((node.elt, loop_depth + 1))
+            continue
+        next_depth = 0 if isinstance(node, _FUNCTION_NODES) else loop_depth
+        stack.extend(
+            (child, next_depth) for child in ast.iter_child_nodes(node)
+        )
+    return calls
+
+
+def _path_literals(tree: ast.AST) -> set[str]:
+    """Absolute-path-looking string constants (policy path rules key on
+    prefixes, so only rooted literals matter). Multi-line strings and
+    anything space-separated are prose, not paths."""
+    out: set[str] = set()
+    for node in ast.walk(tree):
+        if (
+            isinstance(node, ast.Constant)
+            and isinstance(node.value, str)
+            and node.value.startswith("/")
+            and len(node.value) > 1
+            and len(node.value) <= 256
+            and not any(ch.isspace() for ch in node.value)
+        ):
+            out.add(node.value)
+    return out
+
+
+def inspect_source(source_code: str) -> SourceInspection:
+    """ONE parse of a submission; everything the edge decides on comes off
+    the same tree. Syntax errors short-circuit with the rendered stderr."""
+    # CPython's FILE tokenizer treats NUL as end-of-input: the sandbox
+    # executes everything BEFORE the first null byte and ignores the rest
+    # (verified against this image's interpreter). ``ast.parse`` on a
+    # string instead raises ValueError — so truncate exactly the way the
+    # sandbox will, and the analysis describes precisely what would run
+    # (a null byte can't smuggle a denied import past the gate, nor 500
+    # a request the sandbox would accept).
+    if "\x00" in source_code:
+        source_code = source_code[: source_code.index("\x00")]
+    try:
+        tree = ast.parse(source_code, filename=SCRIPT_FILENAME)
+    except SyntaxError as e:
+        return SourceInspection(syntax_error=render_syntax_error(e))
+    except (RecursionError, MemoryError, ValueError) as e:
+        # Degenerate-but-parseable-in-C programs (100k-deep unary chains)
+        # can blow ast.parse's Python-object construction where the
+        # sandbox's compile() might survive. The edge makes NO claim here
+        # — never a 500; the policy layer decides refuse-vs-proceed.
+        return SourceInspection(analysis_error=repr(e))
+    imports = dep_guess.guessed_imports_from_tree(tree)
+    return SourceInspection(
+        imports=imports,
+        calls=_walk_calls(tree, collect_aliases(tree)),
+        path_literals=_path_literals(tree),
+        predicted_deps=dep_guess.dependencies_for_imports(imports),
+    )

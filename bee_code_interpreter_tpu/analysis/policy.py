@@ -1,0 +1,418 @@
+"""Config-declared workload policy + the edge analyzer (docs/analysis.md).
+
+The :class:`PolicyEngine` evaluates one :class:`~.inspect.SourceInspection`
+against operator-declared rules (``APP_POLICY_DENY_IMPORTS``,
+``APP_POLICY_DENY_CALLS``, … — comma-separated, parsed here) plus built-in
+call *shapes* the lists can name:
+
+- ``fork_in_loop``  — ``os.fork``/``os.forkpty`` inside a loop body
+- ``raw_socket``    — direct socket construction/connection
+- ``subprocess``    — any ``subprocess.*`` entry point or the ``os`` spawn
+                      family (``os.system``, ``os.popen``, ``os.exec*``,
+                      ``os.spawn*``)
+
+Severities: ``deny`` findings reject the request at the edge (HTTP 422 /
+gRPC INVALID_ARGUMENT — a client fault, SLI-good on both transports, per
+the convention docs/observability.md "SLOs" establishes); ``warn`` findings
+annotate the response and count a metric, but the execution proceeds.
+
+:class:`WorkloadAnalyzer` is the piece the API edges hold: one call runs
+the single AST pass, evaluates policy, predicts deps, and accounts all of
+it (``analysis`` stage span, ``bci_analysis_seconds``,
+``bci_analysis_rejections_total{rule}``,
+``bci_analysis_dep_predictions_total``).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+
+from bee_code_interpreter_tpu.analysis.inspect import (
+    SourceInspection,
+    inspect_source,
+)
+from bee_code_interpreter_tpu.observability import span
+
+# bci_analysis_seconds buckets: the gate budget is sub-millisecond (the
+# acceptance bound is < 1ms p50 added to the warm path), so the default
+# request buckets (50ms+) would put every observation in the first bucket.
+ANALYSIS_BUCKETS = (
+    0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+)
+
+_FORK_CALLS = frozenset({"os.fork", "os.forkpty"})
+_RAW_SOCKET_CALLS = frozenset(
+    {"socket.socket", "socket.create_connection", "socket.socketpair"}
+)
+_OS_EXEC_PREFIXES = ("os.exec", "os.spawn", "os.posix_spawn")
+
+
+def _shape_fork_in_loop(inspection: SourceInspection) -> list[int]:
+    return [c.line for c in inspection.calls if c.name in _FORK_CALLS and c.in_loop]
+
+
+def _shape_raw_socket(inspection: SourceInspection) -> list[int]:
+    return [c.line for c in inspection.calls if c.name in _RAW_SOCKET_CALLS]
+
+
+def _shape_subprocess(inspection: SourceInspection) -> list[int]:
+    return [
+        c.line
+        for c in inspection.calls
+        if c.name.startswith("subprocess.")
+        or c.name in ("os.system", "os.popen")
+        or c.name.startswith(_OS_EXEC_PREFIXES)
+    ]
+
+
+# Shape name → detector returning the offending line numbers. Shape names
+# are valid entries in the call-policy lists alongside dotted call names.
+SHAPES = {
+    "fork_in_loop": _shape_fork_in_loop,
+    "raw_socket": _shape_raw_socket,
+    "subprocess": _shape_subprocess,
+}
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One policy hit. ``rule`` is CATEGORICAL — it becomes the Prometheus
+    label on ``bci_analysis_rejections_total`` and is bounded by the size
+    of the operator's policy lists, never by request content."""
+
+    rule: str  # "import:socket" | "call:os.fork" | "shape:subprocess" | "path:/etc" | "syntax"
+    severity: str  # "deny" | "warn"
+    message: str
+
+    def to_dict(self) -> dict:
+        return {"rule": self.rule, "severity": self.severity, "message": self.message}
+
+
+def split_patterns(raw: str | None) -> tuple[str, ...]:
+    """Comma-separated config string → pattern tuple (the same spelling
+    convention as ``APP_SLO_LATENCY_MS``)."""
+    if not raw:
+        return ()
+    return tuple(p.strip() for p in raw.split(",") if p.strip())
+
+
+def _import_matches(pattern: str, imported: str) -> bool:
+    """``socket`` matches ``socket`` and ``socket.anything``; dotted
+    patterns (``google.auth``) match that subtree only."""
+    return imported == pattern or imported.startswith(pattern + ".") or (
+        "." not in pattern and imported.split(".", 1)[0] == pattern
+    )
+
+
+def _call_matches(pattern: str, call: str) -> bool:
+    """Exact dotted name, or a ``pkg.*`` prefix wildcard."""
+    if pattern.endswith(".*"):
+        return call.startswith(pattern[:-1])
+    return call == pattern
+
+
+def _path_matches(pattern: str, literal: str) -> bool:
+    return literal == pattern or literal.startswith(pattern.rstrip("/") + "/")
+
+
+class PolicyEngine:
+    """Declared rules evaluated over one inspection. Construction validates
+    nothing beyond shape-name spelling — an unknown shape in a call list is
+    treated as a dotted name, which simply never matches; the analyze CLI
+    (scripts/analyze.py) is the place to eyeball a policy."""
+
+    def __init__(
+        self,
+        deny_imports: tuple[str, ...] = (),
+        warn_imports: tuple[str, ...] = (),
+        deny_calls: tuple[str, ...] = (),
+        warn_calls: tuple[str, ...] = (),
+        deny_paths: tuple[str, ...] = (),
+        warn_paths: tuple[str, ...] = (),
+    ) -> None:
+        self.deny_imports = tuple(deny_imports)
+        self.warn_imports = tuple(warn_imports)
+        self.deny_calls = tuple(deny_calls)
+        self.warn_calls = tuple(warn_calls)
+        self.deny_paths = tuple(deny_paths)
+        self.warn_paths = tuple(warn_paths)
+
+    @classmethod
+    def from_config(cls, config) -> "PolicyEngine":
+        return cls(
+            deny_imports=split_patterns(config.policy_deny_imports),
+            warn_imports=split_patterns(config.policy_warn_imports),
+            deny_calls=split_patterns(config.policy_deny_calls),
+            warn_calls=split_patterns(config.policy_warn_calls),
+            deny_paths=split_patterns(config.policy_deny_paths),
+            warn_paths=split_patterns(config.policy_warn_paths),
+        )
+
+    @property
+    def declared(self) -> bool:
+        return any(
+            (
+                self.deny_imports, self.warn_imports, self.deny_calls,
+                self.warn_calls, self.deny_paths, self.warn_paths,
+            )
+        )
+
+    def unanalyzable_findings(self, reason: str) -> list[Finding]:
+        """What an unanalyzable submission (parse blew a limit, or the
+        source exceeds the analyzable-size bound) means under THIS policy:
+        fail-closed when any rule is declared — a degenerate program must
+        not become a policy bypass — nothing otherwise. Shared by the
+        analyzer and the scripts/analyze.py dry run, so they can never
+        disagree."""
+        if not self.declared:
+            return []
+        return [
+            Finding(
+                rule="unanalyzable",
+                severity="deny",
+                message=(
+                    f"source could not be analyzed ({reason}); a policy is "
+                    "declared, so it cannot be admitted unchecked"
+                ),
+            )
+        ]
+
+    def evaluate(self, inspection: SourceInspection) -> list[Finding]:
+        findings: list[Finding] = []
+        for severity, imports, calls, paths in (
+            ("deny", self.deny_imports, self.deny_calls, self.deny_paths),
+            ("warn", self.warn_imports, self.warn_calls, self.warn_paths),
+        ):
+            for pattern in imports:
+                hits = sorted(
+                    i for i in inspection.imports if _import_matches(pattern, i)
+                )
+                if hits:
+                    findings.append(
+                        Finding(
+                            rule=f"import:{pattern}",
+                            severity=severity,
+                            message=f"import of {', '.join(hits)} is not allowed",
+                        )
+                    )
+            for pattern in calls:
+                if pattern in SHAPES:
+                    lines = SHAPES[pattern](inspection)
+                    if lines:
+                        findings.append(
+                            Finding(
+                                rule=f"shape:{pattern}",
+                                severity=severity,
+                                message=(
+                                    f"call shape {pattern} at line(s) "
+                                    f"{', '.join(str(n) for n in sorted(lines))}"
+                                ),
+                            )
+                        )
+                    continue
+                lines = sorted(
+                    c.line
+                    for c in inspection.calls
+                    if _call_matches(pattern, c.name)
+                )
+                if lines:
+                    findings.append(
+                        Finding(
+                            rule=f"call:{pattern}",
+                            severity=severity,
+                            message=(
+                                f"call to {pattern} at line(s) "
+                                f"{', '.join(str(n) for n in lines)}"
+                            ),
+                        )
+                    )
+            for pattern in paths:
+                hits = sorted(
+                    p
+                    for p in inspection.path_literals
+                    if _path_matches(pattern, p)
+                )
+                if hits:
+                    findings.append(
+                        Finding(
+                            rule=f"path:{pattern}",
+                            severity=severity,
+                            message=(
+                                f"path literal(s) under {pattern}: "
+                                f"{', '.join(hits)}"
+                            ),
+                        )
+                    )
+        return findings
+
+
+@dataclass
+class AnalysisVerdict:
+    """What one edge analysis decided. Exactly one of three outcomes:
+    ``syntax_error`` set (fail-fast as a normal exit_code=1 response),
+    ``denials`` non-empty (reject 422/INVALID_ARGUMENT), or proceed —
+    possibly with warnings annotated and deps predicted.
+
+    ``predicted_deps`` distinguishes "no claim" (``None`` — the source
+    was unanalyzable, the sandbox must run its own scan) from the
+    positive claim "scanned, install exactly this" (a list, possibly
+    empty)."""
+
+    syntax_error: str | None
+    denials: list[Finding]
+    warnings: list[Finding]
+    predicted_deps: list[str] | None
+
+    def annotation(self) -> dict | None:
+        """The response-side ``analysis`` block: present only when there is
+        something to say (warnings or a non-empty dep prediction) so the
+        common path stays byte-identical to the pre-analysis contract."""
+        out: dict = {}
+        if self.warnings:
+            out["warnings"] = [f.to_dict() for f in self.warnings]
+        if self.predicted_deps:
+            out["predicted_deps"] = list(self.predicted_deps)
+        return out or None
+
+    def denial_detail(self) -> str:
+        return "; ".join(f"{f.rule}: {f.message}" for f in self.denials)
+
+
+class WorkloadAnalyzer:
+    """The pre-flight gate both API edges run before any sandbox is
+    touched. One instance per process (the composition root builds it from
+    config and shares it, like the tracer)."""
+
+    # Analysis is sub-ms for real submissions but runs ON the event loop;
+    # parsing a multi-MB body would stall every in-flight request, so
+    # longer sources are "unanalyzable" without ever being parsed.
+    DEFAULT_MAX_SOURCE_BYTES = 262_144
+
+    def __init__(
+        self,
+        policy: PolicyEngine | None = None,
+        metrics=None,
+        max_source_bytes: int | None = None,
+    ) -> None:
+        self._policy = policy or PolicyEngine()
+        self._max_source_bytes = (
+            max_source_bytes
+            if max_source_bytes is not None
+            else self.DEFAULT_MAX_SOURCE_BYTES
+        )
+        self._seconds = None
+        self._rejections_total = None
+        self._warnings_total = None
+        self._dep_predictions_total = None
+        if metrics is not None:
+            self._seconds = metrics.histogram(
+                "bci_analysis_seconds",
+                "Edge static-analysis latency per submission",
+                buckets=ANALYSIS_BUCKETS,
+            )
+            self._rejections_total = metrics.counter(
+                "bci_analysis_rejections_total",
+                "Submissions refused at the edge (syntax fail-fast + policy deny), by rule",
+            )
+            self._warnings_total = metrics.counter(
+                "bci_analysis_warnings_total",
+                "Policy warn findings annotated on responses, by rule",
+            )
+            self._dep_predictions_total = metrics.counter(
+                "bci_analysis_dep_predictions_total",
+                "PyPI dependencies predicted at the edge and shipped to the sandbox",
+            )
+
+    @classmethod
+    def from_config(cls, config, metrics=None) -> "WorkloadAnalyzer | None":
+        """The instance the composition root wires, or None when the edge
+        gate is switched off (``APP_ANALYSIS_ENABLED=false``)."""
+        if not config.analysis_enabled:
+            return None
+        return cls(
+            policy=PolicyEngine.from_config(config),
+            metrics=metrics,
+            max_source_bytes=config.analysis_max_source_bytes,
+        )
+
+    @property
+    def policy(self) -> PolicyEngine:
+        return self._policy
+
+    def analyze(self, source_code: str) -> AnalysisVerdict:
+        """One submission through parse → policy → dep prediction, traced
+        as the ``analysis`` stage and timed into ``bci_analysis_seconds``."""
+        t0 = time.monotonic()
+        with span("analysis") as s:
+            if len(source_code) > self._max_source_bytes:
+                inspection = SourceInspection(
+                    analysis_error=(
+                        f"source is {len(source_code)} chars, over the "
+                        f"{self._max_source_bytes}-byte analysis bound"
+                    )
+                )
+            else:
+                inspection = inspect_source(source_code)
+            if inspection.syntax_error is not None:
+                verdict = AnalysisVerdict(
+                    syntax_error=inspection.syntax_error,
+                    denials=[],
+                    warnings=[],
+                    predicted_deps=[],
+                )
+            elif inspection.analysis_error is not None:
+                # The edge can make NO claim about this source (parse blew
+                # a limit, or it is over the size bound): fail-closed under
+                # a declared policy, else proceed to the sandbox with
+                # prediction None so the in-pod scan runs as before the
+                # gate existed.
+                verdict = AnalysisVerdict(
+                    syntax_error=None,
+                    denials=self._policy.unanalyzable_findings(
+                        inspection.analysis_error
+                    ),
+                    warnings=[],
+                    predicted_deps=None,
+                )
+            else:
+                findings = self._policy.evaluate(inspection)
+                verdict = AnalysisVerdict(
+                    syntax_error=None,
+                    denials=[f for f in findings if f.severity == "deny"],
+                    warnings=[f for f in findings if f.severity == "warn"],
+                    predicted_deps=inspection.predicted_deps,
+                )
+            if s is not None:
+                if verdict.syntax_error is not None:
+                    s.attributes["analysis.outcome"] = "syntax_error"
+                elif verdict.denials:
+                    s.attributes["analysis.outcome"] = "deny"
+                    s.attributes["analysis.rules"] = ",".join(
+                        f.rule for f in verdict.denials
+                    )
+                elif inspection.analysis_error is not None:
+                    s.attributes["analysis.outcome"] = "unanalyzable"
+                else:
+                    s.attributes["analysis.outcome"] = "ok"
+                if verdict.warnings:
+                    s.attributes["analysis.warnings"] = ",".join(
+                        f.rule for f in verdict.warnings
+                    )
+                if verdict.predicted_deps:
+                    s.attributes["analysis.predicted_deps"] = ",".join(
+                        verdict.predicted_deps
+                    )
+        if self._seconds is not None:
+            self._seconds.observe(time.monotonic() - t0)
+        if self._rejections_total is not None:
+            if verdict.syntax_error is not None:
+                self._rejections_total.inc(rule="syntax")
+            for f in verdict.denials:
+                self._rejections_total.inc(rule=f.rule)
+        if self._warnings_total is not None:
+            for f in verdict.warnings:
+                self._warnings_total.inc(rule=f.rule)
+        if self._dep_predictions_total is not None and verdict.predicted_deps:
+            self._dep_predictions_total.inc(len(verdict.predicted_deps))
+        return verdict

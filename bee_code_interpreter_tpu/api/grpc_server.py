@@ -17,6 +17,7 @@ from __future__ import annotations
 import asyncio
 import json
 import logging
+import textwrap
 import time
 from contextlib import nullcontext
 
@@ -25,6 +26,7 @@ import grpc.aio
 from google.protobuf import descriptor_pb2, descriptor_pool
 from pydantic import ValidationError
 
+from bee_code_interpreter_tpu.analysis import stash_predicted_deps
 from bee_code_interpreter_tpu.api import models as api_models
 from bee_code_interpreter_tpu.observability import (
     FleetJournal,
@@ -113,6 +115,7 @@ class CodeInterpreterServicer:
         tracer: Tracer | None = None,
         drain=None,  # resilience.DrainController
         slo=None,  # observability.SloEngine (shared with the HTTP edge)
+        analyzer=None,  # analysis.WorkloadAnalyzer (shared with the HTTP edge)
     ) -> None:
         self._code_executor = code_executor
         self._custom_tool_executor = custom_tool_executor
@@ -120,6 +123,7 @@ class CodeInterpreterServicer:
         self._request_deadline_s = request_deadline_s
         self._drain = drain
         self._slo = slo
+        self._analyzer = analyzer
         self._tracer = tracer or Tracer(metrics=metrics)
         self._deadline_exceeded_total = (
             metrics.counter(
@@ -287,6 +291,42 @@ class CodeInterpreterServicer:
         logger.info("Executing code: %s", validated.source_code)
 
         async def run(deadline):
+            # Per-request reset (mirror of the HTTP edge): never let a
+            # prediction stashed earlier in this task's context describe
+            # THIS source.
+            stash_predicted_deps(None)
+            if self._analyzer is not None:
+                # The gate mirrors the HTTP edge exactly (docs/analysis.md):
+                # syntax errors answer as a normal exit_code=1 response with
+                # zero sandbox checkouts; policy denies abort
+                # INVALID_ARGUMENT (a client fault, SLI-good via the abort
+                # handling in _with_resilience); warn findings ride the
+                # trailing metadata (the proto response has no field for
+                # them) and the dep prediction ships with the data plane.
+                verdict = self._analyzer.analyze(validated.source_code)
+                if verdict.syntax_error is not None:
+                    return pb.ExecuteResponse(
+                        stdout="",
+                        stderr=verdict.syntax_error,
+                        exit_code=1,
+                    )
+                if verdict.denials:
+                    await context.abort(
+                        grpc.StatusCode.INVALID_ARGUMENT,
+                        f"denied by execution policy: {verdict.denial_detail()}",
+                    )
+                if verdict.warnings:
+                    context.set_trailing_metadata(
+                        (
+                            (
+                                "bci-analysis-warnings",
+                                "; ".join(
+                                    f.rule for f in verdict.warnings
+                                ),
+                            ),
+                        )
+                    )
+                stash_predicted_deps(verdict.predicted_deps)
             result = await self._code_executor.execute(
                 source_code=validated.source_code,
                 files=validated.files,
@@ -353,6 +393,22 @@ class CodeInterpreterServicer:
             env=dict(request.env),
         )
         async def run(deadline):
+            stash_predicted_deps(None)  # per-request reset, see Execute
+            if self._analyzer is not None:
+                # Policy half only, analyzed DEDENTED like the parser does
+                # (mirror of the HTTP edge): a syntax error in tool source
+                # keeps the parser's oneof-error contract, and no dep
+                # prediction is stashed — the sandbox runs the generated
+                # wrapper, whose imports the tool source doesn't mention.
+                verdict = self._analyzer.analyze(
+                    textwrap.dedent(validated.tool_source_code)
+                )
+                if verdict.syntax_error is None and verdict.denials:
+                    await context.abort(
+                        grpc.StatusCode.INVALID_ARGUMENT,
+                        "denied by execution policy: "
+                        f"{verdict.denial_detail()}",
+                    )
             try:
                 output = await self._custom_tool_executor.execute(
                     tool_source_code=validated.tool_source_code,
@@ -730,6 +786,7 @@ class GrpcServer:
         drain=None,  # resilience.DrainController
         slo=None,  # observability.SloEngine shared with the HTTP edge
         debug_bundle=None,  # callable -> dict (ApplicationContext builder)
+        analyzer=None,  # analysis.WorkloadAnalyzer shared with the HTTP edge
     ) -> None:
         self._servicer = CodeInterpreterServicer(
             code_executor,
@@ -740,6 +797,7 @@ class GrpcServer:
             tracer=tracer,
             drain=drain,
             slo=slo,
+            analyzer=analyzer,
         )
         self._slo = slo
         self._debug_bundle = debug_bundle

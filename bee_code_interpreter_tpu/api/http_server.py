@@ -31,6 +31,14 @@ serving-engine steps. ``GET /v1/slo`` reports error-budget burn rates,
 ``GET /v1/debug/bundle`` is the one-call incident snapshot, and
 ``GET /metrics`` serves OpenMetrics-with-exemplars when the scraper's
 ``Accept`` header asks for it.
+
+Edge static analysis (docs/analysis.md): when a ``WorkloadAnalyzer`` is
+wired in, every submission is parsed ONCE before any sandbox is touched —
+syntax errors return a normal ``ExecuteResponse`` (exit_code=1, stderr in
+the in-sandbox traceback shape) with ZERO sandbox checkouts, policy
+``deny`` findings reject as 422 (a client fault, SLI-good), ``warn``
+findings annotate the response, and the same pass pre-resolves deps for
+the sandbox to skip its own scan.
 """
 
 from __future__ import annotations
@@ -39,12 +47,14 @@ import asyncio
 import json
 import logging
 import math
+import textwrap
 import time
 from contextlib import nullcontext
 
 import pydantic
 from aiohttp import web
 
+from bee_code_interpreter_tpu.analysis import stash_predicted_deps
 from bee_code_interpreter_tpu.api import models
 from bee_code_interpreter_tpu.observability import (
     PROFILE_DIR_ENV,
@@ -104,6 +114,7 @@ def create_http_server(
     supervisor=None,  # resilience.PoolSupervisor, surfaced on /v1/fleet
     slo=None,  # observability.SloEngine for GET /v1/slo + SLI recording
     debug_bundle=None,  # callable -> dict (ApplicationContext.build_debug_bundle)
+    analyzer=None,  # analysis.WorkloadAnalyzer for the pre-flight code gate
 ) -> web.Application:
     app = web.Application(client_max_size=1 << 30)
     metrics = metrics or Registry()
@@ -264,6 +275,52 @@ def create_http_server(
         # parse. The deadline covers the body read too.
         async def run(deadline):
             req = await parse_body(request, models.ExecuteRequest)
+            # Clear any prediction left by a previous request: aiohttp serves
+            # sequential keep-alive requests on ONE connection task, so the
+            # contextvar would otherwise leak across requests.
+            stash_predicted_deps(None)
+            verdict = (
+                analyzer.analyze(req.source_code)
+                if analyzer is not None
+                else None
+            )
+            if verdict is not None:
+                if verdict.syntax_error is not None:
+                    # Fail-fast: the sandbox would have died at parse with
+                    # this exact stderr shape — answer it from the edge
+                    # without a pool checkout (the fleet journal stays
+                    # untouched; timings_ms carries only `analysis`).
+                    trace = current_trace()
+                    return web.json_response(
+                        models.ExecuteResponse(
+                            stdout="",
+                            stderr=verdict.syntax_error,
+                            exit_code=1,
+                            files={},
+                            trace_id=(
+                                trace.trace_id if trace is not None else None
+                            ),
+                            timings_ms=(
+                                trace.stage_ms() if trace is not None else None
+                            ),
+                        ).model_dump()
+                    )
+                if verdict.denials:
+                    logger.warning(
+                        "Request denied by policy: %s", verdict.denial_detail()
+                    )
+                    return web.json_response(
+                        {
+                            "detail": "Denied by execution policy",
+                            "violations": [
+                                f.to_dict() for f in verdict.denials
+                            ],
+                        },
+                        status=422,
+                    )
+                # The edge already scanned: ship the prediction with the
+                # data-plane call so the pod skips its own scan.
+                stash_predicted_deps(verdict.predicted_deps)
             logger.info("Executing code: %s", req.source_code)
             try:
                 result = await code_executor.execute(
@@ -294,6 +351,9 @@ def create_http_server(
                     **result.model_dump(),
                     trace_id=trace.trace_id if trace is not None else None,
                     timings_ms=trace.stage_ms() if trace is not None else None,
+                    analysis=(
+                        verdict.annotation() if verdict is not None else None
+                    ),
                 ).model_dump()
             )
 
@@ -305,6 +365,10 @@ def create_http_server(
 
         async def run(deadline):
             req = await parse_body(request, models.ProfileRequest)
+            # Profiled executions are not analyzed; clear any prediction a
+            # previous request on this connection task stashed so the pod
+            # scans THIS source itself.
+            stash_predicted_deps(None)
             if req.target == "serving":
                 if profiler is None:
                     return web.json_response(
@@ -381,6 +445,32 @@ def create_http_server(
     async def execute_custom_tool(request: web.Request) -> web.Response:
         async def run(deadline):
             req = await parse_body(request, models.ExecuteCustomToolRequest)
+            stash_predicted_deps(None)  # see execute(): per-request reset
+            if analyzer is not None:
+                # Tool sources get the policy half only, analyzed DEDENTED —
+                # the same preprocessing the parser applies, so a uniformly
+                # indented tool can't slip past the policy as a "syntax
+                # error". A real syntax error keeps the parser's 400 +
+                # error_messages contract (fail-fast skipped), and no dep
+                # prediction is stashed: the sandbox runs the generated
+                # wrapper (whose own imports, e.g. pydantic, the tool source
+                # doesn't mention), so the in-pod scan must still run.
+                verdict = analyzer.analyze(
+                    textwrap.dedent(req.tool_source_code)
+                )
+                if verdict.syntax_error is None and verdict.denials:
+                    logger.warning(
+                        "Tool denied by policy: %s", verdict.denial_detail()
+                    )
+                    return web.json_response(
+                        {
+                            "detail": "Denied by execution policy",
+                            "violations": [
+                                f.to_dict() for f in verdict.denials
+                            ],
+                        },
+                        status=422,
+                    )
             try:
                 output = await custom_tool_executor.execute(
                     tool_source_code=req.tool_source_code,

@@ -35,6 +35,11 @@ class ExecuteResponse(BaseModel):
     # data-plane byte deltas (schema in docs/observability.md). The same
     # figures appear as usage.* attributes on the request's root trace span.
     usage: dict | None = None
+    # Edge static-analysis annotation (docs/analysis.md): policy `warn`
+    # findings and the dep prediction shipped to the sandbox. Absent when
+    # the analyzer had nothing to say, so the common path's wire shape is
+    # unchanged.
+    analysis: dict | None = None
 
 
 class ProfileRequest(BaseModel):

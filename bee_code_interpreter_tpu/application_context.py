@@ -206,6 +206,16 @@ class ApplicationContext:
         )
 
     @cached_property
+    def analyzer(self):
+        """Edge static-analysis gate shared by both transports (None when
+        APP_ANALYSIS_ENABLED=false): one policy, one metrics surface, one
+        dep-prediction behavior — the two edges can never disagree about
+        what gets refused."""
+        from bee_code_interpreter_tpu.analysis import WorkloadAnalyzer
+
+        return WorkloadAnalyzer.from_config(self.config, metrics=self.metrics)
+
+    @cached_property
     def admission(self):
         """Edge admission gate shared by the HTTP and gRPC servers: one
         in-flight/queue budget for the whole service, not per transport."""
@@ -335,6 +345,7 @@ class ApplicationContext:
             supervisor=self.supervisor,
             slo=self.slo,
             debug_bundle=self.build_debug_bundle,
+            analyzer=self.analyzer,
         )
 
     @cached_property
@@ -355,4 +366,5 @@ class ApplicationContext:
             drain=self.drain,
             slo=self.slo,
             debug_bundle=self.build_debug_bundle,
+            analyzer=self.analyzer,
         )

@@ -184,6 +184,32 @@ class Config(BaseModel):
     # SLO sliding-window bucket coarseness; windows span 5m..6h.
     slo_window_bucket_s: float = Field(default=10.0, gt=0)
 
+    # --- edge static analysis (new; see docs/analysis.md) ---
+    # Master switch for the pre-flight code gate at both API edges: one AST
+    # pass per submission that fail-fasts syntax errors without consuming a
+    # warm sandbox, evaluates the policy below, and pre-resolves deps for
+    # the pod. Disable only to A/B the gate's cost.
+    analysis_enabled: bool = True
+    # The gate runs ON the event loop (it is sub-ms for real submissions);
+    # source longer than this is "unanalyzable" instead of being parsed —
+    # a multi-MB body must never stall every in-flight request for seconds.
+    # Unanalyzable = refused fail-closed when a policy is declared, admitted
+    # with the in-pod dep scan when none is (docs/analysis.md).
+    analysis_max_source_bytes: int = Field(default=262_144, ge=1)
+    # Policy rules, comma-separated (same spelling convention as
+    # APP_SLO_LATENCY_MS). Imports match top-level or dotted-subtree names
+    # ("socket", "google.auth"); calls match alias-resolved dotted names
+    # ("os.fork"), "pkg.*" wildcards, or built-in shape names
+    # (fork_in_loop / raw_socket / subprocess); paths match absolute-path
+    # literal prefixes ("/etc"). deny → HTTP 422 / gRPC INVALID_ARGUMENT
+    # (SLI-good client faults); warn → response annotation + metric.
+    policy_deny_imports: str | None = None
+    policy_warn_imports: str | None = None
+    policy_deny_calls: str | None = None
+    policy_warn_calls: str | None = None
+    policy_deny_paths: str | None = None
+    policy_warn_paths: str | None = None
+
     # --- object storage (reference config.py:74) ---
     file_storage_path: str = "./.tmp/files"
     # Optional TTL sweep of stored objects (the reference leaves cleanup to

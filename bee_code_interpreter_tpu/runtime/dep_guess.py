@@ -737,6 +737,13 @@ def guessed_imports(source_code: str) -> set[str]:
         tree = ast.parse(source_code)
     except SyntaxError:
         return set()
+    return guessed_imports_from_tree(tree)
+
+
+def guessed_imports_from_tree(tree: ast.AST) -> set[str]:
+    """:func:`guessed_imports` over an already-parsed tree — the edge-side
+    analyzer (``analysis/inspect.py``) makes ONE AST pass per submission and
+    feeds this from it rather than paying a second parse."""
     names: set[str] = set()
     for node in ast.walk(tree):
         if isinstance(node, ast.Import):
@@ -765,9 +772,21 @@ def guess_dependencies(
     (loaded from requirements.txt like the reference's REQUIREMENTS set,
     executor/server.rs:44-67).
     """
+    return dependencies_for_imports(
+        guessed_imports(source_code), preinstalled, extra_skip
+    )
+
+
+def dependencies_for_imports(
+    imports: set[str],
+    preinstalled: frozenset[str] | set[str] = frozenset(),
+    extra_skip: frozenset[str] | set[str] = frozenset(),
+) -> list[str]:
+    """The mapping half of :func:`guess_dependencies`, over an
+    already-collected import set (one shared AST pass at the edge)."""
     deps: set[str] = set()
     pre = {_normalize(p) for p in preinstalled}
-    for mod in guessed_imports(source_code):
+    for mod in imports:
         top = mod.split(".", 1)[0]
         if top in sys.stdlib_module_names or top in SKIP or top in extra_skip:
             continue
@@ -780,6 +799,41 @@ def guess_dependencies(
             continue
         deps.add(pkg)
     return sorted(deps)
+
+
+def filter_predicted(
+    predicted: list[str] | tuple[str, ...],
+    preinstalled: frozenset[str] | set[str] = frozenset(),
+    extra_skip: frozenset[str] | set[str] = frozenset(),
+) -> list[str]:
+    """Edge-predicted PyPI package names filtered against THIS sandbox's
+    preinstalled/skip sets — the pod-side half of edge dep pre-resolution
+    (docs/analysis.md): when the edge already ran the AST scan and shipped
+    its prediction with the execute call, the sandbox pays set lookups only,
+    never a second parse. The skip list still applies here (defense in
+    depth: a prediction must never clobber the pinned accelerator stack),
+    and so does THIS interpreter's stdlib: edge and sandbox can run
+    different Python versions, and a module that is stdlib HERE but not at
+    the edge (telnetlib across the 3.12 removal, say) arrives predicted as
+    an identity-mapped package name — installing an arbitrary same-named
+    PyPI dist would be a dependency-confusion bug, so it is dropped."""
+    pre = {_normalize(p) for p in preinstalled}
+    skip = {_normalize(s) for s in SKIP} | {_normalize(s) for s in extra_skip}
+    # SKIP names the *imports* of the pinned stack; their mapped dist names
+    # (torch, dm-haiku, orbax-checkpoint, …) must be refused too.
+    skip |= {
+        _normalize(PYPI_MAP[imp]) for imp in SKIP if imp in PYPI_MAP
+    }
+    stdlib = {name.lower() for name in sys.stdlib_module_names}
+    return sorted(
+        {
+            pkg
+            for pkg in predicted
+            if _normalize(pkg) not in pre | skip
+            and pkg.lower() not in stdlib
+            and pkg.lower().replace("-", "_") not in stdlib
+        }
+    )
 
 
 def _normalize(name: str) -> str:

@@ -165,9 +165,19 @@ class ExecutorCore:
 
     # ---- dependency install ----
 
-    async def ensure_dependencies(self, source_code: str) -> tuple[list[str], str]:
-        """Guess + install missing deps. Returns (installed, stderr_notes)."""
-        deps = dep_guess.guess_dependencies(source_code, self.preinstalled)
+    async def ensure_dependencies(
+        self, source_code: str, predicted_deps: list[str] | None = None
+    ) -> tuple[list[str], str]:
+        """Guess + install missing deps. Returns (installed, stderr_notes).
+
+        With an edge prediction attached to the request (docs/analysis.md),
+        the sandbox's own AST scan is skipped entirely — the prediction is
+        only re-filtered against THIS image's preinstalled/skip sets, which
+        the edge cannot know."""
+        if predicted_deps is not None:
+            deps = dep_guess.filter_predicted(predicted_deps, self.preinstalled)
+        else:
+            deps = dep_guess.guess_dependencies(source_code, self.preinstalled)
         deps = [d for d in deps if d not in self._installed_this_session]
         if not deps or self.disable_dep_install:
             return [], ""
@@ -250,6 +260,7 @@ class ExecutorCore:
         source_code: str,
         env: dict[str, str] | None = None,
         timeout_s: float | None = None,
+        predicted_deps: list[str] | None = None,
     ) -> ExecutionOutcome:
         env = env or {}
         timeout_s = timeout_s or self.default_timeout_s
@@ -258,7 +269,9 @@ class ExecutorCore:
         # part of what this execution cost the sandbox.
         meter = UsageMeter()
 
-        installed, pip_notes = await self.ensure_dependencies(source_code)
+        installed, pip_notes = await self.ensure_dependencies(
+            source_code, predicted_deps
+        )
 
         with tempfile.TemporaryDirectory(prefix="exec-") as td:
             script = Path(td) / "script.py"

@@ -115,6 +115,9 @@ def create_app(core: ExecutorCore, tracer: Tracer | None = None) -> web.Applicat
             source_code=body["source_code"],
             env=body.get("env") or {},
             timeout_s=body.get("timeout"),
+            # Edge dep pre-resolution (docs/analysis.md): with a prediction
+            # attached, the core skips its own AST scan.
+            predicted_deps=body.get("predicted_deps"),
         )
         logger.info("Sandboxed execution finished: exit_code=%s", outcome.exit_code)
         return web.json_response(

@@ -33,6 +33,7 @@ from contextlib import nullcontext
 
 import httpx
 
+from bee_code_interpreter_tpu.analysis.context import predicted_deps
 from bee_code_interpreter_tpu.config import Config
 from bee_code_interpreter_tpu.observability import (
     outbound_headers,
@@ -171,16 +172,24 @@ class ExecutorHttpDriver:
             kwargs["timeout"] = deadline.clamp(
                 kwargs.get("timeout", self._config.executor_http_timeout_s)
             )
+        body = {
+            "source_code": source_code,
+            "env": env,
+            "timeout": timeout_s,
+        }
+        # Edge dep pre-resolution (docs/analysis.md): when the API edge
+        # already ran its AST pass, its prediction rides the execute call so
+        # the sandbox pays set lookups instead of a second parse. Absent
+        # when no analyzer ran — the sandbox then scans as before.
+        deps = predicted_deps()
+        if deps is not None:
+            body["predicted_deps"] = deps
         with span("execute", addr=addr):
             async with self._data_plane_guard():
                 try:
                     response = await self._http.post(
                         f"http://{addr}/execute",
-                        json={
-                            "source_code": source_code,
-                            "env": env,
-                            "timeout": timeout_s,
-                        },
+                        json=body,
                         headers=outbound_headers(),
                         **kwargs,
                     )

@@ -584,9 +584,11 @@ class KubernetesCodeExecutor(ExecutorHttpDriver):
                 detail=(str(e) or type(e).__name__)[:200],
             )
             # Delete-on-failure (reference :242-246), for every member — also
-            # on cancellation (the deadline bound cancels a hung spawn).
+            # on cancellation (the deadline bound cancels a hung spawn). The
+            # deletions ride the background-task set so teardown can still
+            # observe them (asynclint: no dropped task handles).
             for pod_name in created:
-                asyncio.ensure_future(self._delete_pod(pod_name))
+                self._spawn_background(self._delete_pod(pod_name))
             if isinstance(e, DeadlineExceeded) or not isinstance(e, Exception):
                 # DeadlineExceeded and bare BaseExceptions (CancelledError,
                 # KeyboardInterrupt, SystemExit) must keep their type: wrapping

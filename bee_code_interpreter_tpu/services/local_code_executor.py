@@ -17,6 +17,7 @@ import secrets
 import shutil
 from pathlib import Path
 
+from bee_code_interpreter_tpu.analysis.context import predicted_deps
 from bee_code_interpreter_tpu.observability import span
 from bee_code_interpreter_tpu.resilience import Deadline
 from bee_code_interpreter_tpu.runtime.executor_core import ExecutorCore
@@ -113,7 +114,12 @@ class LocalCodeExecutor:
 
             with span("execute"):
                 outcome = await core.execute(
-                    source_code, env=env, timeout_s=self._clamp_timeout(timeout_s)
+                    source_code,
+                    env=env,
+                    timeout_s=self._clamp_timeout(timeout_s),
+                    # The edge's ambient dep prediction (docs/analysis.md)
+                    # reaches the in-process core directly — no wire hop.
+                    predicted_deps=predicted_deps(),
                 )
 
             # Snapshot changed files back (reference :126-142).

@@ -1,0 +1,163 @@
+#!/usr/bin/env python3
+"""Run the edge workload analyzer on a file or stdin (docs/analysis.md).
+
+The exact pass both API edges run before a submission can touch a warm
+sandbox: syntax fail-fast, policy findings, and the dep prediction — so an
+operator can dry-run a policy (or a user can see why the edge refused
+their code) without submitting anything.
+
+Usage:
+
+    python scripts/analyze.py payload.py
+    cat payload.py | python scripts/analyze.py -
+    python scripts/analyze.py payload.py --json
+    python scripts/analyze.py payload.py --deny-imports socket,ctypes \\
+        --deny-calls "subprocess,os.fork" --warn-calls "raw_socket"
+    python scripts/analyze.py --self-lint        # run the repo asynclint
+
+Without explicit --deny/--warn flags the policy comes from the same
+APP_POLICY_* environment the service reads, so a dry run matches what the
+deployed edge would decide. Exit codes: 0 clean (warnings included),
+1 syntax error, 2 policy deny, 3 self-lint violations.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+from bee_code_interpreter_tpu.analysis import (  # noqa: E402
+    PolicyEngine,
+    inspect_source,
+    split_patterns,
+)
+from bee_code_interpreter_tpu.config import Config  # noqa: E402
+
+
+def build_policy(args: argparse.Namespace) -> PolicyEngine:
+    flags = (
+        args.deny_imports, args.warn_imports, args.deny_calls,
+        args.warn_calls, args.deny_paths, args.warn_paths,
+    )
+    if any(f is not None for f in flags):
+        return PolicyEngine(
+            deny_imports=split_patterns(args.deny_imports),
+            warn_imports=split_patterns(args.warn_imports),
+            deny_calls=split_patterns(args.deny_calls),
+            warn_calls=split_patterns(args.warn_calls),
+            deny_paths=split_patterns(args.deny_paths),
+            warn_paths=split_patterns(args.warn_paths),
+        )
+    return PolicyEngine.from_config(Config.from_env())
+
+
+def render_table(rows: list[tuple[str, ...]], header: tuple[str, ...]) -> str:
+    widths = [
+        max(len(r[i]) for r in [header, *rows]) for i in range(len(header))
+    ]
+    def fmt(row: tuple[str, ...]) -> str:
+        return "  ".join(c.ljust(w) for c, w in zip(row, widths)).rstrip()
+    lines = [fmt(header), fmt(tuple("-" * w for w in widths))]
+    lines += [fmt(r) for r in rows]
+    return "\n".join(lines)
+
+
+def self_lint(as_json: bool) -> int:
+    from bee_code_interpreter_tpu.analysis import lint_paths
+
+    report = lint_paths()
+    if as_json:
+        print(
+            json.dumps(
+                {
+                    "violations": [vars(v) for v in report.violations],
+                    "suppressed": [
+                        {**vars(v), "reason": s.reason}
+                        for v, s in report.suppressed
+                    ],
+                    "stale_suppressions": [
+                        vars(s) for s in report.stale_suppressions
+                    ],
+                }
+            )
+        )
+    else:
+        print(report.summary())
+        if report.suppressed:
+            print(f"({len(report.suppressed)} suppressed with justification)")
+    return 0 if report.clean else 3
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(
+        description="Edge workload analyzer (docs/analysis.md)"
+    )
+    parser.add_argument("source", nargs="?", help="file to analyze, or - for stdin")
+    parser.add_argument("--json", action="store_true", help="machine-readable output")
+    parser.add_argument("--self-lint", action="store_true",
+                        help="run the repo asynclint instead of analyzing a payload")
+    for flag in ("deny-imports", "warn-imports", "deny-calls", "warn-calls",
+                 "deny-paths", "warn-paths"):
+        parser.add_argument(f"--{flag}", default=None,
+                            help=f"comma-separated {flag.replace('-', ' ')} patterns")
+    args = parser.parse_args()
+
+    if args.self_lint:
+        return self_lint(args.json)
+    if not args.source:
+        parser.error("source file (or -) required unless --self-lint")
+
+    source = (
+        sys.stdin.read()
+        if args.source == "-"
+        else Path(args.source).read_text()
+    )
+    inspection = inspect_source(source)
+    if inspection.syntax_error is not None:
+        if args.json:
+            print(json.dumps({"syntax_error": inspection.syntax_error}))
+        else:
+            sys.stderr.write(inspection.syntax_error)
+        return 1
+
+    policy = build_policy(args)
+    if inspection.analysis_error is not None:
+        # Mirror the deployed edge exactly (the docstring's promise):
+        # unanalyzable + declared policy = fail-closed deny.
+        findings = policy.unanalyzable_findings(inspection.analysis_error)
+    else:
+        findings = policy.evaluate(inspection)
+    if args.json:
+        print(
+            json.dumps(
+                {
+                    "findings": [f.to_dict() for f in findings],
+                    "imports": sorted(inspection.imports),
+                    "predicted_deps": inspection.predicted_deps,
+                }
+            )
+        )
+    else:
+        if findings:
+            print(
+                render_table(
+                    [(f.severity, f.rule, f.message) for f in findings],
+                    ("severity", "rule", "message"),
+                )
+            )
+        else:
+            print("no policy findings")
+        print(
+            "predicted deps: "
+            + (", ".join(inspection.predicted_deps) or "(none)")
+        )
+    return 2 if any(f.severity == "deny" for f in findings) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -27,6 +27,12 @@ wiring minus kubectl. Scenarios:
                             slowed) and every trace that missed the
                             collector is accounted in
                             bci_telemetry_dropped_total
+  9. edge analysis gate   — a flood of syntax-broken (and policy-denied)
+                            submissions through the REAL HTTP edge leaves
+                            the warm pool untouched: zero checkouts, pool
+                            depth and executions_total unchanged, and every
+                            refusal accounted in
+                            bci_analysis_rejections_total{rule}
 
 Exits nonzero if any scenario misbehaves. Usage:
 
@@ -382,6 +388,82 @@ async def main() -> int:
         finally:
             await pods4.close()
 
+        # 9. edge analysis gate: a flood of doomed submissions never touches
+        #    the warm pool (fresh registry for exact rejection accounting)
+        from aiohttp.test_utils import TestClient, TestServer
+
+        from bee_code_interpreter_tpu.analysis import (
+            PolicyEngine,
+            WorkloadAnalyzer,
+        )
+        from bee_code_interpreter_tpu.api.http_server import create_http_server
+        from bee_code_interpreter_tpu.services.custom_tool_executor import (
+            CustomToolExecutor,
+        )
+
+        m9 = Registry()
+        executor9, _, _, pods9 = make_stack(tmp, storage, m9, clock)
+        k8s9 = executor9.primary.primary  # unwrap resilient -> hedging -> pool
+        try:
+            k8s9._config.executor_pod_queue_target_length = 2
+            await k8s9.fill_executor_pod_queue()
+            ready_before = k8s9.pool_ready_count
+            execs_before = k8s9.journal.executions_total
+            app = create_http_server(
+                code_executor=executor9,
+                custom_tool_executor=CustomToolExecutor(code_executor=executor9),
+                metrics=m9,
+                analyzer=WorkloadAnalyzer(
+                    PolicyEngine(deny_imports=("socket",)), metrics=m9
+                ),
+            )
+            client = TestClient(TestServer(app))
+            await client.start_server()
+            n_syntax, n_deny = 24, 8
+            try:
+                statuses_ok = True
+                for i in range(n_syntax):
+                    resp = await client.post(
+                        "/v1/execute",
+                        json={"source_code": f"def broken{i}(:\n"},
+                    )
+                    body = await resp.json()
+                    statuses_ok &= (
+                        resp.status == 200
+                        and body["exit_code"] == 1
+                        and "SyntaxError" in body["stderr"]
+                    )
+                for _ in range(n_deny):
+                    resp = await client.post(
+                        "/v1/execute", json={"source_code": "import socket\n"}
+                    )
+                    statuses_ok &= resp.status == 422
+            finally:
+                await client.close()
+            report(
+                "doomed flood answered without a sandbox",
+                statuses_ok,
+                f"{n_syntax} syntax fail-fasts + {n_deny} policy denies",
+            )
+            report(
+                "warm pool untouched by the flood",
+                k8s9.pool_ready_count == ready_before
+                and k8s9.journal.executions_total == execs_before,
+                f"ready={k8s9.pool_ready_count} (was {ready_before}), "
+                f"executions_total={k8s9.journal.executions_total}",
+            )
+            rejections = m9.metrics["bci_analysis_rejections_total"]._values
+            syntax_n = rejections.get((("rule", "syntax"),), 0)
+            deny_n = rejections.get((("rule", "import:socket"),), 0)
+            report(
+                "every refusal accounted in bci_analysis_rejections_total",
+                syntax_n == n_syntax and deny_n == n_deny,
+                f"syntax={syntax_n:g}/{n_syntax} import:socket={deny_n:g}/{n_deny}",
+            )
+            dump_fleet("edge analysis gate", executor9)
+        finally:
+            await pods9.close()
+
         text = metrics.expose()
         wanted = [
             "bci_executor_fallback_total 1",
@@ -404,7 +486,8 @@ async def main() -> int:
         return 1
     print(
         "chaos smoke passed: deadline, breaker, fallback, admission, replay, "
-        "supervisor, watchdog, drain, telemetry export all behaved"
+        "supervisor, watchdog, drain, telemetry export, edge analysis gate "
+        "all behaved"
     )
     return 0
 

@@ -15,6 +15,10 @@ import os
 # platform and prepends it to jax_platforms even when the env var says cpu):
 # tests must run on the virtual 8-device CPU mesh.
 os.environ["JAX_PLATFORMS"] = "cpu"
+# grpc C-core INFO logs (GOAWAY notices on channel close) write straight to
+# stderr and can interleave into pytest's progress-dot stream, corrupting
+# dot-counting harnesses; only errors are worth hearing from the transport.
+os.environ.setdefault("GRPC_VERBOSITY", "ERROR")
 # Drop accelerator-tunnel plugin vars entirely: the dev box's TPU plugin hooks
 # jax backend init whenever its pool vars are visible — even under
 # JAX_PLATFORMS=cpu — and blocks on the (single-client) tunnel. Tests and

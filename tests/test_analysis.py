@@ -1,0 +1,350 @@
+"""Unit tests for the edge static-analysis subsystem (docs/analysis.md):
+the single-pass inspector, the policy engine, the dep pre-resolution, and
+the WorkloadAnalyzer's metrics/trace accounting."""
+
+import subprocess
+import sys
+
+import pytest
+
+from bee_code_interpreter_tpu.analysis import (
+    PolicyEngine,
+    WorkloadAnalyzer,
+    inspect_source,
+)
+from bee_code_interpreter_tpu.analysis.context import (
+    predicted_deps,
+    stash_predicted_deps,
+)
+from bee_code_interpreter_tpu.observability import Tracer
+from bee_code_interpreter_tpu.runtime import dep_guess
+from bee_code_interpreter_tpu.runtime.executor_core import ExecutorCore
+from bee_code_interpreter_tpu.utils.metrics import Registry
+
+# ---------------------------------------------------------------- inspect
+
+
+def test_syntax_error_matches_in_sandbox_stderr_shape(tmp_path):
+    """The fail-fast stderr must be the shape ``python script.py`` prints:
+    File line, source line, caret, final ``SyntaxError:`` line — compared
+    structurally against a REAL interpreter run of the same source."""
+    source = "def broken(:\n"
+    inspection = inspect_source(source)
+    assert inspection.syntax_error is not None
+
+    script = tmp_path / "script.py"
+    script.write_text(source)
+    real = subprocess.run(
+        [sys.executable, str(script)], capture_output=True, text=True
+    )
+    assert real.returncode == 1
+    real_lines = real.stderr.strip().splitlines()
+    edge_lines = inspection.syntax_error.strip().splitlines()
+    # same structure: File header first, SyntaxError verdict last
+    assert edge_lines[0].lstrip().startswith('File "')
+    assert real_lines[0].lstrip().startswith('File "')
+    assert edge_lines[-1] == real_lines[-1]  # identical SyntaxError line
+    assert any("^" in line for line in edge_lines)
+
+
+def test_inspection_collects_imports_calls_paths():
+    src = (
+        "import subprocess as sp\n"
+        "from os import fork\n"
+        "import socket\n"
+        "sp.run(['ls'])\n"
+        "while True:\n"
+        "    fork()\n"
+        "x = open('/etc/passwd').read()\n"
+    )
+    inspection = inspect_source(src)
+    assert inspection.syntax_error is None
+    assert {"subprocess", "os", "socket"} <= inspection.imports
+    names = inspection.call_names()
+    assert "subprocess.run" in names  # alias-resolved
+    assert "os.fork" in names  # from-import resolved
+    forks = [c for c in inspection.calls if c.name == "os.fork"]
+    assert forks and all(c.in_loop for c in forks)
+    runs = [c for c in inspection.calls if c.name == "subprocess.run"]
+    assert runs and not any(c.in_loop for c in runs)
+    assert "/etc/passwd" in inspection.path_literals
+
+
+def test_inspection_loop_context_resets_in_nested_function():
+    src = "for i in range(3):\n    def f():\n        g()\n"
+    calls = {c.name: c for c in inspect_source(src).calls}
+    assert not calls["g"].in_loop  # def body only runs when called
+    assert calls["range"].in_loop is False  # the iterable is evaluated once
+
+
+def test_inspection_loop_context_once_only_constructs():
+    """Constructs that execute exactly once must not read as looped — a
+    fork_in_loop deny on a for-else body would 422 correct code."""
+    cases = {
+        # for-else: the else suite runs at most once, after the loop
+        "import os\nfor i in range(3):\n    pass\nelse:\n    os.fork()\n": False,
+        # while-else: same
+        "import os\nwhile f():\n    pass\nelse:\n    os.fork()\n": False,
+        # a comprehension's OUTERMOST iterable evaluates once
+        "import os\nxs = [y for y in range(os.fork())]\n": False,
+        # ...but the element expression runs per element
+        "import os\nxs = [os.fork() for y in range(3)]\n": True,
+        # and a while test re-evaluates every iteration
+        "import os\nwhile os.fork():\n    pass\n": True,
+    }
+    for src, expect_in_loop in cases.items():
+        forks = [
+            c for c in inspect_source(src).calls if c.name == "os.fork"
+        ]
+        assert forks, src
+        assert forks[0].in_loop is expect_in_loop, src
+
+
+def test_inspection_predicts_deps_from_same_tree():
+    inspection = inspect_source("import pandas\nimport yaml\nimport json\n")
+    assert inspection.predicted_deps == ["PyYAML", "pandas"]  # stdlib dropped
+
+
+def test_null_byte_truncates_like_the_sandbox_tokenizer():
+    """CPython's FILE tokenizer treats NUL as end-of-input: code before
+    the null runs, code after is ignored. ast.parse on a string raises
+    ValueError instead — the inspector must truncate, not crash, and the
+    analysis must describe exactly what would execute (a NUL after a
+    denied import is not a bypass)."""
+    inspection = inspect_source("import socket\nprint('ran')\x00junk junk")
+    assert inspection.syntax_error is None
+    assert inspection.analysis_error is None
+    assert "socket" in inspection.imports  # the pre-NUL code is analyzed
+
+
+def test_deep_unary_chain_is_analyzable():
+    """ast.parse accepts expressions far deeper than the recursion limit
+    (a 2KB ----…x chain is a valid program the sandbox runs); the walker
+    must be iterative, never a RecursionError → 500."""
+    inspection = inspect_source("import pandas\ny = " + "-" * 5000 + "1\n")
+    assert inspection.analysis_error is None
+    assert inspection.predicted_deps == ["pandas"]
+
+
+def test_unanalyzable_source_fails_closed_only_under_policy():
+    import bee_code_interpreter_tpu.analysis.inspect as inspect_mod
+
+    blown = inspect_mod.SourceInspection(
+        analysis_error="RecursionError('maximum recursion depth exceeded')"
+    )
+    real = inspect_mod.inspect_source
+    try:
+        inspect_mod.inspect_source = lambda _src: blown
+        # reload the symbol policy.py bound at import time
+        import bee_code_interpreter_tpu.analysis.policy as policy_mod
+
+        orig = policy_mod.inspect_source
+        policy_mod.inspect_source = lambda _src: blown
+        try:
+            registry = Registry()
+            guarded = WorkloadAnalyzer(
+                PolicyEngine(deny_imports=("socket",)), metrics=registry
+            ).analyze("whatever")
+            assert guarded.denials and guarded.denials[0].rule == "unanalyzable"
+            assert (
+                'bci_analysis_rejections_total{rule="unanalyzable"} 1'
+                in registry.expose()
+            )
+            open_gate = WorkloadAnalyzer().analyze("whatever")
+            # no policy: proceed, but with NO dep claim — the sandbox must
+            # run its own scan
+            assert not open_gate.denials
+            assert open_gate.predicted_deps is None
+        finally:
+            policy_mod.inspect_source = orig
+    finally:
+        inspect_mod.inspect_source = real
+
+
+# ----------------------------------------------------------------- policy
+
+
+def test_policy_import_matching_and_severity():
+    engine = PolicyEngine(
+        deny_imports=("socket",), warn_imports=("requests",)
+    )
+    findings = engine.evaluate(
+        inspect_source("import socket\nimport requests\nimport math\n")
+    )
+    by_rule = {f.rule: f for f in findings}
+    assert by_rule["import:socket"].severity == "deny"
+    assert by_rule["import:requests"].severity == "warn"
+    assert len(findings) == 2
+
+
+def test_policy_import_matches_submodules():
+    engine = PolicyEngine(deny_imports=("socket",))
+    assert engine.evaluate(inspect_source("from socket import socket\n"))
+    assert engine.evaluate(inspect_source("import socket.timeout\n"))
+    assert not engine.evaluate(inspect_source("import socketserver2\n"))
+
+
+def test_policy_call_wildcards_and_shapes():
+    engine = PolicyEngine(
+        deny_calls=("subprocess.*", "fork_in_loop"),
+        warn_calls=("raw_socket",),
+    )
+    src = (
+        "import subprocess, os, socket\n"
+        "subprocess.check_output(['id'])\n"
+        "for _ in range(10):\n"
+        "    os.fork()\n"
+        "socket.socket()\n"
+    )
+    findings = engine.evaluate(inspect_source(src))
+    rules = {f.rule: f.severity for f in findings}
+    assert rules["call:subprocess.*"] == "deny"
+    assert rules["shape:fork_in_loop"] == "deny"
+    assert rules["shape:raw_socket"] == "warn"
+    # a single fork OUTSIDE a loop does not trip the shape
+    assert not PolicyEngine(deny_calls=("fork_in_loop",)).evaluate(
+        inspect_source("import os\nos.fork()\n")
+    )
+
+
+def test_policy_path_prefixes():
+    engine = PolicyEngine(deny_paths=("/etc",), warn_paths=("/tmp",))
+    findings = engine.evaluate(
+        inspect_source("a = '/etc/shadow'\nb = '/tmp/x'\nc = '/workspace/f'\n")
+    )
+    rules = {f.rule: f.severity for f in findings}
+    assert rules == {"path:/etc": "deny", "path:/tmp": "warn"}
+    # prefix means path-component prefix: /etcetera must not match /etc
+    assert not engine.evaluate(inspect_source("a = '/etcetera'\n"))
+
+
+# ---------------------------------------------------- dep pre-resolution
+
+
+def test_filter_predicted_drops_preinstalled_and_pinned():
+    predicted = ["pandas", "PyYAML", "jax", "torch", "numpy"]
+    out = dep_guess.filter_predicted(predicted, preinstalled={"NumPy"})
+    # numpy preinstalled (normalized match), jax/torch pinned-stack skip
+    assert out == ["PyYAML", "pandas"]
+
+
+def test_filter_predicted_drops_this_interpreters_stdlib():
+    """Edge and sandbox may run different Python versions: a module that
+    is stdlib HERE must never be pip-installed because an older/newer
+    edge identity-mapped it to a same-named PyPI package (dependency
+    confusion). sqlite3 stands in for the telnetlib-style divergence."""
+    out = dep_guess.filter_predicted(["sqlite3", "asyncio", "pandas"])
+    assert out == ["pandas"]
+
+
+async def test_executor_core_skips_scan_when_prediction_attached(
+    tmp_path, monkeypatch
+):
+    core = ExecutorCore(
+        workspace=tmp_path / "ws", disable_dep_install=True
+    )
+
+    def boom(*a, **k):
+        raise AssertionError("sandbox ran its own scan despite a prediction")
+
+    monkeypatch.setattr(dep_guess, "guess_dependencies", boom)
+    installed, notes = await core.ensure_dependencies(
+        "import pandas\n", predicted_deps=["pandas"]
+    )
+    assert (installed, notes) == ([], "")  # install disabled; scan skipped
+    # without a prediction the scan still runs (and here, raises)
+    with pytest.raises(AssertionError):
+        await core.ensure_dependencies("import pandas\n")
+
+
+def test_context_stash_roundtrip():
+    assert predicted_deps() is None
+    stash_predicted_deps(["pandas"])
+    assert predicted_deps() == ["pandas"]
+    stash_predicted_deps([])  # "scanned, nothing to install" is a claim
+    assert predicted_deps() == []
+    stash_predicted_deps(None)
+    assert predicted_deps() is None
+
+
+# ------------------------------------------------------------- analyzer
+
+
+def test_analyzer_accounts_rejections_and_predictions():
+    registry = Registry()
+    analyzer = WorkloadAnalyzer(
+        PolicyEngine(deny_imports=("socket",)), metrics=registry
+    )
+    assert analyzer.analyze("def broken(:\n").syntax_error is not None
+    assert analyzer.analyze("import socket\n").denials
+    ok = analyzer.analyze("import pandas\n")
+    assert not ok.denials and ok.predicted_deps == ["pandas"]
+    text = registry.expose()
+    assert 'bci_analysis_rejections_total{rule="syntax"} 1' in text
+    assert 'bci_analysis_rejections_total{rule="import:socket"} 1' in text
+    assert "bci_analysis_dep_predictions_total 1" in text
+    assert "bci_analysis_seconds_count 3" in text
+
+
+def test_analyzer_counts_warnings():
+    registry = Registry()
+    analyzer = WorkloadAnalyzer(
+        PolicyEngine(warn_imports=("requests",)), metrics=registry
+    )
+    analyzer.analyze("import requests\n")
+    analyzer.analyze("import requests\n")
+    assert (
+        'bci_analysis_warnings_total{rule="import:requests"} 2'
+        in registry.expose()
+    )
+
+
+def test_analyzer_records_analysis_stage_span():
+    registry = Registry()
+    tracer = Tracer(metrics=registry)
+    analyzer = WorkloadAnalyzer(metrics=registry)
+    with tracer.trace("/v1/execute") as trace:
+        verdict = analyzer.analyze("import pandas\n")
+    assert verdict.predicted_deps == ["pandas"]
+    assert "analysis" in trace.stage_ms()
+    assert 'stage="analysis"' in registry.expose()
+    span = next(s for s in trace.spans if s.name == "analysis")
+    assert span.attributes["analysis.outcome"] == "ok"
+    assert span.attributes["analysis.predicted_deps"] == "pandas"
+
+
+def test_analyzer_annotation_shape():
+    analyzer = WorkloadAnalyzer(PolicyEngine(warn_calls=("subprocess",)))
+    verdict = analyzer.analyze("import subprocess\nsubprocess.run(['ls'])\n")
+    annotation = verdict.annotation()
+    assert annotation["warnings"][0]["rule"] == "shape:subprocess"
+    assert "predicted_deps" not in annotation  # key absent when empty
+    # clean source with no deps annotates nothing at all
+    assert WorkloadAnalyzer().analyze("print(1)\n").annotation() is None
+
+
+def test_analyzer_size_bound_is_unanalyzable_not_a_stall():
+    """The gate runs ON the event loop: a multi-MB source must never be
+    parsed there. Over the bound it is `unanalyzable` — fail-closed with
+    a policy declared, admitted (prediction None, pod scans) without."""
+    big = "x = 1\n" * 200  # ~1.2KB, over a tiny test bound
+    guarded = WorkloadAnalyzer(
+        PolicyEngine(deny_imports=("socket",)), max_source_bytes=512
+    ).analyze(big)
+    assert guarded.denials and guarded.denials[0].rule == "unanalyzable"
+    open_gate = WorkloadAnalyzer(max_source_bytes=512).analyze(big)
+    assert not open_gate.denials
+    assert open_gate.predicted_deps is None  # the pod must scan itself
+    # under the bound everything works as usual
+    ok = WorkloadAnalyzer(max_source_bytes=1 << 20).analyze(big)
+    assert ok.predicted_deps == []
+
+
+def test_analyzer_from_config_honors_enable_switch():
+    from bee_code_interpreter_tpu.config import Config
+
+    assert WorkloadAnalyzer.from_config(Config(analysis_enabled=False)) is None
+    analyzer = WorkloadAnalyzer.from_config(
+        Config(policy_deny_imports="socket, ctypes")
+    )
+    assert analyzer.policy.deny_imports == ("socket", "ctypes")

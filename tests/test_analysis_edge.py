@@ -1,0 +1,378 @@
+"""Edge behavior of the pre-flight code gate (docs/analysis.md), on both
+transports: syntax fail-fast without a sandbox checkout, policy deny as a
+client fault (422 / INVALID_ARGUMENT), warn annotations, and the dep
+prediction riding the execution."""
+
+import grpc.aio
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from bee_code_interpreter_tpu.analysis import PolicyEngine, WorkloadAnalyzer
+from bee_code_interpreter_tpu.api.grpc_server import GrpcServer, service_stubs
+from bee_code_interpreter_tpu.api.http_server import create_http_server
+from bee_code_interpreter_tpu.observability import FleetJournal
+from bee_code_interpreter_tpu.proto import code_interpreter_pb2 as pb
+from bee_code_interpreter_tpu.services.custom_tool_executor import (
+    CustomToolExecutor,
+)
+from bee_code_interpreter_tpu.utils.metrics import Registry
+
+
+class CountingExecutor:
+    """Wraps the real local executor; counts how many executions actually
+    reached a sandbox — the gate's whole point is keeping this at zero for
+    doomed submissions."""
+
+    def __init__(self, inner):
+        self.inner = inner
+        self.executions = 0
+
+    async def execute(self, *args, **kwargs):
+        self.executions += 1
+        return await self.inner.execute(*args, **kwargs)
+
+
+@pytest.fixture
+def counting_executor(local_executor):
+    return CountingExecutor(local_executor)
+
+
+def make_app(executor, analyzer, metrics=None, fleet=None):
+    return create_http_server(
+        code_executor=executor,
+        custom_tool_executor=CustomToolExecutor(code_executor=executor),
+        metrics=metrics,
+        analyzer=analyzer,
+        fleet=fleet,
+    )
+
+
+async def with_client(app, fn):
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    try:
+        return await fn(client)
+    finally:
+        await client.close()
+
+
+async def test_http_syntax_failfast_zero_checkouts(counting_executor):
+    metrics = Registry()
+    fleet = FleetJournal()
+    analyzer = WorkloadAnalyzer(metrics=metrics)
+    app = make_app(counting_executor, analyzer, metrics=metrics, fleet=fleet)
+
+    async def go(client):
+        resp = await client.post(
+            "/v1/execute", json={"source_code": "def broken(:\n"}
+        )
+        # a normal ExecuteResponse, exactly as if the sandbox had died at
+        # parse: HTTP 200, exit_code=1, the in-sandbox stderr shape
+        assert resp.status == 200
+        body = await resp.json()
+        assert body["exit_code"] == 1
+        assert body["stdout"] == ""
+        lines = body["stderr"].strip().splitlines()
+        assert lines[0].lstrip().startswith('File "')
+        assert lines[-1].startswith("SyntaxError:")
+        assert body["files"] == {}
+        # the analysis stage is the ONLY stage the request paid for
+        assert "analysis" in body["timings_ms"]
+        assert "execute" not in body["timings_ms"]
+        assert body["trace_id"]
+
+    await with_client(app, go)
+    # zero sandbox checkouts: nothing reached an executor, nothing in the
+    # fleet journal
+    assert counting_executor.executions == 0
+    assert len(fleet) == 0
+    assert (
+        'bci_analysis_rejections_total{rule="syntax"} 1' in metrics.expose()
+    )
+
+
+async def test_http_policy_deny_is_422(counting_executor):
+    metrics = Registry()
+    analyzer = WorkloadAnalyzer(
+        PolicyEngine(deny_imports=("socket",), deny_calls=("subprocess",)),
+        metrics=metrics,
+    )
+    app = make_app(counting_executor, analyzer, metrics=metrics)
+
+    async def go(client):
+        resp = await client.post(
+            "/v1/execute", json={"source_code": "import socket\n"}
+        )
+        assert resp.status == 422
+        body = await resp.json()
+        assert body["violations"][0]["rule"] == "import:socket"
+        resp = await client.post(
+            "/v1/execute",
+            json={"source_code": "import subprocess\nsubprocess.run(['id'])\n"},
+        )
+        assert resp.status == 422
+
+    await with_client(app, go)
+    assert counting_executor.executions == 0
+    text = metrics.expose()
+    assert 'bci_analysis_rejections_total{rule="import:socket"} 1' in text
+    assert 'bci_analysis_rejections_total{rule="shape:subprocess"} 1' in text
+
+
+async def test_http_warn_annotates_and_executes(counting_executor):
+    analyzer = WorkloadAnalyzer(PolicyEngine(warn_imports=("json",)))
+    app = make_app(counting_executor, analyzer)
+
+    async def go(client):
+        resp = await client.post(
+            "/v1/execute",
+            json={"source_code": "import json\nprint(json.dumps(1))"},
+        )
+        assert resp.status == 200
+        body = await resp.json()
+        assert body["stdout"] == "1\n"
+        assert body["exit_code"] == 0
+        warned = body["analysis"]["warnings"]
+        assert warned[0]["rule"] == "import:json"
+        assert warned[0]["severity"] == "warn"
+
+    await with_client(app, go)
+    assert counting_executor.executions == 1  # warn does not block
+
+
+async def test_http_clean_source_response_unchanged(counting_executor):
+    """No warnings, no deps → the analysis key is null: the wire shape of
+    the common path is exactly the pre-gate contract."""
+    app = make_app(counting_executor, WorkloadAnalyzer())
+
+    async def go(client):
+        body = await (
+            await client.post(
+                "/v1/execute", json={"source_code": "print(21 * 2)"}
+            )
+        ).json()
+        assert body["stdout"] == "42\n"
+        assert body["analysis"] is None
+
+    await with_client(app, go)
+
+
+async def test_http_dep_prediction_annotated(counting_executor):
+    app = make_app(counting_executor, WorkloadAnalyzer())
+
+    async def go(client):
+        body = await (
+            await client.post(
+                "/v1/execute",
+                json={
+                    "source_code": (
+                        "try:\n    import pandas\nexcept ImportError:\n"
+                        "    print('no pandas')\n"
+                    )
+                },
+            )
+        ).json()
+        assert body["analysis"]["predicted_deps"] == ["pandas"]
+
+    await with_client(app, go)
+
+
+async def test_http_custom_tool_policy(counting_executor):
+    analyzer = WorkloadAnalyzer(PolicyEngine(deny_imports=("socket",)))
+    app = make_app(counting_executor, analyzer)
+
+    async def go(client):
+        # deny applies to tool source too
+        resp = await client.post(
+            "/v1/execute-custom-tool",
+            json={
+                "tool_source_code": (
+                    "import socket\ndef t(a: int) -> int:\n    return a"
+                ),
+                "tool_input_json": '{"a": 1}',
+            },
+        )
+        assert resp.status == 422
+        # but a syntax error keeps the PARSER's 400 contract
+        resp = await client.post(
+            "/v1/execute-custom-tool",
+            json={"tool_source_code": "def t(:\n", "tool_input_json": "{}"},
+        )
+        assert resp.status == 400
+        assert "error_messages" in await resp.json()
+
+    await with_client(app, go)
+    assert counting_executor.executions == 0
+
+
+async def test_http_custom_tool_policy_applies_to_indented_source(
+    counting_executor,
+):
+    """The parser dedents uniformly indented tool sources before parsing —
+    the policy must see the SAME preprocessing, or indentation becomes a
+    policy bypass (raw parse fails → deny check skipped → tool runs)."""
+    analyzer = WorkloadAnalyzer(PolicyEngine(deny_imports=("socket",)))
+    app = make_app(counting_executor, analyzer)
+    indented = (
+        "    import socket\n"
+        "    def t(a: int) -> int:\n"
+        "        return a\n"
+    )
+
+    async def go(client):
+        resp = await client.post(
+            "/v1/execute-custom-tool",
+            json={"tool_source_code": indented, "tool_input_json": '{"a": 1}'},
+        )
+        assert resp.status == 422
+        body = await resp.json()
+        assert body["violations"][0]["rule"] == "import:socket"
+
+    await with_client(app, go)
+    assert counting_executor.executions == 0
+
+
+class DepSpyExecutor:
+    """Records the ambient dep prediction at the moment the executor runs —
+    what the data-plane driver would ship to the sandbox."""
+
+    def __init__(self, inner):
+        self.inner = inner
+        self.seen: list = []
+
+    async def execute(self, *args, **kwargs):
+        from bee_code_interpreter_tpu.analysis.context import predicted_deps
+
+        self.seen.append(predicted_deps())
+        return await self.inner.execute(*args, **kwargs)
+
+
+async def test_http_prediction_stash_per_route(local_executor):
+    """/v1/execute ships its prediction; custom tools and profiled runs must
+    ship NONE — the sandbox executes generated/unanalyzed source there and
+    its own scan must run (and a prediction stashed by an earlier request
+    in the same connection task must never leak forward)."""
+    spy = DepSpyExecutor(local_executor)
+    app = make_app(spy, WorkloadAnalyzer())
+
+    async def go(client):
+        payload = (
+            "try:\n    import pandas\nexcept ImportError:\n    pass\n"
+        )
+        await client.post("/v1/execute", json={"source_code": payload})
+        await client.post(
+            "/v1/execute-custom-tool",
+            json={
+                "tool_source_code": "def t(a: int) -> int:\n    return a",
+                "tool_input_json": '{"a": 1}',
+            },
+        )
+        resp = await client.post(
+            "/v1/profile",
+            json={"target": "sandbox", "source_code": "print(1)"},
+        )
+        assert resp.status == 200
+
+    await with_client(app, go)
+    assert spy.seen[0] == ["pandas"]  # /v1/execute: prediction shipped
+    assert spy.seen[1] is None  # custom tool: pod scans the wrapper itself
+    assert spy.seen[2] is None  # profile: unanalyzed, pod scans itself
+
+
+# ------------------------------------------------------------------ gRPC
+
+
+async def run_grpc(server: GrpcServer, fn):
+    port = await server.start("127.0.0.1:0")
+    try:
+        async with grpc.aio.insecure_channel(f"127.0.0.1:{port}") as channel:
+            await fn(service_stubs(channel))
+    finally:
+        await server.stop(None)
+
+
+async def test_grpc_syntax_failfast(counting_executor):
+    server = GrpcServer(
+        code_executor=counting_executor,
+        custom_tool_executor=CustomToolExecutor(code_executor=counting_executor),
+        analyzer=WorkloadAnalyzer(),
+    )
+
+    async def go(stubs):
+        resp = await stubs["Execute"](
+            pb.ExecuteRequest(source_code="def broken(:\n")
+        )
+        assert resp.exit_code == 1
+        assert resp.stdout == ""
+        assert resp.stderr.strip().splitlines()[-1].startswith("SyntaxError:")
+
+    await run_grpc(server, go)
+    assert counting_executor.executions == 0
+
+
+async def test_grpc_policy_deny_invalid_argument(counting_executor):
+    server = GrpcServer(
+        code_executor=counting_executor,
+        custom_tool_executor=CustomToolExecutor(code_executor=counting_executor),
+        analyzer=WorkloadAnalyzer(PolicyEngine(deny_imports=("socket",))),
+    )
+
+    async def go(stubs):
+        try:
+            await stubs["Execute"](pb.ExecuteRequest(source_code="import socket"))
+        except grpc.aio.AioRpcError as e:
+            assert e.code() == grpc.StatusCode.INVALID_ARGUMENT
+            assert "import:socket" in e.details()
+        else:
+            raise AssertionError("expected INVALID_ARGUMENT")
+
+    await run_grpc(server, go)
+    assert counting_executor.executions == 0
+
+
+async def test_grpc_custom_tool_policy_applies_to_indented_source(
+    counting_executor,
+):
+    server = GrpcServer(
+        code_executor=counting_executor,
+        custom_tool_executor=CustomToolExecutor(code_executor=counting_executor),
+        analyzer=WorkloadAnalyzer(PolicyEngine(deny_imports=("socket",))),
+    )
+    indented = (
+        "    import socket\n"
+        "    def t(a: int) -> int:\n"
+        "        return a\n"
+    )
+
+    async def go(stubs):
+        try:
+            await stubs["ExecuteCustomTool"](
+                pb.ExecuteCustomToolRequest(
+                    tool_source_code=indented, tool_input_json='{"a": 1}'
+                )
+            )
+        except grpc.aio.AioRpcError as e:
+            assert e.code() == grpc.StatusCode.INVALID_ARGUMENT
+            assert "import:socket" in e.details()
+        else:
+            raise AssertionError("expected INVALID_ARGUMENT")
+
+    await run_grpc(server, go)
+    assert counting_executor.executions == 0
+
+
+async def test_grpc_clean_source_executes(counting_executor):
+    server = GrpcServer(
+        code_executor=counting_executor,
+        custom_tool_executor=CustomToolExecutor(code_executor=counting_executor),
+        analyzer=WorkloadAnalyzer(PolicyEngine(warn_imports=("json",))),
+    )
+
+    async def go(stubs):
+        resp = await stubs["Execute"](
+            pb.ExecuteRequest(source_code="import json\nprint(json.dumps(2))")
+        )
+        assert resp.stdout == "2\n"
+
+    await run_grpc(server, go)
+    assert counting_executor.executions == 1

@@ -88,6 +88,11 @@ def test_every_registered_metric_follows_conventions(tmp_path):
         "bci_telemetry_queue_depth",
         "bci_slo_error_budget_remaining_ratio",
         "bci_slo_burn_rate",
+        # edge static analysis (ISSUE 6): the pre-flight code gate
+        "bci_analysis_seconds",
+        "bci_analysis_rejections_total",
+        "bci_analysis_warnings_total",
+        "bci_analysis_dep_predictions_total",
     ):
         assert required in metrics, f"{required}: not registered by the wiring"
     assert isinstance(metrics["bci_pool_spawn_seconds"], Histogram)
@@ -104,6 +109,9 @@ def test_every_registered_metric_follows_conventions(tmp_path):
     assert isinstance(metrics["bci_telemetry_queue_depth"], Gauge)
     assert isinstance(metrics["bci_slo_error_budget_remaining_ratio"], Gauge)
     assert isinstance(metrics["bci_slo_burn_rate"], Gauge)
+    assert isinstance(metrics["bci_analysis_seconds"], Histogram)
+    assert isinstance(metrics["bci_analysis_rejections_total"], Counter)
+    assert isinstance(metrics["bci_analysis_dep_predictions_total"], Counter)
 
     for name, metric in metrics.items():
         assert name.startswith("bci_"), (
@@ -135,6 +143,24 @@ def test_every_registered_metric_follows_conventions(tmp_path):
         assert text.count(f"# HELP {name} ") == 1, (
             f"{name}: duplicate or missing exposition block"
         )
+
+
+def test_analysis_stage_appears_in_stage_seconds(tmp_path):
+    """The edge gate's work is a first-class request stage: one analyzed
+    submission under a trace must surface as
+    ``bci_stage_seconds{stage="analysis"}`` — the same histogram every
+    other stage (admission/spawn/upload/execute/download) feeds, so
+    dashboards see the gate's cost next to what it saves."""
+    from bee_code_interpreter_tpu.analysis import WorkloadAnalyzer
+    from bee_code_interpreter_tpu.observability import Tracer
+
+    registry = build_service_registry(tmp_path)
+    tracer = Tracer(metrics=registry)
+    analyzer = WorkloadAnalyzer(metrics=registry)
+    with tracer.trace("/v1/execute"):
+        analyzer.analyze("print(1)\n")
+    text = registry.expose()
+    assert 'bci_stage_seconds_count{stage="analysis"} 1' in text
 
 
 def test_every_seconds_histogram_carries_exemplars_when_trace_active(tmp_path):
