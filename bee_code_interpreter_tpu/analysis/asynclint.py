@@ -40,6 +40,7 @@ shrink honestly.
 from __future__ import annotations
 
 import ast
+import re
 from dataclasses import dataclass, field
 from pathlib import Path
 
@@ -199,9 +200,12 @@ class _Linter(ast.NodeVisitor):
         if isinstance(node.value, ast.Call):
             name = resolve_call_name(node.value.func, self.aliases)
             func = node.value.func
+            # ANY `<receiver>.create_task(...)` / `.ensure_future(...)` as a
+            # bare statement is flagged, whatever the receiver spelling —
+            # `asyncio.`, `loop.`, `self._loop.`, a call chain. The name
+            # check only adds the bare `create_task(...)` from-import form.
             if name in _TASK_SPAWNERS or (
-                name is None
-                and isinstance(func, ast.Attribute)
+                isinstance(func, ast.Attribute)
                 and func.attr in _TASK_SPAWNER_ATTRS
             ):
                 spelled = name or f"<…>.{func.attr}"
@@ -266,6 +270,18 @@ def _lint_one(source: str, path: str) -> _Linter:
     return linter
 
 
+def _documented(name: str, docs_text: str) -> bool:
+    """Word-bounded match: `bci_hedge` must not count as documented just
+    because `bci_hedge_total` is — an operator searching the docs for the
+    exact metric name has to find it."""
+    return (
+        re.search(
+            rf"(?<![A-Za-z0-9_]){re.escape(name)}(?![A-Za-z0-9_])", docs_text
+        )
+        is not None
+    )
+
+
 def _metric_violations(
     linter: _Linter, docs_text: str | None
 ) -> list[Violation]:
@@ -282,7 +298,7 @@ def _metric_violations(
             ),
         )
         for name, line in linter.metric_sites
-        if name not in docs_text
+        if not _documented(name, docs_text)
     ]
 
 

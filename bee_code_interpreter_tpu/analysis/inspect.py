@@ -203,15 +203,24 @@ def _path_literals(tree: ast.AST) -> set[str]:
 def inspect_source(source_code: str) -> SourceInspection:
     """ONE parse of a submission; everything the edge decides on comes off
     the same tree. Syntax errors short-circuit with the rendered stderr."""
-    # CPython's FILE tokenizer treats NUL as end-of-input: the sandbox
-    # executes everything BEFORE the first null byte and ignores the rest
-    # (verified against this image's interpreter). ``ast.parse`` on a
-    # string instead raises ValueError — so truncate exactly the way the
-    # sandbox will, and the analysis describes precisely what would run
-    # (a null byte can't smuggle a denied import past the gate, nor 500
-    # a request the sandbox would accept).
+    # A NUL byte makes the source unanalyzable. ``ast.parse`` on a string
+    # raises ValueError, and the sandbox's FILE tokenizer treats NUL
+    # line-dependently (verified on this image's 3.10: a NUL drops only
+    # the remainder of its own line — LATER lines still execute, while a
+    # NUL mid-statement is a SyntaxError), so any edge truncation would
+    # misdescribe what actually runs: 'print(1)\n\x00\nimport socket'
+    # would pass a deny-imports gate yet run the denied import. The edge
+    # makes NO claim — fail-closed under a declared policy, and
+    # predicted_deps=None keeps the in-pod scan (which reads the real
+    # file) authoritative.
     if "\x00" in source_code:
-        source_code = source_code[: source_code.index("\x00")]
+        return SourceInspection(
+            analysis_error=(
+                "source contains a NUL byte; the sandbox tokenizer's "
+                "handling is line-dependent and cannot be mirrored at "
+                "the edge"
+            )
+        )
     try:
         tree = ast.parse(source_code, filename=SCRIPT_FILENAME)
     except SyntaxError as e:

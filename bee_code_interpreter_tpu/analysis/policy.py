@@ -100,9 +100,7 @@ def split_patterns(raw: str | None) -> tuple[str, ...]:
 def _import_matches(pattern: str, imported: str) -> bool:
     """``socket`` matches ``socket`` and ``socket.anything``; dotted
     patterns (``google.auth``) match that subtree only."""
-    return imported == pattern or imported.startswith(pattern + ".") or (
-        "." not in pattern and imported.split(".", 1)[0] == pattern
-    )
+    return imported == pattern or imported.startswith(pattern + ".")
 
 
 def _call_matches(pattern: str, call: str) -> bool:
@@ -113,7 +111,13 @@ def _call_matches(pattern: str, call: str) -> bool:
 
 
 def _path_matches(pattern: str, literal: str) -> bool:
-    return literal == pattern or literal.startswith(pattern.rstrip("/") + "/")
+    # Normalize the pattern so "/etc/" and "/etc" declare the same rule:
+    # either spelling matches the bare directory literal AND everything
+    # under it (per path component — /etcetera stays unmatched).
+    base = pattern.rstrip("/")
+    if not base:  # pattern "/" — every absolute path literal is under it
+        return True
+    return literal == base or literal.startswith(base + "/")
 
 
 class PolicyEngine:
@@ -345,11 +349,22 @@ class WorkloadAnalyzer:
         as the ``analysis`` stage and timed into ``bci_analysis_seconds``."""
         t0 = time.monotonic()
         with span("analysis") as s:
-            if len(source_code) > self._max_source_bytes:
+            # The bound is BYTES (what actually arrived on the wire), so
+            # UTF-8-heavy source can't pack ~4x the limit into a passing
+            # char count. A char count over the bound is already over
+            # (UTF-8 is >= 1 byte/char) — multi-MB bodies are never
+            # encoded just to be measured.
+            source_bytes = (
+                len(source_code)
+                if len(source_code) > self._max_source_bytes
+                else len(source_code.encode("utf-8", "surrogatepass"))
+            )
+            if source_bytes > self._max_source_bytes:
                 inspection = SourceInspection(
                     analysis_error=(
-                        f"source is {len(source_code)} chars, over the "
-                        f"{self._max_source_bytes}-byte analysis bound"
+                        f"source is at least {source_bytes} bytes of "
+                        f"UTF-8, over the {self._max_source_bytes}-byte "
+                        "analysis bound"
                     )
                 )
             else:

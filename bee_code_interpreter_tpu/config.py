@@ -191,10 +191,11 @@ class Config(BaseModel):
     # the pod. Disable only to A/B the gate's cost.
     analysis_enabled: bool = True
     # The gate runs ON the event loop (it is sub-ms for real submissions);
-    # source longer than this is "unanalyzable" instead of being parsed —
-    # a multi-MB body must never stall every in-flight request for seconds.
-    # Unanalyzable = refused fail-closed when a policy is declared, admitted
-    # with the in-pod dep scan when none is (docs/analysis.md).
+    # source whose UTF-8 encoding exceeds this is "unanalyzable" instead of
+    # being parsed — a multi-MB body must never stall every in-flight
+    # request for seconds. Unanalyzable = refused fail-closed when a policy
+    # is declared, admitted with the in-pod dep scan when none is
+    # (docs/analysis.md).
     analysis_max_source_bytes: int = Field(default=262_144, ge=1)
     # Policy rules, comma-separated (same spelling convention as
     # APP_SLO_LATENCY_MS). Imports match top-level or dotted-subtree names
@@ -203,6 +204,9 @@ class Config(BaseModel):
     # (fork_in_loop / raw_socket / subprocess); paths match absolute-path
     # literal prefixes ("/etc"). deny → HTTP 422 / gRPC INVALID_ARGUMENT
     # (SLI-good client faults); warn → response annotation + metric.
+    # NOT a security boundary: matching is static only — __import__(...),
+    # importlib, getattr indirection evade it. The sandbox enforces
+    # isolation; these rules just refuse doomed work early.
     policy_deny_imports: str | None = None
     policy_warn_imports: str | None = None
     policy_deny_calls: str | None = None

@@ -735,7 +735,10 @@ def guessed_imports(source_code: str) -> set[str]:
     is retained (``google.protobuf``, ``google.cloud.storage``)."""
     try:
         tree = ast.parse(source_code)
-    except SyntaxError:
+    except (SyntaxError, ValueError):
+        # Best-effort: source ast.parse refuses (ValueError on NUL bytes —
+        # which the FILE tokenizer the sandbox actually uses tolerates)
+        # guesses nothing rather than failing the execution.
         return set()
     return guessed_imports_from_tree(tree)
 

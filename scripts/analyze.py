@@ -132,13 +132,19 @@ def main() -> int:
         findings = policy.unanalyzable_findings(inspection.analysis_error)
     else:
         findings = policy.evaluate(inspection)
+    # The edge ships None ("no claim; the pod scans itself") for
+    # unanalyzable source — distinct from [] ("scanned, install nothing").
+    deps = (
+        None if inspection.analysis_error is not None
+        else inspection.predicted_deps
+    )
     if args.json:
         print(
             json.dumps(
                 {
                     "findings": [f.to_dict() for f in findings],
                     "imports": sorted(inspection.imports),
-                    "predicted_deps": inspection.predicted_deps,
+                    "predicted_deps": deps,
                 }
             )
         )
@@ -154,7 +160,11 @@ def main() -> int:
             print("no policy findings")
         print(
             "predicted deps: "
-            + (", ".join(inspection.predicted_deps) or "(none)")
+            + (
+                "(no claim — unanalyzable; the sandbox scans itself)"
+                if deps is None
+                else ", ".join(deps) or "(none)"
+            )
         )
     return 2 if any(f.severity == "deny" for f in findings) else 0
 

@@ -105,16 +105,37 @@ def test_inspection_predicts_deps_from_same_tree():
     assert inspection.predicted_deps == ["PyYAML", "pandas"]  # stdlib dropped
 
 
-def test_null_byte_truncates_like_the_sandbox_tokenizer():
-    """CPython's FILE tokenizer treats NUL as end-of-input: code before
-    the null runs, code after is ignored. ast.parse on a string raises
-    ValueError instead — the inspector must truncate, not crash, and the
-    analysis must describe exactly what would execute (a NUL after a
-    denied import is not a bypass)."""
-    inspection = inspect_source("import socket\nprint('ran')\x00junk junk")
-    assert inspection.syntax_error is None
-    assert inspection.analysis_error is None
-    assert "socket" in inspection.imports  # the pre-NUL code is analyzed
+def test_null_byte_source_is_unanalyzable_never_truncated():
+    """The sandbox's FILE tokenizer handles NUL line-dependently (on this
+    image's 3.10 a NUL drops only the rest of its own line — later lines
+    still execute), so edge truncation at the first NUL would let
+    'print(1)\\n\\x00\\nimport socket' pass a deny-imports gate and then
+    run the denied import. NUL-bearing source must make NO claim: it is
+    unanalyzable, never a crash (ast.parse would raise ValueError) and
+    never a prefix-only analysis."""
+    for src in (
+        "print(1)\n\x00\nimport socket\nsocket.socket()\n",  # NUL on its own line
+        "import socket\nprint('ran')\x00junk junk",  # NUL mid-line, trailing
+    ):
+        inspection = inspect_source(src)
+        assert inspection.syntax_error is None
+        assert inspection.analysis_error is not None
+        assert not inspection.imports  # no partial claims from a prefix
+
+
+def test_null_byte_cannot_bypass_policy_or_skip_pod_scan():
+    """End-to-end shape of the review finding: under a declared policy a
+    NUL-bearing submission is refused fail-closed; with no policy it
+    proceeds with predicted_deps=None — no truncated-prefix dep claim is
+    ever stashed; the pod's own (best-effort) scan is authoritative."""
+    evasion = "print(1)\n\x00\nimport socket\nsocket.socket()\n"
+    guarded = WorkloadAnalyzer(
+        PolicyEngine(deny_imports=("socket",))
+    ).analyze(evasion)
+    assert guarded.denials and guarded.denials[0].rule == "unanalyzable"
+    open_gate = WorkloadAnalyzer().analyze(evasion)
+    assert not open_gate.denials
+    assert open_gate.predicted_deps is None  # the pod must scan itself
 
 
 def test_deep_unary_chain_is_analyzable():
@@ -216,6 +237,12 @@ def test_policy_path_prefixes():
     assert rules == {"path:/etc": "deny", "path:/tmp": "warn"}
     # prefix means path-component prefix: /etcetera must not match /etc
     assert not engine.evaluate(inspect_source("a = '/etcetera'\n"))
+    # "/etc/" and "/etc" declare the same rule: both match the bare
+    # directory literal and everything under it
+    slashed = PolicyEngine(deny_paths=("/etc/",))
+    assert slashed.evaluate(inspect_source("a = '/etc'\n"))
+    assert slashed.evaluate(inspect_source("a = '/etc/passwd'\n"))
+    assert not slashed.evaluate(inspect_source("a = '/etcetera'\n"))
 
 
 # ---------------------------------------------------- dep pre-resolution
@@ -338,6 +365,18 @@ def test_analyzer_size_bound_is_unanalyzable_not_a_stall():
     # under the bound everything works as usual
     ok = WorkloadAnalyzer(max_source_bytes=1 << 20).analyze(big)
     assert ok.predicted_deps == []
+
+
+def test_analyzer_size_bound_measures_utf8_bytes_not_chars():
+    """The knob is a BYTE bound (what arrived on the wire): 200 chars of
+    4-byte emoji is 800 bytes and must trip a 512-byte bound even though
+    the char count passes."""
+    wide = "x = '" + "\U0001f600" * 200 + "'\n"
+    assert len(wide) < 512 < len(wide.encode("utf-8"))
+    verdict = WorkloadAnalyzer(
+        PolicyEngine(deny_imports=("socket",)), max_source_bytes=512
+    ).analyze(wide)
+    assert verdict.denials and verdict.denials[0].rule == "unanalyzable"
 
 
 def test_analyzer_from_config_honors_enable_switch():

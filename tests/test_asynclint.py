@@ -131,6 +131,21 @@ def test_fire_and_forget_task_flagged():
             asyncio.get_running_loop().create_task(c)
         """
     ) == ["fire-and-forget-task"]
+    # Name-rooted receivers are the COMMON spelling and must be caught too
+    assert _rules(
+        """
+        import asyncio
+        async def f(c):
+            loop = asyncio.get_event_loop()
+            loop.create_task(c)
+        """
+    ) == ["fire-and-forget-task"]
+    assert _rules(
+        """
+        async def f(self, c):
+            self._loop.create_task(c)
+        """
+    ) == ["fire-and-forget-task"]
     # retained handles satisfy the rule: assigned, awaited, passed on
     assert _rules(
         """
@@ -177,5 +192,15 @@ def test_undocumented_metric_rule_uses_docs_corpus():
     src = 'metrics.counter("bci_new_thing_total", "help")\n'
     assert _rules(src, docs_text="`bci_new_thing_total` is ...") == []
     assert _rules(src, docs_text="other text") == ["undocumented-metric"]
+    # word-bounded: being a substring of a DIFFERENT documented metric
+    # does not count as documented...
+    assert _rules(
+        'metrics.counter("bci_new_thing", "help")\n',
+        docs_text="`bci_new_thing_total` is ...",
+    ) == ["undocumented-metric"]
+    # ...but a trailing label-set brace is not a word character
+    assert _rules(
+        src, docs_text="bci_new_thing_total{rule} counts ..."
+    ) == []
     # without a docs corpus the rule is off (unit-test isolation)
     assert _rules(src) == []

@@ -35,6 +35,14 @@ def test_syntax_error_returns_empty():
     assert guess_dependencies("def broken(:\n") == []
 
 
+def test_null_byte_returns_empty_not_valueerror():
+    """ast.parse raises ValueError (not SyntaxError) on NUL bytes, but the
+    FILE tokenizer the sandbox runs the script with tolerates them — the
+    best-effort guesser must degrade to 'no deps', never fail the
+    execution with a 500."""
+    assert guess_dependencies("print(1)\n\x00\nimport pandas\n") == []
+
+
 def test_nested_function_imports_found():
     src = "def f():\n    import requests\n    return requests\n"
     assert guess_dependencies(src) == ["requests"]
