@@ -51,7 +51,7 @@ from bee_code_interpreter_tpu.analysis.inspect import (
 
 PACKAGE_ROOT = Path(__file__).resolve().parent.parent
 REPO_ROOT = PACKAGE_ROOT.parent
-DEFAULT_PACKAGES = ("api", "services", "resilience", "observability")
+DEFAULT_PACKAGES = ("api", "services", "resilience", "observability", "sessions")
 DEFAULT_DOCS = REPO_ROOT / "docs" / "observability.md"
 
 # Blocking entry points that must not run on the event loop. subprocess.Popen
@@ -111,6 +111,16 @@ SUPPRESSIONS: tuple[Suppression, ...] = (
             "local tmp files; per-chunk thread-pool hops would cost more than "
             "the sync writes they hide (the production pod path streams over "
             "HTTP instead)"
+        ),
+    ),
+    Suppression(
+        path="sessions/lease.py",
+        rule="blocking-call-in-async",
+        reason=(
+            "LocalLease is the dev/test backend's lease: chunked I/O on "
+            "local tmp files, same tradeoff (and the same sanction) as "
+            "services/local_code_executor.py; the production pool leases "
+            "stream over HTTP instead"
         ),
     ),
     Suppression(

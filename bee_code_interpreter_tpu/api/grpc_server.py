@@ -19,7 +19,7 @@ import json
 import logging
 import textwrap
 import time
-from contextlib import nullcontext
+from contextlib import asynccontextmanager, nullcontext
 
 import grpc
 import grpc.aio
@@ -47,6 +47,13 @@ from bee_code_interpreter_tpu.resilience import (
     Deadline,
     DeadlineExceeded,
 )
+from bee_code_interpreter_tpu.sessions import (
+    CheckpointNotFound,
+    InvalidSessionRequest,
+    SessionLimitExceeded,
+    SessionNotFound,
+    streamed_events,
+)
 from bee_code_interpreter_tpu.services.code_executor import CodeExecutor
 from bee_code_interpreter_tpu.services.custom_tool_executor import (
     CustomToolExecuteError,
@@ -66,6 +73,16 @@ SERVICE_NAME = "code_interpreter.v1.CodeInterpreterService"
 _ABORT_ERRORS = tuple(
     t for t in (getattr(grpc.aio, "AbortError", None),) if t is not None
 )
+
+class _SliSample:
+    """Mutable outcome holder for one RPC's SLI sample. ``ok`` None at scope
+    exit means "not a sample" (shed, drain, client cancel)."""
+
+    __slots__ = ("ok",)
+
+    def __init__(self) -> None:
+        self.ok: bool | None = None
+
 
 _METHODS: dict[str, tuple[type, type]] = {
     "Execute": (pb.ExecuteRequest, pb.ExecuteResponse),
@@ -116,6 +133,7 @@ class CodeInterpreterServicer:
         drain=None,  # resilience.DrainController
         slo=None,  # observability.SloEngine (shared with the HTTP edge)
         analyzer=None,  # analysis.WorkloadAnalyzer (shared with the HTTP edge)
+        sessions=None,  # sessions.SessionManager (shared with the HTTP edge)
     ) -> None:
         self._code_executor = code_executor
         self._custom_tool_executor = custom_tool_executor
@@ -124,6 +142,7 @@ class CodeInterpreterServicer:
         self._drain = drain
         self._slo = slo
         self._analyzer = analyzer
+        self._sessions = sessions
         self._tracer = tracer or Tracer(metrics=metrics)
         self._deadline_exceeded_total = (
             metrics.counter(
@@ -189,15 +208,19 @@ class CodeInterpreterServicer:
             )
         return Deadline.after(budget) if budget is not None else None
 
-    async def _with_resilience(self, context: grpc.aio.ServicerContext, run):
-        """Run a sandbox-bound RPC body under the edge deadline and the
-        admission gate, mapping the shared shed/deadline abort contract
-        (docs/resilience.md) — the one place it is spelled for gRPC.
-        ``run(deadline)`` returns the success response.
+    @asynccontextmanager
+    async def _resilience_scope(self, context: grpc.aio.ServicerContext):
+        """The shared resilience ladder for sandbox-bound RPCs — drain check,
+        edge deadline, admission gate, the shed/deadline abort contract
+        (docs/resilience.md), and SLI recording — the one place it is spelled
+        for gRPC. Yields ``(deadline, sample)``; unary bodies run inside it
+        via :meth:`_with_resilience`, the streaming generator (which cannot
+        call a plain wrapper because it must yield) enters it directly and
+        sets ``sample.ok`` per terminal event.
 
         SLI recording mirrors the HTTP edge (docs/observability.md "SLOs"):
         server-side failures (blown deadline, open breaker, internal error)
-        burn availability budget; client-fault aborts raised by ``run``
+        burn availability budget; client-fault aborts raised by the body
         (INVALID_ARGUMENT) count good; shed/drain/cancel are excluded."""
         # Drain check BEFORE admission (mirror of the HTTP edge): a
         # draining replica rejects new work retryably while in-flight RPCs
@@ -212,7 +235,7 @@ class CodeInterpreterServicer:
             )
         deadline = self._new_deadline(context)
         slo_start = time.monotonic()
-        outcome: bool | None = None
+        sample = _SliSample()
         try:
             try:
                 # track() covers the admission wait too (mirror of the HTTP
@@ -228,9 +251,9 @@ class CodeInterpreterServicer:
                         if self._admission is not None
                         else nullcontext()
                     ):
-                        response = await run(deadline)
-                outcome = True
-                return response
+                        yield deadline, sample
+                if sample.ok is None:
+                    sample.ok = True
             except AdmissionRejected as e:
                 context.set_trailing_metadata(
                     (("retry-after-s", f"{e.retry_after_s:g}"),)
@@ -240,7 +263,7 @@ class CodeInterpreterServicer:
                     f"service overloaded ({e.reason}); retry in {e.retry_after_s:g}s",
                 )
             except DeadlineExceeded:
-                outcome = False
+                sample.ok = False
                 if self._deadline_exceeded_total is not None:
                     self._deadline_exceeded_total.inc(transport="grpc")
                 await context.abort(
@@ -249,7 +272,7 @@ class CodeInterpreterServicer:
             except BreakerOpenError as e:
                 # Open breaker, no fallback: retryable overload, not an internal
                 # error — UNAVAILABLE with the breaker's retry hint.
-                outcome = False
+                sample.ok = False
                 context.set_trailing_metadata(
                     (("retry-after-s", f"{e.retry_after_s:g}"),)
                 )
@@ -258,18 +281,24 @@ class CodeInterpreterServicer:
                     f"backend temporarily unavailable; retry in {e.retry_after_s:g}s",
                 )
             except asyncio.CancelledError:
-                raise  # client went away: not an SLI sample
+                raise  # client went away: sample.ok untouched (not a sample)
             except _ABORT_ERRORS:
-                outcome = True  # run() aborted INVALID_ARGUMENT: client fault
+                sample.ok = True  # body aborted INVALID_ARGUMENT: client fault
                 raise
             except BaseException:
-                outcome = False  # unhandled → gRPC UNKNOWN
+                sample.ok = False  # unhandled → gRPC UNKNOWN
                 raise
         finally:
-            if self._slo is not None and outcome is not None:
+            if self._slo is not None and sample.ok is not None:
                 self._slo.record(
-                    ok=outcome, duration_s=time.monotonic() - slo_start
+                    ok=sample.ok, duration_s=time.monotonic() - slo_start
                 )
+
+    async def _with_resilience(self, context: grpc.aio.ServicerContext, run):
+        """Run a unary sandbox-bound RPC body under :meth:`_resilience_scope`;
+        ``run(deadline)`` returns the success response."""
+        async with self._resilience_scope(context) as (deadline, _sample):
+            return await run(deadline)
 
     async def Execute(
         self, request: pb.ExecuteRequest, context: grpc.aio.ServicerContext
@@ -349,6 +378,194 @@ class CodeInterpreterServicer:
 
         with self._trace_rpc("Execute", context, rid):
             return await self._with_resilience(context, run)
+
+    async def ExecuteStream(self, request: bytes, context: grpc.aio.ServicerContext):
+        """Server-streaming execute over JSON message bytes (the checked-in
+        ``*_pb2`` descriptors cannot grow new message types without protoc —
+        same trick as ``FleetService``). Request:
+
+            {"source_code": ..., "files": {...}, "env": {...},
+             "timeout": N, "session_id": "sess-..."?}
+
+        With ``session_id`` the execution runs inside that lease
+        (docs/sessions.md); without it, on a single-use sandbox. Responses
+        are the shared streaming event vocabulary: ``{"stream": "stdout"|
+        "stderr", "data": ...}`` chunks, then exactly one terminal
+        ``{"event": "result", ...envelope...}`` or ``{"event": "error",
+        "detail": ...}``. Failures after the first chunk are in-band
+        terminal events (chunks cannot be un-delivered), mirroring SSE."""
+        rid = new_request_id()
+        rpc_start = time.monotonic()
+        try:
+            body = json.loads(request.decode() or "{}")
+            if not isinstance(body, dict):
+                raise ValueError("request must be a JSON object")
+        except (ValueError, UnicodeDecodeError):
+            self._sample_client_fault(rpc_start)
+            await context.abort(
+                grpc.StatusCode.INVALID_ARGUMENT,
+                'request must be JSON like {"source_code": "print(1)"}',
+            )
+        session_id = body.get("session_id")
+        validated = await self._validated_sampled(
+            context,
+            rpc_start,
+            api_models.ExecuteRequest,
+            source_code=body.get("source_code") or "",
+            files=body.get("files") or {},
+            env=body.get("env") or {},
+            timeout=body.get("timeout") or None,
+        )
+        if not validated.source_code:
+            self._sample_client_fault(rpc_start)
+            await context.abort(
+                grpc.StatusCode.INVALID_ARGUMENT, "source_code is required"
+            )
+        with self._trace_rpc("ExecuteStream", context, rid):
+            # The generator cannot run inside _with_resilience (it must
+            # yield), so it enters the shared ladder directly; terminal
+            # events set sample.ok the way a unary body's return would.
+            async with self._resilience_scope(context) as (deadline, sample):
+                stash_predicted_deps(None)
+                verdict = (
+                    self._analyzer.analyze(validated.source_code)
+                    if self._analyzer is not None
+                    else None
+                )
+                if verdict is not None:
+                    if verdict.syntax_error is not None:
+                        # Fail-fast terminal event, zero checkouts.
+                        sample.ok = True
+                        yield json.dumps(
+                            {
+                                "event": "result",
+                                "stdout": "",
+                                "stderr": verdict.syntax_error,
+                                "exit_code": 1,
+                            }
+                        ).encode()
+                        return
+                    if verdict.denials:
+                        await context.abort(
+                            grpc.StatusCode.INVALID_ARGUMENT,
+                            "denied by execution policy: "
+                            f"{verdict.denial_detail()}",
+                        )
+                    stash_predicted_deps(verdict.predicted_deps)
+                async for event in self._stream_events(
+                    session_id, validated, deadline, context
+                ):
+                    if event.get("event") == "error":
+                        sample.ok = event.pop("_client_fault", False)
+                    elif event.get("event") == "result":
+                        sample.ok = True
+                    yield json.dumps(event).encode()
+
+    async def _stream_events(self, session_id, validated, deadline, context):
+        """The shared chunk/terminal event pump for ``ExecuteStream``,
+        sessionful or stateless. Terminal errors carry ``_client_fault``
+        (stripped before the wire) so the caller samples the SLI right."""
+        if session_id is not None:
+            if self._sessions is None:
+                await context.abort(
+                    grpc.StatusCode.UNIMPLEMENTED,
+                    "no session manager wired into this server",
+                )
+            trace = current_trace()
+            if trace is not None:
+                trace.root.attributes["session"] = str(session_id)
+
+            def run(on_event):
+                return self._sessions.execute(
+                    session_id,
+                    validated.source_code,
+                    files=validated.files,
+                    env=validated.env,
+                    timeout_s=validated.timeout,
+                    deadline=deadline,
+                    on_event=on_event,
+                )
+
+        else:
+            from bee_code_interpreter_tpu.observability import unwrap_executor
+
+            backend = unwrap_executor(self._code_executor)
+            if not hasattr(backend, "execute_stream"):
+                await context.abort(
+                    grpc.StatusCode.UNIMPLEMENTED,
+                    "this backend cannot stream output",
+                )
+
+            def run(on_event):
+                return backend.execute_stream(
+                    validated.source_code,
+                    files=validated.files,
+                    env=validated.env,
+                    timeout_s=validated.timeout,
+                    on_event=on_event,
+                    deadline=deadline,
+                )
+
+        async for item in streamed_events(run):
+            if item.get("event") == "error":
+                error = item["error"]
+                if isinstance(error, asyncio.CancelledError):
+                    raise error
+                logger.warning("Streaming execution failed: %r", error)
+                if isinstance(error, DeadlineExceeded):
+                    yield {"event": "error", "detail": "deadline exceeded"}
+                elif isinstance(error, SessionNotFound):
+                    yield {
+                        "event": "error",
+                        "detail": str(error),
+                        "_client_fault": True,
+                    }
+                else:
+                    yield {"event": "error", "detail": "execution failed"}
+            elif item.get("event") == "result":
+                result = item["result"]
+                trace = current_trace()
+                if session_id is not None:
+                    session, outcome = result
+                    record_usage_at_edge(
+                        outcome.usage,
+                        trace,
+                        self._execution_cpu_seconds,
+                        self._execution_peak_rss,
+                    )
+                    yield {
+                        "event": "result",
+                        "stdout": outcome.stdout,
+                        "stderr": outcome.stderr,
+                        "exit_code": outcome.exit_code,
+                        "changed_paths": outcome.changed_paths,
+                        "session_id": session.session_id,
+                        "execution": session.executions,
+                        "trace_id": (
+                            trace.trace_id if trace is not None else None
+                        ),
+                        "usage": outcome.usage,
+                    }
+                else:
+                    record_usage_at_edge(
+                        result.usage,
+                        trace,
+                        self._execution_cpu_seconds,
+                        self._execution_peak_rss,
+                    )
+                    yield {
+                        "event": "result",
+                        "stdout": result.stdout,
+                        "stderr": result.stderr,
+                        "exit_code": result.exit_code,
+                        "files": result.files,
+                        "trace_id": (
+                            trace.trace_id if trace is not None else None
+                        ),
+                        "usage": result.usage,
+                    }
+            else:
+                yield item
 
     async def ParseCustomTool(
         self, request: pb.ParseCustomToolRequest, context: grpc.aio.ServicerContext
@@ -432,6 +649,285 @@ class CodeInterpreterServicer:
 
         with self._trace_rpc("ExecuteCustomTool", context, rid):
             return await self._with_resilience(context, run)
+
+
+SESSION_SERVICE_NAME = "code_interpreter.v1.SessionService"
+
+
+class SessionServicer:
+    """The session-lease API over gRPC (docs/sessions.md): JSON message
+    bytes through a generic handler, the transport mirror of the
+    ``/v1/sessions`` HTTP routes (same manager, same semantics; protoc is
+    unavailable so no generated messages — the ``FleetService`` trick).
+
+    Wraps the main :class:`CodeInterpreterServicer` to reuse its
+    resilience/SLO/trace/analyzer plumbing — per-execute admission,
+    deadline, analysis, and SLI sampling match the stateless path."""
+
+    def __init__(self, servicer: CodeInterpreterServicer) -> None:
+        self._s = servicer
+
+    async def _manager(self, context):
+        manager = self._s._sessions
+        if manager is None:
+            await context.abort(
+                grpc.StatusCode.UNIMPLEMENTED,
+                "no session manager wired into this server",
+            )
+        return manager
+
+    @staticmethod
+    async def _body(request: bytes, context) -> dict:
+        if not request:
+            return {}
+        try:
+            body = json.loads(request.decode())
+            if not isinstance(body, dict):
+                raise ValueError("not an object")
+        except (ValueError, UnicodeDecodeError):
+            await context.abort(
+                grpc.StatusCode.INVALID_ARGUMENT,
+                "request must be a JSON object",
+            )
+        return body
+
+    async def CreateSession(self, request: bytes, context) -> bytes:
+        s = self._s
+        manager = await self._manager(context)
+        body = await self._body(request, context)
+        rid = new_request_id()
+
+        async def run(deadline):
+            stash_predicted_deps(None)
+            try:
+                session = await manager.create(
+                    files=body.get("files") or {},
+                    ttl_s=body.get("ttl_s"),
+                    idle_s=body.get("idle_s"),
+                    deadline=deadline,
+                )
+            except InvalidSessionRequest as e:
+                # The JSON-bytes edge has no generated message to validate
+                # with; the manager is the backstop (its docstring) and the
+                # fault is the client's — INVALID_ARGUMENT, SLI-good, the
+                # exact twin of the HTTP edge's pydantic 422.
+                await context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+            except SessionLimitExceeded as e:
+                context.set_trailing_metadata(
+                    (("retry-after-s", f"{e.retry_after_s:g}"),)
+                )
+                await context.abort(
+                    grpc.StatusCode.RESOURCE_EXHAUSTED, str(e)
+                )
+            return json.dumps(
+                {
+                    "session_id": session.session_id,
+                    "expires_at": session.expires_unix,
+                    "ttl_s": session.ttl_s,
+                    "idle_timeout_s": session.idle_s,
+                    "sandbox": session.lease.name,
+                }
+            ).encode()
+
+        with s._trace_rpc("CreateSession", context, rid):
+            return await s._with_resilience(context, run)
+
+    async def ExecuteInSession(self, request: bytes, context) -> bytes:
+        s = self._s
+        manager = await self._manager(context)
+        body = await self._body(request, context)
+        session_id = str(body.get("session_id") or "")
+        rid = new_request_id()
+        rpc_start = time.monotonic()
+        validated = await s._validated_sampled(
+            context,
+            rpc_start,
+            api_models.SessionExecuteRequest,
+            source_code=body.get("source_code") or "",
+            files=body.get("files") or {},
+            env=body.get("env") or {},
+            timeout=body.get("timeout") or None,
+        )
+
+        async def run(deadline):
+            stash_predicted_deps(None)
+            trace = current_trace()
+            if trace is not None:
+                trace.root.attributes["session"] = session_id
+            verdict = (
+                s._analyzer.analyze(validated.source_code)
+                if s._analyzer is not None
+                else None
+            )
+            if verdict is not None:
+                if verdict.syntax_error is not None:
+                    try:
+                        session = manager.get(session_id)
+                    except SessionNotFound as e:
+                        await context.abort(grpc.StatusCode.NOT_FOUND, str(e))
+                    return json.dumps(
+                        {
+                            "stdout": "",
+                            "stderr": verdict.syntax_error,
+                            "exit_code": 1,
+                            "changed_paths": [],
+                            "session_id": session.session_id,
+                            "execution": session.executions,
+                        }
+                    ).encode()
+                if verdict.denials:
+                    await context.abort(
+                        grpc.StatusCode.INVALID_ARGUMENT,
+                        "denied by execution policy: "
+                        f"{verdict.denial_detail()}",
+                    )
+                stash_predicted_deps(verdict.predicted_deps)
+            try:
+                session, outcome = await manager.execute(
+                    session_id,
+                    validated.source_code,
+                    files=validated.files,
+                    env=validated.env,
+                    timeout_s=validated.timeout,
+                    deadline=deadline,
+                )
+            except SessionNotFound as e:
+                await context.abort(grpc.StatusCode.NOT_FOUND, str(e))
+            record_usage_at_edge(
+                outcome.usage,
+                current_trace(),
+                s._execution_cpu_seconds,
+                s._execution_peak_rss,
+            )
+            return json.dumps(
+                {
+                    "stdout": outcome.stdout,
+                    "stderr": outcome.stderr,
+                    "exit_code": outcome.exit_code,
+                    "changed_paths": outcome.changed_paths,
+                    "session_id": session.session_id,
+                    "execution": session.executions,
+                    "expires_at": session.expires_unix,
+                    "usage": outcome.usage,
+                }
+            ).encode()
+
+        with s._trace_rpc("ExecuteInSession", context, rid):
+            return await s._with_resilience(context, run)
+
+    async def Checkpoint(self, request: bytes, context) -> bytes:
+        s = self._s
+        manager = await self._manager(context)
+        body = await self._body(request, context)
+        session_id = str(body.get("session_id") or "")
+        rid = new_request_id()
+
+        async def run(deadline):
+            stash_predicted_deps(None)
+            try:
+                session, checkpoint = await manager.checkpoint(
+                    session_id, deadline=deadline
+                )
+            except SessionNotFound as e:
+                await context.abort(grpc.StatusCode.NOT_FOUND, str(e))
+            return json.dumps(
+                {
+                    "session_id": session.session_id,
+                    "checkpoint_id": checkpoint.checkpoint_id,
+                    "files": checkpoint.files,
+                }
+            ).encode()
+
+        with s._trace_rpc("Checkpoint", context, rid):
+            return await s._with_resilience(context, run)
+
+    async def Rollback(self, request: bytes, context) -> bytes:
+        s = self._s
+        manager = await self._manager(context)
+        body = await self._body(request, context)
+        session_id = str(body.get("session_id") or "")
+        rid = new_request_id()
+
+        async def run(deadline):
+            stash_predicted_deps(None)
+            try:
+                session, checkpoint = await manager.rollback(
+                    session_id,
+                    str(body.get("checkpoint_id") or ""),
+                    deadline=deadline,
+                )
+            except (SessionNotFound, CheckpointNotFound) as e:
+                await context.abort(grpc.StatusCode.NOT_FOUND, str(e))
+            return json.dumps(
+                {
+                    "session_id": session.session_id,
+                    "checkpoint_id": checkpoint.checkpoint_id,
+                    "files": checkpoint.files,
+                }
+            ).encode()
+
+        with s._trace_rpc("Rollback", context, rid):
+            return await s._with_resilience(context, run)
+
+    async def DeleteSession(self, request: bytes, context) -> bytes:
+        manager = await self._manager(context)
+        body = await self._body(request, context)
+        new_request_id()
+        try:
+            session = await manager.release(str(body.get("session_id") or ""))
+        except SessionNotFound as e:
+            await context.abort(grpc.StatusCode.NOT_FOUND, str(e))
+        return json.dumps(
+            {
+                "session_id": session.session_id,
+                "released": True,
+                "executions": session.executions,
+            }
+        ).encode()
+
+    async def ListSessions(self, request: bytes, context) -> bytes:
+        manager = await self._manager(context)
+        return json.dumps(manager.snapshot()).encode()
+
+
+_SESSION_METHODS = (
+    "CreateSession",
+    "ExecuteInSession",
+    "Checkpoint",
+    "Rollback",
+    "DeleteSession",
+    "ListSessions",
+)
+
+
+def _session_handler(servicer: SessionServicer) -> grpc.GenericRpcHandler:
+    passthrough = bytes  # JSON bytes in/out; no generated messages
+    return grpc.method_handlers_generic_handler(
+        SESSION_SERVICE_NAME,
+        {
+            name: grpc.unary_unary_rpc_method_handler(
+                getattr(servicer, name),
+                request_deserializer=passthrough,
+                response_serializer=passthrough,
+            )
+            for name in _SESSION_METHODS
+        },
+    )
+
+
+def session_stubs(channel: grpc.aio.Channel | grpc.Channel) -> dict[str, object]:
+    """Client-side multicallables for the session RPCs (tooling/tests);
+    send JSON bytes and json.loads the reply."""
+    return {
+        name: channel.unary_unary(f"/{SESSION_SERVICE_NAME}/{name}")
+        for name in _SESSION_METHODS
+    }
+
+
+def execute_stream_stub(channel: grpc.aio.Channel | grpc.Channel):
+    """Client-side ``ExecuteStream`` multicallable: send JSON request
+    bytes, iterate JSON event bytes (docs/sessions.md wire format)."""
+    return channel.unary_stream(f"/{SERVICE_NAME}/ExecuteStream")
 
 
 FLEET_SERVICE_NAME = "code_interpreter.v1.FleetService"
@@ -746,6 +1242,13 @@ def _generic_handler(servicer: CodeInterpreterServicer) -> grpc.GenericRpcHandle
         )
         for name, (req_cls, resp_cls) in _METHODS.items()
     }
+    # Server-streaming execute rides the same service as JSON message bytes
+    # (new proto messages are impossible without protoc; see FleetService).
+    handlers["ExecuteStream"] = grpc.unary_stream_rpc_method_handler(
+        servicer.ExecuteStream,
+        request_deserializer=bytes,
+        response_serializer=bytes,
+    )
     return grpc.method_handlers_generic_handler(SERVICE_NAME, handlers)
 
 
@@ -787,6 +1290,7 @@ class GrpcServer:
         slo=None,  # observability.SloEngine shared with the HTTP edge
         debug_bundle=None,  # callable -> dict (ApplicationContext builder)
         analyzer=None,  # analysis.WorkloadAnalyzer shared with the HTTP edge
+        sessions=None,  # sessions.SessionManager shared with the HTTP edge
     ) -> None:
         self._servicer = CodeInterpreterServicer(
             code_executor,
@@ -798,6 +1302,7 @@ class GrpcServer:
             drain=drain,
             slo=slo,
             analyzer=analyzer,
+            sessions=sessions,
         )
         self._slo = slo
         self._debug_bundle = debug_bundle
@@ -832,6 +1337,7 @@ class GrpcServer:
         reflection = ReflectionServicer(
             (
                 SERVICE_NAME,
+                SESSION_SERVICE_NAME,
                 FLEET_SERVICE_NAME,
                 OBSERVABILITY_SERVICE_NAME,
                 HEALTH_SERVICE_NAME,
@@ -841,6 +1347,7 @@ class GrpcServer:
         self._server.add_generic_rpc_handlers(
             (
                 _generic_handler(self._servicer),
+                _session_handler(SessionServicer(self._servicer)),
                 _fleet_handler(FleetServicer(self._fleet)),
                 _observability_handler(
                     ObservabilityServicer(

@@ -72,6 +72,7 @@ from bee_code_interpreter_tpu.observability import (
     profile_artifacts,
     record_usage_at_edge,
     register_usage_metrics,
+    unwrap_executor,
 )
 from bee_code_interpreter_tpu.resilience import (
     AdmissionController,
@@ -79,6 +80,13 @@ from bee_code_interpreter_tpu.resilience import (
     BreakerOpenError,
     Deadline,
     DeadlineExceeded,
+    SandboxTransientError,
+)
+from bee_code_interpreter_tpu.sessions import (
+    CheckpointNotFound,
+    SessionLimitExceeded,
+    SessionNotFound,
+    streamed_events,
 )
 from bee_code_interpreter_tpu.services.code_executor import CodeExecutor
 from bee_code_interpreter_tpu.services.custom_tool_executor import (
@@ -115,6 +123,7 @@ def create_http_server(
     slo=None,  # observability.SloEngine for GET /v1/slo + SLI recording
     debug_bundle=None,  # callable -> dict (ApplicationContext.build_debug_bundle)
     analyzer=None,  # analysis.WorkloadAnalyzer for the pre-flight code gate
+    sessions=None,  # sessions.SessionManager for the /v1/sessions lease API
 ) -> web.Application:
     app = web.Application(client_max_size=1 << 30)
     metrics = metrics or Registry()
@@ -177,7 +186,13 @@ def create_http_server(
                         else nullcontext()
                     ):
                         response = await run(deadline)
-                outcome = response.status < 500
+                # bci_sli_bad: an SSE run whose terminal event reported a
+                # server-side failure after the 200 status was already spent
+                # (_run_sse) — the sample must burn budget like the buffered
+                # path's 500 would.
+                outcome = response.status < 500 and not getattr(
+                    response, "bci_sli_bad", False
+                )
                 return response
             except AdmissionRejected as e:
                 logger.warning("Request shed: %s", e)
@@ -269,6 +284,90 @@ def create_http_server(
                 text=e.json(), content_type="application/json"
             ) from e
 
+    def _truthy_query(request: web.Request, name: str) -> bool:
+        return request.query.get(name, "").lower() in ("1", "true", "yes", "on")
+
+    def _stream_backend():
+        """The pool/local backend implementing ``execute_stream`` behind the
+        resilience fronts. Streaming deliberately bypasses retry/replay/
+        hedging: chunks already delivered to a client cannot be
+        un-delivered, so a mid-stream failure is a terminal error event,
+        never a silent re-run."""
+        backend = unwrap_executor(code_executor)
+        return backend if hasattr(backend, "execute_stream") else None
+
+    async def _sse_prepare(request: web.Request) -> web.StreamResponse:
+        response = web.StreamResponse(
+            headers={
+                "Content-Type": "text/event-stream",
+                "Cache-Control": "no-store",
+                "X-Accel-Buffering": "no",  # proxies must not re-buffer SSE
+            }
+        )
+        response.enable_chunked_encoding()
+        await response.prepare(request)
+        return response
+
+    async def _sse_event(response, event: str, data: dict) -> None:
+        await response.write(
+            f"event: {event}\ndata: {json.dumps(data)}\n\n".encode()
+        )
+
+    async def _run_sse(request, verdict, execute_call, envelope):
+        """Drive one streaming execution as SSE (docs/sessions.md
+        "Streaming wire format"): ``stdout``/``stderr`` events per chunk,
+        exactly one terminal ``result`` (the usual envelope, trace_id
+        included) or ``error`` event. Once the stream is prepared the HTTP
+        status is spent, so failures are in-band terminal events."""
+        response = await _sse_prepare(request)
+        if verdict is not None and verdict.syntax_error is not None:
+            # Fail-fast mirrors the buffered path: zero sandbox checkouts,
+            # the terminal event IS the whole stream.
+            trace = current_trace()
+            await _sse_event(
+                response,
+                "result",
+                models.ExecuteResponse(
+                    stdout="",
+                    stderr=verdict.syntax_error,
+                    exit_code=1,
+                    files={},
+                    trace_id=trace.trace_id if trace is not None else None,
+                    timings_ms=trace.stage_ms() if trace is not None else None,
+                ).model_dump(),
+            )
+            await response.write_eof()
+            return response
+        async for item in streamed_events(execute_call):
+            if item.get("event") == "error":
+                error = item["error"]
+                if isinstance(error, asyncio.CancelledError):
+                    raise error  # our own unwind (client gone); don't mask it
+                logger.warning("Streaming execution failed: %r", error)
+                if isinstance(error, DeadlineExceeded):
+                    detail = "Deadline exceeded"
+                elif isinstance(error, SessionNotFound):
+                    detail = str(error)
+                else:
+                    detail = "Execution failed"
+                if not isinstance(error, SessionNotFound):
+                    # The 200 status was spent at prepare time, but a
+                    # mid-stream server failure must still burn availability
+                    # budget — the gRPC twin (ExecuteStream) samples the
+                    # identical failure bad, and the transports must agree.
+                    # SessionNotFound is the client's fault (the buffered
+                    # path's 404), so it stays good.
+                    response.bci_sli_bad = True
+                await _sse_event(response, "error", {"detail": detail})
+            elif item.get("event") == "result":
+                await _sse_event(response, "result", envelope(item["result"]))
+            else:
+                await _sse_event(
+                    response, item["stream"], {"text": item["data"]}
+                )
+        await response.write_eof()
+        return response
+
     async def execute(request: web.Request) -> web.Response:
         # Admission runs BEFORE the body is read: a shed request must cost a
         # queue check, not a (up to client_max_size) body read + pydantic
@@ -279,13 +378,14 @@ def create_http_server(
             # sequential keep-alive requests on ONE connection task, so the
             # contextvar would otherwise leak across requests.
             stash_predicted_deps(None)
+            streaming = _truthy_query(request, "stream")
             verdict = (
                 analyzer.analyze(req.source_code)
                 if analyzer is not None
                 else None
             )
             if verdict is not None:
-                if verdict.syntax_error is not None:
+                if verdict.syntax_error is not None and not streaming:
                     # Fail-fast: the sandbox would have died at parse with
                     # this exact stderr shape — answer it from the edge
                     # without a pool checkout (the fleet journal stays
@@ -321,6 +421,46 @@ def create_http_server(
                 # The edge already scanned: ship the prediction with the
                 # data-plane call so the pod skips its own scan.
                 stash_predicted_deps(verdict.predicted_deps)
+            if streaming:
+                backend = _stream_backend()
+                if backend is None:
+                    return web.json_response(
+                        {"detail": "this backend cannot stream output"},
+                        status=501,
+                    )
+
+                def envelope(result) -> dict:
+                    trace = current_trace()
+                    record_usage_at_edge(
+                        result.usage,
+                        trace,
+                        execution_cpu_seconds,
+                        execution_peak_rss,
+                    )
+                    return models.ExecuteResponse(
+                        **result.model_dump(),
+                        trace_id=trace.trace_id if trace is not None else None,
+                        timings_ms=(
+                            trace.stage_ms() if trace is not None else None
+                        ),
+                        analysis=(
+                            verdict.annotation() if verdict is not None else None
+                        ),
+                    ).model_dump()
+
+                return await _run_sse(
+                    request,
+                    verdict,
+                    lambda on_event: backend.execute_stream(
+                        req.source_code,
+                        files=req.files,
+                        env=req.env,
+                        timeout_s=req.timeout,
+                        on_event=on_event,
+                        deadline=deadline,
+                    ),
+                    envelope,
+                )
             logger.info("Executing code: %s", req.source_code)
             try:
                 result = await code_executor.execute(
@@ -492,6 +632,266 @@ def create_http_server(
 
         return await with_resilience(run)
 
+    # ------------------------------------------------------------- sessions
+
+    def _sessions_unwired() -> web.Response:
+        return web.json_response(
+            {"detail": "no session manager wired into this server"}, status=501
+        )
+
+    def _session_trace_attr(session_id: str) -> None:
+        """Thread the session id through tracing: a ``session`` attribute on
+        the request's root span, visible in /v1/traces and the OTLP export."""
+        trace = current_trace()
+        if trace is not None:
+            trace.root.attributes["session"] = session_id
+
+    def _session_execute_envelope(
+        session, outcome, verdict=None
+    ) -> dict:
+        trace = current_trace()
+        record_usage_at_edge(
+            outcome.usage, trace, execution_cpu_seconds, execution_peak_rss
+        )
+        return models.SessionExecuteResponse(
+            stdout=outcome.stdout,
+            stderr=outcome.stderr,
+            exit_code=outcome.exit_code,
+            changed_paths=outcome.changed_paths,
+            session_id=session.session_id,
+            execution=session.executions,
+            expires_at=session.expires_unix,
+            trace_id=trace.trace_id if trace is not None else None,
+            timings_ms=trace.stage_ms() if trace is not None else None,
+            usage=outcome.usage,
+            analysis=verdict.annotation() if verdict is not None else None,
+        ).model_dump()
+
+    async def session_create(request: web.Request) -> web.Response:
+        if sessions is None:
+            return _sessions_unwired()
+
+        async def run(deadline):
+            req = await parse_body(request, models.SessionCreateRequest)
+            stash_predicted_deps(None)
+            try:
+                session = await sessions.create(
+                    files=req.files,
+                    ttl_s=req.ttl_s,
+                    idle_s=req.idle_s,
+                    deadline=deadline,
+                )
+            except SessionLimitExceeded as e:
+                return web.json_response(
+                    {"detail": str(e)},
+                    status=429,
+                    headers={
+                        "Retry-After": str(max(1, math.ceil(e.retry_after_s)))
+                    },
+                )
+            except (DeadlineExceeded, BreakerOpenError):
+                raise  # shared resilience contract (504/503)
+            except Exception:
+                logger.exception("Session create failed")
+                return web.json_response(
+                    {"detail": "Session create failed"}, status=500
+                )
+            _session_trace_attr(session.session_id)
+            return web.json_response(
+                models.SessionCreateResponse(
+                    session_id=session.session_id,
+                    expires_at=session.expires_unix,
+                    ttl_s=session.ttl_s,
+                    idle_timeout_s=session.idle_s,
+                    sandbox=session.lease.name,
+                ).model_dump()
+            )
+
+        return await with_resilience(run)
+
+    async def session_execute(request: web.Request) -> web.Response:
+        if sessions is None:
+            return _sessions_unwired()
+        session_id = request.match_info["session_id"]
+
+        async def run(deadline):
+            req = await parse_body(request, models.SessionExecuteRequest)
+            stash_predicted_deps(None)
+            _session_trace_attr(session_id)
+            streaming = _truthy_query(request, "stream")
+            # Admission/deadline/analysis/SLO apply per-execute exactly as
+            # on the stateless path (docs/sessions.md): the analyzer gate
+            # runs BEFORE the leased sandbox is touched.
+            verdict = (
+                analyzer.analyze(req.source_code)
+                if analyzer is not None
+                else None
+            )
+            try:
+                session = sessions.get(session_id)
+            except SessionNotFound as e:
+                return web.json_response({"detail": str(e)}, status=404)
+            if verdict is not None:
+                if verdict.syntax_error is not None and not streaming:
+                    # Fail-fast without touching the lease (it stays warm,
+                    # its idle clock untouched by a doomed submission).
+                    return web.json_response(
+                        _session_execute_envelope(
+                            session,
+                            _syntax_outcome(verdict.syntax_error),
+                        )
+                    )
+                if verdict.denials:
+                    logger.warning(
+                        "Session execute denied by policy: %s",
+                        verdict.denial_detail(),
+                    )
+                    return web.json_response(
+                        {
+                            "detail": "Denied by execution policy",
+                            "violations": [
+                                f.to_dict() for f in verdict.denials
+                            ],
+                        },
+                        status=422,
+                    )
+                stash_predicted_deps(verdict.predicted_deps)
+            if streaming:
+                return await _run_sse(
+                    request,
+                    verdict,
+                    lambda on_event: sessions.execute(
+                        session_id,
+                        req.source_code,
+                        files=req.files,
+                        env=req.env,
+                        timeout_s=req.timeout,
+                        deadline=deadline,
+                        on_event=on_event,
+                    ),
+                    lambda pair: _session_execute_envelope(
+                        pair[0], pair[1], verdict
+                    ),
+                )
+            try:
+                session, outcome = await sessions.execute(
+                    session_id,
+                    req.source_code,
+                    files=req.files,
+                    env=req.env,
+                    timeout_s=req.timeout,
+                    deadline=deadline,
+                )
+            except SessionNotFound as e:
+                return web.json_response({"detail": str(e)}, status=404)
+            except (DeadlineExceeded, BreakerOpenError):
+                raise  # shared resilience contract (504/503)
+            except SandboxTransientError:
+                logger.exception("Leased sandbox died mid-execute")
+                return web.json_response(
+                    {"detail": "Session sandbox died; lease ended"},
+                    status=500,
+                )
+            except Exception:
+                logger.exception("Session execution failed")
+                return web.json_response(
+                    {"detail": "Execution failed"}, status=500
+                )
+            return web.json_response(
+                _session_execute_envelope(session, outcome, verdict)
+            )
+
+        return await with_resilience(run)
+
+    def _syntax_outcome(stderr: str):
+        from bee_code_interpreter_tpu.sessions import LeaseOutcome
+
+        return LeaseOutcome(stdout="", stderr=stderr, exit_code=1)
+
+    async def session_checkpoint(request: web.Request) -> web.Response:
+        if sessions is None:
+            return _sessions_unwired()
+        session_id = request.match_info["session_id"]
+
+        async def run(deadline):
+            stash_predicted_deps(None)
+            _session_trace_attr(session_id)
+            try:
+                session, checkpoint = await sessions.checkpoint(
+                    session_id, deadline=deadline
+                )
+            except SessionNotFound as e:
+                return web.json_response({"detail": str(e)}, status=404)
+            except (DeadlineExceeded, BreakerOpenError):
+                raise
+            except Exception:
+                logger.exception("Session checkpoint failed")
+                return web.json_response(
+                    {"detail": "Checkpoint failed"}, status=500
+                )
+            return web.json_response(
+                models.SessionCheckpointResponse(
+                    session_id=session.session_id,
+                    checkpoint_id=checkpoint.checkpoint_id,
+                    files=checkpoint.files,
+                ).model_dump()
+            )
+
+        return await with_resilience(run)
+
+    async def session_rollback(request: web.Request) -> web.Response:
+        if sessions is None:
+            return _sessions_unwired()
+        session_id = request.match_info["session_id"]
+
+        async def run(deadline):
+            req = await parse_body(request, models.SessionRollbackRequest)
+            stash_predicted_deps(None)
+            _session_trace_attr(session_id)
+            try:
+                session, checkpoint = await sessions.rollback(
+                    session_id, req.checkpoint_id, deadline=deadline
+                )
+            except (SessionNotFound, CheckpointNotFound) as e:
+                return web.json_response({"detail": str(e)}, status=404)
+            except (DeadlineExceeded, BreakerOpenError):
+                raise
+            except Exception:
+                logger.exception("Session rollback failed")
+                return web.json_response(
+                    {"detail": "Rollback failed"}, status=500
+                )
+            return web.json_response(
+                models.SessionCheckpointResponse(
+                    session_id=session.session_id,
+                    checkpoint_id=checkpoint.checkpoint_id,
+                    files=checkpoint.files,
+                ).model_dump()
+            )
+
+        return await with_resilience(run)
+
+    async def session_delete(request: web.Request) -> web.Response:
+        if sessions is None:
+            return _sessions_unwired()
+        session_id = request.match_info["session_id"]
+        try:
+            session = await sessions.release(session_id)
+        except SessionNotFound as e:
+            return web.json_response({"detail": str(e)}, status=404)
+        return web.json_response(
+            {
+                "session_id": session.session_id,
+                "released": True,
+                "executions": session.executions,
+            }
+        )
+
+    async def session_list(_request: web.Request) -> web.Response:
+        if sessions is None:
+            return _sessions_unwired()
+        return web.json_response(sessions.snapshot())
+
     async def healthz(request: web.Request) -> web.Response:
         # "draining" is a distinct liveness answer (still HTTP 200: the
         # process is healthy, just finishing up) so preStop hooks and
@@ -610,6 +1010,11 @@ def create_http_server(
         if supervisor is not None:
             snap["supervisor"] = supervisor.snapshot()
         snap["draining"] = bool(drain is not None and drain.draining)
+        if sessions is not None:
+            # Lease table next to the pool view: leased pods in `pods`
+            # already carry owner session + lease age; this is the summary
+            # (active/max, how leases have been ending).
+            snap["sessions"] = sessions.snapshot()
         return web.json_response(snap)
 
     async def fleet_events(request: web.Request) -> web.Response:
@@ -626,6 +1031,12 @@ def create_http_server(
         return web.json_response({"events": fleet.events(limit=limit)})
 
     app.router.add_post("/v1/execute", execute)
+    app.router.add_post("/v1/sessions", session_create)
+    app.router.add_get("/v1/sessions", session_list)
+    app.router.add_post("/v1/sessions/{session_id}/execute", session_execute)
+    app.router.add_post("/v1/sessions/{session_id}/checkpoint", session_checkpoint)
+    app.router.add_post("/v1/sessions/{session_id}/rollback", session_rollback)
+    app.router.add_delete("/v1/sessions/{session_id}", session_delete)
     app.router.add_post("/v1/profile", profile)
     app.router.add_post("/v1/parse-custom-tool", parse_custom_tool)
     app.router.add_post("/v1/execute-custom-tool", execute_custom_tool)

@@ -42,6 +42,69 @@ class ExecuteResponse(BaseModel):
     analysis: dict | None = None
 
 
+class SessionCreateRequest(BaseModel):
+    """``POST /v1/sessions`` (docs/sessions.md): lease one warm sandbox.
+
+    ``files`` restores an initial workspace snapshot into the lease (the
+    same {path: object id} map ``/v1/execute`` takes). ``ttl_s``/``idle_s``
+    may shorten the configured bounds, never extend them."""
+
+    files: dict[AbsolutePath, Hash] = Field(default_factory=dict)
+    ttl_s: float | None = Field(default=None, gt=0)
+    idle_s: float | None = Field(default=None, gt=0)
+
+
+class SessionCreateResponse(BaseModel):
+    session_id: str
+    # Unix seconds after which the lease is expired regardless of activity;
+    # idle_timeout_s is the bound between executions.
+    expires_at: float
+    ttl_s: float
+    idle_timeout_s: float
+    sandbox: str
+
+
+class SessionExecuteRequest(BaseModel):
+    """``POST /v1/sessions/{id}/execute``: one REPL turn. ``files`` are
+    *delta* uploads into the live workspace — there is no per-execute
+    restore; the sandbox keeps its state."""
+
+    source_code: str
+    files: dict[AbsolutePath, Hash] = Field(default_factory=dict)
+    env: dict[str, str] = Field(default_factory=dict)
+    timeout: float | None = Field(default=None, gt=0)
+
+
+class SessionExecuteResponse(BaseModel):
+    """Leased-execute envelope: like ``ExecuteResponse`` but the snapshot is
+    deferred — ``changed_paths`` lists what the run touched; object ids
+    exist only after ``POST /v1/sessions/{id}/checkpoint``."""
+
+    stdout: str
+    stderr: str
+    exit_code: int
+    changed_paths: list[str]
+    session_id: str
+    execution: int  # 1-based index of this execute within the lease
+    expires_at: float
+    trace_id: str | None = None
+    timings_ms: dict[str, float] | None = None
+    usage: dict | None = None
+    analysis: dict | None = None
+
+
+class SessionCheckpointResponse(BaseModel):
+    session_id: str
+    checkpoint_id: str
+    # The snapshot: the same {path: object id} map the stateless path
+    # returns — feedable back into /v1/execute or a new session.
+    files: dict[AbsolutePath, Hash]
+
+
+class SessionRollbackRequest(BaseModel):
+    checkpoint_id: str
+
+
 class ProfileRequest(BaseModel):
     """``POST /v1/profile`` (docs/observability.md "Profiling workflow").
 

@@ -158,6 +158,13 @@ class ApplicationContext:
         sweeper = getattr(self, "_storage_sweeper_task", None)
         if sweeper is not None:
             sweeper.cancel()
+        sessions = self.__dict__.get("sessions")
+        if sessions is not None:
+            # Leases end BEFORE the executor closes: each teardown journals
+            # its reason and returns the sandbox through the backend while
+            # the backend is still alive to do it.
+            await sessions.stop()
+            await sessions.close_all("shutdown")
         if self.exporter is not None:
             # Final best-effort flush (retry-bounded) before teardown.
             await self.exporter.stop()
@@ -204,6 +211,34 @@ class ApplicationContext:
             hedge_delay_s=cfg.hedge_delay_s,
             metrics=self.metrics,
         )
+
+    @cached_property
+    def sessions(self):
+        """Session-lease manager shared by both transports
+        (docs/sessions.md): one lease table, one expiry sweep, one cap for
+        the whole service. Its background sweep starts with the first
+        access inside a running loop (tests drive ``sweep_once`` by hand)."""
+        from bee_code_interpreter_tpu.sessions import SessionManager
+
+        cfg = self.config
+        manager = SessionManager(
+            self.code_executor,
+            self.storage,
+            max_sessions=cfg.session_max,
+            ttl_s=cfg.session_ttl_s,
+            idle_s=cfg.session_idle_s,
+            sweep_interval_s=cfg.session_sweep_interval_s,
+            retry_after_s=cfg.admission_retry_after_s,
+            metrics=self.metrics,
+            drain=self.drain,
+        )
+        try:
+            asyncio.get_running_loop()
+        except RuntimeError:
+            pass
+        else:
+            manager.start()
+        return manager
 
     @cached_property
     def analyzer(self):
@@ -346,6 +381,7 @@ class ApplicationContext:
             slo=self.slo,
             debug_bundle=self.build_debug_bundle,
             analyzer=self.analyzer,
+            sessions=self.sessions,
         )
 
     @cached_property
@@ -367,4 +403,5 @@ class ApplicationContext:
             slo=self.slo,
             debug_bundle=self.build_debug_bundle,
             analyzer=self.analyzer,
+            sessions=self.sessions,
         )

@@ -184,6 +184,22 @@ class Config(BaseModel):
     # SLO sliding-window bucket coarseness; windows span 5m..6h.
     slo_window_bucket_s: float = Field(default=10.0, gt=0)
 
+    # --- sessions: leased sandboxes + streaming (new; see docs/sessions.md) ---
+    # Hard cap on concurrent session leases. Each lease pins one warm
+    # sandbox the stateless pool cannot serve with, so this bounds how much
+    # of the fleet interactive clients can hold; past the cap POST
+    # /v1/sessions answers 429.
+    session_max: int = Field(default=16, ge=0)
+    # Total lease lifetime: a session older than this is expired by the
+    # background sweep regardless of activity (a request may ask for less,
+    # never more).
+    session_ttl_s: float = Field(default=900.0, gt=0)
+    # Idle bound between executions inside a lease: a REPL nobody is typing
+    # into gives its sandbox back.
+    session_idle_s: float = Field(default=120.0, gt=0)
+    # Expiry sweep cadence; also how quickly a drain reclaims idle leases.
+    session_sweep_interval_s: float = Field(default=1.0, gt=0)
+
     # --- edge static analysis (new; see docs/analysis.md) ---
     # Master switch for the pre-flight code gate at both API edges: one AST
     # pass per submission that fail-fasts syntax errors without consuming a

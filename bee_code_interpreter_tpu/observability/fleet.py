@@ -31,18 +31,25 @@ from collections import deque
 from dataclasses import dataclass, field
 
 # Canonical lifecycle states. Terminal states drop the pod from the live
-# snapshot; its history stays in the event ring.
+# snapshot; its history stays in the event ring. ``leased`` marks a sandbox
+# owned by an interactive session (docs/sessions.md) — busy from the pool's
+# point of view even while idle between executes — and ``lease_expired`` is
+# the terminal event for a lease the service ended (TTL, idle timeout,
+# drain, shutdown), as opposed to ``released`` (clean client release) and
+# ``reaped`` (the sandbox died under the lease).
 STATES = (
     "spawning",
     "ready",
     "assigned",
+    "leased",
     "executing",
     "released",
+    "lease_expired",
     "reaped",
     "failed",
 )
-TERMINAL_STATES = frozenset(("released", "reaped", "failed"))
-BUSY_STATES = frozenset(("assigned", "executing"))
+TERMINAL_STATES = frozenset(("released", "lease_expired", "reaped", "failed"))
+BUSY_STATES = frozenset(("assigned", "leased", "executing"))
 
 
 def unwrap_executor(executor):
@@ -80,9 +87,13 @@ class PodRecord:
     spawn_s: float | None = None
     executions: int = 0
     last_reason: str | None = None
+    # Session lease (docs/sessions.md): owner session id + when the lease
+    # began, so operators can tell a busy REPL from a stuck pod.
+    session: str | None = None
+    leased_mono: float | None = None
 
     def to_dict(self) -> dict:
-        return {
+        out = {
             "pod": self.name,
             "state": self.state,
             "workers": self.workers,
@@ -91,6 +102,14 @@ class PodRecord:
             "executions": self.executions,
             "reason": self.last_reason,
         }
+        if self.session is not None:
+            out["session"] = self.session
+            out["lease_age_s"] = (
+                time.monotonic() - self.leased_mono
+                if self.leased_mono is not None
+                else None
+            )
+        return out
 
 
 class FleetJournal:
@@ -165,6 +184,13 @@ class FleetJournal:
         event.update(attrs)
 
         self.counts[state] += 1
+        if state == "leased":
+            # Set once per sandbox (a sandbox serves at most one lease): the
+            # post-execute re-record keeps the ORIGINAL lease age.
+            if rec.leased_mono is None:
+                rec.leased_mono = now
+            if "session" in attrs:
+                rec.session = attrs["session"]
         if state == "ready" and rec.ready_mono is None:
             rec.ready_mono = now
             rec.spawn_s = now - rec.created_mono

@@ -176,7 +176,16 @@ class InflightRegistry:
 
 class PoolSupervisor:
     """Background reconciler for one pool executor (k8s pod groups or native
-    processes). The executor contract is duck-typed:
+    processes).
+
+    Session leases (docs/sessions.md) are invisible here BY CONSTRUCTION:
+    a leased sandbox was popped out of the queue (so ``reap_unhealthy_idle``
+    never probes it) and enters the inflight registry only while one of its
+    executes runs (so the watchdog sees a wedged leased execute, never a
+    healthy-but-idle REPL). An owned sandbox is not "stuck"; the
+    SessionManager's own TTL/idle sweep is its reaper.
+
+    The executor contract is duck-typed:
 
     - ``reap_unhealthy_idle()`` (async) — probe queued warm sandboxes, reap
       dead ones, return the count;

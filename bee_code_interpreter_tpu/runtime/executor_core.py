@@ -27,11 +27,14 @@ Deliberate TPU-first departures from the reference:
 from __future__ import annotations
 
 import asyncio
+import codecs
 import dataclasses
 import os
+import signal
 import sys
 import tempfile
 from pathlib import Path
+from typing import AsyncIterator
 
 from bee_code_interpreter_tpu.observability.accounting import UsageMeter
 from bee_code_interpreter_tpu.runtime import dep_guess
@@ -264,7 +267,10 @@ class ExecutorCore:
     ) -> ExecutionOutcome:
         env = env or {}
         timeout_s = timeout_s or self.default_timeout_s
-        before = snapshot_workspace(self.workspace)
+        # Off-loop walk: the workspace scan is sync filesystem I/O, and in the
+        # pod server it would otherwise stall every concurrent data-plane
+        # request for the duration of the walk.
+        before = await asyncio.to_thread(snapshot_workspace, self.workspace)
         # The meter opens before the dep install on purpose: pip time/CPU is
         # part of what this execution cost the sandbox.
         meter = UsageMeter()
@@ -298,19 +304,24 @@ class ExecutorCore:
             except asyncio.TimeoutError:
                 # Reference behavior: kill, exit_code -1, fixed stderr message
                 # (server.rs:151-169); the kill targets the whole group.
-                import signal
-
-                try:
-                    os.killpg(proc.pid, signal.SIGKILL)
-                except (ProcessLookupError, PermissionError):
-                    proc.kill()
+                self._kill_process_group(proc)
                 await proc.wait()
                 stdout, stderr, exit_code = "", EXECUTION_TIMED_OUT, -1
+            finally:
+                if proc.returncode is None:
+                    # Cancelled mid-run (vanished client, watchdog kill): the
+                    # user process must not outlive the execute that owns it.
+                    # Under a lease the workspace survives this call, so an
+                    # orphan would keep mutating state the next REPL turn (or
+                    # a checkpoint) reads; the streaming twin already kills in
+                    # its finally for the same reason.
+                    self._kill_process_group(proc)
+                    await proc.wait()
 
         if pip_notes:
             stderr = pip_notes + ("\n" + stderr if stderr else "")
 
-        after = snapshot_workspace(self.workspace)
+        after = await asyncio.to_thread(snapshot_workspace, self.workspace)
         changed = changed_files(before, after)
         usage = meter.finish(
             workspace_bytes_written=sum(after[rel][1] for rel in changed),
@@ -321,6 +332,153 @@ class ExecutorCore:
         return ExecutionOutcome(
             stdout=stdout, stderr=stderr, exit_code=exit_code, files=files,
             usage=usage,
+        )
+
+    @staticmethod
+    def _kill_process_group(proc) -> None:
+        """Kill the whole process group (user code may spawn subprocesses; a
+        surviving orphan would keep writing into a torn-down workspace)."""
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            proc.kill()
+
+    async def execute_stream(
+        self,
+        source_code: str,
+        env: dict[str, str] | None = None,
+        timeout_s: float | None = None,
+        predicted_deps: list[str] | None = None,
+    ) -> AsyncIterator[tuple[str, object]]:
+        """Streaming twin of :meth:`execute`: an async generator yielding
+        ``("stdout"|"stderr", text_chunk)`` as the child produces output,
+        then a final ``("end", ExecutionOutcome)`` with the same envelope
+        the non-streaming path returns.
+
+        Contract notes:
+
+        - Chunk boundaries are whatever the pipe delivered; multi-byte UTF-8
+          sequences split across reads are held by an incremental decoder so
+          chunks are always valid text.
+        - On timeout the process group is killed and the final outcome
+          mirrors :meth:`execute` exactly (stdout "", stderr
+          ``EXECUTION_TIMED_OUT``, exit_code -1) — chunks already delivered
+          stay delivered; the envelope is authoritative.
+        - An abandoned generator (consumer gone mid-stream) kills the
+          process group in its ``finally`` — a vanished client must never
+          leave user code running against a workspace nothing will snapshot.
+        """
+        env = env or {}
+        timeout_s = timeout_s or self.default_timeout_s
+        before = await asyncio.to_thread(snapshot_workspace, self.workspace)
+        meter = UsageMeter()
+
+        installed, pip_notes = await self.ensure_dependencies(
+            source_code, predicted_deps
+        )
+        if pip_notes:
+            # Surfaced in-band ahead of user output, matching execute()'s
+            # prepend; the final envelope re-prepends so both views agree.
+            yield ("stderr", pip_notes + "\n")
+
+        proc = None
+        pumps: list[asyncio.Task] = []
+        timed_out = False
+        stdout = stderr = ""
+        exit_code: int = -1
+        try:
+            with tempfile.TemporaryDirectory(prefix="exec-") as td:
+                script = Path(td) / "script.py"
+                script.write_text(source_code)
+                proc = await asyncio.create_subprocess_exec(
+                    self.python, str(script),
+                    cwd=self.workspace,
+                    env=self._child_env(env),
+                    stdout=asyncio.subprocess.PIPE,
+                    stderr=asyncio.subprocess.PIPE,
+                    start_new_session=True,
+                )
+                loop = asyncio.get_running_loop()
+                hard_deadline = loop.time() + timeout_s
+                queue: asyncio.Queue[tuple[str, str | None]] = asyncio.Queue()
+
+                async def pump(stream, kind: str) -> None:
+                    decoder = codecs.getincrementaldecoder("utf-8")("replace")
+                    while True:
+                        chunk = await stream.read(1 << 16)
+                        if not chunk:
+                            tail = decoder.decode(b"", True)
+                            if tail:
+                                await queue.put((kind, tail))
+                            break
+                        text = decoder.decode(chunk)
+                        if text:
+                            await queue.put((kind, text))
+                    await queue.put((kind, None))  # EOF marker
+
+                pumps = [
+                    asyncio.ensure_future(pump(proc.stdout, "stdout")),
+                    asyncio.ensure_future(pump(proc.stderr, "stderr")),
+                ]
+                parts: dict[str, list[str]] = {"stdout": [], "stderr": []}
+                eofs = 0
+                while eofs < 2:
+                    remaining = hard_deadline - loop.time()
+                    if remaining <= 0:
+                        timed_out = True
+                        break
+                    try:
+                        kind, text = await asyncio.wait_for(
+                            queue.get(), timeout=remaining
+                        )
+                    except asyncio.TimeoutError:
+                        timed_out = True
+                        break
+                    if text is None:
+                        eofs += 1
+                        continue
+                    parts[kind].append(text)
+                    yield (kind, text)
+                if timed_out:
+                    self._kill_process_group(proc)
+                    await proc.wait()
+                    stdout, stderr, exit_code = "", EXECUTION_TIMED_OUT, -1
+                    # The envelope is authoritative, but a live consumer
+                    # deserves the reason in-band too.
+                    yield ("stderr", EXECUTION_TIMED_OUT)
+                else:
+                    await proc.wait()
+                    exit_code = proc.returncode
+                    stdout = "".join(parts["stdout"])
+                    stderr = "".join(parts["stderr"])
+        finally:
+            for task in pumps:
+                task.cancel()
+            if proc is not None and proc.returncode is None:
+                # Abandoned mid-stream (GeneratorExit lands here): reap the
+                # child before the workspace goes away.
+                self._kill_process_group(proc)
+                await proc.wait()
+
+        if pip_notes:
+            stderr = pip_notes + ("\n" + stderr if stderr else "")
+
+        after = await asyncio.to_thread(snapshot_workspace, self.workspace)
+        changed = changed_files(before, after)
+        usage = meter.finish(
+            workspace_bytes_written=sum(after[rel][1] for rel in changed),
+            files_changed=len(changed),
+            deps_installed=installed,
+        )
+        yield (
+            "end",
+            ExecutionOutcome(
+                stdout=stdout,
+                stderr=stderr,
+                exit_code=exit_code,
+                files=[self.logical(rel) for rel in changed],
+                usage=usage,
+            ),
         )
 
     async def warmup(self) -> None:

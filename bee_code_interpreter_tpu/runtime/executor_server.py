@@ -32,6 +32,7 @@ local store), and the id is echoed back in the response headers.
 from __future__ import annotations
 
 import asyncio
+import json
 import logging
 import os
 
@@ -106,6 +107,19 @@ def create_app(core: ExecutorCore, tracer: Tracer | None = None) -> web.Applicat
             return web.Response(status=404)
         return web.FileResponse(path)
 
+    async def delete_file(request: web.Request) -> web.Response:
+        """Remove one workspace file (sessions use this for rollback: files
+        created after a checkpoint must not survive restoring it). 404 for
+        a path that isn't there — callers treat that as already-gone."""
+        try:
+            path = core.resolve(request.match_info["path"])
+        except ValueError as e:
+            return web.Response(status=400, text=str(e))
+        if not path.is_file():
+            return web.Response(status=404)
+        path.unlink(missing_ok=True)
+        return web.Response(status=204)
+
     async def execute(request: web.Request) -> web.Response:
         body = await request.json()
         loop = asyncio.get_running_loop()
@@ -136,12 +150,83 @@ def create_app(core: ExecutorCore, tracer: Tracer | None = None) -> web.Applicat
             }
         )
 
+    async def execute_stream(request: web.Request) -> web.StreamResponse:
+        """Streaming twin of ``POST /execute``: newline-delimited JSON
+        events, one per output chunk —
+
+            {"stream": "stdout"|"stderr", "data": "<text>"}\\n
+
+        — closed by a terminal event carrying the exact non-streaming
+        envelope (plus ``duration_ms``/``usage``):
+
+            {"event": "end", "stdout": ..., "stderr": ..., "exit_code": ...,
+             "files": [...], "duration_ms": ..., "usage": {...}}\\n
+
+        Chunked transfer with per-event flush, so the control plane (and
+        through it an SSE client) sees output the moment the sandboxed
+        process writes it, not when the run ends."""
+        body = await request.json()
+        loop = asyncio.get_running_loop()
+        t0 = loop.time()
+        logger.info(
+            "Executing sandboxed code, streaming (%d bytes)",
+            len(body["source_code"]),
+        )
+        response = web.StreamResponse(
+            headers={"Content-Type": "application/x-ndjson"}
+        )
+        response.enable_chunked_encoding()
+        await response.prepare(request)
+        gen = core.execute_stream(
+            source_code=body["source_code"],
+            env=body.get("env") or {},
+            timeout_s=body.get("timeout"),
+            predicted_deps=body.get("predicted_deps"),
+        )
+        try:
+            await _pump_stream(gen, response, loop, t0)
+        except ConnectionResetError:
+            # The consumer vanished mid-stream: expected (a dead SSE
+            # client upstream), not an error worth a traceback — the
+            # generator's own finally already reaped the user process.
+            logger.info("Stream consumer disconnected mid-execution")
+            return response
+        finally:
+            await gen.aclose()
+        await response.write_eof()
+        return response
+
+    async def _pump_stream(gen, response, loop, t0: float) -> None:
+        async for kind, payload in gen:
+            if kind == "end":
+                await response.write(
+                    json.dumps(
+                        {
+                            "event": "end",
+                            "stdout": payload.stdout,
+                            "stderr": payload.stderr,
+                            "exit_code": payload.exit_code,
+                            "files": payload.files,
+                            "duration_ms": (loop.time() - t0) * 1000,
+                            "usage": payload.usage,
+                        }
+                    ).encode()
+                    + b"\n"
+                )
+            else:
+                await response.write(
+                    json.dumps({"stream": kind, "data": payload}).encode()
+                    + b"\n"
+                )
+
     async def healthz(_request: web.Request) -> web.Response:
         return web.json_response({"status": "ok", "workspace": str(core.workspace)})
 
     app.router.add_put("/workspace/{path:.+}", upload_file)
     app.router.add_get("/workspace/{path:.+}", download_file)
+    app.router.add_delete("/workspace/{path:.+}", delete_file)
     app.router.add_post("/execute", execute)
+    app.router.add_post("/execute/stream", execute_stream)
     app.router.add_get("/healthz", healthz)
     return app
 

@@ -13,11 +13,32 @@ lacked, SURVEY.md §4).
 
 from __future__ import annotations
 
-from typing import Protocol, runtime_checkable
+from dataclasses import dataclass, field
+from typing import Callable, Protocol, runtime_checkable
 
 from pydantic import BaseModel
 
 from bee_code_interpreter_tpu.utils.validation import AbsolutePath, Hash
+
+
+@dataclass
+class LeaseHandle:
+    """One sandbox checked out of its pool for a *session lease*
+    (docs/sessions.md): unlike the single-use execute path, the holder keeps
+    the warm sandbox across N executions and the backend must not treat it
+    as queue inventory (reaper) or as a stuck execution (watchdog) while it
+    idles between them.
+
+    ``addrs`` are the data-plane ``host:port`` targets (one per gang worker;
+    empty for the in-process local backend, which sets ``core`` instead).
+    ``kill`` is the backend's sync sandbox teardown; ``handle`` the backend's
+    native object (PodGroup / NativeSandbox / workspace path)."""
+
+    name: str
+    addrs: list[str] = field(default_factory=list)
+    kill: Callable[[], None] = lambda: None
+    handle: object | None = None
+    core: object | None = None  # runtime.ExecutorCore for the local backend
 
 
 class Result(BaseModel):

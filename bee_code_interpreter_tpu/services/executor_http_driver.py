@@ -29,6 +29,7 @@ so ``ExecuteResponse.usage`` can attribute data-plane traffic per request.
 
 from __future__ import annotations
 
+import json
 from contextlib import nullcontext
 
 import httpx
@@ -202,6 +203,128 @@ class ExecutorHttpDriver:
                         response.status_code, f"{what} ({response.text[:200]})"
                     )
         return response.json()
+
+    async def _post_execute_stream(
+        self,
+        addr: str,
+        source_code: str,
+        env: dict[str, str],
+        timeout_s: float,
+        on_event=None,  # async (kind, text) -> None, called per output chunk
+        deadline: Deadline | None = None,
+    ) -> dict:
+        """Streaming twin of :meth:`_post_execute`: drives the sandbox's
+        ``POST /execute/stream`` ndjson wire, forwarding each output chunk
+        to ``on_event`` as it arrives and returning the terminal envelope
+        (same dict shape the non-streaming call returns). The configured
+        per-call HTTP timeout applies *between* chunks (httpx read timeout),
+        so a silent sandbox still fails transient — a chatty long run keeps
+        the stream alive the way a long response body would."""
+        what = f"streaming execute on {addr}"
+        kwargs: dict = {}
+        if deadline is not None:
+            deadline.check(what)
+            timeout_s = deadline.clamp(timeout_s)
+            kwargs["timeout"] = deadline.clamp(
+                self._config.executor_http_timeout_s
+            )
+        body = {
+            "source_code": source_code,
+            "env": env,
+            "timeout": timeout_s,
+        }
+        deps = predicted_deps()
+        if deps is not None:
+            body["predicted_deps"] = deps
+        end: dict | None = None
+        unsupported = False
+        with span("execute", addr=addr, stream="1"):
+            async with self._data_plane_guard():
+                try:
+                    async with self._http.stream(
+                        "POST",
+                        f"http://{addr}/execute/stream",
+                        json=body,
+                        headers=outbound_headers(),
+                        **kwargs,
+                    ) as response:
+                        if response.status_code in (404, 405):
+                            # Executor predates the stream route (native C++
+                            # server); fall back to the buffered call OUTSIDE
+                            # this breaker guard (nesting would double-count
+                            # the half-open slot).
+                            await response.aread()
+                            unsupported = True
+                        elif response.status_code != 200:
+                            await response.aread()
+                            raise classify_http_status(
+                                response.status_code,
+                                f"{what} ({response.text[:200]})",
+                            )
+                        else:
+                            async for line in response.aiter_lines():
+                                if not line.strip():
+                                    continue
+                                event = json.loads(line)
+                                if event.get("event") == "end":
+                                    end = event
+                                elif on_event is not None:
+                                    await on_event(
+                                        event["stream"], event["data"]
+                                    )
+                except httpx.TimeoutException as e:
+                    raise SandboxTransientError(f"{what} timed out: {e}") from e
+                except httpx.TransportError as e:
+                    raise SandboxTransientError(f"{what} failed: {e}") from e
+                except (json.JSONDecodeError, KeyError) as e:
+                    raise SandboxTransientError(
+                        f"{what} produced a malformed event: {e}"
+                    ) from e
+        if unsupported:
+            # Degraded delivery: one buffered run, whole output as a single
+            # chunk per stream, exact terminal envelope either way.
+            end = await self._post_execute(
+                addr, source_code, env, timeout_s, deadline=deadline
+            )
+            if on_event is not None:
+                for kind in ("stdout", "stderr"):
+                    if end.get(kind):
+                        await on_event(kind, end[kind])
+            return end
+        if end is None:
+            # The connection closed without a terminal envelope: the sandbox
+            # died mid-stream. Transient — the SANDBOX is gone, but the
+            # caller decides whether a replay is safe (it is not once chunks
+            # reached a client).
+            raise SandboxTransientError(f"{what} ended without a terminal event")
+        return end
+
+    async def _delete_file(
+        self, addr: str, path: str, deadline: Deadline | None = None
+    ) -> bool:
+        """Best-effort workspace file removal (session rollback). True when
+        the file was deleted, False when the sandbox doesn't have it — or
+        doesn't speak DELETE at all (404/405 from older executors): rollback
+        then restores checkpoint content but cannot evict strays."""
+        what = f"file delete on {addr}"
+        kwargs = self._deadline_kwargs(deadline, what)
+        with span("delete", addr=addr, path=path):
+            async with self._data_plane_guard():
+                try:
+                    response = await self._http.delete(
+                        self._sandbox_url(addr, path),
+                        headers=outbound_headers(),
+                        **kwargs,
+                    )
+                except httpx.TimeoutException as e:
+                    raise SandboxTransientError(f"{what} timed out: {e}") from e
+                except httpx.TransportError as e:
+                    raise SandboxTransientError(f"{what} failed: {e}") from e
+                if response.status_code in (404, 405):
+                    return False
+                if response.status_code >= 300:
+                    raise classify_http_status(response.status_code, what)
+        return True
 
     def _sandbox_url(self, addr: str, logical_path: str) -> str:
         rel = logical_path.removeprefix("/workspace/").lstrip("/")

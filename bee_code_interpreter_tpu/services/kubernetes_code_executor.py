@@ -70,7 +70,7 @@ from bee_code_interpreter_tpu.resilience import (
     journal_sandbox_teardown,
     retryable,
 )
-from bee_code_interpreter_tpu.services.code_executor import Result
+from bee_code_interpreter_tpu.services.code_executor import LeaseHandle, Result
 from bee_code_interpreter_tpu.services.executor_http_driver import ExecutorHttpDriver
 from bee_code_interpreter_tpu.services.kubectl import Kubectl
 from bee_code_interpreter_tpu.services.storage import Storage
@@ -241,9 +241,7 @@ class KubernetesCodeExecutor(ExecutorHttpDriver):
         self, source_code, files, env, timeout_s, deadline, transfer
     ) -> Result:
         async with self.executor_pod_group(deadline=deadline) as group:
-            addrs = [
-                f"{ip}:{self._config.executor_port}" for ip in group.pod_ips
-            ]
+            addrs = self._group_addrs(group)
             # Restore the workspace snapshot on every worker (SPMD inputs).
             await asyncio.gather(
                 *(
@@ -272,40 +270,106 @@ class KubernetesCodeExecutor(ExecutorHttpDriver):
                         for addr in addrs
                     )
                 )
-            primary = responses[0]
-            exit_code = next(
-                (r["exit_code"] for r in responses if r["exit_code"] != 0), 0
+            return await self._assemble_group_result(
+                addrs, responses, transfer, deadline
             )
-            # Union changed files across the gang: a per-host output (orbax
-            # checkpoint shard, per-process log) exists only on its writer.
-            # Iteration order makes worker 0 win collisions on shared names
-            # (process-0-owns-I/O convention).
-            path_owner: dict[str, str] = {}
-            for addr, response in zip(addrs, responses):
-                for path in response["files"]:
-                    path_owner.setdefault(path, addr)
-            out_files = dict(
-                zip(
-                    path_owner,
-                    await asyncio.gather(
-                        *(
-                            self._download_file(addr, path, deadline=deadline)
-                            for path, addr in path_owner.items()
-                        )
-                    ),
+
+    def _group_addrs(self, group: PodGroup) -> list[str]:
+        return [f"{ip}:{self._config.executor_port}" for ip in group.pod_ips]
+
+    async def _assemble_group_result(
+        self, addrs, responses, transfer, deadline
+    ) -> Result:
+        """Gang responses → one :class:`Result`: worker 0's stdout/stderr
+        (process-0-owns-I/O convention), first nonzero exit code, changed
+        files unioned across the gang (each path downloaded from its writer;
+        worker 0 wins collisions on shared names), usage merged."""
+        primary = responses[0]
+        exit_code = next(
+            (r["exit_code"] for r in responses if r["exit_code"] != 0), 0
+        )
+        path_owner: dict[str, str] = {}
+        for addr, response in zip(addrs, responses):
+            for path in response["files"]:
+                path_owner.setdefault(path, addr)
+        out_files = dict(
+            zip(
+                path_owner,
+                await asyncio.gather(
+                    *(
+                        self._download_file(addr, path, deadline=deadline)
+                        for path, addr in path_owner.items()
+                    )
+                ),
+            )
+        )
+        # Gang usage: CPU sums, RSS/wall max across workers; the
+        # driver's data-plane byte counts ride in the same block.
+        usage = merge_worker_usage([r.get("usage") for r in responses])
+        usage.update(transfer.as_dict())
+        return Result(
+            stdout=primary["stdout"],
+            stderr=primary["stderr"],
+            exit_code=exit_code,
+            files=out_files,
+            usage=usage,
+        )
+
+    async def execute_stream(
+        self,
+        source_code: str,
+        files: dict[AbsolutePath, Hash] | None = None,
+        env: dict[str, str] | None = None,
+        timeout_s: float | None = None,
+        on_event=None,  # async (kind, text) -> None per stdout/stderr chunk
+        deadline: Deadline | None = None,
+    ) -> Result:
+        """Streaming execute (docs/sessions.md "Streaming"): same single-use
+        sandbox lifecycle as :meth:`execute`, but worker 0's output chunks
+        are forwarded to ``on_event`` as the sandbox produces them (workers
+        1..N-1 run the regular call concurrently — the I/O convention already
+        makes worker 0 the only stdout that matters). No retry/replay/hedge
+        layer wraps this path: chunks already delivered to a client cannot
+        be un-delivered, so a mid-stream death surfaces as an error event,
+        never as a silent second run."""
+        files = files or {}
+        env = env or {}
+        if deadline is not None:
+            deadline.check("execute")
+        with collect_transfer() as transfer:
+            async with self.executor_pod_group(deadline=deadline) as group:
+                addrs = self._group_addrs(group)
+                await asyncio.gather(
+                    *(
+                        self._upload_file(addr, path, object_id, deadline=deadline)
+                        for addr in addrs
+                        for path, object_id in files.items()
+                    )
                 )
-            )
-            # Gang usage: CPU sums, RSS/wall max across workers; the
-            # driver's data-plane byte counts ride in the same block.
-            usage = merge_worker_usage([r.get("usage") for r in responses])
-            usage.update(transfer.as_dict())
-            return Result(
-                stdout=primary["stdout"],
-                stderr=primary["stderr"],
-                exit_code=exit_code,
-                files=out_files,
-                usage=usage,
-            )
+                self.journal.record(group.name, "executing")
+                timeout = self._effective_timeout(timeout_s)
+                with self.inflight.track(
+                    group.name, kill=lambda: self._kill_group(group)
+                ):
+                    responses = await asyncio.gather(
+                        self._post_execute_stream(
+                            addrs[0],
+                            source_code,
+                            env,
+                            timeout,
+                            on_event=on_event,
+                            deadline=deadline,
+                        ),
+                        *(
+                            self._post_execute(
+                                addr, source_code, env, timeout, deadline=deadline
+                            )
+                            for addr in addrs[1:]
+                        ),
+                    )
+                return await self._assemble_group_result(
+                    addrs, list(responses), transfer, deadline
+                )
 
     # ------------------------------------------------------------------ pool
 
@@ -322,6 +386,24 @@ class KubernetesCodeExecutor(ExecutorHttpDriver):
         On-demand spawns (pool empty) go through the spawn circuit breaker
         and are hard-bounded by the request deadline.
         """
+        group = await self._checkout_group(deadline)
+        try:
+            yield group
+        except BaseException as e:
+            # A transient data-plane failure means the sandbox is presumed
+            # dead or wedged (a pod dying mid-execute lands here); the
+            # journal reason is what the replay acceptance asserts on.
+            journal_sandbox_teardown(self.journal, group.name, e)
+            raise
+        else:
+            journal_sandbox_teardown(self.journal, group.name, None)
+        finally:
+            self._kill_group(group)
+
+    async def _checkout_group(self, deadline: Deadline | None = None) -> PodGroup:
+        """Pop a healthy warm group (probing and discarding corpses) or spawn
+        one, journal the assignment, and kick a refill — the acquisition half
+        shared by the single-use execute path and session leases."""
         group = None
         while group is None:
             if not self._queue:
@@ -347,18 +429,39 @@ class KubernetesCodeExecutor(ExecutorHttpDriver):
                 self.journal.record(candidate.name, "reaped", reason="unhealthy")
                 self._kill_group(candidate)
         self._spawn_background(self.fill_executor_pod_queue())
-        try:
-            yield group
-        except BaseException as e:
-            # A transient data-plane failure means the sandbox is presumed
-            # dead or wedged (a pod dying mid-execute lands here); the
-            # journal reason is what the replay acceptance asserts on.
-            journal_sandbox_teardown(self.journal, group.name, e)
-            raise
-        else:
-            journal_sandbox_teardown(self.journal, group.name, None)
-        finally:
-            self._kill_group(group)
+        return group
+
+    # ---------------------------------------------------------------- leases
+
+    async def checkout_for_lease(
+        self, deadline: Deadline | None = None
+    ) -> LeaseHandle:
+        """Check a warm group out of the pool for a session lease
+        (docs/sessions.md): the holder owns it across N executions. Popped
+        out of the queue, so the supervisor's idle reaper never probes it,
+        and nothing is in the inflight registry while it idles between
+        executes — an owned sandbox is not "stuck"."""
+        group = await self._checkout_group(deadline)
+        return LeaseHandle(
+            name=group.name,
+            addrs=self._group_addrs(group),
+            kill=lambda: self._kill_group(group),
+            handle=group,
+        )
+
+    def release_lease(
+        self,
+        lease: LeaseHandle,
+        state: str = "released",
+        reason: str = "lease_released",
+        detail: str | None = None,
+    ) -> None:
+        """End a lease: one terminal journal event with the real reason
+        (released / lease_expired / reaped — the session manager spells it),
+        sandbox torn down, pool refill kicked."""
+        self.journal.record(lease.name, state, reason=reason, detail=detail)
+        lease.kill()
+        self._spawn_background(self.fill_executor_pod_queue())
 
     async def _spawn_guarded(self, deadline: Deadline | None) -> PodGroup:
         """Request-path spawn: breaker-gated and deadline-bounded. A hang or

@@ -50,7 +50,7 @@ from bee_code_interpreter_tpu.resilience import (
     journal_sandbox_teardown,
     retryable,
 )
-from bee_code_interpreter_tpu.services.code_executor import Result
+from bee_code_interpreter_tpu.services.code_executor import LeaseHandle, Result
 from bee_code_interpreter_tpu.services.executor_http_driver import ExecutorHttpDriver
 from bee_code_interpreter_tpu.services.storage import Storage
 from bee_code_interpreter_tpu.utils.validation import AbsolutePath, Hash
@@ -331,11 +331,12 @@ class NativeProcessCodeExecutor(ExecutorHttpDriver):
 
     # ------------------------------------------------------------------ pool
 
-    @asynccontextmanager
-    async def sandbox(self, deadline: Deadline | None = None):
-        """Pop a warm server or spawn one; single-use teardown + async refill.
-        A sandbox whose process died while queued (OOM, crash) is discarded,
-        not handed to a request."""
+    async def _checkout_sandbox(
+        self, deadline: Deadline | None = None
+    ) -> NativeSandbox:
+        """Pop a live warm server (discarding corpses) or spawn one, journal
+        the assignment, kick a refill — the acquisition half shared by the
+        single-use execute path and session leases."""
         box = None
         while self._queue:
             candidate = self._queue.popleft()
@@ -365,6 +366,14 @@ class NativeProcessCodeExecutor(ExecutorHttpDriver):
                 )
             self.journal.record(box.name, "assigned", reason="cold_spawn")
         self._spawn_background(self.fill_sandbox_queue())
+        return box
+
+    @asynccontextmanager
+    async def sandbox(self, deadline: Deadline | None = None):
+        """Pop a warm server or spawn one; single-use teardown + async refill.
+        A sandbox whose process died while queued (OOM, crash) is discarded,
+        not handed to a request."""
+        box = await self._checkout_sandbox(deadline)
         try:
             yield box
         except BaseException as e:
@@ -389,6 +398,93 @@ class NativeProcessCodeExecutor(ExecutorHttpDriver):
         """Watchdog teardown of a wedged sandbox (sync, fire-and-forget):
         killing the process resets the in-flight HTTP call's transport."""
         asyncio.get_running_loop().run_in_executor(None, box.destroy)
+
+    # ---------------------------------------------------------------- leases
+
+    async def checkout_for_lease(
+        self, deadline: Deadline | None = None
+    ) -> LeaseHandle:
+        """Check a warm server out of the pool for a session lease
+        (docs/sessions.md): popped out of the queue, so the supervisor's
+        idle reaper never probes it while the session holds it."""
+        box = await self._checkout_sandbox(deadline)
+        return LeaseHandle(
+            name=box.name,
+            addrs=[box.addr],
+            kill=lambda: self._kill_sandbox(box),
+            handle=box,
+        )
+
+    def release_lease(
+        self,
+        lease: LeaseHandle,
+        state: str = "released",
+        reason: str = "lease_released",
+        detail: str | None = None,
+    ) -> None:
+        """End a lease: terminal journal event, sandbox torn down, refill
+        kicked (mirror of the Kubernetes backend)."""
+        self.journal.record(lease.name, state, reason=reason, detail=detail)
+        lease.kill()
+        self._spawn_background(self.fill_sandbox_queue())
+
+    async def execute_stream(
+        self,
+        source_code: str,
+        files: dict[AbsolutePath, Hash] | None = None,
+        env: dict[str, str] | None = None,
+        timeout_s: float | None = None,
+        on_event=None,  # async (kind, text) -> None per stdout/stderr chunk
+        deadline: Deadline | None = None,
+    ) -> Result:
+        """Streaming execute over a single-use native sandbox: output chunks
+        forward to ``on_event`` as the server produces them; workspace
+        restore before / snapshot after are unchanged. No retry/replay wraps
+        this path — delivered chunks cannot be un-delivered."""
+        files = files or {}
+        env = env or {}
+        if deadline is not None:
+            deadline.check("execute")
+        with collect_transfer() as transfer:
+            async with self.sandbox(deadline=deadline) as box:
+                await asyncio.gather(
+                    *(
+                        self._upload_file(box.addr, path, object_id, deadline=deadline)
+                        for path, object_id in files.items()
+                    )
+                )
+                self.journal.record(box.name, "executing")
+                with self.inflight.track(
+                    box.name, kill=lambda: self._kill_sandbox(box)
+                ):
+                    response = await self._post_execute_stream(
+                        box.addr,
+                        source_code,
+                        env,
+                        self._effective_timeout(timeout_s),
+                        on_event=on_event,
+                        deadline=deadline,
+                    )
+                out_files: dict[str, str] = {}
+                for path, object_id in zip(
+                    response["files"],
+                    await asyncio.gather(
+                        *(
+                            self._download_file(box.addr, p, deadline=deadline)
+                            for p in response["files"]
+                        )
+                    ),
+                ):
+                    out_files[path] = object_id
+                usage = merge_worker_usage([response.get("usage")])
+                usage.update(transfer.as_dict())
+                return Result(
+                    stdout=response["stdout"],
+                    stderr=response["stderr"],
+                    exit_code=response["exit_code"],
+                    files=out_files,
+                    usage=usage,
+                )
 
     async def _sandbox_healthy(self, box: NativeSandbox) -> bool:
         """The process is alive AND its /healthz answers — a live-but-wedged

@@ -1,0 +1,48 @@
+"""Sessions: leased sandboxes, checkpoint/rollback, and output streaming.
+
+The third workload class next to one-shot execute and custom tools
+(ROADMAP item 3, docs/sessions.md): a client leases one warm sandbox for a
+conversation of N executions (restore skipped, snapshot deferred to
+explicit checkpoints), can roll the workspace back to any checkpoint, and
+can stream stdout/stderr as the sandbox produces them — on both the
+sessionful and the stateless path, over both transports.
+
+Layered like ``resilience/`` and ``observability/``: primitives here
+(manager, lease drivers, the streaming pump), wiring at the edges (api/)
+and in the backends (services/ checkout/lease hooks, runtime/ chunked
+read loop).
+"""
+
+from bee_code_interpreter_tpu.sessions.lease import (
+    LeaseOutcome,
+    LocalLease,
+    RemoteLease,
+    build_lease,
+)
+from bee_code_interpreter_tpu.sessions.manager import (
+    Checkpoint,
+    CheckpointNotFound,
+    InvalidSessionRequest,
+    Session,
+    SessionError,
+    SessionLimitExceeded,
+    SessionManager,
+    SessionNotFound,
+)
+from bee_code_interpreter_tpu.sessions.streaming import streamed_events
+
+__all__ = [
+    "Checkpoint",
+    "CheckpointNotFound",
+    "InvalidSessionRequest",
+    "LeaseOutcome",
+    "LocalLease",
+    "RemoteLease",
+    "Session",
+    "SessionError",
+    "SessionLimitExceeded",
+    "SessionManager",
+    "SessionNotFound",
+    "build_lease",
+    "streamed_events",
+]

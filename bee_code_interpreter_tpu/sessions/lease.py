@@ -1,0 +1,292 @@
+"""Leased-sandbox execution: the data-plane half of a session.
+
+A session holds ONE warm sandbox across N executions (docs/sessions.md).
+This module adapts the two sandbox shapes behind a uniform lease API the
+:class:`~bee_code_interpreter_tpu.sessions.manager.SessionManager` drives:
+
+- :class:`RemoteLease` — a pool sandbox (Kubernetes pod group or native
+  server process) addressed over the executor HTTP wire. Executes skip the
+  workspace restore (state lives in the sandbox) and defer the snapshot:
+  each run reports *changed paths* only; bytes move at checkpoint time.
+  Gang semantics are preserved: uploads go to every worker, executes run
+  SPMD on all of them, each changed path is owned by the first worker that
+  reported it (worker 0 wins collisions — process-0-owns-I/O).
+- :class:`LocalLease` — the in-process backend's lease: a persistent
+  workspace + ``ExecutorCore`` living for the lease's lifetime.
+
+Either way the lease tracks the set of logical paths known to exist in the
+workspace (initial restore ∪ changed paths reported by executes); that set
+is what a checkpoint snapshots and what a rollback prunes against.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from dataclasses import dataclass, field
+
+from bee_code_interpreter_tpu.observability import merge_worker_usage
+from bee_code_interpreter_tpu.resilience import Deadline, SandboxFatalError
+from bee_code_interpreter_tpu.services.code_executor import LeaseHandle
+from bee_code_interpreter_tpu.utils.validation import Hash
+
+
+@dataclass
+class LeaseOutcome:
+    """One execution inside a lease. ``changed_paths`` replaces the
+    stateless path's ``files`` map: the snapshot is deferred, so there are
+    no object ids until the client checkpoints."""
+
+    stdout: str
+    stderr: str
+    exit_code: int
+    changed_paths: list[str] = field(default_factory=list)
+    usage: dict | None = None
+
+
+class RemoteLease:
+    """A pool sandbox held for a session, driven over the executor HTTP
+    wire through the owning backend's driver methods."""
+
+    def __init__(self, backend, handle: LeaseHandle) -> None:
+        self._backend = backend
+        self.handle = handle
+        self.name = handle.name
+        self._addrs = handle.addrs
+        # logical path -> the worker addr that wrote it (uploads exist on
+        # every worker; worker 0 is the canonical owner).
+        self._path_owner: dict[str, str] = {}
+
+    @property
+    def tracked_paths(self) -> set[str]:
+        return set(self._path_owner)
+
+    async def upload(
+        self, path: str, object_id: Hash, deadline: Deadline | None = None
+    ) -> None:
+        await asyncio.gather(
+            *(
+                self._backend._upload_file(addr, path, object_id, deadline=deadline)
+                for addr in self._addrs
+            )
+        )
+        self._path_owner.setdefault(path, self._addrs[0])
+
+    async def execute(
+        self,
+        source_code: str,
+        env: dict[str, str],
+        timeout_s: float | None,
+        deadline: Deadline | None = None,
+        on_event=None,  # async (kind, text) -> None enables streaming
+    ) -> LeaseOutcome:
+        backend = self._backend
+        timeout = backend._effective_timeout(timeout_s)
+        # Tracked while executing so the supervisor watchdog still kills a
+        # WEDGED leased execute — only the idle-between-executes state is
+        # exempt from the watchdog, never a run in flight.
+        with backend.inflight.track(self.name, kill=self.handle.kill):
+            if on_event is not None:
+                responses = list(
+                    await asyncio.gather(
+                        backend._post_execute_stream(
+                            self._addrs[0],
+                            source_code,
+                            env,
+                            timeout,
+                            on_event=on_event,
+                            deadline=deadline,
+                        ),
+                        *(
+                            backend._post_execute(
+                                addr, source_code, env, timeout, deadline=deadline
+                            )
+                            for addr in self._addrs[1:]
+                        ),
+                    )
+                )
+            else:
+                responses = list(
+                    await asyncio.gather(
+                        *(
+                            backend._post_execute(
+                                addr, source_code, env, timeout, deadline=deadline
+                            )
+                            for addr in self._addrs
+                        )
+                    )
+                )
+        primary = responses[0]
+        exit_code = next(
+            (r["exit_code"] for r in responses if r["exit_code"] != 0), 0
+        )
+        changed: dict[str, None] = {}
+        for addr, response in zip(self._addrs, responses):
+            for path in response["files"]:
+                changed.setdefault(path)
+                self._path_owner.setdefault(path, addr)
+        usage = merge_worker_usage([r.get("usage") for r in responses])
+        return LeaseOutcome(
+            stdout=primary["stdout"],
+            stderr=primary["stderr"],
+            exit_code=exit_code,
+            changed_paths=list(changed),
+            usage=usage,
+        )
+
+    async def snapshot(
+        self, paths, deadline: Deadline | None = None
+    ) -> dict[str, Hash]:
+        """Download ``paths`` from their owners into content-addressed
+        storage (the deferred snapshot, paid at checkpoint time). A path the
+        workspace no longer has (user code deleted it) is dropped from the
+        result AND from the tracked set."""
+
+        async def grab(path: str):
+            addr = self._path_owner.get(path, self._addrs[0])
+            try:
+                return path, await self._backend._download_file(
+                    addr, path, deadline=deadline
+                )
+            except SandboxFatalError:
+                return path, None  # deleted since it was last reported
+
+        out: dict[str, Hash] = {}
+        for path, object_id in await asyncio.gather(*(grab(p) for p in paths)):
+            if object_id is None:
+                self._path_owner.pop(path, None)
+            else:
+                out[path] = object_id
+        return out
+
+    async def restore(
+        self,
+        files: dict[str, Hash],
+        delete_paths,
+        deadline: Deadline | None = None,
+    ) -> None:
+        """Rollback: put every checkpoint file back on every worker and
+        best-effort delete the strays created since (executors without the
+        DELETE route keep them; docs/sessions.md spells the caveat)."""
+        await asyncio.gather(
+            *(
+                self._backend._upload_file(addr, path, object_id, deadline=deadline)
+                for addr in self._addrs
+                for path, object_id in files.items()
+            )
+        )
+        await asyncio.gather(
+            *(
+                self._backend._delete_file(addr, path, deadline=deadline)
+                for addr in self._addrs
+                for path in delete_paths
+            )
+        )
+        self._path_owner = {path: self._addrs[0] for path in files}
+
+
+class LocalLease:
+    """The in-process backend's lease: a persistent workspace + core; the
+    same API as :class:`RemoteLease` with direct file I/O instead of the
+    HTTP wire."""
+
+    def __init__(self, backend, handle: LeaseHandle, storage) -> None:
+        self._backend = backend
+        self.handle = handle
+        self.name = handle.name
+        self._core = handle.core
+        self._storage = storage
+        self._tracked: set[str] = set()
+
+    @property
+    def tracked_paths(self) -> set[str]:
+        return set(self._tracked)
+
+    async def upload(
+        self, path: str, object_id: Hash, deadline: Deadline | None = None
+    ) -> None:
+        real = self._core.resolve(path)
+        real.parent.mkdir(parents=True, exist_ok=True)
+        with open(real, "wb") as f:
+            async with self._storage.reader(object_id) as reader:
+                async for chunk in reader:
+                    f.write(chunk)
+        self._tracked.add(path)
+
+    async def execute(
+        self,
+        source_code: str,
+        env: dict[str, str],
+        timeout_s: float | None,
+        deadline: Deadline | None = None,
+        on_event=None,
+    ) -> LeaseOutcome:
+        timeout = self._backend._clamp_timeout(timeout_s)
+        if deadline is not None:
+            deadline.check("leased execute")
+            timeout = deadline.clamp(
+                timeout or self._core.default_timeout_s
+            )
+        if on_event is None:
+            outcome = await self._core.execute(
+                source_code, env=env, timeout_s=timeout
+            )
+        else:
+            outcome = None
+            gen = self._core.execute_stream(
+                source_code, env=env, timeout_s=timeout
+            )
+            try:
+                async for kind, payload in gen:
+                    if kind == "end":
+                        outcome = payload
+                    else:
+                        await on_event(kind, payload)
+            finally:
+                await gen.aclose()
+        self._tracked.update(outcome.files)
+        return LeaseOutcome(
+            stdout=outcome.stdout,
+            stderr=outcome.stderr,
+            exit_code=outcome.exit_code,
+            changed_paths=list(outcome.files),
+            usage=outcome.usage,
+        )
+
+    async def snapshot(
+        self, paths, deadline: Deadline | None = None
+    ) -> dict[str, Hash]:
+        out: dict[str, Hash] = {}
+        for path in paths:
+            real = self._core.resolve(path)
+            if not real.is_file():
+                self._tracked.discard(path)
+                continue
+            async with self._storage.writer() as writer:
+                with open(real, "rb") as f:
+                    while chunk := f.read(1 << 20):
+                        await writer.write(chunk)
+            out[path] = writer.hash
+        return out
+
+    async def restore(
+        self,
+        files: dict[str, Hash],
+        delete_paths,
+        deadline: Deadline | None = None,
+    ) -> None:
+        for path in delete_paths:
+            real = self._core.resolve(path)
+            if real.is_file():
+                real.unlink(missing_ok=True)
+        for path, object_id in files.items():
+            await self.upload(path, object_id, deadline=deadline)
+        self._tracked = set(files)
+
+
+def build_lease(backend, handle: LeaseHandle, storage):
+    """The right lease flavor for what the backend checked out: an
+    in-process core (local backend) or data-plane addresses (pool
+    backends)."""
+    if handle.core is not None:
+        return LocalLease(backend, handle, storage)
+    return RemoteLease(backend, handle)

@@ -1,0 +1,507 @@
+"""Leased interactive sessions over the warm sandbox pool (docs/sessions.md).
+
+The stateless path pays a full workspace restore + snapshot round-trip per
+execution; a *session* amortizes that across a conversation: the client
+acquires one warm sandbox (``POST /v1/sessions``), runs N executions against
+it (restore skipped — state lives in the sandbox — and snapshot deferred to
+explicit checkpoints), and releases it. The interpreter becomes a REPL
+surface for agents.
+
+Guarantees the :class:`SessionManager` owns:
+
+- **Bounded leases.** ``APP_SESSION_MAX`` caps concurrent leases (each one
+  pins a warm sandbox the stateless pool can't use); ``APP_SESSION_TTL_S``
+  bounds total lease lifetime and ``APP_SESSION_IDLE_S`` the gap between
+  executions. A background sweep expires violators; expiry while an execute
+  is in flight is deferred to the next sweep (the execute itself is bounded
+  by the edge deadline and the supervisor's hard cap).
+- **Drain integration.** A draining service takes no new leases (the edges'
+  drain gate answers 503/UNAVAILABLE before the manager is reached) and the
+  sweep expires existing leases with ``reason="drain"`` so teardown never
+  waits on an idle REPL.
+- **Supervisor integration.** A leased sandbox is out of the pool queue, so
+  the idle reaper never probes it, and it is in the inflight registry only
+  WHILE an execute runs — healthy-but-idle is owned, not stuck; a wedged
+  leased execute is still watchdog-killed.
+- **Checkpoint/rollback.** A checkpoint snapshots the live workspace's
+  tracked files through the content-addressed ``Storage`` and returns an id;
+  rollback restores any prior checkpoint (best-effort deleting files created
+  since). Checkpoint file maps are plain ``{path: object_id}`` — a client
+  can feed one to the stateless ``/v1/execute`` too.
+- **Accounting.** Fleet journal events ``leased`` (with the owner session
+  id) and ``lease_expired``/``released``/``reaped`` on end; metrics
+  ``bci_session_active``, ``bci_session_lease_seconds``,
+  ``bci_session_expirations_total{reason}``; a ``session`` attribute on the
+  request's root trace span.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import secrets
+import time
+from dataclasses import dataclass, field
+from typing import Callable
+
+from bee_code_interpreter_tpu.observability import collect_transfer, unwrap_executor
+from bee_code_interpreter_tpu.resilience import Deadline, SandboxTransientError
+from bee_code_interpreter_tpu.sessions.lease import LeaseOutcome, build_lease
+from bee_code_interpreter_tpu.utils.validation import Hash
+
+logger = logging.getLogger(__name__)
+
+
+class SessionError(Exception):
+    """Base class for session-API faults the edges map to statuses."""
+
+
+class SessionNotFound(SessionError):
+    """Unknown, expired, or already-released session id (HTTP 404)."""
+
+
+class SessionLimitExceeded(SessionError):
+    """The ``APP_SESSION_MAX`` lease cap is reached (HTTP 429)."""
+
+    def __init__(self, limit: int, retry_after_s: float = 1.0) -> None:
+        super().__init__(f"session limit reached ({limit} active leases)")
+        self.retry_after_s = retry_after_s
+
+
+class CheckpointNotFound(SessionError):
+    """Unknown checkpoint id for this session (HTTP 404)."""
+
+
+class InvalidSessionRequest(SessionError):
+    """Malformed lease parameters (HTTP 422 / gRPC INVALID_ARGUMENT).
+
+    The HTTP edge's pydantic model rejects these before the manager is
+    reached; the gRPC JSON-bytes edge has no generated message to validate
+    with, so the manager is the backstop — and it must reject BEFORE any
+    sandbox is checked out."""
+
+
+@dataclass
+class Checkpoint:
+    checkpoint_id: str
+    files: dict[str, Hash]
+    created_unix: float
+
+
+@dataclass
+class Session:
+    """One leased sandbox + its client-visible state."""
+
+    session_id: str
+    lease: object  # sessions.lease.RemoteLease | LocalLease
+    ttl_s: float
+    idle_s: float
+    created_mono: float
+    created_unix: float
+    last_used_mono: float
+    executions: int = 0
+    checkpoints: dict[str, Checkpoint] = field(default_factory=dict)
+    closed: bool = False
+    close_reason: str | None = None
+    lock: asyncio.Lock = field(default_factory=asyncio.Lock)
+
+    @property
+    def expires_unix(self) -> float:
+        return self.created_unix + self.ttl_s
+
+    def to_dict(self, now_mono: float) -> dict:
+        return {
+            "session_id": self.session_id,
+            "sandbox": self.lease.name,
+            "created_unix": self.created_unix,
+            "expires_at": self.expires_unix,
+            "ttl_s": self.ttl_s,
+            "idle_timeout_s": self.idle_s,
+            "age_s": now_mono - self.created_mono,
+            "idle_s": now_mono - self.last_used_mono,
+            "executions": self.executions,
+            "checkpoints": sorted(self.checkpoints),
+            "tracked_files": len(self.lease.tracked_paths),
+        }
+
+
+class SessionManager:
+    """Owns every lease in the service. One per process, shared by both API
+    edges (``ApplicationContext.sessions``) — the transports can never
+    disagree about which sessions exist."""
+
+    def __init__(
+        self,
+        executor,
+        storage,
+        *,
+        max_sessions: int = 16,
+        ttl_s: float = 900.0,
+        idle_s: float = 120.0,
+        sweep_interval_s: float = 1.0,
+        retry_after_s: float = 1.0,
+        metrics=None,
+        drain=None,
+        clock: Callable[[], float] = time.monotonic,
+    ) -> None:
+        # The lease works against the raw pool backend: the resilience
+        # fronts (retry/replay/hedge) wrap single-shot executes and are
+        # deliberately NOT applied to leased ones — replaying onto a fresh
+        # sandbox would silently discard the session state the client is
+        # paying to keep.
+        self._backend = unwrap_executor(executor)
+        self._storage = storage
+        self._max_sessions = max_sessions
+        self._ttl_s = ttl_s
+        self._idle_s = idle_s
+        self._sweep_interval_s = max(0.05, sweep_interval_s)
+        self._retry_after_s = retry_after_s
+        self._drain = drain
+        self._clock = clock
+        self._sessions: dict[str, Session] = {}
+        # Creates in flight between the cap check and registration: the
+        # checkout awaits, so the cap must be check-AND-reserve, not
+        # check-then-act, or a burst of concurrent creates blows past it.
+        self._creating = 0
+        self._task: asyncio.Task | None = None
+        self.expired_total: dict[str, int] = {}
+        self._lease_seconds = None
+        self._expirations_total = None
+        if metrics is not None:
+            metrics.gauge(
+                "bci_session_active",
+                "Session leases currently holding a warm sandbox",
+                lambda: len(self._sessions),
+            )
+            self._lease_seconds = metrics.histogram(
+                "bci_session_lease_seconds",
+                "Session lease duration, acquire to end",
+            )
+            self._expirations_total = metrics.counter(
+                "bci_session_expirations_total",
+                "Session leases ended, by reason (ttl/idle/drain/shutdown/"
+                "released/sandbox_died)",
+            )
+
+    # ------------------------------------------------------------- lifecycle
+
+    @property
+    def active_count(self) -> int:
+        return len(self._sessions)
+
+    def start(self) -> asyncio.Task:
+        """Start the background expiry sweep (requires a running loop);
+        idempotent."""
+        if self._task is not None and not self._task.done():
+            return self._task
+        self._task = asyncio.get_running_loop().create_task(self._run())
+        return self._task
+
+    async def stop(self) -> None:
+        task, self._task = self._task, None
+        if task is not None:
+            task.cancel()
+            try:
+                await task
+            except asyncio.CancelledError:
+                pass
+
+    async def _run(self) -> None:
+        while True:
+            try:
+                await asyncio.sleep(self._sweep_interval_s)
+                await self.sweep_once()
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                # One bad sweep must not end lease expiry for the process.
+                logger.exception("Session expiry sweep failed")
+
+    # ------------------------------------------------------------------- api
+
+    def get(self, session_id: str) -> Session:
+        session = self._sessions.get(session_id)
+        if session is None or session.closed:
+            raise SessionNotFound(f"unknown or expired session {session_id!r}")
+        return session
+
+    @staticmethod
+    def _clamped_bound(value, cap: float, what: str) -> float:
+        """A request may shorten a lease bound, never extend it — and a
+        malformed value must be rejected BEFORE a sandbox is checked out
+        (a post-checkout TypeError would leak the lease forever)."""
+        if value is None:
+            return cap
+        try:
+            bound = float(value)
+        except (TypeError, ValueError):
+            raise InvalidSessionRequest(f"{what} must be a number") from None
+        if bound <= 0:
+            raise InvalidSessionRequest(f"{what} must be > 0")
+        return min(bound, cap)
+
+    async def create(
+        self,
+        files: dict[str, Hash] | None = None,
+        ttl_s: float | None = None,
+        idle_s: float | None = None,
+        deadline: Deadline | None = None,
+    ) -> Session:
+        """Acquire one warm sandbox under a lease. A request may shorten the
+        TTL / idle bounds, never extend them past the configured caps."""
+        ttl = self._clamped_bound(ttl_s, self._ttl_s, "ttl_s")
+        idle = self._clamped_bound(idle_s, self._idle_s, "idle_s")
+        if files and (
+            not isinstance(files, dict)
+            or any(
+                not isinstance(k, str) or not isinstance(v, str)
+                for k, v in files.items()
+            )
+        ):
+            raise InvalidSessionRequest(
+                "files must be a {path: object id} object"
+            )
+        # Reserve the cap slot synchronously: the checkout below awaits, and
+        # two concurrent creates racing one free slot must not both win.
+        if len(self._sessions) + self._creating >= self._max_sessions:
+            raise SessionLimitExceeded(
+                self._max_sessions, retry_after_s=self._retry_after_s
+            )
+        self._creating += 1
+        try:
+            handle = await self._backend.checkout_for_lease(deadline=deadline)
+            session_id = f"sess-{secrets.token_hex(8)}"
+            lease = build_lease(self._backend, handle, self._storage)
+            now = self._clock()
+            session = Session(
+                session_id=session_id,
+                lease=lease,
+                ttl_s=ttl,
+                idle_s=idle,
+                created_mono=now,
+                created_unix=time.time(),
+                last_used_mono=now,
+            )
+            self._journal("leased", session, reason="acquired")
+            try:
+                for path, object_id in (files or {}).items():
+                    await lease.upload(path, object_id, deadline=deadline)
+            except BaseException:
+                # The initial restore failed (bad object id, dead sandbox,
+                # deadline): the lease must not leak.
+                self._end_lease(session, "reaped", "restore_failed", "sandbox_died")
+                raise
+            self._sessions[session_id] = session
+        finally:
+            self._creating -= 1
+        logger.info(
+            "Session %s leased sandbox %s (ttl=%.0fs idle=%.0fs)",
+            session_id,
+            lease.name,
+            session.ttl_s,
+            session.idle_s,
+        )
+        return session
+
+    async def execute(
+        self,
+        session_id: str,
+        source_code: str,
+        files: dict[str, Hash] | None = None,
+        env: dict[str, str] | None = None,
+        timeout_s: float | None = None,
+        deadline: Deadline | None = None,
+        on_event=None,  # async (kind, text) -> None enables streaming
+    ) -> tuple[Session, LeaseOutcome]:
+        """One execution inside the lease. Serialized per session (a REPL is
+        a conversation, not a fan-out); restore is skipped and snapshot
+        deferred — new ``files`` the client sends are uploaded as deltas."""
+        session = self.get(session_id)
+        async with session.lock:
+            if session.closed:  # expired while we waited for the lock
+                raise SessionNotFound(
+                    f"session {session_id!r} expired ({session.close_reason})"
+                )
+            session.last_used_mono = self._clock()
+            lease = session.lease
+            try:
+                with collect_transfer() as transfer:
+                    for path, object_id in (files or {}).items():
+                        await lease.upload(path, object_id, deadline=deadline)
+                    self._journal("executing", session)
+                    outcome = await lease.execute(
+                        source_code,
+                        env or {},
+                        timeout_s,
+                        deadline=deadline,
+                        on_event=on_event,
+                    )
+            except SandboxTransientError as e:
+                # The sandbox died (or was watchdog-killed) under the lease:
+                # its state is gone, so the session is over. No transparent
+                # replay — a fresh sandbox would not BE this session.
+                self._end_lease(
+                    session,
+                    "reaped",
+                    getattr(e, "reap_reason", "died_mid_lease"),
+                    "sandbox_died",
+                    detail=str(e)[:200],
+                )
+                raise
+            except asyncio.CancelledError:
+                # Client vanished (or the edge deadline fired) mid-execute:
+                # the cancelled data-plane call killed the in-flight run, but
+                # the sandbox server — and the session state — survive. The
+                # lease stays open; if the client never comes back, the
+                # TTL/idle sweep reaps it (chaos scenario 10 asserts this).
+                session.last_used_mono = self._clock()
+                self._journal("leased", session)
+                raise
+            session.executions += 1
+            session.last_used_mono = self._clock()
+            if outcome.usage is not None:
+                outcome.usage.update(transfer.as_dict())
+            # Back to idle-in-lease: the fleet view shows an owned, idle
+            # sandbox (not an executing one) between REPL turns.
+            self._journal("leased", session)
+            return session, outcome
+
+    async def checkpoint(
+        self, session_id: str, deadline: Deadline | None = None
+    ) -> tuple[Session, Checkpoint]:
+        """Snapshot the live workspace's tracked files through storage; the
+        deferred-snapshot bill is paid here, once, instead of per execute."""
+        session = self.get(session_id)
+        async with session.lock:
+            if session.closed:
+                raise SessionNotFound(f"session {session_id!r} expired")
+            session.last_used_mono = self._clock()
+            files = await session.lease.snapshot(
+                sorted(session.lease.tracked_paths), deadline=deadline
+            )
+            checkpoint = Checkpoint(
+                checkpoint_id=f"ckpt-{len(session.checkpoints) + 1}-{secrets.token_hex(4)}",
+                files=files,
+                created_unix=time.time(),
+            )
+            session.checkpoints[checkpoint.checkpoint_id] = checkpoint
+            session.last_used_mono = self._clock()
+            return session, checkpoint
+
+    async def rollback(
+        self,
+        session_id: str,
+        checkpoint_id: str,
+        deadline: Deadline | None = None,
+    ) -> tuple[Session, Checkpoint]:
+        """Restore a prior checkpoint into the live workspace: checkpoint
+        files re-uploaded, files created since best-effort deleted."""
+        session = self.get(session_id)
+        async with session.lock:
+            if session.closed:
+                raise SessionNotFound(f"session {session_id!r} expired")
+            checkpoint = session.checkpoints.get(checkpoint_id)
+            if checkpoint is None:
+                raise CheckpointNotFound(
+                    f"session {session_id!r} has no checkpoint {checkpoint_id!r}"
+                )
+            session.last_used_mono = self._clock()
+            strays = session.lease.tracked_paths - set(checkpoint.files)
+            await session.lease.restore(
+                checkpoint.files, sorted(strays), deadline=deadline
+            )
+            session.last_used_mono = self._clock()
+            return session, checkpoint
+
+    async def release(self, session_id: str) -> Session:
+        """Clean client release (``DELETE /v1/sessions/{id}``)."""
+        session = self.get(session_id)
+        async with session.lock:
+            if not session.closed:
+                self._end_lease(session, "released", "lease_released", "released")
+        return session
+
+    # ---------------------------------------------------------------- expiry
+
+    async def sweep_once(self) -> int:
+        """Expire leases past their TTL / idle bound (or all of them while
+        draining). Sessions with an execute in flight are skipped — the run
+        is deadline- and watchdog-bounded; the next sweep gets them."""
+        draining = self._drain is not None and self._drain.draining
+        now = self._clock()
+        expired = 0
+        for session in list(self._sessions.values()):
+            if session.closed or session.lock.locked():
+                continue
+            if draining:
+                reason = "drain"
+            elif now - session.created_mono >= session.ttl_s:
+                reason = "ttl"
+            elif now - session.last_used_mono >= session.idle_s:
+                reason = "idle"
+            else:
+                continue
+            logger.info(
+                "Expiring session %s (%s) after %d execution(s)",
+                session.session_id,
+                reason,
+                session.executions,
+            )
+            self._end_lease(session, "lease_expired", reason, reason)
+            expired += 1
+        return expired
+
+    async def close_all(self, reason: str = "shutdown") -> int:
+        """Deterministic teardown (``ctx.aclose``): every lease ends NOW."""
+        closed = 0
+        for session in list(self._sessions.values()):
+            if not session.closed:
+                self._end_lease(session, "lease_expired", reason, reason)
+                closed += 1
+        return closed
+
+    # ------------------------------------------------------------- internals
+
+    def _journal(self, state: str, session: Session, reason: str | None = None) -> None:
+        journal = getattr(self._backend, "journal", None)
+        if journal is None:
+            return
+        attrs: dict = {"session": session.session_id}
+        journal.record(session.lease.name, state, reason=reason, **attrs)
+
+    def _end_lease(
+        self,
+        session: Session,
+        state: str,
+        journal_reason: str,
+        metric_reason: str,
+        detail: str | None = None,
+    ) -> None:
+        """The ONE spelling for a lease's end: journal terminal event with
+        the real reason, sandbox torn down via the backend (which kicks a
+        refill), duration + reason accounted in metrics."""
+        if session.closed:
+            return
+        session.closed = True
+        session.close_reason = metric_reason
+        self._sessions.pop(session.session_id, None)
+        self._backend.release_lease(
+            session.lease.handle, state=state, reason=journal_reason, detail=detail
+        )
+        if self._lease_seconds is not None:
+            self._lease_seconds.observe(self._clock() - session.created_mono)
+        if self._expirations_total is not None:
+            self._expirations_total.inc(reason=metric_reason)
+        self.expired_total[metric_reason] = (
+            self.expired_total.get(metric_reason, 0) + 1
+        )
+
+    def snapshot(self) -> dict:
+        """Operator view for ``GET /v1/sessions`` and the debug bundle."""
+        now = self._clock()
+        return {
+            "sessions": [s.to_dict(now) for s in self._sessions.values()],
+            "active": len(self._sessions),
+            "max": self._max_sessions,
+            "ended_by_reason": dict(self.expired_total),
+        }

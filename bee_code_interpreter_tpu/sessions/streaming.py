@@ -1,0 +1,61 @@
+"""Transport-neutral streaming pump (docs/sessions.md "Streaming").
+
+Both streaming edges — the HTTP SSE response and the gRPC ``ExecuteStream``
+server stream — consume the same event sequence: zero or more output chunks
+
+    {"stream": "stdout"|"stderr", "data": "<text>"}
+
+closed by EXACTLY one terminal event,
+
+    {"event": "result", "result": <backend Result | LeaseOutcome>}   or
+    {"event": "error", "error": <exception>}
+
+:func:`streamed_events` adapts the backends' callback-shaped
+``execute_stream(..., on_event=...)`` API into that async-iterator shape,
+and guarantees the underlying execution is cancelled if the consumer
+abandons the iterator (client vanished mid-stream) — the sandbox side then
+unwinds through its own teardown, so a dead client never leaves a run
+dangling against a workspace nothing will read.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import AsyncIterator, Awaitable, Callable
+
+
+async def streamed_events(
+    run: Callable[[Callable], Awaitable],
+) -> AsyncIterator[dict]:
+    """Drive ``run(on_event)`` (an awaitable returning the final result)
+    while yielding its chunk events as they arrive, then one terminal
+    event. The runner is cancelled if the consumer stops iterating."""
+    queue: asyncio.Queue[dict] = asyncio.Queue()
+
+    async def on_event(kind: str, text: str) -> None:
+        await queue.put({"stream": kind, "data": text})
+
+    async def runner() -> None:
+        try:
+            result = await run(on_event)
+        except BaseException as e:  # terminal errors are in-band events
+            await queue.put({"event": "error", "error": e})
+        else:
+            await queue.put({"event": "result", "result": result})
+
+    task = asyncio.ensure_future(runner())
+    try:
+        while True:
+            item = await queue.get()
+            yield item
+            if "event" in item:
+                return
+    finally:
+        if not task.done():
+            task.cancel()
+        try:
+            await task
+        except (asyncio.CancelledError, Exception):
+            # The consumer is gone; the cancellation (or the error already
+            # reported in-band) has nowhere useful to propagate.
+            pass

@@ -289,18 +289,7 @@ class Executor {
         read_file(env_or("APP_REQUIREMENTS_SKIP", "/requirements-skip.txt")),
         guesser_.preinstalled);
     if (config_.prestart) {
-      auto env = base_env({});
-      // base_env deliberately excludes APP_* control vars; the preload list
-      // is the one the bootstrap needs.
-      const std::string preload = env_or("APP_PRESTART_IMPORTS", "");
-      if (!preload.empty()) env["APP_PRESTART_IMPORTS"] = preload;
-      const std::string preload_timeout = env_or("APP_PRESTART_PRELOAD_TIMEOUT_S", "");
-      if (!preload_timeout.empty())
-        env["APP_PRESTART_PRELOAD_TIMEOUT_S"] = preload_timeout;
-      prestart_ = subprocess::spawn({config_.python, "-c", kPrestartBootstrap},
-                                    env, config_.workspace_root.string(),
-                                    /*want_stdin=*/true, /*want_status=*/true);
-      prestart_spawned_at_ = std::chrono::steady_clock::now();
+      spawn_prestart();
       const char* pt = getenv("APP_PRESTART_PRELOAD_TIMEOUT_S");
       if (pt) {
         char* end = nullptr;
@@ -308,6 +297,28 @@ class Executor {
         if (end != pt && v > 0) preload_deadline_s_ = v;
       }
     }
+  }
+
+  // Spawn (or re-spawn) the pre-started warm interpreter. Called from the
+  // constructor and, under prestart_mutex_, right after a request claims
+  // the current worker: a session lease runs N executes against this ONE
+  // server, and execute #2..N should find a preloaded interpreter the way
+  // execute #1 did. Single-use sandboxes die moments after their one
+  // execute; the unclaimed replacement dies with them (ppid watchdog).
+  void spawn_prestart() {
+    auto env = base_env({});
+    // base_env deliberately excludes APP_* control vars; the preload list
+    // is the one the bootstrap needs.
+    const std::string preload = env_or("APP_PRESTART_IMPORTS", "");
+    if (!preload.empty()) env["APP_PRESTART_IMPORTS"] = preload;
+    const std::string preload_timeout = env_or("APP_PRESTART_PRELOAD_TIMEOUT_S", "");
+    if (!preload_timeout.empty())
+      env["APP_PRESTART_PRELOAD_TIMEOUT_S"] = preload_timeout;
+    prestart_ = subprocess::spawn({config_.python, "-c", kPrestartBootstrap},
+                                  env, config_.workspace_root.string(),
+                                  /*want_stdin=*/true, /*want_status=*/true);
+    prestart_spawned_at_ = std::chrono::steady_clock::now();
+    prestart_warm_seen_ = false;
   }
 
   minihttp::Response handle(const minihttp::Request& req) {
@@ -517,10 +528,19 @@ class Executor {
         hermetic_it != request_env.end() && hermetic_it->second == "1";
     subprocess::Child worker;
     if (!hermetic) {
-      // Claim the pre-started worker (single-use, like the sandbox itself).
+      // Claim the pre-started worker (single-use). From the SECOND claim
+      // on, this server is evidently serving a session lease (single-use
+      // sandboxes execute once and die), so re-warm for the next REPL
+      // turn. The first claim deliberately does NOT respawn: the
+      // replacement's preload (numpy import) would compete with the user
+      // code for CPU — measured ~4-7 ms added to the stateless warm p50 —
+      // for a worker a single-use sandbox never uses. Net: lease turn #1
+      // warm, #2 cold (triggers the re-warm), #3+ warm.
       std::lock_guard<std::mutex> lock(prestart_mutex_);
       worker = prestart_;
       prestart_ = {};
+      if (config_.prestart && claimed_once_) spawn_prestart();
+      claimed_once_ = true;
     }
     bool ran_warm = false;
     double remaining_s = timeout_s;
@@ -770,6 +790,9 @@ class Executor {
   subprocess::Child prestart_;
   std::mutex prestart_mutex_;
   bool prestart_warm_seen_ = false;
+  // True after the first worker claim: the signal that this server is
+  // serving a session lease (single-use sandboxes claim exactly once).
+  bool claimed_once_ = false;
   std::chrono::steady_clock::time_point prestart_spawned_at_;
   double preload_deadline_s_ = 45.0;
 };

@@ -33,6 +33,14 @@ wiring minus kubectl. Scenarios:
                             depth and executions_total unchanged, and every
                             refusal accounted in
                             bci_analysis_rejections_total{rule}
+ 10. sessions under chaos — a streaming client vanishes mid-chunk (the
+                            lease survives and is reaped by the TTL sweep),
+                            a sandbox dies mid-lease (the session ends as
+                            reaped/died_mid_lease and the pool refills),
+                            and a stateless stream whose pod dies delivers
+                            a terminal error event — with
+                            bci_session_expirations_total accounting every
+                            lease end exactly
 
 Exits nonzero if any scenario misbehaves. Usage:
 
@@ -464,6 +472,118 @@ async def main() -> int:
         finally:
             await pods9.close()
 
+        # 10. sessions under chaos: vanished stream client, sandbox death
+        #     mid-lease, terminal error events, exact lease accounting
+        from bee_code_interpreter_tpu.sessions import (
+            SessionManager,
+            streamed_events,
+        )
+
+        m10 = Registry()
+        executor10, _, faults10, pods10 = make_stack(tmp, storage, m10, clock)
+        k8s10 = executor10.primary.primary  # unwrap resilient -> hedging -> pool
+        try:
+            k8s10._config.executor_pod_queue_target_length = 1
+            await k8s10.fill_executor_pod_queue()
+            sessions10 = SessionManager(
+                k8s10, storage, max_sessions=2, ttl_s=0.6, idle_s=10.0,
+                metrics=m10,
+            )
+
+            # 10a. client vanishes mid-stream: the lease survives the
+            #      disconnect and the TTL sweep reaps it later.
+            session_a = await sessions10.create()
+            chunks_seen = asyncio.Event()
+
+            async def first_chunk(_kind, _text):
+                chunks_seen.set()
+
+            vanish = asyncio.ensure_future(
+                sessions10.execute(
+                    session_a.session_id,
+                    "import time\nprint('c1', flush=True)\ntime.sleep(20)\n",
+                    on_event=first_chunk,
+                )
+            )
+            await asyncio.wait_for(chunks_seen.wait(), timeout=10)
+            vanish.cancel()  # the "client" is gone
+            try:
+                await vanish
+            except asyncio.CancelledError:
+                pass
+            report(
+                "vanished stream client leaves the lease alive",
+                sessions10.active_count == 1,
+                f"active={sessions10.active_count}",
+            )
+            await asyncio.sleep(0.7)  # past the 0.6s TTL
+            expired = await sessions10.sweep_once()
+            for _ in range(200):  # the reap kicks a refill fire-and-forget
+                if k8s10.pool_ready_count >= 1:
+                    break
+                await asyncio.sleep(0.01)
+            ttl_events = [
+                e
+                for e in k8s10.journal.events()
+                if e["state"] == "lease_expired" and e.get("reason") == "ttl"
+            ]
+            report(
+                "abandoned lease reaped on TTL and the pool refilled",
+                expired == 1
+                and len(ttl_events) == 1
+                and k8s10.pool_ready_count >= 1,
+                f"expired={expired} ready={k8s10.pool_ready_count}",
+            )
+
+            # 10b. the sandbox dies mid-lease: the session ends as
+            #      reaped/died_mid_lease and the pool refills.
+            session_b = await sessions10.create()
+            faults10.die_mid_execute()
+            try:
+                await sessions10.execute(session_b.session_id, "print('x')")
+                report("sandbox death mid-lease surfaces", False, "succeeded?!")
+            except SandboxTransientError:
+                died_events = [
+                    e
+                    for e in k8s10.journal.events()
+                    if e["state"] == "reaped"
+                    and e.get("reason") == "died_mid_lease"
+                ]
+                report(
+                    "sandbox death mid-lease ends the session as reaped",
+                    sessions10.active_count == 0 and len(died_events) == 1,
+                    f"active={sessions10.active_count}",
+                )
+
+            # 10c. a stateless stream whose pod dies mid-run delivers a
+            #      terminal error event (never a silent hang).
+            faults10.die_mid_execute()
+
+            async def run_stream(on_event):
+                return await k8s10.execute_stream(
+                    "print('doomed')", on_event=on_event
+                )
+
+            events = [item async for item in streamed_events(run_stream)]
+            report(
+                "mid-stream pod death yields a terminal error event",
+                bool(events) and events[-1].get("event") == "error",
+                f"terminal={events[-1].get('event') if events else None}",
+            )
+
+            # 10d. exact accounting: every lease end has exactly one reason.
+            ends = m10.metrics["bci_session_expirations_total"]._values
+            ttl_n = ends.get((("reason", "ttl"),), 0)
+            died_n = ends.get((("reason", "sandbox_died"),), 0)
+            report(
+                "every lease end accounted in bci_session_expirations_total",
+                ttl_n == 1 and died_n == 1 and sum(ends.values()) == 2,
+                f"ttl={ttl_n:g} sandbox_died={died_n:g} total={sum(ends.values()):g}",
+            )
+            dump_fleet("sessions under chaos", executor10)
+        finally:
+            await pods10.close()
+
         text = metrics.expose()
         wanted = [
             "bci_executor_fallback_total 1",
@@ -486,8 +606,8 @@ async def main() -> int:
         return 1
     print(
         "chaos smoke passed: deadline, breaker, fallback, admission, replay, "
-        "supervisor, watchdog, drain, telemetry export, edge analysis gate "
-        "all behaved"
+        "supervisor, watchdog, drain, telemetry export, edge analysis gate, "
+        "sessions-under-chaos all behaved"
     )
     return 0
 

@@ -60,17 +60,39 @@ def render_snapshot(snap: dict) -> str:
         )
     )
     lines.append("")
-    header = f"{'POD':<28} {'STATE':<10} {'AGE':>7} {'SPAWN':>8} {'WORKERS':>7} {'EXECS':>5}"
+    header = (
+        f"{'POD':<28} {'STATE':<10} {'AGE':>7} {'SPAWN':>8} {'WORKERS':>7} "
+        f"{'EXECS':>5}  {'SESSION':<22} {'LEASE':>7}"
+    )
     lines.append(header)
     lines.append("-" * len(header))
     for pod in snap["pods"]:
         spawn = f"{pod['spawn_s'] * 1000:.0f}ms" if pod.get("spawn_s") else "-"
+        # Leased sandboxes show their owner session + lease age so an
+        # operator can tell a busy REPL from a stuck pod (docs/sessions.md);
+        # EXECS counts executions inside the lease.
+        session = pod.get("session") or "-"
+        lease_age = fmt_age(pod.get("lease_age_s")) if pod.get("session") else "-"
         lines.append(
             f"{pod['pod']:<28} {pod['state']:<10} {fmt_age(pod['age_s']):>7} "
-            f"{spawn:>8} {pod['workers']:>7} {pod['executions']:>5}"
+            f"{spawn:>8} {pod['workers']:>7} {pod['executions']:>5}  "
+            f"{session:<22} {lease_age:>7}"
         )
     if not snap["pods"]:
         lines.append("(no live sandboxes)")
+    sess = snap.get("sessions")
+    if sess:
+        lines.append(
+            f"sessions: {sess['active']}/{sess['max']} leased"
+            + (
+                "  ended: "
+                + ", ".join(
+                    f"{k}={v}" for k, v in sorted(sess["ended_by_reason"].items())
+                )
+                if sess.get("ended_by_reason")
+                else ""
+            )
+        )
     return "\n".join(lines)
 
 

@@ -55,7 +55,8 @@ class FakeExecutorPods:
 
         @web.middleware
         async def count_executes(request, handler):
-            if request.path == "/execute":
+            # /execute and its streaming twin /execute/stream both count.
+            if request.path.startswith("/execute"):
                 self.execute_counts[ip] = self.execute_counts.get(ip, 0) + 1
             return await handler(request)
 
@@ -63,7 +64,7 @@ class FakeExecutorPods:
         async def inject_faults(request, handler):
             if self.faults is not None:
                 op = None
-                if request.path == "/execute":
+                if request.path.startswith("/execute"):
                     op = "execute"
                 elif request.path.startswith("/workspace"):
                     op = "upload" if request.method == "PUT" else "download"

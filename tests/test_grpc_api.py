@@ -272,6 +272,7 @@ async def test_server_reflection_list_and_describe(grpc_server):
         OBSERVABILITY_SERVICE_NAME,
         REFLECTION_SERVICE_NAME,
         SERVICE_NAME,
+        SESSION_SERVICE_NAME,
         reflection_stub,
     )
     from bee_code_interpreter_tpu.proto import reflection_pb2
@@ -298,6 +299,7 @@ async def test_server_reflection_list_and_describe(grpc_server):
             listed = {s.name for s in responses[0].list_services_response.service}
             assert listed == {
                 SERVICE_NAME,
+                SESSION_SERVICE_NAME,
                 FLEET_SERVICE_NAME,
                 OBSERVABILITY_SERVICE_NAME,
                 HEALTH_SERVICE_NAME,

@@ -93,6 +93,10 @@ def test_every_registered_metric_follows_conventions(tmp_path):
         "bci_analysis_rejections_total",
         "bci_analysis_warnings_total",
         "bci_analysis_dep_predictions_total",
+        # sessions (ISSUE 7): leased sandboxes + checkpoint/rollback
+        "bci_session_active",
+        "bci_session_lease_seconds",
+        "bci_session_expirations_total",
     ):
         assert required in metrics, f"{required}: not registered by the wiring"
     assert isinstance(metrics["bci_pool_spawn_seconds"], Histogram)
@@ -112,6 +116,9 @@ def test_every_registered_metric_follows_conventions(tmp_path):
     assert isinstance(metrics["bci_analysis_seconds"], Histogram)
     assert isinstance(metrics["bci_analysis_rejections_total"], Counter)
     assert isinstance(metrics["bci_analysis_dep_predictions_total"], Counter)
+    assert isinstance(metrics["bci_session_active"], Gauge)
+    assert isinstance(metrics["bci_session_lease_seconds"], Histogram)
+    assert isinstance(metrics["bci_session_expirations_total"], Counter)
 
     for name, metric in metrics.items():
         assert name.startswith("bci_"), (
