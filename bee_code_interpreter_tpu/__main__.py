@@ -47,6 +47,9 @@ async def main() -> None:
     # Background OTLP push of traces + metric snapshots (APP_OTLP_ENDPOINT);
     # no-op when export isn't configured.
     ctx.start_telemetry_exporter()
+    # Flight-recorder disk flusher, event-loop lag probe, and the
+    # continuous profiler (docs/observability.md).
+    ctx.start_observability()
 
     stop = asyncio.Event()
     loop = asyncio.get_running_loop()

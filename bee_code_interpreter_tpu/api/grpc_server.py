@@ -36,7 +36,10 @@ from bee_code_interpreter_tpu.observability import (
     find_journal,
     parse_traceparent,
     record_usage_at_edge,
+    register_stream_metrics,
     register_usage_metrics,
+    task_inventory,
+    thread_inventory,
 )
 from bee_code_interpreter_tpu.proto import code_interpreter_pb2 as pb
 from bee_code_interpreter_tpu.proto import health_pb2, reflection_pb2
@@ -89,6 +92,17 @@ _METHODS: dict[str, tuple[type, type]] = {
     "ParseCustomTool": (pb.ParseCustomToolRequest, pb.ParseCustomToolResponse),
     "ExecuteCustomTool": (pb.ExecuteCustomToolRequest, pb.ExecuteCustomToolResponse),
 }
+
+
+def _annotate_outcome(label: str, ok: bool | None) -> None:
+    """Stamp the resilience ladder's verdict on the RPC's root span so the
+    flight recorder's wide event (a tracer sink) carries the outcome and
+    SLO classification — the exact mirror of the HTTP edge's annotation."""
+    trace = current_trace()
+    if trace is not None:
+        trace.root.attributes["outcome"] = label
+        if ok is not None:
+            trace.root.attributes["sli"] = "good" if ok else "bad"
 
 
 def _violation_text(error: ValidationError) -> str:
@@ -157,6 +171,9 @@ class CodeInterpreterServicer:
         # gRPC callers read the figures off the trace span / metrics.
         self._execution_cpu_seconds, self._execution_peak_rss = (
             register_usage_metrics(metrics) if metrics is not None else (None, None)
+        )
+        self._stream_ttfb_seconds, self._stream_chunks_total = (
+            register_stream_metrics(metrics) if metrics is not None else (None, None)
         )
 
     def _sample_client_fault(self, start: float) -> None:
@@ -229,6 +246,7 @@ class CodeInterpreterServicer:
             context.set_trailing_metadata(
                 (("retry-after-s", f"{self._drain.retry_after_s:g}"),)
             )
+            _annotate_outcome("drained", None)
             await context.abort(
                 grpc.StatusCode.UNAVAILABLE,
                 "service draining; retry against another replica",
@@ -236,6 +254,7 @@ class CodeInterpreterServicer:
         deadline = self._new_deadline(context)
         slo_start = time.monotonic()
         sample = _SliSample()
+        label = "cancelled"  # only a CancelledError leaves it unassigned
         try:
             try:
                 # track() covers the admission wait too (mirror of the HTTP
@@ -254,7 +273,9 @@ class CodeInterpreterServicer:
                         yield deadline, sample
                 if sample.ok is None:
                     sample.ok = True
+                label = "ok" if sample.ok else "error"
             except AdmissionRejected as e:
+                label = "shed"
                 context.set_trailing_metadata(
                     (("retry-after-s", f"{e.retry_after_s:g}"),)
                 )
@@ -264,6 +285,7 @@ class CodeInterpreterServicer:
                 )
             except DeadlineExceeded:
                 sample.ok = False
+                label = "deadline"
                 if self._deadline_exceeded_total is not None:
                     self._deadline_exceeded_total.inc(transport="grpc")
                 await context.abort(
@@ -273,6 +295,7 @@ class CodeInterpreterServicer:
                 # Open breaker, no fallback: retryable overload, not an internal
                 # error — UNAVAILABLE with the breaker's retry hint.
                 sample.ok = False
+                label = "breaker_open"
                 context.set_trailing_metadata(
                     (("retry-after-s", f"{e.retry_after_s:g}"),)
                 )
@@ -284,15 +307,18 @@ class CodeInterpreterServicer:
                 raise  # client went away: sample.ok untouched (not a sample)
             except _ABORT_ERRORS:
                 sample.ok = True  # body aborted INVALID_ARGUMENT: client fault
+                label = "client_error"
                 raise
             except BaseException:
                 sample.ok = False  # unhandled → gRPC UNKNOWN
+                label = "error"
                 raise
         finally:
             if self._slo is not None and sample.ok is not None:
                 self._slo.record(
                     ok=sample.ok, duration_s=time.monotonic() - slo_start
                 )
+            _annotate_outcome(label, sample.ok)
 
     async def _with_resilience(self, context: grpc.aio.ServicerContext, run):
         """Run a unary sandbox-bound RPC body under :meth:`_resilience_scope`;
@@ -426,40 +452,74 @@ class CodeInterpreterServicer:
             # yield), so it enters the shared ladder directly; terminal
             # events set sample.ok the way a unary body's return would.
             async with self._resilience_scope(context) as (deadline, sample):
+                stream_start = time.monotonic()
+                chunks = 0
+                first_chunk_s: float | None = None
+
+                def _annotate_stream() -> None:
+                    # Stream context onto the root span (→ the wide event)
+                    # and the production streaming metrics, mirroring SSE.
+                    if self._stream_chunks_total is not None:
+                        self._stream_chunks_total.inc(chunks, transport="grpc")
+                    trace = current_trace()
+                    if trace is not None:
+                        trace.root.attributes["stream.chunks"] = str(chunks)
+                        if first_chunk_s is not None:
+                            trace.root.attributes["stream.ttfb_ms"] = (
+                                f"{first_chunk_s * 1000:.3f}"
+                            )
+
                 stash_predicted_deps(None)
                 verdict = (
                     self._analyzer.analyze(validated.source_code)
                     if self._analyzer is not None
                     else None
                 )
-                if verdict is not None:
-                    if verdict.syntax_error is not None:
-                        # Fail-fast terminal event, zero checkouts.
-                        sample.ok = True
-                        yield json.dumps(
-                            {
-                                "event": "result",
-                                "stdout": "",
-                                "stderr": verdict.syntax_error,
-                                "exit_code": 1,
-                            }
-                        ).encode()
-                        return
-                    if verdict.denials:
-                        await context.abort(
-                            grpc.StatusCode.INVALID_ARGUMENT,
-                            "denied by execution policy: "
-                            f"{verdict.denial_detail()}",
-                        )
-                    stash_predicted_deps(verdict.predicted_deps)
-                async for event in self._stream_events(
-                    session_id, validated, deadline, context
-                ):
-                    if event.get("event") == "error":
-                        sample.ok = event.pop("_client_fault", False)
-                    elif event.get("event") == "result":
-                        sample.ok = True
-                    yield json.dumps(event).encode()
+                # finally, not per-terminal-event calls: a client that
+                # cancels mid-stream unwinds the generator before any
+                # terminal event, and its delivered chunks must still be
+                # counted and stamped on the wide event (SSE twin agrees).
+                try:
+                    if verdict is not None:
+                        if verdict.syntax_error is not None:
+                            # Fail-fast terminal event, zero checkouts.
+                            sample.ok = True
+                            yield json.dumps(
+                                {
+                                    "event": "result",
+                                    "stdout": "",
+                                    "stderr": verdict.syntax_error,
+                                    "exit_code": 1,
+                                }
+                            ).encode()
+                            return
+                        if verdict.denials:
+                            await context.abort(
+                                grpc.StatusCode.INVALID_ARGUMENT,
+                                "denied by execution policy: "
+                                f"{verdict.denial_detail()}",
+                            )
+                        stash_predicted_deps(verdict.predicted_deps)
+                    async for event in self._stream_events(
+                        session_id, validated, deadline, context
+                    ):
+                        if event.get("event") == "error":
+                            sample.ok = event.pop("_client_fault", False)
+                        elif event.get("event") == "result":
+                            sample.ok = True
+                        else:
+                            if chunks == 0:
+                                first_chunk_s = (
+                                    time.monotonic() - stream_start
+                                )
+                                if self._stream_ttfb_seconds is not None:
+                                    self._stream_ttfb_seconds.observe(
+                                        first_chunk_s, transport="grpc"
+                                    )
+                            chunks += 1
+                        yield json.dumps(event).encode()
+                finally:
+                    _annotate_stream()
 
     async def _stream_events(self, session_id, validated, deadline, context):
         """The shared chunk/terminal event pump for ``ExecuteStream``,
@@ -995,14 +1055,26 @@ OBSERVABILITY_SERVICE_NAME = "code_interpreter.v1.ObservabilityService"
 
 
 class ObservabilityServicer:
-    """SLO state and the one-call debug bundle over gRPC — the transport
-    mirror of ``GET /v1/slo`` / ``GET /v1/debug/bundle``, as JSON message
-    bytes through a generic handler (same protoc-less trick as
+    """SLO state, the one-call debug bundle, the flight recorder's wide
+    events, the live task inventory, and the continuous profiler over gRPC
+    — the transport mirror of ``GET /v1/slo`` / ``/v1/debug/bundle`` /
+    ``/v1/events`` / ``/v1/debug/tasks`` / ``/v1/debug/pprof``, as JSON
+    message bytes through a generic handler (same protoc-less trick as
     ``FleetService``)."""
 
-    def __init__(self, slo=None, debug_bundle=None) -> None:
+    def __init__(
+        self,
+        slo=None,
+        debug_bundle=None,
+        recorder=None,  # observability.FlightRecorder
+        loopmon=None,  # observability.LoopMonitor
+        contprof=None,  # observability.ContinuousProfiler
+    ) -> None:
         self._slo = slo
         self._debug_bundle = debug_bundle
+        self._recorder = recorder
+        self._loopmon = loopmon
+        self._contprof = contprof
 
     async def GetSlo(self, request: bytes, context) -> bytes:
         snapshot = (
@@ -1018,8 +1090,83 @@ class ObservabilityServicer:
             )
         return json.dumps(self._debug_bundle()).encode()
 
+    async def GetEvents(self, request: bytes, context) -> bytes:
+        """Wide events, filtered like ``GET /v1/events``: optional JSON
+        request ``{"kind"|"outcome"|"session": str, "limit"|
+        "min_duration_ms"|"since": number}`` (no streaming mirror — live
+        tails are the SSE endpoint's job)."""
+        if self._recorder is None:
+            await context.abort(
+                grpc.StatusCode.UNIMPLEMENTED,
+                "no flight recorder wired into this server",
+            )
+        body: dict = {}
+        if request:
+            try:
+                body = json.loads(request.decode())
+                if not isinstance(body, dict):
+                    raise ValueError("not an object")
+            except (ValueError, UnicodeDecodeError):
+                await context.abort(
+                    grpc.StatusCode.INVALID_ARGUMENT,
+                    'request must be JSON like {"outcome": "error", "limit": 50}',
+                )
+        try:
+            events = self._recorder.events(
+                kind=body.get("kind"),
+                outcome=body.get("outcome"),
+                session=body.get("session"),
+                min_duration_ms=(
+                    float(body["min_duration_ms"])
+                    if body.get("min_duration_ms") is not None
+                    else None
+                ),
+                since=(
+                    float(body["since"])
+                    if body.get("since") is not None
+                    else None
+                ),
+                limit=(
+                    int(body["limit"])
+                    if body.get("limit") is not None
+                    else None
+                ),
+            )
+        except (TypeError, ValueError):
+            await context.abort(
+                grpc.StatusCode.INVALID_ARGUMENT,
+                "limit, min_duration_ms and since must be numeric",
+            )
+        return json.dumps({"events": events}).encode()
 
-_OBSERVABILITY_METHODS = ("GetSlo", "GetDebugBundle")
+    async def GetTasks(self, request: bytes, context) -> bytes:
+        body = task_inventory()
+        body["threads"] = thread_inventory()
+        if self._loopmon is not None:
+            body["monitor"] = self._loopmon.snapshot()
+        return json.dumps(body).encode()
+
+    async def GetPprof(self, request: bytes, context) -> bytes:
+        if self._contprof is None:
+            await context.abort(
+                grpc.StatusCode.UNIMPLEMENTED,
+                "no continuous profiler wired into this server",
+            )
+        return json.dumps(
+            {
+                **self._contprof.snapshot(),
+                "collapsed": self._contprof.collapsed(),
+            }
+        ).encode()
+
+
+_OBSERVABILITY_METHODS = (
+    "GetSlo",
+    "GetDebugBundle",
+    "GetEvents",
+    "GetTasks",
+    "GetPprof",
+)
 
 
 def _observability_handler(servicer: ObservabilityServicer) -> grpc.GenericRpcHandler:
@@ -1291,6 +1438,9 @@ class GrpcServer:
         debug_bundle=None,  # callable -> dict (ApplicationContext builder)
         analyzer=None,  # analysis.WorkloadAnalyzer shared with the HTTP edge
         sessions=None,  # sessions.SessionManager shared with the HTTP edge
+        recorder=None,  # observability.FlightRecorder shared with the HTTP edge
+        loopmon=None,  # observability.LoopMonitor shared with the HTTP edge
+        contprof=None,  # observability.ContinuousProfiler, likewise
     ) -> None:
         self._servicer = CodeInterpreterServicer(
             code_executor,
@@ -1306,6 +1456,9 @@ class GrpcServer:
         )
         self._slo = slo
         self._debug_bundle = debug_bundle
+        self._recorder = recorder
+        self._loopmon = loopmon
+        self._contprof = contprof
         # Mirror the HTTP edge: use the executor backend's own journal when
         # one exists (find_journal is the one shared discovery rule), else
         # an (honestly empty) standalone journal. Explicit None checks: an
@@ -1351,7 +1504,11 @@ class GrpcServer:
                 _fleet_handler(FleetServicer(self._fleet)),
                 _observability_handler(
                     ObservabilityServicer(
-                        slo=self._slo, debug_bundle=self._debug_bundle
+                        slo=self._slo,
+                        debug_bundle=self._debug_bundle,
+                        recorder=self._recorder,
+                        loopmon=self._loopmon,
+                        contprof=self._contprof,
                     )
                 ),
                 _health_handler(self.health),

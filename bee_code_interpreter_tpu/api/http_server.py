@@ -30,7 +30,11 @@ on-demand ``jax.profiler`` trace of a sandbox execution or of N
 serving-engine steps. ``GET /v1/slo`` reports error-budget burn rates,
 ``GET /v1/debug/bundle`` is the one-call incident snapshot, and
 ``GET /metrics`` serves OpenMetrics-with-exemplars when the scraper's
-``Accept`` header asks for it.
+``Accept`` header asks for it. ``GET /v1/events`` serves the flight
+recorder's wide-event journal (filterable; ``?follow=1`` is a live SSE
+tail), ``GET /v1/debug/tasks`` the live asyncio task inventory + loop-lag
+state, and ``GET /v1/debug/pprof`` the continuous profiler's latest
+collapsed-stack window.
 
 Edge static analysis (docs/analysis.md): when a ``WorkloadAnalyzer`` is
 wired in, every submission is parsed ONCE before any sandbox is touched —
@@ -60,6 +64,7 @@ from bee_code_interpreter_tpu.observability import (
     PROFILE_DIR_ENV,
     REQUEST_ID_HEADER,
     FleetJournal,
+    FlightRecorder,
     ProfilerUnavailable,
     Tracer,
     build_debug_bundle,
@@ -71,7 +76,10 @@ from bee_code_interpreter_tpu.observability import (
     parse_traceparent,
     profile_artifacts,
     record_usage_at_edge,
+    register_stream_metrics,
     register_usage_metrics,
+    task_inventory,
+    thread_inventory,
     unwrap_executor,
 )
 from bee_code_interpreter_tpu.resilience import (
@@ -124,10 +132,19 @@ def create_http_server(
     debug_bundle=None,  # callable -> dict (ApplicationContext.build_debug_bundle)
     analyzer=None,  # analysis.WorkloadAnalyzer for the pre-flight code gate
     sessions=None,  # sessions.SessionManager for the /v1/sessions lease API
+    recorder=None,  # observability.FlightRecorder for GET /v1/events
+    loopmon=None,  # observability.LoopMonitor for GET /v1/debug/tasks
+    contprof=None,  # observability.ContinuousProfiler for GET /v1/debug/pprof
 ) -> web.Application:
     app = web.Application(client_max_size=1 << 30)
     metrics = metrics or Registry()
     tracer = tracer or Tracer(metrics=metrics)
+    if recorder is None:
+        # Standalone servers (tests) get their own recorder; the
+        # composition root passes one already wired as a tracer sink —
+        # wiring it again here would double every event.
+        recorder = FlightRecorder(metrics=metrics)
+        tracer.add_sink(recorder.record_trace)
     # The executor backend's own journal when it has one (pool executors
     # attach it at construction); an empty journal otherwise so /v1/fleet is
     # always mounted and answers honestly. Explicit None checks: an empty
@@ -147,6 +164,17 @@ def create_http_server(
         "Requests that ran out of their edge deadline",
     )
     execution_cpu_seconds, execution_peak_rss = register_usage_metrics(metrics)
+    stream_ttfb_seconds, stream_chunks_total = register_stream_metrics(metrics)
+
+    def _annotate_outcome(outcome: str, sli: bool | None) -> None:
+        """Stamp the resilience ladder's verdict on the request's root span
+        so the flight recorder's wide event (a tracer sink — it fires when
+        the trace closes) carries the outcome and SLO classification."""
+        trace = current_trace()
+        if trace is not None:
+            trace.root.attributes["outcome"] = outcome
+            if sli is not None:
+                trace.root.attributes["sli"] = "good" if sli else "bad"
 
     async def with_resilience(run):
         """Run a sandbox-bound handler body under the edge deadline and the
@@ -165,6 +193,7 @@ def create_http_server(
         # client (or the balancer) to go elsewhere, while requests already
         # in flight (tracked below) run to completion.
         if drain is not None and drain.draining:
+            _annotate_outcome("drained", None)
             return web.json_response(
                 {"detail": "Service draining; retry against another replica"},
                 status=503,
@@ -173,6 +202,7 @@ def create_http_server(
         deadline = Deadline.after(request_deadline_s) if request_deadline_s else None
         slo_start = time.monotonic()
         outcome: bool | None = None
+        label = "cancelled"  # only a CancelledError leaves it unassigned
         try:
             try:
                 # track() covers the admission wait too: a request already
@@ -193,8 +223,14 @@ def create_http_server(
                 outcome = response.status < 500 and not getattr(
                     response, "bci_sli_bad", False
                 )
+                label = (
+                    "error"
+                    if not outcome
+                    else ("ok" if response.status < 400 else "client_error")
+                )
                 return response
             except AdmissionRejected as e:
+                label = "shed"
                 logger.warning("Request shed: %s", e)
                 return web.json_response(
                     {"detail": "Service overloaded; retry later"},
@@ -203,6 +239,7 @@ def create_http_server(
                 )
             except DeadlineExceeded as e:
                 outcome = False
+                label = "deadline"
                 deadline_exceeded_total.inc(transport="http")
                 logger.warning("Request deadline exceeded: %s", e)
                 return web.json_response({"detail": "Deadline exceeded"}, status=504)
@@ -211,6 +248,7 @@ def create_http_server(
                 # overload (the breaker knows when it will probe again), not a
                 # server bug — 503 + Retry-After, never a generic 500.
                 outcome = False
+                label = "breaker_open"
                 logger.warning("Request rejected by open breaker: %s", e)
                 return web.json_response(
                     {"detail": "Backend temporarily unavailable; retry later"},
@@ -221,13 +259,16 @@ def create_http_server(
                 raise  # client went away: not an SLI sample
             except web.HTTPException as e:
                 outcome = e.status < 500  # 422 body-validation etc.
+                label = "client_error" if outcome else "error"
                 raise
             except BaseException:
                 outcome = False  # unhandled → aiohttp's 500
+                label = "error"
                 raise
         finally:
             if slo is not None and outcome is not None:
                 slo.record(ok=outcome, duration_s=time.monotonic() - slo_start)
+            _annotate_outcome(label, outcome)
 
     @web.middleware
     async def request_id_middleware(request: web.Request, handler):
@@ -319,54 +360,88 @@ def create_http_server(
         exactly one terminal ``result`` (the usual envelope, trace_id
         included) or ``error`` event. Once the stream is prepared the HTTP
         status is spent, so failures are in-band terminal events."""
-        response = await _sse_prepare(request)
-        if verdict is not None and verdict.syntax_error is not None:
-            # Fail-fast mirrors the buffered path: zero sandbox checkouts,
-            # the terminal event IS the whole stream.
+        start = time.monotonic()
+        chunks = 0
+        first_chunk_s: float | None = None
+
+        def _annotate_stream() -> None:
+            """Stream context onto the root span (→ the wide event) and the
+            production streaming metrics the bench numbers graduated into."""
+            stream_chunks_total.inc(chunks, transport="http")
             trace = current_trace()
-            await _sse_event(
-                response,
-                "result",
-                models.ExecuteResponse(
-                    stdout="",
-                    stderr=verdict.syntax_error,
-                    exit_code=1,
-                    files={},
-                    trace_id=trace.trace_id if trace is not None else None,
-                    timings_ms=trace.stage_ms() if trace is not None else None,
-                ).model_dump(),
-            )
+            if trace is not None:
+                trace.root.attributes["stream.chunks"] = str(chunks)
+                if first_chunk_s is not None:
+                    trace.root.attributes["stream.ttfb_ms"] = (
+                        f"{first_chunk_s * 1000:.3f}"
+                    )
+
+        response = await _sse_prepare(request)
+        # finally, not a tail call: a client that vanishes mid-stream (write
+        # raises / handler cancelled) must still count its delivered chunks
+        # and leave stream context on the wide event — abnormal streams are
+        # exactly the ones an operator queries for.
+        try:
+            if verdict is not None and verdict.syntax_error is not None:
+                # Fail-fast mirrors the buffered path: zero sandbox
+                # checkouts, the terminal event IS the whole stream.
+                trace = current_trace()
+                await _sse_event(
+                    response,
+                    "result",
+                    models.ExecuteResponse(
+                        stdout="",
+                        stderr=verdict.syntax_error,
+                        exit_code=1,
+                        files={},
+                        trace_id=trace.trace_id if trace is not None else None,
+                        timings_ms=(
+                            trace.stage_ms() if trace is not None else None
+                        ),
+                    ).model_dump(),
+                )
+                await response.write_eof()
+                return response
+            async for item in streamed_events(execute_call):
+                if item.get("event") == "error":
+                    error = item["error"]
+                    if isinstance(error, asyncio.CancelledError):
+                        raise error  # our own unwind (client gone); don't mask it
+                    logger.warning("Streaming execution failed: %r", error)
+                    if isinstance(error, DeadlineExceeded):
+                        detail = "Deadline exceeded"
+                    elif isinstance(error, SessionNotFound):
+                        detail = str(error)
+                    else:
+                        detail = "Execution failed"
+                    if not isinstance(error, SessionNotFound):
+                        # The 200 status was spent at prepare time, but a
+                        # mid-stream server failure must still burn
+                        # availability budget — the gRPC twin (ExecuteStream)
+                        # samples the identical failure bad, and the
+                        # transports must agree. SessionNotFound is the
+                        # client's fault (the buffered path's 404), so it
+                        # stays good.
+                        response.bci_sli_bad = True
+                    await _sse_event(response, "error", {"detail": detail})
+                elif item.get("event") == "result":
+                    await _sse_event(
+                        response, "result", envelope(item["result"])
+                    )
+                else:
+                    if chunks == 0:
+                        first_chunk_s = time.monotonic() - start
+                        stream_ttfb_seconds.observe(
+                            first_chunk_s, transport="http"
+                        )
+                    chunks += 1
+                    await _sse_event(
+                        response, item["stream"], {"text": item["data"]}
+                    )
             await response.write_eof()
             return response
-        async for item in streamed_events(execute_call):
-            if item.get("event") == "error":
-                error = item["error"]
-                if isinstance(error, asyncio.CancelledError):
-                    raise error  # our own unwind (client gone); don't mask it
-                logger.warning("Streaming execution failed: %r", error)
-                if isinstance(error, DeadlineExceeded):
-                    detail = "Deadline exceeded"
-                elif isinstance(error, SessionNotFound):
-                    detail = str(error)
-                else:
-                    detail = "Execution failed"
-                if not isinstance(error, SessionNotFound):
-                    # The 200 status was spent at prepare time, but a
-                    # mid-stream server failure must still burn availability
-                    # budget — the gRPC twin (ExecuteStream) samples the
-                    # identical failure bad, and the transports must agree.
-                    # SessionNotFound is the client's fault (the buffered
-                    # path's 404), so it stays good.
-                    response.bci_sli_bad = True
-                await _sse_event(response, "error", {"detail": detail})
-            elif item.get("event") == "result":
-                await _sse_event(response, "result", envelope(item["result"]))
-            else:
-                await _sse_event(
-                    response, item["stream"], {"text": item["data"]}
-                )
-        await response.write_eof()
-        return response
+        finally:
+            _annotate_stream()
 
     async def execute(request: web.Request) -> web.Response:
         # Admission runs BEFORE the body is read: a shed request must cost a
@@ -918,6 +993,10 @@ def create_http_server(
                 # Budget exhaustion is a *health* fact: health_check.py's
                 # --verbose warning exit keys off fast_burn_alerting here.
                 body["slo"] = slo.snapshot()
+            if loopmon is not None:
+                # Loop health next to pool health: a stalled loop makes
+                # every other number here lie by omission.
+                body["loop"] = loopmon.snapshot()
         return web.json_response(body)
 
     async def metrics_endpoint(request: web.Request) -> web.Response:
@@ -957,6 +1036,9 @@ def create_http_server(
                 executor=code_executor,
                 supervisor=supervisor,
                 drain=drain,
+                recorder=recorder,
+                loopmon=loopmon,
+                contprof=contprof,
             )
         )
         return web.json_response(bundle)
@@ -1001,6 +1083,93 @@ def create_http_server(
                 {"detail": "unknown or evicted trace"}, status=404
             )
         return web.json_response(trace.to_dict())
+
+    async def list_events(request: web.Request) -> web.StreamResponse:
+        """The flight recorder's wide-event journal (docs/observability.md
+        "Flight recorder"): filterable snapshot by default, a live SSE tail
+        with ``?follow=1`` (same filters; ``backlog=N`` replays the last N
+        matching events first)."""
+        from bee_code_interpreter_tpu.observability import event_matches
+
+        query = request.query
+        try:
+            limit = int(query["limit"]) if "limit" in query else None
+            backlog = int(query.get("backlog", "0"))
+            min_duration_ms = (
+                float(query["min_duration_ms"])
+                if "min_duration_ms" in query
+                else None
+            )
+            since = float(query["since"]) if "since" in query else None
+        except ValueError:
+            return web.json_response(
+                {
+                    "detail": "limit, backlog, min_duration_ms and since "
+                    "must be numeric"
+                },
+                status=400,
+            )
+        if (limit is not None and limit < 0) or backlog < 0:
+            return web.json_response(
+                {"detail": "limit and backlog must be >= 0"}, status=400
+            )
+        filters = {
+            "kind": query.get("kind"),
+            "outcome": query.get("outcome"),
+            "session": query.get("session"),
+            "min_duration_ms": min_duration_ms,
+            "since": since,
+        }
+        if not _truthy_query(request, "follow"):
+            return web.json_response(
+                {"events": recorder.events(limit=limit, **filters)}
+            )
+        response = await _sse_prepare(request)
+        # Subscribe BEFORE replaying the backlog: an event recorded between
+        # the two is delivered (possibly twice at the seam — consumers
+        # dedupe on `seq`), never lost.
+        queue = recorder.subscribe()
+        try:
+            for event in reversed(recorder.events(limit=backlog, **filters)):
+                await _sse_event(response, "wide_event", event)
+            while True:
+                try:
+                    event = await asyncio.wait_for(queue.get(), timeout=15.0)
+                except asyncio.TimeoutError:
+                    # SSE comment as keep-alive so idle tails survive
+                    # proxies with read timeouts.
+                    await response.write(b": keep-alive\n\n")
+                    continue
+                if event_matches(event, **filters):
+                    await _sse_event(response, "wide_event", event)
+        except (ConnectionResetError, ConnectionAbortedError):
+            return response  # tail client went away: a normal ending
+        finally:
+            recorder.unsubscribe(queue)
+
+    async def debug_tasks(_request: web.Request) -> web.Response:
+        """Live task/thread inventory + the loop monitor's lag state (and
+        its last captured stall, stacks included)."""
+        body = task_inventory()
+        body["threads"] = thread_inventory()
+        if loopmon is not None:
+            body["monitor"] = loopmon.snapshot()
+        return web.json_response(body)
+
+    async def debug_pprof(request: web.Request) -> web.Response:
+        """The continuous profiler's latest window: collapsed-stack text
+        (feed it straight to flamegraph tooling) or ``?format=json`` for
+        the structured view incl. the trace ids active during sampling."""
+        if contprof is None:
+            return web.json_response(
+                {"detail": "no continuous profiler wired into this server"},
+                status=501,
+            )
+        if request.query.get("format", "").lower() == "json":
+            return web.json_response(contprof.snapshot())
+        return web.Response(
+            text=contprof.collapsed() + "\n", content_type="text/plain"
+        )
 
     async def fleet_snapshot(_request: web.Request) -> web.Response:
         snap = fleet.snapshot()
@@ -1047,5 +1216,8 @@ def create_http_server(
     app.router.add_get("/v1/fleet", fleet_snapshot)
     app.router.add_get("/v1/fleet/events", fleet_events)
     app.router.add_get("/v1/slo", slo_endpoint)
+    app.router.add_get("/v1/events", list_events)
     app.router.add_get("/v1/debug/bundle", debug_bundle_endpoint)
+    app.router.add_get("/v1/debug/tasks", debug_tasks)
+    app.router.add_get("/v1/debug/pprof", debug_pprof)
     return app

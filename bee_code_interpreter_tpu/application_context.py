@@ -14,7 +14,10 @@ from functools import cached_property
 
 from bee_code_interpreter_tpu.config import Config
 from bee_code_interpreter_tpu.observability import (
+    ContinuousProfiler,
     FleetJournal,
+    FlightRecorder,
+    LoopMonitor,
     SloEngine,
     TelemetryExporter,
     Tracer,
@@ -60,6 +63,34 @@ class ApplicationContext:
             metrics=self.metrics,
             bucket_s=self.config.slo_window_bucket_s,
         )
+        # Flight recorder (docs/observability.md "Flight recorder"): ONE
+        # canonical wide event per execution / session op / stream / loop
+        # stall, fed by a tracer sink so both edges emit identically.
+        self.flight = FlightRecorder(
+            max_events=self.config.events_max,
+            dir=self.config.events_dir,
+            segment_bytes=self.config.events_segment_bytes,
+            max_segments=self.config.events_segments,
+            metrics=self.metrics,
+        )
+        self.tracer.add_sink(self.flight.record_trace)
+        # Event-loop health: lag probe + stall detector (task-stack dumps
+        # land in the flight recorder); started by __main__ with the loop.
+        self.loopmon = LoopMonitor(
+            interval_s=self.config.loop_lag_interval_s,
+            stall_threshold_s=self.config.loop_lag_stall_s,
+            recorder=self.flight,
+            metrics=self.metrics,
+        )
+        # Continuous profiler: constructed unconditionally (its metric must
+        # exist either way); the sampler thread starts only when enabled.
+        self.contprof = ContinuousProfiler(
+            hz=self.config.contprof_hz,
+            window_s=self.config.contprof_window_s,
+            max_windows=self.config.contprof_windows,
+            active_trace_ids=self.tracer.active_trace_ids,
+            metrics=self.metrics,
+        )
         # Telemetry export: with APP_OTLP_ENDPOINT set, finished traces and
         # metric snapshots are pushed OTLP/JSON to the collector by a
         # background exporter (started by __main__ once the loop runs).
@@ -81,6 +112,9 @@ class ApplicationContext:
                 timeout_s=self.config.otlp_timeout_s,
             )
             self.tracer.add_sink(self.exporter.enqueue_trace)
+            # Wide events ride the logs signal through the same exporter
+            # (drop-not-block queue, exact drop accounting).
+            self.flight.add_sink(self.exporter.enqueue_log)
 
     @cached_property
     def storage(self) -> Storage:
@@ -116,6 +150,15 @@ class ApplicationContext:
             self.exporter.start()
         return self.exporter
 
+    def start_observability(self) -> None:
+        """Start the flight recorder's disk flusher (when a segment dir is
+        configured), the event-loop lag probe, and the continuous profiler
+        (must be called from a running loop; __main__ does)."""
+        self.flight.start()
+        self.loopmon.start()
+        if self.config.contprof_enabled:
+            self.contprof.start()
+
     def build_debug_bundle(self) -> dict:
         """The one-call incident snapshot both edges serve — built here so
         HTTP and gRPC can never disagree about what a bundle contains."""
@@ -131,6 +174,9 @@ class ApplicationContext:
             supervisor=self.supervisor,
             drain=self.drain,
             exporter=self.exporter,
+            recorder=self.flight,
+            loopmon=self.loopmon,
+            contprof=self.contprof,
         )
 
     @cached_property
@@ -168,6 +214,11 @@ class ApplicationContext:
         if self.exporter is not None:
             # Final best-effort flush (retry-bounded) before teardown.
             await self.exporter.stop()
+        self.contprof.stop()
+        await self.loopmon.stop()
+        # After the exporter: its final flush may still have drained wide
+        # events; the recorder's stop writes its own pending disk segment.
+        await self.flight.stop()
         if self.supervisor is not None:
             await self.supervisor.stop()
         executor = self.__dict__.get("code_executor")
@@ -231,6 +282,7 @@ class ApplicationContext:
             retry_after_s=cfg.admission_retry_after_s,
             metrics=self.metrics,
             drain=self.drain,
+            recorder=self.flight,
         )
         try:
             asyncio.get_running_loop()
@@ -382,6 +434,9 @@ class ApplicationContext:
             debug_bundle=self.build_debug_bundle,
             analyzer=self.analyzer,
             sessions=self.sessions,
+            recorder=self.flight,
+            loopmon=self.loopmon,
+            contprof=self.contprof,
         )
 
     @cached_property
@@ -404,4 +459,7 @@ class ApplicationContext:
             debug_bundle=self.build_debug_bundle,
             analyzer=self.analyzer,
             sessions=self.sessions,
+            recorder=self.flight,
+            loopmon=self.loopmon,
+            contprof=self.contprof,
         )

@@ -153,6 +153,36 @@ class Config(BaseModel):
     # Sandbox lifecycle events retained in the fleet journal for
     # GET /v1/fleet/events (each pod contributes ~4-6 events per life).
     fleet_max_events: int = Field(default=512, ge=1)
+    # --- flight recorder (docs/observability.md "Flight recorder") ---
+    # Wide events retained in memory for GET /v1/events: one canonical
+    # record per execution / session lifecycle op / stream / loop stall.
+    events_max: int = Field(default=512, ge=1)
+    # Directory for size-rotated ndjson segment files of every wide event;
+    # unset keeps the recorder memory-only. Writes happen off-loop behind a
+    # bounded queue — a slow disk drops events (accounted), never blocks.
+    events_dir: str | None = None
+    # Rotate the active segment once it exceeds this many bytes; keep at
+    # most events_segments files (oldest deleted).
+    events_segment_bytes: int = Field(default=1 << 20, ge=1)
+    events_segments: int = Field(default=4, ge=1)
+    # --- event-loop health (docs/observability.md "Event-loop health") ---
+    # Lag-probe cadence for bci_event_loop_lag_seconds; 0 disables the
+    # background probe entirely.
+    loop_lag_interval_s: float = Field(default=0.25, ge=0)
+    # Lag at/over this threshold is a *stall*: the monitor captures an
+    # asyncio task-stack dump into a wide event and GET /v1/debug/tasks.
+    loop_lag_stall_s: float = Field(default=0.5, gt=0)
+    # --- continuous profiler (docs/observability.md "Continuous profiler") ---
+    # Always-on sampling profiler over sys._current_frames, served at
+    # GET /v1/debug/pprof. The sampler costs per-process (not per-request);
+    # disable only to A/B its overhead.
+    contprof_enabled: bool = True
+    # Sampling rate; ~19 Hz is deliberately off-beat so the sampler cannot
+    # phase-lock with periodic work.
+    contprof_hz: float = Field(default=19.0, gt=0)
+    # Aggregation window length and how many completed windows to retain.
+    contprof_window_s: float = Field(default=60.0, gt=0)
+    contprof_windows: int = Field(default=5, ge=1)
     # --- telemetry export (docs/observability.md "Telemetry export") ---
     # OTLP/HTTP collector base URL (e.g. http://otel-collector:4318): finished
     # traces and metric snapshots are pushed as OTLP/JSON to

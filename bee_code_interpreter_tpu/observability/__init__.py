@@ -16,10 +16,25 @@ from bee_code_interpreter_tpu.observability.accounting import (
     record_usage_at_edge,
     register_usage_metrics,
 )
+from bee_code_interpreter_tpu.observability.contprof import (
+    ContinuousProfiler,
+    collapse_stack,
+)
 from bee_code_interpreter_tpu.observability.fleet import (
     FleetJournal,
     find_journal,
     unwrap_executor,
+)
+from bee_code_interpreter_tpu.observability.flightrecorder import (
+    FlightRecorder,
+    event_matches,
+    register_stream_metrics,
+    wide_event_from_trace,
+)
+from bee_code_interpreter_tpu.observability.loopmon import (
+    LoopMonitor,
+    task_inventory,
+    thread_inventory,
 )
 from bee_code_interpreter_tpu.observability.logging import JsonLogFormatter
 from bee_code_interpreter_tpu.observability.profiling import (
@@ -56,6 +71,7 @@ from bee_code_interpreter_tpu.observability.bundle import (  # noqa: E402
 )
 from bee_code_interpreter_tpu.observability.export import (  # noqa: E402
     TelemetryExporter,
+    logs_payload,
     metrics_payload,
     spans_payload,
 )
@@ -67,8 +83,11 @@ from bee_code_interpreter_tpu.observability.slo import (  # noqa: E402
 )
 
 __all__ = [
+    "ContinuousProfiler",
     "FleetJournal",
+    "FlightRecorder",
     "JsonLogFormatter",
+    "LoopMonitor",
     "Objective",
     "PROFILE_DIR_ENV",
     "ProfilerUnavailable",
@@ -80,13 +99,20 @@ __all__ = [
     "TransferAccounting",
     "UsageMeter",
     "build_debug_bundle",
+    "collapse_stack",
     "collect_transfer",
     "empty_slo_snapshot",
+    "event_matches",
     "executor_health",
     "find_journal",
+    "logs_payload",
     "metrics_payload",
     "parse_objectives",
+    "register_stream_metrics",
     "spans_payload",
+    "task_inventory",
+    "thread_inventory",
+    "wide_event_from_trace",
     "inject_profile_env",
     "merge_worker_usage",
     "profile_artifacts",

@@ -53,9 +53,13 @@ def build_debug_bundle(
     supervisor=None,
     drain=None,
     exporter=None,
+    recorder=None,
+    loopmon=None,
+    contprof=None,
     recent_traces: int = 50,
     slowest_traces: int = 10,
     fleet_events: int = 100,
+    recent_events: int = 50,
 ) -> dict:
     """Assemble the bundle from whatever components exist; every section is
     present (null/empty when its component isn't wired) so consumers parse
@@ -97,6 +101,26 @@ def build_debug_bundle(
     bundle["service"] = service
 
     bundle["telemetry"] = exporter.snapshot() if exporter is not None else None
+
+    # The flight-recorder / loop-health / profiler view (ISSUE 8): the last
+    # N wide events, the live task dump with the monitor's lag state, and
+    # the latest profile window — one call still captures a whole incident.
+    bundle["events"] = (
+        {
+            **recorder.snapshot(),
+            "recent": recorder.events(limit=recent_events),
+        }
+        if recorder is not None
+        else None
+    )
+    from bee_code_interpreter_tpu.observability.loopmon import task_inventory
+
+    bundle["loop"] = {
+        "monitor": loopmon.snapshot() if loopmon is not None else None,
+        "tasks": task_inventory(),
+    }
+    bundle["profile"] = contprof.snapshot() if contprof is not None else None
+
     bundle["config"] = config.redacted_dump() if config is not None else None
     bundle["metrics"] = metrics.expose() if metrics is not None else None
     return bundle

@@ -1,0 +1,268 @@
+"""Always-on continuous profiler: low-overhead wall-clock stack sampling.
+
+``POST /v1/profile`` (observability/profiling.py) answers "profile THIS
+request, now, on purpose" — useless for the incident that already
+happened. The :class:`ContinuousProfiler` answers "what has this process
+been doing for the last minute": a daemon thread samples every thread's
+current stack via ``sys._current_frames`` at a deliberately off-beat
+~19 Hz (a prime-ish rate so the sampler can't phase-lock with periodic
+work and systematically miss it), aggregates the samples into
+collapsed-stack form (``frame;frame;frame count`` — the folded format
+flamegraph tooling eats directly), and keeps a short history of completed
+windows. Each window also remembers the trace ids that were in flight
+while its samples were taken, so a hot window links back to the requests
+that were running through it.
+
+Overhead is bounded by construction: sampling cost is per-*thread*, not
+per-request (the request path is never touched); stack depth, distinct
+stacks per window, and remembered trace ids are all capped. The profiler
+holds no references to frames beyond the sampling instant.
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+import time
+from collections import deque
+from pathlib import Path
+
+DEFAULT_HZ = 19.0
+_REPO_ROOT = str(Path(__file__).resolve().parent.parent.parent)
+_TRUNCATED = "<truncated>"
+
+
+def _frame_label(frame) -> str:
+    filename = frame.f_code.co_filename
+    if filename.startswith(_REPO_ROOT):
+        filename = filename[len(_REPO_ROOT):].lstrip("/")
+    else:
+        # Off-repo frames (stdlib, site-packages) collapse to their module
+        # file name: full interpreter paths would explode stack cardinality.
+        filename = filename.rsplit("/", 1)[-1]
+    return f"{filename}:{frame.f_code.co_name}"
+
+
+def collapse_stack(frame, max_depth: int = 48) -> str:
+    """One thread's current stack as a collapsed-stack line key:
+    root-first, ``;``-joined, depth-capped (innermost frames win — the
+    leaf is where the time is actually being spent)."""
+    labels: list[str] = []
+    f = frame
+    while f is not None and len(labels) < max_depth:
+        labels.append(_frame_label(f))
+        f = f.f_back
+    return ";".join(reversed(labels))
+
+
+class ProfileWindow:
+    """One aggregation window: collapsed stacks → sample counts, plus the
+    trace ids seen in flight during sampling (capped)."""
+
+    def __init__(
+        self, start_unix: float, max_stacks: int, max_trace_ids: int
+    ) -> None:
+        self.start_unix = start_unix
+        self.end_unix: float | None = None
+        self.samples = 0
+        self.stacks: dict[str, int] = {}
+        self.trace_ids: set[str] = set()
+        self._max_stacks = max_stacks
+        self._max_trace_ids = max_trace_ids
+
+    def add(self, stack: str) -> None:
+        if stack in self.stacks or len(self.stacks) < self._max_stacks:
+            self.stacks[stack] = self.stacks.get(stack, 0) + 1
+        else:
+            self.stacks[_TRUNCATED] = self.stacks.get(_TRUNCATED, 0) + 1
+
+    def note_traces(self, trace_ids) -> None:
+        for trace_id in trace_ids:
+            if len(self.trace_ids) >= self._max_trace_ids:
+                break
+            self.trace_ids.add(trace_id)
+
+    def collapsed(self, top: int | None = None) -> str:
+        """The folded flamegraph exposition: one ``stack count`` line per
+        distinct stack, hottest first."""
+        ranked = sorted(self.stacks.items(), key=lambda kv: -kv[1])
+        if top is not None:
+            ranked = ranked[:top]
+        return "\n".join(f"{stack} {count}" for stack, count in ranked)
+
+    def to_dict(self, top: int = 50) -> dict:
+        ranked = sorted(self.stacks.items(), key=lambda kv: -kv[1])
+        return {
+            "start_unix": self.start_unix,
+            "end_unix": self.end_unix,
+            "samples": self.samples,
+            "distinct_stacks": len(self.stacks),
+            "trace_ids": sorted(self.trace_ids),
+            "hot_stacks": [
+                {"stack": stack, "count": count}
+                for stack, count in ranked[:top]
+            ],
+        }
+
+
+class ContinuousProfiler:
+    """Background sampling profiler over ``sys._current_frames``.
+
+    ``active_trace_ids`` is a zero-arg callable returning the trace ids
+    currently in flight (the ``Tracer`` provides one); it is read from the
+    sampler thread, so it must be cheap and thread-safe — a GIL-atomic
+    snapshot of a set qualifies.
+    """
+
+    def __init__(
+        self,
+        *,
+        hz: float = DEFAULT_HZ,
+        window_s: float = 60.0,
+        max_windows: int = 5,
+        max_stack_depth: int = 48,
+        max_stacks_per_window: int = 512,
+        max_trace_ids_per_window: int = 64,
+        active_trace_ids=None,
+        metrics=None,
+        clock=time.time,
+    ) -> None:
+        self.hz = max(0.1, hz)
+        self.window_s = max(1.0, window_s)
+        self._max_stack_depth = max_stack_depth
+        self._max_stacks = max_stacks_per_window
+        self._max_trace_ids = max_trace_ids_per_window
+        self._active_trace_ids = active_trace_ids
+        self._clock = clock
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._lock = threading.Lock()
+        self._current = ProfileWindow(
+            self._clock(), self._max_stacks, self._max_trace_ids
+        )
+        self._completed: deque[ProfileWindow] = deque(
+            maxlen=max(1, max_windows)
+        )
+        self._samples_total = (
+            metrics.counter(
+                "bci_contprof_samples_total",
+                "Stack samples taken by the continuous profiler",
+            )
+            if metrics is not None
+            else None
+        )
+
+    # ----------------------------------------------------------- sampling
+
+    def sample_once(self) -> None:
+        """Take one sample of every thread's stack (public so tests can
+        drive sampling deterministically without the thread)."""
+        own = threading.get_ident()
+        frames = sys._current_frames()
+        now = self._clock()
+        with self._lock:
+            window = self._roll(now)
+            window.samples += 1
+            for thread_id, frame in frames.items():
+                if thread_id == own:
+                    continue  # the profiler must not profile itself
+                window.add(collapse_stack(frame, self._max_stack_depth))
+            if self._active_trace_ids is not None:
+                try:
+                    window.note_traces(tuple(self._active_trace_ids()))
+                except Exception:
+                    pass  # the trace hook must never kill the sampler
+        # sys._current_frames returns live frames; drop the references
+        # before sleeping so the sampler never extends their lifetime.
+        del frames
+        if self._samples_total is not None:
+            self._samples_total.inc()
+
+    def _roll(self, now: float) -> ProfileWindow:
+        if now - self._current.start_unix >= self.window_s:
+            self._current.end_unix = now
+            if self._current.samples:
+                self._completed.append(self._current)
+            self._current = ProfileWindow(
+                now, self._max_stacks, self._max_trace_ids
+            )
+        return self._current
+
+    def _run(self) -> None:
+        interval = 1.0 / self.hz
+        while not self._stop.wait(interval):
+            try:
+                self.sample_once()
+            except Exception:
+                # A pathological frame walk must not end profiling forever;
+                # skip the sample and keep the cadence.
+                continue
+
+    # ---------------------------------------------------------- lifecycle
+
+    def start(self) -> None:
+        if self._thread is not None and self._thread.is_alive():
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, name="bci-contprof", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self, timeout_s: float = 2.0) -> None:
+        self._stop.set()
+        thread, self._thread = self._thread, None
+        if thread is not None:
+            thread.join(timeout=timeout_s)
+
+    @property
+    def running(self) -> bool:
+        return self._thread is not None and self._thread.is_alive()
+
+    # ----------------------------------------------------------- operator
+
+    def windows(self) -> list[ProfileWindow]:
+        """Completed windows plus the in-progress one, oldest first."""
+        with self._lock:
+            return list(self._completed) + [self._current]
+
+    def _latest_locked(self) -> ProfileWindow:
+        if self._current.samples or not self._completed:
+            return self._current
+        return self._completed[-1]
+
+    def latest_window(self) -> ProfileWindow:
+        """The freshest window with samples (the in-progress one, or the
+        last completed one right after a roll)."""
+        with self._lock:
+            return self._latest_locked()
+
+    def collapsed(self) -> str:
+        """The latest window in folded flamegraph form (the
+        ``GET /v1/debug/pprof`` default body). Rendered under the lock:
+        the latest window is usually the LIVE one the sampler thread is
+        mutating, and iterating its stacks unlocked is a crash waiting for
+        an incident (dict changed size mid-sort)."""
+        with self._lock:
+            return self._latest_locked().collapsed()
+
+    def snapshot(self, top: int = 50) -> dict:
+        with self._lock:
+            window_dict = self._latest_locked().to_dict(top)
+            completed = list(self._completed)
+        return {
+            "running": self.running,
+            "hz": self.hz,
+            "window_s": self.window_s,
+            "window": window_dict,
+            "completed_windows": [
+                {
+                    "start_unix": w.start_unix,
+                    "end_unix": w.end_unix,
+                    "samples": w.samples,
+                    "distinct_stacks": len(w.stacks),
+                    "trace_ids": len(w.trace_ids),
+                }
+                for w in completed
+            ],
+        }

@@ -3,10 +3,12 @@
 PRs 2–3 made the observability stack rich but replica-local: traces live in a
 bounded in-memory ring and vanish on restart, metrics are pull-only. This
 module is the fleet-scale half — a background :class:`TelemetryExporter` that
-batches finished traces (fed by a ``Tracer`` sink) and periodic snapshots of
-the whole metrics ``Registry`` into OTLP/JSON payloads and pushes them to the
-collector named by ``APP_OTLP_ENDPOINT`` (``POST {endpoint}/v1/traces`` and
-``/v1/metrics``, the standard OTLP/HTTP paths).
+batches finished traces (fed by a ``Tracer`` sink), flight-recorder wide
+events as the **logs signal** (fed by a ``FlightRecorder`` sink), and
+periodic snapshots of the whole metrics ``Registry`` into OTLP/JSON payloads
+and pushes them to the collector named by ``APP_OTLP_ENDPOINT``
+(``POST {endpoint}/v1/traces``, ``/v1/logs`` and ``/v1/metrics``, the
+standard OTLP/HTTP paths).
 
 The wire format is hand-rolled (no OTel SDK in the image) but spec-conformant
 in the shapes a collector actually parses: ``resourceSpans`` → ``scopeSpans``
@@ -48,7 +50,14 @@ logger = logging.getLogger(__name__)
 
 TRACES_PATH = "/v1/traces"
 METRICS_PATH = "/v1/metrics"
+LOGS_PATH = "/v1/logs"
 SCOPE_NAME = "bee_code_interpreter_tpu.observability"
+
+# OTLP severity numbers (opentelemetry.proto.logs.v1.SeverityNumber) for
+# the wide-event outcomes worth distinguishing downstream.
+_SEVERITY_INFO, _SEVERITY_WARN, _SEVERITY_ERROR = 9, 13, 17
+_WARN_OUTCOMES = frozenset({"stall", "shed", "drained", "breaker_open"})
+_ERROR_OUTCOMES = frozenset({"error", "deadline"})
 
 _SPAN_KIND_INTERNAL = 1  # opentelemetry.proto.trace.v1.Span.SpanKind
 _STATUS_OK, _STATUS_ERROR = 1, 2  # Status.StatusCode
@@ -99,6 +108,56 @@ def spans_payload(traces, service_name: str) -> dict:
                 },
                 "scopeSpans": [
                     {"scope": {"name": SCOPE_NAME}, "spans": spans}
+                ],
+            }
+        ]
+    }
+
+
+def log_record_to_otlp(event: dict) -> dict:
+    """One flight-recorder wide event as an OTLP/JSON LogRecord: the whole
+    event rides in ``body`` as canonical JSON (wide events are the point —
+    flattening to attributes would shear the nested fields), with the
+    query-worthy scalars (kind/outcome/session) doubled as attributes and
+    the trace id attached for log↔trace correlation."""
+    outcome = event.get("outcome")
+    if outcome in _ERROR_OUTCOMES:
+        severity, severity_text = _SEVERITY_ERROR, "ERROR"
+    elif outcome in _WARN_OUTCOMES:
+        severity, severity_text = _SEVERITY_WARN, "WARN"
+    else:
+        severity, severity_text = _SEVERITY_INFO, "INFO"
+    attributes = [_attr("event.kind", event.get("kind", "event"))]
+    for key in ("outcome", "session", "name"):
+        if event.get(key):
+            attributes.append(_attr(f"event.{key}", event[key]))
+    record = {
+        "timeUnixNano": _nanos(float(event.get("ts", time.time()))),
+        "severityNumber": severity,
+        "severityText": severity_text,
+        "body": {"stringValue": json.dumps(event, default=str)},
+        "attributes": attributes,
+    }
+    if event.get("trace_id"):
+        record["traceId"] = event["trace_id"]
+    return record
+
+
+def logs_payload(events, service_name: str) -> dict:
+    """A batch of wide events as one OTLP/JSON ExportLogsServiceRequest."""
+    return {
+        "resourceLogs": [
+            {
+                "resource": {
+                    "attributes": [_attr("service.name", service_name)]
+                },
+                "scopeLogs": [
+                    {
+                        "scope": {"name": SCOPE_NAME},
+                        "logRecords": [
+                            log_record_to_otlp(e) for e in events
+                        ],
+                    }
                 ],
             }
         ]
@@ -243,6 +302,10 @@ class TelemetryExporter:
         self._timeout_s = timeout_s
         self._transport = transport
         self._queue: deque = deque()
+        # Wide events bound for the logs signal: the same drop-not-block
+        # queue discipline and exact accounting as traces, separately
+        # bounded so a log storm can't evict traces (or vice versa).
+        self._logs_queue: deque = deque()
         self._start_unix = time.time()  # cumulative-point start stamp
         self._wake = asyncio.Event()
         self._task: asyncio.Task | None = None
@@ -276,9 +339,24 @@ class TelemetryExporter:
         if len(self._queue) >= self._batch_max:
             self._wake.set()
 
+    def enqueue_log(self, event: dict) -> None:
+        """Flight-recorder sink: wide events bound for ``/v1/logs``. Same
+        contract as :meth:`enqueue_trace` — O(1), no I/O, a full queue
+        drops the new event and accounts it."""
+        if len(self._logs_queue) >= self._queue_max:
+            self._dropped_total.inc(signal="logs", reason="queue_full")
+            return
+        self._logs_queue.append(event)
+        if len(self._logs_queue) >= self._batch_max:
+            self._wake.set()
+
     @property
     def queue_depth(self) -> int:
         return len(self._queue)
+
+    @property
+    def logs_queue_depth(self) -> int:
+        return len(self._logs_queue)
 
     # ------------------------------------------------------- background loop
 
@@ -316,6 +394,11 @@ class TelemetryExporter:
                 len(self._queue), signal="traces", reason="shutdown"
             )
             self._queue.clear()
+        if self._logs_queue:
+            self._dropped_total.inc(
+                len(self._logs_queue), signal="logs", reason="shutdown"
+            )
+            self._logs_queue.clear()
         if self._client is not None:
             await self._client.aclose()
             self._client = None
@@ -335,29 +418,39 @@ class TelemetryExporter:
                 logger.exception("telemetry flush failed")
         await self.flush_once()
 
-    async def flush_once(self) -> dict:
-        """Drain the trace queue in batches, then push one metrics snapshot.
-        A failed batch is dropped (accounted) and ends the trace drain for
-        this flush — the rest of the queue waits for the next interval."""
+    async def _drain_queue(self, queue, path, payload_fn, signal) -> tuple[int, int]:
+        """Drain one signal's queue in batches; a failed batch is dropped
+        (accounted) and ends this signal's drain for the flush — the rest
+        waits for the next interval. Returns (exported, dropped)."""
         exported = dropped = 0
-        while self._queue:
+        while queue:
             # Peek, send, THEN pop: a cancellation mid-send (the bounded
             # stop()) leaves the batch queued where shutdown accounting
-            # still sees it — no trace is ever silently lost.
-            batch = list(itertools.islice(self._queue, self._batch_max))
-            payload = spans_payload(batch, self._service_name)
-            sent = await self._push(TRACES_PATH, payload)
+            # still sees it — no item is ever silently lost.
+            batch = list(itertools.islice(queue, self._batch_max))
+            sent = await self._push(path, payload_fn(batch, self._service_name))
             for _ in batch:
-                self._queue.popleft()
+                queue.popleft()
             if sent:
-                self._exported_total.inc(len(batch), signal="traces")
+                self._exported_total.inc(len(batch), signal=signal)
                 exported += len(batch)
             else:
                 self._dropped_total.inc(
-                    len(batch), signal="traces", reason="send_failed"
+                    len(batch), signal=signal, reason="send_failed"
                 )
                 dropped += len(batch)
                 break
+        return exported, dropped
+
+    async def flush_once(self) -> dict:
+        """Drain the trace queue in batches, then the wide-event logs
+        queue, then push one metrics snapshot."""
+        exported, dropped = await self._drain_queue(
+            self._queue, TRACES_PATH, spans_payload, "traces"
+        )
+        logs_exported, logs_dropped = await self._drain_queue(
+            self._logs_queue, LOGS_PATH, logs_payload, "logs"
+        )
         metrics_ok = await self._push(
             METRICS_PATH,
             metrics_payload(
@@ -371,6 +464,8 @@ class TelemetryExporter:
         return {
             "traces_exported": exported,
             "traces_dropped": dropped,
+            "logs_exported": logs_exported,
+            "logs_dropped": logs_dropped,
             "metrics_exported": metrics_ok,
         }
 
@@ -413,6 +508,7 @@ class TelemetryExporter:
         return {
             "endpoint": self._endpoint,
             "queue_depth": len(self._queue),
+            "logs_queue_depth": len(self._logs_queue),
             "queue_max": self._queue_max,
             "running": self._task is not None and not self._task.done(),
         }

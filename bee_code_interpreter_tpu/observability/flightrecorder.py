@@ -1,0 +1,418 @@
+"""Flight recorder: one canonical wide event per request (docs/observability.md).
+
+Traces answer "where did THIS request spend its time" and metrics answer
+"how is the fleet doing in aggregate"; neither answers "show me everything
+that happened to the executions matching X" without joining three APIs by
+hand. The flight recorder is that third signal: every execution, session
+lifecycle op, and stream emits ONE wide event — ids, outcome, stage
+timings, usage, analysis findings, replay/hedge outcomes, SLO
+classification, session and stream context — into a bounded in-memory ring
+with optional size-rotated ndjson segment files.
+
+Event sources:
+
+- **Requests** — a :class:`~.tracing.Tracer` sink (:meth:`FlightRecorder.
+  record_trace`) fires on every finished trace; the event is assembled from
+  the root span plus the edge annotations the request path stamped on it
+  (``outcome``/``sli``/``session``/``usage.*``/``replays``/``hedge``/
+  ``stream.*``) and the analysis stage span's findings.
+- **Session lifecycle** — the :class:`~..sessions.manager.SessionManager`
+  emits ``kind="session"`` events for created/released/expired leases
+  (sweep-driven expiries have no request to ride on).
+- **Loop stalls** — the :class:`~.loopmon.LoopMonitor` emits
+  ``kind="loop_stall"`` events carrying the asyncio task-stack dump it
+  captured when event-loop lag blew its threshold.
+
+Delivery is drop-not-block everywhere: the in-memory ring evicts oldest
+(retention, accounted nowhere — that is what a ring is), SSE followers with
+a full queue lose events (``bci_events_dropped_total{reason="follower"}``),
+the disk-write queue drops beyond its bound (``reason="write_queue_full"``),
+and the OTLP logs sink inherits the telemetry exporter's exact accounting.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import threading
+import time
+from collections import deque
+from pathlib import Path
+
+logger = logging.getLogger(__name__)
+
+# Keys the edges stamp on the root span that the wide event lifts into
+# first-class fields (everything else stays under "attributes").
+_LIFTED_ROOT_KEYS = frozenset(
+    {"outcome", "sli", "session", "replays", "hedge"}
+)
+_SEGMENT_PREFIX = "events-"
+_SEGMENT_SUFFIX = ".ndjson"
+
+
+def register_stream_metrics(metrics):
+    """The production streaming metrics both edges record (the numbers
+    bench.py could previously only measure offline): time-to-first-chunk
+    and chunks delivered, labeled by transport. Registry name-dedup makes
+    this safe to call from both edges."""
+    from bee_code_interpreter_tpu.utils.metrics import TOKEN_LATENCY_BUCKETS
+
+    ttfb = metrics.histogram(
+        "bci_stream_ttfb_seconds",
+        "Streaming executions: start to first output chunk, by transport",
+        buckets=TOKEN_LATENCY_BUCKETS,
+    )
+    chunks = metrics.counter(
+        "bci_stream_chunks_total",
+        "Streaming output chunks delivered to clients, by transport",
+    )
+    return ttfb, chunks
+
+
+def _float_or_none(value) -> float | None:
+    try:
+        return float(value)
+    except (TypeError, ValueError):
+        return None
+
+
+def event_matches(
+    event: dict,
+    *,
+    kind: str | None = None,
+    outcome: str | None = None,
+    session: str | None = None,
+    min_duration_ms: float | None = None,
+    since: float | None = None,
+) -> bool:
+    """The ONE filter predicate for wide events — the ring query and the
+    live SSE tail must accept identical events for identical filters."""
+    if kind is not None and event.get("kind") != kind:
+        return False
+    if outcome is not None and event.get("outcome") != outcome:
+        return False
+    if session is not None and event.get("session") != session:
+        return False
+    if min_duration_ms is not None:
+        duration = event.get("duration_ms")
+        if duration is None or duration < min_duration_ms:
+            return False
+    if since is not None and event.get("ts", 0.0) <= since:
+        return False
+    return True
+
+
+def wide_event_from_trace(trace) -> dict:
+    """Assemble the canonical wide event for one finished trace. Root-span
+    annotations become first-class fields; the analysis stage span
+    contributes the gate's findings; everything else the request stamped
+    stays under ``attributes`` so nothing is lost to the schema."""
+    root = trace.root
+    attrs = dict(root.attributes)
+    usage = {}
+    stream = {}
+    extra = {}
+    for key, value in attrs.items():
+        if key.startswith("usage."):
+            usage[key[len("usage."):]] = _float_or_none(value)
+        elif key.startswith("stream."):
+            stream[key[len("stream."):]] = _float_or_none(value)
+        elif key not in _LIFTED_ROOT_KEYS:
+            extra[key] = value
+    analysis = {}
+    for s in trace.spans:
+        if s is root:
+            continue
+        for key, value in s.attributes.items():
+            if key.startswith("analysis."):
+                analysis[key[len("analysis."):]] = value
+    event: dict = {
+        "kind": "request",
+        "ts": root.start_unix,
+        "name": trace.name,
+        "trace_id": trace.trace_id,
+        "request_id": trace.request_id,
+        "status": root.status,
+        "outcome": attrs.get("outcome") or (
+            "error" if root.status == "error" else "ok"
+        ),
+        "duration_ms": (
+            root.duration_s * 1000.0 if root.duration_s is not None else None
+        ),
+        "timings_ms": trace.stage_ms(),
+        "session": attrs.get("session"),
+        "sli": attrs.get("sli"),
+        "replays": int(_float_or_none(attrs.get("replays", 0)) or 0),
+        "hedge": attrs.get("hedge"),
+        "usage": usage or None,
+        "stream": stream or None,
+        "analysis": analysis or None,
+        "attributes": extra or None,
+    }
+    return event
+
+
+class FlightRecorder:
+    """Bounded wide-event ring + optional ndjson segment files + live sinks.
+
+    ``record()`` is the one ingest point: O(1) on the request path (a dict
+    append plus non-blocking fan-out), never I/O. Disk persistence, when a
+    directory is configured, happens on a background flusher task that
+    drains a bounded pending queue through ``asyncio.to_thread``.
+    """
+
+    def __init__(
+        self,
+        *,
+        max_events: int = 512,
+        dir: str | None = None,
+        segment_bytes: int = 1 << 20,
+        max_segments: int = 4,
+        follower_queue_max: int = 256,
+        write_queue_max: int = 1024,
+        flush_interval_s: float = 0.5,
+        metrics=None,
+    ) -> None:
+        self._ring: deque[dict] = deque(maxlen=max(1, max_events))
+        self._dir = Path(dir) if dir else None
+        self._segment_bytes = max(1, segment_bytes)
+        self._max_segments = max(1, max_segments)
+        self._follower_queue_max = follower_queue_max
+        self._write_queue_max = write_queue_max
+        self._flush_interval_s = flush_interval_s
+        self._seq = 0
+        self._segment_seq = 0
+        self._segment_path: Path | None = None
+        self._followers: set[asyncio.Queue] = set()
+        self._pending: deque[dict] = deque()
+        self._sinks: list = []
+        self._task: asyncio.Task | None = None
+        self._wake: asyncio.Event | None = None
+        # The ring is appended from the loop; scripts/tests may read from
+        # other threads — guard the ring walk, not the hot append.
+        self._lock = threading.Lock()
+        self._emitted_total = None
+        self._dropped_total = None
+        if metrics is not None:
+            self._emitted_total = metrics.counter(
+                "bci_events_emitted_total",
+                "Wide events recorded by the flight recorder, by kind",
+            )
+            self._dropped_total = metrics.counter(
+                "bci_events_dropped_total",
+                "Wide events dropped instead of blocking (slow SSE follower, "
+                "full disk-write queue), by reason",
+            )
+
+    # ------------------------------------------------------------ ingest
+
+    def record_trace(self, trace) -> None:
+        """Tracer sink: one wide event per finished trace."""
+        self.record(wide_event_from_trace(trace))
+
+    def record(self, event: dict) -> None:
+        """Ingest one wide event (cheap, non-blocking, no I/O). Missing
+        ``ts``/``kind`` are filled; ``seq`` is stamped here — a total order
+        the ``since`` filter and the tail script can resume from."""
+        self._seq += 1
+        event.setdefault("kind", "event")
+        event.setdefault("ts", time.time())
+        event["seq"] = self._seq
+        with self._lock:
+            self._ring.append(event)
+        if self._emitted_total is not None:
+            self._emitted_total.inc(kind=event["kind"])
+        for queue in list(self._followers):
+            try:
+                queue.put_nowait(event)
+            except asyncio.QueueFull:
+                if self._dropped_total is not None:
+                    self._dropped_total.inc(reason="follower")
+        if self._dir is not None:
+            if len(self._pending) >= self._write_queue_max:
+                if self._dropped_total is not None:
+                    self._dropped_total.inc(reason="write_queue_full")
+            else:
+                self._pending.append(event)
+                if self._wake is not None:
+                    self._wake.set()
+        for sink in self._sinks:
+            # A broken sink must never fail the request that emitted this.
+            try:
+                sink(event)
+            except Exception:
+                logger.exception("wide-event sink %r failed", sink)
+
+    def add_sink(self, sink) -> None:
+        """Register a callable invoked with each recorded event (the OTLP
+        logs exporter's ``enqueue_log``). Sinks must be cheap and
+        non-blocking — they run on the request path."""
+        self._sinks.append(sink)
+
+    # ------------------------------------------------------------- query
+
+    def events(
+        self,
+        *,
+        kind: str | None = None,
+        outcome: str | None = None,
+        session: str | None = None,
+        min_duration_ms: float | None = None,
+        since: float | None = None,
+        limit: int | None = None,
+    ) -> list[dict]:
+        """Filtered view of the ring, newest first. ``since`` is a unix
+        timestamp lower bound (strictly after); ``min_duration_ms`` keeps
+        events whose ``duration_ms`` is known and at least the bound."""
+        if limit is not None and limit <= 0:
+            return []
+        with self._lock:
+            snapshot = list(self._ring)
+        out: list[dict] = []
+        for event in reversed(snapshot):
+            if not event_matches(
+                event,
+                kind=kind,
+                outcome=outcome,
+                session=session,
+                min_duration_ms=min_duration_ms,
+                since=since,
+            ):
+                continue
+            out.append(event)
+            if limit is not None and len(out) >= limit:
+                break
+        return out
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._ring)
+
+    # ------------------------------------------------------------ follow
+
+    def subscribe(self) -> asyncio.Queue:
+        """A live tail (the SSE ``?follow=1`` feed): events recorded from
+        now on land in the returned queue; a slow consumer loses events
+        (accounted) rather than backing up the recorder."""
+        queue: asyncio.Queue = asyncio.Queue(maxsize=self._follower_queue_max)
+        self._followers.add(queue)
+        return queue
+
+    def unsubscribe(self, queue: asyncio.Queue) -> None:
+        self._followers.discard(queue)
+
+    # -------------------------------------------------------------- disk
+
+    def start(self) -> None:
+        """Start the background disk flusher (requires a running loop);
+        a no-op when no segment directory is configured."""
+        if self._dir is None:
+            return
+        if self._task is None or self._task.done():
+            self._wake = asyncio.Event()
+            self._task = asyncio.create_task(self._run())
+
+    async def stop(self) -> None:
+        task, self._task = self._task, None
+        if task is not None:
+            task.cancel()
+            try:
+                await task
+            except asyncio.CancelledError:
+                pass
+        if self._dir is not None and self._pending:
+            # Final flush is small (bounded queue) and teardown-critical:
+            # run it to_thread like the loop did.
+            await asyncio.to_thread(self.flush_to_disk)
+
+    async def _run(self) -> None:
+        assert self._wake is not None
+        while True:
+            try:
+                await asyncio.wait_for(
+                    self._wake.wait(), timeout=self._flush_interval_s
+                )
+            except asyncio.TimeoutError:
+                pass
+            self._wake.clear()
+            if self._pending:
+                try:
+                    await asyncio.to_thread(self.flush_to_disk)
+                except Exception:  # the flusher must survive a bad disk
+                    logger.exception("wide-event segment write failed")
+
+    def flush_to_disk(self) -> int:
+        """Drain the pending queue into the current ndjson segment,
+        rotating when it exceeds the size bound (sync — called off-loop by
+        the flusher, directly by tests)."""
+        if self._dir is None:
+            return 0
+        lines: list[str] = []
+        while self._pending:
+            lines.append(json.dumps(self._pending.popleft(), default=str))
+        if not lines:
+            return 0
+        self._dir.mkdir(parents=True, exist_ok=True)
+        path = self._current_segment()
+        with path.open("a", encoding="utf-8") as f:
+            f.write("\n".join(lines) + "\n")
+        if path.stat().st_size >= self._segment_bytes:
+            self._rotate()
+        return len(lines)
+
+    def _current_segment(self) -> Path:
+        if self._segment_path is None:
+            existing = self.segment_paths()
+            if existing:
+                last = existing[-1]
+                self._segment_seq = int(
+                    last.name[len(_SEGMENT_PREFIX):-len(_SEGMENT_SUFFIX)]
+                )
+                self._segment_path = last
+            else:
+                self._segment_path = self._segment_name(self._segment_seq)
+        return self._segment_path
+
+    def _segment_name(self, seq: int) -> Path:
+        assert self._dir is not None
+        return self._dir / f"{_SEGMENT_PREFIX}{seq:06d}{_SEGMENT_SUFFIX}"
+
+    def _rotate(self) -> None:
+        self._segment_seq += 1
+        self._segment_path = self._segment_name(self._segment_seq)
+        # The new active segment (created on the next flush) counts toward
+        # the bound: keep max_segments - 1 existing files so the documented
+        # "at most events_segments files" holds once it materializes.
+        keep = self._max_segments - 1
+        stale_segments = (
+            self.segment_paths()[:-keep] if keep else self.segment_paths()
+        )
+        for stale in stale_segments:
+            try:
+                stale.unlink()
+            except OSError:
+                logger.warning("could not remove stale segment %s", stale)
+
+    def segment_paths(self) -> list[Path]:
+        """Existing segment files, oldest first."""
+        if self._dir is None or not self._dir.exists():
+            return []
+        return sorted(
+            p
+            for p in self._dir.glob(f"{_SEGMENT_PREFIX}*{_SEGMENT_SUFFIX}")
+            if p.name[len(_SEGMENT_PREFIX):-len(_SEGMENT_SUFFIX)].isdigit()
+        )
+
+    # ---------------------------------------------------------- operator
+
+    def snapshot(self) -> dict:
+        """Recorder state for the debug bundle / verbose health."""
+        return {
+            "retained": len(self),
+            "emitted": self._seq,
+            "followers": len(self._followers),
+            "pending_write": len(self._pending),
+            "segment_dir": str(self._dir) if self._dir is not None else None,
+            "segments": [p.name for p in self.segment_paths()],
+        }

@@ -1,0 +1,240 @@
+"""Event-loop health: lag probe, stall detector, live task inventory.
+
+The service is ONE asyncio loop; anything that blocks it — an accidental
+sync call, a pathological parse, a GC storm — stalls every in-flight
+request at once, and nothing in the request-scoped telemetry can see it
+(the stalled requests just look uniformly slow). The
+:class:`LoopMonitor` measures the loop itself: a probe task arms a timer,
+sleeps, and reads how late the wakeup was. The lag feeds
+``bci_event_loop_lag_seconds``; a wakeup later than the stall threshold
+additionally captures an asyncio task-stack dump — who was running, who
+was waiting, where — into a ``kind="loop_stall"`` wide event, and keeps
+the latest dump for ``GET /v1/debug/tasks``.
+
+The probe math is clock-injectable (``clock=``) so tests drive arm/tick
+by hand with a ManualClock; production uses the loop's own time via the
+background task.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import sys
+import time
+from pathlib import Path
+
+logger = logging.getLogger(__name__)
+
+# Loop lag lives decades below request latency: sub-ms when healthy, tens
+# of ms under pressure, seconds only when something is very wrong.
+LAG_BUCKETS = (
+    0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0,
+)
+
+_REPO_ROOT = str(Path(__file__).resolve().parent.parent.parent)
+
+
+def _short_path(filename: str) -> str:
+    if filename.startswith(_REPO_ROOT):
+        return filename[len(_REPO_ROOT):].lstrip("/")
+    return filename
+
+
+def task_inventory(max_tasks: int = 256, max_frames: int = 8) -> dict:
+    """The live asyncio task set with per-task (truncated) stacks — the
+    "what is the loop doing right now" answer ``GET /v1/debug/tasks``
+    serves. Outside a running loop (scripts, teardown) it answers honestly
+    empty instead of raising."""
+    try:
+        tasks = asyncio.all_tasks()
+    except RuntimeError:
+        return {"count": 0, "truncated": False, "tasks": []}
+    inventory = []
+    for task in list(tasks)[:max_tasks]:
+        coro = task.get_coro()
+        entry: dict = {
+            "name": task.get_name(),
+            "coro": getattr(coro, "__qualname__", None) or repr(coro)[:120],
+            "done": task.done(),
+        }
+        try:
+            frames = task.get_stack(limit=max_frames)
+        except RuntimeError:
+            frames = []
+        entry["stack"] = [
+            f"{_short_path(f.f_code.co_filename)}:{f.f_lineno} "
+            f"{f.f_code.co_name}"
+            for f in frames
+        ]
+        inventory.append(entry)
+    return {
+        "count": len(tasks),
+        "truncated": len(tasks) > max_tasks,
+        "tasks": inventory,
+    }
+
+
+class LoopMonitor:
+    """Lag probe + stall detector over the running event loop.
+
+    ``arm()`` notes when the next wakeup *should* happen; ``tick()``
+    measures how late it actually was. The background task does exactly
+    that on a cadence; tests call the pair directly under a ManualClock.
+    """
+
+    def __init__(
+        self,
+        *,
+        interval_s: float = 0.25,
+        stall_threshold_s: float = 0.5,
+        recorder=None,  # FlightRecorder for kind="loop_stall" events
+        metrics=None,
+        clock=time.monotonic,
+        max_stall_tasks: int = 64,
+    ) -> None:
+        self._interval_s = max(0.01, interval_s)
+        self.enabled = interval_s > 0
+        self._stall_threshold_s = stall_threshold_s
+        self._recorder = recorder
+        self._clock = clock
+        self._max_stall_tasks = max_stall_tasks
+        self._expected: float | None = None
+        self._task: asyncio.Task | None = None
+        self.probes = 0
+        self.stalls = 0
+        self.last_lag_s = 0.0
+        self.max_lag_s = 0.0
+        self.last_probe_unix: float | None = None
+        self.last_stall: dict | None = None
+        self._lag_seconds = None
+        self._stalls_total = None
+        if metrics is not None:
+            self._lag_seconds = metrics.histogram(
+                "bci_event_loop_lag_seconds",
+                "How late the event-loop lag probe's wakeups fire: the time "
+                "every in-flight request was stalled on top of its own work",
+                buckets=LAG_BUCKETS,
+            )
+            self._stalls_total = metrics.counter(
+                "bci_loop_stalls_total",
+                "Event-loop stalls (lag over the configured threshold) that "
+                "triggered a task-stack capture",
+            )
+
+    # -------------------------------------------------------------- probe
+
+    def arm(self) -> None:
+        """Note when the next :meth:`tick` *should* run (now + interval)."""
+        self._expected = self._clock() + self._interval_s
+
+    def tick(self) -> float:
+        """Measure how late this wakeup was relative to :meth:`arm`;
+        record the lag and run stall detection. Returns the lag."""
+        now = self._clock()
+        lag = max(0.0, now - self._expected) if self._expected is not None else 0.0
+        self._expected = None
+        self.probes += 1
+        self.last_lag_s = lag
+        self.max_lag_s = max(self.max_lag_s, lag)
+        self.last_probe_unix = time.time()
+        if self._lag_seconds is not None:
+            self._lag_seconds.observe(lag)
+        if self._stall_threshold_s > 0 and lag >= self._stall_threshold_s:
+            self._on_stall(lag)
+        return lag
+
+    def _on_stall(self, lag: float) -> None:
+        self.stalls += 1
+        if self._stalls_total is not None:
+            self._stalls_total.inc()
+        dump = task_inventory(max_tasks=self._max_stall_tasks)
+        self.last_stall = {
+            "ts": time.time(),
+            "lag_s": lag,
+            "threshold_s": self._stall_threshold_s,
+            "tasks": dump,
+        }
+        logger.warning(
+            "Event loop stalled %.3fs (threshold %.3fs); captured %d task "
+            "stack(s)",
+            lag,
+            self._stall_threshold_s,
+            dump["count"],
+        )
+        if self._recorder is not None:
+            self._recorder.record(
+                {
+                    "kind": "loop_stall",
+                    "outcome": "stall",
+                    "duration_ms": lag * 1000.0,
+                    "lag_s": lag,
+                    "threshold_s": self._stall_threshold_s,
+                    "tasks": dump,
+                }
+            )
+
+    # ---------------------------------------------------------- lifecycle
+
+    def start(self) -> None:
+        """Start the background probe (requires a running loop); a no-op
+        when the monitor is disabled (interval 0)."""
+        if not self.enabled:
+            return
+        if self._task is None or self._task.done():
+            self._task = asyncio.create_task(self._run())
+
+    async def stop(self) -> None:
+        task, self._task = self._task, None
+        if task is not None:
+            task.cancel()
+            try:
+                await task
+            except asyncio.CancelledError:
+                pass
+
+    async def _run(self) -> None:
+        while True:
+            self.arm()
+            await asyncio.sleep(self._interval_s)
+            self.tick()
+
+    # ----------------------------------------------------------- operator
+
+    @property
+    def running(self) -> bool:
+        return self._task is not None and not self._task.done()
+
+    def snapshot(self) -> dict:
+        """Monitor state for ``/healthz?verbose=1`` / the debug bundle /
+        ``GET /v1/debug/tasks``."""
+        return {
+            "enabled": self.enabled,
+            "running": self.running,
+            "interval_s": self._interval_s,
+            "stall_threshold_s": self._stall_threshold_s,
+            "probes": self.probes,
+            "last_lag_ms": self.last_lag_s * 1000.0,
+            "max_lag_ms": self.max_lag_s * 1000.0,
+            "stalls": self.stalls,
+            "last_stall": self.last_stall,
+        }
+
+
+def thread_inventory(max_frames: int = 8) -> list[dict]:
+    """Every OS thread's current (truncated) stack via
+    ``sys._current_frames`` — the non-asyncio half of "what is this
+    process doing", served next to the task inventory."""
+    out = []
+    for thread_id, frame in sys._current_frames().items():
+        stack = []
+        f = frame
+        while f is not None and len(stack) < max_frames:
+            stack.append(
+                f"{_short_path(f.f_code.co_filename)}:{f.f_lineno} "
+                f"{f.f_code.co_name}"
+            )
+            f = f.f_back
+        out.append({"thread_id": thread_id, "stack": stack})
+    return out

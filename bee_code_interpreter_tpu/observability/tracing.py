@@ -263,6 +263,10 @@ class Tracer:
         # gets the whole Trace right after it lands in the store. Sinks MUST
         # be cheap and non-blocking — they run on the request path.
         self._sinks: list = []
+        # Trace ids currently in flight. Read by the continuous profiler's
+        # sampler THREAD (GIL-atomic set ops; a momentarily stale view is
+        # fine — it tags profile windows, it doesn't gate anything).
+        self._active: set[str] = set()
         self._stage_seconds = (
             metrics.histogram(
                 "bci_stage_seconds",
@@ -275,6 +279,11 @@ class Tracer:
     def add_sink(self, sink) -> None:
         """Register a callable invoked with each finished :class:`Trace`."""
         self._sinks.append(sink)
+
+    def active_trace_ids(self) -> tuple[str, ...]:
+        """Ids of traces currently in flight (the continuous profiler tags
+        its windows with these)."""
+        return tuple(self._active)
 
     def _on_span_end(self, trace: Trace, s: Span) -> None:
         if self._stage_seconds is not None and s is not trace.root:
@@ -302,6 +311,7 @@ class Tracer:
         )
         trace_token = _current_trace.set(t)
         span_token = _current_span.set(t.root)
+        self._active.add(t.trace_id)
         try:
             yield t
         except BaseException as e:
@@ -310,6 +320,7 @@ class Tracer:
         else:
             t.end_span(t.root)
         finally:
+            self._active.discard(t.trace_id)
             _current_span.reset(span_token)
             _current_trace.reset(trace_token)
             self.store.add(t)

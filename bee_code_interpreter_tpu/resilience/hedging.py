@@ -38,6 +38,17 @@ from bee_code_interpreter_tpu.utils.validation import AbsolutePath, Hash
 logger = logging.getLogger(__name__)
 
 
+def _annotate_root(key: str, value: str) -> None:
+    """Stamp a replay/hedge outcome on the ambient request's root span so
+    the flight recorder's wide event carries it; a no-op off the request
+    path (lazy import: resilience loads before observability finishes)."""
+    from bee_code_interpreter_tpu.observability.tracing import current_trace
+
+    trace = current_trace()
+    if trace is not None:
+        trace.root.attributes[key] = value
+
+
 class HedgingExecutor:
     """Replay + hedge front over a pool executor backend.
 
@@ -104,6 +115,7 @@ class HedgingExecutor:
                 replays += 1
                 if self._replays_total is not None:
                     self._replays_total.inc()
+                _annotate_root("replays", str(replays))
                 logger.warning(
                     "Execution attempt died mid-flight (%s); replaying on a "
                     "fresh sandbox (replay %d/%d)",
@@ -161,12 +173,14 @@ class HedgingExecutor:
                         outcome = f"{names[task]}_won"
                         if self._hedge_total is not None:
                             self._hedge_total.inc(outcome=outcome)
+                        _annotate_root("hedge", outcome)
                         logger.info("Hedged execution resolved: %s", outcome)
                         return task.result()
                     if first_error is None:
                         first_error = task.exception()
             if self._hedge_total is not None:
                 self._hedge_total.inc(outcome="both_failed")
+            _annotate_root("hedge", "both_failed")
             assert first_error is not None
             raise first_error
         except asyncio.CancelledError:

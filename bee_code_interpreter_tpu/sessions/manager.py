@@ -142,6 +142,7 @@ class SessionManager:
         retry_after_s: float = 1.0,
         metrics=None,
         drain=None,
+        recorder=None,  # observability.FlightRecorder for lifecycle events
         clock: Callable[[], float] = time.monotonic,
     ) -> None:
         # The lease works against the raw pool backend: the resilience
@@ -157,6 +158,7 @@ class SessionManager:
         self._sweep_interval_s = max(0.05, sweep_interval_s)
         self._retry_after_s = retry_after_s
         self._drain = drain
+        self._recorder = recorder
         self._clock = clock
         self._sessions: dict[str, Session] = {}
         # Creates in flight between the cap check and registration: the
@@ -294,6 +296,7 @@ class SessionManager:
             self._sessions[session_id] = session
         finally:
             self._creating -= 1
+        self._emit("created", session)
         logger.info(
             "Session %s leased sandbox %s (ttl=%.0fs idle=%.0fs)",
             session_id,
@@ -462,6 +465,26 @@ class SessionManager:
 
     # ------------------------------------------------------------- internals
 
+    def _emit(self, op: str, session: Session, reason: str | None = None) -> None:
+        """One wide event per lease lifecycle op (docs/observability.md
+        "Flight recorder"): sweep-driven expiries have no request to ride
+        on, so the manager is their emission point — and create/release get
+        the same treatment so the session's whole life reads from ONE
+        filterable stream (``/v1/events?session=...``)."""
+        if self._recorder is None:
+            return
+        self._recorder.record(
+            {
+                "kind": "session",
+                "name": f"session.{op}",
+                "outcome": reason or op,
+                "session": session.session_id,
+                "sandbox": session.lease.name,
+                "executions": session.executions,
+                "duration_ms": (self._clock() - session.created_mono) * 1000.0,
+            }
+        )
+
     def _journal(self, state: str, session: Session, reason: str | None = None) -> None:
         journal = getattr(self._backend, "journal", None)
         if journal is None:
@@ -495,6 +518,7 @@ class SessionManager:
         self.expired_total[metric_reason] = (
             self.expired_total.get(metric_reason, 0) + 1
         )
+        self._emit("ended", session, reason=metric_reason)
 
     def snapshot(self) -> dict:
         """Operator view for ``GET /v1/sessions`` and the debug bundle."""

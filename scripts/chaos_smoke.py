@@ -41,6 +41,11 @@ wiring minus kubectl. Scenarios:
                             a terminal error event — with
                             bci_session_expirations_total accounting every
                             lease end exactly
+ 11. flight recorder        — wide events flow to the collector as OTLP
+                            logs; the collector is killed and the event
+                            ring saturated mid-load: request latency is
+                            unchanged, and emitted == exported +
+                            dropped{reason} exactly for the logs signal
 
 Exits nonzero if any scenario misbehaves. Usage:
 
@@ -584,6 +589,85 @@ async def main() -> int:
         finally:
             await pods10.close()
 
+        # 11. flight recorder: wide events as OTLP logs; dead collector +
+        #     saturated ring mid-load degrade to exactly-accounted drops
+        #     with the request path untouched (fresh registry for exact
+        #     accounting).
+        from bee_code_interpreter_tpu.observability import FlightRecorder
+
+        m11 = Registry()
+        tracer11 = Tracer(metrics=m11)
+        recorder11 = FlightRecorder(max_events=16, metrics=m11)
+        tracer11.add_sink(recorder11.record_trace)
+        collector11 = await FakeCollector().start()
+        exporter11 = TelemetryExporter(
+            collector11.endpoint, m11,
+            flush_interval_s=0.05, queue_max=8, batch_max=4,
+            retry=RetryPolicy(attempts=2, wait_min_s=0.01, wait_max_s=0.02),
+        )
+        recorder11.add_sink(exporter11.enqueue_log)
+        exporter11.start()
+        executor11, _, _, pods11 = make_stack(tmp, storage, m11, clock)
+        try:
+            async def wide_execute(tag: str) -> float:
+                t0 = time.monotonic()
+                with tracer11.trace("/v1/execute"):
+                    result = await executor11.execute(f"print('{tag}')")
+                assert result.stdout == f"{tag}\n"
+                return time.monotonic() - t0
+
+            pre = [await wide_execute(f"wide{i}") for i in range(3)]
+            for _ in range(200):  # the background loop flushes every 50ms
+                if collector11.log_records():
+                    break
+                await asyncio.sleep(0.02)
+            records = collector11.log_records()
+            report(
+                "wide events reach the collector as OTLP logs",
+                len(records) >= 1
+                and '"kind": "request"' in records[0]["body"]["stringValue"],
+                f"{len(records)} log record(s) received",
+            )
+
+            await collector11.stop()  # chaos: collector dies mid-run
+            # saturate the ring + logs queue with a burst of synthetic
+            # events while real requests keep flowing
+            for i in range(40):
+                recorder11.record({"kind": "request", "outcome": "ok", "n": i})
+            post = [await wide_execute(f"after{i}") for i in range(6)]
+            report(
+                "saturated ring + dead collector leave latency unchanged",
+                exporter11.logs_queue_depth <= 8
+                and len(recorder11) <= 16
+                and max(post) < max(max(pre) * 3, max(pre) + 0.3),
+                f"logs_queue={exporter11.logs_queue_depth}/8 "
+                f"ring={len(recorder11)}/16 "
+                f"pre_max={max(pre) * 1000:.0f}ms "
+                f"post_max={max(post) * 1000:.0f}ms",
+            )
+
+            await exporter11.stop()
+            emitted = recorder11.snapshot()["emitted"]
+            logs_exported = m11.metrics[
+                "bci_telemetry_exported_total"
+            ]._values.get((("signal", "logs"),), 0)
+            logs_dropped = sum(
+                v
+                for k, v in m11.metrics[
+                    "bci_telemetry_dropped_total"
+                ]._values.items()
+                if ("signal", "logs") in k
+            )
+            report(
+                "every wide event accounted across the logs signal",
+                logs_exported + logs_dropped + exporter11.logs_queue_depth
+                == emitted,
+                f"exported={logs_exported:g} dropped={logs_dropped:g} "
+                f"queued={exporter11.logs_queue_depth} of {emitted} emitted",
+            )
+        finally:
+            await pods11.close()
+
         text = metrics.expose()
         wanted = [
             "bci_executor_fallback_total 1",
@@ -607,7 +691,7 @@ async def main() -> int:
     print(
         "chaos smoke passed: deadline, breaker, fallback, admission, replay, "
         "supervisor, watchdog, drain, telemetry export, edge analysis gate, "
-        "sessions-under-chaos all behaved"
+        "sessions-under-chaos, flight-recorder-logs all behaved"
     )
     return 0
 

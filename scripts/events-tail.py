@@ -1,0 +1,137 @@
+#!/usr/bin/env python3
+"""Live tail of the flight recorder's wide events (docs/observability.md).
+
+Follows ``GET /v1/events?follow=1`` over SSE and renders each wide event as
+a one-line table row (or raw JSON with ``--json``) — `tail -f` for the
+service's request journal, with the same filters the API supports:
+
+    python scripts/events-tail.py [--url http://localhost:50081]
+        [--outcome error] [--session sess-...] [--kind request]
+        [--min-duration-ms 500] [--backlog 20] [--json] [--once]
+
+``--once`` skips the follow and prints the current snapshot instead.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+import httpx
+
+
+def fmt_ts(ts: float | None) -> str:
+    if ts is None:
+        return "-"
+    return time.strftime("%H:%M:%S", time.localtime(ts))
+
+
+def render(event: dict) -> str:
+    duration = event.get("duration_ms")
+    dur = f"{duration:8.1f}ms" if duration is not None else "         -"
+    stream = event.get("stream") or {}
+    extras = []
+    if event.get("session"):
+        extras.append(f"session={event['session']}")
+    if stream.get("chunks"):
+        extras.append(f"chunks={stream['chunks']:g}")
+    if stream.get("ttfb_ms") is not None:
+        extras.append(f"ttfb={stream['ttfb_ms']:.0f}ms")
+    if event.get("replays"):
+        extras.append(f"replays={event['replays']}")
+    if event.get("hedge"):
+        extras.append(f"hedge={event['hedge']}")
+    if event.get("kind") == "loop_stall":
+        extras.append(f"lag={event.get('lag_s', 0) * 1000:.0f}ms")
+    return (
+        f"{fmt_ts(event.get('ts'))} {event.get('kind', '-'):<10} "
+        f"{(event.get('name') or '-'):<32} {(event.get('outcome') or '-'):<12} "
+        f"{dur}  trace={event.get('trace_id') or '-':<32} "
+        + " ".join(extras)
+    )
+
+
+def emit(event: dict, as_json: bool) -> None:
+    print(json.dumps(event) if as_json else render(event), flush=True)
+
+
+def tail(client: httpx.Client, base: str, params: dict, as_json: bool) -> int:
+    # SSE: "event: wide_event" lines name the event, "data: {...}" carries
+    # it, a blank line ends each record; ": keep-alive" comments are noise.
+    with client.stream(
+        "GET", f"{base}/v1/events", params={**params, "follow": "1"},
+        timeout=httpx.Timeout(10.0, read=None),
+    ) as response:
+        response.raise_for_status()
+        data_lines: list[str] = []
+        for line in response.iter_lines():
+            if line.startswith("data:"):
+                data_lines.append(line[len("data:"):].strip())
+            elif not line.strip():
+                if data_lines:
+                    emit(json.loads("\n".join(data_lines)), as_json)
+                    data_lines = []
+    return 0
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(
+        description="Tail GET /v1/events?follow=1 (wide-event journal)."
+    )
+    parser.add_argument("--url", default="http://localhost:50081")
+    parser.add_argument("--outcome", help="filter by outcome (e.g. error)")
+    parser.add_argument("--session", help="filter by session id")
+    parser.add_argument("--kind", help="filter by kind (request/session/loop_stall)")
+    parser.add_argument("--min-duration-ms", type=float, default=None)
+    parser.add_argument(
+        "--backlog", type=int, default=10,
+        help="replay the last N matching events before following (default 10)",
+    )
+    parser.add_argument(
+        "--json", action="store_true", help="print raw JSON instead of the table"
+    )
+    parser.add_argument(
+        "--once", action="store_true",
+        help="print the current snapshot and exit (no follow)",
+    )
+    args = parser.parse_args()
+    base = args.url.rstrip("/")
+    params: dict = {}
+    if args.outcome:
+        params["outcome"] = args.outcome
+    if args.session:
+        params["session"] = args.session
+    if args.kind:
+        params["kind"] = args.kind
+    if args.min_duration_ms is not None:
+        params["min_duration_ms"] = args.min_duration_ms
+    try:
+        with httpx.Client() as client:
+            if args.once:
+                body = (
+                    client.get(
+                        f"{base}/v1/events",
+                        params={**params, "limit": max(0, args.backlog)},
+                        timeout=10.0,
+                    )
+                    .raise_for_status()
+                    .json()
+                )
+                for event in reversed(body["events"]):  # oldest first
+                    emit(event, args.json)
+                return 0
+            return tail(
+                client, base, {**params, "backlog": max(0, args.backlog)},
+                args.json,
+            )
+    except httpx.HTTPError as e:
+        print(f"events-tail: cannot reach {base}: {e}", file=sys.stderr)
+        return 1
+    except KeyboardInterrupt:
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

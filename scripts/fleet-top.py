@@ -121,6 +121,27 @@ def render_slo(slo: dict) -> str:
     return line
 
 
+def render_loop(health: dict) -> str:
+    """One event-loop health line from ``GET /healthz?verbose=1`` — a
+    stalled loop makes every other number in this view lie by omission."""
+    mon = health.get("loop")
+    if not mon:
+        return "loop: (no monitor wired)"
+    line = (
+        f"loop: lag_last={mon.get('last_lag_ms', 0):.1f}ms"
+        f" lag_max={mon.get('max_lag_ms', 0):.1f}ms"
+        f" probes={mon.get('probes', 0)}"
+        f" stalls={mon.get('stalls', 0)}"
+    )
+    stall = mon.get("last_stall")
+    if stall:
+        line += (
+            f"  ** LAST STALL {stall.get('lag_s', 0) * 1000:.0f}ms"
+            f" ({stall.get('tasks', {}).get('count', 0)} tasks captured) **"
+        )
+    return line
+
+
 def render_events(events: list[dict]) -> str:
     lines = ["", f"recent events (newest first, {len(events)}):"]
     for e in events:
@@ -144,6 +165,15 @@ def render_once(client: httpx.Client, base: str, events: int) -> None:
     except httpx.HTTPError:
         slo = {}
     print(render_slo(slo))
+    try:
+        health = (
+            client.get(f"{base}/healthz", params={"verbose": "1"})
+            .raise_for_status()
+            .json()
+        )
+    except httpx.HTTPError:
+        health = {}
+    print(render_loop(health))
     if events > 0:
         event_list = (
             client.get(f"{base}/v1/fleet/events", params={"limit": events})

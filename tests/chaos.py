@@ -77,6 +77,20 @@ class DieMidExecute:
     sandbox."""
 
 
+def block_loop(seconds: float) -> float:
+    """Synchronously hog the event loop for ~``seconds`` (busy-wait, not
+    ``time.sleep``, so a patched/virtual clock can't skip it): the
+    deterministic way to make the loop-lag monitor observe a real stall
+    (docs/observability.md "Event-loop health"). Returns the actual time
+    burned."""
+    import time
+
+    start = time.perf_counter()
+    while time.perf_counter() - start < seconds:
+        pass
+    return time.perf_counter() - start
+
+
 class ManualClock:
     """Deterministic monotonic clock for Deadline/CircuitBreaker tests."""
 

@@ -157,14 +157,15 @@ class FakeKubectl:
 
 class FakeCollector:
     """In-process OTLP/HTTP collector double for the telemetry exporter:
-    records every JSON payload POSTed to ``/v1/traces`` / ``/v1/metrics``.
-    ``fail_next`` makes the next N posts answer 503 (retry coverage);
-    ``stop()`` kills the listener mid-run (the chaos scenario)."""
+    records every JSON payload POSTed to ``/v1/traces`` / ``/v1/metrics`` /
+    ``/v1/logs``. ``fail_next`` makes the next N posts answer 503 (retry
+    coverage); ``stop()`` kills the listener mid-run (the chaos scenario)."""
 
     def __init__(self, port: int | None = None) -> None:
         self.port = port or free_port()
         self.trace_batches: list[dict] = []
         self.metric_batches: list[dict] = []
+        self.log_batches: list[dict] = []
         self.requests = 0
         self.fail_next = 0
         self._runner: web.AppRunner | None = None
@@ -183,6 +184,17 @@ class FakeCollector:
             for span in ss.get("spans", [])
         }
 
+    def log_records(self) -> list[dict]:
+        """Every logRecord seen across all received logs batches (the wide
+        events the flight recorder exported)."""
+        return [
+            record
+            for batch in self.log_batches
+            for rl in batch.get("resourceLogs", [])
+            for sl in rl.get("scopeLogs", [])
+            for record in sl.get("logRecords", [])
+        ]
+
     async def _handle(self, request: web.Request, sink: list) -> web.Response:
         self.requests += 1
         if self.fail_next > 0:
@@ -200,8 +212,12 @@ class FakeCollector:
         async def metrics(request):
             return await self._handle(request, self.metric_batches)
 
+        async def logs(request):
+            return await self._handle(request, self.log_batches)
+
         app.router.add_post("/v1/traces", traces)
         app.router.add_post("/v1/metrics", metrics)
+        app.router.add_post("/v1/logs", logs)
         self._runner = web.AppRunner(app)
         await self._runner.setup()
         await web.TCPSite(self._runner, "127.0.0.1", self.port).start()

@@ -1,0 +1,465 @@
+"""Flight recorder (ISSUE 8): wide-event ring/rotation/filtering units, the
+tracer-sink emission path, the `/v1/events` API + SSE tail on the real HTTP
+edge, the gRPC mirror, OTLP logs export with exact drop accounting (the
+tier-1 half of chaos scenario 11), and session lifecycle emission."""
+
+import asyncio
+import json
+
+import grpc.aio
+import pytest
+
+from aiohttp.test_utils import TestClient, TestServer
+
+from bee_code_interpreter_tpu.api.grpc_server import (
+    GrpcServer,
+    observability_stubs,
+    service_stubs,
+)
+from bee_code_interpreter_tpu.api.http_server import create_http_server
+from bee_code_interpreter_tpu.observability import (
+    FlightRecorder,
+    TelemetryExporter,
+    Tracer,
+    span,
+    wide_event_from_trace,
+)
+from bee_code_interpreter_tpu.proto import code_interpreter_pb2 as pb
+from bee_code_interpreter_tpu.resilience import RetryPolicy
+from bee_code_interpreter_tpu.services.custom_tool_executor import (
+    CustomToolExecutor,
+)
+from bee_code_interpreter_tpu.utils.metrics import Registry
+from tests.fakes import FakeCollector
+
+
+# ------------------------------------------------------------------ ring/query
+
+
+def test_ring_bounded_filters_and_seq():
+    recorder = FlightRecorder(max_events=4, metrics=Registry())
+    for i in range(6):
+        recorder.record(
+            {
+                "kind": "request",
+                "outcome": "ok" if i % 2 == 0 else "error",
+                "session": f"sess-{i % 3}",
+                "duration_ms": float(i * 100),
+                "ts": 1000.0 + i,
+            }
+        )
+    assert len(recorder) == 4  # ring evicted the oldest two
+    events = recorder.events()
+    assert [e["seq"] for e in events] == [6, 5, 4, 3]  # newest first
+    assert [e["seq"] for e in recorder.events(outcome="error")] == [6, 4]
+    assert [e["seq"] for e in recorder.events(session="sess-2")] == [6, 3]
+    assert [e["seq"] for e in recorder.events(min_duration_ms=400.0)] == [6, 5]
+    assert [e["seq"] for e in recorder.events(since=1003.5)] == [6, 5]
+    assert [e["seq"] for e in recorder.events(limit=1)] == [6]
+    assert recorder.events(limit=0) == []  # a zero backlog replays nothing
+    assert recorder.events(kind="session") == []
+
+
+def test_min_duration_filter_skips_durationless_events():
+    recorder = FlightRecorder()
+    recorder.record({"kind": "session", "outcome": "created"})  # no duration
+    recorder.record({"kind": "request", "duration_ms": 50.0})
+    assert [e["kind"] for e in recorder.events(min_duration_ms=1.0)] == [
+        "request"
+    ]
+
+
+# ------------------------------------------------------------------- rotation
+
+
+def test_segment_rotation_bounds_disk(tmp_path):
+    recorder = FlightRecorder(
+        dir=tmp_path / "events",
+        segment_bytes=500,
+        max_segments=2,
+        metrics=Registry(),
+    )
+    for batch in range(6):
+        for i in range(5):
+            recorder.record({"kind": "request", "n": batch * 5 + i, "pad": "x" * 40})
+        assert recorder.flush_to_disk() == 5
+    segments = recorder.segment_paths()
+    assert 1 <= len(segments) <= 2, segments  # rotation deleted the oldest
+    # every line in every surviving segment is valid ndjson with a seq
+    lines = [
+        json.loads(line)
+        for p in segments
+        for line in p.read_text().splitlines()
+    ]
+    assert lines and all("seq" in e for e in lines)
+    # the newest event survived in the newest segment
+    assert lines[-1]["n"] == 29
+    assert recorder.snapshot()["segments"] == [p.name for p in segments]
+
+
+def test_write_queue_bounded_and_accounted(tmp_path):
+    metrics = Registry()
+    recorder = FlightRecorder(
+        dir=tmp_path / "events", write_queue_max=3, metrics=metrics
+    )
+    for i in range(5):
+        recorder.record({"n": i})
+    assert len(recorder._pending) == 3
+    dropped = metrics.metrics["bci_events_dropped_total"]._values
+    assert dropped.get((("reason", "write_queue_full"),)) == 2
+
+
+# ----------------------------------------------------------- trace -> event
+
+
+def test_wide_event_from_trace_lifts_annotations():
+    tracer = Tracer(metrics=Registry())
+    with tracer.trace("/v1/execute", request_id="req-1") as trace:
+        with span("execute"):
+            pass
+        with span("analysis") as s:
+            s.attributes["analysis.predicted_deps"] = "numpy"
+        trace.root.attributes.update(
+            {
+                "outcome": "ok",
+                "sli": "good",
+                "session": "sess-abc",
+                "usage.cpu_user_s": "0.25",
+                "stream.chunks": "3",
+                "stream.ttfb_ms": "17.5",
+                "replays": "1",
+                "hedge": "primary_won",
+                "custom": "kept",
+            }
+        )
+    event = wide_event_from_trace(trace)
+    assert event["kind"] == "request"
+    assert event["name"] == "/v1/execute"
+    assert event["trace_id"] == trace.trace_id
+    assert event["request_id"] == "req-1"
+    assert event["outcome"] == "ok" and event["sli"] == "good"
+    assert event["session"] == "sess-abc"
+    assert event["usage"] == {"cpu_user_s": 0.25}
+    assert event["stream"] == {"chunks": 3.0, "ttfb_ms": 17.5}
+    assert event["replays"] == 1 and event["hedge"] == "primary_won"
+    assert event["analysis"] == {"predicted_deps": "numpy"}
+    assert event["attributes"] == {"custom": "kept"}
+    assert set(event["timings_ms"]) == {"execute", "analysis"}
+    assert event["duration_ms"] == pytest.approx(trace.duration_s * 1000.0)
+
+
+def test_error_trace_defaults_outcome_error():
+    tracer = Tracer()
+    with pytest.raises(RuntimeError):
+        with tracer.trace("/v1/execute") as trace:
+            raise RuntimeError("boom")
+    assert wide_event_from_trace(trace)["outcome"] == "error"
+
+
+# ------------------------------------------------------------- HTTP transport
+
+
+async def with_client(app, fn):
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    try:
+        return await fn(client)
+    finally:
+        await client.close()
+
+
+def make_local_app(local_executor, metrics=None, tracer=None, recorder=None):
+    metrics = metrics or Registry()
+    return create_http_server(
+        code_executor=local_executor,
+        custom_tool_executor=CustomToolExecutor(code_executor=local_executor),
+        metrics=metrics,
+        tracer=tracer,
+        recorder=recorder,
+    )
+
+
+async def test_http_wide_event_agrees_with_trace(local_executor):
+    """Acceptance: one execution's wide event at /v1/events carries a
+    trace_id resolvable at /v1/traces/{id}, and the two views agree on the
+    stage breakdown (same sum — they are computed from the same spans)."""
+    app = make_local_app(local_executor)
+
+    async def go(client):
+        resp = await client.post(
+            "/v1/execute", json={"source_code": "print(6 * 7)"}
+        )
+        body = await resp.json()
+        assert resp.status == 200 and body["stdout"] == "42\n"
+        trace_id = body["trace_id"]
+
+        events = (await (await client.get("/v1/events")).json())["events"]
+        mine = [e for e in events if e.get("trace_id") == trace_id]
+        assert len(mine) == 1, events
+        event = mine[0]
+        assert event["kind"] == "request"
+        assert event["name"] == "/v1/execute"
+        assert event["outcome"] == "ok" and event["sli"] == "good"
+        assert event["duration_ms"] > 0
+
+        detail = await (await client.get(f"/v1/traces/{trace_id}")).json()
+        assert detail["trace_id"] == trace_id
+        assert sum(event["timings_ms"].values()) == pytest.approx(
+            sum(detail["stage_ms"].values())
+        )
+        # filters reach the same event
+        filtered = (
+            await (
+                await client.get("/v1/events", params={"outcome": "ok"})
+            ).json()
+        )["events"]
+        assert trace_id in {e.get("trace_id") for e in filtered}
+        assert (
+            await (
+                await client.get("/v1/events", params={"outcome": "deadline"})
+            ).json()
+        )["events"] == []
+        bad = await client.get("/v1/events", params={"limit": "nope"})
+        assert bad.status == 400
+
+    await with_client(app, go)
+
+
+async def test_http_sse_follow_delivers_live(local_executor):
+    app = make_local_app(local_executor)
+
+    async def go(client):
+        tail = await client.get(
+            "/v1/events", params={"follow": "1"}, timeout=30
+        )
+        assert tail.status == 200
+        assert tail.headers["Content-Type"].startswith("text/event-stream")
+
+        resp = await client.post(
+            "/v1/execute", json={"source_code": "print('live')"}
+        )
+        trace_id = (await resp.json())["trace_id"]
+
+        async def read_event():
+            data_lines = []
+            while True:
+                line = (await tail.content.readline()).decode()
+                if line.startswith("data:"):
+                    data_lines.append(line[len("data:"):].strip())
+                elif line.strip() == "" and data_lines:
+                    return json.loads("\n".join(data_lines))
+
+        event = await asyncio.wait_for(read_event(), timeout=10)
+        assert event["trace_id"] == trace_id
+        tail.close()
+
+    await with_client(app, go)
+
+
+async def test_debug_bundle_carries_events_section(local_executor):
+    app = make_local_app(local_executor)
+
+    async def go(client):
+        await client.post("/v1/execute", json={"source_code": "print(1)"})
+        bundle = await (await client.get("/v1/debug/bundle")).json()
+        assert bundle["events"]["retained"] >= 1
+        assert bundle["events"]["recent"][0]["kind"] == "request"
+        # loop/profile sections are always present (null when unwired)
+        assert "loop" in bundle and "profile" in bundle
+        assert bundle["loop"]["tasks"]["count"] >= 1
+
+    await with_client(app, go)
+
+
+# ------------------------------------------------------------- gRPC transport
+
+
+async def test_grpc_wide_event_agrees_with_trace(local_executor):
+    """The same acceptance on the other transport: Execute over gRPC emits
+    a wide event (shared tracer sink) whose trace resolves in the shared
+    store with an identical stage breakdown, served by
+    ObservabilityService/GetEvents."""
+    metrics = Registry()
+    tracer = Tracer(metrics=metrics)
+    recorder = FlightRecorder(metrics=metrics)
+    tracer.add_sink(recorder.record_trace)
+    server = GrpcServer(
+        code_executor=local_executor,
+        custom_tool_executor=CustomToolExecutor(code_executor=local_executor),
+        metrics=metrics,
+        tracer=tracer,
+        recorder=recorder,
+    )
+    port = await server.start("127.0.0.1:0")
+    try:
+        async with grpc.aio.insecure_channel(f"127.0.0.1:{port}") as channel:
+            stubs = service_stubs(channel)
+            resp = await stubs["Execute"](
+                pb.ExecuteRequest(source_code="print('grpc wide')")
+            )
+            assert resp.stdout == "grpc wide\n"
+            obs = observability_stubs(channel)
+            body = json.loads(await obs["GetEvents"](b'{"outcome": "ok"}'))
+            events = [
+                e for e in body["events"] if e["name"] == "grpc:Execute"
+            ]
+            assert len(events) == 1
+            event = events[0]
+            trace = tracer.store.get(event["trace_id"])
+            assert trace is not None  # resolvable at /v1/traces/{id}
+            assert sum(event["timings_ms"].values()) == pytest.approx(
+                sum(trace.stage_ms().values())
+            )
+            assert event["sli"] == "good"
+            # malformed filter bodies are INVALID_ARGUMENT, never UNKNOWN
+            with pytest.raises(grpc.aio.AioRpcError) as excinfo:
+                await obs["GetEvents"](b"not json")
+            assert excinfo.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+            # the task inventory serves real data over this transport too
+            tasks = json.loads(await obs["GetTasks"](b""))
+            assert tasks["count"] >= 1 and tasks["threads"]
+    finally:
+        await server.stop(None)
+
+
+# ------------------------------------------- OTLP logs export (chaos 11 pair)
+
+
+async def test_logs_export_exact_accounting_under_dead_collector():
+    """Wide events flow through the exporter as OTLP logs; killing the
+    collector and saturating the queue degrades to bounded, exactly
+    accounted drops: emitted == exported + dropped{reason} + queued."""
+    metrics = Registry()
+    recorder = FlightRecorder(max_events=16, metrics=metrics)
+    collector = await FakeCollector().start()
+    exporter = TelemetryExporter(
+        collector.endpoint,
+        metrics,
+        flush_interval_s=0.05,
+        queue_max=8,
+        batch_max=4,
+        retry=RetryPolicy(attempts=2, wait_min_s=0.01, wait_max_s=0.02),
+    )
+    recorder.add_sink(exporter.enqueue_log)
+    try:
+        for i in range(3):
+            recorder.record({"kind": "request", "outcome": "ok", "n": i})
+        result = await exporter.flush_once()
+        assert result["logs_exported"] == 3
+        records = collector.log_records()
+        assert len(records) == 3
+        # the record body IS the wide event, JSON-encoded, trace-correlatable
+        body = json.loads(records[0]["body"]["stringValue"])
+        assert body["kind"] == "request" and body["seq"] == 1
+        assert {"key": "event.kind", "value": {"stringValue": "request"}} in (
+            records[0]["attributes"]
+        )
+
+        await collector.stop()  # chaos: collector dies mid-run
+        # saturate: 20 more events against a queue of 8
+        for i in range(20):
+            recorder.record({"kind": "request", "outcome": "ok", "n": 100 + i})
+        await exporter.flush_once()  # fails, drops one batch, stops draining
+        await exporter.stop()  # accounts the rest as shutdown
+
+        emitted = recorder.snapshot()["emitted"]
+        assert emitted == 23
+        exported = metrics.metrics["bci_telemetry_exported_total"]._values.get(
+            (("signal", "logs"),), 0
+        )
+        dropped_by_reason = {
+            dict(k)["reason"]: v
+            for k, v in metrics.metrics[
+                "bci_telemetry_dropped_total"
+            ]._values.items()
+            if dict(k)["signal"] == "logs"
+        }
+        assert exported == 3
+        assert dropped_by_reason.get("queue_full", 0) == 12  # 20 - queue of 8
+        # the queued 8: one batch dropped at send, the rest at shutdown
+        assert (
+            dropped_by_reason.get("send_failed", 0)
+            + dropped_by_reason.get("shutdown", 0)
+            == 8
+        )
+        assert exported + sum(dropped_by_reason.values()) == emitted
+        assert exporter.logs_queue_depth == 0
+    finally:
+        await exporter.stop()
+        await collector.stop()
+
+
+# --------------------------------------------------- streaming metrics (sat.)
+
+
+async def test_streaming_metrics_on_both_edges(local_executor):
+    """Satellite: the bench-only streaming numbers are production metrics
+    now — an SSE stream records bci_stream_ttfb_seconds +
+    bci_stream_chunks_total{transport="http"} and its wide event carries
+    stream.chunks / stream.ttfb_ms; gRPC ExecuteStream records the same
+    under transport="grpc"."""
+    metrics = Registry()
+    app = make_local_app(local_executor, metrics=metrics)
+
+    async def go(client):
+        resp = await client.post(
+            "/v1/execute",
+            params={"stream": "1"},
+            json={"source_code": "print('c1', flush=True)\nprint('c2')"},
+        )
+        assert resp.status == 200
+        await resp.read()  # drain the SSE body to completion
+        text = (await (await client.get("/metrics")).text())
+        assert 'bci_stream_ttfb_seconds_count{transport="http"} 1' in text
+        assert 'bci_stream_chunks_total{transport="http"}' in text
+        events = (await (await client.get("/v1/events")).json())["events"]
+        streamed = [e for e in events if e.get("stream")]
+        assert streamed, events
+        assert streamed[0]["stream"]["chunks"] >= 1
+        assert streamed[0]["stream"]["ttfb_ms"] > 0
+
+    await with_client(app, go)
+
+    from bee_code_interpreter_tpu.api.grpc_server import execute_stream_stub
+
+    server = GrpcServer(
+        code_executor=local_executor,
+        custom_tool_executor=CustomToolExecutor(code_executor=local_executor),
+        metrics=metrics,
+    )
+    port = await server.start("127.0.0.1:0")
+    try:
+        async with grpc.aio.insecure_channel(f"127.0.0.1:{port}") as channel:
+            call = execute_stream_stub(channel)(
+                json.dumps({"source_code": "print('g1', flush=True)"}).encode()
+            )
+            events = [json.loads(raw) async for raw in call]
+            assert events[-1]["event"] == "result"
+        text = metrics.expose()
+        assert 'bci_stream_ttfb_seconds_count{transport="grpc"} 1' in text
+        assert 'bci_stream_chunks_total{transport="grpc"}' in text
+    finally:
+        await server.stop(None)
+
+
+# --------------------------------------------------------- session lifecycle
+
+
+async def test_session_lifecycle_ops_emit_wide_events(local_executor, storage):
+    from bee_code_interpreter_tpu.sessions import SessionManager
+
+    metrics = Registry()
+    recorder = FlightRecorder(metrics=metrics)
+    manager = SessionManager(
+        local_executor, storage, metrics=metrics, recorder=recorder, ttl_s=0.2
+    )
+    session = await manager.create()
+    sid = session.session_id
+    created = recorder.events(kind="session", session=sid)
+    assert [e["name"] for e in created] == ["session.created"]
+    await asyncio.sleep(0.25)
+    assert await manager.sweep_once() == 1
+    events = recorder.events(kind="session", session=sid)
+    assert [e["name"] for e in events] == ["session.ended", "session.created"]
+    assert events[0]["outcome"] == "ttl"
+    assert events[0]["sandbox"] == session.lease.name

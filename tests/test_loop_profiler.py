@@ -1,0 +1,291 @@
+"""Event-loop health monitor + continuous profiler (ISSUE 8): ManualClock-
+driven lag/stall detection, the live probe against a real loop hog, the
+task inventory, profiler collapsed-stack shape and bounded overhead, and
+the debug endpoints serving real data through the HTTP edge."""
+
+import asyncio
+import threading
+import time
+
+import pytest
+
+from aiohttp.test_utils import TestClient, TestServer
+
+from bee_code_interpreter_tpu.api.http_server import create_http_server
+from bee_code_interpreter_tpu.observability import (
+    ContinuousProfiler,
+    FlightRecorder,
+    LoopMonitor,
+    collapse_stack,
+    task_inventory,
+)
+from bee_code_interpreter_tpu.observability.contprof import ProfileWindow
+from bee_code_interpreter_tpu.services.custom_tool_executor import (
+    CustomToolExecutor,
+)
+from bee_code_interpreter_tpu.utils.metrics import Registry
+from tests.chaos import ManualClock, block_loop
+
+
+# ------------------------------------------------------------- loop monitor
+
+
+def test_lag_probe_under_manual_clock():
+    clock = ManualClock()
+    metrics = Registry()
+    recorder = FlightRecorder(metrics=metrics)
+    monitor = LoopMonitor(
+        interval_s=1.0,
+        stall_threshold_s=0.5,
+        recorder=recorder,
+        metrics=metrics,
+        clock=clock,
+    )
+    # on-time wakeup: zero lag, no stall
+    monitor.arm()
+    clock.advance(1.0)
+    assert monitor.tick() == 0.0
+    assert monitor.stalls == 0
+    # a wakeup 1.5s late: lag recorded, stall captured
+    monitor.arm()
+    clock.advance(2.5)
+    assert monitor.tick() == pytest.approx(1.5)
+    assert monitor.probes == 2
+    assert monitor.stalls == 1
+    assert monitor.last_lag_s == pytest.approx(1.5)
+    assert monitor.max_lag_s == pytest.approx(1.5)
+    stall = monitor.last_stall
+    assert stall is not None and stall["lag_s"] == pytest.approx(1.5)
+    assert "tasks" in stall  # the dump shape exists even outside a loop
+    # the stall became a wide event and the metrics observed both probes
+    events = recorder.events(kind="loop_stall")
+    assert len(events) == 1 and events[0]["outcome"] == "stall"
+    assert events[0]["lag_s"] == pytest.approx(1.5)
+    text = metrics.expose()
+    assert "bci_event_loop_lag_seconds_count 2" in text
+    assert "bci_loop_stalls_total 1" in text
+    # sub-threshold lag never captures
+    monitor.arm()
+    clock.advance(1.2)
+    monitor.tick()
+    assert monitor.stalls == 1
+
+
+async def test_live_probe_catches_a_real_loop_hog():
+    recorder = FlightRecorder()
+    monitor = LoopMonitor(
+        interval_s=0.05, stall_threshold_s=0.15, recorder=recorder
+    )
+    monitor.start()
+    try:
+        await asyncio.sleep(0.12)  # a couple of healthy probes
+        block_loop(0.3)  # the loop hog the monitor exists to catch
+        await asyncio.sleep(0.12)  # let the late wakeup fire
+    finally:
+        await monitor.stop()
+    assert monitor.probes >= 2
+    assert monitor.stalls >= 1
+    assert monitor.max_lag_s >= 0.15
+    stall_events = recorder.events(kind="loop_stall")
+    assert stall_events
+    # the dump was taken from inside the running loop: real tasks captured
+    assert stall_events[0]["tasks"]["count"] >= 1
+
+
+async def test_task_inventory_names_and_stacks():
+    release = asyncio.Event()
+
+    async def parked():
+        await release.wait()
+
+    task = asyncio.get_running_loop().create_task(parked(), name="bci-parked")
+    await asyncio.sleep(0)
+    try:
+        inventory = task_inventory()
+        assert inventory["count"] >= 2  # this test's task + parked
+        mine = [t for t in inventory["tasks"] if t["name"] == "bci-parked"]
+        assert len(mine) == 1
+        assert mine[0]["done"] is False
+        assert any("parked" in frame for frame in mine[0]["stack"])
+    finally:
+        release.set()
+        await task
+
+
+def test_disabled_monitor_never_starts():
+    monitor = LoopMonitor(interval_s=0)
+    assert monitor.enabled is False
+    monitor.start()  # no loop needed: disabled start is a no-op
+    assert monitor.running is False
+
+
+# -------------------------------------------------------- continuous profiler
+
+
+def _burn_for_profiler(stop: threading.Event) -> None:
+    x = 0
+    while not stop.is_set():
+        x += 1
+
+
+def test_profiler_collapsed_stack_shape_and_trace_ids():
+    metrics = Registry()
+    profiler = ContinuousProfiler(
+        hz=50,
+        window_s=3600,
+        active_trace_ids=lambda: ("deadbeef" * 4,),
+        metrics=metrics,
+    )
+    stop = threading.Event()
+    worker = threading.Thread(
+        target=_burn_for_profiler, args=(stop,), daemon=True
+    )
+    worker.start()
+    try:
+        for _ in range(25):
+            profiler.sample_once()
+    finally:
+        stop.set()
+        worker.join()
+    window = profiler.latest_window()
+    assert window.samples == 25
+    # folded format: every line is "frame;frame;... count", and the busy
+    # worker's function is visible as a leaf frame
+    folded = profiler.collapsed()
+    assert folded
+    for line in folded.splitlines():
+        stack, _, count = line.rpartition(" ")
+        assert stack and count.isdigit()
+    assert "_burn_for_profiler" in folded
+    assert "deadbeef" * 4 in window.trace_ids
+    snapshot = profiler.snapshot()
+    assert snapshot["window"]["samples"] == 25
+    assert snapshot["window"]["hot_stacks"]
+    assert "bci_contprof_samples_total 25" in metrics.expose()
+
+
+def test_profiler_excludes_its_own_thread():
+    profiler = ContinuousProfiler(hz=50, window_s=3600)
+    profiler.start()
+    try:
+        time.sleep(0.15)
+    finally:
+        profiler.stop()
+    window = profiler.latest_window()
+    assert window.samples >= 2
+    assert all("contprof" not in stack for stack in window.stacks)
+
+
+def test_profiler_window_rolls_and_bounds_stacks():
+    clock_now = [1000.0]
+    profiler = ContinuousProfiler(
+        hz=50, window_s=10.0, max_windows=2, clock=lambda: clock_now[0]
+    )
+    profiler.sample_once()
+    clock_now[0] += 11.0  # past the window bound -> roll on next sample
+    profiler.sample_once()
+    clock_now[0] += 11.0
+    profiler.sample_once()
+    windows = profiler.windows()
+    assert len(windows) == 3  # two completed + current
+    assert windows[0].end_unix is not None
+    # direct bound check: past max_stacks new stacks aggregate as truncated
+    window = ProfileWindow(0.0, max_stacks=2, max_trace_ids=4)
+    for name in ("a;b", "a;c", "d;e", "f;g"):
+        window.add(name)
+    assert len(window.stacks) == 3
+    assert window.stacks["<truncated>"] == 2
+
+
+def test_profiler_overhead_is_bounded():
+    """The always-on budget: one sample must stay far below the ~53ms
+    sampling period, or "low overhead" is a lie. 5ms/sample would be <10%
+    of the period; real cost is tens of microseconds."""
+    profiler = ContinuousProfiler(hz=19)
+    profiler.sample_once()  # warm
+    n = 200
+    start = time.perf_counter()
+    for _ in range(n):
+        profiler.sample_once()
+    per_sample = (time.perf_counter() - start) / n
+    assert per_sample < 0.005, f"{per_sample * 1000:.2f}ms per sample"
+
+
+def test_collapse_stack_depth_capped():
+    def recurse(depth):
+        if depth == 0:
+            import sys
+
+            return collapse_stack(
+                sys._current_frames()[threading.get_ident()], max_depth=5
+            )
+        return recurse(depth - 1)
+
+    collapsed = recurse(20)
+    assert collapsed.count(";") == 4  # 5 frames -> 4 separators
+
+
+# ------------------------------------------------- debug endpoints (HTTP e2e)
+
+
+async def test_debug_endpoints_serve_real_data(local_executor):
+    """Acceptance: with the monitor and profiler ON, /v1/debug/tasks,
+    /v1/debug/pprof and bci_event_loop_lag_seconds all serve real data
+    through the HTTP edge, and healthz?verbose=1 carries the loop view."""
+    metrics = Registry()
+    monitor = LoopMonitor(interval_s=0.05, metrics=metrics)
+    profiler = ContinuousProfiler(hz=100, window_s=3600, metrics=metrics)
+    app = create_http_server(
+        code_executor=local_executor,
+        custom_tool_executor=CustomToolExecutor(code_executor=local_executor),
+        metrics=metrics,
+        loopmon=monitor,
+        contprof=profiler,
+    )
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    monitor.start()
+    profiler.start()
+    try:
+        await client.post("/v1/execute", json={"source_code": "print(1)"})
+        await asyncio.sleep(0.2)  # a few probes and samples land
+
+        tasks = await (await client.get("/v1/debug/tasks")).json()
+        assert tasks["count"] >= 1 and tasks["threads"]
+        assert tasks["monitor"]["probes"] >= 1
+
+        pprof = await client.get("/v1/debug/pprof")
+        assert pprof.status == 200
+        assert (await pprof.text()).strip()  # collapsed stacks present
+        pprof_json = await (
+            await client.get("/v1/debug/pprof", params={"format": "json"})
+        ).json()
+        assert pprof_json["window"]["samples"] >= 1
+
+        health = await (
+            await client.get("/healthz", params={"verbose": "1"})
+        ).json()
+        assert health["loop"]["probes"] >= 1
+
+        text = (
+            await (await client.get("/metrics")).text()
+        )
+        assert "bci_event_loop_lag_seconds_count" in text
+        assert "bci_contprof_samples_total" in text
+    finally:
+        profiler.stop()
+        await monitor.stop()
+        await client.close()
+
+
+async def test_pprof_unwired_is_501(local_executor):
+    app = create_http_server(
+        code_executor=local_executor,
+        custom_tool_executor=CustomToolExecutor(code_executor=local_executor),
+    )
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    try:
+        assert (await client.get("/v1/debug/pprof")).status == 501
+    finally:
+        await client.close()

@@ -97,6 +97,15 @@ def test_every_registered_metric_follows_conventions(tmp_path):
         "bci_session_active",
         "bci_session_lease_seconds",
         "bci_session_expirations_total",
+        # flight recorder + loop health + continuous profiler (ISSUE 8)
+        "bci_events_emitted_total",
+        "bci_events_dropped_total",
+        "bci_event_loop_lag_seconds",
+        "bci_loop_stalls_total",
+        "bci_contprof_samples_total",
+        # streaming promoted from bench-only numbers to production metrics
+        "bci_stream_ttfb_seconds",
+        "bci_stream_chunks_total",
     ):
         assert required in metrics, f"{required}: not registered by the wiring"
     assert isinstance(metrics["bci_pool_spawn_seconds"], Histogram)
@@ -119,6 +128,13 @@ def test_every_registered_metric_follows_conventions(tmp_path):
     assert isinstance(metrics["bci_session_active"], Gauge)
     assert isinstance(metrics["bci_session_lease_seconds"], Histogram)
     assert isinstance(metrics["bci_session_expirations_total"], Counter)
+    assert isinstance(metrics["bci_events_emitted_total"], Counter)
+    assert isinstance(metrics["bci_events_dropped_total"], Counter)
+    assert isinstance(metrics["bci_event_loop_lag_seconds"], Histogram)
+    assert isinstance(metrics["bci_loop_stalls_total"], Counter)
+    assert isinstance(metrics["bci_contprof_samples_total"], Counter)
+    assert isinstance(metrics["bci_stream_ttfb_seconds"], Histogram)
+    assert isinstance(metrics["bci_stream_chunks_total"], Counter)
 
     for name, metric in metrics.items():
         assert name.startswith("bci_"), (
