@@ -1056,11 +1056,12 @@ OBSERVABILITY_SERVICE_NAME = "code_interpreter.v1.ObservabilityService"
 
 class ObservabilityServicer:
     """SLO state, the one-call debug bundle, the flight recorder's wide
-    events, the live task inventory, and the continuous profiler over gRPC
-    — the transport mirror of ``GET /v1/slo`` / ``/v1/debug/bundle`` /
-    ``/v1/events`` / ``/v1/debug/tasks`` / ``/v1/debug/pprof``, as JSON
-    message bytes through a generic handler (same protoc-less trick as
-    ``FleetService``)."""
+    events, the live task inventory, the continuous profiler, and the
+    serving engine's telemetry over gRPC — the transport mirror of
+    ``GET /v1/slo`` / ``/v1/debug/bundle`` / ``/v1/events`` /
+    ``/v1/debug/tasks`` / ``/v1/debug/pprof`` / ``/v1/serving`` (+
+    ``/requests``), as JSON message bytes through a generic handler (same
+    protoc-less trick as ``FleetService``)."""
 
     def __init__(
         self,
@@ -1069,12 +1070,14 @@ class ObservabilityServicer:
         recorder=None,  # observability.FlightRecorder
         loopmon=None,  # observability.LoopMonitor
         contprof=None,  # observability.ContinuousProfiler
+        serving=None,  # observability.ServingMonitor
     ) -> None:
         self._slo = slo
         self._debug_bundle = debug_bundle
         self._recorder = recorder
         self._loopmon = loopmon
         self._contprof = contprof
+        self._serving = serving
 
     async def GetSlo(self, request: bytes, context) -> bytes:
         snapshot = (
@@ -1100,17 +1103,7 @@ class ObservabilityServicer:
                 grpc.StatusCode.UNIMPLEMENTED,
                 "no flight recorder wired into this server",
             )
-        body: dict = {}
-        if request:
-            try:
-                body = json.loads(request.decode())
-                if not isinstance(body, dict):
-                    raise ValueError("not an object")
-            except (ValueError, UnicodeDecodeError):
-                await context.abort(
-                    grpc.StatusCode.INVALID_ARGUMENT,
-                    'request must be JSON like {"outcome": "error", "limit": 50}',
-                )
+        body = await self._parse_json_request(request, context)
         try:
             events = self._recorder.events(
                 kind=body.get("kind"),
@@ -1139,6 +1132,88 @@ class ObservabilityServicer:
             )
         return json.dumps({"events": events}).encode()
 
+    async def GetServing(self, request: bytes, context) -> bytes:
+        """The serving engine's deep-observability snapshot — the gRPC
+        spelling of ``GET /v1/serving``. Optional JSON request
+        ``{"steps": N}`` bounds how many recent step records ride along
+        (default 32)."""
+        if self._serving is None:
+            await context.abort(
+                grpc.StatusCode.UNIMPLEMENTED,
+                "no serving monitor wired into this server",
+            )
+        body = await self._parse_json_request(request, context)
+        try:
+            steps = int(body.get("steps", 32))
+            if steps < 0:
+                raise ValueError("steps must be >= 0")
+        except (TypeError, ValueError):
+            await context.abort(
+                grpc.StatusCode.INVALID_ARGUMENT,
+                "steps must be a non-negative integer",
+            )
+        return json.dumps(self._serving.snapshot(steps=steps)).encode()
+
+    async def GetServingRequests(self, request: bytes, context) -> bytes:
+        """Per-request serving lifecycle records, filtered like
+        ``GET /v1/serving/requests``: optional JSON request with
+        ``outcome``/``finish``/``adapter``/``active``/``min_duration_ms``/
+        ``limit``."""
+        if self._serving is None:
+            await context.abort(
+                grpc.StatusCode.UNIMPLEMENTED,
+                "no serving monitor wired into this server",
+            )
+        body = await self._parse_json_request(request, context)
+        active = body.get("active")
+        if active is not None and not isinstance(active, bool):
+            # accept the HTTP edge's ?active=1/0 string forms with the
+            # SAME truthiness (bool("0") would invert them)
+            active = str(active).lower() in ("1", "true", "yes", "on")
+        try:
+            limit = int(body["limit"]) if body.get("limit") is not None else None
+            if limit is not None and limit < 0:
+                raise ValueError("limit must be >= 0")
+            records = self._serving.requests(
+                outcome=body.get("outcome"),
+                finish=body.get("finish"),
+                adapter=(
+                    int(body["adapter"])
+                    if body.get("adapter") is not None
+                    else None
+                ),
+                active=active,
+                min_duration_ms=(
+                    float(body["min_duration_ms"])
+                    if body.get("min_duration_ms") is not None
+                    else None
+                ),
+                limit=limit,
+            )
+        except (TypeError, ValueError):
+            await context.abort(
+                grpc.StatusCode.INVALID_ARGUMENT,
+                "limit, adapter and min_duration_ms must be numeric "
+                "(limit >= 0)",
+            )
+        return json.dumps({"requests": records}).encode()
+
+    async def _parse_json_request(self, request: bytes, context) -> dict:
+        """Empty request bytes mean defaults; anything else must be a JSON
+        object (the convention GetEvents established)."""
+        if not request:
+            return {}
+        try:
+            body = json.loads(request.decode())
+            if not isinstance(body, dict):
+                raise ValueError("not an object")
+        except (ValueError, UnicodeDecodeError):
+            await context.abort(
+                grpc.StatusCode.INVALID_ARGUMENT,
+                'request must be a JSON object like {"limit": 50}',
+            )
+        return body
+
     async def GetTasks(self, request: bytes, context) -> bytes:
         body = task_inventory()
         body["threads"] = thread_inventory()
@@ -1166,6 +1241,8 @@ _OBSERVABILITY_METHODS = (
     "GetEvents",
     "GetTasks",
     "GetPprof",
+    "GetServing",
+    "GetServingRequests",
 )
 
 
@@ -1441,6 +1518,7 @@ class GrpcServer:
         recorder=None,  # observability.FlightRecorder shared with the HTTP edge
         loopmon=None,  # observability.LoopMonitor shared with the HTTP edge
         contprof=None,  # observability.ContinuousProfiler, likewise
+        serving=None,  # observability.ServingMonitor, likewise
     ) -> None:
         self._servicer = CodeInterpreterServicer(
             code_executor,
@@ -1459,6 +1537,7 @@ class GrpcServer:
         self._recorder = recorder
         self._loopmon = loopmon
         self._contprof = contprof
+        self._serving = serving
         # Mirror the HTTP edge: use the executor backend's own journal when
         # one exists (find_journal is the one shared discovery rule), else
         # an (honestly empty) standalone journal. Explicit None checks: an
@@ -1509,6 +1588,7 @@ class GrpcServer:
                         recorder=self._recorder,
                         loopmon=self._loopmon,
                         contprof=self._contprof,
+                        serving=self._serving,
                     )
                 ),
                 _health_handler(self.health),

@@ -34,7 +34,9 @@ serving-engine steps. ``GET /v1/slo`` reports error-budget burn rates,
 recorder's wide-event journal (filterable; ``?follow=1`` is a live SSE
 tail), ``GET /v1/debug/tasks`` the live asyncio task inventory + loop-lag
 state, and ``GET /v1/debug/pprof`` the continuous profiler's latest
-collapsed-stack window.
+collapsed-stack window. ``GET /v1/serving`` serves the serving engine's
+step/KV-cache telemetry and ``GET /v1/serving/requests`` its per-request
+lifecycle records (docs/observability.md "Serving observability").
 
 Edge static analysis (docs/analysis.md): when a ``WorkloadAnalyzer`` is
 wired in, every submission is parsed ONCE before any sandbox is touched —
@@ -135,6 +137,7 @@ def create_http_server(
     recorder=None,  # observability.FlightRecorder for GET /v1/events
     loopmon=None,  # observability.LoopMonitor for GET /v1/debug/tasks
     contprof=None,  # observability.ContinuousProfiler for GET /v1/debug/pprof
+    serving=None,  # observability.ServingMonitor for GET /v1/serving
 ) -> web.Application:
     app = web.Application(client_max_size=1 << 30)
     metrics = metrics or Registry()
@@ -585,7 +588,13 @@ def create_http_server(
             # scans THIS source itself.
             stash_predicted_deps(None)
             if req.target == "serving":
-                if profiler is None:
+                # 501 both when no profiler was wired AND when one exists
+                # but its stepper has no engine attached yet (the
+                # composition root wires the profiler unconditionally; the
+                # engine arrives via ApplicationContext.attach_serving_engine)
+                if profiler is None or not getattr(
+                    profiler, "available", True
+                ):
                     return web.json_response(
                         {"detail": "no serving engine attached to /v1/profile"},
                         status=501,
@@ -1039,6 +1048,7 @@ def create_http_server(
                 recorder=recorder,
                 loopmon=loopmon,
                 contprof=contprof,
+                serving=serving,
             )
         )
         return web.json_response(bundle)
@@ -1171,6 +1181,77 @@ def create_http_server(
             text=contprof.collapsed() + "\n", content_type="text/plain"
         )
 
+    async def serving_snapshot(request: web.Request) -> web.Response:
+        """The serving engine's deep-observability view (docs/observability.md
+        "Serving observability"): batcher/queue aggregates, KV-cache
+        telemetry, lifetime totals, and the last ``?steps=N`` step records
+        (default 32). 501 when no ServingMonitor is wired (standalone
+        servers); with one wired but no engine attached the body answers
+        honestly (``attached: false``)."""
+        if serving is None:
+            return web.json_response(
+                {"detail": "no serving monitor wired into this server"},
+                status=501,
+            )
+        try:
+            steps = int(request.query.get("steps", "32"))
+        except ValueError:
+            return web.json_response(
+                {"detail": "steps must be an integer"}, status=400
+            )
+        if steps < 0:
+            return web.json_response(
+                {"detail": "steps must be >= 0"}, status=400
+            )
+        return web.json_response(serving.snapshot(steps=steps))
+
+    async def serving_requests(request: web.Request) -> web.Response:
+        """Per-request lifecycle records, newest first, with filters:
+        ``outcome`` (ok/error/cancelled/preempted), ``finish`` (the batcher
+        done reason), ``adapter``, ``active`` (1/0), ``min_duration_ms``,
+        ``limit``."""
+        if serving is None:
+            return web.json_response(
+                {"detail": "no serving monitor wired into this server"},
+                status=501,
+            )
+        query = request.query
+        try:
+            limit = int(query["limit"]) if "limit" in query else None
+            adapter = int(query["adapter"]) if "adapter" in query else None
+            min_duration_ms = (
+                float(query["min_duration_ms"])
+                if "min_duration_ms" in query
+                else None
+            )
+        except ValueError:
+            return web.json_response(
+                {
+                    "detail": "limit, adapter and min_duration_ms must be "
+                    "numeric"
+                },
+                status=400,
+            )
+        if limit is not None and limit < 0:
+            return web.json_response(
+                {"detail": "limit must be >= 0"}, status=400
+            )
+        active = (
+            _truthy_query(request, "active") if "active" in query else None
+        )
+        return web.json_response(
+            {
+                "requests": serving.requests(
+                    outcome=query.get("outcome"),
+                    finish=query.get("finish"),
+                    adapter=adapter,
+                    active=active,
+                    min_duration_ms=min_duration_ms,
+                    limit=limit,
+                )
+            }
+        )
+
     async def fleet_snapshot(_request: web.Request) -> web.Response:
         snap = fleet.snapshot()
         # Supervisor + drain state ride on the fleet view: "is anything
@@ -1216,6 +1297,8 @@ def create_http_server(
     app.router.add_get("/v1/fleet", fleet_snapshot)
     app.router.add_get("/v1/fleet/events", fleet_events)
     app.router.add_get("/v1/slo", slo_endpoint)
+    app.router.add_get("/v1/serving", serving_snapshot)
+    app.router.add_get("/v1/serving/requests", serving_requests)
     app.router.add_get("/v1/events", list_events)
     app.router.add_get("/v1/debug/bundle", debug_bundle_endpoint)
     app.router.add_get("/v1/debug/tasks", debug_tasks)

@@ -18,6 +18,8 @@ from bee_code_interpreter_tpu.observability import (
     FleetJournal,
     FlightRecorder,
     LoopMonitor,
+    ServingMonitor,
+    ServingProfiler,
     SloEngine,
     TelemetryExporter,
     Tracer,
@@ -91,6 +93,22 @@ class ApplicationContext:
             active_trace_ids=self.tracer.active_trace_ids,
             metrics=self.metrics,
         )
+        # Serving-engine deep observability (docs/observability.md "Serving
+        # observability"): per-request lifecycle traces into the shared
+        # trace store, kind="serving" wide events into the flight recorder,
+        # a bounded step-record ring behind GET /v1/serving. Constructed
+        # unconditionally (its metrics must exist either way); an engine
+        # binds later via attach_serving_engine, which also arms the
+        # serving profiler (POST /v1/profile target=serving answers 501
+        # until then).
+        self.serving = ServingMonitor(
+            metrics=self.metrics,
+            store=self.trace_store,
+            recorder=self.flight,
+            max_steps=self.config.serving_step_records,
+            max_requests=self.config.serving_request_records,
+        )
+        self.serving_profiler = ServingProfiler(self.serving)
         # Telemetry export: with APP_OTLP_ENDPOINT set, finished traces and
         # metric snapshots are pushed OTLP/JSON to the collector by a
         # background exporter (started by __main__ once the loop runs).
@@ -156,8 +174,21 @@ class ApplicationContext:
         (must be called from a running loop; __main__ does)."""
         self.flight.start()
         self.loopmon.start()
+        # the serving monitor's wide events must reach the recorder's loop
+        # even when its hooks fire from a worker thread (profiler captures)
+        # and the engine was attached before the loop existed
+        self.serving.arm_loop()
         if self.config.contprof_enabled:
             self.contprof.start()
+
+    def attach_serving_engine(self, engine) -> None:
+        """Bind a ``models.engine.Engine`` (or bare ``ContinuousBatcher``)
+        to the serving monitor: per-request lifecycle traces/wide events
+        start flowing, ``GET /v1/serving`` reports it, and ``POST
+        /v1/profile`` target=serving captures real batcher steps instead of
+        answering 501. Construct the engine with ``metrics=ctx.metrics`` so
+        its aggregate gauges land in the same registry."""
+        self.serving.attach(engine)
 
     def build_debug_bundle(self) -> dict:
         """The one-call incident snapshot both edges serve — built here so
@@ -177,6 +208,7 @@ class ApplicationContext:
             recorder=self.flight,
             loopmon=self.loopmon,
             contprof=self.contprof,
+            serving=self.serving,
         )
 
     @cached_property
@@ -437,6 +469,8 @@ class ApplicationContext:
             recorder=self.flight,
             loopmon=self.loopmon,
             contprof=self.contprof,
+            serving=self.serving,
+            profiler=self.serving_profiler,
         )
 
     @cached_property
@@ -462,4 +496,5 @@ class ApplicationContext:
             recorder=self.flight,
             loopmon=self.loopmon,
             contprof=self.contprof,
+            serving=self.serving,
         )

@@ -183,6 +183,15 @@ class Config(BaseModel):
     # Aggregation window length and how many completed windows to retain.
     contprof_window_s: float = Field(default=60.0, gt=0)
     contprof_windows: int = Field(default=5, ge=1)
+    # --- serving observability (docs/observability.md "Serving
+    # observability") ---
+    # Batcher step records retained in the serving monitor's ring for
+    # GET /v1/serving (one record per ContinuousBatcher.step when an
+    # engine is attached).
+    serving_step_records: int = Field(default=512, ge=1)
+    # Finished per-request lifecycle records retained for
+    # GET /v1/serving/requests (live requests are always reported).
+    serving_request_records: int = Field(default=256, ge=1)
     # --- telemetry export (docs/observability.md "Telemetry export") ---
     # OTLP/HTTP collector base URL (e.g. http://otel-collector:4318): finished
     # traces and metric snapshots are pushed as OTLP/JSON to

@@ -48,6 +48,7 @@ class _Queued:
     adapter: int | None
     pages_needed: int
     interleave_admission: int | None = None
+    priority: int = 0  # kept so a preempted ticket requeues in class
 
 
 class Engine:
@@ -59,9 +60,24 @@ class Engine:
     """
 
     def __init__(self, batcher: ContinuousBatcher,
-                 max_queue: int | None = None, metrics=None) -> None:
+                 max_queue: int | None = None, metrics=None,
+                 monitor=None) -> None:
         self.batcher = batcher
         self.max_queue = max_queue
+        # Lifecycle monitor (observability.ServingMonitor): the engine owns
+        # the queued/requeued/rejected part of a request's story, the
+        # batcher the rest — one monitor sees both. Inherits the batcher's
+        # when not given so a single attach() wires the whole stack.
+        self._monitor = monitor if monitor is not None else getattr(
+            batcher, "_monitor", None
+        )
+        # ticket -> original request for tickets admitted with interleaved
+        # prefill — the only preemptable kind (see preempt); dropped on
+        # preempt-resubmit consumption or release().
+        self._preemptable: dict[int, _Queued] = {}
+        # preempted tickets requeue at the HEAD of their priority class:
+        # strictly decreasing negative seqs sort before every arrival seq
+        self._front_seq = 0
         # Queue-level instrumentation (docs/observability.md): the batcher
         # covers decode cadence; the engine covers what happens BEFORE a
         # request reaches a batch row — depth, wait, capacity bounce-backs.
@@ -117,6 +133,8 @@ class Engine:
             "holdback": dict(self._holdback),
             "next_seq": self._next_seq,
             "next_ticket": self._next_ticket,
+            "preemptable": copy.deepcopy(self._preemptable),
+            "front_seq": self._front_seq,
         }
 
     def load_state_dict(self, state: dict) -> None:
@@ -131,6 +149,9 @@ class Engine:
         self._holdback = dict(state["holdback"])
         self._next_seq = state["next_seq"]
         self._next_ticket = state["next_ticket"]
+        # .get(): snapshots from before the preemption API lack these keys
+        self._preemptable = copy.deepcopy(state.get("preemptable", {}))
+        self._front_seq = state.get("front_seq", 0)
         # max_queue is POLICY, not serving state: the receiving engine's
         # configured bound stays (a snapshot must not smuggle in an old
         # overload policy)
@@ -162,11 +183,14 @@ class Engine:
         if self.max_queue is not None and len(self._queued) >= self.max_queue:
             if self._metrics is not None:
                 self._rejected_total.inc()
+            if self._monitor is not None:
+                self._monitor.on_ticket_rejected("queue_full")
             raise RuntimeError(f"queue full ({self.max_queue})")
         req = _Queued(
             prompt, max_new_tokens, sampling, prefill_chunk, adapter,
             pages_needed=pages_needed,
             interleave_admission=interleave_admission,
+            priority=priority,
         )
         ticket = self._next_ticket
         self._next_ticket += 1
@@ -184,7 +208,13 @@ class Engine:
         self._holdback[ticket] = max((len(s) for s in stops), default=1) - 1
         if self._metrics is not None:
             self._ticket_submit_t[ticket] = time.monotonic()
+        if self._monitor is not None:
+            self._monitor.on_ticket_queued(ticket)
         return ticket
+
+    def set_monitor(self, monitor) -> None:
+        """Attach a lifecycle monitor (ServingMonitor.attach calls this)."""
+        self._monitor = monitor
 
     # -------------------------------------------------------------- admit
     def _admit_ready(self) -> None:
@@ -210,6 +240,12 @@ class Engine:
                 return
             heapq.heappop(self._heap)
             self._queued.discard(ticket)
+            if self._monitor is not None:
+                # BEFORE the submit: the monitor stages this ticket's queue
+                # wait so the lifecycle record born inside the call starts
+                # its clock at engine intake (blocking admission fixes TTFT
+                # before submit returns)
+                self._monitor.on_ticket_admitting(ticket)
             try:
                 rid = self.batcher.submit(
                     req.prompt, req.max_new_tokens, sampling=req.sampling,
@@ -228,6 +264,8 @@ class Engine:
                 self._queued.add(ticket)
                 if self._metrics is not None:
                     self._requeues_total.inc()
+                if self._monitor is not None:
+                    self._monitor.on_ticket_requeued(ticket)
                 return
             except Exception as e:
                 # validate_request ran at intake, so this "cannot happen";
@@ -236,8 +274,14 @@ class Engine:
                 # forever and taking the whole step loop down
                 self._state[ticket] = ("error", repr(e))
                 self._ticket_submit_t.pop(ticket, None)
+                if self._monitor is not None:
+                    self._monitor.on_ticket_failed(ticket, repr(e))
                 continue
             self._state[ticket] = rid
+            if req.interleave_admission is not None:
+                # only interleaved admissions are preemptable mid-prefill;
+                # keep the request so preempt() can requeue it verbatim
+                self._preemptable[ticket] = req
             if self._metrics is not None:
                 t0 = self._ticket_submit_t.pop(ticket, None)
                 if t0 is not None:
@@ -355,6 +399,37 @@ class Engine:
         self._stream_cursor[ticket] = limit
         return list(tokens[cursor:limit])
 
+    def preempt(self, ticket: int) -> bool:
+        """Evict an admitted ticket whose INTERLEAVED prefill hasn't
+        produced a token yet, back to the HEAD of its priority class (it
+        already earned its pages once; making it re-race arrivals would
+        starve long prompts under load). The batcher frees its pages and
+        forgets the old request id; re-admission recomputes the prefill —
+        exact, because nothing was emitted. Returns False for queued,
+        finished, decoding (use :meth:`cancel` to stop those and keep their
+        partial output) or blocking-admitted tickets; an unknown ticket
+        raises KeyError — the same contract as :meth:`result`."""
+        rid = self._rid(ticket)
+        if not isinstance(rid, int):
+            return False
+        req = self._preemptable.pop(ticket, None)
+        if req is None or not self.batcher.preempt(rid):
+            return False
+        self._front_seq -= 1
+        heapq.heappush(
+            self._heap, (-req.priority, self._front_seq, ticket, req)
+        )
+        self._queued.add(ticket)
+        self._state[ticket] = "queued"
+        self._stream_cursor[ticket] = 0
+        if self._metrics is not None:
+            # queue wait re-measures from the preemption, matching the
+            # monitor's fresh queued clock below
+            self._ticket_submit_t[ticket] = time.monotonic()
+        if self._monitor is not None:
+            self._monitor.on_ticket_queued(ticket)
+        return True
+
     def cancel(self, ticket: int) -> None:
         """Cancel queued (never touches the device) or admitted (pages
         freed mid-decode) work; racing completion is a no-op."""
@@ -365,6 +440,8 @@ class Engine:
             self._stream_cursor.pop(ticket, None)
             self._holdback.pop(ticket, None)
             self._ticket_submit_t.pop(ticket, None)
+            if self._monitor is not None:
+                self._monitor.on_ticket_cancelled(ticket)
             return
         if rid != "cancelled" and not isinstance(rid, tuple):
             self.batcher.cancel(rid)
@@ -377,3 +454,4 @@ class Engine:
             self.batcher.release(rid)
         self._stream_cursor.pop(ticket, None)
         self._holdback.pop(ticket, None)
+        self._preemptable.pop(ticket, None)

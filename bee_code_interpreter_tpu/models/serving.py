@@ -60,6 +60,7 @@ import functools
 import hashlib
 import time
 from collections import OrderedDict, deque
+from contextlib import nullcontext
 from dataclasses import dataclass
 
 import jax
@@ -328,6 +329,7 @@ class ContinuousBatcher:
         lora_scale: float = 1.0,
         mesh=None,
         metrics=None,
+        monitor=None,
     ) -> None:
         """``draft_params``/``draft_config`` switch the batcher into
         SPECULATIVE mode: every step, the draft proposes ``gamma`` greedy
@@ -587,6 +589,20 @@ class ContinuousBatcher:
         # the serving-quality numbers (Orca-style per-stage visibility);
         # occupancy/pages/tokens-per-second are the capacity ones.
         self._metrics = metrics
+        # ``monitor`` is a duck-typed observability.ServingMonitor (per-
+        # request lifecycle traces + step records + wide events); usually
+        # injected via monitor.attach(engine) -> set_monitor. None keeps
+        # every hook site a single falsy check.
+        self._monitor = monitor
+        # Lifetime telemetry counters the monitor's step records difference.
+        # Deliberately NOT serving state (excluded from _HOST_STATE, like
+        # the metrics cursors): a restored snapshot starts its telemetry
+        # from this process's zero.
+        self._pages_allocated = 0
+        self._pages_released = 0
+        self._prefill_tokens = 0
+        self._spec_accepted = 0
+        self._spec_rejected = 0
         self._t_submit: float | None = None
         if metrics is not None:
             from bee_code_interpreter_tpu.utils.metrics import (
@@ -662,6 +678,43 @@ class ContinuousBatcher:
         if delta > 0:
             self._tokens_total.inc(delta)
             self._tokens_counted = self.n_tokens_generated
+
+    def set_monitor(self, monitor) -> None:
+        """Attach (or detach, with None) a lifecycle monitor
+        (observability.ServingMonitor.attach calls this). Requests already
+        in flight are not traced retroactively."""
+        self._monitor = monitor
+
+    def kv_telemetry(self) -> dict:
+        """KV-cache pool telemetry (docs/observability.md "Serving
+        observability"): page accounting + slot-level internal
+        fragmentation from ``ops.paged_kv_cache.pool_telemetry``, plus the
+        prefix-chain reuse counters. Pure host bookkeeping — safe on every
+        scrape."""
+        from bee_code_interpreter_tpu.ops.paged_kv_cache import pool_telemetry
+
+        out = pool_telemetry(
+            block_table=self.block_table,
+            pos=self.pos,
+            active=self.active,
+            page_ref=self.page_ref,
+            page_size=self.page_size,
+            free_pages=len(self.free_pages),
+            parked_pages=len(self.evictable),
+            scratch_page=_SCRATCH_PAGE,
+        )
+        lookups = self.prefix_stats["lookups"]
+        hits = self.prefix_stats["hits"]
+        out["prefix"] = {
+            **self.prefix_stats,
+            "misses": lookups - hits,
+            "hit_ratio": hits / lookups if lookups else 0.0,
+            "indexed_pages": len(self.prefix_index),
+            "enabled": self.prefix_cache_enabled,
+        }
+        out["pages_allocated_total"] = self._pages_allocated
+        out["pages_released_total"] = self._pages_released
+        return out
 
     # ----------------------------------------------------- snapshot/resume
 
@@ -772,6 +825,13 @@ class ContinuousBatcher:
         if self._metrics is not None:
             self._tokens_counted = self.n_tokens_generated
             self._rate_samples.clear()
+        # Telemetry counters are per-process too, but the adopted page_ref
+        # table changes what "held" means here: realign so the step
+        # records' held_pages (allocated - released) keeps equaling the
+        # pool scan's ref>0 count from this point on.
+        self._pages_allocated = self._pages_released + int(
+            (self.page_ref > 0).sum()
+        )
 
     def _shard_pool(self, pool: dict) -> dict:
         """Shard a page pool's kv-head axis over the mesh's tp axis (axis 2
@@ -941,6 +1001,11 @@ class ContinuousBatcher:
         # toward the fresh-page budget nor be pickable by the allocator's
         # eviction. Refs are released if the capacity check then fails.
         for page in shared:
+            if self.page_ref[page] == 0:
+                # reviving a parked page re-enters "held": count it as an
+                # allocation so the churn counters stay symmetric with
+                # _release_page's 1 -> 0 accounting (held == alloc - rel)
+                self._pages_allocated += 1
             self.page_ref[page] += 1
             self.evictable.pop(page, None)
         available = len(self.free_pages) + len(self.evictable)
@@ -956,6 +1021,22 @@ class ContinuousBatcher:
             self.prefix_stats["pages_reused"] += matched
         row = int(free_rows[0])
         pages = shared + [self._alloc_page() for _ in range(n_need - matched)]
+        # The request id is born HERE, once admission is committed (row and
+        # pages secured): the lifecycle monitor needs it before the prefill
+        # runs, and both the blocking and interleaved paths share it.
+        req = self._next_request_id
+        self._next_request_id += 1
+        if self._monitor is not None:
+            self._monitor.on_submit(
+                req,
+                prompt_tokens=L,
+                max_new_tokens=max_new_tokens,
+                pages=n_need,
+                prefix_pages=matched,
+                adapter=adapter,
+                speculative=speculative,
+                interleaved=interleave_admission is not None,
+            )
 
         if interleave_admission is not None:
             # Deferred admission: no model runs now. The block-table row
@@ -981,8 +1062,6 @@ class ContinuousBatcher:
                 (1, self.block_table.shape[1]), _SCRATCH_PAGE, dtype=np.int32
             )
             bt_row[0, :n_need] = pages
-            req = self._next_request_id
-            self._next_request_id += 1
             self.results[req] = []
             self.done[req] = False
             self.prefill_state[row] = {
@@ -1029,32 +1108,37 @@ class ContinuousBatcher:
                 last_row = self._full_admit(
                     prompt, pages, L, speculative, prefill_chunk
                 )
-        except BaseException:
+        except BaseException as e:
             # a failed admission (prefill OOM, bad sampling params, ...)
             # must not leak its pages: the row never activated, so nothing
             # else will ever return them to the pool. Shared pages drop the
             # acquired ref (back to the LRU if nobody else holds them);
             # fresh ones go straight back to the free list. (Unlike
             # mid-decode, a user-callable error here PROPAGATES: submit is
-            # synchronous and no request id exists yet.)
+            # synchronous and the caller never receives the request id.)
             self.block_table[row, :] = _SCRATCH_PAGE
             for page in reversed(pages):
                 self._release_page(page)
+            if self._monitor is not None:
+                self._monitor.on_done(req, "error", tokens=0, error=repr(e))
             raise
+        self._prefill_tokens += L - matched * self.page_size
         self._t_submit = t_submit
         return self._activate_row(
             row, last_row, prompt, pages, hashes, L, sampling,
-            max_new_tokens, adapter_internal,
+            max_new_tokens, adapter_internal, req=req, propagate=True,
         )
 
     def _activate_row(
         self, row, last_row, prompt, pages, hashes, L, sampling,
-        max_new_tokens, adapter_internal, req=None,
+        max_new_tokens, adapter_internal, req, propagate=False,
     ) -> int:
         """Admission epilogue, shared by the blocking path and interleaved
         finalization: register prefix pages, sample the first token,
-        activate the row. ``req`` is pre-allocated on the interleaved path
-        (the caller got an id at submit); None allocates one."""
+        activate the row. ``req`` was allocated by ``submit``;
+        ``propagate`` re-raises first-token failures (the blocking path —
+        the caller never received the id) instead of recording them on the
+        ticket (interleaved finalization — submit returned long ago)."""
         sampling = sampling or SamplingParams()
         try:
             # rng construction INSIDE the protected region: a bad seed
@@ -1068,24 +1152,26 @@ class ContinuousBatcher:
             self.block_table[row, :] = _SCRATCH_PAGE
             for page in reversed(pages):
                 self._release_page(page)
-            if req is None:
-                req = self._next_request_id
-                self._next_request_id += 1
             self.results[req] = []
             if sampling.logprobs:
                 self.results_logprobs[req] = []
             self.done[req] = True
             self.finish[req] = "constraint"
+            if self._monitor is not None:
+                self._monitor.on_done(req, "constraint", tokens=0)
             return req
         except BaseException as _activation_error:
             # user-callable failure at the first token: release the pages
-            # either way; blocking submit PROPAGATES (no id exists from
-            # the caller's view), interleaved finalization records the
-            # error on the ticket (submit returned long ago)
+            # either way; blocking submit PROPAGATES, interleaved
+            # finalization records the error on the ticket
             self.block_table[row, :] = _SCRATCH_PAGE
             for page in reversed(pages):
                 self._release_page(page)
-            if req is None:
+            if self._monitor is not None:
+                self._monitor.on_done(
+                    req, "error", tokens=0, error=repr(_activation_error)
+                )
+            if propagate:
                 raise
             self.done[req] = True
             self.finish[req] = "error"
@@ -1115,9 +1201,6 @@ class ContinuousBatcher:
                         self.free_pages.append(prev)
                 self.prefix_index[hashes[j]] = page
                 self.page_hash[page] = hashes[j]
-        if req is None:
-            req = self._next_request_id
-            self._next_request_id += 1
         self.pos[row] = L
         self.current[row, 0] = first
         self.budget[row] = max_new_tokens
@@ -1127,9 +1210,26 @@ class ContinuousBatcher:
         self.row_rng[row] = rng
         self.results[req] = [first]
         self.n_tokens_generated += 1
+        if self._monitor is not None:
+            # first token exists: the prefill span closes, TTFT is fixed,
+            # and the decode span opens — BEFORE the metric observation so
+            # the exemplar context below finds the live record.
+            self._monitor.on_first_token(req)
         if self._metrics is not None:
             if self._t_submit is not None:
-                self._ttft_seconds.observe(time.monotonic() - self._t_submit)
+                # Observed under the request's serving trace (when a
+                # monitor is attached) so the OpenMetrics exemplar on
+                # bci_serving_ttft_seconds names the same trace_id the wide
+                # event and /v1/traces carry.
+                ctx = (
+                    self._monitor.exemplar_context(req)
+                    if self._monitor is not None
+                    else nullcontext()
+                )
+                with ctx:
+                    self._ttft_seconds.observe(
+                        time.monotonic() - self._t_submit
+                    )
                 self._t_submit = None
             self._sync_token_counter()
         if sampling.logprobs:
@@ -1155,6 +1255,7 @@ class ContinuousBatcher:
             bt_row = jnp.asarray(rec["bt_row"])
             win_arr = jnp.asarray(win[None, :])
             pos_arr = jnp.asarray([rec["pos"]], dtype=np.int32)
+            t_win = time.monotonic()
             logits, self.cache = self._window(
                 self.params, win_arr, pos_arr, self.cache, bt_row,
                 **self._lora_kwargs(np.array([rec["adapter_internal"]])),
@@ -1168,6 +1269,13 @@ class ContinuousBatcher:
             if 0 <= idx < win.shape[0]:
                 rec["last_row"] = np.asarray(logits[0, idx], dtype=np.float32)
             rec["pos"] += int(win.shape[0])
+            self._prefill_tokens += int(win.shape[0])
+            if self._monitor is not None:
+                self._monitor.on_prefill_window(
+                    rec["req"],
+                    tokens=int(win.shape[0]),
+                    duration_s=time.monotonic() - t_win,
+                )
             if done_tokens + rec["width"] >= len(rec["suffix"]):
                 # prefill complete: publish the pages and activate
                 del self.prefill_state[row]
@@ -1378,6 +1486,7 @@ class ContinuousBatcher:
                 del self.prefix_index[h]
             self.prefix_stats["evictions"] += 1
         self.page_ref[page] = 1
+        self._pages_allocated += 1
         return page
 
     def _release_page(self, page: int) -> None:
@@ -1386,6 +1495,7 @@ class ContinuousBatcher:
         self.page_ref[page] -= 1
         if self.page_ref[page] > 0:
             return
+        self._pages_released += 1  # leaves "held" (parks or frees below)
         h = self.page_hash.get(page)
         if h is not None and self.prefix_index.get(h) == page:
             self.evictable[page] = None  # MRU end
@@ -1404,24 +1514,59 @@ class ContinuousBatcher:
         wall time, the per-row inter-token latency (step time scaled by how
         many tokens each row committed — one in plain mode, the accept
         length in speculative mode), and the throughput window the
-        tokens-per-second gauge reads."""
-        if self._metrics is None:
+        tokens-per-second gauge reads. With a lifecycle monitor attached,
+        each step additionally lands one step record (occupancy, token
+        counts, speculative accepts, page churn — see
+        docs/observability.md "Serving observability")."""
+        if self._metrics is None and self._monitor is None:
             self._step_inner()
             return
-        rows_before = int(self.active.sum())
+        rows_before = int(np.count_nonzero(self.active))
+        prefilling_before = len(self.prefill_state)
         tokens_before = self.n_tokens_generated
+        prefill_before = self._prefill_tokens
+        spec_acc_before = self._spec_accepted
+        spec_rej_before = self._spec_rejected
+        alloc_before = self._pages_allocated
+        released_before = self._pages_released
         t0 = time.monotonic()
         self._step_inner()
         t1 = time.monotonic()
         produced = self.n_tokens_generated - tokens_before
-        self._step_seconds.observe(t1 - t0)
-        if produced:
-            if rows_before:
-                self._inter_token_seconds.observe(
-                    (t1 - t0) * rows_before / produced
-                )
-            self._rate_samples.append((t1, self.n_tokens_generated))
-        self._sync_token_counter()
+        if self._metrics is not None:
+            self._step_seconds.observe(t1 - t0)
+            if produced:
+                if rows_before:
+                    self._inter_token_seconds.observe(
+                        (t1 - t0) * rows_before / produced
+                    )
+                self._rate_samples.append((t1, self.n_tokens_generated))
+            self._sync_token_counter()
+        if self._monitor is not None:
+            # occupancy is deliberately NOT a field: it is active_rows /
+            # max_batch, and the step path builds this record thousands of
+            # times a second — derivable values are the reader's job
+            self._monitor.on_step(
+                {
+                    "duration_ms": (t1 - t0) * 1000.0,
+                    "active_rows": rows_before,
+                    "active_rows_after": int(np.count_nonzero(self.active)),
+                    "prefilling_rows": prefilling_before,
+                    "max_batch": int(self.active.shape[0]),
+                    "decode_tokens": produced,
+                    "prefill_tokens": self._prefill_tokens - prefill_before,
+                    "spec_accepted": self._spec_accepted - spec_acc_before,
+                    "spec_rejected": self._spec_rejected - spec_rej_before,
+                    "pages_allocated": self._pages_allocated - alloc_before,
+                    "pages_released": self._pages_released - released_before,
+                    "free_pages": len(self.free_pages),
+                    "parked_pages": len(self.evictable),
+                    # allocated-minus-released IS the held count (a release
+                    # is counted exactly when a page's refcount hits 0):
+                    # integer math instead of a page_ref scan per step
+                    "held_pages": self._pages_allocated - self._pages_released,
+                }
+            )
 
     def _step_inner(self) -> None:
         if self.prefill_state:
@@ -1567,6 +1712,12 @@ class ContinuousBatcher:
         greedy and sampled rounds so their semantics cannot drift."""
         sp = self.row_sampling[row]
         req = int(self.row_request[row])
+        self._spec_accepted += n
+        self._spec_rejected += self.gamma - n
+        if self._monitor is not None:
+            self._monitor.on_commit(
+                req, accepted=n, rejected=self.gamma - n
+            )
         out = self.results[req]
         lp = self.results_logprobs.get(req) if sp.logprobs else None
         for j, tok_committed in enumerate(commit):
@@ -1703,6 +1854,10 @@ class ContinuousBatcher:
             self._release_page(page)
         self.block_table[row, :] = _SCRATCH_PAGE
         # pos stays for inspection; scratch-page writes are masked
+        if self._monitor is not None:
+            self._monitor.on_done(
+                req, reason, tokens=len(out), error=self.errors.get(req)
+            )
 
     # -------------------------------------------------------------- results
     @property
@@ -1796,9 +1951,33 @@ class ContinuousBatcher:
                 self.finish[request_id] = "cancelled"
                 if rec["sampling"] is not None and rec["sampling"].logprobs:
                     self.results_logprobs[request_id] = []
+                if self._monitor is not None:
+                    self._monitor.on_done(request_id, "cancelled", tokens=0)
                 return
         if request_id not in self.done:
             raise KeyError(f"unknown request {request_id}")
+
+    def preempt(self, request_id: int) -> bool:
+        """Evict a request whose INTERLEAVED admission is still prefilling:
+        its pages free immediately and the request is erased as if never
+        submitted (the id is dead; the caller re-submits the same prompt
+        later and the prefill recomputes — vLLM-style recompute preemption,
+        restricted to the pre-first-token window where recomputation is
+        trivially exact because there is nothing else to reproduce).
+        Returns False once the request has produced a token (decoding),
+        finished, or is unknown — callers that need to stop a decoding
+        request want :meth:`cancel`, which keeps its partial output."""
+        for row, rec in list(self.prefill_state.items()):
+            if rec["req"] == request_id:
+                del self.prefill_state[row]
+                for page in reversed(rec["pages"]):
+                    self._release_page(page)
+                self.results.pop(request_id, None)
+                self.done.pop(request_id, None)
+                if self._monitor is not None:
+                    self._monitor.on_preempt(request_id)
+                return True
+        return False
 
     def release(self, request_id: int) -> None:
         """Drop a finished request's stored result (pages were already

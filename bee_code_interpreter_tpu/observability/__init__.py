@@ -45,6 +45,9 @@ from bee_code_interpreter_tpu.observability.profiling import (
     inject_profile_env,
     profile_artifacts,
 )
+from bee_code_interpreter_tpu.observability.serving_trace import (
+    ServingMonitor,
+)
 from bee_code_interpreter_tpu.observability.tracing import (
     REQUEST_ID_HEADER,
     TRACEPARENT_HEADER,
@@ -52,6 +55,7 @@ from bee_code_interpreter_tpu.observability.tracing import (
     Trace,
     Tracer,
     TraceStore,
+    activate_trace,
     current_ids,
     current_span,
     current_trace,
@@ -93,8 +97,10 @@ __all__ = [
     "ProfilerUnavailable",
     "REQUEST_ID_HEADER",
     "SANDBOX_PROFILE_DIR",
+    "ServingMonitor",
     "ServingProfiler",
     "SloEngine",
+    "activate_trace",
     "TelemetryExporter",
     "TransferAccounting",
     "UsageMeter",

@@ -56,10 +56,12 @@ def build_debug_bundle(
     recorder=None,
     loopmon=None,
     contprof=None,
+    serving=None,
     recent_traces: int = 50,
     slowest_traces: int = 10,
     fleet_events: int = 100,
     recent_events: int = 50,
+    serving_steps: int = 16,
 ) -> dict:
     """Assemble the bundle from whatever components exist; every section is
     present (null/empty when its component isn't wired) so consumers parse
@@ -120,6 +122,13 @@ def build_debug_bundle(
         "tasks": task_inventory(),
     }
     bundle["profile"] = contprof.snapshot() if contprof is not None else None
+
+    # Serving-engine deep observability (docs/observability.md "Serving
+    # observability"): batcher/queue aggregates, KV-cache telemetry, and
+    # the last few step records next to everything else an incident needs.
+    bundle["serving"] = (
+        serving.snapshot(steps=serving_steps) if serving is not None else None
+    )
 
     bundle["config"] = config.redacted_dump() if config is not None else None
     bundle["metrics"] = metrics.expose() if metrics is not None else None

@@ -204,15 +204,10 @@ def _gauge_otlp(metric: Gauge, now: str) -> dict:
 def _histogram_otlp(metric: Histogram, now: str, start: str) -> dict:
     points = []
     for key in sorted(metric._totals):
-        cumulative = metric._counts.get(key, [0] * len(metric._buckets))
         total = metric._totals[key]
-        # Our buckets are Prometheus-cumulative; OTLP wants per-bucket counts
-        # with one overflow bucket beyond the last explicit bound.
-        per_bucket = [
-            c - (cumulative[i - 1] if i else 0)
-            for i, c in enumerate(cumulative)
-        ]
-        per_bucket.append(total - (cumulative[-1] if cumulative else 0))
+        # OTLP wants per-bucket counts with one overflow bucket beyond the
+        # last explicit bound — exactly the histogram's native accessor.
+        per_bucket = metric.per_bucket_counts(key)
         points.append(
             {
                 "attributes": [_attr(k, v) for k, v in key],

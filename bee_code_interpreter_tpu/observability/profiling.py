@@ -75,6 +75,14 @@ class ServingProfiler:
     def capturing(self) -> bool:
         return self._capturing
 
+    @property
+    def available(self) -> bool:
+        """True when the stepper can actually step. A stepper may expose
+        its own ``available`` (the ServingMonitor reports False until an
+        engine attaches — the edge answers 501, not a capture error);
+        steppers without the attribute are assumed ready."""
+        return bool(getattr(self._stepper, "available", True))
+
     def capture(self, steps: int) -> dict:
         """Run ``steps`` stepper steps under a profiler trace; returns
         ``{trace_dir, files, steps, duration_ms}`` with ``files`` relative

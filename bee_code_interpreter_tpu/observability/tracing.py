@@ -258,7 +258,12 @@ class Tracer:
     they attach through the ambient context via :func:`span`."""
 
     def __init__(self, store: TraceStore | None = None, metrics=None) -> None:
-        self.store = store or TraceStore()
+        # `store or TraceStore()` would discard a passed-in EMPTY store
+        # (TraceStore defines __len__, and a fresh store is len 0 — falsy):
+        # the composition root's configured ring sizes silently never
+        # applied, and a second consumer sharing ctx.trace_store (the
+        # serving monitor) saw a different store than the edge served.
+        self.store = store if store is not None else TraceStore()
         # Finished-trace sinks (the telemetry exporter's enqueue, say): each
         # gets the whole Trace right after it lands in the store. Sinks MUST
         # be cheap and non-blocking — they run on the request path.
@@ -357,6 +362,25 @@ def span(name: str, **attributes):
         trace.end_span(s)
     finally:
         _current_span.reset(token)
+
+
+@contextmanager
+def activate_trace(trace: Trace, span: Span | None = None):
+    """Make an externally-managed trace the ambient one for the duration.
+
+    The request path gets its ambient trace from :meth:`Tracer.trace`; code
+    that manages traces by hand — the serving monitor's per-request
+    lifecycle traces live across many batcher steps, far outside any one
+    call stack — uses this to scope a metric observation (histogram
+    exemplars read the ambient ids) or a log line to a specific trace
+    without adopting the context-manager lifecycle."""
+    trace_token = _current_trace.set(trace)
+    span_token = _current_span.set(span or trace.root)
+    try:
+        yield trace
+    finally:
+        _current_span.reset(span_token)
+        _current_trace.reset(trace_token)
 
 
 def current_trace() -> Trace | None:

@@ -33,8 +33,60 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from bee_code_interpreter_tpu.ops.kv_cache import quantize
+
+
+def pool_telemetry(
+    *,
+    block_table: np.ndarray,  # [B, P] int32, scratch-page entries for holes
+    pos: np.ndarray,  # [B] int32 decode cursors (tokens written per row)
+    active: np.ndarray,  # [B] bool
+    page_ref: np.ndarray,  # [n_pages] int32 refcounts
+    page_size: int,
+    free_pages: int,
+    parked_pages: int,
+    scratch_page: int = 0,
+) -> dict:
+    """Host-side page-pool telemetry (docs/observability.md "Serving
+    observability") — pure integer bookkeeping over the scheduler's own
+    state, zero device traffic, cheap enough for every ``/metrics`` scrape.
+
+    ``fragmentation`` is slot-level INTERNAL fragmentation of the pages
+    active rows hold: ``1 - used_slots / allocated_slots``. A page holds
+    ``page_size`` K/V slots but a row's cursor covers only ``pos`` of the
+    slots its pages reserve — the tail of the last page (and budget-sized
+    over-allocation) is capacity the pool cannot hand to anyone else.
+    Prefix-shared pages are counted once per HOLDER (each sharer's table
+    maps them), which is deliberate: the metric describes how efficiently
+    *reserved* capacity is used, and a shared page is reserved by every
+    sharer's admission arithmetic. ``pages_shared`` (refcount > 1) reports
+    the sharing itself.
+    """
+    n_pages = int(page_ref.shape[0])
+    held = int((page_ref > 0).sum())
+    shared = int((page_ref > 1).sum())
+    slots_allocated = 0
+    slots_used = 0
+    for row in np.flatnonzero(active):
+        row_pages = int((block_table[row] != scratch_page).sum())
+        slots_allocated += row_pages * page_size
+        slots_used += int(pos[row])
+    fragmentation = (
+        1.0 - slots_used / slots_allocated if slots_allocated else 0.0
+    )
+    return {
+        "pages_total": n_pages - 1,  # the scratch page is never allocatable
+        "pages_free": free_pages,
+        "pages_parked": parked_pages,
+        "pages_held": held,
+        "pages_shared": shared,
+        "page_size": page_size,
+        "slots_allocated": slots_allocated,
+        "slots_used": slots_used,
+        "fragmentation": fragmentation,
+    }
 
 
 def alloc_paged_cache(config, n_pages: int, page_size: int) -> dict:

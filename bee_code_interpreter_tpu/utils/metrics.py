@@ -17,6 +17,7 @@ spike straight to ``GET /v1/traces/{id}`` (docs/observability.md).
 from __future__ import annotations
 
 import time
+from bisect import bisect_left
 from collections import defaultdict
 from typing import Callable, Iterable
 
@@ -163,6 +164,11 @@ class Histogram:
     ) -> None:
         self.name, self.help = name, help_text
         self._buckets = tuple(sorted(buckets))
+        # PER-BUCKET (non-cumulative) counts, one overflow-free list per
+        # label set; the Prometheus-cumulative view is produced at collect
+        # time. observe() is the serving hot path (called per batcher step
+        # and per token-latency sample): a bisect + one increment beats
+        # walking every bucket bound per observation ~10x in-loop.
         self._counts: dict[tuple, list[int]] = {}
         self._sums: dict[tuple, float] = defaultdict(float)
         self._totals: dict[tuple, int] = defaultdict(int)
@@ -172,21 +178,31 @@ class Histogram:
         self._exemplars: dict[tuple, dict[str, tuple[float, str, str, float]]] = {}
 
     def observe(self, value: float, **labels: str) -> None:
-        key = tuple(sorted(labels.items()))
-        counts = self._counts.setdefault(key, [0] * len(self._buckets))
-        exemplar_le = None
-        for i, bound in enumerate(self._buckets):
-            if value <= bound:
-                counts[i] += 1
-                if exemplar_le is None:
-                    exemplar_le = f"{bound:g}"
+        key = tuple(sorted(labels.items())) if labels else ()
+        counts = self._counts.get(key)
+        if counts is None:
+            counts = self._counts.setdefault(key, [0] * len(self._buckets))
+        # first bucket whose bound >= value (le semantics); == len(buckets)
+        # means only the implicit +Inf bucket catches it
+        idx = bisect_left(self._buckets, value)
+        if idx < len(counts):
+            counts[idx] += 1
         self._sums[key] += value
         self._totals[key] += 1
         ids = _active_trace_ids()
         if ids is not None:
-            self._exemplars.setdefault(key, {})[exemplar_le or "+Inf"] = (
+            exemplar_le = (
+                f"{self._buckets[idx]:g}" if idx < len(counts) else "+Inf"
+            )
+            self._exemplars.setdefault(key, {})[exemplar_le] = (
                 value, ids[0], ids[1], time.time(),
             )
+
+    def per_bucket_counts(self, key: tuple) -> list[int]:
+        """Non-cumulative per-bucket counts for one label set, with the
+        overflow (+Inf) bucket appended — the shape OTLP wants."""
+        counts = self._counts.get(key, [0] * len(self._buckets))
+        return [*counts, self._totals[key] - sum(counts)]
 
     def time(self, **labels: str) -> "_Timer":
         return _Timer(self, labels)
@@ -207,11 +223,13 @@ class Histogram:
         for key in sorted(self._totals):
             base = dict(key)
             counts = self._counts.get(key, [0] * len(self._buckets))
+            cumulative = 0
             for bound, c in zip(self._buckets, counts):
+                cumulative += c
                 le = f"{bound:g}"
                 yield (
                     f"{self.name}_bucket"
-                    f"{_fmt_labels({**base, 'le': le})} {c}"
+                    f"{_fmt_labels({**base, 'le': le})} {cumulative}"
                     + (self._exemplar_suffix(key, le) if openmetrics else "")
                 )
             yield (

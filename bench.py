@@ -11,8 +11,10 @@ reference's CPU path is the comparison baseline").
 Prints ONE JSON line:
     {"metric": ..., "value": N, "unit": "GFLOPS", "vs_baseline": N,
      "latency_warm_p50_ms": N | null, "cpu_baseline_gflops": N,
-     "serving_smoke": {...} when the continuous-batching stack ran
-     solo-equal through the service path, "hardware_evidence": [...]}
+     "serving": {...} when the continuous-batching stack ran through the
+     service path (tokens/sec, TTFT p50/p95, inter-token latency, and a
+     measured instrumentation on/off overhead — models/serving_bench.py),
+     "hardware_evidence": [...]}
 
 Extra detail lines go to stderr.
 
@@ -187,39 +189,19 @@ flops = 2 * B * H * L * L * D  # causal: half of 4*B*H*L*L*D
 print(f"RESULT_FLASH {flops / t_fl / 1e12:.2f} {flops / t_xl / 1e12:.2f}")
 """
 
-# Serving-stack smoke through the service path: a tiny continuous-batching
-# run (admission + paged decode + retirement) whose outputs are asserted
-# equal to solo decode INSIDE the payload, reporting steady-state tokens/s
-# on already-compiled programs. CPU-pinned: the point is proving the
-# serving stack end-to-end in every artifact, not a hardware number (the
-# hardware serving battery is scripts/bench-decode.py's ledger rows).
+# Serving phase through the service path (ROADMAP item 4: "a tokens/sec +
+# TTFT trajectory alongside warm-execute p50"): a continuous-batching run
+# on already-compiled programs, measured with the full observability stack
+# attached AND bare, so every artifact carries tokens/sec, TTFT p50/p95,
+# inter-token latency, and the MEASURED instrumentation overhead. The
+# arithmetic lives in models/serving_bench.py (shared with the tier-1
+# suite); arm-equality and pass-to-pass determinism are asserted inside.
+# CPU-pinned: the point is a stable trajectory of the serving STACK, not a
+# hardware number (that battery is scripts/bench-decode.py's ledger rows).
 SERVING_PAYLOAD = """
-import dataclasses, time
-import jax, jax.numpy as jnp, numpy as np
-from bee_code_interpreter_tpu.models import transformer as T
-from bee_code_interpreter_tpu.models.serving import ContinuousBatcher
-
-cfg = dataclasses.replace(T.TransformerConfig.tiny(), dtype=jnp.float32,
-                          n_kv_heads=2)
-params = T.init_params(cfg, jax.random.PRNGKey(0))
-prompts = [[int(x) for x in np.random.default_rng(i).integers(0, 200, 5 + i)]
-           for i in range(4)]
-want = []
-for p in prompts:
-    out = T.Transformer(cfg).generate_cached(
-        params, jnp.asarray(p)[None], max_new_tokens=8)
-    want.append(np.asarray(out[0, len(p):]).tolist())
-b = ContinuousBatcher(params, cfg, max_batch=4, n_pages=32, page_size=4,
-                      max_pages_per_seq=6)
-tix = [b.submit(p, 8) for p in prompts]
-b.run_to_completion()  # includes every compile
-assert all(b.result(t) == w for t, w in zip(tix, want)), "solo-equality broke"
-t0 = time.perf_counter()  # steady state: rows + pages recycle, no re-trace
-tix = [b.submit(p, 8) for p in prompts]
-b.run_to_completion()
-dt = time.perf_counter() - t0
-assert all(b.result(t) == w for t, w in zip(tix, want)), "solo-equality broke"
-print("RESULT_SERVING", 4 * 8 / dt)
+import json
+from bee_code_interpreter_tpu.models.serving_bench import run_serving_bench
+print("RESULT_SERVING_JSON", json.dumps(run_serving_bench()))
 """
 
 
@@ -292,13 +274,10 @@ async def run_payload_values(
     return (await run_payload_multi(source, env, timeout_s, (marker,)))[marker]
 
 
-async def run_payload_multi(
-    source: str, env: dict[str, str], timeout_s: float,
-    markers: tuple[str, ...],
-) -> dict[str, list[float]]:
-    """Execute ONCE through the service path; return the floats following
-    each ``marker`` line (one executor run can carry several measurements —
-    scripts/bench-mfu.py's train + decode share a payload)."""
+async def _run_payload_result(source: str, env: dict[str, str], timeout_s: float):
+    """One execution through the service path — the scaffold the marker
+    parsers below share; raises PayloadError (stderr attached) on a
+    nonzero exit."""
     from bee_code_interpreter_tpu.services.local_code_executor import (
         LocalCodeExecutor,
     )
@@ -318,6 +297,17 @@ async def run_payload_multi(
         raise PayloadError(
             f"payload failed (exit {result.exit_code})", stderr=result.stderr
         )
+    return result
+
+
+async def run_payload_multi(
+    source: str, env: dict[str, str], timeout_s: float,
+    markers: tuple[str, ...],
+) -> dict[str, list[float]]:
+    """Execute ONCE through the service path; return the floats following
+    each ``marker`` line (one executor run can carry several measurements —
+    scripts/bench-mfu.py's train + decode share a payload)."""
+    result = await _run_payload_result(source, env, timeout_s)
     out: dict[str, list[float]] = {}
     for line in result.stdout.splitlines():
         for marker in markers:
@@ -329,6 +319,19 @@ async def run_payload_multi(
             f"no {missing} in stdout: {result.stdout!r}"
         )
     return out
+
+
+async def run_payload_json(
+    source: str, env: dict[str, str], timeout_s: float, marker: str
+) -> dict:
+    """Execute through the service path; return the JSON object following
+    ``marker`` on the payload's result line (structured measurements — the
+    serving phase reports a whole dict, not a float tuple)."""
+    result = await _run_payload_result(source, env, timeout_s)
+    for line in result.stdout.splitlines():
+        if line.startswith(marker):
+            return json.loads(line[len(marker):])
+    raise PayloadError(f"no {marker} in stdout: {result.stdout!r}")
 
 
 def scrub_tunnel_vars() -> None:
@@ -870,21 +873,24 @@ def main() -> None:
     except Exception as e:
         print(f"streaming TTFB measurement failed: {e}", file=sys.stderr)
 
-    # --- 3b. serving-stack smoke (guarded; extra field only) ---------------
-    serving_smoke: dict | None = None
+    # --- 3b. serving phase (guarded; extra field only): tokens/sec + TTFT
+    # p50/p95 + inter-token latency with a measured instrumentation on/off
+    # A/B (models/serving_bench.py; docs/observability.md "Serving
+    # observability") -------------------------------------------------------
+    serving: dict | None = None
     try:
-        toks = asyncio.run(run_payload_values(
-            SERVING_PAYLOAD, {"JAX_PLATFORMS": "cpu"}, timeout_s=300.0,
-            marker="RESULT_SERVING",
-        ))[0]
-        serving_smoke = {
-            "tokens_per_s": round(toks, 1),
-            "config": "tiny f32, 4 rows, paged pool, cpu",
-            "solo_equal": True,  # asserted inside the payload
-        }
-        print(f"serving smoke: {serving_smoke}", file=sys.stderr)
+        # PYTHONPATH carries the repo into the sandbox: the payload imports
+        # the serving stack itself, and the executor drops the host's
+        # import path (request-supplied entries survive the scrub)
+        serving = asyncio.run(run_payload_json(
+            SERVING_PAYLOAD,
+            {"JAX_PLATFORMS": "cpu", "PYTHONPATH": str(REPO)},
+            timeout_s=420.0,
+            marker="RESULT_SERVING_JSON",
+        ))
+        print(f"serving bench: {serving}", file=sys.stderr)
     except Exception as e:
-        print(f"serving smoke failed (field omitted): {e}", file=sys.stderr)
+        print(f"serving bench failed (field omitted): {e}", file=sys.stderr)
 
     if tpu_gflops is not None:
         result = {
@@ -919,8 +925,8 @@ def main() -> None:
     result["streaming_ttfb_ms"] = (
         round(streaming_ttfb_ms, 1) if streaming_ttfb_ms is not None else None
     )
-    if serving_smoke is not None:
-        result["serving_smoke"] = serving_smoke
+    if serving is not None:
+        result["serving"] = serving
     result["cpu_baseline_gflops"] = round(cpu_gflops, 1)
     # "recorded" = the live CPU run failed and vs_baseline uses the recorded
     # machine-class figure — a constant must never masquerade as a measurement

@@ -46,6 +46,13 @@ wiring minus kubectl. Scenarios:
                             ring saturated mid-load: request latency is
                             unchanged, and emitted == exported +
                             dropped{reason} exactly for the logs signal
+ 12. serving saturation   — the serving engine is driven past queue
+                            capacity (and through an admission capacity
+                            race) while executor requests keep flowing:
+                            every bounce lands exactly once in the
+                            kind="serving" wide events AND the
+                            bci_serving_* counters AND the monitor totals,
+                            and the executor path's latency is unchanged
 
 Exits nonzero if any scenario misbehaves. Usage:
 
@@ -668,6 +675,113 @@ async def main() -> int:
         finally:
             await pods11.close()
 
+        # 12. serving saturation: the continuous-batching engine is driven
+        #     past queue capacity and through an admission capacity race
+        #     while executor requests keep flowing — every bounce accounted
+        #     exactly once across wide events / counters / monitor totals,
+        #     executor-path latency unchanged (fresh registry, tiny CPU
+        #     model; docs/observability.md "Serving observability").
+        import dataclasses
+
+        import numpy as np
+        import jax
+        import jax.numpy as jnp
+
+        from bee_code_interpreter_tpu.models import transformer as T
+        from bee_code_interpreter_tpu.models.engine import Engine
+        from bee_code_interpreter_tpu.models.serving import ContinuousBatcher
+        from bee_code_interpreter_tpu.observability import (
+            ServingMonitor,
+            TraceStore,
+        )
+
+        m12 = Registry()
+        recorder12 = FlightRecorder(max_events=64, metrics=m12)
+        monitor12 = ServingMonitor(
+            metrics=m12, store=TraceStore(), recorder=recorder12
+        )
+        cfg12 = dataclasses.replace(
+            T.TransformerConfig.tiny(), dtype=jnp.float32, n_kv_heads=2
+        )
+        batcher12 = ContinuousBatcher(
+            T.init_params(cfg12, jax.random.PRNGKey(0)), cfg12,
+            max_batch=2, n_pages=16, page_size=4, max_pages_per_seq=4,
+            metrics=m12,
+        )
+        engine12 = Engine(batcher12, max_queue=2, metrics=m12)
+        monitor12.attach(engine12)
+        executor12, _, _, pods12 = make_stack(tmp, storage, m12, clock)
+        try:
+            async def timed_execute(tag: str) -> float:
+                t0 = time.monotonic()
+                result = await executor12.execute(f"print('{tag}')")
+                assert result.stdout == f"{tag}\n"
+                return time.monotonic() - t0
+
+            pre = [await timed_execute(f"calm{i}") for i in range(3)]
+
+            long_prompt = [
+                int(x)
+                for x in np.random.default_rng(7).integers(0, 200, 9)
+            ]
+            # one admission capacity race: queue-level credit says go, the
+            # batcher's own page arithmetic says no -> requeue, not failure
+            tickets = [engine12.submit(long_prompt, 4)]
+            real_credit = batcher12.prefix_credit
+            free_backup = batcher12.free_pages
+            batcher12.prefix_credit = lambda prompt, adapter: 10_000
+            batcher12.free_pages = []
+            engine12._admit_ready()
+            batcher12.prefix_credit = real_credit
+            batcher12.free_pages = free_backup
+
+            tickets.append(engine12.submit([5, 3, 7, 2], 4))
+            rejections = 0
+            for _ in range(3):  # queue full (2): every further submit bounces
+                try:
+                    engine12.submit([1, 2, 3], 4)
+                except RuntimeError:
+                    rejections += 1
+            # decode runs off-loop while executor requests keep flowing
+            decode = asyncio.create_task(
+                asyncio.to_thread(engine12.run_to_completion)
+            )
+            during = [await timed_execute(f"busy{i}") for i in range(6)]
+            await decode
+            ok = all(len(engine12.result(t)) == 4 for t in tickets)
+
+            snap12 = monitor12.snapshot()
+            events12 = recorder12.events(kind="serving", limit=100)
+            by_name = {
+                name: len([e for e in events12 if e["name"] == name])
+                for name in (
+                    "serving.reject", "serving.requeue", "serving.request"
+                )
+            }
+            text12 = m12.expose()
+            report(
+                "every serving bounce accounted exactly once",
+                ok
+                and rejections == 3
+                and by_name["serving.reject"] == 3
+                and by_name["serving.requeue"] == 1
+                and by_name["serving.request"] == 2
+                and snap12["totals"]["rejected"] == 3
+                and snap12["totals"]["requeued"] == 1
+                and snap12["totals"]["finished"] == 2
+                and "bci_serving_queue_rejected_total 3" in text12
+                and "bci_serving_requeues_total 1" in text12,
+                f"events={by_name} totals={snap12['totals']}",
+            )
+            report(
+                "executor-path latency unchanged under serving saturation",
+                max(during) < max(max(pre) * 3, max(pre) + 0.3),
+                f"pre_max={max(pre) * 1000:.0f}ms "
+                f"during_max={max(during) * 1000:.0f}ms",
+            )
+        finally:
+            await pods12.close()
+
         text = metrics.expose()
         wanted = [
             "bci_executor_fallback_total 1",
@@ -691,7 +805,8 @@ async def main() -> int:
     print(
         "chaos smoke passed: deadline, breaker, fallback, admission, replay, "
         "supervisor, watchdog, drain, telemetry export, edge analysis gate, "
-        "sessions-under-chaos, flight-recorder-logs all behaved"
+        "sessions-under-chaos, flight-recorder-logs, serving-saturation all "
+        "behaved"
     )
     return 0
 

@@ -6,7 +6,7 @@ a one-line table row (or raw JSON with ``--json``) — `tail -f` for the
 service's request journal, with the same filters the API supports:
 
     python scripts/events-tail.py [--url http://localhost:50081]
-        [--outcome error] [--session sess-...] [--kind request]
+        [--outcome error] [--session sess-...] [--kind serving]
         [--min-duration-ms 500] [--backlog 20] [--json] [--once]
 
 ``--once`` skips the follow and prints the current snapshot instead.
@@ -45,6 +45,16 @@ def render(event: dict) -> str:
         extras.append(f"hedge={event['hedge']}")
     if event.get("kind") == "loop_stall":
         extras.append(f"lag={event.get('lag_s', 0) * 1000:.0f}ms")
+    serving = event.get("serving") or {}
+    if serving:
+        extras.append(
+            f"tokens={serving.get('prompt_tokens', 0)}"
+            f"+{serving.get('output_tokens', 0)}"
+        )
+        if serving.get("ttft_ms") is not None:
+            extras.append(f"ttft={serving['ttft_ms']:.1f}ms")
+        if serving.get("requeues"):
+            extras.append(f"requeues={serving['requeues']}")
     return (
         f"{fmt_ts(event.get('ts'))} {event.get('kind', '-'):<10} "
         f"{(event.get('name') or '-'):<32} {(event.get('outcome') or '-'):<12} "
@@ -83,7 +93,9 @@ def main() -> int:
     parser.add_argument("--url", default="http://localhost:50081")
     parser.add_argument("--outcome", help="filter by outcome (e.g. error)")
     parser.add_argument("--session", help="filter by session id")
-    parser.add_argument("--kind", help="filter by kind (request/session/loop_stall)")
+    parser.add_argument(
+        "--kind", help="filter by kind (request/session/serving/loop_stall)"
+    )
     parser.add_argument("--min-duration-ms", type=float, default=None)
     parser.add_argument(
         "--backlog", type=int, default=10,

@@ -199,7 +199,8 @@ def test_probe_runs_against_this_interpreter():
 def test_payloads_are_valid_python():
     # The TPU/flash payloads only execute on a healthy chip — a syntax error
     # would otherwise surface for the first time inside the driver's window.
-    for name in ("TPU_PAYLOAD", "CPU_PAYLOAD", "FLASH_PAYLOAD"):
+    for name in ("TPU_PAYLOAD", "CPU_PAYLOAD", "FLASH_PAYLOAD",
+                 "SERVING_PAYLOAD"):
         compile(getattr(bench, name), f"<{name}>", "exec")
 
 
@@ -211,6 +212,25 @@ def test_run_payload_values_parses_marker_floats():
         bench.run_payload_values(src, {}, timeout_s=30.0, marker="RESULT_FLASH")
     )
     assert vals == [12.5, 3.25]
+
+
+def test_run_payload_json_parses_marker_object():
+    import asyncio
+
+    src = "import json; print('RESULT_X', json.dumps({'a': 1.5, 'b': None}))"
+    got = asyncio.run(
+        bench.run_payload_json(src, {}, timeout_s=30.0, marker="RESULT_X")
+    )
+    assert got == {"a": 1.5, "b": None}
+
+
+def test_serving_payload_imports_library_code():
+    # The serving phase's arithmetic lives in models/serving_bench.py and
+    # is covered by the tier-1 test_serving_trace suite; this module only
+    # pins the payload↔library seam (the payload runs inside a sandbox
+    # whose import path is the request's PYTHONPATH, not the host's).
+    assert "serving_bench import run_serving_bench" in bench.SERVING_PAYLOAD
+    assert "RESULT_SERVING_JSON" in bench.SERVING_PAYLOAD
 
 
 def test_benchclock_chain_diff_guard():

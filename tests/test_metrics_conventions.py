@@ -106,6 +106,29 @@ def test_every_registered_metric_follows_conventions(tmp_path):
         # streaming promoted from bench-only numbers to production metrics
         "bci_stream_ttfb_seconds",
         "bci_stream_chunks_total",
+        # serving deep observability (ISSUE 9): the ServingMonitor's
+        # per-request rollups register in the composition root; the
+        # batcher/engine aggregates register at model wiring (the
+        # register_serving_metrics call above)
+        "bci_serving_requests_total",
+        "bci_serving_request_seconds",
+        "bci_serving_preemptions_total",
+        "bci_serving_spec_tokens_total",
+        "bci_serving_spec_accept_ratio",
+        "bci_serving_prefix_hit_ratio",
+        "bci_serving_page_fragmentation",
+        "bci_serving_ttft_seconds",
+        "bci_serving_inter_token_seconds",
+        "bci_serving_step_seconds",
+        "bci_serving_tokens_total",
+        "bci_serving_active_rows",
+        "bci_serving_batch_occupancy",
+        "bci_serving_free_pages",
+        "bci_serving_tokens_per_second",
+        "bci_serving_queue_wait_seconds",
+        "bci_serving_requeues_total",
+        "bci_serving_queue_rejected_total",
+        "bci_serving_queue_depth",
     ):
         assert required in metrics, f"{required}: not registered by the wiring"
     assert isinstance(metrics["bci_pool_spawn_seconds"], Histogram)
@@ -135,6 +158,13 @@ def test_every_registered_metric_follows_conventions(tmp_path):
     assert isinstance(metrics["bci_contprof_samples_total"], Counter)
     assert isinstance(metrics["bci_stream_ttfb_seconds"], Histogram)
     assert isinstance(metrics["bci_stream_chunks_total"], Counter)
+    assert isinstance(metrics["bci_serving_requests_total"], Counter)
+    assert isinstance(metrics["bci_serving_request_seconds"], Histogram)
+    assert isinstance(metrics["bci_serving_preemptions_total"], Counter)
+    assert isinstance(metrics["bci_serving_spec_tokens_total"], Counter)
+    assert isinstance(metrics["bci_serving_spec_accept_ratio"], Gauge)
+    assert isinstance(metrics["bci_serving_prefix_hit_ratio"], Gauge)
+    assert isinstance(metrics["bci_serving_page_fragmentation"], Gauge)
 
     for name, metric in metrics.items():
         assert name.startswith("bci_"), (
@@ -165,6 +195,30 @@ def test_every_registered_metric_follows_conventions(tmp_path):
     for name in metrics:
         assert text.count(f"# HELP {name} ") == 1, (
             f"{name}: duplicate or missing exposition block"
+        )
+
+
+def test_every_serving_metric_is_documented(tmp_path):
+    """asynclint's undocumented-metric rule scopes to the control plane
+    (api/ + services/ + resilience/ + observability/ + sessions/) and
+    deliberately does not lint models/ — hold the serving-engine metrics
+    to the same standard here: every registered ``bci_serving_*`` name
+    must appear (word-bounded) in docs/observability.md."""
+    import re
+    from pathlib import Path
+
+    registry = build_service_registry(tmp_path)
+    register_serving_metrics(registry)
+    doc = (
+        Path(__file__).resolve().parent.parent / "docs" / "observability.md"
+    ).read_text()
+    serving = sorted(
+        n for n in registry.metrics if n.startswith("bci_serving_")
+    )
+    assert len(serving) >= 16, serving  # both layers actually registered
+    for name in serving:
+        assert re.search(rf"\b{name}\b", doc), (
+            f"{name}: registered but not documented in docs/observability.md"
         )
 
 
