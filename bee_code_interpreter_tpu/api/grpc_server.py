@@ -1071,6 +1071,7 @@ class ObservabilityServicer:
         loopmon=None,  # observability.LoopMonitor
         contprof=None,  # observability.ContinuousProfiler
         serving=None,  # observability.ServingMonitor
+        autoscale=None,  # callable -> dict (resilience.autoscale_snapshot)
     ) -> None:
         self._slo = slo
         self._debug_bundle = debug_bundle
@@ -1078,12 +1079,24 @@ class ObservabilityServicer:
         self._loopmon = loopmon
         self._contprof = contprof
         self._serving = serving
+        self._autoscale = autoscale
 
     async def GetSlo(self, request: bytes, context) -> bytes:
         snapshot = (
             self._slo.snapshot() if self._slo is not None else empty_slo_snapshot()
         )
         return json.dumps(snapshot).encode()
+
+    async def GetAutoscale(self, request: bytes, context) -> bytes:
+        """Capacity observability (docs/autoscaling.md) — the gRPC spelling
+        of ``GET /v1/autoscale``: demand snapshot, forecast, current/target
+        pool size, and the bounded scaling-decision log."""
+        if self._autoscale is None:
+            await context.abort(
+                grpc.StatusCode.UNIMPLEMENTED,
+                "no capacity tracker wired into this server",
+            )
+        return json.dumps(self._autoscale()).encode()
 
     async def GetDebugBundle(self, request: bytes, context) -> bytes:
         if self._debug_bundle is None:
@@ -1237,6 +1250,7 @@ class ObservabilityServicer:
 
 _OBSERVABILITY_METHODS = (
     "GetSlo",
+    "GetAutoscale",
     "GetDebugBundle",
     "GetEvents",
     "GetTasks",
@@ -1519,6 +1533,7 @@ class GrpcServer:
         loopmon=None,  # observability.LoopMonitor shared with the HTTP edge
         contprof=None,  # observability.ContinuousProfiler, likewise
         serving=None,  # observability.ServingMonitor, likewise
+        autoscale=None,  # callable -> dict for GetAutoscale (docs/autoscaling.md)
     ) -> None:
         self._servicer = CodeInterpreterServicer(
             code_executor,
@@ -1538,6 +1553,7 @@ class GrpcServer:
         self._loopmon = loopmon
         self._contprof = contprof
         self._serving = serving
+        self._autoscale = autoscale
         # Mirror the HTTP edge: use the executor backend's own journal when
         # one exists (find_journal is the one shared discovery rule), else
         # an (honestly empty) standalone journal. Explicit None checks: an
@@ -1589,6 +1605,7 @@ class GrpcServer:
                         loopmon=self._loopmon,
                         contprof=self._contprof,
                         serving=self._serving,
+                        autoscale=self._autoscale,
                     )
                 ),
                 _health_handler(self.health),

@@ -138,6 +138,7 @@ def create_http_server(
     loopmon=None,  # observability.LoopMonitor for GET /v1/debug/tasks
     contprof=None,  # observability.ContinuousProfiler for GET /v1/debug/pprof
     serving=None,  # observability.ServingMonitor for GET /v1/serving
+    autoscale=None,  # callable -> dict for GET /v1/autoscale (docs/autoscaling.md)
 ) -> web.Application:
     app = web.Application(client_max_size=1 << 30)
     metrics = metrics or Registry()
@@ -1030,6 +1031,17 @@ def create_http_server(
             slo.snapshot() if slo is not None else empty_slo_snapshot()
         )
 
+    async def autoscale_endpoint(_request: web.Request) -> web.Response:
+        """Capacity observability (docs/autoscaling.md): the demand
+        snapshot, the forecast, the current/target pool size, and the
+        bounded scaling-decision log with reasons."""
+        if autoscale is None:
+            return web.json_response(
+                {"detail": "no capacity tracker wired into this server"},
+                status=501,
+            )
+        return web.json_response(autoscale())
+
     async def debug_bundle_endpoint(_request: web.Request) -> web.Response:
         # One-call incident snapshot (docs/observability.md "Debug bundle").
         # The composition root's builder when wired; otherwise assembled
@@ -1049,6 +1061,7 @@ def create_http_server(
                 loopmon=loopmon,
                 contprof=contprof,
                 serving=serving,
+                autoscale=autoscale,
             )
         )
         return web.json_response(bundle)
@@ -1297,6 +1310,7 @@ def create_http_server(
     app.router.add_get("/v1/fleet", fleet_snapshot)
     app.router.add_get("/v1/fleet/events", fleet_events)
     app.router.add_get("/v1/slo", slo_endpoint)
+    app.router.add_get("/v1/autoscale", autoscale_endpoint)
     app.router.add_get("/v1/serving", serving_snapshot)
     app.router.add_get("/v1/serving/requests", serving_requests)
     app.router.add_get("/v1/events", list_events)

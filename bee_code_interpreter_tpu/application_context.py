@@ -15,8 +15,10 @@ from functools import cached_property
 from bee_code_interpreter_tpu.config import Config
 from bee_code_interpreter_tpu.observability import (
     ContinuousProfiler,
+    DemandTracker,
     FleetJournal,
     FlightRecorder,
+    Forecaster,
     LoopMonitor,
     ServingMonitor,
     ServingProfiler,
@@ -55,6 +57,25 @@ class ApplicationContext:
         # Pool supervisor (resilience/supervisor.py): created with the pool
         # executor it reconciles, None for the pool-less local backend.
         self.supervisor = None
+        # Capacity observability (docs/autoscaling.md): per-second demand
+        # telemetry fed by the shared admission gate and the fleet journal,
+        # and the forecaster over it. Constructed unconditionally (their
+        # gauges must exist either way); the PoolAutoscaler consuming them
+        # is created with the pool executor in _wrap_pool_executor (None
+        # for the pool-less local backend).
+        self.demand = DemandTracker(
+            window_s=self.config.demand_window_s,
+            spawn_samples=self.config.demand_spawn_samples,
+            metrics=self.metrics,
+        )
+        self.fleet.add_sink(self.demand.on_fleet_event)
+        self.forecaster = Forecaster(
+            self.demand,
+            alpha=self.config.demand_ewma_alpha,
+            beta=self.config.demand_trend_beta,
+            metrics=self.metrics,
+        )
+        self.autoscaler = None
         # SLO engine: objectives come from config (APP_SLO_AVAILABILITY /
         # APP_SLO_LATENCY_MS); with none declared it is inert and /v1/slo
         # answers honestly empty. Both edges record into the ONE engine.
@@ -190,6 +211,18 @@ class ApplicationContext:
         its aggregate gauges land in the same registry."""
         self.serving.attach(engine)
 
+    def autoscale_snapshot(self) -> dict:
+        """The ``GET /v1/autoscale`` document both edges serve — demand
+        telemetry, the forecast, and the autoscaler's target + decision log
+        (null section for the pool-less local backend)."""
+        from bee_code_interpreter_tpu.resilience import autoscale_snapshot
+
+        return autoscale_snapshot(
+            demand=self.demand,
+            forecaster=self.forecaster,
+            autoscaler=self.autoscaler,
+        )
+
     def build_debug_bundle(self) -> dict:
         """The one-call incident snapshot both edges serve — built here so
         HTTP and gRPC can never disagree about what a bundle contains."""
@@ -209,6 +242,7 @@ class ApplicationContext:
             loopmon=self.loopmon,
             contprof=self.contprof,
             serving=self.serving,
+            autoscale=self.autoscale_snapshot,
         )
 
     @cached_property
@@ -265,21 +299,38 @@ class ApplicationContext:
                 backend.shutdown()
 
     def _wrap_pool_executor(self, executor):
-        """Shared pool-backend wiring: the replay/hedge front and the pool
+        """Shared pool-backend wiring: the replay/hedge front, the
+        SLO-aware predictive autoscaler (docs/autoscaling.md), and the pool
         supervisor (owned per executor; its loop starts only when one runs —
         mirroring the warmup deferral below)."""
         from bee_code_interpreter_tpu.resilience import (
             HedgingExecutor,
+            PoolAutoscaler,
             PoolSupervisor,
         )
 
         cfg = self.config
+        self.autoscaler = PoolAutoscaler(
+            executor,
+            self.forecaster,
+            self.demand,
+            mode=cfg.autoscale_mode,
+            min_size=cfg.autoscale_min,
+            max_size=cfg.autoscale_max,
+            idle_s=cfg.autoscale_idle_s,
+            cooldown_s=cfg.autoscale_cooldown_s,
+            base_target=cfg.executor_pod_queue_target_length,
+            slo=self.slo,
+            recorder=self.flight,
+            metrics=self.metrics,
+        )
         self.supervisor = PoolSupervisor(
             executor,
             interval_s=cfg.supervisor_interval_s,
             execute_hard_cap_s=cfg.resolved_execution_hard_cap_s(),
             metrics=self.metrics,
             drain=self.drain,
+            autoscaler=self.autoscaler,
         )
         if cfg.supervisor_interval_s > 0:
             try:
@@ -345,6 +396,9 @@ class ApplicationContext:
             max_queue=self.config.admission_max_queue,
             retry_after_s=self.config.admission_retry_after_s,
             metrics=self.metrics,
+            # The one chokepoint both transports share is also the demand
+            # sensor: arrivals/sheds/queue-waits feed the capacity tracker.
+            demand=self.demand,
         )
 
     def _build_local_executor(self):
@@ -471,6 +525,7 @@ class ApplicationContext:
             contprof=self.contprof,
             serving=self.serving,
             profiler=self.serving_profiler,
+            autoscale=self.autoscale_snapshot,
         )
 
     @cached_property
@@ -497,4 +552,5 @@ class ApplicationContext:
             loopmon=self.loopmon,
             contprof=self.contprof,
             serving=self.serving,
+            autoscale=self.autoscale_snapshot,
         )

@@ -223,6 +223,34 @@ class Config(BaseModel):
     # SLO sliding-window bucket coarseness; windows span 5m..6h.
     slo_window_bucket_s: float = Field(default=10.0, gt=0)
 
+    # --- capacity observability + predictive pool autoscaling
+    # (new; see docs/autoscaling.md) ---
+    # What the PoolAutoscaler does with its recommendations: `off` = no
+    # evaluation at all; `advise` = decisions are logged/counted/emitted
+    # (GET /v1/autoscale, bci_autoscale_decisions_total, kind="autoscale"
+    # wide events) but the pool keeps its static target — run this in
+    # production until the decision log earns trust; `act` = the pool
+    # backend's refill target follows the recommendation.
+    autoscale_mode: Literal["off", "advise", "act"] = "advise"
+    # Warm-pool size bounds the recommendation is clamped to.
+    autoscale_min: int = Field(default=1, ge=0)
+    autoscale_max: int = Field(default=16, ge=1)
+    # Shrink only after this long with NO arrivals at all (sustained idle);
+    # scale-ups are never delayed by it.
+    autoscale_idle_s: float = Field(default=60.0, gt=0)
+    # Minimum spacing between a scale-down (or an SLO-burn-driven notch up)
+    # and the previous decision — the anti-flap hysteresis.
+    autoscale_cooldown_s: float = Field(default=15.0, ge=0)
+    # Demand telemetry: per-second ring length behind GET /v1/autoscale and
+    # the forecaster (bounded memory: one small bucket per second).
+    demand_window_s: float = Field(default=120.0, gt=0)
+    # Observed sandbox spawn latencies sampled for the forecast horizon.
+    demand_spawn_samples: int = Field(default=64, ge=1)
+    # Holt's linear smoothing constants over the per-second arrival series:
+    # alpha weights the newest second's rate, beta the trend update.
+    demand_ewma_alpha: float = Field(default=0.4, gt=0, le=1)
+    demand_trend_beta: float = Field(default=0.2, ge=0, le=1)
+
     # --- sessions: leased sandboxes + streaming (new; see docs/sessions.md) ---
     # Hard cap on concurrent session leases. Each lease pins one warm
     # sandbox the stateless pool cannot serve with, so this bounds how much

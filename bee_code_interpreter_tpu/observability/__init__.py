@@ -16,9 +16,15 @@ from bee_code_interpreter_tpu.observability.accounting import (
     record_usage_at_edge,
     register_usage_metrics,
 )
+from bee_code_interpreter_tpu.observability.capacity import (
+    DemandTracker,
+)
 from bee_code_interpreter_tpu.observability.contprof import (
     ContinuousProfiler,
     collapse_stack,
+)
+from bee_code_interpreter_tpu.observability.forecast import (
+    Forecaster,
 )
 from bee_code_interpreter_tpu.observability.fleet import (
     FleetJournal,
@@ -88,6 +94,8 @@ from bee_code_interpreter_tpu.observability.slo import (  # noqa: E402
 
 __all__ = [
     "ContinuousProfiler",
+    "DemandTracker",
+    "Forecaster",
     "FleetJournal",
     "FlightRecorder",
     "JsonLogFormatter",

@@ -31,6 +31,9 @@ def executor_health(executor) -> dict:
         info["pool"] = {
             "ready": ready,
             "spawning": getattr(inner, "pool_spawning_count", 0),
+            # The live refill target (the autoscaler's override in act
+            # mode, the static config otherwise — docs/autoscaling.md).
+            "target": getattr(inner, "pool_target", None),
         }
     breakers = {}
     for attr in ("spawn_breaker", "http_breaker"):
@@ -57,6 +60,7 @@ def build_debug_bundle(
     loopmon=None,
     contprof=None,
     serving=None,
+    autoscale=None,  # callable -> dict (resilience.autoscale_snapshot)
     recent_traces: int = 50,
     slowest_traces: int = 10,
     fleet_events: int = 100,
@@ -129,6 +133,11 @@ def build_debug_bundle(
     bundle["serving"] = (
         serving.snapshot(steps=serving_steps) if serving is not None else None
     )
+
+    # Capacity observability (docs/autoscaling.md): demand, forecast, and
+    # the autoscaler's target + decision log — the "was the pool sized for
+    # this" context every capacity incident needs.
+    bundle["autoscale"] = autoscale() if autoscale is not None else None
 
     bundle["config"] = config.redacted_dump() if config is not None else None
     bundle["metrics"] = metrics.expose() if metrics is not None else None

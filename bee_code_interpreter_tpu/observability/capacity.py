@@ -1,0 +1,246 @@
+"""Capacity observability: per-second demand telemetry (docs/autoscaling.md).
+
+The fleet journal answers "what did each sandbox do" and the SLO engine
+answers "are we failing users"; neither answers "how much work is ARRIVING
+and is the warm pool sized for it". The ``DemandTracker`` is that missing
+signal: a bounded ring of per-second buckets — arrival rate, admission queue
+wait, shed count, concurrency high-water, warm-pop vs cold-spawn outcomes —
+fed from the hooks the service already has:
+
+- the shared :class:`~..resilience.admission.AdmissionController` (one gate
+  for BOTH API edges) reports arrivals, sheds, queue waits, and the
+  in-flight high-water mark;
+- the :class:`~.fleet.FleetJournal` sink reports every pool checkout
+  (``assigned`` with ``warm_pop``/``cold_spawn``) and every spawn latency
+  (``ready`` with ``spawn_s``) — the tracker keeps a bounded sample ring of
+  spawn latencies so the forecaster can size its horizon from OBSERVED
+  spawn behavior, not a config constant.
+
+Everything is loop-local, O(1) per recorded event, and clock-injectable so
+the chaos/autoscale suites drive time deterministically. Served as the
+``demand`` section of ``GET /v1/autoscale`` and as the ``bci_demand_rps`` /
+``bci_warm_pop_ratio`` gauges.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from typing import Callable
+
+
+class _DemandBucket:
+    """One second of demand history."""
+
+    __slots__ = (
+        "arrivals",
+        "sheds",
+        "admitted",
+        "queue_wait_sum",
+        "queue_wait_max",
+        "concurrency_hw",
+        "warm_pops",
+        "cold_spawns",
+    )
+
+    def __init__(self) -> None:
+        self.arrivals = 0
+        self.sheds = 0
+        self.admitted = 0
+        self.queue_wait_sum = 0.0
+        self.queue_wait_max = 0.0
+        self.concurrency_hw = 0
+        self.warm_pops = 0
+        self.cold_spawns = 0
+
+
+class DemandTracker:
+    """Bounded per-second demand ring + spawn-latency sample ring.
+
+    Writers (the admission gate, the fleet-journal sink) call the
+    ``record_*`` / ``on_fleet_event`` hooks; readers (the forecaster, the
+    autoscaler, ``GET /v1/autoscale``) call the windowed accessors. Windows
+    are trailing: a bucket belongs to ``window_s`` while its second starts
+    within the last ``window_s`` seconds.
+    """
+
+    def __init__(
+        self,
+        *,
+        window_s: float = 120.0,
+        spawn_samples: int = 64,
+        metrics=None,
+        clock: Callable[[], float] = time.monotonic,
+    ) -> None:
+        self._window_s = max(2.0, window_s)
+        self._clock = clock
+        self._buckets: dict[int, _DemandBucket] = {}
+        self._spawn_s: deque[float] = deque(maxlen=max(1, spawn_samples))
+        self._last_arrival_mono: float | None = None
+        self.arrivals_total = 0
+        self.sheds_total = 0
+        if metrics is not None:
+            metrics.gauge(
+                "bci_demand_rps",
+                "Observed sandbox-bound request arrival rate (trailing 10s)",
+                lambda: self.rate_rps(10.0),
+            )
+            metrics.gauge(
+                "bci_warm_pop_ratio",
+                "Pool checkouts served by a warm sandbox over the trailing "
+                "60s (1.0 with no checkouts: nothing was missed)",
+                lambda: self.warm_pop_ratio(60.0),
+            )
+
+    # ------------------------------------------------------------- writers
+
+    def _bucket(self) -> _DemandBucket:
+        idx = int(self._clock())
+        bucket = self._buckets.get(idx)
+        if bucket is None:
+            self._prune(idx)
+            bucket = self._buckets[idx] = _DemandBucket()
+        return bucket
+
+    def _prune(self, now_idx: int) -> None:
+        horizon = now_idx - int(self._window_s) - 1
+        for idx in [i for i in self._buckets if i < horizon]:
+            del self._buckets[idx]
+
+    def record_arrival(self) -> None:
+        """One sandbox-bound request reached the admission gate (either
+        edge; shed or admitted, it is demand either way)."""
+        self._bucket().arrivals += 1
+        self.arrivals_total += 1
+        self._last_arrival_mono = self._clock()
+
+    def record_shed(self) -> None:
+        self._bucket().sheds += 1
+        self.sheds_total += 1
+
+    def record_admitted(self, queue_wait_s: float, in_flight: int) -> None:
+        """One request got past the gate after ``queue_wait_s`` in the
+        queue, with ``in_flight`` requests (itself included) now running —
+        the per-second high-water of that count is the concurrency the pool
+        must cover."""
+        bucket = self._bucket()
+        bucket.admitted += 1
+        bucket.queue_wait_sum += max(0.0, queue_wait_s)
+        bucket.queue_wait_max = max(bucket.queue_wait_max, queue_wait_s)
+        bucket.concurrency_hw = max(bucket.concurrency_hw, in_flight)
+
+    def on_fleet_event(self, event: dict) -> None:
+        """FleetJournal sink: checkout outcomes (warm vs cold) and observed
+        spawn latencies. Cheap and exception-free — it runs inside
+        ``FleetJournal.record`` on the request path."""
+        state = event.get("state")
+        if state == "assigned":
+            bucket = self._bucket()
+            if event.get("reason") == "warm_pop":
+                bucket.warm_pops += 1
+            else:
+                bucket.cold_spawns += 1
+        elif state == "ready" and event.get("spawn_s") is not None:
+            try:
+                self._spawn_s.append(float(event["spawn_s"]))
+            except (TypeError, ValueError):
+                pass
+
+    # ------------------------------------------------------------- readers
+
+    def _window_buckets(self, window_s: float) -> list[_DemandBucket]:
+        # A bucket belongs while its second STARTS within the window (the
+        # class contract): end-inside inclusion would sum up to one extra
+        # bucket and overstate every rate by up to 1/window_s.
+        floor = self._clock() - min(window_s, self._window_s)
+        return [b for idx, b in self._buckets.items() if idx >= floor]
+
+    def rate_rps(self, window_s: float = 10.0) -> float:
+        window_s = min(window_s, self._window_s)
+        arrivals = sum(b.arrivals for b in self._window_buckets(window_s))
+        return arrivals / window_s if window_s > 0 else 0.0
+
+    def shed_count(self, window_s: float = 60.0) -> int:
+        return sum(b.sheds for b in self._window_buckets(window_s))
+
+    def concurrency_high_water(self, window_s: float = 60.0) -> int:
+        buckets = self._window_buckets(window_s)
+        return max((b.concurrency_hw for b in buckets), default=0)
+
+    def warm_pop_ratio(self, window_s: float = 60.0) -> float:
+        """Checkouts served warm over the window; 1.0 with no checkouts
+        (an idle pool missed nothing — the recovered state, not NaN)."""
+        buckets = self._window_buckets(window_s)
+        warm = sum(b.warm_pops for b in buckets)
+        cold = sum(b.cold_spawns for b in buckets)
+        total = warm + cold
+        return warm / total if total else 1.0
+
+    def queue_wait(self, window_s: float = 60.0) -> dict:
+        buckets = self._window_buckets(window_s)
+        admitted = sum(b.admitted for b in buckets)
+        wait_sum = sum(b.queue_wait_sum for b in buckets)
+        wait_max = max((b.queue_wait_max for b in buckets), default=0.0)
+        return {
+            "admitted": admitted,
+            "avg_ms": (wait_sum / admitted * 1000.0) if admitted else 0.0,
+            "max_ms": wait_max * 1000.0,
+        }
+
+    def last_arrival_age_s(self) -> float | None:
+        """Seconds since the last arrival; None when none was ever seen.
+        The autoscaler's "sustained idle" clock."""
+        if self._last_arrival_mono is None:
+            return None
+        return self._clock() - self._last_arrival_mono
+
+    def completed_series(self) -> list[int]:
+        """Dense per-second arrival counts, oldest→newest, over the
+        retained window, EXCLUDING the current (incomplete) second — the
+        forecaster's EWMA input. Missing seconds between observed buckets
+        count as zero; seconds before the first observation are not data."""
+        now_idx = int(self._clock())
+        floor = now_idx - int(self._window_s)
+        indices = [i for i in self._buckets if floor <= i < now_idx]
+        if not indices:
+            return []
+        start = min(indices)
+        return [
+            self._buckets[i].arrivals if i in self._buckets else 0
+            for i in range(start, now_idx)
+        ]
+
+    def peak_rps(self, window_s: float = 60.0) -> float:
+        """Largest single-second arrival count over the window, current
+        partial second included — the envelope a forecast must not sit
+        under while a burst is still in flight."""
+        buckets = self._window_buckets(window_s)
+        return float(max((b.arrivals for b in buckets), default=0))
+
+    def spawn_latency_quantile(self, q: float) -> float | None:
+        """Observed sandbox spawn latency quantile (from the fleet
+        journal's ``ready`` events); None before the first spawn."""
+        if not self._spawn_s:
+            return None
+        ordered = sorted(self._spawn_s)
+        idx = min(len(ordered) - 1, int(q * len(ordered)))
+        return ordered[idx]
+
+    def snapshot(self) -> dict:
+        """The ``demand`` section of ``GET /v1/autoscale``."""
+        return {
+            "rps_10s": self.rate_rps(10.0),
+            "rps_60s": self.rate_rps(60.0),
+            "peak_rps_60s": self.peak_rps(60.0),
+            "warm_pop_ratio_60s": self.warm_pop_ratio(60.0),
+            "sheds_60s": self.shed_count(60.0),
+            "concurrency_high_water_60s": self.concurrency_high_water(60.0),
+            "queue_wait_60s": self.queue_wait(60.0),
+            "spawn_p50_s": self.spawn_latency_quantile(0.5),
+            "spawn_p95_s": self.spawn_latency_quantile(0.95),
+            "spawn_samples": len(self._spawn_s),
+            "last_arrival_age_s": self.last_arrival_age_s(),
+            "arrivals_total": self.arrivals_total,
+            "sheds_total": self.sheds_total,
+            "window_s": self._window_s,
+        }

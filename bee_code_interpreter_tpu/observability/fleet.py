@@ -26,9 +26,12 @@ transition.
 
 from __future__ import annotations
 
+import logging
 import time
 from collections import deque
 from dataclasses import dataclass, field
+
+logger = logging.getLogger(__name__)
 
 # Canonical lifecycle states. Terminal states drop the pod from the live
 # snapshot; its history stays in the event ring. ``leased`` marks a sandbox
@@ -120,6 +123,7 @@ class FleetJournal:
     def __init__(self, metrics=None, max_events: int = 512) -> None:
         self._events: deque[dict] = deque(maxlen=max(1, max_events))
         self._live: dict[str, PodRecord] = {}
+        self._sinks: list = []
         # Lifetime counters (survive pod eviction from the live map).
         self.counts: dict[str, int] = {state: 0 for state in STATES}
         self.executions_total = 0
@@ -207,6 +211,19 @@ class FleetJournal:
             if state in ("reaped", "failed") and self._reaped_total is not None:
                 self._reaped_total.inc(reason=reason or state)
         self._events.append(event)
+        for sink in self._sinks:
+            # A broken sink (the demand tracker) must never fail the
+            # checkout/teardown that recorded this transition.
+            try:
+                sink(event)
+            except Exception:
+                logger.exception("fleet-journal sink %r failed", sink)
+
+    def add_sink(self, sink) -> None:
+        """Register a callable invoked with each recorded event (the
+        capacity tracker's ``on_fleet_event``). Sinks must be cheap and
+        non-blocking — they run on the checkout path."""
+        self._sinks.append(sink)
 
     # -------------------------------------------------------------- reading
 

@@ -1,0 +1,90 @@
+"""Demand forecasting over the capacity tracker (docs/autoscaling.md).
+
+A reactive pool refill always pays one cold-spawn latency per traffic step;
+acting *proactively* needs a short-horizon forecast of the arrival rate.
+The model here is deliberately small and fully inspectable (the
+Borg/Autopilot-style moving-window estimators, not an ML service):
+
+- **EWMA level + trend** (Holt's linear smoothing) over the tracker's
+  completed per-second arrival series — the smoothed rate and its slope,
+  projected one horizon ahead;
+- **recent-peak envelope** — the largest single second observed recently
+  (current partial second included), so a burst raises the forecast the
+  moment it starts instead of one smoothing constant later;
+- **horizon = observed p95 sandbox spawn latency** (from the fleet
+  journal's spawn samples, clamped to a sane band): the forecast looks
+  exactly as far ahead as the pool needs to START a spawn for it to be warm
+  in time.
+
+``forecast()`` recomputes from the ring on every call — deterministic under
+a ManualClock, nothing to keep consistent, and the ring is at most
+``APP_DEMAND_WINDOW_S`` entries. Served as the ``forecast`` section of
+``GET /v1/autoscale`` and the ``bci_forecast_rps`` gauge.
+"""
+
+from __future__ import annotations
+
+from bee_code_interpreter_tpu.observability.capacity import DemandTracker
+
+
+class Forecaster:
+    def __init__(
+        self,
+        demand: DemandTracker,
+        *,
+        alpha: float = 0.4,
+        beta: float = 0.2,
+        peak_window_s: float = 60.0,
+        min_horizon_s: float = 1.0,
+        max_horizon_s: float = 60.0,
+        metrics=None,
+    ) -> None:
+        self._demand = demand
+        self._alpha = min(1.0, max(0.0, alpha))
+        self._beta = min(1.0, max(0.0, beta))
+        self._peak_window_s = peak_window_s
+        self._min_horizon_s = min_horizon_s
+        self._max_horizon_s = max_horizon_s
+        if metrics is not None:
+            metrics.gauge(
+                "bci_forecast_rps",
+                "Forecast arrival rate one spawn-horizon ahead "
+                "(EWMA level+trend with a recent-peak envelope)",
+                lambda: self.forecast()["forecast_rps"],
+            )
+
+    def horizon_s(self) -> float:
+        """How far ahead the forecast looks: the observed p95 spawn latency
+        (what a pre-spawn must beat), clamped to [min, max] — before the
+        first spawn is observed, the floor."""
+        p95 = self._demand.spawn_latency_quantile(0.95)
+        if p95 is None:
+            return self._min_horizon_s
+        return min(self._max_horizon_s, max(self._min_horizon_s, p95))
+
+    def forecast(self) -> dict:
+        """The full forecast document (the ``forecast`` section of
+        ``GET /v1/autoscale``). ``forecast_rps`` is the number the
+        autoscaler sizes against: the Holt projection at the horizon,
+        floored by the recent-peak envelope, never negative."""
+        series = self._demand.completed_series()
+        level = 0.0
+        trend = 0.0
+        if series:
+            level = float(series[0])
+            for y in series[1:]:
+                prev = level
+                level = self._alpha * y + (1.0 - self._alpha) * (level + trend)
+                trend = self._beta * (level - prev) + (1.0 - self._beta) * trend
+        horizon = self.horizon_s()
+        projected = max(0.0, level + trend * horizon)
+        peak = self._demand.peak_rps(self._peak_window_s)
+        return {
+            "level_rps": level,
+            "trend_rps_per_s": trend,
+            "projected_rps": projected,
+            "peak_rps": peak,
+            "forecast_rps": max(projected, peak),
+            "horizon_s": horizon,
+            "samples": len(series),
+        }

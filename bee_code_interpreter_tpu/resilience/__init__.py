@@ -12,6 +12,10 @@ from bee_code_interpreter_tpu.resilience.admission import (
     AdmissionController,
     AdmissionRejected,
 )
+from bee_code_interpreter_tpu.resilience.autoscaler import (
+    PoolAutoscaler,
+    autoscale_snapshot,
+)
 from bee_code_interpreter_tpu.resilience.circuit_breaker import (
     BreakerOpenError,
     BreakerState,
@@ -47,7 +51,9 @@ __all__ = [
     "HedgingExecutor",
     "InflightExecution",
     "InflightRegistry",
+    "PoolAutoscaler",
     "PoolSupervisor",
+    "autoscale_snapshot",
     "ResilientCodeExecutor",
     "RetryPolicy",
     "SandboxError",

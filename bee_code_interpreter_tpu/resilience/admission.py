@@ -20,6 +20,7 @@ overshoot ``max_in_flight``.
 from __future__ import annotations
 
 import asyncio
+import time
 from collections import deque
 from contextlib import asynccontextmanager
 
@@ -41,12 +42,18 @@ class AdmissionController:
         default_wait_s: float = 30.0,
         retry_after_s: float = 1.0,
         metrics=None,
+        demand=None,  # observability.DemandTracker (capacity telemetry)
     ) -> None:
         self._max_in_flight = max(1, max_in_flight)
         self._max_queue = max(0, max_queue)
         self._default_wait_s = default_wait_s
         self._retry_after_s = retry_after_s
         self._in_flight = 0
+        # The gate is the ONE chokepoint every sandbox-bound request on
+        # either transport passes, which makes it the natural demand
+        # sensor: arrivals, sheds, queue waits, and the in-flight
+        # high-water feed the capacity tracker here (docs/autoscaling.md).
+        self._demand = demand
         self._waiters: deque[asyncio.Future] = deque()
         self._shed_total = None
         self._admitted_total = None
@@ -79,6 +86,8 @@ class AdmissionController:
     def _shed(self, reason: str) -> None:
         if self._shed_total is not None:
             self._shed_total.inc(reason=reason)
+        if self._demand is not None:
+            self._demand.record_shed()
         raise AdmissionRejected(reason, self._retry_after_s)
 
     @asynccontextmanager
@@ -86,8 +95,16 @@ class AdmissionController:
         # The trace stage span covers ONLY the acquire (the queue wait a
         # slow request may have paid); the admitted body's time belongs to
         # its own stages. One instrumentation site serves every edge.
+        if self._demand is not None:
+            self._demand.record_arrival()
+        wait_start = time.monotonic()
         with trace_span("admission"):
             await self._acquire(deadline)
+        if self._demand is not None:
+            self._demand.record_admitted(
+                queue_wait_s=time.monotonic() - wait_start,
+                in_flight=self._in_flight,
+            )
         try:
             yield
         finally:

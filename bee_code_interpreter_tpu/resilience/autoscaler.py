@@ -1,0 +1,290 @@
+"""SLO-aware predictive pool autoscaling (docs/autoscaling.md).
+
+The supervisor's refill keeps the warm pool at a *static* target, so a
+traffic step always pays cold-spawn latency until the pool catches up. The
+``PoolAutoscaler`` closes the observe→forecast→recommend loop the capacity
+tracker and forecaster open:
+
+- **target sizing** — the warm pool must cover one spawn-horizon of
+  forecast demand (each execution consumes a single-use sandbox, so at
+  ``R`` req/s with spawn latency ``L`` the refill pipeline holds ``R×L``
+  sandboxes — Little's law over the horizon) AND the observed concurrency
+  high-water (a burst of N simultaneous requests pops N sandboxes at
+  once), clamped to ``[APP_AUTOSCALE_MIN, APP_AUTOSCALE_MAX]``;
+- **scale up early** — immediately when the forecast demands it, and on a
+  fast-window SLO burn (the page pair firing means users are already
+  hurting: add capacity without waiting for the forecast to agree);
+- **shrink late** — only after ``APP_AUTOSCALE_IDLE_S`` of sustained idle
+  (no arrivals at all), and never two shrinks inside the cooldown — the
+  hysteresis that keeps recommendations from flapping;
+- **modes** (``APP_AUTOSCALE_MODE``): ``off`` = no evaluation; ``advise`` =
+  decisions are computed, logged, counted, and emitted as wide events but
+  NEVER actuated (the decision log is testable in production before anyone
+  trusts it with the pool); ``act`` = the pool backend's refill target is
+  overridden, so the existing supervisor replenish loop — and every
+  checkout-kicked refill — pre-spawns to the recommendation.
+
+Every scale decision lands exactly once in the bounded decision log
+(``GET /v1/autoscale``), in ``bci_autoscale_decisions_total{direction,
+reason}``, and as a ``kind="autoscale"`` wide event through the flight
+recorder (→ OTLP logs). ``bci_pool_target_size`` is the HPA-consumable
+recommendation gauge.
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+import time
+from collections import deque
+from typing import Callable
+
+logger = logging.getLogger(__name__)
+
+MODES = ("off", "advise", "act")
+
+
+class PoolAutoscaler:
+    """One autoscaler per pool executor (k8s pod groups or native
+    processes), evaluated by the supervisor's reconcile sweep.
+
+    The executor contract is duck-typed: ``pool_ready_count`` /
+    ``pool_spawning_count`` (current size) and ``pool_target_override``
+    (written in ``act`` mode; the backends' refill — the supervisor
+    sweep's, and every checkout-kicked one — reads it through their
+    ``pool_target`` property).
+    """
+
+    def __init__(
+        self,
+        executor,
+        forecaster,
+        demand,
+        *,
+        mode: str = "advise",
+        min_size: int = 1,
+        max_size: int = 16,
+        idle_s: float = 60.0,
+        cooldown_s: float = 15.0,
+        base_target: int | None = None,
+        hw_window_s: float = 60.0,
+        slo=None,
+        recorder=None,
+        metrics=None,
+        clock: Callable[[], float] = time.monotonic,
+        decision_log_max: int = 128,
+    ) -> None:
+        if mode not in MODES:
+            raise ValueError(f"autoscale mode must be one of {MODES}, got {mode!r}")
+        if min_size > max_size:
+            # Fail at construction, where the blame is local: silently
+            # widening max past the operator's explicit quota cap would
+            # scale the pool beyond what they set out to protect.
+            raise ValueError(
+                f"APP_AUTOSCALE_MIN ({min_size}) must not exceed "
+                f"APP_AUTOSCALE_MAX ({max_size})"
+            )
+        self._executor = executor
+        self._forecaster = forecaster
+        self._demand = demand
+        self.mode = mode
+        self._min = max(0, min_size)
+        self._max = max_size
+        self._idle_s = idle_s
+        self._cooldown_s = cooldown_s
+        self._hw_window_s = hw_window_s
+        self._slo = slo
+        self._recorder = recorder
+        self._clock = clock
+        self._base_target = (
+            base_target
+            if base_target is not None
+            else getattr(executor, "pool_target", self._min)
+        )
+        if self._base_target > self._max:
+            # The operator's configured static pool is the one size we KNOW
+            # they want; silently clamping the recommendation below it would
+            # misreport bci_pool_target_size and make act mode a downgrade.
+            # Raise the effective ceiling instead (loudly).
+            logger.warning(
+                "Static pool target %d exceeds APP_AUTOSCALE_MAX %d; raising "
+                "the effective autoscale ceiling to %d",
+                self._base_target, self._max, self._base_target,
+            )
+            self._max = self._base_target
+        # The standing recommendation; starts at the static configured
+        # target so advise mode's first decision reads as a delta from
+        # what the service would have done anyway.
+        self.target = min(self._max, max(self._min, self._base_target))
+        self._decisions: deque[dict] = deque(maxlen=max(1, decision_log_max))
+        self._seq = 0
+        self._last_decision_mono: float | None = None
+        self._decisions_total = None
+        if metrics is not None:
+            metrics.gauge(
+                "bci_pool_target_size",
+                "Autoscaler-recommended warm pool size (actuated only in "
+                "APP_AUTOSCALE_MODE=act; HPA-consumable either way)",
+                lambda: self.target,
+            )
+            self._decisions_total = metrics.counter(
+                "bci_autoscale_decisions_total",
+                "Pool scaling decisions by direction and reason "
+                "(advise mode counts them too — applied=false in the log)",
+            )
+
+    # ------------------------------------------------------------ evaluate
+
+    def current_size(self) -> int:
+        """Warm + in-flight-spawn sandboxes — what the pool is already
+        committed to, the number the target is compared against."""
+        ready = getattr(self._executor, "pool_ready_count", 0)
+        spawning = getattr(self._executor, "pool_spawning_count", 0)
+        return int(ready) + int(spawning)
+
+    def _slo_fast_burning(self) -> bool:
+        if self._slo is None or not getattr(self._slo, "objectives", ()):
+            return False
+        try:
+            return bool(self._slo.snapshot().get("fast_burn_alerting"))
+        except Exception:
+            logger.exception("Autoscaler could not read SLO state")
+            return False
+
+    def evaluate(self) -> dict | None:
+        """One observe→forecast→recommend pass (the supervisor calls this
+        per sweep). Returns the decision dict when the target changed,
+        None on hold. Never raises on the sweep path."""
+        if self.mode == "off":
+            return None
+        forecast = self._forecaster.forecast()
+        demand_rps = self._demand.rate_rps(10.0)
+        needed = max(
+            math.ceil(forecast["forecast_rps"] * forecast["horizon_s"]),
+            self._demand.concurrency_high_water(self._hw_window_s),
+        )
+        now = self._clock()
+        cooled = (
+            self._last_decision_mono is None
+            or now - self._last_decision_mono >= self._cooldown_s
+        )
+        reason = "forecast"
+        if self._slo_fast_burning() and needed <= self.target:
+            # Users are already burning budget while the forecast says the
+            # pool suffices: add capacity beyond it anyway, one notch per
+            # cooldown so a long burn ratchets up to max instead of jumping
+            # there in one sweep. A forecast-sized jump that merely
+            # coincides with a burn keeps reason="forecast" — the decision
+            # log must attribute sizes to what actually produced them.
+            if not cooled:
+                return None
+            needed = self.target + 1
+            reason = "slo_burn"
+        desired = min(self._max, max(self._min, needed))
+        if desired > self.target:
+            return self._decide("up", desired, reason, forecast, demand_rps)
+        if desired < self.target:
+            idle_age = self._demand.last_arrival_age_s()
+            if idle_age is None or idle_age < self._idle_s or not cooled:
+                return None  # shrink only after sustained idle, cooled down
+            return self._decide("down", desired, "idle", forecast, demand_rps)
+        return None
+
+    def _decide(
+        self, direction: str, to_size: int, reason: str, forecast: dict,
+        demand_rps: float,
+    ) -> dict:
+        from_size = self.target
+        self.target = to_size
+        self._seq += 1
+        self._last_decision_mono = self._clock()
+        applied = False
+        if self.mode == "act":
+            self._executor.pool_target_override = to_size
+            applied = True
+        decision = {
+            "decision_id": f"asd-{self._seq}",
+            "ts": time.time(),
+            "direction": direction,
+            "from": from_size,
+            "to": to_size,
+            "reason": reason,
+            "mode": self.mode,
+            "applied": applied,
+            "forecast_rps": round(forecast["forecast_rps"], 3),
+            "horizon_s": round(forecast["horizon_s"], 3),
+            "demand_rps": round(demand_rps, 3),
+        }
+        self._decisions.append(decision)
+        if self._decisions_total is not None:
+            self._decisions_total.inc(direction=direction, reason=reason)
+        if self._recorder is not None:
+            # The wide event is a COPY: the recorder stamps its own ring
+            # seq on whatever dict it ingests, and the decision log's entry
+            # must stay exactly what /v1/autoscale serves.
+            self._recorder.record(
+                {"kind": "autoscale", "name": "autoscale", **decision}
+            )
+        logger.info(
+            "Autoscale %s: pool target %d -> %d (%s, forecast %.1f rps over "
+            "%.1fs horizon, mode=%s)",
+            direction, from_size, to_size, reason,
+            decision["forecast_rps"], decision["horizon_s"], self.mode,
+        )
+        # No refill kick here: evaluate() runs inside the supervisor sweep,
+        # whose own refill fires right after and reads the new target.
+        return decision
+
+    # ------------------------------------------------------------- reading
+
+    def decisions(self, limit: int | None = None) -> list[dict]:
+        """Bounded decision log, newest first."""
+        out = [dict(d) for d in reversed(self._decisions)]
+        if limit is not None and limit >= 0:
+            out = out[:limit]
+        return out
+
+    def snapshot(self) -> dict:
+        return {
+            "mode": self.mode,
+            "min": self._min,
+            "max": self._max,
+            "base_target": self._base_target,
+            "target": self.target,
+            "current_size": self.current_size(),
+            "applied_override": getattr(
+                self._executor, "pool_target_override", None
+            ),
+            "idle_s": self._idle_s,
+            "cooldown_s": self._cooldown_s,
+            "decisions_total": self._seq,
+            "last_decision": (
+                dict(self._decisions[-1]) if self._decisions else None
+            ),
+        }
+
+
+def autoscale_snapshot(demand=None, forecaster=None, autoscaler=None) -> dict:
+    """The ``GET /v1/autoscale`` document, shared by both transports (and
+    the debug bundle) so they can never disagree. Pool-less deployments
+    (the in-process local backend) have no autoscaler: the demand and
+    forecast sections still answer, the autoscaler section is null."""
+    body: dict = {
+        "demand": demand.snapshot() if demand is not None else None,
+        "forecast": forecaster.forecast() if forecaster is not None else None,
+    }
+    if autoscaler is not None:
+        snap = autoscaler.snapshot()
+        body.update(snap)
+        body["decisions"] = autoscaler.decisions()
+    else:
+        body.update(
+            {
+                "mode": None,
+                "target": None,
+                "current_size": None,
+                "decisions": [],
+                "last_decision": None,
+            }
+        )
+    return body

@@ -205,12 +205,14 @@ class PoolSupervisor:
         execute_hard_cap_s: float | None = None,
         metrics=None,
         drain: "DrainController | None" = None,
+        autoscaler=None,  # resilience.PoolAutoscaler (docs/autoscaling.md)
         clock: Callable[[], float] = time.monotonic,
     ) -> None:
         self._executor = executor
         self._interval_s = max(0.05, interval_s)
         self._hard_cap_s = execute_hard_cap_s
         self._drain = drain
+        self._autoscaler = autoscaler
         self._clock = clock
         self._reap = getattr(executor, "reap_unhealthy_idle", None)
         self._refill = getattr(
@@ -224,6 +226,7 @@ class PoolSupervisor:
         self.sweeps_total = 0
         self.reaped_total = 0
         self.watchdog_kills_total = 0
+        self.trimmed_total = 0
         self.last_sweep_mono: float | None = None
         self._probe_seconds = (
             metrics.histogram(
@@ -295,6 +298,24 @@ class PoolSupervisor:
         if self._probe_seconds is not None:
             self._probe_seconds.observe(duration)
         draining = self._drain is not None and self._drain.draining
+        if self._autoscaler is not None and not draining:
+            # Observe→forecast→recommend BEFORE the refill below, so an
+            # act-mode target change is what this sweep replenishes to
+            # (docs/autoscaling.md). A draining pool is never resized.
+            try:
+                self._autoscaler.evaluate()
+            except Exception:
+                logger.exception("Autoscaler evaluation failed")
+            # The shrink half of actuation: a lowered target must also
+            # reap the now-excess warm sandboxes (refill alone would hold
+            # an idle pool at its peak size forever). No-op unless an
+            # act-mode decision dropped pool_target below the queue depth.
+            trim = getattr(self._executor, "trim_excess_warm", None)
+            if trim is not None:
+                try:
+                    self.trimmed_total += trim()
+                except Exception:
+                    logger.exception("Warm-pool trim failed")
         if self._refill is not None and not draining:
             # Replenish through the backend's own breaker-gated refill
             # (a no-op while the spawn breaker is open) — kicked
@@ -331,6 +352,7 @@ class PoolSupervisor:
             "sweeps": self.sweeps_total,
             "reaped": self.reaped_total,
             "watchdog_kills": self.watchdog_kills_total,
+            "trimmed": self.trimmed_total,
             "last_sweep_age_s": last_age,
             "inflight": len(self._inflight) if self._inflight is not None else 0,
             "inflight_oldest_age_s": (

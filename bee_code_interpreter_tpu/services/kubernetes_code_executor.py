@@ -128,6 +128,10 @@ class KubernetesCodeExecutor(ExecutorHttpDriver):
         # Executions in flight, killable by the supervisor's stuck-execution
         # watchdog (resilience/supervisor.py).
         self.inflight = InflightRegistry()
+        # Dynamic warm-pool target (docs/autoscaling.md): the PoolAutoscaler
+        # writes this in APP_AUTOSCALE_MODE=act; None means the static
+        # configured target. Every refill reads `pool_target`.
+        self.pool_target_override: int | None = None
         self._closed = False
 
         self._metrics = metrics
@@ -214,6 +218,14 @@ class KubernetesCodeExecutor(ExecutorHttpDriver):
     def pool_spawning_count(self) -> int:
         """Pod groups currently being spawned (metrics/introspection)."""
         return self._spawning_count
+
+    @property
+    def pool_target(self) -> int:
+        """The refill target: the autoscaler's override when one is
+        actuated, the static configured length otherwise."""
+        if self.pool_target_override is not None:
+            return self.pool_target_override
+        return self._config.executor_pod_queue_target_length
 
     # ------------------------------------------------------------- execution
 
@@ -522,6 +534,21 @@ class KubernetesCodeExecutor(ExecutorHttpDriver):
         for pod_name in group.pod_names:
             self._spawn_background(self._delete_pod(pod_name))
 
+    def trim_excess_warm(self) -> int:
+        """Supervisor hook for the autoscaler's act-mode shrink
+        (docs/autoscaling.md): reap queued warm groups beyond the current
+        refill target — without this a scale-down would only stop refills,
+        and an idle pool would hold its peak size forever. Trims the
+        newest-queued first so the survivors' FIFO checkout order is
+        untouched. Returns the number reaped."""
+        trimmed = 0
+        while len(self._queue) > self.pool_target:
+            group = self._queue.pop()
+            self.journal.record(group.name, "reaped", reason="scaled_down")
+            self._kill_group(group)
+            trimmed += 1
+        return trimmed
+
     async def reap_unhealthy_idle(self) -> int:
         """Supervisor hook: probe every *queued* warm group and reap the
         ones that died in place (preemption, OOM, node loss) instead of
@@ -575,11 +602,7 @@ class KubernetesCodeExecutor(ExecutorHttpDriver):
         if self._closed:
             return
         async with self._fill_lock:
-            missing = (
-                self._config.executor_pod_queue_target_length
-                - len(self._queue)
-                - self._spawning_count
-            )
+            missing = self.pool_target - len(self._queue) - self._spawning_count
             if missing <= 0:
                 return
             self._spawning_count += missing

@@ -143,6 +143,10 @@ class NativeProcessCodeExecutor(ExecutorHttpDriver):
         self._refill_gate = asyncio.Semaphore(
             max(1, (os.cpu_count() or 2) - 1)
         )
+        # Dynamic warm-pool target (docs/autoscaling.md): the PoolAutoscaler
+        # writes this in APP_AUTOSCALE_MODE=act; None means the static
+        # configured target. Every refill reads `pool_target`.
+        self.pool_target_override: int | None = None
         self._closed = False
         # The event loop holds only weak refs to tasks; fire-and-forget refills
         # must be anchored here or GC can cancel them mid-spawn.
@@ -225,6 +229,14 @@ class NativeProcessCodeExecutor(ExecutorHttpDriver):
     @property
     def pool_spawning_count(self) -> int:
         return self._spawning_count
+
+    @property
+    def pool_target(self) -> int:
+        """The refill target: the autoscaler's override when one is
+        actuated, the static configured length otherwise."""
+        if self.pool_target_override is not None:
+            return self.pool_target_override
+        return self._config.executor_pod_queue_target_length
 
     # ------------------------------------------------------------- execution
 
@@ -500,6 +512,19 @@ class NativeProcessCodeExecutor(ExecutorHttpDriver):
         except httpx.HTTPError:
             return False
 
+    def trim_excess_warm(self) -> int:
+        """Supervisor hook for the autoscaler's act-mode shrink
+        (docs/autoscaling.md): reap queued warm servers beyond the current
+        refill target (mirror of the Kubernetes backend — a scale-down
+        must shrink the live pool, not just stop refills)."""
+        trimmed = 0
+        while len(self._queue) > self.pool_target:
+            box = self._queue.pop()
+            self.journal.record(box.name, "reaped", reason="scaled_down")
+            self._kill_sandbox(box)
+            trimmed += 1
+        return trimmed
+
     async def reap_unhealthy_idle(self) -> int:
         """Supervisor hook: probe every queued warm sandbox and reap the
         ones that died or wedged in place. Returns the number reaped."""
@@ -539,11 +564,7 @@ class NativeProcessCodeExecutor(ExecutorHttpDriver):
         if self._closed:
             return
         async with self._fill_lock:
-            missing = (
-                self._config.executor_pod_queue_target_length
-                - len(self._queue)
-                - self._spawning_count
-            )
+            missing = self.pool_target - len(self._queue) - self._spawning_count
             if missing <= 0:
                 return
             self._spawning_count += missing

@@ -395,6 +395,13 @@ async def measure_warm_latency_p50_ms(
         storage=Storage(tmp / "objects"), config=config, binary=binary
     )
     analyzer = WorkloadAnalyzer()  # default (empty) policy: the gate's floor cost
+    # The capacity tracker rides the fleet journal exactly as the
+    # composition root wires it (docs/autoscaling.md), so this p50 INCLUDES
+    # the demand-sampling cost — the <5% acceptance budget is measured on
+    # every artifact, not asserted blind.
+    from bee_code_interpreter_tpu.observability import DemandTracker
+
+    executor.journal.add_sink(DemandTracker().on_fleet_event)
     try:
         await executor.fill_sandbox_queue()
         samples: list[float] = []
@@ -446,6 +453,121 @@ async def measure_warm_latency_p50_ms(
         return statistics.median(samples) * 1000, phases_p50
     finally:
         executor.shutdown()
+
+
+async def measure_surge(binary: Path) -> dict | None:
+    """The `surge` phase (docs/autoscaling.md): a load step against the
+    native warm pool, A/B with the predictive autoscaler in ``act`` vs
+    ``off``. Reports time-to-absorb (seconds from the step until a whole
+    burst pops warm again, warm_pop_ratio >= 0.95) and how many requests
+    the admission gate shed while the pool was cold — the two numbers the
+    capacity loop exists to improve. Starts the surge trajectory next to
+    warm p50 and tokens/sec in the BENCH artifact."""
+    from bee_code_interpreter_tpu.config import Config
+    from bee_code_interpreter_tpu.observability import DemandTracker, Forecaster
+    from bee_code_interpreter_tpu.resilience import (
+        AdmissionController,
+        AdmissionRejected,
+        PoolAutoscaler,
+        PoolSupervisor,
+    )
+    from bee_code_interpreter_tpu.services.native_process_code_executor import (
+        NativeProcessCodeExecutor,
+    )
+    from bee_code_interpreter_tpu.services.storage import Storage
+
+    # Burst/pace sized for a 1-core bench box: the native refill pipeline
+    # produces ~5 sandboxes/s there (serialized CPU-bound spawns), so the
+    # sustained demand (~2.7/s) must sit under it or even a perfectly
+    # scaled pool can never catch up and the A/B measures host load.
+    BURST, MAX_ROUNDS, PACE_S = 4, 8, 1.5
+
+    async def arm(mode: str) -> dict:
+        tmp = Path(tempfile.mkdtemp(prefix=f"bench-surge-{mode}-"))
+        config = Config(
+            file_storage_path=str(tmp / "objects"),
+            local_workspace_root=str(tmp / "ws"),
+            executor_pod_queue_target_length=2,
+            disable_dep_install=True,
+        )
+        executor = NativeProcessCodeExecutor(
+            storage=Storage(tmp / "objects"), config=config, binary=binary
+        )
+        demand = DemandTracker()
+        executor.journal.add_sink(demand.on_fleet_event)
+        forecaster = Forecaster(demand)
+        admission = AdmissionController(
+            max_in_flight=8, max_queue=0, retry_after_s=0.1, demand=demand
+        )
+        autoscaler = PoolAutoscaler(
+            executor, forecaster, demand,
+            mode=mode, min_size=1, max_size=8, idle_s=60.0, cooldown_s=0.0,
+            base_target=2,
+        )
+        supervisor = PoolSupervisor(
+            executor, interval_s=0.2, autoscaler=autoscaler
+        )
+
+        async def one_request() -> bool:
+            try:
+                async with admission.admit():
+                    result = await executor.execute(LATENCY_PAYLOAD)
+                    return result.exit_code == 0
+            except AdmissionRejected:
+                return False
+
+        def assigned_counts() -> tuple[int, int]:
+            warm = cold = 0
+            for e in executor.journal.events():
+                if e["state"] == "assigned":
+                    if e.get("reason") == "warm_pop":
+                        warm += 1
+                    else:
+                        cold += 1
+            return warm, cold
+
+        try:
+            await executor.fill_sandbox_queue()
+            supervisor.start()
+            for _ in range(3):  # steady trickle: baseline demand + spawns
+                await one_request()
+                await asyncio.sleep(0.3)
+            t_step = time.perf_counter()
+            absorb_s: float | None = None
+            for _ in range(MAX_ROUNDS):
+                warm0, cold0 = assigned_counts()
+                await asyncio.gather(*(one_request() for _ in range(BURST)))
+                warm1, cold1 = assigned_counts()
+                popped = (warm1 - warm0) + (cold1 - cold0)
+                ratio = (warm1 - warm0) / popped if popped else 1.0
+                if absorb_s is None and ratio >= 0.95:
+                    absorb_s = time.perf_counter() - t_step
+                    break
+                await asyncio.sleep(PACE_S)
+            return {
+                "absorb_s": round(absorb_s, 2) if absorb_s is not None else None,
+                "sheds": demand.sheds_total,
+                "pool_target_final": executor.pool_target,
+                "decisions": len(autoscaler.decisions()),
+            }
+        finally:
+            await supervisor.stop()
+            # Let in-flight refills land before teardown: a spawn racing
+            # aclose() would just die noisily against the closed pool.
+            for _ in range(100):
+                if executor.pool_spawning_count == 0:
+                    break
+                await asyncio.sleep(0.05)
+            await executor.aclose()
+
+    on = await arm("act")
+    off = await arm("off")
+    return {
+        "burst": BURST,
+        "pace_s": PACE_S,
+        "autoscaler_on": on,
+        "autoscaler_off": off,
+    }
 
 
 async def measure_session_latency_p50_ms(
@@ -873,6 +995,19 @@ def main() -> None:
     except Exception as e:
         print(f"streaming TTFB measurement failed: {e}", file=sys.stderr)
 
+    # --- 3a'. surge phase (guarded; extra field only; docs/autoscaling.md):
+    # a load step absorbed by the predictive autoscaler (act) vs the static
+    # pool (off) — time-to-absorb + sheds, the capacity loop's own numbers
+    surge: dict | None = None
+    if binary is not None:
+        try:
+            surge = asyncio.run(
+                asyncio.wait_for(measure_surge(binary), timeout=150.0)
+            )
+            print(f"surge A/B: {surge}", file=sys.stderr)
+        except Exception as e:
+            print(f"surge measurement failed (field omitted): {e}", file=sys.stderr)
+
     # --- 3b. serving phase (guarded; extra field only): tokens/sec + TTFT
     # p50/p95 + inter-token latency with a measured instrumentation on/off
     # A/B (models/serving_bench.py; docs/observability.md "Serving
@@ -925,6 +1060,8 @@ def main() -> None:
     result["streaming_ttfb_ms"] = (
         round(streaming_ttfb_ms, 1) if streaming_ttfb_ms is not None else None
     )
+    if surge is not None:
+        result["surge"] = surge
     if serving is not None:
         result["serving"] = serving
     result["cpu_baseline_gflops"] = round(cpu_gflops, 1)

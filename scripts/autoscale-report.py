@@ -1,0 +1,148 @@
+#!/usr/bin/env python3
+"""Render the pool autoscaler's decision log as a table (docs/autoscaling.md).
+
+Fetches ``GET /v1/autoscale`` from a running service and prints the demand
+snapshot, the forecast, and every retained scaling decision — the artifact
+to read in ``advise`` mode before trusting the autoscaler with ``act``.
+
+Exit codes:
+  0  healthy (or nothing to report)
+  1  service unreachable
+  2  mode=act and the target is unmet past the forecast horizon — the
+     autoscaler asked for capacity the pool could not deliver (spawn
+     failures, breaker open, APP_AUTOSCALE_MAX vs quota): page-worthy.
+
+    python scripts/autoscale-report.py [--url http://localhost:50081]
+        [--limit N] [--json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+import httpx
+
+UNMET_EXIT = 2
+
+
+def fmt_ts(ts: float | None) -> str:
+    if ts is None:
+        return "-"
+    return time.strftime("%H:%M:%S", time.localtime(ts))
+
+
+def render(body: dict, limit: int) -> str:
+    lines = []
+    demand = body.get("demand") or {}
+    forecast = body.get("forecast") or {}
+    lines.append(
+        f"demand: {demand.get('rps_10s', 0):.2f} rps (10s)"
+        f"  peak={demand.get('peak_rps_60s', 0):g} rps"
+        f"  warm_pop={demand.get('warm_pop_ratio_60s', 1.0):.0%}"
+        f"  sheds(60s)={demand.get('sheds_60s', 0)}"
+        f"  concurrency_hw={demand.get('concurrency_high_water_60s', 0)}"
+    )
+    lines.append(
+        f"forecast: {forecast.get('forecast_rps', 0):.2f} rps"
+        f" over a {forecast.get('horizon_s', 0):.1f}s horizon"
+        f" (level={forecast.get('level_rps', 0):.2f}"
+        f" trend={forecast.get('trend_rps_per_s', 0):+.2f}/s"
+        f" peak={forecast.get('peak_rps', 0):g})"
+    )
+    if body.get("mode") is None:
+        lines.append("autoscaler: (none — pool-less local backend)")
+        return "\n".join(lines)
+    lines.append(
+        f"autoscaler: mode={body['mode']}"
+        f"  pool {body.get('current_size', 0)}->{body.get('target', 0)}"
+        f"  bounds=[{body.get('min', '?')}, {body.get('max', '?')}]"
+        f"  decisions={body.get('decisions_total', 0)}"
+    )
+    decisions = (body.get("decisions") or [])[:limit]
+    if not decisions:
+        lines.append("(no scaling decisions retained)")
+        return "\n".join(lines)
+    lines.append("")
+    header = (
+        f"{'TIME':<9} {'ID':<8} {'DIR':<5} {'SIZE':<9} {'REASON':<10} "
+        f"{'FORECAST':>9} {'DEMAND':>7} {'HORIZON':>8} {'APPLIED':>7}"
+    )
+    lines.append(header)
+    lines.append("-" * len(header))
+    for d in decisions:
+        lines.append(
+            f"{fmt_ts(d.get('ts')):<9} {d.get('decision_id', '-'):<8} "
+            f"{d.get('direction', '-'):<5} "
+            f"{str(d.get('from', '?')) + '->' + str(d.get('to', '?')):<9} "
+            f"{d.get('reason', '-'):<10} "
+            f"{d.get('forecast_rps', 0):>6.1f}rps "
+            f"{d.get('demand_rps', 0):>4.1f}rps "
+            f"{d.get('horizon_s', 0):>7.1f}s "
+            f"{'yes' if d.get('applied') else 'no':>7}"
+        )
+    return "\n".join(lines)
+
+
+def target_unmet_past_horizon(body: dict) -> bool:
+    """True when mode=act asked for capacity the pool hasn't delivered one
+    full forecast horizon after the deciding scale-up — the condition that
+    means actuation is broken (quota, spawn failures, open breaker), not
+    merely in progress."""
+    if body.get("mode") != "act":
+        return False
+    target = body.get("target") or 0
+    current = body.get("current_size") or 0
+    if current >= target:
+        return False
+    last = body.get("last_decision")
+    if not last or last.get("direction") != "up":
+        return False
+    # The DECIDING decision's horizon, not the current forecast's: spawn
+    # samples arriving after the decision must neither suppress nor hasten
+    # the page the decision itself promised.
+    horizon = last.get("horizon_s", 0.0) or 0.0
+    return time.time() - (last.get("ts") or 0.0) > horizon
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(
+        description="Render GET /v1/autoscale's decision log as a table."
+    )
+    parser.add_argument("--url", default="http://localhost:50081")
+    parser.add_argument(
+        "--limit", type=int, default=32,
+        help="decisions to show, newest first (default 32)",
+    )
+    parser.add_argument(
+        "--json", action="store_true", help="print the raw JSON body instead"
+    )
+    args = parser.parse_args()
+    base = args.url.rstrip("/")
+    try:
+        with httpx.Client(timeout=10.0) as client:
+            body = (
+                client.get(f"{base}/v1/autoscale").raise_for_status().json()
+            )
+    except httpx.HTTPError as e:
+        print(f"autoscale-report: cannot reach {base}: {e}", file=sys.stderr)
+        return 1
+    if args.json:
+        print(json.dumps(body, indent=2))
+    else:
+        print(render(body, max(0, args.limit)))
+    if target_unmet_past_horizon(body):
+        print(
+            "autoscale-report: TARGET UNMET past the forecast horizon "
+            f"(pool {body.get('current_size')}/{body.get('target')} in "
+            "mode=act) — check spawn failures / breaker state / quota",
+            file=sys.stderr,
+        )
+        return UNMET_EXIT
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -53,6 +53,14 @@ wiring minus kubectl. Scenarios:
                             kind="serving" wide events AND the
                             bci_serving_* counters AND the monitor totals,
                             and the executor path's latency is unchanged
+ 13. autoscale 10x step   — a 10x arrival-rate step under a manual clock:
+                            mode=act pre-spawns within one forecast
+                            horizon (warm_pop_ratio back >= 0.95) while
+                            mode=off keeps paying cold spawns; sheds stay
+                            inside the SLO error budget; every scale
+                            decision lands exactly once in the decision
+                            log, the kind="autoscale" wide events, and
+                            bci_autoscale_decisions_total
 
 Exits nonzero if any scenario misbehaves. Usage:
 
@@ -782,6 +790,163 @@ async def main() -> int:
         finally:
             await pods12.close()
 
+        # 13. capacity loop under a 10x arrival step (docs/autoscaling.md):
+        #     the REAL executor + supervisor + autoscaler over fake pods,
+        #     driven by a manual clock. mode=act pre-spawns within one
+        #     forecast horizon (warm_pop_ratio recovers >= 0.95) while
+        #     mode=off keeps paying cold spawns; sheds stay inside the SLO
+        #     error budget; every decision lands exactly once in the
+        #     decision log, the kind="autoscale" wide events, and
+        #     bci_autoscale_decisions_total.
+        from bee_code_interpreter_tpu.observability import (
+            DemandTracker,
+            Forecaster,
+            SloEngine,
+            parse_objectives,
+        )
+        from bee_code_interpreter_tpu.resilience import PoolAutoscaler
+
+        BURST13, STEP13 = 6, 4
+
+        async def drive_surge13(mode: str) -> dict:
+            clock13 = ManualClock(2000.0)
+            m13 = Registry()
+            recorder13 = FlightRecorder(max_events=64)
+            demand13 = DemandTracker(clock=clock13, metrics=m13)
+            forecaster13 = Forecaster(demand13)
+            slo13 = SloEngine(parse_objectives(99.5, None), clock=clock13)
+            admission13 = AdmissionController(
+                max_in_flight=32, max_queue=0, metrics=m13, demand=demand13
+            )
+            faults13 = FaultPlan()
+            pods13 = FakeExecutorPods(
+                tmp / f"pods13-{mode}", faults=faults13
+            )
+            k8s13 = KubernetesCodeExecutor(
+                kubectl=ChaosKubectl(pods13, faults13),
+                storage=storage,
+                config=Config(
+                    executor_backend="kubernetes",
+                    executor_port=pods13.port,
+                    executor_pod_queue_target_length=2,
+                    pod_ready_timeout_s=5,
+                    executor_retry_attempts=1,
+                ),
+                metrics=m13,
+                ip_poll_interval_s=0.02,
+            )
+            k8s13.journal.add_sink(demand13.on_fleet_event)
+            autoscaler13 = PoolAutoscaler(
+                k8s13, forecaster13, demand13,
+                mode=mode, min_size=1, max_size=12, idle_s=30.0,
+                cooldown_s=0.0, base_target=2, slo=slo13,
+                recorder=recorder13, metrics=m13, clock=clock13,
+            )
+            supervisor13 = PoolSupervisor(
+                k8s13, interval_s=60, autoscaler=autoscaler13
+            )
+
+            async def one_request() -> None:
+                async with admission13.admit():
+                    result = await k8s13.execute("print(1)")
+                    assert result.stdout == "1\n"
+                    slo13.record(ok=True, duration_s=0.01)
+
+            def assigned_counts() -> tuple[int, int]:
+                warm = cold = 0
+                for e in k8s13.journal.events():
+                    if e["state"] == "assigned":
+                        if e.get("reason") == "warm_pop":
+                            warm += 1
+                        else:
+                            cold += 1
+                return warm, cold
+
+            async def settle() -> None:
+                for _ in range(400):
+                    if (
+                        k8s13.pool_ready_count
+                        >= min(k8s13.pool_target, 12)
+                        and k8s13.pool_spawning_count == 0
+                    ):
+                        break
+                    await asyncio.sleep(0.01)
+
+            try:
+                await k8s13.fill_executor_pod_queue()
+                for _ in range(3):  # warm trickle
+                    await one_request()
+                    await supervisor13.sweep_once()
+                    await settle()
+                    clock13.advance(1.0)
+                ratios = []
+                for _ in range(STEP13):  # the 10x step
+                    w0, c0 = assigned_counts()
+                    await asyncio.gather(
+                        *(one_request() for _ in range(BURST13))
+                    )
+                    w1, _ = assigned_counts()
+                    ratios.append((w1 - w0) / BURST13)
+                    await supervisor13.sweep_once()
+                    await settle()
+                    clock13.advance(1.0)
+                return {
+                    "ratios": ratios,
+                    "target": k8s13.pool_target,
+                    "override": k8s13.pool_target_override,
+                    "decisions": autoscaler13.decisions(),
+                    "wide": recorder13.events(kind="autoscale"),
+                    "metrics_text": m13.expose(),
+                    "sheds": demand13.sheds_total,
+                    "arrivals": demand13.arrivals_total,
+                    "horizon": forecaster13.horizon_s(),
+                    "budget_left": slo13.error_budget_remaining(
+                        slo13.objectives[0]
+                    ),
+                }
+            finally:
+                await pods13.close()
+
+        act13 = await drive_surge13("act")
+        off13 = await drive_surge13("off")
+        report(
+            "act absorbs the 10x step within one forecast horizon",
+            act13["ratios"][0] < 0.95
+            and all(r >= 0.95 for r in act13["ratios"][1:])
+            and act13["target"] >= BURST13
+            and act13["override"] is not None,
+            f"per-burst warm ratios {act13['ratios']} "
+            f"target={act13['target']} horizon={act13['horizon']:.1f}s",
+        )
+        report(
+            "off keeps paying cold spawns under the same step",
+            all(r < 0.95 for r in off13["ratios"])
+            and off13["target"] == 2
+            and not off13["decisions"],
+            f"per-burst warm ratios {off13['ratios']} (static target 2)",
+        )
+        report(
+            "sheds stay inside the SLO error budget",
+            act13["sheds"] <= 0.005 * act13["arrivals"]
+            and act13["budget_left"] == 1.0,
+            f"sheds={act13['sheds']} of {act13['arrivals']} arrivals, "
+            f"budget_left={act13['budget_left']:.0%}",
+        )
+        ids13 = [d["decision_id"] for d in act13["decisions"]]
+        counted13 = sum(
+            int(line.rsplit(" ", 1)[1])
+            for line in act13["metrics_text"].splitlines()
+            if line.startswith("bci_autoscale_decisions_total{")
+        )
+        report(
+            "every scale decision accounted exactly once",
+            len(ids13) == len(set(ids13))
+            and sorted(e["decision_id"] for e in act13["wide"])
+            == sorted(ids13)
+            and counted13 == len(ids13),
+            f"{len(ids13)} decision(s) across log/wide-events/counter",
+        )
+
         text = metrics.expose()
         wanted = [
             "bci_executor_fallback_total 1",
@@ -805,8 +970,8 @@ async def main() -> int:
     print(
         "chaos smoke passed: deadline, breaker, fallback, admission, replay, "
         "supervisor, watchdog, drain, telemetry export, edge analysis gate, "
-        "sessions-under-chaos, flight-recorder-logs, serving-saturation all "
-        "behaved"
+        "sessions-under-chaos, flight-recorder-logs, serving-saturation, "
+        "autoscale-10x-step all behaved"
     )
     return 0
 

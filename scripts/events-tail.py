@@ -45,6 +45,17 @@ def render(event: dict) -> str:
         extras.append(f"hedge={event['hedge']}")
     if event.get("kind") == "loop_stall":
         extras.append(f"lag={event.get('lag_s', 0) * 1000:.0f}ms")
+    if event.get("kind") == "autoscale":
+        # One scaling decision (docs/autoscaling.md): direction, size
+        # delta, reason, and whether act mode actually moved the pool.
+        extras.append(
+            f"{event.get('direction', '?')} {event.get('from', '?')}"
+            f"->{event.get('to', '?')} reason={event.get('reason', '?')}"
+        )
+        extras.append(
+            f"mode={event.get('mode', '?')}"
+            + ("" if event.get("applied") else " (not applied)")
+        )
     serving = event.get("serving") or {}
     if serving:
         extras.append(
@@ -94,7 +105,8 @@ def main() -> int:
     parser.add_argument("--outcome", help="filter by outcome (e.g. error)")
     parser.add_argument("--session", help="filter by session id")
     parser.add_argument(
-        "--kind", help="filter by kind (request/session/serving/loop_stall)"
+        "--kind",
+        help="filter by kind (request/session/serving/loop_stall/autoscale)",
     )
     parser.add_argument("--min-duration-ms", type=float, default=None)
     parser.add_argument(

@@ -121,6 +121,38 @@ def render_slo(slo: dict) -> str:
     return line
 
 
+def render_capacity(autoscale: dict) -> str:
+    """One capacity line from ``GET /v1/autoscale`` (docs/autoscaling.md):
+    demand vs forecast, current→target pool size, and the last scaling
+    decision with its reason."""
+    if not autoscale:
+        return "capacity: (no capacity tracker wired)"
+    demand = autoscale.get("demand") or {}
+    forecast = autoscale.get("forecast") or {}
+    line = (
+        f"capacity: demand={demand.get('rps_10s', 0):.1f}rps"
+        f" forecast={forecast.get('forecast_rps', 0):.1f}rps"
+        f" (horizon {forecast.get('horizon_s', 0):.1f}s)"
+        f" warm_pop={demand.get('warm_pop_ratio_60s', 1.0):.0%}"
+    )
+    if autoscale.get("mode") is not None:
+        line += (
+            f"  pool {autoscale.get('current_size', 0)}"
+            f"->{autoscale.get('target', 0)}"
+            f" mode={autoscale['mode']}"
+        )
+        last = autoscale.get("last_decision")
+        if last:
+            line += (
+                f"  last={last.get('direction')}"
+                f" {last.get('from')}->{last.get('to')}"
+                f" ({last.get('reason')})"
+            )
+    else:
+        line += "  (no pool autoscaler: local backend)"
+    return line
+
+
 def render_loop(health: dict) -> str:
     """One event-loop health line from ``GET /healthz?verbose=1`` — a
     stalled loop makes every other number in this view lie by omission."""
@@ -165,6 +197,14 @@ def render_once(client: httpx.Client, base: str, events: int) -> None:
     except httpx.HTTPError:
         slo = {}
     print(render_slo(slo))
+    try:
+        # Older replicas without /v1/autoscale degrade to the no-tracker line.
+        autoscale = (
+            client.get(f"{base}/v1/autoscale").raise_for_status().json()
+        )
+    except httpx.HTTPError:
+        autoscale = {}
+    print(render_capacity(autoscale))
     try:
         health = (
             client.get(f"{base}/healthz", params={"verbose": "1"})

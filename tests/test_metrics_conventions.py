@@ -129,6 +129,14 @@ def test_every_registered_metric_follows_conventions(tmp_path):
         "bci_serving_requeues_total",
         "bci_serving_queue_rejected_total",
         "bci_serving_queue_depth",
+        # capacity observability + predictive autoscaling (ISSUE 10): the
+        # demand tracker + forecaster register in the composition root, the
+        # autoscaler with the pool executor
+        "bci_demand_rps",
+        "bci_forecast_rps",
+        "bci_warm_pop_ratio",
+        "bci_pool_target_size",
+        "bci_autoscale_decisions_total",
     ):
         assert required in metrics, f"{required}: not registered by the wiring"
     assert isinstance(metrics["bci_pool_spawn_seconds"], Histogram)
@@ -165,6 +173,11 @@ def test_every_registered_metric_follows_conventions(tmp_path):
     assert isinstance(metrics["bci_serving_spec_accept_ratio"], Gauge)
     assert isinstance(metrics["bci_serving_prefix_hit_ratio"], Gauge)
     assert isinstance(metrics["bci_serving_page_fragmentation"], Gauge)
+    assert isinstance(metrics["bci_demand_rps"], Gauge)
+    assert isinstance(metrics["bci_forecast_rps"], Gauge)
+    assert isinstance(metrics["bci_warm_pop_ratio"], Gauge)
+    assert isinstance(metrics["bci_pool_target_size"], Gauge)
+    assert isinstance(metrics["bci_autoscale_decisions_total"], Counter)
 
     for name, metric in metrics.items():
         assert name.startswith("bci_"), (

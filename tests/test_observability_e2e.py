@@ -432,7 +432,9 @@ async def test_healthz_verbose_reports_pool_breakers_and_fleet(
         await client.post("/v1/execute", json={"source_code": "print(1)"})
         verbose = await (await client.get("/healthz?verbose=1")).json()
         assert verbose["status"] == "ok"
-        assert verbose["pool"] == {"ready": 0, "spawning": 0}
+        # `target` is the live refill target (docs/autoscaling.md): the
+        # static config length until an act-mode autoscaler overrides it.
+        assert verbose["pool"] == {"ready": 0, "spawning": 0, "target": 0}
         assert verbose["breakers"] == {
             "k8s-spawn": "closed", "k8s-http": "closed",
         }
