@@ -44,6 +44,10 @@ async def main() -> None:
     logger.info("gRPC server listening on %s", ctx.config.grpc_listen_addr)
 
     ctx.start_storage_sweeper()
+    # Once-only sweep of crash-orphaned .tmp-* writer temps (lazily kicked
+    # by the first write otherwise): run at boot so the count is logged
+    # deterministically.
+    await ctx.storage.recover_orphans()
     # Background OTLP push of traces + metric snapshots (APP_OTLP_ENDPOINT);
     # no-op when export isn't configured.
     ctx.start_telemetry_exporter()

@@ -51,7 +51,14 @@ from bee_code_interpreter_tpu.analysis.inspect import (
 
 PACKAGE_ROOT = Path(__file__).resolve().parent.parent
 REPO_ROOT = PACKAGE_ROOT.parent
-DEFAULT_PACKAGES = ("api", "services", "resilience", "observability", "sessions")
+DEFAULT_PACKAGES = (
+    "api",
+    "services",
+    "resilience",
+    "observability",
+    "sessions",
+    "fleet",
+)
 DEFAULT_DOCS = REPO_ROOT / "docs" / "observability.md"
 
 # Blocking entry points that must not run on the event loop. subprocess.Popen

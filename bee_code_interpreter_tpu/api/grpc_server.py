@@ -226,7 +226,11 @@ class CodeInterpreterServicer:
         return Deadline.after(budget) if budget is not None else None
 
     @asynccontextmanager
-    async def _resilience_scope(self, context: grpc.aio.ServicerContext):
+    async def _resilience_scope(
+        self,
+        context: grpc.aio.ServicerContext,
+        allow_draining: bool = False,
+    ):
         """The shared resilience ladder for sandbox-bound RPCs — drain check,
         edge deadline, admission gate, the shed/deadline abort contract
         (docs/resilience.md), and SLI recording — the one place it is spelled
@@ -242,7 +246,9 @@ class CodeInterpreterServicer:
         # Drain check BEFORE admission (mirror of the HTTP edge): a
         # draining replica rejects new work retryably while in-flight RPCs
         # (tracked below) run to completion. Health answers NOT_SERVING.
-        if self._drain is not None and self._drain.draining:
+        # Evacuation ops (``allow_draining``: session checkpoint — the
+        # lease-handoff path, docs/fleet.md) are exempt on BOTH transports.
+        if self._drain is not None and self._drain.draining and not allow_draining:
             context.set_trailing_metadata(
                 (("retry-after-s", f"{self._drain.retry_after_s:g}"),)
             )
@@ -320,10 +326,17 @@ class CodeInterpreterServicer:
                 )
             _annotate_outcome(label, sample.ok)
 
-    async def _with_resilience(self, context: grpc.aio.ServicerContext, run):
+    async def _with_resilience(
+        self,
+        context: grpc.aio.ServicerContext,
+        run,
+        allow_draining: bool = False,
+    ):
         """Run a unary sandbox-bound RPC body under :meth:`_resilience_scope`;
         ``run(deadline)`` returns the success response."""
-        async with self._resilience_scope(context) as (deadline, _sample):
+        async with self._resilience_scope(
+            context, allow_draining=allow_draining
+        ) as (deadline, _sample):
             return await run(deadline)
 
     async def Execute(
@@ -899,7 +912,9 @@ class SessionServicer:
             ).encode()
 
         with s._trace_rpc("Checkpoint", context, rid):
-            return await s._with_resilience(context, run)
+            # allow_draining: lease handoff checkpoints THROUGH the drain
+            # window (docs/fleet.md), matching the HTTP edge.
+            return await s._with_resilience(context, run, allow_draining=True)
 
     async def Rollback(self, request: bytes, context) -> bytes:
         s = self._s

@@ -180,7 +180,7 @@ def create_http_server(
             if sli is not None:
                 trace.root.attributes["sli"] = "good" if sli else "bad"
 
-    async def with_resilience(run):
+    async def with_resilience(run, allow_draining: bool = False):
         """Run a sandbox-bound handler body under the edge deadline and the
         admission gate, mapping the shared shed/deadline response contract
         (docs/resilience.md) — the one place it is spelled for HTTP.
@@ -195,8 +195,11 @@ def create_http_server(
         # Drain check BEFORE admission: a draining replica must not queue
         # new work it has promised to finish — 503 + Retry-After tells the
         # client (or the balancer) to go elsewhere, while requests already
-        # in flight (tracked below) run to completion.
-        if drain is not None and drain.draining:
+        # in flight (tracked below) run to completion. Evacuation ops
+        # (``allow_draining``: session checkpoint — the lease-handoff path,
+        # docs/fleet.md) are exempt: moving existing state OUT is part of
+        # finishing up, not new work.
+        if drain is not None and drain.draining and not allow_draining:
             _annotate_outcome("drained", None)
             return web.json_response(
                 {"detail": "Service draining; retry against another replica"},
@@ -922,7 +925,9 @@ def create_http_server(
                 ).model_dump()
             )
 
-        return await with_resilience(run)
+        # allow_draining: a fleet router evacuating this replica's leases
+        # checkpoints them THROUGH the drain window (docs/fleet.md).
+        return await with_resilience(run, allow_draining=True)
 
     async def session_rollback(request: web.Request) -> web.Response:
         if sessions is None:

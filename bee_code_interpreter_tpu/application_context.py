@@ -157,10 +157,12 @@ class ApplicationContext:
 
     @cached_property
     def storage(self) -> Storage:
-        return Storage(
-            storage_path=self.config.file_storage_path,
-            touch_on_read=self.config.storage_max_age_s is not None,
-        )
+        # Backend selected by APP_STORAGE_BACKEND (docs/fleet.md): local
+        # replica-private directory by default, shared mounted volume or an
+        # S3-shaped store when snapshots must resolve fleet-wide. The
+        # backend's init sweep reaps crash-orphaned .tmp-* writer temps,
+        # counted and logged once.
+        return Storage.from_config(self.config)
 
     def start_storage_sweeper(self) -> asyncio.Task | None:
         """Periodic TTL sweep of stored objects when storage_max_age_s is set
@@ -297,6 +299,12 @@ class ApplicationContext:
                 await aclose()
             elif hasattr(backend, "shutdown"):
                 backend.shutdown()
+        storage = self.__dict__.get("storage")
+        if storage is not None:
+            # After the executor: snapshots may still move during teardown
+            # (lease checkpoints). No-op for directory backends; closes the
+            # s3 backend's HTTP client.
+            await storage.aclose()
 
     def _wrap_pool_executor(self, executor):
         """Shared pool-backend wiring: the replay/hedge front, the
@@ -362,6 +370,7 @@ class ApplicationContext:
             ttl_s=cfg.session_ttl_s,
             idle_s=cfg.session_idle_s,
             sweep_interval_s=cfg.session_sweep_interval_s,
+            drain_grace_s=cfg.session_drain_grace_s,
             retry_after_s=cfg.admission_retry_after_s,
             metrics=self.metrics,
             drain=self.drain,

@@ -266,6 +266,42 @@ class Config(BaseModel):
     session_idle_s: float = Field(default=120.0, gt=0)
     # Expiry sweep cadence; also how quickly a drain reclaims idle leases.
     session_sweep_interval_s: float = Field(default=1.0, gt=0)
+    # Grace between drain start and the sweep force-expiring live leases
+    # (reason="drain"): gives a fleet router time to hand leases off
+    # (checkpoint → re-lease elsewhere → restore, docs/fleet.md) instead of
+    # the replica killing them. 0 keeps the original behavior — first sweep
+    # after drain reclaims everything. Set it at least one router refresh
+    # interval on replicas fronted by a router.
+    session_drain_grace_s: float = Field(default=0.0, ge=0)
+
+    # --- fleet router (new; see docs/fleet.md) ---
+    # The router edge (`python -m bee_code_interpreter_tpu.fleet`) listens
+    # here and proxies /v1/execute, streaming, and session routes across the
+    # replicas below.
+    router_listen_addr: str = "0.0.0.0:50080"
+    # Comma-separated replica base URLs, optionally named:
+    # "r0=http://a:50081,r1=http://b:50081" (bare URLs are auto-named).
+    router_replicas: str | None = None
+    # Background refresh cadence: each tick pulls /v1/fleet (utilization,
+    # drain state, leases) + /v1/slo (burn) from every replica.
+    router_refresh_interval_s: float = Field(default=2.0, gt=0)
+    # Virtual nodes per replica on the consistent-hash ring; more vnodes =
+    # smoother ownership split at a small ring-size cost.
+    router_vnodes: int = Field(default=64, ge=1)
+    # Spill threshold: the ring owner is passed over while its utilization
+    # is at/above this (or its SLO page alert fires) and a healthier
+    # replica exists — affinity is a preference, overload is a veto.
+    router_utilization_spill: float = Field(default=0.9, gt=0, le=1)
+    # Cross-replica attempts per request (sheds/5xx/unreachable walk the
+    # ring to the next replica; the count includes the first attempt).
+    router_retry_attempts: int = Field(default=3, ge=1)
+    # Router -> replica HTTP client timeout (covers the proxied execute).
+    router_http_timeout_s: float = Field(default=120.0, gt=0)
+    # A replica whose refresh has failed for this long is DEAD: out of the
+    # ring until a refresh succeeds again.
+    router_dead_after_s: float = Field(default=10.0, gt=0)
+    # Routing/migration wide events retained in the router's ring.
+    router_events_max: int = Field(default=1024, ge=1)
 
     # --- edge static analysis (new; see docs/analysis.md) ---
     # Master switch for the pre-flight code gate at both API edges: one AST
@@ -297,7 +333,24 @@ class Config(BaseModel):
     policy_deny_paths: str | None = None
     policy_warn_paths: str | None = None
 
-    # --- object storage (reference config.py:74) ---
+    # --- object storage (reference config.py:74; backends in docs/fleet.md) ---
+    # Where snapshot bytes live. `local` (default) is a replica-private flat
+    # directory; `shared` is the same layout on a volume mounted into every
+    # replica (fsync'd commits, age-gated orphan recovery) so snapshot ids
+    # resolve identically fleet-wide; `s3` is an S3-shaped HTTP object store
+    # (PUT/GET/HEAD {endpoint}/{bucket}/{id}) for deployments with a real
+    # object store — the jump the reference plans as "shared volume/S3 in
+    # prod".
+    storage_backend: Literal["local", "shared", "s3"] = "local"
+    # s3 backend: base endpoint URL (e.g. http://minio:9000) and bucket.
+    storage_s3_endpoint: str | None = None
+    storage_s3_bucket: str = "bci-snapshots"
+    storage_s3_timeout_s: float = Field(default=30.0, gt=0)
+    # Shared-backend startup orphan sweep: only `.tmp-*` writer temps older
+    # than this are reaped (a fresh temp may be another live replica's
+    # in-flight upload). The local backend always uses 0 — nothing else
+    # writes its private root.
+    storage_orphan_age_s: float = Field(default=3600.0, ge=0)
     file_storage_path: str = "./.tmp/files"
     # Optional TTL sweep of stored objects (the reference leaves cleanup to
     # the operator, its README.md:167). Unset disables; objects age from

@@ -1,0 +1,25 @@
+"""Fleet tier (docs/fleet.md): the replica-aware router edge that turns N
+single-replica stacks into one logical service — consistent-hash placement
+over shared snapshot storage, per-replica circuit breakers, cross-replica
+retry, mandatory session affinity, and lease handoff on drain."""
+
+from bee_code_interpreter_tpu.fleet.app import create_router_app
+from bee_code_interpreter_tpu.fleet.ring import HashRing, affinity_key
+from bee_code_interpreter_tpu.fleet.router import (
+    FleetRouter,
+    NoReplicasAvailable,
+    Replica,
+    RouterSession,
+    UnknownRouterSession,
+)
+
+__all__ = [
+    "FleetRouter",
+    "HashRing",
+    "NoReplicasAvailable",
+    "Replica",
+    "RouterSession",
+    "UnknownRouterSession",
+    "affinity_key",
+    "create_router_app",
+]

@@ -1,0 +1,73 @@
+"""Router entry point: ``python -m bee_code_interpreter_tpu.fleet``.
+
+Reads the same ``APP_*`` env surface as the service (``APP_ROUTER_LISTEN_ADDR``,
+``APP_ROUTER_REPLICAS``, and the rest of the ``APP_ROUTER_*`` family —
+docs/fleet.md). SIGTERM stops the refresh loop and the listener; the router
+holds no durable state beyond session pins, so a restart re-learns the fleet
+from the first refresh (pinned sessions on a restarted router are gone —
+front the router with more than one instance only if you externalize pins).
+
+    APP_ROUTER_REPLICAS="r0=http://replica-0:50081,r1=http://replica-1:50081" \\
+        python -m bee_code_interpreter_tpu.fleet
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import signal
+
+from aiohttp import web
+
+from bee_code_interpreter_tpu.config import Config
+from bee_code_interpreter_tpu.fleet.app import create_router_app
+from bee_code_interpreter_tpu.fleet.router import FleetRouter
+
+# Explicit name: under `python -m` this module runs as "__main__", which
+# would fall to the root logger's WARNING level and drop the startup lines.
+logger = logging.getLogger("bee_code_interpreter_tpu.fleet.main")
+
+
+async def main() -> None:
+    import logging.config
+
+    from bee_code_interpreter_tpu.utils.request_id import (
+        install_request_id_filter,
+    )
+
+    config = Config.from_env()
+    logging.config.dictConfig(config.resolved_logging_config())
+    # The shared log format expects %(request_id)s on every record; the
+    # filter supplies it (or "-") exactly as the service's own entry point.
+    install_request_id_filter()
+    if not (config.router_replicas or "").strip():
+        raise SystemExit(
+            "APP_ROUTER_REPLICAS is required (comma-separated replica base "
+            "URLs, e.g. http://replica-0:50081,http://replica-1:50081)"
+        )
+    router = FleetRouter.from_config(config)
+    router.start()
+
+    host, _, port = config.router_listen_addr.rpartition(":")
+    runner = web.AppRunner(create_router_app(router), shutdown_timeout=3.0)
+    await runner.setup()
+    await web.TCPSite(runner, host or "0.0.0.0", int(port)).start()
+    logger.info(
+        "Fleet router listening on %s over %d replica(s)",
+        config.router_listen_addr,
+        len(router.replicas),
+    )
+
+    stop = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        loop.add_signal_handler(sig, stop.set)
+    await stop.wait()
+
+    logger.info("Shutting down fleet router")
+    await runner.cleanup()
+    await router.stop()
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

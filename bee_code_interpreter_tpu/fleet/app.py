@@ -1,0 +1,687 @@
+"""The fleet router's own aiohttp app (docs/fleet.md).
+
+Launchable (``python -m bee_code_interpreter_tpu.fleet``) and embeddable in
+tests (``create_router_app(FleetRouter([...]))``). Proxied surface:
+
+- ``POST /v1/execute`` (+ ``?stream=1`` SSE passthrough) — consistent-hash
+  affinity on the request's ``files`` map, cross-replica retry of sheds,
+  unavailability, 5xx, and unreachable replicas.
+- ``POST /v1/parse-custom-tool`` / ``/v1/execute-custom-tool`` — keyless
+  (load-based) placement, same retry envelope.
+- ``POST /v1/sessions`` — placed by the initial snapshot's affinity key and
+  PINNED; every ``/v1/sessions/{id}/*`` call then follows the pin (never
+  retried cross-replica: the lease is one sandbox on one replica).
+- ``GET /v1/fleet/replicas`` — the router's decision/health view;
+  ``POST /v1/fleet/replicas/{name}/drain`` evacuates a replica's leases.
+- ``GET /v1/events`` — the router's own wide events (``kind="routing"`` /
+  ``"lease_migrate"``); ``GET /healthz``; ``GET /metrics``.
+
+Status contract at this edge: 503 + Retry-After when no replica is
+eligible, 502 when every attempt died in transport, 404 for session ids the
+router has no pin for; everything else is the chosen replica's own answer,
+proxied verbatim.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import math
+import time
+
+from aiohttp import web
+
+from bee_code_interpreter_tpu.fleet.ring import affinity_key
+from bee_code_interpreter_tpu.fleet.router import (
+    FleetRouter,
+    NoReplicasAvailable,
+    UnknownRouterSession,
+)
+from bee_code_interpreter_tpu.resilience import BreakerOpenError
+from bee_code_interpreter_tpu.utils.metrics import (
+    OPENMETRICS_CONTENT_TYPE,
+    PROMETHEUS_CONTENT_TYPE,
+    accepts_openmetrics,
+)
+
+logger = logging.getLogger(__name__)
+
+
+def _key_from_body(raw: bytes) -> str | None:
+    """The affinity key from a request body's ``files`` snapshot map;
+    malformed bodies have no key — the replica's own validation is the
+    source of truth for rejecting them."""
+    try:
+        body = json.loads(raw)
+    except (ValueError, UnicodeDecodeError):
+        return None
+    if not isinstance(body, dict):
+        return None
+    files = body.get("files")
+    return affinity_key(files if isinstance(files, dict) else None)
+
+
+def _truthy(request: web.Request, name: str) -> bool:
+    return request.query.get(name, "").lower() in ("1", "true", "yes", "on")
+
+
+def _upstream_response(response) -> web.Response:
+    # passthrough_headers keeps Retry-After: the shed/drain contract's
+    # backoff hint must survive the proxy hop.
+    return web.Response(
+        body=response.content,
+        status=response.status_code,
+        headers=response.passthrough_headers(),
+    )
+
+
+def _no_replicas(e: NoReplicasAvailable) -> web.Response:
+    return web.json_response(
+        {"detail": "no eligible replicas; fleet is draining or down"},
+        status=503,
+        headers={"Retry-After": str(max(1, math.ceil(e.retry_after_s)))},
+    )
+
+
+def create_router_app(router: FleetRouter) -> web.Application:
+    app = web.Application(client_max_size=1 << 30)
+    clock = time.monotonic
+
+    # ------------------------------------------------------ routed proxying
+
+    async def _proxy_routed(
+        request: web.Request, route: str, path: str, keyed: bool, retry_5xx: bool
+    ) -> web.Response:
+        raw = await request.read()
+        key = _key_from_body(raw) if keyed else None
+        headers = router.forward_headers(request.headers)
+        params = dict(request.query)
+        start = clock()
+        try:
+            response, replica, retries = await router.route_buffered(
+                route,
+                "POST",
+                path,
+                key=key,
+                body=raw,
+                headers=headers,
+                params=params,
+                retry_5xx=retry_5xx,
+            )
+        except NoReplicasAvailable as e:
+            router.record_route(
+                route,
+                outcome="unrouteable",
+                replica=None,
+                key=key,
+                duration_s=clock() - start,
+            )
+            return _no_replicas(e)
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:
+            router.record_route(
+                route,
+                outcome="unreachable",
+                replica=None,
+                key=key,
+                duration_s=clock() - start,
+            )
+            logger.warning("All replica attempts failed for %s: %s", route, e)
+            return web.json_response(
+                {"detail": "all replica attempts failed"}, status=502
+            )
+        router.record_route(
+            route,
+            outcome=router.outcome_for_status(response.status_code),
+            replica=replica,
+            key=key,
+            affinity=(
+                router.affinity_result(key, replica)
+                if replica is not None
+                else None
+            ),
+            retries=retries,
+            duration_s=clock() - start,
+        )
+        return _upstream_response(response)
+
+    async def _routed(request, route, path, keyed, retry_5xx=True):
+        return await _proxy_routed(request, route, path, keyed, retry_5xx)
+
+    async def _pump_sse(
+        request: web.Request,
+        route: str,
+        upstream,
+        *,
+        replica: str,
+        key: str | None = None,
+        affinity: str | None = None,
+        session: str | None = None,
+        retries: int,
+        start: float,
+    ) -> web.StreamResponse:
+        """Copy a COMMITTED upstream SSE body to the client, accounting the
+        route exactly once whatever ends the stream. Once ``prepare()`` has
+        run, the response status is spent: failures here are terminal —
+        never retried on another replica, never re-accounted by a caller
+        (only a CancelledError escapes, already recorded)."""
+        response = web.StreamResponse(
+            status=upstream.status_code,
+            headers={
+                **upstream.passthrough_headers("text/event-stream"),
+                "Cache-Control": "no-store",
+                "X-Accel-Buffering": "no",
+            },
+        )
+        response.enable_chunked_encoding()
+        outcome = "error"
+        try:
+            await response.prepare(request)
+            async for chunk in upstream.aiter_bytes():
+                await response.write(chunk)
+            await response.write_eof()
+            outcome = "ok"
+            return response
+        except asyncio.CancelledError:
+            outcome = "cancelled"
+            raise
+        except (ConnectionResetError, ConnectionAbortedError):
+            outcome = "cancelled"  # the downstream client vanished
+            return response
+        except Exception as e:
+            # The upstream died mid-body: delivered chunks cannot be
+            # un-delivered, so this is a terminal truncated stream.
+            logger.warning("Stream relay for %s ended early: %s", route, e)
+            return response
+        finally:
+            router.record_route(
+                route,
+                outcome=outcome,
+                replica=replica,
+                key=key,
+                affinity=affinity,
+                retries=retries,
+                duration_s=clock() - start,
+                session=session,
+            )
+
+    async def _stream_routed(
+        request: web.Request, route: str, path: str, key: str | None, raw: bytes
+    ) -> web.StreamResponse:
+        """SSE passthrough with retry-before-first-byte: sheds and
+        unavailability walk the ring like the buffered path, but once the
+        upstream answered 200 the stream is committed to that replica
+        (``_pump_sse``) — delivered chunks cannot be un-delivered."""
+        headers = router.forward_headers(request.headers)
+        params = dict(request.query)
+        start = clock()
+        exclude: set[str] = set()
+        retries = 0
+        last_verdict: tuple[int, dict, bytes] | None = None
+        for _ in range(router.retry_attempts):
+            try:
+                replica = router.place(key, exclude=exclude)[0]
+            except NoReplicasAvailable as e:
+                if last_verdict is not None:
+                    break
+                router.record_route(
+                    route,
+                    outcome="unrouteable",
+                    replica=None,
+                    key=key,
+                    retries=retries,
+                    duration_s=clock() - start,
+                )
+                return _no_replicas(e)
+            try:
+                async with router.stream_replica(
+                    replica, "POST", path, body=raw, headers=headers, params=params
+                ) as upstream:
+                    reason = router.retry_reason(upstream.status_code)
+                    if reason is not None:
+                        last_verdict = (
+                            upstream.status_code,
+                            upstream.passthrough_headers(),
+                            await upstream.aread(),
+                        )
+                        router.record_retry(reason)
+                        retries += 1
+                        exclude.add(replica.name)
+                        continue
+                    if upstream.status_code >= 400:
+                        body = await upstream.aread()
+                        router.record_route(
+                            route,
+                            outcome="client_error",
+                            replica=replica.name,
+                            key=key,
+                            retries=retries,
+                            duration_s=clock() - start,
+                        )
+                        return web.Response(
+                            body=body,
+                            status=upstream.status_code,
+                            headers=upstream.passthrough_headers(),
+                        )
+                    return await _pump_sse(
+                        request,
+                        route,
+                        upstream,
+                        replica=replica.name,
+                        key=key,
+                        affinity=router.affinity_result(key, replica.name),
+                        retries=retries,
+                        start=start,
+                    )
+            except asyncio.CancelledError:
+                raise  # _pump_sse already accounted a committed stream
+            except BreakerOpenError:
+                # Same handling as the buffered path: an open breaker is a
+                # placement miss, not a transport failure — skip silently.
+                exclude.add(replica.name)
+            except Exception as e:
+                logger.warning(
+                    "Stream attempt on %s failed before first byte: %s",
+                    replica.name,
+                    e,
+                )
+                router.record_retry("unreachable")
+                retries += 1
+                exclude.add(replica.name)
+        if last_verdict is not None:
+            # Out of replicas: the last upstream verdict (a shed or 503,
+            # Retry-After included) is the honest answer — not a 502.
+            status, verdict_headers, body = last_verdict
+            router.record_route(
+                route,
+                outcome=router.outcome_for_status(status),
+                replica=None,
+                key=key,
+                retries=retries,
+                duration_s=clock() - start,
+            )
+            return web.Response(
+                body=body, status=status, headers=verdict_headers
+            )
+        router.record_route(
+            route,
+            outcome="unreachable",
+            replica=None,
+            key=key,
+            retries=retries,
+            duration_s=clock() - start,
+        )
+        return web.json_response(
+            {"detail": "all replica attempts failed"}, status=502
+        )
+
+    async def execute(request: web.Request) -> web.StreamResponse:
+        if _truthy(request, "stream"):
+            raw = await request.read()
+            return await _stream_routed(
+                request, "/v1/execute", "/v1/execute", _key_from_body(raw), raw
+            )
+        return await _routed(request, "/v1/execute", "/v1/execute", keyed=True)
+
+    async def parse_custom_tool(request: web.Request) -> web.Response:
+        return await _routed(
+            request,
+            "/v1/parse-custom-tool",
+            "/v1/parse-custom-tool",
+            keyed=False,
+        )
+
+    async def execute_custom_tool(request: web.Request) -> web.Response:
+        return await _routed(
+            request,
+            "/v1/execute-custom-tool",
+            "/v1/execute-custom-tool",
+            keyed=False,
+        )
+
+    # --------------------------------------------------------- session pins
+
+    async def session_create(request: web.Request) -> web.Response:
+        raw = await request.read()
+        key = _key_from_body(raw)
+        headers = router.forward_headers(request.headers)
+        start = clock()
+        try:
+            # 5xx is NOT retried here: a create that failed after the
+            # replica leased a sandbox would leak that lease if silently
+            # re-run elsewhere; shed/unavailable (nothing leased) still
+            # walk the ring.
+            response, replica, retries = await router.route_buffered(
+                "/v1/sessions",
+                "POST",
+                "/v1/sessions",
+                key=key,
+                body=raw,
+                headers=headers,
+                params=dict(request.query),
+                retry_5xx=False,
+            )
+        except NoReplicasAvailable as e:
+            router.record_route(
+                "/v1/sessions",
+                outcome="unrouteable",
+                replica=None,
+                key=key,
+                duration_s=clock() - start,
+            )
+            return _no_replicas(e)
+        except asyncio.CancelledError:
+            raise
+        except Exception:
+            router.record_route(
+                "/v1/sessions",
+                outcome="unreachable",
+                replica=None,
+                key=key,
+                duration_s=clock() - start,
+            )
+            return web.json_response(
+                {"detail": "all replica attempts failed"}, status=502
+            )
+        session_id = None
+        if response.status_code == 200 and replica is not None:
+            session_id = response.json().get("session_id")
+            if session_id:
+                router.pin_session(session_id, replica)
+        router.record_route(
+            "/v1/sessions",
+            outcome=router.outcome_for_status(response.status_code),
+            replica=replica,
+            key=key,
+            affinity=(
+                router.affinity_result(key, replica)
+                if replica is not None
+                else None
+            ),
+            retries=retries,
+            duration_s=clock() - start,
+            session=session_id,
+        )
+        return _upstream_response(response)
+
+    def _public_body(response, session) -> bytes:
+        """A migrated session's replica answers with ITS lease id; the
+        client must keep seeing the stable public id."""
+        if session.backend_id == session.public_id:
+            return response.content
+        try:
+            body = response.json()
+        except ValueError:
+            return response.content
+        if isinstance(body, dict) and "session_id" in body:
+            body["session_id"] = session.public_id
+            return json.dumps(body).encode()
+        return response.content
+
+    async def _session_op(
+        request: web.Request, route: str, method: str, suffix: str
+    ) -> web.StreamResponse:
+        session_id = request.match_info["session_id"]
+        start = clock()
+        try:
+            session = router.get_session(session_id)
+        except UnknownRouterSession as e:
+            router.record_route(
+                route,
+                outcome="client_error",
+                replica=None,
+                session=session_id,
+                duration_s=clock() - start,
+            )
+            return web.json_response({"detail": str(e)}, status=404)
+        raw = await request.read()
+        headers = router.forward_headers(request.headers)
+        params = dict(request.query)
+        streaming = suffix == "/execute" and _truthy(request, "stream")
+        async with session.lock:
+            replica = router.replicas[session.replica]
+            path = f"/v1/sessions/{session.backend_id}{suffix}"
+            try:
+                if streaming:
+                    # Pinned stream: no cross-replica retry possible, so
+                    # drive the passthrough directly under the lock (a
+                    # migration must wait out the in-flight REPL turn).
+                    return await _pinned_stream(
+                        request, route, session, replica, path, raw,
+                        headers, params, start,
+                    )
+                response = await router.call_replica(
+                    replica, method, path, body=raw, headers=headers, params=params
+                )
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:
+                router.record_route(
+                    route,
+                    outcome="unreachable",
+                    replica=session.replica,
+                    session=session_id,
+                    duration_s=clock() - start,
+                )
+                logger.warning(
+                    "Pinned session call to %s failed: %s", session.replica, e
+                )
+                return web.json_response(
+                    {"detail": "leasing replica unreachable"}, status=502
+                )
+            retries = 0
+            if response.status_code == 503 and method != "DELETE":
+                # The pinned replica is draining (or its breaker is open):
+                # hand the lease off NOW — checkpoint is exempt from the
+                # drain gate exactly for this — and re-issue the call once
+                # against the new lease. The handoff is invisible to the
+                # client: same public id, state restored from the shared
+                # checkpoint.
+                rescued = await router.migrate_session(
+                    session, exclude={session.replica}, locked=True
+                )
+                if rescued:
+                    retries = 1
+                    router.record_retry("unavailable")
+                    replica = router.replicas[session.replica]
+                    path = f"/v1/sessions/{session.backend_id}{suffix}"
+                    try:
+                        response = await router.call_replica(
+                            replica,
+                            method,
+                            path,
+                            body=raw,
+                            headers=headers,
+                            params=params,
+                        )
+                    except asyncio.CancelledError:
+                        raise
+                    except Exception:
+                        router.record_route(
+                            route,
+                            outcome="unreachable",
+                            replica=session.replica,
+                            session=session_id,
+                            retries=retries,
+                            duration_s=clock() - start,
+                        )
+                        return web.json_response(
+                            {"detail": "leasing replica unreachable"},
+                            status=502,
+                        )
+            if response.status_code == 404:
+                # The backend lease is gone (expired/released there): the
+                # pin is stale and must not shadow future ids.
+                router.unpin_session(session_id)
+            if method == "DELETE" and response.status_code < 400:
+                router.unpin_session(session_id)
+            router.record_route(
+                route,
+                outcome=router.outcome_for_status(response.status_code),
+                replica=session.replica,
+                session=session_id,
+                retries=retries,
+                duration_s=clock() - start,
+            )
+            return web.Response(
+                body=_public_body(response, session),
+                status=response.status_code,
+                headers=response.passthrough_headers(),
+            )
+
+    async def _pinned_stream(
+        request, route, session, replica, path, raw, headers, params, start
+    ) -> web.StreamResponse:
+        """Pinned SSE: no cross-replica retry ever; the pump owns the
+        accounting once the stream is committed. Failures OPENING the
+        stream propagate to ``_session_op``'s handler (nothing prepared,
+        nothing recorded yet)."""
+        async with router.stream_replica(
+            replica, "POST", path, body=raw, headers=headers, params=params
+        ) as upstream:
+            if upstream.status_code >= 400:
+                body = await upstream.aread()
+                if upstream.status_code == 404:
+                    router.unpin_session(session.public_id)
+                router.record_route(
+                    route,
+                    outcome=router.outcome_for_status(upstream.status_code),
+                    replica=session.replica,
+                    session=session.public_id,
+                    duration_s=clock() - start,
+                )
+                return web.Response(
+                    body=body,
+                    status=upstream.status_code,
+                    headers=upstream.passthrough_headers(),
+                )
+            return await _pump_sse(
+                request,
+                route,
+                upstream,
+                replica=session.replica,
+                session=session.public_id,
+                retries=0,
+                start=start,
+            )
+
+    async def session_execute(request: web.Request) -> web.StreamResponse:
+        return await _session_op(
+            request, "/v1/sessions/{id}/execute", "POST", "/execute"
+        )
+
+    async def session_checkpoint(request: web.Request) -> web.Response:
+        return await _session_op(
+            request, "/v1/sessions/{id}/checkpoint", "POST", "/checkpoint"
+        )
+
+    async def session_rollback(request: web.Request) -> web.Response:
+        return await _session_op(
+            request, "/v1/sessions/{id}/rollback", "POST", "/rollback"
+        )
+
+    async def session_delete(request: web.Request) -> web.Response:
+        return await _session_op(request, "/v1/sessions/{id}", "DELETE", "")
+
+    async def session_list(_request: web.Request) -> web.Response:
+        return web.json_response(
+            {
+                "sessions": [s.to_dict() for s in router.sessions.values()],
+                "pinned": len(router.sessions),
+            }
+        )
+
+    # ------------------------------------------------------- router surface
+
+    async def fleet_replicas(_request: web.Request) -> web.Response:
+        return web.json_response(router.snapshot())
+
+    async def drain_replica(request: web.Request) -> web.Response:
+        name = request.match_info["name"]
+        try:
+            tally = await router.drain_replica(name)
+        except KeyError:
+            return web.json_response(
+                {"detail": f"unknown replica {name!r}"}, status=404
+            )
+        return web.json_response({"replica": name, **tally})
+
+    async def events(request: web.Request) -> web.Response:
+        query = request.query
+        try:
+            limit = int(query["limit"]) if "limit" in query else None
+            min_duration_ms = (
+                float(query["min_duration_ms"])
+                if "min_duration_ms" in query
+                else None
+            )
+            since = float(query["since"]) if "since" in query else None
+        except ValueError:
+            return web.json_response(
+                {"detail": "limit, min_duration_ms and since must be numeric"},
+                status=400,
+            )
+        if limit is not None and limit < 0:
+            return web.json_response(
+                {"detail": "limit must be >= 0"}, status=400
+            )
+        return web.json_response(
+            {
+                "events": router.recorder.events(
+                    limit=limit,
+                    kind=query.get("kind"),
+                    outcome=query.get("outcome"),
+                    session=query.get("session"),
+                    min_duration_ms=min_duration_ms,
+                    since=since,
+                )
+            }
+        )
+
+    async def healthz(request: web.Request) -> web.Response:
+        """The router's own liveness + the fleet reachability verdict
+        ``health_check.py --router`` keys off: a router with zero healthy
+        replicas is alive but can't route — status "degraded"."""
+        now = clock()
+        by_state: dict[str, list[str]] = {"healthy": [], "draining": [], "dead": []}
+        for replica in router.replicas.values():
+            by_state[replica.state(now, router.dead_after_s)].append(
+                replica.name
+            )
+        status = "ok" if by_state["healthy"] else "degraded"
+        body = {"status": status, "replicas": {k: sorted(v) for k, v in by_state.items()}}
+        if request.query.get("verbose", "").lower() in ("1", "true", "yes", "on"):
+            body["sessions_pinned"] = len(router.sessions)
+            body["totals"] = dict(router.totals)
+        return web.json_response(body)
+
+    async def metrics_endpoint(request: web.Request) -> web.Response:
+        openmetrics = accepts_openmetrics(request.headers.get("Accept", ""))
+        return web.Response(
+            body=router.metrics.expose(openmetrics=openmetrics).encode("utf-8"),
+            headers={
+                "Content-Type": (
+                    OPENMETRICS_CONTENT_TYPE
+                    if openmetrics
+                    else PROMETHEUS_CONTENT_TYPE
+                )
+            },
+        )
+
+    app.router.add_post("/v1/execute", execute)
+    app.router.add_post("/v1/parse-custom-tool", parse_custom_tool)
+    app.router.add_post("/v1/execute-custom-tool", execute_custom_tool)
+    app.router.add_post("/v1/sessions", session_create)
+    app.router.add_get("/v1/sessions", session_list)
+    app.router.add_post("/v1/sessions/{session_id}/execute", session_execute)
+    app.router.add_post("/v1/sessions/{session_id}/checkpoint", session_checkpoint)
+    app.router.add_post("/v1/sessions/{session_id}/rollback", session_rollback)
+    app.router.add_delete("/v1/sessions/{session_id}", session_delete)
+    app.router.add_get("/v1/fleet/replicas", fleet_replicas)
+    app.router.add_post("/v1/fleet/replicas/{name}/drain", drain_replica)
+    app.router.add_get("/v1/events", events)
+    app.router.add_get("/healthz", healthz)
+    app.router.add_get("/metrics", metrics_endpoint)
+    return app

@@ -1,0 +1,100 @@
+"""Consistent-hash ring + execution affinity keys (docs/fleet.md).
+
+Placement wants two properties at once: *stability* (adding or losing one
+replica must not reshuffle every key — a reshuffle throws away every warm
+snapshot chain at once) and *affinity* (the same key must keep landing on
+the same replica, because that replica's content-addressed store and XLA
+compile cache are warm for it). A consistent-hash ring with virtual nodes
+gives both: each replica owns ``vnodes`` pseudo-random arcs of the hash
+space, a key belongs to the first arc clockwise of its hash, and losing a
+replica only re-homes the arcs it owned.
+
+The affinity key is the execution's **files hash chain**: the sha256 over
+the sorted ``{path: object_id}`` snapshot map. Repeat executions over the
+same workspace (an agent iterating on one checkpoint chain) hash
+identically and land where their snapshots are warm; executions with no
+files have no affinity and are placed by load instead.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from bisect import bisect_right
+
+
+def affinity_key(files: dict | None) -> str | None:
+    """The placement key for one execution: sha256 over the sorted
+    ``{path: object_id}`` map, or None when there is nothing to be warm
+    for (docs/fleet.md "Placement rules")."""
+    if not files:
+        return None
+    hasher = hashlib.sha256()
+    for path in sorted(files):
+        hasher.update(str(path).encode())
+        hasher.update(b"\0")
+        hasher.update(str(files[path]).encode())
+        hasher.update(b"\0")
+    return hasher.hexdigest()
+
+
+def _point(token: str) -> int:
+    return int.from_bytes(hashlib.sha256(token.encode()).digest()[:8], "big")
+
+
+class HashRing:
+    """Virtual-node consistent-hash ring over replica names. Membership is
+    the *registered* fleet, not the healthy one: health filters placement
+    (``FleetRouter.place``), never ring ownership, so a replica bouncing in
+    and out of health keeps its arcs — and its warm keys — stable."""
+
+    _SPACE = 1 << 64
+
+    def __init__(self, vnodes: int = 64) -> None:
+        self._vnodes = max(1, vnodes)
+        self._points: list[tuple[int, str]] = []  # sorted (point, name)
+
+    def add(self, name: str) -> None:
+        for i in range(self._vnodes):
+            self._points.append((_point(f"{name}#{i}"), name))
+        self._points.sort()
+
+    def remove(self, name: str) -> None:
+        self._points = [(p, n) for p, n in self._points if n != name]
+
+    def __contains__(self, name: str) -> bool:
+        return any(n == name for _, n in self._points)
+
+    def owner(self, key: str) -> str | None:
+        """The replica whose arc contains ``key`` — the warm home."""
+        order = self.preference(key, limit=1)
+        return order[0] if order else None
+
+    def preference(self, key: str, limit: int | None = None) -> list[str]:
+        """Distinct replicas in ring order clockwise from ``key``'s hash:
+        the owner first, then the natural spill-over sequence (the same
+        order a key would re-home through as replicas drop)."""
+        if not self._points:
+            return []
+        idx = bisect_right(self._points, (_point(key), "￿"))
+        seen: dict[str, None] = {}
+        for offset in range(len(self._points)):
+            name = self._points[(idx + offset) % len(self._points)][1]
+            if name not in seen:
+                seen[name] = None
+                if limit is not None and len(seen) >= limit:
+                    break
+        return list(seen)
+
+    def shares(self) -> dict[str, float]:
+        """Fraction of the hash space each replica owns (vnodes make these
+        approach 1/N); the ``ring_share`` column in fleet-router-top."""
+        if not self._points:
+            return {}
+        out: dict[str, float] = {}
+        for i, (point, name) in enumerate(self._points):
+            prev = self._points[i - 1][0]
+            arc = (point - prev) % self._SPACE
+            if arc == 0 and len(self._points) == 1:
+                arc = self._SPACE
+            out[name] = out.get(name, 0.0) + arc / self._SPACE
+        return out

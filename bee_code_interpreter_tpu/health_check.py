@@ -14,9 +14,15 @@ states, fleet aggregates, SLO state — docs/observability.md), prints it,
 and exits ``4`` when a fast-window SLO burn-rate alert is firing — alive,
 but spending error budget at page rate.
 
+``--router`` probes a fleet-router edge (docs/fleet.md) instead: ``addr`` is
+the router's HTTP listener, the probe reads ``GET /v1/fleet/replicas``, and
+the exit reuses the same ladder — ``2`` when any replica is unreachable
+(dead), ``3`` when replicas are draining (and none dead), ``0`` when every
+replica is healthy.
+
     python -m bee_code_interpreter_tpu.health_check [addr] \\
         [--timeout S] [--attempts N] [--backoff S] \\
-        [--verbose] [--http-addr HOST:PORT]
+        [--verbose] [--http-addr HOST:PORT] [--router]
 """
 
 from __future__ import annotations
@@ -124,15 +130,80 @@ async def check(
     raise last
 
 
-def _default_http_addr() -> str:
-    """The service's own HTTP listener config (APP_HTTP_LISTEN_ADDR — the
-    same env the service reads), with wildcard binds mapped to localhost
-    so the probe dials something connectable."""
-    listen = os.environ.get("APP_HTTP_LISTEN_ADDR", "localhost:50081")
+def _connectable(listen: str) -> str:
+    """A listen address as something the probe can dial: wildcard binds
+    mapped to localhost."""
     host, _, port = listen.rpartition(":")
     if host in ("", "0.0.0.0", "::", "[::]"):
         host = "localhost"
     return f"{host}:{port}"
+
+
+def _default_http_addr() -> str:
+    """The service's own HTTP listener config (APP_HTTP_LISTEN_ADDR — the
+    same env the service reads)."""
+    return _connectable(os.environ.get("APP_HTTP_LISTEN_ADDR", "localhost:50081"))
+
+
+def _default_router_addr() -> str:
+    """The router's own listener config (APP_ROUTER_LISTEN_ADDR — the same
+    env ``python -m bee_code_interpreter_tpu.fleet`` reads), so a bare
+    ``--router`` probe inside the router pod dials the right port."""
+    return _connectable(
+        os.environ.get("APP_ROUTER_LISTEN_ADDR", "localhost:50080")
+    )
+
+
+def assess_router(body: dict) -> tuple[int, str]:
+    """The ``--router`` verdict from a ``GET /v1/fleet/replicas`` document:
+    ``(exit_code, message)`` on the standard ladder — dead replicas beat
+    draining ones; an empty fleet is dead by definition."""
+    replicas = body.get("replicas") or []
+    dead = sorted(r["name"] for r in replicas if r.get("state") == "dead")
+    draining = sorted(
+        r["name"] for r in replicas if r.get("state") == "draining"
+    )
+    healthy = sorted(
+        r["name"] for r in replicas if r.get("state") == "healthy"
+    )
+    if dead:
+        return 2, (
+            f"UNHEALTHY: {len(dead)}/{len(replicas)} replica(s) "
+            f"unreachable: {', '.join(dead)}"
+        )
+    if not healthy:
+        return 2, "UNHEALTHY: router has no healthy replicas"
+    if draining:
+        return DRAINING_EXIT, (
+            f"DRAINING: replica(s) in graceful drain: {', '.join(draining)}"
+        )
+    return 0, f"healthy ({len(healthy)} replica(s))"
+
+
+async def router_replicas(http_addr: str, timeout: float = 10.0) -> dict:
+    """The router's ``GET /v1/fleet/replicas`` document."""
+    async with httpx.AsyncClient(timeout=timeout) as client:
+        response = await client.get(f"http://{http_addr}/v1/fleet/replicas")
+        response.raise_for_status()
+        return response.json()
+
+
+def router_main(args) -> None:
+    try:
+        body = asyncio.run(
+            router_replicas(args.addr, timeout=min(args.timeout, 15.0))
+        )
+    except Exception as e:
+        print(
+            f"UNHEALTHY: fleet router at {args.addr} unreachable: {e}",
+            file=sys.stderr,
+        )
+        sys.exit(2)
+    code, message = assess_router(body)
+    print(message, file=sys.stderr if code else sys.stdout)
+    if args.verbose:
+        print(json.dumps(body, indent=2))
+    sys.exit(code)
 
 
 async def verbose_health(http_addr: str, timeout: float = 10.0) -> dict:
@@ -149,11 +220,9 @@ def main() -> None:
     parser = argparse.ArgumentParser(
         description="End-to-end gRPC health check (Execute must return 42)."
     )
-    parser.add_argument(
-        "addr",
-        nargs="?",
-        default=os.environ.get("APP_GRPC_ADDR", "localhost:50051"),
-    )
+    # Resolved after parsing: the right default depends on --router (the
+    # router's HTTP listener, not the replica's gRPC one).
+    parser.add_argument("addr", nargs="?", default=None)
     parser.add_argument(
         "--timeout",
         type=float,
@@ -181,7 +250,19 @@ def main() -> None:
         help="HTTP listener for the --verbose deep-health view "
         "(default: derived from APP_HTTP_LISTEN_ADDR)",
     )
+    parser.add_argument(
+        "--router",
+        action="store_true",
+        help="probe a fleet-router edge instead: addr is the router's HTTP "
+        "listener; exits 2 listing unreachable replicas, 3 when replicas "
+        "are draining (docs/fleet.md)",
+    )
     args = parser.parse_args()
+    if args.router:
+        args.addr = args.addr or _default_router_addr()
+        router_main(args)
+        return
+    args.addr = args.addr or os.environ.get("APP_GRPC_ADDR", "localhost:50051")
     try:
         asyncio.run(
             check(

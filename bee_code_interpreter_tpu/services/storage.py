@@ -4,116 +4,539 @@ The reference stores files in a flat directory under *random* 64-hex ids despite
 its docstring claiming sha256 addressing (reference: src/code_interpreter/services/
 storage.py:34-90, the random id at :52). We implement what the docstring promised:
 the object id IS the sha256 of the content, computed while streaming the write and
-atomically renamed into place on close. This gives free dedup across executions
-(identical workspace files snapshot to the same object) while keeping the exact
-same API contract — clients treat ids as opaque ``Hash`` strings either way.
+atomically published under that id on commit. This gives free dedup across
+executions (identical workspace files snapshot to the same object) while keeping
+the exact same API contract — clients treat ids as opaque ``Hash`` strings.
 
-Async file I/O uses a worker thread via asyncio.to_thread per chunk, mirroring the
-reference's anyio usage without the dependency on anyio.Path semantics.
+Fleet tier (docs/fleet.md): the byte persistence behind that contract is a
+pluggable **backend seam**, because "where the bytes live" is exactly what
+changes when one replica becomes N (the reference plans the same jump:
+"shared volume/S3 in prod", its storage.py docstring):
+
+- :class:`LocalDirectoryBackend` — the original flat directory, private to
+  one replica.
+- :class:`SharedDirectoryBackend` — the same layout on a *shared* mounted
+  volume: commits fsync file and directory before/after the atomic rename
+  (a network mount that loses the rename loses the snapshot), and the
+  startup orphan sweep only reaps temp files old enough that no live
+  replica can still be writing them.
+- :class:`S3HttpBackend` — an S3-shaped HTTP object store
+  (``PUT/GET/HEAD {endpoint}/{bucket}/{object_id}``), exercised in-repo
+  against ``tests.fakes.FakeS3``. TTL cleanup belongs to bucket lifecycle
+  rules, so :meth:`Storage.sweep` is an accounted no-op there.
+
+Because ids are content hashes, an object written through ANY backend
+instance is readable by any other instance pointed at the same root/bucket —
+the property that makes snapshots replica-agnostic (the conformance suite in
+``tests/test_storage_backends.py`` proves it per backend rather than
+assuming it).
+
+Async file I/O uses a worker thread via asyncio.to_thread per chunk, mirroring
+the reference's anyio usage without the dependency on anyio.Path semantics.
 """
 
 from __future__ import annotations
 
 import asyncio
 import hashlib
+import logging
 import os
 import secrets
+import time
 from contextlib import asynccontextmanager
 from pathlib import Path
 from typing import AsyncIterator
 
 from bee_code_interpreter_tpu.utils.validation import Hash
 
+logger = logging.getLogger(__name__)
+
 
 class ObjectReader:
-    def __init__(self, path: Path, chunk_size: int = 1 << 20) -> None:
-        self._path = path
+    """Facade over a backend read handle; chunked async iteration."""
+
+    def __init__(self, handle, chunk_size: int = 1 << 20) -> None:
+        self._handle = handle
         self._chunk_size = chunk_size
+
+    async def read(self, size: int = -1) -> bytes:
+        return await self._handle.read(size)
+
+    async def __aiter__(self) -> AsyncIterator[bytes]:
+        while chunk := await self._handle.read(self._chunk_size):
+            yield chunk
+
+    async def _close(self) -> None:
+        await self._handle.close()
+
+
+class ObjectWriter:
+    """Streams bytes to a backend staging area while hashing; the final id
+    is the sha256 hex, published atomically on commit."""
+
+    def __init__(self, handle) -> None:
+        self._handle = handle
+        self._hasher = hashlib.sha256()
+        self.hash: Hash | None = None
+
+    async def write(self, data: bytes) -> None:
+        self._hasher.update(data)
+        await self._handle.write(data)
+
+    async def _finalize(self) -> None:
+        self.hash = self._hasher.hexdigest()
+        await self._handle.commit(self.hash)
+
+    async def _abort(self) -> None:
+        await self._handle.abort()
+
+
+# --------------------------------------------------------------- fs backends
+
+
+class _FsReadHandle:
+    def __init__(self, path: Path) -> None:
+        self._path = path
         self._file = None
 
-    async def _open(self) -> None:
+    async def open(self) -> "_FsReadHandle":
         self._file = await asyncio.to_thread(open, self._path, "rb")
+        return self
 
     async def read(self, size: int = -1) -> bytes:
         return await asyncio.to_thread(self._file.read, size)
 
-    async def __aiter__(self) -> AsyncIterator[bytes]:
-        while chunk := await asyncio.to_thread(self._file.read, self._chunk_size):
-            yield chunk
-
-    async def _close(self) -> None:
+    async def close(self) -> None:
         await asyncio.to_thread(self._file.close)
 
 
-class ObjectWriter:
-    """Streams bytes to a temp file while hashing; final id is the sha256 hex."""
-
-    def __init__(self, root: Path) -> None:
+class _FsWriteHandle:
+    def __init__(self, root: Path, durable: bool) -> None:
         self._root = root
         self._tmp_path = root / f".tmp-{secrets.token_hex(8)}"
-        self._hasher = hashlib.sha256()
+        self._durable = durable
         self._file = None
-        self.hash: Hash | None = None
 
-    async def _open(self) -> None:
+    async def open(self) -> "_FsWriteHandle":
         self._file = await asyncio.to_thread(open, self._tmp_path, "wb")
+        return self
 
     async def write(self, data: bytes) -> None:
-        self._hasher.update(data)
         await asyncio.to_thread(self._file.write, data)
 
-    async def _finalize(self) -> None:
-        await asyncio.to_thread(self._file.close)
-        self.hash = self._hasher.hexdigest()
-        final = self._root / self.hash
-        # Content-addressed: identical content → same path; rename is atomic and
-        # overwriting an identical object is a no-op.
-        await asyncio.to_thread(os.replace, self._tmp_path, final)
+    async def commit(self, object_id: Hash) -> None:
+        def _commit() -> None:
+            if self._durable:
+                # Shared mount: the bytes AND the rename must survive the
+                # writer replica dying right after commit — another replica
+                # may already be resolving this id.
+                self._file.flush()
+                os.fsync(self._file.fileno())
+            self._file.close()
+            # Content-addressed: identical content → same path; rename is
+            # atomic and overwriting an identical object is a no-op.
+            os.replace(self._tmp_path, self._root / object_id)
+            if self._durable:
+                fd = os.open(self._root, os.O_RDONLY)
+                try:
+                    os.fsync(fd)
+                finally:
+                    os.close(fd)
 
-    async def _abort(self) -> None:
-        await asyncio.to_thread(self._file.close)
+        await asyncio.to_thread(_commit)
+
+    async def abort(self) -> None:
+        def _abort() -> None:
+            self._file.close()
+            try:
+                os.unlink(self._tmp_path)
+            except FileNotFoundError:
+                pass
+
+        await asyncio.to_thread(_abort)
+
+
+class LocalDirectoryBackend:
+    """Flat-directory object store keyed by content hash — one replica's
+    private store (the original ``Storage`` behavior)."""
+
+    name = "local"
+    _durable = False
+
+    def __init__(
+        self, root: str | os.PathLike, orphan_min_age_s: float = 0.0
+    ) -> None:
+        self.root = Path(root)
+        self._orphan_min_age_s = orphan_min_age_s
+        self.orphans_recovered: int | None = None  # set by the first sweep
+
+    async def recover_orphans(self) -> int:
+        """Startup sweep of orphaned writer temps: a crash mid-ObjectWriter
+        leaks ``.tmp-*`` files forever in the flat object dir (nothing else
+        ever touches them — the TTL sweep deliberately skips in-flight
+        temps). Runs ONCE — kicked by the first ``start_write`` (or
+        explicitly at boot), off-loop like every other directory walk here,
+        counted and logged. Only temps that already existed when the sweep
+        started are candidates (the cutoff is captured first), so a writer
+        racing the sweep can never lose its fresh temp; the min-age gate
+        additionally matters on shared roots, where a recent ``.tmp-*`` may
+        be another live replica's upload. ``.tmp-sweep-`` guards belong to
+        the TTL sweep's own crash recovery and are left for it."""
+        if self.orphans_recovered is not None:
+            return self.orphans_recovered
+        self.orphans_recovered = 0  # claimed: concurrent writers skip
+        cutoff = time.time() - self._orphan_min_age_s
+
+        def _recover() -> int:
+            if not self.root.is_dir():
+                return 0
+            removed = 0
+            for entry in self.root.iterdir():
+                name = entry.name
+                if not name.startswith(".tmp-") or name.startswith(
+                    ".tmp-sweep-"
+                ):
+                    continue
+                try:
+                    if entry.stat().st_mtime < cutoff:
+                        entry.unlink()
+                        removed += 1
+                except OSError:
+                    continue
+            return removed
+
+        self.orphans_recovered = await asyncio.to_thread(_recover)
+        if self.orphans_recovered:
+            logger.info(
+                "Storage recovered %d orphaned temp file(s) in %s",
+                self.orphans_recovered,
+                self.root,
+            )
+        return self.orphans_recovered
+
+    def _object_path(self, object_id: Hash) -> Path:
+        # Hash pattern forbids "/" and ".." so a plain join cannot escape root.
+        return self.root / object_id
+
+    async def open_read(self, object_id: Hash) -> _FsReadHandle:
+        return await _FsReadHandle(self._object_path(object_id)).open()
+
+    async def start_write(self) -> _FsWriteHandle:
+        if self.orphans_recovered is None:
+            await self.recover_orphans()
+        await asyncio.to_thread(self.root.mkdir, 0o777, True, True)
+        return await _FsWriteHandle(self.root, self._durable).open()
+
+    async def exists(self, object_id: Hash) -> bool:
+        return await asyncio.to_thread(self._object_path(object_id).exists)
+
+    async def touch(self, object_id: Hash) -> None:
         try:
-            await asyncio.to_thread(os.unlink, self._tmp_path)
-        except FileNotFoundError:
+            await asyncio.to_thread(os.utime, self._object_path(object_id))
+        except OSError:
             pass
+
+    def describe(self) -> dict:
+        return {"backend": self.name, "root": str(self.root)}
+
+    async def aclose(self) -> None:
+        pass
+
+    async def sweep(self, max_age_s: float) -> int:
+        """Delete objects untouched for longer than ``max_age_s``; returns the
+        count removed.
+
+        The reference leaves cleanup to the operator ("temporary solution ...
+        S3 TTL", its README.md:167); this makes the TTL a service feature for
+        the flat-directory store. Objects age from last *use*: writes refresh
+        mtime via os.replace (commit) and reads refresh it explicitly
+        (``Storage.reader``), so anything an active session touches stays.
+
+        Stale-unlink race closed with a per-object rename guard: the entry is
+        atomically renamed aside, re-stat'ed, and renamed back if something
+        refreshed it between the first stat and the rename. A concurrent
+        identical-content write is unaffected either way (os.replace creates
+        a fresh object under the public name). The one remaining race — a
+        reader touching the object in the instant it is renamed aside — is
+        surfaced to that reader as a missing object, the same outcome S3
+        lifecycle rules produce.
+
+        A crash between the rename-aside and its resolution would otherwise
+        strand the object as ``.tmp-sweep-*`` forever (every future sweep
+        skips ``.tmp-`` names), so each sweep first recovers orphaned guards:
+        put fresh ones back under their public name, unlink expired ones.
+        """
+
+        def _sweep_sync() -> int:
+            root = self.root
+            if not root.is_dir():
+                return 0
+            cutoff = time.time() - max_age_s
+            removed = 0
+            for entry in root.iterdir():
+                if not entry.name.startswith(".tmp-sweep-"):
+                    continue
+                public = root / entry.name.removeprefix(".tmp-sweep-")
+                try:
+                    if entry.stat().st_mtime >= cutoff:
+                        # A live object a crashed sweep renamed aside. Restore
+                        # no-clobber (link fails with EEXIST): a fresh write
+                        # that recreated the public name is newer — prefer it.
+                        try:
+                            os.link(entry, public)
+                        except FileExistsError:
+                            pass
+                        entry.unlink()
+                    else:
+                        entry.unlink()
+                        removed += 1
+                except OSError:
+                    continue
+            for entry in root.iterdir():
+                try:
+                    if entry.name.startswith(".tmp-"):
+                        continue  # in-flight write
+                    if entry.stat().st_mtime >= cutoff:
+                        continue
+                    guard = root / f".tmp-sweep-{entry.name}"
+                    entry.rename(guard)
+                except OSError:
+                    # Missing (raced), a directory, permission-denied — skip
+                    # this entry, keep sweeping the rest.
+                    continue
+                try:
+                    if guard.stat().st_mtime >= cutoff:
+                        # refreshed between stat and rename: put it back
+                        guard.rename(entry)
+                        continue
+                    guard.unlink()
+                    removed += 1
+                except OSError:
+                    continue
+            return removed
+
+        return await asyncio.to_thread(_sweep_sync)
+
+
+class SharedDirectoryBackend(LocalDirectoryBackend):
+    """The flat-directory layout on a volume MOUNTED INTO EVERY REPLICA
+    (docs/fleet.md "Storage backends"): commits are fsync'd so a replica
+    dying right after publishing a snapshot cannot strand the readers on
+    other replicas, and the startup orphan sweep is age-gated (default 1h)
+    because a ``.tmp-*`` in a shared root may be another live replica's
+    in-flight upload, not a leak."""
+
+    name = "shared"
+    _durable = True
+
+    def __init__(
+        self, root: str | os.PathLike, orphan_min_age_s: float = 3600.0
+    ) -> None:
+        super().__init__(root, orphan_min_age_s=orphan_min_age_s)
+
+
+# --------------------------------------------------------------- s3 backend
+
+
+class _S3ReadHandle:
+    """Whole-object buffer: snapshot objects are workspace files (bounded by
+    the sandbox workspace), and the driver re-chunks uploads from ``read``
+    calls anyway."""
+
+    def __init__(self, body: bytes) -> None:
+        self._body = memoryview(body)
+        self._pos = 0
+
+    async def read(self, size: int = -1) -> bytes:
+        if size is None or size < 0:
+            chunk = self._body[self._pos :]
+            self._pos = len(self._body)
+        else:
+            chunk = self._body[self._pos : self._pos + size]
+            self._pos += len(chunk)
+        return bytes(chunk)
+
+    async def close(self) -> None:
+        self._pos = len(self._body)
+
+
+class _S3WriteHandle:
+    def __init__(self, backend: "S3HttpBackend") -> None:
+        self._backend = backend
+        self._chunks: list[bytes] = []
+
+    async def write(self, data: bytes) -> None:
+        self._chunks.append(bytes(data))
+
+    async def commit(self, object_id: Hash) -> None:
+        await self._backend._put(object_id, b"".join(self._chunks))
+        self._chunks.clear()
+
+    async def abort(self) -> None:
+        self._chunks.clear()
+
+
+class S3HttpBackend:
+    """S3-shaped HTTP object store: ``PUT/GET/HEAD {endpoint}/{bucket}/{id}``.
+
+    Deliberately speaks only the unauthenticated path-style subset every
+    S3-compatible store (and the in-repo ``tests.fakes.FakeS3``) accepts —
+    credentials/signing belong to the deployment's ambient auth (IRSA,
+    sidecar proxy), exactly like the reference's "S3 in prod" plan. Missing
+    objects surface as ``FileNotFoundError`` so every backend answers the
+    same way."""
+
+    name = "s3"
+
+    def __init__(
+        self,
+        endpoint: str,
+        bucket: str,
+        timeout_s: float = 30.0,
+        client=None,
+    ) -> None:
+        import httpx
+
+        self.endpoint = endpoint.rstrip("/")
+        self.bucket = bucket.strip("/")
+        self._client = client or httpx.AsyncClient(timeout=timeout_s)
+        self.orphans_recovered = 0  # no staging temps: uploads are one PUT
+        self._sweep_noted = False
+
+    async def recover_orphans(self) -> int:
+        return 0  # uploads are a single PUT; nothing to strand
+
+    def _url(self, object_id: Hash) -> str:
+        return f"{self.endpoint}/{self.bucket}/{object_id}"
+
+    async def _put(self, object_id: Hash, body: bytes) -> None:
+        response = await self._client.put(self._url(object_id), content=body)
+        if response.status_code >= 300:
+            raise OSError(
+                f"s3 put {object_id} failed: HTTP {response.status_code}"
+            )
+
+    async def open_read(self, object_id: Hash) -> _S3ReadHandle:
+        response = await self._client.get(self._url(object_id))
+        if response.status_code == 404:
+            raise FileNotFoundError(f"no such object: {object_id}")
+        if response.status_code >= 300:
+            raise OSError(
+                f"s3 get {object_id} failed: HTTP {response.status_code}"
+            )
+        return _S3ReadHandle(response.content)
+
+    async def start_write(self) -> _S3WriteHandle:
+        return _S3WriteHandle(self)
+
+    async def exists(self, object_id: Hash) -> bool:
+        response = await self._client.head(self._url(object_id))
+        return response.status_code < 300
+
+    async def touch(self, object_id: Hash) -> None:
+        pass  # object age is the bucket's concern (lifecycle rules)
+
+    async def sweep(self, max_age_s: float) -> int:
+        if not self._sweep_noted:
+            self._sweep_noted = True
+            logger.info(
+                "Storage TTL sweep is a no-op on the s3 backend; configure "
+                "bucket lifecycle rules instead (docs/fleet.md)"
+            )
+        return 0
+
+    def describe(self) -> dict:
+        return {
+            "backend": self.name,
+            "endpoint": self.endpoint,
+            "bucket": self.bucket,
+        }
+
+    async def aclose(self) -> None:
+        await self._client.aclose()
+
+
+# ------------------------------------------------------------------- facade
 
 
 class Storage:
-    """Flat-directory object store keyed by content hash.
+    """Content-addressed object store over a pluggable backend.
 
-    API shape mirrors the reference (storage.py:44-90): async ``reader``/``writer``
-    context managers plus whole-object ``read``/``write``/``exists`` helpers.
+    API shape mirrors the reference (storage.py:44-90): async ``reader``/
+    ``writer`` context managers plus whole-object ``read``/``write``/
+    ``exists`` helpers. Default backend is the replica-private local
+    directory; ``Storage.from_config`` picks by ``APP_STORAGE_BACKEND``.
     """
 
     def __init__(
-        self, storage_path: str | os.PathLike, touch_on_read: bool = False
+        self,
+        storage_path: str | os.PathLike | None = None,
+        touch_on_read: bool = False,
+        backend=None,
     ) -> None:
-        self._root = Path(storage_path)
-        # Only pay the per-read utime when a TTL sweep actually ages objects
+        if backend is None:
+            if storage_path is None:
+                raise ValueError("Storage needs a storage_path or a backend")
+            backend = LocalDirectoryBackend(storage_path)
+        self.backend = backend
+        # Only pay the per-read touch when a TTL sweep actually ages objects
         # (ApplicationContext sets this from storage_max_age_s); reads are on
         # the warm-execute hot path.
         self._touch_on_read = touch_on_read
 
-    async def _ensure_root(self) -> None:
-        await asyncio.to_thread(self._root.mkdir, 0o777, True, True)
+    @classmethod
+    def from_config(cls, config) -> "Storage":
+        """The composition-root construction (docs/fleet.md "Storage
+        backends"): ``APP_STORAGE_BACKEND`` selects the seam, everything
+        else keeps its existing meaning (``APP_FILE_STORAGE_PATH`` is the
+        local/shared root; the TTL sweep opts reads into touch)."""
+        kind = config.storage_backend
+        if kind == "s3":
+            if not config.storage_s3_endpoint:
+                raise ValueError(
+                    "APP_STORAGE_BACKEND=s3 requires APP_STORAGE_S3_ENDPOINT"
+                )
+            backend = S3HttpBackend(
+                config.storage_s3_endpoint,
+                config.storage_s3_bucket,
+                timeout_s=config.storage_s3_timeout_s,
+            )
+        elif kind == "shared":
+            backend = SharedDirectoryBackend(
+                config.file_storage_path,
+                orphan_min_age_s=config.storage_orphan_age_s,
+            )
+        else:
+            backend = LocalDirectoryBackend(config.file_storage_path)
+        return cls(
+            touch_on_read=config.storage_max_age_s is not None,
+            backend=backend,
+        )
 
-    def _object_path(self, object_id: Hash) -> Path:
-        # Hash pattern forbids "/" and ".." so a plain join cannot escape root.
-        return self._root / object_id
+    @property
+    def orphans_recovered(self) -> int | None:
+        """Orphaned writer temps reaped by the backend's startup sweep
+        (None until the sweep has run — first write, or
+        :meth:`recover_orphans`)."""
+        return self.backend.orphans_recovered
+
+    async def recover_orphans(self) -> int:
+        """Run the backend's once-only orphan sweep now (normally kicked by
+        the first write; ``__main__`` calls this at boot so the count is
+        logged deterministically)."""
+        return await self.backend.recover_orphans()
+
+    def describe(self) -> dict:
+        return self.backend.describe()
 
     @asynccontextmanager
     async def reader(self, object_id: Hash) -> AsyncIterator[ObjectReader]:
-        path = self._object_path(object_id)
-        reader = ObjectReader(path)
-        await reader._open()
+        reader = ObjectReader(await self.backend.open_read(object_id))
         if self._touch_on_read:
-            try:
-                # Reads mark the object as in use: sessions that only restore
-                # a file (never modify it) must still keep it alive under the
-                # TTL sweep, which ages by mtime.
-                await asyncio.to_thread(os.utime, path)
-            except OSError:
-                pass
+            # Reads mark the object as in use: sessions that only restore
+            # a file (never modify it) must still keep it alive under the
+            # TTL sweep, which ages by mtime.
+            await self.backend.touch(object_id)
         try:
             yield reader
         finally:
@@ -121,9 +544,7 @@ class Storage:
 
     @asynccontextmanager
     async def writer(self) -> AsyncIterator[ObjectWriter]:
-        await self._ensure_root()
-        writer = ObjectWriter(self._root)
-        await writer._open()
+        writer = ObjectWriter(await self.backend.start_write())
         try:
             yield writer
         except BaseException:
@@ -142,80 +563,12 @@ class Storage:
         return w.hash
 
     async def exists(self, object_id: Hash) -> bool:
-        return await asyncio.to_thread(self._object_path(object_id).exists)
+        return await self.backend.exists(object_id)
 
     async def sweep(self, max_age_s: float) -> int:
-        """Delete objects untouched for longer than ``max_age_s``; returns the
-        count removed.
+        """TTL-expire stored objects (see the backend docstrings; the s3
+        backend defers to bucket lifecycle rules and returns 0)."""
+        return await self.backend.sweep(max_age_s)
 
-        The reference leaves cleanup to the operator ("temporary solution ...
-        S3 TTL", its README.md:167); this makes the TTL a service feature for
-        the flat-directory store. Objects age from last *use*: writes refresh
-        mtime via os.replace (ObjectWriter._finalize) and reads refresh it
-        explicitly (reader()), so anything an active session touches stays.
-
-        Stale-unlink race closed with a per-object rename guard: the entry is
-        atomically renamed aside, re-stat'ed, and renamed back if something
-        refreshed it between the first stat and the rename. A concurrent
-        identical-content write is unaffected either way (os.replace creates
-        a fresh object under the public name). The one remaining race — a
-        reader touching the object in the instant it is renamed aside — is
-        surfaced to that reader as a missing object, the same outcome S3
-        lifecycle rules produce.
-
-        A crash between the rename-aside and its resolution would otherwise
-        strand the object as ``.tmp-sweep-*`` forever (every future sweep
-        skips ``.tmp-`` names), so each sweep first recovers orphaned guards:
-        put fresh ones back under their public name, unlink expired ones.
-        """
-
-        def _sweep_sync() -> int:
-            import time
-
-            if not self._root.is_dir():
-                return 0
-            cutoff = time.time() - max_age_s
-            removed = 0
-            for entry in self._root.iterdir():
-                if not entry.name.startswith(".tmp-sweep-"):
-                    continue
-                public = self._root / entry.name.removeprefix(".tmp-sweep-")
-                try:
-                    if entry.stat().st_mtime >= cutoff:
-                        # A live object a crashed sweep renamed aside. Restore
-                        # no-clobber (link fails with EEXIST): a fresh write
-                        # that recreated the public name is newer — prefer it.
-                        try:
-                            os.link(entry, public)
-                        except FileExistsError:
-                            pass
-                        entry.unlink()
-                    else:
-                        entry.unlink()
-                        removed += 1
-                except OSError:
-                    continue
-            for entry in self._root.iterdir():
-                try:
-                    if entry.name.startswith(".tmp-"):
-                        continue  # in-flight write
-                    if entry.stat().st_mtime >= cutoff:
-                        continue
-                    guard = self._root / f".tmp-sweep-{entry.name}"
-                    entry.rename(guard)
-                except OSError:
-                    # Missing (raced), a directory, permission-denied — skip
-                    # this entry, keep sweeping the rest.
-                    continue
-                try:
-                    if guard.stat().st_mtime >= cutoff:
-                        # refreshed between stat and rename: put it back
-                        guard.rename(entry)
-                        continue
-                    guard.unlink()
-                    removed += 1
-                except OSError:
-                    continue
-            return removed
-
-        return await asyncio.to_thread(_sweep_sync)
+    async def aclose(self) -> None:
+        await self.backend.aclose()

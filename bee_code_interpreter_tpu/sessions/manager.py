@@ -139,6 +139,7 @@ class SessionManager:
         ttl_s: float = 900.0,
         idle_s: float = 120.0,
         sweep_interval_s: float = 1.0,
+        drain_grace_s: float = 0.0,
         retry_after_s: float = 1.0,
         metrics=None,
         drain=None,
@@ -156,6 +157,13 @@ class SessionManager:
         self._ttl_s = ttl_s
         self._idle_s = idle_s
         self._sweep_interval_s = max(0.05, sweep_interval_s)
+        # Lease-handoff window (docs/fleet.md): a fleet router needs time
+        # after drain begins to checkpoint each live lease and re-lease it
+        # on another replica; the sweep only force-expires leases
+        # (reason="drain") once this grace has elapsed. 0 = original
+        # behavior, first sweep reclaims everything.
+        self._drain_grace_s = drain_grace_s
+        self._drain_seen_mono: float | None = None
         self._retry_after_s = retry_after_s
         self._drain = drain
         self._recorder = recorder
@@ -432,11 +440,20 @@ class SessionManager:
         is deadline- and watchdog-bounded; the next sweep gets them."""
         draining = self._drain is not None and self._drain.draining
         now = self._clock()
+        if not draining:
+            self._drain_seen_mono = None
+        elif self._drain_seen_mono is None:
+            self._drain_seen_mono = now
+        # During the handoff grace, drain does not force-expire leases (the
+        # router is evacuating them); TTL/idle still apply as usual.
+        drain_expire = draining and (
+            now - self._drain_seen_mono >= self._drain_grace_s
+        )
         expired = 0
         for session in list(self._sessions.values()):
             if session.closed or session.lock.locked():
                 continue
-            if draining:
+            if drain_expire:
                 reason = "drain"
             elif now - session.created_mono >= session.ttl_s:
                 reason = "ttl"

@@ -570,6 +570,170 @@ async def measure_surge(binary: Path) -> dict | None:
     }
 
 
+async def measure_router(binary: Path) -> dict | None:
+    """The `router` phase (docs/fleet.md): p50 of the SAME warm execute
+    direct-to-replica vs through the fleet-router edge — the routing tax,
+    budgeted < 2 ms added p50 — plus the consistent-hash warm-affinity hit
+    rate on repeat-client traffic (>= 90% expected: repeat keys must keep
+    landing where their snapshot chain is warm). Two complete replicas
+    (real HTTP edge over the native pool) share one snapshot root; samples
+    alternate arms so host drift cancels."""
+    import socket
+    import statistics as stats
+
+    import httpx
+    from aiohttp import web
+
+    from bee_code_interpreter_tpu.api.http_server import create_http_server
+    from bee_code_interpreter_tpu.config import Config
+    from bee_code_interpreter_tpu.fleet import FleetRouter, create_router_app
+    from bee_code_interpreter_tpu.services.custom_tool_executor import (
+        CustomToolExecutor,
+    )
+    from bee_code_interpreter_tpu.services.native_process_code_executor import (
+        NativeProcessCodeExecutor,
+    )
+    from bee_code_interpreter_tpu.services.storage import (
+        SharedDirectoryBackend,
+        Storage,
+    )
+
+    def free_port() -> int:
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            return s.getsockname()[1]
+
+    ROUNDS, KEYS = 24, 4
+    tmp = Path(tempfile.mkdtemp(prefix="bench-router-"))
+    shared_root = tmp / "objects"
+    replicas: list[tuple] = []
+    router = None
+    router_runner = None
+    client = None
+    try:
+        for i in range(2):
+            storage = Storage(backend=SharedDirectoryBackend(shared_root))
+            config = Config(
+                file_storage_path=str(shared_root),
+                local_workspace_root=str(tmp / f"ws-{i}"),
+                executor_pod_queue_target_length=2,
+                disable_dep_install=True,
+            )
+            executor = NativeProcessCodeExecutor(
+                storage=storage, config=config, binary=binary
+            )
+            await executor.fill_sandbox_queue()
+            app = create_http_server(
+                code_executor=executor,
+                custom_tool_executor=CustomToolExecutor(code_executor=executor),
+            )
+            runner = web.AppRunner(app)
+            await runner.setup()
+            port = free_port()
+            await web.TCPSite(runner, "127.0.0.1", port).start()
+            replicas.append((executor, runner, f"http://127.0.0.1:{port}"))
+        # No background refresh: the view is refreshed manually while the
+        # fleet is idle, so this LATENCY bench can't trip the overload-spill
+        # path by having a refresh catch the (sequentially driven) owner
+        # mid-request — spill behavior is chaos/tier-1 territory
+        # (tests/test_fleet_router.py), the bench measures tax + affinity.
+        router = FleetRouter(
+            [(f"r{i}", r[2]) for i, r in enumerate(replicas)],
+            refresh_interval_s=30.0,
+        )
+        router_runner = web.AppRunner(create_router_app(router))
+        await router_runner.setup()
+        router_port = free_port()
+        await web.TCPSite(router_runner, "127.0.0.1", router_port).start()
+        router_url = f"http://127.0.0.1:{router_port}"
+        await router.refresh_once()
+
+        seed_storage = Storage(backend=SharedDirectoryBackend(shared_root))
+        seeds = []
+        for i in range(KEYS):
+            object_id = await seed_storage.write(f"router-chain-{i}".encode())
+            seeds.append({"/workspace/seed.txt": object_id})
+
+        client = httpx.AsyncClient(timeout=30.0)
+
+        async def timed(url: str, files: dict) -> float:
+            t0 = time.perf_counter()
+            response = await client.post(
+                f"{url}/v1/execute",
+                json={"source_code": "print('ok')", "files": files},
+            )
+            if response.status_code != 200 or response.json()["exit_code"] != 0:
+                raise RuntimeError(f"router bench execute failed: {response.text}")
+            return (time.perf_counter() - t0) * 1000.0
+
+        from bee_code_interpreter_tpu.fleet import affinity_key
+
+        def owner_url(files: dict) -> str:
+            # "direct-to-replica" is the ideal client that already knows
+            # where its snapshot chain is warm: the key's ring owner — the
+            # same replica the router should pick, so both arms measure the
+            # same replica in the same state and the difference IS the tax.
+            owner = router.ring.owner(affinity_key(files))
+            return dict(
+                (f"r{i}", r[2]) for i, r in enumerate(replicas)
+            )[owner]
+
+        # PACE_S between requests lets the pool refill land, so every
+        # sample pops warm: a random cold spawn is tens of ms of noise
+        # against a single-digit-ms tax.
+        PACE_S = 0.15
+
+        async def timed_paced(url: str, files: dict) -> float:
+            sample = await timed(url, files)
+            await asyncio.sleep(PACE_S)
+            return sample
+
+        # Warm both arms (pool probe + first-touch costs land here).
+        for files in seeds:
+            await timed_paced(owner_url(files), files)
+            await timed_paced(router_url, files)
+        await router.refresh_once()  # idle fleet: placement view settles
+        direct_ms: list[float] = []
+        routed_ms: list[float] = []
+        for i in range(ROUNDS):
+            files = seeds[i % KEYS]
+            # alternate arm ORDER per round so drift cancels
+            if i % 2 == 0:
+                direct_ms.append(await timed_paced(owner_url(files), files))
+                routed_ms.append(await timed_paced(router_url, files))
+            else:
+                routed_ms.append(await timed_paced(router_url, files))
+                direct_ms.append(await timed_paced(owner_url(files), files))
+        keyed = (
+            router.affinity_totals["warm"] + router.affinity_totals["spill"]
+        )
+        direct_p50 = stats.median(direct_ms)
+        router_p50 = stats.median(routed_ms)
+        # The tax is the MEDIAN OF PAIRED same-key differences, not the
+        # difference of medians: pairing cancels per-key and drift effects,
+        # and the median shrugs off any residual cold-pop outlier.
+        tax = stats.median(r - d for d, r in zip(direct_ms, routed_ms))
+        return {
+            "requests_per_arm": ROUNDS,
+            "direct_p50_ms": round(direct_p50, 2),
+            "router_p50_ms": round(router_p50, 2),
+            "router_tax_ms": round(tax, 2),
+            "warm_pop_rate": round(
+                router.affinity_totals["warm"] / keyed if keyed else 0.0, 3
+            ),
+        }
+    finally:
+        if client is not None:
+            await client.aclose()
+        if router_runner is not None:
+            await router_runner.cleanup()
+        if router is not None:
+            await router.stop()
+        for executor, runner, _url in replicas:
+            await runner.cleanup()
+            await executor.aclose()
+
+
 async def measure_session_latency_p50_ms(
     binary: Path, n: int = 12
 ) -> float | None:
@@ -1008,6 +1172,19 @@ def main() -> None:
         except Exception as e:
             print(f"surge measurement failed (field omitted): {e}", file=sys.stderr)
 
+    # --- 3a''. router phase (guarded; extra field only; docs/fleet.md):
+    # p50 through the fleet router vs direct-to-replica on the native pool
+    # (the routing tax, budget < 2ms added p50) + warm-affinity hit rate
+    router_phase: dict | None = None
+    if binary is not None:
+        try:
+            router_phase = asyncio.run(
+                asyncio.wait_for(measure_router(binary), timeout=150.0)
+            )
+            print(f"router phase: {router_phase}", file=sys.stderr)
+        except Exception as e:
+            print(f"router measurement failed (field omitted): {e}", file=sys.stderr)
+
     # --- 3b. serving phase (guarded; extra field only): tokens/sec + TTFT
     # p50/p95 + inter-token latency with a measured instrumentation on/off
     # A/B (models/serving_bench.py; docs/observability.md "Serving
@@ -1062,6 +1239,8 @@ def main() -> None:
     )
     if surge is not None:
         result["surge"] = surge
+    if router_phase is not None:
+        result["router"] = router_phase
     if serving is not None:
         result["serving"] = serving
     result["cpu_baseline_gflops"] = round(cpu_gflops, 1)

@@ -1,0 +1,119 @@
+#!/usr/bin/env python3
+"""Text rendering of the fleet-router state (docs/fleet.md).
+
+Fetches ``GET /v1/fleet/replicas`` from a running router edge and prints a
+`top`-style per-replica table — utilization, SLO burn, leases, hash-ring
+ownership share, breaker state, routed totals — plus the router's session
+pins and decision/affinity/migration tallies. ``--watch N`` refreshes every
+N seconds until interrupted.
+
+    python scripts/fleet-router-top.py [--url http://localhost:50080]
+        [--watch SECONDS]
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+import httpx
+
+
+def fmt_age(seconds: float | None) -> str:
+    if seconds is None:
+        return "-"
+    if seconds < 60:
+        return f"{seconds:.1f}s"
+    if seconds < 3600:
+        return f"{seconds / 60:.1f}m"
+    return f"{seconds / 3600:.1f}h"
+
+
+def render(snap: dict) -> str:
+    lines = []
+    replicas = snap.get("replicas", [])
+    by_state: dict[str, int] = {}
+    for replica in replicas:
+        by_state[replica["state"]] = by_state.get(replica["state"], 0) + 1
+    state_summary = (
+        ", ".join(f"{s}={c}" for s, c in sorted(by_state.items())) or "empty"
+    )
+    totals = snap.get("totals", {})
+    affinity = snap.get("affinity", {})
+    sessions = snap.get("sessions", {})
+    lines.append(
+        f"router: {len(replicas)} replica(s) ({state_summary})  "
+        f"routed={totals.get('routed', 0)}  "
+        f"retries={totals.get('retries', 0)}  "
+        f"pinned_sessions={sessions.get('pinned', 0)}"
+    )
+    keyed = affinity.get("warm", 0) + affinity.get("spill", 0)
+    warm_rate = affinity.get("warm", 0) / keyed if keyed else None
+    lines.append(
+        "placement: "
+        + "  ".join(f"{k}={affinity.get(k, 0)}" for k in ("warm", "spill", "keyless"))
+        + (f"  warm_rate={warm_rate:.0%}" if warm_rate is not None else "")
+        + f"  migrations ok={totals.get('migrations_ok', 0)}"
+        + f" failed={totals.get('migrations_failed', 0)}"
+    )
+    lines.append("")
+    header = (
+        f"{'REPLICA':<12} {'STATE':<9} {'UTIL':>5} {'BURN':>5} "
+        f"{'LEASES':>6} {'PODS':>5} {'RING':>5} {'ROUTED':>7} "
+        f"{'BREAKER':<9} {'SEEN':>6}  ERROR"
+    )
+    lines.append(header)
+    by_replica = sessions.get("by_replica", {})
+    for replica in replicas:
+        lines.append(
+            f"{replica['name']:<12} "
+            f"{replica['state'] + ('*' if replica.get('cordoned') else ''):<9} "
+            f"{replica['utilization']:>5.0%} "
+            f"{'PAGE' if replica.get('slo_fast_burn') else 'ok':>5} "
+            f"{by_replica.get(replica['name'], replica.get('leases', 0)):>6} "
+            f"{str(replica.get('ready_pods', 0)) + '/' + str(replica.get('live_pods', 0)):>5} "
+            f"{replica.get('ring_share', 0.0):>5.0%} "
+            f"{replica.get('routed_total', 0):>7} "
+            f"{replica.get('breaker', '-'):<9} "
+            f"{fmt_age(replica.get('last_refresh_age_s')):>6}  "
+            f"{replica.get('refresh_error') or ''}"
+        )
+    if not replicas:
+        lines.append("(no replicas registered)")
+    return "\n".join(lines)
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(
+        description="Fleet-router replica table (GET /v1/fleet/replicas)."
+    )
+    parser.add_argument("--url", default="http://localhost:50080")
+    parser.add_argument(
+        "--watch",
+        type=float,
+        default=None,
+        metavar="SECONDS",
+        help="refresh every N seconds until interrupted",
+    )
+    args = parser.parse_args()
+    while True:
+        try:
+            response = httpx.get(f"{args.url}/v1/fleet/replicas", timeout=10.0)
+            response.raise_for_status()
+        except Exception as e:
+            print(f"cannot reach router at {args.url}: {e}", file=sys.stderr)
+            return 2
+        if args.watch is not None:
+            print("\033[2J\033[H", end="")  # clear like top
+        print(render(response.json()))
+        if args.watch is None:
+            return 0
+        try:
+            time.sleep(args.watch)
+        except KeyboardInterrupt:
+            return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -155,6 +155,188 @@ class FakeKubectl:
         return {}
 
 
+class ReplicaStack:
+    """One COMPLETE in-process replica for fleet-tier tests and chaos
+    scenario 14 (docs/fleet.md): the real HTTP edge over the real
+    KubernetesCodeExecutor against its own fake-pod cluster, with its own
+    SessionManager / SLO engine / admission / drain — sharing a
+    SharedDirectoryBackend snapshot root with its siblings, served on a
+    real localhost socket. Production fleet shape minus kubectl.
+
+    Imports are deferred to ``start()`` so importing tests.fakes stays
+    cheap for the many suites that only want the fake cluster."""
+
+    def __init__(self, name: str, tmp_path, shared_root, faults=None) -> None:
+        self.name = name
+        self.tmp_path = Path(tmp_path)
+        self.shared_root = shared_root
+        self.faults = faults
+        self.stopped = False
+
+    async def start(self) -> "ReplicaStack":
+        from bee_code_interpreter_tpu.api.http_server import create_http_server
+        from bee_code_interpreter_tpu.config import Config
+        from bee_code_interpreter_tpu.observability import (
+            SloEngine,
+            Tracer,
+            parse_objectives,
+        )
+        from bee_code_interpreter_tpu.resilience import (
+            AdmissionController,
+            DrainController,
+        )
+        from bee_code_interpreter_tpu.services.custom_tool_executor import (
+            CustomToolExecutor,
+        )
+        from bee_code_interpreter_tpu.services.kubernetes_code_executor import (
+            KubernetesCodeExecutor,
+        )
+        from bee_code_interpreter_tpu.services.storage import (
+            SharedDirectoryBackend,
+            Storage,
+        )
+        from bee_code_interpreter_tpu.sessions import SessionManager
+        from bee_code_interpreter_tpu.utils.metrics import Registry
+
+        self.pods = FakeExecutorPods(
+            self.tmp_path / f"pods-{self.name}", faults=self.faults
+        )
+        self.storage = Storage(
+            backend=SharedDirectoryBackend(self.shared_root)
+        )
+        config = Config(
+            executor_backend="kubernetes",
+            executor_port=self.pods.port,
+            executor_pod_queue_target_length=1,
+            pod_ready_timeout_s=5,
+            executor_retry_attempts=1,
+            session_drain_grace_s=30.0,
+        )
+        self.metrics = Registry()
+        self.k8s = KubernetesCodeExecutor(
+            kubectl=FakeKubectl(self.pods),
+            storage=self.storage,
+            config=config,
+            metrics=self.metrics,
+            ip_poll_interval_s=0.02,
+        )
+        await self.k8s.fill_executor_pod_queue()
+        self.drain = DrainController()
+        self.slo = SloEngine(parse_objectives(99.5, None), metrics=self.metrics)
+        self.sessions = SessionManager(
+            self.k8s,
+            self.storage,
+            max_sessions=4,
+            ttl_s=120.0,
+            idle_s=120.0,
+            sweep_interval_s=0.2,
+            drain_grace_s=30.0,
+            drain=self.drain,
+            metrics=self.metrics,
+        )
+        app = create_http_server(
+            code_executor=self.k8s,
+            custom_tool_executor=CustomToolExecutor(code_executor=self.k8s),
+            metrics=self.metrics,
+            admission=AdmissionController(
+                max_in_flight=8, max_queue=16, retry_after_s=0.2
+            ),
+            request_deadline_s=30.0,
+            tracer=Tracer(metrics=self.metrics),
+            fleet=self.k8s.journal,
+            drain=self.drain,
+            slo=self.slo,
+            sessions=self.sessions,
+        )
+        self.runner = web.AppRunner(app)
+        await self.runner.setup()
+        self.port = free_port()
+        await web.TCPSite(self.runner, "127.0.0.1", self.port).start()
+        self.base_url = f"http://127.0.0.1:{self.port}"
+        return self
+
+    async def stop(self, hard: bool = False) -> None:
+        """``hard=True`` is the replica-kill: listener and backend torn
+        down with leases left wherever they are (a fleet router must have
+        moved them first)."""
+        if self.stopped:
+            return
+        self.stopped = True
+        await self.sessions.stop()
+        if not hard:
+            await self.sessions.close_all()
+        await self.runner.cleanup()
+        await self.k8s.aclose()
+        await self.pods.close()
+
+
+class FakeS3:
+    """In-process S3-shaped object store for the ``S3HttpBackend``
+    conformance suite (docs/fleet.md "Storage backends"): path-style
+    ``PUT/GET/HEAD /{bucket}/{key}`` over an in-memory dict. Multiple
+    backend instances pointed at the same FakeS3 share one "bucket" —
+    exactly the replica-agnosticism the fleet tier relies on."""
+
+    def __init__(self, port: int | None = None) -> None:
+        self.port = port or free_port()
+        self.objects: dict[tuple[str, str], bytes] = {}
+        self.put_count = 0
+        self.fail_next = 0  # next N PUT/GETs answer 503 (retry/error paths)
+        self._runner: web.AppRunner | None = None
+
+    @property
+    def endpoint(self) -> str:
+        return f"http://127.0.0.1:{self.port}"
+
+    def _maybe_fail(self) -> web.Response | None:
+        if self.fail_next > 0:
+            self.fail_next -= 1
+            return web.json_response({"detail": "slow down"}, status=503)
+        return None
+
+    async def _put(self, request: web.Request) -> web.Response:
+        if (fail := self._maybe_fail()) is not None:
+            return fail
+        key = (request.match_info["bucket"], request.match_info["key"])
+        self.objects[key] = await request.read()
+        self.put_count += 1
+        return web.Response(status=200)
+
+    async def _get(self, request: web.Request) -> web.Response:
+        if (fail := self._maybe_fail()) is not None:
+            return fail
+        key = (request.match_info["bucket"], request.match_info["key"])
+        body = self.objects.get(key)
+        if body is None:
+            return web.Response(status=404)
+        return web.Response(body=body)
+
+    async def _head(self, request: web.Request) -> web.Response:
+        key = (request.match_info["bucket"], request.match_info["key"])
+        return web.Response(status=200 if key in self.objects else 404)
+
+    async def _delete(self, request: web.Request) -> web.Response:
+        key = (request.match_info["bucket"], request.match_info["key"])
+        self.objects.pop(key, None)
+        return web.Response(status=204)
+
+    async def start(self) -> "FakeS3":
+        app = web.Application(client_max_size=1 << 28)
+        app.router.add_put("/{bucket}/{key}", self._put)
+        app.router.add_route("HEAD", "/{bucket}/{key}", self._head)
+        app.router.add_get("/{bucket}/{key}", self._get, allow_head=False)
+        app.router.add_delete("/{bucket}/{key}", self._delete)
+        self._runner = web.AppRunner(app)
+        await self._runner.setup()
+        await web.TCPSite(self._runner, "127.0.0.1", self.port).start()
+        return self
+
+    async def stop(self) -> None:
+        if self._runner is not None:
+            await self._runner.cleanup()
+            self._runner = None
+
+
 class FakeCollector:
     """In-process OTLP/HTTP collector double for the telemetry exporter:
     records every JSON payload POSTed to ``/v1/traces`` / ``/v1/metrics`` /

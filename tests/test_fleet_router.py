@@ -1,0 +1,528 @@
+"""Fleet-router acceptance (ISSUE 11, docs/fleet.md): consistent-hash
+placement, cross-replica retry, session pinning, and lease handoff on
+drain — chaos scenario 14's tier-1 twin.
+
+The harness is N COMPLETE in-process replicas: each one the real HTTP edge
+(create_http_server) over the real KubernetesCodeExecutor against its own
+fake-pod cluster, with its own SessionManager/SLO/admission/drain — all
+sharing ONE SharedDirectoryBackend snapshot root, exactly the production
+fleet shape minus kubectl. The real FleetRouter fronts them over real
+sockets."""
+
+import asyncio
+
+import httpx
+import pytest
+from aiohttp import web
+
+from bee_code_interpreter_tpu.fleet import (
+    FleetRouter,
+    HashRing,
+    NoReplicasAvailable,
+    affinity_key,
+    create_router_app,
+)
+from bee_code_interpreter_tpu.health_check import assess_router
+from tests.fakes import ReplicaStack, free_port
+
+pytestmark = pytest.mark.chaos
+
+
+# ------------------------------------------------------------------ units
+
+
+def test_ring_preference_is_stable_under_replica_loss():
+    ring = HashRing(vnodes=64)
+    for name in ("r0", "r1", "r2"):
+        ring.add(name)
+    keys = [affinity_key({f"/workspace/{i}.txt": "ab" * 32}) for i in range(64)]
+    owners_before = {k: ring.owner(k) for k in keys}
+    ring.remove("r1")
+    for key, owner in owners_before.items():
+        if owner != "r1":
+            # keys not owned by the lost replica keep their warm home
+            assert ring.owner(key) == owner
+        else:
+            assert ring.owner(key) in ("r0", "r2")
+
+
+def test_ring_shares_sum_to_one_and_spread():
+    ring = HashRing(vnodes=128)
+    for name in ("a", "b", "c", "d"):
+        ring.add(name)
+    shares = ring.shares()
+    assert abs(sum(shares.values()) - 1.0) < 1e-9
+    assert all(0.1 < s < 0.5 for s in shares.values()), shares
+
+
+def test_affinity_key_semantics():
+    assert affinity_key(None) is None
+    assert affinity_key({}) is None
+    a = affinity_key({"/workspace/x": "11" * 32, "/workspace/y": "22" * 32})
+    b = affinity_key({"/workspace/y": "22" * 32, "/workspace/x": "11" * 32})
+    assert a == b  # order-independent
+    assert a != affinity_key({"/workspace/x": "11" * 32})
+
+
+def _synthetic_router(clock):
+    router = FleetRouter(
+        [(f"r{i}", f"http://127.0.0.1:{i + 1}") for i in range(3)],
+        refresh_interval_s=0.2,
+        dead_after_s=5.0,
+        clock=clock,
+    )
+    for replica in router.replicas.values():
+        replica.last_refresh_mono = clock()
+    return router
+
+
+async def test_placement_eligibility_and_spill():
+    now = [100.0]
+    router = _synthetic_router(lambda: now[0])
+    key = affinity_key({"/workspace/a": "ab" * 32})
+    owner = router.ring.owner(key)
+    assert router.place(key)[0].name == owner
+
+    # a saturated owner with NO warm capacity spills to a healthier
+    # replica; with even one ready sandbox the warm owner keeps the key
+    # (it is still the fastest home)
+    router.replicas[owner].utilization = 0.95
+    router.replicas[owner].ready_pods = 0
+    spilled = router.place(key)[0]
+    assert spilled.name != owner
+    assert router.affinity_result(key, spilled.name) == "spill"
+    router.replicas[owner].ready_pods = 1
+    assert router.place(key)[0].name == owner
+    router.replicas[owner].utilization = 0.0
+
+    # an SLO page on the owner is the same veto
+    router.replicas[owner].slo_fast_burn = True
+    assert router.place(key)[0].name != owner
+    router.replicas[owner].slo_fast_burn = False
+    assert router.place(key)[0].name == owner
+    assert router.affinity_result(key, owner) == "warm"
+
+    # draining and stale replicas leave placement
+    router.replicas[owner].draining = True
+    assert all(r.name != owner for r in router.place(key))
+    router.replicas[owner].draining = False
+    now[0] += 10.0  # every refresh is now stale
+    with pytest.raises(NoReplicasAvailable):
+        router.place(key)
+
+
+async def test_keyless_placement_prefers_least_loaded():
+    now = [50.0]
+    router = _synthetic_router(lambda: now[0])
+    router.replicas["r0"].utilization = 0.8
+    router.replicas["r1"].utilization = 0.1
+    router.replicas["r2"].utilization = 0.4
+    assert router.place(None)[0].name == "r1"
+    assert router.affinity_result(None, "r1") == "keyless"
+
+
+def test_assess_router_exit_ladder():
+    def body(*states):
+        return {
+            "replicas": [
+                {"name": f"r{i}", "state": s} for i, s in enumerate(states)
+            ]
+        }
+
+    assert assess_router(body("healthy", "healthy"))[0] == 0
+    code, message = assess_router(body("healthy", "dead", "dead"))
+    assert code == 2 and "r1" in message and "r2" in message
+    assert assess_router(body("healthy", "draining"))[0] == 3
+    # dead outranks draining; an empty fleet is dead
+    assert assess_router(body("draining", "dead"))[0] == 2
+    assert assess_router({"replicas": []})[0] == 2
+    assert assess_router(body("draining"))[0] == 2  # no healthy replica left
+
+
+# ----------------------------------------------------------- fleet harness
+# ReplicaStack (tests/fakes.py): one complete in-process replica — real HTTP
+# edge + KubernetesCodeExecutor over fake pods + SessionManager/SLO/admission/
+# drain — sharing one SharedDirectoryBackend snapshot root. Shared with chaos
+# scenario 14 (scripts/chaos_smoke.py).
+
+
+async def _start_fleet(tmp_path, n=3, **router_kwargs):
+    shared_root = tmp_path / "shared-objects"
+    stacks = [
+        await ReplicaStack(f"r{i}", tmp_path, shared_root).start()
+        for i in range(n)
+    ]
+    router_kwargs.setdefault("refresh_interval_s", 0.2)
+    router_kwargs.setdefault("dead_after_s", 0.5)
+    router = FleetRouter(
+        [(s.name, s.base_url) for s in stacks], **router_kwargs
+    )
+    runner = web.AppRunner(create_router_app(router))
+    await runner.setup()
+    port = free_port()
+    await web.TCPSite(runner, "127.0.0.1", port).start()
+    await router.refresh_once()
+    # the production shape: the background loop keeps the placement view
+    # fresh (and auto-evacuates draining replicas) while load flows
+    router.start()
+    return stacks, router, runner, f"http://127.0.0.1:{port}"
+
+
+async def _stop_fleet(stacks, router, runner, client):
+    await client.aclose()
+    await runner.cleanup()
+    await router.stop()
+    for stack in stacks:
+        await stack.stop()
+
+
+async def test_chaos14_affinity_handoff_and_accounting(tmp_path):
+    """Chaos scenario 14's tier-1 twin: 3 replicas under mixed load, the
+    replica holding leases drains and dies — affinity stays >= 90% warm,
+    every live lease migrates (checkpoint -> re-lease -> restore through
+    shared storage), zero lease-scoped 5xx after the kill, the surviving
+    replicas' SLO page alerts stay silent, and the decision/event/counter
+    accounting agrees exactly."""
+    stacks, router, runner, url = await _start_fleet(tmp_path, n=3)
+    client = httpx.AsyncClient(timeout=30.0)
+    try:
+        # Seed the SHARED store once; three distinct snapshot chains.
+        seeds = []
+        for i in range(3):
+            object_id = await stacks[0].storage.write(f"chain-{i}".encode())
+            seeds.append({"/workspace/seed.txt": object_id})
+
+        # --- keyed warm-affinity load: 4 rounds over 3 chains
+        landed: dict[int, set[str]] = {i: set() for i in range(3)}
+        for _round in range(4):
+            for i, files in enumerate(seeds):
+                response = await client.post(
+                    f"{url}/v1/execute",
+                    json={
+                        "source_code": "print(open('seed.txt').read())",
+                        "files": files,
+                    },
+                )
+                assert response.status_code == 200, response.text
+                body = response.json()
+                assert body["exit_code"] == 0
+                assert f"chain-{i}" in body["stdout"]
+                event = router.recorder.events(kind="routing", limit=1)[0]
+                landed[i].add(event["replica"])
+        # Repeat traffic lands where its chain is warm — the acceptance bar
+        # is >= 90% warm placements. (Not "exactly one replica per chain":
+        # a sustained-saturation spill is CORRECT router behavior, and on a
+        # loaded CI box one such spill can legitimately occur.)
+        total_keyed = sum(router.affinity_totals.values())
+        assert router.affinity_totals["warm"] / total_keyed >= 0.9, (
+            router.affinity_totals,
+            landed,
+        )
+
+        # --- two live sessions through the router
+        session_ids = []
+        for i in range(2):
+            response = await client.post(f"{url}/v1/sessions", json={})
+            assert response.status_code == 200, response.text
+            session_id = response.json()["session_id"]
+            session_ids.append(session_id)
+            response = await client.post(
+                f"{url}/v1/sessions/{session_id}/execute",
+                json={
+                    "source_code": (
+                        f"open('state.txt', 'w').write('state-{i}')\n"
+                        "print('written')"
+                    )
+                },
+            )
+            assert response.status_code == 200, response.text
+
+        # --- the replica holding session 0 drains (its SIGTERM path)
+        victim_name = router.sessions[session_ids[0]].replica
+        victim = next(s for s in stacks if s.name == victim_name)
+        pinned_to_victim = [
+            sid
+            for sid in session_ids
+            if router.sessions[sid].replica == victim_name
+        ]
+        victim.drain.begin()
+        await router.refresh_once()
+        assert router.replicas[victim_name].draining
+        # evacuations are background tasks (a busy lease must not stall the
+        # refresh loop); the background loop may have claimed the handoff
+        # first, so await our spawn AND poll until the pins have moved
+        await asyncio.gather(*await router.evacuate_draining())
+        for _ in range(100):
+            if all(
+                router.sessions[sid].replica != victim_name
+                for sid in pinned_to_victim
+            ):
+                break
+            await asyncio.sleep(0.05)
+
+        for sid in pinned_to_victim:
+            assert router.sessions[sid].replica != victim_name
+            assert router.sessions[sid].migrations == 1
+        assert router.totals["migrations_ok"] == len(pinned_to_victim)
+        assert router.totals["migrations_failed"] == 0
+
+        # --- kill the victim outright
+        await victim.stop(hard=True)
+        survivors = [s for s in stacks if s.name != victim_name]
+
+        # Every session keeps serving under its ORIGINAL id with its state
+        # intact (restored from the shared checkpoint) — zero lease-scoped
+        # 5xx after the kill window.
+        for i, sid in enumerate(session_ids):
+            response = await client.post(
+                f"{url}/v1/sessions/{sid}/execute",
+                json={"source_code": "print(open('state.txt').read())"},
+            )
+            assert response.status_code == 200, response.text
+            body = response.json()
+            assert body["session_id"] == sid
+            assert f"state-{i}" in body["stdout"]
+
+        # Stateless traffic re-homes (dead replica's keys spill).
+        for files in seeds:
+            response = await client.post(
+                f"{url}/v1/execute",
+                json={"source_code": "print('alive')", "files": files},
+            )
+            assert response.status_code == 200, response.text
+
+        # The dead replica is visible as dead once its refresh goes stale.
+        await asyncio.sleep(0.6)
+        await router.refresh_once()
+        snapshot = (await client.get(f"{url}/v1/fleet/replicas")).json()
+        by_name = {r["name"]: r for r in snapshot["replicas"]}
+        assert by_name[victim_name]["state"] == "dead"
+        code, message = assess_router(snapshot)
+        assert code == 2 and victim_name in message
+
+        # SLO page alerts silent on the survivors.
+        for stack in survivors:
+            assert stack.slo.snapshot()["fast_burn_alerting"] is False
+
+        # --- exactly-once accounting across the three surfaces
+        routing_events = router.recorder.events(kind="routing", limit=10_000)
+        assert len(routing_events) == router.totals["routed"]
+        migrate_events = router.recorder.events(
+            kind="lease_migrate", limit=10_000
+        )
+        assert len(migrate_events) == (
+            router.totals["migrations_ok"] + router.totals["migrations_failed"]
+        )
+        requests_counter = router.metrics.metrics["bci_router_requests_total"]
+        assert (
+            sum(requests_counter._values.values()) == router.totals["routed"]
+        )
+        migrations_counter = router.metrics.metrics[
+            "bci_router_lease_migrations_total"
+        ]
+        assert sum(migrations_counter._values.values()) == len(migrate_events)
+        placed_events = [
+            e for e in routing_events if e.get("replica") is not None
+        ]
+        assert sum(r["routed_total"] for r in by_name.values()) == len(
+            placed_events
+        )
+        affinity_counter = router.metrics.metrics["bci_router_affinity_total"]
+        assert sum(affinity_counter._values.values()) == sum(
+            router.affinity_totals.values()
+        )
+    finally:
+        await _stop_fleet(stacks, router, runner, client)
+
+
+async def test_router_retries_shed_and_dead_replicas(tmp_path):
+    """A replica that sheds (429) or drops off the network mid-fleet: the
+    router walks the ring and the client sees one clean 200."""
+    stacks, router, runner, url = await _start_fleet(tmp_path, n=2)
+    client = httpx.AsyncClient(timeout=30.0)
+    try:
+        # Kill replica 0's listener WITHOUT telling the router: the first
+        # routed attempt may hit it, fail transport, and must retry to r1.
+        await stacks[0].stop(hard=True)
+        ok = 0
+        for i in range(4):
+            response = await client.post(
+                f"{url}/v1/execute",
+                json={"source_code": f"print({i} + 1)"},
+            )
+            assert response.status_code == 200, response.text
+            ok += 1
+        assert ok == 4
+        # the dead replica's breaker/refresh keeps later placements away
+        await asyncio.sleep(0.6)
+        await router.refresh_once()
+        assert router.replicas["r0"].state(
+            router._clock(), router.dead_after_s
+        ) == "dead"
+    finally:
+        await _stop_fleet(stacks, router, runner, client)
+
+
+async def test_router_streaming_passthrough_and_session_404(tmp_path):
+    stacks, router, runner, url = await _start_fleet(tmp_path, n=2)
+    client = httpx.AsyncClient(timeout=30.0)
+    try:
+        # SSE passthrough: stdout chunk events + exactly one result event.
+        events = []
+        async with client.stream(
+            "POST",
+            f"{url}/v1/execute",
+            params={"stream": "1"},
+            json={"source_code": "print('chunk-one')\nprint('chunk-two')"},
+        ) as response:
+            assert response.status_code == 200
+            assert response.headers["content-type"].startswith(
+                "text/event-stream"
+            )
+            async for line in response.aiter_lines():
+                if line.startswith("event: "):
+                    events.append(line.removeprefix("event: "))
+        assert events.count("result") == 1
+        assert "stdout" in events
+
+        # Unknown session id at the router edge: 404, no replica touched.
+        response = await client.post(
+            f"{url}/v1/sessions/sess-nope/execute",
+            json={"source_code": "print(1)"},
+        )
+        assert response.status_code == 404
+
+        # Router healthz + drain endpoint contracts.
+        health = (await client.get(f"{url}/healthz")).json()
+        assert health["status"] == "ok"
+        assert set(health["replicas"]["healthy"]) == {"r0", "r1"}
+        response = await client.post(f"{url}/v1/fleet/replicas/nope/drain")
+        assert response.status_code == 404
+
+        # /metrics exposes the router family.
+        text = (await client.get(f"{url}/metrics")).text
+        assert "bci_router_requests_total" in text
+        assert "bci_router_replicas" in text
+    finally:
+        await _stop_fleet(stacks, router, runner, client)
+
+
+async def test_exhausted_retries_return_the_honest_upstream_verdict(tmp_path):
+    """When every replica answers a clean shed/drain verdict, the router
+    proxies the LAST verdict — Retry-After included — instead of masking
+    it as a 502; on both the buffered and streaming paths."""
+    stacks, router, runner, url = await _start_fleet(tmp_path, n=2)
+    client = httpx.AsyncClient(timeout=30.0)
+    try:
+        # Drain both replicas WITHOUT letting the router refresh: the
+        # proxied attempts hit live 503s rather than failing placement.
+        await router.stop()  # stop the background refresh loop
+        for stack in stacks:
+            stack.drain.begin()
+        response = await client.post(
+            f"{url}/v1/execute", json={"source_code": "print(1)"}
+        )
+        assert response.status_code == 503, response.text
+        assert "Retry-After" in response.headers
+        assert "draining" in response.json()["detail"]  # the replica's body
+        async with client.stream(
+            "POST",
+            f"{url}/v1/execute",
+            params={"stream": "1"},
+            json={"source_code": "print(1)"},
+        ) as stream_response:
+            assert stream_response.status_code == 503
+            assert "Retry-After" in stream_response.headers
+        # every shed attempt was counted as a retry, none as unreachable
+        retries = router.metrics.metrics["bci_router_retries_total"]._values
+        assert retries.get((("reason", "unavailable"),), 0) >= 2
+        assert (("reason", "unreachable"),) not in retries
+    finally:
+        await _stop_fleet(stacks, router, runner, client)
+
+
+async def test_checkpoint_is_exempt_from_the_drain_gate(tmp_path):
+    """The lease-handoff enabler: a DRAINING replica still answers session
+    checkpoint (and delete) — evacuating existing state is part of
+    finishing up — while new work (execute/create) keeps getting the
+    drain 503."""
+    shared_root = tmp_path / "shared-objects"
+    stack = await ReplicaStack("r0", tmp_path, shared_root).start()
+    client = httpx.AsyncClient(timeout=30.0)
+    try:
+        response = await client.post(f"{stack.base_url}/v1/sessions", json={})
+        session_id = response.json()["session_id"]
+        response = await client.post(
+            f"{stack.base_url}/v1/sessions/{session_id}/execute",
+            json={"source_code": "open('kept.txt', 'w').write('kept')"},
+        )
+        assert response.status_code == 200
+
+        stack.drain.begin()
+        # new work: rejected retryably
+        response = await client.post(
+            f"{stack.base_url}/v1/execute", json={"source_code": "print(1)"}
+        )
+        assert response.status_code == 503
+        response = await client.post(
+            f"{stack.base_url}/v1/sessions/{session_id}/execute",
+            json={"source_code": "print(1)"},
+        )
+        assert response.status_code == 503
+        # evacuation: checkpoint works THROUGH the drain window
+        response = await client.post(
+            f"{stack.base_url}/v1/sessions/{session_id}/checkpoint", json={}
+        )
+        assert response.status_code == 200, response.text
+        files = response.json()["files"]
+        assert "/workspace/kept.txt" in files
+        # and the checkpointed bytes are real shared-storage objects
+        assert (
+            await stack.storage.read(files["/workspace/kept.txt"]) == b"kept"
+        )
+        response = await client.delete(
+            f"{stack.base_url}/v1/sessions/{session_id}"
+        )
+        assert response.status_code == 200
+    finally:
+        await client.aclose()
+        await stack.stop()
+
+
+async def test_drain_endpoint_cordons_and_migrates(tmp_path):
+    """Operator-initiated drain via the router API: the replica is cordoned
+    out of placement and its pinned leases move — while the replica itself
+    is still serving (preStop ordering, docs/fleet.md)."""
+    stacks, router, runner, url = await _start_fleet(tmp_path, n=2)
+    client = httpx.AsyncClient(timeout=30.0)
+    try:
+        response = await client.post(f"{url}/v1/sessions", json={})
+        session_id = response.json()["session_id"]
+        home = router.sessions[session_id].replica
+        await client.post(
+            f"{url}/v1/sessions/{session_id}/execute",
+            json={"source_code": "open('x.txt', 'w').write('pre-drain')"},
+        )
+        response = await client.post(f"{url}/v1/fleet/replicas/{home}/drain")
+        assert response.status_code == 200
+        body = response.json()
+        assert body["migrated"] == 1 and body["failed"] == 0
+        assert router.sessions[session_id].replica != home
+        assert router.replicas[home].cordoned
+        # cordoned replicas take no new placements
+        for _ in range(3):
+            response = await client.post(
+                f"{url}/v1/execute", json={"source_code": "print('x')"}
+            )
+            assert response.status_code == 200
+            event = router.recorder.events(kind="routing", limit=1)[0]
+            assert event["replica"] != home
+        # the migrated session still reads its pre-drain state
+        response = await client.post(
+            f"{url}/v1/sessions/{session_id}/execute",
+            json={"source_code": "print(open('x.txt').read())"},
+        )
+        assert response.status_code == 200
+        assert "pre-drain" in response.json()["stdout"]
+    finally:
+        await _stop_fleet(stacks, router, runner, client)

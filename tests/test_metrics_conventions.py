@@ -61,9 +61,24 @@ def register_serving_metrics(registry: Registry) -> None:
     Engine(batcher, metrics=registry)
 
 
+def register_router_metrics(registry: Registry) -> None:
+    """The fleet-router edge (docs/fleet.md) registers into ITS OWN
+    registry in production; constructing one here holds its bci_router_*
+    family to the same conventions."""
+    import asyncio
+
+    from bee_code_interpreter_tpu.fleet import FleetRouter
+
+    router = FleetRouter(
+        [("r0", "http://127.0.0.1:1")], metrics=registry
+    )
+    asyncio.run(router.stop())
+
+
 def test_every_registered_metric_follows_conventions(tmp_path):
     registry = build_service_registry(tmp_path)
     register_serving_metrics(registry)
+    register_router_metrics(registry)
     metrics = registry.metrics
     assert len(metrics) >= 20, sorted(metrics)  # the wiring actually ran
 
@@ -137,6 +152,14 @@ def test_every_registered_metric_follows_conventions(tmp_path):
         "bci_warm_pop_ratio",
         "bci_pool_target_size",
         "bci_autoscale_decisions_total",
+        # fleet router (ISSUE 11): the replica-aware edge's own surface
+        "bci_router_requests_total",
+        "bci_router_request_seconds",
+        "bci_router_retries_total",
+        "bci_router_affinity_total",
+        "bci_router_lease_migrations_total",
+        "bci_router_replicas",
+        "bci_router_pinned_sessions",
     ):
         assert required in metrics, f"{required}: not registered by the wiring"
     assert isinstance(metrics["bci_pool_spawn_seconds"], Histogram)
@@ -178,6 +201,10 @@ def test_every_registered_metric_follows_conventions(tmp_path):
     assert isinstance(metrics["bci_warm_pop_ratio"], Gauge)
     assert isinstance(metrics["bci_pool_target_size"], Gauge)
     assert isinstance(metrics["bci_autoscale_decisions_total"], Counter)
+    assert isinstance(metrics["bci_router_requests_total"], Counter)
+    assert isinstance(metrics["bci_router_request_seconds"], Histogram)
+    assert isinstance(metrics["bci_router_lease_migrations_total"], Counter)
+    assert isinstance(metrics["bci_router_replicas"], Gauge)
 
     for name, metric in metrics.items():
         assert name.startswith("bci_"), (
