@@ -11,8 +11,11 @@ Two halves (docs/analysis.md):
   can skip its own scan (``context.py`` carries the prediction to the
   data plane).
 - **Self-analysis** — ``asynclint.py`` turns the same machinery on our own
-  ``api``/``services``/``resilience``/``observability`` packages,
-  enforcing repo asyncio invariants in tier-1.
+  control-plane packages (the scope is DERIVED from the package tree so a
+  new subsystem is linted by default), and ``concurrencylint.py`` adds the
+  await-aware rules (RMW across await, lock leaks, self-deadlocks,
+  unawaited teardown, cross-thread loop touches) on top of the
+  ``dataflow.py`` CFG engine — both enforced in tier-1.
 
 Layered like ``resilience/`` and ``observability/``: primitives here,
 wiring at the edges (api/, services/, runtime/).
@@ -22,9 +25,21 @@ from bee_code_interpreter_tpu.analysis.asynclint import (
     LintReport,
     Suppression,
     Violation,
+    default_packages,
     lint_paths,
     lint_source,
 )
+from bee_code_interpreter_tpu.analysis.concurrencylint import (
+    ConcurrencyReport,
+    lint_concurrency_paths,
+    lint_concurrency_source,
+)
+from bee_code_interpreter_tpu.analysis.dataflow import (
+    EXIT,
+    FunctionFlow,
+    iter_scopes,
+)
+from bee_code_interpreter_tpu.analysis.sarif import sarif_log, tool_run
 from bee_code_interpreter_tpu.analysis.context import (
     predicted_deps,
     stash_predicted_deps,
@@ -36,18 +51,26 @@ from bee_code_interpreter_tpu.analysis.inspect import (
     render_syntax_error,
 )
 from bee_code_interpreter_tpu.analysis.policy import (
+    COST_CLASSES,
+    HEAVY_COST_CLASSES,
     SHAPES,
     AnalysisVerdict,
     Finding,
     PolicyEngine,
     WorkloadAnalyzer,
+    classify_cost,
     split_patterns,
 )
 
 __all__ = [
     "AnalysisVerdict",
+    "COST_CLASSES",
     "CallSite",
+    "ConcurrencyReport",
+    "EXIT",
     "Finding",
+    "FunctionFlow",
+    "HEAVY_COST_CLASSES",
     "LintReport",
     "PolicyEngine",
     "SHAPES",
@@ -55,11 +78,18 @@ __all__ = [
     "Suppression",
     "Violation",
     "WorkloadAnalyzer",
+    "classify_cost",
+    "default_packages",
     "inspect_source",
+    "iter_scopes",
+    "lint_concurrency_paths",
+    "lint_concurrency_source",
     "lint_paths",
     "lint_source",
     "predicted_deps",
     "render_syntax_error",
+    "sarif_log",
     "split_patterns",
     "stash_predicted_deps",
+    "tool_run",
 ]

@@ -51,15 +51,47 @@ from bee_code_interpreter_tpu.analysis.inspect import (
 
 PACKAGE_ROOT = Path(__file__).resolve().parent.parent
 REPO_ROOT = PACKAGE_ROOT.parent
-DEFAULT_PACKAGES = (
-    "api",
-    "services",
-    "resilience",
-    "observability",
-    "sessions",
-    "fleet",
+# The default scope is DERIVED from the package tree minus this explicit
+# exclude list — a hand-maintained include list silently skipped every new
+# top-level package (fleet/ shipped unlinted for a whole PR before being
+# added by hand). Exclusions are the packages that are not asyncio
+# control plane: model/kernel code (models/, parallel/, ops/) and the
+# sandbox-side sitecustomize shim (runtime/shim/, which runs inside the
+# pod's interpreter, not our event loop). Entries may be top-level package
+# names or `pkg/subtree` path prefixes.
+DEFAULT_EXCLUDES = (
+    "models",
+    "parallel",
+    "ops",
+    "runtime/shim",
 )
 DEFAULT_DOCS = REPO_ROOT / "docs" / "observability.md"
+
+
+def default_packages(
+    root: Path | str = PACKAGE_ROOT,
+    excludes: tuple[str, ...] = DEFAULT_EXCLUDES,
+) -> tuple[str, ...]:
+    """Every top-level package under ``root`` (a directory with an
+    ``__init__.py``) that is not excluded — the scope a freshly created
+    subsystem lands in BY DEFAULT."""
+    root = Path(root)
+    return tuple(
+        sorted(
+            p.name
+            for p in root.iterdir()
+            if p.is_dir()
+            and (p / "__init__.py").exists()
+            and p.name not in excludes
+        )
+    )
+
+
+def _excluded(rel_path: str, excludes: tuple[str, ...]) -> bool:
+    """Is a package-root-relative file path under an excluded subtree?"""
+    return any(
+        rel_path == e or rel_path.startswith(e + "/") for e in excludes
+    )
 
 # Blocking entry points that must not run on the event loop. subprocess.Popen
 # is absent deliberately: constructing it is quick; *communicating* with it
@@ -137,6 +169,47 @@ SUPPRESSIONS: tuple[Suppression, ...] = (
             "APP_PYTHON selects the *sandbox* interpreter for spawned "
             "executor-server processes (docs/configuration.md); it configures "
             "the child environment contract, not this service's Config"
+        ),
+    ),
+    Suppression(
+        path="health_check.py",
+        rule="env-bypass",
+        reason=(
+            "the health probe is a kubelet exec'd CLI run hundreds of times "
+            "an hour; it reads the handful of APP_* listen-addr/TLS knobs "
+            "directly instead of importing pydantic + Config (import cost "
+            "dominates an exec probe), and each knob it reads is the same "
+            "documented field Config owns"
+        ),
+    ),
+    Suppression(
+        path="runtime/executor_server.py",
+        rule="env-bypass",
+        reason=(
+            "the in-sandbox executor server is configured SOLELY by the env "
+            "the control plane injects into its pod/process (the child "
+            "environment contract, docs/configuration.md); it has no Config "
+            "object by design — it must match the C++/Rust servers' surface"
+        ),
+    ),
+    Suppression(
+        path="runtime/executor_server.py",
+        rule="blocking-call-in-async",
+        reason=(
+            "the sandbox-side upload handler writes chunks to pod-local "
+            "tmpfs; per-chunk thread-pool hops cost more than the sync "
+            "writes they hide, and this loop serves ONE sandbox, not the "
+            "control plane (same tradeoff as services/local_code_executor.py)"
+        ),
+    ),
+    Suppression(
+        path="runtime/executor_core.py",
+        rule="env-bypass",
+        reason=(
+            "APP_JAX_CACHE_DIR is read in the sandbox-side core to export "
+            "JAX_COMPILATION_CACHE_DIR into the child interpreter — part of "
+            "the injected child environment contract, not this service's "
+            "Config (the control-plane half IS a Config field: jax_cache_dir)"
         ),
     ),
 )
@@ -333,26 +406,39 @@ def lint_source(
 
 def lint_paths(
     root: Path | str = PACKAGE_ROOT,
-    packages: tuple[str, ...] = DEFAULT_PACKAGES,
+    packages: tuple[str, ...] | None = None,
     docs_path: Path | str | None = DEFAULT_DOCS,
     suppressions: tuple[Suppression, ...] = SUPPRESSIONS,
+    excludes: tuple[str, ...] = DEFAULT_EXCLUDES,
 ) -> LintReport:
     """Lint the control-plane packages, apply the suppression list, and
-    report what remains — the tier-1 entry point."""
+    report what remains — the tier-1 entry point. ``packages=None`` (the
+    default) derives the scope from the package tree so a new subsystem
+    cannot ship unlinted by omission."""
     root = Path(root)
+    if packages is None:
+        packages = default_packages(root, excludes)
     docs_text: str | None = None
     if docs_path is not None:
         docs = Path(docs_path)
         docs_text = docs.read_text() if docs.exists() else ""
     report = LintReport()
     all_violations: list[Violation] = []
-    for package in packages:
-        for py in sorted((root / package).rglob("*.py")):
-            rel = str(py.relative_to(root.parent))
-            linter = _lint_one(py.read_text(), rel)
-            all_violations.extend(linter.violations)
-            all_violations.extend(_metric_violations(linter, docs_text))
-            report.metric_names.update(name for name, _ in linter.metric_sites)
+    # Top-level modules (application_context.py, health_check.py, __main__)
+    # are control plane too — the composition root is where wiring bugs
+    # live, and it is in no package directory.
+    top_modules = tuple(sorted(root.glob("*.py")))
+    package_files = [
+        py for package in packages for py in sorted((root / package).rglob("*.py"))
+    ]
+    for py in [*top_modules, *package_files]:
+        rel = str(py.relative_to(root.parent))
+        if _excluded(str(py.relative_to(root)), excludes):
+            continue
+        linter = _lint_one(py.read_text(), rel)
+        all_violations.extend(linter.violations)
+        all_violations.extend(_metric_violations(linter, docs_text))
+        report.metric_names.update(name for name, _ in linter.metric_sites)
     used: set[Suppression] = set()
     for v in all_violations:
         match = next((s for s in suppressions if s.matches(v)), None)

@@ -1,0 +1,688 @@
+"""Await-aware concurrency lint for the asyncio control plane
+(docs/analysis.md "Concurrency lint rules").
+
+``asynclint.py`` catches single-statement hazards (a blocking call inside
+``async def``, a dropped task handle). This linter catches the hazards that
+only exist *across* statements — the bug class the repo has now hit several
+times by hand-auditing: shared state mutated across an ``await``, a lock
+that leaks on an early return, a teardown nobody awaits. It is built on the
+``analysis/dataflow.py`` CFG engine and, like asynclint, runs as a tier-1
+test (tests/test_concurrencylint.py) with an explicit, justified suppression
+list where stale suppressions FAIL.
+
+Rules:
+
+- ``unlocked-rmw-across-await``   a ``self.``-attribute (or declared-global)
+  value is read, an ``await`` can run, and the stale value is then written
+  back — the lost-update shape single-loop asyncio only protects you from
+  *between* awaits, never across them — with no ``asyncio.Lock`` scope
+  (``async with lock:``) shared by the read and the write.
+- ``lock-not-released``           ``<x>.acquire()`` with a CFG path to the
+  function exit that never passes ``<x>.release()`` (early return, raise
+  into a handler that forgets, missing ``finally``). ``async with`` cannot
+  leak and is the sanctioned spelling.
+- ``await-under-lock-self-deadlock``  while a lock scope is held, ``await
+  self.m(...)`` where method ``m`` of the same class takes the SAME lock —
+  asyncio.Lock is not reentrant, so the caller deadlocks on itself.
+- ``unawaited-teardown``          a class defines ``async def aclose``/
+  ``stop``, an instance is constructed somewhere in the linted corpus, and
+  NO teardown path ever awaits either method on such an instance — work
+  nothing can cancel at drain.
+- ``thread-loop-touch``           a function handed to ``threading.Thread``
+  / ``asyncio.to_thread`` / ``run_in_executor`` pokes event-loop state
+  directly (``call_soon``/``create_task``/``ensure_future``/``set_result``/
+  ``set_exception``) instead of going through ``call_soon_threadsafe`` —
+  the contprof/serving-hook bug class (PR 8/9) promoted to a rule.
+
+The first three rules are intraprocedural per ``async def``; the last two
+aggregate per file / per corpus. All of them over-approximate *paths* and
+under-approximate *values* (see dataflow.py), so a finding is a real shape
+in the code even when the runtime schedule happens to be benign — which is
+exactly what the suppression list is for.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from pathlib import Path
+
+from bee_code_interpreter_tpu.analysis.asynclint import (
+    PACKAGE_ROOT,
+    Suppression,
+    Violation,
+    default_packages,
+)
+from bee_code_interpreter_tpu.analysis.dataflow import (
+    EXIT,
+    FunctionFlow,
+    expr_text,
+    iter_own_exprs,
+)
+from bee_code_interpreter_tpu.analysis.inspect import (
+    collect_aliases,
+    resolve_call_name,
+)
+
+#: Packages the concurrency lint additionally skips beyond asynclint's
+#: excludes: generated proto stubs, the in-sandbox runtime (its own process,
+#: not this event loop), and leaf util/model/kernel code with no async state.
+EXTRA_EXCLUDES = ("proto", "runtime", "utils")
+
+_TEARDOWN_METHODS = ("aclose", "stop")
+_LOOP_TOUCH_ATTRS = frozenset(
+    {"call_soon", "create_task", "ensure_future", "set_result", "set_exception"}
+)
+_THREAD_SPAWNERS = frozenset({"threading.Thread", "asyncio.to_thread"})
+
+
+# The shipped suppression budget — same contract as asynclint.SUPPRESSIONS:
+# every entry names WHY the shape is sound, and an entry that no longer
+# matches any violation fails the suite.
+SUPPRESSIONS: tuple[Suppression, ...] = (
+    Suppression(
+        path="services/kubernetes_code_executor.py",
+        rule="unawaited-teardown",
+        reason=(
+            "closed at drain by ApplicationContext.aclose via the getattr-"
+            "dispatched `aclose = getattr(backend, 'aclose', None); await "
+            "aclose()` behind unwrap_executor — dynamic dispatch the "
+            "intraprocedural engine cannot follow; the e2e drain suite "
+            "exercises the real path"
+        ),
+    ),
+    Suppression(
+        path="services/native_process_code_executor.py",
+        rule="unawaited-teardown",
+        reason=(
+            "same getattr-dispatched backend aclose as the kubernetes "
+            "executor (ApplicationContext.aclose / unwrap_executor); the "
+            "bench and chaos harnesses also close it via shutdown() on "
+            "their sync exit paths"
+        ),
+    ),
+)
+
+
+@dataclass
+class ConcurrencyReport:
+    violations: list[Violation] = field(default_factory=list)
+    suppressed: list[tuple[Violation, Suppression]] = field(default_factory=list)
+    stale_suppressions: list[Suppression] = field(default_factory=list)
+    files_scanned: int = 0
+
+    @property
+    def clean(self) -> bool:
+        return not self.violations and not self.stale_suppressions
+
+    def summary(self) -> str:
+        lines = [str(v) for v in self.violations]
+        lines += [
+            f"stale suppression ({s.path} [{s.rule}]): no matching violation"
+            for s in self.stale_suppressions
+        ]
+        return "\n".join(lines) or "clean"
+
+
+# --------------------------------------------------------------------------
+# per-function rules (RMW across await, lock leak, self-deadlock)
+# --------------------------------------------------------------------------
+
+
+def _attr_loads(stmt: ast.stmt) -> set[str]:
+    out = set()
+    for e in iter_own_exprs(stmt):
+        if isinstance(e, ast.Attribute) and isinstance(e.ctx, ast.Load):
+            t = expr_text(e)
+            if t is not None and t.startswith("self."):
+                out.add(t)
+    return out
+
+
+def _attr_stores(stmt: ast.stmt) -> set[str]:
+    targets: list[ast.expr] = []
+    if isinstance(stmt, ast.Assign):
+        targets = stmt.targets
+    elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+        targets = [stmt.target]
+    out = set()
+    for t in targets:
+        if isinstance(t, ast.Attribute):
+            text = expr_text(t)
+            if text is not None and text.startswith("self."):
+                out.add(text)
+    return out
+
+
+def _rhs_name_loads(stmt: ast.stmt) -> set[str]:
+    value = getattr(stmt, "value", None)
+    if not isinstance(value, ast.expr):
+        return set()
+    return {
+        n.id
+        for n in ast.walk(value)
+        if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load)
+    }
+
+
+def _global_names(func: ast.AST) -> set[str]:
+    """Names a ``global`` statement makes writable module state inside this
+    function — the module-global half of the RMW rule."""
+    out: set[str] = set()
+    for node in ast.walk(func):
+        if isinstance(node, ast.Global):
+            out.update(node.names)
+    return out
+
+
+def _lock_calls(stmt: ast.stmt, method: str) -> set[str]:
+    """Receiver texts of ``<recv>.acquire()`` / ``.release()`` calls in this
+    statement's own region."""
+    out = set()
+    for e in iter_own_exprs(stmt):
+        if (
+            isinstance(e, ast.Call)
+            and isinstance(e.func, ast.Attribute)
+            and e.func.attr == method
+            and not e.args
+            and not e.keywords
+        ):
+            recv = expr_text(e.func.value)
+            if recv is not None:
+                out.add(recv)
+    return out
+
+
+def _check_rmw(flow: FunctionFlow, path: str, out: list[Violation]) -> None:
+    globals_here = _global_names(flow.scope)
+
+    def stores(node) -> set[str]:
+        s = _attr_stores(node.stmt)
+        if globals_here:
+            s |= {n for n in node.defines if n in globals_here}
+        return s
+
+    def loads(node) -> set[str]:
+        s = _attr_loads(node.stmt)
+        if globals_here:
+            for e in iter_own_exprs(node.stmt):
+                if (
+                    isinstance(e, ast.Name)
+                    and isinstance(e.ctx, ast.Load)
+                    and e.id in globals_here
+                ):
+                    s.add(e.id)
+        return s
+
+    for node in flow.nodes:
+        written = stores(node)
+        if not written:
+            continue
+        # Case A: one statement reads, awaits, and writes the same target
+        # (`self.x = self.x + await f()`, `self.x += await q.get()`): the
+        # read value is stale by the time the store runs.
+        if node.has_await and not node.held_locks:
+            one_stmt_rmw = written & loads(node)
+            if isinstance(node.stmt, ast.AugAssign):
+                # the AugAssign target is a read too (AST marks it Store only)
+                one_stmt_rmw = written
+            for target in one_stmt_rmw:
+                out.append(
+                    Violation(
+                        path=path,
+                        line=node.line,
+                        rule="unlocked-rmw-across-await",
+                        message=(
+                            f"{target} is read and written back in one "
+                            "statement that awaits in between; the stored "
+                            "value is stale — guard with an asyncio.Lock "
+                            "or restructure to write before the await"
+                        ),
+                    )
+                )
+        # Case B: the write's RHS flows from a local whose defining
+        # statement read the same target, with an await on some path in
+        # between and no lock scope shared by both ends.
+        rhs_locals = _rhs_name_loads(node.stmt)
+        if not rhs_locals:
+            continue
+        reach = flow.reach_in(node.idx)
+        for name in rhs_locals:
+            for def_idx in reach.get(name, ()):
+                def_node = flow.nodes[def_idx]
+                for target in written & loads(def_node):
+                    # Scope IDENTITY, not lock name: two separate
+                    # `async with self._lock` blocks release the lock
+                    # across the await between them — the exact window
+                    # this rule exists for.
+                    if def_node.held_scopes & node.held_scopes:
+                        continue
+                    if flow.await_between(def_idx, node.idx):
+                        out.append(
+                            Violation(
+                                path=path,
+                                line=node.line,
+                                rule="unlocked-rmw-across-await",
+                                message=(
+                                    f"{target} read at line {def_node.line} "
+                                    f"is written back here after an await "
+                                    "without a shared asyncio.Lock scope; "
+                                    "another task can interleave and the "
+                                    "update is lost"
+                                ),
+                            )
+                        )
+
+
+def _check_lock_release(flow: FunctionFlow, path: str, out: list[Violation]) -> None:
+    for node in flow.nodes:
+        for recv in _lock_calls(node.stmt, "acquire"):
+            leaks = flow.exit_reachable_without(
+                node.idx, lambda n, r=recv: r in _lock_calls(n.stmt, "release")
+            )
+            if leaks:
+                out.append(
+                    Violation(
+                        path=path,
+                        line=node.line,
+                        rule="lock-not-released",
+                        message=(
+                            f"{recv}.acquire() has a path to the function "
+                            f"exit that never calls {recv}.release(); use "
+                            "`async with` (it cannot leak) or release in "
+                            "a finally"
+                        ),
+                    )
+                )
+
+
+def _locks_taken(func: ast.AST) -> set[str]:
+    """Every ``self.*`` lock scope a method enters anywhere in its body:
+    ``async with self._lock`` items plus ``await self._lock.acquire()``."""
+    out: set[str] = set()
+    for node in ast.walk(func):
+        if isinstance(node, ast.AsyncWith):
+            for item in node.items:
+                t = expr_text(item.context_expr)
+                if t is not None and t.startswith("self."):
+                    out.add(t)
+        elif (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "acquire"
+        ):
+            recv = expr_text(node.func.value)
+            if recv is not None and recv.startswith("self."):
+                out.add(recv)
+    return out
+
+
+def _acquired_locks_at(flow: FunctionFlow, node) -> set[str]:
+    """``self.*`` locks held at ``node`` via the explicit
+    ``await <lock>.acquire()`` spelling: an acquire site reaches this
+    statement on some path with no intervening ``release()``."""
+    out: set[str] = set()
+    for acq in flow.nodes:
+        if acq.idx == node.idx:
+            continue
+        for recv in _lock_calls(acq.stmt, "acquire"):
+            if not recv.startswith("self."):
+                continue
+            if flow.reaches_without(
+                acq.idx,
+                node.idx,
+                lambda n, r=recv: r in _lock_calls(n.stmt, "release"),
+            ):
+                out.add(recv)
+    return out
+
+
+def _check_self_deadlock(
+    methods: dict[str, ast.AST],
+    flows: dict[str, FunctionFlow],
+    path: str,
+    out: list[Violation],
+) -> None:
+    taken = {name: _locks_taken(func) for name, func in methods.items()}
+    for name, flow in flows.items():
+        for node in flow.nodes:
+            awaited_callees = [
+                e.value.func.attr
+                for e in iter_own_exprs(node.stmt)
+                if isinstance(e, ast.Await)
+                and isinstance(e.value, ast.Call)
+                and isinstance(e.value.func, ast.Attribute)
+                and isinstance(e.value.func.value, ast.Name)
+                and e.value.func.value.id == "self"
+            ]
+            if not awaited_callees:
+                continue
+            held = {k for k in node.held_locks if k.startswith("self.")}
+            # ...plus locks held via the explicit acquire() spelling (an
+            # acquire reaching here with no release on the path)
+            held |= _acquired_locks_at(flow, node)
+            if not held:
+                continue
+            for callee in awaited_callees:
+                overlap = held & taken.get(callee, set())
+                if overlap:
+                    lock = sorted(overlap)[0]
+                    out.append(
+                        Violation(
+                            path=path,
+                            line=node.line,
+                            rule="await-under-lock-self-deadlock",
+                            message=(
+                                f"await self.{callee}(...) while holding "
+                                f"{lock}, which {callee}() takes again — "
+                                "asyncio.Lock is not reentrant; this "
+                                "deadlocks on itself"
+                            ),
+                        )
+                    )
+
+
+# --------------------------------------------------------------------------
+# per-file rules (thread-loop-touch) and corpus aggregation (teardown)
+# --------------------------------------------------------------------------
+
+
+def _walk_excluding_nested(func: ast.AST):
+    """Walk a function body without descending into nested defs/lambdas —
+    a nested function handed to ``call_soon_threadsafe`` runs ON the loop,
+    where touching loop state is the whole point."""
+    stack = list(ast.iter_child_nodes(func))
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _thread_entry_names(tree: ast.AST, aliases: dict[str, str]) -> set[str]:
+    """Function/method names handed to a thread in this file: the
+    ``target=`` of ``threading.Thread``, the callable of
+    ``asyncio.to_thread`` / ``<loop>.run_in_executor``."""
+    out: set[str] = set()
+
+    def callable_name(expr: ast.expr) -> str | None:
+        if isinstance(expr, ast.Name):
+            return expr.id
+        if isinstance(expr, ast.Attribute):
+            return expr.attr  # self._run -> "_run"
+        return None
+
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        resolved = resolve_call_name(node.func, aliases)
+        if resolved in _THREAD_SPAWNERS:
+            target: ast.expr | None = None
+            if resolved == "threading.Thread":
+                for kw in node.keywords:
+                    if kw.arg == "target":
+                        target = kw.value
+            elif node.args:
+                target = node.args[0]
+            name = callable_name(target) if target is not None else None
+            if name:
+                out.add(name)
+        elif (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr == "run_in_executor"
+            and len(node.args) >= 2
+        ):
+            name = callable_name(node.args[1])
+            if name:
+                out.add(name)
+    return out
+
+
+def _check_thread_loop_touch(
+    tree: ast.AST, aliases: dict[str, str], path: str, out: list[Violation]
+) -> None:
+    entries = _thread_entry_names(tree, aliases)
+    if not entries:
+        return
+    for node in ast.walk(tree):
+        if (
+            isinstance(node, ast.FunctionDef)  # a thread target is sync
+            and node.name in entries
+        ):
+            for inner in _walk_excluding_nested(node):
+                if not isinstance(inner, ast.Call):
+                    continue
+                attr = (
+                    inner.func.attr
+                    if isinstance(inner.func, ast.Attribute)
+                    else None
+                )
+                resolved = resolve_call_name(inner.func, aliases)
+                if attr in _LOOP_TOUCH_ATTRS or resolved in (
+                    "asyncio.create_task",
+                    "asyncio.ensure_future",
+                ):
+                    touched = attr or resolved
+                    out.append(
+                        Violation(
+                            path=path,
+                            line=inner.lineno,
+                            rule="thread-loop-touch",
+                            message=(
+                                f"{node.name}() runs on a worker thread but "
+                                f"calls {touched}() directly; asyncio state "
+                                "is not thread-safe — marshal through "
+                                "loop.call_soon_threadsafe"
+                            ),
+                        )
+                    )
+
+
+@dataclass
+class _TeardownFacts:
+    """Cross-file facts the unawaited-teardown rule aggregates."""
+
+    # class name -> (path, line, tuple of async teardown method names)
+    classes: dict[str, tuple[str, int, tuple[str, ...]]] = field(
+        default_factory=dict
+    )
+    # class name -> set of binding components its instances land in
+    # ("self.storage = Storage(...)" -> "storage")
+    constructions: dict[str, set[str]] = field(default_factory=dict)
+    # (binding component, method) pairs awaited anywhere
+    awaited: set[tuple[str, str]] = field(default_factory=set)
+    # classes entered via `async with Class(...)` — teardown via __aexit__
+    async_with: set[str] = field(default_factory=set)
+
+
+def _class_of_call(func: ast.expr) -> str | None:
+    """The class a construction-shaped call names: ``C(...)`` → C,
+    ``mod.C(...)`` → C, ``C.from_config(...)`` → C (classmethod)."""
+    text = expr_text(func)
+    if text is None:
+        return None
+    parts = text.split(".")
+    for part in reversed(parts):
+        if part[:1].isupper():
+            return part
+    return None
+
+
+def _binding_component(target: ast.expr) -> str | None:
+    if isinstance(target, ast.Name):
+        return target.id
+    if isinstance(target, ast.Attribute):
+        return target.attr
+    return None
+
+
+def _collect_teardown_facts(
+    tree: ast.AST, path: str, facts: _TeardownFacts
+) -> None:
+    def visit(node: ast.AST, func_name: str | None) -> None:
+        if isinstance(node, ast.ClassDef):
+            methods = tuple(
+                m.name
+                for m in node.body
+                if isinstance(m, ast.AsyncFunctionDef)
+                and m.name in _TEARDOWN_METHODS
+            )
+            if methods and node.name not in facts.classes:
+                facts.classes[node.name] = (path, node.lineno, methods)
+        elif isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            cls = _class_of_call(node.value.func)
+            if cls is not None:
+                for t in node.targets:
+                    comp = _binding_component(t)
+                    if comp is not None:
+                        facts.constructions.setdefault(cls, set()).add(comp)
+                if func_name is not None:
+                    # The factory pattern: a construction inside `def N`
+                    # usually escapes AS `N` (cached_property / builder
+                    # methods) — `await ctx.sessions.stop()` tears down the
+                    # SessionManager that `def sessions()` built.
+                    facts.constructions.setdefault(cls, set()).add(func_name)
+        elif isinstance(node, ast.Await):
+            call = node.value
+            if (
+                isinstance(call, ast.Call)
+                and isinstance(call.func, ast.Attribute)
+                and call.func.attr in _TEARDOWN_METHODS
+            ):
+                recv = expr_text(call.func.value)
+                if recv is not None:
+                    facts.awaited.add((recv.split(".")[-1], call.func.attr))
+        elif isinstance(node, ast.AsyncWith):
+            for item in node.items:
+                if isinstance(item.context_expr, ast.Call):
+                    cls = _class_of_call(item.context_expr.func)
+                    if cls is not None:
+                        facts.async_with.add(cls)
+        inner = func_name
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            inner = node.name
+        for child in ast.iter_child_nodes(node):
+            visit(child, inner)
+
+    visit(tree, None)
+
+
+def _teardown_violations(facts: _TeardownFacts) -> list[Violation]:
+    out: list[Violation] = []
+    for cls, (path, line, methods) in sorted(facts.classes.items()):
+        if cls in facts.async_with:
+            continue
+        bindings = facts.constructions.get(cls)
+        if not bindings:
+            continue  # never constructed in the linted corpus
+        awaited = any(
+            (comp, m) in facts.awaited for comp in bindings for m in methods
+        )
+        if not awaited:
+            spelled = "/".join(methods)
+            out.append(
+                Violation(
+                    path=path,
+                    line=line,
+                    rule="unawaited-teardown",
+                    message=(
+                        f"{cls} defines async {spelled} but no teardown "
+                        f"path awaits it on any constructed instance "
+                        f"({', '.join(sorted(bindings))}) — its background "
+                        "work cannot be cancelled at drain"
+                    ),
+                )
+            )
+    return out
+
+
+# --------------------------------------------------------------------------
+# entry points
+# --------------------------------------------------------------------------
+
+
+def _lint_tree(
+    tree: ast.AST, path: str, facts: _TeardownFacts | None
+) -> list[Violation]:
+    aliases = collect_aliases(tree)
+    out: list[Violation] = []
+    # class methods first (so self-deadlock sees whole classes), then
+    # remaining async defs (module-level helpers, nested closures)
+    seen: set[int] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef):
+            methods: dict[str, ast.AST] = {}
+            flows: dict[str, FunctionFlow] = {}
+            for m in node.body:
+                if isinstance(m, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    methods[m.name] = m
+                    if isinstance(m, ast.AsyncFunctionDef):
+                        flows[m.name] = FunctionFlow(m, aliases=aliases)
+                        seen.add(id(m))
+            for flow in flows.values():
+                _check_rmw(flow, path, out)
+                _check_lock_release(flow, path, out)
+            _check_self_deadlock(methods, flows, path, out)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.AsyncFunctionDef) and id(node) not in seen:
+            flow = FunctionFlow(node, aliases=aliases)
+            _check_rmw(flow, path, out)
+            _check_lock_release(flow, path, out)
+    _check_thread_loop_touch(tree, aliases, path, out)
+    if facts is not None:
+        _collect_teardown_facts(tree, path, facts)
+    return out
+
+
+def lint_concurrency_source(source: str, path: str = "<memory>") -> list[Violation]:
+    """Lint one source blob with the intraprocedural + per-file rules and
+    the teardown rule scoped to this blob alone (unit-test entry point)."""
+    import textwrap
+
+    tree = ast.parse(textwrap.dedent(source), filename=path)
+    facts = _TeardownFacts()
+    violations = _lint_tree(tree, path, facts)
+    violations += _teardown_violations(facts)
+    violations.sort(key=lambda v: (v.path, v.line, v.rule))
+    return violations
+
+
+def lint_concurrency_paths(
+    root: Path | str = PACKAGE_ROOT,
+    packages: tuple[str, ...] | None = None,
+    suppressions: tuple[Suppression, ...] = SUPPRESSIONS,
+) -> ConcurrencyReport:
+    """Lint the control-plane packages (asynclint's derived scope minus
+    :data:`EXTRA_EXCLUDES`), apply the suppression list, and report what
+    remains — the tier-1 entry point."""
+    root = Path(root)
+    if packages is None:
+        packages = tuple(
+            p for p in default_packages(root) if p not in EXTRA_EXCLUDES
+        )
+    report = ConcurrencyReport()
+    facts = _TeardownFacts()
+    all_violations: list[Violation] = []
+    # Top-level modules too: the composition root (application_context.py)
+    # is where most teardown paths live.
+    files = list(sorted(root.glob("*.py"))) + [
+        py for package in packages for py in sorted((root / package).rglob("*.py"))
+    ]
+    for py in files:
+        rel = str(py.relative_to(root.parent))
+        tree = ast.parse(py.read_text(), filename=rel)
+        all_violations.extend(_lint_tree(tree, rel, facts))
+        report.files_scanned += 1
+    all_violations.extend(_teardown_violations(facts))
+    used: set[Suppression] = set()
+    for v in all_violations:
+        match = next((s for s in suppressions if s.matches(v)), None)
+        if match is None:
+            report.violations.append(v)
+        else:
+            used.add(match)
+            report.suppressed.append((v, match))
+    report.stale_suppressions = [s for s in suppressions if s not in used]
+    report.violations.sort(key=lambda v: (v.path, v.line, v.rule))
+    return report

@@ -1,0 +1,884 @@
+"""Intraprocedural CFG + dataflow over ``ast`` (docs/analysis.md "Dataflow layer").
+
+One reusable engine with two very different consumers:
+
+- the **concurrency lint** (``analysis/concurrencylint.py``) walks per-function
+  control-flow graphs asking path questions — "is there an await between this
+  read and that write", "does every path from this ``acquire()`` pass a
+  ``release()``" — with every statement annotated by the ``async with`` lock
+  scopes that enclose it;
+- the **workload policy** (``analysis/policy.py`` via ``inspect.py``) uses the
+  same reaching-definitions + alias layer to resolve *values*: what dotted
+  origin a name can hold at a call site (``x = __import__; x("socket")``) and
+  whether a string argument constant-folds (``getattr(os, "sys" + "tem")``).
+
+Design constraints, in order: never crash on valid Python (every construct has
+a conservative fallback), stay intraprocedural (one function or the module
+body at a time; nested functions get the enclosing module's *single-assignment*
+bindings as extra aliases, nothing more), and stay cheap — the policy consumer
+runs on the request path under a <1 ms p50 budget (bench.py asserts it), so
+everything here is a single flattening pass plus a small fixpoint over
+statement nodes.
+
+Approximations are one-directional by rule: the CFG *over*-approximates paths
+(every statement in a ``try`` may reach every handler; a ``finally`` body is
+duplicated for abrupt exits), which is the safe direction for "a release must
+exist on all paths"; value resolution *under*-approximates (a name with two
+conflicting reaching definitions resolves to both origins, an unresolvable
+expression to none), the safe direction for deny rules.
+"""
+
+from __future__ import annotations
+
+import ast
+import builtins
+from dataclasses import dataclass, field
+
+_BUILTIN_NAMES = frozenset(dir(builtins))
+
+#: Virtual exit node id: edges to EXIT mean "the function returns/raises out".
+EXIT = -1
+
+_FUNCTION_NODES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+#: Callables whose *value* is an import of their first (string) argument.
+IMPORT_FUNCTIONS = frozenset(
+    {
+        "__import__",
+        "builtins.__import__",
+        "importlib.import_module",
+        "importlib.__import__",
+    }
+)
+
+
+def expr_text(expr: ast.expr) -> str | None:
+    """Dotted source text of a plain ``Name``/``Attribute`` chain
+    (``self._lock``, ``mod.sub.thing``); ``None`` for anything else —
+    call results, subscripts and constants have no stable identity to
+    compare lock scopes or receivers by."""
+    parts: list[str] = []
+    node = expr
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if not isinstance(node, ast.Name):
+        return None
+    parts.append(node.id)
+    return ".".join(reversed(parts))
+
+
+def iter_own_exprs(stmt: ast.stmt):
+    """The expressions a statement itself evaluates, excluding the bodies
+    of nested functions/lambdas and — for compound statements — excluding
+    sub-statement bodies (those become their own CFG nodes). ``ClassDef``
+    is a leaf in the CFG, so its whole body (minus nested functions)
+    counts as its own region."""
+    if isinstance(stmt, (ast.If, ast.While)):
+        roots: list[ast.AST] = [stmt.test]
+    elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+        roots = [stmt.target, stmt.iter]
+    elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+        roots = [item.context_expr for item in stmt.items]
+        roots += [
+            item.optional_vars
+            for item in stmt.items
+            if item.optional_vars is not None
+        ]
+    elif isinstance(stmt, ast.Try):
+        roots = []
+    elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        roots = list(stmt.decorator_list)
+    elif isinstance(stmt, ast.ClassDef):
+        roots = list(stmt.decorator_list) + list(stmt.bases) + list(stmt.body)
+    else:
+        roots = [stmt]
+    stack = list(roots)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, _FUNCTION_NODES):
+            continue
+        if isinstance(node, ast.expr):
+            yield node
+        for child in ast.iter_child_nodes(node):
+            stack.append(child)
+
+
+def _stmt_has_await(stmt: ast.stmt) -> bool:
+    """Does evaluating THIS statement's own region suspend? ``async for``
+    headers and ``async with`` enters are await points by construction."""
+    if isinstance(stmt, (ast.AsyncFor, ast.AsyncWith)):
+        return True
+    return any(isinstance(e, ast.Await) for e in iter_own_exprs(stmt))
+
+
+def _assigned_names(stmt: ast.stmt) -> set[str]:
+    """Plain names this statement (re)binds — the kill/gen set for
+    reaching definitions. Attribute/subscript targets are not name
+    bindings and are tracked separately by consumers."""
+    names: set[str] = set()
+
+    def targets(node: ast.expr) -> None:
+        if isinstance(node, ast.Name):
+            names.add(node.id)
+        elif isinstance(node, (ast.Tuple, ast.List)):
+            for elt in node.elts:
+                targets(elt)
+        elif isinstance(node, ast.Starred):
+            targets(node.value)
+
+    if isinstance(stmt, ast.Assign):
+        for t in stmt.targets:
+            targets(t)
+    elif isinstance(stmt, (ast.AnnAssign, ast.AugAssign)):
+        targets(stmt.target)
+    elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+        targets(stmt.target)
+    elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+        for item in stmt.items:
+            if item.optional_vars is not None:
+                targets(item.optional_vars)
+    elif isinstance(stmt, (ast.Import, ast.ImportFrom)):
+        for alias in stmt.names:
+            names.add((alias.asname or alias.name).split(".", 1)[0])
+    elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+        names.add(stmt.name)
+    # walrus bindings inside the statement's own expressions
+    for e in iter_own_exprs(stmt):
+        if isinstance(e, ast.NamedExpr) and isinstance(e.target, ast.Name):
+            names.add(e.target.id)
+    return names
+
+
+def _assign_value(stmt: ast.stmt, name: str) -> ast.expr | None:
+    """The RHS expression that gives ``name`` its value at this def site,
+    when one exists in a resolvable single-target shape. Tuple unpacking,
+    loop targets, and with-as bindings return None ("unknown value")."""
+    if isinstance(stmt, ast.Assign):
+        for t in stmt.targets:
+            if isinstance(t, ast.Name) and t.id == name:
+                return stmt.value
+    elif isinstance(stmt, ast.AnnAssign):
+        if isinstance(stmt.target, ast.Name) and stmt.target.id == name:
+            return stmt.value
+    return None
+
+
+#: Sentinel distinguishing "this expression is not a literal shape" from a
+#: legitimate ``None`` fold result in :func:`_fold_literal`.
+_NOT_LITERAL = object()
+
+
+def _fold_literal(expr: ast.expr, recurse):
+    """The literal constant-folding arms (string constants, ``+`` of
+    foldables, all-literal f-strings) shared by BOTH folding modes —
+    :meth:`FunctionFlow.fold_str` and :meth:`ScopeBindings.fold_str` differ
+    only in how they resolve a *name*, never in what a literal is.
+    Returns :data:`_NOT_LITERAL` when the expression needs name
+    resolution (or cannot fold structurally)."""
+    if isinstance(expr, ast.Constant):
+        return expr.value if isinstance(expr.value, str) else None
+    if isinstance(expr, ast.BinOp) and isinstance(expr.op, ast.Add):
+        left = recurse(expr.left)
+        right = recurse(expr.right)
+        return left + right if left is not None and right is not None else None
+    if isinstance(expr, ast.JoinedStr):
+        parts = []
+        for value in expr.values:
+            if isinstance(value, ast.Constant) and isinstance(value.value, str):
+                parts.append(value.value)
+            else:
+                return None
+        return "".join(parts)
+    return _NOT_LITERAL
+
+
+@dataclass
+class StmtNode:
+    """One flattened statement in the CFG. ``held_scopes`` is the set of
+    ``(context-expression text, id of the enclosing async-with statement)``
+    pairs lexically enclosing this statement — the SCOPE identity matters:
+    two separate ``async with self._lock`` blocks hold the same lock NAME
+    but release it in between, which is exactly the window the RMW rule
+    exists to catch. ``held_locks`` is the name-only projection for rules
+    that compare against lock names (self-deadlock)."""
+
+    idx: int
+    stmt: ast.stmt
+    succs: set[int] = field(default_factory=set)
+    has_await: bool = False
+    held_scopes: frozenset[tuple[str, int]] = frozenset()
+    defines: set[str] = field(default_factory=set)
+
+    @property
+    def held_locks(self) -> frozenset[str]:
+        return frozenset(name for name, _scope in self.held_scopes)
+
+    @property
+    def line(self) -> int:
+        return getattr(self.stmt, "lineno", 0)
+
+
+class FunctionFlow:
+    """CFG + reaching definitions for ONE scope: a function body or the
+    module body (``scope`` is the FunctionDef/AsyncFunctionDef/Module).
+
+    ``outer_origins``/``outer_consts`` carry the enclosing module's
+    single-assignment bindings into nested scopes — enough to resolve
+    ``IMP = __import__`` at module level used inside a function, without
+    pretending to be interprocedural."""
+
+    def __init__(
+        self,
+        scope: ast.AST,
+        aliases: dict[str, str] | None = None,
+        outer_origins: dict[str, set[str]] | None = None,
+        outer_consts: dict[str, str] | None = None,
+    ) -> None:
+        self.scope = scope
+        self.aliases = aliases or {}
+        self.outer_origins = outer_origins or {}
+        self.outer_consts = outer_consts or {}
+        self.nodes: list[StmtNode] = []
+        self._stmt_to_idx: dict[int, int] = {}  # id(ast stmt) -> node idx
+        body = list(getattr(scope, "body", []))
+        self._build_seq(body, EXIT, loops=[], finallies=[], exc=(), held=frozenset())
+        # entry is the first statement of the body (nodes are created in
+        # source order by _build_seq's reverse fold, so re-derive it):
+        self.entry = self._stmt_to_idx[id(body[0])] if body else EXIT
+        self._preds: dict[int, set[int]] | None = None
+        self._reach_in: list[dict[str, frozenset[int]]] | None = None
+        self.assigned_names: set[str] = set()
+        for node in self.nodes:
+            self.assigned_names |= node.defines
+
+    # ------------------------------------------------------------ build
+    def _new_node(
+        self, stmt: ast.stmt, held: frozenset[tuple[str, int]]
+    ) -> StmtNode:
+        node = StmtNode(
+            idx=len(self.nodes),
+            stmt=stmt,
+            has_await=_stmt_has_await(stmt),
+            held_scopes=held,
+            defines=_assigned_names(stmt),
+        )
+        self.nodes.append(node)
+        self._stmt_to_idx[id(stmt)] = node.idx
+        return node
+
+    def _build_seq(
+        self, stmts, succ, *, loops, finallies, exc, held
+    ) -> int:
+        """Flatten a statement sequence; returns the entry node idx (or
+        ``succ`` for an empty sequence). Built by a reverse fold so each
+        statement's successor is already known."""
+        entry = succ
+        for stmt in reversed(stmts):
+            entry = self._build_stmt(
+                stmt, entry, loops=loops, finallies=finallies, exc=exc, held=held
+            )
+        return entry
+
+    def _abrupt_target(self, finallies) -> int:
+        return finallies[-1] if finallies else EXIT
+
+    def _build_stmt(self, stmt, succ, *, loops, finallies, exc, held) -> int:
+        node = self._new_node(stmt, held)
+        kw = dict(loops=loops, finallies=finallies, exc=exc, held=held)
+        if isinstance(stmt, ast.If):
+            body = self._build_seq(stmt.body, succ, **kw)
+            orelse = self._build_seq(stmt.orelse, succ, **kw) if stmt.orelse else succ
+            node.succs = {body, orelse}
+        elif isinstance(stmt, (ast.While, ast.For, ast.AsyncFor)):
+            orelse = self._build_seq(stmt.orelse, succ, **kw) if stmt.orelse else succ
+            inner_loops = loops + [(succ, node.idx)]  # (break, continue)
+            body = self._build_seq(
+                stmt.body, node.idx,
+                loops=inner_loops, finallies=finallies, exc=exc, held=held,
+            )
+            node.succs = {body, orelse}
+        elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+            inner_held = held
+            if isinstance(stmt, ast.AsyncWith):
+                keys = {
+                    (t, id(stmt)) for item in stmt.items
+                    if (t := expr_text(item.context_expr)) is not None
+                }
+                inner_held = held | keys
+            body = self._build_seq(
+                stmt.body, succ,
+                loops=loops, finallies=finallies, exc=exc, held=inner_held,
+            )
+            node.succs = {body}
+        elif isinstance(stmt, ast.Try):
+            after = succ
+            inner_finallies = finallies
+            if stmt.finalbody:
+                # Two copies of the finally body: one continuing normally,
+                # one continuing the abrupt exit it is unwinding toward.
+                after = self._build_seq(stmt.finalbody, succ, **kw)
+                abrupt = self._build_seq(
+                    stmt.finalbody, self._abrupt_target(finallies), **kw
+                )
+                inner_finallies = finallies + [abrupt]
+            handler_entries = []
+            for handler in stmt.handlers:
+                handler_entries.append(
+                    self._build_seq(
+                        handler.body, after,
+                        loops=loops, finallies=inner_finallies, exc=exc, held=held,
+                    )
+                )
+            orelse = (
+                self._build_seq(
+                    stmt.orelse, after,
+                    loops=loops, finallies=inner_finallies, exc=exc, held=held,
+                )
+                if stmt.orelse
+                else after
+            )
+            inner_exc = tuple(handler_entries) or (
+                (inner_finallies[-1],) if stmt.finalbody else exc
+            )
+            body = self._build_seq(
+                stmt.body, orelse,
+                loops=loops, finallies=inner_finallies, exc=inner_exc, held=held,
+            )
+            node.succs = {body}
+        elif isinstance(stmt, (ast.Return, ast.Raise)):
+            if isinstance(stmt, ast.Raise) and exc:
+                node.succs = set(exc)
+            else:
+                node.succs = {self._abrupt_target(finallies)}
+        elif isinstance(stmt, ast.Break):
+            node.succs = {loops[-1][0]} if loops else {self._abrupt_target(finallies)}
+        elif isinstance(stmt, ast.Continue):
+            node.succs = {loops[-1][1]} if loops else {self._abrupt_target(finallies)}
+        else:
+            node.succs = {succ}
+        # Any statement inside a try body may raise into the handlers —
+        # the over-approximation that keeps "on all paths" rules honest.
+        if exc and not isinstance(stmt, (ast.Return, ast.Break, ast.Continue)):
+            node.succs |= set(exc)
+        return node.idx
+
+    # --------------------------------------------------- reaching defs
+    def preds(self) -> dict[int, set[int]]:
+        if self._preds is None:
+            preds: dict[int, set[int]] = {n.idx: set() for n in self.nodes}
+            for n in self.nodes:
+                for s in n.succs:
+                    if s != EXIT:
+                        preds[s].add(n.idx)
+            self._preds = preds
+        return self._preds
+
+    def reach_in(self, idx: int) -> dict[str, frozenset[int]]:
+        """name → def-site node ids reaching the ENTRY of statement ``idx``."""
+        if self._reach_in is None:
+            self._compute_reaching()
+        return self._reach_in[idx]
+
+    def _compute_reaching(self) -> None:
+        n = len(self.nodes)
+        reach_in: list[dict[str, frozenset[int]]] = [{} for _ in range(n)]
+        preds = self.preds()
+        out: list[dict[str, frozenset[int]]] = [{} for _ in range(n)]
+
+        def transfer(idx: int, in_map):
+            node = self.nodes[idx]
+            if not node.defines:
+                return in_map
+            new = dict(in_map)
+            for name in node.defines:
+                new[name] = frozenset((idx,))
+            return new
+
+        # Source order first (nodes are created roughly in source order):
+        # forward dataflow over a mostly-reducible CFG then converges in
+        # one or two sweeps instead of thrashing backwards.
+        worklist = list(range(n - 1, -1, -1))
+        while worklist:
+            idx = worklist.pop()
+            merged: dict[str, frozenset[int]] = {}
+            for p in preds[idx]:
+                for name, defs in out[p].items():
+                    if name in merged:
+                        merged[name] = merged[name] | defs
+                    else:
+                        merged[name] = defs
+            if merged != reach_in[idx]:
+                reach_in[idx] = merged
+            new_out = transfer(idx, merged)
+            if new_out != out[idx]:
+                out[idx] = new_out
+                for s in self.nodes[idx].succs:
+                    if s != EXIT:
+                        worklist.append(s)
+        self._reach_in = reach_in
+
+    # ------------------------------------------------- value resolution
+    def idx_of(self, stmt: ast.stmt) -> int | None:
+        return self._stmt_to_idx.get(id(stmt))
+
+    def resolve_name(self, name: str, at_idx: int, _depth: int = 0) -> set[str]:
+        """Possible dotted origins of ``name`` at statement ``at_idx``:
+        import aliases, reaching single assignments (followed through
+        plain-name and ``getattr``/``__import__`` chains), and enclosing-
+        module single-assignment bindings. Empty set = unresolvable."""
+        if _depth > 6:
+            return set()
+        defs = self.reach_in(at_idx).get(name) if 0 <= at_idx < len(self.nodes) else None
+        if defs:
+            origins: set[str] = set()
+            for d in defs:
+                value = _assign_value(self.nodes[d].stmt, name)
+                if value is not None:
+                    origins |= self.expr_origins(value, d, _depth + 1)
+                elif name in self.aliases and isinstance(
+                    self.nodes[d].stmt, (ast.Import, ast.ImportFrom)
+                ):
+                    origins.add(self.aliases[name])
+            return origins
+        if name in self.aliases:
+            return {self.aliases[name]}
+        if name in self.assigned_names:
+            return set()  # assigned on some path we can't see through
+        if name in self.outer_origins:
+            return set(self.outer_origins[name])
+        # An unbound, unaliased bare name resolves to the builtin itself
+        # (`__import__`, `getattr`, `open`); anything else — parameters,
+        # names bound by constructs we don't track — has no origin.
+        return {name} if name in _BUILTIN_NAMES else set()
+
+    def expr_origins(self, expr: ast.expr, at_idx: int, _depth: int = 0) -> set[str]:
+        """Dotted origins an expression's VALUE may be: names/attributes
+        resolve through :meth:`resolve_name`; ``getattr(x, "a")`` with a
+        foldable name resolves like ``x.a``; ``__import__("m")``-shaped
+        calls resolve to the module ``m`` itself."""
+        if _depth > 6:
+            return set()
+        if isinstance(expr, ast.Name):
+            return self.resolve_name(expr.id, at_idx, _depth)
+        if isinstance(expr, ast.Attribute):
+            return {
+                f"{base}.{expr.attr}"
+                for base in self.expr_origins(expr.value, at_idx, _depth + 1)
+            }
+        if isinstance(expr, ast.Call):
+            func_origins = self.expr_origins(expr.func, at_idx, _depth + 1)
+            out: set[str] = set()
+            if func_origins & IMPORT_FUNCTIONS and expr.args:
+                folded = self.fold_str(expr.args[0], at_idx)
+                if folded:
+                    out.add(folded)
+            if "getattr" in func_origins and len(expr.args) >= 2:
+                attr = self.fold_str(expr.args[1], at_idx)
+                if attr and attr.isidentifier():
+                    out |= {
+                        f"{base}.{attr}"
+                        for base in self.expr_origins(
+                            expr.args[0], at_idx, _depth + 1
+                        )
+                    }
+            return out
+        return set()
+
+    def fold_str(self, expr: ast.expr, at_idx: int, _depth: int = 0) -> str | None:
+        """Constant-fold an expression to a string: literals, ``+`` of
+        foldables, f-strings with only literal parts, and names whose
+        every reaching definition folds to the SAME value. ``None`` means
+        "not a compile-time constant" — the dynamic_import rule's case."""
+        if _depth > 6:
+            return None
+        literal = _fold_literal(
+            expr, lambda e: self.fold_str(e, at_idx, _depth + 1)
+        )
+        if literal is not _NOT_LITERAL:
+            return literal
+        if isinstance(expr, ast.Name):
+            defs = (
+                self.reach_in(at_idx).get(expr.id)
+                if 0 <= at_idx < len(self.nodes)
+                else None
+            )
+            if not defs:
+                return self.outer_consts.get(expr.id)
+            folded: set[str] = set()
+            for d in defs:
+                value = _assign_value(self.nodes[d].stmt, expr.id)
+                if value is None:
+                    return None
+                one = self.fold_str(value, d, _depth + 1)
+                if one is None:
+                    return None
+                folded.add(one)
+            return folded.pop() if len(folded) == 1 else None
+        return None
+
+    # ------------------------------------------------------ path queries
+    def reaches(self, a: int, b: int) -> bool:
+        """Is there a CFG path from (after) statement ``a`` to ``b``?"""
+        seen: set[int] = set()
+        stack = [s for s in self.nodes[a].succs if s != EXIT]
+        while stack:
+            idx = stack.pop()
+            if idx == b:
+                return True
+            if idx in seen:
+                continue
+            seen.add(idx)
+            stack.extend(s for s in self.nodes[idx].succs if s != EXIT)
+        return False
+
+    def await_between(self, a: int, b: int) -> bool:
+        """Does some path from ``a`` to ``b`` cross an await point? ``b``'s
+        own await counts (``self.x = await f() + r`` suspends before the
+        store); ``a``'s does not (its await happened before the read's
+        value escaped)."""
+        if a == b:
+            # One statement reading and writing itself (AugAssign) is the
+            # caller's case to judge — no path exists "between".
+            return False
+        seen: set[int] = set()
+        stack = [s for s in self.nodes[a].succs if s != EXIT]
+        while stack:
+            idx = stack.pop()
+            if idx in seen:
+                continue
+            seen.add(idx)
+            if idx == b:
+                if self.nodes[b].has_await:
+                    return True
+                continue  # path hit b without an await; keep exploring others
+            if self.nodes[idx].has_await and (idx == b or self.reaches(idx, b)):
+                return True
+            stack.extend(s for s in self.nodes[idx].succs if s != EXIT)
+        return False
+
+    def reaches_without(self, a: int, b: int, predicate) -> bool:
+        """Is there a CFG path from (after) ``a`` to ``b`` that never
+        crosses a statement satisfying ``predicate``? The "lock still
+        held here" query: an ``acquire()`` at ``a`` reaches ``b`` without
+        passing a ``release()``."""
+        seen: set[int] = set()
+        stack = [s for s in self.nodes[a].succs if s != EXIT]
+        while stack:
+            idx = stack.pop()
+            if idx == b:
+                return True
+            if idx in seen:
+                continue
+            seen.add(idx)
+            if predicate(self.nodes[idx]):
+                continue  # this path is blocked; others may still reach
+            stack.extend(s for s in self.nodes[idx].succs if s != EXIT)
+        return False
+
+    def exit_reachable_without(self, start: int, predicate) -> bool:
+        """Can EXIT be reached from (after) ``start`` without passing a
+        statement for which ``predicate(node)`` is true? The shape of the
+        lock-release rule: acquire → EXIT avoiding every release."""
+        seen: set[int] = set()
+        stack = list(self.nodes[start].succs)
+        while stack:
+            idx = stack.pop()
+            if idx == EXIT:
+                return True
+            if idx in seen:
+                continue
+            seen.add(idx)
+            if predicate(self.nodes[idx]):
+                continue  # this path is satisfied; do not cross it
+            stack.extend(self.nodes[idx].succs)
+        return False
+
+
+class ScopeBindings:
+    """The FLOW-INSENSITIVE face of the dataflow layer: per-scope
+    union-over-all-definitions value resolution, O(statements) to build
+    and memoized to query — the mode the request-path policy consumer
+    uses (the full CFG fixpoint in :class:`FunctionFlow` is for the
+    offline concurrency lint; it is quadratic on adversarial input and
+    the edge gate runs ON the event loop under a <1 ms budget).
+
+    Union semantics are strictly *over*-approximating for origins (a name
+    rebound ``x = print; x = __import__`` resolves to both — the safe
+    direction for deny rules, and order-blind means padding the source
+    with rebindings cannot hide one) and *under*-approximating for
+    constant folding (a name folds only when every definition folds to
+    the SAME string — a conflicting rebinding makes the argument
+    non-constant, which lands in the ``dynamic_import`` rule, again the
+    safe direction)."""
+
+    def __init__(
+        self,
+        scope: ast.AST,
+        aliases: dict[str, str],
+        outer: "ScopeBindings | None" = None,
+    ) -> None:
+        self.scope = scope
+        self.aliases = aliases
+        self.outer = outer
+        #: name -> list of RHS exprs; None entries are opaque definitions
+        #: (loop targets, unpacking, parameters — no resolvable value).
+        self._defs: dict[str, list[ast.expr | None]] = {}
+        self._origin_memo: dict[str, set[str]] = {}
+        self._fold_memo: dict[str, str | None] = {}
+        #: names currently being resolved (cycle guard). Results computed
+        #: while ANY name is in flight may be truncated by the cycle edge
+        #: and must not be memoized — caching them would make `x = y; y =
+        #: x; x = __import__` permanently unresolvable depending on query
+        #: order, silently reopening the evasion this layer closes.
+        self._active: set[str] = set()
+        args = getattr(scope, "args", None)
+        if args is not None:
+            for a in (
+                list(args.posonlyargs)
+                + list(args.args)
+                + list(args.kwonlyargs)
+                + ([args.vararg] if args.vararg else [])
+                + ([args.kwarg] if args.kwarg else [])
+            ):
+                self._defs.setdefault(a.arg, []).append(None)
+        for stmt in self._own_stmts(scope):
+            names = _assigned_names(stmt)
+            for name in names:
+                self._defs.setdefault(name, []).append(
+                    _assign_value(stmt, name)
+                )
+
+    @staticmethod
+    def _own_stmts(scope: ast.AST):
+        """Statements belonging to this scope: the body, recursively, but
+        never descending into nested function scopes. Class bodies are
+        skipped for *bindings* (``class A: x = 1`` binds ``A.x``, not
+        ``x``)."""
+        stack = list(getattr(scope, "body", []))
+        while stack:
+            stmt = stack.pop()
+            yield stmt
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+                continue
+            for field_name in ("body", "orelse", "finalbody"):
+                stack.extend(getattr(stmt, field_name, []))
+            for handler in getattr(stmt, "handlers", []):
+                stack.extend(handler.body)
+            for case in getattr(stmt, "cases", []):  # match statements
+                stack.extend(case.body)
+
+    def origins(self, name: str) -> set[str]:
+        memo = self._origin_memo.get(name)
+        if memo is not None:
+            return memo
+        if name in self._active:
+            return set()  # resolution cycle edge (x = y; y = x)
+        self._active.add(name)
+        try:
+            out: set[str] = set()
+            if name in self._defs:
+                for value in self._defs[name]:
+                    if value is not None:
+                        out |= self.expr_origins(value)
+                if name in self.aliases:
+                    out.add(self.aliases[name])
+            elif name in self.aliases:
+                out = {self.aliases[name]}
+            elif self.outer is not None:
+                out = self.outer.origins(name)
+            elif name in _BUILTIN_NAMES:
+                out = {name}
+        finally:
+            self._active.discard(name)
+        if not self._active:
+            # Top-level resolution only: a result computed under an
+            # in-flight outer name may be cut short by the cycle guard.
+            self._origin_memo[name] = out
+        return out
+
+    def expr_origins(self, expr: ast.expr) -> set[str]:
+        if isinstance(expr, ast.Name):
+            return self.origins(expr.id)
+        if isinstance(expr, ast.Attribute):
+            return {
+                f"{base}.{expr.attr}"
+                for base in self.expr_origins(expr.value)
+            }
+        if isinstance(expr, ast.Call):
+            func_origins = self.expr_origins(expr.func)
+            out: set[str] = set()
+            if func_origins & IMPORT_FUNCTIONS and expr.args:
+                folded = self.fold_str(expr.args[0])
+                if folded:
+                    out.add(folded)
+            if "getattr" in func_origins and len(expr.args) >= 2:
+                attr = self.fold_str(expr.args[1])
+                if attr and attr.isidentifier():
+                    out |= {
+                        f"{base}.{attr}"
+                        for base in self.expr_origins(expr.args[0])
+                    }
+            return out
+        return set()
+
+    def fold_str(self, expr: ast.expr) -> str | None:
+        literal = _fold_literal(expr, self.fold_str)
+        if literal is not _NOT_LITERAL:
+            return literal
+        if isinstance(expr, ast.Name):
+            return self._fold_name(expr.id)
+        return None
+
+    def _fold_name(self, name: str) -> str | None:
+        memo = self._fold_memo.get(name, False)
+        if memo is not False:
+            return memo
+        fold_key = "fold:" + name  # distinct cycle domain from origins()
+        if fold_key in self._active:
+            return None  # folding cycle: not a constant
+        self._active.add(fold_key)
+        try:
+            result: str | None = None
+            if name in self._defs:
+                folded: set[str] = set()
+                ok = True
+                for value in self._defs[name]:
+                    one = self.fold_str(value) if value is not None else None
+                    if one is None:
+                        ok = False
+                        break
+                    folded.add(one)
+                if ok and len(folded) == 1:
+                    result = folded.pop()
+            elif self.outer is not None:
+                result = self.outer._fold_name(name)
+        finally:
+            self._active.discard(fold_key)
+        if not any(k.startswith("fold:") for k in self._active):
+            self._fold_memo[name] = result
+        return result
+
+    def own_calls(self):
+        """Every ``ast.Call`` in this scope's own statements (class bodies
+        included — they execute at module import; nested function bodies
+        excluded — they are their own scope)."""
+        for stmt in self._own_stmts(self.scope):
+            for expr in iter_own_exprs(stmt):
+                if isinstance(expr, ast.Call):
+                    yield expr
+
+
+def iter_scope_bindings(tree: ast.Module, aliases: dict[str, str]):
+    """Yield :class:`ScopeBindings` for the module and every nested
+    function, function scopes chained to the module scope (names not
+    assigned locally resolve through the module's bindings)."""
+    mod = ScopeBindings(tree, aliases)
+    yield mod
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield ScopeBindings(node, aliases, outer=mod)
+
+
+#: Identifier tokens whose absence PROVES a source cannot contain a
+#: dynamic-import evasion this layer resolves — the cheap pre-scan that
+#: keeps the dataflow pass off the hot path for ordinary submissions.
+DYNAMIC_TRIGGER_NAMES = frozenset(
+    {"__import__", "getattr", "import_module", "importlib", "builtins"}
+)
+
+
+def has_dynamic_triggers(tree: ast.AST) -> bool:
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Name) and node.id in DYNAMIC_TRIGGER_NAMES:
+            return True
+        if isinstance(node, ast.Import) and any(
+            alias.name.split(".", 1)[0] in ("importlib", "builtins")
+            for alias in node.names
+        ):
+            return True
+        if isinstance(node, ast.ImportFrom) and (node.module or "").split(
+            ".", 1
+        )[0] in ("importlib", "builtins"):
+            return True
+    return False
+
+
+def module_bindings(tree: ast.Module) -> dict[str, str]:
+    """Names bound at module top level by ``import X [as y]`` → dotted
+    module path. The receivers ``getattr(<module>, ...)`` policy rules
+    recognize as modules."""
+    out: dict[str, str] = {}
+    for stmt in tree.body:
+        if isinstance(stmt, ast.Import):
+            for alias in stmt.names:
+                if alias.asname:
+                    out[alias.asname] = alias.name
+                else:
+                    root = alias.name.split(".", 1)[0]
+                    out[root] = root
+    return out
+
+
+def module_flow(tree: ast.Module, aliases: dict[str, str]) -> FunctionFlow:
+    return FunctionFlow(tree, aliases=aliases)
+
+
+def outer_bindings_for_nested(
+    mod_flow: FunctionFlow,
+) -> tuple[dict[str, set[str]], dict[str, str]]:
+    """The module-level bindings a nested function may rely on: names
+    assigned exactly ONCE at module level, resolved to origins / folded
+    constants at their (single) def site. Single-assignment only — a
+    rebound module global has no one value to carry inward."""
+    def_sites: dict[str, list[int]] = {}
+    for node in mod_flow.nodes:
+        for name in node.defines:
+            def_sites.setdefault(name, []).append(node.idx)
+    origins: dict[str, set[str]] = {}
+    consts: dict[str, str] = {}
+    for name, sites in def_sites.items():
+        if len(sites) != 1:
+            continue
+        stmt = mod_flow.nodes[sites[0]].stmt
+        value = _assign_value(stmt, name)
+        if value is None:
+            if name in mod_flow.aliases and isinstance(
+                stmt, (ast.Import, ast.ImportFrom)
+            ):
+                origins[name] = {mod_flow.aliases[name]}
+            continue
+        o = mod_flow.expr_origins(value, sites[0])
+        if o:
+            origins[name] = o
+        c = mod_flow.fold_str(value, sites[0])
+        if c is not None:
+            consts[name] = c
+    return origins, consts
+
+
+def iter_scopes(tree: ast.Module, aliases: dict[str, str]):
+    """Yield ``(scope_node, FunctionFlow)`` for the module body and every
+    (arbitrarily nested) function, each nested flow seeded with the
+    module's single-assignment bindings."""
+    mod = module_flow(tree, aliases)
+    yield tree, mod
+    outer_origins, outer_consts = outer_bindings_for_nested(mod)
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node, FunctionFlow(
+                node,
+                aliases=aliases,
+                outer_origins=outer_origins,
+                outer_consts=outer_consts,
+            )
+
+
+def scope_calls(flow: FunctionFlow):
+    """Every ``ast.Call`` in the scope's own statements, paired with the
+    enclosing flattened statement idx (for reach-in lookups). Calls inside
+    nested functions belong to the nested scope and are excluded."""
+    for node in flow.nodes:
+        for expr in iter_own_exprs(node.stmt):
+            if isinstance(expr, ast.Call):
+                yield expr, node.idx

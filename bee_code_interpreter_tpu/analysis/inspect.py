@@ -28,6 +28,7 @@ import ast
 import traceback
 from dataclasses import dataclass, field
 
+from bee_code_interpreter_tpu.analysis import dataflow
 from bee_code_interpreter_tpu.runtime import dep_guess
 
 # The sandbox writes the submission to <tempdir>/script.py and execs it
@@ -60,6 +61,15 @@ class SourceInspection:
     calls: list[CallSite] = field(default_factory=list)
     path_literals: set[str] = field(default_factory=set)
     predicted_deps: list[str] = field(default_factory=list)
+    # Dataflow layer (docs/analysis.md "Dataflow layer"): dynamic imports
+    # whose target constant-folds (`x = __import__; x("socket")` →
+    # {"socket": [line]}) are matched by the import policy lists exactly
+    # like static imports; sites whose target does NOT fold are the
+    # `dynamic_import` rule's input. ``max_loop_depth`` feeds cost
+    # classification.
+    dynamic_imports: dict[str, list[int]] = field(default_factory=dict)
+    dynamic_import_sites: list[tuple[int, str]] = field(default_factory=list)
+    max_loop_depth: int = 0
 
     def call_names(self) -> set[str]:
         return {c.name for c in self.calls}
@@ -121,22 +131,34 @@ _COMPREHENSION_NODES = (ast.ListComp, ast.SetComp, ast.DictComp, ast.GeneratorEx
 _FUNCTION_NODES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
 
 
-def _walk_calls(tree: ast.AST, aliases: dict[str, str]) -> list[CallSite]:
-    """Call sites with loop context: a call lexically inside a For/While/
-    comprehension body is ``in_loop``. Entering a nested function resets the
-    loop context (the def executes in the loop; its body only runs when
-    called) — a deliberate under-approximation that keeps ``deny`` rules
-    free of false positives.
+def _walk_calls(
+    tree: ast.AST, aliases: dict[str, str]
+) -> tuple[list[CallSite], int, dict[int, bool]]:
+    """Call sites with loop context (plus the tree's maximum loop-nesting
+    depth — a cost-classification input — and a per-Call-node loop-context
+    map for the dataflow resolver): a call lexically inside a For/
+    While/comprehension body is ``in_loop``. Entering a nested function
+    resets the loop context (the def executes in the loop; its body only
+    runs when called) — a deliberate under-approximation that keeps
+    ``deny`` rules free of false positives.
 
     Iterative on an explicit stack: ``ast.parse`` accepts expressions far
     deeper than the interpreter's recursion limit (a 2 KB ``----…x`` chain
     is a valid program), and the edge gate must never blow the stack on
     source the sandbox would happily run."""
     calls: list[CallSite] = []
+    max_depth = 0
+    # Every Call node's loop context, keyed by node identity — the
+    # dataflow resolver reuses it so a RESOLVED call site (`m = x("os");
+    # m.fork()` in a loop) keeps its in_loop flag and still matches the
+    # loop-sensitive shapes (fork_in_loop).
+    loop_context: dict[int, bool] = {}
     stack: list[tuple[ast.AST, int]] = [(tree, 0)]
     while stack:
         node, loop_depth = stack.pop()
+        max_depth = max(max_depth, loop_depth)
         if isinstance(node, ast.Call):
+            loop_context[id(node)] = loop_depth > 0
             name = resolve_call_name(node.func, aliases)
             if name is not None:
                 calls.append(
@@ -179,7 +201,93 @@ def _walk_calls(tree: ast.AST, aliases: dict[str, str]) -> list[CallSite]:
         stack.extend(
             (child, next_depth) for child in ast.iter_child_nodes(node)
         )
-    return calls
+    return calls, max_depth, loop_context
+
+
+@dataclass
+class _DynamicResolution:
+    """What the dataflow pass adds on top of the syntactic walk."""
+
+    imports: dict[str, list[int]] = field(default_factory=dict)
+    sites: list[tuple[int, str]] = field(default_factory=list)
+    extra_calls: list[CallSite] = field(default_factory=list)
+
+
+def _resolve_dynamic(
+    tree: ast.Module,
+    aliases: dict[str, str],
+    loop_context: dict[int, bool] | None = None,
+) -> _DynamicResolution:
+    """Close the easy policy evasions with the dataflow layer's
+    flow-insensitive bindings (docs/analysis.md "Dataflow layer"):
+    ``__import__``/``importlib.import_module`` reached through assignments,
+    ``getattr(<module>, <const str>)`` chains, and calls through variables
+    bound to either. Constant-foldable targets become ordinary policy
+    inputs; non-constant ones become ``dynamic_import`` sites
+    (warn/deny-able, docs/analysis.md).
+
+    Cost discipline: this runs ON the event loop inside the <1 ms gate
+    budget, so (a) sources without any trigger identifier skip the pass
+    entirely — no binding can reach ``__import__``/``getattr`` without
+    spelling one of the trigger tokens somewhere — and (b) resolution is
+    the O(statements) union-over-defs mode, not the CFG fixpoint (see
+    ``dataflow.ScopeBindings``)."""
+    out = _DynamicResolution()
+    if not dataflow.has_dynamic_triggers(tree):
+        return out
+    modules = dataflow.module_bindings(tree)
+    module_names = set(modules.values()) | {"builtins"}
+    seen_sites: set[int] = set()
+    for scope in dataflow.iter_scope_bindings(tree, aliases):
+        for call in scope.own_calls():
+            line = getattr(call, "lineno", 0)
+            syntactic = resolve_call_name(call.func, aliases)
+            func_origins = scope.expr_origins(call.func)
+            if not func_origins:
+                continue
+            if func_origins & dataflow.IMPORT_FUNCTIONS:
+                folded = scope.fold_str(call.args[0]) if call.args else None
+                if folded is not None:
+                    out.imports.setdefault(folded, []).append(line)
+                elif line not in seen_sites:
+                    seen_sites.add(line)
+                    spelled = sorted(func_origins & dataflow.IMPORT_FUNCTIONS)[0]
+                    out.sites.append(
+                        (line, f"{spelled} with a non-constant module name")
+                    )
+            if "getattr" in func_origins and len(call.args) >= 2:
+                receiver_origins = scope.expr_origins(call.args[0])
+                on_module = receiver_origins & module_names
+                if on_module and scope.fold_str(call.args[1]) is None:
+                    if line not in seen_sites:
+                        seen_sites.add(line)
+                        out.sites.append(
+                            (
+                                line,
+                                f"getattr on module {sorted(on_module)[0]} "
+                                "with a non-constant attribute name",
+                            )
+                        )
+            # A call whose target RESOLVES to a dotted name the syntactic
+            # walk could not see (`g = getattr(os, "system"); g(...)`,
+            # `m = __import__("subprocess"); m.run(...)`) joins the
+            # ordinary call-policy inputs.
+            for origin in func_origins:
+                if (
+                    origin != syntactic
+                    and "." in origin
+                    and origin not in dataflow.IMPORT_FUNCTIONS
+                ):
+                    out.extra_calls.append(
+                        CallSite(
+                            name=origin,
+                            line=line,
+                            in_loop=(loop_context or {}).get(
+                                id(call), False
+                            ),
+                        )
+                    )
+    return out
 
 
 def _path_literals(tree: ast.AST) -> set[str]:
@@ -232,9 +340,22 @@ def inspect_source(source_code: str) -> SourceInspection:
         # — never a 500; the policy layer decides refuse-vs-proceed.
         return SourceInspection(analysis_error=repr(e))
     imports = dep_guess.guessed_imports_from_tree(tree)
+    aliases = collect_aliases(tree)
+    calls, max_loop_depth, loop_context = _walk_calls(tree, aliases)
+    try:
+        dynamic = _resolve_dynamic(tree, aliases, loop_context)
+    except (RecursionError, MemoryError) as e:
+        # The dataflow pass recurses on statement nesting; a degenerate
+        # program can exhaust it where the flat walks above survived. Same
+        # contract as a parse-limit blowup: the edge makes NO claim
+        # (fail-closed under a declared policy), never a 500.
+        return SourceInspection(analysis_error=repr(e))
     return SourceInspection(
         imports=imports,
-        calls=_walk_calls(tree, collect_aliases(tree)),
+        calls=calls + dynamic.extra_calls,
         path_literals=_path_literals(tree),
         predicted_deps=dep_guess.dependencies_for_imports(imports),
+        dynamic_imports=dynamic.imports,
+        dynamic_import_sites=sorted(dynamic.sites),
+        max_loop_depth=max_loop_depth,
     )

@@ -47,6 +47,46 @@ _RAW_SOCKET_CALLS = frozenset(
 )
 _OS_EXEC_PREFIXES = ("os.exec", "os.spawn", "os.posix_spawn")
 
+# --- cost classification (docs/analysis.md "Cost classes") ----------------
+#: The closed label set of ``bci_analysis_cost_class_total{class}`` and the
+#: ``cost_class`` hint on spans / wide events / ``ExecuteResponse.analysis``.
+COST_CLASSES = ("cheap", "loopy", "io_heavy", "install_heavy")
+#: Cost classes the cost-aware admission gate (APP_ADMISSION_COST_AWARE)
+#: treats as heavy-lane work.
+HEAVY_COST_CLASSES = frozenset({"io_heavy", "install_heavy"})
+
+#: Blocking-I/O call sites (alias-resolved names/prefixes): their presence
+#: upgrades a workload to ``io_heavy`` — wall-clock the sandbox will spend
+#: off-CPU, which the router/admission should not weigh like a hot loop.
+_IO_CALLS = frozenset(
+    {
+        "open",
+        "os.system",
+        "os.popen",
+        "time.sleep",
+        "urllib.request.urlopen",
+        "socket.create_connection",
+    }
+)
+_IO_PREFIXES = ("requests.", "subprocess.", "http.client.", "urllib3.")
+
+
+def classify_cost(inspection: SourceInspection) -> str:
+    """One of :data:`COST_CLASSES` for an analyzable submission, by
+    dominant predicted expense: a pip install dwarfs everything
+    (``install_heavy``), blocking I/O dwarfs compute (``io_heavy``),
+    nested loops mark compute-bound work (``loopy``), the rest is
+    ``cheap``. Single-pass over facts the inspection already collected —
+    the hint must fit inside the gate's <1 ms budget."""
+    if inspection.predicted_deps:
+        return "install_heavy"
+    for c in inspection.calls:
+        if c.name in _IO_CALLS or c.name.startswith(_IO_PREFIXES):
+            return "io_heavy"
+    if inspection.max_loop_depth >= 2:
+        return "loopy"
+    return "cheap"
+
 
 def _shape_fork_in_loop(inspection: SourceInspection) -> list[int]:
     return [c.line for c in inspection.calls if c.name in _FORK_CALLS and c.in_loop]
@@ -134,6 +174,7 @@ class PolicyEngine:
         warn_calls: tuple[str, ...] = (),
         deny_paths: tuple[str, ...] = (),
         warn_paths: tuple[str, ...] = (),
+        dynamic_import: str = "warn",
     ) -> None:
         self.deny_imports = tuple(deny_imports)
         self.warn_imports = tuple(warn_imports)
@@ -141,6 +182,14 @@ class PolicyEngine:
         self.warn_calls = tuple(warn_calls)
         self.deny_paths = tuple(deny_paths)
         self.warn_paths = tuple(warn_paths)
+        # What an import whose target the dataflow layer could NOT
+        # constant-fold means: "warn" (default — fail-open, annotated +
+        # counted), "deny", or "off". Resolved dynamic imports are not
+        # this rule's business: they hit deny_imports/warn_imports like
+        # static imports (docs/analysis.md "Dataflow layer").
+        self.dynamic_import = (
+            dynamic_import if dynamic_import in ("off", "warn", "deny") else "warn"
+        )
 
     @classmethod
     def from_config(cls, config) -> "PolicyEngine":
@@ -151,14 +200,21 @@ class PolicyEngine:
             warn_calls=split_patterns(config.policy_warn_calls),
             deny_paths=split_patterns(config.policy_deny_paths),
             warn_paths=split_patterns(config.policy_warn_paths),
+            dynamic_import=config.policy_dynamic_import,
         )
 
     @property
     def declared(self) -> bool:
+        # dynamic_import="deny" counts as a declared policy: an
+        # unanalyzable source could hide exactly the imports it denies, so
+        # it must fail closed like any other deny rule. The "warn" DEFAULT
+        # does not — it would flip every policy-less deployment's
+        # unanalyzable handling from admit to refuse.
         return any(
             (
                 self.deny_imports, self.warn_imports, self.deny_calls,
                 self.warn_calls, self.deny_paths, self.warn_paths,
+                self.dynamic_import == "deny",
             )
         )
 
@@ -192,12 +248,26 @@ class PolicyEngine:
                 hits = sorted(
                     i for i in inspection.imports if _import_matches(pattern, i)
                 )
-                if hits:
+                # Dynamic imports whose target constant-folded resolve
+                # against the SAME lists as static imports — `x =
+                # __import__; x("socket")` must not outrun
+                # deny_imports=socket (docs/analysis.md "Dataflow layer").
+                dyn_hits = sorted(
+                    m
+                    for m in inspection.dynamic_imports
+                    if _import_matches(pattern, m) and m not in hits
+                )
+                if hits or dyn_hits:
+                    spelled = hits + [
+                        f"{m} (dynamic, line(s) "
+                        f"{', '.join(str(n) for n in sorted(inspection.dynamic_imports[m]))})"
+                        for m in dyn_hits
+                    ]
                     findings.append(
                         Finding(
                             rule=f"import:{pattern}",
                             severity=severity,
-                            message=f"import of {', '.join(hits)} is not allowed",
+                            message=f"import of {', '.join(spelled)} is not allowed",
                         )
                     )
             for pattern in calls:
@@ -248,6 +318,22 @@ class PolicyEngine:
                             ),
                         )
                     )
+        if self.dynamic_import != "off" and inspection.dynamic_import_sites:
+            detail = "; ".join(
+                f"line {line}: {reason}"
+                for line, reason in inspection.dynamic_import_sites
+            )
+            findings.append(
+                Finding(
+                    rule="dynamic_import",
+                    severity=self.dynamic_import,
+                    message=(
+                        f"import target cannot be resolved statically "
+                        f"({detail}); the policy cannot vouch for what it "
+                        "loads"
+                    ),
+                )
+            )
         return findings
 
 
@@ -261,22 +347,28 @@ class AnalysisVerdict:
     ``predicted_deps`` distinguishes "no claim" (``None`` — the source
     was unanalyzable, the sandbox must run its own scan) from the
     positive claim "scanned, install exactly this" (a list, possibly
-    empty)."""
+    empty). ``cost_class`` is the scheduling hint (one of
+    :data:`COST_CLASSES`; ``None`` when the source never analyzed)."""
 
     syntax_error: str | None
     denials: list[Finding]
     warnings: list[Finding]
     predicted_deps: list[str] | None
+    cost_class: str | None = None
 
     def annotation(self) -> dict | None:
-        """The response-side ``analysis`` block: present only when there is
-        something to say (warnings or a non-empty dep prediction) so the
-        common path stays byte-identical to the pre-analysis contract."""
+        """The response-side ``analysis`` block: warnings, the dep
+        prediction, and the ``cost_class`` hint. Present on every
+        successfully analyzed execution since the cost hint landed
+        (docs/analysis.md "Cost classes"); absent only when the analyzer
+        had nothing at all to say (unanalyzable / gate disabled)."""
         out: dict = {}
         if self.warnings:
             out["warnings"] = [f.to_dict() for f in self.warnings]
         if self.predicted_deps:
             out["predicted_deps"] = list(self.predicted_deps)
+        if self.cost_class is not None:
+            out["cost_class"] = self.cost_class
         return out or None
 
     def denial_detail(self) -> str:
@@ -309,6 +401,12 @@ class WorkloadAnalyzer:
         self._rejections_total = None
         self._warnings_total = None
         self._dep_predictions_total = None
+        self._dynamic_imports_total = None
+        self._cost_class_total = None
+        # Running per-class tallies, exported on GET /v1/fleet for the
+        # fleet router's placement view (docs/fleet.md): what MIX of work
+        # this replica has been analyzing, cheap scrape-free reads.
+        self.cost_class_counts: dict[str, int] = {c: 0 for c in COST_CLASSES}
         if metrics is not None:
             self._seconds = metrics.histogram(
                 "bci_analysis_seconds",
@@ -326,6 +424,15 @@ class WorkloadAnalyzer:
             self._dep_predictions_total = metrics.counter(
                 "bci_analysis_dep_predictions_total",
                 "PyPI dependencies predicted at the edge and shipped to the sandbox",
+            )
+            self._dynamic_imports_total = metrics.counter(
+                "bci_analysis_dynamic_imports_total",
+                "Dynamic-import sites seen by the dataflow layer, by action "
+                "(resolved / warn / deny)",
+            )
+            self._cost_class_total = metrics.counter(
+                "bci_analysis_cost_class_total",
+                "Analyzed submissions by predicted workload cost class",
             )
 
     @classmethod
@@ -397,6 +504,7 @@ class WorkloadAnalyzer:
                     denials=[f for f in findings if f.severity == "deny"],
                     warnings=[f for f in findings if f.severity == "warn"],
                     predicted_deps=inspection.predicted_deps,
+                    cost_class=classify_cost(inspection),
                 )
             if s is not None:
                 if verdict.syntax_error is not None:
@@ -418,6 +526,11 @@ class WorkloadAnalyzer:
                     s.attributes["analysis.predicted_deps"] = ",".join(
                         verdict.predicted_deps
                     )
+                if verdict.cost_class is not None:
+                    # analysis.* span attributes are lifted into the wide
+                    # event's `analysis` block by the flight recorder, so
+                    # the hint lands there for free.
+                    s.attributes["analysis.cost_class"] = verdict.cost_class
         if self._seconds is not None:
             self._seconds.observe(time.monotonic() - t0)
         if self._rejections_total is not None:
@@ -430,4 +543,21 @@ class WorkloadAnalyzer:
                 self._warnings_total.inc(rule=f.rule)
         if self._dep_predictions_total is not None and verdict.predicted_deps:
             self._dep_predictions_total.inc(len(verdict.predicted_deps))
+        if verdict.cost_class is not None:
+            self.cost_class_counts[verdict.cost_class] += 1
+            if self._cost_class_total is not None:
+                # "class" is a Python keyword, hence the dict spelling
+                self._cost_class_total.inc(**{"class": verdict.cost_class})
+        if self._dynamic_imports_total is not None:
+            resolved_sites = sum(
+                len(lines) for lines in inspection.dynamic_imports.values()
+            )
+            if resolved_sites:
+                self._dynamic_imports_total.inc(resolved_sites, action="resolved")
+            if inspection.dynamic_import_sites:
+                action = self._policy.dynamic_import
+                if action != "off":
+                    self._dynamic_imports_total.inc(
+                        len(inspection.dynamic_import_sites), action=action
+                    )
         return verdict

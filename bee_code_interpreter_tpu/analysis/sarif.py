@@ -1,0 +1,69 @@
+"""Minimal SARIF 2.1.0 rendering for the repo self-lints
+(docs/analysis.md "Self-lint").
+
+One run per tool (asynclint, concurrencylint), the smallest shape CI code
+scanners accept: driver name + declared rules, one ``result`` per
+violation with a physical location. Suppressed findings are emitted with
+``suppressions`` entries (kind="inSource" is wrong for our list-based
+model, so they carry kind="external" with the justification), which is how
+the SARIF viewers show "known, explained" without hiding it.
+"""
+
+from __future__ import annotations
+
+SARIF_VERSION = "2.1.0"
+SARIF_SCHEMA = "https://json.schemastore.org/sarif-2.1.0.json"
+
+
+def _result(violation, suppression=None) -> dict:
+    out: dict = {
+        "ruleId": violation.rule,
+        "level": "error",
+        "message": {"text": violation.message},
+        "locations": [
+            {
+                "physicalLocation": {
+                    "artifactLocation": {"uri": violation.path},
+                    "region": {"startLine": max(1, violation.line)},
+                }
+            }
+        ],
+    }
+    if suppression is not None:
+        out["suppressions"] = [
+            {"kind": "external", "justification": suppression.reason}
+        ]
+    return out
+
+
+def tool_run(
+    tool_name: str,
+    violations,
+    suppressed=(),
+    information_uri: str = "docs/analysis.md",
+) -> dict:
+    """One SARIF ``run`` for one lint tool. ``violations`` are unexplained
+    findings; ``suppressed`` is the (violation, suppression) pairs that
+    carried a justification."""
+    rules = sorted(
+        {v.rule for v in violations} | {v.rule for v, _ in suppressed}
+    )
+    return {
+        "tool": {
+            "driver": {
+                "name": tool_name,
+                "informationUri": information_uri,
+                "rules": [{"id": r} for r in rules],
+            }
+        },
+        "results": [_result(v) for v in violations]
+        + [_result(v, s) for v, s in suppressed],
+    }
+
+
+def sarif_log(runs: list[dict]) -> dict:
+    return {
+        "$schema": SARIF_SCHEMA,
+        "version": SARIF_VERSION,
+        "runs": runs,
+    }

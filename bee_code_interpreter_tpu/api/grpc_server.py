@@ -363,14 +363,16 @@ class CodeInterpreterServicer:
             # prediction stashed earlier in this task's context describe
             # THIS source.
             stash_predicted_deps(None)
+            verdict = None
             if self._analyzer is not None:
                 # The gate mirrors the HTTP edge exactly (docs/analysis.md):
                 # syntax errors answer as a normal exit_code=1 response with
                 # zero sandbox checkouts; policy denies abort
                 # INVALID_ARGUMENT (a client fault, SLI-good via the abort
-                # handling in _with_resilience); warn findings ride the
-                # trailing metadata (the proto response has no field for
-                # them) and the dep prediction ships with the data plane.
+                # handling in _with_resilience); warn findings and the
+                # cost_class hint ride the trailing metadata (the proto
+                # response has no field for them) and the dep prediction
+                # ships with the data plane.
                 verdict = self._analyzer.analyze(validated.source_code)
                 if verdict.syntax_error is not None:
                     return pb.ExecuteResponse(
@@ -383,25 +385,36 @@ class CodeInterpreterServicer:
                         grpc.StatusCode.INVALID_ARGUMENT,
                         f"denied by execution policy: {verdict.denial_detail()}",
                     )
+                trailers = []
                 if verdict.warnings:
-                    context.set_trailing_metadata(
+                    trailers.append(
                         (
-                            (
-                                "bci-analysis-warnings",
-                                "; ".join(
-                                    f.rule for f in verdict.warnings
-                                ),
-                            ),
+                            "bci-analysis-warnings",
+                            "; ".join(f.rule for f in verdict.warnings),
                         )
                     )
+                if verdict.cost_class is not None:
+                    trailers.append(
+                        ("bci-analysis-cost-class", verdict.cost_class)
+                    )
+                if trailers:
+                    context.set_trailing_metadata(tuple(trailers))
                 stash_predicted_deps(verdict.predicted_deps)
-            result = await self._code_executor.execute(
-                source_code=validated.source_code,
-                files=validated.files,
-                env=validated.env,  # env forwarded, unlike reference (:67-70)
-                timeout_s=validated.timeout,
-                deadline=deadline,
-            )
+            # Cost-aware admission (opt-in; mirror of the HTTP edge): a
+            # heavy-lane shed aborts RESOURCE_EXHAUSTED via the shared
+            # AdmissionRejected handling in _resilience_scope.
+            async with (
+                self._admission.heavy_lane(verdict.cost_class)
+                if self._admission is not None and verdict is not None
+                else nullcontext()
+            ):
+                result = await self._code_executor.execute(
+                    source_code=validated.source_code,
+                    files=validated.files,
+                    env=validated.env,  # env forwarded, unlike reference (:67-70)
+                    timeout_s=validated.timeout,
+                    deadline=deadline,
+                )
             record_usage_at_edge(
                 result.usage,
                 current_trace(),

@@ -503,81 +503,92 @@ def create_http_server(
                 # The edge already scanned: ship the prediction with the
                 # data-plane call so the pod skips its own scan.
                 stash_predicted_deps(verdict.predicted_deps)
-            if streaming:
-                backend = _stream_backend()
-                if backend is None:
-                    return web.json_response(
-                        {"detail": "this backend cannot stream output"},
-                        status=501,
-                    )
+            # Cost-aware admission (opt-in, docs/analysis.md "Cost
+            # classes"): heavy-classified work passes the bounded heavy
+            # lane; a shed here surfaces as the ordinary 429 contract.
+            heavy_lane = (
+                admission.heavy_lane(verdict.cost_class)
+                if admission is not None and verdict is not None
+                else nullcontext()
+            )
+            async with heavy_lane:
+                if streaming:
+                    backend = _stream_backend()
+                    if backend is None:
+                        return web.json_response(
+                            {"detail": "this backend cannot stream output"},
+                            status=501,
+                        )
 
-                def envelope(result) -> dict:
-                    trace = current_trace()
-                    record_usage_at_edge(
-                        result.usage,
-                        trace,
-                        execution_cpu_seconds,
-                        execution_peak_rss,
+                    def envelope(result) -> dict:
+                        trace = current_trace()
+                        record_usage_at_edge(
+                            result.usage,
+                            trace,
+                            execution_cpu_seconds,
+                            execution_peak_rss,
+                        )
+                        return models.ExecuteResponse(
+                            **result.model_dump(),
+                            trace_id=trace.trace_id if trace is not None else None,
+                            timings_ms=(
+                                trace.stage_ms() if trace is not None else None
+                            ),
+                            analysis=(
+                                verdict.annotation() if verdict is not None else None
+                            ),
+                        ).model_dump()
+
+                    return await _run_sse(
+                        request,
+                        verdict,
+                        lambda on_event: backend.execute_stream(
+                            req.source_code,
+                            files=req.files,
+                            env=req.env,
+                            timeout_s=req.timeout,
+                            on_event=on_event,
+                            deadline=deadline,
+                        ),
+                        envelope,
                     )
-                    return models.ExecuteResponse(
+                logger.info("Executing code: %s", req.source_code)
+                try:
+                    result = await code_executor.execute(
+                        source_code=req.source_code,
+                        files=req.files,
+                        env=req.env,
+                        timeout_s=req.timeout,
+                        deadline=deadline,
+                    )
+                except (DeadlineExceeded, BreakerOpenError):
+                    raise  # handled by the shared resilience contract (504/503)
+                except Exception:
+                    logger.exception("Execution failed")
+                    return web.json_response(
+                        {"detail": "Execution failed"}, status=500
+                    )
+                logger.info("Execution result: exit_code=%s", result.exit_code)
+                # Per-stage timing breakdown off the request's own trace: the
+                # stage spans have all finished by now (the root closes with
+                # the middleware), so agents/benchmarks can self-report where
+                # the time went without a second round-trip to /v1/traces.
+                trace = current_trace()
+                # Execution-cost accounting lands at the edge: histograms +
+                # usage.* attributes on the root span, mirroring the response.
+                record_usage_at_edge(
+                    result.usage, trace, execution_cpu_seconds, execution_peak_rss
+                )
+                return web.json_response(
+                    models.ExecuteResponse(
                         **result.model_dump(),
                         trace_id=trace.trace_id if trace is not None else None,
-                        timings_ms=(
-                            trace.stage_ms() if trace is not None else None
-                        ),
+                        timings_ms=trace.stage_ms() if trace is not None else None,
                         analysis=(
                             verdict.annotation() if verdict is not None else None
                         ),
                     ).model_dump()
-
-                return await _run_sse(
-                    request,
-                    verdict,
-                    lambda on_event: backend.execute_stream(
-                        req.source_code,
-                        files=req.files,
-                        env=req.env,
-                        timeout_s=req.timeout,
-                        on_event=on_event,
-                        deadline=deadline,
-                    ),
-                    envelope,
                 )
-            logger.info("Executing code: %s", req.source_code)
-            try:
-                result = await code_executor.execute(
-                    source_code=req.source_code,
-                    files=req.files,
-                    env=req.env,
-                    timeout_s=req.timeout,
-                    deadline=deadline,
-                )
-            except (DeadlineExceeded, BreakerOpenError):
-                raise  # handled by the shared resilience contract (504/503)
-            except Exception:
-                logger.exception("Execution failed")
-                return web.json_response({"detail": "Execution failed"}, status=500)
-            logger.info("Execution result: exit_code=%s", result.exit_code)
-            # Per-stage timing breakdown off the request's own trace: the
-            # stage spans have all finished by now (the root closes with the
-            # middleware), so agents/benchmarks can self-report where the
-            # time went without a second round-trip to /v1/traces.
-            trace = current_trace()
-            # Execution-cost accounting lands at the edge: histograms +
-            # usage.* attributes on the root span, mirroring the response.
-            record_usage_at_edge(
-                result.usage, trace, execution_cpu_seconds, execution_peak_rss
-            )
-            return web.json_response(
-                models.ExecuteResponse(
-                    **result.model_dump(),
-                    trace_id=trace.trace_id if trace is not None else None,
-                    timings_ms=trace.stage_ms() if trace is not None else None,
-                    analysis=(
-                        verdict.annotation() if verdict is not None else None
-                    ),
-                ).model_dump()
-            )
 
         return await with_resilience(run)
 
@@ -1283,6 +1294,12 @@ def create_http_server(
             # already carry owner session + lease age; this is the summary
             # (active/max, how leases have been ending).
             snap["sessions"] = sessions.snapshot()
+        if analyzer is not None:
+            # The analyzer's running cost-class mix (docs/analysis.md "Cost
+            # classes"): exported here so the fleet router's refresh loop
+            # sees what KIND of work each replica has been absorbing, not
+            # just how much.
+            snap["cost_classes"] = dict(analyzer.cost_class_counts)
         return web.json_response(snap)
 
     async def fleet_events(request: web.Request) -> web.Response:

@@ -408,6 +408,9 @@ class ApplicationContext:
             # The one chokepoint both transports share is also the demand
             # sensor: arrivals/sheds/queue-waits feed the capacity tracker.
             demand=self.demand,
+            # Opt-in: the analyzer's cost_class hint bounds heavy work
+            # (docs/analysis.md "Cost classes").
+            cost_aware=self.config.admission_cost_aware,
         )
 
     def _build_local_executor(self):

@@ -105,6 +105,13 @@ class Config(BaseModel):
     admission_max_in_flight: int = Field(default=64, ge=1)
     admission_max_queue: int = Field(default=128, ge=0)
     admission_retry_after_s: float = Field(default=1.0, gt=0)
+    # Opt-in cost-aware admission (docs/analysis.md "Cost classes"): when
+    # on, executions the edge analyzer classified io_heavy/install_heavy
+    # additionally pass a bounded heavy lane (half of max_in_flight), so a
+    # burst of expensive work is shed (429/RESOURCE_EXHAUSTED) before it
+    # can starve cheap interactive turns out of the warm pool. Off by
+    # default: cost classes are then hints only (span/wide event/response).
+    admission_cost_aware: bool = False
     # Transient-failure retry schedule for executor spawn and data-plane
     # calls (the seed hardcoded tenacity's 3×/4-10s at import time).
     executor_retry_attempts: int = Field(default=3, ge=1)
@@ -332,6 +339,16 @@ class Config(BaseModel):
     policy_warn_calls: str | None = None
     policy_deny_paths: str | None = None
     policy_warn_paths: str | None = None
+    # What an import whose target the dataflow layer cannot constant-fold
+    # (`__import__(name)`, `importlib.import_module(user_choice)`,
+    # `getattr(<module>, <non-constant>)`) means under this policy:
+    # `warn` (default — fail-open: annotated `dynamic_import` finding +
+    # bci_analysis_dynamic_imports_total), `deny` (422/INVALID_ARGUMENT;
+    # also makes unanalyzable sources fail closed), or `off`. Resolvable
+    # dynamic imports are not this knob's business: the dataflow layer
+    # constant-folds them into the ordinary deny/warn import lists
+    # (docs/analysis.md "Dataflow layer").
+    policy_dynamic_import: Literal["off", "warn", "deny"] = "warn"
 
     # --- object storage (reference config.py:74; backends in docs/fleet.md) ---
     # Where snapshot bytes live. `local` (default) is a replica-private flat

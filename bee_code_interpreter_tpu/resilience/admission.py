@@ -15,6 +15,15 @@ standard load-shedding contract instead:
 Slot handoff is direct: a releasing request transfers its slot to the oldest
 live waiter without decrementing the in-flight count, so a burst can never
 overshoot ``max_in_flight``.
+
+Cost-aware mode (``APP_ADMISSION_COST_AWARE``, default off): the edge
+analyzer's ``cost_class`` hint (docs/analysis.md "Cost classes") becomes a
+priority signal — executions classified ``io_heavy``/``install_heavy``
+additionally pass :meth:`AdmissionController.heavy_lane`, a bounded
+secondary gate (half of ``max_in_flight``), after analysis and before the
+sandbox is touched. A saturated heavy lane sheds immediately
+(``reason="heavy_lane"``) instead of letting a burst of slow expensive work
+occupy every slot cheap interactive turns need.
 """
 
 from __future__ import annotations
@@ -25,6 +34,10 @@ from collections import deque
 from contextlib import asynccontextmanager
 
 from bee_code_interpreter_tpu.observability import span as trace_span
+
+# Mirror of analysis.policy.HEAVY_COST_CLASSES, spelled here so resilience/
+# never imports the analysis layer (the hint arrives as a plain string).
+_HEAVY_COST_CLASSES = frozenset({"io_heavy", "install_heavy"})
 
 
 class AdmissionRejected(Exception):
@@ -43,12 +56,21 @@ class AdmissionController:
         retry_after_s: float = 1.0,
         metrics=None,
         demand=None,  # observability.DemandTracker (capacity telemetry)
+        cost_aware: bool = False,
+        heavy_max_in_flight: int | None = None,
     ) -> None:
         self._max_in_flight = max(1, max_in_flight)
         self._max_queue = max(0, max_queue)
         self._default_wait_s = default_wait_s
         self._retry_after_s = retry_after_s
         self._in_flight = 0
+        self._cost_aware = cost_aware
+        self._heavy_max = (
+            heavy_max_in_flight
+            if heavy_max_in_flight is not None
+            else max(1, self._max_in_flight // 2)
+        )
+        self._heavy_in_flight = 0
         # The gate is the ONE chokepoint every sandbox-bound request on
         # either transport passes, which makes it the natural demand
         # sensor: arrivals, sheds, queue waits, and the in-flight
@@ -74,6 +96,11 @@ class AdmissionController:
                 "Requests waiting in the admission queue",
                 lambda: len(self._waiters),
             )
+            metrics.gauge(
+                "bci_admission_heavy_in_flight",
+                "Cost-classified heavy executions currently in the heavy lane",
+                lambda: self._heavy_in_flight,
+            )
 
     @property
     def in_flight(self) -> int:
@@ -82,6 +109,31 @@ class AdmissionController:
     @property
     def queue_depth(self) -> int:
         return len(self._waiters)
+
+    @property
+    def heavy_in_flight(self) -> int:
+        return self._heavy_in_flight
+
+    @asynccontextmanager
+    async def heavy_lane(self, cost_class: str | None):
+        """The cost-aware secondary gate (docs/analysis.md "Cost classes").
+
+        A no-op unless cost-aware mode is on AND the edge analyzer
+        classified this execution heavy (io_heavy/install_heavy). It runs
+        AFTER :meth:`admit` (analysis needs the request body, which is only
+        read once admitted), so a heavy-lane shed releases an admission
+        slot immediately — the bounded cost of classifying is one queue
+        check, never a sandbox checkout."""
+        if not self._cost_aware or cost_class not in _HEAVY_COST_CLASSES:
+            yield
+            return
+        if self._heavy_in_flight >= self._heavy_max:
+            self._shed("heavy_lane")
+        self._heavy_in_flight += 1
+        try:
+            yield
+        finally:
+            self._heavy_in_flight -= 1
 
     def _shed(self, reason: str) -> None:
         if self._shed_total is not None:

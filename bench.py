@@ -450,6 +450,16 @@ async def measure_warm_latency_p50_ms(
             sum(1 for p in phase_samples if p.get("warm_pop")) / len(phase_samples),
             2,
         )
+        # HARD budget, not a report: the acceptance bound for the edge gate
+        # (now including the dataflow pass, docs/analysis.md "Dataflow
+        # layer") is < 1 ms p50 added to the warm path. Failing the whole
+        # latency phase is deliberate — a silently regressed gate would
+        # otherwise ride along inside a number nobody decomposes.
+        if phases_p50["analysis_ms"] >= 1.0:
+            raise RuntimeError(
+                f"analysis gate over budget: p50 {phases_p50['analysis_ms']:.3f} ms"
+                " >= 1 ms — the static-analysis pass regressed the warm path"
+            )
         return statistics.median(samples) * 1000, phases_p50
     finally:
         executor.shutdown()

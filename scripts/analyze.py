@@ -14,6 +14,12 @@ Usage:
     python scripts/analyze.py payload.py --deny-imports socket,ctypes \\
         --deny-calls "subprocess,os.fork" --warn-calls "raw_socket"
     python scripts/analyze.py --self-lint        # run the repo asynclint
+    python scripts/analyze.py --concurrency-lint # the await-aware lint
+    python scripts/analyze.py --self-lint --sarif > asynclint.sarif
+
+scripts/lint.sh chains both self-lints plus the metrics/docs lints — the
+one command CI needs. ``--sarif`` renders either self-lint as a SARIF
+2.1.0 log (suppressed findings carried with their justifications).
 
 Without explicit --deny/--warn flags the policy comes from the same
 APP_POLICY_* environment the service reads, so a dry run matches what the
@@ -43,6 +49,7 @@ def build_policy(args: argparse.Namespace) -> PolicyEngine:
     flags = (
         args.deny_imports, args.warn_imports, args.deny_calls,
         args.warn_calls, args.deny_paths, args.warn_paths,
+        args.dynamic_import,
     )
     if any(f is not None for f in flags):
         return PolicyEngine(
@@ -52,6 +59,7 @@ def build_policy(args: argparse.Namespace) -> PolicyEngine:
             warn_calls=split_patterns(args.warn_calls),
             deny_paths=split_patterns(args.deny_paths),
             warn_paths=split_patterns(args.warn_paths),
+            dynamic_import=args.dynamic_import or "warn",
         )
     return PolicyEngine.from_config(Config.from_env())
 
@@ -67,11 +75,18 @@ def render_table(rows: list[tuple[str, ...]], header: tuple[str, ...]) -> str:
     return "\n".join(lines)
 
 
-def self_lint(as_json: bool) -> int:
-    from bee_code_interpreter_tpu.analysis import lint_paths
+def _render_lint(report, tool_name: str, as_json: bool, as_sarif: bool) -> int:
+    if as_sarif:
+        from bee_code_interpreter_tpu.analysis import sarif_log, tool_run
 
-    report = lint_paths()
-    if as_json:
+        print(
+            json.dumps(
+                sarif_log(
+                    [tool_run(tool_name, report.violations, report.suppressed)]
+                )
+            )
+        )
+    elif as_json:
         print(
             json.dumps(
                 {
@@ -93,6 +108,20 @@ def self_lint(as_json: bool) -> int:
     return 0 if report.clean else 3
 
 
+def self_lint(as_json: bool, as_sarif: bool = False) -> int:
+    from bee_code_interpreter_tpu.analysis import lint_paths
+
+    return _render_lint(lint_paths(), "asynclint", as_json, as_sarif)
+
+
+def concurrency_lint(as_json: bool, as_sarif: bool = False) -> int:
+    from bee_code_interpreter_tpu.analysis import lint_concurrency_paths
+
+    return _render_lint(
+        lint_concurrency_paths(), "concurrencylint", as_json, as_sarif
+    )
+
+
 def main() -> int:
     parser = argparse.ArgumentParser(
         description="Edge workload analyzer (docs/analysis.md)"
@@ -101,16 +130,30 @@ def main() -> int:
     parser.add_argument("--json", action="store_true", help="machine-readable output")
     parser.add_argument("--self-lint", action="store_true",
                         help="run the repo asynclint instead of analyzing a payload")
+    parser.add_argument("--concurrency-lint", action="store_true",
+                        help="run the await-aware concurrency lint "
+                             "(analysis/concurrencylint.py)")
+    parser.add_argument("--sarif", action="store_true",
+                        help="render a self-lint as SARIF 2.1.0 (implies "
+                             "machine-readable output)")
     for flag in ("deny-imports", "warn-imports", "deny-calls", "warn-calls",
                  "deny-paths", "warn-paths"):
         parser.add_argument(f"--{flag}", default=None,
                             help=f"comma-separated {flag.replace('-', ' ')} patterns")
+    parser.add_argument("--dynamic-import", default=None,
+                        choices=("off", "warn", "deny"),
+                        help="what a non-constant-foldable import target "
+                             "means (default: warn)")
     args = parser.parse_args()
 
     if args.self_lint:
-        return self_lint(args.json)
+        return self_lint(args.json, args.sarif)
+    if args.concurrency_lint:
+        return concurrency_lint(args.json, args.sarif)
     if not args.source:
-        parser.error("source file (or -) required unless --self-lint")
+        parser.error(
+            "source file (or -) required unless --self-lint/--concurrency-lint"
+        )
 
     source = (
         sys.stdin.read()

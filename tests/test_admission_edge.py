@@ -40,13 +40,14 @@ class GatedExecutor:
         return Result(stdout="done\n", stderr="", exit_code=0, files={})
 
 
-def make_app(executor, admission, metrics, request_deadline_s=30.0):
+def make_app(executor, admission, metrics, request_deadline_s=30.0, analyzer=None):
     return create_http_server(
         code_executor=executor,
         custom_tool_executor=CustomToolExecutor(code_executor=executor),
         metrics=metrics,
         admission=admission,
         request_deadline_s=request_deadline_s,
+        analyzer=analyzer,
     )
 
 
@@ -263,5 +264,123 @@ async def test_grpc_client_deadline_caps_the_edge_deadline():
         # (small tolerance: time_remaining() is measured wall-clock and can
         # read a few ms over the client's requested timeout)
         assert deadline.budget_s < 6.0
+    finally:
+        await server.stop(None)
+
+
+# ------------------------------------------------------ cost-aware lane
+# (docs/analysis.md "Cost classes"): APP_ADMISSION_COST_AWARE bounds
+# heavy-classified executions to a secondary lane so expensive work can
+# never occupy every slot cheap interactive turns need. Off by default.
+
+IO_HEAVY_SOURCE = 'open("/tmp/bci-heavy-probe")\n'  # classifies io_heavy
+
+
+async def test_heavy_lane_is_a_noop_by_default():
+    admission = AdmissionController(max_in_flight=4)
+    async with admission.heavy_lane("install_heavy"):
+        assert admission.heavy_in_flight == 0  # not even counted
+
+
+async def test_heavy_lane_bounds_heavy_classes_only():
+    from bee_code_interpreter_tpu.resilience import AdmissionRejected
+
+    admission = AdmissionController(
+        max_in_flight=4, cost_aware=True, heavy_max_in_flight=1
+    )
+    async with admission.heavy_lane("io_heavy"):
+        assert admission.heavy_in_flight == 1
+        # cheap work is never heavy-gated, even at the bound
+        async with admission.heavy_lane("cheap"):
+            pass
+        with pytest.raises(AdmissionRejected) as e:
+            async with admission.heavy_lane("install_heavy"):
+                raise AssertionError("must shed before entering")
+        assert e.value.reason == "heavy_lane"
+    assert admission.heavy_in_flight == 0  # slot returned
+
+
+async def test_http_cost_aware_sheds_heavy_burst_keeps_cheap():
+    from bee_code_interpreter_tpu.analysis import WorkloadAnalyzer
+
+    metrics = Registry()
+    gated = GatedExecutor()
+    admission = AdmissionController(
+        max_in_flight=4,
+        max_queue=4,
+        retry_after_s=3.0,
+        metrics=metrics,
+        cost_aware=True,
+        heavy_max_in_flight=1,
+    )
+    app = make_app(gated, admission, metrics, analyzer=WorkloadAnalyzer())
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    try:
+        heavy = {"source_code": IO_HEAVY_SOURCE}
+        t1 = asyncio.create_task(client.post("/v1/execute", json=heavy))
+        while gated.started < 1:
+            await asyncio.sleep(0.01)  # t1 holds the one heavy slot
+
+        # Second heavy request: heavy lane full -> shed as the ordinary
+        # 429 contract, while plain admission still has 3 free slots.
+        resp = await client.post("/v1/execute", json=heavy)
+        assert resp.status == 429
+        assert resp.headers["Retry-After"] == "3"
+        assert (
+            'bci_admission_shed_total{reason="heavy_lane"} 1'
+            in metrics.expose()
+        )
+        assert "bci_admission_heavy_in_flight 1" in metrics.expose()
+
+        # Cheap work sails past the saturated heavy lane.
+        t2 = asyncio.create_task(
+            client.post("/v1/execute", json={"source_code": "print(1)"})
+        )
+        while gated.started < 2:
+            await asyncio.sleep(0.01)
+
+        gated.release.set()
+        r1, r2 = await asyncio.gather(t1, t2)
+        assert r1.status == 200 and r2.status == 200
+        assert (await r1.json())["analysis"]["cost_class"] == "io_heavy"
+    finally:
+        await client.close()
+
+
+async def test_grpc_cost_aware_sheds_heavy_as_resource_exhausted():
+    from bee_code_interpreter_tpu.analysis import WorkloadAnalyzer
+
+    gated = GatedExecutor()
+    admission = AdmissionController(
+        max_in_flight=4, cost_aware=True, heavy_max_in_flight=1
+    )
+    server = GrpcServer(
+        code_executor=gated,
+        custom_tool_executor=CustomToolExecutor(code_executor=gated),
+        admission=admission,
+        analyzer=WorkloadAnalyzer(),
+    )
+    port = await server.start("127.0.0.1:0")
+    try:
+        async with grpc.aio.insecure_channel(f"127.0.0.1:{port}") as channel:
+            stubs = service_stubs(channel)
+            t1 = asyncio.ensure_future(
+                stubs["Execute"](pb.ExecuteRequest(source_code=IO_HEAVY_SOURCE))
+            )
+            while gated.started < 1:
+                await asyncio.sleep(0.01)
+            try:
+                await stubs["Execute"](
+                    pb.ExecuteRequest(source_code=IO_HEAVY_SOURCE)
+                )
+            except grpc.aio.AioRpcError as e:
+                assert e.code() == grpc.StatusCode.RESOURCE_EXHAUSTED
+                assert "heavy_lane" in e.details()
+            else:
+                raise AssertionError("expected RESOURCE_EXHAUSTED")
+            gated.release.set()
+            resp = await t1
+            assert resp.stdout == "done\n"
     finally:
         await server.stop(None)

@@ -346,8 +346,11 @@ def test_analyzer_annotation_shape():
     annotation = verdict.annotation()
     assert annotation["warnings"][0]["rule"] == "shape:subprocess"
     assert "predicted_deps" not in annotation  # key absent when empty
-    # clean source with no deps annotates nothing at all
-    assert WorkloadAnalyzer().analyze("print(1)\n").annotation() is None
+    # clean source still annotates the cost hint (docs/analysis.md "Cost
+    # classes") and nothing else
+    assert WorkloadAnalyzer().analyze("print(1)\n").annotation() == {
+        "cost_class": "cheap"
+    }
 
 
 def test_analyzer_size_bound_is_unanalyzable_not_a_stall():
@@ -387,3 +390,191 @@ def test_analyzer_from_config_honors_enable_switch():
         Config(policy_deny_imports="socket, ctypes")
     )
     assert analyzer.policy.deny_imports == ("socket", "ctypes")
+
+
+# ------------------------------------------------------- dataflow layer
+# (docs/analysis.md "Dataflow layer"): the CFG engine the concurrency lint
+# walks and the flow-insensitive bindings the policy consumer resolves
+# through. The evasion-closing edge behavior lives in test_analysis_edge.
+
+
+def test_cfg_reaching_defs_and_await_annotations():
+    import ast
+
+    from bee_code_interpreter_tpu.analysis.dataflow import EXIT, FunctionFlow
+
+    src = (
+        "async def f(self, q):\n"
+        "    n = self.count\n"
+        "    if n:\n"
+        "        n = 0\n"
+        "    await q.put(n)\n"
+        "    self.count = n\n"
+    )
+    func = ast.parse(src).body[0]
+    flow = FunctionFlow(func)
+    # last statement sees BOTH definitions of n (the if is a real branch)
+    write_idx = next(
+        n.idx for n in flow.nodes if isinstance(n.stmt, ast.Assign)
+        and isinstance(n.stmt.targets[0], ast.Attribute)
+    )
+    read_idx = next(
+        n.idx for n in flow.nodes if isinstance(n.stmt, ast.Assign)
+        and not isinstance(n.stmt.targets[0], ast.Attribute)
+    )
+    assert len(flow.reach_in(write_idx)["n"]) == 2
+    # the await stmt is annotated and lies between the read and the write
+    assert flow.await_between(read_idx, write_idx)
+    assert not flow.await_between(write_idx, read_idx)
+    assert EXIT in flow.nodes[write_idx].succs
+
+
+def test_cfg_lock_scopes_annotate_statements():
+    import ast
+
+    from bee_code_interpreter_tpu.analysis.dataflow import FunctionFlow
+
+    src = (
+        "async def f(self):\n"
+        "    a = 1\n"
+        "    async with self._lock:\n"
+        "        b = 2\n"
+        "    c = 3\n"
+    )
+    flow = FunctionFlow(ast.parse(src).body[0])
+    held = {
+        n.stmt.targets[0].id: n.held_locks
+        for n in flow.nodes
+        if isinstance(n.stmt, ast.Assign)
+    }
+    assert held["a"] == frozenset()
+    assert held["b"] == frozenset({"self._lock"})
+    assert held["c"] == frozenset()
+
+
+def test_scope_bindings_union_semantics():
+    import ast
+
+    from bee_code_interpreter_tpu.analysis.dataflow import ScopeBindings
+
+    tree = ast.parse(
+        'x = print\n'
+        'x = __import__\n'
+        's = "soc"\n'
+        's2 = s + "ket"\n'
+        'other = s if x else "tls"\n'
+    )
+    scope = ScopeBindings(tree, {})
+    # a rebound name resolves to BOTH origins (order-blind, over-approx)
+    assert scope.origins("x") == {"print", "__import__"}
+    # constants fold through names and concatenation...
+    assert scope.fold_str(ast.parse('s + "ket"').body[0].value) == "socket"
+    # ...but a name with a non-foldable definition does not fold
+    assert scope._fold_name("other") is None
+
+
+def test_inspection_dynamic_fields_and_trigger_gate():
+    # no trigger tokens -> the dataflow pass is skipped entirely
+    clean = inspect_source("x = 1\nprint(x)\n")
+    assert clean.dynamic_imports == {}
+    assert clean.dynamic_import_sites == []
+    resolved = inspect_source('imp = __import__\nimp("socket")\n')
+    assert resolved.dynamic_imports == {"socket": [2]}
+    dyn = inspect_source("n = input()\n__import__(n)\n")
+    assert [line for line, _ in dyn.dynamic_import_sites] == [2]
+
+
+def test_dynamic_import_value_flows_into_call_names():
+    # m = __import__("subprocess"); m.run(...) is a subprocess.run call
+    insp = inspect_source('m = __import__("subprocess")\nm.run(["id"])\n')
+    assert "subprocess.run" in insp.call_names()
+    findings = PolicyEngine(deny_calls=("subprocess",)).evaluate(insp)
+    assert [f.rule for f in findings] == ["shape:subprocess"]
+
+
+def test_dynamic_import_off_mode_is_silent():
+    insp = inspect_source("n = input()\n__import__(n)\n")
+    assert PolicyEngine(dynamic_import="off").evaluate(insp) == []
+    assert not PolicyEngine(dynamic_import="off").declared
+    assert PolicyEngine(dynamic_import="deny").declared  # fail-closed mode
+
+
+# ----------------------------------------------------------- cost classes
+
+
+def test_cost_classification_ladder():
+    from bee_code_interpreter_tpu.analysis import classify_cost
+
+    assert classify_cost(inspect_source("print(1)\n")) == "cheap"
+    assert classify_cost(inspect_source(
+        "for i in range(9):\n    print(i)\n"
+    )) == "cheap"  # a single loop is just a program
+    assert classify_cost(inspect_source(
+        "for i in range(9):\n    for j in range(9):\n        print(j)\n"
+    )) == "loopy"
+    assert classify_cost(inspect_source('open("/tmp/x")\n')) == "io_heavy"
+    # an install dwarfs everything else, loops included
+    assert classify_cost(inspect_source(
+        "import pandas\nfor i in range(9):\n    for j in range(9):\n"
+        "        open('/t')\n"
+    )) == "install_heavy"
+
+
+def test_analyzer_stamps_cost_class_on_span_and_counts():
+    registry = Registry()
+    tracer = Tracer(metrics=registry)
+    analyzer = WorkloadAnalyzer(metrics=registry)
+    with tracer.trace("/v1/execute") as trace:
+        verdict = analyzer.analyze('open("/tmp/x")\n')
+    assert verdict.cost_class == "io_heavy"
+    span = next(s for s in trace.spans if s.name == "analysis")
+    assert span.attributes["analysis.cost_class"] == "io_heavy"
+    assert analyzer.cost_class_counts["io_heavy"] == 1
+    assert (
+        'bci_analysis_cost_class_total{class="io_heavy"} 1'
+        in registry.expose()
+    )
+
+
+def test_cost_class_lands_on_wide_event():
+    """The flight recorder lifts analysis.* span attributes into the wide
+    event's `analysis` block — the cost hint must arrive there for free."""
+    from bee_code_interpreter_tpu.observability import FlightRecorder
+
+    registry = Registry()
+    tracer = Tracer(metrics=registry)
+    recorder = FlightRecorder(metrics=registry)
+    tracer.add_sink(recorder.record_trace)
+    analyzer = WorkloadAnalyzer(metrics=registry)
+    with tracer.trace("/v1/execute"):
+        analyzer.analyze("print(1)\n")
+    event = recorder.events(limit=1)[0]
+    assert event["analysis"]["cost_class"] == "cheap"
+
+
+def test_unanalyzable_source_has_no_cost_class():
+    verdict = WorkloadAnalyzer(max_source_bytes=8).analyze("x = 1\n" * 10)
+    assert verdict.cost_class is None
+    assert verdict.annotation() is None
+
+
+def test_cyclic_alias_chain_still_resolves():
+    """Code-review regression: a resolution cycle (x = y; y = x) must not
+    poison the memo — `y` still resolves to __import__ and the socket
+    import is denied regardless of call/query order."""
+    insp = inspect_source('x = y\ny = x\nx = __import__\ny("socket")\nx("os")\n')
+    assert insp.dynamic_imports == {"os": [5], "socket": [4]}
+    findings = PolicyEngine(deny_imports=("socket",)).evaluate(insp)
+    assert [f.rule for f in findings] == ["import:socket"]
+
+
+def test_resolved_calls_keep_loop_context():
+    """Code-review regression: `m = x("os"); m.fork()` inside a for loop
+    must keep in_loop so fork_in_loop still matches through the
+    indirection the dataflow layer resolves."""
+    insp = inspect_source(
+        'x = __import__\nfor i in range(3):\n    m = x("os")\n    m.fork()\n'
+    )
+    assert ("os.fork", True) in {(c.name, c.in_loop) for c in insp.calls}
+    findings = PolicyEngine(deny_calls=("fork_in_loop",)).evaluate(insp)
+    assert [f.rule for f in findings] == ["shape:fork_in_loop"]

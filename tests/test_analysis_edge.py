@@ -140,9 +140,9 @@ async def test_http_warn_annotates_and_executes(counting_executor):
     assert counting_executor.executions == 1  # warn does not block
 
 
-async def test_http_clean_source_response_unchanged(counting_executor):
-    """No warnings, no deps → the analysis key is null: the wire shape of
-    the common path is exactly the pre-gate contract."""
+async def test_http_clean_source_carries_cost_class(counting_executor):
+    """No warnings, no deps → the analysis block carries exactly the
+    cost hint (docs/analysis.md "Cost classes") and nothing else."""
     app = make_app(counting_executor, WorkloadAnalyzer())
 
     async def go(client):
@@ -152,7 +152,7 @@ async def test_http_clean_source_response_unchanged(counting_executor):
             )
         ).json()
         assert body["stdout"] == "42\n"
-        assert body["analysis"] is None
+        assert body["analysis"] == {"cost_class": "cheap"}
 
     await with_client(app, go)
 
@@ -359,6 +359,203 @@ async def test_grpc_custom_tool_policy_applies_to_indented_source(
 
     await run_grpc(server, go)
     assert counting_executor.executions == 0
+
+
+# ------------------------------------------- dataflow evasion closings
+# (docs/analysis.md "Dataflow layer"): the four regression shapes, each on
+# BOTH transports, each with zero sandbox checkouts under deny.
+
+EVASIONS = {
+    "dunder_alias": 'x = __import__\nx("socket")\n',
+    "importlib_from": (
+        "from importlib import import_module as f\n"
+        'f("socket")\n'
+    ),
+    "getattr_chain": (
+        "import os\n"
+        'g = getattr(os, "sys" + "tem")\n'
+        'g("id")\n'
+    ),
+}
+EVASION_POLICY = dict(
+    deny_imports=("socket",), deny_calls=("os.system",)
+)
+
+
+async def test_http_dynamic_import_evasions_denied(counting_executor):
+    metrics = Registry()
+    analyzer = WorkloadAnalyzer(
+        PolicyEngine(**EVASION_POLICY), metrics=metrics
+    )
+    app = make_app(counting_executor, analyzer, metrics=metrics)
+
+    async def go(client):
+        for name, src in EVASIONS.items():
+            resp = await client.post(
+                "/v1/execute", json={"source_code": src}
+            )
+            assert resp.status == 422, name
+            rules = {v["rule"] for v in (await resp.json())["violations"]}
+            assert rules & {"import:socket", "call:os.system"}, (name, rules)
+
+    await with_client(app, go)
+    assert counting_executor.executions == 0
+    assert (
+        'bci_analysis_dynamic_imports_total{action="resolved"}'
+        in metrics.expose()
+    )
+
+
+async def test_http_dynamic_import_warn_path(counting_executor):
+    """Non-constant import target under the default fail-open policy:
+    the execution proceeds, annotated `dynamic_import` + counted."""
+    metrics = Registry()
+    analyzer = WorkloadAnalyzer(
+        PolicyEngine(dynamic_import="warn"), metrics=metrics
+    )
+    app = make_app(counting_executor, analyzer, metrics=metrics)
+
+    async def go(client):
+        resp = await client.post(
+            "/v1/execute",
+            json={"source_code": 'name = str(1)\n__import__(name)\n'},
+        )
+        assert resp.status == 200
+        body = await resp.json()
+        warned = body["analysis"]["warnings"]
+        assert warned[0]["rule"] == "dynamic_import"
+
+    await with_client(app, go)
+    assert counting_executor.executions == 1  # warn does not block
+    assert (
+        'bci_analysis_dynamic_imports_total{action="warn"} 1'
+        in metrics.expose()
+    )
+
+
+async def test_http_dynamic_import_deny_mode(counting_executor):
+    analyzer = WorkloadAnalyzer(PolicyEngine(dynamic_import="deny"))
+    app = make_app(counting_executor, analyzer)
+
+    async def go(client):
+        resp = await client.post(
+            "/v1/execute",
+            json={"source_code": 'name = str(1)\n__import__(name)\n'},
+        )
+        assert resp.status == 422
+        body = await resp.json()
+        assert body["violations"][0]["rule"] == "dynamic_import"
+
+    await with_client(app, go)
+    assert counting_executor.executions == 0
+
+
+async def test_grpc_dynamic_import_evasions_denied(counting_executor):
+    server = GrpcServer(
+        code_executor=counting_executor,
+        custom_tool_executor=CustomToolExecutor(code_executor=counting_executor),
+        analyzer=WorkloadAnalyzer(PolicyEngine(**EVASION_POLICY)),
+    )
+
+    async def go(stubs):
+        for name, src in EVASIONS.items():
+            try:
+                await stubs["Execute"](pb.ExecuteRequest(source_code=src))
+            except grpc.aio.AioRpcError as e:
+                assert e.code() == grpc.StatusCode.INVALID_ARGUMENT, name
+                assert (
+                    "import:socket" in e.details()
+                    or "call:os.system" in e.details()
+                ), (name, e.details())
+            else:
+                raise AssertionError(f"{name}: expected INVALID_ARGUMENT")
+
+    await run_grpc(server, go)
+    assert counting_executor.executions == 0
+
+
+async def test_grpc_dynamic_import_warn_rides_trailer(counting_executor):
+    server = GrpcServer(
+        code_executor=counting_executor,
+        custom_tool_executor=CustomToolExecutor(code_executor=counting_executor),
+        analyzer=WorkloadAnalyzer(PolicyEngine(dynamic_import="warn")),
+    )
+
+    async def go(stubs):
+        call = stubs["Execute"](
+            pb.ExecuteRequest(
+                # statically a dynamic-import site; never actually runs
+                source_code=(
+                    "name = str(1)\n"
+                    "if not name:\n    __import__(name)\n"
+                    "print(1)\n"
+                )
+            )
+        )
+        resp = await call
+        assert resp.exit_code == 0
+        trailers = {k: v for k, v in await call.trailing_metadata()}
+        assert "dynamic_import" in trailers.get("bci-analysis-warnings", "")
+
+    await run_grpc(server, go)
+    assert counting_executor.executions == 1
+
+
+async def test_grpc_dynamic_import_deny_mode(counting_executor):
+    server = GrpcServer(
+        code_executor=counting_executor,
+        custom_tool_executor=CustomToolExecutor(code_executor=counting_executor),
+        analyzer=WorkloadAnalyzer(PolicyEngine(dynamic_import="deny")),
+    )
+
+    async def go(stubs):
+        try:
+            await stubs["Execute"](
+                pb.ExecuteRequest(source_code='n = str(1)\n__import__(n)\n')
+            )
+        except grpc.aio.AioRpcError as e:
+            assert e.code() == grpc.StatusCode.INVALID_ARGUMENT
+            assert "dynamic_import" in e.details()
+        else:
+            raise AssertionError("expected INVALID_ARGUMENT")
+
+    await run_grpc(server, go)
+    assert counting_executor.executions == 0
+
+
+# ----------------------------------------------------------- cost class
+
+
+async def test_grpc_cost_class_rides_trailer(counting_executor):
+    server = GrpcServer(
+        code_executor=counting_executor,
+        custom_tool_executor=CustomToolExecutor(code_executor=counting_executor),
+        analyzer=WorkloadAnalyzer(),
+    )
+
+    async def go(stubs):
+        call = stubs["Execute"](pb.ExecuteRequest(source_code="print(1)\n"))
+        await call
+        trailers = {k: v for k, v in await call.trailing_metadata()}
+        assert trailers.get("bci-analysis-cost-class") == "cheap"
+
+    await run_grpc(server, go)
+
+
+async def test_http_cost_class_on_fleet_snapshot(counting_executor):
+    """The running cost-class mix is exported on GET /v1/fleet for the
+    router's placement view (docs/fleet.md)."""
+    analyzer = WorkloadAnalyzer()
+    app = make_app(counting_executor, analyzer)
+
+    async def go(client):
+        await client.post(
+            "/v1/execute", json={"source_code": "print(1)\n"}
+        )
+        snap = await (await client.get("/v1/fleet")).json()
+        assert snap["cost_classes"]["cheap"] == 1
+
+    await with_client(app, go)
 
 
 async def test_grpc_clean_source_executes(counting_executor):

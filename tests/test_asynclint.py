@@ -9,7 +9,9 @@ regression names the broken rule, not just "the repo got dirty"."""
 import textwrap
 
 from bee_code_interpreter_tpu.analysis.asynclint import (
+    DEFAULT_EXCLUDES,
     SUPPRESSIONS,
+    default_packages,
     lint_paths,
     lint_source,
 )
@@ -51,11 +53,52 @@ def test_every_suppression_is_justified():
 
 def test_lint_covers_every_registered_bci_metric():
     """The undocumented-metric rule only means something if the scan sees
-    the registrations: the control-plane registry surface must be found."""
+    the registrations: the control-plane registry surface must be found.
+    Since the scope became derived (analysis/ included), the linter's own
+    metrics are lintees too — no package gets to grade itself out."""
     report = lint_paths()
     assert "bci_stage_seconds" in report.metric_names
-    assert "bci_analysis_seconds" not in report.metric_names  # analysis/ is the linter, not the lintee
+    assert "bci_analysis_seconds" in report.metric_names
     assert len(report.metric_names) >= 20
+
+
+def test_default_scope_is_derived_not_hand_maintained():
+    """The scope comes from the package tree minus the explicit exclude
+    list — the hand-maintained include list silently skipped every new
+    top-level package (fleet/ shipped a whole PR unlinted that way)."""
+    packages = default_packages()
+    # the control plane is all in scope...
+    for required in ("api", "services", "resilience", "observability",
+                     "sessions", "fleet", "analysis"):
+        assert required in packages
+    # ...and only the declared excludes are out
+    for excluded in ("models", "parallel", "ops"):
+        assert excluded not in packages
+    assert "runtime" in packages  # runtime/ is in; runtime/shim is excluded
+    assert "runtime/shim" in DEFAULT_EXCLUDES
+
+
+def test_fresh_package_is_in_scope_by_default(tmp_path):
+    """Regression for the omission bug class: a freshly created top-level
+    package must be linted WITHOUT anyone editing a scope list."""
+    pkg_root = tmp_path / "fakepkg"
+    shiny = pkg_root / "shiny_new_subsystem"
+    shiny.mkdir(parents=True)
+    (shiny / "__init__.py").write_text("")
+    (shiny / "svc.py").write_text(
+        "import time\nasync def f():\n    time.sleep(1)\n"
+    )
+    # excluded subtrees stay out even when present
+    excluded = pkg_root / "models"
+    excluded.mkdir()
+    (excluded / "__init__.py").write_text("")
+    (excluded / "bad.py").write_text(
+        "import time\nasync def g():\n    time.sleep(1)\n"
+    )
+    assert default_packages(pkg_root) == ("shiny_new_subsystem",)
+    report = lint_paths(pkg_root, docs_path=None, suppressions=())
+    assert [v.rule for v in report.violations] == ["blocking-call-in-async"]
+    assert report.violations[0].path.endswith("shiny_new_subsystem/svc.py")
 
 
 # ----------------------------------------------------------- rule units

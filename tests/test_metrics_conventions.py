@@ -108,6 +108,12 @@ def test_every_registered_metric_follows_conventions(tmp_path):
         "bci_analysis_rejections_total",
         "bci_analysis_warnings_total",
         "bci_analysis_dep_predictions_total",
+        # dataflow layer + cost classes (ISSUE 12): dynamic-import
+        # resolution accounting and the scheduling hint, plus the
+        # cost-aware heavy lane's occupancy gauge
+        "bci_analysis_dynamic_imports_total",
+        "bci_analysis_cost_class_total",
+        "bci_admission_heavy_in_flight",
         # sessions (ISSUE 7): leased sandboxes + checkpoint/rollback
         "bci_session_active",
         "bci_session_lease_seconds",
@@ -179,6 +185,9 @@ def test_every_registered_metric_follows_conventions(tmp_path):
     assert isinstance(metrics["bci_analysis_seconds"], Histogram)
     assert isinstance(metrics["bci_analysis_rejections_total"], Counter)
     assert isinstance(metrics["bci_analysis_dep_predictions_total"], Counter)
+    assert isinstance(metrics["bci_analysis_dynamic_imports_total"], Counter)
+    assert isinstance(metrics["bci_analysis_cost_class_total"], Counter)
+    assert isinstance(metrics["bci_admission_heavy_in_flight"], Gauge)
     assert isinstance(metrics["bci_session_active"], Gauge)
     assert isinstance(metrics["bci_session_lease_seconds"], Histogram)
     assert isinstance(metrics["bci_session_expirations_total"], Counter)
