@@ -35,6 +35,7 @@ from bee_code_interpreter_tpu.observability import (
     empty_slo_snapshot,
     find_journal,
     parse_traceparent,
+    record_sli,
     record_usage_at_edge,
     register_stream_metrics,
     register_usage_metrics,
@@ -62,6 +63,12 @@ from bee_code_interpreter_tpu.services.custom_tool_executor import (
     CustomToolExecuteError,
     CustomToolExecutor,
     CustomToolParseError,
+)
+from bee_code_interpreter_tpu.tenancy import (
+    TENANT_METADATA_KEY,
+    bearer_token,
+    build_tenants_snapshot,
+    tenant_scope,
 )
 from bee_code_interpreter_tpu.utils.metrics import Registry
 from bee_code_interpreter_tpu.utils.request_id import new_request_id
@@ -148,6 +155,7 @@ class CodeInterpreterServicer:
         slo=None,  # observability.SloEngine (shared with the HTTP edge)
         analyzer=None,  # analysis.WorkloadAnalyzer (shared with the HTTP edge)
         sessions=None,  # sessions.SessionManager (shared with the HTTP edge)
+        tenancy=None,  # tenancy.TenantRegistry (shared with the HTTP edge)
     ) -> None:
         self._code_executor = code_executor
         self._custom_tool_executor = custom_tool_executor
@@ -157,6 +165,7 @@ class CodeInterpreterServicer:
         self._slo = slo
         self._analyzer = analyzer
         self._sessions = sessions
+        self._tenancy = tenancy
         self._tracer = tracer or Tracer(metrics=metrics)
         self._deadline_exceeded_total = (
             metrics.counter(
@@ -211,6 +220,23 @@ class CodeInterpreterServicer:
             request_id=rid,
         )
 
+    def _resolve_tenant(self, context: grpc.aio.ServicerContext):
+        """The gRPC spelling of tenant resolution (docs/tenancy.md):
+        ``x-tenant-id`` invocation metadata, or an ``authorization: Bearer``
+        API key from the tenant table; None when no registry is wired."""
+        if self._tenancy is None:
+            return None
+        metadata = {
+            k.lower(): v for k, v in (context.invocation_metadata() or ())
+        }
+        tctx = self._tenancy.resolve(
+            metadata.get(TENANT_METADATA_KEY),
+            bearer_token(metadata.get("authorization")),
+        )
+        if self._admission is not None and tctx.retry_budget is None:
+            tctx.retry_budget = self._admission.tenant_retry_budget(tctx)
+        return tctx
+
     def _new_deadline(self, context: grpc.aio.ServicerContext) -> Deadline | None:
         budget = self._request_deadline_s
         client_remaining = context.time_remaining()
@@ -243,88 +269,115 @@ class CodeInterpreterServicer:
         server-side failures (blown deadline, open breaker, internal error)
         burn availability budget; client-fault aborts raised by the body
         (INVALID_ARGUMENT) count good; shed/drain/cancel are excluded."""
-        # Drain check BEFORE admission (mirror of the HTTP edge): a
-        # draining replica rejects new work retryably while in-flight RPCs
-        # (tracked below) run to completion. Health answers NOT_SERVING.
-        # Evacuation ops (``allow_draining``: session checkpoint — the
-        # lease-handoff path, docs/fleet.md) are exempt on BOTH transports.
-        if self._drain is not None and self._drain.draining and not allow_draining:
-            context.set_trailing_metadata(
-                (("retry-after-s", f"{self._drain.retry_after_s:g}"),)
-            )
-            _annotate_outcome("drained", None)
-            await context.abort(
-                grpc.StatusCode.UNAVAILABLE,
-                "service draining; retry against another replica",
-            )
-        deadline = self._new_deadline(context)
-        slo_start = time.monotonic()
-        sample = _SliSample()
-        label = "cancelled"  # only a CancelledError leaves it unassigned
-        try:
-            try:
-                # track() covers the admission wait too (mirror of the HTTP
-                # edge): a queued waiter was admitted past the drain check and
-                # WILL execute — teardown must wait for it.
-                with (
-                    self._drain.track()
-                    if self._drain is not None
-                    else nullcontext()
-                ):
-                    async with (
-                        self._admission.admit(deadline)
-                        if self._admission is not None
-                        else nullcontext()
-                    ):
-                        yield deadline, sample
-                if sample.ok is None:
-                    sample.ok = True
-                label = "ok" if sample.ok else "error"
-            except AdmissionRejected as e:
-                label = "shed"
+        # Tenant identity resolves HERE from the invocation metadata — the
+        # gRPC twin of the HTTP middleware (docs/tenancy.md): its quotas
+        # apply at the admission gate, its SLO slice gets the sample, its
+        # usage meter gets the outcome.
+        tctx = self._resolve_tenant(context)
+        with tenant_scope(tctx):
+            if tctx is not None:
+                trace = current_trace()
+                if trace is not None:
+                    trace.root.attributes["tenant"] = tctx.label
+            # Drain check BEFORE admission (mirror of the HTTP edge): a
+            # draining replica rejects new work retryably while in-flight
+            # RPCs (tracked below) run to completion. Health answers
+            # NOT_SERVING. Evacuation ops (``allow_draining``: session
+            # checkpoint — the lease-handoff path, docs/fleet.md) are
+            # exempt on BOTH transports.
+            if (
+                self._drain is not None
+                and self._drain.draining
+                and not allow_draining
+            ):
                 context.set_trailing_metadata(
-                    (("retry-after-s", f"{e.retry_after_s:g}"),)
+                    (("retry-after-s", f"{self._drain.retry_after_s:g}"),)
                 )
-                await context.abort(
-                    grpc.StatusCode.RESOURCE_EXHAUSTED,
-                    f"service overloaded ({e.reason}); retry in {e.retry_after_s:g}s",
-                )
-            except DeadlineExceeded:
-                sample.ok = False
-                label = "deadline"
-                if self._deadline_exceeded_total is not None:
-                    self._deadline_exceeded_total.inc(transport="grpc")
-                await context.abort(
-                    grpc.StatusCode.DEADLINE_EXCEEDED, "request deadline exceeded"
-                )
-            except BreakerOpenError as e:
-                # Open breaker, no fallback: retryable overload, not an internal
-                # error — UNAVAILABLE with the breaker's retry hint.
-                sample.ok = False
-                label = "breaker_open"
-                context.set_trailing_metadata(
-                    (("retry-after-s", f"{e.retry_after_s:g}"),)
-                )
+                _annotate_outcome("drained", None)
                 await context.abort(
                     grpc.StatusCode.UNAVAILABLE,
-                    f"backend temporarily unavailable; retry in {e.retry_after_s:g}s",
+                    "service draining; retry against another replica",
                 )
-            except asyncio.CancelledError:
-                raise  # client went away: sample.ok untouched (not a sample)
-            except _ABORT_ERRORS:
-                sample.ok = True  # body aborted INVALID_ARGUMENT: client fault
-                label = "client_error"
-                raise
-            except BaseException:
-                sample.ok = False  # unhandled → gRPC UNKNOWN
-                label = "error"
-                raise
-        finally:
-            if self._slo is not None and sample.ok is not None:
-                self._slo.record(
-                    ok=sample.ok, duration_s=time.monotonic() - slo_start
-                )
-            _annotate_outcome(label, sample.ok)
+            deadline = self._new_deadline(context)
+            slo_start = time.monotonic()
+            sample = _SliSample()
+            label = "cancelled"  # only a CancelledError leaves it unassigned
+            try:
+                try:
+                    # track() covers the admission wait too (mirror of the
+                    # HTTP edge): a queued waiter was admitted past the
+                    # drain check and WILL execute — teardown must wait
+                    # for it.
+                    with (
+                        self._drain.track()
+                        if self._drain is not None
+                        else nullcontext()
+                    ):
+                        async with (
+                            self._admission.admit(deadline, tenant=tctx)
+                            if self._admission is not None
+                            else nullcontext()
+                        ):
+                            yield deadline, sample
+                    if sample.ok is None:
+                        sample.ok = True
+                    label = "ok" if sample.ok else "error"
+                except AdmissionRejected as e:
+                    label = "shed"
+                    context.set_trailing_metadata(
+                        (("retry-after-s", f"{e.retry_after_s:g}"),)
+                    )
+                    await context.abort(
+                        grpc.StatusCode.RESOURCE_EXHAUSTED,
+                        f"service overloaded ({e.reason}); "
+                        f"retry in {e.retry_after_s:g}s",
+                    )
+                except DeadlineExceeded:
+                    sample.ok = False
+                    label = "deadline"
+                    if self._deadline_exceeded_total is not None:
+                        self._deadline_exceeded_total.inc(transport="grpc")
+                    await context.abort(
+                        grpc.StatusCode.DEADLINE_EXCEEDED,
+                        "request deadline exceeded",
+                    )
+                except BreakerOpenError as e:
+                    # Open breaker, no fallback: retryable overload, not an
+                    # internal error — UNAVAILABLE with the breaker's retry
+                    # hint.
+                    sample.ok = False
+                    label = "breaker_open"
+                    context.set_trailing_metadata(
+                        (("retry-after-s", f"{e.retry_after_s:g}"),)
+                    )
+                    await context.abort(
+                        grpc.StatusCode.UNAVAILABLE,
+                        "backend temporarily unavailable; "
+                        f"retry in {e.retry_after_s:g}s",
+                    )
+                except asyncio.CancelledError:
+                    raise  # client went away: sample.ok untouched (not a sample)
+                except _ABORT_ERRORS:
+                    sample.ok = True  # body aborted INVALID_ARGUMENT: client fault
+                    label = "client_error"
+                    raise
+                except BaseException:
+                    sample.ok = False  # unhandled → gRPC UNKNOWN
+                    label = "error"
+                    raise
+            finally:
+                if self._slo is not None and sample.ok is not None:
+                    record_sli(
+                        self._slo,
+                        ok=sample.ok,
+                        duration_s=time.monotonic() - slo_start,
+                        tenant=tctx.label if tctx is not None else None,
+                    )
+                if tctx is not None:
+                    # Mirror of the HTTP edge: every resolved RPC lands in
+                    # the tenant's usage meter with its outcome.
+                    tctx.record_request(label)
+                _annotate_outcome(label, sample.ok)
 
     async def _with_resilience(
         self,
@@ -1100,6 +1153,7 @@ class ObservabilityServicer:
         contprof=None,  # observability.ContinuousProfiler
         serving=None,  # observability.ServingMonitor
         autoscale=None,  # callable -> dict (resilience.autoscale_snapshot)
+        tenants=None,  # callable -> dict (tenancy.build_tenants_snapshot)
     ) -> None:
         self._slo = slo
         self._debug_bundle = debug_bundle
@@ -1108,12 +1162,28 @@ class ObservabilityServicer:
         self._contprof = contprof
         self._serving = serving
         self._autoscale = autoscale
+        self._tenants = tenants
 
     async def GetSlo(self, request: bytes, context) -> bytes:
-        snapshot = (
-            self._slo.snapshot() if self._slo is not None else empty_slo_snapshot()
-        )
-        return json.dumps(snapshot).encode()
+        """``GET /v1/slo`` twin; an optional JSON request ``{"tenant":
+        "alpha"}`` selects that tenant's SLO slice (docs/tenancy.md)."""
+        if self._slo is None:
+            return json.dumps(empty_slo_snapshot()).encode()
+        body = await self._parse_json_request(request, context)
+        tenant = body.get("tenant")
+        if tenant is not None:
+            return json.dumps(self._slo.tenant_snapshot(str(tenant))).encode()
+        return json.dumps(self._slo.snapshot()).encode()
+
+    async def GetTenants(self, request: bytes, context) -> bytes:
+        """Per-tenant isolation + billing view — the gRPC spelling of
+        ``GET /v1/tenants`` (docs/tenancy.md)."""
+        if self._tenants is None:
+            await context.abort(
+                grpc.StatusCode.UNIMPLEMENTED,
+                "no tenant registry wired into this server",
+            )
+        return json.dumps(self._tenants()).encode()
 
     async def GetAutoscale(self, request: bytes, context) -> bytes:
         """Capacity observability (docs/autoscaling.md) — the gRPC spelling
@@ -1150,6 +1220,7 @@ class ObservabilityServicer:
                 kind=body.get("kind"),
                 outcome=body.get("outcome"),
                 session=body.get("session"),
+                tenant=body.get("tenant"),
                 min_duration_ms=(
                     float(body["min_duration_ms"])
                     if body.get("min_duration_ms") is not None
@@ -1285,6 +1356,7 @@ _OBSERVABILITY_METHODS = (
     "GetPprof",
     "GetServing",
     "GetServingRequests",
+    "GetTenants",
 )
 
 
@@ -1562,6 +1634,7 @@ class GrpcServer:
         contprof=None,  # observability.ContinuousProfiler, likewise
         serving=None,  # observability.ServingMonitor, likewise
         autoscale=None,  # callable -> dict for GetAutoscale (docs/autoscaling.md)
+        tenancy=None,  # tenancy.TenantRegistry shared with the HTTP edge
     ) -> None:
         self._servicer = CodeInterpreterServicer(
             code_executor,
@@ -1574,6 +1647,18 @@ class GrpcServer:
             slo=slo,
             analyzer=analyzer,
             sessions=sessions,
+            tenancy=tenancy,
+        )
+        # GetTenants closure: built here so the HTTP and gRPC documents can
+        # never disagree (both call tenancy.build_tenants_snapshot).
+        self._tenants_snapshot = (
+            (
+                lambda: build_tenants_snapshot(
+                    tenancy, admission=admission, slo=slo, sessions=sessions
+                )
+            )
+            if tenancy is not None
+            else None
         )
         self._slo = slo
         self._debug_bundle = debug_bundle
@@ -1634,6 +1719,7 @@ class GrpcServer:
                         contprof=self._contprof,
                         serving=self._serving,
                         autoscale=self._autoscale,
+                        tenants=self._tenants_snapshot,
                     )
                 ),
                 _health_handler(self.health),

@@ -77,6 +77,7 @@ from bee_code_interpreter_tpu.observability import (
     inject_profile_env,
     parse_traceparent,
     profile_artifacts,
+    record_sli,
     record_usage_at_edge,
     register_stream_metrics,
     register_usage_metrics,
@@ -103,6 +104,13 @@ from bee_code_interpreter_tpu.services.custom_tool_executor import (
     CustomToolExecuteError,
     CustomToolExecutor,
     CustomToolParseError,
+)
+from bee_code_interpreter_tpu.tenancy import (
+    TENANT_HEADER,
+    bearer_token,
+    build_tenants_snapshot,
+    current_tenant_context,
+    tenant_scope,
 )
 from bee_code_interpreter_tpu.utils.metrics import (
     OPENMETRICS_CONTENT_TYPE,
@@ -139,6 +147,7 @@ def create_http_server(
     contprof=None,  # observability.ContinuousProfiler for GET /v1/debug/pprof
     serving=None,  # observability.ServingMonitor for GET /v1/serving
     autoscale=None,  # callable -> dict for GET /v1/autoscale (docs/autoscaling.md)
+    tenancy=None,  # tenancy.TenantRegistry: identity + GET /v1/tenants
 ) -> web.Application:
     app = web.Application(client_max_size=1 << 30)
     metrics = metrics or Registry()
@@ -210,6 +219,10 @@ def create_http_server(
         slo_start = time.monotonic()
         outcome: bool | None = None
         label = "cancelled"  # only a CancelledError leaves it unassigned
+        # The tenant the middleware resolved (docs/tenancy.md): its quotas
+        # apply at the admission gate, its SLO slice gets the sample, its
+        # usage meter gets the outcome.
+        tctx = current_tenant_context()
         try:
             try:
                 # track() covers the admission wait too: a request already
@@ -218,7 +231,7 @@ def create_http_server(
                 # must wait for it, not just for bodies already running.
                 with drain.track() if drain is not None else nullcontext():
                     async with (
-                        admission.admit(deadline)
+                        admission.admit(deadline, tenant=tctx)
                         if admission is not None
                         else nullcontext()
                     ):
@@ -239,8 +252,14 @@ def create_http_server(
             except AdmissionRejected as e:
                 label = "shed"
                 logger.warning("Request shed: %s", e)
+                # The reason in the body makes the verdict legible per
+                # tenant: "tenant_quota" is YOUR quota, "queue_full" is
+                # global overload (docs/tenancy.md).
                 return web.json_response(
-                    {"detail": "Service overloaded; retry later"},
+                    {
+                        "detail": f"Service overloaded ({e.reason}); retry later",
+                        "reason": e.reason,
+                    },
                     status=429,
                     headers=_retry_after_header(e),
                 )
@@ -274,7 +293,17 @@ def create_http_server(
                 raise
         finally:
             if slo is not None and outcome is not None:
-                slo.record(ok=outcome, duration_s=time.monotonic() - slo_start)
+                record_sli(
+                    slo,
+                    ok=outcome,
+                    duration_s=time.monotonic() - slo_start,
+                    tenant=tctx.label if tctx is not None else None,
+                )
+            if tctx is not None:
+                # Every resolved request lands in the tenant's usage meter
+                # with its outcome — sheds included, so /v1/tenants and the
+                # shed counters agree by construction.
+                tctx.record_request(label)
             _annotate_outcome(label, outcome)
 
     @web.middleware
@@ -306,17 +335,36 @@ def create_http_server(
             if traced
             else nullcontext()
         )
-        with trace_ctx:
-            with request_seconds.time(route=route):
-                try:
-                    response = await handler(request)
-                except web.HTTPException as e:
-                    requests_total.inc(route=route, status=str(e.status))
-                    e.headers.setdefault(REQUEST_ID_HEADER, rid)
-                    raise
-                except Exception:
-                    requests_total.inc(route=route, status="500")
-                    raise
+        # Tenant identity resolves HERE — once, for every route — into the
+        # ambient context every downstream layer reads (docs/tenancy.md).
+        # tenant_scope(None) when no registry is wired still clears any
+        # context a previous request on this keep-alive connection left.
+        tctx = None
+        if tenancy is not None:
+            tctx = tenancy.resolve(
+                request.headers.get(TENANT_HEADER),
+                bearer_token(request.headers.get("Authorization")),
+            )
+            if admission is not None and tctx.retry_budget is None:
+                tctx.retry_budget = admission.tenant_retry_budget(tctx)
+        with tenant_scope(tctx):
+            with trace_ctx:
+                if traced and tctx is not None:
+                    trace = current_trace()
+                    if trace is not None:
+                        # The root-span attribute the wide event lifts into
+                        # its first-class `tenant` field.
+                        trace.root.attributes["tenant"] = tctx.label
+                with request_seconds.time(route=route):
+                    try:
+                        response = await handler(request)
+                    except web.HTTPException as e:
+                        requests_total.inc(route=route, status=str(e.status))
+                        e.headers.setdefault(REQUEST_ID_HEADER, rid)
+                        raise
+                    except Exception:
+                        requests_total.inc(route=route, status="500")
+                        raise
         requests_total.inc(route=route, status=str(response.status))
         response.headers.setdefault(REQUEST_ID_HEADER, rid)
         return response
@@ -1042,9 +1090,28 @@ def create_http_server(
             },
         )
 
-    async def slo_endpoint(_request: web.Request) -> web.Response:
+    async def slo_endpoint(request: web.Request) -> web.Response:
+        if slo is None:
+            return web.json_response(empty_slo_snapshot())
+        tenant = request.query.get("tenant")
+        if tenant is not None:
+            # One tenant's SLO slice (docs/tenancy.md "SLO slices").
+            return web.json_response(slo.tenant_snapshot(tenant))
+        return web.json_response(slo.snapshot())
+
+    async def tenants_endpoint(_request: web.Request) -> web.Response:
+        """Per-tenant isolation + billing view (docs/tenancy.md): declared
+        quotas, live admission state, usage metering, SLO-slice burn, and
+        session counts — the blast-radius accounting surface."""
+        if tenancy is None:
+            return web.json_response(
+                {"detail": "no tenant registry wired into this server"},
+                status=501,
+            )
         return web.json_response(
-            slo.snapshot() if slo is not None else empty_slo_snapshot()
+            build_tenants_snapshot(
+                tenancy, admission=admission, slo=slo, sessions=sessions
+            )
         )
 
     async def autoscale_endpoint(_request: web.Request) -> web.Response:
@@ -1156,6 +1223,7 @@ def create_http_server(
             "kind": query.get("kind"),
             "outcome": query.get("outcome"),
             "session": query.get("session"),
+            "tenant": query.get("tenant"),
             "min_duration_ms": min_duration_ms,
             "since": since,
         }
@@ -1300,6 +1368,11 @@ def create_http_server(
             # sees what KIND of work each replica has been absorbing, not
             # just how much.
             snap["cost_classes"] = dict(analyzer.cost_class_counts)
+        if tenancy is not None:
+            # Tenant mix (docs/tenancy.md): per-tenant request totals, so
+            # a fleet router can place by WHO is sending, not just how
+            # much is arriving.
+            snap["tenants"] = tenancy.mix()
         return web.json_response(snap)
 
     async def fleet_events(request: web.Request) -> web.Response:
@@ -1332,6 +1405,7 @@ def create_http_server(
     app.router.add_get("/v1/fleet", fleet_snapshot)
     app.router.add_get("/v1/fleet/events", fleet_events)
     app.router.add_get("/v1/slo", slo_endpoint)
+    app.router.add_get("/v1/tenants", tenants_endpoint)
     app.router.add_get("/v1/autoscale", autoscale_endpoint)
     app.router.add_get("/v1/serving", serving_snapshot)
     app.router.add_get("/v1/serving/requests", serving_requests)

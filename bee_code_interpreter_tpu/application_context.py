@@ -42,6 +42,20 @@ class ApplicationContext:
         logging.config.dictConfig(self.config.resolved_logging_config())
         install_request_id_filter()
         self.metrics = Registry()
+        # Tenant-label cardinality bound (docs/tenancy.md "Cardinality"):
+        # applied before any tenant-labeled metric registers.
+        self.metrics.bound_label(
+            "tenant", self.config.metrics_max_tenant_labels
+        )
+        # Tenant table + usage meter shared by both edges (docs/tenancy.md).
+        # Always constructed: with no APP_TENANTS declared, every request
+        # shares one unlimited `default` tenant and behavior is unchanged —
+        # but the bci_tenant_* surface exists from first scrape.
+        from bee_code_interpreter_tpu.tenancy import TenantRegistry
+
+        self.tenancy = TenantRegistry.from_config(
+            self.config, metrics=self.metrics
+        )
         # One tracer + retention store shared by both transports: a trace is
         # a service-level object, whichever edge rooted it.
         self.trace_store = TraceStore(
@@ -85,6 +99,9 @@ class ApplicationContext:
             ),
             metrics=self.metrics,
             bucket_s=self.config.slo_window_bucket_s,
+            # Per-tenant SLO slices share the tenant-label bound the
+            # registry and usage meter use (docs/tenancy.md "Cardinality").
+            max_tenants=self.config.metrics_max_tenant_labels,
         )
         # Flight recorder (docs/observability.md "Flight recorder"): ONE
         # canonical wide event per execution / session op / stream / loop
@@ -245,6 +262,7 @@ class ApplicationContext:
             contprof=self.contprof,
             serving=self.serving,
             autoscale=self.autoscale_snapshot,
+            tenancy=self.tenancy,
         )
 
     @cached_property
@@ -411,6 +429,9 @@ class ApplicationContext:
             # Opt-in: the analyzer's cost_class hint bounds heavy work
             # (docs/analysis.md "Cost classes").
             cost_aware=self.config.admission_cost_aware,
+            # Per-tenant WFQ + quotas (docs/tenancy.md): with no tenant
+            # table declared this is one unlimited default lane.
+            tenancy=self.tenancy,
         )
 
     def _build_local_executor(self):
@@ -538,6 +559,7 @@ class ApplicationContext:
             serving=self.serving,
             profiler=self.serving_profiler,
             autoscale=self.autoscale_snapshot,
+            tenancy=self.tenancy,
         )
 
     @cached_property
@@ -565,4 +587,5 @@ class ApplicationContext:
             contprof=self.contprof,
             serving=self.serving,
             autoscale=self.autoscale_snapshot,
+            tenancy=self.tenancy,
         )

@@ -258,6 +258,22 @@ class Config(BaseModel):
     demand_ewma_alpha: float = Field(default=0.4, gt=0, le=1)
     demand_trend_beta: float = Field(default=0.2, ge=0, le=1)
 
+    # --- tenancy (new; see docs/tenancy.md) ---
+    # The tenant table: comma-separated "name[:key=value]..." entries, e.g.
+    # APP_TENANTS="alpha:weight=4:max_in_flight=8:rps=20,beta:weight=1:rps=5".
+    # Keys: weight (WFQ share), max_in_flight, rps, burst, sessions
+    # (per-tenant lease cap), key (API key for Authorization: Bearer). A
+    # "default" entry customizes the catch-all lane every unknown or
+    # anonymous request shares; unset leaves one unlimited default tenant —
+    # identical behavior to the pre-tenancy service.
+    tenants: str | None = None
+    # Bounded tenant-label cardinality: at most this many distinct tenant
+    # labels on /metrics, in the SLO slices, and in the usage meter before
+    # further ids collapse into "other" (overflow counted in
+    # bci_metrics_label_overflow_total) — a tenant-id flood can widen one
+    # bucket, never OOM the exposition.
+    metrics_max_tenant_labels: int = Field(default=32, ge=1)
+
     # --- sessions: leased sandboxes + streaming (new; see docs/sessions.md) ---
     # Hard cap on concurrent session leases. Each lease pins one warm
     # sandbox the stateless pool cannot serve with, so this bounds how much

@@ -83,6 +83,9 @@ class Replica:
     live_pods: int = 0
     ready_pods: int = 0
     leases: int = 0
+    # Tenant mix off /v1/fleet (docs/tenancy.md): per-tenant request totals
+    # this replica has absorbed — the signal tenant-aware placement reads.
+    tenants: dict = field(default_factory=dict)
     draining: bool = False  # the replica says so (/v1/fleet "draining")
     cordoned: bool = False  # the ROUTER says so (drain_replica)
     slo_fast_burn: bool = False
@@ -116,6 +119,7 @@ class Replica:
             "live_pods": self.live_pods,
             "ready_pods": self.ready_pods,
             "leases": self.leases,
+            "tenants": dict(self.tenants),
             "slo_fast_burn": self.slo_fast_burn,
             "breaker": self.breaker.state.name.lower(),
             "ring_share": ring_share,
@@ -472,6 +476,7 @@ class FleetRouter:
         replica.draining = bool(fleet.get("draining"))
         sessions = fleet.get("sessions") or {}
         replica.leases = int(sessions.get("active") or 0)
+        replica.tenants = dict(fleet.get("tenants") or {})
         replica.slo_fast_burn = bool(slo.get("fast_burn_alerting"))
         replica.last_refresh_mono = self._clock()
         replica.refresh_error = None

@@ -90,6 +90,7 @@ from bee_code_interpreter_tpu.observability.slo import (  # noqa: E402
     SloEngine,
     empty_slo_snapshot,
     parse_objectives,
+    record_sli,
 )
 
 __all__ = [
@@ -130,6 +131,7 @@ __all__ = [
     "inject_profile_env",
     "merge_worker_usage",
     "profile_artifacts",
+    "record_sli",
     "record_transfer",
     "record_usage_at_edge",
     "register_usage_metrics",

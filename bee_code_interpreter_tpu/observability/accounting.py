@@ -204,10 +204,15 @@ def register_usage_metrics(metrics):
 
 def record_usage_at_edge(usage: dict | None, trace, cpu_hist, rss_hist) -> None:
     """Land one execution's ``usage`` block at the edge: observe the cost
-    histograms and mirror the figures onto the request's root span so the
-    trace view and the response body report identical numbers."""
+    histograms, mirror the figures onto the request's root span so the
+    trace view and the response body report identical numbers, and meter
+    them into the ambient tenant's usage rollup (docs/tenancy.md) — one
+    call site for all three, so they can never disagree."""
     if not usage:
         return
+    from bee_code_interpreter_tpu.tenancy.context import meter_ambient_usage
+
+    meter_ambient_usage(usage)
     if cpu_hist is not None and (
         "cpu_user_s" in usage or "cpu_system_s" in usage
     ):

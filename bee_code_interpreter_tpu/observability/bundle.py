@@ -61,6 +61,7 @@ def build_debug_bundle(
     contprof=None,
     serving=None,
     autoscale=None,  # callable -> dict (resilience.autoscale_snapshot)
+    tenancy=None,  # tenancy.TenantRegistry (per-tenant view in the bundle)
     recent_traces: int = 50,
     slowest_traces: int = 10,
     fleet_events: int = 100,
@@ -138,6 +139,16 @@ def build_debug_bundle(
     # the autoscaler's target + decision log — the "was the pool sized for
     # this" context every capacity incident needs.
     bundle["autoscale"] = autoscale() if autoscale is not None else None
+
+    # Multi-tenant view (docs/tenancy.md): who has been spending what —
+    # the declared table, usage rollups, and per-tenant SLO burn, so a
+    # noisy-neighbor incident reads from the same one call.
+    if tenancy is not None:
+        from bee_code_interpreter_tpu.tenancy import build_tenants_snapshot
+
+        bundle["tenants"] = build_tenants_snapshot(tenancy, slo=slo)
+    else:
+        bundle["tenants"] = None
 
     bundle["config"] = config.redacted_dump() if config is not None else None
     bundle["metrics"] = metrics.expose() if metrics is not None else None

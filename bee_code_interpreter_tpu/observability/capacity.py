@@ -79,6 +79,11 @@ class DemandTracker:
         self._last_arrival_mono: float | None = None
         self.arrivals_total = 0
         self.sheds_total = 0
+        # Lifetime per-tenant demand (docs/tenancy.md): labels arrive
+        # already bounded (the registry collapses unknown ids), so these
+        # maps cannot grow past the tenant-label cap.
+        self.arrivals_by_tenant: dict[str, int] = {}
+        self.sheds_by_tenant: dict[str, int] = {}
         if metrics is not None:
             metrics.gauge(
                 "bci_demand_rps",
@@ -107,16 +112,25 @@ class DemandTracker:
         for idx in [i for i in self._buckets if i < horizon]:
             del self._buckets[idx]
 
-    def record_arrival(self) -> None:
+    def record_arrival(self, tenant: str | None = None) -> None:
         """One sandbox-bound request reached the admission gate (either
-        edge; shed or admitted, it is demand either way)."""
+        edge; shed or admitted, it is demand either way). ``tenant`` is the
+        bounded-cardinality tenant label when the edge resolved one."""
         self._bucket().arrivals += 1
         self.arrivals_total += 1
+        if tenant is not None:
+            self.arrivals_by_tenant[tenant] = (
+                self.arrivals_by_tenant.get(tenant, 0) + 1
+            )
         self._last_arrival_mono = self._clock()
 
-    def record_shed(self) -> None:
+    def record_shed(self, tenant: str | None = None) -> None:
         self._bucket().sheds += 1
         self.sheds_total += 1
+        if tenant is not None:
+            self.sheds_by_tenant[tenant] = (
+                self.sheds_by_tenant.get(tenant, 0) + 1
+            )
 
     def record_admitted(self, queue_wait_s: float, in_flight: int) -> None:
         """One request got past the gate after ``queue_wait_s`` in the
@@ -242,5 +256,12 @@ class DemandTracker:
             "last_arrival_age_s": self.last_arrival_age_s(),
             "arrivals_total": self.arrivals_total,
             "sheds_total": self.sheds_total,
+            "by_tenant": {
+                tenant: {
+                    "arrivals": arrivals,
+                    "sheds": self.sheds_by_tenant.get(tenant, 0),
+                }
+                for tenant, arrivals in sorted(self.arrivals_by_tenant.items())
+            },
             "window_s": self._window_s,
         }

@@ -45,7 +45,7 @@ logger = logging.getLogger(__name__)
 # Keys the edges stamp on the root span that the wide event lifts into
 # first-class fields (everything else stays under "attributes").
 _LIFTED_ROOT_KEYS = frozenset(
-    {"outcome", "sli", "session", "replays", "hedge"}
+    {"outcome", "sli", "session", "replays", "hedge", "tenant"}
 )
 _SEGMENT_PREFIX = "events-"
 _SEGMENT_SUFFIX = ".ndjson"
@@ -83,6 +83,7 @@ def event_matches(
     kind: str | None = None,
     outcome: str | None = None,
     session: str | None = None,
+    tenant: str | None = None,
     min_duration_ms: float | None = None,
     since: float | None = None,
 ) -> bool:
@@ -93,6 +94,8 @@ def event_matches(
     if outcome is not None and event.get("outcome") != outcome:
         return False
     if session is not None and event.get("session") != session:
+        return False
+    if tenant is not None and event.get("tenant") != tenant:
         return False
     if min_duration_ms is not None:
         duration = event.get("duration_ms")
@@ -142,6 +145,7 @@ def wide_event_from_trace(trace) -> dict:
         ),
         "timings_ms": trace.stage_ms(),
         "session": attrs.get("session"),
+        "tenant": attrs.get("tenant"),
         "sli": attrs.get("sli"),
         "replays": int(_float_or_none(attrs.get("replays", 0)) or 0),
         "hedge": attrs.get("hedge"),
@@ -258,6 +262,7 @@ class FlightRecorder:
         kind: str | None = None,
         outcome: str | None = None,
         session: str | None = None,
+        tenant: str | None = None,
         min_duration_ms: float | None = None,
         since: float | None = None,
         limit: int | None = None,
@@ -276,6 +281,7 @@ class FlightRecorder:
                 kind=kind,
                 outcome=outcome,
                 session=session,
+                tenant=tenant,
                 min_duration_ms=min_duration_ms,
                 since=since,
             ):

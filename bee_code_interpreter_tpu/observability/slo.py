@@ -118,6 +118,16 @@ def empty_slo_snapshot() -> dict:
     return {"objectives": [], "alerting": False, "fast_burn_alerting": False}
 
 
+def record_sli(engine, ok: bool, duration_s: float, tenant: str | None) -> None:
+    """The one edge-side spelling of SLI recording: pass ``tenant`` only
+    when one was resolved, so engine doubles without the kwarg (test
+    spies, older engines) keep working on tenancy-less servers."""
+    if tenant is not None:
+        engine.record(ok=ok, duration_s=duration_s, tenant=tenant)
+    else:
+        engine.record(ok=ok, duration_s=duration_s)
+
+
 class _Bucket:
     __slots__ = ("total", "errors", "ok_total", "slow")
 
@@ -138,6 +148,7 @@ class SloEngine:
         metrics=None,
         clock=time.monotonic,
         bucket_s: float = 10.0,
+        max_tenants: int = 32,
     ) -> None:
         self._objectives = list(objectives)
         self._latency = [o for o in self._objectives if o.kind == "latency"]
@@ -146,6 +157,12 @@ class SloEngine:
         self._bucket_s = bucket_s
         self._retention_s = max(WINDOWS.values())
         self._buckets: dict[int, _Bucket] = {}
+        # Per-tenant SLO slices (docs/tenancy.md): one child engine per
+        # tenant label, same objectives/clock/buckets, bounded to
+        # max_tenants (overflow collapses into "other"). Metric-less:
+        # per-tenant burn is served by /v1/slo?tenant= and /v1/tenants.
+        self._max_tenants = max(1, max_tenants)
+        self._tenants: dict[str, SloEngine] = {}
         if metrics is not None and self._objectives:
             for objective in self._objectives:
                 metrics.gauge(
@@ -173,12 +190,19 @@ class SloEngine:
 
     # ------------------------------------------------------------- recording
 
-    def record(self, ok: bool, duration_s: float) -> None:
+    def record(
+        self, ok: bool, duration_s: float, tenant: str | None = None
+    ) -> None:
         """One request outcome. ``ok=False`` burns availability budget;
         slow-but-successful requests burn latency budget. Callers simply do
-        not call this for excluded outcomes (shed/drain/cancel)."""
+        not call this for excluded outcomes (shed/drain/cancel). With a
+        ``tenant`` label the sample ALSO lands in that tenant's SLO slice,
+        so one tenant's failures burn its own budget visibly — the global
+        number still aggregates everyone."""
         if not self._objectives:
             return
+        if tenant is not None:
+            self._tenant_engine(tenant).record(ok, duration_s)
         idx = int(self._clock() // self._bucket_s)
         bucket = self._buckets.get(idx)
         if bucket is None:
@@ -192,6 +216,19 @@ class SloEngine:
                     bucket.slow[i] += 1
         else:
             bucket.errors += 1
+
+    def _tenant_engine(self, tenant: str) -> "SloEngine":
+        engine = self._tenants.get(tenant)
+        if engine is None:
+            if len(self._tenants) >= self._max_tenants and tenant != "other":
+                return self._tenant_engine("other")
+            engine = self._tenants[tenant] = SloEngine(
+                self._objectives,
+                clock=self._clock,
+                bucket_s=self._bucket_s,
+                max_tenants=1,
+            )
+        return engine
 
     def _prune(self, now_idx: int) -> None:
         horizon = now_idx - int(self._retention_s // self._bucket_s) - 1
@@ -295,8 +332,42 @@ class SloEngine:
                     "alerts": alerts,
                 }
             )
-        return {
+        out = {
             "objectives": objectives,
             "alerting": alerting,
             "fast_burn_alerting": fast_burn,
         }
+        if self._tenants:
+            out["tenants"] = self.tenant_summaries()
+        return out
+
+    # ------------------------------------------------------- tenant slices
+
+    def tenant_snapshot(self, tenant: str) -> dict:
+        """One tenant's full SLO slice (``GET /v1/slo?tenant=``); honestly
+        empty for a tenant with no recorded samples."""
+        engine = self._tenants.get(tenant)
+        if engine is None:
+            return empty_slo_snapshot()
+        return engine.snapshot()
+
+    def tenant_summaries(self) -> dict[str, dict]:
+        """Per-tenant burn rollup for ``/v1/tenants`` and the global
+        snapshot: budget remaining + whether that tenant's own alert pairs
+        fire — a noisy neighbor burning ITS slice shows here while the
+        victims' rows stay quiet."""
+        out: dict[str, dict] = {}
+        for label in sorted(self._tenants):
+            snap = self._tenants[label].snapshot()
+            out[label] = {
+                "alerting": snap["alerting"],
+                "fast_burn_alerting": snap["fast_burn_alerting"],
+                "error_budget_remaining_ratio": min(
+                    (
+                        o["error_budget_remaining_ratio"]
+                        for o in snap["objectives"]
+                    ),
+                    default=1.0,
+                ),
+            }
+        return out

@@ -1,4 +1,5 @@
-"""Admission control: bounded in-flight work + bounded, deadline-aware queue.
+"""Admission control: bounded in-flight work + bounded, deadline-aware queue,
+weighted-fair across tenants.
 
 The seed accepted every request and let them pile up inside the executor
 (unbounded queueing → every client times out). This gate enforces the
@@ -12,9 +13,34 @@ standard load-shedding contract instead:
   retry-after hint. The HTTP edge maps this to 429 + ``Retry-After``; the
   gRPC edge to ``RESOURCE_EXHAUSTED``. Nothing ever hangs.
 
-Slot handoff is direct: a releasing request transfers its slot to the oldest
-live waiter without decrementing the in-flight count, so a burst can never
-overshoot ``max_in_flight``.
+Multi-tenant fairness (docs/tenancy.md): when the edges resolve a
+:class:`~..tenancy.TenantContext`, the single FIFO becomes per-tenant FIFOs
+scheduled by deficit round-robin weighted by each tenant's configured
+``weight`` — under saturation, grants track weights instead of arrival
+order, so one hot tenant can no longer monopolize the queue. On top of the
+fair scheduler each tenant gets its own quotas:
+
+- a **token-bucket rate quota** (``rps``/``burst``): excess arrivals shed
+  as ``reason="tenant_quota"`` with a Retry-After naming when the next
+  token lands — a per-tenant verdict, not a global one;
+- a **concurrency cap** (``max_in_flight``): requests over it queue in the
+  tenant's own FIFO (never another tenant's share) until a slot frees;
+- a **queue share**: each tenant may occupy at most its weight-proportional
+  slice of ``max_queue`` (shed ``tenant_quota`` past it), so a flood can
+  fill its own slice but never the whole queue;
+- a **retry budget**: tenants with a rate quota get a matching retry
+  token bucket (~10% of quota); the resilience retry loop consults it via
+  the ambient tenant context and fails fast when it is spent.
+
+The global bounds still cap aggregate load; with no tenant table declared
+every request shares one unlimited ``default`` lane and behavior is
+identical to the pre-tenancy gate.
+
+Slot accounting is exact: a grant increments both the global and the lane
+in-flight counts before the waiter resumes, so a burst can never overshoot
+``max_in_flight``; a waiter abandoned after winning the grant race returns
+the slot through the same ``_release`` path, and its demand-tracker sample
+is a single shed — never shed *and* admitted.
 
 Cost-aware mode (``APP_ADMISSION_COST_AWARE``, default off): the edge
 analyzer's ``cost_class`` hint (docs/analysis.md "Cost classes") becomes a
@@ -22,8 +48,10 @@ priority signal — executions classified ``io_heavy``/``install_heavy``
 additionally pass :meth:`AdmissionController.heavy_lane`, a bounded
 secondary gate (half of ``max_in_flight``), after analysis and before the
 sandbox is touched. A saturated heavy lane sheds immediately
-(``reason="heavy_lane"``) instead of letting a burst of slow expensive work
-occupy every slot cheap interactive turns need.
+(``reason="heavy_lane"``). Independently of that gate, a heavy-classified
+execution debits its tenant's WFQ deficit by one extra unit — heavy work
+costs double the fair-share credit, generalizing the serving engine's
+priority classes to the executor pool (tenant weight × cost class).
 """
 
 from __future__ import annotations
@@ -34,10 +62,28 @@ from collections import deque
 from contextlib import asynccontextmanager
 
 from bee_code_interpreter_tpu.observability import span as trace_span
+from bee_code_interpreter_tpu.tenancy.context import current_tenant_context
+from bee_code_interpreter_tpu.tenancy.registry import Tenant
 
 # Mirror of analysis.policy.HEAVY_COST_CLASSES, spelled here so resilience/
 # never imports the analysis layer (the hint arrives as a plain string).
 _HEAVY_COST_CLASSES = frozenset({"io_heavy", "install_heavy"})
+
+# DRR bookkeeping: every admitted request costs one unit of its lane's
+# deficit; a visit tops each eligible lane up by its weight, so grant
+# ratios converge to weight ratios under sustained backlog. Heavy-classed
+# work debits one extra unit (docs/tenancy.md "Cost classes").
+_REQUEST_COST = 1.0
+_HEAVY_EXTRA_COST = 1.0
+# A lane may bank at most this many top-up rounds of credit (and never
+# less than one request's cost), bounding post-idle bursts.
+_DEFICIT_CAP_ROUNDS = 4.0
+
+# Retry budget (docs/tenancy.md "Retry budgets"): tenants with a rate
+# quota may retry at ~10% of it, bucket depth 10.
+_RETRY_BUDGET_RATIO = 0.1
+_RETRY_BUDGET_MIN_RATE = 0.1
+_RETRY_BUDGET_BURST = 10.0
 
 
 class AdmissionRejected(Exception):
@@ -45,6 +91,42 @@ class AdmissionRejected(Exception):
         super().__init__(f"request shed: {reason} (retry in {retry_after_s:.1f}s)")
         self.reason = reason
         self.retry_after_s = max(0.0, retry_after_s)
+
+
+class _TenantLane:
+    """One tenant's admission state: FIFO, in-flight count, DRR deficit,
+    and the rate/retry token buckets."""
+
+    __slots__ = (
+        "tenant",
+        "label",
+        "waiters",
+        "in_flight",
+        "deficit",
+        "tokens",
+        "tokens_mono",
+        "retry_tokens",
+        "retry_mono",
+        "admitted",
+        "sheds",
+        "retries_denied",
+        "queue_wait_sum_s",
+    )
+
+    def __init__(self, tenant: Tenant, now: float) -> None:
+        self.tenant = tenant
+        self.label = tenant.id
+        self.waiters: deque[asyncio.Future] = deque()
+        self.in_flight = 0
+        self.deficit = 0.0
+        self.tokens = tenant.burst_depth
+        self.tokens_mono = now
+        self.retry_tokens = _RETRY_BUDGET_BURST
+        self.retry_mono = now
+        self.admitted = 0
+        self.sheds: dict[str, int] = {}
+        self.retries_denied = 0
+        self.queue_wait_sum_s = 0.0
 
 
 class AdmissionController:
@@ -58,12 +140,15 @@ class AdmissionController:
         demand=None,  # observability.DemandTracker (capacity telemetry)
         cost_aware: bool = False,
         heavy_max_in_flight: int | None = None,
+        tenancy=None,  # tenancy.TenantRegistry (per-tenant quotas + WFQ)
+        clock=time.monotonic,  # injectable for the token buckets
     ) -> None:
         self._max_in_flight = max(1, max_in_flight)
         self._max_queue = max(0, max_queue)
         self._default_wait_s = default_wait_s
         self._retry_after_s = retry_after_s
         self._in_flight = 0
+        self._queued = 0
         self._cost_aware = cost_aware
         self._heavy_max = (
             heavy_max_in_flight
@@ -71,20 +156,40 @@ class AdmissionController:
             else max(1, self._max_in_flight // 2)
         )
         self._heavy_in_flight = 0
+        self._tenancy = tenancy
+        self._clock = clock
+        self._lanes: dict[str, _TenantLane] = {}
+        self._rr_cursor: str | None = None
         # The gate is the ONE chokepoint every sandbox-bound request on
         # either transport passes, which makes it the natural demand
         # sensor: arrivals, sheds, queue waits, and the in-flight
         # high-water feed the capacity tracker here (docs/autoscaling.md).
         self._demand = demand
-        self._waiters: deque[asyncio.Future] = deque()
+        self._metrics = metrics
         self._shed_total = None
         self._admitted_total = None
+        self._tenant_shed_total = None
+        self._tenant_admitted_total = None
+        self._tenant_queue_wait_seconds = None
         if metrics is not None:
             self._shed_total = metrics.counter(
                 "bci_admission_shed_total", "Requests shed by admission control"
             )
             self._admitted_total = metrics.counter(
                 "bci_admission_admitted_total", "Requests admitted past the gate"
+            )
+            self._tenant_shed_total = metrics.counter(
+                "bci_tenant_shed_total",
+                "Requests shed per tenant, by reason (tenant_quota/queue_full/"
+                "queue_timeout/heavy_lane)",
+            )
+            self._tenant_admitted_total = metrics.counter(
+                "bci_tenant_admitted_total",
+                "Requests admitted past the gate, per tenant",
+            )
+            self._tenant_queue_wait_seconds = metrics.histogram(
+                "bci_tenant_queue_wait_seconds",
+                "Admission queue wait per tenant (admitted requests)",
             )
             metrics.gauge(
                 "bci_admission_in_flight",
@@ -94,13 +199,21 @@ class AdmissionController:
             metrics.gauge(
                 "bci_admission_queue_depth",
                 "Requests waiting in the admission queue",
-                lambda: len(self._waiters),
+                lambda: self._queued,
             )
             metrics.gauge(
                 "bci_admission_heavy_in_flight",
                 "Cost-classified heavy executions currently in the heavy lane",
                 lambda: self._heavy_in_flight,
             )
+        # The default lane exists from construction: its per-tenant gauges
+        # must be scrapable before the first request arrives.
+        self._lane(self._default_tenant())
+
+    def _default_tenant(self) -> Tenant:
+        if self._tenancy is not None:
+            return self._tenancy.default
+        return Tenant(id="default")
 
     @property
     def in_flight(self) -> int:
@@ -108,105 +221,362 @@ class AdmissionController:
 
     @property
     def queue_depth(self) -> int:
-        return len(self._waiters)
+        return self._queued
 
     @property
     def heavy_in_flight(self) -> int:
         return self._heavy_in_flight
 
+    # ---------------------------------------------------------------- lanes
+
+    def _lane(self, tenant: Tenant) -> _TenantLane:
+        lane = self._lanes.get(tenant.id)
+        if lane is None:
+            lane = self._lanes[tenant.id] = _TenantLane(tenant, self._clock())
+            if self._metrics is not None:
+                self._metrics.gauge(
+                    "bci_tenant_in_flight",
+                    "Requests currently executing past admission, per tenant",
+                    (lambda l: lambda: l.in_flight)(lane),
+                    tenant=lane.label,
+                )
+                self._metrics.gauge(
+                    "bci_tenant_queue_depth",
+                    "Requests waiting in the admission queue, per tenant",
+                    (lambda l: lambda: len(l.waiters))(lane),
+                    tenant=lane.label,
+                )
+        return lane
+
+    def _lane_for(self, tenant) -> _TenantLane:
+        """The lane a request belongs to. ``tenant`` may be a
+        ``TenantContext``, a ``Tenant``, or None (→ the default lane);
+        unknown ids already resolved to the default tenant at the edge, so
+        they share its lane and quotas."""
+        resolved = getattr(tenant, "tenant", tenant)
+        if resolved is None:
+            resolved = self._default_tenant()
+        return self._lane(resolved)
+
+    def _ambient_lane(self) -> _TenantLane | None:
+        ctx = current_tenant_context()
+        return None if ctx is None else self._lane_for(ctx)
+
+    def _lane_queue_cap(self, lane: _TenantLane) -> int:
+        """A tenant's slice of the global queue, proportional to weight —
+        one flooding tenant can fill its slice, never the whole queue. A
+        single-lane (tenancy-less) gate keeps the full queue."""
+        if self._tenancy is None:
+            return self._max_queue
+        tenants = self._tenancy.tenants()
+        if len(tenants) <= 1:
+            return self._max_queue
+        total_weight = sum(t.weight for t in tenants)
+        share = self._max_queue * lane.tenant.weight / total_weight
+        return max(1, int(share))
+
+    # ----------------------------------------------------------- heavy lane
+
     @asynccontextmanager
     async def heavy_lane(self, cost_class: str | None):
         """The cost-aware secondary gate (docs/analysis.md "Cost classes").
 
-        A no-op unless cost-aware mode is on AND the edge analyzer
-        classified this execution heavy (io_heavy/install_heavy). It runs
-        AFTER :meth:`admit` (analysis needs the request body, which is only
-        read once admitted), so a heavy-lane shed releases an admission
-        slot immediately — the bounded cost of classifying is one queue
-        check, never a sandbox checkout."""
-        if not self._cost_aware or cost_class not in _HEAVY_COST_CLASSES:
+        The bounded-lane half is a no-op unless cost-aware mode is on AND
+        the edge analyzer classified this execution heavy (io_heavy/
+        install_heavy). It runs AFTER :meth:`admit` (analysis needs the
+        request body, which is only read once admitted), so a heavy-lane
+        shed releases an admission slot immediately — the bounded cost of
+        classifying is one queue check, never a sandbox checkout.
+
+        Independently of the gate, a heavy classification debits the
+        ambient tenant's WFQ deficit (tenant weight × cost class): under
+        saturation a tenant spending heavy requests earns fewer grants."""
+        heavy = cost_class in _HEAVY_COST_CLASSES
+        if heavy:
+            lane = self._ambient_lane()
+            if lane is not None:
+                floor = -lane.tenant.weight * _DEFICIT_CAP_ROUNDS
+                lane.deficit = max(floor, lane.deficit - _HEAVY_EXTRA_COST)
+        if not self._cost_aware or not heavy:
             yield
             return
         if self._heavy_in_flight >= self._heavy_max:
-            self._shed("heavy_lane")
+            self._shed("heavy_lane", self._ambient_lane())
         self._heavy_in_flight += 1
         try:
             yield
         finally:
             self._heavy_in_flight -= 1
 
-    def _shed(self, reason: str) -> None:
+    # ----------------------------------------------------------------- shed
+
+    def _shed(
+        self,
+        reason: str,
+        lane: _TenantLane | None = None,
+        retry_after_s: float | None = None,
+    ) -> None:
         if self._shed_total is not None:
             self._shed_total.inc(reason=reason)
+        if lane is not None:
+            lane.sheds[reason] = lane.sheds.get(reason, 0) + 1
+            if self._tenant_shed_total is not None:
+                self._tenant_shed_total.inc(tenant=lane.label, reason=reason)
         if self._demand is not None:
-            self._demand.record_shed()
-        raise AdmissionRejected(reason, self._retry_after_s)
+            self._demand.record_shed(
+                tenant=lane.label if lane is not None else None
+            )
+        raise AdmissionRejected(
+            reason,
+            retry_after_s if retry_after_s is not None else self._retry_after_s,
+        )
+
+    # ---------------------------------------------------------------- admit
 
     @asynccontextmanager
-    async def admit(self, deadline=None):
+    async def admit(self, deadline=None, tenant=None):
         # The trace stage span covers ONLY the acquire (the queue wait a
         # slow request may have paid); the admitted body's time belongs to
         # its own stages. One instrumentation site serves every edge.
+        lane = self._lane_for(tenant)
         if self._demand is not None:
-            self._demand.record_arrival()
+            self._demand.record_arrival(tenant=lane.label)
         wait_start = time.monotonic()
         with trace_span("admission"):
-            await self._acquire(deadline)
+            await self._acquire(deadline, lane)
+        queue_wait_s = time.monotonic() - wait_start
+        lane.queue_wait_sum_s += queue_wait_s
+        if self._tenant_queue_wait_seconds is not None:
+            self._tenant_queue_wait_seconds.observe(
+                queue_wait_s, tenant=lane.label
+            )
         if self._demand is not None:
             self._demand.record_admitted(
-                queue_wait_s=time.monotonic() - wait_start,
+                queue_wait_s=queue_wait_s,
                 in_flight=self._in_flight,
             )
         try:
             yield
         finally:
-            self._release()
+            self._release(lane)
 
-    async def _acquire(self, deadline) -> None:
-        if self._in_flight < self._max_in_flight and not self._waiters:
-            self._in_flight += 1
-            self._admitted()
+    def _refill_tokens(self, lane: _TenantLane) -> None:
+        rps = lane.tenant.rps
+        if rps is None:
             return
-        if len(self._waiters) >= self._max_queue:
-            self._shed("queue_full")
+        now = self._clock()
+        lane.tokens = min(
+            lane.tenant.burst_depth,
+            lane.tokens + (now - lane.tokens_mono) * rps,
+        )
+        lane.tokens_mono = now
+
+    async def _acquire(self, deadline, lane: _TenantLane) -> None:
+        tenant = lane.tenant
+        # 1. Rate quota: a per-tenant verdict, charged at arrival. The
+        # Retry-After names when the next token lands, not a global hint.
+        if tenant.rps is not None:
+            self._refill_tokens(lane)
+            if lane.tokens < 1.0:
+                self._shed(
+                    "tenant_quota",
+                    lane,
+                    retry_after_s=(1.0 - lane.tokens) / tenant.rps,
+                )
+            lane.tokens -= 1.0
+        # 2. Uncontended fast path: free global slot, empty queue, tenant
+        # under its concurrency cap.
+        cap = tenant.max_in_flight
+        if (
+            self._in_flight < self._max_in_flight
+            and self._queued == 0
+            and (cap is None or lane.in_flight < cap)
+        ):
+            self._grant(lane)
+            self._admitted(lane)
+            return
+        # 3. Queue bounds: the global bound first (aggregate protection),
+        # then the tenant's weight-proportional slice (per-tenant verdict).
+        if self._queued >= self._max_queue:
+            self._shed("queue_full", lane)
+        if len(lane.waiters) >= self._lane_queue_cap(lane):
+            self._shed("tenant_quota", lane)
         timeout = self._default_wait_s
         if deadline is not None:
             timeout = min(timeout, deadline.remaining())
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
-        self._waiters.append(fut)
+        if not lane.waiters:
+            lane.deficit = 0.0  # fresh backlog starts without banked credit
+        lane.waiters.append(fut)
+        self._queued += 1
+        # A free slot may exist even with waiters queued (every queued
+        # tenant at its cap): dispatch immediately rather than waiting for
+        # the next release.
+        self._dispatch()
         try:
             await asyncio.wait_for(fut, timeout)
         except (asyncio.TimeoutError, TimeoutError):
-            self._abandon_wait(fut)
-            self._shed("queue_timeout")
+            self._abandon_wait(fut, lane)
+            self._shed("queue_timeout", lane)
         except asyncio.CancelledError:
             # Client disconnected while queued: the dead future must not keep
             # consuming a queue slot (it would shed healthy traffic as
             # queue_full long after the client left).
-            self._abandon_wait(fut)
+            self._abandon_wait(fut, lane)
             raise
         else:
-            # Slot transferred by _release(); in-flight already accounts us.
-            self._admitted()
+            # Slot granted by _dispatch(); both counts already include us.
+            self._admitted(lane)
 
-    def _abandon_wait(self, fut: asyncio.Future) -> None:
-        """Withdraw a waiter that will not proceed, returning any slot the
-        grant-vs-abandon race already transferred to it."""
+    def _abandon_wait(self, fut: asyncio.Future, lane: _TenantLane) -> None:
+        """Withdraw a waiter that will not proceed. If the grant-vs-abandon
+        race already transferred a slot to it, the slot goes back through
+        ``_release`` — ONE code path, so the demand tracker sees exactly
+        one shed and zero admissions for an abandoned waiter."""
         try:
-            self._waiters.remove(fut)
+            lane.waiters.remove(fut)
         except ValueError:
-            pass
+            pass  # already popped by _dispatch
+        else:
+            self._queued -= 1
         if fut.done() and not fut.cancelled():
-            self._release()
+            self._release(lane)
 
-    def _admitted(self) -> None:
+    def _admitted(self, lane: _TenantLane) -> None:
+        lane.admitted += 1
         if self._admitted_total is not None:
             self._admitted_total.inc()
+        if self._tenant_admitted_total is not None:
+            self._tenant_admitted_total.inc(tenant=lane.label)
 
-    def _release(self) -> None:
-        while self._waiters:
-            fut = self._waiters.popleft()
-            if not fut.done():
-                fut.set_result(None)  # direct handoff: in-flight unchanged
-                return
+    def _grant(self, lane: _TenantLane, fut: asyncio.Future | None = None) -> None:
+        self._in_flight += 1
+        lane.in_flight += 1
+        if fut is not None:
+            fut.set_result(None)
+
+    def _release(self, lane: _TenantLane) -> None:
         self._in_flight -= 1
+        lane.in_flight -= 1
+        self._dispatch()
+
+    # ------------------------------------------------------------- dispatch
+
+    def _dispatch(self) -> None:
+        """Grant free slots to queued waiters, weighted-fair across lanes.
+        Runs synchronously on the loop (no awaits), so counts are always
+        consistent when control returns to a coroutine."""
+        while self._in_flight < self._max_in_flight:
+            lane = self._next_lane()
+            if lane is None:
+                return
+            fut: asyncio.Future | None = None
+            while lane.waiters:
+                cand = lane.waiters.popleft()
+                self._queued -= 1
+                if not cand.done():
+                    fut = cand
+                    break
+            if fut is None:
+                continue  # only dead waiters; re-evaluate lanes
+            # Debt is floored like credit is capped: a lane served solo
+            # (the single-eligible fast path skips top-ups) must not
+            # accrue unbounded debt, or the moment a second tenant starts
+            # queuing the weights invert until the debt is paid off.
+            floor = -lane.tenant.weight * _DEFICIT_CAP_ROUNDS
+            lane.deficit = max(floor, lane.deficit - _REQUEST_COST)
+            if not lane.waiters:
+                lane.deficit = 0.0  # DRR: an emptied queue banks no credit
+            self._grant(lane, fut)
+
+    def _next_lane(self) -> _TenantLane | None:
+        """Deficit round-robin: serve the first lane (cursor-rotated) with
+        enough credit; when none has, top every eligible lane up by its
+        weight and try again — grant ratios converge to weight ratios."""
+        eligible = [
+            lane
+            for label in sorted(self._lanes)
+            for lane in (self._lanes[label],)
+            if lane.waiters
+            and (
+                lane.tenant.max_in_flight is None
+                or lane.in_flight < lane.tenant.max_in_flight
+            )
+        ]
+        if not eligible:
+            return None
+        if len(eligible) == 1:
+            return eligible[0]
+        labels = [lane.label for lane in eligible]
+        if self._rr_cursor in labels:
+            i = labels.index(self._rr_cursor)
+            eligible = eligible[i:] + eligible[:i]
+        # Bounded: each top-up adds >= min(weight) > 0 credit to every lane,
+        # so some lane reaches _REQUEST_COST within cost/min(weight) rounds.
+        min_weight = min(lane.tenant.weight for lane in eligible)
+        rounds = max(2, int(_REQUEST_COST / min_weight) + 2)
+        for _ in range(rounds):
+            for lane in eligible:
+                if lane.deficit >= _REQUEST_COST:
+                    self._rr_cursor = lane.label
+                    return lane
+            for lane in eligible:
+                cap = max(
+                    _REQUEST_COST, lane.tenant.weight * _DEFICIT_CAP_ROUNDS
+                )
+                lane.deficit = min(cap, lane.deficit + lane.tenant.weight)
+        return eligible[0]  # unreachable with weights > 0; safe fallback
+
+    # --------------------------------------------------------- retry budget
+
+    def tenant_retry_budget(self, tenant):
+        """A zero-arg callable spending one retry from ``tenant``'s budget
+        (the edge binds it into the ``TenantContext``; the resilience retry
+        loop consults it). Tenants without a rate quota get no budget —
+        ``None`` — preserving pre-tenancy retry behavior for them."""
+        lane = self._lane_for(tenant)
+        if lane.tenant.rps is None:
+            return None
+        rate = max(_RETRY_BUDGET_MIN_RATE, lane.tenant.rps * _RETRY_BUDGET_RATIO)
+
+        def spend() -> bool:
+            now = self._clock()
+            lane.retry_tokens = min(
+                _RETRY_BUDGET_BURST,
+                lane.retry_tokens + (now - lane.retry_mono) * rate,
+            )
+            lane.retry_mono = now
+            if lane.retry_tokens >= 1.0:
+                lane.retry_tokens -= 1.0
+                return True
+            lane.retries_denied += 1
+            return False
+
+        return spend
+
+    # ------------------------------------------------------------- operator
+
+    def tenant_snapshot(self) -> dict[str, dict]:
+        """Per-tenant admission state for ``GET /v1/tenants``."""
+        out: dict[str, dict] = {}
+        for label in sorted(self._lanes):
+            lane = self._lanes[label]
+            out[label] = {
+                "weight": lane.tenant.weight,
+                "in_flight": lane.in_flight,
+                "queued": len(lane.waiters),
+                "admitted": lane.admitted,
+                "sheds": dict(lane.sheds),
+                "retries_denied": lane.retries_denied,
+                "queue_wait_avg_ms": (
+                    lane.queue_wait_sum_s / lane.admitted * 1000.0
+                    if lane.admitted
+                    else 0.0
+                ),
+                "rate_tokens": (
+                    round(lane.tokens, 3)
+                    if lane.tenant.rps is not None
+                    else None
+                ),
+            }
+        return out

@@ -22,6 +22,7 @@ import logging
 from dataclasses import dataclass
 
 from bee_code_interpreter_tpu.resilience.deadline import Deadline
+from bee_code_interpreter_tpu.tenancy.context import consume_retry_budget
 
 logger = logging.getLogger(__name__)
 
@@ -57,6 +58,17 @@ def retryable(policy_attr: str, op: str):
                     return await fn(self, *args, **kwargs)
                 except policy.retry_on as e:
                     if attempt >= policy.attempts:
+                        raise
+                    if not consume_retry_budget():
+                        # Per-tenant retry budget exhausted (docs/tenancy.md
+                        # "Retry budgets"): a quota'd tenant whose failures
+                        # outpace ~10% of its rate quota fails fast instead
+                        # of multiplying load through retries.
+                        logger.warning(
+                            "%s attempt %d failed (%s); tenant retry budget "
+                            "exhausted, not retrying",
+                            op, attempt, e,
+                        )
                         raise
                     sleep_s = policy.backoff_s(attempt)
                     if deadline is not None and deadline.remaining() <= sleep_s:

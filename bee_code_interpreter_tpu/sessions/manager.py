@@ -47,6 +47,7 @@ from typing import Callable
 from bee_code_interpreter_tpu.observability import collect_transfer, unwrap_executor
 from bee_code_interpreter_tpu.resilience import Deadline, SandboxTransientError
 from bee_code_interpreter_tpu.sessions.lease import LeaseOutcome, build_lease
+from bee_code_interpreter_tpu.tenancy.context import current_tenant_context
 from bee_code_interpreter_tpu.utils.validation import Hash
 
 logger = logging.getLogger(__name__)
@@ -61,11 +62,21 @@ class SessionNotFound(SessionError):
 
 
 class SessionLimitExceeded(SessionError):
-    """The ``APP_SESSION_MAX`` lease cap is reached (HTTP 429)."""
+    """The ``APP_SESSION_MAX`` lease cap — or a tenant's own ``sessions``
+    cap (docs/tenancy.md) — is reached (HTTP 429)."""
 
-    def __init__(self, limit: int, retry_after_s: float = 1.0) -> None:
-        super().__init__(f"session limit reached ({limit} active leases)")
+    def __init__(
+        self,
+        limit: int,
+        retry_after_s: float = 1.0,
+        tenant: str | None = None,
+    ) -> None:
+        scope = f"tenant {tenant!r} " if tenant is not None else ""
+        super().__init__(
+            f"{scope}session limit reached ({limit} active leases)"
+        )
         self.retry_after_s = retry_after_s
+        self.tenant = tenant
 
 
 class CheckpointNotFound(SessionError):
@@ -100,6 +111,11 @@ class Session:
     created_unix: float
     last_used_mono: float
     executions: int = 0
+    # `tenant` is the bounded-cardinality label (observability); the cap
+    # is enforced on `tenant_id`, the RESOLVED tenant — unknown ids all
+    # share the default tenant's quota, they don't each get a fresh one.
+    tenant: str | None = None
+    tenant_id: str | None = None
     checkpoints: dict[str, Checkpoint] = field(default_factory=dict)
     closed: bool = False
     close_reason: str | None = None
@@ -120,6 +136,7 @@ class Session:
             "age_s": now_mono - self.created_mono,
             "idle_s": now_mono - self.last_used_mono,
             "executions": self.executions,
+            "tenant": self.tenant,
             "checkpoints": sorted(self.checkpoints),
             "tracked_files": len(self.lease.tracked_paths),
         }
@@ -172,7 +189,9 @@ class SessionManager:
         # Creates in flight between the cap check and registration: the
         # checkout awaits, so the cap must be check-AND-reserve, not
         # check-then-act, or a burst of concurrent creates blows past it.
+        # The per-tenant reservation (docs/tenancy.md) works the same way.
         self._creating = 0
+        self._creating_by_tenant: dict[str, int] = {}
         self._task: asyncio.Task | None = None
         self.expired_total: dict[str, int] = {}
         self._lease_seconds = None
@@ -277,7 +296,35 @@ class SessionManager:
             raise SessionLimitExceeded(
                 self._max_sessions, retry_after_s=self._retry_after_s
             )
+        # Per-tenant cap (docs/tenancy.md): each lease pins a warm sandbox,
+        # so a tenant's `sessions` quota bounds how much of the fleet THAT
+        # tenant can hold — same check-and-reserve discipline as the global
+        # cap, so a burst of one tenant's creates cannot race past it.
+        tctx = current_tenant_context()
+        tenant_label = tctx.label if tctx is not None else None
+        tenant_id = tctx.tenant.id if tctx is not None else None
+        tenant_cap = (
+            tctx.tenant.max_sessions if tctx is not None else None
+        )
+        if tenant_cap is not None:
+            # Count by the RESOLVED tenant, not the label: spoofed unknown
+            # ids all share the default tenant's allotment.
+            held = sum(
+                1
+                for s in self._sessions.values()
+                if s.tenant_id == tenant_id
+            ) + self._creating_by_tenant.get(tenant_id, 0)
+            if held >= tenant_cap:
+                raise SessionLimitExceeded(
+                    tenant_cap,
+                    retry_after_s=self._retry_after_s,
+                    tenant=tenant_id,
+                )
         self._creating += 1
+        if tenant_id is not None:
+            self._creating_by_tenant[tenant_id] = (
+                self._creating_by_tenant.get(tenant_id, 0) + 1
+            )
         try:
             handle = await self._backend.checkout_for_lease(deadline=deadline)
             session_id = f"sess-{secrets.token_hex(8)}"
@@ -291,6 +338,8 @@ class SessionManager:
                 created_mono=now,
                 created_unix=time.time(),
                 last_used_mono=now,
+                tenant=tenant_label,
+                tenant_id=tenant_id,
             )
             self._journal("leased", session, reason="acquired")
             try:
@@ -304,6 +353,12 @@ class SessionManager:
             self._sessions[session_id] = session
         finally:
             self._creating -= 1
+            if tenant_id is not None:
+                remaining = self._creating_by_tenant.get(tenant_id, 1) - 1
+                if remaining > 0:
+                    self._creating_by_tenant[tenant_id] = remaining
+                else:
+                    self._creating_by_tenant.pop(tenant_id, None)
         self._emit("created", session)
         logger.info(
             "Session %s leased sandbox %s (ttl=%.0fs idle=%.0fs)",
@@ -507,6 +562,8 @@ class SessionManager:
         if journal is None:
             return
         attrs: dict = {"session": session.session_id}
+        if session.tenant is not None:
+            attrs["tenant"] = session.tenant
         journal.record(session.lease.name, state, reason=reason, **attrs)
 
     def _end_lease(
@@ -537,12 +594,24 @@ class SessionManager:
         )
         self._emit("ended", session, reason=metric_reason)
 
+    def tenant_counts(self) -> dict[str, int]:
+        """Active leases per tenant label (``GET /v1/tenants``)."""
+        counts: dict[str, int] = {}
+        for session in self._sessions.values():
+            if session.tenant is not None:
+                counts[session.tenant] = counts.get(session.tenant, 0) + 1
+        return counts
+
     def snapshot(self) -> dict:
         """Operator view for ``GET /v1/sessions`` and the debug bundle."""
         now = self._clock()
-        return {
+        snap = {
             "sessions": [s.to_dict(now) for s in self._sessions.values()],
             "active": len(self._sessions),
             "max": self._max_sessions,
             "ended_by_reason": dict(self.expired_total),
         }
+        tenants = self.tenant_counts()
+        if tenants:
+            snap["by_tenant"] = tenants
+        return snap

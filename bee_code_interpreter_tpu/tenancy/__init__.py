@@ -1,0 +1,51 @@
+"""Multi-tenant isolation (docs/tenancy.md).
+
+Identity (``X-Tenant-Id`` / API key → :class:`TenantContext` contextvar),
+the config-declared tenant table (``APP_TENANTS`` → :class:`TenantRegistry`),
+and per-tenant usage metering (:class:`TenantUsageMeter`, the billing
+substrate behind ``GET /v1/tenants``). The *enforcement* lives where the
+chokepoints already are: weighted-fair queuing and per-tenant quotas on
+``resilience.AdmissionController``, per-tenant SLO slices on
+``observability.SloEngine``, per-tenant lease caps on
+``sessions.SessionManager`` — this package only says WHO a request is.
+"""
+
+from bee_code_interpreter_tpu.tenancy.context import (
+    TENANT_HEADER,
+    TENANT_METADATA_KEY,
+    TenantContext,
+    bearer_token,
+    consume_retry_budget,
+    current_tenant_context,
+    current_tenant_label,
+    meter_ambient_usage,
+    tenant_scope,
+)
+from bee_code_interpreter_tpu.tenancy.metering import TenantUsageMeter
+from bee_code_interpreter_tpu.tenancy.registry import (
+    DEFAULT_TENANT_ID,
+    Tenant,
+    TenantRegistry,
+    build_tenants_snapshot,
+    parse_tenants,
+    sanitize_tenant_id,
+)
+
+__all__ = [
+    "DEFAULT_TENANT_ID",
+    "TENANT_HEADER",
+    "TENANT_METADATA_KEY",
+    "Tenant",
+    "TenantContext",
+    "TenantRegistry",
+    "TenantUsageMeter",
+    "bearer_token",
+    "build_tenants_snapshot",
+    "consume_retry_budget",
+    "current_tenant_context",
+    "current_tenant_label",
+    "meter_ambient_usage",
+    "parse_tenants",
+    "sanitize_tenant_id",
+    "tenant_scope",
+]

@@ -1,0 +1,116 @@
+"""Ambient tenant identity for one request (docs/tenancy.md).
+
+Both API edges resolve the caller's identity (``X-Tenant-Id`` header /
+``x-tenant-id`` gRPC metadata, or an ``Authorization: Bearer`` API key from
+the tenant table) into ONE :class:`TenantContext` and activate it here for
+the request's lifetime — the same contextvar design as ``tracing.span`` and
+``collect_transfer``: downstream layers (admission, SLO, usage accounting,
+session caps, the retry loop) read the ambient context instead of threading
+a ``tenant=`` argument through every call signature, and code running
+outside a request (tests, scripts, background sweeps) sees ``None`` and
+behaves exactly as before tenancy existed.
+
+This module deliberately imports nothing from the rest of the service so
+any layer (``utils``, ``resilience``, ``observability``) can consume it
+without import cycles.
+"""
+
+from __future__ import annotations
+
+from contextlib import contextmanager
+from contextvars import ContextVar
+from dataclasses import dataclass
+from typing import Callable
+
+# The HTTP header and its gRPC invocation-metadata twin (metadata keys are
+# lowercase on the wire).
+TENANT_HEADER = "X-Tenant-Id"
+TENANT_METADATA_KEY = "x-tenant-id"
+
+
+@dataclass
+class TenantContext:
+    """One request's resolved tenant identity.
+
+    ``tenant`` is the :class:`~.registry.Tenant` whose quotas/weight apply
+    (unknown ids share the ``default`` tenant's lane); ``label`` is the
+    bounded-cardinality spelling safe to use as a metric label and span
+    attribute; ``raw_id`` is what the client actually sent (wide events
+    keep it for forensics, metrics never see it)."""
+
+    tenant: object  # tenancy.registry.Tenant (untyped: no import cycle)
+    label: str
+    raw_id: str | None = None
+    meter: object | None = None  # tenancy.metering.TenantUsageMeter
+    # Per-tenant retry budget (docs/tenancy.md "Retry budgets"): the edge
+    # binds this to the admission controller's per-tenant token bucket; the
+    # resilience retry loop consults it before every re-attempt.
+    retry_budget: Callable[[], bool] | None = None
+
+    def record_usage(self, usage: dict | None) -> None:
+        if self.meter is not None and usage:
+            self.meter.record_usage(self.label, usage)
+
+    def record_request(self, outcome: str) -> None:
+        if self.meter is not None:
+            self.meter.record_request(self.label, outcome)
+
+
+_current: ContextVar[TenantContext | None] = ContextVar(
+    "bci_tenant_context", default=None
+)
+
+
+def current_tenant_context() -> TenantContext | None:
+    return _current.get()
+
+
+def current_tenant_label() -> str | None:
+    ctx = _current.get()
+    return ctx.label if ctx is not None else None
+
+
+@contextmanager
+def tenant_scope(ctx: TenantContext | None):
+    """Activate ``ctx`` for the enclosed request; ``None`` explicitly
+    clears any inherited context (an aiohttp keep-alive connection task
+    serves sequential requests in ONE context — identity must never leak
+    across them)."""
+    token = _current.set(ctx)
+    try:
+        yield ctx
+    finally:
+        _current.reset(token)
+
+
+def meter_ambient_usage(usage: dict | None) -> None:
+    """Report one execution's ``usage`` block to the ambient tenant's
+    usage meter; a no-op outside a tenant-resolved request. Called by
+    ``observability.record_usage_at_edge`` so every path that lands usage
+    at an edge also meters it per tenant — by construction, not by eight
+    separate call sites."""
+    ctx = _current.get()
+    if ctx is not None:
+        ctx.record_usage(usage)
+
+
+def consume_retry_budget() -> bool:
+    """One retry's worth of the ambient tenant's retry budget. ``True``
+    (retry allowed) outside a request or when no budget is bound — the
+    pre-tenancy behavior."""
+    ctx = _current.get()
+    if ctx is None or ctx.retry_budget is None:
+        return True
+    return bool(ctx.retry_budget())
+
+
+def bearer_token(authorization: str | None) -> str | None:
+    """The token from an ``Authorization: Bearer <token>`` value; None for
+    anything else (other schemes are not tenant API keys)."""
+    if not authorization:
+        return None
+    scheme, _, token = authorization.partition(" ")
+    if scheme.lower() != "bearer":
+        return None
+    token = token.strip()
+    return token or None

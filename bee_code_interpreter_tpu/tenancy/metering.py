@@ -1,0 +1,152 @@
+"""Per-tenant usage metering — the billing substrate (docs/tenancy.md).
+
+PR 3's accounting answers "what did THIS request cost" (``usage`` blocks on
+the wire, ``bci_execution_*`` histograms); this module rolls the same blocks
+up per *tenant*: CPU-seconds, peak RSS, data-plane bytes, workspace writes
+and request outcomes, served at ``GET /v1/tenants`` (gRPC ``GetTenants``)
+and exported as ``bci_tenant_*`` metrics.
+
+Cardinality is bounded twice: the meter itself keeps at most ``max_labels``
+tenant slots (further labels collapse into ``other``), and the metrics
+Registry's label guard (``utils/metrics.py``) clamps the ``tenant`` label
+independently — a tenant-id flood can grow neither this map nor
+``/metrics``.
+"""
+
+from __future__ import annotations
+
+
+class _TenantUsage:
+    __slots__ = (
+        "requests",
+        "outcomes",
+        "sheds",
+        "executions",
+        "cpu_s",
+        "wall_s",
+        "max_rss_bytes",
+        "workspace_bytes",
+        "uploaded_bytes",
+        "downloaded_bytes",
+        "files_changed",
+    )
+
+    def __init__(self) -> None:
+        self.requests = 0
+        self.outcomes: dict[str, int] = {}
+        self.sheds = 0
+        self.executions = 0
+        self.cpu_s = 0.0
+        self.wall_s = 0.0
+        self.max_rss_bytes = 0
+        self.workspace_bytes = 0
+        self.uploaded_bytes = 0
+        self.downloaded_bytes = 0
+        self.files_changed = 0
+
+    def to_dict(self) -> dict:
+        return {
+            "requests": self.requests,
+            "outcomes": dict(self.outcomes),
+            "sheds": self.sheds,
+            "executions": self.executions,
+            "cpu_s": round(self.cpu_s, 6),
+            "wall_s": round(self.wall_s, 6),
+            "max_rss_bytes": self.max_rss_bytes,
+            "workspace_bytes": self.workspace_bytes,
+            "uploaded_bytes": self.uploaded_bytes,
+            "downloaded_bytes": self.downloaded_bytes,
+            "files_changed": self.files_changed,
+        }
+
+
+class TenantUsageMeter:
+    """Bounded per-tenant usage rollups. Writers are the edges (via the
+    ambient :func:`~.context.meter_ambient_usage`) and the admission gate
+    (sheds); readers are ``GET /v1/tenants`` and the fleet tenant-mix
+    export."""
+
+    def __init__(self, metrics=None, max_labels: int = 32) -> None:
+        self._slots: dict[str, _TenantUsage] = {}
+        self._max_labels = max(1, max_labels)
+        self._requests_total = None
+        self._cpu_seconds_total = None
+        self._bytes_total = None
+        if metrics is not None:
+            self._requests_total = metrics.counter(
+                "bci_tenant_requests_total",
+                "Sandbox-bound requests recorded per tenant, by outcome",
+            )
+            self._cpu_seconds_total = metrics.counter(
+                "bci_tenant_cpu_seconds_total",
+                "Sandbox CPU time (user+system) consumed per tenant",
+            )
+            self._bytes_total = metrics.counter(
+                "bci_tenant_bytes_total",
+                "Data-plane and workspace bytes moved per tenant, by direction",
+            )
+
+    def _slot(self, label: str) -> _TenantUsage:
+        slot = self._slots.get(label)
+        if slot is None:
+            if len(self._slots) >= self._max_labels and label != "other":
+                return self._slot("other")
+            slot = self._slots[label] = _TenantUsage()
+        return slot
+
+    # ------------------------------------------------------------- writers
+
+    def record_request(self, label: str, outcome: str) -> None:
+        slot = self._slot(label)
+        slot.requests += 1
+        slot.outcomes[outcome] = slot.outcomes.get(outcome, 0) + 1
+        if outcome == "shed":
+            slot.sheds += 1
+        if self._requests_total is not None:
+            self._requests_total.inc(tenant=label, outcome=outcome)
+
+    def record_usage(self, label: str, usage: dict) -> None:
+        """One execution's ``usage`` block (the same dict the response
+        carries), attributed to ``label``."""
+        slot = self._slot(label)
+        slot.executions += 1
+        cpu = float(usage.get("cpu_user_s", 0.0)) + float(
+            usage.get("cpu_system_s", 0.0)
+        )
+        slot.cpu_s += cpu
+        slot.wall_s += float(usage.get("wall_s", 0.0) or 0.0)
+        rss = int(usage.get("max_rss_bytes", 0) or 0)
+        slot.max_rss_bytes = max(slot.max_rss_bytes, rss)
+        workspace = int(usage.get("workspace_bytes_written", 0) or 0)
+        uploaded = int(usage.get("uploaded_bytes", 0) or 0)
+        downloaded = int(usage.get("downloaded_bytes", 0) or 0)
+        slot.workspace_bytes += workspace
+        slot.uploaded_bytes += uploaded
+        slot.downloaded_bytes += downloaded
+        slot.files_changed += int(usage.get("files_changed", 0) or 0)
+        if self._cpu_seconds_total is not None and cpu > 0:
+            self._cpu_seconds_total.inc(cpu, tenant=label)
+        if self._bytes_total is not None:
+            if uploaded:
+                self._bytes_total.inc(uploaded, tenant=label, direction="upload")
+            if downloaded:
+                self._bytes_total.inc(
+                    downloaded, tenant=label, direction="download"
+                )
+            if workspace:
+                self._bytes_total.inc(
+                    workspace, tenant=label, direction="workspace"
+                )
+
+    # ------------------------------------------------------------- readers
+
+    def labels(self) -> tuple[str, ...]:
+        return tuple(sorted(self._slots))
+
+    def mix(self) -> dict[str, int]:
+        """Per-tenant lifetime request counts — the ``tenants`` section of
+        ``GET /v1/fleet`` a placement-aware router consumes."""
+        return {label: slot.requests for label, slot in sorted(self._slots.items())}
+
+    def snapshot(self) -> dict[str, dict]:
+        return {label: slot.to_dict() for label, slot in sorted(self._slots.items())}

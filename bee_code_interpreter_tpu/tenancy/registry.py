@@ -1,0 +1,302 @@
+"""The config-declared tenant table (docs/tenancy.md).
+
+``APP_TENANTS`` declares who shares the service and on what terms, in the
+same comma/colon spelling the SLO and policy knobs use::
+
+    APP_TENANTS="alpha:weight=4:max_in_flight=8:rps=20,beta:weight=1:rps=5,
+                 default:weight=1:rps=2"
+
+Each entry is ``name[:key=value]...`` with keys:
+
+- ``weight``        WFQ share under saturation (float > 0, default 1)
+- ``max_in_flight`` per-tenant concurrency cap (default: unlimited — the
+                    global admission bound still applies)
+- ``rps``           token-bucket rate quota, requests/second (default: none)
+- ``burst``         bucket depth (default ``max(1, rps)``)
+- ``sessions``      per-tenant session-lease cap (default: none — the
+                    global ``APP_SESSION_MAX`` still applies)
+- ``key``           API key: ``Authorization: Bearer <key>`` resolves to
+                    this tenant (the header is then unnecessary)
+
+A ``default`` entry customizes the catch-all every unknown or anonymous
+request lands in; when absent an unlimited ``default`` tenant is implied, so
+an undeclared deployment behaves exactly as before tenancy existed.
+Malformed specs raise ``ValueError`` at startup — config errors must fail
+loudly, not silently disable isolation.
+
+Unknown tenant ids are *bounded-cardinality*: they share the ``default``
+tenant's quotas and lane, and at most ``max_labels`` distinct raw ids are
+tracked as labels before collapsing into ``other`` (the metrics Registry's
+label guard clamps the ``tenant`` label independently; see
+``utils/metrics.py``).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from bee_code_interpreter_tpu.tenancy.context import TenantContext
+from bee_code_interpreter_tpu.tenancy.metering import TenantUsageMeter
+
+DEFAULT_TENANT_ID = "default"
+
+# Raw ids longer than this are truncated before becoming labels/attributes.
+_MAX_ID_LEN = 64
+
+
+@dataclass(frozen=True)
+class Tenant:
+    """One declared tenant and its quotas. ``None`` means "no per-tenant
+    bound" — the global limits still apply."""
+
+    id: str
+    weight: float = 1.0
+    max_in_flight: int | None = None
+    rps: float | None = None
+    burst: float | None = None
+    max_sessions: int | None = None
+    api_key: str | None = None
+
+    @property
+    def burst_depth(self) -> float:
+        if self.burst is not None:
+            return self.burst
+        return max(1.0, self.rps) if self.rps is not None else 1.0
+
+
+def _parse_entry(entry: str) -> Tenant:
+    parts = [p.strip() for p in entry.split(":")]
+    name = parts[0]
+    if not name:
+        raise ValueError(f"APP_TENANTS entry {entry!r}: empty tenant name")
+    kwargs: dict = {}
+    for part in parts[1:]:
+        key, sep, value = part.partition("=")
+        key, value = key.strip(), value.strip()
+        if not sep or not value:
+            raise ValueError(
+                f"APP_TENANTS entry {entry!r}: expected key=value, got {part!r}"
+            )
+        try:
+            if key == "weight":
+                kwargs["weight"] = float(value)
+                if kwargs["weight"] <= 0:
+                    raise ValueError
+            elif key == "max_in_flight":
+                kwargs["max_in_flight"] = int(value)
+                if kwargs["max_in_flight"] < 1:
+                    raise ValueError
+            elif key == "rps":
+                kwargs["rps"] = float(value)
+                if kwargs["rps"] <= 0:
+                    raise ValueError
+            elif key == "burst":
+                kwargs["burst"] = float(value)
+                if kwargs["burst"] < 1:
+                    raise ValueError
+            elif key == "sessions":
+                kwargs["max_sessions"] = int(value)
+                if kwargs["max_sessions"] < 0:
+                    raise ValueError
+            elif key == "key":
+                kwargs["api_key"] = value
+            else:
+                raise ValueError(
+                    f"APP_TENANTS entry {entry!r}: unknown attribute {key!r}"
+                )
+        except ValueError as e:
+            if e.args and "APP_TENANTS" in str(e):
+                raise
+            raise ValueError(
+                f"APP_TENANTS entry {entry!r}: bad value for {key}: {value!r}"
+            ) from None
+    return Tenant(id=name, **kwargs)
+
+
+def parse_tenants(spec: str | None) -> dict[str, Tenant]:
+    """Tenant table from the raw ``APP_TENANTS`` string. Always contains a
+    ``default`` catch-all (implied unlimited when not declared)."""
+    tenants: dict[str, Tenant] = {}
+    seen_keys: dict[str, str] = {}
+    for entry in (spec or "").split(","):
+        entry = entry.strip()
+        if not entry:
+            continue
+        tenant = _parse_entry(entry)
+        if tenant.id in tenants:
+            raise ValueError(f"APP_TENANTS: duplicate tenant {tenant.id!r}")
+        if tenant.api_key is not None:
+            owner = seen_keys.get(tenant.api_key)
+            if owner is not None:
+                raise ValueError(
+                    f"APP_TENANTS: API key of {tenant.id!r} already "
+                    f"assigned to {owner!r}"
+                )
+            seen_keys[tenant.api_key] = tenant.id
+        tenants[tenant.id] = tenant
+    tenants.setdefault(DEFAULT_TENANT_ID, Tenant(id=DEFAULT_TENANT_ID))
+    return tenants
+
+
+def sanitize_tenant_id(raw: str) -> str:
+    """A raw client-supplied id made safe for labels/span attributes:
+    printable, no exposition-hostile characters, bounded length."""
+    cleaned = "".join(
+        ch if ch.isprintable() and ch not in '",\\\n' else "_" for ch in raw
+    )
+    return cleaned[:_MAX_ID_LEN]
+
+
+class TenantRegistry:
+    """Identity resolution + the per-tenant usage meter, shared by both API
+    edges (one table, one meter — the transports can never disagree about
+    who a request belongs to)."""
+
+    def __init__(
+        self,
+        tenants: dict[str, Tenant] | None = None,
+        *,
+        max_labels: int = 32,
+        metrics=None,
+    ) -> None:
+        self._tenants = dict(tenants) if tenants else parse_tenants(None)
+        self._tenants.setdefault(DEFAULT_TENANT_ID, Tenant(id=DEFAULT_TENANT_ID))
+        self._by_key = {
+            t.api_key: t for t in self._tenants.values() if t.api_key
+        }
+        self._max_labels = max(1, max_labels)
+        # Distinct unknown ids kept as labels before collapsing to "other";
+        # bounded so a tenant-id flood cannot grow this map.
+        self._unknown: set[str] = set()
+        self.unknown_overflow = 0
+        self.meter = TenantUsageMeter(metrics=metrics, max_labels=max_labels)
+
+    @classmethod
+    def from_config(cls, config, metrics=None) -> "TenantRegistry":
+        return cls(
+            parse_tenants(config.tenants),
+            max_labels=config.metrics_max_tenant_labels,
+            metrics=metrics,
+        )
+
+    @property
+    def default(self) -> Tenant:
+        return self._tenants[DEFAULT_TENANT_ID]
+
+    def get(self, tenant_id: str) -> Tenant | None:
+        return self._tenants.get(tenant_id)
+
+    def tenants(self) -> tuple[Tenant, ...]:
+        return tuple(self._tenants[name] for name in sorted(self._tenants))
+
+    # ----------------------------------------------------------- resolution
+
+    def resolve(
+        self, tenant_id: str | None = None, api_key: str | None = None
+    ) -> TenantContext:
+        """One request's identity: API key wins over the header; a declared
+        id gets its own tenant; anything else shares ``default`` (unknown
+        ids keep a bounded-cardinality label for observability)."""
+        if api_key is not None:
+            tenant = self._by_key.get(api_key)
+            if tenant is not None:
+                return TenantContext(
+                    tenant=tenant, label=tenant.id, raw_id=tenant.id,
+                    meter=self.meter,
+                )
+        if tenant_id:
+            tenant = self._tenants.get(tenant_id)
+            if tenant is not None:
+                return TenantContext(
+                    tenant=tenant, label=tenant.id, raw_id=tenant_id,
+                    meter=self.meter,
+                )
+            label = self._unknown_label(sanitize_tenant_id(tenant_id))
+            return TenantContext(
+                tenant=self.default,
+                label=label,
+                raw_id=sanitize_tenant_id(tenant_id),
+                meter=self.meter,
+            )
+        return TenantContext(
+            tenant=self.default,
+            label=DEFAULT_TENANT_ID,
+            raw_id=None,
+            meter=self.meter,
+        )
+
+    def _unknown_label(self, cleaned: str) -> str:
+        if cleaned in self._unknown:
+            return cleaned
+        if len(self._unknown) < self._max_labels:
+            self._unknown.add(cleaned)
+            return cleaned
+        self.unknown_overflow += 1
+        return "other"
+
+    # ------------------------------------------------------------- readers
+
+    def mix(self) -> dict[str, int]:
+        """Per-tenant request totals for the ``/v1/fleet`` export."""
+        return self.meter.mix()
+
+    def snapshot(self) -> dict:
+        return {
+            "tenants": {
+                t.id: {
+                    "weight": t.weight,
+                    "max_in_flight": t.max_in_flight,
+                    "rps": t.rps,
+                    "burst": t.burst_depth if t.rps is not None else None,
+                    "sessions": t.max_sessions,
+                    "has_api_key": t.api_key is not None,
+                }
+                for t in self.tenants()
+            },
+            "unknown_ids": len(self._unknown),
+            "unknown_overflow": self.unknown_overflow,
+        }
+
+
+def build_tenants_snapshot(
+    registry: TenantRegistry | None,
+    admission=None,
+    slo=None,
+    sessions=None,
+) -> dict:
+    """The ``GET /v1/tenants`` document (gRPC ``GetTenants`` twin): the
+    declared table, live admission state, usage metering, SLO-slice
+    summaries, and session counts, merged per tenant label. Built in ONE
+    place so the transports can never disagree about its shape."""
+    if registry is None:
+        return {"detail": "no tenant registry wired into this server"}
+    table = registry.snapshot()
+    usage = registry.meter.snapshot()
+    admission_state = (
+        admission.tenant_snapshot() if admission is not None else {}
+    )
+    slo_state = slo.tenant_summaries() if slo is not None else {}
+    session_counts = (
+        sessions.tenant_counts() if sessions is not None else {}
+    )
+    labels = (
+        set(table["tenants"])
+        | set(usage)
+        | set(admission_state)
+        | set(slo_state)
+        | set(session_counts)
+    )
+    tenants = {}
+    for label in sorted(labels):
+        tenants[label] = {
+            "config": table["tenants"].get(label),
+            "admission": admission_state.get(label),
+            "usage": usage.get(label),
+            "slo": slo_state.get(label),
+            "sessions": session_counts.get(label, 0),
+        }
+    return {
+        "tenants": tenants,
+        "unknown_ids": table["unknown_ids"],
+        "unknown_overflow": table["unknown_overflow"],
+    }

@@ -112,8 +112,12 @@ class Counter:
     def __init__(self, name: str, help_text: str) -> None:
         self.name, self.help = name, help_text
         self._values: dict[tuple, float] = defaultdict(float)
+        # Registry-installed label-cardinality clamp (None for bare metrics).
+        self._clamp: Callable[[dict], dict] | None = None
 
     def inc(self, value: float = 1.0, **labels: str) -> None:
+        if self._clamp is not None and labels:
+            labels = self._clamp(labels)
         self._values[tuple(sorted(labels.items()))] += value
 
     def collect(self, openmetrics: bool = False) -> Iterable[str]:
@@ -143,8 +147,11 @@ class Gauge:
     def __init__(self, name: str, help_text: str) -> None:
         self.name, self.help = name, help_text
         self._fns: dict[tuple, Callable[[], float]] = {}
+        self._clamp: Callable[[dict], dict] | None = None
 
     def set_fn(self, fn: Callable[[], float], **labels: str) -> None:
+        if self._clamp is not None and labels:
+            labels = self._clamp(labels)
         self._fns[tuple(sorted(labels.items()))] = fn
 
     def collect(self, openmetrics: bool = False) -> Iterable[str]:
@@ -176,8 +183,11 @@ class Histogram:
         # most recent traced observation per bucket, exposed as an
         # OpenMetrics exemplar so a dashboard can jump spike -> trace.
         self._exemplars: dict[tuple, dict[str, tuple[float, str, str, float]]] = {}
+        self._clamp: Callable[[dict], dict] | None = None
 
     def observe(self, value: float, **labels: str) -> None:
+        if self._clamp is not None and labels:
+            labels = self._clamp(labels)
         key = tuple(sorted(labels.items())) if labels else ()
         counts = self._counts.get(key)
         if counts is None:
@@ -253,13 +263,57 @@ class _Timer:
         self._hist.observe(time.monotonic() - self._t0, **self._labels)
 
 
+# Labels whose VALUES are client-influenced get a cardinality bound by
+# default in every registry: the tenant label is stamped from (bounded)
+# resolved ids, but defense in depth means even a buggy caller passing raw
+# ids cannot OOM /metrics.
+DEFAULT_LABEL_BOUNDS = {"tenant": 32}
+
+
 class Registry:
     """Metrics are deduplicated by name: asking twice for the same counter
     (e.g. two components sharing ``bci_breaker_transitions_total``) returns
-    the same object, so the exposition never emits duplicate metric blocks."""
+    the same object, so the exposition never emits duplicate metric blocks.
+
+    Label-cardinality guard (docs/tenancy.md "Cardinality"): labels
+    registered via :meth:`bound_label` (the ``tenant`` label by default,
+    ``APP_METRICS_MAX_TENANT_LABELS``) admit at most N distinct values;
+    further values collapse into ``other`` and every collapsed observation
+    is counted in ``bci_metrics_label_overflow_total{label}`` — a
+    tenant-id flood can widen one bucket, never the exposition."""
 
     def __init__(self) -> None:
         self._metrics: dict[str, Counter | Gauge | Histogram] = {}
+        self._label_bounds: dict[str, int] = dict(DEFAULT_LABEL_BOUNDS)
+        self._label_seen: dict[str, set[str]] = {}
+        self._label_overflow_total = self.counter(
+            "bci_metrics_label_overflow_total",
+            "Observations whose bounded label value collapsed into 'other' "
+            "(cardinality guard), by label name",
+        )
+
+    def bound_label(self, label: str, limit: int) -> None:
+        """(Re)bound a label's distinct-value budget; existing seen values
+        keep their series, new ones past the limit collapse to 'other'."""
+        self._label_bounds[label] = max(1, limit)
+
+    def _clamp_labels(self, labels: dict) -> dict:
+        clamped = None
+        for name, limit in self._label_bounds.items():
+            value = labels.get(name)
+            if value is None or value == "other":
+                continue
+            seen = self._label_seen.setdefault(name, set())
+            if value in seen:
+                continue
+            if len(seen) < limit:
+                seen.add(value)
+                continue
+            if clamped is None:
+                clamped = dict(labels)
+            clamped[name] = "other"
+            self._label_overflow_total.inc(label=name)
+        return labels if clamped is None else clamped
 
     @property
     def metrics(self) -> dict[str, "Counter | Gauge | Histogram"]:
@@ -280,6 +334,12 @@ class Registry:
                 )
             return existing
         m = factory()
+        # Registry-owned metrics share the cardinality clamp; the overflow
+        # counter itself stays clamp-free (its label values are label
+        # NAMES, inherently bounded — and exempting it forecloses any
+        # clamp→overflow→clamp recursion).
+        if name != "bci_metrics_label_overflow_total":
+            m._clamp = self._clamp_labels
         self._metrics[name] = m
         return m
 

@@ -74,6 +74,15 @@ wiring minus kubectl. Scenarios:
                             routing/migration accounting agrees exactly
                             across the decision totals, the wide events,
                             and bci_router_* (docs/fleet.md)
+ 15. abusive tenant        — one tenant floods 100x its rate quota through
+                            the REAL HTTP edge over the fake-pod stack
+                            (weighted-fair admission + per-tenant quotas,
+                            docs/tenancy.md): the other tenants' p50 stays
+                            within 10% of baseline, ZERO of their requests
+                            shed, their SLO-slice burn alerts stay silent,
+                            and the abuser's sheds are accounted exactly
+                            once across bci_tenant_shed_total, the wide
+                            events, and /v1/tenants
 
 Exits nonzero if any scenario misbehaves. Usage:
 
@@ -1159,6 +1168,160 @@ async def main() -> int:
             for s in stacks14:
                 await s.stop()
 
+        # 15. abusive tenant: 100x-quota flood through the real HTTP edge
+        #     over the fake-pod stack — victims provably untouched, abuser
+        #     sheds accounted exactly once (docs/tenancy.md; tier-1 twin in
+        #     tests/test_tenancy.py).
+        import statistics
+
+        from aiohttp.test_utils import TestClient as TClient15
+        from aiohttp.test_utils import TestServer as TServer15
+
+        from bee_code_interpreter_tpu.api.http_server import (
+            create_http_server as create_http_15,
+        )
+        from bee_code_interpreter_tpu.observability import (
+            FlightRecorder as Recorder15,
+        )
+        from bee_code_interpreter_tpu.observability import (
+            SloEngine as Slo15,
+        )
+        from bee_code_interpreter_tpu.observability import (
+            parse_objectives as parse_objectives_15,
+        )
+        from bee_code_interpreter_tpu.services.custom_tool_executor import (
+            CustomToolExecutor as ToolExec15,
+        )
+        from bee_code_interpreter_tpu.tenancy import (
+            TENANT_HEADER,
+            TenantRegistry,
+            parse_tenants,
+        )
+
+        m15 = Registry()
+        faults15 = FaultPlan()
+        pods15 = FakeExecutorPods(tmp / "pods15", faults=faults15)
+        k8s15 = KubernetesCodeExecutor(
+            kubectl=ChaosKubectl(pods15, faults15),
+            storage=storage,
+            config=Config(
+                executor_backend="kubernetes",
+                executor_port=pods15.port,
+                executor_pod_queue_target_length=2,
+                pod_ready_timeout_s=5,
+                executor_retry_attempts=1,
+            ),
+            metrics=m15,
+            ip_poll_interval_s=0.02,
+        )
+        registry15 = TenantRegistry(
+            parse_tenants("abuser:weight=1:rps=2:burst=2,victim:weight=4"),
+            metrics=m15,
+        )
+        admission15 = AdmissionController(
+            max_in_flight=4, max_queue=8, retry_after_s=0.2,
+            metrics=m15, tenancy=registry15,
+        )
+        slo15 = Slo15(parse_objectives_15(99.5, None), metrics=m15)
+        tracer15 = Tracer(metrics=m15)
+        recorder15 = Recorder15(max_events=4096, metrics=m15)
+        tracer15.add_sink(recorder15.record_trace)
+        app15 = create_http_15(
+            code_executor=k8s15,
+            custom_tool_executor=ToolExec15(code_executor=k8s15),
+            metrics=m15,
+            admission=admission15,
+            request_deadline_s=30.0,
+            tracer=tracer15,
+            recorder=recorder15,
+            slo=slo15,
+            tenancy=registry15,
+        )
+        client15 = TClient15(TServer15(app15))
+        await client15.start_server()
+        N_ABUSE15 = 200  # 100x the abuser's burst-2 token bucket
+        try:
+            await k8s15.fill_executor_pod_queue()
+            body15 = {"source_code": "print('ok')"}
+
+            async def victim_request() -> float:
+                t0 = time.monotonic()
+                resp = await client15.post(
+                    "/v1/execute", json=body15,
+                    headers={TENANT_HEADER: "victim"},
+                )
+                assert resp.status == 200, await resp.text()
+                return time.monotonic() - t0
+
+            baseline15 = []
+            for _ in range(15):
+                baseline15.append(await victim_request())
+                await asyncio.sleep(0.02)
+            p50_base15 = statistics.median(baseline15)
+
+            async def abuse15() -> None:
+                await client15.post(
+                    "/v1/execute", json=body15,
+                    headers={TENANT_HEADER: "abuser"},
+                )
+
+            flood15 = [
+                asyncio.create_task(abuse15()) for _ in range(N_ABUSE15)
+            ]
+            during15 = []
+            for _ in range(15):
+                during15.append(await victim_request())
+                await asyncio.sleep(0.02)
+            await asyncio.gather(*flood15)
+            p50_during15 = statistics.median(during15)
+
+            report(
+                "victim p50 within 10% under a 100x-quota flood",
+                p50_during15 <= p50_base15 * 1.10,
+                f"baseline {p50_base15 * 1000:.1f}ms vs "
+                f"{p50_during15 * 1000:.1f}ms during the flood",
+            )
+            victim15 = admission15.tenant_snapshot()["victim"]
+            victim_slo15 = slo15.tenant_snapshot("victim")
+            report(
+                "zero victim sheds and a silent victim SLO slice",
+                victim15["sheds"] == {}
+                and recorder15.events(outcome="shed", tenant="victim") == []
+                and not victim_slo15["alerting"]
+                and not victim_slo15["fast_burn_alerting"],
+                f"victim sheds={victim15['sheds']}",
+            )
+            abuser15 = admission15.tenant_snapshot()["abuser"]
+            shed15 = sum(abuser15["sheds"].values())
+            counter15 = sum(
+                v
+                for key, v in m15.metrics["bci_tenant_shed_total"]
+                ._values.items()
+                if ("tenant", "abuser") in key
+            )
+            wide15 = recorder15.events(
+                outcome="shed", tenant="abuser", limit=10_000
+            )
+            tenants_doc15 = (
+                await (await client15.get("/v1/tenants")).json()
+            )
+            report(
+                "abuser sheds accounted exactly once across "
+                "counter/wide-events/v1-tenants",
+                shed15 > 0
+                and shed15 + abuser15["admitted"] == N_ABUSE15
+                and counter15 == shed15
+                and len(wide15) == shed15
+                and tenants_doc15["tenants"]["abuser"]["usage"]["sheds"]
+                == shed15,
+                f"{shed15} shed of {N_ABUSE15} flood requests "
+                f"(counter={counter15:g} wide={len(wide15)})",
+            )
+        finally:
+            await client15.close()
+            await k8s15.aclose()
+            await pods15.close()
+
         text = metrics.expose()
         wanted = [
             "bci_executor_fallback_total 1",
@@ -1183,7 +1346,7 @@ async def main() -> int:
         "chaos smoke passed: deadline, breaker, fallback, admission, replay, "
         "supervisor, watchdog, drain, telemetry export, edge analysis gate, "
         "sessions-under-chaos, flight-recorder-logs, serving-saturation, "
-        "autoscale-10x-step, fleet-router-kill all behaved"
+        "autoscale-10x-step, fleet-router-kill, abusive-tenant all behaved"
     )
     return 0
 

@@ -6,8 +6,9 @@ a one-line table row (or raw JSON with ``--json``) — `tail -f` for the
 service's request journal, with the same filters the API supports:
 
     python scripts/events-tail.py [--url http://localhost:50081]
-        [--outcome error] [--session sess-...] [--kind serving]
-        [--min-duration-ms 500] [--backlog 20] [--json] [--once]
+        [--outcome error] [--session sess-...] [--tenant alpha]
+        [--kind serving] [--min-duration-ms 500] [--backlog 20]
+        [--json] [--once]
 
 ``--once`` skips the follow and prints the current snapshot instead.
 """
@@ -33,6 +34,8 @@ def render(event: dict) -> str:
     dur = f"{duration:8.1f}ms" if duration is not None else "         -"
     stream = event.get("stream") or {}
     extras = []
+    if event.get("tenant"):
+        extras.append(f"tenant={event['tenant']}")
     if event.get("session"):
         extras.append(f"session={event['session']}")
     if stream.get("chunks"):
@@ -104,6 +107,7 @@ def main() -> int:
     parser.add_argument("--url", default="http://localhost:50081")
     parser.add_argument("--outcome", help="filter by outcome (e.g. error)")
     parser.add_argument("--session", help="filter by session id")
+    parser.add_argument("--tenant", help="filter by tenant label")
     parser.add_argument(
         "--kind",
         help="filter by kind (request/session/serving/loop_stall/autoscale)",
@@ -127,6 +131,8 @@ def main() -> int:
         params["outcome"] = args.outcome
     if args.session:
         params["session"] = args.session
+    if args.tenant:
+        params["tenant"] = args.tenant
     if args.kind:
         params["kind"] = args.kind
     if args.min_duration_ms is not None:

@@ -80,6 +80,16 @@ def render_snapshot(snap: dict) -> str:
         )
     if not snap["pods"]:
         lines.append("(no live sandboxes)")
+    tenants = snap.get("tenants")
+    if tenants:
+        # Tenant mix (docs/tenancy.md): who this replica has been serving —
+        # the signal a placement-aware router reads off /v1/fleet.
+        lines.append(
+            "tenants: "
+            + "  ".join(
+                f"{name}={count}" for name, count in sorted(tenants.items())
+            )
+        )
     sess = snap.get("sessions")
     if sess:
         lines.append(

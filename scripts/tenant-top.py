@@ -1,0 +1,133 @@
+#!/usr/bin/env python3
+"""Text rendering of the per-tenant isolation state (docs/tenancy.md).
+
+Fetches ``GET /v1/tenants`` (plus ``GET /v1/slo?tenant=`` burn summaries,
+already merged into the document) from a running service and prints a
+`top`-style table — the quickest answer to "who is eating the service
+right now" without curl+jq gymnastics. ``--watch N`` refreshes every N
+seconds until interrupted.
+
+    python scripts/tenant-top.py [--url http://localhost:50081]
+        [--watch SECONDS]
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+import httpx
+
+
+def fmt_bytes(n: int | float | None) -> str:
+    if not n:
+        return "-"
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if n < 1024:
+            return f"{n:.0f}{unit}"
+        n /= 1024
+    return f"{n:.1f}TiB"
+
+
+def render(doc: dict) -> str:
+    tenants = doc.get("tenants") or {}
+    lines = []
+    header = (
+        f"{'TENANT':<18} {'WEIGHT':>6} {'INFL':>4} {'QUEUED':>6} "
+        f"{'WAIT':>7} {'ADMIT':>7} {'SHED':>6} {'CPU s':>8} "
+        f"{'BYTES':>9} {'SESS':>4} {'BURN':<10}"
+    )
+    lines.append(header)
+    lines.append("-" * len(header))
+    for label in sorted(tenants):
+        row = tenants[label]
+        config = row.get("config") or {}
+        admission = row.get("admission") or {}
+        usage = row.get("usage") or {}
+        slo = row.get("slo") or {}
+        sheds = admission.get("sheds") or {}
+        moved = (
+            (usage.get("uploaded_bytes") or 0)
+            + (usage.get("downloaded_bytes") or 0)
+            + (usage.get("workspace_bytes") or 0)
+        )
+        if slo.get("fast_burn_alerting"):
+            burn = "** PAGE **"
+        elif slo.get("alerting"):
+            burn = "ALERT"
+        elif slo:
+            burn = f"{slo.get('error_budget_remaining_ratio', 1.0):.0%} left"
+        else:
+            burn = "-"
+        lines.append(
+            f"{label:<18} {config.get('weight') or '-':>6} "
+            f"{admission.get('in_flight', 0):>4} "
+            f"{admission.get('queued', 0):>6} "
+            f"{admission.get('queue_wait_avg_ms', 0.0):>5.1f}ms "
+            f"{admission.get('admitted', 0):>7} "
+            f"{sum(sheds.values()):>6} "
+            f"{usage.get('cpu_s', 0.0):>8.2f} "
+            f"{fmt_bytes(moved):>9} "
+            f"{row.get('sessions', 0):>4} {burn:<10}"
+        )
+        if sheds:
+            lines.append(
+                "  " + "  ".join(f"shed[{k}]={v}" for k, v in sorted(sheds.items()))
+            )
+    if not tenants:
+        lines.append("(no tenants recorded yet)")
+    unknown = doc.get("unknown_ids", 0)
+    overflow = doc.get("unknown_overflow", 0)
+    if unknown or overflow:
+        lines.append(
+            f"unknown tenant ids: {unknown} tracked"
+            + (f", {overflow} collapsed into 'other'" if overflow else "")
+        )
+    return "\n".join(lines)
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(
+        description="Render GET /v1/tenants as a text table."
+    )
+    parser.add_argument("--url", default="http://localhost:50081")
+    parser.add_argument(
+        "--watch",
+        type=float,
+        default=0,
+        metavar="SECONDS",
+        help="refresh every N seconds until interrupted (0 = one shot)",
+    )
+    args = parser.parse_args()
+    base = args.url.rstrip("/")
+    try:
+        with httpx.Client(timeout=10.0) as client:
+            while True:
+                try:
+                    response = client.get(f"{base}/v1/tenants")
+                    if response.status_code == 501:
+                        print(
+                            "tenant-top: no tenant registry wired into "
+                            f"{base}",
+                            file=sys.stderr,
+                        )
+                        return 1
+                    print(render(response.raise_for_status().json()))
+                except httpx.HTTPError as e:
+                    print(
+                        f"tenant-top: cannot reach {base}: {e}",
+                        file=sys.stderr,
+                    )
+                    if args.watch <= 0:
+                        return 1
+                if args.watch <= 0:
+                    return 0
+                time.sleep(args.watch)
+                print(f"\n--- {time.strftime('%H:%M:%S')} ---")
+    except KeyboardInterrupt:
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -158,6 +158,17 @@ def test_every_registered_metric_follows_conventions(tmp_path):
         "bci_warm_pop_ratio",
         "bci_pool_target_size",
         "bci_autoscale_decisions_total",
+        # multi-tenant isolation (ISSUE 13): per-tenant admission/quota/
+        # usage surface + the label-cardinality guard's overflow counter
+        "bci_tenant_shed_total",
+        "bci_tenant_admitted_total",
+        "bci_tenant_queue_wait_seconds",
+        "bci_tenant_in_flight",
+        "bci_tenant_queue_depth",
+        "bci_tenant_requests_total",
+        "bci_tenant_cpu_seconds_total",
+        "bci_tenant_bytes_total",
+        "bci_metrics_label_overflow_total",
         # fleet router (ISSUE 11): the replica-aware edge's own surface
         "bci_router_requests_total",
         "bci_router_request_seconds",
@@ -210,6 +221,12 @@ def test_every_registered_metric_follows_conventions(tmp_path):
     assert isinstance(metrics["bci_warm_pop_ratio"], Gauge)
     assert isinstance(metrics["bci_pool_target_size"], Gauge)
     assert isinstance(metrics["bci_autoscale_decisions_total"], Counter)
+    assert isinstance(metrics["bci_tenant_shed_total"], Counter)
+    assert isinstance(metrics["bci_tenant_queue_wait_seconds"], Histogram)
+    assert isinstance(metrics["bci_tenant_in_flight"], Gauge)
+    assert isinstance(metrics["bci_tenant_requests_total"], Counter)
+    assert isinstance(metrics["bci_tenant_cpu_seconds_total"], Counter)
+    assert isinstance(metrics["bci_metrics_label_overflow_total"], Counter)
     assert isinstance(metrics["bci_router_requests_total"], Counter)
     assert isinstance(metrics["bci_router_request_seconds"], Histogram)
     assert isinstance(metrics["bci_router_lease_migrations_total"], Counter)
@@ -330,6 +347,53 @@ def test_every_seconds_histogram_carries_exemplars_when_trace_active(tmp_path):
     fresh = Registry()
     fresh.histogram("bci_plain_seconds", "untraced").observe(0.5)
     assert "trace_id=" not in fresh.expose(openmetrics=True)
+
+
+def test_tenant_label_cardinality_guard_collapses_to_other():
+    """ISSUE 13 satellite: the Registry bounds per-label-value cardinality
+    — a tenant-id flood collapses into one 'other' series past the bound,
+    with every collapsed observation counted, so /metrics cannot OOM."""
+    registry = Registry()
+    registry.bound_label("tenant", 3)
+    shed = registry.counter("bci_tenant_shed_total", "sheds per tenant")
+    for i in range(50):
+        shed.inc(tenant=f"flood-{i}", reason="tenant_quota")
+    text = registry.expose()
+    # exactly 3 distinct tenant series + the collapsed bucket
+    assert text.count('reason="tenant_quota",tenant="flood-') == 3
+    assert (
+        'bci_tenant_shed_total{reason="tenant_quota",tenant="other"} 47'
+        in text
+    )
+    assert 'bci_metrics_label_overflow_total{label="tenant"} 47' in text
+    # already-seen values keep their own series (no flapping to "other")
+    shed.inc(tenant="flood-0", reason="tenant_quota")
+    assert (
+        'bci_tenant_shed_total{reason="tenant_quota",tenant="flood-0"} 2'
+        in registry.expose()
+    )
+    # histograms and gauges honor the same bound
+    hist = registry.histogram("bci_tenant_queue_wait_seconds", "wait")
+    for i in range(10):
+        hist.observe(0.01, tenant=f"h-{i}")
+    om = registry.expose()
+    assert om.count("bci_tenant_queue_wait_seconds_count") <= 4
+    gauge_values = iter(range(100))
+    for i in range(10):
+        registry.gauge(
+            "bci_tenant_in_flight", "in flight",
+            (lambda v: lambda: v)(next(gauge_values)),
+            tenant=f"g-{i}",
+        )
+    assert registry.expose().count("bci_tenant_in_flight{tenant=") <= 4
+
+    # every registry ships a default bound for the tenant label: even a
+    # bare Registry cannot be flooded
+    bare = Registry()
+    c = bare.counter("bci_tenant_requests_total", "reqs")
+    for i in range(100):
+        c.inc(tenant=f"t-{i}")
+    assert 'tenant="other"' in bare.expose()
 
 
 def test_openmetrics_counter_family_drops_total_suffix():

@@ -1,0 +1,682 @@
+"""Multi-tenant isolation acceptance (ISSUE 13): identity resolution at
+both edges, weighted-fair admission under saturation, per-tenant quota
+verdicts on both transports, per-tenant SLO slices / usage metering /
+session caps, the shed-after-wait demand-accounting regression, and the
+chaos scenario 15 tier-1 twin (one abusive tenant floods 100x its quota
+through the real HTTP edge over the fake-pod stack; everyone else's
+latency, sheds, and error budgets are provably untouched)."""
+
+import asyncio
+import statistics
+import time
+
+import grpc.aio
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from bee_code_interpreter_tpu.api.grpc_server import (
+    GrpcServer,
+    observability_stubs,
+    service_stubs,
+)
+from bee_code_interpreter_tpu.api.http_server import create_http_server
+from bee_code_interpreter_tpu.config import Config
+from bee_code_interpreter_tpu.observability import (
+    DemandTracker,
+    FlightRecorder,
+    SloEngine,
+    Tracer,
+    parse_objectives,
+)
+from bee_code_interpreter_tpu.proto import code_interpreter_pb2 as pb
+from bee_code_interpreter_tpu.resilience import (
+    AdmissionController,
+    AdmissionRejected,
+)
+from bee_code_interpreter_tpu.services.code_executor import Result
+from bee_code_interpreter_tpu.services.custom_tool_executor import CustomToolExecutor
+from bee_code_interpreter_tpu.services.kubernetes_code_executor import (
+    KubernetesCodeExecutor,
+)
+from bee_code_interpreter_tpu.sessions import SessionLimitExceeded, SessionManager
+from bee_code_interpreter_tpu.tenancy import (
+    TENANT_HEADER,
+    TenantRegistry,
+    bearer_token,
+    parse_tenants,
+    tenant_scope,
+)
+from bee_code_interpreter_tpu.utils.metrics import Registry
+from tests.chaos import FaultPlan, ManualClock
+from tests.fakes import FakeExecutorPods, FakeKubectl
+
+pytestmark = pytest.mark.chaos
+
+
+class EchoExecutor:
+    async def execute(self, source_code, files=None, env=None, timeout_s=None,
+                      deadline=None):
+        return Result(stdout="ok\n", stderr="", exit_code=0, files={})
+
+
+def make_app(executor, admission, metrics, tenancy, slo=None, **kwargs):
+    return create_http_server(
+        code_executor=executor,
+        custom_tool_executor=CustomToolExecutor(code_executor=executor),
+        metrics=metrics,
+        admission=admission,
+        request_deadline_s=30.0,
+        tenancy=tenancy,
+        slo=slo,
+        **kwargs,
+    )
+
+
+# ----------------------------------------------------------------- grammar
+
+
+def test_parse_tenants_grammar_and_default_catch_all():
+    tenants = parse_tenants(
+        "alpha:weight=4:max_in_flight=8:rps=20,beta:weight=1:rps=5:burst=10,"
+        "gold:key=sk-gold:sessions=2"
+    )
+    assert tenants["alpha"].weight == 4.0
+    assert tenants["alpha"].max_in_flight == 8
+    assert tenants["alpha"].rps == 20.0
+    assert tenants["alpha"].burst_depth == 20.0  # default burst = rps
+    assert tenants["beta"].burst_depth == 10.0
+    assert tenants["gold"].api_key == "sk-gold"
+    assert tenants["gold"].max_sessions == 2
+    # the catch-all is implied, unlimited
+    assert tenants["default"].rps is None
+    assert tenants["default"].max_in_flight is None
+
+    # a declared default customizes the catch-all instead
+    tenants = parse_tenants("default:weight=2:rps=3")
+    assert tenants["default"].weight == 2.0
+
+
+@pytest.mark.parametrize(
+    "bad",
+    [
+        "alpha:weight=0",  # weight must be > 0
+        "alpha:rps=-1",
+        "alpha:nope=1",  # unknown attribute
+        "alpha:weight",  # not key=value
+        "alpha,alpha",  # duplicate
+        "a:key=k,b:key=k",  # duplicate API key
+    ],
+)
+def test_parse_tenants_rejects_malformed_specs(bad):
+    with pytest.raises(ValueError):
+        parse_tenants(bad)
+
+
+def test_registry_resolution_and_bounded_unknown_labels():
+    registry = TenantRegistry(
+        parse_tenants("alpha:weight=2,gold:key=sk-gold"), max_labels=3
+    )
+    assert registry.resolve("alpha").tenant.id == "alpha"
+    # API key wins, header unnecessary
+    assert registry.resolve(None, api_key="sk-gold").tenant.id == "gold"
+    assert bearer_token("Bearer sk-gold") == "sk-gold"
+    assert bearer_token("Basic abc") is None
+    # anonymous -> default
+    anon = registry.resolve(None)
+    assert anon.tenant.id == "default" and anon.label == "default"
+    # unknown ids share the default tenant's lane with a bounded label
+    u1 = registry.resolve("mystery-1")
+    assert u1.tenant.id == "default" and u1.label == "mystery-1"
+    for i in range(5):
+        registry.resolve(f"flood-{i}")
+    overflowed = registry.resolve("flood-99")
+    assert overflowed.label == "other"
+    assert registry.unknown_overflow >= 1
+    # hostile ids are sanitized before becoming labels
+    hostile = registry.resolve('evil"\n' + "x" * 200)
+    assert '"' not in hostile.label and "\n" not in hostile.label
+    assert len(hostile.label) <= 64
+
+
+# --------------------------------------------------------------------- WFQ
+
+
+async def test_wfq_grants_track_weights_under_saturation():
+    """The WFQ math: with three saturated tenants weighted 4:2:1 over ONE
+    execution slot, the grant mix over a full backlog tracks the weights
+    within +/-10% — arrival order stops mattering."""
+    registry = TenantRegistry(parse_tenants("a:weight=4,b:weight=2,c:weight=1"))
+    # ManualClock: the DRR math must not depend on wall time at all — the
+    # token buckets (the only clock consumer) stay frozen throughout.
+    admission = AdmissionController(
+        max_in_flight=1, max_queue=1000, tenancy=registry, clock=ManualClock()
+    )
+    release = asyncio.Event()
+    order: list[str] = []
+
+    async def blocker():
+        async with admission.admit(tenant=registry.resolve("a")):
+            await release.wait()
+
+    async def one(name: str):
+        async with admission.admit(tenant=registry.resolve(name)):
+            order.append(name)
+
+    holder = asyncio.create_task(blocker())
+    while admission.in_flight < 1:
+        await asyncio.sleep(0.001)
+    per_tenant = 30
+    tasks = [
+        asyncio.create_task(one(name))
+        for _ in range(per_tenant)
+        for name in ("c", "b", "a")  # adversarial arrival order
+    ]
+    while admission.queue_depth < 3 * per_tenant:
+        await asyncio.sleep(0.001)
+    release.set()
+    await holder
+    await asyncio.gather(*tasks)
+    assert len(order) == 3 * per_tenant
+
+    # While ALL three tenants still have backlog, shares must track the
+    # weights within 10%. a (weight 4) drains its 30-deep queue first,
+    # after ~30/4 rounds of 7 grants — 49 grants is safely inside that.
+    window = order[: 7 * 7]
+    for name, weight in (("a", 4), ("b", 2), ("c", 1)):
+        share = window.count(name) / len(window)
+        assert abs(share - weight / 7) <= 0.10 * weight / 7 + 1 / len(window), (
+            name, share, window[:21],
+        )
+
+
+async def test_tenant_concurrency_cap_queues_not_starves():
+    """A tenant over its max_in_flight queues in ITS lane while other
+    tenants keep flowing through the free global slots."""
+    registry = TenantRegistry(parse_tenants("small:max_in_flight=1,big:weight=1"))
+    admission = AdmissionController(max_in_flight=4, max_queue=16, tenancy=registry)
+    small_gate = asyncio.Event()
+    done: list[str] = []
+
+    async def small_hold():
+        async with admission.admit(tenant=registry.resolve("small")):
+            await small_gate.wait()
+
+    async def small_second():
+        async with admission.admit(tenant=registry.resolve("small")):
+            done.append("small2")
+
+    async def big():
+        async with admission.admit(tenant=registry.resolve("big")):
+            done.append("big")
+
+    holder = asyncio.create_task(small_hold())
+    while admission.in_flight < 1:
+        await asyncio.sleep(0.001)
+    second = asyncio.create_task(small_second())
+    while admission.queue_depth < 1:
+        await asyncio.sleep(0.001)
+    # big sails past the queued small request (global slots are free)
+    await asyncio.wait_for(big(), timeout=2.0)
+    assert done == ["big"]
+    assert not second.done()
+    small_gate.set()
+    await holder
+    await asyncio.wait_for(second, timeout=2.0)
+    assert done == ["big", "small2"]
+
+
+async def test_solo_backlog_cannot_bankrupt_a_lane():
+    """Review regression: a lane served solo (the single-eligible dispatch
+    path skips top-ups) must not accrue unbounded deficit debt — otherwise
+    the moment a second tenant starts queuing, the weights invert until
+    the debt is paid off and the HIGH-weight tenant is starved."""
+    from bee_code_interpreter_tpu.resilience.admission import (
+        _DEFICIT_CAP_ROUNDS,
+        _REQUEST_COST,
+    )
+
+    registry = TenantRegistry(parse_tenants("a:weight=4,b:weight=1"))
+    admission = AdmissionController(
+        max_in_flight=1, max_queue=200, tenancy=registry, clock=ManualClock()
+    )
+    order: list[str] = []
+    admitted_gates: list[asyncio.Event] = []
+
+    async def one(name: str):
+        gate = asyncio.Event()
+        async with admission.admit(tenant=registry.resolve(name)):
+            order.append(name)
+            admitted_gates.append(gate)
+            await gate.wait()
+
+    async def serve_until(n: int) -> None:
+        while len(order) < n:
+            if admitted_gates:
+                admitted_gates[-1].set()
+            await asyncio.sleep(0.001)
+
+    tasks = [asyncio.create_task(one("a")) for _ in range(50)]
+    while admission.queue_depth < 49:
+        await asyncio.sleep(0.001)
+    # Serve 40 solo grants while a's queue STAYS non-empty (no idle reset).
+    await serve_until(41)
+    lane = admission._lane_for(registry.resolve("a"))
+    floor = -lane.tenant.weight * _DEFICIT_CAP_ROUNDS
+    assert lane.deficit >= floor - _REQUEST_COST, lane.deficit
+    # A second tenant arriving now is not handed an inverted schedule:
+    # a's bounded debt pays off within a few rounds and both keep flowing.
+    b_tasks = [asyncio.create_task(one("b")) for _ in range(5)]
+    while admission.queue_depth < 14:
+        await asyncio.sleep(0.001)
+    await serve_until(55)
+    admitted_gates[-1].set()
+    await asyncio.gather(*tasks, *b_tasks)
+    mixed = order[41:]
+    assert "a" in mixed[:8] and "b" in mixed[:8], mixed
+
+
+# ----------------------------------------------------- quota verdicts: HTTP
+
+
+async def test_http_tenant_rate_quota_sheds_429_tenant_quota():
+    clock = ManualClock(100.0)
+    registry = TenantRegistry(parse_tenants("alpha:rps=1:burst=1"))
+    metrics = Registry()
+    admission = AdmissionController(
+        max_in_flight=8, max_queue=8, metrics=metrics, tenancy=registry,
+        clock=clock,
+    )
+    client = TestClient(
+        TestServer(make_app(EchoExecutor(), admission, metrics, registry))
+    )
+    await client.start_server()
+    try:
+        headers = {TENANT_HEADER: "alpha"}
+        body = {"source_code": "print(1)"}
+        r1 = await client.post("/v1/execute", json=body, headers=headers)
+        assert r1.status == 200
+        r2 = await client.post("/v1/execute", json=body, headers=headers)
+        assert r2.status == 429
+        payload = await r2.json()
+        assert payload["reason"] == "tenant_quota"
+        assert "tenant_quota" in payload["detail"]
+        assert int(r2.headers["Retry-After"]) >= 1
+        # other tenants are untouched by alpha's quota
+        r3 = await client.post(
+            "/v1/execute", json=body, headers={TENANT_HEADER: "someone-else"}
+        )
+        assert r3.status == 200
+        # the bucket refills with time
+        clock.advance(1.5)
+        r4 = await client.post("/v1/execute", json=body, headers=headers)
+        assert r4.status == 200
+        text = metrics.expose()
+        assert (
+            'bci_tenant_shed_total{reason="tenant_quota",tenant="alpha"} 1'
+            in text
+        )
+        # /v1/tenants carries the same verdict
+        snap = await (await client.get("/v1/tenants")).json()
+        assert snap["tenants"]["alpha"]["admission"]["sheds"] == {
+            "tenant_quota": 1
+        }
+        assert snap["tenants"]["alpha"]["usage"]["sheds"] == 1
+    finally:
+        await client.close()
+
+
+# ----------------------------------------------------- quota verdicts: gRPC
+
+
+async def test_grpc_tenant_rate_quota_resource_exhausted():
+    clock = ManualClock(100.0)
+    registry = TenantRegistry(parse_tenants("alpha:rps=1:burst=1"))
+    admission = AdmissionController(
+        max_in_flight=8, max_queue=8, tenancy=registry, clock=clock
+    )
+    executor = EchoExecutor()
+    server = GrpcServer(
+        code_executor=executor,
+        custom_tool_executor=CustomToolExecutor(code_executor=executor),
+        admission=admission,
+        request_deadline_s=30.0,
+        tenancy=registry,
+    )
+    port = await server.start("127.0.0.1:0")
+    try:
+        async with grpc.aio.insecure_channel(f"127.0.0.1:{port}") as channel:
+            stubs = service_stubs(channel)
+            req = pb.ExecuteRequest(source_code="print(1)")
+            metadata = (("x-tenant-id", "alpha"),)
+            resp = await stubs["Execute"](req, metadata=metadata)
+            assert resp.stdout == "ok\n"
+            with pytest.raises(grpc.aio.AioRpcError) as exc:
+                await stubs["Execute"](req, metadata=metadata)
+            assert exc.value.code() == grpc.StatusCode.RESOURCE_EXHAUSTED
+            assert "tenant_quota" in exc.value.details()
+            # anonymous traffic shares the (unlimited) default lane
+            resp = await stubs["Execute"](req)
+            assert resp.stdout == "ok\n"
+            # the GetTenants mirror reports the shed
+            import json as _json
+
+            obs = observability_stubs(channel)
+            snap = _json.loads(await obs["GetTenants"](b""))
+            assert (
+                snap["tenants"]["alpha"]["admission"]["sheds"]["tenant_quota"]
+                == 1
+            )
+    finally:
+        await server.stop(None)
+
+
+# ------------------------------------------------ shed-after-wait regression
+
+
+async def test_shed_after_wait_releases_demand_sample_exactly_once():
+    """Regression (ISSUE 13 bugfix): a queued waiter that is shed after
+    waiting — including one whose slot grant races its abandonment — must
+    produce exactly ONE demand-tracker shed, ZERO admitted samples, and
+    return the granted slot, never leak it."""
+    demand = DemandTracker()
+    admission = AdmissionController(
+        max_in_flight=1, max_queue=4, demand=demand
+    )
+    lane = admission._lane_for(None)
+
+    # The race, reproduced white-box: a waiter is granted by dispatch and
+    # abandoned (its wait timed out) before it could proceed.
+    fut = asyncio.get_running_loop().create_future()
+    lane.waiters.append(fut)
+    admission._queued += 1
+    admission._dispatch()
+    assert fut.done() and admission.in_flight == 1
+    admission._abandon_wait(fut, lane)
+    assert admission.in_flight == 0  # the granted slot came back exactly once
+    assert admission.queue_depth == 0
+
+    # End-to-end: a waiter behind a stuck holder sheds at its queue bound.
+    from bee_code_interpreter_tpu.resilience import Deadline
+
+    release = asyncio.Event()
+
+    async def holder():
+        async with admission.admit():
+            await release.wait()
+
+    task = asyncio.create_task(holder())
+    while admission.in_flight < 1:
+        await asyncio.sleep(0.001)
+    with pytest.raises(AdmissionRejected) as exc:
+        async with admission.admit(deadline=Deadline.after(0.05)):
+            raise AssertionError("must shed, not admit")
+    assert exc.value.reason == "queue_timeout"
+    release.set()
+    await task
+    assert admission.in_flight == 0 and admission.queue_depth == 0
+    # demand ledger: 2 arrivals (holder + waiter), 1 admitted, 1 shed —
+    # the shed waiter contributed exactly one shed and no admitted sample.
+    assert demand.arrivals_total == 2
+    assert demand.sheds_total == 1
+    admitted = sum(b.admitted for b in demand._buckets.values())
+    assert admitted == 1
+    # and the gate still works (no leaked slot or phantom queue entry)
+    async with admission.admit():
+        pass
+
+
+# ------------------------------------------------------------ retry budgets
+
+
+async def test_tenant_retry_budget_fails_fast_when_exhausted():
+    clock = ManualClock(50.0)
+    registry = TenantRegistry(parse_tenants("alpha:rps=10"))
+    admission = AdmissionController(tenancy=registry, clock=clock)
+    ctx = registry.resolve("alpha")
+    spend = admission.tenant_retry_budget(ctx)
+    assert spend is not None
+    # burst of 10 retry tokens, then dry until time passes
+    assert all(spend() for _ in range(10))
+    assert spend() is False
+    clock.advance(1.0)  # 10 rps * 10% = 1 retry token per second
+    assert spend() is True
+    assert spend() is False
+
+    # unlimited tenants get no budget: pre-tenancy retry behavior
+    assert admission.tenant_retry_budget(registry.resolve(None)) is None
+
+    # the retry loop consults the ambient budget and fails fast
+    from bee_code_interpreter_tpu.resilience.retry import RetryPolicy, retryable
+
+    class Flaky:
+        policy = RetryPolicy(attempts=3, wait_min_s=0.001, wait_max_s=0.002)
+        calls = 0
+
+        @retryable("policy", "flaky-op")
+        async def run(self):
+            self.calls += 1
+            raise RuntimeError("transient")
+
+    ctx.retry_budget = lambda: False  # budget already dry
+    flaky = Flaky()
+    with tenant_scope(ctx):
+        with pytest.raises(RuntimeError):
+            await flaky.run()
+    assert flaky.calls == 1  # failed fast: no retry attempts burned
+
+    flaky2 = Flaky()
+    with pytest.raises(RuntimeError):
+        await flaky2.run()  # outside any tenant scope: retries as before
+    assert flaky2.calls == 3
+
+
+# ------------------------------------------------------- session tenant caps
+
+
+async def test_per_tenant_session_cap_429(storage, tmp_path):
+    pods = FakeExecutorPods(tmp_path / "pods")
+    config = Config(
+        executor_backend="kubernetes",
+        executor_port=pods.port,
+        executor_pod_queue_target_length=3,
+        pod_ready_timeout_s=5,
+        executor_retry_attempts=1,
+    )
+    k8s = KubernetesCodeExecutor(
+        kubectl=FakeKubectl(pods), storage=storage, config=config,
+        ip_poll_interval_s=0.02,
+    )
+    registry = TenantRegistry(parse_tenants("alpha:sessions=1,beta:weight=1"))
+    manager = SessionManager(k8s, storage, max_sessions=8)
+    try:
+        await k8s.fill_executor_pod_queue()
+        with tenant_scope(registry.resolve("alpha")):
+            first = await manager.create()
+            with pytest.raises(SessionLimitExceeded) as exc:
+                await manager.create()
+            assert "alpha" in str(exc.value)
+        # beta (and the global cap) are untouched by alpha's cap
+        with tenant_scope(registry.resolve("beta")):
+            second = await manager.create()
+        assert manager.tenant_counts() == {"alpha": 1, "beta": 1}
+        assert manager.snapshot()["by_tenant"] == {"alpha": 1, "beta": 1}
+        # releasing frees alpha's slot
+        await manager.release(first.session_id)
+        with tenant_scope(registry.resolve("alpha")):
+            third = await manager.create()
+        await manager.release(second.session_id)
+        await manager.release(third.session_id)
+    finally:
+        await manager.close_all()
+        await k8s.aclose()
+        await pods.close()
+
+
+async def test_default_session_cap_not_multiplied_by_spoofed_ids(
+    storage, tmp_path
+):
+    """Review regression: unknown X-Tenant-Id values share the DEFAULT
+    tenant's session allotment — each spoofed id must not get a fresh
+    quota (the cap is keyed on the resolved tenant, not the label)."""
+    pods = FakeExecutorPods(tmp_path / "pods-spoof")
+    config = Config(
+        executor_backend="kubernetes",
+        executor_port=pods.port,
+        executor_pod_queue_target_length=2,
+        pod_ready_timeout_s=5,
+        executor_retry_attempts=1,
+    )
+    k8s = KubernetesCodeExecutor(
+        kubectl=FakeKubectl(pods), storage=storage, config=config,
+        ip_poll_interval_s=0.02,
+    )
+    registry = TenantRegistry(parse_tenants("default:sessions=1"))
+    manager = SessionManager(k8s, storage, max_sessions=8)
+    try:
+        await k8s.fill_executor_pod_queue()
+        with tenant_scope(registry.resolve("spoof-1")):
+            first = await manager.create()
+        with tenant_scope(registry.resolve("spoof-2")):
+            with pytest.raises(SessionLimitExceeded) as exc:
+                await manager.create()
+        assert "default" in str(exc.value)
+        # the label still shows WHO held the lease
+        assert manager.tenant_counts() == {"spoof-1": 1}
+        await manager.release(first.session_id)
+    finally:
+        await manager.close_all()
+        await k8s.aclose()
+        await pods.close()
+
+
+# ------------------------------------------------- chaos scenario 15 (twin)
+
+
+async def test_chaos15_twin_abusive_tenant_cannot_touch_the_others(
+    storage, tmp_path
+):
+    """One tenant floods 100x its rate quota through the REAL HTTP edge
+    over the fake-pod stack. The victims' p50 stays within 10% of their
+    no-abuse baseline, ZERO victim requests shed, victim SLO burn alerts
+    stay silent — and the abuser's sheds are accounted exactly once across
+    bci_tenant_shed_total <-> the wide events <-> /v1/tenants."""
+    faults = FaultPlan()
+    pods = FakeExecutorPods(tmp_path / "pods15", faults=faults)
+    config = Config(
+        executor_backend="kubernetes",
+        executor_port=pods.port,
+        executor_pod_queue_target_length=2,
+        pod_ready_timeout_s=5,
+        executor_retry_attempts=1,
+    )
+    metrics = Registry()
+    k8s = KubernetesCodeExecutor(
+        kubectl=FakeKubectl(pods), storage=storage, config=config,
+        metrics=metrics, ip_poll_interval_s=0.02,
+    )
+    registry = TenantRegistry(
+        parse_tenants("abuser:weight=1:rps=2:burst=2,victim:weight=4"),
+        metrics=metrics,
+    )
+    admission = AdmissionController(
+        max_in_flight=4, max_queue=8, retry_after_s=0.2,
+        metrics=metrics, tenancy=registry,
+    )
+    slo = SloEngine(parse_objectives(99.5, None), metrics=metrics)
+    tracer = Tracer(metrics=metrics)
+    recorder = FlightRecorder(max_events=2048, metrics=metrics)
+    tracer.add_sink(recorder.record_trace)
+    app = create_http_server(
+        code_executor=k8s,
+        custom_tool_executor=CustomToolExecutor(code_executor=k8s),
+        metrics=metrics,
+        admission=admission,
+        request_deadline_s=30.0,
+        tracer=tracer,
+        recorder=recorder,
+        slo=slo,
+        tenancy=registry,
+    )
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    N_ABUSE = 200  # 100x the abuser's burst-2 bucket
+    try:
+        await k8s.fill_executor_pod_queue()
+        body = {"source_code": "print('ok')"}
+
+        async def victim_request() -> float:
+            t0 = time.perf_counter()
+            resp = await client.post(
+                "/v1/execute", json=body, headers={TENANT_HEADER: "victim"}
+            )
+            assert resp.status == 200, await resp.text()
+            return time.perf_counter() - t0
+
+        # Baseline: the victim alone, paced.
+        baseline = []
+        for _ in range(15):
+            baseline.append(await victim_request())
+            await asyncio.sleep(0.02)
+        p50_base = statistics.median(baseline)
+
+        async def abuse() -> None:
+            await client.post(
+                "/v1/execute", json=body, headers={TENANT_HEADER: "abuser"}
+            )
+
+        # The flood: 100x quota, concurrent with the victim's steady trickle.
+        flood = [asyncio.create_task(abuse()) for _ in range(N_ABUSE)]
+        during = []
+        for _ in range(15):
+            during.append(await victim_request())
+            await asyncio.sleep(0.02)
+        await asyncio.gather(*flood)
+        p50_during = statistics.median(during)
+
+        # Victim latency provably untouched (10% + scheduling-jitter floor).
+        assert p50_during <= p50_base * 1.10 + 0.005, (p50_base, p50_during)
+
+        # ZERO victim sheds, on every ledger.
+        victim_lane = admission.tenant_snapshot()["victim"]
+        assert victim_lane["sheds"] == {}
+        assert recorder.events(outcome="shed", tenant="victim") == []
+        tenants_doc = await (await client.get("/v1/tenants")).json()
+        assert tenants_doc["tenants"]["victim"]["usage"]["sheds"] == 0
+
+        # The victim's SLO slice is silent; the global page alert too.
+        victim_slo = await (
+            await client.get("/v1/slo", params={"tenant": "victim"})
+        ).json()
+        assert victim_slo["fast_burn_alerting"] is False
+        assert victim_slo["alerting"] is False
+        global_slo = await (await client.get("/v1/slo")).json()
+        assert global_slo["fast_burn_alerting"] is False
+
+        # The abuser's sheds are real and accounted EXACTLY ONCE across
+        # counter <-> wide events <-> /v1/tenants.
+        abuser_lane = admission.tenant_snapshot()["abuser"]
+        shed_count = sum(abuser_lane["sheds"].values())
+        assert shed_count > 0
+        assert shed_count + abuser_lane["admitted"] == N_ABUSE
+        counter_total = sum(
+            v
+            for key, v in metrics.metrics["bci_tenant_shed_total"]
+            ._values.items()
+            if ("tenant", "abuser") in key
+        )
+        assert counter_total == shed_count
+        wide_sheds = recorder.events(
+            outcome="shed", tenant="abuser", limit=10_000
+        )
+        assert len(wide_sheds) == shed_count
+        assert (
+            tenants_doc["tenants"]["abuser"]["usage"]["sheds"] == shed_count
+        )
+        # the fleet view exports the tenant mix for the router
+        fleet_doc = await (await client.get("/v1/fleet")).json()
+        assert fleet_doc["tenants"]["victim"] == 30
+        assert fleet_doc["tenants"]["abuser"] == N_ABUSE
+    finally:
+        await client.close()
+        await k8s.aclose()
+        await pods.close()
