@@ -12,10 +12,15 @@ Two halves (docs/analysis.md):
   data plane).
 - **Self-analysis** — ``asynclint.py`` turns the same machinery on our own
   control-plane packages (the scope is DERIVED from the package tree so a
-  new subsystem is linted by default), and ``concurrencylint.py`` adds the
+  new subsystem is linted by default), ``concurrencylint.py`` adds the
   await-aware rules (RMW across await, lock leaks, self-deadlocks,
   unawaited teardown, cross-thread loop touches) on top of the
-  ``dataflow.py`` CFG engine — both enforced in tier-1.
+  ``dataflow.py`` CFG engine, and ``jaxlint.py`` owns the OTHER half of
+  the tree — the accelerator stack (``models/``, ``parallel/``, ``ops/``,
+  ``runtime/shim/``) the asyncio lints exclude — with TPU-throughput
+  rules (host-sync-in-hot-loop, retrace hazards, missing donation,
+  traced Python branches, unbound collective axes). All three enforced
+  in tier-1.
 
 Layered like ``resilience/`` and ``observability/``: primitives here,
 wiring at the edges (api/, services/, runtime/).
@@ -38,6 +43,12 @@ from bee_code_interpreter_tpu.analysis.dataflow import (
     EXIT,
     FunctionFlow,
     iter_scopes,
+)
+from bee_code_interpreter_tpu.analysis.jaxlint import (
+    ACCELERATOR_SCOPE,
+    JaxLintReport,
+    lint_jax_paths,
+    lint_jax_source,
 )
 from bee_code_interpreter_tpu.analysis.sarif import sarif_log, tool_run
 from bee_code_interpreter_tpu.analysis.context import (
@@ -63,6 +74,7 @@ from bee_code_interpreter_tpu.analysis.policy import (
 )
 
 __all__ = [
+    "ACCELERATOR_SCOPE",
     "AnalysisVerdict",
     "COST_CLASSES",
     "CallSite",
@@ -71,6 +83,7 @@ __all__ = [
     "Finding",
     "FunctionFlow",
     "HEAVY_COST_CLASSES",
+    "JaxLintReport",
     "LintReport",
     "PolicyEngine",
     "SHAPES",
@@ -84,6 +97,8 @@ __all__ = [
     "iter_scopes",
     "lint_concurrency_paths",
     "lint_concurrency_source",
+    "lint_jax_paths",
+    "lint_jax_source",
     "lint_paths",
     "lint_source",
     "predicted_deps",

@@ -58,7 +58,10 @@ REPO_ROOT = PACKAGE_ROOT.parent
 # control plane: model/kernel code (models/, parallel/, ops/) and the
 # sandbox-side sitecustomize shim (runtime/shim/, which runs inside the
 # pod's interpreter, not our event loop). Entries may be top-level package
-# names or `pkg/subtree` path prefixes.
+# names or `pkg/subtree` path prefixes. These excluded trees are NOT
+# unlinted: they are exactly jaxlint's ACCELERATOR_SCOPE (which imports
+# this very tuple), so the two lint families partition the package and a
+# module added anywhere lands in one of them by construction.
 DEFAULT_EXCLUDES = (
     "models",
     "parallel",

@@ -67,6 +67,8 @@ from bee_code_interpreter_tpu.analysis.inspect import (
 #: Packages the concurrency lint additionally skips beyond asynclint's
 #: excludes: generated proto stubs, the in-sandbox runtime (its own process,
 #: not this event loop), and leaf util/model/kernel code with no async state.
+#: (asynclint's excluded accelerator trees — models/, parallel/, ops/,
+#: runtime/shim/ — are owned by jaxlint, not skipped.)
 EXTRA_EXCLUDES = ("proto", "runtime", "utils")
 
 _TEARDOWN_METHODS = ("aclose", "stop")

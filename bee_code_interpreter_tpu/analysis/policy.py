@@ -50,10 +50,24 @@ _OS_EXEC_PREFIXES = ("os.exec", "os.spawn", "os.posix_spawn")
 # --- cost classification (docs/analysis.md "Cost classes") ----------------
 #: The closed label set of ``bci_analysis_cost_class_total{class}`` and the
 #: ``cost_class`` hint on spans / wide events / ``ExecuteResponse.analysis``.
-COST_CLASSES = ("cheap", "loopy", "io_heavy", "install_heavy")
+COST_CLASSES = ("cheap", "loopy", "io_heavy", "install_heavy", "accelerator")
 #: Cost classes the cost-aware admission gate (APP_ADMISSION_COST_AWARE)
 #: treats as heavy-lane work.
-HEAVY_COST_CLASSES = frozenset({"io_heavy", "install_heavy"})
+HEAVY_COST_CLASSES = frozenset({"io_heavy", "install_heavy", "accelerator"})
+
+#: Top-level imports that mark a submission as ACCELERATOR-bound: the ML
+#: frameworks the image pins (runtime/dep_guess.SKIP's accelerator block —
+#: importing them never predicts a pip install, so this check is the only
+#: signal) plus the wider framework family. Checked against the import set
+#: the one AST pass already collected — a jax-free submission pays a set
+#: intersection, nothing else (the <1 ms gate budget, bench-asserted).
+ACCELERATOR_IMPORTS = frozenset(
+    {
+        "jax", "jaxlib", "libtpu", "flax", "optax", "orbax", "chex",
+        "haiku", "pallas", "torch", "torch_xla", "functorch", "triton",
+        "tensorflow", "keras", "cupy",
+    }
+)
 
 #: Blocking-I/O call sites (alias-resolved names/prefixes): their presence
 #: upgrades a workload to ``io_heavy`` — wall-clock the sandbox will spend
@@ -73,11 +87,18 @@ _IO_PREFIXES = ("requests.", "subprocess.", "http.client.", "urllib3.")
 
 def classify_cost(inspection: SourceInspection) -> str:
     """One of :data:`COST_CLASSES` for an analyzable submission, by
-    dominant predicted expense: a pip install dwarfs everything
+    dominant predicted expense — except ``accelerator``, which is checked
+    FIRST because it is a PLACEMENT signal, not an expense rank: a
+    jax/torch submission belongs on a TPU-capable replica whatever else
+    it does (the ``/v1/fleet`` cost-mix export is the router's view), and
+    the image-pinned frameworks never appear in ``predicted_deps`` so no
+    other class can witness them. Then: a pip install dwarfs everything
     (``install_heavy``), blocking I/O dwarfs compute (``io_heavy``),
     nested loops mark compute-bound work (``loopy``), the rest is
     ``cheap``. Single-pass over facts the inspection already collected —
     the hint must fit inside the gate's <1 ms budget."""
+    if inspection.imports & ACCELERATOR_IMPORTS:
+        return "accelerator"
     if inspection.predicted_deps:
         return "install_heavy"
     for c in inspection.calls:

@@ -1596,8 +1596,20 @@ class ContinuousBatcher:
             self.row_sampling[row].logprobs or self.row_sampling[row].steered
             for row in active_rows
         )
-        greedy = np.asarray(
-            jnp.argmax(logits[:, -1, :], axis=-1), dtype=np.int32
+        # ...and the device argmax + its [B] pull only runs when some
+        # active row actually decodes greedily (sampled/steered rows pick
+        # from lg): an all-sampled batch was paying an argmax kernel and a
+        # host sync per token for an array nobody read — found by the
+        # jaxlint host-sync audit (docs/analysis.md "Accelerator lint"),
+        # A/B'd with serving_bench(temperature>0)
+        need_greedy = any(
+            self.row_sampling[row].temperature <= 0.0
+            and not self.row_sampling[row].steered
+            for row in active_rows
+        )
+        greedy = (
+            np.asarray(jnp.argmax(logits[:, -1, :], axis=-1), dtype=np.int32)
+            if need_greedy else None
         )
         lg = (
             np.asarray(logits[:, -1, :], dtype=np.float32)

@@ -53,10 +53,16 @@ def run_serving_bench(
     max_batch: int = 8,
     overhead_budget_pct: float = 5.0,
     inner: int = 2,
+    temperature: float = 0.0,
 ) -> dict:
     """One serving bench run; returns the BENCH-artifact dict (see module
     docstring). Deterministic workload (fixed seeds, greedy decode) so the
-    two arms execute identical token streams."""
+    two arms execute identical token streams. ``temperature`` > 0 runs the
+    SAMPLED decode path instead (per-request seeded generators — still
+    deterministic, still arm-identical): the A/B lever for host/device
+    split changes that only show on the sampling path, e.g. the jaxlint
+    host-sync audit's lazy-greedy fix (docs/analysis.md "Accelerator
+    lint")."""
     import dataclasses
 
     import jax
@@ -65,7 +71,10 @@ def run_serving_bench(
 
     from bee_code_interpreter_tpu.models import transformer as T
     from bee_code_interpreter_tpu.models.engine import Engine
-    from bee_code_interpreter_tpu.models.serving import ContinuousBatcher
+    from bee_code_interpreter_tpu.models.serving import (
+        ContinuousBatcher,
+        SamplingParams,
+    )
     from bee_code_interpreter_tpu.observability import (
         FlightRecorder,
         ServingMonitor,
@@ -107,10 +116,18 @@ def run_serving_bench(
             return engine, monitor
         return Engine(ContinuousBatcher(params, config, **geometry)), None
 
+    sampling = [
+        SamplingParams(temperature=temperature, seed=100 + i)
+        if temperature > 0.0
+        else None
+        for i in range(n_requests)
+    ]
+
     def run_once(engine) -> tuple[float, list[int]]:
         t0 = time.perf_counter()
         tickets = [
-            engine.submit(p, max_new_tokens) for p in prompts
+            engine.submit(p, max_new_tokens, sampling=s)
+            for p, s in zip(prompts, sampling)
         ]
         engine.run_to_completion()
         dt = time.perf_counter() - t0
@@ -186,5 +203,9 @@ def run_serving_bench(
         "requests": n_requests,
         "max_new_tokens": max_new_tokens,
         "repeats": repeats,
-        "config": "tiny f32, greedy, paged pool",
+        "config": (
+            "tiny f32, "
+            + (f"sampled T={temperature}" if temperature > 0.0 else "greedy")
+            + ", paged pool"
+        ),
     }

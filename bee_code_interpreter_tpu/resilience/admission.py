@@ -67,7 +67,10 @@ from bee_code_interpreter_tpu.tenancy.registry import Tenant
 
 # Mirror of analysis.policy.HEAVY_COST_CLASSES, spelled here so resilience/
 # never imports the analysis layer (the hint arrives as a plain string).
-_HEAVY_COST_CLASSES = frozenset({"io_heavy", "install_heavy"})
+# `accelerator` rides the heavy lane too: device-bound work holds a sandbox
+# for whole training/inference runs, the opposite of an interactive turn
+# (tests/test_analysis.py pins the two sets equal).
+_HEAVY_COST_CLASSES = frozenset({"io_heavy", "install_heavy", "accelerator"})
 
 # DRR bookkeeping: every admitted request costs one unit of its lane's
 # deficit; a visit tops each eligible lane up by its weight, so grant

@@ -15,10 +15,11 @@ Usage:
         --deny-calls "subprocess,os.fork" --warn-calls "raw_socket"
     python scripts/analyze.py --self-lint        # run the repo asynclint
     python scripts/analyze.py --concurrency-lint # the await-aware lint
+    python scripts/analyze.py --jax-lint         # the accelerator-stack lint
     python scripts/analyze.py --self-lint --sarif > asynclint.sarif
 
-scripts/lint.sh chains both self-lints plus the metrics/docs lints — the
-one command CI needs. ``--sarif`` renders either self-lint as a SARIF
+scripts/lint.sh chains all three self-lints plus the metrics/docs lints —
+the one command CI needs. ``--sarif`` renders any self-lint as a SARIF
 2.1.0 log (suppressed findings carried with their justifications).
 
 Without explicit --deny/--warn flags the policy comes from the same
@@ -122,6 +123,12 @@ def concurrency_lint(as_json: bool, as_sarif: bool = False) -> int:
     )
 
 
+def jax_lint(as_json: bool, as_sarif: bool = False) -> int:
+    from bee_code_interpreter_tpu.analysis import lint_jax_paths
+
+    return _render_lint(lint_jax_paths(), "jaxlint", as_json, as_sarif)
+
+
 def main() -> int:
     parser = argparse.ArgumentParser(
         description="Edge workload analyzer (docs/analysis.md)"
@@ -133,6 +140,10 @@ def main() -> int:
     parser.add_argument("--concurrency-lint", action="store_true",
                         help="run the await-aware concurrency lint "
                              "(analysis/concurrencylint.py)")
+    parser.add_argument("--jax-lint", action="store_true",
+                        help="run the accelerator-stack lint over models/ "
+                             "ops/ parallel/ runtime/shim/ "
+                             "(analysis/jaxlint.py)")
     parser.add_argument("--sarif", action="store_true",
                         help="render a self-lint as SARIF 2.1.0 (implies "
                              "machine-readable output)")
@@ -150,9 +161,12 @@ def main() -> int:
         return self_lint(args.json, args.sarif)
     if args.concurrency_lint:
         return concurrency_lint(args.json, args.sarif)
+    if args.jax_lint:
+        return jax_lint(args.json, args.sarif)
     if not args.source:
         parser.error(
-            "source file (or -) required unless --self-lint/--concurrency-lint"
+            "source file (or -) required unless "
+            "--self-lint/--concurrency-lint/--jax-lint"
         )
 
     source = (

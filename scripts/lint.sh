@@ -1,8 +1,8 @@
 #!/usr/bin/env bash
 # The one lint command CI needs (docs/analysis.md "Self-lint"): the
-# asyncio self-lint, the await-aware concurrency lint, and the metrics/
-# docs convention lints. Exits nonzero on ANY unexplained finding (a
-# stale suppression counts as one).
+# asyncio self-lint, the await-aware concurrency lint, the accelerator-
+# stack jaxlint, and the metrics/docs convention lints. Exits nonzero on
+# ANY unexplained finding (a stale suppression counts as one).
 #
 #   scripts/lint.sh            # human output
 #   scripts/lint.sh --sarif    # SARIF 2.1.0 logs to lint-*.sarif
@@ -14,16 +14,20 @@ PYTHON="${PYTHON:-python3}"
 if [[ "${1:-}" == "--sarif" ]]; then
     "$PYTHON" scripts/analyze.py --self-lint --sarif > lint-asynclint.sarif
     "$PYTHON" scripts/analyze.py --concurrency-lint --sarif > lint-concurrency.sarif
-    echo "wrote lint-asynclint.sarif lint-concurrency.sarif"
+    "$PYTHON" scripts/analyze.py --jax-lint --sarif > lint-jaxlint.sarif
+    echo "wrote lint-asynclint.sarif lint-concurrency.sarif lint-jaxlint.sarif"
 else
     echo "== asynclint (analysis/asynclint.py)"
     "$PYTHON" scripts/analyze.py --self-lint
     echo "== concurrencylint (analysis/concurrencylint.py)"
     "$PYTHON" scripts/analyze.py --concurrency-lint
+    echo "== jaxlint (analysis/jaxlint.py)"
+    "$PYTHON" scripts/analyze.py --jax-lint
 fi
 
 echo "== metrics/docs conventions (pytest)"
 "$PYTHON" -m pytest -q \
     tests/test_asynclint.py \
     tests/test_concurrencylint.py \
+    tests/test_jaxlint.py \
     tests/test_metrics_conventions.py

@@ -558,6 +558,45 @@ def test_unanalyzable_source_has_no_cost_class():
     assert verdict.annotation() is None
 
 
+def test_accelerator_class_outranks_every_expense_rung():
+    """`accelerator` is a PLACEMENT signal (docs/analysis.md "Cost
+    classes"): a jax/torch submission routes to a TPU-capable replica
+    whatever else it does, and the image-pinned frameworks never appear
+    in predicted_deps so no other rung can witness them."""
+    from bee_code_interpreter_tpu.analysis import classify_cost
+
+    assert classify_cost(inspect_source("import jax\n")) == "accelerator"
+    assert classify_cost(inspect_source(
+        "import jax.numpy as jnp\nprint(jnp.zeros(3))\n"
+    )) == "accelerator"
+    # even alongside an install + I/O + nested loops
+    assert classify_cost(inspect_source(
+        "import torch\nimport pandas\nfor i in range(9):\n"
+        "    for j in range(9):\n        open('/t')\n"
+    )) == "accelerator"
+    # jax-free submissions land exactly where they always did
+    assert classify_cost(inspect_source(
+        "try:\n    import pandas\nexcept ImportError:\n    pass\n"
+    )) == "install_heavy"
+
+
+def test_heavy_lane_mirror_includes_accelerator():
+    """resilience/ deliberately re-spells HEAVY_COST_CLASSES instead of
+    importing the analysis layer — this pin is what keeps the two sets
+    from drifting."""
+    from bee_code_interpreter_tpu.analysis import (
+        COST_CLASSES,
+        HEAVY_COST_CLASSES,
+    )
+    from bee_code_interpreter_tpu.resilience.admission import (
+        _HEAVY_COST_CLASSES,
+    )
+
+    assert HEAVY_COST_CLASSES == _HEAVY_COST_CLASSES
+    assert "accelerator" in HEAVY_COST_CLASSES
+    assert "accelerator" in COST_CLASSES
+
+
 def test_cyclic_alias_chain_still_resolves():
     """Code-review regression: a resolution cycle (x = y; y = x) must not
     poison the memo — `y` still resolves to __import__ and the socket

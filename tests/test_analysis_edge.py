@@ -558,6 +558,75 @@ async def test_http_cost_class_on_fleet_snapshot(counting_executor):
     await with_client(app, go)
 
 
+ACCELERATOR_SOURCE = "import jax\nprint(jax.numpy.zeros(3).sum())\n"
+
+
+async def test_http_accelerator_submission_classified_end_to_end(
+    counting_executor,
+):
+    """An accelerator-shaped submission gets `accelerator` on the
+    response AND the /v1/fleet cost-mix export, with the classification
+    itself spending zero sandbox checkouts (the execute below is the
+    request's own run, not the classifier's)."""
+    analyzer = WorkloadAnalyzer()
+    app = make_app(counting_executor, analyzer)
+
+    async def go(client):
+        body = await (
+            await client.post(
+                "/v1/execute", json={"source_code": ACCELERATOR_SOURCE}
+            )
+        ).json()
+        assert body["analysis"]["cost_class"] == "accelerator"
+        snap = await (await client.get("/v1/fleet")).json()
+        assert snap["cost_classes"]["accelerator"] == 1
+
+    await with_client(app, go)
+    assert counting_executor.executions == 1  # the run itself, nothing more
+
+
+async def test_grpc_accelerator_class_rides_trailer(counting_executor):
+    server = GrpcServer(
+        code_executor=counting_executor,
+        custom_tool_executor=CustomToolExecutor(code_executor=counting_executor),
+        analyzer=WorkloadAnalyzer(),
+    )
+
+    async def go(stubs):
+        call = stubs["Execute"](
+            pb.ExecuteRequest(source_code=ACCELERATOR_SOURCE)
+        )
+        await call
+        trailers = {k: v for k, v in await call.trailing_metadata()}
+        assert trailers.get("bci-analysis-cost-class") == "accelerator"
+
+    await run_grpc(server, go)
+
+
+def test_accelerator_class_lands_on_wide_event():
+    """Same flight-recorder lift as the other classes: the span attribute
+    becomes the wide event's analysis block."""
+    from bee_code_interpreter_tpu.observability import (
+        FlightRecorder,
+        Tracer,
+    )
+    from bee_code_interpreter_tpu.utils.metrics import Registry
+
+    registry = Registry()
+    tracer = Tracer(metrics=registry)
+    recorder = FlightRecorder(metrics=registry)
+    tracer.add_sink(recorder.record_trace)
+    analyzer = WorkloadAnalyzer(metrics=registry)
+    with tracer.trace("/v1/execute"):
+        analyzer.analyze(ACCELERATOR_SOURCE)
+    event = recorder.events(limit=1)[0]
+    assert event["analysis"]["cost_class"] == "accelerator"
+    assert (
+        'bci_analysis_cost_class_total{class="accelerator"} 1'
+        in registry.expose()
+    )
+
+
 async def test_grpc_clean_source_executes(counting_executor):
     server = GrpcServer(
         code_executor=counting_executor,

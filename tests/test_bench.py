@@ -244,3 +244,40 @@ def test_benchclock_chain_diff_guard():
     assert abs(chain_diff(1.0, 0.1, 10) - 0.1) < 1e-12
     with pytest.raises(AssertionError, match="clock failed"):
         chain_diff(0.105, 0.100, 10)
+
+
+def test_analysis_budget_guard_still_raises():
+    """The warm-path < 1 ms p50 budget must stay a HARD raise with the
+    accelerator classifier active — not drift into a report nobody reads
+    (docs/analysis.md "Observability")."""
+    import pytest
+
+    bench.check_analysis_budget({"analysis_ms": 0.4})  # under: silent
+    with pytest.raises(RuntimeError, match="analysis gate over budget"):
+        bench.check_analysis_budget(
+            {"analysis_ms": bench.ANALYSIS_BUDGET_MS}
+        )
+
+
+def test_jax_free_payload_stays_inside_analysis_budget():
+    """The accelerator cost classifier is a set intersection over facts
+    the one AST pass already collected — a jax-free submission (the bench
+    latency payload) must stay an order of magnitude inside the 1 ms
+    budget, while an accelerator payload classifies without any extra
+    pass either."""
+    import statistics
+    import time
+
+    from bee_code_interpreter_tpu.analysis import WorkloadAnalyzer
+
+    analyzer = WorkloadAnalyzer()
+    samples = []
+    for _ in range(60):
+        t0 = time.perf_counter()
+        verdict = analyzer.analyze(bench.LATENCY_PAYLOAD)
+        samples.append((time.perf_counter() - t0) * 1000.0)
+        assert verdict.cost_class == "cheap"
+    p50 = statistics.median(samples)
+    assert p50 < bench.ANALYSIS_BUDGET_MS, f"analysis p50 {p50:.3f} ms"
+    accel = analyzer.analyze("import jax\nprint(jax.devices())\n")
+    assert accel.cost_class == "accelerator"

@@ -1,0 +1,595 @@
+"""Tier-1 accelerator-stack lint (docs/analysis.md "Accelerator lint"):
+the trees the asyncio lints exclude — models/, parallel/, ops/,
+runtime/shim/ — must carry ZERO unexplained jaxlint violations, with
+every suppression still earning its justification (a stale suppression
+is itself a failure), exactly the asynclint/concurrencylint contract.
+
+The second half unit-tests each rule on synthetic snippets so a
+regression names the broken rule."""
+
+from bee_code_interpreter_tpu.analysis.asynclint import DEFAULT_EXCLUDES
+from bee_code_interpreter_tpu.analysis.jaxlint import (
+    ACCELERATOR_SCOPE,
+    SUPPRESSIONS,
+    lint_jax_paths,
+    lint_jax_source,
+)
+
+
+def _rules(source: str) -> list[str]:
+    return [v.rule for v in lint_jax_source(source)]
+
+
+# ------------------------------------------------------------- the repo
+
+
+def test_accelerator_stack_has_zero_unexplained_violations():
+    report = lint_jax_paths()
+    assert report.files_scanned >= 25  # the derived scope actually resolved
+    assert not report.violations, "\n" + report.summary()
+
+
+def test_no_stale_suppressions():
+    report = lint_jax_paths()
+    assert not report.stale_suppressions, (
+        "suppressions no longer matching any violation — delete them:\n"
+        + report.summary()
+    )
+    used = {s for _, s in report.suppressed}
+    assert used == set(SUPPRESSIONS)
+
+
+def test_every_suppression_is_justified():
+    for s in SUPPRESSIONS:
+        assert len(s.reason.split()) >= 8, (
+            f"{s.path} [{s.rule}]: a suppression needs a real justification"
+        )
+
+
+def test_scope_is_the_asynclint_exclude_partition():
+    """jaxlint's scope IS asynclint's exclude tuple — the same object, so
+    the two lint families partition the tree and cannot drift apart."""
+    assert ACCELERATOR_SCOPE is DEFAULT_EXCLUDES
+    assert set(ACCELERATOR_SCOPE) == {
+        "models", "parallel", "ops", "runtime/shim",
+    }
+
+
+def test_fresh_module_under_models_is_in_scope_by_default(tmp_path):
+    """Regression for the omission bug class (mirrors asynclint's
+    tmp-tree test): a new module dropped under models/ or parallel/ is
+    jaxlint-scoped without anyone editing a scope list, and control-plane
+    trees stay out of THIS lint's scope."""
+    pkg_root = tmp_path / "fakepkg"
+    models = pkg_root / "models"
+    models.mkdir(parents=True)
+    (models / "__init__.py").write_text("")
+    (models / "shiny_new_model.py").write_text(
+        "import jax\n"
+        "def f(fns):\n"
+        "    out = []\n"
+        "    for fn in fns:\n"
+        "        out.append(jax.jit(fn))\n"
+        "    return out\n"
+    )
+    # a control-plane package with the same shape stays out of THIS scope
+    api = pkg_root / "api"
+    api.mkdir()
+    (api / "__init__.py").write_text("")
+    (api / "svc.py").write_text(
+        "import jax\nfor i in range(3):\n    g = jax.jit(print)\n"
+    )
+    report = lint_jax_paths(pkg_root, suppressions=())
+    assert [v.rule for v in report.violations] == ["jit-in-loop"]
+    assert report.violations[0].path.endswith("models/shiny_new_model.py")
+
+
+def test_jax_free_files_short_circuit():
+    """The trigger pre-scan: a file with no jax spelling anywhere costs
+    one token walk and produces nothing (the same discipline as the
+    dynamic-import trigger scan)."""
+    assert _rules(
+        """
+        import numpy as np
+        def f(items):
+            out = []
+            for it in items:
+                out.append(np.asarray(it))
+            return out
+        """
+    ) == []
+
+
+# ------------------------------------------------- host-sync-in-hot-loop
+
+
+def test_host_sync_on_jitted_result_in_loop_flagged():
+    assert _rules(
+        """
+        import jax
+        import numpy as np
+        def _step(x):
+            return x + 1
+        m = jax.jit(_step)
+        def decode(params):
+            out = []
+            for _ in range(10):
+                logits = m(params)
+                out.append(np.asarray(logits))
+            return out
+        """
+    ) == ["host-sync-in-hot-loop"]
+
+
+def test_item_on_device_value_in_loop_flagged():
+    assert _rules(
+        """
+        import jax.numpy as jnp
+        def f(xs):
+            total = 0.0
+            for x in xs:
+                y = jnp.sin(x)
+                total += y.item()
+            return total
+        """
+    ) == ["host-sync-in-hot-loop"]
+
+
+def test_block_until_ready_in_loop_flagged():
+    assert _rules(
+        """
+        import jax.numpy as jnp
+        def f():
+            for _ in range(3):
+                jnp.ones(3).block_until_ready()
+        """
+    ) == ["host-sync-in-hot-loop"]
+
+
+def test_step_path_sync_flagged_without_lexical_loop():
+    # `step()` runs per token in every serving loop: a transfer anywhere
+    # it reaches is per-token work even with no `for` in sight
+    assert _rules(
+        """
+        import numpy as np
+        import jax.numpy as jnp
+        class Batcher:
+            def step(self):
+                return self._tick()
+            def _tick(self):
+                logits = jnp.ones((2, 2))
+                return np.asarray(logits)
+        """
+    ) == ["host-sync-in-hot-loop"]
+
+
+def test_sync_via_jit_attribute_alias_tracked():
+    # self._verify = self._window aliasing: one compiled program, two
+    # roles — the alias must still mark results as device values
+    assert _rules(
+        """
+        import jax
+        import numpy as np
+        class B:
+            def __init__(self, f):
+                self._window = jax.jit(f)
+                self._verify = self._window
+            def step(self):
+                t = self._verify(1)
+                return np.asarray(t)
+        """
+    ) == ["host-sync-in-hot-loop"]
+
+
+def test_cold_path_sync_is_clean():
+    # a one-shot transfer outside any loop / step path is the normal way
+    # results leave the device — not a finding
+    assert _rules(
+        """
+        import numpy as np
+        import jax.numpy as jnp
+        def admit():
+            x = jnp.ones(3)
+            return np.asarray(x)
+        """
+    ) == []
+
+
+def test_host_numpy_in_loop_is_clean():
+    # np.asarray over plain host data in a loop is ordinary numpy code;
+    # only alias-tracked DEVICE values count
+    assert _rules(
+        """
+        import numpy as np
+        import jax.numpy as jnp
+        def f(items):
+            dev = jnp.ones(3)  # jax present, but not what crosses
+            out = []
+            for it in items:
+                out.append(np.asarray(it))
+            return out
+        """
+    ) == []
+
+
+# ------------------------------------------------ jit-in-loop / retrace
+
+
+def test_jit_in_loop_flagged():
+    assert "jit-in-loop" in _rules(
+        """
+        import jax
+        def f(fns):
+            out = []
+            for fn in fns:
+                out.append(jax.jit(fn))
+            return out
+        """
+    )
+
+
+def test_immediate_jit_invocation_flagged():
+    assert _rules(
+        """
+        import jax
+        def f(g, x):
+            return jax.jit(g)(x)
+        """
+    ) == ["retrace-hazard"]
+
+
+def test_jit_built_and_called_per_call_flagged():
+    assert _rules(
+        """
+        import jax
+        def f(step, x):
+            g = jax.jit(step)
+            return g(x)
+        """
+    ) == ["retrace-hazard"]
+
+
+def test_jit_factory_return_is_clean():
+    # the mnist/transformer make_train_step shape: build once, hand the
+    # compiled callable to the caller
+    assert _rules(
+        """
+        import jax
+        def make_step(step):
+            return jax.jit(step, donate_argnums=(0, 1))
+        """
+    ) == []
+
+
+def test_jit_bound_to_self_in_init_is_clean():
+    # the serving-engine shape: compiled once at construction
+    assert _rules(
+        """
+        import jax
+        class B:
+            def __init__(self, f):
+                self._decode = jax.jit(f, donate_argnums=(1,))
+        """
+    ) == []
+
+
+def test_nonconstant_static_argnums_flagged():
+    assert _rules(
+        """
+        import jax
+        def f(g, idxs):
+            return jax.jit(g, static_argnums=idxs)
+        """
+    ) == ["retrace-hazard"]
+
+
+def test_constant_static_argnames_clean():
+    assert _rules(
+        """
+        import jax
+        def make(g):
+            return jax.jit(g, static_argnames=("total_len", "chunk"))
+        """
+    ) == []
+
+
+# ------------------------------------------------------ missing-donation
+
+
+def test_undonated_state_threading_jit_flagged():
+    assert _rules(
+        """
+        import jax
+        def train_step(params, opt_state, batch):
+            return params, opt_state, 1.0
+        def make():
+            return jax.jit(train_step)
+        """
+    ) == ["missing-donation"]
+
+
+def test_donated_state_threading_jit_clean():
+    assert _rules(
+        """
+        import jax
+        def train_step(params, opt_state, batch):
+            return params, opt_state, 1.0
+        def make():
+            return jax.jit(train_step, donate_argnums=(0, 1))
+        """
+    ) == []
+
+
+def test_jit_without_state_out_is_clean():
+    # forward-only functions return fresh values, nothing to donate
+    assert _rules(
+        """
+        import jax
+        def forward(params, tokens):
+            return tokens
+        def make():
+            return jax.jit(lambda p, t: p)  # unresolvable target: no claim
+        def make2():
+            return jax.jit(forward)
+        """
+    ) == ["missing-donation"]  # forward returns its `tokens` param
+
+
+def test_partial_bound_state_not_donation_candidate():
+    # a functools.partial-bound kwarg is a Python constant at trace time,
+    # not a donatable buffer argument
+    assert _rules(
+        """
+        import functools
+        import jax
+        def apply(cfg, x):
+            return cfg
+        def make(cfg):
+            return jax.jit(functools.partial(apply, cfg=cfg))
+        """
+    ) == []
+
+
+# ------------------------------------------------- traced-python-branch
+
+
+def test_branch_on_traced_param_flagged():
+    assert _rules(
+        """
+        import jax
+        def f(x):
+            if x > 0:
+                return x * 2
+            return -x
+        g = jax.jit(f)
+        """
+    ) == ["traced-python-branch"]
+
+
+def test_while_on_traced_param_flagged():
+    assert _rules(
+        """
+        import jax
+        def f(x):
+            while x > 0:
+                x = x - 1
+            return x + 0
+        g = jax.jit(f)
+        """
+    ) == ["traced-python-branch"]
+
+
+def test_shape_dtype_none_and_len_tests_are_static():
+    assert _rules(
+        """
+        import jax
+        def f(x, mask):
+            if x.shape[0] > 1:
+                x = x + 1
+            if mask is None:
+                return x * 1
+            if len(x) > 2:
+                return x + 1
+            return x * 1
+        g = jax.jit(f)
+        """
+    ) == []
+
+
+def test_default_valued_flag_param_is_static():
+    # a flag the jit caller leaves at its default is a concrete Python
+    # value during tracing — the return_kv / lora_bank idiom
+    assert _rules(
+        """
+        import jax
+        def f(x, return_aux=False):
+            if return_aux:
+                return x * 1, x.sum()
+            return x * 1
+        g = jax.jit(f)
+        """
+    ) == []
+
+
+def test_static_argnums_sanctions_the_branch():
+    assert _rules(
+        """
+        import jax
+        def f(n, x):
+            if n > 4:
+                return x * 2
+            return x * 1
+        g = jax.jit(f, static_argnums=(0,))
+        """
+    ) == []
+
+
+def test_unjitted_function_branches_freely():
+    assert _rules(
+        """
+        import jax.numpy as jnp
+        def host_helper(x):
+            if x > 0:
+                return jnp.ones(3)
+            return jnp.zeros(3)
+        """
+    ) == []
+
+
+# -------------------------------------------- collective-axis-mismatch
+
+
+def test_unbound_literal_axis_flagged():
+    assert _rules(
+        """
+        from jax import lax
+        def f(x):
+            return lax.psum(x, "tp")
+        """
+    ) == ["collective-axis-mismatch"]
+
+
+def test_axis_bound_by_partition_spec_clean():
+    assert _rules(
+        """
+        import jax
+        from jax import lax
+        from jax.sharding import PartitionSpec as P
+        def f(x):
+            return lax.psum(x, "tp")
+        def wrap(mesh, x):
+            fn = jax.shard_map(
+                f, mesh=mesh, in_specs=(P("tp"),), out_specs=P()
+            )
+            return fn(x)
+        """
+    ) == []
+
+
+def test_axis_from_parameter_chain_clean():
+    # the ring/ulysses idiom: the axis arrives as a parameter (with the
+    # mesh-side binding completed by the *_sharded wrapper's specs)
+    assert _rules(
+        """
+        from jax import lax
+        def ring(x, axis_name="sp"):
+            n = lax.axis_size(axis_name)
+            return lax.ppermute(x, axis_name, [(i, (i + 1) % n) for i in range(2)])
+        """
+    ) == []
+
+
+def test_axis_from_enclosing_closure_param_clean():
+    # shard_map bodies close over the OUTER function's axis param
+    # (parallel/pipeline.py's per_rank/tick nesting)
+    assert _rules(
+        """
+        from jax import lax
+        def pipelined(x, axis="pp"):
+            def per_rank(h):
+                idx = lax.axis_index(axis)
+                return lax.psum(h, axis) + idx
+            return per_rank
+        """
+    ) == []
+
+
+def test_unauditable_axis_name_flagged():
+    assert _rules(
+        """
+        from jax import lax
+        AXIS = object()
+        def f(x):
+            return lax.psum(x, AXIS)
+        """
+    ) == ["collective-axis-mismatch"]
+
+
+def test_kwarg_axis_name_checked_too():
+    assert _rules(
+        """
+        from jax import lax
+        def f(x):
+            return lax.all_to_all(
+                x, axis_name="sp", split_axis=1, concat_axis=2
+            )
+        """
+    ) == ["collective-axis-mismatch"]
+
+
+# ------------------------------------------- code-review regressions
+
+
+def test_closure_factory_is_clean():
+    # the canonical jit factory: build once, return a closure that calls
+    # it — the nested call must not read as "rebuilt per invocation"
+    # (ast.walk does not prune skipped FunctionDef bodies)
+    assert _rules(
+        """
+        import jax
+        def make_step(f):
+            g = jax.jit(f, donate_argnums=(0,))
+            def step(x):
+                return g(x)
+            return step
+        """
+    ) == []
+
+
+def test_nested_def_device_bindings_do_not_leak_out():
+    # a nested def's `logits = jnp.zeros(...)` is ITS scope's name; the
+    # enclosing function's same-named host list must not inherit it
+    assert _rules(
+        """
+        import numpy as np
+        import jax.numpy as jnp
+        def outer(rows):
+            def inner():
+                logits = jnp.zeros(3)
+                return logits
+            logits = [1.0, 2.0]
+            out = []
+            for r in rows:
+                out.append(np.asarray(logits))
+            return out, inner
+        """
+    ) == []
+
+
+def test_lambda_body_sync_in_loop_flagged():
+    # a sort key runs per comparison inside the loop: a device->host
+    # float() there is exactly the per-iteration sync the rule targets
+    assert _rules(
+        """
+        import jax.numpy as jnp
+        def f(rows):
+            logits = jnp.zeros((3, 3))
+            for r in rows:
+                rows = sorted(rows, key=lambda i: float(logits[i].sum()))
+            return rows
+        """
+    ) == ["host-sync-in-hot-loop"]
+
+
+def test_aliased_cross_file_jit_target_still_checked(tmp_path):
+    # `from m import forward as fwd; jax.jit(fwd)` must route to m's
+    # `forward` for the traced-branch pass, same as the unaliased import
+    pkg_root = tmp_path / "fakepkg"
+    models = pkg_root / "models"
+    models.mkdir(parents=True)
+    (models / "__init__.py").write_text("")
+    (models / "deff.py").write_text(
+        "import jax.numpy as jnp\n"
+        "def forward(x):\n"
+        "    if x > 0:\n"
+        "        return x * 2\n"
+        "    return -x\n"
+    )
+    (models / "caller.py").write_text(
+        "import jax\n"
+        "from fakepkg.models.deff import forward as fwd\n"
+        "g = jax.jit(fwd)\n"
+    )
+    report = lint_jax_paths(pkg_root, suppressions=())
+    assert [v.rule for v in report.violations] == ["traced-python-branch"]
+    assert report.violations[0].path.endswith("models/deff.py")

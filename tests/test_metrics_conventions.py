@@ -288,6 +288,31 @@ def test_every_serving_metric_is_documented(tmp_path):
         )
 
 
+def test_every_cost_class_label_is_documented():
+    """`bci_analysis_cost_class_total{class}` is a CLOSED label set
+    (COST_CLASSES); an operator reading docs/observability.md must find
+    every value it can take — `accelerator` joined the set with the
+    jaxlint PR and must not be the last one anyone documents."""
+    from pathlib import Path
+
+    from bee_code_interpreter_tpu.analysis import COST_CLASSES
+
+    doc = (
+        Path(__file__).resolve().parent.parent / "docs" / "observability.md"
+    ).read_text()
+    row = next(
+        line
+        for line in doc.splitlines()
+        if "bci_analysis_cost_class_total" in line and line.startswith("|")
+    )
+    for cls in COST_CLASSES:
+        assert f"`{cls}`" in row, (
+            f"cost class {cls!r} missing from the "
+            "bci_analysis_cost_class_total row in docs/observability.md"
+        )
+    assert "accelerator" in row
+
+
 def test_analysis_stage_appears_in_stage_seconds(tmp_path):
     """The edge gate's work is a first-class request stage: one analyzed
     submission under a trace must surface as
