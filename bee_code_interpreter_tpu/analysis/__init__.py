@@ -44,6 +44,13 @@ from bee_code_interpreter_tpu.analysis.dataflow import (
     FunctionFlow,
     iter_scopes,
 )
+from bee_code_interpreter_tpu.analysis.contractlint import (
+    ContractReport,
+    extract_surface,
+    lint_contract_paths,
+    surface_json,
+    surface_section,
+)
 from bee_code_interpreter_tpu.analysis.jaxlint import (
     ACCELERATOR_SCOPE,
     JaxLintReport,
@@ -79,6 +86,7 @@ __all__ = [
     "COST_CLASSES",
     "CallSite",
     "ConcurrencyReport",
+    "ContractReport",
     "EXIT",
     "Finding",
     "FunctionFlow",
@@ -93,6 +101,7 @@ __all__ = [
     "WorkloadAnalyzer",
     "classify_cost",
     "default_packages",
+    "extract_surface",
     "inspect_source",
     "iter_scopes",
     "lint_concurrency_paths",
@@ -102,9 +111,12 @@ __all__ = [
     "lint_paths",
     "lint_source",
     "predicted_deps",
+    "lint_contract_paths",
     "render_syntax_error",
     "sarif_log",
     "split_patterns",
     "stash_predicted_deps",
+    "surface_json",
+    "surface_section",
     "tool_run",
 ]

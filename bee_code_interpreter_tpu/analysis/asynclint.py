@@ -132,14 +132,24 @@ class Violation:
 @dataclass(frozen=True)
 class Suppression:
     """One justified exception. ``path`` is a suffix match against the
-    repo-relative file path; ``rule`` must match exactly."""
+    repo-relative file path; ``rule`` must match exactly. ``contains``
+    (optional) narrows the entry to violations whose MESSAGE contains the
+    substring — without it a file+rule entry sanctions every future
+    violation of that rule in the file, which for surface-wide rules
+    (contractlint anchors most status-mapping violations to the one HTTP
+    edge file) would let one suppression neuter the rule."""
 
     path: str
     rule: str
     reason: str
+    contains: str | None = None
 
     def matches(self, v: Violation) -> bool:
-        return v.rule == self.rule and v.path.endswith(self.path)
+        return (
+            v.rule == self.rule
+            and v.path.endswith(self.path)
+            and (self.contains is None or self.contains in v.message)
+        )
 
 
 # The shipped suppression budget: every entry names WHY the violation is

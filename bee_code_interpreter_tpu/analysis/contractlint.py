@@ -1,0 +1,1991 @@
+"""Cross-transport API-contract + exception-surface lint (docs/analysis.md
+"Contract lint").
+
+The service serves the SAME surface over two transports — aiohttp HTTP and
+grpc.aio — plus the FleetRouter's proxy edge, and the repo's most repeated
+review-hardening bug class is drift between them: an exception mapped to a
+clean status on one transport escaping as UNKNOWN/500 on the other, a
+query parameter parsed with different int/bool semantics per edge, an SLI
+verdict transports disagree on, a `Retry-After` hint one hop strips. This
+module holds that surface by construction, with two faces:
+
+**Face 1 — surface extraction.** One AST pass over the edge files
+(``api/http_server.py``, ``api/grpc_server.py``, ``fleet/app.py``,
+``fleet/router.py``, ``api/models.py``) produces a machine-readable
+surface model: every HTTP route (method, path, SSE vs unary, query-param
+coercions, resilience/drain/SLI scope, the statuses it can emit, the
+exception→status mapping its handlers implement), every gRPC method
+(streaming kind, request shape, status codes, trailers), the router's
+proxied surface and header-passthrough contract, and the pydantic
+request/response models. ``scripts/analyze.py --surface`` dumps it; the
+dump is checked in as ``docs/api_surface.json`` and enforced by a tier-1
+golden test, so ANY surface change is an explicit, reviewed diff. The
+same model is served as the ``surface`` section of ``/v1/debug/bundle``
+(and its gRPC twin) so operators and the FleetRouter can read the route
+table instead of hardcoding it.
+
+**Face 2 — contract rules**, held at zero unexplained violations with the
+asynclint suppression contract (justified entries only; stale ones FAIL):
+
+- ``route-twin-missing``    every surfaced HTTP route must be declared a
+  twin of a gRPC method (or carry a transport-specific exemption, e.g.
+  ``GET /metrics``), and vice versa; a twin/exemption naming a surface
+  that no longer exists is itself a violation — the map can only shrink
+  honestly.
+- ``status-mapping-drift``  per twin pair, the canonical status table
+  (422/400→INVALID_ARGUMENT, 404→NOT_FOUND, 429→RESOURCE_EXHAUSTED with
+  a ``retry-after-s`` trailer, 500→INTERNAL, 501→UNIMPLEMENTED,
+  503→UNAVAILABLE, 504→DEADLINE_EXCEEDED) must hold in both directions —
+  the ``InvalidSessionRequest``→UNKNOWN bug class (PR 7) as a rule.
+- ``sli-parity``            twin pairs must agree on whether they run
+  under the resilience ladder (admission + deadline + SLI sampling) and
+  on drain exemption — the mid-stream-death SLI split (PR 7) as a rule.
+- ``param-coercion-drift``  a parameter spelled on both transports must
+  be coerced identically (int vs float vs truthy-string) and bounded
+  identically (a negative ``limit`` 400s on HTTP, so it must
+  INVALID_ARGUMENT on gRPC) — the ``bool("0")`` inversion (PR 9) as a
+  rule.
+- ``exception-escapes-as-500`` an exception type raisable in a handler
+  body — its own ``raise`` statements plus one level into in-corpus
+  callees, resolved through import aliases and parameter/attribute type
+  annotations (the jaxlint cross-file precedent) — that no enclosing
+  ``except`` arm, resilience-ladder arm, or declared mapping catches
+  escapes as a generic 500/UNKNOWN (the NUL-ValueError bug class, PR 6).
+- ``undocumented-route``    every surfaced route and RPC must appear in
+  docs/ — an operator cannot reason about a surface they cannot find.
+
+Approximation stance matches the engine underneath (dataflow.py): the
+status/code sets over-approximate (every spelled status counts, reachable
+or not), exception resolution under-approximates (a receiver the alias/
+annotation pass cannot type makes no claim) — a finding is a real shape
+in the edge code, and the suppression list is where a real-but-sanctioned
+shape records its justification.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import re
+import threading
+from dataclasses import dataclass, field
+from pathlib import Path
+
+from bee_code_interpreter_tpu.analysis.asynclint import (
+    PACKAGE_ROOT,
+    Suppression,
+    Violation,
+)
+from bee_code_interpreter_tpu.analysis.inspect import (
+    collect_aliases,
+    resolve_call_name,
+)
+
+#: The edge files the extractor reads, keyed by the surface scope each
+#: belongs to (package-root-relative).
+EDGE_FILES: dict[str, str] = {
+    "http": "api/http_server.py",
+    "grpc": "api/grpc_server.py",
+    "router": "fleet/app.py",
+}
+ROUTER_CORE_FILE = "fleet/router.py"
+MODELS_FILE = "api/models.py"
+
+#: The canonical HTTP-status → gRPC-code table (docs/analysis.md "Contract
+#: lint"). Forward: an HTTP status a handler emits requires the mapped
+#: code on its twin. Reverse (CANONICAL_CODE_TO_STATUSES): a code the twin
+#: emits requires one of the mapped statuses on the HTTP side.
+CANONICAL_STATUS_TO_CODE: dict[int, str] = {
+    400: "INVALID_ARGUMENT",
+    404: "NOT_FOUND",
+    422: "INVALID_ARGUMENT",
+    429: "RESOURCE_EXHAUSTED",
+    500: "INTERNAL",
+    501: "UNIMPLEMENTED",
+    503: "UNAVAILABLE",
+    504: "DEADLINE_EXCEEDED",
+}
+CANONICAL_CODE_TO_STATUSES: dict[str, tuple[int, ...]] = {
+    "INVALID_ARGUMENT": (400, 422),
+    "NOT_FOUND": (404,),
+    "RESOURCE_EXHAUSTED": (429,),
+    "INTERNAL": (500,),
+    "UNIMPLEMENTED": (501,),
+    "UNAVAILABLE": (503,),
+    "DEADLINE_EXCEEDED": (504,),
+}
+#: Codes that must ride with a trailing-metadata hint when emitted — the
+#: gRPC spelling of the shed contract's Retry-After header.
+TRAILER_REQUIRED: dict[str, str] = {"RESOURCE_EXHAUSTED": "retry-after-s"}
+
+#: aiohttp's raisable response classes by leaf name → status.
+AIOHTTP_RAISE_STATUS: dict[str, int] = {
+    "HTTPBadRequest": 400,
+    "HTTPUnauthorized": 401,
+    "HTTPForbidden": 403,
+    "HTTPNotFound": 404,
+    "HTTPTooManyRequests": 429,
+    "HTTPUnprocessableEntity": 422,
+    "HTTPInternalServerError": 500,
+    "HTTPNotImplemented": 501,
+    "HTTPServiceUnavailable": 503,
+    "HTTPGatewayTimeout": 504,
+}
+
+#: The resilience-ladder entry points: a handler that (transitively)
+#: calls one runs under admission + deadline + SLI sampling, inherits the
+#: ladder's statuses/codes/trailers, and has the ladder's exception arms
+#: applied to everything raisable in its body.
+LADDER_NAMES = frozenset(
+    {"with_resilience", "_with_resilience", "_resilience_scope"}
+)
+#: Exceptions the shared ladder maps to clean statuses on both edges.
+LADDER_CAUGHT = frozenset(
+    {"AdmissionRejected", "DeadlineExceeded", "BreakerOpenError"}
+)
+#: Leaf names that are mapped/benign wherever they escape: cancellation
+#: unwinds, abort IS the mapping, aiohttp HTTP* carry their own status,
+#: and abstract-stub/interpreter-exit noise makes no contract claim.
+MAPPED_EXCEPTIONS = frozenset(
+    {"CancelledError", "AbortError", "StopAsyncIteration"}
+)
+BENIGN_EXCEPTIONS = frozenset(
+    {"NotImplementedError", "AssertionError", "KeyboardInterrupt", "SystemExit"}
+)
+
+#: Helper spellings both edges use for the ("1","true","yes","on")
+#: truthy-string coercion.
+TRUTHY_HELPERS = frozenset({"_truthy_query", "_truthy"})
+
+_GRPC_HANDLER_KINDS = {
+    "unary_unary_rpc_method_handler": "unary",
+    "unary_stream_rpc_method_handler": "server_streaming",
+    "stream_unary_rpc_method_handler": "client_streaming",
+    "stream_stream_rpc_method_handler": "bidi_streaming",
+}
+
+_HTTP_ADD_METHODS = {
+    "add_get": "GET",
+    "add_post": "POST",
+    "add_put": "PUT",
+    "add_patch": "PATCH",
+    "add_delete": "DELETE",
+}
+
+_FUNCTION_NODES = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+
+# --------------------------------------------------------------------------
+# surface model
+# --------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class QueryParam:
+    """One request parameter as a transport coerces it: ``kind`` is the
+    parse applied at the edge (int/float/truthy/str), ``bounded`` whether
+    a negative value is rejected (compared against 0 somewhere in the
+    handler)."""
+
+    kind: str
+    bounded: bool
+
+
+@dataclass
+class HttpRoute:
+    method: str
+    path: str
+    handler: str
+    file: str
+    line: int
+    scope: str = "http"  # "http" (api edge) or "router" (fleet proxy edge)
+    sse: bool = False
+    resilient: bool = False
+    allow_draining: bool = False
+    statuses: set[int] = field(default_factory=set)
+    params: dict[str, QueryParam] = field(default_factory=dict)
+    response_models: set[str] = field(default_factory=set)
+    exception_statuses: dict[str, tuple[int, ...]] = field(default_factory=dict)
+
+    @property
+    def key(self) -> str:
+        prefix = "router:" if self.scope == "router" else ""
+        return f"{prefix}{self.method} {self.path}"
+
+
+@dataclass
+class GrpcMethod:
+    service: str  # short service name (last dotted component)
+    method: str
+    file: str
+    line: int
+    streaming: str = "unary"
+    request: str = "json-bytes"
+    resilient: bool = False
+    allow_draining: bool = False
+    codes: set[str] = field(default_factory=set)
+    trailers: set[str] = field(default_factory=set)
+    params: dict[str, QueryParam] = field(default_factory=dict)
+    exception_codes: dict[str, tuple[str, ...]] = field(default_factory=dict)
+
+    @property
+    def key(self) -> str:
+        return f"{self.service}.{self.method}"
+
+
+@dataclass
+class Surface:
+    http_path: str = EDGE_FILES["http"]
+    grpc_path: str = EDGE_FILES["grpc"]
+    http: list[HttpRoute] = field(default_factory=list)
+    grpc: list[GrpcMethod] = field(default_factory=list)
+    router: list[HttpRoute] = field(default_factory=list)
+    router_headers: dict[str, list[str]] = field(default_factory=dict)
+    models: dict[str, dict] = field(default_factory=dict)
+    files_scanned: int = 0
+    #: (file, handler-or-method, line, exception, via) tuples the
+    #: exception-surface pass could not prove caught — rule input.
+    escapes: list[tuple[str, str, int, str, str]] = field(default_factory=list)
+
+    def http_by_key(self) -> dict[str, HttpRoute]:
+        return {r.key: r for r in [*self.http, *self.router]}
+
+    def grpc_by_key(self) -> dict[str, GrpcMethod]:
+        return {m.key: m for m in self.grpc}
+
+
+@dataclass(frozen=True)
+class Twin:
+    """One declared HTTP↔gRPC pair: the HTTP key (``"POST /v1/execute"``)
+    and the gRPC method key(s) (``"CodeInterpreterService.Execute"``) that
+    serve the same operation. A route split across two RPCs (buffered
+    Execute + streaming ExecuteStream) lists both; checks run against the
+    union of the twins' codes/trailers/params."""
+
+    http: str
+    grpc: tuple[str, ...]
+
+
+@dataclass(frozen=True)
+class Exemption:
+    """One declared transport-specific surface with the reason it has no
+    twin. ``surface`` is an exact HTTP/gRPC key, or a ``prefix*`` glob
+    (``"router:*"`` — the whole proxy edge is single-transport by
+    design). A stale exemption fails like a stale suppression."""
+
+    surface: str
+    reason: str
+
+    def matches(self, key: str) -> bool:
+        if self.surface.endswith("*"):
+            return key.startswith(self.surface[:-1])
+        return key == self.surface
+
+
+#: The declared twin map for THIS repo's surface. Every entry is checked
+#: against the extracted model both ways: an entry naming a route/method
+#: that stopped existing is a route-twin-missing violation.
+TWINS: tuple[Twin, ...] = (
+    Twin(
+        "POST /v1/execute",
+        (
+            "CodeInterpreterService.Execute",
+            "CodeInterpreterService.ExecuteStream",
+        ),
+    ),
+    Twin("POST /v1/parse-custom-tool", ("CodeInterpreterService.ParseCustomTool",)),
+    Twin(
+        "POST /v1/execute-custom-tool",
+        ("CodeInterpreterService.ExecuteCustomTool",),
+    ),
+    Twin("POST /v1/sessions", ("SessionService.CreateSession",)),
+    Twin("GET /v1/sessions", ("SessionService.ListSessions",)),
+    Twin(
+        "POST /v1/sessions/{session_id}/execute",
+        (
+            "SessionService.ExecuteInSession",
+            "CodeInterpreterService.ExecuteStream",
+        ),
+    ),
+    Twin(
+        "POST /v1/sessions/{session_id}/checkpoint",
+        ("SessionService.Checkpoint",),
+    ),
+    Twin(
+        "POST /v1/sessions/{session_id}/rollback", ("SessionService.Rollback",)
+    ),
+    Twin("DELETE /v1/sessions/{session_id}", ("SessionService.DeleteSession",)),
+    Twin("GET /v1/fleet", ("FleetService.GetFleet",)),
+    Twin("GET /v1/fleet/events", ("FleetService.GetFleetEvents",)),
+    Twin("GET /v1/slo", ("ObservabilityService.GetSlo",)),
+    Twin("GET /v1/tenants", ("ObservabilityService.GetTenants",)),
+    Twin("GET /v1/autoscale", ("ObservabilityService.GetAutoscale",)),
+    Twin("GET /v1/serving", ("ObservabilityService.GetServing",)),
+    Twin(
+        "GET /v1/serving/requests",
+        ("ObservabilityService.GetServingRequests",),
+    ),
+    Twin("GET /v1/events", ("ObservabilityService.GetEvents",)),
+    Twin("GET /v1/debug/bundle", ("ObservabilityService.GetDebugBundle",)),
+    Twin("GET /v1/debug/tasks", ("ObservabilityService.GetTasks",)),
+    Twin("GET /v1/debug/pprof", ("ObservabilityService.GetPprof",)),
+)
+
+#: Declared transport-specific surfaces — the honest single-transport
+#: remainder, each with its reason.
+EXEMPTIONS: tuple[Exemption, ...] = (
+    Exemption(
+        "GET /healthz",
+        "the gRPC liveness surface is the standard grpc.health.v1 protocol "
+        "(Health.Check/Watch), not a JSON twin",
+    ),
+    Exemption(
+        "GET /metrics",
+        "the Prometheus/OpenMetrics scrape surface is pull-based HTTP by "
+        "definition",
+    ),
+    Exemption(
+        "GET /v1/traces",
+        "trace inspection is an HTTP-only debug API "
+        "(docs/observability.md); traces export to OTLP for non-HTTP "
+        "consumers",
+    ),
+    Exemption(
+        "GET /v1/traces/{trace_id}",
+        "trace inspection is an HTTP-only debug API (see GET /v1/traces)",
+    ),
+    Exemption(
+        "POST /v1/profile",
+        "on-demand jax.profiler capture is an HTTP-only operator surface "
+        "(docs/observability.md 'Profiling workflow')",
+    ),
+    Exemption(
+        "Health.Check",
+        "standard grpc.health.v1 protocol; GET /healthz is the HTTP "
+        "analogue",
+    ),
+    Exemption(
+        "Health.Watch",
+        "standard grpc.health.v1 protocol (streaming watch has no HTTP "
+        "analogue; /healthz is polled)",
+    ),
+    Exemption(
+        "ServerReflection.ServerReflectionInfo",
+        "standard gRPC reflection protocol; descriptor discovery has no "
+        "HTTP meaning",
+    ),
+    Exemption(
+        "router:*",
+        "the FleetRouter proxy edge is a single-transport HTTP tier by "
+        "design (docs/fleet.md); it forwards to replicas that serve both "
+        "transports",
+    ),
+)
+
+#: The shipped suppression budget — same contract as the other self-lints
+#: (asynclint/concurrencylint/jaxlint): every entry names WHY the flagged
+#: shape is sound, and a stale entry fails tests/test_contractlint.py.
+#: The audit's drift DEFECTS were fixed, not suppressed (CHANGES.md
+#: PR 15); what remains sanctioned is one deliberate defensive shape.
+SUPPRESSIONS: tuple[Suppression, ...] = (
+    Suppression(
+        path="api/http_server.py",
+        rule="status-mapping-drift",
+        contains="twin of GET /v1/events emits UNIMPLEMENTED",
+        reason=(
+            "GetEvents keeps a defensive UNIMPLEMENTED arm for a bare "
+            "ObservabilityServicer embedding, but the arm is unreachable "
+            "through GrpcServer, which — exactly like create_http_server "
+            "— always wires a FlightRecorder, so the deployed twin of "
+            "GET /v1/events can never answer UNIMPLEMENTED where HTTP "
+            "lacks a 501"
+        ),
+    ),
+    Suppression(
+        path="api/http_server.py",
+        rule="status-mapping-drift",
+        contains="twin of GET /v1/debug/bundle emits UNIMPLEMENTED",
+        reason=(
+            "GetDebugBundle keeps a defensive UNIMPLEMENTED arm for a "
+            "bare ObservabilityServicer embedding, but GrpcServer always "
+            "wires the same debug-bundle fallback create_http_server "
+            "has, so the deployed twin of GET /v1/debug/bundle can never "
+            "answer UNIMPLEMENTED where HTTP lacks a 501"
+        ),
+    ),
+)
+
+
+@dataclass
+class ContractReport:
+    violations: list[Violation] = field(default_factory=list)
+    suppressed: list[tuple[Violation, Suppression]] = field(default_factory=list)
+    stale_suppressions: list[Suppression] = field(default_factory=list)
+    surface: Surface = field(default_factory=Surface)
+
+    @property
+    def files_scanned(self) -> int:
+        return self.surface.files_scanned
+
+    @property
+    def clean(self) -> bool:
+        return not self.violations and not self.stale_suppressions
+
+    def summary(self) -> str:
+        lines = [str(v) for v in self.violations]
+        lines += [
+            f"stale suppression ({s.path} [{s.rule}]): no matching violation"
+            for s in self.stale_suppressions
+        ]
+        return "\n".join(lines) or "clean"
+
+
+# --------------------------------------------------------------------------
+# per-function facts (statuses, codes, trailers, params, call edges)
+# --------------------------------------------------------------------------
+
+
+def _leaf(name: str | None) -> str | None:
+    return name.rsplit(".", 1)[-1] if name else None
+
+
+def _exc_leaf_names(expr: ast.expr | None) -> set[str]:
+    """Leaf class names an ``except`` clause catches (tuple-aware)."""
+    if expr is None:
+        return {"BaseException"}  # bare except
+    out: set[str] = set()
+    elts = expr.elts if isinstance(expr, (ast.Tuple, ast.List)) else [expr]
+    for e in elts:
+        if isinstance(e, ast.Name):
+            out.add(e.id)
+        elif isinstance(e, ast.Attribute):
+            out.add(e.attr)
+    return out
+
+
+def _const_status(call: ast.Call) -> int | None:
+    """The constant ``status=`` keyword of a response constructor, or 200
+    when absent; None when spelled but not a constant (proxied
+    passthrough — no claim)."""
+    for kw in call.keywords:
+        if kw.arg == "status":
+            if isinstance(kw.value, ast.Constant) and isinstance(
+                kw.value.value, int
+            ):
+                return kw.value.value
+            return None
+    return 200
+
+
+def _abort_code(call: ast.Call, aliases: dict[str, str]) -> str | None:
+    """``context.abort(grpc.StatusCode.X, …)`` → ``"X"``."""
+    func = call.func
+    if not (isinstance(func, ast.Attribute) and func.attr == "abort"):
+        return None
+    if not call.args:
+        return None
+    name = resolve_call_name(call.args[0], aliases)
+    if name and "StatusCode." in name:
+        return name.rsplit(".", 1)[-1]
+    return None
+
+
+def _trailer_keys(call: ast.Call) -> set[str]:
+    """String keys inside a ``set_trailing_metadata(((k, v), …))`` call."""
+    out: set[str] = set()
+    for arg in [*call.args, *[kw.value for kw in call.keywords]]:
+        for node in ast.walk(arg):
+            if isinstance(node, (ast.Tuple, ast.List)) and len(node.elts) == 2:
+                key = node.elts[0]
+                if isinstance(key, ast.Constant) and isinstance(key.value, str):
+                    out.add(key.value)
+    return out
+
+
+def _is_param_receiver(expr: ast.expr, aliases: dict[str, str]) -> bool:
+    """Is this the thing request parameters are read off? ``request.query``
+    (any base spelled ``.query``), a local named ``query``/``body`` (the
+    edge convention for both the aiohttp multidict and the JSON-bytes
+    dict), or a direct ``json.loads(…)`` of the raw request."""
+    if isinstance(expr, ast.Attribute) and expr.attr == "query":
+        return True
+    if isinstance(expr, ast.Name) and expr.id in ("query", "body"):
+        return True
+    if isinstance(expr, ast.Call):
+        name = resolve_call_name(expr.func, aliases)
+        if name and name.endswith("json.loads"):
+            return True
+    return False
+
+
+class _ParamReads:
+    """Request-parameter reads in one function: node-identity → param
+    name, so coercion classification can ask 'does this int(...) wrap a
+    read of p?'."""
+
+    def __init__(self) -> None:
+        self.nodes: dict[int, str] = {}  # id(read node) -> param
+        self.params: set[str] = set()
+        self.bound: dict[str, set[str]] = {}  # local name -> params it holds
+
+    def note(self, node: ast.AST, param: str) -> None:
+        self.nodes[id(node)] = param
+        self.params.add(param)
+
+    def params_in(self, expr: ast.AST) -> set[str]:
+        """Params read anywhere inside ``expr`` — directly or through a
+        local the read was bound to."""
+        out: set[str] = set()
+        for node in ast.walk(expr):
+            hit = self.nodes.get(id(node))
+            if hit is not None:
+                out.add(hit)
+            if isinstance(node, ast.Name) and node.id in self.bound:
+                out.update(self.bound[node.id])
+        return out
+
+
+@dataclass
+class _FuncFacts:
+    """Everything one function definition (nested defs included — a
+    handler's ``run`` closure is part of its surface) contributes."""
+
+    node: ast.AST
+    name: str
+    statuses: set[int] = field(default_factory=set)
+    codes: set[str] = field(default_factory=set)
+    trailers: set[str] = field(default_factory=set)
+    sse: bool = False
+    calls: set[str] = field(default_factory=set)  # bare callee names
+    allow_draining: bool = False
+    params: dict[str, QueryParam] = field(default_factory=dict)
+    exception_statuses: dict[str, set[int]] = field(default_factory=dict)
+    exception_codes: dict[str, set[str]] = field(default_factory=dict)
+    response_models: set[str] = field(default_factory=set)
+
+
+_TRUTHY_TUPLE = frozenset({"1", "true", "yes", "on"})
+
+
+def _collect_func_facts(
+    func: ast.AST, aliases: dict[str, str]
+) -> _FuncFacts:
+    facts = _FuncFacts(node=func, name=getattr(func, "name", "<fn>"))
+    reads = _ParamReads()
+    # pass 1: parameter reads + the locals they are bound to
+    for node in ast.walk(func):
+        if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute):
+            if (
+                node.func.attr == "get"
+                and node.args
+                and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)
+                and _is_param_receiver(node.func.value, aliases)
+            ):
+                reads.note(node, node.args[0].value)
+        elif isinstance(node, ast.Subscript):
+            if (
+                _is_param_receiver(node.value, aliases)
+                and isinstance(node.slice, ast.Constant)
+                and isinstance(node.slice.value, str)
+            ):
+                reads.note(node, node.slice.value)
+        elif isinstance(node, ast.Call) and isinstance(node.func, ast.Name):
+            if (
+                node.func.id in TRUTHY_HELPERS
+                and len(node.args) >= 2
+                and isinstance(node.args[1], ast.Constant)
+                and isinstance(node.args[1].value, str)
+            ):
+                reads.note(node, node.args[1].value)
+                facts.params[node.args[1].value] = QueryParam("truthy", False)
+    for node in ast.walk(func):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            target = node.targets[0]
+            if isinstance(target, ast.Name):
+                hit = reads.params_in(node.value)
+                if hit:
+                    reads.bound.setdefault(target.id, set()).update(hit)
+    # pass 2: coercion kinds, truthy membership tests, and 0-bounds
+    kinds: dict[str, str] = {}
+    bounded: set[str] = set()
+    for node in ast.walk(func):
+        if isinstance(node, ast.Call) and isinstance(node.func, ast.Name):
+            if node.func.id in ("int", "float") and node.args:
+                for p in reads.params_in(node.args[0]):
+                    kinds.setdefault(p, node.func.id)
+        elif isinstance(node, ast.Compare):
+            if any(isinstance(op, ast.In) for op in node.ops) and all(
+                isinstance(c, ast.Constant) and c.value in _TRUTHY_TUPLE
+                for comp in node.comparators
+                if isinstance(comp, (ast.Tuple, ast.List))
+                for c in comp.elts
+            ) and any(
+                isinstance(comp, (ast.Tuple, ast.List)) and comp.elts
+                for comp in node.comparators
+            ):
+                for p in reads.params_in(node.left):
+                    kinds[p] = "truthy"
+            sides = [node.left, *node.comparators]
+            has_zero = any(
+                isinstance(s, ast.Constant) and s.value == 0 for s in sides
+            )
+            ordered = any(
+                isinstance(op, (ast.Lt, ast.LtE, ast.Gt, ast.GtE))
+                for op in node.ops
+            )
+            if has_zero and ordered:
+                for s in sides:
+                    bounded.update(reads.params_in(s))
+    for p in reads.params:
+        if p in facts.params and facts.params[p].kind == "truthy":
+            kind = "truthy"
+        else:
+            kind = kinds.get(p, "str")
+        facts.params[p] = QueryParam(kind, p in bounded)
+    # pass 3: statuses / codes / trailers / SSE / call edges / models
+    for node in ast.walk(func):
+        if isinstance(node, ast.Call):
+            name = resolve_call_name(node.func, aliases)
+            leaf = _leaf(name)
+            if leaf == "json_response" or (
+                leaf in ("Response", "StreamResponse")
+                and name
+                and ("web." in name or "aiohttp" in name)
+            ):
+                status = _const_status(node)
+                if status is not None:
+                    facts.statuses.add(status)
+                if leaf == "StreamResponse":
+                    facts.sse = True
+            code = _abort_code(node, aliases)
+            if code is not None:
+                facts.codes.add(code)
+            if (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr == "set_trailing_metadata"
+            ):
+                facts.trailers.update(_trailer_keys(node))
+            # call edges by bare name. Attribute calls only follow the
+            # underscore-helper convention (`self._resilience_scope`,
+            # `s._with_resilience`): a public method on a data object
+            # (`custom_tool_executor.execute`) must not alias a same-named
+            # handler into this closure.
+            if isinstance(node.func, ast.Name):
+                facts.calls.add(node.func.id)
+            elif isinstance(node.func, ast.Attribute) and node.func.attr.startswith(
+                "_"
+            ):
+                facts.calls.add(node.func.attr)
+            if any(
+                kw.arg == "allow_draining"
+                and isinstance(kw.value, ast.Constant)
+                and kw.value.value is True
+                for kw in node.keywords
+            ):
+                facts.allow_draining = True
+            # response models: models.ExecuteResponse(...) / api_models.X
+            if name and _leaf(name) and name.count(".") >= 1:
+                root = name.split(".", 1)[0]
+                if root in ("models", "api_models") or ".models." in (
+                    aliases.get(root, "") + "."
+                ):
+                    facts.response_models.add(_leaf(name))
+        elif isinstance(node, ast.Raise) and node.exc is not None:
+            exc = node.exc
+            exc_name = (
+                resolve_call_name(exc.func, aliases)
+                if isinstance(exc, ast.Call)
+                else resolve_call_name(exc, aliases)
+            )
+            exc_leaf = _leaf(exc_name)
+            if exc_leaf in AIOHTTP_RAISE_STATUS:
+                facts.statuses.add(AIOHTTP_RAISE_STATUS[exc_leaf])
+    # pass 4: exception→status mapping per except arm
+    for node in ast.walk(func):
+        if not isinstance(node, ast.Try):
+            continue
+        for handler in node.handlers:
+            names = _exc_leaf_names(handler.type)
+            arm = _FuncFacts(node=handler, name="<arm>")
+            for inner in handler.body:
+                for sub in ast.walk(inner):
+                    if isinstance(sub, ast.Call):
+                        subname = resolve_call_name(sub.func, aliases)
+                        if _leaf(subname) == "json_response":
+                            status = _const_status(sub)
+                            if status is not None:
+                                arm.statuses.add(status)
+                        code = _abort_code(sub, aliases)
+                        if code is not None:
+                            arm.codes.add(code)
+            for exc_name in names:
+                if arm.statuses:
+                    facts.exception_statuses.setdefault(exc_name, set()).update(
+                        arm.statuses
+                    )
+                if arm.codes:
+                    facts.exception_codes.setdefault(exc_name, set()).update(
+                        arm.codes
+                    )
+    return facts
+
+
+def _top_level_and_module_functions(tree: ast.Module) -> dict[str, list[ast.AST]]:
+    """Function defs usable as in-file call-edge targets: module-level
+    functions, functions at the immediate body level of a module-level
+    function (the create_http_server handler/helper layer), and class
+    methods — keyed by bare name. Deeper nesting (a handler's ``run``) is
+    part of its parent's own walk and must not be an edge target."""
+    table: dict[str, list[ast.AST]] = {}
+
+    def add(node: ast.AST) -> None:
+        table.setdefault(node.name, []).append(node)
+
+    for stmt in tree.body:
+        if isinstance(stmt, _FUNCTION_NODES):
+            add(stmt)
+            for inner in ast.iter_child_nodes(stmt):
+                if isinstance(inner, _FUNCTION_NODES):
+                    add(inner)
+        elif isinstance(stmt, ast.ClassDef):
+            for inner in stmt.body:
+                if isinstance(inner, _FUNCTION_NODES):
+                    add(inner)
+    return table
+
+
+@dataclass
+class _FileFacts:
+    """One edge file's fact base: per-function facts plus the transitive
+    closure used to attribute helper statuses/codes to handlers."""
+
+    tree: ast.Module
+    path: str
+    aliases: dict[str, str]
+    table: dict[str, list[ast.AST]]
+    facts: dict[int, _FuncFacts]
+
+    def facts_for(self, name: str) -> _FuncFacts | None:
+        defs = self.table.get(name)
+        if not defs:
+            return None
+        return self.facts[id(defs[0])]
+
+    def closure(self, name: str) -> _FuncFacts | None:
+        """Facts for ``name`` with every unambiguous in-file callee's
+        facts folded in (fixpoint over the call graph): the handler view
+        with ladder statuses, helper 501s, and SSE bits attributed."""
+        start = self.facts_for(name)
+        if start is None:
+            return None
+        merged = _FuncFacts(node=start.node, name=start.name)
+        seen: set[str] = set()
+        frontier = [name]
+        resilient = False
+        while frontier:
+            current = frontier.pop()
+            if current in seen:
+                continue
+            seen.add(current)
+            defs = self.table.get(current)
+            if not defs or len(defs) > 1:
+                continue  # unknown or ambiguous: no claim
+            facts = self.facts[id(defs[0])]
+            merged.statuses.update(facts.statuses)
+            merged.codes.update(facts.codes)
+            merged.trailers.update(facts.trailers)
+            merged.sse = merged.sse or facts.sse
+            merged.allow_draining = merged.allow_draining or facts.allow_draining
+            merged.response_models.update(facts.response_models)
+            for exc, statuses in facts.exception_statuses.items():
+                merged.exception_statuses.setdefault(exc, set()).update(statuses)
+            for exc, codes in facts.exception_codes.items():
+                merged.exception_codes.setdefault(exc, set()).update(codes)
+            for p, qp in facts.params.items():
+                merged.params.setdefault(p, qp)
+            for callee in facts.calls:
+                if callee in LADDER_NAMES:
+                    resilient = True
+                if callee not in seen:
+                    frontier.append(callee)
+        merged.calls = set(seen)
+        if resilient:
+            merged.calls.add("__resilient__")
+        return merged
+
+
+def _file_facts(tree: ast.Module, path: str) -> _FileFacts:
+    aliases = collect_aliases(tree)
+    table = _top_level_and_module_functions(tree)
+    facts: dict[int, _FuncFacts] = {}
+    for defs in table.values():
+        for node in defs:
+            facts[id(node)] = _collect_func_facts(node, aliases)
+    return _FileFacts(
+        tree=tree, path=path, aliases=aliases, table=table, facts=facts
+    )
+
+
+# --------------------------------------------------------------------------
+# HTTP / router route extraction
+# --------------------------------------------------------------------------
+
+
+def _extract_http_routes(
+    ff: _FileFacts, scope: str
+) -> list[HttpRoute]:
+    routes: list[HttpRoute] = []
+    for node in ast.walk(ff.tree):
+        if not (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in _HTTP_ADD_METHODS
+            and len(node.args) >= 2
+            and isinstance(node.args[0], ast.Constant)
+            and isinstance(node.args[0].value, str)
+            and isinstance(node.args[1], ast.Name)
+        ):
+            continue
+        handler = node.args[1].id
+        merged = ff.closure(handler)
+        route = HttpRoute(
+            method=_HTTP_ADD_METHODS[node.func.attr],
+            path=node.args[0].value,
+            handler=handler,
+            file=ff.path,
+            line=node.lineno,
+            scope=scope,
+        )
+        if merged is not None:
+            route.sse = merged.sse
+            route.resilient = "__resilient__" in merged.calls
+            route.allow_draining = merged.allow_draining
+            route.statuses = merged.statuses
+            route.params = merged.params
+            route.response_models = merged.response_models
+            route.exception_statuses = {
+                exc: tuple(sorted(statuses))
+                for exc, statuses in merged.exception_statuses.items()
+            }
+        routes.append(route)
+    routes.sort(key=lambda r: (r.path, r.method))
+    return routes
+
+
+# --------------------------------------------------------------------------
+# gRPC registration + servicer extraction
+# --------------------------------------------------------------------------
+
+
+def _module_consts(tree: ast.Module) -> tuple[dict[str, str], dict[str, list[str]], dict[str, dict[str, str]]]:
+    """Module-level constants the registrations reference: string consts
+    (service names), string sequences (method tuples / dict keys), and
+    per-method request-model names off dict values like
+    ``{"Execute": (pb.ExecuteRequest, pb.ExecuteResponse)}``."""
+    strings: dict[str, str] = {}
+    seqs: dict[str, list[str]] = {}
+    requests: dict[str, dict[str, str]] = {}
+    for stmt in tree.body:
+        # AnnAssign covers the typed spelling (`_METHODS: dict[...] = {…}`)
+        if isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+            target, value = stmt.target, stmt.value
+        elif isinstance(stmt, ast.Assign) and len(stmt.targets) == 1:
+            target, value = stmt.targets[0], stmt.value
+        else:
+            continue
+        if not isinstance(target, ast.Name):
+            continue
+        if isinstance(value, ast.Constant) and isinstance(value.value, str):
+            strings[target.id] = value.value
+        elif isinstance(value, (ast.Tuple, ast.List)) and all(
+            isinstance(e, ast.Constant) and isinstance(e.value, str)
+            for e in value.elts
+        ):
+            seqs[target.id] = [e.value for e in value.elts]
+        elif isinstance(value, ast.Dict):
+            keys = [
+                k.value
+                for k in value.keys
+                if isinstance(k, ast.Constant) and isinstance(k.value, str)
+            ]
+            if len(keys) == len(value.keys):
+                seqs[target.id] = keys
+                models: dict[str, str] = {}
+                for k, v in zip(keys, value.values):
+                    if isinstance(v, (ast.Tuple, ast.List)) and v.elts:
+                        first = v.elts[0]
+                        if isinstance(first, ast.Attribute):
+                            models[k] = first.attr
+                if models:
+                    requests[target.id] = models
+    return strings, seqs, requests
+
+
+def _request_model_from_deserializer(expr: ast.expr | None) -> str | None:
+    """``pb.ExecuteRequest.FromString`` → ``"ExecuteRequest"``; a plain
+    name (``bytes`` / the ``passthrough`` local) → json-bytes; a bare
+    ``req_cls.FromString`` (comprehension variable) → None (resolved from
+    the methods dict instead)."""
+    if expr is None:
+        return None
+    if isinstance(expr, ast.Name):
+        return "json-bytes"
+    if isinstance(expr, ast.Attribute) and expr.attr == "FromString":
+        owner = expr.value
+        if isinstance(owner, ast.Attribute):
+            return owner.attr
+        if isinstance(owner, ast.Name):
+            return None  # comprehension variable: caller resolves per method
+    return None
+
+
+@dataclass
+class _Registration:
+    service: str
+    methods: dict[str, tuple[str, str]]  # name -> (streaming kind, request)
+
+
+def _handler_ctor_kind(call: ast.Call) -> str | None:
+    name = call.func.attr if isinstance(call.func, ast.Attribute) else (
+        call.func.id if isinstance(call.func, ast.Name) else None
+    )
+    return _GRPC_HANDLER_KINDS.get(name or "")
+
+
+def _deserializer_expr(call: ast.Call) -> ast.expr | None:
+    for kw in call.keywords:
+        if kw.arg == "request_deserializer":
+            return kw.value
+    return None
+
+
+def _enclosing_function(tree: ast.Module, target: ast.AST) -> ast.AST | None:
+    for node in ast.walk(tree):
+        if isinstance(node, _FUNCTION_NODES):
+            for sub in ast.walk(node):
+                if sub is target:
+                    return node
+    return None
+
+
+def _resolve_handlers_expr(
+    expr: ast.expr,
+    enclosing: ast.AST | None,
+    strings: dict[str, str],
+    seqs: dict[str, list[str]],
+    requests: dict[str, dict[str, str]],
+) -> dict[str, tuple[str, str]]:
+    """The ``{method: rpc_method_handler(...)}`` mapping of one generic
+    registration, whatever its spelling: a dict literal, a dict
+    comprehension over a module tuple/dict, or a local name assigned one
+    of those plus ``handlers["X"] = …`` additions."""
+    out: dict[str, tuple[str, str]] = {}
+    if isinstance(expr, ast.Dict):
+        for k, v in zip(expr.keys, expr.values):
+            if not (
+                isinstance(k, ast.Constant)
+                and isinstance(k.value, str)
+                and isinstance(v, ast.Call)
+            ):
+                continue
+            kind = _handler_ctor_kind(v) or "unary"
+            request = (
+                _request_model_from_deserializer(_deserializer_expr(v))
+                or "json-bytes"
+            )
+            out[k.value] = (kind, request)
+    elif isinstance(expr, ast.DictComp):
+        gen = expr.generators[0]
+        names: list[str] = []
+        per_method_requests: dict[str, str] = {}
+        if isinstance(gen.iter, ast.Name):
+            names = seqs.get(gen.iter.id, [])
+            per_method_requests = requests.get(gen.iter.id, {})
+        elif (
+            isinstance(gen.iter, ast.Call)
+            and isinstance(gen.iter.func, ast.Attribute)
+            and gen.iter.func.attr == "items"
+            and isinstance(gen.iter.func.value, ast.Name)
+        ):
+            names = seqs.get(gen.iter.func.value.id, [])
+            per_method_requests = requests.get(gen.iter.func.value.id, {})
+        kind = "unary"
+        request_default = "json-bytes"
+        if isinstance(expr.value, ast.Call):
+            kind = _handler_ctor_kind(expr.value) or "unary"
+            deser = _request_model_from_deserializer(
+                _deserializer_expr(expr.value)
+            )
+            if deser is not None:
+                request_default = deser
+        for name in names:
+            out[name] = (kind, per_method_requests.get(name, request_default))
+    elif isinstance(expr, ast.Name) and enclosing is not None:
+        for node in ast.walk(enclosing):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                target = node.targets[0]
+                if (
+                    isinstance(target, ast.Name)
+                    and target.id == expr.id
+                    and isinstance(node.value, (ast.Dict, ast.DictComp))
+                ):
+                    out.update(
+                        _resolve_handlers_expr(
+                            node.value, enclosing, strings, seqs, requests
+                        )
+                    )
+                elif (
+                    isinstance(target, ast.Subscript)
+                    and isinstance(target.value, ast.Name)
+                    and target.value.id == expr.id
+                    and isinstance(target.slice, ast.Constant)
+                    and isinstance(target.slice.value, str)
+                    and isinstance(node.value, ast.Call)
+                ):
+                    kind = _handler_ctor_kind(node.value) or "unary"
+                    request = (
+                        _request_model_from_deserializer(
+                            _deserializer_expr(node.value)
+                        )
+                        or "json-bytes"
+                    )
+                    out[target.slice.value] = (kind, request)
+    return out
+
+
+def _extract_registrations(ff: _FileFacts) -> list[_Registration]:
+    strings, seqs, requests = _module_consts(ff.tree)
+    out: list[_Registration] = []
+    for node in ast.walk(ff.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = resolve_call_name(node.func, ff.aliases) or ""
+        if not name.endswith("method_handlers_generic_handler"):
+            continue
+        if len(node.args) < 2:
+            continue
+        service_expr = node.args[0]
+        if isinstance(service_expr, ast.Constant) and isinstance(
+            service_expr.value, str
+        ):
+            service = service_expr.value
+        elif isinstance(service_expr, ast.Name):
+            service = strings.get(service_expr.id, service_expr.id)
+        else:
+            continue
+        enclosing = _enclosing_function(ff.tree, node)
+        methods = _resolve_handlers_expr(
+            node.args[1], enclosing, strings, seqs, requests
+        )
+        if methods:
+            out.append(
+                _Registration(service=service.rsplit(".", 1)[-1], methods=methods)
+            )
+    return out
+
+
+def _class_method_defs(tree: ast.Module) -> dict[str, list[tuple[str, ast.AST]]]:
+    out: dict[str, list[tuple[str, ast.AST]]] = {}
+    for node in tree.body:
+        if isinstance(node, ast.ClassDef):
+            for inner in node.body:
+                if isinstance(inner, _FUNCTION_NODES):
+                    out.setdefault(inner.name, []).append((node.name, inner))
+    return out
+
+
+def _extract_grpc_methods(ff: _FileFacts) -> list[GrpcMethod]:
+    registrations = _extract_registrations(ff)
+    method_defs = _class_method_defs(ff.tree)
+    out: list[GrpcMethod] = []
+    for registration in registrations:
+        for name, (kind, request) in registration.methods.items():
+            defs = method_defs.get(name, [])
+            line = defs[0][1].lineno if defs else 0
+            method = GrpcMethod(
+                service=registration.service,
+                method=name,
+                file=ff.path,
+                line=line,
+                streaming=kind,
+                request=request,
+            )
+            merged = ff.closure(name)
+            if merged is not None:
+                method.resilient = "__resilient__" in merged.calls
+                method.allow_draining = merged.allow_draining
+                method.codes = merged.codes
+                method.trailers = merged.trailers
+                method.params = merged.params
+                method.exception_codes = {
+                    exc: tuple(sorted(codes))
+                    for exc, codes in merged.exception_codes.items()
+                }
+            out.append(method)
+    out.sort(key=lambda m: (m.service, m.method))
+    return out
+
+
+# --------------------------------------------------------------------------
+# models + router-core extraction
+# --------------------------------------------------------------------------
+
+
+def _extract_models(tree: ast.Module) -> dict[str, dict]:
+    """Pydantic request/response models: field name → {annotation,
+    required} — the wire-shape half of the surface golden."""
+    out: dict[str, dict] = {}
+    for node in tree.body:
+        if not isinstance(node, ast.ClassDef):
+            continue
+        bases = {_leaf(resolve_call_name(b, {})) or "" for b in node.bases}
+        if "BaseModel" not in bases:
+            continue
+        fields: dict[str, dict] = {}
+        for stmt in node.body:
+            if isinstance(stmt, ast.AnnAssign) and isinstance(
+                stmt.target, ast.Name
+            ):
+                fields[stmt.target.id] = {
+                    "annotation": ast.unparse(stmt.annotation),
+                    "required": stmt.value is None,
+                }
+        out[node.name] = fields
+    return out
+
+
+def _extract_router_headers(tree: ast.Module) -> dict[str, list[str]]:
+    """The proxy's header contract off fleet/router.py's module tuples:
+    which request headers are forwarded upstream and which response
+    headers survive the hop (Retry-After lives or dies here — the PR 11
+    bug class, golden-pinned)."""
+    out: dict[str, list[str]] = {}
+    labels = {
+        "_FORWARD_HEADERS": "forward",
+        "_PASSTHROUGH_RESPONSE_HEADERS": "response_passthrough",
+    }
+    for stmt in tree.body:
+        if not (isinstance(stmt, ast.Assign) and len(stmt.targets) == 1):
+            continue
+        target = stmt.targets[0]
+        if not (isinstance(target, ast.Name) and target.id in labels):
+            continue
+        if isinstance(stmt.value, (ast.Tuple, ast.List)):
+            out[labels[target.id]] = [
+                e.value
+                for e in stmt.value.elts
+                if isinstance(e, ast.Constant) and isinstance(e.value, str)
+            ]
+    return out
+
+
+# --------------------------------------------------------------------------
+# exception surface (corpus raises + per-handler escape computation)
+# --------------------------------------------------------------------------
+
+
+def _raise_leafs(func: ast.AST, aliases: dict[str, str]) -> set[str]:
+    """Leaf names of exceptions a function's own body raises (bare
+    re-raises make no claim)."""
+    out: set[str] = set()
+    for node in ast.walk(func):
+        if isinstance(node, ast.Raise) and node.exc is not None:
+            exc = node.exc
+            name = (
+                resolve_call_name(exc.func, aliases)
+                if isinstance(exc, ast.Call)
+                else resolve_call_name(exc, aliases)
+            )
+            leaf = _leaf(name)
+            if leaf:
+                out.add(leaf)
+    return out
+
+
+def _build_raise_corpus(root: Path) -> dict[str, frozenset[str]]:
+    """``module.func`` / ``module.Class.method`` → the leaf exception
+    names its own body raises, for every file in the package that spells
+    ``raise`` at all (the cheap pre-scan discipline). One level deep by
+    design: a handler's resolvable callees are checked against THEIR own
+    raise statements, not a transitive closure — under-approximating, the
+    safe direction for an escape rule with a suppression ledger."""
+    corpus: dict[str, frozenset[str]] = {}
+    for py in sorted(root.rglob("*.py")):
+        try:
+            source = py.read_text()
+        except OSError:
+            continue
+        if "raise" not in source:
+            continue
+        try:
+            tree = ast.parse(source)
+        except SyntaxError:
+            continue
+        aliases = collect_aliases(tree)
+        dotted_mod = str(py.relative_to(root.parent))[: -len(".py")].replace(
+            "/", "."
+        )
+        for stmt in tree.body:
+            if isinstance(stmt, _FUNCTION_NODES):
+                raises = _raise_leafs(stmt, aliases)
+                if raises:
+                    corpus[f"{dotted_mod}.{stmt.name}"] = frozenset(raises)
+            elif isinstance(stmt, ast.ClassDef):
+                for inner in stmt.body:
+                    if isinstance(inner, _FUNCTION_NODES):
+                        raises = _raise_leafs(inner, aliases)
+                        if raises:
+                            corpus[f"{dotted_mod}.{stmt.name}.{inner.name}"] = (
+                                frozenset(raises)
+                            )
+    return corpus
+
+
+def _annotation_dotted(
+    annotation: ast.expr | None, aliases: dict[str, str]
+) -> str | None:
+    """A parameter annotation resolved to the dotted class it names
+    (``code_executor: CodeExecutor`` → the imported class's module path);
+    Optional/union/string annotations make no claim."""
+    if isinstance(annotation, ast.Name):
+        return aliases.get(annotation.id)
+    if isinstance(annotation, ast.Attribute):
+        return resolve_call_name(annotation, aliases)
+    return None
+
+
+def _receiver_types(ff: _FileFacts) -> dict[int, dict[str, str]]:
+    """Per function-def id: {receiver spelling → dotted class}. Two
+    sources, both the dataflow layer's alias/value discipline: annotated
+    parameters (``"code_executor"``), and self-attributes bound to an
+    annotated constructor parameter (``"self._code_executor"``)."""
+    out: dict[int, dict[str, str]] = {}
+
+    # annotated params, inherited INTO nested defs: a handler closed over
+    # create_http_server's `code_executor: CodeExecutor` parameter reads
+    # that annotation exactly like its own (inner shadows win)
+    def visit(node: ast.AST, inherited: dict[str, str]) -> None:
+        if isinstance(node, _FUNCTION_NODES):
+            own: dict[str, str] = {}
+            args = node.args
+            named = [*args.posonlyargs, *args.args, *args.kwonlyargs]
+            for a in named:
+                dotted = _annotation_dotted(a.annotation, ff.aliases)
+                if dotted is not None:
+                    own[a.arg] = dotted
+            inherited = {**inherited, **own}
+            if inherited:
+                out[id(node)] = dict(inherited)
+        for child in ast.iter_child_nodes(node):
+            visit(child, inherited)
+
+    visit(ff.tree, {})
+    # self-attr types per class, from __init__ assignments of annotated
+    # params, shared by every method of that class
+    for node in ff.tree.body:
+        if not isinstance(node, ast.ClassDef):
+            continue
+        init = next(
+            (
+                m
+                for m in node.body
+                if isinstance(m, _FUNCTION_NODES) and m.name == "__init__"
+            ),
+            None,
+        )
+        if init is None:
+            continue
+        param_types = out.get(id(init), {})
+        attr_types: dict[str, str] = {}
+        for sub in ast.walk(init):
+            if (
+                isinstance(sub, ast.Assign)
+                and len(sub.targets) == 1
+                and isinstance(sub.targets[0], ast.Attribute)
+                and isinstance(sub.targets[0].value, ast.Name)
+                and sub.targets[0].value.id == "self"
+                and isinstance(sub.value, ast.Name)
+                and sub.value.id in param_types
+            ):
+                attr_types[f"self.{sub.targets[0].attr}"] = param_types[
+                    sub.value.id
+                ]
+        if attr_types:
+            for m in node.body:
+                if isinstance(m, _FUNCTION_NODES):
+                    out.setdefault(id(m), {}).update(attr_types)
+    return out
+
+
+def _receiver_spelling(expr: ast.expr) -> str | None:
+    if isinstance(expr, ast.Name):
+        return expr.id
+    if (
+        isinstance(expr, ast.Attribute)
+        and isinstance(expr.value, ast.Name)
+        and expr.value.id == "self"
+    ):
+        return f"self.{expr.attr}"
+    return None
+
+
+def _walk_with_coverage(func: ast.AST):
+    """Yield ``(node, covered)`` for every node in the function, where
+    ``covered`` is the frozen set of exception leaf names the enclosing
+    ``try`` arms HANDLE at that point. An arm handles only if it contains
+    no bare ``raise`` (a re-raising arm maps nothing); handler/finally
+    bodies and nested defs run outside the try's protection."""
+    stack: list[tuple[ast.AST, frozenset[str]]] = [
+        (child, frozenset()) for child in ast.iter_child_nodes(func)
+    ]
+    while stack:
+        node, covered = stack.pop()
+        yield node, covered
+        if isinstance(node, ast.Try):
+            caught: set[str] = set()
+            for handler in node.handlers:
+                handles = not any(
+                    isinstance(sub, ast.Raise) and sub.exc is None
+                    for sub in ast.walk(handler)
+                )
+                if handles:
+                    caught.update(_exc_leaf_names(handler.type))
+            inner = covered | frozenset(caught)
+            for child in node.body:
+                stack.append((child, inner))
+            # the else block runs AFTER the try body completes and its
+            # exceptions are NOT caught by this try's arms — it gets the
+            # outer coverage, like the handlers and finally
+            for child in node.orelse:
+                stack.append((child, covered))
+            for handler in node.handlers:
+                for child in handler.body:
+                    stack.append((child, covered))
+            for child in node.finalbody:
+                stack.append((child, covered))
+            continue
+        if isinstance(node, _FUNCTION_NODES):
+            # a nested def's body runs when called, not under this try —
+            # but the ladder/declared sets still apply (caller-side), so
+            # reset only the lexical coverage
+            for child in ast.iter_child_nodes(node):
+                stack.append((child, frozenset()))
+            continue
+        for child in ast.iter_child_nodes(node):
+            stack.append((child, covered))
+
+
+def _handler_escapes(
+    ff: _FileFacts,
+    handler_name: str,
+    corpus: dict[str, frozenset[str]],
+    receiver_types: dict[int, dict[str, str]],
+    resilient: bool,
+) -> list[tuple[str, int, str, str]]:
+    """(handler, line, exception, via) for every raisable exception the
+    coverage walk cannot prove caught: local raises plus one level into
+    callees resolved through import aliases and annotated receivers."""
+    defs = ff.table.get(handler_name)
+    if not defs:
+        return []
+    func = defs[0]
+    baseline = MAPPED_EXCEPTIONS | BENIGN_EXCEPTIONS
+    if resilient:
+        baseline = baseline | LADDER_CAUGHT
+    out: list[tuple[str, int, str, str]] = []
+    seen: set[tuple[str, str]] = set()
+    # receiver types visible in this handler: its own def plus nested defs
+    types: dict[str, str] = {}
+    for node in ast.walk(func):
+        if isinstance(node, _FUNCTION_NODES):
+            types.update(receiver_types.get(id(node), {}))
+    types.update(receiver_types.get(id(func), {}))
+
+    def flag(exc: str, via: str, line: int, covered: frozenset[str]) -> None:
+        if exc in covered or exc in baseline:
+            return
+        if "Exception" in covered or "BaseException" in covered:
+            return
+        if exc.startswith("HTTP"):
+            return  # aiohttp response classes carry their own status
+        if (handler_name, exc) in seen:
+            return
+        seen.add((handler_name, exc))
+        out.append((handler_name, line, exc, via))
+
+    for node, covered in _walk_with_coverage(func):
+        if isinstance(node, ast.Raise) and node.exc is not None:
+            exc_expr = node.exc
+            name = (
+                resolve_call_name(exc_expr.func, ff.aliases)
+                if isinstance(exc_expr, ast.Call)
+                else resolve_call_name(exc_expr, ff.aliases)
+            )
+            leaf = _leaf(name)
+            if leaf:
+                flag(leaf, "local raise", node.lineno, covered)
+        elif isinstance(node, ast.Call):
+            # module-level function through an import alias
+            if isinstance(node.func, ast.Name):
+                dotted = ff.aliases.get(node.func.id)
+                if dotted and dotted in corpus:
+                    for exc in sorted(corpus[dotted]):
+                        flag(exc, f"{node.func.id}()", node.lineno, covered)
+            elif isinstance(node.func, ast.Attribute):
+                spelled = _receiver_spelling(node.func.value)
+                if spelled is not None and spelled in types:
+                    key = f"{types[spelled]}.{node.func.attr}"
+                    if key in corpus:
+                        for exc in sorted(corpus[key]):
+                            flag(
+                                exc,
+                                f"{spelled}.{node.func.attr}()",
+                                node.lineno,
+                                covered,
+                            )
+    return out
+
+
+# --------------------------------------------------------------------------
+# surface assembly
+# --------------------------------------------------------------------------
+
+
+def extract_surface(root: Path | str = PACKAGE_ROOT) -> Surface:
+    """One pass over the edge files → the full surface model. Missing
+    files are skipped (synthetic trees need only the scopes they test)."""
+    root = Path(root)
+    surface = Surface()
+    corpus = _build_raise_corpus(root)
+    for scope, rel in EDGE_FILES.items():
+        py = root / rel
+        if not py.exists():
+            continue
+        surface.files_scanned += 1
+        rel_path = f"{root.name}/{rel}"
+        if scope == "http":
+            surface.http_path = rel_path
+        elif scope == "grpc":
+            surface.grpc_path = rel_path
+        ff = _file_facts(ast.parse(py.read_text(), filename=rel_path), rel_path)
+        receiver_types = _receiver_types(ff)
+        if scope == "grpc":
+            surface.grpc = _extract_grpc_methods(ff)
+            for method in surface.grpc:
+                for handler, line, exc, via in _handler_escapes(
+                    ff, method.method, corpus, receiver_types, method.resilient
+                ):
+                    surface.escapes.append((ff.path, handler, line, exc, via))
+        else:
+            routes = _extract_http_routes(ff, scope)
+            if scope == "http":
+                surface.http = routes
+            else:
+                surface.router = routes
+            for route in routes:
+                for handler, line, exc, via in _handler_escapes(
+                    ff, route.handler, corpus, receiver_types, route.resilient
+                ):
+                    surface.escapes.append((ff.path, handler, line, exc, via))
+    router_core = root / ROUTER_CORE_FILE
+    if router_core.exists():
+        surface.files_scanned += 1
+        surface.router_headers = _extract_router_headers(
+            ast.parse(router_core.read_text())
+        )
+    models_py = root / MODELS_FILE
+    if models_py.exists():
+        surface.files_scanned += 1
+        surface.models = _extract_models(ast.parse(models_py.read_text()))
+    return surface
+
+
+def surface_to_dict(surface: Surface) -> dict:
+    """The checked-in golden's shape: deterministic ordering, NO line
+    numbers (an edit that moves code without changing the surface must
+    not churn the golden)."""
+
+    def route_dict(r: HttpRoute) -> dict:
+        return {
+            "method": r.method,
+            "path": r.path,
+            "handler": r.handler,
+            "sse": r.sse,
+            "resilient": r.resilient,
+            "allow_draining": r.allow_draining,
+            "statuses": sorted(r.statuses),
+            "query_params": {
+                name: {"kind": p.kind, "bounded": p.bounded}
+                for name, p in sorted(r.params.items())
+            },
+            "response_models": sorted(r.response_models),
+            "exception_statuses": {
+                exc: list(statuses)
+                for exc, statuses in sorted(r.exception_statuses.items())
+            },
+        }
+
+    def method_dict(m: GrpcMethod) -> dict:
+        return {
+            "service": m.service,
+            "method": m.method,
+            "streaming": m.streaming,
+            "request": m.request,
+            "resilient": m.resilient,
+            "allow_draining": m.allow_draining,
+            "codes": sorted(m.codes),
+            "trailers": sorted(m.trailers),
+            "params": {
+                name: {"kind": p.kind, "bounded": p.bounded}
+                for name, p in sorted(m.params.items())
+            },
+            "exception_codes": {
+                exc: list(codes)
+                for exc, codes in sorted(m.exception_codes.items())
+            },
+        }
+
+    return {
+        "version": 1,
+        "http": [route_dict(r) for r in surface.http],
+        "grpc": [method_dict(m) for m in surface.grpc],
+        "router": [route_dict(r) for r in surface.router],
+        "router_headers": {
+            k: list(v) for k, v in sorted(surface.router_headers.items())
+        },
+        "models": {
+            name: dict(sorted(fields.items()))
+            for name, fields in sorted(surface.models.items())
+        },
+        "twins": [
+            {"http": t.http, "grpc": list(t.grpc)}
+            for t in sorted(TWINS, key=lambda t: t.http)
+        ],
+        "exemptions": [
+            {"surface": e.surface, "reason": e.reason}
+            for e in sorted(EXEMPTIONS, key=lambda e: e.surface)
+        ],
+    }
+
+
+# --------------------------------------------------------------------------
+# the contract rules
+# --------------------------------------------------------------------------
+
+
+def _v(path: str, line: int, rule: str, message: str) -> Violation:
+    return Violation(path=path, line=line, rule=rule, message=message)
+
+
+def _check_twins(
+    surface: Surface, twins: tuple[Twin, ...], exemptions: tuple[Exemption, ...]
+) -> list[Violation]:
+    out: list[Violation] = []
+    http = surface.http_by_key()
+    grpc = surface.grpc_by_key()
+    declared_http = {t.http for t in twins}
+    declared_grpc = {key for t in twins for key in t.grpc}
+
+    def exempt(key: str) -> bool:
+        return any(e.matches(key) for e in exemptions)
+
+    for key, route in http.items():
+        if key not in declared_http and not exempt(key):
+            out.append(
+                _v(
+                    route.file,
+                    route.line,
+                    "route-twin-missing",
+                    f"HTTP route {key} has no declared gRPC twin and no "
+                    "transport-specific exemption — declare one in "
+                    "contractlint.TWINS/EXEMPTIONS so the mirror is a "
+                    "reviewed decision, not an omission",
+                )
+            )
+    for key, method in grpc.items():
+        if key not in declared_grpc and not exempt(key):
+            out.append(
+                _v(
+                    method.file,
+                    method.line,
+                    "route-twin-missing",
+                    f"gRPC method {key} has no declared HTTP twin and no "
+                    "transport-specific exemption (contractlint.TWINS/"
+                    "EXEMPTIONS)",
+                )
+            )
+    for twin in twins:
+        if twin.http not in http:
+            out.append(
+                _v(
+                    surface.http_path,
+                    0,
+                    "route-twin-missing",
+                    f"twin map names HTTP route {twin.http}, which the "
+                    "surface no longer contains — delete the stale entry",
+                )
+            )
+        for key in twin.grpc:
+            if key not in grpc:
+                out.append(
+                    _v(
+                        surface.grpc_path,
+                        0,
+                        "route-twin-missing",
+                        f"twin map names gRPC method {key}, which the "
+                        "surface no longer contains — delete the stale entry",
+                    )
+                )
+    surfaced = set(http) | set(grpc)
+    for exemption in exemptions:
+        if exemption.surface.endswith("*"):
+            hit = any(exemption.matches(k) for k in surfaced)
+        else:
+            hit = exemption.surface in surfaced
+        if not hit:
+            out.append(
+                _v(
+                    surface.http_path,
+                    0,
+                    "route-twin-missing",
+                    f"exemption for {exemption.surface} matches nothing on "
+                    "the surface — delete the stale entry",
+                )
+            )
+    return out
+
+
+def _check_status_mapping(
+    surface: Surface, twins: tuple[Twin, ...]
+) -> list[Violation]:
+    out: list[Violation] = []
+    http = surface.http_by_key()
+    grpc = surface.grpc_by_key()
+    for twin in twins:
+        route = http.get(twin.http)
+        methods = [grpc[k] for k in twin.grpc if k in grpc]
+        if route is None or not methods:
+            continue  # stale entries are route-twin-missing's finding
+        codes = set().union(*(m.codes for m in methods))
+        trailers = set().union(*(m.trailers for m in methods))
+        for status in sorted(route.statuses & CANONICAL_STATUS_TO_CODE.keys()):
+            expected = CANONICAL_STATUS_TO_CODE[status]
+            if expected not in codes:
+                out.append(
+                    _v(
+                        route.file,
+                        route.line,
+                        "status-mapping-drift",
+                        f"{twin.http} can answer {status} but its twin "
+                        f"({', '.join(twin.grpc)}) never emits {expected} — "
+                        "the same failure surfaces as UNKNOWN/OK there "
+                        "(canonical table, docs/analysis.md 'Contract lint')",
+                    )
+                )
+        for code in sorted(codes & CANONICAL_CODE_TO_STATUSES.keys()):
+            # Reverse direction. INVALID_ARGUMENT is forward-only: the
+            # JSON-bytes gRPC envelope can always fail to DECODE (an
+            # encoding-level IA with no HTTP analogue — a GET query
+            # string or an empty POST body cannot be malformed JSON), so
+            # only the 422/400→IA direction is a contract claim.
+            if code == "INVALID_ARGUMENT":
+                continue
+            expected_statuses = CANONICAL_CODE_TO_STATUSES[code]
+            if not route.statuses & set(expected_statuses):
+                out.append(
+                    _v(
+                        route.file,
+                        route.line,
+                        "status-mapping-drift",
+                        f"twin of {twin.http} emits {code} but the HTTP "
+                        "side never answers "
+                        f"{'/'.join(map(str, expected_statuses))} — the "
+                        "same failure has no HTTP spelling",
+                    )
+                )
+        for code, trailer in TRAILER_REQUIRED.items():
+            if code in codes and trailer not in trailers:
+                out.append(
+                    _v(
+                        methods[0].file,
+                        methods[0].line,
+                        "status-mapping-drift",
+                        f"twin of {twin.http} emits {code} without the "
+                        f"`{trailer}` trailer — the HTTP side's Retry-After "
+                        "hint has no gRPC spelling",
+                    )
+                )
+    return out
+
+
+def _check_sli_parity(
+    surface: Surface, twins: tuple[Twin, ...]
+) -> list[Violation]:
+    out: list[Violation] = []
+    http = surface.http_by_key()
+    grpc = surface.grpc_by_key()
+    for twin in twins:
+        route = http.get(twin.http)
+        if route is None:
+            continue
+        for key in twin.grpc:
+            method = grpc.get(key)
+            if method is None:
+                continue
+            if method.resilient != route.resilient:
+                out.append(
+                    _v(
+                        method.file,
+                        method.line,
+                        "sli-parity",
+                        f"{key} {'runs' if method.resilient else 'does not run'} "
+                        f"under the resilience ladder but its twin {twin.http} "
+                        f"{'does' if route.resilient else 'does not'} — the "
+                        "transports would compute different SLIs for the "
+                        "same workload",
+                    )
+                )
+            elif method.allow_draining != route.allow_draining:
+                out.append(
+                    _v(
+                        method.file,
+                        method.line,
+                        "sli-parity",
+                        f"{key} and {twin.http} disagree on the drain "
+                        "exemption (allow_draining) — lease handoff would "
+                        "work on one transport and 503 on the other",
+                    )
+                )
+    return out
+
+
+def _check_param_coercion(
+    surface: Surface, twins: tuple[Twin, ...]
+) -> list[Violation]:
+    out: list[Violation] = []
+    http = surface.http_by_key()
+    grpc = surface.grpc_by_key()
+    for twin in twins:
+        route = http.get(twin.http)
+        if route is None:
+            continue
+        for key in twin.grpc:
+            method = grpc.get(key)
+            if method is None:
+                continue
+            for name in sorted(set(route.params) & set(method.params)):
+                hp, gp = route.params[name], method.params[name]
+                if hp.kind != gp.kind:
+                    out.append(
+                        _v(
+                            method.file,
+                            method.line,
+                            "param-coercion-drift",
+                            f"`{name}` is parsed as {hp.kind} on {twin.http} "
+                            f"but as {gp.kind} on {key} — the same value "
+                            "means different things per transport (the "
+                            "bool(\"0\") bug class)",
+                        )
+                    )
+                elif hp.bounded != gp.bounded:
+                    strict = twin.http if hp.bounded else key
+                    loose = key if hp.bounded else twin.http
+                    out.append(
+                        _v(
+                            method.file,
+                            method.line,
+                            "param-coercion-drift",
+                            f"`{name}` is rejected when negative on {strict} "
+                            f"but accepted on {loose} — bound both or "
+                            "neither",
+                        )
+                    )
+    return out
+
+
+def _check_exception_escapes(surface: Surface) -> list[Violation]:
+    return [
+        _v(
+            path,
+            line,
+            "exception-escapes-as-500",
+            f"{exc} (via {via}) can escape `{handler}` uncaught: no except "
+            "arm, resilience-ladder arm, or declared mapping turns it into "
+            "a clean status — it surfaces as a generic 500/UNKNOWN",
+        )
+        for path, handler, line, exc, via in surface.escapes
+    ]
+
+
+def _route_doc_pattern(path: str) -> re.Pattern:
+    escaped = re.escape(path)
+    return re.compile(re.sub(r"\\\{[^}]*\\\}", r"\\{[^}]+\\}", escaped))
+
+
+def _check_documented(
+    surface: Surface, docs_text: str | None
+) -> list[Violation]:
+    if docs_text is None:
+        return []
+    out: list[Violation] = []
+    seen_paths: set[str] = set()
+    for route in [*surface.http, *surface.router]:
+        if route.path in seen_paths:
+            continue
+        seen_paths.add(route.path)
+        if not _route_doc_pattern(route.path).search(docs_text):
+            out.append(
+                _v(
+                    route.file,
+                    route.line,
+                    "undocumented-route",
+                    f"route {route.path} appears nowhere in docs/ — an "
+                    "operator cannot find a surface that is not written "
+                    "down",
+                )
+            )
+    for method in surface.grpc:
+        pattern = rf"(?<![A-Za-z0-9_]){re.escape(method.method)}(?![A-Za-z0-9_])"
+        if not re.search(pattern, docs_text):
+            out.append(
+                _v(
+                    method.file,
+                    method.line,
+                    "undocumented-route",
+                    f"gRPC method {method.key} appears nowhere in docs/",
+                )
+            )
+    return out
+
+
+# --------------------------------------------------------------------------
+# entry points
+# --------------------------------------------------------------------------
+
+
+def _docs_corpus(root: Path) -> str:
+    """Everything under <repo>/docs plus the README — the documentation
+    corpus the undocumented-route rule searches."""
+    repo = root.parent
+    chunks: list[str] = []
+    docs = repo / "docs"
+    if docs.is_dir():
+        for md in sorted(docs.glob("*.md")):
+            chunks.append(md.read_text())
+    readme = repo / "README.md"
+    if readme.exists():
+        chunks.append(readme.read_text())
+    return "\n".join(chunks)
+
+
+def lint_contract_paths(
+    root: Path | str = PACKAGE_ROOT,
+    twins: tuple[Twin, ...] = TWINS,
+    exemptions: tuple[Exemption, ...] = EXEMPTIONS,
+    suppressions: tuple[Suppression, ...] = SUPPRESSIONS,
+    docs_text: str | None = None,
+) -> ContractReport:
+    """Extract the surface, run every contract rule, apply the
+    suppression ledger — the tier-1 entry point. ``docs_text=None`` (the
+    default) reads the repo docs corpus; pass ``""`` to disable the
+    undocumented-route rule on synthetic trees."""
+    root = Path(root)
+    surface = extract_surface(root)
+    if docs_text is None:
+        docs_text = _docs_corpus(root)
+    all_violations = [
+        *_check_twins(surface, twins, exemptions),
+        *_check_status_mapping(surface, twins),
+        *_check_sli_parity(surface, twins),
+        *_check_param_coercion(surface, twins),
+        *_check_exception_escapes(surface),
+        *_check_documented(surface, docs_text or None),
+    ]
+    report = ContractReport(surface=surface)
+    used: set[Suppression] = set()
+    for violation in all_violations:
+        match = next((s for s in suppressions if s.matches(violation)), None)
+        if match is None:
+            report.violations.append(violation)
+        else:
+            used.add(match)
+            report.suppressed.append((violation, match))
+    report.stale_suppressions = [s for s in suppressions if s not in used]
+    report.violations.sort(key=lambda v: (v.path, v.line, v.rule, v.message))
+    return report
+
+
+def surface_json(root: Path | str = PACKAGE_ROOT) -> dict:
+    """The golden document: ``python scripts/analyze.py --surface``
+    regenerates it; tests/test_contractlint.py compares it against
+    docs/api_surface.json."""
+    return surface_to_dict(extract_surface(root))
+
+
+# Memoized by hand rather than lru_cache: the FAILURE outcome must cache
+# too (a stripped image without the source tree must pay the failing scan
+# once, not once per bundle pull), and the lock keeps two first-pullers
+# from scanning concurrently.
+_SURFACE_MEMO: dict[str, str | None] = {}
+_SURFACE_LOCK = threading.Lock()
+
+
+def _compute_surface_section() -> str | None:
+    try:
+        report = lint_contract_paths()
+        return json.dumps(
+            {
+                "model": surface_to_dict(report.surface),
+                "lint": {
+                    "clean": report.clean,
+                    "violations": len(report.violations),
+                    "suppressed": len(report.suppressed),
+                    "stale_suppressions": len(report.stale_suppressions),
+                },
+            }
+        )
+    except Exception:
+        return None
+
+
+def surface_section() -> dict | None:
+    """The ``surface`` section of ``/v1/debug/bundle``: the extraction
+    model plus the live lint verdict and suppression count, computed once
+    per process (a pure function of the installed source; None where the
+    source tree isn't readable) and cached — success and failure alike."""
+    with _SURFACE_LOCK:
+        if "section" not in _SURFACE_MEMO:
+            _SURFACE_MEMO["section"] = _compute_surface_section()
+    value = _SURFACE_MEMO["section"]
+    return json.loads(value) if value is not None else None
+
+
+def surface_section_nowait() -> dict | None:
+    """The request-path view: the cached section when the scan has
+    completed, else ``{"status": "warming"}`` (kicking the warm thread if
+    nothing is computing) — the event loop NEVER waits on the scan lock,
+    so a bundle pulled right after process start answers immediately and
+    the next pull carries the model."""
+    if _SURFACE_LOCK.acquire(blocking=False):
+        try:
+            if "section" in _SURFACE_MEMO:
+                value = _SURFACE_MEMO["section"]
+                return json.loads(value) if value is not None else None
+        finally:
+            _SURFACE_LOCK.release()
+        warm_surface_cache()
+        return {"status": "warming"}
+    return {"status": "warming"}  # the warm thread holds the lock: scanning
+
+
+def warm_surface_cache() -> threading.Thread:
+    """Fill the surface cache off the event loop. The scan is hundreds of
+    milliseconds of synchronous AST work; both server constructors kick
+    this daemon thread at build time so the first debug-bundle pull —
+    usually mid-incident — doesn't stall the loop computing it."""
+    thread = threading.Thread(
+        target=surface_section, name="contractlint-surface-warm", daemon=True
+    )
+    thread.start()
+    return thread

@@ -30,7 +30,9 @@ from bee_code_interpreter_tpu.analysis import stash_predicted_deps
 from bee_code_interpreter_tpu.api import models as api_models
 from bee_code_interpreter_tpu.observability import (
     FleetJournal,
+    FlightRecorder,
     Tracer,
+    build_debug_bundle,
     current_trace,
     empty_slo_snapshot,
     find_journal,
@@ -50,6 +52,7 @@ from bee_code_interpreter_tpu.resilience import (
     BreakerOpenError,
     Deadline,
     DeadlineExceeded,
+    SandboxTransientError,
 )
 from bee_code_interpreter_tpu.sessions import (
     CheckpointNotFound,
@@ -82,6 +85,13 @@ SERVICE_NAME = "code_interpreter.v1.CodeInterpreterService"
 # from run() fall through to the catch-all.
 _ABORT_ERRORS = tuple(
     t for t in (getattr(grpc.aio, "AbortError", None),) if t is not None
+)
+
+# Abort codes that are the SERVER's fault for SLI purposes: an explicit
+# INTERNAL abort is the gRPC spelling of the HTTP edge's 500 and must burn
+# availability budget exactly like one (docs/observability.md "SLOs").
+_SERVER_FAULT_CODES = frozenset(
+    {grpc.StatusCode.INTERNAL, grpc.StatusCode.UNKNOWN, grpc.StatusCode.DATA_LOSS}
 )
 
 class _SliSample:
@@ -358,8 +368,16 @@ class CodeInterpreterServicer:
                 except asyncio.CancelledError:
                     raise  # client went away: sample.ok untouched (not a sample)
                 except _ABORT_ERRORS:
-                    sample.ok = True  # body aborted INVALID_ARGUMENT: client fault
-                    label = "client_error"
+                    # The body aborted with an explicit status. Client-fault
+                    # codes (INVALID_ARGUMENT/NOT_FOUND/…) sample good — the
+                    # twin of the HTTP edge's 4xx — while an INTERNAL abort
+                    # (the 500 twin: sandbox died, execution failed) must
+                    # burn budget like the 500 it mirrors. The context's
+                    # code is the verdict; a body that already set
+                    # sample.ok (ExecuteStream terminal events) wins.
+                    if sample.ok is None:
+                        sample.ok = context.code() not in _SERVER_FAULT_CODES
+                    label = "client_error" if sample.ok else "error"
                     raise
                 except BaseException:
                     sample.ok = False  # unhandled → gRPC UNKNOWN
@@ -461,13 +479,25 @@ class CodeInterpreterServicer:
                 if self._admission is not None and verdict is not None
                 else nullcontext()
             ):
-                result = await self._code_executor.execute(
-                    source_code=validated.source_code,
-                    files=validated.files,
-                    env=validated.env,  # env forwarded, unlike reference (:67-70)
-                    timeout_s=validated.timeout,
-                    deadline=deadline,
-                )
+                try:
+                    result = await self._code_executor.execute(
+                        source_code=validated.source_code,
+                        files=validated.files,
+                        env=validated.env,  # env forwarded, unlike reference (:67-70)
+                        timeout_s=validated.timeout,
+                        deadline=deadline,
+                    )
+                except (DeadlineExceeded, BreakerOpenError):
+                    raise  # shared resilience contract (DEADLINE_EXCEEDED/UNAVAILABLE)
+                except Exception:
+                    # The HTTP twin answers 500 "Execution failed" here; an
+                    # unhandled escape would surface as UNKNOWN — INTERNAL
+                    # is the canonical 500 mapping (docs/analysis.md
+                    # "Contract lint"), and the abort arm samples it bad.
+                    logger.exception("Execution failed")
+                    await context.abort(
+                        grpc.StatusCode.INTERNAL, "execution failed"
+                    )
             record_usage_at_edge(
                 result.usage,
                 current_trace(),
@@ -780,6 +810,15 @@ class CodeInterpreterServicer:
                 return pb.ExecuteCustomToolResponse(
                     error=pb.ExecuteCustomToolResponse.ErrorResponse(stderr=e.stderr)
                 )
+            except (DeadlineExceeded, BreakerOpenError):
+                raise  # shared resilience contract (DEADLINE_EXCEEDED/UNAVAILABLE)
+            except Exception:
+                # Mirror of the HTTP twin's 500 (a raw sandbox failure must
+                # not escape as UNKNOWN); sampled bad via the abort arm.
+                logger.exception("Custom tool execution failed")
+                await context.abort(
+                    grpc.StatusCode.INTERNAL, "execution failed"
+                )
             return pb.ExecuteCustomToolResponse(
                 success=pb.ExecuteCustomToolResponse.SuccessResponse(
                     tool_output_json=json.dumps(output)
@@ -858,6 +897,16 @@ class SessionServicer:
                 await context.abort(
                     grpc.StatusCode.RESOURCE_EXHAUSTED, str(e)
                 )
+            except (DeadlineExceeded, BreakerOpenError):
+                raise  # shared resilience contract (DEADLINE_EXCEEDED/UNAVAILABLE)
+            except Exception:
+                # HTTP twin: 500 "Session create failed". An unhandled
+                # escape would be UNKNOWN; INTERNAL is the canonical 500
+                # mapping and the abort arm samples it bad.
+                logger.exception("Session create failed")
+                await context.abort(
+                    grpc.StatusCode.INTERNAL, "session create failed"
+                )
             return json.dumps(
                 {
                     "session_id": session.session_id,
@@ -932,6 +981,21 @@ class SessionServicer:
                 )
             except SessionNotFound as e:
                 await context.abort(grpc.StatusCode.NOT_FOUND, str(e))
+            except (DeadlineExceeded, BreakerOpenError):
+                raise  # shared resilience contract (DEADLINE_EXCEEDED/UNAVAILABLE)
+            except SandboxTransientError:
+                # The leased sandbox died mid-execute: the HTTP twin's 500
+                # "Session sandbox died; lease ended" — INTERNAL, sampled
+                # bad via the abort arm, never an UNKNOWN escape.
+                logger.exception("Leased sandbox died mid-execute")
+                await context.abort(
+                    grpc.StatusCode.INTERNAL, "session sandbox died; lease ended"
+                )
+            except Exception:
+                logger.exception("Session execution failed")
+                await context.abort(
+                    grpc.StatusCode.INTERNAL, "execution failed"
+                )
             record_usage_at_edge(
                 outcome.usage,
                 current_trace(),
@@ -969,6 +1033,14 @@ class SessionServicer:
                 )
             except SessionNotFound as e:
                 await context.abort(grpc.StatusCode.NOT_FOUND, str(e))
+            except (DeadlineExceeded, BreakerOpenError):
+                raise  # shared resilience contract (DEADLINE_EXCEEDED/UNAVAILABLE)
+            except Exception:
+                # HTTP twin: 500 "Checkpoint failed" — INTERNAL, not UNKNOWN.
+                logger.exception("Session checkpoint failed")
+                await context.abort(
+                    grpc.StatusCode.INTERNAL, "checkpoint failed"
+                )
             return json.dumps(
                 {
                     "session_id": session.session_id,
@@ -999,6 +1071,14 @@ class SessionServicer:
                 )
             except (SessionNotFound, CheckpointNotFound) as e:
                 await context.abort(grpc.StatusCode.NOT_FOUND, str(e))
+            except (DeadlineExceeded, BreakerOpenError):
+                raise  # shared resilience contract (DEADLINE_EXCEEDED/UNAVAILABLE)
+            except Exception:
+                # HTTP twin: 500 "Rollback failed" — INTERNAL, not UNKNOWN.
+                logger.exception("Session rollback failed")
+                await context.abort(
+                    grpc.StatusCode.INTERNAL, "rollback failed"
+                )
             return json.dumps(
                 {
                     "session_id": session.session_id,
@@ -1095,13 +1175,17 @@ class FleetServicer:
                 # TypeError covers {"limit": null} / {"limit": [1]} — every
                 # malformed shape must be INVALID_ARGUMENT, never UNKNOWN.
                 limit = int(json.loads(request.decode()).get("limit", limit))
+                if limit < 0:
+                    # the HTTP twin (GET /v1/fleet/events) 400s negative
+                    # limits; the old max(0, …) clamp silently diverged
+                    raise ValueError("limit must be >= 0")
             except (ValueError, TypeError, AttributeError, OverflowError):
                 await context.abort(
                     grpc.StatusCode.INVALID_ARGUMENT,
-                    'request must be JSON like {"limit": 50}',
+                    'request must be JSON like {"limit": 50} (limit >= 0)',
                 )
         return json.dumps(
-            {"events": self._journal.events(limit=max(0, limit))}
+            {"events": self._journal.events(limit=limit)}
         ).encode()
 
 
@@ -1216,6 +1300,11 @@ class ObservabilityServicer:
             )
         body = await self._parse_json_request(request, context)
         try:
+            limit = int(body["limit"]) if body.get("limit") is not None else None
+            if limit is not None and limit < 0:
+                # the HTTP twin 400s negative limits; accepting them here
+                # was the bool("0")-class coercion drift
+                raise ValueError("limit must be >= 0")
             events = self._recorder.events(
                 kind=body.get("kind"),
                 outcome=body.get("outcome"),
@@ -1231,16 +1320,13 @@ class ObservabilityServicer:
                     if body.get("since") is not None
                     else None
                 ),
-                limit=(
-                    int(body["limit"])
-                    if body.get("limit") is not None
-                    else None
-                ),
+                limit=limit,
             )
         except (TypeError, ValueError):
             await context.abort(
                 grpc.StatusCode.INVALID_ARGUMENT,
-                "limit, min_duration_ms and since must be numeric",
+                "limit, min_duration_ms and since must be numeric "
+                "(limit >= 0)",
             )
         return json.dumps({"events": events}).encode()
 
@@ -1636,6 +1722,22 @@ class GrpcServer:
         autoscale=None,  # callable -> dict for GetAutoscale (docs/autoscaling.md)
         tenancy=None,  # tenancy.TenantRegistry shared with the HTTP edge
     ) -> None:
+        # Mirror create_http_server's standalone wiring: a tracer exists
+        # always, and when no FlightRecorder was handed in (tests,
+        # standalone servers) one is built here and wired as a tracer sink
+        # — the composition root passes one already wired, and wiring it
+        # again would double every event. Before this, a standalone gRPC
+        # server had NO events API (GetEvents aborted UNIMPLEMENTED) while
+        # its HTTP twin always answered.
+        tracer = tracer or Tracer(metrics=metrics)
+        if recorder is None:
+            recorder = FlightRecorder(metrics=metrics)
+            tracer.add_sink(recorder.record_trace)
+        # Warm the bundle's `surface` section off-loop (see
+        # create_http_server: the scan must not stall the first pull).
+        from bee_code_interpreter_tpu.analysis import contractlint
+
+        contractlint.warm_surface_cache()
         self._servicer = CodeInterpreterServicer(
             code_executor,
             custom_tool_executor,
@@ -1674,6 +1776,25 @@ class GrpcServer:
         if fleet is None:
             fleet = find_journal(code_executor)
         self._fleet = fleet if fleet is not None else FleetJournal()
+        if self._debug_bundle is None:
+            # Standalone fallback, the HTTP edge's exact shape: assemble
+            # the bundle from what this server was handed instead of
+            # aborting UNIMPLEMENTED — the transports must answer the same
+            # question the same way (docs/analysis.md "Contract lint").
+            self._debug_bundle = lambda: build_debug_bundle(
+                tracer=tracer,
+                fleet=self._fleet,
+                slo=slo,
+                metrics=metrics,
+                executor=code_executor,
+                drain=drain,
+                recorder=recorder,
+                loopmon=loopmon,
+                contprof=contprof,
+                serving=serving,
+                autoscale=autoscale,
+                tenancy=tenancy,
+            )
         self.health = HealthServicer()
         self._tls_cert = tls_cert
         self._tls_cert_key = tls_cert_key

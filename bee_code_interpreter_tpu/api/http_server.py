@@ -152,6 +152,13 @@ def create_http_server(
     app = web.Application(client_max_size=1 << 30)
     metrics = metrics or Registry()
     tracer = tracer or Tracer(metrics=metrics)
+    # Warm the debug bundle's `surface` section off-loop at build time:
+    # the contract-lint scan is hundreds of milliseconds of synchronous
+    # AST work that must not run on the event loop during the first
+    # (usually mid-incident) bundle pull.
+    from bee_code_interpreter_tpu.analysis import contractlint
+
+    contractlint.warm_surface_cache()
     if recorder is None:
         # Standalone servers (tests) get their own recorder; the
         # composition root passes one already wired as a tracer sink —
@@ -771,6 +778,17 @@ def create_http_server(
                 )
             except CustomToolExecuteError as e:
                 return web.json_response({"stderr": e.stderr}, status=400)
+            except (DeadlineExceeded, BreakerOpenError):
+                raise  # shared resilience contract (504/503)
+            except Exception:
+                # Without this arm a raw sandbox failure escaped as
+                # aiohttp's default text/plain 500 (no detail, no JSON)
+                # while /v1/execute answered a JSON 500 — and the gRPC
+                # twin aborts INTERNAL "execution failed".
+                logger.exception("Custom tool execution failed")
+                return web.json_response(
+                    {"detail": "Execution failed"}, status=500
+                )
             return web.json_response(
                 models.ExecuteCustomToolResponse(
                     tool_output_json=json.dumps(output)
@@ -1145,6 +1163,7 @@ def create_http_server(
                 contprof=contprof,
                 serving=serving,
                 autoscale=autoscale,
+                tenancy=tenancy,
             )
         )
         return web.json_response(bundle)

@@ -150,6 +150,22 @@ def build_debug_bundle(
     else:
         bundle["tenants"] = None
 
+    # The extracted API surface model + the contract lint's live verdict
+    # (docs/analysis.md "Contract lint"): the route table an operator or
+    # the FleetRouter reads instead of hardcoding it. Non-blocking: the
+    # scan runs once per process on the warm thread both servers kick at
+    # build time; a pull that races it answers {"status": "warming"}
+    # instead of stalling the event loop, and None means the source tree
+    # isn't readable where this process runs (a stripped image).
+    try:
+        from bee_code_interpreter_tpu.analysis.contractlint import (
+            surface_section_nowait,
+        )
+
+        bundle["surface"] = surface_section_nowait()
+    except Exception:
+        bundle["surface"] = None
+
     bundle["config"] = config.redacted_dump() if config is not None else None
     bundle["metrics"] = metrics.expose() if metrics is not None else None
     return bundle

@@ -16,11 +16,15 @@ Usage:
     python scripts/analyze.py --self-lint        # run the repo asynclint
     python scripts/analyze.py --concurrency-lint # the await-aware lint
     python scripts/analyze.py --jax-lint         # the accelerator-stack lint
+    python scripts/analyze.py --contract-lint    # the cross-transport lint
+    python scripts/analyze.py --surface > docs/api_surface.json  # the golden
     python scripts/analyze.py --self-lint --sarif > asynclint.sarif
 
-scripts/lint.sh chains all three self-lints plus the metrics/docs lints —
+scripts/lint.sh chains all four self-lints plus the metrics/docs lints —
 the one command CI needs. ``--sarif`` renders any self-lint as a SARIF
 2.1.0 log (suppressed findings carried with their justifications).
+``--surface`` dumps the extracted API surface model (docs/analysis.md
+"Contract lint") in the exact checked-in golden format.
 
 Without explicit --deny/--warn flags the policy comes from the same
 APP_POLICY_* environment the service reads, so a dry run matches what the
@@ -129,6 +133,24 @@ def jax_lint(as_json: bool, as_sarif: bool = False) -> int:
     return _render_lint(lint_jax_paths(), "jaxlint", as_json, as_sarif)
 
 
+def contract_lint(as_json: bool, as_sarif: bool = False) -> int:
+    from bee_code_interpreter_tpu.analysis import lint_contract_paths
+
+    return _render_lint(
+        lint_contract_paths(), "contractlint", as_json, as_sarif
+    )
+
+
+def dump_surface() -> int:
+    from bee_code_interpreter_tpu.analysis import surface_json
+
+    # sort_keys + trailing newline: byte-identical to the checked-in
+    # golden, so `--surface > docs/api_surface.json` is the whole update
+    # workflow (docs/analysis.md "Updating the surface golden").
+    print(json.dumps(surface_json(), indent=2, sort_keys=True))
+    return 0
+
+
 def main() -> int:
     parser = argparse.ArgumentParser(
         description="Edge workload analyzer (docs/analysis.md)"
@@ -144,6 +166,13 @@ def main() -> int:
                         help="run the accelerator-stack lint over models/ "
                              "ops/ parallel/ runtime/shim/ "
                              "(analysis/jaxlint.py)")
+    parser.add_argument("--contract-lint", action="store_true",
+                        help="run the cross-transport API-contract lint "
+                             "over the HTTP/gRPC/router edges "
+                             "(analysis/contractlint.py)")
+    parser.add_argument("--surface", action="store_true",
+                        help="dump the extracted API surface model in the "
+                             "docs/api_surface.json golden format")
     parser.add_argument("--sarif", action="store_true",
                         help="render a self-lint as SARIF 2.1.0 (implies "
                              "machine-readable output)")
@@ -163,10 +192,15 @@ def main() -> int:
         return concurrency_lint(args.json, args.sarif)
     if args.jax_lint:
         return jax_lint(args.json, args.sarif)
+    if args.contract_lint:
+        return contract_lint(args.json, args.sarif)
+    if args.surface:
+        return dump_surface()
     if not args.source:
         parser.error(
             "source file (or -) required unless "
-            "--self-lint/--concurrency-lint/--jax-lint"
+            "--self-lint/--concurrency-lint/--jax-lint/--contract-lint/"
+            "--surface"
         )
 
     source = (

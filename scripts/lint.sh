@@ -1,8 +1,9 @@
 #!/usr/bin/env bash
 # The one lint command CI needs (docs/analysis.md "Self-lint"): the
 # asyncio self-lint, the await-aware concurrency lint, the accelerator-
-# stack jaxlint, and the metrics/docs convention lints. Exits nonzero on
-# ANY unexplained finding (a stale suppression counts as one).
+# stack jaxlint, the cross-transport contractlint, and the metrics/docs
+# convention lints. Exits nonzero on ANY unexplained finding (a stale
+# suppression counts as one).
 #
 #   scripts/lint.sh            # human output
 #   scripts/lint.sh --sarif    # SARIF 2.1.0 logs to lint-*.sarif
@@ -15,7 +16,8 @@ if [[ "${1:-}" == "--sarif" ]]; then
     "$PYTHON" scripts/analyze.py --self-lint --sarif > lint-asynclint.sarif
     "$PYTHON" scripts/analyze.py --concurrency-lint --sarif > lint-concurrency.sarif
     "$PYTHON" scripts/analyze.py --jax-lint --sarif > lint-jaxlint.sarif
-    echo "wrote lint-asynclint.sarif lint-concurrency.sarif lint-jaxlint.sarif"
+    "$PYTHON" scripts/analyze.py --contract-lint --sarif > lint-contractlint.sarif
+    echo "wrote lint-asynclint.sarif lint-concurrency.sarif lint-jaxlint.sarif lint-contractlint.sarif"
 else
     echo "== asynclint (analysis/asynclint.py)"
     "$PYTHON" scripts/analyze.py --self-lint
@@ -23,6 +25,8 @@ else
     "$PYTHON" scripts/analyze.py --concurrency-lint
     echo "== jaxlint (analysis/jaxlint.py)"
     "$PYTHON" scripts/analyze.py --jax-lint
+    echo "== contractlint (analysis/contractlint.py)"
+    "$PYTHON" scripts/analyze.py --contract-lint
 fi
 
 echo "== metrics/docs conventions (pytest)"
@@ -30,4 +34,5 @@ echo "== metrics/docs conventions (pytest)"
     tests/test_asynclint.py \
     tests/test_concurrencylint.py \
     tests/test_jaxlint.py \
+    tests/test_contractlint.py \
     tests/test_metrics_conventions.py

@@ -1,0 +1,672 @@
+"""Tier-1 cross-transport contract lint (docs/analysis.md "Contract
+lint"): the HTTP/gRPC/router edges must carry ZERO unexplained
+contractlint violations, the checked-in ``docs/api_surface.json`` golden
+must match the extracted model byte-for-byte, and every suppression must
+still earn its justification — the asynclint/jaxlint contract, pointed at
+the API surface.
+
+Three sections: the repo itself; per-rule units on synthetic edge trees
+(so a regression names the broken rule); and both-transport regressions
+for the drift defects the PR 15 audit surfaced and FIXED (server faults
+as INTERNAL never UNKNOWN, negative-limit coercion parity, standalone
+gRPC observability parity)."""
+
+import json
+from pathlib import Path
+
+import pytest
+
+from bee_code_interpreter_tpu.analysis.asynclint import Suppression
+from bee_code_interpreter_tpu.analysis.contractlint import (
+    EXEMPTIONS,
+    SUPPRESSIONS,
+    TWINS,
+    Exemption,
+    Twin,
+    lint_contract_paths,
+    surface_json,
+)
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+# ------------------------------------------------------------- the repo
+
+
+def test_edges_have_zero_unexplained_violations():
+    report = lint_contract_paths()
+    assert report.files_scanned >= 5  # both edges + router + core + models
+    assert not report.violations, "\n" + report.summary()
+
+
+def test_no_stale_suppressions():
+    report = lint_contract_paths()
+    assert not report.stale_suppressions, (
+        "suppressions no longer matching any violation — delete them:\n"
+        + report.summary()
+    )
+    used = {s for _, s in report.suppressed}
+    assert used == set(SUPPRESSIONS)
+
+
+def test_every_suppression_and_exemption_is_justified():
+    for s in SUPPRESSIONS:
+        assert len(s.reason.split()) >= 8, (
+            f"{s.path} [{s.rule}]: a suppression needs a real justification"
+        )
+    for e in EXEMPTIONS:
+        assert len(e.reason.split()) >= 5, (
+            f"{e.surface}: an exemption needs a real reason"
+        )
+
+
+def test_stale_suppression_fails():
+    report = lint_contract_paths(
+        suppressions=(
+            *SUPPRESSIONS,
+            Suppression(
+                path="api/http_server.py",
+                rule="sli-parity",
+                reason="does not match anything",
+            ),
+        )
+    )
+    assert any(s.rule == "sli-parity" for s in report.stale_suppressions)
+    assert not report.clean
+
+
+def test_surface_golden_matches_checked_in_document():
+    """The golden contract: ANY surface change — a new route, a new
+    status, a coercion change — must land as a reviewed diff of
+    docs/api_surface.json. Regenerate with
+    `python scripts/analyze.py --surface > docs/api_surface.json`."""
+    golden = json.loads((REPO / "docs" / "api_surface.json").read_text())
+    assert surface_json() == golden, (
+        "the extracted API surface no longer matches docs/api_surface.json "
+        "— regenerate it (scripts/analyze.py --surface) and review the diff"
+    )
+
+
+def test_surface_section_served_in_debug_bundle():
+    from bee_code_interpreter_tpu.analysis.contractlint import surface_section
+    from bee_code_interpreter_tpu.observability import build_debug_bundle
+
+    surface_section()  # fill the cache synchronously: no warming race
+    bundle = build_debug_bundle()
+    surface = bundle["surface"]
+    assert surface["lint"]["clean"] is True
+    assert surface["lint"]["stale_suppressions"] == 0
+    assert {r["path"] for r in surface["model"]["http"]} >= {
+        "/v1/execute",
+        "/v1/sessions",
+    }
+    # the router's Retry-After passthrough contract is golden-pinned
+    assert "Retry-After" in surface["model"]["router_headers"][
+        "response_passthrough"
+    ]
+
+
+def test_twin_map_covers_every_v1_http_route():
+    """Belt and braces over the rule itself: every non-exempt /v1 route
+    is twinned, so the map cannot silently rot."""
+    report = lint_contract_paths()
+    declared = {t.http for t in TWINS}
+    for route in report.surface.http:
+        exempt = any(e.matches(route.key) for e in EXEMPTIONS)
+        assert route.key in declared or exempt, route.key
+
+
+# -------------------------------------------------- synthetic edge trees
+
+
+HTTP_OK = """
+from aiohttp import web
+
+async def with_resilience(run):
+    try:
+        return await run(None)
+    except AdmissionRejected:
+        return web.json_response({}, status=429)
+    except DeadlineExceeded:
+        return web.json_response({}, status=504)
+    except BreakerOpenError:
+        return web.json_response({}, status=503)
+
+async def execute(request):
+    async def run(deadline):
+        limit = int(request.query.get("limit", "1"))
+        if limit < 0:
+            return web.json_response({}, status=400)
+        return web.json_response({})
+    return await with_resilience(run)
+
+def build(app):
+    app.router.add_post("/v1/execute", execute)
+"""
+
+GRPC_OK = """
+import grpc
+import json
+
+SERVICE_NAME = "x.v1.Demo"
+_METHODS = ("Execute",)
+
+class Servicer:
+    async def _resilience_scope(self, context):
+        try:
+            yield None
+        except AdmissionRejected:
+            context.set_trailing_metadata((("retry-after-s", "1"),))
+            await context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED, "shed")
+        except DeadlineExceeded:
+            await context.abort(grpc.StatusCode.DEADLINE_EXCEEDED, "late")
+        except BreakerOpenError:
+            context.set_trailing_metadata((("retry-after-s", "1"),))
+            await context.abort(grpc.StatusCode.UNAVAILABLE, "open")
+
+    async def Execute(self, request, context):
+        body = json.loads(request.decode() or "{}")
+        limit = int(body.get("limit", 1))
+        if limit < 0:
+            await context.abort(grpc.StatusCode.INVALID_ARGUMENT, "neg")
+        async with self._resilience_scope(context):
+            return b"{}"
+
+def handler(servicer):
+    return grpc.method_handlers_generic_handler(
+        SERVICE_NAME,
+        {
+            name: grpc.unary_unary_rpc_method_handler(
+                getattr(servicer, name),
+                request_deserializer=bytes,
+                response_serializer=bytes,
+            )
+            for name in _METHODS
+        },
+    )
+"""
+
+TWIN = (Twin("POST /v1/execute", ("Demo.Execute",)),)
+DOCS = "/v1/execute and Execute are documented here"
+
+
+def make_tree(tmp_path, http_source=HTTP_OK, grpc_source=GRPC_OK):
+    pkg = tmp_path / "fakepkg"
+    (pkg / "api").mkdir(parents=True, exist_ok=True)
+    (pkg / "api" / "http_server.py").write_text(http_source)
+    (pkg / "api" / "grpc_server.py").write_text(grpc_source)
+    return pkg
+
+
+def rules_for(pkg, twins=TWIN, exemptions=(), docs_text=DOCS):
+    report = lint_contract_paths(
+        pkg, twins=twins, exemptions=exemptions, suppressions=(),
+        docs_text=docs_text,
+    )
+    return [v.rule for v in report.violations], report
+
+
+def test_synthetic_twin_pair_is_clean(tmp_path):
+    rules, report = rules_for(make_tree(tmp_path))
+    assert rules == [], "\n" + report.summary()
+
+
+def test_new_route_is_scoped_by_default(tmp_path):
+    """The omission bug class: a freshly added route (or servicer method)
+    is a route-twin-missing finding until someone DECLARES its twin or
+    its exemption — mirror coverage is a reviewed decision."""
+    http = HTTP_OK + """
+async def shiny(request):
+    return web.json_response({})
+
+def build2(app):
+    app.router.add_get("/v1/shiny", shiny)
+"""
+    rules, report = rules_for(
+        make_tree(tmp_path, http_source=http), docs_text=DOCS + " /v1/shiny"
+    )
+    assert rules == ["route-twin-missing"]
+    assert "/v1/shiny" in report.violations[0].message
+
+
+def test_stale_twin_and_stale_exemption_fail(tmp_path):
+    rules, _ = rules_for(
+        make_tree(tmp_path),
+        twins=(*TWIN, Twin("POST /v1/gone", ("Demo.Gone",))),
+    )
+    assert rules.count("route-twin-missing") == 2  # route AND method stale
+    rules, _ = rules_for(
+        make_tree(tmp_path),
+        exemptions=(Exemption("GET /nope", "never existed at all"),),
+    )
+    assert rules == ["route-twin-missing"]
+
+
+def test_status_mapping_drift_forward(tmp_path):
+    # HTTP grows a 404 arm; the twin has no NOT_FOUND
+    http = HTTP_OK.replace(
+        '        return web.json_response({})\n    return await',
+        '        if limit == 9:\n'
+        '            return web.json_response({}, status=404)\n'
+        '        return web.json_response({})\n    return await',
+    )
+    rules, report = rules_for(make_tree(tmp_path, http_source=http))
+    assert rules == ["status-mapping-drift"]
+    assert "NOT_FOUND" in report.violations[0].message
+
+
+def test_status_mapping_requires_retry_after_trailer(tmp_path):
+    grpc_source = GRPC_OK.replace(
+        '        except AdmissionRejected:\n'
+        '            context.set_trailing_metadata((("retry-after-s", "1"),))\n',
+        '        except AdmissionRejected:\n',
+    ).replace(
+        '        except BreakerOpenError:\n'
+        '            context.set_trailing_metadata((("retry-after-s", "1"),))\n',
+        '        except BreakerOpenError:\n',
+    )
+    rules, report = rules_for(make_tree(tmp_path, grpc_source=grpc_source))
+    assert "status-mapping-drift" in rules
+    assert any("retry-after-s" in v.message for v in report.violations)
+
+
+def test_sli_parity_drift(tmp_path):
+    # the gRPC method stops using the ladder while the HTTP twin keeps it
+    grpc_source = GRPC_OK.replace(
+        "        async with self._resilience_scope(context):\n"
+        "            return b\"{}\"",
+        "        return b\"{}\"",
+    )
+    rules, _ = rules_for(make_tree(tmp_path, grpc_source=grpc_source))
+    assert "sli-parity" in rules
+
+
+def test_param_coercion_kind_drift(tmp_path):
+    grpc_source = GRPC_OK.replace(
+        'limit = int(body.get("limit", 1))', 'limit = float(body.get("limit", 1))'
+    )
+    rules, report = rules_for(make_tree(tmp_path, grpc_source=grpc_source))
+    assert "param-coercion-drift" in rules
+    assert any("`limit`" in v.message for v in report.violations)
+
+
+def test_param_coercion_bound_drift(tmp_path):
+    # gRPC stops rejecting negative limits; HTTP still 400s them — the
+    # GetFleetEvents max(0, …) clamp bug class
+    grpc_source = GRPC_OK.replace(
+        "        if limit < 0:\n"
+        '            await context.abort(grpc.StatusCode.INVALID_ARGUMENT, "neg")\n',
+        "        limit = max(0, limit)\n",
+    )
+    rules, report = rules_for(make_tree(tmp_path, grpc_source=grpc_source))
+    assert "param-coercion-drift" in rules
+    assert any("negative" in v.message for v in report.violations)
+
+
+def test_undocumented_route_flagged(tmp_path):
+    rules, _ = rules_for(make_tree(tmp_path), docs_text="nothing relevant")
+    assert rules.count("undocumented-route") == 2  # the route AND the RPC
+
+
+ESCAPE_HTTP = """
+from aiohttp import web
+from fakepkg.services.executor import Executor
+
+def create(executor: Executor):
+    async def boom(request):
+        return web.json_response(await executor.run())
+
+    async def safe(request):
+        try:
+            return web.json_response(await executor.run())
+        except Exception:
+            return web.json_response({}, status=500)
+
+    def build(app):
+        app.router.add_post("/v1/boom", boom)
+        app.router.add_post("/v1/safe", safe)
+    return build
+"""
+
+ESCAPE_SERVICE = """
+class KabloomError(Exception):
+    pass
+
+class Executor:
+    async def run(self):
+        raise KabloomError("pod exploded")
+"""
+
+
+def test_exception_escape_flagged_and_catching_clears_it(tmp_path):
+    pkg = make_tree(tmp_path, http_source=ESCAPE_HTTP)
+    (pkg / "services").mkdir()
+    (pkg / "services" / "executor.py").write_text(ESCAPE_SERVICE)
+    twins = ()
+    exemptions = (Exemption("POST /v1/boom", "synthetic tree for the rule"),
+                  Exemption("POST /v1/safe", "synthetic tree for the rule"),
+                  Exemption("Demo.Execute", "synthetic tree for the rule"))
+    report = lint_contract_paths(
+        pkg, twins=twins, exemptions=exemptions, suppressions=(),
+        docs_text="/v1/boom /v1/safe Execute",
+    )
+    escapes = [v for v in report.violations if v.rule == "exception-escapes-as-500"]
+    assert len(escapes) == 1
+    assert "KabloomError" in escapes[0].message
+    assert "boom" in escapes[0].message  # `safe` catches: no finding
+
+
+ELSE_ESCAPE_HTTP = """
+from aiohttp import web
+from fakepkg.services.executor import Executor
+
+def create(executor: Executor):
+    async def sneaky(request):
+        try:
+            prepared = 1
+        except Exception:
+            return web.json_response({}, status=500)
+        else:
+            # runs AFTER the try body: the arms above DON'T cover it
+            return web.json_response(await executor.run())
+
+    def build(app):
+        app.router.add_post("/v1/sneaky", sneaky)
+    return build
+"""
+
+
+def test_exception_escape_in_else_block_is_not_covered(tmp_path):
+    """A try's else block runs outside its arms' protection — a raise
+    there escapes (code-review regression: the coverage walk used to
+    treat orelse like the body and silently under-reported the rule)."""
+    pkg = make_tree(tmp_path, http_source=ELSE_ESCAPE_HTTP)
+    (pkg / "services").mkdir()
+    (pkg / "services" / "executor.py").write_text(ESCAPE_SERVICE)
+    report = lint_contract_paths(
+        pkg,
+        twins=(),
+        exemptions=(
+            Exemption("POST /v1/sneaky", "synthetic tree for the rule"),
+            Exemption("Demo.Execute", "synthetic tree for the rule"),
+        ),
+        suppressions=(),
+        docs_text="/v1/sneaky Execute",
+    )
+    escapes = [
+        v for v in report.violations if v.rule == "exception-escapes-as-500"
+    ]
+    assert len(escapes) == 1 and "KabloomError" in escapes[0].message
+
+
+# ------------------------------------- both-transport drift regressions
+
+
+class _BoomCodeExecutor:
+    """Executor whose sandbox 'dies' with a raw exception: the verdict
+    must be the canonical 500/INTERNAL pair, never UNKNOWN."""
+
+    async def execute(self, **kwargs):
+        raise RuntimeError("sandbox exploded")
+
+
+class _BoomToolExecutor:
+    async def execute(self, **kwargs):
+        raise RuntimeError("tool sandbox exploded")
+
+
+class _DyingSessionManager:
+    """Session manager whose leased sandbox dies mid-execute."""
+
+    def get(self, session_id):
+        return self
+
+    async def execute(self, session_id, source_code, **kwargs):
+        from bee_code_interpreter_tpu.resilience import SandboxTransientError
+
+        raise SandboxTransientError("leased pod died")
+
+
+async def _http_client(app):
+    from aiohttp.test_utils import TestClient, TestServer
+
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    return client
+
+
+async def test_execute_server_fault_is_500_and_internal_on_both(
+    local_executor,
+):
+    import grpc
+    import grpc.aio
+
+    from bee_code_interpreter_tpu.api.grpc_server import (
+        GrpcServer,
+        service_stubs,
+    )
+    from bee_code_interpreter_tpu.api.http_server import create_http_server
+    from bee_code_interpreter_tpu.observability import SloEngine, parse_objectives
+    from bee_code_interpreter_tpu.observability.slo import WINDOWS
+    from bee_code_interpreter_tpu.proto import code_interpreter_pb2 as pb
+    from bee_code_interpreter_tpu.services.custom_tool_executor import (
+        CustomToolExecutor,
+    )
+
+    # HTTP: explicit JSON 500 and an SLI-bad sample
+    http_slo = SloEngine(parse_objectives(99.5, "2000:99"))
+    app = create_http_server(
+        code_executor=_BoomCodeExecutor(),
+        custom_tool_executor=CustomToolExecutor(code_executor=local_executor),
+        slo=http_slo,
+    )
+    client = await _http_client(app)
+    try:
+        resp = await client.post("/v1/execute", json={"source_code": "print(1)"})
+        assert resp.status == 500
+        assert (await resp.json())["detail"] == "Execution failed"
+    finally:
+        await client.close()
+    (availability, _) = http_slo.objectives
+    assert http_slo._window_counts(availability, WINDOWS["5m"]) == (1, 1)
+
+    # gRPC: the same failure aborts INTERNAL (it escaped as UNKNOWN
+    # before PR 15) and burns budget identically
+    grpc_slo = SloEngine(parse_objectives(99.5, "2000:99"))
+    server = GrpcServer(
+        code_executor=_BoomCodeExecutor(),
+        custom_tool_executor=CustomToolExecutor(code_executor=local_executor),
+        slo=grpc_slo,
+    )
+    port = await server.start("127.0.0.1:0")
+    try:
+        async with grpc.aio.insecure_channel(f"127.0.0.1:{port}") as channel:
+            stubs = service_stubs(channel)
+            with pytest.raises(grpc.aio.AioRpcError) as err:
+                await stubs["Execute"](pb.ExecuteRequest(source_code="print(1)"))
+            assert err.value.code() == grpc.StatusCode.INTERNAL
+            assert "execution failed" in err.value.details()
+    finally:
+        await server.stop(None)
+    (availability, _) = grpc_slo.objectives
+    assert grpc_slo._window_counts(availability, WINDOWS["5m"]) == (1, 1)
+
+
+async def test_custom_tool_server_fault_is_500_and_internal_on_both(
+    local_executor,
+):
+    import grpc
+    import grpc.aio
+
+    from bee_code_interpreter_tpu.api.grpc_server import (
+        GrpcServer,
+        service_stubs,
+    )
+    from bee_code_interpreter_tpu.api.http_server import create_http_server
+    from bee_code_interpreter_tpu.proto import code_interpreter_pb2 as pb
+
+    app = create_http_server(
+        code_executor=local_executor,
+        custom_tool_executor=_BoomToolExecutor(),
+    )
+    client = await _http_client(app)
+    try:
+        resp = await client.post(
+            "/v1/execute-custom-tool",
+            json={
+                "tool_source_code": "def t(a: int) -> int:\n  return a",
+                "tool_input_json": '{"a": 1}',
+            },
+        )
+        # before PR 15 this was aiohttp's default text/plain 500
+        assert resp.status == 500
+        assert (await resp.json())["detail"] == "Execution failed"
+    finally:
+        await client.close()
+
+    server = GrpcServer(
+        code_executor=local_executor, custom_tool_executor=_BoomToolExecutor()
+    )
+    port = await server.start("127.0.0.1:0")
+    try:
+        async with grpc.aio.insecure_channel(f"127.0.0.1:{port}") as channel:
+            stubs = service_stubs(channel)
+            with pytest.raises(grpc.aio.AioRpcError) as err:
+                await stubs["ExecuteCustomTool"](
+                    pb.ExecuteCustomToolRequest(
+                        tool_source_code="def t(a: int) -> int:\n  return a",
+                        tool_input_json='{"a": 1}',
+                    )
+                )
+            assert err.value.code() == grpc.StatusCode.INTERNAL
+    finally:
+        await server.stop(None)
+
+
+async def test_dead_leased_sandbox_is_500_and_internal_on_both(
+    local_executor,
+):
+    import grpc
+    import grpc.aio
+
+    from bee_code_interpreter_tpu.api.grpc_server import (
+        GrpcServer,
+        session_stubs,
+    )
+    from bee_code_interpreter_tpu.api.http_server import create_http_server
+    from bee_code_interpreter_tpu.services.custom_tool_executor import (
+        CustomToolExecutor,
+    )
+
+    tools = CustomToolExecutor(code_executor=local_executor)
+    app = create_http_server(
+        code_executor=local_executor,
+        custom_tool_executor=tools,
+        sessions=_DyingSessionManager(),
+    )
+    client = await _http_client(app)
+    try:
+        resp = await client.post(
+            "/v1/sessions/sess-x/execute", json={"source_code": "print(1)"}
+        )
+        assert resp.status == 500
+        assert "sandbox died" in (await resp.json())["detail"]
+    finally:
+        await client.close()
+
+    server = GrpcServer(
+        code_executor=local_executor,
+        custom_tool_executor=tools,
+        sessions=_DyingSessionManager(),
+    )
+    port = await server.start("127.0.0.1:0")
+    try:
+        async with grpc.aio.insecure_channel(f"127.0.0.1:{port}") as channel:
+            stubs = session_stubs(channel)
+            with pytest.raises(grpc.aio.AioRpcError) as err:
+                await stubs["ExecuteInSession"](
+                    json.dumps(
+                        {"session_id": "sess-x", "source_code": "print(1)"}
+                    ).encode()
+                )
+            # escaped as UNKNOWN before PR 15
+            assert err.value.code() == grpc.StatusCode.INTERNAL
+            assert "sandbox died" in err.value.details()
+    finally:
+        await server.stop(None)
+
+
+async def test_negative_limit_rejected_identically_on_both(local_executor):
+    import grpc
+    import grpc.aio
+
+    from bee_code_interpreter_tpu.api.grpc_server import (
+        GrpcServer,
+        fleet_stubs,
+        observability_stubs,
+    )
+    from bee_code_interpreter_tpu.api.http_server import create_http_server
+    from bee_code_interpreter_tpu.services.custom_tool_executor import (
+        CustomToolExecutor,
+    )
+
+    tools = CustomToolExecutor(code_executor=local_executor)
+    app = create_http_server(code_executor=local_executor, custom_tool_executor=tools)
+    client = await _http_client(app)
+    try:
+        assert (await client.get("/v1/events?limit=-1")).status == 400
+        assert (await client.get("/v1/fleet/events?limit=-1")).status == 400
+    finally:
+        await client.close()
+
+    server = GrpcServer(code_executor=local_executor, custom_tool_executor=tools)
+    port = await server.start("127.0.0.1:0")
+    try:
+        async with grpc.aio.insecure_channel(f"127.0.0.1:{port}") as channel:
+            obs = observability_stubs(channel)
+            with pytest.raises(grpc.aio.AioRpcError) as err:
+                # accepted (and mis-sliced) before PR 15
+                await obs["GetEvents"](b'{"limit": -1}')
+            assert err.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+            fleet = fleet_stubs(channel)
+            with pytest.raises(grpc.aio.AioRpcError) as err:
+                # silently clamped to 0 before PR 15
+                await fleet["GetFleetEvents"](b'{"limit": -1}')
+            assert err.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+    finally:
+        await server.stop(None)
+
+
+async def test_standalone_grpc_serves_events_and_bundle_like_http(
+    local_executor,
+):
+    """create_http_server always wired a default FlightRecorder and a
+    debug-bundle fallback; a standalone GrpcServer aborted UNIMPLEMENTED
+    for both. The twins must answer alike (PR 15)."""
+    import grpc.aio
+
+    from bee_code_interpreter_tpu.analysis.contractlint import surface_section
+    from bee_code_interpreter_tpu.api.grpc_server import (
+        GrpcServer,
+        observability_stubs,
+    )
+    from bee_code_interpreter_tpu.services.custom_tool_executor import (
+        CustomToolExecutor,
+    )
+
+    surface_section()  # fill the cache synchronously: no warming race
+    server = GrpcServer(
+        code_executor=local_executor,
+        custom_tool_executor=CustomToolExecutor(code_executor=local_executor),
+    )
+    port = await server.start("127.0.0.1:0")
+    try:
+        async with grpc.aio.insecure_channel(f"127.0.0.1:{port}") as channel:
+            obs = observability_stubs(channel)
+            events = json.loads(await obs["GetEvents"](b""))
+            assert events == {"events": []}
+            bundle = json.loads(await obs["GetDebugBundle"](b""))
+            assert "traces" in bundle and "slo" in bundle
+            assert bundle["surface"]["lint"]["clean"] is True
+    finally:
+        await server.stop(None)
