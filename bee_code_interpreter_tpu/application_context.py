@@ -34,6 +34,18 @@ from bee_code_interpreter_tpu.utils.metrics import Registry
 from bee_code_interpreter_tpu.utils.request_id import install_request_id_filter
 
 
+class _LazyAdmission:
+    """Late-binding admission handle for the quota-lease client: the real
+    AdmissionController is a cached_property that itself consumes the lease
+    cache, so the client (constructed first) must not materialize it."""
+
+    def __init__(self, ctx: "ApplicationContext") -> None:
+        self._ctx = ctx
+
+    def quota_tenants(self) -> list[str]:
+        return self._ctx.admission.quota_tenants()
+
+
 class ApplicationContext:
     def __init__(self, config: Config | None = None) -> None:
         self.config = config or Config.from_env()
@@ -56,6 +68,37 @@ class ApplicationContext:
         self.tenancy = TenantRegistry.from_config(
             self.config, metrics=self.metrics
         )
+        # Fleet-wide quota leases (docs/tenancy.md "Fleet-wide tenancy"):
+        # with APP_QUOTA_LEASE_URLS set, this replica's rate quotas become
+        # leased slices of each tenant's FLEET-wide quota, refreshed from
+        # the router tier in the background. The cache is constructed
+        # eagerly (the admission gate reads it synchronously); the client
+        # loop starts in start_observability / on demand. Unset: leasing
+        # off, local quotas enforced in full — the pre-fleet behavior.
+        self.quota_leases = None
+        self.quota_lease_client = None
+        if self.config.quota_lease_urls:
+            from bee_code_interpreter_tpu.tenancy import (
+                QuotaLeaseCache,
+                QuotaLeaseClient,
+            )
+
+            self.quota_leases = QuotaLeaseCache()
+            self.quota_lease_client = QuotaLeaseClient(
+                self.quota_leases,
+                # late-bound: self.admission is a cached_property that
+                # itself consumes self.quota_leases
+                _LazyAdmission(self),
+                replica=self.config.replica_name
+                or self.config.http_listen_addr,
+                router_urls=[
+                    u.strip()
+                    for u in self.config.quota_lease_urls.split(",")
+                    if u.strip()
+                ],
+                interval_s=self.config.quota_lease_interval_s,
+                metrics=self.metrics,
+            )
         # One tracer + retention store shared by both transports: a trace is
         # a service-level object, whichever edge rooted it.
         self.trace_store = TraceStore(
@@ -220,6 +263,8 @@ class ApplicationContext:
         self.serving.arm_loop()
         if self.config.contprof_enabled:
             self.contprof.start()
+        if self.quota_lease_client is not None:
+            self.quota_lease_client.start()
 
     def attach_serving_engine(self, engine) -> None:
         """Bind a ``models.engine.Engine`` (or bare ``ContinuousBatcher``)
@@ -290,6 +335,8 @@ class ApplicationContext:
         sweeper = getattr(self, "_storage_sweeper_task", None)
         if sweeper is not None:
             sweeper.cancel()
+        if self.quota_lease_client is not None:
+            await self.quota_lease_client.stop()
         sessions = self.__dict__.get("sessions")
         if sessions is not None:
             # Leases end BEFORE the executor closes: each teardown journals
@@ -432,6 +479,9 @@ class ApplicationContext:
             # Per-tenant WFQ + quotas (docs/tenancy.md): with no tenant
             # table declared this is one unlimited default lane.
             tenancy=self.tenancy,
+            # Fleet-wide quota leases: rate refills consult the leased
+            # slice (or its fail-safe 1/N fallback) when leasing is on.
+            quota_leases=self.quota_leases,
         )
 
     def _build_local_executor(self):

@@ -325,6 +325,28 @@ class Config(BaseModel):
     router_dead_after_s: float = Field(default=10.0, gt=0)
     # Routing/migration wide events retained in the router's ring.
     router_events_max: int = Field(default=1024, ge=1)
+    # --- fleet-wide tenancy (new; see docs/fleet.md "Fleet-wide tenancy") ---
+    # Peer router edges for HA, comma-separated base URLs (optionally
+    # named, same spelling as APP_ROUTER_REPLICAS). Peers gossip session
+    # pins and the quota-lease ledger every refresh tick, so killing one
+    # edge loses no pins and double-issues no quota beyond one lease TTL.
+    router_peers: str | None = None
+    # Lifetime of a quota lease the router grants a replica. Shorter =
+    # faster fleet-wide convergence after membership churn (the declared
+    # double-issue bound is one TTL); longer = more partition tolerance
+    # before replicas fall back to their local 1/N split.
+    router_quota_ttl_s: float = Field(default=3.0, gt=0)
+    # Replica side of the lease protocol: comma-separated router base URLs
+    # this replica leases quota slices from (usually the same list every
+    # client uses). Unset disables leasing — each replica enforces its
+    # full local quota, the pre-fleet behavior.
+    quota_lease_urls: str | None = None
+    # Lease refresh cadence; keep comfortably under APP_ROUTER_QUOTA_TTL_S
+    # so a healthy replica never expires into the 1/N fallback.
+    quota_lease_interval_s: float = Field(default=1.0, gt=0)
+    # This replica's name in lease requests and the router ledger. Unset
+    # derives "host:port" from the listen address.
+    replica_name: str | None = None
 
     # --- edge static analysis (new; see docs/analysis.md) ---
     # Master switch for the pre-flight code gate at both API edges: one AST

@@ -8,18 +8,30 @@ from bee_code_interpreter_tpu.fleet.ring import HashRing, affinity_key
 from bee_code_interpreter_tpu.fleet.router import (
     FleetRouter,
     NoReplicasAvailable,
+    PeerRouter,
     Replica,
     RouterSession,
     UnknownRouterSession,
+)
+from bee_code_interpreter_tpu.fleet.tenancy_plane import (
+    QuotaLedger,
+    RetryBudget,
+    rendezvous_rank,
+    subset_size,
 )
 
 __all__ = [
     "FleetRouter",
     "HashRing",
     "NoReplicasAvailable",
+    "PeerRouter",
+    "QuotaLedger",
     "Replica",
+    "RetryBudget",
     "RouterSession",
     "UnknownRouterSession",
     "affinity_key",
     "create_router_app",
+    "rendezvous_rank",
+    "subset_size",
 ]

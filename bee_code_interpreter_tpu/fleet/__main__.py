@@ -3,11 +3,14 @@
 Reads the same ``APP_*`` env surface as the service (``APP_ROUTER_LISTEN_ADDR``,
 ``APP_ROUTER_REPLICAS``, and the rest of the ``APP_ROUTER_*`` family —
 docs/fleet.md). SIGTERM stops the refresh loop and the listener; the router
-holds no durable state beyond session pins, so a restart re-learns the fleet
-from the first refresh (pinned sessions on a restarted router are gone —
-front the router with more than one instance only if you externalize pins).
+holds no durable state beyond session pins and the quota-lease ledger, and
+with ``APP_ROUTER_PEERS`` set (docs/fleet.md "Fleet-wide tenancy") N router
+edges gossip both every refresh tick — a killed or restarted edge re-learns
+the fleet from its first refresh and its pins from the surviving peers, so
+HA is a config line, not an external store.
 
     APP_ROUTER_REPLICAS="r0=http://replica-0:50081,r1=http://replica-1:50081" \\
+    APP_ROUTER_PEERS="http://router-b:50080" \\
         python -m bee_code_interpreter_tpu.fleet
 """
 

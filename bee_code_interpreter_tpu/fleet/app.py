@@ -13,13 +13,22 @@ tests (``create_router_app(FleetRouter([...]))``). Proxied surface:
   retried cross-replica: the lease is one sandbox on one replica).
 - ``GET /v1/fleet/replicas`` — the router's decision/health view;
   ``POST /v1/fleet/replicas/{name}/drain`` evacuates a replica's leases.
+- ``POST /v1/fleet/quota/lease`` — the fleet-wide tenancy plane's lease
+  grant: a replica asks for its slice of each tenant's fleet-wide rate
+  quota (docs/fleet.md "Fleet-wide tenancy").
+- ``GET /v1/fleet/peer`` — the router-HA gossip exchange: session pins +
+  the quota-lease ledger, pulled by peer router edges (APP_ROUTER_PEERS).
 - ``GET /v1/events`` — the router's own wide events (``kind="routing"`` /
   ``"lease_migrate"``); ``GET /healthz``; ``GET /metrics``.
 
 Status contract at this edge: 503 + Retry-After when no replica is
 eligible, 502 when every attempt died in transport, 404 for session ids the
 router has no pin for; everything else is the chosen replica's own answer,
-proxied verbatim.
+proxied verbatim. Tenant-scoped 429s (``reason="tenant_quota"`` /
+``"heavy_lane"``) are returned verbatim WITHOUT cross-replica retry —
+retrying a quota shed into a fresh replica's bucket would silently multiply
+the tenant's effective quota — and every cross-replica retry first debits
+the requesting tenant's router-edge retry budget.
 """
 
 from __future__ import annotations
@@ -32,6 +41,7 @@ import time
 
 from aiohttp import web
 
+from bee_code_interpreter_tpu.analysis import classify_cost, inspect_source
 from bee_code_interpreter_tpu.fleet.ring import affinity_key
 from bee_code_interpreter_tpu.fleet.router import (
     FleetRouter,
@@ -60,6 +70,35 @@ def _key_from_body(raw: bytes) -> str | None:
         return None
     files = body.get("files")
     return affinity_key(files if isinstance(files, dict) else None)
+
+
+#: Source larger than this is never classified at the router edge — the
+#: replica's own analysis gate (APP_ANALYSIS_MAX_SOURCE_BYTES) owns the
+#: real verdict; here classification is only a placement hint and must
+#: stay sub-ms on the router's event loop.
+_CLASSIFY_MAX_SOURCE_BYTES = 262_144
+
+
+def _cost_class_from_body(raw: bytes) -> str | None:
+    """The submission's cost class ("accelerator"/"io"/"cpu") as a
+    placement steering hint, or None when the body can't be cheaply
+    classified. Best-effort by design: a None here just means least-loaded
+    placement, the replica still runs its own full gate."""
+    if len(raw) > _CLASSIFY_MAX_SOURCE_BYTES:
+        return None
+    try:
+        body = json.loads(raw)
+    except (ValueError, UnicodeDecodeError):
+        return None
+    if not isinstance(body, dict):
+        return None
+    source = body.get("source_code")
+    if not isinstance(source, str) or not source:
+        return None
+    try:
+        return classify_cost(inspect_source(source))
+    except Exception:
+        return None
 
 
 def _truthy(request: web.Request, name: str) -> bool:
@@ -91,10 +130,17 @@ def create_router_app(router: FleetRouter) -> web.Application:
     # ------------------------------------------------------ routed proxying
 
     async def _proxy_routed(
-        request: web.Request, route: str, path: str, keyed: bool, retry_5xx: bool
+        request: web.Request,
+        route: str,
+        path: str,
+        keyed: bool,
+        retry_5xx: bool,
+        classify: bool = False,
     ) -> web.Response:
         raw = await request.read()
         key = _key_from_body(raw) if keyed else None
+        tenant = router.resolve_tenant(request.headers)
+        cost_class = _cost_class_from_body(raw) if classify else None
         headers = router.forward_headers(request.headers)
         params = dict(request.query)
         start = clock()
@@ -108,6 +154,8 @@ def create_router_app(router: FleetRouter) -> web.Application:
                 headers=headers,
                 params=params,
                 retry_5xx=retry_5xx,
+                tenant=tenant,
+                cost_class=cost_class,
             )
         except NoReplicasAvailable as e:
             router.record_route(
@@ -138,7 +186,7 @@ def create_router_app(router: FleetRouter) -> web.Application:
             replica=replica,
             key=key,
             affinity=(
-                router.affinity_result(key, replica)
+                router.affinity_result(key, replica, tenant=tenant)
                 if replica is not None
                 else None
             ),
@@ -147,8 +195,10 @@ def create_router_app(router: FleetRouter) -> web.Application:
         )
         return _upstream_response(response)
 
-    async def _routed(request, route, path, keyed, retry_5xx=True):
-        return await _proxy_routed(request, route, path, keyed, retry_5xx)
+    async def _routed(request, route, path, keyed, retry_5xx=True, classify=False):
+        return await _proxy_routed(
+            request, route, path, keyed, retry_5xx, classify
+        )
 
     async def _pump_sse(
         request: web.Request,
@@ -213,7 +263,11 @@ def create_router_app(router: FleetRouter) -> web.Application:
         """SSE passthrough with retry-before-first-byte: sheds and
         unavailability walk the ring like the buffered path, but once the
         upstream answered 200 the stream is committed to that replica
-        (``_pump_sse``) — delivered chunks cannot be un-delivered."""
+        (``_pump_sse``) — delivered chunks cannot be un-delivered.
+        Tenant-scoped sheds are terminal here too, and every retry debits
+        the tenant's router-edge retry budget."""
+        tenant = router.resolve_tenant(request.headers)
+        cost_class = _cost_class_from_body(raw)
         headers = router.forward_headers(request.headers)
         params = dict(request.query)
         start = clock()
@@ -222,7 +276,9 @@ def create_router_app(router: FleetRouter) -> web.Application:
         last_verdict: tuple[int, dict, bytes] | None = None
         for _ in range(router.retry_attempts):
             try:
-                replica = router.place(key, exclude=exclude)[0]
+                replica = router.place(
+                    key, exclude=exclude, tenant=tenant, cost_class=cost_class
+                )[0]
             except NoReplicasAvailable as e:
                 if last_verdict is not None:
                     break
@@ -246,6 +302,16 @@ def create_router_app(router: FleetRouter) -> web.Application:
                             upstream.passthrough_headers(),
                             await upstream.aread(),
                         )
+                        # Tenant-scoped sheds (tenant_quota / heavy_lane)
+                        # are terminal: retrying them into another
+                        # replica's bucket multiplies the tenant's
+                        # effective quota. Denied retry budget ends the
+                        # walk the same way — the verdict stands.
+                        if (
+                            reason == "shed"
+                            and router.sticky_shed(last_verdict[2])
+                        ) or not router.spend_retry_budget(tenant):
+                            break
                         router.record_retry(reason)
                         retries += 1
                         exclude.add(replica.name)
@@ -271,7 +337,9 @@ def create_router_app(router: FleetRouter) -> web.Application:
                         upstream,
                         replica=replica.name,
                         key=key,
-                        affinity=router.affinity_result(key, replica.name),
+                        affinity=router.affinity_result(
+                            key, replica.name, tenant=tenant
+                        ),
                         retries=retries,
                         start=start,
                     )
@@ -287,6 +355,8 @@ def create_router_app(router: FleetRouter) -> web.Application:
                     replica.name,
                     e,
                 )
+                if not router.spend_retry_budget(tenant):
+                    break
                 router.record_retry("unreachable")
                 retries += 1
                 exclude.add(replica.name)
@@ -323,7 +393,9 @@ def create_router_app(router: FleetRouter) -> web.Application:
             return await _stream_routed(
                 request, "/v1/execute", "/v1/execute", _key_from_body(raw), raw
             )
-        return await _routed(request, "/v1/execute", "/v1/execute", keyed=True)
+        return await _routed(
+            request, "/v1/execute", "/v1/execute", keyed=True, classify=True
+        )
 
     async def parse_custom_tool(request: web.Request) -> web.Response:
         return await _routed(
@@ -346,6 +418,7 @@ def create_router_app(router: FleetRouter) -> web.Application:
     async def session_create(request: web.Request) -> web.Response:
         raw = await request.read()
         key = _key_from_body(raw)
+        tenant = router.resolve_tenant(request.headers)
         headers = router.forward_headers(request.headers)
         start = clock()
         try:
@@ -362,6 +435,7 @@ def create_router_app(router: FleetRouter) -> web.Application:
                 headers=headers,
                 params=dict(request.query),
                 retry_5xx=False,
+                tenant=tenant,
             )
         except NoReplicasAvailable as e:
             router.record_route(
@@ -396,7 +470,7 @@ def create_router_app(router: FleetRouter) -> web.Application:
             replica=replica,
             key=key,
             affinity=(
-                router.affinity_result(key, replica)
+                router.affinity_result(key, replica, tenant=tenant)
                 if replica is not None
                 else None
             ),
@@ -598,6 +672,41 @@ def create_router_app(router: FleetRouter) -> web.Application:
     async def fleet_replicas(_request: web.Request) -> web.Response:
         return web.json_response(router.snapshot())
 
+    async def quota_lease(request: web.Request) -> web.Response:
+        """One lease grant in the fleet-wide quota plane: a replica posts
+        ``{"replica": name, "tenants": [ids...]}`` and gets back its slice
+        of each tenant's fleet-wide rate quota (docs/fleet.md)."""
+        try:
+            body = await request.json()
+        except (ValueError, UnicodeDecodeError):
+            return web.json_response(
+                {"detail": "body must be a JSON object"}, status=400
+            )
+        if not isinstance(body, dict):
+            return web.json_response(
+                {"detail": "body must be a JSON object"}, status=400
+            )
+        replica = body.get("replica")
+        tenants = body.get("tenants")
+        if not isinstance(replica, str) or not replica:
+            return web.json_response(
+                {"detail": "replica (non-empty string) is required"},
+                status=400,
+            )
+        if not isinstance(tenants, list) or not all(
+            isinstance(t, str) for t in tenants
+        ):
+            return web.json_response(
+                {"detail": "tenants must be a list of tenant ids"},
+                status=400,
+            )
+        return web.json_response(router.grant_quota_leases(replica, tenants))
+
+    async def fleet_peer(_request: web.Request) -> web.Response:
+        """The router-HA gossip exchange: this edge's session pins and
+        quota-lease ledger, pulled by peers every refresh tick."""
+        return web.json_response(router.peer_export())
+
     async def drain_replica(request: web.Request) -> web.Response:
         name = request.match_info["name"]
         try:
@@ -681,6 +790,8 @@ def create_router_app(router: FleetRouter) -> web.Application:
     app.router.add_delete("/v1/sessions/{session_id}", session_delete)
     app.router.add_get("/v1/fleet/replicas", fleet_replicas)
     app.router.add_post("/v1/fleet/replicas/{name}/drain", drain_replica)
+    app.router.add_post("/v1/fleet/quota/lease", quota_lease)
+    app.router.add_get("/v1/fleet/peer", fleet_peer)
     app.router.add_get("/v1/events", events)
     app.router.add_get("/healthz", healthz)
     app.router.add_get("/metrics", metrics_endpoint)

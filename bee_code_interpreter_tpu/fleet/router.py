@@ -27,6 +27,20 @@ alerts) — so the router is a thin, *stateless-except-for-pins* tier:
   backend lease. The refresh loop auto-evacuates replicas it sees enter
   drain (give them ``APP_SESSION_DRAIN_GRACE_S`` so their own sweep doesn't
   win the race).
+- **Fleet-wide tenancy** (docs/fleet.md "Fleet-wide tenancy"): with a
+  tenant table wired in, each declared tenant is rendezvous-hashed onto a
+  bounded replica subset (k ∝ weight) so per-replica quotas compose into a
+  fleet-wide bound; ``cost_class="accelerator"`` submissions steer toward
+  replicas whose cost-class mix shows accelerator capability; the router
+  holds the quota-lease ledger (``POST /v1/fleet/quota/lease``); and
+  per-tenant ``tenant_quota``/``heavy_lane`` sheds are returned VERBATIM —
+  never retried into a fresh replica's bucket — with cross-replica retries
+  debiting the tenant's router-side retry budget.
+- **Router HA** (``APP_ROUTER_PEERS``): N router edges gossip session pins
+  and the quota-lease ledger over ``GET /v1/fleet/peer`` each refresh tick,
+  with consecutive-failure peer detection — killing one edge mid-flood
+  loses zero pins, and lease reconciliation bounds quota double-issue to
+  one lease TTL of membership skew.
 
 Accounting is exactly-once by construction: every routed request lands in
 the decision totals (``GET /v1/fleet/replicas``), ONE ``kind="routing"``
@@ -46,18 +60,46 @@ from dataclasses import dataclass, field
 from typing import Callable
 
 from bee_code_interpreter_tpu.fleet.ring import HashRing, affinity_key
+from bee_code_interpreter_tpu.fleet.tenancy_plane import (
+    QuotaLedger,
+    RetryBudget,
+    rendezvous_rank,
+    subset_size,
+)
 from bee_code_interpreter_tpu.observability import FlightRecorder
 from bee_code_interpreter_tpu.resilience import (
     BreakerOpenError,
     BreakerState,
     CircuitBreaker,
 )
+from bee_code_interpreter_tpu.tenancy import (
+    DEFAULT_TENANT_ID,
+    TENANT_HEADER,
+    bearer_token,
+)
 
 logger = logging.getLogger(__name__)
 
-# Headers worth forwarding to a replica: content negotiation + the trace
-# context, so a replica's trace continues the router-side caller's.
-_FORWARD_HEADERS = ("content-type", "traceparent", "x-request-id", "accept")
+# Headers worth forwarding to a replica: content negotiation, the trace
+# context (a replica's trace continues the router-side caller's), and the
+# tenant identity (header or API key) — the replica-side admission gate
+# must see WHO is asking through the proxy hop.
+_FORWARD_HEADERS = (
+    "content-type",
+    "traceparent",
+    "x-request-id",
+    "accept",
+    "x-tenant-id",
+    "authorization",
+)
+
+# Shed reasons that are per-tenant verdicts (docs/tenancy.md): retrying
+# them on another replica would charge a FRESH token bucket there,
+# silently multiplying the tenant's effective quota. Returned verbatim.
+_TENANT_SCOPED_SHEDS = frozenset({"tenant_quota", "heavy_lane"})
+
+# A peer router is DOWN after this many consecutive failed gossip syncs.
+_PEER_DOWN_AFTER = 2
 
 
 class NoReplicasAvailable(Exception):
@@ -86,6 +128,10 @@ class Replica:
     # Tenant mix off /v1/fleet (docs/tenancy.md): per-tenant request totals
     # this replica has absorbed — the signal tenant-aware placement reads.
     tenants: dict = field(default_factory=dict)
+    # Cost-class mix off /v1/fleet (docs/analysis.md "Cost classes"): a
+    # replica whose mix shows absorbed `accelerator` work is known
+    # TPU-capable, and accelerator submissions steer toward it.
+    cost_classes: dict = field(default_factory=dict)
     draining: bool = False  # the replica says so (/v1/fleet "draining")
     cordoned: bool = False  # the ROUTER says so (drain_replica)
     slo_fast_burn: bool = False
@@ -120,6 +166,7 @@ class Replica:
             "ready_pods": self.ready_pods,
             "leases": self.leases,
             "tenants": dict(self.tenants),
+            "cost_classes": dict(self.cost_classes),
             "slo_fast_burn": self.slo_fast_burn,
             "breaker": self.breaker.state.name.lower(),
             "ring_share": ring_share,
@@ -130,6 +177,35 @@ class Replica:
                 else None
             ),
             "refresh_error": self.refresh_error,
+        }
+
+
+@dataclass
+class PeerRouter:
+    """One fellow router edge (``APP_ROUTER_PEERS``) and this edge's view
+    of it: gossip reachability plus what the last syncs adopted."""
+
+    name: str
+    base_url: str
+    failures: int = 0
+    last_sync_mono: float | None = None
+    last_error: str | None = None
+    pins_adopted: int = 0
+    leases_merged: int = 0
+
+    @property
+    def up(self) -> bool:
+        return self.failures < _PEER_DOWN_AFTER
+
+    def to_dict(self) -> dict:
+        return {
+            "name": self.name,
+            "base_url": self.base_url,
+            "up": self.up,
+            "failures": self.failures,
+            "pins_adopted": self.pins_adopted,
+            "leases_merged": self.leases_merged,
+            "last_error": self.last_error,
         }
 
 
@@ -246,11 +322,24 @@ class FleetRouter:
         events_max: int = 1024,
         http_client=None,
         clock: Callable[[], float] = time.monotonic,
+        tenancy=None,  # tenancy.TenantRegistry (fleet-wide tenancy plane)
+        peers: list[tuple[str, str]] | None = None,
+        quota_ttl_s: float = 3.0,
+        router_id: str = "router",
     ) -> None:
         from bee_code_interpreter_tpu.utils.metrics import Registry
 
         self.metrics = metrics or Registry()
         self._clock = clock
+        self.router_id = router_id
+        self._tenancy = tenancy
+        # The router's half of the quota-lease protocol (docs/fleet.md
+        # "Fleet-wide tenancy"). Constructed unconditionally: without a
+        # tenant table every grant answers empty and replicas stay on
+        # their local fallback split.
+        self.ledger = QuotaLedger(tenancy, ttl_s=quota_ttl_s, clock=clock)
+        # Router-edge retry budgets, one bucket per rate-quota'd tenant.
+        self._retry_budgets: dict[str, RetryBudget] = {}
         self._refresh_interval_s = refresh_interval_s
         self._utilization_spill = utilization_spill
         self.retry_attempts = max(1, retry_attempts)
@@ -265,6 +354,9 @@ class FleetRouter:
         self.replicas: dict[str, Replica] = {}
         for name, base_url in replicas:
             self.add_replica(name, base_url)
+        self.peers: dict[str, PeerRouter] = {}
+        for name, base_url in peers or []:
+            self.add_peer(name, base_url)
         self.sessions: dict[str, RouterSession] = {}
         self._rr = 0  # keyless-placement tie-break rotation
         self._task: asyncio.Task | None = None
@@ -285,6 +377,9 @@ class FleetRouter:
             "warm": 0,
             "spill": 0,
             "keyless": 0,
+            # Tenant-aware placements (no affinity key, declared tenant):
+            # the request landed inside its rendezvous subset.
+            "tenant": 0,
         }
         self._requests_total = self.metrics.counter(
             "bci_router_requests_total",
@@ -320,20 +415,59 @@ class FleetRouter:
             "Sessions the router currently pins to a replica",
             lambda: len(self.sessions),
         )
+        # Fleet-wide tenancy surface (docs/observability.md): the lease
+        # ledger and the peer-gossip health, registered unconditionally so
+        # the families exist from first scrape.
+        self._quota_leases_total = self.metrics.counter(
+            "bci_router_quota_leases_total",
+            "Quota lease grants served by this router edge, by outcome "
+            "(granted/empty)",
+        )
+        self.metrics.gauge(
+            "bci_router_quota_active_leases",
+            "Non-expired (tenant, replica) quota leases in this router's "
+            "ledger",
+            lambda: self.ledger.active_count(),
+        )
+        self._peer_sync_total = self.metrics.counter(
+            "bci_router_peer_sync_total",
+            "Peer-router gossip syncs, by peer and outcome (ok/error)",
+        )
+        self._retry_budget_denied_total = self.metrics.counter(
+            "bci_router_retry_budget_denied_total",
+            "Cross-replica retries suppressed by a tenant's exhausted "
+            "router-side retry budget",
+        )
+
+    @staticmethod
+    def _parse_endpoints(spec: str | None, prefix: str) -> list[tuple[str, str]]:
+        """Comma-separated ``name=url`` (bare URLs auto-named
+        ``{prefix}0..N``) — the shared APP_ROUTER_REPLICAS /
+        APP_ROUTER_PEERS spelling."""
+        out: list[tuple[str, str]] = []
+        entries = filter(None, (s.strip() for s in (spec or "").split(",")))
+        for i, entry in enumerate(entries):
+            if "=" in entry.split("://", 1)[0]:
+                name, _, url = entry.partition("=")
+                out.append((name.strip(), url.strip().rstrip("/")))
+            else:
+                out.append((f"{prefix}{i}", entry.rstrip("/")))
+        return out
 
     @classmethod
     def from_config(cls, config, **overrides) -> "FleetRouter":
         """Build from ``APP_ROUTER_*`` (docs/fleet.md): replicas come from
         the comma-separated ``APP_ROUTER_REPLICAS`` list of base URLs,
-        optionally ``name=url`` named (bare URLs are auto-named r0..rN)."""
-        spec = (config.router_replicas or "").strip()
-        replicas: list[tuple[str, str]] = []
-        for i, entry in enumerate(filter(None, (s.strip() for s in spec.split(",")))):
-            if "=" in entry.split("://", 1)[0]:
-                name, _, url = entry.partition("=")
-                replicas.append((name.strip(), url.strip().rstrip("/")))
-            else:
-                replicas.append((f"r{i}", entry.rstrip("/")))
+        optionally ``name=url`` named (bare URLs are auto-named r0..rN);
+        fellow router edges from ``APP_ROUTER_PEERS`` (auto-named
+        p0..pN); the tenant table from ``APP_TENANTS`` — declared tenants
+        get rendezvous placement, quota leases, and router-side retry
+        budgets."""
+        from bee_code_interpreter_tpu.tenancy import (
+            TenantRegistry,
+            parse_tenants,
+        )
+
         kwargs = dict(
             vnodes=config.router_vnodes,
             refresh_interval_s=config.router_refresh_interval_s,
@@ -342,9 +476,13 @@ class FleetRouter:
             http_timeout_s=config.router_http_timeout_s,
             dead_after_s=config.router_dead_after_s,
             events_max=config.router_events_max,
+            peers=cls._parse_endpoints(config.router_peers, "p"),
+            tenancy=TenantRegistry(parse_tenants(config.tenants)),
+            quota_ttl_s=config.router_quota_ttl_s,
+            router_id=config.router_listen_addr,
         )
         kwargs.update(overrides)
-        return cls(replicas, **kwargs)
+        return cls(cls._parse_endpoints(config.router_replicas, "r"), **kwargs)
 
     # ---------------------------------------------------------------- fleet
 
@@ -380,6 +518,20 @@ class FleetRouter:
         self.replicas[name] = replica
         self.ring.add(name)
         return replica
+
+    def add_peer(self, name: str, base_url: str) -> PeerRouter:
+        if name in self.peers:
+            raise ValueError(f"peer router {name!r} already registered")
+        peer = PeerRouter(name=name, base_url=base_url.rstrip("/"))
+        self.peers[name] = peer
+        self.metrics.gauge(
+            "bci_router_peer_up",
+            "Peer router edges answering gossip (1) vs failing "
+            "consecutive syncs (0)",
+            (lambda p: lambda: 1 if p.up else 0)(peer),
+            peer=name,
+        )
+        return peer
 
     # ------------------------------------------------------------ refreshing
 
@@ -428,6 +580,7 @@ class FleetRouter:
             try:
                 await self.refresh_once()
                 await self.evacuate_draining()
+                await self.sync_peers()
             except asyncio.CancelledError:
                 raise
             except Exception:
@@ -477,18 +630,150 @@ class FleetRouter:
         sessions = fleet.get("sessions") or {}
         replica.leases = int(sessions.get("active") or 0)
         replica.tenants = dict(fleet.get("tenants") or {})
+        replica.cost_classes = dict(fleet.get("cost_classes") or {})
         replica.slo_fast_burn = bool(slo.get("fast_burn_alerting"))
         replica.last_refresh_mono = self._clock()
         replica.refresh_error = None
 
+    # ------------------------------------------------------------- HA gossip
+
+    def peer_export(self) -> dict:
+        """The ``GET /v1/fleet/peer`` document this edge serves: its pins
+        and its lease ledger, in peer-portable (clock-free) form."""
+        return {
+            "router": self.router_id,
+            "pins": [s.to_dict() for s in self.sessions.values()],
+            "ledger": self.ledger.export(),
+        }
+
+    def adopt_pins(self, pins) -> int:
+        """Merge a peer's session pins: unknown ids are adopted as-is; for
+        ids both edges know, the entry with more migrations wins (each
+        handoff bumps the count, so it is a monotonic version). Adopted
+        pins are what make a router kill lose zero sessions — the
+        surviving edge already holds every pin the dead one created."""
+        adopted = 0
+        if not isinstance(pins, list):
+            return 0
+        for doc in pins:
+            if not isinstance(doc, dict):
+                continue
+            sid = doc.get("session_id")
+            replica = doc.get("replica")
+            backend_id = doc.get("backend_id")
+            if not sid or replica not in self.replicas or not backend_id:
+                continue
+            migrations = int(doc.get("migrations") or 0)
+            mine = self.sessions.get(sid)
+            if mine is None:
+                session = RouterSession(
+                    public_id=sid,
+                    replica=replica,
+                    backend_id=backend_id,
+                    created_unix=float(
+                        doc.get("created_unix") or time.time()
+                    ),
+                )
+                session.migrations = migrations
+                self.sessions[sid] = session
+                adopted += 1
+            elif migrations > mine.migrations:
+                mine.replica = replica
+                mine.backend_id = backend_id
+                mine.migrations = migrations
+                adopted += 1
+        return adopted
+
+    async def sync_peers(self) -> None:
+        """One gossip round: pull every peer's pins + ledger concurrently.
+        A peer failing ``_PEER_DOWN_AFTER`` consecutive syncs is DOWN
+        (``bci_router_peer_up`` 0) until it answers again; its state last
+        adopted here keeps serving — failure detection informs operators,
+        it never discards pins."""
+        if self.peers:
+            await asyncio.gather(
+                *(self._sync_peer(p) for p in self.peers.values())
+            )
+
+    async def _sync_peer(self, peer: PeerRouter) -> None:
+        timeout = min(5.0, self._refresh_interval_s * 2)
+        try:
+            response = await self._request(
+                "GET", f"{peer.base_url}/v1/fleet/peer", timeout=timeout
+            )
+            if response.status_code >= 400:
+                raise OSError(f"peer sync HTTP {response.status_code}")
+            doc = response.json()
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:
+            peer.failures += 1
+            peer.last_error = str(e) or type(e).__name__
+            self._peer_sync_total.inc(peer=peer.name, outcome="error")
+            return
+        peer.failures = 0
+        peer.last_error = None
+        peer.last_sync_mono = self._clock()
+        peer.pins_adopted += self.adopt_pins(doc.get("pins"))
+        peer.leases_merged += self.ledger.merge(doc.get("ledger") or {})
+        self._peer_sync_total.inc(peer=peer.name, outcome="ok")
+
+    # ---------------------------------------------------------- quota leases
+
+    def grant_quota_leases(self, replica: str, tenant_ids) -> dict:
+        """The ``POST /v1/fleet/quota/lease`` body: per-tenant slices from
+        the ledger plus the registered fleet size (the replica's fail-safe
+        1/N divisor while partitioned from every router)."""
+        leases = self.ledger.grant(replica, tenant_ids)
+        self._quota_leases_total.inc(
+            outcome="granted" if leases else "empty"
+        )
+        return {
+            "router": self.router_id,
+            "fleet_size": len(self.replicas),
+            "leases": leases,
+        }
+
     # ------------------------------------------------------------- placement
 
+    def tenant_subset(self, tenant) -> list[str]:
+        """The bounded replica subset a declared tenant's keyless traffic
+        lands on: the top-k of the rendezvous ranking over ALL registered
+        replica names (health-independent, so every router edge and every
+        moment agree), k proportional to the tenant's WFQ weight."""
+        ranked = rendezvous_rank(tenant.id, sorted(self.replicas))
+        return ranked[: subset_size(tenant.weight, len(ranked))]
+
+    def _steer_accelerator(self, ordered: list[Replica]) -> list[Replica]:
+        """Stable-partition known TPU-capable replicas first. Capability
+        is LEARNED from each replica's ``/v1/fleet`` cost-class mix (it
+        has absorbed ``accelerator`` work before); while no replica has,
+        there is no signal and the order stands."""
+        capable = [
+            r for r in ordered if (r.cost_classes.get("accelerator") or 0) > 0
+        ]
+        if not capable or len(capable) == len(ordered):
+            return ordered
+        return capable + [r for r in ordered if r not in capable]
+
     def place(
-        self, key: str | None, exclude: frozenset[str] | set[str] = frozenset()
+        self,
+        key: str | None,
+        exclude: frozenset[str] | set[str] = frozenset(),
+        *,
+        tenant=None,
+        cost_class: str | None = None,
     ) -> list[Replica]:
         """Preference-ordered eligible replicas for one request. Keyed:
-        ring order with the overloaded/burning owner demoted (spill).
-        Keyless: least-utilized first, round-robin tie-break."""
+        ring order with the overloaded/burning owner demoted (spill) —
+        snapshot locality beats every other signal. Unkeyed with a
+        declared tenant: its rendezvous subset first (least-utilized
+        within it), the remaining eligible replicas only as a last-resort
+        tail — per-replica quota enforcement composes into a fleet-wide
+        bound because the subset is where the traffic lands. Keyless/
+        default-tenant: least-utilized first, round-robin tie-break.
+        ``cost_class="accelerator"`` steers unkeyed placements toward
+        known TPU-capable replicas."""
         now = self._clock()
         eligible = {
             r.name: r
@@ -507,6 +792,30 @@ class FleetRouter:
             if len(head) > 1:
                 rotated = head[pivot % len(head) :] + head[: pivot % len(head)]
                 ordered = rotated + ordered[len(head) :]
+            if (
+                tenant is not None
+                and getattr(tenant, "id", None) not in (None, DEFAULT_TENANT_ID)
+            ):
+                # The subset is the top-k of the rendezvous ranking over
+                # the ELIGIBLE replicas: a dead member's slot is taken by
+                # the next-ranked name (minimal re-form), every other
+                # tenant's subset is untouched.
+                ranked = [
+                    name
+                    for name in rendezvous_rank(
+                        tenant.id, sorted(self.replicas)
+                    )
+                    if name in eligible
+                ]
+                members = set(
+                    ranked[: subset_size(tenant.weight, len(self.replicas))]
+                )
+                if members:
+                    ordered = [r for r in ordered if r.name in members] + [
+                        r for r in ordered if r.name not in members
+                    ]
+            if cost_class == "accelerator":
+                ordered = self._steer_accelerator(ordered)
             return ordered
         ordered = [
             eligible[name]
@@ -542,13 +851,69 @@ class FleetRouter:
                 ordered.insert(0, better)
         return ordered
 
-    def affinity_result(self, key: str | None, chosen: str) -> str:
+    def affinity_result(
+        self, key: str | None, chosen: str, tenant=None
+    ) -> str:
         """warm = the request landed on its ring owner (its snapshot chain
         is warm there); spill = re-homed (owner dead/overloaded/retried
-        past); keyless = no files, placed by load."""
+        past); tenant = unkeyed but placed inside a declared tenant's
+        rendezvous subset; keyless = no files, placed by load."""
         if key is None:
+            if (
+                tenant is not None
+                and getattr(tenant, "id", None)
+                not in (None, DEFAULT_TENANT_ID)
+                and chosen in self.tenant_subset(tenant)
+            ):
+                return "tenant"
             return "keyless"
         return "warm" if self.ring.owner(key) == chosen else "spill"
+
+    # ----------------------------------------------------- tenant resolution
+
+    def resolve_tenant(self, headers):
+        """The request's tenant at the ROUTER edge (same resolution rule
+        as the replica edges: API key beats the X-Tenant-Id header), for
+        placement and the router-side retry budget. None without a tenant
+        table — every placement is then load-based, as before tenancy."""
+        if self._tenancy is None:
+            return None
+        return self._tenancy.resolve(
+            headers.get(TENANT_HEADER),
+            bearer_token(headers.get("Authorization")),
+        ).tenant
+
+    def spend_retry_budget(self, tenant) -> bool:
+        """Debit one cross-replica retry from the tenant's router-side
+        budget. Tenants without a rate quota (and anonymous traffic) have
+        no budget — unlimited, preserving pre-tenancy retry behavior."""
+        rps = getattr(tenant, "rps", None)
+        if tenant is None or rps is None:
+            return True
+        budget = self._retry_budgets.get(tenant.id)
+        if budget is None:
+            budget = self._retry_budgets[tenant.id] = RetryBudget(
+                rps, clock=self._clock
+            )
+        if budget.spend():
+            return True
+        self._retry_budget_denied_total.inc(tenant=tenant.id)
+        return False
+
+    @staticmethod
+    def sticky_shed(content: bytes) -> bool:
+        """True when a 429 body carries a per-tenant shed reason
+        (``tenant_quota``/``heavy_lane``): the verdict applies to the
+        TENANT, not the replica — retrying it elsewhere would charge a
+        fresh bucket and silently multiply the tenant's quota."""
+        try:
+            doc = json.loads(content)
+        except (ValueError, UnicodeDecodeError):
+            return False
+        return (
+            isinstance(doc, dict)
+            and doc.get("reason") in _TENANT_SCOPED_SHEDS
+        )
 
     # ------------------------------------------------------------ accounting
 
@@ -747,12 +1112,17 @@ class FleetRouter:
         params=None,
         retry: bool = True,
         retry_5xx: bool = True,
+        tenant=None,
+        cost_class: str | None = None,
     ):
         """Place + proxy one buffered request with cross-replica retry;
         returns ``(response, replica_name, retries)`` and leaves the
         accounting to the caller's single ``record_route``. ``retry_5xx``
         is off for calls whose replica-side effect may have happened
-        despite the 5xx (session create: a leaked lease)."""
+        despite the 5xx (session create: a leaked lease). Per-tenant
+        sheds (``tenant_quota``/``heavy_lane``) are returned verbatim —
+        never walked to another replica's bucket — and every cross-replica
+        retry first debits the tenant's router-side retry budget."""
         attempts = self.retry_attempts if retry else 1
         exclude: set[str] = set()
         retries = 0
@@ -760,7 +1130,9 @@ class FleetRouter:
         last_error: Exception | None = None
         for _ in range(attempts):
             try:
-                candidates = self.place(key, exclude=exclude)
+                candidates = self.place(
+                    key, exclude=exclude, tenant=tenant, cost_class=cost_class
+                )
             except NoReplicasAvailable:
                 if last_response is not None or last_error is not None:
                     break
@@ -777,6 +1149,8 @@ class FleetRouter:
                 continue
             except Exception as e:
                 last_error = e
+                if not self.spend_retry_budget(tenant):
+                    break
                 self.record_retry("unreachable")
                 retries += 1
                 exclude.add(replica.name)
@@ -784,7 +1158,12 @@ class FleetRouter:
             reason = self.retry_reason(response.status_code)
             if reason is None or (reason == "server_error" and not retry_5xx):
                 return response, replica.name, retries
+            if reason == "shed" and self.sticky_shed(response.content):
+                # A per-tenant verdict with its Retry-After: honest as-is.
+                return response, replica.name, retries
             last_response = response
+            if not self.spend_retry_budget(tenant):
+                return response, replica.name, retries
             self.record_retry(reason)
             retries += 1
             exclude.add(replica.name)
@@ -1034,4 +1413,10 @@ class FleetRouter:
             },
             "totals": dict(self.totals),
             "affinity": dict(self.affinity_totals),
+            # Fleet-wide tenancy plane (docs/fleet.md "Fleet-wide
+            # tenancy"): the quota-lease ledger and the peer-router view.
+            "quota": self.ledger.snapshot(),
+            "peers": [
+                self.peers[name].to_dict() for name in sorted(self.peers)
+            ],
         }

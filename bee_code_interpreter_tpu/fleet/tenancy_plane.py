@@ -1,0 +1,231 @@
+"""Router-side fleet-wide tenancy plane (docs/fleet.md "Fleet-wide
+tenancy").
+
+Three small pieces the :class:`~.router.FleetRouter` composes:
+
+- **Rendezvous placement** (:func:`rendezvous_rank`, :func:`subset_size`):
+  each declared tenant is hashed onto a bounded replica subset (k replicas
+  proportional to its WFQ weight), so per-replica admission enforcement
+  composes into a fleet-wide bound *by construction* — a tenant spraying
+  keyless requests cannot collect one bucket per replica. Rendezvous
+  (highest-random-weight) hashing re-forms the subset minimally when a
+  replica dies: only the dead member's slot moves.
+- **Quota-lease ledger** (:class:`QuotaLedger`): the router's half of the
+  lease protocol. Each replica periodically asks for a slice of every
+  rate-quota'd tenant it serves; the ledger splits the tenant's declared
+  fleet-wide ``rps`` equally across the ACTIVE lessees (replicas holding a
+  non-expired lease), so the fleet-wide sum converges to the declared
+  quota as leases refresh. Membership churn can transiently over-issue —
+  bounded by one lease TTL, the declared bound docs/fleet.md states.
+- **Router-edge retry budgets** (:class:`RetryBudget`): the proxy-side
+  twin of the admission controller's per-tenant retry budget, consulted
+  before every cross-replica retry so a retry storm cannot amplify
+  through the router.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import math
+import time
+from typing import Callable, Iterable
+
+#: Mirrors resilience/admission.py: a tenant with a rate quota may retry at
+#: ~10% of it through the router, bucket depth 10.
+RETRY_BUDGET_RATIO = 0.1
+RETRY_BUDGET_MIN_RATE = 0.1
+RETRY_BUDGET_BURST = 10.0
+
+
+def rendezvous_rank(tenant_id: str, names: Iterable[str]) -> list[str]:
+    """All replica names ranked by rendezvous (highest-random-weight)
+    score for ``tenant_id``. Deterministic across router edges (pure
+    function of the names), and minimally disruptive: removing one name
+    never reorders the others, so a dead subset member's slot is taken by
+    the next-ranked replica and every other tenant's subset is unmoved."""
+
+    def score(name: str) -> int:
+        digest = hashlib.sha256(f"{tenant_id}|{name}".encode()).digest()
+        return int.from_bytes(digest[:8], "big")
+
+    return sorted(names, key=score, reverse=True)
+
+
+def subset_size(weight: float, n_replicas: int) -> int:
+    """k ∝ weight, clamped to [1, N]: a weight-1 tenant concentrates on
+    one replica (its per-replica quota IS its fleet quota), a weight-4
+    tenant spreads across four."""
+    return max(1, min(n_replicas, math.ceil(weight)))
+
+
+class QuotaLedger:
+    """Which replicas currently hold a lease on which tenant's quota.
+
+    ``registry`` is the router's :class:`~..tenancy.TenantRegistry` (may
+    be None: every grant answers with zero leases, and replicas stay on
+    their local fallback). Lessee entries expire after ``ttl_s``; a grant
+    recomputes the equal split over the active lessees *including the
+    asker*, so the first refresh after membership changes re-converges
+    the fleet-wide sum."""
+
+    def __init__(
+        self,
+        registry=None,
+        *,
+        ttl_s: float = 3.0,
+        clock: Callable[[], float] = time.monotonic,
+    ) -> None:
+        self._registry = registry
+        self._ttl_s = ttl_s
+        self._clock = clock
+        # tenant id -> {replica name -> lease expiry (this clock)}
+        self._lessees: dict[str, dict[str, float]] = {}
+        self.granted_total = 0
+        self.merged_total = 0
+
+    @property
+    def ttl_s(self) -> float:
+        return self._ttl_s
+
+    def _active(self, tenant_id: str, now: float) -> dict[str, float]:
+        table = self._lessees.get(tenant_id)
+        if not table:
+            return {}
+        for name in [n for n, exp in table.items() if exp <= now]:
+            del table[name]
+        return table
+
+    def grant(self, replica: str, tenant_ids: Iterable[str]) -> dict:
+        """One lease request from ``replica``: returns the per-tenant
+        slices ``{tenant: {rps, burst, ttl_s}}``. Tenants unknown to the
+        registry or without a rate quota are skipped — the replica's own
+        table is authoritative for everything but the split."""
+        now = self._clock()
+        leases: dict[str, dict] = {}
+        for tenant_id in tenant_ids:
+            tenant = (
+                self._registry.get(tenant_id)
+                if self._registry is not None
+                else None
+            )
+            if tenant is None or tenant.rps is None:
+                continue
+            table = self._lessees.setdefault(tenant_id, {})
+            table[replica] = now + self._ttl_s
+            share = max(1, len(self._active(tenant_id, now)))
+            leases[tenant_id] = {
+                "rps": tenant.rps / share,
+                "burst": max(1.0, tenant.burst_depth / share),
+                "ttl_s": self._ttl_s,
+            }
+            self.granted_total += 1
+        return leases
+
+    def active_count(self) -> int:
+        now = self._clock()
+        return sum(
+            len(self._active(tenant_id, now))
+            for tenant_id in list(self._lessees)
+        )
+
+    # ------------------------------------------------------------ HA gossip
+
+    def export(self) -> dict:
+        """The ledger as peer-portable relative expiries (router clocks
+        are not comparable): ``{tenant: {replica: expires_in_s}}``."""
+        now = self._clock()
+        out: dict[str, dict[str, float]] = {}
+        for tenant_id in list(self._lessees):
+            active = self._active(tenant_id, now)
+            if active:
+                out[tenant_id] = {
+                    name: round(exp - now, 3) for name, exp in active.items()
+                }
+        return out
+
+    def merge(self, peer_export: dict) -> int:
+        """Reconcile a peer's ledger into this one: max expiry wins per
+        (tenant, replica). After a router edge dies, the survivor already
+        knows every lessee the dead edge granted to — the next refresh
+        splits over the full set instead of re-issuing full quotas, which
+        is what bounds double-issue to one TTL of membership skew."""
+        now = self._clock()
+        merged = 0
+        if not isinstance(peer_export, dict):
+            return 0
+        for tenant_id, lessees in peer_export.items():
+            if not isinstance(lessees, dict):
+                continue
+            table = self._lessees.setdefault(str(tenant_id), {})
+            for replica, expires_in_s in lessees.items():
+                try:
+                    expiry = now + min(float(expires_in_s), self._ttl_s)
+                except (TypeError, ValueError):
+                    continue
+                if expiry > table.get(str(replica), 0.0):
+                    table[str(replica)] = expiry
+                    merged += 1
+        self.merged_total += merged
+        return merged
+
+    def snapshot(self) -> dict:
+        """The operator view (``GET /v1/fleet/replicas`` "quota" section;
+        scripts/fleet-router-top.py renders it)."""
+        now = self._clock()
+        tenants: dict[str, dict] = {}
+        for tenant_id in sorted(self._lessees):
+            active = self._active(tenant_id, now)
+            if not active:
+                continue
+            tenant = (
+                self._registry.get(tenant_id)
+                if self._registry is not None
+                else None
+            )
+            rps = tenant.rps if tenant is not None else None
+            tenants[tenant_id] = {
+                "rps": rps,
+                "lessees": {
+                    name: round(exp - now, 3)
+                    for name, exp in sorted(active.items())
+                },
+                "slice_rps": (
+                    round(rps / max(1, len(active)), 3)
+                    if rps is not None
+                    else None
+                ),
+            }
+        return {
+            "ttl_s": self._ttl_s,
+            "granted_total": self.granted_total,
+            "merged_total": self.merged_total,
+            "tenants": tenants,
+        }
+
+
+class RetryBudget:
+    """Router-edge per-tenant retry token bucket, mirroring the admission
+    controller's (~10% of the rate quota, depth 10). One instance per
+    tenant, created lazily by the router."""
+
+    def __init__(
+        self, rps: float, *, clock: Callable[[], float] = time.monotonic
+    ) -> None:
+        self._rate = max(RETRY_BUDGET_MIN_RATE, rps * RETRY_BUDGET_RATIO)
+        self._clock = clock
+        self._tokens = RETRY_BUDGET_BURST
+        self._mono = clock()
+        self.denied = 0
+
+    def spend(self) -> bool:
+        now = self._clock()
+        self._tokens = min(
+            RETRY_BUDGET_BURST,
+            self._tokens + (now - self._mono) * self._rate,
+        )
+        self._mono = now
+        if self._tokens >= 1.0:
+            self._tokens -= 1.0
+            return True
+        self.denied += 1
+        return False

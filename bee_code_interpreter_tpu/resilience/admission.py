@@ -144,6 +144,7 @@ class AdmissionController:
         cost_aware: bool = False,
         heavy_max_in_flight: int | None = None,
         tenancy=None,  # tenancy.TenantRegistry (per-tenant quotas + WFQ)
+        quota_leases=None,  # tenancy.QuotaLeaseCache (fleet-wide quotas)
         clock=time.monotonic,  # injectable for the token buckets
     ) -> None:
         self._max_in_flight = max(1, max_in_flight)
@@ -160,6 +161,12 @@ class AdmissionController:
         )
         self._heavy_in_flight = 0
         self._tenancy = tenancy
+        # Fleet-wide rate quotas (docs/tenancy.md "Fleet-wide tenancy"):
+        # when a lease cache is wired in, each lane's token bucket refills
+        # at this replica's GRANTED slice of the tenant's fleet-wide rps
+        # rather than the full declared quota; with no cache (single
+        # replica, pre-fleet deployments) behavior is unchanged.
+        self._quota_leases = quota_leases
         self._clock = clock
         self._lanes: dict[str, _TenantLane] = {}
         self._rr_cursor: str | None = None
@@ -363,28 +370,40 @@ class AdmissionController:
         finally:
             self._release(lane)
 
-    def _refill_tokens(self, lane: _TenantLane) -> None:
-        rps = lane.tenant.rps
-        if rps is None:
-            return
+    def _effective_quota(self, lane: _TenantLane) -> tuple[float, float]:
+        """The ``(rps, burst)`` this replica enforces for the lane's
+        tenant: the full declared quota without a lease cache, otherwise
+        the granted slice (or the cache's fail-safe 1/N split)."""
+        tenant = lane.tenant
+        if self._quota_leases is None:
+            return tenant.rps, tenant.burst_depth
+        return self._quota_leases.effective(tenant)
+
+    def _refill_tokens(self, lane: _TenantLane) -> float | None:
+        if lane.tenant.rps is None:
+            return None
+        rate, burst = self._effective_quota(lane)
         now = self._clock()
         lane.tokens = min(
-            lane.tenant.burst_depth,
-            lane.tokens + (now - lane.tokens_mono) * rps,
+            burst,
+            lane.tokens + (now - lane.tokens_mono) * rate,
         )
         lane.tokens_mono = now
+        return rate
 
     async def _acquire(self, deadline, lane: _TenantLane) -> None:
         tenant = lane.tenant
         # 1. Rate quota: a per-tenant verdict, charged at arrival. The
-        # Retry-After names when the next token lands, not a global hint.
+        # Retry-After names when the next token lands at the CURRENT
+        # effective rate (the leased slice, behind a fleet router), not a
+        # global hint.
         if tenant.rps is not None:
-            self._refill_tokens(lane)
+            rate = self._refill_tokens(lane)
             if lane.tokens < 1.0:
                 self._shed(
                     "tenant_quota",
                     lane,
-                    retry_after_s=(1.0 - lane.tokens) / tenant.rps,
+                    retry_after_s=(1.0 - lane.tokens) / max(rate, 1e-9),
                 )
             lane.tokens -= 1.0
         # 2. Uncontended fast path: free global slot, empty queue, tenant
@@ -557,6 +576,20 @@ class AdmissionController:
 
         return spend
 
+    # --------------------------------------------------------- quota leases
+
+    def quota_tenants(self) -> list[str]:
+        """Tenant ids worth leasing fleet-wide quota slices for: every
+        rate-quota'd tenant that has a lane here (i.e. this replica has
+        actually seen its traffic). The lease client sends this list each
+        refresh — replicas a tenant never reaches never claim a slice, so
+        the tenant's active lessees converge to its placement subset."""
+        return sorted(
+            lane.tenant.id
+            for lane in self._lanes.values()
+            if lane.tenant.rps is not None
+        )
+
     # ------------------------------------------------------------- operator
 
     def tenant_snapshot(self) -> dict[str, dict]:
@@ -582,4 +615,13 @@ class AdmissionController:
                     else None
                 ),
             }
+            if self._quota_leases is not None and lane.tenant.rps is not None:
+                rate, burst = self._effective_quota(lane)
+                out[label]["quota"] = {
+                    "effective_rps": round(rate, 3),
+                    "effective_burst": round(burst, 3),
+                    "leased": (
+                        self._quota_leases.lease(lane.tenant.id) is not None
+                    ),
+                }
         return out

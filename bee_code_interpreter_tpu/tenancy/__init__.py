@@ -21,6 +21,11 @@ from bee_code_interpreter_tpu.tenancy.context import (
     meter_ambient_usage,
     tenant_scope,
 )
+from bee_code_interpreter_tpu.tenancy.leases import (
+    QuotaLease,
+    QuotaLeaseCache,
+    QuotaLeaseClient,
+)
 from bee_code_interpreter_tpu.tenancy.metering import TenantUsageMeter
 from bee_code_interpreter_tpu.tenancy.registry import (
     DEFAULT_TENANT_ID,
@@ -35,6 +40,9 @@ __all__ = [
     "DEFAULT_TENANT_ID",
     "TENANT_HEADER",
     "TENANT_METADATA_KEY",
+    "QuotaLease",
+    "QuotaLeaseCache",
+    "QuotaLeaseClient",
     "Tenant",
     "TenantContext",
     "TenantRegistry",

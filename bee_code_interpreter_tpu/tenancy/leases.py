@@ -1,0 +1,269 @@
+"""Replica-side fleet-wide quota leases (docs/tenancy.md "Fleet-wide
+tenancy").
+
+A tenant's ``rps`` quota is declared for the LOGICAL service, but PR 13's
+token bucket enforces it per replica — behind a fleet router, N replicas
+would hand out N× the declared rate. The lease protocol closes that gap
+without a per-request coordination hop:
+
+- :class:`QuotaLeaseCache` holds the slice of each tenant's fleet-wide
+  rate this replica may currently enforce, granted by a router edge
+  (``POST /v1/fleet/quota/lease``) with a TTL. The admission controller
+  consults it on every token refill — enforcement stays local and
+  synchronous; only the *budget* is distributed.
+- :class:`QuotaLeaseClient` is the background refresher: every
+  ``interval_s`` it asks a router for fresh slices covering the tenants
+  this replica has actually seen, failing over across router edges in
+  order.
+
+**Fail SAFE, never open**: when every router is unreachable the cached
+leases expire and :meth:`QuotaLeaseCache.effective` degrades to a local
+``1/N`` split over the last known fleet size — a partitioned replica
+enforces a TIGHTER quota than its lease, never an unlimited one, and never
+more than the tenant's full declared quota.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from dataclasses import dataclass
+from typing import Callable
+
+logger = logging.getLogger(__name__)
+
+#: Default lease lifetime a router grants; the refresh interval should be
+#: comfortably shorter so a healthy replica never falls into the 1/N
+#: fallback between refreshes.
+LEASE_DEFAULT_TTL_S = 3.0
+
+
+@dataclass
+class QuotaLease:
+    """One granted slice of a tenant's fleet-wide rate quota."""
+
+    tenant_id: str
+    rps: float
+    burst: float
+    expires_mono: float
+    router: str | None = None  # which router edge granted it
+
+
+class QuotaLeaseCache:
+    """The replica's view of its granted quota slices, with the fail-safe
+    fallback built in. Synchronous and allocation-light: the admission
+    controller reads it on every token refill."""
+
+    def __init__(
+        self,
+        *,
+        fleet_size_hint: int = 1,
+        clock: Callable[[], float] = time.monotonic,
+    ) -> None:
+        self._clock = clock
+        self._leases: dict[str, QuotaLease] = {}
+        # Last known replica count, for the 1/N fallback split. Starts at
+        # the configured hint (1 = standalone keeps its full quota) and is
+        # updated from every lease response — a replica that has EVER
+        # talked to a router keeps splitting correctly while partitioned.
+        self._fleet_size = max(1, int(fleet_size_hint))
+        self.granted = 0  # lease updates applied
+        self.fallbacks = 0  # effective() answers served by the 1/N split
+
+    @property
+    def fleet_size(self) -> int:
+        return self._fleet_size
+
+    def observe_fleet_size(self, n) -> None:
+        if isinstance(n, (int, float)) and n >= 1:
+            self._fleet_size = int(n)
+
+    def update(
+        self,
+        tenant_id: str,
+        *,
+        rps: float,
+        burst: float,
+        ttl_s: float,
+        router: str | None = None,
+    ) -> None:
+        self._leases[tenant_id] = QuotaLease(
+            tenant_id=tenant_id,
+            rps=max(0.0, float(rps)),
+            burst=max(1.0, float(burst)),
+            expires_mono=self._clock() + max(0.0, float(ttl_s)),
+            router=router,
+        )
+        self.granted += 1
+
+    def lease(self, tenant_id: str) -> QuotaLease | None:
+        """The non-expired lease for ``tenant_id``, else None."""
+        lease = self._leases.get(tenant_id)
+        if lease is None or lease.expires_mono <= self._clock():
+            return None
+        return lease
+
+    def effective(self, tenant) -> tuple[float, float]:
+        """The ``(rps, burst)`` this replica may enforce for ``tenant``
+        right now. A valid lease caps at the tenant's own declared quota
+        (a buggy or malicious router can tighten, never widen); no valid
+        lease means the 1/N fallback split — degraded enforcement is a
+        tighter quota, never an open one."""
+        rps = tenant.rps
+        burst = tenant.burst_depth
+        lease = self.lease(tenant.id)
+        if lease is not None:
+            return min(lease.rps, rps), max(1.0, min(lease.burst, burst))
+        self.fallbacks += 1
+        n = self._fleet_size
+        return rps / n, max(1.0, burst / n)
+
+    def snapshot(self) -> dict:
+        now = self._clock()
+        return {
+            "fleet_size": self._fleet_size,
+            "granted": self.granted,
+            "fallbacks": self.fallbacks,
+            "leases": {
+                tid: {
+                    "rps": round(lease.rps, 3),
+                    "burst": round(lease.burst, 3),
+                    "ttl_s": round(max(0.0, lease.expires_mono - now), 3),
+                    "router": lease.router,
+                }
+                for tid, lease in sorted(self._leases.items())
+            },
+        }
+
+
+class QuotaLeaseClient:
+    """Background lease refresher for one replica.
+
+    Every ``interval_s`` it POSTs ``/v1/fleet/quota/lease`` to the first
+    reachable router edge (failing over in declared order, sticking with
+    the last one that answered), covering every rate-quota'd tenant the
+    admission controller has seen. Total unreachability is not an error
+    path the data plane ever observes: the cache simply expires into its
+    1/N fallback."""
+
+    def __init__(
+        self,
+        cache: QuotaLeaseCache,
+        admission,
+        *,
+        replica: str,
+        router_urls: list[str],
+        interval_s: float = 1.0,
+        http_timeout_s: float = 2.0,
+        metrics=None,
+        http_client=None,
+    ) -> None:
+        self._cache = cache
+        self._admission = admission
+        self._replica = replica
+        self._urls = [u.rstrip("/") for u in router_urls if u.strip()]
+        self._interval_s = interval_s
+        self._http_timeout_s = http_timeout_s
+        self._client = http_client
+        self._task: asyncio.Task | None = None
+        self._preferred = 0  # index of the last router that answered
+        self._refresh_total = None
+        if metrics is not None:
+            self._refresh_total = metrics.counter(
+                "bci_quota_lease_refresh_total",
+                "Quota-lease refresh attempts against the router tier, by "
+                "outcome (ok/unreachable)",
+            )
+            metrics.gauge(
+                "bci_quota_lease_fleet_size",
+                "Fleet size last reported by a router (the 1/N fallback "
+                "divisor)",
+                lambda: self._cache.fleet_size,
+            )
+
+    def start(self) -> asyncio.Task:
+        """Start the refresh loop (requires a running loop); idempotent."""
+        if self._task is not None and not self._task.done():
+            return self._task
+        self._task = asyncio.get_running_loop().create_task(self._run())
+        return self._task
+
+    async def stop(self) -> None:
+        task, self._task = self._task, None
+        if task is not None:
+            task.cancel()
+            try:
+                await task
+            except asyncio.CancelledError:
+                pass
+        client, self._client = self._client, None
+        if client is not None:
+            await client.close()
+
+    async def _run(self) -> None:
+        while True:
+            try:
+                await self.refresh_once()
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                # One bad sweep must not end quota convergence for good.
+                logger.exception("Quota lease refresh failed")
+            await asyncio.sleep(self._interval_s)
+
+    def _session(self):
+        if self._client is None:
+            import aiohttp
+
+            self._client = aiohttp.ClientSession(
+                timeout=aiohttp.ClientTimeout(total=self._http_timeout_s)
+            )
+        return self._client
+
+    async def refresh_once(self) -> bool:
+        """One refresh attempt across the router list. Returns True when a
+        router answered (even with zero leases: the fleet-size observation
+        alone keeps the fallback split honest)."""
+        import json as _json
+
+        tenants = self._admission.quota_tenants()
+        body = _json.dumps(
+            {"replica": self._replica, "tenants": tenants}
+        ).encode()
+        n = len(self._urls)
+        for i in range(n):
+            url = self._urls[(self._preferred + i) % n]
+            try:
+                async with self._session().post(
+                    f"{url}/v1/fleet/quota/lease",
+                    data=body,
+                    headers={"content-type": "application/json"},
+                ) as response:
+                    if response.status != 200:
+                        continue
+                    doc = await response.json()
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                continue
+            router_id = doc.get("router")
+            for tid, lease in (doc.get("leases") or {}).items():
+                try:
+                    self._cache.update(
+                        tid,
+                        rps=lease["rps"],
+                        burst=lease["burst"],
+                        ttl_s=lease["ttl_s"],
+                        router=router_id,
+                    )
+                except (KeyError, TypeError, ValueError):
+                    continue  # one malformed grant must not kill the rest
+            self._cache.observe_fleet_size(doc.get("fleet_size"))
+            self._preferred = (self._preferred + i) % n
+            if self._refresh_total is not None:
+                self._refresh_total.inc(outcome="ok")
+            return True
+        if self._refresh_total is not None:
+            self._refresh_total.inc(outcome="unreachable")
+        return False

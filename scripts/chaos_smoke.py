@@ -83,6 +83,20 @@ wiring minus kubectl. Scenarios:
                             and the abuser's sheds are accounted exactly
                             once across bci_tenant_shed_total, the wide
                             events, and /v1/tenants
+ 16. fleet-wide tenancy    — 3 COMPLETE replicas behind 2 peered router
+                            edges (docs/fleet.md "Fleet-wide tenancy"):
+                            tenant-aware rendezvous placement pins a
+                            weight-1 abuser to a single-replica subset,
+                            replicas lease fleet-wide quota slices from
+                            the routers, and one router edge is KILLED
+                            mid-flood: the keyless 100x-quota abuser is
+                            held <= 1.2x the fleet-wide quota, victims'
+                            p50 stays within 10% with zero sheds, the
+                            session created through the dead edge keeps
+                            serving through the survivor (pin gossip,
+                            zero lease-scoped 5xx), and sheds/leases are
+                            accounted exactly across /v1/tenants, the
+                            wide events, and bci_tenant_shed_total
 
 Exits nonzero if any scenario misbehaves. Usage:
 
@@ -1322,6 +1336,225 @@ async def main() -> int:
             await k8s15.aclose()
             await pods15.close()
 
+        # 16. fleet-wide tenancy: tenant-aware placement + distributed
+        #     quota leases + router HA under a router-edge kill
+        #     (docs/fleet.md "Fleet-wide tenancy"; tier-1 twin in
+        #     tests/test_fleet_router.py).
+        from bee_code_interpreter_tpu.tenancy import (
+            TenantRegistry as Registry16,
+            parse_tenants as parse_tenants_16,
+        )
+
+        spec16 = "abuser:weight=1:rps=2:burst=2,victim:weight=4"
+        shared16 = tmp / "shared-objects-16"
+        port16a, port16b = free_port(), free_port()
+        url16a = f"http://127.0.0.1:{port16a}"
+        url16b = f"http://127.0.0.1:{port16b}"
+        stacks16 = [
+            await ReplicaStack(
+                f"r{i}",
+                tmp / "fleet16",
+                shared16,
+                tenants=spec16,
+                lease_router_urls=[url16a, url16b],
+            ).start()
+            for i in range(3)
+        ]
+
+        def make_router16(rid, peer_name, peer_url):
+            return FleetRouter(
+                [(s.name, s.base_url) for s in stacks16],
+                refresh_interval_s=0.2,
+                dead_after_s=1.0,
+                tenancy=Registry16(parse_tenants_16(spec16)),
+                peers=[(peer_name, peer_url)],
+                quota_ttl_s=1.0,
+                router_id=rid,
+            )
+
+        router16a = make_router16("A", "b", url16b)
+        router16b = make_router16("B", "a", url16a)
+        runners16 = []
+        for router, port in ((router16a, port16a), (router16b, port16b)):
+            runner = aioweb.AppRunner(create_router_app(router))
+            await runner.setup()
+            await aioweb.TCPSite(runner, "127.0.0.1", port).start()
+            await router.refresh_once()
+            router.start()
+            runners16.append(runner)
+        runner16a, runner16b = runners16
+        client16 = httpx.AsyncClient(timeout=30.0)
+        statuses16: list[int] = []
+        try:
+            body16 = {"source_code": "print('ok')"}
+            r = await client16.post(f"{url16a}/v1/sessions", json={})
+            sid16 = r.json()["session_id"]
+            r = await client16.post(
+                f"{url16a}/v1/sessions/{sid16}/execute",
+                json={
+                    "source_code": "open('state.txt', 'w').write('sixteen')"
+                },
+            )
+            assert r.status_code == 200, r.text
+
+            async def victim16() -> float:
+                t0 = time.monotonic()
+                resp = await client16.post(
+                    f"{url16b}/v1/execute",
+                    json=body16,
+                    headers={TENANT_HEADER: "victim"},
+                )
+                assert resp.status_code == 200, resp.text
+                return time.monotonic() - t0
+
+            baseline16 = []
+            for _ in range(12):
+                baseline16.append(await victim16())
+                await asyncio.sleep(0.02)
+            p50_base16 = statistics.median(baseline16)
+
+            flood16_start = time.monotonic()
+
+            async def abuse16(base_url) -> None:
+                resp = await client16.post(
+                    f"{base_url}/v1/execute",
+                    json=body16,
+                    headers={TENANT_HEADER: "abuser"},
+                )
+                statuses16.append(resp.status_code)
+
+            wave16 = [
+                asyncio.create_task(abuse16(url16a if i % 2 else url16b))
+                for i in range(60)
+            ]
+            during16 = []
+            for _ in range(6):
+                during16.append(await victim16())
+                await asyncio.sleep(0.02)
+            await asyncio.gather(*wave16)
+            await asyncio.sleep(0.5)  # one gossip + lease-refresh beat
+
+            await runner16a.cleanup()  # kill edge A mid-flood
+            await router16a.stop()
+
+            wave16 = [
+                asyncio.create_task(abuse16(url16b)) for _ in range(60)
+            ]
+            for _ in range(6):
+                during16.append(await victim16())
+                await asyncio.sleep(0.02)
+            await asyncio.gather(*wave16)
+            elapsed16 = time.monotonic() - flood16_start
+            p50_during16 = statistics.median(during16)
+
+            admitted16 = sum(
+                s.admission.tenant_snapshot()
+                .get("abuser", {})
+                .get("admitted", 0)
+                for s in stacks16
+            )
+            abuser16 = router16b._tenancy.get("abuser")
+            bound16 = 1.2 * (
+                abuser16.rps * elapsed16 + abuser16.burst_depth
+            )
+            report(
+                "keyless 100x abuser held <= 1.2x the FLEET-wide quota",
+                1 <= admitted16 <= bound16,
+                f"{admitted16} admitted fleet-wide vs bound "
+                f"{bound16:.1f} over {elapsed16:.1f}s",
+            )
+            victim_sheds16 = sum(
+                sum(
+                    s.admission.tenant_snapshot()
+                    .get("victim", {})
+                    .get("sheds", {})
+                    .values()
+                )
+                for s in stacks16
+            )
+            report(
+                "victim p50 within 10% and zero victim sheds fleet-wide",
+                p50_during16 <= p50_base16 * 1.10 + 0.01
+                and victim_sheds16 == 0,
+                f"baseline {p50_base16 * 1000:.1f}ms vs "
+                f"{p50_during16 * 1000:.1f}ms, {victim_sheds16} shed(s)",
+            )
+
+            r = await client16.post(
+                f"{url16b}/v1/sessions/{sid16}/execute",
+                json={"source_code": "print(open('state.txt').read())"},
+            )
+            report(
+                "session from the DEAD edge keeps serving via gossip "
+                "(zero lease-scoped 5xx)",
+                r.status_code == 200
+                and "sixteen" in r.json().get("stdout", "")
+                and r.json().get("session_id") == sid16,
+                f"status {r.status_code} via the surviving edge",
+            )
+
+            ledger16 = router16b.ledger.snapshot()
+            lessees16 = set(
+                ledger16["tenants"].get("abuser", {}).get("lessees", {})
+            )
+            lease16 = next(
+                (
+                    s.quota_leases.lease("abuser")
+                    for s in stacks16
+                    if s.name in lessees16
+                ),
+                None,
+            )
+            retries16 = router16b.metrics.metrics[
+                "bci_router_retries_total"
+            ]._values
+            total_sheds16 = 0
+            exact16 = True
+            for s in stacks16:
+                lane = s.admission.tenant_snapshot().get("abuser")
+                sheds = sum((lane or {}).get("sheds", {}).values())
+                total_sheds16 += sheds
+                wide = s.recorder.events(
+                    outcome="shed", tenant="abuser", limit=10_000
+                )
+                counter = sum(
+                    v
+                    for key, v in s.metrics.metrics[
+                        "bci_tenant_shed_total"
+                    ]._values.items()
+                    if ("tenant", "abuser") in key
+                )
+                doc = (
+                    await client16.get(f"{s.base_url}/v1/tenants")
+                ).json()
+                usage = (
+                    doc["tenants"].get("abuser", {}).get("usage") or {}
+                )
+                exact16 = exact16 and (
+                    len(wide) == sheds
+                    and counter == sheds
+                    and usage.get("sheds", sheds) == sheds
+                )
+            report(
+                "sheds + leases account exactly across "
+                "v1-tenants/wide-events/metrics, sticky sheds never "
+                "re-walked, single-subset lease on the survivor ledger",
+                exact16
+                and total_sheds16 == statuses16.count(429)
+                and admitted16 + total_sheds16 == len(statuses16)
+                and len(lessees16) == 1
+                and lease16 is not None
+                and retries16.get((("reason", "shed"),), 0) == 0,
+                f"{total_sheds16} shed(s), lessees={sorted(lessees16)}",
+            )
+        finally:
+            await client16.aclose()
+            await runner16b.cleanup()
+            await router16b.stop()
+            await router16a.stop()
+            for s in stacks16:
+                await s.stop()
+
         text = metrics.expose()
         wanted = [
             "bci_executor_fallback_total 1",
@@ -1346,7 +1579,8 @@ async def main() -> int:
         "chaos smoke passed: deadline, breaker, fallback, admission, replay, "
         "supervisor, watchdog, drain, telemetry export, edge analysis gate, "
         "sessions-under-chaos, flight-recorder-logs, serving-saturation, "
-        "autoscale-10x-step, fleet-router-kill, abusive-tenant all behaved"
+        "autoscale-10x-step, fleet-router-kill, abusive-tenant, "
+        "fleet-wide-tenancy all behaved"
     )
     return 0
 

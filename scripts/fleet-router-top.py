@@ -4,8 +4,10 @@
 Fetches ``GET /v1/fleet/replicas`` from a running router edge and prints a
 `top`-style per-replica table — utilization, SLO burn, leases, hash-ring
 ownership share, breaker state, routed totals — plus the router's session
-pins and decision/affinity/migration tallies. ``--watch N`` refreshes every
-N seconds until interrupted.
+pins, decision/affinity/migration tallies, each replica's tenant and
+cost-class mix, the fleet-wide quota-lease ledger, and peer-router health
+(docs/fleet.md "Fleet-wide tenancy"). ``--watch N`` refreshes every N
+seconds until interrupted.
 
     python scripts/fleet-router-top.py [--url http://localhost:50080]
         [--watch SECONDS]
@@ -30,6 +32,12 @@ def fmt_age(seconds: float | None) -> str:
     return f"{seconds / 3600:.1f}h"
 
 
+def fmt_mix(mix: dict) -> str:
+    """``{"alpha": 12, "beta": 3}`` -> ``alpha=12 beta=3``, largest first."""
+    items = sorted(mix.items(), key=lambda kv: (-kv[1], kv[0]))
+    return " ".join(f"{k}={v}" for k, v in items) or "-"
+
+
 def render(snap: dict) -> str:
     lines = []
     replicas = snap.get("replicas", [])
@@ -52,11 +60,24 @@ def render(snap: dict) -> str:
     warm_rate = affinity.get("warm", 0) / keyed if keyed else None
     lines.append(
         "placement: "
-        + "  ".join(f"{k}={affinity.get(k, 0)}" for k in ("warm", "spill", "keyless"))
+        + "  ".join(
+            f"{k}={affinity.get(k, 0)}"
+            for k in ("warm", "spill", "keyless", "tenant")
+        )
         + (f"  warm_rate={warm_rate:.0%}" if warm_rate is not None else "")
         + f"  migrations ok={totals.get('migrations_ok', 0)}"
         + f" failed={totals.get('migrations_failed', 0)}"
     )
+    peers = snap.get("peers", [])
+    if peers:
+        lines.append(
+            "peers: "
+            + "  ".join(
+                f"{p['name']}={'up' if p.get('up') else 'DOWN'}"
+                + (f"({p['last_error']})" if p.get("last_error") else "")
+                for p in peers
+            )
+        )
     lines.append("")
     header = (
         f"{'REPLICA':<12} {'STATE':<9} {'UTIL':>5} {'BURN':>5} "
@@ -81,6 +102,40 @@ def render(snap: dict) -> str:
         )
     if not replicas:
         lines.append("(no replicas registered)")
+    mixes = [
+        (r["name"], r.get("tenants") or {}, r.get("cost_classes") or {})
+        for r in replicas
+    ]
+    if any(t or c for _, t, c in mixes):
+        lines.append("")
+        lines.append("mix (per replica):")
+        for name, tenants, costs in mixes:
+            lines.append(
+                f"  {name:<12} tenants: {fmt_mix(tenants):<32} "
+                f"cost: {fmt_mix(costs)}"
+            )
+    quota = snap.get("quota") or {}
+    tenants_ledger = quota.get("tenants") or {}
+    if tenants_ledger:
+        lines.append("")
+        lines.append(
+            f"quota leases (ttl={quota.get('ttl_s', 0):g}s "
+            f"granted={quota.get('granted_total', 0)} "
+            f"merged={quota.get('merged_total', 0)}):"
+        )
+        for tid in sorted(tenants_ledger):
+            row = tenants_ledger[tid]
+            lessees = row.get("lessees") or {}
+            lessee_str = (
+                " ".join(
+                    f"{n}={lessees[n]:.1f}s" for n in sorted(lessees)
+                )
+                or "(none)"
+            )
+            lines.append(
+                f"  {tid:<12} rps={row.get('rps', 0):g} "
+                f"slice={row.get('slice_rps', 0):g}  lessees: {lessee_str}"
+            )
     return "\n".join(lines)
 
 

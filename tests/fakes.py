@@ -166,17 +166,32 @@ class ReplicaStack:
     Imports are deferred to ``start()`` so importing tests.fakes stays
     cheap for the many suites that only want the fake cluster."""
 
-    def __init__(self, name: str, tmp_path, shared_root, faults=None) -> None:
+    def __init__(
+        self,
+        name: str,
+        tmp_path,
+        shared_root,
+        faults=None,
+        tenants: str | None = None,
+        lease_router_urls: list[str] | None = None,
+    ) -> None:
         self.name = name
         self.tmp_path = Path(tmp_path)
         self.shared_root = shared_root
         self.faults = faults
+        self.tenants = tenants  # APP_TENANTS spec for this replica's edge
+        # Fleet-wide quota leasing (docs/tenancy.md "Fleet-wide tenancy"):
+        # router base URLs this replica leases rate-quota slices from.
+        self.lease_router_urls = lease_router_urls
+        self.lease_client = None
+        self.quota_leases = None
         self.stopped = False
 
     async def start(self) -> "ReplicaStack":
         from bee_code_interpreter_tpu.api.http_server import create_http_server
         from bee_code_interpreter_tpu.config import Config
         from bee_code_interpreter_tpu.observability import (
+            FlightRecorder,
             SloEngine,
             Tracer,
             parse_objectives,
@@ -234,19 +249,57 @@ class ReplicaStack:
             drain=self.drain,
             metrics=self.metrics,
         )
+        self.tenancy = None
+        if self.tenants is not None:
+            from bee_code_interpreter_tpu.tenancy import (
+                TenantRegistry,
+                parse_tenants,
+            )
+
+            self.tenancy = TenantRegistry(
+                parse_tenants(self.tenants), metrics=self.metrics
+            )
+        if self.lease_router_urls:
+            from bee_code_interpreter_tpu.tenancy import (
+                QuotaLeaseCache,
+                QuotaLeaseClient,
+            )
+
+            self.quota_leases = QuotaLeaseCache()
+        self.admission = AdmissionController(
+            max_in_flight=8,
+            max_queue=16,
+            retry_after_s=0.2,
+            metrics=self.metrics,
+            tenancy=self.tenancy,
+            quota_leases=self.quota_leases,
+        )
+        if self.lease_router_urls:
+            self.lease_client = QuotaLeaseClient(
+                self.quota_leases,
+                self.admission,
+                replica=self.name,
+                router_urls=list(self.lease_router_urls),
+                interval_s=0.2,
+                metrics=self.metrics,
+            )
+            self.lease_client.start()
+        self.recorder = FlightRecorder(max_events=4096, metrics=self.metrics)
+        tracer = Tracer(metrics=self.metrics)
+        tracer.add_sink(self.recorder.record_trace)
         app = create_http_server(
             code_executor=self.k8s,
             custom_tool_executor=CustomToolExecutor(code_executor=self.k8s),
             metrics=self.metrics,
-            admission=AdmissionController(
-                max_in_flight=8, max_queue=16, retry_after_s=0.2
-            ),
+            admission=self.admission,
             request_deadline_s=30.0,
-            tracer=Tracer(metrics=self.metrics),
+            tracer=tracer,
             fleet=self.k8s.journal,
             drain=self.drain,
             slo=self.slo,
             sessions=self.sessions,
+            tenancy=self.tenancy,
+            recorder=self.recorder,
         )
         self.runner = web.AppRunner(app)
         await self.runner.setup()
@@ -262,6 +315,8 @@ class ReplicaStack:
         if self.stopped:
             return
         self.stopped = True
+        if self.lease_client is not None:
+            await self.lease_client.stop()
         await self.sessions.stop()
         if not hard:
             await self.sessions.close_all()

@@ -10,6 +10,9 @@ fleet shape minus kubectl. The real FleetRouter fronts them over real
 sockets."""
 
 import asyncio
+import json
+import statistics
+import time
 
 import httpx
 import pytest
@@ -21,8 +24,15 @@ from bee_code_interpreter_tpu.fleet import (
     NoReplicasAvailable,
     affinity_key,
     create_router_app,
+    rendezvous_rank,
+    subset_size,
 )
 from bee_code_interpreter_tpu.health_check import assess_router
+from bee_code_interpreter_tpu.tenancy import (
+    TENANT_HEADER,
+    TenantRegistry,
+    parse_tenants,
+)
 from tests.fakes import ReplicaStack, free_port
 
 pytestmark = pytest.mark.chaos
@@ -119,6 +129,156 @@ async def test_keyless_placement_prefers_least_loaded():
     router.replicas["r2"].utilization = 0.4
     assert router.place(None)[0].name == "r1"
     assert router.affinity_result(None, "r1") == "keyless"
+
+
+def _tenant_router(clock, n=4, spec="small:weight=1:rps=5,big:weight=3:rps=30"):
+    router = FleetRouter(
+        [(f"r{i}", f"http://127.0.0.1:{i + 1}") for i in range(n)],
+        refresh_interval_s=0.2,
+        dead_after_s=5.0,
+        clock=clock,
+        tenancy=TenantRegistry(parse_tenants(spec)),
+    )
+    for replica in router.replicas.values():
+        replica.last_refresh_mono = clock()
+    return router
+
+
+async def test_tenant_placement_lands_on_exactly_the_rendezvous_subset():
+    """ISSUE 16 tentpole (a): a declared tenant's keyless traffic lands on
+    exactly its rendezvous subset — k replicas proportional to weight — so
+    per-replica quota enforcement composes into a fleet-wide bound."""
+    now = [10.0]
+    router = _tenant_router(lambda: now[0])
+    small = router._tenancy.get("small")
+    big = router._tenancy.get("big")
+
+    expected_small = set(router.tenant_subset(small))
+    expected_big = set(router.tenant_subset(big))
+    assert len(expected_small) == subset_size(small.weight, 4) == 1
+    assert len(expected_big) == subset_size(big.weight, 4) == 3
+
+    landed_small, landed_big = set(), set()
+    for _ in range(32):
+        landed_small.add(router.place(None, tenant=small)[0].name)
+        landed_big.add(router.place(None, tenant=big)[0].name)
+    assert landed_small == expected_small
+    assert landed_big <= expected_big
+    chosen = router.place(None, tenant=big)[0].name
+    assert router.affinity_result(None, chosen, tenant=big) == "tenant"
+
+    # keyless/default traffic keeps pure load-based placement
+    landed_keyless = {router.place(None)[0].name for _ in range(32)}
+    assert landed_keyless == set(router.replicas)
+    default = router._tenancy.resolve("nobody").tenant
+    assert (
+        router.affinity_result(
+            None, router.place(None, tenant=default)[0].name, tenant=default
+        )
+        == "keyless"
+    )
+
+
+async def test_tenant_subset_reforms_minimally_when_a_replica_dies():
+    """Rendezvous re-form: when a subset member dies, ONLY its slot moves —
+    to the next-ranked eligible replica — and other tenants' subsets are
+    untouched."""
+    now = [10.0]
+    router = _tenant_router(lambda: now[0])
+    small = router._tenancy.get("small")
+    ranking = rendezvous_rank("small", sorted(router.replicas))
+    home, backup = ranking[0], ranking[1]
+    assert router.place(None, tenant=small)[0].name == home
+
+    # the subset member drops out of eligibility -> the NEXT-ranked name
+    # takes its slot (not an arbitrary least-loaded replica)
+    router.replicas[home].draining = True
+    assert router.place(None, tenant=small)[0].name == backup
+    # …and recovery restores the original subset
+    router.replicas[home].draining = False
+    assert router.place(None, tenant=small)[0].name == home
+
+    # another tenant whose subset does not contain the dead replica is
+    # completely unmoved by the churn
+    big = router._tenancy.get("big")
+    before = {router.place(None, tenant=big)[0].name for _ in range(16)}
+    victim = next(n for n in router.replicas if n not in before)
+    router.replicas[victim].draining = True
+    after = {router.place(None, tenant=big)[0].name for _ in range(16)}
+    assert after <= before
+
+
+async def test_accelerator_cost_class_steers_to_capable_replicas():
+    """ISSUE 16 tentpole (a): cost_class="accelerator" submissions steer to
+    replicas whose learned cost-class mix shows accelerator capability."""
+    now = [10.0]
+    router = _tenant_router(lambda: now[0])
+    router.replicas["r2"].cost_classes = {"accelerator": 5, "cpu_light": 20}
+    for _ in range(8):
+        assert router.place(None, cost_class="accelerator")[0].name == "r2"
+        # non-accelerator work is NOT steered
+        assert {r.name for r in router.place(None)[:2]} != {"r2"}
+    # with no capability signal anywhere the order stands untouched
+    router.replicas["r2"].cost_classes = {}
+    landed = {router.place(None, cost_class="accelerator")[0].name for _ in range(16)}
+    assert len(landed) > 1
+
+
+async def test_router_retries_debit_the_tenant_retry_budget():
+    """ISSUE 16 satellite 2: cross-replica retries consult the tenant's
+    router-side retry budget — an exhausted budget ends the walk instead of
+    amplifying a retry storm through the proxy."""
+    now = [10.0]
+    router = _tenant_router(lambda: now[0])
+    small = router._tenancy.get("small")
+
+    calls = []
+
+    async def unreachable(replica, *a, **k):
+        calls.append(replica.name)
+        raise OSError("replica down")
+
+    router.call_replica = unreachable
+
+    # budget present: the walk retries across replicas as before
+    with pytest.raises(OSError):
+        await router.route_buffered(
+            "/v1/execute", "POST", "/v1/execute",
+            key=None, body=b"{}", headers={}, tenant=small,
+        )
+    assert len(calls) == router.retry_attempts
+
+    # drain the remaining budget (burst 10; 2 already spent above)
+    while router.spend_retry_budget(small):
+        pass
+    calls.clear()
+    with pytest.raises(OSError):
+        await router.route_buffered(
+            "/v1/execute", "POST", "/v1/execute",
+            key=None, body=b"{}", headers={}, tenant=small,
+        )
+    assert len(calls) == 1  # first attempt only — no budget, no retry
+    denied = router.metrics.metrics[
+        "bci_router_retry_budget_denied_total"
+    ]._values
+    assert sum(denied.values()) >= 2
+
+    # anonymous / unlimited tenants keep pre-tenancy behavior
+    calls.clear()
+    with pytest.raises(OSError):
+        await router.route_buffered(
+            "/v1/execute", "POST", "/v1/execute",
+            key=None, body=b"{}", headers={}, tenant=None,
+        )
+    assert len(calls) == router.retry_attempts
+
+
+def test_sticky_shed_recognizes_tenant_scoped_verdicts():
+    assert FleetRouter.sticky_shed(b'{"detail": "x", "reason": "tenant_quota"}')
+    assert FleetRouter.sticky_shed(b'{"detail": "x", "reason": "heavy_lane"}')
+    assert not FleetRouter.sticky_shed(b'{"detail": "x", "reason": "queue_full"}')
+    assert not FleetRouter.sticky_shed(b"not json")
+    assert not FleetRouter.sticky_shed(b"[1, 2]")
 
 
 def test_assess_router_exit_ladder():
@@ -487,6 +647,293 @@ async def test_checkpoint_is_exempt_from_the_drain_gate(tmp_path):
     finally:
         await client.aclose()
         await stack.stop()
+
+
+async def test_tenant_quota_sheds_are_never_retried_cross_replica(tmp_path):
+    """ISSUE 16 satellite 1, both transports: a ``reason="tenant_quota"``
+    429 is a per-TENANT verdict — the router must return it verbatim
+    (Retry-After intact) instead of "retrying" it into a fresh replica's
+    token bucket, which would silently multiply the tenant's quota."""
+    spec = "capped:weight=1:rps=1:burst=1"
+    shared_root = tmp_path / "shared-objects"
+    stacks = [
+        await ReplicaStack(f"r{i}", tmp_path, shared_root, tenants=spec).start()
+        for i in range(2)
+    ]
+    router = FleetRouter(
+        [(s.name, s.base_url) for s in stacks],
+        refresh_interval_s=0.2,
+        dead_after_s=0.5,
+        tenancy=TenantRegistry(parse_tenants(spec)),
+    )
+    runner = web.AppRunner(create_router_app(router))
+    await runner.setup()
+    port = free_port()
+    await web.TCPSite(runner, "127.0.0.1", port).start()
+    await router.refresh_once()
+    router.start()
+    url = f"http://127.0.0.1:{port}"
+    client = httpx.AsyncClient(timeout=30.0)
+    headers = {TENANT_HEADER: "capped"}
+    try:
+        # burn the burst-1 bucket, then hit the quota on BOTH transports
+        response = await client.post(
+            f"{url}/v1/execute",
+            json={"source_code": "print('ok')"},
+            headers=headers,
+        )
+        assert response.status_code == 200, response.text
+
+        response = await client.post(
+            f"{url}/v1/execute",
+            json={"source_code": "print('ok')"},
+            headers=headers,
+        )
+        assert response.status_code == 429, response.text
+        assert response.json()["reason"] == "tenant_quota"  # verbatim body
+        assert "Retry-After" in response.headers
+
+        async with client.stream(
+            "POST",
+            f"{url}/v1/execute",
+            params={"stream": "1"},
+            json={"source_code": "print('ok')"},
+            headers=headers,
+        ) as stream_response:
+            assert stream_response.status_code == 429
+            assert "Retry-After" in stream_response.headers
+            body = json.loads(await stream_response.aread())
+            assert body["reason"] == "tenant_quota"
+
+        # ZERO cross-replica shed retries: the verdicts were terminal
+        retries = router.metrics.metrics["bci_router_retries_total"]._values
+        assert retries.get((("reason", "shed"),), 0) == 0
+        # and only ONE replica's bucket was ever charged for the tenant
+        charged = [
+            s
+            for s in stacks
+            if "capped" in s.admission.tenant_snapshot()
+        ]
+        assert len(charged) == 1
+    finally:
+        await client.aclose()
+        await runner.cleanup()
+        await router.stop()
+        for stack in stacks:
+            await stack.stop()
+
+
+# ------------------------------------------------------- chaos 16 twin
+# Chaos scenario 16 (scripts/chaos_smoke.py): fleet-wide tenancy under a
+# router-edge kill. 3 replicas + 2 peered router edges; a keyless abuser
+# flooding 100x its fleet-wide quota through both edges is held to <= 1.2x
+# that quota; victims' p50 stays within 10% with zero sheds; one router is
+# killed mid-flood with zero lease-scoped 5xx; sheds + leases account
+# exactly once across /v1/tenants <-> wide events <-> metrics.
+
+
+async def test_chaos16_twin_fleet_tenancy_survives_router_kill(tmp_path):
+    spec = "abuser:weight=1:rps=2:burst=2,victim:weight=4"
+    shared_root = tmp_path / "shared-objects"
+    port_a, port_b = free_port(), free_port()
+    url_a = f"http://127.0.0.1:{port_a}"
+    url_b = f"http://127.0.0.1:{port_b}"
+    # each replica leases its fleet-wide quota slices from BOTH edges,
+    # preferring A — exactly the failover the kill must exercise
+    stacks = [
+        await ReplicaStack(
+            f"r{i}",
+            tmp_path,
+            shared_root,
+            tenants=spec,
+            lease_router_urls=[url_a, url_b],
+        ).start()
+        for i in range(3)
+    ]
+
+    def make_router(rid, peer_name, peer_url):
+        return FleetRouter(
+            [(s.name, s.base_url) for s in stacks],
+            refresh_interval_s=0.2,
+            dead_after_s=1.0,
+            tenancy=TenantRegistry(parse_tenants(spec)),
+            peers=[(peer_name, peer_url)],
+            quota_ttl_s=1.0,
+            router_id=rid,
+        )
+
+    router_a = make_router("A", "b", url_b)
+    router_b = make_router("B", "a", url_a)
+    runners = []
+    for router, port in ((router_a, port_a), (router_b, port_b)):
+        runner = web.AppRunner(create_router_app(router))
+        await runner.setup()
+        await web.TCPSite(runner, "127.0.0.1", port).start()
+        await router.refresh_once()
+        router.start()
+        runners.append(runner)
+    runner_a, runner_b = runners
+    client = httpx.AsyncClient(timeout=30.0)
+    abuse_statuses: list[int] = []
+    try:
+        body = {"source_code": "print('ok')"}
+
+        # --- a session created through edge A, state written
+        response = await client.post(f"{url_a}/v1/sessions", json={})
+        assert response.status_code == 200, response.text
+        session_id = response.json()["session_id"]
+        response = await client.post(
+            f"{url_a}/v1/sessions/{session_id}/execute",
+            json={"source_code": "open('state.txt', 'w').write('sixteen')"},
+        )
+        assert response.status_code == 200
+
+        async def victim_request(base_url) -> float:
+            t0 = time.perf_counter()
+            resp = await client.post(
+                f"{base_url}/v1/execute",
+                json=body,
+                headers={TENANT_HEADER: "victim"},
+            )
+            assert resp.status_code == 200, resp.text
+            return time.perf_counter() - t0
+
+        # --- victim baseline through edge B (the surviving edge)
+        baseline = []
+        for _ in range(12):
+            baseline.append(await victim_request(url_b))
+            await asyncio.sleep(0.02)
+        p50_base = statistics.median(baseline)
+
+        flood_start = time.monotonic()
+
+        async def abuse(base_url) -> None:
+            resp = await client.post(
+                f"{base_url}/v1/execute",
+                json=body,
+                headers={TENANT_HEADER: "abuser"},
+            )
+            assert resp.status_code in (200, 429), resp.text
+            abuse_statuses.append(resp.status_code)
+
+        # --- wave 1: the abuser sprays keyless across BOTH edges while
+        # the victim keeps its steady trickle through B
+        wave1 = [
+            asyncio.create_task(abuse(url_a if i % 2 else url_b))
+            for i in range(60)
+        ]
+        during = []
+        for _ in range(6):
+            during.append(await victim_request(url_b))
+            await asyncio.sleep(0.02)
+        await asyncio.gather(*wave1)
+        # give the pin/ledger gossip + lease refresh one full beat
+        await asyncio.sleep(0.5)
+
+        # --- kill edge A mid-flood
+        await runner_a.cleanup()
+        await router_a.stop()
+
+        # --- wave 2: the flood continues through the survivor
+        wave2 = [asyncio.create_task(abuse(url_b)) for i in range(60)]
+        for _ in range(6):
+            during.append(await victim_request(url_b))
+            await asyncio.sleep(0.02)
+        await asyncio.gather(*wave2)
+        elapsed = time.monotonic() - flood_start
+        p50_during = statistics.median(during)
+
+        # --- the abuser is held to <= 1.2x its FLEET-wide quota
+        admitted = sum(
+            s.admission.tenant_snapshot()
+            .get("abuser", {})
+            .get("admitted", 0)
+            for s in stacks
+        )
+        abuser = router_b._tenancy.get("abuser")
+        bound = 1.2 * (abuser.rps * elapsed + abuser.burst_depth)
+        assert abuse_statuses.count(200) == admitted
+        assert admitted <= bound, (admitted, bound, elapsed)
+        assert admitted >= 1  # the quota is enforced, not the service down
+
+        # --- victims provably untouched: p50 within 10% (+ jitter floor),
+        # ZERO victim sheds on any replica, on every ledger
+        assert p50_during <= p50_base * 1.10 + 0.01, (p50_base, p50_during)
+        for stack in stacks:
+            snapshot = stack.admission.tenant_snapshot()
+            assert snapshot.get("victim", {}).get("sheds", {}) == {}
+            assert (
+                stack.recorder.events(outcome="shed", tenant="victim") == []
+            )
+
+        # --- zero lease-scoped 5xx: the session created through the DEAD
+        # edge keeps serving through the survivor (pins gossiped), state
+        # intact, same public id
+        response = await client.post(
+            f"{url_b}/v1/sessions/{session_id}/execute",
+            json={"source_code": "print(open('state.txt').read())"},
+        )
+        assert response.status_code == 200, response.text
+        assert "sixteen" in response.json()["stdout"]
+        assert response.json()["session_id"] == session_id
+
+        # --- the survivor noticed the dead peer (operator signal), and
+        # its ledger holds the reconciled lease state
+        assert router_b.peers["a"].failures >= 1
+        ledger = router_b.ledger.snapshot()
+        assert "abuser" in ledger["tenants"]
+        lessees = set(ledger["tenants"]["abuser"]["lessees"])
+        assert len(lessees) == 1  # single-subset tenant: ONE lessee
+        # the lessee replica holds a live lease for its FULL fleet slice
+        lessee_stack = next(s for s in stacks if s.name in lessees)
+        lease = lessee_stack.quota_leases.lease("abuser")
+        assert lease is not None
+        assert lease.rps == pytest.approx(abuser.rps)
+        # replicas the abuser never reached never claimed a slice
+        for stack in stacks:
+            if stack.name not in lessees:
+                assert stack.quota_leases.lease("abuser") is None
+
+        # --- sticky sheds: no tenant_quota verdict was ever re-walked
+        retries_b = router_b.metrics.metrics[
+            "bci_router_retries_total"
+        ]._values
+        assert retries_b.get((("reason", "shed"),), 0) == 0
+
+        # --- exactly-once shed accounting across the three surfaces,
+        # summed over the fleet: admission snapshot <-> tenant usage
+        # (/v1/tenants) <-> wide events <-> bci_tenant_shed_total
+        total_sheds = 0
+        for stack in stacks:
+            lane = stack.admission.tenant_snapshot().get("abuser")
+            sheds = sum((lane or {}).get("sheds", {}).values())
+            total_sheds += sheds
+            wide = stack.recorder.events(
+                outcome="shed", tenant="abuser", limit=10_000
+            )
+            assert len(wide) == sheds
+            counter = sum(
+                v
+                for key, v in stack.metrics.metrics["bci_tenant_shed_total"]
+                ._values.items()
+                if ("tenant", "abuser") in key
+            )
+            assert counter == sheds
+            tenants_doc = (
+                await client.get(f"{stack.base_url}/v1/tenants")
+            ).json()
+            usage = tenants_doc["tenants"].get("abuser", {}).get("usage")
+            if usage is not None:
+                assert usage["sheds"] == sheds
+        assert total_sheds == abuse_statuses.count(429)
+        assert admitted + total_sheds == len(abuse_statuses)
+    finally:
+        await client.aclose()
+        await runner_b.cleanup()
+        await router_b.stop()
+        await router_a.stop()
+        for stack in stacks:
+            await stack.stop()
 
 
 async def test_drain_endpoint_cordons_and_migrates(tmp_path):

@@ -34,6 +34,10 @@ def build_service_registry(tmp_path) -> Registry:
             otlp_endpoint="http://127.0.0.1:4318",
             slo_availability=99.5,
             slo_latency_ms="2000:99",
+            # fleet-wide tenancy (ISSUE 16): a lease client wires the
+            # replica-side bci_quota_lease_* surface (never started here)
+            tenants="alpha:weight=2:rps=5",
+            quota_lease_urls="http://127.0.0.1:1",
         )
     )
     _ = ctx.code_executor  # registers executor, breaker, pool, fallback
@@ -68,9 +72,15 @@ def register_router_metrics(registry: Registry) -> None:
     import asyncio
 
     from bee_code_interpreter_tpu.fleet import FleetRouter
+    from bee_code_interpreter_tpu.tenancy import TenantRegistry, parse_tenants
 
     router = FleetRouter(
-        [("r0", "http://127.0.0.1:1")], metrics=registry
+        [("r0", "http://127.0.0.1:1")],
+        metrics=registry,
+        # fleet-wide tenancy (ISSUE 16): a declared tenant table + a peer
+        # edge register the quota-ledger and gossip families too
+        tenancy=TenantRegistry(parse_tenants("alpha:weight=2:rps=5")),
+        peers=[("p1", "http://127.0.0.1:2")],
     )
     asyncio.run(router.stop())
 
@@ -177,6 +187,16 @@ def test_every_registered_metric_follows_conventions(tmp_path):
         "bci_router_lease_migrations_total",
         "bci_router_replicas",
         "bci_router_pinned_sessions",
+        # fleet-wide tenancy (ISSUE 16): router-held quota-lease ledger,
+        # peer gossip, tenant retry budgets, and the replica-side lease
+        # client's refresh/fleet-size surface
+        "bci_router_quota_leases_total",
+        "bci_router_quota_active_leases",
+        "bci_router_peer_sync_total",
+        "bci_router_peer_up",
+        "bci_router_retry_budget_denied_total",
+        "bci_quota_lease_refresh_total",
+        "bci_quota_lease_fleet_size",
     ):
         assert required in metrics, f"{required}: not registered by the wiring"
     assert isinstance(metrics["bci_pool_spawn_seconds"], Histogram)
@@ -231,6 +251,15 @@ def test_every_registered_metric_follows_conventions(tmp_path):
     assert isinstance(metrics["bci_router_request_seconds"], Histogram)
     assert isinstance(metrics["bci_router_lease_migrations_total"], Counter)
     assert isinstance(metrics["bci_router_replicas"], Gauge)
+    assert isinstance(metrics["bci_router_quota_leases_total"], Counter)
+    assert isinstance(metrics["bci_router_quota_active_leases"], Gauge)
+    assert isinstance(metrics["bci_router_peer_sync_total"], Counter)
+    assert isinstance(metrics["bci_router_peer_up"], Gauge)
+    assert isinstance(
+        metrics["bci_router_retry_budget_denied_total"], Counter
+    )
+    assert isinstance(metrics["bci_quota_lease_refresh_total"], Counter)
+    assert isinstance(metrics["bci_quota_lease_fleet_size"], Gauge)
 
     for name, metric in metrics.items():
         assert name.startswith("bci_"), (

@@ -1,0 +1,427 @@
+"""Fleet-wide quota-lease protocol units (ISSUE 16, docs/fleet.md
+"Fleet-wide tenancy"): the router-side ledger (grant/split/expiry/merge),
+the replica-side cache (lease-capped enforcement, the fail-SAFE 1/N
+fallback on partition), the refresh client's router failover, and the
+admission controller enforcing LEASED slices instead of full local quotas.
+Everything runs on a ManualClock — no sleeps, no wall-clock flake."""
+
+import asyncio
+
+import pytest
+
+from bee_code_interpreter_tpu.fleet.tenancy_plane import (
+    QuotaLedger,
+    RetryBudget,
+    rendezvous_rank,
+    subset_size,
+)
+from bee_code_interpreter_tpu.resilience import (
+    AdmissionController,
+    AdmissionRejected,
+)
+from bee_code_interpreter_tpu.tenancy import (
+    QuotaLeaseCache,
+    QuotaLeaseClient,
+    TenantRegistry,
+    parse_tenants,
+)
+from bee_code_interpreter_tpu.utils.metrics import Registry
+
+
+class ManualClock:
+    def __init__(self, start: float = 1000.0) -> None:
+        self.now = start
+
+    def __call__(self) -> float:
+        return self.now
+
+    def advance(self, dt: float) -> None:
+        self.now += dt
+
+
+def _registry() -> TenantRegistry:
+    return TenantRegistry(
+        parse_tenants("alpha:weight=4:rps=20:burst=10,beta:rps=5,free:weight=2")
+    )
+
+
+# ------------------------------------------------------------- rendezvous
+
+
+def test_rendezvous_rank_is_deterministic_and_minimally_disruptive():
+    names = [f"r{i}" for i in range(5)]
+    ranked = rendezvous_rank("alpha", names)
+    assert sorted(ranked) == sorted(names)
+    # pure function of the names: every router edge agrees
+    assert rendezvous_rank("alpha", list(reversed(names))) == ranked
+    # removing one name never reorders the others
+    survivor_rank = rendezvous_rank("alpha", [n for n in names if n != ranked[0]])
+    assert survivor_rank == ranked[1:]
+    # different tenants get (generally) different orders
+    assert any(
+        rendezvous_rank(t, names) != ranked for t in ("beta", "gamma", "delta")
+    )
+
+
+def test_subset_size_is_weight_proportional_and_clamped():
+    assert subset_size(1.0, 5) == 1
+    assert subset_size(4.0, 5) == 4
+    assert subset_size(2.5, 5) == 3  # ceil
+    assert subset_size(100.0, 3) == 3  # never beyond the fleet
+    assert subset_size(0.0, 3) == 1  # never zero
+
+
+# ----------------------------------------------------------------- ledger
+
+
+def test_ledger_splits_equally_over_active_lessees():
+    clock = ManualClock()
+    ledger = QuotaLedger(_registry(), ttl_s=3.0, clock=clock)
+
+    # first lessee: the full declared quota
+    leases = ledger.grant("r0", ["alpha"])
+    assert leases["alpha"]["rps"] == 20.0
+    assert leases["alpha"]["burst"] == 10.0
+    assert leases["alpha"]["ttl_s"] == 3.0
+
+    # second lessee: the split halves — fleet-wide sum == declared quota
+    assert ledger.grant("r1", ["alpha"])["alpha"]["rps"] == 10.0
+    # ...and the first lessee converges on ITS next refresh
+    assert ledger.grant("r0", ["alpha"])["alpha"]["rps"] == 10.0
+    assert ledger.active_count() == 2
+
+    # an expired lessee leaves the split
+    clock.advance(2.0)
+    ledger.grant("r0", ["alpha"])  # r0 renews at t+2, r1 does not
+    clock.advance(1.5)  # r1's lease (t0+3) is now past
+    assert ledger.grant("r0", ["alpha"])["alpha"]["rps"] == 20.0
+    assert ledger.active_count() == 1
+
+
+def test_ledger_skips_unknown_and_unlimited_tenants():
+    ledger = QuotaLedger(_registry(), clock=ManualClock())
+    leases = ledger.grant("r0", ["alpha", "free", "ghost"])
+    assert set(leases) == {"alpha"}  # free has no rps; ghost is undeclared
+    # no registry at all: every grant is honestly empty
+    bare = QuotaLedger(None, clock=ManualClock())
+    assert bare.grant("r0", ["alpha"]) == {}
+
+
+def test_ledger_export_merge_reconciles_peers():
+    clock = ManualClock()
+    a = QuotaLedger(_registry(), ttl_s=3.0, clock=clock)
+    b = QuotaLedger(_registry(), ttl_s=3.0, clock=clock)
+    a.grant("r0", ["alpha"])
+    a.grant("r1", ["alpha", "beta"])
+    b.grant("r2", ["alpha"])
+
+    # B pulls A's ledger: it now knows every lessee A granted to, so its
+    # next grant splits over the FULL set instead of re-issuing quota —
+    # the reconciliation that bounds double-issue to one TTL of skew.
+    merged = b.merge(a.export())
+    assert merged == 3  # (alpha,r0) (alpha,r1) (beta,r1)
+    assert b.grant("r2", ["alpha"])["alpha"]["rps"] == pytest.approx(20 / 3)
+
+    # merge is max-expiry-wins and idempotent for fresher local state
+    assert b.merge(a.export()) == 0
+    # garbage peers are ignored, not fatal
+    assert b.merge({"alpha": "nope"}) == 0
+    assert b.merge("garbage") == 0
+    # a peer cannot extend a lease beyond the local TTL cap
+    b2 = QuotaLedger(_registry(), ttl_s=3.0, clock=clock)
+    b2.merge({"alpha": {"r9": 9999.0}})
+    snap = b2.snapshot()
+    assert snap["tenants"]["alpha"]["lessees"]["r9"] <= 3.0
+
+
+def test_ledger_snapshot_is_operator_readable():
+    clock = ManualClock()
+    ledger = QuotaLedger(_registry(), ttl_s=3.0, clock=clock)
+    ledger.grant("r0", ["alpha"])
+    ledger.grant("r1", ["alpha"])
+    snap = ledger.snapshot()
+    assert snap["tenants"]["alpha"]["rps"] == 20.0
+    assert snap["tenants"]["alpha"]["slice_rps"] == 10.0
+    assert set(snap["tenants"]["alpha"]["lessees"]) == {"r0", "r1"}
+    assert snap["granted_total"] == 2
+
+
+# ------------------------------------------------------------------ cache
+
+
+def test_cache_enforces_leased_slice_and_expires_to_fallback():
+    clock = ManualClock()
+    cache = QuotaLeaseCache(clock=clock)
+    alpha = _registry().get("alpha")
+
+    # no lease ever seen, fleet size unknown (hint 1): full local quota —
+    # the standalone replica behaves exactly as before the fleet tier
+    assert cache.effective(alpha) == (20.0, 10.0)
+
+    cache.update("alpha", rps=10.0, burst=5.0, ttl_s=3.0, router="A")
+    cache.observe_fleet_size(4)
+    assert cache.effective(alpha) == (10.0, 5.0)
+
+    # lease expiry degrades to the 1/N split over the LAST KNOWN fleet
+    # size — tighter than the lease, never open
+    clock.advance(3.1)
+    assert cache.lease("alpha") is None
+    assert cache.effective(alpha) == (5.0, 2.5)
+    assert cache.fallbacks == 2  # the pre-lease answer was a fallback too
+
+
+def test_quota_fails_safe_never_unlimited_on_partition():
+    """The dedicated partition fail-safe (ISSUE 16 acceptance): with every
+    router unreachable, enforcement degrades to a LOCAL 1/N split — never
+    unlimited, and a buggy/malicious router grant can tighten the quota
+    but never widen it past the tenant's own declared numbers."""
+    clock = ManualClock()
+    cache = QuotaLeaseCache(fleet_size_hint=3, clock=clock)
+    alpha = _registry().get("alpha")
+
+    # partitioned from birth: 1/N of the DECLARED quota, not infinity
+    rps, burst = cache.effective(alpha)
+    assert rps == pytest.approx(20.0 / 3)
+    assert 1.0 <= burst <= alpha.burst_depth
+
+    # an over-generous (buggy router) lease is capped at the declared quota
+    cache.update("alpha", rps=1e9, burst=1e9, ttl_s=3.0)
+    assert cache.effective(alpha) == (20.0, 10.0)
+
+    # partition after convergence: fallback uses the learned fleet size
+    cache.observe_fleet_size(5)
+    clock.advance(10.0)
+    rps, burst = cache.effective(alpha)
+    assert rps == pytest.approx(4.0)
+    assert rps <= alpha.rps
+    # burst never collapses below one admission
+    tiny = QuotaLeaseCache(fleet_size_hint=100, clock=clock)
+    assert tiny.effective(alpha)[1] >= 1.0
+
+
+# ------------------------------------------------- admission x lease cache
+
+
+def test_admission_enforces_leased_slice_with_manual_clock():
+    clock = ManualClock()
+    registry = _registry()
+    cache = QuotaLeaseCache(clock=clock)
+    admission = AdmissionController(
+        max_in_flight=100,
+        max_queue=100,
+        tenancy=registry,
+        quota_leases=cache,
+        clock=clock,
+    )
+
+    alpha = registry.get("alpha")
+
+    async def spend_until_shed(limit=1000) -> int:
+        admitted = 0
+        for _ in range(limit):
+            try:
+                async with admission.admit(tenant=alpha):
+                    admitted += 1
+            except AdmissionRejected as e:
+                assert e.reason == "tenant_quota"
+                return admitted
+        raise AssertionError("never shed")
+
+    async def run() -> None:
+        # leased slice: 2 rps / burst 2 of the declared 20/10
+        cache.update("alpha", rps=2.0, burst=2.0, ttl_s=5.0)
+        assert await spend_until_shed() == 2  # the leased burst, not 10
+        # refill happens at the LEASED rate: +1 token after 0.5 s
+        clock.advance(0.5)
+        assert await spend_until_shed() == 1
+        # the lease expires mid-traffic -> 1/N fallback over the learned
+        # fleet size, still never the full local quota
+        cache.observe_fleet_size(2)
+        clock.advance(10.0)  # lease gone; 10 s * (20/2 rps) but burst caps
+        assert cache.lease("alpha") is None
+        admitted = await spend_until_shed()
+        assert 1 <= admitted <= registry.get("alpha").burst_depth / 2
+        # the tenant snapshot exposes the effective (degraded) quota
+        quota = admission.tenant_snapshot()["alpha"]["quota"]
+        assert quota["leased"] is False
+        assert quota["effective_rps"] == pytest.approx(10.0)
+
+    asyncio.run(run())
+
+
+def test_quota_tenants_lists_only_rate_quota_lanes():
+    clock = ManualClock()
+    registry = _registry()
+    admission = AdmissionController(
+        max_in_flight=8, tenancy=registry, clock=clock
+    )
+
+    async def run() -> None:
+        assert admission.quota_tenants() == []  # no lanes yet
+        for tid in ("alpha", "free", "nobody"):
+            async with admission.admit(tenant=registry.resolve(tid)):
+                pass
+        # alpha has rps; free does not; "nobody" collapses into default
+        assert admission.quota_tenants() == ["alpha"]
+
+    asyncio.run(run())
+
+
+# ------------------------------------------------------------ lease client
+
+
+class _FakeLeaseResponse:
+    def __init__(self, status: int, doc: dict) -> None:
+        self.status = status
+        self._doc = doc
+
+    async def json(self) -> dict:
+        return self._doc
+
+    async def __aenter__(self) -> "_FakeLeaseResponse":
+        return self
+
+    async def __aexit__(self, *exc) -> None:
+        return None
+
+
+class _FakeHttpClient:
+    """aiohttp-shaped POST stub: per-URL scripted answers (an Exception
+    means unreachable)."""
+
+    def __init__(self, answers: dict) -> None:
+        self.answers = answers
+        self.calls: list[str] = []
+        self.closed = False
+
+    def post(self, url: str, **kwargs):
+        self.calls.append(url)
+        answer = self.answers[url.removesuffix("/v1/fleet/quota/lease")]
+        if isinstance(answer, Exception):
+            raise answer
+        return answer
+
+    async def close(self) -> None:
+        self.closed = True
+
+
+class _FakeAdmission:
+    def __init__(self, tenants: list[str]) -> None:
+        self._tenants = tenants
+
+    def quota_tenants(self) -> list[str]:
+        return self._tenants
+
+
+def test_lease_client_fails_over_and_applies_grants():
+    clock = ManualClock()
+    cache = QuotaLeaseCache(clock=clock)
+    metrics = Registry()
+    grant = {
+        "router": "B",
+        "fleet_size": 3,
+        "leases": {"alpha": {"rps": 10.0, "burst": 5.0, "ttl_s": 3.0}},
+    }
+    http = _FakeHttpClient(
+        {
+            "http://a": OSError("connection refused"),
+            "http://b": _FakeLeaseResponse(200, grant),
+        }
+    )
+    client = QuotaLeaseClient(
+        cache,
+        _FakeAdmission(["alpha"]),
+        replica="r0",
+        router_urls=["http://a", "http://b"],
+        metrics=metrics,
+        http_client=http,
+    )
+
+    async def run() -> None:
+        assert await client.refresh_once() is True
+        lease = cache.lease("alpha")
+        assert lease is not None and lease.rps == 10.0 and lease.router == "B"
+        assert cache.fleet_size == 3
+        # failover is sticky: the next refresh goes straight to B
+        assert await client.refresh_once() is True
+        assert http.calls[-1].startswith("http://b")
+        assert http.calls.count("http://a/v1/fleet/quota/lease") == 1
+        refresh = metrics.metrics["bci_quota_lease_refresh_total"]._values
+        assert refresh[(("outcome", "ok"),)] == 2
+        await client.stop()
+        assert http.closed
+
+    asyncio.run(run())
+
+
+def test_lease_client_total_unreachability_is_not_an_error():
+    clock = ManualClock()
+    cache = QuotaLeaseCache(fleet_size_hint=2, clock=clock)
+    metrics = Registry()
+    http = _FakeHttpClient(
+        {"http://a": OSError("down"), "http://b": OSError("down")}
+    )
+    client = QuotaLeaseClient(
+        cache,
+        _FakeAdmission(["alpha"]),
+        replica="r0",
+        router_urls=["http://a", "http://b"],
+        metrics=metrics,
+        http_client=http,
+    )
+    alpha = _registry().get("alpha")
+
+    async def run() -> None:
+        assert await client.refresh_once() is False
+        refresh = metrics.metrics["bci_quota_lease_refresh_total"]._values
+        assert refresh[(("outcome", "unreachable"),)] == 1
+        # the data plane never sees the failure: enforcement degrades to
+        # the 1/N split, tighter than any lease — never open
+        assert cache.effective(alpha) == (10.0, 5.0)
+        await client.stop()
+
+    asyncio.run(run())
+
+
+def test_lease_client_ignores_malformed_grants():
+    clock = ManualClock()
+    cache = QuotaLeaseCache(clock=clock)
+    doc = {
+        "router": "A",
+        "fleet_size": "not-a-number",
+        "leases": {
+            "alpha": {"rps": 10.0, "burst": 5.0, "ttl_s": 3.0},
+            "beta": {"rps": "garbage"},
+            "gamma": None,
+        },
+    }
+    http = _FakeHttpClient({"http://a": _FakeLeaseResponse(200, doc)})
+    client = QuotaLeaseClient(
+        cache,
+        _FakeAdmission(["alpha", "beta", "gamma"]),
+        replica="r0",
+        router_urls=["http://a"],
+        http_client=http,
+    )
+
+    async def run() -> None:
+        assert await client.refresh_once() is True
+        assert cache.lease("alpha") is not None  # good grant applied
+        assert cache.lease("beta") is None  # malformed ones skipped
+        assert cache.fleet_size == 1  # bogus fleet size ignored
+        await client.stop()
+
+    asyncio.run(run())
+
+
+# ------------------------------------------------------ router retry budget
+
+
+def test_router_retry_budget_caps_and_refills():
+    clock = ManualClock()
+    budget = RetryBudget(20.0, clock=clock)  # 10% of 20 rps = 2/s, burst 10
+    assert sum(budget.spend() for _ in range(15)) == 10
+    assert budget.denied == 5
+    clock.advance(1.0)
+    assert budget.spend() and budget.spend()
+    assert not budget.spend()
