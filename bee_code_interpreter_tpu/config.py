@@ -325,6 +325,12 @@ class Config(BaseModel):
     router_dead_after_s: float = Field(default=10.0, gt=0)
     # Routing/migration wide events retained in the router's ring.
     router_events_max: int = Field(default=1024, ge=1)
+    # Per-replica deadline for federated fleet queries (the router-side
+    # scatter-gather behind GET /v1/slo, /v1/traces, /v1/events,
+    # /v1/tenants and /v1/fleet/debug/bundle — docs/fleet.md "Fleet
+    # observability"). A replica slower than this is accounted in
+    # `replicas_failed`, never waited out.
+    router_federation_timeout_s: float = Field(default=2.0, gt=0)
     # --- fleet-wide tenancy (new; see docs/fleet.md "Fleet-wide tenancy") ---
     # Peer router edges for HA, comma-separated base URLs (optionally
     # named, same spelling as APP_ROUTER_REPLICAS). Peers gossip session

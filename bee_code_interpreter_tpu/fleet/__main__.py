@@ -51,6 +51,32 @@ async def main() -> None:
     router = FleetRouter.from_config(config)
     router.start()
 
+    # Telemetry export: the router edge pushes its OWN traces (the routed
+    # data plane's span trees) and wide events (routing/migration journal)
+    # to the same APP_OTLP_ENDPOINT collector the replicas use — the
+    # distributed trace arrives from both ends and stitches by trace_id.
+    exporter = None
+    if config.otlp_endpoint:
+        from bee_code_interpreter_tpu.observability import TelemetryExporter
+        from bee_code_interpreter_tpu.resilience import RetryPolicy
+
+        exporter = TelemetryExporter(
+            config.otlp_endpoint,
+            router.metrics,
+            flush_interval_s=config.otlp_flush_interval_s,
+            queue_max=config.otlp_queue_max,
+            batch_max=config.otlp_batch_max,
+            retry=RetryPolicy(
+                attempts=config.otlp_retry_attempts,
+                wait_min_s=config.otlp_retry_wait_min_s,
+                wait_max_s=config.otlp_retry_wait_max_s,
+            ),
+            timeout_s=config.otlp_timeout_s,
+        )
+        router.tracer.add_sink(exporter.enqueue_trace)
+        router.recorder.add_sink(exporter.enqueue_log)
+        exporter.start()
+
     host, _, port = config.router_listen_addr.rpartition(":")
     runner = web.AppRunner(create_router_app(router), shutdown_timeout=3.0)
     await runner.setup()
@@ -69,6 +95,8 @@ async def main() -> None:
 
     logger.info("Shutting down fleet router")
     await runner.cleanup()
+    if exporter is not None:
+        await exporter.stop()
     await router.stop()
 
 

@@ -18,8 +18,20 @@ tests (``create_router_app(FleetRouter([...]))``). Proxied surface:
   quota (docs/fleet.md "Fleet-wide tenancy").
 - ``GET /v1/fleet/peer`` — the router-HA gossip exchange: session pins +
   the quota-lease ledger, pulled by peer router edges (APP_ROUTER_PEERS).
-- ``GET /v1/events`` — the router's own wide events (``kind="routing"`` /
-  ``"lease_migrate"``); ``GET /healthz``; ``GET /metrics``.
+- Federated fleet observability (docs/observability.md "Fleet
+  observability"): ``GET /v1/traces`` / ``/v1/traces/{id}`` /
+  ``/v1/events`` (``?follow=1`` SSE-tails the router's own journal) /
+  ``/v1/slo`` / ``/v1/tenants`` scatter-gather the live replicas and merge
+  with the router's own stores, every response carrying
+  ``replicas_reporting``/``replicas_failed`` partial-result accounting;
+  ``GET /v1/fleet/debug/bundle`` is the one-call fleet incident snapshot.
+- ``GET /healthz``; ``GET /metrics``.
+
+Every response carries ``X-Request-Id`` (the router's own id for this
+request) and — on the traced data plane — ``X-Trace-Id``, the distributed
+trace the router rooted (or continued from the client's ``traceparent``)
+and propagated to the chosen replica, so an error or shed answer is always
+one federated ``GET /v1/traces/{id}`` away from its full span tree.
 
 Status contract at this edge: 503 + Retry-After when no replica is
 eligible, 502 when every attempt died in transport, 404 for session ids the
@@ -48,14 +60,27 @@ from bee_code_interpreter_tpu.fleet.router import (
     NoReplicasAvailable,
     UnknownRouterSession,
 )
+from bee_code_interpreter_tpu.observability import event_matches
+from bee_code_interpreter_tpu.observability.tracing import (
+    REQUEST_ID_HEADER,
+    current_trace,
+    parse_traceparent,
+    span,
+)
 from bee_code_interpreter_tpu.resilience import BreakerOpenError
 from bee_code_interpreter_tpu.utils.metrics import (
     OPENMETRICS_CONTENT_TYPE,
     PROMETHEUS_CONTENT_TYPE,
     accepts_openmetrics,
 )
+from bee_code_interpreter_tpu.utils.request_id import new_request_id
 
 logger = logging.getLogger(__name__)
+
+#: The distributed-trace correlation handle on every traced router
+#: response (docs/observability.md "Fleet observability"): feed it to the
+#: federated ``GET /v1/traces/{id}`` for the full router+replica span tree.
+TRACE_ID_HEADER = "X-Trace-Id"
 
 
 def _key_from_body(raw: bytes) -> str | None:
@@ -127,6 +152,60 @@ def create_router_app(router: FleetRouter) -> web.Application:
     app = web.Application(client_max_size=1 << 30)
     clock = time.monotonic
 
+    @web.middleware
+    async def trace_middleware(request: web.Request, handler):
+        """The router edge's twin of the replica's request_id middleware:
+        one request id per inbound request, one TRACE per routed data-plane
+        request (continuing the client's ``traceparent`` when one came in),
+        and the correlation headers on EVERY response — success, shed, 502,
+        404, all of them."""
+        rid = new_request_id()
+        # Label by the *matched* route template, never the raw path (raw
+        # paths are attacker-controlled — unbounded trace-name cardinality).
+        match_info = request.match_info
+        resource = match_info.route.resource if match_info is not None else None
+        route = resource.canonical if resource is not None else "unmatched"
+        # Trace the proxied data plane only (the replica edge's rule, plus
+        # the pinned DELETE): the federated GET surface, /healthz and
+        # /metrics must not drown the store in self-traffic.
+        traced = (
+            request.method in ("POST", "DELETE")
+            and route.startswith("/v1/")
+            and not route.startswith("/v1/fleet/")
+        )
+        inbound = (
+            parse_traceparent(request.headers.get("traceparent"))
+            if traced
+            else None
+        )
+        trace_id = None
+        try:
+            if traced:
+                with router.tracer.trace(
+                    route,
+                    trace_id=inbound[0] if inbound else None,
+                    parent_span_id=inbound[1] if inbound else None,
+                    request_id=rid,
+                ) as trace:
+                    trace_id = trace.trace_id
+                    response = await handler(request)
+            else:
+                response = await handler(request)
+        except web.HTTPException as e:
+            e.headers.setdefault(REQUEST_ID_HEADER, rid)
+            if trace_id is not None:
+                e.headers.setdefault(TRACE_ID_HEADER, trace_id)
+            raise
+        if not response.prepared:
+            # A committed SSE stream already carries these (set by the pump
+            # before prepare; headers are spent once sent).
+            response.headers[REQUEST_ID_HEADER] = rid
+            if trace_id is not None:
+                response.headers[TRACE_ID_HEADER] = trace_id
+        return response
+
+    app.middlewares.append(trace_middleware)
+
     # ------------------------------------------------------ routed proxying
 
     async def _proxy_routed(
@@ -164,6 +243,7 @@ def create_router_app(router: FleetRouter) -> web.Application:
                 replica=None,
                 key=key,
                 duration_s=clock() - start,
+                tenant=tenant,
             )
             return _no_replicas(e)
         except asyncio.CancelledError:
@@ -175,6 +255,7 @@ def create_router_app(router: FleetRouter) -> web.Application:
                 replica=None,
                 key=key,
                 duration_s=clock() - start,
+                tenant=tenant,
             )
             logger.warning("All replica attempts failed for %s: %s", route, e)
             return web.json_response(
@@ -192,6 +273,7 @@ def create_router_app(router: FleetRouter) -> web.Application:
             ),
             retries=retries,
             duration_s=clock() - start,
+            tenant=tenant,
         )
         return _upstream_response(response)
 
@@ -209,6 +291,7 @@ def create_router_app(router: FleetRouter) -> web.Application:
         key: str | None = None,
         affinity: str | None = None,
         session: str | None = None,
+        tenant=None,
         retries: int,
         start: float,
     ) -> web.StreamResponse:
@@ -217,22 +300,32 @@ def create_router_app(router: FleetRouter) -> web.Application:
         run, the response status is spent: failures here are terminal —
         never retried on another replica, never re-accounted by a caller
         (only a CancelledError escapes, already recorded)."""
+        # The middleware can't stamp a prepared stream, so the correlation
+        # headers ride the first (only) header flush here.
+        corr: dict[str, str] = {}
+        trace = current_trace()
+        if trace is not None:
+            corr[TRACE_ID_HEADER] = trace.trace_id
+            if trace.request_id:
+                corr[REQUEST_ID_HEADER] = trace.request_id
         response = web.StreamResponse(
             status=upstream.status_code,
             headers={
                 **upstream.passthrough_headers("text/event-stream"),
                 "Cache-Control": "no-store",
                 "X-Accel-Buffering": "no",
+                **corr,
             },
         )
         response.enable_chunked_encoding()
         outcome = "error"
         try:
-            await response.prepare(request)
-            async for chunk in upstream.aiter_bytes():
-                await response.write(chunk)
-            await response.write_eof()
-            outcome = "ok"
+            with span("sse_pump", replica=replica):
+                await response.prepare(request)
+                async for chunk in upstream.aiter_bytes():
+                    await response.write(chunk)
+                await response.write_eof()
+                outcome = "ok"
             return response
         except asyncio.CancelledError:
             outcome = "cancelled"
@@ -255,6 +348,7 @@ def create_router_app(router: FleetRouter) -> web.Application:
                 retries=retries,
                 duration_s=clock() - start,
                 session=session,
+                tenant=tenant,
             )
 
     async def _stream_routed(
@@ -289,6 +383,7 @@ def create_router_app(router: FleetRouter) -> web.Application:
                     key=key,
                     retries=retries,
                     duration_s=clock() - start,
+                    tenant=tenant,
                 )
                 return _no_replicas(e)
             try:
@@ -325,6 +420,7 @@ def create_router_app(router: FleetRouter) -> web.Application:
                             key=key,
                             retries=retries,
                             duration_s=clock() - start,
+                            tenant=tenant,
                         )
                         return web.Response(
                             body=body,
@@ -340,6 +436,7 @@ def create_router_app(router: FleetRouter) -> web.Application:
                         affinity=router.affinity_result(
                             key, replica.name, tenant=tenant
                         ),
+                        tenant=tenant,
                         retries=retries,
                         start=start,
                     )
@@ -371,6 +468,7 @@ def create_router_app(router: FleetRouter) -> web.Application:
                 key=key,
                 retries=retries,
                 duration_s=clock() - start,
+                tenant=tenant,
             )
             return web.Response(
                 body=body, status=status, headers=verdict_headers
@@ -382,6 +480,7 @@ def create_router_app(router: FleetRouter) -> web.Application:
             key=key,
             retries=retries,
             duration_s=clock() - start,
+            tenant=tenant,
         )
         return web.json_response(
             {"detail": "all replica attempts failed"}, status=502
@@ -444,6 +543,7 @@ def create_router_app(router: FleetRouter) -> web.Application:
                 replica=None,
                 key=key,
                 duration_s=clock() - start,
+                tenant=tenant,
             )
             return _no_replicas(e)
         except asyncio.CancelledError:
@@ -455,6 +555,7 @@ def create_router_app(router: FleetRouter) -> web.Application:
                 replica=None,
                 key=key,
                 duration_s=clock() - start,
+                tenant=tenant,
             )
             return web.json_response(
                 {"detail": "all replica attempts failed"}, status=502
@@ -477,6 +578,7 @@ def create_router_app(router: FleetRouter) -> web.Application:
             retries=retries,
             duration_s=clock() - start,
             session=session_id,
+            tenant=tenant,
         )
         return _upstream_response(response)
 
@@ -498,6 +600,7 @@ def create_router_app(router: FleetRouter) -> web.Application:
         request: web.Request, route: str, method: str, suffix: str
     ) -> web.StreamResponse:
         session_id = request.match_info["session_id"]
+        tenant = router.resolve_tenant(request.headers)
         start = clock()
         try:
             session = router.get_session(session_id)
@@ -508,6 +611,7 @@ def create_router_app(router: FleetRouter) -> web.Application:
                 replica=None,
                 session=session_id,
                 duration_s=clock() - start,
+                tenant=tenant,
             )
             return web.json_response({"detail": str(e)}, status=404)
         raw = await request.read()
@@ -524,7 +628,7 @@ def create_router_app(router: FleetRouter) -> web.Application:
                     # migration must wait out the in-flight REPL turn).
                     return await _pinned_stream(
                         request, route, session, replica, path, raw,
-                        headers, params, start,
+                        headers, params, start, tenant,
                     )
                 response = await router.call_replica(
                     replica, method, path, body=raw, headers=headers, params=params
@@ -538,6 +642,7 @@ def create_router_app(router: FleetRouter) -> web.Application:
                     replica=session.replica,
                     session=session_id,
                     duration_s=clock() - start,
+                    tenant=tenant,
                 )
                 logger.warning(
                     "Pinned session call to %s failed: %s", session.replica, e
@@ -580,6 +685,7 @@ def create_router_app(router: FleetRouter) -> web.Application:
                             session=session_id,
                             retries=retries,
                             duration_s=clock() - start,
+                            tenant=tenant,
                         )
                         return web.json_response(
                             {"detail": "leasing replica unreachable"},
@@ -598,6 +704,7 @@ def create_router_app(router: FleetRouter) -> web.Application:
                 session=session_id,
                 retries=retries,
                 duration_s=clock() - start,
+                tenant=tenant,
             )
             return web.Response(
                 body=_public_body(response, session),
@@ -606,7 +713,8 @@ def create_router_app(router: FleetRouter) -> web.Application:
             )
 
     async def _pinned_stream(
-        request, route, session, replica, path, raw, headers, params, start
+        request, route, session, replica, path, raw, headers, params, start,
+        tenant=None,
     ) -> web.StreamResponse:
         """Pinned SSE: no cross-replica retry ever; the pump owns the
         accounting once the stream is committed. Failures OPENING the
@@ -625,6 +733,7 @@ def create_router_app(router: FleetRouter) -> web.Application:
                     replica=session.replica,
                     session=session.public_id,
                     duration_s=clock() - start,
+                    tenant=tenant,
                 )
                 return web.Response(
                     body=body,
@@ -637,6 +746,7 @@ def create_router_app(router: FleetRouter) -> web.Application:
                 upstream,
                 replica=session.replica,
                 session=session.public_id,
+                tenant=tenant,
                 retries=0,
                 start=start,
             )
@@ -717,7 +827,58 @@ def create_router_app(router: FleetRouter) -> web.Application:
             )
         return web.json_response({"replica": name, **tally})
 
-    async def events(request: web.Request) -> web.Response:
+    # --------------------------------------- federated fleet observability
+
+    async def _tail_events(
+        request: web.Request, filters: dict, limit: int | None
+    ) -> web.StreamResponse:
+        """``?follow=1``: SSE-tail the ROUTER'S OWN journal (routing +
+        migration decisions, live). Federating a live tail would need N
+        upstream SSE connections per client; the merged historical view is
+        the plain GET — the follow mode is the router's decision stream."""
+        response = web.StreamResponse(
+            status=200,
+            headers={
+                "Content-Type": "text/event-stream",
+                "Cache-Control": "no-store",
+                "X-Accel-Buffering": "no",
+            },
+        )
+        response.enable_chunked_encoding()
+        await response.prepare(request)
+
+        async def send(event: dict) -> None:
+            payload = json.dumps({**event, "source": "router"})
+            await response.write(
+                f"event: wide_event\ndata: {payload}\n\n".encode("utf-8")
+            )
+
+        # Subscribe BEFORE snapshotting the backlog so nothing recorded in
+        # between is lost (the replica edge's exact ordering).
+        queue = router.recorder.subscribe()
+        try:
+            for event in reversed(
+                router.recorder.events(limit=limit, **filters)
+            ):
+                await send(event)
+            while True:
+                try:
+                    event = await asyncio.wait_for(queue.get(), timeout=15.0)
+                except asyncio.TimeoutError:
+                    await response.write(b": keep-alive\n\n")
+                    continue
+                if event_matches(event, **filters):
+                    await send(event)
+        except (
+            asyncio.CancelledError,
+            ConnectionResetError,
+            ConnectionAbortedError,
+        ):
+            return response
+        finally:
+            router.recorder.unsubscribe(queue)
+
+    async def events(request: web.Request) -> web.StreamResponse:
         query = request.query
         try:
             limit = int(query["limit"]) if "limit" in query else None
@@ -736,18 +897,65 @@ def create_router_app(router: FleetRouter) -> web.Application:
             return web.json_response(
                 {"detail": "limit must be >= 0"}, status=400
             )
-        return web.json_response(
-            {
-                "events": router.recorder.events(
-                    limit=limit,
-                    kind=query.get("kind"),
-                    outcome=query.get("outcome"),
-                    session=query.get("session"),
-                    min_duration_ms=min_duration_ms,
-                    since=since,
-                )
-            }
+        filters = dict(
+            kind=query.get("kind"),
+            outcome=query.get("outcome"),
+            session=query.get("session"),
+            tenant=query.get("tenant"),
+            min_duration_ms=min_duration_ms,
+            since=since,
         )
+        if _truthy(request, "follow"):
+            return await _tail_events(request, filters, limit)
+        return web.json_response(
+            await router.federation.events(limit=limit, **filters)
+        )
+
+    async def fleet_slo(request: web.Request) -> web.Response:
+        return web.json_response(
+            await router.federation.slo(tenant=request.query.get("tenant"))
+        )
+
+    async def fleet_traces(request: web.Request) -> web.Response:
+        query = request.query
+        try:
+            limit = int(query["limit"]) if "limit" in query else None
+            min_duration_ms = (
+                float(query["min_duration_ms"])
+                if "min_duration_ms" in query
+                else None
+            )
+        except ValueError:
+            return web.json_response(
+                {"detail": "limit and min_duration_ms must be numeric"},
+                status=400,
+            )
+        if limit is not None and limit < 0:
+            return web.json_response(
+                {"detail": "limit must be >= 0"}, status=400
+            )
+        return web.json_response(
+            await router.federation.traces(
+                limit=limit, min_duration_ms=min_duration_ms
+            )
+        )
+
+    async def fleet_trace(request: web.Request) -> web.Response:
+        body = await router.federation.trace(request.match_info["trace_id"])
+        if not body["sources"]:
+            # Same shape as the replica edge's miss — but only when NOBODY
+            # that answered knows the id; a partial fleet never 404s a
+            # trace a surviving source still holds.
+            return web.json_response(
+                {"detail": "unknown or evicted trace", **body}, status=404
+            )
+        return web.json_response(body)
+
+    async def fleet_tenants(_request: web.Request) -> web.Response:
+        return web.json_response(await router.federation.tenants())
+
+    async def fleet_debug_bundle(_request: web.Request) -> web.Response:
+        return web.json_response(await router.federation.debug_bundle())
 
     async def healthz(request: web.Request) -> web.Response:
         """The router's own liveness + the fleet reachability verdict
@@ -793,6 +1001,11 @@ def create_router_app(router: FleetRouter) -> web.Application:
     app.router.add_post("/v1/fleet/quota/lease", quota_lease)
     app.router.add_get("/v1/fleet/peer", fleet_peer)
     app.router.add_get("/v1/events", events)
+    app.router.add_get("/v1/slo", fleet_slo)
+    app.router.add_get("/v1/traces", fleet_traces)
+    app.router.add_get("/v1/traces/{trace_id}", fleet_trace)
+    app.router.add_get("/v1/tenants", fleet_tenants)
+    app.router.add_get("/v1/fleet/debug/bundle", fleet_debug_bundle)
     app.router.add_get("/healthz", healthz)
     app.router.add_get("/metrics", metrics_endpoint)
     return app

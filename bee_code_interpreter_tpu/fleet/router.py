@@ -67,6 +67,15 @@ from bee_code_interpreter_tpu.fleet.tenancy_plane import (
     subset_size,
 )
 from bee_code_interpreter_tpu.observability import FlightRecorder
+from bee_code_interpreter_tpu.observability.federation import FederationPlane
+from bee_code_interpreter_tpu.observability.slo import SloEngine
+from bee_code_interpreter_tpu.observability.tracing import (
+    TraceStore,
+    Tracer,
+    current_trace,
+    outbound_headers,
+    span,
+)
 from bee_code_interpreter_tpu.resilience import (
     BreakerOpenError,
     BreakerState,
@@ -326,6 +335,10 @@ class FleetRouter:
         peers: list[tuple[str, str]] | None = None,
         quota_ttl_s: float = 3.0,
         router_id: str = "router",
+        slo_objectives=None,  # list[observability.slo.Objective]
+        trace_max_traces: int = 256,
+        trace_slowest_keep: int = 32,
+        federation_timeout_s: float = 2.0,
     ) -> None:
         from bee_code_interpreter_tpu.utils.metrics import Registry
 
@@ -366,6 +379,27 @@ class FleetRouter:
         # request, kind="lease_migrate" per handoff (docs/fleet.md).
         self.recorder = FlightRecorder(
             max_events=events_max, metrics=self.metrics
+        )
+        # The router is a first-class trace participant (docs/
+        # observability.md "Fleet observability"): one trace per routed
+        # request — continued from the client's traceparent, continued BY
+        # the replica edge downstream — with stage spans for placement,
+        # breaker gate, retry attempts, and the proxied call.
+        self.trace_store = TraceStore(
+            max_traces=trace_max_traces, slowest_keep=trace_slowest_keep
+        )
+        self.tracer = Tracer(store=self.trace_store, metrics=self.metrics)
+        # User-perceived SLO: what the CLIENT saw after retries/failover —
+        # the number no per-replica engine can measure (a request that
+        # failed on two replicas and succeeded on the third is ONE good
+        # request here and three mixed samples fleet-wide).
+        self.slo = SloEngine(
+            slo_objectives or [], metrics=self.metrics, clock=clock
+        )
+        # Fleet-scoped scatter-gather queries (federated /v1/traces,
+        # /v1/slo, /v1/events, /v1/tenants, /v1/fleet/debug/bundle).
+        self.federation = FederationPlane(
+            self, timeout_s=federation_timeout_s, metrics=self.metrics
         )
         self.totals: dict[str, int] = {
             "routed": 0,
@@ -463,6 +497,9 @@ class FleetRouter:
         p0..pN); the tenant table from ``APP_TENANTS`` — declared tenants
         get rendezvous placement, quota leases, and router-side retry
         budgets."""
+        from bee_code_interpreter_tpu.observability.slo import (
+            parse_objectives,
+        )
         from bee_code_interpreter_tpu.tenancy import (
             TenantRegistry,
             parse_tenants,
@@ -480,6 +517,14 @@ class FleetRouter:
             tenancy=TenantRegistry(parse_tenants(config.tenants)),
             quota_ttl_s=config.router_quota_ttl_s,
             router_id=config.router_listen_addr,
+            # Same APP_SLO_* declarations as the replicas, but measured at
+            # the edge the client actually talks to.
+            slo_objectives=parse_objectives(
+                config.slo_availability, config.slo_latency_ms
+            ),
+            trace_max_traces=config.trace_max_traces,
+            trace_slowest_keep=config.trace_slowest_keep,
+            federation_timeout_s=config.router_federation_timeout_s,
         )
         kwargs.update(overrides)
         return cls(cls._parse_endpoints(config.router_replicas, "r"), **kwargs)
@@ -774,6 +819,19 @@ class FleetRouter:
         default-tenant: least-utilized first, round-robin tie-break.
         ``cost_class="accelerator"`` steers unkeyed placements toward
         known TPU-capable replicas."""
+        with span("placement", keyed=str(key is not None)):
+            return self._place(
+                key, exclude, tenant=tenant, cost_class=cost_class
+            )
+
+    def _place(
+        self,
+        key: str | None,
+        exclude: frozenset[str] | set[str] = frozenset(),
+        *,
+        tenant=None,
+        cost_class: str | None = None,
+    ) -> list[Replica]:
         now = self._clock()
         eligible = {
             r.name: r
@@ -928,10 +986,12 @@ class FleetRouter:
         retries: int = 0,
         duration_s: float = 0.0,
         session: str | None = None,
+        tenant=None,
     ) -> None:
         """The ONE chokepoint every routed request passes through exactly
-        once: decision totals, the ``kind="routing"`` wide event, and the
-        ``bci_router_*`` counters all increment here — they can only agree."""
+        once: decision totals, the ``kind="routing"`` wide event, the
+        ``bci_router_*`` counters, and the router's user-perceived SLO
+        sample all land here — they can only agree."""
         self.totals["routed"] += 1
         self.totals["retries"] += retries
         if replica is not None and replica in self.replicas:
@@ -941,6 +1001,17 @@ class FleetRouter:
             self._affinity_total.inc(result=affinity)
         self._requests_total.inc(route=route, outcome=outcome)
         self._request_seconds.observe(duration_s, route=route)
+        if outcome != "shed":
+            # User-perceived availability: the verdict the CLIENT saw after
+            # every retry/failover the router performed. 4xx is the
+            # client's own doing; error/unavailable/unreachable/unrouteable
+            # all spend fleet error budget. Sheds are deliberate per-tenant
+            # quota verdicts — excluded, matching the replica engines.
+            self.slo.record(
+                outcome in ("ok", "client_error", "cancelled"),
+                duration_s,
+                tenant=getattr(tenant, "id", None),
+            )
         event = {
             "kind": "routing",
             "name": route,
@@ -949,12 +1020,22 @@ class FleetRouter:
             "retries": retries,
             "duration_ms": duration_s * 1000.0,
         }
+        trace = current_trace()
+        if trace is not None:
+            # The correlation handles the replica recorder already stamps
+            # (wide_event_from_trace): events-tail joins events to traces.
+            event["trace_id"] = trace.trace_id
+            if trace.request_id:
+                event["request_id"] = trace.request_id
         if key is not None:
             event["key"] = key[:16]
         if affinity is not None:
             event["affinity"] = affinity
         if session is not None:
             event["session"] = session
+        tenant_id = getattr(tenant, "id", None)
+        if tenant_id is not None:
+            event["tenant"] = tenant_id
         self.recorder.record(event)
 
     def record_retry(self, reason: str) -> None:
@@ -969,6 +1050,28 @@ class FleetRouter:
             for name in _FORWARD_HEADERS
             if headers.get(name)
         }
+
+    @staticmethod
+    def _inject_trace_context(headers: dict[str, str] | None) -> dict[str, str]:
+        """Overlay the router's AMBIENT trace context onto the forwarded
+        headers: the replica must continue the router's span (making its
+        trace a child of the router trace), not the client's original
+        ``traceparent`` — the router's own root already continued that one.
+        A case-insensitive replace, so the filtered lowercase client copy
+        never rides along as a duplicate header. No ambient trace (peer
+        gossip, refresh, evacuations off the request path) leaves the
+        headers untouched."""
+        out = dict(headers or {})
+        extra = outbound_headers()
+        if extra:
+            lowered = {name.lower() for name in extra}
+            out = {
+                name: value
+                for name, value in out.items()
+                if name.lower() not in lowered
+            }
+            out.update(extra)
+        return out
 
     @staticmethod
     def retry_reason(status: int) -> str | None:
@@ -1037,16 +1140,23 @@ class FleetRouter:
         the replica's breaker and re-raise; HTTP answers are returned with
         5xx recorded as breaker failures (the replica is answering, badly)
         and everything else as successes."""
-        replica.breaker.before_call()
+        with span("breaker", replica=replica.name):
+            replica.breaker.before_call()
         try:
-            response = await self._request(
-                method,
-                f"{replica.base_url}{path}",
-                body=body,
-                headers=headers,
-                params=params,
-                timeout=timeout,
-            )
+            with span("proxy", replica=replica.name) as proxy_span:
+                # Trace context is computed INSIDE the proxy span so the
+                # replica's continuation parents at this span — the replica
+                # trace slots under the hop that carried it.
+                response = await self._request(
+                    method,
+                    f"{replica.base_url}{path}",
+                    body=body,
+                    headers=self._inject_trace_context(headers),
+                    params=params,
+                    timeout=timeout,
+                )
+                if proxy_span is not None:
+                    proxy_span.attributes["status"] = str(response.status_code)
         except asyncio.CancelledError:
             replica.breaker.record_abandoned()
             raise
@@ -1074,17 +1184,23 @@ class FleetRouter:
         The replica's health verdict is taken from the response STATUS
         (known at open); mid-stream trouble — usually the downstream client
         vanishing — deliberately doesn't feed the breaker."""
-        replica.breaker.before_call()
+        with span("breaker", replica=replica.name):
+            replica.breaker.before_call()
         kwargs = {"params": params} if params else {}
         cm = self._session().request(
             method,
             f"{replica.base_url}{path}",
             data=body,
-            headers=headers or {},
+            headers=self._inject_trace_context(headers),
             **kwargs,
         )
         try:
-            response = await cm.__aenter__()
+            # The proxy span for a stream covers time-to-headers only; the
+            # pump's own span owns the body relay.
+            with span("proxy", replica=replica.name, stream="1") as proxy_span:
+                response = await cm.__aenter__()
+                if proxy_span is not None:
+                    proxy_span.attributes["status"] = str(response.status)
         except asyncio.CancelledError:
             replica.breaker.record_abandoned()
             raise
@@ -1128,45 +1244,60 @@ class FleetRouter:
         retries = 0
         last_response = None
         last_error: Exception | None = None
-        for _ in range(attempts):
-            try:
-                candidates = self.place(
-                    key, exclude=exclude, tenant=tenant, cost_class=cost_class
-                )
-            except NoReplicasAvailable:
-                if last_response is not None or last_error is not None:
-                    break
-                raise
-            replica = candidates[0]
-            try:
-                response = await self.call_replica(
-                    replica, method, path, body=body, headers=headers, params=params
-                )
-            except asyncio.CancelledError:
-                raise
-            except BreakerOpenError:
-                exclude.add(replica.name)
-                continue
-            except Exception as e:
-                last_error = e
+        for attempt in range(attempts):
+            # One stage span per attempt: placement + breaker + proxy nest
+            # under it, so the trace shows exactly where a retried request
+            # spent its time and which replica each walk landed on.
+            with span("attempt", attempt=attempt):
+                try:
+                    candidates = self.place(
+                        key,
+                        exclude=exclude,
+                        tenant=tenant,
+                        cost_class=cost_class,
+                    )
+                except NoReplicasAvailable:
+                    if last_response is not None or last_error is not None:
+                        break
+                    raise
+                replica = candidates[0]
+                try:
+                    response = await self.call_replica(
+                        replica,
+                        method,
+                        path,
+                        body=body,
+                        headers=headers,
+                        params=params,
+                    )
+                except asyncio.CancelledError:
+                    raise
+                except BreakerOpenError:
+                    exclude.add(replica.name)
+                    continue
+                except Exception as e:
+                    last_error = e
+                    if not self.spend_retry_budget(tenant):
+                        break
+                    self.record_retry("unreachable")
+                    retries += 1
+                    exclude.add(replica.name)
+                    continue
+                reason = self.retry_reason(response.status_code)
+                if reason is None or (
+                    reason == "server_error" and not retry_5xx
+                ):
+                    return response, replica.name, retries
+                if reason == "shed" and self.sticky_shed(response.content):
+                    # A per-tenant verdict with its Retry-After: honest
+                    # as-is.
+                    return response, replica.name, retries
+                last_response = response
                 if not self.spend_retry_budget(tenant):
-                    break
-                self.record_retry("unreachable")
+                    return response, replica.name, retries
+                self.record_retry(reason)
                 retries += 1
                 exclude.add(replica.name)
-                continue
-            reason = self.retry_reason(response.status_code)
-            if reason is None or (reason == "server_error" and not retry_5xx):
-                return response, replica.name, retries
-            if reason == "shed" and self.sticky_shed(response.content):
-                # A per-tenant verdict with its Retry-After: honest as-is.
-                return response, replica.name, retries
-            last_response = response
-            if not self.spend_retry_budget(tenant):
-                return response, replica.name, retries
-            self.record_retry(reason)
-            retries += 1
-            exclude.add(replica.name)
         if last_response is not None:
             # Out of replicas: the last upstream verdict is the honest one.
             return last_response, None, retries
@@ -1378,6 +1509,14 @@ class FleetRouter:
                 "to": target_name,
                 "duration_ms": (self._clock() - start) * 1000.0,
             }
+            trace = current_trace()
+            if trace is not None:
+                # A pinned-503 rescue runs inside the request's trace; the
+                # correlation fields join the handoff to it. Background
+                # evacuations have no ambient trace — fields absent.
+                event["trace_id"] = trace.trace_id
+                if trace.request_id:
+                    event["request_id"] = trace.request_id
             if detail is not None:
                 event["detail"] = detail
             self.recorder.record(event)

@@ -188,6 +188,46 @@ async def router_replicas(http_addr: str, timeout: float = 10.0) -> dict:
         return response.json()
 
 
+async def router_slo(http_addr: str, timeout: float = 10.0) -> dict | None:
+    """The router's federated ``GET /v1/slo`` document, or ``None`` when the
+    surface is unreachable — the burn check is an add-on to the reachability
+    verdict, never the reason the probe itself errors out."""
+    try:
+        async with httpx.AsyncClient(timeout=timeout) as client:
+            response = await client.get(f"http://{http_addr}/v1/slo")
+            response.raise_for_status()
+            body = response.json()
+            return body if isinstance(body, dict) else None
+    except Exception:
+        return None
+
+
+def assess_router_burn(slo: dict | None) -> tuple[int, str | None]:
+    """The fleet SLO-burn verdict layered on a clean reachability check
+    (``slo-report.py``'s page semantics): the router's own user-perceived
+    fast-burn pages, and so does any single replica's (``fleet_fast_burn``
+    rollup) — a replica can burn its budget while retries keep the edge
+    numbers clean."""
+    if not slo:
+        return 0, None
+    if slo.get("fast_burn_alerting"):
+        return SLO_BURN_EXIT, (
+            "SLO BURN: router edge fast-burn page is firing "
+            "(user-perceived error budget)"
+        )
+    if slo.get("fleet_fast_burn"):
+        burning = sorted(
+            name
+            for name, doc in (slo.get("fleet") or {}).items()
+            if isinstance(doc, dict) and doc.get("fast_burn_alerting")
+        )
+        return SLO_BURN_EXIT, (
+            "SLO BURN: replica fast-burn page is firing: "
+            f"{', '.join(burning) or 'unknown'}"
+        )
+    return 0, None
+
+
 def router_main(args) -> None:
     try:
         body = asyncio.run(
@@ -200,6 +240,13 @@ def router_main(args) -> None:
         )
         sys.exit(2)
     code, message = assess_router(body)
+    if code == 0:
+        # Reachable and routable — but a firing fast-burn page still makes
+        # the probe red (exit 4, the same code slo-report.py pages with).
+        slo = asyncio.run(router_slo(args.addr, timeout=min(args.timeout, 15.0)))
+        burn_code, burn_message = assess_router_burn(slo)
+        if burn_code:
+            code, message = burn_code, burn_message
     print(message, file=sys.stderr if code else sys.stdout)
     if args.verbose:
         print(json.dumps(body, indent=2))

@@ -85,6 +85,9 @@ from bee_code_interpreter_tpu.observability.export import (  # noqa: E402
     metrics_payload,
     spans_payload,
 )
+from bee_code_interpreter_tpu.observability.federation import (  # noqa: E402
+    FederationPlane,
+)
 from bee_code_interpreter_tpu.observability.slo import (  # noqa: E402
     Objective,
     SloEngine,
@@ -97,6 +100,7 @@ __all__ = [
     "ContinuousProfiler",
     "DemandTracker",
     "Forecaster",
+    "FederationPlane",
     "FleetJournal",
     "FlightRecorder",
     "JsonLogFormatter",

@@ -1,0 +1,336 @@
+"""Fleet-scoped observability queries at the router edge (docs/fleet.md,
+docs/observability.md "Fleet observability").
+
+Every observability surface built for one replica — traces, wide events,
+SLO snapshots, tenants, the debug bundle — terminates at that replica; N
+replicas behind a router are N disconnected answers. The
+:class:`FederationPlane` turns them into ONE answer at the edge the client
+actually talks to, by scatter-gathering the same GET across the live
+replicas and merging with the router's own local view.
+
+Contract, deliberately partial-tolerant:
+
+- **Never a 500 because one replica is down.** A dead, breaker-open,
+  timed-out, or garbage-answering replica is *accounted*, not fatal: every
+  federated response carries ``replicas_reporting`` (names that answered)
+  and ``replicas_failed`` (name → reason) so a partial answer is visibly
+  partial.
+- **Bounded fan-out.** One concurrent GET per live replica, each with its
+  own ``APP_ROUTER_FEDERATION_TIMEOUT_S`` deadline, issued through the
+  router's existing per-replica circuit breakers (``call_replica``) — a
+  replica that stops answering federated queries trips the same breaker
+  the data plane uses, and an open breaker skips the call entirely.
+- **Dead replicas cost nothing.** Replicas the refresh loop already marked
+  dead are accounted as ``"dead"`` without a network call.
+
+The plane is duck-typed against :class:`fleet.router.FleetRouter` (it only
+reads ``replicas``/``dead_after_s`` and calls ``call_replica``), so this
+module stays free of any ``fleet`` import — ``fleet.router`` imports *it*.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from urllib.parse import quote
+
+from bee_code_interpreter_tpu.observability.bundle import build_debug_bundle
+from bee_code_interpreter_tpu.resilience import BreakerOpenError
+
+
+class FederationPlane:
+    """Scatter-gather fan-out over a router's live replicas, merged with
+    the router's own stores. All query methods are total: they return a
+    (possibly partial) document, never raise for replica trouble."""
+
+    def __init__(self, router, *, timeout_s: float = 2.0, metrics=None) -> None:
+        from bee_code_interpreter_tpu.utils.metrics import Registry
+
+        self._router = router
+        self._timeout_s = timeout_s
+        self._clock = getattr(router, "_clock", time.monotonic)
+        metrics = metrics or Registry()
+        self._requests_total = metrics.counter(
+            "bci_federation_requests_total",
+            "Federated fleet queries served at this router edge, by "
+            "endpoint",
+        )
+        self._replica_errors_total = metrics.counter(
+            "bci_federation_replica_errors_total",
+            "Per-replica failures during federated fan-out, by reason "
+            "(dead/breaker_open/timeout/unreachable/http_*/bad_json)",
+        )
+        self._fanout_seconds = metrics.histogram(
+            "bci_federation_fanout_seconds",
+            "Wall-clock of one federated scatter-gather, by endpoint",
+        )
+
+    # ------------------------------------------------------------ fan-out
+
+    async def _fan_out(
+        self,
+        endpoint: str,
+        path: str,
+        *,
+        params=None,
+        accept: tuple[int, ...] = (200,),
+        timeout_s: float | None = None,
+    ) -> tuple[dict, dict]:
+        """One bounded scatter-gather: ``(answers, failed)`` where answers
+        maps replica name → ``(status, parsed_body)`` for statuses in
+        ``accept`` and failed maps name → reason for everything else."""
+        router = self._router
+        self._requests_total.inc(endpoint=endpoint)
+        now = self._clock()
+        live, failed = [], {}
+        for name in sorted(router.replicas):
+            replica = router.replicas[name]
+            if replica.state(now, router.dead_after_s) == "dead":
+                failed[name] = "dead"
+            else:
+                live.append(replica)
+
+        async def one(replica):
+            try:
+                response = await router.call_replica(
+                    replica,
+                    "GET",
+                    path,
+                    params=params,
+                    timeout=timeout_s or self._timeout_s,
+                )
+            except asyncio.CancelledError:
+                raise
+            except BreakerOpenError:
+                return replica.name, None, "breaker_open"
+            except asyncio.TimeoutError:
+                return replica.name, None, "timeout"
+            except Exception:
+                return replica.name, None, "unreachable"
+            if response.status_code not in accept:
+                return replica.name, None, f"http_{response.status_code}"
+            try:
+                body = response.json()
+            except ValueError:
+                return replica.name, None, "bad_json"
+            if not isinstance(body, dict):
+                return replica.name, None, "bad_json"
+            return replica.name, (response.status_code, body), None
+
+        start = self._clock()
+        answers: dict[str, tuple[int, dict]] = {}
+        for name, answer, reason in await asyncio.gather(
+            *(one(r) for r in live)
+        ):
+            if reason is not None:
+                failed[name] = reason
+                self._replica_errors_total.inc(reason=reason)
+            else:
+                answers[name] = answer
+        self._fanout_seconds.observe(self._clock() - start, endpoint=endpoint)
+        return answers, failed
+
+    @staticmethod
+    def _accounted(body: dict, answers: dict, failed: dict) -> dict:
+        """Stamp the partial-result contract onto a federated response."""
+        body["replicas_reporting"] = sorted(answers)
+        body["replicas_failed"] = {k: failed[k] for k in sorted(failed)}
+        return body
+
+    # ------------------------------------------------------------ queries
+
+    async def slo(self, tenant: str | None = None) -> dict:
+        """Federated ``GET /v1/slo``: the router's USER-PERCEIVED engine
+        (what clients saw after retries/failover) at top level — so
+        ``slo-report.py``/``health_check.py`` pointed at a router edge read
+        the same keys they read on a replica — plus each live replica's
+        own budget snapshot under ``fleet`` and two fleet-wide rollups."""
+        params = {"tenant": tenant} if tenant is not None else None
+        answers, failed = await self._fan_out("slo", "/v1/slo", params=params)
+        router = self._router
+        body = (
+            router.slo.tenant_snapshot(tenant)
+            if tenant is not None
+            else router.slo.snapshot()
+        )
+        fleet = {name: doc for name, (_status, doc) in answers.items()}
+        body["fleet"] = {k: fleet[k] for k in sorted(fleet)}
+        # Any-replica rollups: a single replica paging is a fleet fact even
+        # while the user-perceived edge numbers still look clean.
+        body["fleet_alerting"] = any(
+            doc.get("alerting") for doc in fleet.values()
+        )
+        body["fleet_fast_burn"] = any(
+            doc.get("fast_burn_alerting") for doc in fleet.values()
+        )
+        return self._accounted(body, answers, failed)
+
+    async def traces(
+        self,
+        limit: int | None = None,
+        min_duration_ms: float | None = None,
+    ) -> dict:
+        """Federated ``GET /v1/traces``: router + replica trace summaries
+        merged newest-first, each stamped with its ``source`` (``router``
+        or the replica name)."""
+        params = {}
+        if limit is not None:
+            params["limit"] = str(limit)
+        if min_duration_ms is not None:
+            params["min_duration_ms"] = str(min_duration_ms)
+        answers, failed = await self._fan_out(
+            "traces", "/v1/traces", params=params or None
+        )
+        merged = []
+        for t in self._router.trace_store.traces():
+            if (
+                min_duration_ms is not None
+                and t.duration_s * 1000.0 < min_duration_ms
+            ):
+                continue
+            merged.append({**t.summary(), "source": "router"})
+        for name in sorted(answers):
+            _status, doc = answers[name]
+            for summary in doc.get("traces") or []:
+                if isinstance(summary, dict):
+                    merged.append({**summary, "source": name})
+        merged.sort(key=lambda d: d.get("start_unix") or 0.0, reverse=True)
+        if limit is not None:
+            merged = merged[:limit]
+        return self._accounted({"traces": merged}, answers, failed)
+
+    async def trace(self, trace_id: str) -> dict:
+        """Federated ``GET /v1/traces/{id}``: ONE distributed trace
+        stitched by trace_id — the router's spans plus every replica's
+        continuation — with a merged ``spans`` list (each span stamped
+        with its ``source``) and the per-source documents intact. A 404
+        from a replica means "not mine", not a failure; ``sources`` empty
+        means the trace is known nowhere that answered."""
+        answers, failed = await self._fan_out(
+            "trace",
+            f"/v1/traces/{quote(trace_id, safe='')}",
+            accept=(200, 404),
+        )
+        docs: dict[str, dict] = {}
+        own = self._router.trace_store.get(trace_id)
+        if own is not None:
+            docs["router"] = own.to_dict()
+        for name in sorted(answers):
+            status, doc = answers[name]
+            if status == 200:
+                docs[name] = doc
+        sources = [s for s in ("router", *sorted(answers)) if s in docs]
+        spans = []
+        for source in sources:
+            for sp in docs[source].get("spans") or []:
+                if isinstance(sp, dict):
+                    spans.append({**sp, "source": source})
+        body = {
+            "trace_id": trace_id,
+            "sources": sources,
+            "router": docs.get("router"),
+            "replicas": {n: d for n, d in docs.items() if n != "router"},
+            "spans": spans,
+        }
+        return self._accounted(body, answers, failed)
+
+    async def events(
+        self,
+        *,
+        limit: int | None = None,
+        kind: str | None = None,
+        outcome: str | None = None,
+        session: str | None = None,
+        tenant: str | None = None,
+        min_duration_ms: float | None = None,
+        since: float | None = None,
+    ) -> dict:
+        """Federated ``GET /v1/events``: the router's own routing/migration
+        journal merged with every live replica's wide events, same filter
+        surface, each event stamped with its ``source``. Timestamps order
+        the merge; they are per-host clocks, close enough for a tail."""
+        params = {}
+        for name, value in (
+            ("limit", limit),
+            ("kind", kind),
+            ("outcome", outcome),
+            ("session", session),
+            ("tenant", tenant),
+            ("min_duration_ms", min_duration_ms),
+            ("since", since),
+        ):
+            if value is not None:
+                params[name] = str(value)
+        answers, failed = await self._fan_out(
+            "events", "/v1/events", params=params or None
+        )
+        merged = [
+            {**event, "source": "router"}
+            for event in self._router.recorder.events(
+                limit=limit,
+                kind=kind,
+                outcome=outcome,
+                session=session,
+                tenant=tenant,
+                min_duration_ms=min_duration_ms,
+                since=since,
+            )
+        ]
+        for name in sorted(answers):
+            _status, doc = answers[name]
+            for event in doc.get("events") or []:
+                if isinstance(event, dict):
+                    merged.append({**event, "source": name})
+        merged.sort(key=lambda e: e.get("ts") or 0.0, reverse=True)
+        if limit is not None:
+            merged = merged[:limit]
+        return self._accounted({"events": merged}, answers, failed)
+
+    async def tenants(self) -> dict:
+        """Federated ``GET /v1/tenants``: each live replica's isolation/
+        billing snapshot side by side with the router's fleet-wide
+        quota-lease ledger — the two halves of the tenancy plane in one
+        answer. A replica answering 501 (no tenant registry wired) reports
+        ``null``, which is its honest answer, not a failure."""
+        answers, failed = await self._fan_out(
+            "tenants", "/v1/tenants", accept=(200, 501)
+        )
+        replicas = {
+            name: (doc if status == 200 else None)
+            for name, (status, doc) in answers.items()
+        }
+        body = {
+            "replicas": {k: replicas[k] for k in sorted(replicas)},
+            "quota": self._router.ledger.snapshot(),
+        }
+        return self._accounted(body, answers, failed)
+
+    async def debug_bundle(self) -> dict:
+        """``GET /v1/fleet/debug/bundle``: the one-call incident snapshot
+        for the whole fleet — the router's own bundle (traces, SLO, events,
+        metrics) plus its decision snapshot, and every live replica's full
+        debug bundle. Partial-tolerant like every federated query: a dead
+        replica costs an accounting entry, not the bundle."""
+        answers, failed = await self._fan_out(
+            "bundle",
+            "/v1/debug/bundle",
+            # Bundles are the heaviest federated answer; give slow replicas
+            # headroom beyond the per-query default.
+            timeout_s=max(self._timeout_s, 5.0),
+        )
+        router = self._router
+        router_bundle = build_debug_bundle(
+            tracer=router.tracer,
+            slo=router.slo,
+            metrics=router.metrics,
+            recorder=router.recorder,
+        )
+        router_bundle["snapshot"] = router.snapshot()
+        body = {
+            "generated_unix": time.time(),
+            "router": router_bundle,
+            "replicas": {
+                name: doc for name, (_status, doc) in sorted(answers.items())
+            },
+        }
+        return self._accounted(body, answers, failed)

@@ -814,11 +814,26 @@ async def measure_router(binary: Path) -> dict | None:
         # difference of medians: pairing cancels per-key and drift effects,
         # and the median shrugs off any residual cold-pop outlier.
         tax = stats.median(r - d for d, r in zip(direct_ms, routed_ms))
+        # Per-stage p50 breakdown of where the tax goes, from the router's
+        # own stage spans (docs/observability.md "Fleet observability"):
+        # placement decision, breaker gate, retry attempt, proxied call.
+        # The proxy stage CONTAINS the replica's work — only placement +
+        # breaker (plus attempt minus proxy) are router-added time, so the
+        # breakdown attributes the <2ms budget rather than re-measuring it.
+        by_stage: dict[str, list[float]] = {}
+        for trace in router.trace_store.traces():
+            for stage, ms in trace.stage_ms().items():
+                by_stage.setdefault(stage, []).append(ms)
+        stage_p50 = {
+            stage: round(stats.median(samples), 3)
+            for stage, samples in sorted(by_stage.items())
+        }
         return {
             "requests_per_arm": ROUNDS,
             "direct_p50_ms": round(direct_p50, 2),
             "router_p50_ms": round(router_p50, 2),
             "router_tax_ms": round(tax, 2),
+            "router_stage_p50_ms": stage_p50,
             "warm_pop_rate": round(
                 router.affinity_totals["warm"] / keyed if keyed else 0.0, 3
             ),

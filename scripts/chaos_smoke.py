@@ -1555,6 +1555,147 @@ async def main() -> int:
             for s in stacks16:
                 await s.stop()
 
+        # 17. fleet observability plane: one distributed trace through
+        #     router + replica, a replica killed mid-traced-request (the
+        #     retry walk shows in the trace) and mid-federated-query (the
+        #     answer stays partial-valid, never a 500)
+        #     (docs/observability.md "Fleet observability"; tier-1 twin in
+        #     tests/test_fleet_observability.py).
+        from bee_code_interpreter_tpu.fleet import (
+            affinity_key as affinity_key_17,
+        )
+
+        shared17 = tmp / "shared-objects-17"
+        stacks17 = [
+            await ReplicaStack(f"r{i}", tmp / "fleet17", shared17).start()
+            for i in range(3)
+        ]
+        router17 = FleetRouter(
+            [(s.name, s.base_url) for s in stacks17],
+            refresh_interval_s=0.2,
+            dead_after_s=0.5,
+        )
+        runner17 = aioweb.AppRunner(create_router_app(router17))
+        await runner17.setup()
+        port17 = free_port()
+        await aioweb.TCPSite(runner17, "127.0.0.1", port17).start()
+        await router17.refresh_once()
+        router17.start()
+        url17 = f"http://127.0.0.1:{port17}"
+        client17 = httpx.AsyncClient(timeout=30.0)
+        try:
+            object17 = await stacks17[0].storage.write(b"chaos-17")
+            files17 = {"/workspace/seed.txt": object17}
+            client_trace17 = "beadfeedbeadfeedbeadfeedbeadfeed"
+            r = await client17.post(
+                f"{url17}/v1/execute",
+                json={"source_code": "print('ok')", "files": files17},
+                headers={
+                    "traceparent": f"00-{client_trace17}-b7ad6b7169203331-01"
+                },
+            )
+            trace17 = (
+                await client17.get(f"{url17}/v1/traces/{client_trace17}")
+            ).json()
+            router_stages17 = set(
+                (trace17.get("router") or {}).get("stage_ms") or {}
+            )
+            replica_sources17 = [
+                s for s in trace17.get("sources", []) if s != "router"
+            ]
+            report(
+                "one trace spans router->replica->sandbox, client "
+                "traceparent continued",
+                r.status_code == 200
+                and r.headers.get("X-Trace-Id") == client_trace17
+                and {"placement", "breaker", "attempt", "proxy"}
+                <= router_stages17
+                and len(replica_sources17) == 1
+                and bool(
+                    trace17["replicas"][replica_sources17[0]]["stage_ms"]
+                ),
+                f"sources={trace17.get('sources')} "
+                f"router stages={sorted(router_stages17)}",
+            )
+
+            # Kill the key's OWNER mid-request: the in-flight proxied call
+            # dies, the router's retry walk lands the request elsewhere —
+            # all of it inside ONE trace.
+            owner17 = router17.ring.owner(affinity_key_17(files17))
+            victim17 = next(s for s in stacks17 if s.name == owner17)
+            task17 = asyncio.create_task(
+                client17.post(
+                    f"{url17}/v1/execute",
+                    json={
+                        "source_code": "import time; time.sleep(0.6); print('survived')",
+                        "files": files17,
+                    },
+                )
+            )
+            await asyncio.sleep(0.25)  # let the proxied call commit
+            # An abrupt kill: don't let the dying edge drain the in-flight
+            # request gracefully — the router must see the connection die.
+            victim17.runner._shutdown_timeout = 0.05
+            await victim17.stop(hard=True)
+            r = await task17
+            mid_trace17 = router17.trace_store.get(
+                r.headers.get("X-Trace-Id", "")
+            )
+            attempts17 = (
+                sum(
+                    1
+                    for s in mid_trace17.spans
+                    if s.name == "attempt"
+                )
+                if mid_trace17 is not None
+                else 0
+            )
+            report(
+                "replica killed mid-traced-request: rerouted to a "
+                "survivor, retry walk visible in the trace",
+                r.status_code == 200
+                and "survived" in r.json().get("stdout", "")
+                and attempts17 >= 2,
+                f"status={r.status_code} attempts={attempts17}",
+            )
+
+            # Mid-kill federated query (dead not yet detected), then the
+            # settled form: exact {"name": "dead"} accounting, never a 500.
+            bundle17 = await client17.get(f"{url17}/v1/fleet/debug/bundle")
+            mid_ok17 = (
+                bundle17.status_code == 200
+                and owner17 in bundle17.json()["replicas_failed"]
+            )
+            deadline17 = time.monotonic() + 5.0
+            while time.monotonic() < deadline17:
+                states17 = {
+                    rep["name"]: rep["state"]
+                    for rep in router17.snapshot()["replicas"]
+                }
+                if states17.get(owner17) == "dead":
+                    break
+                await asyncio.sleep(0.05)
+            slo17 = (await client17.get(f"{url17}/v1/slo")).json()
+            survivors17 = sorted(
+                s.name for s in stacks17 if s.name != owner17
+            )
+            report(
+                "federated SLO/bundle survive the kill with exact "
+                "partial accounting",
+                mid_ok17
+                and slo17["replicas_failed"] == {owner17: "dead"}
+                and sorted(slo17["replicas_reporting"]) == survivors17
+                and sorted(slo17["fleet"]) == survivors17,
+                f"failed={slo17['replicas_failed']} "
+                f"reporting={slo17['replicas_reporting']}",
+            )
+        finally:
+            await client17.aclose()
+            await runner17.cleanup()
+            await router17.stop()
+            for s in stacks17:
+                await s.stop()
+
         text = metrics.expose()
         wanted = [
             "bci_executor_fallback_total 1",
@@ -1580,7 +1721,7 @@ async def main() -> int:
         "supervisor, watchdog, drain, telemetry export, edge analysis gate, "
         "sessions-under-chaos, flight-recorder-logs, serving-saturation, "
         "autoscale-10x-step, fleet-router-kill, abusive-tenant, "
-        "fleet-wide-tenancy all behaved"
+        "fleet-wide-tenancy, fleet-observability all behaved"
     )
     return 0
 

@@ -11,6 +11,13 @@ service's request journal, with the same filters the API supports:
         [--json] [--once]
 
 ``--once`` skips the follow and prints the current snapshot instead.
+
+Point ``--url`` at a FLEET ROUTER edge (docs/observability.md "Fleet
+observability") and the same commands work fleet-wide: ``--once`` renders
+the federated merge (router + every live replica, each event's ``source``
+in the first column), while the follow mode tails the router's own
+``kind=routing`` / ``kind=lease_migrate`` decision journal live — each row
+carrying the trace_id that joins it to the distributed trace.
 """
 
 from __future__ import annotations
@@ -48,6 +55,18 @@ def render(event: dict) -> str:
         extras.append(f"hedge={event['hedge']}")
     if event.get("kind") == "loop_stall":
         extras.append(f"lag={event.get('lag_s', 0) * 1000:.0f}ms")
+    if event.get("kind") == "routing":
+        # One router decision (docs/fleet.md): chosen replica, ring
+        # verdict, and how many cross-replica retries the client never saw.
+        extras.append(f"replica={event.get('replica') or '-'}")
+        if event.get("affinity"):
+            extras.append(f"affinity={event['affinity']}")
+        if event.get("retries"):
+            extras.append(f"retries={event['retries']}")
+    if event.get("kind") == "lease_migrate":
+        extras.append(
+            f"{event.get('from', '?')}->{event.get('to') or '?'}"
+        )
     if event.get("kind") == "autoscale":
         # One scaling decision (docs/autoscaling.md): direction, size
         # delta, reason, and whether act mode actually moved the pool.
@@ -69,8 +88,11 @@ def render(event: dict) -> str:
             extras.append(f"ttft={serving['ttft_ms']:.1f}ms")
         if serving.get("requeues"):
             extras.append(f"requeues={serving['requeues']}")
+    # Federated rows (a router edge merging N replicas) carry their origin;
+    # single-replica rows don't — omit the column rather than pad it.
+    source = f" {event['source']:<8}" if event.get("source") else ""
     return (
-        f"{fmt_ts(event.get('ts'))} {event.get('kind', '-'):<10} "
+        f"{fmt_ts(event.get('ts'))}{source} {event.get('kind', '-'):<10} "
         f"{(event.get('name') or '-'):<32} {(event.get('outcome') or '-'):<12} "
         f"{dur}  trace={event.get('trace_id') or '-':<32} "
         + " ".join(extras)

@@ -6,8 +6,10 @@ Fetches ``GET /v1/fleet/replicas`` from a running router edge and prints a
 ownership share, breaker state, routed totals — plus the router's session
 pins, decision/affinity/migration tallies, each replica's tenant and
 cost-class mix, the fleet-wide quota-lease ledger, and peer-router health
-(docs/fleet.md "Fleet-wide tenancy"). ``--watch N`` refreshes every N
-seconds until interrupted.
+(docs/fleet.md "Fleet-wide tenancy"). When the router serves the federated
+``GET /v1/slo`` surface (docs/observability.md "Fleet observability") a
+fleet SLO line and federation health (replicas reporting/failed) render
+too. ``--watch N`` refreshes every N seconds until interrupted.
 
     python scripts/fleet-router-top.py [--url http://localhost:50080]
         [--watch SECONDS]
@@ -38,7 +40,44 @@ def fmt_mix(mix: dict) -> str:
     return " ".join(f"{k}={v}" for k, v in items) or "-"
 
 
-def render(snap: dict) -> str:
+def render_slo(slo: dict | None) -> list[str]:
+    """The fleet SLO line + federation health from the router's federated
+    ``GET /v1/slo`` (docs/observability.md "Fleet observability"); empty
+    when the router predates the federated surface."""
+    if not slo:
+        return []
+    lines = []
+    burn = "PAGE" if slo.get("fast_burn_alerting") else (
+        "ticket" if slo.get("alerting") else "ok"
+    )
+    fleet_burn = "PAGE" if slo.get("fleet_fast_burn") else (
+        "ticket" if slo.get("fleet_alerting") else "ok"
+    )
+    budget = "-"
+    for objective in slo.get("objectives") or []:
+        if objective.get("kind") == "availability":
+            remaining = objective.get("error_budget_remaining_ratio")
+            if isinstance(remaining, (int, float)):
+                budget = f"{remaining:.0%}"
+            break
+    lines.append(
+        f"slo: edge budget_remaining={budget} burn={burn}  "
+        f"fleet burn={fleet_burn}"
+    )
+    reporting = slo.get("replicas_reporting")
+    failed = slo.get("replicas_failed") or {}
+    if reporting is not None:
+        failed_str = (
+            " ".join(f"{n}={failed[n]}" for n in sorted(failed)) or "-"
+        )
+        lines.append(
+            f"federation: reporting={len(reporting)} "
+            f"failed={len(failed)} ({failed_str})"
+        )
+    return lines
+
+
+def render(snap: dict, slo: dict | None = None) -> str:
     lines = []
     replicas = snap.get("replicas", [])
     by_state: dict[str, int] = {}
@@ -78,6 +117,7 @@ def render(snap: dict) -> str:
                 for p in peers
             )
         )
+    lines.extend(render_slo(slo))
     lines.append("")
     header = (
         f"{'REPLICA':<12} {'STATE':<9} {'UTIL':>5} {'BURN':>5} "
@@ -159,9 +199,19 @@ def main() -> int:
         except Exception as e:
             print(f"cannot reach router at {args.url}: {e}", file=sys.stderr)
             return 2
+        # Best-effort: the replica table must render even when the
+        # federated SLO surface is missing (older router) or slow.
+        slo = None
+        try:
+            slo_response = httpx.get(f"{args.url}/v1/slo", timeout=10.0)
+            if slo_response.status_code == 200:
+                body = slo_response.json()
+                slo = body if isinstance(body, dict) else None
+        except Exception:
+            pass
         if args.watch is not None:
             print("\033[2J\033[H", end="")  # clear like top
-        print(render(response.json()))
+        print(render(response.json(), slo))
         if args.watch is None:
             return 0
         try:

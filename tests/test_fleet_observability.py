@@ -1,0 +1,360 @@
+"""Fleet observability plane acceptance (ISSUE 17, docs/observability.md
+"Fleet observability"): the router as a first-class trace participant and
+the federated traces/events/SLO/tenants/debug surface at the router edge —
+chaos scenario 17's tier-1 twin.
+
+Same harness as tests/test_fleet_router.py: N COMPLETE in-process replicas
+(real HTTP edge over fake pods, sharing one snapshot root) behind the real
+FleetRouter over real sockets. The distributed-trace assertions here are
+end-to-end: one client request, one trace_id, spans recorded by TWO
+processes' tracers, stitched back together by the federated query."""
+
+import asyncio
+import time
+
+import httpx
+import pytest
+from aiohttp import web
+
+from bee_code_interpreter_tpu.fleet import FleetRouter, create_router_app
+from bee_code_interpreter_tpu.health_check import (
+    SLO_BURN_EXIT,
+    assess_router_burn,
+)
+from bee_code_interpreter_tpu.observability import parse_objectives
+from tests.fakes import ReplicaStack, free_port
+
+pytestmark = pytest.mark.chaos
+
+
+async def _start_fleet(tmp_path, n=3, **router_kwargs):
+    shared_root = tmp_path / "shared-objects"
+    stacks = [
+        await ReplicaStack(f"r{i}", tmp_path, shared_root).start()
+        for i in range(n)
+    ]
+    router_kwargs.setdefault("refresh_interval_s", 0.2)
+    router_kwargs.setdefault("dead_after_s", 0.5)
+    router = FleetRouter(
+        [(s.name, s.base_url) for s in stacks], **router_kwargs
+    )
+    runner = web.AppRunner(create_router_app(router))
+    await runner.setup()
+    port = free_port()
+    await web.TCPSite(runner, "127.0.0.1", port).start()
+    await router.refresh_once()
+    router.start()
+    return stacks, router, runner, f"http://127.0.0.1:{port}"
+
+
+async def _stop_fleet(stacks, router, runner, client):
+    await client.aclose()
+    await runner.cleanup()
+    await router.stop()
+    for stack in stacks:
+        await stack.stop()
+
+
+async def _wait_for_state(router, name, state, timeout_s=5.0):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        snap = {
+            r["name"]: r["state"] for r in router.snapshot()["replicas"]
+        }
+        if snap.get(name) == state:
+            return
+        await asyncio.sleep(0.05)
+    raise AssertionError(f"{name} never reached state {state!r}")
+
+
+# ------------------------------------------------- end-to-end distributed
+
+
+async def test_one_trace_spans_router_and_replica(tmp_path):
+    """THE tentpole acceptance: a client request through the router yields
+    ONE distributed trace — router stage spans (placement / breaker /
+    attempt / proxy) and the owning replica's pipeline spans under the SAME
+    trace_id, queryable as one document from the federated
+    ``GET /v1/traces/{id}`` — and an inbound client ``traceparent`` is
+    continued, not replaced."""
+    stacks, router, runner, url = await _start_fleet(tmp_path, n=2)
+    client = httpx.AsyncClient(timeout=30.0)
+    try:
+        object_id = await stacks[0].storage.write(b"trace-seed")
+        files = {"/workspace/seed.txt": object_id}
+
+        # --- no client traceparent: the router roots the trace
+        response = await client.post(
+            f"{url}/v1/execute",
+            json={"source_code": "print(1)", "files": files},
+        )
+        assert response.status_code == 200
+        trace_id = response.headers.get("X-Trace-Id")
+        request_id = response.headers.get("X-Request-Id")
+        assert trace_id and request_id
+
+        doc = (
+            (await client.get(f"{url}/v1/traces/{trace_id}"))
+            .raise_for_status()
+            .json()
+        )
+        assert doc["trace_id"] == trace_id
+        # Stitched from BOTH ends: the router's own document plus exactly
+        # one replica's continuation.
+        assert "router" in doc["sources"]
+        replica_sources = [s for s in doc["sources"] if s != "router"]
+        assert len(replica_sources) == 1
+        assert doc["replicas_failed"] == {}
+
+        router_doc = doc["router"]
+        assert router_doc["trace_id"] == trace_id
+        for stage in ("placement", "breaker", "attempt", "proxy"):
+            assert stage in router_doc["stage_ms"], router_doc["stage_ms"]
+
+        replica_doc = doc["replicas"][replica_sources[0]]
+        assert replica_doc["trace_id"] == trace_id
+        # The replica edge recorded its own pipeline stages (admission,
+        # spawn/pop, upload, execute, download — exact set is the replica's
+        # contract; here: non-empty and contained in the router's total).
+        assert replica_doc["stage_ms"]
+        assert router_doc["duration_ms"] >= sum(
+            replica_doc["stage_ms"].values()
+        ) * 0.5  # halved: two monotonic clocks, zero tolerance is flaky
+        # The merged span list stamps every span's origin.
+        assert {s["source"] for s in doc["spans"]} == {
+            "router",
+            replica_sources[0],
+        }
+
+        # The replica's root span is a CHILD of the router's trace — the
+        # injected traceparent carried the router's active span id down.
+        replica_root = replica_doc["spans"][0]
+        router_span_ids = {s["span_id"] for s in router_doc["spans"]}
+        assert replica_root["parent_id"] in router_span_ids
+
+        # --- routing wide event carries the correlation handles
+        events = (
+            (await client.get(f"{url}/v1/events", params={"kind": "routing"}))
+            .raise_for_status()
+            .json()["events"]
+        )
+        correlated = [e for e in events if e.get("trace_id") == trace_id]
+        assert correlated and correlated[0]["request_id"] == request_id
+        assert correlated[0]["source"] == "router"
+
+        # --- inbound client traceparent is CONTINUED
+        client_trace = "0af7651916cd43dd8448eb211c80319c"
+        client_span = "b7ad6b7169203331"
+        response = await client.post(
+            f"{url}/v1/execute",
+            json={"source_code": "print(2)", "files": files},
+            headers={"traceparent": f"00-{client_trace}-{client_span}-01"},
+        )
+        assert response.status_code == 200
+        assert response.headers["X-Trace-Id"] == client_trace
+        doc = (
+            (await client.get(f"{url}/v1/traces/{client_trace}"))
+            .raise_for_status()
+            .json()
+        )
+        assert "router" in doc["sources"]
+        # The router's root span parents at the CLIENT's span.
+        root = doc["router"]["spans"][0]
+        assert root["parent_id"] == client_span
+    finally:
+        await _stop_fleet(stacks, router, runner, client)
+
+
+async def test_router_correlation_headers_on_error_paths(tmp_path):
+    """The header contract holds on every path, not just 200s: a pinned
+    404 and a federated trace miss still answer with ``X-Request-Id`` (and
+    ``X-Trace-Id`` on the traced data plane)."""
+    stacks, router, runner, url = await _start_fleet(tmp_path, n=2)
+    client = httpx.AsyncClient(timeout=30.0)
+    try:
+        response = await client.post(
+            f"{url}/v1/sessions/sess-does-not-exist/execute",
+            json={"source_code": "print(1)"},
+        )
+        assert response.status_code == 404
+        assert response.headers.get("X-Request-Id")
+        assert response.headers.get("X-Trace-Id")
+
+        response = await client.get(f"{url}/v1/traces/{'0' * 32}")
+        assert response.status_code == 404
+        assert response.headers.get("X-Request-Id")
+        # Even the miss carries the partial-result accounting.
+        body = response.json()
+        assert body["sources"] == []
+        assert sorted(body["replicas_reporting"]) == ["r0", "r1"]
+    finally:
+        await _stop_fleet(stacks, router, runner, client)
+
+
+async def test_unrouteable_shed_carries_headers():
+    """A 503 from an empty/dead fleet — the shed path that never touches a
+    replica — still carries both correlation headers."""
+    router = FleetRouter([("r0", "http://127.0.0.1:9")], dead_after_s=0.1)
+    runner = web.AppRunner(create_router_app(router))
+    await runner.setup()
+    port = free_port()
+    await web.TCPSite(runner, "127.0.0.1", port).start()
+    client = httpx.AsyncClient(timeout=10.0)
+    try:
+        response = await client.post(
+            f"http://127.0.0.1:{port}/v1/execute",
+            json={"source_code": "print(1)"},
+        )
+        assert response.status_code == 503
+        assert "Retry-After" in response.headers
+        assert response.headers.get("X-Request-Id")
+        trace_id = response.headers.get("X-Trace-Id")
+        assert trace_id
+        # The shed is itself traced: the placement span that found nobody.
+        trace = router.trace_store.get(trace_id)
+        assert trace is not None and "placement" in trace.stage_ms()
+    finally:
+        await client.aclose()
+        await runner.cleanup()
+        await router.stop()
+
+
+# ------------------------------------------------------------- federation
+
+
+async def test_federated_queries_survive_replica_death(tmp_path):
+    """Chaos scenario 17's core clause, tier-1: with 1 of 3 replicas
+    killed, every federated query still answers from the survivors with
+    exact ``replicas_failed`` accounting — never a 500."""
+    stacks, router, runner, url = await _start_fleet(tmp_path, n=3)
+    client = httpx.AsyncClient(timeout=30.0)
+    try:
+        object_id = await stacks[0].storage.write(b"fed-seed")
+        files = {"/workspace/seed.txt": object_id}
+        response = await client.post(
+            f"{url}/v1/execute",
+            json={"source_code": "print(1)", "files": files},
+        )
+        assert response.status_code == 200
+
+        # Kill mid-fleet and query IMMEDIATELY — before the refresh loop
+        # marks it dead the fan-out eats the failure live (unreachable /
+        # breaker / http error), and the answer is already partial-valid.
+        await stacks[2].stop(hard=True)
+        body = (
+            (await client.get(f"{url}/v1/slo")).raise_for_status().json()
+        )
+        assert "r2" in body["replicas_failed"]
+        assert "r2" not in body["replicas_reporting"]
+
+        # Once the refresh loop has marked it dead, the accounting is the
+        # cheap, exact form: reason "dead", no network call spent.
+        await _wait_for_state(router, "r2", "dead")
+        for path in ("/v1/slo", "/v1/traces", "/v1/events", "/v1/tenants"):
+            body = (
+                (await client.get(f"{url}{path}")).raise_for_status().json()
+            )
+            assert body["replicas_failed"] == {"r2": "dead"}, path
+            assert sorted(body["replicas_reporting"]) == ["r0", "r1"], path
+
+        # The incident snapshot: router's own bundle + every survivor's.
+        bundle = (
+            (await client.get(f"{url}/v1/fleet/debug/bundle"))
+            .raise_for_status()
+            .json()
+        )
+        assert bundle["replicas_failed"] == {"r2": "dead"}
+        assert sorted(bundle["replicas"]) == ["r0", "r1"]
+        assert bundle["router"]["snapshot"]["totals"]["routed"] >= 1
+        assert bundle["router"]["slo"] is not None
+        for name in ("r0", "r1"):
+            assert bundle["replicas"][name]["slo"] is not None
+
+        # Fleet SLO rollup: survivors' budget snapshots ride under "fleet".
+        slo = (await client.get(f"{url}/v1/slo")).raise_for_status().json()
+        assert sorted(slo["fleet"]) == ["r0", "r1"]
+        assert slo["fleet_fast_burn"] is False
+    finally:
+        await _stop_fleet(stacks, router, runner, client)
+
+
+async def test_federated_events_merge_and_tail(tmp_path):
+    """The federated ``GET /v1/events`` merges the router's routing journal
+    with the replicas' request journals (each stamped ``source``), and
+    ``?follow=1`` tails the router's own decisions live over SSE."""
+    stacks, router, runner, url = await _start_fleet(tmp_path, n=2)
+    client = httpx.AsyncClient(timeout=30.0)
+    try:
+        response = await client.post(
+            f"{url}/v1/execute", json={"source_code": "print(1)"}
+        )
+        assert response.status_code == 200
+        events = (
+            (await client.get(f"{url}/v1/events"))
+            .raise_for_status()
+            .json()["events"]
+        )
+        sources = {e["source"] for e in events}
+        assert "router" in sources
+        assert sources & {"r0", "r1"}  # at least the serving replica's view
+        assert any(e["kind"] == "routing" for e in events)
+        assert any(e["kind"] == "request" for e in events)
+
+        # Live SSE tail of the router's own journal.
+        lines: list[str] = []
+        async with client.stream(
+            "GET",
+            f"{url}/v1/events",
+            params={"follow": "1", "kind": "routing", "limit": 5},
+        ) as stream:
+            assert stream.status_code == 200
+            async for line in stream.aiter_lines():
+                lines.append(line)
+                if line.startswith("data:"):
+                    break
+        assert any(line == "event: wide_event" for line in lines)
+        data = next(line for line in lines if line.startswith("data:"))
+        assert '"source": "router"' in data
+    finally:
+        await _stop_fleet(stacks, router, runner, client)
+
+
+# ------------------------------------------------- router SLO + burn exit
+
+
+def test_router_slo_is_user_perceived():
+    """The router engine samples what the CLIENT saw: ok/4xx/cancelled are
+    good, error/unavailable/unreachable/unrouteable burn budget, and sheds
+    (deliberate per-tenant verdicts) are excluded entirely."""
+    now = [100.0]
+    router = FleetRouter(
+        [("r0", "http://127.0.0.1:1")],
+        clock=lambda: now[0],
+        slo_objectives=parse_objectives(99.5, None),
+    )
+    for outcome in ("ok", "client_error", "cancelled"):
+        router.record_route("/v1/execute", outcome=outcome, replica="r0")
+    for outcome in ("error", "unavailable", "unreachable", "unrouteable"):
+        router.record_route("/v1/execute", outcome=outcome, replica="r0")
+    router.record_route("/v1/execute", outcome="shed", replica="r0")
+    window = router.slo.snapshot()["objectives"][0]["windows"]["5m"]
+    assert window["total"] == 7  # the shed never landed
+    assert window["bad"] == 4
+
+
+def test_assess_router_burn_exit_ladder():
+    assert assess_router_burn(None) == (0, None)
+    assert assess_router_burn({}) == (0, None)
+    assert assess_router_burn({"fast_burn_alerting": False}) == (0, None)
+    code, message = assess_router_burn({"fast_burn_alerting": True})
+    assert code == SLO_BURN_EXIT and "router edge" in message
+    code, message = assess_router_burn(
+        {
+            "fleet_fast_burn": True,
+            "fleet": {
+                "r1": {"fast_burn_alerting": True},
+                "r0": {"fast_burn_alerting": False},
+            },
+        }
+    )
+    assert code == SLO_BURN_EXIT and "r1" in message and "r0" not in message

@@ -197,6 +197,14 @@ def test_every_registered_metric_follows_conventions(tmp_path):
         "bci_router_retry_budget_denied_total",
         "bci_quota_lease_refresh_total",
         "bci_quota_lease_fleet_size",
+        # fleet observability plane (ISSUE 17): federated scatter-gather
+        # at the router edge + the router's own stage-span histogram
+        # (bci_stage_seconds registers via the router Tracer; slo gauges
+        # via the router SloEngine when objectives are configured)
+        "bci_federation_requests_total",
+        "bci_federation_replica_errors_total",
+        "bci_federation_fanout_seconds",
+        "bci_stage_seconds",
     ):
         assert required in metrics, f"{required}: not registered by the wiring"
     assert isinstance(metrics["bci_pool_spawn_seconds"], Histogram)
