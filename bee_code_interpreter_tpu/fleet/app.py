@@ -954,6 +954,9 @@ def create_router_app(router: FleetRouter) -> web.Application:
     async def fleet_tenants(_request: web.Request) -> web.Response:
         return web.json_response(await router.federation.tenants())
 
+    async def fleet_autoscale(_request: web.Request) -> web.Response:
+        return web.json_response(await router.federation.autoscale())
+
     async def fleet_debug_bundle(_request: web.Request) -> web.Response:
         return web.json_response(await router.federation.debug_bundle())
 
@@ -1005,6 +1008,7 @@ def create_router_app(router: FleetRouter) -> web.Application:
     app.router.add_get("/v1/traces", fleet_traces)
     app.router.add_get("/v1/traces/{trace_id}", fleet_trace)
     app.router.add_get("/v1/tenants", fleet_tenants)
+    app.router.add_get("/v1/autoscale", fleet_autoscale)
     app.router.add_get("/v1/fleet/debug/bundle", fleet_debug_bundle)
     app.router.add_get("/healthz", healthz)
     app.router.add_get("/metrics", metrics_endpoint)

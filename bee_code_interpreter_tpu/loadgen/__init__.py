@@ -1,0 +1,61 @@
+"""Deterministic open-loop load generation + capacity measurement
+(docs/capacity.md).
+
+Every proof elsewhere in-tree is *closed-loop*: a test awaits each response
+before sending the next request, so the offered rate silently degrades to
+whatever the service can absorb and queueing collapse is invisible by
+construction. This package is the open-loop counterpart — arrivals are
+scheduled by wall-clock **intention** (a shape's integrated rate curve),
+fired whether or not earlier responses came back — plus the capacity
+reporter that reads the PR 10–17 observability plane while the load runs
+and binary-searches the max-sustained-rps-at-SLO knee published as
+``CAPACITY_r01.json`` by ``bench.py capacity``.
+
+Layering mirrors ``observability/``: pure primitives here (shapes, mix,
+generator, reporter — stdlib + the repo's own metrics registry only), the
+fleet wiring lives in ``bench.py`` and the chaos suite.
+"""
+
+from bee_code_interpreter_tpu.loadgen.generator import (
+    LoadResult,
+    OpenLoopGenerator,
+    quantile,
+)
+from bee_code_interpreter_tpu.loadgen.mix import (
+    COST_CLASS_PAYLOADS,
+    PlannedRequest,
+    TrafficMix,
+    heavy_tail_weights,
+)
+from bee_code_interpreter_tpu.loadgen.reporter import (
+    CapacityReporter,
+    evaluate_sustained,
+    find_knee,
+)
+from bee_code_interpreter_tpu.loadgen.shapes import (
+    Diurnal,
+    FlashCrowd,
+    Phases,
+    Ramp,
+    Steady,
+    arrival_times,
+)
+
+__all__ = [
+    "COST_CLASS_PAYLOADS",
+    "CapacityReporter",
+    "Diurnal",
+    "FlashCrowd",
+    "LoadResult",
+    "OpenLoopGenerator",
+    "Phases",
+    "PlannedRequest",
+    "Ramp",
+    "Steady",
+    "TrafficMix",
+    "arrival_times",
+    "evaluate_sustained",
+    "find_knee",
+    "heavy_tail_weights",
+    "quantile",
+]

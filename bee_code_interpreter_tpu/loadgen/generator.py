@@ -1,0 +1,311 @@
+"""The open-loop generator: fire at the scheduled instant, never gate on
+responses.
+
+The one invariant that distinguishes this from every closed-loop test in
+the repo: the send loop's only await is *sleeping until the next scheduled
+arrival*. Each request runs as its own task; a slow or collapsing service
+changes what comes BACK, never what goes OUT — so queue growth, shed
+storms, and latency knees show at the offered rate that caused them. The
+schedule lag (intended send instant vs actual) is itself a first-class
+sample: a generator that cannot keep its own schedule invalidates the
+probe, and says so instead of silently under-offering.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import math
+from dataclasses import dataclass, field
+
+from bee_code_interpreter_tpu.loadgen.mix import PlannedRequest, TrafficMix
+from bee_code_interpreter_tpu.loadgen.shapes import arrival_times
+
+TENANT_HEADER = "X-Tenant-Id"
+
+
+def quantile(values: list[float], q: float) -> float:
+    """Nearest-rank quantile, 0.0 on empty — the same convention the
+    DemandTracker uses for spawn latencies."""
+    if not values:
+        return 0.0
+    if not math.isfinite(q):
+        q = 1.0
+    q = min(1.0, max(0.0, q))
+    ordered = sorted(values)
+    idx = min(len(ordered) - 1, int(q * len(ordered)))
+    return ordered[idx]
+
+
+@dataclass
+class Sample:
+    """One fired request's outcome."""
+
+    kind: str
+    cost_class: str
+    tenant: str | None
+    scheduled_s: float
+    lag_s: float
+    latency_s: float
+    status: int | None  # None: transport error or undrained at cutoff
+    error: str | None = None
+
+
+@dataclass
+class LoadResult:
+    """One shape's worth of open-loop samples, with the aggregates the
+    capacity reporter judges."""
+
+    label: str
+    offered: int
+    duration_s: float
+    samples: list[Sample] = field(default_factory=list)
+
+    @property
+    def sent(self) -> int:
+        return len(self.samples)
+
+    @property
+    def completed(self) -> int:
+        return sum(
+            1
+            for s in self.samples
+            if s.status is not None and 200 <= s.status < 300
+        )
+
+    @property
+    def sheds(self) -> int:
+        return sum(1 for s in self.samples if s.status == 429)
+
+    @property
+    def errors(self) -> int:
+        """5xx plus transport failures plus undrained requests — anything
+        a USER would experience as the service failing."""
+        return sum(
+            1
+            for s in self.samples
+            if s.status is None or s.status >= 500
+        )
+
+    @property
+    def offered_rps(self) -> float:
+        return self.offered / self.duration_s if self.duration_s > 0 else 0.0
+
+    @property
+    def achieved_rps(self) -> float:
+        return (
+            self.completed / self.duration_s if self.duration_s > 0 else 0.0
+        )
+
+    def latency_quantile_ms(self, q: float) -> float:
+        oks = [
+            s.latency_s
+            for s in self.samples
+            if s.status is not None and 200 <= s.status < 300
+        ]
+        return quantile(oks, q) * 1000.0
+
+    def lag_quantile_s(self, q: float) -> float:
+        return quantile([max(0.0, s.lag_s) for s in self.samples], q)
+
+    def shed_ledger(self) -> dict[str, int]:
+        """Client-observed 429s by tenant label (``-`` for keyless) — the
+        half of the shed accounting the SERVICE cannot fake; chaos-18
+        reconciles it against the demand tracker's ledger."""
+        out: dict[str, int] = {}
+        for s in self.samples:
+            if s.status == 429:
+                label = s.tenant or "-"
+                out[label] = out.get(label, 0) + 1
+        return {k: out[k] for k in sorted(out)}
+
+    def to_dict(self) -> dict:
+        statuses: dict[str, int] = {}
+        for s in self.samples:
+            key = str(s.status) if s.status is not None else "transport_error"
+            statuses[key] = statuses.get(key, 0) + 1
+        return {
+            "label": self.label,
+            "offered": self.offered,
+            "sent": self.sent,
+            "duration_s": self.duration_s,
+            "offered_rps": self.offered_rps,
+            "achieved_rps": self.achieved_rps,
+            "completed": self.completed,
+            "sheds": self.sheds,
+            "errors": self.errors,
+            "statuses": {k: statuses[k] for k in sorted(statuses)},
+            "latency_ms": {
+                "p50": self.latency_quantile_ms(0.50),
+                "p95": self.latency_quantile_ms(0.95),
+                "p99": self.latency_quantile_ms(0.99),
+            },
+            "schedule_lag_p95_s": self.lag_quantile_s(0.95),
+            "shed_ledger": self.shed_ledger(),
+        }
+
+
+def _outcome_label(status: int | None) -> str:
+    if status is None:
+        return "transport_error"
+    if status == 429:
+        return "shed"
+    if status >= 500:
+        return "error"
+    if status >= 400:
+        return "client_error"
+    return "ok"
+
+
+class OpenLoopGenerator:
+    """Drives one base URL (a replica or a router edge) with planned
+    open-loop traffic. ``client`` is any httpx-compatible async client —
+    the chaos suite passes its in-process ASGI-free transport, bench
+    passes a real socket client."""
+
+    def __init__(
+        self,
+        client,
+        base_url: str,
+        *,
+        mix: TrafficMix | None = None,
+        session_ids: list[str] | None = None,
+        metrics=None,
+        request_timeout_s: float = 30.0,
+    ) -> None:
+        self._client = client
+        self._base_url = base_url.rstrip("/")
+        self._mix = mix or TrafficMix()
+        self._session_ids = list(session_ids or [])
+        self._timeout_s = request_timeout_s
+        self._last_offered_rps = 0.0
+        self._sent_total = None
+        self._lag_seconds = None
+        if metrics is not None:
+            self._sent_total = metrics.counter(
+                "bci_loadgen_sent_total",
+                "Open-loop requests fired, by kind and client-observed "
+                "outcome",
+            )
+            self._lag_seconds = metrics.histogram(
+                "bci_loadgen_lag_seconds",
+                "Scheduled-vs-actual send lag per open-loop request — "
+                "nonzero tails mean the GENERATOR, not the service, was "
+                "the bottleneck",
+            )
+            metrics.gauge(
+                "bci_loadgen_offered_rps",
+                "Offered (intended) arrival rate of the most recent "
+                "open-loop run",
+                lambda: self._last_offered_rps,
+            )
+
+    async def _fire(
+        self, request: PlannedRequest, target_mono: float
+    ) -> Sample:
+        loop = asyncio.get_running_loop()
+        start = loop.time()
+        lag = start - target_mono
+        if self._lag_seconds is not None:
+            self._lag_seconds.observe(max(0.0, lag), kind=request.kind)
+        headers = {}
+        if request.tenant is not None:
+            headers[TENANT_HEADER] = request.tenant
+        url = f"{self._base_url}/v1/execute"
+        params = None
+        if request.kind == "stream":
+            params = {"stream": "1"}
+        elif request.kind == "session" and self._session_ids:
+            sid = self._session_ids[request.index % len(self._session_ids)]
+            url = f"{self._base_url}/v1/sessions/{sid}/execute"
+        status: int | None = None
+        error: str | None = None
+        try:
+            response = await self._client.post(
+                url,
+                json={"source_code": request.source},
+                params=params,
+                headers=headers or None,
+                timeout=self._timeout_s,
+            )
+            status = response.status_code
+        except asyncio.CancelledError:
+            raise
+        except Exception as exc:  # noqa: BLE001 — the outcome IS the data
+            error = type(exc).__name__
+        latency = loop.time() - start
+        if self._sent_total is not None:
+            self._sent_total.inc(
+                kind=request.kind, outcome=_outcome_label(status)
+            )
+        return Sample(
+            kind=request.kind,
+            cost_class=request.cost_class,
+            tenant=request.tenant,
+            scheduled_s=request.at_s,
+            lag_s=lag,
+            latency_s=latency,
+            status=status,
+            error=error,
+        )
+
+    async def run(
+        self,
+        shape,
+        *,
+        label: str = "load",
+        jitter_s: float = 0.0,
+        seed: int = 0,
+        drain_timeout_s: float = 30.0,
+    ) -> LoadResult:
+        """Fire the shape's full schedule open-loop and collect samples.
+        The send loop NEVER awaits a response; after the last scheduled
+        send, in-flight requests get ``drain_timeout_s`` to land, then are
+        cancelled and counted as errors (an overloaded service does not
+        get to launder its queue into an infinite drain)."""
+        times = arrival_times(shape, jitter_s=jitter_s, seed=seed)
+        plan = self._mix.plan(times)
+        result = LoadResult(
+            label=label, offered=len(plan), duration_s=shape.duration_s
+        )
+        self._last_offered_rps = result.offered_rps
+        loop = asyncio.get_running_loop()
+        t0 = loop.time()
+        tasks: list[tuple[PlannedRequest, asyncio.Task]] = []
+        for request in plan:
+            target = t0 + request.at_s
+            delay = target - loop.time()
+            if delay > 0:
+                await asyncio.sleep(delay)
+            tasks.append(
+                (request, asyncio.create_task(self._fire(request, target)))
+            )
+        if tasks:
+            await asyncio.wait(
+                [task for _, task in tasks], timeout=drain_timeout_s
+            )
+        for request, task in tasks:
+            if task.done() and not task.cancelled():
+                result.samples.append(task.result())
+            else:
+                task.cancel()
+                try:
+                    await task
+                except (asyncio.CancelledError, Exception):  # noqa: BLE001
+                    pass
+                result.samples.append(
+                    Sample(
+                        kind=request.kind,
+                        cost_class=request.cost_class,
+                        tenant=request.tenant,
+                        scheduled_s=request.at_s,
+                        lag_s=0.0,
+                        latency_s=drain_timeout_s,
+                        status=None,
+                        error="undrained",
+                    )
+                )
+                if self._sent_total is not None:
+                    self._sent_total.inc(
+                        kind=request.kind, outcome="undrained"
+                    )
+        return result

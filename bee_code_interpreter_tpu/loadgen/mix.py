@@ -1,0 +1,108 @@
+"""Request mixes: what each scheduled arrival actually sends.
+
+The fleet's cost-aware placement (docs/fleet.md) keys off
+``analysis.classify_cost``; the payloads here are chosen so the
+CLASSIFIER sees each cost class while the sandbox does near-zero work —
+the ``accelerator`` payload carries a statically-visible ``import jax``
+inside an ``if False:`` arm, so the router steers it like TPU work
+without any sandbox ever paying the import. Tenant assignment follows a
+seeded weighted draw; ``heavy_tail_weights`` produces the Zipf-like skew
+(one hot tenant, a long cold tail) that makes per-tenant isolation tests
+mean something.
+"""
+
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass
+
+# One near-free payload per analysis.policy.COST_CLASSES verdict (minus
+# install_heavy, which would hit the dependency gate, not the pool).
+COST_CLASS_PAYLOADS: dict[str, str] = {
+    "cheap": "print(21 * 2)",
+    "loopy": (
+        "total = 0\n"
+        "for i in range(3):\n"
+        "    for j in range(3):\n"
+        "        total += i * j\n"
+        "print(total)"
+    ),
+    "io_heavy": (
+        "with open('loadgen.txt', 'w') as f:\n"
+        "    f.write('x')\n"
+        "print('io')"
+    ),
+    "accelerator": "if False:\n    import jax\nprint('accel')",
+}
+
+
+def heavy_tail_weights(
+    names: list[str] | tuple[str, ...], exponent: float = 1.5
+) -> list[tuple[str, float]]:
+    """Zipf-like weights over ``names``: the first gets weight 1, the
+    k-th gets 1/k**exponent — the canonical heavy-tail tenant popularity
+    curve."""
+    return [
+        (name, 1.0 / (k + 1) ** exponent) for k, name in enumerate(names)
+    ]
+
+
+@dataclass(frozen=True)
+class PlannedRequest:
+    """One scheduled arrival, fully decided before the load starts."""
+
+    index: int
+    at_s: float
+    kind: str  # execute | session | stream
+    cost_class: str
+    tenant: str | None
+    source: str
+
+
+class TrafficMix:
+    """Seeded weighted assignment of (kind, cost class, tenant) to each
+    arrival slot. Same seed → same plan, so a probe is repeatable."""
+
+    def __init__(
+        self,
+        *,
+        kinds: tuple = (("execute", 7.0), ("session", 2.0), ("stream", 1.0)),
+        cost_classes: tuple = (
+            ("cheap", 8.0),
+            ("loopy", 2.0),
+            ("io_heavy", 1.0),
+            ("accelerator", 1.0),
+        ),
+        tenants: list[tuple[str, float]] | None = None,
+        seed: int = 0,
+    ) -> None:
+        self._kinds = [k for k, _ in kinds]
+        self._kind_weights = [w for _, w in kinds]
+        self._classes = [c for c, _ in cost_classes]
+        self._class_weights = [w for _, w in cost_classes]
+        self._tenants = [t for t, _ in tenants] if tenants else None
+        self._tenant_weights = [w for _, w in tenants] if tenants else None
+        self._seed = seed
+
+    def plan(self, times: list[float]) -> list[PlannedRequest]:
+        """Assign every arrival in one pass with one seeded rng — calling
+        again with the same times reproduces the identical plan."""
+        rng = random.Random(self._seed)
+        out: list[PlannedRequest] = []
+        for index, at_s in enumerate(times):
+            kind = rng.choices(self._kinds, self._kind_weights)[0]
+            cost_class = rng.choices(self._classes, self._class_weights)[0]
+            tenant = None
+            if self._tenants:
+                tenant = rng.choices(self._tenants, self._tenant_weights)[0]
+            out.append(
+                PlannedRequest(
+                    index=index,
+                    at_s=at_s,
+                    kind=kind,
+                    cost_class=cost_class,
+                    tenant=tenant,
+                    source=COST_CLASS_PAYLOADS[cost_class],
+                )
+            )
+        return out

@@ -1,0 +1,203 @@
+"""The capacity reporter: judge a load run against the SLO, find the knee.
+
+The generator says what the CLIENT saw; this module reads what the
+SERVICE said about itself while it happened — the federated ``/v1/slo``
+burn state and the ``/v1/autoscale`` demand/forecast/recommendation
+document — and combines both into one verdict per probe:
+
+    sustained  ⇔  p99 ≤ threshold
+               ∧  user-visible error ratio within the SLO's error budget
+               ∧  shed ratio within budget
+               ∧  achieved ≥ 90% of offered
+               ∧  no fast-burn page fired
+               ∧  the generator held its own schedule
+
+``find_knee`` then bisects offered rps on that predicate: the largest
+rate every probe sustained is the max-sustained-rps-at-SLO that
+``CAPACITY_r01.json`` publishes, and the probes themselves are the
+p99-vs-load curve.
+"""
+
+from __future__ import annotations
+
+import statistics
+
+from bee_code_interpreter_tpu.loadgen.generator import LoadResult
+from bee_code_interpreter_tpu.loadgen.shapes import Steady
+
+
+class CapacityReporter:
+    """Scrapes one base URL's observability plane. Works identically
+    against a replica edge and a router edge: both serve ``/v1/slo`` and
+    ``/v1/autoscale`` (the router's are the federated documents). With an
+    in-process router handle, also reads per-stage trace p50s."""
+
+    def __init__(self, client, base_url: str, *, router=None) -> None:
+        self._client = client
+        self._base_url = base_url.rstrip("/")
+        self._router = router
+
+    async def _get(self, path: str) -> dict | None:
+        try:
+            response = await self._client.get(
+                f"{self._base_url}{path}", timeout=10.0
+            )
+        except Exception:  # noqa: BLE001 — a scrape must never kill a probe
+            return None
+        if response.status_code != 200:
+            return None
+        try:
+            body = response.json()
+        except ValueError:
+            return None
+        return body if isinstance(body, dict) else None
+
+    async def scrape(self) -> dict:
+        """One observation of the plane: SLO + autoscale, each None when
+        the edge cannot answer (scrapes are best-effort by contract)."""
+        slo = await self._get("/v1/slo")
+        autoscale = await self._get("/v1/autoscale")
+        return {
+            "slo": slo,
+            "autoscale": autoscale,
+            "fast_burn": bool((slo or {}).get("fast_burn_alerting"))
+            or bool((slo or {}).get("fleet_fast_burn")),
+            "warm_pop_ratio": _warm_pop_ratio(autoscale),
+            "recommendation": (autoscale or {}).get("recommendation"),
+        }
+
+    def stage_p50_ms(self) -> dict[str, float]:
+        """Per-stage router-tax breakdown from the in-process trace store
+        (same computation as the bench router phase); empty without a
+        router handle."""
+        if self._router is None:
+            return {}
+        by_stage: dict[str, list[float]] = {}
+        for trace in self._router.trace_store.traces():
+            for stage, ms in trace.stage_ms().items():
+                by_stage.setdefault(stage, []).append(ms)
+        return {
+            stage: round(statistics.median(samples), 3)
+            for stage, samples in sorted(by_stage.items())
+        }
+
+
+def _warm_pop_ratio(autoscale: dict | None) -> float | None:
+    if not autoscale:
+        return None
+    demand = autoscale.get("demand") or {}
+    for key in ("warm_pop_ratio_60s", "warm_pop_ratio_min"):
+        if demand.get(key) is not None:
+            return demand[key]
+    return None
+
+
+def evaluate_sustained(
+    result: LoadResult,
+    scrape: dict | None = None,
+    *,
+    p99_ms: float,
+    error_budget: float = 0.005,
+    shed_budget: float = 0.01,
+    max_lag_s: float = 0.25,
+) -> dict:
+    """The at-SLO verdict for one probe, with every failed criterion
+    named — a knee you cannot explain is a number, not a measurement."""
+    reasons: list[str] = []
+    sent = max(1, result.sent)
+    p99 = result.latency_quantile_ms(0.99)
+    if p99 > p99_ms:
+        reasons.append(f"p99 {p99:.0f}ms > {p99_ms:.0f}ms")
+    error_ratio = result.errors / sent
+    if error_ratio > error_budget:
+        reasons.append(f"error ratio {error_ratio:.3f} > {error_budget}")
+    shed_ratio = result.sheds / sent
+    if shed_ratio > shed_budget:
+        reasons.append(f"shed ratio {shed_ratio:.3f} > {shed_budget}")
+    if result.achieved_rps < 0.9 * result.offered_rps:
+        reasons.append(
+            f"achieved {result.achieved_rps:.2f} rps < 90% of offered "
+            f"{result.offered_rps:.2f}"
+        )
+    if scrape is not None and scrape.get("fast_burn"):
+        reasons.append("fast-burn page fired")
+    lag = result.lag_quantile_s(0.95)
+    if lag > max_lag_s:
+        # The generator fell behind its own schedule: the probe measured
+        # the load box, not the service — an invalid probe counts as
+        # unsustained so the knee search stays conservative.
+        reasons.append(f"generator lag p95 {lag:.2f}s > {max_lag_s}s")
+    return {"sustained": not reasons, "reasons": reasons}
+
+
+async def find_knee(
+    generator,
+    *,
+    lo_rps: float,
+    hi_rps: float,
+    duration_s: float,
+    p99_ms: float,
+    reporter: CapacityReporter | None = None,
+    iterations: int = 5,
+    error_budget: float = 0.005,
+    shed_budget: float = 0.01,
+    drain_timeout_s: float = 15.0,
+    settle_s: float = 0.0,
+    on_probe=None,
+) -> tuple[float, list[dict]]:
+    """Bisect offered steady rps on the sustained predicate. Returns
+    ``(knee_rps, probes)``: the largest rate that sustained (0.0 when even
+    ``lo_rps`` did not) plus every probe point — offered/achieved rps,
+    latency quantiles, sheds, the plane scrape — oldest first, which IS
+    the p99-vs-load curve."""
+    probes: list[dict] = []
+    knee = 0.0
+
+    async def probe(rps: float) -> bool:
+        result = await generator.run(
+            Steady(rps=rps, duration_s=duration_s),
+            label=f"steady-{rps:g}rps",
+            drain_timeout_s=drain_timeout_s,
+        )
+        scrape = await reporter.scrape() if reporter is not None else None
+        verdict = evaluate_sustained(
+            result,
+            scrape,
+            p99_ms=p99_ms,
+            error_budget=error_budget,
+            shed_budget=shed_budget,
+        )
+        point = {
+            "offered_rps": result.offered_rps,
+            **verdict,
+            "result": result.to_dict(),
+            "warm_pop_ratio": (scrape or {}).get("warm_pop_ratio"),
+            "recommendation": (scrape or {}).get("recommendation"),
+        }
+        probes.append(point)
+        if on_probe is not None:
+            on_probe(point)
+        if settle_s > 0:
+            # Let queues fully drain between probes so each rate is judged
+            # from a clean start, not the previous probe's backlog.
+            import asyncio
+
+            await asyncio.sleep(settle_s)
+        return verdict["sustained"]
+
+    if not await probe(lo_rps):
+        return 0.0, probes
+    knee = lo_rps
+    if await probe(hi_rps):
+        return hi_rps, probes
+    lo, hi = lo_rps, hi_rps
+    for _ in range(max(0, iterations - 2)):
+        mid = (lo + hi) / 2.0
+        if hi - lo < 0.5:
+            break
+        if await probe(mid):
+            knee = mid
+            lo = mid
+        else:
+            hi = mid
+    return knee, probes

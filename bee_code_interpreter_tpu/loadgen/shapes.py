@@ -1,0 +1,148 @@
+"""Composable traffic shapes → deterministic arrival schedules.
+
+A shape is a rate curve ``rate_at(t) -> rps`` over a finite duration; the
+arrival schedule is its integral: the k-th request fires when the
+cumulative expected-arrival count crosses k. That makes schedules exactly
+reproducible (same shape, same jitter seed → byte-identical schedule),
+which is what lets a capacity probe be re-run and compared — the classic
+open-loop construction from the load-testing literature, where arrivals
+model USERS (who do not politely wait for the previous user's response)
+rather than a single serialized client.
+"""
+
+from __future__ import annotations
+
+import math
+import random
+from dataclasses import dataclass
+
+
+@dataclass(frozen=True)
+class Steady:
+    """Constant ``rps`` for ``duration_s`` — the capacity-probe unit."""
+
+    rps: float
+    duration_s: float
+
+    def rate_at(self, t: float) -> float:
+        return self.rps if 0.0 <= t < self.duration_s else 0.0
+
+
+@dataclass(frozen=True)
+class Ramp:
+    """Linear ``start_rps`` → ``end_rps`` sweep: where the p99-vs-load
+    curve's knee shows up as a bend, not a cliff."""
+
+    start_rps: float
+    end_rps: float
+    duration_s: float
+
+    def rate_at(self, t: float) -> float:
+        if not 0.0 <= t < self.duration_s:
+            return 0.0
+        frac = t / self.duration_s if self.duration_s > 0 else 0.0
+        return self.start_rps + (self.end_rps - self.start_rps) * frac
+
+
+@dataclass(frozen=True)
+class Diurnal:
+    """One (or more) raised-cosine day cycles compressed into
+    ``duration_s`` — trough at t=0, crest mid-period. The forecaster's
+    EWMA trend term exists for exactly this curve."""
+
+    base_rps: float
+    peak_rps: float
+    duration_s: float
+    period_s: float | None = None
+
+    def rate_at(self, t: float) -> float:
+        if not 0.0 <= t < self.duration_s:
+            return 0.0
+        period = self.period_s or self.duration_s
+        if period <= 0:
+            return self.base_rps
+        swing = (1.0 - math.cos(2.0 * math.pi * t / period)) / 2.0
+        return self.base_rps + (self.peak_rps - self.base_rps) * swing
+
+
+@dataclass(frozen=True)
+class FlashCrowd:
+    """``base_rps`` with a ``multiplier``× step during
+    [``crowd_start_s``, ``crowd_start_s + crowd_s``) — the league-client
+    stampede the warm pool exists to absorb. Default 10×."""
+
+    base_rps: float
+    duration_s: float
+    crowd_start_s: float
+    crowd_s: float
+    multiplier: float = 10.0
+
+    def rate_at(self, t: float) -> float:
+        if not 0.0 <= t < self.duration_s:
+            return 0.0
+        if self.crowd_start_s <= t < self.crowd_start_s + self.crowd_s:
+            return self.base_rps * self.multiplier
+        return self.base_rps
+
+
+@dataclass(frozen=True)
+class Phases:
+    """Shapes in sequence (steady warm-up, then a ramp, then a crowd…);
+    each phase's clock starts at zero when the previous one ends."""
+
+    phases: tuple
+
+    @property
+    def duration_s(self) -> float:
+        return sum(p.duration_s for p in self.phases)
+
+    def rate_at(self, t: float) -> float:
+        if t < 0.0:
+            return 0.0
+        for phase in self.phases:
+            if t < phase.duration_s:
+                return phase.rate_at(t)
+            t -= phase.duration_s
+        return 0.0
+
+
+def arrival_times(
+    shape,
+    *,
+    jitter_s: float = 0.0,
+    seed: int = 0,
+    dt: float = 0.001,
+) -> list[float]:
+    """Integrate the shape's rate curve into a sorted arrival schedule
+    (seconds from load start). Deterministic: fixed-step trapezoid-free
+    integration (the step is small against any sane rate), plus optional
+    uniform ``±jitter_s`` from a SEEDED rng so two runs with the same seed
+    stress identical instants."""
+    duration = float(shape.duration_s)
+    if duration <= 0.0 or dt <= 0.0:
+        return []
+    times: list[float] = []
+    accumulated = 0.0
+    target = 1.0
+    steps = int(math.ceil(duration / dt))
+    # The epsilon absorbs the drift of summing ~duration/dt tiny floats:
+    # without it, an exact-integral shape (5 rps × 4 s = 20) drops its
+    # final arrival at 19.999999…
+    eps = 1e-6
+    for step in range(steps):
+        t = step * dt
+        # Midpoint rule: exact for the piecewise-linear shapes (a left sum
+        # under-integrates every ramp by (end−start)·dt/2 and loses the
+        # final arrival).
+        accumulated += max(0.0, shape.rate_at(t + 0.5 * dt)) * dt
+        while accumulated >= target - eps:
+            times.append(min(t, duration))
+            target += 1.0
+    if jitter_s > 0.0:
+        rng = random.Random(seed)
+        times = [
+            min(duration, max(0.0, t + rng.uniform(-jitter_s, jitter_s)))
+            for t in times
+        ]
+        times.sort()
+    return times

@@ -25,6 +25,7 @@ from bee_code_interpreter_tpu.observability.contprof import (
 )
 from bee_code_interpreter_tpu.observability.forecast import (
     Forecaster,
+    recommend_replicas,
 )
 from bee_code_interpreter_tpu.observability.fleet import (
     FleetJournal,
@@ -135,6 +136,7 @@ __all__ = [
     "inject_profile_env",
     "merge_worker_usage",
     "profile_artifacts",
+    "recommend_replicas",
     "record_sli",
     "record_transfer",
     "record_usage_at_edge",

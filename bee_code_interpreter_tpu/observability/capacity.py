@@ -24,6 +24,7 @@ the chaos/autoscale suites drive time deterministically. Served as the
 
 from __future__ import annotations
 
+import math
 import time
 from collections import deque
 from typing import Callable
@@ -139,7 +140,13 @@ class DemandTracker:
         must cover."""
         bucket = self._bucket()
         bucket.admitted += 1
-        bucket.queue_wait_sum += max(0.0, queue_wait_s)
+        if not math.isfinite(queue_wait_s):
+            # A NaN/inf wait (a clock that jumped, a poisoned caller) must
+            # not poison the whole window's avg/max — drop the sample, keep
+            # the admission count.
+            queue_wait_s = 0.0
+        queue_wait_s = max(0.0, queue_wait_s)
+        bucket.queue_wait_sum += queue_wait_s
         bucket.queue_wait_max = max(bucket.queue_wait_max, queue_wait_s)
         bucket.concurrency_hw = max(bucket.concurrency_hw, in_flight)
 
@@ -156,21 +163,35 @@ class DemandTracker:
                 bucket.cold_spawns += 1
         elif state == "ready" and event.get("spawn_s") is not None:
             try:
-                self._spawn_s.append(float(event["spawn_s"]))
+                spawn_s = float(event["spawn_s"])
             except (TypeError, ValueError):
-                pass
+                return
+            # The sample ring feeds the forecaster's horizon: one NaN/inf
+            # (or a negative from a clock step) would make every quantile —
+            # and therefore the scaling horizon — garbage for the next 64
+            # spawns. Refuse the sample, not just the crash.
+            if math.isfinite(spawn_s) and spawn_s >= 0.0:
+                self._spawn_s.append(spawn_s)
 
     # ------------------------------------------------------------- readers
+
+    def _clamp_window(self, window_s: float) -> float:
+        """Windows are trailing seconds within the retained ring. A NaN or
+        non-positive request would otherwise leak into a division and come
+        back as NaN on a gauge — clamp to [0, retained window] instead."""
+        if not math.isfinite(window_s) or window_s <= 0.0:
+            return 0.0
+        return min(window_s, self._window_s)
 
     def _window_buckets(self, window_s: float) -> list[_DemandBucket]:
         # A bucket belongs while its second STARTS within the window (the
         # class contract): end-inside inclusion would sum up to one extra
         # bucket and overstate every rate by up to 1/window_s.
-        floor = self._clock() - min(window_s, self._window_s)
+        floor = self._clock() - self._clamp_window(window_s)
         return [b for idx, b in self._buckets.items() if idx >= floor]
 
     def rate_rps(self, window_s: float = 10.0) -> float:
-        window_s = min(window_s, self._window_s)
+        window_s = self._clamp_window(window_s)
         arrivals = sum(b.arrivals for b in self._window_buckets(window_s))
         return arrivals / window_s if window_s > 0 else 0.0
 
@@ -236,6 +257,9 @@ class DemandTracker:
         journal's ``ready`` events); None before the first spawn."""
         if not self._spawn_s:
             return None
+        if not math.isfinite(q):
+            q = 1.0
+        q = min(1.0, max(0.0, q))
         ordered = sorted(self._spawn_s)
         idx = min(len(ordered) - 1, int(q * len(ordered)))
         return ordered[idx]

@@ -64,6 +64,13 @@ class FederationPlane:
             "bci_federation_fanout_seconds",
             "Wall-clock of one federated scatter-gather, by endpoint",
         )
+        self._last_target_replicas = 0
+        metrics.gauge(
+            "bci_fleet_target_replicas",
+            "Replica count the federated autoscale query last recommended "
+            "at this router edge (0 before the first query)",
+            lambda: float(self._last_target_replicas),
+        )
 
     # ------------------------------------------------------------ fan-out
 
@@ -302,6 +309,99 @@ class FederationPlane:
         body = {
             "replicas": {k: replicas[k] for k in sorted(replicas)},
             "quota": self._router.ledger.snapshot(),
+        }
+        return self._accounted(body, answers, failed)
+
+    async def autoscale(self) -> dict:
+        """Federated ``GET /v1/autoscale``: each live replica's demand/
+        forecast document side by side, summed into one fleet-wide demand
+        signal, and — the loop the forecaster exists for — a fleet
+        **replica-count recommendation** (docs/capacity.md). Rates and
+        concurrency add across replicas; the horizon is the slowest
+        replica's (a pre-spawn must beat the worst spawn anywhere); the
+        per-replica capacity unit is the largest pool ceiling any replica
+        reports. A replica answering 501 (no capacity tracker wired)
+        reports ``null`` — its honest answer, not a failure. The router's
+        own user-perceived fast-burn page vetoes any shrink, exactly as on
+        the single-replica edge."""
+        from bee_code_interpreter_tpu.observability.forecast import (
+            recommend_replicas,
+        )
+
+        answers, failed = await self._fan_out(
+            "autoscale", "/v1/autoscale", accept=(200, 501)
+        )
+        router = self._router
+        replicas = {
+            name: (doc if status == 200 else None)
+            for name, (status, doc) in answers.items()
+        }
+        wired = [doc for doc in replicas.values() if doc is not None]
+        demands = [d.get("demand") or {} for d in wired]
+        forecasts = [d.get("forecast") or {} for d in wired]
+        by_tenant: dict[str, dict[str, int]] = {}
+        for demand in demands:
+            for tenant, counts in (demand.get("by_tenant") or {}).items():
+                slot = by_tenant.setdefault(
+                    tenant, {"arrivals": 0, "sheds": 0}
+                )
+                slot["arrivals"] += int(counts.get("arrivals") or 0)
+                slot["sheds"] += int(counts.get("sheds") or 0)
+        fleet_demand = {
+            "rps_10s": sum(d.get("rps_10s") or 0.0 for d in demands),
+            "peak_rps_60s": sum(d.get("peak_rps_60s") or 0.0 for d in demands),
+            "sheds_60s": sum(int(d.get("sheds_60s") or 0) for d in demands),
+            "sheds_total": sum(int(d.get("sheds_total") or 0) for d in demands),
+            "arrivals_total": sum(
+                int(d.get("arrivals_total") or 0) for d in demands
+            ),
+            "concurrency_high_water_60s": sum(
+                int(d.get("concurrency_high_water_60s") or 0) for d in demands
+            ),
+            "warm_pop_ratio_min": min(
+                (
+                    d.get("warm_pop_ratio_60s")
+                    for d in demands
+                    if d.get("warm_pop_ratio_60s") is not None
+                ),
+                default=1.0,
+            ),
+            "by_tenant": {k: by_tenant[k] for k in sorted(by_tenant)},
+        }
+        fleet_forecast = {
+            "forecast_rps": sum(
+                f.get("forecast_rps") or 0.0 for f in forecasts
+            ),
+            "horizon_s": max(
+                (f.get("horizon_s") or 0.0 for f in forecasts), default=0.0
+            ),
+        }
+        per_replica = max(
+            (int(d.get("max") or 0) for d in wired), default=0
+        ) or 8
+        now = self._clock()
+        states = {"healthy": 0, "draining": 0, "dead": 0}
+        for replica in router.replicas.values():
+            state = replica.state(now, router.dead_after_s)
+            states[state] = states.get(state, 0) + 1
+        burn = bool(
+            router.slo.snapshot().get("fast_burn_alerting", False)
+        )
+        recommendation = recommend_replicas(
+            forecast_rps=fleet_forecast["forecast_rps"],
+            horizon_s=fleet_forecast["horizon_s"],
+            concurrency_high_water=fleet_demand["concurrency_high_water_60s"],
+            per_replica_capacity=per_replica,
+            current_replicas=states["healthy"],
+            slo_fast_burn=burn,
+        )
+        self._last_target_replicas = recommendation["target_replicas"]
+        body = {
+            "demand": fleet_demand,
+            "forecast": fleet_forecast,
+            "recommendation": recommendation,
+            "replica_states": states,
+            "replicas": {k: replicas[k] for k in sorted(replicas)},
         }
         return self._accounted(body, answers, failed)
 

@@ -24,7 +24,17 @@ a ManualClock, nothing to keep consistent, and the ring is at most
 
 from __future__ import annotations
 
+import math
+
 from bee_code_interpreter_tpu.observability.capacity import DemandTracker
+
+
+def _finite(value: float, fallback: float) -> float:
+    try:
+        value = float(value)
+    except (TypeError, ValueError):
+        return fallback
+    return value if math.isfinite(value) else fallback
 
 
 class Forecaster:
@@ -40,11 +50,16 @@ class Forecaster:
         metrics=None,
     ) -> None:
         self._demand = demand
-        self._alpha = min(1.0, max(0.0, alpha))
-        self._beta = min(1.0, max(0.0, beta))
-        self._peak_window_s = peak_window_s
-        self._min_horizon_s = min_horizon_s
-        self._max_horizon_s = max_horizon_s
+        self._alpha = min(1.0, max(0.0, _finite(alpha, 0.4)))
+        self._beta = min(1.0, max(0.0, _finite(beta, 0.2)))
+        self._peak_window_s = max(0.0, _finite(peak_window_s, 60.0))
+        # An inverted or non-finite band would make horizon_s() — and with
+        # it every proactive spawn decision — NaN or permanently pinned;
+        # normalize once here so horizon_s() is a pure clamp.
+        min_h = max(0.0, _finite(min_horizon_s, 1.0))
+        max_h = _finite(max_horizon_s, 60.0)
+        self._min_horizon_s = min_h
+        self._max_horizon_s = max(min_h, max_h)
         if metrics is not None:
             metrics.gauge(
                 "bci_forecast_rps",
@@ -88,3 +103,62 @@ class Forecaster:
             "horizon_s": horizon,
             "samples": len(series),
         }
+
+
+def recommend_replicas(
+    *,
+    forecast_rps: float,
+    horizon_s: float,
+    concurrency_high_water: float = 0.0,
+    per_replica_capacity: int = 8,
+    current_replicas: int = 1,
+    min_replicas: int = 1,
+    max_replicas: int = 64,
+    slo_fast_burn: bool = False,
+) -> dict:
+    """Turn the forecast into a concrete replica count — the
+    ``recommendation`` section of ``GET /v1/autoscale`` on both edges
+    (docs/capacity.md).
+
+    Same sizing rule as :class:`~..resilience.autoscaler.PoolAutoscaler`
+    applies to sandboxes, lifted one level: the fleet must cover
+    ``max(forecast_rps * horizon_s, concurrency_high_water)`` in-flight
+    requests, and each replica covers ``per_replica_capacity`` of them
+    (its admission ``max_in_flight`` / pool ceiling). An active fast-burn
+    page overrides arithmetic — capacity math that says "shrink" while
+    users are failing is wrong by definition, so burn holds or grows the
+    fleet by one. Every input is NaN/inf-guarded: this document feeds an
+    actuator."""
+    forecast_rps = max(0.0, _finite(forecast_rps, 0.0))
+    horizon_s = max(0.0, _finite(horizon_s, 0.0))
+    concurrency_high_water = max(0.0, _finite(concurrency_high_water, 0.0))
+    per_replica_capacity = max(1, int(_finite(per_replica_capacity, 1)))
+    min_replicas = max(0, int(_finite(min_replicas, 1)))
+    max_replicas = max(min_replicas, int(_finite(max_replicas, 64)))
+    current_replicas = max(0, int(_finite(current_replicas, 0)))
+
+    needed = max(forecast_rps * horizon_s, concurrency_high_water)
+    target = math.ceil(needed / per_replica_capacity) if needed > 0 else 0
+    reason = "forecast"
+    if target <= 0:
+        target = min_replicas
+        reason = "idle"
+    if slo_fast_burn and target <= current_replicas:
+        # Never recommend scale-in (or even steady-state) while the page
+        # is firing: whatever the demand math says, the fleet is failing
+        # users at its CURRENT size.
+        target = current_replicas + 1
+        reason = "slo_burn"
+    clamped = min(max_replicas, max(min_replicas, target))
+    if clamped != target and reason != "slo_burn":
+        reason = "clamped"
+    return {
+        "target_replicas": clamped,
+        "reason": reason,
+        "needed_slots": needed,
+        "per_replica_capacity": per_replica_capacity,
+        "current_replicas": current_replicas,
+        "min_replicas": min_replicas,
+        "max_replicas": max_replicas,
+        "slo_fast_burn": bool(slo_fast_burn),
+    }

@@ -264,11 +264,24 @@ class PoolAutoscaler:
         }
 
 
-def autoscale_snapshot(demand=None, forecaster=None, autoscaler=None) -> dict:
+def autoscale_snapshot(
+    demand=None, forecaster=None, autoscaler=None, slo=None
+) -> dict:
     """The ``GET /v1/autoscale`` document, shared by both transports (and
     the debug bundle) so they can never disagree. Pool-less deployments
     (the in-process local backend) have no autoscaler: the demand and
-    forecast sections still answer, the autoscaler section is null."""
+    forecast sections still answer, the autoscaler section is null.
+
+    ``recommendation`` closes the forecast→fleet-size loop
+    (docs/capacity.md): the same demand signal the pool autoscaler sizes
+    sandboxes with, restated as a replica count a fleet controller can
+    actuate. A single replica reports its OWN capacity as the unit; the
+    router's federated ``GET /v1/autoscale`` recomputes the same document
+    fleet-wide."""
+    from bee_code_interpreter_tpu.observability.forecast import (
+        recommend_replicas,
+    )
+
     body: dict = {
         "demand": demand.snapshot() if demand is not None else None,
         "forecast": forecaster.forecast() if forecaster is not None else None,
@@ -287,4 +300,21 @@ def autoscale_snapshot(demand=None, forecaster=None, autoscaler=None) -> dict:
                 "last_decision": None,
             }
         )
+    forecast = body["forecast"]
+    demand_doc = body["demand"]
+    per_replica = body.get("max") or 8
+    burn = False
+    if slo is not None:
+        burn = bool(slo.snapshot().get("fast_burn_alerting", False))
+    body["recommendation"] = recommend_replicas(
+        forecast_rps=(forecast or {}).get("forecast_rps", 0.0) or 0.0,
+        horizon_s=(forecast or {}).get("horizon_s", 0.0) or 0.0,
+        concurrency_high_water=(demand_doc or {}).get(
+            "concurrency_high_water_60s", 0.0
+        )
+        or 0.0,
+        per_replica_capacity=per_replica,
+        current_replicas=1,
+        slo_fast_burn=burn,
+    )
     return body

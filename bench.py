@@ -1158,6 +1158,321 @@ def patient_tpu_capture(state: dict, patience_s: float) -> float | None:
     return None
 
 
+CAPACITY_ARTIFACT = REPO / "CAPACITY_r01.json"
+# The at-SLO p99 threshold for the knee search: generous against the warm
+# execute p50 (tens of ms) so the knee marks queueing collapse, not jitter.
+CAPACITY_SLO_P99_MS = 1500.0
+CAPACITY_PROBE_S = 4.0
+
+
+def _capacity_free_port() -> int:
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+async def _capacity_replica(binary: Path, tmp: Path, shared_root: Path, index: int) -> dict:
+    """One COMPLETE capacity-instrumented replica over the native pool:
+    real HTTP edge + admission + SLO engine + DemandTracker/Forecaster
+    wired into GET /v1/autoscale — the production edge shape the loadgen
+    measures, sharing a snapshot root with its siblings."""
+    from aiohttp import web
+
+    from bee_code_interpreter_tpu.api.http_server import create_http_server
+    from bee_code_interpreter_tpu.config import Config
+    from bee_code_interpreter_tpu.observability import (
+        DemandTracker,
+        Forecaster,
+        SloEngine,
+        parse_objectives,
+    )
+    from bee_code_interpreter_tpu.resilience import AdmissionController
+    from bee_code_interpreter_tpu.resilience.autoscaler import autoscale_snapshot
+    from bee_code_interpreter_tpu.services.custom_tool_executor import (
+        CustomToolExecutor,
+    )
+    from bee_code_interpreter_tpu.services.native_process_code_executor import (
+        NativeProcessCodeExecutor,
+    )
+    from bee_code_interpreter_tpu.services.storage import (
+        SharedDirectoryBackend,
+        Storage,
+    )
+    from bee_code_interpreter_tpu.sessions import SessionManager
+    from bee_code_interpreter_tpu.utils.metrics import Registry
+
+    metrics = Registry()
+    demand = DemandTracker(window_s=30.0, metrics=metrics)
+    forecaster = Forecaster(
+        demand, peak_window_s=10.0, max_horizon_s=5.0, metrics=metrics
+    )
+    storage = Storage(backend=SharedDirectoryBackend(shared_root))
+    config = Config(
+        file_storage_path=str(shared_root),
+        local_workspace_root=str(tmp / f"ws-{index}"),
+        executor_pod_queue_target_length=2,
+        disable_dep_install=True,
+    )
+    executor = NativeProcessCodeExecutor(
+        storage=storage, config=config, binary=binary, metrics=metrics
+    )
+    executor.journal.add_sink(demand.on_fleet_event)
+    await executor.fill_sandbox_queue()
+    slo = SloEngine(parse_objectives(99.5, None), metrics=metrics)
+    admission = AdmissionController(
+        max_in_flight=8,
+        max_queue=16,
+        retry_after_s=0.2,
+        metrics=metrics,
+        demand=demand,
+    )
+    sessions = SessionManager(
+        executor, storage, max_sessions=4, ttl_s=300, idle_s=300,
+        metrics=metrics,
+    )
+    app = create_http_server(
+        code_executor=executor,
+        custom_tool_executor=CustomToolExecutor(code_executor=executor),
+        metrics=metrics,
+        admission=admission,
+        slo=slo,
+        sessions=sessions,
+        fleet=executor.journal,
+        autoscale=lambda: autoscale_snapshot(
+            demand=demand, forecaster=forecaster, slo=slo
+        ),
+    )
+    runner = web.AppRunner(app)
+    await runner.setup()
+    port = _capacity_free_port()
+    await web.TCPSite(runner, "127.0.0.1", port).start()
+    return {
+        "name": f"r{index}",
+        "url": f"http://127.0.0.1:{port}",
+        "executor": executor,
+        "runner": runner,
+        "sessions": sessions,
+    }
+
+
+def _capacity_point(point: dict) -> dict:
+    """One p99-vs-load curve point for the artifact: the verdict plus the
+    quantiles that explain it, without the full per-sample dump."""
+    result = point.get("result") or {}
+    latency = result.get("latency_ms") or {}
+    rec = point.get("recommendation") or {}
+
+    def r1(value):
+        return None if value is None else round(value, 1)
+
+    warm = point.get("warm_pop_ratio")
+    return {
+        "offered_rps": round(point["offered_rps"], 2),
+        "achieved_rps": r1(result.get("achieved_rps")),
+        "sustained": point["sustained"],
+        "reasons": point["reasons"],
+        "p50_ms": r1(latency.get("p50")),
+        "p95_ms": r1(latency.get("p95")),
+        "p99_ms": r1(latency.get("p99")),
+        "sheds": result.get("sheds"),
+        "errors": result.get("errors"),
+        "warm_pop_ratio": None if warm is None else round(warm, 3),
+        "recommended_replicas": rec.get("target_replicas"),
+    }
+
+
+async def _capacity_probe_config(
+    client, base_url: str, *, replicas: int, router=None, hi_rps: float
+) -> dict:
+    """Knee-search one configuration, then hold a 10x flash crowd against
+    it and record what the observability plane said while it burned."""
+    from bee_code_interpreter_tpu.loadgen import (
+        CapacityReporter,
+        FlashCrowd,
+        OpenLoopGenerator,
+        TrafficMix,
+        find_knee,
+    )
+
+    session_ids: list[str] = []
+    response = await client.post(f"{base_url}/v1/sessions", json={})
+    if response.status_code == 200:
+        session_ids.append(response.json()["session_id"])
+    kinds = (
+        (("execute", 8.0), ("session", 1.0), ("stream", 1.0))
+        if session_ids
+        else (("execute", 9.0), ("stream", 1.0))
+    )
+    generator = OpenLoopGenerator(
+        client, base_url, mix=TrafficMix(kinds=kinds), session_ids=session_ids
+    )
+    reporter = CapacityReporter(client, base_url, router=router)
+    knee, probes = await find_knee(
+        generator,
+        lo_rps=1.0,
+        hi_rps=hi_rps,
+        duration_s=CAPACITY_PROBE_S,
+        p99_ms=CAPACITY_SLO_P99_MS,
+        reporter=reporter,
+        iterations=5,
+        settle_s=1.0,
+        drain_timeout_s=20.0,
+        on_probe=lambda p: print(
+            f"capacity probe {p['offered_rps']:.2f} rps: "
+            f"{'sustained' if p['sustained'] else p['reasons']}",
+            file=sys.stderr,
+        ),
+    )
+    base = max(1.0, knee / 2.0)
+    crowd = await generator.run(
+        FlashCrowd(
+            base_rps=base,
+            duration_s=8.0,
+            crowd_start_s=2.0,
+            crowd_s=2.0,
+            multiplier=10.0,
+        ),
+        label="flash-crowd",
+        drain_timeout_s=30.0,
+    )
+    scrape = await reporter.scrape()
+    config = {
+        "replicas": replicas,
+        "router": router is not None,
+        "max_sustained_rps": round(knee, 2),
+        "curve": [_capacity_point(p) for p in probes],
+        "flash_crowd": {
+            **crowd.to_dict(),
+            "shed_ledger": crowd.shed_ledger(),
+            "warm_pop_ratio": scrape.get("warm_pop_ratio"),
+            "recommendation": scrape.get("recommendation"),
+            "fast_burn": scrape.get("fast_burn"),
+        },
+    }
+    stage_p50 = reporter.stage_p50_ms()
+    if stage_p50:
+        config["router_stage_p50_ms"] = stage_p50
+    return config
+
+
+async def measure_capacity(binary: Path) -> dict:
+    """The `capacity` phase (docs/capacity.md): max-sustained-rps-at-SLO
+    for (a) one replica hit directly and (b) three replicas behind the
+    real FleetRouter — measured by the open-loop generator, judged by the
+    federated SLO/autoscale plane, published as CAPACITY_r01.json."""
+    import httpx
+    from aiohttp import web
+
+    from bee_code_interpreter_tpu.fleet import FleetRouter, create_router_app
+
+    configs: dict[str, dict] = {}
+    client = httpx.AsyncClient(timeout=30.0)
+    try:
+        # --- config A: one replica, clients hit its edge directly
+        tmp = Path(tempfile.mkdtemp(prefix="bench-capacity-solo-"))
+        replica = await _capacity_replica(binary, tmp, tmp / "objects", 0)
+        try:
+            configs["replica-1"] = await _capacity_probe_config(
+                client, replica["url"], replicas=1, hi_rps=10.0
+            )
+        finally:
+            await replica["sessions"].close_all()
+            await replica["runner"].cleanup()
+            await replica["executor"].aclose()
+
+        # --- config B: three replicas behind the fleet router (live
+        # background refresh: the production edge shape, router tax and
+        # retry policy included in every sample)
+        tmp = Path(tempfile.mkdtemp(prefix="bench-capacity-fleet-"))
+        replicas = [
+            await _capacity_replica(binary, tmp, tmp / "objects", i)
+            for i in range(3)
+        ]
+        router = FleetRouter(
+            [(r["name"], r["url"]) for r in replicas],
+            refresh_interval_s=1.0,
+            dead_after_s=5.0,
+        )
+        router_runner = web.AppRunner(create_router_app(router))
+        await router_runner.setup()
+        router_port = _capacity_free_port()
+        await web.TCPSite(router_runner, "127.0.0.1", router_port).start()
+        await router.refresh_once()
+        router.start()
+        try:
+            configs["router-3"] = await _capacity_probe_config(
+                client,
+                f"http://127.0.0.1:{router_port}",
+                replicas=3,
+                router=router,
+                hi_rps=16.0,
+            )
+        finally:
+            await router.stop()
+            await router_runner.cleanup()
+            for r in replicas:
+                await r["sessions"].close_all()
+                await r["runner"].cleanup()
+                await r["executor"].aclose()
+    finally:
+        await client.aclose()
+    return configs
+
+
+def capacity_main() -> None:
+    """`python bench.py capacity`: measure the SLO-vs-load curves and
+    write the CAPACITY_r01.json artifact (plus one summary line on
+    stdout, same one-line contract as the main bench)."""
+    binary = ensure_native_binary()
+    if binary is None:
+        print(
+            json.dumps({"error": "no native executor binary; capacity "
+                        "phase needs `make -C executor`"}),
+            flush=True,
+        )
+        sys.exit(1)
+    t0 = time.time()
+    configs = asyncio.run(
+        asyncio.wait_for(measure_capacity(binary), timeout=540.0)
+    )
+    artifact = {
+        "version": "r01",
+        "generated_at": time.strftime(
+            "%Y-%m-%dT%H:%M:%SZ", time.gmtime()
+        ),
+        "host": {"platform": sys.platform, "cpus": os.cpu_count()},
+        "slo": {
+            "availability_pct": 99.5,
+            "p99_ms": CAPACITY_SLO_P99_MS,
+            "error_budget": 0.005,
+            "shed_budget": 0.01,
+        },
+        "probe": {
+            "duration_s": CAPACITY_PROBE_S,
+            "mix": "execute 8 : session 1 : stream 1, heavy-tail cost classes",
+            "method": "bisection on the sustained predicate (docs/capacity.md)",
+        },
+        "configs": configs,
+        "wall_s": round(time.time() - t0, 1),
+    }
+    CAPACITY_ARTIFACT.write_text(json.dumps(artifact, indent=2) + "\n")
+    print(
+        json.dumps(
+            {
+                "metric": "max sustained rps at SLO (p99<=1500ms, err<=0.5%, shed<=1%)",
+                "configs": {
+                    name: c["max_sustained_rps"]
+                    for name, c in configs.items()
+                },
+                "artifact": CAPACITY_ARTIFACT.name,
+            }
+        ),
+        flush=True,
+    )
+
+
 def main() -> None:
     # --- 1. the headline TPU number (runs first; ambient accelerator env —
     # including any tunnel plugin vars — flows through the executor's
@@ -1395,4 +1710,7 @@ def main() -> None:
 
 
 if __name__ == "__main__":
-    main()
+    if len(sys.argv) > 1 and sys.argv[1] == "capacity":
+        capacity_main()
+    else:
+        main()

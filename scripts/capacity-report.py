@@ -1,0 +1,139 @@
+#!/usr/bin/env python3
+"""Terminal rendering of the measured capacity artifact (docs/capacity.md).
+
+Per configuration: the max-sustained-rps-at-SLO headline, the p99-vs-load
+curve the knee search walked (every probe, with the criteria that failed
+named), the flash-crowd account (sheds by tenant, warm-pool hit ratio,
+the forecaster's replica recommendation while the crowd burned), and the
+router's per-stage p50 tax when the configuration had one.
+
+    python scripts/capacity-report.py [CAPACITY_r01.json]
+
+Exit codes: 0 rendered, 1 unreadable artifact, 2 a configuration whose
+knee is 0.0 (nothing sustained — the probe floor itself failed).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+DEFAULT_ARTIFACT = Path(__file__).resolve().parent.parent / "CAPACITY_r01.json"
+
+
+def _fmt(value, suffix: str = "") -> str:
+    if value is None:
+        return "-"
+    if isinstance(value, float):
+        return f"{value:.1f}{suffix}"
+    return f"{value}{suffix}"
+
+
+def render_config(name: str, config: dict) -> list[str]:
+    shape = f"{config.get('replicas', '?')} replica(s)"
+    if config.get("router"):
+        shape += " behind the fleet router"
+    lines = [
+        f"config {name} — {shape}",
+        f"  max sustained: {config.get('max_sustained_rps', 0.0):g} rps at SLO",
+        f"  {'OFFERED':>8} {'ACHIEVED':>9} {'P50':>8} {'P99':>9} "
+        f"{'SHEDS':>6} {'ERRS':>5}  VERDICT",
+    ]
+    lines.append("  " + "-" * (len(lines[-1]) - 2))
+    for point in sorted(
+        config.get("curve", []), key=lambda p: p.get("offered_rps", 0.0)
+    ):
+        verdict = (
+            "sustained"
+            if point.get("sustained")
+            else "; ".join(point.get("reasons") or ["unsustained"])
+        )
+        lines.append(
+            f"  {_fmt(point.get('offered_rps')):>8} "
+            f"{_fmt(point.get('achieved_rps')):>9} "
+            f"{_fmt(point.get('p50_ms'), 'ms'):>8} "
+            f"{_fmt(point.get('p99_ms'), 'ms'):>9} "
+            f"{_fmt(point.get('sheds')):>6} "
+            f"{_fmt(point.get('errors')):>5}  {verdict}"
+        )
+    crowd = config.get("flash_crowd")
+    if crowd:
+        lines.append(
+            f"  flash crowd: offered {crowd.get('offered')} "
+            f"(peak {_fmt(crowd.get('offered_rps'))} rps mean), "
+            f"completed {crowd.get('completed')}, "
+            f"sheds {crowd.get('sheds')}, errors {crowd.get('errors')}"
+        )
+        ledger = crowd.get("shed_ledger") or {}
+        if ledger:
+            by_tenant = ", ".join(
+                f"{tenant}={count}" for tenant, count in sorted(ledger.items())
+            )
+            lines.append(f"    shed ledger: {by_tenant}")
+        warm = crowd.get("warm_pop_ratio")
+        if warm is not None:
+            lines.append(f"    warm_pop_ratio under crowd: {warm:.2f}")
+        rec = crowd.get("recommendation") or {}
+        if rec:
+            lines.append(
+                f"    forecaster recommendation: "
+                f"{rec.get('target_replicas')} replicas "
+                f"({rec.get('reason')}; have {rec.get('current_replicas')})"
+            )
+        lines.append(
+            f"    fast-burn page fired: {bool(crowd.get('fast_burn'))}"
+        )
+    stages = config.get("router_stage_p50_ms")
+    if stages:
+        tax = ", ".join(f"{k}={v:g}ms" for k, v in sorted(stages.items()))
+        lines.append(f"  router stage p50: {tax}")
+    return lines
+
+
+def render(artifact: dict) -> str:
+    slo = artifact.get("slo") or {}
+    host = artifact.get("host") or {}
+    lines = [
+        f"capacity artifact {artifact.get('version', '?')} — "
+        f"{artifact.get('generated_at', 'undated')} on "
+        f"{host.get('platform', '?')}/{host.get('cpus', '?')}cpu "
+        f"({artifact.get('wall_s', '?')}s wall)",
+        f"SLO: p99 <= {slo.get('p99_ms', '?'):g}ms, "
+        f"errors <= {slo.get('error_budget', 0):.1%}, "
+        f"sheds <= {slo.get('shed_budget', 0):.1%}",
+        "",
+    ]
+    for name in sorted(artifact.get("configs") or {}):
+        lines.extend(render_config(name, artifact["configs"][name]))
+        lines.append("")
+    return "\n".join(lines).rstrip()
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(
+        description="Render CAPACITY_r01.json as a terminal table."
+    )
+    parser.add_argument(
+        "artifact", nargs="?", default=str(DEFAULT_ARTIFACT),
+        help="path to the capacity artifact (default: repo root)",
+    )
+    args = parser.parse_args()
+    try:
+        artifact = json.loads(Path(args.artifact).read_text())
+    except (OSError, ValueError) as e:
+        print(f"capacity-report: cannot read {args.artifact}: {e}",
+              file=sys.stderr)
+        return 1
+    print(render(artifact))
+    configs = artifact.get("configs") or {}
+    if any(
+        not c.get("max_sustained_rps") for c in configs.values()
+    ) or not configs:
+        return 2
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -7,8 +7,14 @@ pairs — the numbers an on-call pastes into an incident doc.
 
     python scripts/slo-report.py [--url http://localhost:50081]
 
+Pointed at a ROUTER edge, the same endpoint answers the federated
+document (docs/capacity.md): the user-perceived numbers at top level plus
+every replica's own budget under ``fleet`` — rendered as a per-replica
+roll-call with the names that failed to answer called out.
+
 Exit codes: 0 quiet, 1 unreachable, 3 a slow (ticket) alert firing,
-4 a fast (page) alert firing — scriptable from deploy gates.
+4 a fast (page) alert firing — fleet-wide rollups included, so a single
+replica paging fails a deploy gate even while the edge looks clean.
 """
 
 from __future__ import annotations
@@ -54,6 +60,43 @@ def render(slo: dict) -> str:
     return "\n".join(lines).rstrip()
 
 
+def render_fleet(slo: dict) -> str | None:
+    """The federated sections a router edge adds — None on a plain
+    replica document, so the replica rendering is unchanged."""
+    fleet = slo.get("fleet")
+    if fleet is None:
+        return None
+    lines = ["fleet (per-replica error budgets)"]
+    for name in sorted(fleet):
+        doc = fleet[name] or {}
+        objectives = doc.get("objectives") or []
+        if objectives:
+            budget = min(
+                o.get("error_budget_remaining_ratio", 1.0)
+                for o in objectives
+            )
+            budget_s = f"budget {budget:.1%}"
+        else:
+            budget_s = "no objectives"
+        state = (
+            "FAST-BURN"
+            if doc.get("fast_burn_alerting")
+            else "alerting"
+            if doc.get("alerting")
+            else "ok"
+        )
+        lines.append(f"  {name:<12} {budget_s:<16} {state}")
+    failed = slo.get("replicas_failed") or {}
+    for name in sorted(failed):
+        lines.append(f"  {name:<12} {'NO ANSWER':<16} {failed[name]}")
+    lines.append(
+        f"  fleet_alerting={slo.get('fleet_alerting')} "
+        f"fleet_fast_burn={slo.get('fleet_fast_burn')} "
+        f"reporting={len(slo.get('replicas_reporting') or [])}"
+    )
+    return "\n".join(lines)
+
+
 def main() -> int:
     parser = argparse.ArgumentParser(
         description="Render GET /v1/slo burn-rate windows as a text table."
@@ -68,9 +111,13 @@ def main() -> int:
         print(f"slo-report: cannot reach {base}: {e}", file=sys.stderr)
         return 1
     print(render(slo))
-    if slo.get("fast_burn_alerting"):
+    fleet = render_fleet(slo)
+    if fleet is not None:
+        print()
+        print(fleet)
+    if slo.get("fast_burn_alerting") or slo.get("fleet_fast_burn"):
         return 4
-    if slo.get("alerting"):
+    if slo.get("alerting") or slo.get("fleet_alerting"):
         return 3
     return 0
 

@@ -174,6 +174,7 @@ class ReplicaStack:
         faults=None,
         tenants: str | None = None,
         lease_router_urls: list[str] | None = None,
+        autoscale_window_s: float | None = None,
     ) -> None:
         self.name = name
         self.tmp_path = Path(tmp_path)
@@ -183,6 +184,13 @@ class ReplicaStack:
         # Fleet-wide quota leasing (docs/tenancy.md "Fleet-wide tenancy"):
         # router base URLs this replica leases rate-quota slices from.
         self.lease_router_urls = lease_router_urls
+        # Capacity observability (docs/capacity.md): a short demand window
+        # wires the DemandTracker/Forecaster pair into this replica's edge
+        # so GET /v1/autoscale answers — short so chaos tests see the
+        # recommendation converge in test-scale seconds, not 60s windows.
+        self.autoscale_window_s = autoscale_window_s
+        self.demand = None
+        self.forecaster = None
         self.lease_client = None
         self.quota_leases = None
         self.stopped = False
@@ -266,6 +274,32 @@ class ReplicaStack:
             )
 
             self.quota_leases = QuotaLeaseCache()
+        autoscale = None
+        if self.autoscale_window_s is not None:
+            from bee_code_interpreter_tpu.observability import (
+                DemandTracker,
+                Forecaster,
+            )
+            from bee_code_interpreter_tpu.resilience.autoscaler import (
+                autoscale_snapshot,
+            )
+
+            window = self.autoscale_window_s
+            self.demand = DemandTracker(
+                window_s=window, metrics=self.metrics
+            )
+            self.forecaster = Forecaster(
+                self.demand,
+                peak_window_s=min(window, 5.0),
+                max_horizon_s=2.0,
+                metrics=self.metrics,
+            )
+            self.k8s.journal.add_sink(self.demand.on_fleet_event)
+            autoscale = lambda: autoscale_snapshot(  # noqa: E731
+                demand=self.demand,
+                forecaster=self.forecaster,
+                slo=self.slo,
+            )
         self.admission = AdmissionController(
             max_in_flight=8,
             max_queue=16,
@@ -273,6 +307,7 @@ class ReplicaStack:
             metrics=self.metrics,
             tenancy=self.tenancy,
             quota_leases=self.quota_leases,
+            demand=self.demand,
         )
         if self.lease_router_urls:
             self.lease_client = QuotaLeaseClient(
@@ -300,6 +335,7 @@ class ReplicaStack:
             sessions=self.sessions,
             tenancy=self.tenancy,
             recorder=self.recorder,
+            autoscale=autoscale,
         )
         self.runner = web.AppRunner(app)
         await self.runner.setup()

@@ -681,3 +681,106 @@ async def test_grpc_get_autoscale_mirrors_http(clock):
     reply = json.loads(await servicer.GetAutoscale(b"", None))
     assert reply["mode"] == "advise" and reply["target"] == 2
     assert reply["demand"]["arrivals_total"] == 0
+
+
+# ---------------------------------------- empty-window edge cases (ISSUE 18)
+# The capacity harness reads these documents straight into an actuator, so
+# every accessor must stay finite and clamped when windows are empty, the
+# window argument is garbage, or a writer hands in a poisoned float.
+
+
+def test_demand_tracker_empty_windows_are_finite(clock):
+    import math
+
+    d = DemandTracker(clock=clock)
+    assert d.warm_pop_ratio(60.0) == 1.0
+    assert d.rate_rps(10.0) == 0.0
+    assert d.peak_rps(60.0) == 0.0
+    assert d.spawn_latency_quantile(0.95) is None
+    assert d.queue_wait(60.0) == {
+        "admitted": 0, "avg_ms": 0.0, "max_ms": 0.0,
+    }
+    snapshot = d.snapshot()
+    for key, value in snapshot.items():
+        if isinstance(value, float):
+            assert math.isfinite(value), key
+
+
+def test_demand_tracker_garbage_window_arguments(clock):
+    d = DemandTracker(clock=clock)
+    d.record_arrival()
+    clock.advance(1.0)
+    for bad in (float("nan"), float("inf"), -5.0, 0.0):
+        assert d.rate_rps(bad) == 0.0
+        assert d.warm_pop_ratio(bad) == 1.0
+        assert d.peak_rps(bad) == 0.0
+        assert d.shed_count(bad) == 0
+    # -inf quantile clamps to the low end, +inf/nan to the high end.
+    d.on_fleet_event({"state": "ready", "spawn_s": 1.0})
+    assert d.spawn_latency_quantile(float("nan")) == 1.0
+    assert d.spawn_latency_quantile(float("-inf")) == 1.0
+    assert d.spawn_latency_quantile(9.0) == 1.0
+
+
+def test_demand_tracker_rejects_poisoned_samples(clock):
+    d = DemandTracker(clock=clock)
+    for bad in (float("nan"), float("inf"), -1.0, "soon", None):
+        d.on_fleet_event({"state": "ready", "spawn_s": bad})
+    assert d.spawn_latency_quantile(0.95) is None
+    d.on_fleet_event({"state": "ready", "spawn_s": 0.25})
+    assert d.spawn_latency_quantile(0.95) == 0.25
+    # A NaN queue wait keeps the admission COUNT but drops the sample.
+    d.record_admitted(queue_wait_s=float("nan"), in_flight=3)
+    d.record_admitted(queue_wait_s=float("inf"), in_flight=4)
+    clock.advance(1.0)
+    wait = d.queue_wait(60.0)
+    assert wait["admitted"] == 2
+    assert wait["avg_ms"] == 0.0 and wait["max_ms"] == 0.0
+    assert d.concurrency_high_water(60.0) == 4
+
+
+def test_forecaster_empty_demand_is_clamped_and_finite(clock):
+    import math
+
+    d = DemandTracker(clock=clock)
+    f = Forecaster(d, min_horizon_s=2.0, max_horizon_s=30.0)
+    assert f.horizon_s() == 2.0  # no spawn samples: the floor, not NaN
+    doc = f.forecast()
+    assert doc["samples"] == 0
+    for key, value in doc.items():
+        if isinstance(value, float):
+            assert math.isfinite(value), key
+    assert doc["forecast_rps"] == 0.0
+
+
+def test_forecaster_inverted_horizon_band_is_normalized(clock):
+    d = DemandTracker(clock=clock)
+    # min > max (a config typo) must not pin horizon_s above its ceiling
+    # forever — the band normalizes to [min, min].
+    f = Forecaster(d, min_horizon_s=10.0, max_horizon_s=2.0)
+    assert f.horizon_s() == 10.0
+    d.on_fleet_event({"state": "ready", "spawn_s": 500.0})
+    assert f.horizon_s() == 10.0
+    # Non-finite band values fall back to defaults instead of spreading.
+    f = Forecaster(
+        d, min_horizon_s=float("nan"), max_horizon_s=float("inf")
+    )
+    assert f.horizon_s() == 60.0  # p95=500 clamped by the default ceiling
+
+
+def test_autoscale_snapshot_recommendation_is_always_present(clock):
+    body = autoscale_snapshot()
+    rec = body["recommendation"]
+    assert rec["target_replicas"] == 1 and rec["reason"] == "idle"
+    demand = DemandTracker(clock=clock)
+    forecaster = Forecaster(demand, min_horizon_s=1.0)
+    for _ in range(40):
+        demand.record_arrival()
+    demand.record_admitted(queue_wait_s=0.0, in_flight=20)
+    clock.advance(1.0)
+    body = autoscale_snapshot(demand=demand, forecaster=forecaster)
+    rec = body["recommendation"]
+    # peak envelope 40 rps × 1s horizon / 8 per replica → 5 replicas.
+    assert rec["target_replicas"] == 5
+    assert rec["reason"] == "forecast"
+    assert rec["per_replica_capacity"] == 8

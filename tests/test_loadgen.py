@@ -1,0 +1,326 @@
+"""Open-loop load generator + capacity reporter (ISSUE 18,
+docs/capacity.md).
+
+The units prove the two properties the whole capacity methodology rests
+on: schedules are DETERMINISTIC (same shape + seed → identical arrival
+instants) and the generator is genuinely OPEN-LOOP (a slow service
+changes what comes back, never what goes out). The knee search runs as a
+seconds-scale smoke sweep against a stub service with a known concurrency
+ceiling — the real-fleet sweeps live in ``bench.py capacity`` and the
+``slow``-marked fleet test."""
+
+import asyncio
+
+import httpx
+import pytest
+from aiohttp import web
+
+from bee_code_interpreter_tpu.loadgen import (
+    COST_CLASS_PAYLOADS,
+    CapacityReporter,
+    Diurnal,
+    FlashCrowd,
+    OpenLoopGenerator,
+    Phases,
+    Ramp,
+    Steady,
+    TrafficMix,
+    arrival_times,
+    evaluate_sustained,
+    find_knee,
+    heavy_tail_weights,
+)
+from bee_code_interpreter_tpu.observability import recommend_replicas
+from bee_code_interpreter_tpu.utils.metrics import Registry
+from tests.fakes import free_port
+
+# ------------------------------------------------------------------ shapes
+
+
+def test_steady_schedule_is_even_and_exact():
+    times = arrival_times(Steady(rps=5.0, duration_s=4.0))
+    assert len(times) == 20
+    gaps = [b - a for a, b in zip(times, times[1:])]
+    assert all(abs(g - 0.2) < 0.01 for g in gaps)
+
+
+def test_schedules_are_deterministic_per_seed():
+    shape = Ramp(start_rps=1.0, end_rps=9.0, duration_s=6.0)
+    a = arrival_times(shape, jitter_s=0.05, seed=7)
+    b = arrival_times(shape, jitter_s=0.05, seed=7)
+    c = arrival_times(shape, jitter_s=0.05, seed=8)
+    assert a == b
+    assert a != c
+    assert a == sorted(a)
+
+
+def test_ramp_integrates_to_mean_rate():
+    # 1→9 rps over 6s ≡ mean 5 rps → 30 arrivals, denser at the end.
+    times = arrival_times(Ramp(start_rps=1.0, end_rps=9.0, duration_s=6.0))
+    assert len(times) == 30
+    first_half = sum(1 for t in times if t < 3.0)
+    assert first_half < len(times) / 2
+
+
+def test_flash_crowd_is_a_step_multiplier():
+    shape = FlashCrowd(
+        base_rps=2.0, duration_s=10.0, crowd_start_s=4.0, crowd_s=2.0,
+        multiplier=10.0,
+    )
+    assert shape.rate_at(0.0) == 2.0
+    assert shape.rate_at(5.0) == 20.0
+    assert shape.rate_at(7.0) == 2.0
+    # 2 rps × 10s base + (20−2) rps × 2s crowd = 56 arrivals.
+    assert len(arrival_times(shape)) == 56
+
+
+def test_diurnal_troughs_at_edges_and_peaks_mid_period():
+    shape = Diurnal(base_rps=1.0, peak_rps=11.0, duration_s=8.0)
+    assert shape.rate_at(0.0) == pytest.approx(1.0)
+    assert shape.rate_at(4.0) == pytest.approx(11.0)
+
+
+def test_phases_sequence_shapes():
+    shape = Phases(
+        phases=(
+            Steady(rps=2.0, duration_s=3.0),
+            Steady(rps=8.0, duration_s=2.0),
+        )
+    )
+    assert shape.duration_s == 5.0
+    assert shape.rate_at(1.0) == 2.0
+    assert shape.rate_at(4.0) == 8.0
+    assert len(arrival_times(shape)) == 22
+
+
+def test_heavy_tail_mix_is_skewed_and_deterministic():
+    tenants = [f"t{i}" for i in range(8)]
+    mix = TrafficMix(tenants=heavy_tail_weights(tenants), seed=3)
+    times = arrival_times(Steady(rps=50.0, duration_s=8.0))
+    plan = mix.plan(times)
+    assert [p.tenant for p in plan] == [p.tenant for p in mix.plan(times)]
+    counts: dict[str, int] = {}
+    for p in plan:
+        counts[p.tenant] = counts.get(p.tenant, 0) + 1
+    # Zipf head dominates: the hottest tenant beats the coldest by a lot.
+    assert counts["t0"] > 4 * counts.get("t7", 1)
+    # Every planned payload is one of the classifier-visible cost classes.
+    assert {p.source for p in plan} <= set(COST_CLASS_PAYLOADS.values())
+
+
+# ------------------------------------------------------ stub service
+
+
+class StubService:
+    """Minimal /v1/execute edge with a tunable service time and a hard
+    concurrency ceiling (429 beyond it) — a known-capacity device under
+    test for the open-loop and knee properties."""
+
+    def __init__(self, *, delay_s: float = 0.0, max_in_flight: int = 10**9):
+        self.delay_s = delay_s
+        self.max_in_flight = max_in_flight
+        self.in_flight = 0
+        self.peak_in_flight = 0
+        self.served = 0
+        self.shed = 0
+        self.runner = None
+        self.url = ""
+
+    async def _execute(self, request: web.Request) -> web.Response:
+        if self.in_flight >= self.max_in_flight:
+            self.shed += 1
+            return web.json_response(
+                {"reason": "capacity"}, status=429
+            )
+        self.in_flight += 1
+        self.peak_in_flight = max(self.peak_in_flight, self.in_flight)
+        try:
+            if self.delay_s:
+                await asyncio.sleep(self.delay_s)
+            self.served += 1
+            return web.json_response({"exit_code": 0, "stdout": "42\n"})
+        finally:
+            self.in_flight -= 1
+
+    async def _slo(self, _request: web.Request) -> web.Response:
+        return web.json_response(
+            {"fast_burn_alerting": False, "alerting": False}
+        )
+
+    async def __aenter__(self) -> "StubService":
+        app = web.Application()
+        app.router.add_post("/v1/execute", self._execute)
+        app.router.add_post(
+            "/v1/sessions/{sid}/execute", self._execute
+        )
+        app.router.add_get("/v1/slo", self._slo)
+        self.runner = web.AppRunner(app)
+        await self.runner.setup()
+        port = free_port()
+        await web.TCPSite(self.runner, "127.0.0.1", port).start()
+        self.url = f"http://127.0.0.1:{port}"
+        return self
+
+    async def __aexit__(self, *exc) -> None:
+        await self.runner.cleanup()
+
+
+# --------------------------------------------------------- open loop
+
+
+async def test_generator_is_open_loop_not_response_gated():
+    """20 offered arrivals in 1s against a 300ms service: a closed loop
+    would serialize to ~3 sends; the open loop fires all 20 on schedule
+    and the stub's peak concurrency proves they overlapped."""
+    async with StubService(delay_s=0.3) as stub:
+        async with httpx.AsyncClient() as client:
+            generator = OpenLoopGenerator(
+                client,
+                stub.url,
+                mix=TrafficMix(kinds=(("execute", 1.0),)),
+                metrics=Registry(),
+            )
+            result = await generator.run(
+                Steady(rps=20.0, duration_s=1.0), label="openloop"
+            )
+    assert result.sent == 20
+    assert result.completed == 20
+    assert stub.peak_in_flight >= 5
+    assert result.lag_quantile_s(0.95) < 0.25
+    doc = result.to_dict()
+    assert doc["statuses"] == {"200": 20}
+    assert doc["latency_ms"]["p50"] >= 300.0
+
+
+async def test_generator_sessions_and_tenants_route_and_ledger():
+    async with StubService() as stub:
+        async with httpx.AsyncClient() as client:
+            generator = OpenLoopGenerator(
+                client,
+                stub.url,
+                mix=TrafficMix(
+                    kinds=(("execute", 1.0), ("session", 1.0)),
+                    tenants=[("abuser", 1.0)],
+                ),
+                session_ids=["s-1", "s-2"],
+            )
+            result = await generator.run(Steady(rps=30.0, duration_s=0.5))
+    assert result.sent == 15
+    assert result.completed == 15
+    assert result.shed_ledger() == {}
+
+
+async def test_overload_is_visible_sheds_and_undrained_count_as_errors():
+    async with StubService(delay_s=0.2, max_in_flight=2) as stub:
+        async with httpx.AsyncClient() as client:
+            generator = OpenLoopGenerator(
+                client, stub.url, mix=TrafficMix(kinds=(("execute", 1.0),))
+            )
+            result = await generator.run(
+                Steady(rps=40.0, duration_s=0.5), drain_timeout_s=2.0
+            )
+    # Offered 20 in 0.5s against a 2-wide 0.2s service (≈10 rps capacity):
+    # the collapse shows up as client-visible sheds, not a quietly slower
+    # send loop.
+    assert result.sent == 20
+    assert result.sheds > 0
+    assert result.completed < 20
+    verdict = evaluate_sustained(result, p99_ms=1000.0)
+    assert not verdict["sustained"]
+    assert any("shed" in r for r in verdict["reasons"])
+
+
+# -------------------------------------------------------- knee search
+
+
+async def test_find_knee_brackets_the_stub_capacity():
+    """Smoke sweep (the tier-1 scale one): a 2-wide 100ms stub saturates
+    at ~20 rps; the bisection must land the knee between the known-good
+    floor and the known-bad ceiling and keep every probe point."""
+    async with StubService(delay_s=0.1, max_in_flight=2) as stub:
+        async with httpx.AsyncClient() as client:
+            generator = OpenLoopGenerator(
+                client, stub.url, mix=TrafficMix(kinds=(("execute", 1.0),))
+            )
+            reporter = CapacityReporter(client, stub.url)
+            knee, probes = await find_knee(
+                generator,
+                lo_rps=4.0,
+                hi_rps=60.0,
+                duration_s=1.0,
+                p99_ms=2000.0,
+                reporter=reporter,
+                iterations=4,
+                drain_timeout_s=2.0,
+            )
+    assert 4.0 <= knee < 60.0
+    assert len(probes) >= 3
+    assert probes[0]["sustained"] is True
+    assert probes[1]["sustained"] is False
+    offered = [p["offered_rps"] for p in probes]
+    assert offered == sorted(set(offered), key=offered.index)
+
+
+async def test_capacity_reporter_scrape_is_total():
+    """A scrape against an edge with no /v1/autoscale (and then no edge at
+    all) reports None sections — never an exception into the probe."""
+    async with StubService() as stub:
+        async with httpx.AsyncClient() as client:
+            reporter = CapacityReporter(client, stub.url)
+            scrape = await reporter.scrape()
+            assert scrape["slo"] is not None
+            assert scrape["autoscale"] is None
+            assert scrape["fast_burn"] is False
+            dead = CapacityReporter(client, "http://127.0.0.1:9")
+            scrape = await dead.scrape()
+            assert scrape["slo"] is None and scrape["autoscale"] is None
+
+
+# ----------------------------------------------- replica recommendation
+
+
+def test_recommend_replicas_sizing_and_reasons():
+    # forecast 20 rps × 2s horizon = 40 slots / 8 per replica → 5.
+    doc = recommend_replicas(
+        forecast_rps=20.0, horizon_s=2.0, per_replica_capacity=8,
+        current_replicas=3,
+    )
+    assert doc["target_replicas"] == 5 and doc["reason"] == "forecast"
+    # Concurrency high-water floors the demand even when rates are low.
+    doc = recommend_replicas(
+        forecast_rps=0.5, horizon_s=1.0, concurrency_high_water=17.0,
+        per_replica_capacity=8,
+    )
+    assert doc["target_replicas"] == 3
+    # Idle fleet shrinks to the floor, and says that is why.
+    doc = recommend_replicas(
+        forecast_rps=0.0, horizon_s=2.0, current_replicas=4
+    )
+    assert doc["target_replicas"] == 1 and doc["reason"] == "idle"
+    # An active fast-burn page vetoes shrink: grow by one instead.
+    doc = recommend_replicas(
+        forecast_rps=0.0, horizon_s=0.0, current_replicas=4,
+        slo_fast_burn=True,
+    )
+    assert doc["target_replicas"] == 5 and doc["reason"] == "slo_burn"
+    # The band clamps, and the clamp is named.
+    doc = recommend_replicas(
+        forecast_rps=1000.0, horizon_s=10.0, per_replica_capacity=1,
+        max_replicas=8,
+    )
+    assert doc["target_replicas"] == 8 and doc["reason"] == "clamped"
+
+
+def test_recommend_replicas_is_nan_and_inf_proof():
+    nan = float("nan")
+    inf = float("inf")
+    doc = recommend_replicas(
+        forecast_rps=nan, horizon_s=inf, concurrency_high_water=nan,
+        per_replica_capacity=0, current_replicas=-3, min_replicas=-1,
+        max_replicas=0,
+    )
+    assert doc["target_replicas"] == 0  # min_replicas clamped to 0
+    assert isinstance(doc["target_replicas"], int)
+    # Non-finite demand is GARBAGE, not "huge": it must not scale to max.
+    doc = recommend_replicas(forecast_rps=inf, horizon_s=1.0)
+    assert doc["target_replicas"] == 1 and doc["reason"] == "idle"

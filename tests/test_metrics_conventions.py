@@ -85,10 +85,22 @@ def register_router_metrics(registry: Registry) -> None:
     asyncio.run(router.stop())
 
 
+def register_loadgen_metrics(registry: Registry) -> None:
+    """The capacity harness's client-side family (ISSUE 18): the open-loop
+    generator registers its sent/lag/offered surface when handed a
+    registry — same conventions as the service it measures."""
+    from bee_code_interpreter_tpu.loadgen import OpenLoopGenerator
+
+    OpenLoopGenerator(
+        client=None, base_url="http://127.0.0.1:1", metrics=registry
+    )
+
+
 def test_every_registered_metric_follows_conventions(tmp_path):
     registry = build_service_registry(tmp_path)
     register_serving_metrics(registry)
     register_router_metrics(registry)
+    register_loadgen_metrics(registry)
     metrics = registry.metrics
     assert len(metrics) >= 20, sorted(metrics)  # the wiring actually ran
 
@@ -205,6 +217,13 @@ def test_every_registered_metric_follows_conventions(tmp_path):
         "bci_federation_replica_errors_total",
         "bci_federation_fanout_seconds",
         "bci_stage_seconds",
+        # capacity harness + forecaster→replica-count loop (ISSUE 18):
+        # the open-loop generator's client-side family and the federated
+        # recommendation gauge the router edge publishes
+        "bci_loadgen_sent_total",
+        "bci_loadgen_lag_seconds",
+        "bci_loadgen_offered_rps",
+        "bci_fleet_target_replicas",
     ):
         assert required in metrics, f"{required}: not registered by the wiring"
     assert isinstance(metrics["bci_pool_spawn_seconds"], Histogram)
@@ -268,6 +287,10 @@ def test_every_registered_metric_follows_conventions(tmp_path):
     )
     assert isinstance(metrics["bci_quota_lease_refresh_total"], Counter)
     assert isinstance(metrics["bci_quota_lease_fleet_size"], Gauge)
+    assert isinstance(metrics["bci_loadgen_sent_total"], Counter)
+    assert isinstance(metrics["bci_loadgen_lag_seconds"], Histogram)
+    assert isinstance(metrics["bci_loadgen_offered_rps"], Gauge)
+    assert isinstance(metrics["bci_fleet_target_replicas"], Gauge)
 
     for name, metric in metrics.items():
         assert name.startswith("bci_"), (
