@@ -326,6 +326,7 @@ TWINS: tuple[Twin, ...] = (
         ("ObservabilityService.GetServingRequests",),
     ),
     Twin("GET /v1/events", ("ObservabilityService.GetEvents",)),
+    Twin("GET /v1/accelerator", ("ObservabilityService.GetAccelerator",)),
     Twin("GET /v1/debug/bundle", ("ObservabilityService.GetDebugBundle",)),
     Twin("GET /v1/debug/tasks", ("ObservabilityService.GetTasks",)),
     Twin("GET /v1/debug/pprof", ("ObservabilityService.GetPprof",)),

@@ -1236,6 +1236,7 @@ class ObservabilityServicer:
         loopmon=None,  # observability.LoopMonitor
         contprof=None,  # observability.ContinuousProfiler
         serving=None,  # observability.ServingMonitor
+        device=None,  # observability.DeviceMonitor
         autoscale=None,  # callable -> dict (resilience.autoscale_snapshot)
         tenants=None,  # callable -> dict (tenancy.build_tenants_snapshot)
     ) -> None:
@@ -1245,6 +1246,7 @@ class ObservabilityServicer:
         self._loopmon = loopmon
         self._contprof = contprof
         self._serving = serving
+        self._device = device
         self._autoscale = autoscale
         self._tenants = tenants
 
@@ -1396,6 +1398,29 @@ class ObservabilityServicer:
             )
         return json.dumps({"requests": records}).encode()
 
+    async def GetAccelerator(self, request: bytes, context) -> bytes:
+        """The accelerator observability snapshot — the gRPC spelling of
+        ``GET /v1/accelerator`` (docs/observability.md "Accelerator
+        observability"): compile/retrace totals, device-memory sample,
+        per-mesh-shape step timing. Optional JSON request ``{"recent": N}``
+        bounds the compile-record tail (default 16)."""
+        if self._device is None:
+            await context.abort(
+                grpc.StatusCode.UNIMPLEMENTED,
+                "no device monitor wired into this server",
+            )
+        body = await self._parse_json_request(request, context)
+        try:
+            recent = int(body.get("recent", 16))
+            if recent < 0:
+                raise ValueError("recent must be >= 0")
+        except (TypeError, ValueError):
+            await context.abort(
+                grpc.StatusCode.INVALID_ARGUMENT,
+                "recent must be a non-negative integer",
+            )
+        return json.dumps(self._device.snapshot(recent=recent)).encode()
+
     async def _parse_json_request(self, request: bytes, context) -> dict:
         """Empty request bytes mean defaults; anything else must be a JSON
         object (the convention GetEvents established)."""
@@ -1442,6 +1467,7 @@ _OBSERVABILITY_METHODS = (
     "GetPprof",
     "GetServing",
     "GetServingRequests",
+    "GetAccelerator",
     "GetTenants",
 )
 
@@ -1719,6 +1745,7 @@ class GrpcServer:
         loopmon=None,  # observability.LoopMonitor shared with the HTTP edge
         contprof=None,  # observability.ContinuousProfiler, likewise
         serving=None,  # observability.ServingMonitor, likewise
+        device=None,  # observability.DeviceMonitor, likewise
         autoscale=None,  # callable -> dict for GetAutoscale (docs/autoscaling.md)
         tenancy=None,  # tenancy.TenantRegistry shared with the HTTP edge
     ) -> None:
@@ -1768,6 +1795,7 @@ class GrpcServer:
         self._loopmon = loopmon
         self._contprof = contprof
         self._serving = serving
+        self._device = device
         self._autoscale = autoscale
         # Mirror the HTTP edge: use the executor backend's own journal when
         # one exists (find_journal is the one shared discovery rule), else
@@ -1792,6 +1820,7 @@ class GrpcServer:
                 loopmon=loopmon,
                 contprof=contprof,
                 serving=serving,
+                device=device,
                 autoscale=autoscale,
                 tenancy=tenancy,
             )
@@ -1839,6 +1868,7 @@ class GrpcServer:
                         loopmon=self._loopmon,
                         contprof=self._contprof,
                         serving=self._serving,
+                        device=self._device,
                         autoscale=self._autoscale,
                         tenants=self._tenants_snapshot,
                     )

@@ -146,6 +146,8 @@ def create_http_server(
     loopmon=None,  # observability.LoopMonitor for GET /v1/debug/tasks
     contprof=None,  # observability.ContinuousProfiler for GET /v1/debug/pprof
     serving=None,  # observability.ServingMonitor for GET /v1/serving
+    device=None,  # observability.DeviceMonitor for GET /v1/accelerator
+    device_profiler=None,  # observability.DeviceProfiler for target=device
     autoscale=None,  # callable -> dict for GET /v1/autoscale (docs/autoscaling.md)
     tenancy=None,  # tenancy.TenantRegistry: identity + GET /v1/tenants
 ) -> web.Application:
@@ -678,6 +680,35 @@ def create_http_server(
                 except ProfilerUnavailable as e:
                     return web.json_response({"detail": str(e)}, status=503)
                 return web.json_response({"target": "serving", **captured})
+
+            if req.target == "device":
+                # Raw device-runtime capture (docs/observability.md
+                # "Accelerator observability"): serving steps when an
+                # engine is attached, a probe computation otherwise. 501
+                # with the concrete reason when the runtime cannot trace
+                # (no profiler wired, jax.profiler missing, or start_trace
+                # rejected by this backend); 503 only for the transient
+                # capture-already-running case.
+                if device_profiler is None or not getattr(
+                    device_profiler, "available", True
+                ):
+                    return web.json_response(
+                        {
+                            "detail": "device profiling unavailable: no "
+                            "jax.profiler on this runtime"
+                        },
+                        status=501,
+                    )
+                try:
+                    captured = await asyncio.to_thread(
+                        device_profiler.capture, req.steps
+                    )
+                except ProfilerUnavailable as e:
+                    busy = device_profiler.capturing
+                    return web.json_response(
+                        {"detail": str(e)}, status=503 if busy else 501
+                    )
+                return web.json_response({"target": "device", **captured})
 
             if not req.source_code:
                 return web.json_response(
@@ -1368,6 +1399,32 @@ def create_http_server(
             }
         )
 
+    async def accelerator_snapshot(request: web.Request) -> web.Response:
+        """The accelerator observability view (docs/observability.md
+        "Accelerator observability"): compile/retrace totals + per-function
+        signature sets, the latest device-memory sample (estimated on
+        CPU-only runtimes), per-mesh-shape step timing, and KV-pool
+        occupancy. ``?recent=N`` bounds the compile-record tail (default
+        16). 501 when no DeviceMonitor is wired (standalone servers); with
+        one wired but no engine attached the body answers honestly
+        (``attached: false``)."""
+        if device is None:
+            return web.json_response(
+                {"detail": "no device monitor wired into this server"},
+                status=501,
+            )
+        try:
+            recent = int(request.query.get("recent", "16"))
+        except ValueError:
+            return web.json_response(
+                {"detail": "recent must be an integer"}, status=400
+            )
+        if recent < 0:
+            return web.json_response(
+                {"detail": "recent must be >= 0"}, status=400
+            )
+        return web.json_response(device.snapshot(recent=recent))
+
     async def fleet_snapshot(_request: web.Request) -> web.Response:
         snap = fleet.snapshot()
         # Supervisor + drain state ride on the fleet view: "is anything
@@ -1392,6 +1449,12 @@ def create_http_server(
             # a fleet router can place by WHO is sending, not just how
             # much is arriving.
             snap["tenants"] = tenancy.mix()
+        if device is not None:
+            # Accelerator summary (docs/observability.md "Accelerator
+            # observability"): compile/retrace totals + HBM headroom, so
+            # a fleet router can steer load away from replicas that are
+            # retracing or memory-tight.
+            snap["accelerator"] = device.fleet_summary()
         return web.json_response(snap)
 
     async def fleet_events(request: web.Request) -> web.Response:
@@ -1428,6 +1491,7 @@ def create_http_server(
     app.router.add_get("/v1/autoscale", autoscale_endpoint)
     app.router.add_get("/v1/serving", serving_snapshot)
     app.router.add_get("/v1/serving/requests", serving_requests)
+    app.router.add_get("/v1/accelerator", accelerator_snapshot)
     app.router.add_get("/v1/events", list_events)
     app.router.add_get("/v1/debug/bundle", debug_bundle_endpoint)
     app.router.add_get("/v1/debug/tasks", debug_tasks)

@@ -113,9 +113,13 @@ class ProfileRequest(BaseModel):
     back through the ordinary changed-file map (listed in
     ``profile_files``). ``target="serving"`` captures ``steps`` serving-engine
     batcher steps into a control-plane-local trace directory.
+    ``target="device"`` captures a raw device-runtime trace via
+    ``jax.profiler`` (serving steps when an engine is attached, a probe
+    computation otherwise); 501 with the concrete reason when the runtime
+    cannot trace.
     """
 
-    target: Literal["sandbox", "serving"] = "sandbox"
+    target: Literal["sandbox", "serving", "device"] = "sandbox"
     # sandbox mode (same semantics as ExecuteRequest)
     source_code: str | None = None
     files: dict[AbsolutePath, Hash] = Field(default_factory=dict)

@@ -16,6 +16,8 @@ from bee_code_interpreter_tpu.config import Config
 from bee_code_interpreter_tpu.observability import (
     ContinuousProfiler,
     DemandTracker,
+    DeviceMonitor,
+    DeviceProfiler,
     FleetJournal,
     FlightRecorder,
     Forecaster,
@@ -190,6 +192,23 @@ class ApplicationContext:
             max_requests=self.config.serving_request_records,
         )
         self.serving_profiler = ServingProfiler(self.serving)
+        # Accelerator observability (docs/observability.md "Accelerator
+        # observability"): compile/retrace wide events + counters, the
+        # device-memory sampler (live-buffer estimate on CPU), per-mesh-
+        # shape step timing. Constructed unconditionally — metrics must
+        # exist either way, and the constructor's eager memory sample
+        # registers the HBM gauges; attach_serving_engine binds the
+        # batcher's tracked jits, start_observability starts the sampler.
+        self.device = DeviceMonitor(
+            metrics=self.metrics,
+            recorder=self.flight,
+            sample_interval_s=self.config.device_sample_interval_s,
+            max_compiles=self.config.device_compile_records,
+        )
+        # POST /v1/profile target=device: raw jax.profiler capture —
+        # serving steps when an engine is attached, a probe computation
+        # otherwise (501 when the runtime cannot trace at all).
+        self.device_profiler = DeviceProfiler(self.serving)
         # Telemetry export: with APP_OTLP_ENDPOINT set, finished traces and
         # metric snapshots are pushed OTLP/JSON to the collector by a
         # background exporter (started by __main__ once the loop runs).
@@ -261,6 +280,11 @@ class ApplicationContext:
         # even when its hooks fire from a worker thread (profiler captures)
         # and the engine was attached before the loop existed
         self.serving.arm_loop()
+        if self.config.device_monitor_enabled:
+            # periodic device-memory sampler + compile-event loop binding
+            self.device.start()
+        else:
+            self.device.arm_loop()
         if self.config.contprof_enabled:
             self.contprof.start()
         if self.quota_lease_client is not None:
@@ -272,8 +296,11 @@ class ApplicationContext:
         start flowing, ``GET /v1/serving`` reports it, and ``POST
         /v1/profile`` target=serving captures real batcher steps instead of
         answering 501. Construct the engine with ``metrics=ctx.metrics`` so
-        its aggregate gauges land in the same registry."""
+        its aggregate gauges land in the same registry. The device monitor
+        attaches too: the batcher's tracked jits start reporting compiles
+        and its steps land in the per-mesh-shape aggregates."""
         self.serving.attach(engine)
+        self.device.attach(engine)
 
     def autoscale_snapshot(self) -> dict:
         """The ``GET /v1/autoscale`` document both edges serve — demand
@@ -306,6 +333,7 @@ class ApplicationContext:
             loopmon=self.loopmon,
             contprof=self.contprof,
             serving=self.serving,
+            device=self.device,
             autoscale=self.autoscale_snapshot,
             tenancy=self.tenancy,
         )
@@ -348,6 +376,7 @@ class ApplicationContext:
             # Final best-effort flush (retry-bounded) before teardown.
             await self.exporter.stop()
         self.contprof.stop()
+        self.device.stop()
         await self.loopmon.stop()
         # After the exporter: its final flush may still have drained wide
         # events; the recorder's stop writes its own pending disk segment.
@@ -608,6 +637,8 @@ class ApplicationContext:
             contprof=self.contprof,
             serving=self.serving,
             profiler=self.serving_profiler,
+            device=self.device,
+            device_profiler=self.device_profiler,
             autoscale=self.autoscale_snapshot,
             tenancy=self.tenancy,
         )
@@ -636,6 +667,7 @@ class ApplicationContext:
             loopmon=self.loopmon,
             contprof=self.contprof,
             serving=self.serving,
+            device=self.device,
             autoscale=self.autoscale_snapshot,
             tenancy=self.tenancy,
         )

@@ -199,6 +199,19 @@ class Config(BaseModel):
     # Finished per-request lifecycle records retained for
     # GET /v1/serving/requests (live requests are always reported).
     serving_request_records: int = Field(default=256, ge=1)
+    # --- accelerator observability (docs/observability.md "Accelerator
+    # observability") ---
+    # Gates the background device-memory sampler only: compile/retrace
+    # tracking and per-mesh-shape step telemetry are hook-driven and stay
+    # on whenever a serving engine is attached (their cost is one None
+    # check when nothing is).
+    device_monitor_enabled: bool = True
+    # Device-memory sample cadence (memory_stats on TPU; the live-buffer
+    # estimate walks every live array on CPU, so not too hot).
+    device_sample_interval_s: float = Field(default=10.0, gt=0)
+    # Recent compile records retained for GET /v1/accelerator (lifetime
+    # totals and per-function signature sets are kept regardless).
+    device_compile_records: int = Field(default=256, ge=1)
     # --- telemetry export (docs/observability.md "Telemetry export") ---
     # OTLP/HTTP collector base URL (e.g. http://otel-collector:4318): finished
     # traces and metric snapshots are pushed as OTLP/JSON to

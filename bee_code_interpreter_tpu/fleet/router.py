@@ -141,6 +141,10 @@ class Replica:
     # replica whose mix shows absorbed `accelerator` work is known
     # TPU-capable, and accelerator submissions steer toward it.
     cost_classes: dict = field(default_factory=dict)
+    # Accelerator summary off /v1/fleet (docs/observability.md "Accelerator
+    # observability"): compile/retrace totals, mesh shape, and HBM headroom
+    # — the signal for steering load off retracing or memory-tight replicas.
+    accelerator: dict = field(default_factory=dict)
     draining: bool = False  # the replica says so (/v1/fleet "draining")
     cordoned: bool = False  # the ROUTER says so (drain_replica)
     slo_fast_burn: bool = False
@@ -176,6 +180,7 @@ class Replica:
             "leases": self.leases,
             "tenants": dict(self.tenants),
             "cost_classes": dict(self.cost_classes),
+            "accelerator": dict(self.accelerator),
             "slo_fast_burn": self.slo_fast_burn,
             "breaker": self.breaker.state.name.lower(),
             "ring_share": ring_share,
@@ -676,6 +681,7 @@ class FleetRouter:
         replica.leases = int(sessions.get("active") or 0)
         replica.tenants = dict(fleet.get("tenants") or {})
         replica.cost_classes = dict(fleet.get("cost_classes") or {})
+        replica.accelerator = dict(fleet.get("accelerator") or {})
         replica.slo_fast_burn = bool(slo.get("fast_burn_alerting"))
         replica.last_refresh_mono = self._clock()
         replica.refresh_error = None

@@ -79,6 +79,8 @@ from bee_code_interpreter_tpu.ops.paged_kv_cache import (
     seed_from_contiguous,
     seed_prefill,
 )
+from bee_code_interpreter_tpu.parallel.mesh import mesh_shape_key
+from bee_code_interpreter_tpu.utils.jitwatch import TrackedJit
 
 # physical page 0 is the scratch page: idle rows' block tables point at it,
 # so their (masked, ignored) reads and writes never touch a live request's
@@ -379,6 +381,13 @@ class ContinuousBatcher:
         guaranteed (tests/test_serving_mesh.py)."""
         self.params = params
         self.mesh = mesh
+        # duck-typed observability.DeviceMonitor (compile/retrace tracking
+        # + per-mesh-shape step telemetry); injected via
+        # DeviceMonitor.attach -> set_device_monitor. None keeps every
+        # tracked-jit call a single falsy check. The shape key tags step
+        # records so multi-shape fleets aggregate per mesh.
+        self._device_monitor = None
+        self._mesh_key = mesh_shape_key(mesh)
         if mesh is not None:
             from bee_code_interpreter_tpu.models.transformer import (
                 shard_params,
@@ -519,11 +528,16 @@ class ContinuousBatcher:
         self.prefill_state: dict[int, dict] = {}
         # donate the pool: without aliasing, every decoded token would pay
         # a full page-pool HBM copy (precedent: make_train_step's donation)
-        self._decode = jax.jit(
-            functools.partial(
-                decode_step_paged, config=config, lora_scale=self.lora_scale
+        self._decode = self._track(
+            jax.jit(
+                functools.partial(
+                    decode_step_paged,
+                    config=config,
+                    lora_scale=self.lora_scale,
+                ),
+                donate_argnums=(3,),
             ),
-            donate_argnums=(3,),
+            "decode_step_paged",
         )
         # Admission prefill. With a mesh the full forward runs under it —
         # in particular an ``sp`` axis shards the attention over the
@@ -534,25 +548,36 @@ class ContinuousBatcher:
         # itself stays single-token and ignores sp. ``prefill_chunk``
         # remains the single-chip activation-memory tool; sp admission is
         # the multi-chip one.
-        self._prefill = jax.jit(
-            functools.partial(
-                forward, config=config, return_kv=True, mesh=mesh
-            )
+        self._prefill = self._track(
+            jax.jit(
+                functools.partial(
+                    forward, config=config, return_kv=True, mesh=mesh
+                )
+            ),
+            "prefill_forward",
         )
         # chunked admission compiles once per (total_len, chunk, L) shape —
         # without the jit the remainder window would dispatch op-by-op
         # eagerly on every submit
-        self._prefill_chunked = jax.jit(
-            functools.partial(prefill_chunked, config=config),
-            static_argnames=("total_len", "chunk"),
+        self._prefill_chunked = self._track(
+            jax.jit(
+                functools.partial(prefill_chunked, config=config),
+                static_argnames=("total_len", "chunk"),
+            ),
+            "prefill_chunked",
         )
         # suffix-only admission windows (prefix-cache hits); compiles once
         # per page-aligned window width, bounded by max_pages_per_seq
-        self._window = jax.jit(
-            functools.partial(
-                decode_window_paged, config=config, lora_scale=self.lora_scale
+        self._window = self._track(
+            jax.jit(
+                functools.partial(
+                    decode_window_paged,
+                    config=config,
+                    lora_scale=self.lora_scale,
+                ),
+                donate_argnums=(3,),
             ),
-            donate_argnums=(3,),
+            "decode_window_paged",
         )
         if draft_config is not None:
             # the draft's own paged pool, addressed by the SAME block
@@ -565,22 +590,33 @@ class ContinuousBatcher:
                     draft_params, draft_config, mesh
                 )
                 self.draft_cache = self._shard_pool(self.draft_cache)
-            self._draft_decode = jax.jit(
-                functools.partial(decode_step_paged, config=draft_config),
-                donate_argnums=(3,),
+            self._draft_decode = self._track(
+                jax.jit(
+                    functools.partial(decode_step_paged, config=draft_config),
+                    donate_argnums=(3,),
+                ),
+                "draft_decode_step_paged",
             )
-            self._draft_prefill = jax.jit(
-                functools.partial(
-                    forward, config=draft_config, return_kv=True, mesh=mesh
-                )
+            self._draft_prefill = self._track(
+                jax.jit(
+                    functools.partial(
+                        forward, config=draft_config, return_kv=True, mesh=mesh
+                    )
+                ),
+                "draft_prefill_forward",
             )
             # the verify pass IS a window over the target pool — one jit
             # wrapper (self._window) so a suffix-admission width that
             # happens to equal gamma+1 reuses the compiled program
             self._verify = self._window
-            self._draft_window = jax.jit(
-                functools.partial(decode_window_paged, config=draft_config),
-                donate_argnums=(3,),
+            self._draft_window = self._track(
+                jax.jit(
+                    functools.partial(
+                        decode_window_paged, config=draft_config
+                    ),
+                    donate_argnums=(3,),
+                ),
+                "draft_decode_window_paged",
             )
 
         # Serving-engine instrumentation (docs/observability.md): ``metrics``
@@ -684,6 +720,19 @@ class ContinuousBatcher:
         (observability.ServingMonitor.attach calls this). Requests already
         in flight are not traced retroactively."""
         self._monitor = monitor
+
+    def _track(self, fn, name: str) -> TrackedJit:
+        """Wrap a jit entry point so an attached device monitor sees its
+        compilations. The monitor resolves per call, so attach/detach
+        works after construction and the unmonitored path pays one None
+        check."""
+        return TrackedJit(fn, name, lambda: self._device_monitor)
+
+    def set_device_monitor(self, monitor) -> None:
+        """Attach (or detach, with None) a compile/step telemetry monitor
+        (observability.DeviceMonitor.attach calls this). Programs compiled
+        before attachment are not reported retroactively."""
+        self._device_monitor = monitor
 
     def kv_telemetry(self) -> dict:
         """KV-cache pool telemetry (docs/observability.md "Serving
@@ -1079,6 +1128,31 @@ class ContinuousBatcher:
         self.block_table[row, :] = _SCRATCH_PAGE
         self.block_table[row, :n_need] = pages
 
+        # Admission runs under the request's serving trace (when a monitor
+        # is attached): a compile forced by a new prefill shape lands as an
+        # ``xla.compile`` span inside THIS request's span tree, so the TTFT
+        # it inflated is explained where the operator looks for it
+        # (observability/device.py).
+        admit_ctx = (
+            self._monitor.exemplar_context(req)
+            if self._monitor is not None
+            else nullcontext()
+        )
+        with admit_ctx:
+            return self._blocking_admit(
+                row, prompt, pages, hashes, matched, L, n_need, sampling,
+                max_new_tokens, adapter_internal, speculative,
+                prefill_chunk, req, t_submit,
+            )
+
+    def _blocking_admit(
+        self, row, prompt, pages, hashes, matched, L, n_need, sampling,
+        max_new_tokens, adapter_internal, speculative, prefill_chunk,
+        req, t_submit,
+    ) -> int:
+        """The blocking admission tail of ``submit``: run the prefill,
+        release pages on failure, activate the row. Split out so ``submit``
+        can activate the request's trace around the whole region."""
         try:
             if matched or adapter_internal > 0:
                 # Window-prefill admissions: shared-prefix hits AND every
@@ -1256,15 +1330,23 @@ class ContinuousBatcher:
             win_arr = jnp.asarray(win[None, :])
             pos_arr = jnp.asarray([rec["pos"]], dtype=np.int32)
             t_win = time.monotonic()
-            logits, self.cache = self._window(
-                self.params, win_arr, pos_arr, self.cache, bt_row,
-                **self._lora_kwargs(np.array([rec["adapter_internal"]])),
+            # under the request's trace (monitor attached): a compile
+            # forced by a new window width attributes to THIS request
+            win_ctx = (
+                self._monitor.exemplar_context(rec["req"])
+                if self._monitor is not None
+                else nullcontext()
             )
-            if rec["speculative"]:
-                _, self.draft_cache = self._draft_window(
-                    self.draft_params, win_arr, pos_arr,
-                    self.draft_cache, bt_row,
+            with win_ctx:
+                logits, self.cache = self._window(
+                    self.params, win_arr, pos_arr, self.cache, bt_row,
+                    **self._lora_kwargs(np.array([rec["adapter_internal"]])),
                 )
+                if rec["speculative"]:
+                    _, self.draft_cache = self._draft_window(
+                        self.draft_params, win_arr, pos_arr,
+                        self.draft_cache, bt_row,
+                    )
             idx = rec["L"] - 1 - rec["pos"]  # last REAL token in window?
             if 0 <= idx < win.shape[0]:
                 rec["last_row"] = np.asarray(logits[0, idx], dtype=np.float32)
@@ -1518,7 +1600,11 @@ class ContinuousBatcher:
         each step additionally lands one step record (occupancy, token
         counts, speculative accepts, page churn — see
         docs/observability.md "Serving observability")."""
-        if self._metrics is None and self._monitor is None:
+        if (
+            self._metrics is None
+            and self._monitor is None
+            and self._device_monitor is None
+        ):
             self._step_inner()
             return
         rows_before = int(np.count_nonzero(self.active))
@@ -1542,6 +1628,12 @@ class ContinuousBatcher:
                     )
                 self._rate_samples.append((t1, self.n_tokens_generated))
             self._sync_token_counter()
+        if self._device_monitor is not None:
+            # per-mesh-shape step timing (observability/device.py): the
+            # aggregate behind the tokens/sec-vs-mesh-shape curve
+            self._device_monitor.record_step(
+                (t1 - t0) * 1000.0, shape=self._mesh_key
+            )
         if self._monitor is not None:
             # occupancy is deliberately NOT a field: it is active_rows /
             # max_batch, and the step path builds this record thousands of
@@ -1549,6 +1641,7 @@ class ContinuousBatcher:
             self._monitor.on_step(
                 {
                     "duration_ms": (t1 - t0) * 1000.0,
+                    "mesh": self._mesh_key,
                     "active_rows": rows_before,
                     "active_rows_after": int(np.count_nonzero(self.active)),
                     "prefilling_rows": prefilling_before,

@@ -76,6 +76,7 @@ def run_serving_bench(
         SamplingParams,
     )
     from bee_code_interpreter_tpu.observability import (
+        DeviceMonitor,
         FlightRecorder,
         ServingMonitor,
         TraceStore,
@@ -103,16 +104,20 @@ def run_serving_bench(
     def build(instrumented: bool):
         if instrumented:
             registry = Registry()
+            recorder = FlightRecorder(metrics=registry)
             monitor = ServingMonitor(
-                metrics=registry,
-                store=TraceStore(),
-                recorder=FlightRecorder(metrics=registry),
+                metrics=registry, store=TraceStore(), recorder=recorder
             )
             batcher = ContinuousBatcher(
                 params, config, metrics=registry, **geometry
             )
             engine = Engine(batcher, metrics=registry)
             monitor.attach(engine)
+            # The accelerator plane rides the instrumented arm too: the
+            # overhead number must price compile tracking + per-step mesh
+            # telemetry, not just the serving monitor
+            # (docs/observability.md "Accelerator observability").
+            DeviceMonitor(metrics=registry, recorder=recorder).attach(engine)
             return engine, monitor
         return Engine(ContinuousBatcher(params, config, **geometry)), None
 

@@ -23,6 +23,9 @@ from bee_code_interpreter_tpu.observability.contprof import (
     ContinuousProfiler,
     collapse_stack,
 )
+from bee_code_interpreter_tpu.observability.device import (
+    DeviceMonitor,
+)
 from bee_code_interpreter_tpu.observability.forecast import (
     Forecaster,
     recommend_replicas,
@@ -47,6 +50,7 @@ from bee_code_interpreter_tpu.observability.logging import JsonLogFormatter
 from bee_code_interpreter_tpu.observability.profiling import (
     PROFILE_DIR_ENV,
     SANDBOX_PROFILE_DIR,
+    DeviceProfiler,
     ProfilerUnavailable,
     ServingProfiler,
     inject_profile_env,
@@ -100,6 +104,8 @@ from bee_code_interpreter_tpu.observability.slo import (  # noqa: E402
 __all__ = [
     "ContinuousProfiler",
     "DemandTracker",
+    "DeviceMonitor",
+    "DeviceProfiler",
     "Forecaster",
     "FederationPlane",
     "FleetJournal",

@@ -60,6 +60,7 @@ def build_debug_bundle(
     loopmon=None,
     contprof=None,
     serving=None,
+    device=None,  # observability.DeviceMonitor (accelerator section)
     autoscale=None,  # callable -> dict (resilience.autoscale_snapshot)
     tenancy=None,  # tenancy.TenantRegistry (per-tenant view in the bundle)
     recent_traces: int = 50,
@@ -134,6 +135,12 @@ def build_debug_bundle(
     bundle["serving"] = (
         serving.snapshot(steps=serving_steps) if serving is not None else None
     )
+
+    # Accelerator observability (docs/observability.md "Accelerator
+    # observability"): compile/retrace totals + per-function signature
+    # sets, the latest device-memory sample (estimated on CPU), KV-pool
+    # occupancy, and per-mesh-shape step timing.
+    bundle["accelerator"] = device.snapshot() if device is not None else None
 
     # Capacity observability (docs/autoscaling.md): demand, forecast, and
     # the autoscaler's target + decision log — the "was the pool sized for

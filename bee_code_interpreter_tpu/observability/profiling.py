@@ -135,3 +135,100 @@ class ServingProfiler:
             "steps": steps,
             "duration_ms": (time.monotonic() - t0) * 1000.0,
         }
+
+
+class DeviceProfiler:
+    """``POST /v1/profile target=device``: one on-demand ``jax.profiler``
+    trace directory of raw device activity (docs/observability.md
+    "Accelerator observability").
+
+    Unlike ``target=serving`` this does not REQUIRE an engine: with one
+    attached (``stepper.available``) the capture windows real batcher
+    steps; without one it runs a small probe computation so the timeline
+    is never empty — the capture is about the DEVICE runtime (XLA ops,
+    transfers, compilation), not the serving loop. Raises
+    :class:`ProfilerUnavailable` with the concrete reason (the edge's 501
+    body) when the runtime cannot trace at all.
+    """
+
+    def __init__(self, stepper=None, trace_root: str | Path | None = None) -> None:
+        self._stepper = stepper
+        self._trace_root = str(trace_root) if trace_root else None
+        self._capturing = False
+        self._lock = threading.Lock()
+
+    @property
+    def capturing(self) -> bool:
+        return self._capturing
+
+    @property
+    def available(self) -> bool:
+        """True when jax.profiler is importable here. Whether start_trace
+        actually works on this backend is only knowable by trying — the
+        capture path turns that failure into ProfilerUnavailable."""
+        try:
+            import jax.profiler  # noqa: F401
+        except Exception:
+            return False
+        return True
+
+    def capture(self, steps: int = 8) -> dict:
+        """Capture a device trace: ``steps`` engine steps when an engine is
+        attached, a probe computation otherwise. Returns the
+        ``ServingProfiler.capture`` shape plus ``source`` =
+        ``serving|probe``."""
+        if steps < 1:
+            raise ValueError(f"steps must be >= 1, got {steps}")
+        with self._lock:
+            if self._capturing:
+                raise ProfilerUnavailable("a capture is already in progress")
+            self._capturing = True
+        try:
+            try:
+                import jax
+                import jax.numpy as jnp
+            except ImportError as e:  # pragma: no cover - jax is baked in
+                raise ProfilerUnavailable(f"jax not importable: {e}") from e
+            trace_dir = tempfile.mkdtemp(
+                prefix="bci-device-profile-", dir=self._trace_root
+            )
+            t0 = time.monotonic()
+            try:
+                jax.profiler.start_trace(trace_dir)
+            except Exception as e:
+                shutil.rmtree(trace_dir, ignore_errors=True)
+                raise ProfilerUnavailable(
+                    f"jax.profiler cannot trace on this runtime: {e}"
+                ) from e
+            stepped = bool(
+                self._stepper is not None
+                and getattr(self._stepper, "available", True)
+            )
+            try:
+                if stepped:
+                    for _ in range(steps):
+                        self._stepper.step()
+                else:
+                    x = jnp.ones((256, 256), dtype=jnp.float32)
+                    for _ in range(steps):
+                        x = x @ x / 256.0
+                    x.block_until_ready()
+            finally:
+                try:
+                    jax.profiler.stop_trace()
+                except Exception:
+                    pass
+        finally:
+            self._capturing = False
+        files = sorted(
+            str(Path(root, name).relative_to(trace_dir))
+            for root, _dirs, names in os.walk(trace_dir)
+            for name in names
+        )
+        return {
+            "trace_dir": trace_dir,
+            "files": files,
+            "steps": steps,
+            "source": "serving" if stepped else "probe",
+            "duration_ms": (time.monotonic() - t0) * 1000.0,
+        }

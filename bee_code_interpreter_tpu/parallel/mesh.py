@@ -109,6 +109,51 @@ def auto_mesh(n_devices: int | None = None, *, sp: int = 1) -> Mesh:
     return make_mesh({"dp": dp, "sp": sp, "tp": tp})
 
 
+def axis_size_compat(axis_name: str) -> int:
+    """Static size of a named mesh axis from inside ``shard_map`` across
+    jax versions: new jax has ``lax.axis_size``; on 0.4.x ``psum(1, axis)``
+    constant-folds to the same static int."""
+    from jax import lax
+
+    if hasattr(lax, "axis_size"):
+        return lax.axis_size(axis_name)
+    return lax.psum(1, axis_name)
+
+
+def pcast_compat(x, axes, *, to="varying"):
+    """``lax.pcast`` across jax versions: marks a value varying over mesh
+    axes for the vma type system. 0.4.x has no vma typing (and
+    ``shard_map_compat`` runs it with the replication check off), so the
+    cast is the identity there."""
+    from jax import lax
+
+    if hasattr(lax, "pcast"):
+        return lax.pcast(x, axes, to=to)
+    return x
+
+
+def shard_map_compat(f, *, mesh, in_specs, out_specs, check_vma=True):
+    """``jax.shard_map`` across jax versions: new jax exposes it at the top
+    level with ``check_vma``; 0.4.x spells it ``jax.experimental.shard_map
+    .shard_map``. Every shard_map call in models/ and parallel/ routes
+    through here. On 0.4.x the replication checker (``check_rep``) predates
+    vma typing and rejects valid ``lax.cond`` bodies (the ring/pipeline
+    hop-skipping pattern) with "mismatched replication types", so the
+    legacy path always disables it — ``check_vma`` only reaches a backend
+    that can actually honor it."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=check_vma,
+        )
+    from jax.experimental.shard_map import shard_map
+
+    return shard_map(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        check_rep=False,
+    )
+
+
 def sharding(mesh: Mesh, *spec) -> NamedSharding:
     return NamedSharding(mesh, P(*spec))
 
@@ -131,3 +176,57 @@ def batch_axes(mesh: Mesh | None) -> tuple[str, ...] | None:
 
 def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
+
+
+def mesh_shape_key(mesh: Mesh | None) -> str:
+    """Stable string key for a mesh's axis sizes (``"dp=2,tp=4"``) — the
+    per-shape bucket the step-telemetry aggregates group under. ``"1"``
+    for no mesh (single-device serving)."""
+    if mesh is None:
+        return "1"
+    key = ",".join(
+        f"{name}={int(size)}"
+        for name, size in zip(mesh.axis_names, mesh.devices.shape)
+    )
+    return key or "1"
+
+
+def mesh_descriptor(mesh: Mesh | None) -> dict:
+    """JSON-able description of a mesh for telemetry (observability's
+    ``GET /v1/accelerator``): axis names/sizes, device counts, this
+    process's position in the grid (the coordinates of its first local
+    device per axis — dp/tp placement for multi-host step records), and
+    the device platform. With no mesh, the single-device degradation:
+    axes ``{}``, shape ``"1"``."""
+    process_index = int(jax.process_index())
+    if mesh is None:
+        devices = jax.devices()
+        return {
+            "axes": {},
+            "shape": "1",
+            "n_devices": 1,
+            "n_local_devices": 1,
+            "process_index": process_index,
+            "coords": {},
+            "platform": devices[0].platform if devices else "unknown",
+        }
+    local = [d for d in mesh.devices.flat if d.process_index == process_index]
+    coords: dict[str, int] = {}
+    if local:
+        idx = np.argwhere(mesh.devices == local[0])
+        if idx.size:
+            coords = {
+                name: int(i) for name, i in zip(mesh.axis_names, idx[0])
+            }
+    return {
+        "axes": {
+            name: int(size)
+            for name, size in zip(mesh.axis_names, mesh.devices.shape)
+        },
+        "shape": mesh_shape_key(mesh),
+        "n_devices": int(mesh.devices.size),
+        "n_local_devices": len(local),
+        "process_index": process_index,
+        "coords": coords,
+        "platform": local[0].platform if local else "unknown",
+    }

@@ -1696,6 +1696,92 @@ async def main() -> int:
             for s in stacks17:
                 await s.stop()
 
+        # 19. accelerator observability: a forced retrace during a live
+        #     serving request is accounted exactly once — ONE kind="compile"
+        #     wide event per new executable, the bci_compile_total{retrace}
+        #     counter, a backdated xla.compile span inside the REQUEST's
+        #     trace, and GET-/v1/accelerator-shape totals all agreeing on
+        #     the same numbers and the same trace_id
+        #     (docs/observability.md "Accelerator observability"; scenario
+        #     18 is the capacity flash crowd in tests/test_chaos_capacity.py).
+        from bee_code_interpreter_tpu.observability import DeviceMonitor
+
+        m19 = Registry()
+        recorder19 = FlightRecorder(max_events=256, metrics=m19)
+        store19 = TraceStore()
+        monitor19 = ServingMonitor(
+            metrics=m19, store=store19, recorder=recorder19
+        )
+        device19 = DeviceMonitor(metrics=m19, recorder=recorder19)
+        batcher19 = ContinuousBatcher(
+            T.init_params(cfg12, jax.random.PRNGKey(0)), cfg12,
+            max_batch=2, n_pages=16, page_size=4, max_pages_per_seq=4,
+            metrics=m19,
+        )
+        engine19 = Engine(batcher19, max_queue=4, metrics=m19)
+        monitor19.attach(engine19)
+        device19.attach(engine19)
+
+        # Request A: first contact — everything compiles as first_call.
+        t19a = engine19.submit([1, 2, 3], 4)
+        await asyncio.to_thread(engine19.run_to_completion)
+        first_calls19 = recorder19.events(kind="compile", limit=100)
+        baseline_retraces19 = device19.snapshot()["compile"]["by_trigger"].get(
+            "retrace", 0
+        )
+
+        # Request B: a longer prompt pads to MORE pages -> a new prefill
+        # shape -> XLA retraces while the request is live.
+        t19b = engine19.submit([5, 3, 7, 2, 9, 11], 4)
+        await asyncio.to_thread(engine19.run_to_completion)
+        ok19 = (
+            len(engine19.result(t19a)) == 4
+            and len(engine19.result(t19b)) == 4
+        )
+
+        retrace_events19 = [
+            e
+            for e in recorder19.events(kind="compile", limit=100)
+            if e["trigger"] == "retrace"
+        ]
+        snap19 = device19.snapshot()
+        n_retraces19 = len(retrace_events19) - baseline_retraces19
+        text19 = m19.expose()
+        counter19 = 0
+        for line in text19.splitlines():
+            if line.startswith('bci_compile_total{trigger="retrace"}'):
+                counter19 = int(float(line.split()[-1]))
+        trace_ids19 = {e.get("trace_id") for e in retrace_events19}
+        # the retrace happened during ONE live request: every retrace event
+        # names that request's trace, and that trace holds the compile span
+        tid19 = next(iter(trace_ids19), None)
+        trace19 = store19.get(tid19) if tid19 else None
+        compile_spans19 = [
+            s
+            for s in (trace19.spans if trace19 is not None else [])
+            if s.name == "xla.compile"
+        ]
+        report(
+            "forced retrace during live serving accounted exactly once "
+            "across event/counter/span/snapshot, one trace_id",
+            ok19
+            and n_retraces19 >= 1
+            and len(first_calls19) >= 1
+            and all(
+                e["trigger"] == "first_call" for e in first_calls19
+            )
+            and counter19 == len(retrace_events19)
+            and snap19["compile"]["by_trigger"].get("retrace", 0)
+            == len(retrace_events19)
+            and snap19["compile"]["total"]
+            == len(recorder19.events(kind="compile", limit=100))
+            and len(trace_ids19) == 1
+            and tid19 is not None
+            and len(compile_spans19) == len(retrace_events19),
+            f"retraces={n_retraces19} counter={counter19} "
+            f"trace_ids={trace_ids19} spans={len(compile_spans19)}",
+        )
+
         text = metrics.expose()
         wanted = [
             "bci_executor_fallback_total 1",
@@ -1721,7 +1807,8 @@ async def main() -> int:
         "supervisor, watchdog, drain, telemetry export, edge analysis gate, "
         "sessions-under-chaos, flight-recorder-logs, serving-saturation, "
         "autoscale-10x-step, fleet-router-kill, abusive-tenant, "
-        "fleet-wide-tenancy, fleet-observability all behaved"
+        "fleet-wide-tenancy, fleet-observability, accelerator-compile "
+        "all behaved"
     )
     return 0
 

@@ -67,6 +67,18 @@ def render(event: dict) -> str:
         extras.append(
             f"{event.get('from', '?')}->{event.get('to') or '?'}"
         )
+    if event.get("kind") == "compile":
+        # One XLA compilation (docs/observability.md "Accelerator
+        # observability"): which jitted function, what triggered it
+        # (first_call vs retrace), and the abstract input signature that
+        # forced the new executable.
+        extras.append(
+            f"{event.get('function', '?')}[{event.get('trigger', '?')}]"
+        )
+        if event.get("signature"):
+            extras.append(f"sig={event['signature']}")
+        if event.get("mesh"):
+            extras.append(f"mesh={event['mesh']}")
     if event.get("kind") == "autoscale":
         # One scaling decision (docs/autoscaling.md): direction, size
         # delta, reason, and whether act mode actually moved the pool.
@@ -132,7 +144,8 @@ def main() -> int:
     parser.add_argument("--tenant", help="filter by tenant label")
     parser.add_argument(
         "--kind",
-        help="filter by kind (request/session/serving/loop_stall/autoscale)",
+        help="filter by kind (request/session/serving/compile/loop_stall/"
+        "autoscale)",
     )
     parser.add_argument("--min-duration-ms", type=float, default=None)
     parser.add_argument(

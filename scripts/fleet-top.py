@@ -90,6 +90,27 @@ def render_snapshot(snap: dict) -> str:
                 f"{name}={count}" for name, count in sorted(tenants.items())
             )
         )
+    accel = snap.get("accelerator")
+    if accel:
+        # Accelerator summary (docs/observability.md "Accelerator
+        # observability"): compile/retrace totals + HBM headroom — the
+        # placement signal the FleetRouter reads off this same field.
+        hbm = accel.get("hbm") or {}
+        live = hbm.get("live_bytes")
+        limit = hbm.get("limit_bytes")
+        hbm_part = (
+            f"hbm={live / (1 << 20):.1f}MiB" if live is not None else "hbm=-"
+        )
+        if limit:
+            hbm_part += f"/{limit / (1 << 20):.1f}MiB"
+        if hbm.get("estimated"):
+            hbm_part += " (estimated)"
+        lines.append(
+            f"accelerator: mesh={accel.get('mesh') or '1'}"
+            f"  compiles={accel.get('compiles', 0)}"
+            f"  retraces={accel.get('retraces', 0)}"
+            f"  {hbm_part}"
+        )
     sess = snap.get("sessions")
     if sess:
         lines.append(

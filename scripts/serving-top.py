@@ -82,6 +82,54 @@ def render_summary(snap: dict) -> str:
     return "\n".join(lines)
 
 
+def fmt_bytes(n: float | None) -> str:
+    if n is None:
+        return "-"
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if abs(n) < 1024 or unit == "TiB":
+            return f"{n:.0f}{unit}" if unit == "B" else f"{n:.1f}{unit}"
+        n /= 1024
+    return "-"
+
+
+def render_accelerator(snap: dict | None) -> str:
+    """The compile/HBM pane off ``GET /v1/accelerator``
+    (docs/observability.md "Accelerator observability")."""
+    if not snap:
+        return ""
+    compile_ = snap.get("compile", {})
+    by_trigger = compile_.get("by_trigger", {})
+    mesh = snap.get("mesh") or {}
+    lines = [
+        f"accelerator: mesh={mesh.get('shape', '1')}"
+        f"  compiles={compile_.get('total', 0)}"
+        f" (first_call={by_trigger.get('first_call', 0)},"
+        f" retrace={by_trigger.get('retrace', 0)})"
+    ]
+    memory = snap.get("memory", {})
+    for dev in memory.get("devices", []):
+        est = " (estimated)" if dev.get("estimated") else ""
+        lines.append(
+            f"  hbm {dev.get('device', '-')}:"
+            f" live={fmt_bytes(dev.get('live_bytes'))}"
+            f" peak={fmt_bytes(dev.get('peak_bytes'))}"
+            f" limit={fmt_bytes(dev.get('limit_bytes'))}{est}"
+        )
+    recent = compile_.get("recent", [])
+    if recent:
+        lines.append(
+            f"  {'SEQ':>4} {'TRIGGER':<10} {'WALL':>8} "
+            f"{'FUNCTION':<24} SIGNATURE"
+        )
+        for c in recent:
+            lines.append(
+                f"  {c.get('seq', 0):>4} {c.get('trigger', '-'):<10} "
+                f"{fmt_ms(c.get('duration_ms')):>8} "
+                f"{c.get('function', '-'):<24} {c.get('signature', '-')}"
+            )
+    return "\n".join(lines)
+
+
 def render_steps(snap: dict) -> str:
     steps = snap.get("steps", {})
     last = steps.get("last", [])
@@ -143,6 +191,14 @@ def render_once(
     print(render_summary(snap))
     if snap.get("attached"):
         print(render_steps(snap))
+    # Compile/HBM pane: tolerate servers predating /v1/accelerator.
+    accel_resp = client.get(
+        f"{base}/v1/accelerator", params={"recent": min(steps, 8)}
+    )
+    if accel_resp.status_code == 200:
+        pane = render_accelerator(accel_resp.json())
+        if pane:
+            print(pane)
     if requests > 0:
         rows = (
             client.get(

@@ -85,6 +85,16 @@ def register_router_metrics(registry: Registry) -> None:
     asyncio.run(router.stop())
 
 
+def register_device_metrics(registry: Registry) -> None:
+    """The accelerator plane (ISSUE 20): DeviceMonitor registers the
+    compile/step families at construction, and its eager memory sample
+    creates the per-device ``bci_device_hbm_bytes`` gauge series — no
+    batcher attachment needed."""
+    from bee_code_interpreter_tpu.observability.device import DeviceMonitor
+
+    DeviceMonitor(metrics=registry)
+
+
 def register_loadgen_metrics(registry: Registry) -> None:
     """The capacity harness's client-side family (ISSUE 18): the open-loop
     generator registers its sent/lag/offered surface when handed a
@@ -101,6 +111,7 @@ def test_every_registered_metric_follows_conventions(tmp_path):
     register_serving_metrics(registry)
     register_router_metrics(registry)
     register_loadgen_metrics(registry)
+    register_device_metrics(registry)
     metrics = registry.metrics
     assert len(metrics) >= 20, sorted(metrics)  # the wiring actually ran
 
@@ -224,6 +235,13 @@ def test_every_registered_metric_follows_conventions(tmp_path):
         "bci_loadgen_lag_seconds",
         "bci_loadgen_offered_rps",
         "bci_fleet_target_replicas",
+        # accelerator observability plane (ISSUE 20): compile/retrace
+        # tracking, per-device HBM accounting, and mesh-shaped step
+        # telemetry from the DeviceMonitor
+        "bci_compile_total",
+        "bci_compile_seconds",
+        "bci_device_hbm_bytes",
+        "bci_device_step_seconds",
     ):
         assert required in metrics, f"{required}: not registered by the wiring"
     assert isinstance(metrics["bci_pool_spawn_seconds"], Histogram)
@@ -291,6 +309,10 @@ def test_every_registered_metric_follows_conventions(tmp_path):
     assert isinstance(metrics["bci_loadgen_lag_seconds"], Histogram)
     assert isinstance(metrics["bci_loadgen_offered_rps"], Gauge)
     assert isinstance(metrics["bci_fleet_target_replicas"], Gauge)
+    assert isinstance(metrics["bci_compile_total"], Counter)
+    assert isinstance(metrics["bci_compile_seconds"], Histogram)
+    assert isinstance(metrics["bci_device_hbm_bytes"], Gauge)
+    assert isinstance(metrics["bci_device_step_seconds"], Histogram)
 
     for name, metric in metrics.items():
         assert name.startswith("bci_"), (
